@@ -1,0 +1,25 @@
+"""hadoop_tpu — a TPU-native distributed storage, scheduling and compute framework.
+
+Capability-equivalent rebuild of Apache Hadoop (HDFS + YARN + MapReduce + Common)
+for TPU-VM pods:
+
+- ``hadoop_tpu.conf``      layered typed configuration    (ref: conf/Configuration.java)
+- ``hadoop_tpu.service``   service lifecycle              (ref: service/AbstractService.java)
+- ``hadoop_tpu.ipc``       RPC client/server with QoS     (ref: ipc/Server.java, ipc/Client.java)
+- ``hadoop_tpu.io``        serialization, codecs, EC      (ref: io/)
+- ``hadoop_tpu.fs``        FileSystem SPI                 (ref: fs/FileSystem.java)
+- ``hadoop_tpu.dfs``       distributed filesystem         (ref: hadoop-hdfs-project)
+- ``hadoop_tpu.yarn``      resource manager / node agents (ref: hadoop-yarn-project)
+- ``hadoop_tpu.mr``        map/shuffle/reduce engine      (ref: hadoop-mapreduce-project)
+- ``hadoop_tpu.parallel``  device meshes + ICI collectives (TPU-native data plane)
+- ``hadoop_tpu.ops``       Pallas/XLA kernels (CRC, EC, sort)
+- ``hadoop_tpu.metrics``   metrics registry + sinks       (ref: metrics2/)
+- ``hadoop_tpu.security``  auth context / tokens seam     (ref: security/UserGroupInformation.java)
+
+Control plane is host-side Python over DCN; bulk data rides either host streams
+(storage) or XLA collectives over ICI (compute); hot host kernels are C++
+(``hadoop_tpu.native``) with pure-Python fallbacks, mirroring the reference's
+optional-native policy (BUILDING.txt:173-183).
+"""
+
+__version__ = "0.1.0"

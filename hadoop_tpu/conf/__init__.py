@@ -1,0 +1,3 @@
+from hadoop_tpu.conf.configuration import Configuration, ConfigRegistry
+
+__all__ = ["Configuration", "ConfigRegistry"]

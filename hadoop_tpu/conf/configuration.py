@@ -1,0 +1,373 @@
+"""Layered, typed key/value configuration.
+
+Capability parity with the reference's ``conf/Configuration.java`` (3,968 LoC;
+see SURVEY.md §5.6): default resources overlaid by site resources, ``${var}``
+expansion (with environment fallback ``${env.VAR}``), a deprecation table that
+maps old keys to new ones with warn-once semantics, typed getters, final
+(unoverridable) properties, and live reconfiguration hooks
+(ref: conf/ReconfigurableBase.java).
+
+Differences from the reference, by design: resources are TOML-ish flat
+``key = value`` text or JSON dicts rather than Hadoop XML — there is no XML
+ecosystem to stay compatible with, and flat files diff cleanly.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import logging
+import os
+import re
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+_VAR_PATTERN = re.compile(r"\$\{([^}$\s]+)\}")
+_MAX_SUBST_DEPTH = 20
+
+# Size suffixes accepted by get_size_bytes (ref: Configuration.getLongBytes /
+# StringUtils.TraditionalBinaryPrefix).
+_SIZE_SUFFIXES = {
+    "k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40, "p": 1 << 50,
+}
+_TIME_SUFFIXES = {  # ref: Configuration.getTimeDuration
+    "ns": 1e-9, "us": 1e-6, "ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0,
+}
+
+_TRUE = {"true", "yes", "on", "1"}
+_FALSE = {"false", "no", "off", "0"}
+
+
+class DeprecationDelta:
+    """One deprecated key and its replacement(s). Ref: Configuration.DeprecationDelta."""
+
+    def __init__(self, old_key: str, new_keys: List[str], message: Optional[str] = None):
+        self.old_key = old_key
+        self.new_keys = list(new_keys)
+        self.message = message or (
+            f"{old_key} is deprecated. Instead, use {', '.join(new_keys)}"
+        )
+        self.warned = False
+
+
+class ConfigRegistry:
+    """Process-wide default resources + deprecation table.
+
+    Ref: Configuration.addDefaultResource / Configuration.addDeprecations —
+    statics on the Java class; here an explicit singleton so tests can reset it.
+    """
+
+    _lock = threading.Lock()
+    _default_resources: List[Dict[str, str]] = []
+    _deprecations: Dict[str, DeprecationDelta] = {}
+
+    @classmethod
+    def add_default_resource(cls, resource: Dict[str, str]) -> None:
+        with cls._lock:
+            cls._default_resources.append(dict(resource))
+
+    @classmethod
+    def add_deprecations(cls, deltas: List[DeprecationDelta]) -> None:
+        with cls._lock:
+            for d in deltas:
+                cls._deprecations[d.old_key] = d
+
+    @classmethod
+    def deprecation_for(cls, key: str) -> Optional[DeprecationDelta]:
+        return cls._deprecations.get(key)
+
+    @classmethod
+    def default_resources(cls) -> List[Dict[str, str]]:
+        with cls._lock:
+            return list(cls._default_resources)
+
+    @classmethod
+    def reset_for_tests(cls) -> None:
+        with cls._lock:
+            cls._default_resources = []
+            cls._deprecations = {}
+
+
+class Configuration:
+    """Layered key/value store with typed access and variable expansion."""
+
+    def __init__(self, other: Optional["Configuration"] = None,
+                 load_defaults: bool = True):
+        self._lock = threading.RLock()
+        self._props: Dict[str, str] = {}
+        self._finals: set = set()
+        self._sources: Dict[str, str] = {}
+        self._reconf_listeners: List[Callable[[str, Optional[str], Optional[str]], None]] = []
+        if other is not None:
+            with other._lock:
+                self._props = dict(other._props)
+                self._finals = set(other._finals)
+                self._sources = dict(other._sources)
+        elif load_defaults:
+            for res in ConfigRegistry.default_resources():
+                self._merge(res, source="default", respect_final=False)
+
+    # ------------------------------------------------------------------ load
+
+    def _merge(self, props: Dict[str, Any], source: str,
+               respect_final: bool = True,
+               final_keys: Optional[set] = None) -> None:
+        for k, v in props.items():
+            k = self._handle_deprecation_on_set(k)
+            if respect_final and k in self._finals:
+                log.warning("Ignoring override of final parameter %s from %s", k, source)
+                continue
+            self._props[k] = str(v)
+            self._sources[k] = source
+            if final_keys and k in final_keys:
+                self._finals.add(k)
+
+    def add_resource(self, resource, source: Optional[str] = None) -> None:
+        """Overlay a resource: a dict, a JSON file path, or a flat key=value file.
+
+        Flat format: one ``key = value`` per line, '#' comments, and an optional
+        ``!final`` suffix marking the property final (ref: <final>true</final>).
+        """
+        if isinstance(resource, dict):
+            self._merge(resource, source or "dict")
+            return
+        path = str(resource)
+        finals: set = set()
+        props: Dict[str, str] = {}
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+        stripped = text.lstrip()
+        if stripped.startswith("{"):
+            props = {str(k): str(v) for k, v in json.loads(text).items()}
+        else:
+            for lineno, line in enumerate(text.splitlines(), 1):
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                if "=" not in line:
+                    raise ValueError(f"{path}:{lineno}: expected 'key = value'")
+                k, v = line.split("=", 1)
+                k, v = k.strip(), v.strip()
+                if v.endswith("!final"):
+                    v = v[: -len("!final")].rstrip()
+                    finals.add(k)
+                props[k] = v
+        self._merge(props, source or path, final_keys=finals)
+
+    # ------------------------------------------------------- deprecation
+
+    def _handle_deprecation_on_set(self, key: str) -> str:
+        d = ConfigRegistry.deprecation_for(key)
+        if d is None:
+            return key
+        if not d.warned:
+            log.warning("%s", d.message)
+            d.warned = True
+        return d.new_keys[0] if d.new_keys else key
+
+    def _resolve_keys(self, key: str) -> List[str]:
+        """All storage keys this lookup key may live under (new names first)."""
+        d = ConfigRegistry.deprecation_for(key)
+        if d is None:
+            return [key]
+        if not d.warned:
+            log.warning("%s", d.message)
+            d.warned = True
+        return d.new_keys + [key]
+
+    # ------------------------------------------------------------ raw get/set
+
+    def get_raw(self, key: str) -> Optional[str]:
+        with self._lock:
+            for k in self._resolve_keys(key):
+                if k in self._props:
+                    return self._props[k]
+        return None
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        raw = self.get_raw(key)
+        if raw is None:
+            return default
+        return self._substitute(raw)
+
+    def get_trimmed(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        v = self.get(key, default)
+        return v.strip() if isinstance(v, str) else v
+
+    def set(self, key: str, value: Any, source: str = "programmatic") -> None:
+        with self._lock:
+            k = self._handle_deprecation_on_set(key)
+            old = self._props.get(k)
+            self._props[k] = str(value)
+            self._sources[k] = source
+            listeners = list(self._reconf_listeners)
+        for cb in listeners:
+            cb(k, old, str(value))
+
+    def unset(self, key: str) -> None:
+        with self._lock:
+            for k in self._resolve_keys(key):
+                self._props.pop(k, None)
+                self._sources.pop(k, None)
+                self._finals.discard(k)
+
+    def set_if_unset(self, key: str, value: Any) -> None:
+        if self.get_raw(key) is None:
+            self.set(key, value)
+
+    # -------------------------------------------------------- substitution
+
+    def _substitute(self, value: str, depth: int = 0) -> str:
+        """${var} expansion against other keys, then ${env.NAME}. Ref:
+        Configuration.substituteVars (MAX_SUBST=20)."""
+        if depth >= _MAX_SUBST_DEPTH or "${" not in value:
+            return value
+
+        def repl(m: re.Match) -> str:
+            name = m.group(1)
+            if name.startswith("env."):
+                return os.environ.get(name[4:], m.group(0))
+            with self._lock:
+                inner = self._props.get(name)
+            if inner is None:
+                return m.group(0)
+            return self._substitute(inner, depth + 1)
+
+        return _VAR_PATTERN.sub(repl, value)
+
+    # ------------------------------------------------------------ typed gets
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        v = self.get_trimmed(key)
+        if v is None or v == "":
+            return default
+        if v.lower().startswith("0x"):
+            return int(v, 16)
+        return int(v)
+
+    def get_float(self, key: str, default: float = 0.0) -> float:
+        v = self.get_trimmed(key)
+        return default if v is None or v == "" else float(v)
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self.get_trimmed(key)
+        if v is None:
+            return default
+        vl = v.lower()
+        if vl in _TRUE:
+            return True
+        if vl in _FALSE:
+            return False
+        return default
+
+    def get_size_bytes(self, key: str, default: int = 0) -> int:
+        """'64m' → 67108864. Ref: Configuration.getLongBytes."""
+        v = self.get_trimmed(key)
+        if v is None or v == "":
+            return default
+        vl = v.lower()
+        if vl[-1] in _SIZE_SUFFIXES and not vl[-1].isdigit():
+            return int(float(vl[:-1]) * _SIZE_SUFFIXES[vl[-1]])
+        return int(v)
+
+    def get_time_seconds(self, key: str, default: float = 0.0) -> float:
+        """'30s' / '5m' / '100ms' → seconds. Ref: Configuration.getTimeDuration."""
+        v = self.get_trimmed(key)
+        if v is None or v == "":
+            return default
+        vl = v.lower()
+        for suf in sorted(_TIME_SUFFIXES, key=len, reverse=True):
+            if vl.endswith(suf) and not vl[: -len(suf)] == "":
+                head = vl[: -len(suf)]
+                try:
+                    return float(head) * _TIME_SUFFIXES[suf]
+                except ValueError:
+                    continue
+        return float(vl)
+
+    def get_list(self, key: str, default: Optional[List[str]] = None) -> List[str]:
+        v = self.get_trimmed(key)
+        if v is None or v == "":
+            return list(default) if default else []
+        return [s.strip() for s in v.split(",") if s.strip()]
+
+    def get_range(self, key: str, default: str = "") -> List[int]:
+        """'2000-2010,2020' → expanded int list. Ref: Configuration.getRange."""
+        v = self.get_trimmed(key, default)
+        out: List[int] = []
+        if not v:
+            return out
+        for part in v.split(","):
+            part = part.strip()
+            if "-" in part:
+                lo, hi = part.split("-", 1)
+                out.extend(range(int(lo), int(hi) + 1))
+            elif part:
+                out.append(int(part))
+        return out
+
+    def get_class(self, key: str, default: Optional[type] = None) -> Optional[type]:
+        """Resolve a dotted class name. Ref: Configuration.getClass."""
+        v = self.get_trimmed(key)
+        if v is None or v == "":
+            return default
+        mod, _, cls = v.rpartition(".")
+        import importlib
+        return getattr(importlib.import_module(mod), cls)
+
+    # --------------------------------------------------------- introspection
+
+    def get_property_source(self, key: str) -> Optional[str]:
+        with self._lock:
+            for k in self._resolve_keys(key):
+                if k in self._sources:
+                    return self._sources[k]
+        return None
+
+    def get_by_prefix(self, prefix: str) -> Dict[str, str]:
+        """Ref: Configuration.getPropsWithPrefix (keys with prefix stripped)."""
+        with self._lock:
+            return {
+                k[len(prefix):]: self._substitute(v)
+                for k, v in self._props.items() if k.startswith(prefix)
+            }
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._props)
+
+    def __contains__(self, key: str) -> bool:
+        return self.get_raw(key) is not None
+
+    def __iter__(self) -> Iterator[Tuple[str, str]]:
+        with self._lock:
+            items = list(self._props.items())
+        return iter(items)
+
+    def to_dict(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._props)
+
+    def copy(self) -> "Configuration":
+        return Configuration(other=self)
+
+    def write_json(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+
+    # ------------------------------------------------------ reconfiguration
+
+    def register_reconfigure_listener(
+            self, cb: Callable[[str, Optional[str], Optional[str]], None]) -> None:
+        """Live-reconfiguration hook (ref: conf/ReconfigurableBase.java):
+        cb(key, old_value, new_value) fires on every set()."""
+        with self._lock:
+            self._reconf_listeners.append(cb)
+
+    def __deepcopy__(self, memo):
+        return Configuration(other=self)
+
+    def __repr__(self) -> str:
+        return f"Configuration({self.size()} props)"

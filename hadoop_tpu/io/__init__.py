@@ -1,0 +1,3 @@
+from hadoop_tpu.io.wire import pack, unpack, WireError, Encoder, Decoder
+
+__all__ = ["pack", "unpack", "WireError", "Encoder", "Decoder"]

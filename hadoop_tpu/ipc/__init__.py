@@ -1,0 +1,26 @@
+from hadoop_tpu.ipc.errors import (
+    RemoteError, RpcError, ServerTooBusyError, RpcTimeoutError,
+    register_exception, resolve_exception,
+)
+from hadoop_tpu.ipc.server import Server, CallContext, current_call
+from hadoop_tpu.ipc.client import Client
+from hadoop_tpu.ipc.rpc import get_proxy, idempotent, at_most_once, stop_proxy
+from hadoop_tpu.ipc.callqueue import (
+    CallQueueManager, FairCallQueue, DecayRpcScheduler, DefaultRpcScheduler,
+)
+from hadoop_tpu.ipc.retry import (
+    RetryPolicies, RetryPolicy, RetryInvocationHandler, FailoverProxyProvider,
+    StaticFailoverProxyProvider,
+)
+from hadoop_tpu.ipc.retry_cache import RetryCache
+
+__all__ = [
+    "Server", "Client", "CallContext", "current_call", "get_proxy",
+    "stop_proxy", "idempotent", "at_most_once",
+    "RemoteError", "RpcError", "ServerTooBusyError", "RpcTimeoutError",
+    "register_exception", "resolve_exception",
+    "CallQueueManager", "FairCallQueue", "DecayRpcScheduler",
+    "DefaultRpcScheduler", "RetryPolicies", "RetryPolicy",
+    "RetryInvocationHandler", "FailoverProxyProvider",
+    "StaticFailoverProxyProvider", "RetryCache",
+]

@@ -1,0 +1,104 @@
+"""RPC exception model.
+
+Server-side exceptions cross the wire as (class_name, message) and are
+re-raised client-side as the registered local class when one exists, else as
+``RemoteError`` — the reference's RemoteException.unwrapRemoteException
+behavior (ref: ipc/RemoteException.java, Client.java:1193 receiveRpcResponse).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Type
+
+
+class RpcError(IOError):
+    """Base for transport-level RPC failures (connection refused/reset/etc.)."""
+
+
+class RpcTimeoutError(RpcError):
+    pass
+
+
+class ServerTooBusyError(RpcError):
+    """Queue-full backoff signal (ref: ipc callqueue backoff /
+    RetriableException). Retryable by policy."""
+
+
+class FatalRpcError(RpcError):
+    """Connection-level failure from the server (bad header, auth failure)."""
+
+
+class RemoteError(IOError):
+    """An exception raised by the remote handler with no local class mapping."""
+
+    def __init__(self, class_name: str, message: str):
+        super().__init__(f"{class_name}: {message}")
+        self.class_name = class_name
+        self.remote_message = message
+
+
+class StandbyError(IOError):
+    """Operation sent to a standby node (ref: ha/StandbyException.java).
+    Triggers failover in the retry layer."""
+
+
+class RetriableError(IOError):
+    """Transient server condition; retry on the same node
+    (ref: ipc/RetriableException.java)."""
+
+
+_registry: Dict[str, Type[BaseException]] = {}
+
+
+def register_exception(cls: Type[BaseException], name: Optional[str] = None) -> Type[BaseException]:
+    """Register an exception class for cross-wire reconstruction. Usable as a
+    decorator. The wire name is the qualified dotted name by default."""
+    _registry[name or f"{cls.__module__}.{cls.__qualname__}"] = cls
+    return cls
+
+
+def wire_name(e: BaseException) -> str:
+    cls = type(e)
+    name = f"{cls.__module__}.{cls.__qualname__}"
+    if name not in _registry and cls.__module__ == "builtins":
+        return cls.__qualname__
+    return name
+
+
+def is_remote(e: BaseException) -> bool:
+    """True when the exception was raised by a remote handler (as opposed to a
+    local transport failure). Retry policies must NOT treat remote application
+    errors as network failures just because they subclass OSError."""
+    return bool(getattr(e, "_rpc_remote", False))
+
+
+def resolve_exception(class_name: str, message: str) -> BaseException:
+    cls = _registry.get(class_name)
+    if cls is None and "." not in class_name:
+        import builtins
+        cls = getattr(builtins, class_name, None)
+        if cls is not None and not (isinstance(cls, type)
+                                    and issubclass(cls, BaseException)):
+            cls = None
+    if cls is None:
+        e: BaseException = RemoteError(class_name, message)
+    else:
+        try:
+            e = cls(message)
+        except Exception:
+            e = RemoteError(class_name, message)
+    try:
+        e._rpc_remote = True
+    except AttributeError:
+        pass
+    return e
+
+
+# Framework exceptions that cross the wire frequently.
+register_exception(StandbyError)
+register_exception(RetriableError)
+register_exception(ServerTooBusyError)
+
+from hadoop_tpu.security.ugi import AccessControlError  # noqa: E402
+
+register_exception(AccessControlError)

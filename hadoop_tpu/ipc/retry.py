@@ -1,0 +1,235 @@
+"""Retry policies and failover proxy providers.
+
+Parity with the reference's retry layer (ref: io/retry/RetryPolicies.java,
+io/retry/RetryInvocationHandler.java, io/retry/FailoverProxyProvider.java,
+hdfs namenode/ha/ConfiguredFailoverProxyProvider.java): a policy decides
+FAIL / RETRY / FAILOVER_AND_RETRY per exception, idempotency-aware; the
+invocation handler wraps a proxy factory and performs sleeps and failovers.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from hadoop_tpu.ipc.errors import (RetriableError, RpcError, RpcTimeoutError,
+                                   ServerTooBusyError, StandbyError, is_remote)
+
+log = logging.getLogger(__name__)
+
+
+class RetryAction:
+    FAIL = "fail"
+    RETRY = "retry"
+    FAILOVER_AND_RETRY = "failover"
+
+    def __init__(self, action: str, delay_s: float = 0.0, reason: str = ""):
+        self.action = action
+        self.delay_s = delay_s
+        self.reason = reason
+
+
+class RetryPolicy:
+    def should_retry(self, e: BaseException, retries: int, failovers: int,
+                     idempotent: bool) -> RetryAction:
+        raise NotImplementedError
+
+
+class _TryOnceThenFail(RetryPolicy):
+    def should_retry(self, e, retries, failovers, idempotent):
+        return RetryAction(RetryAction.FAIL, reason="try once")
+
+
+class _RetryForever(RetryPolicy):
+    def __init__(self, delay_s: float = 1.0):
+        self.delay_s = delay_s
+
+    def should_retry(self, e, retries, failovers, idempotent):
+        return RetryAction(RetryAction.RETRY, self.delay_s)
+
+
+class _RetryUpToMaximumCount(RetryPolicy):
+    def __init__(self, max_retries: int, delay_s: float):
+        self.max_retries = max_retries
+        self.delay_s = delay_s
+
+    def should_retry(self, e, retries, failovers, idempotent):
+        if retries >= self.max_retries:
+            return RetryAction(RetryAction.FAIL,
+                               reason=f"exceeded {self.max_retries} retries")
+        return RetryAction(RetryAction.RETRY, self.delay_s)
+
+
+class _ExponentialBackoff(RetryPolicy):
+    def __init__(self, max_retries: int, base_delay_s: float, max_delay_s: float = 30.0):
+        self.max_retries = max_retries
+        self.base_delay_s = base_delay_s
+        self.max_delay_s = max_delay_s
+
+    def should_retry(self, e, retries, failovers, idempotent):
+        if retries >= self.max_retries:
+            return RetryAction(RetryAction.FAIL,
+                               reason=f"exceeded {self.max_retries} retries")
+        delay = min(self.max_delay_s,
+                    self.base_delay_s * (2 ** retries) * (0.5 + random.random()))
+        return RetryAction(RetryAction.RETRY, delay)
+
+
+class FailoverOnNetworkExceptionRetry(RetryPolicy):
+    """The policy HA clients use (ref: RetryPolicies
+    .failoverOnNetworkException): StandbyError → failover; connection errors →
+    failover if the op is idempotent or was never sent; busy/retriable →
+    retry with backoff; anything else → fail.
+    """
+
+    def __init__(self, fallback: RetryPolicy = None, max_failovers: int = 15,
+                 max_retries: int = 10, delay_s: float = 0.5,
+                 max_delay_s: float = 15.0):
+        self.fallback = fallback or _TryOnceThenFail()
+        self.max_failovers = max_failovers
+        self.max_retries = max_retries
+        self.delay_s = delay_s
+        self.max_delay_s = max_delay_s
+
+    def _failover_delay(self, failovers: int) -> float:
+        if failovers == 0:
+            return 0.0
+        return min(self.max_delay_s,
+                   self.delay_s * (2 ** failovers) * (0.5 + random.random()))
+
+    def should_retry(self, e, retries, failovers, idempotent):
+        if failovers >= self.max_failovers:
+            return RetryAction(RetryAction.FAIL,
+                               reason=f"exceeded {self.max_failovers} failovers")
+        if retries >= self.max_retries:
+            return RetryAction(RetryAction.FAIL,
+                               reason=f"exceeded {self.max_retries} retries")
+        if isinstance(e, StandbyError):
+            return RetryAction(RetryAction.FAILOVER_AND_RETRY,
+                               self._failover_delay(failovers))
+        if isinstance(e, (ServerTooBusyError, RetriableError)):
+            return RetryAction(RetryAction.RETRY,
+                               self._failover_delay(retries + 1))
+        if is_remote(e):
+            # A remote application error (permission denied, missing file, ...)
+            # is deterministic: failing over or retrying would only add
+            # latency. Ref: RemoteException.unwrapRemoteException semantics.
+            return self.fallback.should_retry(e, retries, failovers, idempotent)
+        if isinstance(e, (RpcError, ConnectionError, OSError)) and not isinstance(
+                e, RpcTimeoutError):
+            if idempotent:
+                return RetryAction(RetryAction.FAILOVER_AND_RETRY,
+                                   self._failover_delay(failovers))
+            return RetryAction(RetryAction.FAIL,
+                               reason="non-idempotent op on broken connection")
+        if isinstance(e, RpcTimeoutError) and idempotent:
+            return RetryAction(RetryAction.RETRY, self.delay_s)
+        return self.fallback.should_retry(e, retries, failovers, idempotent)
+
+
+class RetryPolicies:
+    TRY_ONCE_THEN_FAIL: RetryPolicy = _TryOnceThenFail()
+    RETRY_FOREVER: RetryPolicy = _RetryForever()
+
+    @staticmethod
+    def retry_up_to_maximum_count(n: int, delay_s: float = 1.0) -> RetryPolicy:
+        return _RetryUpToMaximumCount(n, delay_s)
+
+    @staticmethod
+    def exponential_backoff(max_retries: int = 10, base_delay_s: float = 0.2,
+                            max_delay_s: float = 30.0) -> RetryPolicy:
+        return _ExponentialBackoff(max_retries, base_delay_s, max_delay_s)
+
+    @staticmethod
+    def failover_on_network_exception(max_failovers: int = 15,
+                                      max_retries: int = 10,
+                                      delay_s: float = 0.5) -> RetryPolicy:
+        return FailoverOnNetworkExceptionRetry(
+            max_failovers=max_failovers, max_retries=max_retries, delay_s=delay_s)
+
+
+class FailoverProxyProvider:
+    """Yields proxies over candidate servers. Ref:
+    io/retry/FailoverProxyProvider.java."""
+
+    def get_proxy(self):
+        raise NotImplementedError
+
+    def perform_failover(self, current) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class StaticFailoverProxyProvider(FailoverProxyProvider):
+    """Round-robin over a fixed address list (ref:
+    ConfiguredFailoverProxyProvider.java — the standard NN HA provider)."""
+
+    def __init__(self, proxy_factory: Callable[[Tuple[str, int]], object],
+                 addresses: Sequence[Tuple[str, int]]):
+        if not addresses:
+            raise ValueError("no addresses")
+        self._factory = proxy_factory
+        self._addresses: List[Tuple[str, int]] = list(addresses)
+        self._idx = 0
+        self._proxy = None
+
+    @property
+    def current_address(self) -> Tuple[str, int]:
+        return self._addresses[self._idx]
+
+    def get_proxy(self):
+        if self._proxy is None:
+            self._proxy = self._factory(self._addresses[self._idx])
+        return self._proxy
+
+    def perform_failover(self, current) -> None:
+        self._idx = (self._idx + 1) % len(self._addresses)
+        self._proxy = None
+        log.info("Failing over to %s", self._addresses[self._idx])
+
+
+class RetryInvocationHandler:
+    """Wraps a FailoverProxyProvider; retries according to policy.
+    Ref: io/retry/RetryInvocationHandler.java.
+
+    The wrapped proxy must expose ``_is_idempotent(method_name) -> bool`` and
+    ``_set_retry_count(n)`` hooks (the rpc.RpcProxy does); absent those, all
+    methods are treated as non-idempotent.
+    """
+
+    def __init__(self, provider: FailoverProxyProvider, policy: RetryPolicy):
+        self.provider = provider
+        self.policy = policy
+
+    def invoke(self, method_name: str, *args, **kwargs):
+        retries = 0
+        failovers = 0
+        while True:
+            proxy = self.provider.get_proxy()
+            idem = bool(getattr(proxy, "_is_idempotent", lambda m: False)(method_name))
+            try:
+                set_rc = getattr(proxy, "_set_retry_count", None)
+                if set_rc:
+                    set_rc(retries)
+                return getattr(proxy, method_name)(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001 — policy decides
+                action = self.policy.should_retry(e, retries, failovers, idem)
+                if action.action == RetryAction.FAIL:
+                    raise
+                if action.delay_s > 0:
+                    time.sleep(action.delay_s)
+                if action.action == RetryAction.FAILOVER_AND_RETRY:
+                    self.provider.perform_failover(proxy)
+                    failovers += 1
+                retries += 1
+                log.debug("Retrying %s (retries=%d failovers=%d) after %s",
+                          method_name, retries, failovers, type(e).__name__)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return lambda *a, **kw: self.invoke(name, *a, **kw)

@@ -1,0 +1,574 @@
+"""Threaded reactor RPC server.
+
+Architecture parity with the reference server (ref: ipc/Server.java:141):
+
+    Listener (accept loop)                      ref: Server.java:1186
+      → Reader pool (selector threads, frame parse)    ref: Server.java:1236
+        → CallQueueManager (QoS, backoff)              ref: CallQueueManager.java
+          → Handler pool (doAs + dispatch)             ref: Server.java:2897
+            → Responder (selector write-back)          ref: Server.java:1479
+    ConnectionManager (idle scan)                      ref: Server.java:3654
+
+Wire format: u32-framed wirepack dicts. First frame on a connection is the
+connection header (protocol negotiation + auth); every later frame is a call
+request. Responses carry a server state id for observer-read alignment
+(ref: ipc/AlignmentContext.java).
+
+Auth: SIMPLE trusts the client-claimed user (as the reference does without
+Kerberos); TOKEN verifies an HMAC delegation token against the server's
+SecretManager (ref: security/SaslRpcServer.java DIGEST-MD5 path).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import logging
+import queue as _queue
+import selectors
+import socket
+import struct
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.io.wire import Decoder, Encoder, WireError, pack, unpack
+from hadoop_tpu.ipc.callqueue import CallQueueManager
+from hadoop_tpu.ipc.errors import ServerTooBusyError, wire_name
+from hadoop_tpu.metrics import metrics_system
+from hadoop_tpu.security.ugi import (AccessControlError, SecretManager, Token,
+                                     UserGroupInformation)
+from hadoop_tpu.tracing.tracer import SpanContext, global_tracer
+from hadoop_tpu.util.misc import Daemon
+
+log = logging.getLogger(__name__)
+
+MAGIC = "htpu1"
+PING_CALL_ID = -1
+MAX_FRAME = 128 * 1024 * 1024
+
+
+class CallContext:
+    """Per-call server-side context available to handlers via current_call().
+    Carries what the reference spreads across Server.Call (Server.java:758),
+    CallerContext and the UGI: caller identity, ids for the retry cache,
+    the trace span, and the client's seen state id."""
+
+    def __init__(self, user: UserGroupInformation, client_id: bytes,
+                 call_id: int, retry_count: int, address: str,
+                 protocol: str, method: str, client_state_id: int):
+        self.user = user
+        self.client_id = client_id
+        self.call_id = call_id
+        self.retry_count = retry_count
+        self.address = address
+        self.protocol = protocol
+        self.method = method
+        self.client_state_id = client_state_id
+        self.priority = 0
+
+
+_current_call: contextvars.ContextVar[Optional[CallContext]] = \
+    contextvars.ContextVar("htpu_current_call", default=None)
+
+
+def current_call() -> Optional[CallContext]:
+    return _current_call.get()
+
+
+class _Connection:
+    def __init__(self, sock: socket.socket, addr: Tuple[str, int]):
+        self.sock = sock
+        self.addr = addr
+        self.inbuf = bytearray()
+        self.header: Optional[Dict] = None
+        self.user: Optional[UserGroupInformation] = None
+        self.out_pending: deque = deque()
+        self.out_lock = threading.Lock()
+        self.closed = False
+        self.last_activity = time.monotonic()
+
+    def caller_key(self) -> str:
+        return self.user.user_name if self.user else self.addr[0]
+
+
+class _Call:
+    __slots__ = ("conn", "req", "recv_time", "priority")
+
+    def __init__(self, conn: _Connection, req: Dict):
+        self.conn = conn
+        self.req = req
+        self.recv_time = time.monotonic()
+        self.priority = 0
+
+
+class Server:
+    """RPC server hosting one or more protocol implementations."""
+
+    def __init__(self, conf: Optional[Configuration] = None,
+                 bind: Tuple[str, int] = ("127.0.0.1", 0),
+                 num_handlers: int = 4, num_readers: int = 1,
+                 queue_capacity: int = 1024, name: str = "rpc",
+                 secret_manager: Optional[SecretManager] = None,
+                 state_provider: Optional[Callable[[], int]] = None,
+                 queue_prefix: str = "ipc"):
+        self.conf = conf or Configuration(load_defaults=False)
+        self.name = name
+        self.num_handlers = num_handlers
+        self.num_readers = max(1, num_readers)
+        self.secret_manager = secret_manager
+        self.state_provider = state_provider  # AlignmentContext analog
+        self._protocols: Dict[str, Any] = {}
+        self._callq = CallQueueManager(self.conf, queue_capacity, queue_prefix)
+        self._lsock: Optional[socket.socket] = None
+        self.port = 0
+        self._running = False
+        self._threads: List[threading.Thread] = []
+        self._readers: List["_Reader"] = []
+        self._responder: Optional["_Responder"] = None
+        self._conns: Dict[int, _Connection] = {}
+        self._conns_lock = threading.Lock()
+        self.max_idle_s = self.conf.get_time_seconds("ipc.client.connection.maxidletime", 120.0)
+        reg = metrics_system().source(f"rpc.{name}")
+        self._m_calls = reg.counter("rpc_processing_calls")
+        self._m_queue_time = reg.rate("rpc_queue_time")
+        self._m_processing = reg.rate("rpc_processing_time")
+        self._m_auth_failures = reg.counter("rpc_authentication_failures")
+        self._m_open_conns = reg.gauge("rpc_open_connections")
+        reg.register_callback_gauge("rpc_call_queue_length", self._callq.qsize)
+        self._tracer = global_tracer()
+
+        self._bind_addr = bind
+
+    # ----------------------------------------------------------------- admin
+
+    def register_protocol(self, protocol_name: str, impl: Any) -> None:
+        self._protocols[protocol_name] = impl
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self._bind_addr[0], self.port)
+
+    def start(self) -> None:
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind(self._bind_addr)
+        self._lsock.listen(256)
+        self.port = self._lsock.getsockname()[1]
+        self._running = True
+
+        self._responder = _Responder(self)
+        self._threads.append(Daemon(self._responder.run, f"{self.name}-responder"))
+        for i in range(self.num_readers):
+            r = _Reader(self, i)
+            self._readers.append(r)
+            self._threads.append(Daemon(r.run, f"{self.name}-reader-{i}"))
+        self._threads.append(Daemon(self._listen_loop, f"{self.name}-listener"))
+        for i in range(self.num_handlers):
+            self._threads.append(Daemon(self._handler_loop, f"{self.name}-handler-{i}"))
+        self._threads.append(Daemon(self._idle_scan_loop, f"{self.name}-connmgr"))
+        for t in self._threads:
+            t.start()
+        log.info("RPC server %s listening on %s:%d (%d handlers, %d readers)",
+                 self.name, self._bind_addr[0], self.port,
+                 self.num_handlers, self.num_readers)
+
+    def stop(self) -> None:
+        self._running = False
+        if self._lsock:
+            try:
+                self._lsock.close()
+            except OSError:
+                pass
+        with self._conns_lock:
+            conns = list(self._conns.values())
+        for c in conns:
+            self._close_conn(c)
+        for r in self._readers:
+            r.wake()
+        if self._responder:
+            self._responder.wake()
+        self._callq.stop()
+
+    # -------------------------------------------------------------- listener
+
+    def _listen_loop(self) -> None:
+        """Accept loop; hands sockets to readers round-robin.
+        Ref: Server.Listener (Server.java:1186)."""
+        i = 0
+        while self._running:
+            try:
+                sock, addr = self._lsock.accept()
+            except OSError:
+                return
+            sock.setblocking(False)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = _Connection(sock, addr)
+            with self._conns_lock:
+                self._conns[id(conn)] = conn
+            self._m_open_conns.incr()
+            self._readers[i % len(self._readers)].add_connection(conn)
+            i += 1
+
+    # ---------------------------------------------------------------- frames
+
+    def _on_frame(self, conn: _Connection, frame: bytes) -> None:
+        conn.last_activity = time.monotonic()
+        try:
+            msg = unpack(frame)
+        except WireError as e:
+            log.warning("Bad frame from %s: %s", conn.addr, e)
+            self._close_conn(conn)
+            return
+        if not isinstance(msg, dict):
+            log.warning("Non-record frame (%s) from %s", type(msg).__name__,
+                        conn.addr)
+            self._close_conn(conn)
+            return
+        if conn.header is None:
+            self._process_header(conn, msg)
+            return
+        if msg.get("id") == PING_CALL_ID:
+            return
+        call = _Call(conn, msg)
+        try:
+            self._callq.put(call, conn.caller_key())
+        except ServerTooBusyError as e:
+            self._send_error(conn, msg.get("id", 0), e, retryable=True)
+
+    def _process_header(self, conn: _Connection, hdr: Dict) -> None:
+        """Connection setup: magic check + auth. Ref: Server.Connection
+        .processConnectionContext / SASL negotiation."""
+        if hdr.get("magic") != MAGIC:
+            self._send_fatal(conn, f"bad magic {hdr.get('magic')!r}")
+            return
+        auth = hdr.get("auth", UserGroupInformation.AUTH_SIMPLE)
+        try:
+            if auth == UserGroupInformation.AUTH_TOKEN:
+                if self.secret_manager is None:
+                    raise AccessControlError("server does not accept tokens")
+                raw_token = hdr.get("token")
+                if not isinstance(raw_token, dict):
+                    raise AccessControlError("TOKEN auth without a token")
+                token = Token.from_wire(raw_token)
+                ident = self.secret_manager.verify_token(token)
+                owner = ident["owner"]
+                # The token proves the *real* identity; the claimed effective
+                # user (if different) rides on top as a proxy user so
+                # impersonation works under token auth too.
+                real_ugi = UserGroupInformation.create_remote_user(
+                    owner, auth=UserGroupInformation.AUTH_TOKEN)
+                effective = hdr.get("user") or owner
+                if effective != owner:
+                    user = UserGroupInformation.create_proxy_user(
+                        effective, real_ugi)
+                else:
+                    user = real_ugi
+            else:
+                user = UserGroupInformation.create_remote_user(
+                    hdr.get("user") or "anonymous")
+                real = hdr.get("real")
+                if real and real != user.user_name:
+                    real_ugi = UserGroupInformation.create_remote_user(real)
+                    user = UserGroupInformation.create_proxy_user(
+                        user.user_name, real_ugi)
+        except (AccessControlError, KeyError, TypeError) as e:
+            self._m_auth_failures.incr()
+            self._send_fatal(conn, f"auth failed: {e}")
+            return
+        conn.header = hdr
+        conn.user = user
+
+    # -------------------------------------------------------------- handlers
+
+    def _handler_loop(self) -> None:
+        """Take → doAs → dispatch → respond. Ref: Server.Handler.run
+        (Server.java:2897)."""
+        while self._running:
+            try:
+                call = self._callq.take(timeout=0.2)
+            except _queue.Empty:
+                continue
+            self._handle_one(call)
+
+    def _handle_one(self, call: _Call) -> None:
+        conn, req = call.conn, call.req
+        self._m_queue_time.add(time.monotonic() - call.recv_time)
+        call_id = req.get("id", 0)
+        method = req.get("m", "")
+        protocol = req.get("p", "")
+        ctx = CallContext(
+            user=conn.user, client_id=req.get("cid", b""), call_id=call_id,
+            retry_count=req.get("rc", 0), address=f"{conn.addr[0]}:{conn.addr[1]}",
+            protocol=protocol, method=method,
+            client_state_id=req.get("sid", -1))
+        ctx.priority = call.priority
+        span_ctx = SpanContext.from_wire(req.get("t"))
+        t0 = time.monotonic()
+        token = _current_call.set(ctx)
+        try:
+            with self._tracer.span(f"{self.name}.{method}", parent=span_ctx) as sp:
+                sp.add_kv("caller", conn.caller_key())
+                impl = self._protocols.get(protocol)
+                if impl is None:
+                    raise ValueError(f"unknown protocol {protocol!r}")
+                fn = getattr(impl, method, None)
+                if fn is None or method.startswith("_") or not callable(fn):
+                    raise AttributeError(f"no such RPC method {protocol}.{method}")
+                value = conn.user.do_as(fn, *req.get("a", ()),
+                                        **req.get("kw", {}))
+            self._send_value(conn, call_id, value)
+        except Exception as e:  # noqa: BLE001 — every handler error crosses the wire
+            if not isinstance(e, (AccessControlError,)):
+                log.debug("RPC handler error %s.%s: %s", protocol, method, e)
+            self._send_error(conn, call_id, e)
+        finally:
+            _current_call.reset(token)
+            elapsed = time.monotonic() - t0
+            self._m_processing.add(elapsed)
+            self._m_calls.incr()
+            self._callq.add_response_time(conn.caller_key(), call.priority, elapsed)
+
+    # ------------------------------------------------------------- responses
+
+    def _state_id(self) -> int:
+        if self.state_provider is None:
+            return -1
+        try:
+            return self.state_provider()
+        except Exception:
+            return -1
+
+    def _send_value(self, conn: _Connection, call_id: int, value: Any) -> None:
+        try:
+            payload = pack({"id": call_id, "ok": True, "val": value,
+                            "sid": self._state_id()})
+        except WireError as e:
+            self._send_error(conn, call_id, e)
+            return
+        self._responder.respond(conn, payload)
+
+    def _send_error(self, conn: _Connection, call_id: int, e: BaseException,
+                    retryable: bool = False) -> None:
+        payload = pack({"id": call_id, "ok": False, "ec": wire_name(e),
+                        "em": str(e), "retryable": retryable,
+                        "sid": self._state_id()})
+        self._responder.respond(conn, payload)
+
+    def _send_fatal(self, conn: _Connection, msg: str) -> None:
+        payload = pack({"id": -2, "ok": False, "fatal": True,
+                        "ec": "hadoop_tpu.ipc.errors.FatalRpcError", "em": msg})
+        self._responder.respond(conn, payload, close_after=True)
+
+    # ------------------------------------------------------------ connection
+
+    def _close_conn(self, conn: _Connection) -> None:
+        if conn.closed:
+            return
+        conn.closed = True
+        with self._conns_lock:
+            self._conns.pop(id(conn), None)
+        self._m_open_conns.decr()
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    def _idle_scan_loop(self) -> None:
+        """Close idle connections. Ref: Server.ConnectionManager
+        (Server.java:3654)."""
+        while self._running:
+            time.sleep(min(10.0, self.max_idle_s / 2))
+            cutoff = time.monotonic() - self.max_idle_s
+            with self._conns_lock:
+                idle = [c for c in self._conns.values()
+                        if c.last_activity < cutoff and not c.out_pending]
+            for c in idle:
+                log.debug("Closing idle connection %s", c.addr)
+                self._close_conn(c)
+
+
+class _Reader:
+    """Selector thread: reads bytes, splits frames.
+    Ref: Server.Listener.Reader (Server.java:1236)."""
+
+    def __init__(self, server: Server, idx: int):
+        self.server = server
+        self.sel = selectors.DefaultSelector()
+        self._pending: deque = deque()
+        self._waker_r, self._waker_w = socket.socketpair()
+        self._waker_r.setblocking(False)
+        self.sel.register(self._waker_r, selectors.EVENT_READ, None)
+
+    def add_connection(self, conn: _Connection) -> None:
+        self._pending.append(conn)
+        self.wake()
+
+    def wake(self) -> None:
+        try:
+            self._waker_w.send(b"x")
+        except OSError:
+            pass
+
+    def run(self) -> None:
+        srv = self.server
+        while srv._running:
+            while self._pending:
+                conn = self._pending.popleft()
+                try:
+                    self.sel.register(conn.sock, selectors.EVENT_READ, conn)
+                except (KeyError, ValueError, OSError):
+                    srv._close_conn(conn)
+            for key, _ in self.sel.select(timeout=0.5):
+                if key.data is None:
+                    try:
+                        self._waker_r.recv(4096)
+                    except OSError:
+                        pass
+                    continue
+                conn: _Connection = key.data
+                try:
+                    data = conn.sock.recv(256 * 1024)
+                except (BlockingIOError, InterruptedError):
+                    continue
+                except OSError:
+                    data = b""
+                if not data:
+                    self._drop(conn)
+                    continue
+                conn.inbuf += data
+                self._drain_frames(conn)
+        self.sel.close()
+
+    def _drain_frames(self, conn: _Connection) -> None:
+        buf = conn.inbuf
+        off = 0
+        n = len(buf)
+        while n - off >= 4:
+            (flen,) = struct.unpack_from(">I", buf, off)
+            if flen > MAX_FRAME:
+                log.warning("Oversized frame (%d) from %s", flen, conn.addr)
+                self._drop(conn)
+                return
+            if n - off - 4 < flen:
+                break
+            frame = bytes(buf[off + 4: off + 4 + flen])
+            off += 4 + flen
+            try:
+                self.server._on_frame(conn, frame)
+            except Exception:  # noqa: BLE001 — one bad client must not kill the reader
+                log.exception("Dropping connection %s after frame error",
+                              conn.addr)
+                self.server._close_conn(conn)
+            if conn.closed:
+                self._drop(conn, already_closed=True)
+                return
+        if off:
+            del buf[:off]
+
+    def _drop(self, conn: _Connection, already_closed: bool = False) -> None:
+        try:
+            self.sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        if not already_closed:
+            self.server._close_conn(conn)
+
+
+class _Responder:
+    """Async write-back thread. Handlers enqueue; an inline fast-path write is
+    attempted first (as the reference's doRespond does) and the selector loop
+    drains the rest. Ref: Server.Responder (Server.java:1479)."""
+
+    def __init__(self, server: Server):
+        self.server = server
+        self.sel = selectors.DefaultSelector()
+        self._waker_r, self._waker_w = socket.socketpair()
+        self._waker_r.setblocking(False)
+        self.sel.register(self._waker_r, selectors.EVENT_READ, None)
+        self._to_register: deque = deque()
+        self._close_after: set = set()
+
+    def respond(self, conn: _Connection, payload: bytes,
+                close_after: bool = False) -> None:
+        if conn.closed:
+            return
+        data = struct.pack(">I", len(payload)) + payload
+        with conn.out_lock:
+            empty = not conn.out_pending
+            if empty:
+                # Fast path: try inline non-blocking write.
+                sent = 0
+                try:
+                    sent = conn.sock.send(data)
+                except (BlockingIOError, InterruptedError):
+                    sent = 0
+                except OSError:
+                    self.server._close_conn(conn)
+                    return
+                if sent == len(data):
+                    if close_after:
+                        self.server._close_conn(conn)
+                    return
+                data = data[sent:]
+            conn.out_pending.append(data)
+        if close_after:
+            self._close_after.add(id(conn))
+        self._to_register.append(conn)
+        self.wake()
+
+    def wake(self) -> None:
+        try:
+            self._waker_w.send(b"x")
+        except OSError:
+            pass
+
+    def run(self) -> None:
+        srv = self.server
+        while srv._running:
+            while self._to_register:
+                conn = self._to_register.popleft()
+                if conn.closed:
+                    continue
+                try:
+                    self.sel.register(conn.sock, selectors.EVENT_WRITE, conn)
+                except KeyError:
+                    pass  # already registered
+                except (ValueError, OSError):
+                    srv._close_conn(conn)
+            for key, _ in self.sel.select(timeout=0.5):
+                if key.data is None:
+                    try:
+                        self._waker_r.recv(4096)
+                    except OSError:
+                        pass
+                    continue
+                self._flush(key.data)
+        self.sel.close()
+
+    def _flush(self, conn: _Connection) -> None:
+        done = False
+        with conn.out_lock:
+            while conn.out_pending:
+                data = conn.out_pending[0]
+                try:
+                    sent = conn.sock.send(data)
+                except (BlockingIOError, InterruptedError):
+                    break
+                except OSError:
+                    done = True
+                    break
+                if sent < len(data):
+                    conn.out_pending[0] = data[sent:]
+                    break
+                conn.out_pending.popleft()
+            drained = not conn.out_pending
+        if drained or done:
+            try:
+                self.sel.unregister(conn.sock)
+            except (KeyError, ValueError):
+                pass
+        if done or (drained and id(conn) in self._close_after):
+            self._close_after.discard(id(conn))
+            self.server._close_conn(conn)

@@ -1,0 +1,295 @@
+"""Metrics system: named registries of mutable metrics, periodic snapshots to sinks.
+
+Capability parity with the reference's metrics2 (ref:
+metrics2/impl/MetricsSystemImpl.java (638 LoC), metrics2/lib/DefaultMetricsSystem.java,
+metrics2/lib/MutableCounterLong.java, MutableRate, MutableQuantiles; sinks under
+metrics2/sink/): sources register a registry of counters/gauges/rates; the
+system snapshots all sources on demand or on a timer and pushes records to
+sinks (file/callback here; the JMX equivalent is the /jmx HTTP endpoint served
+by hadoop_tpu.http).
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from hadoop_tpu.util.misc import Daemon
+
+
+class MutableCounter:
+    """Monotonic counter. Ref: metrics2/lib/MutableCounterLong.java."""
+
+    def __init__(self, name: str, description: str = ""):
+        self.name = name
+        self.description = description
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def incr(self, delta: int = 1) -> None:
+        with self._lock:
+            self._value += delta
+
+    def value(self) -> int:
+        return self._value
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {self.name: self._value}
+
+
+class MutableGauge:
+    """Settable gauge. Ref: metrics2/lib/MutableGaugeLong.java."""
+
+    def __init__(self, name: str, description: str = "", initial=0):
+        self.name = name
+        self.description = description
+        self._value = initial
+        self._lock = threading.Lock()
+
+    def set(self, v) -> None:
+        with self._lock:
+            self._value = v
+
+    def incr(self, delta=1) -> None:
+        with self._lock:
+            self._value += delta
+
+    def decr(self, delta=1) -> None:
+        with self._lock:
+            self._value -= delta
+
+    def value(self):
+        return self._value
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {self.name: self._value}
+
+
+class MutableRate:
+    """Op count + mean/min/max duration since last snapshot.
+    Ref: metrics2/lib/MutableRate.java / MutableStat."""
+
+    def __init__(self, name: str, description: str = ""):
+        self.name = name
+        self.description = description
+        self._lock = threading.Lock()
+        self._n = 0
+        self._total = 0.0
+        self._min = float("inf")
+        self._max = 0.0
+        self._lifetime_n = 0
+
+    def add(self, elapsed_s: float) -> None:
+        with self._lock:
+            self._n += 1
+            self._lifetime_n += 1
+            self._total += elapsed_s
+            self._min = min(self._min, elapsed_s)
+            self._max = max(self._max, elapsed_s)
+
+    def snapshot(self, reset: bool = False) -> Dict[str, Any]:
+        with self._lock:
+            out = {
+                f"{self.name}_num_ops": self._lifetime_n,
+                f"{self.name}_avg_time": (self._total / self._n) if self._n else 0.0,
+                f"{self.name}_min_time": 0.0 if self._min == float("inf") else self._min,
+                f"{self.name}_max_time": self._max,
+            }
+            if reset:
+                self._n = 0
+                self._total = 0.0
+                self._min = float("inf")
+                self._max = 0.0
+            return out
+
+    def time(self):
+        """Context manager: ``with rate.time(): ...``"""
+        return _Timer(self)
+
+
+class _Timer:
+    def __init__(self, rate: MutableRate):
+        self._rate = rate
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self._rate.add(time.monotonic() - self._t0)
+        return False
+
+
+class MutableQuantiles:
+    """Bounded-reservoir latency quantiles (p50/p75/p90/p95/p99).
+    Ref: metrics2/lib/MutableQuantiles.java (CKMS there; a sorted sampled
+    reservoir here — the observable surface is the same)."""
+
+    QUANTILES = (0.50, 0.75, 0.90, 0.95, 0.99)
+
+    def __init__(self, name: str, description: str = "", max_samples: int = 4096):
+        self.name = name
+        self.description = description
+        self.max_samples = max_samples
+        self._samples: List[float] = []
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def add(self, v: float) -> None:
+        with self._lock:
+            self._n += 1
+            if len(self._samples) < self.max_samples:
+                bisect.insort(self._samples, v)
+            else:
+                # Reservoir sampling keeps the estimate unbiased under load.
+                import random
+                idx = random.randrange(self._n)
+                if idx < self.max_samples:
+                    del self._samples[random.randrange(len(self._samples))]
+                    bisect.insort(self._samples, v)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = {f"{self.name}_count": self._n}
+            s = self._samples
+            for q in self.QUANTILES:
+                key = f"{self.name}_p{int(q * 100)}"
+                out[key] = s[min(len(s) - 1, int(q * len(s)))] if s else 0.0
+            return out
+
+
+class MetricsRegistry:
+    """Per-source registry. Ref: metrics2/lib/MetricsRegistry.java."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._metrics: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, description: str = "") -> MutableCounter:
+        return self._get_or_make(name, lambda: MutableCounter(name, description))
+
+    def gauge(self, name: str, description: str = "", initial=0) -> MutableGauge:
+        return self._get_or_make(name, lambda: MutableGauge(name, description, initial))
+
+    def rate(self, name: str, description: str = "") -> MutableRate:
+        return self._get_or_make(name, lambda: MutableRate(name, description))
+
+    def quantiles(self, name: str, description: str = "") -> MutableQuantiles:
+        return self._get_or_make(name, lambda: MutableQuantiles(name, description))
+
+    def register_callback_gauge(self, name: str, fn: Callable[[], Any]) -> None:
+        with self._lock:
+            self._metrics[name] = _CallbackGauge(name, fn)
+
+    def _get_or_make(self, name: str, factory: Callable):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            return m
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out: Dict[str, Any] = {}
+        for m in metrics:
+            out.update(m.snapshot())
+        return out
+
+
+class _CallbackGauge:
+    def __init__(self, name: str, fn: Callable[[], Any]):
+        self.name = name
+        self._fn = fn
+
+    def snapshot(self) -> Dict[str, Any]:
+        try:
+            return {self.name: self._fn()}
+        except Exception:
+            return {self.name: None}
+
+
+class MetricsSystem:
+    """Process-wide source/sink hub. Ref: DefaultMetricsSystem +
+    MetricsSystemImpl. Sources are MetricsRegistry objects; sinks are
+    callables receiving {source_name: {metric: value}} snapshots."""
+
+    def __init__(self):
+        self._sources: Dict[str, MetricsRegistry] = {}
+        self._sinks: List[Callable[[Dict[str, Dict[str, Any]]], None]] = []
+        self._lock = threading.Lock()
+        self._timer: Optional[threading.Event] = None
+
+    def register(self, registry: MetricsRegistry) -> MetricsRegistry:
+        with self._lock:
+            self._sources[registry.name] = registry
+        return registry
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._sources.pop(name, None)
+
+    def source(self, name: str) -> MetricsRegistry:
+        with self._lock:
+            reg = self._sources.get(name)
+            if reg is None:
+                reg = MetricsRegistry(name)
+                self._sources[name] = reg
+            return reg
+
+    def add_sink(self, sink: Callable[[Dict[str, Dict[str, Any]]], None]) -> None:
+        with self._lock:
+            self._sinks.append(sink)
+
+    def add_file_sink(self, path: str) -> None:
+        """Ref: metrics2/sink/FileSink.java — JSON-lines snapshots."""
+        def sink(snap: Dict[str, Dict[str, Any]]) -> None:
+            with open(path, "a", encoding="utf-8") as f:
+                f.write(json.dumps({"ts": time.time(), **snap}) + "\n")
+        self.add_sink(sink)
+
+    def snapshot_all(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            sources = dict(self._sources)
+        return {name: reg.snapshot() for name, reg in sources.items()}
+
+    def publish(self) -> None:
+        snap = self.snapshot_all()
+        with self._lock:
+            sinks = list(self._sinks)
+        for s in sinks:
+            try:
+                s(snap)
+            except Exception:
+                pass
+
+    def start_periodic_publish(self, period_s: float = 10.0) -> None:
+        stop = threading.Event()
+        self._timer = stop
+
+        def run():
+            while not stop.wait(period_s):
+                self.publish()
+
+        Daemon(run, "metrics-publisher").start()
+
+    def stop(self) -> None:
+        if self._timer is not None:
+            self._timer.set()
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._sources.clear()
+            self._sinks.clear()
+
+
+_global = MetricsSystem()
+
+
+def metrics_system() -> MetricsSystem:
+    return _global

@@ -1,0 +1,8 @@
+from hadoop_tpu.security.ugi import (
+    UserGroupInformation, current_user, AccessControlError, Token, SecretManager,
+)
+
+__all__ = [
+    "UserGroupInformation", "current_user", "AccessControlError", "Token",
+    "SecretManager",
+]

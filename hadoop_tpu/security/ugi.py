@@ -1,0 +1,200 @@
+"""User/auth context threaded through every call path.
+
+Capability parity with the reference's security layer (ref:
+security/UserGroupInformation.java:104, :1107 loginUserFromKeytab, :1839 doAs;
+security/token/ secret managers; security/SaslRpcServer.java). The reference's
+hardest retrofit lesson (SURVEY.md §7) is that the auth seam must exist from
+day one even when the first implementation is simple-auth-only — so:
+
+- Every RPC carries an effective user + real user (impersonation-aware).
+- Servers resolve the caller via ``current_user()`` inside handlers
+  (the doAs propagation; a contextvar here instead of a JAAS Subject).
+- ``Token``/``SecretManager`` implement HMAC-signed delegation tokens — the
+  real mechanism (ref: security/token/SecretManager.java,
+  delegation/AbstractDelegationTokenSecretManager.java), usable for block
+  tokens and job tokens. Kerberos/SASL negotiation is a pluggable
+  ``AuthMethod`` with SIMPLE and TOKEN implemented; KERBEROS is a stub seam.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import getpass
+import hashlib
+import hmac
+import os
+import secrets
+import threading
+import time
+from typing import Dict, List, Optional
+
+from hadoop_tpu.io import pack, unpack
+
+
+class AccessControlError(PermissionError):
+    pass
+
+
+_current: contextvars.ContextVar[Optional["UserGroupInformation"]] = \
+    contextvars.ContextVar("htpu_current_ugi", default=None)
+
+
+class UserGroupInformation:
+    AUTH_SIMPLE = "SIMPLE"
+    AUTH_TOKEN = "TOKEN"
+    AUTH_KERBEROS = "KERBEROS"  # seam: negotiation not implemented, shape is
+
+    _login_user: Optional["UserGroupInformation"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, user_name: str, groups: Optional[List[str]] = None,
+                 auth_method: str = AUTH_SIMPLE,
+                 real_user: Optional["UserGroupInformation"] = None):
+        self.user_name = user_name
+        self.groups = list(groups or [])
+        self.auth_method = auth_method
+        self.real_user = real_user  # impersonation: proxy-user chains
+        self.tokens: Dict[str, "Token"] = {}
+
+    # ------------------------------------------------------------- factories
+
+    @classmethod
+    def get_login_user(cls) -> "UserGroupInformation":
+        """Ref: UGI.getLoginUser — the OS process user."""
+        with cls._lock:
+            if cls._login_user is None:
+                cls._login_user = cls(getpass.getuser())
+            return cls._login_user
+
+    @classmethod
+    def create_remote_user(cls, name: str,
+                           auth: str = AUTH_SIMPLE) -> "UserGroupInformation":
+        return cls(name, auth_method=auth)
+
+    @classmethod
+    def create_proxy_user(cls, name: str,
+                          real: "UserGroupInformation") -> "UserGroupInformation":
+        return cls(name, auth_method=real.auth_method, real_user=real)
+
+    @classmethod
+    def login_from_keytab(cls, principal: str, keytab_path: str) -> "UserGroupInformation":
+        """Kerberos seam (ref: UGI.loginUserFromKeytab:1107). Validates the
+        keytab exists and records the principal; actual KDC exchange is the
+        pluggable part left for a kerberos backend."""
+        if not os.path.exists(keytab_path):
+            raise AccessControlError(f"keytab not found: {keytab_path}")
+        user = principal.split("/")[0].split("@")[0]
+        ugi = cls(user, auth_method=cls.AUTH_KERBEROS)
+        with cls._lock:
+            cls._login_user = ugi
+        return ugi
+
+    # ----------------------------------------------------------------- doAs
+
+    def do_as(self, fn, *args, **kwargs):
+        """Run fn with this UGI as the current caller. Ref: UGI.doAs:1839."""
+        token = _current.set(self)
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _current.reset(token)
+
+    def add_token(self, token: "Token") -> None:
+        self.tokens[token.kind] = token
+
+    def short_name(self) -> str:
+        return self.user_name
+
+    def effective_and_real(self) -> Dict[str, Optional[str]]:
+        return {
+            "user": self.user_name,
+            "real": self.real_user.user_name if self.real_user else None,
+        }
+
+    def __repr__(self) -> str:
+        via = f" via {self.real_user.user_name}" if self.real_user else ""
+        return f"{self.user_name}{via} (auth:{self.auth_method})"
+
+
+def current_user() -> "UserGroupInformation":
+    ugi = _current.get()
+    return ugi if ugi is not None else UserGroupInformation.get_login_user()
+
+
+class Token:
+    """Signed delegation token: identifier + HMAC(password) derived from a
+    SecretManager key. Ref: security/token/Token.java."""
+
+    def __init__(self, kind: str, identifier: bytes, password: bytes,
+                 service: str = ""):
+        self.kind = kind
+        self.identifier = identifier
+        self.password = password
+        self.service = service
+
+    def to_wire(self) -> Dict:
+        return {"k": self.kind, "i": self.identifier, "p": self.password,
+                "s": self.service}
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "Token":
+        return cls(d["k"], d["i"], d["p"], d.get("s", ""))
+
+
+class SecretManager:
+    """HMAC secret manager with rolling master keys.
+    Ref: security/token/SecretManager.java,
+    delegation/AbstractDelegationTokenSecretManager.java."""
+
+    def __init__(self, kind: str, key_rotation_s: float = 24 * 3600.0,
+                 token_ttl_s: float = 7 * 24 * 3600.0):
+        self.kind = kind
+        self.key_rotation_s = key_rotation_s
+        self.token_ttl_s = token_ttl_s
+        self._keys: Dict[int, bytes] = {}
+        self._key_id = 0
+        self._lock = threading.Lock()
+        self._roll_key()
+
+    def _roll_key(self) -> None:
+        with self._lock:
+            self._key_id += 1
+            self._keys[self._key_id] = secrets.token_bytes(32)
+            # Retain last 3 keys so in-flight tokens survive a rotation.
+            for kid in list(self._keys):
+                if kid < self._key_id - 2:
+                    del self._keys[kid]
+
+    def _sign(self, key: bytes, ident: bytes) -> bytes:
+        return hmac.new(key, ident, hashlib.sha256).digest()
+
+    def create_token(self, owner: str, renewer: str = "",
+                     extra: Optional[Dict] = None) -> Token:
+        with self._lock:
+            kid = self._key_id
+            key = self._keys[kid]
+        ident = pack({
+            "owner": owner, "renewer": renewer, "issue": time.time(),
+            "expiry": time.time() + self.token_ttl_s, "key_id": kid,
+            "extra": extra or {},
+        })
+        return Token(self.kind, ident, self._sign(key, ident))
+
+    def verify_token(self, token: Token) -> Dict:
+        """Returns the decoded identifier; raises AccessControlError on
+        bad signature or expiry."""
+        if token.kind != self.kind:
+            raise AccessControlError(
+                f"token kind {token.kind!r} != expected {self.kind!r}")
+        ident = unpack(token.identifier)
+        kid = ident.get("key_id")
+        with self._lock:
+            key = self._keys.get(kid)
+        if key is None:
+            raise AccessControlError(f"unknown/expired master key {kid}")
+        if not hmac.compare_digest(self._sign(key, token.identifier),
+                                   token.password):
+            raise AccessControlError("token signature mismatch")
+        if ident["expiry"] < time.time():
+            raise AccessControlError("token expired")
+        return ident

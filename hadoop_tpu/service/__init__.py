@@ -1,0 +1,9 @@
+from hadoop_tpu.service.service import (
+    Service, ServiceState, AbstractService, CompositeService,
+    ServiceStateException, LifecycleEvent,
+)
+
+__all__ = [
+    "Service", "ServiceState", "AbstractService", "CompositeService",
+    "ServiceStateException", "LifecycleEvent",
+]

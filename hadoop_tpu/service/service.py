@@ -1,0 +1,249 @@
+"""Service lifecycle state machine.
+
+Capability parity with the reference's service framework
+(ref: service/AbstractService.java (490 LoC), service/CompositeService.java,
+service/ServiceStateModel.java): NOTINITED → INITED → STARTED → STOPPED with a
+validated transition matrix, idempotent stop, failure capture, lifecycle
+listeners, and composite services that init/start children in order and stop
+them in reverse.
+
+Every daemon in this framework (NameNode, BlockServer, ResourceManager,
+NodeAgent, AppMaster) is a CompositeService tree, exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import threading
+import time
+from typing import Callable, List, Optional
+
+from hadoop_tpu.conf import Configuration
+
+log = logging.getLogger(__name__)
+
+
+class ServiceState(enum.IntEnum):
+    NOTINITED = 0
+    INITED = 1
+    STARTED = 2
+    STOPPED = 3
+
+
+# Valid transitions (ref: ServiceStateModel.statemap). stop() is legal from any
+# state (idempotent teardown).
+_VALID = {
+    ServiceState.NOTINITED: {ServiceState.INITED, ServiceState.STOPPED},
+    ServiceState.INITED: {ServiceState.STARTED, ServiceState.STOPPED},
+    ServiceState.STARTED: {ServiceState.STOPPED},
+    ServiceState.STOPPED: {ServiceState.STOPPED},
+}
+
+
+class ServiceStateException(RuntimeError):
+    pass
+
+
+class LifecycleEvent:
+    def __init__(self, state: ServiceState):
+        self.state = state
+        self.time = time.time()
+
+
+class Service:
+    """Interface — see AbstractService for the standard implementation."""
+
+    def init(self, conf: Configuration) -> None: ...
+    def start(self) -> None: ...
+    def stop(self) -> None: ...
+    @property
+    def state(self) -> ServiceState: ...
+    @property
+    def name(self) -> str: ...
+
+
+class AbstractService(Service):
+    """Subclasses override service_init / service_start / service_stop.
+
+    Ref: AbstractService.serviceInit/serviceStart/serviceStop — public
+    init/start/stop do the state checking and exception capture, the
+    ``service_*`` hooks do the work.
+    """
+
+    def __init__(self, name: Optional[str] = None):
+        self._name = name or type(self).__name__
+        self._state = ServiceState.NOTINITED
+        self._state_lock = threading.RLock()
+        self._conf: Optional[Configuration] = None
+        self._failure: Optional[BaseException] = None
+        self._failure_state: Optional[ServiceState] = None
+        self._listeners: List[Callable[["AbstractService", ServiceState], None]] = []
+        self._lifecycle_history: List[LifecycleEvent] = []
+        self._start_time = 0.0
+
+    # ------------------------------------------------------------- properties
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def state(self) -> ServiceState:
+        return self._state
+
+    @property
+    def config(self) -> Optional[Configuration]:
+        return self._conf
+
+    @property
+    def failure_cause(self) -> Optional[BaseException]:
+        return self._failure
+
+    def is_in_state(self, s: ServiceState) -> bool:
+        return self._state == s
+
+    # -------------------------------------------------------------- lifecycle
+
+    def _enter(self, new_state: ServiceState) -> bool:
+        """Returns False when already in new_state (no-op re-entry)."""
+        with self._state_lock:
+            if self._state == new_state:
+                return False
+            if new_state not in _VALID[self._state]:
+                raise ServiceStateException(
+                    f"{self._name}: cannot enter {new_state.name} from {self._state.name}")
+            self._state = new_state
+            self._lifecycle_history.append(LifecycleEvent(new_state))
+            return True
+
+    def init(self, conf: Configuration) -> None:
+        if self._state == ServiceState.INITED:
+            return
+        self._conf = conf
+        if not self._enter(ServiceState.INITED):
+            return
+        try:
+            self.service_init(conf)
+        except BaseException as e:
+            self._note_failure(e)
+            self.stop()
+            raise
+        self._notify(ServiceState.INITED)
+
+    def start(self) -> None:
+        if self._state == ServiceState.STARTED:
+            return
+        if not self._enter(ServiceState.STARTED):
+            return
+        self._start_time = time.time()
+        try:
+            self.service_start()
+        except BaseException as e:
+            self._note_failure(e)
+            self.stop()
+            raise
+        log.debug("Service %s started", self._name)
+        self._notify(ServiceState.STARTED)
+
+    def stop(self) -> None:
+        with self._state_lock:
+            if self._state == ServiceState.STOPPED:
+                return
+            self._state = ServiceState.STOPPED
+            self._lifecycle_history.append(LifecycleEvent(ServiceState.STOPPED))
+        try:
+            self.service_stop()
+        except BaseException as e:
+            self._note_failure(e)
+            raise
+        finally:
+            self._notify(ServiceState.STOPPED)
+
+    def close(self) -> None:
+        self.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def _note_failure(self, e: BaseException) -> None:
+        if self._failure is None:
+            self._failure = e
+            self._failure_state = self._state
+        log.error("Service %s failed in state %s: %s", self._name,
+                  self._state.name, e)
+
+    # -------------------------------------------------------------- listeners
+
+    def register_listener(self, cb: Callable[["AbstractService", ServiceState], None]) -> None:
+        self._listeners.append(cb)
+
+    def _notify(self, state: ServiceState) -> None:
+        for cb in list(self._listeners):
+            try:
+                cb(self, state)
+            except Exception:
+                log.exception("Listener failure on %s", self._name)
+
+    # ------------------------------------------------------------------ hooks
+
+    def service_init(self, conf: Configuration) -> None:
+        pass
+
+    def service_start(self) -> None:
+        pass
+
+    def service_stop(self) -> None:
+        pass
+
+    def __repr__(self) -> str:
+        return f"{self._name}[{self._state.name}]"
+
+
+class CompositeService(AbstractService):
+    """Parent service managing an ordered list of children.
+
+    Ref: CompositeService.java — children are inited/started in add order and
+    stopped in reverse; a child failure during start triggers a full stop.
+    """
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name)
+        self._services: List[Service] = []
+
+    def add_service(self, svc: Service) -> Service:
+        self._services.append(svc)
+        return svc
+
+    def add_if_service(self, obj) -> bool:
+        if isinstance(obj, Service):
+            self.add_service(obj)
+            return True
+        return False
+
+    def get_services(self) -> List[Service]:
+        return list(self._services)
+
+    def service_init(self, conf: Configuration) -> None:
+        for s in list(self._services):
+            s.init(conf)
+
+    def service_start(self) -> None:
+        for s in list(self._services):
+            s.start()
+
+    def service_stop(self) -> None:
+        first_error: Optional[BaseException] = None
+        for s in reversed(list(self._services)):
+            try:
+                s.stop()
+            except BaseException as e:
+                log.exception("Error stopping child %s", getattr(s, "name", s))
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            raise first_error

@@ -1,0 +1,3 @@
+from hadoop_tpu.tracing.tracer import Tracer, Span, SpanContext, current_span
+
+__all__ = ["Tracer", "Span", "SpanContext", "current_span"]

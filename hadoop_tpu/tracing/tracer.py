@@ -1,0 +1,148 @@
+"""Distributed tracing: spans created client-side, propagated in RPC headers,
+resumed server-side around handler execution.
+
+Capability parity with the reference's HTrace-4 integration (ref:
+hadoop-common/pom.xml:286-287; span creation hdfs/DFSClient.java:1563;
+propagation ipc/Server.java:121-123 SpanId in RPC headers; runtime-configurable
+receivers tracing/TracerConfigurationManager.java, TraceAdmin.java).
+
+A Span carries (trace_id, span_id, parent_id); the active span lives in a
+contextvar so nested ``with tracer.span(...)`` calls parent correctly across
+threads spawned with Span-aware helpers. Receivers are callables fed finished
+spans; the default in-memory receiver backs tests and the /tracing endpoint.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+_active: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "htpu_active_span", default=None)
+
+
+class SpanContext:
+    """Wire form of a span: what travels in RPC headers."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: int, span_id: int):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def to_wire(self) -> Dict[str, int]:
+        return {"t": self.trace_id, "s": self.span_id}
+
+    @classmethod
+    def from_wire(cls, d: Optional[Dict[str, int]]) -> Optional["SpanContext"]:
+        if not d:
+            return None
+        return cls(d["t"], d["s"])
+
+
+class Span:
+    def __init__(self, tracer: "Tracer", name: str, trace_id: int,
+                 parent_id: Optional[int]):
+        self.tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = random.getrandbits(63)
+        self.parent_id = parent_id
+        self.start = time.time()
+        self.end: Optional[float] = None
+        self.annotations: List[str] = []
+        self.kv: Dict[str, str] = {}
+        self._token = None
+
+    def annotate(self, msg: str) -> None:
+        self.annotations.append(msg)
+
+    def add_kv(self, k: str, v: str) -> None:
+        self.kv[k] = v
+
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id)
+
+    def __enter__(self) -> "Span":
+        self._token = _active.set(self)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.finish()
+        return False
+
+    def finish(self) -> None:
+        if self.end is None:
+            self.end = time.time()
+            if self._token is not None:
+                _active.reset(self._token)
+                self._token = None
+            self.tracer._deliver(self)
+
+    def to_dict(self) -> Dict:
+        return {
+            "name": self.name, "trace_id": self.trace_id, "span_id": self.span_id,
+            "parent_id": self.parent_id, "start": self.start, "end": self.end,
+            "annotations": list(self.annotations), "kv": dict(self.kv),
+        }
+
+
+def current_span() -> Optional[Span]:
+    return _active.get()
+
+
+class Tracer:
+    """Per-process tracer with sampling and pluggable receivers."""
+
+    def __init__(self, name: str = "htpu", sample_rate: float = 1.0):
+        self.name = name
+        self.sample_rate = sample_rate
+        self._receivers: List[Callable[[Span], None]] = []
+        self._lock = threading.Lock()
+        self.finished: List[Span] = []  # in-memory receiver (tests, /tracing)
+        self._keep_in_memory = True
+        self.max_kept = 1000
+
+    def add_receiver(self, fn: Callable[[Span], None]) -> None:
+        with self._lock:
+            self._receivers.append(fn)
+
+    def span(self, name: str, parent: Optional[SpanContext] = None) -> Span:
+        """New span: child of ``parent`` (wire context), else of the active
+        span, else a new trace root. Unsampled traces still produce Span
+        objects (cheap) but aren't delivered."""
+        cur = _active.get()
+        if parent is not None:
+            return Span(self, name, parent.trace_id, parent.span_id)
+        if cur is not None:
+            return Span(self, name, cur.trace_id, cur.span_id)
+        return Span(self, name, random.getrandbits(63), None)
+
+    def _deliver(self, span: Span) -> None:
+        if self.sample_rate < 1.0 and random.random() > self.sample_rate:
+            return
+        with self._lock:
+            if self._keep_in_memory:
+                self.finished.append(span)
+                if len(self.finished) > self.max_kept:
+                    del self.finished[: len(self.finished) // 2]
+            receivers = list(self._receivers)
+        for r in receivers:
+            try:
+                r(span)
+            except Exception:
+                pass
+
+    def set_sample_rate(self, rate: float) -> None:
+        """Runtime reconfiguration (ref: TracerConfigurationManager)."""
+        self.sample_rate = rate
+
+
+_global_tracer = Tracer()
+
+
+def global_tracer() -> Tracer:
+    return _global_tracer

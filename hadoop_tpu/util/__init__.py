@@ -1,0 +1,4 @@
+from hadoop_tpu.util.crc import crc32c, DataChecksum
+from hadoop_tpu.util.misc import Daemon, free_port, StopWatch, PauseMonitor
+
+__all__ = ["crc32c", "DataChecksum", "Daemon", "free_port", "StopWatch", "PauseMonitor"]

@@ -1,0 +1,111 @@
+"""Small shared utilities.
+
+Ref analogs: util/Daemon.java (daemon threads), util/StopWatch.java,
+util/JvmPauseMonitor.java:47 (here: a GC/GIL stall detector based on wall-clock
+drift of a sleeper thread), NetUtils (ephemeral port helpers).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+from typing import Callable, List, Optional
+
+log = logging.getLogger(__name__)
+
+
+class Daemon(threading.Thread):
+    """Named daemon thread. Ref: util/Daemon.java."""
+
+    def __init__(self, target: Callable, name: str, args=(), kwargs=None):
+        super().__init__(target=target, name=name, args=args,
+                         kwargs=kwargs or {}, daemon=True)
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """Ephemeral port for minicluster daemons (ref: MiniDFSCluster port=0 use)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+class StopWatch:
+    def __init__(self, start: bool = True):
+        self._t0 = time.monotonic() if start else None
+        self._elapsed = 0.0
+
+    def start(self) -> "StopWatch":
+        self._t0 = time.monotonic()
+        return self
+
+    def stop(self) -> float:
+        if self._t0 is not None:
+            self._elapsed += time.monotonic() - self._t0
+            self._t0 = None
+        return self._elapsed
+
+    def elapsed(self) -> float:
+        if self._t0 is not None:
+            return self._elapsed + (time.monotonic() - self._t0)
+        return self._elapsed
+
+
+class PauseMonitor:
+    """Detects interpreter stalls (GC, GIL convoys, host overload) by measuring
+    oversleep of a fixed-interval sleeper. Ref: util/JvmPauseMonitor.java:47 —
+    same detection principle (sleep 500ms, warn when the wakeup is late).
+    """
+
+    def __init__(self, warn_threshold_s: float = 1.0, interval_s: float = 0.5,
+                 on_pause: Optional[Callable[[float], None]] = None):
+        self.warn_threshold_s = warn_threshold_s
+        self.interval_s = interval_s
+        self.pauses: List[float] = []
+        self._on_pause = on_pause
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = Daemon(self._run, "pause-monitor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2 * self.interval_s + 1)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            t0 = time.monotonic()
+            self._stop.wait(self.interval_s)
+            overslept = (time.monotonic() - t0) - self.interval_s
+            if overslept > self.warn_threshold_s:
+                self.pauses.append(overslept)
+                log.warning("Detected pause of ~%.2fs (threshold %.2fs)",
+                            overslept, self.warn_threshold_s)
+                if self._on_pause:
+                    self._on_pause(overslept)
+
+
+class RetryOnException:
+    """Bounded retry helper for idempotent host-side calls."""
+
+    def __init__(self, attempts: int = 3, delay_s: float = 0.1, backoff: float = 2.0,
+                 retryable=(OSError, ConnectionError)):
+        self.attempts = attempts
+        self.delay_s = delay_s
+        self.backoff = backoff
+        self.retryable = retryable
+
+    def call(self, fn: Callable, *args, **kwargs):
+        delay = self.delay_s
+        for i in range(self.attempts):
+            try:
+                return fn(*args, **kwargs)
+            except self.retryable:
+                if i == self.attempts - 1:
+                    raise
+                time.sleep(delay)
+                delay *= self.backoff

@@ -1,0 +1,30 @@
+"""Test harness configuration.
+
+Multi-chip code paths are tested on a virtual 8-device CPU mesh (the
+minicluster philosophy of the reference — real protocols, simulated fleet;
+ref: MiniDFSCluster.java:157): JAX must see these flags before first import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import logging
+
+import pytest
+
+logging.basicConfig(level=logging.INFO)
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_state():
+    """Each test gets a clean config registry and metrics system."""
+    from hadoop_tpu.conf import ConfigRegistry
+    from hadoop_tpu.metrics import metrics_system
+    yield
+    ConfigRegistry.reset_for_tests()
+    metrics_system().reset_for_tests()

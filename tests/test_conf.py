@@ -1,0 +1,137 @@
+"""Configuration tests (behavioral parity targets:
+ref hadoop-common/src/test/java/org/apache/hadoop/conf/TestConfiguration.java)."""
+
+import pytest
+
+from hadoop_tpu.conf import Configuration, ConfigRegistry
+from hadoop_tpu.conf.configuration import DeprecationDelta
+
+
+def test_basic_get_set():
+    c = Configuration(load_defaults=False)
+    c.set("a.b", "hello")
+    assert c.get("a.b") == "hello"
+    assert c.get("missing") is None
+    assert c.get("missing", "dflt") == "dflt"
+    assert "a.b" in c and "missing" not in c
+
+
+def test_typed_getters():
+    c = Configuration(load_defaults=False)
+    c.set("i", "42")
+    c.set("hex", "0x10")
+    c.set("f", "2.5")
+    c.set("b1", "true")
+    c.set("b2", "off")
+    c.set("sz", "64m")
+    c.set("t1", "30s")
+    c.set("t2", "5m")
+    c.set("t3", "100ms")
+    c.set("lst", "a, b ,c")
+    c.set("rng", "2000-2002,2010")
+    assert c.get_int("i") == 42
+    assert c.get_int("hex") == 16
+    assert c.get_int("nope", 7) == 7
+    assert c.get_float("f") == 2.5
+    assert c.get_bool("b1") is True
+    assert c.get_bool("b2") is False
+    assert c.get_size_bytes("sz") == 64 * 1024 * 1024
+    assert c.get_time_seconds("t1") == 30.0
+    assert c.get_time_seconds("t2") == 300.0
+    assert abs(c.get_time_seconds("t3") - 0.1) < 1e-9
+    assert c.get_list("lst") == ["a", "b", "c"]
+    assert c.get_range("rng") == [2000, 2001, 2002, 2010]
+
+
+def test_variable_expansion():
+    c = Configuration(load_defaults=False)
+    c.set("base.dir", "/data")
+    c.set("log.dir", "${base.dir}/logs")
+    c.set("deep", "${log.dir}/app")
+    assert c.get("log.dir") == "/data/logs"
+    assert c.get("deep") == "/data/logs/app"
+    c.set("unresolved", "${nope}/x")
+    assert c.get("unresolved") == "${nope}/x"
+
+
+def test_env_expansion(monkeypatch):
+    monkeypatch.setenv("HTPU_TEST_HOME", "/opt/htpu")
+    c = Configuration(load_defaults=False)
+    c.set("home", "${env.HTPU_TEST_HOME}/bin")
+    assert c.get("home") == "/opt/htpu/bin"
+
+
+def test_self_recursion_bounded():
+    c = Configuration(load_defaults=False)
+    c.set("x", "${x}")
+    assert c.get("x") == "${x}"  # bounded at MAX_SUBST_DEPTH, no hang
+
+
+def test_deprecation():
+    ConfigRegistry.add_deprecations([DeprecationDelta("old.key", ["new.key"])])
+    c = Configuration(load_defaults=False)
+    c.set("old.key", "v1")  # writes through to new.key
+    assert c.get("new.key") == "v1"
+    assert c.get("old.key") == "v1"
+    c.set("new.key", "v2")
+    assert c.get("old.key") == "v2"
+
+
+def test_final_properties(tmp_path):
+    site = tmp_path / "site.conf"
+    site.write_text("locked.key = base !final\nfree.key = f1\n")
+    c = Configuration(load_defaults=False)
+    c.add_resource(str(site))
+    assert c.get("locked.key") == "base"
+    override = tmp_path / "override.conf"
+    override.write_text("locked.key = hacked\nfree.key = f2\n")
+    c.add_resource(str(override))
+    assert c.get("locked.key") == "base"  # final wins
+    assert c.get("free.key") == "f2"
+
+
+def test_flat_and_json_resources(tmp_path):
+    flat = tmp_path / "a.conf"
+    flat.write_text("# comment\nk1 = v1\nk2=  v2\n")
+    js = tmp_path / "b.json"
+    js.write_text('{"k3": "v3", "k4": 4}')
+    c = Configuration(load_defaults=False)
+    c.add_resource(str(flat))
+    c.add_resource(str(js))
+    assert c.get("k1") == "v1"
+    assert c.get("k2") == "v2"
+    assert c.get("k3") == "v3"
+    assert c.get_int("k4") == 4
+    assert c.get_property_source("k1") == str(flat)
+
+
+def test_default_resources():
+    ConfigRegistry.add_default_resource({"framework.default": "yes"})
+    c = Configuration()
+    assert c.get("framework.default") == "yes"
+
+
+def test_prefix_and_copy():
+    c = Configuration(load_defaults=False)
+    c.set("dfs.block.size", "128m")
+    c.set("dfs.replication", "3")
+    c.set("yarn.memory", "8g")
+    assert c.get_by_prefix("dfs.") == {"block.size": "128m", "replication": "3"}
+    c2 = c.copy()
+    c2.set("dfs.replication", "5")
+    assert c.get("dfs.replication") == "3"
+
+
+def test_reconfigure_listener():
+    seen = []
+    c = Configuration(load_defaults=False)
+    c.set("k", "v0")
+    c.register_reconfigure_listener(lambda k, old, new: seen.append((k, old, new)))
+    c.set("k", "v1")
+    assert seen == [("k", "v0", "v1")]
+
+
+def test_get_class():
+    c = Configuration(load_defaults=False)
+    c.set("impl", "hadoop_tpu.conf.configuration.Configuration")
+    assert c.get_class("impl") is Configuration

@@ -1,0 +1,420 @@
+"""RPC layer tests (parity targets: ref
+hadoop-common/src/test/java/org/apache/hadoop/ipc/TestRPC.java,
+TestFairCallQueue.java, TestDecayRpcScheduler.java, TestRetryCache.java)."""
+
+import threading
+import time
+
+import pytest
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.ipc import (Client, DecayRpcScheduler, FairCallQueue,
+                            RemoteError, RetryCache, RetryInvocationHandler,
+                            RetryPolicies, RpcError, Server,
+                            StaticFailoverProxyProvider, current_call,
+                            get_proxy, idempotent)
+from hadoop_tpu.ipc.errors import StandbyError
+from hadoop_tpu.security.ugi import (AccessControlError, SecretManager,
+                                     UserGroupInformation)
+from hadoop_tpu.tracing.tracer import global_tracer
+
+
+class EchoProtocol:
+    """Test protocol."""
+
+    @idempotent
+    def echo(self, x):
+        return x
+
+    @idempotent
+    def add(self, a, b):
+        return a + b
+
+    def whoami(self):
+        ctx = current_call()
+        return {"user": ctx.user.user_name,
+                "real": ctx.user.real_user.user_name if ctx.user.real_user else None}
+
+    def boom(self):
+        raise ValueError("deliberate failure")
+
+    def access_denied(self):
+        raise AccessControlError("not allowed")
+
+    @idempotent
+    def slow(self, seconds):
+        time.sleep(seconds)
+        return "done"
+
+    @idempotent
+    def big(self, n):
+        return b"x" * n
+
+
+@pytest.fixture
+def server():
+    srv = Server(num_handlers=3, name="test")
+    srv.register_protocol("EchoProtocol", EchoProtocol())
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture
+def client():
+    c = Client()
+    yield c
+    c.stop()
+
+
+def test_roundtrip(server, client):
+    proxy = get_proxy(EchoProtocol, ("127.0.0.1", server.port), client=client)
+    assert proxy.echo("hello") == "hello"
+    assert proxy.add(2, 3) == 5
+    assert proxy.echo({"nested": [1, b"bytes", None]}) == {"nested": [1, b"bytes", None]}
+
+
+def test_large_payload(server, client):
+    proxy = get_proxy(EchoProtocol, ("127.0.0.1", server.port), client=client)
+    assert len(proxy.big(4 * 1024 * 1024)) == 4 * 1024 * 1024
+
+
+def test_remote_exception_resolution(server, client):
+    proxy = get_proxy(EchoProtocol, ("127.0.0.1", server.port), client=client)
+    with pytest.raises(ValueError, match="deliberate failure"):
+        proxy.boom()
+    with pytest.raises(AccessControlError):
+        proxy.access_denied()
+
+
+def test_unknown_method(server, client):
+    proxy = get_proxy(EchoProtocol, ("127.0.0.1", server.port), client=client)
+    with pytest.raises((AttributeError, RemoteError)):
+        proxy.no_such_method()
+
+
+def test_user_propagation(server, client):
+    proxy = get_proxy(EchoProtocol, ("127.0.0.1", server.port), client=client)
+    ugi = UserGroupInformation.create_remote_user("alice")
+    result = ugi.do_as(proxy.whoami)
+    assert result["user"] == "alice"
+
+
+def test_proxy_user(server, client):
+    real = UserGroupInformation.create_remote_user("scheduler")
+    proxy_ugi = UserGroupInformation.create_proxy_user("enduser", real)
+    proxy = get_proxy(EchoProtocol, ("127.0.0.1", server.port), client=client,
+                      user=proxy_ugi)
+    result = proxy.whoami()
+    assert result == {"user": "enduser", "real": "scheduler"}
+
+
+def test_concurrent_calls_multiplexed(server, client):
+    proxy = get_proxy(EchoProtocol, ("127.0.0.1", server.port), client=client)
+    results = []
+    errs = []
+
+    def worker(i):
+        try:
+            results.append(proxy.add(i, i))
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(20)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert sorted(results) == [2 * i for i in range(20)]
+
+
+def test_timeout():
+    srv = Server(num_handlers=1, name="slow")
+    srv.register_protocol("EchoProtocol", EchoProtocol())
+    srv.start()
+    c = Client()
+    try:
+        proxy = get_proxy(EchoProtocol, ("127.0.0.1", srv.port), client=c,
+                          timeout=0.3)
+        from hadoop_tpu.ipc import RpcTimeoutError
+        with pytest.raises(RpcTimeoutError):
+            proxy.slow(2.0)
+    finally:
+        c.stop()
+        srv.stop()
+
+
+def test_connection_refused():
+    c = Client()
+    try:
+        proxy = get_proxy(EchoProtocol, ("127.0.0.1", 1), client=c)
+        with pytest.raises(RpcError):
+            proxy.echo("x")
+    finally:
+        c.stop()
+
+
+def test_token_auth():
+    sm = SecretManager(kind="test-token")
+    srv = Server(num_handlers=2, name="secure", secret_manager=sm)
+    srv.register_protocol("EchoProtocol", EchoProtocol())
+    srv.start()
+    c = Client(token_kind="test-token")
+    try:
+        ugi = UserGroupInformation.create_remote_user("bob")
+        ugi.add_token(sm.create_token("bob"))
+        proxy = get_proxy(EchoProtocol, ("127.0.0.1", srv.port), client=c,
+                          user=ugi)
+        assert proxy.whoami()["user"] == "bob"
+    finally:
+        c.stop()
+        srv.stop()
+
+
+def test_bad_token_rejected():
+    sm = SecretManager(kind="test-token")
+    other_sm = SecretManager(kind="test-token")
+    srv = Server(num_handlers=2, name="secure2", secret_manager=sm)
+    srv.register_protocol("EchoProtocol", EchoProtocol())
+    srv.start()
+    c = Client(token_kind="test-token")
+    try:
+        ugi = UserGroupInformation.create_remote_user("mallory")
+        ugi.add_token(other_sm.create_token("mallory"))  # wrong key
+        proxy = get_proxy(EchoProtocol, ("127.0.0.1", srv.port), client=c,
+                          user=ugi)
+        with pytest.raises((RpcError, AccessControlError)):
+            proxy.whoami()
+    finally:
+        c.stop()
+        srv.stop()
+
+
+def test_trace_propagation(server, client):
+    tracer = global_tracer()
+    before = len(tracer.finished)
+    proxy = get_proxy(EchoProtocol, ("127.0.0.1", server.port), client=client)
+    with tracer.span("client-op") as sp:
+        trace_id = sp.trace_id
+        proxy.echo("traced")
+    spans = tracer.finished[before:]
+    server_spans = [s for s in spans if s.name == "test.echo"]
+    assert server_spans, "server should emit a span"
+    assert server_spans[0].trace_id == trace_id  # same trace across the wire
+
+
+def test_state_alignment(client):
+    state = {"txid": 7}
+    srv = Server(num_handlers=1, name="aligned",
+                 state_provider=lambda: state["txid"])
+    srv.register_protocol("EchoProtocol", EchoProtocol())
+    srv.start()
+    try:
+        proxy = get_proxy(EchoProtocol, ("127.0.0.1", srv.port), client=client)
+        proxy.echo(1)
+        conn = next(iter(client._conns.values()))
+        assert conn.last_state_id == 7
+        state["txid"] = 9
+        proxy.echo(2)
+        assert conn.last_state_id == 9
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------- QoS
+
+
+def test_fair_call_queue_priorities():
+    q = FairCallQueue(num_levels=2, capacity=100)
+    for i in range(10):
+        q.put_nowait(f"hog{i}", 1)
+    q.put_nowait("light0", 0)
+    q.put_nowait("light1", 0)
+    first_four = [q.get(timeout=1) for _ in range(4)]
+    # Weighted RR must service level-0 items promptly despite the hog backlog.
+    assert "light0" in first_four and "light1" in first_four
+    # All items eventually drain.
+    rest = [q.get(timeout=1) for _ in range(8)]
+    assert len(rest) == 8
+
+
+def test_decay_scheduler_prioritizes_light_users():
+    conf = Configuration(load_defaults=False)
+    conf.set("ipc.decay-scheduler.period", "3600s")  # no decay during test
+    sched = DecayRpcScheduler(num_levels=4, conf=conf)
+    try:
+        for _ in range(1000):
+            sched.priority("hog")
+        light = sched.priority("light")
+        hog = sched.priority("hog")
+        assert hog > light  # heavy user demoted
+        assert light == 0
+    finally:
+        sched.stop()
+
+
+def test_retry_cache_replay():
+    cache = RetryCache(ttl_s=60)
+    executions = []
+
+    def mutate(client_id, call_id):
+        entry = cache.wait_for_completion(client_id, call_id)
+        if entry.done:
+            return entry.payload
+        executions.append(1)
+        result = f"result-{len(executions)}"
+        cache.complete(entry, True, result)
+        return result
+
+    r1 = mutate(b"c1", 5)
+    r2 = mutate(b"c1", 5)  # retried call — must not re-execute
+    assert r1 == r2 == "result-1"
+    assert len(executions) == 1
+    r3 = mutate(b"c1", 6)  # different call id executes
+    assert r3 == "result-2"
+
+
+def test_retry_cache_failed_execution_retries():
+    cache = RetryCache()
+    entry = cache.wait_for_completion(b"c", 1)
+    cache.complete(entry, False)
+    entry2 = cache.wait_for_completion(b"c", 1)
+    assert not entry2.done  # failure evicted; retry re-executes
+
+
+# ------------------------------------------------------------ retry/failover
+
+
+class FlakyProxy:
+    def __init__(self, fail_times, exc_factory):
+        self.fail_times = fail_times
+        self.exc_factory = exc_factory
+        self.calls = 0
+
+    def _is_idempotent(self, name):
+        return True
+
+    def _set_retry_count(self, n):
+        pass
+
+    def op(self):
+        self.calls += 1
+        if self.calls <= self.fail_times:
+            raise self.exc_factory()
+        return "ok"
+
+
+def test_retry_handler_retries_then_succeeds():
+    from hadoop_tpu.ipc.errors import RetriableError
+    proxy = FlakyProxy(2, lambda: RetriableError("busy"))
+    provider = StaticFailoverProxyProvider(lambda addr: proxy, [("a", 1)])
+    handler = RetryInvocationHandler(
+        provider, RetryPolicies.failover_on_network_exception(delay_s=0.01))
+    assert handler.op() == "ok"
+    assert proxy.calls == 3
+
+
+def test_failover_on_standby():
+    active = FlakyProxy(0, lambda: None)
+    standby_calls = []
+
+    class StandbyProxy:
+        def _is_idempotent(self, name):
+            return True
+
+        def _set_retry_count(self, n):
+            pass
+
+        def op(self):
+            standby_calls.append(1)
+            raise StandbyError("standby")
+
+    proxies = {("standby", 1): StandbyProxy(), ("active", 2): active}
+    provider = StaticFailoverProxyProvider(
+        lambda addr: proxies[addr], [("standby", 1), ("active", 2)])
+    handler = RetryInvocationHandler(
+        provider, RetryPolicies.failover_on_network_exception(delay_s=0.01))
+    assert handler.op() == "ok"
+    assert len(standby_calls) == 1
+
+
+def test_server_metrics(server, client):
+    from hadoop_tpu.metrics import metrics_system
+    proxy = get_proxy(EchoProtocol, ("127.0.0.1", server.port), client=client)
+    for i in range(5):
+        proxy.echo(i)
+    snap = metrics_system().snapshot_all()["rpc.test"]
+    assert snap["rpc_processing_calls"] >= 5
+    assert snap["rpc_processing_time_num_ops"] >= 5
+
+
+def test_malformed_frame_does_not_kill_reader(server, client):
+    """Regression: a structurally-bad (non-dict) frame must drop only that
+    connection; the reader thread keeps serving others."""
+    import socket as _socket
+    import struct as _struct
+    from hadoop_tpu.io.wire import pack as _pack
+
+    proxy = get_proxy(EchoProtocol, ("127.0.0.1", server.port), client=client)
+    assert proxy.echo("before") == "before"
+
+    s = _socket.create_connection(("127.0.0.1", server.port))
+    hdr = _pack({"magic": "htpu1", "user": "evil"})
+    s.sendall(_struct.pack(">I", len(hdr)) + hdr)
+    bad = _pack(12345)  # valid wirepack, not a record
+    s.sendall(_struct.pack(">I", len(bad)) + bad)
+    time.sleep(0.3)
+    s.close()
+
+    # Existing multiplexed connection must still work.
+    assert proxy.echo("after") == "after"
+    # And brand-new connections must still be accepted and served.
+    c2 = Client()
+    try:
+        p2 = get_proxy(EchoProtocol, ("127.0.0.1", server.port), client=c2)
+        assert p2.echo("fresh") == "fresh"
+    finally:
+        c2.stop()
+
+
+def test_token_auth_preserves_proxy_user():
+    """Regression: under TOKEN auth the effective user must ride on top of the
+    token owner as a proxy user, not be silently replaced by it."""
+    sm = SecretManager(kind="test-token")
+    srv = Server(num_handlers=2, name="secure3", secret_manager=sm)
+    srv.register_protocol("EchoProtocol", EchoProtocol())
+    srv.start()
+    c = Client(token_kind="test-token")
+    try:
+        real = UserGroupInformation.create_remote_user("scheduler")
+        ugi = UserGroupInformation.create_proxy_user("enduser", real)
+        ugi.add_token(sm.create_token("scheduler"))
+        proxy = get_proxy(EchoProtocol, ("127.0.0.1", srv.port), client=c,
+                          user=ugi)
+        assert proxy.whoami() == {"user": "enduser", "real": "scheduler"}
+    finally:
+        c.stop()
+        srv.stop()
+
+
+def test_remote_app_errors_do_not_failover():
+    """Regression: a deterministic remote error (e.g. AccessControlError) must
+    fail fast, not bounce across HA nodes."""
+    from hadoop_tpu.ipc.errors import resolve_exception
+
+    e = resolve_exception(
+        "hadoop_tpu.security.ugi.AccessControlError", "denied")
+    policy = RetryPolicies.failover_on_network_exception(delay_s=0.01)
+    action = policy.should_retry(e, 0, 0, idempotent=True)
+    from hadoop_tpu.ipc.retry import RetryAction
+    assert action.action == RetryAction.FAIL
+
+
+def test_retry_cache_timeout_is_retriable():
+    from hadoop_tpu.ipc.errors import RetriableError
+    cache = RetryCache()
+    owner = cache.wait_for_completion(b"c", 1)
+    assert not owner.done  # we own it and never complete it
+    with pytest.raises(RetriableError):
+        cache.wait_for_completion(b"c", 1, timeout=0.1)

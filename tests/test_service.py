@@ -1,0 +1,112 @@
+"""Service lifecycle tests (parity targets: ref
+hadoop-common/src/test/java/org/apache/hadoop/service/TestServiceLifecycle.java,
+TestCompositeService.java)."""
+
+import pytest
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.service import (AbstractService, CompositeService, ServiceState,
+                                ServiceStateException)
+
+
+class Recorder(AbstractService):
+    def __init__(self, name, events, fail_in=None):
+        super().__init__(name)
+        self.events = events
+        self.fail_in = fail_in
+
+    def service_init(self, conf):
+        if self.fail_in == "init":
+            raise RuntimeError("init boom")
+        self.events.append(f"{self.name}.init")
+
+    def service_start(self):
+        if self.fail_in == "start":
+            raise RuntimeError("start boom")
+        self.events.append(f"{self.name}.start")
+
+    def service_stop(self):
+        self.events.append(f"{self.name}.stop")
+
+
+def test_lifecycle_order():
+    ev = []
+    s = Recorder("s", ev)
+    conf = Configuration(load_defaults=False)
+    assert s.state == ServiceState.NOTINITED
+    s.init(conf)
+    assert s.state == ServiceState.INITED
+    s.start()
+    assert s.state == ServiceState.STARTED
+    s.stop()
+    assert s.state == ServiceState.STOPPED
+    assert ev == ["s.init", "s.start", "s.stop"]
+
+
+def test_cannot_start_uninited():
+    s = Recorder("s", [])
+    with pytest.raises(ServiceStateException):
+        s.start()
+
+
+def test_stop_idempotent_from_any_state():
+    ev = []
+    s = Recorder("s", ev)
+    s.stop()
+    s.stop()
+    assert s.state == ServiceState.STOPPED
+    assert ev == ["s.stop"]
+
+
+def test_start_failure_triggers_stop():
+    ev = []
+    s = Recorder("s", ev, fail_in="start")
+    s.init(Configuration(load_defaults=False))
+    with pytest.raises(RuntimeError):
+        s.start()
+    assert s.state == ServiceState.STOPPED
+    assert s.failure_cause is not None
+    assert ev == ["s.init", "s.stop"]
+
+
+def test_composite_order_and_reverse_stop():
+    ev = []
+    parent = CompositeService("parent")
+    parent.add_service(Recorder("a", ev))
+    parent.add_service(Recorder("b", ev))
+    conf = Configuration(load_defaults=False)
+    parent.init(conf)
+    parent.start()
+    parent.stop()
+    assert ev == ["a.init", "b.init", "a.start", "b.start", "b.stop", "a.stop"]
+
+
+def test_composite_child_start_failure_stops_started_children():
+    ev = []
+    parent = CompositeService("parent")
+    parent.add_service(Recorder("a", ev))
+    parent.add_service(Recorder("bad", ev, fail_in="start"))
+    parent.init(Configuration(load_defaults=False))
+    with pytest.raises(RuntimeError):
+        parent.start()
+    assert parent.state == ServiceState.STOPPED
+    assert "a.stop" in ev  # started child got torn down
+
+
+def test_listeners():
+    states = []
+    s = Recorder("s", [])
+    s.register_listener(lambda svc, st: states.append(st))
+    s.init(Configuration(load_defaults=False))
+    s.start()
+    s.stop()
+    assert states == [ServiceState.INITED, ServiceState.STARTED,
+                      ServiceState.STOPPED]
+
+
+def test_context_manager():
+    ev = []
+    with Recorder("s", ev) as s:
+        s.init(Configuration(load_defaults=False))
+        s.start()
+    assert s.state == ServiceState.STOPPED

@@ -1,0 +1,80 @@
+"""Wire format round-trip tests."""
+
+import io
+
+import pytest
+
+from hadoop_tpu.io.wire import (WireError, pack, read_frame, unpack,
+                                unpack_with_offset, write_frame)
+
+
+@pytest.mark.parametrize("value", [
+    None, True, False, 0, 1, 127, 128, -1, -32, -33, 2**40, -(2**40),
+    2**70, -(2**70), 0.0, -1.5, 3.14159, "", "hi", "x" * 31, "x" * 32,
+    "日本語テキスト", b"", b"\x00\xff" * 100, [], [1, 2, 3], list(range(50)),
+    {}, {"a": 1}, {"k" + str(i): i for i in range(40)},
+    {"nested": {"list": [1, "two", b"three", None, {"deep": [[[]]]}]}},
+])
+def test_roundtrip(value):
+    assert unpack(pack(value)) == value
+
+
+def test_tuple_decodes_as_list():
+    assert unpack(pack((1, 2))) == [1, 2]
+
+
+def test_small_values_compact():
+    assert len(pack(5)) == 1
+    assert len(pack("abc")) == 4
+    assert len(pack({})) == 1
+    assert len(pack([1, 2, 3])) == 4
+
+
+def test_non_str_key_rejected():
+    with pytest.raises(WireError):
+        pack({1: "x"})
+
+
+def test_unencodable_rejected():
+    with pytest.raises(WireError):
+        pack(object())
+
+
+def test_truncated_raises():
+    data = pack({"k": "value-that-is-long-enough"})
+    with pytest.raises(WireError):
+        unpack(data[:-3])
+
+
+def test_offset_chaining():
+    data = pack(1) + pack("two") + pack([3])
+    v1, off = unpack_with_offset(data, 0)
+    v2, off = unpack_with_offset(data, off)
+    v3, off = unpack_with_offset(data, off)
+    assert (v1, v2, v3) == (1, "two", [3])
+    assert off == len(data)
+
+
+def test_to_wire_objects():
+    class Point:
+        def to_wire(self):
+            return {"x": 1, "y": 2}
+    assert unpack(pack(Point())) == {"x": 1, "y": 2}
+    assert unpack(pack([Point(), Point()])) == [{"x": 1, "y": 2}] * 2
+
+
+def test_stream_framing():
+    buf = io.BytesIO()
+    write_frame(buf, pack({"msg": "hello"}))
+    write_frame(buf, pack([1, 2]))
+    buf.seek(0)
+    assert unpack(read_frame(buf)) == {"msg": "hello"}
+    assert unpack(read_frame(buf)) == [1, 2]
+
+
+def test_frame_limit():
+    buf = io.BytesIO()
+    write_frame(buf, b"x" * 100)
+    buf.seek(0)
+    with pytest.raises(WireError):
+        read_frame(buf, max_frame=10)
