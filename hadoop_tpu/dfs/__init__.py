@@ -1,0 +1,8 @@
+"""hadoop_tpu.dfs — the distributed filesystem.
+
+Capability-equivalent rebuild of HDFS (ref: hadoop-hdfs-project): a metadata
+master (``namenode``) holding the namespace in memory backed by a write-ahead
+edit log + checkpoint images; block servers (``datanode``) storing replicated
+blocks and streaming them over a packet protocol with per-chunk CRCs; and a
+client (``client``) with pipelined writes and replica-failover reads.
+"""

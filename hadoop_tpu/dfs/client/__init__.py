@@ -1,0 +1,4 @@
+from hadoop_tpu.dfs.client.dfsclient import DFSClient
+from hadoop_tpu.dfs.client.filesystem import DistributedFileSystem
+
+__all__ = ["DFSClient", "DistributedFileSystem"]

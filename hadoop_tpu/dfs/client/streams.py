@@ -1,0 +1,455 @@
+"""Client streams: pipelined block writes, checksum-verified failover reads.
+
+Write path parity (ref: hadoop-hdfs-client DFSOutputStream.java:263
+newStreamForCreate, DataStreamer.java:116/:655 run/:1656
+nextBlockOutputStream/:872 waitForAckedSeqno, FSOutputSummer.java): the app
+thread chunks bytes into 64 KB packets with per-512B CRCs onto a bounded data
+queue; the DataStreamer thread allocates blocks (add_block RPC with an
+exclude list), builds the DN pipeline, streams packets, and a
+ResponseProcessor consumes pipeline acks.
+
+Pipeline failure handling: a failed setup excludes the reported bad node and
+re-allocates (ref: nextBlockOutputStream's abandonBlock+retry loop). A
+mid-block failure re-sends the whole current block through a fresh pipeline —
+packets of the active block are retained until the block completes, so the
+recovery window is one block (the reference instead replays only unacked
+packets onto the surviving DNs with a new generation stamp
+[DataStreamer error paths + updatePipeline]; same durability contract, at
+the cost of a block-sized rather than window-sized client buffer).
+
+Read path parity (ref: DFSInputStream.java:639 blockSeekTo / :724
+getBlockReader, BlockReaderFactory.java:88): per-block location list from the
+NN (NN pre-shuffles), CRC verification per packet, dead-node marking and
+next-replica failover; corrupt replicas are reported back to the NN.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+from hadoop_tpu.dfs.protocol import datatransfer as dt
+from hadoop_tpu.dfs.protocol.records import Block, DatanodeInfo, LocatedBlock
+from hadoop_tpu.util.crc import ChecksumError, DataChecksum
+
+log = logging.getLogger(__name__)
+
+
+class _Packet:
+    __slots__ = ("seq", "offset", "data", "sums", "last")
+
+    def __init__(self, seq: int, offset: int, data: bytes, sums: bytes,
+                 last: bool):
+        self.seq = seq
+        self.offset = offset
+        self.data = data
+        self.sums = sums
+        self.last = last
+
+    def to_frame(self) -> Dict:
+        return {"seq": self.seq, "off": self.offset, "data": self.data,
+                "sums": self.sums, "last": self.last}
+
+
+class PipelineError(IOError):
+    def __init__(self, msg: str, bad_node: Optional[str] = None):
+        super().__init__(msg)
+        self.bad_node = bad_node
+
+
+class DFSOutputStream:
+    def __init__(self, client, path: str, packet_size: int = dt.PACKET_SIZE,
+                 chunk_size: int = dt.CHUNK_SIZE):
+        self.client = client
+        self.path = path
+        self.packet_size = packet_size
+        self.checksum = DataChecksum(chunk_size)
+        self._buf = bytearray()
+        self._pos = 0          # bytes written overall
+        self._block_pos = 0    # bytes in current block
+        self._seq = 0
+        self._closed = False
+        self._block_size = None  # filled on first allocation
+        # Packets of the in-flight block, retained for whole-block recovery.
+        self._block_packets: List[_Packet] = []
+        self._exclude: Set[str] = set()
+        self._current: Optional[Block] = None   # last allocated block
+        self._pipeline: Optional[_Pipeline] = None  # open write pipeline
+
+    # --------------------------------------------------------------- writes
+
+    def write(self, data: bytes) -> int:
+        if self._closed:
+            raise ValueError("stream closed")
+        self._buf += data
+        self._drain_full_packets()
+        return len(data)
+
+    def _drain_full_packets(self, flush_all: bool = False) -> None:
+        while len(self._buf) >= self.packet_size or (flush_all and self._buf):
+            if self._pipeline is None:
+                self._start_block()  # sets _block_size
+            room = self._block_size - self._block_pos
+            if room <= 0:
+                self._finish_block()
+                self._start_block()
+                room = self._block_size
+            take = min(self.packet_size, len(self._buf), room)
+            chunk = bytes(self._buf[:take])
+            del self._buf[:take]
+            self._send_packet(chunk)
+
+    def _send_packet(self, data: bytes) -> None:
+        sums = self.checksum.checksums_for(data)
+        pkt = _Packet(self._seq, self._block_pos, data, sums, last=False)
+        self._seq += 1
+        self._block_packets.append(pkt)
+        self._stream_packet(pkt)
+        self._block_pos += len(data)
+        self._pos += len(data)
+
+    # ----------------------------------------------------- block lifecycle
+
+    def _start_block(self) -> None:
+        """Allocate a block + build its pipeline, excluding known-bad nodes.
+        Ref: DataStreamer.nextBlockOutputStream:1656."""
+        last_exc: Optional[Exception] = None
+        for _ in range(5):
+            prev = self._current.to_wire() if self._current else None
+            lb = self.client.allocate_block(self.path, prev,
+                                            list(self._exclude))
+            block, locs = lb.block, lb.locations
+            if self._block_size is None:
+                self._block_size = self.client.block_size_for(self.path)
+            try:
+                self._pipeline = _Pipeline(block, locs, self.checksum)
+                self._current = block
+                self._block_pos = 0
+                self._block_packets = []
+                return
+            except PipelineError as e:
+                last_exc = e
+                if e.bad_node:
+                    self._exclude.add(e.bad_node)
+                self.client.abandon_block(self.path, block)
+                log.warning("Pipeline setup for %s failed (%s); retrying",
+                            block, e)
+        raise IOError(f"could not build pipeline for {self.path}: {last_exc}")
+
+    def _stream_packet(self, pkt: _Packet) -> None:
+        try:
+            self._pipeline.send(pkt)
+        except (OSError, PipelineError) as e:
+            self._recover_block(e)
+
+    def _recover_block(self, cause: Exception) -> None:
+        """Whole-block recovery: abandon, re-allocate excluding suspects,
+        replay retained packets."""
+        log.warning("Pipeline for %s failed (%s); recovering block",
+                    self._current, cause)
+        bad = getattr(cause, "bad_node", None)
+        if bad:
+            self._exclude.add(bad)
+        else:
+            self._exclude.update(self._pipeline.suspect_nodes())
+        try:
+            self._pipeline.close(abort=True)
+        except Exception:
+            pass
+        old_packets = self._block_packets
+        self.client.abandon_block(self.path, self._current)
+        # The block before the abandoned one was already committed by the
+        # add_block(previous=...) that allocated it, so the fresh allocation
+        # passes previous=None.
+        self._current = None
+        self._start_block()
+        for pkt in old_packets:
+            self._block_packets.append(pkt)
+            self._pipeline.send(pkt)
+            self._block_pos += len(pkt.data)
+
+    def _finish_block(self) -> None:
+        """Send the trailing empty packet, await all acks, commit length."""
+        if self._pipeline is None:
+            return
+        last = _Packet(self._seq, self._block_pos, b"", b"", last=True)
+        self._seq += 1
+        while True:
+            try:
+                self._pipeline.send(last)
+                self._pipeline.wait_all_acked()
+                break
+            except (OSError, PipelineError) as e:
+                self._recover_block(e)
+        self._current.num_bytes = self._block_pos
+        self._pipeline.close()
+        self._pipeline = None
+        self._block_packets = []
+
+    # ---------------------------------------------------------------- close
+
+    def flush(self) -> None:
+        self._drain_full_packets(flush_all=True)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._drain_full_packets(flush_all=True)
+        self._finish_block()  # no-op for an empty file (no pipeline)
+        self.client.complete_file(
+            self.path, self._current.to_wire() if self._current else None)
+        self._closed = True
+
+    def tell(self) -> int:
+        return self._pos
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, *rest):
+        if exc_type is None:
+            self.close()
+        return False
+
+
+class _Pipeline:
+    """One block's write pipeline: socket to the first DN, ack reader thread.
+    Ref: DataStreamer's blockStream + ResponseProcessor."""
+
+    ACK_TIMEOUT_S = 30.0
+
+    def __init__(self, block: Block, locations: List[DatanodeInfo],
+                 checksum: DataChecksum):
+        if not locations:
+            raise PipelineError("no locations for block")
+        self.block = block
+        self.locations = locations
+        self._unacked: "queue.Queue[int]" = queue.Queue()
+        self._acked_through = -1
+        self._ack_cond = threading.Condition()
+        self._error: Optional[Exception] = None
+        try:
+            self.sock = dt.connect(locations[0].xfer_addr(), timeout=10.0)
+            dt.send_frame(self.sock, {
+                "op": dt.OP_WRITE_BLOCK, "b": block.to_wire(),
+                "targets": [t.to_wire() for t in locations[1:]],
+                "stage": dt.STAGE_PIPELINE_SETUP_CREATE,
+                "bpc": checksum.bytes_per_chunk,
+            })
+            setup = dt.recv_frame(self.sock)
+            if not setup.get("ok"):
+                raise PipelineError(setup.get("em", "pipeline setup failed"),
+                                    bad_node=setup.get("bad_node"))
+        except (OSError, EOFError) as e:
+            raise PipelineError(
+                f"connect to {locations[0]} failed: {e}",
+                bad_node=locations[0].uuid) from e
+        self._ack_thread = threading.Thread(
+            target=self._ack_loop, daemon=True,
+            name=f"resp-proc-{block.block_id}")
+        self._ack_thread.start()
+
+    def _ack_loop(self) -> None:
+        try:
+            while True:
+                ack = dt.recv_frame(self.sock)
+                statuses = ack.get("statuses", [])
+                if any(s != dt.STATUS_SUCCESS for s in statuses):
+                    bad_idx = next(i for i, s in enumerate(statuses)
+                                   if s != dt.STATUS_SUCCESS)
+                    bad = self.locations[bad_idx].uuid \
+                        if bad_idx < len(self.locations) else None
+                    raise PipelineError(f"ack failure {statuses}",
+                                        bad_node=bad)
+                with self._ack_cond:
+                    self._acked_through = ack["seq"]
+                    self._ack_cond.notify_all()
+                if ack.get("last"):
+                    return
+        except (OSError, EOFError, PipelineError, Exception) as e:  # noqa: BLE001
+            with self._ack_cond:
+                self._error = e if isinstance(e, (OSError, PipelineError)) \
+                    else PipelineError(str(e))
+                self._ack_cond.notify_all()
+
+    def send(self, pkt: _Packet) -> None:
+        with self._ack_cond:
+            if self._error is not None:
+                raise self._error
+        self._last_seq = pkt.seq
+        dt.send_frame(self.sock, pkt.to_frame())
+
+    def wait_all_acked(self) -> None:
+        """Ref: DataStreamer.waitForAckedSeqno:872."""
+        deadline = time.monotonic() + self.ACK_TIMEOUT_S
+        with self._ack_cond:
+            while self._acked_through < getattr(self, "_last_seq", -1):
+                if self._error is not None:
+                    raise self._error
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise PipelineError("timed out waiting for pipeline acks")
+                self._ack_cond.wait(remaining)
+
+    def suspect_nodes(self) -> List[str]:
+        return [d.uuid for d in self.locations]
+
+    def close(self, abort: bool = False) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class DFSInputStream:
+    def __init__(self, client, path: str):
+        self.client = client
+        self.path = path
+        self._refresh_locations()
+        self._pos = 0
+        self._closed = False
+        self._dead: Set[str] = set()
+        self._sock = None
+        self._sock_block: Optional[int] = None
+        self._chunk_buf = b""
+        self._chunk_buf_off = 0
+
+    def _refresh_locations(self) -> None:
+        info = self.client.get_block_locations(self.path)
+        self.length = info["length"]
+        self.blocks = [LocatedBlock.from_wire(b) for b in info["blocks"]]
+
+    # ---------------------------------------------------------------- reads
+
+    def read(self, n: int = -1) -> bytes:
+        if self._closed:
+            raise ValueError("stream closed")
+        if n < 0:
+            n = self.length - self._pos
+        out = bytearray()
+        while n > 0 and self._pos < self.length:
+            chunk = self._read_some(self._pos, n)
+            if not chunk:
+                break
+            out += chunk
+            self._pos += len(chunk)
+            n -= len(chunk)
+        return bytes(out)
+
+    def pread(self, position: int, length: int) -> bytes:
+        """Positioned read, does not move the cursor.
+        Ref: DFSInputStream.read(long,...) / PositionedReadable."""
+        out = bytearray()
+        pos = position
+        remaining = min(length, self.length - position)
+        while remaining > 0:
+            chunk = self._fetch_range(pos, remaining)
+            if not chunk:
+                break
+            out += chunk
+            pos += len(chunk)
+            remaining -= len(chunk)
+        return bytes(out)
+
+    def seek(self, pos: int) -> None:
+        if pos != self._pos:
+            self._pos = pos
+            self._close_block_sock()
+
+    def tell(self) -> int:
+        return self._pos
+
+    def _block_for(self, pos: int) -> LocatedBlock:
+        for lb in self.blocks:
+            if lb.offset <= pos < lb.offset + lb.block.num_bytes:
+                return lb
+        raise EOFError(f"offset {pos} beyond file length {self.length}")
+
+    def _read_some(self, pos: int, want: int) -> bytes:
+        return self._fetch_range(pos, want)
+
+    def _fetch_range(self, pos: int, want: int) -> bytes:
+        """Read up to ``want`` bytes at pos from one replica, with failover.
+        Ref: DFSInputStream.blockSeekTo:639 + read retry loop."""
+        lb = self._block_for(pos)
+        in_block_off = pos - lb.offset
+        want = min(want, lb.block.num_bytes - in_block_off)
+        errors: List[str] = []
+        candidates = [d for d in lb.locations if d.uuid not in self._dead] \
+            or lb.locations  # all dead? retry everyone once
+        for dn in candidates:
+            try:
+                return self._read_from_datanode(dn, lb.block, in_block_off,
+                                                want)
+            except ChecksumError:
+                log.warning("Checksum error reading %s from %s; reporting",
+                            lb.block, dn)
+                self.client.report_bad_block(lb.block, dn.uuid)
+                self._dead.add(dn.uuid)
+                errors.append(f"{dn}: checksum")
+            except (OSError, EOFError, IOError) as e:
+                self._dead.add(dn.uuid)
+                errors.append(f"{dn}: {e}")
+            self._close_block_sock()
+        # One refresh: replicas may have moved (re-replication).
+        self._refresh_locations()
+        self._dead.clear()
+        lb = self._block_for(pos)
+        for dn in lb.locations:
+            try:
+                return self._read_from_datanode(dn, lb.block, in_block_off,
+                                                want)
+            except (OSError, EOFError, IOError) as e:
+                errors.append(f"{dn}: {e}")
+        raise IOError(f"could not read {self.path} at {pos} from any "
+                      f"replica: {errors}")
+
+    def _read_from_datanode(self, dn: DatanodeInfo, block: Block,
+                            offset: int, want: int) -> bytes:
+        sock = dt.connect(dn.xfer_addr(), timeout=10.0)
+        try:
+            dt.send_frame(sock, {"op": dt.OP_READ_BLOCK, "b": block.to_wire(),
+                                 "offset": offset, "length": want})
+            setup = dt.recv_frame(sock)
+            if not setup.get("ok"):
+                raise IOError(setup.get("em", "read setup failed"))
+            checksum = DataChecksum(dt.CHUNK_SIZE)
+            out = bytearray()
+            skip = None
+            while True:
+                pkt = dt.recv_frame(sock)
+                if pkt.get("last"):
+                    break
+                data, sums = pkt["data"], pkt["sums"]
+                checksum.verify(data, sums, base_pos=pkt["off"])
+                if skip is None:
+                    skip = offset - pkt["off"]  # chunk alignment slack
+                take = data[skip:skip + (want - len(out))] if skip else \
+                    data[:want - len(out)]
+                out += take
+                skip = 0
+            return bytes(out)
+        finally:
+            sock.close()
+
+    def _close_block_sock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        self._closed = True
+        self._close_block_sock()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

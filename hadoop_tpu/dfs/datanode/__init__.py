@@ -1,0 +1,3 @@
+from hadoop_tpu.dfs.datanode.datanode import DataNode
+
+__all__ = ["DataNode"]

@@ -1,0 +1,249 @@
+"""On-disk replica store.
+
+Parity with the reference's dataset layer (ref:
+server/datanode/fsdataset/impl/FsDatasetImpl.java:136, ReplicaInfo state
+machine, BlockMetadataHeader): replicas live as a data file + a ``.meta``
+side file (DataChecksum header + one CRC per chunk). Under-construction
+replicas ("rbw" — replica being written) live in ``rbw/`` and move to
+``finalized/`` atomically on completion.
+
+Layout:  <dir>/rbw/blk_<id>            + blk_<id>.meta
+         <dir>/finalized/blk_<id>      + blk_<id>.meta
+(The gen stamp is recorded inside the meta header trailer, not the filename,
+so recovery-time stamp bumps are a metadata rewrite, not a data copy.)
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from hadoop_tpu.dfs.protocol.records import Block, ReplicaNotFoundError
+from hadoop_tpu.util.crc import DataChecksum
+
+_META_MAGIC = b"HTPM"
+
+
+class Replica:
+    FINALIZED = "finalized"
+    RBW = "rbw"
+
+    __slots__ = ("block_id", "gen_stamp", "num_bytes", "state")
+
+    def __init__(self, block_id: int, gen_stamp: int, num_bytes: int,
+                 state: str):
+        self.block_id = block_id
+        self.gen_stamp = gen_stamp
+        self.num_bytes = num_bytes
+        self.state = state
+
+    def to_block(self) -> Block:
+        return Block(self.block_id, self.gen_stamp, self.num_bytes)
+
+
+class _OpenReplica:
+    """An rbw replica with open file handles, fed packet by packet."""
+
+    def __init__(self, store: "BlockStore", block: Block, checksum: DataChecksum):
+        self.store = store
+        self.block_id = block.block_id
+        self.gen_stamp = block.gen_stamp
+        self.checksum = checksum
+        self.data_path = store._path(Replica.RBW, block.block_id)
+        self.meta_path = self.data_path + ".meta"
+        self._data_f = open(self.data_path, "wb")
+        self._meta_f = open(self.meta_path, "wb")
+        self._meta_f.write(_META_MAGIC + struct.pack(">q", block.gen_stamp)
+                           + checksum.header())
+        self.num_bytes = 0
+
+    def write_packet(self, data: bytes, sums: bytes) -> None:
+        self._data_f.write(data)
+        self._meta_f.write(sums)
+        self.num_bytes += len(data)
+
+    def fsync(self) -> None:
+        self._data_f.flush()
+        os.fsync(self._data_f.fileno())
+        self._meta_f.flush()
+        os.fsync(self._meta_f.fileno())
+
+    def close(self) -> None:
+        self._data_f.close()
+        self._meta_f.close()
+
+    def abort(self) -> None:
+        self.close()
+        for p in (self.data_path, self.meta_path):
+            if os.path.exists(p):
+                os.remove(p)
+
+
+class BlockStore:
+    def __init__(self, directory: str, chunk_size: int = 512):
+        self.dir = directory
+        self.chunk_size = chunk_size
+        for sub in (Replica.RBW, Replica.FINALIZED):
+            os.makedirs(os.path.join(directory, sub), exist_ok=True)
+        self._replicas: Dict[int, Replica] = {}
+        self._lock = threading.Lock()
+        self._scan()
+
+    def _path(self, state: str, block_id: int) -> str:
+        return os.path.join(self.dir, state, f"blk_{block_id}")
+
+    def _scan(self) -> None:
+        """Startup inventory (ref: DataNode's DirectoryScanner.java:64 initial
+        pass). rbw replicas left by a crash are kept — the NN decides their
+        fate via block recovery or invalidation."""
+        for state in (Replica.FINALIZED, Replica.RBW):
+            d = os.path.join(self.dir, state)
+            for name in os.listdir(d):
+                if not name.startswith("blk_") or name.endswith(".meta"):
+                    continue
+                bid = int(name[4:])
+                data_path = os.path.join(d, name)
+                gs = self._read_meta_genstamp(data_path + ".meta")
+                if gs is None:
+                    continue
+                self._replicas[bid] = Replica(
+                    bid, gs, os.path.getsize(data_path), state)
+
+    @staticmethod
+    def _read_meta_genstamp(meta_path: str) -> Optional[int]:
+        try:
+            with open(meta_path, "rb") as f:
+                magic = f.read(4)
+                if magic != _META_MAGIC:
+                    return None
+                return struct.unpack(">q", f.read(8))[0]
+        except OSError:
+            return None
+
+    # --------------------------------------------------------------- writes
+
+    def create_rbw(self, block: Block, checksum: DataChecksum) -> _OpenReplica:
+        with self._lock:
+            existing = self._replicas.get(block.block_id)
+            if existing is not None:
+                if existing.state == Replica.FINALIZED:
+                    raise IOError(f"block {block.block_id} already finalized")
+                # Pipeline recovery overwrites a stale rbw replica.
+                self._remove_files(existing)
+                del self._replicas[block.block_id]
+            rep = Replica(block.block_id, block.gen_stamp, 0, Replica.RBW)
+            self._replicas[block.block_id] = rep
+        return _OpenReplica(self, block, checksum)
+
+    def finalize(self, open_rep: _OpenReplica) -> Replica:
+        """fsync + atomic move rbw → finalized.
+        Ref: FsDatasetImpl.finalizeBlock."""
+        open_rep.fsync()
+        open_rep.close()
+        dst = self._path(Replica.FINALIZED, open_rep.block_id)
+        os.replace(open_rep.data_path, dst)
+        os.replace(open_rep.meta_path, dst + ".meta")
+        with self._lock:
+            rep = Replica(open_rep.block_id, open_rep.gen_stamp,
+                          open_rep.num_bytes, Replica.FINALIZED)
+            self._replicas[open_rep.block_id] = rep
+            return rep
+
+    def invalidate(self, block: Block) -> bool:
+        """Delete a replica. Ref: FsDatasetImpl.invalidate."""
+        with self._lock:
+            rep = self._replicas.pop(block.block_id, None)
+            if rep is None:
+                return False
+            self._remove_files(rep)
+            return True
+
+    def _remove_files(self, rep: Replica) -> None:
+        p = self._path(rep.state, rep.block_id)
+        for path in (p, p + ".meta"):
+            if os.path.exists(path):
+                os.remove(path)
+
+    def update_gen_stamp(self, block_id: int, new_gs: int) -> None:
+        """Block recovery: bump the stamp in place (metadata rewrite)."""
+        with self._lock:
+            rep = self._replicas.get(block_id)
+            if rep is None:
+                raise ReplicaNotFoundError(str(block_id))
+            meta = self._path(rep.state, block_id) + ".meta"
+            with open(meta, "r+b") as f:
+                f.seek(4)
+                f.write(struct.pack(">q", new_gs))
+            rep.gen_stamp = new_gs
+
+    # ---------------------------------------------------------------- reads
+
+    def get_replica(self, block_id: int) -> Optional[Replica]:
+        with self._lock:
+            return self._replicas.get(block_id)
+
+    def open_for_read(self, block: Block) -> Tuple[str, str, DataChecksum, int]:
+        """Returns (data_path, meta_path, checksum, visible_length)."""
+        with self._lock:
+            rep = self._replicas.get(block.block_id)
+        if rep is None:
+            raise ReplicaNotFoundError(f"blk_{block.block_id} not on this node")
+        if rep.gen_stamp < block.gen_stamp:
+            raise ReplicaNotFoundError(
+                f"blk_{block.block_id} replica genstamp {rep.gen_stamp} < "
+                f"requested {block.gen_stamp}")
+        data_path = self._path(rep.state, block.block_id)
+        meta_path = data_path + ".meta"
+        with open(meta_path, "rb") as f:
+            f.seek(4 + 8)
+            checksum = DataChecksum.from_header(
+                f.read(DataChecksum.HEADER_LEN))
+        return data_path, meta_path, checksum, rep.num_bytes
+
+    def read_chunks(self, block: Block, offset: int, length: int):
+        """Yield (chunk_aligned_offset, data, sums) runs for a byte range,
+        chunk-aligned so the reader can CRC-verify. Ref: BlockSender.java."""
+        data_path, meta_path, checksum, visible = self.open_for_read(block)
+        bpc = checksum.bytes_per_chunk
+        start = (offset // bpc) * bpc
+        end = min(visible, offset + length)
+        with open(data_path, "rb") as df, open(meta_path, "rb") as mf:
+            meta_header = 4 + 8 + DataChecksum.HEADER_LEN
+            pos = start
+            while pos < end:
+                n = min(64 * 1024, end - pos)
+                # Round n up to chunk boundary (or EOF).
+                n = min(((n + bpc - 1) // bpc) * bpc, visible - pos)
+                df.seek(pos)
+                data = df.read(n)
+                first_chunk = pos // bpc
+                n_chunks = (len(data) + bpc - 1) // bpc
+                mf.seek(meta_header + 4 * first_chunk)
+                sums = mf.read(4 * n_chunks)
+                yield pos, data, sums
+                pos += len(data)
+                if len(data) < n:
+                    break
+
+    # ------------------------------------------------------------ inventory
+
+    def all_finalized(self) -> List[Block]:
+        with self._lock:
+            return [r.to_block() for r in self._replicas.values()
+                    if r.state == Replica.FINALIZED]
+
+    def stats(self) -> Dict[str, int]:
+        used = 0
+        with self._lock:
+            n = len(self._replicas)
+            for rep in self._replicas.values():
+                used += rep.num_bytes
+        st = os.statvfs(self.dir)
+        return {
+            "capacity": st.f_blocks * st.f_frsize,
+            "dfs_used": used,
+            "remaining": st.f_bavail * st.f_frsize,
+            "num_replicas": n,
+        }

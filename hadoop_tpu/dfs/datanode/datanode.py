@@ -1,0 +1,221 @@
+"""DataNode daemon: block store + streaming server + NN heartbeat actor.
+
+Parity with the reference (ref: server/datanode/DataNode.java (3,788 LoC;
+:1388 startDataNode, :2975 main), BPServiceActor.java:516 sendHeartBeat /
+:643 offerService): registers with the NameNode, heartbeats on an interval
+(NN commands ride the response), sends incremental "received/deleted" reports
+promptly and full block reports periodically, and executes TRANSFER /
+INVALIDATE / RECOVER commands.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import uuid as uuid_mod
+from typing import Dict, List, Optional, Tuple
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.dfs.datanode.blockstore import BlockStore
+from hadoop_tpu.dfs.datanode.xceiver import DataXceiverServer, push_block
+from hadoop_tpu.dfs.protocol.records import Block, DatanodeInfo, DnCommand
+from hadoop_tpu.ipc import Client, get_proxy
+from hadoop_tpu.service import AbstractService
+from hadoop_tpu.util.misc import Daemon
+
+log = logging.getLogger(__name__)
+
+
+class DataNodeFaultInjector:
+    """Overridable fault-injection points, compiled into the main code the
+    way the reference does it (ref: server/datanode/DataNodeFaultInjector
+    .java; call site DataXceiver.java:848). Tests install a subclass via
+    ``DataNodeFaultInjector.set(instance)``."""
+
+    _instance: "DataNodeFaultInjector" = None  # type: ignore[assignment]
+
+    @classmethod
+    def get(cls) -> "DataNodeFaultInjector":
+        if cls._instance is None:
+            cls._instance = DataNodeFaultInjector()
+        return cls._instance
+
+    @classmethod
+    def set(cls, inst: Optional["DataNodeFaultInjector"]) -> None:
+        cls._instance = inst
+
+    # ---- hooks (no-ops by default) ----
+    def before_write_block(self, block: Block) -> None: ...
+    def before_packet_write(self, block: Block, pkt: dict) -> None: ...
+    def before_read_block(self, block: Block) -> None: ...
+    def corrupt_read_packet(self, block, data, sums) -> Tuple[bytes, bytes]:
+        return data, sums
+    def before_heartbeat(self, dn: "DataNode") -> None: ...
+
+
+class DataNode(AbstractService):
+    def __init__(self, conf: Configuration, data_dir: Optional[str] = None,
+                 nn_addr: Optional[Tuple[str, int]] = None):
+        super().__init__("DataNode")
+        self.data_dir = data_dir or conf.get("dfs.datanode.data.dir",
+                                             "/tmp/htpu-data")
+        host = conf.get("dfs.datanode.hostname", "127.0.0.1")
+        self.nn_addr = nn_addr or (
+            conf.get("dfs.namenode.rpc-address", "127.0.0.1").split(":")[0],
+            int(conf.get("dfs.namenode.rpc-address", "127.0.0.1:8020")
+                .split(":")[1]))
+        self.host = host
+        self.uuid = self._load_or_create_uuid()
+        self.store: Optional[BlockStore] = None
+        self.xceiver: Optional[DataXceiverServer] = None
+        self._client: Optional[Client] = None
+        self._nn_proxy = None
+        self._stop_event = threading.Event()
+        self._ibr_lock = threading.Lock()
+        self._received: List[Block] = []
+        self._deleted: List[Block] = []
+
+    def _load_or_create_uuid(self) -> str:
+        os.makedirs(self.data_dir, exist_ok=True)
+        path = os.path.join(self.data_dir, "VERSION")
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    if line.startswith("datanodeUuid="):
+                        return line.split("=", 1)[1].strip()
+        u = str(uuid_mod.uuid4())
+        with open(path, "w") as f:
+            f.write(f"datanodeUuid={u}\n")
+        return u
+
+    @property
+    def xfer_port(self) -> int:
+        return self.xceiver.port
+
+    def datanode_info(self) -> DatanodeInfo:
+        stats = self.store.stats()
+        return DatanodeInfo(self.uuid, self.host, self.xceiver.port,
+                            capacity=stats["capacity"],
+                            dfs_used=stats["dfs_used"],
+                            remaining=stats["remaining"])
+
+    # ------------------------------------------------------------- lifecycle
+
+    def service_init(self, conf: Configuration) -> None:
+        self.store = BlockStore(os.path.join(self.data_dir, "current"))
+        self.xceiver = DataXceiverServer(
+            self.store, self._on_block_received, bind_host=self.host,
+            port=conf.get_int("dfs.datanode.port", 0),
+            fault_injector=DataNodeFaultInjector.get())
+        self.heartbeat_interval = conf.get_time_seconds(
+            "dfs.heartbeat.interval", 3.0)
+        self.block_report_interval = conf.get_time_seconds(
+            "dfs.blockreport.interval", 6 * 3600.0)
+        self._client = Client(conf)
+
+    def service_start(self) -> None:
+        self.xceiver.start()
+        self._nn_proxy = get_proxy("DatanodeProtocol", self.nn_addr,
+                                   client=self._client)
+        Daemon(self._offer_service, f"bp-actor-{self.uuid[:8]}").start()
+        log.info("DataNode %s up (xfer port %d, NN %s)", self.uuid[:8],
+                 self.xceiver.port, self.nn_addr)
+
+    def service_stop(self) -> None:
+        self._stop_event.set()
+        if self.xceiver:
+            self.xceiver.stop()
+        if self._client:
+            self._client.stop()
+
+    # ---------------------------------------------------------- NN reporting
+
+    def _on_block_received(self, block: Block) -> None:
+        with self._ibr_lock:
+            self._received.append(block)
+
+    def _offer_service(self) -> None:
+        """Main actor loop. Ref: BPServiceActor.offerService:643."""
+        registered = False
+        last_full_report = 0.0
+        import time as _time
+        while not self._stop_event.is_set():
+            try:
+                if not registered:
+                    self._nn_proxy.register_datanode(
+                        self.datanode_info().to_wire())
+                    registered = True
+                    self._send_full_report()
+                    last_full_report = _time.monotonic()
+                self._flush_incremental_reports()
+                DataNodeFaultInjector.get().before_heartbeat(self)
+                stats = self.store.stats()
+                cmds = self._nn_proxy.send_heartbeat(
+                    self.uuid, stats["capacity"], stats["dfs_used"],
+                    stats["remaining"], self.xceiver.active_xceivers)
+                for c in cmds:
+                    registered &= self._execute(DnCommand.from_wire(c))
+                if _time.monotonic() - last_full_report > \
+                        self.block_report_interval:
+                    self._send_full_report()
+                    last_full_report = _time.monotonic()
+            except Exception as e:  # noqa: BLE001 — actor must survive NN bounces
+                log.debug("heartbeat round failed (%s); will retry", e)
+                registered = False
+                # NN may have restarted on a new address (minicluster) —
+                # rebuild the proxy from the current nn_addr.
+                self._nn_proxy = get_proxy("DatanodeProtocol", self.nn_addr,
+                                           client=self._client)
+            self._stop_event.wait(self.heartbeat_interval)
+
+    def _send_full_report(self) -> None:
+        blocks = [b.to_wire() for b in self.store.all_finalized()]
+        self._nn_proxy.block_report(self.uuid, blocks)
+
+    def _flush_incremental_reports(self) -> None:
+        with self._ibr_lock:
+            received, self._received = self._received, []
+            deleted, self._deleted = self._deleted, []
+        if received or deleted:
+            self._nn_proxy.block_received_and_deleted(
+                self.uuid, [b.to_wire() for b in received],
+                [b.to_wire() for b in deleted])
+
+    # -------------------------------------------------------------- commands
+
+    def _execute(self, cmd: DnCommand) -> bool:
+        """Returns False to force re-registration."""
+        if cmd.action == DnCommand.REREGISTER:
+            return False
+        if cmd.action == DnCommand.INVALIDATE:
+            for b in cmd.blocks:
+                if self.store.invalidate(b):
+                    with self._ibr_lock:
+                        self._deleted.append(b)
+        elif cmd.action == DnCommand.TRANSFER:
+            for block, targets in zip(cmd.blocks, cmd.targets):
+                Daemon(self._transfer, "dn-transfer",
+                       args=(block, targets)).start()
+        elif cmd.action == DnCommand.RECOVER:
+            for block, new_gs in zip(cmd.blocks, cmd.new_gen_stamps):
+                try:
+                    self.store.update_gen_stamp(block.block_id, new_gs)
+                    rep = self.store.get_replica(block.block_id)
+                    if rep is not None:
+                        with self._ibr_lock:
+                            self._received.append(rep.to_block())
+                except IOError as e:
+                    log.warning("recover of %s failed: %s", block, e)
+        return True
+
+    def _transfer(self, block: Block, targets) -> None:
+        try:
+            rep = self.store.get_replica(block.block_id)
+            if rep is None:
+                log.warning("asked to transfer %s but replica not found", block)
+                return
+            push_block(self.store, rep.to_block(), targets)
+            log.info("Transferred %s to %s", block, targets)
+        except Exception as e:  # noqa: BLE001
+            log.warning("transfer of %s failed: %s", block, e)

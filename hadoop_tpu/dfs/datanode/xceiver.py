@@ -1,0 +1,264 @@
+"""Streaming data server: thread-per-transfer, pipelined writes, chunked reads.
+
+Parity with the reference's xceiver layer (ref:
+server/datanode/DataXceiverServer.java:48/:222 run, DataXceiver.java:667
+writeBlock (mirror connect at :831), BlockReceiver.java:953 receiveBlock +
+PacketResponder (:975), BlockSender.java):
+
+WRITE_BLOCK: accept op → connect downstream mirror (remaining targets) →
+ack the setup upstream → receive packets: CRC-verify, write, forward; a
+responder thread relays downstream acks upstream with this node's status
+prepended. The terminal node acks directly. Last packet (empty, last=True)
+finalizes the replica and queues an incremental block report.
+
+READ_BLOCK: stream chunk-aligned packets with their stored checksums (client
+verifies; a checksum error at the client marks the replica corrupt at the NN).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+from typing import Callable, List, Optional
+
+from hadoop_tpu.dfs.protocol import datatransfer as dt
+from hadoop_tpu.dfs.protocol.records import Block, DatanodeInfo
+from hadoop_tpu.dfs.datanode.blockstore import BlockStore
+from hadoop_tpu.metrics import metrics_system
+from hadoop_tpu.util.crc import ChecksumError, DataChecksum
+from hadoop_tpu.util.misc import Daemon
+
+log = logging.getLogger(__name__)
+
+
+class DataXceiverServer:
+    def __init__(self, store: BlockStore,
+                 on_block_received: Callable[[Block], None],
+                 bind_host: str = "127.0.0.1", port: int = 0,
+                 fault_injector=None):
+        self.store = store
+        self.on_block_received = on_block_received
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((bind_host, port))
+        self._lsock.listen(128)
+        self.port = self._lsock.getsockname()[1]
+        self._running = False
+        self.active_xceivers = 0
+        self.fault_injector = fault_injector
+        reg = metrics_system().source(f"datanode.xceiver.{self.port}")
+        self._m_writes = reg.counter("blocks_written")
+        self._m_reads = reg.counter("blocks_read")
+        self._m_bytes_in = reg.counter("bytes_written")
+        self._m_bytes_out = reg.counter("bytes_read")
+
+    def start(self) -> None:
+        self._running = True
+        Daemon(self._accept_loop, f"xceiver-server-{self.port}").start()
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                sock, addr = self._lsock.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            Daemon(self._serve, f"xceiver-{addr[1]}", args=(sock,)).start()
+
+    def _serve(self, sock: socket.socket) -> None:
+        self.active_xceivers += 1
+        try:
+            req = dt.recv_frame(sock)
+            op = req.get("op")
+            if op == dt.OP_WRITE_BLOCK:
+                self._write_block(sock, req)
+            elif op == dt.OP_READ_BLOCK:
+                self._read_block(sock, req)
+            else:
+                dt.send_frame(sock, {"ok": False, "em": f"bad op {op!r}"})
+        except (OSError, EOFError) as e:
+            log.debug("xceiver connection error: %s", e)
+        except Exception:
+            log.exception("xceiver failure")
+        finally:
+            self.active_xceivers -= 1
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # -------------------------------------------------------------- writing
+
+    def _write_block(self, up: socket.socket, req: dict) -> None:
+        """Ref: DataXceiver.writeBlock:667 + BlockReceiver.receiveBlock:953."""
+        block = Block.from_wire(req["b"])
+        targets = [DatanodeInfo.from_wire(t) for t in req.get("targets", [])]
+        checksum = DataChecksum(req.get("bpc", dt.CHUNK_SIZE))
+        if self.fault_injector is not None:
+            self.fault_injector.before_write_block(block)
+
+        down: Optional[socket.socket] = None
+        down_name = ""
+        if targets:
+            nxt, rest = targets[0], targets[1:]
+            try:
+                down = dt.connect(nxt.xfer_addr())
+                fwd = dict(req)
+                fwd["targets"] = [t.to_wire() for t in rest]
+                dt.send_frame(down, fwd)
+                setup = dt.recv_frame(down)
+                if not setup.get("ok"):
+                    raise IOError(
+                        f"downstream {nxt} setup failed: {setup.get('em')}")
+                down_name = f"{nxt.host}:{nxt.xfer_port}"
+            except (OSError, EOFError, IOError) as e:
+                # Setup failure: tell upstream which node failed so the client
+                # can exclude it (ref: writeBlock's firstBadLink reply).
+                dt.send_frame(up, {"ok": False,
+                                   "em": f"mirror {nxt} failed: {e}",
+                                   "bad_node": nxt.uuid})
+                if down is not None:
+                    down.close()
+                return
+
+        open_rep = self.store.create_rbw(block, checksum)
+        dt.send_frame(up, {"ok": True})
+
+        # Responder: relays downstream acks upstream with our status first.
+        # Terminal node acks directly. Ref: BlockReceiver.PacketResponder.
+        ack_lock = threading.Lock()
+        my_status: dict = {}
+        responder_done = threading.Event()
+
+        def responder():
+            try:
+                while True:
+                    ack = dt.recv_frame(down)
+                    with ack_lock:
+                        st = my_status.pop(ack["seq"], dt.STATUS_SUCCESS)
+                    dt.send_frame(up, {"seq": ack["seq"],
+                                       "statuses": [st] + ack["statuses"],
+                                       "last": ack.get("last", False)})
+                    if ack.get("last"):
+                        return
+            except (OSError, EOFError):
+                pass
+            finally:
+                responder_done.set()
+
+        if down is not None:
+            Daemon(responder, "packet-responder").start()
+
+        ok = True
+        try:
+            while True:
+                pkt = dt.recv_frame(up)
+                data, sums = pkt.get("data", b""), pkt.get("sums", b"")
+                status = dt.STATUS_SUCCESS
+                if data:
+                    try:
+                        checksum.verify(data, sums, base_pos=pkt.get("off", 0))
+                    except ChecksumError as e:
+                        log.warning("Checksum error on %s from upstream: %s",
+                                    block, e)
+                        status = dt.STATUS_ERROR_CHECKSUM
+                        ok = False
+                    if self.fault_injector is not None:
+                        self.fault_injector.before_packet_write(block, pkt)
+                    if status == dt.STATUS_SUCCESS:
+                        open_rep.write_packet(data, sums)
+                        self._m_bytes_in.incr(len(data))
+                if down is not None:
+                    with ack_lock:
+                        my_status[pkt["seq"]] = status
+                    dt.send_frame(down, pkt)
+                else:
+                    dt.send_frame(up, {"seq": pkt["seq"], "statuses": [status],
+                                       "last": pkt.get("last", False)})
+                if pkt.get("last"):
+                    break
+            if ok:
+                block.num_bytes = open_rep.num_bytes
+                rep = self.store.finalize(open_rep)
+                self._m_writes.incr()
+                self.on_block_received(rep.to_block())
+            else:
+                open_rep.abort()
+        except (OSError, EOFError) as e:
+            log.debug("write of %s aborted: %s", block, e)
+            open_rep.abort()
+        finally:
+            if down is not None:
+                responder_done.wait(timeout=5.0)
+                down.close()
+
+    # -------------------------------------------------------------- reading
+
+    def _read_block(self, sock: socket.socket, req: dict) -> None:
+        """Ref: BlockSender.java — chunk-aligned stream with stored sums."""
+        block = Block.from_wire(req["b"])
+        offset = req.get("offset", 0)
+        length = req.get("length", 1 << 62)
+        if self.fault_injector is not None:
+            self.fault_injector.before_read_block(block)
+        try:
+            chunks = self.store.read_chunks(block, offset, length)
+        except IOError as e:
+            dt.send_frame(sock, {"ok": False, "em": str(e)})
+            return
+        dt.send_frame(sock, {"ok": True})
+        seq = 0
+        for pos, data, sums in chunks:
+            if self.fault_injector is not None:
+                data, sums = self.fault_injector.corrupt_read_packet(
+                    block, data, sums)
+            dt.send_frame(sock, {"seq": seq, "off": pos, "data": data,
+                                 "sums": sums, "last": False})
+            self._m_bytes_out.incr(len(data))
+            seq += 1
+        dt.send_frame(sock, {"seq": seq, "off": 0, "data": b"", "sums": b"",
+                             "last": True})
+        self._m_reads.incr()
+
+
+def push_block(store: BlockStore, block: Block,
+               targets: List[DatanodeInfo]) -> None:
+    """Re-replication push: stream a local finalized replica into a pipeline
+    of targets. Ref: DataNode.DataTransfer (new Sender().writeBlock for
+    TRANSFER stage)."""
+    if not targets:
+        return
+    sock = dt.connect(targets[0].xfer_addr())
+    try:
+        dt.send_frame(sock, {
+            "op": dt.OP_WRITE_BLOCK, "b": block.to_wire(),
+            "targets": [t.to_wire() for t in targets[1:]],
+            "stage": dt.STAGE_TRANSFER, "bpc": dt.CHUNK_SIZE,
+        })
+        setup = dt.recv_frame(sock)
+        if not setup.get("ok"):
+            raise IOError(f"transfer setup failed: {setup.get('em')}")
+        seq = 0
+        for pos, data, sums in store.read_chunks(block, 0, block.num_bytes):
+            dt.send_frame(sock, {"seq": seq, "off": pos, "data": data,
+                                 "sums": sums, "last": False})
+            seq += 1
+        dt.send_frame(sock, {"seq": seq, "off": 0, "data": b"", "sums": b"",
+                             "last": True})
+        # Drain acks until last.
+        while True:
+            ack = dt.recv_frame(sock)
+            if any(s != dt.STATUS_SUCCESS for s in ack["statuses"]):
+                raise IOError(f"transfer ack failure: {ack}")
+            if ack.get("last"):
+                break
+    finally:
+        sock.close()

@@ -1,0 +1,3 @@
+from hadoop_tpu.dfs.namenode.namenode import NameNode
+
+__all__ = ["NameNode"]

@@ -1,0 +1,548 @@
+"""Block → replica map, datanode liveness, replication scheduling, safemode.
+
+Parity with the reference's block management layer (ref:
+server/blockmanagement/BlockManager.java (5,459 LoC; :2731 processReport),
+DatanodeManager.java (2,052; :1673 handleHeartbeat), HeartbeatManager.java:46,
+DatanodeAdminManager.java:78, BlockPlacementPolicyDefault.java):
+
+- ``DatanodeDescriptor`` — server-side view of one block server: stored
+  blocks, pending invalidation queue, pending transfer (re-replication) work.
+- ``DatanodeManager`` — registration, heartbeats, dead-node sweep,
+  decommissioning drains.
+- ``BlockManager`` — blocks map keyed by id with expected replication and the
+  owning file; full/incremental report processing; under-replication priority
+  queues worked off by the RedundancyMonitor; excess-replica pruning; corrupt
+  replica tracking; safemode (block threshold + auto-exit).
+
+Replica placement is load-balanced-random over live nodes (the topology seam
+exists — ``NetworkTopology`` racks — but one TPU-VM pod is one rack; the
+reference's rack spread policy degenerates to spread-over-hosts there).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.dfs.protocol.records import (Block, DatanodeInfo, DnCommand,
+                                             LocatedBlock)
+from hadoop_tpu.metrics import metrics_system
+from hadoop_tpu.util.misc import Daemon
+
+log = logging.getLogger(__name__)
+
+
+class DatanodeDescriptor(DatanodeInfo):
+    """NN-side state for one registered datanode.
+    Ref: blockmanagement/DatanodeDescriptor.java."""
+
+    __slots__ = ("blocks", "invalidate_queue", "transfer_queue",
+                 "recover_queue", "xceiver_count")
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.blocks: Set[int] = set()
+        self.invalidate_queue: List[Block] = []
+        self.transfer_queue: List[Tuple[Block, List[DatanodeInfo]]] = []
+        self.recover_queue: List[Tuple[Block, int]] = []
+        self.xceiver_count = 0
+
+    def public_info(self) -> DatanodeInfo:
+        info = DatanodeInfo(self.uuid, self.host, self.xfer_port,
+                            self.ipc_port, self.capacity, self.dfs_used,
+                            self.remaining)
+        info.state = self.state
+        info.num_blocks = len(self.blocks)
+        return info
+
+
+class BlockInfo:
+    """Ref: blockmanagement/BlockInfo.java — block + owning file + replicas."""
+
+    __slots__ = ("block", "inode", "expected_replication", "locations",
+                 "corrupt_replicas", "under_construction")
+
+    def __init__(self, block: Block, inode, expected_replication: int):
+        self.block = block
+        self.inode = inode  # INodeFile back-reference (BlockCollection)
+        self.expected_replication = expected_replication
+        self.locations: Set[str] = set()       # datanode uuids
+        self.corrupt_replicas: Set[str] = set()
+        self.under_construction = True
+
+    def live_replicas(self) -> int:
+        return len(self.locations - self.corrupt_replicas)
+
+
+class DatanodeManager:
+    """Ref: blockmanagement/DatanodeManager.java."""
+
+    def __init__(self, conf: Configuration, block_manager: "BlockManager"):
+        self.conf = conf
+        self.bm = block_manager
+        self.heartbeat_interval_s = conf.get_time_seconds(
+            "dfs.heartbeat.interval", 3.0)
+        # Ref formula: 2 * recheck + 10 * heartbeat
+        self.dead_interval_s = conf.get_time_seconds(
+            "dfs.namenode.heartbeat.recheck-interval", 10.0) * 2 \
+            + 10 * self.heartbeat_interval_s
+        self._nodes: Dict[str, DatanodeDescriptor] = {}
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------- registration
+
+    def register(self, info: DatanodeInfo) -> DatanodeDescriptor:
+        with self._lock:
+            node = self._nodes.get(info.uuid)
+            if node is None:
+                node = DatanodeDescriptor(info.uuid, info.host,
+                                          info.xfer_port, info.ipc_port)
+                self._nodes[info.uuid] = node
+                log.info("Registered datanode %s", node)
+            node.host = info.host
+            node.xfer_port = info.xfer_port
+            node.ipc_port = info.ipc_port
+            node.state = DatanodeInfo.STATE_LIVE
+            node.last_heartbeat = time.monotonic()
+            return node
+
+    def get(self, uuid: str) -> Optional[DatanodeDescriptor]:
+        with self._lock:
+            return self._nodes.get(uuid)
+
+    def handle_heartbeat(self, uuid: str, capacity: int, dfs_used: int,
+                         remaining: int, xceivers: int) -> List[DnCommand]:
+        """Ref: DatanodeManager.handleHeartbeat:1673 — refresh stats, hand the
+        node its queued work as commands."""
+        with self._lock:
+            node = self._nodes.get(uuid)
+            if node is None:
+                return [DnCommand(DnCommand.REREGISTER)]
+            node.last_heartbeat = time.monotonic()
+            if node.state == DatanodeInfo.STATE_DEAD:
+                node.state = DatanodeInfo.STATE_LIVE
+            node.capacity = capacity
+            node.dfs_used = dfs_used
+            node.remaining = remaining
+            node.xceiver_count = xceivers
+            cmds: List[DnCommand] = []
+            if node.invalidate_queue:
+                cmds.append(DnCommand(DnCommand.INVALIDATE,
+                                      blocks=node.invalidate_queue[:100]))
+                del node.invalidate_queue[:100]
+            if node.transfer_queue:
+                work = node.transfer_queue[:10]
+                del node.transfer_queue[:10]
+                cmds.append(DnCommand(
+                    DnCommand.TRANSFER,
+                    blocks=[b for b, _ in work],
+                    targets=[t for _, t in work]))
+            if node.recover_queue:
+                work = node.recover_queue[:10]
+                del node.recover_queue[:10]
+                cmds.append(DnCommand(
+                    DnCommand.RECOVER,
+                    blocks=[b for b, _ in work],
+                    new_gen_stamps=[gs for _, gs in work]))
+            return cmds
+
+    # ------------------------------------------------------------- liveness
+
+    def check_dead_nodes(self) -> List[DatanodeDescriptor]:
+        """Mark nodes past the dead interval; returns newly-dead nodes.
+        Ref: HeartbeatManager.heartbeatCheck."""
+        now = time.monotonic()
+        newly_dead = []
+        with self._lock:
+            for node in self._nodes.values():
+                if (node.state == DatanodeInfo.STATE_LIVE
+                        and now - node.last_heartbeat > self.dead_interval_s):
+                    node.state = DatanodeInfo.STATE_DEAD
+                    newly_dead.append(node)
+        for node in newly_dead:
+            log.warning("Datanode %s declared dead (no heartbeat for %.1fs)",
+                        node, self.dead_interval_s)
+        return newly_dead
+
+    def live_nodes(self) -> List[DatanodeDescriptor]:
+        with self._lock:
+            return [n for n in self._nodes.values()
+                    if n.state == DatanodeInfo.STATE_LIVE]
+
+    def all_nodes(self) -> List[DatanodeDescriptor]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    def start_decommission(self, uuid: str) -> None:
+        """Ref: DatanodeAdminManager.startDecommission:78."""
+        with self._lock:
+            node = self._nodes.get(uuid)
+        if node is not None and node.state == DatanodeInfo.STATE_LIVE:
+            node.state = DatanodeInfo.STATE_DECOMMISSIONING
+            log.info("Starting decommission of %s", node)
+            self.bm.schedule_drain(node)
+
+    # ------------------------------------------------------------ placement
+
+    def choose_targets(self, n: int, exclude: Set[str],
+                       writer_host: Optional[str] = None
+                       ) -> List[DatanodeDescriptor]:
+        """Pick n distinct live targets, local-writer-first then
+        load-weighted random. Ref: BlockPlacementPolicyDefault.chooseTarget."""
+        with self._lock:
+            candidates = [node for node in self._nodes.values()
+                          if node.state == DatanodeInfo.STATE_LIVE
+                          and node.uuid not in exclude]
+        if not candidates:
+            return []
+        chosen: List[DatanodeDescriptor] = []
+        # First replica on the writer's host when possible (short-circuit win).
+        if writer_host is not None:
+            local = [c for c in candidates if c.host == writer_host]
+            if local:
+                pick = min(local, key=lambda c: c.xceiver_count)
+                chosen.append(pick)
+                candidates.remove(pick)
+        while candidates and len(chosen) < n:
+            # Load-spread: sample 2, keep the less-loaded (power of two choices).
+            a = random.choice(candidates)
+            b = random.choice(candidates)
+            pick = a if a.xceiver_count <= b.xceiver_count else b
+            chosen.append(pick)
+            candidates.remove(pick)
+        return chosen
+
+
+class BlockManager:
+    """Ref: blockmanagement/BlockManager.java."""
+
+    def __init__(self, conf: Configuration):
+        self.conf = conf
+        self.min_replication = conf.get_int("dfs.namenode.replication.min", 1)
+        self.max_replication = conf.get_int("dfs.replication.max", 512)
+        self.dn_manager = DatanodeManager(conf, self)
+        self._blocks: Dict[int, BlockInfo] = {}
+        self._lock = threading.RLock()
+        # Under-replication priority queues (ref: LowRedundancyBlocks.java):
+        # 0 = highest risk (1 replica), 1 = under-replicated, 2 = queued drains.
+        self._needed: List[Set[int]] = [set(), set(), set()]
+        self._pending_reconstruction: Dict[int, float] = {}  # id → deadline
+        self.safemode = SafeMode(self, conf)
+        reg = metrics_system().source("namenode.blocks")
+        reg.register_callback_gauge("blocks_total", lambda: len(self._blocks))
+        reg.register_callback_gauge(
+            "under_replicated", lambda: sum(len(q) for q in self._needed[:2]))
+        reg.register_callback_gauge(
+            "pending_reconstruction", lambda: len(self._pending_reconstruction))
+        self._m_reconstructions = reg.counter("reconstructions_scheduled")
+
+    # ----------------------------------------------------------- block index
+
+    def add_block_collection(self, block: Block, inode,
+                             replication: int) -> BlockInfo:
+        with self._lock:
+            info = BlockInfo(block, inode, replication)
+            self._blocks[block.block_id] = info
+            return info
+
+    def get(self, block_id: int) -> Optional[BlockInfo]:
+        with self._lock:
+            return self._blocks.get(block_id)
+
+    def remove_block(self, block: Block) -> None:
+        """File deleted: forget the block, queue replica invalidation.
+        Ref: BlockManager.removeBlock."""
+        with self._lock:
+            info = self._blocks.pop(block.block_id, None)
+            for q in self._needed:
+                q.discard(block.block_id)
+            self._pending_reconstruction.pop(block.block_id, None)
+        if info is None:
+            return
+        for uuid in info.locations:
+            node = self.dn_manager.get(uuid)
+            if node is not None:
+                node.invalidate_queue.append(info.block)
+                node.blocks.discard(block.block_id)
+
+    def num_blocks(self) -> int:
+        with self._lock:
+            return len(self._blocks)
+
+    # -------------------------------------------------------------- reports
+
+    def process_report(self, uuid: str, blocks: List[Block]) -> None:
+        """Full block report: reconcile the DN's replica set with ours.
+        Ref: BlockManager.processReport:2731."""
+        node = self.dn_manager.get(uuid)
+        if node is None:
+            return
+        reported = {b.block_id: b for b in blocks}
+        with self._lock:
+            gone = node.blocks - set(reported)
+            for bid in gone:
+                self._remove_stored_block_locked(bid, node)
+            for bid, blk in reported.items():
+                self._add_stored_block_locked(blk, node)
+        self.safemode.report_blocks()
+
+    def add_stored_block(self, block: Block, uuid: str) -> None:
+        """Incremental 'block received' report.
+        Ref: BlockManager.addStoredBlock."""
+        node = self.dn_manager.get(uuid)
+        if node is None:
+            return
+        with self._lock:
+            self._add_stored_block_locked(block, node)
+        self.safemode.report_blocks()
+
+    def remove_stored_block(self, block: Block, uuid: str) -> None:
+        node = self.dn_manager.get(uuid)
+        if node is None:
+            return
+        with self._lock:
+            self._remove_stored_block_locked(block.block_id, node)
+
+    def _add_stored_block_locked(self, block: Block,
+                                 node: DatanodeDescriptor) -> None:
+        info = self._blocks.get(block.block_id)
+        if info is None:
+            # Replica of a deleted/unknown block → invalidate at the DN.
+            node.invalidate_queue.append(block)
+            return
+        if block.gen_stamp < info.block.gen_stamp:
+            # Stale replica from a failed pipeline — corrupt by definition.
+            info.corrupt_replicas.add(node.uuid)
+            node.invalidate_queue.append(block)
+            return
+        info.locations.add(node.uuid)
+        info.corrupt_replicas.discard(node.uuid)
+        node.blocks.add(block.block_id)
+        if block.num_bytes > info.block.num_bytes:
+            info.block.num_bytes = block.num_bytes
+        self._pending_reconstruction.pop(block.block_id, None)
+        self._update_needed_locked(info)
+
+    def _remove_stored_block_locked(self, block_id: int,
+                                    node: DatanodeDescriptor) -> None:
+        info = self._blocks.get(block_id)
+        node.blocks.discard(block_id)
+        if info is None:
+            return
+        info.locations.discard(node.uuid)
+        info.corrupt_replicas.discard(node.uuid)
+        self._update_needed_locked(info)
+
+    def mark_corrupt(self, block: Block, uuid: str) -> None:
+        """Client/scanner found a bad replica. Ref: BlockManager
+        .findAndMarkBlockAsCorrupt."""
+        node = self.dn_manager.get(uuid)
+        with self._lock:
+            info = self._blocks.get(block.block_id)
+            if info is None or node is None:
+                return
+            info.corrupt_replicas.add(uuid)
+            # Only invalidate once a healthy replica can replace it.
+            if info.live_replicas() > 0:
+                node.invalidate_queue.append(info.block)
+                info.locations.discard(uuid)
+                node.blocks.discard(block.block_id)
+            self._update_needed_locked(info)
+
+    # ----------------------------------------------------- replication queue
+
+    def _update_needed_locked(self, info: BlockInfo) -> None:
+        live = info.live_replicas()
+        bid = info.block.block_id
+        for q in self._needed:
+            q.discard(bid)
+        if info.under_construction:
+            return
+        if live < info.expected_replication:
+            if bid in self._pending_reconstruction:
+                return
+            if live <= 1:
+                self._needed[0].add(bid)
+            else:
+                self._needed[1].add(bid)
+        elif live > info.expected_replication:
+            self._process_excess_locked(info)
+
+    def _process_excess_locked(self, info: BlockInfo) -> None:
+        """Drop excess replicas, most-loaded node first.
+        Ref: BlockManager.processExtraRedundancyBlock."""
+        excess = info.live_replicas() - info.expected_replication
+        if excess <= 0:
+            return
+        nodes = [self.dn_manager.get(u)
+                 for u in (info.locations - info.corrupt_replicas)]
+        nodes = [n for n in nodes if n is not None
+                 and n.state == DatanodeInfo.STATE_LIVE]
+        nodes.sort(key=lambda n: -len(n.blocks))
+        for node in nodes[:excess]:
+            node.invalidate_queue.append(info.block)
+            info.locations.discard(node.uuid)
+            node.blocks.discard(info.block.block_id)
+
+    def schedule_drain(self, node: DatanodeDescriptor) -> None:
+        """Queue every block on a decommissioning node for re-replication."""
+        with self._lock:
+            for bid in list(node.blocks):
+                info = self._blocks.get(bid)
+                if info is not None and not info.under_construction:
+                    self._needed[2].add(bid)
+
+    def compute_reconstruction_work(self, max_work: int = 64) -> int:
+        """RedundancyMonitor pass: assign transfer work to source DNs.
+        Ref: BlockManager.computeBlockReconstructionWork."""
+        now = time.monotonic()
+        scheduled = 0
+        with self._lock:
+            # Expire pending reconstructions that never completed.
+            for bid, deadline in list(self._pending_reconstruction.items()):
+                if deadline < now:
+                    del self._pending_reconstruction[bid]
+                    info = self._blocks.get(bid)
+                    if info is not None:
+                        self._update_needed_locked(info)
+            for q in self._needed:
+                for bid in list(q):
+                    if scheduled >= max_work:
+                        return scheduled
+                    info = self._blocks.get(bid)
+                    if info is None:
+                        q.discard(bid)
+                        continue
+                    if self._schedule_one_locked(info):
+                        q.discard(bid)
+                        scheduled += 1
+        return scheduled
+
+    def _schedule_one_locked(self, info: BlockInfo) -> bool:
+        live_uuids = info.locations - info.corrupt_replicas
+        sources = [self.dn_manager.get(u) for u in live_uuids]
+        sources = [s for s in sources if s is not None and s.state in
+                   (DatanodeInfo.STATE_LIVE, DatanodeInfo.STATE_DECOMMISSIONING)]
+        if not sources:
+            return False  # unrecoverable for now (all replicas lost)
+        # Decommission drains count live-elsewhere replicas as deficits too.
+        deficit = info.expected_replication - len(
+            [s for s in sources if s.state == DatanodeInfo.STATE_LIVE])
+        if deficit <= 0:
+            return True  # nothing to do (e.g. replicas recovered meanwhile)
+        targets = self.dn_manager.choose_targets(
+            deficit, exclude=set(info.locations))
+        if not targets:
+            return False
+        src = min(sources, key=lambda s: len(s.transfer_queue))
+        src.transfer_queue.append(
+            (info.block, [t.public_info() for t in targets]))
+        self._pending_reconstruction[info.block.block_id] = (
+            time.monotonic() + 30.0)
+        self._m_reconstructions.incr()
+        return True
+
+    def node_died(self, node: DatanodeDescriptor) -> None:
+        """All replicas on a dead node are gone; requeue its blocks."""
+        with self._lock:
+            for bid in list(node.blocks):
+                self._remove_stored_block_locked(bid, node)
+
+    # --------------------------------------------------------------- queries
+
+    def located_block(self, block: Block, offset: int) -> LocatedBlock:
+        with self._lock:
+            info = self._blocks.get(block.block_id)
+            if info is None:
+                return LocatedBlock(block, [], offset)
+            locs = []
+            for uuid in info.locations - info.corrupt_replicas:
+                node = self.dn_manager.get(uuid)
+                if node is not None and node.state != DatanodeInfo.STATE_DEAD:
+                    locs.append(node.public_info())
+            random.shuffle(locs)  # spread read load
+            return LocatedBlock(info.block, locs, offset,
+                                corrupt=(not locs and bool(info.locations)))
+
+    def complete_block(self, block: Block) -> None:
+        with self._lock:
+            info = self._blocks.get(block.block_id)
+            if info is not None:
+                info.under_construction = False
+                info.block.num_bytes = block.num_bytes
+                self._update_needed_locked(info)
+
+    def under_replicated_count(self) -> int:
+        with self._lock:
+            return sum(len(q) for q in self._needed[:2])
+
+
+class SafeMode:
+    """Startup safemode: reject mutations until enough blocks are reported.
+    Ref: blockmanagement/BlockManagerSafeMode.java."""
+
+    def __init__(self, bm: BlockManager, conf: Configuration):
+        self.bm = bm
+        self.threshold = conf.get_float(
+            "dfs.namenode.safemode.threshold-pct", 0.999)
+        self.extension_s = conf.get_time_seconds(
+            "dfs.namenode.safemode.extension", 0.0)
+        self._on = True
+        self._manual = False
+        self._block_total = 0
+        self._reached_at: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def set_block_total(self, total: int) -> None:
+        with self._lock:
+            self._block_total = total
+        self.report_blocks()
+
+    def is_on(self) -> bool:
+        return self._on
+
+    def enter_manual(self) -> None:
+        with self._lock:
+            self._on = True
+            self._manual = True
+
+    def leave(self, force: bool = False) -> None:
+        with self._lock:
+            self._on = False
+            self._manual = False
+        log.info("Safemode is OFF%s", " (forced)" if force else "")
+
+    def _blocks_safe(self) -> int:
+        count = 0
+        with self.bm._lock:
+            for info in self.bm._blocks.values():
+                if info.under_construction or \
+                        info.live_replicas() >= self.bm.min_replication:
+                    count += 1
+        return count
+
+    def report_blocks(self) -> None:
+        if not self._on or self._manual:
+            return
+        import math
+        with self._lock:
+            needed = math.ceil(self.threshold * self._block_total)
+            if self._blocks_safe() >= needed:
+                if self._reached_at is None:
+                    self._reached_at = time.monotonic()
+                if time.monotonic() - self._reached_at >= self.extension_s:
+                    self._on = False
+                    log.info("Safemode is OFF (threshold reached)")
+            else:
+                self._reached_at = None
+
+    def status(self) -> Dict:
+        with self._lock:
+            return {"on": self._on, "manual": self._manual,
+                    "block_total": self._block_total,
+                    "blocks_safe": self._blocks_safe() if self._on else None,
+                    "threshold": self.threshold}

@@ -1,0 +1,241 @@
+"""In-memory namespace: inode tree + directory operations.
+
+Parity with the reference namespace core (ref: server/namenode/INode.java,
+INodeFile.java, INodeDirectory.java, FSDirectory.java (2,077 LoC)): a rooted
+tree of directories and files, files holding ordered block lists, with
+owner/permission/times metadata. All mutations happen under the namesystem
+lock (see fsnamesystem.py) — this module is lock-free by contract.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterator, List, Optional
+
+from hadoop_tpu.dfs.protocol.records import Block, FileStatus
+
+
+class INode:
+    __slots__ = ("name", "parent", "mtime", "atime", "owner", "group",
+                 "permission")
+
+    def __init__(self, name: str, owner: str = "", group: str = "",
+                 permission: int = 0o755):
+        self.name = name
+        self.parent: Optional["INodeDirectory"] = None
+        self.mtime = time.time()
+        self.atime = self.mtime
+        self.owner = owner
+        self.group = group
+        self.permission = permission
+
+    @property
+    def is_dir(self) -> bool:
+        return isinstance(self, INodeDirectory)
+
+    def full_path(self) -> str:
+        parts: List[str] = []
+        node: Optional[INode] = self
+        while node is not None and node.parent is not None:
+            parts.append(node.name)
+            node = node.parent
+        return "/" + "/".join(reversed(parts))
+
+
+class INodeFile(INode):
+    __slots__ = ("replication", "block_size", "blocks", "under_construction",
+                 "client_name")
+
+    def __init__(self, name: str, replication: int, block_size: int,
+                 owner: str = "", permission: int = 0o644):
+        super().__init__(name, owner=owner, permission=permission)
+        self.replication = replication
+        self.block_size = block_size
+        self.blocks: List[Block] = []
+        self.under_construction = False
+        self.client_name: Optional[str] = None  # lease holder while open
+
+    def length(self) -> int:
+        return sum(b.num_bytes for b in self.blocks)
+
+    def last_block(self) -> Optional[Block]:
+        return self.blocks[-1] if self.blocks else None
+
+    def status(self, path: Optional[str] = None) -> FileStatus:
+        return FileStatus(path if path is not None else self.full_path(),
+                          False, self.length(), self.replication,
+                          self.block_size, self.mtime, self.atime,
+                          self.owner, self.group, self.permission)
+
+
+class INodeDirectory(INode):
+    __slots__ = ("children",)
+
+    def __init__(self, name: str, owner: str = "", permission: int = 0o755):
+        super().__init__(name, owner=owner, permission=permission)
+        self.children: Dict[str, INode] = {}
+
+    def add_child(self, node: INode) -> None:
+        node.parent = self
+        self.children[node.name] = node
+        self.mtime = time.time()
+
+    def remove_child(self, name: str) -> Optional[INode]:
+        node = self.children.pop(name, None)
+        if node is not None:
+            node.parent = None
+            self.mtime = time.time()
+        return node
+
+    def get_child(self, name: str) -> Optional[INode]:
+        return self.children.get(name)
+
+    def status(self, path: Optional[str] = None) -> FileStatus:
+        return FileStatus(path if path is not None else self.full_path(),
+                          True, 0, 0, 0, self.mtime, self.atime, self.owner,
+                          self.group, self.permission)
+
+
+def _components(path: str) -> List[str]:
+    if not path.startswith("/"):
+        raise ValueError(f"path must be absolute: {path!r}")
+    return [c for c in path.split("/") if c]
+
+
+class FSDirectory:
+    """Path-indexed view over the inode tree. Ref: FSDirectory.java."""
+
+    def __init__(self):
+        self.root = INodeDirectory("")
+        self._inode_count = 1
+
+    # ------------------------------------------------------------- resolve
+
+    def get_inode(self, path: str) -> Optional[INode]:
+        node: INode = self.root
+        for comp in _components(path):
+            if not isinstance(node, INodeDirectory):
+                return None
+            nxt = node.get_child(comp)
+            if nxt is None:
+                return None
+            node = nxt
+        return node
+
+    def get_parent(self, path: str) -> Optional[INodeDirectory]:
+        comps = _components(path)
+        if not comps:
+            return None
+        node: INode = self.root
+        for comp in comps[:-1]:
+            if not isinstance(node, INodeDirectory):
+                return None
+            nxt = node.get_child(comp)
+            if nxt is None:
+                return None
+            node = nxt
+        return node if isinstance(node, INodeDirectory) else None
+
+    def exists(self, path: str) -> bool:
+        return self.get_inode(path) is not None
+
+    # ------------------------------------------------------------ mutations
+
+    def mkdirs(self, path: str, owner: str = "",
+               permission: int = 0o755) -> INodeDirectory:
+        """Create all missing path components. Ref: FSDirectory.mkdirs."""
+        node: INode = self.root
+        for comp in _components(path):
+            if not isinstance(node, INodeDirectory):
+                raise NotADirectoryError(
+                    f"{node.full_path()} is a file in path {path}")
+            nxt = node.get_child(comp)
+            if nxt is None:
+                nxt = INodeDirectory(comp, owner=owner, permission=permission)
+                node.add_child(nxt)
+                self._inode_count += 1
+            node = nxt
+        if not isinstance(node, INodeDirectory):
+            raise NotADirectoryError(f"{path} exists as a file")
+        return node
+
+    def add_file(self, path: str, replication: int, block_size: int,
+                 owner: str = "", permission: int = 0o644) -> INodeFile:
+        comps = _components(path)
+        if not comps:
+            raise IsADirectoryError("cannot create file at /")
+        parent = self.mkdirs("/" + "/".join(comps[:-1]), owner=owner)
+        if parent.get_child(comps[-1]) is not None:
+            raise FileExistsError(f"{path} already exists")
+        f = INodeFile(comps[-1], replication, block_size, owner=owner,
+                      permission=permission)
+        parent.add_child(f)
+        self._inode_count += 1
+        return f
+
+    def delete(self, path: str, recursive: bool) -> Optional[INode]:
+        """Detach the subtree at path; caller collects its blocks.
+        Ref: FSDirectory.delete."""
+        node = self.get_inode(path)
+        if node is None:
+            return None
+        if node is self.root:
+            raise PermissionError("cannot delete /")
+        if isinstance(node, INodeDirectory) and node.children and not recursive:
+            raise OSError(f"{path} is non-empty; use recursive delete")
+        node.parent.remove_child(node.name)
+        self._inode_count -= sum(1 for _ in iter_tree(node))
+        return node
+
+    def rename(self, src: str, dst: str) -> None:
+        """POSIX-ish rename. Ref: FSDirectory.renameTo (RENAME semantics:
+        fail if dst exists; moving into an existing dir targets dst/basename)."""
+        node = self.get_inode(src)
+        if node is None:
+            raise FileNotFoundError(f"rename source {src} not found")
+        if node is self.root:
+            raise PermissionError("cannot rename /")
+        dst_node = self.get_inode(dst)
+        if isinstance(dst_node, INodeDirectory):
+            dst = dst.rstrip("/") + "/" + node.name
+            dst_node = self.get_inode(dst)
+        if dst_node is not None:
+            raise FileExistsError(f"rename target {dst} exists")
+        if dst.startswith(src.rstrip("/") + "/"):
+            raise ValueError(f"cannot rename {src} under itself: {dst}")
+        dst_parent = self.get_parent(dst)
+        if dst_parent is None:
+            raise FileNotFoundError(f"rename target parent missing: {dst}")
+        node.parent.remove_child(node.name)
+        node.name = _components(dst)[-1]
+        dst_parent.add_child(node)
+
+    # ------------------------------------------------------------- queries
+
+    def listing(self, path: str) -> List[FileStatus]:
+        node = self.get_inode(path)
+        if node is None:
+            raise FileNotFoundError(path)
+        base = path.rstrip("/")
+        if isinstance(node, INodeDirectory):
+            return [child.status(f"{base}/{name}" if base else f"/{name}")
+                    for name, child in sorted(node.children.items())]
+        return [node.status(path)]
+
+    def num_inodes(self) -> int:
+        return self._inode_count
+
+
+def iter_tree(node: INode) -> Iterator[INode]:
+    yield node
+    if isinstance(node, INodeDirectory):
+        for child in list(node.children.values()):
+            yield from iter_tree(child)
+
+
+def collect_blocks(node: INode) -> List[Block]:
+    out: List[Block] = []
+    for n in iter_tree(node):
+        if isinstance(n, INodeFile):
+            out.extend(n.blocks)
+    return out

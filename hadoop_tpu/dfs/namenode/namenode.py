@@ -1,0 +1,308 @@
+"""NameNode daemon: RPC surface + background monitors.
+
+Parity with the reference (ref: server/namenode/NameNode.java:722 initialize,
+:1701 createNameNode, :1821 main; NameNodeRpcServer.java (2,659 LoC; :781
+create)): hosts two RPC protocols on one server —
+
+- ``ClientProtocol`` — namespace + block allocation ops for DFS clients
+  (ref: hdfs/protocol/ClientProtocol.java)
+- ``DatanodeProtocol`` — registration, heartbeats (commands ride the
+  response), full + incremental block reports
+  (ref: server/protocol/DatanodeProtocol.java, BPServiceActor's view)
+
+Background: RedundancyMonitor (re-replication work + dead-node sweep,
+ref: BlockManager.RedundancyMonitor), lease monitor (ref: LeaseManager
+.Monitor), checkpointer (ref: StandbyCheckpointer.java:194 — here a periodic
+local checkpoint; the HA standby variant arrives with qjournal/HA).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, List, Optional
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.dfs.namenode.fsnamesystem import FSNamesystem
+from hadoop_tpu.dfs.protocol.records import Block, DatanodeInfo
+from hadoop_tpu.ipc import RetryCache, Server, current_call, idempotent
+from hadoop_tpu.ipc.server import CallContext
+from hadoop_tpu.service import AbstractService
+from hadoop_tpu.util.misc import Daemon
+
+log = logging.getLogger(__name__)
+
+
+class ClientProtocol:
+    """RPC facade over FSNamesystem. Ref: NameNodeRpcServer.java — the thin
+    translation layer; at-most-once mutations go through the retry cache."""
+
+    def __init__(self, fsn: FSNamesystem, retry_cache: RetryCache):
+        self.fsn = fsn
+        self.retry_cache = retry_cache
+
+    def _cached(self, fn, *args):
+        """Retry-cache wrapper for non-idempotent mutations.
+        Ref: FSNamesystem's RetryCache.waitForCompletion call sites."""
+        ctx = current_call()
+        if ctx is None or not ctx.client_id:
+            return fn(*args)
+        entry = self.retry_cache.wait_for_completion(ctx.client_id, ctx.call_id)
+        if entry.done:
+            return entry.payload
+        try:
+            result = fn(*args)
+        except BaseException:
+            self.retry_cache.complete(entry, False)
+            raise
+        self.retry_cache.complete(entry, True, result)
+        return result
+
+    # namespace ------------------------------------------------------------
+
+    def create(self, path: str, client_name: str, replication=None,
+               block_size=None, overwrite: bool = False):
+        return self._cached(
+            lambda: self.fsn.create(path, client_name, replication,
+                                    block_size, overwrite).to_wire())
+
+    def add_block(self, path: str, client_name: str, previous=None,
+                  exclude: Optional[List[str]] = None):
+        ctx = current_call()
+        writer_host = ctx.address.rsplit(":", 1)[0] if ctx else None
+        return self.fsn.add_block(path, client_name, previous,
+                                  exclude or [], writer_host).to_wire()
+
+    def abandon_block(self, path: str, client_name: str, block: Dict):
+        self.fsn.abandon_block(path, client_name, block)
+        return True
+
+    def complete(self, path: str, client_name: str, last=None) -> bool:
+        return self.fsn.complete(path, client_name, last)
+
+    def update_pipeline(self, client_name: str, path: str, old_block: Dict,
+                        new_gs: int, new_len: int):
+        self.fsn.update_pipeline(client_name, path, old_block, new_gs, new_len)
+        return True
+
+    @idempotent
+    def get_block_locations(self, path: str, offset: int = 0,
+                            length: int = 1 << 62):
+        return self.fsn.get_block_locations(path, offset, length)
+
+    @idempotent
+    def get_file_info(self, path: str):
+        return self.fsn.get_file_info(path)
+
+    @idempotent
+    def listing(self, path: str):
+        return self.fsn.listing(path)
+
+    @idempotent
+    def content_summary(self, path: str):
+        return self.fsn.content_summary(path)
+
+    def mkdirs(self, path: str) -> bool:
+        return self._cached(lambda: self.fsn.mkdirs(path))
+
+    def delete(self, path: str, recursive: bool = False) -> bool:
+        return self._cached(lambda: self.fsn.delete(path, recursive))
+
+    def rename(self, src: str, dst: str) -> bool:
+        return self._cached(lambda: self.fsn.rename(src, dst))
+
+    def set_replication(self, path: str, replication: int) -> bool:
+        return self.fsn.set_replication(path, replication)
+
+    def set_times(self, path: str, mtime: float, atime: float):
+        self.fsn.set_times(path, mtime, atime)
+        return True
+
+    def set_permission(self, path: str, permission: int):
+        self.fsn.set_permission(path, permission)
+        return True
+
+    def set_owner(self, path: str, owner: str, group: str):
+        self.fsn.set_owner(path, owner, group)
+        return True
+
+    @idempotent
+    def renew_lease(self, client_name: str):
+        self.fsn.renew_lease(client_name)
+        return True
+
+    def recover_lease(self, path: str, new_holder: str) -> bool:
+        return self.fsn.recover_lease(path, new_holder)
+
+    # admin ----------------------------------------------------------------
+
+    @idempotent
+    def get_datanode_report(self, state: str = "all"):
+        nodes = self.fsn.bm.dn_manager.all_nodes()
+        if state == "live":
+            nodes = [n for n in nodes if n.state == DatanodeInfo.STATE_LIVE]
+        elif state == "dead":
+            nodes = [n for n in nodes if n.state == DatanodeInfo.STATE_DEAD]
+        return [n.public_info().to_wire() for n in nodes]
+
+    @idempotent
+    def get_stats(self):
+        fsn = self.fsn
+        return {
+            "files": fsn.fsdir.num_inodes(),
+            "blocks": fsn.bm.num_blocks(),
+            "under_replicated": fsn.bm.under_replicated_count(),
+            "live_datanodes": len(fsn.bm.dn_manager.live_nodes()),
+            "safemode": fsn.bm.safemode.is_on(),
+            "leases": fsn.leases.num_leases(),
+            "txid": fsn.editlog.last_txid,
+        }
+
+    def set_safemode(self, action: str) -> bool:
+        """action: enter | leave | get. Ref: DFSAdmin -safemode."""
+        sm = self.fsn.bm.safemode
+        if action == "enter":
+            sm.enter_manual()
+        elif action == "leave":
+            sm.leave(force=True)
+        return sm.is_on()
+
+    def save_namespace(self) -> str:
+        return self.fsn.save_namespace()
+
+    def decommission_datanode(self, uuid: str) -> bool:
+        self.fsn.bm.dn_manager.start_decommission(uuid)
+        return True
+
+    def report_bad_blocks(self, blocks: List[Dict], uuids: List[str]):
+        """Client-detected corrupt replicas. Ref: ClientProtocol
+        .reportBadBlocks."""
+        for b, uuid in zip(blocks, uuids):
+            self.fsn.bm.mark_corrupt(Block.from_wire(b), uuid)
+        return True
+
+    @idempotent
+    def get_service_status(self):
+        return {"state": "active", "safemode": self.fsn.bm.safemode.is_on()}
+
+
+class DatanodeProtocol:
+    """NN side of the DN↔NN protocol. Ref: server/protocol/DatanodeProtocol
+    .java; the DN's BPServiceActor (BPServiceActor.java:516,:643) drives it."""
+
+    def __init__(self, fsn: FSNamesystem):
+        self.fsn = fsn
+
+    def register_datanode(self, info: Dict) -> Dict:
+        node = self.fsn.bm.dn_manager.register(DatanodeInfo.from_wire(info))
+        return {"uuid": node.uuid}
+
+    @idempotent
+    def send_heartbeat(self, uuid: str, capacity: int, dfs_used: int,
+                       remaining: int, xceivers: int = 0):
+        cmds = self.fsn.bm.dn_manager.handle_heartbeat(
+            uuid, capacity, dfs_used, remaining, xceivers)
+        return [c.to_wire() for c in cmds]
+
+    @idempotent
+    def block_report(self, uuid: str, blocks: List[Dict]):
+        self.fsn.bm.process_report(uuid, [Block.from_wire(b) for b in blocks])
+        return True
+
+    @idempotent
+    def block_received_and_deleted(self, uuid: str, received: List[Dict],
+                                   deleted: List[Dict]):
+        for b in received:
+            self.fsn.bm.add_stored_block(Block.from_wire(b), uuid)
+        for b in deleted:
+            self.fsn.bm.remove_stored_block(Block.from_wire(b), uuid)
+        return True
+
+    def report_bad_blocks(self, blocks: List[Dict], uuids: List[str]):
+        for b, uuid in zip(blocks, uuids):
+            self.fsn.bm.mark_corrupt(Block.from_wire(b), uuid)
+        return True
+
+    def next_generation_stamp(self) -> int:
+        return self.fsn.next_gen_stamp()
+
+
+class NameNode(AbstractService):
+    """The daemon. Ref: server/namenode/NameNode.java."""
+
+    def __init__(self, conf: Configuration, name_dir: Optional[str] = None):
+        super().__init__("NameNode")
+        self._conf_in = conf
+        self.name_dir = name_dir or conf.get("dfs.namenode.name.dir",
+                                             "/tmp/htpu-name")
+        self.fsn: Optional[FSNamesystem] = None
+        self.rpc: Optional[Server] = None
+        self._stop_event = threading.Event()
+
+    @property
+    def port(self) -> int:
+        return self.rpc.port
+
+    def service_init(self, conf: Configuration) -> None:
+        os.makedirs(self.name_dir, exist_ok=True)
+        self.fsn = FSNamesystem(conf, self.name_dir)
+        self.fsn.load_from_disk()
+        bind_host = conf.get("dfs.namenode.rpc-bind-host", "127.0.0.1")
+        port = conf.get_int("dfs.namenode.rpc-port", 0)
+        self.retry_cache = RetryCache()
+        self.rpc = Server(
+            conf, bind=(bind_host, port),
+            num_handlers=conf.get_int("dfs.namenode.handler.count", 8),
+            name="namenode",
+            state_provider=lambda: self.fsn.editlog.last_txid,
+            queue_prefix="dfs.namenode")
+        self.rpc.register_protocol(
+            "ClientProtocol", ClientProtocol(self.fsn, self.retry_cache))
+        self.rpc.register_protocol("DatanodeProtocol", DatanodeProtocol(self.fsn))
+
+    def service_start(self) -> None:
+        self.rpc.start()
+        Daemon(self._redundancy_monitor, "nn-redundancy-monitor").start()
+        Daemon(self._checkpoint_monitor, "nn-checkpointer").start()
+        log.info("NameNode up at 127.0.0.1:%d (name dir %s)",
+                 self.rpc.port, self.name_dir)
+
+    def service_stop(self) -> None:
+        self._stop_event.set()
+        if self.rpc:
+            self.rpc.stop()
+        if self.fsn:
+            self.fsn.close()
+
+    # ------------------------------------------------------------- monitors
+
+    def _redundancy_monitor(self) -> None:
+        """Ref: BlockManager.RedundancyMonitor + HeartbeatManager.Monitor +
+        LeaseManager.Monitor rolled into one sweep loop."""
+        interval = self.config.get_time_seconds(
+            "dfs.namenode.redundancy.interval", 3.0)
+        while not self._stop_event.wait(interval):
+            try:
+                for node in self.fsn.bm.dn_manager.check_dead_nodes():
+                    self.fsn.bm.node_died(node)
+                if not self.fsn.bm.safemode.is_on():
+                    self.fsn.bm.compute_reconstruction_work()
+                    self.fsn.check_leases()
+            except Exception:
+                log.exception("Redundancy monitor pass failed")
+
+    def _checkpoint_monitor(self) -> None:
+        """Periodic checkpoint by txn count / period.
+        Ref: StandbyCheckpointer.doCheckpoint:194 trigger conditions."""
+        period = self.config.get_time_seconds(
+            "dfs.namenode.checkpoint.period", 3600.0)
+        txns = self.config.get_int("dfs.namenode.checkpoint.txns", 1_000_000)
+        last_ckpt_txid = self.fsn.editlog.last_txid
+        while not self._stop_event.wait(min(period, 10.0)):
+            try:
+                if self.fsn.editlog.last_txid - last_ckpt_txid >= txns:
+                    self.fsn.save_namespace()
+                    last_ckpt_txid = self.fsn.editlog.last_txid
+            except Exception:
+                log.exception("Checkpoint failed")

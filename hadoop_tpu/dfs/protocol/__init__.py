@@ -1,0 +1,11 @@
+from hadoop_tpu.dfs.protocol.records import (
+    Block, DatanodeID, DatanodeInfo, LocatedBlock, FileStatus, DnCommand,
+    SafeModeError, NotReplicatedYetError, LeaseExpiredError,
+    AlreadyBeingCreatedError, ReplicaNotFoundError,
+)
+
+__all__ = [
+    "Block", "DatanodeID", "DatanodeInfo", "LocatedBlock", "FileStatus",
+    "DnCommand", "SafeModeError", "NotReplicatedYetError",
+    "LeaseExpiredError", "AlreadyBeingCreatedError", "ReplicaNotFoundError",
+]

@@ -1,0 +1,66 @@
+"""DataTransferProtocol — the bulk-data streaming plane.
+
+Parity with the reference's block wire protocol (ref:
+hadoop-hdfs-client/.../protocol/datatransfer/DataTransferProtocol.java,
+Sender.java:63, Op.java, PacketHeader.java, PacketReceiver.java,
+PipelineAck.java; server side hadoop-hdfs/.../datatransfer/Receiver.java:56):
+op-coded requests followed by framed packets with a separated checksum plane
+(CRC32C per 512B chunk), pipelined store-and-forward with acks flowing
+upstream.
+
+This is deliberately NOT the RPC plane: one long-lived TCP stream per block
+transfer, sized for throughput (64 KB packets) rather than latency.
+
+Frames are u32-length-prefixed wirepack dicts:
+  op request   {"op": "write_block"|"read_block", "b": <block>, ...}
+  op response  {"ok": bool, "em": str}
+  data packet  {"seq": int, "off": int, "last": bool, "data": bytes,
+                "sums": bytes}
+  ack          {"seq": int, "statuses": [str, ...]}   # pipeline order
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Dict, Optional
+
+from hadoop_tpu.io.wire import pack, read_frame, unpack
+
+OP_WRITE_BLOCK = "write_block"
+OP_READ_BLOCK = "read_block"
+OP_TRANSFER_BLOCK = "transfer"   # DN→DN re-replication push
+
+STATUS_SUCCESS = "ok"
+STATUS_ERROR = "error"
+STATUS_ERROR_CHECKSUM = "checksum"
+
+PACKET_SIZE = 64 * 1024          # ref: dfs.client-write-packet-size
+CHUNK_SIZE = 512                 # ref: dfs.bytes-per-checksum
+
+# Pipeline stages (ref: BlockConstructionStage)
+STAGE_PIPELINE_SETUP_CREATE = "create"
+STAGE_PIPELINE_SETUP_APPEND = "append"
+STAGE_TRANSFER = "transfer"
+STAGE_PIPELINE_RECOVERY = "recovery"
+
+
+def send_frame(sock: socket.socket, msg: Dict) -> None:
+    payload = pack(msg)
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket) -> Dict:
+    msg = unpack(read_frame(sock))
+    if not isinstance(msg, dict):
+        raise IOError(f"malformed transfer frame ({type(msg).__name__})")
+    return msg
+
+
+def connect(addr, timeout: float = 30.0) -> socket.socket:
+    sock = socket.create_connection(addr, timeout=timeout)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    # Throughput plane: fat buffers.
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 1 << 20)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 20)
+    return sock

@@ -1,0 +1,248 @@
+"""DFS protocol records — the wire-visible data types.
+
+Parity with the reference's protocol classes (ref:
+hadoop-hdfs-client/src/main/java/org/apache/hadoop/hdfs/protocol/:
+Block.java, ExtendedBlock.java, DatanodeID.java, DatanodeInfo.java,
+LocatedBlock.java, HdfsFileStatus.java; server commands
+hadoop-hdfs/src/main/proto/DatanodeProtocol.proto). Plain records with
+to_wire/from_wire; no protobuf codegen (see hadoop_tpu.io.wire).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from hadoop_tpu.ipc.errors import register_exception
+
+
+@register_exception
+class SafeModeError(IOError):
+    """Namespace mutations rejected while the NameNode is in safemode.
+    Ref: hdfs/server/namenode/SafeModeException.java."""
+
+
+@register_exception
+class NotReplicatedYetError(IOError):
+    """add_block called before the previous block reached min replication.
+    Ref: hdfs/protocol/NotReplicatedYetException.java (retryable)."""
+
+
+@register_exception
+class LeaseExpiredError(IOError):
+    """Ref: hdfs/protocol/LeaseExpiredException.java."""
+
+
+@register_exception
+class AlreadyBeingCreatedError(IOError):
+    """Ref: hdfs/protocol/AlreadyBeingCreatedException.java."""
+
+
+@register_exception
+class ReplicaNotFoundError(IOError):
+    """Ref: hdfs/server/datanode/ReplicaNotFoundException.java."""
+
+
+@register_exception
+class QuotaExceededError(IOError):
+    """Namespace or space quota violated.
+    Ref: hdfs/protocol/QuotaExceededException.java."""
+
+
+class Block:
+    """(block_id, generation_stamp, num_bytes). Ref: protocol/Block.java;
+    the generation stamp versions replicas across pipeline recoveries."""
+
+    __slots__ = ("block_id", "gen_stamp", "num_bytes")
+
+    def __init__(self, block_id: int, gen_stamp: int, num_bytes: int = 0):
+        self.block_id = block_id
+        self.gen_stamp = gen_stamp
+        self.num_bytes = num_bytes
+
+    def name(self) -> str:
+        return f"blk_{self.block_id}_{self.gen_stamp}"
+
+    def to_wire(self) -> Dict:
+        return {"id": self.block_id, "gs": self.gen_stamp, "nb": self.num_bytes}
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "Block":
+        return cls(d["id"], d["gs"], d.get("nb", 0))
+
+    def __eq__(self, other):
+        return (isinstance(other, Block) and other.block_id == self.block_id
+                and other.gen_stamp == self.gen_stamp)
+
+    def __hash__(self):
+        return hash((self.block_id, self.gen_stamp))
+
+    def __repr__(self):
+        return f"{self.name()}(len={self.num_bytes})"
+
+
+class DatanodeID:
+    """Identity + addresses of one block server. Ref: protocol/DatanodeID.java."""
+
+    __slots__ = ("uuid", "host", "xfer_port", "ipc_port")
+
+    def __init__(self, uuid: str, host: str, xfer_port: int, ipc_port: int = 0):
+        self.uuid = uuid
+        self.host = host
+        self.xfer_port = xfer_port
+        self.ipc_port = ipc_port
+
+    def xfer_addr(self) -> tuple:
+        return (self.host, self.xfer_port)
+
+    def to_wire(self) -> Dict:
+        return {"u": self.uuid, "h": self.host, "xp": self.xfer_port,
+                "ip": self.ipc_port}
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "DatanodeID":
+        return cls(d["u"], d["h"], d["xp"], d.get("ip", 0))
+
+    def __eq__(self, other):
+        return isinstance(other, DatanodeID) and other.uuid == self.uuid
+
+    def __hash__(self):
+        return hash(self.uuid)
+
+    def __repr__(self):
+        return f"DN[{self.uuid[:8]}@{self.host}:{self.xfer_port}]"
+
+
+class DatanodeInfo(DatanodeID):
+    """DatanodeID + liveness/usage stats. Ref: protocol/DatanodeInfo.java."""
+
+    __slots__ = ("capacity", "dfs_used", "remaining", "last_heartbeat",
+                 "num_blocks", "state")
+
+    STATE_LIVE = "live"
+    STATE_DEAD = "dead"
+    STATE_DECOMMISSIONING = "decommissioning"
+    STATE_DECOMMISSIONED = "decommissioned"
+
+    def __init__(self, uuid: str, host: str, xfer_port: int, ipc_port: int = 0,
+                 capacity: int = 0, dfs_used: int = 0, remaining: int = 0):
+        super().__init__(uuid, host, xfer_port, ipc_port)
+        self.capacity = capacity
+        self.dfs_used = dfs_used
+        self.remaining = remaining
+        self.last_heartbeat = time.monotonic()
+        self.num_blocks = 0
+        self.state = self.STATE_LIVE
+
+    def to_wire(self) -> Dict:
+        d = super().to_wire()
+        d.update({"cap": self.capacity, "used": self.dfs_used,
+                  "rem": self.remaining, "st": self.state,
+                  "nblk": self.num_blocks})
+        return d
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "DatanodeInfo":
+        info = cls(d["u"], d["h"], d["xp"], d.get("ip", 0), d.get("cap", 0),
+                   d.get("used", 0), d.get("rem", 0))
+        info.state = d.get("st", cls.STATE_LIVE)
+        info.num_blocks = d.get("nblk", 0)
+        return info
+
+
+class LocatedBlock:
+    """A block + where its replicas live + its offset in the file.
+    Ref: protocol/LocatedBlock.java."""
+
+    __slots__ = ("block", "locations", "offset", "corrupt")
+
+    def __init__(self, block: Block, locations: List[DatanodeInfo],
+                 offset: int = 0, corrupt: bool = False):
+        self.block = block
+        self.locations = locations
+        self.offset = offset
+        self.corrupt = corrupt
+
+    def to_wire(self) -> Dict:
+        return {"b": self.block.to_wire(),
+                "locs": [d.to_wire() for d in self.locations],
+                "off": self.offset, "cor": self.corrupt}
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "LocatedBlock":
+        return cls(Block.from_wire(d["b"]),
+                   [DatanodeInfo.from_wire(x) for x in d["locs"]],
+                   d.get("off", 0), d.get("cor", False))
+
+
+class FileStatus:
+    """Ref: fs/FileStatus.java + hdfs HdfsFileStatus.java."""
+
+    __slots__ = ("path", "is_dir", "length", "replication", "block_size",
+                 "mtime", "atime", "owner", "group", "permission")
+
+    def __init__(self, path: str, is_dir: bool, length: int = 0,
+                 replication: int = 0, block_size: int = 0,
+                 mtime: float = 0.0, atime: float = 0.0, owner: str = "",
+                 group: str = "", permission: int = 0o644):
+        self.path = path
+        self.is_dir = is_dir
+        self.length = length
+        self.replication = replication
+        self.block_size = block_size
+        self.mtime = mtime
+        self.atime = atime
+        self.owner = owner
+        self.group = group
+        self.permission = permission
+
+    def to_wire(self) -> Dict:
+        return {"p": self.path, "d": self.is_dir, "len": self.length,
+                "rep": self.replication, "bs": self.block_size,
+                "mt": self.mtime, "at": self.atime, "o": self.owner,
+                "g": self.group, "perm": self.permission}
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "FileStatus":
+        return cls(d["p"], d["d"], d.get("len", 0), d.get("rep", 0),
+                   d.get("bs", 0), d.get("mt", 0.0), d.get("at", 0.0),
+                   d.get("o", ""), d.get("g", ""), d.get("perm", 0o644))
+
+    def __repr__(self):
+        kind = "dir" if self.is_dir else f"file[{self.length}B]"
+        return f"FileStatus({self.path}, {kind})"
+
+
+class DnCommand:
+    """NameNode → DataNode command piggybacked on heartbeat responses.
+    Ref: server/protocol/DatanodeProtocol.proto (BlockCommandProto):
+    TRANSFER = replicate a block to targets; INVALIDATE = delete blocks;
+    RECOVER = recover an under-construction block to a new gen stamp."""
+
+    TRANSFER = "transfer"
+    INVALIDATE = "invalidate"
+    RECOVER = "recover"
+    REREGISTER = "reregister"
+
+    def __init__(self, action: str, blocks: Optional[List[Block]] = None,
+                 targets: Optional[List[List[DatanodeInfo]]] = None,
+                 new_gen_stamps: Optional[List[int]] = None):
+        self.action = action
+        self.blocks = blocks or []
+        self.targets = targets or []
+        self.new_gen_stamps = new_gen_stamps or []
+
+    def to_wire(self) -> Dict:
+        return {
+            "a": self.action,
+            "b": [b.to_wire() for b in self.blocks],
+            "t": [[d.to_wire() for d in tgt] for tgt in self.targets],
+            "gs": self.new_gen_stamps,
+        }
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "DnCommand":
+        return cls(d["a"], [Block.from_wire(x) for x in d.get("b", [])],
+                   [[DatanodeInfo.from_wire(y) for y in t]
+                    for t in d.get("t", [])],
+                   d.get("gs", []))

@@ -1,0 +1,4 @@
+from hadoop_tpu.fs.filesystem import (FileSystem, LocalFileSystem, Path,
+                                      register_filesystem)
+
+__all__ = ["FileSystem", "LocalFileSystem", "Path", "register_filesystem"]

@@ -21,7 +21,7 @@ import time
 from typing import Any, Dict, Optional, Tuple
 
 from hadoop_tpu.conf import Configuration
-from hadoop_tpu.io.wire import pack, read_frame, unpack
+from hadoop_tpu.io.wire import pack, unpack
 from hadoop_tpu.ipc.errors import (FatalRpcError, RpcError, RpcTimeoutError,
                                    resolve_exception)
 from hadoop_tpu.ipc.server import MAGIC, PING_CALL_ID
@@ -32,6 +32,8 @@ from hadoop_tpu.util.misc import Daemon
 log = logging.getLogger(__name__)
 
 Address = Tuple[str, int]
+
+MAX_CLIENT_FRAME = 128 * 1024 * 1024  # mirror of server-side MAX_FRAME
 
 
 class _PendingCall:
@@ -62,12 +64,26 @@ class _Connection:
     def _connect(self) -> None:
         conf = self.client.conf
         timeout = conf.get_time_seconds("ipc.client.connect.timeout", 20.0)
+        # Idle receive probe: after this long with no inbound bytes, send a
+        # ping (only while calls are outstanding); a half-open connection
+        # (server died without FIN reaching us) surfaces as a ping write
+        # failure within ~2 intervals instead of hanging calls until their
+        # full RPC timeout. Ref: ipc/Client.java sendPing / ipc.ping.interval.
+        # The wait is select()-based so sends stay fully blocking — a socket
+        # timeout would cap sendall() too and kill slow large sends.
+        self.ping_interval = conf.get_time_seconds("ipc.ping.interval", 10.0)
+        # Client-side idle close (ref: ipc.client.connection.maxidletime,
+        # client default 10s): a connection with no outstanding calls closes
+        # itself rather than pinging the server's idle reaper awake forever.
+        self.max_idle_s = conf.get_time_seconds(
+            "ipc.client.connection.maxidletime", 10.0)
         try:
             self.sock = socket.create_connection(self.addr, timeout=timeout)
         except OSError as e:
             raise RpcError(f"failed to connect to {self.addr}: {e}") from e
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.sock.settimeout(None)
+        self.last_activity = time.monotonic()
         hdr: Dict[str, Any] = {
             "magic": MAGIC,
             "protocol": self.protocol,
@@ -84,33 +100,81 @@ class _Connection:
         self.sock.sendall(struct.pack(">I", len(payload)) + payload)
 
     def _receive_loop(self) -> None:
+        import select
+
+        buf = bytearray()
         while not self.dead:
             try:
-                frame = read_frame(self.sock)
-            except (OSError, EOFError):
+                ready, _, _ = select.select([self.sock], [], [],
+                                            self.ping_interval)
+            except (OSError, ValueError):
                 self._fail_all(RpcError(f"connection to {self.addr} closed"))
                 return
+            if not ready:
+                # Idle (or very slow peer). With calls in flight, probe
+                # liveness; with none, close once past the idle limit.
+                with self.calls_lock:
+                    outstanding = len(self.calls)
+                if outstanding:
+                    try:
+                        self.ping()
+                    except OSError:
+                        self._fail_all(RpcError(
+                            f"connection to {self.addr} failed ping probe"))
+                        return
+                elif time.monotonic() - self.last_activity > self.max_idle_s:
+                    self._fail_all(RpcError(
+                        f"connection to {self.addr} idle-closed"))
+                    return
+                continue
             try:
-                msg = unpack(frame)
-            except Exception as e:  # noqa: BLE001
-                self._fail_all(RpcError(f"bad response frame: {e}"))
+                chunk = self.sock.recv(256 * 1024)
+            except OSError:
+                chunk = b""
+            if not chunk:
+                self._fail_all(RpcError(f"connection to {self.addr} closed"))
                 return
-            if not isinstance(msg, dict):
-                self._fail_all(RpcError(
-                    f"non-record response frame ({type(msg).__name__})"))
-                return
-            sid = msg.get("sid", -1)
-            if sid is not None and sid > self.last_state_id:
-                self.last_state_id = sid
-            if msg.get("fatal"):
-                self._fail_all(FatalRpcError(msg.get("em", "fatal rpc error")))
-                return
-            call_id = msg.get("id")
-            with self.calls_lock:
-                pend = self.calls.pop(call_id, None)
-            if pend is not None:
-                pend.response = msg
-                pend.event.set()
+            self.last_activity = time.monotonic()
+            buf += chunk
+            while len(buf) >= 4:
+                (flen,) = struct.unpack_from(">I", buf, 0)
+                if flen > MAX_CLIENT_FRAME:
+                    self._fail_all(RpcError(
+                        f"oversized response frame ({flen} bytes) from "
+                        f"{self.addr}"))
+                    return
+                if len(buf) - 4 < flen:
+                    break
+                frame = bytes(buf[4:4 + flen])
+                del buf[:4 + flen]
+                if not self._handle_frame(frame):
+                    return
+
+    def _handle_frame(self, frame: bytes) -> bool:
+        """Process one response frame; returns False when the connection is
+        being torn down."""
+        try:
+            msg = unpack(frame)
+        except Exception as e:  # noqa: BLE001
+            self._fail_all(RpcError(f"bad response frame: {e}"))
+            return False
+        if not isinstance(msg, dict):
+            self._fail_all(RpcError(
+                f"non-record response frame ({type(msg).__name__})"))
+            return False
+        sid = msg.get("sid", -1)
+        if sid is not None and sid > self.last_state_id:
+            self.last_state_id = sid
+        if msg.get("fatal"):
+            self._fail_all(FatalRpcError(msg.get("em", "fatal rpc error")))
+            return False
+        call_id = msg.get("id")
+        with self.calls_lock:
+            pend = self.calls.pop(call_id, None)
+        if pend is not None:
+            pend.response = msg
+            pend.event.set()
+        return True
 
     def _fail_all(self, err: BaseException) -> None:
         self.dead = True
@@ -135,6 +199,7 @@ class _Connection:
             self.calls[call_id] = pend
         payload = pack(req)
         data = struct.pack(">I", len(payload)) + payload
+        self.last_activity = time.monotonic()
         try:
             with self.send_lock:
                 self.sock.sendall(data)

@@ -153,7 +153,20 @@ class Server:
     def start(self) -> None:
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._lsock.bind(self._bind_addr)
+        # A restart on a fixed port can race lingering FIN_WAIT sockets from
+        # the previous incarnation's clients; retry briefly instead of dying
+        # (SO_REUSEADDR only covers TIME_WAIT).
+        import errno
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                self._lsock.bind(self._bind_addr)
+                break
+            except OSError as e:
+                if e.errno != errno.EADDRINUSE or \
+                        time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
         self._lsock.listen(256)
         self.port = self._lsock.getsockname()[1]
         self._running = True
@@ -181,10 +194,17 @@ class Server:
                 self._lsock.close()
             except OSError:
                 pass
-        with self._conns_lock:
-            conns = list(self._conns.values())
-        for c in conns:
-            self._close_conn(c)
+        # Sweep connections repeatedly: the listener may register a
+        # just-accepted connection concurrently with this stop; a missed one
+        # would leave the peer half-open until its ping probe fires.
+        for _ in range(20):
+            with self._conns_lock:
+                conns = list(self._conns.values())
+            if not conns:
+                break
+            for c in conns:
+                self._close_conn(c)
+            time.sleep(0.01)
         for r in self._readers:
             r.wake()
         if self._responder:
@@ -207,7 +227,10 @@ class Server:
             conn = _Connection(sock, addr)
             with self._conns_lock:
                 self._conns[id(conn)] = conn
-            self._m_open_conns.incr()
+            self._m_open_conns.incr()  # before the raced close: decr pairs up
+            if not self._running:  # raced with stop(): don't strand the peer
+                self._close_conn(conn)
+                continue
             self._readers[i % len(self._readers)].add_connection(conn)
             i += 1
 

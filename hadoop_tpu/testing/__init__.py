@@ -1,0 +1,3 @@
+from hadoop_tpu.testing.minicluster import MiniDFSCluster
+
+__all__ = ["MiniDFSCluster"]

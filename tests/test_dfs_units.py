@@ -1,0 +1,370 @@
+"""DFS component unit tests: inodes, edit log, image, leases, block manager,
+block store. (Parity targets: ref TestINodeFile, TestEditLog, TestFSImage,
+TestLeaseManager, TestBlockManager, TestFsDatasetImpl.)"""
+
+import os
+
+import pytest
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.dfs.namenode.blockmanager import BlockManager
+from hadoop_tpu.dfs.namenode.editlog import (OP_MKDIR, FSEditLog,
+                                             FileJournalManager)
+from hadoop_tpu.dfs.namenode.fsimage import FSImage
+from hadoop_tpu.dfs.namenode.inodes import FSDirectory, INodeFile
+from hadoop_tpu.dfs.namenode.lease import LeaseManager
+from hadoop_tpu.dfs.datanode.blockstore import BlockStore, Replica
+from hadoop_tpu.dfs.protocol.records import Block, DatanodeInfo
+from hadoop_tpu.util.crc import DataChecksum
+
+
+# ------------------------------------------------------------------ inodes
+
+
+def test_fsdirectory_basic():
+    d = FSDirectory()
+    d.mkdirs("/a/b/c")
+    assert d.exists("/a/b/c")
+    assert d.get_inode("/a/b").is_dir
+    f = d.add_file("/a/b/f.txt", replication=3, block_size=1024)
+    assert not f.is_dir
+    assert d.get_inode("/a/b/f.txt") is f
+    with pytest.raises(FileExistsError):
+        d.add_file("/a/b/f.txt", 3, 1024)
+    listing = d.listing("/a/b")
+    assert [s.path for s in listing] == ["/a/b/c", "/a/b/f.txt"]
+
+
+def test_fsdirectory_delete_rename():
+    d = FSDirectory()
+    d.add_file("/x/f1", 3, 1024)
+    d.add_file("/x/f2", 3, 1024)
+    with pytest.raises(OSError):
+        d.delete("/x", recursive=False)
+    d.rename("/x/f1", "/y/")  # /y doesn't exist → parent missing
+    # ^ rename to /y/: components ["y"], parent of "/y/" is root, dst=/y
+    assert d.exists("/y")
+    d.mkdirs("/z")
+    d.rename("/x/f2", "/z")  # into existing dir → /z/f2
+    assert d.exists("/z/f2")
+    assert d.delete("/z", recursive=True) is not None
+    assert not d.exists("/z/f2")
+
+
+def test_rename_under_self_rejected():
+    d = FSDirectory()
+    d.mkdirs("/a/b")
+    with pytest.raises(ValueError):
+        d.rename("/a", "/a/b/c")
+
+
+# ---------------------------------------------------------------- edit log
+
+
+def test_editlog_roundtrip(tmp_path):
+    jm = FileJournalManager(str(tmp_path / "edits"))
+    elog = FSEditLog(jm)
+    elog.open_for_write(0)
+    txids = [elog.log_edit(OP_MKDIR, {"p": f"/d{i}"}) for i in range(10)]
+    elog.log_sync()
+    assert txids == list(range(1, 11))
+    elog.close()
+    recs = list(jm.read_edits(1))
+    assert len(recs) == 10
+    assert recs[0]["p"] == "/d0"
+    assert recs[-1]["t"] == 10
+    # Finalized segment exists.
+    segs = jm.segments()
+    assert segs == [(1, 10, str(tmp_path / "edits" / "edits_1-10"))]
+
+
+def test_editlog_torn_tail_tolerated(tmp_path):
+    jm = FileJournalManager(str(tmp_path / "edits"))
+    elog = FSEditLog(jm)
+    elog.open_for_write(0)
+    for i in range(5):
+        elog.log_edit(OP_MKDIR, {"p": f"/d{i}"})
+    elog.log_sync()
+    # Simulate crash: truncate the in-progress segment mid-frame.
+    seg = os.path.join(str(tmp_path / "edits"), "edits_inprogress_1")
+    size = os.path.getsize(seg)
+    with open(seg, "r+b") as f:
+        f.truncate(size - 3)
+    jm2 = FileJournalManager(str(tmp_path / "edits"))
+    recs = list(jm2.read_edits(1))
+    assert len(recs) == 4  # last record torn away, rest intact
+
+
+def test_editlog_group_commit_batches(tmp_path):
+    import threading
+    jm = FileJournalManager(str(tmp_path / "edits"))
+    elog = FSEditLog(jm)
+    elog.open_for_write(0)
+
+    def writer(i):
+        t = elog.log_edit(OP_MKDIR, {"p": f"/t{i}"})
+        elog.log_sync(t)
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(32)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert elog.synced_txid == 32
+    elog.close()
+    assert len(list(jm.read_edits(1))) == 32
+
+
+def test_editlog_roll(tmp_path):
+    jm = FileJournalManager(str(tmp_path / "edits"))
+    elog = FSEditLog(jm)
+    elog.open_for_write(0)
+    elog.log_edit(OP_MKDIR, {"p": "/a"})
+    first_new = elog.roll()
+    assert first_new == 2
+    elog.log_edit(OP_MKDIR, {"p": "/b"})
+    elog.close()
+    firsts = [s[0] for s in jm.segments()]
+    assert firsts == [1, 2]
+    assert [r["p"] for r in jm.read_edits(1)] == ["/a", "/b"]
+
+
+# ------------------------------------------------------------------ fsimage
+
+
+def test_fsimage_roundtrip(tmp_path):
+    d = FSDirectory()
+    d.mkdirs("/data/sub")
+    f = d.add_file("/data/file", 2, 4096, owner="alice")
+    f.blocks = [Block(101, 1000, 500), Block(102, 1001, 300)]
+    img = FSImage(str(tmp_path / "img"))
+    img.save(d, txid=42, extra={"gen_stamp": 1001})
+    loaded = img.load()
+    assert loaded is not None
+    txid, d2, extra = loaded
+    assert txid == 42
+    assert extra["gen_stamp"] == 1001
+    f2 = d2.get_inode("/data/file")
+    assert isinstance(f2, INodeFile)
+    assert f2.owner == "alice"
+    assert [b.block_id for b in f2.blocks] == [101, 102]
+    assert f2.length() == 800
+    assert d2.exists("/data/sub")
+
+
+def test_fsimage_corruption_detected(tmp_path):
+    d = FSDirectory()
+    img = FSImage(str(tmp_path / "img"))
+    path = img.save(d, txid=1, extra={})
+    with open(path, "r+b") as f:
+        f.seek(10)
+        f.write(b"\xde\xad")
+    with pytest.raises(IOError, match="corrupt"):
+        img.load()
+
+
+# ------------------------------------------------------------------- leases
+
+
+def test_lease_lifecycle():
+    lm = LeaseManager(soft_limit_s=0.2, hard_limit_s=0.5)
+    lm.add_lease("client1", "/f1")
+    assert lm.holder_of("/f1") == "client1"
+    assert not lm.is_soft_expired("/f1")
+    import time
+    time.sleep(0.25)
+    assert lm.is_soft_expired("/f1")
+    lm.renew_lease("client1")
+    assert not lm.is_soft_expired("/f1")
+    time.sleep(0.55)
+    assert lm.hard_expired_paths() == ["/f1"]
+    lm.remove_lease("client1", "/f1")
+    assert lm.holder_of("/f1") is None
+    assert lm.num_leases() == 0
+
+
+# ------------------------------------------------------------ block manager
+
+
+def _register(bm, n):
+    nodes = []
+    for i in range(n):
+        info = DatanodeInfo(f"uuid-{i}", "127.0.0.1", 5000 + i)
+        nodes.append(bm.dn_manager.register(info))
+    return nodes
+
+
+def test_block_manager_replication_tracking():
+    conf = Configuration(load_defaults=False)
+    bm = BlockManager(conf)
+    bm.safemode.leave(force=True)
+    nodes = _register(bm, 3)
+    blk = Block(1, 100, 1024)
+    info = bm.add_block_collection(blk, None, 3)
+    info.under_construction = False
+    for node in nodes:
+        bm.add_stored_block(blk, node.uuid)
+    assert bm.get(1).live_replicas() == 3
+    assert bm.under_replicated_count() == 0
+    # Lose a node → under-replicated.
+    nodes[0].state = DatanodeInfo.STATE_DEAD
+    bm.node_died(nodes[0])
+    assert bm.get(1).live_replicas() == 2
+    assert bm.under_replicated_count() == 1
+    # Only 2 nodes remain live and both already hold replicas → no target.
+    assert bm.compute_reconstruction_work() == 0
+
+
+def test_block_manager_schedules_reconstruction():
+    conf = Configuration(load_defaults=False)
+    bm = BlockManager(conf)
+    bm.safemode.leave(force=True)
+    nodes = _register(bm, 4)
+    blk = Block(1, 100, 1024)
+    info = bm.add_block_collection(blk, None, 3)
+    info.under_construction = False
+    for node in nodes[:3]:
+        bm.add_stored_block(blk, node.uuid)
+    nodes[0].state = DatanodeInfo.STATE_DEAD
+    bm.node_died(nodes[0])
+    assert bm.compute_reconstruction_work() == 1
+    queued = [n for n in nodes[1:3] if n.transfer_queue]
+    assert len(queued) == 1
+    _, targets = queued[0].transfer_queue[0]
+    assert targets[0].uuid == nodes[3].uuid
+
+
+def test_block_manager_excess_replicas_pruned():
+    conf = Configuration(load_defaults=False)
+    bm = BlockManager(conf)
+    bm.safemode.leave(force=True)
+    nodes = _register(bm, 4)
+    blk = Block(1, 100, 1024)
+    info = bm.add_block_collection(blk, None, 2)  # want 2
+    info.under_construction = False
+    for node in nodes:
+        bm.add_stored_block(blk, node.uuid)  # have 4
+    assert bm.get(1).live_replicas() == 2
+    invalidations = sum(len(n.invalidate_queue) for n in nodes)
+    assert invalidations == 2
+
+
+def test_block_manager_stale_genstamp_is_corrupt():
+    conf = Configuration(load_defaults=False)
+    bm = BlockManager(conf)
+    bm.safemode.leave(force=True)
+    nodes = _register(bm, 2)
+    blk = Block(1, gen_stamp=200, num_bytes=100)
+    info = bm.add_block_collection(blk, None, 2)
+    info.under_construction = False
+    bm.add_stored_block(Block(1, 200, 100), nodes[0].uuid)
+    bm.add_stored_block(Block(1, 150, 80), nodes[1].uuid)  # stale
+    assert bm.get(1).live_replicas() == 1
+    assert nodes[1].invalidate_queue  # stale replica queued for deletion
+
+
+def test_safemode_threshold():
+    conf = Configuration(load_defaults=False)
+    bm = BlockManager(conf)
+    nodes = _register(bm, 1)
+    blocks = [Block(i, 100, 10) for i in range(10)]
+    for b in blocks:
+        bi = bm.add_block_collection(b, None, 1)
+        bi.under_construction = False
+    bm.safemode.set_block_total(10)
+    assert bm.safemode.is_on()
+    for b in blocks[:9]:
+        bm.add_stored_block(b, nodes[0].uuid)
+    assert bm.safemode.is_on()  # 9/10 < 99.9%
+    bm.add_stored_block(blocks[9], nodes[0].uuid)
+    assert not bm.safemode.is_on()
+
+
+def test_heartbeat_commands_roundtrip():
+    conf = Configuration(load_defaults=False)
+    bm = BlockManager(conf)
+    nodes = _register(bm, 1)
+    nodes[0].invalidate_queue.append(Block(7, 1, 0))
+    cmds = bm.dn_manager.handle_heartbeat("uuid-0", 100, 10, 90, 0)
+    assert len(cmds) == 1
+    assert cmds[0].action == "invalidate"
+    assert cmds[0].blocks[0].block_id == 7
+    # Queue drained.
+    assert bm.dn_manager.handle_heartbeat("uuid-0", 100, 10, 90, 0) == []
+    # Unknown node → reregister.
+    cmds = bm.dn_manager.handle_heartbeat("ghost", 1, 1, 1, 0)
+    assert cmds[0].action == "reregister"
+
+
+# --------------------------------------------------------------- blockstore
+
+
+def test_blockstore_write_read_roundtrip(tmp_path):
+    store = BlockStore(str(tmp_path / "bs"))
+    cs = DataChecksum(512)
+    blk = Block(42, 1000)
+    rep = store.create_rbw(blk, cs)
+    data = os.urandom(3000)
+    for off in range(0, len(data), 1024):
+        chunk = data[off:off + 1024]
+        rep.write_packet(chunk, cs.checksums_for(chunk))
+    final = store.finalize(rep)
+    assert final.num_bytes == 3000
+    assert final.state == Replica.FINALIZED
+    # Read back whole + ranges, verifying checksums.
+    got = bytearray()
+    for pos, d, sums in store.read_chunks(Block(42, 1000, 3000), 0, 3000):
+        cs.verify(d, sums, base_pos=pos)
+        got += d
+    assert bytes(got) == data
+
+
+def test_blockstore_range_read_chunk_aligned(tmp_path):
+    store = BlockStore(str(tmp_path / "bs"))
+    cs = DataChecksum(512)
+    blk = Block(1, 5)
+    rep = store.create_rbw(blk, cs)
+    data = bytes(range(256)) * 8  # 2048
+    rep.write_packet(data, cs.checksums_for(data))
+    store.finalize(rep)
+    # Ask for bytes 700..900; reader gets chunk-aligned data covering it.
+    runs = list(store.read_chunks(Block(1, 5, 2048), 700, 200))
+    start = runs[0][0]
+    assert start == 512  # aligned down
+    total = b"".join(r[1] for r in runs)
+    assert data[700:900] in total
+
+
+def test_blockstore_survives_restart(tmp_path):
+    store = BlockStore(str(tmp_path / "bs"))
+    cs = DataChecksum(512)
+    rep = store.create_rbw(Block(9, 77), cs)
+    rep.write_packet(b"abc", cs.checksums_for(b"abc"))
+    store.finalize(rep)
+    store2 = BlockStore(str(tmp_path / "bs"))
+    r = store2.get_replica(9)
+    assert r is not None and r.gen_stamp == 77 and r.num_bytes == 3
+    assert [b.block_id for b in store2.all_finalized()] == [9]
+
+
+def test_blockstore_genstamp_update(tmp_path):
+    store = BlockStore(str(tmp_path / "bs"))
+    cs = DataChecksum(512)
+    rep = store.create_rbw(Block(5, 10), cs)
+    rep.write_packet(b"x", cs.checksums_for(b"x"))
+    store.finalize(rep)
+    store.update_gen_stamp(5, 20)
+    assert store.get_replica(5).gen_stamp == 20
+    store2 = BlockStore(str(tmp_path / "bs"))
+    assert store2.get_replica(5).gen_stamp == 20
+
+
+def test_blockstore_invalidate(tmp_path):
+    store = BlockStore(str(tmp_path / "bs"))
+    cs = DataChecksum(512)
+    rep = store.create_rbw(Block(3, 1), cs)
+    rep.write_packet(b"zz", cs.checksums_for(b"zz"))
+    store.finalize(rep)
+    assert store.invalidate(Block(3, 1))
+    assert store.get_replica(3) is None
+    assert not store.invalidate(Block(3, 1))

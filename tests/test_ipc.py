@@ -344,7 +344,14 @@ def test_server_metrics(server, client):
     proxy = get_proxy(EchoProtocol, ("127.0.0.1", server.port), client=client)
     for i in range(5):
         proxy.echo(i)
-    snap = metrics_system().snapshot_all()["rpc.test"]
+    # Counters tick in the handler's finally, after the response is written —
+    # poll briefly instead of racing it.
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:
+        snap = metrics_system().snapshot_all()["rpc.test"]
+        if snap["rpc_processing_calls"] >= 5:
+            break
+        time.sleep(0.02)
     assert snap["rpc_processing_calls"] >= 5
     assert snap["rpc_processing_time_num_ops"] >= 5
 

@@ -1,0 +1,263 @@
+"""End-to-end DFS tests on the in-process minicluster.
+
+Parity targets: ref TestDistributedFileSystem, TestReplication,
+TestFileCreation, TestDataTransferProtocol, TestFsck-adjacent flows — real
+NN + 3 DNs, real RPC + streaming protocols, one process.
+"""
+
+import os
+import time
+
+import pytest
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.testing.minicluster import MiniDFSCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MiniDFSCluster(num_datanodes=3) as c:
+        yield c
+
+
+@pytest.fixture(scope="module")
+def fs(cluster):
+    return cluster.get_filesystem()
+
+
+def test_write_read_roundtrip(cluster, fs):
+    data = os.urandom(300_000)  # < 1 block
+    with fs.create("/roundtrip.bin") as out:
+        out.write(data)
+    with fs.open("/roundtrip.bin") as f:
+        assert f.read() == data
+    st = fs.get_file_status("/roundtrip.bin")
+    assert st.length == len(data)
+    assert not st.is_dir
+
+
+def test_multi_block_file(cluster, fs):
+    # 1 MB blocks (fast_conf) → 3.5 MB = 4 blocks.
+    data = os.urandom(3 * 1024 * 1024 + 512 * 1024)
+    with fs.create("/big.bin") as out:
+        # Write in odd-sized chunks to exercise packet buffering.
+        for off in range(0, len(data), 97_531):
+            out.write(data[off:off + 97_531])
+    with fs.open("/big.bin") as f:
+        got = f.read()
+    assert got == data
+    locs = cluster.get_filesystem().client.get_block_locations("/big.bin")
+    assert len(locs["blocks"]) == 4
+
+
+def test_replication_factor_honored(cluster, fs):
+    with fs.create("/rep.bin", replication=2) as out:
+        out.write(b"hello replication")
+    time.sleep(0.3)  # let incremental reports land
+    locs = fs.client.get_block_locations("/rep.bin")
+    assert len(locs["blocks"]) == 1
+    assert len(locs["blocks"][0]["locs"]) == 2
+
+
+def test_empty_file(cluster, fs):
+    with fs.create("/empty") as out:
+        pass
+    st = fs.get_file_status("/empty")
+    assert st.length == 0
+    with fs.open("/empty") as f:
+        assert f.read() == b""
+
+
+def test_mkdirs_listing_delete(cluster, fs):
+    fs.mkdirs("/dir/sub")
+    fs.write_all("/dir/a.txt", b"aaa")
+    fs.write_all("/dir/b.txt", b"bbb")
+    names = [s.path for s in fs.list_status("/dir")]
+    assert names == ["/dir/a.txt", "/dir/b.txt", "/dir/sub"]
+    assert fs.delete("/dir", recursive=True)
+    assert not fs.exists("/dir")
+
+
+def test_rename(cluster, fs):
+    fs.write_all("/src.txt", b"content")
+    fs.rename("/src.txt", "/dst.txt")
+    assert not fs.exists("/src.txt")
+    assert fs.read_all("/dst.txt") == b"content"
+
+
+def test_overwrite_semantics(cluster, fs):
+    fs.write_all("/ow.txt", b"v1")
+    with pytest.raises(FileExistsError):
+        with fs.create("/ow.txt", overwrite=False) as out:
+            out.write(b"nope")
+    fs.write_all("/ow.txt", b"v2", overwrite=True)
+    assert fs.read_all("/ow.txt") == b"v2"
+
+
+def test_seek_and_pread(cluster, fs):
+    data = bytes(range(256)) * 5000  # 1.28 MB, crosses a block boundary
+    fs.write_all("/seek.bin", data)
+    with fs.open("/seek.bin") as f:
+        f.seek(1000)
+        assert f.read(100) == data[1000:1100]
+        assert f.pread(1024 * 1024 - 50, 100) == \
+            data[1024 * 1024 - 50:1024 * 1024 + 50]  # spans block edge
+        f.seek(0)
+        assert f.read(10) == data[:10]
+
+
+def test_concurrent_writers_distinct_files(cluster, fs):
+    import threading
+    payload = {i: os.urandom(200_000) for i in range(6)}
+    errs = []
+
+    def write(i):
+        try:
+            fs.write_all(f"/conc/f{i}", payload[i])
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=write, args=(i,)) for i in payload]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    for i, data in payload.items():
+        assert fs.read_all(f"/conc/f{i}") == data
+
+
+def test_single_writer_enforced(cluster, fs):
+    from hadoop_tpu.dfs.protocol.records import AlreadyBeingCreatedError
+    out = fs.create("/locked.txt")
+    out.write(b"partial")
+    other = cluster.get_filesystem()
+    try:
+        with pytest.raises((AlreadyBeingCreatedError, FileExistsError)):
+            other.create("/locked.txt", overwrite=True)
+    finally:
+        out.close()
+        other.close()
+
+
+def test_read_failover_on_dead_datanode(cluster, fs):
+    """Kill a DN holding a replica; reads must fail over to survivors."""
+    data = os.urandom(400_000)
+    fs.write_all("/failover.bin", data)
+    time.sleep(0.3)
+    locs = fs.client.get_block_locations("/failover.bin")
+    holder_uuids = {l["u"] for l in locs["blocks"][0]["locs"]}
+    victim_idx = next(i for i, dn in enumerate(cluster.datanodes)
+                      if dn is not None and dn.uuid in holder_uuids)
+    cluster.kill_datanode(victim_idx)
+    try:
+        with fs.open("/failover.bin") as f:
+            assert f.read() == data
+    finally:
+        cluster.restart_datanode(victim_idx)
+        cluster.wait_active()
+
+
+def test_re_replication_after_datanode_death(cluster, fs):
+    """The RedundancyMonitor must restore replication after a DN dies."""
+    data = os.urandom(100_000)
+    fs.write_all("/heal.bin", data, overwrite=True)
+    time.sleep(0.3)
+    locs = fs.client.get_block_locations("/heal.bin")
+    block_id = locs["blocks"][0]["b"]["id"]
+    holders = {l["u"] for l in locs["blocks"][0]["locs"]}
+    assert len(holders) == 3
+    victim_idx = next(i for i, dn in enumerate(cluster.datanodes)
+                      if dn is not None and dn.uuid in holders)
+    victim_uuid = cluster.datanodes[victim_idx].uuid
+    cluster.kill_datanode(victim_idx)
+    # Not possible to reach 3 replicas with 2 nodes; bring up a fresh 4th DN.
+    cluster.num_datanodes += 1
+    cluster._start_datanode(len(cluster.datanodes))
+    try:
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            info = cluster.namenode.fsn.bm.get(block_id)
+            live = {u for u in info.locations if u != victim_uuid}
+            if len(live) >= 3:
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail(f"block never re-replicated: {info.locations}")
+        with fs.open("/heal.bin") as f:
+            assert f.read() == data
+    finally:
+        cluster.restart_datanode(victim_idx)
+        cluster.wait_active()
+
+
+def test_corrupt_replica_detected_and_avoided(cluster, fs):
+    data = os.urandom(50_000)
+    fs.write_all("/corrupt.bin", data, overwrite=True)
+    time.sleep(0.3)
+    locs = fs.client.get_block_locations("/corrupt.bin")
+    block_id = locs["blocks"][0]["b"]["id"]
+    holders = [l["u"] for l in locs["blocks"][0]["locs"]]
+    dn_idx = next(i for i, dn in enumerate(cluster.datanodes)
+                  if dn is not None and dn.uuid == holders[0])
+    assert cluster.corrupt_replica(block_id, dn_idx)
+    # Fresh reader (no cached dead-node state): must transparently survive.
+    fs2 = cluster.get_filesystem()
+    with fs2.open("/corrupt.bin") as f:
+        assert f.read() == data
+
+
+def test_namenode_restart_preserves_namespace(cluster, fs):
+    data = os.urandom(150_000)
+    fs.write_all("/persist/f.bin", data, overwrite=True)
+    fs.mkdirs("/persist/dir")
+    cluster.restart_namenode()
+    cluster.wait_active()
+    fs2 = cluster.get_filesystem()
+    assert fs2.exists("/persist/f.bin")
+    assert fs2.exists("/persist/dir")
+    assert fs2.read_all("/persist/f.bin") == data
+
+
+def test_namenode_restart_after_checkpoint(cluster, fs):
+    fs.write_all("/ckpt/a.bin", b"before checkpoint", overwrite=True)
+    fs.client.nn.save_namespace()
+    fs.write_all("/ckpt/b.bin", b"after checkpoint", overwrite=True)
+    cluster.restart_namenode()
+    cluster.wait_active()
+    fs2 = cluster.get_filesystem()
+    assert fs2.read_all("/ckpt/a.bin") == b"before checkpoint"
+    assert fs2.read_all("/ckpt/b.bin") == b"after checkpoint"
+
+
+def test_lease_recovery_on_abandoned_writer(cluster, fs):
+    """A writer that vanishes must not lock the file forever."""
+    out = fs.create("/abandoned.txt")
+    out.write(b"some data that will be recovered")
+    out.flush()
+    # Simulate writer death: stop renewing (kill the renewer + client ref).
+    fs.client._renewer_stop.set()
+    deadline = time.monotonic() + 15
+    fs2 = cluster.get_filesystem()
+    recovered = False
+    while time.monotonic() < deadline:
+        try:
+            if fs2.client.nn.recover_lease("/abandoned.txt", "taker"):
+                recovered = True
+                break
+        except Exception:
+            pass
+        time.sleep(0.3)
+    assert recovered
+    # Restart the renewer thread machinery for later tests.
+    fs.client._renewer_stop = None
+    fs.client._open_files = 0
+
+
+def test_datanode_report_and_stats(cluster, fs):
+    stats = fs.client.nn.get_stats()
+    assert stats["live_datanodes"] >= 3
+    assert not stats["safemode"]
+    report = fs.client.nn.get_datanode_report("live")
+    assert len(report) >= 3
+    assert all(r["st"] == "live" for r in report)
