@@ -80,13 +80,10 @@ class DFSClient:
     def create(self, path: str, overwrite: bool = False,
                replication: Optional[int] = None,
                block_size: Optional[int] = None) -> DFSOutputStream:
-        self.nn.create(path, self.client_name, replication, block_size,
-                       overwrite)
-        if block_size:
-            self._block_sizes[path] = block_size
-        else:
-            st = FileStatus.from_wire(self.nn.get_file_info(path))
-            self._block_sizes[path] = st.block_size
+        st = FileStatus.from_wire(
+            self.nn.create(path, self.client_name, replication, block_size,
+                           overwrite))
+        self._block_sizes[path] = st.block_size
         self._writer_opened()
         stream = DFSOutputStream(self, path)
         orig_close = stream.close

@@ -312,10 +312,6 @@ class DFSInputStream:
         self._pos = 0
         self._closed = False
         self._dead: Set[str] = set()
-        self._sock = None
-        self._sock_block: Optional[int] = None
-        self._chunk_buf = b""
-        self._chunk_buf_off = 0
 
     def _refresh_locations(self) -> None:
         info = self.client.get_block_locations(self.path)
@@ -355,9 +351,7 @@ class DFSInputStream:
         return bytes(out)
 
     def seek(self, pos: int) -> None:
-        if pos != self._pos:
-            self._pos = pos
-            self._close_block_sock()
+        self._pos = pos
 
     def tell(self) -> int:
         return self._pos
@@ -393,7 +387,6 @@ class DFSInputStream:
             except (OSError, EOFError, IOError) as e:
                 self._dead.add(dn.uuid)
                 errors.append(f"{dn}: {e}")
-            self._close_block_sock()
         # One refresh: replicas may have moved (re-replication).
         self._refresh_locations()
         self._dead.clear()
@@ -435,17 +428,8 @@ class DFSInputStream:
         finally:
             sock.close()
 
-    def _close_block_sock(self) -> None:
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None
-
     def close(self) -> None:
         self._closed = True
-        self._close_block_sock()
 
     def __enter__(self):
         return self

@@ -44,7 +44,13 @@ class Replica:
 
 
 class _OpenReplica:
-    """An rbw replica with open file handles, fed packet by packet."""
+    """An rbw replica with open file handles, fed packet by packet.
+
+    Block recovery may *steal* an open writer (ref: ReplicaInPipeline
+    .stopWriter): the store flushes + closes the handles under ``_io_lock``
+    and marks the writer stolen; the feeding xceiver's next write raises and
+    its teardown becomes a no-op, so recovery never races buffered data or
+    moves files out from under live handles."""
 
     def __init__(self, store: "BlockStore", block: Block, checksum: DataChecksum):
         self.store = store
@@ -58,24 +64,60 @@ class _OpenReplica:
         self._meta_f.write(_META_MAGIC + struct.pack(">q", block.gen_stamp)
                            + checksum.header())
         self.num_bytes = 0
+        self.stolen = False
+        self._io_lock = threading.Lock()
 
     def write_packet(self, data: bytes, sums: bytes) -> None:
-        self._data_f.write(data)
-        self._meta_f.write(sums)
-        self.num_bytes += len(data)
+        with self._io_lock:
+            if self.stolen:
+                raise IOError(f"writer of blk_{self.block_id} stopped by "
+                              f"block recovery")
+            self._data_f.write(data)
+            self._meta_f.write(sums)
+            self.num_bytes += len(data)
 
     def fsync(self) -> None:
+        with self._io_lock:
+            if self.stolen:
+                return
+            self._fsync_locked()
+
+    def _fsync_locked(self) -> None:
         self._data_f.flush()
         os.fsync(self._data_f.fileno())
         self._meta_f.flush()
         os.fsync(self._meta_f.fileno())
 
     def close(self) -> None:
+        with self._io_lock:
+            if self.stolen:
+                return
+            self._close_locked()
+        self.store._writer_closed(self)
+
+    def _close_locked(self) -> None:
         self._data_f.close()
         self._meta_f.close()
 
+    def steal(self) -> None:
+        """Flush + close + fence the writer (recovery path)."""
+        with self._io_lock:
+            if self.stolen:
+                return
+            try:
+                self._fsync_locked()
+            finally:
+                self._close_locked()
+                self.stolen = True
+        self.store._writer_closed(self)
+
     def abort(self) -> None:
-        self.close()
+        with self._io_lock:
+            if self.stolen:
+                return  # recovery owns the files now
+            self._close_locked()
+            self.stolen = True
+        self.store._writer_closed(self)
         for p in (self.data_path, self.meta_path):
             if os.path.exists(p):
                 os.remove(p)
@@ -88,6 +130,7 @@ class BlockStore:
         for sub in (Replica.RBW, Replica.FINALIZED):
             os.makedirs(os.path.join(directory, sub), exist_ok=True)
         self._replicas: Dict[int, Replica] = {}
+        self._open_writers: Dict[int, _OpenReplica] = {}
         self._lock = threading.Lock()
         self._scan()
 
@@ -127,6 +170,11 @@ class BlockStore:
     def create_rbw(self, block: Block, checksum: DataChecksum) -> _OpenReplica:
         with self._lock:
             existing = self._replicas.get(block.block_id)
+            stale_writer = self._open_writers.get(block.block_id)
+        if existing is not None and stale_writer is not None:
+            stale_writer.steal()  # fence the old pipeline's writer
+        with self._lock:
+            existing = self._replicas.get(block.block_id)
             if existing is not None:
                 if existing.state == Replica.FINALIZED:
                     raise IOError(f"block {block.block_id} already finalized")
@@ -135,7 +183,14 @@ class BlockStore:
                 del self._replicas[block.block_id]
             rep = Replica(block.block_id, block.gen_stamp, 0, Replica.RBW)
             self._replicas[block.block_id] = rep
-        return _OpenReplica(self, block, checksum)
+            writer = _OpenReplica(self, block, checksum)
+            self._open_writers[block.block_id] = writer
+            return writer
+
+    def _writer_closed(self, writer: "_OpenReplica") -> None:
+        with self._lock:
+            if self._open_writers.get(writer.block_id) is writer:
+                del self._open_writers[writer.block_id]
 
     def finalize(self, open_rep: _OpenReplica) -> Replica:
         """fsync + atomic move rbw → finalized.
@@ -165,6 +220,31 @@ class BlockStore:
         for path in (p, p + ".meta"):
             if os.path.exists(path):
                 os.remove(path)
+
+    def finalize_existing(self, block_id: int) -> Optional[Replica]:
+        """Block recovery: promote an rbw replica to finalized at its current
+        length. Stops a still-open writer first so buffered bytes reach disk
+        and the handles can't race the rename.
+        Ref: FsDatasetImpl.recoverRbw (stopWriter) + finalizeBlock."""
+        with self._lock:
+            writer = self._open_writers.get(block_id)
+        if writer is not None:
+            writer.steal()
+        with self._lock:
+            rep = self._replicas.get(block_id)
+            if rep is None:
+                raise ReplicaNotFoundError(str(block_id))
+            if rep.state == Replica.FINALIZED:
+                return rep
+            src = self._path(Replica.RBW, block_id)
+            dst = self._path(Replica.FINALIZED, block_id)
+            # The on-disk length is the truth: an interrupted pipeline leaves
+            # the in-memory record at 0 while the rbw file holds the data.
+            rep.num_bytes = os.path.getsize(src)
+            os.replace(src, dst)
+            os.replace(src + ".meta", dst + ".meta")
+            rep.state = Replica.FINALIZED
+            return rep
 
     def update_gen_stamp(self, block_id: int, new_gs: int) -> None:
         """Block recovery: bump the stamp in place (metadata rewrite)."""

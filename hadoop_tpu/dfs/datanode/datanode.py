@@ -198,10 +198,13 @@ class DataNode(AbstractService):
                 Daemon(self._transfer, "dn-transfer",
                        args=(block, targets)).start()
         elif cmd.action == DnCommand.RECOVER:
+            # Block recovery: bump the stamp and promote the rbw replica to
+            # finalized at its current length, then report it.
+            # Ref: DataNode.recoverBlocks / BlockRecoveryWorker.
             for block, new_gs in zip(cmd.blocks, cmd.new_gen_stamps):
                 try:
                     self.store.update_gen_stamp(block.block_id, new_gs)
-                    rep = self.store.get_replica(block.block_id)
+                    rep = self.store.finalize_existing(block.block_id)
                     if rep is not None:
                         with self._ibr_lock:
                             self._received.append(rep.to_block())

@@ -193,8 +193,17 @@ class DataXceiverServer:
             else:
                 open_rep.abort()
         except (OSError, EOFError) as e:
-            log.debug("write of %s aborted: %s", block, e)
-            open_rep.abort()
+            # Writer vanished mid-block. KEEP the partial rbw replica on
+            # disk — block recovery may finalize it at this length (the rbw
+            # directory exists exactly for this; ref: ReplicaBeingWritten
+            # surviving pipeline failure, BlockRecoveryWorker).
+            log.debug("write of %s interrupted: %s (rbw retained, %d bytes)",
+                      block, e, open_rep.num_bytes)
+            try:
+                open_rep.fsync()
+            except OSError:
+                pass
+            open_rep.close()
         finally:
             if down is not None:
                 responder_done.wait(timeout=5.0)

@@ -64,15 +64,19 @@ class BlockInfo:
     """Ref: blockmanagement/BlockInfo.java — block + owning file + replicas."""
 
     __slots__ = ("block", "inode", "expected_replication", "locations",
-                 "corrupt_replicas", "under_construction")
+                 "corrupt_replicas", "under_construction", "rbw_locations")
 
     def __init__(self, block: Block, inode, expected_replication: int):
         self.block = block
         self.inode = inode  # INodeFile back-reference (BlockCollection)
         self.expected_replication = expected_replication
-        self.locations: Set[str] = set()       # datanode uuids
+        self.locations: Set[str] = set()       # datanode uuids (finalized)
         self.corrupt_replicas: Set[str] = set()
         self.under_construction = True
+        # Expected pipeline members while under construction — where rbw
+        # replicas live, the targets block recovery contacts.
+        # Ref: BlockUnderConstructionFeature.expectedLocations.
+        self.rbw_locations: Set[str] = set()
 
     def live_replicas(self) -> int:
         return len(self.locations - self.corrupt_replicas)

@@ -82,6 +82,18 @@ class FileJournalManager(JournalManager):
     def start_segment(self, first_txid: int) -> None:
         assert self._f is None, "segment already open"
         path = os.path.join(self.dir, f"edits_inprogress_{first_txid}")
+        if os.path.exists(path):
+            # Crash recovery: a torn partial frame at the tail must be
+            # physically truncated before appending, or edits written after
+            # it would be unreachable on the next replay (the reader stops
+            # at the first bad frame). Ref: EditLogFileOutputStream recovery
+            # + FSEditLogLoader recovery mode.
+            valid = _valid_prefix_len(path)
+            if valid < os.path.getsize(path):
+                log.warning("Truncating torn edit segment %s from %d to %d "
+                            "bytes", path, os.path.getsize(path), valid)
+                with open(path, "r+b") as f:
+                    f.truncate(valid)
         self._f = open(path, "ab")
         self._inprogress_first = first_txid
 
@@ -144,6 +156,24 @@ class FileJournalManager(JournalManager):
             self._f = None
 
 
+def _valid_prefix_len(path: str) -> int:
+    """Byte length of the longest prefix of whole, decodable frames."""
+    with open(path, "rb") as f:
+        data = f.read()
+    off = 0
+    n = len(data)
+    while n - off >= 4:
+        (flen,) = struct.unpack_from(">I", data, off)
+        if n - off - 4 < flen:
+            break
+        try:
+            unpack(data[off + 4: off + 4 + flen])
+        except Exception:
+            break
+        off += 4 + flen
+    return off
+
+
 def _read_segment_file(path: str, from_txid: int) -> Iterator[Dict]:
     """Frame-by-frame read tolerating a torn tail (crash mid-write)."""
     with open(path, "rb") as f:
@@ -204,35 +234,44 @@ class FSEditLog:
     def close(self) -> None:
         if not self._open:
             return
-        self.log_sync(self._txid)
-        with self._lock:
+        # _sync_lock serializes against concurrent log_sync; the internal
+        # flush covers any buffered edits. A log_edit racing close() would be
+        # a namesystem bug (mutations after shutdown), not an editlog one.
+        with self._sync_lock:
+            last = self._flush_and_sync_locked()
             first = self._segment_first
-            last = self._txid
-        if first is not None and last >= first:
-            self.journal.finalize_segment(first, last)
-        self._open = False
-        self.journal.close()
+            self._open = False
+            if first is not None and last >= first:
+                self.journal.finalize_segment(first, last)
+            self.journal.close()
 
     def roll(self) -> int:
         """Finalize the current segment and start a new one (checkpointing
         boundary). Ref: FSEditLog.rollEditLog. Returns first txid of the new
-        segment."""
-        self.log_sync(self._txid)
-        with self._lock:
-            first, last = self._segment_first, self._txid
+        segment.
+
+        Holds _sync_lock across flush + finalize + restart so a concurrent
+        log_sync can neither write into a closing segment nor observe the
+        journal handle mid-swap; the txid boundary is captured atomically
+        with the buffer drain, so every txid <= boundary is in the finalized
+        segment and every later txid lands in the new one."""
+        with self._sync_lock:
+            last = self._flush_and_sync_locked()
+            first = self._segment_first
             new_first = last + 1
             self._segment_first = new_first
-        if last >= first:
-            self.journal.finalize_segment(first, last)
-        else:
-            self.journal.close()
-            # Empty in-progress segment: remove and restart.
-            p = os.path.join(self.journal.dir, f"edits_inprogress_{first}")
-            if os.path.exists(p):
-                os.remove(p)
-        self.journal.start_segment(new_first)
-        self.journal.write_seen_txid(new_first)
-        return new_first
+            if last >= first:
+                self.journal.finalize_segment(first, last)
+            else:
+                self.journal.close()
+                # Empty in-progress segment: remove and restart.
+                p = os.path.join(self.journal.dir,
+                                 f"edits_inprogress_{first}")
+                if os.path.exists(p):
+                    os.remove(p)
+            self.journal.start_segment(new_first)
+            self.journal.write_seen_txid(new_first)
+            return new_first
 
     # -------------------------------------------------------------- logging
 
@@ -273,19 +312,26 @@ class FSEditLog:
             # waited for the sync lock — that's the batching win.
             if self._synced_txid >= txid:
                 return
-            with self._lock:
-                buf = bytes(self._buf)
-                first = self._buf_first_txid
-                count = self._buf_count
-                sync_to = self._txid
-                self._buf = bytearray()
-                self._buf_first_txid = None
-                self._buf_count = 0
-            if buf:
-                self.journal.journal(buf, first, count)
+            self._flush_and_sync_locked()
+
+    def _flush_and_sync_locked(self) -> int:
+        """Drain the buffer + fsync. Caller holds _sync_lock. Returns the
+        txid boundary covered (atomic with the buffer capture)."""
+        with self._lock:
+            buf = bytes(self._buf)
+            first = self._buf_first_txid
+            count = self._buf_count
+            sync_to = self._txid
+            self._buf = bytearray()
+            self._buf_first_txid = None
+            self._buf_count = 0
+        if buf:
+            self.journal.journal(buf, first, count)
+        if self._open:
             with self._m_sync_time.time():
                 self.journal.sync()
-            self._synced_txid = sync_to
-            self._m_syncs.incr()
-            if count > 1:
-                self._m_batched.incr(count - 1)
+        self._synced_txid = sync_to
+        self._m_syncs.incr()
+        if count > 1:
+            self._m_batched.incr(count - 1)
+        return sync_to

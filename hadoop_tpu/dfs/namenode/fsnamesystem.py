@@ -61,6 +61,7 @@ class FSNamesystem:
         self._next_block_id = 1 << 30   # ref: SequentialBlockIdGenerator
         self._gen_stamp = 1000          # ref: GenerationStamp
         self._id_lock = threading.Lock()
+        self._pending_recovery: set = set()  # paths mid block-recovery
         reg = metrics_system().source("namenode.ops")
         self._m = {name: reg.rate(name) for name in
                    ("create", "add_block", "complete", "get_block_locations",
@@ -219,6 +220,7 @@ class FSNamesystem:
                         f"(live={len(self.bm.dn_manager.live_nodes())})")
                 info = self.bm.add_block_collection(block, inode,
                                                     inode.replication)
+                info.rbw_locations = {t.uuid for t in targets}
                 inode.blocks.append(block)
                 txid = self.editlog.log_edit(el.OP_ADD_BLOCK, {
                     "p": path, "b": block.to_wire()})
@@ -321,34 +323,89 @@ class FSNamesystem:
             self._recover_lease_locked(path, inode)
             return not inode.under_construction
 
-    def _recover_lease_locked(self, path: str, inode: INodeFile) -> None:
-        """Close an abandoned under-construction file with its durable blocks.
+    def _recover_lease_locked(self, path: str, inode: INodeFile) -> bool:
+        """Release an abandoned under-construction file. Two phases, like the
+        reference (ref: FSNamesystem.internalReleaseLease →
+        BlockUnderConstructionFeature.initializeBlockRecovery):
 
-        Trailing under-construction blocks with no finalized replica are
-        dropped: nothing durable is known about them (the reference instead
-        runs DN-side block recovery to agree on the rbw length —
-        ref: FSNamesystem.internalReleaseLease → initializeBlockRecovery;
-        un-hflushed data carries no durability guarantee either way)."""
+        1. The trailing UC block has no finalized replica but known pipeline
+           members → issue RECOVER commands (gen-stamp bump; each DN
+           finalizes its rbw replica at its length and reports it) and leave
+           the file open-pending; a later pass closes it.
+        2. Finalized replicas exist (or recovery completed) → commit lengths
+           and close. A trailing block nothing durable is known about is
+           dropped.
+
+        Returns True when the file is closed.
+        """
         holder = self.leases.holder_of(path)
         if holder:
             self.leases.remove_lease(holder, path)
-        while inode.blocks:
-            last = inode.blocks[-1]
+        last = inode.last_block()
+        if last is not None:
             info = self.bm.get(last.block_id)
             if info is not None and info.under_construction and \
                     info.live_replicas() == 0:
+                if info.rbw_locations and \
+                        self._start_block_recovery_locked(path, info):
+                    return False  # recovery in flight; close on a later pass
+                # Nothing recoverable: drop the trailing block.
                 inode.blocks.pop()
                 self.bm.remove_block(last)
-            else:
-                break
+        self._pending_recovery.discard(path)
         inode.under_construction = False
         inode.client_name = None
         for b in inode.blocks:
+            info = self.bm.get(b.block_id)
+            if info is not None and info.block.num_bytes > b.num_bytes:
+                b.num_bytes = info.block.num_bytes  # recovered length
             self.bm.complete_block(b)
         txid = self.editlog.log_edit(el.OP_CLOSE, {
             "p": path, "b": [b.to_wire() for b in inode.blocks]})
         self.editlog.log_sync(txid)
         log.info("Recovered lease on %s (was held by %s)", path, holder)
+        return True
+
+    def _start_block_recovery_locked(self, path: str,
+                                     info) -> bool:
+        """Queue RECOVER commands to the expected pipeline members.
+        Returns False when no member is live (recovery impossible)."""
+        nodes = [self.bm.dn_manager.get(u) for u in info.rbw_locations]
+        nodes = [n for n in nodes if n is not None
+                 and n.state != "dead"]
+        if not nodes:
+            return False
+        if path in self._pending_recovery:
+            return True  # already issued; waiting for reports
+        new_gs = self.next_gen_stamp()
+        old_block = Block(info.block.block_id, info.block.gen_stamp,
+                          info.block.num_bytes)
+        info.block.gen_stamp = new_gs
+        for b in info.inode.blocks:
+            if b.block_id == info.block.block_id:
+                b.gen_stamp = new_gs
+        for node in nodes:
+            node.recover_queue.append((old_block, new_gs))
+        self._pending_recovery.add(path)
+        log.info("Started block recovery of %s for %s on %d nodes "
+                 "(gs %d -> %d)", info.block, path, len(nodes),
+                 old_block.gen_stamp, new_gs)
+        return True
+
+    def check_pending_recoveries(self) -> None:
+        """Second phase of lease recovery: close files whose block recovery
+        reported back. Ref: commitBlockSynchronization's role."""
+        for path in list(self._pending_recovery):
+            with self.lock.write():
+                inode = self.fsdir.get_inode(path)
+                if inode is None or not isinstance(inode, INodeFile) or \
+                        not inode.under_construction:
+                    self._pending_recovery.discard(path)
+                    continue
+                last = inode.last_block()
+                info = self.bm.get(last.block_id) if last else None
+                if info is not None and info.live_replicas() > 0:
+                    self._recover_lease_locked(path, inode)
 
     def check_leases(self) -> None:
         """Periodic hard-limit sweep. Ref: LeaseManager.Monitor."""
@@ -357,6 +414,7 @@ class FSNamesystem:
                 inode = self.fsdir.get_inode(path)
                 if isinstance(inode, INodeFile) and inode.under_construction:
                     self._recover_lease_locked(path, inode)
+        self.check_pending_recoveries()
 
     # ------------------------------------------------------------ reads
 
@@ -435,9 +493,8 @@ class FSNamesystem:
         node = self.fsdir.delete(path, recursive)
         if node is None:
             return False
-        holder = self.leases.holder_of(path)
-        if holder:
-            self.leases.remove_lease(holder, path)
+        # Open files anywhere under the deleted subtree lose their leases.
+        self.leases.remove_under(path)
         for b in collect_blocks(node):
             self.bm.remove_block(b)
         return True
@@ -446,8 +503,8 @@ class FSNamesystem:
         with self._m["rename"].time():
             with self.lock.write():
                 self._check_not_safemode("rename")
-                self.fsdir.rename(src, dst)
-                self.leases.rename_path(src, dst)
+                actual_dst = self.fsdir.rename(src, dst)
+                self.leases.rename_path(src, actual_dst)
                 txid = self.editlog.log_edit(el.OP_RENAME,
                                              {"s": src, "d": dst})
             self.editlog.log_sync(txid)
@@ -560,12 +617,10 @@ class FSNamesystem:
         elif op == el.OP_DELETE:
             node = self.fsdir.delete(rec["p"], rec.get("r", True))
             if node is not None:
-                holder = self.leases.holder_of(rec["p"])
-                if holder:
-                    self.leases.remove_lease(holder, rec["p"])
+                self.leases.remove_under(rec["p"])
         elif op == el.OP_RENAME:
-            self.fsdir.rename(rec["s"], rec["d"])
-            self.leases.rename_path(rec["s"], rec["d"])
+            actual = self.fsdir.rename(rec["s"], rec["d"])
+            self.leases.rename_path(rec["s"], actual)
         elif op == el.OP_SET_REPLICATION:
             inode = self.fsdir.get_inode(rec["p"])
             if isinstance(inode, INodeFile):

@@ -187,9 +187,10 @@ class FSDirectory:
         self._inode_count -= sum(1 for _ in iter_tree(node))
         return node
 
-    def rename(self, src: str, dst: str) -> None:
+    def rename(self, src: str, dst: str) -> str:
         """POSIX-ish rename. Ref: FSDirectory.renameTo (RENAME semantics:
-        fail if dst exists; moving into an existing dir targets dst/basename)."""
+        fail if dst exists; moving into an existing dir targets dst/basename).
+        Returns the actual destination path (after into-dir adjustment)."""
         node = self.get_inode(src)
         if node is None:
             raise FileNotFoundError(f"rename source {src} not found")
@@ -209,6 +210,7 @@ class FSDirectory:
         node.parent.remove_child(node.name)
         node.name = _components(dst)[-1]
         dst_parent.add_child(node)
+        return dst
 
     # ------------------------------------------------------------- queries
 

@@ -68,14 +68,33 @@ class LeaseManager:
             return self._path_to_holder.get(path)
 
     def rename_path(self, old: str, new: str) -> None:
+        """Re-key leases for a renamed path AND everything under it (a
+        directory rename moves open files with it).
+        Ref: LeaseManager.renameLease / getINodeWithLeases subtree walk."""
+        old_prefix = old.rstrip("/") + "/"
+        new_base = new.rstrip("/")
         with self._lock:
-            holder = self._path_to_holder.pop(old, None)
-            if holder is not None:
-                self._path_to_holder[new] = holder
+            moves = [(p, h) for p, h in self._path_to_holder.items()
+                     if p == old or p.startswith(old_prefix)]
+            for path, holder in moves:
+                newp = new_base + path[len(old.rstrip("/")):] \
+                    if path != old else new
+                del self._path_to_holder[path]
+                self._path_to_holder[newp] = holder
                 lease = self._leases.get(holder)
                 if lease is not None:
-                    lease.paths.discard(old)
-                    lease.paths.add(new)
+                    lease.paths.discard(path)
+                    lease.paths.add(newp)
+
+    def remove_under(self, root: str) -> None:
+        """Drop leases for a path and its whole subtree (deletion).
+        Ref: LeaseManager.removeLeases."""
+        prefix = root.rstrip("/") + "/"
+        with self._lock:
+            doomed = [(p, h) for p, h in self._path_to_holder.items()
+                      if p == root or p.startswith(prefix)]
+        for path, holder in doomed:
+            self.remove_lease(holder, path)
 
     def is_soft_expired(self, path: str) -> bool:
         """May another writer preempt this lease? Ref: soft limit check in

@@ -1,0 +1,109 @@
+"""Distributed shell — the canonical YARN application.
+
+Parity with the reference's distributedshell (ref:
+hadoop-yarn-applications-distributedshell/.../ApplicationMaster.java:199,
+Client.java): a client submits an app whose AM requests N containers and runs
+one shell command in each; the AM tracks completions and unregisters. It is
+both an example and the scheduler's acceptance test.
+
+Run a command on 3 containers:
+    from hadoop_tpu.examples.distributed_shell import submit
+    app_id = submit(rm_addr, ["bash", "-c", "hostname"], n=3)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import List, Optional, Tuple
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.yarn.client import AMRMClient, NMClient, YarnClient
+from hadoop_tpu.yarn.records import (ApplicationSubmissionContext,
+                                     ContainerLaunchContext, Resource)
+
+TASK_PRIORITY = 1
+
+
+def submit(rm_addr: Tuple[str, int], command: List[str], n: int = 1,
+           resource: Optional[Resource] = None, queue: str = "default",
+           name: str = "distributed-shell",
+           conf: Optional[Configuration] = None,
+           env: Optional[dict] = None):
+    """Client side. Ref: distributedshell/Client.java."""
+    conf = conf or Configuration()
+    yc = YarnClient(rm_addr, conf)
+    try:
+        app_id, _ = yc.create_application()
+        am_env = {
+            "PYTHONPATH": _repo_root(),
+            "HTPU_DSHELL_N": str(n),
+            "HTPU_DSHELL_CMD": "\x1f".join(command),
+            "HTPU_DSHELL_MEM": str((resource or Resource(128, 1)).memory_mb),
+            "HTPU_DSHELL_VCORES": str((resource or Resource(128, 1)).vcores),
+            "HTPU_DSHELL_TPU": str((resource or Resource(128, 1)).tpu_chips),
+        }
+        if env:
+            am_env.update(env)
+        ctx = ApplicationSubmissionContext(
+            app_id, name,
+            ContainerLaunchContext(
+                [sys.executable, "-m",
+                 "hadoop_tpu.examples.distributed_shell", "--am"], am_env),
+            am_resource=Resource(256, 1), queue=queue)
+        yc.submit_application(ctx)
+        return app_id
+    finally:
+        yc.close()
+
+
+def _repo_root() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    existing = os.environ.get("PYTHONPATH", "")
+    return f"{here}:{existing}" if existing else here
+
+
+def am_main() -> int:
+    """AM side. Ref: distributedshell/ApplicationMaster.java:199."""
+    n = int(os.environ["HTPU_DSHELL_N"])
+    command = os.environ["HTPU_DSHELL_CMD"].split("\x1f")
+    resource = Resource(int(os.environ.get("HTPU_DSHELL_MEM", "128")),
+                        int(os.environ.get("HTPU_DSHELL_VCORES", "1")),
+                        int(os.environ.get("HTPU_DSHELL_TPU", "0")))
+    amrm = AMRMClient.from_env()
+    nm = NMClient()
+    amrm.register()
+    amrm.add_request(TASK_PRIORITY, n, resource)
+    launched = 0
+    completed = 0
+    failed = 0
+    deadline = time.monotonic() + 600
+    while completed < n and time.monotonic() < deadline:
+        allocated, done = amrm.allocate(progress=completed / max(n, 1))
+        for container in allocated:
+            if launched >= n:
+                amrm.release(container.container_id)
+                continue
+            env = {"HTPU_SHELL_INDEX": str(launched)}
+            nm.start_container(container,
+                               ContainerLaunchContext(command, env))
+            launched += 1
+        for status in done:
+            completed += 1
+            if status.exit_code != 0:
+                failed += 1
+        time.sleep(0.1)
+    status = "SUCCEEDED" if failed == 0 and completed >= n else "FAILED"
+    amrm.unregister(status, f"{completed} done, {failed} failed")
+    amrm.close()
+    nm.close()
+    return 0 if status == "SUCCEEDED" else 1
+
+
+if __name__ == "__main__":
+    if "--am" in sys.argv:
+        sys.exit(am_main())
+    print("use submit() from code, or --am inside a container", file=sys.stderr)
+    sys.exit(2)

@@ -36,6 +36,11 @@ Address = Tuple[str, int]
 MAX_CLIENT_FRAME = 128 * 1024 * 1024  # mirror of server-side MAX_FRAME
 
 
+class _ConnClosedBeforeSend(RpcError):
+    """The cached connection closed (idle reaper, races) before the request
+    hit the socket — always safe to transparently retry once."""
+
+
 class _PendingCall:
     __slots__ = ("event", "response", "error")
 
@@ -112,9 +117,23 @@ class _Connection:
                 return
             if not ready:
                 # Idle (or very slow peer). With calls in flight, probe
-                # liveness; with none, close once past the idle limit.
+                # liveness; with none, close once past the idle limit. The
+                # idle decision is made under calls_lock and marks the
+                # connection dead atomically, so a racing send_call either
+                # sees dead (and retries on a fresh connection — nothing was
+                # sent) or registers first (and we don't close).
+                close_idle = False
                 with self.calls_lock:
                     outstanding = len(self.calls)
+                    if outstanding == 0 and \
+                            time.monotonic() - self.last_activity > \
+                            self.max_idle_s:
+                        self.dead = True
+                        close_idle = True
+                if close_idle:
+                    self._fail_all(RpcError(
+                        f"connection to {self.addr} idle-closed"))
+                    return
                 if outstanding:
                     try:
                         self.ping()
@@ -122,10 +141,6 @@ class _Connection:
                         self._fail_all(RpcError(
                             f"connection to {self.addr} failed ping probe"))
                         return
-                elif time.monotonic() - self.last_activity > self.max_idle_s:
-                    self._fail_all(RpcError(
-                        f"connection to {self.addr} idle-closed"))
-                    return
                 continue
             try:
                 chunk = self.sock.recv(256 * 1024)
@@ -195,7 +210,10 @@ class _Connection:
         pend = _PendingCall()
         with self.calls_lock:
             if self.dead:
-                raise RpcError(f"connection to {self.addr} is closed")
+                # Nothing was sent: the caller may safely retry on a fresh
+                # connection even for non-idempotent methods.
+                raise _ConnClosedBeforeSend(
+                    f"connection to {self.addr} closed before send")
             self.calls[call_id] = pend
         payload = pack(req)
         data = struct.pack(">I", len(payload)) + payload
@@ -275,19 +293,26 @@ class Client:
         """One RPC round trip. Raises the remote exception (resolved to a
         local class when registered), RpcTimeoutError, or RpcError."""
         user = user or current_user()
-        conn = self._get_connection(addr, protocol, user)
-        call_id = self._next_call_id()
         span = current_span()
-        req: Dict[str, Any] = {
-            "id": call_id, "p": protocol, "m": method, "a": list(args),
-            "cid": self.client_id, "rc": retry_count,
-            "sid": conn.last_state_id,
-        }
-        if kwargs:
-            req["kw"] = kwargs
-        if span is not None:
-            req["t"] = span.context().to_wire()
-        pend = conn.send_call(call_id, req)
+        for attempt in range(3):
+            conn = self._get_connection(addr, protocol, user)
+            call_id = self._next_call_id()
+            req: Dict[str, Any] = {
+                "id": call_id, "p": protocol, "m": method, "a": list(args),
+                "cid": self.client_id, "rc": retry_count,
+                "sid": conn.last_state_id,
+            }
+            if kwargs:
+                req["kw"] = kwargs
+            if span is not None:
+                req["t"] = span.context().to_wire()
+            try:
+                pend = conn.send_call(call_id, req)
+                break
+            except _ConnClosedBeforeSend:
+                if attempt == 2:
+                    raise
+                continue  # fresh connection; nothing was sent
         timeout = self.default_timeout if timeout is None else timeout
         if not pend.event.wait(timeout):
             with conn.calls_lock:

@@ -1,3 +1,3 @@
-from hadoop_tpu.testing.minicluster import MiniDFSCluster
+from hadoop_tpu.testing.minicluster import MiniDFSCluster, MiniYARNCluster
 
-__all__ = ["MiniDFSCluster"]
+__all__ = ["MiniDFSCluster", "MiniYARNCluster"]

@@ -1,0 +1,8 @@
+"""hadoop_tpu.yarn — cluster resource management.
+
+Capability-equivalent rebuild of YARN (ref: hadoop-yarn-project): a
+ResourceManager (app lifecycle state machines over an async dispatcher,
+pluggable FIFO/capacity schedulers, AM liveness), node agents that launch
+containers as real processes with TPU chips as a first-class resource
+dimension, and client libraries (YarnClient / AMRMClient / NMClient).
+"""

@@ -1,0 +1,164 @@
+"""YARN client libraries: YarnClient, AMRMClient, NMClient.
+
+Parity with the reference client layer (ref: hadoop-yarn-client
+YarnClientImpl.java:333 submitApplication (+ polling loop :384),
+AMRMClient.java / AMRMClientImpl, NMClientImpl.java).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.ipc import Client, get_proxy
+from hadoop_tpu.yarn.records import (ApplicationId, ApplicationReport,
+                                     ApplicationSubmissionContext, AppState,
+                                     Container, ContainerId,
+                                     ContainerLaunchContext, ContainerStatus,
+                                     Resource, ResourceRequest)
+
+log = logging.getLogger(__name__)
+
+
+class YarnClient:
+    """Ref: YarnClientImpl.java."""
+
+    def __init__(self, rm_addr: Tuple[str, int],
+                 conf: Optional[Configuration] = None):
+        self.conf = conf or Configuration()
+        self._client = Client(self.conf)
+        self.rm = get_proxy("ClientRMProtocol", rm_addr, client=self._client)
+
+    def create_application(self) -> Tuple[ApplicationId, Resource]:
+        resp = self.rm.get_new_application()
+        return (ApplicationId.from_wire(resp["app_id"]),
+                Resource.from_wire(resp["max_resource"]))
+
+    def submit_application(self, ctx: ApplicationSubmissionContext,
+                           wait_accepted: bool = True,
+                           timeout: float = 30.0) -> ApplicationId:
+        """Submit + poll until past NEW/SUBMITTED.
+        Ref: YarnClientImpl.submitApplication:333 (poll :384)."""
+        self.rm.submit_application(ctx.to_wire())
+        if wait_accepted:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                report = self.application_report(ctx.app_id)
+                if report.state not in (AppState.NEW, AppState.SUBMITTED):
+                    return ctx.app_id
+                time.sleep(0.1)
+            raise TimeoutError(f"{ctx.app_id} still not accepted")
+        return ctx.app_id
+
+    def application_report(self, app_id: ApplicationId) -> ApplicationReport:
+        return ApplicationReport.from_wire(
+            self.rm.get_application_report(app_id.to_wire()))
+
+    def wait_for_completion(self, app_id: ApplicationId,
+                            timeout: float = 300.0) -> ApplicationReport:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            report = self.application_report(app_id)
+            if report.state in AppState.TERMINAL:
+                return report
+            time.sleep(0.2)
+        raise TimeoutError(f"{app_id} did not finish in {timeout}s")
+
+    def kill_application(self, app_id: ApplicationId) -> None:
+        self.rm.kill_application(app_id.to_wire())
+
+    def list_applications(self) -> List[ApplicationReport]:
+        return [ApplicationReport.from_wire(d)
+                for d in self.rm.list_applications()]
+
+    def cluster_metrics(self) -> Dict:
+        return self.rm.get_cluster_metrics()
+
+    def nodes(self) -> List[Dict]:
+        return self.rm.get_nodes()
+
+    def close(self) -> None:
+        self._client.stop()
+
+
+class AMRMClient:
+    """The AM's RM-facing helper: ask/release bookkeeping around the
+    allocate heartbeat. Ref: AMRMClientImpl.java."""
+
+    def __init__(self, attempt_id: str, rm_addr: Tuple[str, int],
+                 conf: Optional[Configuration] = None):
+        self.attempt_id = attempt_id
+        self.conf = conf or Configuration()
+        self._client = Client(self.conf)
+        self.rm = get_proxy("AMRMProtocol", rm_addr, client=self._client)
+        self._asks: List[ResourceRequest] = []
+        self._releases: List[ContainerId] = []
+
+    @classmethod
+    def from_env(cls, conf: Optional[Configuration] = None) -> "AMRMClient":
+        """Inside an AM container, identity arrives via env (set by the
+        AMLauncher — ref: ApplicationConstants.Environment)."""
+        attempt_id = os.environ["HTPU_ATTEMPT_ID"]
+        host, port = os.environ["HTPU_RM_ADDRESS"].rsplit(":", 1)
+        return cls(attempt_id, (host, int(port)), conf)
+
+    def register(self, tracking_url: str = "") -> Dict:
+        return self.rm.register_application_master(self.attempt_id,
+                                                   tracking_url)
+
+    def add_request(self, priority: int, count: int, capability: Resource,
+                    host: str = "*") -> None:
+        self._asks.append(ResourceRequest(priority, count, capability, host))
+
+    def release(self, container_id: ContainerId) -> None:
+        self._releases.append(container_id)
+
+    def allocate(self, progress: float = 0.0
+                 ) -> Tuple[List[Container], List[ContainerStatus]]:
+        asks, self._asks = self._asks, []
+        releases, self._releases = self._releases, []
+        resp = self.rm.allocate(self.attempt_id,
+                                [a.to_wire() for a in asks],
+                                [r.to_wire() for r in releases], progress)
+        return ([Container.from_wire(c) for c in resp["allocated"]],
+                [ContainerStatus.from_wire(s) for s in resp["completed"]])
+
+    def unregister(self, final_status: str = "SUCCEEDED",
+                   diagnostics: str = "") -> None:
+        self.rm.finish_application_master(self.attempt_id, final_status,
+                                          diagnostics)
+
+    def close(self) -> None:
+        self._client.stop()
+
+
+class NMClient:
+    """Start/stop containers on node agents. Ref: NMClientImpl.java."""
+
+    def __init__(self, conf: Optional[Configuration] = None):
+        self.conf = conf or Configuration()
+        self._client = Client(self.conf)
+
+    def _nm(self, container: Container):
+        host, port = container.nm_address.rsplit(":", 1)
+        return get_proxy("ContainerManagerProtocol", (host, int(port)),
+                         client=self._client)
+
+    def start_container(self, container: Container,
+                        ctx: ContainerLaunchContext) -> None:
+        self._nm(container).start_container(container.to_wire(),
+                                            ctx.to_wire())
+
+    def stop_container(self, container: Container) -> None:
+        self._nm(container).stop_container(container.container_id.to_wire())
+
+    def container_status(self, container: Container) -> Optional[ContainerStatus]:
+        d = self._nm(container).get_container_status(
+            container.container_id.to_wire())
+        return None if d is None else ContainerStatus.from_wire(d)
+
+    def close(self) -> None:
+        self._client.stop()

@@ -1,0 +1,160 @@
+"""Event bus + declarative state machines — the RM/NM substrate.
+
+Parity with yarn-common's core machinery (ref:
+yarn/event/AsyncDispatcher.java:51, yarn/state/StateMachineFactory.java:46):
+every daemon-side lifecycle object (app, attempt, container) is a state
+machine whose transitions fire on events delivered by a single dispatcher
+thread — serialization by design, no per-object locking.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from hadoop_tpu.service import AbstractService
+
+log = logging.getLogger(__name__)
+
+
+class Event:
+    __slots__ = ("etype", "payload")
+
+    def __init__(self, etype: str, payload: Any = None):
+        self.etype = etype
+        self.payload = payload
+
+    def __repr__(self):
+        return f"Event({self.etype})"
+
+
+class AsyncDispatcher(AbstractService):
+    """Single-threaded event loop with per-type handler registry.
+    Ref: yarn/event/AsyncDispatcher.java."""
+
+    def __init__(self, name: str = "dispatcher"):
+        super().__init__(name)
+        self._queue: "queue.Queue[Optional[Tuple[str, Event]]]" = queue.Queue()
+        self._handlers: Dict[str, Callable[[Event], None]] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._drained = threading.Event()
+
+    def register(self, category: str, handler: Callable[[Event], None]) -> None:
+        self._handlers[category] = handler
+
+    def dispatch(self, category: str, event: Event) -> None:
+        self._queue.put((category, event))
+
+    def handler(self, category: str) -> Callable[[Event], None]:
+        return lambda ev: self.dispatch(category, ev)
+
+    def service_start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"{self.name}-thread")
+        self._thread.start()
+
+    def service_stop(self) -> None:
+        self._queue.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            category, event = item
+            handler = self._handlers.get(category)
+            if handler is None:
+                log.warning("No handler for category %r (%r)", category, event)
+                continue
+            try:
+                handler(event)
+            except Exception:
+                # Ref: AsyncDispatcher logs & continues (RM crash-on-error is
+                # opt-in via yarn.dispatcher.exit-on-error).
+                log.exception("Error dispatching %r to %r", event, category)
+
+    def drain(self, timeout: float = 5.0) -> bool:
+        """Test helper: wait until the queue momentarily empties."""
+        import time
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._queue.empty():
+                return True
+            time.sleep(0.01)
+        return False
+
+
+class InvalidStateTransitionError(RuntimeError):
+    def __init__(self, state: str, event: str):
+        super().__init__(f"invalid event {event!r} in state {state!r}")
+        self.state = state
+        self.event = event
+
+
+class StateMachineFactory:
+    """Declarative transition table, instantiated per stateful object.
+
+    Ref: yarn/state/StateMachineFactory.java — addTransition(pre, post,
+    event, hook) with multi-post-state transitions whose hook returns the
+    actual post state.
+
+        factory = (StateMachineFactory("NEW")
+            .add("NEW", "SUBMITTED", "start", on_start)
+            .add("SUBMITTED", ("ACCEPTED", "FAILED"), "attempt_added", pick))
+        sm = factory.make(owner)
+        sm.handle("start", payload)
+    """
+
+    def __init__(self, initial_state: str):
+        self.initial_state = initial_state
+        # (state, event) -> (post_states tuple, hook)
+        self._table: Dict[Tuple[str, str], Tuple[Tuple[str, ...], Optional[Callable]]] = {}
+
+    def add(self, pre: str, post, event: str,
+            hook: Optional[Callable] = None) -> "StateMachineFactory":
+        posts = (post,) if isinstance(post, str) else tuple(post)
+        self._table[(pre, event)] = (posts, hook)
+        return self
+
+    def add_many(self, pres: List[str], post, event: str,
+                 hook: Optional[Callable] = None) -> "StateMachineFactory":
+        for pre in pres:
+            self.add(pre, post, event, hook)
+        return self
+
+    def make(self, owner: Any) -> "StateMachine":
+        return StateMachine(self, owner)
+
+
+class StateMachine:
+    def __init__(self, factory: StateMachineFactory, owner: Any):
+        self._factory = factory
+        self.owner = owner
+        self.state = factory.initial_state
+
+    def handle(self, event: str, payload: Any = None) -> str:
+        key = (self.state, event)
+        entry = self._factory._table.get(key)
+        if entry is None:
+            raise InvalidStateTransitionError(self.state, event)
+        posts, hook = entry
+        if hook is None:
+            assert len(posts) == 1, "multi-state transition requires a hook"
+            self.state = posts[0]
+            return self.state
+        result = hook(self.owner, payload)
+        if len(posts) == 1:
+            self.state = posts[0]
+        else:
+            if result not in posts:
+                raise RuntimeError(
+                    f"hook returned {result!r}, not one of {posts}")
+            self.state = result
+        return self.state
+
+    def can_handle(self, event: str) -> bool:
+        return (self.state, event) in self._factory._table

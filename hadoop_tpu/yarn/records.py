@@ -1,0 +1,333 @@
+"""YARN protocol records.
+
+Parity with yarn-api's record types (ref: hadoop-yarn-api
+ApplicationId.java, Resource.java, Container.java,
+ContainerLaunchContext.java, ApplicationSubmissionContext.java,
+NodeReport.java; protos yarn_protos.proto). TPU-first deviation: ``Resource``
+carries ``tpu_chips`` as a first-class dimension next to memory/vcores — the
+role GPUs play via the reference's pluggable resource types
+(ref: nodemanager resourceplugin/, resource-types.xml mechanism).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+
+class Resource:
+    __slots__ = ("memory_mb", "vcores", "tpu_chips")
+
+    def __init__(self, memory_mb: int = 0, vcores: int = 0, tpu_chips: int = 0):
+        self.memory_mb = memory_mb
+        self.vcores = vcores
+        self.tpu_chips = tpu_chips
+
+    def fits_in(self, other: "Resource") -> bool:
+        return (self.memory_mb <= other.memory_mb
+                and self.vcores <= other.vcores
+                and self.tpu_chips <= other.tpu_chips)
+
+    def add(self, other: "Resource") -> "Resource":
+        return Resource(self.memory_mb + other.memory_mb,
+                        self.vcores + other.vcores,
+                        self.tpu_chips + other.tpu_chips)
+
+    def subtract(self, other: "Resource") -> "Resource":
+        return Resource(self.memory_mb - other.memory_mb,
+                        self.vcores - other.vcores,
+                        self.tpu_chips - other.tpu_chips)
+
+    def dominant_share(self, total: "Resource") -> float:
+        """Dominant resource fairness share (ref: DominantResourceCalculator)."""
+        shares = []
+        if total.memory_mb:
+            shares.append(self.memory_mb / total.memory_mb)
+        if total.vcores:
+            shares.append(self.vcores / total.vcores)
+        if total.tpu_chips:
+            shares.append(self.tpu_chips / total.tpu_chips)
+        return max(shares) if shares else 0.0
+
+    def is_empty(self) -> bool:
+        return self.memory_mb <= 0 and self.vcores <= 0 and self.tpu_chips <= 0
+
+    def to_wire(self) -> Dict:
+        return {"m": self.memory_mb, "v": self.vcores, "t": self.tpu_chips}
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "Resource":
+        return cls(d.get("m", 0), d.get("v", 0), d.get("t", 0))
+
+    def __eq__(self, o):
+        return (isinstance(o, Resource) and o.memory_mb == self.memory_mb
+                and o.vcores == self.vcores and o.tpu_chips == self.tpu_chips)
+
+    def __repr__(self):
+        s = f"<mem {self.memory_mb}MB, {self.vcores} cores"
+        if self.tpu_chips:
+            s += f", {self.tpu_chips} tpu"
+        return s + ">"
+
+
+class ApplicationId:
+    """app_<cluster_ts>_<seq>. Ref: ApplicationId.java."""
+
+    __slots__ = ("cluster_ts", "seq")
+
+    def __init__(self, cluster_ts: int, seq: int):
+        self.cluster_ts = cluster_ts
+        self.seq = seq
+
+    def __str__(self):
+        return f"application_{self.cluster_ts}_{self.seq:04d}"
+
+    def to_wire(self) -> Dict:
+        return {"ts": self.cluster_ts, "s": self.seq}
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "ApplicationId":
+        return cls(d["ts"], d["s"])
+
+    @classmethod
+    def parse(cls, s: str) -> "ApplicationId":
+        _, ts, seq = s.split("_")
+        return cls(int(ts), int(seq))
+
+    def __eq__(self, o):
+        return isinstance(o, ApplicationId) and str(o) == str(self)
+
+    def __hash__(self):
+        return hash((self.cluster_ts, self.seq))
+
+
+class ContainerId:
+    """container_<app>_<attempt>_<seq>. Ref: ContainerId.java."""
+
+    __slots__ = ("app_id", "attempt_no", "seq")
+
+    def __init__(self, app_id: ApplicationId, attempt_no: int, seq: int):
+        self.app_id = app_id
+        self.attempt_no = attempt_no
+        self.seq = seq
+
+    def __str__(self):
+        return (f"container_{self.app_id.cluster_ts}_{self.app_id.seq:04d}"
+                f"_{self.attempt_no:02d}_{self.seq:06d}")
+
+    def to_wire(self) -> Dict:
+        return {"a": self.app_id.to_wire(), "n": self.attempt_no, "s": self.seq}
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "ContainerId":
+        return cls(ApplicationId.from_wire(d["a"]), d["n"], d["s"])
+
+    def __eq__(self, o):
+        return isinstance(o, ContainerId) and str(o) == str(self)
+
+    def __hash__(self):
+        return hash(str(self))
+
+
+class NodeId:
+    __slots__ = ("host", "port")
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+
+    def __str__(self):
+        return f"{self.host}:{self.port}"
+
+    def to_wire(self) -> Dict:
+        return {"h": self.host, "p": self.port}
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "NodeId":
+        return cls(d["h"], d["p"])
+
+    def __eq__(self, o):
+        return isinstance(o, NodeId) and str(o) == str(self)
+
+    def __hash__(self):
+        return hash(str(self))
+
+
+class Container:
+    """An allocation: id + node + resource (+ the NM address to launch at).
+    Ref: Container.java."""
+
+    __slots__ = ("container_id", "node_id", "resource", "nm_address")
+
+    def __init__(self, container_id: ContainerId, node_id: NodeId,
+                 resource: Resource, nm_address: str = ""):
+        self.container_id = container_id
+        self.node_id = node_id
+        self.resource = resource
+        self.nm_address = nm_address
+
+    def to_wire(self) -> Dict:
+        return {"id": self.container_id.to_wire(),
+                "n": self.node_id.to_wire(), "r": self.resource.to_wire(),
+                "nm": self.nm_address}
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "Container":
+        return cls(ContainerId.from_wire(d["id"]), NodeId.from_wire(d["n"]),
+                   Resource.from_wire(d["r"]), d.get("nm", ""))
+
+
+class ContainerLaunchContext:
+    """What to run: command argv, env, local resources (DFS paths to
+    localize). Ref: ContainerLaunchContext.java."""
+
+    __slots__ = ("commands", "env", "local_resources")
+
+    def __init__(self, commands: List[str],
+                 env: Optional[Dict[str, str]] = None,
+                 local_resources: Optional[Dict[str, str]] = None):
+        self.commands = commands            # argv
+        self.env = env or {}
+        self.local_resources = local_resources or {}  # name -> dfs uri
+
+    def to_wire(self) -> Dict:
+        return {"c": self.commands, "e": self.env, "lr": self.local_resources}
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "ContainerLaunchContext":
+        return cls(d["c"], d.get("e", {}), d.get("lr", {}))
+
+
+class ApplicationSubmissionContext:
+    """Ref: ApplicationSubmissionContext.java."""
+
+    __slots__ = ("app_id", "name", "queue", "am_launch_context", "am_resource",
+                 "max_attempts", "app_type", "in_process_am")
+
+    def __init__(self, app_id: ApplicationId, name: str,
+                 am_launch_context: ContainerLaunchContext,
+                 am_resource: Resource, queue: str = "default",
+                 max_attempts: int = 2, app_type: str = "YARN",
+                 in_process_am: bool = False):
+        self.app_id = app_id
+        self.name = name
+        self.queue = queue
+        self.am_launch_context = am_launch_context
+        self.am_resource = am_resource
+        self.max_attempts = max_attempts
+        self.app_type = app_type
+        # Minicluster mode: run the AM as a thread in the submitter's process
+        # (ref: MiniYARNCluster's unmanaged-AM-style testing shortcut).
+        self.in_process_am = in_process_am
+
+    def to_wire(self) -> Dict:
+        return {"id": self.app_id.to_wire(), "nm": self.name, "q": self.queue,
+                "lc": self.am_launch_context.to_wire(),
+                "r": self.am_resource.to_wire(), "ma": self.max_attempts,
+                "t": self.app_type, "ip": self.in_process_am}
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "ApplicationSubmissionContext":
+        return cls(ApplicationId.from_wire(d["id"]), d["nm"],
+                   ContainerLaunchContext.from_wire(d["lc"]),
+                   Resource.from_wire(d["r"]), d.get("q", "default"),
+                   d.get("ma", 2), d.get("t", "YARN"), d.get("ip", False))
+
+
+# Application / attempt / container externally-visible states
+# (ref: YarnApplicationState, ContainerState enums).
+class AppState:
+    NEW = "NEW"
+    SUBMITTED = "SUBMITTED"
+    ACCEPTED = "ACCEPTED"
+    RUNNING = "RUNNING"
+    FINISHED = "FINISHED"
+    FAILED = "FAILED"
+    KILLED = "KILLED"
+    TERMINAL = (FINISHED, FAILED, KILLED)
+
+
+class ContainerState:
+    NEW = "NEW"
+    LOCALIZING = "LOCALIZING"
+    RUNNING = "RUNNING"
+    COMPLETE = "COMPLETE"
+
+
+class ContainerStatus:
+    __slots__ = ("container_id", "state", "exit_code", "diagnostics")
+
+    def __init__(self, container_id: ContainerId, state: str,
+                 exit_code: int = -1000, diagnostics: str = ""):
+        self.container_id = container_id
+        self.state = state
+        self.exit_code = exit_code
+        self.diagnostics = diagnostics
+
+    def to_wire(self) -> Dict:
+        return {"id": self.container_id.to_wire(), "st": self.state,
+                "ec": self.exit_code, "d": self.diagnostics}
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "ContainerStatus":
+        return cls(ContainerId.from_wire(d["id"]), d["st"], d.get("ec", -1000),
+                   d.get("d", ""))
+
+
+class ApplicationReport:
+    __slots__ = ("app_id", "name", "user", "queue", "state", "final_status",
+                 "diagnostics", "tracking_url", "start_time", "finish_time",
+                 "attempt_no")
+
+    def __init__(self, app_id: ApplicationId, name: str, user: str,
+                 queue: str, state: str, final_status: str = "",
+                 diagnostics: str = "", tracking_url: str = "",
+                 start_time: float = 0.0, finish_time: float = 0.0,
+                 attempt_no: int = 0):
+        self.app_id = app_id
+        self.name = name
+        self.user = user
+        self.queue = queue
+        self.state = state
+        self.final_status = final_status
+        self.diagnostics = diagnostics
+        self.tracking_url = tracking_url
+        self.start_time = start_time
+        self.finish_time = finish_time
+        self.attempt_no = attempt_no
+
+    def to_wire(self) -> Dict:
+        return {"id": self.app_id.to_wire(), "nm": self.name, "u": self.user,
+                "q": self.queue, "st": self.state, "fs": self.final_status,
+                "d": self.diagnostics, "tu": self.tracking_url,
+                "t0": self.start_time, "t1": self.finish_time,
+                "at": self.attempt_no}
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "ApplicationReport":
+        return cls(ApplicationId.from_wire(d["id"]), d["nm"], d["u"], d["q"],
+                   d["st"], d.get("fs", ""), d.get("d", ""), d.get("tu", ""),
+                   d.get("t0", 0.0), d.get("t1", 0.0), d.get("at", 0))
+
+
+class ResourceRequest:
+    """AM asks: (priority, count, capability, locality).
+    Ref: ResourceRequest.java."""
+
+    __slots__ = ("priority", "num_containers", "capability", "host")
+
+    def __init__(self, priority: int, num_containers: int,
+                 capability: Resource, host: str = "*"):
+        self.priority = priority
+        self.num_containers = num_containers
+        self.capability = capability
+        self.host = host
+
+    def to_wire(self) -> Dict:
+        return {"p": self.priority, "n": self.num_containers,
+                "c": self.capability.to_wire(), "h": self.host}
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "ResourceRequest":
+        return cls(d["p"], d["n"], Resource.from_wire(d["c"]),
+                   d.get("h", "*"))

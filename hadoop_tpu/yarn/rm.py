@@ -1,0 +1,615 @@
+"""ResourceManager: app/attempt state machines, RPC services, liveness.
+
+Parity with the reference RM (ref: resourcemanager/ResourceManager.java
+(1,745 LoC), rmapp/RMAppImpl.java:117/:201, rmapp/attempt/RMAppAttemptImpl
+.java, ClientRMService.java:588 submitApplication,
+ApplicationMasterService.java:243 registerApplicationMaster / :390 allocate,
+ResourceTrackerService.java, amlauncher/AMLauncher.java,
+recovery/FileSystemRMStateStore): one dispatcher thread drives RMApp and
+RMAppAttempt state machines; three RPC protocols face clients, AMs and node
+agents; monitors expire silent AMs and NMs; an on-disk state store recovers
+app submissions across RM restarts (non-work-preserving round-1 recovery:
+incomplete apps restart with a fresh attempt).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.ipc import Client, Server, get_proxy, idempotent
+from hadoop_tpu.metrics import metrics_system
+from hadoop_tpu.security.ugi import current_user
+from hadoop_tpu.service import AbstractService
+from hadoop_tpu.util.misc import Daemon
+from hadoop_tpu.yarn.common import AsyncDispatcher, Event, StateMachineFactory
+from hadoop_tpu.yarn.records import (ApplicationId, ApplicationReport,
+                                     ApplicationSubmissionContext, AppState,
+                                     Container, ContainerId, ContainerStatus,
+                                     NodeId, Resource, ResourceRequest)
+from hadoop_tpu.yarn.scheduler import make_scheduler
+
+log = logging.getLogger(__name__)
+
+AM_PRIORITY = 0  # the RM's own request priority for AM containers
+
+
+class RMApp:
+    """Ref: rmapp/RMAppImpl.java — states NEW/SUBMITTED/ACCEPTED/RUNNING/
+    FINISHED/FAILED/KILLED driven by dispatcher events."""
+
+    _factory = (
+        StateMachineFactory(AppState.NEW)
+        .add(AppState.NEW, AppState.SUBMITTED, "submit",
+             lambda app, _: app._on_submit())
+        .add(AppState.SUBMITTED, AppState.ACCEPTED, "accepted",
+             lambda app, _: app._new_attempt())
+        .add(AppState.ACCEPTED, AppState.RUNNING, "attempt_registered",
+             lambda app, _: None)
+        .add(AppState.RUNNING, AppState.FINISHED, "attempt_finished",
+             lambda app, diag: app._on_done(AppState.FINISHED, diag))
+        .add_many([AppState.ACCEPTED, AppState.RUNNING],
+                  (AppState.ACCEPTED, AppState.FAILED), "attempt_failed",
+                  lambda app, diag: app._on_attempt_failed(diag))
+        .add_many([AppState.NEW, AppState.SUBMITTED, AppState.ACCEPTED,
+                   AppState.RUNNING], AppState.KILLED, "kill",
+                  lambda app, _: app._on_done(AppState.KILLED, "killed by user"))
+        # Terminal states swallow late events (hook keeps the current state).
+        .add_many(list(AppState.TERMINAL), AppState.TERMINAL,
+                  "attempt_finished", lambda app, _: app.sm.state)
+        .add_many(list(AppState.TERMINAL), AppState.TERMINAL,
+                  "attempt_failed", lambda app, _: app.sm.state)
+        .add_many(list(AppState.TERMINAL), AppState.TERMINAL, "kill",
+                  lambda app, _: app.sm.state)
+    )
+
+    def __init__(self, rm: "ResourceManager",
+                 ctx: ApplicationSubmissionContext, user: str):
+        self.rm = rm
+        self.ctx = ctx
+        self.user = user
+        self.app_id = ctx.app_id
+        self.sm = self._factory.make(self)
+        self.attempt_no = 0
+        self.current_attempt: Optional["RMAppAttempt"] = None
+        self.diagnostics = ""
+        self.final_status = ""
+        self.start_time = time.time()
+        self.finish_time = 0.0
+        self.tracking_url = ""
+
+    # hooks ----------------------------------------------------------------
+
+    def _on_submit(self):
+        try:
+            self.rm.scheduler_queue_check(self.ctx.queue)
+        except ValueError as e:
+            self.diagnostics = str(e)
+            # Reject: flip to FAILED via the dispatcher on the next tick.
+            self.rm.dispatcher.dispatch("app", Event(
+                "app_attempt_failed_terminal",
+                (self.app_id, str(e))))
+            return
+        self.rm.dispatcher.dispatch("app", Event("app_accepted", self.app_id))
+
+    def _new_attempt(self):
+        self.attempt_no += 1
+        attempt = RMAppAttempt(self, self.attempt_no)
+        self.current_attempt = attempt
+        self.rm.attempts[attempt.attempt_id] = attempt
+        attempt.start()
+
+    def _on_attempt_failed(self, diag: str) -> str:
+        self.diagnostics = diag or ""
+        if self.attempt_no >= self.ctx.max_attempts:
+            self._on_done(AppState.FAILED, f"exhausted {self.attempt_no} "
+                          f"attempts; last: {diag}")
+            return AppState.FAILED
+        self._new_attempt()
+        return AppState.ACCEPTED
+
+    def _on_done(self, state: str, diag) -> None:
+        self.finish_time = time.time()
+        if diag:
+            self.diagnostics = str(diag)
+        self.final_status = state
+        att = self.current_attempt
+        if att is not None:
+            self.rm.release_attempt(att)
+        self.rm.state_store.store_app_done(self.app_id, state,
+                                           self.diagnostics)
+
+    def report(self) -> ApplicationReport:
+        return ApplicationReport(
+            self.app_id, self.ctx.name, self.user, self.ctx.queue,
+            self.sm.state, self.final_status, self.diagnostics,
+            self.tracking_url, self.start_time, self.finish_time,
+            self.attempt_no)
+
+
+class RMAppAttempt:
+    """Ref: rmapp/attempt/RMAppAttemptImpl.java (simplified state set:
+    SCHEDULED → ALLOCATED → LAUNCHED → RUNNING → FINISHED/FAILED)."""
+
+    def __init__(self, app: RMApp, attempt_no: int):
+        self.app = app
+        self.attempt_no = attempt_no
+        self.attempt_id = f"{app.app_id}_{attempt_no:02d}"
+        self.state = "SCHEDULED"
+        self.am_container: Optional[Container] = None
+        self.progress = 0.0
+        self.last_heartbeat = time.monotonic()
+        self.tracking_url = ""
+
+    def start(self) -> None:
+        rm = self.app.rm
+        rm.scheduler.add_app(self.attempt_id, self.app.ctx.queue,
+                             self.app.user)
+        rm.scheduler.allocate(self.attempt_id, [ResourceRequest(
+            AM_PRIORITY, 1, self.app.ctx.am_resource)], [])
+        log.info("Attempt %s scheduled (AM resource %r)", self.attempt_id,
+                 self.app.ctx.am_resource)
+
+    def fail(self, diag: str) -> None:
+        self.state = "FAILED"
+        self.app.rm.dispatcher.dispatch("app", Event(
+            "app_attempt_failed", (self.app.app_id, diag)))
+
+    def finish(self, final_status: str, diag: str) -> None:
+        self.state = "FINISHED"
+        if final_status in ("FAILED", "KILLED"):
+            self.app.rm.dispatcher.dispatch("app", Event(
+                "app_attempt_failed", (self.app.app_id, diag)))
+        else:
+            self.app.rm.dispatcher.dispatch("app", Event(
+                "app_attempt_finished", (self.app.app_id, diag)))
+
+
+class FileRMStateStore:
+    """App submissions + outcomes on local disk.
+    Ref: recovery/FileSystemRMStateStore.java."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, app_id: ApplicationId) -> str:
+        return os.path.join(self.dir, f"{app_id}.json")
+
+    def store_app(self, ctx: ApplicationSubmissionContext, user: str) -> None:
+        with open(self._path(ctx.app_id), "w") as f:
+            json.dump({"ctx": _wire_to_jsonable(ctx.to_wire()),
+                       "user": user, "state": "RUNNING"}, f)
+
+    def store_app_done(self, app_id: ApplicationId, state: str,
+                       diag: str) -> None:
+        path = self._path(app_id)
+        if not os.path.exists(path):
+            return
+        with open(path) as f:
+            d = json.load(f)
+        d["state"] = state
+        d["diagnostics"] = diag
+        with open(path, "w") as f:
+            json.dump(d, f)
+
+    def load_all(self) -> List[Dict]:
+        out = []
+        for name in sorted(os.listdir(self.dir)):
+            if name.endswith(".json"):
+                with open(os.path.join(self.dir, name)) as f:
+                    out.append(json.load(f))
+        return out
+
+
+def _wire_to_jsonable(obj):
+    if isinstance(obj, bytes):
+        import base64
+        return {"__b64__": base64.b64encode(obj).decode()}
+    if isinstance(obj, dict):
+        return {k: _wire_to_jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_wire_to_jsonable(v) for v in obj]
+    return obj
+
+
+def _jsonable_to_wire(obj):
+    if isinstance(obj, dict):
+        if "__b64__" in obj:
+            import base64
+            return base64.b64decode(obj["__b64__"])
+        return {k: _jsonable_to_wire(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_jsonable_to_wire(v) for v in obj]
+    return obj
+
+
+class RMNode:
+    def __init__(self, node_id: NodeId, total: Resource, nm_address: str):
+        self.node_id = node_id
+        self.total = total
+        self.nm_address = nm_address
+        self.last_heartbeat = time.monotonic()
+        self.state = "RUNNING"
+        self.containers_to_cleanup: List[ContainerId] = []
+
+
+class ClientRMProtocol:
+    """Client ↔ RM. Ref: ClientRMService.java."""
+
+    def __init__(self, rm: "ResourceManager"):
+        self.rm = rm
+
+    def get_new_application(self) -> Dict:
+        app_id = self.rm.new_app_id()
+        return {"app_id": app_id.to_wire(),
+                "max_resource": self.rm.scheduler.cluster_resource().to_wire()}
+
+    def submit_application(self, ctx_wire: Dict) -> Dict:
+        """Ref: ClientRMService.submitApplication:588."""
+        ctx = ApplicationSubmissionContext.from_wire(ctx_wire)
+        user = current_user().user_name
+        return self.rm.submit_application(ctx, user)
+
+    @idempotent
+    def get_application_report(self, app_id_wire: Dict) -> Dict:
+        app = self.rm.apps.get(ApplicationId.from_wire(app_id_wire))
+        if app is None:
+            raise ValueError(f"unknown application")
+        return app.report().to_wire()
+
+    @idempotent
+    def list_applications(self) -> List[Dict]:
+        return [a.report().to_wire() for a in self.rm.apps.values()]
+
+    def kill_application(self, app_id_wire: Dict) -> bool:
+        app_id = ApplicationId.from_wire(app_id_wire)
+        self.rm.dispatcher.dispatch("app", Event("app_kill", app_id))
+        return True
+
+    @idempotent
+    def get_cluster_metrics(self) -> Dict:
+        nodes = self.rm.nodes
+        return {
+            "num_node_managers": len(nodes),
+            "total_resource": self.rm.scheduler.cluster_resource().to_wire(),
+            "apps": len(self.rm.apps),
+        }
+
+    @idempotent
+    def get_nodes(self) -> List[Dict]:
+        return [{"id": n.node_id.to_wire(), "r": n.total.to_wire(),
+                 "state": n.state, "nm": n.nm_address}
+                for n in self.rm.nodes.values()]
+
+    @idempotent
+    def get_service_status(self) -> Dict:
+        return {"state": "active"}
+
+
+class AMRMProtocol:
+    """AM ↔ RM. Ref: ApplicationMasterService.java."""
+
+    def __init__(self, rm: "ResourceManager"):
+        self.rm = rm
+
+    def register_application_master(self, attempt_id: str,
+                                    tracking_url: str = "") -> Dict:
+        """Ref: ApplicationMasterService.registerApplicationMaster:243."""
+        attempt = self.rm.attempts.get(attempt_id)
+        if attempt is None:
+            raise ValueError(f"unknown attempt {attempt_id}")
+        attempt.state = "RUNNING"
+        attempt.last_heartbeat = time.monotonic()
+        attempt.tracking_url = tracking_url
+        attempt.app.tracking_url = tracking_url
+        self.rm.dispatcher.dispatch("app", Event("app_attempt_registered",
+                                                 attempt.app.app_id))
+        return {"max_resource": self.rm.scheduler.cluster_resource().to_wire(),
+                "queue": attempt.app.ctx.queue}
+
+    def allocate(self, attempt_id: str, asks: List[Dict],
+                 releases: List[Dict], progress: float = 0.0) -> Dict:
+        """The AM↔RM heartbeat. Ref: ApplicationMasterService.allocate:390."""
+        attempt = self.rm.attempts.get(attempt_id)
+        if attempt is None:
+            raise ValueError(f"unknown attempt {attempt_id}")
+        attempt.last_heartbeat = time.monotonic()
+        attempt.progress = progress
+        allocated, completed = self.rm.scheduler.allocate(
+            attempt_id,
+            [ResourceRequest.from_wire(a) for a in asks],
+            [ContainerId.from_wire(r) for r in releases])
+        return {
+            "allocated": [c.to_wire() for c in allocated],
+            "completed": [s.to_wire() for s in completed],
+            "num_nodes": len(self.rm.nodes),
+        }
+
+    def finish_application_master(self, attempt_id: str, final_status: str,
+                                  diagnostics: str = "") -> bool:
+        attempt = self.rm.attempts.get(attempt_id)
+        if attempt is None:
+            return True
+        attempt.finish(final_status, diagnostics)
+        return True
+
+
+class ResourceTrackerProtocol:
+    """NM ↔ RM. Ref: ResourceTrackerService.java."""
+
+    def __init__(self, rm: "ResourceManager"):
+        self.rm = rm
+
+    def register_node_manager(self, node_id_wire: Dict, resource_wire: Dict,
+                              nm_address: str) -> Dict:
+        node_id = NodeId.from_wire(node_id_wire)
+        total = Resource.from_wire(resource_wire)
+        with self.rm.nodes_lock:
+            node = RMNode(node_id, total, nm_address)
+            self.rm.nodes[node_id] = node
+        self.rm.scheduler.add_node(node_id, total, nm_address)
+        log.info("Node %s registered (%r) at %s", node_id, total, nm_address)
+        return {"ok": True}
+
+    def node_heartbeat(self, node_id_wire: Dict,
+                       container_statuses: List[Dict]) -> Dict:
+        node_id = NodeId.from_wire(node_id_wire)
+        with self.rm.nodes_lock:
+            node = self.rm.nodes.get(node_id)
+        if node is None:
+            return {"action": "reregister"}
+        node.last_heartbeat = time.monotonic()
+        # Route completed containers to their attempt + the AM watcher.
+        for sw in container_statuses:
+            status = ContainerStatus.from_wire(sw)
+            if status.state == "COMPLETE":
+                self.rm.on_container_complete(status)
+        # Offer this node to the scheduler, then launch any AM containers it
+        # just granted.
+        self.rm.scheduler.node_heartbeat(node_id)
+        self.rm.launch_allocated_am_containers()
+        cleanup = node.containers_to_cleanup
+        node.containers_to_cleanup = []
+        return {"action": "ok",
+                "cleanup": [c.to_wire() for c in cleanup]}
+
+
+class ResourceManager(AbstractService):
+    def __init__(self, conf: Configuration, state_dir: Optional[str] = None):
+        super().__init__("ResourceManager")
+        self._conf_in = conf
+        self.cluster_ts = int(time.time())
+        self._app_seq = 0
+        self._seq_lock = threading.Lock()
+        self.apps: Dict[ApplicationId, RMApp] = {}
+        self.attempts: Dict[str, RMAppAttempt] = {}
+        self.nodes: Dict[NodeId, RMNode] = {}
+        self.nodes_lock = threading.Lock()
+        self.dispatcher = AsyncDispatcher("rm-dispatcher")
+        self.state_dir = state_dir or conf.get(
+            "yarn.resourcemanager.store.dir", "/tmp/htpu-rm-state")
+        self.state_store = FileRMStateStore(self.state_dir)
+        self.rpc: Optional[Server] = None
+        self._stop_event = threading.Event()
+        self._nm_client = Client(conf)
+        reg = metrics_system().source("rm")
+        reg.register_callback_gauge("apps", lambda: len(self.apps))
+        reg.register_callback_gauge("nodes", lambda: len(self.nodes))
+        self._m_submitted = reg.counter("apps_submitted")
+        self._m_completed = reg.counter("apps_completed")
+
+    @property
+    def port(self) -> int:
+        return self.rpc.port
+
+    # ------------------------------------------------------------- lifecycle
+
+    def service_init(self, conf: Configuration) -> None:
+        self.scheduler = make_scheduler(conf, self._make_container_id)
+        self.dispatcher.register("app", self._handle_app_event)
+        self.dispatcher.init(conf)
+        bind_host = conf.get("yarn.resourcemanager.bind-host", "127.0.0.1")
+        self.rpc = Server(
+            conf, bind=(bind_host, conf.get_int("yarn.resourcemanager.port", 0)),
+            num_handlers=conf.get_int("yarn.resourcemanager.handler.count", 8),
+            name="rm")
+        self.rpc.register_protocol("ClientRMProtocol", ClientRMProtocol(self))
+        self.rpc.register_protocol("AMRMProtocol", AMRMProtocol(self))
+        self.rpc.register_protocol("ResourceTrackerProtocol",
+                                   ResourceTrackerProtocol(self))
+        self.am_expiry_s = conf.get_time_seconds(
+            "yarn.am.liveness-monitor.expiry-interval", 60.0)
+        self.nm_expiry_s = conf.get_time_seconds(
+            "yarn.nm.liveness-monitor.expiry-interval", 60.0)
+
+    def service_start(self) -> None:
+        self.dispatcher.start()
+        self.rpc.start()
+        self._recover()
+        Daemon(self._liveness_loop, "rm-liveness").start()
+        log.info("ResourceManager up at 127.0.0.1:%d", self.rpc.port)
+
+    def service_stop(self) -> None:
+        self._stop_event.set()
+        if self.rpc:
+            self.rpc.stop()
+        self.dispatcher.stop()
+        self._nm_client.stop()
+
+    def _recover(self) -> None:
+        """Non-work-preserving recovery: resubmit incomplete apps.
+        Ref: RMAppManager.recoverApplication (work-preserving restart is the
+        reference's richer variant — ZKRMStateStore.java:180)."""
+        for d in self.state_store.load_all():
+            if d.get("state") in (AppState.FINISHED, AppState.FAILED,
+                                  AppState.KILLED):
+                continue
+            try:
+                ctx = ApplicationSubmissionContext.from_wire(
+                    _jsonable_to_wire(d["ctx"]))
+                log.info("Recovering application %s", ctx.app_id)
+                self.submit_application(ctx, d.get("user", "unknown"),
+                                        store=False)
+                self._app_seq = max(self._app_seq, ctx.app_id.seq)
+            except Exception:
+                log.exception("Failed to recover an application")
+
+    # --------------------------------------------------------------- events
+
+    def _handle_app_event(self, ev: Event) -> None:
+        if ev.etype == "app_kill":
+            app = self.apps.get(ev.payload)
+            if app is not None and app.sm.can_handle("kill"):
+                app.sm.handle("kill")
+            return
+        if ev.etype == "app_accepted":
+            app = self.apps.get(ev.payload)
+            if app is not None:
+                app.sm.handle("accepted")
+            return
+        if ev.etype == "app_attempt_registered":
+            app = self.apps.get(ev.payload)
+            if app is not None and app.sm.state == AppState.ACCEPTED:
+                app.sm.handle("attempt_registered")
+            return
+        if ev.etype in ("app_attempt_finished", "app_attempt_failed"):
+            app_id, diag = ev.payload
+            app = self.apps.get(app_id)
+            if app is None:
+                return
+            event = ("attempt_finished" if ev.etype == "app_attempt_finished"
+                     else "attempt_failed")
+            if app.sm.can_handle(event):
+                app.sm.handle(event, diag)
+            if app.sm.state in AppState.TERMINAL:
+                self._m_completed.incr()
+            return
+        if ev.etype == "app_attempt_failed_terminal":
+            app_id, diag = ev.payload
+            app = self.apps.get(app_id)
+            if app is not None:
+                app._on_done(AppState.FAILED, diag)
+                app.sm.state = AppState.FAILED
+            return
+        log.warning("Unhandled app event %s", ev.etype)
+
+    # ----------------------------------------------------------- operations
+
+    def new_app_id(self) -> ApplicationId:
+        with self._seq_lock:
+            self._app_seq += 1
+            return ApplicationId(self.cluster_ts, self._app_seq)
+
+    def submit_application(self, ctx: ApplicationSubmissionContext,
+                           user: str, store: bool = True) -> Dict:
+        if ctx.app_id in self.apps:
+            return {"ok": True, "dup": True}  # idempotent resubmission
+        app = RMApp(self, ctx, user)
+        self.apps[ctx.app_id] = app
+        if store:
+            self.state_store.store_app(ctx, user)
+        self._m_submitted.incr()
+        app.sm.handle("submit")
+        return {"ok": True}
+
+    def scheduler_queue_check(self, queue: str) -> None:
+        checker = getattr(self.scheduler, "queues", None)
+        if checker is not None and queue not in checker:
+            raise ValueError(f"unknown queue {queue!r}")
+
+    def _make_container_id(self, attempt_id: str, seq: int) -> ContainerId:
+        # attempt_id = application_<ts>_<seq>_<no>
+        parts = attempt_id.rsplit("_", 1)
+        app_id = ApplicationId.parse(parts[0])
+        return ContainerId(app_id, int(parts[1]), seq)
+
+    def on_container_complete(self, status: ContainerStatus) -> None:
+        cid = status.container_id
+        attempt_id = f"{cid.app_id}_{cid.attempt_no:02d}"
+        self.scheduler.container_completed(attempt_id, status)
+        attempt = self.attempts.get(attempt_id)
+        if attempt is None:
+            return
+        am = attempt.am_container
+        if am is not None and am.container_id == cid and \
+                attempt.state in ("LAUNCHED", "RUNNING", "ALLOCATED"):
+            # The AM container itself died.
+            if status.exit_code == 0:
+                attempt.finish("SUCCEEDED", "AM exited 0 without unregister")
+            else:
+                attempt.fail(f"AM container exited {status.exit_code}: "
+                             f"{status.diagnostics}")
+
+    def launch_allocated_am_containers(self) -> None:
+        """Scan SCHEDULED attempts whose AM container was just granted.
+        Ref: RMAppAttemptImpl.AMContainerAllocatedTransition + AMLauncher."""
+        for attempt in list(self.attempts.values()):
+            if attempt.state != "SCHEDULED":
+                continue
+            allocated, _ = self.scheduler.allocate(attempt.attempt_id, [], [])
+            if not allocated:
+                continue
+            attempt.am_container = allocated[0]
+            attempt.state = "ALLOCATED"
+            Daemon(self._launch_am, "am-launcher",
+                   args=(attempt,)).start()
+
+    def _launch_am(self, attempt: RMAppAttempt) -> None:
+        """Ref: amlauncher/AMLauncher.java — start the AM container on its NM."""
+        c = attempt.am_container
+        ctx = attempt.app.ctx.am_launch_context
+        env = dict(ctx.env)
+        env["HTPU_ATTEMPT_ID"] = attempt.attempt_id
+        env["HTPU_RM_ADDRESS"] = f"127.0.0.1:{self.rpc.port}"
+        env["HTPU_CONTAINER_ID"] = str(c.container_id)
+        launch = type(ctx)(ctx.commands, env, ctx.local_resources)
+        try:
+            host, port = c.nm_address.rsplit(":", 1)
+            nm = get_proxy("ContainerManagerProtocol", (host, int(port)),
+                           client=self._nm_client)
+            nm.start_container(c.to_wire(), launch.to_wire())
+            attempt.state = "LAUNCHED"
+            log.info("Launched AM for %s in %s on %s", attempt.attempt_id,
+                     c.container_id, c.node_id)
+        except Exception as e:  # noqa: BLE001
+            log.warning("AM launch for %s failed: %s", attempt.attempt_id, e)
+            attempt.fail(f"AM launch failed: {e}")
+
+    def release_attempt(self, attempt: RMAppAttempt) -> None:
+        freed = self.scheduler.remove_app(attempt.attempt_id)
+        with self.nodes_lock:
+            for c in freed:
+                node = self.nodes.get(c.node_id)
+                if node is not None:
+                    node.containers_to_cleanup.append(c.container_id)
+
+    # ------------------------------------------------------------- liveness
+
+    def _liveness_loop(self) -> None:
+        """AM + NM expiry. Ref: AMLivelinessMonitor, NMLivelinessMonitor.
+        Guarded per pass: one bad attempt/node must not kill the monitor."""
+        while not self._stop_event.wait(0.5):
+            now = time.monotonic()
+            try:
+                for attempt in list(self.attempts.values()):
+                    if attempt.state == "RUNNING" and \
+                            now - attempt.last_heartbeat > self.am_expiry_s:
+                        log.warning("Attempt %s expired (no AM heartbeat)",
+                                    attempt.attempt_id)
+                        attempt.fail("AM liveness expired")
+                with self.nodes_lock:
+                    nodes = list(self.nodes.items())
+                for node_id, node in nodes:
+                    if node.state == "RUNNING" and \
+                            now - node.last_heartbeat > self.nm_expiry_s:
+                        log.warning("Node %s expired", node_id)
+                        node.state = "LOST"
+                        self.scheduler.remove_node(node_id)
+            except Exception:
+                log.exception("Liveness monitor pass failed")

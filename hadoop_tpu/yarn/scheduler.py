@@ -1,0 +1,321 @@
+"""Pluggable schedulers: FIFO and hierarchical capacity.
+
+Parity with the reference's scheduler layer (ref:
+scheduler/capacity/CapacityScheduler.java:174 (3,273 LoC; :1220 allocate,
+:1747 allocateContainersToNode), scheduler/fifo/FifoScheduler.java, common
+SchedulerNode/SchedulerApplicationAttempt): allocation is heartbeat-driven —
+each NM heartbeat offers its node to the scheduler, which walks the queue
+hierarchy (most-under-served first), picks an app, and matches its pending
+resource requests against the node's headroom. AMs pick allocations up on
+their next ``allocate`` call.
+
+TPU-first: Resource is (memory, vcores, tpu_chips); queue ordering uses
+dominant-resource share so chip-hungry and memory-hungry queues compare
+sanely (ref: DominantResourceCalculator).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.yarn.records import (Container, ContainerId, ContainerStatus,
+                                     NodeId, Resource, ResourceRequest)
+
+log = logging.getLogger(__name__)
+
+
+class SchedulerNode:
+    """Ref: scheduler/SchedulerNode.java."""
+
+    def __init__(self, node_id: NodeId, total: Resource, nm_address: str):
+        self.node_id = node_id
+        self.total = total
+        self.available = Resource(total.memory_mb, total.vcores,
+                                  total.tpu_chips)
+        self.nm_address = nm_address
+        self.containers: Dict[ContainerId, Container] = {}
+
+    def allocate(self, container: Container) -> None:
+        self.available = self.available.subtract(container.resource)
+        self.containers[container.container_id] = container
+
+    def release(self, container_id: ContainerId) -> Optional[Container]:
+        c = self.containers.pop(container_id, None)
+        if c is not None:
+            self.available = self.available.add(c.resource)
+        return c
+
+
+class SchedulerApp:
+    """One app attempt's scheduling state.
+    Ref: scheduler/SchedulerApplicationAttempt.java."""
+
+    def __init__(self, attempt_id: str, queue: str, user: str):
+        self.attempt_id = attempt_id
+        self.queue = queue
+        self.user = user
+        # priority -> list of outstanding requests
+        self.pending: Dict[int, List[ResourceRequest]] = {}
+        self.allocated_unfetched: List[Container] = []
+        self.live_containers: Dict[ContainerId, Container] = {}
+        self.completed_unfetched: List[ContainerStatus] = []
+        self.used = Resource()
+        self._seq = 0
+
+    def add_requests(self, asks: List[ResourceRequest]) -> None:
+        for ask in asks:
+            self.pending.setdefault(ask.priority, []).append(ask)
+
+    def next_container_seq(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    def has_pending(self) -> bool:
+        return any(r.num_containers > 0
+                   for reqs in self.pending.values() for r in reqs)
+
+
+class Scheduler:
+    """Interface. Ref: scheduler/YarnScheduler.java."""
+
+    def add_node(self, node_id: NodeId, total: Resource,
+                 nm_address: str) -> None: ...
+    def remove_node(self, node_id: NodeId) -> List[ContainerId]: ...
+    def node_heartbeat(self, node_id: NodeId) -> None: ...
+    def add_app(self, attempt_id: str, queue: str, user: str) -> None: ...
+    def remove_app(self, attempt_id: str) -> List[Container]: ...
+    def allocate(self, attempt_id: str, asks, releases) -> Tuple[List, List]: ...
+    def cluster_resource(self) -> Resource: ...
+
+
+class _BaseScheduler(Scheduler):
+    def __init__(self, conf: Configuration,
+                 container_id_factory) -> None:
+        self.conf = conf
+        self.nodes: Dict[NodeId, SchedulerNode] = {}
+        self.apps: "OrderedDict[str, SchedulerApp]" = OrderedDict()
+        self.lock = threading.RLock()
+        self.make_container_id = container_id_factory
+        self.min_alloc = Resource(
+            conf.get_int("yarn.scheduler.minimum-allocation-mb", 128),
+            1, 0)
+
+    # ------------------------------------------------------------- nodes
+
+    def add_node(self, node_id: NodeId, total: Resource,
+                 nm_address: str) -> None:
+        with self.lock:
+            self.nodes[node_id] = SchedulerNode(node_id, total, nm_address)
+
+    def remove_node(self, node_id: NodeId) -> List[ContainerId]:
+        """Node lost: complete its containers as LOST."""
+        with self.lock:
+            node = self.nodes.pop(node_id, None)
+            if node is None:
+                return []
+            lost = list(node.containers)
+            for cid in lost:
+                for app in self.apps.values():
+                    if cid in app.live_containers:
+                        c = app.live_containers.pop(cid)
+                        app.used = app.used.subtract(c.resource)
+                        app.completed_unfetched.append(ContainerStatus(
+                            cid, "COMPLETE", exit_code=-100,
+                            diagnostics="container lost: node expired"))
+            return lost
+
+    def cluster_resource(self) -> Resource:
+        with self.lock:
+            total = Resource()
+            for n in self.nodes.values():
+                total = total.add(n.total)
+            return total
+
+    # -------------------------------------------------------------- apps
+
+    def add_app(self, attempt_id: str, queue: str, user: str) -> None:
+        with self.lock:
+            self.apps[attempt_id] = SchedulerApp(attempt_id, queue, user)
+
+    def remove_app(self, attempt_id: str) -> List[Container]:
+        """App done: free its containers; returns them for NM cleanup."""
+        with self.lock:
+            app = self.apps.pop(attempt_id, None)
+            if app is None:
+                return []
+            freed = list(app.live_containers.values())
+            for c in freed:
+                node = self.nodes.get(c.node_id)
+                if node is not None:
+                    node.release(c.container_id)
+            return freed
+
+    def allocate(self, attempt_id: str, asks: List[ResourceRequest],
+                 releases: List[ContainerId]
+                 ) -> Tuple[List[Container], List[ContainerStatus]]:
+        """AM heartbeat: record asks, apply releases, hand back anything
+        allocated since last call. Ref: CapacityScheduler.allocate:1220."""
+        with self.lock:
+            app = self.apps.get(attempt_id)
+            if app is None:
+                return [], []
+            app.add_requests(asks)
+            for cid in releases:
+                c = app.live_containers.pop(cid, None)
+                if c is not None:
+                    app.used = app.used.subtract(c.resource)
+                    node = self.nodes.get(c.node_id)
+                    if node is not None:
+                        node.release(cid)
+            allocated = app.allocated_unfetched
+            app.allocated_unfetched = []
+            completed = app.completed_unfetched
+            app.completed_unfetched = []
+            return allocated, completed
+
+    def container_completed(self, attempt_id: str,
+                            status: ContainerStatus) -> None:
+        """NM reported a container exit."""
+        with self.lock:
+            app = self.apps.get(attempt_id)
+            for node in self.nodes.values():
+                node.release(status.container_id)
+            if app is not None:
+                c = app.live_containers.pop(status.container_id, None)
+                if c is not None:
+                    app.used = app.used.subtract(c.resource)
+                app.completed_unfetched.append(status)
+
+    # --------------------------------------------------------- allocation
+
+    def node_heartbeat(self, node_id: NodeId) -> None:
+        """Offer the node to apps. Subclasses choose the app order.
+        Ref: CapacityScheduler.allocateContainersToNode:1747."""
+        with self.lock:
+            node = self.nodes.get(node_id)
+            if node is None:
+                return
+            for app in self._app_order():
+                self._assign_on_node(app, node)
+
+    def _may_assign(self, app: SchedulerApp, capability: Resource) -> bool:
+        return True
+
+    def _assign_on_node(self, app: SchedulerApp, node: SchedulerNode) -> None:
+        for priority in sorted(app.pending):
+            for req in app.pending[priority]:
+                while req.num_containers > 0:
+                    if req.host not in ("*", node.node_id.host):
+                        break
+                    if not req.capability.fits_in(node.available):
+                        break
+                    if not self._may_assign(app, req.capability):
+                        return
+                    cid = self.make_container_id(app.attempt_id,
+                                                 app.next_container_seq())
+                    container = Container(cid, node.node_id, req.capability,
+                                          node.nm_address)
+                    node.allocate(container)
+                    app.used = app.used.add(req.capability)
+                    app.live_containers[cid] = container
+                    app.allocated_unfetched.append(container)
+                    req.num_containers -= 1
+            app.pending[priority] = [r for r in app.pending[priority]
+                                     if r.num_containers > 0]
+
+    def _app_order(self) -> List[SchedulerApp]:
+        raise NotImplementedError
+
+
+class FifoScheduler(_BaseScheduler):
+    """Single queue, submission order. Ref: scheduler/fifo/FifoScheduler.java."""
+
+    def _app_order(self) -> List[SchedulerApp]:
+        return list(self.apps.values())
+
+
+class QueueConfig:
+    def __init__(self, name: str, capacity: float, max_capacity: float = 1.0):
+        self.name = name
+        self.capacity = capacity        # guaranteed fraction of the cluster
+        self.max_capacity = max_capacity
+
+
+class CapacityScheduler(_BaseScheduler):
+    """Flat leaf queues under root with capacity / max-capacity, served
+    most-under-served-first by dominant-resource usage ratio; FIFO within a
+    queue; hard cap at max_capacity.
+
+    Ref: scheduler/capacity/CapacityScheduler.java + CapacitySchedulerConfiguration —
+    config keys mirror the reference's shape:
+        yarn.scheduler.capacity.root.queues = a,b
+        yarn.scheduler.capacity.root.<q>.capacity = 60          (percent)
+        yarn.scheduler.capacity.root.<q>.maximum-capacity = 100 (percent)
+    (Hierarchical sub-queues collapse to leaves here; the reference's parent
+    queues exist to subdivide capacity, which a flat list with fractions
+    expresses equivalently for scheduling purposes.)
+    """
+
+    def __init__(self, conf: Configuration, container_id_factory):
+        super().__init__(conf, container_id_factory)
+        self.queues: Dict[str, QueueConfig] = {}
+        names = conf.get_list("yarn.scheduler.capacity.root.queues",
+                              ["default"])
+        for name in names:
+            cap = conf.get_float(
+                f"yarn.scheduler.capacity.root.{name}.capacity",
+                100.0 / len(names)) / 100.0
+            mx = conf.get_float(
+                f"yarn.scheduler.capacity.root.{name}.maximum-capacity",
+                100.0) / 100.0
+            self.queues[name] = QueueConfig(name, cap, mx)
+
+    def add_app(self, attempt_id: str, queue: str, user: str) -> None:
+        if queue not in self.queues:
+            raise ValueError(f"unknown queue {queue!r} "
+                             f"(have {sorted(self.queues)})")
+        super().add_app(attempt_id, queue, user)
+
+    def _may_assign(self, app: SchedulerApp, capability: Resource) -> bool:
+        """Per-assignment max-capacity enforcement: would this allocation push
+        the queue past its hard cap? Ref: AbstractCSQueue.canAssignToThisQueue."""
+        qc = self.queues[app.queue]
+        total = self.cluster_resource()
+        after = self._queue_usage()[app.queue].add(capability)
+        return after.dominant_share(total) <= qc.max_capacity + 1e-9
+
+    def _queue_usage(self) -> Dict[str, Resource]:
+        usage: Dict[str, Resource] = {q: Resource() for q in self.queues}
+        for app in self.apps.values():
+            usage[app.queue] = usage[app.queue].add(app.used)
+        return usage
+
+    def _app_order(self) -> List[SchedulerApp]:
+        total = self.cluster_resource()
+        usage = self._queue_usage()
+        # Most-under-served queue first: usage_share / capacity ascending.
+        def queue_key(qname: str) -> float:
+            qc = self.queues[qname]
+            share = usage[qname].dominant_share(total)
+            return share / max(qc.capacity, 1e-9)
+
+        ordered_queues = sorted(self.queues, key=queue_key)
+        out: List[SchedulerApp] = []
+        for qname in ordered_queues:
+            qc = self.queues[qname]
+            share = usage[qname].dominant_share(total)
+            if share >= qc.max_capacity:
+                continue  # hard cap (ref: maximum-capacity enforcement)
+            out.extend(a for a in self.apps.values() if a.queue == qname)
+        return out
+
+
+def make_scheduler(conf: Configuration, container_id_factory) -> Scheduler:
+    kind = conf.get("yarn.resourcemanager.scheduler.class", "capacity")
+    if kind in ("fifo", "FifoScheduler"):
+        return FifoScheduler(conf, container_id_factory)
+    return CapacityScheduler(conf, container_id_factory)

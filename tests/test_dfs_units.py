@@ -368,3 +368,109 @@ def test_blockstore_invalidate(tmp_path):
     assert store.invalidate(Block(3, 1))
     assert store.get_replica(3) is None
     assert not store.invalidate(Block(3, 1))
+
+
+def test_editlog_torn_tail_truncated_before_append(tmp_path):
+    """Regression: a torn in-progress segment must be truncated on reopen,
+    or edits appended after the torn frame are unreachable on replay."""
+    jm = FileJournalManager(str(tmp_path / "edits"))
+    elog = FSEditLog(jm)
+    elog.open_for_write(0)
+    for i in range(3):
+        elog.log_edit(OP_MKDIR, {"p": f"/a{i}"})
+    elog.log_sync()
+    # Crash: torn frame at the tail of the in-progress segment.
+    seg = str(tmp_path / "edits" / "edits_inprogress_1")
+    with open(seg, "ab") as f:
+        f.write(b"\x00\x00\x01\x00partial-frame")
+    # Restart: reopen the same segment and write more durable edits.
+    jm2 = FileJournalManager(str(tmp_path / "edits"))
+    elog2 = FSEditLog(jm2)
+    elog2.open_for_write(3)
+    elog2.log_edit(OP_MKDIR, {"p": "/after-crash"})
+    elog2.log_sync()
+    elog2.close()
+    # Second restart must see ALL four edits.
+    jm3 = FileJournalManager(str(tmp_path / "edits"))
+    paths = [r["p"] for r in jm3.read_edits(1)]
+    assert paths == ["/a0", "/a1", "/a2", "/after-crash"]
+
+
+def test_editlog_roll_races_concurrent_writers(tmp_path):
+    """Regression: roll() must not lose or misplace edits logged
+    concurrently by other threads."""
+    import threading
+    jm = FileJournalManager(str(tmp_path / "edits"))
+    elog = FSEditLog(jm)
+    elog.open_for_write(0)
+    stop = threading.Event()
+    errors = []
+
+    def writer(tid):
+        i = 0
+        while not stop.is_set():
+            try:
+                t = elog.log_edit(OP_MKDIR, {"p": f"/w{tid}-{i}"})
+                elog.log_sync(t)
+                i += 1
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    import time as _t
+    for _ in range(10):
+        elog.roll()
+        _t.sleep(0.01)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
+    elog.close()
+    jm2 = FileJournalManager(str(tmp_path / "edits"))
+    recs = list(jm2.read_edits(1))
+    txids = [r["t"] for r in recs]
+    assert txids == list(range(1, elog.last_txid + 1))  # no gaps, no loss
+
+
+def test_lease_rename_subtree_and_actual_dst():
+    """Regression: leases must follow directory renames and into-dir moves."""
+    lm = LeaseManager()
+    lm.add_lease("c1", "/d/open1")
+    lm.add_lease("c1", "/d/sub/open2")
+    lm.add_lease("c2", "/other")
+    lm.rename_path("/d", "/d2")
+    assert lm.holder_of("/d/open1") is None
+    assert lm.holder_of("/d2/open1") == "c1"
+    assert lm.holder_of("/d2/sub/open2") == "c1"
+    assert lm.holder_of("/other") == "c2"
+
+
+def test_lease_remove_under():
+    lm = LeaseManager()
+    lm.add_lease("c1", "/gone/f1")
+    lm.add_lease("c1", "/gone/deep/f2")
+    lm.add_lease("c1", "/keep/f3")
+    lm.remove_under("/gone")
+    assert lm.holder_of("/gone/f1") is None
+    assert lm.holder_of("/gone/deep/f2") is None
+    assert lm.holder_of("/keep/f3") == "c1"
+
+
+def test_blockstore_finalize_existing_rbw(tmp_path):
+    """Regression: block recovery finalizes a partial rbw replica at its
+    on-disk length."""
+    store = BlockStore(str(tmp_path / "bs"))
+    cs = DataChecksum(512)
+    rep = store.create_rbw(Block(11, 100), cs)
+    rep.write_packet(b"x" * 700, cs.checksums_for(b"x" * 700))
+    rep.fsync()
+    rep.close()  # interrupted write: rbw retained, never finalized
+    store.update_gen_stamp(11, 101)
+    final = store.finalize_existing(11)
+    assert final.state == Replica.FINALIZED
+    assert final.num_bytes == 700
+    assert final.gen_stamp == 101
+    assert [b.block_id for b in store.all_finalized()] == [11]

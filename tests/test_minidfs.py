@@ -231,13 +231,16 @@ def test_namenode_restart_after_checkpoint(cluster, fs):
 
 
 def test_lease_recovery_on_abandoned_writer(cluster, fs):
-    """A writer that vanishes must not lock the file forever."""
+    """A writer that vanishes must not lock the file forever — and flushed
+    data must survive via block recovery (rbw replicas finalized at their
+    length; ref: internalReleaseLease → block recovery)."""
+    payload = b"some data that will be recovered"
     out = fs.create("/abandoned.txt")
-    out.write(b"some data that will be recovered")
+    out.write(payload)
     out.flush()
     # Simulate writer death: stop renewing (kill the renewer + client ref).
     fs.client._renewer_stop.set()
-    deadline = time.monotonic() + 15
+    deadline = time.monotonic() + 20
     fs2 = cluster.get_filesystem()
     recovered = False
     while time.monotonic() < deadline:
@@ -249,6 +252,7 @@ def test_lease_recovery_on_abandoned_writer(cluster, fs):
             pass
         time.sleep(0.3)
     assert recovered
+    assert fs2.read_all("/abandoned.txt") == payload  # flushed bytes durable
     # Restart the renewer thread machinery for later tests.
     fs.client._renewer_stop = None
     fs.client._open_files = 0

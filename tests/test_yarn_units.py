@@ -1,0 +1,235 @@
+"""YARN component unit tests: dispatcher, state machines, schedulers.
+(Parity targets: ref TestAsyncDispatcher, TestStateMachine (implicit via
+rmapp tests), TestCapacityScheduler, TestFifoScheduler.)"""
+
+import time
+
+import pytest
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.yarn.common import (AsyncDispatcher, Event,
+                                    InvalidStateTransitionError,
+                                    StateMachineFactory)
+from hadoop_tpu.yarn.records import (ApplicationId, ContainerId, NodeId,
+                                     Resource, ResourceRequest)
+from hadoop_tpu.yarn.scheduler import CapacityScheduler, FifoScheduler
+
+
+# ----------------------------------------------------------------- records
+
+
+def test_resource_arithmetic():
+    a = Resource(1024, 2, 1)
+    b = Resource(512, 1, 0)
+    assert b.fits_in(a)
+    assert not a.fits_in(b)
+    assert a.add(b).memory_mb == 1536
+    assert a.subtract(b).tpu_chips == 1
+    total = Resource(10240, 20, 8)
+    assert Resource(1024, 1, 4).dominant_share(total) == 0.5  # tpu dominates
+
+
+def test_id_formats():
+    app = ApplicationId(1700000000, 7)
+    assert str(app) == "application_1700000000_0007"
+    assert ApplicationId.parse(str(app)) == app
+    cid = ContainerId(app, 1, 42)
+    assert str(cid) == "container_1700000000_0007_01_000042"
+    assert ContainerId.from_wire(cid.to_wire()) == cid
+
+
+# -------------------------------------------------------------- dispatcher
+
+
+def test_dispatcher_routes_and_survives_handler_errors():
+    d = AsyncDispatcher()
+    seen = []
+
+    def handler(ev):
+        if ev.etype == "boom":
+            raise RuntimeError("handler failure")
+        seen.append(ev.etype)
+
+    d.register("cat", handler)
+    d.init(Configuration(load_defaults=False))
+    d.start()
+    try:
+        d.dispatch("cat", Event("a"))
+        d.dispatch("cat", Event("boom"))  # must not kill the loop
+        d.dispatch("cat", Event("b"))
+        deadline = time.monotonic() + 5
+        while len(seen) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert seen == ["a", "b"]
+    finally:
+        d.stop()
+
+
+# ------------------------------------------------------------ state machine
+
+
+def test_state_machine_transitions():
+    hooks = []
+    factory = (StateMachineFactory("NEW")
+               .add("NEW", "RUNNING", "start",
+                    lambda o, p: hooks.append(("start", p)))
+               .add("RUNNING", ("DONE", "FAILED"), "finish",
+                    lambda o, p: "DONE" if p == 0 else "FAILED"))
+    sm = factory.make(object())
+    assert sm.state == "NEW"
+    sm.handle("start", "payload")
+    assert sm.state == "RUNNING"
+    assert hooks == [("start", "payload")]
+    with pytest.raises(InvalidStateTransitionError):
+        sm.handle("start")
+    sm.handle("finish", 1)
+    assert sm.state == "FAILED"
+
+    sm2 = factory.make(object())
+    sm2.handle("start", None)
+    sm2.handle("finish", 0)
+    assert sm2.state == "DONE"
+
+
+# --------------------------------------------------------------- scheduler
+
+
+def _mk_cid(attempt_id, seq):
+    parts = attempt_id.rsplit("_", 1)
+    return ContainerId(ApplicationId.parse(parts[0]), int(parts[1]), seq)
+
+
+def _fifo():
+    return FifoScheduler(Configuration(load_defaults=False), _mk_cid)
+
+
+def test_fifo_allocates_on_heartbeat():
+    s = _fifo()
+    n1 = NodeId("h1", 1)
+    s.add_node(n1, Resource(4096, 8, 0), "h1:1")
+    s.add_app("application_1_0001_01", "default", "u")
+    s.allocate("application_1_0001_01",
+               [ResourceRequest(1, 2, Resource(1024, 1))], [])
+    s.node_heartbeat(n1)
+    allocated, _ = s.allocate("application_1_0001_01", [], [])
+    assert len(allocated) == 2
+    assert all(c.node_id == n1 for c in allocated)
+    assert s.nodes[n1].available.memory_mb == 4096 - 2048
+
+
+def test_fifo_respects_capacity_limits():
+    s = _fifo()
+    n1 = NodeId("h1", 1)
+    s.add_node(n1, Resource(2048, 8, 0), "h1:1")
+    s.add_app("application_1_0001_01", "default", "u")
+    s.allocate("application_1_0001_01",
+               [ResourceRequest(1, 5, Resource(1024, 1))], [])
+    s.node_heartbeat(n1)
+    allocated, _ = s.allocate("application_1_0001_01", [], [])
+    assert len(allocated) == 2  # only 2 fit
+    # Free one → next heartbeat grants one more.
+    s.allocate("application_1_0001_01", [],
+               [allocated[0].container_id])
+    s.node_heartbeat(n1)
+    more, _ = s.allocate("application_1_0001_01", [], [])
+    assert len(more) == 1
+
+
+def test_tpu_chips_are_scheduling_dimension():
+    s = _fifo()
+    n1 = NodeId("h1", 1)
+    s.add_node(n1, Resource(8192, 16, 4), "h1:1")
+    s.add_app("application_1_0001_01", "default", "u")
+    s.allocate("application_1_0001_01",
+               [ResourceRequest(1, 8, Resource(512, 1, 1))], [])
+    s.node_heartbeat(n1)
+    allocated, _ = s.allocate("application_1_0001_01", [], [])
+    assert len(allocated) == 4  # chip-bound, not memory-bound
+    assert s.nodes[n1].available.tpu_chips == 0
+
+
+def test_node_locality_request():
+    s = _fifo()
+    s.add_node(NodeId("h1", 1), Resource(4096, 8, 0), "h1:1")
+    s.add_node(NodeId("h2", 2), Resource(4096, 8, 0), "h2:2")
+    s.add_app("application_1_0001_01", "default", "u")
+    s.allocate("application_1_0001_01",
+               [ResourceRequest(1, 1, Resource(512, 1), host="h2")], [])
+    s.node_heartbeat(NodeId("h1", 1))  # wrong host: nothing
+    allocated, _ = s.allocate("application_1_0001_01", [], [])
+    assert allocated == []
+    s.node_heartbeat(NodeId("h2", 2))
+    allocated, _ = s.allocate("application_1_0001_01", [], [])
+    assert len(allocated) == 1
+    assert allocated[0].node_id.host == "h2"
+
+
+def test_node_removal_reports_lost_containers():
+    s = _fifo()
+    n1 = NodeId("h1", 1)
+    s.add_node(n1, Resource(4096, 8, 0), "h1:1")
+    s.add_app("application_1_0001_01", "default", "u")
+    s.allocate("application_1_0001_01",
+               [ResourceRequest(1, 1, Resource(512, 1))], [])
+    s.node_heartbeat(n1)
+    allocated, _ = s.allocate("application_1_0001_01", [], [])
+    assert len(allocated) == 1
+    s.remove_node(n1)
+    _, completed = s.allocate("application_1_0001_01", [], [])
+    assert len(completed) == 1
+    assert completed[0].exit_code == -100  # lost
+
+
+def _capacity(queues="a,b", caps=None):
+    conf = Configuration(load_defaults=False)
+    conf.set("yarn.scheduler.capacity.root.queues", queues)
+    for q, c in (caps or {}).items():
+        conf.set(f"yarn.scheduler.capacity.root.{q}.capacity", c)
+    return CapacityScheduler(conf, _mk_cid)
+
+
+def test_capacity_unknown_queue_rejected():
+    s = _capacity()
+    with pytest.raises(ValueError, match="unknown queue"):
+        s.add_app("application_1_0001_01", "nope", "u")
+
+
+def test_capacity_under_served_queue_wins():
+    s = _capacity(caps={"a": "50", "b": "50"})
+    n1 = NodeId("h1", 1)
+    s.add_node(n1, Resource(4096, 8, 0), "h1:1")
+    s.add_app("application_1_0001_01", "a", "u")
+    s.add_app("application_1_0002_01", "b", "u")
+    # Queue a grabs 3GB of 4GB (75% > its 50% share).
+    s.allocate("application_1_0001_01",
+               [ResourceRequest(1, 3, Resource(1024, 1))], [])
+    s.node_heartbeat(n1)
+    a1, _ = s.allocate("application_1_0001_01", [], [])
+    assert len(a1) == 3
+    # Now both queues ask for the last GB; b (0% used of 50%) must win.
+    s.allocate("application_1_0001_01",
+               [ResourceRequest(1, 1, Resource(1024, 1))], [])
+    s.allocate("application_1_0002_01",
+               [ResourceRequest(1, 1, Resource(1024, 1))], [])
+    s.node_heartbeat(n1)
+    a2, _ = s.allocate("application_1_0002_01", [], [])
+    assert len(a2) == 1
+    a1b, _ = s.allocate("application_1_0001_01", [], [])
+    assert a1b == []
+
+
+def test_capacity_max_capacity_hard_cap():
+    s = _capacity(caps={"a": "50", "b": "50"})
+    conf_cap = s.queues["a"]
+    conf_cap.max_capacity = 0.5  # a may never exceed half the cluster
+    n1 = NodeId("h1", 1)
+    s.add_node(n1, Resource(4096, 8, 0), "h1:1")
+    s.add_app("application_1_0001_01", "a", "u")
+    s.allocate("application_1_0001_01",
+               [ResourceRequest(1, 4, Resource(1024, 1))], [])
+    s.node_heartbeat(n1)
+    a1, _ = s.allocate("application_1_0001_01", [], [])
+    assert len(a1) == 2  # capped at 50% despite free space
+    s.node_heartbeat(n1)
+    a2, _ = s.allocate("application_1_0001_01", [], [])
+    assert a2 == []
