@@ -1,0 +1,19 @@
+"""Model families for the TPU compute engine.
+
+One functional decoder core (``hadoop_tpu.models.decoder``) with family
+presets (``hadoop_tpu.models.config``):
+
+- ``gpt2``    — LayerNorm + learned positions + GeLU MLP
+- ``llama``   — RMSNorm + RoPE + SwiGLU + grouped-query attention
+- ``mixtral`` — llama core with a top-k routed mixture-of-experts MLP
+
+Parameters are stored layer-stacked (leading ``n_layers`` dim) so pipeline
+parallelism shards them over the ``pp`` mesh axis and the single-device
+path runs them under ``lax.scan`` — one compiled layer body either way.
+"""
+
+from hadoop_tpu.models.config import ModelConfig, PRESETS, get_config
+from hadoop_tpu.models.decoder import init_params, forward, count_params
+
+__all__ = ["ModelConfig", "PRESETS", "get_config", "init_params", "forward",
+           "count_params"]

@@ -1,0 +1,300 @@
+"""Functional decoder-only transformer core.
+
+Design (TPU-first):
+
+- **Layer-stacked parameters**: every per-layer weight is one array with a
+  leading ``n_layers`` dim. The single-device path runs layers under
+  ``lax.scan`` (one compiled layer body); the pipeline-parallel path shards
+  the same leading dim over the ``pp`` mesh axis. No per-layer Python
+  objects, no dynamic shapes.
+- **One body, many placements**: ``layer_forward`` takes a ``ParallelCtx``
+  naming the mesh axes it is running under. With all axes ``None`` it is
+  the single-device reference; inside ``shard_map`` the same code inserts
+  the Megatron-style collectives (all-gather/reduce-scatter for sequence
+  parallelism, psum after row-parallel matmuls, all-to-all for experts).
+  This is the tensor-parallel semantics of Megatron's
+  ColumnParallelLinear/RowParallelLinear re-expressed as SPMD collectives
+  over ICI rather than NCCL calls.
+
+Weight layout notes: qkv/gate/up projections are column-parallel (output
+dim sharded over ``tp``), out/down projections are row-parallel (input dim
+sharded, psum after) — so inside shard_map the local arrays are simply the
+narrow slices and the math is unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from hadoop_tpu.models.config import ModelConfig
+from hadoop_tpu.ops import (apply_rope, causal_attention, gelu, layer_norm,
+                            rms_norm, rope_frequencies, swiglu)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelCtx:
+    """Names of the mesh axes the current trace runs under (None = absent).
+
+    tp_axis:  tensor parallelism (heads / ff / vocab sharding, psum).
+    megatron_sp: sequence parallelism on the tp axis (activations between
+        blocks are sequence-sharded; all-gather in, reduce-scatter out).
+    ep_axis:  expert parallelism (experts sharded, all_to_all dispatch).
+    ring_axis: context parallelism (sequence sharded end-to-end, ring
+        attention rotates K/V with ppermute).
+    """
+    tp_axis: Optional[str] = None
+    tp_size: int = 1
+    megatron_sp: bool = False
+    ep_axis: Optional[str] = None
+    ep_size: int = 1
+    ring_axis: Optional[str] = None
+    ring_size: int = 1
+
+    @property
+    def seq_offset_fn(self):
+        return None
+
+
+SINGLE = ParallelCtx()
+
+
+# ----------------------------------------------------------------- params
+
+def init_params(rng: jax.Array, cfg: ModelConfig) -> Dict[str, Any]:
+    """Initialize the full (unsharded) parameter pytree."""
+    k_embed, k_layers, k_head, k_pos = jax.random.split(rng, 4)
+    dt = cfg.jax_dtype
+    D, L, F, V = cfg.d_model, cfg.n_layers, cfg.d_ff, cfg.vocab_size
+    Hq, Hkv, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    def winit(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dt)
+
+    ks = jax.random.split(k_layers, 16)
+    layers: Dict[str, jnp.ndarray] = {
+        "attn_norm_w": jnp.ones((L, D), dt),
+        "wq": winit(ks[0], (L, D, Hq * Dh), D),
+        "wk": winit(ks[1], (L, D, Hkv * Dh), D),
+        "wv": winit(ks[2], (L, D, Hkv * Dh), D),
+        "wo": winit(ks[3], (L, Hq * Dh, D), Hq * Dh),
+        "mlp_norm_w": jnp.ones((L, D), dt),
+    }
+    if not cfg.use_rmsnorm:
+        layers["attn_norm_b"] = jnp.zeros((L, D), dt)
+        layers["mlp_norm_b"] = jnp.zeros((L, D), dt)
+    if cfg.is_moe:
+        E = cfg.n_experts
+        layers["router"] = winit(ks[4], (L, D, E), D)
+        layers["w_gate"] = winit(ks[5], (L, E, D, F), D)
+        layers["w_up"] = winit(ks[6], (L, E, D, F), D)
+        layers["w_down"] = winit(ks[7], (L, E, F, D), F)
+    elif cfg.use_swiglu:
+        layers["w_gate"] = winit(ks[5], (L, D, F), D)
+        layers["w_up"] = winit(ks[6], (L, D, F), D)
+        layers["w_down"] = winit(ks[7], (L, F, D), F)
+    else:
+        layers["w_in"] = winit(ks[5], (L, D, F), D)
+        layers["b_in"] = jnp.zeros((L, F), dt)
+        layers["w_out"] = winit(ks[6], (L, F, D), F)
+        layers["b_out"] = jnp.zeros((L, D), dt)
+
+    params: Dict[str, Any] = {
+        "embed": winit(k_embed, (V, D), D),
+        "layers": layers,
+        "final_norm_w": jnp.ones((D,), dt),
+    }
+    if not cfg.use_rmsnorm:
+        params["final_norm_b"] = jnp.zeros((D,), dt)
+    if not cfg.use_rope:
+        params["pos_embed"] = winit(k_pos, (cfg.max_seq, D), D)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = winit(k_head, (D, V), D)
+    return params
+
+
+def count_params(params) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+
+# ------------------------------------------------------------------ norms
+
+def _norm(x, w, b, cfg: ModelConfig):
+    if cfg.use_rmsnorm:
+        return rms_norm(x, w, cfg.norm_eps)
+    return layer_norm(x, w, b, cfg.norm_eps)
+
+
+# -------------------------------------------------------------- attention
+
+def _attention_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
+    """Pre-norm attention with residual. x: [B, S_local, D]."""
+    resid = x
+    h = _norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"), cfg)
+
+    if ctx.megatron_sp:
+        # sequence-sharded activations -> full sequence for attention
+        h = jax.lax.all_gather(h, ctx.tp_axis, axis=1, tiled=True)
+
+    B, S, _ = h.shape
+    # local head counts (already sharded if tp): infer from weight shapes
+    hq_local = lp["wq"].shape[-1] // cfg.head_dim
+    hkv_local = lp["wk"].shape[-1] // cfg.head_dim
+    q = (h @ lp["wq"]).reshape(B, S, hq_local, cfg.head_dim)
+    k = (h @ lp["wk"]).reshape(B, S, hkv_local, cfg.head_dim)
+    v = (h @ lp["wv"]).reshape(B, S, hkv_local, cfg.head_dim)
+
+    if cfg.use_rope:
+        if ctx.ring_axis is not None:
+            offs = jax.lax.axis_index(ctx.ring_axis) * S
+            positions = offs + jnp.arange(S)
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+        else:
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+
+    if ctx.ring_axis is not None:
+        from hadoop_tpu.parallel.ring_attention import ring_attention
+        attn = ring_attention(q, k, v, axis_name=ctx.ring_axis,
+                              axis_size=ctx.ring_size)
+    else:
+        attn = causal_attention(q, k, v)
+
+    out = attn.reshape(B, S, hq_local * cfg.head_dim) @ lp["wo"]
+    if ctx.tp_axis is not None:
+        if ctx.megatron_sp:  # reduce + re-scatter the sequence in one op
+            out = jax.lax.psum_scatter(out, ctx.tp_axis,
+                                       scatter_dimension=1, tiled=True)
+        else:
+            out = jax.lax.psum(out, ctx.tp_axis)
+    return resid + out.astype(resid.dtype)
+
+
+# -------------------------------------------------------------------- mlp
+
+def _dense_mlp(h, lp, cfg: ModelConfig):
+    if cfg.use_swiglu:
+        return swiglu(h @ lp["w_gate"], h @ lp["w_up"]) @ lp["w_down"]
+    return gelu(h @ lp["w_in"] + lp["b_in"]) @ lp["w_out"] + lp["b_out"]
+
+
+def _mlp_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx):
+    resid = x
+    h = _norm(x, lp["mlp_norm_w"], lp.get("mlp_norm_b"), cfg)
+    if ctx.megatron_sp:
+        h = jax.lax.all_gather(h, ctx.tp_axis, axis=1, tiled=True)
+    if cfg.is_moe:
+        from hadoop_tpu.models.moe import moe_mlp
+        out = moe_mlp(h, lp, cfg, ctx)
+    else:
+        out = _dense_mlp(h, lp, cfg)
+    if ctx.tp_axis is not None:
+        if ctx.megatron_sp:
+            out = jax.lax.psum_scatter(out, ctx.tp_axis,
+                                       scatter_dimension=1, tiled=True)
+        else:
+            out = jax.lax.psum(out, ctx.tp_axis)
+    return resid + out.astype(resid.dtype)
+
+
+# ------------------------------------------------------------------ layer
+
+def layer_forward(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
+    """One transformer block. lp: this layer's weights (no leading L dim)."""
+    x = _attention_block(x, lp, cfg, ctx, cos, sin)
+    x = _mlp_block(x, lp, cfg, ctx)
+    return x
+
+
+def run_layers(x, layers, cfg: ModelConfig, ctx: ParallelCtx, cos, sin,
+               remat: bool = False):
+    """scan the (local slice of the) layer stack over x."""
+    from hadoop_tpu.ops.vma import pvary_to, tree_vma, vma_of
+    body = layer_forward
+    if remat:
+        body = jax.checkpoint(
+            body, static_argnums=(2, 3))  # cfg, ctx are static pytrees
+
+    def step(h, lp):
+        return body(h, lp, cfg, ctx, cos, sin), None
+
+    # the carry leaves the scan varying over every axis the layer weights
+    # vary over; the initial carry must match
+    out, _ = jax.lax.scan(step, pvary_to(x, vma_of(x) | tree_vma(layers)),
+                          layers)
+    return out
+
+
+# ------------------------------------------------------------- embeddings
+
+def embed_tokens(params, tokens, cfg: ModelConfig, ctx: ParallelCtx):
+    """Token (+ position) embedding; vocab-parallel under tp.
+
+    tokens: [B, S_local] int32. Returns [B, S_local, D] (sequence-scattered
+    if megatron_sp).
+    """
+    embed = params["embed"]
+    if ctx.tp_axis is not None:
+        # vocab-parallel: each shard holds rows [lo, lo+Vl)
+        vl = embed.shape[0]
+        lo = jax.lax.axis_index(ctx.tp_axis) * vl
+        local_ids = tokens - lo
+        ok = (local_ids >= 0) & (local_ids < vl)
+        h = jnp.where(ok[..., None],
+                      embed[jnp.clip(local_ids, 0, vl - 1)], 0)
+        if ctx.megatron_sp:
+            h = jax.lax.psum_scatter(h.astype(jnp.float32), ctx.tp_axis,
+                                     scatter_dimension=1, tiled=True)
+            h = h.astype(embed.dtype)
+        else:
+            h = jax.lax.psum(h.astype(jnp.float32),
+                             ctx.tp_axis).astype(embed.dtype)
+    else:
+        h = embed[tokens]
+    if not cfg.use_rope:
+        S = tokens.shape[1]
+        if ctx.ring_axis is not None:
+            offs = jax.lax.axis_index(ctx.ring_axis) * S
+            pos = params["pos_embed"][offs + jnp.arange(S)]
+        elif ctx.megatron_sp:
+            # h is sequence-scattered: add the matching pos-embed slice
+            sl = S // ctx.tp_size
+            offs = jax.lax.axis_index(ctx.tp_axis) * sl
+            pos = jax.lax.dynamic_slice_in_dim(
+                params["pos_embed"], offs, sl, axis=0)
+            return h + pos[None]
+        else:
+            pos = params["pos_embed"][:S]
+        h = h + pos[None]
+    return h
+
+
+def lm_logits(params, h, cfg: ModelConfig, ctx: ParallelCtx = None):
+    """Final norm + LM head. Under tp the head weight is vocab-sharded and
+    the returned logits are the local vocab slice. Under Megatron sequence
+    parallelism the final norm runs on the sequence shard and the full
+    sequence is gathered just before the head (Megatron's exit gather)."""
+    ctx = ctx or SINGLE
+    h = _norm(h, params["final_norm_w"], params.get("final_norm_b"), cfg)
+    if ctx.megatron_sp:
+        h = jax.lax.all_gather(h, ctx.tp_axis, axis=1, tiled=True)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return h @ head.astype(h.dtype)
+
+
+# ---------------------------------------------------------------- forward
+
+def forward(params, tokens, cfg: ModelConfig, ctx: ParallelCtx = SINGLE,
+            remat: bool = False):
+    """Full forward to logits. Single-device when ctx is SINGLE; inside
+    shard_map the ctx axes drive collectives. (Pipeline parallelism wraps
+    run_layers differently — see hadoop_tpu.parallel.pipeline.)"""
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    h = embed_tokens(params, tokens, cfg, ctx)
+    h = run_layers(h, params["layers"], cfg, ctx, cos, sin, remat=remat)
+    return lm_logits(params, h, cfg, ctx)

@@ -1,0 +1,16 @@
+"""Activation functions for transformer MLP blocks."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def gelu(x: jnp.ndarray) -> jnp.ndarray:
+    """tanh-approximated GeLU; XLA fuses this into the preceding matmul."""
+    return jax.nn.gelu(x, approximate=True)
+
+
+def swiglu(gate: jnp.ndarray, up: jnp.ndarray) -> jnp.ndarray:
+    """SwiGLU gating: silu(gate) * up (Llama/Mixtral MLPs)."""
+    return jax.nn.silu(gate) * up

@@ -1,0 +1,93 @@
+"""Causal (grouped-query) attention.
+
+The portable path is a jnp softmax-attention that XLA maps onto the MXU; a
+fused Pallas flash kernel lives in ``hadoop_tpu.ops.flash`` and is selected
+explicitly on TPU backends.
+
+Ring attention (sequence/context parallelism over the mesh) builds on
+``chunk_attention`` + ``merge_attention``: each partial result is the
+*chunk-normalized* output plus its per-row log-sum-exp, and two partials
+merge by log-add-exp weighting — the standard online-softmax recombination.
+See ``hadoop_tpu.parallel.ring_attention``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """Expand KV heads for grouped-query attention: [B,S,Hkv,D] -> [B,S,Hkv*n,D]."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+        b, s, h * n_rep, d)
+
+
+def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                     scale: float | None = None,
+                     q_offset: int | jnp.ndarray = 0,
+                     kv_offset: int | jnp.ndarray = 0) -> jnp.ndarray:
+    """Causal self-attention.
+
+    q: [B, Sq, Hq, D]; k, v: [B, Skv, Hkv, D] with Hq a multiple of Hkv
+    (grouped-query). ``q_offset``/``kv_offset`` are absolute positions of the
+    first query/key token — sequence-parallel shards pass their slice start
+    so masking stays globally causal. Returns [B, Sq, Hq, D].
+    """
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    k = _repeat_kv(k, hq // hkv)
+    v = _repeat_kv(v, hq // hkv)
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    qpos = q_offset + jnp.arange(sq)
+    kpos = kv_offset + jnp.arange(skv)
+    mask = qpos[:, None] >= kpos[None, :]
+    logits = jnp.where(mask[None, None, :, :], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def chunk_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    scale: float,
+                    q_positions: jnp.ndarray,
+                    kv_positions: jnp.ndarray):
+    """Attention of q against one K/V chunk, as an online-softmax partial.
+
+    Shapes: q [B,Sq,H,D]; k,v [B,Sk,H,D] (KV heads already expanded).
+    Returns (out [B,Sq,H,D] float32 — normalized within this chunk,
+    lse [B,Sq,H] float32 — log-sum-exp of visible logits; -inf rows, i.e.
+    rows with no visible keys, produce out=0 and act as the merge identity).
+    """
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    mask = q_positions[:, None] >= kv_positions[None, :]
+    logits = jnp.where(mask[None, None, :, :], logits, -jnp.inf)
+    row_max = jnp.max(logits, axis=-1, keepdims=True)            # [B,H,Sq,1]
+    safe_max = jnp.where(jnp.isfinite(row_max), row_max, 0.0)
+    unnorm = jnp.exp(logits - safe_max)                          # masked -> 0
+    denom = jnp.sum(unnorm, axis=-1)                             # [B,H,Sq]
+    out = jnp.einsum("bhqk,bkhd->bqhd", unnorm, v.astype(jnp.float32))
+    out = out / jnp.maximum(denom, 1e-30).transpose(0, 2, 1)[..., None]
+    lse = jnp.where(denom > 0,
+                    jnp.log(jnp.maximum(denom, 1e-30)) + safe_max[..., 0],
+                    -jnp.inf)
+    return out, jnp.transpose(lse, (0, 2, 1))                    # lse [B,Sq,H]
+
+
+def merge_attention(out_a, lse_a, out_b, lse_b):
+    """Merge two (chunk-normalized out, lse) partials into one."""
+    lse_new = jnp.logaddexp(lse_a, lse_b)
+    safe = jnp.where(jnp.isfinite(lse_new), lse_new, 0.0)
+    wa = jnp.where(jnp.isfinite(lse_a), jnp.exp(lse_a - safe), 0.0)
+    wb = jnp.where(jnp.isfinite(lse_b), jnp.exp(lse_b - safe), 0.0)
+    out = out_a * wa[..., None] + out_b * wb[..., None]
+    return out, lse_new

@@ -1,0 +1,55 @@
+"""Token cross-entropy, plain and vocab-parallel.
+
+The vocab-parallel form computes the softmax normalizer with two ``psum``s
+over the tensor-parallel axis so each shard only ever materializes its own
+vocab slice of the logits — the memory-critical trick for large-vocab
+models. Must be called inside ``shard_map`` with ``axis_name`` bound.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray):
+    """Mean cross-entropy. logits [B,S,V] (any float dtype), targets [B,S] int."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    target_logit = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - target_logit)
+
+
+def vocab_parallel_cross_entropy(local_logits: jnp.ndarray,
+                                 targets: jnp.ndarray,
+                                 axis_name: str,
+                                 vocab_shard_size: int):
+    """Cross-entropy where logits are sharded over the vocab dim.
+
+    local_logits: [B,S,V/tp] — this shard's slice of the vocab.
+    targets: [B,S] global token ids.
+    The global normalizer needs psum(max) then psum(sumexp); the target
+    logit is found by masking ids outside this shard's [lo, hi) range and
+    psum-ing the (single nonzero) contribution.
+    """
+    local_logits = local_logits.astype(jnp.float32)
+    idx = jax.lax.axis_index(axis_name)
+    lo = idx * vocab_shard_size
+
+    # the max shift is numerics-only; keep it out of the autodiff graph
+    # (lax.pmax has no differentiation rule)
+    local_max = jax.lax.stop_gradient(jnp.max(local_logits, axis=-1))
+    global_max = jax.lax.pmax(local_max, axis_name)
+    sumexp = jnp.sum(jnp.exp(local_logits - global_max[..., None]), axis=-1)
+    global_sumexp = jax.lax.psum(sumexp, axis_name)
+    lse = jnp.log(global_sumexp) + global_max
+
+    local_ids = targets - lo
+    in_shard = (local_ids >= 0) & (local_ids < vocab_shard_size)
+    safe_ids = jnp.clip(local_ids, 0, vocab_shard_size - 1)
+    picked = jnp.take_along_axis(
+        local_logits, safe_ids[..., None], axis=-1)[..., 0]
+    target_logit = jax.lax.psum(jnp.where(in_shard, picked, 0.0), axis_name)
+
+    return jnp.mean(lse - target_logit)

@@ -1,0 +1,43 @@
+"""Rotary position embeddings (RoPE).
+
+Frequencies are precomputed once per model config (static shapes) and the
+rotation is a pure elementwise op, so XLA folds it into the QK projection
+epilogue. Rotation is applied in float32 for accuracy, then cast back.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_seq: int, theta: float = 10000.0):
+    """Return (cos, sin) tables of shape [max_seq, head_dim // 2], float32."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    pos = jnp.arange(max_seq, dtype=jnp.float32)
+    angles = jnp.outer(pos, inv_freq)  # [S, D/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
+               positions: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Rotate q or k of shape [..., S, H, D] by position.
+
+    ``positions``: optional [S] int array of absolute positions (used by
+    sequence-parallel shards that own a slice of the sequence); defaults to
+    0..S-1.
+    """
+    seq = x.shape[-3]
+    if positions is None:
+        c = cos[:seq]
+        s = sin[:seq]
+    else:
+        c = cos[positions]
+        s = sin[positions]
+    # [S, D/2] -> [S, 1, D/2] to broadcast over heads.
+    c = c[:, None, :]
+    s = s[:, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = jnp.split(xf, 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,25 @@
+"""Device-mesh parallelism: the ICI data plane of the framework.
+
+Where the reference scales by forking JVMs across hosts and exchanging
+bytes over TCP/HTTP (ref: SURVEY.md §2.7 — RPC control plane,
+DataTransferProtocol bulk plane, shuffle HTTP plane), the TPU compute
+engine scales by laying a ``jax.sharding.Mesh`` over the pod and letting
+XLA collectives ride ICI:
+
+- ``mesh``           — mesh plans (dp/pp/tp/ep/sp axes) + parameter
+                       PartitionSpecs
+- ``train``          — the sharded train step (shard_map, manual
+                       collectives, grads + fused AdamW on local shards)
+- ``pipeline``       — pipeline-parallel schedule over the pp axis
+                       (ppermute microbatch rotation)
+- ``ring_attention`` — context parallelism: K/V rotation with running
+                       log-sum-exp merge
+- ``optimizer``      — fused AdamW on local shards (the distributed
+                       optimizer: state is sharded exactly like params)
+- ``collectives``    — host-level all_to_all/sort primitives reused by the
+                       compute engine (device-path shuffle)
+"""
+
+from hadoop_tpu.parallel.mesh import MeshPlan, make_mesh, param_specs
+
+__all__ = ["MeshPlan", "make_mesh", "param_specs"]

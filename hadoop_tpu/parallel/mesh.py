@@ -1,0 +1,150 @@
+"""Mesh plans and parameter sharding rules.
+
+A ``MeshPlan`` names the five parallel axes. All five always exist on the
+mesh (size-1 axes are free), so one set of PartitionSpecs covers every
+plan; the ``ParallelCtx`` handed to the model only names axes with size>1
+so degenerate collectives are elided at trace time.
+
+Axis roles:
+- ``dp`` data parallelism (batch)
+- ``pp`` pipeline parallelism (layer-stack leading dim)
+- ``tp`` tensor parallelism (heads / ffn / vocab; Megatron sequence
+  parallelism rides this axis when enabled)
+- ``ep`` expert parallelism (MoE expert dim; also shards the batch,
+  i.e. dp×ep ranks are all data-parallel for non-expert params)
+- ``sp`` context parallelism (sequence dim end-to-end, ring attention)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from hadoop_tpu.models.config import ModelConfig
+from hadoop_tpu.models.decoder import ParallelCtx
+
+AXES = ("dp", "pp", "tp", "ep", "sp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    dp: int = 1
+    pp: int = 1
+    tp: int = 1
+    ep: int = 1
+    sp: int = 1
+    megatron_sp: bool = False   # sequence parallelism on the tp axis
+
+    def __post_init__(self):
+        if self.megatron_sp and self.tp == 1:
+            raise ValueError("megatron_sp requires tp > 1")
+        if self.sp > 1 and (self.tp > 1 or self.pp > 1):
+            raise ValueError("ring context parallelism (sp) is composed "
+                             "with dp only in this version")
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.pp * self.tp * self.ep * self.sp
+
+    @property
+    def batch_axes(self):
+        """Mesh axes that shard the batch (grad-allreduce axes)."""
+        axes = ["dp"]
+        if self.ep > 1:
+            axes.append("ep")
+        return tuple(axes)
+
+    def ctx(self, cfg: ModelConfig) -> ParallelCtx:
+        return ParallelCtx(
+            tp_axis="tp" if self.tp > 1 else None,
+            tp_size=self.tp,
+            megatron_sp=self.megatron_sp,
+            ep_axis="ep" if (self.ep > 1 and cfg.is_moe) else None,
+            ep_size=self.ep,
+            ring_axis="sp" if self.sp > 1 else None,
+            ring_size=self.sp,
+        )
+
+    def validate(self, cfg: ModelConfig, batch: int, seq: int,
+                 n_microbatches: int = 1) -> None:
+        checks = [
+            (cfg.n_layers % self.pp == 0, "n_layers %% pp"),
+            (cfg.vocab_size % self.tp == 0, "vocab %% tp"),
+            (cfg.n_heads % self.tp == 0, "heads %% tp"),
+            (cfg.n_kv_heads % self.tp == 0, "kv heads %% tp"),
+            (cfg.d_ff % self.tp == 0, "d_ff %% tp"),
+            (batch % (self.dp * self.ep) == 0, "batch %% dp*ep"),
+            (seq % self.sp == 0, "seq %% sp"),
+            (not self.megatron_sp or seq % self.tp == 0, "seq %% tp (sp)"),
+            (not cfg.is_moe or cfg.n_experts % self.ep == 0, "experts %% ep"),
+            (self.ep == 1 or cfg.is_moe, "ep needs a MoE config"),
+            ((batch // (self.dp * self.ep)) % n_microbatches == 0,
+             "local batch %% microbatches"),
+        ]
+        for ok, what in checks:
+            if not ok:
+                raise ValueError(f"plan/config mismatch: {what} "
+                                 f"(plan={self}, cfg={cfg.family})")
+
+
+def make_mesh(plan: MeshPlan, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if len(devices) < plan.n_devices:
+        raise ValueError(
+            f"plan needs {plan.n_devices} devices, have {len(devices)}")
+    arr = np.array(devices[: plan.n_devices]).reshape(
+        plan.dp, plan.pp, plan.tp, plan.ep, plan.sp)
+    return Mesh(arr, AXES)
+
+
+def param_specs(cfg: ModelConfig, plan: MeshPlan) -> Dict[str, Any]:
+    """PartitionSpec pytree matching ``models.decoder.init_params``."""
+    layers: Dict[str, P] = {
+        "attn_norm_w": P("pp", None),
+        "wq": P("pp", None, "tp"),
+        "wk": P("pp", None, "tp"),
+        "wv": P("pp", None, "tp"),
+        "wo": P("pp", "tp", None),
+        "mlp_norm_w": P("pp", None),
+    }
+    if not cfg.use_rmsnorm:
+        layers["attn_norm_b"] = P("pp", None)
+        layers["mlp_norm_b"] = P("pp", None)
+    if cfg.is_moe:
+        layers["router"] = P("pp", None, None)
+        layers["w_gate"] = P("pp", "ep", None, "tp")
+        layers["w_up"] = P("pp", "ep", None, "tp")
+        layers["w_down"] = P("pp", "ep", "tp", None)
+    elif cfg.use_swiglu:
+        layers["w_gate"] = P("pp", None, "tp")
+        layers["w_up"] = P("pp", None, "tp")
+        layers["w_down"] = P("pp", "tp", None)
+    else:
+        layers["w_in"] = P("pp", None, "tp")
+        layers["b_in"] = P("pp", "tp")
+        layers["w_out"] = P("pp", "tp", None)
+        layers["b_out"] = P("pp", None)
+
+    specs: Dict[str, Any] = {
+        "embed": P("tp", None),
+        "layers": layers,
+        "final_norm_w": P(),
+    }
+    if not cfg.use_rmsnorm:
+        specs["final_norm_b"] = P()
+    if not cfg.use_rope:
+        specs["pos_embed"] = P()
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def shard_params(params, mesh: Mesh, specs):
+    """Place an (unsharded) param tree onto the mesh per the spec tree."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, jax.sharding.NamedSharding(mesh, s)),
+        params, specs)

@@ -1,0 +1,218 @@
+"""The sharded training step: one shard_map over the whole mesh.
+
+Megatron-style *manual* SPMD: the body sees local shards, and every
+cross-device exchange is an explicit XLA collective over ICI —
+
+- tp   : psum after row-parallel matmuls, vocab-parallel CE psums
+- sp(tp): all_gather / psum_scatter of sequence-sharded activations
+- pp   : ppermute microbatch rotation (GPipe schedule; autodiff produces
+         the backward interleave)
+- ep   : all_to_all expert dispatch
+- sp   : ppermute K/V ring (ring attention)
+- dp/ep: psum of gradients
+- grads + fused AdamW run on local shards (distributed optimizer)
+
+Gradient reduction rule: a leaf's gradient is psum'd over every *data*
+axis (dp, ep, sp, plus pp always and tp only under sequence parallelism —
+the cases where ranks see different tokens or stages) that does NOT
+appear in the leaf's PartitionSpec; axes in the spec mean the leaf is
+sharded there and its gradient is already local-complete.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from hadoop_tpu.models.config import ModelConfig
+from hadoop_tpu.models.decoder import (embed_tokens, forward, lm_logits,
+                                       run_layers)
+from hadoop_tpu.models.decoder import init_params as _init_params
+from hadoop_tpu.ops import rope_frequencies, softmax_cross_entropy
+from hadoop_tpu.ops.cross_entropy import vocab_parallel_cross_entropy
+from hadoop_tpu.parallel.mesh import MeshPlan, param_specs, shard_params
+from hadoop_tpu.parallel.optimizer import (AdamWState, adamw_init,
+                                           adamw_update)
+
+try:  # stable name first, experimental fallback
+    _shard_map_fn = jax.shard_map  # type: ignore[attr-defined]
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map_fn
+
+
+def _smap(f, mesh, in_specs, out_specs):
+    # check_vma=True (the default) is load-bearing for correctness: the
+    # varying-manual-axes tracking is what makes collective TRANSPOSES
+    # insert the cotangent psums for replicated values used in
+    # rank-divergent pathways (residual streams feeding vocab-sliced
+    # heads, embeddings feeding only stage 0, ...). With it, gradients
+    # of replicated params come out fully reduced over every axis whose
+    # ranks see different data — the only manual step left is the
+    # mean-vs-sum scaling (see make_train_step).
+    return _shard_map_fn(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs)
+
+
+def _spec_axes(spec) -> set:
+    names = set()
+    for part in spec:
+        if part is None:
+            continue
+        if isinstance(part, tuple):
+            names.update(part)
+        else:
+            names.add(part)
+    return names
+
+
+def _loss_from_h(params, h, targets, cfg: ModelConfig, ctx):
+    logits = lm_logits(params, h, cfg, ctx)
+    if ctx.tp_axis is not None:
+        return vocab_parallel_cross_entropy(
+            logits, targets, ctx.tp_axis, cfg.vocab_size // ctx.tp_size)
+    return softmax_cross_entropy(logits, targets)
+
+
+def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
+                    lr: float = 3e-4, n_microbatches: int = 1,
+                    remat: bool = False, donate: bool = True,
+                    optimizer: str = "adamw"):
+    """Build the jitted sharded train step.
+
+    Returns fn(params, opt_state, tokens, targets) ->
+    (params, opt_state, metrics) where tokens/targets are global
+    [batch, seq] int32 arrays (batch sharded over dp×ep, sequence over sp).
+    """
+    ctx = plan.ctx(cfg)
+    specs = param_specs(cfg, plan)
+    data_spec = P(("dp", "ep"), "sp")
+
+    # Data axes: each rank's local loss covers 1/data_ranks of the global
+    # batch. The autodiff objective is effectively sum-over-data-ranks (the
+    # vma transpose machinery psums cotangents of replicated params), so
+    # gradients of the global *mean* loss need one uniform scale.
+    loss_div = plan.dp * plan.ep * plan.sp
+
+    def _reduce_grads(grads):
+        if loss_div == 1:
+            return grads
+        return jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) / loss_div).astype(g.dtype),
+            grads)
+
+    def _global_grad_sq(grads):
+        def leaf(g, s):
+            local = jnp.sum(jnp.square(g.astype(jnp.float32)))
+            shard_axes = tuple(sorted(_spec_axes(s)))
+            return jax.lax.psum(local, shard_axes) if shard_axes else local
+        parts = jax.tree_util.tree_map(leaf, grads, specs)
+        return functools.reduce(
+            jnp.add, jax.tree_util.tree_leaves(parts))
+
+    # ------------------------------------------------------------ losses
+
+    def flat_loss(params, tokens, targets):
+        logits = forward(params, tokens, cfg, ctx, remat=remat)
+        if ctx.tp_axis is not None:
+            return vocab_parallel_cross_entropy(
+                logits, targets, ctx.tp_axis, cfg.vocab_size // ctx.tp_size)
+        return softmax_cross_entropy(logits, targets)
+
+    def pipelined_loss(params, tokens, targets):
+        M = n_microbatches
+        Pp = plan.pp
+        B_l, S = tokens.shape
+        tok_mb = tokens.reshape(M, B_l // M, S)
+        tgt_mb = targets.reshape(M, B_l // M, S)
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq,
+                                    cfg.rope_theta)
+        stage = jax.lax.axis_index("pp")
+        s_act = S // plan.tp if plan.megatron_sp else S
+        perm = [(i, i + 1) for i in range(Pp - 1)]
+
+        def step(recv, t):
+            mb_in = jnp.clip(t, 0, M - 1)
+            x0 = embed_tokens(params, jnp.take(tok_mb, mb_in, axis=0),
+                              cfg, ctx)
+            x_in = jnp.where(stage == 0, x0, recv)
+            y = run_layers(x_in, params["layers"], cfg, ctx, cos, sin,
+                           remat=remat)
+            out_i = t - (Pp - 1)
+            mb_out = jnp.clip(out_i, 0, M - 1)
+            loss_mb = _loss_from_h(
+                params, y, jnp.take(tgt_mb, mb_out, axis=0), cfg, ctx)
+            take = (stage == Pp - 1) & (out_i >= 0) & (out_i < M)
+            loss_t = jnp.where(take, loss_mb, 0.0)
+            recv2 = jax.lax.ppermute(y, "pp", perm)
+            return recv2, loss_t
+
+        from hadoop_tpu.ops.vma import pvary_to
+        from hadoop_tpu.parallel.mesh import AXES
+        # activations vary over every mesh axis: dp/ep/sp from the data,
+        # pp/tp from the weights (vma is tracked even on size-1 axes)
+        recv0 = pvary_to(
+            jnp.zeros((B_l // M, s_act, cfg.d_model), cfg.jax_dtype), AXES)
+        _, losses = jax.lax.scan(step, recv0, jnp.arange(M + Pp - 1))
+        return jax.lax.psum(jnp.sum(losses), "pp") / M
+
+    loss_fn = pipelined_loss if plan.pp > 1 else flat_loss
+
+    # -------------------------------------------------------------- body
+
+    from hadoop_tpu.ops.vma import vma_of
+
+    def body(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        grads = _reduce_grads(grads)
+        # sum the per-data-rank losses over whatever axes the loss still
+        # varies on (real data axes, plus identity-psums on size-1 axes)
+        # and turn the sum into the global batch mean
+        rem = tuple(sorted(vma_of(loss)))
+        if rem:
+            loss = jax.lax.psum(loss, rem)
+        loss = loss / loss_div
+        gsq = _global_grad_sq(grads)
+        if optimizer == "sgd":
+            # plain SGD: exact-parity testing mode (no adaptive-state
+            # amplification of float accumulation noise)
+            new_params = jax.tree_util.tree_map(
+                lambda p, g: (p.astype(jnp.float32)
+                              - lr * g.astype(jnp.float32)).astype(p.dtype),
+                params, grads)
+            new_opt = AdamWState(opt_state.count + 1, opt_state.mu,
+                                 opt_state.nu)
+            gnorm = jnp.sqrt(gsq)
+        else:
+            new_params, new_opt, gnorm = adamw_update(
+                params, grads, opt_state, lr, gsq=gsq)
+        metrics = {"loss": loss, "grad_norm": gnorm}
+        return new_params, new_opt, metrics
+
+    opt_specs = AdamWState(count=P(), mu=specs, nu=specs)
+    mapped = _smap(
+        body, mesh,
+        in_specs=(specs, opt_specs, data_spec, data_spec),
+        out_specs=(specs, opt_specs, {"loss": P(), "grad_norm": P()}))
+    return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
+
+
+def init_sharded(rng, cfg: ModelConfig, plan: MeshPlan, mesh: Mesh):
+    """Initialize params + optimizer state and place them on the mesh."""
+    params = _init_params(rng, cfg)
+    specs = param_specs(cfg, plan)
+    params = shard_params(params, mesh, specs)
+    opt = adamw_init(params)
+    opt = AdamWState(
+        count=jax.device_put(
+            opt.count, jax.sharding.NamedSharding(mesh, P())),
+        mu=shard_params(opt.mu, mesh, specs),
+        nu=shard_params(opt.nu, mesh, specs))
+    return params, opt
+
+
+def make_data_sharding(mesh: Mesh):
+    return jax.sharding.NamedSharding(mesh, P(("dp", "ep"), "sp"))

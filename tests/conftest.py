@@ -7,11 +7,20 @@ ref: MiniDFSCluster.java:157): JAX must see these flags before first import.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't default: the environment's sitecustomize force-registers
+# the tunneled TPU (axon) PJRT plugin and overrides JAX_PLATFORMS, so the
+# env var alone is not enough — jax.config.update is authoritative.
+# Tests always run on the virtual 8-device CPU mesh for determinism and
+# multi-chip coverage.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import logging
 

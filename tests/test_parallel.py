@@ -1,0 +1,131 @@
+"""Parallel-plan parity tests on the virtual 8-device CPU mesh.
+
+The contract under test: every mesh plan computes the SAME training step
+as the single-device reference — same loss, same updated parameters —
+with the placement (tp psums, sp gathers, pp ppermute, ep all_to_all,
+ring attention) being pure implementation detail. This is the compute
+engine's minicluster pattern (ref: SURVEY.md §4 — real protocols,
+simulated fleet).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hadoop_tpu.models import get_config, init_params
+from hadoop_tpu.parallel import MeshPlan, make_mesh
+from hadoop_tpu.parallel.train import (init_sharded, make_data_sharding,
+                                       make_train_step)
+from hadoop_tpu.parallel.optimizer import adamw_init
+
+BATCH, SEQ = 8, 32
+
+
+def _data(cfg, key=7):
+    k1 = jax.random.PRNGKey(key)
+    tokens = jax.random.randint(k1, (BATCH, SEQ), 0, cfg.vocab_size,
+                                dtype=jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+    return tokens, targets
+
+
+def _run_plan(cfg, plan, n_steps=2, n_microbatches=1, optimizer="sgd"):
+    mesh = make_mesh(plan)
+    plan.validate(cfg, BATCH, SEQ, n_microbatches)
+    step = make_train_step(cfg, plan, mesh, lr=1e-2,
+                           n_microbatches=n_microbatches, donate=False,
+                           optimizer=optimizer)
+    params, opt = init_sharded(jax.random.PRNGKey(0), cfg, plan, mesh)
+    ds = make_data_sharding(mesh)
+    tokens, targets = _data(cfg)
+    tokens = jax.device_put(tokens, ds)
+    targets = jax.device_put(targets, ds)
+    losses = []
+    for _ in range(n_steps):
+        params, opt, m = step(params, opt, tokens, targets)
+        losses.append(float(m["loss"]))
+    gathered = jax.tree_util.tree_map(np.asarray, jax.device_get(params))
+    return losses, gathered
+
+
+def _assert_tree_close(a, b, rtol=2e-4, atol=2e-4):
+    flat_a = jax.tree_util.tree_leaves_with_path(a)
+    flat_b = dict(jax.tree_util.tree_leaves_with_path(b))
+    for path, leaf in flat_a:
+        other = flat_b[path]
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(other), rtol=rtol, atol=atol,
+            err_msg=f"mismatch at {jax.tree_util.keystr(path)}")
+
+
+@pytest.fixture(scope="module")
+def reference_dense():
+    cfg = get_config("tiny")
+    return _run_plan(cfg, MeshPlan())
+
+
+def test_single_device_plan_trains(reference_dense):
+    losses, _ = reference_dense
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+
+
+def test_adamw_trains():
+    cfg = get_config("tiny")
+    losses, _ = _run_plan(cfg, MeshPlan(), n_steps=5, optimizer="adamw")
+    assert losses[-1] < losses[0]
+
+
+def test_dp_tp_parity(reference_dense):
+    cfg = get_config("tiny")
+    losses, params = _run_plan(cfg, MeshPlan(dp=2, tp=2))
+    ref_losses, ref_params = reference_dense
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
+    _assert_tree_close(params, ref_params)
+
+
+def test_dp_pp_tp_parity(reference_dense):
+    cfg = get_config("tiny")
+    losses, params = _run_plan(cfg, MeshPlan(dp=2, pp=2, tp=2),
+                               n_microbatches=2)
+    ref_losses, ref_params = reference_dense
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
+    _assert_tree_close(params, ref_params)
+
+
+def test_sequence_parallel_parity(reference_dense):
+    cfg = get_config("tiny")
+    losses, params = _run_plan(
+        cfg, MeshPlan(dp=2, pp=2, tp=2, megatron_sp=True),
+        n_microbatches=2)
+    ref_losses, ref_params = reference_dense
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
+    _assert_tree_close(params, ref_params)
+
+
+def test_ring_attention_parity(reference_dense):
+    cfg = get_config("tiny")
+    losses, params = _run_plan(cfg, MeshPlan(dp=2, sp=4))
+    ref_losses, ref_params = reference_dense
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
+    _assert_tree_close(params, ref_params)
+
+
+def test_moe_ep_parity():
+    cfg = get_config("tiny-moe", capacity_factor=4.0)
+    ref_losses, ref_params = _run_plan(cfg, MeshPlan())
+    losses, params = _run_plan(cfg, MeshPlan(dp=2, ep=2, tp=2))
+    assert ref_losses[-1] < ref_losses[0]
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
+    _assert_tree_close(params, ref_params)
+
+
+def test_plan_validation_rejects_bad_shapes():
+    cfg = get_config("tiny")
+    with pytest.raises(ValueError):
+        MeshPlan(dp=2, tp=3).validate(cfg, BATCH, SEQ)
+    with pytest.raises(ValueError):
+        MeshPlan(sp=2, tp=2)
+    with pytest.raises(ValueError):
+        MeshPlan(megatron_sp=True)
