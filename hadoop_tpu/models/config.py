@@ -73,6 +73,12 @@ PRESETS = {
                                 d_model=4096, n_layers=32, n_heads=32,
                                 n_kv_heads=8, d_ff=14336, max_seq=8192,
                                 n_experts=8, top_k=2, rope_theta=1e6),
+    # flagship for single-chip bench/entry: llama-style ~420M that fits
+    # one v5e chip with optimizer state
+    "flagship-420m": ModelConfig(family="llama", vocab_size=32768,
+                                 d_model=1024, n_layers=24, n_heads=16,
+                                 n_kv_heads=8, d_ff=2816, max_seq=2048,
+                                 rope_theta=500000.0),
     # tiny configs for tests and the multi-chip dryrun
     "tiny": ModelConfig(family="llama", vocab_size=256, d_model=64,
                         n_layers=4, n_heads=4, n_kv_heads=2, d_ff=128,
