@@ -1,0 +1,186 @@
+"""TeraGen / TeraSort / TeraValidate — the canonical sort benchmark.
+
+Parity with the reference terasort suite (ref: hadoop-mapreduce-examples/
+src/main/java/org/apache/hadoop/examples/terasort/{TeraGen,TeraSort,
+TeraValidate}.java): 100-byte records (10-byte key + 90-byte payload),
+globally sorted output via a total-order partitioner built from sampled cut
+points (ref: TeraSort.TotalOrderPartitioner + TeraInputFormat.writePartitionFile
+sampling), validation checks intra- and inter-partition order plus record
+count. This triple is the end-to-end acceptance test of the compute engine
+(SURVEY §7: the minimum-slice smoke test) and the TeraSort bytes/sec
+benchmark harness (SURVEY §6).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from hadoop_tpu.fs import FileSystem
+from hadoop_tpu.mapreduce.api import (FixedLengthInputFormat,
+                                      FixedLengthOutputFormat, Mapper,
+                                      Partitioner, Reducer)
+
+RECORD_LEN = 100
+KEY_LEN = 10
+CUTS_KEY = "terasort.partition.cutpoints"
+
+
+# ----------------------------------------------------------------- teragen
+
+
+def teragen(fs: FileSystem, out_dir: str, num_records: int,
+            num_files: int = 3, seed: int = 1234) -> None:
+    """Deterministic 100-byte records, striped over ``num_files`` files.
+    Ref: TeraGen.java (its 10-byte keys come from a seeded PRNG too)."""
+    fs.mkdirs(out_dir)
+    per_file = [num_records // num_files] * num_files
+    per_file[-1] += num_records - sum(per_file)
+    row = 0
+    for i, count in enumerate(per_file):
+        stream = fs.create(f"{out_dir}/part-{i:05d}", overwrite=True)
+        try:
+            buf = bytearray()
+            for _ in range(count):
+                key = hashlib.sha256(f"{seed}:{row}".encode()).digest()[:KEY_LEN]
+                payload = (f"{row:020d}".encode() +
+                           bytes((row + j) & 0x7F for j in range(70)))
+                buf += key + payload
+                row += 1
+                if len(buf) >= 1 << 20:
+                    stream.write(bytes(buf))
+                    buf.clear()
+            if buf:
+                stream.write(bytes(buf))
+        finally:
+            stream.close()
+
+
+# ----------------------------------------------------------------- terasort
+
+
+class TeraSortMapper(Mapper):
+    pass  # identity — sorting happens in the framework
+
+
+class TeraSortReducer(Reducer):
+    pass  # identity — values stream out in key order
+
+
+class TotalOrderPartitioner(Partitioner):
+    """Route keys by sampled cut points so partition i's keys all sort
+    before partition i+1's. Ref: TeraSort.TotalOrderPartitioner (the
+    reference builds a trie over the same cut points)."""
+
+    def __init__(self):
+        self._cuts: List[bytes] = []
+
+    def configure(self, conf: Dict[str, str]) -> None:
+        packed = conf.get(CUTS_KEY, "")
+        self._cuts = ([base64.b64decode(c) for c in packed.split(",")]
+                      if packed else [])
+
+    def partition(self, key: bytes, num_reduces: int) -> int:
+        # binary search over cut points
+        lo, hi = 0, len(self._cuts)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if key < self._cuts[mid]:
+                hi = mid
+            else:
+                lo = mid + 1
+        return min(lo, num_reduces - 1)
+
+
+def sample_cutpoints(fs: FileSystem, input_dir: str, num_reduces: int,
+                     sample_per_file: int = 1000) -> List[bytes]:
+    """Client-side key sampling at submit time.
+    Ref: TeraInputFormat.writePartitionFile — samples input keys and writes
+    R-1 split points before the job starts."""
+    keys: List[bytes] = []
+    for st in fs.list_status(input_dir):
+        if st.is_dir or st.length == 0:
+            continue
+        stream = fs.open(st.path)
+        try:
+            n = min(sample_per_file, st.length // RECORD_LEN)
+            for i in range(n):
+                row = stream.read(RECORD_LEN)
+                if len(row) < RECORD_LEN:
+                    break
+                keys.append(row[:KEY_LEN])
+        finally:
+            stream.close()
+    keys.sort()
+    if not keys or num_reduces <= 1:
+        return []
+    return [keys[len(keys) * i // num_reduces]
+            for i in range(1, num_reduces)]
+
+
+def make_terasort_job(rm_addr, default_fs: str, input_dir: str,
+                      output_dir: str, num_reduces: int = 3,
+                      split_mb: int = 1):
+    from hadoop_tpu.mapreduce import Job
+    fs = FileSystem.get(default_fs)
+    try:
+        cuts = sample_cutpoints(fs, input_dir, num_reduces)
+    finally:
+        fs.close()
+    job = (Job(rm_addr, default_fs, name="terasort")
+           .set_mapper(TeraSortMapper)
+           .set_reducer(TeraSortReducer)
+           .set_partitioner(TotalOrderPartitioner)
+           .set_input_format(FixedLengthInputFormat)
+           .set_output_format(FixedLengthOutputFormat)
+           .add_input_path(input_dir)
+           .set_output_path(output_dir)
+           .set_num_reduces(num_reduces)
+           .set(FixedLengthInputFormat.RECORD_LENGTH_KEY, str(RECORD_LEN))
+           .set("mapreduce.input.fixedlength.key.length", str(KEY_LEN))
+           .set("mapreduce.input.split.size", str(split_mb * 1024 * 1024))
+           .set(CUTS_KEY,
+                ",".join(base64.b64encode(c).decode() for c in cuts)))
+    return job
+
+
+# --------------------------------------------------------------- validate
+
+
+def teravalidate(fs: FileSystem, output_dir: str) -> Tuple[int, List[str]]:
+    """Check global sort order + return (record_count, errors).
+    Ref: TeraValidate.java — per-part order check + boundary check between
+    consecutive parts via first/last keys."""
+    errors: List[str] = []
+    total = 0
+    prev_last: Optional[bytes] = None
+    parts = sorted(st.path for st in fs.list_status(output_dir)
+                   if not st.is_dir and "part-" in st.path)
+    for path in parts:
+        stream = fs.open(path)
+        try:
+            last: Optional[bytes] = None
+            first: Optional[bytes] = None
+            while True:
+                row = stream.read(RECORD_LEN)
+                if not row:
+                    break
+                if len(row) != RECORD_LEN:
+                    errors.append(f"{path}: short record {len(row)}B")
+                    break
+                key = row[:KEY_LEN]
+                if first is None:
+                    first = key
+                if last is not None and key < last:
+                    errors.append(f"{path}: out of order at record {total}")
+                last = key
+                total += 1
+            if first is not None and prev_last is not None \
+                    and first < prev_last:
+                errors.append(f"{path}: first key below previous part's last")
+            if last is not None:
+                prev_last = last
+        finally:
+            stream.close()
+    return total, errors

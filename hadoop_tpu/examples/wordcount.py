@@ -1,0 +1,37 @@
+"""WordCount — the canonical MapReduce example.
+
+Parity with the reference example (ref: hadoop-mapreduce-examples/src/main/
+java/org/apache/hadoop/examples/WordCount.java): tokenize lines, emit
+(word, 1), sum in a combiner + reducer.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Tuple
+
+from hadoop_tpu.mapreduce.api import Mapper, Reducer, TaskContext
+
+
+class TokenizerMapper(Mapper):
+    def map(self, key: bytes, value: bytes, ctx: TaskContext) -> None:
+        for word in value.split():
+            ctx.emit(word, b"1")
+
+
+class IntSumReducer(Reducer):
+    def reduce(self, key: bytes, values: Iterator[bytes],
+               ctx: TaskContext) -> None:
+        total = sum(int(v) for v in values)
+        ctx.emit(key, str(total).encode())
+
+
+def make_job(rm_addr: Tuple[str, int], default_fs: str,
+             input_path: str, output_path: str, num_reduces: int = 2):
+    from hadoop_tpu.mapreduce import Job
+    return (Job(rm_addr, default_fs, name="wordcount")
+            .set_mapper(TokenizerMapper)
+            .set_combiner(IntSumReducer)
+            .set_reducer(IntSumReducer)
+            .add_input_path(input_path)
+            .set_output_path(output_path)
+            .set_num_reduces(num_reduces))

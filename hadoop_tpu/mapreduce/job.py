@@ -1,0 +1,206 @@
+"""Job — client-side configuration, submission, and monitoring.
+
+Parity with the reference's job client (ref: mapreduce/Job.java:1566 submit,
+:1590 waitForCompletion; mapreduce/JobSubmitter.java:139 submitJobInternal —
+compute splits, stage job resources, hand off to the cluster; YARN hand-off
+ref: mapred/YARNRunner.java:110). Submission stages ``job.json`` (descriptor
++ splits, the analog of job.xml + job.split) into a per-job staging directory
+on the default filesystem, then submits a YARN application whose AM is
+``hadoop_tpu.mapreduce.appmaster``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.fs import FileSystem
+from hadoop_tpu.fs.filesystem import Path
+from hadoop_tpu.mapreduce.api import (HashPartitioner, InputFormat,
+                                      TextInputFormat, TextOutputFormat,
+                                      class_ref)
+from hadoop_tpu.yarn.client import YarnClient
+from hadoop_tpu.yarn.records import (ApplicationSubmissionContext, AppState,
+                                     ContainerLaunchContext, Resource)
+
+log = logging.getLogger(__name__)
+
+
+class JobFailedError(RuntimeError):
+    def __init__(self, msg: str, diagnostics: Optional[List[str]] = None):
+        super().__init__(msg)
+        self.diagnostics = diagnostics or []
+
+
+class Job:
+    """Configure + run one MapReduce job."""
+
+    def __init__(self, rm_addr: Tuple[str, int], default_fs: str,
+                 name: str = "job", conf: Optional[Configuration] = None):
+        self.rm_addr = rm_addr
+        self.default_fs = default_fs
+        self.name = name
+        self.cluster_conf = conf or Configuration()
+        self.job_id = f"job_{uuid.uuid4().hex[:12]}"
+        self.conf: Dict[str, str] = {}
+        self.mapper = "hadoop_tpu.mapreduce.api:Mapper"
+        self.reducer = "hadoop_tpu.mapreduce.api:Reducer"
+        self.combiner: Optional[str] = None
+        self.partitioner = class_ref(HashPartitioner)
+        self.input_format = class_ref(TextInputFormat)
+        self.output_format = class_ref(TextOutputFormat)
+        self.input_paths: List[str] = []
+        self.output_path = ""
+        self.num_reduces = 1
+        self._report: Optional[Dict] = None
+        self._app_id = None
+
+    # ------------------------------------------------------------- builders
+
+    def set_mapper(self, cls) -> "Job":
+        self.mapper = class_ref(cls) if isinstance(cls, type) else cls
+        return self
+
+    def set_reducer(self, cls) -> "Job":
+        self.reducer = class_ref(cls) if isinstance(cls, type) else cls
+        return self
+
+    def set_combiner(self, cls) -> "Job":
+        self.combiner = class_ref(cls) if isinstance(cls, type) else cls
+        return self
+
+    def set_partitioner(self, cls) -> "Job":
+        self.partitioner = class_ref(cls) if isinstance(cls, type) else cls
+        return self
+
+    def set_input_format(self, cls) -> "Job":
+        self.input_format = class_ref(cls) if isinstance(cls, type) else cls
+        return self
+
+    def set_output_format(self, cls) -> "Job":
+        self.output_format = class_ref(cls) if isinstance(cls, type) else cls
+        return self
+
+    def add_input_path(self, path: str) -> "Job":
+        self.input_paths.append(path)
+        return self
+
+    def set_output_path(self, path: str) -> "Job":
+        self.output_path = path
+        return self
+
+    def set_num_reduces(self, n: int) -> "Job":
+        self.num_reduces = n
+        return self
+
+    def set(self, key: str, value: str) -> "Job":
+        self.conf[key] = value
+        return self
+
+    # ----------------------------------------------------------- submission
+
+    @property
+    def staging_uri(self) -> str:
+        return f"{self.default_fs}/tmp/staging/{self.job_id}"
+
+    def submit(self):
+        """Ref: JobSubmitter.submitJobInternal:139."""
+        if not self.input_paths or not self.output_path:
+            raise ValueError("input and output paths are required")
+        fs = FileSystem.get(self.default_fs, self.cluster_conf)
+        try:
+            if fs.exists(self.output_path):
+                raise JobFailedError(
+                    f"output path {self.output_path} already exists")
+            from hadoop_tpu.mapreduce.api import load_class
+            fmt: InputFormat = load_class(self.input_format)()
+            splits = fmt.get_splits(fs, self.input_paths, self.conf)
+            if not splits:
+                raise JobFailedError("no input splits computed")
+            descriptor = {
+                "job_id": self.job_id, "name": self.name,
+                "default_fs": self.default_fs,
+                "mapper": self.mapper, "reducer": self.reducer,
+                "combiner": self.combiner,
+                "partitioner": self.partitioner,
+                "input_format": self.input_format,
+                "output_format": self.output_format,
+                "output": self.output_path,
+                "num_reduces": self.num_reduces,
+                "conf": self.conf,
+                "splits": [s.to_wire() for s in splits],
+            }
+            staging_path = Path(self.staging_uri).path
+            fs.mkdirs(staging_path)
+            fs.write_all(f"{staging_path}/job.json",
+                         json.dumps(descriptor).encode())
+        finally:
+            fs.close()
+
+        yc = YarnClient(self.rm_addr, self.cluster_conf)
+        try:
+            app_id, _ = yc.create_application()
+            env = {
+                "PYTHONPATH": _pythonpath(),
+                "HTPU_MR_STAGING": self.staging_uri,
+            }
+            am_mem = int(self.conf.get("yarn.app.mapreduce.am.resource.mb",
+                                       "256"))
+            ctx = ApplicationSubmissionContext(
+                app_id, f"mr:{self.name}",
+                ContainerLaunchContext(
+                    [sys.executable, "-m", "hadoop_tpu.mapreduce.appmaster"],
+                    env),
+                am_resource=Resource(am_mem, 1),
+                queue=self.conf.get("mapreduce.job.queuename", "default"))
+            yc.submit_application(ctx)
+            self._app_id = app_id
+            log.info("submitted %s as %s (%d splits, %d reduces)",
+                     self.job_id, app_id, len(splits), self.num_reduces)
+            return app_id
+        finally:
+            yc.close()
+
+    def wait_for_completion(self, timeout: float = 600.0) -> bool:
+        """Ref: Job.waitForCompletion:1590 — monitor + return success."""
+        if self._app_id is None:
+            self.submit()
+        yc = YarnClient(self.rm_addr, self.cluster_conf)
+        try:
+            report = yc.wait_for_completion(self._app_id, timeout=timeout)
+        finally:
+            yc.close()
+        fs = FileSystem.get(self.default_fs, self.cluster_conf)
+        try:
+            report_path = f"{Path(self.staging_uri).path}/job-report.json"
+            if fs.exists(report_path):
+                self._report = json.loads(fs.read_all(report_path).decode())
+        finally:
+            fs.close()
+        if self._report is None:
+            self._report = {"state": str(report.state),
+                            "counters": {},
+                            "diagnostics": [report.diagnostics]}
+        return (report.state == AppState.FINISHED
+                and self._report.get("state") == "SUCCEEDED")
+
+    @property
+    def counters(self) -> Dict[str, Dict[str, int]]:
+        return (self._report or {}).get("counters", {})
+
+    @property
+    def diagnostics(self) -> List[str]:
+        return (self._report or {}).get("diagnostics", [])
+
+
+def _pythonpath() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    existing = os.environ.get("PYTHONPATH", "")
+    return f"{here}:{existing}" if existing else here

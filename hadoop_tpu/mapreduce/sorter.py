@@ -1,0 +1,163 @@
+"""Map-output collector: in-memory buffer → sort → spill → merge.
+
+Parity with the reference's map-side sort machinery (ref: mapred/MapTask.java
+:888 MapOutputBuffer.collect, :1605 sortAndSpill, mergeParts; combiner run at
+spill and merge time ref: MapTask.java CombinerRunner). The collector
+accumulates (partition, key, value) with byte accounting; when the buffer
+exceeds ``mapreduce.task.io.sort.mb`` it sorts by (partition, key) and spills
+one IFile-segmented run; close() merges all spills into the single
+partitioned ``file.out`` + index that the shuffle serves.
+
+A C++ collector (the reference's own optimization — nativetask, §2.6) plugs
+in behind the same interface via hadoop_tpu.native when built.
+"""
+
+from __future__ import annotations
+
+import heapq
+import os
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from hadoop_tpu.mapreduce import ifile
+from hadoop_tpu.mapreduce.api import Counters
+
+CombinerFn = Optional[Callable[[Iterator[Tuple[bytes, List[bytes]]]],
+                               Iterator[Tuple[bytes, bytes]]]]
+
+
+def merge_sorted_runs(runs: List[List[Tuple[bytes, bytes]]]
+                      ) -> Iterator[Tuple[bytes, bytes]]:
+    """k-way merge of sorted (key, value) runs, stable by run order.
+    Ref: mapred/Merger.java."""
+    return heapq.merge(*runs, key=lambda kv: kv[0])
+
+
+def group_by_key(stream: Iterator[Tuple[bytes, bytes]]
+                 ) -> Iterator[Tuple[bytes, Iterator[bytes]]]:
+    """Turn a key-sorted stream into (key, values-iterator) groups.
+    Ref: mapred/ReduceTask ValuesIterator."""
+    stream = iter(stream)
+    try:
+        pending = next(stream)
+    except StopIteration:
+        return
+    done = False
+    while not done:
+        cur_key = pending[0]
+
+        def values():
+            nonlocal pending, done
+            yield pending[1]
+            for k, v in stream:
+                if k != cur_key:
+                    pending = (k, v)
+                    return
+                yield v
+            done = True
+
+        vit = values()
+        yield cur_key, vit
+        for _ in vit:  # drain if the reducer didn't
+            pass
+
+
+class MapOutputCollector:
+    def __init__(self, num_partitions: int, partition_fn,
+                 spill_dir: str, counters: Counters,
+                 sort_mb: float = 64.0, codec: Optional[str] = None,
+                 combiner: CombinerFn = None):
+        self.num_partitions = num_partitions
+        self.partition_fn = partition_fn
+        self.spill_dir = spill_dir
+        self.counters = counters
+        self.spill_bytes = int(sort_mb * 1024 * 1024)
+        self.codec = codec
+        self.combiner = combiner
+        self._parts: List[List[Tuple[bytes, bytes]]] = [
+            [] for _ in range(num_partitions)]
+        self._bytes = 0
+        self._spills: List[Tuple[str, ifile.SpillIndex]] = []
+        os.makedirs(spill_dir, exist_ok=True)
+
+    def collect(self, key: bytes, value: bytes) -> None:
+        p = self.partition_fn(key, self.num_partitions)
+        self._parts[p].append((key, value))
+        self._bytes += len(key) + len(value) + 16
+        self.counters.incr(Counters.MAP_OUTPUT_RECORDS)
+        self.counters.incr(Counters.MAP_OUTPUT_BYTES, len(key) + len(value))
+        if self._bytes >= self.spill_bytes:
+            self._sort_and_spill()
+
+    # ------------------------------------------------------------- internals
+
+    def _sorted_runs(self) -> List[List[Tuple[bytes, bytes]]]:
+        runs = []
+        for records in self._parts:
+            records.sort(key=lambda kv: kv[0])
+            if self.combiner is not None and records:
+                before = len(records)
+                records = list(self.combiner(
+                    group_by_key(iter(records))))
+                self.counters.incr(Counters.COMBINE_INPUT_RECORDS, before)
+                self.counters.incr(Counters.COMBINE_OUTPUT_RECORDS,
+                                   len(records))
+            runs.append(records)
+        return runs
+
+    def _sort_and_spill(self) -> None:
+        """Ref: MapTask.sortAndSpill:1605."""
+        runs = self._sorted_runs()
+        n = len(self._spills)
+        path = os.path.join(self.spill_dir, f"spill{n}.out")
+        index = ifile.write_partitioned(path, runs, self.codec)
+        self._spills.append((path, index))
+        self.counters.incr(Counters.SPILLED_RECORDS,
+                           sum(len(r) for r in runs))
+        self._parts = [[] for _ in range(self.num_partitions)]
+        self._bytes = 0
+
+    def close(self, out_path: str) -> ifile.SpillIndex:
+        """Merge spills + in-memory remainder into file.out (+ return index).
+        Ref: MapTask.mergeParts."""
+        if not self._spills:
+            runs = self._sorted_runs()
+            index = ifile.write_partitioned(out_path, runs, self.codec)
+            return index
+        self._sort_and_spill()
+        final_runs: List[List[Tuple[bytes, bytes]]] = []
+        for p in range(self.num_partitions):
+            segs = [ifile.read_partition(path, idx, p, self.codec)
+                    for path, idx in self._spills]
+            merged: Iterator[Tuple[bytes, bytes]] = merge_sorted_runs(segs)
+            if self.combiner is not None and len(self._spills) > 1:
+                merged = self.combiner(group_by_key(merged))
+            final_runs.append(list(merged))
+        index = ifile.write_partitioned(out_path, final_runs, self.codec)
+        for path, _ in self._spills:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        return index
+
+
+def make_combiner(reducer_cls, conf: Dict[str, str],
+                  counters: Counters) -> CombinerFn:
+    """Adapt a Reducer class into a spill-time combiner function.
+    Ref: Task.CombinerRunner.create."""
+
+    def run(groups: Iterator[Tuple[bytes, Iterator[bytes]]]
+            ) -> Iterator[Tuple[bytes, bytes]]:
+        out: List[Tuple[bytes, bytes]] = []
+        from hadoop_tpu.mapreduce.api import TaskContext
+        red = reducer_cls()
+        ctx = TaskContext(conf, counters,
+                          lambda k, v: out.append((k, v)))
+        red.setup(ctx)
+        for key, values in groups:
+            red.reduce(key, values, ctx)
+        red.cleanup(ctx)
+        out.sort(key=lambda kv: kv[0])
+        yield from out
+
+    return run

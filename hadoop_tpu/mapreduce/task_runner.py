@@ -1,0 +1,278 @@
+"""Task container entry point — the YarnChild equivalent.
+
+Parity with the reference's in-container task runtime (ref:
+mapred/YarnChild.java:77 main — connect umbilical, fetch task, run, report;
+mapred/MapTask.java:311 run; mapred/ReduceTask.java:320 run; commit
+handshake ref: Task.done → TaskAttemptListener canCommit). One process runs
+ONE task attempt:
+
+  map:    read split → user Mapper → MapOutputCollector (sort/spill/merge)
+          → attempt-named partitioned output in the node shuffle dir
+          → can_commit → atomic rename to task-named files
+  reduce: poll map completion events → Fetcher pulls this partition from
+          every map's shuffle server → MergeManager final merge →
+          user Reducer → _temporary/<attempt> output → can_commit → rename
+
+A status thread heartbeats progress to the AM (liveness; ref:
+Task.TaskReporter).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.fs import FileSystem
+from hadoop_tpu.ipc import Client, get_proxy
+from hadoop_tpu.mapreduce import ifile, shuffle
+from hadoop_tpu.mapreduce.api import (Counters, FileSplit, TaskContext,
+                                      load_class)
+from hadoop_tpu.mapreduce.sorter import (MapOutputCollector, group_by_key,
+                                         make_combiner)
+
+log = logging.getLogger(__name__)
+
+ENV_AM_ADDRESS = "HTPU_MR_AM_ADDRESS"
+ENV_ATTEMPT_ID = "HTPU_MR_ATTEMPT_ID"
+
+
+class TaskFailure(Exception):
+    pass
+
+
+class _Reporter:
+    """Progress heartbeat to the AM. Ref: Task.TaskReporter."""
+
+    def __init__(self, umbilical, attempt_id: str, counters: Counters,
+                 interval: float = 1.0):
+        self._um = umbilical
+        self.attempt_id = attempt_id
+        self.counters = counters
+        self.progress = 0.0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def set_progress(self, p: float) -> None:
+        self.progress = min(1.0, max(0.0, p))
+
+    def stop(self):
+        self._stop.set()
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self._um.status_update(self.attempt_id, self.progress,
+                                       self.counters.to_wire())
+            except Exception as e:  # noqa: BLE001 — AM may be mid-failover
+                log.debug("status_update failed: %s", e)
+            self._stop.wait(1.0)
+
+
+def _await_commit(umbilical, attempt_id: str, timeout: float = 120.0) -> None:
+    """Ref: Task.commit — poll canCommit until granted."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if umbilical.can_commit(attempt_id):
+            return
+        time.sleep(0.2)
+    raise TaskFailure("commit permission not granted in time")
+
+
+# ------------------------------------------------------------------ map task
+
+
+def run_map(job: Dict, task: Dict, umbilical, attempt_id: str,
+            reporter: _Reporter) -> None:
+    conf = job["conf"]
+    counters = reporter.counters
+    fs = FileSystem.get(job["default_fs"], Configuration())
+    split = FileSplit.from_wire(task["split"])
+    mapper = load_class(job["mapper"])()
+    partitioner = load_class(job["partitioner"])()
+    if hasattr(partitioner, "configure"):  # e.g. TotalOrderPartitioner
+        partitioner.configure(conf)
+    input_format = load_class(job["input_format"])()
+    num_reduces = job["num_reduces"]
+    codec = conf.get("mapreduce.map.output.compress.codec") \
+        if conf.get("mapreduce.map.output.compress") else None
+
+    shuffle_dir = os.environ[shuffle.ENV_SHUFFLE_DIR]
+    combiner = None
+    if job.get("combiner"):
+        combiner = make_combiner(load_class(job["combiner"]), conf, counters)
+    workdir = os.environ.get("HTPU_WORK_DIR", ".")
+    collector = MapOutputCollector(
+        max(num_reduces, 1), partitioner.partition,
+        os.path.join(workdir, "spill"), counters,
+        sort_mb=float(conf.get("mapreduce.task.io.sort.mb", "64")),
+        codec=codec, combiner=combiner)
+
+    ctx = TaskContext(conf, counters, collector.collect, task["task_id"])
+    mapper.setup(ctx)
+    nrec = 0
+    for key, value in input_format.read(fs, split, conf):
+        counters.incr(Counters.MAP_INPUT_RECORDS)
+        mapper.map(key, value, ctx)
+        nrec += 1
+        if nrec % 1000 == 0:
+            reporter.set_progress(0.9 * min(1.0, nrec / (nrec + 1000)))
+    mapper.cleanup(ctx)
+
+    # attempt-named output; committed by rename (speculative attempts write
+    # distinct files, only the one granted can_commit publishes).
+    out_path, idx_path = shuffle.map_output_paths(
+        shuffle_dir, job["job_id"], attempt_id)
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    index = collector.close(out_path)
+    with open(idx_path, "wb") as f:
+        f.write(index.to_bytes())
+    reporter.set_progress(0.95)
+
+    _await_commit(umbilical, attempt_id)
+    final_out, final_idx = shuffle.map_output_paths(
+        shuffle_dir, job["job_id"], task["task_id"])
+    os.replace(out_path, final_out)
+    os.replace(idx_path, final_idx)
+    reporter.set_progress(1.0)
+    fs.close()
+    host = os.environ.get("HTPU_NM_HOST", "127.0.0.1")
+    return f"{host}:{os.environ[shuffle.ENV_SHUFFLE_PORT]}"
+
+
+# --------------------------------------------------------------- reduce task
+
+
+def run_reduce(job: Dict, task: Dict, umbilical, attempt_id: str,
+               reporter: _Reporter) -> None:
+    conf = job["conf"]
+    counters = reporter.counters
+    partition = task["partition"]
+    num_maps = task["num_maps"]
+    codec = conf.get("mapreduce.map.output.compress.codec") \
+        if conf.get("mapreduce.map.output.compress") else None
+    workdir = os.environ.get("HTPU_WORK_DIR", ".")
+
+    merger = shuffle.MergeManager(
+        os.path.join(workdir, "merge"), codec, counters,
+        mem_limit=int(conf.get("mapreduce.reduce.shuffle.memory.limit",
+                               str(128 * 1024 * 1024))))
+    fetcher = shuffle.Fetcher(partition, job["job_id"], merger,
+                              num_threads=int(conf.get(
+                                  "mapreduce.reduce.shuffle.parallelcopies",
+                                  "4")))
+    # shuffle phase: poll completion events until all maps fetched
+    # (ref: Shuffle.java:97 run + EventFetcher)
+    next_event = 0
+    deadline = time.monotonic() + float(
+        conf.get("mapreduce.reduce.shuffle.timeout", "600"))
+    while True:
+        events = umbilical.map_completion_events(job["job_id"], next_event)
+        next_event += len(events)
+        fetcher.add_events([(e["task_id"], e["addr"]) for e in events])
+        got = len(fetcher._seen)
+        reporter.set_progress(0.3 * got / max(num_maps, 1))
+        if got >= num_maps and fetcher.fetched_all():
+            break
+        if time.monotonic() > deadline:
+            raise TaskFailure(
+                f"shuffle timed out with {got}/{num_maps} map outputs")
+        time.sleep(0.1)
+    fetcher.finish()
+    reporter.set_progress(0.35)
+
+    # sort phase is free (runs are sorted; merge is streaming) → reduce phase
+    output_format = load_class(job["output_format"])()
+    reducer = load_class(job["reducer"])()
+    fs = FileSystem.get(job["default_fs"], Configuration())
+    part_name = f"part-r-{partition:05d}"
+    tmp_path = f"{job['output']}/_temporary/{attempt_id}/{part_name}"
+    writer = output_format.open(fs, tmp_path, conf)
+
+    def emit(k: bytes, v: bytes) -> None:
+        counters.incr(Counters.REDUCE_OUTPUT_RECORDS)
+        writer.write(k, v)
+
+    ctx = TaskContext(conf, counters, emit, task["task_id"])
+    reducer.setup(ctx)
+    for key, values in group_by_key(merger.merged_iterator()):
+        counted = _CountingValues(values, counters)
+        reducer.reduce(key, counted, ctx)
+    reducer.cleanup(ctx)
+    writer.close()
+    reporter.set_progress(0.95)
+
+    # two-phase commit (ref: FileOutputCommitter.commitTask)
+    _await_commit(umbilical, attempt_id)
+    final_path = f"{job['output']}/{part_name}"
+    if not fs.rename(tmp_path, final_path):
+        raise TaskFailure(f"commit rename {tmp_path} -> {final_path} failed")
+    fs.delete(f"{job['output']}/_temporary/{attempt_id}", recursive=True)
+    reporter.set_progress(1.0)
+    fs.close()
+
+
+class _CountingValues:
+    def __init__(self, it, counters: Counters):
+        self._it = it
+        self._counters = counters
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        v = next(self._it)
+        self._counters.incr(Counters.REDUCE_INPUT_RECORDS)
+        return v
+
+
+# ----------------------------------------------------------------- main
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    host, _, port = os.environ[ENV_AM_ADDRESS].rpartition(":")
+    attempt_id = os.environ[ENV_ATTEMPT_ID]
+    client = Client(Configuration())
+    umbilical = get_proxy("TaskUmbilicalProtocol", (host, int(port)),
+                          client=client)
+    job = umbilical.get_job()
+    task = umbilical.get_task(attempt_id)
+    if task is None:
+        log.warning("AM has no task for %s; exiting", attempt_id)
+        return 0
+    counters = Counters()
+    reporter = _Reporter(umbilical, attempt_id, counters)
+    reporter.start()
+    try:
+        if task["type"] == "map":
+            shuffle_addr = run_map(job, task, umbilical, attempt_id, reporter)
+        else:
+            run_reduce(job, task, umbilical, attempt_id, reporter)
+            shuffle_addr = ""
+        reporter.stop()
+        umbilical.done(attempt_id, counters.to_wire(), shuffle_addr)
+        return 0
+    except Exception as e:  # noqa: BLE001 — report any failure to the AM
+        reporter.stop()
+        err = f"{type(e).__name__}: {e}\n{traceback.format_exc(limit=8)}"
+        log.error("task %s failed: %s", attempt_id, err)
+        try:
+            umbilical.fatal_error(attempt_id, err)
+        except Exception:  # noqa: BLE001
+            pass
+        return 1
+    finally:
+        client.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -251,3 +251,58 @@ class MiniYARNCluster:
     def __exit__(self, *exc) -> bool:
         self.shutdown()
         return False
+
+
+class MiniMRYarnCluster:
+    """DFS + YARN + shuffle aux service — full MapReduce-on-YARN in one
+    process. Ref: hadoop-mapreduce-client-jobclient MiniMRYarnCluster.java:63
+    (whole-job integration tests like TestMRJobs run on it)."""
+
+    def __init__(self, num_nodes: int = 3,
+                 conf: Optional[Configuration] = None,
+                 base_dir: Optional[str] = None,
+                 node_resource: Optional[dict] = None):
+        self.base_dir = base_dir or tempfile.mkdtemp(prefix="htpu-minimr-")
+        self._owns_dir = base_dir is None
+        self.conf = Configuration(other=conf) if conf else Configuration(
+            load_defaults=False)
+        self.conf.set_if_unset(
+            "yarn.nodemanager.aux-services",
+            "hadoop_tpu.mapreduce.shuffle:ShuffleService")
+        self.dfs = MiniDFSCluster(
+            num_datanodes=num_nodes, conf=self.conf,
+            base_dir=os.path.join(self.base_dir, "dfs"))
+        self.yarn = MiniYARNCluster(
+            num_nodes=num_nodes, conf=self.conf,
+            base_dir=os.path.join(self.base_dir, "yarn"),
+            node_resource=node_resource or {"memory_mb": 8192, "vcores": 16})
+
+    def start(self) -> "MiniMRYarnCluster":
+        self.dfs.start()
+        self.yarn.start()
+        return self
+
+    @property
+    def default_fs(self) -> str:
+        host, port = self.dfs.nn_addr
+        return f"htpu://{host}:{port}"
+
+    @property
+    def rm_addr(self):
+        return self.yarn.rm_addr
+
+    def get_filesystem(self):
+        return self.dfs.get_filesystem()
+
+    def shutdown(self) -> None:
+        self.yarn.shutdown()
+        self.dfs.shutdown()
+        if self._owns_dir:
+            shutil.rmtree(self.base_dir, ignore_errors=True)
+
+    def __enter__(self) -> "MiniMRYarnCluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.shutdown()
+        return False

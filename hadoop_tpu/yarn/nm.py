@@ -133,11 +133,21 @@ class NodeAgent(AbstractService):
         self._client: Optional[Client] = None
         self.rpc: Optional[Server] = None
         self._chip_pool: List[int] = []
+        self.aux_services: List = []
 
     # ------------------------------------------------------------- lifecycle
 
     def service_init(self, conf: Configuration) -> None:
         os.makedirs(self.work_root, exist_ok=True)
+        # Auxiliary services (ref: containermanager/AuxServices.java — how
+        # ShuffleHandler rides the NM): conf lists module:Class entries; each
+        # gets start()/stop() and injects env into every container.
+        self.aux_services = []
+        for ref in conf.get_list("yarn.nodemanager.aux-services"):
+            mod, _, name = ref.partition(":")
+            import importlib
+            cls = getattr(importlib.import_module(mod), name)
+            self.aux_services.append(cls(conf, self.work_root))
         self.resource = Resource(
             conf.get_int("yarn.nodemanager.resource.memory-mb", 8192),
             conf.get_int("yarn.nodemanager.resource.cpu-vcores", 8),
@@ -154,6 +164,8 @@ class NodeAgent(AbstractService):
         self.host = bind_host
 
     def service_start(self) -> None:
+        for aux in self.aux_services:
+            aux.start()
         self.rpc.start()
         self.node_id = NodeId(self.host, self.rpc.port)
         self._rm = get_proxy("ResourceTrackerProtocol", self.rm_addr,
@@ -167,6 +179,11 @@ class NodeAgent(AbstractService):
             running = list(self.containers.values())
         for rc in running:
             self._kill(rc)
+        for aux in self.aux_services:
+            try:
+                aux.stop()
+            except Exception:  # noqa: BLE001
+                pass
         if self.rpc:
             self.rpc.stop()
         if self._client:
@@ -203,6 +220,8 @@ class NodeAgent(AbstractService):
             rc.state = "LOCALIZING"
             self._localize(rc)
             env = dict(rc.ctx.env)
+            for aux in self.aux_services:
+                env.update(aux.container_env())
             env["HTPU_CONTAINER_ID"] = str(cid)
             env["HTPU_WORK_DIR"] = rc.workdir
             if rc.chips:

@@ -1,0 +1,98 @@
+"""Tests for codecs + SequenceFile/MapFile (ref test model:
+hadoop-common/src/test .../io/TestSequenceFile.java, compress/TestCodec.java)."""
+
+import io
+
+import pytest
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.fs.filesystem import LocalFileSystem
+from hadoop_tpu.io import sequencefile as sf
+from hadoop_tpu.io.codecs import CodecFactory, ZstdCodec
+
+
+@pytest.mark.parametrize("name", ["zlib", "gzip", "bzip2", "lzma"])
+def test_codec_roundtrip(name):
+    codec = CodecFactory.get(name)
+    data = b"the quick brown fox " * 1000
+    comp = codec.compress(data)
+    assert len(comp) < len(data)
+    assert codec.decompress(comp) == data
+
+
+def test_zstd_if_available():
+    if not ZstdCodec.available():
+        pytest.skip("libzstd not present")
+    codec = CodecFactory.get("zstd")
+    data = b"abc" * 10000
+    assert codec.decompress(codec.compress(data)) == data
+
+
+def test_codec_by_extension():
+    assert CodecFactory.by_extension("/a/b.gz").name == "gzip"
+    assert CodecFactory.by_extension("/a/b.txt") is None
+
+
+def test_streaming_codec_faces():
+    codec = CodecFactory.get("zlib")
+    sink = io.BytesIO()
+    out = codec.wrap_output(_NoClose(sink))
+    payload = b"0123456789" * 100000
+    out.write(payload)
+    out.close()
+    src = codec.wrap_input(io.BytesIO(sink.getvalue()))
+    got = src.read()
+    assert got == payload
+
+
+class _NoClose:
+    def __init__(self, inner):
+        self._inner = inner
+
+    def write(self, b):
+        return self._inner.write(b)
+
+    def close(self):
+        pass
+
+
+records = [(f"key{i:05d}".encode(), b"value" * (i % 7) + str(i).encode())
+           for i in range(2000)]
+
+
+@pytest.mark.parametrize("compression,codec", [
+    (sf.NONE, "zlib"), (sf.RECORD, "zlib"), (sf.BLOCK, "zlib"),
+    (sf.BLOCK, "bzip2"),
+])
+def test_sequencefile_roundtrip(compression, codec):
+    sink = io.BytesIO()
+    w = sf.Writer(_NoClose(sink), compression=compression, codec=codec,
+                  metadata={"who": "test"})
+    for k, v in records:
+        w.append(k, v)
+    w.close()
+    r = sf.Reader(io.BytesIO(sink.getvalue()))
+    assert r.compression == compression
+    assert r.metadata == {"who": "test"}
+    assert list(r) == records
+
+
+def test_sequencefile_detects_bad_magic():
+    with pytest.raises(IOError):
+        sf.Reader(io.BytesIO(b"JUNKJUNKJUNK"))
+
+
+def test_mapfile(tmp_path):
+    fs = LocalFileSystem(Configuration(load_defaults=False))
+    path = str(tmp_path / "map")
+    w = sf.MapFileWriter(fs, path)
+    for k, v in records:
+        w.append(k, v)
+    w.close()
+    r = sf.MapFileReader(fs, path)
+    assert r.get(b"key00123") == records[123][1]
+    assert r.get(b"nope") is None
+    with pytest.raises(ValueError):
+        w2 = sf.MapFileWriter(fs, str(tmp_path / "m2"))
+        w2.append(b"b", b"")
+        w2.append(b"a", b"")
