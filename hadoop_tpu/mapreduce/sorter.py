@@ -18,8 +18,28 @@ import heapq
 import os
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
+from hadoop_tpu import native as _nat
 from hadoop_tpu.mapreduce import ifile
 from hadoop_tpu.mapreduce.api import Counters
+
+
+def sort_records(records: List[Tuple[bytes, bytes]]
+                 ) -> List[Tuple[bytes, bytes]]:
+    """Sort one partition's records by key, via the native sorter when
+    loaded (the reference's own map-side optimization: nativetask §2.6)."""
+    if _nat.available() and len(records) > 4096:
+        offs: List[int] = []
+        lens: List[int] = []
+        o = 0
+        for k, _ in records:
+            offs.append(o)
+            lens.append(len(k))
+            o += len(k)
+        keybuf = b"".join(k for k, _ in records)
+        idx = _nat.sort_kv(keybuf, offs, lens, [0] * len(records))
+        return [records[i] for i in idx]
+    records.sort(key=lambda kv: kv[0])
+    return records
 
 CombinerFn = Optional[Callable[[Iterator[Tuple[bytes, List[bytes]]]],
                                Iterator[Tuple[bytes, bytes]]]]
@@ -93,7 +113,7 @@ class MapOutputCollector:
     def _sorted_runs(self) -> List[List[Tuple[bytes, bytes]]]:
         runs = []
         for records in self._parts:
-            records.sort(key=lambda kv: kv[0])
+            records = sort_records(records)
             if self.combiner is not None and records:
                 before = len(records)
                 records = list(self.combiner(

@@ -1,0 +1,171 @@
+"""Native host library: build, load, and typed ctypes bindings.
+
+This package is the framework's libhadoop.so equivalent (ref:
+hadoop-common/src/main/native/, loaded by util/NativeCodeLoader.java).
+It follows the reference's optional-native policy (ref: BUILDING.txt:
+173-183): if `libhadoop_tpu.so` is present — or a C++ toolchain is
+available to build it from the checked-in sources — callers get the fast
+path; otherwise every caller has a pure-Python/numpy fallback and the
+framework stays fully functional.
+
+Exposes:
+  crc32c(crc, data)                      one-shot CRC32C
+  crc32c_chunked(data, bpc) -> sums      one call per packet
+  crc32c_verify(data, bpc, sums) -> idx  -1 = ok, else first bad chunk
+  rs_encode(k, m, cell, data) -> parity
+  rs_decode(k, m, cell, shards, present) -> restored shards
+  xor_encode(k, cell, data) -> parity
+  sort_kv(keybuf, offs, lens, parts) -> sorted index list
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "libhadoop_tpu.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    """Try to build the .so from the in-tree sources; quiet on failure."""
+    try:
+        res = subprocess.run(
+            ["make", "-s", "-C", _HERE], capture_output=True, timeout=120)
+        return res.returncode == 0 and os.path.exists(_LIB_PATH)
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.htpu_crc32c.restype = ctypes.c_uint32
+    lib.htpu_crc32c.argtypes = [ctypes.c_uint32, ctypes.c_char_p,
+                                ctypes.c_size_t]
+    lib.htpu_crc32c_chunked.restype = None
+    lib.htpu_crc32c_chunked.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t, u8p]
+    lib.htpu_crc32c_verify.restype = ctypes.c_int64
+    lib.htpu_crc32c_verify.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t, ctypes.c_char_p]
+    lib.htpu_rs_encode.restype = None
+    lib.htpu_rs_encode.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_size_t, ctypes.c_char_p, u8p]
+    lib.htpu_rs_decode.restype = ctypes.c_int
+    lib.htpu_rs_decode.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_size_t, u8p, ctypes.c_char_p]
+    lib.htpu_xor_encode.restype = None
+    lib.htpu_xor_encode.argtypes = [
+        ctypes.c_int, ctypes.c_size_t, ctypes.c_char_p, u8p]
+    lib.htpu_sort_kv.restype = None
+    lib.htpu_sort_kv.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint32)]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first use if possible."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        if os.environ.get("HADOOP_TPU_DISABLE_NATIVE"):
+            _tried = True
+            return None
+        # An operator-supplied prebuilt lib wins over the bundled one
+        # (matches the old crc.py loader's contract).
+        candidates = [os.environ.get("HADOOP_TPU_NATIVE_LIB", ""), _LIB_PATH]
+        if not any(c and os.path.exists(c) for c in candidates):
+            _build()
+        for cand in candidates:
+            if not cand or not os.path.exists(cand):
+                continue
+            try:
+                _lib = _bind(ctypes.CDLL(cand))
+                break
+            except (OSError, AttributeError):
+                continue
+        _tried = True
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+# Resolve (and if needed build) the library at import, not on the first
+# data-plane call: a first-use g++ build under _lock would stall the first
+# packet a daemon serves for the length of the compile.
+get_lib()
+
+
+# ------------------------------------------------------------------ wrappers
+
+def crc32c(crc: int, data: bytes) -> int:
+    return get_lib().htpu_crc32c(crc, data, len(data))
+
+
+def crc32c_chunked(data: bytes, bytes_per_chunk: int) -> bytes:
+    lib = get_lib()
+    n_chunks = (len(data) + bytes_per_chunk - 1) // bytes_per_chunk
+    out = (ctypes.c_uint8 * (4 * n_chunks))()
+    lib.htpu_crc32c_chunked(data, len(data), bytes_per_chunk, out)
+    return bytes(out)
+
+
+def crc32c_verify(data: bytes, bytes_per_chunk: int, sums: bytes) -> int:
+    return get_lib().htpu_crc32c_verify(
+        data, len(data), bytes_per_chunk, sums)
+
+
+def rs_encode(k: int, m: int, cell: int, data: bytes) -> bytes:
+    """data: k contiguous cells → m contiguous parity cells."""
+    lib = get_lib()
+    out = (ctypes.c_uint8 * (m * cell))()
+    lib.htpu_rs_encode(k, m, cell, data, out)
+    return bytes(out)
+
+
+def rs_decode(k: int, m: int, cell: int, shards: bytes,
+              present: Sequence[bool]) -> bytes:
+    """shards: (k+m) contiguous cells; rebuilds absent ones, returns all."""
+    lib = get_lib()
+    buf = (ctypes.c_uint8 * len(shards)).from_buffer_copy(shards)
+    flags = bytes(1 if p else 0 for p in present)
+    rc = lib.htpu_rs_decode(k, m, cell, buf, flags)
+    if rc != 0:
+        raise ValueError(
+            f"RS({k},{m}) decode: only {sum(present)} of {k + m} "
+            "shards present")
+    return bytes(buf)
+
+
+def xor_encode(k: int, cell: int, data: bytes) -> bytes:
+    lib = get_lib()
+    out = (ctypes.c_uint8 * cell)()
+    lib.htpu_xor_encode(k, cell, data, out)
+    return bytes(out)
+
+
+def sort_kv(keybuf: bytes, offs: Sequence[int], lens: Sequence[int],
+            parts: Sequence[int]) -> List[int]:
+    """Sorted record order by (partition, key bytes)."""
+    lib = get_lib()
+    n = len(offs)
+    c_off = (ctypes.c_uint64 * n)(*offs)
+    c_len = (ctypes.c_uint32 * n)(*lens)
+    c_part = (ctypes.c_uint32 * n)(*parts)
+    c_idx = (ctypes.c_uint32 * n)(*range(n))
+    lib.htpu_sort_kv(keybuf, c_off, c_len, c_part, n, c_idx)
+    return list(c_idx)

@@ -1,0 +1,194 @@
+// GF(256) Reed-Solomon + XOR erasure codecs.
+//
+// Role parity with the reference's native EC slice (ref:
+// hadoop-common/src/main/native/src/org/apache/hadoop/io/erasurecode/
+// {erasure_code.c,gf_util.c,jni_rs_encoder.c,jni_rs_decoder.c}, which wraps
+// ISA-L): encode k data cells into m parity cells; decode any k surviving
+// cells back into the full k+m stripe. Schemes RS(6,3), RS(3,2), RS(10,4),
+// XOR(2,1) all ride this one pair of entry points.
+//
+// The generator uses a Cauchy matrix over GF(256) (poly 0x11D, the same
+// field ISA-L uses), which guarantees every k×k submatrix is invertible —
+// so any m losses are recoverable, matching the reference's contract
+// (rawcoder/RSRawDecoder.java).
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr unsigned kPoly = 0x11D;
+
+uint8_t g_exp[512];
+uint8_t g_log[256];
+// 64 KB full multiplication table: mul[a][b] = a*b in GF(256). Hot loops
+// index this directly instead of going through log/exp.
+uint8_t g_mul[256][256];
+
+struct GfInit {
+  GfInit() {
+    unsigned x = 1;
+    for (int i = 0; i < 255; i++) {
+      g_exp[i] = static_cast<uint8_t>(x);
+      g_log[x] = static_cast<uint8_t>(i);
+      x <<= 1;
+      if (x & 0x100) x ^= kPoly;
+    }
+    for (int i = 255; i < 512; i++) g_exp[i] = g_exp[i - 255];
+    for (int a = 0; a < 256; a++)
+      for (int b = 0; b < 256; b++)
+        g_mul[a][b] = (a && b)
+                          ? g_exp[g_log[a] + g_log[b]]
+                          : 0;
+  }
+} g_gf_init;
+
+inline uint8_t gf_mul(uint8_t a, uint8_t b) { return g_mul[a][b]; }
+
+inline uint8_t gf_inv(uint8_t a) { return g_exp[255 - g_log[a]]; }
+
+// rows×k generator for the parity part: Cauchy over disjoint index sets
+// x_i = k+i, y_j = j.
+void cauchy_parity_matrix(int k, int m, uint8_t* mat /* m*k */) {
+  for (int i = 0; i < m; i++)
+    for (int j = 0; j < k; j++)
+      mat[i * k + j] = gf_inv(static_cast<uint8_t>((k + i) ^ j));
+}
+
+// Invert an n×n matrix over GF(256) in place via Gauss-Jordan.
+// Returns false if singular (cannot happen for Cauchy submatrices).
+bool gf_invert(std::vector<uint8_t>& a, int n) {
+  std::vector<uint8_t> inv(n * n, 0);
+  for (int i = 0; i < n; i++) inv[i * n + i] = 1;
+  for (int col = 0; col < n; col++) {
+    int piv = -1;
+    for (int r = col; r < n; r++)
+      if (a[r * n + col]) {
+        piv = r;
+        break;
+      }
+    if (piv < 0) return false;
+    if (piv != col) {
+      for (int j = 0; j < n; j++) {
+        std::swap(a[piv * n + j], a[col * n + j]);
+        std::swap(inv[piv * n + j], inv[col * n + j]);
+      }
+    }
+    uint8_t d = gf_inv(a[col * n + col]);
+    for (int j = 0; j < n; j++) {
+      a[col * n + j] = gf_mul(a[col * n + j], d);
+      inv[col * n + j] = gf_mul(inv[col * n + j], d);
+    }
+    for (int r = 0; r < n; r++) {
+      if (r == col) continue;
+      uint8_t f = a[r * n + col];
+      if (!f) continue;
+      for (int j = 0; j < n; j++) {
+        a[r * n + j] ^= gf_mul(f, a[col * n + j]);
+        inv[r * n + j] ^= gf_mul(f, inv[col * n + j]);
+      }
+    }
+  }
+  a = inv;
+  return true;
+}
+
+// out ^= coef * src over `len` bytes — the single hot loop of both encode
+// and decode (ref: erasure_code.c gf_vect_mad equivalents).
+void gf_mul_accum(uint8_t coef, const uint8_t* src, uint8_t* out,
+                  size_t len) {
+  if (coef == 0) return;
+  const uint8_t* row = g_mul[coef];
+  if (coef == 1) {
+    for (size_t i = 0; i < len; i++) out[i] ^= src[i];
+    return;
+  }
+  for (size_t i = 0; i < len; i++) out[i] ^= row[src[i]];
+}
+
+}  // namespace
+
+extern "C" {
+
+// Encode: data = k contiguous cells of `cell` bytes; writes m parity cells.
+void htpu_rs_encode(int k, int m, size_t cell, const uint8_t* data,
+                    uint8_t* parity) {
+  std::vector<uint8_t> mat(m * k);
+  cauchy_parity_matrix(k, m, mat.data());
+  std::memset(parity, 0, m * cell);
+  for (int i = 0; i < m; i++)
+    for (int j = 0; j < k; j++)
+      gf_mul_accum(mat[i * k + j], data + j * cell, parity + i * cell, cell);
+}
+
+// Decode: shards = (k+m) contiguous cells (content of absent ones
+// ignored), present = k+m flags. Rebuilds every absent shard in place.
+// Returns 0 on success, -1 if fewer than k shards survive.
+int htpu_rs_decode(int k, int m, size_t cell, uint8_t* shards,
+                   const uint8_t* present) {
+  int n = k + m;
+  int alive = 0;
+  for (int i = 0; i < n; i++) alive += present[i] ? 1 : 0;
+  if (alive < k) return -1;
+
+  bool data_loss = false;
+  for (int i = 0; i < k; i++)
+    if (!present[i]) data_loss = true;
+
+  if (data_loss) {
+    // Generator rows: identity for data shards, Cauchy for parity.
+    std::vector<uint8_t> sub(k * k);
+    std::vector<const uint8_t*> src(k);
+    std::vector<uint8_t> pmat(m * k);
+    cauchy_parity_matrix(k, m, pmat.data());
+    int r = 0;
+    for (int i = 0; i < n && r < k; i++) {
+      if (!present[i]) continue;
+      if (i < k) {
+        std::memset(&sub[r * k], 0, k);
+        sub[r * k + i] = 1;
+      } else {
+        std::memcpy(&sub[r * k], &pmat[(i - k) * k], k);
+      }
+      src[r] = shards + i * cell;
+      r++;
+    }
+    if (!gf_invert(sub, k)) return -1;
+    // Recover each missing data shard: row of inv × surviving shards.
+    for (int d = 0; d < k; d++) {
+      if (present[d]) continue;
+      uint8_t* out = shards + d * cell;
+      std::memset(out, 0, cell);
+      for (int j = 0; j < k; j++)
+        gf_mul_accum(sub[d * k + j], src[j], out, cell);
+    }
+  }
+  // All data shards now valid; recompute any missing parity.
+  bool parity_loss = false;
+  for (int i = k; i < n; i++)
+    if (!present[i]) parity_loss = true;
+  if (parity_loss) {
+    std::vector<uint8_t> pmat(m * k);
+    cauchy_parity_matrix(k, m, pmat.data());
+    for (int p = 0; p < m; p++) {
+      if (present[k + p]) continue;
+      uint8_t* out = shards + (k + p) * cell;
+      std::memset(out, 0, cell);
+      for (int j = 0; j < k; j++)
+        gf_mul_accum(pmat[p * k + j], shards + j * cell, out, cell);
+    }
+  }
+  return 0;
+}
+
+// XOR codec (ref: jni_xor_encoder.c): parity = xor of k data cells.
+void htpu_xor_encode(int k, size_t cell, const uint8_t* data,
+                     uint8_t* parity) {
+  std::memcpy(parity, data, cell);
+  for (int j = 1; j < k; j++)
+    for (size_t i = 0; i < cell; i++) parity[i] ^= data[j * cell + i];
+}
+
+}  // extern "C"

@@ -1,0 +1,37 @@
+// Native map-output record sorter.
+//
+// Role parity with the reference's nativetask C++ collector (ref:
+// hadoop-mapreduce-client-nativetask/src/main/native/src/lib — the
+// reference's own answer to MapOutputBuffer::sortAndSpill being the map
+// side's hot loop, ref: mapred/MapTask.java:1605). Records stay in one
+// Python-owned byte arena; this sorts an index array by
+// (partition, key-bytes) so the spill can stream records in shuffle order
+// without materializing per-record Python tuples for the comparison loop.
+
+#include <algorithm>
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// keybuf: arena holding all keys back to back.
+// key_off/key_len: per-record key location (n entries).
+// part: per-record partition id.
+// idx: in/out — n record indices, sorted in place.
+void htpu_sort_kv(const uint8_t* keybuf, const uint64_t* key_off,
+                  const uint32_t* key_len, const uint32_t* part, uint32_t n,
+                  uint32_t* idx) {
+  std::sort(idx, idx + n, [&](uint32_t a, uint32_t b) {
+    if (part[a] != part[b]) return part[a] < part[b];
+    const uint8_t* ka = keybuf + key_off[a];
+    const uint8_t* kb = keybuf + key_off[b];
+    uint32_t la = key_len[a], lb = key_len[b];
+    int c = std::memcmp(ka, kb, la < lb ? la : lb);
+    if (c) return c < 0;
+    if (la != lb) return la < lb;
+    return a < b;  // stable
+  });
+}
+
+}  // extern "C"

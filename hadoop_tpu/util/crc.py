@@ -12,41 +12,15 @@ Backend selection mirrors the optional-native policy (BUILDING.txt:173-183):
 
 from __future__ import annotations
 
-import ctypes
-import os
 import struct
-from typing import Optional
+
+from hadoop_tpu import native as _nat
 
 _CASTAGNOLI = 0x82F63B78
 
-# ---------------------------------------------------------------- native load
-
-
-def _load_native() -> Optional[ctypes.CDLL]:
-    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    # Env override wins over the bundled lib; a bad candidate falls through to
-    # the next instead of aborting the search.
-    for cand in (
-        os.environ.get("HADOOP_TPU_NATIVE_LIB", ""),
-        os.path.join(here, "native", "libhadoop_tpu.so"),
-    ):
-        if cand and os.path.exists(cand):
-            try:
-                lib = ctypes.CDLL(cand)
-                lib.htpu_crc32c.restype = ctypes.c_uint32
-                lib.htpu_crc32c.argtypes = [
-                    ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t]
-                return lib
-            except (OSError, AttributeError):
-                continue
-    return None
-
-
-_native = _load_native()
-
 
 def native_available() -> bool:
-    return _native is not None
+    return _nat.available()
 
 
 # ---------------------------------------------------------------- pure python
@@ -76,8 +50,8 @@ def crc32c(data, crc: int = 0) -> int:
     """CRC32C (Castagnoli) of ``data``, continuing from ``crc``."""
     if isinstance(data, memoryview):
         data = bytes(data)
-    if _native is not None:
-        return _native.htpu_crc32c(crc, data, len(data))
+    if _nat.available():
+        return _nat.crc32c(crc, data)
     return _crc32c_py(crc, data)
 
 
@@ -121,6 +95,9 @@ class DataChecksum:
         """Concatenated big-endian u32 CRCs, one per chunk of ``data``."""
         if self.type == self.TYPE_NULL:
             return b""
+        if _nat.available():
+            buf = bytes(data) if isinstance(data, memoryview) else data
+            return _nat.crc32c_chunked(buf, self.bytes_per_chunk)
         mv = memoryview(data)
         out = bytearray()
         for off in range(0, len(mv), self.bytes_per_chunk):
@@ -138,6 +115,19 @@ class DataChecksum:
         if len(sums) < 4 * n_chunks:
             raise ChecksumError(
                 f"need {4 * n_chunks} checksum bytes, got {len(sums)}")
+        if _nat.available():
+            buf = data if isinstance(data, bytes) else bytes(mv)
+            bad = _nat.crc32c_verify(buf, self.bytes_per_chunk, sums)
+            if bad >= 0:
+                off = bad * self.bytes_per_chunk
+                expect = struct.unpack_from(">I", sums, 4 * bad)[0]
+                actual = crc32c(buf[off:off + self.bytes_per_chunk])
+                raise ChecksumError(
+                    f"checksum mismatch at chunk {bad} "
+                    f"(stream offset {base_pos + off}): "
+                    f"expected {expect:#010x} got {actual:#010x}",
+                    pos=base_pos + off)
+            return
         for i in range(n_chunks):
             off = i * self.bytes_per_chunk
             expect = struct.unpack_from(">I", sums, 4 * i)[0]
