@@ -79,13 +79,17 @@ class DFSClient:
 
     def create(self, path: str, overwrite: bool = False,
                replication: Optional[int] = None,
-               block_size: Optional[int] = None) -> DFSOutputStream:
+               block_size: Optional[int] = None):
         st = FileStatus.from_wire(
             self.nn.create(path, self.client_name, replication, block_size,
                            overwrite))
         self._block_sizes[path] = st.block_size
         self._writer_opened()
-        stream = DFSOutputStream(self, path)
+        if st.ec_policy:
+            from hadoop_tpu.dfs.client.striped import DFSStripedOutputStream
+            stream = DFSStripedOutputStream(self, path, st.ec_policy)
+        else:
+            stream = DFSOutputStream(self, path)
         orig_close = stream.close
 
         def close_and_release():
@@ -96,8 +100,23 @@ class DFSClient:
         stream.close = close_and_release  # type: ignore[method-assign]
         return stream
 
-    def open(self, path: str) -> DFSInputStream:
-        return DFSInputStream(self, path)
+    def open(self, path: str):
+        # One NN round trip: the located blocks carry the EC marker, so the
+        # stream type is chosen from the same response the stream consumes.
+        info = self.get_block_locations(path)
+        blocks = info.get("blocks", [])
+        if blocks and blocks[0].get("ec"):
+            from hadoop_tpu.dfs.client.striped import DFSStripedInputStream
+            return DFSStripedInputStream(self, path, info)
+        return DFSInputStream(self, path, info)
+
+    # ----------------------------------------------------- erasure coding
+
+    def set_ec_policy(self, path: str, policy: Optional[str]) -> bool:
+        return self.nn.set_ec_policy(path, policy)
+
+    def get_ec_policy(self, path: str) -> Optional[str]:
+        return self.nn.get_ec_policy(path)
 
     # ------------------------------------------------- stream callbacks
 

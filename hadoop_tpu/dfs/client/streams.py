@@ -305,16 +305,21 @@ class _Pipeline:
 
 
 class DFSInputStream:
-    def __init__(self, client, path: str):
+    def __init__(self, client, path: str, info: Optional[Dict] = None):
         self.client = client
         self.path = path
-        self._refresh_locations()
+        if info is None:
+            self._refresh_locations()
+        else:
+            self._set_locations(info)
         self._pos = 0
         self._closed = False
         self._dead: Set[str] = set()
 
     def _refresh_locations(self) -> None:
-        info = self.client.get_block_locations(self.path)
+        self._set_locations(self.client.get_block_locations(self.path))
+
+    def _set_locations(self, info: Dict) -> None:
         self.length = info["length"]
         self.blocks = [LocatedBlock.from_wire(b) for b in info["blocks"]]
 

@@ -197,6 +197,9 @@ class DataNode(AbstractService):
             for block, targets in zip(cmd.blocks, cmd.targets):
                 Daemon(self._transfer, "dn-transfer",
                        args=(block, targets)).start()
+        elif cmd.action == DnCommand.EC_RECONSTRUCT:
+            Daemon(self._ec_reconstruct, "dn-ec-worker",
+                   args=(cmd.extra,)).start()
         elif cmd.action == DnCommand.RECOVER:
             # Block recovery: bump the stamp and promote the rbw replica to
             # finalized at its current length, then report it.
@@ -211,6 +214,14 @@ class DataNode(AbstractService):
                 except IOError as e:
                     log.warning("recover of %s failed: %s", block, e)
         return True
+
+    def _ec_reconstruct(self, payload: Dict) -> None:
+        """Ref: ErasureCodingWorker.processErasureCodingTasks."""
+        from hadoop_tpu.dfs.datanode import ec_worker
+        rebuilt = ec_worker.reconstruct(self.store, payload)
+        if rebuilt is not None:
+            with self._ibr_lock:
+                self._received.append(rebuilt)
 
     def _transfer(self, block: Block, targets) -> None:
         try:

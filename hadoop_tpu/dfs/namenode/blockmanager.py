@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from hadoop_tpu.conf import Configuration
 from hadoop_tpu.dfs.protocol.records import (Block, DatanodeInfo, DnCommand,
                                              LocatedBlock)
+from hadoop_tpu.io import erasurecode as ec
 from hadoop_tpu.metrics import metrics_system
 from hadoop_tpu.util.misc import Daemon
 
@@ -41,7 +42,7 @@ class DatanodeDescriptor(DatanodeInfo):
     Ref: blockmanagement/DatanodeDescriptor.java."""
 
     __slots__ = ("blocks", "invalidate_queue", "transfer_queue",
-                 "recover_queue", "xceiver_count")
+                 "recover_queue", "ec_queue", "xceiver_count")
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
@@ -49,6 +50,7 @@ class DatanodeDescriptor(DatanodeInfo):
         self.invalidate_queue: List[Block] = []
         self.transfer_queue: List[Tuple[Block, List[DatanodeInfo]]] = []
         self.recover_queue: List[Tuple[Block, int]] = []
+        self.ec_queue: List[Dict] = []  # EC_RECONSTRUCT payloads
         self.xceiver_count = 0
 
     def public_info(self) -> DatanodeInfo:
@@ -80,6 +82,40 @@ class BlockInfo:
 
     def live_replicas(self) -> int:
         return len(self.locations - self.corrupt_replicas)
+
+
+class BlockInfoStriped(BlockInfo):
+    """A striped block group (ref: blockmanagement/BlockInfoStriped.java):
+    k+m storage units, each a single replica on one DN; ``unit_map`` maps
+    datanode uuid → unit index. ``expected_replication`` is k+m."""
+
+    __slots__ = ("policy", "unit_map", "unit_lengths")
+
+    def __init__(self, block: Block, inode, policy: ec.ECPolicy):
+        super().__init__(block, inode, policy.num_units)
+        self.policy = policy
+        self.unit_map: Dict[str, int] = {}
+        # Reported finalized unit lengths (idx → bytes), the ground truth
+        # for recovering the group's logical length after a client crash.
+        self.unit_lengths: Dict[int, int] = {}
+
+    def live_units(self) -> Set[int]:
+        return {idx for uuid, idx in self.unit_map.items()
+                if uuid in self.locations and
+                uuid not in self.corrupt_replicas}
+
+    def live_replicas(self) -> int:
+        # "Replicas" for health purposes = distinct live units.
+        return len(self.live_units())
+
+    def missing_units(self) -> List[int]:
+        live = self.live_units()
+        return [i for i in range(self.policy.num_units) if i not in live]
+
+    def logical_length(self) -> int:
+        """Data bytes implied by the reported data-unit lengths (ref:
+        StripedBlockUtil.getSpannedSize's inverse)."""
+        return sum(self.unit_lengths.get(i, 0) for i in range(self.policy.k))
 
 
 class DatanodeManager:
@@ -152,6 +188,10 @@ class DatanodeManager:
                     DnCommand.RECOVER,
                     blocks=[b for b, _ in work],
                     new_gen_stamps=[gs for _, gs in work]))
+            for payload in node.ec_queue[:4]:
+                cmds.append(DnCommand(DnCommand.EC_RECONSTRUCT,
+                                      extra=payload))
+            del node.ec_queue[:4]
             return cmds
 
     # ------------------------------------------------------------- liveness
@@ -253,6 +293,23 @@ class BlockManager:
             self._blocks[block.block_id] = info
             return info
 
+    def add_striped_block_collection(self, block: Block, inode,
+                                     policy: ec.ECPolicy
+                                     ) -> BlockInfoStriped:
+        with self._lock:
+            info = BlockInfoStriped(block, inode, policy)
+            self._blocks[block.block_id] = info
+            return info
+
+    def _resolve_locked(self, block_id: int) -> Optional[BlockInfo]:
+        """Map a reported block id to its BlockInfo; a striped unit id
+        resolves to its group (ref: BlockManager.getStoredBlock's
+        BlockIdManager.convertToStripedID)."""
+        info = self._blocks.get(block_id)
+        if info is None and ec.is_striped_id(block_id):
+            info = self._blocks.get(ec.group_id_of(block_id))
+        return info
+
     def get(self, block_id: int) -> Optional[BlockInfo]:
         with self._lock:
             return self._blocks.get(block_id)
@@ -269,7 +326,14 @@ class BlockManager:
             return
         for uuid in info.locations:
             node = self.dn_manager.get(uuid)
-            if node is not None:
+            if node is None:
+                continue
+            if isinstance(info, BlockInfoStriped):
+                idx = info.unit_map.get(uuid, 0)
+                unit = Block(info.block.block_id + idx, info.block.gen_stamp)
+                node.invalidate_queue.append(unit)
+                node.blocks.discard(unit.block_id)
+            else:
                 node.invalidate_queue.append(info.block)
                 node.blocks.discard(block.block_id)
 
@@ -313,7 +377,7 @@ class BlockManager:
 
     def _add_stored_block_locked(self, block: Block,
                                  node: DatanodeDescriptor) -> None:
-        info = self._blocks.get(block.block_id)
+        info = self._resolve_locked(block.block_id)
         if info is None:
             # Replica of a deleted/unknown block → invalidate at the DN.
             node.invalidate_queue.append(block)
@@ -323,22 +387,29 @@ class BlockManager:
             info.corrupt_replicas.add(node.uuid)
             node.invalidate_queue.append(block)
             return
+        if isinstance(info, BlockInfoStriped):
+            idx = ec.unit_index_of(block.block_id)
+            info.unit_map[node.uuid] = idx
+            if block.num_bytes > info.unit_lengths.get(idx, 0):
+                info.unit_lengths[idx] = block.num_bytes
+        elif block.num_bytes > info.block.num_bytes:
+            info.block.num_bytes = block.num_bytes
         info.locations.add(node.uuid)
         info.corrupt_replicas.discard(node.uuid)
         node.blocks.add(block.block_id)
-        if block.num_bytes > info.block.num_bytes:
-            info.block.num_bytes = block.num_bytes
-        self._pending_reconstruction.pop(block.block_id, None)
+        self._pending_reconstruction.pop(info.block.block_id, None)
         self._update_needed_locked(info)
 
     def _remove_stored_block_locked(self, block_id: int,
                                     node: DatanodeDescriptor) -> None:
-        info = self._blocks.get(block_id)
+        info = self._resolve_locked(block_id)
         node.blocks.discard(block_id)
         if info is None:
             return
         info.locations.discard(node.uuid)
         info.corrupt_replicas.discard(node.uuid)
+        if isinstance(info, BlockInfoStriped):
+            info.unit_map.pop(node.uuid, None)
         self._update_needed_locked(info)
 
     def mark_corrupt(self, block: Block, uuid: str) -> None:
@@ -346,7 +417,7 @@ class BlockManager:
         .findAndMarkBlockAsCorrupt."""
         node = self.dn_manager.get(uuid)
         with self._lock:
-            info = self._blocks.get(block.block_id)
+            info = self._resolve_locked(block.block_id)
             if info is None or node is None:
                 return
             info.corrupt_replicas.add(uuid)
@@ -369,16 +440,18 @@ class BlockManager:
         if live < info.expected_replication:
             if bid in self._pending_reconstruction:
                 return
-            if live <= 1:
-                self._needed[0].add(bid)
-            else:
-                self._needed[1].add(bid)
+            # Highest priority: one more loss makes the block unreadable.
+            at_risk = live <= (info.policy.k if isinstance(
+                info, BlockInfoStriped) else 1)
+            self._needed[0 if at_risk else 1].add(bid)
         elif live > info.expected_replication:
             self._process_excess_locked(info)
 
     def _process_excess_locked(self, info: BlockInfo) -> None:
         """Drop excess replicas, most-loaded node first.
         Ref: BlockManager.processExtraRedundancyBlock."""
+        if isinstance(info, BlockInfoStriped):
+            return  # units are unique; nothing is "excess"
         excess = info.live_replicas() - info.expected_replication
         if excess <= 0:
             return
@@ -393,12 +466,13 @@ class BlockManager:
             node.blocks.discard(info.block.block_id)
 
     def schedule_drain(self, node: DatanodeDescriptor) -> None:
-        """Queue every block on a decommissioning node for re-replication."""
+        """Queue every block on a decommissioning node for re-replication.
+        Striped unit ids resolve to their group."""
         with self._lock:
             for bid in list(node.blocks):
-                info = self._blocks.get(bid)
+                info = self._resolve_locked(bid)
                 if info is not None and not info.under_construction:
-                    self._needed[2].add(bid)
+                    self._needed[2].add(info.block.block_id)
 
     def compute_reconstruction_work(self, max_work: int = 64) -> int:
         """RedundancyMonitor pass: assign transfer work to source DNs.
@@ -427,6 +501,8 @@ class BlockManager:
         return scheduled
 
     def _schedule_one_locked(self, info: BlockInfo) -> bool:
+        if isinstance(info, BlockInfoStriped):
+            return self._schedule_ec_locked(info)
         live_uuids = info.locations - info.corrupt_replicas
         sources = [self.dn_manager.get(u) for u in live_uuids]
         sources = [s for s in sources if s is not None and s.state in
@@ -450,6 +526,47 @@ class BlockManager:
         self._m_reconstructions.incr()
         return True
 
+    def _schedule_ec_locked(self, info: BlockInfoStriped) -> bool:
+        """Schedule reconstruction of missing striped units: the chosen
+        target DN reads k surviving units from peers, decodes, and stores
+        the missing unit (ref: BlockManager.scheduleReconstruction →
+        BlockECReconstructionCommand; worker ErasureCodingWorker.java:47)."""
+        # Units whose every holder is leaving (decommissioning) need a new
+        # home just like lost ones (ref: DatanodeAdminManager's handling of
+        # striped blocks with only decommissioning replicas).
+        fully_live: Set[int] = set()
+        sources = []
+        for uuid, idx in info.unit_map.items():
+            if uuid not in info.locations or uuid in info.corrupt_replicas:
+                continue
+            n = self.dn_manager.get(uuid)
+            if n is None or n.state == DatanodeInfo.STATE_DEAD:
+                continue
+            sources.append((n.public_info().to_wire(), idx))
+            if n.state == DatanodeInfo.STATE_LIVE:
+                fully_live.add(idx)
+        missing = [i for i in range(info.policy.num_units)
+                   if i not in fully_live]
+        if not missing:
+            return True
+        if len({idx for _, idx in sources}) < info.policy.k:
+            return False  # unrecoverable until more units resurface
+        targets = self.dn_manager.choose_targets(
+            len(missing), exclude=set(info.unit_map))
+        if not targets:
+            return False
+        for idx, target in zip(missing, targets):
+            target.ec_queue.append({
+                "group": info.block.to_wire(),
+                "policy": info.policy.name,
+                "idx": idx,
+                "sources": sources,
+            })
+        self._pending_reconstruction[info.block.block_id] = (
+            time.monotonic() + 60.0)
+        self._m_reconstructions.incr()
+        return True
+
     def node_died(self, node: DatanodeDescriptor) -> None:
         """All replicas on a dead node are gone; requeue its blocks."""
         with self._lock:
@@ -463,6 +580,19 @@ class BlockManager:
             info = self._blocks.get(block.block_id)
             if info is None:
                 return LocatedBlock(block, [], offset)
+            if isinstance(info, BlockInfoStriped):
+                locs, indices = [], []
+                for uuid in info.locations - info.corrupt_replicas:
+                    node = self.dn_manager.get(uuid)
+                    if node is not None and \
+                            node.state != DatanodeInfo.STATE_DEAD and \
+                            uuid in info.unit_map:
+                        locs.append(node.public_info())
+                        indices.append(info.unit_map[uuid])
+                return LocatedBlock(info.block, locs, offset,
+                                    corrupt=len(set(indices)) < info.policy.k,
+                                    ec_policy=info.policy.name,
+                                    indices=indices)
             locs = []
             for uuid in info.locations - info.corrupt_replicas:
                 node = self.dn_manager.get(uuid)
@@ -524,8 +654,9 @@ class SafeMode:
         count = 0
         with self.bm._lock:
             for info in self.bm._blocks.values():
-                if info.under_construction or \
-                        info.live_replicas() >= self.bm.min_replication:
+                need = info.policy.k if isinstance(info, BlockInfoStriped) \
+                    else self.bm.min_replication
+                if info.under_construction or info.live_replicas() >= need:
                     count += 1
         return count
 

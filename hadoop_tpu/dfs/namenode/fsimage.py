@@ -21,18 +21,24 @@ from hadoop_tpu.io.wire import pack, unpack
 
 def _serialize_node(node: INode) -> Dict:
     if isinstance(node, INodeDirectory):
-        return {
+        d = {
             "k": "d", "n": node.name, "mt": node.mtime, "o": node.owner,
             "g": node.group, "pm": node.permission,
             "c": [_serialize_node(c) for c in node.children.values()],
         }
+        if node.ec_policy:
+            d["ec"] = node.ec_policy
+        return d
     f: INodeFile = node  # type: ignore[assignment]
-    return {
+    d = {
         "k": "f", "n": f.name, "mt": f.mtime, "o": f.owner, "g": f.group,
         "pm": f.permission, "rep": f.replication, "bs": f.block_size,
         "uc": f.under_construction, "cl": f.client_name,
         "b": [b.to_wire() for b in f.blocks],
     }
+    if f.ec_policy:
+        d["ec"] = f.ec_policy
+    return d
 
 
 def _deserialize_node(d: Dict) -> INode:
@@ -41,11 +47,13 @@ def _deserialize_node(d: Dict) -> INode:
                               permission=d.get("pm", 0o755))
         node.mtime = d.get("mt", 0.0)
         node.group = d.get("g", "")
+        node.ec_policy = d.get("ec")
         for cd in d.get("c", []):
             node.add_child(_deserialize_node(cd))
         return node
     f = INodeFile(d["n"], d.get("rep", 3), d.get("bs", 0),
-                  owner=d.get("o", ""), permission=d.get("pm", 0o644))
+                  owner=d.get("o", ""), permission=d.get("pm", 0o644),
+                  ec_policy=d.get("ec"))
     f.mtime = d.get("mt", 0.0)
     f.group = d.get("g", "")
     f.under_construction = d.get("uc", False)

@@ -34,6 +34,7 @@ from hadoop_tpu.dfs.protocol.records import (AlreadyBeingCreatedError, Block,
                                              LeaseExpiredError, LocatedBlock,
                                              NotReplicatedYetError,
                                              SafeModeError)
+from hadoop_tpu.io import erasurecode as ec
 from hadoop_tpu.metrics import metrics_system
 from hadoop_tpu.security.ugi import current_user
 
@@ -59,6 +60,7 @@ class FSNamesystem:
             hard_limit_s=conf.get_time_seconds("dfs.lease.hard-limit", 1200.0))
         self.bm = BlockManager(conf)
         self._next_block_id = 1 << 30   # ref: SequentialBlockIdGenerator
+        self._next_group_id = ec.STRIPED_ID_BASE  # striped block groups
         self._gen_stamp = 1000          # ref: GenerationStamp
         self._id_lock = threading.Lock()
         self._pending_recovery: set = set()  # paths mid block-recovery
@@ -78,6 +80,7 @@ class FSNamesystem:
         if loaded is not None:
             last_txid, self.fsdir, extra = loaded
             self._next_block_id = extra.get("next_block_id", self._next_block_id)
+            self._next_group_id = extra.get("next_group_id", self._next_group_id)
             self._gen_stamp = extra.get("gen_stamp", self._gen_stamp)
             self.leases.restore_from_image(extra.get("leases", {}))
         replayed = 0
@@ -101,15 +104,15 @@ class FSNamesystem:
         for node in iter_tree(self.fsdir.root):
             if isinstance(node, INodeFile):
                 for b in node.blocks:
-                    info = self.bm.add_block_collection(b, node,
-                                                        node.replication)
+                    if node.ec_policy:
+                        info = self.bm.add_striped_block_collection(
+                            b, node, ec.get_policy(node.ec_policy))
+                    else:
+                        info = self.bm.add_block_collection(b, node,
+                                                            node.replication)
                     info.under_construction = node.under_construction and \
                         b is node.blocks[-1]
-                    with self._id_lock:
-                        if b.block_id > self._next_block_id:
-                            self._next_block_id = b.block_id
-                        if b.gen_stamp > self._gen_stamp:
-                            self._gen_stamp = b.gen_stamp
+                    self._track_block_id(b.to_wire())
 
     def save_namespace(self) -> str:
         """Checkpoint. Ref: FSNamesystem.saveNamespace — requires safemode in
@@ -119,6 +122,7 @@ class FSNamesystem:
             txid = self.editlog.last_txid
             extra = {
                 "next_block_id": self._next_block_id,
+                "next_group_id": self._next_group_id,
                 "gen_stamp": self._gen_stamp,
                 "leases": self.leases.snapshot_for_image(),
             }
@@ -139,6 +143,23 @@ class FSNamesystem:
         with self._id_lock:
             self._next_block_id += 1
             return self._next_block_id
+
+    def _new_group_id(self) -> int:
+        with self._id_lock:
+            self._next_group_id += ec.MAX_UNITS
+            return self._next_group_id
+
+    def _track_block_id(self, bw: Dict) -> None:
+        """Advance the id/stamp high-water marks past a (re)played block."""
+        bid, gs = bw.get("id", 0), bw.get("gs", 0)
+        with self._id_lock:
+            if ec.is_striped_id(bid):
+                if ec.group_id_of(bid) > self._next_group_id:
+                    self._next_group_id = ec.group_id_of(bid)
+            elif bid > self._next_block_id:
+                self._next_block_id = bid
+            if gs > self._gen_stamp:
+                self._gen_stamp = gs
 
     def next_gen_stamp(self) -> int:
         with self._id_lock:
@@ -179,14 +200,17 @@ class FSNamesystem:
                     if not overwrite:
                         raise FileExistsError(path)
                     self._delete_locked(path, recursive=False)
+                ec_policy = self._effective_ec_policy_locked(path)
                 inode = self.fsdir.add_file(path, replication, block_size,
                                             owner=owner)
+                inode.ec_policy = ec_policy
                 inode.under_construction = True
                 inode.client_name = client_name
                 self.leases.add_lease(client_name, path)
                 txid = self.editlog.log_edit(el.OP_ADD, {
                     "p": path, "rep": replication, "bs": block_size,
-                    "cl": client_name, "o": owner, "ov": overwrite})
+                    "cl": client_name, "o": owner, "ov": overwrite,
+                    "ec": ec_policy})
                 status = inode.status(path)
             self.editlog.log_sync(txid)
             return status
@@ -206,28 +230,50 @@ class FSNamesystem:
                 last = inode.last_block()
                 if last is not None:
                     info = self.bm.get(last.block_id)
+                    min_rep = ec.get_policy(inode.ec_policy).k \
+                        if inode.ec_policy else self.bm.min_replication
                     if info is not None and info.under_construction and \
-                            info.live_replicas() < self.bm.min_replication:
+                            info.live_replicas() < min_rep:
                         raise NotReplicatedYetError(
                             f"last block of {path} not yet minimally "
                             f"replicated ({info.live_replicas()})")
-                block = Block(self._new_block_id(), self._gen_stamp, 0)
-                targets = self.bm.dn_manager.choose_targets(
-                    inode.replication, set(exclude), writer_host)
-                if not targets:
-                    raise IOError(
-                        f"no datanodes available for {path} "
-                        f"(live={len(self.bm.dn_manager.live_nodes())})")
-                info = self.bm.add_block_collection(block, inode,
-                                                    inode.replication)
-                info.rbw_locations = {t.uuid for t in targets}
+                offset = sum(b.num_bytes for b in inode.blocks)
+                if inode.ec_policy:
+                    policy = ec.get_policy(inode.ec_policy)
+                    block = Block(self._new_group_id(), self._gen_stamp, 0)
+                    targets = self.bm.dn_manager.choose_targets(
+                        policy.num_units, set(exclude), None)
+                    if len(targets) < policy.k:
+                        raise IOError(
+                            f"not enough datanodes for {inode.ec_policy} "
+                            f"({len(targets)} live, need >={policy.k})")
+                    sinfo = self.bm.add_striped_block_collection(
+                        block, inode, policy)
+                    sinfo.rbw_locations = {t.uuid for t in targets}
+                    for i, t in enumerate(targets):
+                        sinfo.unit_map[t.uuid] = i
+                    lb = LocatedBlock(
+                        block, [t.public_info() for t in targets], offset,
+                        ec_policy=policy.name,
+                        indices=list(range(len(targets))))
+                else:
+                    block = Block(self._new_block_id(), self._gen_stamp, 0)
+                    targets = self.bm.dn_manager.choose_targets(
+                        inode.replication, set(exclude), writer_host)
+                    if not targets:
+                        raise IOError(
+                            f"no datanodes available for {path} "
+                            f"(live={len(self.bm.dn_manager.live_nodes())})")
+                    info = self.bm.add_block_collection(block, inode,
+                                                        inode.replication)
+                    info.rbw_locations = {t.uuid for t in targets}
+                    lb = LocatedBlock(
+                        block, [t.public_info() for t in targets], offset)
                 inode.blocks.append(block)
                 txid = self.editlog.log_edit(el.OP_ADD_BLOCK, {
                     "p": path, "b": block.to_wire()})
             self.editlog.log_sync(txid)
-            return LocatedBlock(block, [t.public_info() for t in targets],
-                                offset=sum(b.num_bytes
-                                           for b in inode.blocks[:-1]))
+            return lb
 
     def abandon_block(self, path: str, client_name: str, block: Dict) -> None:
         """Client gave up on a block (pipeline could not be built).
@@ -253,8 +299,10 @@ class FSNamesystem:
                 lb = inode.last_block()
                 if lb is not None:
                     info = self.bm.get(lb.block_id)
+                    min_rep = ec.get_policy(inode.ec_policy).k \
+                        if inode.ec_policy else self.bm.min_replication
                     if info is not None and \
-                            info.live_replicas() < self.bm.min_replication:
+                            info.live_replicas() < min_rep:
                         return False  # client retries (ref: completeFile loop)
                 inode.under_construction = False
                 inode.client_name = None
@@ -355,9 +403,15 @@ class FSNamesystem:
         self._pending_recovery.discard(path)
         inode.under_construction = False
         inode.client_name = None
+        from hadoop_tpu.dfs.namenode.blockmanager import BlockInfoStriped
         for b in inode.blocks:
             info = self.bm.get(b.block_id)
-            if info is not None and info.block.num_bytes > b.num_bytes:
+            if isinstance(info, BlockInfoStriped) and b.num_bytes == 0:
+                # Group length was never committed by the client; derive it
+                # from the finalized unit lengths the DNs reported.
+                b.num_bytes = info.logical_length()
+                info.block.num_bytes = b.num_bytes
+            elif info is not None and info.block.num_bytes > b.num_bytes:
                 b.num_bytes = info.block.num_bytes  # recovered length
             self.bm.complete_block(b)
         txid = self.editlog.log_edit(el.OP_CLOSE, {
@@ -384,8 +438,18 @@ class FSNamesystem:
         for b in info.inode.blocks:
             if b.block_id == info.block.block_id:
                 b.gen_stamp = new_gs
+        from hadoop_tpu.dfs.namenode.blockmanager import BlockInfoStriped
         for node in nodes:
-            node.recover_queue.append((old_block, new_gs))
+            if isinstance(info, BlockInfoStriped):
+                # DNs store unit replicas, not the group: recover the unit
+                # this node was assigned at allocation time.
+                idx = info.unit_map.get(node.uuid)
+                if idx is None:
+                    continue
+                unit = Block(old_block.block_id + idx, old_block.gen_stamp)
+                node.recover_queue.append((unit, new_gs))
+            else:
+                node.recover_queue.append((old_block, new_gs))
         self._pending_recovery.add(path)
         log.info("Started block recovery of %s for %s on %d nodes "
                  "(gs %d -> %d)", info.block, path, len(nodes),
@@ -528,6 +592,57 @@ class FSNamesystem:
         self.editlog.log_sync(txid)
         return True
 
+    # ---------------------------------------------------------- erasure coding
+
+    def _effective_ec_policy_locked(self, path: str) -> Optional[str]:
+        """Nearest ancestor directory's EC policy (ref:
+        FSDirErasureCodingOp.getErasureCodingPolicy — the EC xattr is
+        inherited down the tree)."""
+        comps = [c for c in path.split("/") if c]
+        node = self.fsdir.root
+        policy = node.ec_policy
+        for comp in comps[:-1]:
+            if not isinstance(node, INodeDirectory):
+                break
+            node = node.get_child(comp)
+            if node is None:
+                break
+            if getattr(node, "ec_policy", None):
+                policy = node.ec_policy
+        return policy
+
+    def set_ec_policy(self, path: str, policy_name: Optional[str]) -> bool:
+        """Set (or clear, with None) the EC policy on a directory.
+        Ref: FSNamesystem.setErasureCodingPolicy."""
+        if policy_name:
+            ec.get_policy(policy_name)  # validate
+        with self.lock.write():
+            self._check_not_safemode("set EC policy")
+            node = self.fsdir.get_inode(path)
+            if node is None:
+                raise FileNotFoundError(path)
+            if not isinstance(node, INodeDirectory):
+                raise NotADirectoryError(
+                    f"EC policy can only be set on directories: {path}")
+            node.ec_policy = policy_name
+            txid = self.editlog.log_edit(el.OP_SET_EC_POLICY, {
+                "p": path, "ec": policy_name})
+        self.editlog.log_sync(txid)
+        return True
+
+    def get_ec_policy(self, path: str) -> Optional[str]:
+        """Effective policy for a path (file's own or inherited)."""
+        with self.lock.read():
+            node = self.fsdir.get_inode(path)
+            if node is None:
+                raise FileNotFoundError(path)
+            own = getattr(node, "ec_policy", None)
+            if own:
+                return own
+            return self._effective_ec_policy_locked(
+                path.rstrip("/") + "/_" if isinstance(node, INodeDirectory)
+                else path)
+
     def set_times(self, path: str, mtime: float, atime: float) -> None:
         with self.lock.write():
             inode = self.fsdir.get_inode(path)
@@ -577,10 +692,7 @@ class FSNamesystem:
                    rec.get("b", []) if op in (el.OP_UPDATE_BLOCKS, el.OP_CLOSE)
                    else []):
             if isinstance(bw, dict):
-                if bw.get("id", 0) > self._next_block_id:
-                    self._next_block_id = bw["id"]
-                if bw.get("gs", 0) > self._gen_stamp:
-                    self._gen_stamp = bw["gs"]
+                self._track_block_id(bw)
         if op == el.OP_ADD:
             if rec.get("ov") and self.fsdir.exists(rec["p"]):
                 # create(overwrite=True) replaced an existing file; replay the
@@ -591,7 +703,8 @@ class FSNamesystem:
                 if holder:
                     self.leases.remove_lease(holder, rec["p"])
             inode = self.fsdir.add_file(rec["p"], rec["rep"], rec["bs"],
-                                        owner=rec.get("o", ""))
+                                        owner=rec.get("o", ""),
+                                        ec_policy=rec.get("ec"))
             inode.under_construction = True
             inode.client_name = rec.get("cl")
             if inode.client_name:
@@ -641,6 +754,10 @@ class FSNamesystem:
             if inode is not None:
                 inode.owner = rec.get("o") or inode.owner
                 inode.group = rec.get("g") or inode.group
+        elif op == el.OP_SET_EC_POLICY:
+            node = self.fsdir.get_inode(rec["p"])
+            if isinstance(node, INodeDirectory):
+                node.ec_policy = rec.get("ec")
         elif op == el.OP_SET_GENSTAMP:
             self._gen_stamp = max(self._gen_stamp, rec["gs"])
         else:

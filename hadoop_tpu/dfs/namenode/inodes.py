@@ -44,16 +44,20 @@ class INode:
 
 class INodeFile(INode):
     __slots__ = ("replication", "block_size", "blocks", "under_construction",
-                 "client_name")
+                 "client_name", "ec_policy")
 
     def __init__(self, name: str, replication: int, block_size: int,
-                 owner: str = "", permission: int = 0o644):
+                 owner: str = "", permission: int = 0o644,
+                 ec_policy: Optional[str] = None):
         super().__init__(name, owner=owner, permission=permission)
         self.replication = replication
         self.block_size = block_size
         self.blocks: List[Block] = []
         self.under_construction = False
         self.client_name: Optional[str] = None  # lease holder while open
+        # Striped layout policy name, fixed at create (ref: INodeFile's
+        # erasure-coding-policy ID in its header).
+        self.ec_policy: Optional[str] = ec_policy
 
     def length(self) -> int:
         return sum(b.num_bytes for b in self.blocks)
@@ -65,15 +69,19 @@ class INodeFile(INode):
         return FileStatus(path if path is not None else self.full_path(),
                           False, self.length(), self.replication,
                           self.block_size, self.mtime, self.atime,
-                          self.owner, self.group, self.permission)
+                          self.owner, self.group, self.permission,
+                          ec_policy=self.ec_policy)
 
 
 class INodeDirectory(INode):
-    __slots__ = ("children",)
+    __slots__ = ("children", "ec_policy")
 
     def __init__(self, name: str, owner: str = "", permission: int = 0o755):
         super().__init__(name, owner=owner, permission=permission)
         self.children: Dict[str, INode] = {}
+        # EC policy set on this directory; inherited by files created under
+        # it (ref: ErasureCodingPolicyManager + the EC xattr on dirs).
+        self.ec_policy: Optional[str] = None
 
     def add_child(self, node: INode) -> None:
         node.parent = self
@@ -160,7 +168,8 @@ class FSDirectory:
         return node
 
     def add_file(self, path: str, replication: int, block_size: int,
-                 owner: str = "", permission: int = 0o644) -> INodeFile:
+                 owner: str = "", permission: int = 0o644,
+                 ec_policy: Optional[str] = None) -> INodeFile:
         comps = _components(path)
         if not comps:
             raise IsADirectoryError("cannot create file at /")
@@ -168,7 +177,7 @@ class FSDirectory:
         if parent.get_child(comps[-1]) is not None:
             raise FileExistsError(f"{path} already exists")
         f = INodeFile(comps[-1], replication, block_size, owner=owner,
-                      permission=permission)
+                      permission=permission, ec_policy=ec_policy)
         parent.add_child(f)
         self._inode_count += 1
         return f

@@ -127,6 +127,20 @@ class ClientProtocol:
         self.fsn.set_owner(path, owner, group)
         return True
 
+    def set_ec_policy(self, path: str, policy: Optional[str]) -> bool:
+        """Ref: ClientProtocol.setErasureCodingPolicy."""
+        return self.fsn.set_ec_policy(path, policy)
+
+    @idempotent
+    def get_ec_policy(self, path: str):
+        return self.fsn.get_ec_policy(path)
+
+    @idempotent
+    def get_ec_policies(self):
+        from hadoop_tpu.io.erasurecode import SYSTEM_POLICIES
+        return [{"name": p.name, "codec": p.codec, "k": p.k, "m": p.m,
+                 "cell": p.cell_size} for p in SYSTEM_POLICIES.values()]
+
     @idempotent
     def renew_lease(self, client_name: str):
         self.fsn.renew_lease(client_name)

@@ -64,3 +64,39 @@ def connect(addr, timeout: float = 30.0) -> socket.socket:
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 1 << 20)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 20)
     return sock
+
+
+def read_block_range(addr, block_wire: Dict, offset: int,
+                     length: int) -> bytes:
+    """Read [offset, offset+length) of one replica over OP_READ_BLOCK,
+    verifying checksums. The shared client of BlockSender — used by the
+    striped reader, the EC reconstruction worker, and the balancer
+    (ref: the remote half of BlockReaderFactory.getRemoteBlockReader)."""
+    from hadoop_tpu.util.crc import DataChecksum
+    if length <= 0:
+        return b""
+    sock = connect(addr, timeout=10.0)
+    try:
+        send_frame(sock, {"op": OP_READ_BLOCK, "b": block_wire,
+                          "offset": offset, "length": length})
+        setup = recv_frame(sock)
+        if not setup.get("ok"):
+            raise IOError(setup.get("em", "read setup failed"))
+        checksum = DataChecksum(CHUNK_SIZE)
+        out = bytearray()
+        skip: Optional[int] = None
+        while True:
+            pkt = recv_frame(sock)
+            if pkt.get("last"):
+                break
+            data = pkt["data"]
+            checksum.verify(data, pkt["sums"], base_pos=pkt["off"])
+            if skip is None:
+                skip = offset - pkt["off"]  # chunk-alignment slack
+            take = data[skip:skip + (length - len(out))] if skip else \
+                data[:length - len(out)]
+            out += take
+            skip = 0
+        return bytes(out)
+    finally:
+        sock.close()

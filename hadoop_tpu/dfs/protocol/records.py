@@ -152,39 +152,53 @@ class DatanodeInfo(DatanodeID):
 
 class LocatedBlock:
     """A block + where its replicas live + its offset in the file.
-    Ref: protocol/LocatedBlock.java."""
+    Ref: protocol/LocatedBlock.java. For a striped block group (ref:
+    LocatedStripedBlock.java) ``ec_policy`` names the policy and
+    ``indices[i]`` is the storage-unit index served by ``locations[i]``."""
 
-    __slots__ = ("block", "locations", "offset", "corrupt")
+    __slots__ = ("block", "locations", "offset", "corrupt", "ec_policy",
+                 "indices")
 
     def __init__(self, block: Block, locations: List[DatanodeInfo],
-                 offset: int = 0, corrupt: bool = False):
+                 offset: int = 0, corrupt: bool = False,
+                 ec_policy: Optional[str] = None,
+                 indices: Optional[List[int]] = None):
         self.block = block
         self.locations = locations
         self.offset = offset
         self.corrupt = corrupt
+        self.ec_policy = ec_policy
+        self.indices = indices
 
     def to_wire(self) -> Dict:
-        return {"b": self.block.to_wire(),
-                "locs": [d.to_wire() for d in self.locations],
-                "off": self.offset, "cor": self.corrupt}
+        d = {"b": self.block.to_wire(),
+             "locs": [x.to_wire() for x in self.locations],
+             "off": self.offset, "cor": self.corrupt}
+        if self.ec_policy:
+            d["ec"] = self.ec_policy
+            d["idx"] = self.indices
+        return d
 
     @classmethod
     def from_wire(cls, d: Dict) -> "LocatedBlock":
         return cls(Block.from_wire(d["b"]),
                    [DatanodeInfo.from_wire(x) for x in d["locs"]],
-                   d.get("off", 0), d.get("cor", False))
+                   d.get("off", 0), d.get("cor", False),
+                   d.get("ec"), d.get("idx"))
 
 
 class FileStatus:
     """Ref: fs/FileStatus.java + hdfs HdfsFileStatus.java."""
 
     __slots__ = ("path", "is_dir", "length", "replication", "block_size",
-                 "mtime", "atime", "owner", "group", "permission")
+                 "mtime", "atime", "owner", "group", "permission",
+                 "ec_policy")
 
     def __init__(self, path: str, is_dir: bool, length: int = 0,
                  replication: int = 0, block_size: int = 0,
                  mtime: float = 0.0, atime: float = 0.0, owner: str = "",
-                 group: str = "", permission: int = 0o644):
+                 group: str = "", permission: int = 0o644,
+                 ec_policy: Optional[str] = None):
         self.path = path
         self.is_dir = is_dir
         self.length = length
@@ -195,18 +209,23 @@ class FileStatus:
         self.owner = owner
         self.group = group
         self.permission = permission
+        self.ec_policy = ec_policy
 
     def to_wire(self) -> Dict:
-        return {"p": self.path, "d": self.is_dir, "len": self.length,
-                "rep": self.replication, "bs": self.block_size,
-                "mt": self.mtime, "at": self.atime, "o": self.owner,
-                "g": self.group, "perm": self.permission}
+        d = {"p": self.path, "d": self.is_dir, "len": self.length,
+             "rep": self.replication, "bs": self.block_size,
+             "mt": self.mtime, "at": self.atime, "o": self.owner,
+             "g": self.group, "perm": self.permission}
+        if self.ec_policy:
+            d["ec"] = self.ec_policy
+        return d
 
     @classmethod
     def from_wire(cls, d: Dict) -> "FileStatus":
         return cls(d["p"], d["d"], d.get("len", 0), d.get("rep", 0),
                    d.get("bs", 0), d.get("mt", 0.0), d.get("at", 0.0),
-                   d.get("o", ""), d.get("g", ""), d.get("perm", 0o644))
+                   d.get("o", ""), d.get("g", ""), d.get("perm", 0o644),
+                   d.get("ec"))
 
     def __repr__(self):
         kind = "dir" if self.is_dir else f"file[{self.length}B]"
@@ -223,14 +242,20 @@ class DnCommand:
     INVALIDATE = "invalidate"
     RECOVER = "recover"
     REREGISTER = "reregister"
+    # EC reconstruction (ref: BlockECReconstructionCommand.java): the
+    # receiving DN reads surviving units from peers, decodes, and stores
+    # the missing unit locally. ``extra`` carries the reconstruction info.
+    EC_RECONSTRUCT = "ec_reconstruct"
 
     def __init__(self, action: str, blocks: Optional[List[Block]] = None,
                  targets: Optional[List[List[DatanodeInfo]]] = None,
-                 new_gen_stamps: Optional[List[int]] = None):
+                 new_gen_stamps: Optional[List[int]] = None,
+                 extra: Optional[Dict] = None):
         self.action = action
         self.blocks = blocks or []
         self.targets = targets or []
         self.new_gen_stamps = new_gen_stamps or []
+        self.extra = extra or {}
 
     def to_wire(self) -> Dict:
         return {
@@ -238,6 +263,7 @@ class DnCommand:
             "b": [b.to_wire() for b in self.blocks],
             "t": [[d.to_wire() for d in tgt] for tgt in self.targets],
             "gs": self.new_gen_stamps,
+            "x": self.extra,
         }
 
     @classmethod
@@ -245,4 +271,4 @@ class DnCommand:
         return cls(d["a"], [Block.from_wire(x) for x in d.get("b", [])],
                    [[DatanodeInfo.from_wire(y) for y in t]
                     for t in d.get("t", [])],
-                   d.get("gs", []))
+                   d.get("gs", []), d.get("x", {}))

@@ -1,0 +1,206 @@
+"""Erasure coding: rawcoders, policies, striped IO on a minicluster.
+
+Mirrors the reference's EC test posture (ref:
+hadoop-common io/erasurecode/rawcoder/TestRSRawCoder.java;
+hadoop-hdfs TestDFSStripedOutputStream.java,
+TestDFSStripedInputStream.java, TestReconstructStripedFile.java):
+coder correctness for every loss pattern, striped write/read roundtrip,
+decode-on-read with a dead datanode, and background reconstruction.
+"""
+
+import itertools
+import os
+import time
+
+import pytest
+
+from hadoop_tpu.io import erasurecode as ec
+
+
+# ------------------------------------------------------------- raw coders
+
+@pytest.mark.parametrize("k,m", [(3, 2), (6, 3)])
+def test_rs_coder_all_loss_patterns(k, m):
+    coder = ec.RSRawCoder(k, m)
+    cell = 512
+    data = [os.urandom(cell) for _ in range(k)]
+    parity = coder.encode(data)
+    full = data + parity
+    for lost in itertools.combinations(range(k + m), m):
+        shards = [None if i in lost else full[i] for i in range(k + m)]
+        assert coder.decode(shards) == full
+
+
+def test_rs_numpy_matches_native():
+    # Force the numpy path and compare against whatever encode() produced
+    # (native when available): both must emit identical parity.
+    k, m, cell = 6, 3, 256
+    data = [os.urandom(cell) for _ in range(k)]
+    coder = ec.RSRawCoder(k, m)
+    parity = coder.encode(data)
+    import numpy as np
+    mat = ec._cauchy_parity_matrix(k, m)
+    stacked = np.stack([np.frombuffer(c, np.uint8) for c in data])
+    ref = ec._gf_matmul(mat, stacked)
+    assert [ref[i].tobytes() for i in range(m)] == parity
+
+
+def test_xor_coder_roundtrip():
+    coder = ec.XORRawCoder(2, 1)
+    data = [os.urandom(128), os.urandom(128)]
+    parity = coder.encode(data)
+    for lost in range(3):
+        shards = [None if i == lost else (data + parity)[i] for i in range(3)]
+        assert coder.decode(shards) == data + parity
+
+
+def test_unit_length_accounting():
+    p = ec.get_policy("RS-3-2-64k")
+    cell = p.cell_size
+    # 2 full stripes + 1.5 cells
+    logical = 2 * 3 * cell + cell + cell // 2
+    lens = [ec.unit_length(logical, p, i) for i in range(5)]
+    assert lens[0] == 3 * cell
+    assert lens[1] == 2 * cell + cell // 2
+    assert lens[2] == 2 * cell
+    assert lens[3] == lens[4] == 3 * cell  # parity tracks longest column
+    assert sum(lens[:3]) == logical
+
+
+def test_striped_id_scheme():
+    gid = ec.STRIPED_ID_BASE + 32
+    assert ec.is_striped_id(gid)
+    assert not ec.is_striped_id(1 << 31)
+    assert ec.group_id_of(gid + 7) == gid
+    assert ec.unit_index_of(gid + 7) == 7
+
+
+# ------------------------------------------------------------ minicluster
+
+@pytest.fixture
+def ec_cluster(tmp_path):
+    from hadoop_tpu.conf import Configuration
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster, fast_conf
+    conf = fast_conf()
+    conf.set("dfs.blocksize", str(256 * 1024))  # small groups → multi-group
+    cluster = MiniDFSCluster(num_datanodes=5, base_dir=str(tmp_path),
+                             conf=conf).start()
+    cluster.wait_active()
+    yield cluster
+    cluster.shutdown()
+
+
+def _fs(cluster):
+    return cluster.get_filesystem()
+
+
+def test_striped_write_read_roundtrip(ec_cluster):
+    fs = _fs(ec_cluster)
+    fs.mkdirs("/ec")
+    fs.client.set_ec_policy("/ec", "RS-3-2-64k")
+    assert fs.client.get_ec_policy("/ec") == "RS-3-2-64k"
+    # Spans multiple stripes + a partial tail cell; > one block group.
+    payload = os.urandom(900 * 1024 + 12345)
+    with fs.create("/ec/striped.bin") as out:
+        out.write(payload)
+    st = fs.get_file_status("/ec/striped.bin")
+    assert st.ec_policy == "RS-3-2-64k"
+    assert st.length == len(payload)
+    with fs.open("/ec/striped.bin") as f:
+        assert f.read() == payload
+
+
+def test_striped_read_with_dead_datanode_decodes(ec_cluster):
+    fs = _fs(ec_cluster)
+    fs.mkdirs("/ec2")
+    fs.client.set_ec_policy("/ec2", "RS-3-2-64k")
+    payload = os.urandom(400 * 1024)
+    with fs.create("/ec2/f.bin") as out:
+        out.write(payload)
+    # Kill one datanode holding a unit; the read must decode around it.
+    ec_cluster.kill_datanode(0)
+    with fs.open("/ec2/f.bin") as f:
+        assert f.read() == payload
+
+
+def test_striped_reconstruction_after_loss(ec_cluster):
+    fs = _fs(ec_cluster)
+    fs.mkdirs("/ec3")
+    fs.client.set_ec_policy("/ec3", "RS-3-2-64k")
+    payload = os.urandom(300 * 1024)
+    with fs.create("/ec3/f.bin") as out:
+        out.write(payload)
+    fsn = ec_cluster.namenode.fsn
+    gid = next(bid for bid in fsn.bm._blocks
+               if ec.is_striped_id(bid))
+    info = fsn.bm.get(gid)
+    assert set(info.live_units()) == {0, 1, 2, 3, 4} or \
+        len(info.live_units()) == 5
+    ec_cluster.kill_datanode(1)
+    # The redundancy monitor should notice the dead node and schedule
+    # reconstruction on a surviving DN; wait for 5 live units again.
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if len(info.live_units()) == 5:
+            break
+        time.sleep(0.3)
+    assert len(info.live_units()) == 5, (
+        f"units never reconstructed: {sorted(info.live_units())}")
+    with fs.open("/ec3/f.bin") as f:
+        assert f.read() == payload
+
+
+def test_striped_lease_recovery_closes_abandoned_file(ec_cluster):
+    """A client that dies mid-EC-write must not wedge the file: lease
+    recovery issues unit-level RECOVER commands and derives the group
+    length from the finalized unit lengths (ref: recoverLeaseInternal +
+    commitBlockSynchronization for striped groups)."""
+    fs = _fs(ec_cluster)
+    fs.mkdirs("/ec5")
+    fs.client.set_ec_policy("/ec5", "RS-3-2-64k")
+    payload = os.urandom(200 * 1024)
+    out = fs.create("/ec5/abandoned.bin")
+    out.write(payload)
+    # Simulate client death: unit sockets vanish, no complete() RPC.
+    for w in out._writers:
+        if w is not None:
+            w.close()
+    fsn = ec_cluster.namenode.fsn
+    # Force lease expiry rather than waiting out the hard limit.
+    fsn.leases.soft_limit_s = fsn.leases.hard_limit_s = 0.0
+    deadline = time.monotonic() + 60
+    closed = False
+    while time.monotonic() < deadline:
+        fsn.check_leases()
+        inode = fsn.fsdir.get_inode("/ec5/abandoned.bin")
+        if inode is not None and not inode.under_construction:
+            closed = True
+            break
+        time.sleep(0.3)
+    assert closed, "lease recovery never closed the striped file"
+    st = fs.get_file_status("/ec5/abandoned.bin")
+    # All full stripes the writers pushed before death are recoverable;
+    # the tail may be truncated at a stripe boundary but never beyond.
+    assert st.length >= 0
+    if st.length:
+        with fs.open("/ec5/abandoned.bin") as f:
+            data = f.read()
+        assert data == payload[:len(data)]
+
+
+def test_ec_policy_inherited_and_image_persisted(ec_cluster):
+    fs = _fs(ec_cluster)
+    fs.mkdirs("/ec4/sub")
+    fs.client.set_ec_policy("/ec4", "XOR-2-1-64k")
+    with fs.create("/ec4/sub/f.bin") as out:
+        out.write(b"x" * 100_000)
+    st = fs.get_file_status("/ec4/sub/f.bin")
+    assert st.ec_policy == "XOR-2-1-64k"
+    # Survives a namenode restart (image + edits replay).
+    ec_cluster.namenode.fsn.save_namespace()
+    ec_cluster.restart_namenode()
+    ec_cluster.wait_active()
+    fs2 = _fs(ec_cluster)
+    assert fs2.get_file_status("/ec4/sub/f.bin").ec_policy == "XOR-2-1-64k"
+    with fs2.open("/ec4/sub/f.bin") as f:
+        assert f.read() == b"x" * 100_000
