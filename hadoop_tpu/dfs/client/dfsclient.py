@@ -44,6 +44,12 @@ class _ClientProtocolDecl:
     def get_datanode_report(self): ...
     @_idem
     def get_service_status(self): ...
+    @_idem
+    def msync(self): ...
+    @_idem
+    def get_ec_policy(self): ...
+    @_idem
+    def get_ec_policies(self): ...
 
 
 class DFSClient:
@@ -70,6 +76,13 @@ class DFSClient:
         policy = RetryPolicies.failover_on_network_exception(
             max_failovers=len(nn_addrs) * 4, delay_s=0.3)
         self.nn = _DeclaredRetryProxy(provider, policy, self._decl)
+        # Observer reads (ref: namenode/ha/ObserverReadProxyProvider.java:70):
+        # route idempotent calls to an observer NN, writes to the active; an
+        # initial msync seeds the state id so observer reads are consistent.
+        if self.conf.get_bool("dfs.client.observer.reads.enabled", False) \
+                and len(nn_addrs) > 1:
+            self.nn = _ObserverReadProxy(
+                provider, policy, self._decl, self, nn_addrs)
         self._block_sizes: Dict[str, int] = {}
         self._open_files = 0
         self._renewer_lock = threading.Lock()
@@ -183,6 +196,75 @@ class DFSClient:
         if self._renewer_stop is not None:
             self._renewer_stop.set()
         self._rpc_client.stop()
+
+
+_OBSERVER_READS = frozenset({
+    # Pure namespace reads an observer may serve (ref: the @ReadOnly
+    # annotations ObserverReadProxyProvider honors). renew_lease and
+    # report_bad_blocks are idempotent but mutate active-side state.
+    "get_block_locations", "get_file_info", "listing", "content_summary",
+    "get_stats", "get_datanode_report", "get_ec_policy", "get_ec_policies",
+})
+
+
+class _ObserverReadProxy:
+    """Ref: ObserverReadProxyProvider.java — read-only calls try an
+    observer first (with state-id alignment carried by the RPC layer);
+    everything else, and any observer failure, goes through the normal
+    active-failover proxy."""
+
+    def __init__(self, provider, policy, decl_cls, client: "DFSClient",
+                 nn_addrs):
+        self._active = _DeclaredRetryProxy(provider, policy, decl_cls)
+        self._decl = decl_cls
+        self._client = client
+        self._addrs = nn_addrs
+        self._observer = None
+        self._probed = False
+        self._synced = False
+
+    def _find_observer(self):
+        from hadoop_tpu.ipc import get_proxy
+        for addr in self._addrs:
+            try:
+                proxy = get_proxy("ClientProtocol", addr,
+                                  client=self._client._rpc_client)
+                st = proxy.get_service_status()
+                if st.get("state") == "observer":
+                    log.info("Observer reads via %s", addr)
+                    return proxy
+            except Exception:  # noqa: BLE001 — not an observer / down
+                continue
+        return None
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def call(*args, **kwargs):
+            if name in _OBSERVER_READS:
+                if not self._synced:
+                    # Seed the client state id from the active so the first
+                    # observer read already waits for current state.
+                    try:
+                        self._active.msync()
+                        self._synced = True
+                    except Exception:  # noqa: BLE001
+                        pass
+                if not self._probed:
+                    self._observer = self._find_observer()
+                    self._probed = True
+                if self._observer is not None:
+                    try:
+                        return getattr(self._observer, name)(*args, **kwargs)
+                    except Exception as e:  # noqa: BLE001 — fall to active
+                        log.debug("observer read %s failed (%s); using "
+                                  "active", name, e)
+                        self._observer = None
+                        self._probed = False
+            return getattr(self._active, name)(*args, **kwargs)
+
+        return call
 
 
 class _DeclaredRetryProxy(RetryInvocationHandler):

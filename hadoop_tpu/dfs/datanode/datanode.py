@@ -55,26 +55,31 @@ class DataNodeFaultInjector:
 
 
 class DataNode(AbstractService):
+    """One actor loop per configured NameNode (ref: BPServiceActor — the
+    DN heartbeats/reports to EVERY NN of the nameservice so standbys stay
+    block-map-warm and promotion needs no report storm)."""
+
     def __init__(self, conf: Configuration, data_dir: Optional[str] = None,
-                 nn_addr: Optional[Tuple[str, int]] = None):
+                 nn_addr=None):
         super().__init__("DataNode")
         self.data_dir = data_dir or conf.get("dfs.datanode.data.dir",
                                              "/tmp/htpu-data")
         host = conf.get("dfs.datanode.hostname", "127.0.0.1")
-        self.nn_addr = nn_addr or (
-            conf.get("dfs.namenode.rpc-address", "127.0.0.1").split(":")[0],
-            int(conf.get("dfs.namenode.rpc-address", "127.0.0.1:8020")
-                .split(":")[1]))
+        if nn_addr is None:
+            from hadoop_tpu.util.misc import parse_addr_list
+            self.nn_addrs = parse_addr_list(
+                conf.get("dfs.namenode.rpc-address", "127.0.0.1:8020"))
+        elif isinstance(nn_addr, tuple):
+            self.nn_addrs = [nn_addr]
+        else:
+            self.nn_addrs = list(nn_addr)
         self.host = host
         self.uuid = self._load_or_create_uuid()
         self.store: Optional[BlockStore] = None
         self.xceiver: Optional[DataXceiverServer] = None
         self._client: Optional[Client] = None
-        self._nn_proxy = None
         self._stop_event = threading.Event()
-        self._ibr_lock = threading.Lock()
-        self._received: List[Block] = []
-        self._deleted: List[Block] = []
+        self._actors: List["_BPServiceActor"] = []
 
     def _load_or_create_uuid(self) -> str:
         os.makedirs(self.data_dir, exist_ok=True)
@@ -116,11 +121,12 @@ class DataNode(AbstractService):
 
     def service_start(self) -> None:
         self.xceiver.start()
-        self._nn_proxy = get_proxy("DatanodeProtocol", self.nn_addr,
-                                   client=self._client)
-        Daemon(self._offer_service, f"bp-actor-{self.uuid[:8]}").start()
-        log.info("DataNode %s up (xfer port %d, NN %s)", self.uuid[:8],
-                 self.xceiver.port, self.nn_addr)
+        for addr in self.nn_addrs:
+            actor = _BPServiceActor(self, addr)
+            self._actors.append(actor)
+            actor.start()
+        log.info("DataNode %s up (xfer port %d, NNs %s)", self.uuid[:8],
+                 self.xceiver.port, self.nn_addrs)
 
     def service_stop(self) -> None:
         self._stop_event.set()
@@ -131,56 +137,24 @@ class DataNode(AbstractService):
 
     # ---------------------------------------------------------- NN reporting
 
+    @property
+    def nn_addr(self):
+        """First NN address (compat for single-NN callers/tests)."""
+        return self.nn_addrs[0]
+
+    @nn_addr.setter
+    def nn_addr(self, addr) -> None:
+        self.nn_addrs[0] = addr
+        if self._actors:
+            self._actors[0].nn_addr = addr
+
     def _on_block_received(self, block: Block) -> None:
-        with self._ibr_lock:
-            self._received.append(block)
+        for actor in self._actors:
+            actor.note_received(block)
 
-    def _offer_service(self) -> None:
-        """Main actor loop. Ref: BPServiceActor.offerService:643."""
-        registered = False
-        last_full_report = 0.0
-        import time as _time
-        while not self._stop_event.is_set():
-            try:
-                if not registered:
-                    self._nn_proxy.register_datanode(
-                        self.datanode_info().to_wire())
-                    registered = True
-                    self._send_full_report()
-                    last_full_report = _time.monotonic()
-                self._flush_incremental_reports()
-                DataNodeFaultInjector.get().before_heartbeat(self)
-                stats = self.store.stats()
-                cmds = self._nn_proxy.send_heartbeat(
-                    self.uuid, stats["capacity"], stats["dfs_used"],
-                    stats["remaining"], self.xceiver.active_xceivers)
-                for c in cmds:
-                    registered &= self._execute(DnCommand.from_wire(c))
-                if _time.monotonic() - last_full_report > \
-                        self.block_report_interval:
-                    self._send_full_report()
-                    last_full_report = _time.monotonic()
-            except Exception as e:  # noqa: BLE001 — actor must survive NN bounces
-                log.debug("heartbeat round failed (%s); will retry", e)
-                registered = False
-                # NN may have restarted on a new address (minicluster) —
-                # rebuild the proxy from the current nn_addr.
-                self._nn_proxy = get_proxy("DatanodeProtocol", self.nn_addr,
-                                           client=self._client)
-            self._stop_event.wait(self.heartbeat_interval)
-
-    def _send_full_report(self) -> None:
-        blocks = [b.to_wire() for b in self.store.all_finalized()]
-        self._nn_proxy.block_report(self.uuid, blocks)
-
-    def _flush_incremental_reports(self) -> None:
-        with self._ibr_lock:
-            received, self._received = self._received, []
-            deleted, self._deleted = self._deleted, []
-        if received or deleted:
-            self._nn_proxy.block_received_and_deleted(
-                self.uuid, [b.to_wire() for b in received],
-                [b.to_wire() for b in deleted])
+    def _on_block_deleted(self, block: Block) -> None:
+        for actor in self._actors:
+            actor.note_deleted(block)
 
     # -------------------------------------------------------------- commands
 
@@ -191,8 +165,7 @@ class DataNode(AbstractService):
         if cmd.action == DnCommand.INVALIDATE:
             for b in cmd.blocks:
                 if self.store.invalidate(b):
-                    with self._ibr_lock:
-                        self._deleted.append(b)
+                    self._on_block_deleted(b)
         elif cmd.action == DnCommand.TRANSFER:
             for block, targets in zip(cmd.blocks, cmd.targets):
                 Daemon(self._transfer, "dn-transfer",
@@ -209,8 +182,7 @@ class DataNode(AbstractService):
                     self.store.update_gen_stamp(block.block_id, new_gs)
                     rep = self.store.finalize_existing(block.block_id)
                     if rep is not None:
-                        with self._ibr_lock:
-                            self._received.append(rep.to_block())
+                        self._on_block_received(rep.to_block())
                 except IOError as e:
                     log.warning("recover of %s failed: %s", block, e)
         return True
@@ -220,8 +192,7 @@ class DataNode(AbstractService):
         from hadoop_tpu.dfs.datanode import ec_worker
         rebuilt = ec_worker.reconstruct(self.store, payload)
         if rebuilt is not None:
-            with self._ibr_lock:
-                self._received.append(rebuilt)
+            self._on_block_received(rebuilt)
 
     def _transfer(self, block: Block, targets) -> None:
         try:
@@ -233,3 +204,79 @@ class DataNode(AbstractService):
             log.info("Transferred %s to %s", block, targets)
         except Exception as e:  # noqa: BLE001
             log.warning("transfer of %s failed: %s", block, e)
+
+
+class _BPServiceActor:
+    """One DN→NN reporting loop. Ref: server/datanode/BPServiceActor.java
+    (:516 sendHeartBeat, :643 offerService)."""
+
+    def __init__(self, dn: DataNode, nn_addr: Tuple[str, int]):
+        self.dn = dn
+        self.nn_addr = nn_addr
+        self._lock = threading.Lock()
+        self._received: List[Block] = []
+        self._deleted: List[Block] = []
+        self._proxy = None
+
+    def start(self) -> None:
+        Daemon(self._offer_service,
+               f"bp-actor-{self.dn.uuid[:8]}-{self.nn_addr[1]}").start()
+
+    def note_received(self, block: Block) -> None:
+        with self._lock:
+            self._received.append(block)
+
+    def note_deleted(self, block: Block) -> None:
+        with self._lock:
+            self._deleted.append(block)
+
+    def _offer_service(self) -> None:
+        """Main actor loop. Ref: BPServiceActor.offerService:643."""
+        dn = self.dn
+        registered = False
+        last_full_report = 0.0
+        import time as _time
+        self._proxy = get_proxy("DatanodeProtocol", self.nn_addr,
+                                client=dn._client)
+        while not dn._stop_event.is_set():
+            try:
+                if not registered:
+                    self._proxy.register_datanode(
+                        dn.datanode_info().to_wire())
+                    registered = True
+                    self._send_full_report()
+                    last_full_report = _time.monotonic()
+                self._flush_incremental_reports()
+                DataNodeFaultInjector.get().before_heartbeat(dn)
+                stats = dn.store.stats()
+                cmds = self._proxy.send_heartbeat(
+                    dn.uuid, stats["capacity"], stats["dfs_used"],
+                    stats["remaining"], dn.xceiver.active_xceivers)
+                for c in cmds:
+                    registered &= dn._execute(DnCommand.from_wire(c))
+                if _time.monotonic() - last_full_report > \
+                        dn.block_report_interval:
+                    self._send_full_report()
+                    last_full_report = _time.monotonic()
+            except Exception as e:  # noqa: BLE001 — survive NN bounces
+                log.debug("heartbeat round to %s failed (%s); will retry",
+                          self.nn_addr, e)
+                registered = False
+                # NN may have restarted on a new address (minicluster) —
+                # rebuild the proxy from the current nn_addr.
+                self._proxy = get_proxy("DatanodeProtocol", self.nn_addr,
+                                        client=dn._client)
+            dn._stop_event.wait(dn.heartbeat_interval)
+
+    def _send_full_report(self) -> None:
+        blocks = [b.to_wire() for b in self.dn.store.all_finalized()]
+        self._proxy.block_report(self.dn.uuid, blocks)
+
+    def _flush_incremental_reports(self) -> None:
+        with self._lock:
+            received, self._received = self._received, []
+            deleted, self._deleted = self._deleted, []
+        if received or deleted:
+            self._proxy.block_received_and_deleted(
+                self.dn.uuid, [b.to_wire() for b in received],
+                [b.to_wire() for b in deleted])

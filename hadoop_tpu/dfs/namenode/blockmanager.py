@@ -155,9 +155,12 @@ class DatanodeManager:
             return self._nodes.get(uuid)
 
     def handle_heartbeat(self, uuid: str, capacity: int, dfs_used: int,
-                         remaining: int, xceivers: int) -> List[DnCommand]:
+                         remaining: int, xceivers: int,
+                         issue_commands: bool = True) -> List[DnCommand]:
         """Ref: DatanodeManager.handleHeartbeat:1673 — refresh stats, hand the
-        node its queued work as commands."""
+        node its queued work as commands. A standby NN passes
+        ``issue_commands=False``: liveness/stats refresh only, queues stay
+        intact for whoever becomes active."""
         with self._lock:
             node = self._nodes.get(uuid)
             if node is None:
@@ -169,6 +172,8 @@ class DatanodeManager:
             node.dfs_used = dfs_used
             node.remaining = remaining
             node.xceiver_count = xceivers
+            if not issue_commands:
+                return []
             cmds: List[DnCommand] = []
             if node.invalidate_queue:
                 cmds.append(DnCommand(DnCommand.INVALIDATE,

@@ -64,7 +64,10 @@ class JournalManager:
     def journal(self, records: bytes, first_txid: int, count: int) -> None: ...
     def sync(self) -> None: ...
     def finalize_segment(self, first_txid: int, last_txid: int) -> None: ...
+    def discard_inprogress(self, first_txid: int) -> None: ...
     def read_edits(self, from_txid: int) -> Iterator[Dict]: ...
+    def write_seen_txid(self, txid: int) -> None: ...
+    def read_seen_txid(self) -> int: ...
     def close(self) -> None: ...
 
 
@@ -112,6 +115,12 @@ class FileJournalManager(JournalManager):
         dst = os.path.join(self.dir, f"edits_{first_txid}-{last_txid}")
         os.rename(src, dst)
         self._inprogress_first = None
+
+    def discard_inprogress(self, first_txid: int) -> None:
+        self.close()
+        p = os.path.join(self.dir, f"edits_inprogress_{first_txid}")
+        if os.path.exists(p):
+            os.remove(p)
 
     def write_seen_txid(self, txid: int) -> None:
         tmp = os.path.join(self.dir, "seen_txid.tmp")
@@ -203,7 +212,7 @@ class FSEditLog:
         editlog.log_sync(txid)        # batched fsync up to >= txid
     """
 
-    def __init__(self, journal: FileJournalManager):
+    def __init__(self, journal: JournalManager):
         self.journal = journal
         self._lock = threading.Lock()        # append ordering
         self._sync_lock = threading.Lock()   # one syncer at a time
@@ -232,6 +241,14 @@ class FSEditLog:
         self._open = True
 
     def close(self) -> None:
+        self.close_segment()
+        self.journal.close()
+
+    def close_segment(self) -> None:
+        """Flush + finalize the open segment WITHOUT closing the journal
+        manager — demotion to standby keeps tailing through the same
+        QuorumJournalManager (ref: FSEditLog.close vs. the standby's
+        continued use of the shared journal)."""
         if not self._open:
             return
         # _sync_lock serializes against concurrent log_sync; the internal
@@ -243,7 +260,6 @@ class FSEditLog:
             self._open = False
             if first is not None and last >= first:
                 self.journal.finalize_segment(first, last)
-            self.journal.close()
 
     def roll(self) -> int:
         """Finalize the current segment and start a new one (checkpointing
@@ -263,12 +279,8 @@ class FSEditLog:
             if last >= first:
                 self.journal.finalize_segment(first, last)
             else:
-                self.journal.close()
                 # Empty in-progress segment: remove and restart.
-                p = os.path.join(self.journal.dir,
-                                 f"edits_inprogress_{first}")
-                if os.path.exists(p):
-                    os.remove(p)
+                self.journal.discard_inprogress(first)
             self.journal.start_segment(new_first)
             self.journal.write_seen_txid(new_first)
             return new_first

@@ -42,7 +42,8 @@ log = logging.getLogger(__name__)
 
 
 class FSNamesystem:
-    def __init__(self, conf: Configuration, name_dir: str):
+    def __init__(self, conf: Configuration, name_dir: str,
+                 journal_manager=None):
         self.conf = conf
         self.name_dir = name_dir
         self.default_block_size = conf.get_size_bytes("dfs.blocksize",
@@ -53,7 +54,9 @@ class FSNamesystem:
                 "dfs.namenode.write-lock-reporting-threshold", 1.0))
         self.fsdir = FSDirectory()
         self.image = FSImage(os.path.join(name_dir, "image"))
-        self.editlog = FSEditLog(FileJournalManager(
+        # Journal seam: local directory by default, quorum journal in HA
+        # (ref: FSEditLog's JournalSet of FileJournalManager/QJM members).
+        self.editlog = FSEditLog(journal_manager or FileJournalManager(
             os.path.join(name_dir, "edits")))
         self.leases = LeaseManager(
             soft_limit_s=conf.get_time_seconds("dfs.lease.soft-limit", 60.0),
@@ -73,8 +76,11 @@ class FSNamesystem:
 
     # ------------------------------------------------------------- lifecycle
 
-    def load_from_disk(self) -> None:
-        """Ref: FSNamesystem.loadFromDisk:766 — image then edits replay."""
+    def load_from_disk(self, open_edits: bool = True) -> int:
+        """Ref: FSNamesystem.loadFromDisk:766 — image then edits replay.
+        ``open_edits=False`` loads read-only (HA standby: the tailer keeps
+        applying and a later transition opens the journal for write).
+        Returns the last applied txid."""
         last_txid = 0
         loaded = self.image.load()
         if loaded is not None:
@@ -91,8 +97,10 @@ class FSNamesystem:
         log.info("Loaded namespace: %d inodes, replayed %d edits, txid=%d",
                  self.fsdir.num_inodes(), replayed, last_txid)
         self._rebuild_block_map()
-        self.editlog.open_for_write(last_txid)
+        if open_edits:
+            self.editlog.open_for_write(last_txid)
         self.bm.safemode.set_block_total(self.bm.num_blocks())
+        return last_txid
 
     def _rebuild_block_map(self) -> None:
         """Blocks live in inodes after load; register them with the BM
@@ -104,15 +112,24 @@ class FSNamesystem:
         for node in iter_tree(self.fsdir.root):
             if isinstance(node, INodeFile):
                 for b in node.blocks:
-                    if node.ec_policy:
-                        info = self.bm.add_striped_block_collection(
-                            b, node, ec.get_policy(node.ec_policy))
-                    else:
-                        info = self.bm.add_block_collection(b, node,
-                                                            node.replication)
+                    info = self._register_block_locked(node, b)
                     info.under_construction = node.under_construction and \
                         b is node.blocks[-1]
                     self._track_block_id(b.to_wire())
+
+    def _register_block_locked(self, inode: INodeFile, b: Block):
+        """Idempotently register an inode's block with the block manager
+        (replay/tailing path — locations already reported must survive)."""
+        info = self.bm.get(b.block_id)
+        if info is not None:
+            info.block.num_bytes = max(info.block.num_bytes, b.num_bytes)
+            if b.gen_stamp > info.block.gen_stamp:
+                info.block.gen_stamp = b.gen_stamp
+            return info
+        if inode.ec_policy:
+            return self.bm.add_striped_block_collection(
+                b, inode, ec.get_policy(inode.ec_policy))
+        return self.bm.add_block_collection(b, inode, inode.replication)
 
     def save_namespace(self) -> str:
         """Checkpoint. Ref: FSNamesystem.saveNamespace — requires safemode in
@@ -120,16 +137,21 @@ class FSNamesystem:
         serialize, then roll the edit log."""
         with self.lock.write():
             txid = self.editlog.last_txid
-            extra = {
-                "next_block_id": self._next_block_id,
-                "next_group_id": self._next_group_id,
-                "gen_stamp": self._gen_stamp,
-                "leases": self.leases.snapshot_for_image(),
-            }
-            path = self.image.save(self.fsdir, txid, extra)
+            path = self.image.save(self.fsdir, txid, self.image_extra())
         self.editlog.roll()
         self.image.purge_old()
         return path
+
+    def image_extra(self) -> Dict:
+        """Counters that must survive restart alongside the image — the
+        single source for both the local checkpointer and the standby's
+        (drift here would lose id/stamp state across failover)."""
+        return {
+            "next_block_id": self._next_block_id,
+            "next_group_id": self._next_group_id,
+            "gen_stamp": self._gen_stamp,
+            "leases": self.leases.snapshot_for_image(),
+        }
 
     def close(self) -> None:
         try:
@@ -698,7 +720,10 @@ class FSNamesystem:
                 # create(overwrite=True) replaced an existing file; replay the
                 # implicit delete (its blocks die with it — any replicas left
                 # on DNs are invalidated as unknown at report time).
-                self.fsdir.delete(rec["p"], recursive=False)
+                gone = self.fsdir.delete(rec["p"], recursive=False)
+                if gone is not None:
+                    for b in collect_blocks(gone):
+                        self.bm.remove_block(b)
                 holder = self.leases.holder_of(rec["p"])
                 if holder:
                     self.leases.remove_lease(holder, rec["p"])
@@ -712,11 +737,21 @@ class FSNamesystem:
         elif op == el.OP_ADD_BLOCK:
             inode = self.fsdir.get_inode(rec["p"])
             if isinstance(inode, INodeFile):
-                inode.blocks.append(Block.from_wire(rec["b"]))
+                blk = Block.from_wire(rec["b"])
+                inode.blocks.append(blk)
+                info = self._register_block_locked(inode, blk)
+                info.under_construction = True
         elif op == el.OP_UPDATE_BLOCKS:
             inode = self.fsdir.get_inode(rec["p"])
             if isinstance(inode, INodeFile):
-                inode.blocks = [Block.from_wire(b) for b in rec["b"]]
+                new_blocks = [Block.from_wire(b) for b in rec["b"]]
+                kept = {b.block_id for b in new_blocks}
+                for old in inode.blocks:
+                    if old.block_id not in kept:
+                        self.bm.remove_block(old)
+                inode.blocks = new_blocks
+                for b in inode.blocks:
+                    self._register_block_locked(inode, b)
         elif op == el.OP_CLOSE:
             inode = self.fsdir.get_inode(rec["p"])
             if isinstance(inode, INodeFile):
@@ -725,12 +760,17 @@ class FSNamesystem:
                 if inode.client_name:
                     self.leases.remove_lease(inode.client_name, rec["p"])
                     inode.client_name = None
+                for b in inode.blocks:
+                    self._register_block_locked(inode, b)
+                    self.bm.complete_block(b)
         elif op == el.OP_MKDIR:
             self.fsdir.mkdirs(rec["p"], owner=rec.get("o", ""))
         elif op == el.OP_DELETE:
             node = self.fsdir.delete(rec["p"], rec.get("r", True))
             if node is not None:
                 self.leases.remove_under(rec["p"])
+                for b in collect_blocks(node):
+                    self.bm.remove_block(b)
         elif op == el.OP_RENAME:
             actual = self.fsdir.rename(rec["s"], rec["d"])
             self.leases.rename_path(rec["s"], actual)

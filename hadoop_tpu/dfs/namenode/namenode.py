@@ -21,26 +21,43 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from typing import Dict, List, Optional
 
 from hadoop_tpu.conf import Configuration
+from hadoop_tpu.dfs.namenode import ha
 from hadoop_tpu.dfs.namenode.fsnamesystem import FSNamesystem
 from hadoop_tpu.dfs.protocol.records import Block, DatanodeInfo
 from hadoop_tpu.ipc import RetryCache, Server, current_call, idempotent
+from hadoop_tpu.ipc.errors import RetriableError
 from hadoop_tpu.ipc.server import CallContext
 from hadoop_tpu.service import AbstractService
 from hadoop_tpu.util.misc import Daemon
 
 log = logging.getLogger(__name__)
 
+# ClientProtocol methods that mutate the namespace — everything else is a
+# read (ref: the OperationCategory.WRITE annotations in NameNodeRpcServer).
+WRITE_METHODS = frozenset({
+    "create", "add_block", "abandon_block", "complete", "update_pipeline",
+    "mkdirs", "delete", "rename", "set_replication", "set_times",
+    "set_permission", "set_owner", "recover_lease", "set_safemode",
+    "save_namespace", "decommission_datanode", "set_ec_policy", "msync",
+    # Lease renewal and corruption reports mutate active-side state; an
+    # observer silently swallowing them would expire live writers.
+    "renew_lease", "report_bad_blocks",
+})
+
 
 class ClientProtocol:
     """RPC facade over FSNamesystem. Ref: NameNodeRpcServer.java — the thin
     translation layer; at-most-once mutations go through the retry cache."""
 
-    def __init__(self, fsn: FSNamesystem, retry_cache: RetryCache):
+    def __init__(self, fsn: FSNamesystem, retry_cache: RetryCache,
+                 state_getter=lambda: ha.ACTIVE):
         self.fsn = fsn
         self.retry_cache = retry_cache
+        self._state = state_getter
 
     def _cached(self, fn, *args):
         """Retry-cache wrapper for non-idempotent mutations.
@@ -197,16 +214,26 @@ class ClientProtocol:
         return True
 
     @idempotent
+    def msync(self):
+        """State alignment point (ref: ClientProtocol.msync:1844): served
+        only by the active (routed there via WRITE_METHODS), the response's
+        state id tells the client the latest committed txid so subsequent
+        observer reads wait for it."""
+        return None
+
+    @idempotent
     def get_service_status(self):
-        return {"state": "active", "safemode": self.fsn.bm.safemode.is_on()}
+        return {"state": self._state(),
+                "safemode": self.fsn.bm.safemode.is_on()}
 
 
 class DatanodeProtocol:
     """NN side of the DN↔NN protocol. Ref: server/protocol/DatanodeProtocol
     .java; the DN's BPServiceActor (BPServiceActor.java:516,:643) drives it."""
 
-    def __init__(self, fsn: FSNamesystem):
+    def __init__(self, fsn: FSNamesystem, state_getter=lambda: ha.ACTIVE):
         self.fsn = fsn
+        self._state = state_getter
 
     def register_datanode(self, info: Dict) -> Dict:
         node = self.fsn.bm.dn_manager.register(DatanodeInfo.from_wire(info))
@@ -215,8 +242,12 @@ class DatanodeProtocol:
     @idempotent
     def send_heartbeat(self, uuid: str, capacity: int, dfs_used: int,
                        remaining: int, xceivers: int = 0):
+        # Standby/observer track liveness but never command DNs — queued
+        # work stays put for whoever becomes active (ref: the standby's
+        # BPServiceActor ignoring command responses).
         cmds = self.fsn.bm.dn_manager.handle_heartbeat(
-            uuid, capacity, dfs_used, remaining, xceivers)
+            uuid, capacity, dfs_used, remaining, xceivers,
+            issue_commands=self._state() == ha.ACTIVE)
         return [c.to_wire() for c in cmds]
 
     @idempotent
@@ -242,26 +273,95 @@ class DatanodeProtocol:
         return self.fsn.next_gen_stamp()
 
 
-class NameNode(AbstractService):
-    """The daemon. Ref: server/namenode/NameNode.java."""
+class HAServiceProtocol:
+    """Manual HA admin RPC (ref: HAServiceProtocol.proto +
+    NameNode.stateChangeRequest paths; driven by `dfsadmin -transition*`)."""
 
-    def __init__(self, conf: Configuration, name_dir: Optional[str] = None):
+    def __init__(self, namenode: "NameNode"):
+        self.nn = namenode
+
+    def transition_to_active(self) -> bool:
+        self.nn.transition_to_active()
+        return True
+
+    def transition_to_standby(self) -> bool:
+        self.nn.transition_to_standby()
+        return True
+
+    def transition_to_observer(self) -> bool:
+        self.nn.transition_to_observer()
+        return True
+
+    @idempotent
+    def get_ha_status(self) -> Dict:
+        return {"state": self.nn.ha_state, "nn_id": self.nn.nn_id,
+                "last_txid": self.nn.applied_txid()}
+
+    @idempotent
+    def monitor_health(self) -> bool:
+        return self.nn.is_healthy()
+
+
+class NameNode(AbstractService):
+    """The daemon. Ref: server/namenode/NameNode.java. Non-HA: single
+    active with a local journal. HA: a QuorumJournalManager over the
+    configured JournalNodes; the node boots standby and is promoted by the
+    failover controller (auto) or HAServiceProtocol (manual)."""
+
+    def __init__(self, conf: Configuration, name_dir: Optional[str] = None,
+                 nn_id: Optional[str] = None):
         super().__init__("NameNode")
         self._conf_in = conf
         self.name_dir = name_dir or conf.get("dfs.namenode.name.dir",
                                              "/tmp/htpu-name")
+        self.nn_id = nn_id or conf.get("dfs.ha.namenode.id", "nn1")
         self.fsn: Optional[FSNamesystem] = None
         self.rpc: Optional[Server] = None
+        self.ha_enabled = False
+        self.ha_state = ha.ACTIVE
+        self.tailer: Optional[ha.EditLogTailer] = None
+        self.checkpointer: Optional[ha.StandbyCheckpointer] = None
+        self.failover: Optional[ha.FailoverController] = None
+        self._ha_lock = threading.RLock()
         self._stop_event = threading.Event()
 
     @property
     def port(self) -> int:
         return self.rpc.port
 
+    def applied_txid(self) -> int:
+        if self.ha_state == ha.ACTIVE or self.tailer is None:
+            return self.fsn.editlog.last_txid
+        return self.tailer.last_applied_txid
+
+    def is_healthy(self) -> bool:
+        return self.fsn is not None and self.rpc is not None
+
     def service_init(self, conf: Configuration) -> None:
         os.makedirs(self.name_dir, exist_ok=True)
-        self.fsn = FSNamesystem(conf, self.name_dir)
-        self.fsn.load_from_disk()
+        shared = conf.get("dfs.namenode.shared.edits.dir", "")
+        self.ha_enabled = bool(shared)
+        journal = None
+        if self.ha_enabled:
+            from hadoop_tpu.dfs.qjournal import QuorumJournalManager
+            from hadoop_tpu.util.misc import parse_addr_list
+            self._jn_addrs = parse_addr_list(shared)
+            journal = QuorumJournalManager(self._jn_addrs, conf=conf)
+        self.fsn = FSNamesystem(conf, self.name_dir, journal_manager=journal)
+        if self.ha_enabled:
+            self.ha_state = ha.STANDBY
+            last = self.fsn.load_from_disk(open_edits=False)
+            self.tailer = ha.EditLogTailer(
+                self.fsn, interval_s=conf.get_time_seconds(
+                    "dfs.ha.tail-edits.period", 0.5))
+            self.tailer.last_applied_txid = last
+            self.checkpointer = ha.StandbyCheckpointer(
+                self.fsn, self.tailer,
+                period_s=conf.get_time_seconds(
+                    "dfs.namenode.checkpoint.period", 3600.0),
+                txns=conf.get_int("dfs.namenode.checkpoint.txns", 1_000_000))
+        else:
+            self.fsn.load_from_disk()
         bind_host = conf.get("dfs.namenode.rpc-bind-host", "127.0.0.1")
         port = conf.get_int("dfs.namenode.rpc-port", 0)
         self.retry_cache = RetryCache()
@@ -269,46 +369,155 @@ class NameNode(AbstractService):
             conf, bind=(bind_host, port),
             num_handlers=conf.get_int("dfs.namenode.handler.count", 8),
             name="namenode",
-            state_provider=lambda: self.fsn.editlog.last_txid,
+            state_provider=self.applied_txid,
             queue_prefix="dfs.namenode")
+        state = lambda: self.ha_state  # noqa: E731
         self.rpc.register_protocol(
-            "ClientProtocol", ClientProtocol(self.fsn, self.retry_cache))
-        self.rpc.register_protocol("DatanodeProtocol", DatanodeProtocol(self.fsn))
+            "ClientProtocol", ClientProtocol(self.fsn, self.retry_cache,
+                                             state),
+            pre_call=self._client_pre_call)
+        self.rpc.register_protocol("DatanodeProtocol",
+                                   DatanodeProtocol(self.fsn, state))
+        self.rpc.register_protocol("HAServiceProtocol",
+                                   HAServiceProtocol(self))
+
+    def _client_pre_call(self, method: str, ctx: CallContext) -> None:
+        """HA gate + observer alignment (ref: NameNodeRpcServer's
+        checkOperation + GlobalStateIdContext.receiveRequestState)."""
+        ha.check_operation(self.ha_state, method in WRITE_METHODS)
+        if self.ha_state == ha.OBSERVER and ctx.client_state_id >= 0:
+            deadline = time.monotonic() + 3.0
+            while self.applied_txid() < ctx.client_state_id:
+                if time.monotonic() > deadline:
+                    raise RetriableError(
+                        f"observer lagging: applied {self.applied_txid()} "
+                        f"< requested {ctx.client_state_id}")
+                time.sleep(0.01)
 
     def service_start(self) -> None:
         self.rpc.start()
         Daemon(self._redundancy_monitor, "nn-redundancy-monitor").start()
-        Daemon(self._checkpoint_monitor, "nn-checkpointer").start()
-        log.info("NameNode up at 127.0.0.1:%d (name dir %s)",
-                 self.rpc.port, self.name_dir)
+        if self.ha_enabled:
+            self.tailer.start(self.tailer.last_applied_txid)
+            self.checkpointer.start()
+            auto = self.config.get_bool(
+                "dfs.ha.automatic-failover.enabled", True)
+            want_observer = self.config.get(
+                "dfs.ha.initial-state", "") == ha.OBSERVER
+            if want_observer:
+                self.ha_state = ha.OBSERVER
+            elif auto:
+                from hadoop_tpu.dfs.qjournal import QuorumLease
+                lease = QuorumLease(
+                    self._jn_addrs, holder=self.nn_id,
+                    ttl_s=self.config.get_time_seconds(
+                        "dfs.ha.lease-duration", 4.0),
+                    conf=self.config)
+                self.failover = ha.FailoverController(
+                    self, lease, check_interval_s=self.config.get_time_seconds(
+                        "dfs.ha.health-check.interval", 0.5))
+                self.failover.start()
+        else:
+            Daemon(self._checkpoint_monitor, "nn-checkpointer").start()
+        log.info("NameNode %s up at 127.0.0.1:%d (state %s, name dir %s)",
+                 self.nn_id, self.rpc.port, self.ha_state, self.name_dir)
 
     def service_stop(self) -> None:
         self._stop_event.set()
+        if self.failover is not None:
+            self.failover.stop()
+            self.failover.lease.release()
+            self.failover.lease.close()
+        if self.tailer is not None:
+            self.tailer.stop()
+        if self.checkpointer is not None:
+            self.checkpointer.stop()
         if self.rpc:
             self.rpc.stop()
         if self.fsn:
             self.fsn.close()
 
+    # ---------------------------------------------------------- transitions
+
+    def transition_to_active(self) -> None:
+        """Ref: NameNode.transitionToActive → startActiveServices: final
+        tail, fence + recover the quorum journal, open for write."""
+        with self._ha_lock:
+            if self.ha_state == ha.ACTIVE:
+                return
+            if not self.ha_enabled:
+                raise ValueError("HA is not enabled")
+            self.tailer.stop()
+            self.checkpointer.stop()
+            qjm = self.fsn.editlog.journal
+            last_committed = qjm.recover()      # epoch fencing happens here
+            # Apply anything committed but not yet tailed.
+            with self.fsn.lock.write():
+                for rec in qjm.read_edits(self.tailer.last_applied_txid + 1):
+                    self.fsn._apply_edit(rec)
+                    self.tailer.last_applied_txid = rec["t"]
+            last = max(last_committed, self.tailer.last_applied_txid)
+            self.fsn.editlog.open_for_write(last)
+            self.ha_state = ha.ACTIVE
+            log.info("NameNode %s is now ACTIVE at txid %d", self.nn_id, last)
+
+    def transition_to_standby(self) -> None:
+        """Ref: NameNode.transitionToStandby → startStandbyServices."""
+        with self._ha_lock:
+            if self.ha_state == ha.STANDBY:
+                return
+            if not self.ha_enabled:
+                raise ValueError("HA is not enabled")
+            was_active = self.ha_state == ha.ACTIVE
+            self.ha_state = ha.STANDBY
+            # Always stop first: observer→standby must not leave the old
+            # tailer/checkpointer threads running beside fresh ones.
+            self.tailer.stop()
+            self.checkpointer.stop()
+            if was_active:
+                try:
+                    # Finalize our segment but keep the journal manager
+                    # alive — the standby tails through it and a later
+                    # re-promotion reuses it.
+                    self.fsn.editlog.close_segment()
+                except Exception:
+                    log.exception("closing edit segment on demotion")
+                start_from = self.fsn.editlog.last_txid
+            else:
+                start_from = self.tailer.last_applied_txid
+            self.tailer.start(start_from)
+            self.checkpointer.start()
+            log.info("NameNode %s is now STANDBY", self.nn_id)
+
+    def transition_to_observer(self) -> None:
+        with self._ha_lock:
+            if self.ha_state == ha.ACTIVE:
+                self.transition_to_standby()
+            self.ha_state = ha.OBSERVER
+            log.info("NameNode %s is now OBSERVER", self.nn_id)
+
     # ------------------------------------------------------------- monitors
 
     def _redundancy_monitor(self) -> None:
         """Ref: BlockManager.RedundancyMonitor + HeartbeatManager.Monitor +
-        LeaseManager.Monitor rolled into one sweep loop."""
+        LeaseManager.Monitor rolled into one sweep loop. Active-only work;
+        liveness sweeps run in every state."""
         interval = self.config.get_time_seconds(
             "dfs.namenode.redundancy.interval", 3.0)
         while not self._stop_event.wait(interval):
             try:
                 for node in self.fsn.bm.dn_manager.check_dead_nodes():
                     self.fsn.bm.node_died(node)
-                if not self.fsn.bm.safemode.is_on():
+                if self.ha_state == ha.ACTIVE and \
+                        not self.fsn.bm.safemode.is_on():
                     self.fsn.bm.compute_reconstruction_work()
                     self.fsn.check_leases()
             except Exception:
                 log.exception("Redundancy monitor pass failed")
 
     def _checkpoint_monitor(self) -> None:
-        """Periodic checkpoint by txn count / period.
-        Ref: StandbyCheckpointer.doCheckpoint:194 trigger conditions."""
+        """Periodic checkpoint by txn count / period (non-HA only; in HA
+        the standby checkpoints — ref: StandbyCheckpointer.java:64)."""
         period = self.config.get_time_seconds(
             "dfs.namenode.checkpoint.period", 3600.0)
         txns = self.config.get_int("dfs.namenode.checkpoint.txns", 1_000_000)

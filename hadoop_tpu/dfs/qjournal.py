@@ -1,0 +1,491 @@
+"""Quorum journal: JournalNode daemons + QuorumJournalManager client.
+
+Parity with the reference's QJM (ref: hadoop-hdfs qjournal/server/
+Journal.java, JournalNode.java, JournalNodeRpcServer.java; client
+qjournal/client/QuorumJournalManager.java, AsyncLoggerSet): the edit log
+is replicated to N journal daemons and a write is durable once a majority
+acks it. Writer exclusivity is epoch-fenced: becoming the writer bumps an
+epoch on a quorum (``new_epoch``), and every journal RPC carries it — a
+deposed writer's appends are rejected, which is the split-brain guard
+(ref: Journal.checkRequest's epoch validation).
+
+Recovery on writer takeover is the simplified equivalent of the
+reference's prepare/accept protocol: collect segment states from a
+majority, adopt the longest available tail from any responder, rewrite it
+with the new epoch, and finalize (any txid acked to a client lived on a
+majority, so the max responder tail always contains it).
+
+The JournalNodes double as the failover lock service: a lease named
+``active`` granted by a majority elects the active NameNode (the ZKFC/
+ZooKeeper analog — ref: ha/ActiveStandbyElector.java — reimagined on the
+quorum that already exists instead of an external ensemble).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.dfs.namenode.editlog import (FileJournalManager,
+                                             JournalManager)
+from hadoop_tpu.ipc import Client, Server, get_proxy, idempotent
+from hadoop_tpu.ipc.errors import register_exception
+from hadoop_tpu.service import AbstractService
+from hadoop_tpu.util.misc import Daemon
+
+log = logging.getLogger(__name__)
+
+
+@register_exception
+class FencedError(IOError):
+    """Request carried a stale epoch — the caller has been superseded.
+    Ref: qjournal JournalOutOfSyncException / IOException('epoch ...')."""
+
+
+class _Journal:
+    """One journal's state on a JournalNode. Ref: qjournal/server/Journal
+    .java — promised/writer epochs are durable so fencing survives
+    restarts."""
+
+    def __init__(self, storage_dir: str):
+        self.fjm = FileJournalManager(storage_dir)
+        self._epoch_file = os.path.join(storage_dir, "epoch")
+        self.promised_epoch = self._load_epoch()
+        self.writer_epoch = 0
+        self.last_txid = self._scan_last_txid()
+        self.lock = threading.Lock()
+
+    def _load_epoch(self) -> int:
+        try:
+            with open(self._epoch_file) as f:
+                return int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            return 0
+
+    def persist_epoch(self, epoch: int) -> None:
+        tmp = self._epoch_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(epoch))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._epoch_file)
+        self.promised_epoch = epoch
+
+    def _scan_last_txid(self) -> int:
+        last = 0
+        for rec in self.fjm.read_edits(1):
+            if rec["t"] > last:
+                last = rec["t"]
+        return last
+
+    def check_epoch(self, epoch: int) -> None:
+        if epoch < self.promised_epoch:
+            raise FencedError(
+                f"epoch {epoch} < promised {self.promised_epoch}")
+
+
+class JournalProtocol:
+    """RPC surface of a JournalNode. Ref: qjournal/protocol/
+    QJournalProtocol.java."""
+
+    def __init__(self, node: "JournalNode"):
+        self.node = node
+
+    def _journal(self, jid: str) -> _Journal:
+        return self.node.get_journal(jid)
+
+    @idempotent
+    def get_state(self, jid: str) -> Dict:
+        j = self._journal(jid)
+        with j.lock:
+            return {"promised": j.promised_epoch, "last_txid": j.last_txid}
+
+    def new_epoch(self, jid: str, epoch: int) -> Dict:
+        """Promise the epoch (if newer); returns this JN's tail position.
+        Ref: Journal.newEpoch."""
+        j = self._journal(jid)
+        with j.lock:
+            if epoch <= j.promised_epoch:
+                raise FencedError(
+                    f"epoch {epoch} <= promised {j.promised_epoch}")
+            j.persist_epoch(epoch)
+            # A segment left open by the deposed writer stays on disk; the
+            # recovering writer rewrites/finalizes through accept_tail.
+            j.fjm.close()
+            return {"last_txid": j.last_txid}
+
+    def start_segment(self, jid: str, epoch: int, first_txid: int) -> bool:
+        j = self._journal(jid)
+        with j.lock:
+            j.check_epoch(epoch)
+            j.writer_epoch = epoch
+            j.fjm.close()
+            # Drop any stale in-progress segment at this boundary — the new
+            # writer's stream replaces it.
+            p = os.path.join(j.fjm.dir, f"edits_inprogress_{first_txid}")
+            if os.path.exists(p):
+                os.remove(p)
+            j.fjm.start_segment(first_txid)
+            return True
+
+    def journal(self, jid: str, epoch: int, records: bytes,
+                first_txid: int, count: int, last_txid: int) -> bool:
+        """Append + fsync one batch. The JN always syncs — quorum ack means
+        durable on a majority (ref: Journal.journal's sync)."""
+        j = self._journal(jid)
+        with j.lock:
+            j.check_epoch(epoch)
+            j.fjm.journal(records, first_txid, count)
+            j.fjm.sync()
+            if last_txid > j.last_txid:
+                j.last_txid = last_txid
+            return True
+
+    def finalize_segment(self, jid: str, epoch: int, first_txid: int,
+                         last_txid: int) -> bool:
+        j = self._journal(jid)
+        with j.lock:
+            j.check_epoch(epoch)
+            j.fjm.finalize_segment(first_txid, last_txid)
+            return True
+
+    def discard_inprogress(self, jid: str, epoch: int,
+                           first_txid: int) -> bool:
+        j = self._journal(jid)
+        with j.lock:
+            j.check_epoch(epoch)
+            j.fjm.close()
+            p = os.path.join(j.fjm.dir, f"edits_inprogress_{first_txid}")
+            if os.path.exists(p):
+                os.remove(p)
+            return True
+
+    @idempotent
+    def get_edits(self, jid: str, from_txid: int,
+                  max_count: int = 50_000) -> List[Dict]:
+        """Serve edits for standby tailing / recovery (ref:
+        Journal.getJournaledEdits + JournaledEditsCache)."""
+        j = self._journal(jid)
+        out: List[Dict] = []
+        seen = set()
+        for rec in j.fjm.read_edits(from_txid):
+            # A retried quorum batch may have appended a txid twice —
+            # first write wins, duplicates are skipped.
+            if rec["t"] in seen:
+                continue
+            seen.add(rec["t"])
+            out.append(rec)
+            if len(out) >= max_count:
+                break
+        return out
+
+    # ------------------------------------------------- active-lease service
+
+    @idempotent
+    def acquire_lease(self, name: str, holder: str, ttl_s: float) -> Dict:
+        """Grant/renew if free, expired, or already held by ``holder``."""
+        return self.node.acquire_lease(name, holder, ttl_s)
+
+    def release_lease(self, name: str, holder: str) -> bool:
+        return self.node.release_lease(name, holder)
+
+
+class JournalNode(AbstractService):
+    """The daemon. Ref: qjournal/server/JournalNode.java."""
+
+    def __init__(self, conf: Configuration, storage_dir: Optional[str] = None):
+        super().__init__("JournalNode")
+        self.storage_dir = storage_dir or conf.get(
+            "dfs.journalnode.edits.dir", "/tmp/htpu-journal")
+        self._journals: Dict[str, _Journal] = {}
+        self._jlock = threading.Lock()
+        self._leases: Dict[str, Tuple[str, float]] = {}  # name → (holder, exp)
+        self._lease_lock = threading.Lock()
+        self.rpc: Optional[Server] = None
+
+    @property
+    def port(self) -> int:
+        return self.rpc.port
+
+    def get_journal(self, jid: str) -> _Journal:
+        with self._jlock:
+            j = self._journals.get(jid)
+            if j is None:
+                j = _Journal(os.path.join(self.storage_dir, jid))
+                self._journals[jid] = j
+            return j
+
+    def acquire_lease(self, name: str, holder: str, ttl_s: float) -> Dict:
+        now = time.monotonic()
+        with self._lease_lock:
+            cur = self._leases.get(name)
+            if cur is None or cur[1] < now or cur[0] == holder:
+                self._leases[name] = (holder, now + ttl_s)
+                return {"granted": True, "holder": holder}
+            return {"granted": False, "holder": cur[0]}
+
+    def release_lease(self, name: str, holder: str) -> bool:
+        with self._lease_lock:
+            if self._leases.get(name, ("", 0))[0] == holder:
+                del self._leases[name]
+                return True
+            return False
+
+    def service_init(self, conf: Configuration) -> None:
+        os.makedirs(self.storage_dir, exist_ok=True)
+        self.rpc = Server(
+            conf, bind=("127.0.0.1",
+                        conf.get_int("dfs.journalnode.rpc-port", 0)),
+            num_handlers=conf.get_int("dfs.journalnode.handler.count", 4),
+            name="journalnode")
+        self.rpc.register_protocol("JournalProtocol", JournalProtocol(self))
+
+    def service_start(self) -> None:
+        self.rpc.start()
+        log.info("JournalNode up at 127.0.0.1:%d (%s)", self.rpc.port,
+                 self.storage_dir)
+
+    def service_stop(self) -> None:
+        if self.rpc:
+            self.rpc.stop()
+
+
+# ======================================================================
+# Client side
+# ======================================================================
+
+class QuorumJournalManager(JournalManager):
+    """Journal manager writing to a JN quorum. Plugs into FSEditLog via the
+    JournalManager seam (ref: QuorumJournalManager.java + AsyncLoggerSet).
+
+    ``recover()`` must run (after winning election) before
+    ``FSEditLog.open_for_write``; it fences prior writers and repairs the
+    shared log to a consistent finalized tail.
+    """
+
+    def __init__(self, addrs: List[Tuple[str, int]], jid: str = "ns",
+                 conf: Optional[Configuration] = None):
+        self.addrs = list(addrs)
+        self.jid = jid
+        self.conf = conf or Configuration()
+        self.epoch = 0
+        self._client = Client(self.conf)
+        self._proxies = [get_proxy("JournalProtocol", a, client=self._client)
+                         for a in self.addrs]
+        self._pool = ThreadPoolExecutor(
+            max_workers=len(self.addrs), thread_name_prefix="qjm")
+        self._seen_txid = 0
+        self._segment_first: Optional[int] = None
+        self._last_txid = 0
+        self._buf = bytearray()
+        self._buf_first: Optional[int] = None
+        self._buf_count = 0
+        self._buf_last = 0
+
+    @property
+    def majority(self) -> int:
+        return len(self.addrs) // 2 + 1
+
+    # ---------------------------------------------------------- quorum call
+
+    def _call_all(self, method: str, *args) -> List[Tuple[int, object]]:
+        """Invoke on every JN in parallel; returns [(index, result|exc)]."""
+        futs = {i: self._pool.submit(getattr(p, method), *args)
+                for i, p in enumerate(self._proxies)}
+        out: List[Tuple[int, object]] = []
+        for i, f in futs.items():
+            try:
+                out.append((i, f.result(timeout=15.0)))
+            except Exception as e:  # noqa: BLE001 — quorum math handles it
+                out.append((i, e))
+        return out
+
+    def _quorum(self, method: str, *args) -> List[Tuple[int, object]]:
+        """Like _call_all but raises unless a majority succeeded. A fencing
+        rejection from ANY node aborts immediately — this writer is stale."""
+        results = self._call_all(method, *args)
+        good = [(i, r) for i, r in results if not isinstance(r, Exception)]
+        for _, r in results:
+            if isinstance(r, Exception) and "FencedError" in type(r).__name__:
+                raise r
+            if isinstance(r, Exception) and "epoch" in str(r) and \
+                    "promised" in str(r):
+                raise FencedError(str(r))
+        if len(good) < self.majority:
+            errs = [f"{self.addrs[i]}: {r}" for i, r in results
+                    if isinstance(r, Exception)]
+            raise IOError(
+                f"quorum {method} failed ({len(good)}/{len(self.addrs)} ok): "
+                f"{errs}")
+        return good
+
+    # ------------------------------------------------------------- recovery
+
+    def recover(self) -> int:
+        """Fence prior writers and repair the shared log; returns the last
+        committed txid. Ref: QuorumJournalManager.recoverUnfinalizedSegments
+        (prepare/accept collapsed onto adopt-the-longest-available-tail)."""
+        states = self._quorum("get_state", self.jid)
+        max_promised = max(r["promised"] for _, r in states)
+        self.epoch = max_promised + 1
+        acks = self._quorum("new_epoch", self.jid, self.epoch)
+        # The longest tail among the promising majority contains every
+        # committed txn (each was acked by a majority).
+        best_i, best = max(acks, key=lambda t: t[1]["last_txid"])
+        last = best["last_txid"]
+        self._last_txid = last
+        self._seen_txid = last
+        if last > 0:
+            self._sync_laggards(best_i, acks, last)
+        return last
+
+    def _sync_laggards(self, best_i: int, acks, last: int) -> None:
+        """Bring lagging JNs up to the recovered tail by replaying edits
+        from the most advanced one (ref: JournalNodeSyncer, collapsed into
+        writer-driven recovery)."""
+        from hadoop_tpu.io.wire import pack
+        import struct as _struct
+        for i, st in acks:
+            if i == best_i or st["last_txid"] >= last:
+                continue
+            frm = st["last_txid"] + 1
+            try:
+                edits = self._proxies[best_i].get_edits(self.jid, frm)
+                if not edits:
+                    continue
+                blob = bytearray()
+                for rec in edits:
+                    data = pack(rec)
+                    blob += _struct.pack(">I", len(data)) + data
+                p = self._proxies[i]
+                p.start_segment(self.jid, self.epoch, frm)
+                p.journal(self.jid, self.epoch, bytes(blob), frm,
+                          len(edits), last)
+                p.finalize_segment(self.jid, self.epoch, frm, last)
+                log.info("Synced laggard JN %s to txid %d", self.addrs[i],
+                         last)
+            except Exception as e:  # noqa: BLE001 — laggard stays lagging
+                log.warning("Could not sync JN %s: %s", self.addrs[i], e)
+
+    # --------------------------------------------------- JournalManager API
+
+    def start_segment(self, first_txid: int) -> None:
+        assert self.epoch > 0, "recover() must run before writing"
+        self._quorum("start_segment", self.jid, self.epoch, first_txid)
+        self._segment_first = first_txid
+
+    def journal(self, records: bytes, first_txid: int, count: int) -> None:
+        self._buf += records
+        if self._buf_first is None:
+            self._buf_first = first_txid
+        self._buf_count += count
+        self._buf_last = max(self._buf_last, first_txid + count - 1)
+
+    def sync(self) -> None:
+        """The quorum commit point: the buffered batch must land on a
+        majority before log_sync returns to the mutating caller. On quorum
+        failure the buffer is RETAINED so a later sync retries the same
+        batch — dropping it would mark in-memory mutations durable that
+        never reached the journal. (JN re-appends of an already-stored
+        txid are deduplicated at read time.)"""
+        if not self._buf:
+            return
+        self._quorum("journal", self.jid, self.epoch, bytes(self._buf),
+                     self._buf_first, self._buf_count, self._buf_last)
+        self._last_txid = max(self._last_txid, self._buf_last)
+        self._buf = bytearray()
+        self._buf_first = None
+        self._buf_count = 0
+
+    def finalize_segment(self, first_txid: int, last_txid: int) -> None:
+        self._quorum("finalize_segment", self.jid, self.epoch, first_txid,
+                     last_txid)
+        self._segment_first = None
+
+    def discard_inprogress(self, first_txid: int) -> None:
+        self._quorum("discard_inprogress", self.jid, self.epoch, first_txid)
+
+    def read_edits(self, from_txid: int) -> Iterator[Dict]:
+        """Read from whichever responder has the most data (tailing path:
+        ref EditLogTailer via getJournaledEdits)."""
+        results = self._call_all("get_edits", self.jid, from_txid)
+        best: List[Dict] = []
+        for _, r in results:
+            if isinstance(r, list) and len(r) > len(best):
+                best = r
+        # Dedup/order by txid; trust txid monotonicity.
+        seen = set()
+        for rec in sorted(best, key=lambda r: r["t"]):
+            if rec["t"] not in seen and rec["t"] >= from_txid:
+                seen.add(rec["t"])
+                yield rec
+
+    # seen_txid: QJM tracks it in memory; the authoritative value for
+    # startup comes from the image + JN replay, so a local file is not
+    # load-bearing (the reference keeps it in each storage dir).
+    def write_seen_txid(self, txid: int) -> None:
+        self._seen_txid = txid
+
+    def read_seen_txid(self) -> int:
+        return self._seen_txid
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+        self._client.stop()
+
+
+class QuorumLease:
+    """Majority-lease election over the JN set — the elector used for
+    automatic NN failover (ref: ha/ActiveStandbyElector.java, with the JN
+    quorum standing in for the ZooKeeper ensemble)."""
+
+    def __init__(self, addrs: List[Tuple[str, int]], holder: str,
+                 name: str = "active", ttl_s: float = 6.0,
+                 conf: Optional[Configuration] = None):
+        self.addrs = addrs
+        self.holder = holder
+        self.name = name
+        self.ttl_s = ttl_s
+        self._client = Client(conf or Configuration())
+        self._proxies = [get_proxy("JournalProtocol", a, client=self._client)
+                         for a in addrs]
+        self._pool = ThreadPoolExecutor(max_workers=len(addrs),
+                                        thread_name_prefix="lease")
+
+    @property
+    def majority(self) -> int:
+        return len(self.addrs) // 2 + 1
+
+    def try_acquire(self) -> bool:
+        """Acquire/renew on a majority. Not atomic across JNs — but two
+        candidates can each win only disjoint minorities plus at most one
+        shared grant round; the loser sees < majority and backs off, and
+        journal-epoch fencing protects the data path regardless."""
+        futs = [self._pool.submit(p.acquire_lease, self.name, self.holder,
+                                  self.ttl_s) for p in self._proxies]
+        granted = 0
+        for f in futs:
+            try:
+                if f.result(timeout=5.0).get("granted"):
+                    granted += 1
+            except Exception:  # noqa: BLE001 — unreachable JN = no grant
+                pass
+        return granted >= self.majority
+
+    def release(self) -> None:
+        futs = [self._pool.submit(p.release_lease, self.name, self.holder)
+                for p in self._proxies]
+        for f in futs:
+            try:
+                f.result(timeout=5.0)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+        self._client.stop()

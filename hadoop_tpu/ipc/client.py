@@ -22,7 +22,8 @@ from typing import Any, Dict, Optional, Tuple
 
 from hadoop_tpu.conf import Configuration
 from hadoop_tpu.io.wire import pack, unpack
-from hadoop_tpu.ipc.errors import (FatalRpcError, RpcError, RpcTimeoutError,
+from hadoop_tpu.ipc.errors import (ConnectFailedError, FatalRpcError,
+                                   RpcError, RpcTimeoutError,
                                    resolve_exception)
 from hadoop_tpu.ipc.server import MAGIC, PING_CALL_ID
 from hadoop_tpu.security.ugi import UserGroupInformation, current_user
@@ -85,7 +86,8 @@ class _Connection:
         try:
             self.sock = socket.create_connection(self.addr, timeout=timeout)
         except OSError as e:
-            raise RpcError(f"failed to connect to {self.addr}: {e}") from e
+            raise ConnectFailedError(
+                f"failed to connect to {self.addr}: {e}") from e
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.sock.settimeout(None)
         self.last_activity = time.monotonic()
@@ -180,6 +182,12 @@ class _Connection:
         sid = msg.get("sid", -1)
         if sid is not None and sid > self.last_state_id:
             self.last_state_id = sid
+            # Shared across connections: a read sent to an observer must
+            # carry the state id last seen from the ACTIVE (different
+            # connection). Ref: ClientGSIContext is per-client, not
+            # per-connection.
+            if sid > self.client.last_state_id:
+                self.client.last_state_id = sid
         if msg.get("fatal"):
             self._fail_all(FatalRpcError(msg.get("em", "fatal rpc error")))
             return False
@@ -245,6 +253,7 @@ class Client:
         self.conf = conf or Configuration(load_defaults=False)
         self.token_kind = token_kind
         self.client_id = os.urandom(16)  # ref: ipc/ClientId.java
+        self.last_state_id = -1          # ref: ClientGSIContext (msync)
         self._call_id = 0
         self._id_lock = threading.Lock()
         self._conns: Dict[Tuple[Address, str, str], _Connection] = {}
@@ -300,7 +309,7 @@ class Client:
             req: Dict[str, Any] = {
                 "id": call_id, "p": protocol, "m": method, "a": list(args),
                 "cid": self.client_id, "rc": retry_count,
-                "sid": conn.last_state_id,
+                "sid": max(conn.last_state_id, self.last_state_id),
             }
             if kwargs:
                 req["kw"] = kwargs

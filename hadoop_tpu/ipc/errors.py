@@ -19,6 +19,12 @@ class RpcTimeoutError(RpcError):
     pass
 
 
+class ConnectFailedError(RpcError):
+    """Connection setup failed — the request was NEVER sent, so retry or
+    failover is safe even for non-idempotent operations (ref: the
+    RetryInvocationHandler's isRequestNotSent/ConnectException cases)."""
+
+
 class ServerTooBusyError(RpcError):
     """Queue-full backoff signal (ref: ipc callqueue backoff /
     RetriableException). Retryable by policy."""

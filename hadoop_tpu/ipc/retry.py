@@ -14,7 +14,8 @@ import random
 import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
-from hadoop_tpu.ipc.errors import (RetriableError, RpcError, RpcTimeoutError,
+from hadoop_tpu.ipc.errors import (ConnectFailedError, RetriableError,
+                                   RpcError, RpcTimeoutError,
                                    ServerTooBusyError, StandbyError, is_remote)
 
 log = logging.getLogger(__name__)
@@ -107,6 +108,11 @@ class FailoverOnNetworkExceptionRetry(RetryPolicy):
             return RetryAction(RetryAction.FAIL,
                                reason=f"exceeded {self.max_retries} retries")
         if isinstance(e, StandbyError):
+            return RetryAction(RetryAction.FAILOVER_AND_RETRY,
+                               self._failover_delay(failovers))
+        if isinstance(e, ConnectFailedError):
+            # The request was never sent — failover is safe regardless of
+            # idempotency (ref: RetryInvocationHandler's requestNotSent).
             return RetryAction(RetryAction.FAILOVER_AND_RETRY,
                                self._failover_delay(failovers))
         if isinstance(e, (ServerTooBusyError, RetriableError)):

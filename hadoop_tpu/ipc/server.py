@@ -120,6 +120,7 @@ class Server:
         self.secret_manager = secret_manager
         self.state_provider = state_provider  # AlignmentContext analog
         self._protocols: Dict[str, Any] = {}
+        self._pre_calls: Dict[str, Callable] = {}
         self._callq = CallQueueManager(self.conf, queue_capacity, queue_prefix)
         self._lsock: Optional[socket.socket] = None
         self.port = 0
@@ -143,8 +144,14 @@ class Server:
 
     # ----------------------------------------------------------------- admin
 
-    def register_protocol(self, protocol_name: str, impl: Any) -> None:
+    def register_protocol(self, protocol_name: str, impl: Any,
+                          pre_call: Optional[Callable] = None) -> None:
+        """``pre_call(method, ctx)`` runs before dispatch — the seam HA
+        state checks and observer-read alignment hang off (ref: the
+        checkOperation + AlignmentContext hooks in NameNodeRpcServer)."""
         self._protocols[protocol_name] = impl
+        if pre_call is not None:
+            self._pre_calls[protocol_name] = pre_call
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -339,6 +346,9 @@ class Server:
                 fn = getattr(impl, method, None)
                 if fn is None or method.startswith("_") or not callable(fn):
                     raise AttributeError(f"no such RPC method {protocol}.{method}")
+                pre = self._pre_calls.get(protocol)
+                if pre is not None:
+                    pre(method, ctx)
                 value = conn.user.do_as(fn, *req.get("a", ()),
                                         **req.get("kw", {}))
             self._send_value(conn, call_id, value)

@@ -25,6 +25,148 @@ from hadoop_tpu.dfs.namenode import NameNode
 log = logging.getLogger(__name__)
 
 
+class MiniQJMHACluster:
+    """HA minicluster: N JournalNodes + M NameNodes (QJM shared edits,
+    automatic lease failover) + D DataNodes reporting to every NN.
+    Ref: hadoop-hdfs/src/test/.../qjournal/MiniQJMHACluster.java:47 +
+    MiniJournalCluster."""
+
+    def __init__(self, num_journalnodes: int = 3, num_namenodes: int = 2,
+                 num_datanodes: int = 3, num_observers: int = 0,
+                 conf: Optional[Configuration] = None,
+                 base_dir: Optional[str] = None):
+        self.conf = fast_conf(conf)
+        self.conf.set_if_unset("dfs.ha.tail-edits.period", "0.2s")
+        self.conf.set_if_unset("dfs.ha.lease-duration", "1.5s")
+        self.conf.set_if_unset("dfs.ha.health-check.interval", "0.3s")
+        self.base_dir = base_dir or tempfile.mkdtemp(prefix="htpu-qjmha-")
+        self._owns_dir = base_dir is None
+        self.num_journalnodes = num_journalnodes
+        self.num_namenodes = num_namenodes
+        self.num_observers = num_observers
+        self.num_datanodes = num_datanodes
+        self.journalnodes: List = []
+        self.namenodes: List[Optional[NameNode]] = []
+        self.datanodes: List[Optional[DataNode]] = []
+        self._fs_instances: List[DistributedFileSystem] = []
+        self._nn_ports: dict = {}  # index → last known port (for restarts)
+
+    def start(self) -> "MiniQJMHACluster":
+        from hadoop_tpu.dfs.qjournal import JournalNode
+        for i in range(self.num_journalnodes):
+            jn_conf = Configuration(other=self.conf)
+            jn = JournalNode(jn_conf, storage_dir=os.path.join(
+                self.base_dir, f"journal{i}"))
+            jn.init(jn_conf)
+            jn.start()
+            self.journalnodes.append(jn)
+        jn_spec = ",".join(f"127.0.0.1:{j.port}" for j in self.journalnodes)
+        self.conf.set("dfs.namenode.shared.edits.dir", jn_spec)
+        total_nn = self.num_namenodes + self.num_observers
+        for i in range(total_nn):
+            self._start_namenode(i, observer=i >= self.num_namenodes)
+        self.conf.set("dfs.namenode.rpc-address", ",".join(
+            f"127.0.0.1:{nn.port}" for nn in self.namenodes))
+        for i in range(self.num_datanodes):
+            dn_conf = Configuration(other=self.conf)
+            dn = DataNode(dn_conf,
+                          data_dir=os.path.join(self.base_dir, f"data{i}"),
+                          nn_addr=[("127.0.0.1", nn.port)
+                                   for nn in self.namenodes])
+            dn.init(dn_conf)
+            dn.start()
+            self.datanodes.append(dn)
+        return self
+
+    def _start_namenode(self, i: int, observer: bool = False) -> None:
+        nn_conf = Configuration(other=self.conf)
+        if observer:
+            nn_conf.set("dfs.ha.initial-state", "observer")
+        if i in self._nn_ports:
+            nn_conf.set("dfs.namenode.rpc-port", self._nn_ports[i])
+        nn = NameNode(nn_conf,
+                      name_dir=os.path.join(self.base_dir, f"name{i}"),
+                      nn_id=f"nn{i + 1}")
+        nn.init(nn_conf)
+        nn.start()
+        self._nn_ports[i] = nn.port
+        if i < len(self.namenodes):
+            self.namenodes[i] = nn
+        else:
+            self.namenodes.append(nn)
+
+    # --------------------------------------------------------------- access
+
+    def active_index(self) -> Optional[int]:
+        for i, nn in enumerate(self.namenodes):
+            if nn is not None and nn.ha_state == "active":
+                return i
+        return None
+
+    def wait_active(self, timeout: float = 30.0) -> int:
+        """Wait for an elected active NN with all DNs live + safemode off."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            idx = self.active_index()
+            if idx is not None:
+                fsn = self.namenodes[idx].fsn
+                live = len(fsn.bm.dn_manager.live_nodes())
+                want = sum(1 for d in self.datanodes if d is not None)
+                if not fsn.bm.safemode.is_on() and live >= want:
+                    return idx
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"no active NN (states: "
+            f"{[nn.ha_state if nn else None for nn in self.namenodes]})")
+
+    def kill_active(self) -> int:
+        """Stop the active NN (simulates a crash); returns its index."""
+        idx = self.active_index()
+        assert idx is not None, "no active to kill"
+        nn = self.namenodes[idx]
+        self.namenodes[idx] = None
+        nn.stop()
+        return idx
+
+    def restart_namenode(self, i: int) -> None:
+        self._start_namenode(i, observer=i >= self.num_namenodes)
+
+    def get_filesystem(self, observer_reads: bool = False
+                       ) -> DistributedFileSystem:
+        conf = Configuration(other=self.conf)
+        if observer_reads:
+            conf.set("dfs.client.observer.reads.enabled", "true")
+        fs = DistributedFileSystem(
+            [("127.0.0.1", nn.port) for nn in self.namenodes
+             if nn is not None], conf)
+        self._fs_instances.append(fs)
+        return fs
+
+    def shutdown(self) -> None:
+        for fs in self._fs_instances:
+            try:
+                fs.close()
+            except Exception:
+                pass
+        for dn in self.datanodes:
+            if dn is not None:
+                dn.stop()
+        for nn in self.namenodes:
+            if nn is not None:
+                nn.stop()
+        for jn in self.journalnodes:
+            jn.stop()
+        if self._owns_dir:
+            shutil.rmtree(self.base_dir, ignore_errors=True)
+
+    def __enter__(self) -> "MiniQJMHACluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.shutdown()
+        return False
+
+
 def fast_conf(base: Optional[Configuration] = None) -> Configuration:
     """Aggressive intervals so failure paths run in test time."""
     conf = Configuration(other=base) if base else Configuration(

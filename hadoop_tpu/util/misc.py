@@ -24,6 +24,22 @@ class Daemon(threading.Thread):
                          kwargs=kwargs or {}, daemon=True)
 
 
+def parse_addr_list(spec):
+    """Parse a comma-separated ``host:port`` list into [(host, port)].
+    Raises on a missing/non-numeric port instead of silently mis-splitting
+    (ref: NetUtils.createSocketAddr's strict parsing)."""
+    out = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, sep, port = part.rpartition(":")
+        if not sep or not port.isdigit():
+            raise ValueError(f"invalid host:port {part!r} in {spec!r}")
+        out.append((host or "127.0.0.1", int(port)))
+    return out
+
+
 def free_port(host: str = "127.0.0.1") -> int:
     """Ephemeral port for minicluster daemons (ref: MiniDFSCluster port=0 use)."""
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
