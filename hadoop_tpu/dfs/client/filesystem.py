@@ -61,6 +61,54 @@ class DistributedFileSystem(FileSystem):
     def set_replication(self, path: str, replication: int) -> bool:
         return self.client.nn.set_replication(path, replication)
 
+    # ------------------------------------------------- namespace features
+
+    def set_quota(self, path: str, ns_quota: int = -1,
+                  space_quota: int = -1) -> bool:
+        return self.client.nn.set_quota(path, ns_quota, space_quota)
+
+    def set_xattr(self, path: str, name: str, value: bytes) -> bool:
+        return self.client.nn.set_xattr(path, name, value)
+
+    def get_xattrs(self, path: str, names=None):
+        return self.client.nn.get_xattrs(path, names)
+
+    def remove_xattr(self, path: str, name: str) -> bool:
+        return self.client.nn.remove_xattr(path, name)
+
+    def set_acl(self, path: str, entries) -> bool:
+        return self.client.nn.set_acl(path, entries)
+
+    def get_acl(self, path: str):
+        return self.client.nn.get_acl(path)
+
+    def set_storage_policy(self, path: str, policy: str) -> bool:
+        return self.client.nn.set_storage_policy(path, policy)
+
+    def get_storage_policy(self, path: str) -> str:
+        return self.client.nn.get_storage_policy(path)
+
+    def allow_snapshot(self, path: str) -> bool:
+        return self.client.nn.allow_snapshot(path)
+
+    def create_snapshot(self, path: str, name: str) -> str:
+        return self.client.nn.create_snapshot(path, name)
+
+    def delete_snapshot(self, path: str, name: str) -> bool:
+        return self.client.nn.delete_snapshot(path, name)
+
+    def rename_snapshot(self, path: str, old: str, new: str) -> bool:
+        return self.client.nn.rename_snapshot(path, old, new)
+
+    def snapshot_diff(self, path: str, from_snap: str, to_snap: str):
+        return self.client.nn.snapshot_diff(path, from_snap, to_snap)
+
+    def concat(self, target: str, srcs) -> bool:
+        return self.client.nn.concat(target, srcs)
+
+    def truncate(self, path: str, new_length: int) -> bool:
+        return self.client.nn.truncate(path, new_length)
+
     def content_summary(self, path: str):
         return self.client.nn.content_summary(path)
 

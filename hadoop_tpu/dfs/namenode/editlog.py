@@ -50,8 +50,12 @@ OP_REASSIGN_LEASE = "reassign_lease"
 OP_SET_GENSTAMP = "set_genstamp"
 OP_SET_XATTR = "set_xattr"
 OP_REMOVE_XATTR = "remove_xattr"
+OP_SET_ACL = "set_acl"
 OP_CREATE_SNAPSHOT = "create_snapshot"
 OP_DELETE_SNAPSHOT = "delete_snapshot"
+OP_RENAME_SNAPSHOT = "rename_snapshot"
+OP_ALLOW_SNAPSHOT = "allow_snapshot"
+OP_DISALLOW_SNAPSHOT = "disallow_snapshot"
 OP_SET_STORAGE_POLICY = "set_storage_policy"
 OP_SET_EC_POLICY = "set_ec_policy"
 

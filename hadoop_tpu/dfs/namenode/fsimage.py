@@ -19,6 +19,24 @@ from hadoop_tpu.dfs.protocol.records import Block
 from hadoop_tpu.io.wire import pack, unpack
 
 
+def _common_attrs(node: INode, d: Dict) -> Dict:
+    if node.xattrs:
+        d["xa"] = dict(node.xattrs)
+    if node.acl:
+        d["acl"] = list(node.acl)
+    if node.storage_policy:
+        d["sp"] = node.storage_policy
+    return d
+
+
+def _restore_common(node: INode, d: Dict) -> None:
+    node.mtime = d.get("mt", 0.0)
+    node.group = d.get("g", "")
+    node.xattrs = dict(d["xa"]) if d.get("xa") else None
+    node.acl = list(d["acl"]) if d.get("acl") else None
+    node.storage_policy = d.get("sp")
+
+
 def _serialize_node(node: INode) -> Dict:
     if isinstance(node, INodeDirectory):
         d = {
@@ -28,7 +46,12 @@ def _serialize_node(node: INode) -> Dict:
         }
         if node.ec_policy:
             d["ec"] = node.ec_policy
-        return d
+        if node.ns_quota >= 0 or node.space_quota >= 0:
+            d["nq"], d["sq"] = node.ns_quota, node.space_quota
+        if node.snapshottable:
+            d["snap"] = {name: _serialize_node(root)
+                         for name, root in (node.snapshots or {}).items()}
+        return _common_attrs(node, d)
     f: INodeFile = node  # type: ignore[assignment]
     d = {
         "k": "f", "n": f.name, "mt": f.mtime, "o": f.owner, "g": f.group,
@@ -38,24 +61,28 @@ def _serialize_node(node: INode) -> Dict:
     }
     if f.ec_policy:
         d["ec"] = f.ec_policy
-    return d
+    return _common_attrs(f, d)
 
 
 def _deserialize_node(d: Dict) -> INode:
     if d["k"] == "d":
         node = INodeDirectory(d["n"], owner=d.get("o", ""),
                               permission=d.get("pm", 0o755))
-        node.mtime = d.get("mt", 0.0)
-        node.group = d.get("g", "")
+        _restore_common(node, d)
         node.ec_policy = d.get("ec")
+        node.ns_quota = d.get("nq", -1)
+        node.space_quota = d.get("sq", -1)
+        if "snap" in d:
+            node.snapshottable = True
+            node.snapshots = {name: _deserialize_node(sd)
+                              for name, sd in d["snap"].items()}
         for cd in d.get("c", []):
             node.add_child(_deserialize_node(cd))
         return node
     f = INodeFile(d["n"], d.get("rep", 3), d.get("bs", 0),
                   owner=d.get("o", ""), permission=d.get("pm", 0o644),
                   ec_policy=d.get("ec"))
-    f.mtime = d.get("mt", 0.0)
-    f.group = d.get("g", "")
+    _restore_common(f, d)
     f.under_construction = d.get("uc", False)
     f.client_name = d.get("cl")
     f.blocks = [Block.from_wire(b) for b in d.get("b", [])]

@@ -26,7 +26,9 @@ from hadoop_tpu.dfs.namenode.blockmanager import BlockManager
 from hadoop_tpu.dfs.namenode.editlog import FSEditLog, FileJournalManager
 from hadoop_tpu.dfs.namenode.fsimage import FSImage
 from hadoop_tpu.dfs.namenode.inodes import (FSDirectory, INodeDirectory,
-                                            INodeFile, collect_blocks)
+                                            INodeFile, collect_blocks,
+                                            iter_tree, snapshot_copy,
+                                            subtree_counts)
 from hadoop_tpu.dfs.namenode.lease import LeaseManager
 from hadoop_tpu.dfs.namenode.namesystem_lock import NamesystemLock
 from hadoop_tpu.dfs.protocol.records import (AlreadyBeingCreatedError, Block,
@@ -35,10 +37,16 @@ from hadoop_tpu.dfs.protocol.records import (AlreadyBeingCreatedError, Block,
                                              NotReplicatedYetError,
                                              SafeModeError)
 from hadoop_tpu.io import erasurecode as ec
+from hadoop_tpu.dfs.protocol.records import QuotaExceededError
 from hadoop_tpu.metrics import metrics_system
 from hadoop_tpu.security.ugi import current_user
 
 log = logging.getLogger(__name__)
+
+# Ref: BlockStoragePolicySuite — policy ids the mover acts on. On a
+# homogeneous TPU-host fleet these are placement intents, not media types.
+STORAGE_POLICIES = ("HOT", "WARM", "COLD", "ALL_SSD", "ONE_SSD",
+                    "LAZY_PERSIST", "PROVIDED")
 
 
 class FSNamesystem:
@@ -67,6 +75,7 @@ class FSNamesystem:
         self._gen_stamp = 1000          # ref: GenerationStamp
         self._id_lock = threading.Lock()
         self._pending_recovery: set = set()  # paths mid block-recovery
+        self._snapshot_count = 0             # namespace-wide, for fast paths
         reg = metrics_system().source("namenode.ops")
         self._m = {name: reg.rate(name) for name in
                    ("create", "add_block", "complete", "get_block_locations",
@@ -89,6 +98,11 @@ class FSNamesystem:
             self._next_group_id = extra.get("next_group_id", self._next_group_id)
             self._gen_stamp = extra.get("gen_stamp", self._gen_stamp)
             self.leases.restore_from_image(extra.get("leases", {}))
+        # Count image-loaded snapshots BEFORE replay: replayed
+        # delete-snapshot ops consult the counter for pin checks.
+        self._snapshot_count = sum(
+            len(n.snapshots or {}) for n in iter_tree(self.fsdir.root)
+            if isinstance(n, INodeDirectory))
         replayed = 0
         for rec in self.editlog.journal.read_edits(last_txid + 1):
             self._apply_edit(rec)
@@ -108,7 +122,6 @@ class FSNamesystem:
         recover the id/stamp generators past everything ever allocated —
         reusing a block id after restart would collide with live replicas
         (ref: SequentialBlockIdGenerator skipTo on image load)."""
-        from hadoop_tpu.dfs.namenode.inodes import iter_tree
         for node in iter_tree(self.fsdir.root):
             if isinstance(node, INodeFile):
                 for b in node.blocks:
@@ -116,6 +129,16 @@ class FSNamesystem:
                     info.under_construction = node.under_construction and \
                         b is node.blocks[-1]
                     self._track_block_id(b.to_wire())
+            elif isinstance(node, INodeDirectory) and node.snapshots:
+                # Snapshot-pinned blocks whose live file is gone must stay
+                # known, or block reports would invalidate their replicas.
+                for snap in node.snapshots.values():
+                    for f in iter_tree(snap):
+                        if isinstance(f, INodeFile):
+                            for b in f.blocks:
+                                info = self._register_block_locked(f, b)
+                                info.under_construction = False
+                                self._track_block_id(b.to_wire())
 
     def _register_block_locked(self, inode: INodeFile, b: Block):
         """Idempotently register an inode's block with the block manager
@@ -222,6 +245,7 @@ class FSNamesystem:
                     if not overwrite:
                         raise FileExistsError(path)
                     self._delete_locked(path, recursive=False)
+                self._check_quota_locked(path, d_inodes=1, d_space=0)
                 ec_policy = self._effective_ec_policy_locked(path)
                 inode = self.fsdir.add_file(path, replication, block_size,
                                             owner=owner)
@@ -259,6 +283,10 @@ class FSNamesystem:
                         raise NotReplicatedYetError(
                             f"last block of {path} not yet minimally "
                             f"replicated ({info.live_replicas()})")
+                self._check_quota_locked(
+                    path, d_inodes=0,
+                    d_space=inode.block_size * (
+                        1 if inode.ec_policy else max(1, inode.replication)))
                 offset = sum(b.num_bytes for b in inode.blocks)
                 if inode.ec_policy:
                     policy = ec.get_policy(inode.ec_policy)
@@ -557,6 +585,8 @@ class FSNamesystem:
             owner = current_user().user_name
             with self.lock.write():
                 self._check_not_safemode("mkdirs")
+                if not self.fsdir.exists(path):
+                    self._check_quota_locked(path, d_inodes=1, d_space=0)
                 self.fsdir.mkdirs(path, owner=owner)
                 txid = self.editlog.log_edit(el.OP_MKDIR,
                                              {"p": path, "o": owner})
@@ -576,13 +606,27 @@ class FSNamesystem:
             return True
 
     def _delete_locked(self, path: str, recursive: bool) -> bool:
+        target = self.fsdir.get_inode(path)
+        if target is not None:
+            for n in iter_tree(target):
+                if isinstance(n, INodeDirectory) and n.snapshots:
+                    raise OSError(
+                        f"cannot delete {path}: {n.full_path() or '/'} has "
+                        f"{len(n.snapshots)} snapshot(s) — delete them "
+                        "first (ref: the snapshottable-dir delete guard)")
         node = self.fsdir.delete(path, recursive)
         if node is None:
             return False
         # Open files anywhere under the deleted subtree lose their leases.
         self.leases.remove_under(path)
-        for b in collect_blocks(node):
-            self.bm.remove_block(b)
+        blocks = collect_blocks(node)
+        # Blocks captured by a snapshot stay alive until the last snapshot
+        # referencing them is deleted (ref: snapshot block collection in
+        # INodeFile.destroyAndCollectBlocks).
+        pinned = self._pinned_block_ids_locked() if blocks else set()
+        for b in blocks:
+            if b.block_id not in pinned:
+                self.bm.remove_block(b)
         return True
 
     def rename(self, src: str, dst: str) -> bool:
@@ -611,6 +655,368 @@ class FSNamesystem:
                         self.bm._update_needed_locked(info)
             txid = self.editlog.log_edit(el.OP_SET_REPLICATION,
                                          {"p": path, "rep": replication})
+        self.editlog.log_sync(txid)
+        return True
+
+    # --------------------------------------------------------------- quotas
+
+    def _check_quota_locked(self, path: str, d_inodes: int,
+                            d_space: int) -> None:
+        """Verify every quota-bearing ancestor of ``path`` can absorb the
+        delta (ref: FSDirectory.verifyQuota). Quotas are rare, so usage is
+        computed on demand rather than cached. Missing intermediate
+        directories count toward the inode delta — they are about to be
+        created too."""
+        comps = [c for c in path.split("/") if c]
+        node = self.fsdir.root
+        chain = [node]
+        for i, comp in enumerate(comps[:-1]):
+            if not isinstance(node, INodeDirectory):
+                break
+            node = node.get_child(comp)
+            if node is None:
+                d_inodes += len(comps) - 1 - i  # dirs mkdirs will create
+                break
+            chain.append(node)
+        for d in chain:
+            if not isinstance(d, INodeDirectory):
+                continue
+            if d.ns_quota < 0 and d.space_quota < 0:
+                continue
+            inodes, space = subtree_counts(d)
+            if 0 <= d.ns_quota < inodes + d_inodes:
+                raise QuotaExceededError(
+                    f"namespace quota of {d.full_path() or '/'} exceeded: "
+                    f"quota={d.ns_quota} would-be={inodes + d_inodes}")
+            if 0 <= d.space_quota < space + d_space:
+                raise QuotaExceededError(
+                    f"space quota of {d.full_path() or '/'} exceeded: "
+                    f"quota={d.space_quota} would-be={space + d_space}")
+
+    def set_quota(self, path: str, ns_quota: int, space_quota: int) -> None:
+        """Ref: FSDirAttrOp.setQuota; -1 clears a dimension."""
+        with self.lock.write():
+            self._check_not_safemode("set quota")
+            node = self.fsdir.get_inode(path)
+            if not isinstance(node, INodeDirectory):
+                raise NotADirectoryError(f"quota target {path}")
+            node.ns_quota = ns_quota
+            node.space_quota = space_quota
+            txid = self.editlog.log_edit(el.OP_SET_QUOTA, {
+                "p": path, "nq": ns_quota, "sq": space_quota})
+        self.editlog.log_sync(txid)
+
+    # --------------------------------------------------------------- xattrs
+
+    def set_xattr(self, path: str, name: str, value: bytes) -> None:
+        """Ref: FSDirXAttrOp.setXAttr — names are namespaced."""
+        ns = name.split(".", 1)[0]
+        if ns not in ("user", "trusted", "system", "security", "raw"):
+            raise ValueError(f"xattr name must be namespaced: {name!r}")
+        with self.lock.write():
+            node = self._inode_or_raise(path)
+            if node.xattrs is None:
+                node.xattrs = {}
+            node.xattrs[name] = value
+            txid = self.editlog.log_edit(el.OP_SET_XATTR, {
+                "p": path, "n": name, "v": value})
+        self.editlog.log_sync(txid)
+
+    def get_xattrs(self, path: str,
+                   names: Optional[List[str]] = None) -> Dict[str, bytes]:
+        with self.lock.read():
+            node = self._inode_or_raise(path)
+            attrs = node.xattrs or {}
+            if names:
+                missing = [n for n in names if n not in attrs]
+                if missing:
+                    raise ValueError(f"no such xattr(s) {missing} on {path}")
+                return {n: attrs[n] for n in names}
+            return dict(attrs)
+
+    def remove_xattr(self, path: str, name: str) -> None:
+        with self.lock.write():
+            node = self._inode_or_raise(path)
+            if not node.xattrs or name not in node.xattrs:
+                raise ValueError(f"no xattr {name!r} on {path}")
+            del node.xattrs[name]
+            txid = self.editlog.log_edit(el.OP_REMOVE_XATTR, {
+                "p": path, "n": name})
+        self.editlog.log_sync(txid)
+
+    # ----------------------------------------------------------------- acls
+
+    def set_acl(self, path: str, entries: List[str]) -> None:
+        """Replace the full ACL (ref: FSDirAclOp.setAcl). Entries are
+        "type:name:perms" strings ("user:alice:rw-", "group::r--")."""
+        for e in entries:
+            if len(e.split(":")) != 3:
+                raise ValueError(f"malformed ACL entry {e!r}")
+        with self.lock.write():
+            node = self._inode_or_raise(path)
+            node.acl = list(entries) or None
+            txid = self.editlog.log_edit(el.OP_SET_ACL, {
+                "p": path, "e": list(entries)})
+        self.editlog.log_sync(txid)
+
+    def get_acl(self, path: str) -> List[str]:
+        with self.lock.read():
+            return list(self._inode_or_raise(path).acl or [])
+
+    def remove_acl(self, path: str) -> None:
+        self.set_acl(path, [])
+
+    # ------------------------------------------------------- storage policy
+
+    def set_storage_policy(self, path: str, policy: str) -> None:
+        if policy not in STORAGE_POLICIES:
+            raise ValueError(
+                f"unknown storage policy {policy!r}; known: "
+                f"{STORAGE_POLICIES}")
+        with self.lock.write():
+            node = self._inode_or_raise(path)
+            node.storage_policy = policy
+            txid = self.editlog.log_edit(el.OP_SET_STORAGE_POLICY, {
+                "p": path, "sp": policy})
+        self.editlog.log_sync(txid)
+
+    def get_storage_policy(self, path: str) -> str:
+        """Effective (inherited) policy; HOT when unset."""
+        with self.lock.read():
+            node = self._inode_or_raise(path)
+            while node is not None:
+                if node.storage_policy:
+                    return node.storage_policy
+                node = node.parent
+            return "HOT"
+
+    def _inode_or_raise(self, path: str):
+        node = self.fsdir.get_inode(path)
+        if node is None:
+            raise FileNotFoundError(path)
+        return node
+
+    # ------------------------------------------------------------ snapshots
+
+    def allow_snapshot(self, path: str) -> None:
+        """Ref: FSDirSnapshotOp.allowSnapshot."""
+        with self.lock.write():
+            node = self._inode_or_raise(path)
+            if not isinstance(node, INodeDirectory):
+                raise NotADirectoryError(path)
+            node.snapshottable = True
+            if node.snapshots is None:
+                node.snapshots = {}
+            txid = self.editlog.log_edit(el.OP_ALLOW_SNAPSHOT, {"p": path})
+        self.editlog.log_sync(txid)
+
+    def disallow_snapshot(self, path: str) -> None:
+        with self.lock.write():
+            node = self._inode_or_raise(path)
+            if not isinstance(node, INodeDirectory):
+                raise NotADirectoryError(path)
+            if node.snapshots:
+                raise OSError(
+                    f"{path} has {len(node.snapshots)} snapshot(s); delete "
+                    "them first")
+            node.snapshottable = False
+            txid = self.editlog.log_edit(el.OP_DISALLOW_SNAPSHOT,
+                                         {"p": path})
+        self.editlog.log_sync(txid)
+
+    def create_snapshot(self, path: str, name: str) -> str:
+        """Ref: FSDirSnapshotOp.createSnapshot — captures the subtree's
+        metadata; shared Block objects pin the data against deletion."""
+        with self.lock.write():
+            self._check_not_safemode("create snapshot")
+            node = self._inode_or_raise(path)
+            if not isinstance(node, INodeDirectory) or not node.snapshottable:
+                raise OSError(f"{path} is not snapshottable")
+            if name in (node.snapshots or {}):
+                raise FileExistsError(f"snapshot {name} exists on {path}")
+            node.snapshots[name] = snapshot_copy(node)
+            self._snapshot_count += 1
+            txid = self.editlog.log_edit(el.OP_CREATE_SNAPSHOT, {
+                "p": path, "n": name})
+        self.editlog.log_sync(txid)
+        return f"{path.rstrip('/')}/.snapshot/{name}"
+
+    def delete_snapshot(self, path: str, name: str) -> None:
+        with self.lock.write():
+            node = self._inode_or_raise(path)
+            self._delete_snapshot_locked(node, path, name)
+            txid = self.editlog.log_edit(el.OP_DELETE_SNAPSHOT, {
+                "p": path, "n": name})
+        self.editlog.log_sync(txid)
+
+    def _delete_snapshot_locked(self, node, path: str, name: str) -> None:
+        """Drop the snapshot and garbage-collect blocks referenced by
+        nothing else — shared by the live path and edit replay so a
+        standby's block map tracks the active's."""
+        if not isinstance(node, INodeDirectory) or \
+                name not in (node.snapshots or {}):
+            raise FileNotFoundError(f"snapshot {name} on {path}")
+        dropped = node.snapshots.pop(name)
+        self._snapshot_count -= 1
+        still = self._pinned_block_ids_locked()
+        for n in iter_tree(self.fsdir.root):
+            if isinstance(n, INodeFile):
+                still.update(b.block_id for b in n.blocks)
+        for b in collect_blocks(dropped):
+            if b.block_id not in still:
+                self.bm.remove_block(b)
+
+    def rename_snapshot(self, path: str, old: str, new: str) -> None:
+        with self.lock.write():
+            node = self._inode_or_raise(path)
+            if not isinstance(node, INodeDirectory) or \
+                    old not in (node.snapshots or {}):
+                raise FileNotFoundError(f"snapshot {old} on {path}")
+            if new in node.snapshots:
+                raise FileExistsError(f"snapshot {new} on {path}")
+            node.snapshots[new] = node.snapshots.pop(old)
+            txid = self.editlog.log_edit(el.OP_RENAME_SNAPSHOT, {
+                "p": path, "o": old, "n": new})
+        self.editlog.log_sync(txid)
+
+    def snapshot_diff(self, path: str, from_snap: str,
+                      to_snap: str) -> Dict:
+        """Paths created/deleted/modified between two snapshots ('' = the
+        live tree). Ref: SnapshotDiffReport."""
+        def index(root, prefix: str, out: Dict) -> Dict:
+            # Keys are paths RELATIVE to the compared root — a snapshot
+            # copy and the live dir share no parent chain, so absolute
+            # paths would never align.
+            out[prefix or "/"] = root
+            if isinstance(root, INodeDirectory):
+                for name, child in root.children.items():
+                    index(child, f"{prefix}/{name}", out)
+            return out
+
+        with self.lock.read():
+            node = self._inode_or_raise(path)
+            if not isinstance(node, INodeDirectory):
+                raise NotADirectoryError(path)
+
+            def pick(name):
+                if not name:
+                    return node
+                snap = (node.snapshots or {}).get(name)
+                if snap is None:
+                    raise FileNotFoundError(f"snapshot {name} on {path}")
+                return snap
+
+            a = index(pick(from_snap), "", {})
+            b = index(pick(to_snap), "", {})
+            base = path.rstrip("/")
+            created = sorted(base + p for p in set(b) - set(a))
+            deleted = sorted(base + p for p in set(a) - set(b))
+            modified = sorted(
+                base + p for p in set(a) & set(b)
+                if isinstance(a[p], INodeFile) and isinstance(b[p], INodeFile)
+                and ([blk.block_id for blk in a[p].blocks],
+                     a[p].length()) != ([blk.block_id for blk in b[p].blocks],
+                                        b[p].length()))
+            return {"created": created, "deleted": deleted,
+                    "modified": modified}
+
+    def _pinned_block_ids_locked(self) -> set:
+        """Block ids held by ANY snapshot anywhere in the namespace. The
+        snapshot counter makes the no-snapshots case O(1) — deletes on a
+        snapshot-free namespace must not pay a full tree walk."""
+        if self._snapshot_count <= 0:
+            return set()
+        pinned = set()
+        for n in iter_tree(self.fsdir.root):
+            if isinstance(n, INodeDirectory) and n.snapshots:
+                for snap in n.snapshots.values():
+                    pinned.update(b.block_id for b in collect_blocks(snap))
+        return pinned
+
+    # ------------------------------------------------------ concat/truncate
+
+    def concat(self, target: str, srcs: List[str]) -> None:
+        """Move the blocks of ``srcs`` onto the end of ``target`` and
+        delete the sources (ref: FSDirConcatOp — metadata-only append)."""
+        with self.lock.write():
+            self._check_not_safemode("concat")
+            if len(set(srcs)) != len(srcs) or target in srcs:
+                raise ValueError(
+                    f"concat sources must be distinct and exclude the "
+                    f"target: {target} ← {srcs}")
+            tgt = self._inode_or_raise(target)
+            if not isinstance(tgt, INodeFile) or tgt.under_construction:
+                raise OSError(f"concat target {target} not a closed file")
+            if tgt.ec_policy:
+                raise OSError("concat of striped files is unsupported")
+            for s in srcs:
+                src = self._inode_or_raise(s)
+                if not isinstance(src, INodeFile) or src.under_construction:
+                    raise OSError(f"concat source {s} not a closed file")
+                if src.ec_policy:
+                    raise OSError("concat of striped files is unsupported")
+                if src.block_size != tgt.block_size:
+                    raise OSError(f"block size mismatch: {s}")
+            for s in srcs:
+                src = self.fsdir.get_inode(s)
+                for b in src.blocks:
+                    info = self.bm.get(b.block_id)
+                    if info is not None:
+                        info.inode = tgt
+                        info.expected_replication = tgt.replication
+                tgt.blocks.extend(src.blocks)
+                src.blocks = []
+                self.fsdir.delete(s, recursive=False)
+            tgt.mtime = time.time()
+            txid = self.editlog.log_edit(el.OP_CONCAT, {
+                "p": target, "s": list(srcs)})
+        self.editlog.log_sync(txid)
+
+    def truncate(self, path: str, new_length: int) -> bool:
+        """Shrink a file (ref: FSDirTruncateOp). Whole blocks past the cut
+        are dropped; the boundary block's length is trimmed in metadata —
+        reads clamp to it, so no DN round trip is needed. Returns True
+        (immediate completion; the reference's in-progress recovery case
+        does not arise)."""
+        with self.lock.write():
+            self._check_not_safemode("truncate")
+            inode = self._inode_or_raise(path)
+            if not isinstance(inode, INodeFile):
+                raise IsADirectoryError(path)
+            if inode.under_construction:
+                raise OSError(f"{path} is being written")
+            if inode.ec_policy:
+                raise OSError("truncate of striped files is unsupported")
+            if new_length > inode.length():
+                raise ValueError(
+                    f"truncate length {new_length} > file length "
+                    f"{inode.length()}")
+            pinned = self._pinned_block_ids_locked()
+            if any(b.block_id in pinned for b in inode.blocks):
+                # Block objects are shared with snapshot copies; trimming
+                # or dropping them would corrupt the captured version (the
+                # reference versions the boundary block instead — here the
+                # operation is refused, not silently wrong).
+                raise OSError(
+                    f"cannot truncate {path}: captured in a snapshot")
+            pos = 0
+            kept: List[Block] = []
+            for b in inode.blocks:
+                if pos >= new_length:
+                    self.bm.remove_block(b)
+                    continue
+                if pos + b.num_bytes > new_length:
+                    b.num_bytes = new_length - pos
+                    info = self.bm.get(b.block_id)
+                    if info is not None:
+                        info.block.num_bytes = b.num_bytes
+                kept.append(b)
+                pos += b.num_bytes
+            inode.blocks = kept
+            inode.mtime = time.time()
+            txid = self.editlog.log_edit(el.OP_TRUNCATE, {
+                "p": path, "l": new_length,
+                "b": [b.to_wire() for b in kept]})
         self.editlog.log_sync(txid)
         return True
 
@@ -798,6 +1204,82 @@ class FSNamesystem:
             node = self.fsdir.get_inode(rec["p"])
             if isinstance(node, INodeDirectory):
                 node.ec_policy = rec.get("ec")
+        elif op == el.OP_SET_QUOTA:
+            node = self.fsdir.get_inode(rec["p"])
+            if isinstance(node, INodeDirectory):
+                node.ns_quota = rec.get("nq", -1)
+                node.space_quota = rec.get("sq", -1)
+        elif op == el.OP_SET_XATTR:
+            node = self.fsdir.get_inode(rec["p"])
+            if node is not None:
+                if node.xattrs is None:
+                    node.xattrs = {}
+                node.xattrs[rec["n"]] = rec["v"]
+        elif op == el.OP_REMOVE_XATTR:
+            node = self.fsdir.get_inode(rec["p"])
+            if node is not None and node.xattrs:
+                node.xattrs.pop(rec["n"], None)
+        elif op == el.OP_SET_ACL:
+            node = self.fsdir.get_inode(rec["p"])
+            if node is not None:
+                node.acl = list(rec.get("e") or []) or None
+        elif op == el.OP_SET_STORAGE_POLICY:
+            node = self.fsdir.get_inode(rec["p"])
+            if node is not None:
+                node.storage_policy = rec.get("sp")
+        elif op == el.OP_ALLOW_SNAPSHOT:
+            node = self.fsdir.get_inode(rec["p"])
+            if isinstance(node, INodeDirectory):
+                node.snapshottable = True
+                if node.snapshots is None:
+                    node.snapshots = {}
+        elif op == el.OP_DISALLOW_SNAPSHOT:
+            node = self.fsdir.get_inode(rec["p"])
+            if isinstance(node, INodeDirectory):
+                node.snapshottable = False
+        elif op == el.OP_CREATE_SNAPSHOT:
+            node = self.fsdir.get_inode(rec["p"])
+            if isinstance(node, INodeDirectory) and node.snapshottable:
+                node.snapshots[rec["n"]] = snapshot_copy(node)
+                self._snapshot_count += 1
+        elif op == el.OP_DELETE_SNAPSHOT:
+            node = self.fsdir.get_inode(rec["p"])
+            try:
+                self._delete_snapshot_locked(node, rec["p"], rec["n"])
+            except FileNotFoundError:
+                pass
+        elif op == el.OP_RENAME_SNAPSHOT:
+            node = self.fsdir.get_inode(rec["p"])
+            if isinstance(node, INodeDirectory) and node.snapshots and \
+                    rec["o"] in node.snapshots:
+                node.snapshots[rec["n"]] = node.snapshots.pop(rec["o"])
+        elif op == el.OP_CONCAT:
+            tgt = self.fsdir.get_inode(rec["p"])
+            if isinstance(tgt, INodeFile):
+                for s in rec.get("s", []):
+                    src = self.fsdir.get_inode(s)
+                    if isinstance(src, INodeFile):
+                        for b in src.blocks:
+                            info = self.bm.get(b.block_id)
+                            if info is not None:
+                                info.inode = tgt
+                                info.expected_replication = tgt.replication
+                        tgt.blocks.extend(src.blocks)
+                        src.blocks = []
+                        self.fsdir.delete(s, recursive=False)
+        elif op == el.OP_TRUNCATE:
+            inode = self.fsdir.get_inode(rec["p"])
+            if isinstance(inode, INodeFile):
+                new_blocks = [Block.from_wire(b) for b in rec.get("b", [])]
+                kept = {b.block_id for b in new_blocks}
+                for old in inode.blocks:
+                    if old.block_id not in kept:
+                        self.bm.remove_block(old)
+                inode.blocks = new_blocks
+                for b in inode.blocks:
+                    info = self.bm.get(b.block_id)
+                    if info is not None:
+                        info.block.num_bytes = b.num_bytes
         elif op == el.OP_SET_GENSTAMP:
             self._gen_stamp = max(self._gen_stamp, rec["gs"])
         else:

@@ -17,7 +17,7 @@ from hadoop_tpu.dfs.protocol.records import Block, FileStatus
 
 class INode:
     __slots__ = ("name", "parent", "mtime", "atime", "owner", "group",
-                 "permission")
+                 "permission", "xattrs", "acl", "storage_policy")
 
     def __init__(self, name: str, owner: str = "", group: str = "",
                  permission: int = 0o755):
@@ -28,6 +28,15 @@ class INode:
         self.owner = owner
         self.group = group
         self.permission = permission
+        # Extended attributes (ref: XAttrFeature; user./trusted./system.
+        # namespaces enforced at the RPC layer).
+        self.xattrs: Optional[Dict[str, bytes]] = None
+        # ACL entries beyond the permission bits (ref: AclFeature):
+        # list of "type:name:perms" strings, e.g. "user:alice:rw-".
+        self.acl: Optional[List[str]] = None
+        # Storage policy name (ref: BlockStoragePolicySuite; HOT default,
+        # inherited from the nearest ancestor that sets one).
+        self.storage_policy: Optional[str] = None
 
     @property
     def is_dir(self) -> bool:
@@ -74,7 +83,8 @@ class INodeFile(INode):
 
 
 class INodeDirectory(INode):
-    __slots__ = ("children", "ec_policy")
+    __slots__ = ("children", "ec_policy", "ns_quota", "space_quota",
+                 "snapshottable", "snapshots")
 
     def __init__(self, name: str, owner: str = "", permission: int = 0o755):
         super().__init__(name, owner=owner, permission=permission)
@@ -82,6 +92,14 @@ class INodeDirectory(INode):
         # EC policy set on this directory; inherited by files created under
         # it (ref: ErasureCodingPolicyManager + the EC xattr on dirs).
         self.ec_policy: Optional[str] = None
+        # Quotas (ref: DirectoryWithQuotaFeature): -1 = unset.
+        self.ns_quota: int = -1      # max inodes in subtree
+        self.space_quota: int = -1   # max bytes × replication in subtree
+        # Snapshots (ref: DirectorySnapshottableFeature): name → captured
+        # root (an immutable deep copy of this subtree's metadata; block
+        # objects are shared, the snapshot pins them against deletion).
+        self.snapshottable = False
+        self.snapshots: Optional[Dict[str, "INodeDirectory"]] = None
 
     def add_child(self, node: INode) -> None:
         node.parent = self
@@ -110,6 +128,50 @@ def _components(path: str) -> List[str]:
     return [c for c in path.split("/") if c]
 
 
+SNAPSHOT_DIR = ".snapshot"
+
+
+def snapshot_copy(node: INode) -> INode:
+    """Immutable metadata copy of a subtree for a snapshot (ref:
+    snapshot/Snapshot.java's root copy). Block objects are shared — the
+    snapshot pins them, it does not duplicate data."""
+    if isinstance(node, INodeDirectory):
+        cp = INodeDirectory(node.name, owner=node.owner,
+                            permission=node.permission)
+        cp.group = node.group
+        cp.mtime, cp.atime = node.mtime, node.atime
+        cp.ec_policy = node.ec_policy
+        cp.storage_policy = node.storage_policy
+        cp.xattrs = dict(node.xattrs) if node.xattrs else None
+        cp.acl = list(node.acl) if node.acl else None
+        for child in node.children.values():
+            cp.add_child(snapshot_copy(child))
+        return cp
+    f: INodeFile = node  # type: ignore[assignment]
+    cp = INodeFile(f.name, f.replication, f.block_size, owner=f.owner,
+                   permission=f.permission, ec_policy=f.ec_policy)
+    cp.group = f.group
+    cp.mtime, cp.atime = f.mtime, f.atime
+    cp.storage_policy = f.storage_policy
+    cp.xattrs = dict(f.xattrs) if f.xattrs else None
+    cp.acl = list(f.acl) if f.acl else None
+    cp.blocks = list(f.blocks)
+    return cp
+
+
+def subtree_counts(node: INode) -> tuple:
+    """(inodes, space) where space = Σ file length × replication — the
+    quota dimensions (ref: QuotaCounts)."""
+    inodes = 0
+    space = 0
+    for n in iter_tree(node):
+        inodes += 1
+        if isinstance(n, INodeFile):
+            rep = 1 if n.ec_policy else max(1, n.replication)
+            space += n.length() * rep
+    return inodes, space
+
+
 class FSDirectory:
     """Path-indexed view over the inode tree. Ref: FSDirectory.java."""
 
@@ -121,13 +183,28 @@ class FSDirectory:
 
     def get_inode(self, path: str) -> Optional[INode]:
         node: INode = self.root
-        for comp in _components(path):
+        comps = _components(path)
+        i = 0
+        while i < len(comps):
+            comp = comps[i]
             if not isinstance(node, INodeDirectory):
                 return None
+            if comp == SNAPSHOT_DIR and node.snapshottable:
+                # /dir/.snapshot/<name>/... resolves inside the captured
+                # subtree (ref: INodeDirectory.getChild's snapshot path).
+                if i + 1 >= len(comps):
+                    return node  # "/dir/.snapshot" itself → listed specially
+                snap = (node.snapshots or {}).get(comps[i + 1])
+                if snap is None:
+                    return None
+                node = snap
+                i += 2
+                continue
             nxt = node.get_child(comp)
             if nxt is None:
                 return None
             node = nxt
+            i += 1
         return node
 
     def get_parent(self, path: str) -> Optional[INodeDirectory]:
@@ -224,10 +301,17 @@ class FSDirectory:
     # ------------------------------------------------------------- queries
 
     def listing(self, path: str) -> List[FileStatus]:
+        base = path.rstrip("/")
+        if base.endswith("/" + SNAPSHOT_DIR):
+            parent = self.get_inode(base[:-len(SNAPSHOT_DIR) - 1] or "/")
+            if not isinstance(parent, INodeDirectory) or \
+                    not parent.snapshottable:
+                raise FileNotFoundError(path)
+            return [snap.status(f"{base}/{name}")
+                    for name, snap in sorted((parent.snapshots or {}).items())]
         node = self.get_inode(path)
         if node is None:
             raise FileNotFoundError(path)
-        base = path.rstrip("/")
         if isinstance(node, INodeDirectory):
             return [child.status(f"{base}/{name}" if base else f"/{name}")
                     for name, child in sorted(node.children.items())]

@@ -46,6 +46,11 @@ WRITE_METHODS = frozenset({
     # Lease renewal and corruption reports mutate active-side state; an
     # observer silently swallowing them would expire live writers.
     "renew_lease", "report_bad_blocks",
+    # Namespace-feature mutations.
+    "set_quota", "set_xattr", "remove_xattr", "set_acl", "remove_acl",
+    "set_storage_policy", "allow_snapshot", "disallow_snapshot",
+    "create_snapshot", "delete_snapshot", "rename_snapshot", "concat",
+    "truncate",
 })
 
 
@@ -143,6 +148,74 @@ class ClientProtocol:
     def set_owner(self, path: str, owner: str, group: str):
         self.fsn.set_owner(path, owner, group)
         return True
+
+    # namespace features --------------------------------------------------
+
+    def set_quota(self, path: str, ns_quota: int, space_quota: int) -> bool:
+        self.fsn.set_quota(path, ns_quota, space_quota)
+        return True
+
+    def set_xattr(self, path: str, name: str, value: bytes) -> bool:
+        self.fsn.set_xattr(path, name, value)
+        return True
+
+    @idempotent
+    def get_xattrs(self, path: str, names: Optional[List[str]] = None):
+        return self.fsn.get_xattrs(path, names)
+
+    def remove_xattr(self, path: str, name: str) -> bool:
+        self.fsn.remove_xattr(path, name)
+        return True
+
+    def set_acl(self, path: str, entries: List[str]) -> bool:
+        self.fsn.set_acl(path, entries)
+        return True
+
+    @idempotent
+    def get_acl(self, path: str):
+        return self.fsn.get_acl(path)
+
+    def remove_acl(self, path: str) -> bool:
+        self.fsn.remove_acl(path)
+        return True
+
+    def set_storage_policy(self, path: str, policy: str) -> bool:
+        self.fsn.set_storage_policy(path, policy)
+        return True
+
+    @idempotent
+    def get_storage_policy(self, path: str) -> str:
+        return self.fsn.get_storage_policy(path)
+
+    def allow_snapshot(self, path: str) -> bool:
+        self.fsn.allow_snapshot(path)
+        return True
+
+    def disallow_snapshot(self, path: str) -> bool:
+        self.fsn.disallow_snapshot(path)
+        return True
+
+    def create_snapshot(self, path: str, name: str) -> str:
+        return self._cached(lambda: self.fsn.create_snapshot(path, name))
+
+    def delete_snapshot(self, path: str, name: str) -> bool:
+        self.fsn.delete_snapshot(path, name)
+        return True
+
+    def rename_snapshot(self, path: str, old: str, new: str) -> bool:
+        self.fsn.rename_snapshot(path, old, new)
+        return True
+
+    @idempotent
+    def snapshot_diff(self, path: str, from_snap: str, to_snap: str):
+        return self.fsn.snapshot_diff(path, from_snap, to_snap)
+
+    def concat(self, target: str, srcs: List[str]) -> bool:
+        self._cached(lambda: self.fsn.concat(target, srcs))
+        return True
+
+    def truncate(self, path: str, new_length: int) -> bool:
+        return self._cached(lambda: self.fsn.truncate(path, new_length))
 
     def set_ec_policy(self, path: str, policy: Optional[str]) -> bool:
         """Ref: ClientProtocol.setErasureCodingPolicy."""

@@ -411,19 +411,28 @@ class QuorumJournalManager(JournalManager):
         self._quorum("discard_inprogress", self.jid, self.epoch, first_txid)
 
     def read_edits(self, from_txid: int) -> Iterator[Dict]:
-        """Read from whichever responder has the most data (tailing path:
-        ref EditLogTailer via getJournaledEdits)."""
+        """Serve only QUORUM-COMMITTED edits: a txid counts as committed
+        when a majority of JNs hold it (every acked batch landed on a
+        majority, so this is a sound commit witness). A txid present on a
+        lone JN may be an abandoned write from a dead deposed writer —
+        replaying it would diverge the tailer from what recovery keeps
+        (ref: the committed-txn filter in getJournaledEdits / the
+        maxSeenTxId vs committedTxnId distinction)."""
         results = self._call_all("get_edits", self.jid, from_txid)
-        best: List[Dict] = []
+        holders: Dict[int, int] = {}     # txid → #JNs holding it
+        records: Dict[int, Dict] = {}
         for _, r in results:
-            if isinstance(r, list) and len(r) > len(best):
-                best = r
-        # Dedup/order by txid; trust txid monotonicity.
-        seen = set()
-        for rec in sorted(best, key=lambda r: r["t"]):
-            if rec["t"] not in seen and rec["t"] >= from_txid:
-                seen.add(rec["t"])
-                yield rec
+            if not isinstance(r, list):
+                continue
+            for rec in r:
+                t = rec["t"]
+                holders[t] = holders.get(t, 0) + 1
+                records.setdefault(t, rec)
+        # Contiguous committed prefix from from_txid.
+        t = from_txid
+        while holders.get(t, 0) >= self.majority:
+            yield records[t]
+            t += 1
 
     # seen_txid: QJM tracks it in memory; the authoritative value for
     # startup comes from the image + JN replay, so a local file is not

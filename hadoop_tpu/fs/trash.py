@@ -1,0 +1,100 @@
+"""Trash: recoverable deletes with interval-based expiry.
+
+Parity with the reference (ref: hadoop-common fs/TrashPolicyDefault.java,
+Trash.java): ``move_to_trash`` renames into
+``/user/<user>/.Trash/Current/<original-path>`` instead of deleting;
+a checkpoint rolls ``Current`` to a timestamped directory; ``expunge``
+removes checkpoints older than the interval. The shell's ``rm`` routes
+through this unless ``-skipTrash`` is passed, exactly like the
+reference's FsShell.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from typing import List
+
+from hadoop_tpu.security.ugi import current_user
+
+CHECKPOINT_FMT = "%y%m%d%H%M%S"
+
+
+class Trash:
+    def __init__(self, fs, interval_s: float = 24 * 3600.0):
+        self.fs = fs
+        self.interval_s = interval_s
+
+    def _trash_root(self) -> str:
+        return f"/user/{current_user().user_name}/.Trash"
+
+    @property
+    def enabled(self) -> bool:
+        return self.interval_s > 0
+
+    def move_to_trash(self, path: str) -> str:
+        """Rename ``path`` under Current/; returns the trash location.
+        Ref: TrashPolicyDefault.moveToTrash."""
+        if not self.enabled:
+            raise ValueError("trash is disabled (interval 0)")
+        path = path.rstrip("/")
+        if not path:
+            raise ValueError("cannot trash /")
+        root = self._trash_root()
+        if path.startswith(root):
+            raise ValueError(f"{path} is already in the trash")
+        target = f"{root}/Current{path}"
+        parent = target.rsplit("/", 1)[0]
+        self.fs.mkdirs(parent)
+        # Name collision (same file deleted twice): timestamp-suffix it.
+        try:
+            if self.fs.get_file_status(target):
+                target = f"{target}.{int(time.time() * 1000)}"
+        except FileNotFoundError:
+            pass
+        if not self.fs.rename(path, target):
+            raise IOError(f"could not move {path} to trash")
+        return target
+
+    def checkpoint(self) -> str:
+        """Roll Current → a timestamped checkpoint.
+        Ref: TrashPolicyDefault.createCheckpoint."""
+        root = self._trash_root()
+        cur = f"{root}/Current"
+        try:
+            self.fs.get_file_status(cur)
+        except FileNotFoundError:
+            return ""
+        stamp = time.strftime(CHECKPOINT_FMT, time.localtime())
+        dst = f"{root}/{stamp}"
+        self.fs.rename(cur, dst)
+        return dst
+
+    def expunge(self, immediately: bool = False) -> List[str]:
+        """Delete checkpoints older than the interval (all of them when
+        ``immediately``). Ref: TrashPolicyDefault.deleteCheckpoint +
+        Emptier."""
+        root = self._trash_root()
+        removed = []
+        try:
+            entries = self.fs.list_status(root)
+        except FileNotFoundError:
+            return removed
+        now = time.time()
+        for st in entries:
+            name = st.path.rsplit("/", 1)[-1]
+            if name == "Current":
+                continue
+            if not re.fullmatch(r"\d{12}", name):
+                continue
+            age = now - time.mktime(time.strptime(name, CHECKPOINT_FMT))
+            if immediately or age > self.interval_s:
+                self.fs.delete(st.path, recursive=True)
+                removed.append(st.path)
+        if immediately:
+            try:
+                self.fs.delete(f"{root}/Current", recursive=True)
+                removed.append(f"{root}/Current")
+            except FileNotFoundError:
+                pass
+        return removed
