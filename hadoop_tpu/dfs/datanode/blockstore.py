@@ -124,9 +124,13 @@ class _OpenReplica:
 
 
 class BlockStore:
-    def __init__(self, directory: str, chunk_size: int = 512):
+    def __init__(self, directory: str, chunk_size: int = 512,
+                 capacity_override: int = 0):
         self.dir = directory
         self.chunk_size = chunk_size
+        # Advertised capacity for shared volumes / simulated heterogeneity
+        # (ref: dfs.datanode.du.reserved + SimulatedFSDataset's capacity).
+        self.capacity_override = capacity_override
         for sub in (Replica.RBW, Replica.FINALIZED):
             os.makedirs(os.path.join(directory, sub), exist_ok=True)
         self._replicas: Dict[int, Replica] = {}
@@ -320,6 +324,13 @@ class BlockStore:
             n = len(self._replicas)
             for rep in self._replicas.values():
                 used += rep.num_bytes
+        if self.capacity_override:
+            return {
+                "capacity": self.capacity_override,
+                "dfs_used": used,
+                "remaining": max(0, self.capacity_override - used),
+                "num_replicas": n,
+            }
         st = os.statvfs(self.dir)
         return {
             "capacity": st.f_blocks * st.f_frsize,

@@ -103,12 +103,17 @@ class DataNode(AbstractService):
         return DatanodeInfo(self.uuid, self.host, self.xceiver.port,
                             capacity=stats["capacity"],
                             dfs_used=stats["dfs_used"],
-                            remaining=stats["remaining"])
+                            remaining=stats["remaining"],
+                            storage_type=self.config.get(
+                                "dfs.datanode.storage.type", "DISK"))
 
     # ------------------------------------------------------------- lifecycle
 
     def service_init(self, conf: Configuration) -> None:
-        self.store = BlockStore(os.path.join(self.data_dir, "current"))
+        self.store = BlockStore(
+            os.path.join(self.data_dir, "current"),
+            capacity_override=conf.get_size_bytes(
+                "dfs.datanode.capacity", 0))
         self.xceiver = DataXceiverServer(
             self.store, self._on_block_received, bind_host=self.host,
             port=conf.get_int("dfs.datanode.port", 0),

@@ -82,6 +82,8 @@ class DataXceiverServer:
                 self._write_block(sock, req)
             elif op == dt.OP_READ_BLOCK:
                 self._read_block(sock, req)
+            elif op == dt.OP_TRANSFER_BLOCK:
+                self._transfer_block(sock, req)
             else:
                 dt.send_frame(sock, {"ok": False, "em": f"bad op {op!r}"})
         except (OSError, EOFError) as e:
@@ -208,6 +210,20 @@ class DataXceiverServer:
             if down is not None:
                 responder_done.wait(timeout=5.0)
                 down.close()
+
+    def _transfer_block(self, sock: socket.socket, req: dict) -> None:
+        """Balancer/mover-commanded copy: push a local finalized replica
+        to the given targets (ref: DataXceiver.replaceBlock's role — the
+        receiving side of Dispatcher.PendingMove, driven here from the
+        source)."""
+        block = Block.from_wire(req["b"])
+        targets = [DatanodeInfo.from_wire(t) for t in req.get("targets", [])]
+        try:
+            push_block(self.store, block, targets)
+        except (OSError, IOError) as e:
+            dt.send_frame(sock, {"ok": False, "em": str(e)})
+            return
+        dt.send_frame(sock, {"ok": True})
 
     # -------------------------------------------------------------- reading
 

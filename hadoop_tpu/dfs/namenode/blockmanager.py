@@ -56,7 +56,7 @@ class DatanodeDescriptor(DatanodeInfo):
     def public_info(self) -> DatanodeInfo:
         info = DatanodeInfo(self.uuid, self.host, self.xfer_port,
                             self.ipc_port, self.capacity, self.dfs_used,
-                            self.remaining)
+                            self.remaining, self.storage_type)
         info.state = self.state
         info.num_blocks = len(self.blocks)
         return info
@@ -146,6 +146,7 @@ class DatanodeManager:
             node.host = info.host
             node.xfer_port = info.xfer_port
             node.ipc_port = info.ipc_port
+            node.storage_type = info.storage_type
             node.state = DatanodeInfo.STATE_LIVE
             node.last_heartbeat = time.monotonic()
             return node
@@ -235,17 +236,68 @@ class DatanodeManager:
             log.info("Starting decommission of %s", node)
             self.bm.schedule_drain(node)
 
+    def start_maintenance(self, uuid: str) -> None:
+        """Ref: DatanodeAdminManager.startMaintenance — like decommission
+        but the node is expected back; replicas are topped up elsewhere
+        without invalidating its copies."""
+        with self._lock:
+            node = self._nodes.get(uuid)
+        if node is not None and node.state == DatanodeInfo.STATE_LIVE:
+            node.state = DatanodeInfo.STATE_ENTERING_MAINTENANCE
+            log.info("Starting maintenance of %s", node)
+            self.bm.schedule_drain(node)
+
+    def stop_maintenance(self, uuid: str) -> None:
+        with self._lock:
+            node = self._nodes.get(uuid)
+            if node is not None and node.state in (
+                    DatanodeInfo.STATE_ENTERING_MAINTENANCE,
+                    DatanodeInfo.STATE_IN_MAINTENANCE):
+                node.state = DatanodeInfo.STATE_LIVE
+
+    def check_admin_progress(self) -> None:
+        """Promote DECOMMISSIONING → DECOMMISSIONED (and entering →
+        in-maintenance) once every block on the node is adequately
+        redundant elsewhere. Ref: DatanodeAdminManager.Monitor.check."""
+        with self._lock:
+            draining = [n for n in self._nodes.values() if n.state in
+                        (DatanodeInfo.STATE_DECOMMISSIONING,
+                         DatanodeInfo.STATE_ENTERING_MAINTENANCE)]
+        for node in draining:
+            drained = self.bm.is_node_drained(node)  # slow — outside lock
+            with self._lock:
+                # Re-check: an operator may have flipped the state while
+                # the drain scan ran (stop_maintenance races this monitor).
+                if not drained:
+                    continue
+                if node.state == DatanodeInfo.STATE_DECOMMISSIONING:
+                    node.state = DatanodeInfo.STATE_DECOMMISSIONED
+                elif node.state == DatanodeInfo.STATE_ENTERING_MAINTENANCE:
+                    node.state = DatanodeInfo.STATE_IN_MAINTENANCE
+                else:
+                    continue
+            log.info("Node %s is now %s", node, node.state)
+
     # ------------------------------------------------------------ placement
 
     def choose_targets(self, n: int, exclude: Set[str],
-                       writer_host: Optional[str] = None
+                       writer_host: Optional[str] = None,
+                       preferred_types: Optional[List[str]] = None
                        ) -> List[DatanodeDescriptor]:
         """Pick n distinct live targets, local-writer-first then
-        load-weighted random. Ref: BlockPlacementPolicyDefault.chooseTarget."""
+        load-weighted random. Ref: BlockPlacementPolicyDefault.chooseTarget.
+        ``preferred_types`` narrows to those storage types when any such
+        node is live (falling back to all, like the reference's
+        fallback-storage-type chain)."""
         with self._lock:
             candidates = [node for node in self._nodes.values()
                           if node.state == DatanodeInfo.STATE_LIVE
                           and node.uuid not in exclude]
+        if preferred_types:
+            typed = [c for c in candidates
+                     if c.storage_type in preferred_types]
+            if typed:
+                candidates = typed
         if not candidates:
             return []
         chosen: List[DatanodeDescriptor] = []
@@ -457,14 +509,26 @@ class BlockManager:
         Ref: BlockManager.processExtraRedundancyBlock."""
         if isinstance(info, BlockInfoStriped):
             return  # units are unique; nothing is "excess"
-        excess = info.live_replicas() - info.expected_replication
-        if excess <= 0:
-            return
         nodes = [self.dn_manager.get(u)
                  for u in (info.locations - info.corrupt_replicas)]
+        # Only LIVE-state replicas count toward (and are candidates for)
+        # excess — copies on draining nodes are already leaving and
+        # pruning live ones against them would starve the drain (ref:
+        # countNodes' decommissioning vs live split).
         nodes = [n for n in nodes if n is not None
                  and n.state == DatanodeInfo.STATE_LIVE]
-        nodes.sort(key=lambda n: -len(n.blocks))
+        excess = len(nodes) - info.expected_replication
+        if excess <= 0:
+            return
+        # Drop policy-violating replicas first (the mover just created a
+        # right-type copy; pruning it instead would undo the migration —
+        # ref: the delNodeHint the reference's Dispatcher passes), then
+        # most-loaded.
+        from hadoop_tpu.dfs.protocol.records import (POLICY_TYPES,
+                                                     effective_storage_policy)
+        wanted = POLICY_TYPES.get(effective_storage_policy(info.inode),
+                                  ["DISK"])
+        nodes.sort(key=lambda n: (n.storage_type in wanted, -len(n.blocks)))
         for node in nodes[:excess]:
             node.invalidate_queue.append(info.block)
             info.locations.discard(node.uuid)
@@ -511,7 +575,9 @@ class BlockManager:
         live_uuids = info.locations - info.corrupt_replicas
         sources = [self.dn_manager.get(u) for u in live_uuids]
         sources = [s for s in sources if s is not None and s.state in
-                   (DatanodeInfo.STATE_LIVE, DatanodeInfo.STATE_DECOMMISSIONING)]
+                   (DatanodeInfo.STATE_LIVE,
+                    DatanodeInfo.STATE_DECOMMISSIONING,
+                    DatanodeInfo.STATE_ENTERING_MAINTENANCE)]
         if not sources:
             return False  # unrecoverable for now (all replicas lost)
         # Decommission drains count live-elsewhere replicas as deficits too.
@@ -571,6 +637,63 @@ class BlockManager:
             time.monotonic() + 60.0)
         self._m_reconstructions.incr()
         return True
+
+    def is_node_drained(self, node: DatanodeDescriptor) -> bool:
+        """True when no block on the node still depends on it."""
+        with self._lock:
+            for bid in list(node.blocks):
+                info = self._resolve_locked(bid)
+                if info is None or info.under_construction:
+                    continue
+                others = {u for u in (info.locations - info.corrupt_replicas)
+                          if u != node.uuid}
+                live_others = [u for u in others
+                               if (n := self.dn_manager.get(u)) is not None
+                               and n.state == DatanodeInfo.STATE_LIVE]
+                if isinstance(info, BlockInfoStriped):
+                    unit = ec.unit_index_of(bid)
+                    if not any(info.unit_map.get(u) == unit
+                               for u in live_others):
+                        return False
+                elif len(live_others) < min(info.expected_replication,
+                                            len(self.dn_manager.live_nodes())):
+                    return False
+            return True
+
+    def blocks_on_node(self, uuid: str, max_blocks: int = 256,
+                       min_size: int = 0) -> List[Block]:
+        """Blocks stored on a node, biggest first — the balancer's source
+        inventory (ref: NamenodeProtocol.getBlocks)."""
+        node = self.dn_manager.get(uuid)
+        if node is None:
+            return []
+        out: List[Block] = []
+        with self._lock:
+            for bid in list(node.blocks):
+                info = self._resolve_locked(bid)
+                if info is None or info.under_construction or \
+                        isinstance(info, BlockInfoStriped):
+                    continue  # balancer moves contiguous replicas only
+                if info.block.num_bytes >= min_size:
+                    out.append(info.block)
+        out.sort(key=lambda b: -b.num_bytes)
+        return out[:max_blocks]
+
+    def invalidate_replica(self, block: Block, uuid: str) -> bool:
+        """Drop one specific replica (mover/balancer cleanup; ref: the
+        excess-replica choice the Dispatcher makes via delHints)."""
+        node = self.dn_manager.get(uuid)
+        with self._lock:
+            info = self._resolve_locked(block.block_id)
+            if info is None or node is None:
+                return False
+            if info.live_replicas() <= 1:
+                return False  # never drop the last copy
+            node.invalidate_queue.append(block)
+            info.locations.discard(uuid)
+            node.blocks.discard(block.block_id)
+            self._update_needed_locked(info)
+            return True
 
     def node_died(self, node: DatanodeDescriptor) -> None:
         """All replicas on a dead node are gone; requeue its blocks."""

@@ -221,6 +221,16 @@ class FSNamesystem:
                 f"cannot {action}: name node is in safe mode "
                 f"({self.bm.safemode.status()})")
 
+    @staticmethod
+    def _check_mutable_path(*paths: str) -> None:
+        """Snapshot contents are immutable and the .snapshot pseudo-dir is
+        not a real inode — every mutating op must reject such paths (ref:
+        FSDirectory.verifySnapshotName / the isSnapshotPath checks)."""
+        for p in paths:
+            if ".snapshot" in [c for c in p.split("/") if c]:
+                raise OSError(
+                    f"cannot modify {p}: snapshot paths are read-only")
+
     # ========================================================== client ops
 
     def create(self, path: str, client_name: str, replication: Optional[int],
@@ -232,6 +242,7 @@ class FSNamesystem:
         with self._m["create"].time():
             with self.lock.write():
                 self._check_not_safemode("create")
+                self._check_mutable_path(path)
                 existing = self.fsdir.get_inode(path)
                 if existing is not None:
                     if isinstance(existing, INodeDirectory):
@@ -244,8 +255,12 @@ class FSNamesystem:
                         self._recover_lease_locked(path, existing)
                     if not overwrite:
                         raise FileExistsError(path)
+                    # Quota BEFORE the overwrite-delete: a rejection must
+                    # leave the old file (and its replicas) untouched.
+                    self._check_quota_locked(path, d_inodes=1, d_space=0)
                     self._delete_locked(path, recursive=False)
-                self._check_quota_locked(path, d_inodes=1, d_space=0)
+                else:
+                    self._check_quota_locked(path, d_inodes=1, d_space=0)
                 ec_policy = self._effective_ec_policy_locked(path)
                 inode = self.fsdir.add_file(path, replication, block_size,
                                             owner=owner)
@@ -307,9 +322,13 @@ class FSNamesystem:
                         ec_policy=policy.name,
                         indices=list(range(len(targets))))
                 else:
+                    from hadoop_tpu.dfs.protocol.records import (
+                        POLICY_TYPES, effective_storage_policy)
                     block = Block(self._new_block_id(), self._gen_stamp, 0)
                     targets = self.bm.dn_manager.choose_targets(
-                        inode.replication, set(exclude), writer_host)
+                        inode.replication, set(exclude), writer_host,
+                        preferred_types=POLICY_TYPES.get(
+                            effective_storage_policy(inode)))
                     if not targets:
                         raise IOError(
                             f"no datanodes available for {path} "
@@ -585,6 +604,7 @@ class FSNamesystem:
             owner = current_user().user_name
             with self.lock.write():
                 self._check_not_safemode("mkdirs")
+                self._check_mutable_path(path)
                 if not self.fsdir.exists(path):
                     self._check_quota_locked(path, d_inodes=1, d_space=0)
                 self.fsdir.mkdirs(path, owner=owner)
@@ -597,6 +617,7 @@ class FSNamesystem:
         with self._m["delete"].time():
             with self.lock.write():
                 self._check_not_safemode("delete")
+                self._check_mutable_path(path)
                 removed = self._delete_locked(path, recursive)
                 if not removed:
                     return False
@@ -633,6 +654,7 @@ class FSNamesystem:
         with self._m["rename"].time():
             with self.lock.write():
                 self._check_not_safemode("rename")
+                self._check_mutable_path(src, dst)
                 actual_dst = self.fsdir.rename(src, dst)
                 self.leases.rename_path(src, actual_dst)
                 txid = self.editlog.log_edit(el.OP_RENAME,
@@ -641,6 +663,7 @@ class FSNamesystem:
             return True
 
     def set_replication(self, path: str, replication: int) -> bool:
+        self._check_mutable_path(path)
         with self.lock.write():
             self._check_not_safemode("set replication")
             inode = self.fsdir.get_inode(path)
@@ -695,6 +718,7 @@ class FSNamesystem:
 
     def set_quota(self, path: str, ns_quota: int, space_quota: int) -> None:
         """Ref: FSDirAttrOp.setQuota; -1 clears a dimension."""
+        self._check_mutable_path(path)
         with self.lock.write():
             self._check_not_safemode("set quota")
             node = self.fsdir.get_inode(path)
@@ -710,6 +734,7 @@ class FSNamesystem:
 
     def set_xattr(self, path: str, name: str, value: bytes) -> None:
         """Ref: FSDirXAttrOp.setXAttr — names are namespaced."""
+        self._check_mutable_path(path)
         ns = name.split(".", 1)[0]
         if ns not in ("user", "trusted", "system", "security", "raw"):
             raise ValueError(f"xattr name must be namespaced: {name!r}")
@@ -735,6 +760,7 @@ class FSNamesystem:
             return dict(attrs)
 
     def remove_xattr(self, path: str, name: str) -> None:
+        self._check_mutable_path(path)
         with self.lock.write():
             node = self._inode_or_raise(path)
             if not node.xattrs or name not in node.xattrs:
@@ -749,6 +775,7 @@ class FSNamesystem:
     def set_acl(self, path: str, entries: List[str]) -> None:
         """Replace the full ACL (ref: FSDirAclOp.setAcl). Entries are
         "type:name:perms" strings ("user:alice:rw-", "group::r--")."""
+        self._check_mutable_path(path)
         for e in entries:
             if len(e.split(":")) != 3:
                 raise ValueError(f"malformed ACL entry {e!r}")
@@ -769,6 +796,7 @@ class FSNamesystem:
     # ------------------------------------------------------- storage policy
 
     def set_storage_policy(self, path: str, policy: str) -> None:
+        self._check_mutable_path(path)
         if policy not in STORAGE_POLICIES:
             raise ValueError(
                 f"unknown storage policy {policy!r}; known: "
@@ -940,6 +968,7 @@ class FSNamesystem:
         delete the sources (ref: FSDirConcatOp — metadata-only append)."""
         with self.lock.write():
             self._check_not_safemode("concat")
+            self._check_mutable_path(target, *srcs)
             if len(set(srcs)) != len(srcs) or target in srcs:
                 raise ValueError(
                     f"concat sources must be distinct and exclude the "
@@ -980,6 +1009,7 @@ class FSNamesystem:
         does not arise)."""
         with self.lock.write():
             self._check_not_safemode("truncate")
+            self._check_mutable_path(path)
             inode = self._inode_or_raise(path)
             if not isinstance(inode, INodeFile):
                 raise IsADirectoryError(path)
@@ -1072,6 +1102,7 @@ class FSNamesystem:
                 else path)
 
     def set_times(self, path: str, mtime: float, atime: float) -> None:
+        self._check_mutable_path(path)
         with self.lock.write():
             inode = self.fsdir.get_inode(path)
             if inode is None:
@@ -1085,6 +1116,7 @@ class FSNamesystem:
         self.editlog.log_sync(txid)
 
     def set_permission(self, path: str, permission: int) -> None:
+        self._check_mutable_path(path)
         with self.lock.write():
             inode = self.fsdir.get_inode(path)
             if inode is None:
@@ -1095,6 +1127,7 @@ class FSNamesystem:
         self.editlog.log_sync(txid)
 
     def set_owner(self, path: str, owner: str, group: str) -> None:
+        self._check_mutable_path(path)
         with self.lock.write():
             inode = self.fsdir.get_inode(path)
             if inode is None:
@@ -1125,11 +1158,14 @@ class FSNamesystem:
             if rec.get("ov") and self.fsdir.exists(rec["p"]):
                 # create(overwrite=True) replaced an existing file; replay the
                 # implicit delete (its blocks die with it — any replicas left
-                # on DNs are invalidated as unknown at report time).
+                # on DNs are invalidated as unknown at report time). Pinned
+                # blocks survive, exactly like the live path.
                 gone = self.fsdir.delete(rec["p"], recursive=False)
                 if gone is not None:
+                    pinned = self._pinned_block_ids_locked()
                     for b in collect_blocks(gone):
-                        self.bm.remove_block(b)
+                        if b.block_id not in pinned:
+                            self.bm.remove_block(b)
                 holder = self.leases.holder_of(rec["p"])
                 if holder:
                     self.leases.remove_lease(holder, rec["p"])
@@ -1175,8 +1211,10 @@ class FSNamesystem:
             node = self.fsdir.delete(rec["p"], rec.get("r", True))
             if node is not None:
                 self.leases.remove_under(rec["p"])
+                pinned = self._pinned_block_ids_locked()
                 for b in collect_blocks(node):
-                    self.bm.remove_block(b)
+                    if b.block_id not in pinned:
+                        self.bm.remove_block(b)
         elif op == el.OP_RENAME:
             actual = self.fsdir.rename(rec["s"], rec["d"])
             self.leases.rename_path(rec["s"], actual)

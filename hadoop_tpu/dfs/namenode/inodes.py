@@ -155,7 +155,16 @@ def snapshot_copy(node: INode) -> INode:
     cp.storage_policy = f.storage_policy
     cp.xattrs = dict(f.xattrs) if f.xattrs else None
     cp.acl = list(f.acl) if f.acl else None
-    cp.blocks = list(f.blocks)
+    if f.under_construction:
+        # The trailing blocks of an open file are still mutated in place
+        # (commit/recovery update num_bytes/gen_stamp on the shared
+        # objects) — value-copy so the snapshot stays frozen at the
+        # capture point. Finalized files' blocks are immutable and safe
+        # to share.
+        cp.blocks = [Block(b.block_id, b.gen_stamp, b.num_bytes)
+                     for b in f.blocks]
+    else:
+        cp.blocks = list(f.blocks)
     return cp
 
 

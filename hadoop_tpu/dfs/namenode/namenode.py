@@ -51,6 +51,8 @@ WRITE_METHODS = frozenset({
     "set_storage_policy", "allow_snapshot", "disallow_snapshot",
     "create_snapshot", "delete_snapshot", "rename_snapshot", "concat",
     "truncate",
+    # Admin/balancer mutations.
+    "start_maintenance", "stop_maintenance", "invalidate_replica",
 })
 
 
@@ -278,6 +280,30 @@ class ClientProtocol:
     def decommission_datanode(self, uuid: str) -> bool:
         self.fsn.bm.dn_manager.start_decommission(uuid)
         return True
+
+    def start_maintenance(self, uuid: str) -> bool:
+        self.fsn.bm.dn_manager.start_maintenance(uuid)
+        return True
+
+    def stop_maintenance(self, uuid: str) -> bool:
+        self.fsn.bm.dn_manager.stop_maintenance(uuid)
+        return True
+
+    @idempotent
+    def get_blocks(self, uuid: str, max_blocks: int = 256,
+                   min_size: int = 0):
+        """Balancer inventory (ref: NamenodeProtocol.getBlocks)."""
+        return [b.to_wire() for b in
+                self.fsn.bm.blocks_on_node(uuid, max_blocks, min_size)]
+
+    @idempotent
+    def get_block_datanodes(self, block: Dict):
+        """Current replica holders of one block (balancer/mover probe)."""
+        lb = self.fsn.bm.located_block(Block.from_wire(block), 0)
+        return [d.to_wire() for d in lb.locations]
+
+    def invalidate_replica(self, block: Dict, uuid: str) -> bool:
+        return self.fsn.bm.invalidate_replica(Block.from_wire(block), uuid)
 
     def report_bad_blocks(self, blocks: List[Dict], uuids: List[str]):
         """Client-detected corrupt replicas. Ref: ClientProtocol
@@ -584,6 +610,7 @@ class NameNode(AbstractService):
                 if self.ha_state == ha.ACTIVE and \
                         not self.fsn.bm.safemode.is_on():
                     self.fsn.bm.compute_reconstruction_work()
+                    self.fsn.bm.dn_manager.check_admin_progress()
                     self.fsn.check_leases()
             except Exception:
                 log.exception("Redundancy monitor pass failed")

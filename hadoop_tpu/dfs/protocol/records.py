@@ -49,6 +49,31 @@ class QuotaExceededError(IOError):
     Ref: hdfs/protocol/QuotaExceededException.java."""
 
 
+# Which media classes satisfy each storage policy, in preference order
+# (ref: BlockStoragePolicySuite's storage-type lists). Shared by the
+# placement policy, excess pruning, and the Mover.
+POLICY_TYPES = {
+    "HOT": ["DISK"],
+    "WARM": ["DISK", "ARCHIVE"],
+    "COLD": ["ARCHIVE"],
+    "ALL_SSD": ["SSD"],
+    "ONE_SSD": ["SSD", "DISK"],
+    "LAZY_PERSIST": ["RAM_DISK", "DISK"],
+    "PROVIDED": ["PROVIDED", "DISK"],
+}
+
+
+def effective_storage_policy(inode) -> str:
+    """Nearest ancestor-or-self storage policy; HOT when unset."""
+    node = inode
+    while node is not None:
+        sp = getattr(node, "storage_policy", None)
+        if sp:
+            return sp
+        node = getattr(node, "parent", None)
+    return "HOT"
+
+
 class Block:
     """(block_id, generation_stamp, num_bytes). Ref: protocol/Block.java;
     the generation stamp versions replicas across pipeline recoveries."""
@@ -114,18 +139,23 @@ class DatanodeID:
 
 
 class DatanodeInfo(DatanodeID):
-    """DatanodeID + liveness/usage stats. Ref: protocol/DatanodeInfo.java."""
+    """DatanodeID + liveness/usage stats. Ref: protocol/DatanodeInfo.java.
+    ``storage_type`` is the node's media class (ref: StorageType.java) —
+    the dimension storage policies and the Mover act on."""
 
     __slots__ = ("capacity", "dfs_used", "remaining", "last_heartbeat",
-                 "num_blocks", "state")
+                 "num_blocks", "state", "storage_type")
 
     STATE_LIVE = "live"
     STATE_DEAD = "dead"
     STATE_DECOMMISSIONING = "decommissioning"
     STATE_DECOMMISSIONED = "decommissioned"
+    STATE_ENTERING_MAINTENANCE = "entering_maintenance"
+    STATE_IN_MAINTENANCE = "in_maintenance"
 
     def __init__(self, uuid: str, host: str, xfer_port: int, ipc_port: int = 0,
-                 capacity: int = 0, dfs_used: int = 0, remaining: int = 0):
+                 capacity: int = 0, dfs_used: int = 0, remaining: int = 0,
+                 storage_type: str = "DISK"):
         super().__init__(uuid, host, xfer_port, ipc_port)
         self.capacity = capacity
         self.dfs_used = dfs_used
@@ -133,18 +163,23 @@ class DatanodeInfo(DatanodeID):
         self.last_heartbeat = time.monotonic()
         self.num_blocks = 0
         self.state = self.STATE_LIVE
+        self.storage_type = storage_type
+
+    def utilization(self) -> float:
+        return self.dfs_used / self.capacity if self.capacity else 0.0
 
     def to_wire(self) -> Dict:
         d = super().to_wire()
         d.update({"cap": self.capacity, "used": self.dfs_used,
                   "rem": self.remaining, "st": self.state,
-                  "nblk": self.num_blocks})
+                  "nblk": self.num_blocks, "sty": self.storage_type})
         return d
 
     @classmethod
     def from_wire(cls, d: Dict) -> "DatanodeInfo":
         info = cls(d["u"], d["h"], d["xp"], d.get("ip", 0), d.get("cap", 0),
-                   d.get("used", 0), d.get("rem", 0))
+                   d.get("used", 0), d.get("rem", 0),
+                   d.get("sty", "DISK"))
         info.state = d.get("st", cls.STATE_LIVE)
         info.num_blocks = d.get("nblk", 0)
         return info

@@ -188,11 +188,13 @@ def fast_conf(base: Optional[Configuration] = None) -> Configuration:
 class MiniDFSCluster:
     def __init__(self, num_datanodes: int = 3,
                  conf: Optional[Configuration] = None,
-                 base_dir: Optional[str] = None):
+                 base_dir: Optional[str] = None,
+                 storage_types: Optional[List[str]] = None):
         self.conf = fast_conf(conf)
         self.base_dir = base_dir or tempfile.mkdtemp(prefix="htpu-minidfs-")
         self._owns_dir = base_dir is None
         self.num_datanodes = num_datanodes
+        self.storage_types = storage_types  # per-DN media class (mover tests)
         self.namenode: Optional[NameNode] = None
         self.datanodes: List[Optional[DataNode]] = []
         self._fs_instances: List[DistributedFileSystem] = []
@@ -220,6 +222,9 @@ class MiniDFSCluster:
 
     def _start_datanode(self, i: int) -> None:
         dn_conf = Configuration(other=self.conf)
+        if self.storage_types:
+            dn_conf.set("dfs.datanode.storage.type",
+                        self.storage_types[i % len(self.storage_types)])
         dn = DataNode(dn_conf,
                       data_dir=os.path.join(self.base_dir, f"data{i}"),
                       nn_addr=("127.0.0.1", self.namenode.port))

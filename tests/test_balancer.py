@@ -1,0 +1,147 @@
+"""Balancer, Mover, decommission completion, maintenance mode.
+
+Mirrors the reference tests (ref: hadoop-hdfs TestBalancer.java,
+TestMover.java, TestDecommission.java, TestMaintenanceState.java).
+"""
+
+import os
+import time
+
+import pytest
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.dfs.balancer import Balancer, Mover
+from hadoop_tpu.dfs.protocol.records import DatanodeInfo
+from hadoop_tpu.testing.minicluster import MiniDFSCluster, fast_conf
+
+
+def _conf():
+    conf = fast_conf()
+    conf.set("dfs.blocksize", str(64 * 1024))
+    conf.set("dfs.replication", "1")
+    # Small fixed capacity so utilization deltas are visible (all mini-DNs
+    # share one host volume otherwise).
+    conf.set("dfs.datanode.capacity", "2m")
+    return conf
+
+
+def test_balancer_spreads_blocks(tmp_path):
+    """Start with 2 DNs, load them, add 2 empty DNs; the balancer should
+    move blocks onto the newcomers."""
+    with MiniDFSCluster(num_datanodes=2, conf=_conf(),
+                        base_dir=str(tmp_path)) as cluster:
+        cluster.wait_active()
+        fs = cluster.get_filesystem()
+        for i in range(6):
+            with fs.create(f"/load/f{i}") as out:
+                out.write(os.urandom(64 * 1024))
+        # Two empty newcomers.
+        cluster.num_datanodes = 4
+        cluster._start_datanode(2)
+        cluster._start_datanode(3)
+        cluster.wait_active()
+        bal = Balancer(cluster.nn_addr, cluster.conf, threshold=0.02)
+        try:
+            stats = bal.run()
+        finally:
+            bal.close()
+        assert stats["blocks_moved"] > 0
+        # The newcomers now hold replicas.
+        deadline = time.monotonic() + 20
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            fsn = cluster.namenode.fsn
+            new_nodes = [cluster.datanodes[2].uuid, cluster.datanodes[3].uuid]
+            held = sum(len(fsn.bm.dn_manager.get(u).blocks)
+                       for u in new_nodes)
+            ok = held > 0
+            time.sleep(0.2)
+        assert ok, "no blocks landed on the new datanodes"
+        # Data still fully readable after moves + excess pruning.
+        for i in range(6):
+            with fs.open(f"/load/f{i}") as f:
+                assert len(f.read()) == 64 * 1024
+
+
+def test_mover_satisfies_cold_policy(tmp_path):
+    with MiniDFSCluster(num_datanodes=3, conf=_conf(),
+                        base_dir=str(tmp_path),
+                        storage_types=["DISK", "DISK", "ARCHIVE"]
+                        ) as cluster:
+        cluster.wait_active()
+        fs = cluster.get_filesystem()
+        fs.mkdirs("/archive")
+        with fs.create("/archive/old.dat") as out:
+            out.write(os.urandom(100 * 1024))
+        fs.set_storage_policy("/archive", "COLD")
+        mover = Mover(cluster.nn_addr, cluster.conf)
+        try:
+            stats = mover.run("/archive")
+        finally:
+            mover.close()
+        assert stats["replicas_moved"] > 0
+        # Replicas now live on the ARCHIVE node only.
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            info = fs.client.get_block_locations("/archive/old.dat")
+            types = {DatanodeInfo.from_wire(d).storage_type
+                     for b in info["blocks"] for d in b["locs"]}
+            if types == {"ARCHIVE"}:
+                break
+            time.sleep(0.2)
+        assert types == {"ARCHIVE"}, types
+        with fs.open("/archive/old.dat") as f:
+            assert len(f.read()) == 100 * 1024
+
+
+def test_decommission_completes_and_data_survives(tmp_path):
+    conf = fast_conf()
+    conf.set("dfs.blocksize", str(64 * 1024))
+    conf.set("dfs.replication", "2")
+    with MiniDFSCluster(num_datanodes=4, conf=conf,
+                        base_dir=str(tmp_path)) as cluster:
+        cluster.wait_active()
+        fs = cluster.get_filesystem()
+        payload = os.urandom(150 * 1024)
+        with fs.create("/dc/data") as out:
+            out.write(payload)
+        victim = cluster.datanodes[0]
+        fs.client.nn.decommission_datanode(victim.uuid)
+        fsn = cluster.namenode.fsn
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            node = fsn.bm.dn_manager.get(victim.uuid)
+            if node.state == DatanodeInfo.STATE_DECOMMISSIONED:
+                break
+            time.sleep(0.2)
+        assert node.state == DatanodeInfo.STATE_DECOMMISSIONED, node.state
+        # Safe to stop it now.
+        cluster.kill_datanode(0)
+        with fs.open("/dc/data") as f:
+            assert f.read() == payload
+
+
+def test_maintenance_mode_roundtrip(tmp_path):
+    conf = fast_conf()
+    conf.set("dfs.replication", "2")
+    with MiniDFSCluster(num_datanodes=3, conf=conf,
+                        base_dir=str(tmp_path)) as cluster:
+        cluster.wait_active()
+        fs = cluster.get_filesystem()
+        with fs.create("/mm/f") as out:
+            out.write(b"z" * 50_000)
+        victim = cluster.datanodes[1]
+        fs.client.nn.start_maintenance(victim.uuid)
+        fsn = cluster.namenode.fsn
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            node = fsn.bm.dn_manager.get(victim.uuid)
+            if node.state == DatanodeInfo.STATE_IN_MAINTENANCE:
+                break
+            time.sleep(0.2)
+        assert node.state == DatanodeInfo.STATE_IN_MAINTENANCE
+        fs.client.nn.stop_maintenance(victim.uuid)
+        assert fsn.bm.dn_manager.get(victim.uuid).state == \
+            DatanodeInfo.STATE_LIVE
+        with fs.open("/mm/f") as f:
+            assert f.read() == b"z" * 50_000
