@@ -1,0 +1,8 @@
+"""Command-line layer: the `hadoop-tpu` dispatcher and its subcommands.
+
+Parity with the reference's shell framework (ref: hadoop-common
+src/main/bin/hadoop + hadoop-functions.sh (2,744 LoC), hdfs/yarn/mapred
+scripts) — one console entry point dispatching to fs shell, admin tools,
+daemons, and jobs, with GenericOptionsParser-style -D/-conf/-fs handling
+(ref: util/GenericOptionsParser.java).
+"""

@@ -1,0 +1,168 @@
+"""`hadoop-tpu` — the single dispatcher entry point.
+
+Parity with the reference's shell scripts (ref: hadoop-common
+src/main/bin/hadoop + hadoop-functions.sh, hdfs/yarn/mapred CLIs):
+
+  hadoop-tpu fs -ls /                      filesystem shell
+  hadoop-tpu dfsadmin -report              DFS administration
+  hadoop-tpu fsck /path                    namespace health check
+  hadoop-tpu balancer [-threshold 0.1]     rebalance block placement
+  hadoop-tpu mover [path]                  satisfy storage policies
+  hadoop-tpu namenode|datanode|journalnode daemon launchers
+  hadoop-tpu rm|nodeagent                  resource-manager daemons
+  hadoop-tpu job -submit ...               MapReduce job control
+  hadoop-tpu version
+
+Generic options (before the subcommand args, ref:
+util/GenericOptionsParser.java): -D key=value, -conf file.xml, -fs uri.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Tuple
+
+from hadoop_tpu.conf import Configuration
+
+VERSION = "0.1.0"
+
+
+def parse_generic_options(conf: Configuration,
+                          argv: List[str]) -> List[str]:
+    """Consume -D/-conf/-fs prefix options into ``conf``; returns the
+    remaining args. Ref: GenericOptionsParser."""
+    rest: List[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "-D" and i + 1 < len(argv):
+            key, _, val = argv[i + 1].partition("=")
+            conf.set(key, val)
+            i += 2
+        elif a.startswith("-D") and "=" in a:
+            key, _, val = a[2:].partition("=")
+            conf.set(key, val)
+            i += 1
+        elif a == "-conf" and i + 1 < len(argv):
+            conf.add_resource(argv[i + 1])
+            i += 2
+        elif a == "-fs" and i + 1 < len(argv):
+            conf.set("fs.defaultFS", argv[i + 1])
+            i += 2
+        else:
+            rest.append(a)
+            i += 1
+    return rest
+
+
+def _run_daemon(service, conf: Configuration) -> int:
+    import signal
+    import threading
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    service.init(conf)
+    service.start()
+    try:
+        while not stop.wait(1.0):
+            pass
+    finally:
+        service.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    try:
+        return _main(argv)
+    except BrokenPipeError:
+        # Downstream pipe (e.g. `| head`) closed early — normal for CLIs.
+        import os
+        try:
+            os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        except OSError:
+            pass
+        return 0
+
+
+def _main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help", "help"):
+        print(__doc__)
+        return 0
+    cmd, *rest = argv
+    conf = Configuration()
+    rest = parse_generic_options(conf, rest)
+
+    if cmd == "version":
+        print(f"hadoop-tpu {VERSION}")
+        return 0
+    if cmd == "fs":
+        from hadoop_tpu.cli.shell import FsShell
+        shell = FsShell(conf)
+        try:
+            return shell.run(rest)
+        finally:
+            shell.close()
+    if cmd == "dfsadmin":
+        from hadoop_tpu.cli.dfsadmin import DFSAdmin
+        admin = DFSAdmin(conf)
+        try:
+            return admin.run(rest)
+        finally:
+            admin.close()
+    if cmd == "fsck":
+        from hadoop_tpu.cli.dfsadmin import Fsck
+        fsck = Fsck(conf)
+        try:
+            return fsck.run(rest)
+        finally:
+            fsck.close()
+    if cmd == "balancer":
+        from hadoop_tpu.dfs.balancer import Balancer
+        from hadoop_tpu.util.misc import parse_addr_list
+        threshold = 0.10
+        if "-threshold" in rest:
+            threshold = float(rest[rest.index("-threshold") + 1])
+        addrs = parse_addr_list(conf.get("dfs.namenode.rpc-address",
+                                         "127.0.0.1:8020"))
+        bal = Balancer(addrs, conf, threshold=threshold)
+        try:
+            stats = bal.run()
+            print(f"Balancing complete: {stats}")
+        finally:
+            bal.close()
+        return 0
+    if cmd == "mover":
+        from hadoop_tpu.dfs.balancer import Mover
+        from hadoop_tpu.util.misc import parse_addr_list
+        addrs = parse_addr_list(conf.get("dfs.namenode.rpc-address",
+                                         "127.0.0.1:8020"))
+        mover = Mover(addrs, conf)
+        try:
+            stats = mover.run(rest[0] if rest else "/")
+            print(f"Mover complete: {stats}")
+        finally:
+            mover.close()
+        return 0
+    if cmd == "namenode":
+        from hadoop_tpu.dfs.namenode import NameNode
+        return _run_daemon(NameNode(conf), conf)
+    if cmd == "datanode":
+        from hadoop_tpu.dfs.datanode import DataNode
+        return _run_daemon(DataNode(conf), conf)
+    if cmd == "journalnode":
+        from hadoop_tpu.dfs.qjournal import JournalNode
+        return _run_daemon(JournalNode(conf), conf)
+    if cmd == "rm":
+        from hadoop_tpu.yarn.rm import ResourceManager
+        return _run_daemon(ResourceManager(conf), conf)
+    if cmd == "nodeagent":
+        from hadoop_tpu.yarn.nm import NodeAgent
+        return _run_daemon(NodeAgent(conf), conf)
+    print(f"hadoop-tpu: unknown command {cmd!r}; try `hadoop-tpu help`",
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

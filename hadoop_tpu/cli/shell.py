@@ -1,0 +1,358 @@
+"""FsShell: the `hadoop-tpu fs` command family.
+
+Parity with the reference (ref: hadoop-common fs/FsShell.java:45 and the
+fs/shell/ command classes: Ls, Mkdir, CopyCommands, Delete, Tail, Count,
+SetReplication, XAttrCommands, AclCommands, SnapshotCommands): each
+``-command`` maps to one method; paths without a scheme resolve against
+``fs.defaultFS``.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import List, Optional
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.fs.filesystem import FileSystem, Path
+from hadoop_tpu.fs.trash import Trash
+
+
+def _fmt_size(n: int) -> str:
+    for unit in ("", "K", "M", "G", "T"):
+        if n < 1024 or unit == "T":
+            return f"{n:.1f}{unit}" if unit else str(n)
+        n /= 1024.0
+    return str(n)
+
+
+def _perm_str(st) -> str:
+    kind = "d" if st.is_dir else "-"
+    bits = ""
+    for shift in (6, 3, 0):
+        p = (st.permission >> shift) & 7
+        bits += ("r" if p & 4 else "-") + ("w" if p & 2 else "-") + \
+            ("x" if p & 1 else "-")
+    return kind + bits
+
+
+class FsShell:
+    """Ref: fs/FsShell.java — run() returns a process exit code."""
+
+    def __init__(self, conf: Optional[Configuration] = None, out=None):
+        self.conf = conf or Configuration()
+        self.out = out or sys.stdout
+        self._fs_cache = {}
+
+    def _fs(self, path: str) -> FileSystem:
+        p = Path(path)
+        if p.scheme == "file" and not path.startswith("file:"):
+            default = self.conf.get("fs.defaultFS", "")
+            if default:
+                key = default
+                if key not in self._fs_cache:
+                    self._fs_cache[key] = FileSystem.get(default, self.conf)
+                return self._fs_cache[key]
+        key = f"{p.scheme}://{p.authority}"
+        if key not in self._fs_cache:
+            self._fs_cache[key] = FileSystem.get(path, self.conf)
+        return self._fs_cache[key]
+
+    def _print(self, *args) -> None:
+        print(*args, file=self.out)
+
+    def close(self) -> None:
+        for fs in self._fs_cache.values():
+            try:
+                fs.close()
+            except Exception:
+                pass
+
+    # ----------------------------------------------------------------- run
+
+    def run(self, argv: List[str]) -> int:
+        if not argv or not argv[0].startswith("-"):
+            self._print("Usage: hadoop-tpu fs -<command> [args]")
+            return 1
+        cmd = argv[0].lstrip("-")
+        handler = getattr(self, f"cmd_{cmd.replace('-', '_')}", None)
+        if handler is None:
+            self._print(f"fs: unknown command -{cmd}")
+            return 1
+        try:
+            return handler(argv[1:]) or 0
+        except (IndexError, KeyError):
+            self._print(f"fs -{cmd}: missing or malformed arguments")
+            return 1
+        except (OSError, ValueError) as e:
+            self._print(f"fs -{cmd}: {e}")
+            return 1
+
+    # ------------------------------------------------------------- commands
+
+    def cmd_ls(self, args: List[str]) -> int:
+        recursive = "-R" in args
+        paths = [a for a in args if not a.startswith("-")] or ["/"]
+        for path in paths:
+            fs = self._fs(path)
+            self._ls_one(fs, Path(path).path, recursive)
+        return 0
+
+    def _ls_one(self, fs, path: str, recursive: bool) -> None:
+        entries = fs.list_status(path)
+        self._print(f"Found {len(entries)} items")
+        for st in entries:
+            when = time.strftime("%Y-%m-%d %H:%M",
+                                 time.localtime(st.mtime or 0))
+            self._print(f"{_perm_str(st)} {st.replication or '-':>3} "
+                        f"{st.owner:8} {st.group:8} {st.length:>10} "
+                        f"{when} {st.path}")
+        if recursive:
+            for st in entries:
+                if st.is_dir:
+                    self._ls_one(fs, st.path, recursive)
+
+    def cmd_lsr(self, args):
+        return self.cmd_ls(["-R"] + args)
+
+    def cmd_mkdir(self, args: List[str]) -> int:
+        args = [a for a in args if a != "-p"]
+        for path in args:
+            self._fs(path).mkdirs(Path(path).path)
+        return 0
+
+    def cmd_put(self, args: List[str]) -> int:
+        """-put <localsrc>... <dst>. Ref: CopyCommands.Put."""
+        *srcs, dst = args
+        fs = self._fs(dst)
+        dstp = Path(dst).path
+        many = len(srcs) > 1 or (fs.exists(dstp)
+                                 and fs.get_file_status(dstp).is_dir)
+        for src in srcs:
+            target = f"{dstp.rstrip('/')}/{src.rsplit('/', 1)[-1]}" \
+                if many else dstp
+            with open(src, "rb") as inf, fs.create(target) as outf:
+                while True:
+                    chunk = inf.read(1 << 20)
+                    if not chunk:
+                        break
+                    outf.write(chunk)
+        return 0
+
+    def cmd_get(self, args: List[str]) -> int:
+        src, dst = args
+        fs = self._fs(src)
+        import os
+        if os.path.isdir(dst):
+            dst = os.path.join(dst, Path(src).name)
+        with fs.open(Path(src).path) as inf, open(dst, "wb") as outf:
+            while True:
+                chunk = inf.read(1 << 20)
+                if not chunk:
+                    break
+                outf.write(chunk)
+        return 0
+
+    def cmd_cat(self, args: List[str]) -> int:
+        for path in args:
+            fs = self._fs(path)
+            with fs.open(Path(path).path) as f:
+                data = f.read()
+            self.out.write(data.decode("utf-8", "replace"))
+        return 0
+
+    def cmd_text(self, args):
+        return self.cmd_cat(args)
+
+    def cmd_tail(self, args: List[str]) -> int:
+        path = args[-1]
+        fs = self._fs(path)
+        st = fs.get_file_status(Path(path).path)
+        with fs.open(Path(path).path) as f:
+            f.seek(max(0, st.length - 1024))
+            self.out.write(f.read().decode("utf-8", "replace"))
+        return 0
+
+    def cmd_rm(self, args: List[str]) -> int:
+        """-rm [-r] [-skipTrash] <path>...; trash by default when
+        fs.trash.interval > 0 (ref: Delete.Rm + moveToTrash)."""
+        recursive = "-r" in args or "-R" in args
+        skip_trash = "-skipTrash" in args
+        paths = [a for a in args if not a.startswith("-")]
+        interval = self.conf.get_time_seconds("fs.trash.interval", 0.0)
+        for path in paths:
+            fs = self._fs(path)
+            p = Path(path).path
+            if not recursive and fs.get_file_status(p).is_dir:
+                self._print(f"rm: `{path}': Is a directory")
+                return 1
+            if interval > 0 and not skip_trash:
+                loc = Trash(fs, interval).move_to_trash(p)
+                self._print(f"Moved: '{path}' to trash at: {loc}")
+            else:
+                if not fs.delete(p, recursive=recursive):
+                    self._print(f"rm: `{path}': No such file or directory")
+                    return 1
+                self._print(f"Deleted {path}")
+        return 0
+
+    def cmd_rmr(self, args):
+        return self.cmd_rm(["-r"] + args)
+
+    def cmd_expunge(self, args: List[str]) -> int:
+        fs = self._fs(self.conf.get("fs.defaultFS", "/"))
+        trash = Trash(fs, self.conf.get_time_seconds(
+            "fs.trash.interval", 24 * 3600.0))
+        trash.checkpoint()
+        for gone in trash.expunge():
+            self._print(f"Deleted trash checkpoint: {gone}")
+        return 0
+
+    def cmd_mv(self, args: List[str]) -> int:
+        src, dst = args
+        self._fs(src).rename(Path(src).path, Path(dst).path)
+        return 0
+
+    def cmd_cp(self, args: List[str]) -> int:
+        src, dst = args
+        sfs, dfs = self._fs(src), self._fs(dst)
+        with sfs.open(Path(src).path) as inf, \
+                dfs.create(Path(dst).path) as outf:
+            while True:
+                chunk = inf.read(1 << 20)
+                if not chunk:
+                    break
+                outf.write(chunk)
+        return 0
+
+    def cmd_touchz(self, args: List[str]) -> int:
+        for path in args:
+            with self._fs(path).create(Path(path).path) as f:
+                f.write(b"")
+        return 0
+
+    def cmd_stat(self, args: List[str]) -> int:
+        for path in args:
+            st = self._fs(path).get_file_status(Path(path).path)
+            self._print(time.strftime("%Y-%m-%d %H:%M:%S",
+                                      time.localtime(st.mtime or 0)))
+        return 0
+
+    def cmd_du(self, args: List[str]) -> int:
+        human = "-h" in args
+        paths = [a for a in args if not a.startswith("-")] or ["/"]
+        for path in paths:
+            fs = self._fs(path)
+            for st in fs.list_status(Path(path).path):
+                size = st.length
+                if st.is_dir and hasattr(fs, "content_summary"):
+                    size = fs.content_summary(st.path)["length"]
+                self._print(f"{_fmt_size(size) if human else size:>12}  "
+                            f"{st.path}")
+        return 0
+
+    def cmd_count(self, args: List[str]) -> int:
+        for path in args:
+            fs = self._fs(path)
+            cs = fs.content_summary(Path(path).path)
+            self._print(f"{cs['dirs']:>12} {cs['files']:>12} "
+                        f"{cs['length']:>12} {path}")
+        return 0
+
+    def cmd_df(self, args: List[str]) -> int:
+        fs = self._fs(args[0] if args else
+                      self.conf.get("fs.defaultFS", "/"))
+        stats = fs.client.nn.get_stats() if hasattr(fs, "client") else {}
+        self._print(f"Filesystem stats: {stats}")
+        return 0
+
+    def cmd_setrep(self, args: List[str]) -> int:
+        rep, path = int(args[0]), args[1]
+        self._fs(path).set_replication(Path(path).path, rep)
+        self._print(f"Replication {rep} set: {path}")
+        return 0
+
+    def cmd_chmod(self, args: List[str]) -> int:
+        mode, path = args[0], args[1]
+        fs = self._fs(path)
+        fs.client.nn.set_permission(Path(path).path, int(mode, 8))
+        return 0
+
+    def cmd_chown(self, args: List[str]) -> int:
+        spec, path = args[0], args[1]
+        owner, _, group = spec.partition(":")
+        fs = self._fs(path)
+        fs.client.nn.set_owner(Path(path).path, owner, group)
+        return 0
+
+    def cmd_test(self, args: List[str]) -> int:
+        """-test -e|-d|-f <path> — exit code is the answer."""
+        flag, path = args[0], args[1]
+        fs = self._fs(path)
+        try:
+            st = fs.get_file_status(Path(path).path)
+        except FileNotFoundError:
+            return 1
+        if flag == "-d":
+            return 0 if st.is_dir else 1
+        if flag == "-f":
+            return 0 if not st.is_dir else 1
+        return 0
+
+    # xattr / acl ---------------------------------------------------------
+
+    def cmd_setfattr(self, args: List[str]) -> int:
+        """-setfattr -n name [-v value] | -x name <path>."""
+        if "-x" in args:
+            name = args[args.index("-x") + 1]
+            path = args[-1]
+            self._fs(path).remove_xattr(Path(path).path, name)
+            return 0
+        name = args[args.index("-n") + 1]
+        value = args[args.index("-v") + 1].encode() if "-v" in args else b""
+        path = args[-1]
+        self._fs(path).set_xattr(Path(path).path, name, value)
+        return 0
+
+    def cmd_getfattr(self, args: List[str]) -> int:
+        path = args[-1]
+        attrs = self._fs(path).get_xattrs(Path(path).path)
+        self._print(f"# file: {path}")
+        for name, value in sorted(attrs.items()):
+            self._print(f'{name}="{value.decode("utf-8", "replace")}"')
+        return 0
+
+    def cmd_setfacl(self, args: List[str]) -> int:
+        """-setfacl -m entries | -b <path>."""
+        path = args[-1]
+        if "-b" in args:
+            self._fs(path).set_acl(Path(path).path, [])
+            return 0
+        entries = args[args.index("-m") + 1].split(",")
+        self._fs(path).set_acl(Path(path).path, entries)
+        return 0
+
+    def cmd_getfacl(self, args: List[str]) -> int:
+        path = args[-1]
+        self._print(f"# file: {path}")
+        for e in self._fs(path).get_acl(Path(path).path):
+            self._print(e)
+        return 0
+
+    # snapshots -----------------------------------------------------------
+
+    def cmd_createSnapshot(self, args: List[str]) -> int:
+        path = args[0]
+        name = args[1] if len(args) > 1 else f"s{int(time.time())}"
+        loc = self._fs(path).create_snapshot(Path(path).path, name)
+        self._print(f"Created snapshot {loc}")
+        return 0
+
+    def cmd_deleteSnapshot(self, args: List[str]) -> int:
+        self._fs(args[0]).delete_snapshot(Path(args[0]).path, args[1])
+        return 0
+
+    def cmd_renameSnapshot(self, args: List[str]) -> int:
+        self._fs(args[0]).rename_snapshot(Path(args[0]).path, args[1],
+                                          args[2])
+        return 0

@@ -147,7 +147,12 @@ class DatanodeManager:
             node.xfer_port = info.xfer_port
             node.ipc_port = info.ipc_port
             node.storage_type = info.storage_type
-            node.state = DatanodeInfo.STATE_LIVE
+            # Re-registration revives a DEAD node but must NOT cancel an
+            # operator-set admin state — rebooting a DN is exactly what
+            # maintenance mode exists for (ref: the admin-state survival
+            # in registerDatanode/startAdminOperationIfNecessary).
+            if node.state == DatanodeInfo.STATE_DEAD:
+                node.state = DatanodeInfo.STATE_LIVE
             node.last_heartbeat = time.monotonic()
             return node
 
@@ -640,6 +645,7 @@ class BlockManager:
 
     def is_node_drained(self, node: DatanodeDescriptor) -> bool:
         """True when no block on the node still depends on it."""
+        n_live = len(self.dn_manager.live_nodes())  # loop-invariant
         with self._lock:
             for bid in list(node.blocks):
                 info = self._resolve_locked(bid)
@@ -656,7 +662,7 @@ class BlockManager:
                                for u in live_others):
                         return False
                 elif len(live_others) < min(info.expected_replication,
-                                            len(self.dn_manager.live_nodes())):
+                                            n_live):
                     return False
             return True
 

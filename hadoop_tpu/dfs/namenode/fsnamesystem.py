@@ -255,9 +255,11 @@ class FSNamesystem:
                         self._recover_lease_locked(path, existing)
                     if not overwrite:
                         raise FileExistsError(path)
-                    # Quota BEFORE the overwrite-delete: a rejection must
-                    # leave the old file (and its replicas) untouched.
-                    self._check_quota_locked(path, d_inodes=1, d_space=0)
+                    # Quota BEFORE the overwrite-delete (a rejection must
+                    # leave the old file untouched) — but the replace is
+                    # inode-neutral: the old file still counts, the new one
+                    # takes its slot (ref: overwrite at quota is legal).
+                    self._check_quota_locked(path, d_inodes=0, d_space=0)
                     self._delete_locked(path, recursive=False)
                 else:
                     self._check_quota_locked(path, d_inodes=1, d_space=0)
