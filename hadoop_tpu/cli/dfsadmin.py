@@ -32,8 +32,12 @@ class DFSAdmin:
 
     def fs(self):
         if self._fs is None:
-            self._fs = FileSystem.get(self.conf.get("fs.defaultFS"),
-                                      self.conf)
+            uri = self.conf.get("fs.defaultFS", "")
+            self._fs = FileSystem.get(uri, self.conf)
+            if not hasattr(self._fs, "client"):
+                raise ValueError(
+                    f"fs.defaultFS ({uri or 'unset'}) is not a DFS — pass "
+                    f"-fs htpu://host:port")
         return self._fs
 
     def nn(self):
@@ -207,8 +211,12 @@ class Fsck:
 
     def fs(self):
         if self._fs is None:
-            self._fs = FileSystem.get(self.conf.get("fs.defaultFS"),
-                                      self.conf)
+            uri = self.conf.get("fs.defaultFS", "")
+            self._fs = FileSystem.get(uri, self.conf)
+            if not hasattr(self._fs, "client"):
+                raise ValueError(
+                    f"fs.defaultFS ({uri or 'unset'}) is not a DFS — pass "
+                    f"-fs htpu://host:port")
         return self._fs
 
     def close(self) -> None:
@@ -216,11 +224,16 @@ class Fsck:
             self._fs.close()
 
     def run(self, argv: List[str]) -> int:
-        path = argv[0] if argv and not argv[0].startswith("-") else "/"
+        non_flags = [a for a in argv if not a.startswith("-")]
+        path = non_flags[0] if non_flags else "/"
         verbose = "-files" in argv or "-blocks" in argv
         stats = {"files": 0, "dirs": 0, "bytes": 0, "blocks": 0,
                  "healthy": 0, "under": 0, "corrupt": 0, "missing": 0}
-        nn = self.fs().client.nn
+        try:
+            nn = self.fs().client.nn
+        except ValueError as e:
+            self._print(f"fsck: {e}")
+            return 1
         stack = [path]
         while stack:
             p = stack.pop()

@@ -274,15 +274,13 @@ class FsShell:
 
     def cmd_chmod(self, args: List[str]) -> int:
         mode, path = args[0], args[1]
-        fs = self._fs(path)
-        fs.client.nn.set_permission(Path(path).path, int(mode, 8))
+        self._fs(path).set_permission(Path(path).path, int(mode, 8))
         return 0
 
     def cmd_chown(self, args: List[str]) -> int:
         spec, path = args[0], args[1]
         owner, _, group = spec.partition(":")
-        fs = self._fs(path)
-        fs.client.nn.set_owner(Path(path).path, owner, group)
+        self._fs(path).set_owner(Path(path).path, owner, group)
         return 0
 
     def cmd_test(self, args: List[str]) -> int:

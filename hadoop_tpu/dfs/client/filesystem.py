@@ -61,6 +61,12 @@ class DistributedFileSystem(FileSystem):
     def set_replication(self, path: str, replication: int) -> bool:
         return self.client.nn.set_replication(path, replication)
 
+    def set_permission(self, path: str, permission: int) -> None:
+        self.client.nn.set_permission(path, permission)
+
+    def set_owner(self, path: str, owner: str, group: str) -> None:
+        self.client.nn.set_owner(path, owner, group)
+
     # ------------------------------------------------- namespace features
 
     def set_quota(self, path: str, ns_quota: int = -1,

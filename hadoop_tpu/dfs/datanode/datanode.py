@@ -126,6 +126,17 @@ class DataNode(AbstractService):
 
     def service_start(self) -> None:
         self.xceiver.start()
+        self.http = None
+        if self.config.get_bool("dfs.datanode.http.enabled", True):
+            from hadoop_tpu.http import HttpServer
+            self.http = HttpServer(
+                self.config,
+                bind=("127.0.0.1",
+                      self.config.get_int("dfs.datanode.http-port", 0)),
+                daemon_name=f"datanode-{self.uuid[:8]}")
+            self.http.add_handler(
+                "/blockstats", lambda q, b: (200, self.store.stats()))
+            self.http.start()
         for addr in self.nn_addrs:
             actor = _BPServiceActor(self, addr)
             self._actors.append(actor)
@@ -135,6 +146,8 @@ class DataNode(AbstractService):
 
     def service_stop(self) -> None:
         self._stop_event.set()
+        if getattr(self, "http", None) is not None:
+            self.http.stop()
         if self.xceiver:
             self.xceiver.stop()
         if self._client:

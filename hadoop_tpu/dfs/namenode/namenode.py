@@ -421,6 +421,8 @@ class NameNode(AbstractService):
         self.tailer: Optional[ha.EditLogTailer] = None
         self.checkpointer: Optional[ha.StandbyCheckpointer] = None
         self.failover: Optional[ha.FailoverController] = None
+        self.http = None
+        self._webhdfs = None
         self._ha_lock = threading.RLock()
         self._stop_event = threading.Event()
 
@@ -479,6 +481,22 @@ class NameNode(AbstractService):
                                    DatanodeProtocol(self.fsn, state))
         self.rpc.register_protocol("HAServiceProtocol",
                                    HAServiceProtocol(self))
+        # Admin HTTP + WebHDFS (ref: NameNodeHttpServer.java).
+        self.http = None
+        self._webhdfs = None
+        if conf.get_bool("dfs.namenode.http.enabled", True):
+            from hadoop_tpu.dfs.webhdfs import PREFIX, WebHdfsHandler
+            from hadoop_tpu.http import HttpServer
+            self.http = HttpServer(
+                conf, bind=("127.0.0.1",
+                            conf.get_int("dfs.namenode.http-port", 0)),
+                daemon_name=f"namenode-{self.nn_id}")
+            self._webhdfs = WebHdfsHandler(self)
+            self.http.add_handler(PREFIX, self._webhdfs)
+            status_proto = ClientProtocol(self.fsn, self.retry_cache,
+                                          lambda: self.ha_state)
+            self.http.add_handler(
+                "/fsstatus", lambda q, b: (200, status_proto.get_stats()))
 
     def _client_pre_call(self, method: str, ctx: CallContext) -> None:
         """HA gate + observer alignment (ref: NameNodeRpcServer's
@@ -495,6 +513,8 @@ class NameNode(AbstractService):
 
     def service_start(self) -> None:
         self.rpc.start()
+        if self.http is not None:
+            self.http.start()
         Daemon(self._redundancy_monitor, "nn-redundancy-monitor").start()
         if self.ha_enabled:
             self.tailer.start(self.tailer.last_applied_txid)
@@ -531,6 +551,10 @@ class NameNode(AbstractService):
             self.tailer.stop()
         if self.checkpointer is not None:
             self.checkpointer.stop()
+        if self.http is not None:
+            self.http.stop()
+        if self._webhdfs is not None:
+            self._webhdfs.close()
         if self.rpc:
             self.rpc.stop()
         if self.fsn:

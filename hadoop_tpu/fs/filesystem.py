@@ -89,6 +89,12 @@ class FileSystem:
     def get_file_status(self, path: str) -> FileStatus:
         raise NotImplementedError
 
+    def set_permission(self, path: str, permission: int) -> None:
+        raise NotImplementedError
+
+    def set_owner(self, path: str, owner: str, group: str) -> None:
+        raise NotImplementedError
+
     def exists(self, path: str) -> bool:
         try:
             self.get_file_status(path)
@@ -178,6 +184,13 @@ class LocalFileSystem(FileSystem):
         if not os.path.exists(path):
             raise FileNotFoundError(path)
         return self._status(path)
+
+    def set_permission(self, path: str, permission: int) -> None:
+        os.chmod(path, permission)
+
+    def set_owner(self, path: str, owner: str, group: str) -> None:
+        import shutil as _sh
+        _sh.chown(path, user=owner or None, group=group or None)
 
 
 register_filesystem("file", LocalFileSystem)

@@ -1,0 +1,1 @@
+from hadoop_tpu.http.server import HttpServer  # noqa: F401

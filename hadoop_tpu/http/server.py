@@ -1,0 +1,171 @@
+"""Embedded admin HTTP server — every daemon's observability face.
+
+Parity with the reference (ref: hadoop-common http/HttpServer2.java:123
+and its standard servlets conf/ConfServlet, jmx JMXJsonServlet,
+StackServlet): `/jmx` serves the metrics system snapshot as JSON,
+`/conf` the live configuration, `/stacks` a dump of every thread, and
+`/health` a liveness probe. Daemons can register extra JSON endpoints
+(the WebHDFS handlers ride the same server on the NameNode).
+
+stdlib ThreadingHTTPServer — the HTTP plane is an admin/REST surface,
+not the data plane; bulk bytes ride DataTransferProtocol.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.metrics import metrics_system
+
+
+class HttpServer:
+    """Ref: http/HttpServer2.java."""
+
+    def __init__(self, conf: Optional[Configuration] = None,
+                 bind: Tuple[str, int] = ("127.0.0.1", 0),
+                 daemon_name: str = "daemon"):
+        self.conf = conf or Configuration()
+        self.daemon_name = daemon_name
+        # path → fn(query_dict, body_bytes) → (status, payload)
+        self._handlers: Dict[str, Callable] = {}
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _dispatch(self, body: bytes = b""):
+                try:
+                    outer._dispatch(self, body)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    try:
+                        payload = json.dumps(
+                            {"RemoteException": {
+                                "exception": type(e).__name__,
+                                "message": str(e)}}).encode()
+                        self.send_response(
+                            404 if isinstance(e, FileNotFoundError) else 500)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length",
+                                         str(len(payload)))
+                        self.end_headers()
+                        self.wfile.write(payload)
+                    except OSError:
+                        pass
+
+            def do_GET(self):
+                self._dispatch()
+
+            def do_PUT(self):
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                self._dispatch(self.rfile.read(n) if n else b"")
+
+            def do_POST(self):
+                self.do_PUT()
+
+            def do_DELETE(self):
+                self._dispatch()
+
+        self._httpd = ThreadingHTTPServer(bind, Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+        # The standard servlets.
+        self.add_handler("/jmx", self._jmx)
+        self.add_handler("/conf", self._conf)
+        self.add_handler("/stacks", self._stacks)
+        self.add_handler("/health", lambda q, b: (200, {"status": "alive",
+                                                        "daemon":
+                                                        self.daemon_name}))
+
+    # ------------------------------------------------------------ lifecycle
+
+    def add_handler(self, prefix: str, fn: Callable) -> None:
+        """fn(query: dict, body: bytes) -> (status, obj|bytes|str).
+        Longest-prefix match; the request object is reachable via
+        query['__path__'] (full path) for prefix handlers."""
+        self._handlers[prefix] = fn
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"http-{self.daemon_name}-{self.port}", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        # shutdown() blocks on serve_forever's loop flag — calling it on a
+        # never-started server waits forever and hangs daemon teardown
+        # after a startup failure.
+        if self._thread is not None:
+            self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # ------------------------------------------------------------- dispatch
+
+    def _dispatch(self, req, body: bytes) -> None:
+        parsed = urlparse(req.path)
+        path = parsed.path
+        query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        query["__path__"] = path
+        query["__method__"] = req.command
+        handler = None
+        best = -1
+        for prefix, fn in self._handlers.items():
+            if path == prefix or path.startswith(prefix.rstrip("/") + "/") \
+                    or (prefix.endswith("/") and path.startswith(prefix)):
+                if len(prefix) > best:
+                    handler = fn
+                    best = len(prefix)
+        if handler is None:
+            req.send_response(404)
+            req.send_header("Content-Length", "0")
+            req.end_headers()
+            return
+        status, payload = handler(query, body)
+        if isinstance(payload, (dict, list)):
+            payload = json.dumps(payload, default=str).encode()
+            ctype = "application/json"
+        elif isinstance(payload, str):
+            payload = payload.encode()
+            ctype = "text/plain"
+        else:
+            ctype = "application/octet-stream"
+        req.send_response(status)
+        req.send_header("Content-Type", ctype)
+        req.send_header("Content-Length", str(len(payload)))
+        req.end_headers()
+        req.wfile.write(payload)
+
+    # ------------------------------------------------------------- servlets
+
+    def _jmx(self, query, body):
+        """Ref: JMXJsonServlet — ?qry=<source-prefix> filters."""
+        snap = metrics_system().snapshot_all()
+        qry = query.get("qry")
+        if qry:
+            snap = {k: v for k, v in snap.items() if k.startswith(qry)}
+        return 200, {"beans": [dict(name=k, **v) for k, v in snap.items()]}
+
+    def _conf(self, query, body):
+        return 200, self.conf.to_dict()
+
+    def _stacks(self, query, body):
+        """Ref: HttpServer2.StackServlet — dump of every live thread."""
+        out = []
+        frames = sys._current_frames()
+        for t in threading.enumerate():
+            frame = frames.get(t.ident)
+            stack = "".join(traceback.format_stack(frame)) if frame else ""
+            out.append(f'Thread "{t.name}" daemon={t.daemon}:\n{stack}')
+        return 200, "\n".join(out)

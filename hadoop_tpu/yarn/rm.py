@@ -431,12 +431,35 @@ class ResourceManager(AbstractService):
     def service_start(self) -> None:
         self.dispatcher.start()
         self.rpc.start()
+        # Admin HTTP: /jmx /conf /stacks plus cluster + app status JSON
+        # (ref: the RM webapp's /ws/v1/cluster REST endpoints).
+        self.http = None
+        if self.config.get_bool("yarn.resourcemanager.http.enabled", True):
+            from hadoop_tpu.http import HttpServer
+            self.http = HttpServer(
+                self.config,
+                bind=("127.0.0.1", self.config.get_int(
+                    "yarn.resourcemanager.http-port", 0)),
+                daemon_name="resourcemanager")
+            client_proto = ClientRMProtocol(self)
+            self.http.add_handler(
+                "/ws/v1/cluster/info",
+                lambda q, b: (200, client_proto.get_cluster_metrics()))
+            self.http.add_handler(
+                "/ws/v1/cluster/apps",
+                lambda q, b: (200, {"apps": client_proto.list_applications()}))
+            self.http.add_handler(
+                "/ws/v1/cluster/nodes",
+                lambda q, b: (200, {"nodes": client_proto.get_nodes()}))
+            self.http.start()
         self._recover()
         Daemon(self._liveness_loop, "rm-liveness").start()
         log.info("ResourceManager up at 127.0.0.1:%d", self.rpc.port)
 
     def service_stop(self) -> None:
         self._stop_event.set()
+        if getattr(self, "http", None) is not None:
+            self.http.stop()
         if self.rpc:
             self.rpc.stop()
         self.dispatcher.stop()

@@ -1,0 +1,116 @@
+"""HTTP surface: admin servlets, WebHDFS REST, RM web status.
+
+Mirrors the reference tests (ref: hadoop-common TestHttpServer.java,
+hadoop-hdfs TestWebHDFS.java, yarn TestRMWebServices)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.http import HttpServer
+from hadoop_tpu.testing.minicluster import (MiniDFSCluster,
+                                            MiniYARNCluster, fast_conf)
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        body = r.read()
+        ctype = r.headers.get("Content-Type", "")
+        return (r.status, json.loads(body) if "json" in ctype else body)
+
+
+def _req(url: str, method: str, data: bytes = b""):
+    req = urllib.request.Request(url, data=data, method=method)
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, json.loads(r.read() or b"{}")
+
+
+def test_standard_servlets():
+    conf = Configuration(load_defaults=False)
+    conf.set("test.key", "test.value")
+    srv = HttpServer(conf, daemon_name="unit")
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        st, health = _get(f"{base}/health")
+        assert st == 200 and health["status"] == "alive"
+        st, beans = _get(f"{base}/jmx")
+        assert st == 200 and "beans" in beans
+        st, cfg = _get(f"{base}/conf")
+        assert cfg.get("test.key") == "test.value"
+        st, stacks = _get(f"{base}/stacks")
+        assert b"Thread" in stacks
+        with pytest.raises(urllib.error.HTTPError):
+            _get(f"{base}/nope")
+    finally:
+        srv.stop()
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MiniDFSCluster(num_datanodes=3) as c:
+        c.wait_active()
+        yield c
+
+
+def test_webhdfs_roundtrip(cluster):
+    base = (f"http://127.0.0.1:{cluster.namenode.http.port}"
+            f"/webhdfs/v1")
+    st, _ = _req(f"{base}/web/dir?op=MKDIRS", "PUT")
+    assert st == 200
+    payload = b"webhdfs payload bytes"
+    st, _ = _req(f"{base}/web/dir/f.bin?op=CREATE", "PUT", payload)
+    assert st == 201
+    st, info = _get(f"{base}/web/dir/f.bin?op=GETFILESTATUS")
+    assert info["FileStatus"]["length"] == len(payload)
+    assert info["FileStatus"]["type"] == "FILE"
+    st, data = _get(f"{base}/web/dir/f.bin?op=OPEN")
+    assert data == payload
+    st, data = _get(f"{base}/web/dir/f.bin?op=OPEN&offset=8&length=7")
+    assert data == payload[8:15]
+    st, ls = _get(f"{base}/web/dir?op=LISTSTATUS")
+    names = [e["pathSuffix"] for e in ls["FileStatuses"]["FileStatus"]]
+    assert names == ["f.bin"]
+    st, cs = _get(f"{base}/web?op=GETCONTENTSUMMARY")
+    assert cs["ContentSummary"]["fileCount"] == 1
+    st, _ = _req(f"{base}/web/dir/f.bin?op=RENAME&"
+                 f"destination=/web/dir/g.bin", "PUT")
+    st, _ = _req(f"{base}/web/dir/g.bin?op=DELETE", "DELETE")
+    st, ls = _get(f"{base}/web/dir?op=LISTSTATUS")
+    assert ls["FileStatuses"]["FileStatus"] == []
+
+
+def test_webhdfs_errors(cluster):
+    base = (f"http://127.0.0.1:{cluster.namenode.http.port}"
+            f"/webhdfs/v1")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(f"{base}/no/such/file?op=GETFILESTATUS")
+    assert ei.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(f"{base}/x?op=BOGUS", "PUT")
+    assert ei.value.code == 400
+
+
+def test_namenode_jmx_has_metrics():
+    # Own cluster: the autouse conftest fixture resets the process-global
+    # metrics system between tests, so module-scoped sources vanish.
+    with MiniDFSCluster(num_datanodes=1) as c:
+        c.wait_active()
+        base = f"http://127.0.0.1:{c.namenode.http.port}"
+        st, beans = _get(f"{base}/jmx?qry=namenode")
+        names = [b["name"] for b in beans["beans"]]
+        assert any("namenode" in n for n in names)
+
+
+def test_rm_web_status():
+    with MiniYARNCluster(num_nodes=2) as yc:
+        yc.wait_nodes()
+        base = f"http://127.0.0.1:{yc.rm.http.port}"
+        st, info = _get(f"{base}/ws/v1/cluster/info")
+        assert info["num_node_managers"] == 2
+        st, nodes = _get(f"{base}/ws/v1/cluster/nodes")
+        assert len(nodes["nodes"]) == 2
+        st, apps = _get(f"{base}/ws/v1/cluster/apps")
+        assert apps["apps"] == []
