@@ -1,0 +1,4 @@
+from hadoop_tpu.crypto.streams import (CryptoInputStream,  # noqa: F401
+                                       CryptoOutputStream)
+from hadoop_tpu.crypto.keys import (KeyProvider,  # noqa: F401
+                                    FileKeyProvider, KMSClientProvider)
