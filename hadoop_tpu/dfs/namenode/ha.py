@@ -66,14 +66,24 @@ class EditLogTailer:
         return self.last_applied_txid
 
     def do_tail(self) -> int:
-        """One tailing pass. Ref: EditLogTailer.doTailEdits:324."""
+        """One tailing pass. Ref: EditLogTailer.doTailEdits:324.
+
+        The journal read happens BEFORE taking the namesystem write lock:
+        for a quorum journal the read is an RPC fan-out with multi-second
+        timeouts when a JN is down, and holding the write lock across it
+        would stall observer reads for the whole timeout (the reference
+        likewise streams edits outside the lock and applies under it)."""
+        edits = list(self.fsn.editlog.journal.read_edits(
+            self.last_applied_txid + 1))
         applied = 0
-        with self.fsn.lock.write():
-            for rec in self.fsn.editlog.journal.read_edits(
-                    self.last_applied_txid + 1):
-                self.fsn._apply_edit(rec)
-                self.last_applied_txid = rec["t"]
-                applied += 1
+        if edits:
+            with self.fsn.lock.write():
+                for rec in edits:
+                    if rec["t"] <= self.last_applied_txid:
+                        continue  # lost race with a concurrent catch-up
+                    self.fsn._apply_edit(rec)
+                    self.last_applied_txid = rec["t"]
+                    applied += 1
         if applied:
             log.debug("Tailed %d edits (through txid %d)", applied,
                       self.last_applied_txid)

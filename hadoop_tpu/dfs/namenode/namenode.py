@@ -574,11 +574,36 @@ class NameNode(AbstractService):
             self.checkpointer.stop()
             qjm = self.fsn.editlog.journal
             last_committed = qjm.recover()      # epoch fencing happens here
-            # Apply anything committed but not yet tailed.
-            with self.fsn.lock.write():
-                for rec in qjm.read_edits(self.tailer.last_applied_txid + 1):
-                    self.fsn._apply_edit(rec)
-                    self.tailer.last_applied_txid = rec["t"]
+            # Apply anything committed but not yet tailed. Loop: one
+            # read_edits pass is capped server-side (~50k records per JN),
+            # and a long-lagging standby must fully catch up here, not
+            # trip the abort guard below.
+            while self.tailer.last_applied_txid < last_committed:
+                before = self.tailer.last_applied_txid
+                edits = list(qjm.read_edits(before + 1))
+                if edits:
+                    with self.fsn.lock.write():
+                        for rec in edits:
+                            self.fsn._apply_edit(rec)
+                            self.tailer.last_applied_txid = rec["t"]
+                if self.tailer.last_applied_txid == before:
+                    break  # no forward progress — the guard below decides
+            if self.tailer.last_applied_txid < last_committed:
+                # Recovery adopted a tail the quorum cannot serve us — a
+                # JN died between accept and this read, or the accept
+                # itself was torn. Opening the log here would issue txids
+                # past edits this namespace never applied, silently
+                # dropping them (and wedging every standby at the gap).
+                # Abort; the failover controller retries the transition.
+                # Ref: the reference's recoverUnfinalizedSegments +
+                # catchupDuringFailover both completing before
+                # startActiveServices opens the log.
+                self.tailer.start(self.tailer.last_applied_txid)
+                self.checkpointer.start()
+                raise IOError(
+                    f"transition to active aborted: caught up only to txid "
+                    f"{self.tailer.last_applied_txid} of recovered tail "
+                    f"{last_committed}")
             last = max(last_committed, self.tailer.last_applied_txid)
             self.fsn.editlog.open_for_write(last)
             self.ha_state = ha.ACTIVE

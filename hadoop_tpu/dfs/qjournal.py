@@ -9,11 +9,18 @@ epoch on a quorum (``new_epoch``), and every journal RPC carries it — a
 deposed writer's appends are rejected, which is the split-brain guard
 (ref: Journal.checkRequest's epoch validation).
 
-Recovery on writer takeover is the simplified equivalent of the
-reference's prepare/accept protocol: collect segment states from a
-majority, adopt the longest available tail from any responder, rewrite it
-with the new epoch, and finalize (any txid acked to a client lived on a
-majority, so the max responder tail always contains it).
+Recovery on writer takeover follows the reference's prepare/accept shape
+(ref: QuorumJournalManager.recoverUnfinalizedSegments, Journal
+.prepareRecovery/.acceptRecovery): ``new_epoch`` collects each JN's tail
+state *including the writer epoch of its latest segment*; the recovering
+writer adopts the tail of the highest-epoch (then longest) responder,
+reconstructs the committed suffix by a union read that prefers
+higher-epoch record content, and then — the accept phase — rewrites every
+responding JN's unfinalized tail to exactly the adopted state (dropping
+stale in-progress segments from deposed writers) before the log opens for
+write. Any txid acked to a client lived on a majority, so the adopted
+tail always contains it; after accept, the adopted tail itself lives on a
+majority, so tailing readers can always reach it.
 
 The JournalNodes double as the failover lock service: a lease named
 ``active`` granted by a majority elects the active NameNode (the ZKFC/
@@ -55,10 +62,36 @@ class _Journal:
     def __init__(self, storage_dir: str):
         self.fjm = FileJournalManager(storage_dir)
         self._epoch_file = os.path.join(storage_dir, "epoch")
+        self._seg_epoch_file = os.path.join(storage_dir, "segment_epochs")
+        self._committed_file = os.path.join(storage_dir, "committed_txid")
         self.promised_epoch = self._load_epoch()
         self.writer_epoch = 0
+        self.segment_epochs = self._load_segment_epochs()
         self.last_txid = self._scan_last_txid()
+        self.committed_txid = self._load_committed()
         self.lock = threading.Lock()
+
+    def _load_committed(self) -> int:
+        try:
+            with open(self._committed_file) as f:
+                return int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            return 0
+
+    def update_committed(self, txid: int) -> None:
+        """Advance the known quorum-commit point (monotonic). Best-effort
+        durable like the reference's BestEffortLongFile-backed
+        committedTxnId — losing it is safe (reads just stall until the
+        next writer sync/recovery re-teaches it), an fsync per batch here
+        would double the sync cost for no correctness gain."""
+        if txid <= self.committed_txid:
+            return
+        self.committed_txid = txid
+        try:
+            with open(self._committed_file, "w") as f:
+                f.write(str(txid))
+        except OSError:
+            pass
 
     def _load_epoch(self) -> int:
         try:
@@ -76,12 +109,58 @@ class _Journal:
         os.replace(tmp, self._epoch_file)
         self.promised_epoch = epoch
 
+    def _load_segment_epochs(self) -> Dict[int, int]:
+        """first_txid → writer epoch of that segment. Ref: the per-segment
+        lastWriterEpoch the reference persists in its paxos metadata dir
+        (Journal.java PersistedRecoveryPaxosData)."""
+        try:
+            with open(self._seg_epoch_file) as f:
+                return {int(k): int(v) for k, v in
+                        (ln.split() for ln in f if ln.strip())}
+        except (OSError, ValueError):
+            return {}
+
+    def record_segment_epoch(self, first_txid: int, epoch: int) -> None:
+        self.segment_epochs[first_txid] = epoch
+        # Drop entries for segments no longer on disk.
+        firsts = {s[0] for s in self.fjm.segments()} | {first_txid}
+        self.segment_epochs = {k: v for k, v in self.segment_epochs.items()
+                               if k in firsts}
+        tmp = self._seg_epoch_file + ".tmp"
+        with open(tmp, "w") as f:
+            for k, v in sorted(self.segment_epochs.items()):
+                f.write(f"{k} {v}\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._seg_epoch_file)
+
     def _scan_last_txid(self) -> int:
         last = 0
         for rec in self.fjm.read_edits(1):
             if rec["t"] > last:
                 last = rec["t"]
         return last
+
+    def contiguous_finalized_tail(self) -> int:
+        """Highest txid C such that FINALIZED segments cover [1..C] with no
+        hole. Everything past C is replaceable during recovery's accept
+        phase (finalized data is quorum-committed; in-progress data is
+        not)."""
+        c = 0
+        for first, last, _path in self.fjm.segments():
+            if last is None:
+                continue
+            if first > c + 1:
+                break  # hole — a skipped segment this JN never received
+            c = max(c, last)
+        return c
+
+    def tail_epoch(self) -> int:
+        """Writer epoch of the latest segment on disk (0 if none)."""
+        segs = self.fjm.segments()
+        if not segs:
+            return 0
+        return self.segment_epochs.get(segs[-1][0], 0)
 
     def check_epoch(self, epoch: int) -> None:
         if epoch < self.promised_epoch:
@@ -106,8 +185,10 @@ class JournalProtocol:
             return {"promised": j.promised_epoch, "last_txid": j.last_txid}
 
     def new_epoch(self, jid: str, epoch: int) -> Dict:
-        """Promise the epoch (if newer); returns this JN's tail position.
-        Ref: Journal.newEpoch."""
+        """Promise the epoch (if newer); returns this JN's tail state —
+        last txid seen, the contiguous finalized prefix end, and the writer
+        epoch of its latest segment. Ref: Journal.newEpoch +
+        getJournalState/prepareRecovery's segment state."""
         j = self._journal(jid)
         with j.lock:
             if epoch <= j.promised_epoch:
@@ -117,7 +198,9 @@ class JournalProtocol:
             # A segment left open by the deposed writer stays on disk; the
             # recovering writer rewrites/finalizes through accept_tail.
             j.fjm.close()
-            return {"last_txid": j.last_txid}
+            return {"last_txid": j.last_txid,
+                    "ctail": j.contiguous_finalized_tail(),
+                    "tail_epoch": j.tail_epoch()}
 
     def start_segment(self, jid: str, epoch: int, first_txid: int) -> bool:
         j = self._journal(jid)
@@ -131,12 +214,65 @@ class JournalProtocol:
             if os.path.exists(p):
                 os.remove(p)
             j.fjm.start_segment(first_txid)
+            j.record_segment_epoch(first_txid, epoch)
+            return True
+
+    def accept_tail(self, jid: str, epoch: int, first_txid: int,
+                    records: bytes, count: int, last_txid: int) -> bool:
+        """Recovery accept phase (ref: Journal.acceptRecovery): replace
+        everything past this JN's committed prefix with the adopted tail.
+        Drops ALL segments at/after ``first_txid`` (stale in-progress
+        writes from deposed writers, holed finalized segments) and writes
+        the adopted records as one finalized segment stamped with the
+        recovery epoch. Idempotent: re-accepting the same tail is a no-op
+        rewrite."""
+        j = self._journal(jid)
+        with j.lock:
+            j.check_epoch(epoch)
+            j.fjm.close()
+            for first, last, path in j.fjm.segments():
+                # Drop everything past the committed prefix AND any
+                # in-progress segment wherever it starts — post-accept, a
+                # JN holds only finalized, adopted data.
+                if first >= first_txid or last is None:
+                    os.remove(path)
+                    j.segment_epochs.pop(first, None)
+            if last_txid >= first_txid:
+                if count != last_txid - first_txid + 1:
+                    raise IOError(
+                        f"accept_tail record count {count} does not cover "
+                        f"[{first_txid}, {last_txid}]")
+                j.fjm.start_segment(first_txid)
+                j.fjm.journal(records, first_txid, count)
+                j.fjm.sync()
+                j.fjm.finalize_segment(first_txid, last_txid)
+                j.record_segment_epoch(first_txid, epoch)
+            j.last_txid = j._scan_last_txid()
+            # NOTE: committed_txid is deliberately NOT advanced here — the
+            # adopted tail is only committed once a MAJORITY has accepted
+            # it. The writer teaches the commit point via commit_point()
+            # after its accept round succeeds (a lone accepted JN must not
+            # feed still-uncommitted txids to tailers through the commit
+            # gate if the rest of the round tears).
+            return True
+
+    def commit_point(self, jid: str, epoch: int, txid: int) -> bool:
+        """Writer-taught quorum commit point (ref: the committedTxnId
+        piggyback; sent explicitly after recovery's accept round and after
+        quorum-acked syncs)."""
+        j = self._journal(jid)
+        with j.lock:
+            j.check_epoch(epoch)
+            j.update_committed(txid)
             return True
 
     def journal(self, jid: str, epoch: int, records: bytes,
-                first_txid: int, count: int, last_txid: int) -> bool:
+                first_txid: int, count: int, last_txid: int,
+                committed_txid: int = 0) -> bool:
         """Append + fsync one batch. The JN always syncs — quorum ack means
-        durable on a majority (ref: Journal.journal's sync)."""
+        durable on a majority (ref: Journal.journal's sync). The writer
+        piggybacks its commit point (highest quorum-acked txid) the way
+        the reference piggybacks committedTxnId on every journal RPC."""
         j = self._journal(jid)
         with j.lock:
             j.check_epoch(epoch)
@@ -144,6 +280,7 @@ class JournalProtocol:
             j.fjm.sync()
             if last_txid > j.last_txid:
                 j.last_txid = last_txid
+            j.update_committed(committed_txid)
             return True
 
     def finalize_segment(self, jid: str, epoch: int, first_txid: int,
@@ -152,6 +289,8 @@ class JournalProtocol:
         with j.lock:
             j.check_epoch(epoch)
             j.fjm.finalize_segment(first_txid, last_txid)
+            # A writer only finalizes a fully quorum-synced segment.
+            j.update_committed(last_txid)
             return True
 
     def discard_inprogress(self, jid: str, epoch: int,
@@ -167,22 +306,40 @@ class JournalProtocol:
 
     @idempotent
     def get_edits(self, jid: str, from_txid: int,
-                  max_count: int = 50_000) -> List[Dict]:
+                  max_count: int = 50_000) -> Dict:
         """Serve edits for standby tailing / recovery (ref:
-        Journal.getJournaledEdits + JournaledEditsCache)."""
+        Journal.getJournaledEdits + JournaledEditsCache). Returns
+        ``{"records": [...], "committed": <this JN's known commit point>}``.
+        Each record is annotated with ``"_e"`` — the writer epoch of the
+        segment it came from — so quorum readers can prefer the newest
+        writer's content for a txid over a deposed writer's stale copy
+        (the role the reference's per-segment lastWriterEpoch plays in
+        recovery). Tailing readers must additionally gate on ``committed``
+        — records past the quorum commit point may be uncommitted
+        proposals (recovery reads them; tailers must not apply them)."""
         j = self._journal(jid)
-        out: List[Dict] = []
-        seen = set()
-        for rec in j.fjm.read_edits(from_txid):
-            # A retried quorum batch may have appended a txid twice —
-            # first write wins, duplicates are skipped.
-            if rec["t"] in seen:
+        from hadoop_tpu.dfs.namenode.editlog import _read_segment_file
+        best: Dict[int, Dict] = {}
+        for first, last, path in j.fjm.segments():
+            if last is not None and last < from_txid:
                 continue
-            seen.add(rec["t"])
-            out.append(rec)
-            if len(out) >= max_count:
-                break
-        return out
+            if len(best) >= max_count and first > max(best):
+                break  # later segments only add txids past the cap window
+            epoch = j.segment_epochs.get(first, 0)
+            for rec in _read_segment_file(path, from_txid):
+                t = rec["t"]
+                # The same txid can exist twice on one JN: a retried quorum
+                # batch re-appended it (same content — writers are single-
+                # stream, so same-epoch copies are identical), or a stale
+                # segment from a deposed writer overlaps a newer one
+                # (divergent content). Higher segment epoch wins.
+                cur = best.get(t)
+                if cur is None or epoch > cur["_e"]:
+                    rec = dict(rec)
+                    rec["_e"] = epoch
+                    best[t] = rec
+        return {"records": [best[t] for t in sorted(best)[:max_count]],
+                "committed": j.committed_txid}
 
     # ------------------------------------------------- active-lease service
 
@@ -286,6 +443,8 @@ class QuorumJournalManager(JournalManager):
         self._buf_first: Optional[int] = None
         self._buf_count = 0
         self._buf_last = 0
+        self._committed = 0         # highest quorum-acked txid
+        self._fetch_batch = 50_000  # per-get_edits cap (tests shrink it)
 
     @property
     def majority(self) -> int:
@@ -329,48 +488,150 @@ class QuorumJournalManager(JournalManager):
     def recover(self) -> int:
         """Fence prior writers and repair the shared log; returns the last
         committed txid. Ref: QuorumJournalManager.recoverUnfinalizedSegments
-        (prepare/accept collapsed onto adopt-the-longest-available-tail)."""
+        (prepareRecovery/acceptRecovery).
+
+        Three phases:
+        1. **Prepare** — ``new_epoch`` on a quorum fences older writers and
+           collects each responder's tail state (last txid, contiguous
+           finalized prefix, tail-segment writer epoch).
+        2. **Adopt** — the tail of the responder whose latest segment has
+           the highest writer epoch (ties: longest) is the recovered log.
+           Its content for [min_ctail+1 .. last] is reconstructed by a
+           union read over all responders, preferring the record written
+           at the highest epoch for each txid (a lone stale copy from a
+           deposed writer always loses to the rewrite that superseded it).
+        3. **Accept** — every responding JN's unfinalized tail is rewritten
+           to exactly the adopted records and finalized; stale in-progress
+           segments are dropped. This must succeed on a majority, which
+           guarantees later quorum reads can serve the whole adopted tail
+           even if the original best responder dies.
+        """
         states = self._quorum("get_state", self.jid)
         max_promised = max(r["promised"] for _, r in states)
         self.epoch = max_promised + 1
         acks = self._quorum("new_epoch", self.jid, self.epoch)
-        # The longest tail among the promising majority contains every
-        # committed txn (each was acked by a majority).
-        best_i, best = max(acks, key=lambda t: t[1]["last_txid"])
+        best_i, best = max(
+            acks, key=lambda t: (t[1]["tail_epoch"], t[1]["last_txid"]))
         last = best["last_txid"]
         self._last_txid = last
         self._seen_txid = last
         if last > 0:
-            self._sync_laggards(best_i, acks, last)
+            self._accept_phase(best_i, acks, last)
+        self._committed = last
         return last
 
-    def _sync_laggards(self, best_i: int, acks, last: int) -> None:
-        """Bring lagging JNs up to the recovered tail by replaying edits
-        from the most advanced one (ref: JournalNodeSyncer, collapsed into
-        writer-driven recovery)."""
-        from hadoop_tpu.io.wire import pack
+    def _fetch_edits(self, proxy, from_txid: int, through: int) -> List[Dict]:
+        """Fetch [from_txid..through] from one JN, looping past the
+        per-call cap. Stops early if the JN has a gap/short tail."""
+        out: List[Dict] = []
+        nxt = from_txid
+        while nxt <= through:
+            batch = proxy.get_edits(self.jid, nxt, self._fetch_batch)
+            batch = [r for r in batch["records"]
+                     if nxt <= r["t"] <= through]
+            if not batch:
+                break
+            out.extend(batch)
+            top = max(r["t"] for r in batch)
+            if top < nxt:  # defensive: no forward progress
+                break
+            nxt = top + 1
+        return out
+
+    def _accept_phase(self, best_i: int, acks, last: int) -> None:
+        """Rewrite every responder's unfinalized tail to the adopted log
+        (ref: Journal.acceptRecovery + JournalNodeSyncer, driven by the
+        recovering writer). Raises unless a majority accepted — a torn
+        accept would let a later reader observe a tail the quorum cannot
+        serve.
+
+        The adopted content for each txid is the highest-writer-epoch copy
+        among responders (a deposed writer's stale copy always loses to
+        the rewrite that superseded it; same-epoch copies are identical
+        because a writer is single-stream). Fetching is two-phase to keep
+        a fresh/empty JN from forcing a full-log pull from everyone: the
+        optimistic pass reads the full suffix only from the adopted best
+        responder and just each responder's own unfinalized tail from the
+        rest; if that leaves holes (the best responder itself had a gap),
+        a full-range pass over all responders fills them before giving up."""
         import struct as _struct
+        from hadoop_tpu.io.wire import pack
+        min_ctail = min(st["ctail"] for _i, st in acks)
+
+        def merge(into: Dict[int, Dict], recs: List[Dict]) -> None:
+            for rec in recs:
+                cur = into.get(rec["t"])
+                if cur is None or rec.get("_e", 0) > cur.get("_e", 0):
+                    into[rec["t"]] = rec
+
+        union: Dict[int, Dict] = {}
+        best_recs: List[Dict] = []
         for i, st in acks:
-            if i == best_i or st["last_txid"] >= last:
-                continue
-            frm = st["last_txid"] + 1
+            frm = min_ctail + 1 if i == best_i else st["ctail"] + 1
             try:
-                edits = self._proxies[best_i].get_edits(self.jid, frm)
-                if not edits:
+                recs = self._fetch_edits(self._proxies[i], frm, last)
+            except Exception as e:
+                # Abort, don't degrade: every ack-er is a potential sole
+                # holder of a committed txid's adopted-content copy. If
+                # its read fails, a lower-epoch stale copy from another
+                # responder could silently win the union and be rewritten
+                # onto the quorum — destroying a client-acked edit. The
+                # failover controller retries recovery from scratch.
+                raise IOError(
+                    f"recovery union read from JN {self.addrs[i]} failed: "
+                    f"{e}") from e
+            if i == best_i:
+                best_recs = recs
+            merge(union, recs)
+        # Any txid the best responder itself could not supply (a hole in
+        # its log) must be re-sought across every responder's FULL range:
+        # its committed copy may sit in another JN's finalized prefix,
+        # outside the restricted tail range fetched above, and a stale
+        # unfinalized copy must not win the union unopposed.
+        best_has = {r["t"] for r in best_recs}
+        if any(t not in best_has for t in range(min_ctail + 1, last + 1)):
+            for i, _st in acks:
+                if i == best_i:
                     continue
-                blob = bytearray()
-                for rec in edits:
-                    data = pack(rec)
-                    blob += _struct.pack(">I", len(data)) + data
-                p = self._proxies[i]
-                p.start_segment(self.jid, self.epoch, frm)
-                p.journal(self.jid, self.epoch, bytes(blob), frm,
-                          len(edits), last)
-                p.finalize_segment(self.jid, self.epoch, frm, last)
-                log.info("Synced laggard JN %s to txid %d", self.addrs[i],
-                         last)
-            except Exception as e:  # noqa: BLE001 — laggard stays lagging
-                log.warning("Could not sync JN %s: %s", self.addrs[i], e)
+                merge(union, self._fetch_edits(
+                    self._proxies[i], min_ctail + 1, last))
+        missing = [t for t in range(min_ctail + 1, last + 1)
+                   if t not in union]
+        if missing:
+            raise IOError(
+                f"recovery cannot reconstruct txids {missing[:10]}"
+                f"{'...' if len(missing) > 10 else ''} of adopted tail "
+                f"[{min_ctail + 1}..{last}] — refusing to adopt a log "
+                f"with holes")
+        # Pack each record once; per-JN blobs are suffix joins.
+        frames: Dict[int, bytes] = {}
+        for t in range(min_ctail + 1, last + 1):
+            rec = {k: v for k, v in union[t].items() if k != "_e"}
+            data = pack(rec)
+            frames[t] = _struct.pack(">I", len(data)) + data
+        ok = 0
+        for i, st in acks:
+            frm = st["ctail"] + 1
+            try:
+                blob = b"".join(frames[t] for t in range(frm, last + 1))
+                self._proxies[i].accept_tail(
+                    self.jid, self.epoch, frm, blob, last - frm + 1, last)
+                ok += 1
+            except Exception as e:  # noqa: BLE001 — majority math below
+                log.warning("Recovery accept on JN %s failed: %s",
+                            self.addrs[i], e)
+        if ok < self.majority:
+            raise IOError(
+                f"recovery accept reached only {ok}/{len(self.addrs)} "
+                f"journals (need {self.majority})")
+        # A majority holds the adopted tail — it is now committed. Teach
+        # the commit point (best-effort: the same-epoch-majority read rule
+        # already covers responders this misses).
+        for i, r in self._call_all("commit_point", self.jid, self.epoch,
+                                   last):
+            if isinstance(r, Exception):
+                log.debug("commit_point to JN %s failed: %s",
+                          self.addrs[i], r)
 
     # --------------------------------------------------- JournalManager API
 
@@ -396,8 +657,13 @@ class QuorumJournalManager(JournalManager):
         if not self._buf:
             return
         self._quorum("journal", self.jid, self.epoch, bytes(self._buf),
-                     self._buf_first, self._buf_count, self._buf_last)
+                     self._buf_first, self._buf_count, self._buf_last,
+                     self._committed)
         self._last_txid = max(self._last_txid, self._buf_last)
+        # Quorum ack ⇒ this batch is committed; the commit point rides
+        # the NEXT journal/finalize RPC to the JNs (ref: the piggybacked
+        # committedTxnId in QJournalProtocol requests).
+        self._committed = max(self._committed, self._buf_last)
         self._buf = bytearray()
         self._buf_first = None
         self._buf_count = 0
@@ -417,21 +683,40 @@ class QuorumJournalManager(JournalManager):
         lone JN may be an abandoned write from a dead deposed writer —
         replaying it would diverge the tailer from what recovery keeps
         (ref: the committed-txn filter in getJournaledEdits / the
-        maxSeenTxId vs committedTxnId distinction)."""
+        maxSeenTxId vs committedTxnId distinction).
+
+        A txid is served when EITHER (a) it is at or below the quorum
+        commit point some responder reports (the writer piggybacks it;
+        recovery's accept stamps it), or (b) a majority of responders hold
+        it *at the chosen epoch* — durable on a majority is the commit
+        criterion, and counting only same-epoch copies keeps a deposed
+        writer's stale record from teaming up with an unrelated newer copy
+        to fake a majority. Content is always the highest-segment-epoch
+        copy: a JN that slept through a recovery and resurfaced with a
+        divergent record cannot shadow the quorum's adopted copy (ref: the
+        acceptRecovery rewrite that prevents this on-disk; this is the
+        read-side belt to that suspender)."""
         results = self._call_all("get_edits", self.jid, from_txid)
-        holders: Dict[int, int] = {}     # txid → #JNs holding it
+        holders: Dict[int, int] = {}  # txid → #copies at the chosen epoch
         records: Dict[int, Dict] = {}
+        committed = 0
         for _, r in results:
-            if not isinstance(r, list):
+            if not isinstance(r, dict):
                 continue
-            for rec in r:
+            committed = max(committed, r.get("committed", 0))
+            for rec in r["records"]:
                 t = rec["t"]
-                holders[t] = holders.get(t, 0) + 1
-                records.setdefault(t, rec)
+                cur = records.get(t)
+                if cur is None or rec.get("_e", 0) > cur.get("_e", 0):
+                    records[t] = rec
+                    holders[t] = 1
+                elif rec.get("_e", 0) == cur.get("_e", 0):
+                    holders[t] += 1
         # Contiguous committed prefix from from_txid.
         t = from_txid
-        while holders.get(t, 0) >= self.majority:
-            yield records[t]
+        while t in records and (t <= committed or
+                                holders.get(t, 0) >= self.majority):
+            yield {k: v for k, v in records[t].items() if k != "_e"}
             t += 1
 
     # seen_txid: QJM tracks it in memory; the authoritative value for

@@ -41,7 +41,9 @@ class Trash:
         if not path:
             raise ValueError("cannot trash /")
         root = self._trash_root()
-        if path.startswith(root):
+        # Component-wise containment: /u/a/.TrashOld is a sibling of the
+        # trash root /u/a/.Trash, not inside it.
+        if path == root or path.startswith(root + "/"):
             raise ValueError(f"{path} is already in the trash")
         target = f"{root}/Current{path}"
         parent = target.rsplit("/", 1)[0]

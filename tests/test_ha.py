@@ -92,6 +92,172 @@ def test_recovery_survives_one_jn_down(jns):
     w2.close()
 
 
+def _blob(recs):
+    import struct
+    from hadoop_tpu.io.wire import pack
+    out = bytearray()
+    for r in recs:
+        data = pack(r)
+        out += struct.pack(">I", len(data)) + data
+    return bytes(out)
+
+
+def test_recovery_syncs_laggard_past_fetch_cap(jns, tmp_path):
+    """A JN lagging by more records than one get_edits call can carry must
+    be fully caught up — never given a finalized segment with holes (ref:
+    JournalNodeSyncer transfers whole segments; regression for the
+    partial-sync-then-finalize bug)."""
+    conf = fast_conf()
+    qjm = QuorumJournalManager(_addrs(jns))
+    qjm.recover()
+    qjm.start_segment(1)
+    _write(qjm, 1, [{"t": t, "op": "mkdir", "p": f"/d{t}"}
+                    for t in range(1, 4)])
+    jns[2].stop()
+    _write(qjm, 4, [{"t": t, "op": "mkdir", "p": f"/d{t}"}
+                    for t in range(4, 121)])
+    qjm.close()
+    # Restart the laggard (same storage, fresh port).
+    jn2 = JournalNode(conf, storage_dir=jns[2].storage_dir)
+    jn2.init(conf)
+    jn2.start()
+    try:
+        addrs = _addrs(jns[:2]) + [("127.0.0.1", jn2.port)]
+        w2 = QuorumJournalManager(addrs)
+        w2._fetch_batch = 10   # force many fetch round-trips
+        assert w2.recover() == 120
+        w2.close()
+        # The laggard itself must now hold every txid, contiguously.
+        got = [r["t"] for r in jn2.get_journal("ns").fjm.read_edits(1)]
+        assert got == list(range(1, 121))
+        # And the quorum must be able to serve the whole tail even with
+        # the most advanced original JN gone.
+        jns[0].stop()
+        reader = QuorumJournalManager(
+            [("127.0.0.1", jns[1].port), ("127.0.0.1", jn2.port)])
+        assert [r["t"] for r in reader.read_edits(1)] == list(range(1, 121))
+        reader.close()
+    finally:
+        jn2.stop()
+
+
+def test_stale_divergent_record_cannot_shadow_quorum(jns):
+    """A JN that slept through a recovery and kept a deposed writer's
+    divergent record for a txid must not have its copy served to tailers
+    over the quorum's adopted copy (ref: acceptRecovery's rewrite; the
+    read path prefers the highest segment epoch)."""
+    from hadoop_tpu.dfs.qjournal import JournalProtocol
+    conf = fast_conf()
+    w1 = QuorumJournalManager(_addrs(jns))
+    w1.recover()
+    w1.start_segment(1)
+    _write(w1, 1, [{"t": 1, "op": "mkdir", "p": "/a"}])
+    # The deposed writer got txid 2 onto ONE journal only (no quorum ack).
+    JournalProtocol(jns[2]).journal(
+        "ns", w1.epoch, _blob([{"t": 2, "op": "mkdir", "p": "/stale"}]),
+        2, 1, 2)
+    jns[2].stop()
+    # New writer recovers without that JN and rewrites txid 2.
+    w2 = QuorumJournalManager(_addrs(jns[:2]) + [("127.0.0.1", 1)])
+    assert w2.recover() == 1
+    w2.start_segment(2)
+    _write(w2, 2, [{"t": 2, "op": "mkdir", "p": "/new"}])
+    w2.close()
+    w1.close()
+    # The stale JN resurfaces; a tailer reading the quorum must see the
+    # adopted content for txid 2, not the deposed writer's.
+    jn2 = JournalNode(conf, storage_dir=jns[2].storage_dir)
+    jn2.init(conf)
+    jn2.start()
+    try:
+        reader = QuorumJournalManager(
+            _addrs(jns[:2]) + [("127.0.0.1", jn2.port)])
+        got = list(reader.read_edits(1))
+        assert [r["t"] for r in got] == [1, 2]
+        assert got[1]["p"] == "/new"
+        assert "_e" not in got[1]
+        reader.close()
+    finally:
+        jn2.stop()
+
+
+def test_uncommitted_mixed_epoch_copies_do_not_fake_quorum(jns):
+    """A lone newest-epoch proposal plus an unrelated stale-epoch copy of
+    the same txid must not count as a served majority: tailers apply a
+    txid only when it is at/below the piggybacked commit point or a
+    majority holds it AT the same epoch (ref: committedTxnId gating in
+    getJournaledEdits)."""
+    from hadoop_tpu.dfs.qjournal import JournalProtocol
+    w1 = QuorumJournalManager(_addrs(jns))
+    w1.recover()
+    w1.start_segment(1)
+    _write(w1, 1, [{"t": 1, "op": "mkdir", "p": "/a"}])
+    # Deposed writer leaves an uncommitted txid 2 on jn2 only, and jn2
+    # then sleeps through the next recovery.
+    JournalProtocol(jns[2]).journal(
+        "ns", w1.epoch, _blob([{"t": 2, "op": "mkdir", "p": "/stale"}]),
+        2, 1, 2)
+    jns[2].stop()
+    w1.close()
+    # New writer recovers without jn2 (adopts tail=1), then dies after
+    # landing its own txid 2 on ONE journal without a quorum ack.
+    conf = fast_conf()
+    w2 = QuorumJournalManager(_addrs(jns[:2]) + [("127.0.0.1", 1)])
+    assert w2.recover() == 1
+    w2.start_segment(2)
+    JournalProtocol(jns[0]).journal(
+        "ns", w2.epoch, _blob([{"t": 2, "op": "mkdir", "p": "/new"}]),
+        2, 1, 2)
+    w2.close()
+    # jn2 resurfaces with its stale copy.
+    jn2 = JournalNode(conf, storage_dir=jns[2].storage_dir)
+    jn2.init(conf)
+    jn2.start()
+    addrs = _addrs(jns[:2]) + [("127.0.0.1", jn2.port)]
+    try:
+        # Tailers must stop at txid 1: txid 2 has one copy at epoch 2 and
+        # one stale copy at epoch 1 — no same-epoch majority, no commit
+        # point covering it.
+        reader = QuorumJournalManager(addrs)
+        assert [r["t"] for r in reader.read_edits(1)] == [1]
+        reader.close()
+        # The next recovery adopts the newest-epoch proposal; only then is
+        # txid 2 committed and served — with the adopted content.
+        w3 = QuorumJournalManager(addrs)
+        assert w3.recover() == 2
+        got = list(w3.read_edits(1))
+        assert [r["t"] for r in got] == [1, 2]
+        assert got[1]["p"] == "/new"
+        w3.close()
+    finally:
+        jn2.stop()
+
+
+def test_recovery_refuses_tail_with_holes(jns):
+    """If the adopted tail cannot be fully reconstructed from responders,
+    recovery must fail rather than adopt a log with missing txids (ref:
+    the reference never finalizes a segment it hasn't fully transferred)."""
+    from hadoop_tpu.dfs.qjournal import JournalProtocol
+    w1 = QuorumJournalManager(_addrs(jns))
+    w1.recover()
+    w1.start_segment(1)
+    _write(w1, 1, [{"t": t, "op": "mkdir", "p": f"/d{t}"}
+                   for t in (1, 2, 3)])
+    w1.finalize_segment(1, 3)
+    # One journal gets a later segment with a hole before it (txids 4..7
+    # never landed anywhere).
+    p0 = JournalProtocol(jns[0])
+    p0.start_segment("ns", w1.epoch, 8)
+    p0.journal("ns", w1.epoch,
+               _blob([{"t": t, "op": "mkdir", "p": f"/d{t}"}
+                      for t in (8, 9, 10)]), 8, 3, 10)
+    w1.close()
+    w2 = QuorumJournalManager(_addrs(jns))
+    with pytest.raises(IOError):
+        w2.recover()
+    w2.close()
+
+
 def test_quorum_lease_single_winner(jns):
     a = QuorumLease(_addrs(jns), holder="nn1", ttl_s=2.0)
     b = QuorumLease(_addrs(jns), holder="nn2", ttl_s=2.0)

@@ -182,6 +182,22 @@ def test_trash_move_and_expunge(fs):
         fs.get_file_status(loc)
 
 
+def test_trash_sibling_of_root_is_trashable(fs):
+    """A path sharing the trash root's name as a string prefix but NOT a
+    component prefix (/user/u/.TrashOld vs /user/u/.Trash) must be
+    movable to trash (ref: TrashPolicyDefault's path containment check)."""
+    trash = Trash(fs, interval_s=3600.0)
+    root = trash._trash_root()
+    sibling = root + "Old"
+    _write(fs, sibling + "/f.txt", b"x")
+    loc = trash.move_to_trash(sibling)
+    assert "/.Trash/Current" in loc
+    # And the root itself still refuses.
+    fs.mkdirs(root + "/Current")
+    with pytest.raises(ValueError):
+        trash.move_to_trash(root)
+
+
 # --------------------------------------------------------- concat/truncate
 
 def test_concat_merges_blocks(fs):
