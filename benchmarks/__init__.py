@@ -1,0 +1,16 @@
+"""Storage/compute benchmark harnesses.
+
+The reference publishes no numbers in-tree; what it ships is harnesses
+(SURVEY.md §6). These are their counterparts, each a runnable one-liner
+printing ONE JSON line:
+
+  python -m benchmarks.nn_throughput   — namespace ops/sec per op type
+      (ref: hadoop-hdfs src/test .../namenode/NNThroughputBenchmark.java)
+  python -m benchmarks.dfsio           — DFS write/read MB/s
+      (ref: hadoop-mapreduce-client-jobclient src/test .../fs/TestDFSIO.java)
+  python -m benchmarks.terasort_bench  — end-to-end sort bytes/sec
+      (ref: hadoop-mapreduce-examples .../terasort/TeraSort.java)
+  python -m benchmarks.rpc_bench       — RPC calls/sec
+      (ref: hadoop-common src/test .../ipc/RPCCallBenchmark.java)
+  python -m benchmarks.run_all         — all four → STORAGE_BENCH.json
+"""

@@ -1,0 +1,81 @@
+"""DFS stream throughput: aggregate write/read MB/s through a minicluster.
+
+Counterpart of the reference's TestDFSIO (ref:
+hadoop-mapreduce-client-jobclient/src/test/java/org/apache/hadoop/fs/
+TestDFSIO.java), shrunk to the in-process minicluster the way its own
+unit mode runs: N client threads each write then read a file through the
+full DFS path — NameNode block allocation, the replication pipeline
+across DataNode xceivers, CRC per packet — and the aggregate MB/s is
+reported.
+
+  python -m benchmarks.dfsio [--files 4] [--mb 16] [--replication 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+
+def run(n_files: int = 4, mb_per_file: int = 16, replication: int = 2,
+        num_datanodes: int = 3) -> dict:
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster
+
+    payload = os.urandom(1024 * 1024)
+    cluster = MiniDFSCluster(num_datanodes=num_datanodes)
+    cluster.start()
+    try:
+        cluster.conf.set("dfs.replication", str(replication))
+        fs = cluster.get_filesystem()
+
+        def write_one(i: int) -> None:
+            with fs.create(f"/dfsio/in_{i}") as f:
+                for _ in range(mb_per_file):
+                    f.write(payload)
+
+        def read_one(i: int) -> int:
+            total = 0
+            with fs.open(f"/dfsio/in_{i}") as f:
+                while True:
+                    chunk = f.read(4 * 1024 * 1024)
+                    if not chunk:
+                        return total
+                    total += len(chunk)
+
+        pool = ThreadPoolExecutor(max_workers=n_files)
+        t0 = time.perf_counter()
+        list(pool.map(write_one, range(n_files)))
+        write_dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        sizes = list(pool.map(read_one, range(n_files)))
+        read_dt = time.perf_counter() - t0
+        pool.shutdown()
+        assert all(s == mb_per_file * 1024 * 1024 for s in sizes), sizes
+        total_mb = n_files * mb_per_file
+        return {"write_mb_s": round(total_mb / write_dt, 1),
+                "read_mb_s": round(total_mb / read_dt, 1),
+                "total_mb": total_mb}
+    finally:
+        cluster.shutdown()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--files", type=int, default=4)
+    ap.add_argument("--mb", type=int, default=16)
+    ap.add_argument("--replication", type=int, default=2)
+    args = ap.parse_args()
+    r = run(args.files, args.mb, args.replication)
+    print(json.dumps({
+        "metric": "dfsio_throughput", "value": r["write_mb_s"],
+        "unit": "write MB/s", **r,
+        "files": args.files, "mb_per_file": args.mb,
+        "replication": args.replication,
+    }))
+
+
+if __name__ == "__main__":
+    main()

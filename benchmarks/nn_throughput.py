@@ -1,0 +1,87 @@
+"""NameNode metadata throughput: ops/sec per op type.
+
+Counterpart of the reference's NNThroughputBenchmark (ref: hadoop-hdfs
+src/test/java/.../server/namenode/NNThroughputBenchmark.java): drives the
+NameNode's RPC-facing protocol object IN-PROCESS (no network) with many
+client threads, so the number measured is the namesystem's own op rate —
+lock discipline, edit-log group commit, and retry-cache included.
+
+  python -m benchmarks.nn_throughput [--ops 5000] [--threads 16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import tempfile
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+
+def _rate(fn, n_ops: int, threads: int) -> float:
+    """Run fn(i) for i in range(n_ops) across threads; return ops/sec."""
+    pool = ThreadPoolExecutor(max_workers=threads)
+    t0 = time.perf_counter()
+    list(pool.map(fn, range(n_ops), chunksize=max(1, n_ops // threads // 4)))
+    dt = time.perf_counter() - t0
+    pool.shutdown()
+    return n_ops / dt
+
+
+def run(n_ops: int = 5000, threads: int = 16) -> dict:
+    from hadoop_tpu.conf import Configuration
+    from hadoop_tpu.dfs.namenode.namenode import ClientProtocol, NameNode
+    from hadoop_tpu.testing.minicluster import fast_conf
+
+    base = tempfile.mkdtemp(prefix="htpu-nnbench-")
+    conf = fast_conf()
+    conf.set("dfs.namenode.safemode.threshold-pct", "0")
+    nn = NameNode(Configuration(other=conf), name_dir=base + "/name")
+    nn.init(conf)
+    nn.start()
+    proto = ClientProtocol(nn.fsn, nn.retry_cache, nn)
+    results = {}
+    try:
+        results["mkdirs"] = _rate(
+            lambda i: proto.mkdirs(f"/bench/dirs/{i % 100}/{i}"),
+            n_ops, threads)
+        def create(i):
+            p = f"/bench/files/{i % 100}/f{i}"
+            proto.create(p, client_name=f"bench-{i % threads}")
+            proto.complete(p, client_name=f"bench-{i % threads}")
+        results["create"] = _rate(create, n_ops, threads)
+        results["open"] = _rate(
+            lambda i: proto.get_block_locations(
+                f"/bench/files/{i % 100}/f{i}"), n_ops, threads)
+        results["fileinfo"] = _rate(
+            lambda i: proto.get_file_info(f"/bench/files/{i % 100}/f{i}"),
+            n_ops, threads)
+        results["rename"] = _rate(
+            lambda i: proto.rename(f"/bench/files/{i % 100}/f{i}",
+                                   f"/bench/files/{i % 100}/r{i}"),
+            n_ops, threads)
+        results["delete"] = _rate(
+            lambda i: proto.delete(f"/bench/files/{i % 100}/r{i}"),
+            n_ops, threads)
+    finally:
+        nn.stop()
+        shutil.rmtree(base, ignore_errors=True)
+    return {k: round(v, 1) for k, v in results.items()}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", type=int, default=5000)
+    ap.add_argument("--threads", type=int, default=16)
+    args = ap.parse_args()
+    ops = run(args.ops, args.threads)
+    print(json.dumps({
+        "metric": "nn_throughput_ops_per_sec", "value": ops["create"],
+        "unit": "create ops/s", "per_op": ops,
+        "n_ops": args.ops, "threads": args.threads,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""RPC calls/sec: client/server thread scaling over the real wire path.
+
+Counterpart of the reference's RPCCallBenchmark (ref: hadoop-common
+src/test/java/org/apache/hadoop/ipc/RPCCallBenchmark.java): a server with
+H handlers, C client threads each hammering a trivial echo method over
+real TCP connections — measures the Listener→Reader→CallQueue→Handler→
+Responder reactor end to end.
+
+  python -m benchmarks.rpc_bench [--seconds 5] [--client-threads 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+
+class BenchProtocol:
+    def ping(self, x: int) -> int:
+        return x + 1
+
+    def payload(self, data: bytes) -> int:
+        return len(data)
+
+
+def run(seconds: float = 5.0, client_threads: int = 8,
+        handlers: int = 8, payload_kb: int = 0) -> dict:
+    from hadoop_tpu.ipc import Client, Server, get_proxy
+
+    srv = Server(num_handlers=handlers, name="rpcbench")
+    srv.register_protocol("BenchProtocol", BenchProtocol())
+    srv.start()
+    stop = threading.Event()
+    counts = [0] * client_threads
+    clients = [Client() for _ in range(client_threads)]
+    blob = b"x" * (payload_kb * 1024)
+
+    def worker(idx: int) -> None:
+        proxy = get_proxy("BenchProtocol", ("127.0.0.1", srv.port),
+                          client=clients[idx])
+        n = 0
+        if payload_kb:
+            while not stop.is_set():
+                proxy.payload(blob)
+                n += 1
+        else:
+            while not stop.is_set():
+                proxy.ping(n)
+                n += 1
+        counts[idx] = n
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(client_threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    dt = time.perf_counter() - t0
+    for c in clients:
+        c.stop()
+    srv.stop()
+    total = sum(counts)
+    return {"calls_per_sec": round(total / dt, 1), "total_calls": total,
+            "client_threads": client_threads, "handlers": handlers}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=5.0)
+    ap.add_argument("--client-threads", type=int, default=8)
+    ap.add_argument("--handlers", type=int, default=8)
+    ap.add_argument("--payload-kb", type=int, default=0)
+    args = ap.parse_args()
+    r = run(args.seconds, args.client_threads, args.handlers,
+            args.payload_kb)
+    print(json.dumps({
+        "metric": "rpc_calls_per_sec", "value": r["calls_per_sec"],
+        "unit": "calls/s", **r,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""Run every storage/compute benchmark and record STORAGE_BENCH.json.
+
+  python -m benchmarks.run_all [--out STORAGE_BENCH.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import platform
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="STORAGE_BENCH.json")
+    ap.add_argument("--quick", action="store_true",
+                    help="smaller sizes for smoke runs")
+    args = ap.parse_args()
+
+    from benchmarks import dfsio, nn_throughput, rpc_bench, terasort_bench
+
+    scale = 0.2 if args.quick else 1.0
+    out = {"timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+           "host": platform.node()}
+    t0 = time.perf_counter()
+    out["nn_throughput_ops_per_sec"] = nn_throughput.run(
+        n_ops=int(5000 * scale))
+    out["rpc"] = rpc_bench.run(seconds=5.0 * scale)
+    out["dfsio"] = dfsio.run(n_files=4, mb_per_file=int(16 * scale) or 2)
+    out["terasort"] = terasort_bench.run(records=int(200_000 * scale))
+    out["wall_seconds"] = round(time.perf_counter() - t0, 1)
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""End-to-end TeraSort rate: bytes/sec through the full MR-on-YARN stack.
+
+Counterpart of the canonical reference benchmark (ref:
+hadoop-mapreduce-examples/src/main/java/org/apache/hadoop/examples/
+terasort/TeraSort.java + TeraGen/TeraValidate): generate N records,
+sort them through map → shuffle → reduce on the minicluster, validate
+global order, report sorted bytes/sec.
+
+  python -m benchmarks.terasort_bench [--records 200000] [--nodes 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+RECORD_LEN = 100
+
+
+def run(records: int = 200_000, nodes: int = 3, reduces: int = 3) -> dict:
+    from hadoop_tpu.examples.terasort import (make_terasort_job, teragen,
+                                              teravalidate)
+    from hadoop_tpu.testing.minicluster import MiniMRYarnCluster
+
+    cluster = MiniMRYarnCluster(num_nodes=nodes)
+    cluster.start()
+    try:
+        fs = cluster.get_filesystem()
+        t0 = time.perf_counter()
+        teragen(fs, "/tera/in", records, num_files=nodes)
+        gen_dt = time.perf_counter() - t0
+
+        job = make_terasort_job(cluster.rm_addr, cluster.default_fs,
+                                "/tera/in", "/tera/out",
+                                num_reduces=reduces)
+        t0 = time.perf_counter()
+        ok = job.wait_for_completion()
+        sort_dt = time.perf_counter() - t0
+        if not ok:
+            raise RuntimeError("terasort job failed")
+
+        checked, errors = teravalidate(fs, "/tera/out")
+        if errors:
+            raise RuntimeError(f"teravalidate: {errors[:3]}")
+        total_bytes = records * RECORD_LEN
+        return {"sort_bytes_per_sec": round(total_bytes / sort_dt, 1),
+                "gen_bytes_per_sec": round(total_bytes / gen_dt, 1),
+                "records": records, "validated": checked,
+                "sort_seconds": round(sort_dt, 2)}
+    finally:
+        cluster.shutdown()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--records", type=int, default=200_000)
+    ap.add_argument("--nodes", type=int, default=3)
+    ap.add_argument("--reduces", type=int, default=3)
+    args = ap.parse_args()
+    r = run(args.records, args.nodes, args.reduces)
+    print(json.dumps({
+        "metric": "terasort_rate", "value": r["sort_bytes_per_sec"],
+        "unit": "bytes/s", **r,
+    }))
+
+
+if __name__ == "__main__":
+    main()

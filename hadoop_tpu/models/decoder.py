@@ -274,27 +274,46 @@ def embed_tokens(params, tokens, cfg: ModelConfig, ctx: ParallelCtx):
     return h
 
 
+def final_hidden(params, h, cfg: ModelConfig, ctx: ParallelCtx = None):
+    """Final norm (+ Megatron exit gather): the hidden states the LM head
+    consumes. Split out so losses can fuse head-matmul + CE chunked
+    (ops.cross_entropy.chunked_lm_cross_entropy) without a full [B,S,V]
+    logits tensor ever existing."""
+    ctx = ctx or SINGLE
+    h = _norm(h, params["final_norm_w"], params.get("final_norm_b"), cfg)
+    if ctx.megatron_sp:
+        h = jax.lax.all_gather(h, ctx.tp_axis, axis=1, tiled=True)
+    return h
+
+
+def head_matrix(params, cfg: ModelConfig, dtype=None):
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return head.astype(dtype) if dtype is not None else head
+
+
 def lm_logits(params, h, cfg: ModelConfig, ctx: ParallelCtx = None):
     """Final norm + LM head. Under tp the head weight is vocab-sharded and
     the returned logits are the local vocab slice. Under Megatron sequence
     parallelism the final norm runs on the sequence shard and the full
     sequence is gathered just before the head (Megatron's exit gather)."""
-    ctx = ctx or SINGLE
-    h = _norm(h, params["final_norm_w"], params.get("final_norm_b"), cfg)
-    if ctx.megatron_sp:
-        h = jax.lax.all_gather(h, ctx.tp_axis, axis=1, tiled=True)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    return h @ head.astype(h.dtype)
+    h = final_hidden(params, h, cfg, ctx)
+    return h @ head_matrix(params, cfg, h.dtype)
 
 
 # ---------------------------------------------------------------- forward
+
+def forward_hidden(params, tokens, cfg: ModelConfig,
+                   ctx: ParallelCtx = SINGLE, remat: bool = False):
+    """Embed + layer stack (everything before the LM head)."""
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    h = embed_tokens(params, tokens, cfg, ctx)
+    return run_layers(h, params["layers"], cfg, ctx, cos, sin, remat=remat)
+
 
 def forward(params, tokens, cfg: ModelConfig, ctx: ParallelCtx = SINGLE,
             remat: bool = False):
     """Full forward to logits. Single-device when ctx is SINGLE; inside
     shard_map the ctx axes drive collectives. (Pipeline parallelism wraps
     run_layers differently — see hadoop_tpu.parallel.pipeline.)"""
-    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
-    h = embed_tokens(params, tokens, cfg, ctx)
-    h = run_layers(h, params["layers"], cfg, ctx, cos, sin, remat=remat)
+    h = forward_hidden(params, tokens, cfg, ctx, remat=remat)
     return lm_logits(params, h, cfg, ctx)

@@ -1,8 +1,9 @@
 """Causal (grouped-query) attention.
 
-The portable path is a jnp softmax-attention that XLA maps onto the MXU; a
-fused Pallas flash kernel lives in ``hadoop_tpu.ops.flash`` and is selected
-explicitly on TPU backends.
+The portable path is a jnp softmax-attention that XLA maps onto the MXU;
+the fused Pallas flash kernel in ``hadoop_tpu.ops.flash`` is selected
+automatically on TPU backends for qualifying shapes (see
+``causal_attention``'s ``impl`` arg).
 
 Ring attention (sequence/context parallelism over the mesh) builds on
 ``chunk_attention`` + ``merge_attention``: each partial result is the
@@ -31,14 +32,31 @@ def _repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
 def causal_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                      scale: float | None = None,
                      q_offset: int | jnp.ndarray = 0,
-                     kv_offset: int | jnp.ndarray = 0) -> jnp.ndarray:
+                     kv_offset: int | jnp.ndarray = 0,
+                     impl: str = "auto") -> jnp.ndarray:
     """Causal self-attention.
 
     q: [B, Sq, Hq, D]; k, v: [B, Skv, Hkv, D] with Hq a multiple of Hkv
     (grouped-query). ``q_offset``/``kv_offset`` are absolute positions of the
     first query/key token — sequence-parallel shards pass their slice start
     so masking stays globally causal. Returns [B, Sq, Hq, D].
+
+    ``impl``: "auto" picks the fused Pallas flash kernel
+    (``hadoop_tpu.ops.flash``) on TPU backends when the shapes qualify and
+    falls back to this portable jnp path otherwise; "flash"/"ref" force.
     """
+    if impl != "ref":
+        from hadoop_tpu.ops import flash
+        if impl == "flash":
+            if not flash.supported(q.shape, k.shape, q_offset, kv_offset):
+                raise ValueError(
+                    "impl='flash' forced but the fused kernel does not "
+                    f"support q={q.shape} k={k.shape} q_offset={q_offset} "
+                    f"kv_offset={kv_offset} (offsets must be static 0)")
+            return flash.flash_attention(q, k, v, scale)
+        if jax.default_backend() not in ("cpu", "gpu") and \
+                flash.supported(q.shape, k.shape, q_offset, kv_offset):
+            return flash.flash_attention(q, k, v, scale)
     b, sq, hq, d = q.shape
     _, skv, hkv, _ = k.shape
     k = _repeat_kv(k, hq // hkv)

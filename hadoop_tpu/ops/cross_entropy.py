@@ -53,3 +53,54 @@ def vocab_parallel_cross_entropy(local_logits: jnp.ndarray,
     target_logit = jax.lax.psum(jnp.where(in_shard, picked, 0.0), axis_name)
 
     return jnp.mean(lse - target_logit)
+
+
+def chunked_lm_cross_entropy(h: jnp.ndarray, head: jnp.ndarray,
+                             targets: jnp.ndarray, chunk: int = 256,
+                             axis_name=None, vocab_shard_size: int = 0):
+    """Fused LM-head + cross-entropy, chunked over the sequence.
+
+    The memory-critical op of a large-vocab LM step: materializing the
+    full [B, S, V] logits (bf16) plus their float32 softmax intermediates
+    costs gigabytes and caps the batch size. This computes the head
+    matmul and the CE one sequence-chunk at a time under ``jax.checkpoint``
+    — peak memory is one [B, chunk, V] slab, and backward recomputes each
+    chunk's logits instead of storing them (the same trade Megatron's
+    fused vocab-parallel CE kernel makes; ref-philosophy: nativetask's
+    "put the hot loop in the fast substrate").
+
+    h: [B, S, D] final hidden states (post final-norm/gather).
+    head: [D, V] (or [D, V/tp] with ``axis_name`` set for vocab-parallel).
+    Returns the mean CE over B*S tokens (psum'd over ``axis_name`` if set).
+    """
+    b, s, d = h.shape
+    if s % chunk:
+        chunk = s  # degenerate fallback — callers pick aligned chunks
+    n = s // chunk
+    h_c = jnp.moveaxis(h.reshape(b, n, chunk, d), 1, 0)
+    t_c = jnp.moveaxis(targets.reshape(b, n, chunk), 1, 0)
+
+    def piece(head, h_chunk, t_chunk):
+        logits = h_chunk @ head
+        if axis_name is None:
+            return softmax_cross_entropy(logits, t_chunk) * t_chunk.size
+        return vocab_parallel_cross_entropy(
+            logits, t_chunk, axis_name, vocab_shard_size) * t_chunk.size
+
+    piece = jax.checkpoint(piece)
+
+    def step(acc, xs):
+        hc, tc = xs
+        return acc + piece(head, hc, tc), None
+
+    from hadoop_tpu.ops.vma import pvary_to, tree_vma
+    # The carry's vma must match the piece output's: the vocab-parallel
+    # branch psums over axis_name inside, so the per-chunk loss no longer
+    # varies there — marking the carry varying would make the caller's
+    # final psum double-count.
+    acc_vma = tree_vma((h, head, targets))
+    if axis_name is not None:
+        acc_vma = acc_vma - {axis_name}
+    acc0 = pvary_to(jnp.zeros((), jnp.float32), acc_vma)
+    total, _ = jax.lax.scan(step, acc0, (h_c, t_c))
+    return total / (b * s)

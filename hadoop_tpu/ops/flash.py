@@ -1,0 +1,411 @@
+"""Fused causal flash attention — Pallas TPU kernels (fwd + bwd).
+
+The hot op of the training engine, implemented the TPU way (cf. the
+reference's philosophy of moving its hot loop into the fast substrate —
+its C++ map-output collector, hadoop-mapreduce-client-nativetask): one
+fused kernel streams K/V blocks through VMEM against a resident Q block,
+keeping the softmax online (running max / running sum) so the [Sq, Skv]
+score matrix never materializes in HBM.
+
+Layout: [B, H, S, D] inside the kernels (head-major so a (block, D) tile
+is a clean VMEM block); the public wrapper takes the model's [B, S, H, D].
+Grouped-query attention is native: the K/V BlockSpec index maps query head
+``h`` onto kv head ``h // n_rep`` — no materialized head replication.
+
+Causality is exploited twice: fully-masked K/V blocks are skipped via
+``pl.when``, and their BlockSpec index is clamped to the last visible
+block so the skipped grid steps re-use the already-resident buffer
+instead of issuing dead DMAs.
+
+Backward follows the standard flash decomposition: a cheap jnp
+``delta = rowsum(dO * O)``, then one kernel accumulating dK/dV over query
+blocks and one accumulating dQ over key blocks, both recomputing P from
+the saved per-row log-sum-exp.
+
+Numerics: scores and softmax statistics in float32 (MXU accumulate via
+``preferred_element_type``), P cast back to the input dtype for the P·V
+and Pᵀ·dO matmuls, outputs in the input dtype, LSE in float32.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hadoop_tpu.ops.vma import vma_of
+
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying the caller's varying-manual-axes set —
+    required for pallas_call outputs under shard_map's vma checking."""
+    vma = vma_of(like)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+_NEG_INF = -1e30
+
+
+def _pick_block(seq: int, preferred: int) -> int:
+    b = min(preferred, seq)
+    while seq % b:
+        b //= 2
+    return max(b, 1)
+
+
+def supported(q_shape, k_shape, q_offset, kv_offset) -> bool:
+    """Shapes/args the fused kernel handles; callers fall back otherwise."""
+    b, sq, hq, d = q_shape
+    _, skv, hkv, _ = k_shape
+    if not (isinstance(q_offset, int) and isinstance(kv_offset, int)):
+        return False
+    if q_offset != 0 or kv_offset != 0 or sq != skv:
+        return False
+    if hq % hkv:
+        return False
+    # Lane-dim friendliness + at least one full min-tile of rows.
+    return d % 64 == 0 and sq % 128 == 0 and sq >= 128
+
+
+# ===================================================================== fwd
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale: float, block_q: int, block_k: int):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    num_k = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # Visible iff this K/V block intersects the causal lower triangle.
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    @pl.when(k_start <= q_start + block_q - 1)
+    def _step():
+        q = q_ref[0, 0]                                   # [bq, d]
+        k = k_ref[0, 0]                                   # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        qpos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kpos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        m_prev = m_ref[:, :1]                             # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)         # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)                   # rescale old state
+        p = jnp.exp(s - m_new)                            # [bq, bk]
+        l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0],
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == num_k - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        o_ref[0, 0] = (acc_ref[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[:, :1] + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _fwd(q, k, v, scale, block_q, block_k, interpret):
+    """q: [B,Hq,S,D]; k,v: [B,Hkv,S,D] → (o [B,Hq,S,D], lse [B,Hq,S])."""
+    b, hq, s, d = q.shape
+    hkv = k.shape[1]
+    n_rep = hq // hkv
+    bq = _pick_block(s, block_q)
+    bk = _pick_block(s, block_k)
+    nq, nk = s // bq, s // bk
+
+    def q_map(bi, hi, qi, ki):
+        return (bi, hi, qi, 0)
+
+    def kv_map(bi, hi, qi, ki):
+        # GQA head fold + causal clamp: dead upper-triangle steps re-use
+        # the last visible block (no fresh DMA).
+        last_visible = (qi * bq + bq - 1) // bk
+        return (bi, hi // n_rep, jnp.minimum(ki, last_visible), 0)
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, block_q=bq,
+                               block_k=bk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(b, hq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), q_map),
+            pl.BlockSpec((1, 1, bk, d), kv_map),
+            pl.BlockSpec((1, 1, bk, d), kv_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), q_map),
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            _sds(q.shape, q.dtype, q),
+            _sds((b, hq, s, 1), jnp.float32, q),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),     # acc
+            pltpu.VMEM((bq, 128), jnp.float32),   # running max
+            pltpu.VMEM((bq, 128), jnp.float32),   # running sum
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ===================================================================== bwd
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale: float, block_q: int, block_k: int):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    num_q = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    k_start = ki * block_k
+    q_start = qi * block_q
+
+    @pl.when(q_start + block_q - 1 >= k_start)
+    def _step():
+        q = q_ref[0, 0]                                    # [bq, d]
+        k = k_ref[0, 0]                                    # [bk, d]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]                                  # [bq, d]
+        lse = lse_ref[0, 0]                                # [bq, 1]
+        delta = delta_ref[0, 0]                            # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale    # [bq, bk]
+        qpos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kpos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                      # [bq, bk]
+        # dV += Pᵀ · dO
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dP = dO · Vᵀ ;  dS = P ∘ (dP − delta)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        ds = p * (dp - delta)                     # [bq, bk]
+        # dK += dSᵀ · Q  (scale folded into dS)
+        dk_acc[:] += jax.lax.dot_general(
+            (ds * scale).astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale: float, block_q: int,
+                   block_k: int):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    num_k = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    @pl.when(k_start <= q_start + block_q - 1)
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]                                # [bq, 1]
+        delta = delta_ref[0, 0]                            # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        qpos = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kpos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_acc[:] += jax.lax.dot_general(
+            (ds * scale).astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_k - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd(scale, block_q, block_k, interpret, residuals, g):
+    q, k, v, o, lse = residuals
+    do, _ = g
+    b, hq, s, d = q.shape
+    hkv = k.shape[1]
+    n_rep = hq // hkv
+    bq = _pick_block(s, block_q)
+    bk = _pick_block(s, block_k)
+    nq, nk = s // bq, s // bk
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)                # [B,Hq,S,1]
+
+    # dK/dV: one (ki) block accumulates over all visible q blocks. The
+    # kernel runs per QUERY head; per-kv-head gradients are the sum over
+    # the replication group, done with a cheap reshape-sum after.
+    dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale,
+                                   block_q=bq, block_k=bk)
+
+    def qclamp(bi, hi, ki, qi):
+        # Dead lower q blocks (q_end < k_start) clamp to first visible.
+        first_visible = (ki * bk) // bq
+        return (bi, hi, jnp.maximum(qi, first_visible), 0)
+
+    dk_full, dv_full = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, hq, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), qclamp),           # q
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, ki, qi: (bi, hi // n_rep, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, ki, qi: (bi, hi // n_rep, ki, 0)),
+            pl.BlockSpec((1, 1, bq, d), qclamp),           # do
+            pl.BlockSpec((1, 1, bq, 1), qclamp),           # lse
+            pl.BlockSpec((1, 1, bq, 1), qclamp),           # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+        ],
+        out_shape=[
+            _sds((b, hq, s, d), k.dtype, do),
+            _sds((b, hq, s, d), v.dtype, do),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    if n_rep > 1:
+        # Sum the replication group in float32 — the kernel kept f32
+        # accumulators; don't round to bf16 before the final reduction.
+        dk = dk_full.reshape(b, hkv, n_rep, s, d).sum(
+            axis=2, dtype=jnp.float32).astype(k.dtype)
+        dv = dv_full.reshape(b, hkv, n_rep, s, d).sum(
+            axis=2, dtype=jnp.float32).astype(v.dtype)
+    else:
+        dk, dv = dk_full, dv_full
+
+    dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale,
+                                  block_q=bq, block_k=bk)
+
+    def kclamp(bi, hi, qi, ki):
+        last_visible = (qi * bq + bq - 1) // bk
+        return (bi, hi // n_rep, jnp.minimum(ki, last_visible), 0)
+
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, hq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bk, d), kclamp),
+            pl.BlockSpec((1, 1, bk, d), kclamp),
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        out_shape=_sds(q.shape, q.dtype, do),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ================================================================== public
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, block_q, block_k, interpret):
+    o, _ = _fwd(q, k, v, scale, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, scale, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, scale, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(scale, block_q, block_k, interpret, residuals, g):
+    return _bwd(scale, block_q, block_k, interpret, residuals, (g, None))
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: bool = False) -> jnp.ndarray:
+    """Fused causal flash attention.
+
+    q: [B, Sq, Hq, D]; k, v: [B, Skv, Hkv, D] with Hq % Hkv == 0 (GQA).
+    Returns [B, Sq, Hq, D]. Differentiable (custom fused VJP).
+    """
+    b, sq, hq, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    qh = jnp.swapaxes(q, 1, 2)       # [B, Hq, S, D]
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    o = _flash(qh, kh, vh, float(scale), block_q, block_k, interpret)
+    return jnp.swapaxes(o, 1, 2)

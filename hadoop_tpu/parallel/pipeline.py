@@ -1,0 +1,169 @@
+"""1F1B pipeline schedule: manual fwd/bwd interleave with bounded buffers.
+
+The GPipe schedule in ``parallel.train`` runs all M microbatch forwards,
+then lets autodiff replay them backwards — activation liveness grows with
+M. This module implements the 1F1B (one-forward-one-backward) schedule the
+Megatron-class north star names (BASELINE.json): after a P-deep warmup
+each stage alternates one microbatch forward with one backward, so at most
+``2P-1`` microbatch stage-inputs are ever live per stage — activation
+memory is bounded by the pipeline depth, not the microbatch count.
+
+Because the backward order is interleaved with forwards, autodiff of the
+whole schedule cannot produce it; the schedule is written out explicitly:
+
+- One ``lax.scan`` over the global clock (M + 2P - 2 ticks). Every tick,
+  every stage (SPMD over the ``pp`` mesh axis) runs one *forward half*
+  (microbatch ``t - s``) and one *backward half* (microbatch
+  ``t - (2P-2-s)``), each masked out while invalid.
+- Forward half: receive the upstream activation (``ppermute`` +1), run
+  this stage's layer slice, stash the stage INPUT in a ``2P-1``-slot ring
+  buffer (activation checkpointing at stage boundaries: the backward
+  recomputes the stage body, Megatron's selective-recompute trade).
+- Backward half: receive the downstream cotangent (``ppermute`` -1),
+  ``jax.vjp``-recompute the stage for the saved input, apply the
+  cotangent — plus a unit cotangent on the per-microbatch loss at the
+  last stage, which is where the head/loss gradient enters — accumulate
+  parameter grads, send the input-cotangent upstream.
+
+Gradient reduction happens in the caller (train.make_train_step) by the
+same vma-driven rule both schedules share: psum each leaf over the axes
+its gradient varies on minus the axes it is sharded on.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from hadoop_tpu.models.config import ModelConfig
+from hadoop_tpu.models.decoder import embed_tokens, run_layers
+from hadoop_tpu.ops import rope_frequencies
+from hadoop_tpu.ops.vma import pvary_to, tree_vma, vma_of
+
+
+def stage_body(params, tok, tgt, x_in, stage, cfg, ctx, cos, sin,
+               remat, loss_from_h):
+    """One pipeline stage's work on one microbatch — shared by the GPipe
+    and 1F1B schedules so they cannot diverge: embed (used at stage 0),
+    this rank's layer slice, the loss head (used at the last stage). The
+    unused halves are masked by ``jnp.where`` so their cotangents vanish."""
+    x0 = embed_tokens(params, tok, cfg, ctx)
+    x = jnp.where(stage == 0, x0, x_in)
+    y = run_layers(x, params["layers"], cfg, ctx, cos, sin, remat=remat)
+    return y, loss_from_h(params, y, tgt, cfg, ctx)
+
+
+def pipeline_1f1b_loss_and_grad(params, tokens, targets, *,
+                                cfg: ModelConfig, plan, ctx,
+                                n_microbatches: int, remat: bool,
+                                loss_from_h) -> Tuple[jnp.ndarray, Any]:
+    """Runs inside shard_map. tokens/targets: [B_local, S] on this rank.
+
+    Returns (sum of per-microbatch mean losses on the last stage — psum
+    over 'pp' and divide by M in the caller —, local parameter grads).
+    """
+    M = n_microbatches
+    Pp = plan.pp
+    B_l, S = tokens.shape
+    tok_mb = tokens.reshape(M, B_l // M, S)
+    tgt_mb = targets.reshape(M, B_l // M, S)
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    stage = jax.lax.axis_index("pp")
+    s_act = S // plan.tp if plan.megatron_sp else S
+    K = 2 * Pp - 1                       # ring-buffer depth (max in-flight)
+    fwd_perm = [(i, (i + 1) % Pp) for i in range(Pp)]
+    bwd_perm = [((i + 1) % Pp, i) for i in range(Pp)]
+
+    def stage_fn(params, tok, tgt, x_in):
+        return stage_body(params, tok, tgt, x_in, stage, cfg, ctx,
+                          cos, sin, remat, loss_from_h)
+
+    act_shape = (B_l // M, s_act, cfg.d_model)
+
+    # ---- abstract vma discovery -----------------------------------------
+    # shard_map's varying-manual-axes checking requires scan carries and
+    # vjp cotangents to carry EXACTLY the vma of the values they stand in
+    # for. Find the circulating activation's vma as a fixed point of one
+    # stage application, then the cotangent avals from an abstract vjp.
+    def _apply(p, x):
+        return stage_fn(p, tok_mb[0], tgt_mb[0], x)
+
+    act_vma = frozenset()
+    for _ in range(4):
+        x_probe = pvary_to(jnp.zeros(act_shape, cfg.jax_dtype), act_vma)
+        y_av, loss_av = jax.eval_shape(_apply, params, x_probe)
+        new = act_vma | frozenset(y_av.vma)
+        if new == act_vma:
+            break
+        act_vma = new
+    loss_vma = frozenset(loss_av.vma) | {"pp"}
+    x_probe = pvary_to(jnp.zeros(act_shape, cfg.jax_dtype), act_vma)
+
+    def _cotangent_avals(p, x):
+        (y, loss), vjp = jax.vjp(_apply, p, x)
+        return vjp((y, loss))
+
+    dparams_av, dx_av = jax.eval_shape(_cotangent_avals, params, x_probe)
+    zero_grads = jax.tree_util.tree_map(
+        lambda av: pvary_to(jnp.zeros(av.shape, jnp.float32),
+                            frozenset(av.vma)),
+        dparams_av)
+
+    def tick(carry, t):
+        recv_f, recv_b, buf, gacc, loss_acc = carry
+
+        # ---------------- forward half: microbatch mf = t - stage
+        mf = t - stage
+        f_valid = (mf >= 0) & (mf < M)
+        mf_c = jnp.clip(mf, 0, M - 1)
+        tok_f = jnp.take(tok_mb, mf_c, axis=0)
+        tgt_f = jnp.take(tgt_mb, mf_c, axis=0)
+        y, loss_f = stage_fn(params, tok_f, tgt_f, recv_f)
+        is_last = stage == Pp - 1
+        loss_acc = loss_acc + jnp.where(
+            f_valid & is_last, loss_f, 0.0)
+        # Checkpoint the stage input (recv_f; embed recomputed at stage 0).
+        buf = jax.lax.dynamic_update_index_in_dim(
+            buf, recv_f, mf_c % K, axis=0)
+
+        # ---------------- backward half: microbatch mb = t - (2P-2-stage)
+        mb = t - (2 * Pp - 2 - stage)
+        b_valid = (mb >= 0) & (mb < M)
+        mb_c = jnp.clip(mb, 0, M - 1)
+        tok_b = jnp.take(tok_mb, mb_c, axis=0)
+        tgt_b = jnp.take(tgt_mb, mb_c, axis=0)
+        x_saved = jax.lax.dynamic_index_in_dim(
+            buf, mb_c % K, axis=0, keepdims=False)
+        _, vjp = jax.vjp(lambda p, x: stage_fn(p, tok_b, tgt_b, x),
+                         params, x_saved)
+        # Cotangents: downstream dy (zero at the last stage — its y feeds
+        # nothing), unit loss cotangent at the last stage only. Each must
+        # carry exactly the primal output's vma.
+        dy = pvary_to(jnp.where(b_valid & ~is_last, 1.0, 0.0).astype(
+            recv_b.dtype) * recv_b, act_vma)
+        dloss = pvary_to(
+            jnp.where(b_valid & is_last, 1.0, 0.0), loss_vma)
+        dparams, dx = vjp((dy, dloss))
+        gacc = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(jnp.float32), gacc, dparams)
+
+        # ---------------- rotate: activations down, cotangents up
+        recv_f2 = jax.lax.ppermute(y, "pp", fwd_perm)
+        recv_b2 = jax.lax.ppermute(dx, "pp", bwd_perm)
+        return (recv_f2, recv_b2, buf, gacc, loss_acc), None
+
+    # Carries start with exactly the vma the tick outputs will have
+    # (scan requires a fixed-point vma).
+    recv_f0 = pvary_to(jnp.zeros(act_shape, cfg.jax_dtype), act_vma)
+    recv_b0 = pvary_to(jnp.zeros(act_shape, cfg.jax_dtype),
+                       frozenset(dx_av.vma))
+    buf0 = pvary_to(jnp.zeros((K,) + act_shape, cfg.jax_dtype), act_vma)
+    loss0 = pvary_to(jnp.zeros((), jnp.float32), loss_vma)
+
+    (_, _, _, grads, loss_sum), _ = jax.lax.scan(
+        tick, (recv_f0, recv_b0, buf0, zero_grads, loss0),
+        jnp.arange(M + 2 * Pp - 2))
+    # float32 accumulators; the caller reduces across ranks, then casts.
+    return loss_sum, grads

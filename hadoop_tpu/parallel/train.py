@@ -29,11 +29,12 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from hadoop_tpu.models.config import ModelConfig
-from hadoop_tpu.models.decoder import (embed_tokens, forward, lm_logits,
+from hadoop_tpu.models.decoder import (embed_tokens, final_hidden,
+                                       forward_hidden, head_matrix,
                                        run_layers)
 from hadoop_tpu.models.decoder import init_params as _init_params
-from hadoop_tpu.ops import rope_frequencies, softmax_cross_entropy
-from hadoop_tpu.ops.cross_entropy import vocab_parallel_cross_entropy
+from hadoop_tpu.ops import rope_frequencies
+from hadoop_tpu.ops.cross_entropy import chunked_lm_cross_entropy
 from hadoop_tpu.parallel.mesh import MeshPlan, param_specs, shard_params
 from hadoop_tpu.parallel.optimizer import (AdamWState, adamw_init,
                                            adamw_update)
@@ -69,23 +70,36 @@ def _spec_axes(spec) -> set:
     return names
 
 
-def _loss_from_h(params, h, targets, cfg: ModelConfig, ctx):
-    logits = lm_logits(params, h, cfg, ctx)
+def _loss_from_h(params, h, targets, cfg: ModelConfig, ctx,
+                 chunk: int = 256):
+    """LM loss from pre-head hidden states, chunked over the sequence so
+    the full [B,S,V] logits never materialize (the batch-size ceiling on
+    large-vocab models — see chunked_lm_cross_entropy)."""
+    h = final_hidden(params, h, cfg, ctx)
+    head = head_matrix(params, cfg, h.dtype)
     if ctx.tp_axis is not None:
-        return vocab_parallel_cross_entropy(
-            logits, targets, ctx.tp_axis, cfg.vocab_size // ctx.tp_size)
-    return softmax_cross_entropy(logits, targets)
+        return chunked_lm_cross_entropy(
+            h, head, targets, chunk, axis_name=ctx.tp_axis,
+            vocab_shard_size=cfg.vocab_size // ctx.tp_size)
+    return chunked_lm_cross_entropy(h, head, targets, chunk)
 
 
 def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
                     lr: float = 3e-4, n_microbatches: int = 1,
                     remat: bool = False, donate: bool = True,
-                    optimizer: str = "adamw"):
+                    optimizer: str = "adamw",
+                    pipeline_schedule: str = "1f1b"):
     """Build the jitted sharded train step.
 
     Returns fn(params, opt_state, tokens, targets) ->
     (params, opt_state, metrics) where tokens/targets are global
     [batch, seq] int32 arrays (batch sharded over dp×ep, sequence over sp).
+
+    ``pipeline_schedule`` (used when plan.pp > 1): "1f1b" — the manual
+    one-forward-one-backward interleave with pipeline-depth-bounded
+    activation memory (parallel.pipeline); "gpipe" — all-forwards scan
+    with autodiff-generated backwards (activation liveness grows with
+    n_microbatches).
     """
     ctx = plan.ctx(cfg)
     specs = param_specs(cfg, plan)
@@ -116,11 +130,8 @@ def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
     # ------------------------------------------------------------ losses
 
     def flat_loss(params, tokens, targets):
-        logits = forward(params, tokens, cfg, ctx, remat=remat)
-        if ctx.tp_axis is not None:
-            return vocab_parallel_cross_entropy(
-                logits, targets, ctx.tp_axis, cfg.vocab_size // ctx.tp_size)
-        return softmax_cross_entropy(logits, targets)
+        h = forward_hidden(params, tokens, cfg, ctx, remat=remat)
+        return _loss_from_h(params, h, targets, cfg, ctx)
 
     def pipelined_loss(params, tokens, targets):
         M = n_microbatches
@@ -160,20 +171,54 @@ def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
         return jax.lax.psum(jnp.sum(losses), "pp") / M
 
     loss_fn = pipelined_loss if plan.pp > 1 else flat_loss
+    use_1f1b = plan.pp > 1 and pipeline_schedule == "1f1b"
+
+    # Manual-schedule gradient reduction (the vma transpose machinery does
+    # this automatically inside value_and_grad for the autodiff paths):
+    # psum each leaf over every axis its accumulated gradient actually
+    # varies on and the leaf is not sharded on — those are exactly the
+    # axes whose ranks contributed partial sums (different tokens or
+    # stages); anything the grad does not vary on is already complete.
+    def _reduce_manual(grads):
+        from hadoop_tpu.ops.vma import vma_of
+
+        def leaf(g, s):
+            reduce_axes = tuple(sorted(vma_of(g) - _spec_axes(s)))
+            return jax.lax.psum(g, reduce_axes) if reduce_axes else g
+        return jax.tree_util.tree_map(leaf, grads, specs)
 
     # -------------------------------------------------------------- body
 
     from hadoop_tpu.ops.vma import vma_of
 
     def body(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        if use_1f1b:
+            from hadoop_tpu.parallel.pipeline import \
+                pipeline_1f1b_loss_and_grad
+            loss, grads = pipeline_1f1b_loss_and_grad(
+                params, tokens, targets, cfg=cfg, plan=plan, ctx=ctx,
+                n_microbatches=n_microbatches, remat=remat,
+                loss_from_h=_loss_from_h)
+            grads = _reduce_manual(grads)
+            # Accumulators summed M per-microbatch mean-losses; the
+            # objective (like the gpipe path's psum(...)/M) is their mean.
+            grads = jax.tree_util.tree_map(
+                lambda g, p: (g / n_microbatches).astype(p.dtype),
+                grads, params)
+            rem = tuple(sorted(vma_of(loss)))
+            if rem:
+                loss = jax.lax.psum(loss, rem)
+            loss = loss / n_microbatches
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, tokens, targets)
+            # sum the per-data-rank losses over whatever axes the loss
+            # still varies on (real data axes, plus identity-psums on
+            # size-1 axes) and turn the sum into the global batch mean
+            rem = tuple(sorted(vma_of(loss)))
+            if rem:
+                loss = jax.lax.psum(loss, rem)
         grads = _reduce_grads(grads)
-        # sum the per-data-rank losses over whatever axes the loss still
-        # varies on (real data axes, plus identity-psums on size-1 axes)
-        # and turn the sum into the global batch mean
-        rem = tuple(sorted(vma_of(loss)))
-        if rem:
-            loss = jax.lax.psum(loss, rem)
         loss = loss / loss_div
         gsq = _global_grad_sq(grads)
         if optimizer == "sgd":

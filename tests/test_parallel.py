@@ -30,12 +30,13 @@ def _data(cfg, key=7):
     return tokens, targets
 
 
-def _run_plan(cfg, plan, n_steps=2, n_microbatches=1, optimizer="sgd"):
+def _run_plan(cfg, plan, n_steps=2, n_microbatches=1, optimizer="sgd",
+              schedule="1f1b"):
     mesh = make_mesh(plan)
     plan.validate(cfg, BATCH, SEQ, n_microbatches)
     step = make_train_step(cfg, plan, mesh, lr=1e-2,
                            n_microbatches=n_microbatches, donate=False,
-                           optimizer=optimizer)
+                           optimizer=optimizer, pipeline_schedule=schedule)
     params, opt = init_sharded(jax.random.PRNGKey(0), cfg, plan, mesh)
     ds = make_data_sharding(mesh)
     tokens, targets = _data(cfg)
@@ -85,10 +86,29 @@ def test_dp_tp_parity(reference_dense):
     _assert_tree_close(params, ref_params)
 
 
-def test_dp_pp_tp_parity(reference_dense):
+def test_dp_pp_tp_parity_1f1b(reference_dense):
+    """pp runs the manual 1F1B schedule by default (parallel.pipeline)."""
     cfg = get_config("tiny")
     losses, params = _run_plan(cfg, MeshPlan(dp=2, pp=2, tp=2),
                                n_microbatches=2)
+    ref_losses, ref_params = reference_dense
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
+    _assert_tree_close(params, ref_params)
+
+
+def test_dp_pp_tp_parity_gpipe(reference_dense):
+    cfg = get_config("tiny")
+    losses, params = _run_plan(cfg, MeshPlan(dp=2, pp=2, tp=2),
+                               n_microbatches=2, schedule="gpipe")
+    ref_losses, ref_params = reference_dense
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
+    _assert_tree_close(params, ref_params)
+
+
+def test_pp4_deep_pipeline_1f1b(reference_dense):
+    """pp=4 with M=4: warmup/steady/drain phases all exercised."""
+    cfg = get_config("tiny")
+    losses, params = _run_plan(cfg, MeshPlan(pp=4), n_microbatches=4)
     ref_losses, ref_params = reference_dense
     np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
     _assert_tree_close(params, ref_params)
