@@ -24,8 +24,19 @@ def run(n_files: int = 4, mb_per_file: int = 16, replication: int = 2,
         num_datanodes: int = 3) -> dict:
     from hadoop_tpu.testing.minicluster import MiniDFSCluster
 
+    from hadoop_tpu.conf import Configuration
+
+    from benchmarks import bench_base_dir
+
     payload = os.urandom(1024 * 1024)
-    cluster = MiniDFSCluster(num_datanodes=num_datanodes)
+    # Throughput sizing, not test sizing: real block size (the minicluster
+    # default of 1 MB exists to exercise multi-block code paths in tests —
+    # a 64 MB stream would pay 64 block allocations + pipeline setups).
+    conf = Configuration(load_defaults=False)
+    conf.set("dfs.blocksize", "64m")
+    base = bench_base_dir("dfsio")
+    cluster = MiniDFSCluster(num_datanodes=num_datanodes, conf=conf,
+                             base_dir=base)
     cluster.start()
     try:
         cluster.conf.set("dfs.replication", str(replication))
@@ -60,6 +71,9 @@ def run(n_files: int = 4, mb_per_file: int = 16, replication: int = 2,
                 "total_mb": total_mb}
     finally:
         cluster.shutdown()
+        if base:
+            import shutil
+            shutil.rmtree(base, ignore_errors=True)
 
 
 def main() -> None:

@@ -1,0 +1,89 @@
+"""Component-level timing of the flagship train step (diagnosis tool).
+
+Times forward-only, fwd+bwd, and the full optimizer step separately at
+several batch sizes to locate super-linear scaling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+
+from hadoop_tpu.models import count_params, get_config
+from hadoop_tpu.parallel import MeshPlan, make_mesh
+from hadoop_tpu.parallel.train import (init_sharded, make_data_sharding,
+                                       make_train_step)
+
+
+def timeit(fn, *args, steps=8, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.tree_util.tree_map(
+        lambda x: x.block_until_ready() if hasattr(x, "block_until_ready")
+        else x, out)
+    # sync via host transfer (axon block_until_ready returns early)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(jnp.sum(leaf.astype(jnp.float32)))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(jnp.sum(leaf.astype(jnp.float32)))
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="flagship-420m")
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--batches", default="4,8,16")
+    ap.add_argument("--remat", default="full",
+                    choices=["none", "full", "dots"])
+    args = ap.parse_args()
+    remat = {"none": False, "full": True, "dots": "dots"}[args.remat]
+
+    cfg = get_config(args.preset, max_seq=args.seq)
+    plan = MeshPlan()
+    mesh = make_mesh(plan)
+    params, opt = init_sharded(jax.random.PRNGKey(0), cfg, plan, mesh)
+    ds = make_data_sharding(mesh)
+
+    from hadoop_tpu.models.decoder import forward_hidden
+    for batch in [int(x) for x in args.batches.split(",")]:
+        tokens = jax.device_put(
+            jax.random.randint(jax.random.PRNGKey(1), (batch, args.seq), 0,
+                               cfg.vocab_size, dtype=jnp.int32), ds)
+        targets = jax.device_put(jnp.roll(tokens, -1, axis=1), ds)
+
+        from hadoop_tpu.models.config import ModelConfig
+        from hadoop_tpu.parallel.train import _loss_from_h
+        ctx = plan.ctx(cfg)
+
+        @jax.jit
+        def fwd_only(params, tokens, targets):
+            h = forward_hidden(params, tokens, cfg, ctx, remat=remat)
+            return _loss_from_h(params, h, targets, cfg, ctx)
+
+        @jax.jit
+        def fwd_bwd(params, tokens, targets):
+            def f(p):
+                h = forward_hidden(p, tokens, cfg, ctx, remat=remat)
+                return _loss_from_h(p, h, targets, cfg, ctx)
+            return jax.value_and_grad(f)(params)
+
+        step = make_train_step(cfg, plan, mesh, remat=remat, donate=False)
+
+        t_f = timeit(fwd_only, params, tokens, targets)
+        t_fb = timeit(fwd_bwd, params, tokens, targets)
+        t_full = timeit(step, params, opt, tokens, targets)
+        tok = batch * args.seq
+        print(f"batch={batch:3d} fwd={t_f*1e3:8.1f}ms "
+              f"fwd+bwd={t_fb*1e3:8.1f}ms full={t_full*1e3:8.1f}ms "
+              f"tok/s(full)={tok/t_full:,.0f}")
+
+
+if __name__ == "__main__":
+    main()

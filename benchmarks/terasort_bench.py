@@ -18,12 +18,25 @@ import time
 RECORD_LEN = 100
 
 
-def run(records: int = 200_000, nodes: int = 3, reduces: int = 3) -> dict:
+def run(records: int = 200_000, nodes: int = 3, reduces: int = 3,
+        split_mb: int = 64) -> dict:
+    from hadoop_tpu.conf import Configuration
     from hadoop_tpu.examples.terasort import (make_terasort_job, teragen,
                                               teravalidate)
     from hadoop_tpu.testing.minicluster import MiniMRYarnCluster
 
-    cluster = MiniMRYarnCluster(num_nodes=nodes)
+    # Load-tolerant intervals: the minicluster's default sub-second dead
+    # detection (tuned for failover tests) misfires when dozens of task
+    # processes compete for the host's cores and starve DN heartbeat
+    # threads — a benchmark run is load, not failure.
+    conf = Configuration(load_defaults=False)
+    conf.set("dfs.heartbeat.interval", "0.5s")
+    conf.set("dfs.namenode.heartbeat.recheck-interval", "5s")
+    conf.set("dfs.blocksize", "64m")  # throughput sizing (not the 1 MB
+    # multi-block-test default)
+    from benchmarks import bench_base_dir
+    base = bench_base_dir("terasort")
+    cluster = MiniMRYarnCluster(num_nodes=nodes, conf=conf, base_dir=base)
     cluster.start()
     try:
         fs = cluster.get_filesystem()
@@ -33,7 +46,7 @@ def run(records: int = 200_000, nodes: int = 3, reduces: int = 3) -> dict:
 
         job = make_terasort_job(cluster.rm_addr, cluster.default_fs,
                                 "/tera/in", "/tera/out",
-                                num_reduces=reduces)
+                                num_reduces=reduces, split_mb=split_mb)
         t0 = time.perf_counter()
         ok = job.wait_for_completion()
         sort_dt = time.perf_counter() - t0
@@ -50,6 +63,9 @@ def run(records: int = 200_000, nodes: int = 3, reduces: int = 3) -> dict:
                 "sort_seconds": round(sort_dt, 2)}
     finally:
         cluster.shutdown()
+        if base:
+            import shutil
+            shutil.rmtree(base, ignore_errors=True)
 
 
 def main() -> None:

@@ -1,0 +1,159 @@
+"""Short-circuit local reads — same-host replicas bypass the DN data path.
+
+Parity with the reference's short-circuit read stack (ref:
+hadoop-hdfs-client/.../shortcircuit/ShortCircuitCache.java:72,
+ShortCircuitShm.java, client/impl/BlockReaderFactory.java:354-381
+getBlockReaderLocal; native transport
+hadoop-common/src/main/native/src/org/apache/hadoop/net/unix/DomainSocket.c):
+when a replica lives on the reader's own host, the client asks the DN once
+for the replica's file layout and from then on reads the block file
+directly — no socket hop, no DN thread, no packet framing — while STILL
+verifying the stored CRCs (BlockReaderLocal does the same; skipping
+verification is a separate opt-in there).
+
+Transport simplification: the reference passes open file descriptors over
+a Unix domain socket so the DN never reveals paths; here the DN hands the
+client the replica's (data, meta) paths over the regular transfer port.
+Same trust domain (one OS user runs both on a TPU-VM host), one fewer
+native layer. The cache keys and invalidation rules mirror
+ShortCircuitCache: cached per (block, genstamp), dropped on any IO error
+so the TCP path takes over (e.g. after the balancer moves a replica).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+from typing import Optional, Tuple
+
+from hadoop_tpu.dfs.protocol import datatransfer as dt
+from hadoop_tpu.dfs.protocol.records import Block, DatanodeInfo
+from hadoop_tpu.util.crc import DataChecksum
+from hadoop_tpu.util.misc import local_host_names
+
+log = logging.getLogger(__name__)
+
+
+class ShortCircuitUnavailable(Exception):
+    """Fall back to the TCP reader (DN too old, replica moved, ...)."""
+
+
+class _Slot:
+    __slots__ = ("data_path", "meta_path", "bpc", "visible")
+
+    def __init__(self, data_path: str, meta_path: str, bpc: int,
+                 visible: int):
+        self.data_path = data_path
+        self.meta_path = meta_path
+        self.bpc = bpc
+        self.visible = visible
+
+
+class ShortCircuitCache:
+    """Per-process replica-layout cache, LRU-bounded (the reference's
+    ShortCircuitCache evicts on expiry; a size cap serves the same
+    goal — a long-lived reader must not accumulate a slot per block it
+    ever touched). Ref: ShortCircuitCache.java:72."""
+
+    MAX_SLOTS = 4096  # ~a few hundred KB of path strings at the cap
+
+    _instance: Optional["ShortCircuitCache"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._slots: "collections.OrderedDict[Tuple, _Slot]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+        self._local = local_host_names()
+        self.hits = 0
+        self.requests = 0
+
+    @classmethod
+    def get(cls) -> "ShortCircuitCache":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def is_local(self, dn: DatanodeInfo) -> bool:
+        return dn.host in self._local
+
+    # ------------------------------------------------------------ plumbing
+
+    def _slot_for(self, dn: DatanodeInfo, block: Block) -> _Slot:
+        # keyed per REPLICA (dn included): every same-host DN holds its own
+        # copy, and a corrupt copy must not shadow the healthy ones
+        key = (dn.uuid, block.block_id, block.gen_stamp)
+        with self._lock:
+            slot = self._slots.get(key)
+            if slot is not None:
+                self._slots.move_to_end(key)
+        if slot is not None:
+            return slot
+        self.requests += 1
+        sock = dt.connect(dn.xfer_addr(), timeout=10.0)
+        try:
+            dt.send_frame(sock, {"op": dt.OP_SHORT_CIRCUIT,
+                                 "b": block.to_wire()})
+            resp = dt.recv_frame(sock)
+        finally:
+            sock.close()
+        if not resp.get("ok"):
+            raise ShortCircuitUnavailable(resp.get("em", "refused"))
+        slot = _Slot(resp["data_path"], resp["meta_path"], resp["bpc"],
+                     resp["visible"])
+        with self._lock:
+            self._slots[key] = slot
+            self._slots.move_to_end(key)
+            while len(self._slots) > self.MAX_SLOTS:
+                self._slots.popitem(last=False)
+        return slot
+
+    def invalidate(self, block: Block, dn: Optional[DatanodeInfo] = None
+                   ) -> None:
+        with self._lock:
+            for key in [k for k in self._slots
+                        if k[1] == block.block_id
+                        and k[2] == block.gen_stamp
+                        and (dn is None or k[0] == dn.uuid)]:
+                del self._slots[key]
+
+    # ---------------------------------------------------------------- read
+
+    META_HEADER = 4 + 8 + DataChecksum.HEADER_LEN
+
+    def read(self, dn: DatanodeInfo, block: Block, offset: int,
+             want: int) -> bytes:
+        """Read [offset, offset+want) of a local replica, CRC-verified.
+        Raises ShortCircuitUnavailable to punt to the TCP reader; raises
+        ChecksumError (like the remote path) on real corruption."""
+        slot = self._slot_for(dn, block)
+        try:
+            bpc = slot.bpc
+            avail = min(want, slot.visible - offset)
+            if avail <= 0:
+                return b""
+            # chunk-align both edges: stored CRCs cover whole chunks
+            start = (offset // bpc) * bpc
+            end = min(slot.visible,
+                      (offset + avail + bpc - 1) // bpc * bpc)
+            with open(slot.data_path, "rb") as df:
+                df.seek(start)
+                data = df.read(end - start)
+            first_chunk = start // bpc
+            n_chunks = (len(data) + bpc - 1) // bpc
+            with open(slot.meta_path, "rb") as mf:
+                mf.seek(self.META_HEADER + 4 * first_chunk)
+                sums = mf.read(4 * n_chunks)
+        except OSError as e:
+            # replica moved/deleted under us — forget it, use TCP
+            self.invalidate(block, dn)
+            raise ShortCircuitUnavailable(str(e)) from e
+        try:
+            DataChecksum(bpc).verify(data, sums, base_pos=start)
+        except Exception:
+            self.invalidate(block, dn)  # corrupt copy: never re-serve it
+            raise
+        self.hits += 1
+        return data[offset - start:offset - start + avail]

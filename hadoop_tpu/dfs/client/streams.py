@@ -84,6 +84,26 @@ class DFSOutputStream:
     def write(self, data: bytes) -> int:
         if self._closed:
             raise ValueError("stream closed")
+        # Zero-copy fast path: packet-sized slices of the caller's buffer
+        # go straight out (bulk writers hand ≥1 MB buffers; routing them
+        # through the staging bytearray would copy every byte twice).
+        if not self._buf and len(data) >= self.packet_size:
+            mv = memoryview(data)
+            off = 0
+            while len(data) - off >= self.packet_size:
+                if self._pipeline is None:
+                    self._start_block()
+                room = self._block_size - self._block_pos
+                if room <= 0:
+                    self._finish_block()
+                    self._start_block()
+                    room = self._block_size
+                take = min(self.packet_size, len(data) - off, room)
+                self._send_packet(bytes(mv[off:off + take]))
+                off += take
+            if off < len(data):
+                self._buf += mv[off:]
+            return len(data)
         self._buf += data
         self._drain_full_packets()
         return len(data)
@@ -315,6 +335,12 @@ class DFSInputStream:
         self._pos = 0
         self._closed = False
         self._dead: Set[str] = set()
+        # ref: dfs.client.read.shortcircuit (the reference defaults it off
+        # because domain-socket setup needs operator config; the path-based
+        # transport here has no setup, so default on)
+        conf = getattr(client, "conf", None)
+        self._short_circuit_ok = conf is None or conf.get_bool(
+            "dfs.client.read.shortcircuit", True)
 
     def _refresh_locations(self) -> None:
         self._set_locations(self.client.get_block_locations(self.path))
@@ -370,6 +396,13 @@ class DFSInputStream:
     def _read_some(self, pos: int, want: int) -> bytes:
         return self._fetch_range(pos, want)
 
+    # Refresh/backoff rounds when every replica fails or the NN reports
+    # no locations (nodes transiently dead under load, re-replication in
+    # flight). Ref: DFSInputStream chooseDataNode's retry window
+    # (dfs.client.retries.window.base — sleeps then refetches locations).
+    LOCATION_RETRIES = 4
+    RETRY_BACKOFF_S = 0.5
+
     def _fetch_range(self, pos: int, want: int) -> bytes:
         """Read up to ``want`` bytes at pos from one replica, with failover.
         Ref: DFSInputStream.blockSeekTo:639 + read retry loop."""
@@ -392,21 +425,41 @@ class DFSInputStream:
             except (OSError, EOFError, IOError) as e:
                 self._dead.add(dn.uuid)
                 errors.append(f"{dn}: {e}")
-        # One refresh: replicas may have moved (re-replication).
-        self._refresh_locations()
-        self._dead.clear()
-        lb = self._block_for(pos)
-        for dn in lb.locations:
-            try:
-                return self._read_from_datanode(dn, lb.block, in_block_off,
-                                                want)
-            except (OSError, EOFError, IOError) as e:
-                errors.append(f"{dn}: {e}")
+        # Refresh + backoff rounds: replicas may have moved
+        # (re-replication) or their nodes may be only transiently dead.
+        for attempt in range(self.LOCATION_RETRIES):
+            self._refresh_locations()
+            self._dead.clear()
+            lb = self._block_for(pos)
+            for dn in lb.locations:
+                try:
+                    return self._read_from_datanode(dn, lb.block,
+                                                    in_block_off, want)
+                except (OSError, EOFError, IOError) as e:
+                    errors.append(f"{dn}: {e}")
+            if attempt < self.LOCATION_RETRIES - 1:
+                time.sleep(self.RETRY_BACKOFF_S * (attempt + 1))
         raise IOError(f"could not read {self.path} at {pos} from any "
                       f"replica: {errors}")
 
     def _read_from_datanode(self, dn: DatanodeInfo, block: Block,
                             offset: int, want: int) -> bytes:
+        """BlockReaderFactory seam (ref: BlockReaderFactory.java:354-381):
+        local replica → short-circuit direct file read; else TCP."""
+        if self._short_circuit_ok:
+            from hadoop_tpu.dfs.client.shortcircuit import (
+                ShortCircuitCache, ShortCircuitUnavailable)
+            cache = ShortCircuitCache.get()
+            if cache.is_local(dn):
+                try:
+                    return cache.read(dn, block, offset, want)
+                except ShortCircuitUnavailable as e:
+                    log.debug("short-circuit read of %s fell back: %s",
+                              block, e)
+        return self._read_remote(dn, block, offset, want)
+
+    def _read_remote(self, dn: DatanodeInfo, block: Block,
+                     offset: int, want: int) -> bytes:
         sock = dt.connect(dn.xfer_addr(), timeout=10.0)
         try:
             dt.send_frame(sock, {"op": dt.OP_READ_BLOCK, "b": block.to_wire(),

@@ -113,7 +113,8 @@ class DataNode(AbstractService):
         self.store = BlockStore(
             os.path.join(self.data_dir, "current"),
             capacity_override=conf.get_size_bytes(
-                "dfs.datanode.capacity", 0))
+                "dfs.datanode.capacity", 0),
+            sync_on_close=conf.get_bool("dfs.datanode.synconclose", False))
         self.xceiver = DataXceiverServer(
             self.store, self._on_block_received, bind_host=self.host,
             port=conf.get_int("dfs.datanode.port", 0),

@@ -52,6 +52,7 @@ class DataXceiverServer:
         self._m_reads = reg.counter("blocks_read")
         self._m_bytes_in = reg.counter("bytes_written")
         self._m_bytes_out = reg.counter("bytes_read")
+        self._m_short_circuit = reg.counter("short_circuit_grants")
 
     def start(self) -> None:
         self._running = True
@@ -84,6 +85,8 @@ class DataXceiverServer:
                 self._read_block(sock, req)
             elif op == dt.OP_TRANSFER_BLOCK:
                 self._transfer_block(sock, req)
+            elif op == dt.OP_SHORT_CIRCUIT:
+                self._short_circuit(sock, req)
             else:
                 dt.send_frame(sock, {"ok": False, "em": f"bad op {op!r}"})
         except (OSError, EOFError) as e:
@@ -159,20 +162,37 @@ class DataXceiverServer:
         if down is not None:
             Daemon(responder, "packet-responder").start()
 
+        import struct as _struct
+
+        from hadoop_tpu.io.wire import read_frame, unpack
+
         ok = True
         try:
             while True:
-                pkt = dt.recv_frame(up)
+                # keep the raw frame: a mirror forwards it verbatim (no
+                # re-encode of the megabyte payload per hop)
+                raw = read_frame(up)
+                pkt = unpack(raw)
+                if not isinstance(pkt, dict):
+                    raise IOError("malformed packet frame")
                 data, sums = pkt.get("data", b""), pkt.get("sums", b"")
                 status = dt.STATUS_SUCCESS
                 if data:
-                    try:
-                        checksum.verify(data, sums, base_pos=pkt.get("off", 0))
-                    except ChecksumError as e:
-                        log.warning("Checksum error on %s from upstream: %s",
-                                    block, e)
-                        status = dt.STATUS_ERROR_CHECKSUM
-                        ok = False
+                    # Verify at the TERMINAL node only — exactly the
+                    # reference's rule (BlockReceiver.shouldVerifyChecksum:
+                    # mirror nodes forward unverified; the last node's
+                    # verdict covers the wire for the whole chain and the
+                    # ack path reports which hop corrupted).
+                    if down is None:
+                        try:
+                            checksum.verify(data, sums,
+                                            base_pos=pkt.get("off", 0))
+                        except ChecksumError as e:
+                            log.warning(
+                                "Checksum error on %s from upstream: %s",
+                                block, e)
+                            status = dt.STATUS_ERROR_CHECKSUM
+                            ok = False
                     if self.fault_injector is not None:
                         self.fault_injector.before_packet_write(block, pkt)
                     if status == dt.STATUS_SUCCESS:
@@ -181,7 +201,7 @@ class DataXceiverServer:
                 if down is not None:
                     with ack_lock:
                         my_status[pkt["seq"]] = status
-                    dt.send_frame(down, pkt)
+                    down.sendall(_struct.pack(">I", len(raw)) + raw)
                 else:
                     dt.send_frame(up, {"seq": pkt["seq"], "statuses": [status],
                                        "last": pkt.get("last", False)})
@@ -226,6 +246,23 @@ class DataXceiverServer:
         dt.send_frame(sock, {"ok": True})
 
     # -------------------------------------------------------------- reading
+
+    def _short_circuit(self, sock: socket.socket, req: dict) -> None:
+        """Hand a same-host client the replica's file layout so it reads
+        the block file directly (ref: DataXceiver.requestShortCircuitFds —
+        paths instead of passed fds; see client/shortcircuit.py)."""
+        block = Block.from_wire(req["b"])
+        try:
+            data_path, meta_path, checksum, visible = \
+                self.store.open_for_read(block)
+        except IOError as e:
+            dt.send_frame(sock, {"ok": False, "em": str(e)})
+            return
+        self._m_short_circuit.incr()
+        dt.send_frame(sock, {
+            "ok": True, "data_path": data_path, "meta_path": meta_path,
+            "bpc": checksum.bytes_per_chunk, "visible": visible,
+        })
 
     def _read_block(self, sock: socket.socket, req: dict) -> None:
         """Ref: BlockSender.java — chunk-aligned stream with stored sums."""

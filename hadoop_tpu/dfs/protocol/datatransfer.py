@@ -30,12 +30,20 @@ from hadoop_tpu.io.wire import pack, read_frame, unpack
 OP_WRITE_BLOCK = "write_block"
 OP_READ_BLOCK = "read_block"
 OP_TRANSFER_BLOCK = "transfer"   # DN→DN re-replication push
+# Short-circuit replica-layout request (ref: the REQUEST_SHORT_CIRCUIT_FDS
+# op in the reference's DataTransferProtocol; see client/shortcircuit.py)
+OP_SHORT_CIRCUIT = "short_circuit"
 
 STATUS_SUCCESS = "ok"
 STATUS_ERROR = "error"
 STATUS_ERROR_CHECKSUM = "checksum"
 
-PACKET_SIZE = 64 * 1024          # ref: dfs.client-write-packet-size
+# ref: dfs.client-write-packet-size. The reference ships 64 KB packets;
+# that sizing amortizes C/JNI per-packet costs. Here every per-packet step
+# is interpreted Python, so the bulk plane uses 1 MB packets — same
+# separated-checksum wire format (one CRC per 512 B chunk either way),
+# 16x fewer per-packet interpreter round trips per hop.
+PACKET_SIZE = 1024 * 1024
 CHUNK_SIZE = 512                 # ref: dfs.bytes-per-checksum
 
 # Pipeline stages (ref: BlockConstructionStage)
@@ -60,9 +68,9 @@ def recv_frame(sock: socket.socket) -> Dict:
 def connect(addr, timeout: float = 30.0) -> socket.socket:
     sock = socket.create_connection(addr, timeout=timeout)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-    # Throughput plane: fat buffers.
-    sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 1 << 20)
-    sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 20)
+    # Throughput plane: fat buffers (≥ a few packets in flight per hop).
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4 << 20)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4 << 20)
     return sock
 
 

@@ -14,7 +14,6 @@ benchmark harness (SURVEY §6).
 from __future__ import annotations
 
 import base64
-import hashlib
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from hadoop_tpu.fs import FileSystem
@@ -32,27 +31,34 @@ CUTS_KEY = "terasort.partition.cutpoints"
 
 def teragen(fs: FileSystem, out_dir: str, num_records: int,
             num_files: int = 3, seed: int = 1234) -> None:
-    """Deterministic 100-byte records, striped over ``num_files`` files.
-    Ref: TeraGen.java (its 10-byte keys come from a seeded PRNG too)."""
+    """Deterministic 100-byte records, striped over ``num_files`` files —
+    one vectorized numpy pass per ~64K-record chunk (the reference's
+    TeraGen is a counter-based PRNG per row too, ref: TeraGen.java
+    GenSort/Random16; per-row Python would bottleneck the whole bench)."""
+    import numpy as np
     fs.mkdirs(out_dir)
     per_file = [num_records // num_files] * num_files
     per_file[-1] += num_records - sum(per_file)
     row = 0
+    chunk_records = 65536
     for i, count in enumerate(per_file):
         stream = fs.create(f"{out_dir}/part-{i:05d}", overwrite=True)
         try:
-            buf = bytearray()
-            for _ in range(count):
-                key = hashlib.sha256(f"{seed}:{row}".encode()).digest()[:KEY_LEN]
-                payload = (f"{row:020d}".encode() +
-                           bytes((row + j) & 0x7F for j in range(70)))
-                buf += key + payload
-                row += 1
-                if len(buf) >= 1 << 20:
-                    stream.write(bytes(buf))
-                    buf.clear()
-            if buf:
-                stream.write(bytes(buf))
+            for start in range(0, count, chunk_records):
+                n = min(chunk_records, count - start)
+                rng = np.random.default_rng([seed, i, start])
+                rows_idx = np.arange(row, row + n, dtype=np.int64)
+                rec = np.empty((n, RECORD_LEN), dtype=np.uint8)
+                rec[:, :KEY_LEN] = rng.integers(
+                    0, 256, (n, KEY_LEN), dtype=np.uint8)
+                dec = np.char.zfill(rows_idx.astype("U20"), 20).astype("S20")
+                rec[:, KEY_LEN:KEY_LEN + 20] = np.frombuffer(
+                    dec.tobytes(), dtype=np.uint8).reshape(n, 20)
+                rec[:, KEY_LEN + 20:] = (
+                    (rows_idx[:, None] + np.arange(70)) & 0x7F
+                ).astype(np.uint8)
+                stream.write(rec.tobytes())
+                row += n
         finally:
             stream.close()
 
@@ -92,6 +98,12 @@ class TotalOrderPartitioner(Partitioner):
                 lo = mid + 1
         return min(lo, num_reduces - 1)
 
+    def native_spec(self, num_reduces: int):
+        """Range partitioning is expressible in the C++ collector — same
+        lower-bound search over the same cut points (native/src/collector
+        .cc range_part)."""
+        return ("range", self._cuts)
+
 
 def sample_cutpoints(fs: FileSystem, input_dir: str, num_reduces: int,
                      sample_per_file: int = 1000) -> List[bytes]:
@@ -121,7 +133,7 @@ def sample_cutpoints(fs: FileSystem, input_dir: str, num_reduces: int,
 
 def make_terasort_job(rm_addr, default_fs: str, input_dir: str,
                       output_dir: str, num_reduces: int = 3,
-                      split_mb: int = 1):
+                      split_mb: int = 32):
     from hadoop_tpu.mapreduce import Job
     fs = FileSystem.get(default_fs)
     try:
@@ -139,6 +151,12 @@ def make_terasort_job(rm_addr, default_fs: str, input_dir: str,
            .set_num_reduces(num_reduces)
            .set(FixedLengthInputFormat.RECORD_LENGTH_KEY, str(RECORD_LEN))
            .set("mapreduce.input.fixedlength.key.length", str(KEY_LEN))
+           # ref: TeraSortConfigKeys.OUTPUT_REPLICATION default 1 —
+           # the canonical benchmark writes its output unreplicated
+           .set("mapreduce.output.replication", "1")
+           # keep a whole partition's segments in memory through the merge
+           .set("mapreduce.reduce.shuffle.memory.limit",
+                str(512 * 1024 * 1024))
            .set("mapreduce.input.split.size", str(split_mb * 1024 * 1024))
            .set(CUTS_KEY,
                 ",".join(base64.b64encode(c).decode() for c in cuts)))
@@ -149,33 +167,56 @@ def make_terasort_job(rm_addr, default_fs: str, input_dir: str,
 
 
 def teravalidate(fs: FileSystem, output_dir: str) -> Tuple[int, List[str]]:
-    """Check global sort order + return (record_count, errors).
+    """Check global sort order + return (record_count, errors) — chunked
+    numpy passes (lexicographic key compare via two packed integers).
     Ref: TeraValidate.java — per-part order check + boundary check between
     consecutive parts via first/last keys."""
+    import numpy as np
     errors: List[str] = []
     total = 0
     prev_last: Optional[bytes] = None
     parts = sorted(st.path for st in fs.list_status(output_dir)
                    if not st.is_dir and "part-" in st.path)
+    chunk_bytes = (1 << 22) // RECORD_LEN * RECORD_LEN
     for path in parts:
         stream = fs.open(path)
         try:
-            last: Optional[bytes] = None
             first: Optional[bytes] = None
+            last: Optional[bytes] = None
+            carry = b""
             while True:
-                row = stream.read(RECORD_LEN)
-                if not row:
+                raw = stream.read(chunk_bytes)
+                if not raw:
                     break
-                if len(row) != RECORD_LEN:
-                    errors.append(f"{path}: short record {len(row)}B")
-                    break
-                key = row[:KEY_LEN]
+                raw = carry + raw
+                usable = len(raw) // RECORD_LEN * RECORD_LEN
+                carry = raw[usable:]
+                if not usable:
+                    continue
+                n = usable // RECORD_LEN
+                keys = np.frombuffer(raw, dtype=np.uint8,
+                                     count=usable).reshape(
+                    n, RECORD_LEN)[:, :KEY_LEN]
+                # 10-byte keys order-packed into (u64 hi, u16 lo)
+                hi = np.zeros(n, dtype=np.uint64)
+                for b in range(8):
+                    hi = (hi << np.uint64(8)) | keys[:, b].astype(np.uint64)
+                lo = (keys[:, 8].astype(np.uint16) << np.uint16(8)) | \
+                    keys[:, 9].astype(np.uint16)
+                inorder = (hi[1:] > hi[:-1]) | (
+                    (hi[1:] == hi[:-1]) & (lo[1:] >= lo[:-1]))
+                if not inorder.all():
+                    errors.append(f"{path}: out of order at record "
+                                  f"{total + int(np.argmin(inorder))}")
+                chunk_first = keys[0].tobytes()
                 if first is None:
-                    first = key
-                if last is not None and key < last:
+                    first = chunk_first
+                if last is not None and chunk_first < last:
                     errors.append(f"{path}: out of order at record {total}")
-                last = key
-                total += 1
+                last = keys[-1].tobytes()
+                total += n
+            if carry:
+                errors.append(f"{path}: short record {len(carry)}B")
             if first is not None and prev_last is not None \
                     and first < prev_last:
                 errors.append(f"{path}: first key below previous part's last")

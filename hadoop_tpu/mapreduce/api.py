@@ -111,21 +111,38 @@ class TaskContext:
     Ref: mapreduce/TaskInputOutputContext.java."""
 
     def __init__(self, conf: Dict[str, str], counters: Counters,
-                 emit, task_id: str = ""):
+                 emit, task_id: str = "", emit_batch=None):
         self.conf = conf
         self.counters = counters
         self._emit = emit
+        self._emit_batch = emit_batch
         self.task_id = task_id
 
     def emit(self, key: bytes, value: bytes) -> None:
         self._emit(key, value)
+
+    def emit_batch(self, packed: bytes) -> None:
+        """Emit one packed KV batch (mapreduce.batch format) — the fast
+        plane for batch-aware user code; falls back to per-record emit."""
+        if self._emit_batch is not None:
+            self._emit_batch(packed)
+            return
+        from hadoop_tpu.mapreduce.batch import iter_records
+        for k, v in iter_records(packed):
+            self._emit(k, v)
 
     def incr_counter(self, group: str, name: str, amount: int = 1) -> None:
         self.counters.incr((group, name), amount)
 
 
 class Mapper:
-    """Ref: mapreduce/Mapper.java — setup/map/cleanup template."""
+    """Ref: mapreduce/Mapper.java — setup/map/cleanup template.
+
+    Batch plane: a mapper may implement ``map_batch(packed, ctx)`` to
+    process whole packed KV batches (mapreduce.batch format) — the
+    engine then feeds it batches straight from the input format. The
+    un-overridden identity ``map`` is automatically batch-capable.
+    """
 
     def setup(self, ctx: TaskContext) -> None:
         pass
@@ -205,6 +222,12 @@ class InputFormat:
     def read(self, fs: FileSystem, split: FileSplit,
              conf: Dict[str, str]) -> Iterable[Tuple[bytes, bytes]]:
         raise NotImplementedError
+
+    def read_batches(self, fs: FileSystem, split: FileSplit,
+                     conf: Dict[str, str]) -> Optional[Iterable[bytes]]:
+        """Optional batch plane: yield packed KV batches
+        (mapreduce.batch format). None = format is per-record only."""
+        return None
 
 
 class TextInputFormat(InputFormat):
@@ -308,6 +331,37 @@ class FixedLengthInputFormat(InputFormat):
         finally:
             stream.close()
 
+    BATCH_BYTES = 4 * 1024 * 1024
+
+    def read_batches(self, fs, split, conf):
+        """Vectorized read: whole-MB reads → packed batches via numpy."""
+        rec = int(conf.get(self.RECORD_LENGTH_KEY, 100))
+        key_len = int(conf.get("mapreduce.input.fixedlength.key.length", 10))
+        from hadoop_tpu.mapreduce.batch import pack_fixed
+
+        def gen():
+            stream = fs.open(split.path)
+            try:
+                stream.seek(split.start)
+                remaining = split.length
+                chunk = max(rec, (self.BATCH_BYTES // rec) * rec)
+                carry = b""
+                while remaining > 0:
+                    raw = stream.read(min(chunk, remaining))
+                    if not raw:
+                        break
+                    remaining -= len(raw)
+                    if carry:
+                        raw = carry + raw
+                        carry = b""
+                    usable = (len(raw) // rec) * rec
+                    carry = raw[usable:]
+                    if usable:
+                        yield pack_fixed(raw[:usable], key_len, rec - key_len)
+            finally:
+                stream.close()
+        return gen()
+
 
 class OutputFormat:
     """Ref: mapreduce/OutputFormat.java. ``open`` returns a writer object
@@ -318,15 +372,42 @@ class OutputFormat:
 
 
 class _StreamWriter:
-    def __init__(self, stream, fmt):
+    def __init__(self, stream, fmt, concat_rows: bool = False):
         self._stream = stream
         self._fmt = fmt
+        self._concat_rows = concat_rows
+        # concat formats can take raw key+value rows with no translation
+        self.accepts_raw_rows = concat_rows
+
+    def write_raw_rows(self, raw: bytes) -> None:
+        self._stream.write(raw)
 
     def write(self, key: bytes, value: bytes) -> None:
         self._stream.write(self._fmt(key, value))
 
+    def write_batch(self, packed: bytes) -> None:
+        """Write one packed KV batch. Concat-row formats (key+value) strip
+        headers in one numpy pass when records are uniform."""
+        from hadoop_tpu.mapreduce import batch as _b
+        if self._concat_rows:
+            probe = _b.probe_fixed(packed)
+            if probe is not None:
+                raw = _b.unpack_fixed(packed, *probe)
+                if raw is not None:
+                    self._stream.write(raw)
+                    return
+        for k, v in _b.iter_records(packed):
+            self.write(k, v)
+
     def close(self) -> None:
         self._stream.close()
+
+
+def _output_replication(conf) -> Optional[int]:
+    """Job-level output replication override (the reference's terasort sets
+    mapreduce.terasort.output.replication=1 this way — TeraSort.java:275)."""
+    r = conf.get("mapreduce.output.replication", "")
+    return int(r) if r else None
 
 
 class TextOutputFormat(OutputFormat):
@@ -335,7 +416,8 @@ class TextOutputFormat(OutputFormat):
     def open(self, fs, path, conf):
         # separator omitted only for None values (null in the reference),
         # not for empty ones — field counts stay uniform per row.
-        return _StreamWriter(fs.create(path, overwrite=True),
+        return _StreamWriter(fs.create(path, overwrite=True,
+                                       replication=_output_replication(conf)),
                              lambda k, v: k + b"\t" + v + b"\n"
                              if v is not None else k + b"\n")
 
@@ -344,5 +426,6 @@ class FixedLengthOutputFormat(OutputFormat):
     """Concatenated key+value rows (terasort output)."""
 
     def open(self, fs, path, conf):
-        return _StreamWriter(fs.create(path, overwrite=True),
-                             lambda k, v: k + v)
+        return _StreamWriter(fs.create(path, overwrite=True,
+                                       replication=_output_replication(conf)),
+                             lambda k, v: k + v, concat_rows=True)

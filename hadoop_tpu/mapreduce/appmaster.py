@@ -184,8 +184,11 @@ class MRAppMaster:
         self.task_timeout = float(jconf.get("mapreduce.task.timeout", "120"))
         self.speculation = jconf.get(
             "mapreduce.map.speculative", "false") == "true"
+        # ref: mapred-default.xml mapreduce.job.reduce.slowstart
+        # .completedmaps = 0.05 — reduces launch early so shuffle
+        # overlaps the map wave
         self.slowstart = float(jconf.get(
-            "mapreduce.job.reduce.slowstart.completedmaps", "1.0"))
+            "mapreduce.job.reduce.slowstart.completedmaps", "0.05"))
         for i, split in enumerate(self.job["splits"]):
             tid = f"{self.job['job_id']}_m_{i:06d}"
             self.tasks[tid] = _Task(tid, "map", {"split": split})

@@ -31,6 +31,10 @@ log = logging.getLogger(__name__)
 ENV_SHUFFLE_DIR = "HTPU_SHUFFLE_DIR"
 ENV_SHUFFLE_PORT = "HTPU_SHUFFLE_PORT"
 
+from hadoop_tpu.util.misc import local_host_names  # noqa: E402
+
+_LOCAL_HOSTS = local_host_names()
+
 
 def map_output_paths(shuffle_dir: str, job_id: str,
                      map_task_id: str) -> Tuple[str, str]:
@@ -99,10 +103,31 @@ class ShuffleService:
                         write_frame(wfile, pack({"ok": True}))
                         wfile.flush()
                         continue
+                    if req.get("op") == "locate":
+                        write_frame(wfile, pack(self._locate(req)))
+                        wfile.flush()
+                        continue
                     write_frame(wfile, pack(self._fetch(req)))
                     wfile.flush()
         except (OSError, EOFError, ValueError) as e:
             log.debug("shuffle conn error: %s", e)
+
+    def _locate(self, req: Dict) -> Dict:
+        """Same-host fetch shortcut: hand back (path, offset, length) so
+        the reducer reads the segment file directly — the reference's
+        LocalFetcher does exactly this for local map outputs (ref:
+        mapreduce/task/reduce/LocalFetcher.java doCopy → spill file
+        read, no HTTP)."""
+        data_path, index_path = map_output_paths(
+            self.shuffle_dir, req["job"], req["map"])
+        try:
+            with open(index_path, "rb") as f:
+                index = ifile.SpillIndex.from_bytes(f.read())
+            off, length = index.range_for(req["partition"])
+            return {"ok": True, "path": data_path, "off": off,
+                    "len": length}
+        except (OSError, IndexError) as e:
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
 
     def _fetch(self, req: Dict) -> Dict:
         data_path, index_path = map_output_paths(
@@ -146,7 +171,12 @@ class ShuffleError(IOError):
 
 class MergeManager:
     """Reduce-side accumulation of fetched segments with disk spill.
-    Ref: MergeManagerImpl.java — in-memory merger + on-disk merger."""
+    Ref: MergeManagerImpl.java — in-memory merger + on-disk merger.
+
+    Uncompressed segments are kept as raw stored bytes (spilled verbatim
+    to disk over the memory limit) and k-way-merged ONCE, in C++, when
+    the reduce phase starts — per-record Python only happens for
+    compressed intermediates or when the native library is absent."""
 
     def __init__(self, local_dir: str, codec: Optional[str],
                  counters: Counters, mem_limit: int = 128 * 1024 * 1024):
@@ -154,6 +184,9 @@ class MergeManager:
         self.codec = codec
         self.counters = counters
         self.mem_limit = mem_limit
+        from hadoop_tpu import native as _nat
+        self._raw_mode = codec is None and _nat.available()
+        self._raw_segs: List[bytes] = []       # raw mode: stored segments
         self._mem_runs: List[List[Tuple[bytes, bytes]]] = []
         self._mem_bytes = 0
         self._disk_runs: List[str] = []
@@ -161,6 +194,23 @@ class MergeManager:
         os.makedirs(local_dir, exist_ok=True)
 
     def add_segment(self, stored: bytes) -> None:
+        if self._raw_mode:
+            with self._lock:
+                self.counters.incr(Counters.SHUFFLED_BYTES, len(stored))
+                if self._mem_bytes + len(stored) >= self.mem_limit:
+                    # over budget: decode (CRC-verified) and spill as a
+                    # STREAMABLE run so the final merge stays memory-
+                    # bounded, exactly like decode mode below
+                    path = os.path.join(
+                        self.local_dir,
+                        f"merge{len(self._disk_runs)}.out")
+                    ifile.write_stream(
+                        path, ifile.decode_records(stored, self.codec))
+                    self._disk_runs.append(path)
+                else:
+                    self._mem_bytes += len(stored)
+                    self._raw_segs.append(stored)
+            return
         records = list(ifile.decode_records(stored, self.codec))
         with self._lock:
             self._mem_runs.append(records)
@@ -177,13 +227,40 @@ class MergeManager:
         self._disk_runs.append(path)
         self._mem_runs, self._mem_bytes = [], 0
 
+    def merged_packed(self) -> Optional[bytes]:
+        """One packed KV buffer of every fetched record, key-sorted, merged
+        in C++ — the batch plane feeding batch-capable reducers/writers.
+        None when this manager isn't in raw mode or has disk spills (the
+        spilled case must stay memory-bounded → iterator path)."""
+        if not self._raw_mode or self._disk_runs:
+            return None
+        from hadoop_tpu import native as _nat
+        with self._lock:
+            segs = list(self._raw_segs)
+        return _nat.merge_segments(segs)
+
+    def merged_rows_counted(self):
+        """(concatenated key+value rows, record count) — the identity-
+        reduce → concat-output fast lane (no headers built or stripped).
+        None when not in raw mode or when segments spilled to disk."""
+        if not self._raw_mode or self._disk_runs:
+            return None
+        from hadoop_tpu import native as _nat
+        with self._lock:
+            segs = list(self._raw_segs)
+        return _nat.merge_segments_counted(segs, raw=True)
+
     def merged_iterator(self) -> Iterator[Tuple[bytes, bytes]]:
         """Final merge feeding the reducer: in-memory runs + lazily-streamed
         disk runs, so total memory stays ~mem_limit even when shuffled data
         far exceeds it. Ref: MergeManagerImpl.close (its finalMerge also
         mixes in-memory segments with on-disk streamed segments)."""
         with self._lock:
-            runs: List = list(self._mem_runs)
+            if self._raw_mode:
+                runs: List = [list(ifile.decode_records(s, self.codec))
+                              for s in self._raw_segs]
+            else:
+                runs = list(self._mem_runs)
             runs.extend(ifile.stream_records(p) for p in self._disk_runs)
         return merge_sorted_runs(runs)
 
@@ -245,12 +322,28 @@ class Fetcher:
                 map_id, addr_s = self._pending.pop()
             host, _, port = addr_s.rpartition(":")
             try:
-                resp = _request((host, int(port)), {
-                    "job": self.job_id, "map": map_id,
-                    "partition": self.partition})
-                if not resp.get("ok"):
-                    raise ShuffleError(resp.get("error", "fetch failed"))
-                self.merger.add_segment(resp["data"])
+                stored = None
+                if host in _LOCAL_HOSTS:
+                    # LocalFetcher lane (ref: LocalFetcher.java): read the
+                    # same-host segment file directly
+                    resp = _request((host, int(port)), {
+                        "op": "locate", "job": self.job_id, "map": map_id,
+                        "partition": self.partition})
+                    if resp.get("ok"):
+                        try:
+                            with open(resp["path"], "rb") as f:
+                                f.seek(resp["off"])
+                                stored = f.read(resp["len"])
+                        except OSError:
+                            stored = None  # renamed/purged → remote path
+                if stored is None:
+                    resp = _request((host, int(port)), {
+                        "job": self.job_id, "map": map_id,
+                        "partition": self.partition})
+                    if not resp.get("ok"):
+                        raise ShuffleError(resp.get("error", "fetch failed"))
+                    stored = resp["data"]
+                self.merger.add_segment(stored)
                 with self._cv:
                     self._done_count += 1
                     self._cv.notify_all()

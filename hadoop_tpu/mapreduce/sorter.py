@@ -45,6 +45,30 @@ CombinerFn = Optional[Callable[[Iterator[Tuple[bytes, List[bytes]]]],
                                Iterator[Tuple[bytes, bytes]]]]
 
 
+def _native_partition_spec(partitioner, num_partitions: int):
+    """(kind, cuts) for the C++ collector, or None when the partition
+    function is custom Python and must stay in Python.
+
+    Safe-by-construction: the base HashPartitioner qualifies only when its
+    ``partition`` is literally un-overridden (the C++ FNV-1a is its exact
+    twin); any other class must explicitly describe itself via
+    ``native_spec(num_partitions) -> ("hash"|"range", cuts)``.
+    """
+    if partitioner is None:
+        return None
+    from hadoop_tpu.mapreduce.api import Partitioner
+    spec = None
+    if hasattr(type(partitioner), "native_spec"):
+        spec = partitioner.native_spec(num_partitions)
+    elif type(partitioner).partition is Partitioner.partition:
+        spec = ("hash", [])
+    if spec is None:
+        return None
+    kind_s, cuts = spec
+    kind = {"hash": _nat.PART_HASH, "range": _nat.PART_RANGE}.get(kind_s)
+    return None if kind is None else (kind, list(cuts))
+
+
 def merge_sorted_runs(runs: List[List[Tuple[bytes, bytes]]]
                       ) -> Iterator[Tuple[bytes, bytes]]:
     """k-way merge of sorted (key, value) runs, stable by run order.
@@ -85,7 +109,7 @@ class MapOutputCollector:
     def __init__(self, num_partitions: int, partition_fn,
                  spill_dir: str, counters: Counters,
                  sort_mb: float = 64.0, codec: Optional[str] = None,
-                 combiner: CombinerFn = None):
+                 combiner: CombinerFn = None, partitioner=None):
         self.num_partitions = num_partitions
         self.partition_fn = partition_fn
         self.spill_dir = spill_dir
@@ -98,8 +122,31 @@ class MapOutputCollector:
         self._bytes = 0
         self._spills: List[Tuple[str, ifile.SpillIndex]] = []
         os.makedirs(spill_dir, exist_ok=True)
+        # Native batch engine (ref: nativetask) — engaged when the
+        # partition function is expressible in C++ (hash/range), there is
+        # no combiner, and spills aren't compressed. Anything else takes
+        # the Python path below.
+        self._native = None
+        self._pending: List[Tuple[bytes, bytes]] = []
+        self._pending_bytes = 0
+        spec = _native_partition_spec(partitioner, num_partitions)
+        if (spec is not None and combiner is None and codec is None
+                and _nat.available()):
+            kind, cuts = spec
+            self._native = _nat.NativeCollector(
+                max(num_partitions, 1), kind, cuts, spill_dir,
+                spill_limit=self.spill_bytes)
 
     def collect(self, key: bytes, value: bytes) -> None:
+        if self._native is not None:
+            self._pending.append((key, value))
+            self._pending_bytes += len(key) + len(value) + 8
+            self.counters.incr(Counters.MAP_OUTPUT_RECORDS)
+            self.counters.incr(Counters.MAP_OUTPUT_BYTES,
+                               len(key) + len(value))
+            if self._pending_bytes >= 1 << 20:
+                self._flush_pending()
+            return
         p = self.partition_fn(key, self.num_partitions)
         self._parts[p].append((key, value))
         self._bytes += len(key) + len(value) + 16
@@ -107,6 +154,28 @@ class MapOutputCollector:
         self.counters.incr(Counters.MAP_OUTPUT_BYTES, len(key) + len(value))
         if self._bytes >= self.spill_bytes:
             self._sort_and_spill()
+
+    def collect_batch(self, packed: bytes) -> None:
+        """Accept one packed KV batch (mapreduce.batch format)."""
+        if not packed:
+            return
+        if self._native is not None:
+            self._flush_pending()
+            n = self._native.feed(packed)
+            self.counters.incr(Counters.MAP_OUTPUT_RECORDS, n)
+            self.counters.incr(Counters.MAP_OUTPUT_BYTES,
+                               len(packed) - 8 * n)
+            return
+        from hadoop_tpu.mapreduce.batch import iter_records
+        for k, v in iter_records(packed):
+            self.collect(k, v)
+
+    def _flush_pending(self) -> None:
+        if self._pending:
+            from hadoop_tpu.mapreduce.batch import pack_records
+            self._native.feed(pack_records(self._pending))
+            self._pending = []
+            self._pending_bytes = 0
 
     # ------------------------------------------------------------- internals
 
@@ -139,6 +208,13 @@ class MapOutputCollector:
     def close(self, out_path: str) -> ifile.SpillIndex:
         """Merge spills + in-memory remainder into file.out (+ return index).
         Ref: MapTask.mergeParts."""
+        if self._native is not None:
+            self._flush_pending()
+            entries = self._native.close(out_path)
+            self._native.free()
+            self.counters.incr(Counters.SPILLED_RECORDS,
+                               sum(e[2] for e in entries))
+            return ifile.SpillIndex([tuple(e) for e in entries])
         if not self._spills:
             runs = self._sorted_runs()
             index = ifile.write_partitioned(out_path, runs, self.codec)

@@ -31,8 +31,8 @@ from hadoop_tpu.conf import Configuration
 from hadoop_tpu.fs import FileSystem
 from hadoop_tpu.ipc import Client, get_proxy
 from hadoop_tpu.mapreduce import ifile, shuffle
-from hadoop_tpu.mapreduce.api import (Counters, FileSplit, TaskContext,
-                                      load_class)
+from hadoop_tpu.mapreduce.api import (Counters, FileSplit, Mapper, Reducer,
+                                      TaskContext, load_class)
 from hadoop_tpu.mapreduce.sorter import (MapOutputCollector, group_by_key,
                                          make_combiner)
 
@@ -78,12 +78,15 @@ class _Reporter:
 
 
 def _await_commit(umbilical, attempt_id: str, timeout: float = 120.0) -> None:
-    """Ref: Task.commit — poll canCommit until granted."""
+    """Ref: Task.commit — poll canCommit until granted (the first poll
+    almost always grants; back off only while contended)."""
     deadline = time.monotonic() + timeout
+    delay = 0.01
     while time.monotonic() < deadline:
         if umbilical.can_commit(attempt_id):
             return
-        time.sleep(0.2)
+        time.sleep(delay)
+        delay = min(delay * 2, 0.2)
     raise TaskFailure("commit permission not granted in time")
 
 
@@ -114,25 +117,47 @@ def run_map(job: Dict, task: Dict, umbilical, attempt_id: str,
         max(num_reduces, 1), partitioner.partition,
         os.path.join(workdir, "spill"), counters,
         sort_mb=float(conf.get("mapreduce.task.io.sort.mb", "64")),
-        codec=codec, combiner=combiner)
+        codec=codec, combiner=combiner, partitioner=partitioner)
 
-    ctx = TaskContext(conf, counters, collector.collect, task["task_id"])
+    ctx = TaskContext(conf, counters, collector.collect, task["task_id"],
+                      emit_batch=collector.collect_batch)
     mapper.setup(ctx)
-    nrec = 0
-    for key, value in input_format.read(fs, split, conf):
-        counters.incr(Counters.MAP_INPUT_RECORDS)
-        mapper.map(key, value, ctx)
-        nrec += 1
-        if nrec % 1000 == 0:
-            reporter.set_progress(0.9 * min(1.0, nrec / (nrec + 1000)))
+    # Batch plane: when the input format can hand packed batches and the
+    # mapper is batch-capable (explicit map_batch, or the un-overridden
+    # identity map), records never surface as per-record Python objects.
+    batches = None
+    map_batch = getattr(type(mapper), "map_batch", None)
+    identity = type(mapper).map is Mapper.map and map_batch is None
+    if map_batch is not None or identity:
+        batches = input_format.read_batches(fs, split, conf)
+    t_read = time.monotonic()
+    if batches is not None:
+        from hadoop_tpu.mapreduce.batch import fast_count
+        for packed in batches:
+            counters.incr(Counters.MAP_INPUT_RECORDS, fast_count(packed))
+            if identity:
+                collector.collect_batch(packed)
+            else:
+                mapper.map_batch(packed, ctx)
+    else:
+        nrec = 0
+        for key, value in input_format.read(fs, split, conf):
+            counters.incr(Counters.MAP_INPUT_RECORDS)
+            mapper.map(key, value, ctx)
+            nrec += 1
+            if nrec % 1000 == 0:
+                reporter.set_progress(0.9 * min(1.0, nrec / (nrec + 1000)))
     mapper.cleanup(ctx)
 
+    t_mapped = time.monotonic()
     # attempt-named output; committed by rename (speculative attempts write
     # distinct files, only the one granted can_commit publishes).
     out_path, idx_path = shuffle.map_output_paths(
         shuffle_dir, job["job_id"], attempt_id)
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
     index = collector.close(out_path)
+    log.info("map %s: read+collect %.2fs sort+write %.2fs", attempt_id,
+             t_mapped - t_read, time.monotonic() - t_mapped)
     with open(idx_path, "wb") as f:
         f.write(index.to_bytes())
     reporter.set_progress(0.95)
@@ -153,6 +178,7 @@ def run_map(job: Dict, task: Dict, umbilical, attempt_id: str,
 
 def run_reduce(job: Dict, task: Dict, umbilical, attempt_id: str,
                reporter: _Reporter) -> None:
+    t_start = time.monotonic()
     conf = job["conf"]
     counters = reporter.counters
     partition = task["partition"]
@@ -187,6 +213,7 @@ def run_reduce(job: Dict, task: Dict, umbilical, attempt_id: str,
                 f"shuffle timed out with {got}/{num_maps} map outputs")
         time.sleep(0.1)
     fetcher.finish()
+    t_shuffled = time.monotonic()
     reporter.set_progress(0.35)
 
     # sort phase is free (runs are sorted; merge is streaming) → reduce phase
@@ -203,11 +230,37 @@ def run_reduce(job: Dict, task: Dict, umbilical, attempt_id: str,
 
     ctx = TaskContext(conf, counters, emit, task["task_id"])
     reducer.setup(ctx)
-    for key, values in group_by_key(merger.merged_iterator()):
-        counted = _CountingValues(values, counters)
-        reducer.reduce(key, counted, ctx)
+    # Batch plane: an identity reducer over a raw-mode merge never sees
+    # per-record Python — the C++ k-way merge hands one packed buffer
+    # straight to the writer's batch path.
+    identity = (type(reducer).reduce is Reducer.reduce
+                and not hasattr(type(reducer), "reduce_batch"))
+    rows = packed = None
+    if identity and getattr(writer, "accepts_raw_rows", False):
+        rows = merger.merged_rows_counted()
+    if rows is None and identity and hasattr(writer, "write_batch"):
+        packed = merger.merged_packed()
+    t_merged = time.monotonic()
+    if rows is not None:
+        buf, n = rows
+        counters.incr(Counters.REDUCE_INPUT_RECORDS, n)
+        counters.incr(Counters.REDUCE_OUTPUT_RECORDS, n)
+        writer.write_raw_rows(buf)
+    elif packed is not None:
+        from hadoop_tpu.mapreduce.batch import fast_count
+        n = fast_count(packed)
+        counters.incr(Counters.REDUCE_INPUT_RECORDS, n)
+        counters.incr(Counters.REDUCE_OUTPUT_RECORDS, n)
+        writer.write_batch(packed)
+    else:
+        for key, values in group_by_key(merger.merged_iterator()):
+            counted = _CountingValues(values, counters)
+            reducer.reduce(key, counted, ctx)
     reducer.cleanup(ctx)
     writer.close()
+    log.info("reduce %s: shuffle %.2fs merge %.2fs reduce+write %.2fs",
+             attempt_id, t_shuffled - t_start, t_merged - t_shuffled,
+             time.monotonic() - t_merged)
     reporter.set_progress(0.95)
 
     # two-phase commit (ref: FileOutputCommitter.commitTask)

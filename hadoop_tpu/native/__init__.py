@@ -69,6 +69,26 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
         ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
         ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint32)]
+    lib.htpu_coll_new.restype = ctypes.c_void_p
+    lib.htpu_coll_new.argtypes = [
+        ctypes.c_uint32, ctypes.c_int, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_uint64, ctypes.c_char_p]
+    lib.htpu_coll_free.restype = None
+    lib.htpu_coll_free.argtypes = [ctypes.c_void_p]
+    lib.htpu_coll_feed.restype = ctypes.c_int64
+    lib.htpu_coll_feed.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+    lib.htpu_coll_close.restype = ctypes.c_int64
+    lib.htpu_coll_close.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
+    lib.htpu_merge_segments.restype = ctypes.c_int64
+    lib.htpu_merge_segments.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_uint32, ctypes.c_int,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.htpu_buf_free.restype = None
+    lib.htpu_buf_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
     return lib
 
 
@@ -169,3 +189,76 @@ def sort_kv(keybuf: bytes, offs: Sequence[int], lens: Sequence[int],
     c_idx = (ctypes.c_uint32 * n)(*range(n))
     lib.htpu_sort_kv(keybuf, c_off, c_len, c_part, n, c_idx)
     return list(c_idx)
+
+
+# ------------------------------------------------- batch collector / merger
+
+PART_HASH = 0   # FNV-1a % R (matches mapreduce.api.Partitioner)
+PART_RANGE = 1  # sorted cutpoints (matches TotalOrderPartitioner)
+
+
+class NativeCollector:
+    """The nativetask-style batch collector: Python hands packed KV
+    batches; partition/sort/spill/IFile-encode run in C++ (ref:
+    hadoop-mapreduce-client-nativetask/src/main/native/src/lib)."""
+
+    def __init__(self, num_partitions: int, part_kind: int,
+                 cuts: Sequence[bytes], spill_dir: str,
+                 spill_limit: int = 256 * 1024 * 1024):
+        import struct
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        packed = b"".join(struct.pack("<I", len(c)) + c for c in cuts)
+        self._h = lib.htpu_coll_new(
+            num_partitions, part_kind, packed, len(packed),
+            spill_limit, spill_dir.encode())
+        self.num_partitions = num_partitions
+
+    def feed(self, packed: bytes) -> int:
+        n = self._lib.htpu_coll_feed(self._h, packed, len(packed))
+        if n < 0:
+            raise IOError("native collector: malformed batch or spill fail")
+        return n
+
+    def close(self, path: str) -> List[tuple]:
+        """Write final partitioned IFile; returns [(off, len, nrec)] * R."""
+        idx = (ctypes.c_uint64 * (3 * self.num_partitions))()
+        n = self._lib.htpu_coll_close(self._h, path.encode(), idx)
+        if n < 0:
+            raise IOError("native collector close failed")
+        return [(idx[3 * i], idx[3 * i + 1], idx[3 * i + 2])
+                for i in range(self.num_partitions)]
+
+    def free(self) -> None:
+        if self._h:
+            self._lib.htpu_coll_free(self._h)
+            self._h = None
+
+
+def merge_segments(segments: Sequence[bytes], raw: bool = False) -> bytes:
+    """K-way merge of stored IFile segments (codec=None) sorted by key.
+    raw=False → packed KV batch; raw=True → concatenated key+value rows
+    (identity-reduce fast lane). Ref: MergeManagerImpl final merge."""
+    buf, _ = merge_segments_counted(segments, raw)
+    return buf
+
+
+def merge_segments_counted(segments: Sequence[bytes],
+                           raw: bool = False) -> tuple:
+    """merge_segments + record count (saves a counting pass)."""
+    lib = get_lib()
+    n = len(segments)
+    seg_arr = (ctypes.c_char_p * n)(*segments)
+    len_arr = (ctypes.c_uint64 * n)(*[len(s) for s in segments])
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_uint64()
+    rc = lib.htpu_merge_segments(seg_arr, len_arr, n, 1 if raw else 0,
+                                 ctypes.byref(out), ctypes.byref(out_len))
+    if rc < 0:
+        raise IOError("native merge: checksum mismatch or malformed segment")
+    try:
+        return ctypes.string_at(out, out_len.value), rc
+    finally:
+        lib.htpu_buf_free(out)

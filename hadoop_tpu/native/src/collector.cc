@@ -1,0 +1,491 @@
+// Native map-output collector + shuffle merger — the batch data plane.
+//
+// Role parity with the reference's nativetask engine (ref:
+// hadoop-mapreduce-client-nativetask/src/main/native/src/lib/
+// {MapOutputCollector.cc,PartitionBucket.cc,Merge.cc} — the reference's
+// own conclusion that the map-side collect→partition→sort→spill loop and
+// the reduce-side merge must leave the managed runtime). Python hands
+// whole PACKED BATCHES of records across the ctypes boundary; everything
+// per-record — partitioning, sorting, spilling, IFile encode/decode,
+// k-way merge — happens here.
+//
+// Packed KV batch wire format (little-endian, shared with the Python
+// side and numpy writers):   repeated { u32 klen, u32 vlen, key, value }
+//
+// IFile segment format (must match hadoop_tpu/mapreduce/ifile.py,
+// codec=None): repeated { varint klen, varint vlen, key, value },
+// EOF marker 0xFFFFFFFF, then big-endian u32 CRC32C of the body.
+//
+// Spills: when the arena exceeds the spill limit the collector sorts
+// what it holds and writes one raw sorted run per spill (packed format,
+// with a partition directory); close() k-way-merges runs + the live
+// arena into the final partitioned IFile, exactly like
+// MapTask.mergeParts (ref: mapred/MapTask.java:1605).
+
+#include <algorithm>
+#include <cstddef>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <queue>
+#include <string>
+#include <vector>
+
+extern "C" uint32_t htpu_crc32c(uint32_t crc, const char* data, size_t len);
+
+namespace {
+
+struct Rec {
+  uint32_t part;
+  uint64_t off;    // offset of klen header in arena
+  uint32_t klen;
+  uint32_t vlen;
+};
+
+struct SpillRun {
+  std::string path;
+  // per-partition record counts so merge knows segment boundaries
+  std::vector<uint64_t> part_records;
+};
+
+struct Collector {
+  uint32_t num_parts = 1;
+  int part_kind = 0;              // 0 = FNV-1a hash, 1 = range cutpoints
+  std::vector<std::string> cuts;  // sorted, R-1 entries (range)
+  uint64_t spill_limit = 256ull << 20;
+  std::string spill_dir;
+  std::vector<uint8_t> arena;
+  std::vector<Rec> recs;
+  std::vector<SpillRun> spills;
+  uint64_t total_records = 0;
+  bool failed = false;
+};
+
+inline uint32_t fnv1a_mod(const uint8_t* key, uint32_t len, uint32_t mod) {
+  // must match hadoop_tpu.mapreduce.api.Partitioner.partition
+  uint64_t h = 0xcbf29ce484222325ull;
+  for (uint32_t i = 0; i < len; i++) {
+    h ^= key[i];
+    h *= 0x100000001b3ull;
+  }
+  return static_cast<uint32_t>(h % mod);
+}
+
+inline uint32_t range_part(const Collector& c, const uint8_t* key,
+                           uint32_t len) {
+  // lower_bound over cut points: first cut with key < cut
+  uint32_t lo = 0, hi = static_cast<uint32_t>(c.cuts.size());
+  while (lo < hi) {
+    uint32_t mid = (lo + hi) / 2;
+    const std::string& cut = c.cuts[mid];
+    int cmp = std::memcmp(key, cut.data(), std::min<size_t>(len, cut.size()));
+    if (cmp == 0) cmp = (len < cut.size()) ? -1 : (len > cut.size() ? 1 : 0);
+    if (cmp < 0)
+      hi = mid;
+    else
+      lo = mid + 1;
+  }
+  return std::min(lo, c.num_parts - 1);
+}
+
+inline int key_cmp(const uint8_t* ka, uint32_t la, const uint8_t* kb,
+                   uint32_t lb) {
+  int c = std::memcmp(ka, kb, la < lb ? la : lb);
+  if (c) return c;
+  return la < lb ? -1 : (la > lb ? 1 : 0);
+}
+
+void sort_recs(const std::vector<uint8_t>& arena, std::vector<Rec>& recs) {
+  const uint8_t* base = arena.data();
+  std::stable_sort(recs.begin(), recs.end(),
+                   [base](const Rec& a, const Rec& b) {
+                     if (a.part != b.part) return a.part < b.part;
+                     return key_cmp(base + a.off + 8, a.klen,
+                                    base + b.off + 8, b.klen) < 0;
+                   });
+}
+
+void put_varint(std::vector<uint8_t>& out, uint32_t n) {
+  while (true) {
+    uint8_t b = n & 0x7F;
+    n >>= 7;
+    if (n) {
+      out.push_back(b | 0x80);
+    } else {
+      out.push_back(b);
+      return;
+    }
+  }
+}
+
+bool write_all(FILE* f, const void* p, size_t n) {
+  return fwrite(p, 1, n, f) == n;
+}
+
+// ---------------------------------------------------------------- spilling
+
+bool spill_now(Collector* c) {
+  sort_recs(c->arena, c->recs);
+  SpillRun run;
+  run.path = c->spill_dir + "/nspill" + std::to_string(c->spills.size()) +
+             ".run";
+  run.part_records.assign(c->num_parts, 0);
+  FILE* f = fopen(run.path.c_str(), "wb");
+  if (!f) return false;
+  bool ok = true;
+  for (const Rec& r : c->recs) {
+    run.part_records[r.part]++;
+    ok = ok && write_all(f, c->arena.data() + r.off, 8ull + r.klen + r.vlen);
+  }
+  if (fclose(f) != 0) ok = false;  // close unconditionally — no fd leak
+  if (!ok) return false;
+  c->spills.push_back(std::move(run));
+  c->arena.clear();
+  c->arena.shrink_to_fit();
+  c->recs.clear();
+  return true;
+}
+
+// A streaming reader over one spill run (packed records, sorted by
+// (part, key) with per-partition counts known).
+struct RunReader {
+  FILE* f = nullptr;
+  std::vector<uint64_t> part_records;
+  std::vector<uint8_t> buf;
+  size_t pos = 0, len = 0;
+  bool eof = false;
+
+  bool fill(size_t need) {
+    if (len - pos >= need) return true;
+    std::memmove(buf.data(), buf.data() + pos, len - pos);
+    len -= pos;
+    pos = 0;
+    if (buf.size() < std::max<size_t>(need, 1 << 20))
+      buf.resize(std::max<size_t>(need, 1 << 20));
+    size_t got = fread(buf.data() + len, 1, buf.size() - len, f);
+    len += got;
+    return len >= need;
+  }
+  // Peek header of next record; false at end.
+  bool next(const uint8_t** rec, uint32_t* klen, uint32_t* vlen) {
+    if (!fill(8)) return false;
+    uint32_t kl, vl;
+    std::memcpy(&kl, buf.data() + pos, 4);
+    std::memcpy(&vl, buf.data() + pos + 4, 4);
+    if (!fill(8ull + kl + vl)) return false;
+    *rec = buf.data() + pos;
+    *klen = kl;
+    *vlen = vl;
+    return true;
+  }
+  void advance(uint32_t klen, uint32_t vlen) { pos += 8ull + klen + vlen; }
+};
+
+// ---------------------------------------------------------- IFile writing
+
+struct IFileWriter {
+  FILE* f = nullptr;
+  std::vector<uint8_t> seg;  // current segment body
+  uint64_t file_off = 0;
+  // index entries: (offset, stored_len, records)
+  std::vector<uint64_t> index;
+  uint64_t seg_records = 0;
+
+  void add(const uint8_t* key, uint32_t klen, const uint8_t* val,
+           uint32_t vlen) {
+    put_varint(seg, klen);
+    put_varint(seg, vlen);
+    seg.insert(seg.end(), key, key + klen);
+    seg.insert(seg.end(), val, val + vlen);
+    seg_records++;
+  }
+
+  bool end_segment() {
+    static const uint8_t kEof[4] = {0xFF, 0xFF, 0xFF, 0xFF};
+    seg.insert(seg.end(), kEof, kEof + 4);
+    uint32_t crc = htpu_crc32c(0, reinterpret_cast<const char*>(seg.data()),
+                               seg.size());
+    uint8_t crc_be[4] = {static_cast<uint8_t>(crc >> 24),
+                         static_cast<uint8_t>(crc >> 16),
+                         static_cast<uint8_t>(crc >> 8),
+                         static_cast<uint8_t>(crc)};
+    size_t stored = seg.size() + 4;
+    bool ok = write_all(f, seg.data(), seg.size()) &&
+              write_all(f, crc_be, 4);
+    index.push_back(file_off);
+    index.push_back(stored);
+    index.push_back(seg_records);
+    file_off += stored;
+    seg.clear();
+    seg_records = 0;
+    return ok;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// ------------------------------------------------------------- collector
+
+void* htpu_coll_new(uint32_t num_partitions, int part_kind,
+                    const uint8_t* cuts, size_t cuts_len,
+                    uint64_t spill_limit, const char* spill_dir) {
+  Collector* c = new Collector();
+  c->num_parts = num_partitions ? num_partitions : 1;
+  c->part_kind = part_kind;
+  c->spill_limit = spill_limit;
+  c->spill_dir = spill_dir ? spill_dir : ".";
+  size_t off = 0;
+  while (off + 4 <= cuts_len) {  // repeated {u32 len, bytes}
+    uint32_t n;
+    std::memcpy(&n, cuts + off, 4);
+    off += 4;
+    if (off + n > cuts_len) break;
+    c->cuts.emplace_back(reinterpret_cast<const char*>(cuts + off), n);
+    off += n;
+  }
+  return c;
+}
+
+void htpu_coll_free(void* h) { delete static_cast<Collector*>(h); }
+
+// Feed one packed batch. Returns number of records consumed, or -1.
+int64_t htpu_coll_feed(void* h, const uint8_t* buf, size_t len) {
+  Collector* c = static_cast<Collector*>(h);
+  if (c->failed) return -1;
+  size_t off = 0;
+  int64_t n = 0;
+  uint64_t arena_base = c->arena.size();
+  c->arena.insert(c->arena.end(), buf, buf + len);
+  while (off + 8 <= len) {
+    uint32_t klen, vlen;
+    std::memcpy(&klen, buf + off, 4);
+    std::memcpy(&vlen, buf + off + 4, 4);
+    if (off + 8ull + klen + vlen > len) {
+      c->failed = true;
+      return -1;  // malformed batch
+    }
+    const uint8_t* key = buf + off + 8;
+    uint32_t part = c->part_kind == 1
+                        ? range_part(*c, key, klen)
+                        : fnv1a_mod(key, klen, c->num_parts);
+    c->recs.push_back(Rec{part, arena_base + off, klen, vlen});
+    off += 8ull + klen + vlen;
+    n++;
+  }
+  if (off != len) {
+    c->failed = true;
+    return -1;
+  }
+  c->total_records += n;
+  if (c->arena.size() >= c->spill_limit) {
+    if (!spill_now(c)) {
+      c->failed = true;
+      return -1;
+    }
+  }
+  return n;
+}
+
+// Sort + merge spills + write the final partitioned IFile.
+// index_out must hold 3*num_partitions u64s. Returns total records or -1.
+int64_t htpu_coll_close(void* h, const char* path, uint64_t* index_out) {
+  Collector* c = static_cast<Collector*>(h);
+  if (c->failed) return -1;
+  sort_recs(c->arena, c->recs);
+
+  IFileWriter w;
+  w.f = fopen(path, "wb");
+  if (!w.f) return -1;
+
+  bool ok = true;
+  if (c->spills.empty()) {
+    // single in-memory pass
+    size_t i = 0;
+    for (uint32_t p = 0; p < c->num_parts && ok; p++) {
+      while (i < c->recs.size() && c->recs[i].part == p) {
+        const Rec& r = c->recs[i];
+        const uint8_t* rec = c->arena.data() + r.off;
+        w.add(rec + 8, r.klen, rec + 8 + r.klen, r.vlen);
+        i++;
+      }
+      ok = w.end_segment();
+    }
+  } else {
+    // merge: spill runs + the live arena (as a virtual run)
+    std::vector<RunReader> readers(c->spills.size());
+    for (size_t s = 0; s < c->spills.size() && ok; s++) {
+      readers[s].f = fopen(c->spills[s].path.c_str(), "rb");
+      readers[s].part_records = c->spills[s].part_records;
+      ok = readers[s].f != nullptr;
+    }
+    size_t mem_i = 0;
+    for (uint32_t p = 0; p < c->num_parts && ok; p++) {
+      // heap entries: (key ptr/len, source) — source nspills = memory
+      struct Head {
+        const uint8_t* rec;
+        uint32_t klen, vlen;
+        size_t src;
+        uint64_t remaining;  // records left in this partition (disk runs)
+      };
+      auto gt = [](const Head& a, const Head& b) {
+        int cmp = key_cmp(a.rec + 8, a.klen, b.rec + 8, b.klen);
+        if (cmp) return cmp > 0;
+        return a.src > b.src;  // stable by run order
+      };
+      std::priority_queue<Head, std::vector<Head>, decltype(gt)> heap(gt);
+      for (size_t s = 0; s < readers.size(); s++) {
+        uint64_t rem = readers[s].part_records[p];
+        if (!rem) continue;
+        const uint8_t* rec;
+        uint32_t kl, vl;
+        if (readers[s].next(&rec, &kl, &vl))
+          heap.push(Head{rec, kl, vl, s, rem});
+      }
+      uint64_t mem_rem = 0;
+      {
+        size_t j = mem_i;
+        while (j < c->recs.size() && c->recs[j].part == p) {
+          j++;
+          mem_rem++;
+        }
+      }
+      if (mem_rem) {
+        const Rec& r = c->recs[mem_i];
+        heap.push(Head{c->arena.data() + r.off, r.klen, r.vlen,
+                       readers.size(), mem_rem});
+      }
+      while (!heap.empty() && ok) {
+        Head t = heap.top();
+        heap.pop();
+        w.add(t.rec + 8, t.klen, t.rec + 8 + t.klen, t.vlen);
+        if (t.src < readers.size()) {
+          readers[t.src].advance(t.klen, t.vlen);
+          if (--t.remaining) {
+            const uint8_t* rec;
+            uint32_t kl, vl;
+            if (readers[t.src].next(&rec, &kl, &vl)) {
+              heap.push(Head{rec, kl, vl, t.src, t.remaining});
+            } else {
+              ok = false;  // truncated run
+            }
+          }
+        } else {
+          mem_i++;
+          if (--t.remaining) {
+            const Rec& r = c->recs[mem_i];
+            heap.push(Head{c->arena.data() + r.off, r.klen, r.vlen,
+                           readers.size(), t.remaining});
+          }
+        }
+      }
+      ok = ok && w.end_segment();
+    }
+    for (auto& rd : readers)
+      if (rd.f) fclose(rd.f);
+    for (auto& sp : c->spills) std::remove(sp.path.c_str());
+  }
+
+  ok = fclose(w.f) == 0 && ok;
+  if (!ok) return -1;
+  for (size_t i = 0; i < w.index.size() && i < 3ull * c->num_parts; i++)
+    index_out[i] = w.index[i];
+  return static_cast<int64_t>(c->total_records);
+}
+
+// -------------------------------------------------------- reduce-side merge
+
+// K-way merge of IFile segments (stored bytes incl. EOF+CRC, codec=None),
+// sorted by key (stable by segment order). mode 0: packed KV batch
+// ({u32 klen, u32 vlen, k, v}); mode 1: raw concatenated key+value rows
+// (the identity-reduce → fixed-length-output fast lane — no headers to
+// strip afterwards). Returns record count, or -1 (bad CRC / malformed).
+// *out is malloc'd; free with htpu_buf_free.
+int64_t htpu_merge_segments(const uint8_t** segs, const uint64_t* lens,
+                            uint32_t nsegs, int mode, uint8_t** out,
+                            uint64_t* out_len) {
+  struct Cursor {
+    const uint8_t* p;
+    const uint8_t* end;  // at EOF marker
+    const uint8_t* key;
+    uint32_t klen, vlen;
+    size_t src;
+  };
+  auto read_varint = [](const uint8_t*& p) {
+    uint32_t n = 0;
+    int shift = 0;
+    while (true) {
+      uint8_t b = *p++;
+      n |= (b & 0x7Fu) << shift;
+      if (!(b & 0x80)) return n;
+      shift += 7;
+    }
+  };
+  auto load = [&](Cursor& c) -> bool {  // false = segment exhausted
+    if (c.p + 4 <= c.end && c.p[0] == 0xFF && c.p[1] == 0xFF &&
+        c.p[2] == 0xFF && c.p[3] == 0xFF)
+      return false;
+    if (c.p >= c.end) return false;
+    c.klen = read_varint(c.p);
+    c.vlen = read_varint(c.p);
+    c.key = c.p;
+    c.p += c.klen + c.vlen;
+    return c.p <= c.end;
+  };
+
+  std::vector<Cursor> curs;
+  uint64_t total_bytes = 0;
+  for (uint32_t s = 0; s < nsegs; s++) {
+    if (lens[s] < 8) continue;  // empty segment: EOF + CRC only
+    const uint8_t* body = segs[s];
+    uint64_t blen = lens[s] - 4;
+    uint32_t want = (static_cast<uint32_t>(segs[s][lens[s] - 4]) << 24) |
+                    (static_cast<uint32_t>(segs[s][lens[s] - 3]) << 16) |
+                    (static_cast<uint32_t>(segs[s][lens[s] - 2]) << 8) |
+                    static_cast<uint32_t>(segs[s][lens[s] - 1]);
+    uint32_t got =
+        htpu_crc32c(0, reinterpret_cast<const char*>(body), blen);
+    if (got != want) return -1;
+    Cursor c{body, body + blen - 4, nullptr, 0, 0, s};
+    if (load(c)) curs.push_back(c);
+    total_bytes += blen;
+  }
+
+  auto gt = [](const Cursor& a, const Cursor& b) {
+    int cmp = key_cmp(a.key, a.klen, b.key, b.klen);
+    if (cmp) return cmp > 0;
+    return a.src > b.src;
+  };
+  std::priority_queue<Cursor, std::vector<Cursor>, decltype(gt)> heap(gt);
+  for (auto& c : curs) heap.push(c);
+
+  std::vector<uint8_t> ob;
+  // packed headers are 8B vs ~2-4B varints, so reserve with headroom
+  ob.reserve(total_bytes + total_bytes / 2 + 16);
+  int64_t n = 0;
+  while (!heap.empty()) {
+    Cursor c = heap.top();
+    heap.pop();
+    uint32_t kl = c.klen, vl = c.vlen;
+    if (mode == 0) {
+      ob.insert(ob.end(), reinterpret_cast<uint8_t*>(&kl),
+                reinterpret_cast<uint8_t*>(&kl) + 4);
+      ob.insert(ob.end(), reinterpret_cast<uint8_t*>(&vl),
+                reinterpret_cast<uint8_t*>(&vl) + 4);
+    }
+    ob.insert(ob.end(), c.key, c.key + kl + vl);
+    n++;
+    if (load(c)) heap.push(c);
+  }
+  uint8_t* flat = static_cast<uint8_t*>(malloc(ob.size() ? ob.size() : 1));
+  if (!flat) return -1;
+  std::memcpy(flat, ob.data(), ob.size());
+  *out = flat;
+  *out_len = ob.size();
+  return n;
+}
+
+void htpu_buf_free(uint8_t* p) { free(p); }
+
+}  // extern "C"

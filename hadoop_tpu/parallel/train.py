@@ -35,9 +35,10 @@ from hadoop_tpu.models.decoder import (embed_tokens, final_hidden,
 from hadoop_tpu.models.decoder import init_params as _init_params
 from hadoop_tpu.ops import rope_frequencies
 from hadoop_tpu.ops.cross_entropy import chunked_lm_cross_entropy
-from hadoop_tpu.parallel.mesh import MeshPlan, param_specs, shard_params
+from hadoop_tpu.parallel.mesh import AXES, MeshPlan, param_specs, \
+    shard_params
 from hadoop_tpu.parallel.optimizer import (AdamWState, adamw_init,
-                                           adamw_update)
+                                           adamw_update, zero1_update)
 
 try:  # stable name first, experimental fallback
     _shard_map_fn = jax.shard_map  # type: ignore[attr-defined]
@@ -70,6 +71,53 @@ def _spec_axes(spec) -> set:
     return names
 
 
+def _spec_axes_ordered(spec) -> list:
+    names = []
+    for part in spec:
+        if part is None:
+            continue
+        for a in (part if isinstance(part, tuple) else (part,)):
+            names.append(a)
+    return names
+
+
+def zero1_layout(cfg: ModelConfig, plan: MeshPlan):
+    """Per-leaf ZeRO-1 state layout: (data axes partitioning the state,
+    global state shape, state PartitionSpec). State leaves are
+    ``(*spec_axis_sizes, *data_axis_sizes, K)`` arrays whose spec names
+    every leading axis, so the per-rank piece is one (K,) slice —
+    optimizer memory ÷ (dp·ep) for replicated leaves."""
+    import numpy as np
+    shapes = jax.eval_shape(
+        lambda: _init_params(jax.random.PRNGKey(0), cfg))
+    specs = param_specs(cfg, plan)
+    sizes = dict(zip(AXES, (plan.dp, plan.pp, plan.tp, plan.ep, plan.sp)))
+    data_axes = plan.batch_axes
+
+    class _Leaf:  # opaque (not a pytree) so tree_map treats it atomically
+        __slots__ = ("z_ax", "shape", "spec")
+
+        def __init__(self, z_ax, shape, spec):
+            self.z_ax, self.shape, self.spec = z_ax, shape, spec
+
+    def leaf(sh, spec):
+        spec_ax = _spec_axes_ordered(spec)
+        z_ax = tuple(a for a in data_axes if a not in spec_ax)
+        denom = int(np.prod([sizes[a] for a in spec_ax])) if spec_ax else 1
+        local = max(1, int(np.prod(sh.shape)) // denom)
+        z = int(np.prod([sizes[a] for a in z_ax])) if z_ax else 1
+        k = (local + z - 1) // z
+        state_shape = tuple(sizes[a] for a in spec_ax) + \
+            tuple(sizes[a] for a in z_ax) + (k,)
+        return _Leaf(z_ax, state_shape, P(*spec_ax, *z_ax, None))
+
+    layout = jax.tree_util.tree_map(leaf, shapes, specs)
+    axes_tree = jax.tree_util.tree_map(lambda lo: lo.z_ax, layout)
+    shape_tree = jax.tree_util.tree_map(lambda lo: lo.shape, layout)
+    spec_tree = jax.tree_util.tree_map(lambda lo: lo.spec, layout)
+    return axes_tree, shape_tree, spec_tree, sizes
+
+
 def _loss_from_h(params, h, targets, cfg: ModelConfig, ctx,
                  chunk: int = 256):
     """LM loss from pre-head hidden states, chunked over the sequence so
@@ -87,7 +135,7 @@ def _loss_from_h(params, h, targets, cfg: ModelConfig, ctx,
 def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
                     lr: float = 3e-4, n_microbatches: int = 1,
                     remat: bool = False, donate: bool = True,
-                    optimizer: str = "adamw",
+                    optimizer: str = "adamw", zero1: bool = False,
                     pipeline_schedule: str = "1f1b"):
     """Build the jitted sharded train step.
 
@@ -221,6 +269,26 @@ def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
         grads = _reduce_grads(grads)
         loss = loss / loss_div
         gsq = _global_grad_sq(grads)
+        if zero1 and optimizer == "adamw":
+            mu_l = jax.tree_util.tree_map(
+                lambda m: m.reshape(-1), opt_state.mu)
+            nu_l = jax.tree_util.tree_map(
+                lambda n: n.reshape(-1), opt_state.nu)
+            new_params, new_opt_l, gnorm = zero1_update(
+                params, grads,
+                AdamWState(opt_state.count, mu_l, nu_l), lr,
+                leaf_axes=z1_axes, mesh_axis_sizes=z1_sizes, gsq=gsq)
+            # restore the (1,...,1,K) local state layout for out_specs
+            new_opt = AdamWState(
+                new_opt_l.count,
+                jax.tree_util.tree_map(
+                    lambda n2, old: n2.reshape(old.shape),
+                    new_opt_l.mu, opt_state.mu),
+                jax.tree_util.tree_map(
+                    lambda n2, old: n2.reshape(old.shape),
+                    new_opt_l.nu, opt_state.nu))
+            metrics = {"loss": loss, "grad_norm": gnorm}
+            return new_params, new_opt, metrics
         if optimizer == "sgd":
             # plain SGD: exact-parity testing mode (no adaptive-state
             # amplification of float accumulation noise)
@@ -237,7 +305,12 @@ def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
         metrics = {"loss": loss, "grad_norm": gnorm}
         return new_params, new_opt, metrics
 
-    opt_specs = AdamWState(count=P(), mu=specs, nu=specs)
+    if zero1 and optimizer == "adamw":
+        z1_axes, _, z1_specs, z1_sizes = zero1_layout(cfg, plan)
+        opt_specs = AdamWState(count=P(), mu=z1_specs, nu=z1_specs)
+    else:
+        z1_axes = z1_sizes = None
+        opt_specs = AdamWState(count=P(), mu=specs, nu=specs)
     mapped = _smap(
         body, mesh,
         in_specs=(specs, opt_specs, data_spec, data_spec),
@@ -245,11 +318,31 @@ def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
     return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
 
 
-def init_sharded(rng, cfg: ModelConfig, plan: MeshPlan, mesh: Mesh):
-    """Initialize params + optimizer state and place them on the mesh."""
+def init_sharded(rng, cfg: ModelConfig, plan: MeshPlan, mesh: Mesh,
+                 zero1: bool = False):
+    """Initialize params + optimizer state and place them on the mesh.
+    ``zero1``: moment state in the ZeRO-1 slice layout (must match the
+    train step's flag)."""
     params = _init_params(rng, cfg)
     specs = param_specs(cfg, plan)
     params = shard_params(params, mesh, specs)
+    if zero1:
+        _, z1_shapes, z1_specs, _ = zero1_layout(cfg, plan)
+        def mk(shape, spec):
+            return jax.device_put(
+                jnp.zeros(shape, jnp.float32),
+                jax.sharding.NamedSharding(mesh, spec))
+        mu = jax.tree_util.tree_map(
+            mk, z1_shapes, z1_specs,
+            is_leaf=lambda x: isinstance(x, tuple))
+        nu = jax.tree_util.tree_map(
+            mk, z1_shapes, z1_specs,
+            is_leaf=lambda x: isinstance(x, tuple))
+        return params, AdamWState(
+            count=jax.device_put(
+                jnp.zeros((), jnp.int32),
+                jax.sharding.NamedSharding(mesh, P())),
+            mu=mu, nu=nu)
     opt = adamw_init(params)
     opt = AdamWState(
         count=jax.device_put(

@@ -125,3 +125,18 @@ class RetryOnException:
                     raise
                 time.sleep(delay)
                 delay *= self.backoff
+
+
+def local_host_names() -> set:
+    """Names/addresses that mean "this host" — shared by the short-circuit
+    read lane and the local shuffle fetch lane (ref: the reference's
+    DomainSocketFactory.getPathInfo locality check)."""
+    import socket as _socket
+    names = {"127.0.0.1", "localhost", "::1"}
+    try:
+        hn = _socket.gethostname()
+        names.add(hn)
+        names.add(_socket.gethostbyname(hn))
+    except OSError:
+        pass
+    return names

@@ -226,6 +226,14 @@ class NodeAgent(AbstractService):
             env["HTPU_WORK_DIR"] = rc.workdir
             if rc.chips:
                 env["HTPU_TPU_CHIPS"] = ",".join(map(str, rc.chips))
+            else:
+                # Device isolation both ways: a container that was not
+                # granted chips must not attach to the host's TPU runtime
+                # (the accelerator plugin initializes via sitecustomize and
+                # costs ~2s of process startup — the dominant term in task
+                # launch latency). Clearing the trigger var disables it;
+                # empty string is falsy for the plugin's gate.
+                env["PALLAS_AXON_POOL_IPS"] = ""
             rc.proc = self.executor.launch(rc.workdir, rc.ctx.commands, env)
             rc.state = "RUNNING"
             exit_code = rc.proc.wait()

@@ -265,3 +265,51 @@ def test_datanode_report_and_stats(cluster, fs):
     report = fs.client.nn.get_datanode_report("live")
     assert len(report) >= 3
     assert all(r["st"] == "live" for r in report)
+
+
+def test_short_circuit_local_read(cluster, fs):
+    """Same-host reads take the direct-file path (ref:
+    ShortCircuitCache.java:72 / BlockReaderFactory.java:354-381)."""
+    from hadoop_tpu.dfs.client.shortcircuit import ShortCircuitCache
+    data = os.urandom(2 * 1024 * 1024 + 12345)  # spans blocks
+    with fs.create("/sc.bin") as out:
+        out.write(data)
+    cache = ShortCircuitCache.get()
+    hits0, reqs0 = cache.hits, cache.requests
+    with fs.open("/sc.bin") as f:
+        assert f.read() == data
+    assert cache.hits > hits0          # local path actually taken
+    assert cache.requests > reqs0
+
+
+def test_short_circuit_disabled_by_conf(cluster, fs):
+    from hadoop_tpu.dfs.client.streams import DFSInputStream
+    data = os.urandom(10_000)
+    fs.write_all("/sc3.bin", data)
+    # flag plumbed through the stream (TCP path still correct)
+    s = DFSInputStream(fs.client, "/sc3.bin")
+    assert s._short_circuit_ok  # default on
+    fs.client.conf.set("dfs.client.read.shortcircuit", "false")
+    try:
+        s2 = DFSInputStream(fs.client, "/sc3.bin")
+        assert not s2._short_circuit_ok
+        assert s2.read() == data  # remote path works
+    finally:
+        fs.client.conf.set("dfs.client.read.shortcircuit", "true")
+
+
+def test_short_circuit_fallback_when_replica_moved(cluster, fs):
+    """A stale cached path falls back to TCP instead of failing."""
+    from hadoop_tpu.dfs.client import shortcircuit as scmod
+    data = os.urandom(100_000)
+    with fs.create("/sc2.bin") as out:
+        out.write(data)
+    cache = scmod.ShortCircuitCache.get()
+    with fs.open("/sc2.bin") as f:
+        assert f.read(10) == data[:10]
+    # poison every cached slot's data path; next read must still succeed
+    with cache._lock:
+        for slot in cache._slots.values():
+            slot.data_path = slot.data_path + ".gone"
+    with fs.open("/sc2.bin") as f:
+        assert f.read() == data
