@@ -1,0 +1,144 @@
+"""Trainer ⇄ DFS integration: sharded checkpoints + streaming dataloader.
+
+The acceptance bar (VERDICT r2 item 3): kill a training run mid-stream,
+resume from the DFS checkpoint, and the loss curve continues EXACTLY as
+an uninterrupted run — params, optimizer moments, and the data cursor all
+round-trip through the framework's own storage layer.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from hadoop_tpu.models import get_config
+from hadoop_tpu.parallel import MeshPlan
+from hadoop_tpu.parallel.checkpoint import (latest_step, list_checkpoints,
+                                            load_checkpoint,
+                                            save_checkpoint)
+from hadoop_tpu.testing.minicluster import MiniDFSCluster
+
+BATCH = 8
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MiniDFSCluster(num_datanodes=3) as c:
+        yield c
+
+
+@pytest.fixture(scope="module")
+def fs(cluster):
+    return cluster.get_filesystem()
+
+
+@pytest.fixture(scope="module")
+def token_file(fs):
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 256, 200_000, dtype=np.uint16)
+    fs.mkdirs("/data")
+    fs.write_all("/data/tokens.bin", toks.tobytes())
+    return "/data/tokens.bin"
+
+
+def _trainer(fs, token_file, ckpt_dir, zero1=False, interval=0):
+    from hadoop_tpu.parallel.trainer import Trainer
+    cfg = get_config("tiny")
+    return Trainer(cfg, MeshPlan(dp=2, tp=2), fs, token_file, ckpt_dir,
+                   batch=BATCH, lr=1e-2, optimizer="adamw", zero1=zero1,
+                   ckpt_interval=interval)
+
+
+def test_resume_continues_loss_curve_exactly(fs, token_file):
+    # uninterrupted 6-step run
+    ref = _trainer(fs, token_file, "/ckpt/ref")
+    ref_losses = ref.train(6)
+
+    # crashed run: 3 steps, checkpoint, new process (fresh Trainer), resume
+    a = _trainer(fs, token_file, "/ckpt/crash")
+    a_losses = a.train(3)
+    a.save()
+    del a
+
+    b = _trainer(fs, token_file, "/ckpt/crash")
+    assert b.try_restore()
+    assert b.step == 3
+    b_losses = b.train(3)
+
+    np.testing.assert_allclose(a_losses, ref_losses[:3], rtol=1e-6)
+    np.testing.assert_allclose(b_losses, ref_losses[3:], rtol=1e-6)
+
+
+def test_resume_zero1_state_roundtrip(fs, token_file):
+    a = _trainer(fs, token_file, "/ckpt/z1", zero1=True)
+    a_losses = a.train(4)
+    a.save()
+
+    b = _trainer(fs, token_file, "/ckpt/z1", zero1=True)
+    assert b.try_restore()
+    b_losses = b.train(2)
+
+    ref = _trainer(fs, token_file, "/ckpt/z1ref", zero1=True)
+    ref_losses = ref.train(6)
+    np.testing.assert_allclose(a_losses + b_losses, ref_losses, rtol=1e-6)
+
+
+def test_checkpoint_resharding_across_plans(fs, token_file):
+    """A checkpoint saved under dp2×tp2 loads into dp4 (and back) — the
+    global-value manifest makes resharding at load free."""
+    from hadoop_tpu.parallel.trainer import Trainer
+    cfg = get_config("tiny")
+    t1 = Trainer(cfg, MeshPlan(dp=2, tp=2), fs, token_file, "/ckpt/rs",
+                 batch=BATCH, lr=1e-2, ckpt_interval=0)
+    t1.train(2)
+    t1.save()
+    expect = jax.tree_util.tree_map(np.asarray, jax.device_get(t1.params))
+
+    t2 = Trainer(cfg, MeshPlan(dp=4), fs, token_file, "/ckpt/rs",
+                 batch=BATCH, lr=1e-2, ckpt_interval=0)
+    assert t2.try_restore()
+    got = jax.tree_util.tree_map(np.asarray, jax.device_get(t2.params))
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(expect),
+            jax.tree_util.tree_leaves_with_path(got)):
+        np.testing.assert_array_equal(a, b, err_msg=str(pa))
+
+
+def test_checkpoint_retention_and_atomicity(fs, token_file):
+    a = _trainer(fs, token_file, "/ckpt/keep", interval=1)
+    a.keep = 2
+    a.train(5)
+    steps = list_checkpoints(fs, "/ckpt/keep")
+    assert steps == [4, 5]
+    assert latest_step(fs, "/ckpt/keep") == 5
+    # a torn tmp dir is never listed as a checkpoint
+    fs.mkdirs("/ckpt/keep/step_000000000099._tmp")
+    assert latest_step(fs, "/ckpt/keep") == 5
+
+
+def test_save_load_plain_tree(fs):
+    tree = {"a": jax.numpy.arange(12, dtype=jax.numpy.float32)
+            .reshape(3, 4), "n": jax.numpy.zeros((), jax.numpy.int32)}
+    save_checkpoint(fs, "/ckpt/plain", 7, tree)
+    like = {"a": np.zeros((3, 4), np.float32),
+            "n": np.zeros((), np.int32)}
+    out, step = load_checkpoint(fs, "/ckpt/plain", like)
+    assert step == 7
+    np.testing.assert_array_equal(np.asarray(out["a"]),
+                                  np.asarray(tree["a"]))
+
+
+def test_dataloader_state_roundtrip(fs, token_file):
+    from hadoop_tpu.parallel.data import TokenDataset
+    d1 = TokenDataset(fs, token_file, batch=4, seq=32)
+    first = [d1.next_batch() for _ in range(3)]
+    st = d1.state()
+    nxt = d1.next_batch()
+
+    d2 = TokenDataset(fs, token_file, batch=4, seq=32)
+    d2.restore(st)
+    np.testing.assert_array_equal(d2.next_batch(), nxt)
+
+    # deterministic from the start too
+    d3 = TokenDataset(fs, token_file, batch=4, seq=32)
+    np.testing.assert_array_equal(d3.next_batch(), first[0])
