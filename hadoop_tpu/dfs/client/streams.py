@@ -60,6 +60,29 @@ class PipelineError(IOError):
         self.bad_node = bad_node
 
 
+class DFSClientFaultInjector:
+    """Overridable fault points on the client write path (ref:
+    hadoop-hdfs-client DFSClientFaultInjector.java — tests subclass the
+    singleton to fail the stream at exact packets/acks)."""
+
+    _instance: "DFSClientFaultInjector" = None  # type: ignore[assignment]
+
+    @classmethod
+    def get(cls) -> "DFSClientFaultInjector":
+        if cls._instance is None:
+            cls._instance = DFSClientFaultInjector()
+        return cls._instance
+
+    @classmethod
+    def set(cls, inst) -> None:
+        cls._instance = inst
+
+    # ---- hooks (no-ops by default) ----
+    def before_send_packet(self, block: Block, seq: int) -> None: ...
+    def on_ack(self, block: Block, seq: int) -> None: ...
+    def before_pipeline_setup(self, locations) -> None: ...
+
+
 class DFSOutputStream:
     def __init__(self, client, path: str, packet_size: int = dt.PACKET_SIZE,
                  chunk_size: int = dt.CHUNK_SIZE):
@@ -127,9 +150,12 @@ class DFSOutputStream:
         pkt = _Packet(self._seq, self._block_pos, data, sums, last=False)
         self._seq += 1
         self._block_packets.append(pkt)
-        self._stream_packet(pkt)
+        # account BEFORE streaming: recovery resets _block_pos and replays
+        # every retained packet (including this one), so a post-stream
+        # increment would double-count the packet that triggered recovery
         self._block_pos += len(data)
         self._pos += len(data)
+        self._stream_packet(pkt)
 
     # ----------------------------------------------------- block lifecycle
 
@@ -245,6 +271,7 @@ class _Pipeline:
                  checksum: DataChecksum):
         if not locations:
             raise PipelineError("no locations for block")
+        DFSClientFaultInjector.get().before_pipeline_setup(locations)
         self.block = block
         self.locations = locations
         self._unacked: "queue.Queue[int]" = queue.Queue()
@@ -284,6 +311,7 @@ class _Pipeline:
                         if bad_idx < len(self.locations) else None
                     raise PipelineError(f"ack failure {statuses}",
                                         bad_node=bad)
+                DFSClientFaultInjector.get().on_ack(self.block, ack["seq"])
                 with self._ack_cond:
                     self._acked_through = ack["seq"]
                     self._ack_cond.notify_all()
@@ -296,6 +324,7 @@ class _Pipeline:
                 self._ack_cond.notify_all()
 
     def send(self, pkt: _Packet) -> None:
+        DFSClientFaultInjector.get().before_send_packet(self.block, pkt.seq)
         with self._ack_cond:
             if self._error is not None:
                 raise self._error

@@ -117,8 +117,7 @@ class DataNode(AbstractService):
             sync_on_close=conf.get_bool("dfs.datanode.synconclose", False))
         self.xceiver = DataXceiverServer(
             self.store, self._on_block_received, bind_host=self.host,
-            port=conf.get_int("dfs.datanode.port", 0),
-            fault_injector=DataNodeFaultInjector.get())
+            port=conf.get_int("dfs.datanode.port", 0))
         self.heartbeat_interval = conf.get_time_seconds(
             "dfs.heartbeat.interval", 3.0)
         self.block_report_interval = conf.get_time_seconds(

@@ -46,13 +46,22 @@ class DataXceiverServer:
         self.port = self._lsock.getsockname()[1]
         self._running = False
         self.active_xceivers = 0
-        self.fault_injector = fault_injector
+        # Explicit injector wins; otherwise resolve the SINGLETON at each
+        # use so tests can install one after the daemon started (the
+        # reference's injectors are resolved per-call the same way).
+        self._fixed_injector = fault_injector
         reg = metrics_system().source(f"datanode.xceiver.{self.port}")
         self._m_writes = reg.counter("blocks_written")
         self._m_reads = reg.counter("blocks_read")
         self._m_bytes_in = reg.counter("bytes_written")
         self._m_bytes_out = reg.counter("bytes_read")
         self._m_short_circuit = reg.counter("short_circuit_grants")
+
+    def _fi(self):
+        if self._fixed_injector is not None:
+            return self._fixed_injector
+        from hadoop_tpu.dfs.datanode.datanode import DataNodeFaultInjector
+        return DataNodeFaultInjector.get()
 
     def start(self) -> None:
         self._running = True
@@ -107,8 +116,7 @@ class DataXceiverServer:
         block = Block.from_wire(req["b"])
         targets = [DatanodeInfo.from_wire(t) for t in req.get("targets", [])]
         checksum = DataChecksum(req.get("bpc", dt.CHUNK_SIZE))
-        if self.fault_injector is not None:
-            self.fault_injector.before_write_block(block)
+        self._fi().before_write_block(block)
 
         down: Optional[socket.socket] = None
         down_name = ""
@@ -193,8 +201,7 @@ class DataXceiverServer:
                                 block, e)
                             status = dt.STATUS_ERROR_CHECKSUM
                             ok = False
-                    if self.fault_injector is not None:
-                        self.fault_injector.before_packet_write(block, pkt)
+                    self._fi().before_packet_write(block, pkt)
                     if status == dt.STATUS_SUCCESS:
                         open_rep.write_packet(data, sums)
                         self._m_bytes_in.incr(len(data))
@@ -269,8 +276,7 @@ class DataXceiverServer:
         block = Block.from_wire(req["b"])
         offset = req.get("offset", 0)
         length = req.get("length", 1 << 62)
-        if self.fault_injector is not None:
-            self.fault_injector.before_read_block(block)
+        self._fi().before_read_block(block)
         try:
             chunks = self.store.read_chunks(block, offset, length)
         except IOError as e:
@@ -279,9 +285,7 @@ class DataXceiverServer:
         dt.send_frame(sock, {"ok": True})
         seq = 0
         for pos, data, sums in chunks:
-            if self.fault_injector is not None:
-                data, sums = self.fault_injector.corrupt_read_packet(
-                    block, data, sums)
+            data, sums = self._fi().corrupt_read_packet(block, data, sums)
             dt.send_frame(sock, {"seq": seq, "off": pos, "data": data,
                                  "sums": sums, "last": False})
             self._m_bytes_out.incr(len(data))

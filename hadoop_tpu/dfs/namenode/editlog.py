@@ -60,6 +60,27 @@ OP_SET_STORAGE_POLICY = "set_storage_policy"
 OP_SET_EC_POLICY = "set_ec_policy"
 
 
+class EditLogFaultInjector:
+    """Overridable fault point at the edit-log group-commit boundary (ref:
+    the reference's injector-singleton pattern — CheckpointFaultInjector
+    .java / JournalFaultInjector.java). ``before_sync`` raising simulates
+    journal IO failure at exactly the durability point."""
+
+    _instance: "EditLogFaultInjector" = None  # type: ignore[assignment]
+
+    @classmethod
+    def get(cls) -> "EditLogFaultInjector":
+        if cls._instance is None:
+            cls._instance = EditLogFaultInjector()
+        return cls._instance
+
+    @classmethod
+    def set(cls, inst) -> None:
+        cls._instance = inst
+
+    def before_sync(self, txid: int) -> None: ...
+
+
 class JournalManager:
     """Seam for pluggable journals (local dir / quorum).
     Ref: server/namenode/JournalManager.java."""
@@ -333,6 +354,7 @@ class FSEditLog:
     def _flush_and_sync_locked(self) -> int:
         """Drain the buffer + fsync. Caller holds _sync_lock. Returns the
         txid boundary covered (atomic with the buffer capture)."""
+        EditLogFaultInjector.get().before_sync(self._txid)
         with self._lock:
             buf = bytes(self._buf)
             first = self._buf_first_txid

@@ -54,6 +54,32 @@ class FencedError(IOError):
     Ref: qjournal JournalOutOfSyncException / IOException('epoch ...')."""
 
 
+class JournalFaultInjector:
+    """Overridable fault points compiled into the JN main path, the way
+    the reference does it (ref: qjournal/server/JournalFaultInjector.java
+    — injectors are singletons tests subclass). Hooks raise to simulate
+    IO failure at the exact point; default is a no-op."""
+
+    _instance: "JournalFaultInjector" = None  # type: ignore[assignment]
+
+    @classmethod
+    def get(cls) -> "JournalFaultInjector":
+        if cls._instance is None:
+            cls._instance = JournalFaultInjector()
+        return cls._instance
+
+    @classmethod
+    def set(cls, inst) -> None:
+        cls._instance = inst
+
+    # ---- hooks (no-ops by default); jn_port identifies WHICH node ----
+    def before_journal(self, jn_port: int, first_txid: int) -> None: ...
+    def before_finalize(self, jn_port: int, first_txid: int) -> None: ...
+    def before_accept(self, jn_port: int, first_txid: int) -> None: ...
+    def before_start_segment(self, jn_port: int, first_txid: int) -> None:
+        ...
+
+
 class _Journal:
     """One journal's state on a JournalNode. Ref: qjournal/server/Journal
     .java — promised/writer epochs are durable so fencing survives
@@ -206,6 +232,8 @@ class JournalProtocol:
         j = self._journal(jid)
         with j.lock:
             j.check_epoch(epoch)
+            JournalFaultInjector.get().before_start_segment(
+                self.node.port, first_txid)
             j.writer_epoch = epoch
             j.fjm.close()
             # Drop any stale in-progress segment at this boundary — the new
@@ -229,6 +257,8 @@ class JournalProtocol:
         j = self._journal(jid)
         with j.lock:
             j.check_epoch(epoch)
+            JournalFaultInjector.get().before_accept(
+                self.node.port, first_txid)
             j.fjm.close()
             for first, last, path in j.fjm.segments():
                 # Drop everything past the committed prefix AND any
@@ -276,6 +306,8 @@ class JournalProtocol:
         j = self._journal(jid)
         with j.lock:
             j.check_epoch(epoch)
+            JournalFaultInjector.get().before_journal(
+                self.node.port, first_txid)
             j.fjm.journal(records, first_txid, count)
             j.fjm.sync()
             if last_txid > j.last_txid:
@@ -288,6 +320,8 @@ class JournalProtocol:
         j = self._journal(jid)
         with j.lock:
             j.check_epoch(epoch)
+            JournalFaultInjector.get().before_finalize(
+                self.node.port, first_txid)
             j.fjm.finalize_segment(first_txid, last_txid)
             # A writer only finalizes a fully quorum-synced segment.
             j.update_committed(last_txid)
