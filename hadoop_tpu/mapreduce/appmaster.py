@@ -127,7 +127,8 @@ class TaskUmbilicalProtocol:
             attempt.state = "SUCCEEDED"
             attempt.progress = 1.0
             task = attempt.task
-            if not task.succeeded:
+            first_success = not task.succeeded
+            if first_success:
                 task.succeeded = True
                 task.finished_at = time.monotonic()
                 self.am.counters.merge(counters_wire)
@@ -139,7 +140,12 @@ class TaskUmbilicalProtocol:
             for other in task.running_attempts():
                 if other.id != attempt_id:
                     self.am.kill_attempt(other, "sibling attempt succeeded")
-            return True
+        if first_success:
+            # durable BEFORE ack: an AM restart must know this task is
+            # done (ref: JobHistoryEventHandler's event-before-commit
+            # ordering that MRAppMaster recovery depends on)
+            self.am.log_task_finished(task, shuffle_addr, counters_wire)
+        return True
 
     def fatal_error(self, attempt_id: str, msg: str) -> bool:
         with self.am.lock:
@@ -170,15 +176,29 @@ class MRAppMaster:
         self._container_attempts: Dict[str, str] = {}  # container id -> attempt
         self._pending_assign: List[_Task] = []
         self._requested = 0
+        self.recovered_tasks = 0
+        self.history = None
+        self._history_fs = None
 
     # --------------------------------------------------------------- setup
 
     def load_job(self) -> None:
-        fs = FileSystem.get(self.staging_uri, self.conf)
         from hadoop_tpu.fs.filesystem import Path
+        from hadoop_tpu.mapreduce import history as jh
+        fs = FileSystem.get(self.staging_uri, self.conf)
         base = Path(self.staging_uri).path
         self.job = json.loads(fs.read_all(f"{base}/job.json").decode())
-        fs.close()
+        # History + recovery (ref: MRAppMaster.java:180 recovery path):
+        # a prior attempt's event log seeds completed tasks so only
+        # unfinished work reruns.
+        self._history_dir = f"{base}/history"
+        self._recovered = jh.recover_completed_tasks(fs, self._history_dir)
+        self.history = jh.JobHistoryWriter(fs, self._history_dir)
+        self._history_fs = fs
+        if not self._recovered["submitted"]:
+            self.history.event(jh.JOB_SUBMITTED, job_id=self.job["job_id"],
+                               name=self.job.get("name", ""))
+            self.history.flush()
         jconf = self.job["conf"]
         self.max_attempts = int(jconf.get("mapreduce.map.maxattempts", "4"))
         self.task_timeout = float(jconf.get("mapreduce.task.timeout", "120"))
@@ -197,6 +217,25 @@ class MRAppMaster:
             tid = f"{self.job['job_id']}_r_{r:06d}"
             self.tasks[tid] = _Task(
                 tid, "reduce", {"partition": r, "num_maps": num_maps})
+        # seed recovered completions (prior AM attempt's durable events)
+        n_rec = 0
+        for tid, ev in self._recovered["tasks"].items():
+            task = self.tasks.get(tid)
+            if task is None:
+                continue
+            task.succeeded = True
+            task.finished_at = time.monotonic()
+            self.counters.merge(ev.get("counters", {}))
+            if task.type == "map":
+                addr = ev.get("shuffle_addr", "")
+                self.map_events.append({"task_id": tid, "addr": addr})
+                if addr:
+                    self.shuffle_nodes.add(addr)
+            n_rec += 1
+        if n_rec:
+            self.recovered_tasks = n_rec
+            log.info("recovered %d completed task(s) from job history",
+                     n_rec)
 
     # ------------------------------------------------------------ main loop
 
@@ -254,13 +293,17 @@ class MRAppMaster:
             amrm.close()
             nm.close()
             self.umbilical_server.stop()
+            if self._history_fs is not None:
+                self._history_fs.close()
         return 0 if ok else 1
 
     # ---------------------------------------------------------- allocation
 
     def _schedule(self, amrm: AMRMClient, tasks: List[_Task]) -> None:
         """Queue tasks for assignment + ask the RM for that many containers.
+        Recovered (already-succeeded) tasks never re-enter the ask table.
         Ref: RMContainerAllocator — ask table keyed by priority."""
+        tasks = [t for t in tasks if not t.succeeded]
         with self.lock:
             self._pending_assign.extend(tasks)
         for t in tasks:
@@ -409,6 +452,23 @@ class MRAppMaster:
                 except Exception:  # noqa: BLE001
                     pass
 
+    # ------------------------------------------------------------- history
+
+    def log_task_finished(self, task: _Task, shuffle_addr: str,
+                          counters_wire: Dict) -> None:
+        """Durable task-completion record (ref: TaskFinishedEvent)."""
+        from hadoop_tpu.mapreduce import history as jh
+        if self.history is None:
+            return
+        try:
+            self.history.event(jh.TASK_FINISHED, task_id=task.id,
+                               task_type=task.type,
+                               shuffle_addr=shuffle_addr,
+                               counters=counters_wire)
+            self.history.flush()
+        except Exception as e:  # noqa: BLE001 — history must not kill tasks
+            log.warning("history write failed: %s", e)
+
     # ---------------------------------------------------------- speculation
 
     def _speculate(self, amrm: AMRMClient) -> None:
@@ -457,10 +517,25 @@ class MRAppMaster:
                 pass
             fs.write_all(f"{out}/_SUCCESS", b"")
         report = {"state": "SUCCEEDED" if ok else "FAILED",
+                  "name": self.job.get("name", ""),
                   "counters": self.counters.to_wire(),
                   "diagnostics": self.diagnostics[:20]}
         fs.write_all(f"{base}/job-report.json",
                      json.dumps(report).encode())
+        # seal + publish history to the done-dir for the history server
+        from hadoop_tpu.mapreduce import history as jh
+        try:
+            if self.history is not None:
+                self.history.event(jh.JOB_FINISHED, job_id=self.job["job_id"],
+                                   state=report["state"])
+                self.history.flush()
+                jh.publish_to_done_dir(
+                    fs, self._history_dir, self.job["job_id"], report,
+                    done_dir=self.job["conf"].get(
+                        "mapreduce.jobhistory.done-dir",
+                        jh.DEFAULT_DONE_DIR))
+        except Exception as e:  # noqa: BLE001
+            log.warning("history publish failed: %s", e)
         fs.close()
         for addr in self.shuffle_nodes:
             host, _, port = addr.rpartition(":")

@@ -1,0 +1,128 @@
+"""Job history: the event log the AM writes, and AM-restart recovery.
+
+Parity with the reference's .jhist machinery (ref:
+hadoop-mapreduce-client-core/.../jobhistory/JobHistoryEventHandler (via
+-app), EventWriter/EventReader — Avro event stream; recovery consumer
+ref: MRAppMaster.java:180 serviceInit's recovery path, which parses the
+prior attempt's partial .jhist and seeds completed tasks).
+
+Format here: each flush writes one small JSON-lines file
+``<staging>/history/ev-<seq>.jsonl`` (the DFS write path is
+create-then-close, so an append-style log becomes a sequence of sealed
+files; the NN handles thousands of creates/sec — STORAGE_BENCH). Readers
+concatenate files in sequence order. On job completion the whole history
+directory plus the final report moves to the cluster's done-dir
+(``mapreduce.jobhistory.done-dir``), where the JobHistoryServer serves it
+(ref: hadoop-mapreduce-client-hs HistoryFileManager's intermediate→done
+move).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Dict, Iterator, List, Optional
+
+from hadoop_tpu.fs import FileSystem
+
+log = logging.getLogger(__name__)
+
+DEFAULT_DONE_DIR = "/mr-history/done"
+
+# event types (ref: jobhistory/EventType.java, condensed)
+JOB_SUBMITTED = "JOB_SUBMITTED"
+TASK_FINISHED = "TASK_FINISHED"
+JOB_FINISHED = "JOB_FINISHED"
+
+
+class JobHistoryWriter:
+    """AM-side event log. One sealed file per flush — task completions
+    are low-rate, so a file per event batch keeps every completed task
+    durable the moment it finishes (the recovery granularity)."""
+
+    def __init__(self, fs: FileSystem, history_dir: str):
+        self.fs = fs
+        self.dir = history_dir
+        fs.mkdirs(history_dir)
+        # continue numbering after any prior attempt's files
+        existing = _event_files(fs, history_dir)
+        self._seq = (existing[-1][0] + 1) if existing else 0
+        self._pending: List[Dict] = []
+
+    def event(self, etype: str, **fields) -> None:
+        self._pending.append(dict(fields, type=etype))
+
+    def flush(self) -> None:
+        if not self._pending:
+            return
+        body = "\n".join(json.dumps(e) for e in self._pending) + "\n"
+        self.fs.write_all(f"{self.dir}/ev-{self._seq:06d}.jsonl",
+                          body.encode())
+        self._seq += 1
+        self._pending = []
+
+
+def _event_files(fs: FileSystem, history_dir: str):
+    try:
+        entries = fs.list_status(history_dir)
+    except (IOError, OSError, FileNotFoundError):
+        return []
+    out = []
+    for st in entries:
+        name = st.path.rsplit("/", 1)[-1]
+        if name.startswith("ev-") and name.endswith(".jsonl"):
+            out.append((int(name[3:-6]), st.path))
+    return sorted(out)
+
+
+def read_events(fs: FileSystem, history_dir: str) -> Iterator[Dict]:
+    """Replay the event stream in write order. A file that is still
+    in-flight (concurrent poller) or torn (writer died mid-create) is
+    skipped — an unrecorded completion only means that task reruns."""
+    for _, path in _event_files(fs, history_dir):
+        try:
+            raw = fs.read_all(path)
+        except (IOError, OSError) as e:
+            log.debug("skipping unreadable history file %s: %s", path, e)
+            continue
+        for line in raw.decode(errors="replace").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except ValueError:
+                log.debug("skipping torn history line in %s", path)
+
+
+def recover_completed_tasks(fs: FileSystem, history_dir: str) -> Dict:
+    """Digest a (possibly partial) event stream for AM restart:
+    {"tasks": {task_id: event}, "submitted": bool, "finished": event|None}.
+    Ref: MRAppMaster recovery — completed tasks are seeded as SUCCEEDED so
+    only unfinished work reruns."""
+    tasks: Dict[str, Dict] = {}
+    submitted = False
+    finished = None
+    for ev in read_events(fs, history_dir):
+        if ev["type"] == TASK_FINISHED:
+            tasks[ev["task_id"]] = ev
+        elif ev["type"] == JOB_SUBMITTED:
+            submitted = True
+        elif ev["type"] == JOB_FINISHED:
+            finished = ev
+    return {"tasks": tasks, "submitted": submitted, "finished": finished}
+
+
+def publish_to_done_dir(fs: FileSystem, history_dir: str, job_id: str,
+                        report: Dict,
+                        done_dir: str = DEFAULT_DONE_DIR) -> str:
+    """Move a finished job's history to the served done-dir (ref:
+    HistoryFileManager.moveToDone)."""
+    dst = f"{done_dir}/{job_id}"
+    fs.mkdirs(done_dir)
+    fs.delete(dst, recursive=True)
+    if not fs.rename(history_dir, dst):
+        # cross-checks (e.g. history dir never created) — synthesize
+        fs.mkdirs(dst)
+    fs.write_all(f"{dst}/report.json", json.dumps(report).encode())
+    return dst

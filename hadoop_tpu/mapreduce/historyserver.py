@@ -1,0 +1,102 @@
+"""JobHistoryServer — REST over finished jobs' history in the DFS.
+
+Parity with the reference history server (ref:
+hadoop-mapreduce-client-hs/.../HistoryClientService + HsWebServices —
+REST surface /ws/v1/history/mapreduce/jobs[/jobid[/tasks|/counters]]),
+shrunk to the JSON endpoints on the shared admin HttpServer. Reads the
+done-dir the AMs publish into (history.publish_to_done_dir)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.fs import FileSystem
+from hadoop_tpu.http.server import HttpServer
+from hadoop_tpu.mapreduce import history
+from hadoop_tpu.service import AbstractService
+
+log = logging.getLogger(__name__)
+
+
+class JobHistoryServer(AbstractService):
+    def __init__(self, conf: Configuration, default_fs: str):
+        super().__init__("JobHistoryServer")
+        self.default_fs = default_fs
+        self.done_dir = conf.get("mapreduce.jobhistory.done-dir",
+                                 history.DEFAULT_DONE_DIR)
+        self._fs: Optional[FileSystem] = None
+        self.http: Optional[HttpServer] = None
+
+    def service_init(self, conf: Configuration) -> None:
+        self._fs = FileSystem.get(self.default_fs, conf)
+        bind = conf.get("mapreduce.jobhistory.webapp.bind-host",
+                        "127.0.0.1")
+        self.http = HttpServer(conf, (bind, conf.get_int(
+            "mapreduce.jobhistory.webapp.port", 0)), daemon_name="jhs")
+        self.http.add_handler("/ws/v1/history/mapreduce/jobs", self._jobs)
+
+    def service_start(self) -> None:
+        self.http.start()
+        log.info("JobHistoryServer on :%d (done-dir %s)", self.http.port,
+                 self.done_dir)
+
+    def service_stop(self) -> None:
+        if self.http:
+            self.http.stop()
+        if self._fs:
+            self._fs.close()
+
+    @property
+    def port(self) -> int:
+        return self.http.port
+
+    # ------------------------------------------------------------ handlers
+
+    def _jobs(self, query: Dict, body: bytes):
+        # /ws/v1/history/mapreduce/jobs[/<jobid>[/tasks|/counters]]
+        path = query["__path__"]
+        tail = path[len("/ws/v1/history/mapreduce/jobs"):].strip("/")
+        if not tail:
+            return 200, {"jobs": {"job": self._list_jobs()}}
+        parts = tail.split("/")
+        job_id = parts[0]
+        if not self._fs.exists(f"{self.done_dir}/{job_id}"):
+            raise FileNotFoundError(job_id)
+        if len(parts) == 1:
+            return 200, {"job": self._job_summary(job_id)}
+        if parts[1] == "tasks":
+            tasks = [dict(ev) for ev in history.read_events(
+                self._fs, f"{self.done_dir}/{job_id}")
+                if ev["type"] == history.TASK_FINISHED]
+            return 200, {"tasks": {"task": tasks}}
+        if parts[1] == "counters":
+            return 200, {"jobCounters": self._report(job_id)
+                         .get("counters", {})}
+        raise FileNotFoundError(tail)
+
+    def _list_jobs(self):
+        try:
+            entries = self._fs.list_status(self.done_dir)
+        except (IOError, OSError, FileNotFoundError):
+            return []
+        out = []
+        for st in entries:
+            if st.is_dir:
+                job_id = st.path.rstrip("/").rsplit("/", 1)[-1]
+                out.append(self._job_summary(job_id))
+        return out
+
+    def _report(self, job_id: str) -> Dict:
+        import json
+        path = f"{self.done_dir}/{job_id}/report.json"
+        if not self._fs.exists(path):
+            return {}
+        return json.loads(self._fs.read_all(path).decode())
+
+    def _job_summary(self, job_id: str) -> Dict:
+        rep = self._report(job_id)
+        return {"id": job_id, "state": rep.get("state", "UNKNOWN"),
+                "name": rep.get("name", ""),
+                "diagnostics": rep.get("diagnostics", [])}

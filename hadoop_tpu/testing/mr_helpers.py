@@ -1,0 +1,27 @@
+"""Importable user-code classes for MR integration tests.
+
+Task containers import user classes by ``module:Class`` reference
+(mapreduce.api.load_class), so test mappers/reducers must live on the
+framework's import path, not inside a pytest module (whose module name
+differs between pytest and plain imports)."""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+class SlowGateReducer:
+    """Summing reducer that blocks in setup until the gate file (conf
+    ``test.reduce.gate``) disappears — lets tests hold a job mid-flight."""
+
+    def setup(self, ctx):
+        gate = ctx.conf.get("test.reduce.gate", "")
+        while gate and os.path.exists(gate):
+            time.sleep(0.1)
+
+    def reduce(self, key, values, ctx):
+        ctx.emit(key, str(sum(int(v) for v in values)).encode())
+
+    def cleanup(self, ctx):
+        pass
