@@ -269,8 +269,24 @@ class MRAppMaster:
                         maps_done >= self.slowstart * max(len(maps), 1):
                     self._schedule(amrm, reduces)
                     reduces_scheduled = True
-                allocated, completed = amrm.allocate(
-                    progress=done / max(total, 1))
+                try:
+                    allocated, completed = amrm.allocate(
+                        progress=done / max(total, 1))
+                except Exception as e:  # noqa: BLE001 — RM may be bouncing
+                    log.warning("allocate failed (%s); retrying", e)
+                    time.sleep(0.2)
+                    continue
+                if amrm.resynced:
+                    # RM restarted work-preserving: its ask table is
+                    # empty — re-ask for everything still pending
+                    amrm.resynced = False
+                    with self.lock:
+                        pend = [t for t in self._pending_assign
+                                if not t.succeeded]
+                    for t in pend:
+                        pri = (MAP_PRIORITY if t.type == "map"
+                               else REDUCE_PRIORITY)
+                        amrm.add_request(pri, 1, self._task_resource(t))
                 self._assign(nm, allocated, amrm)
                 self._handle_completed(completed, amrm)
                 self._check_liveness(nm, amrm)

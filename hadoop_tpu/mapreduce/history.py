@@ -38,9 +38,15 @@ JOB_FINISHED = "JOB_FINISHED"
 class JobHistoryWriter:
     """AM-side event log. One sealed file per flush — task completions
     are low-rate, so a file per event batch keeps every completed task
-    durable the moment it finishes (the recovery granularity)."""
+    durable the moment it finishes (the recovery granularity).
+
+    Thread-safe: completions arrive on concurrent umbilical handler
+    threads (the reference serializes through JobHistoryEventHandler's
+    single event-dispatch thread; a lock serves the same purpose here —
+    two flushers must never contend for one sequence number's file)."""
 
     def __init__(self, fs: FileSystem, history_dir: str):
+        import threading
         self.fs = fs
         self.dir = history_dir
         fs.mkdirs(history_dir)
@@ -48,18 +54,28 @@ class JobHistoryWriter:
         existing = _event_files(fs, history_dir)
         self._seq = (existing[-1][0] + 1) if existing else 0
         self._pending: List[Dict] = []
+        self._lock = threading.Lock()
 
     def event(self, etype: str, **fields) -> None:
-        self._pending.append(dict(fields, type=etype))
+        with self._lock:
+            self._pending.append(dict(fields, type=etype))
 
     def flush(self) -> None:
-        if not self._pending:
-            return
-        body = "\n".join(json.dumps(e) for e in self._pending) + "\n"
-        self.fs.write_all(f"{self.dir}/ev-{self._seq:06d}.jsonl",
-                          body.encode())
-        self._seq += 1
-        self._pending = []
+        with self._lock:
+            if not self._pending:
+                return
+            events = self._pending
+            seq = self._seq
+            self._seq += 1
+            self._pending = []
+        body = "\n".join(json.dumps(e) for e in events) + "\n"
+        try:
+            self.fs.write_all(f"{self.dir}/ev-{seq:06d}.jsonl",
+                              body.encode())
+        except Exception:
+            with self._lock:  # keep the completions for the next flush
+                self._pending = events + self._pending
+            raise
 
 
 def _event_files(fs: FileSystem, history_dir: str):

@@ -384,6 +384,21 @@ class MiniYARNCluster:
     def rm_addr(self):
         return ("127.0.0.1", self.rm.port)
 
+    def restart_rm(self) -> None:
+        """Bounce the RM on the SAME port + state dir — the work-
+        preserving restart scenario (NMs re-register with live
+        containers, AMs re-register on their next allocate).
+        Ref: TestWorkPreservingRMRestart's rm2-with-same-store pattern."""
+        from hadoop_tpu.yarn.rm import ResourceManager
+        old_port = self.rm.port
+        self.rm.stop()
+        rm_conf = Configuration(other=self.conf)
+        rm_conf.set("yarn.resourcemanager.port", str(old_port))
+        self.rm = ResourceManager(
+            rm_conf, state_dir=os.path.join(self.base_dir, "rm-state"))
+        self.rm.init(rm_conf)
+        self.rm.start()
+
     def shutdown(self) -> None:
         for nm in self.node_agents:
             nm.stop()

@@ -96,6 +96,10 @@ class AMRMClient:
         self.rm = get_proxy("AMRMProtocol", rm_addr, client=self._client)
         self._asks: List[ResourceRequest] = []
         self._releases: List[ContainerId] = []
+        # set when allocate() had to re-register after an RM restart; the
+        # AM should then resend asks for still-pending work (the RM's ask
+        # table restarted empty) and clear the flag
+        self.resynced = False
 
     @classmethod
     def from_env(cls, conf: Optional[Configuration] = None) -> "AMRMClient":
@@ -120,9 +124,27 @@ class AMRMClient:
                  ) -> Tuple[List[Container], List[ContainerStatus]]:
         asks, self._asks = self._asks, []
         releases, self._releases = self._releases, []
-        resp = self.rm.allocate(self.attempt_id,
-                                [a.to_wire() for a in asks],
-                                [r.to_wire() for r in releases], progress)
+        try:
+            resp = self.rm.allocate(self.attempt_id,
+                                    [a.to_wire() for a in asks],
+                                    [r.to_wire() for r in releases],
+                                    progress)
+        except Exception as e:  # noqa: BLE001
+            if "unknown attempt" not in str(e):
+                self._asks = asks + self._asks
+                self._releases = releases + self._releases
+                raise
+            # RM restarted (work-preserving): re-register and resend the
+            # outstanding ask table (ref: AMRMClientImpl.registerAgain on
+            # ApplicationMasterNotRegisteredException)
+            log.warning("RM lost attempt state; re-registering %s",
+                        self.attempt_id)
+            self.register()
+            self.resynced = True
+            resp = self.rm.allocate(self.attempt_id,
+                                    [a.to_wire() for a in asks],
+                                    [r.to_wire() for r in releases],
+                                    progress)
         return ([Container.from_wire(c) for c in resp["allocated"]],
                 [ContainerStatus.from_wire(s) for s in resp["completed"]])
 

@@ -308,9 +308,19 @@ class NodeAgent(AbstractService):
             statuses: List[ContainerStatus] = []
             try:
                 if not registered:
-                    self._rm.register_node_manager(
+                    # report live containers so a restarted RM re-adopts
+                    # them (work-preserving restart; ref:
+                    # NMContainerStatus in RegisterNodeManagerRequest)
+                    with self._lock:
+                        live = [rc.container.to_wire()
+                                for rc in self.containers.values()
+                                if rc.state in ("NEW", "LOCALIZING",
+                                                "RUNNING")]
+                    resp0 = self._rm.register_node_manager(
                         self.node_id.to_wire(), self.resource.to_wire(),
-                        self.nm_address)
+                        self.nm_address, live)
+                    for cw in (resp0 or {}).get("cleanup", []):
+                        self.stop_container(ContainerId.from_wire(cw))
                     registered = True
                 with self._lock:
                     statuses = self._completed_unreported

@@ -102,7 +102,24 @@ class RMApp:
         attempt = RMAppAttempt(self, self.attempt_no)
         self.current_attempt = attempt
         self.rm.attempts[attempt.attempt_id] = attempt
+        self.rm.state_store.store_attempt(self.app_id, self.attempt_no)
         attempt.start()
+
+    def recover_attempt(self, attempt_no: int) -> "RMAppAttempt":
+        """Work-preserving restart: revive the attempt whose AM may still
+        be running — no new AM container; the AM re-registers on its next
+        allocate, or liveness expiry fails the attempt and the normal
+        retry path takes over. Ref: RMAppAttemptImpl recovery +
+        ZKRMStateStore.java:180."""
+        self.attempt_no = attempt_no
+        attempt = RMAppAttempt(self, attempt_no)
+        attempt.state = "RUNNING"
+        self.current_attempt = attempt
+        self.rm.attempts[attempt.attempt_id] = attempt
+        self.rm.scheduler.add_app(attempt.attempt_id, self.ctx.queue,
+                                  self.user)
+        self.sm.state = AppState.RUNNING
+        return attempt
 
     def _on_attempt_failed(self, diag: str) -> str:
         self.diagnostics = diag or ""
@@ -188,13 +205,21 @@ class FileRMStateStore:
 
     def store_app_done(self, app_id: ApplicationId, state: str,
                        diag: str) -> None:
+        self._update(app_id, state=state, diagnostics=diag)
+
+    def store_attempt(self, app_id: ApplicationId, attempt_no: int) -> None:
+        """Ref: RMStateStore.storeNewApplicationAttempt — the attempt
+        number survives restart so work-preserving recovery can revive
+        the attempt the live AM identifies as."""
+        self._update(app_id, attempt_no=attempt_no)
+
+    def _update(self, app_id: ApplicationId, **fields) -> None:
         path = self._path(app_id)
         if not os.path.exists(path):
             return
         with open(path) as f:
             d = json.load(f)
-        d["state"] = state
-        d["diagnostics"] = diag
+        d.update(fields)
         with open(path, "w") as f:
             json.dump(d, f)
 
@@ -347,15 +372,34 @@ class ResourceTrackerProtocol:
         self.rm = rm
 
     def register_node_manager(self, node_id_wire: Dict, resource_wire: Dict,
-                              nm_address: str) -> Dict:
+                              nm_address: str,
+                              running_containers: Optional[List[Dict]] = None
+                              ) -> Dict:
         node_id = NodeId.from_wire(node_id_wire)
         total = Resource.from_wire(resource_wire)
         with self.rm.nodes_lock:
             node = RMNode(node_id, total, nm_address)
             self.rm.nodes[node_id] = node
         self.rm.scheduler.add_node(node_id, total, nm_address)
+        # Work-preserving restart: re-adopt containers this NM kept alive
+        # across our downtime (ref: ResourceTrackerService
+        # .registerNodeManager's NMContainerStatus handling).
+        orphans: List[Dict] = []
+        for cw in running_containers or []:
+            container = Container.from_wire(cw)
+            cid = container.container_id
+            attempt_id = f"{cid.app_id}_{cid.attempt_no:02d}"
+            if self.rm.scheduler.recover_container(attempt_id, container):
+                log.info("Re-adopted live container %s (%s)", cid,
+                         attempt_id)
+                att = self.rm.attempts.get(attempt_id)
+                if att is not None and att.am_container is None and \
+                        cid.seq == 1:
+                    att.am_container = container
+            else:
+                orphans.append(cid.to_wire())  # app finished/unknown: kill
         log.info("Node %s registered (%r) at %s", node_id, total, nm_address)
-        return {"ok": True}
+        return {"ok": True, "cleanup": orphans}
 
     def node_heartbeat(self, node_id_wire: Dict,
                        container_statuses: List[Dict]) -> Dict:
@@ -430,6 +474,9 @@ class ResourceManager(AbstractService):
 
     def service_start(self) -> None:
         self.dispatcher.start()
+        # recover BEFORE opening RPC: re-registering NMs must find the
+        # revived attempts to hang their live-container reports on
+        self._recover()
         self.rpc.start()
         # Admin HTTP: /jmx /conf /stacks plus cluster + app status JSON
         # (ref: the RM webapp's /ws/v1/cluster REST endpoints).
@@ -452,8 +499,10 @@ class ResourceManager(AbstractService):
                 "/ws/v1/cluster/nodes",
                 lambda q, b: (200, {"nodes": client_proto.get_nodes()}))
             self.http.start()
-        self._recover()
         Daemon(self._liveness_loop, "rm-liveness").start()
+        if self.config.get_bool(
+                "yarn.resourcemanager.scheduler.monitor.enable", False):
+            Daemon(self._preemption_loop, "rm-preemption").start()
         log.info("ResourceManager up at 127.0.0.1:%d", self.rpc.port)
 
     def service_stop(self) -> None:
@@ -466,9 +515,15 @@ class ResourceManager(AbstractService):
         self._nm_client.stop()
 
     def _recover(self) -> None:
-        """Non-work-preserving recovery: resubmit incomplete apps.
-        Ref: RMAppManager.recoverApplication (work-preserving restart is the
-        reference's richer variant — ZKRMStateStore.java:180)."""
+        """App recovery on restart. WORK-PRESERVING (default; ref:
+        ZKRMStateStore.java:180 + RMAppAttemptImpl recovery): incomplete
+        apps revive their stored attempt with no new AM launch — the
+        running AM re-registers on its next allocate, NMs re-report live
+        containers on re-registration, and the scheduler re-adopts them.
+        With work-preserving disabled, incomplete apps restart with a
+        fresh attempt (the old round-1 behavior)."""
+        wp = self.config.get_bool(
+            "yarn.resourcemanager.work-preserving-recovery.enabled", True)
         for d in self.state_store.load_all():
             if d.get("state") in (AppState.FINISHED, AppState.FAILED,
                                   AppState.KILLED):
@@ -476,10 +531,19 @@ class ResourceManager(AbstractService):
             try:
                 ctx = ApplicationSubmissionContext.from_wire(
                     _jsonable_to_wire(d["ctx"]))
-                log.info("Recovering application %s", ctx.app_id)
-                self.submit_application(ctx, d.get("user", "unknown"),
-                                        store=False)
                 self._app_seq = max(self._app_seq, ctx.app_id.seq)
+                attempt_no = int(d.get("attempt_no", 0))
+                if wp and attempt_no > 0:
+                    log.info("Work-preserving recovery of %s (attempt %d)",
+                             ctx.app_id, attempt_no)
+                    app = RMApp(self, ctx, d.get("user", "unknown"))
+                    self.apps[ctx.app_id] = app
+                    app.recover_attempt(attempt_no)
+                else:
+                    log.info("Recovering application %s (fresh attempt)",
+                             ctx.app_id)
+                    self.submit_application(ctx, d.get("user", "unknown"),
+                                            store=False)
             except Exception:
                 log.exception("Failed to recover an application")
 
@@ -611,6 +675,40 @@ class ResourceManager(AbstractService):
                 node = self.nodes.get(c.node_id)
                 if node is not None:
                     node.containers_to_cleanup.append(c.container_id)
+
+    # ----------------------------------------------------------- preemption
+
+    def _preemption_loop(self) -> None:
+        """Capacity/fair preemption monitor (ref: monitor/capacity/
+        ProportionalCapacityPreemptionPolicy via SchedulingMonitor):
+        periodically ask the scheduler for over-guarantee containers and
+        kill them (exit -102 PREEMPTED) so starved queues can schedule.
+        AM containers are protected."""
+        interval = self.config.get_time_seconds(
+            "yarn.resourcemanager.monitor.capacity.preemption"
+            ".monitoring_interval", 3.0)
+        while not self._stop_event.wait(interval):
+            try:
+                am_cids = {str(a.am_container.container_id)
+                           for a in self.attempts.values()
+                           if a.am_container is not None}
+                victims = self.scheduler.preemption_candidates(
+                    protect=lambda cid: str(cid) in am_cids)
+                for attempt_id, container in victims:
+                    log.info("Preempting %s of %s",
+                             container.container_id, attempt_id)
+                    self.scheduler.container_completed(
+                        attempt_id, ContainerStatus(
+                            container.container_id, "COMPLETE",
+                            exit_code=-102,
+                            diagnostics="container preempted by scheduler"))
+                    with self.nodes_lock:
+                        node = self.nodes.get(container.node_id)
+                        if node is not None:
+                            node.containers_to_cleanup.append(
+                                container.container_id)
+            except Exception:
+                log.exception("Preemption monitor pass failed")
 
     # ------------------------------------------------------------- liveness
 

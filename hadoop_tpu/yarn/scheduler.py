@@ -177,6 +177,24 @@ class _BaseScheduler(Scheduler):
             app.completed_unfetched = []
             return allocated, completed
 
+    def recover_container(self, attempt_id: str,
+                          container: Container) -> bool:
+        """Work-preserving restart: re-adopt a container an NM reported as
+        live on (re)registration. Ref: AbstractYarnScheduler
+        .recoverContainersOnNode."""
+        with self.lock:
+            app = self.apps.get(attempt_id)
+            node = self.nodes.get(container.node_id)
+            if app is None or node is None:
+                return False
+            if container.container_id in node.containers:
+                return True  # already known
+            node.allocate(container)
+            app.live_containers[container.container_id] = container
+            app.used = app.used.add(container.resource)
+            app._seq = max(app._seq, container.container_id.seq)
+            return True
+
     def container_completed(self, attempt_id: str,
                             status: ContainerStatus) -> None:
         """NM reported a container exit."""
@@ -192,6 +210,11 @@ class _BaseScheduler(Scheduler):
 
     # --------------------------------------------------------- allocation
 
+    # Re-evaluate the app order after every single assignment? Fairness-
+    # based schedulers need this (one drain-all pass would hand the first
+    # app the whole node); FIFO keeps the cheap drain-all.
+    REORDER_PER_ASSIGNMENT = False
+
     def node_heartbeat(self, node_id: NodeId) -> None:
         """Offer the node to apps. Subclasses choose the app order.
         Ref: CapacityScheduler.allocateContainersToNode:1747."""
@@ -199,13 +222,25 @@ class _BaseScheduler(Scheduler):
             node = self.nodes.get(node_id)
             if node is None:
                 return
-            for app in self._app_order():
-                self._assign_on_node(app, node)
+            if not self.REORDER_PER_ASSIGNMENT:
+                for app in self._app_order():
+                    self._assign_on_node(app, node)
+                return
+            while True:
+                for app in self._app_order():
+                    if self._assign_on_node(app, node, max_assign=1):
+                        break
+                else:
+                    return
 
     def _may_assign(self, app: SchedulerApp, capability: Resource) -> bool:
         return True
 
-    def _assign_on_node(self, app: SchedulerApp, node: SchedulerNode) -> None:
+    def _assign_on_node(self, app: SchedulerApp, node: SchedulerNode,
+                        max_assign: int = 0) -> int:
+        """Assign up to ``max_assign`` containers (0 = unlimited) from this
+        app's asks onto the node; returns the number assigned."""
+        assigned = 0
         for priority in sorted(app.pending):
             for req in app.pending[priority]:
                 while req.num_containers > 0:
@@ -214,7 +249,7 @@ class _BaseScheduler(Scheduler):
                     if not req.capability.fits_in(node.available):
                         break
                     if not self._may_assign(app, req.capability):
-                        return
+                        return assigned
                     cid = self.make_container_id(app.attempt_id,
                                                  app.next_container_seq())
                     container = Container(cid, node.node_id, req.capability,
@@ -224,11 +259,63 @@ class _BaseScheduler(Scheduler):
                     app.live_containers[cid] = container
                     app.allocated_unfetched.append(container)
                     req.num_containers -= 1
+                    assigned += 1
+                    if max_assign and assigned >= max_assign:
+                        app.pending[priority] = [
+                            r for r in app.pending[priority]
+                            if r.num_containers > 0]
+                        return assigned
             app.pending[priority] = [r for r in app.pending[priority]
                                      if r.num_containers > 0]
+        return assigned
 
     def _app_order(self) -> List[SchedulerApp]:
         raise NotImplementedError
+
+    # ----------------------------------------------------------- preemption
+
+    def preemption_candidates(self, protect=lambda cid: False
+                              ) -> List[Tuple[str, Container]]:
+        """Containers to preempt so starved queues can reach their
+        guarantee: while some queue with unmet pending demand is under its
+        guaranteed share and another is over, take the over-queue's
+        newest containers (skipping ``protect``-ed ones — AMs). Returns
+        [(attempt_id, container)]. Ref: monitor/capacity/
+        ProportionalCapacityPreemptionPolicy.java (ideal-allocation walk,
+        natural-termination factor collapsed to one-container-per-pass
+        granularity). Base schedulers have no guarantees → nothing."""
+        return []
+
+    def _guaranteed_share(self, queue: str) -> float:
+        return 0.0
+
+    def _preempt_over_guarantee(self, protect) -> List[Tuple[str, Container]]:
+        with self.lock:
+            total = self.cluster_resource()
+            usage: Dict[str, Resource] = {}
+            pending: Dict[str, bool] = {}
+            for app in self.apps.values():
+                usage[app.queue] = usage.get(app.queue, Resource()).add(
+                    app.used)
+                if app.has_pending():
+                    pending[app.queue] = True
+            starved = [q for q in pending
+                       if usage.get(q, Resource()).dominant_share(total)
+                       < self._guaranteed_share(q) - 1e-9]
+            if not starved:
+                return []
+            victims: List[Tuple[str, Container]] = []
+            for app in reversed(list(self.apps.values())):  # newest apps
+                share = usage.get(app.queue, Resource()).dominant_share(
+                    total)
+                if share <= self._guaranteed_share(app.queue) + 1e-9:
+                    continue
+                for cid, c in reversed(list(app.live_containers.items())):
+                    if protect(cid):
+                        continue
+                    victims.append((app.attempt_id, c))
+                    break  # one per over-capacity app per pass
+            return victims
 
 
 class FifoScheduler(_BaseScheduler):
@@ -313,9 +400,78 @@ class CapacityScheduler(_BaseScheduler):
             out.extend(a for a in self.apps.values() if a.queue == qname)
         return out
 
+    def _guaranteed_share(self, queue: str) -> float:
+        qc = self.queues.get(queue)
+        return qc.capacity if qc is not None else 0.0
+
+    def preemption_candidates(self, protect=lambda cid: False):
+        return self._preempt_over_guarantee(protect)
+
+
+class FairScheduler(_BaseScheduler):
+    """Weighted fair sharing over queues, fair within a queue by app usage.
+
+    Ref: scheduler/fair/FairScheduler.java (2,030 LoC) + FSQueue's
+    fair-share ordering: queues are served lowest (usage_share / weight)
+    first — the steady state puts every queue at usage proportional to
+    its weight; apps inside a queue are served smallest-usage first.
+    Config (the reference reads fair-scheduler.xml; same shape as keys):
+        yarn.scheduler.fair.queues = a,b
+        yarn.scheduler.fair.root.<q>.weight = 2.0
+    Unknown queues are auto-created with weight 1 (the reference's
+    aclSubmitApps/auto-create-by-user behavior, simplified)."""
+
+    REORDER_PER_ASSIGNMENT = True
+
+    def __init__(self, conf: Configuration, container_id_factory):
+        super().__init__(conf, container_id_factory)
+        self.weights: Dict[str, float] = {}
+        for name in conf.get_list("yarn.scheduler.fair.queues", ["default"]):
+            self.weights[name] = conf.get_float(
+                f"yarn.scheduler.fair.root.{name}.weight", 1.0)
+
+    def add_app(self, attempt_id: str, queue: str, user: str) -> None:
+        self.weights.setdefault(queue, 1.0)
+        super().add_app(attempt_id, queue, user)
+
+    def _queue_usage(self) -> Dict[str, Resource]:
+        usage: Dict[str, Resource] = {q: Resource() for q in self.weights}
+        for app in self.apps.values():
+            usage[app.queue] = usage[app.queue].add(app.used)
+        return usage
+
+    def fair_share(self, queue: str, total: Resource) -> float:
+        """This queue's deserved share of the cluster (weight-normalized)."""
+        wsum = sum(self.weights.values()) or 1.0
+        return self.weights.get(queue, 1.0) / wsum
+
+    def _app_order(self) -> List[SchedulerApp]:
+        total = self.cluster_resource()
+        usage = self._queue_usage()
+
+        def queue_key(qname: str) -> float:
+            share = usage[qname].dominant_share(total)
+            return share / max(self.weights.get(qname, 1.0), 1e-9)
+
+        out: List[SchedulerApp] = []
+        for qname in sorted(self.weights, key=queue_key):
+            apps = [a for a in self.apps.values() if a.queue == qname]
+            apps.sort(key=lambda a: a.used.dominant_share(total))
+            out.extend(apps)
+        return out
+
+    def _guaranteed_share(self, queue: str) -> float:
+        return self.fair_share(queue, self.cluster_resource())
+
+    def preemption_candidates(self, protect=lambda cid: False):
+        """Fair-share preemption (ref: FSPreemptionThread)."""
+        return self._preempt_over_guarantee(protect)
+
 
 def make_scheduler(conf: Configuration, container_id_factory) -> Scheduler:
     kind = conf.get("yarn.resourcemanager.scheduler.class", "capacity")
     if kind in ("fifo", "FifoScheduler"):
         return FifoScheduler(conf, container_id_factory)
+    if kind in ("fair", "FairScheduler"):
+        return FairScheduler(conf, container_id_factory)
     return CapacityScheduler(conf, container_id_factory)

@@ -1,0 +1,179 @@
+"""Fair scheduler, preemption, and work-preserving RM restart.
+
+Ref targets: scheduler/fair/FairScheduler.java, monitor/capacity/
+ProportionalCapacityPreemptionPolicy.java, recovery/ZKRMStateStore.java:180
+(+ TestWorkPreservingRMRestart's bounce-the-RM-keep-the-store pattern).
+"""
+
+import os
+import time
+
+import pytest
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.yarn.records import (ApplicationId, ContainerId, NodeId,
+                                     Resource, ResourceRequest)
+from hadoop_tpu.yarn.scheduler import (CapacityScheduler, FairScheduler,
+                                       make_scheduler)
+
+
+def _cid_factory():
+    app = ApplicationId(1, 1)
+    seqs = {}
+
+    def make(attempt_id, seq):
+        no = int(attempt_id.rsplit("_", 1)[1])
+        return ContainerId(app, no, seqs.setdefault(attempt_id, 0) + seq)
+    return make
+
+
+def _drive(sched, node_id):
+    sched.node_heartbeat(node_id)
+
+
+def test_fair_scheduler_shares_by_weight():
+    conf = Configuration(load_defaults=False)
+    conf.set("yarn.scheduler.fair.queues", "gold,silver")
+    conf.set("yarn.scheduler.fair.root.gold.weight", "3.0")
+    conf.set("yarn.scheduler.fair.root.silver.weight", "1.0")
+    s = FairScheduler(conf, _cid_factory())
+    nid = NodeId("h1", 1)
+    s.add_node(nid, Resource(8000, 8), "h1:1")
+    s.add_app("application_1_1_01", "gold", "u")
+    s.add_app("application_1_2_01", "silver", "u")
+    # both ask for everything; fair share should land ~3:1 by memory
+    s.allocate("application_1_1_01",
+               [ResourceRequest(1, 8, Resource(1000, 1))], [])
+    s.allocate("application_1_2_01",
+               [ResourceRequest(1, 8, Resource(1000, 1))], [])
+    for _ in range(8):
+        _drive(s, nid)
+    gold, _ = s.allocate("application_1_1_01", [], [])
+    silver, _ = s.allocate("application_1_2_01", [], [])
+    assert len(gold) + len(silver) == 8
+    assert len(gold) == 6 and len(silver) == 2  # 3:1 split of 8 containers
+
+
+def test_fair_scheduler_auto_creates_queue():
+    conf = Configuration(load_defaults=False)
+    s = FairScheduler(conf, _cid_factory())
+    s.add_app("application_1_1_01", "adhoc", "u")  # no error
+    assert "adhoc" in s.weights
+
+
+def test_make_scheduler_kinds():
+    for kind, cls in (("fair", "FairScheduler"),
+                      ("capacity", "CapacityScheduler"),
+                      ("fifo", "FifoScheduler")):
+        conf = Configuration(load_defaults=False)
+        conf.set("yarn.resourcemanager.scheduler.class", kind)
+        assert type(make_scheduler(conf, _cid_factory())).__name__ == cls
+
+
+def test_capacity_preemption_candidates():
+    conf = Configuration(load_defaults=False)
+    conf.set("yarn.scheduler.capacity.root.queues", "a,b")
+    conf.set("yarn.scheduler.capacity.root.a.capacity", "50")
+    conf.set("yarn.scheduler.capacity.root.b.capacity", "50")
+    s = CapacityScheduler(conf, _cid_factory())
+    nid = NodeId("h1", 1)
+    s.add_node(nid, Resource(8000, 8), "h1:1")
+    # app A (queue a) grabs the whole cluster
+    s.add_app("application_1_1_01", "a", "u")
+    s.allocate("application_1_1_01",
+               [ResourceRequest(1, 8, Resource(1000, 1))], [])
+    for _ in range(8):
+        _drive(s, nid)
+    got_a, _ = s.allocate("application_1_1_01", [], [])
+    assert len(got_a) == 8
+    # no starvation yet → nothing to preempt
+    assert s.preemption_candidates() == []
+    # app B (queue b) arrives with demand it can't place
+    s.add_app("application_1_2_01", "b", "u")
+    s.allocate("application_1_2_01",
+               [ResourceRequest(1, 4, Resource(1000, 1))], [])
+    victims = s.preemption_candidates()
+    assert victims, "over-capacity queue must yield victims"
+    assert all(aid == "application_1_1_01" for aid, _ in victims)
+    # protected (AM) containers are skipped
+    protected = {str(got_a[0].container_id)}
+    victims2 = s.preemption_candidates(
+        protect=lambda cid: str(cid) in protected)
+    assert all(str(c.container_id) not in protected for _, c in victims2)
+
+
+def test_fair_preemption_candidates():
+    conf = Configuration(load_defaults=False)
+    conf.set("yarn.scheduler.fair.queues", "a,b")
+    s = FairScheduler(conf, _cid_factory())
+    nid = NodeId("h1", 1)
+    s.add_node(nid, Resource(4000, 4), "h1:1")
+    s.add_app("application_1_1_01", "a", "u")
+    s.allocate("application_1_1_01",
+               [ResourceRequest(1, 4, Resource(1000, 1))], [])
+    for _ in range(4):
+        _drive(s, nid)
+    s.add_app("application_1_2_01", "b", "u")
+    s.allocate("application_1_2_01",
+               [ResourceRequest(1, 2, Resource(1000, 1))], [])
+    assert s.preemption_candidates()
+
+
+# ------------------------------------------------- work-preserving restart
+
+
+def test_work_preserving_rm_restart(tmp_path):
+    """Bounce the RM mid-job: NMs re-register with live containers, the
+    AM re-registers and re-asks, running work is NOT restarted, and the
+    job completes. Ref: TestWorkPreservingRMRestart."""
+    from hadoop_tpu.examples.wordcount import TokenizerMapper
+    from hadoop_tpu.mapreduce import Job
+    from hadoop_tpu.mapreduce.api import class_ref
+    from hadoop_tpu.mapreduce import history
+    from hadoop_tpu.testing.minicluster import MiniMRYarnCluster
+    from hadoop_tpu.testing.mr_helpers import SlowGateReducer
+
+    with MiniMRYarnCluster(num_nodes=2) as cluster:
+        fs = cluster.get_filesystem()
+        fs.mkdirs("/wp-in")
+        for i in range(2):
+            fs.write_all(f"/wp-in/f{i}.txt",
+                         (f"one two three {i}\n" * 40).encode())
+        gate = str(tmp_path / "gate")
+        open(gate, "w").close()
+        job = (Job(cluster.rm_addr, cluster.default_fs, name="wp")
+               .set_mapper(TokenizerMapper)
+               .set_reducer(class_ref(SlowGateReducer))
+               .add_input_path("/wp-in")
+               .set_output_path("/wp-out")
+               .set_num_reduces(1)
+               .set("test.reduce.gate", gate))
+        job.submit()
+
+        # wait until the maps are done (the job is mid-flight: reduce
+        # gated) so the restart happens with live AM + reduce containers
+        hist = f"/tmp/staging/{job.job_id}/history"
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            done = [e for e in history.read_events(fs, hist)
+                    if e["type"] == history.TASK_FINISHED]
+            if len(done) >= 2:
+                break
+            time.sleep(0.2)
+        assert len(done) >= 2, "maps never finished"
+
+        cluster.yarn.restart_rm()
+        time.sleep(1.0)
+        os.remove(gate)
+
+        assert job.wait_for_completion(timeout=90), job.diagnostics
+        # work-preserving: the AM was NOT restarted — the RM knows only
+        # attempt 1 and the history has each map exactly once
+        evs = list(history.read_events(
+            fs, f"/mr-history/done/{job.job_id}"))
+        maps = [e["task_id"] for e in evs
+                if e["type"] == history.TASK_FINISHED
+                and e["task_type"] == "map"]
+        assert len(maps) == len(set(maps)) == 2
+        report = cluster.yarn.rm.apps[job._app_id].report()
+        assert report.attempt_no == 1, "AM must not have been relaunched"
