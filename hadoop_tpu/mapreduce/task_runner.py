@@ -113,14 +113,35 @@ def run_map(job: Dict, task: Dict, umbilical, attempt_id: str,
     if job.get("combiner"):
         combiner = make_combiner(load_class(job["combiner"]), conf, counters)
     workdir = os.environ.get("HTPU_WORK_DIR", ".")
-    collector = MapOutputCollector(
-        max(num_reduces, 1), partitioner.partition,
-        os.path.join(workdir, "spill"), counters,
-        sort_mb=float(conf.get("mapreduce.task.io.sort.mb", "64")),
-        codec=codec, combiner=combiner, partitioner=partitioner)
+    # Map-only job: emitted records go straight through the OutputFormat
+    # to part-m-* files — no sort, no shuffle (ref: MapTask's
+    # NewDirectOutputCollector when numReduceTasks == 0).
+    direct_writer = None
+    direct_tmp = ""
+    if num_reduces == 0:
+        output_format = load_class(job["output_format"])()
+        map_index = int(task["task_id"].rsplit("_", 1)[1])
+        part_name = f"part-m-{map_index:05d}"
+        direct_tmp = f"{job['output']}/_temporary/{attempt_id}/{part_name}"
+        direct_writer = output_format.open(fs, direct_tmp, conf)
 
-    ctx = TaskContext(conf, counters, collector.collect, task["task_id"],
-                      emit_batch=collector.collect_batch)
+        def emit_direct(k: bytes, v: bytes) -> None:
+            counters.incr(Counters.MAP_OUTPUT_RECORDS)
+            direct_writer.write(k, v)
+
+        collector = None
+        ctx = TaskContext(conf, counters, emit_direct, task["task_id"],
+                          emit_batch=getattr(direct_writer, "write_batch",
+                                             None))
+    else:
+        collector = MapOutputCollector(
+            max(num_reduces, 1), partitioner.partition,
+            os.path.join(workdir, "spill"), counters,
+            sort_mb=float(conf.get("mapreduce.task.io.sort.mb", "64")),
+            codec=codec, combiner=combiner, partitioner=partitioner)
+        ctx = TaskContext(conf, counters, collector.collect,
+                          task["task_id"],
+                          emit_batch=collector.collect_batch)
     mapper.setup(ctx)
     # Batch plane: when the input format can hand packed batches and the
     # mapper is batch-capable (explicit map_batch, or the un-overridden
@@ -148,6 +169,21 @@ def run_map(job: Dict, task: Dict, umbilical, attempt_id: str,
             if nrec % 1000 == 0:
                 reporter.set_progress(0.9 * min(1.0, nrec / (nrec + 1000)))
     mapper.cleanup(ctx)
+
+    if direct_writer is not None:
+        direct_writer.close()
+        reporter.set_progress(0.95)
+        _await_commit(umbilical, attempt_id)
+        part_name = direct_tmp.rsplit("/", 1)[-1]
+        final_path = f"{job['output']}/{part_name}"
+        if not fs.rename(direct_tmp, final_path):
+            raise TaskFailure(f"commit rename {direct_tmp} failed")
+        fs.delete(f"{job['output']}/_temporary/{attempt_id}",
+                  recursive=True)
+        reporter.set_progress(1.0)
+        fs.close()
+        host = os.environ.get("HTPU_NM_HOST", "127.0.0.1")
+        return f"{host}:{os.environ[shuffle.ENV_SHUFFLE_PORT]}"
 
     t_mapped = time.monotonic()
     # attempt-named output; committed by rename (speculative attempts write

@@ -276,6 +276,10 @@ class MiniDFSCluster:
     def nn_addr(self):
         return ("127.0.0.1", self.namenode.port)
 
+    @property
+    def default_fs(self) -> str:
+        return f"htpu://127.0.0.1:{self.namenode.port}"
+
     def get_filesystem(self) -> DistributedFileSystem:
         fs = DistributedFileSystem([self.nn_addr],
                                    Configuration(other=self.conf))

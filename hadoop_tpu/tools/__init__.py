@@ -1,0 +1,10 @@
+"""Tools layer (L5) — utilities riding the core framework.
+
+The counterpart of ``hadoop-tools`` (SURVEY §2.5): each tool is a small
+CLI + library on top of the MR engine / FileSystem SPI:
+
+- ``hadoop_tpu.tools.distcp``     distributed copy        (ref: hadoop-distcp)
+- ``hadoop_tpu.tools.streaming``  external-process tasks  (ref: hadoop-streaming)
+- ``hadoop_tpu.tools.sls``        scheduler load simulator (ref: hadoop-sls)
+- ``hadoop_tpu.tools.archive``    har-style archives      (ref: hadoop-archives)
+"""

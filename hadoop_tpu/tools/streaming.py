@@ -1,0 +1,156 @@
+"""Streaming — run mappers/reducers as external processes.
+
+Parity with the reference bridge (ref: hadoop-tools/hadoop-streaming
+(14 K LoC) — PipeMapper/PipeReducer feed records over the child's
+stdin/stdout as ``key<TAB>value`` lines; StreamJob wires the conf): user
+commands see exactly that contract here. A pump thread feeds stdin while
+the task thread consumes parsed stdout lines, so arbitrarily large
+streams flow with bounded buffering (the reference's
+PipedInputStream/OutputStream pair).
+
+  streaming_job(rm, fs, input, output, mapper="/bin/sed -e s/a/b/",
+                reducer="/usr/bin/wc -l")   # reducer optional (map-only)
+
+Line protocol (ref: streaming's KeyValueTextInputFormat defaults): a
+mapper input line is ``key\\tvalue``; output lines split on the first tab
+(no tab → whole line is the key, empty value). The reducer sees its
+group's lines contiguously, key-sorted — identical to the reference.
+"""
+
+from __future__ import annotations
+
+import logging
+import shlex
+import subprocess
+import threading
+from typing import Iterator, List, Optional, Tuple
+
+from hadoop_tpu.mapreduce.api import Mapper, Reducer
+
+log = logging.getLogger(__name__)
+
+
+def _parse_line(line: bytes) -> Tuple[bytes, bytes]:
+    key, sep, val = line.partition(b"\t")
+    return key, val
+
+
+class _Pipe:
+    """One external process with a stdin pump and a stdout line reader."""
+
+    def __init__(self, command: str):
+        self.proc = subprocess.Popen(
+            shlex.split(command), stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE, bufsize=1 << 20)
+        self._out_lines: List[bytes] = []
+        self._out_done = threading.Event()
+        self._reader = threading.Thread(target=self._drain, daemon=True)
+        self._reader.start()
+
+    def _drain(self) -> None:
+        try:
+            for line in self.proc.stdout:
+                self._out_lines.append(line.rstrip(b"\n"))
+        finally:
+            self._out_done.set()
+
+    def feed(self, line: bytes) -> None:
+        self.proc.stdin.write(line + b"\n")
+
+    def finish(self, timeout: float = 60.0) -> List[bytes]:
+        self.proc.stdin.close()
+        if not self._out_done.wait(timeout):
+            self.proc.kill()
+            raise IOError("streaming child produced no EOF in time")
+        rc = self.proc.wait(timeout=timeout)
+        if rc != 0:
+            raise IOError(f"streaming child exited {rc}")
+        return self._out_lines
+
+
+class StreamMapper(Mapper):
+    """Ref: streaming PipeMapper. Feeds every record, emits every output
+    line once the child closes (simple batch contract — the child is
+    line-buffered and free-running, so memory is bounded by its output)."""
+
+    def setup(self, ctx):
+        self._pipe = _Pipe(ctx.conf["stream.map.command"])
+
+    def map(self, key: bytes, value: bytes, ctx) -> None:
+        self._pipe.feed(value if not key else key + b"\t" + value)
+
+    def cleanup(self, ctx):
+        for line in self._pipe.finish():
+            k, v = _parse_line(line)
+            ctx.emit(k, v)
+
+
+class TextValueStreamMapper(StreamMapper):
+    """Text-input convenience: feed only the line (TextInputFormat keys
+    are byte offsets, which streaming children don't want)."""
+
+    def map(self, key: bytes, value: bytes, ctx) -> None:
+        self._pipe.feed(value)
+
+
+class StreamReducer(Reducer):
+    """Ref: streaming PipeReducer — the child sees the sorted
+    ``key\\tvalue`` stream with groups contiguous."""
+
+    def setup(self, ctx):
+        self._pipe = _Pipe(ctx.conf["stream.reduce.command"])
+
+    def reduce(self, key: bytes, values: Iterator[bytes], ctx) -> None:
+        for v in values:
+            self._pipe.feed(key + b"\t" + v)
+
+    def cleanup(self, ctx):
+        for line in self._pipe.finish():
+            k, v = _parse_line(line)
+            ctx.emit(k, v)
+
+
+def streaming_job(rm_addr, default_fs: str, input_path: str,
+                  output_path: str, *, mapper: str,
+                  reducer: Optional[str] = None, num_reduces: int = 1):
+    """Build the streaming Job. Ref: StreamJob.setJobConf."""
+    from hadoop_tpu.mapreduce import Job
+    from hadoop_tpu.mapreduce.api import class_ref
+    job = (Job(rm_addr, default_fs, name="streamjob")
+           .set_mapper(class_ref(TextValueStreamMapper))
+           .add_input_path(input_path)
+           .set_output_path(output_path)
+           .set("stream.map.command", mapper))
+    if reducer:
+        job.set_reducer(class_ref(StreamReducer)) \
+           .set("stream.reduce.command", reducer) \
+           .set_num_reduces(num_reduces)
+    else:
+        job.set_num_reduces(0)
+    return job
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import json
+    ap = argparse.ArgumentParser(prog="streaming")
+    ap.add_argument("--rm", required=True)
+    ap.add_argument("--fs", required=True)
+    ap.add_argument("--input", required=True)
+    ap.add_argument("--output", required=True)
+    ap.add_argument("--mapper", required=True)
+    ap.add_argument("--reducer")
+    ap.add_argument("--reduces", type=int, default=1)
+    args = ap.parse_args(argv)
+    host, _, port = args.rm.rpartition(":")
+    job = streaming_job((host, int(port)), args.fs, args.input,
+                        args.output, mapper=args.mapper,
+                        reducer=args.reducer, num_reduces=args.reduces)
+    ok = job.wait_for_completion()
+    print(json.dumps({"ok": ok}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())
