@@ -319,6 +319,52 @@ class BlockStore:
 
     # ------------------------------------------------------------ inventory
 
+    def reconcile(self) -> Tuple[List[Block], List[Block]]:
+        """DirectoryScanner diff of memory vs disk (ref: server/datanode/
+        DirectoryScanner.java:64 reconcile): replicas whose data file
+        vanished are dropped from memory (returned first — caller tells
+        the NN so re-replication starts); orphaned finalized files with a
+        valid meta are adopted (returned second — caller reports them
+        received)."""
+        with self._lock:
+            snapshot = {bid: rep for bid, rep in self._replicas.items()
+                        if bid not in self._open_writers}
+        vanished: List[Block] = []
+        for bid, rep in snapshot.items():
+            if not os.path.exists(self._path(rep.state, bid)):
+                vanished.append(rep.to_block())
+                with self._lock:
+                    if self._replicas.get(bid) is rep:
+                        del self._replicas[bid]
+        adopted: List[Block] = []
+        fin_dir = os.path.join(self.dir, Replica.FINALIZED)
+        for name in os.listdir(fin_dir):
+            if not name.startswith("blk_") or name.endswith(".meta"):
+                continue
+            bid = int(name[4:])
+            with self._lock:
+                known = bid in self._replicas
+            if known:
+                continue
+            data_path = os.path.join(fin_dir, name)
+            gs = self._read_meta_genstamp(data_path + ".meta")
+            if gs is None:
+                continue  # torn orphan: no valid meta — leave for operator
+            rep = Replica(bid, gs, os.path.getsize(data_path),
+                          Replica.FINALIZED)
+            with self._lock:
+                self._replicas.setdefault(bid, rep)
+            adopted.append(rep.to_block())
+        return vanished, adopted
+
+    def verify_replica(self, block: Block) -> None:
+        """Full CRC sweep of one replica (VolumeScanner's unit of work).
+        Raises ChecksumError on rot. Ref: VolumeScanner.java:55."""
+        from hadoop_tpu.util.crc import DataChecksum
+        _, _, checksum, visible = self.open_for_read(block)
+        for pos, data, sums in self.read_chunks(block, 0, visible):
+            checksum.verify(data, sums, base_pos=pos)
+
     def all_finalized(self) -> List[Block]:
         with self._lock:
             return [r.to_block() for r in self._replicas.values()

@@ -122,6 +122,13 @@ class DataNode(AbstractService):
             "dfs.heartbeat.interval", 3.0)
         self.block_report_interval = conf.get_time_seconds(
             "dfs.blockreport.interval", 6 * 3600.0)
+        # Background integrity scanners (0 = disabled).
+        # Ref: dfs.datanode.scan.period.hours (VolumeScanner.java:55) and
+        # dfs.datanode.directoryscan.interval (DirectoryScanner.java:64).
+        self.volume_scan_interval = conf.get_time_seconds(
+            "dfs.datanode.scan.period", 3 * 3600.0)
+        self.dir_scan_interval = conf.get_time_seconds(
+            "dfs.datanode.directoryscan.interval", 6 * 3600.0)
         self._client = Client(conf)
 
     def service_start(self) -> None:
@@ -141,6 +148,12 @@ class DataNode(AbstractService):
             actor = _BPServiceActor(self, addr)
             self._actors.append(actor)
             actor.start()
+        if self.volume_scan_interval > 0:
+            Daemon(self._volume_scan_loop,
+                   f"volume-scanner-{self.uuid[:8]}").start()
+        if self.dir_scan_interval > 0:
+            Daemon(self._dir_scan_loop,
+                   f"directory-scanner-{self.uuid[:8]}").start()
         log.info("DataNode %s up (xfer port %d, NNs %s)", self.uuid[:8],
                  self.xceiver.port, self.nn_addrs)
 
@@ -173,6 +186,64 @@ class DataNode(AbstractService):
     def _on_block_deleted(self, block: Block) -> None:
         for actor in self._actors:
             actor.note_deleted(block)
+
+    # -------------------------------------------------------------- scanners
+
+    def _report_bad_block(self, block: Block) -> None:
+        """Self-detected rot → every NN (ref: the VolumeScanner's
+        reportBadBlocks path through the BPOS)."""
+        for actor in self._actors:
+            try:
+                if actor._proxy is not None:
+                    actor._proxy.report_bad_blocks(
+                        [block.to_wire()], [self.uuid])
+            except Exception as e:  # noqa: BLE001 — next heartbeat retries
+                log.warning("bad-block report to %s failed: %s",
+                            actor.nn_addr, e)
+
+    def _volume_scan_loop(self) -> None:
+        """Slow CRC sweep: one full pass over finalized replicas per
+        period, spread evenly. Ref: VolumeScanner.java:55 (its
+        bytes-per-second throttle becomes an even per-period spread)."""
+        from hadoop_tpu.util.crc import ChecksumError
+        while not self._stop_event.is_set():
+            blocks = self.store.all_finalized()
+            pause = self.volume_scan_interval / max(len(blocks), 1)
+            for block in blocks:
+                if self._stop_event.wait(min(pause,
+                                             self.volume_scan_interval)):
+                    return
+                try:
+                    self.store.verify_replica(block)
+                except ChecksumError as e:
+                    log.warning("Volume scanner found rot in %s: %s",
+                                block, e)
+                    self._report_bad_block(block)
+                except IOError:
+                    pass  # replica finalized/invalidated mid-scan
+            if not blocks and self._stop_event.wait(
+                    self.volume_scan_interval):
+                return
+
+    def _dir_scan_loop(self) -> None:
+        """Memory↔disk reconciliation. Ref: DirectoryScanner.java:64."""
+        while not self._stop_event.wait(self.dir_scan_interval):
+            try:
+                vanished, adopted = self.store.reconcile()
+            except OSError as e:
+                log.warning("directory scan failed: %s", e)
+                continue
+            for block in vanished:
+                log.warning("Directory scanner: replica %s vanished from "
+                            "disk", block)
+                # report it DELETED (it is): the NN drops this location
+                # and re-replicates from the healthy copies — a bad-block
+                # report would dead-end in invalidating a missing file
+                self._on_block_deleted(block)
+            for block in adopted:
+                log.info("Directory scanner: adopted on-disk replica %s",
+                         block)
+                self._on_block_received(block)
 
     # -------------------------------------------------------------- commands
 

@@ -42,7 +42,8 @@ class DatanodeDescriptor(DatanodeInfo):
     Ref: blockmanagement/DatanodeDescriptor.java."""
 
     __slots__ = ("blocks", "invalidate_queue", "transfer_queue",
-                 "recover_queue", "ec_queue", "xceiver_count")
+                 "recover_queue", "ec_queue", "xceiver_count",
+                 "network_location")
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
@@ -52,6 +53,7 @@ class DatanodeDescriptor(DatanodeInfo):
         self.recover_queue: List[Tuple[Block, int]] = []
         self.ec_queue: List[Dict] = []  # EC_RECONSTRUCT payloads
         self.xceiver_count = 0
+        self.network_location = "/default-pod"
 
     def public_info(self) -> DatanodeInfo:
         info = DatanodeInfo(self.uuid, self.host, self.xfer_port,
@@ -132,17 +134,23 @@ class DatanodeManager:
             + 10 * self.heartbeat_interval_s
         self._nodes: Dict[str, DatanodeDescriptor] = {}
         self._lock = threading.Lock()
+        # Locality tree (ref: DatanodeManager's NetworkTopology + the
+        # dnsToSwitchMapping resolver chain)
+        from hadoop_tpu.net import NetworkTopology, TopologyResolver
+        self.topology = NetworkTopology(TopologyResolver(conf))
 
     # ---------------------------------------------------------- registration
 
     def register(self, info: DatanodeInfo) -> DatanodeDescriptor:
+        location = self.topology.add(info.host)
         with self._lock:
             node = self._nodes.get(info.uuid)
             if node is None:
                 node = DatanodeDescriptor(info.uuid, info.host,
                                           info.xfer_port, info.ipc_port)
                 self._nodes[info.uuid] = node
-                log.info("Registered datanode %s", node)
+                log.info("Registered datanode %s at %s", node, location)
+            node.network_location = location
             node.host = info.host
             node.xfer_port = info.xfer_port
             node.ipc_port = info.ipc_port
@@ -289,8 +297,13 @@ class DatanodeManager:
                        writer_host: Optional[str] = None,
                        preferred_types: Optional[List[str]] = None
                        ) -> List[DatanodeDescriptor]:
-        """Pick n distinct live targets, local-writer-first then
-        load-weighted random. Ref: BlockPlacementPolicyDefault.chooseTarget.
+        """Topology-aware target choice, the reference default policy's
+        shape (ref: BlockPlacementPolicyDefault.chooseTarget): replica 1
+        on the writer's host when possible; replica 2 OFF the first
+        replica's pod (survives a pod/ICI-domain loss); replica 3 on the
+        SAME pod as replica 2 (one cross-pod transfer, not two); the rest
+        load-spread random. Within each constraint the less-loaded of two
+        random candidates wins (power-of-two-choices).
         ``preferred_types`` narrows to those storage types when any such
         node is live (falling back to all, like the reference's
         fallback-storage-type chain)."""
@@ -306,21 +319,48 @@ class DatanodeManager:
         if not candidates:
             return []
         chosen: List[DatanodeDescriptor] = []
-        # First replica on the writer's host when possible (short-circuit win).
+
+        def pick_from(pool: List[DatanodeDescriptor]) -> None:
+            a = random.choice(pool)
+            b = random.choice(pool)
+            pick = a if a.xceiver_count <= b.xceiver_count else b
+            chosen.append(pick)
+            candidates.remove(pick)
+
+        # replica 1: writer-local when possible (short-circuit win)
         if writer_host is not None:
             local = [c for c in candidates if c.host == writer_host]
             if local:
                 pick = min(local, key=lambda c: c.xceiver_count)
                 chosen.append(pick)
                 candidates.remove(pick)
+        if candidates and len(chosen) < n and not chosen:
+            pick_from(candidates)
+        # replica 2: off the first replica's pod when the cluster spans pods
+        if candidates and len(chosen) < n:
+            first_pod = chosen[0].network_location
+            off_pod = [c for c in candidates
+                       if c.network_location != first_pod]
+            pick_from(off_pod or candidates)
+        # replica 3: same pod as replica 2 (one cross-pod hop total)
+        if candidates and len(chosen) < n and len(chosen) >= 2:
+            second_pod = chosen[1].network_location
+            same = [c for c in candidates
+                    if c.network_location == second_pod]
+            pick_from(same or candidates)
         while candidates and len(chosen) < n:
-            # Load-spread: sample 2, keep the less-loaded (power of two choices).
-            a = random.choice(candidates)
-            b = random.choice(candidates)
-            pick = a if a.xceiver_count <= b.xceiver_count else b
-            chosen.append(pick)
-            candidates.remove(pick)
+            pick_from(candidates)
         return chosen
+
+    def sort_by_distance(self, reader_host: Optional[str],
+                         nodes: List[DatanodeDescriptor]
+                         ) -> List[DatanodeDescriptor]:
+        """Read ordering: local, then same-pod, then the rest (ref:
+        DatanodeManager.sortLocatedBlocks → NetworkTopology
+        .sortByDistance)."""
+        if not reader_host:
+            return nodes
+        return self.topology.sort_by_distance(reader_host, nodes)
 
 
 class BlockManager:
@@ -709,7 +749,8 @@ class BlockManager:
 
     # --------------------------------------------------------------- queries
 
-    def located_block(self, block: Block, offset: int) -> LocatedBlock:
+    def located_block(self, block: Block, offset: int,
+                      reader_host: Optional[str] = None) -> LocatedBlock:
         with self._lock:
             info = self._blocks.get(block.block_id)
             if info is None:
@@ -732,7 +773,13 @@ class BlockManager:
                 node = self.dn_manager.get(uuid)
                 if node is not None and node.state != DatanodeInfo.STATE_DEAD:
                     locs.append(node.public_info())
-            random.shuffle(locs)  # spread read load
+            random.shuffle(locs)  # spread read load among equals
+            if reader_host:
+                # closest-first for this reader (ref: DatanodeManager
+                # .sortLocatedBlocks); the shuffle above still spreads
+                # load within each distance class (sort is stable)
+                locs = self.dn_manager.topology.sort_by_distance(
+                    reader_host, locs)
             return LocatedBlock(info.block, locs, offset,
                                 corrupt=(not locs and bool(info.locations)))
 

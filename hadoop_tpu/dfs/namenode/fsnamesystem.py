@@ -43,6 +43,29 @@ from hadoop_tpu.security.ugi import current_user
 
 log = logging.getLogger(__name__)
 
+# The audit trail (ref: FSNamesystem.java:392 logAuditEvent + the
+# "FSNamesystem.audit" logger convention): one line per namespace op with
+# the caller's identity and address from the RPC CallContext. Operators
+# attach handlers/sinks to THIS logger name.
+audit_log = logging.getLogger("hadoop_tpu.audit")
+
+
+def log_audit_event(allowed: bool, cmd: str, src: str,
+                    dst: Optional[str] = None) -> None:
+    """Ref: FSNamesystem.logAuditEvent — ugi/ip/cmd/src/dst(+CallerContext
+    = the RPC client id, its role here)."""
+    if not audit_log.isEnabledFor(logging.INFO):
+        return
+    from hadoop_tpu.ipc.server import current_call
+    call = current_call()
+    ugi = call.user.user_name if call else current_user().user_name
+    ip = call.address if call else "local"
+    ctx = call.client_id.hex()[:16] if call and call.client_id else "-"
+    audit_log.info(
+        "allowed=%s\tugi=%s\tip=%s\tcmd=%s\tsrc=%s\tdst=%s\tcallerContext=%s",
+        str(allowed).lower(), ugi, ip, cmd, src, dst or "null", ctx)
+
+
 # Ref: BlockStoragePolicySuite — policy ids the mover acts on. On a
 # homogeneous TPU-host fleet these are placement intents, not media types.
 STORAGE_POLICIES = ("HOT", "WARM", "COLD", "ALL_SSD", "ONE_SSD",
@@ -276,6 +299,7 @@ class FSNamesystem:
                     "ec": ec_policy})
                 status = inode.status(path)
             self.editlog.log_sync(txid)
+            log_audit_event(True, "create", path)
             return status
 
     def add_block(self, path: str, client_name: str,
@@ -555,7 +579,12 @@ class FSNamesystem:
 
     def get_block_locations(self, path: str, offset: int,
                             length: int) -> Dict:
-        """Ref: FSNamesystem.getBlockLocations."""
+        """Ref: FSNamesystem.getBlockLocations (+ the sortLocatedBlocks
+        call that orders replicas closest-to-reader-first)."""
+        from hadoop_tpu.ipc.server import current_call
+        call = current_call()
+        reader_host = call.address.rsplit(":", 1)[0] if call else None
+        log_audit_event(True, "open", path)
         with self._m["get_block_locations"].time():
             with self.lock.read():
                 inode = self.fsdir.get_inode(path)
@@ -565,7 +594,8 @@ class FSNamesystem:
                 pos = 0
                 for b in inode.blocks:
                     if pos + b.num_bytes > offset and pos < offset + length:
-                        blocks.append(self.bm.located_block(b, pos))
+                        blocks.append(self.bm.located_block(
+                            b, pos, reader_host=reader_host))
                     pos += b.num_bytes
                 return {
                     "length": inode.length(),
@@ -580,6 +610,7 @@ class FSNamesystem:
                 return None if inode is None else inode.status(path).to_wire()
 
     def listing(self, path: str) -> List[Dict]:
+        log_audit_event(True, "listStatus", path)
         with self._m["listing"].time():
             with self.lock.read():
                 return [st.to_wire() for st in self.fsdir.listing(path)]
@@ -613,6 +644,7 @@ class FSNamesystem:
                 txid = self.editlog.log_edit(el.OP_MKDIR,
                                              {"p": path, "o": owner})
             self.editlog.log_sync(txid)
+            log_audit_event(True, "mkdirs", path)
             return True
 
     def delete(self, path: str, recursive: bool) -> bool:
@@ -626,6 +658,7 @@ class FSNamesystem:
                 txid = self.editlog.log_edit(el.OP_DELETE,
                                              {"p": path, "r": recursive})
             self.editlog.log_sync(txid)
+            log_audit_event(True, "delete", path)
             return True
 
     def _delete_locked(self, path: str, recursive: bool) -> bool:
@@ -662,6 +695,7 @@ class FSNamesystem:
                 txid = self.editlog.log_edit(el.OP_RENAME,
                                              {"s": src, "d": dst})
             self.editlog.log_sync(txid)
+            log_audit_event(True, "rename", src, dst)
             return True
 
     def set_replication(self, path: str, replication: int) -> bool:

@@ -1,0 +1,6 @@
+"""Network topology / locality (ref: hadoop-common org.apache.hadoop.net)."""
+
+from hadoop_tpu.net.topology import (NetworkTopology, TopologyResolver,
+                                     distance)
+
+__all__ = ["NetworkTopology", "TopologyResolver", "distance"]

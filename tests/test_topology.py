@@ -1,0 +1,166 @@
+"""NetworkTopology, topology-aware placement/read-order, DN scanners,
+NN audit log. Ref: net/NetworkTopology.java,
+BlockPlacementPolicyDefault.java, VolumeScanner.java:55,
+DirectoryScanner.java:64, FSNamesystem.java:392 (logAuditEvent)."""
+
+import glob
+import logging
+import os
+import time
+
+import pytest
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.net import NetworkTopology, TopologyResolver, distance
+
+
+def test_resolver_table_and_default():
+    conf = Configuration(load_defaults=False)
+    conf.set("net.topology.table", "h1=/pod0, h2=/pod0, h3=/pod1")
+    r = TopologyResolver(conf)
+    assert r.resolve("h1") == "/pod0"
+    assert r.resolve("h3") == "/pod1"
+    assert r.resolve("unknown") == "/default-pod"
+
+
+def test_distance_and_sort():
+    assert distance("/p0", "h1", "/p0", "h1") == 0
+    assert distance("/p0", "h1", "/p0", "h2") == 2
+    assert distance("/p0", "h1", "/p1", "h2") == 4
+    conf = Configuration(load_defaults=False)
+    conf.set("net.topology.table", "h1=/pod0,h2=/pod0,h3=/pod1")
+    topo = NetworkTopology(TopologyResolver(conf))
+    for h in ("h1", "h2", "h3"):
+        topo.add(h)
+
+    class N:
+        def __init__(self, host):
+            self.host = host
+    nodes = [N("h3"), N("h2"), N("h1")]
+    ordered = topo.sort_by_distance("h1", nodes)
+    assert [n.host for n in ordered] == ["h1", "h2", "h3"]
+    assert topo.pods() == {"/pod0": ["h1", "h2"], "/pod1": ["h3"]}
+
+
+def test_placement_spreads_across_pods():
+    from hadoop_tpu.dfs.namenode.blockmanager import BlockManager
+    from hadoop_tpu.dfs.protocol.records import DatanodeInfo
+    conf = Configuration(load_defaults=False)
+    conf.set("net.topology.table",
+             "hA=/pod0,hB=/pod0,hC=/pod1,hD=/pod1")
+    bm = BlockManager(conf)
+    dm = bm.dn_manager
+    for i, host in enumerate(("hA", "hB", "hC", "hD")):
+        dm.register(DatanodeInfo(f"uuid{i}", host, 1000 + i, 2000 + i))
+    for trial in range(10):
+        targets = dm.choose_targets(3, set(), writer_host="hA")
+        assert len(targets) == 3
+        assert targets[0].host == "hA"                      # writer-local
+        assert targets[1].network_location != "/pod0"       # off-pod
+        assert targets[2].network_location == \
+            targets[1].network_location                     # same as r2
+    # read ordering: reader on hC sees pod1 replicas first
+    ordered = dm.sort_by_distance("hC", list(dm._nodes.values()))
+    assert ordered[0].host == "hC"
+    assert {n.host for n in ordered[:2]} == {"hC", "hD"}
+
+
+# --------------------------------------------------------------- e2e bits
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster
+    conf = Configuration(load_defaults=False)
+    conf.set("dfs.datanode.scan.period", "0.4s")
+    conf.set("dfs.datanode.directoryscan.interval", "0.4s")
+    # fast scanners hog the single CI core; don't let a starved heartbeat
+    # read as a dead node (same rationale as the benchmark conf)
+    conf.set("dfs.heartbeat.interval", "0.3s")
+    conf.set("dfs.namenode.heartbeat.recheck-interval", "5s")
+    with MiniDFSCluster(num_datanodes=3, conf=conf) as c:
+        yield c
+
+
+def _replica_files(cluster, suffix=""):
+    files = glob.glob(os.path.join(cluster.base_dir, "data*", "current",
+                                   "finalized", "blk_*" + suffix))
+    return [f for f in files if not f.endswith(".meta")]
+
+
+def test_audit_log_records_namespace_ops(cluster, caplog):
+    fs = cluster.get_filesystem()
+    with caplog.at_level(logging.INFO, logger="hadoop_tpu.audit"):
+        fs.mkdirs("/audit/dir")
+        fs.write_all("/audit/f.bin", b"x" * 1000)
+        fs.read_all("/audit/f.bin")
+        fs.rename("/audit/f.bin", "/audit/g.bin")
+        fs.delete("/audit/g.bin")
+    lines = [r.getMessage() for r in caplog.records
+             if r.name == "hadoop_tpu.audit"]
+    cmds = [dict(kv.split("=", 1) for kv in ln.split("\t"))
+            for ln in lines]
+    by_cmd = {c["cmd"]: c for c in cmds}
+    assert {"mkdirs", "create", "open", "rename", "delete"} <= set(by_cmd)
+    assert by_cmd["mkdirs"]["src"] == "/audit/dir"
+    assert by_cmd["rename"]["dst"] == "/audit/g.bin"
+    assert by_cmd["mkdirs"]["allowed"] == "true"
+    assert by_cmd["mkdirs"]["ugi"]
+    assert by_cmd["mkdirs"]["ip"] not in ("", "local")  # via RPC
+
+
+def test_volume_scanner_detects_silent_corruption(cluster):
+    """Flip bytes in one replica ON DISK (no reads): the volume scanner
+    must find it, report it, and the NN re-replicates around it."""
+    fs = cluster.get_filesystem()
+    fs.write_all("/scan/v.bin", os.urandom(400_000))
+    time.sleep(0.3)  # let incremental reports land
+    block_id = fs.client.get_block_locations(
+        "/scan/v.bin")["blocks"][0]["b"]["id"]
+    files = [f for f in _replica_files(cluster)
+             if os.path.basename(f) == f"blk_{block_id}"]
+    assert files
+    victim = sorted(files)[0]
+    with open(victim, "r+b") as f:
+        f.seek(100)
+        f.write(b"\xde\xad\xbe\xef")
+    # End state, not transient flags: the scanner reports, the NN
+    # invalidates the rotten copy and re-replicates — the victim file is
+    # deleted or rewritten with healthy bytes.
+    deadline = time.monotonic() + 20
+    healed = False
+    while time.monotonic() < deadline:
+        if not os.path.exists(victim):
+            healed = True
+            break
+        with open(victim, "rb") as f:
+            f.seek(100)
+            if f.read(4) != b"\xde\xad\xbe\xef":
+                healed = True
+                break
+        time.sleep(0.2)
+    assert healed, "rotten replica was never invalidated/re-replicated"
+    assert len(fs.read_all("/scan/v.bin")) == 400_000
+
+
+def test_directory_scanner_detects_vanished_replica(cluster):
+    fs = cluster.get_filesystem()
+    fs.write_all("/scan/d.bin", os.urandom(200_000))
+    time.sleep(0.3)
+    files = [f for f in _replica_files(cluster) if "d.bin" or True]
+    # find a replica of THIS block: newest files
+    newest = max(files, key=os.path.getmtime)
+    os.remove(newest)
+    os.remove(newest + ".meta")
+    deadline = time.monotonic() + 20
+    found = False
+    while time.monotonic() < deadline:
+        # the DN must notice and the NN re-replicate: 3 copies of this
+        # block exist again (possibly including a recreated victim path)
+        if len([f for f in _replica_files(cluster)
+                if os.path.basename(f) == os.path.basename(newest)]) >= 3:
+            found = True
+            break
+        time.sleep(0.3)
+    assert found, "vanished replica was never re-replicated"
+    assert len(fs.read_all("/scan/d.bin")) == 200_000
