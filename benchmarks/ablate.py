@@ -1,0 +1,92 @@
+"""MFU ablations on the real chip: which part of the step underperforms.
+
+  python -m benchmarks.ablate
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(fn, *args, steps=10, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    jnp.sum(jax.tree_util.tree_leaves(out)[0].astype(jnp.float32)).block_until_ready()
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0].astype(jnp.float32)))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    float(jnp.sum(jax.tree_util.tree_leaves(out)[0].astype(jnp.float32)))
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    from hadoop_tpu.models import get_config
+    from hadoop_tpu.models.decoder import ParallelCtx, forward_hidden
+    cfg = get_config("flagship-420m")
+    peak = 197e12
+    B, S, D, F, V = 4, 2048, cfg.d_model, cfg.d_ff, cfg.vocab_size
+    key = jax.random.PRNGKey(0)
+
+    # 1. plain matmul chain at model shapes
+    x = jax.random.normal(key, (B * S, D), jnp.bfloat16)
+    w1 = jax.random.normal(key, (D, F), jnp.bfloat16)
+    w2 = jax.random.normal(key, (F, D), jnp.bfloat16)
+
+    @jax.jit
+    def mm_chain(x):
+        for _ in range(24):
+            x = (x @ w1) @ w2
+        return x
+    dt = timeit(mm_chain, x)
+    fl = 24 * 2 * (B * S) * (D * F + F * D) * 2 / 2  # 2*M*K*N per mm
+    fl = 24 * (2 * B * S * D * F + 2 * B * S * F * D)
+    print(f"matmul chain: {dt*1e3:.1f}ms  {fl/dt/1e12:.1f} TFLOP/s "
+          f"({fl/dt/peak:.0%} of peak)")
+
+    # 2. flash attention fwd at model shapes
+    from hadoop_tpu.ops.flash import flash_attention
+    q = jax.random.normal(key, (B, S, cfg.n_heads, cfg.head_dim),
+                          jnp.bfloat16)
+    kv = jax.random.normal(key, (B, S, cfg.n_kv_heads, cfg.head_dim),
+                           jnp.bfloat16)
+    fa = jax.jit(lambda q, k, v: flash_attention(q, k, v))
+    dt = timeit(fa, q, kv, kv)
+    fl = 2 * 2 * B * cfg.n_heads * S * S * cfg.head_dim / 2  # causal
+    print(f"flash fwd:    {dt*1e3:.1f}ms  {fl/dt/1e12:.1f} TFLOP/s "
+          f"({fl/dt/peak:.0%} of peak)")
+
+    # 3. full model forward (no loss)
+    from hadoop_tpu.models import init_params
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(key, (B, S), 0, V, dtype=jnp.int32)
+    ctx = ParallelCtx()
+    fwd = jax.jit(lambda p, t: forward_hidden(p, t, cfg, ctx))
+    dt = timeit(fwd, params, tokens)
+    n = 350_274_560
+    fl = 2 * n * B * S + 12 * cfg.n_layers * S * D / 2 * B * S
+    fl = B * S * (2 * n + 12 * cfg.n_layers * S * D / 2 / S * S)
+    fl = B * S * (2 * n) + 4 * cfg.n_layers * B * cfg.n_heads * S * S * cfg.head_dim / 2
+    print(f"model fwd:    {dt*1e3:.1f}ms  {fl/dt/1e12:.1f} TFLOP/s "
+          f"({fl/dt/peak:.0%} of peak)")
+
+    # 4. forward + chunked CE loss
+    from hadoop_tpu.parallel.train import _loss_from_h
+    from hadoop_tpu.models.decoder import forward_hidden as fh
+
+    @jax.jit
+    def fwd_loss(p, t, tg):
+        h = fh(p, t, cfg, ctx)
+        return _loss_from_h(p, h, tg, cfg, ctx)
+    targets = jnp.roll(tokens, -1, axis=1)
+    dt2 = timeit(fwd_loss, params, tokens, targets)
+    fl2 = fl + 2 * B * S * D * V
+    print(f"fwd+loss:     {dt2*1e3:.1f}ms  {fl2/dt2/1e12:.1f} TFLOP/s "
+          f"({fl2/dt2/peak:.0%} of peak)  [CE adds {(dt2-dt)*1e3:.1f}ms]")
+
+
+if __name__ == "__main__":
+    main()

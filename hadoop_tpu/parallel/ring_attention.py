@@ -23,21 +23,58 @@ from hadoop_tpu.ops.attention import (_repeat_kv, chunk_attention,
 
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                   axis_name: str, axis_size: int) -> jnp.ndarray:
+                   axis_name: str, axis_size: int,
+                   impl: str = "auto") -> jnp.ndarray:
     """q,k,v: [B, S_local, H(q|kv), D] local shards. Returns [B,S_local,Hq,D].
 
-    Must run inside shard_map with ``axis_name`` bound.
-    """
+    Must run inside shard_map with ``axis_name`` bound. ``impl="auto"``
+    runs each ring step through the fused Pallas partial
+    (ops.flash.flash_attention_partial) on TPU for qualifying shapes:
+    the step-0 diagonal is the CAUSAL partial; later chunks run the
+    non-causal partial and fold in through the merge weight (an
+    invisible chunk's lse is forced to -inf, the merge identity — same
+    compute shape every step, so one compiled kernel serves the whole
+    ring)."""
     b, sl, hq, d = q.shape
-    k = _repeat_kv(k, hq // k.shape[2])
-    v = _repeat_kv(v, hq // v.shape[2])
     scale = 1.0 / (d ** 0.5)
     my = jax.lax.axis_index(axis_name)
-    q_pos = my * sl + jnp.arange(sl)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    from hadoop_tpu.ops import flash
+    use_flash = impl == "flash" or (
+        impl == "auto" and jax.default_backend() not in ("cpu", "gpu")
+        and flash.partial_supported(q.shape, k.shape))
 
     from hadoop_tpu.ops.vma import pvary_to, vma_of
     target = vma_of(q) | vma_of(k) | vma_of(v) | {axis_name}
+
+    if use_flash:
+        # step 0: the causal diagonal, fused
+        out, lse = flash.flash_attention_partial(q, k, v, scale, True)
+        out = pvary_to(out, target)
+        lse = pvary_to(lse, target)
+
+        def step(carry, i):
+            o_acc, l_acc, kc, vc = carry
+            kc = jax.lax.ppermute(kc, axis_name, perm)
+            vc = jax.lax.ppermute(vc, axis_name, perm)
+            src = (my - i) % axis_size
+            o_i, l_i = flash.flash_attention_partial(q, kc, vc, scale,
+                                                     False)
+            # visibility by merge weight: chunks from LATER ranks are
+            # entirely in this rank's future → identity
+            visible = src < my
+            l_i = jnp.where(visible, l_i, -jnp.inf)
+            o_acc, l_acc = merge_attention(o_acc, l_acc, o_i, l_i)
+            return (o_acc, l_acc, kc, vc), None
+
+        (out, _, _, _), _ = jax.lax.scan(
+            step, (out, lse, k, v), jnp.arange(1, axis_size))
+        return out.astype(q.dtype)
+
+    k = _repeat_kv(k, hq // k.shape[2])
+    v = _repeat_kv(v, hq // v.shape[2])
+    q_pos = my * sl + jnp.arange(sl)
     out0 = pvary_to(jnp.zeros((b, sl, hq, d), jnp.float32), target)
     lse0 = pvary_to(jnp.full((b, sl, hq), -jnp.inf, jnp.float32), target)
 
