@@ -1,0 +1,9 @@
+"""Offline Edits Viewer entry point (see hadoop_tpu.cli.oiv.dump_edits;
+ref: tools/offlineEditsViewer/OfflineEditsViewer.java)."""
+
+import sys
+
+from hadoop_tpu.cli.oiv import main_oev
+
+if __name__ == "__main__":
+    sys.exit(main_oev())

@@ -1,0 +1,207 @@
+"""KMS — the key management server + its client-side KeyProvider.
+
+Parity with the reference KMS (ref: hadoop-common-project/hadoop-kms —
+KMS.java's REST resource (/kms/v1/…), KMSClientProvider.java on the
+client side, KMSACLs.java for per-op ACLs): a small REST face over any
+``KeyProvider`` (the FileKeyProvider by default), with per-operation
+user ACLs, serving key metadata and the EDEK generate/decrypt pair that
+encryption-at-rest clients use; ``KMSKeyProvider`` makes a remote KMS
+look like a local provider behind the same seam.
+
+Endpoints (the reference's shapes, JSON):
+  GET    /kms/v1/keys/names                  list keys
+  POST   /kms/v1/keys                        {name, length} create
+  GET    /kms/v1/key/<name>/_currentversion
+  GET    /kms/v1/key/<name>/_eek?eek_op=generate
+  POST   /kms/v1/keyversion/<ver>/_eek?eek_op=decrypt   {iv, material,name}
+  DELETE /kms/v1/key/<name>
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+from typing import Dict, List, Optional
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.crypto.keys import (EncryptedKeyVersion, FileKeyProvider,
+                                    KeyProvider, KeyVersion)
+from hadoop_tpu.http.server import HttpServer
+from hadoop_tpu.service import AbstractService
+
+log = logging.getLogger(__name__)
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+class KMSACLs:
+    """Per-operation user allowlists (ref: KMSACLs.java; keys like
+    ``kms.acl.CREATE = alice,bob`` — '*' or unset = everyone)."""
+
+    OPS = ("CREATE", "DELETE", "ROLLOVER", "GET", "GET_KEYS",
+           "GENERATE_EEK", "DECRYPT_EEK")
+
+    def __init__(self, conf: Configuration):
+        self._acl: Dict[str, Optional[set]] = {}
+        for op in self.OPS:
+            spec = conf.get(f"kms.acl.{op}", "*").strip()
+            self._acl[op] = None if spec == "*" else {
+                u.strip() for u in spec.split(",") if u.strip()}
+
+    def check(self, op: str, user: str) -> None:
+        allowed = self._acl.get(op)
+        if allowed is not None and user not in allowed:
+            raise PermissionError(f"user {user!r} lacks KMS ACL {op}")
+
+
+class KMSServer(AbstractService):
+    def __init__(self, conf: Configuration,
+                 provider: Optional[KeyProvider] = None):
+        super().__init__("KMSServer")
+        self._provider_in = provider
+        self.http: Optional[HttpServer] = None
+
+    def service_init(self, conf: Configuration) -> None:
+        self.provider = self._provider_in or FileKeyProvider(
+            conf.get("kms.key.provider.path", "/tmp/htpu-kms/keys.json"))
+        self.acls = KMSACLs(conf)
+        self.http = HttpServer(
+            conf, ("127.0.0.1", conf.get_int("kms.http.port", 0)),
+            daemon_name="kms")
+        self.http.add_handler("/kms/v1/", self._route)
+
+    def service_start(self) -> None:
+        self.http.start()
+        log.info("KMS on :%d", self.http.port)
+
+    def service_stop(self) -> None:
+        if self.http:
+            self.http.stop()
+
+    @property
+    def port(self) -> int:
+        return self.http.port
+
+    # -------------------------------------------------------------- routes
+
+    def _route(self, query: Dict, body: bytes):
+        path = query["__path__"][len("/kms/v1/"):].strip("/")
+        method = query.get("__method__", "GET")
+        user = query.get("user.name", "anonymous")
+        parts = path.split("/")
+        payload = json.loads(body.decode()) if body else {}
+
+        if parts[0] == "keys" and len(parts) == 2 and parts[1] == "names":
+            self.acls.check("GET_KEYS", user)
+            return 200, self.provider.get_keys()
+        if parts[0] == "keys" and method == "POST":
+            self.acls.check("CREATE", user)
+            kv = self.provider.create_key(payload["name"],
+                                          payload.get("length", 128))
+            return 201, self._kv_json(kv)
+        if parts[0] == "key" and len(parts) >= 2:
+            name = parts[1]
+            if method == "DELETE":
+                self.acls.check("DELETE", user)
+                self.provider.delete_key(name)
+                return 200, {"deleted": name}
+            if len(parts) == 3 and parts[2] == "_currentversion":
+                self.acls.check("GET", user)
+                return 200, self._kv_json(self.provider.get_current_key(name))
+            if len(parts) == 3 and parts[2] == "_eek":
+                if query.get("eek_op") == "generate":
+                    self.acls.check("GENERATE_EEK", user)
+                    ekv = self.provider.generate_encrypted_key(name)
+                    return 200, {
+                        "versionName": ekv.key_version,
+                        "iv": _b64(ekv.iv),
+                        "encryptedKeyVersion": {
+                            "material": _b64(ekv.edek)},
+                        "name": ekv.key_name,
+                    }
+            if len(parts) == 3 and parts[2] == "_roll" and method == "POST":
+                self.acls.check("ROLLOVER", user)
+                return 200, self._kv_json(self.provider.roll_key(name))
+        if parts[0] == "keyversion" and len(parts) == 3 and \
+                parts[2] == "_eek" and query.get("eek_op") == "decrypt":
+            self.acls.check("DECRYPT_EEK", user)
+            ekv = EncryptedKeyVersion(
+                payload["name"], parts[1], _unb64(payload["iv"]),
+                _unb64(payload["material"]))
+            material = self.provider.decrypt_encrypted_key(ekv)
+            return 200, {"material": _b64(material)}
+        raise FileNotFoundError(path)
+
+    @staticmethod
+    def _kv_json(kv: KeyVersion) -> Dict:
+        return {"name": kv.name, "versionName": kv.version,
+                "material": _b64(kv.material)}
+
+
+class KMSKeyProvider(KeyProvider):
+    """Client provider speaking to a KMSServer (ref:
+    KMSClientProvider.java). Plugs into the same KeyProvider seam the
+    crypto streams use."""
+
+    def __init__(self, addr: str, user: str = "client"):
+        import urllib.request
+        self._base = f"http://{addr}/kms/v1"
+        self._user = user
+        self._rq = urllib.request
+
+    def _call(self, method: str, path: str, body: Optional[Dict] = None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = self._rq.Request(
+            f"{self._base}/{path}"
+            f"{'&' if '?' in path else '?'}user.name={self._user}",
+            data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with self._rq.urlopen(req) as resp:
+                return json.loads(resp.read().decode())
+        except Exception as e:
+            import urllib.error
+            if isinstance(e, urllib.error.HTTPError):
+                detail = e.read().decode(errors="replace")
+                if e.code == 500 and "PermissionError" in detail:
+                    raise PermissionError(detail) from e
+                raise IOError(f"KMS {e.code}: {detail}") from e
+            raise
+
+    def create_key(self, name: str, bits: int = 128) -> KeyVersion:
+        d = self._call("POST", "keys", {"name": name, "length": bits})
+        return KeyVersion(d["name"], d["versionName"], _unb64(d["material"]))
+
+    def get_current_key(self, name: str) -> KeyVersion:
+        d = self._call("GET", f"key/{name}/_currentversion")
+        return KeyVersion(d["name"], d["versionName"], _unb64(d["material"]))
+
+    def roll_key(self, name: str) -> KeyVersion:
+        d = self._call("POST", f"key/{name}/_roll", {})
+        return KeyVersion(d["name"], d["versionName"], _unb64(d["material"]))
+
+    def get_keys(self) -> List[str]:
+        return self._call("GET", "keys/names")
+
+    def delete_key(self, name: str) -> None:
+        self._call("DELETE", f"key/{name}")
+
+    def generate_encrypted_key(self, name: str) -> EncryptedKeyVersion:
+        d = self._call("GET", f"key/{name}/_eek?eek_op=generate")
+        return EncryptedKeyVersion(
+            d["name"], d["versionName"], _unb64(d["iv"]),
+            _unb64(d["encryptedKeyVersion"]["material"]))
+
+    def decrypt_encrypted_key(self, ekv: EncryptedKeyVersion) -> bytes:
+        d = self._call(
+            "POST", f"keyversion/{ekv.key_version}/_eek?eek_op=decrypt",
+            {"name": ekv.key_name, "iv": _b64(ekv.iv),
+             "material": _b64(ekv.edek)})
+        return _unb64(d["material"])

@@ -1,0 +1,211 @@
+"""HAR-style archives: pack a tree into index + part files.
+
+Parity with the reference archives tool (ref: hadoop-tools/
+hadoop-archives/.../HadoopArchives.java + the HarFileSystem in
+hadoop-common fs/HarFileSystem.java): many small files collapse into one
+``_index`` (JSON: path → part/offset/length) plus concatenated ``part-*``
+data files, relieving NameNode inode pressure; ``HarFileSystem`` serves
+the archived namespace read-only through the ordinary FileSystem SPI
+(open/list/status), resolving byte ranges out of the parts.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.dfs.protocol.records import FileStatus
+from hadoop_tpu.fs import FileSystem
+from hadoop_tpu.fs.filesystem import Path
+
+INDEX_NAME = "_index"
+PART_SIZE = 512 * 1024 * 1024
+
+
+def create_archive(fs: FileSystem, src_dir: str, archive_dir: str) -> Dict:
+    """Pack src_dir into archive_dir (…/<name>.har by convention).
+    Returns the index. Ref: HadoopArchives.archive (the MR-parallel copy
+    phase collapses to a streaming client copy here — parts are written
+    sequentially either way)."""
+    index: Dict[str, Dict] = {}
+    part_no = 0
+    part_stream = None
+    part_written = 0
+    fs.mkdirs(archive_dir)
+
+    def open_part():
+        nonlocal part_stream, part_no, part_written
+        part_stream = fs.create(f"{archive_dir}/part-{part_no}",
+                                overwrite=True)
+        part_written = 0
+
+    open_part()
+    root = src_dir.rstrip("/") or "/"
+
+    def walk(path: str) -> None:
+        nonlocal part_no, part_written, part_stream
+        st = fs.get_file_status(path)
+        rel = path[len(root):].lstrip("/") if path != root else ""
+        key = "/" + rel if rel else "/"
+        if st.is_dir:
+            children = sorted(s.path for s in fs.list_status(path))
+            index[key] = {"dir": True,
+                          "children": [c.rsplit("/", 1)[-1]
+                                       for c in children]}
+            for child in children:
+                walk(child)
+            return
+        if part_written >= PART_SIZE:
+            part_stream.close()
+            part_no += 1
+            open_part()
+        src = fs.open(path)
+        length = 0
+        try:
+            while True:
+                chunk = src.read(4 * 1024 * 1024)
+                if not chunk:
+                    break
+                part_stream.write(chunk)
+                length += len(chunk)
+        finally:
+            src.close()
+        index[key] = {"dir": False, "part": f"part-{part_no}",
+                      "off": part_written, "len": length}
+        part_written += length
+
+    walk(root)
+    part_stream.close()
+    fs.write_all(f"{archive_dir}/{INDEX_NAME}",
+                 json.dumps(index).encode())
+    return index
+
+
+class HarFileSystem(FileSystem):
+    """Read-only view over an archive. Ref: fs/HarFileSystem.java —
+    open() resolves to a (part, offset, length) range read."""
+
+    def __init__(self, underlying: FileSystem, archive_dir: str):
+        self.fs = underlying
+        self.dir = archive_dir.rstrip("/")
+        self.index: Dict[str, Dict] = json.loads(
+            underlying.read_all(f"{self.dir}/{INDEX_NAME}").decode())
+
+    # --------------------------------------------------------------- reads
+
+    def _entry(self, path: str) -> Dict:
+        key = "/" + path.strip("/") if path.strip("/") else "/"
+        entry = self.index.get(key)
+        if entry is None:
+            raise FileNotFoundError(f"{path} not in archive {self.dir}")
+        return entry
+
+    def get_file_status(self, path: str) -> FileStatus:
+        e = self._entry(path)
+        return FileStatus(path, is_dir=e["dir"],
+                          length=0 if e["dir"] else e["len"])
+
+    def exists(self, path: str) -> bool:
+        try:
+            self._entry(path)
+            return True
+        except FileNotFoundError:
+            return False
+
+    def list_status(self, path: str) -> List[FileStatus]:
+        e = self._entry(path)
+        if not e["dir"]:
+            return [self.get_file_status(path)]
+        base = "/" + path.strip("/") if path.strip("/") else ""
+        return [self.get_file_status(f"{base}/{name}")
+                for name in e["children"]]
+
+    def open(self, path: str):
+        e = self._entry(path)
+        if e["dir"]:
+            raise IsADirectoryError(path)
+        return _HarRangeStream(self.fs, f"{self.dir}/{e['part']}",
+                               e["off"], e["len"])
+
+    def read_all(self, path: str) -> bytes:
+        with self.open(path) as s:
+            return s.read()
+
+    # ------------------------------------------------- writes: read-only
+
+    def create(self, path, overwrite=False, replication=None, **kw):
+        raise PermissionError("har archives are immutable")
+
+    def mkdirs(self, path):
+        raise PermissionError("har archives are immutable")
+
+    def delete(self, path, recursive=False):
+        raise PermissionError("har archives are immutable")
+
+    def rename(self, src, dst):
+        raise PermissionError("har archives are immutable")
+
+    def close(self) -> None:
+        pass
+
+
+class _HarRangeStream:
+    """Seekable read view of one [off, off+len) range of a part file."""
+
+    def __init__(self, fs: FileSystem, part_path: str, off: int,
+                 length: int):
+        self._stream = fs.open(part_path)
+        self._base = off
+        self._len = length
+        self._pos = 0
+        self._stream.seek(off)
+
+    def read(self, n: int = -1) -> bytes:
+        remaining = self._len - self._pos
+        if remaining <= 0:
+            return b""
+        take = remaining if n is None or n < 0 else min(n, remaining)
+        data = self._stream.read(take)
+        self._pos += len(data)
+        return data
+
+    def seek(self, pos: int) -> None:
+        self._pos = min(max(pos, 0), self._len)
+        self._stream.seek(self._base + self._pos)
+
+    def tell(self) -> int:
+        return self._pos
+
+    def close(self) -> None:
+        self._stream.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(prog="archive")
+    ap.add_argument("src")
+    ap.add_argument("dst", help="archive directory (e.g. /out/foo.har)")
+    ap.add_argument("--fs", required=True, help="filesystem URI")
+    args = ap.parse_args(argv)
+    fs = FileSystem.get(args.fs, Configuration())
+    try:
+        index = create_archive(fs, Path(args.src).path, Path(args.dst).path)
+        files = sum(1 for e in index.values() if not e["dir"])
+        print(json.dumps({"archived_files": files,
+                          "entries": len(index)}))
+    finally:
+        fs.close()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -103,6 +103,7 @@ class RMApp:
         self.current_attempt = attempt
         self.rm.attempts[attempt.attempt_id] = attempt
         self.rm.state_store.store_attempt(self.app_id, self.attempt_no)
+        self.rm.timeline.app_attempt(str(self.app_id), attempt.attempt_id)
         attempt.start()
 
     def recover_attempt(self, attempt_no: int) -> "RMAppAttempt":
@@ -140,6 +141,8 @@ class RMApp:
             self.rm.release_attempt(att)
         self.rm.state_store.store_app_done(self.app_id, state,
                                            self.diagnostics)
+        self.rm.timeline.app_finished(str(self.app_id), state,
+                                      self.diagnostics)
 
     def report(self) -> ApplicationReport:
         return ApplicationReport(
@@ -439,6 +442,13 @@ class ResourceManager(AbstractService):
         self.state_dir = state_dir or conf.get(
             "yarn.resourcemanager.store.dir", "/tmp/htpu-rm-state")
         self.state_store = FileRMStateStore(self.state_dir)
+        # App lifecycle → timeline store (ref: SystemMetricsPublisher;
+        # serving side: yarn/timeline.py ApplicationHistoryServer)
+        from hadoop_tpu.yarn.timeline import (TimelinePublisher,
+                                              TimelineStore)
+        self.timeline = TimelinePublisher(TimelineStore(
+            conf.get("yarn.timeline-service.store-dir",
+                     os.path.join(self.state_dir, "timeline"))))
         self.rpc: Optional[Server] = None
         self._stop_event = threading.Event()
         self._nm_client = Client(conf)
@@ -602,6 +612,8 @@ class ResourceManager(AbstractService):
         if store:
             self.state_store.store_app(ctx, user)
         self._m_submitted.incr()
+        self.timeline.app_submitted(str(ctx.app_id), ctx.name, user,
+                                    ctx.queue)
         app.sm.handle("submit")
         return {"ok": True}
 

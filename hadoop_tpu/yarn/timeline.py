@@ -1,0 +1,140 @@
+"""Timeline / application-history service.
+
+Parity with the reference's app-history tier (ref:
+hadoop-yarn-server-applicationhistoryservice — the v1 history store the
+RM publishes app lifecycle into, with ApplicationHistoryServer's REST
+face /ws/v1/applicationhistory; ATSv2's entity model collapses to the
+same app/attempt entities at this scope): the RM writes one JSON event
+per app transition into an append-only store, and the history server
+serves finished (and live) apps REST-side so the cluster's job past
+survives RM restarts and app completion.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.http.server import HttpServer
+from hadoop_tpu.service import AbstractService
+
+log = logging.getLogger(__name__)
+
+
+class TimelineStore:
+    """Append-only entity/event store on local disk (ref:
+    applicationhistoryservice's FileSystemApplicationHistoryStore — one
+    writer, many readers; events keyed by entity id)."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self._path = os.path.join(directory, "timeline.jsonl")
+        self._lock = threading.Lock()
+
+    def put_event(self, entity_type: str, entity_id: str, event: str,
+                  **info) -> None:
+        rec = {"type": entity_type, "id": entity_id, "event": event,
+               "ts": time.time(), "info": info}
+        with self._lock:
+            with open(self._path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+
+    def events(self, entity_type: Optional[str] = None,
+               entity_id: Optional[str] = None) -> List[Dict]:
+        out: List[Dict] = []
+        if not os.path.exists(self._path):
+            return out
+        with open(self._path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail
+                if entity_type and rec.get("type") != entity_type:
+                    continue
+                if entity_id and rec.get("id") != entity_id:
+                    continue
+                out.append(rec)
+        return out
+
+    def entities(self, entity_type: str) -> Dict[str, Dict]:
+        """Fold events into per-entity summaries (latest info wins)."""
+        ents: Dict[str, Dict] = {}
+        for rec in self.events(entity_type):
+            e = ents.setdefault(rec["id"], {"id": rec["id"], "events": []})
+            e["events"].append(rec["event"])
+            e.update({k: v for k, v in rec["info"].items()
+                      if v is not None})
+        return ents
+
+
+class TimelinePublisher:
+    """RM-side publisher (ref: SystemMetricsPublisher — the RM component
+    that forwards app/attempt transitions into the timeline)."""
+
+    def __init__(self, store: TimelineStore):
+        self.store = store
+
+    def app_submitted(self, app_id: str, name: str, user: str,
+                      queue: str) -> None:
+        self.store.put_event("YARN_APPLICATION", app_id, "SUBMITTED",
+                             name=name, user=user, queue=queue)
+
+    def app_attempt(self, app_id: str, attempt_id: str) -> None:
+        self.store.put_event("YARN_APPLICATION", app_id, "ATTEMPT",
+                             attempt=attempt_id)
+
+    def app_finished(self, app_id: str, state: str, diagnostics: str
+                     ) -> None:
+        self.store.put_event("YARN_APPLICATION", app_id, "FINISHED",
+                             state=state, diagnostics=diagnostics[:500])
+
+
+class ApplicationHistoryServer(AbstractService):
+    """REST over the store (ref: ApplicationHistoryServer + its
+    WebServices — /ws/v1/applicationhistory/apps[/{appid}])."""
+
+    def __init__(self, conf: Configuration, store_dir: str):
+        super().__init__("ApplicationHistoryServer")
+        self.store = TimelineStore(store_dir)
+        self.http: Optional[HttpServer] = None
+
+    def service_init(self, conf: Configuration) -> None:
+        self.http = HttpServer(
+            conf, ("127.0.0.1",
+                   conf.get_int("yarn.timeline-service.webapp.port", 0)),
+            daemon_name="ahs")
+        self.http.add_handler("/ws/v1/applicationhistory/apps", self._apps)
+
+    def service_start(self) -> None:
+        self.http.start()
+        log.info("ApplicationHistoryServer on :%d", self.http.port)
+
+    def service_stop(self) -> None:
+        if self.http:
+            self.http.stop()
+
+    @property
+    def port(self) -> int:
+        return self.http.port
+
+    def _apps(self, query: Dict, body: bytes):
+        path = query["__path__"]
+        tail = path[len("/ws/v1/applicationhistory/apps"):].strip("/")
+        ents = self.store.entities("YARN_APPLICATION")
+        if not tail:
+            return 200, {"apps": {"app": sorted(
+                ents.values(), key=lambda e: e["id"])}}
+        app = ents.get(tail)
+        if app is None:
+            raise FileNotFoundError(tail)
+        return 200, {"app": app}

@@ -1,0 +1,162 @@
+"""SLS simulator, HAR archives, offline image/edits viewers.
+Ref: hadoop-sls/SLSRunner.java:105, hadoop-archives + fs/HarFileSystem.java,
+tools/offlineImageViewer + offlineEditsViewer."""
+
+import io
+import json
+import os
+
+import pytest
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.testing.minicluster import MiniDFSCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MiniDFSCluster(num_datanodes=2) as c:
+        yield c
+
+
+@pytest.fixture(scope="module")
+def fs(cluster):
+    return cluster.get_filesystem()
+
+
+# ------------------------------------------------------------------- SLS
+
+
+def test_sls_runs_all_schedulers():
+    from hadoop_tpu.tools.sls import run
+    for kind in ("fifo", "capacity", "fair"):
+        r = run(num_nodes=20, num_apps=5, containers_per_app=10,
+                scheduler=kind, ticks=500)
+        assert r["scheduler"] == kind
+        assert r["containers_allocated"] == 50
+        assert r["unfinished_apps"] == 0
+        assert r["decisions_per_sec"] > 0
+
+
+def test_sls_capacity_queues_respected():
+    from hadoop_tpu.tools.sls import run
+    conf = Configuration(load_defaults=False)
+    conf.set("yarn.scheduler.capacity.root.queues", "a,b")
+    conf.set("yarn.scheduler.capacity.root.a.capacity", "50")
+    conf.set("yarn.scheduler.capacity.root.b.capacity", "50")
+    conf.set("sls.queues", "a,b")
+    r = run(num_nodes=10, num_apps=4, containers_per_app=5,
+            scheduler="capacity", ticks=300, conf=conf)
+    assert r["unfinished_apps"] == 0
+
+
+# -------------------------------------------------------------- archives
+
+
+def test_har_roundtrip(fs):
+    from hadoop_tpu.tools.archive import HarFileSystem, create_archive
+    payload = {}
+    fs.mkdirs("/ar/in/sub")
+    for name, size in (("/ar/in/a.bin", 50_000),
+                       ("/ar/in/sub/b.bin", 120_000),
+                       ("/ar/in/sub/c.bin", 7)):
+        data = os.urandom(size)
+        fs.write_all(name, data)
+        payload[name] = data
+
+    index = create_archive(fs, "/ar/in", "/ar/out.har")
+    assert index["/"]["dir"] and "/sub/b.bin" in index
+
+    har = HarFileSystem(fs, "/ar/out.har")
+    # status + listing
+    st = har.get_file_status("/sub/b.bin")
+    assert st.length == 120_000 and not st.is_dir
+    names = sorted(s.path for s in har.list_status("/sub"))
+    assert names == ["/sub/b.bin", "/sub/c.bin"]
+    # contents round-trip
+    for name, data in payload.items():
+        rel = name[len("/ar/in"):]
+        assert har.read_all(rel) == data
+    # ranged reads via seek
+    with har.open("/sub/b.bin") as s:
+        s.seek(100_000)
+        assert s.read() == payload["/ar/in/sub/b.bin"][100_000:]
+    # immutability
+    with pytest.raises(PermissionError):
+        har.create("/new")
+    with pytest.raises(FileNotFoundError):
+        har.read_all("/nope")
+
+
+# --------------------------------------------------------------- oiv/oev
+
+
+def test_oiv_and_oev_dump(tmp_path):
+    from hadoop_tpu.cli.oiv import dump_edits, dump_image
+    from hadoop_tpu.dfs.namenode.fsnamesystem import FSNamesystem
+    conf = Configuration(load_defaults=False)
+    name_dir = str(tmp_path / "name")
+    fsn = FSNamesystem(conf, name_dir)
+    fsn.load_from_disk()
+    fsn.bm.safemode.leave(force=True)
+    fsn.mkdirs("/a")
+    fsn.mkdirs("/a/b")
+    st = fsn.create("/a/f.txt", "client-1", 1, None, False)
+    fsn.save_namespace()
+    fsn.mkdirs("/after-image")  # lives only in edits
+    fsn.close()
+
+    out = io.StringIO()
+    n = dump_image(name_dir, out=out)
+    lines = [json.loads(l) for l in out.getvalue().splitlines()]
+    paths = {l.get("path") for l in lines if "path" in l}
+    assert {"/", "/a", "/a/b", "/a/f.txt"} <= paths
+    types = {l["path"]: l["type"] for l in lines if "path" in l}
+    assert types["/a/f.txt"] == "FILE" and types["/a"] == "DIRECTORY"
+    assert n >= 4
+
+    out = io.StringIO()
+    n = dump_edits(name_dir, out=out)
+    ops = [json.loads(l) for l in out.getvalue().splitlines()]
+    assert n == len(ops) > 0
+    assert any(o["op"] == "mkdir" and o["p"] == "/after-image"
+               for o in ops)
+
+
+# ---------------------------------------------------- timeline / history
+
+
+def test_timeline_records_app_lifecycle(tmp_path):
+    import json as _json
+    import urllib.request
+
+    from hadoop_tpu.examples.wordcount import make_job
+    from hadoop_tpu.testing.minicluster import MiniMRYarnCluster
+    from hadoop_tpu.yarn.timeline import ApplicationHistoryServer
+    with MiniMRYarnCluster(num_nodes=2,
+                           base_dir=str(tmp_path / "c")) as cluster:
+        fs2 = cluster.get_filesystem()
+        fs2.mkdirs("/tl-in")
+        fs2.write_all("/tl-in/x.txt", b"a b a\n")
+        job = make_job(cluster.rm_addr, cluster.default_fs, "/tl-in",
+                       "/tl-out")
+        assert job.wait_for_completion()
+
+        store_dir = cluster.yarn.rm.timeline.store.dir
+        conf = Configuration(load_defaults=False)
+        ahs = ApplicationHistoryServer(conf, store_dir)
+        ahs.init(conf)
+        ahs.start()
+        try:
+            base = (f"http://127.0.0.1:{ahs.port}"
+                    "/ws/v1/applicationhistory/apps")
+            apps = _json.loads(urllib.request.urlopen(base).read())
+            entries = apps["apps"]["app"]
+            assert entries, "no apps in timeline"
+            app = entries[0]
+            assert {"SUBMITTED", "ATTEMPT", "FINISHED"} <= set(app["events"])
+            assert app["state"] == "FINISHED"
+            one = _json.loads(urllib.request.urlopen(
+                f"{base}/{app['id']}").read())
+            assert one["app"]["queue"] == "default"
+        finally:
+            ahs.stop()
