@@ -119,6 +119,7 @@ class HttpServer:
         query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
         query["__path__"] = path
         query["__method__"] = req.command
+        query["__cookie__"] = req.headers.get("Cookie", "")
         handler = None
         best = -1
         for prefix, fn in self._handlers.items():
@@ -132,7 +133,13 @@ class HttpServer:
             req.send_header("Content-Length", "0")
             req.end_headers()
             return
-        status, payload = handler(query, body)
+        out = handler(query, body)
+        # handlers return (status, payload) or (status, payload, headers)
+        if len(out) == 3:
+            status, payload, extra_headers = out
+        else:
+            status, payload = out
+            extra_headers = {}
         if isinstance(payload, (dict, list)):
             payload = json.dumps(payload, default=str).encode()
             ctype = "application/json"
@@ -144,6 +151,8 @@ class HttpServer:
         req.send_response(status)
         req.send_header("Content-Type", ctype)
         req.send_header("Content-Length", str(len(payload)))
+        for name, value in extra_headers.items():
+            req.send_header(name, value)
         req.end_headers()
         req.wfile.write(payload)
 

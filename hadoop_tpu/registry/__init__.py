@@ -1,0 +1,6 @@
+"""Service registry (ref: hadoop-common-project/hadoop-registry)."""
+
+from hadoop_tpu.registry.registry import (RegistryClient, RegistryServer,
+                                          ServiceRecord)
+
+__all__ = ["RegistryClient", "RegistryServer", "ServiceRecord"]

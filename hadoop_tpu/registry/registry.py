@@ -1,0 +1,197 @@
+"""Service registry — named service records with liveness TTLs.
+
+Parity with the reference registry (ref: hadoop-common-project/
+hadoop-registry — RegistryOperations over a ZooKeeper tree of
+ServiceRecords with ephemeral liveness; the DNS frontend is out of
+scope): services register a record (endpoints + attributes) under a
+slash path; EPHEMERAL records disappear when their owner stops
+renewing (the ZK-ephemeral analog on a lease, consistent with how this
+framework replaced ZK everywhere else — cf. the QJM lease elector).
+Served over the framework RPC plane.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.ipc import Client, Server, get_proxy
+from hadoop_tpu.service import AbstractService
+
+log = logging.getLogger(__name__)
+
+
+class ServiceRecord:
+    """Ref: registry/client/types/ServiceRecord.java."""
+
+    def __init__(self, path: str, endpoints: Dict[str, str],
+                 attributes: Optional[Dict[str, str]] = None,
+                 ephemeral: bool = True):
+        self.path = path
+        self.endpoints = endpoints
+        self.attributes = attributes or {}
+        self.ephemeral = ephemeral
+
+    def to_wire(self) -> Dict:
+        return {"p": self.path, "e": self.endpoints,
+                "a": self.attributes, "eph": self.ephemeral}
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "ServiceRecord":
+        return cls(d["p"], d["e"], d.get("a", {}), d.get("eph", True))
+
+
+class _Entry:
+    __slots__ = ("record", "deadline")
+
+    def __init__(self, record: ServiceRecord, deadline: float):
+        self.record = record
+        self.deadline = deadline
+
+
+class RegistryProtocol:
+    def __init__(self, server: "RegistryServer"):
+        self.srv = server
+
+    def register(self, record_wire: Dict, ttl_s: float) -> bool:
+        return self.srv.put(ServiceRecord.from_wire(record_wire), ttl_s)
+
+    def renew(self, path: str, ttl_s: float) -> bool:
+        return self.srv.renew(path, ttl_s)
+
+    def unregister(self, path: str) -> bool:
+        return self.srv.remove(path)
+
+    def resolve(self, path: str) -> Optional[Dict]:
+        rec = self.srv.get(path)
+        return rec.to_wire() if rec else None
+
+    def list(self, prefix: str) -> List[Dict]:
+        return [r.to_wire() for r in self.srv.list(prefix)]
+
+
+class RegistryServer(AbstractService):
+    def __init__(self, conf: Configuration):
+        super().__init__("RegistryServer")
+        self._entries: Dict[str, _Entry] = {}
+        self._lock = threading.Lock()
+        self.rpc: Optional[Server] = None
+        self._stop = threading.Event()
+
+    def service_init(self, conf: Configuration) -> None:
+        self.rpc = Server(conf, bind=("127.0.0.1", conf.get_int(
+            "registry.port", 0)), num_handlers=2, name="registry")
+        self.rpc.register_protocol("RegistryProtocol",
+                                   RegistryProtocol(self))
+        self._sweep_interval = conf.get_time_seconds(
+            "registry.sweep.interval", 1.0)
+
+    def service_start(self) -> None:
+        self.rpc.start()
+        from hadoop_tpu.util.misc import Daemon
+        Daemon(self._sweep_loop, "registry-sweeper").start()
+        log.info("RegistryServer on :%d", self.rpc.port)
+
+    def service_stop(self) -> None:
+        self._stop.set()
+        if self.rpc:
+            self.rpc.stop()
+
+    @property
+    def port(self) -> int:
+        return self.rpc.port
+
+    # ------------------------------------------------------------ storage
+
+    def put(self, record: ServiceRecord, ttl_s: float) -> bool:
+        deadline = time.monotonic() + ttl_s if record.ephemeral \
+            else float("inf")
+        with self._lock:
+            self._entries[record.path] = _Entry(record, deadline)
+        return True
+
+    def renew(self, path: str, ttl_s: float) -> bool:
+        with self._lock:
+            e = self._entries.get(path)
+            if e is None:
+                return False
+            e.deadline = time.monotonic() + ttl_s
+            return True
+
+    def remove(self, path: str) -> bool:
+        with self._lock:
+            return self._entries.pop(path, None) is not None
+
+    def get(self, path: str) -> Optional[ServiceRecord]:
+        with self._lock:
+            e = self._entries.get(path)
+            return e.record if e else None
+
+    def list(self, prefix: str) -> List[ServiceRecord]:
+        prefix = prefix.rstrip("/")
+        with self._lock:
+            return [e.record for p, e in sorted(self._entries.items())
+                    if p == prefix or p.startswith(prefix + "/")]
+
+    def _sweep_loop(self) -> None:
+        while not self._stop.wait(self._sweep_interval):
+            now = time.monotonic()
+            with self._lock:
+                dead = [p for p, e in self._entries.items()
+                        if e.deadline < now]
+                for p in dead:
+                    del self._entries[p]
+            for p in dead:
+                log.info("registry record %s expired", p)
+
+
+class RegistryClient:
+    """Ref: registry/client/impl — register + background renewal."""
+
+    def __init__(self, addr, conf: Optional[Configuration] = None):
+        self.conf = conf or Configuration()
+        self._client = Client(self.conf)
+        self._proxy = get_proxy("RegistryProtocol", addr,
+                                client=self._client)
+        self._renewals: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, record: ServiceRecord, ttl_s: float = 10.0,
+                 auto_renew: bool = True) -> None:
+        self._proxy.register(record.to_wire(), ttl_s)
+        if auto_renew and record.ephemeral:
+            self._renewals[record.path] = ttl_s
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._renew_loop, daemon=True,
+                    name="registry-renewer")
+                self._thread.start()
+
+    def unregister(self, path: str) -> None:
+        self._renewals.pop(path, None)
+        self._proxy.unregister(path)
+
+    def resolve(self, path: str) -> Optional[ServiceRecord]:
+        d = self._proxy.resolve(path)
+        return ServiceRecord.from_wire(d) if d else None
+
+    def list(self, prefix: str) -> List[ServiceRecord]:
+        return [ServiceRecord.from_wire(d)
+                for d in self._proxy.list(prefix)]
+
+    def close(self) -> None:
+        self._stop.set()
+        self._client.stop()
+
+    def _renew_loop(self) -> None:
+        while not self._stop.wait(min(
+                [t / 3 for t in self._renewals.values()] or [1.0])):
+            for path, ttl in list(self._renewals.items()):
+                try:
+                    self._proxy.renew(path, ttl)
+                except Exception as e:  # noqa: BLE001
+                    log.debug("registry renewal of %s failed: %s", path, e)

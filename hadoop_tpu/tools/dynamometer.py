@@ -1,0 +1,107 @@
+"""Dynamometer — NameNode performance under replayed audit workloads.
+
+Parity with the reference tool (ref: hadoop-tools/hadoop-dynamometer —
+its workload half replays production NN AUDIT LOGS against a real
+NameNode (AuditReplayMapper.java) and reports per-op throughput; the
+infra half that simulates a DN fleet maps to the in-process minicluster
+here): parse the framework's own audit trail
+(hadoop_tpu.audit lines: allowed/ugi/ip/cmd/src/dst) and re-issue the
+namespace ops against a live NameNode through a real client, reporting
+achieved ops/sec per command.
+
+  python -m hadoop_tpu.tools.dynamometer --fs htpu://... audit.log
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, Iterable, List, Optional
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.fs import FileSystem
+
+
+def parse_audit_line(line: str) -> Optional[Dict[str, str]]:
+    """One ``k=v\\t…`` audit line → dict (None for non-audit lines)."""
+    fields = {}
+    for part in line.strip().split("\t"):
+        k, sep, v = part.partition("=")
+        if not sep:
+            return None
+        fields[k] = v
+    return fields if "cmd" in fields and "src" in fields else None
+
+
+def replay(fs: FileSystem, lines: Iterable[str],
+           remap_root: str = "/dyn") -> Dict:
+    """Re-issue audited ops (paths re-rooted under ``remap_root`` so the
+    replay can't disturb live data — the reference remaps the same way
+    via auditreplay.command-parser). Returns per-op counts + ops/sec."""
+    counts: Dict[str, int] = {}
+    errors = 0
+    t0 = time.perf_counter()
+    total = 0
+    for line in lines:
+        ev = parse_audit_line(line)
+        if ev is None:
+            continue
+        cmd = ev["cmd"]
+        src = remap_root + ev["src"]
+        try:
+            if cmd == "mkdirs":
+                fs.mkdirs(src)
+            elif cmd == "create":
+                parent = src.rsplit("/", 1)[0]
+                if parent:
+                    fs.mkdirs(parent)
+                fs.write_all(src, b"")
+            elif cmd == "open":
+                if fs.exists(src):
+                    fs.read_all(src)
+            elif cmd == "listStatus":
+                if fs.exists(src):
+                    fs.list_status(src)
+            elif cmd == "rename":
+                dst = remap_root + ev.get("dst", "null")
+                if fs.exists(src):
+                    fs.rename(src, dst)
+            elif cmd == "delete":
+                fs.delete(src, recursive=True)
+            else:
+                continue
+        except (IOError, OSError):
+            errors += 1
+            continue
+        counts[cmd] = counts.get(cmd, 0) + 1
+        total += 1
+    dt = time.perf_counter() - t0
+    return {
+        "ops": total,
+        "errors": errors,
+        "per_op": counts,
+        "wall_seconds": round(dt, 3),
+        "ops_per_sec": round(total / dt, 1) if dt else 0.0,
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(prog="dynamometer")
+    ap.add_argument("audit_log")
+    ap.add_argument("--fs", required=True)
+    ap.add_argument("--remap-root", default="/dyn")
+    args = ap.parse_args(argv)
+    fs = FileSystem.get(args.fs, Configuration())
+    try:
+        with open(args.audit_log) as f:
+            report = replay(fs, f, args.remap_root)
+    finally:
+        fs.close()
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -140,3 +140,32 @@ def local_host_names() -> set:
     except OSError:
         pass
     return names
+
+
+def check_dir(path: str, min_free_bytes: int = 0) -> None:
+    """Health-check a storage directory: exists (created if needed),
+    writable, readable, and above the free-space floor — raising
+    DiskErrorException-style OSError otherwise (ref: util/DiskChecker
+    .java checkDir + the DN's startup/failed-volume policy)."""
+    import os
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError as e:
+        raise OSError(f"cannot create storage dir {path}: {e}") from e
+    if not os.access(path, os.W_OK):
+        raise OSError(f"storage dir {path} is not writable")
+    if not os.access(path, os.R_OK):
+        raise OSError(f"storage dir {path} is not readable")
+    probe = os.path.join(path, ".disk-check")
+    try:
+        with open(probe, "w") as f:
+            f.write("ok")
+        os.remove(probe)
+    except OSError as e:
+        raise OSError(f"storage dir {path} failed write probe: {e}") from e
+    if min_free_bytes:
+        st = os.statvfs(path)
+        free = st.f_bavail * st.f_frsize
+        if free < min_free_bytes:
+            raise OSError(f"storage dir {path} below free-space floor: "
+                          f"{free} < {min_free_bytes}")

@@ -1,0 +1,180 @@
+"""Metrics sinks, HTTP auth filter, service registry, disk checker.
+Ref: metrics2/sink/{FileSink,StatsDSink}.java, hadoop-auth
+AuthenticationFilter.java, hadoop-registry, util/DiskChecker.java."""
+
+import json
+import os
+import socket
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from hadoop_tpu.conf import Configuration
+
+
+# ------------------------------------------------------------------ sinks
+
+
+def test_file_sink_and_publisher(tmp_path):
+    from hadoop_tpu.metrics import metrics_system
+    from hadoop_tpu.metrics.sinks import FileSink, SinkPublisher
+    reg = metrics_system().source("sinktest")
+    c = reg.counter("things")
+    c.incr(41)
+    path = str(tmp_path / "metrics.jsonl")
+    pub = SinkPublisher(period_s=999).add_sink(FileSink(path))
+    c.incr()
+    pub.publish_once()
+    pub.stop()
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    assert lines
+    assert lines[0]["metrics"]["sinktest"]["things"] == 42
+
+
+def test_statsd_sink_datagrams():
+    from hadoop_tpu.metrics import metrics_system
+    from hadoop_tpu.metrics.sinks import SinkPublisher, StatsDSink
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.settimeout(5.0)
+    port = rx.getsockname()[1]
+    reg = metrics_system().source("statsdtest")
+    reg.counter("pkts").incr(7)
+    pub = SinkPublisher(period_s=999).add_sink(
+        StatsDSink("127.0.0.1", port))
+    pub.publish_once()
+    got = []
+    try:
+        for _ in range(200):
+            got.append(rx.recv(4096).decode())
+            if any("statsdtest.pkts:7|g" in g for g in got):
+                break
+    except socket.timeout:
+        pass
+    assert any("statsdtest.pkts:7|g" in g for g in got), got[:5]
+
+
+def test_failing_sink_isolated(tmp_path):
+    from hadoop_tpu.metrics.sinks import (CallbackSink, FileSink,
+                                          SinkPublisher)
+    boom = CallbackSink(lambda ts, s: (_ for _ in ()).throw(IOError("x")))
+    path = str(tmp_path / "ok.jsonl")
+    pub = SinkPublisher(period_s=999).add_sink(boom).add_sink(
+        FileSink(path))
+    pub.publish_once()
+    assert open(path).read().strip()
+
+
+# ------------------------------------------------------------------- auth
+
+
+def test_http_auth_pseudo_and_cookie():
+    from hadoop_tpu.http.server import HttpServer
+    from hadoop_tpu.security.http_auth import AuthFilter
+    http = HttpServer(Configuration(load_defaults=False),
+                      ("127.0.0.1", 0), daemon_name="authtest")
+    filt = AuthFilter(b"secret")
+    http.add_handler("/prot", filt.wrap(
+        lambda q, b: (200, {"user": q["__user__"]})))
+    http.start()
+    try:
+        base = f"http://127.0.0.1:{http.port}/prot"
+        # no auth → 401
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base)
+        assert exc.value.code == 401
+        # pseudo auth → 200 + signed cookie
+        resp = urllib.request.urlopen(f"{base}?user.name=alice")
+        assert json.loads(resp.read())["user"] == "alice"
+        cookie = resp.headers.get("Set-Cookie", "")
+        assert cookie.startswith("hadoop.auth=")
+        # cookie replays without user.name
+        req = urllib.request.Request(
+            base, headers={"Cookie": cookie.split(";")[0]})
+        assert json.loads(urllib.request.urlopen(req).read())[
+            "user"] == "alice"
+        # tampered cookie → 401
+        bad = cookie.split(";")[0][:-4] + "beef"
+        req = urllib.request.Request(base, headers={"Cookie": bad})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        assert exc.value.code == 401
+    finally:
+        http.stop()
+
+
+def test_auth_token_expiry():
+    from hadoop_tpu.security.http_auth import AuthenticationToken
+    tok = AuthenticationToken("bob", time.time() - 1)
+    signed = tok.sign(b"s")
+    assert AuthenticationToken.verify(signed, b"s") is None
+    tok2 = AuthenticationToken("bob", time.time() + 60)
+    got = AuthenticationToken.verify(tok2.sign(b"s"), b"s")
+    assert got is not None and got.user == "bob"
+    assert AuthenticationToken.verify(tok2.sign(b"s"), b"other") is None
+
+
+# --------------------------------------------------------------- registry
+
+
+def test_registry_register_resolve_expire():
+    from hadoop_tpu.registry import (RegistryClient, RegistryServer,
+                                     ServiceRecord)
+    conf = Configuration(load_defaults=False)
+    conf.set("registry.sweep.interval", "0.2s")
+    srv = RegistryServer(conf)
+    srv.init(conf)
+    srv.start()
+    try:
+        c = RegistryClient(("127.0.0.1", srv.port), conf)
+        c.register(ServiceRecord("/services/nn/active",
+                                 {"rpc": "127.0.0.1:9000"},
+                                 {"role": "active"}), ttl_s=5.0)
+        c.register(ServiceRecord("/services/rm",
+                                 {"rpc": "127.0.0.1:9001"},
+                                 ephemeral=False), ttl_s=1.0)
+        got = c.resolve("/services/nn/active")
+        assert got.endpoints["rpc"] == "127.0.0.1:9000"
+        assert got.attributes["role"] == "active"
+        assert len(c.list("/services")) == 2
+        # a second client whose owner dies (no renewal) expires
+        c2 = RegistryClient(("127.0.0.1", srv.port), conf)
+        c2.register(ServiceRecord("/services/ephemeral", {"x": "y"}),
+                    ttl_s=0.4, auto_renew=False)
+        assert c2.resolve("/services/ephemeral") is not None
+        c2.close()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if c.resolve("/services/ephemeral") is None:
+                break
+            time.sleep(0.1)
+        assert c.resolve("/services/ephemeral") is None
+        # persistent record survives with no renewal
+        time.sleep(0.6)
+        assert c.resolve("/services/rm") is not None
+        c.close()
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------------------- disk checker
+
+
+def test_check_dir(tmp_path):
+    from hadoop_tpu.util.misc import check_dir
+    d = str(tmp_path / "vol0")
+    check_dir(d)                      # created + probed
+    assert os.path.isdir(d)
+    with pytest.raises(OSError):
+        check_dir(d, min_free_bytes=1 << 60)  # absurd floor
+    ro = tmp_path / "ro"
+    ro.mkdir()
+    os.chmod(ro, 0o500)
+    try:
+        if os.geteuid() != 0:  # root bypasses mode bits
+            with pytest.raises(OSError):
+                check_dir(str(ro))
+    finally:
+        os.chmod(ro, 0o700)

@@ -160,3 +160,62 @@ def test_timeline_records_app_lifecycle(tmp_path):
             assert one["app"]["queue"] == "default"
         finally:
             ahs.stop()
+
+
+# --------------------------------------------------- rumen + dynamometer
+
+
+def test_rumen_builds_trace_and_sls_replays_it(tmp_path):
+    """History done-dir → rumen trace → SLS replay (the reference's
+    rumen→gridmix/sls chain)."""
+    from hadoop_tpu.examples.wordcount import make_job
+    from hadoop_tpu.testing.minicluster import MiniMRYarnCluster
+    from hadoop_tpu.tools.rumen import build_trace
+    from hadoop_tpu.tools.sls import SyntheticTrace, run
+    with MiniMRYarnCluster(num_nodes=2,
+                           base_dir=str(tmp_path / "c")) as cluster:
+        fs2 = cluster.get_filesystem()
+        fs2.mkdirs("/ru-in")
+        fs2.write_all("/ru-in/x.txt", b"p q r\n" * 20)
+        job = make_job(cluster.rm_addr, cluster.default_fs, "/ru-in",
+                       "/ru-out")
+        assert job.wait_for_completion()
+        trace_jobs = build_trace(fs2)
+    assert trace_jobs and trace_jobs[0]["containers"] >= 2
+    assert trace_jobs[0]["state"] == "SUCCEEDED"
+    tr = SyntheticTrace.__new__(SyntheticTrace)
+    tr.jobs = trace_jobs
+    r = run(num_nodes=5, scheduler="capacity", ticks=200, trace=tr)
+    assert r["unfinished_apps"] == 0
+    assert r["containers_allocated"] == sum(
+        j["containers"] for j in trace_jobs)
+
+
+def test_dynamometer_replays_audit_log(cluster, fs):
+    import logging as _logging
+
+    from hadoop_tpu.tools.dynamometer import parse_audit_line, replay
+
+    # capture a real audit stream from live traffic
+    records = []
+
+    class Cap(_logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    audit = _logging.getLogger("hadoop_tpu.audit")
+    h = Cap()
+    audit.addHandler(h)
+    try:
+        fs.mkdirs("/dsrc/a")
+        fs.write_all("/dsrc/a/f.bin", b"x" * 1000)
+        fs.read_all("/dsrc/a/f.bin")
+        fs.rename("/dsrc/a/f.bin", "/dsrc/a/g.bin")
+    finally:
+        audit.removeHandler(h)
+    assert records and parse_audit_line(records[0])
+
+    report = replay(fs, records, remap_root="/dynreplay")
+    assert report["ops"] >= 4 and report["errors"] == 0
+    assert report["per_op"].get("mkdirs", 0) >= 1
+    assert fs.exists("/dynreplay/dsrc/a/g.bin")  # the rename replayed
