@@ -1,0 +1,141 @@
+// htpu-container-executor — the native container launcher binary.
+//
+// Role parity with the reference's setuid container-executor (ref:
+// hadoop-yarn-server-nodemanager/src/main/native/container-executor/
+// impl/main.c:656 + container-executor.c:2286 launch_container_as_user):
+// the NM delegates the actual fork/exec so the container runs OUTSIDE
+// the NM's process context with resource limits applied BEFORE user code
+// starts. Scope here: process isolation (new session), rlimit
+// enforcement (address space, open files, core), optional cgroup-v2
+// attachment when a writable cgroup path is handed in, stdout/stderr
+// redirection, and clean exit-code propagation. The setuid user-switch
+// arm compiles in only when the binary runs as root (same policy as the
+// reference: without the setuid bit it launches as the invoking user).
+//
+// Usage:
+//   htpu-container-executor <workdir> <stdout> <stderr> \
+//       <mem_limit_mb> <nofile_limit> <cgroup_dir_or_-> [--user UID] \
+//       -- <argv...>
+
+#include <errno.h>
+#include <fcntl.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/resource.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <string>
+#include <vector>
+
+namespace {
+
+int fail(const char* what) {
+  fprintf(stderr, "htpu-container-executor: %s: %s\n", what,
+          strerror(errno));
+  return 127;
+}
+
+bool write_file(const std::string& path, const std::string& value) {
+  FILE* f = fopen(path.c_str(), "w");
+  if (!f) return false;
+  bool ok = fputs(value.c_str(), f) >= 0;
+  return (fclose(f) == 0) && ok;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 8) {
+    fprintf(stderr,
+            "usage: %s <workdir> <stdout> <stderr> <mem_mb> <nofile> "
+            "<cgroup|-> [--user UID] -- <cmd...>\n",
+            argv[0]);
+    return 2;
+  }
+  const char* workdir = argv[1];
+  const char* out_path = argv[2];
+  const char* err_path = argv[3];
+  long mem_mb = atol(argv[4]);
+  long nofile = atol(argv[5]);
+  const char* cgroup = argv[6];
+  int i = 7;
+  long run_uid = -1;
+  if (strcmp(argv[i], "--user") == 0) {
+    if (i + 1 >= argc) return 2;
+    run_uid = atol(argv[i + 1]);
+    i += 2;
+  }
+  if (strcmp(argv[i], "--") != 0) {
+    fprintf(stderr, "missing -- before command\n");
+    return 2;
+  }
+  i++;
+  if (i >= argc) return 2;
+
+  pid_t pid = fork();
+  if (pid < 0) return fail("fork");
+  if (pid == 0) {
+    // --- child: isolate, limit, redirect, drop privileges, exec ---
+    if (setsid() < 0) _exit(fail("setsid"));
+    if (chdir(workdir) < 0) _exit(fail("chdir"));
+
+    // cgroup-v2 attachment (ref: the cgroups module under
+    // container-executor/impl/modules/cgroups): write limits + join.
+    if (strcmp(cgroup, "-") != 0) {
+      std::string dir(cgroup);
+      mkdir(dir.c_str(), 0755);  // may exist
+      if (mem_mb > 0)
+        write_file(dir + "/memory.max",
+                   std::to_string(mem_mb * 1024 * 1024));
+      char pidbuf[32];
+      snprintf(pidbuf, sizeof(pidbuf), "%d", getpid());
+      if (!write_file(dir + "/cgroup.procs", pidbuf))
+        fprintf(stderr, "warning: cgroup attach failed: %s\n",
+                strerror(errno));
+    } else if (mem_mb > 0) {
+      // no cgroup: enforce with RLIMIT_AS (coarser, but something)
+      struct rlimit rl;
+      rl.rlim_cur = rl.rlim_max = (rlim_t)mem_mb * 1024 * 1024;
+      setrlimit(RLIMIT_AS, &rl);
+    }
+    if (nofile > 0) {
+      struct rlimit rl;
+      rl.rlim_cur = rl.rlim_max = (rlim_t)nofile;
+      setrlimit(RLIMIT_NOFILE, &rl);
+    }
+    struct rlimit core = {0, 0};
+    setrlimit(RLIMIT_CORE, &core);
+
+    int ofd = open(out_path, O_WRONLY | O_CREAT | O_APPEND, 0644);
+    int efd = open(err_path, O_WRONLY | O_CREAT | O_APPEND, 0644);
+    if (ofd < 0 || efd < 0) _exit(fail("open log"));
+    dup2(ofd, 1);
+    dup2(efd, 2);
+    close(ofd);
+    close(efd);
+
+    // user switch LAST (ref: launch_container_as_user's ordering —
+    // privileged setup first, then drop). Only meaningful as root.
+    if (run_uid >= 0 && geteuid() == 0) {
+      if (setgid((gid_t)run_uid) < 0 || setuid((uid_t)run_uid) < 0)
+        _exit(fail("setuid"));
+    }
+    execvp(argv[i], &argv[i]);
+    _exit(fail("execvp"));
+  }
+
+  // --- parent: report the child pid, wait, propagate exit status ---
+  printf("%d\n", pid);
+  fflush(stdout);
+  int status = 0;
+  while (waitpid(pid, &status, 0) < 0) {
+    if (errno != EINTR) return fail("waitpid");
+  }
+  if (WIFEXITED(status)) return WEXITSTATUS(status);
+  if (WIFSIGNALED(status)) return 128 + WTERMSIG(status);
+  return 1;
+}

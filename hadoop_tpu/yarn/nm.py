@@ -77,6 +77,54 @@ class DefaultExecutor(ContainerExecutor):
             pass
 
 
+class NativeExecutor(ContainerExecutor):
+    """Launch through the C++ htpu-container-executor binary: the
+    container runs in its own session with rlimits (and a cgroup when
+    configured) applied BEFORE user code starts — the reference's
+    LinuxContainerExecutor.java:519 → native launch_container_as_user
+    chain, with the setuid arm active only when the binary runs as root.
+    Selected via conf ``yarn.nodemanager.container-executor.class =
+    native`` when the binary is built."""
+
+    def __init__(self, mem_limit_mb: int = 0, nofile: int = 8192,
+                 cgroup_root: str = ""):
+        import hadoop_tpu.native as _nat
+        binary = os.path.join(os.path.dirname(
+            os.path.abspath(_nat.__file__)), "htpu-container-executor")
+        if not os.path.exists(binary):
+            _nat._build()
+        if not os.path.exists(binary):
+            raise FileNotFoundError(
+                "htpu-container-executor not built (no toolchain?)")
+        self.binary = binary
+        self.mem_limit_mb = mem_limit_mb
+        self.nofile = nofile
+        self.cgroup_root = cgroup_root
+
+    def launch(self, workdir: str, commands: List[str],
+               env: Dict[str, str]) -> subprocess.Popen:
+        full_env = dict(os.environ)
+        full_env.update(env)
+        cgroup = "-"
+        if self.cgroup_root:
+            cgroup = os.path.join(self.cgroup_root,
+                                  os.path.basename(workdir))
+        argv = [self.binary, workdir,
+                os.path.join(workdir, "stdout"),
+                os.path.join(workdir, "stderr"),
+                str(self.mem_limit_mb), str(self.nofile), cgroup,
+                "--"] + commands
+        return subprocess.Popen(argv, cwd=workdir, env=full_env,
+                                stdout=subprocess.DEVNULL,
+                                start_new_session=True)
+
+    def signal(self, proc: subprocess.Popen, sig: int) -> None:
+        try:
+            os.killpg(os.getpgid(proc.pid), sig)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
 class _RunningContainer:
     def __init__(self, container: Container, ctx: ContainerLaunchContext,
                  workdir: str, chips: List[int]):
@@ -125,6 +173,13 @@ class NodeAgent(AbstractService):
         self.rm_addr = rm_addr
         self.work_root = work_root or conf.get(
             "yarn.nodemanager.local-dirs", "/tmp/htpu-nm")
+        if executor is None and conf.get(
+                "yarn.nodemanager.container-executor.class", "") == "native":
+            executor = NativeExecutor(
+                mem_limit_mb=conf.get_int(
+                    "yarn.nodemanager.container.memory-limit-mb", 0),
+                cgroup_root=conf.get(
+                    "yarn.nodemanager.cgroups.root", ""))
         self.executor = executor or DefaultExecutor()
         self.containers: Dict[ContainerId, _RunningContainer] = {}
         self._lock = threading.Lock()

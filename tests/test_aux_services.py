@@ -178,3 +178,49 @@ def test_check_dir(tmp_path):
                 check_dir(str(ro))
     finally:
         os.chmod(ro, 0o700)
+
+
+# ------------------------------------------------- native container-executor
+
+
+def test_native_executor_launches_and_limits(tmp_path):
+    from hadoop_tpu.yarn.nm import NativeExecutor
+    try:
+        ex = NativeExecutor(nofile=64)
+    except FileNotFoundError:
+        pytest.skip("native toolchain unavailable")
+    wd = tmp_path / "c1"
+    wd.mkdir()
+    import sys
+    proc = ex.launch(str(wd), [sys.executable, "-c",
+                               "import resource,sys;"
+                               "print('hello from container');"
+                               "print(resource.getrlimit("
+                               "resource.RLIMIT_NOFILE)[0])"], {})
+    assert proc.wait(timeout=30) == 0
+    out = (wd / "stdout").read_text()
+    assert "hello from container" in out
+    assert "64" in out            # rlimit applied before user code
+    # exit code propagation
+    p2 = ex.launch(str(wd), [sys.executable, "-c", "raise SystemExit(7)"],
+                   {})
+    assert p2.wait(timeout=30) == 7
+
+
+def test_native_executor_runs_wordcount_job(tmp_path):
+    """Whole MR job with every container through the native launcher."""
+    from hadoop_tpu.examples.wordcount import make_job
+    from hadoop_tpu.testing.minicluster import MiniMRYarnCluster
+    conf = Configuration(load_defaults=False)
+    conf.set("yarn.nodemanager.container-executor.class", "native")
+    with MiniMRYarnCluster(num_nodes=2, conf=conf,
+                           base_dir=str(tmp_path / "c")) as cluster:
+        from hadoop_tpu.yarn.nm import NativeExecutor
+        assert all(isinstance(nm.executor, NativeExecutor)
+                   for nm in cluster.yarn.node_agents)
+        fs = cluster.get_filesystem()
+        fs.mkdirs("/ne-in")
+        fs.write_all("/ne-in/x.txt", b"n m n\n")
+        job = make_job(cluster.rm_addr, cluster.default_fs, "/ne-in",
+                       "/ne-out")
+        assert job.wait_for_completion(), job.diagnostics
