@@ -1,0 +1,132 @@
+"""HttpFS — the standalone WebHDFS gateway.
+
+Parity with the reference gateway (ref: hadoop-hdfs-project/
+hadoop-hdfs-httpfs — HttpFSServer.java exposing the WebHDFS REST API
+from a separate daemon that talks to the NameNode as an ordinary
+client, fronted by hadoop-auth's AuthenticationFilter): same
+``/webhdfs/v1/<path>?op=…`` surface and JSON shapes as the NN-embedded
+face (dfs/webhdfs.py), but served from its own process against any
+filesystem URI, with pseudo/token authentication on every request. The
+proxy niche: REST access for clients outside the cluster's RPC plane
+(firewalled or non-Python), without exposing the NameNode itself.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional, Tuple
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.dfs.webhdfs import PREFIX, _status_json
+from hadoop_tpu.fs import FileSystem
+from hadoop_tpu.http.server import HttpServer
+from hadoop_tpu.security.http_auth import AuthFilter
+from hadoop_tpu.service import AbstractService
+
+log = logging.getLogger(__name__)
+
+
+class HttpFSServer(AbstractService):
+    def __init__(self, conf: Configuration, fs_uri: str):
+        super().__init__("HttpFSServer")
+        self.fs_uri = fs_uri
+        self.http: Optional[HttpServer] = None
+        self._fs: Optional[FileSystem] = None
+
+    def service_init(self, conf: Configuration) -> None:
+        self._fs = FileSystem.get(self.fs_uri, conf)
+        self.http = HttpServer(
+            conf, ("127.0.0.1", conf.get_int("httpfs.http.port", 0)),
+            daemon_name="httpfs")
+        secret = conf.get("httpfs.authentication.signature.secret",
+                          "httpfs-secret").encode()
+        filt = AuthFilter(
+            secret,
+            allow_anonymous=conf.get_bool(
+                "httpfs.authentication.simple.anonymous.allowed", False))
+        self.http.add_handler(PREFIX, filt.wrap(self._handle))
+
+    def service_start(self) -> None:
+        self.http.start()
+        log.info("HttpFS on :%d -> %s", self.http.port, self.fs_uri)
+
+    def service_stop(self) -> None:
+        if self.http:
+            self.http.stop()
+        if self._fs:
+            self._fs.close()
+
+    @property
+    def port(self) -> int:
+        return self.http.port
+
+    # ------------------------------------------------------------- handler
+
+    def _handle(self, query: Dict, body: bytes) -> Tuple[int, object]:
+        path = query["__path__"][len(PREFIX):] or "/"
+        method = query["__method__"]
+        op = query.get("op", "").upper()
+        fs = self._fs
+
+        if method == "GET":
+            if op == "GETFILESTATUS":
+                return 200, {"FileStatus": _status_json(
+                    fs.get_file_status(path).to_wire())}
+            if op == "LISTSTATUS":
+                return 200, {"FileStatuses": {"FileStatus": [
+                    _status_json(s.to_wire())
+                    for s in fs.list_status(path)]}}
+            if op == "GETCONTENTSUMMARY":
+                cs = fs.client.content_summary(path) if hasattr(
+                    fs, "client") else {"dirs": 0, "files": 0, "length": 0}
+                return 200, {"ContentSummary": {
+                    "directoryCount": cs["dirs"],
+                    "fileCount": cs["files"], "length": cs["length"]}}
+            if op == "OPEN":
+                offset = int(query.get("offset", 0))
+                length = int(query.get("length", -1))
+                with fs.open(path) as f:
+                    if offset:
+                        f.seek(offset)
+                    return 200, f.read(length if length >= 0 else -1)
+        elif method == "PUT":
+            if op == "MKDIRS":
+                return 200, {"boolean": fs.mkdirs(path)}
+            if op == "RENAME":
+                return 200, {"boolean": fs.rename(
+                    path, query["destination"])}
+            if op == "CREATE":
+                overwrite = query.get("overwrite", "false") == "true"
+                with fs.create(path, overwrite=overwrite) as f:
+                    f.write(body)
+                return 201, {"boolean": True}
+        elif method == "DELETE":
+            if op == "DELETE":
+                recursive = query.get("recursive", "false") == "true"
+                return 200, {"boolean": fs.delete(path,
+                                                  recursive=recursive)}
+        return 400, {"RemoteException": {
+            "exception": "UnsupportedOperationException",
+            "message": f"op {op!r} with {method}"}}
+
+
+def main(argv=None) -> int:
+    import argparse
+    import signal
+    ap = argparse.ArgumentParser(prog="httpfs")
+    ap.add_argument("--fs", required=True)
+    ap.add_argument("--port", type=int, default=14000)
+    args = ap.parse_args(argv)
+    conf = Configuration()
+    conf.set("httpfs.http.port", str(args.port))
+    srv = HttpFSServer(conf, args.fs)
+    srv.init(conf)
+    srv.start()
+    print(f"HttpFS serving on :{srv.port}")
+    signal.pause()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

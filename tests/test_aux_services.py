@@ -224,3 +224,52 @@ def test_native_executor_runs_wordcount_job(tmp_path):
         job = make_job(cluster.rm_addr, cluster.default_fs, "/ne-in",
                        "/ne-out")
         assert job.wait_for_completion(), job.diagnostics
+
+
+# ----------------------------------------------------------------- httpfs
+
+
+def test_httpfs_gateway(tmp_path):
+    from hadoop_tpu.dfs.httpfs import HttpFSServer
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster
+    with MiniDFSCluster(num_datanodes=2,
+                        base_dir=str(tmp_path / "dfs")) as cluster:
+        conf = Configuration(load_defaults=False)
+        srv = HttpFSServer(conf, cluster.default_fs)
+        srv.init(conf)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}/webhdfs/v1"
+            auth = "user.name=tester"
+            # unauthenticated → 401
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"{base}/?op=LISTSTATUS")
+            assert exc.value.code == 401
+            # mkdirs + create + open + liststatus + delete
+            req = urllib.request.Request(
+                f"{base}/gw/dir?op=MKDIRS&{auth}", method="PUT")
+            assert json.loads(urllib.request.urlopen(req).read())["boolean"]
+            req = urllib.request.Request(
+                f"{base}/gw/dir/f.bin?op=CREATE&{auth}",
+                data=b"payload-123", method="PUT")
+            assert urllib.request.urlopen(req).status == 201
+            got = urllib.request.urlopen(
+                f"{base}/gw/dir/f.bin?op=OPEN&{auth}").read()
+            assert got == b"payload-123"
+            ls = json.loads(urllib.request.urlopen(
+                f"{base}/gw/dir?op=LISTSTATUS&{auth}").read())
+            names = [s["pathSuffix"]
+                     for s in ls["FileStatuses"]["FileStatus"]]
+            assert names == ["f.bin"]
+            st = json.loads(urllib.request.urlopen(
+                f"{base}/gw/dir/f.bin?op=GETFILESTATUS&{auth}").read())
+            assert st["FileStatus"]["length"] == 11
+            req = urllib.request.Request(
+                f"{base}/gw/dir?op=DELETE&recursive=true&{auth}",
+                method="DELETE")
+            assert json.loads(urllib.request.urlopen(req).read())["boolean"]
+            # the gateway's writes are visible through the native client
+            fs = cluster.get_filesystem()
+            assert not fs.exists("/gw/dir")
+        finally:
+            srv.stop()
