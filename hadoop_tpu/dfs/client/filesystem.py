@@ -19,6 +19,47 @@ from hadoop_tpu.fs.filesystem import FileSystem, Path, register_filesystem
 class DistributedFileSystem(FileSystem):
     def __init__(self, nn_addrs, conf: Optional[Configuration] = None):
         self.client = DFSClient(nn_addrs, conf)
+        self._kms_provider = None
+
+    # ------------------------------------------------- encryption at rest
+
+    def _kms(self):
+        uri = self.client.conf.get("dfs.encryption.key.provider.uri", "")
+        if not uri:
+            return None
+        if self._kms_provider is None:
+            from hadoop_tpu.crypto.kms import KMSKeyProvider
+            from hadoop_tpu.security.ugi import current_user
+            self._kms_provider = KMSKeyProvider(
+                uri.split("://", 1)[-1].rstrip("/"),
+                user=current_user().user_name)
+        return self._kms_provider
+
+    def _dek_for(self, path: str):
+        """(dek, iv) for an encrypted file, or None. Ref:
+        HdfsKMSUtil.decryptEncryptedDataEncryptionKey — the client, not
+        the NameNode, resolves EDEK→DEK so plaintext keys never touch
+        the metadata plane."""
+        info = self.client.nn.get_encryption_info(path)
+        if info is None:
+            return None
+        kms = self._kms()
+        if kms is None:
+            raise PermissionError(
+                f"{path} is in an encryption zone but this client has no "
+                "KMS configured (dfs.encryption.key.provider.uri)")
+        import base64 as _b64
+        from hadoop_tpu.crypto.keys import EncryptedKeyVersion
+        ekv = EncryptedKeyVersion(
+            info["key"], info["version"], _b64.b64decode(info["iv"]),
+            _b64.b64decode(info["edek"]))
+        return kms.decrypt_encrypted_key(ekv), ekv.iv
+
+    def create_encryption_zone(self, path: str, key_name: str) -> bool:
+        return self.client.nn.create_encryption_zone(path, key_name)
+
+    def get_encryption_info(self, path: str):
+        return self.client.nn.get_encryption_info(path)
 
     @classmethod
     def create_instance(cls, path: Path, conf: Configuration):
@@ -32,13 +73,23 @@ class DistributedFileSystem(FileSystem):
         return cls(addrs, conf)
 
     def open(self, path: str):
-        return self.client.open(path)
+        stream = self.client.open(path)
+        dek_iv = self._dek_for(path) if self._kms() is not None else None
+        if dek_iv is not None:
+            from hadoop_tpu.crypto.streams import CryptoInputStream
+            return CryptoInputStream(stream, dek_iv[0], dek_iv[1])
+        return stream
 
     def create(self, path: str, overwrite: bool = False, replication=None,
                block_size=None):
-        return self.client.create(path, overwrite=overwrite,
-                                  replication=replication,
-                                  block_size=block_size)
+        stream = self.client.create(path, overwrite=overwrite,
+                                    replication=replication,
+                                    block_size=block_size)
+        dek_iv = self._dek_for(path) if self._kms() is not None else None
+        if dek_iv is not None:
+            from hadoop_tpu.crypto.streams import CryptoOutputStream
+            return CryptoOutputStream(stream, dek_iv[0], dek_iv[1])
+        return stream
 
     def mkdirs(self, path: str) -> bool:
         return self.client.nn.mkdirs(path)

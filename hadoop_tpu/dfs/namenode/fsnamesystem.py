@@ -262,6 +262,16 @@ class FSNamesystem:
         replication = replication or self.default_replication
         block_size = block_size or self.default_block_size
         owner = current_user().user_name
+        # EDEK pre-generation OUTSIDE the namesystem lock (the KMS is an
+        # HTTP round trip; ref: the reference's EDEKCacheLoader exists for
+        # exactly this reason). Re-checked under the lock.
+        pre_zone_key = None
+        pre_edek = None
+        if self._kms() is not None:
+            with self.lock.read():
+                pre_zone_key = self._zone_key_locked(path)
+            if pre_zone_key is not None:
+                pre_edek = self._generate_edek_attr(pre_zone_key)
         with self._m["create"].time():
             with self.lock.write():
                 self._check_not_safemode("create")
@@ -287,6 +297,13 @@ class FSNamesystem:
                 else:
                     self._check_quota_locked(path, d_inodes=1, d_space=0)
                 ec_policy = self._effective_ec_policy_locked(path)
+                zone_key = self._zone_key_locked(path) \
+                    if self._kms() is not None else None
+                edek_attr = pre_edek if zone_key == pre_zone_key else None
+                if zone_key is not None and edek_attr is None:
+                    # zone appeared/changed between the optimistic read
+                    # and now (rare) — pay the KMS call under the lock
+                    edek_attr = self._generate_edek_attr(zone_key)
                 inode = self.fsdir.add_file(path, replication, block_size,
                                             owner=owner)
                 inode.ec_policy = ec_policy
@@ -297,6 +314,12 @@ class FSNamesystem:
                     "p": path, "rep": replication, "bs": block_size,
                     "cl": client_name, "o": owner, "ov": overwrite,
                     "ec": ec_policy})
+                if edek_attr is not None:
+                    # atomic with create: same write lock, extra edit
+                    # before the sync (ref: startFile's FEInfo handling)
+                    inode.xattrs = {self.EDEK_XATTR: edek_attr}
+                    txid = self.editlog.log_edit(el.OP_SET_XATTR, {
+                        "p": path, "n": self.EDEK_XATTR, "v": edek_attr})
                 status = inode.status(path)
             self.editlog.log_sync(txid)
             log_audit_event(True, "create", path)
@@ -767,6 +790,83 @@ class FSNamesystem:
         self.editlog.log_sync(txid)
 
     # --------------------------------------------------------------- xattrs
+
+    # ------------------------------------------------------ encryption zones
+
+    ZONE_XATTR = "system.crypto.zone"       # on the zone root: key name
+    EDEK_XATTR = "system.crypto.edek"       # on each file: json FEInfo
+
+    def _kms(self):
+        """Lazy KMS client (ref: dfs.encryption.key.provider.uri — the NN
+        generates EDEKs; it never sees plaintext DEKs)."""
+        if getattr(self, "_kms_provider", None) is None:
+            uri = self.conf.get("dfs.encryption.key.provider.uri", "")
+            if not uri:
+                return None
+            from hadoop_tpu.crypto.kms import KMSKeyProvider
+            addr = uri.split("://", 1)[-1].rstrip("/")
+            self._kms_provider = KMSKeyProvider(addr, user="namenode")
+        return self._kms_provider
+
+    def create_encryption_zone(self, path: str, key_name: str) -> bool:
+        """Mark an EMPTY directory as an encryption zone (ref:
+        FSDirEncryptionZoneOp.createEncryptionZone — same constraints:
+        directory, empty, not nested inside another zone)."""
+        if self._kms() is None:
+            raise ValueError("no KMS configured "
+                             "(dfs.encryption.key.provider.uri)")
+        self._kms().get_current_key(key_name)  # must exist
+        with self.lock.write():
+            node = self._inode_or_raise(path)
+            if not isinstance(node, INodeDirectory):
+                raise NotADirectoryError(path)
+            if node.children:
+                raise OSError(f"cannot create zone on non-empty {path}")
+            if self._zone_key_locked(path) is not None:
+                raise OSError(f"{path} is already inside an encryption "
+                              "zone")
+            if node.xattrs is None:
+                node.xattrs = {}
+            node.xattrs[self.ZONE_XATTR] = key_name.encode()
+            txid = self.editlog.log_edit(el.OP_SET_XATTR, {
+                "p": path, "n": self.ZONE_XATTR, "v": key_name.encode()})
+        self.editlog.log_sync(txid)
+        log_audit_event(True, "createEncryptionZone", path)
+        return True
+
+    def _generate_edek_attr(self, key_name: str) -> bytes:
+        """EDEK + metadata as the xattr payload (FileEncryptionInfo)."""
+        import base64 as _b64
+        import json as _json
+        ekv = self._kms().generate_encrypted_key(key_name)
+        return _json.dumps({
+            "key": ekv.key_name, "version": ekv.key_version,
+            "iv": _b64.b64encode(ekv.iv).decode(),
+            "edek": _b64.b64encode(ekv.edek).decode(),
+        }).encode()
+
+    def _zone_key_locked(self, path: str) -> Optional[str]:
+        """Nearest ancestor zone's key name (caller holds a lock)."""
+        parts = [p for p in path.split("/") if p]
+        for i in range(len(parts), -1, -1):
+            prefix = "/" + "/".join(parts[:i]) if i else "/"
+            node = self.fsdir.get_inode(prefix)
+            if node is not None and node.xattrs and \
+                    self.ZONE_XATTR in node.xattrs:
+                return node.xattrs[self.ZONE_XATTR].decode()
+        return None
+
+    def get_encryption_info(self, path: str) -> Optional[Dict]:
+        """The file's FileEncryptionInfo for clients (ref:
+        FSDirEncryptionZoneOp.getFileEncryptionInfo): the EDEK + key
+        version the client hands to the KMS to obtain the DEK."""
+        import json as _json
+        with self.lock.read():
+            node = self.fsdir.get_inode(path)
+            if node is None or node.xattrs is None or \
+                    self.EDEK_XATTR not in node.xattrs:
+                return None
+            return _json.loads(node.xattrs[self.EDEK_XATTR].decode())
 
     def set_xattr(self, path: str, name: str, value: bytes) -> None:
         """Ref: FSDirXAttrOp.setXAttr — names are namespaced."""

@@ -48,6 +48,7 @@ WRITE_METHODS = frozenset({
     "renew_lease", "report_bad_blocks",
     # Namespace-feature mutations.
     "set_quota", "set_xattr", "remove_xattr", "set_acl", "remove_acl",
+    "create_encryption_zone",
     "set_storage_policy", "allow_snapshot", "disallow_snapshot",
     "create_snapshot", "delete_snapshot", "rename_snapshot", "concat",
     "truncate",
@@ -168,6 +169,15 @@ class ClientProtocol:
     def remove_xattr(self, path: str, name: str) -> bool:
         self.fsn.remove_xattr(path, name)
         return True
+
+    def create_encryption_zone(self, path: str, key_name: str) -> bool:
+        """Ref: ClientProtocol.createEncryptionZone."""
+        return self.fsn.create_encryption_zone(path, key_name)
+
+    @idempotent
+    def get_encryption_info(self, path: str) -> Optional[Dict]:
+        """Ref: the FileEncryptionInfo returned with getFileInfo/open."""
+        return self.fsn.get_encryption_info(path)
 
     def set_acl(self, path: str, entries: List[str]) -> bool:
         self.fsn.set_acl(path, entries)

@@ -1,0 +1,109 @@
+"""Encryption zones: transparent encryption at rest via the KMS.
+
+Ref: HDFS TDE — FSDirEncryptionZoneOp (zone create + per-file EDEK),
+HdfsKMSUtil (client-side EDEK→DEK), CryptoInput/OutputStream wrapping;
+acceptance mirrors TestEncryptionZones: data readable through the zone,
+ciphertext on disk, unauthorized clients locked out."""
+
+import glob
+import os
+
+import pytest
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.crypto.kms import KMSKeyProvider, KMSServer
+from hadoop_tpu.testing.minicluster import MiniDFSCluster
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("ez")
+    kms_conf = Configuration(load_defaults=False)
+    kms_conf.set("kms.key.provider.path", str(tmp / "keys.json"))
+    kms = KMSServer(kms_conf)
+    kms.init(kms_conf)
+    kms.start()
+    KMSKeyProvider(f"127.0.0.1:{kms.port}").create_key("zone-key", 128)
+
+    conf = Configuration(load_defaults=False)
+    conf.set("dfs.encryption.key.provider.uri",
+             f"kms://127.0.0.1:{kms.port}")
+    cluster = MiniDFSCluster(num_datanodes=2, conf=conf,
+                             base_dir=str(tmp / "dfs"))
+    cluster.start()
+    yield kms, cluster
+    cluster.shutdown()
+    kms.stop()
+
+
+def test_zone_roundtrip_and_ciphertext_on_disk(stack):
+    kms, cluster = stack
+    fs = cluster.get_filesystem()
+    fs.mkdirs("/secure")
+    fs.create_encryption_zone("/secure", "zone-key")
+    data = (b"attack at dawn " * 5000)[:64_000]
+    with fs.create("/secure/plan.txt") as out:
+        out.write(data)
+    # transparent read-back
+    with fs.open("/secure/plan.txt") as f:
+        assert f.read() == data
+    # positioned read decrypts mid-stream
+    with fs.open("/secure/plan.txt") as f:
+        f.seek(31_337)
+        assert f.read(100) == data[31_337:31_437]
+    # ON DISK it is ciphertext
+    raw = b""
+    for path in glob.glob(os.path.join(
+            cluster.base_dir, "data*", "current", "finalized", "blk_*")):
+        if not path.endswith(".meta"):
+            raw += open(path, "rb").read()
+    assert b"attack at dawn" not in raw
+    # files outside the zone stay plaintext
+    fs.write_all("/plain.txt", b"not secret")
+    assert fs.read_all("/plain.txt") == b"not secret"
+    assert fs.get_encryption_info("/plain.txt") is None
+    info = fs.get_encryption_info("/secure/plan.txt")
+    assert info["key"] == "zone-key" and info["edek"]
+
+
+def test_client_without_kms_cannot_read(stack):
+    kms, cluster = stack
+    from hadoop_tpu.dfs.client.filesystem import DistributedFileSystem
+    blind_conf = Configuration(load_defaults=False)  # no KMS uri
+    blind = DistributedFileSystem([cluster.nn_addr], blind_conf)
+    try:
+        # metadata visible, content not decryptable
+        assert blind.get_file_status("/secure/plan.txt").length > 0
+        with blind.open("/secure/plan.txt") as f:
+            assert f.read(100) != b"attack at dawn "[:100]
+    finally:
+        blind.close()
+
+
+def test_zone_constraints(stack):
+    kms, cluster = stack
+    fs = cluster.get_filesystem()
+    fs.mkdirs("/notempty/sub")
+    with pytest.raises(OSError):
+        fs.create_encryption_zone("/notempty", "zone-key")
+    with pytest.raises(Exception):
+        fs.create_encryption_zone("/secure", "no-such-key")
+    fs.mkdirs("/secure/inner")
+    with pytest.raises(OSError):  # no nested zones
+        fs.create_encryption_zone("/secure/inner", "zone-key")
+
+
+def test_zone_survives_namenode_restart(stack):
+    kms, cluster = stack
+    fs = cluster.get_filesystem()
+    data = os.urandom(10_000)
+    with fs.create("/secure/persist.bin") as out:
+        out.write(data)
+    cluster.restart_namenode()
+    fs2 = cluster.get_filesystem()
+    with fs2.open("/secure/persist.bin") as f:
+        assert f.read() == data
+    # new files in the zone still get EDEKs after replay
+    with fs2.create("/secure/after.bin") as out:
+        out.write(b"post-restart")
+    assert fs2.get_encryption_info("/secure/after.bin") is not None
