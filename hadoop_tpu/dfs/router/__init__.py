@@ -1,0 +1,5 @@
+"""Router-based federation (ref: hadoop-hdfs-rbf)."""
+
+from hadoop_tpu.dfs.router.router import MountTable, Router
+
+__all__ = ["MountTable", "Router"]
