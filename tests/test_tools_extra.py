@@ -219,3 +219,27 @@ def test_dynamometer_replays_audit_log(cluster, fs):
     assert report["ops"] >= 4 and report["errors"] == 0
     assert report["per_op"].get("mkdirs", 0) >= 1
     assert fs.exists("/dynreplay/dsrc/a/g.bin")  # the rename replayed
+
+
+# ---------------------------------------------------------------- gridmix
+
+
+def test_gridmix_replays_trace_as_real_jobs(tmp_path):
+    from hadoop_tpu.testing.minicluster import MiniMRYarnCluster
+    from hadoop_tpu.tools.gridmix import run_trace
+    trace = [
+        {"job_id": "job_a", "arrival": 0, "containers": 2},
+        {"job_id": "job_b", "arrival": 1, "containers": 1},
+    ]
+    with MiniMRYarnCluster(num_nodes=2,
+                           base_dir=str(tmp_path / "c")) as cluster:
+        report = run_trace(cluster.rm_addr, cluster.default_fs, trace,
+                           sleep_ms=50, max_concurrent=2)
+        assert report["jobs"] == 2 and report["failed"] == 0
+        assert report["job_latency_s"]["p50"] > 0
+        fs = cluster.get_filesystem()
+        # each job's synthetic maps wrote real committed output
+        assert fs.exists("/gridmix-out/0/_SUCCESS")
+        parts = [s.path for s in fs.list_status("/gridmix-out/0")
+                 if "part-m-" in s.path]
+        assert len(parts) == 2
