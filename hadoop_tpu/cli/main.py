@@ -10,7 +10,13 @@ src/main/bin/hadoop + hadoop-functions.sh, hdfs/yarn/mapred CLIs):
   hadoop-tpu mover [path]                  satisfy storage policies
   hadoop-tpu namenode|datanode|journalnode daemon launchers
   hadoop-tpu rm|nodeagent                  resource-manager daemons
+  hadoop-tpu historyserver|kms|httpfs|router|registry   more daemons
   hadoop-tpu job -submit ...               MapReduce job control
+  hadoop-tpu distcp SRC DST ...            distributed copy
+  hadoop-tpu streaming --mapper CMD ...    external-process jobs
+  hadoop-tpu archive SRC DST.har           create a har archive
+  hadoop-tpu sls|gridmix|rumen|dynamometer simulators / replay tools
+  hadoop-tpu oiv|oev --name-dir DIR        offline image/edits viewers
   hadoop-tpu version
 
 Generic options (before the subcommand args, ref:
@@ -158,7 +164,54 @@ def _main(argv=None) -> int:
         return _run_daemon(ResourceManager(conf), conf)
     if cmd == "nodeagent":
         from hadoop_tpu.yarn.nm import NodeAgent
-        return _run_daemon(NodeAgent(conf), conf)
+        from hadoop_tpu.util.misc import parse_addr_list
+        addrs = parse_addr_list(conf.get(
+            "yarn.resourcemanager.address", "127.0.0.1:8032"))
+        return _run_daemon(NodeAgent(conf, rm_addr=addrs[0]), conf)
+    if cmd == "historyserver":
+        from hadoop_tpu.mapreduce.historyserver import JobHistoryServer
+        return _run_daemon(JobHistoryServer(
+            conf, conf.get("fs.defaultFS", "file:///")), conf)
+    if cmd == "kms":
+        from hadoop_tpu.crypto.kms import KMSServer
+        return _run_daemon(KMSServer(conf), conf)
+    if cmd == "httpfs":
+        from hadoop_tpu.dfs.httpfs import HttpFSServer
+        return _run_daemon(HttpFSServer(
+            conf, conf.get("fs.defaultFS", "file:///")), conf)
+    if cmd == "router":
+        from hadoop_tpu.dfs.router import Router
+        return _run_daemon(Router(conf), conf)
+    if cmd == "registry":
+        from hadoop_tpu.registry import RegistryServer
+        return _run_daemon(RegistryServer(conf), conf)
+    if cmd == "distcp":
+        from hadoop_tpu.tools.distcp import main as distcp_main
+        return distcp_main(rest)
+    if cmd == "streaming":
+        from hadoop_tpu.tools.streaming import main as streaming_main
+        return streaming_main(rest)
+    if cmd == "archive":
+        from hadoop_tpu.tools.archive import main as archive_main
+        return archive_main(rest)
+    if cmd == "sls":
+        from hadoop_tpu.tools.sls import main as sls_main
+        return sls_main(rest)
+    if cmd == "gridmix":
+        from hadoop_tpu.tools.gridmix import main as gridmix_main
+        return gridmix_main(rest)
+    if cmd == "rumen":
+        from hadoop_tpu.tools.rumen import main as rumen_main
+        return rumen_main(rest)
+    if cmd == "dynamometer":
+        from hadoop_tpu.tools.dynamometer import main as dyn_main
+        return dyn_main(rest)
+    if cmd == "oiv":
+        from hadoop_tpu.cli.oiv import main_oiv
+        return main_oiv(rest)
+    if cmd == "oev":
+        from hadoop_tpu.cli.oiv import main_oev
+        return main_oev(rest)
     print(f"hadoop-tpu: unknown command {cmd!r}; try `hadoop-tpu help`",
           file=sys.stderr)
     return 1

@@ -1,0 +1,216 @@
+"""SharedCacheManager — cluster-wide artifact cache keyed by checksum.
+
+Parity with the reference SCM (ref: hadoop-yarn-server-sharedcachemanager
+— ClientProtocolService (use/release), SharedCacheUploaderService
+(SCMUploader.proto notify), CleanerService sweeping unreferenced
+entries; client side SharedCacheClient.java): apps upload each resource
+once, keyed by its SHA-256; later apps ``use`` the cached copy instead
+of re-localizing, with per-app references keeping live entries pinned
+and a cleaner evicting unreferenced ones after a TTL.
+
+Store layout on the backing FileSystem:
+    <root>/<checksum[:2]>/<checksum>/<filename>
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.fs import FileSystem
+from hadoop_tpu.ipc import Client, Server, get_proxy, idempotent
+from hadoop_tpu.service import AbstractService
+from hadoop_tpu.util.misc import Daemon
+
+log = logging.getLogger(__name__)
+
+
+def checksum_file(local_path: str) -> str:
+    h = hashlib.sha256()
+    with open(local_path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+class SCMProtocol:
+    def __init__(self, scm: "SharedCacheManager"):
+        self.scm = scm
+
+    def use(self, checksum: str, app_id: str) -> Optional[str]:
+        return self.scm.use(checksum, app_id)
+
+    def release(self, app_id: str) -> int:
+        return self.scm.release(app_id)
+
+    def notify_uploaded(self, checksum: str, filename: str) -> bool:
+        return self.scm.notify_uploaded(checksum, filename)
+
+    @idempotent
+    def stats(self) -> Dict:
+        return self.scm.stats()
+
+
+class SharedCacheManager(AbstractService):
+    def __init__(self, conf: Configuration, fs_uri: str,
+                 root: str = "/sharedcache"):
+        super().__init__("SharedCacheManager")
+        self.fs_uri = fs_uri
+        self.root = root
+        # checksum → (filename, set of referencing app ids, last_use)
+        self._entries: Dict[str, Tuple[str, Set[str], float]] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.rpc: Optional[Server] = None
+        self._fs: Optional[FileSystem] = None
+
+    def service_init(self, conf: Configuration) -> None:
+        self._fs = FileSystem.get(self.fs_uri, conf)
+        self._fs.mkdirs(self.root)
+        self._scan()
+        self.ttl_s = conf.get_time_seconds(
+            "yarn.sharedcache.cleaner.resource-ttl", 3600.0)
+        self._clean_interval = conf.get_time_seconds(
+            "yarn.sharedcache.cleaner.period", 60.0)
+        self.rpc = Server(conf, bind=("127.0.0.1", conf.get_int(
+            "yarn.sharedcache.port", 0)), num_handlers=4, name="scm")
+        self.rpc.register_protocol("SCMProtocol", SCMProtocol(self))
+
+    def service_start(self) -> None:
+        self.rpc.start()
+        Daemon(self._cleaner_loop, "scm-cleaner").start()
+        log.info("SharedCacheManager on :%d (%d cached entries)",
+                 self.rpc.port, len(self._entries))
+
+    def service_stop(self) -> None:
+        self._stop.set()
+        if self.rpc:
+            self.rpc.stop()
+        if self._fs:
+            self._fs.close()
+
+    @property
+    def port(self) -> int:
+        return self.rpc.port
+
+    # -------------------------------------------------------------- store
+
+    def _entry_dir(self, checksum: str) -> str:
+        return f"{self.root}/{checksum[:2]}/{checksum}"
+
+    def _scan(self) -> None:
+        """Recover the entry map from the store on restart (ref:
+        InMemorySCMStore's initial app-less bootstrap)."""
+        try:
+            shards = self._fs.list_status(self.root)
+        except (IOError, OSError, FileNotFoundError):
+            return
+        for shard in shards:
+            if not shard.is_dir:
+                continue
+            for ent in self._fs.list_status(shard.path):
+                if not ent.is_dir:
+                    continue
+                checksum = ent.path.rstrip("/").rsplit("/", 1)[-1]
+                files = [s for s in self._fs.list_status(ent.path)
+                         if not s.is_dir]
+                if files:
+                    name = files[0].path.rsplit("/", 1)[-1]
+                    self._entries[checksum] = (name, set(), time.time())
+
+    def use(self, checksum: str, app_id: str) -> Optional[str]:
+        """Cache hit → path + a reference pinning it; miss → None (the
+        caller uploads then notifies). Ref: ClientProtocolService.use."""
+        with self._lock:
+            ent = self._entries.get(checksum)
+            if ent is None:
+                return None
+            name, refs, _ = ent
+            refs.add(app_id)
+            self._entries[checksum] = (name, refs, time.time())
+            return f"{self._entry_dir(checksum)}/{name}"
+
+    def release(self, app_id: str) -> int:
+        """Drop every reference this app holds. Ref: the RM's
+        AppChecker-driven release on app completion."""
+        n = 0
+        with self._lock:
+            for checksum, (name, refs, ts) in self._entries.items():
+                if app_id in refs:
+                    refs.discard(app_id)
+                    n += 1
+        return n
+
+    def notify_uploaded(self, checksum: str, filename: str) -> bool:
+        """Ref: SharedCacheUploaderService.notify."""
+        path = f"{self._entry_dir(checksum)}/{filename}"
+        if not self._fs.exists(path):
+            return False
+        with self._lock:
+            self._entries.setdefault(checksum,
+                                     (filename, set(), time.time()))
+        return True
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {"entries": len(self._entries),
+                    "referenced": sum(1 for _, refs, _ in
+                                      self._entries.values() if refs)}
+
+    def _cleaner_loop(self) -> None:
+        """Evict unreferenced entries past the TTL.
+        Ref: CleanerService + CleanerTask."""
+        while not self._stop.wait(self._clean_interval):
+            now = time.time()
+            with self._lock:
+                dead = [c for c, (_, refs, ts) in self._entries.items()
+                        if not refs and now - ts > self.ttl_s]
+                for c in dead:
+                    del self._entries[c]
+            for c in dead:
+                try:
+                    self._fs.delete(self._entry_dir(c), recursive=True)
+                    log.info("SCM cleaned %s", c)
+                except (IOError, OSError):
+                    pass
+
+
+class SharedCacheClient:
+    """Upload/use helper (ref: client-side SharedCacheClient.java)."""
+
+    def __init__(self, scm_addr, fs_uri: str,
+                 conf: Optional[Configuration] = None,
+                 root: str = "/sharedcache"):
+        self.conf = conf or Configuration()
+        self._client = Client(self.conf)
+        self.scm = get_proxy("SCMProtocol", scm_addr, client=self._client)
+        self.fs = FileSystem.get(fs_uri, self.conf)
+        self.root = root
+
+    def use(self, local_path: str, app_id: str) -> str:
+        """Cached path for this file, uploading on first use."""
+        checksum = checksum_file(local_path)
+        cached = self.scm.use(checksum, app_id)
+        if cached is not None:
+            return cached
+        name = local_path.rsplit("/", 1)[-1]
+        dst = f"{self.root}/{checksum[:2]}/{checksum}/{name}"
+        self.fs.mkdirs(dst.rsplit("/", 1)[0])
+        with open(local_path, "rb") as src:
+            with self.fs.create(dst, overwrite=True) as out:
+                for chunk in iter(lambda: src.read(1 << 20), b""):
+                    out.write(chunk)
+        self.scm.notify_uploaded(checksum, name)
+        got = self.scm.use(checksum, app_id)
+        return got if got is not None else dst
+
+    def release(self, app_id: str) -> int:
+        return self.scm.release(app_id)
+
+    def close(self) -> None:
+        self._client.stop()
+        self.fs.close()

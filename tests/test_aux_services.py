@@ -273,3 +273,81 @@ def test_httpfs_gateway(tmp_path):
             assert not fs.exists("/gw/dir")
         finally:
             srv.stop()
+
+
+# ----------------------------------------------------- shared cache (SCM)
+
+
+def test_shared_cache_upload_use_cleanup(tmp_path):
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster
+    from hadoop_tpu.yarn.sharedcache import (SharedCacheClient,
+                                             SharedCacheManager)
+    with MiniDFSCluster(num_datanodes=2,
+                        base_dir=str(tmp_path / "dfs")) as cluster:
+        conf = Configuration(load_defaults=False)
+        conf.set("yarn.sharedcache.cleaner.resource-ttl", "0.3s")
+        conf.set("yarn.sharedcache.cleaner.period", "0.2s")
+        scm = SharedCacheManager(conf, cluster.default_fs)
+        scm.init(conf)
+        scm.start()
+        try:
+            art = tmp_path / "lib.bin"
+            art.write_bytes(os.urandom(50_000))
+            c = SharedCacheClient(("127.0.0.1", scm.port),
+                                  cluster.default_fs, conf)
+            # first use uploads
+            p1 = c.use(str(art), "app_1")
+            fs = cluster.get_filesystem()
+            assert fs.exists(p1)
+            assert fs.get_file_status(p1).length == 50_000
+            # second app hits the cache (no second copy)
+            p2 = c.use(str(art), "app_2")
+            assert p2 == p1
+            assert scm.stats()["entries"] == 1
+            # releases + TTL -> cleaner evicts, file removed from DFS
+            c.release("app_1")
+            c.release("app_2")
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if scm.stats()["entries"] == 0 and not fs.exists(p1):
+                    break
+                time.sleep(0.1)
+            assert scm.stats()["entries"] == 0
+            assert not fs.exists(p1)
+            # re-upload after eviction works
+            p3 = c.use(str(art), "app_3")
+            assert fs.exists(p3)
+            c.close()
+        finally:
+            scm.stop()
+
+
+def test_shared_cache_survives_restart(tmp_path):
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster
+    from hadoop_tpu.yarn.sharedcache import (SharedCacheClient,
+                                             SharedCacheManager)
+    with MiniDFSCluster(num_datanodes=2,
+                        base_dir=str(tmp_path / "dfs")) as cluster:
+        conf = Configuration(load_defaults=False)
+        scm = SharedCacheManager(conf, cluster.default_fs)
+        scm.init(conf)
+        scm.start()
+        art = tmp_path / "model.bin"
+        art.write_bytes(b"weights" * 1000)
+        c = SharedCacheClient(("127.0.0.1", scm.port),
+                              cluster.default_fs, conf)
+        p1 = c.use(str(art), "app_1")
+        c.close()
+        scm.stop()
+        # a fresh SCM recovers the store by scanning
+        scm2 = SharedCacheManager(conf, cluster.default_fs)
+        scm2.init(conf)
+        scm2.start()
+        try:
+            assert scm2.stats()["entries"] == 1
+            c2 = SharedCacheClient(("127.0.0.1", scm2.port),
+                                   cluster.default_fs, conf)
+            assert c2.use(str(art), "app_9") == p1  # hit, no re-upload
+            c2.close()
+        finally:
+            scm2.stop()

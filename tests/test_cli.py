@@ -134,3 +134,15 @@ def test_generic_options_and_version(capsys):
     assert rest == ["-ls", "/"]
     assert main(["version"]) == 0
     assert "hadoop-tpu" in capsys.readouterr().out
+
+
+def test_cli_dispatches_tools(capsys):
+    from hadoop_tpu.cli.main import main
+    assert main(["help"]) == 0
+    assert "distcp" in capsys.readouterr().out
+    assert main(["sls", "--nodes", "5", "--apps", "2",
+                 "--containers", "3", "--ticks", "100"]) == 0
+    out = capsys.readouterr().out
+    import json
+    assert json.loads(out.strip().splitlines()[-1])["unfinished_apps"] == 0
+    assert main(["nope"]) == 1
