@@ -58,6 +58,18 @@ class DistributedFileSystem(FileSystem):
     def create_encryption_zone(self, path: str, key_name: str) -> bool:
         return self.client.nn.create_encryption_zone(path, key_name)
 
+    # ---------------------------------------------------- centralized cache
+
+    def add_cache_directive(self, path: str) -> int:
+        return self.client.nn.add_cache_directive(path)
+
+    def remove_cache_directive(self, directive_id: int) -> bool:
+        return self.client.nn.remove_cache_directive(directive_id)
+
+    def list_cache_directives(self):
+        return {int(k): v
+                for k, v in self.client.nn.list_cache_directives().items()}
+
     def get_encryption_info(self, path: str):
         return self.client.nn.get_encryption_info(path)
 

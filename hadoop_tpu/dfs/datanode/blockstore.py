@@ -133,6 +133,11 @@ class BlockStore:
         # durability comes from 3-way replication, not per-block fsync;
         # fsync per finalize costs ~3x write throughput on ext4.
         self.sync_on_close = sync_on_close
+        # Centralized-cache pinning (ref: fsdataset/impl/FsDatasetCache
+        # .java — mmap+mlock there; resident bytes here): block id →
+        # in-memory copy served by read_chunks without touching disk.
+        self._cached: Dict[int, bytes] = {}
+        self.max_cache_bytes = 64 * 1024 * 1024
         # Advertised capacity for shared volumes / simulated heterogeneity
         # (ref: dfs.datanode.du.reserved + SimulatedFSDataset's capacity).
         self.capacity_override = capacity_override
@@ -292,9 +297,44 @@ class BlockStore:
                 f.read(DataChecksum.HEADER_LEN))
         return data_path, meta_path, checksum, rep.num_bytes
 
+    def cache_block(self, block: Block) -> bool:
+        """Pin a finalized replica's bytes in memory (ref: FsDatasetCache
+        .cacheBlock). False when over the cache budget or not present."""
+        with self._lock:
+            if block.block_id in self._cached:
+                return True
+            used = sum(len(v) for v in self._cached.values())
+        try:
+            data_path, _, _, visible = self.open_for_read(block)
+        except IOError:
+            return False
+        if used + visible > self.max_cache_bytes:
+            return False
+        with open(data_path, "rb") as f:
+            data = f.read(visible)
+        with self._lock:
+            self._cached[block.block_id] = data
+        return True
+
+    def uncache_block(self, block_id: int) -> bool:
+        with self._lock:
+            return self._cached.pop(block_id, None) is not None
+
+    def cached_ids(self) -> List[int]:
+        with self._lock:
+            return list(self._cached)
+
     def read_chunks(self, block: Block, offset: int, length: int):
         """Yield (chunk_aligned_offset, data, sums) runs for a byte range,
-        chunk-aligned so the reader can CRC-verify. Ref: BlockSender.java."""
+        chunk-aligned so the reader can CRC-verify; cached (memory-pinned)
+        replicas serve data without touching the data file.
+        Ref: BlockSender.java."""
+        with self._lock:
+            pinned = self._cached.get(block.block_id)
+        if pinned is not None:
+            yield from self._read_chunks_cached(block, offset, length,
+                                                pinned)
+            return
         data_path, meta_path, checksum, visible = self.open_for_read(block)
         bpc = checksum.bytes_per_chunk
         start = (offset // bpc) * bpc
@@ -315,6 +355,28 @@ class BlockStore:
                 yield pos, data, sums
                 pos += len(data)
                 if len(data) < n:
+                    break
+
+    def _read_chunks_cached(self, block: Block, offset: int, length: int,
+                            pinned: bytes):
+        _, meta_path, checksum, visible = self.open_for_read(block)
+        bpc = checksum.bytes_per_chunk
+        start = (offset // bpc) * bpc
+        end = min(visible, len(pinned), offset + length)
+        meta_header = 4 + 8 + DataChecksum.HEADER_LEN
+        with open(meta_path, "rb") as mf:
+            pos = start
+            while pos < end:
+                n = min(1024 * 1024, end - pos)
+                n = min(((n + bpc - 1) // bpc) * bpc, len(pinned) - pos)
+                data = pinned[pos:pos + n]
+                first_chunk = pos // bpc
+                n_chunks = (len(data) + bpc - 1) // bpc
+                mf.seek(meta_header + 4 * first_chunk)
+                sums = mf.read(4 * n_chunks)
+                yield pos, data, sums
+                pos += len(data)
+                if not data:
                     break
 
     # ------------------------------------------------------------ inventory

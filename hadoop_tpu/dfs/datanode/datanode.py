@@ -129,6 +129,9 @@ class DataNode(AbstractService):
             "dfs.datanode.scan.period", 3 * 3600.0)
         self.dir_scan_interval = conf.get_time_seconds(
             "dfs.datanode.directoryscan.interval", 6 * 3600.0)
+        # ref: dfs.datanode.max.locked.memory
+        self.store.max_cache_bytes = conf.get_size_bytes(
+            "dfs.datanode.max.locked.memory", 64 * 1024 * 1024)
         self._client = Client(conf)
 
     def service_start(self) -> None:
@@ -188,6 +191,16 @@ class DataNode(AbstractService):
             actor.note_deleted(block)
 
     # -------------------------------------------------------------- scanners
+
+    def _report_cached(self) -> None:
+        ids = self.store.cached_ids()
+        for actor in self._actors:
+            try:
+                if actor._proxy is not None:
+                    actor._proxy.report_cached(self.uuid, ids)
+            except Exception as e:  # noqa: BLE001
+                log.debug("cache report to %s failed: %s",
+                          actor.nn_addr, e)
 
     def _report_bad_block(self, block: Block) -> None:
         """Self-detected rot → every NN (ref: the VolumeScanner's
@@ -262,6 +275,17 @@ class DataNode(AbstractService):
         elif cmd.action == DnCommand.EC_RECONSTRUCT:
             Daemon(self._ec_reconstruct, "dn-ec-worker",
                    args=(cmd.extra,)).start()
+        elif cmd.action == DnCommand.CACHE:
+            # pin replicas in memory + report the new cached set (ref:
+            # FsDatasetCache.cacheBlock + DatanodeProtocol.cacheReport)
+            for b in cmd.blocks:
+                if not self.store.cache_block(b):
+                    log.info("could not cache %s (budget/missing)", b)
+            self._report_cached()
+        elif cmd.action == DnCommand.UNCACHE:
+            for b in cmd.blocks:
+                self.store.uncache_block(b.block_id)
+            self._report_cached()
         elif cmd.action == DnCommand.RECOVER:
             # Block recovery: bump the stamp and promote the rbw replica to
             # finalized at its current length, then report it.

@@ -43,7 +43,8 @@ class DatanodeDescriptor(DatanodeInfo):
 
     __slots__ = ("blocks", "invalidate_queue", "transfer_queue",
                  "recover_queue", "ec_queue", "xceiver_count",
-                 "network_location")
+                 "network_location", "cache_queue", "uncache_queue",
+                 "cached_blocks")
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
@@ -54,6 +55,9 @@ class DatanodeDescriptor(DatanodeInfo):
         self.ec_queue: List[Dict] = []  # EC_RECONSTRUCT payloads
         self.xceiver_count = 0
         self.network_location = "/default-pod"
+        self.cache_queue: List[Block] = []
+        self.uncache_queue: List[Block] = []
+        self.cached_blocks: Set[int] = set()
 
     def public_info(self) -> DatanodeInfo:
         info = DatanodeInfo(self.uuid, self.host, self.xfer_port,
@@ -200,6 +204,14 @@ class DatanodeManager:
                     DnCommand.TRANSFER,
                     blocks=[b for b, _ in work],
                     targets=[t for _, t in work]))
+            if node.cache_queue:
+                cmds.append(DnCommand(DnCommand.CACHE,
+                                      blocks=node.cache_queue[:32]))
+                del node.cache_queue[:32]
+            if node.uncache_queue:
+                cmds.append(DnCommand(DnCommand.UNCACHE,
+                                      blocks=node.uncache_queue[:32]))
+                del node.uncache_queue[:32]
             if node.recover_queue:
                 work = node.recover_queue[:10]
                 del node.recover_queue[:10]
@@ -780,8 +792,14 @@ class BlockManager:
                 # load within each distance class (sort is stable)
                 locs = self.dn_manager.topology.sort_by_distance(
                     reader_host, locs)
+            cached = self.cached_holders(info.block.block_id)
+            if cached:
+                # memory-resident replicas first (ref: cachedLocations)
+                locs = sorted(locs,
+                              key=lambda d: d.uuid not in cached)
             return LocatedBlock(info.block, locs, offset,
-                                corrupt=(not locs and bool(info.locations)))
+                                corrupt=(not locs and bool(info.locations)),
+                                cached_uuids=cached)
 
     def complete_block(self, block: Block) -> None:
         with self._lock:
@@ -790,6 +808,61 @@ class BlockManager:
                 info.under_construction = False
                 info.block.num_bytes = block.num_bytes
                 self._update_needed_locked(info)
+
+    # --------------------------------------------------------------- cache
+
+    def report_cached(self, uuid: str, cached_ids: List[int]) -> None:
+        """DN's full cached-set report (ref: DatanodeProtocol
+        cacheReport)."""
+        node = self.dn_manager.get(uuid)
+        if node is not None:
+            node.cached_blocks = set(cached_ids)
+
+    def cached_holders(self, block_id: int) -> List[str]:
+        with self._lock:
+            info = self._blocks.get(block_id)
+            if info is None:
+                return []
+            holders = info.locations - info.corrupt_replicas
+        out = []
+        for uuid in holders:
+            node = self.dn_manager.get(uuid)
+            if node is not None and block_id in node.cached_blocks:
+                out.append(uuid)
+        return out
+
+    def reconcile_cache(self, wanted_block_ids: Set[int]) -> None:
+        """CacheReplicationMonitor pass (ref: blockmanagement/
+        CacheReplicationMonitor.java): queue CACHE work for directive-
+        covered blocks with no cached replica, UNCACHE for cached blocks
+        no directive covers."""
+        with self._lock:
+            want = {bid: self._blocks.get(bid) for bid in wanted_block_ids}
+        for bid, info in want.items():
+            if info is None:
+                continue
+            holders = [u for u in (info.locations - info.corrupt_replicas)]
+            nodes = [self.dn_manager.get(u) for u in holders]
+            nodes = [n for n in nodes
+                     if n is not None
+                     and n.state == DatanodeInfo.STATE_LIVE]
+            if not nodes:
+                continue
+            if any(bid in n.cached_blocks
+                   or any(b.block_id == bid for b in n.cache_queue)
+                   for n in nodes):
+                continue
+            pick = min(nodes, key=lambda n: len(n.cached_blocks))
+            pick.cache_queue.append(info.block)
+        # uncache anything no directive wants
+        for node in list(self.dn_manager._nodes.values()):
+            for bid in list(node.cached_blocks):
+                if bid not in wanted_block_ids:
+                    with self._lock:
+                        info = self._blocks.get(bid)
+                    if info is not None and not any(
+                            b.block_id == bid for b in node.uncache_queue):
+                        node.uncache_queue.append(info.block)
 
     def under_replicated_count(self) -> int:
         with self._lock:

@@ -58,6 +58,8 @@ OP_ALLOW_SNAPSHOT = "allow_snapshot"
 OP_DISALLOW_SNAPSHOT = "disallow_snapshot"
 OP_SET_STORAGE_POLICY = "set_storage_policy"
 OP_SET_EC_POLICY = "set_ec_policy"
+OP_ADD_CACHE_DIRECTIVE = "add_cache_directive"
+OP_REMOVE_CACHE_DIRECTIVE = "remove_cache_directive"
 
 
 class EditLogFaultInjector:

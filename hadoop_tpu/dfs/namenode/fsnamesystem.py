@@ -98,6 +98,10 @@ class FSNamesystem:
         self._gen_stamp = 1000          # ref: GenerationStamp
         self._id_lock = threading.Lock()
         self._pending_recovery: set = set()  # paths mid block-recovery
+        # Centralized cache directives (ref: namenode/CacheManager.java):
+        # id → path; the cache monitor reconciles DN state against them.
+        self.cache_directives: Dict[int, str] = {}
+        self._next_cache_id = 1
         self._snapshot_count = 0             # namespace-wide, for fast paths
         reg = metrics_system().source("namenode.ops")
         self._m = {name: reg.rate(name) for name in
@@ -121,6 +125,10 @@ class FSNamesystem:
             self._next_group_id = extra.get("next_group_id", self._next_group_id)
             self._gen_stamp = extra.get("gen_stamp", self._gen_stamp)
             self.leases.restore_from_image(extra.get("leases", {}))
+            self.cache_directives = {
+                int(k): v for k, v in
+                extra.get("cache_directives", {}).items()}
+            self._next_cache_id = extra.get("next_cache_id", 1)
         # Count image-loaded snapshots BEFORE replay: replayed
         # delete-snapshot ops consult the counter for pin checks.
         self._snapshot_count = sum(
@@ -197,6 +205,8 @@ class FSNamesystem:
             "next_group_id": self._next_group_id,
             "gen_stamp": self._gen_stamp,
             "leases": self.leases.snapshot_for_image(),
+            "cache_directives": dict(self.cache_directives),
+            "next_cache_id": self._next_cache_id,
         }
 
     def close(self) -> None:
@@ -791,6 +801,51 @@ class FSNamesystem:
 
     # --------------------------------------------------------------- xattrs
 
+    # ----------------------------------------------------- centralized cache
+
+    def add_cache_directive(self, path: str) -> int:
+        """Pin a file's blocks in DataNode memory (ref: namenode/
+        CacheManager.java addDirective; pools collapse to flat
+        directives). Returns the directive id."""
+        with self.lock.write():
+            node = self.fsdir.get_inode(path)
+            if node is None or not isinstance(node, INodeFile):
+                raise FileNotFoundError(path)
+            did = self._next_cache_id
+            self._next_cache_id += 1
+            self.cache_directives[did] = path
+            txid = self.editlog.log_edit(el.OP_ADD_CACHE_DIRECTIVE,
+                                         {"id": did, "p": path})
+        self.editlog.log_sync(txid)
+        log_audit_event(True, "addCacheDirective", path)
+        return did
+
+    def remove_cache_directive(self, directive_id: int) -> bool:
+        with self.lock.write():
+            gone = self.cache_directives.pop(directive_id, None)
+            if gone is None:
+                return False
+            txid = self.editlog.log_edit(el.OP_REMOVE_CACHE_DIRECTIVE,
+                                         {"id": directive_id})
+        self.editlog.log_sync(txid)
+        log_audit_event(True, "removeCacheDirective", gone)
+        return True
+
+    def list_cache_directives(self) -> Dict[int, str]:
+        with self.lock.read():
+            return dict(self.cache_directives)
+
+    def cache_monitor_pass(self) -> None:
+        """Reconcile DN cache state against the directives (ref:
+        CacheReplicationMonitor.rescan)."""
+        wanted: set = set()
+        with self.lock.read():
+            for path in self.cache_directives.values():
+                node = self.fsdir.get_inode(path)
+                if isinstance(node, INodeFile):
+                    wanted.update(b.block_id for b in node.blocks)
+        self.bm.reconcile_cache(wanted)
+
     # ------------------------------------------------------ encryption zones
 
     ZONE_XATTR = "system.crypto.zone"       # on the zone root: key name
@@ -1383,6 +1438,11 @@ class FSNamesystem:
             if isinstance(node, INodeDirectory):
                 node.ns_quota = rec.get("nq", -1)
                 node.space_quota = rec.get("sq", -1)
+        elif op == el.OP_ADD_CACHE_DIRECTIVE:
+            self.cache_directives[rec["id"]] = rec["p"]
+            self._next_cache_id = max(self._next_cache_id, rec["id"] + 1)
+        elif op == el.OP_REMOVE_CACHE_DIRECTIVE:
+            self.cache_directives.pop(rec["id"], None)
         elif op == el.OP_SET_XATTR:
             node = self.fsdir.get_inode(rec["p"])
             if node is not None:

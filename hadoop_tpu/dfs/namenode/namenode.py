@@ -48,7 +48,8 @@ WRITE_METHODS = frozenset({
     "renew_lease", "report_bad_blocks",
     # Namespace-feature mutations.
     "set_quota", "set_xattr", "remove_xattr", "set_acl", "remove_acl",
-    "create_encryption_zone",
+    "create_encryption_zone", "add_cache_directive",
+    "remove_cache_directive",
     "set_storage_policy", "allow_snapshot", "disallow_snapshot",
     "create_snapshot", "delete_snapshot", "rename_snapshot", "concat",
     "truncate",
@@ -169,6 +170,19 @@ class ClientProtocol:
     def remove_xattr(self, path: str, name: str) -> bool:
         self.fsn.remove_xattr(path, name)
         return True
+
+    def add_cache_directive(self, path: str) -> int:
+        """Ref: ClientProtocol.addCacheDirective."""
+        return self.fsn.add_cache_directive(path)
+
+    def remove_cache_directive(self, directive_id: int) -> bool:
+        return self.fsn.remove_cache_directive(directive_id)
+
+    @idempotent
+    def list_cache_directives(self) -> Dict[str, str]:
+        # wirepack map keys are strings
+        return {str(k): v
+                for k, v in self.fsn.list_cache_directives().items()}
 
     def create_encryption_zone(self, path: str, key_name: str) -> bool:
         """Ref: ClientProtocol.createEncryptionZone."""
@@ -358,6 +372,12 @@ class DatanodeProtocol:
             uuid, capacity, dfs_used, remaining, xceivers,
             issue_commands=self._state() == ha.ACTIVE)
         return [c.to_wire() for c in cmds]
+
+    @idempotent
+    def report_cached(self, uuid: str, block_ids: List[int]) -> bool:
+        """Ref: DatanodeProtocol.cacheReport."""
+        self.fsn.bm.report_cached(uuid, block_ids)
+        return True
 
     @idempotent
     def block_report(self, uuid: str, blocks: List[Dict]):
@@ -671,6 +691,7 @@ class NameNode(AbstractService):
                     self.fsn.bm.compute_reconstruction_work()
                     self.fsn.bm.dn_manager.check_admin_progress()
                     self.fsn.check_leases()
+                    self.fsn.cache_monitor_pass()
             except Exception:
                 log.exception("Redundancy monitor pass failed")
 

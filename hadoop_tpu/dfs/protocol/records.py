@@ -192,18 +192,22 @@ class LocatedBlock:
     ``indices[i]`` is the storage-unit index served by ``locations[i]``."""
 
     __slots__ = ("block", "locations", "offset", "corrupt", "ec_policy",
-                 "indices")
+                 "indices", "cached_uuids")
 
     def __init__(self, block: Block, locations: List[DatanodeInfo],
                  offset: int = 0, corrupt: bool = False,
                  ec_policy: Optional[str] = None,
-                 indices: Optional[List[int]] = None):
+                 indices: Optional[List[int]] = None,
+                 cached_uuids: Optional[List[str]] = None):
         self.block = block
         self.locations = locations
         self.offset = offset
         self.corrupt = corrupt
         self.ec_policy = ec_policy
         self.indices = indices
+        # replicas pinned in DN memory (ref: LocatedBlock's
+        # cachedLocations) — readers prefer these
+        self.cached_uuids = cached_uuids or []
 
     def to_wire(self) -> Dict:
         d = {"b": self.block.to_wire(),
@@ -212,6 +216,8 @@ class LocatedBlock:
         if self.ec_policy:
             d["ec"] = self.ec_policy
             d["idx"] = self.indices
+        if self.cached_uuids:
+            d["cach"] = self.cached_uuids
         return d
 
     @classmethod
@@ -219,7 +225,7 @@ class LocatedBlock:
         return cls(Block.from_wire(d["b"]),
                    [DatanodeInfo.from_wire(x) for x in d["locs"]],
                    d.get("off", 0), d.get("cor", False),
-                   d.get("ec"), d.get("idx"))
+                   d.get("ec"), d.get("idx"), d.get("cach"))
 
 
 class FileStatus:
@@ -281,6 +287,10 @@ class DnCommand:
     # receiving DN reads surviving units from peers, decodes, and stores
     # the missing unit locally. ``extra`` carries the reconstruction info.
     EC_RECONSTRUCT = "ec_reconstruct"
+    # Centralized cache (ref: DatanodeProtocol CACHE/UNCACHE in
+    # BlockIdCommandProto): pin/unpin block replicas in memory.
+    CACHE = "cache"
+    UNCACHE = "uncache"
 
     def __init__(self, action: str, blocks: Optional[List[Block]] = None,
                  targets: Optional[List[List[DatanodeInfo]]] = None,
