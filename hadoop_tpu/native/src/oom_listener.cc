@@ -1,0 +1,115 @@
+// htpu-oom-listener — cgroup OOM event watcher.
+//
+// Role parity with the reference's oom-listener (ref:
+// hadoop-yarn-server-nodemanager/src/main/native/oom-listener/impl/
+// oom_listener.c): the NM's elastic-memory controller runs this binary
+// against a container's memory cgroup; it blocks until the kernel
+// signals an OOM event and prints one line per event so the NM can pick
+// a victim instead of letting the kernel's OOM killer choose.
+//
+// cgroup v1: registers an eventfd on memory.oom_control via
+// cgroup.event_control. cgroup v2: polls memory.events for oom_kill
+// increments (no eventfd interface for OOM in v2 — inotify+read).
+//
+// Usage: htpu-oom-listener <cgroup-dir>
+//   prints "oom <count>" lines to stdout; exits 0 on cgroup removal,
+//   2 on usage error, 1 on setup failure.
+
+#include <errno.h>
+#include <fcntl.h>
+#include <poll.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/eventfd.h>
+#include <unistd.h>
+
+#include <string>
+
+namespace {
+
+bool exists(const std::string& p) { return access(p.c_str(), F_OK) == 0; }
+
+int watch_v1(const std::string& dir) {
+  int efd = eventfd(0, 0);
+  if (efd < 0) return 1;
+  int ocfd = open((dir + "/memory.oom_control").c_str(), O_RDONLY);
+  if (ocfd < 0) {
+    perror("open memory.oom_control");
+    return 1;
+  }
+  int ctl = open((dir + "/cgroup.event_control").c_str(), O_WRONLY);
+  if (ctl < 0) {
+    perror("open cgroup.event_control");
+    return 1;
+  }
+  char buf[64];
+  snprintf(buf, sizeof(buf), "%d %d", efd, ocfd);
+  if (write(ctl, buf, strlen(buf)) < 0) {
+    perror("register eventfd");
+    return 1;
+  }
+  close(ctl);
+  uint64_t total = 0;
+  while (true) {
+    uint64_t n = 0;
+    ssize_t r = read(efd, &n, sizeof(n));
+    if (r != sizeof(n)) break;
+    if (!exists(dir)) return 0;  // cgroup removed: clean exit
+    total += n;
+    printf("oom %llu\n", (unsigned long long)total);
+    fflush(stdout);
+  }
+  return 0;
+}
+
+long read_oom_kills(const std::string& dir) {
+  FILE* f = fopen((dir + "/memory.events").c_str(), "r");
+  if (!f) return -1;
+  char key[64];
+  long val = 0, out = 0;
+  while (fscanf(f, "%63s %ld", key, &val) == 2) {
+    if (strcmp(key, "oom_kill") == 0 || strcmp(key, "oom") == 0)
+      out += val;
+  }
+  fclose(f);
+  return out;
+}
+
+int watch_v2(const std::string& dir) {
+  long last = read_oom_kills(dir);
+  if (last < 0) return 1;
+  while (exists(dir)) {
+    usleep(200 * 1000);
+    long now = read_oom_kills(dir);
+    if (now < 0) return 0;
+    if (now > last) {
+      printf("oom %ld\n", now);
+      fflush(stdout);
+      last = now;
+    }
+  }
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 2) {
+    fprintf(stderr, "usage: %s <cgroup-dir>\n", argv[0]);
+    return 2;
+  }
+  std::string dir(argv[1]);
+  if (!exists(dir)) {
+    fprintf(stderr, "%s: no such cgroup\n", dir.c_str());
+    return 2;
+  }
+  if (exists(dir + "/memory.oom_control"))
+    return watch_v1(dir);
+  if (exists(dir + "/memory.events"))
+    return watch_v2(dir);
+  fprintf(stderr, "%s: neither v1 memory.oom_control nor v2 "
+          "memory.events present\n", dir.c_str());
+  return 1;
+}

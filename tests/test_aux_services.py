@@ -351,3 +351,40 @@ def test_shared_cache_survives_restart(tmp_path):
             c2.close()
         finally:
             scm2.stop()
+
+
+# --------------------------------------------------------- oom-listener
+
+
+def test_oom_listener_binary(tmp_path):
+    """The watcher binary builds and validates its inputs; the v2 polling
+    arm is exercised against a synthetic memory.events file (real cgroup
+    registration needs root — ref: oom-listener/test's same split)."""
+    import subprocess
+    import sys
+    binary = os.path.join(os.path.dirname(os.path.abspath(
+        __import__("hadoop_tpu.native", fromlist=["x"]).__file__)),
+        "htpu-oom-listener")
+    if not os.path.exists(binary):
+        pytest.skip("native toolchain unavailable")
+    assert subprocess.run([binary]).returncode == 2          # usage
+    assert subprocess.run([binary, "/nonexistent"]).returncode == 2
+    # synthetic v2 cgroup dir: oom_kill increments are reported
+    cg = tmp_path / "cg"
+    cg.mkdir()
+    (cg / "memory.events").write_text("low 0\noom 0\noom_kill 0\n")
+    proc = subprocess.Popen([binary, str(cg)], stdout=subprocess.PIPE,
+                            text=True)
+    try:
+        time.sleep(0.5)
+        (cg / "memory.events").write_text("low 0\noom 1\noom_kill 1\n")
+        line = proc.stdout.readline().strip()
+        assert line.startswith("oom ")
+        # cgroup removal -> clean exit
+        (cg / "memory.events").unlink()
+        import shutil
+        shutil.rmtree(cg)
+        assert proc.wait(timeout=10) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
