@@ -185,6 +185,48 @@ def _main(argv=None) -> int:
     if cmd == "registry":
         from hadoop_tpu.registry import RegistryServer
         return _run_daemon(RegistryServer(conf), conf)
+    if cmd == "cacheadmin":
+        # ref: hdfs cacheadmin — -addDirective/-listDirectives/-remove
+        from hadoop_tpu.fs import FileSystem
+        fs = FileSystem.get(conf.get("fs.defaultFS", "file:///"), conf)
+        try:
+            if rest[:1] == ["-addDirective"]:
+                print(fs.add_cache_directive(rest[1]))
+            elif rest[:1] == ["-removeDirective"]:
+                print(fs.remove_cache_directive(int(rest[1])))
+            elif rest[:1] == ["-listDirectives"] or not rest:
+                for did, path in sorted(
+                        fs.list_cache_directives().items()):
+                    print(f"{did}\t{path}")
+            else:
+                print("usage: cacheadmin -addDirective PATH | "
+                      "-removeDirective ID | -listDirectives",
+                      file=sys.stderr)
+                return 2
+        finally:
+            fs.close()
+        return 0
+    if cmd == "crypto":
+        # ref: hdfs crypto — -createZone/-listZones
+        from hadoop_tpu.fs import FileSystem
+        fs = FileSystem.get(conf.get("fs.defaultFS", "file:///"), conf)
+        try:
+            if rest[:1] == ["-createZone"]:
+                # -createZone -keyName K PATH
+                key = rest[rest.index("-keyName") + 1]
+                path = rest[-1]
+                print(fs.create_encryption_zone(path, key))
+            elif rest[:1] == ["-listZones"] or not rest:
+                for path, key in sorted(
+                        fs.list_encryption_zones().items()):
+                    print(f"{path}\t{key}")
+            else:
+                print("usage: crypto -createZone -keyName K PATH | "
+                      "-listZones", file=sys.stderr)
+                return 2
+        finally:
+            fs.close()
+        return 0
     if cmd == "distcp":
         from hadoop_tpu.tools.distcp import main as distcp_main
         return distcp_main(rest)

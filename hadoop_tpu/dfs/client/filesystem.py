@@ -73,6 +73,9 @@ class DistributedFileSystem(FileSystem):
     def get_encryption_info(self, path: str):
         return self.client.nn.get_encryption_info(path)
 
+    def list_encryption_zones(self):
+        return self.client.nn.list_encryption_zones()
+
     @classmethod
     def create_instance(cls, path: Path, conf: Configuration):
         if path.authority:

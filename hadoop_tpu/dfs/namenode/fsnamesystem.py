@@ -911,6 +911,21 @@ class FSNamesystem:
                 return node.xattrs[self.ZONE_XATTR].decode()
         return None
 
+    def list_encryption_zones(self) -> Dict[str, str]:
+        """path → key name for every zone root (ref:
+        FSDirEncryptionZoneOp.listEncryptionZones)."""
+        out: Dict[str, str] = {}
+        with self.lock.read():
+            def walk(node, path: str) -> None:
+                if node.xattrs and self.ZONE_XATTR in node.xattrs:
+                    out[path or "/"] = \
+                        node.xattrs[self.ZONE_XATTR].decode()
+                if isinstance(node, INodeDirectory):
+                    for name, child in node.children.items():
+                        walk(child, f"{path}/{name}")
+            walk(self.fsdir.root, "")
+        return out
+
     def get_encryption_info(self, path: str) -> Optional[Dict]:
         """The file's FileEncryptionInfo for clients (ref:
         FSDirEncryptionZoneOp.getFileEncryptionInfo): the EDEK + key

@@ -189,6 +189,10 @@ class ClientProtocol:
         return self.fsn.create_encryption_zone(path, key_name)
 
     @idempotent
+    def list_encryption_zones(self) -> Dict[str, str]:
+        return self.fsn.list_encryption_zones()
+
+    @idempotent
     def get_encryption_info(self, path: str) -> Optional[Dict]:
         """Ref: the FileEncryptionInfo returned with getFileInfo/open."""
         return self.fsn.get_encryption_info(path)

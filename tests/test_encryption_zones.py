@@ -107,3 +107,10 @@ def test_zone_survives_namenode_restart(stack):
     with fs2.create("/secure/after.bin") as out:
         out.write(b"post-restart")
     assert fs2.get_encryption_info("/secure/after.bin") is not None
+
+
+def test_list_encryption_zones(stack):
+    kms, cluster = stack
+    fs = cluster.get_filesystem()
+    zones = fs.list_encryption_zones()
+    assert zones.get("/secure") == "zone-key"
