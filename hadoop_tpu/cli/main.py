@@ -185,6 +185,33 @@ def _main(argv=None) -> int:
     if cmd == "registry":
         from hadoop_tpu.registry import RegistryServer
         return _run_daemon(RegistryServer(conf), conf)
+    if cmd == "job":
+        # ref: mapred job -list/-status/-kill
+        from hadoop_tpu.util.misc import parse_addr_list
+        from hadoop_tpu.yarn.client import YarnClient
+        from hadoop_tpu.yarn.records import ApplicationId
+        rm = parse_addr_list(conf.get("yarn.resourcemanager.address",
+                                      "127.0.0.1:8032"))[0]
+        yc = YarnClient(rm, conf)
+        try:
+            if rest[:1] == ["-list"] or not rest:
+                for rep in yc.list_applications():
+                    print(f"{rep.app_id}\t{rep.name}\t{rep.state}\t"
+                          f"{rep.queue}")
+            elif rest[:1] == ["-status"]:
+                rep = yc.application_report(ApplicationId.parse(rest[1]))
+                print(f"{rep.app_id} {rep.state} final={rep.final_status} "
+                      f"diag={rep.diagnostics!r}")
+            elif rest[:1] == ["-kill"]:
+                yc.kill_application(ApplicationId.parse(rest[1]))
+                print(f"killed {rest[1]}")
+            else:
+                print("usage: job -list | -status APPID | -kill APPID",
+                      file=sys.stderr)
+                return 2
+        finally:
+            yc.close()
+        return 0
     if cmd == "cacheadmin":
         # ref: hdfs cacheadmin — -addDirective/-listDirectives/-remove
         from hadoop_tpu.fs import FileSystem

@@ -146,3 +146,25 @@ def test_cli_dispatches_tools(capsys):
     import json
     assert json.loads(out.strip().splitlines()[-1])["unfinished_apps"] == 0
     assert main(["nope"]) == 1
+
+
+def test_cli_job_control(tmp_path, capsys):
+    from hadoop_tpu.cli.main import main
+    from hadoop_tpu.examples.wordcount import make_job
+    from hadoop_tpu.testing.minicluster import MiniMRYarnCluster
+    with MiniMRYarnCluster(num_nodes=2,
+                           base_dir=str(tmp_path / "c")) as cluster:
+        fs = cluster.get_filesystem()
+        fs.mkdirs("/ji")
+        fs.write_all("/ji/x.txt", b"a b\n")
+        job = make_job(cluster.rm_addr, cluster.default_fs, "/ji", "/jo")
+        assert job.wait_for_completion()
+        rm = f"127.0.0.1:{cluster.yarn.rm.port}"
+        assert main(["job", "-Dyarn.resourcemanager.address=" + rm,
+                     "-list"]) == 0
+        out = capsys.readouterr().out
+        assert "FINISHED" in out
+        app_id = out.split()[0]
+        assert main(["job", "-Dyarn.resourcemanager.address=" + rm,
+                     "-status", app_id]) == 0
+        assert "FINISHED" in capsys.readouterr().out
