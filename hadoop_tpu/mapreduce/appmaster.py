@@ -254,6 +254,27 @@ class MRAppMaster:
         amrm.register()
         maps = [t for t in self.tasks.values() if t.type == "map"]
         reduces = [t for t in self.tasks.values() if t.type == "reduce"]
+        if self._uber_eligible(maps, reduces):
+            ok = True
+            try:
+                self._run_uber(amrm, maps, reduces)
+            except Exception as e:  # noqa: BLE001
+                log.exception("uber job failed")
+                self.diagnostics.append(f"uber: {e}")
+                ok = False
+            status = "SUCCEEDED" if ok else "FAILED"
+            try:
+                self._commit_job(ok)
+            except Exception as e:  # noqa: BLE001
+                log.error("job commit failed: %s", e)
+                status, ok = "FAILED", False
+            amrm.unregister(status, "; ".join(self.diagnostics[:5]))
+            amrm.close()
+            nm.close()
+            self.umbilical_server.stop()
+            if self._history_fs is not None:
+                self._history_fs.close()
+            return 0 if ok else 1
         self._schedule(amrm, maps)
         reduces_scheduled = False
         ok = True
@@ -312,6 +333,77 @@ class MRAppMaster:
             if self._history_fs is not None:
                 self._history_fs.close()
         return 0 if ok else 1
+
+    # ---------------------------------------------------------------- uber
+
+    def _uber_eligible(self, maps, reduces) -> bool:
+        """Small jobs run inside the AM itself — no per-task containers
+        (ref: mapreduce.job.ubertask.enable + MRAppMaster.makeUberDecision:
+        maps ≤ maxmaps, reduces ≤ maxreduces)."""
+        jconf = self.job["conf"]
+        if jconf.get("mapreduce.job.ubertask.enable", "false") != "true":
+            return False
+        max_maps = int(jconf.get("mapreduce.job.ubertask.maxmaps", "9"))
+        max_reds = int(jconf.get("mapreduce.job.ubertask.maxreduces", "1"))
+        pending = [t for t in maps if not t.succeeded]
+        return len(pending) <= max_maps and len(reduces) <= max_reds
+
+    def _run_uber(self, amrm: AMRMClient, maps, reduces) -> None:
+        """Execute every task serially in this process (ref:
+        LocalContainerLauncher.EventHandler's subtask loop). A heartbeat
+        thread keeps the RM's AM-liveness fed while tasks run."""
+        from hadoop_tpu.mapreduce import task_runner
+        log.info("running UBER: %d maps, %d reduces in-process",
+                 len(maps), len(reduces))
+        um = TaskUmbilicalProtocol(self)
+        stop_hb = threading.Event()
+
+        def heartbeat():
+            while not stop_hb.is_set():
+                try:
+                    done = sum(1 for t in self.tasks.values()
+                               if t.succeeded)
+                    amrm.allocate(progress=done / max(len(self.tasks), 1))
+                except Exception:  # noqa: BLE001
+                    pass
+                stop_hb.wait(1.0)
+
+        hb = threading.Thread(target=heartbeat, daemon=True,
+                              name="uber-am-heartbeat")
+        hb.start()
+        try:
+            for task in list(maps) + list(reduces):
+                if task.succeeded:
+                    continue  # recovered from history
+                with self.lock:
+                    attempt = self._new_attempt_unassigned(task)
+                d = um.get_task(attempt.id)
+                counters = Counters()
+                reporter = task_runner._Reporter(um, attempt.id, counters)
+                if task.type == "map":
+                    addr = task_runner.run_map(self.job, d, um,
+                                               attempt.id, reporter)
+                else:
+                    task_runner.run_reduce(self.job, d, um, attempt.id,
+                                           reporter)
+                    addr = ""
+                reporter.stop()
+                um.done(attempt.id, counters.to_wire(), addr or "")
+                if not task.succeeded:
+                    raise RuntimeError(f"uber task {task.id} did not "
+                                       "complete")
+        finally:
+            stop_hb.set()
+
+    def _new_attempt_unassigned(self, task: _Task) -> _Attempt:
+        """Attempt bookkeeping for in-process (uber) execution — no
+        container. Caller holds the lock."""
+        aid = f"attempt_{task.id}_{task.next_attempt}"
+        task.next_attempt += 1
+        attempt = _Attempt(aid, task)
+        task.attempts[aid] = attempt
+        self.attempts[aid] = attempt
+        return attempt
 
     # ---------------------------------------------------------- allocation
 

@@ -96,3 +96,31 @@ from hadoop_tpu.mapreduce.api import Mapper  # noqa: E402
 class CrashingMapper(Mapper):
     def map(self, key, value, ctx):
         raise RuntimeError("boom!")
+
+
+def test_uber_mode_runs_job_inside_am(tmp_path):
+    """Small jobs run inside the AM — exactly one container (the AM
+    itself) is ever launched. Ref: mapreduce.job.ubertask.enable +
+    MRAppMaster.makeUberDecision / LocalContainerLauncher."""
+    import glob as _glob
+
+    from hadoop_tpu.examples.wordcount import make_job
+    from hadoop_tpu.testing.minicluster import MiniMRYarnCluster
+    with MiniMRYarnCluster(num_nodes=2,
+                           base_dir=str(tmp_path / "c")) as cluster:
+        fs = cluster.get_filesystem()
+        fs.mkdirs("/uin")
+        fs.write_all("/uin/a.txt", b"x y x\nz x\n")
+        job = make_job(cluster.rm_addr, cluster.default_fs, "/uin",
+                       "/uout")
+        job.set_num_reduces(1)  # uber allows at most maxreduces=1
+        job.set("mapreduce.job.ubertask.enable", "true")
+        assert job.wait_for_completion(), job.diagnostics
+        out = b"".join(fs.read_all(s.path)
+                       for s in fs.list_status("/uout")
+                       if "part-" in s.path)
+        rows = dict(l.split(b"\t") for l in out.splitlines() if l)
+        assert rows[b"x"] == b"3" and rows[b"z"] == b"1"
+        containers = _glob.glob(str(tmp_path / "c" / "yarn" / "nm*" /
+                                    "container_*"))
+        assert len(containers) == 1, containers  # only the AM container
