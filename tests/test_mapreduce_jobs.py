@@ -123,4 +123,6 @@ def test_uber_mode_runs_job_inside_am(tmp_path):
         assert rows[b"x"] == b"3" and rows[b"z"] == b"1"
         containers = _glob.glob(str(tmp_path / "c" / "yarn" / "nm*" /
                                     "container_*"))
-        assert len(containers) == 1, containers  # only the AM container
+        # at most the AM's own container (which the NM may have already
+        # cleaned up after job completion) — never task containers
+        assert len(containers) <= 1, containers
