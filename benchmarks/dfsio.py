@@ -34,6 +34,13 @@ def run(n_files: int = 4, mb_per_file: int = 16, replication: int = 2,
     # a 64 MB stream would pay 64 block allocations + pipeline setups).
     conf = Configuration(load_defaults=False)
     conf.set("dfs.blocksize", "64m")
+    # Load-tolerant liveness (same rationale as terasort_bench): the
+    # minicluster's sub-second dead detection misfires under benchmark
+    # load and the resulting re-replication churn wrecks the measurement.
+    conf.set("dfs.heartbeat.interval", "0.5s")
+    conf.set("dfs.namenode.heartbeat.recheck-interval", "5s")
+    # Bulk streaming amortizes the per-packet thread-handoff chain.
+    conf.set("dfs.client-write-packet-size", str(4 * 1024 * 1024))
     base = bench_base_dir("dfsio")
     cluster = MiniDFSCluster(num_datanodes=num_datanodes, conf=conf,
                              base_dir=base)

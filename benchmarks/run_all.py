@@ -18,7 +18,15 @@ def main() -> None:
                     help="smaller sizes for smoke runs")
     args = ap.parse_args()
 
+    import sys
+
     from benchmarks import dfsio, nn_throughput, rpc_bench, terasort_bench
+
+    # The whole "cluster" shares one interpreter here, so a packet's hop
+    # chain is a chain of GIL handoffs; the default 5 ms switch interval
+    # adds up to 15 ms/packet of scheduling latency on a ~3 ms work path.
+    # Real deployments run one process per daemon and never see this.
+    sys.setswitchinterval(0.001)
 
     scale = 0.2 if args.quick else 1.0
     out = {"timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),

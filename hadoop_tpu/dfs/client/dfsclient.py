@@ -102,7 +102,14 @@ class DFSClient:
             from hadoop_tpu.dfs.client.striped import DFSStripedOutputStream
             stream = DFSStripedOutputStream(self, path, st.ec_policy)
         else:
-            stream = DFSOutputStream(self, path)
+            # ref: dfs.client-write-packet-size (DfsClientConf). The
+            # reference defaults to 64 KB against spinning-disk-era acks;
+            # here the per-packet cost is a Python thread handoff chain,
+            # so the default is 1 MB and bulk writers can raise it.
+            from hadoop_tpu.dfs.protocol import datatransfer as _dt
+            pkt = self.conf.get_int(
+                "dfs.client-write-packet-size", _dt.PACKET_SIZE)
+            stream = DFSOutputStream(self, path, packet_size=pkt)
         orig_close = stream.close
 
         def close_and_release():

@@ -110,11 +110,29 @@ class DataNode(AbstractService):
     # ------------------------------------------------------------- lifecycle
 
     def service_init(self, conf: Configuration) -> None:
-        self.store = BlockStore(
-            os.path.join(self.data_dir, "current"),
-            capacity_override=conf.get_size_bytes(
-                "dfs.datanode.capacity", 0),
-            sync_on_close=conf.get_bool("dfs.datanode.synconclose", False))
+        # Multi-volume node when dfs.datanode.data.dirs lists several
+        # directories (ref: dfs.datanode.data.dir is a comma list backing
+        # FsVolumeList); single-volume stays on the plain BlockStore.
+        extra_dirs = [d for d in conf.get(
+            "dfs.datanode.data.dirs", "").split(",") if d.strip()]
+        n_vols = conf.get_int("dfs.datanode.volumes", 1)
+        if not extra_dirs and n_vols > 1:
+            extra_dirs = [os.path.join(self.data_dir, f"current{i}")
+                          for i in range(n_vols)]
+        cap = conf.get_size_bytes("dfs.datanode.capacity", 0)
+        sync = conf.get_bool("dfs.datanode.synconclose", False)
+        if len(extra_dirs) > 1:
+            from hadoop_tpu.dfs.datanode.volumes import VolumeSet
+            self.store = VolumeSet(
+                [d.strip() for d in extra_dirs], capacity_override=cap,
+                sync_on_close=sync,
+                policy=conf.get("dfs.datanode.volume-choosing-policy",
+                                "available-space"))
+        else:
+            self.store = BlockStore(
+                extra_dirs[0].strip() if extra_dirs
+                else os.path.join(self.data_dir, "current"),
+                capacity_override=cap, sync_on_close=sync)
         self.xceiver = DataXceiverServer(
             self.store, self._on_block_received, bind_host=self.host,
             port=conf.get_int("dfs.datanode.port", 0))
@@ -146,6 +164,8 @@ class DataNode(AbstractService):
                 daemon_name=f"datanode-{self.uuid[:8]}")
             self.http.add_handler(
                 "/blockstats", lambda q, b: (200, self.store.stats()))
+            self.http.add_handler(
+                "/diskbalancer", self._diskbalancer_endpoint)
             self.http.start()
         for addr in self.nn_addrs:
             actor = _BPServiceActor(self, addr)
@@ -189,6 +209,25 @@ class DataNode(AbstractService):
     def _on_block_deleted(self, block: Block) -> None:
         for actor in self._actors:
             actor.note_deleted(block)
+
+    def _diskbalancer_endpoint(self, query, body):
+        """report/plan/execute over the admin HTTP surface (the reference
+        drives these over ClientDatanodeProtocol:
+        submitDiskBalancerPlan/queryDiskBalancerPlan)."""
+        from hadoop_tpu.dfs.datanode.volumes import DiskBalancer, VolumeSet
+        action = query.get("action", "report")
+        if not isinstance(self.store, VolumeSet):
+            return 400, {"error": "not a multi-volume datanode"}
+        db = DiskBalancer(self.store)
+        if action == "report":
+            return 200, db.report()
+        threshold = float(query.get("threshold", 0.10))
+        plan = db.plan(threshold)
+        if action == "plan":
+            return 200, {"moves": plan}
+        if action == "execute":
+            return 200, db.execute(plan)
+        return 400, {"error": f"unknown action {action!r}"}
 
     # -------------------------------------------------------------- scanners
 

@@ -43,6 +43,11 @@ class DataXceiverServer:
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._lsock.bind((bind_host, port))
         self._lsock.listen(128)
+        # Closing a listening socket does NOT wake a thread blocked in
+        # accept(2) on Linux; a periodic timeout lets the accept loop see
+        # _running flip and exit instead of leaking (accepted sockets are
+        # unaffected — they come back in blocking mode).
+        self._lsock.settimeout(0.5)
         self.port = self._lsock.getsockname()[1]
         self._running = False
         self.active_xceivers = 0
@@ -78,6 +83,8 @@ class DataXceiverServer:
         while self._running:
             try:
                 sock, addr = self._lsock.accept()
+            except socket.timeout:
+                continue
             except OSError:
                 return
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)

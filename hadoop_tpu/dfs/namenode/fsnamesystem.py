@@ -102,6 +102,8 @@ class FSNamesystem:
         # id → path; the cache monitor reconciles DN state against them.
         self.cache_directives: Dict[int, str] = {}
         self._next_cache_id = 1
+        from hadoop_tpu.dfs.namenode.sps import StoragePolicySatisfier
+        self.sps = StoragePolicySatisfier(self)
         self._snapshot_count = 0             # namespace-wide, for fast paths
         reg = metrics_system().source("namenode.ops")
         self._m = {name: reg.rate(name) for name in

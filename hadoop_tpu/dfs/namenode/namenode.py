@@ -213,6 +213,11 @@ class ClientProtocol:
         self.fsn.set_storage_policy(path, policy)
         return True
 
+    def satisfy_storage_policy(self, path: str) -> bool:
+        """Queue path for the in-NN StoragePolicySatisfier.
+        Ref: ClientProtocol.satisfyStoragePolicy."""
+        return self.fsn.sps.satisfy(path)
+
     @idempotent
     def get_storage_policy(self, path: str) -> str:
         return self.fsn.get_storage_policy(path)
@@ -696,6 +701,7 @@ class NameNode(AbstractService):
                     self.fsn.bm.dn_manager.check_admin_progress()
                     self.fsn.check_leases()
                     self.fsn.cache_monitor_pass()
+                    self.fsn.sps.pass_once()
             except Exception:
                 log.exception("Redundancy monitor pass failed")
 

@@ -125,6 +125,7 @@ class Server:
         self._lsock: Optional[socket.socket] = None
         self.port = 0
         self._running = False
+        self._stopped = threading.Event()  # prompt connmgr shutdown
         self._threads: List[threading.Thread] = []
         self._readers: List["_Reader"] = []
         self._responder: Optional["_Responder"] = None
@@ -175,8 +176,12 @@ class Server:
                     raise
                 time.sleep(0.1)
         self._lsock.listen(256)
+        # close() won't wake a blocked accept(2); timeout so the listener
+        # polls _running and exits on stop instead of leaking.
+        self._lsock.settimeout(0.5)
         self.port = self._lsock.getsockname()[1]
         self._running = True
+        self._stopped.clear()
 
         self._responder = _Responder(self)
         self._threads.append(Daemon(self._responder.run, f"{self.name}-responder"))
@@ -196,6 +201,7 @@ class Server:
 
     def stop(self) -> None:
         self._running = False
+        self._stopped.set()
         if self._lsock:
             try:
                 self._lsock.close()
@@ -227,6 +233,8 @@ class Server:
         while self._running:
             try:
                 sock, addr = self._lsock.accept()
+            except socket.timeout:
+                continue
             except OSError:
                 return
             sock.setblocking(False)
@@ -412,7 +420,8 @@ class Server:
         """Close idle connections. Ref: Server.ConnectionManager
         (Server.java:3654)."""
         while self._running:
-            time.sleep(min(10.0, self.max_idle_s / 2))
+            if self._stopped.wait(min(10.0, self.max_idle_s / 2)):
+                return
             cutoff = time.monotonic() - self.max_idle_s
             with self._conns_lock:
                 idle = [c for c in self._conns.values()

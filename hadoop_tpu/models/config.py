@@ -79,6 +79,14 @@ PRESETS = {
                                  d_model=1024, n_layers=24, n_heads=16,
                                  n_kv_heads=8, d_ff=2816, max_seq=2048,
                                  rope_theta=500000.0),
+    # wider flagship (~1B): d_model 2048 lifts the single-chip MXU
+    # ceiling from ~0.74 (d=1024 contractions) to ~0.90 measured on the
+    # v5e; sized so params+grads+fp32 AdamW moments (~12 GB) plus
+    # full-remat activations still fit 15.75 GB HBM
+    "flagship-1b": ModelConfig(family="llama", vocab_size=32768,
+                               d_model=2048, n_layers=18, n_heads=16,
+                               n_kv_heads=8, d_ff=5632, max_seq=2048,
+                               rope_theta=500000.0),
     # tiny configs for tests and the multi-chip dryrun
     "tiny": ModelConfig(family="llama", vocab_size=256, d_model=64,
                         n_layers=4, n_heads=4, n_kv_heads=2, d_ff=128,
