@@ -36,22 +36,35 @@ def peak_flops(device) -> float:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--preset", default="flagship-420m")
+    ap.add_argument("--preset", default="flagship-1b")
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=2)
-    # Default = the measured-best verified config on the v5e (27.2k tok/s,
-    # MFU 0.333 at batch 4 + full remat). Sweeps this round found batch 8/16
-    # SLOWER (24-25k) and remat="dots" both OOM-prone at batch>4 and
-    # pathologically slow to compile on the tunneled backend, so the
-    # conservative verified point stays the default.
+    # Default = the measured-best verified config on the v5e: the ~1B
+    # flagship at batch 4 + full remat (MFU 0.527). The old 420M flagship
+    # capped at MFU ~0.34 regardless of batch/remat because its d=1024
+    # contractions only reach ~0.74 of MXU peak (vs ~0.90 at d=2048 —
+    # measured with plain jit matmul chains); remat="none" OOMs at 1B and
+    # remat="dots" fails to compile there on the tunneled backend.
     ap.add_argument("--remat", default="full",
                     choices=["none", "full", "dots"])
     args = ap.parse_args()
     remat = {"none": False, "full": True, "dots": "dots"}[args.remat]
 
+    import os
+
     import jax
+
+    # Persistent compile cache: the ~1B step takes minutes to compile on
+    # the tunneled backend and every bench invocation is a fresh process.
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax: cache simply stays off
     import jax.numpy as jnp
     from hadoop_tpu.models import count_params, get_config
     from hadoop_tpu.parallel import MeshPlan, make_mesh

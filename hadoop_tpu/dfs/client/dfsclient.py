@@ -107,7 +107,7 @@ class DFSClient:
             # here the per-packet cost is a Python thread handoff chain,
             # so the default is 1 MB and bulk writers can raise it.
             from hadoop_tpu.dfs.protocol import datatransfer as _dt
-            pkt = self.conf.get_int(
+            pkt = self.conf.get_size_bytes(
                 "dfs.client-write-packet-size", _dt.PACKET_SIZE)
             stream = DFSOutputStream(self, path, packet_size=pkt)
         orig_close = stream.close

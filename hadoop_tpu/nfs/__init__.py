@@ -1,0 +1,14 @@
+"""NFS support: generic ONC-RPC/XDR library + the NFSv3 DFS gateway.
+
+Counterparts: hadoop-common-project/hadoop-nfs (the protocol library —
+org.apache.hadoop.oncrpc, org.apache.hadoop.portmap) and
+hadoop-hdfs-project/hadoop-hdfs-nfs (the gateway —
+org.apache.hadoop.hdfs.nfs.nfs3.RpcProgramNfs3).
+"""
+
+from hadoop_tpu.nfs.oncrpc import (Portmap, RpcCall, RpcProgram,
+                                   RpcTcpServer, SimpleRpcClient)
+from hadoop_tpu.nfs.nfs3 import Mountd, Nfs3Gateway, NfsGateway
+
+__all__ = ["RpcTcpServer", "RpcProgram", "RpcCall", "Portmap",
+           "SimpleRpcClient", "Nfs3Gateway", "Mountd", "NfsGateway"]

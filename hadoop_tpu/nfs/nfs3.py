@@ -1,0 +1,635 @@
+"""NFSv3 gateway: RFC 1813 procedures over the FileSystem SPI.
+
+Counterpart of hadoop-hdfs-nfs org.apache.hadoop.hdfs.nfs.nfs3:
+RpcProgramNfs3 (procedure dispatch), RpcProgramMountd (MOUNT v3),
+OpenFileCtx (sequential-write reordering buffer — NFS clients issue
+offset-addressed WRITEs but the DFS write path is append-only, so
+out-of-order writes ahead of the append cursor are parked until the
+gap fills), Nfs3Utils (fattr3 marshalling).
+
+File handles are 8-byte ids minted per path by the gateway (the
+reference embeds the HDFS inode fileId; this namespace keeps a
+gateway-side id↔path map, updated by RENAME/REMOVE through the
+gateway).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from hadoop_tpu.nfs.oncrpc import (Portmap, RpcCall, RpcProgram,
+                                   RpcTcpServer, proc_unavailable)
+from hadoop_tpu.nfs.xdr import XdrDecoder, XdrEncoder
+
+log = logging.getLogger(__name__)
+
+NFS_PROGRAM = 100003
+NFS_VERSION = 3
+MOUNT_PROGRAM = 100005
+MOUNT_VERSION = 3
+
+# nfsstat3
+NFS3_OK = 0
+NFS3ERR_PERM = 1
+NFS3ERR_NOENT = 2
+NFS3ERR_IO = 5
+NFS3ERR_EXIST = 17
+NFS3ERR_NOTDIR = 20
+NFS3ERR_ISDIR = 21
+NFS3ERR_INVAL = 22
+NFS3ERR_NOTEMPTY = 66
+NFS3ERR_STALE = 70
+NFS3ERR_NOTSUPP = 10004
+
+NF3REG = 1
+NF3DIR = 2
+
+_WRITE_BUFFER_LIMIT = 8 * 1024 * 1024
+
+
+class FileHandleMap:
+    """Stable 8-byte handles for paths (ref: the fileId inside the
+    reference's FileHandle)."""
+
+    def __init__(self):
+        self._by_path: Dict[str, int] = {}
+        self._by_id: Dict[int, str] = {}
+        self._next = 2  # 1 is the export root
+        self._lock = threading.Lock()
+
+    def fh_of(self, path: str) -> bytes:
+        with self._lock:
+            fid = self._by_path.get(path)
+            if fid is None:
+                fid = self._next
+                self._next += 1
+                self._by_path[path] = fid
+                self._by_id[fid] = path
+        return fid.to_bytes(8, "big")
+
+    def path_of(self, fh: bytes) -> Optional[str]:
+        with self._lock:
+            return self._by_id.get(int.from_bytes(fh, "big"))
+
+    def id_of(self, path: str) -> int:
+        self.fh_of(path)
+        with self._lock:
+            return self._by_path[path]
+
+    def renamed(self, src: str, dst: str) -> None:
+        with self._lock:
+            fid = self._by_path.pop(src, None)
+            if fid is not None:
+                old_dst = self._by_path.pop(dst, None)
+                if old_dst is not None:
+                    self._by_id.pop(old_dst, None)
+                self._by_path[dst] = fid
+                self._by_id[fid] = dst
+
+    def removed(self, path: str) -> None:
+        with self._lock:
+            fid = self._by_path.pop(path, None)
+            if fid is not None:
+                self._by_id.pop(fid, None)
+
+
+class OpenFileCtx:
+    """Sequential-write reassembly for one file (ref: OpenFileCtx.java —
+    its nonSequentialWriteInMemory buffer does exactly this)."""
+
+    def __init__(self, stream):
+        self.stream = stream
+        self.offset = 0                       # append cursor
+        self.pending: Dict[int, bytes] = {}   # offset → parked data
+        self.pending_bytes = 0
+        self.last_activity = time.monotonic()
+        self.lock = threading.Lock()
+
+    def write(self, offset: int, data: bytes) -> int:
+        """Returns an nfsstat3. Retransmits below the cursor succeed."""
+        with self.lock:
+            self.last_activity = time.monotonic()
+            if offset < self.offset:
+                return NFS3_OK  # idempotent retransmit of written bytes
+            if offset > self.offset:
+                if self.pending_bytes + len(data) > _WRITE_BUFFER_LIMIT:
+                    return NFS3ERR_IO
+                self.pending[offset] = data
+                self.pending_bytes += len(data)
+                return NFS3_OK
+            self.stream.write(data)
+            self.offset += len(data)
+            while self.offset in self.pending:
+                nxt = self.pending.pop(self.offset)
+                self.pending_bytes -= len(nxt)
+                self.stream.write(nxt)
+                self.offset += len(nxt)
+            return NFS3_OK
+
+    def close(self) -> int:
+        with self.lock:
+            stat = NFS3_OK if not self.pending else NFS3ERR_IO
+            try:
+                self.stream.close()
+            except (OSError, IOError):
+                stat = NFS3ERR_IO
+            self.pending.clear()
+            self.pending_bytes = 0
+            return stat
+
+
+class Nfs3Gateway(RpcProgram):
+    program = NFS_PROGRAM
+    version = NFS_VERSION
+    name = "nfs3"
+
+    def __init__(self, fs, export: str = "/"):
+        self.fs = fs
+        self.export = export.rstrip("/") or "/"
+        self.handles = FileHandleMap()
+        self.root_fh = self.handles.fh_of(self.export)
+        self._open_writes: Dict[str, OpenFileCtx] = {}
+        self._ow_lock = threading.Lock()
+
+    # ------------------------------------------------------------ plumbing
+
+    def _fattr3(self, e: XdrEncoder, path: str, st) -> None:
+        is_dir = st.is_dir
+        e.u32(NF3DIR if is_dir else NF3REG)
+        e.u32((st.permission or (0o755 if is_dir else 0o644)) & 0o7777)
+        e.u32(2 if is_dir else 1)              # nlink
+        e.u32(0).u32(0)                        # uid, gid
+        size = 0 if is_dir else st.length
+        e.u64(size).u64(size)                  # size, used
+        e.u32(0).u32(0)                        # rdev
+        e.u64(1)                               # fsid
+        e.u64(self.handles.id_of(path))        # fileid
+        for t in (st.atime or st.mtime, st.mtime, st.mtime):
+            e.u32(int(t)).u32(int((t % 1) * 1e9))
+
+    def _post_op_attr(self, e: XdrEncoder, path: str) -> None:
+        try:
+            st = self.fs.get_file_status(path)
+        except (FileNotFoundError, IOError):
+            e.boolean(False)
+            return
+        e.boolean(True)
+        self._fattr3(e, path, st)
+
+    def _resolve(self, fh: bytes) -> Optional[str]:
+        return self.handles.path_of(fh)
+
+    def _child(self, dir_path: str, name: str) -> str:
+        if name in (".", ""):
+            return dir_path
+        if name == "..":
+            parent = dir_path.rsplit("/", 1)[0]
+            return parent or "/"
+        base = dir_path.rstrip("/")
+        return f"{base}/{name}"
+
+    def _err(self, stat: int, wcc_path: Optional[str] = None) -> bytes:
+        e = XdrEncoder()
+        e.u32(stat)
+        if wcc_path is not None:
+            e.boolean(False)       # pre_op_attr
+            self._post_op_attr(e, wcc_path)
+        else:
+            e.boolean(False)       # absent post_op_attr
+        return e.getvalue()
+
+    def _ctx_for(self, path: str, create: bool) -> Optional[OpenFileCtx]:
+        with self._ow_lock:
+            ctx = self._open_writes.get(path)
+            if ctx is None and create:
+                stream = self.fs.create(path, overwrite=True)
+                ctx = OpenFileCtx(stream)
+                self._open_writes[path] = ctx
+            return ctx
+
+    def _close_write(self, path: str) -> int:
+        with self._ow_lock:
+            ctx = self._open_writes.pop(path, None)
+        return ctx.close() if ctx is not None else NFS3_OK
+
+    # ----------------------------------------------------------- dispatch
+
+    def handle(self, call: RpcCall) -> bytes:
+        proc = call.proc
+        x = call.args
+        if proc == 0:                                   # NULL
+            return b""
+        table = {1: self._getattr, 2: self._setattr, 3: self._lookup,
+                 4: self._access, 6: self._read, 7: self._write,
+                 8: self._create, 9: self._mkdir, 12: self._remove,
+                 13: self._rmdir, 14: self._rename, 16: self._readdir,
+                 17: self._readdirplus, 18: self._fsstat, 19: self._fsinfo,
+                 20: self._pathconf, 21: self._commit}
+        fn = table.get(proc)
+        if fn is None:
+            raise proc_unavailable()
+        return fn(x)
+
+    # --------------------------------------------------------- procedures
+
+    def _getattr(self, x: XdrDecoder) -> bytes:
+        path = self._resolve(x.opaque())
+        e = XdrEncoder()
+        if path is None:
+            return e.u32(NFS3ERR_STALE).getvalue()
+        try:
+            st = self.fs.get_file_status(path)
+        except (FileNotFoundError, IOError):
+            return e.u32(NFS3ERR_NOENT).getvalue()
+        e.u32(NFS3_OK)
+        self._fattr3(e, path, st)
+        return e.getvalue()
+
+    def _setattr(self, x: XdrDecoder) -> bytes:
+        path = self._resolve(x.opaque())
+        if path is None:
+            return self._err(NFS3ERR_STALE, None)
+        # sattr3: mode? uid? gid? size? atime(enum) mtime(enum)
+        if x.boolean():
+            mode = x.u32()
+            try:
+                self.fs.set_permission(path, mode & 0o7777)
+            except (IOError, NotImplementedError):
+                pass
+        if x.boolean():
+            x.u32()
+        if x.boolean():
+            x.u32()
+        if x.boolean():
+            x.u64()               # size change unsupported (append-only)
+        e = XdrEncoder()
+        e.u32(NFS3_OK)
+        e.boolean(False)
+        self._post_op_attr(e, path)
+        return e.getvalue()
+
+    def _lookup(self, x: XdrDecoder) -> bytes:
+        dpath = self._resolve(x.opaque())
+        name = x.string()
+        e = XdrEncoder()
+        if dpath is None:
+            return e.u32(NFS3ERR_STALE).boolean(False).getvalue()
+        child = self._child(dpath, name)
+        try:
+            st = self.fs.get_file_status(child)
+        except (FileNotFoundError, IOError):
+            e.u32(NFS3ERR_NOENT)
+            self._post_op_attr(e, dpath)
+            return e.getvalue()
+        e.u32(NFS3_OK)
+        e.opaque(self.handles.fh_of(child))
+        e.boolean(True)
+        self._fattr3(e, child, st)
+        self._post_op_attr(e, dpath)
+        return e.getvalue()
+
+    def _access(self, x: XdrDecoder) -> bytes:
+        path = self._resolve(x.opaque())
+        want = x.u32()
+        e = XdrEncoder()
+        if path is None:
+            return e.u32(NFS3ERR_STALE).boolean(False).getvalue()
+        e.u32(NFS3_OK)
+        self._post_op_attr(e, path)
+        e.u32(want & 0x3F)   # grant everything requested (AUTH_SYS only)
+        return e.getvalue()
+
+    def _read(self, x: XdrDecoder) -> bytes:
+        path = self._resolve(x.opaque())
+        offset, count = x.u64(), x.u32()
+        e = XdrEncoder()
+        if path is None:
+            return e.u32(NFS3ERR_STALE).boolean(False).getvalue()
+        try:
+            st = self.fs.get_file_status(path)
+            if st.is_dir:
+                e.u32(NFS3ERR_ISDIR)
+                self._post_op_attr(e, path)
+                return e.getvalue()
+            with self.fs.open(path) as f:
+                data = f.pread(offset, count) if hasattr(f, "pread") \
+                    else self._seek_read(f, offset, count)
+        except (FileNotFoundError, IOError) as ex:
+            log.warning("NFS READ %s failed: %s", path, ex)
+            e.u32(NFS3ERR_IO)
+            self._post_op_attr(e, path)
+            return e.getvalue()
+        e.u32(NFS3_OK)
+        self._post_op_attr(e, path)
+        eof = offset + len(data) >= st.length
+        e.u32(len(data)).boolean(eof).opaque(data)
+        return e.getvalue()
+
+    @staticmethod
+    def _seek_read(f, offset: int, count: int) -> bytes:
+        f.seek(offset)
+        return f.read(count)
+
+    def _write(self, x: XdrDecoder) -> bytes:
+        path = self._resolve(x.opaque())
+        offset, count = x.u64(), x.u32()
+        stable = x.u32()
+        data = x.opaque()[:count]
+        e = XdrEncoder()
+        if path is None:
+            return self._err(NFS3ERR_STALE, None)
+        ctx = self._ctx_for(path, create=False)
+        if ctx is None:
+            # WRITE without a CREATE through this gateway: only offset-0
+            # starts a fresh stream (append-only storage).
+            if offset == 0:
+                ctx = self._ctx_for(path, create=True)
+            else:
+                return self._err(NFS3ERR_IO, path)
+        stat = ctx.write(offset, data)
+        e.u32(stat)
+        e.boolean(False)
+        self._post_op_attr(e, path)
+        if stat == NFS3_OK:
+            e.u32(len(data))
+            e.u32(stable if stable else 0)   # committed == how asked
+            e.opaque_fixed(b"htpu-nfs")      # write verifier (8 bytes)
+        return e.getvalue()
+
+    def _create(self, x: XdrDecoder) -> bytes:
+        dpath = self._resolve(x.opaque())
+        name = x.string()
+        x.u32()  # createmode (sattr/verf ignored — attrs follow)
+        if dpath is None:
+            return self._err(NFS3ERR_STALE, None)
+        child = self._child(dpath, name)
+        try:
+            self._ctx_for(child, create=True)
+        except (IOError, FileExistsError) as ex:
+            log.warning("NFS CREATE %s failed: %s", child, ex)
+            return self._err(NFS3ERR_IO, dpath)
+        e = XdrEncoder()
+        e.u32(NFS3_OK)
+        e.boolean(True).opaque(self.handles.fh_of(child))
+        self._post_op_attr(e, child)
+        e.boolean(False)
+        self._post_op_attr(e, dpath)
+        return e.getvalue()
+
+    def _mkdir(self, x: XdrDecoder) -> bytes:
+        dpath = self._resolve(x.opaque())
+        name = x.string()
+        if dpath is None:
+            return self._err(NFS3ERR_STALE, None)
+        child = self._child(dpath, name)
+        if self.fs.exists(child):
+            return self._err(NFS3ERR_EXIST, dpath)
+        try:
+            self.fs.mkdirs(child)
+        except IOError:
+            return self._err(NFS3ERR_IO, dpath)
+        e = XdrEncoder()
+        e.u32(NFS3_OK)
+        e.boolean(True).opaque(self.handles.fh_of(child))
+        self._post_op_attr(e, child)
+        e.boolean(False)
+        self._post_op_attr(e, dpath)
+        return e.getvalue()
+
+    def _remove(self, x: XdrDecoder) -> bytes:
+        return self._unlink(x, want_dir=False)
+
+    def _rmdir(self, x: XdrDecoder) -> bytes:
+        return self._unlink(x, want_dir=True)
+
+    def _unlink(self, x: XdrDecoder, want_dir: bool) -> bytes:
+        dpath = self._resolve(x.opaque())
+        name = x.string()
+        if dpath is None:
+            return self._err(NFS3ERR_STALE, None)
+        child = self._child(dpath, name)
+        try:
+            st = self.fs.get_file_status(child)
+        except (FileNotFoundError, IOError):
+            return self._err(NFS3ERR_NOENT, dpath)
+        if st.is_dir != want_dir:
+            return self._err(NFS3ERR_ISDIR if st.is_dir
+                             else NFS3ERR_NOTDIR, dpath)
+        if want_dir and self.fs.list_status(child):
+            return self._err(NFS3ERR_NOTEMPTY, dpath)
+        self._close_write(child)
+        try:
+            self.fs.delete(child, recursive=want_dir)
+        except IOError:
+            return self._err(NFS3ERR_IO, dpath)
+        self.handles.removed(child)
+        e = XdrEncoder()
+        e.u32(NFS3_OK)
+        e.boolean(False)
+        self._post_op_attr(e, dpath)
+        return e.getvalue()
+
+    def _rename(self, x: XdrDecoder) -> bytes:
+        from_dir = self._resolve(x.opaque())
+        from_name = x.string()
+        to_dir = self._resolve(x.opaque())
+        to_name = x.string()
+        e = XdrEncoder()
+        if from_dir is None or to_dir is None:
+            e.u32(NFS3ERR_STALE)
+            for _ in range(2):
+                e.boolean(False)
+                e.boolean(False)
+            return e.getvalue()
+        src = self._child(from_dir, from_name)
+        dst = self._child(to_dir, to_name)
+        stat = NFS3_OK
+        try:
+            self._close_write(src)
+            if not self.fs.rename(src, dst):
+                stat = NFS3ERR_IO
+        except FileNotFoundError:
+            stat = NFS3ERR_NOENT
+        except IOError:
+            stat = NFS3ERR_IO
+        if stat == NFS3_OK:
+            self.handles.renamed(src, dst)
+        e.u32(stat)
+        for d in (from_dir, to_dir):
+            e.boolean(False)
+            self._post_op_attr(e, d)
+        return e.getvalue()
+
+    def _readdir(self, x: XdrDecoder) -> bytes:
+        return self._readdir_common(x, plus=False)
+
+    def _readdirplus(self, x: XdrDecoder) -> bytes:
+        return self._readdir_common(x, plus=True)
+
+    def _readdir_common(self, x: XdrDecoder, plus: bool) -> bytes:
+        path = self._resolve(x.opaque())
+        cookie = x.u64()
+        x.opaque_fixed(8)     # cookieverf
+        e = XdrEncoder()
+        if path is None:
+            return e.u32(NFS3ERR_STALE).boolean(False).getvalue()
+        try:
+            st = self.fs.get_file_status(path)
+            if not st.is_dir:
+                e.u32(NFS3ERR_NOTDIR)
+                self._post_op_attr(e, path)
+                return e.getvalue()
+            entries = sorted(self.fs.list_status(path),
+                             key=lambda s: s.path)
+        except (FileNotFoundError, IOError):
+            return e.u32(NFS3ERR_STALE).boolean(False).getvalue()
+        e.u32(NFS3_OK)
+        self._post_op_attr(e, path)
+        e.opaque_fixed(b"\0" * 8)   # cookieverf
+        for i, ent in enumerate(entries):
+            if i < cookie:
+                continue
+            name = ent.path.rstrip("/").rsplit("/", 1)[-1]
+            e.boolean(True)
+            e.u64(self.handles.id_of(ent.path))
+            e.string(name)
+            e.u64(i + 1)            # cookie
+            if plus:
+                e.boolean(True)
+                self._fattr3(e, ent.path, ent)
+                e.boolean(True)
+                e.opaque(self.handles.fh_of(ent.path))
+        e.boolean(False)            # no more entries
+        e.boolean(True)             # eof
+        return e.getvalue()
+
+    def _fsstat(self, x: XdrDecoder) -> bytes:
+        path = self._resolve(x.opaque())
+        e = XdrEncoder()
+        if path is None:
+            return e.u32(NFS3ERR_STALE).boolean(False).getvalue()
+        e.u32(NFS3_OK)
+        self._post_op_attr(e, path)
+        total = 1 << 40
+        e.u64(total).u64(total).u64(total)   # tbytes fbytes abytes
+        e.u64(1 << 20).u64(1 << 20).u64(1 << 20)  # tfiles ffiles afiles
+        e.u32(0)
+        return e.getvalue()
+
+    def _fsinfo(self, x: XdrDecoder) -> bytes:
+        path = self._resolve(x.opaque())
+        e = XdrEncoder()
+        if path is None:
+            return e.u32(NFS3ERR_STALE).boolean(False).getvalue()
+        e.u32(NFS3_OK)
+        self._post_op_attr(e, path)
+        mb = 1024 * 1024
+        e.u32(mb).u32(mb).u32(4096)       # rtmax rtpref rtmult
+        e.u32(mb).u32(mb).u32(4096)       # wtmax wtpref wtmult
+        e.u32(64 * 1024)                  # dtpref
+        e.u64(1 << 62)                    # maxfilesize
+        e.u32(0).u32(1)                   # time_delta
+        e.u32(0x1B)                       # properties: LINK|SYMLINK off
+        return e.getvalue()
+
+    def _pathconf(self, x: XdrDecoder) -> bytes:
+        path = self._resolve(x.opaque())
+        e = XdrEncoder()
+        if path is None:
+            return e.u32(NFS3ERR_STALE).boolean(False).getvalue()
+        e.u32(NFS3_OK)
+        self._post_op_attr(e, path)
+        e.u32(32).u32(255 * 4)           # linkmax name_max
+        e.boolean(True).boolean(True).boolean(False).boolean(True)
+        return e.getvalue()
+
+    def _commit(self, x: XdrDecoder) -> bytes:
+        path = self._resolve(x.opaque())
+        x.u64()
+        x.u32()
+        if path is None:
+            return self._err(NFS3ERR_STALE, None)
+        stat = self._close_write(path)
+        e = XdrEncoder()
+        e.u32(stat)
+        e.boolean(False)
+        self._post_op_attr(e, path)
+        if stat == NFS3_OK:
+            e.opaque_fixed(b"htpu-nfs")
+        return e.getvalue()
+
+
+class Mountd(RpcProgram):
+    """MOUNT v3 (ref: RpcProgramMountd.java): MNT hands out the export's
+    root file handle; EXPORT lists exports."""
+
+    program = MOUNT_PROGRAM
+    version = MOUNT_VERSION
+    name = "mountd"
+
+    MNT = 1
+    UMNT = 3
+    UMNTALL = 4
+    EXPORT = 5
+
+    def __init__(self, gateway: Nfs3Gateway):
+        self.gateway = gateway
+        self.mounts: Dict[str, float] = {}
+
+    def handle(self, call: RpcCall) -> bytes:
+        e = XdrEncoder()
+        if call.proc == 0:
+            return b""
+        if call.proc == self.MNT:
+            path = call.args.string()
+            if path.rstrip("/") not in (self.gateway.export, ""):
+                return e.u32(NFS3ERR_NOENT).getvalue()
+            self.mounts[path] = time.time()
+            e.u32(NFS3_OK)
+            e.opaque(self.gateway.root_fh)
+            e.u32(1).u32(1)     # auth flavors: [AUTH_SYS]
+            return e.getvalue()
+        if call.proc in (self.UMNT, self.UMNTALL):
+            self.mounts.clear()
+            return b""
+        if call.proc == self.EXPORT:
+            e.boolean(True).string(self.gateway.export)
+            e.boolean(False)    # no groups
+            e.boolean(False)    # no more exports
+            return e.getvalue()
+        raise proc_unavailable()
+
+
+class NfsGateway:
+    """The deployable unit: portmap + mountd + nfs3 on one RPC server
+    (ref: hadoop-hdfs-nfs Nfs3.java main — starts Portmap, Mountd and
+    RpcProgramNfs3)."""
+
+    def __init__(self, fs, export: str = "/", bind_host: str = "127.0.0.1",
+                 port: int = 0):
+        self.nfs3 = Nfs3Gateway(fs, export)
+        self.mountd = Mountd(self.nfs3)
+        self.portmap = Portmap()
+        self.server = RpcTcpServer(bind_host, port)
+        self.server.register(self.nfs3)
+        self.server.register(self.mountd)
+        self.server.register(self.portmap)
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    def start(self) -> None:
+        self.server.start()
+        for prog in (self.nfs3, self.mountd):
+            self.portmap.set(prog.program, prog.version, self.server.port)
+        log.info("NFS gateway exporting %s on port %d",
+                 self.nfs3.export, self.server.port)
+
+    def stop(self) -> None:
+        for path in list(self.nfs3._open_writes):
+            self.nfs3._close_write(path)
+        self.server.stop()

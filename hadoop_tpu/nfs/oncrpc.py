@@ -1,0 +1,308 @@
+"""ONC RPC v2 (RFC 5531) over TCP with record marking, plus portmap.
+
+Counterparts in hadoop-nfs: org.apache.hadoop.oncrpc.{RpcCall,RpcReply,
+RpcProgram,SimpleTcpServer,RpcUtil} and org.apache.hadoop.portmap.Portmap
+(the reference embeds its own portmapper so gateways need no system
+rpcbind; same here). The reference rides Netty; here a thread-per-
+connection TCP server matching the rest of the framework's daemons.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from hadoop_tpu.nfs.xdr import XdrDecoder, XdrEncoder
+from hadoop_tpu.util.misc import Daemon
+
+log = logging.getLogger(__name__)
+
+RPC_CALL = 0
+RPC_REPLY = 1
+RPC_VERSION = 2
+
+MSG_ACCEPTED = 0
+MSG_DENIED = 1
+
+# accept_stat (RFC 5531 §9)
+SUCCESS = 0
+PROG_UNAVAIL = 1
+PROG_MISMATCH = 2
+PROC_UNAVAIL = 3
+GARBAGE_ARGS = 4
+SYSTEM_ERR = 5
+
+AUTH_NONE = 0
+AUTH_SYS = 1
+
+PORTMAP_PROGRAM = 100000
+PORTMAP_VERSION = 2
+PMAPPROC_NULL = 0
+PMAPPROC_SET = 1
+PMAPPROC_UNSET = 2
+PMAPPROC_GETPORT = 3
+PMAPPROC_DUMP = 4
+IPPROTO_TCP = 6
+
+
+class RpcCall:
+    """Decoded call header + a decoder positioned at the arguments."""
+
+    def __init__(self, xid: int, prog: int, vers: int, proc: int,
+                 cred_flavor: int, cred_body: bytes, args: XdrDecoder):
+        self.xid = xid
+        self.prog = prog
+        self.vers = vers
+        self.proc = proc
+        self.cred_flavor = cred_flavor
+        self.cred_body = cred_body
+        self.args = args
+
+    @classmethod
+    def decode(cls, data: bytes) -> "RpcCall":
+        x = XdrDecoder(data)
+        xid = x.u32()
+        mtype = x.u32()
+        if mtype != RPC_CALL:
+            raise ValueError(f"not a CALL message: {mtype}")
+        rpcvers = x.u32()
+        if rpcvers != RPC_VERSION:
+            raise ValueError(f"bad RPC version {rpcvers}")
+        prog, vers, proc = x.u32(), x.u32(), x.u32()
+        cred_flavor = x.u32()
+        cred_body = x.opaque()
+        x.u32()          # verifier flavor
+        x.opaque()       # verifier body
+        return cls(xid, prog, vers, proc, cred_flavor, cred_body, x)
+
+
+def accepted_reply(xid: int, stat: int = SUCCESS,
+                   body: bytes = b"") -> bytes:
+    e = XdrEncoder()
+    e.u32(xid).u32(RPC_REPLY).u32(MSG_ACCEPTED)
+    e.u32(AUTH_NONE).opaque(b"")     # verifier
+    e.u32(stat)
+    e.opaque_fixed(body)
+    return e.getvalue()
+
+
+class RpcProgram:
+    """Subclass with ``handle(call) -> bytes`` returning reply body XDR.
+    Ref: oncrpc.RpcProgram."""
+
+    program = 0
+    version = 1
+    name = "rpc"
+
+    def handle(self, call: RpcCall) -> bytes:
+        raise NotImplementedError
+
+
+def read_record(sock: socket.socket) -> Optional[bytes]:
+    """Record-marking reassembly (RFC 5531 §11): frames carry a 31-bit
+    length + last-fragment bit. Ref: RpcUtil's frame decoder."""
+    frags = []
+    while True:
+        hdr = b""
+        while len(hdr) < 4:
+            c = sock.recv(4 - len(hdr))
+            if not c:
+                return None if not frags and not hdr else _short()
+            hdr += c
+        (mark,) = struct.unpack(">I", hdr)
+        n = mark & 0x7FFFFFFF
+        buf = b""
+        while len(buf) < n:
+            c = sock.recv(n - len(buf))
+            if not c:
+                return _short()
+            buf += c
+        frags.append(buf)
+        if mark & 0x80000000:
+            return b"".join(frags)
+
+
+def _short():
+    raise EOFError("short ONC RPC record")
+
+
+def write_record(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack(">I", 0x80000000 | len(payload)) + payload)
+
+
+class RpcTcpServer:
+    """One listener dispatching to registered (program, version)s."""
+
+    def __init__(self, bind_host: str = "127.0.0.1", port: int = 0):
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((bind_host, port))
+        self._lsock.listen(64)
+        self._lsock.settimeout(0.5)   # see DataXceiverServer: close()
+        self.port = self._lsock.getsockname()[1]   # won't wake accept(2)
+        self._programs: Dict[Tuple[int, int], RpcProgram] = {}
+        self._running = False
+
+    def register(self, prog: RpcProgram) -> None:
+        self._programs[(prog.program, prog.version)] = prog
+
+    def start(self) -> None:
+        self._running = True
+        Daemon(self._accept_loop, f"oncrpc-server-{self.port}").start()
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                sock, addr = self._lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            Daemon(self._serve, f"oncrpc-conn-{addr[1]}",
+                   args=(sock,)).start()
+
+    def _serve(self, sock: socket.socket) -> None:
+        try:
+            while True:
+                rec = read_record(sock)
+                if rec is None:
+                    return
+                try:
+                    call = RpcCall.decode(rec)
+                except ValueError as e:
+                    log.warning("bad RPC record: %s", e)
+                    return
+                prog = self._programs.get((call.prog, call.vers))
+                if prog is None:
+                    stat = PROG_UNAVAIL if not any(
+                        p == call.prog for p, _ in self._programs) \
+                        else PROG_MISMATCH
+                    write_record(sock, accepted_reply(call.xid, stat))
+                    continue
+                try:
+                    body = prog.handle(call)
+                    write_record(sock, accepted_reply(call.xid, SUCCESS,
+                                                      body))
+                except _ProcUnavail:
+                    write_record(sock,
+                                 accepted_reply(call.xid, PROC_UNAVAIL))
+                except Exception:
+                    log.exception("%s proc %d failed", prog.name, call.proc)
+                    write_record(sock,
+                                 accepted_reply(call.xid, SYSTEM_ERR))
+        except (OSError, EOFError):
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class _ProcUnavail(Exception):
+    pass
+
+
+def proc_unavailable() -> Exception:
+    return _ProcUnavail()
+
+
+class Portmap(RpcProgram):
+    """Embedded portmapper (ref: org.apache.hadoop.portmap.Portmap —
+    RpcProgramPortmap handles SET/GETPORT/DUMP for the mount + nfs
+    programs the gateway registers)."""
+
+    program = PORTMAP_PROGRAM
+    version = PORTMAP_VERSION
+    name = "portmap"
+
+    def __init__(self):
+        self._map: Dict[Tuple[int, int, int], int] = {}
+        self._lock = threading.Lock()
+
+    def set(self, prog: int, vers: int, port: int,
+            proto: int = IPPROTO_TCP) -> None:
+        with self._lock:
+            self._map[(prog, vers, proto)] = port
+
+    def handle(self, call: RpcCall) -> bytes:
+        e = XdrEncoder()
+        if call.proc == PMAPPROC_NULL:
+            return b""
+        if call.proc in (PMAPPROC_SET, PMAPPROC_UNSET, PMAPPROC_GETPORT):
+            prog, vers, proto, port = (call.args.u32(), call.args.u32(),
+                                       call.args.u32(), call.args.u32())
+            with self._lock:
+                if call.proc == PMAPPROC_SET:
+                    self._map[(prog, vers, proto)] = port
+                    return e.boolean(True).getvalue()
+                if call.proc == PMAPPROC_UNSET:
+                    self._map.pop((prog, vers, proto), None)
+                    return e.boolean(True).getvalue()
+                return e.u32(self._map.get((prog, vers, proto),
+                                           0)).getvalue()
+        if call.proc == PMAPPROC_DUMP:
+            with self._lock:
+                for (prog, vers, proto), port in self._map.items():
+                    e.boolean(True).u32(prog).u32(vers).u32(proto).u32(port)
+            e.boolean(False)
+            return e.getvalue()
+        raise proc_unavailable()
+
+
+class SimpleRpcClient:
+    """Minimal ONC RPC client for tests/tools (ref: the reference tests
+    drive RpcProgramNfs3 the same way — hand-built XDR calls)."""
+
+    def __init__(self, host: str, port: int, prog: int, vers: int):
+        self.sock = socket.create_connection((host, port), timeout=10.0)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.prog, self.vers = prog, vers
+        self._xid = 1
+
+    def call(self, proc: int, args: bytes = b"",
+             uid: int = 0, gid: int = 0) -> XdrDecoder:
+        self._xid += 1
+        e = XdrEncoder()
+        e.u32(self._xid).u32(RPC_CALL).u32(RPC_VERSION)
+        e.u32(self.prog).u32(self.vers).u32(proc)
+        # AUTH_SYS credential (RFC 5531 appendix A)
+        cred = XdrEncoder()
+        cred.u32(0).string("client").u32(uid).u32(gid).u32(0)
+        e.u32(AUTH_SYS).opaque(cred.getvalue())
+        e.u32(AUTH_NONE).opaque(b"")
+        e.opaque_fixed(args)
+        write_record(self.sock, e.getvalue())
+        rec = read_record(self.sock)
+        if rec is None:
+            raise EOFError("connection closed")
+        x = XdrDecoder(rec)
+        xid = x.u32()
+        assert xid == self._xid, (xid, self._xid)
+        assert x.u32() == RPC_REPLY
+        reply_stat = x.u32()
+        if reply_stat != MSG_ACCEPTED:
+            raise IOError("RPC denied")
+        x.u32()
+        x.opaque()   # verifier
+        stat = x.u32()
+        if stat != SUCCESS:
+            raise IOError(f"RPC accept_stat {stat}")
+        return x
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass

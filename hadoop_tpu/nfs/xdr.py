@@ -1,0 +1,88 @@
+"""XDR (RFC 4506) encoding — the wire syntax under every ONC RPC.
+
+Counterpart of hadoop-nfs org.apache.hadoop.oncrpc.XDR (one growable
+buffer with read/write cursors; 4-byte alignment throughout).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List
+
+
+def _pad(n: int) -> int:
+    return (4 - n % 4) % 4
+
+
+class XdrEncoder:
+    def __init__(self):
+        self._parts: List[bytes] = []
+
+    def u32(self, v: int) -> "XdrEncoder":
+        self._parts.append(struct.pack(">I", v & 0xFFFFFFFF))
+        return self
+
+    def i32(self, v: int) -> "XdrEncoder":
+        self._parts.append(struct.pack(">i", v))
+        return self
+
+    def u64(self, v: int) -> "XdrEncoder":
+        self._parts.append(struct.pack(">Q", v & 0xFFFFFFFFFFFFFFFF))
+        return self
+
+    def boolean(self, v: bool) -> "XdrEncoder":
+        return self.u32(1 if v else 0)
+
+    def opaque_fixed(self, data: bytes) -> "XdrEncoder":
+        self._parts.append(data)
+        self._parts.append(b"\0" * _pad(len(data)))
+        return self
+
+    def opaque(self, data: bytes) -> "XdrEncoder":
+        self.u32(len(data))
+        return self.opaque_fixed(data)
+
+    def string(self, s: str) -> "XdrEncoder":
+        return self.opaque(s.encode())
+
+    def getvalue(self) -> bytes:
+        return b"".join(self._parts)
+
+
+class XdrDecoder:
+    def __init__(self, data: bytes, offset: int = 0):
+        self._d = data
+        self._p = offset
+
+    def _take(self, n: int) -> bytes:
+        if self._p + n > len(self._d):
+            raise ValueError("truncated XDR payload")
+        out = self._d[self._p:self._p + n]
+        self._p += n
+        return out
+
+    def u32(self) -> int:
+        return struct.unpack(">I", self._take(4))[0]
+
+    def i32(self) -> int:
+        return struct.unpack(">i", self._take(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack(">Q", self._take(8))[0]
+
+    def boolean(self) -> bool:
+        return self.u32() != 0
+
+    def opaque_fixed(self, n: int) -> bytes:
+        out = self._take(n)
+        self._take(_pad(n))
+        return out
+
+    def opaque(self) -> bytes:
+        return self.opaque_fixed(self.u32())
+
+    def string(self) -> str:
+        return self.opaque().decode()
+
+    def remaining(self) -> int:
+        return len(self._d) - self._p

@@ -1,0 +1,305 @@
+"""YARN federation: state store + Router over many subclusters.
+
+Counterparts: hadoop-yarn-server-common federation (FederationStateStore
+— subcluster registry + home-subcluster table, ref:
+FederationStateStoreFacade.java; policies ref:
+federation/policies/router/*Policy.java) and hadoop-yarn-server-router
+(Router.java — the client-facing ApplicationClientProtocol that routes
+each app to its home subcluster; ref:
+clientrm/FederationClientInterceptor.java).
+
+Model: every application gets a *home subcluster* chosen at
+``get_new_application`` time by the routing policy; every subsequent
+call for that app (submit/report/kill) follows the home mapping, and
+aggregate reads (list/metrics/nodes) fan out over all ACTIVE
+subclusters — the same shape as the reference's interceptor chain.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.ipc import Client, Server, get_proxy
+from hadoop_tpu.service import AbstractService
+from hadoop_tpu.util.misc import Daemon, parse_addr_list
+from hadoop_tpu.yarn.records import ApplicationId
+
+log = logging.getLogger(__name__)
+
+SC_ACTIVE = "ACTIVE"
+SC_LOST = "LOST"
+SC_DEREGISTERED = "DEREGISTERED"
+
+
+class FederationStateStore:
+    """Subcluster registry + app→home-subcluster table, file-backed the
+    way the RM's FileRMStateStore is (ref: FederationStateStore.java;
+    the reference ships ZK/SQL/in-memory impls)."""
+
+    def __init__(self, store_path: Optional[str] = None):
+        self._path = store_path
+        self._subclusters: Dict[str, Dict] = {}
+        self._homes: Dict[str, str] = {}       # app_id str → subcluster id
+        self._lock = threading.Lock()
+        if store_path and os.path.exists(store_path):
+            with open(store_path) as f:
+                data = json.load(f)
+            self._subclusters = data.get("subclusters", {})
+            self._homes = data.get("homes", {})
+
+    def _save_locked(self) -> None:
+        if not self._path:
+            return
+        os.makedirs(os.path.dirname(self._path), exist_ok=True)
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"subclusters": self._subclusters,
+                       "homes": self._homes}, f)
+        os.replace(tmp, self._path)
+
+    def register_subcluster(self, sc_id: str, rm_addr: str) -> None:
+        with self._lock:
+            self._subclusters[sc_id] = {
+                "addr": rm_addr, "state": SC_ACTIVE,
+                "last_heartbeat": time.time()}
+            self._save_locked()
+
+    def deregister_subcluster(self, sc_id: str) -> bool:
+        with self._lock:
+            sc = self._subclusters.get(sc_id)
+            if sc is None:
+                return False
+            sc["state"] = SC_DEREGISTERED
+            self._save_locked()
+            return True
+
+    def subcluster_heartbeat(self, sc_id: str, state: str = SC_ACTIVE
+                             ) -> None:
+        with self._lock:
+            sc = self._subclusters.get(sc_id)
+            if sc is not None:
+                sc["state"] = state
+                sc["last_heartbeat"] = time.time()
+                self._save_locked()
+
+    def subclusters(self, active_only: bool = False) -> Dict[str, Dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._subclusters.items()
+                    if not active_only or v["state"] == SC_ACTIVE}
+
+    def set_home(self, app_id: str, sc_id: str) -> None:
+        with self._lock:
+            self._homes[app_id] = sc_id
+            self._save_locked()
+
+    def home_of(self, app_id: str) -> Optional[str]:
+        with self._lock:
+            return self._homes.get(app_id)
+
+
+class _RouterClientProtocol:
+    """The router's ApplicationClientProtocol face (ref:
+    FederationClientInterceptor.java)."""
+
+    def __init__(self, router: "YarnRouter"):
+        self.router = router
+
+    def get_new_application(self) -> Dict:
+        sc_id = self.router.choose_subcluster()
+        out = self.router.rm_proxy(sc_id).get_new_application()
+        app_id = str(ApplicationId.from_wire(out["app_id"]))
+        self.router.store.set_home(app_id, sc_id)
+        return out
+
+    def submit_application(self, ctx_wire: Dict) -> Dict:
+        app_id = str(ApplicationId.from_wire(ctx_wire["id"]))
+        sc_id = self.router.home_or_raise(app_id)
+        return self.router.rm_proxy(sc_id).submit_application(ctx_wire)
+
+    def get_application_report(self, app_id_wire: Dict) -> Dict:
+        app_id = str(ApplicationId.from_wire(app_id_wire))
+        sc_id = self.router.home_or_raise(app_id)
+        return self.router.rm_proxy(sc_id).get_application_report(
+            app_id_wire)
+
+    def kill_application(self, app_id_wire: Dict) -> bool:
+        app_id = str(ApplicationId.from_wire(app_id_wire))
+        sc_id = self.router.home_or_raise(app_id)
+        return self.router.rm_proxy(sc_id).kill_application(app_id_wire)
+
+    def list_applications(self) -> List[Dict]:
+        out: List[Dict] = []
+        for sc_id in self.router.store.subclusters(active_only=True):
+            try:
+                out.extend(self.router.rm_proxy(sc_id).list_applications())
+            except (OSError, IOError) as e:
+                log.warning("list_applications on %s failed: %s", sc_id, e)
+        return out
+
+    def get_cluster_metrics(self) -> Dict:
+        agg = {"num_node_managers": 0, "apps": 0, "subclusters": 0,
+               "total_resource": {"m": 0, "v": 0, "c": 0}}
+        for sc_id in self.router.store.subclusters(active_only=True):
+            try:
+                m = self.router.rm_proxy(sc_id).get_cluster_metrics()
+            except (OSError, IOError):
+                continue
+            agg["subclusters"] += 1
+            agg["num_node_managers"] += m.get("num_node_managers", 0)
+            agg["apps"] += m.get("apps", 0)
+            tr = m.get("total_resource", {})
+            for k in ("m", "v", "c"):
+                agg["total_resource"][k] += tr.get(k, 0)
+        return agg
+
+    def get_nodes(self) -> List[Dict]:
+        out: List[Dict] = []
+        for sc_id in self.router.store.subclusters(active_only=True):
+            try:
+                for n in self.router.rm_proxy(sc_id).get_nodes():
+                    n["subcluster"] = sc_id
+                    out.append(n)
+            except (OSError, IOError):
+                continue
+        return out
+
+    def get_service_status(self) -> Dict:
+        return {"state": "active", "role": "router"}
+
+
+class _RouterAdminProtocol:
+    """Ref: router RouterAdminProtocol / FederationStateStore admin."""
+
+    def __init__(self, router: "YarnRouter"):
+        self.router = router
+
+    def register_subcluster(self, sc_id: str, rm_addr: str) -> bool:
+        self.router.store.register_subcluster(sc_id, rm_addr)
+        return True
+
+    def deregister_subcluster(self, sc_id: str) -> bool:
+        return self.router.store.deregister_subcluster(sc_id)
+
+    def list_subclusters(self) -> Dict[str, Dict]:
+        return self.router.store.subclusters()
+
+
+class YarnRouter(AbstractService):
+    """Client-facing router over federated RMs (ref: router/Router.java
+    :82 — a pipeline of interceptors in front of many subclusters)."""
+
+    def __init__(self, conf: Configuration,
+                 state_dir: Optional[str] = None):
+        super().__init__("YarnRouter")
+        self.state_dir = state_dir or conf.get(
+            "yarn.federation.state-store.dir", "/tmp/htpu-yarn-router")
+        self.store = FederationStateStore(
+            os.path.join(self.state_dir, "federation.json"))
+        self.policy = conf.get("yarn.federation.policy", "load")
+        self._proxies: Dict[str, object] = {}
+        self._client: Optional[Client] = None
+        self._rr = 0
+        self._lock = threading.Lock()
+        self.rpc: Optional[Server] = None
+        self._stop_event = threading.Event()
+
+    def service_init(self, conf: Configuration) -> None:
+        # Static registration: yarn.federation.subcluster.<id> = host:port
+        for key, value in conf.to_dict().items():
+            if key.startswith("yarn.federation.subcluster."):
+                sc_id = key[len("yarn.federation.subcluster."):]
+                self.store.register_subcluster(sc_id, value)
+        self._client = Client(conf)
+        self.rpc = Server(conf, bind=("127.0.0.1", conf.get_int(
+            "yarn.federation.router.port", 0)), num_handlers=8,
+            name="yarn-router")
+        self.rpc.register_protocol("ClientRMProtocol",
+                                   _RouterClientProtocol(self))
+        self.rpc.register_protocol("RouterAdminProtocol",
+                                   _RouterAdminProtocol(self))
+
+    def service_start(self) -> None:
+        self.rpc.start()
+        Daemon(self._liveness_loop, "yarn-router-liveness").start()
+        log.info("YARN Router on :%d (%d subclusters, policy=%s)",
+                 self.rpc.port, len(self.store.subclusters()), self.policy)
+
+    def service_stop(self) -> None:
+        self._stop_event.set()
+        if self.rpc:
+            self.rpc.stop()
+        if self._client:
+            self._client.stop()
+
+    @property
+    def port(self) -> int:
+        return self.rpc.port
+
+    # ------------------------------------------------------------- routing
+
+    def rm_proxy(self, sc_id: str):
+        with self._lock:
+            p = self._proxies.get(sc_id)
+            if p is None:
+                sc = self.store.subclusters().get(sc_id)
+                if sc is None:
+                    raise ValueError(f"unknown subcluster {sc_id!r}")
+                addr = parse_addr_list(sc["addr"])[0]
+                p = get_proxy("ClientRMProtocol", addr,
+                              client=self._client)
+                self._proxies[sc_id] = p
+            return p
+
+    def home_or_raise(self, app_id: str) -> str:
+        sc_id = self.store.home_of(app_id)
+        if sc_id is None:
+            raise ValueError(f"no home subcluster for {app_id}")
+        return sc_id
+
+    def choose_subcluster(self) -> str:
+        """Routing policy (ref: LoadBasedRouterPolicy /
+        UniformRandomRouterPolicy)."""
+        active = sorted(self.store.subclusters(active_only=True))
+        if not active:
+            raise IOError("no ACTIVE subclusters")
+        if self.policy == "round-robin":
+            with self._lock:
+                sc = active[self._rr % len(active)]
+                self._rr += 1
+            return sc
+        # load-based: fewest running apps wins
+        best, best_load = active[0], float("inf")
+        for sc_id in active:
+            try:
+                m = self.rm_proxy(sc_id).get_cluster_metrics()
+                load = m.get("apps", 0)
+            except (OSError, IOError):
+                continue
+            if load < best_load:
+                best, best_load = sc_id, load
+        return best
+
+    # ------------------------------------------------------------ liveness
+
+    def _liveness_loop(self) -> None:
+        interval = self.config.get_time_seconds(
+            "yarn.federation.liveness-interval", 2.0)
+        while not self._stop_event.wait(interval):
+            for sc_id in list(self.store.subclusters()):
+                sc = self.store.subclusters().get(sc_id)
+                if sc is None or sc["state"] == SC_DEREGISTERED:
+                    continue
+                try:
+                    self.rm_proxy(sc_id).get_service_status()
+                    self.store.subcluster_heartbeat(sc_id, SC_ACTIVE)
+                except (OSError, IOError):
+                    log.warning("subcluster %s unreachable", sc_id)
+                    with self._lock:
+                        self._proxies.pop(sc_id, None)
+                    self.store.subcluster_heartbeat(sc_id, SC_LOST)

@@ -431,7 +431,11 @@ class ResourceManager(AbstractService):
     def __init__(self, conf: Configuration, state_dir: Optional[str] = None):
         super().__init__("ResourceManager")
         self._conf_in = conf
-        self.cluster_ts = int(time.time())
+        # Milliseconds like the reference (ResourceManager uses
+        # System.currentTimeMillis() as the cluster timestamp) — seconds
+        # granularity made two RMs started in the same second mint
+        # identical ApplicationIds, which collide under federation.
+        self.cluster_ts = int(time.time() * 1000)
         self._app_seq = 0
         self._seq_lock = threading.Lock()
         self.apps: Dict[ApplicationId, RMApp] = {}

@@ -1,0 +1,358 @@
+"""Long-running YARN services framework.
+
+Counterpart of hadoop-yarn-applications/hadoop-yarn-services (ref:
+hadoop-yarn-services-core — ServiceMaster.java keeps each component at
+its target instance count, restarting exited containers;
+ServiceClient.java submits/flexes/stops; ClientAMProtocol.proto is the
+client↔AM control channel; the service spec is the JSON "Service" model
+of ServiceApiUtil).
+
+The AM publishes its control RPC endpoint through the app report's
+tracking URL (``htpu-am://host:port``) — the reference does the same
+dance via the registry; the registry-based lookup also works here
+(`hadoop_tpu.registry`), but the tracking URL needs no extra daemon.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.ipc import Client, Server, get_proxy
+from hadoop_tpu.yarn.client import AMRMClient, NMClient, YarnClient
+from hadoop_tpu.yarn.records import (ApplicationSubmissionContext, AppState,
+                                     ContainerLaunchContext, Resource)
+
+log = logging.getLogger(__name__)
+
+RESTART_ALWAYS = "ALWAYS"        # long-running daemons
+RESTART_ON_FAILURE = "ON_FAILURE"
+RESTART_NEVER = "NEVER"
+
+
+class Component:
+    """Ref: the 'Component' object of the YARN service REST model."""
+
+    def __init__(self, name: str, number_of_containers: int,
+                 launch_command: List[str],
+                 resource: Optional[Resource] = None,
+                 restart_policy: str = RESTART_ALWAYS):
+        self.name = name
+        self.number_of_containers = number_of_containers
+        self.launch_command = launch_command
+        self.resource = resource or Resource(128, 1)
+        self.restart_policy = restart_policy
+
+    def to_dict(self) -> Dict:
+        return {"name": self.name, "n": self.number_of_containers,
+                "cmd": self.launch_command,
+                "r": self.resource.to_wire(),
+                "restart": self.restart_policy}
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "Component":
+        return cls(d["name"], d["n"], d["cmd"],
+                   Resource.from_wire(d["r"]), d.get("restart",
+                                                     RESTART_ALWAYS))
+
+
+class ServiceSpec:
+    """Ref: the 'Service' object (ServiceApiUtil.java validates it)."""
+
+    def __init__(self, name: str, components: List[Component]):
+        self.name = name
+        self.components = components
+
+    def to_json(self) -> str:
+        return json.dumps({"name": self.name,
+                           "components": [c.to_dict()
+                                          for c in self.components]})
+
+    @classmethod
+    def from_json(cls, s: str) -> "ServiceSpec":
+        d = json.loads(s)
+        return cls(d["name"], [Component.from_dict(c)
+                               for c in d["components"]])
+
+
+class _ClientAMProtocol:
+    """The AM-side control face (ref: ClientAMProtocol.proto —
+    flexComponents / getStatus / stop)."""
+
+    def __init__(self, master: "ServiceMaster"):
+        self.master = master
+
+    def get_status(self) -> Dict:
+        return self.master.status()
+
+    def flex_component(self, name: str, count: int) -> bool:
+        return self.master.flex(name, count)
+
+    def stop_service(self) -> bool:
+        self.master.request_stop()
+        return True
+
+
+class _Instance:
+    __slots__ = ("container", "index", "started_at")
+
+    def __init__(self, container, index: int):
+        self.container = container
+        self.index = index
+        self.started_at = time.time()
+
+
+class ServiceMaster:
+    """The service AM. Ref: ServiceMaster.java + ServiceScheduler.java:
+    one allocate loop reconciling actual instances against each
+    component's target, relaunching per restart policy."""
+
+    def __init__(self, spec: ServiceSpec,
+                 conf: Optional[Configuration] = None):
+        self.spec = spec
+        self.conf = conf or Configuration()
+        self.targets: Dict[str, int] = {
+            c.name: c.number_of_containers for c in spec.components}
+        self.components: Dict[str, Component] = {
+            c.name: c for c in spec.components}
+        self.instances: Dict[str, List[_Instance]] = {
+            c.name: [] for c in spec.components}
+        # container_id str → (component, instance)
+        self._by_container: Dict[str, Tuple[str, _Instance]] = {}
+        self._outstanding: Dict[str, int] = {
+            c.name: 0 for c in spec.components}
+        self._next_index: Dict[str, int] = {
+            c.name: 0 for c in spec.components}
+        self._restarts = 0
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self.amrm: Optional[AMRMClient] = None
+        self.nm = NMClient()
+        self.rpc: Optional[Server] = None
+
+    # -------------------------------------------------------- control face
+
+    def status(self) -> Dict:
+        with self._lock:
+            return {
+                "name": self.spec.name,
+                "state": "STOPPING" if self._stop.is_set() else "STABLE"
+                if all(len(self.instances[c]) == self.targets[c]
+                       for c in self.targets) else "FLEXING",
+                "restarts": self._restarts,
+                "components": {
+                    c: {"target": self.targets[c],
+                        "running": len(self.instances[c]),
+                        "containers": [str(i.container.container_id)
+                                       for i in self.instances[c]]}
+                    for c in self.targets},
+            }
+
+    def flex(self, name: str, count: int) -> bool:
+        if name not in self.targets or count < 0:
+            return False
+        with self._lock:
+            self.targets[name] = count
+            # Flexing down stops the newest surplus instances (ref:
+            # ServiceScheduler's flex handling).
+            surplus = sorted(self.instances[name],
+                             key=lambda i: -i.index)[
+                :max(0, len(self.instances[name]) - count)]
+        for inst in surplus:
+            try:
+                self.nm.stop_container(inst.container)
+            except (OSError, IOError):
+                pass
+        log.info("service %s: flex %s -> %d", self.spec.name, name, count)
+        return True
+
+    def request_stop(self) -> None:
+        self._stop.set()
+
+    # ----------------------------------------------------------- main loop
+
+    def run(self) -> int:
+        self.amrm = AMRMClient.from_env(self.conf)
+        self.rpc = Server(self.conf, bind=("127.0.0.1", 0),
+                          num_handlers=2, name="service-am")
+        self.rpc.register_protocol("ClientAMProtocol",
+                                   _ClientAMProtocol(self))
+        self.rpc.start()
+        self.amrm.register(
+            tracking_url=f"htpu-am://127.0.0.1:{self.rpc.port}")
+        try:
+            while not self._stop.is_set():
+                self._reconcile()
+                allocated, done = self.amrm.allocate(progress=0.5)
+                self._place(allocated)
+                self._completed(done)
+                time.sleep(0.1)
+            self._teardown()
+            self.amrm.unregister("SUCCEEDED", "service stopped")
+            return 0
+        finally:
+            self.amrm.close()
+            self.rpc.stop()
+
+    def _reconcile(self) -> None:
+        """Ask for the gap between target and (running + outstanding)."""
+        with self._lock:
+            for name, comp in self.components.items():
+                gap = self.targets[name] - len(self.instances[name]) \
+                    - self._outstanding[name]
+                if gap > 0:
+                    self.amrm.add_request(1, gap, comp.resource)
+                    self._outstanding[name] += gap
+
+    def _place(self, allocated) -> None:
+        for container in allocated:
+            with self._lock:
+                name = next((n for n in self.targets
+                             if self._outstanding[n] > 0), None)
+                if name is None:
+                    self.amrm.release(container.container_id)
+                    continue
+                self._outstanding[name] -= 1
+                comp = self.components[name]
+                # Over target (flexed down while outstanding)?
+                if len(self.instances[name]) >= self.targets[name]:
+                    self.amrm.release(container.container_id)
+                    continue
+                idx = self._next_index[name]
+                self._next_index[name] += 1
+                inst = _Instance(container, idx)
+                self.instances[name].append(inst)
+                self._by_container[str(container.container_id)] = (name,
+                                                                   inst)
+            env = {"HTPU_SERVICE": self.spec.name,
+                   "HTPU_COMPONENT": name,
+                   "HTPU_INSTANCE": str(inst.index)}
+            self.nm.start_container(
+                container, ContainerLaunchContext(comp.launch_command, env))
+
+    def _completed(self, done) -> None:
+        for status in done:
+            cid = str(status.container_id)
+            with self._lock:
+                hit = self._by_container.pop(cid, None)
+                if hit is None:
+                    continue
+                name, inst = hit
+                if inst in self.instances[name]:
+                    self.instances[name].remove(inst)
+                comp = self.components[name]
+                policy = comp.restart_policy
+                if self._stop.is_set():
+                    continue
+                restart = policy == RESTART_ALWAYS or (
+                    policy == RESTART_ON_FAILURE and status.exit_code != 0)
+                if restart and \
+                        len(self.instances[name]) < self.targets[name]:
+                    self._restarts += 1
+                    log.info("service %s: %s instance %d exited (%d); "
+                             "relaunching", self.spec.name, name,
+                             inst.index, status.exit_code)
+        # replacements are requested by the next _reconcile pass
+
+    def _teardown(self) -> None:
+        """Flex everything to 0 and wait briefly for container exits."""
+        with self._lock:
+            for name in self.targets:
+                self.targets[name] = 0
+            live = list(self._by_container)
+        for cid in live:
+            try:
+                name, inst = self._by_container.get(cid, (None, None))
+                if inst is not None:
+                    self.nm.stop_container(inst.container)
+            except (OSError, IOError, AttributeError):
+                pass
+        deadline = time.monotonic() + 5.0
+        while self._by_container and time.monotonic() < deadline:
+            _, done = self.amrm.allocate(progress=1.0)
+            self._completed(done)
+            time.sleep(0.1)
+
+
+class ServiceClient:
+    """Submit/control services (ref: ServiceClient.java: actionCreate,
+    actionFlex, actionStop, getStatus)."""
+
+    def __init__(self, rm_addr: Tuple[str, int],
+                 conf: Optional[Configuration] = None):
+        self.rm_addr = rm_addr
+        self.conf = conf or Configuration()
+        self.yc = YarnClient(rm_addr, self.conf)
+        self._client = Client(self.conf)
+
+    def submit(self, spec: ServiceSpec):
+        app_id, _ = self.yc.create_application()
+        env = {"PYTHONPATH": _repo_root(),
+               "HTPU_SERVICE_SPEC": spec.to_json()}
+        ctx = ApplicationSubmissionContext(
+            app_id, spec.name,
+            ContainerLaunchContext(
+                [sys.executable, "-m", "hadoop_tpu.yarn.services", "--am"],
+                env),
+            am_resource=Resource(256, 1), app_type="yarn-service")
+        self.yc.submit_application(ctx)
+        return app_id
+
+    def _am_proxy(self, app_id):
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            report = self.yc.application_report(app_id)
+            if report.state in (AppState.FAILED, AppState.KILLED):
+                raise IOError(f"service app {report.state}: "
+                              f"{report.diagnostics}")
+            url = report.tracking_url
+            if url.startswith("htpu-am://"):
+                host, port = url[len("htpu-am://"):].split(":")
+                return get_proxy("ClientAMProtocol", (host, int(port)),
+                                 client=self._client)
+            time.sleep(0.2)
+        raise TimeoutError("service AM did not publish its endpoint")
+
+    def status(self, app_id) -> Dict:
+        return self._am_proxy(app_id).get_status()
+
+    def flex(self, app_id, component: str, count: int) -> bool:
+        return self._am_proxy(app_id).flex_component(component, count)
+
+    def stop(self, app_id, timeout: float = 30.0) -> bool:
+        try:
+            self._am_proxy(app_id).stop_service()
+        except (OSError, IOError, TimeoutError):
+            self.yc.kill_application(app_id)
+        report = self.yc.wait_for_completion(app_id, timeout=timeout)
+        return report.state == AppState.FINISHED
+
+    def close(self) -> None:
+        self.yc.close()
+        self._client.stop()
+
+
+def _repo_root() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    existing = os.environ.get("PYTHONPATH", "")
+    return f"{here}:{existing}" if existing else here
+
+
+def am_main() -> int:
+    spec = ServiceSpec.from_json(os.environ["HTPU_SERVICE_SPEC"])
+    master = ServiceMaster(spec)
+    return master.run()
+
+
+if __name__ == "__main__":
+    if "--am" in sys.argv:
+        sys.exit(am_main())
+    print("usage: python -m hadoop_tpu.yarn.services --am", file=sys.stderr)
+    sys.exit(2)

@@ -1,0 +1,161 @@
+"""NFS gateway: ONC RPC plumbing + NFSv3 procedures over a live DFS.
+
+Mirrors the reference tests (ref: hadoop-hdfs-nfs TestRpcProgramNfs3.java
+drives the program with hand-built XDR; TestPortmap.java checks the
+embedded portmapper) — every call here crosses a real TCP socket.
+"""
+
+import os
+
+import pytest
+
+from hadoop_tpu.nfs import NfsGateway, SimpleRpcClient
+from hadoop_tpu.nfs.oncrpc import IPPROTO_TCP
+from hadoop_tpu.nfs.xdr import XdrEncoder
+from hadoop_tpu.testing.minicluster import MiniDFSCluster, fast_conf
+
+NFS_PROGRAM = 100003
+MOUNT_PROGRAM = 100005
+PORTMAP_PROGRAM = 100000
+
+
+@pytest.fixture()
+def gateway(tmp_path):
+    conf = fast_conf()
+    conf.set("dfs.replication", "1")
+    with MiniDFSCluster(num_datanodes=1, conf=conf,
+                        base_dir=str(tmp_path)) as cluster:
+        cluster.wait_active()
+        gw = NfsGateway(cluster.get_filesystem(), export="/")
+        gw.start()
+        try:
+            yield gw
+        finally:
+            gw.stop()
+
+
+def _mount(gw):
+    c = SimpleRpcClient("127.0.0.1", gw.port, MOUNT_PROGRAM, 3)
+    x = c.call(1, XdrEncoder().string("/").getvalue())
+    assert x.u32() == 0
+    fh = x.opaque()
+    c.close()
+    return fh
+
+
+def test_portmap_and_mount(gateway):
+    pm = SimpleRpcClient("127.0.0.1", gateway.port, PORTMAP_PROGRAM, 2)
+    args = XdrEncoder().u32(NFS_PROGRAM).u32(3).u32(IPPROTO_TCP).u32(0)
+    x = pm.call(3, args.getvalue())       # GETPORT
+    assert x.u32() == gateway.port
+    pm.close()
+    fh = _mount(gateway)
+    assert len(fh) == 8
+
+
+def test_nfs3_file_lifecycle(gateway):
+    root = _mount(gateway)
+    nfs = SimpleRpcClient("127.0.0.1", gateway.port, NFS_PROGRAM, 3)
+
+    # MKDIR /data
+    args = XdrEncoder().opaque(root).string("data")
+    args.boolean(False).boolean(False).boolean(False).boolean(False)
+    args.u32(0).u32(0)    # don't-set atime/mtime
+    x = nfs.call(9, args.getvalue())
+    assert x.u32() == 0
+    assert x.boolean()
+    dir_fh = x.opaque()
+
+    # CREATE /data/hello (UNCHECKED + empty sattr)
+    args = XdrEncoder().opaque(dir_fh).string("hello").u32(0)
+    x = nfs.call(8, args.getvalue())
+    assert x.u32() == 0
+    assert x.boolean()
+    file_fh = x.opaque()
+
+    # WRITE: two in-order chunks plus one retransmit
+    payload = os.urandom(100_000)
+    half = len(payload) // 2
+    for off, chunk in ((0, payload[:half]), (half, payload[half:]),
+                       (0, payload[:half])):   # retransmit of chunk 1
+        args = XdrEncoder().opaque(file_fh).u64(off)
+        args.u32(len(chunk)).u32(2).opaque(chunk)   # FILE_SYNC
+        x = nfs.call(7, args.getvalue())
+        assert x.u32() == 0, f"WRITE at {off} failed"
+
+    # COMMIT finalizes the stream
+    args = XdrEncoder().opaque(file_fh).u64(0).u32(0)
+    x = nfs.call(21, args.getvalue())
+    assert x.u32() == 0
+
+    # GETATTR reflects the final size
+    x = nfs.call(1, XdrEncoder().opaque(file_fh).getvalue())
+    assert x.u32() == 0
+    assert x.u32() == 1          # NF3REG
+    x.u32(); x.u32(); x.u32(); x.u32()   # mode nlink uid gid
+    assert x.u64() == len(payload)
+
+    # READ it back in two chunks through the gateway
+    got = b""
+    for off in (0, half):
+        args = XdrEncoder().opaque(file_fh).u64(off).u32(half)
+        x = nfs.call(6, args.getvalue())
+        assert x.u32() == 0
+        x.boolean() and x.opaque_fixed(84)   # skip post_op_attr fattr3
+        n = x.u32()
+        x.boolean()      # eof
+        got += x.opaque()[:n]
+    assert got == payload
+
+    # LOOKUP + READDIRPLUS see it
+    args = XdrEncoder().opaque(dir_fh).string("hello")
+    x = nfs.call(3, args.getvalue())
+    assert x.u32() == 0
+    args = XdrEncoder().opaque(dir_fh).u64(0).opaque_fixed(b"\0" * 8)
+    args.u32(4096).u32(1 << 20)
+    x = nfs.call(17, args.getvalue())
+    assert x.u32() == 0
+
+    # RENAME and REMOVE
+    args = XdrEncoder().opaque(dir_fh).string("hello")
+    args.opaque(dir_fh).string("world")
+    x = nfs.call(14, args.getvalue())
+    assert x.u32() == 0
+    args = XdrEncoder().opaque(dir_fh).string("world")
+    x = nfs.call(12, args.getvalue())
+    assert x.u32() == 0
+    args = XdrEncoder().opaque(dir_fh).string("world")
+    x = nfs.call(3, args.getvalue())
+    assert x.u32() == 2          # NFS3ERR_NOENT
+    nfs.close()
+
+
+def test_out_of_order_writes_reassembled(gateway):
+    """The OpenFileCtx parks ahead-of-cursor writes until the gap fills
+    (ref: OpenFileCtx.nonSequentialWriteInMemory)."""
+    root = _mount(gateway)
+    nfs = SimpleRpcClient("127.0.0.1", gateway.port, NFS_PROGRAM, 3)
+    args = XdrEncoder().opaque(root).string("ooo").u32(0)
+    x = nfs.call(8, args.getvalue())
+    assert x.u32() == 0
+    x.boolean()
+    fh = x.opaque()
+
+    a, b, c = os.urandom(1000), os.urandom(1000), os.urandom(1000)
+    # Send middle chunk first, then the tail, then the head.
+    for off, chunk in ((1000, b), (2000, c), (0, a)):
+        args = XdrEncoder().opaque(fh).u64(off)
+        args.u32(len(chunk)).u32(2).opaque(chunk)
+        x = nfs.call(7, args.getvalue())
+        assert x.u32() == 0
+    args = XdrEncoder().opaque(fh).u64(0).u32(0)
+    assert nfs.call(21, args.getvalue()).u32() == 0   # COMMIT
+
+    args = XdrEncoder().opaque(fh).u64(0).u32(3000)
+    x = nfs.call(6, args.getvalue())
+    assert x.u32() == 0
+    x.boolean() and x.opaque_fixed(84)
+    n = x.u32()
+    x.boolean()
+    assert x.opaque()[:n] == a + b + c
+    nfs.close()
