@@ -65,6 +65,12 @@ class _OpenReplica:
                            + checksum.header())
         self.num_bytes = 0
         self.stolen = False
+        # Bytes of the current incomplete trailing chunk. A client hflush
+        # can end a packet mid-chunk (ref: BlockReceiver's partial-chunk
+        # handling); the next packet then starts unaligned, so its
+        # packet-relative sums can't be appended verbatim — the straddling
+        # chunk's CRC is recomputed over (partial + new) instead.
+        self._partial = b""
         self._io_lock = threading.Lock()
 
     def write_packet(self, data: bytes, sums: bytes) -> None:
@@ -73,7 +79,18 @@ class _OpenReplica:
                 raise IOError(f"writer of blk_{self.block_id} stopped by "
                               f"block recovery")
             self._data_f.write(data)
-            self._meta_f.write(sums)
+            bpc = self.checksum.bytes_per_chunk
+            if self._partial:
+                # Rewind the partial chunk's provisional CRC and re-cover
+                # it together with the new bytes, chunk-aligned.
+                self._meta_f.seek(-4, os.SEEK_END)
+                self._meta_f.truncate()
+                combined = self._partial + data
+                self._meta_f.write(self.checksum.checksums_for(combined))
+            else:
+                combined = data
+                self._meta_f.write(sums)
+            self._partial = combined[len(combined) // bpc * bpc:]
             self.num_bytes += len(data)
 
     def fsync(self) -> None:

@@ -184,14 +184,21 @@ class VolumeSet:
         try:
             shutil.copyfile(sdata, tmp)
             shutil.copyfile(sdata + ".meta", tmp + ".meta")
-            os.replace(tmp, ddata)
+            # Commit meta first, data last: if the second replace fails,
+            # the dst holds at worst an orphan .meta (ignored by the
+            # directory scanner), never a finalized data file without a
+            # .meta that a later reconcile could adopt as corrupt.
             os.replace(tmp + ".meta", ddata + ".meta")
+            os.replace(tmp, ddata)
         except OSError as e:
             log.warning("disk-balancer move of blk_%d failed: %s",
                         block_id, e)
-            for p in (tmp, tmp + ".meta"):
-                if os.path.exists(p):
-                    os.remove(p)
+            for p in (tmp, tmp + ".meta", ddata + ".meta"):
+                try:
+                    if os.path.exists(p):
+                        os.remove(p)
+                except OSError:
+                    pass
             return False
         with dst._lock:
             dst._replicas[block_id] = Replica(

@@ -113,6 +113,17 @@ class OpenFileCtx:
         with self.lock:
             self.last_activity = time.monotonic()
             if offset < self.offset:
+                # Retransmit overlapping the cursor. A pure sub-range
+                # retransmit is idempotent; but Linux clients commonly
+                # re-send a whole dirty page whose tail extends past the
+                # cursor (ref: OpenFileCtx.processOverWrite only accepts
+                # a verified perfect overwrite) — append the unseen tail
+                # rather than silently acking and dropping it.
+                if offset + len(data) > self.offset:
+                    tail = data[self.offset - offset:]
+                    self.stream.write(tail)
+                    self.offset += len(tail)
+                    self._drain_pending()
                 return NFS3_OK  # idempotent retransmit of written bytes
             if offset > self.offset:
                 if self.pending_bytes + len(data) > _WRITE_BUFFER_LIMIT:
@@ -122,12 +133,41 @@ class OpenFileCtx:
                 return NFS3_OK
             self.stream.write(data)
             self.offset += len(data)
-            while self.offset in self.pending:
-                nxt = self.pending.pop(self.offset)
+            self._drain_pending()
+            return NFS3_OK
+
+    def _drain_pending(self) -> None:
+        """Release parked writes the advancing cursor has reached: exact
+        continuations stream out, fully-covered entries are dropped, and
+        partially-overlapped entries contribute only their unseen tail
+        (lock held by caller)."""
+        while True:
+            nxt = self.pending.pop(self.offset, None)
+            if nxt is not None:
                 self.pending_bytes -= len(nxt)
                 self.stream.write(nxt)
                 self.offset += len(nxt)
-            return NFS3_OK
+                continue
+            passed = next((o for o in self.pending if o < self.offset),
+                          None)
+            if passed is None:
+                return
+            data = self.pending.pop(passed)
+            self.pending_bytes -= len(data)
+            if passed + len(data) > self.offset:
+                tail = data[self.offset - passed:]
+                self.stream.write(tail)
+                self.offset += len(tail)
+
+    def flush(self) -> bool:
+        """Persist written-so-far bytes (hflush analog). True on success."""
+        with self.lock:
+            try:
+                if hasattr(self.stream, "flush"):
+                    self.stream.flush()
+                return True
+            except (OSError, IOError):
+                return False
 
     def close(self) -> int:
         with self.lock:
@@ -355,7 +395,16 @@ class Nfs3Gateway(RpcProgram):
         self._post_op_attr(e, path)
         if stat == NFS3_OK:
             e.u32(len(data))
-            e.u32(stable if stable else 0)   # committed == how asked
+            # Only claim DATA_SYNC/FILE_SYNC stability after the bytes
+            # actually reached the stream (out-of-order writes are merely
+            # parked in memory) AND the stream flushed; otherwise a
+            # gateway crash would lose bytes the client was told were
+            # stable (ref: WriteCtx stableHow handling). Anything less
+            # downgrades to UNSTABLE.
+            committed = 0
+            if stable and offset + len(data) <= ctx.offset and ctx.flush():
+                committed = stable
+            e.u32(committed)
             e.opaque_fixed(b"htpu-nfs")      # write verifier (8 bytes)
         return e.getvalue()
 

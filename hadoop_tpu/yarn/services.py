@@ -130,6 +130,9 @@ class ServiceMaster:
         self._next_index: Dict[str, int] = {
             c.name: 0 for c in spec.components}
         self._restarts = 0
+        # Containers the AM itself stopped (flex-down / teardown): their
+        # terminal exit must not count as a component instance finishing.
+        self._am_stopped: set = set()
         self._stop = threading.Event()
         self._lock = threading.Lock()
         self.amrm: Optional[AMRMClient] = None
@@ -165,6 +168,8 @@ class ServiceMaster:
                              key=lambda i: -i.index)[
                 :max(0, len(self.instances[name]) - count)]
         for inst in surplus:
+            with self._lock:
+                self._am_stopped.add(str(inst.container.container_id))
             try:
                 self.nm.stop_container(inst.container)
             except (OSError, IOError):
@@ -203,18 +208,35 @@ class ServiceMaster:
     def _reconcile(self) -> None:
         """Ask for the gap between target and (running + outstanding)."""
         with self._lock:
-            for name, comp in self.components.items():
+            # Distinct priority per component (ref: ServiceScheduler
+            # assigns each component its own priority so allocations can
+            # be attributed back to the asking component).
+            for prio, (name, comp) in enumerate(self.components.items(),
+                                                start=1):
                 gap = self.targets[name] - len(self.instances[name]) \
                     - self._outstanding[name]
                 if gap > 0:
-                    self.amrm.add_request(1, gap, comp.resource)
+                    self.amrm.add_request(prio, gap, comp.resource)
                     self._outstanding[name] += gap
 
     def _place(self, allocated) -> None:
         for container in allocated:
             with self._lock:
-                name = next((n for n in self.targets
-                             if self._outstanding[n] > 0), None)
+                # Attribute the allocation to the component whose ask it
+                # satisfies: match by capability first so heterogeneous
+                # components never receive a container sized for another
+                # component's Resource (ref: ServiceScheduler matches by
+                # priority; the Container wire record here carries the
+                # capability instead).
+                name = next(
+                    (n for n in self.targets
+                     if self._outstanding[n] > 0
+                     and self.components[n].resource.memory_mb
+                     == container.resource.memory_mb
+                     and self.components[n].resource.vcores
+                     == container.resource.vcores),
+                    None) or next((n for n in self.targets
+                                   if self._outstanding[n] > 0), None)
                 if name is None:
                     self.amrm.release(container.container_id)
                     continue
@@ -250,6 +272,11 @@ class ServiceMaster:
                 policy = comp.restart_policy
                 if self._stop.is_set():
                     continue
+                if cid in self._am_stopped:
+                    # Killed by flex-down: not a completion and not a
+                    # failure — never relaunch it, never shrink targets.
+                    self._am_stopped.discard(cid)
+                    continue
                 restart = policy == RESTART_ALWAYS or (
                     policy == RESTART_ON_FAILURE and status.exit_code != 0)
                 if restart and \
@@ -258,6 +285,13 @@ class ServiceMaster:
                     log.info("service %s: %s instance %d exited (%d); "
                              "relaunching", self.spec.name, name,
                              inst.index, status.exit_code)
+                elif not restart:
+                    # Terminal exit (NEVER, or ON_FAILURE with exit 0):
+                    # shrink the target so the next _reconcile doesn't
+                    # see a gap and relaunch it forever (ref:
+                    # ComponentInstance terminated-instance handling).
+                    if self.targets[name] > 0:
+                        self.targets[name] -= 1
         # replacements are requested by the next _reconcile pass
 
     def _teardown(self) -> None:
@@ -270,6 +304,8 @@ class ServiceMaster:
             try:
                 name, inst = self._by_container.get(cid, (None, None))
                 if inst is not None:
+                    with self._lock:
+                        self._am_stopped.add(cid)
                     self.nm.stop_container(inst.container)
             except (OSError, IOError, AttributeError):
                 pass

@@ -313,3 +313,17 @@ def test_short_circuit_fallback_when_replica_moved(cluster, fs):
             slot.data_path = slot.data_path + ".gone"
     with fs.open("/sc2.bin") as f:
         assert f.read() == data
+
+
+def test_unaligned_flush_mid_write(cluster, fs):
+    """hflush at a non-chunk-aligned offset must not corrupt checksums:
+    the DN re-covers the straddling chunk when the next packet arrives
+    (ref: BlockReceiver partial-chunk handling)."""
+    a, b, c = os.urandom(1000), os.urandom(50_001), os.urandom(700)
+    with fs.create("/unaligned_flush.bin") as out:
+        out.write(a)
+        out.flush()          # 1000 % 512 != 0 → partial trailing chunk
+        out.write(b)
+        out.flush()
+        out.write(c)
+    assert fs.read_all("/unaligned_flush.bin") == a + b + c

@@ -159,3 +159,35 @@ def test_out_of_order_writes_reassembled(gateway):
     x.boolean()
     assert x.opaque()[:n] == a + b + c
     nfs.close()
+
+
+def test_write_retransmit_with_tail(gateway):
+    """A Linux client re-sending a whole dirty page whose tail extends
+    past the gateway cursor must not lose the tail (ref:
+    OpenFileCtx.processOverWrite rejects imperfect overwrites; here the
+    unseen suffix is appended instead of silently dropped)."""
+    root = _mount(gateway)
+    nfs = SimpleRpcClient("127.0.0.1", gateway.port, NFS_PROGRAM, 3)
+    args = XdrEncoder().opaque(root).string("page").u32(0)
+    x = nfs.call(8, args.getvalue())
+    assert x.u32() == 0
+    assert x.boolean()
+    fh = x.opaque()
+
+    page = os.urandom(4096)
+    # write first 2K, then retransmit the whole 4K page at offset 0
+    for off, chunk in ((0, page[:2048]), (0, page)):
+        args = XdrEncoder().opaque(fh).u64(off)
+        args.u32(len(chunk)).u32(0).opaque(chunk)   # UNSTABLE
+        x = nfs.call(7, args.getvalue())
+        assert x.u32() == 0
+
+    args = XdrEncoder().opaque(fh).u64(0).u32(0)
+    assert nfs.call(21, args.getvalue()).u32() == 0   # COMMIT
+
+    x = nfs.call(1, XdrEncoder().opaque(fh).getvalue())
+    assert x.u32() == 0
+    assert x.u32() == 1
+    x.u32(); x.u32(); x.u32(); x.u32()
+    assert x.u64() == 4096          # tail bytes 2048-4096 not dropped
+    nfs.close()

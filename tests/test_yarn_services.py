@@ -11,7 +11,8 @@ import pytest
 
 from hadoop_tpu.conf import Configuration
 from hadoop_tpu.testing.minicluster import MiniYARNCluster
-from hadoop_tpu.yarn.services import (RESTART_ON_FAILURE, Component,
+from hadoop_tpu.yarn.services import (RESTART_NEVER, RESTART_ON_FAILURE,
+                                      Component,
                                       ServiceClient, ServiceSpec)
 
 
@@ -77,6 +78,35 @@ def test_flex_unknown_component_rejected(cluster):
               == 1)
         assert not sc.flex(app_id, "nope", 2)
         assert not sc.flex(app_id, "only", -1)
+        assert sc.stop(app_id, timeout=40.0)
+    finally:
+        sc.close()
+
+
+def test_restart_never_runs_once(cluster):
+    """RESTART_NEVER (and ON_FAILURE with exit 0) components must run to
+    completion exactly once, not be relaunched forever (ref:
+    ComponentInstance terminated-instance handling)."""
+    spec = ServiceSpec("oneshot", [
+        Component("task", 1, ["bash", "-c", "exit 0"],
+                  restart_policy=RESTART_NEVER),
+        Component("sleeper", 1, ["bash", "-c", "sleep 300"]),
+    ])
+    sc = ServiceClient(cluster.rm_addr, Configuration(other=cluster.conf))
+    try:
+        app_id = sc.submit(spec)
+        # The one-shot component finishes; its target shrinks to 0 so the
+        # reconcile loop stops replacing it.
+        _wait(lambda: (lambda s:
+                       s["components"]["task"]["running"] == 0
+                       and s["components"]["task"]["target"] == 0
+                       and s["components"]["sleeper"]["running"] == 1)(
+            sc.status(app_id)), timeout=40.0)
+        # Give the loop time to (wrongly) relaunch, then re-check.
+        time.sleep(2.0)
+        st = sc.status(app_id)
+        assert st["components"]["task"]["running"] == 0
+        assert st["restarts"] == 0
         assert sc.stop(app_id, timeout=40.0)
     finally:
         sc.close()
