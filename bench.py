@@ -11,19 +11,51 @@ tree itself publishes no numbers (BASELINE.md), so the baseline is that
 published target utilization, making vs_baseline hardware-neutral:
 >1.0 means this framework utilizes its chip better than the reference
 stack utilizes its own.
+
+Resilience (the tunneled TPU backend has outages): the default mode
+orchestrates — a cheap preflight probe with retry/backoff on
+UNAVAILABLE, then the measurement in a subprocess per preset with a
+wall-clock budget, falling back flagship-1b → flagship-420m → tiny.
+Exactly one JSON line is always printed; on total failure it carries an
+"error" field and rc=1. Successful runs are appended to BENCH_LOG.jsonl
+so every recorded number has an in-repo artifact.
 """
 
 from __future__ import annotations
 
 import argparse
+import datetime
 import json
+import os
+import subprocess
+import sys
 import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
 
 PEAK_BF16_FLOPS = {
     # per-chip peak bf16 FLOP/s by device_kind substring
     "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
     "v4": 275e12, "v6": 918e12, "cpu": 1e12,
 }
+
+# Fallback ladder: (preset, batch, remat, subprocess wall budget seconds).
+# flagship-1b at batch 4 + full remat was the best measured config in
+# round 3 exploration; flagship-420m is the verified round-2 config
+# (BENCH_r02.json, MFU 0.3328); tiny exists so an outage-day run still
+# records *a* number rather than nothing.
+LADDER = [
+    ("flagship-1b", 4, "full", 1500.0),
+    ("flagship-420m", 8, "full", 720.0),
+    ("tiny", 8, "none", 300.0),
+]
+
+PREFLIGHT = (
+    "import jax, jax.numpy as jnp;"
+    "x = jnp.ones((256, 256), jnp.bfloat16);"
+    "print('PREFLIGHT_OK', float((x @ x)[0, 0]),"
+    "      jax.devices()[0].device_kind)"
+)
 
 
 def peak_flops(device) -> float:
@@ -34,32 +66,15 @@ def peak_flops(device) -> float:
     return 197e12
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--preset", default="flagship-1b")
-    ap.add_argument("--batch", type=int, default=4)
-    ap.add_argument("--seq", type=int, default=2048)
-    ap.add_argument("--steps", type=int, default=20)
-    ap.add_argument("--warmup", type=int, default=2)
-    # Default = the measured-best verified config on the v5e: the ~1B
-    # flagship at batch 4 + full remat (MFU 0.527). The old 420M flagship
-    # capped at MFU ~0.34 regardless of batch/remat because its d=1024
-    # contractions only reach ~0.74 of MXU peak (vs ~0.90 at d=2048 —
-    # measured with plain jit matmul chains); remat="none" OOMs at 1B and
-    # remat="dots" fails to compile there on the tunneled backend.
-    ap.add_argument("--remat", default="full",
-                    choices=["none", "full", "dots"])
-    args = ap.parse_args()
+def _measure(args) -> None:
+    """Run one measurement in this process and print the JSON line."""
     remat = {"none": False, "full": True, "dots": "dots"}[args.remat]
-
-    import os
 
     import jax
 
     # Persistent compile cache: the ~1B step takes minutes to compile on
     # the tunneled backend and every bench invocation is a fresh process.
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             ".jax_cache")
+    cache_dir = os.path.join(HERE, ".jax_cache")
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
@@ -116,10 +131,112 @@ def main() -> None:
         "n_params": n_params,
         "batch": args.batch,
         "seq": args.seq,
+        "steps": args.steps,
         "device": getattr(jax.devices()[0], "device_kind", "unknown"),
         "loss": round(float(metrics["loss"]), 4),
     }))
 
 
+def _preflight(budget: float) -> bool:
+    """Cheap backend probe with retry/backoff. True once a trivial jit
+    executes on the device; False when the budget is exhausted."""
+    deadline = time.monotonic() + budget
+    attempt = 0
+    while time.monotonic() < deadline:
+        attempt += 1
+        left = deadline - time.monotonic()
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", PREFLIGHT],
+                capture_output=True, text=True,
+                timeout=max(30.0, min(150.0, left)))
+            if proc.returncode == 0 and "PREFLIGHT_OK" in proc.stdout:
+                print(f"# preflight ok (attempt {attempt}): "
+                      f"{proc.stdout.strip()}", file=sys.stderr)
+                return True
+            print(f"# preflight attempt {attempt} failed rc="
+                  f"{proc.returncode}: {proc.stderr.strip()[-300:]}",
+                  file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"# preflight attempt {attempt} timed out",
+                  file=sys.stderr)
+        time.sleep(min(20.0 * attempt, max(0.0, deadline -
+                                           time.monotonic())))
+    return False
+
+
+def _orchestrate(args) -> int:
+    errors = []
+    if not _preflight(args.preflight_budget):
+        errors.append("preflight: backend UNAVAILABLE within budget")
+        # Fall through anyway with the smallest preset — the measurement
+        # subprocess is the authoritative probe and the backend may have
+        # just come up.
+        ladder = LADDER[-1:]
+    else:
+        ladder = LADDER
+    for preset, batch, remat, budget in ladder:
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--_measure", "--preset", preset, "--batch", str(batch),
+               "--remat", remat, "--seq", str(args.seq),
+               "--steps", str(args.steps), "--warmup", str(args.warmup)]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=budget)
+        except subprocess.TimeoutExpired:
+            errors.append(f"{preset}: exceeded {budget:.0f}s budget")
+            continue
+        result = None
+        for ln in proc.stdout.splitlines():
+            if ln.startswith("{"):
+                try:
+                    parsed = json.loads(ln)
+                except ValueError:
+                    continue
+                if isinstance(parsed, dict) and "metric" in parsed:
+                    result = parsed
+        if proc.returncode == 0 and result:
+            result["fallbacks"] = errors
+            print(json.dumps(result))
+            try:
+                entry = dict(result)
+                entry["timestamp"] = datetime.datetime.now().isoformat(
+                    timespec="seconds")
+                with open(os.path.join(HERE, "BENCH_LOG.jsonl"), "a") as f:
+                    f.write(json.dumps(entry) + "\n")
+            except OSError:
+                pass
+            return 0
+        errors.append(f"{preset}: rc={proc.returncode} "
+                      f"{(proc.stderr or '').strip()[-300:]}")
+    print(json.dumps({
+        "metric": "train_tokens_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "error": "; ".join(errors)[-2000:],
+    }))
+    return 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="flagship-1b")
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--remat", default="full",
+                    choices=["none", "full", "dots"])
+    ap.add_argument("--preflight-budget", type=float, default=420.0)
+    ap.add_argument("--_measure", action="store_true",
+                    help="internal: run one measurement in-process")
+    args = ap.parse_args()
+    if args._measure:
+        _measure(args)
+        return 0
+    return _orchestrate(args)
+
+
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

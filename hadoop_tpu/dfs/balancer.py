@@ -29,10 +29,20 @@ from hadoop_tpu.ipc import Client, get_proxy
 log = logging.getLogger(__name__)
 
 
+
+def _transfer_security(conf: Configuration, nn):
+    """Dial-side security for a standalone balancer/mover process (ref:
+    the reference tools resolve SaslDataTransferClient from conf)."""
+    if not conf.get_bool("dfs.encrypt.data.transfer", False):
+        return dt.default_security()
+    return dt.TransferSecurity(
+        nn.get_data_encryption_key,
+        qop=conf.get("dfs.data.transfer.protection", "privacy"))
+
 def _transfer(source: DatanodeInfo, block: Block,
-              target: DatanodeInfo) -> None:
+              target: DatanodeInfo, security=None) -> None:
     """Command ``source`` to push one replica to ``target``."""
-    sock = dt.connect(source.xfer_addr(), timeout=10.0)
+    sock = dt.connect(source.xfer_addr(), timeout=10.0, security=security)
     try:
         dt.send_frame(sock, {"op": dt.OP_TRANSFER_BLOCK,
                              "b": block.to_wire(),
@@ -57,6 +67,7 @@ class Balancer:
             nn_addrs = [nn_addrs]
         self.nn = get_proxy("ClientProtocol", nn_addrs[0],
                             client=self._client)
+        self.security = _transfer_security(self.conf, self.nn)
 
     def close(self) -> None:
         self._client.stop()
@@ -79,7 +90,8 @@ class Balancer:
             ok = 0
             for source, block, target in plan:
                 try:
-                    _transfer(source, block, target)
+                    _transfer(source, block, target,
+                              security=self.security)
                     ok += 1
                     moved += 1
                 except (OSError, IOError) as e:
@@ -142,6 +154,7 @@ class Mover:
             nn_addrs = [nn_addrs]
         self.nn = get_proxy("ClientProtocol", nn_addrs[0],
                             client=self._client)
+        self.security = _transfer_security(self.conf, self.nn)
 
     def close(self) -> None:
         self._client.stop()
@@ -187,7 +200,7 @@ class Mover:
                 if target is None:
                     break
                 try:
-                    _transfer(bad, block, target)
+                    _transfer(bad, block, target, security=self.security)
                     placed_uuids.add(target.uuid)
                     # Wait for the new replica to register, then retire the
                     # misplaced copy (invalidating first could momentarily

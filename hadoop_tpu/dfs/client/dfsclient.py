@@ -50,6 +50,8 @@ class _ClientProtocolDecl:
     def get_ec_policy(self): ...
     @_idem
     def get_ec_policies(self): ...
+    @_idem
+    def get_data_encryption_key(self): ...
 
 
 class DFSClient:
@@ -83,6 +85,19 @@ class DFSClient:
                 and len(nn_addrs) > 1:
             self.nn = _ObserverReadProxy(
                 provider, policy, self._decl, self, nn_addrs)
+        # Data-transfer encryption (ref: DFSClient's
+        # SaslDataTransferClient under dfs.encrypt.data.transfer): fetch
+        # the NN's current key and install the process dial-side default
+        # so every data socket — pipeline, pread, striped, balancer —
+        # handshakes before the first op frame.
+        self.transfer_security = None
+        if self.conf.get_bool("dfs.encrypt.data.transfer", False):
+            from hadoop_tpu.dfs.protocol import datatransfer as dt
+            self.transfer_security = dt.TransferSecurity(
+                lambda: self.nn.get_data_encryption_key(),
+                qop=self.conf.get("dfs.data.transfer.protection",
+                                  "privacy"))
+            dt.set_default_security(self.transfer_security)
         self._block_sizes: Dict[str, int] = {}
         self._open_files = 0
         self._renewer_lock = threading.Lock()
@@ -203,6 +218,12 @@ class DFSClient:
         if self._renewer_stop is not None:
             self._renewer_stop.set()
         self._rpc_client.stop()
+        if self.transfer_security is not None:
+            from hadoop_tpu.dfs.protocol import datatransfer as dt
+            # Uninstall only if still ours: a newer client may have
+            # replaced the process default.
+            if dt.default_security() is self.transfer_security:
+                dt.set_default_security(None)
 
 
 _OBSERVER_READS = frozenset({

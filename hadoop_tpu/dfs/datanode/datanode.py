@@ -133,9 +133,17 @@ class DataNode(AbstractService):
                 extra_dirs[0].strip() if extra_dirs
                 else os.path.join(self.data_dir, "current"),
                 capacity_override=cap, sync_on_close=sync)
+        security_keys = None
+        if conf.get_bool("dfs.encrypt.data.transfer", False):
+            from hadoop_tpu.dfs.protocol.datatransfer import \
+                DataEncryptionKeys
+            security_keys = DataEncryptionKeys()
         self.xceiver = DataXceiverServer(
             self.store, self._on_block_received, bind_host=self.host,
-            port=conf.get_int("dfs.datanode.port", 0))
+            port=conf.get_int("dfs.datanode.port", 0),
+            security_keys=security_keys,
+            required_qop=conf.get("dfs.data.transfer.protection",
+                                  "privacy"))
         self.heartbeat_interval = conf.get_time_seconds(
             "dfs.heartbeat.interval", 3.0)
         self.block_report_interval = conf.get_time_seconds(
@@ -342,7 +350,8 @@ class DataNode(AbstractService):
     def _ec_reconstruct(self, payload: Dict) -> None:
         """Ref: ErasureCodingWorker.processErasureCodingTasks."""
         from hadoop_tpu.dfs.datanode import ec_worker
-        rebuilt = ec_worker.reconstruct(self.store, payload)
+        rebuilt = ec_worker.reconstruct(
+            self.store, payload, security=self.xceiver._dial_security())
         if rebuilt is not None:
             self._on_block_received(rebuilt)
 
@@ -352,7 +361,8 @@ class DataNode(AbstractService):
             if rep is None:
                 log.warning("asked to transfer %s but replica not found", block)
                 return
-            push_block(self.store, rep.to_block(), targets)
+            push_block(self.store, rep.to_block(), targets,
+                       security=self.xceiver._dial_security())
             log.info("Transferred %s to %s", block, targets)
         except Exception as e:  # noqa: BLE001
             log.warning("transfer of %s failed: %s", block, e)
@@ -395,6 +405,9 @@ class _BPServiceActor:
                 if not registered:
                     self._proxy.register_datanode(
                         dn.datanode_info().to_wire())
+                    if dn.xceiver.security_keys is not None:
+                        dn.xceiver.security_keys.update(
+                            self._proxy.get_data_encryption_keys())
                     registered = True
                     self._send_full_report()
                     last_full_report = _time.monotonic()
@@ -409,6 +422,13 @@ class _BPServiceActor:
                 if _time.monotonic() - last_full_report > \
                         dn.block_report_interval:
                     self._send_full_report()
+                    if dn.xceiver.security_keys is not None:
+                        # Piggyback key refresh: the report interval (6h)
+                        # is inside the key-rotation window (10h TTL,
+                        # rotated at 80%), so a DN never serves with only
+                        # expired keys.
+                        dn.xceiver.security_keys.update(
+                            self._proxy.get_data_encryption_keys())
                     last_full_report = _time.monotonic()
             except Exception as e:  # noqa: BLE001 — survive NN bounces
                 log.debug("heartbeat round to %s failed (%s); will retry",

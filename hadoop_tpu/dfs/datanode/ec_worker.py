@@ -28,12 +28,13 @@ SPAN_CELLS = 64  # cells fetched per source round (64 × 64 KB = 4 MB)
 
 
 def fetch_range(addr: Tuple[str, int], block: Block, offset: int,
-                length: int) -> bytes:
+                length: int, security=None) -> bytes:
     """Read [offset, offset+length) of a remote replica (OP_READ_BLOCK)."""
-    return dt.read_block_range(addr, block.to_wire(), offset, length)
+    return dt.read_block_range(addr, block.to_wire(), offset, length,
+                               security=security)
 
 
-def reconstruct(store, payload: Dict) -> Optional[Block]:
+def reconstruct(store, payload: Dict, security=None) -> Optional[Block]:
     """Execute one EC_RECONSTRUCT command; returns the rebuilt unit block
     (for the incremental report) or None on failure."""
     group = Block.from_wire(payload["group"])
@@ -71,7 +72,8 @@ def reconstruct(store, payload: Dict) -> Optional[Block]:
                 want = min(span_stripes * cell, max(0, src_len - off))
                 blk = Block(group.block_id + idx, group.gen_stamp, src_len)
                 try:
-                    raw = fetch_range(by_idx[idx].xfer_addr(), blk, off, want)
+                    raw = fetch_range(by_idx[idx].xfer_addr(), blk, off,
+                                      want, security=security)
                 except (OSError, EOFError, IOError) as e:
                     log.warning("EC source unit %d unreadable: %s", idx, e)
                     continue

@@ -36,9 +36,16 @@ class DataXceiverServer:
     def __init__(self, store: BlockStore,
                  on_block_received: Callable[[Block], None],
                  bind_host: str = "127.0.0.1", port: int = 0,
-                 fault_injector=None):
+                 fault_injector=None, security_keys=None,
+                 required_qop: str = "privacy"):
         self.store = store
         self.on_block_received = on_block_received
+        # DataEncryptionKeys when dfs.encrypt.data.transfer is on: every
+        # accepted socket must SASL-handshake (ref:
+        # SaslDataTransferServer.java), and mirror/push dials handshake
+        # with the newest key.
+        self.security_keys = security_keys
+        self.required_qop = required_qop
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._lsock.bind((bind_host, port))
@@ -90,9 +97,26 @@ class DataXceiverServer:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             Daemon(self._serve, f"xceiver-{addr[1]}", args=(sock,)).start()
 
+    def _dial_security(self):
+        """Explicit security for DN→DN dials: this DN's own keys when
+        secured, else the process default (covers an in-process
+        minicluster where the client installed it)."""
+        if self.security_keys is None:
+            return dt.default_security()
+        return dt.TransferSecurity(self.security_keys.newest,
+                                   qop=self.required_qop)
+
     def _serve(self, sock: socket.socket) -> None:
         self.active_xceivers += 1
         try:
+            if self.security_keys is not None:
+                from hadoop_tpu.security.ugi import AccessControlError
+                try:
+                    sock = dt.secure_accept(sock, self.security_keys,
+                                            self.required_qop)
+                except AccessControlError as e:
+                    log.warning("xceiver rejected peer: %s", e)
+                    return
             req = dt.recv_frame(sock)
             op = req.get("op")
             if op == dt.OP_WRITE_BLOCK:
@@ -130,7 +154,8 @@ class DataXceiverServer:
         if targets:
             nxt, rest = targets[0], targets[1:]
             try:
-                down = dt.connect(nxt.xfer_addr())
+                down = dt.connect(nxt.xfer_addr(),
+                                  security=self._dial_security())
                 fwd = dict(req)
                 fwd["targets"] = [t.to_wire() for t in rest]
                 dt.send_frame(down, fwd)
@@ -253,7 +278,8 @@ class DataXceiverServer:
         block = Block.from_wire(req["b"])
         targets = [DatanodeInfo.from_wire(t) for t in req.get("targets", [])]
         try:
-            push_block(self.store, block, targets)
+            push_block(self.store, block, targets,
+                       security=self._dial_security())
         except (OSError, IOError) as e:
             dt.send_frame(sock, {"ok": False, "em": str(e)})
             return
@@ -303,13 +329,14 @@ class DataXceiverServer:
 
 
 def push_block(store: BlockStore, block: Block,
-               targets: List[DatanodeInfo]) -> None:
+               targets: List[DatanodeInfo],
+               security=None) -> None:
     """Re-replication push: stream a local finalized replica into a pipeline
     of targets. Ref: DataNode.DataTransfer (new Sender().writeBlock for
     TRANSFER stage)."""
     if not targets:
         return
-    sock = dt.connect(targets[0].xfer_addr())
+    sock = dt.connect(targets[0].xfer_addr(), security=security)
     try:
         dt.send_frame(sock, {
             "op": dt.OP_WRITE_BLOCK, "b": block.to_wire(),

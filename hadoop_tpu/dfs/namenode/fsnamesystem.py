@@ -93,6 +93,14 @@ class FSNamesystem:
             soft_limit_s=conf.get_time_seconds("dfs.lease.soft-limit", 60.0),
             hard_limit_s=conf.get_time_seconds("dfs.lease.hard-limit", 1200.0))
         self.bm = BlockManager(conf)
+        # Data-transfer encryption keys (ref: BlockTokenSecretManager's
+        # DataEncryptionKey minting under dfs.encrypt.data.transfer):
+        # clients fetch the current key, DNs fetch the full set.
+        self.data_encryption_keys = None
+        if conf.get_bool("dfs.encrypt.data.transfer", False):
+            from hadoop_tpu.dfs.protocol.datatransfer import \
+                DataEncryptionKeys
+            self.data_encryption_keys = DataEncryptionKeys()
         self._next_block_id = 1 << 30   # ref: SequentialBlockIdGenerator
         self._next_group_id = ec.STRIPED_ID_BASE  # striped block groups
         self._gen_stamp = 1000          # ref: GenerationStamp

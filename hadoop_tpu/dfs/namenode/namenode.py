@@ -286,6 +286,14 @@ class ClientProtocol:
         return [n.public_info().to_wire() for n in nodes]
 
     @idempotent
+    def get_data_encryption_key(self):
+        """Current key for a dialing client (ref:
+        ClientProtocol.getDataEncryptionKey). None when
+        dfs.encrypt.data.transfer is off."""
+        dek = self.fsn.data_encryption_keys
+        return dek.current() if dek is not None else None
+
+    @idempotent
     def get_stats(self):
         fsn = self.fsn
         return {
@@ -370,6 +378,13 @@ class DatanodeProtocol:
     def register_datanode(self, info: Dict) -> Dict:
         node = self.fsn.bm.dn_manager.register(DatanodeInfo.from_wire(info))
         return {"uuid": node.uuid}
+
+    @idempotent
+    def get_data_encryption_keys(self) -> List[Dict]:
+        """Full key set for an accepting DN (ref: the NN handing
+        BlockTokenSecretManager keys to DNs via DatanodeProtocol)."""
+        dek = self.fsn.data_encryption_keys
+        return dek.all_wire() if dek is not None else []
 
     @idempotent
     def send_heartbeat(self, uuid: str, capacity: int, dfs_used: int,

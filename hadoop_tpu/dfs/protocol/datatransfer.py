@@ -65,17 +65,179 @@ def recv_frame(sock: socket.socket) -> Dict:
     return msg
 
 
-def connect(addr, timeout: float = 30.0) -> socket.socket:
+# Process-wide dial-side security default (ref: the reference resolves
+# SaslDataTransferClient from the client conf everywhere a data socket
+# is dialed). Explicit ``security=`` wins; the DFS client installs the
+# default when dfs.encrypt.data.transfer is on so every dial site —
+# pipelines, preads, striped IO, balancer, EC reconstruction — is
+# covered without threading a handle through each.
+_default_security = None
+
+
+def set_default_security(sec) -> None:
+    global _default_security
+    _default_security = sec
+
+
+def default_security():
+    return _default_security
+
+
+def connect(addr, timeout: float = 30.0, security=None):
     sock = socket.create_connection(addr, timeout=timeout)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     # Throughput plane: fat buffers (≥ a few packets in flight per hop).
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4 << 20)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4 << 20)
+    sec = security if security is not None else _default_security
+    if sec is not None:
+        return sec.dial(sock)
     return sock
 
 
+# ------------------------------------------------------- transfer security
+
+class DataEncryptionKeys:
+    """Rotating shared secrets for SASL on the data plane.
+
+    Ref: the reference's DataEncryptionKey flow — the NN's
+    BlockTokenSecretManager mints encryption keys
+    (``dfs.encrypt.data.transfer``), DNs learn them via the NN, clients
+    fetch them with getDataEncryptionKey(), and
+    SaslDataTransferClient/Server run DIGEST-MD5 with
+    ``user = <keyId>``. Same contract here with the SCRAM-analog:
+    user ``dek-<kid>``, secret = the key bytes.
+
+    One class serves both roles: the NN generates/rotates; DNs/clients
+    ingest wire copies. ``credentials`` is the SaslServerSession
+    callable for the accepting DN.
+    """
+
+    def __init__(self, ttl_s: float = 10 * 3600.0):
+        import threading
+        import time as _time
+        self._ttl = ttl_s
+        self._time = _time
+        self._lock = threading.Lock()
+        self._keys: Dict[int, Dict] = {}
+        self._current_kid = 0
+        self._verifiers: Dict[int, Dict] = {}
+
+    def current(self) -> Dict:
+        """NN role: the active key, rotating it when 80% expired."""
+        import secrets
+        now = self._time.time()
+        with self._lock:
+            cur = self._keys.get(self._current_kid)
+            if cur is None or cur["expiry"] - now < 0.2 * self._ttl:
+                self._current_kid += 1
+                cur = {"kid": self._current_kid,
+                       "key": secrets.token_bytes(32),
+                       "expiry": now + self._ttl}
+                self._keys[self._current_kid] = cur
+                for kid in list(self._keys):
+                    if self._keys[kid]["expiry"] < now:
+                        del self._keys[kid]
+                        self._verifiers.pop(kid, None)
+            return dict(cur)
+
+    def all_wire(self) -> list:
+        self.current()  # ensure at least one live key
+        with self._lock:
+            return [dict(k) for k in self._keys.values()]
+
+    def update(self, entries: list) -> None:
+        """DN role: ingest the NN's key set."""
+        with self._lock:
+            for e in entries:
+                self._keys[e["kid"]] = dict(e)
+
+    def newest(self) -> Dict:
+        """Dial-side key for a node that only ingests (DN→DN push)."""
+        with self._lock:
+            if not self._keys:
+                raise IOError("no data encryption keys received yet")
+            return dict(self._keys[max(self._keys)])
+
+    def credentials(self, user: str):
+        if not user.startswith("dek-"):
+            return None
+        try:
+            kid = int(user[4:])
+        except ValueError:
+            return None
+        from hadoop_tpu.security.sasl import scram_verifier
+        with self._lock:
+            if kid not in self._verifiers:
+                key = self._keys.get(kid)
+                if key is None or key["expiry"] < self._time.time():
+                    return None
+                self._verifiers[kid] = scram_verifier(key["key"])
+            return dict(self._verifiers[kid])
+
+
+class TransferSecurity:
+    """Client-dial half: fetch/cache a DEK, SASL-handshake each data
+    socket, return the (possibly cipher-wrapped) channel. Ref:
+    SaslDataTransferClient.java."""
+
+    def __init__(self, dek_provider, qop: str = "privacy"):
+        self._dek_provider = dek_provider
+        self.qop = qop
+        self._cached: Optional[Dict] = None
+
+    def _dek(self) -> Dict:
+        import time as _time
+        if self._cached is None or \
+                self._cached["expiry"] - _time.time() < 60.0:
+            dek = self._dek_provider()
+            if not dek:
+                # e.g. the NN has dfs.encrypt.data.transfer off while
+                # this client has it on — a config mismatch, not a bug
+                # in the dial path.
+                raise IOError(
+                    "client requires data transfer encryption but the "
+                    "NameNode issued no data encryption key")
+            self._cached = dek
+        return self._cached
+
+    def dial(self, sock):
+        from hadoop_tpu.security.sasl import (MECH_SCRAM, CipherSocket,
+                                              SaslClientSession)
+        dek = self._dek()
+        sess = SaslClientSession(MECH_SCRAM, user=f"dek-{dek['kid']}",
+                                 password=dek["key"], qop=self.qop)
+        send_frame(sock, {"sasl": sess.initiate()})
+        reply = recv_frame(sock)
+        if "sasl" not in reply:
+            raise IOError(reply.get("em", "DN did not negotiate SASL"))
+        send_frame(sock, {"sasl": sess.step(reply["sasl"])})
+        reply = recv_frame(sock)
+        if "sasl" not in reply:
+            raise IOError(reply.get("em", "SASL handshake refused"))
+        sess.step(reply["sasl"])
+        return CipherSocket(sock, sess.cipher) if sess.cipher else sock
+
+
+def secure_accept(sock, keys: DataEncryptionKeys, required_qop: str):
+    """DN-accept half (ref: SaslDataTransferServer.java). Raises
+    AccessControlError on a plaintext or unauthenticated peer."""
+    from hadoop_tpu.security.sasl import CipherSocket, SaslServerSession
+    from hadoop_tpu.security.ugi import AccessControlError
+    sess = SaslServerSession(keys.credentials, required_qop=required_qop)
+    first = recv_frame(sock)
+    if "sasl" not in first:
+        send_frame(sock, {"ok": False,
+                          "em": "data transfer protection is required"})
+        raise AccessControlError("unprotected data-transfer peer rejected")
+    send_frame(sock, {"sasl": sess.step(first["sasl"])})
+    second = recv_frame(sock)
+    send_frame(sock, {"sasl": sess.step(second.get("sasl") or {})})
+    return CipherSocket(sock, sess.cipher) if sess.cipher else sock
+
+
 def read_block_range(addr, block_wire: Dict, offset: int,
-                     length: int) -> bytes:
+                     length: int, security=None) -> bytes:
     """Read [offset, offset+length) of one replica over OP_READ_BLOCK,
     verifying checksums. The shared client of BlockSender — used by the
     striped reader, the EC reconstruction worker, and the balancer
@@ -83,7 +245,7 @@ def read_block_range(addr, block_wire: Dict, offset: int,
     from hadoop_tpu.util.crc import DataChecksum
     if length <= 0:
         return b""
-    sock = connect(addr, timeout=10.0)
+    sock = connect(addr, timeout=10.0, security=security)
     try:
         send_frame(sock, {"op": OP_READ_BLOCK, "b": block_wire,
                           "offset": offset, "length": length})

@@ -91,6 +91,7 @@ class _Connection:
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.sock.settimeout(None)
         self.last_activity = time.monotonic()
+        self.cipher = None
         hdr: Dict[str, Any] = {
             "magic": MAGIC,
             "protocol": self.protocol,
@@ -100,11 +101,70 @@ class _Connection:
         }
         token = self.user.tokens.get(self.client.token_kind) \
             if self.client.token_kind else None
+        if conf.get("hadoop.security.authentication",
+                    "simple").lower() == "sasl":
+            self._sasl_handshake(conf, hdr, token, timeout)
+            return
         if token is not None:
             hdr["auth"] = UserGroupInformation.AUTH_TOKEN
             hdr["token"] = token.to_wire()
         payload = pack(hdr)
         self.sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+    def _sasl_handshake(self, conf, hdr: Dict, token, timeout: float) -> None:
+        """Mutual auth before the connection goes live (ref:
+        SaslRpcClient.java saslConnect — negotiation frames precede the
+        connection context; here the initiate rides in the header)."""
+        from hadoop_tpu.io.wire import read_frame
+        from hadoop_tpu.security.sasl import (MECH_SCRAM, MECH_TOKEN,
+                                              QOP_AUTH, SaslClientSession,
+                                              password_from_keytab)
+        qop = conf.get("hadoop.rpc.protection", QOP_AUTH).lower()
+        # The REAL user authenticates; an effective (proxy) user rides in
+        # the header on top of the proven identity.
+        auth_ugi = self.user.real_user or self.user
+        if token is not None:
+            sess = SaslClientSession(MECH_TOKEN, token=token, qop=qop)
+        else:
+            password = getattr(auth_ugi, "sasl_password", None) or \
+                getattr(self.user, "sasl_password", None)
+            if password is None:
+                keytab = conf.get("hadoop.security.client.keytab", None)
+                if keytab:
+                    password = password_from_keytab(keytab,
+                                                    auth_ugi.user_name)
+            if password is None:
+                raise FatalRpcError(
+                    f"SASL required but no credentials for "
+                    f"{auth_ugi.user_name!r} (login_from_keytab or set "
+                    f"hadoop.security.client.keytab)")
+            sess = SaslClientSession(MECH_SCRAM, user=auth_ugi.user_name,
+                                     password=password, qop=qop)
+        hdr["auth"] = "SASL"
+        hdr["sasl"] = sess.initiate()
+        self.sock.settimeout(timeout)
+        try:
+            payload = pack(hdr)
+            self.sock.sendall(struct.pack(">I", len(payload)) + payload)
+            reply = self._handshake_reply(read_frame)
+            resp = sess.step(reply)
+            payload = pack({"sasl": resp})
+            self.sock.sendall(struct.pack(">I", len(payload)) + payload)
+            sess.step(self._handshake_reply(read_frame))
+        finally:
+            self.sock.settimeout(None)
+        self.cipher = sess.cipher
+
+    def _handshake_reply(self, read_frame) -> Dict:
+        msg = unpack(read_frame(self.sock, MAX_CLIENT_FRAME))
+        if not isinstance(msg, dict) or msg.get("fatal"):
+            raise FatalRpcError(
+                (msg or {}).get("em", "connection failed during SASL")
+                if isinstance(msg, dict) else "bad SASL reply")
+        sasl = msg.get("sasl")
+        if not isinstance(sasl, dict):
+            raise FatalRpcError("server reply missing SASL body")
+        return sasl
 
     def _receive_loop(self) -> None:
         import select
@@ -171,6 +231,8 @@ class _Connection:
         """Process one response frame; returns False when the connection is
         being torn down."""
         try:
+            if self.cipher is not None:
+                frame = self.cipher.unwrap(frame)
             msg = unpack(frame)
         except Exception as e:  # noqa: BLE001
             self._fail_all(RpcError(f"bad response frame: {e}"))
@@ -224,6 +286,8 @@ class _Connection:
                     f"connection to {self.addr} closed before send")
             self.calls[call_id] = pend
         payload = pack(req)
+        if self.cipher is not None:
+            payload = self.cipher.wrap(payload)
         data = struct.pack(">I", len(payload)) + payload
         self.last_activity = time.monotonic()
         try:
@@ -238,6 +302,8 @@ class _Connection:
 
     def ping(self) -> None:
         payload = pack({"id": PING_CALL_ID})
+        if self.cipher is not None:
+            payload = self.cipher.wrap(payload)
         with self.send_lock:
             self.sock.sendall(struct.pack(">I", len(payload)) + payload)
 

@@ -16,7 +16,11 @@ request. Responses carry a server state id for observer-read alignment
 
 Auth: SIMPLE trusts the client-claimed user (as the reference does without
 Kerberos); TOKEN verifies an HMAC delegation token against the server's
-SecretManager (ref: security/SaslRpcServer.java DIGEST-MD5 path).
+SecretManager (ref: security/SaslRpcServer.java DIGEST-MD5 path); SASL
+performs SCRAM-style mutual authentication with optional AES-GCM wire
+privacy (security/sasl.py; ref: SaslRpcServer.java negotiation +
+``hadoop.rpc.protection``). ``hadoop.security.authentication=sasl``
+makes the server REJECT unauthenticated (SIMPLE) connections.
 """
 
 from __future__ import annotations
@@ -84,6 +88,9 @@ class _Connection:
         self.inbuf = bytearray()
         self.header: Optional[Dict] = None
         self.user: Optional[UserGroupInformation] = None
+        self.sasl = None            # in-flight SaslServerSession
+        self.pending_header: Optional[Dict] = None
+        self.cipher = None          # WireCipher once privacy negotiated
         self.out_pending: deque = deque()
         self.out_lock = threading.Lock()
         self.closed = False
@@ -119,6 +126,19 @@ class Server:
         self.num_readers = max(1, num_readers)
         self.secret_manager = secret_manager
         self.state_provider = state_provider  # AlignmentContext analog
+        # SASL posture (ref: SaslRpcServer + SaslPropertiesResolver):
+        # "simple" accepts anything; "sasl" demands a successful SASL
+        # handshake from every connection. Credentials come from the
+        # server keytab (MiniKdc-provisioned in tests).
+        self.auth_mode = self.conf.get(
+            "hadoop.security.authentication", "simple").lower()
+        self.required_qop = self.conf.get(
+            "hadoop.rpc.protection", "authentication").lower()
+        self._credentials = None
+        keytab = self.conf.get("hadoop.security.server.keytab", None)
+        if keytab:
+            from hadoop_tpu.security.sasl import CredentialStore
+            self._credentials = CredentialStore().load_keytab(keytab)
         self._protocols: Dict[str, Any] = {}
         self._pre_calls: Dict[str, Callable] = {}
         self._callq = CallQueueManager(self.conf, queue_capacity, queue_prefix)
@@ -253,6 +273,13 @@ class Server:
 
     def _on_frame(self, conn: _Connection, frame: bytes) -> None:
         conn.last_activity = time.monotonic()
+        if conn.cipher is not None:
+            try:
+                frame = conn.cipher.unwrap(frame)
+            except AccessControlError as e:
+                log.warning("Undecryptable frame from %s: %s", conn.addr, e)
+                self._close_conn(conn)
+                return
         try:
             msg = unpack(frame)
         except WireError as e:
@@ -263,6 +290,9 @@ class Server:
             log.warning("Non-record frame (%s) from %s", type(msg).__name__,
                         conn.addr)
             self._close_conn(conn)
+            return
+        if conn.sasl is not None and not conn.sasl.complete:
+            self._sasl_continue(conn, msg)
             return
         if conn.header is None:
             self._process_header(conn, msg)
@@ -282,6 +312,18 @@ class Server:
             self._send_fatal(conn, f"bad magic {hdr.get('magic')!r}")
             return
         auth = hdr.get("auth", UserGroupInformation.AUTH_SIMPLE)
+        if auth == "SASL":
+            self._sasl_initiate(conn, hdr)
+            return
+        if self.auth_mode == "sasl":
+            # Hard requirement (ref: Server.java refuses SIMPLE when
+            # security is on): an unauthenticated client gets a fatal
+            # close, never a dispatched call.
+            self._m_auth_failures.incr()
+            self._send_fatal(
+                conn, "SIMPLE authentication is not enabled; this server "
+                "requires SASL")
+            return
         try:
             if auth == UserGroupInformation.AUTH_TOKEN:
                 if self.secret_manager is None:
@@ -317,6 +359,58 @@ class Server:
             return
         conn.header = hdr
         conn.user = user
+
+    # ------------------------------------------------------------------ sasl
+
+    def _sasl_initiate(self, conn: _Connection, hdr: Dict) -> None:
+        """First SASL leg, carried inside the connection header. Ref:
+        SaslRpcServer.java — negotiate, then the connection context."""
+        from hadoop_tpu.security.sasl import SaslServerSession
+        init = hdr.get("sasl")
+        if not isinstance(init, dict):
+            self._m_auth_failures.incr()
+            self._send_fatal(conn, "SASL auth without an initiate message")
+            return
+        sess = SaslServerSession(self._credentials, self.secret_manager,
+                                 required_qop=self.required_qop)
+        try:
+            challenge = sess.step(init)
+        except AccessControlError as e:
+            self._m_auth_failures.incr()
+            self._send_fatal(conn, f"auth failed: {e}")
+            return
+        conn.sasl = sess
+        conn.pending_header = hdr
+        self._responder.respond(conn, pack({"id": -3, "sasl": challenge}))
+
+    def _sasl_continue(self, conn: _Connection, msg: Dict) -> None:
+        """Client proof leg → success (mutual proof) → connection live."""
+        try:
+            reply = conn.sasl.step(msg.get("sasl") or {})
+        except AccessControlError as e:
+            self._m_auth_failures.incr()
+            self._send_fatal(conn, f"auth failed: {e}")
+            return
+        hdr = conn.pending_header or {}
+        authed = conn.sasl.user
+        real_ugi = UserGroupInformation.create_remote_user(
+            authed, auth=UserGroupInformation.AUTH_KERBEROS
+            if conn.sasl.token_ident is None
+            else UserGroupInformation.AUTH_TOKEN)
+        effective = hdr.get("user") or authed
+        if effective != authed:
+            # Impersonation rides on top of the PROVEN identity (ref:
+            # proxy users under Kerberos).
+            conn.user = UserGroupInformation.create_proxy_user(
+                effective, real_ugi)
+        else:
+            conn.user = real_ugi
+        conn.header = hdr
+        # Success goes out in PLAINTEXT (the client derives its cipher
+        # while processing it); everything after is encrypted when
+        # privacy was negotiated.
+        self._responder.respond(conn, pack({"id": -3, "sasl": reply}))
+        conn.cipher = conn.sasl.cipher
 
     # -------------------------------------------------------------- handlers
 
@@ -536,6 +630,8 @@ class _Responder:
                 close_after: bool = False) -> None:
         if conn.closed:
             return
+        if conn.cipher is not None:
+            payload = conn.cipher.wrap(payload)
         data = struct.pack(">I", len(payload)) + payload
         with conn.out_lock:
             empty = not conn.out_pending

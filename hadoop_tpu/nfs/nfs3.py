@@ -126,8 +126,12 @@ class OpenFileCtx:
                     self._drain_pending()
                 return NFS3_OK  # idempotent retransmit of written bytes
             if offset > self.offset:
-                if self.pending_bytes + len(data) > _WRITE_BUFFER_LIMIT:
+                prior = self.pending.get(offset)
+                if self.pending_bytes - (len(prior) if prior else 0) \
+                        + len(data) > _WRITE_BUFFER_LIMIT:
                     return NFS3ERR_IO
+                if prior is not None:  # retransmit of a parked write
+                    self.pending_bytes -= len(prior)
                 self.pending[offset] = data
                 self.pending_bytes += len(data)
                 return NFS3_OK

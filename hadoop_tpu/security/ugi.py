@@ -55,6 +55,7 @@ class UserGroupInformation:
         self.auth_method = auth_method
         self.real_user = real_user  # impersonation: proxy-user chains
         self.tokens: Dict[str, "Token"] = {}
+        self.sasl_password: Optional[bytes] = None  # set by keytab login
 
     # ------------------------------------------------------------- factories
 
@@ -78,13 +79,16 @@ class UserGroupInformation:
 
     @classmethod
     def login_from_keytab(cls, principal: str, keytab_path: str) -> "UserGroupInformation":
-        """Kerberos seam (ref: UGI.loginUserFromKeytab:1107). Validates the
-        keytab exists and records the principal; actual KDC exchange is the
-        pluggable part left for a kerberos backend."""
+        """Load credentials for SASL auth (ref: UGI.loginUserFromKeytab
+        :1107). The keytab (MiniKdc-written in tests) holds the
+        principal's secret; the SASL client proves possession of it
+        without ever transmitting it (security/sasl.py)."""
         if not os.path.exists(keytab_path):
             raise AccessControlError(f"keytab not found: {keytab_path}")
+        from hadoop_tpu.security.sasl import password_from_keytab
         user = principal.split("/")[0].split("@")[0]
         ugi = cls(user, auth_method=cls.AUTH_KERBEROS)
+        ugi.sasl_password = password_from_keytab(keytab_path, principal)
         with cls._lock:
             cls._login_user = ugi
         return ugi

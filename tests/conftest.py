@@ -33,7 +33,11 @@ logging.basicConfig(level=logging.INFO)
 def _reset_global_state():
     """Each test gets a clean config registry and metrics system."""
     from hadoop_tpu.conf import ConfigRegistry
+    from hadoop_tpu.dfs.protocol import datatransfer
     from hadoop_tpu.metrics import metrics_system
     yield
     ConfigRegistry.reset_for_tests()
     metrics_system().reset_for_tests()
+    datatransfer.set_default_security(None)
+    from hadoop_tpu.security.ugi import UserGroupInformation
+    UserGroupInformation._login_user = None
