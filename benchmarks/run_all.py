@@ -11,6 +11,32 @@ import platform
 import time
 
 
+def _dynamometer(n_ops: int) -> dict:
+    import os
+    import tempfile
+
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster, fast_conf
+    from hadoop_tpu.tools import dynamometer as dyn
+
+    conf = fast_conf()
+    conf.set("dfs.replication", "1")
+    import shutil
+    base = tempfile.mkdtemp(prefix="dynamometer-",
+                            dir="/dev/shm" if os.path.isdir("/dev/shm")
+                            else None)
+    try:
+        with MiniDFSCluster(num_datanodes=1, conf=conf,
+                            base_dir=base) as c:
+            c.wait_active()
+            trace = os.path.join(base, "audit.log")
+            dyn.generate_trace(trace, n_ops, workers=8)
+            with open(trace) as f:
+                return dyn.replay_parallel(c.default_fs, list(f),
+                                           threads=8)
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="STORAGE_BENCH.json")
@@ -39,6 +65,19 @@ def main() -> None:
     # 400 MB: big enough that scheduling/launch overhead amortizes (the
     # canonical benchmark is run at terabyte scale for the same reason)
     out["terasort"] = terasort_bench.run(records=int(4_000_000 * scale))
+    # SLS: the REAL RM behind its RPC services under a 1,000-node
+    # simulated fleet (ref: SLSRunner.java); and the real scheduler
+    # object driven directly for the pure decision rate.
+    from hadoop_tpu.tools import sls
+    out["sls"] = sls.run_rm(num_nodes=int(1000 * scale) or 200,
+                            num_apps=int(40 * scale) or 8,
+                            containers_per_app=50, sweeps=20)
+    out["sls_scheduler_direct"] = sls.run(
+        num_nodes=int(1000 * scale) or 200, num_apps=int(40 * scale) or 8,
+        containers_per_app=50, ticks=2000)
+    # Dynamometer: >=100K-op audit replay against a real NameNode over
+    # real RPC (ref: hadoop-dynamometer AuditReplayMapper).
+    out["dynamometer"] = _dynamometer(int(100_000 * scale) or 20_000)
     out["wall_seconds"] = round(time.perf_counter() - t0, 1)
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)

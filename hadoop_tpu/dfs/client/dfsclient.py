@@ -99,6 +99,10 @@ class DFSClient:
                                   "privacy"))
             dt.set_default_security(self.transfer_security)
         self._block_sizes: Dict[str, int] = {}
+        self._hedged_pool = None
+        self._hedged_pool_lock = threading.Lock()
+        self.hedged_reads = 0   # hedges started (metric parity:
+        self.hedged_wins = 0    # DFSHedgedReadMetrics)
         self._open_files = 0
         self._renewer_lock = threading.Lock()
         self._renewer_stop: Optional[threading.Event] = None
@@ -214,10 +218,25 @@ class DFSClient:
             except Exception as e:  # noqa: BLE001
                 log.warning("lease renewal failed: %s", e)
 
+    def hedged_pool(self):
+        """Shared executor for hedged reads (ref: DFSClient
+        .initThreadsNumForHedgedReads)."""
+        with self._hedged_pool_lock:
+            if self._hedged_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                size = self.conf.get_int(
+                    "dfs.client.hedged.read.threadpool.size", 4)
+                self._hedged_pool = ThreadPoolExecutor(
+                    max_workers=max(2, size),
+                    thread_name_prefix="hedged-read")
+            return self._hedged_pool
+
     def close(self) -> None:
         if self._renewer_stop is not None:
             self._renewer_stop.set()
         self._rpc_client.stop()
+        if self._hedged_pool is not None:
+            self._hedged_pool.shutdown(wait=False)
         if self.transfer_security is not None:
             from hadoop_tpu.dfs.protocol import datatransfer as dt
             # Uninstall only if still ours: a newer client may have

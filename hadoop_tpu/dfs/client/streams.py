@@ -370,6 +370,17 @@ class DFSInputStream:
         conf = getattr(client, "conf", None)
         self._short_circuit_ok = conf is None or conf.get_bool(
             "dfs.client.read.shortcircuit", True)
+        # Hedged reads (ref: DFSInputStream's hedged-read path +
+        # dfs.client.hedged.read.threadpool.size/threshold.millis):
+        # enabled by a nonzero pool size; after the threshold with no
+        # answer from the first replica, a second read races it.
+        self._hedged_threshold_s = 0.5
+        self._hedged_enabled = False
+        if conf is not None and conf.get_int(
+                "dfs.client.hedged.read.threadpool.size", 0) > 0:
+            self._hedged_enabled = True
+            self._hedged_threshold_s = conf.get_time_seconds(
+                "dfs.client.hedged.read.threshold", 0.5)
 
     def _refresh_locations(self) -> None:
         self._set_locations(self.client.get_block_locations(self.path))
@@ -441,6 +452,16 @@ class DFSInputStream:
         errors: List[str] = []
         candidates = [d for d in lb.locations if d.uuid not in self._dead] \
             or lb.locations  # all dead? retry everyone once
+        if self._hedged_enabled and len(candidates) > 1:
+            try:
+                return self._hedged_fetch(candidates, lb.block,
+                                          in_block_off, want)
+            except (OSError, EOFError, IOError) as e:
+                errors.append(f"hedged: {e}")
+                # Every candidate was already tried (and failed) inside
+                # the hedge — go straight to the refresh/backoff rounds
+                # instead of paying each connect timeout a second time.
+                candidates = []
         for dn in candidates:
             try:
                 return self._read_from_datanode(dn, lb.block, in_block_off,
@@ -470,6 +491,48 @@ class DFSInputStream:
                 time.sleep(self.RETRY_BACKOFF_S * (attempt + 1))
         raise IOError(f"could not read {self.path} at {pos} from any "
                       f"replica: {errors}")
+
+    def _hedged_fetch(self, candidates: List[DatanodeInfo], block: Block,
+                      offset: int, want: int) -> bytes:
+        """Race replicas: the first read gets ``threshold`` alone; then a
+        hedge starts on the next replica and the first success wins. A
+        replica that errors triggers the next hedge immediately. Losers
+        run to completion in the pool (ref: DFSInputStream
+        .hedgedFetchBlockByteRange — it too lets stragglers finish)."""
+        import concurrent.futures as cf
+        pool = self.client.hedged_pool()
+        pending = list(candidates)
+        by_future = {}
+        first = pending.pop(0)
+        by_future[pool.submit(self._read_from_datanode, first, block,
+                              offset, want)] = first
+        errors: List[str] = []
+        while True:
+            timeout = self._hedged_threshold_s if pending else None
+            done, _ = cf.wait(list(by_future), timeout=timeout,
+                              return_when=cf.FIRST_COMPLETED)
+            for f in done:
+                dn = by_future.pop(f)
+                exc = f.exception()
+                if exc is None:
+                    self.client.hedged_wins += 1
+                    return f.result()
+                # Same failure bookkeeping as the sequential path: a
+                # corrupt replica is reported and a failed one goes on
+                # the dead list so later reads skip it.
+                if isinstance(exc, ChecksumError):
+                    log.warning("Checksum error (hedged) reading %s from"
+                                " %s; reporting", block, dn)
+                    self.client.report_bad_block(block, dn.uuid)
+                self._dead.add(dn.uuid)
+                errors.append(f"{dn}: {exc}")
+            if pending:
+                self.client.hedged_reads += 1
+                nxt = pending.pop(0)
+                by_future[pool.submit(self._read_from_datanode, nxt,
+                                      block, offset, want)] = nxt
+            elif not by_future:
+                raise IOError(f"all hedged reads failed: {errors}")
 
     def _read_from_datanode(self, dn: DatanodeInfo, block: Block,
                             offset: int, want: int) -> bytes:

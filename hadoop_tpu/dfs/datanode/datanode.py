@@ -48,7 +48,7 @@ class DataNodeFaultInjector:
     # ---- hooks (no-ops by default) ----
     def before_write_block(self, block: Block) -> None: ...
     def before_packet_write(self, block: Block, pkt: dict) -> None: ...
-    def before_read_block(self, block: Block) -> None: ...
+    def before_read_block(self, block: Block, port: int = 0) -> None: ...
     def corrupt_read_packet(self, block, data, sums) -> Tuple[bytes, bytes]:
         return data, sums
     def before_heartbeat(self, dn: "DataNode") -> None: ...

@@ -309,7 +309,7 @@ class DataXceiverServer:
         block = Block.from_wire(req["b"])
         offset = req.get("offset", 0)
         length = req.get("length", 1 << 62)
-        self._fi().before_read_block(block)
+        self._fi().before_read_block(block, self.port)
         try:
             chunks = self.store.read_chunks(block, offset, length)
         except IOError as e:

@@ -64,8 +64,11 @@ class FileSystem:
         impl_key = f"fs.{p.scheme}.impl"
         impl = conf.get_class(impl_key) or _registry.get(p.scheme)
         if impl is None:
-            # Late import so dfs registers its scheme.
+            # Late imports so built-in schemes register (the ServiceLoader
+            # moment).
             import hadoop_tpu.dfs.client  # noqa: F401
+            import hadoop_tpu.fs.objectstore  # noqa: F401
+            import hadoop_tpu.fs.viewfs  # noqa: F401
             impl = _registry.get(p.scheme)
         if impl is None:
             raise ValueError(f"no filesystem registered for scheme "

@@ -1,0 +1,157 @@
+"""ViewFs — client-side mount tables over multiple filesystems.
+
+Parity with the reference (ref: hadoop-common/.../fs/viewfs/
+ViewFileSystem.java:117 — a FileSystem whose namespace is assembled
+from ``fs.viewfs.mounttable.<table>.link.<path>`` config links, each
+resolving into a target filesystem; InodeTree.java — longest-prefix
+resolution). Lets one logical namespace span several DFS namespaces
+and object stores without a Router in the path.
+
+  conf:  fs.viewfs.mounttable.cluster.link./data  = htpu://nn1:8020/data
+         fs.viewfs.mounttable.cluster.link./logs  = htpu://nn2:8020/logs
+  use:   FileSystem.get("viewfs://cluster/", conf).open("/data/x")
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.dfs.protocol.records import FileStatus
+from hadoop_tpu.fs.filesystem import (FileSystem, Path,
+                                      register_filesystem)
+
+
+class ViewFileSystem(FileSystem):
+    def __init__(self, conf: Configuration, table: str = "default"):
+        self.conf = conf
+        self.table = table
+        prefix = f"fs.viewfs.mounttable.{table}.link."
+        self._links: List[Tuple[str, str]] = []  # (mount path, target uri)
+        for key, value in conf.to_dict().items():
+            if key.startswith(prefix):
+                mount = "/" + key[len(prefix):].strip("/")
+                self._links.append((mount, value))
+        if not self._links:
+            raise ValueError(f"no mount links for viewfs table {table!r} "
+                             f"(set {prefix}<path>)")
+        # longest prefix first (ref: InodeTree resolution)
+        self._links.sort(key=lambda m: -len(m[0]))
+        self._targets: Dict[str, FileSystem] = {}
+
+    @classmethod
+    def create_instance(cls, path: Path, conf: Configuration):
+        return cls(conf, table=path.authority or "default")
+
+    def _target(self, uri: str) -> FileSystem:
+        if uri not in self._targets:
+            self._targets[uri] = FileSystem.get(uri, self.conf)
+        return self._targets[uri]
+
+    def _resolve(self, path: str) -> Tuple[FileSystem, str, str]:
+        """(target fs, translated path, mount point). Ref:
+        InodeTree.resolve."""
+        p = Path(path).path
+        for mount, target in self._links:
+            if p == mount or p.startswith(mount.rstrip("/") + "/"):
+                t = Path(target)
+                rel = p[len(mount):].lstrip("/")
+                base = t.path.rstrip("/")
+                resolved = f"{base}/{rel}" if rel else (base or "/")
+                return self._target(target), resolved, mount
+        raise FileNotFoundError(
+            f"{path}: not under any viewfs mount point "
+            f"({[m for m, _ in self._links]})")
+
+    def _mount_roots(self) -> List[str]:
+        return sorted({m.split("/", 2)[1] for m, _ in self._links})
+
+    # ----------------------------------------------------------------- SPI
+
+    def open(self, path: str):
+        fs, rp, _ = self._resolve(path)
+        return fs.open(rp)
+
+    def create(self, path: str, overwrite: bool = False, replication=None,
+               block_size=None):
+        fs, rp, _ = self._resolve(path)
+        return fs.create(rp, overwrite=overwrite, replication=replication,
+                         block_size=block_size)
+
+    def mkdirs(self, path: str) -> bool:
+        fs, rp, _ = self._resolve(path)
+        return fs.mkdirs(rp)
+
+    def delete(self, path: str, recursive: bool = False) -> bool:
+        fs, rp, _ = self._resolve(path)
+        return fs.delete(rp, recursive=recursive)
+
+    def rename(self, src: str, dst: str) -> bool:
+        sfs, srp, smount = self._resolve(src)
+        dfs, drp, dmount = self._resolve(dst)
+        if sfs is not dfs:
+            # ref: ViewFileSystem.rename refuses cross-mount renames
+            raise IOError(
+                f"rename across mount points {smount} → {dmount} is not "
+                f"supported (copy instead)")
+        return sfs.rename(srp, drp)
+
+    def list_status(self, path: str) -> List[FileStatus]:
+        p = Path(path).path.rstrip("/") or "/"
+        if not any(m == p or p.startswith(m.rstrip("/") + "/")
+                   for m, _ in self._links):
+            # internal node of the mount tree (the root, or a directory
+            # above the links): synthesize the next path components
+            # (ref: ViewFileSystem.listStatus over InodeTree internal
+            # dirs)
+            base = "" if p == "/" else p
+            children = sorted({
+                m[len(base):].lstrip("/").split("/", 1)[0]
+                for m, _ in self._links
+                if m == base or m.startswith(base + "/")} - {""})
+            if not children:
+                raise FileNotFoundError(path)
+            return [FileStatus(f"{base}/{c}", True, 0, 1, 0, 0.0, 0.0)
+                    for c in children]
+        fs, rp, mount = self._resolve(p)
+        out = []
+        for st in fs.list_status(rp):
+            child = Path(st.path).path
+            base = Path(self._link_target(mount)).path.rstrip("/")
+            rel = child[len(base):].lstrip("/") if base != "/" else \
+                child.lstrip("/")
+            vp = f"{mount.rstrip('/')}/{rel}" if rel else mount
+            out.append(FileStatus(vp, st.is_dir, st.length,
+                                  st.replication, st.block_size,
+                                  st.mtime, st.atime, owner=st.owner,
+                                  permission=st.permission))
+        return out
+
+    def _link_target(self, mount: str) -> str:
+        for m, t in self._links:
+            if m == mount:
+                return t
+        raise KeyError(mount)
+
+    def get_file_status(self, path: str) -> FileStatus:
+        p = Path(path).path.rstrip("/") or "/"
+        if p == "/":
+            return FileStatus("/", True, 0, 1, 0, 0.0, 0.0)
+        if not any(m == p or p.startswith(m.rstrip("/") + "/")
+                   for m, _ in self._links):
+            # an internal node of the mount tree (above the links)
+            if any(m.startswith(p + "/") for m, _ in self._links):
+                return FileStatus(p, True, 0, 1, 0, 0.0, 0.0)
+        fs, rp, _ = self._resolve(p)
+        st = fs.get_file_status(rp)
+        return FileStatus(p.rstrip("/") or "/", st.is_dir, st.length,
+                          st.replication, st.block_size, st.mtime,
+                          st.atime, owner=st.owner,
+                          permission=st.permission)
+
+    def close(self) -> None:
+        for fs in self._targets.values():
+            fs.close()
+
+
+register_filesystem("viewfs", ViewFileSystem)

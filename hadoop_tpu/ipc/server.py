@@ -647,7 +647,7 @@ class _Responder:
                     return
                 if sent == len(data):
                     if close_after:
-                        self.server._close_conn(conn)
+                        self._graceful_close(conn)
                     return
                 data = data[sent:]
             conn.out_pending.append(data)
@@ -707,6 +707,19 @@ class _Responder:
                 self.sel.unregister(conn.sock)
             except (KeyError, ValueError):
                 pass
-        if done or (drained and id(conn) in self._close_after):
+        if done:
             self._close_after.discard(id(conn))
+            self.server._close_conn(conn)
+        elif drained and id(conn) in self._close_after:
+            self._close_after.discard(id(conn))
+            self._graceful_close(conn)
+
+    def _graceful_close(self, conn: _Connection) -> None:
+        """Half-close after a fatal frame: SHUT_WR lets the peer drain
+        the frame before seeing EOF (an immediate close() can RST the
+        unread data away under load); the reader's EOF path — or the
+        idle scan, for a peer that lingers — finishes the close."""
+        try:
+            conn.sock.shutdown(socket.SHUT_WR)
+        except OSError:
             self.server._close_conn(conn)

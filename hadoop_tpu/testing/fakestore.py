@@ -1,0 +1,256 @@
+"""In-process object-store server for connector tests.
+
+The test double the object-store FileSystem connector
+(fs/objectstore.py) runs against — the role S3AMockTest/S3 mock
+endpoints play for the reference's hadoop-aws module (ref:
+hadoop-tools/hadoop-aws/src/test/.../MockS3AFileSystem.java and the
+ITest* suites pointed at a store endpoint). Speaks a minimal
+path-style HTTP object API:
+
+  PUT    /bucket/key                     store object (x-htpu-copy-source
+                                         header → server-side copy)
+  GET    /bucket/key                     fetch; honors Range: bytes=a-b
+  HEAD   /bucket/key                     size/mtime or 404
+  DELETE /bucket/key                     remove (idempotent)
+  GET    /bucket?list&prefix=&delimiter=&max-keys=&token=
+                                         paginated listing (JSON)
+  POST   /bucket/key?uploads             initiate multipart → upload id
+  PUT    /bucket/key?uploadId=U&part=N   upload one part
+  POST   /bucket/key?uploadId=U&complete JSON [part numbers] → assemble
+  DELETE /bucket/key?uploadId=U          abort multipart
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+from urllib.parse import parse_qs, unquote, urlparse
+
+
+class FakeObjectStore:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._objects: Dict[Tuple[str, str], Tuple[bytes, float]] = {}
+        self._uploads: Dict[str, Dict] = {}
+        self._next_upload = 0
+        self._lock = threading.Lock()
+        store = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _path(self):
+                u = urlparse(self.path)
+                parts = unquote(u.path).lstrip("/").split("/", 1)
+                bucket = parts[0]
+                key = parts[1] if len(parts) > 1 else ""
+                return bucket, key, parse_qs(u.query,
+                                             keep_blank_values=True)
+
+            def _send(self, code: int, body: bytes = b"",
+                      headers: Optional[Dict] = None):
+                self.send_response(code)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def _body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", 0))
+                return self.rfile.read(n) if n else b""
+
+            def do_PUT(self):
+                bucket, key, q = self._path()
+                if "uploadId" in q:
+                    uid = q["uploadId"][0]
+                    part = int(q["part"][0])
+                    data = self._body()
+                    with store._lock:
+                        up = store._uploads.get(uid)
+                        if up is None or up["bucket"] != bucket or \
+                                up["key"] != key:
+                            self._send(404)
+                            return
+                        up["parts"][part] = data
+                    self._send(200)
+                    return
+                src = self.headers.get("x-htpu-copy-source")
+                if src:
+                    sb, sk = unquote(src).lstrip("/").split("/", 1)
+                    with store._lock:
+                        obj = store._objects.get((sb, sk))
+                        if obj is None:
+                            self._send(404)
+                            return
+                        store._objects[(bucket, key)] = (obj[0],
+                                                         time.time())
+                    self._send(200)
+                    return
+                data = self._body()
+                with store._lock:
+                    store._objects[(bucket, key)] = (data, time.time())
+                self._send(200)
+
+            def do_GET(self):
+                bucket, key, q = self._path()
+                if "list" in q:
+                    self._list(bucket, q)
+                    return
+                with store._lock:
+                    obj = store._objects.get((bucket, key))
+                if obj is None:
+                    self._send(404)
+                    return
+                data = obj[0]
+                rng = self.headers.get("Range")
+                if rng and rng.startswith("bytes="):
+                    a, _, b = rng[6:].partition("-")
+                    start = int(a)
+                    end = int(b) if b else len(data) - 1
+                    if start >= len(data):
+                        self._send(416)
+                        return
+                    body = data[start:min(end + 1, len(data))]
+                    self._send(206, body, {
+                        "Content-Range":
+                            f"bytes {start}-{start + len(body) - 1}"
+                            f"/{len(data)}"})
+                    return
+                self._send(200, data)
+
+            def do_HEAD(self):
+                bucket, key, _ = self._path()
+                with store._lock:
+                    obj = store._objects.get((bucket, key))
+                if obj is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(obj[0])))
+                self.send_header("x-htpu-mtime", str(obj[1]))
+                self.end_headers()
+
+            def do_DELETE(self):
+                bucket, key, q = self._path()
+                with store._lock:
+                    if "uploadId" in q:
+                        store._uploads.pop(q["uploadId"][0], None)
+                    else:
+                        store._objects.pop((bucket, key), None)
+                self._send(204)
+
+            def do_POST(self):
+                bucket, key, q = self._path()
+                if "uploads" in q:
+                    with store._lock:
+                        store._next_upload += 1
+                        uid = f"up-{store._next_upload}"
+                        store._uploads[uid] = {"bucket": bucket,
+                                               "key": key, "parts": {}}
+                    self._send(200, json.dumps({"uploadId": uid}).encode())
+                    return
+                if "uploadId" in q and "complete" in q:
+                    uid = q["uploadId"][0]
+                    order = json.loads(self._body() or b"[]")
+                    with store._lock:
+                        up = store._uploads.pop(uid, None)
+                        if up is None or up["bucket"] != bucket or \
+                                up["key"] != key:
+                            self._send(404)
+                            return
+                        try:
+                            data = b"".join(up["parts"][n] for n in order)
+                        except KeyError:
+                            self._send(400, b"missing part")
+                            return
+                        store._objects[(bucket, key)] = (data, time.time())
+                    self._send(200)
+                    return
+                self._send(400)
+
+            def _list(self, bucket: str, q):
+                prefix = q.get("prefix", [""])[0]
+                delimiter = q.get("delimiter", [""])[0]
+                max_keys = int(q.get("max-keys", ["1000"])[0])
+                token = q.get("token", [""])[0]
+                with store._lock:
+                    keys = sorted(k for (b, k) in store._objects
+                                  if b == bucket and k.startswith(prefix))
+                objects, prefixes = [], []
+                seen_prefixes = set()
+                started = not token
+                truncated_at = None
+                for k in keys:
+                    if not started:
+                        if k > token:
+                            started = True
+                        else:
+                            continue
+                    if delimiter:
+                        rest = k[len(prefix):]
+                        cut = rest.find(delimiter)
+                        if cut >= 0:
+                            cp = prefix + rest[:cut + 1]
+                            if cp not in seen_prefixes:
+                                seen_prefixes.add(cp)
+                                prefixes.append(cp)
+                                if len(objects) + len(prefixes) \
+                                        >= max_keys:
+                                    truncated_at = k
+                                    break
+                            continue
+                    with store._lock:
+                        obj = store._objects.get((bucket, k))
+                    if obj is None:
+                        continue  # deleted since the key snapshot
+                    objects.append({"key": k, "size": len(obj[0]),
+                                    "mtime": obj[1]})
+                    if len(objects) + len(prefixes) >= max_keys:
+                        truncated_at = k
+                        break
+                body = {"objects": objects, "prefixes": prefixes}
+                if truncated_at is not None and truncated_at != keys[-1]:
+                    body["next_token"] = truncated_at
+                self._send(200, json.dumps(body).encode())
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._httpd.server_port
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "FakeObjectStore":
+        t = threading.Thread(target=self._httpd.serve_forever,
+                             name=f"fakestore-{self.port}", daemon=True)
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # test inspection helpers
+    def object_count(self) -> int:
+        with self._lock:
+            return len(self._objects)
+
+    def pending_uploads(self) -> int:
+        with self._lock:
+            return len(self._uploads)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

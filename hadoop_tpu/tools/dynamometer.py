@@ -85,19 +85,126 @@ def replay(fs: FileSystem, lines: Iterable[str],
     }
 
 
+OP_MIX = (  # realistic audit mix (ref: the workload profiles the
+    # dynamometer docs use — reads dominate production NN load)
+    ("open", 0.40), ("listStatus", 0.20), ("create", 0.20),
+    ("delete", 0.10), ("mkdirs", 0.05), ("rename", 0.05),
+)
+
+
+def generate_trace(path: str, n_ops: int, workers: int = 8,
+                   seed: int = 1234) -> str:
+    """Write a synthetic audit log of ``n_ops`` lines (ref: the
+    reference generates workloads when no production log is at hand).
+    Paths are partitioned under /w<k>/ so a ``workers``-way replay can
+    keep per-path op ordering within one worker."""
+    import random
+    rng = random.Random(seed)
+    counters = [0] * workers
+    ops = [op for op, _ in OP_MIX]
+    weights = [w for _, w in OP_MIX]
+    with open(path, "w") as f:
+        for i in range(n_ops):
+            w = i % workers
+            cmd = rng.choices(ops, weights)[0]
+            known = counters[w]
+            if cmd in ("create", "mkdirs") or known == 0:
+                cmd = "create" if cmd not in ("mkdirs",) else cmd
+                counters[w] += 1
+                target = counters[w]
+            else:
+                target = rng.randrange(1, known + 1)
+            src = f"/w{w}/d{target % 97:02d}/f{target:06d}"
+            if cmd == "mkdirs":
+                src = f"/w{w}/d{target % 97:02d}"
+            dst = "null"
+            if cmd == "rename":
+                counters[w] += 1
+                dst = f"/w{w}/d{counters[w] % 97:02d}/f{counters[w]:06d}"
+            f.write(f"allowed=true\tugi=dyn\tip=127.0.0.1\t"
+                    f"cmd={cmd}\tsrc={src}\tdst={dst}\t"
+                    f"callerContext=dynamometer\n")
+    return path
+
+
+def replay_parallel(fs_uri: str, lines: List[str], threads: int = 8,
+                    remap_root: str = "/dyn",
+                    conf=None) -> Dict:
+    """Multi-worker replay against a live NameNode over real RPC (ref:
+    AuditReplayMapper runs many mapper threads). Lines partition by the
+    /w<k>/ top directory so per-path ordering holds within a worker;
+    each worker drives its OWN client (separate RPC connection)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from hadoop_tpu.conf import Configuration
+    conf = conf or Configuration()
+
+    buckets: List[List[str]] = [[] for _ in range(threads)]
+    for line in lines:
+        ev = parse_audit_line(line)
+        if ev is None:
+            continue
+        src = ev.get("src", "")
+        if src.startswith("/w"):
+            try:
+                k = int(src[2:src.index("/", 1)]) % threads
+            except ValueError:
+                k = hash(src.split("/", 2)[1]) % threads
+        else:
+            k = hash(src.split("/", 2)[1] if src.count("/") > 1
+                     else src) % threads
+        buckets[k].append(line)
+
+    def worker(batch: List[str]) -> Dict:
+        wfs = FileSystem.get(fs_uri, conf)
+        try:
+            return replay(wfs, batch, remap_root)
+        finally:
+            wfs.close()
+
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(max_workers=threads) as pool:
+        parts = list(pool.map(worker, [b for b in buckets if b]))
+    dt = time.perf_counter() - t0
+    total = sum(p["ops"] for p in parts)
+    per_op: Dict[str, int] = {}
+    for p in parts:
+        for k, v in p["per_op"].items():
+            per_op[k] = per_op.get(k, 0) + v
+    return {
+        "ops": total,
+        "errors": sum(p["errors"] for p in parts),
+        "threads": threads,
+        "per_op": per_op,
+        "wall_seconds": round(dt, 3),
+        "ops_per_sec": round(total / dt, 1) if dt else 0.0,
+    }
+
+
 def main(argv=None) -> int:
     import argparse
     ap = argparse.ArgumentParser(prog="dynamometer")
     ap.add_argument("audit_log")
     ap.add_argument("--fs", required=True)
     ap.add_argument("--remap-root", default="/dyn")
+    ap.add_argument("--threads", type=int, default=1)
+    ap.add_argument("--generate", type=int, metavar="N_OPS",
+                    help="generate a synthetic N-op trace first")
     args = ap.parse_args(argv)
-    fs = FileSystem.get(args.fs, Configuration())
-    try:
+    if args.generate:
+        generate_trace(args.audit_log, args.generate,
+                       workers=max(1, args.threads))
+    if args.threads > 1:
         with open(args.audit_log) as f:
-            report = replay(fs, f, args.remap_root)
-    finally:
-        fs.close()
+            report = replay_parallel(args.fs, list(f), args.threads,
+                                     args.remap_root)
+    else:
+        fs = FileSystem.get(args.fs, Configuration())
+        try:
+            with open(args.audit_log) as f:
+                report = replay(fs, f, args.remap_root)
+        finally:
+            fs.close()
     print(json.dumps(report))
     return 0
 

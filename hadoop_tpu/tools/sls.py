@@ -147,3 +147,213 @@ def main(argv=None) -> int:
 if __name__ == "__main__":
     import sys
     sys.exit(main())
+
+
+# --------------------------------------------------------------- RM mode
+
+def run_rm(num_nodes: int = 1000, num_apps: int = 20,
+           containers_per_app: int = 20, scheduler: str = "capacity",
+           node_mb: int = 8192, sweeps: int = 30,
+           register_threads: int = 16,
+           conf: Optional[Configuration] = None) -> Dict:
+    """Drive a REAL ResourceManager daemon over REAL RPC: ``num_nodes``
+    simulated NodeManagers register + heartbeat (NMSimulator role), and
+    per-app AM simulators register/allocate over AMRMProtocol
+    (AMSimulator role) — the reference SLSRunner architecture, with the
+    RM taken as a black box behind its three RPC services.
+
+    All simulated NMs advertise ONE shared fake ContainerManager
+    endpoint; AM "launches" land there, handing the attempt id to an AM
+    simulator thread.
+    """
+    import queue as _queue
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from hadoop_tpu.ipc import Client, Server, get_proxy
+    from hadoop_tpu.yarn.client import YarnClient
+    from hadoop_tpu.yarn.records import (ApplicationSubmissionContext,
+                                         ContainerLaunchContext)
+    from hadoop_tpu.yarn.rm import ResourceManager
+
+    conf = conf or Configuration(load_defaults=False)
+    conf.set_if_unset("yarn.resourcemanager.scheduler.class", scheduler)
+    # Simulated NMs sweep in batches; generous liveness so a slow sweep
+    # on a loaded host doesn't mark the fleet dead mid-run.
+    conf.set_if_unset("yarn.nm.liveness-monitor.expiry-interval", "600")
+    conf.set_if_unset("yarn.am.liveness-monitor.expiry-interval", "600")
+
+    import tempfile
+    state_dir = tempfile.mkdtemp(prefix="sls-rm-")
+    rm = ResourceManager(conf, state_dir=state_dir)
+    rm.init(conf)
+    rm.start()
+
+    launched: "_queue.Queue[str]" = _queue.Queue()
+
+    class _FakeContainerManager:
+        """Accepts every AM launch; surfaces the attempt id."""
+
+        def start_container(self, container_wire: Dict,
+                            ctx_wire: Dict) -> Dict:
+            env = ctx_wire.get("e", {})
+            att = env.get("HTPU_ATTEMPT_ID")
+            if att:
+                launched.put(att)
+            return {"ok": True}
+
+        def stop_container(self, container_id_wire: Dict) -> bool:
+            return True
+
+    fake_nm = Server(conf, num_handlers=2, name="sls-fake-nm")
+    fake_nm.register_protocol("ContainerManagerProtocol",
+                              _FakeContainerManager())
+    fake_nm.start()
+    nm_address = f"127.0.0.1:{fake_nm.port}"
+
+    rpc = Client(conf)
+    rm_addr = ("127.0.0.1", rm.port)
+    tracker = get_proxy("ResourceTrackerProtocol", rm_addr, client=rpc)
+    amrm = get_proxy("AMRMProtocol", rm_addr, client=rpc)
+
+    results = {"heartbeats": 0, "allocated": 0}
+    first_alloc_ms: List[float] = []
+    submit_times: Dict[str, float] = {}
+    res_lock = threading.Lock()
+
+    try:
+        nodes = [NodeId(f"host{i:05d}", 9000) for i in range(num_nodes)]
+        pool = ThreadPoolExecutor(max_workers=register_threads)
+        t_reg0 = time.perf_counter()
+        list(pool.map(lambda nid: tracker.register_node_manager(
+            nid.to_wire(), Resource(node_mb, 16).to_wire(), nm_address),
+            nodes, chunksize=max(1, num_nodes // register_threads)))
+        register_s = time.perf_counter() - t_reg0
+
+        # AM simulator: register, ask, drain, finish.
+        def am_sim(attempt_id: str) -> None:
+            app_key = attempt_id.rsplit("_", 1)[0]
+            amrm.register_application_master(attempt_id, "sls://")
+            asks = [ResourceRequest(1, containers_per_app,
+                                    Resource(1024, 1)).to_wire()]
+            got = 0
+            first = None
+            deadline = time.monotonic() + 120.0
+            resp = amrm.allocate(attempt_id, asks, [])
+            while got < containers_per_app and \
+                    time.monotonic() < deadline:
+                n = len(resp["allocated"])
+                if n and first is None:
+                    first = time.perf_counter()
+                got += n
+                if got >= containers_per_app:
+                    break
+                time.sleep(0.05)
+                resp = amrm.allocate(attempt_id, [], [])
+            with res_lock:
+                results["allocated"] += got
+                if first is not None and app_key in submit_times:
+                    first_alloc_ms.append(
+                        (first - submit_times[app_key]) * 1000.0)
+            amrm.finish_application_master(attempt_id, "SUCCEEDED")
+
+        am_pool = ThreadPoolExecutor(max_workers=min(num_apps, 16))
+        am_futures = []
+
+        def am_dispatcher() -> None:
+            seen = 0
+            while seen < num_apps:
+                try:
+                    att = launched.get(timeout=60.0)
+                except _queue.Empty:
+                    return
+                am_futures.append(am_pool.submit(am_sim, att))
+                seen += 1
+
+        dispatcher = threading.Thread(target=am_dispatcher, daemon=True)
+        dispatcher.start()
+
+        # Submit apps through the real client service.
+        yc = YarnClient(rm_addr, conf)
+        queues = conf.get_list("sls.queues", ["default"])
+        t0 = time.perf_counter()
+        for i in range(num_apps):
+            app_id, _ = yc.create_application()
+            ctx = ApplicationSubmissionContext(
+                app_id, f"sls-app-{i}",
+                ContainerLaunchContext(["true"], {}),
+                am_resource=Resource(512, 1),
+                queue=queues[i % len(queues)])
+            submit_times[str(app_id)] = time.perf_counter()
+            yc.submit_application(ctx, wait_accepted=False)
+
+        # NM heartbeat sweeps: every simulated node, over real RPC.
+        # Sweeps continue until every AM simulator drained its asks (the
+        # scheduler only hands out containers at heartbeat time), with
+        # ``sweeps`` as the MINIMUM measured and a wall-clock ceiling.
+        hb_t0 = time.perf_counter()
+        sweep_times = []
+        target = num_apps * containers_per_app
+        hb_deadline = hb_t0 + 180.0
+        n_sweeps = 0
+        while True:
+            s0 = time.perf_counter()
+            list(pool.map(lambda nid: tracker.node_heartbeat(
+                nid.to_wire(), []), nodes,
+                chunksize=max(1, num_nodes // register_threads)))
+            sweep_times.append(time.perf_counter() - s0)
+            n_sweeps += 1
+            with res_lock:
+                results["heartbeats"] += num_nodes
+                got = results["allocated"]
+            if n_sweeps >= sweeps and (got >= target
+                                       or time.perf_counter()
+                                       > hb_deadline):
+                break
+        hb_dt = time.perf_counter() - hb_t0
+
+        dispatcher.join(timeout=30.0)
+        for f in am_futures:
+            f.result(timeout=60.0)
+        total_dt = time.perf_counter() - t0
+
+        lat = sorted(first_alloc_ms)
+
+        def pct(p):
+            return round(lat[min(len(lat) - 1, int(p * len(lat)))], 1) \
+                if lat else None
+
+        return {
+            "mode": "rm-rpc",
+            "scheduler": scheduler,
+            "nodes": num_nodes,
+            "apps": num_apps,
+            "node_register_seconds": round(register_s, 2),
+            "heartbeats": results["heartbeats"],
+            "heartbeats_per_sec": round(results["heartbeats"] / hb_dt, 1)
+            if hb_dt else 0.0,
+            "heartbeat_sweep_p50_s": round(
+                sorted(sweep_times)[len(sweep_times) // 2], 3)
+            if sweep_times else None,
+            "containers_allocated": results["allocated"],
+            "decisions_per_sec": round(results["allocated"] / total_dt, 1)
+            if total_dt else 0.0,
+            "first_alloc_latency_ms": {
+                "p50": pct(0.5), "p95": pct(0.95),
+                "max": round(lat[-1], 1) if lat else None},
+            "wall_seconds": round(total_dt, 2),
+        }
+    finally:
+        try:
+            yc.close()
+        except Exception:
+            pass
+        for p in ("pool", "am_pool"):
+            ex = locals().get(p)
+            if ex is not None:
+                ex.shutdown(wait=False)
+        rpc.stop()
+        fake_nm.stop()
+        rm.stop()
+        import shutil as _shutil
+        _shutil.rmtree(state_dir, ignore_errors=True)

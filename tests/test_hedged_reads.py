@@ -1,0 +1,76 @@
+"""Hedged reads: a slow DataNode must not stall reads.
+
+Mirrors the reference's TestPread.testHedgedPreadDFSBasic /
+testMaxOutHedgedReadPool (ref: hadoop-hdfs TestPread.java): with the
+hedged pool enabled, a read whose first replica is slow completes from
+another replica in ~threshold time, and the hedged metrics move.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from hadoop_tpu.dfs.datanode.datanode import DataNodeFaultInjector
+from hadoop_tpu.testing.minicluster import MiniDFSCluster, fast_conf
+
+
+class _SlowFirstReplica(DataNodeFaultInjector):
+    """Delay the FIRST read attempt (whichever replica the client
+    picks); the hedge that follows is served at full speed."""
+
+    def __init__(self, delay_s: float):
+        self.delay_s = delay_s
+        self.hits = 0
+        self._lock = threading.Lock()
+
+    def before_read_block(self, block, port: int = 0) -> None:
+        with self._lock:
+            self.hits += 1
+            first = self.hits == 1
+        if first:
+            time.sleep(self.delay_s)
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    conf = fast_conf()
+    conf.set("dfs.replication", "2")
+    conf.set("dfs.client.read.shortcircuit", "false")  # force TCP reads
+    conf.set("dfs.client.hedged.read.threadpool.size", "4")
+    conf.set("dfs.client.hedged.read.threshold", "0.15")
+    with MiniDFSCluster(num_datanodes=2, conf=conf,
+                        base_dir=str(tmp_path)) as c:
+        c.wait_active()
+        yield c
+
+
+def test_slow_replica_does_not_stall_read(cluster):
+    fs = cluster.get_filesystem()
+    payload = os.urandom(100_000)
+    fs.write_all("/hedge.bin", payload)
+
+    injector = _SlowFirstReplica(delay_s=3.0)
+    DataNodeFaultInjector.set(injector)
+    try:
+        t0 = time.monotonic()
+        assert fs.read_all("/hedge.bin") == payload
+        elapsed = time.monotonic() - t0
+        # Unhedged this takes >= delay_s (3s); hedged it finishes around
+        # the 0.15s threshold + transfer time.
+        assert elapsed < 2.0, f"read took {elapsed:.2f}s — hedge did not fire"
+        assert injector.hits >= 2, "hedge never reached the second replica"
+        assert fs.client.hedged_reads >= 1
+        assert fs.client.hedged_wins >= 1
+    finally:
+        DataNodeFaultInjector.set(None)
+
+
+def test_hedged_read_correct_when_all_healthy(cluster):
+    fs = cluster.get_filesystem()
+    payload = os.urandom(50_000)
+    fs.write_all("/hedge2.bin", payload)
+    assert fs.read_all("/hedge2.bin") == payload
+    with fs.open("/hedge2.bin") as f:
+        assert f.pread(10_000, 256) == payload[10_000:10_256]

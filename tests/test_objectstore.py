@@ -1,0 +1,186 @@
+"""Object-store connector: FS semantics, streams, committer, distcp.
+
+Mirrors the reference's hadoop-aws test strategy (ref: ITestS3A*
+contract tests driven against a store endpoint; ITestCommitOperations
+for the magic committer; TestDistCpWithS3 for cross-store copies) —
+every test here crosses real HTTP sockets to the in-process fake
+store (testing/fakestore.py).
+"""
+
+import os
+
+import pytest
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.fs import FileSystem
+from hadoop_tpu.fs.objectstore import (ObjectStoreCommitter,
+                                       ObjectStoreFileSystem)
+from hadoop_tpu.testing.fakestore import FakeObjectStore
+
+
+@pytest.fixture()
+def store():
+    with FakeObjectStore() as s:
+        yield s
+
+
+@pytest.fixture()
+def fs(store):
+    f = FileSystem.get(f"htps://{store.endpoint}/bkt", Configuration())
+    assert isinstance(f, ObjectStoreFileSystem)
+    yield f
+    f.close()
+
+
+def test_write_read_roundtrip(fs):
+    data = os.urandom(100_000)
+    fs.write_all("/bkt/dir/a.bin", data)
+    assert fs.read_all("/bkt/dir/a.bin") == data
+    st = fs.get_file_status("/bkt/dir/a.bin")
+    assert not st.is_dir and st.length == len(data)
+
+
+def test_object_invisible_until_close(fs):
+    out = fs.create("/bkt/late.bin")
+    out.write(b"x" * 1000)
+    assert not fs.exists("/bkt/late.bin")  # ref: S3A visibility-at-close
+    out.close()
+    assert fs.exists("/bkt/late.bin")
+
+
+def test_multipart_write(store, fs):
+    fs.part_size = 4096  # force multiple parts
+    data = os.urandom(3 * 4096 + 123)
+    fs.write_all("/bkt/mp.bin", data)
+    assert fs.read_all("/bkt/mp.bin") == data
+    assert store.pending_uploads() == 0  # completed, not leaked
+
+
+def test_range_reads_and_seek(fs):
+    data = bytes(range(256)) * 1000
+    fs.write_all("/bkt/seek.bin", data)
+    with fs.open("/bkt/seek.bin") as f:
+        assert f.read(10) == data[:10]
+        f.seek(100_000)
+        assert f.read(16) == data[100_000:100_016]
+        f.seek(-8, 2)
+        assert f.read() == data[-8:]
+        assert f.pread(5000, 64) == data[5000:5064]
+
+
+def test_listing_directories_and_pagination(fs):
+    fs.list_page = 7  # force pagination
+    for i in range(25):
+        fs.write_all(f"/bkt/pag/f{i:03d}", b"x")
+    fs.mkdirs("/bkt/pag/sub")
+    fs.write_all("/bkt/pag/sub/inner", b"y")
+    sts = fs.list_status("/bkt/pag")
+    names = [s.path.rsplit("/", 1)[-1] for s in sts]
+    assert len([s for s in sts if not s.is_dir]) == 25
+    subs = [s for s in sts if s.is_dir]
+    assert len(subs) == 1 and subs[0].path.endswith("/pag/sub")
+    assert "f000" in names and "f024" in names
+    # implicit directory (no marker) is still a directory
+    fs.write_all("/bkt/imp/deep/file", b"z")
+    assert fs.get_file_status("/bkt/imp").is_dir
+    assert fs.get_file_status("/bkt/imp/deep").is_dir
+
+
+def test_mkdirs_delete(fs):
+    fs.mkdirs("/bkt/d1/d2")
+    assert fs.get_file_status("/bkt/d1/d2").is_dir
+    fs.write_all("/bkt/d1/d2/f", b"data")
+    with pytest.raises(OSError):
+        fs.delete("/bkt/d1/d2", recursive=False)
+    assert fs.delete("/bkt/d1/d2", recursive=True)
+    assert not fs.exists("/bkt/d1/d2/f")
+    assert not fs.delete("/bkt/never-existed")
+
+
+def test_rename_file_and_tree(fs):
+    fs.write_all("/bkt/r/a", b"A")
+    fs.write_all("/bkt/r/sub/b", b"B")
+    assert fs.rename("/bkt/r", "/bkt/moved")
+    assert fs.read_all("/bkt/moved/a") == b"A"
+    assert fs.read_all("/bkt/moved/sub/b") == b"B"
+    assert not fs.exists("/bkt/r/a")
+    # file rename into an existing directory
+    fs.write_all("/bkt/single", b"S")
+    fs.mkdirs("/bkt/into")
+    assert fs.rename("/bkt/single", "/bkt/into")
+    assert fs.read_all("/bkt/into/single") == b"S"
+
+
+def test_committer_atomic_visibility(store, fs):
+    """Task output is invisible until job commit, then appears atomically
+    (ref: the magic committer's deferred multipart completion)."""
+    fs.part_size = 4096
+    committer = ObjectStoreCommitter(fs, "/bkt/out")
+    writers = []
+    for t in range(3):
+        w = committer.task_writer(f"task_{t}", f"part-{t:05d}")
+        w.write(os.urandom(10_000))
+        writers.append(w)
+        committer.commit_task(f"task_{t}", [w])
+    # data uploaded but NOT visible; uploads parked
+    assert not fs.exists("/bkt/out/part-00000")
+    assert store.pending_uploads() == 3
+    n = committer.commit_job()
+    assert n == 3
+    for t in range(3):
+        assert fs.get_file_status(f"/bkt/out/part-{t:05d}").length \
+            == 10_000
+    assert fs.exists("/bkt/out/_SUCCESS")
+    assert store.pending_uploads() == 0
+
+
+def test_committer_abort_leaves_nothing(store, fs):
+    committer = ObjectStoreCommitter(fs, "/bkt/ab")
+    w = committer.task_writer("t0", "part-00000")
+    w.write(b"never seen")
+    committer.commit_task("t0", [w])
+    committer.abort_job()
+    assert store.pending_uploads() == 0
+    assert not fs.exists("/bkt/ab/part-00000")
+
+
+def test_distcp_dfs_to_store_and_back(store, tmp_path):
+    """distcp DFS↔store both directions over a live MR cluster (ref:
+    using hadoop-distcp against s3a:// targets)."""
+    from hadoop_tpu.testing.minicluster import MiniMRYarnCluster
+    from hadoop_tpu.tools.distcp import distcp
+
+    with MiniMRYarnCluster(num_nodes=1,
+                           base_dir=str(tmp_path)) as cluster:
+        dfs = cluster.get_filesystem()
+        payloads = {f"/src/f{i}": os.urandom(20_000 + i) for i in range(3)}
+        for p, data in payloads.items():
+            dfs.write_all(p, data)
+        store_uri = f"htps://{store.endpoint}/bkt"
+
+        counters = distcp(cluster.rm_addr, cluster.default_fs,
+                          f"{cluster.default_fs}/src",
+                          f"{store_uri}/mirror")
+        sfs = FileSystem.get(store_uri, Configuration())
+        for p, data in payloads.items():
+            name = p.rsplit("/", 1)[-1]
+            assert sfs.read_all(f"/bkt/mirror/{name}") == data
+
+        # and back again into a fresh DFS directory
+        distcp(cluster.rm_addr, cluster.default_fs,
+               f"{store_uri}/mirror", f"{cluster.default_fs}/back")
+        for p, data in payloads.items():
+            name = p.rsplit("/", 1)[-1]
+            assert dfs.read_all(f"/back/{name}") == data
+
+
+def test_trailing_slash_is_directory(fs):
+    fs.mkdirs("/bkt/ts")
+    fs.write_all("/bkt/ts/child", b"c")
+    st = fs.get_file_status("/bkt/ts/")
+    assert st.is_dir
+    with pytest.raises(IsADirectoryError):
+        fs.open("/bkt/ts/")
+    with pytest.raises(OSError):
+        fs.delete("/bkt/ts/", recursive=False)
+    assert fs.exists("/bkt/ts/child")

@@ -243,3 +243,36 @@ def test_gridmix_replays_trace_as_real_jobs(tmp_path):
         parts = [s.path for s in fs.list_status("/gridmix-out/0")
                  if "part-m-" in s.path]
         assert len(parts) == 2
+
+
+def test_sls_rm_mode_real_rpc():
+    """SLS drives a REAL ResourceManager over its three RPC services
+    with simulated NMs + AMs (ref: SLSRunner.java architecture)."""
+    from hadoop_tpu.tools.sls import run_rm
+    r = run_rm(num_nodes=60, num_apps=3, containers_per_app=8, sweeps=8)
+    assert r["mode"] == "rm-rpc"
+    assert r["containers_allocated"] == 3 * 8
+    assert r["heartbeats"] >= 60 * 8
+    assert r["decisions_per_sec"] > 0
+    assert r["first_alloc_latency_ms"]["p50"] is not None
+
+
+def test_dynamometer_generate_and_parallel_replay(tmp_path):
+    """Generated audit trace replays multithreaded against a live NN
+    (ref: hadoop-dynamometer AuditReplayMapper)."""
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster, fast_conf
+    from hadoop_tpu.tools import dynamometer as dyn
+    conf = fast_conf()
+    conf.set("dfs.replication", "1")
+    trace = str(tmp_path / "audit.log")
+    dyn.generate_trace(trace, 1500, workers=4)
+    with MiniDFSCluster(num_datanodes=1, conf=conf,
+                        base_dir=str(tmp_path / "dfs")) as c:
+        c.wait_active()
+        with open(trace) as f:
+            r = dyn.replay_parallel(c.default_fs, list(f), threads=4)
+    assert r["ops"] > 1300
+    assert r["ops_per_sec"] > 100
+    assert set(r["per_op"]) >= {"create", "open", "listStatus"}
+    # error rate small (renames/opens racing deletes are tolerated)
+    assert r["errors"] < r["ops"] * 0.05
