@@ -40,7 +40,7 @@ def run(n_ops: int = 5000, threads: int = 16) -> dict:
     nn = NameNode(Configuration(other=conf), name_dir=base + "/name")
     nn.init(conf)
     nn.start()
-    proto = ClientProtocol(nn.fsn, nn.retry_cache, nn)
+    proto = ClientProtocol(nn.fsn, nn.retry_cache)
     results = {}
     try:
         results["mkdirs"] = _rate(

@@ -101,6 +101,7 @@ class DFSClient:
         self._block_sizes: Dict[str, int] = {}
         self._hedged_pool = None
         self._hedged_pool_lock = threading.Lock()
+        self._hedged_inflight = 0   # submitted, not yet finished
         self.hedged_reads = 0   # hedges started (metric parity:
         self.hedged_wins = 0    # DFSHedgedReadMetrics)
         self._open_files = 0
@@ -226,10 +227,30 @@ class DFSClient:
                 from concurrent.futures import ThreadPoolExecutor
                 size = self.conf.get_int(
                     "dfs.client.hedged.read.threadpool.size", 4)
+                self._hedged_workers = max(2, size)
                 self._hedged_pool = ThreadPoolExecutor(
-                    max_workers=max(2, size),
+                    max_workers=self._hedged_workers,
                     thread_name_prefix="hedged-read")
             return self._hedged_pool
+
+    def hedged_submit(self, fn, *args):
+        """Submit a hedged task tracking in-flight count, or None when
+        the pool is saturated by straggling losers — the caller falls
+        back to its sequential path instead of queueing a NEW read
+        behind stuck threads (the reference gets the same property from
+        a SynchronousQueue + CallerRunsPolicy)."""
+        pool = self.hedged_pool()
+        with self._hedged_pool_lock:
+            if self._hedged_inflight >= self._hedged_workers:
+                return None
+            self._hedged_inflight += 1
+        fut = pool.submit(fn, *args)
+
+        def _done(_f):
+            with self._hedged_pool_lock:
+                self._hedged_inflight -= 1
+        fut.add_done_callback(_done)
+        return fut
 
     def close(self) -> None:
         if self._renewer_stop is not None:

@@ -438,9 +438,12 @@ class DFSInputStream:
 
     # Refresh/backoff rounds when every replica fails or the NN reports
     # no locations (nodes transiently dead under load, re-replication in
-    # flight). Ref: DFSInputStream chooseDataNode's retry window
-    # (dfs.client.retries.window.base — sleeps then refetches locations).
-    LOCATION_RETRIES = 4
+    # flight, a fresh post-failover active still collecting block
+    # reports — report interval is seconds). Ref: DFSInputStream
+    # chooseDataNode's retry window (dfs.client.retries.window.base —
+    # sleeps then refetches locations). The window must outlast one
+    # block-report interval: 0.5+1+1.5+2+2.5 = 7.5s of backoff.
+    LOCATION_RETRIES = 6
     RETRY_BACKOFF_S = 0.5
 
     def _fetch_range(self, pos: int, want: int) -> bytes:
@@ -500,12 +503,16 @@ class DFSInputStream:
         run to completion in the pool (ref: DFSInputStream
         .hedgedFetchBlockByteRange — it too lets stragglers finish)."""
         import concurrent.futures as cf
-        pool = self.client.hedged_pool()
         pending = list(candidates)
         by_future = {}
         first = pending.pop(0)
-        by_future[pool.submit(self._read_from_datanode, first, block,
-                              offset, want)] = first
+        fut = self.client.hedged_submit(self._read_from_datanode, first,
+                                        block, offset, want)
+        if fut is None:
+            # Pool saturated by straggling losers: read sequentially
+            # rather than queueing behind them.
+            return self._read_from_datanode(first, block, offset, want)
+        by_future[fut] = first
         errors: List[str] = []
         while True:
             timeout = self._hedged_threshold_s if pending else None
@@ -527,10 +534,17 @@ class DFSInputStream:
                 self._dead.add(dn.uuid)
                 errors.append(f"{dn}: {exc}")
             if pending:
-                self.client.hedged_reads += 1
                 nxt = pending.pop(0)
-                by_future[pool.submit(self._read_from_datanode, nxt,
-                                      block, offset, want)] = nxt
+                fut = self.client.hedged_submit(self._read_from_datanode,
+                                                nxt, block, offset, want)
+                if fut is None:
+                    if by_future:
+                        pending.insert(0, nxt)  # retry hedging next wake
+                        continue
+                    return self._read_from_datanode(nxt, block, offset,
+                                                    want)
+                self.client.hedged_reads += 1
+                by_future[fut] = nxt
             elif not by_future:
                 raise IOError(f"all hedged reads failed: {errors}")
 

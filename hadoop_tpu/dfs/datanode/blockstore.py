@@ -71,7 +71,19 @@ class _OpenReplica:
         # packet-relative sums can't be appended verbatim — the straddling
         # chunk's CRC is recomputed over (partial + new) instead.
         self._partial = b""
+        # NativeIO drop-behind-writes discipline (ref: BlockReceiver's
+        # manageWriterOsCache under dfs.datanode.drop.cache.behind.writes
+        # + sync.behind.writes, both OFF by default like the reference —
+        # right for archival/streaming ingest, wrong for write-then-read
+        # workloads like shuffle spills): kick writeback for the newest
+        # window, evict only the PREVIOUS (already-synced) one so
+        # DONTNEED hits clean pages.
+        self._drop_behind = getattr(store, "drop_behind_writes", False)
+        self._synced_to = 0
+        self._dropped_to = 0
         self._io_lock = threading.Lock()
+
+    DROP_BEHIND_BYTES = 8 * 1024 * 1024
 
     def write_packet(self, data: bytes, sums: bytes) -> None:
         with self._io_lock:
@@ -79,6 +91,24 @@ class _OpenReplica:
                 raise IOError(f"writer of blk_{self.block_id} stopped by "
                               f"block recovery")
             self._data_f.write(data)
+            if self._drop_behind and \
+                    self.num_bytes - self._synced_to >= \
+                    self.DROP_BEHIND_BYTES:
+                from hadoop_tpu import native
+                upto = self.num_bytes - (self.num_bytes %
+                                         self.DROP_BEHIND_BYTES)
+                self._data_f.flush()
+                fd = self._data_f.fileno()
+                native.sync_file_range(fd, self._synced_to,
+                                       upto - self._synced_to)
+                # The range synced LAST window has completed writeback
+                # by now — those pages evict; the fresh window waits.
+                if self._synced_to > self._dropped_to:
+                    native.fadvise(fd, self._dropped_to,
+                                   self._synced_to - self._dropped_to,
+                                   native.FADV_DONTNEED)
+                    self._dropped_to = self._synced_to
+                self._synced_to = upto
             bpc = self.checksum.bytes_per_chunk
             if self._partial:
                 # Rewind the partial chunk's provisional CRC and re-cover
@@ -142,9 +172,13 @@ class _OpenReplica:
 
 class BlockStore:
     def __init__(self, directory: str, chunk_size: int = 512,
-                 capacity_override: int = 0, sync_on_close: bool = False):
+                 capacity_override: int = 0, sync_on_close: bool = False,
+                 drop_behind_writes: bool = False):
         self.dir = directory
         self.chunk_size = chunk_size
+        # ref: dfs.datanode.drop.cache.behind.writes (NativeIO page-cache
+        # discipline; off by default like the reference)
+        self.drop_behind_writes = drop_behind_writes
         # fsync on finalize — ref: dfs.datanode.synconclose, FALSE in the
         # reference too (DataNode.java / BlockReceiver close path): block
         # durability comes from 3-way replication, not per-block fsync;
@@ -357,6 +391,12 @@ class BlockStore:
         start = (offset // bpc) * bpc
         end = min(visible, offset + length)
         with open(data_path, "rb") as df, open(meta_path, "rb") as mf:
+            # Sequential-read hint (ref: BlockSender's
+            # manageOsCache POSIX_FADV_SEQUENTIAL): doubled readahead
+            # for the scan, without polluting cache for other replicas.
+            from hadoop_tpu import native
+            native.fadvise(df.fileno(), start, max(0, end - start),
+                           native.FADV_SEQUENTIAL)
             meta_header = 4 + 8 + DataChecksum.HEADER_LEN
             pos = start
             while pos < end:

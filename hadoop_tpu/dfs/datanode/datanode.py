@@ -132,7 +132,9 @@ class DataNode(AbstractService):
             self.store = BlockStore(
                 extra_dirs[0].strip() if extra_dirs
                 else os.path.join(self.data_dir, "current"),
-                capacity_override=cap, sync_on_close=sync)
+                capacity_override=cap, sync_on_close=sync,
+                drop_behind_writes=conf.get_bool(
+                    "dfs.datanode.drop.cache.behind.writes", False))
         security_keys = None
         if conf.get_bool("dfs.encrypt.data.transfer", False):
             from hadoop_tpu.dfs.protocol.datatransfer import \

@@ -389,6 +389,12 @@ class BlockManager:
         # 0 = highest risk (1 replica), 1 = under-replicated, 2 = queued drains.
         self._needed: List[Set[int]] = [set(), set(), set()]
         self._pending_reconstruction: Dict[int, float] = {}  # id → deadline
+        # How long a scheduled (re)construction may stay outstanding
+        # before re-queueing (ref:
+        # dfs.namenode.reconstruction.pending.timeout-sec). EC gets 2x:
+        # the worker reads k units before writing.
+        self._pending_timeout_s = conf.get_time_seconds(
+            "dfs.namenode.reconstruction.pending.timeout", 30.0)
         self.safemode = SafeMode(self, conf)
         reg = metrics_system().source("namenode.blocks")
         reg.register_callback_gauge("blocks_total", lambda: len(self._blocks))
@@ -650,7 +656,7 @@ class BlockManager:
         src.transfer_queue.append(
             (info.block, [t.public_info() for t in targets]))
         self._pending_reconstruction[info.block.block_id] = (
-            time.monotonic() + 30.0)
+            time.monotonic() + self._pending_timeout_s)
         self._m_reconstructions.incr()
         return True
 
@@ -691,7 +697,7 @@ class BlockManager:
                 "sources": sources,
             })
         self._pending_reconstruction[info.block.block_id] = (
-            time.monotonic() + 60.0)
+            time.monotonic() + 2 * self._pending_timeout_s)
         self._m_reconstructions.incr()
         return True
 

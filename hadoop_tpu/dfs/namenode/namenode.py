@@ -115,7 +115,21 @@ class ClientProtocol:
     @idempotent
     def get_block_locations(self, path: str, offset: int = 0,
                             length: int = 1 << 62):
-        return self.fsn.get_block_locations(path, offset, length)
+        info = self.fsn.get_block_locations(path, offset, length)
+        if self._state() == ha.OBSERVER and not info.get("uc"):
+            # An observer that has tailed the namespace but not yet
+            # received the DNs' block reports would answer with zero
+            # locations for a COMPLETE file — send the client to the
+            # active instead (ref: ObserverRetryOnActiveException in
+            # the reference's getBlockLocations path). Under-
+            # construction files ("uc" is the top-level flag) are
+            # exempt: their in-flight block legitimately has none.
+            for b in info.get("blocks", []):
+                if not b.get("locs"):
+                    raise RetriableError(
+                        f"observer has no locations for a block of "
+                        f"{path} yet; retry on active")
+        return info
 
     @idempotent
     def get_file_info(self, path: str):
@@ -708,17 +722,25 @@ class NameNode(AbstractService):
             "dfs.namenode.redundancy.interval", 3.0)
         while not self._stop_event.wait(interval):
             try:
-                for node in self.fsn.bm.dn_manager.check_dead_nodes():
-                    self.fsn.bm.node_died(node)
-                if self.ha_state == ha.ACTIVE and \
-                        not self.fsn.bm.safemode.is_on():
-                    self.fsn.bm.compute_reconstruction_work()
-                    self.fsn.bm.dn_manager.check_admin_progress()
-                    self.fsn.check_leases()
-                    self.fsn.cache_monitor_pass()
-                    self.fsn.sps.pass_once()
+                self.redundancy_pass()
             except Exception:
                 log.exception("Redundancy monitor pass failed")
+
+    def redundancy_pass(self) -> None:
+        """One monitor sweep, callable synchronously — tests pump this
+        directly so reconstruction scheduling is deterministic under
+        load instead of racing the background thread's timing (ref: the
+        reference triggers BlockManager computation explicitly via
+        BlockManagerTestUtil in the same situations)."""
+        for node in self.fsn.bm.dn_manager.check_dead_nodes():
+            self.fsn.bm.node_died(node)
+        if self.ha_state == ha.ACTIVE and \
+                not self.fsn.bm.safemode.is_on():
+            self.fsn.bm.compute_reconstruction_work()
+            self.fsn.bm.dn_manager.check_admin_progress()
+            self.fsn.check_leases()
+            self.fsn.cache_monitor_pass()
+            self.fsn.sps.pass_once()
 
     def _checkpoint_monitor(self) -> None:
         """Periodic checkpoint by txn count / period (non-HA only; in HA

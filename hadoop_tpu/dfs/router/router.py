@@ -33,6 +33,48 @@ from hadoop_tpu.util.misc import parse_addr_list
 log = logging.getLogger(__name__)
 
 
+class StateStore:
+    """Router State Store (ref: hadoop-hdfs-rbf/.../federation/store/ —
+    StateStoreService with MountTable / MembershipState / RouterState
+    record stores; the reference backs it with ZK or files, this one
+    with JSON files per record type in one directory, consistent with
+    the framework's ZK-less coordination)."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _path(self, record: str) -> str:
+        return os.path.join(self.dir, f"{record}.json")
+
+    def load(self, record: str) -> Dict:
+        with self._lock:
+            path = self._path(record)
+            if not os.path.exists(path):
+                return {}
+            with open(path) as f:
+                return json.load(f)
+
+    def save(self, record: str, data: Dict) -> None:
+        with self._lock:
+            tmp = self._path(record) + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(data, f)
+            os.replace(tmp, self._path(record))
+
+    def update(self, record: str, key: str, value) -> None:
+        data = self.load(record)
+        data[key] = value
+        self.save(record, data)
+
+    def remove(self, record: str, key: str) -> bool:
+        data = self.load(record)
+        gone = data.pop(key, None) is not None
+        self.save(record, data)
+        return gone
+
+
 class MountTable:
     """Longest-prefix path → (nameservice, target path).
     Ref: resolver/MountTableResolver.java."""
@@ -139,6 +181,12 @@ class _RouterClientProtocol:
                 synth = router.synthetic(method, args[0])
                 if synth is not None:
                     return synth
+            if method == "content_summary" and args:
+                agg = router.aggregate_content_summary(args[0])
+                if agg is not None:
+                    return agg
+            if method in ("create", "mkdirs") and args:
+                router.check_mount_quota(args[0])
             if method in _PATH_METHODS and args:
                 path = args[0]
                 ns, remote, mount = router.resolve(path)
@@ -159,11 +207,18 @@ class Router(AbstractService):
         super().__init__("Router")
         self.state_dir = state_dir or conf.get(
             "dfs.federation.router.store.dir", "/tmp/htpu-router")
+        self.store = StateStore(self.state_dir)
         self.mounts = MountTable(os.path.join(self.state_dir,
                                               "mounts.json"))
+        # mount → {"nsquota": files|-1, "ssquota": bytes|-1}; persisted
+        # (ref: MountTable records carry quota; RouterQuotaManager)
+        self.quotas: Dict[str, Dict] = self.store.load("quota")
+        self._quota_usage: Dict[str, Dict] = {}
+        self._quota_ts = 0.0
         self._clients: Dict[str, DFSClient] = {}
         self._lock = threading.Lock()
         self.rpc: Optional[Server] = None
+        self._stop_evt = threading.Event()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -186,15 +241,124 @@ class Router(AbstractService):
 
     def service_start(self) -> None:
         self.rpc.start()
+        from hadoop_tpu.util.misc import Daemon
+        self._stop_evt.clear()
+        Daemon(self._heartbeat_loop, "router-heartbeat").start()
         log.info("Router on :%d (%d nameservices, %d mounts)",
                  self.rpc.port, len(self.ns_addrs),
                  len(self.mounts.entries()))
 
     def service_stop(self) -> None:
+        self._stop_evt.set()
         if self.rpc:
             self.rpc.stop()
         for c in self._clients.values():
             c.close()
+
+    def _heartbeat_loop(self) -> None:
+        """Record nameservice membership into the State Store (ref:
+        NamenodeHeartbeatService writing MembershipState records) and
+        refresh mount quota usage (ref: RouterQuotaUpdateService)."""
+        import time as _time
+        interval = self.config.get_time_seconds(
+            "dfs.federation.router.heartbeat.interval", 2.0)
+        # Quota refresh is a full subtree walk per quota'd mount on the
+        # NNs — its own (much slower) cadence, like the reference's
+        # RouterQuotaUpdateService (60s) vs the NN heartbeat.
+        quota_interval = self.config.get_time_seconds(
+            "dfs.federation.router.quota-cache.update.interval", 60.0)
+        next_quota = 0.0
+        while not self._stop_evt.is_set():
+            membership = {}
+            for ns in self.ns_addrs:
+                try:
+                    st = self.client(ns).nn.get_service_status()
+                    membership[ns] = {"state": st.get("state", "active"),
+                                      "addrs": [list(a) for a in
+                                                self.ns_addrs[ns]],
+                                      "last_seen": _time.time()}
+                except Exception as e:  # noqa: BLE001 — NS may be down
+                    membership[ns] = {"state": "unavailable",
+                                      "error": str(e)[:200],
+                                      "last_seen": _time.time()}
+            try:
+                self.store.save("membership", membership)
+            except OSError:
+                pass
+            import time as _t
+            if self.quotas and _t.monotonic() >= next_quota:
+                self.refresh_quota_usage()
+                next_quota = _t.monotonic() + quota_interval
+            self._stop_evt.wait(interval)
+
+    # -------------------------------------------------------------- quota
+
+    def set_mount_quota(self, mount: str, nsquota: int = -1,
+                        ssquota: int = -1) -> None:
+        mount = "/" + mount.strip("/")
+        self.quotas[mount] = {"nsquota": nsquota, "ssquota": ssquota}
+        self.store.save("quota", self.quotas)
+        self.refresh_quota_usage()
+
+    def refresh_quota_usage(self) -> None:
+        """Aggregate per-mount usage across nameservices (ref:
+        RouterQuotaUpdateService computing RouterQuotaUsage)."""
+        usage = {}
+        for mount in self.quotas:
+            got = self.mounts.resolve(mount)
+            if got is None:
+                continue
+            ns, remote, _ = got
+            try:
+                cs = self.client(ns).nn.content_summary(remote)
+                usage[mount] = {"files": cs["files"] + cs["dirs"],
+                                "bytes": cs["length"]}
+            except (IOError, OSError):
+                continue
+        self._quota_usage = usage
+
+    def check_mount_quota(self, path: str) -> None:
+        """Reject writes into a mount over its quota (ref:
+        Quota.verifyQuota at the router). Uses the refreshed cache, so
+        enforcement lags by one refresh interval like the reference."""
+        from hadoop_tpu.dfs.protocol.records import QuotaExceededError
+        p = "/" + path.strip("/")
+        for mount, q in self.quotas.items():
+            if p != mount and not p.startswith(mount.rstrip("/") + "/"):
+                continue
+            used = self._quota_usage.get(mount)
+            if used is None:
+                continue
+            if 0 <= q["nsquota"] <= used["files"]:
+                raise QuotaExceededError(
+                    f"mount {mount} namespace quota exceeded: "
+                    f"{used['files']} >= {q['nsquota']}")
+            if 0 <= q["ssquota"] <= used["bytes"]:
+                raise QuotaExceededError(
+                    f"mount {mount} space quota exceeded: "
+                    f"{used['bytes']} >= {q['ssquota']}")
+
+    def aggregate_content_summary(self, path: str) -> Optional[Dict]:
+        """content_summary for a path ABOVE the mounts: the sum over
+        every mount beneath it, across nameservices (ref:
+        RouterClientProtocol.getContentSummary merging remote
+        summaries)."""
+        if self.mounts.resolve(path) is not None:
+            return None  # resolvable → forward normally
+        p = "/" + path.strip("/") if path != "/" else ""
+        total = {"files": 0, "dirs": 0, "length": 0}
+        hit = False
+        for mount, (ns, target) in self.mounts.entries().items():
+            if not (mount.startswith(p + "/") or not p):
+                continue
+            try:
+                cs = self.client(ns).nn.content_summary(target)
+            except (IOError, OSError):
+                continue
+            hit = True
+            for k in total:
+                total[k] += cs.get(k, 0)
+        return total if hit else None
 
     @property
     def port(self) -> int:
@@ -290,6 +454,23 @@ class _RouterAdminProtocol:
 
     def remove_mount(self, mount: str) -> bool:
         return self.router.mounts.remove(mount)
+
+    def set_quota(self, mount: str, nsquota: int = -1,
+                  ssquota: int = -1) -> bool:
+        """Ref: RouterAdminServer.setQuota → RouterQuotaManager."""
+        self.router.set_mount_quota(mount, nsquota, ssquota)
+        return True
+
+    @idempotent
+    def get_quota_usage(self) -> Dict:
+        self.router.refresh_quota_usage()
+        return {"quotas": dict(self.router.quotas),
+                "usage": dict(self.router._quota_usage)}
+
+    @idempotent
+    def get_membership(self) -> Dict:
+        """Ref: store MembershipState records via RouterAdmin."""
+        return self.router.store.load("membership")
 
     @idempotent
     def list_mounts(self) -> Dict[str, List[str]]:

@@ -188,7 +188,7 @@ class ObjectStoreFileSystem(FileSystem):
         except FileNotFoundError:
             return False
         if not st.is_dir:
-            self.http.request("DELETE", self._obj_path(bucket, key))
+            self._delete_obj(bucket, key)
             return True
         prefix = key.rstrip("/") + "/" if key else ""
         children = [o["key"] for kind, o in
@@ -197,7 +197,7 @@ class ObjectStoreFileSystem(FileSystem):
         if real_children and not recursive:
             raise OSError(f"{path} is non-empty")
         for k in children:
-            self.http.request("DELETE", self._obj_path(bucket, k))
+            self._delete_obj(bucket, k)
         return True
 
     def rename(self, src: str, dst: str) -> bool:
@@ -220,7 +220,7 @@ class ObjectStoreFileSystem(FileSystem):
         db, dk = self._bucket_key(dst)
         if not sst.is_dir:
             self._copy(sb, sk, db, dk)
-            self.http.request("DELETE", self._obj_path(sb, sk))
+            self._delete_obj(sb, sk)
             return True
         sprefix = sk.rstrip("/") + "/" if sk else ""
         dprefix = dk.rstrip("/") + "/" if dk else ""
@@ -232,8 +232,14 @@ class ObjectStoreFileSystem(FileSystem):
             self._copy(sb, o["key"], db, dprefix + rel)
             moved.append(o["key"])
         for k in moved:
-            self.http.request("DELETE", self._obj_path(sb, k))
+            self._delete_obj(sb, k)
         return True
+
+    def _delete_obj(self, bucket: str, key: str) -> None:
+        status, _, _ = self.http.request("DELETE",
+                                         self._obj_path(bucket, key))
+        if status not in (200, 204, 404):  # 404: already gone (idempotent)
+            raise IOError(f"delete {bucket}/{key}: HTTP {status}")
 
     def _copy(self, sb: str, sk: str, db: str, dk: str) -> None:
         status, _, _ = self.http.request(

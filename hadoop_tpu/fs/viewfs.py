@@ -63,9 +63,6 @@ class ViewFileSystem(FileSystem):
             f"{path}: not under any viewfs mount point "
             f"({[m for m, _ in self._links]})")
 
-    def _mount_roots(self) -> List[str]:
-        return sorted({m.split("/", 2)[1] for m, _ in self._links})
-
     # ----------------------------------------------------------------- SPI
 
     def open(self, path: str):

@@ -201,15 +201,47 @@ class Decoder:
         return out
 
 
+# C accelerator (native/src/wirepack.c — the protobuf-generated-code
+# slot): byte-identical codec; the Python Encoder/Decoder above stays
+# as the fallback and the format's executable spec. The C encoder
+# punts on to_wire() objects, int subclasses, and >64-bit ints via
+# TypeError/OverflowError, which routes those through Python.
+try:
+    from hadoop_tpu.native import _wirepack_c as _C
+except ImportError:  # pragma: no cover - build-less environments
+    _C = None
+
+
 def pack(obj: Any) -> bytes:
+    if _C is not None:
+        try:
+            return _C.pack(obj)
+        except (TypeError, OverflowError):
+            pass
+        except _C.WireError as e:
+            raise WireError(str(e)) from None
     return Encoder().encode(obj).getvalue()
 
 
 def unpack(data, offset: int = 0) -> Any:
+    if _C is not None:
+        try:
+            return _C.unpack(data, offset)
+        except OverflowError:
+            pass  # >64-bit varint: the Python decoder handles it
+        except _C.WireError as e:
+            raise WireError(str(e)) from None
     return Decoder(data, offset).decode()
 
 
 def unpack_with_offset(data, offset: int = 0) -> Tuple[Any, int]:
+    if _C is not None:
+        try:
+            return _C.unpack_with_offset(data, offset)
+        except OverflowError:
+            pass
+        except _C.WireError as e:
+            raise WireError(str(e)) from None
     dec = Decoder(data, offset)
     return dec.decode(), dec.offset
 

@@ -68,6 +68,10 @@ class RpcProxy:
                 user=self._user)
 
         invoke.__name__ = name
+        # Cache on the instance: __getattr__ only fires on a MISS, so
+        # every later proxy.method skips both this closure allocation
+        # and the attribute-protocol slow path (hot on the RPC path).
+        object.__setattr__(self, name, invoke)
         return invoke
 
 

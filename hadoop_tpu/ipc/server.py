@@ -53,6 +53,19 @@ PING_CALL_ID = -1
 MAX_FRAME = 128 * 1024 * 1024
 
 
+class _NoopSpanCm:
+    """Reusable null context for untraced calls (no allocation)."""
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_SPAN = _NoopSpanCm()
+
+
 class CallContext:
     """Per-call server-side context available to handlers via current_call().
     Carries what the reference spreads across Server.Call (Server.java:758),
@@ -440,8 +453,16 @@ class Server:
         t0 = time.monotonic()
         token = _current_call.set(ctx)
         try:
-            with self._tracer.span(f"{self.name}.{method}", parent=span_ctx) as sp:
-                sp.add_kv("caller", conn.caller_key())
+            # Server spans are children of the CALLER's span: when the
+            # request carries no trace context, skip the tracer entirely
+            # (a root span per call would cost an object + delivery
+            # locks on every RPC and record traces nobody asked for —
+            # the htrace model samples at the client).
+            with (self._tracer.span(f"{self.name}.{method}",
+                                    parent=span_ctx)
+                  if span_ctx is not None else _NOOP_SPAN) as sp:
+                if sp is not None:
+                    sp.add_kv("caller", conn.caller_key())
                 impl = self._protocols.get(protocol)
                 if impl is None:
                     raise ValueError(f"unknown protocol {protocol!r}")

@@ -89,6 +89,16 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_uint64)]
     lib.htpu_buf_free.restype = None
     lib.htpu_buf_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+    lib.htpu_fadvise.restype = ctypes.c_int
+    lib.htpu_fadvise.argtypes = [ctypes.c_int, ctypes.c_longlong,
+                                 ctypes.c_longlong, ctypes.c_int]
+    lib.htpu_sync_range.restype = ctypes.c_int
+    lib.htpu_sync_range.argtypes = [ctypes.c_int, ctypes.c_longlong,
+                                    ctypes.c_longlong, ctypes.c_int]
+    for name in ("htpu_fadv_sequential", "htpu_fadv_dontneed",
+                 "htpu_fadv_willneed"):
+        getattr(lib, name).restype = ctypes.c_int
+        getattr(lib, name).argtypes = []
     return lib
 
 
@@ -262,3 +272,44 @@ def merge_segments_counted(segments: Sequence[bytes],
         return ctypes.string_at(out, out_len.value), rc
     finally:
         lib.htpu_buf_free(out)
+
+
+# ------------------------------------------------------------- NativeIO
+
+FADV_SEQUENTIAL = 2
+FADV_DONTNEED = 4
+FADV_WILLNEED = 3
+
+
+def fadvise(fd: int, offset: int, length: int, advice: int) -> bool:
+    """posix_fadvise through the native layer (ref: NativeIO.c
+    posix_fadvise binding). No-op (False) without the library — the
+    reference degrades the same way when libhadoop is absent."""
+    lib = get_lib()
+    if lib is None:
+        return False
+    try:
+        if advice == FADV_SEQUENTIAL:
+            advice = lib.htpu_fadv_sequential()
+        elif advice == FADV_DONTNEED:
+            advice = lib.htpu_fadv_dontneed()
+        elif advice == FADV_WILLNEED:
+            advice = lib.htpu_fadv_willneed()
+        return lib.htpu_fadvise(fd, offset, length, advice) == 0
+    except (OSError, ValueError):
+        return False
+
+
+def sync_file_range(fd: int, offset: int, nbytes: int,
+                    wait: bool = False) -> bool:
+    """Kick (optionally await) writeback for a byte range (ref:
+    NativeIO.c sync_file_range binding — the mechanism behind
+    dfs.datanode.sync.behind.writes)."""
+    lib = get_lib()
+    if lib is None:
+        return False
+    try:
+        return lib.htpu_sync_range(fd, offset, nbytes,
+                                   1 if wait else 0) == 0
+    except (OSError, ValueError):
+        return False

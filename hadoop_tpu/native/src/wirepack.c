@@ -1,0 +1,417 @@
+/* wirepack C accelerator — the control plane's serializer hot path.
+ *
+ * Byte-identical to hadoop_tpu/io/wire.py's Encoder/Decoder (the role
+ * protobuf's generated C++ plays in the reference: every RPC
+ * request/response crosses this codec, so it dominates per-call CPU in
+ * the pure-Python server the way ProtobufRpcEngine would if it were
+ * interpreted). Built as a CPython extension (no pybind11): wire.py
+ * prefers it when importable and keeps the Python codec as the
+ * fallback and the format's executable spec.
+ *
+ * Layout (wire.py "tag space"):
+ *   00-7f fixint | 80-8f fixmap | 90-9f fixarray | a0-bf fixstr
+ *   c0 nil | c2 false | c3 true | c4 bin | c5 str | c6 zigzag varint
+ *   c7 f64 | c8 arr | c9 map | e0-ff negative fixint
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <stdint.h>
+#include <string.h>
+
+static PyObject *WireError;
+
+/* ------------------------------------------------------------ encoder */
+
+typedef struct {
+  char *buf;
+  Py_ssize_t len;
+  Py_ssize_t cap;
+} enc_t;
+
+static int enc_reserve(enc_t *e, Py_ssize_t extra) {
+  if (e->len + extra <= e->cap) return 0;
+  Py_ssize_t ncap = e->cap ? e->cap : 256;
+  while (ncap < e->len + extra) ncap *= 2;
+  char *nbuf = PyMem_Realloc(e->buf, ncap);
+  if (!nbuf) {
+    PyErr_NoMemory();
+    return -1;
+  }
+  e->buf = nbuf;
+  e->cap = ncap;
+  return 0;
+}
+
+static int enc_byte(enc_t *e, uint8_t b) {
+  if (enc_reserve(e, 1)) return -1;
+  e->buf[e->len++] = (char)b;
+  return 0;
+}
+
+static int enc_bytes(enc_t *e, const char *p, Py_ssize_t n) {
+  if (enc_reserve(e, n)) return -1;
+  memcpy(e->buf + e->len, p, n);
+  e->len += n;
+  return 0;
+}
+
+static int enc_uvarint(enc_t *e, uint64_t n) {
+  do {
+    uint8_t b = n & 0x7F;
+    n >>= 7;
+    if (enc_byte(e, n ? (b | 0x80) : b)) return -1;
+  } while (n);
+  return 0;
+}
+
+static int enc_obj(enc_t *e, PyObject *o, int depth) {
+  if (depth > 200) {
+    PyErr_SetString(WireError, "structure too deep");
+    return -1;
+  }
+  if (o == Py_None) return enc_byte(e, 0xC0);
+  if (o == Py_True) return enc_byte(e, 0xC3);
+  if (o == Py_False) return enc_byte(e, 0xC2);
+
+  if (PyLong_CheckExact(o)) {
+    int overflow = 0;
+    long long v = PyLong_AsLongLongAndOverflow(o, &overflow);
+    if (overflow || (v == -1 && PyErr_Occurred())) {
+      PyErr_Clear();
+      /* arbitrary-precision ints are legal in the format; punt to the
+       * Python encoder for the whole message (caller retries). */
+      PyErr_SetString(PyExc_OverflowError, "int beyond 64-bit");
+      return -1;
+    }
+    if (v >= 0 && v <= 0x7F) return enc_byte(e, (uint8_t)v);
+    if (v >= -32 && v < 0) return enc_byte(e, (uint8_t)(0x100 + v));
+    if (enc_byte(e, 0xC6)) return -1;
+    uint64_t zz = v >= 0 ? ((uint64_t)v << 1)
+                         : (((uint64_t)(-(v + 1)) << 1) + 1);
+    return enc_uvarint(e, zz);
+  }
+
+  if (PyFloat_CheckExact(o)) {
+    double d = PyFloat_AS_DOUBLE(o);
+    if (enc_byte(e, 0xC7)) return -1;
+    uint64_t bits;
+    memcpy(&bits, &d, 8);
+    char be[8];
+    for (int i = 0; i < 8; i++) be[i] = (char)(bits >> (56 - 8 * i));
+    return enc_bytes(e, be, 8);
+  }
+
+  if (PyUnicode_CheckExact(o)) {
+    Py_ssize_t n;
+    const char *s = PyUnicode_AsUTF8AndSize(o, &n);
+    if (!s) return -1;
+    if (n <= 31) {
+      if (enc_byte(e, (uint8_t)(0xA0 | n))) return -1;
+    } else {
+      if (enc_byte(e, 0xC5) || enc_uvarint(e, (uint64_t)n)) return -1;
+    }
+    return enc_bytes(e, s, n);
+  }
+
+  if (PyBytes_CheckExact(o)) {
+    Py_ssize_t n = PyBytes_GET_SIZE(o);
+    if (enc_byte(e, 0xC4) || enc_uvarint(e, (uint64_t)n)) return -1;
+    return enc_bytes(e, PyBytes_AS_STRING(o), n);
+  }
+  if (PyByteArray_CheckExact(o)) {
+    Py_ssize_t n = PyByteArray_GET_SIZE(o);
+    if (enc_byte(e, 0xC4) || enc_uvarint(e, (uint64_t)n)) return -1;
+    return enc_bytes(e, PyByteArray_AS_STRING(o), n);
+  }
+  if (PyMemoryView_Check(o)) {
+    Py_buffer view;
+    if (PyObject_GetBuffer(o, &view, PyBUF_CONTIG_RO)) return -1;
+    int rc = enc_byte(e, 0xC4) || enc_uvarint(e, (uint64_t)view.len) ||
+             enc_bytes(e, view.buf, view.len);
+    PyBuffer_Release(&view);
+    return rc ? -1 : 0;
+  }
+
+  if (PyList_CheckExact(o) || PyTuple_CheckExact(o)) {
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(o);
+    if (n <= 15) {
+      if (enc_byte(e, (uint8_t)(0x90 | n))) return -1;
+    } else {
+      if (enc_byte(e, 0xC8) || enc_uvarint(e, (uint64_t)n)) return -1;
+    }
+    PyObject **items = PySequence_Fast_ITEMS(o);
+    for (Py_ssize_t i = 0; i < n; i++)
+      if (enc_obj(e, items[i], depth + 1)) return -1;
+    return 0;
+  }
+
+  if (PyDict_CheckExact(o)) {
+    Py_ssize_t n = PyDict_GET_SIZE(o);
+    if (n <= 15) {
+      if (enc_byte(e, (uint8_t)(0x80 | n))) return -1;
+    } else {
+      if (enc_byte(e, 0xC9) || enc_uvarint(e, (uint64_t)n)) return -1;
+    }
+    PyObject *k, *v;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(o, &pos, &k, &v)) {
+      if (!PyUnicode_CheckExact(k)) {
+        PyErr_Format(WireError, "map keys must be str, got %s",
+                     Py_TYPE(k)->tp_name);
+        return -1;
+      }
+      if (enc_obj(e, k, depth + 1) || enc_obj(e, v, depth + 1)) return -1;
+    }
+    return 0;
+  }
+
+  /* to_wire() objects / int subclasses (bools handled above): defer to
+   * the Python encoder via a recognizable error. */
+  PyErr_Format(PyExc_TypeError, "wirepack_c cannot encode %s",
+               Py_TYPE(o)->tp_name);
+  return -1;
+}
+
+static PyObject *py_pack(PyObject *self, PyObject *arg) {
+  (void)self;
+  enc_t e = {NULL, 0, 0};
+  if (enc_obj(&e, arg, 0)) {
+    PyMem_Free(e.buf);
+    return NULL;
+  }
+  PyObject *out = PyBytes_FromStringAndSize(e.buf, e.len);
+  PyMem_Free(e.buf);
+  return out;
+}
+
+/* ------------------------------------------------------------ decoder */
+
+typedef struct {
+  const uint8_t *d;
+  Py_ssize_t len;
+  Py_ssize_t p;
+} dec_t;
+
+static int dec_uvarint(dec_t *d, uint64_t *out) {
+  uint64_t n = 0;
+  int shift = 0;
+  for (;;) {
+    if (d->p >= d->len) {
+      PyErr_SetString(WireError, "truncated varint");
+      return -1;
+    }
+    uint8_t b = d->d[d->p++];
+    n |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *out = n;
+      return 0;
+    }
+    shift += 7;
+    if (shift > 63) {
+      /* arbitrary-precision int: legal in the format but beyond this
+       * decoder — OverflowError routes the message to the Python
+       * decoder. */
+      PyErr_SetString(PyExc_OverflowError, "varint beyond 64-bit");
+      return -1;
+    }
+  }
+}
+
+static PyObject *dec_obj(dec_t *d, int depth) {
+  if (depth > 200) {
+    PyErr_SetString(WireError, "structure too deep");
+    return NULL;
+  }
+  if (d->p >= d->len) {
+    PyErr_SetString(WireError, "truncated input");
+    return NULL;
+  }
+  uint8_t tag = d->d[d->p++];
+  if (tag <= 0x7F) return PyLong_FromLong(tag);
+  if (tag >= 0xE0) return PyLong_FromLong((long)tag - 0x100);
+
+  if (tag >= 0xA0 && tag <= 0xBF) {
+    Py_ssize_t n = tag & 0x1F;
+    if (d->p + n > d->len) goto truncated;
+    PyObject *s =
+        PyUnicode_DecodeUTF8((const char *)d->d + d->p, n, NULL);
+    d->p += n;
+    return s;
+  }
+  if (tag >= 0x90 && tag <= 0x9F) {
+    Py_ssize_t n = tag & 0x0F;
+    PyObject *lst = PyList_New(n);
+    if (!lst) return NULL;
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject *item = dec_obj(d, depth + 1);
+      if (!item) {
+        Py_DECREF(lst);
+        return NULL;
+      }
+      PyList_SET_ITEM(lst, i, item);
+    }
+    return lst;
+  }
+  if (tag >= 0x80 && tag <= 0x8F) {
+    Py_ssize_t n = tag & 0x0F;
+    PyObject *m = PyDict_New();
+    if (!m) return NULL;
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject *k = dec_obj(d, depth + 1);
+      if (!k) goto mapfail;
+      PyObject *v = dec_obj(d, depth + 1);
+      if (!v) {
+        Py_DECREF(k);
+        goto mapfail;
+      }
+      int rc = PyDict_SetItem(m, k, v);
+      Py_DECREF(k);
+      Py_DECREF(v);
+      if (rc) goto mapfail;
+    }
+    return m;
+  mapfail:
+    Py_DECREF(m);
+    return NULL;
+  }
+
+  switch (tag) {
+    case 0xC0:
+      Py_RETURN_NONE;
+    case 0xC2:
+      Py_RETURN_FALSE;
+    case 0xC3:
+      Py_RETURN_TRUE;
+    case 0xC6: {
+      uint64_t zz;
+      if (dec_uvarint(d, &zz)) return NULL;
+      int64_t v = (int64_t)(zz >> 1) ^ -(int64_t)(zz & 1);
+      return PyLong_FromLongLong(v);
+    }
+    case 0xC7: {
+      if (d->p + 8 > d->len) goto truncated;
+      uint64_t bits = 0;
+      for (int i = 0; i < 8; i++) bits = (bits << 8) | d->d[d->p + i];
+      d->p += 8;
+      double v;
+      memcpy(&v, &bits, 8);
+      return PyFloat_FromDouble(v);
+    }
+    case 0xC5: {
+      uint64_t n;
+      if (dec_uvarint(d, &n)) return NULL;
+      if (d->p + (Py_ssize_t)n > d->len) goto truncated;
+      PyObject *s =
+          PyUnicode_DecodeUTF8((const char *)d->d + d->p, n, NULL);
+      d->p += n;
+      return s;
+    }
+    case 0xC4: {
+      uint64_t n;
+      if (dec_uvarint(d, &n)) return NULL;
+      if (d->p + (Py_ssize_t)n > d->len) goto truncated;
+      PyObject *b =
+          PyBytes_FromStringAndSize((const char *)d->d + d->p, n);
+      d->p += n;
+      return b;
+    }
+    case 0xC8: {
+      uint64_t n;
+      if (dec_uvarint(d, &n)) return NULL;
+      if ((Py_ssize_t)n > d->len - d->p) goto truncated; /* sanity */
+      PyObject *lst = PyList_New((Py_ssize_t)n);
+      if (!lst) return NULL;
+      for (Py_ssize_t i = 0; i < (Py_ssize_t)n; i++) {
+        PyObject *item = dec_obj(d, depth + 1);
+        if (!item) {
+          Py_DECREF(lst);
+          return NULL;
+        }
+        PyList_SET_ITEM(lst, i, item);
+      }
+      return lst;
+    }
+    case 0xC9: {
+      uint64_t n;
+      if (dec_uvarint(d, &n)) return NULL;
+      PyObject *m = PyDict_New();
+      if (!m) return NULL;
+      for (uint64_t i = 0; i < n; i++) {
+        PyObject *k = dec_obj(d, depth + 1);
+        if (!k) {
+          Py_DECREF(m);
+          return NULL;
+        }
+        PyObject *v = dec_obj(d, depth + 1);
+        if (!v) {
+          Py_DECREF(k);
+          Py_DECREF(m);
+          return NULL;
+        }
+        int rc = PyDict_SetItem(m, k, v);
+        Py_DECREF(k);
+        Py_DECREF(v);
+        if (rc) {
+          Py_DECREF(m);
+          return NULL;
+        }
+      }
+      return m;
+    }
+  }
+  PyErr_Format(WireError, "bad tag 0x%02x at %zd", tag, d->p - 1);
+  return NULL;
+truncated:
+  PyErr_SetString(WireError, "truncated payload");
+  return NULL;
+}
+
+static PyObject *py_unpack_with_offset(PyObject *self, PyObject *args) {
+  (void)self;
+  Py_buffer view;
+  Py_ssize_t offset = 0;
+  if (!PyArg_ParseTuple(args, "y*|n", &view, &offset)) return NULL;
+  dec_t d = {(const uint8_t *)view.buf, view.len, offset};
+  PyObject *obj = dec_obj(&d, 0);
+  PyBuffer_Release(&view);
+  if (!obj) return NULL;
+  PyObject *out = Py_BuildValue("(Nn)", obj, d.p);
+  return out;
+}
+
+static PyObject *py_unpack(PyObject *self, PyObject *args) {
+  (void)self;
+  Py_buffer view;
+  Py_ssize_t offset = 0;
+  if (!PyArg_ParseTuple(args, "y*|n", &view, &offset)) return NULL;
+  dec_t d = {(const uint8_t *)view.buf, view.len, offset};
+  PyObject *obj = dec_obj(&d, 0);
+  PyBuffer_Release(&view);
+  return obj;
+}
+
+static PyMethodDef methods[] = {
+    {"pack", py_pack, METH_O, "pack(obj) -> bytes"},
+    {"unpack", py_unpack, METH_VARARGS, "unpack(data, offset=0) -> obj"},
+    {"unpack_with_offset", py_unpack_with_offset, METH_VARARGS,
+     "unpack_with_offset(data, offset=0) -> (obj, end)"},
+    {NULL, NULL, 0, NULL}};
+
+static struct PyModuleDef mod = {PyModuleDef_HEAD_INIT, "_wirepack_c",
+                                 "wirepack codec accelerator", -1, methods,
+                                 NULL, NULL, NULL, NULL};
+
+PyMODINIT_FUNC PyInit__wirepack_c(void) {
+  PyObject *m = PyModule_Create(&mod);
+  if (!m) return NULL;
+  WireError = PyErr_NewException("_wirepack_c.WireError", NULL, NULL);
+  Py_XINCREF(WireError);
+  if (PyModule_AddObject(m, "WireError", WireError)) {
+    Py_XDECREF(WireError);
+    Py_DECREF(m);
+    return NULL;
+  }
+  return m;
+}

@@ -38,10 +38,15 @@ class MeshPlan:
     ep: int = 1
     sp: int = 1
     megatron_sp: bool = False   # sequence parallelism on the tp axis
+    vpp: int = 1                # virtual stages per pp rank (interleaved
+    #                             1F1B model chunks, Megatron-style)
 
     def __post_init__(self):
         if self.megatron_sp and self.tp == 1:
             raise ValueError("megatron_sp requires tp > 1")
+        if self.vpp > 1 and self.pp == 1:
+            raise ValueError("vpp (interleaved virtual stages) requires "
+                             "pp > 1")
         if self.sp > 1 and (self.tp > 1 or self.pp > 1):
             raise ValueError("ring context parallelism (sp) is composed "
                              "with dp only in this version")

@@ -167,3 +167,192 @@ def pipeline_1f1b_loss_and_grad(params, tokens, targets, *,
         jnp.arange(M + 2 * Pp - 2))
     # float32 accumulators; the caller reduces across ranks, then casts.
     return loss_sum, grads
+
+
+def interleaved_layer_permutation(n_layers: int, pp: int, v: int):
+    """Physical→logical layer order for the interleaved schedule.
+
+    Megatron's interleaved layout (ref: BASELINE.json north star "1F1B
+    interleaved pipeline schedule"; Megatron-LM's virtual pipeline
+    model chunks) gives rank ``s`` the v chunks {c·pp + s}: virtual
+    stage q = c·pp + s covers logical layers [q·Lc, (q+1)·Lc). The
+    framework shards the stacked layer axis contiguously over 'pp', so
+    the stacked order must be permuted: physical position
+    (s·v + c)·Lc + i  ←  logical layer (c·pp + s)·Lc + i.
+
+    Returns ``perm`` such that ``stacked.take(perm, axis=0)`` converts
+    logically-ordered layers to the physical interleaved layout
+    (and ``argsort(perm)`` inverts it, e.g. for gradients).
+    """
+    if n_layers % (pp * v):
+        raise ValueError(f"n_layers={n_layers} not divisible by "
+                         f"pp*v={pp * v}")
+    lc = n_layers // (pp * v)
+    perm = []
+    for s in range(pp):
+        for c in range(v):
+            q = c * pp + s
+            perm.extend(range(q * lc, (q + 1) * lc))
+    return perm
+
+
+def pipeline_interleaved_loss_and_grad(params, tokens, targets, *,
+                                       cfg: ModelConfig, plan, ctx,
+                                       n_microbatches: int, remat: bool,
+                                       loss_from_h
+                                       ) -> Tuple[jnp.ndarray, Any]:
+    """Interleaved 1F1B: v virtual stages (model chunks) per rank.
+
+    Ref: the Megatron-LM interleaved schedule (BASELINE.json's north
+    star) — splitting each rank's layers into v chunks multiplies the
+    pipeline's virtual depth by v while each hop stays one rank, which
+    divides the warmup/cooldown bubble per unit of work by ~2 at the
+    cost of v× the in-flight activation slots and v× the p2p hops.
+
+    Same masked-global-clock construction as the plain schedule, with
+    the clock remapped: virtual stage q = c·P + s (chunk c of rank s);
+    per tick each rank runs ONE chunk-forward and ONE chunk-backward.
+    Microbatches advance in groups of P (M must divide by P — the
+    reference imposes the same constraint). All transfers remain
+    single-tick ppermute(+1 fwd / −1 bwd) ring hops: for s<P−1 the
+    activation moves to (c, s+1); off the ring's seam (s=P−1→0) it
+    arrives as chunk c+1 — the clock arithmetic, not a data shuffle,
+    realizes the seam.
+
+    Forward of (m, q=cP+s) fires at  t = (m÷P)·V + cP + (m mod P) + s,
+    backward at                      t = (m÷P)·V + (m mod P) + 2V−1−q,
+    (V = vP), giving an input lifetime of 2V−1−2q ticks → a 2V-slot
+    ring buffer indexed by forward tick never collides.
+    """
+    M = n_microbatches
+    Pp = plan.pp
+    v = getattr(plan, "vpp", 1)
+    V = v * Pp
+    if M % Pp:
+        raise ValueError(f"interleaved schedule needs n_microbatches "
+                         f"({M}) divisible by pp ({Pp})")
+    B_l, S = tokens.shape
+    tok_mb = tokens.reshape(M, B_l // M, S)
+    tgt_mb = targets.reshape(M, B_l // M, S)
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
+    stage = jax.lax.axis_index("pp")
+    s_act = S // plan.tp if plan.megatron_sp else S
+    K = 2 * V
+    fwd_perm = [(i, (i + 1) % Pp) for i in range(Pp)]
+    bwd_perm = [((i + 1) % Pp, i) for i in range(Pp)]
+
+    def chunk_params(p, c):
+        """Chunk c's slice of this rank's stacked layer leaves. Must
+        slice the CALLER's params (the vjp primal), not a closure —
+        a closed-over copy would be constant under differentiation and
+        the layer grads would silently vanish."""
+        def slice_leaf(a):
+            lc = a.shape[0] // v
+            folded = a.reshape((v, lc) + a.shape[1:])
+            return jax.lax.dynamic_index_in_dim(folded, c, axis=0,
+                                                keepdims=False)
+        return jax.tree_util.tree_map(slice_leaf, p["layers"])
+
+    def stage_fn(params, tok, tgt, x_in, q, c):
+        x0 = embed_tokens(params, tok, cfg, ctx)
+        x = jnp.where(q == 0, x0, x_in)
+        y = run_layers(x, chunk_params(params, c), cfg, ctx, cos, sin,
+                       remat=remat)
+        return y, loss_from_h(params, y, tgt, cfg, ctx)
+
+    act_shape = (B_l // M, s_act, cfg.d_model)
+
+    # vma fixed point + cotangent avals (same dance as the plain
+    # schedule — scan carries must hold exactly the right vma).
+    def _apply(p, x):
+        return stage_fn(p, tok_mb[0], tgt_mb[0], x, jnp.int32(1),
+                        jnp.int32(0))
+
+    act_vma = frozenset()
+    for _ in range(4):
+        x_probe = pvary_to(jnp.zeros(act_shape, cfg.jax_dtype), act_vma)
+        y_av, loss_av = jax.eval_shape(_apply, params, x_probe)
+        new = act_vma | frozenset(y_av.vma)
+        if new == act_vma:
+            break
+        act_vma = new
+    loss_vma = frozenset(loss_av.vma) | {"pp"}
+    x_probe = pvary_to(jnp.zeros(act_shape, cfg.jax_dtype), act_vma)
+
+    def _cotangent_avals(p, x):
+        (y, loss), vjp = jax.vjp(_apply, p, x)
+        return vjp((y, loss))
+
+    dparams_av, dx_av = jax.eval_shape(_cotangent_avals, params, x_probe)
+    zero_grads = jax.tree_util.tree_map(
+        lambda av: pvary_to(jnp.zeros(av.shape, jnp.float32),
+                            frozenset(av.vma)),
+        dparams_av)
+
+    def fwd_coords(t):
+        """(m, c, q, valid) whose forward this rank runs at tick t."""
+        u = t - stage
+        uc = jnp.maximum(u, 0)
+        w = uc % V
+        c = w // Pp
+        m = (uc // V) * Pp + (w % Pp)
+        valid = (u >= 0) & (m < M)
+        return jnp.clip(m, 0, M - 1), c, c * Pp + stage, valid
+
+    def bwd_coords(t):
+        """(m, c, q, valid) whose backward this rank runs at tick t."""
+        z = t + stage - (V - 1)
+        zc = jnp.maximum(z, 0)
+        k = zc // V
+        w = zc % V
+        cc = w // Pp
+        c = jnp.where(cc == 0, 0, v - cc)
+        m = (k - jnp.where(cc == 0, 1, 0)) * Pp + (w % Pp)
+        valid = (z >= 0) & (m >= 0) & (m < M)
+        return jnp.clip(m, 0, M - 1), c, c * Pp + stage, valid
+
+    def tick(carry, t):
+        recv_f, recv_b, buf, gacc, loss_acc = carry
+
+        # ---------------- forward half
+        mf, cf, qf, f_valid = fwd_coords(t)
+        tok_f = jnp.take(tok_mb, mf, axis=0)
+        tgt_f = jnp.take(tgt_mb, mf, axis=0)
+        y, loss_f = stage_fn(params, tok_f, tgt_f, recv_f, qf, cf)
+        loss_acc = loss_acc + jnp.where(f_valid & (qf == V - 1),
+                                        loss_f, 0.0)
+        buf = jax.lax.dynamic_update_index_in_dim(
+            buf, recv_f, t % K, axis=0)
+
+        # ---------------- backward half
+        mb, cb, qb, b_valid = bwd_coords(t)
+        tok_b = jnp.take(tok_mb, mb, axis=0)
+        tgt_b = jnp.take(tgt_mb, mb, axis=0)
+        x_saved = jax.lax.dynamic_index_in_dim(
+            buf, (t + 2 * qb + 1) % K, axis=0, keepdims=False)
+        _, vjp = jax.vjp(
+            lambda p, x: stage_fn(p, tok_b, tgt_b, x, qb, cb),
+            params, x_saved)
+        dy = pvary_to(jnp.where(b_valid & (qb != V - 1), 1.0, 0.0)
+                      .astype(recv_b.dtype) * recv_b, act_vma)
+        dloss = pvary_to(
+            jnp.where(b_valid & (qb == V - 1), 1.0, 0.0), loss_vma)
+        dparams, dx = vjp((dy, dloss))
+        gacc = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(jnp.float32), gacc, dparams)
+
+        recv_f2 = jax.lax.ppermute(y, "pp", fwd_perm)
+        recv_b2 = jax.lax.ppermute(dx, "pp", bwd_perm)
+        return (recv_f2, recv_b2, buf, gacc, loss_acc), None
+
+    recv_f0 = pvary_to(jnp.zeros(act_shape, cfg.jax_dtype), act_vma)
+    recv_b0 = pvary_to(jnp.zeros(act_shape, cfg.jax_dtype),
+                       frozenset(dx_av.vma))
+    buf0 = pvary_to(jnp.zeros((K,) + act_shape, cfg.jax_dtype), act_vma)
+    loss0 = pvary_to(jnp.zeros((), jnp.float32), loss_vma)
+
+    n_ticks = (M // Pp + 2) * V + Pp - 1
+    (_, _, _, grads, loss_sum), _ = jax.lax.scan(
+        tick, (recv_f0, recv_b0, buf0, zero_grads, loss0),
+        jnp.arange(n_ticks))
+    return loss_sum, grads

@@ -219,7 +219,11 @@ def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
         return jax.lax.psum(jnp.sum(losses), "pp") / M
 
     loss_fn = pipelined_loss if plan.pp > 1 else flat_loss
-    use_1f1b = plan.pp > 1 and pipeline_schedule == "1f1b"
+    if pipeline_schedule == "interleaved" or \
+            (plan.pp > 1 and plan.vpp > 1 and pipeline_schedule == "1f1b"):
+        pipeline_schedule = "interleaved"
+    use_1f1b = plan.pp > 1 and pipeline_schedule in ("1f1b",
+                                                     "interleaved")
 
     # Manual-schedule gradient reduction (the vma transpose machinery does
     # this automatically inside value_and_grad for the autodiff paths):
@@ -241,9 +245,13 @@ def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
 
     def body(params, opt_state, tokens, targets):
         if use_1f1b:
-            from hadoop_tpu.parallel.pipeline import \
-                pipeline_1f1b_loss_and_grad
-            loss, grads = pipeline_1f1b_loss_and_grad(
+            from hadoop_tpu.parallel.pipeline import (
+                pipeline_1f1b_loss_and_grad,
+                pipeline_interleaved_loss_and_grad)
+            sched = pipeline_interleaved_loss_and_grad \
+                if pipeline_schedule == "interleaved" \
+                else pipeline_1f1b_loss_and_grad
+            loss, grads = sched(
                 params, tokens, targets, cfg=cfg, plan=plan, ctx=ctx,
                 n_microbatches=n_microbatches, remat=remat,
                 loss_from_h=_loss_from_h)
@@ -318,12 +326,44 @@ def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
     return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
 
 
+def physical_layer_order(params, cfg: ModelConfig, plan: MeshPlan):
+    """Interleaved-1F1B placement: permute the stacked layer axis so the
+    contiguous 'pp' shard hands each rank its v model chunks (virtual
+    stages {c·pp + rank}). Identity when vpp == 1."""
+    if getattr(plan, "vpp", 1) <= 1:
+        return params
+    from hadoop_tpu.parallel.pipeline import interleaved_layer_permutation
+    perm = jnp.asarray(interleaved_layer_permutation(
+        cfg.n_layers, plan.pp, plan.vpp))
+    out = dict(params)
+    out["layers"] = jax.tree_util.tree_map(
+        lambda a: jnp.take(a, perm, axis=0), params["layers"])
+    return out
+
+
+def logical_layer_order(params, cfg: ModelConfig, plan: MeshPlan):
+    """Inverse of :func:`physical_layer_order` — back to checkpoint /
+    single-device layer order."""
+    if getattr(plan, "vpp", 1) <= 1:
+        return params
+    import numpy as _np
+
+    from hadoop_tpu.parallel.pipeline import interleaved_layer_permutation
+    inv = jnp.asarray(_np.argsort(interleaved_layer_permutation(
+        cfg.n_layers, plan.pp, plan.vpp)))
+    out = dict(params)
+    out["layers"] = jax.tree_util.tree_map(
+        lambda a: jnp.take(a, inv, axis=0), params["layers"])
+    return out
+
+
 def init_sharded(rng, cfg: ModelConfig, plan: MeshPlan, mesh: Mesh,
                  zero1: bool = False):
     """Initialize params + optimizer state and place them on the mesh.
     ``zero1``: moment state in the ZeRO-1 slice layout (must match the
     train step's flag)."""
     params = _init_params(rng, cfg)
+    params = physical_layer_order(params, cfg, plan)
     specs = param_specs(cfg, plan)
     params = shard_params(params, mesh, specs)
     if zero1:

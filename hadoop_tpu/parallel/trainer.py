@@ -59,6 +59,22 @@ class Trainer:
 
     def save(self) -> str:
         tree = self._state_tree()
+        if getattr(self.plan, "vpp", 1) > 1:
+            # Checkpoints persist the LOGICAL layer order so they stay
+            # portable across plans (interleaved placement permutes the
+            # stacked layer axis on device; see
+            # train.physical_layer_order). Adam moments mirror the
+            # params tree, so they permute the same way. ZeRO-1 state is
+            # flat slices — plan-locked either way — left as stored.
+            from hadoop_tpu.parallel.train import logical_layer_order
+            tree = dict(tree, params=logical_layer_order(
+                tree["params"], self.cfg, self.plan))
+            if not self.zero1:
+                opt = tree["opt"]
+                tree["opt"] = type(opt)(
+                    opt.count,
+                    logical_layer_order(opt.mu, self.cfg, self.plan),
+                    logical_layer_order(opt.nu, self.cfg, self.plan))
         # the data cursor rides in the manifest via an extra scalar leaf
         # cursor is stored modulo the dataset length (see TokenDataset),
         # so int32 is ample
@@ -92,6 +108,17 @@ class Trainer:
                                     step=step, mesh=self.mesh,
                                     specs=spec_tree)
         self.params, self.opt = tree["params"], tree["opt"]
+        if getattr(self.plan, "vpp", 1) > 1:
+            from hadoop_tpu.parallel.train import physical_layer_order
+            self.params = physical_layer_order(self.params, self.cfg,
+                                               self.plan)
+            if not self.zero1:
+                self.opt = type(self.opt)(
+                    self.opt.count,
+                    physical_layer_order(self.opt.mu, self.cfg,
+                                         self.plan),
+                    physical_layer_order(self.opt.nu, self.cfg,
+                                         self.plan))
         self.data.restore({"pos": int(tree["data_pos"])})
         self.step = got
         log.info("restored step %d from %s", got, self.ckpt_dir)

@@ -174,6 +174,7 @@ def fast_conf(base: Optional[Configuration] = None) -> Configuration:
     conf.set_if_unset("dfs.heartbeat.interval", "0.1s")
     conf.set_if_unset("dfs.namenode.heartbeat.recheck-interval", "0.25s")
     conf.set_if_unset("dfs.namenode.redundancy.interval", "0.2s")
+    conf.set_if_unset("dfs.namenode.reconstruction.pending.timeout", "4s")
     conf.set_if_unset("dfs.blockreport.interval", "5s")
     conf.set_if_unset("dfs.lease.soft-limit", "2s")
     conf.set_if_unset("dfs.lease.hard-limit", "5s")

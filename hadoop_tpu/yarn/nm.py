@@ -217,6 +217,16 @@ class NodeAgent(AbstractService):
         self.rpc.register_protocol("ContainerManagerProtocol",
                                    ContainerManagerProtocol(self))
         self.host = bind_host
+        # ATSv2-style per-app timeline collectors (ref:
+        # PerNodeTimelineCollectorsAuxService): spun up with an app's
+        # first container here, stopped when the RM reports the app
+        # finished (heartbeat response).
+        self.timeline = None
+        if conf.get_bool("yarn.timeline-service.enabled", False):
+            from hadoop_tpu.yarn.timeline import TimelineCollectorManager
+            self.timeline = TimelineCollectorManager(
+                conf.get("yarn.timeline-service.store.dir",
+                         os.path.join(self.work_root, "timeline")))
 
     def service_start(self) -> None:
         for aux in self.aux_services:
@@ -239,6 +249,8 @@ class NodeAgent(AbstractService):
                 aux.stop()
             except Exception:  # noqa: BLE001
                 pass
+        if self.timeline is not None:
+            self.timeline.stop_all()
         if self.rpc:
             self.rpc.stop()
         if self._client:
@@ -260,6 +272,11 @@ class NodeAgent(AbstractService):
             workdir = os.path.join(self.work_root, str(cid))
             rc = _RunningContainer(container, ctx, workdir, chips)
             self.containers[cid] = rc
+        if self.timeline is not None:
+            self.timeline.collector_for(str(cid.app_id)).put_entity(
+                "YARN_CONTAINER", str(cid), "CREATED",
+                node=str(self.node_id) if hasattr(self, "node_id")
+                else "", memory_mb=container.resource.memory_mb)
         Daemon(self._launch, f"launch-{cid}", args=(rc,)).start()
 
     def _take_chips(self, n: int) -> List[int]:
@@ -306,6 +323,15 @@ class NodeAgent(AbstractService):
                 self._chip_pool.extend(rc.chips)
                 self._completed_unreported.append(ContainerStatus(
                     cid, "COMPLETE", rc.exit_code, rc.diagnostics))
+            if self.timeline is not None and \
+                    self.timeline.has_collector(str(cid.app_id)):
+                # Publish only through a LIVE collector — a straggling
+                # container finishing after the app's collector stopped
+                # must not resurrect it (the event is dropped, like the
+                # reference's post-stop puts).
+                self.timeline.collector_for(str(cid.app_id)).put_entity(
+                    "YARN_CONTAINER", str(cid), "FINISHED",
+                    exit_code=rc.exit_code)
 
     def _localize(self, rc: _RunningContainer) -> None:
         """Fetch DFS resources into the work dir.
@@ -392,6 +418,9 @@ class NodeAgent(AbstractService):
                         rc = self.containers.pop(cid, None)
                     if rc is not None and os.path.isdir(rc.workdir):
                         shutil.rmtree(rc.workdir, ignore_errors=True)
+                if self.timeline is not None:
+                    for app_id in resp.get("finished_apps", []):
+                        self.timeline.stop_collector(app_id)
             except Exception as e:  # noqa: BLE001 — survive RM bounces
                 if statuses:
                     with self._lock:  # don't lose exit reports

@@ -314,20 +314,26 @@ class ResourceRequest:
     """AM asks: (priority, count, capability, locality).
     Ref: ResourceRequest.java."""
 
-    __slots__ = ("priority", "num_containers", "capability", "host")
+    __slots__ = ("priority", "num_containers", "capability", "host",
+                 "node_label")
 
     def __init__(self, priority: int, num_containers: int,
-                 capability: Resource, host: str = "*"):
+                 capability: Resource, host: str = "*",
+                 node_label: str = ""):
         self.priority = priority
         self.num_containers = num_containers
         self.capability = capability
         self.host = host
+        # Partition label (ref: ResourceRequest.getNodeLabelExpression):
+        # "" = the default (unlabeled) partition, exclusive semantics.
+        self.node_label = node_label
 
     def to_wire(self) -> Dict:
         return {"p": self.priority, "n": self.num_containers,
-                "c": self.capability.to_wire(), "h": self.host}
+                "c": self.capability.to_wire(), "h": self.host,
+                "l": self.node_label}
 
     @classmethod
     def from_wire(cls, d: Dict) -> "ResourceRequest":
         return cls(d["p"], d["n"], Resource.from_wire(d["c"]),
-                   d.get("h", "*"))
+                   d.get("h", "*"), d.get("l", ""))

@@ -136,6 +136,7 @@ class RMApp:
         if diag:
             self.diagnostics = str(diag)
         self.final_status = state
+        self.rm.note_app_finished(str(self.app_id))
         att = self.current_attempt
         if att is not None:
             self.rm.release_attempt(att)
@@ -300,6 +301,25 @@ class ClientRMProtocol:
         self.rm.dispatcher.dispatch("app", Event("app_kill", app_id))
         return True
 
+    def submit_reservation(self, reservation_id: str, queue: str,
+                           capability_wire: Dict, num_containers: int,
+                           start: float, deadline: float) -> bool:
+        """Ref: ClientRMService.submitReservation → ReservationSystem.
+        Only capacity-scheduler deployments accept reservations."""
+        from hadoop_tpu.yarn.scheduler import Reservation
+        sched = self.rm.scheduler
+        if not hasattr(sched, "submit_reservation"):
+            raise ValueError("scheduler does not support reservations")
+        sched.submit_reservation(Reservation(
+            reservation_id, queue, Resource.from_wire(capability_wire),
+            num_containers, start, deadline))
+        return True
+
+    def delete_reservation(self, reservation_id: str) -> bool:
+        sched = self.rm.scheduler
+        return hasattr(sched, "delete_reservation") and \
+            sched.delete_reservation(reservation_id)
+
     @idempotent
     def get_cluster_metrics(self) -> Dict:
         nodes = self.rm.nodes
@@ -423,8 +443,14 @@ class ResourceTrackerProtocol:
         self.rm.launch_allocated_am_containers()
         cleanup = node.containers_to_cleanup
         node.containers_to_cleanup = []
+        # Finished apps ride the heartbeat so NMs can stop per-app
+        # timeline collectors / app resources (ref: NodeHeartbeatResponse
+        # .getApplicationsToCleanup). An explicit terminal-event ring —
+        # not a scan of rm.apps — so old finishes aren't silently
+        # truncated away and heartbeats stay O(1).
         return {"action": "ok",
-                "cleanup": [c.to_wire() for c in cleanup]}
+                "cleanup": [c.to_wire() for c in cleanup],
+                "finished_apps": self.rm.recent_finished_apps()}
 
 
 class ResourceManager(AbstractService):
@@ -439,6 +465,12 @@ class ResourceManager(AbstractService):
         self._app_seq = 0
         self._seq_lock = threading.Lock()
         self.apps: Dict[ApplicationId, RMApp] = {}
+        # Recent terminal transitions, for NM heartbeat app-cleanup
+        # (ref: the RMNode's finishedApplications tracking). A bounded
+        # ring: old entries age out only after 200 newer finishes, far
+        # past any NM heartbeat gap.
+        from collections import deque
+        self._finished_ring: "deque[str]" = deque(maxlen=200)
         self.attempts: Dict[str, RMAppAttempt] = {}
         self.nodes: Dict[NodeId, RMNode] = {}
         self.nodes_lock = threading.Lock()
@@ -683,6 +715,13 @@ class ResourceManager(AbstractService):
         except Exception as e:  # noqa: BLE001
             log.warning("AM launch for %s failed: %s", attempt.attempt_id, e)
             attempt.fail(f"AM launch failed: {e}")
+
+    def note_app_finished(self, app_id: str) -> None:
+        if app_id not in self._finished_ring:
+            self._finished_ring.append(app_id)
+
+    def recent_finished_apps(self) -> List[str]:
+        return list(self._finished_ring)
 
     def release_attempt(self, attempt: RMAppAttempt) -> None:
         freed = self.scheduler.remove_app(attempt.attempt_id)

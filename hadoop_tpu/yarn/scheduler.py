@@ -31,12 +31,18 @@ log = logging.getLogger(__name__)
 class SchedulerNode:
     """Ref: scheduler/SchedulerNode.java."""
 
-    def __init__(self, node_id: NodeId, total: Resource, nm_address: str):
+    def __init__(self, node_id: NodeId, total: Resource, nm_address: str,
+                 label: str = ""):
         self.node_id = node_id
         self.total = total
         self.available = Resource(total.memory_mb, total.vcores,
                                   total.tpu_chips)
         self.nm_address = nm_address
+        # Partition label, exclusive semantics (ref: the default
+        # exclusive node-label partitions of CommonNodeLabelsManager):
+        # only requests carrying this label land here; "" is the
+        # default partition.
+        self.label = label
         self.containers: Dict[ContainerId, Container] = {}
 
     def allocate(self, container: Container) -> None:
@@ -103,13 +109,23 @@ class _BaseScheduler(Scheduler):
         self.min_alloc = Resource(
             conf.get_int("yarn.scheduler.minimum-allocation-mb", 128),
             1, 0)
+        # host → partition label (ref: yarn.node-labels config +
+        # RMAdminCLI -replaceLabelsOnNode; a conf map keeps the test
+        # surface simple): "yarn.node-labels.map = h1=gpu,h2=gpu"
+        self.node_labels: Dict[str, str] = {}
+        for entry in conf.get_list("yarn.node-labels.map", []):
+            host, _, lab = entry.partition("=")
+            if lab:
+                self.node_labels[host.strip()] = lab.strip()
 
     # ------------------------------------------------------------- nodes
 
     def add_node(self, node_id: NodeId, total: Resource,
-                 nm_address: str) -> None:
+                 nm_address: str, label: str = "") -> None:
         with self.lock:
-            self.nodes[node_id] = SchedulerNode(node_id, total, nm_address)
+            self.nodes[node_id] = SchedulerNode(
+                node_id, total, nm_address,
+                label or self.node_labels.get(node_id.host, ""))
 
     def remove_node(self, node_id: NodeId) -> List[ContainerId]:
         """Node lost: complete its containers as LOST."""
@@ -236,6 +252,11 @@ class _BaseScheduler(Scheduler):
     def _may_assign(self, app: SchedulerApp, capability: Resource) -> bool:
         return True
 
+    def _label_accessible(self, app: SchedulerApp, label: str) -> bool:
+        """May this app's queue use the labeled partition? Base
+        schedulers have no queue ACLs → everything accessible."""
+        return True
+
     def _assign_on_node(self, app: SchedulerApp, node: SchedulerNode,
                         max_assign: int = 0) -> int:
         """Assign up to ``max_assign`` containers (0 = unlimited) from this
@@ -245,6 +266,14 @@ class _BaseScheduler(Scheduler):
             for req in app.pending[priority]:
                 while req.num_containers > 0:
                     if req.host not in ("*", node.node_id.host):
+                        break
+                    # Exclusive partitions (ref: SchedulerNode's
+                    # partition + RegularContainerAllocator's
+                    # precheck): the request's label must equal the
+                    # node's, and the queue must be allowed the label.
+                    if getattr(req, "node_label", "") != node.label:
+                        break
+                    if not self._label_accessible(app, node.label):
                         break
                     if not req.capability.fits_in(node.available):
                         break
@@ -326,10 +355,42 @@ class FifoScheduler(_BaseScheduler):
 
 
 class QueueConfig:
-    def __init__(self, name: str, capacity: float, max_capacity: float = 1.0):
+    def __init__(self, name: str, capacity: float, max_capacity: float = 1.0,
+                 labels: Optional[set] = None):
         self.name = name
         self.capacity = capacity        # guaranteed fraction of the cluster
         self.max_capacity = max_capacity
+        # accessible-node-labels (ref: CapacitySchedulerConfiguration
+        # .getAccessibleNodeLabels); "*" = all partitions
+        self.labels = labels or set()
+
+
+class Reservation:
+    """One admitted reservation (ref: reservation/ReservationDefinition +
+    InMemoryReservationAllocation): ``amount`` of resource held for
+    ``queue`` apps carrying this id during [start, deadline)."""
+
+    __slots__ = ("reservation_id", "queue", "capability", "num_containers",
+                 "start", "deadline")
+
+    def __init__(self, reservation_id: str, queue: str,
+                 capability: Resource, num_containers: int,
+                 start: float, deadline: float):
+        self.reservation_id = reservation_id
+        self.queue = queue
+        self.capability = capability
+        self.num_containers = num_containers
+        self.start = start
+        self.deadline = deadline
+
+    def amount(self) -> Resource:
+        r = Resource()
+        for _ in range(self.num_containers):
+            r = r.add(self.capability)
+        return r
+
+    def active(self, now: float) -> bool:
+        return self.start <= now < self.deadline
 
 
 class CapacityScheduler(_BaseScheduler):
@@ -347,9 +408,15 @@ class CapacityScheduler(_BaseScheduler):
     expresses equivalently for scheduling purposes.)
     """
 
-    def __init__(self, conf: Configuration, container_id_factory):
+    def __init__(self, conf: Configuration, container_id_factory,
+                 now_fn=None):
         super().__init__(conf, container_id_factory)
+        import time as _time
+        self._now = now_fn or _time.time
         self.queues: Dict[str, QueueConfig] = {}
+        self.reservations: Dict[str, Reservation] = {}
+        # app attempt → reservation id (apps inside a reservation)
+        self._app_reservation: Dict[str, str] = {}
         names = conf.get_list("yarn.scheduler.capacity.root.queues",
                               ["default"])
         for name in names:
@@ -359,21 +426,114 @@ class CapacityScheduler(_BaseScheduler):
             mx = conf.get_float(
                 f"yarn.scheduler.capacity.root.{name}.maximum-capacity",
                 100.0) / 100.0
-            self.queues[name] = QueueConfig(name, cap, mx)
+            labels = set(conf.get_list(
+                f"yarn.scheduler.capacity.root.{name}"
+                f".accessible-node-labels", []))
+            self.queues[name] = QueueConfig(name, cap, mx, labels)
 
-    def add_app(self, attempt_id: str, queue: str, user: str) -> None:
+    # ------------------------------------------------------- node labels
+
+    def _label_accessible(self, app: SchedulerApp, label: str) -> bool:
+        if not label:
+            return True  # default partition: everyone
+        labels = self.queues[app.queue].labels
+        return "*" in labels or label in labels
+
+    # ------------------------------------------------------ reservations
+
+    def submit_reservation(self, res: Reservation) -> None:
+        """Admission: concurrently-active reservations must fit in the
+        cluster (ref: planning agents' capacity check — the greedy
+        agent's availability test collapsed to peak-concurrency)."""
+        with self.lock:
+            total = self.cluster_resource()
+            demand = res.amount()
+            for other in self.reservations.values():
+                if other.start < res.deadline and                         res.start < other.deadline:
+                    demand = demand.add(other.amount())
+            if not demand.fits_in(total):
+                raise ValueError(
+                    f"reservation {res.reservation_id} rejected: "
+                    f"{demand!r} exceeds cluster {total!r}")
+            self.reservations[res.reservation_id] = res
+
+    def delete_reservation(self, reservation_id: str) -> bool:
+        with self.lock:
+            return self.reservations.pop(reservation_id, None) is not None
+
+    def add_app(self, attempt_id: str, queue: str, user: str,
+                reservation_id: Optional[str] = None) -> None:
+        """``queue`` may be a reservation id (ref: apps submitted to the
+        reservation's dynamic queue under ReservationSystem)."""
+        if reservation_id is None and queue in self.reservations:
+            reservation_id = queue
+        if reservation_id is not None:
+            res = self.reservations.get(reservation_id)
+            if res is None:
+                raise ValueError(f"unknown reservation {reservation_id!r}")
+            queue = res.queue
         if queue not in self.queues:
             raise ValueError(f"unknown queue {queue!r} "
                              f"(have {sorted(self.queues)})")
-        super().add_app(attempt_id, queue, user)
+        _BaseScheduler.add_app(self, attempt_id, queue, user)
+        if reservation_id is not None:
+            with self.lock:
+                self._app_reservation[attempt_id] = reservation_id
+
+    def remove_app(self, attempt_id: str):
+        with self.lock:
+            self._app_reservation.pop(attempt_id, None)
+        return super().remove_app(attempt_id)
+
+    def _reservation_usage(self, rid: str) -> Resource:
+        used = Resource()
+        for app in self.apps.values():
+            if self._app_reservation.get(app.attempt_id) == rid:
+                used = used.add(app.used)
+        return used
+
+    def _active_reserved_headroom(self) -> Resource:
+        """Unconsumed resource of active reservations — the slice
+        ordinary apps must keep free."""
+        now = self._now()
+        headroom = Resource()
+        for rid, res in self.reservations.items():
+            if not res.active(now):
+                continue
+            remaining = res.amount().subtract(self._reservation_usage(rid))
+            headroom = headroom.add(Resource(
+                max(0, remaining.memory_mb), max(0, remaining.vcores),
+                max(0, remaining.tpu_chips)))
+        return headroom
 
     def _may_assign(self, app: SchedulerApp, capability: Resource) -> bool:
-        """Per-assignment max-capacity enforcement: would this allocation push
-        the queue past its hard cap? Ref: AbstractCSQueue.canAssignToThisQueue."""
+        """Per-assignment enforcement: queue max-capacity, plus the
+        reservation contract — a reserved app allocates against its
+        reservation (bypassing queue caps up to the reserved amount,
+        ref: the dynamic reservation queue's guaranteed capacity);
+        an ordinary app may not eat into active reservations' unused
+        headroom (ref: PlanFollower shrinking the default queue)."""
+        rid = self._app_reservation.get(app.attempt_id)
+        if rid is not None:
+            res = self.reservations.get(rid)
+            if res is not None and res.active(self._now()):
+                used = self._reservation_usage(rid).add(capability)
+                if used.fits_in(res.amount()):
+                    return True  # inside the reserved envelope
         qc = self.queues[app.queue]
         total = self.cluster_resource()
         after = self._queue_usage()[app.queue].add(capability)
-        return after.dominant_share(total) <= qc.max_capacity + 1e-9
+        if after.dominant_share(total) > qc.max_capacity + 1e-9:
+            return False
+        headroom = self._active_reserved_headroom()
+        if not headroom.is_empty():
+            free = Resource()
+            for n in self.nodes.values():
+                free = free.add(n.available)
+            left = free.subtract(capability)
+            if not headroom.fits_in(left):
+                return False
+        return True
 
     def _queue_usage(self) -> Dict[str, Resource]:
         usage: Dict[str, Resource] = {q: Resource() for q in self.queues}
@@ -392,12 +552,23 @@ class CapacityScheduler(_BaseScheduler):
 
         ordered_queues = sorted(self.queues, key=queue_key)
         out: List[SchedulerApp] = []
+        # Active-reservation apps first: their envelope is promised
+        # (ref: reservation queues served before the plan's residual).
+        now = self._now()
+        for app in self.apps.values():
+            rid = self._app_reservation.get(app.attempt_id)
+            if rid is not None:
+                res = self.reservations.get(rid)
+                if res is not None and res.active(now):
+                    out.append(app)
+        seen = {a.attempt_id for a in out}
         for qname in ordered_queues:
             qc = self.queues[qname]
             share = usage[qname].dominant_share(total)
             if share >= qc.max_capacity:
                 continue  # hard cap (ref: maximum-capacity enforcement)
-            out.extend(a for a in self.apps.values() if a.queue == qname)
+            out.extend(a for a in self.apps.values()
+                       if a.queue == qname and a.attempt_id not in seen)
         return out
 
     def _guaranteed_share(self, queue: str) -> float:

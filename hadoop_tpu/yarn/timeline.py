@@ -138,3 +138,99 @@ class ApplicationHistoryServer(AbstractService):
         if app is None:
             raise FileNotFoundError(tail)
         return 200, {"app": app}
+
+
+class AppLevelTimelineCollector:
+    """Per-application collector (ref: ATSv2's
+    hadoop-yarn-server-timelineservice TimelineCollector +
+    AppLevelTimelineCollector): buffers one app's entities NM-side and
+    flushes them to the backing store in batches, with a final flush on
+    stop — the write path AMs/containers publish through in v2 instead
+    of posting to a central daemon."""
+
+    def __init__(self, app_id: str, store: TimelineStore,
+                 flush_every: int = 32):
+        self.app_id = app_id
+        self.store = store
+        self.flush_every = flush_every
+        self._buf: List[Dict] = []
+        self._lock = threading.Lock()
+        self.stopped = False
+
+    def put_entity(self, entity_type: str, entity_id: str, event: str,
+                   **info) -> None:
+        rec = {"type": entity_type, "id": entity_id, "event": event,
+               "ts": time.time(),
+               "info": dict(info, app_id=self.app_id)}
+        with self._lock:
+            if self.stopped:
+                return
+            self._buf.append(rec)
+            if len(self._buf) >= self.flush_every:
+                self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        for rec in self._buf:
+            self.store.put_event(rec["type"], rec["id"], rec["event"],
+                                 **rec["info"])
+        self._buf = []
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def stop(self) -> None:
+        with self._lock:
+            if self.stopped:
+                return
+            self._buf.append({"type": "YARN_APPLICATION",
+                              "id": self.app_id,
+                              "event": "COLLECTOR_STOPPED", "ts":
+                              time.time(), "info": {}})
+            self._flush_locked()
+            self.stopped = True
+
+
+class TimelineCollectorManager:
+    """NM-side collector lifecycle (ref: ATSv2
+    NodeTimelineCollectorManager / PerNodeTimelineCollectorsAuxService):
+    a collector exists per app from its first container's start on this
+    node until the RM reports the app finished."""
+
+    def __init__(self, store_dir: str):
+        self.store = TimelineStore(store_dir)
+        self._collectors: Dict[str, AppLevelTimelineCollector] = {}
+        self._lock = threading.Lock()
+
+    def collector_for(self, app_id: str) -> AppLevelTimelineCollector:
+        with self._lock:
+            c = self._collectors.get(app_id)
+            if c is None or c.stopped:
+                c = AppLevelTimelineCollector(app_id, self.store)
+                self._collectors[app_id] = c
+                c.put_entity("YARN_APPLICATION", app_id,
+                             "COLLECTOR_STARTED")
+            return c
+
+    def has_collector(self, app_id: str) -> bool:
+        with self._lock:
+            c = self._collectors.get(app_id)
+            return c is not None and not c.stopped
+
+    def stop_collector(self, app_id: str) -> None:
+        with self._lock:
+            c = self._collectors.pop(app_id, None)
+        if c is not None:
+            c.stop()
+
+    def active_apps(self) -> List[str]:
+        with self._lock:
+            return sorted(a for a, c in self._collectors.items()
+                          if not c.stopped)
+
+    def stop_all(self) -> None:
+        with self._lock:
+            cs = list(self._collectors.values())
+            self._collectors.clear()
+        for c in cs:
+            c.stop()

@@ -388,3 +388,53 @@ def test_oom_listener_binary(tmp_path):
     finally:
         if proc.poll() is None:
             proc.kill()
+
+
+def test_timeline_v2_per_app_collectors(tmp_path):
+    """ATSv2-style collector lifecycle on the NM: a collector appears
+    with an app's first container, gathers container events, and stops
+    when the RM reports the app finished (ref:
+    PerNodeTimelineCollectorsAuxService + TimelineCollector)."""
+    import time
+
+    from hadoop_tpu.conf import Configuration
+    from hadoop_tpu.examples.distributed_shell import submit
+    from hadoop_tpu.testing.minicluster import MiniYARNCluster
+    from hadoop_tpu.yarn.client import YarnClient
+
+    conf = Configuration(load_defaults=False)
+    conf.set("yarn.timeline-service.enabled", "true")
+    with MiniYARNCluster(num_nodes=1, conf=conf,
+                         base_dir=str(tmp_path)) as cluster:
+        nm = cluster.node_agents[0]
+        assert nm.timeline is not None
+        yc = YarnClient(cluster.rm_addr, Configuration(other=cluster.conf))
+        try:
+            app_id = submit(cluster.rm_addr, ["bash", "-c", "exit 0"],
+                            n=1, conf=Configuration(other=cluster.conf))
+            # collector exists while the app runs or shortly after
+            deadline = time.monotonic() + 30
+            seen_active = False
+            while time.monotonic() < deadline:
+                if nm.timeline.has_collector(str(app_id)):
+                    seen_active = True
+                    break
+                time.sleep(0.1)
+            assert seen_active, "collector never started for the app"
+            yc.wait_for_completion(app_id, timeout=60)
+            # RM heartbeat reports the finished app → collector stops
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if not nm.timeline.has_collector(str(app_id)):
+                    break
+                time.sleep(0.2)
+            assert not nm.timeline.has_collector(str(app_id)), \
+                "collector not stopped after app finished"
+            # the store holds this app's container lifecycle events
+            events = nm.timeline.store.events("YARN_CONTAINER")
+            mine = [e for e in events
+                    if e["info"].get("app_id") == str(app_id)]
+            assert any(e["event"] == "CREATED" for e in mine)
+            assert any(e["event"] == "FINISHED" for e in mine)
+        finally:
+            yc.close()

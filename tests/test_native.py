@@ -100,3 +100,23 @@ def test_datachecksum_verify_uses_available_backend():
     with pytest.raises(crcmod.ChecksumError) as ei:
         cs.verify(bytes(bad), sums)
     assert ei.value.pos == 1024
+
+
+def test_native_io_fadvise_and_sync_range(tmp_path):
+    """NativeIO page-cache hints succeed against a real fd (ref:
+    NativeIO.c posix_fadvise/sync_file_range bindings)."""
+    from hadoop_tpu import native
+    if not native.available():
+        import pytest
+        pytest.skip("native library unavailable")
+    p = tmp_path / "f.bin"
+    with open(p, "wb") as f:
+        f.write(b"z" * 65536)
+        f.flush()
+        assert native.fadvise(f.fileno(), 0, 65536,
+                              native.FADV_SEQUENTIAL)
+        assert native.sync_file_range(f.fileno(), 0, 65536)
+        assert native.sync_file_range(f.fileno(), 0, 65536, wait=True)
+        assert native.fadvise(f.fileno(), 0, 65536, native.FADV_DONTNEED)
+    # bad fd reports failure instead of raising
+    assert not native.fadvise(999999, 0, 1, native.FADV_DONTNEED)

@@ -122,3 +122,67 @@ def test_mount_table_persists(federation, tmp_path):
     router, _, _ = federation
     mt2 = MountTable(os.path.join(router.state_dir, "mounts.json"))
     assert "/warm" in mt2.entries() and "/cold" in mt2.entries()
+
+
+def test_quota_aggregation_across_namespaces(federation, rfs):
+    """Mount quotas aggregate usage from BOTH nameservices: the router's
+    content_summary above the mounts sums ns1+ns2, and a mount-level
+    quota is enforced at the router (ref: RouterQuotaManager +
+    RouterQuotaUpdateService)."""
+    router, ns1, ns2 = federation
+    rfs.mkdirs("/warm/qa")
+    rfs.write_all("/warm/qa/a.bin", b"x" * 10_000)
+    rfs.mkdirs("/cold/qb")
+    rfs.write_all("/cold/qb/b.bin", b"y" * 20_000)
+
+    # aggregated summary above the mounts spans both nameservices
+    cs = rfs.client.nn.content_summary("/")
+    assert cs["length"] >= 30_000
+    assert cs["files"] >= 2
+
+    # namespace quota on /warm: already at/above 2 inodes → next create
+    # through the router is rejected
+    router.set_mount_quota("/warm", nsquota=1)
+    router.refresh_quota_usage()
+    from hadoop_tpu.dfs.protocol.records import QuotaExceededError
+    with pytest.raises((QuotaExceededError, IOError),
+                       match="quota exceeded"):
+        rfs.write_all("/warm/qa/more.bin", b"z")
+    # /cold is unaffected
+    rfs.write_all("/cold/qb/ok.bin", b"ok")
+    # lift the quota; writes resume
+    router.set_mount_quota("/warm", nsquota=-1, ssquota=-1)
+    router.refresh_quota_usage()
+    rfs.write_all("/warm/qa/more.bin", b"z")
+
+
+def test_membership_state_store(federation):
+    """The router heartbeats nameservice membership into its State
+    Store (ref: NamenodeHeartbeatService → MembershipState records)."""
+    import time
+    router, ns1, ns2 = federation
+    deadline = time.monotonic() + 15
+    membership = {}
+    while time.monotonic() < deadline:
+        membership = router.store.load("membership")
+        if {"ns1", "ns2"} <= set(membership):
+            break
+        time.sleep(0.3)
+    assert {"ns1", "ns2"} <= set(membership)
+    assert membership["ns1"]["state"] in ("active", "standby")
+    assert membership["ns2"]["last_seen"] > 0
+
+
+def test_quota_survives_router_restart(federation, tmp_path):
+    """Quotas are State-Store records: a new Router over the same store
+    dir sees them (ref: mount-table records persisting quota)."""
+    router, ns1, ns2 = federation
+    router.set_mount_quota("/cold", ssquota=1 << 40)
+    conf = Configuration(load_defaults=False)
+    conf.set("dfs.federation.ns.ns1", f"127.0.0.1:{ns1.namenode.port}")
+    conf.set("dfs.federation.ns.ns2", f"127.0.0.1:{ns2.namenode.port}")
+    r2 = Router(conf, state_dir=router.state_dir)
+    try:
+        assert r2.quotas.get("/cold", {}).get("ssquota") == 1 << 40
+    finally:
+        router.set_mount_quota("/cold", nsquota=-1, ssquota=-1)

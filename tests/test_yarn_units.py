@@ -233,3 +233,129 @@ def test_capacity_max_capacity_hard_cap():
     s.node_heartbeat(n1)
     a2, _ = s.allocate("application_1_0001_01", [], [])
     assert a2 == []
+
+
+# ------------------------------------------------------------- node labels
+
+def test_node_label_partitions_are_exclusive():
+    """A labeled request only lands on matching nodes; unlabeled
+    requests never land on labeled nodes (ref: exclusive node-label
+    partitions)."""
+    conf = Configuration(load_defaults=False)
+    conf.set("yarn.node-labels.map", "g1=gpu")
+    conf.set("yarn.scheduler.capacity.root.queues", "a")
+    conf.set("yarn.scheduler.capacity.root.a.accessible-node-labels", "gpu")
+    s = CapacityScheduler(conf, _mk_cid)
+    gpu_node = NodeId("g1", 1)
+    cpu_node = NodeId("c1", 1)
+    s.add_node(gpu_node, Resource(8192, 8, 4), "g1:1")
+    s.add_node(cpu_node, Resource(8192, 8, 0), "c1:1")
+    s.add_app("application_1_0001_01", "a", "u")
+    s.allocate("application_1_0001_01", [
+        ResourceRequest(1, 1, Resource(1024, 1), node_label="gpu"),
+        ResourceRequest(2, 1, Resource(1024, 1)),
+    ], [])
+    s.node_heartbeat(cpu_node)
+    s.node_heartbeat(gpu_node)
+    allocated, _ = s.allocate("application_1_0001_01", [], [])
+    assert len(allocated) == 2
+    by_prio = {c.node_id.host for c in allocated}
+    # the labeled ask landed on g1, the unlabeled one on c1
+    placed = sorted((c.node_id.host) for c in allocated)
+    assert placed == ["c1", "g1"]
+
+
+def test_node_label_queue_acl_enforced():
+    """A queue without access to a label never allocates there (ref:
+    accessible-node-labels ACL)."""
+    conf = Configuration(load_defaults=False)
+    conf.set("yarn.node-labels.map", "g1=gpu")
+    conf.set("yarn.scheduler.capacity.root.queues", "a")
+    # queue a has NO accessible-node-labels
+    s = CapacityScheduler(conf, _mk_cid)
+    gpu_node = NodeId("g1", 1)
+    s.add_node(gpu_node, Resource(8192, 8, 4), "g1:1")
+    s.add_app("application_1_0001_01", "a", "u")
+    s.allocate("application_1_0001_01",
+               [ResourceRequest(1, 1, Resource(1024, 1),
+                                node_label="gpu")], [])
+    s.node_heartbeat(gpu_node)
+    allocated, _ = s.allocate("application_1_0001_01", [], [])
+    assert allocated == []
+
+
+# ------------------------------------------------------------ reservations
+
+def _reserved_capacity(now):
+    from hadoop_tpu.yarn.scheduler import Reservation
+    conf = Configuration(load_defaults=False)
+    conf.set("yarn.scheduler.capacity.root.queues", "a,b")
+    conf.set("yarn.scheduler.capacity.root.a.capacity", "50")
+    conf.set("yarn.scheduler.capacity.root.b.capacity", "50")
+    s = CapacityScheduler(conf, _mk_cid, now_fn=lambda: now[0])
+    return s, Reservation
+
+
+def test_reservation_honored_at_allocation():
+    """During its window, a reservation's envelope is held: ordinary
+    apps cannot consume it, the reserved app gets it even past its
+    queue share (ref: ReservationSystem + PlanFollower)."""
+    now = [100.0]
+    s, Reservation = _reserved_capacity(now)
+    n1 = NodeId("h1", 1)
+    s.add_node(n1, Resource(4096, 8, 0), "h1:1")
+    s.submit_reservation(Reservation(
+        "res-1", "a", Resource(1024, 1), 2, start=50.0, deadline=200.0))
+
+    # An ordinary app asks for everything — it must be stopped short of
+    # the reserved 2048 MB.
+    s.add_app("application_1_0001_01", "b", "u")
+    s.allocate("application_1_0001_01",
+               [ResourceRequest(1, 4, Resource(1024, 1))], [])
+    s.node_heartbeat(n1)
+    got, _ = s.allocate("application_1_0001_01", [], [])
+    assert len(got) == 2, f"ordinary app got {len(got)}, reserve violated"
+
+    # The reservation's app claims its envelope.
+    s.add_app("application_1_0002_01", "res-1", "u2")
+    s.allocate("application_1_0002_01",
+               [ResourceRequest(1, 2, Resource(1024, 1))], [])
+    s.node_heartbeat(n1)
+    got2, _ = s.allocate("application_1_0002_01", [], [])
+    assert len(got2) == 2, "reserved app denied its envelope"
+
+
+def test_reservation_expires_and_frees_headroom():
+    now = [100.0]
+    s, Reservation = _reserved_capacity(now)
+    n1 = NodeId("h1", 1)
+    s.add_node(n1, Resource(4096, 8, 0), "h1:1")
+    s.submit_reservation(Reservation(
+        "res-1", "a", Resource(1024, 1), 2, start=50.0, deadline=200.0))
+    s.add_app("application_1_0001_01", "b", "u")
+    s.allocate("application_1_0001_01",
+               [ResourceRequest(1, 4, Resource(1024, 1))], [])
+    s.node_heartbeat(n1)
+    got, _ = s.allocate("application_1_0001_01", [], [])
+    assert len(got) == 2
+    now[0] = 250.0  # window passed
+    s.node_heartbeat(n1)
+    got, _ = s.allocate("application_1_0001_01", [], [])
+    assert len(got) == 2  # the held-back headroom is released
+
+
+def test_reservation_admission_rejects_overcommit():
+    now = [0.0]
+    s, Reservation = _reserved_capacity(now)
+    s.add_node(NodeId("h1", 1), Resource(4096, 8, 0), "h1:1")
+    s.submit_reservation(Reservation(
+        "res-1", "a", Resource(2048, 2), 1, start=0.0, deadline=100.0))
+    with pytest.raises(ValueError, match="rejected"):
+        s.submit_reservation(Reservation(
+            "res-2", "b", Resource(4096, 4), 1, start=50.0,
+            deadline=150.0))
+    # non-overlapping window is fine
+    s.submit_reservation(Reservation(
+        "res-3", "b", Resource(4096, 4), 1, start=100.0, deadline=150.0))
+    assert s.delete_reservation("res-1")
+    assert not s.delete_reservation("res-1")
