@@ -185,7 +185,14 @@ class DatanodeManager:
                 return [DnCommand(DnCommand.REREGISTER)]
             node.last_heartbeat = time.monotonic()
             if node.state == DatanodeInfo.STATE_DEAD:
+                # Back from the dead: node_died() already purged its
+                # replica map, so a silent revival would leave its
+                # blocks location-less until the next periodic report.
+                # Command a re-registration — the DN responds with an
+                # immediate full block report (ref: handleHeartbeat's
+                # unregistered-node path returning DNA_REGISTER).
                 node.state = DatanodeInfo.STATE_LIVE
+                return [DnCommand(DnCommand.REREGISTER)]
             node.capacity = capacity
             node.dfs_used = dfs_used
             node.remaining = remaining

@@ -1,0 +1,351 @@
+/* libhtpufs — C client for the DFS, for non-Python consumers.
+ *
+ * Fills the libhdfs slot (ref: hadoop-hdfs-native-client/src/main/
+ * native/libhdfs/hdfs.h — the C API external systems embed; and
+ * libhdfs's REST-backed sibling, which this follows: rather than
+ * embedding a JVM/interpreter, the client speaks the WebHDFS HTTP
+ * gateway (dfs/webhdfs.py, /webhdfs/v1) over plain sockets, giving any
+ * C/C++/FFI consumer read/write/list/metadata access with zero Python
+ * in-process).
+ *
+ * Deliberately dependency-free: hand-rolled HTTP/1.1 and the minimal
+ * JSON field scanning our own gateway's responses need. Error text is
+ * kept per-connection in the handle (htpufs_last_error).
+ */
+
+#include <arpa/inet.h>
+#include <ctype.h>
+#include <errno.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#define ERRLEN 512
+
+typedef struct htpufs_internal {
+  char host[256];
+  int port;
+  char err[ERRLEN];
+} htpufs_t;
+
+typedef htpufs_t *htpuFS;
+
+static void set_err(htpuFS fs, const char *fmt, const char *detail) {
+  if (!fs) return;
+  snprintf(fs->err, ERRLEN, fmt, detail ? detail : "");
+}
+
+const char *htpufs_last_error(htpuFS fs) { return fs ? fs->err : ""; }
+
+htpuFS htpufs_connect(const char *host, int port) {
+  htpufs_t *fs = calloc(1, sizeof(htpufs_t));
+  if (!fs) return NULL;
+  snprintf(fs->host, sizeof(fs->host), "%s", host);
+  fs->port = port;
+  return fs;
+}
+
+void htpufs_disconnect(htpuFS fs) { free(fs); }
+
+/* ---------------------------------------------------------------- http */
+
+static int dial(htpuFS fs) {
+  struct addrinfo hints, *res = NULL;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  char portbuf[16];
+  snprintf(portbuf, sizeof(portbuf), "%d", fs->port);
+  if (getaddrinfo(fs->host, portbuf, &hints, &res) != 0 || !res) {
+    set_err(fs, "resolve failed: %s", fs->host);
+    return -1;
+  }
+  int sock = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (sock < 0 || connect(sock, res->ai_addr, res->ai_addrlen) != 0) {
+    set_err(fs, "connect failed: %s", strerror(errno));
+    if (sock >= 0) close(sock);
+    freeaddrinfo(res);
+    return -1;
+  }
+  freeaddrinfo(res);
+  int one = 1;
+  setsockopt(sock, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return sock;
+}
+
+static int send_all(int sock, const char *buf, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t w = write(sock, buf + off, n - off);
+    if (w <= 0) return -1;
+    off += (size_t)w;
+  }
+  return 0;
+}
+
+/* One HTTP exchange. Returns status code (or -1), body malloc'd into
+ * *body (caller frees), length into *body_len. */
+static int http_request(htpuFS fs, const char *method, const char *target,
+                        const char *req_body, int64_t req_body_len,
+                        char **body, int64_t *body_len) {
+  *body = NULL;
+  *body_len = 0;
+  int sock = dial(fs);
+  if (sock < 0) return -1;
+
+  char hdr[2048];
+  int n = snprintf(hdr, sizeof(hdr),
+                   "%s %s HTTP/1.1\r\nHost: %s:%d\r\n"
+                   "Content-Length: %lld\r\nConnection: close\r\n\r\n",
+                   method, target, fs->host, fs->port,
+                   (long long)(req_body ? req_body_len : 0));
+  if (n <= 0 || n >= (int)sizeof(hdr)) {
+    set_err(fs, "request too large%s", NULL);
+    close(sock);
+    return -1;
+  }
+  if (send_all(sock, hdr, (size_t)n) != 0 ||
+      (req_body && req_body_len &&
+       send_all(sock, req_body, (size_t)req_body_len) != 0)) {
+    set_err(fs, "send failed: %s", strerror(errno));
+    close(sock);
+    return -1;
+  }
+
+  /* read everything (Connection: close) */
+  size_t cap = 65536, len = 0;
+  char *resp = malloc(cap);
+  if (!resp) {
+    close(sock);
+    return -1;
+  }
+  for (;;) {
+    if (len + 16384 > cap) {
+      cap *= 2;
+      char *nr = realloc(resp, cap);
+      if (!nr) {
+        free(resp);
+        close(sock);
+        return -1;
+      }
+      resp = nr;
+    }
+    ssize_t r = read(sock, resp + len, 16384);
+    if (r < 0) {
+      set_err(fs, "recv failed: %s", strerror(errno));
+      free(resp);
+      close(sock);
+      return -1;
+    }
+    if (r == 0) break;
+    len += (size_t)r;
+  }
+  close(sock);
+
+  int status = -1;
+  if (len > 12 && sscanf(resp, "HTTP/1.%*c %d", &status) != 1) status = -1;
+  char *sep = memmem(resp, len, "\r\n\r\n", 4);
+  if (!sep) {
+    set_err(fs, "malformed response%s", NULL);
+    free(resp);
+    return -1;
+  }
+  size_t hlen = (size_t)(sep + 4 - resp);
+  *body_len = (int64_t)(len - hlen);
+  *body = malloc((size_t)*body_len + 1);
+  if (*body) {
+    memcpy(*body, resp + hlen, (size_t)*body_len);
+    (*body)[*body_len] = '\0';
+  }
+  free(resp);
+  if (status >= 400 && *body)
+    set_err(fs, "server error: %s", *body);
+  return status;
+}
+
+/* percent-encode a path (keep '/') into out */
+static void enc_path(const char *path, char *out, size_t outsz) {
+  static const char *hex = "0123456789ABCDEF";
+  size_t o = 0;
+  for (const unsigned char *p = (const unsigned char *)path;
+       *p && o + 4 < outsz; p++) {
+    if (isalnum(*p) || strchr("/-_.~", *p)) {
+      out[o++] = (char)*p;
+    } else {
+      out[o++] = '%';
+      out[o++] = hex[*p >> 4];
+      out[o++] = hex[*p & 15];
+    }
+  }
+  out[o] = '\0';
+}
+
+/* ----------------------------------------------------- tiny json scans */
+
+/* find "key": and return the number after it, or defval */
+static long long json_ll(const char *body, const char *key, long long defval) {
+  char pat[128];
+  snprintf(pat, sizeof(pat), "\"%s\"", key);
+  const char *p = strstr(body, pat);
+  if (!p) return defval;
+  p = strchr(p + strlen(pat), ':');
+  if (!p) return defval;
+  return strtoll(p + 1, NULL, 10);
+}
+
+/* ------------------------------------------------------------ file ops */
+
+int htpufs_exists(htpuFS fs, const char *path) {
+  char ep[1024], target[1200];
+  enc_path(path, ep, sizeof(ep));
+  snprintf(target, sizeof(target), "/webhdfs/v1%s?op=GETFILESTATUS", ep);
+  char *body;
+  int64_t blen;
+  int st = http_request(fs, "GET", target, NULL, 0, &body, &blen);
+  free(body);
+  if (st == 200) return 1;
+  if (st == 404) return 0;
+  return -1;
+}
+
+int64_t htpufs_get_file_size(htpuFS fs, const char *path) {
+  char ep[1024], target[1200];
+  enc_path(path, ep, sizeof(ep));
+  snprintf(target, sizeof(target), "/webhdfs/v1%s?op=GETFILESTATUS", ep);
+  char *body;
+  int64_t blen;
+  int st = http_request(fs, "GET", target, NULL, 0, &body, &blen);
+  if (st != 200 || !body) {
+    free(body);
+    return -1;
+  }
+  long long n = json_ll(body, "length", -1);
+  free(body);
+  return (int64_t)n;
+}
+
+int htpufs_mkdirs(htpuFS fs, const char *path) {
+  char ep[1024], target[1200];
+  enc_path(path, ep, sizeof(ep));
+  snprintf(target, sizeof(target), "/webhdfs/v1%s?op=MKDIRS", ep);
+  char *body;
+  int64_t blen;
+  int st = http_request(fs, "PUT", target, NULL, 0, &body, &blen);
+  free(body);
+  return st == 200 ? 0 : -1;
+}
+
+int htpufs_delete(htpuFS fs, const char *path, int recursive) {
+  char ep[1024], target[1200];
+  enc_path(path, ep, sizeof(ep));
+  snprintf(target, sizeof(target),
+           "/webhdfs/v1%s?op=DELETE&recursive=%s", ep,
+           recursive ? "true" : "false");
+  char *body;
+  int64_t blen;
+  int st = http_request(fs, "DELETE", target, NULL, 0, &body, &blen);
+  free(body);
+  return st == 200 ? 0 : -1;
+}
+
+int htpufs_rename(htpuFS fs, const char *src, const char *dst) {
+  char es[1024], ed[1024], target[2400];
+  enc_path(src, es, sizeof(es));
+  enc_path(dst, ed, sizeof(ed));
+  snprintf(target, sizeof(target),
+           "/webhdfs/v1%s?op=RENAME&destination=%s", es, ed);
+  char *body;
+  int64_t blen;
+  int st = http_request(fs, "PUT", target, NULL, 0, &body, &blen);
+  int ok = st == 200 && body && strstr(body, "true") != NULL;
+  free(body);
+  return ok ? 0 : -1;
+}
+
+/* Read [offset, offset+len) into buf; returns bytes read or -1. */
+int64_t htpufs_pread(htpuFS fs, const char *path, int64_t offset,
+                     char *buf, int64_t len) {
+  char ep[1024], target[1400];
+  enc_path(path, ep, sizeof(ep));
+  snprintf(target, sizeof(target),
+           "/webhdfs/v1%s?op=OPEN&offset=%lld&length=%lld", ep,
+           (long long)offset, (long long)len);
+  char *body;
+  int64_t blen;
+  int st = http_request(fs, "GET", target, NULL, 0, &body, &blen);
+  if (st != 200 || !body) {
+    free(body);
+    return -1;
+  }
+  int64_t n = blen < len ? blen : len;
+  memcpy(buf, body, (size_t)n);
+  free(body);
+  return n;
+}
+
+/* Whole-file write (the gateway streams it into a replicated DFS file). */
+int htpufs_write_file(htpuFS fs, const char *path, const char *data,
+                      int64_t len, int overwrite) {
+  char ep[1024], target[1300];
+  enc_path(path, ep, sizeof(ep));
+  snprintf(target, sizeof(target),
+           "/webhdfs/v1%s?op=CREATE&overwrite=%s", ep,
+           overwrite ? "true" : "false");
+  char *body;
+  int64_t blen;
+  int st = http_request(fs, "PUT", target, data, len, &body, &blen);
+  free(body);
+  return (st == 200 || st == 201) ? 0 : -1;
+}
+
+/* List a directory: returns a malloc'd array of malloc'd names
+ * ("pathSuffix" values); caller frees via htpufs_free_listing. */
+int htpufs_list(htpuFS fs, const char *path, char ***names_out,
+                int *n_out) {
+  *names_out = NULL;
+  *n_out = 0;
+  char ep[1024], target[1200];
+  enc_path(path, ep, sizeof(ep));
+  snprintf(target, sizeof(target), "/webhdfs/v1%s?op=LISTSTATUS", ep);
+  char *body;
+  int64_t blen;
+  int st = http_request(fs, "GET", target, NULL, 0, &body, &blen);
+  if (st != 200 || !body) {
+    free(body);
+    return -1;
+  }
+  int cap = 16, n = 0;
+  char **names = malloc(sizeof(char *) * cap);
+  const char *p = body;
+  while ((p = strstr(p, "\"pathSuffix\"")) != NULL) {
+    p = strchr(p, ':');
+    if (!p) break;
+    p = strchr(p, '"');
+    if (!p) break;
+    p++;
+    const char *end = strchr(p, '"');
+    if (!end) break;
+    if (n == cap) {
+      cap *= 2;
+      names = realloc(names, sizeof(char *) * cap);
+    }
+    names[n] = strndup(p, (size_t)(end - p));
+    n++;
+    p = end + 1;
+  }
+  free(body);
+  *names_out = names;
+  *n_out = n;
+  return 0;
+}
+
+void htpufs_free_listing(char **names, int n) {
+  for (int i = 0; i < n; i++) free(names[i]);
+  free(names);
+}
