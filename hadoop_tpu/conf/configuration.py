@@ -21,6 +21,7 @@ import os
 import re
 import threading
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+from hadoop_tpu.util.annotations import audience, stability
 
 log = logging.getLogger(__name__)
 
@@ -90,6 +91,8 @@ class ConfigRegistry:
             cls._deprecations = {}
 
 
+@audience.public
+@stability.stable
 class Configuration:
     """Layered key/value store with typed access and variable expansion."""
 

@@ -18,6 +18,7 @@ from typing import Dict, Iterator, List, Optional, Tuple, Type
 from urllib.parse import urlparse
 
 from hadoop_tpu.conf import Configuration
+from hadoop_tpu.util.annotations import audience, stability
 from hadoop_tpu.dfs.protocol.records import FileStatus
 
 
@@ -53,6 +54,8 @@ def register_filesystem(scheme: str, cls: Type["FileSystem"]) -> None:
     _registry[scheme] = cls
 
 
+@audience.public
+@stability.stable
 class FileSystem:
     """Abstract filesystem. Ref: fs/FileSystem.java (abstract open at :950,
     create at :1197)."""

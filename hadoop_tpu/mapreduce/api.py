@@ -19,6 +19,7 @@ from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
 
 from hadoop_tpu.fs import FileSystem
 from hadoop_tpu.fs.filesystem import Path
+from hadoop_tpu.util.annotations import audience, stability
 
 
 def class_ref(cls) -> str:
@@ -135,6 +136,8 @@ class TaskContext:
         self.counters.incr((group, name), amount)
 
 
+@audience.public
+@stability.stable
 class Mapper:
     """Ref: mapreduce/Mapper.java — setup/map/cleanup template.
 

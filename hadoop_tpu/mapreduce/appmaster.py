@@ -65,6 +65,7 @@ class _Task:
         self.speculate_pending = False
         self.commit_attempt: Optional[str] = None
         self.finished_at = 0.0
+        self.duration_ms = 0  # succeeding attempt's runtime (for rumen)
 
     def running_attempts(self) -> List[_Attempt]:
         return [a for a in self.attempts.values()
@@ -131,6 +132,8 @@ class TaskUmbilicalProtocol:
             if first_success:
                 task.succeeded = True
                 task.finished_at = time.monotonic()
+                task.duration_ms = int(
+                    (task.finished_at - attempt.started) * 1000)
                 self.am.counters.merge(counters_wire)
                 if task.type == "map":
                     self.am.map_events.append(
@@ -572,6 +575,7 @@ class MRAppMaster:
             self.history.event(jh.TASK_FINISHED, task_id=task.id,
                                task_type=task.type,
                                shuffle_addr=shuffle_addr,
+                               duration_ms=task.duration_ms,
                                counters=counters_wire)
             self.history.flush()
         except Exception as e:  # noqa: BLE001 — history must not kill tasks

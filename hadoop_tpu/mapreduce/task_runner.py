@@ -142,6 +142,9 @@ def run_map(job: Dict, task: Dict, umbilical, attempt_id: str,
         ctx = TaskContext(conf, counters, collector.collect,
                           task["task_id"],
                           emit_batch=collector.collect_batch)
+    # Input split visible to user code (ref: MapContext.getInputSplit —
+    # datajoin's source tagging keys off it).
+    ctx.split = split
     mapper.setup(ctx)
     # Batch plane: when the input format can hand packed batches and the
     # mapper is batch-capable (explicit map_batch, or the un-overridden

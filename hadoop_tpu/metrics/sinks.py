@@ -123,3 +123,52 @@ class SinkPublisher:
     def _run(self) -> None:
         while not self._stop.wait(self.period_s):
             self.publish_once()
+
+
+class StreamSink(Sink):
+    """NDJSON metric records over a TCP stream — the Kafka-sink slot
+    (ref: hadoop-tools/hadoop-kafka KafkaSink.java publishes each
+    metrics record as JSON to a topic; with no broker in this stack,
+    the same JSON records flow to any stream consumer: a collector
+    socket, netcat, or a real broker's TCP ingest). Best-effort like
+    the reference's async producer, but it RECONNECTS: one collector
+    restart must not silently kill export for the process lifetime.
+    Whole snapshots are dropped on failure (never a half-written line —
+    the next connection starts on a record boundary)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 9999,
+                 topic: str = "hadoop-metrics"):
+        self.topic = topic
+        self._addr = (host, port)
+        self._sock = socket.create_connection(self._addr, timeout=5.0)
+
+    def put_snapshot(self, ts: float, snapshot: Dict[str, Dict]) -> None:
+        lines = []
+        for source, metrics in sorted(snapshot.items()):
+            lines.append(json.dumps({
+                "topic": self.topic, "timestamp": int(ts * 1000),
+                "source": source, "metrics": metrics}))
+        payload = ("\n".join(lines) + "\n").encode()
+        for attempt in (0, 1):
+            try:
+                if self._sock is None:
+                    self._sock = socket.create_connection(self._addr,
+                                                          timeout=5.0)
+                self._sock.sendall(payload)
+                return
+            except OSError:
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                self._sock = None
+                if attempt:
+                    return  # drop this snapshot; retry next interval
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass

@@ -126,7 +126,7 @@ static int http_request(htpuFS fs, const char *method, const char *target,
     return -1;
   }
   for (;;) {
-    if (len + 16384 > cap) {
+    if (len + 16385 > cap) { /* +1: NUL after last read */
       cap *= 2;
       char *nr = realloc(resp, cap);
       if (!nr) {
@@ -147,6 +147,7 @@ static int http_request(htpuFS fs, const char *method, const char *target,
     len += (size_t)r;
   }
   close(sock);
+  resp[len] = '\0'; /* headroom guaranteed by the len+16384 growth check */
 
   int status = -1;
   if (len > 12 && sscanf(resp, "HTTP/1.%*c %d", &status) != 1) status = -1;
@@ -169,12 +170,14 @@ static int http_request(htpuFS fs, const char *method, const char *target,
   return status;
 }
 
-/* percent-encode a path (keep '/') into out */
-static void enc_path(const char *path, char *out, size_t outsz) {
+/* percent-encode a path (keep '/') into out; -1 if it would truncate
+ * (a truncated path would address a DIFFERENT file — never proceed) */
+static int enc_path(const char *path, char *out, size_t outsz) {
   static const char *hex = "0123456789ABCDEF";
   size_t o = 0;
-  for (const unsigned char *p = (const unsigned char *)path;
-       *p && o + 4 < outsz; p++) {
+  const unsigned char *p = (const unsigned char *)path;
+  for (; *p; p++) {
+    if (o + 4 >= outsz) return -1;
     if (isalnum(*p) || strchr("/-_.~", *p)) {
       out[o++] = (char)*p;
     } else {
@@ -184,6 +187,7 @@ static void enc_path(const char *path, char *out, size_t outsz) {
     }
   }
   out[o] = '\0';
+  return 0;
 }
 
 /* ----------------------------------------------------- tiny json scans */
@@ -203,7 +207,10 @@ static long long json_ll(const char *body, const char *key, long long defval) {
 
 int htpufs_exists(htpuFS fs, const char *path) {
   char ep[1024], target[1200];
-  enc_path(path, ep, sizeof(ep));
+  if (enc_path(path, ep, sizeof(ep)) != 0) {
+    set_err(fs, "path too long%s", NULL);
+    return -1;
+  }
   snprintf(target, sizeof(target), "/webhdfs/v1%s?op=GETFILESTATUS", ep);
   char *body;
   int64_t blen;
@@ -216,7 +223,10 @@ int htpufs_exists(htpuFS fs, const char *path) {
 
 int64_t htpufs_get_file_size(htpuFS fs, const char *path) {
   char ep[1024], target[1200];
-  enc_path(path, ep, sizeof(ep));
+  if (enc_path(path, ep, sizeof(ep)) != 0) {
+    set_err(fs, "path too long%s", NULL);
+    return -1;
+  }
   snprintf(target, sizeof(target), "/webhdfs/v1%s?op=GETFILESTATUS", ep);
   char *body;
   int64_t blen;
@@ -232,32 +242,46 @@ int64_t htpufs_get_file_size(htpuFS fs, const char *path) {
 
 int htpufs_mkdirs(htpuFS fs, const char *path) {
   char ep[1024], target[1200];
-  enc_path(path, ep, sizeof(ep));
+  if (enc_path(path, ep, sizeof(ep)) != 0) {
+    set_err(fs, "path too long%s", NULL);
+    return -1;
+  }
   snprintf(target, sizeof(target), "/webhdfs/v1%s?op=MKDIRS", ep);
   char *body;
   int64_t blen;
   int st = http_request(fs, "PUT", target, NULL, 0, &body, &blen);
+  int ok = st == 200 && body && strstr(body, "true") != NULL;
   free(body);
-  return st == 200 ? 0 : -1;
+  return ok ? 0 : -1;
 }
 
 int htpufs_delete(htpuFS fs, const char *path, int recursive) {
   char ep[1024], target[1200];
-  enc_path(path, ep, sizeof(ep));
+  if (enc_path(path, ep, sizeof(ep)) != 0) {
+    set_err(fs, "path too long%s", NULL);
+    return -1;
+  }
   snprintf(target, sizeof(target),
            "/webhdfs/v1%s?op=DELETE&recursive=%s", ep,
            recursive ? "true" : "false");
   char *body;
   int64_t blen;
   int st = http_request(fs, "DELETE", target, NULL, 0, &body, &blen);
+  int ok = st == 200 && body && strstr(body, "true") != NULL;
   free(body);
-  return st == 200 ? 0 : -1;
+  return ok ? 0 : -1;
 }
 
 int htpufs_rename(htpuFS fs, const char *src, const char *dst) {
   char es[1024], ed[1024], target[2400];
-  enc_path(src, es, sizeof(es));
-  enc_path(dst, ed, sizeof(ed));
+  if (enc_path(src, es, sizeof(es)) != 0) {
+    set_err(fs, "path too long%s", NULL);
+    return -1;
+  }
+  if (enc_path(dst, ed, sizeof(ed)) != 0) {
+    set_err(fs, "path too long%s", NULL);
+    return -1;
+  }
   snprintf(target, sizeof(target),
            "/webhdfs/v1%s?op=RENAME&destination=%s", es, ed);
   char *body;
@@ -272,7 +296,10 @@ int htpufs_rename(htpuFS fs, const char *src, const char *dst) {
 int64_t htpufs_pread(htpuFS fs, const char *path, int64_t offset,
                      char *buf, int64_t len) {
   char ep[1024], target[1400];
-  enc_path(path, ep, sizeof(ep));
+  if (enc_path(path, ep, sizeof(ep)) != 0) {
+    set_err(fs, "path too long%s", NULL);
+    return -1;
+  }
   snprintf(target, sizeof(target),
            "/webhdfs/v1%s?op=OPEN&offset=%lld&length=%lld", ep,
            (long long)offset, (long long)len);
@@ -293,7 +320,10 @@ int64_t htpufs_pread(htpuFS fs, const char *path, int64_t offset,
 int htpufs_write_file(htpuFS fs, const char *path, const char *data,
                       int64_t len, int overwrite) {
   char ep[1024], target[1300];
-  enc_path(path, ep, sizeof(ep));
+  if (enc_path(path, ep, sizeof(ep)) != 0) {
+    set_err(fs, "path too long%s", NULL);
+    return -1;
+  }
   snprintf(target, sizeof(target),
            "/webhdfs/v1%s?op=CREATE&overwrite=%s", ep,
            overwrite ? "true" : "false");
@@ -311,7 +341,10 @@ int htpufs_list(htpuFS fs, const char *path, char ***names_out,
   *names_out = NULL;
   *n_out = 0;
   char ep[1024], target[1200];
-  enc_path(path, ep, sizeof(ep));
+  if (enc_path(path, ep, sizeof(ep)) != 0) {
+    set_err(fs, "path too long%s", NULL);
+    return -1;
+  }
   snprintf(target, sizeof(target), "/webhdfs/v1%s?op=LISTSTATUS", ep);
   char *body;
   int64_t blen;

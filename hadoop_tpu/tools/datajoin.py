@@ -1,0 +1,70 @@
+"""DataJoin — reduce-side join library for MR jobs.
+
+Parity with the reference contrib (ref: hadoop-tools/hadoop-datajoin —
+DataJoinMapperBase tags each record with its source, DataJoinReducerBase
+groups by join key and crosses the per-source groups; TaggedMapOutput
+carries the tag): records from N inputs meet in the reducer keyed by
+the join key; the reducer emits the combination of every source's
+rows for that key.
+
+Usage: subclass ``JoinMapper`` per source (or configure
+``datajoin.tag.<basename>`` mappings), run with ``JoinReducer``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterator, List
+
+from hadoop_tpu.mapreduce.api import Mapper, Reducer, TaskContext
+
+TAG_SEP = b"\x01"
+
+
+class JoinMapper(Mapper):
+    """Tag + key extraction (ref: DataJoinMapperBase.map → generate
+    TaggedMapOutput + generateGroupKey). Default record shape: TSV with
+    the join key in column 0; the tag is the input file's basename
+    (override ``tag_of``/``join_key`` for other shapes)."""
+
+    def setup(self, ctx: TaskContext) -> None:
+        self._tag = self.tag_of(ctx)
+
+    def tag_of(self, ctx: TaskContext) -> bytes:
+        path = getattr(getattr(ctx, "split", None), "path", "") or \
+            ctx.conf.get("datajoin.tag", "src")
+        return path.rsplit("/", 1)[-1].encode()
+
+    def join_key(self, key: bytes, value: bytes) -> bytes:
+        return value.split(b"\t", 1)[0]
+
+    def map(self, key: bytes, value: bytes, ctx: TaskContext) -> None:
+        if not value.strip():
+            return
+        ctx.emit(self.join_key(key, value), self._tag + TAG_SEP + value)
+
+
+class JoinReducer(Reducer):
+    """Cross the per-tag groups (ref: DataJoinReducerBase.joinAndCollect
+    — the default inner join over every source combination)."""
+
+    def combine(self, key: bytes, rows: List[bytes]) -> bytes:
+        """One joined output row; override for custom shapes."""
+        return b"\t".join(rows)
+
+    def reduce(self, key: bytes, values: Iterator[bytes],
+               ctx: TaskContext) -> None:
+        by_tag: dict = {}
+        for v in values:
+            tag, _, row = v.partition(TAG_SEP)
+            by_tag.setdefault(tag, []).append(row)
+        if len(by_tag) < 2:
+            return  # inner join: key must appear in 2+ sources
+        tags = sorted(by_tag)
+        # cross product across sources (ref: joinAndCollect's recursion)
+        combos: List[List[bytes]] = [[]]
+        for t in tags:
+            combos = [c + [row] for c in combos for row in by_tag[t]]
+        for c in combos:
+            ctx.emit(key, self.combine(key, c))
+            ctx.incr_counter("DataJoin", "JOINED")

@@ -75,7 +75,13 @@ def run_trace(rm_addr, default_fs: str, trace: List[Dict], *,
                    .set(SleepInputFormat.NUM_MAPS_KEY,
                         str(max(1, min(int(entry.get("containers", 1)),
                                        64))))
-                   .set("gridmix.sleep.ms", str(sleep_ms)))
+                   # Trace fidelity: a rumen trace carries the source
+                   # job's measured task runtime; replay each task for
+                   # that long (ref: gridmix's SleepJob using
+                   # LoggedTask runtimes). Fixed sleep_ms otherwise.
+                   .set("gridmix.sleep.ms", str(
+                       entry.get("task_ms", {}).get("mean")
+                       or sleep_ms)))
             job.submit()
             inflight.append({"job": job, "start": time.perf_counter()})
             idx += 1

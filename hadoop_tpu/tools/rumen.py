@@ -34,19 +34,30 @@ def build_trace(fs: FileSystem,
         return jobs
     for i, path in enumerate(entries):
         job_id = path.rstrip("/").rsplit("/", 1)[-1]
-        tasks = [e for e in history.read_events(fs, path)
-                 if e["type"] == history.TASK_FINISHED]
-        finished = [e for e in history.read_events(fs, path)
+        events = list(history.read_events(fs, path))
+        tasks = [e for e in events if e["type"] == history.TASK_FINISHED]
+        finished = [e for e in events
                     if e["type"] == history.JOB_FINISHED]
         if not tasks:
             continue
+        # Per-task runtime distribution — the trace fidelity rumen
+        # exists for (ref: LoggedTask attempt runtimes feeding
+        # gridmix's task models).
+        durations = sorted(e.get("duration_ms", 0) for e in tasks)
+        mean_ms = sum(durations) // len(durations)
         jobs.append({
             "app": f"application_1_{i + 1}_01",
             "job_id": job_id,
             "arrival": i,  # completion order; SLS spreads by this key
             "queue": "default",
             "containers": len(tasks),
+            "maps": sum(1 for e in tasks if e.get("task_type") == "map"),
+            "reduces": sum(1 for e in tasks
+                           if e.get("task_type") == "reduce"),
             "mb": container_mb,
+            "task_ms": {"mean": mean_ms,
+                        "p50": durations[len(durations) // 2],
+                        "max": durations[-1]},
             "state": finished[0]["state"] if finished else "UNKNOWN",
         })
     return jobs

@@ -1,0 +1,80 @@
+"""API-stability classification decorators.
+
+Parity with hadoop-annotations (ref: hadoop-common-project/
+hadoop-annotations/src/main/java/org/apache/hadoop/classification/
+InterfaceAudience.java + InterfaceStability.java — every public Hadoop
+class declares who may depend on it and how much it may change between
+releases; docs and compat checkers key off the annotations).
+
+Python rendition: decorators that stamp ``_api_audience`` /
+``_api_stability`` on the object and record it in a registry, so a
+compat report (``api_report()``) can enumerate the public surface —
+the role the reference's annotation processor plays at build time.
+
+    from hadoop_tpu.util.annotations import audience, stability
+
+    @audience.public
+    @stability.stable
+    class FileSystem: ...
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+_REGISTRY: Dict[str, Tuple[str, str]] = {}
+
+
+def _qualname(obj) -> str:
+    mod = getattr(obj, "__module__", "?")
+    return f"{mod}.{getattr(obj, '__qualname__', repr(obj))}"
+
+
+def _stamp(obj, key: str, value: str):
+    setattr(obj, f"_api_{key}", value)
+    name = _qualname(obj)
+    aud, stab = _REGISTRY.get(name, ("", ""))
+    _REGISTRY[name] = (value, stab) if key == "audience" else (aud, value)
+    return obj
+
+
+class audience:
+    """Who may depend on this API (ref: InterfaceAudience)."""
+
+    @staticmethod
+    def public(obj):
+        return _stamp(obj, "audience", "Public")
+
+    @staticmethod
+    def limited_private(*projects: str):
+        def deco(obj):
+            return _stamp(obj, "audience",
+                          f"LimitedPrivate({','.join(projects)})")
+        return deco
+
+    @staticmethod
+    def private(obj):
+        return _stamp(obj, "audience", "Private")
+
+
+class stability:
+    """How much this API may change (ref: InterfaceStability)."""
+
+    @staticmethod
+    def stable(obj):
+        return _stamp(obj, "stability", "Stable")
+
+    @staticmethod
+    def evolving(obj):
+        return _stamp(obj, "stability", "Evolving")
+
+    @staticmethod
+    def unstable(obj):
+        return _stamp(obj, "stability", "Unstable")
+
+
+def api_report() -> List[Dict[str, str]]:
+    """The annotated public surface, for compat tooling/docs."""
+    return [{"name": name, "audience": aud or "Private",
+             "stability": stab or "Unstable"}
+            for name, (aud, stab) in sorted(_REGISTRY.items())]

@@ -1,0 +1,183 @@
+"""Misc tools: resource estimator, datajoin, fedbalance, stream sink,
+API annotations.
+
+Mirrors the reference's smaller tool modules (ref:
+hadoop-resourceestimator TestLpSolver; hadoop-datajoin TestDataJoin —
+a real MR join job; hadoop-federation-balance TestFedBalance — a real
+mount move; hadoop-kafka TestKafkaMetrics; hadoop-annotations).
+"""
+
+import json
+import socket
+import threading
+
+import pytest
+
+from hadoop_tpu.conf import Configuration
+
+
+def test_resource_estimator_sizes_reservation():
+    from hadoop_tpu.tools.resourceestimator import (estimate,
+                                                    make_reservation)
+    runs = [{"containers": c, "mb": 1024,
+             "task_ms": {"mean": 40_000, "max": 60_000 + i * 1000}}
+            for i, c in enumerate([8, 10, 9, 12, 8])]
+    est = estimate(runs)
+    assert est["containers"] >= 12          # p90 with headroom
+    assert est["mb"] >= 1024
+    assert est["duration_ms"] >= 60_000
+    res = make_reservation("nightly", est, start=1000.0)
+    assert res.num_containers == est["containers"]
+    assert res.deadline > res.start
+    with pytest.raises(ValueError):
+        estimate([])
+
+
+def test_resource_estimate_admits_into_scheduler():
+    """The estimator's output is directly admissible by the capacity
+    scheduler's ReservationSystem (the reference's end-to-end story)."""
+    from hadoop_tpu.tools.resourceestimator import (estimate,
+                                                    make_reservation)
+    from hadoop_tpu.yarn.records import (ApplicationId, ContainerId,
+                                         NodeId, Resource)
+    from hadoop_tpu.yarn.scheduler import CapacityScheduler
+
+    def cid(attempt_id, seq):
+        parts = attempt_id.rsplit("_", 1)
+        return ContainerId(ApplicationId.parse(parts[0]), int(parts[1]),
+                           seq)
+
+    conf = Configuration(load_defaults=False)
+    conf.set("yarn.scheduler.capacity.root.queues", "default")
+    sched = CapacityScheduler(conf, cid, now_fn=lambda: 0.0)
+    sched.add_node(NodeId("h1", 1), Resource(65536, 64), "h1:1")
+    est = estimate([{"containers": 4, "mb": 1024,
+                     "task_ms": {"mean": 30_000}}])
+    sched.submit_reservation(
+        make_reservation("etl", est, start=0.0, deadline=100.0))
+    assert "etl" in sched.reservations
+
+
+def test_datajoin_mr_job(tmp_path):
+    """Reduce-side join over two real inputs on a live MR cluster
+    (ref: TestDataJoin)."""
+    from hadoop_tpu.mapreduce import Job
+    from hadoop_tpu.mapreduce.api import class_ref
+    from hadoop_tpu.testing.minicluster import MiniMRYarnCluster
+    from hadoop_tpu.tools.datajoin import JoinMapper, JoinReducer
+
+    with MiniMRYarnCluster(num_nodes=1,
+                           base_dir=str(tmp_path)) as cluster:
+        fs = cluster.get_filesystem()
+        fs.write_all("/join/users.tsv",
+                     b"u1\talice\nu2\tbob\nu3\tcarol\n")
+        fs.write_all("/join/orders.tsv",
+                     b"u1\tbook\nu1\tpen\nu3\tlamp\nu9\tghost\n")
+        job = (Job(cluster.rm_addr, cluster.default_fs, name="datajoin")
+               .set_mapper(class_ref(JoinMapper))
+               .set_reducer(class_ref(JoinReducer))
+               .add_input_path("/join/users.tsv")
+               .add_input_path("/join/orders.tsv")
+               .set_output_path("/join-out")
+               .set_num_reduces(1))
+        assert job.wait_for_completion()
+        out = b"".join(fs.read_all(p) for p in fs.glob("/join-out/part-*"))
+        # u1 joins twice (two orders), u3 once, u2/u9 unmatched
+        assert out.count(b"alice") == 2
+        assert out.count(b"carol") == 1
+        assert b"bob" not in out and b"ghost" not in out
+
+
+def test_stream_sink_emits_ndjson_records():
+    from hadoop_tpu.metrics.sinks import StreamSink
+    received = []
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+
+    def consumer():
+        conn, _ = srv.accept()
+        buf = b""
+        while b"\n" not in buf:
+            chunk = conn.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+        received.append(buf)
+        conn.close()
+
+    t = threading.Thread(target=consumer, daemon=True)
+    t.start()
+    sink = StreamSink("127.0.0.1", srv.getsockname()[1], topic="tm")
+    sink.put_snapshot(123.0, {"rpc.test": {"calls": 7}})
+    t.join(timeout=5.0)
+    sink.close()
+    srv.close()
+    rec = json.loads(received[0].splitlines()[0])
+    assert rec["topic"] == "tm"
+    assert rec["source"] == "rpc.test"
+    assert rec["metrics"]["calls"] == 7
+
+
+def test_api_annotations_registry():
+    import hadoop_tpu.fs.filesystem  # noqa: F401 — registers annotations
+    from hadoop_tpu.fs.filesystem import FileSystem
+    from hadoop_tpu.util.annotations import api_report
+    assert FileSystem._api_audience == "Public"
+    assert FileSystem._api_stability == "Stable"
+    rep = {r["name"]: r for r in api_report()}
+    assert rep["hadoop_tpu.fs.filesystem.FileSystem"]["audience"] == \
+        "Public"
+
+
+def test_fedbalance_moves_mount_between_nameservices(tmp_path):
+    """FedBalance: distcp the subtree, repoint the mount, retire the
+    source (ref: hadoop-federation-balance's three procedures)."""
+    from hadoop_tpu.dfs.client.filesystem import DistributedFileSystem
+    from hadoop_tpu.dfs.router import Router
+    from hadoop_tpu.testing.minicluster import (MiniDFSCluster,
+                                                MiniMRYarnCluster,
+                                                fast_conf)
+    from hadoop_tpu.tools.fedbalance import fedbalance
+
+    dconf = fast_conf()
+    dconf.set("dfs.replication", "1")
+    with MiniDFSCluster(num_datanodes=1, conf=dconf,
+                        base_dir=str(tmp_path / "ns1")) as ns1, \
+            MiniDFSCluster(num_datanodes=1, conf=dconf,
+                           base_dir=str(tmp_path / "ns2")) as ns2, \
+            MiniMRYarnCluster(num_nodes=1,
+                              base_dir=str(tmp_path / "mr")) as mr:
+        ns1.wait_active()
+        ns2.wait_active()
+        rconf = Configuration(load_defaults=False)
+        rconf.set("dfs.federation.ns.ns1",
+                  f"127.0.0.1:{ns1.namenode.port}")
+        rconf.set("dfs.federation.ns.ns2",
+                  f"127.0.0.1:{ns2.namenode.port}")
+        router = Router(rconf, state_dir=str(tmp_path / "router"))
+        router.init(rconf)
+        router.start()
+        try:
+            router.mounts.add("/data", "ns1", "/warm")
+            f1 = ns1.get_filesystem()
+            f1.write_all("/warm/a.bin", b"A" * 5000)
+            f1.write_all("/warm/sub/b.bin", b"B" * 3000)
+
+            report = fedbalance(router, mr.rm_addr, mr.default_fs,
+                                "/data", "ns2", "/migrated")
+            assert report["to"] == ["ns2", "/migrated"]
+            # mount now points at ns2, data readable through the router
+            rfs = DistributedFileSystem([("127.0.0.1", router.port)],
+                                        Configuration(load_defaults=False))
+            try:
+                assert rfs.read_all("/data/a.bin") == b"A" * 5000
+                assert rfs.read_all("/data/sub/b.bin") == b"B" * 3000
+            finally:
+                rfs.close()
+            # landed on ns2; source retired
+            assert ns2.get_filesystem().read_all(
+                "/migrated/a.bin") == b"A" * 5000
+            assert not ns1.get_filesystem().exists("/warm/a.bin")
+        finally:
+            router.stop()
