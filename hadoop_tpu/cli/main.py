@@ -15,7 +15,7 @@ src/main/bin/hadoop + hadoop-functions.sh, hdfs/yarn/mapred CLIs):
   hadoop-tpu distcp SRC DST ...            distributed copy
   hadoop-tpu streaming --mapper CMD ...    external-process jobs
   hadoop-tpu archive SRC DST.har           create a har archive
-  hadoop-tpu sls|gridmix|rumen|dynamometer simulators / replay tools
+  hadoop-tpu sls|gridmix|rumen|dynamometer simulators / replay tools\n  hadoop-tpu fs2img EXTERNAL DFS_ROOT --fs URI   mount external data as PROVIDED storage\n  hadoop-tpu resourceestimator TRACE       size a recurring job's reservation
   hadoop-tpu oiv|oev --name-dir DIR        offline image/edits viewers
   hadoop-tpu version
 
@@ -275,6 +275,12 @@ def _main(argv=None) -> int:
     if cmd == "dynamometer":
         from hadoop_tpu.tools.dynamometer import main as dyn_main
         return dyn_main(rest)
+    if cmd == "fs2img":
+        from hadoop_tpu.tools.fs2img import main as fs2img_main
+        return fs2img_main(rest)
+    if cmd == "resourceestimator":
+        from hadoop_tpu.tools.resourceestimator import main as re_main
+        return re_main(rest)
     if cmd == "oiv":
         from hadoop_tpu.cli.oiv import main_oiv
         return main_oiv(rest)

@@ -402,6 +402,10 @@ class _BPServiceActor:
         import time as _time
         self._proxy = get_proxy("DatanodeProtocol", self.nn_addr,
                                 client=dn._client)
+        # PROVIDED storage: the xceiver resolves block aliases through
+        # this NN (any actor's proxy works; last writer wins).
+        dn.xceiver.alias_resolver = \
+            lambda bid: self._proxy.get_block_alias(bid)
         while not dn._stop_event.is_set():
             try:
                 if not registered:

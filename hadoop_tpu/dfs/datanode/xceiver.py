@@ -46,6 +46,11 @@ class DataXceiverServer:
         # with the newest key.
         self.security_keys = security_keys
         self.required_qop = required_qop
+        # PROVIDED storage: block id → external alias resolver (wired by
+        # the DataNode once it has an NN proxy; ref: ProvidedVolumeImpl
+        # reading through the alias map). Cache hits avoid per-read RPCs.
+        self.alias_resolver = None
+        self._alias_cache: dict = {}
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._lsock.bind((bind_host, port))
@@ -311,10 +316,16 @@ class DataXceiverServer:
         length = req.get("length", 1 << 62)
         self._fi().before_read_block(block, self.port)
         try:
+            # Probe EAGERLY — read_chunks is a lazy generator, and a
+            # replica-not-found must choose the PROVIDED fallback before
+            # the setup reply, not explode mid-stream.
+            self.store.open_for_read(block)
             chunks = self.store.read_chunks(block, offset, length)
         except IOError as e:
-            dt.send_frame(sock, {"ok": False, "em": str(e)})
-            return
+            chunks = self._provided_chunks(block, offset, length)
+            if chunks is None:
+                dt.send_frame(sock, {"ok": False, "em": str(e)})
+                return
         dt.send_frame(sock, {"ok": True})
         seq = 0
         for pos, data, sums in chunks:
@@ -326,6 +337,60 @@ class DataXceiverServer:
         dt.send_frame(sock, {"seq": seq, "off": 0, "data": b"", "sums": b"",
                              "last": True})
         self._m_reads.incr()
+
+
+    def _provided_chunks(self, block: Block, offset: int, length: int):
+        """Serve a PROVIDED block by range-reading the external store
+        and computing chunk CRCs on the fly (ref: ProvidedVolumeImpl's
+        FileRegion reads — the DN is a caching/streaming proxy for data
+        that lives outside the cluster)."""
+        alias = self._alias_cache.get(block.block_id)
+        if alias is None and self.alias_resolver is not None:
+            try:
+                alias = self.alias_resolver(block.block_id)
+            except Exception as e:  # noqa: BLE001 — NN transient
+                log.debug("alias lookup for blk_%d failed: %s",
+                          block.block_id, e)
+                alias = None
+            if alias:
+                self._alias_cache[block.block_id] = alias
+        if not alias:
+            return None
+        from hadoop_tpu.fs import FileSystem
+        from hadoop_tpu.util.crc import DataChecksum
+
+        def gen():
+            checksum = DataChecksum(dt.CHUNK_SIZE)
+            bpc = checksum.bytes_per_chunk
+            visible = min(block.num_bytes, alias["length"])
+            start = (offset // bpc) * bpc
+            end = min(visible, offset + length)
+            fs = FileSystem.get(alias["uri"])
+            try:
+                with fs.open(_alias_path(alias["uri"])) as f:
+                    pos = start
+                    while pos < end:
+                        n = min(1024 * 1024, end - pos)
+                        n = min(((n + bpc - 1) // bpc) * bpc,
+                                visible - pos)
+                        if hasattr(f, "pread"):
+                            data = f.pread(alias["offset"] + pos, n)
+                        else:
+                            f.seek(alias["offset"] + pos)
+                            data = f.read(n)
+                        if not data:
+                            break
+                        sums = checksum.checksums_for(data)
+                        yield pos, data, sums
+                        pos += len(data)
+            finally:
+                fs.close()
+        return gen()
+
+
+def _alias_path(uri: str) -> str:
+    from hadoop_tpu.fs.filesystem import Path
+    return Path(uri).path
 
 
 def push_block(store: BlockStore, block: Block,

@@ -48,6 +48,7 @@ OP_TRUNCATE = "truncate"
 OP_SYMLINK = "symlink"
 OP_REASSIGN_LEASE = "reassign_lease"
 OP_SET_GENSTAMP = "set_genstamp"
+OP_PROVIDED_FILE = "provided_file"  # fs2img: external file mounted
 OP_SET_XATTR = "set_xattr"
 OP_REMOVE_XATTR = "remove_xattr"
 OP_SET_ACL = "set_acl"

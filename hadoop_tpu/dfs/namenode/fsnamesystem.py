@@ -101,6 +101,11 @@ class FSNamesystem:
             from hadoop_tpu.dfs.protocol.datatransfer import \
                 DataEncryptionKeys
             self.data_encryption_keys = DataEncryptionKeys()
+        # PROVIDED storage alias map (ref: hdfs server/aliasmap/
+        # InMemoryAliasMap.java + common/blockaliasmap/ — block id →
+        # location in an external store; DNs resolve provided reads
+        # through it). Persisted with the image; populated by fs2img.
+        self.alias_map: Dict[int, Dict] = {}
         self._next_block_id = 1 << 30   # ref: SequentialBlockIdGenerator
         self._next_group_id = ec.STRIPED_ID_BASE  # striped block groups
         self._gen_stamp = 1000          # ref: GenerationStamp
@@ -135,6 +140,8 @@ class FSNamesystem:
             self._next_group_id = extra.get("next_group_id", self._next_group_id)
             self._gen_stamp = extra.get("gen_stamp", self._gen_stamp)
             self.leases.restore_from_image(extra.get("leases", {}))
+            self.alias_map = {int(k): v for k, v in
+                              extra.get("alias_map", {}).items()}
             self.cache_directives = {
                 int(k): v for k, v in
                 extra.get("cache_directives", {}).items()}
@@ -166,6 +173,13 @@ class FSNamesystem:
         for node in iter_tree(self.fsdir.root):
             if isinstance(node, INodeFile):
                 for b in node.blocks:
+                    if b.block_id in self.alias_map:
+                        # PROVIDED blocks have no DN replicas: keeping
+                        # them out of the BM keeps them out of safemode
+                        # accounting and the redundancy queues (ref:
+                        # ProvidedStorageMap bypassing block reports).
+                        self._track_block_id(b.to_wire())
+                        continue
                     info = self._register_block_locked(node, b)
                     info.under_construction = node.under_construction and \
                         b is node.blocks[-1]
@@ -217,6 +231,7 @@ class FSNamesystem:
             "leases": self.leases.snapshot_for_image(),
             "cache_directives": dict(self.cache_directives),
             "next_cache_id": self._next_cache_id,
+            "alias_map": {str(k): v for k, v in self.alias_map.items()},
         }
 
     def close(self) -> None:
@@ -620,6 +635,49 @@ class FSNamesystem:
 
     # ------------------------------------------------------------ reads
 
+    def add_provided_file(self, path: str, external_uri: str,
+                          length: int,
+                          block_size: Optional[int] = None) -> Dict:
+        """Mount one external file as a PROVIDED-storage DFS file: the
+        namespace entry + alias-map blocks, no data copied (ref: the
+        fs2img ImageWriter's per-file treatment — here applied to the
+        live namesystem, checkpointed with the image).
+        """
+        block_size = block_size or self.default_block_size
+        owner = current_user().user_name
+        with self.lock.write():
+            self._check_not_safemode("add provided file")
+            self._check_mutable_path(path)
+            if self.fsdir.exists(path):
+                raise FileExistsError(path)
+            inode = self.fsdir.add_file(path, 1, block_size, owner=owner)
+            blocks = []
+            off = 0
+            while off < length or not blocks:
+                n = min(block_size, length - off)
+                blk = Block(self._new_block_id(),
+                            self._gen_stamp, n)
+                self.alias_map[blk.block_id] = {
+                    "uri": external_uri, "offset": off, "length": n}
+                inode.blocks.append(blk)
+                blocks.append(blk)
+                off += n
+                if length == 0:
+                    break
+            inode.under_construction = False
+            txid = self.editlog.log_edit(el.OP_PROVIDED_FILE, {
+                "p": path, "uri": external_uri, "len": length,
+                "bs": block_size, "o": owner,
+                "b": [b.to_wire() for b in blocks]})
+        self.editlog.log_sync(txid)
+        log_audit_event(True, "addProvidedFile", path)
+        return inode.status(path).to_wire()
+
+    def get_block_alias(self, block_id: int) -> Optional[Dict]:
+        with self.lock.read():
+            alias = self.alias_map.get(block_id)
+            return dict(alias) if alias else None
+
     def get_block_locations(self, path: str, offset: int,
                             length: int) -> Dict:
         """Ref: FSNamesystem.getBlockLocations (+ the sortLocatedBlocks
@@ -637,8 +695,17 @@ class FSNamesystem:
                 pos = 0
                 for b in inode.blocks:
                     if pos + b.num_bytes > offset and pos < offset + length:
-                        blocks.append(self.bm.located_block(
-                            b, pos, reader_host=reader_host))
+                        if b.block_id in self.alias_map:
+                            # PROVIDED block: any DN can serve it by
+                            # fetching from the external store (ref:
+                            # ProvidedStorageMap fabricating locations
+                            # for the provided storage id).
+                            locs = [n.public_info() for n in
+                                    self.bm.dn_manager.live_nodes()[:3]]
+                            blocks.append(LocatedBlock(b, locs, pos))
+                        else:
+                            blocks.append(self.bm.located_block(
+                                b, pos, reader_host=reader_host))
                     pos += b.num_bytes
                 return {
                     "length": inode.length(),
@@ -1392,6 +1459,19 @@ class FSNamesystem:
             inode.client_name = rec.get("cl")
             if inode.client_name:
                 self.leases.add_lease(inode.client_name, rec["p"])
+        elif op == el.OP_PROVIDED_FILE:
+            inode = self.fsdir.add_file(rec["p"], 1, rec["bs"],
+                                        owner=rec.get("o", ""))
+            inode.under_construction = False
+            off = 0
+            for bw in rec.get("b", []):
+                blk = Block.from_wire(bw)
+                self._track_block_id(bw)
+                inode.blocks.append(blk)
+                self.alias_map[blk.block_id] = {
+                    "uri": rec["uri"], "offset": off,
+                    "length": blk.num_bytes}
+                off += blk.num_bytes
         elif op == el.OP_ADD_BLOCK:
             inode = self.fsdir.get_inode(rec["p"])
             if isinstance(inode, INodeFile):

@@ -55,6 +55,7 @@ WRITE_METHODS = frozenset({
     "truncate",
     # Admin/balancer mutations.
     "start_maintenance", "stop_maintenance", "invalidate_replica",
+    "add_provided_file",
 })
 
 
@@ -299,6 +300,17 @@ class ClientProtocol:
             nodes = [n for n in nodes if n.state == DatanodeInfo.STATE_DEAD]
         return [n.public_info().to_wire() for n in nodes]
 
+    def add_provided_file(self, path: str, external_uri: str,
+                          length: int, block_size=None):
+        """Mount an external file as PROVIDED storage (fs2img's RPC;
+        ref: the aliasmap-backed provided volumes of HDFS-9806)."""
+        return self._cached(lambda: self.fsn.add_provided_file(
+            path, external_uri, length, block_size))
+
+    @idempotent
+    def get_block_alias(self, block_id: int):
+        return self.fsn.get_block_alias(block_id)
+
     @idempotent
     def get_data_encryption_key(self):
         """Current key for a dialing client (ref:
@@ -392,6 +404,12 @@ class DatanodeProtocol:
     def register_datanode(self, info: Dict) -> Dict:
         node = self.fsn.bm.dn_manager.register(DatanodeInfo.from_wire(info))
         return {"uuid": node.uuid}
+
+    @idempotent
+    def get_block_alias(self, block_id: int):
+        """Provided-block resolution for serving DNs (ref: the
+        InMemoryLevelDBAliasMapClient DNs use)."""
+        return self.fsn.get_block_alias(block_id)
 
     @idempotent
     def get_data_encryption_keys(self) -> List[Dict]:

@@ -157,6 +157,10 @@ def make_terasort_job(rm_addr, default_fs: str, input_dir: str,
            # keep a whole partition's segments in memory through the merge
            .set("mapreduce.reduce.shuffle.memory.limit",
                 str(512 * 1024 * 1024))
+           # sort buffer > split size: single spill per map, no
+           # intermediate merge pass (ref: terasort tuning guidance —
+           # io.sort.mb sized to the split)
+           .set("mapreduce.task.io.sort.mb", str(split_mb * 2))
            .set("mapreduce.input.split.size", str(split_mb * 1024 * 1024))
            .set(CUTS_KEY,
                 ",".join(base64.b64encode(c).decode() for c in cuts)))

@@ -181,3 +181,47 @@ def test_fedbalance_moves_mount_between_nameservices(tmp_path):
             assert not ns1.get_filesystem().exists("/warm/a.bin")
         finally:
             router.stop()
+
+
+def test_fs2img_provided_storage(tmp_path):
+    """fs2img mounts an external tree as PROVIDED storage: namespace +
+    alias map on the NN, reads served by DNs range-reading the external
+    store, nothing copied (ref: hadoop-fs2img + HDFS-9806 provided
+    volumes). Survives an NN restart (alias map rides the image)."""
+    import os
+
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster, fast_conf
+    from hadoop_tpu.tools.fs2img import mount_tree
+
+    # external data: a local tree
+    ext = tmp_path / "external"
+    (ext / "sub").mkdir(parents=True)
+    big = os.urandom(3 * 1024 * 1024)  # spans multiple 1MB blocks
+    (ext / "big.bin").write_bytes(big)
+    (ext / "sub" / "small.txt").write_bytes(b"provided bytes")
+
+    conf = fast_conf()
+    conf.set("dfs.replication", "1")
+    with MiniDFSCluster(num_datanodes=1, conf=conf,
+                        base_dir=str(tmp_path / "dfs")) as cluster:
+        cluster.wait_active()
+        fs = cluster.get_filesystem()
+        report = mount_tree(fs, f"file://{ext}", "/provided")
+        assert report["files"] == 2
+        # reads flow DN → external file, CRC'd like any replica
+        assert fs.read_all("/provided/sub/small.txt") == b"provided bytes"
+        assert fs.read_all("/provided/big.bin") == big
+        with fs.open("/provided/big.bin") as f:
+            assert f.pread(2_000_000, 64) == big[2_000_000:2_000_064]
+        st = fs.get_file_status("/provided/big.bin")
+        assert st.length == len(big)
+        # no local replicas were created for provided blocks
+        locs = fs.client.get_block_locations("/provided/big.bin")
+        assert locs["blocks"], "provided blocks must have locations"
+
+        # namespace + alias map survive an NN restart via the image
+        cluster.namenode.fsn.save_namespace()
+        cluster.restart_namenode()
+        cluster.wait_active()
+        fs2 = cluster.get_filesystem()
+        assert fs2.read_all("/provided/sub/small.txt") == b"provided bytes"
