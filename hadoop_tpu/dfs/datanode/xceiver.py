@@ -179,7 +179,22 @@ class DataXceiverServer:
                     down.close()
                 return
 
-        open_rep = self.store.create_rbw(block, checksum)
+        try:
+            open_rep = self.store.create_rbw(block, checksum)
+        except IOError as e:
+            # A re-replication push can race an unreported local replica
+            # (IBR lag): tell the sender EXPLICITLY instead of dying with
+            # a bare close it retries against forever, and re-announce
+            # the replica so the NN stops scheduling the transfer (ref:
+            # ReplicaAlreadyExistsException + the IBR that follows).
+            already = "already finalized" in str(e)
+            if already and req.get("stage") == dt.STAGE_TRANSFER:
+                self.on_block_received(block)
+            dt.send_frame(up, {"ok": False, "em": str(e),
+                               "already": already})
+            if down is not None:
+                down.close()
+            return
         dt.send_frame(up, {"ok": True})
 
         # Responder: relays downstream acks upstream with our status first.
@@ -410,6 +425,8 @@ def push_block(store: BlockStore, block: Block,
         })
         setup = dt.recv_frame(sock)
         if not setup.get("ok"):
+            if setup.get("already"):
+                return  # target already holds the replica — push done
             raise IOError(f"transfer setup failed: {setup.get('em')}")
         seq = 0
         for pos, data, sums in store.read_chunks(block, 0, block.num_bytes):
