@@ -21,9 +21,11 @@ Mechanisms:
   (ref: SaslRpcServer.AuthMethod.TOKEN).
 
 QoP (``hadoop.rpc.protection``): ``authentication`` authenticates and
-leaves the channel plaintext; ``privacy`` additionally derives
-per-direction AES-256-GCM session keys bound to both nonces (so neither
-side can replay the other's traffic) and encrypts every frame.
+leaves the channel plaintext; ``integrity`` MACs every frame
+(HMAC-SHA256 with per-direction session keys — tamper-evident,
+readable); ``privacy`` encrypts every frame with per-direction
+AES-256-GCM keys bound to both nonces (so neither side can replay the
+other's traffic).
 
 Handshake (both mechanisms; 2 round trips, mutual):
   C→S  initiate: mech, user/token-identifier, client nonce, wanted QoP
@@ -53,6 +55,7 @@ MECH_SCRAM = "SCRAM-HTPU"
 MECH_TOKEN = "TOKEN"
 
 QOP_AUTH = "authentication"
+QOP_INTEGRITY = "integrity"
 QOP_PRIVACY = "privacy"
 
 _DEFAULT_ITERS = 4096
@@ -132,6 +135,42 @@ class WireCipher:
                 return self._in.decrypt(record[:12], record[12:], b"")
         except Exception as e:  # InvalidTag
             raise AccessControlError(f"frame decryption failed: {e}") from e
+
+
+class IntegrityWrapper:
+    """auth-int QoP: per-frame HMAC-SHA256 with direction-scoped
+    counters (ref: SASL auth-int wrap/unwrap). Same wrap/unwrap surface
+    as WireCipher so the transports don't care which QoP won."""
+
+    MACLEN = 32
+
+    def __init__(self, c2s_key: bytes, s2c_key: bytes, is_client: bool):
+        self._out_key, self._in_key = (c2s_key, s2c_key) if is_client \
+            else (s2c_key, c2s_key)
+        self._out_ctr = 0
+        self._in_ctr = 0
+        self._out_lock = threading.Lock()
+        self._in_lock = threading.Lock()
+
+    def wrap(self, payload: bytes) -> bytes:
+        with self._out_lock:
+            ctr = struct.pack(">Q", self._out_ctr)
+            self._out_ctr += 1
+        return ctr + _hmac(self._out_key, ctr + payload) + payload
+
+    def unwrap(self, record: bytes) -> bytes:
+        if len(record) < 8 + self.MACLEN:
+            raise AccessControlError("truncated integrity frame")
+        ctr, mac = record[:8], record[8:8 + self.MACLEN]
+        payload = record[8 + self.MACLEN:]
+        with self._in_lock:
+            expect = struct.pack(">Q", self._in_ctr)
+            self._in_ctr += 1
+        if ctr != expect or not hmac.compare_digest(
+                mac, _hmac(self._in_key, ctr + payload)):
+            raise AccessControlError(
+                "frame integrity check failed (tampered or replayed)")
+        return payload
 
 
 class CipherSocket:
@@ -223,9 +262,13 @@ class SaslServerSession:
         cnonce = msg.get("cnonce", b"")
         if not isinstance(cnonce, bytes) or len(cnonce) < 8:
             raise AccessControlError("bad client nonce")
-        qop = QOP_PRIVACY if (self.required_qop == QOP_PRIVACY
-                              or msg.get("qop") == QOP_PRIVACY) \
-            else QOP_AUTH
+        wanted = (msg.get("qop"), self.required_qop)
+        if QOP_PRIVACY in wanted:
+            qop = QOP_PRIVACY
+        elif QOP_INTEGRITY in wanted:
+            qop = QOP_INTEGRITY
+        else:
+            qop = QOP_AUTH
         if mech == MECH_SCRAM:
             user = msg.get("user")
             if not user:
@@ -268,10 +311,12 @@ class SaslServerSession:
         self.user = st["user"]
         self.token_ident = st["token_ident"]
         self.complete = True
-        if st["qop"] == QOP_PRIVACY:
+        if st["qop"] in (QOP_PRIVACY, QOP_INTEGRITY):
             c2s, s2c = _derive_wire_keys(client_key, st["cnonce"],
                                          st["snonce"])
-            self.cipher = WireCipher(c2s, s2c, is_client=False)
+            cls = WireCipher if st["qop"] == QOP_PRIVACY \
+                else IntegrityWrapper
+            self.cipher = cls(c2s, s2c, is_client=False)
         return {"state": "success", "qop": st["qop"],
                 "server_proof": _hmac(ver["server_key"], auth_msg)}
 
@@ -337,10 +382,12 @@ class SaslClientSession:
                     "server failed mutual authentication (bad server "
                     "proof) — possible impostor endpoint")
             self.complete = True
-            if self._granted_qop == QOP_PRIVACY:
+            if self._granted_qop in (QOP_PRIVACY, QOP_INTEGRITY):
                 c2s, s2c = _derive_wire_keys(self._client_key,
                                              *self._nonces)
-                self.cipher = WireCipher(c2s, s2c, is_client=True)
+                cls = WireCipher if self._granted_qop == QOP_PRIVACY \
+                    else IntegrityWrapper
+                self.cipher = cls(c2s, s2c, is_client=True)
             return None
         raise AccessControlError(f"unexpected SASL state {state!r}")
 
