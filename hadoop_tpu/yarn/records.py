@@ -314,12 +314,16 @@ class ResourceRequest:
     """AM asks: (priority, count, capability, locality).
     Ref: ResourceRequest.java."""
 
+    EXEC_GUARANTEED = "GUARANTEED"
+    EXEC_OPPORTUNISTIC = "OPPORTUNISTIC"
+
     __slots__ = ("priority", "num_containers", "capability", "host",
-                 "node_label")
+                 "node_label", "execution_type")
 
     def __init__(self, priority: int, num_containers: int,
                  capability: Resource, host: str = "*",
-                 node_label: str = ""):
+                 node_label: str = "",
+                 execution_type: str = EXEC_GUARANTEED):
         self.priority = priority
         self.num_containers = num_containers
         self.capability = capability
@@ -327,13 +331,18 @@ class ResourceRequest:
         # Partition label (ref: ResourceRequest.getNodeLabelExpression):
         # "" = the default (unlabeled) partition, exclusive semantics.
         self.node_label = node_label
+        # ref: ExecutionTypeRequest — OPPORTUNISTIC containers may be
+        # allocated past a node's guaranteed capacity and queue at the
+        # NM (YARN-2882 distributed/opportunistic scheduling).
+        self.execution_type = execution_type
 
     def to_wire(self) -> Dict:
         return {"p": self.priority, "n": self.num_containers,
                 "c": self.capability.to_wire(), "h": self.host,
-                "l": self.node_label}
+                "l": self.node_label, "x": self.execution_type}
 
     @classmethod
     def from_wire(cls, d: Dict) -> "ResourceRequest":
         return cls(d["p"], d["n"], Resource.from_wire(d["c"]),
-                   d.get("h", "*"), d.get("l", ""))
+                   d.get("h", "*"), d.get("l", ""),
+                   d.get("x", cls.EXEC_GUARANTEED))

@@ -44,6 +44,10 @@ class SchedulerNode:
         # default partition.
         self.label = label
         self.containers: Dict[ContainerId, Container] = {}
+        # Opportunistic containers allocated past guaranteed capacity
+        # (queued/run best-effort NM-side; ref: the per-node queue the
+        # OpportunisticContainerAllocator bounds).
+        self.opportunistic: Dict[ContainerId, Container] = {}
 
     def allocate(self, container: Container) -> None:
         self.available = self.available.subtract(container.resource)
@@ -167,8 +171,44 @@ class _BaseScheduler(Scheduler):
             for c in freed:
                 node = self.nodes.get(c.node_id)
                 if node is not None:
-                    node.release(c.container_id)
+                    if node.opportunistic.pop(c.container_id,
+                                              None) is None:
+                        node.release(c.container_id)
             return freed
+
+    # Cap on queued opportunistic containers per node (ref:
+    # yarn.opportunistic-container-allocation.nodes-used +
+    # NM queue limits, collapsed to one knob).
+    MAX_OPPORTUNISTIC_PER_NODE = 8
+
+    def _allocate_opportunistic(self, app: SchedulerApp,
+                                req: ResourceRequest) -> None:
+        """Allocate O-containers IMMEDIATELY at ask time, past node
+        capacity, round-robin over the least-loaded nodes (ref:
+        OpportunisticContainerAllocatorAMService.allocate — the central
+        allocator variant of YARN-2882; containers queue at the NM)."""
+        nodes = sorted(self.nodes.values(),
+                       key=lambda n: len(n.opportunistic))
+        if not nodes:
+            return
+        i = 0
+        while req.num_containers > 0:
+            node = nodes[i % len(nodes)]
+            if len(node.opportunistic) >= self.MAX_OPPORTUNISTIC_PER_NODE:
+                if all(len(n.opportunistic) >=
+                       self.MAX_OPPORTUNISTIC_PER_NODE for n in nodes):
+                    return  # every queue full; leave the rest pending
+                i += 1
+                continue
+            cid = self.make_container_id(app.attempt_id,
+                                         app.next_container_seq())
+            container = Container(cid, node.node_id, req.capability,
+                                  node.nm_address)
+            node.opportunistic[cid] = container
+            app.live_containers[cid] = container
+            app.allocated_unfetched.append(container)
+            req.num_containers -= 1
+            i += 1
 
     def allocate(self, attempt_id: str, asks: List[ResourceRequest],
                  releases: List[ContainerId]
@@ -179,12 +219,22 @@ class _BaseScheduler(Scheduler):
             app = self.apps.get(attempt_id)
             if app is None:
                 return [], []
-            app.add_requests(asks)
+            guaranteed = []
+            for ask in asks:
+                if getattr(ask, "execution_type", "") == \
+                        ResourceRequest.EXEC_OPPORTUNISTIC:
+                    self._allocate_opportunistic(app, ask)
+                else:
+                    guaranteed.append(ask)
+            app.add_requests(guaranteed)
             for cid in releases:
                 c = app.live_containers.pop(cid, None)
                 if c is not None:
-                    app.used = app.used.subtract(c.resource)
                     node = self.nodes.get(c.node_id)
+                    if node is not None and \
+                        node.opportunistic.pop(cid, None) is not None:
+                        continue  # O-containers never held node capacity
+                    app.used = app.used.subtract(c.resource)
                     if node is not None:
                         node.release(cid)
             allocated = app.allocated_unfetched
@@ -218,6 +268,7 @@ class _BaseScheduler(Scheduler):
             app = self.apps.get(attempt_id)
             for node in self.nodes.values():
                 node.release(status.container_id)
+                node.opportunistic.pop(status.container_id, None)
             if app is not None:
                 c = app.live_containers.pop(status.container_id, None)
                 if c is not None:

@@ -359,3 +359,54 @@ def test_reservation_admission_rejects_overcommit():
         "res-3", "b", Resource(4096, 4), 1, start=100.0, deadline=150.0))
     assert s.delete_reservation("res-1")
     assert not s.delete_reservation("res-1")
+
+
+# --------------------------------------------------- opportunistic containers
+
+def test_opportunistic_allocation_past_capacity():
+    """OPPORTUNISTIC asks allocate immediately even on a FULL cluster
+    (queued best-effort), while GUARANTEED asks wait for capacity
+    (ref: YARN-2882 OpportunisticContainerAllocatorAMService)."""
+    s = _fifo()
+    n1 = NodeId("h1", 1)
+    s.add_node(n1, Resource(1024, 2, 0), "h1:1")
+    s.add_app("application_1_0001_01", "default", "u")
+    # fill the node with a guaranteed container
+    s.allocate("application_1_0001_01",
+               [ResourceRequest(1, 1, Resource(1024, 2))], [])
+    s.node_heartbeat(n1)
+    got, _ = s.allocate("application_1_0001_01", [], [])
+    assert len(got) == 1
+
+    # guaranteed ask: blocked (node full)
+    s.allocate("application_1_0001_01",
+               [ResourceRequest(2, 1, Resource(512, 1))], [])
+    s.node_heartbeat(n1)
+    got, _ = s.allocate("application_1_0001_01", [], [])
+    assert got == []
+
+    # opportunistic ask: allocated instantly, past capacity
+    got, _ = s.allocate("application_1_0001_01", [
+        ResourceRequest(3, 2, Resource(512, 1),
+                        execution_type=ResourceRequest
+                        .EXEC_OPPORTUNISTIC)], [])
+    assert len(got) == 2
+    assert all(c.node_id == n1 for c in got)
+    # releasing O-containers does not free (never held) node capacity
+    avail_before = s.nodes[n1].available.memory_mb
+    s.allocate("application_1_0001_01", [],
+               [c.container_id for c in got])
+    assert s.nodes[n1].available.memory_mb == avail_before
+    assert not s.nodes[n1].opportunistic
+
+
+def test_opportunistic_queue_cap_per_node():
+    s = _fifo()
+    n1 = NodeId("h1", 1)
+    s.add_node(n1, Resource(1024, 2, 0), "h1:1")
+    s.add_app("application_1_0001_01", "default", "u")
+    got, _ = s.allocate("application_1_0001_01", [
+        ResourceRequest(1, 50, Resource(128, 1),
+                        execution_type=ResourceRequest
+                        .EXEC_OPPORTUNISTIC)], [])
+    assert len(got) == s.MAX_OPPORTUNISTIC_PER_NODE  # bounded queue
