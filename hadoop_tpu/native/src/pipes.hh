@@ -1,0 +1,115 @@
+// htpu pipes — the C++ task-authoring API.
+//
+// Fills the hadoop-pipes slot (ref: hadoop-tools/hadoop-pipes/src/main/
+// native/pipes/api/hadoop/Pipes.hh — Mapper/Reducer/TaskContext classes
+// C++ jobs subclass, driven by a protocol runner that the framework's
+// task talks to). Here the runner speaks the streaming line protocol
+// (tools/streaming.py: `key\tvalue` per line), so a pipes binary is a
+// self-contained executable the ordinary streaming job machinery
+// launches — no wire-format divergence between pipes and streaming,
+// which is also why the reference eventually recommended streaming
+// over its custom binary protocol.
+//
+// Usage (see pipes_wordcount.cc):
+//   class MyMap : public htpu::pipes::Mapper { ... };
+//   class MyReduce : public htpu::pipes::Reducer { ... };
+//   int main(int argc, char** argv) {
+//     MyMap m; MyReduce r;
+//     return htpu::pipes::runTask(argc, argv, m, r);
+//   }
+// The binary runs as `prog map` for the map phase and `prog reduce`
+// for the reduce phase (tools/pipes.py wires both commands).
+
+#ifndef HTPU_PIPES_HH
+#define HTPU_PIPES_HH
+
+#include <iostream>
+#include <string>
+#include <vector>
+
+namespace htpu {
+namespace pipes {
+
+class Emitter {
+ public:
+  // One output record (streaming contract: key TAB value, one line).
+  void emit(const std::string& key, const std::string& value) {
+    std::cout << key << '\t' << value << '\n';
+  }
+};
+
+class Mapper {
+ public:
+  virtual ~Mapper() = default;
+  virtual void map(const std::string& key, const std::string& value,
+                   Emitter& out) = 0;
+};
+
+class Reducer {
+ public:
+  virtual ~Reducer() = default;
+  // values: every value of one key group (inputs arrive key-sorted).
+  virtual void reduce(const std::string& key,
+                      const std::vector<std::string>& values,
+                      Emitter& out) = 0;
+};
+
+inline void splitKV(const std::string& line, std::string* key,
+                    std::string* value) {
+  auto tab = line.find('\t');
+  if (tab == std::string::npos) {
+    *key = line;
+    value->clear();
+  } else {
+    *key = line.substr(0, tab);
+    *value = line.substr(tab + 1);
+  }
+}
+
+inline int runMap(Mapper& mapper) {
+  Emitter out;
+  std::string line, key, value;
+  while (std::getline(std::cin, line)) {
+    splitKV(line, &key, &value);
+    mapper.map(key, value, out);
+  }
+  std::cout.flush();
+  return 0;
+}
+
+inline int runReduce(Reducer& reducer) {
+  Emitter out;
+  std::string line, key, value, current;
+  std::vector<std::string> values;
+  bool any = false;
+  while (std::getline(std::cin, line)) {
+    splitKV(line, &key, &value);
+    if (any && key != current) {
+      reducer.reduce(current, values, out);
+      values.clear();
+    }
+    current = key;
+    values.push_back(value);
+    any = true;
+  }
+  if (any) reducer.reduce(current, values, out);
+  std::cout.flush();
+  return 0;
+}
+
+// Entry point: argv[1] selects the phase ("map" | "reduce").
+inline int runTask(int argc, char** argv, Mapper& mapper,
+                   Reducer& reducer) {
+  std::ios::sync_with_stdio(false);
+  if (argc > 1 && std::string(argv[1]) == "reduce")
+    return runReduce(reducer);
+  if (argc > 1 && std::string(argv[1]) == "map") return runMap(mapper);
+  std::cerr << "usage: " << (argc ? argv[0] : "task")
+            << " map|reduce\n";
+  return 2;
+}
+
+}  // namespace pipes
+}  // namespace htpu
+
+#endif  // HTPU_PIPES_HH

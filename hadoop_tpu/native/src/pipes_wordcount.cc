@@ -1,0 +1,50 @@
+// Pipes example: word count in C++ (ref: the reference's
+// hadoop-pipes examples/impl — the canonical pipes demo program).
+
+#include <cctype>
+#include <cstdlib>
+#include <sstream>
+
+#include "pipes.hh"
+
+namespace {
+
+class WordCountMap : public htpu::pipes::Mapper {
+ public:
+  void map(const std::string& key, const std::string& value,
+           htpu::pipes::Emitter& out) override {
+    const std::string& text = value.empty() ? key : value;
+    std::string word;
+    for (char c : text) {
+      if (std::isalnum(static_cast<unsigned char>(c))) {
+        word.push_back(static_cast<char>(
+            std::tolower(static_cast<unsigned char>(c))));
+      } else if (!word.empty()) {
+        out.emit(word, "1");
+        word.clear();
+      }
+    }
+    if (!word.empty()) out.emit(word, "1");
+  }
+};
+
+class SumReduce : public htpu::pipes::Reducer {
+ public:
+  void reduce(const std::string& key,
+              const std::vector<std::string>& values,
+              htpu::pipes::Emitter& out) override {
+    long total = 0;
+    for (const auto& v : values) total += std::strtol(v.c_str(), nullptr, 10);
+    std::ostringstream s;
+    s << total;
+    out.emit(key, s.str());
+  }
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  WordCountMap m;
+  SumReduce r;
+  return htpu::pipes::runTask(argc, argv, m, r);
+}

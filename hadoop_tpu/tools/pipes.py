@@ -1,0 +1,44 @@
+"""Pipes — run C++ Mapper/Reducer binaries as MR tasks.
+
+Parity with the reference tool (ref: hadoop-tools/hadoop-pipes —
+Submitter.java launches a job whose tasks drive a C++ child written
+against Pipes.hh). The C++ API lives in native/src/pipes.hh; a pipes
+binary handles both phases (``prog map`` / ``prog reduce``) over the
+streaming line protocol, so the job machinery is the ordinary
+streaming bridge with the program wired into both commands.
+
+  from hadoop_tpu.tools.pipes import pipes_job
+  job = pipes_job(rm, fs_uri, "/in", "/out",
+                  program="/path/to/htpu-pipes-wordcount")
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from hadoop_tpu.tools.streaming import streaming_job
+
+
+def pipes_job(rm_addr, default_fs: str, input_path: str,
+              output_path: str, *, program: str,
+              num_reduces: int = 1):
+    """Build the MR job for one pipes binary (ref: Submitter.runJob).
+    ``program`` must be executable on every NodeManager host (localize
+    it beforehand or use a shared path — the reference ships it via the
+    distributed cache, the same contract)."""
+    if not os.path.exists(program):
+        raise FileNotFoundError(f"pipes program not found: {program}")
+    if not os.access(program, os.X_OK):
+        raise PermissionError(f"pipes program not executable: {program}")
+    return streaming_job(
+        rm_addr, default_fs, input_path, output_path,
+        mapper=f"{program} map", reducer=f"{program} reduce",
+        num_reduces=num_reduces)
+
+
+def example_wordcount_binary() -> Optional[str]:
+    """The in-tree pipes example, built by the native Makefile."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, "native", "htpu-pipes-wordcount")
+    return path if os.path.exists(path) else None

@@ -225,3 +225,31 @@ def test_fs2img_provided_storage(tmp_path):
         cluster.wait_active()
         fs2 = cluster.get_filesystem()
         assert fs2.read_all("/provided/sub/small.txt") == b"provided bytes"
+
+
+def test_pipes_cpp_wordcount_job(tmp_path):
+    """A C++ pipes binary (native/src/pipes.hh API) runs as a real MR
+    job — map and reduce phases both execute compiled C++ (ref:
+    hadoop-pipes Submitter + its wordcount example)."""
+    import pytest
+
+    from hadoop_tpu.testing.minicluster import MiniMRYarnCluster
+    from hadoop_tpu.tools.pipes import (example_wordcount_binary,
+                                        pipes_job)
+
+    prog = example_wordcount_binary()
+    if prog is None:
+        pytest.skip("pipes example binary not built")
+    with MiniMRYarnCluster(num_nodes=1,
+                           base_dir=str(tmp_path)) as cluster:
+        fs = cluster.get_filesystem()
+        fs.write_all("/pin/a.txt",
+                     b"the quick fox\nand the lazy dog and the fox\n")
+        job = pipes_job(cluster.rm_addr, cluster.default_fs,
+                        "/pin", "/pout", program=prog)
+        assert job.wait_for_completion()
+        out = b"".join(fs.read_all(p) for p in fs.glob("/pout/part-*"))
+        counts = dict(line.split(b"\t") for line in out.splitlines())
+        assert counts[b"the"] == b"3"
+        assert counts[b"fox"] == b"2"
+        assert counts[b"dog"] == b"1"
