@@ -375,7 +375,8 @@ class BlockStore:
         with self._lock:
             return list(self._cached)
 
-    def read_chunks(self, block: Block, offset: int, length: int):
+    def read_chunks(self, block: Block, offset: int, length: int,
+                    opened=None):
         """Yield (chunk_aligned_offset, data, sums) runs for a byte range,
         chunk-aligned so the reader can CRC-verify; cached (memory-pinned)
         replicas serve data without touching the data file.
@@ -386,7 +387,8 @@ class BlockStore:
             yield from self._read_chunks_cached(block, offset, length,
                                                 pinned)
             return
-        data_path, meta_path, checksum, visible = self.open_for_read(block)
+        data_path, meta_path, checksum, visible = \
+            opened if opened is not None else self.open_for_read(block)
         bpc = checksum.bytes_per_chunk
         start = (offset // bpc) * bpc
         end = min(visible, offset + length)

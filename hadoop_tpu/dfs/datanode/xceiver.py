@@ -50,7 +50,9 @@ class DataXceiverServer:
         # the DataNode once it has an NN proxy; ref: ProvidedVolumeImpl
         # reading through the alias map). Cache hits avoid per-read RPCs.
         self.alias_resolver = None
-        self._alias_cache: dict = {}
+        self._alias_cache: dict = {}       # block id → (alias, expiry)
+        self.ALIAS_CACHE_TTL = 60.0
+        self.ALIAS_CACHE_MAX = 4096
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._lsock.bind((bind_host, port))
@@ -333,9 +335,11 @@ class DataXceiverServer:
         try:
             # Probe EAGERLY — read_chunks is a lazy generator, and a
             # replica-not-found must choose the PROVIDED fallback before
-            # the setup reply, not explode mid-stream.
-            self.store.open_for_read(block)
-            chunks = self.store.read_chunks(block, offset, length)
+            # the setup reply, not explode mid-stream. The probe result
+            # feeds read_chunks so the meta header parses once.
+            opened = self.store.open_for_read(block)
+            chunks = self.store.read_chunks(block, offset, length,
+                                            opened=opened)
         except IOError as e:
             chunks = self._provided_chunks(block, offset, length)
             if chunks is None:
@@ -359,7 +363,10 @@ class DataXceiverServer:
         and computing chunk CRCs on the fly (ref: ProvidedVolumeImpl's
         FileRegion reads — the DN is a caching/streaming proxy for data
         that lives outside the cluster)."""
-        alias = self._alias_cache.get(block.block_id)
+        import time as _time
+        now = _time.monotonic()
+        hit = self._alias_cache.get(block.block_id)
+        alias = hit[0] if hit and hit[1] > now else None
         if alias is None and self.alias_resolver is not None:
             try:
                 alias = self.alias_resolver(block.block_id)
@@ -368,7 +375,12 @@ class DataXceiverServer:
                           block.block_id, e)
                 alias = None
             if alias:
-                self._alias_cache[block.block_id] = alias
+                # TTL bounds the serve-after-delete window; size cap
+                # bounds memory (coarse clear — aliases re-resolve).
+                if len(self._alias_cache) >= self.ALIAS_CACHE_MAX:
+                    self._alias_cache.clear()
+                self._alias_cache[block.block_id] = (
+                    alias, now + self.ALIAS_CACHE_TTL)
         if not alias:
             return None
         from hadoop_tpu.fs import FileSystem

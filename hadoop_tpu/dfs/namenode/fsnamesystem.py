@@ -793,6 +793,10 @@ class FSNamesystem:
         for b in blocks:
             if b.block_id not in pinned:
                 self.bm.remove_block(b)
+                # Provided blocks: drop the alias entry too, or it leaks
+                # into every future image and keeps the external bytes
+                # addressable by block id after delete.
+                self.alias_map.pop(b.block_id, None)
         return True
 
     def rename(self, src: str, dst: str) -> bool:
@@ -1449,6 +1453,7 @@ class FSNamesystem:
                     for b in collect_blocks(gone):
                         if b.block_id not in pinned:
                             self.bm.remove_block(b)
+                            self.alias_map.pop(b.block_id, None)
                 holder = self.leases.holder_of(rec["p"])
                 if holder:
                     self.leases.remove_lease(holder, rec["p"])

@@ -381,6 +381,17 @@ class SaslClientSession:
                 raise AccessControlError(
                     "server failed mutual authentication (bad server "
                     "proof) — possible impostor endpoint")
+            # QoP floor: the client never accepts LESS protection than
+            # it asked for — a stripped initiate frame must not
+            # downgrade integrity/privacy to plaintext (the granted QoP
+            # is bound into both proofs, so a tampered challenge fails
+            # the handshake; this guards the honest-server-lower-config
+            # case too).
+            rank = {QOP_AUTH: 0, QOP_INTEGRITY: 1, QOP_PRIVACY: 2}
+            if rank.get(self._granted_qop, 0) < rank.get(self.qop, 0):
+                raise AccessControlError(
+                    f"server granted qop {self._granted_qop!r} below "
+                    f"the required {self.qop!r}")
             self.complete = True
             if self._granted_qop in (QOP_PRIVACY, QOP_INTEGRITY):
                 c2s, s2c = _derive_wire_keys(self._client_key,

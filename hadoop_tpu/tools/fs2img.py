@@ -41,23 +41,24 @@ def mount_tree(dfs, external_uri: str, dfs_root: str, *,
     files = 0
     total = 0
     try:
-        def walk(path: str) -> None:
+        def walk(path: str, st) -> None:
             nonlocal files, total
-            st = ext.get_file_status(path)
             rel = path[len(root):].lstrip("/") if path != root else ""
             target = f"{dfs_root.rstrip('/')}/{rel}" if rel \
                 else dfs_root.rstrip("/")
             if st.is_dir:
                 dfs.mkdirs(target)
                 for child in ext.list_status(path):
-                    walk(Path(child.path).path)
+                    # reuse the listing's FileStatus — one metadata RPC
+                    # per node, not two (the walk IS remote traffic)
+                    walk(Path(child.path).path, child)
             else:
                 dfs.client.nn.add_provided_file(
                     target, f"{scheme_prefix}{path}", st.length,
                     block_size)
                 files += 1
                 total += st.length
-        walk(root)
+        walk(root, ext.get_file_status(root))
     finally:
         ext.close()
     log.info("fs2img: mounted %d files (%d bytes) from %s at %s",

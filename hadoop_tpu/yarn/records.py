@@ -157,24 +157,30 @@ class Container:
     """An allocation: id + node + resource (+ the NM address to launch at).
     Ref: Container.java."""
 
-    __slots__ = ("container_id", "node_id", "resource", "nm_address")
+    __slots__ = ("container_id", "node_id", "resource", "nm_address",
+                 "execution_type")
 
     def __init__(self, container_id: ContainerId, node_id: NodeId,
-                 resource: Resource, nm_address: str = ""):
+                 resource: Resource, nm_address: str = "",
+                 execution_type: str = "GUARANTEED"):
         self.container_id = container_id
         self.node_id = node_id
         self.resource = resource
         self.nm_address = nm_address
+        # ref: Container.getExecutionType — carried on the wire so
+        # O-ness survives RM restart / work-preserving recovery.
+        self.execution_type = execution_type
 
     def to_wire(self) -> Dict:
         return {"id": self.container_id.to_wire(),
                 "n": self.node_id.to_wire(), "r": self.resource.to_wire(),
-                "nm": self.nm_address}
+                "nm": self.nm_address, "x": self.execution_type}
 
     @classmethod
     def from_wire(cls, d: Dict) -> "Container":
         return cls(ContainerId.from_wire(d["id"]), NodeId.from_wire(d["n"]),
-                   Resource.from_wire(d["r"]), d.get("nm", ""))
+                   Resource.from_wire(d["r"]), d.get("nm", ""),
+                   d.get("x", "GUARANTEED"))
 
 
 class ContainerLaunchContext:

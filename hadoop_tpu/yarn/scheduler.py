@@ -137,12 +137,14 @@ class _BaseScheduler(Scheduler):
             node = self.nodes.pop(node_id, None)
             if node is None:
                 return []
-            lost = list(node.containers)
+            lost = list(node.containers) + list(node.opportunistic)
             for cid in lost:
                 for app in self.apps.values():
                     if cid in app.live_containers:
                         c = app.live_containers.pop(cid)
-                        app.used = app.used.subtract(c.resource)
+                        if getattr(c, "execution_type", "") != \
+                                ResourceRequest.EXEC_OPPORTUNISTIC:
+                            app.used = app.used.subtract(c.resource)
                         app.completed_unfetched.append(ContainerStatus(
                             cid, "COMPLETE", exit_code=-100,
                             diagnostics="container lost: node expired"))
@@ -202,8 +204,9 @@ class _BaseScheduler(Scheduler):
                 continue
             cid = self.make_container_id(app.attempt_id,
                                          app.next_container_seq())
-            container = Container(cid, node.node_id, req.capability,
-                                  node.nm_address)
+            container = Container(
+                cid, node.node_id, req.capability, node.nm_address,
+                execution_type=ResourceRequest.EXEC_OPPORTUNISTIC)
             node.opportunistic[cid] = container
             app.live_containers[cid] = container
             app.allocated_unfetched.append(container)
@@ -219,21 +222,23 @@ class _BaseScheduler(Scheduler):
             app = self.apps.get(attempt_id)
             if app is None:
                 return [], []
-            guaranteed = []
             for ask in asks:
                 if getattr(ask, "execution_type", "") == \
                         ResourceRequest.EXEC_OPPORTUNISTIC:
                     self._allocate_opportunistic(app, ask)
-                else:
-                    guaranteed.append(ask)
-            app.add_requests(guaranteed)
+            # Remainders of O-asks (queues full) stay pending like any
+            # other request and drain as per-node queues free up (see
+            # node_heartbeat); _assign_on_node skips them.
+            app.add_requests([a for a in asks if a.num_containers > 0])
             for cid in releases:
                 c = app.live_containers.pop(cid, None)
                 if c is not None:
                     node = self.nodes.get(c.node_id)
-                    if node is not None and \
-                        node.opportunistic.pop(cid, None) is not None:
-                        continue  # O-containers never held node capacity
+                    if node is not None:
+                        node.opportunistic.pop(cid, None)
+                    if getattr(c, "execution_type", "") == \
+                            ResourceRequest.EXEC_OPPORTUNISTIC:
+                        continue  # never held capacity or app.used
                     app.used = app.used.subtract(c.resource)
                     if node is not None:
                         node.release(cid)
@@ -253,11 +258,20 @@ class _BaseScheduler(Scheduler):
             node = self.nodes.get(container.node_id)
             if app is None or node is None:
                 return False
-            if container.container_id in node.containers:
+            if container.container_id in node.containers or \
+                    container.container_id in node.opportunistic:
                 return True  # already known
-            node.allocate(container)
-            app.live_containers[container.container_id] = container
-            app.used = app.used.add(container.resource)
+            if getattr(container, "execution_type", "") == \
+                    ResourceRequest.EXEC_OPPORTUNISTIC:
+                # O-ness rides the container wire record: recover into
+                # the O-queue, never into guaranteed capacity (which it
+                # was allocated past by design).
+                node.opportunistic[container.container_id] = container
+                app.live_containers[container.container_id] = container
+            else:
+                node.allocate(container)
+                app.live_containers[container.container_id] = container
+                app.used = app.used.add(container.resource)
             app._seq = max(app._seq, container.container_id.seq)
             return True
 
@@ -271,7 +285,10 @@ class _BaseScheduler(Scheduler):
                 node.opportunistic.pop(status.container_id, None)
             if app is not None:
                 c = app.live_containers.pop(status.container_id, None)
-                if c is not None:
+                if c is not None and getattr(
+                        c, "execution_type", "") != \
+                        ResourceRequest.EXEC_OPPORTUNISTIC:
+                    # O-containers never added to app.used
                     app.used = app.used.subtract(c.resource)
                 app.completed_unfetched.append(status)
 
@@ -289,6 +306,15 @@ class _BaseScheduler(Scheduler):
             node = self.nodes.get(node_id)
             if node is None:
                 return
+            # Drain pending opportunistic remainders first — per-node
+            # queue slots may have freed since the ask.
+            for app in self.apps.values():
+                for reqs in app.pending.values():
+                    for req in reqs:
+                        if req.num_containers > 0 and \
+                                getattr(req, "execution_type", "") == \
+                                ResourceRequest.EXEC_OPPORTUNISTIC:
+                            self._allocate_opportunistic(app, req)
             if not self.REORDER_PER_ASSIGNMENT:
                 for app in self._app_order():
                     self._assign_on_node(app, node)
@@ -316,6 +342,9 @@ class _BaseScheduler(Scheduler):
         for priority in sorted(app.pending):
             for req in app.pending[priority]:
                 while req.num_containers > 0:
+                    if getattr(req, "execution_type", "") == \
+                            ResourceRequest.EXEC_OPPORTUNISTIC:
+                        break  # O-asks drain via the O-allocator only
                     if req.host not in ("*", node.node_id.host):
                         break
                     # Exclusive partitions (ref: SchedulerNode's

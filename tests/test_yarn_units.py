@@ -410,3 +410,9 @@ def test_opportunistic_queue_cap_per_node():
                         execution_type=ResourceRequest
                         .EXEC_OPPORTUNISTIC)], [])
     assert len(got) == s.MAX_OPPORTUNISTIC_PER_NODE  # bounded queue
+    # the remainder stays pending and drains as queue slots free
+    s.allocate("application_1_0001_01", [],
+               [c.container_id for c in got[:4]])
+    s.node_heartbeat(n1)
+    more, _ = s.allocate("application_1_0001_01", [], [])
+    assert len(more) == 4  # refilled up to the cap
