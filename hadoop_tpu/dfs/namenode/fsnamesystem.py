@@ -98,6 +98,21 @@ class FSNamesystem:
         # clients fetch the current key, DNs fetch the full set.
         self.data_encryption_keys = None
         if conf.get_bool("dfs.encrypt.data.transfer", False):
+            # Fail fast on the incompatible combination: on a secured
+            # cluster DEKs are only served over privacy-QoP RPC, so
+            # anything below privacy would strand every DN/client at
+            # key-fetch time with nothing but a DEBUG log to show for
+            # it. Surface the misconfiguration at NN startup instead.
+            auth = conf.get("hadoop.security.authentication",
+                            "simple").lower()
+            qop = conf.get("hadoop.rpc.protection",
+                           "authentication").lower()
+            if auth == "sasl" and qop != "privacy":
+                raise ValueError(
+                    "dfs.encrypt.data.transfer=true on a secured cluster "
+                    "requires hadoop.rpc.protection=privacy (got "
+                    f"{qop!r}): data encryption keys are only served "
+                    "over privacy-protected RPC")
             from hadoop_tpu.dfs.protocol.datatransfer import \
                 DataEncryptionKeys
             self.data_encryption_keys = DataEncryptionKeys()

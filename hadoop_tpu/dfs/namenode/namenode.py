@@ -29,6 +29,7 @@ from hadoop_tpu.dfs.namenode import ha
 from hadoop_tpu.dfs.namenode.fsnamesystem import FSNamesystem
 from hadoop_tpu.dfs.protocol.records import Block, DatanodeInfo
 from hadoop_tpu.ipc import RetryCache, Server, current_call, idempotent
+from hadoop_tpu.security.ugi import AccessControlError
 from hadoop_tpu.ipc.errors import RetriableError
 from hadoop_tpu.ipc.server import CallContext
 from hadoop_tpu.service import AbstractService
@@ -57,6 +58,42 @@ WRITE_METHODS = frozenset({
     "start_maintenance", "stop_maintenance", "invalidate_replica",
     "add_provided_file",
 })
+
+
+_dek_insecure_warned = False
+
+
+def _check_dek_channel(fsn) -> None:
+    """Gate data-encryption-key RPCs on the transport that carries them.
+
+    On a secured cluster the DEK is the whole data-plane secret: serving
+    it over an unprotected channel makes dfs.encrypt.data.transfer
+    security theater (ref: the NN only issues DEKs on Kerberos-secured,
+    QoP-protected RPC — SaslDataTransferClient/DataEncryptionKeyFactory).
+    So: hadoop.security.authentication=sasl ⇒ the calling connection
+    must have negotiated privacy QoP; simple-auth (dev/test) clusters
+    get a one-time loud warning instead, matching the reference's
+    insecure-cluster posture.
+    """
+    global _dek_insecure_warned
+    secured = fsn.conf.get(
+        "hadoop.security.authentication", "simple").lower() == "sasl"
+    ctx = current_call()
+    qop = getattr(ctx, "sasl_qop", None) if ctx is not None else None
+    if secured:
+        if qop != "privacy":
+            raise AccessControlError(
+                "data encryption keys are only served over SASL "
+                "privacy-protected RPC on a secured cluster "
+                f"(connection qop={qop!r})")
+    elif not _dek_insecure_warned:
+        _dek_insecure_warned = True
+        log.warning(
+            "dfs.encrypt.data.transfer is on but RPC authentication is "
+            "'simple': encryption keys travel over an unauthenticated "
+            "channel and protect only against passive mistakes, not "
+            "attackers. Set hadoop.security.authentication=sasl with "
+            "hadoop.rpc.protection=privacy for real protection.")
 
 
 class ClientProtocol:
@@ -317,7 +354,10 @@ class ClientProtocol:
         ClientProtocol.getDataEncryptionKey). None when
         dfs.encrypt.data.transfer is off."""
         dek = self.fsn.data_encryption_keys
-        return dek.current() if dek is not None else None
+        if dek is None:
+            return None
+        _check_dek_channel(self.fsn)
+        return dek.current()
 
     @idempotent
     def get_stats(self):
@@ -416,7 +456,10 @@ class DatanodeProtocol:
         """Full key set for an accepting DN (ref: the NN handing
         BlockTokenSecretManager keys to DNs via DatanodeProtocol)."""
         dek = self.fsn.data_encryption_keys
-        return dek.all_wire() if dek is not None else []
+        if dek is None:
+            return []
+        _check_dek_channel(self.fsn)
+        return dek.all_wire()
 
     @idempotent
     def send_heartbeat(self, uuid: str, capacity: int, dfs_used: int,

@@ -286,12 +286,15 @@ class _Connection:
                     f"connection to {self.addr} closed before send")
             self.calls[call_id] = pend
         payload = pack(req)
-        if self.cipher is not None:
-            payload = self.cipher.wrap(payload)
-        data = struct.pack(">I", len(payload)) + payload
         self.last_activity = time.monotonic()
         try:
+            # wrap() under send_lock: the cipher counters are sequential
+            # and the peer enforces transmit order, so wrap and send must
+            # be atomic across threads sharing this connection.
             with self.send_lock:
+                if self.cipher is not None:
+                    payload = self.cipher.wrap(payload)
+                data = struct.pack(">I", len(payload)) + payload
                 self.sock.sendall(data)
         except OSError as e:
             with self.calls_lock:
@@ -302,9 +305,9 @@ class _Connection:
 
     def ping(self) -> None:
         payload = pack({"id": PING_CALL_ID})
-        if self.cipher is not None:
-            payload = self.cipher.wrap(payload)
         with self.send_lock:
+            if self.cipher is not None:
+                payload = self.cipher.wrap(payload)
             self.sock.sendall(struct.pack(">I", len(payload)) + payload)
 
     def close(self) -> None:

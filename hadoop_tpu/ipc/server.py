@@ -74,7 +74,8 @@ class CallContext:
 
     def __init__(self, user: UserGroupInformation, client_id: bytes,
                  call_id: int, retry_count: int, address: str,
-                 protocol: str, method: str, client_state_id: int):
+                 protocol: str, method: str, client_state_id: int,
+                 sasl_qop: Optional[str] = None):
         self.user = user
         self.client_id = client_id
         self.call_id = call_id
@@ -84,6 +85,9 @@ class CallContext:
         self.method = method
         self.client_state_id = client_state_id
         self.priority = 0
+        # QoP the CONNECTION negotiated (None = unauthenticated/simple).
+        # Handlers serving secrets (the NN's DEK RPCs) gate on this.
+        self.sasl_qop = sasl_qop
 
 
 _current_call: contextvars.ContextVar[Optional[CallContext]] = \
@@ -147,6 +151,8 @@ class Server:
             "hadoop.security.authentication", "simple").lower()
         self.required_qop = self.conf.get(
             "hadoop.rpc.protection", "authentication").lower()
+        from hadoop_tpu.security.proxyusers import ProxyUsers
+        self.proxy_users = ProxyUsers(self.conf)
         self._credentials = None
         keytab = self.conf.get("hadoop.security.server.keytab", None)
         if keytab:
@@ -356,6 +362,10 @@ class Server:
                 if effective != owner:
                     user = UserGroupInformation.create_proxy_user(
                         effective, real_ugi)
+                    # Impersonation needs an explicit ACL grant even for
+                    # a proven token identity (ref: ProxyUsers.authorize
+                    # runs for every real!=effective connection).
+                    self.proxy_users.authorize(user, conn.addr[0])
                 else:
                     user = real_ugi
             else:
@@ -366,6 +376,7 @@ class Server:
                     real_ugi = UserGroupInformation.create_remote_user(real)
                     user = UserGroupInformation.create_proxy_user(
                         user.user_name, real_ugi)
+                    self.proxy_users.authorize(user, conn.addr[0])
         except (AccessControlError, KeyError, TypeError) as e:
             self._m_auth_failures.incr()
             self._send_fatal(conn, f"auth failed: {e}")
@@ -413,9 +424,18 @@ class Server:
         effective = hdr.get("user") or authed
         if effective != authed:
             # Impersonation rides on top of the PROVEN identity (ref:
-            # proxy users under Kerberos).
-            conn.user = UserGroupInformation.create_proxy_user(
+            # proxy users under Kerberos) — and must pass the proxy-user
+            # ACL, or any authenticated principal could act as the
+            # superuser just by claiming its name in the header.
+            proxy = UserGroupInformation.create_proxy_user(
                 effective, real_ugi)
+            try:
+                self.proxy_users.authorize(proxy, conn.addr[0])
+            except AccessControlError as e:
+                self._m_auth_failures.incr()
+                self._send_fatal(conn, f"auth failed: {e}")
+                return
+            conn.user = proxy
         else:
             conn.user = real_ugi
         conn.header = hdr
@@ -447,7 +467,9 @@ class Server:
             user=conn.user, client_id=req.get("cid", b""), call_id=call_id,
             retry_count=req.get("rc", 0), address=f"{conn.addr[0]}:{conn.addr[1]}",
             protocol=protocol, method=method,
-            client_state_id=req.get("sid", -1))
+            client_state_id=req.get("sid", -1),
+            sasl_qop=(conn.sasl.qop if conn.sasl is not None
+                      and conn.sasl.complete else None))
         ctx.priority = call.priority
         span_ctx = SpanContext.from_wire(req.get("t"))
         t0 = time.monotonic()
@@ -651,10 +673,14 @@ class _Responder:
                 close_after: bool = False) -> None:
         if conn.closed:
             return
-        if conn.cipher is not None:
-            payload = conn.cipher.wrap(payload)
-        data = struct.pack(">I", len(payload)) + payload
         with conn.out_lock:
+            # wrap() must happen under the SAME lock that orders the
+            # transmit: the integrity/privacy counters are sequential,
+            # so wrap-then-race-to-send would deliver counter N+1 before
+            # N and the peer would tear the connection down as replayed.
+            if conn.cipher is not None:
+                payload = conn.cipher.wrap(payload)
+            data = struct.pack(">I", len(payload)) + payload
             empty = not conn.out_pending
             if empty:
                 # Fast path: try inline non-blocking write.

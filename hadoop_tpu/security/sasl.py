@@ -107,8 +107,10 @@ class WireCipher:
     """Per-connection AES-256-GCM frame protection.
 
     Each wrapped record is ``12-byte nonce || ciphertext+tag``. Nonces
-    are direction-scoped counters (TCP preserves order; the explicit
-    nonce makes truncation/reorder tampering fail the tag check).
+    are direction-scoped counters; the receiver enforces that each
+    record's nonce counter is exactly the next expected value, so a
+    captured record cannot be replayed or reordered within the
+    connection (GCM's tag alone only binds content, not position).
     ``is_client`` picks which derived key encrypts outbound.
     """
 
@@ -118,6 +120,7 @@ class WireCipher:
         self._out = AESGCM(out_key)
         self._in = AESGCM(in_key)
         self._out_ctr = 0
+        self._in_ctr = 0
         self._in_lock = threading.Lock()
         self._out_lock = threading.Lock()
 
@@ -130,11 +133,19 @@ class WireCipher:
     def unwrap(self, record: bytes) -> bytes:
         if len(record) < 12 + 16:
             raise AccessControlError("truncated encrypted frame")
-        try:
-            with self._in_lock:
-                return self._in.decrypt(record[:12], record[12:], b"")
-        except Exception as e:  # InvalidTag
-            raise AccessControlError(f"frame decryption failed: {e}") from e
+        with self._in_lock:
+            expect = struct.pack(">4xQ", self._in_ctr)
+            if record[:12] != expect:
+                raise AccessControlError(
+                    "frame decryption failed: out-of-order nonce "
+                    "(replayed or reordered record)")
+            try:
+                out = self._in.decrypt(record[:12], record[12:], b"")
+            except Exception as e:  # InvalidTag
+                raise AccessControlError(
+                    f"frame decryption failed: {e}") from e
+            self._in_ctr += 1
+            return out
 
 
 class IntegrityWrapper:
@@ -246,6 +257,7 @@ class SaslServerSession:
         self.user: Optional[str] = None
         self.token_ident: Optional[Dict] = None
         self.cipher: Optional[WireCipher] = None
+        self.qop: Optional[str] = None   # granted QoP once complete
         self.complete = False
         self._state: Optional[Dict] = None
 
@@ -310,6 +322,7 @@ class SaslServerSession:
                 f"authentication failed for {st['user']!r}")
         self.user = st["user"]
         self.token_ident = st["token_ident"]
+        self.qop = st["qop"]
         self.complete = True
         if st["qop"] in (QOP_PRIVACY, QOP_INTEGRITY):
             c2s, s2c = _derive_wire_keys(client_key, st["cnonce"],

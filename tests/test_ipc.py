@@ -53,7 +53,12 @@ class EchoProtocol:
 
 @pytest.fixture
 def server():
-    srv = Server(num_handlers=3, name="test")
+    conf = Configuration(load_defaults=False)
+    # grant the impersonation used by the proxy-user tests (real
+    # 'scheduler' may act as anyone from anywhere)
+    conf.set("hadoop.proxyuser.scheduler.users", "*")
+    conf.set("hadoop.proxyuser.scheduler.hosts", "*")
+    srv = Server(conf, num_handlers=3, name="test")
     srv.register_protocol("EchoProtocol", EchoProtocol())
     srv.start()
     yield srv
@@ -389,7 +394,10 @@ def test_token_auth_preserves_proxy_user():
     """Regression: under TOKEN auth the effective user must ride on top of the
     token owner as a proxy user, not be silently replaced by it."""
     sm = SecretManager(kind="test-token")
-    srv = Server(num_handlers=2, name="secure3", secret_manager=sm)
+    conf = Configuration(load_defaults=False)
+    conf.set("hadoop.proxyuser.scheduler.users", "enduser")
+    conf.set("hadoop.proxyuser.scheduler.hosts", "*")
+    srv = Server(conf, num_handlers=2, name="secure3", secret_manager=sm)
     srv.register_protocol("EchoProtocol", EchoProtocol())
     srv.start()
     c = Client(token_kind="test-token")
