@@ -170,7 +170,13 @@ class DFSClient:
 
     def complete_file(self, path: str, last: Optional[Dict]) -> None:
         import time
-        for backoff in (0.05, 0.1, 0.2, 0.4, 0.8, 1.6, 3.2, 6.4):
+        # Millisecond-scale early rungs: DNs enqueue the incremental
+        # block report the moment a replica finalizes (immediate-IBR
+        # wake in _BPServiceActor), so the NN usually learns of the
+        # last block within a few ms of our final ack — the reference's
+        # 400 ms initial delay (locateFollowingBlock.initial.delay.ms)
+        # is sized for its heartbeat-batched IBR path, not this one.
+        for backoff in (0.003, 0.01, 0.03, 0.1, 0.4, 0.8, 1.6, 3.2, 6.4):
             if self.nn.complete(path, self.client_name, last):
                 return
             time.sleep(backoff)  # ref: DFSOutputStream.completeFile loop

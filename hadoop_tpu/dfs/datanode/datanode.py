@@ -381,6 +381,12 @@ class _BPServiceActor:
         self._received: List[Block] = []
         self._deleted: List[Block] = []
         self._proxy = None
+        # Immediate-IBR wake (ref: BPServiceActor.sendImmediateIBR /
+        # triggerBlockReportForTests): a finalized replica must reach
+        # the NN NOW, not on the next heartbeat tick — the client's
+        # completeFile() polls with backoff, so a heartbeat-cadence IBR
+        # turns every small-file close into a ~0.75 s stall.
+        self._wake = threading.Event()
 
     def start(self) -> None:
         Daemon(self._offer_service,
@@ -389,10 +395,12 @@ class _BPServiceActor:
     def note_received(self, block: Block) -> None:
         with self._lock:
             self._received.append(block)
+        self._wake.set()
 
     def note_deleted(self, block: Block) -> None:
         with self._lock:
             self._deleted.append(block)
+        self._wake.set()
 
     def _offer_service(self) -> None:
         """Main actor loop. Ref: BPServiceActor.offerService:643."""
@@ -444,7 +452,21 @@ class _BPServiceActor:
                 # rebuild the proxy from the current nn_addr.
                 self._proxy = get_proxy("DatanodeProtocol", self.nn_addr,
                                         client=dn._client)
-            dn._stop_event.wait(dn.heartbeat_interval)
+            # Sleep until the next heartbeat, but wake early to flush
+            # incremental reports the moment a block lands/deletes.
+            deadline = _time.monotonic() + dn.heartbeat_interval
+            while not dn._stop_event.is_set():
+                rem = deadline - _time.monotonic()
+                if rem <= 0:
+                    break
+                if not self._wake.wait(timeout=min(rem, 0.25)):
+                    continue
+                self._wake.clear()
+                try:
+                    self._flush_incremental_reports()
+                except Exception:  # noqa: BLE001 — NN bounce
+                    registered = False
+                    break  # next outer iteration rebuilds + re-registers
 
     def _send_full_report(self) -> None:
         blocks = [b.to_wire() for b in self.dn.store.all_finalized()]
@@ -455,6 +477,17 @@ class _BPServiceActor:
             received, self._received = self._received, []
             deleted, self._deleted = self._deleted, []
         if received or deleted:
-            self._proxy.block_received_and_deleted(
-                self.dn.uuid, [b.to_wire() for b in received],
-                [b.to_wire() for b in deleted])
+            try:
+                self._proxy.block_received_and_deleted(
+                    self.dn.uuid, [b.to_wire() for b in received],
+                    [b.to_wire() for b in deleted])
+            except Exception:
+                # NN unreachable/bouncing: put the reports BACK — a
+                # dropped IBR means the NN never learns the replica
+                # exists until the next full report (hours).
+                with self._lock:
+                    self._received[:0] = received
+                    self._deleted[:0] = deleted
+                # No _wake.set() here: the next heartbeat-cadence flush
+                # retries; waking now would busy-spin against a dead NN.
+                raise

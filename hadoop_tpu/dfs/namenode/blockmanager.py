@@ -396,6 +396,17 @@ class BlockManager:
         # 0 = highest risk (1 replica), 1 = under-replicated, 2 = queued drains.
         self._needed: List[Set[int]] = [set(), set(), set()]
         self._pending_reconstruction: Dict[int, float] = {}  # id → deadline
+        # Standby postponement (ref: BlockManager.PendingDataNodeMessages
+        # + shouldPostponeBlocksFromFuture): a standby's editlog tail can
+        # lag the DNs' incremental reports, so a received-report for a
+        # block the namespace doesn't know yet must be QUEUED, not
+        # invalidated — invalidating would delete the only replica of a
+        # just-written block after failover. Replayed when the block
+        # appears (edit tailing) and drained on transition to active.
+        self.postpone_unknown = False
+        self._postponed: Dict[int, List[tuple]] = {}  # id → [(Block, uuid)]
+        self._postponed_count = 0
+        self.POSTPONED_MAX = 100_000
         # How long a scheduled (re)construction may stay outstanding
         # before re-queueing (ref:
         # dfs.namenode.reconstruction.pending.timeout-sec). EC gets 2x:
@@ -418,15 +429,57 @@ class BlockManager:
         with self._lock:
             info = BlockInfo(block, inode, replication)
             self._blocks[block.block_id] = info
-            return info
+            replayed = self._replay_postponed_locked(block.block_id)
+        if replayed:
+            # outside _lock: report_blocks re-enters it via _blocks_safe
+            self.safemode.report_blocks()
+        return info
 
     def add_striped_block_collection(self, block: Block, inode,
                                      policy: ec.ECPolicy
                                      ) -> BlockInfoStriped:
+        replayed = False
         with self._lock:
             info = BlockInfoStriped(block, inode, policy)
             self._blocks[block.block_id] = info
-            return info
+            # striped units report under unit ids = group id | index —
+            # probe the group's width directly instead of scanning the
+            # whole postponed dict per group
+            width = policy.k + policy.m
+            for bid in [block.block_id] + \
+                    [block.block_id + i for i in range(width)]:
+                replayed |= self._replay_postponed_locked(bid)
+        if replayed:
+            self.safemode.report_blocks()
+        return info
+
+    def _replay_postponed_locked(self, block_id: int) -> bool:
+        msgs = self._postponed.pop(block_id, None)
+        if not msgs:
+            return False
+        self._postponed_count -= len(msgs)
+        for blk, uuid in msgs:
+            node = self.dn_manager.get(uuid)
+            if node is not None:
+                self._add_stored_block_locked(blk, node)
+        return True
+
+    def process_all_postponed(self) -> None:
+        """Drain the postponed queue with postponement OFF — run on
+        transition to active (ref: processAllPendingDNMessages): by now
+        the namespace is fully caught up, so anything still unknown
+        really is deletable."""
+        with self._lock:
+            self.postpone_unknown = False
+            pending, self._postponed = self._postponed, {}
+            self._postponed_count = 0
+            for msgs in pending.values():
+                for blk, uuid in msgs:
+                    node = self.dn_manager.get(uuid)
+                    if node is not None:
+                        self._add_stored_block_locked(blk, node)
+        if pending:
+            self.safemode.report_blocks()
 
     def _resolve_locked(self, block_id: int) -> Optional[BlockInfo]:
         """Map a reported block id to its BlockInfo; a striped unit id
@@ -449,6 +502,11 @@ class BlockManager:
             for q in self._needed:
                 q.discard(block.block_id)
             self._pending_reconstruction.pop(block.block_id, None)
+            # deletion tailed on a standby: postponed reports for this
+            # block are moot — free their slots
+            stale = self._postponed.pop(block.block_id, None)
+            if stale:
+                self._postponed_count -= len(stale)
         if info is None:
             return
         for uuid in info.locations:
@@ -506,8 +564,30 @@ class BlockManager:
                                  node: DatanodeDescriptor) -> None:
         info = self._resolve_locked(block.block_id)
         if info is None:
+            if self.postpone_unknown:
+                # Past the cap we DROP rather than invalidate: a lost
+                # report self-heals at the next full block report, but
+                # an invalidate issued from a lagging standby deletes
+                # what may be the only replica of a committed block
+                # after failover (commands queue on the descriptor and
+                # dispatch once active — namenode.py issue_commands).
+                if self._postponed_count < self.POSTPONED_MAX:
+                    self._postponed.setdefault(block.block_id, []).append(
+                        (block, node.uuid))
+                    self._postponed_count += 1
+                return
             # Replica of a deleted/unknown block → invalidate at the DN.
             node.invalidate_queue.append(block)
+            return
+        if self.postpone_unknown and \
+                block.gen_stamp > info.block.gen_stamp:
+            # Replica from the FUTURE relative to our namespace view
+            # (pipeline recovery we haven't tailed yet) — same postpone
+            # (same drop-past-cap rationale as above).
+            if self._postponed_count < self.POSTPONED_MAX:
+                self._postponed.setdefault(block.block_id, []).append(
+                    (block, node.uuid))
+                self._postponed_count += 1
             return
         if block.gen_stamp < info.block.gen_stamp:
             # Stale replica from a failed pipeline — corrupt by definition.

@@ -580,6 +580,10 @@ class NameNode(AbstractService):
         self.fsn = FSNamesystem(conf, self.name_dir, journal_manager=journal)
         if self.ha_enabled:
             self.ha_state = ha.STANDBY
+            # Standby: DN reports can outrun edit tailing — postpone
+            # unknown-block reports instead of invalidating (ref:
+            # shouldPostponeBlocksFromFuture set in startStandbyServices).
+            self.fsn.bm.postpone_unknown = True
             last = self.fsn.load_from_disk(open_edits=False)
             self.tailer = ha.EditLogTailer(
                 self.fsn, interval_s=conf.get_time_seconds(
@@ -736,6 +740,9 @@ class NameNode(AbstractService):
             last = max(last_committed, self.tailer.last_applied_txid)
             self.fsn.editlog.open_for_write(last)
             self.ha_state = ha.ACTIVE
+            # Namespace is caught up: replay every postponed DN report
+            # (ref: processAllPendingDNMessages in startActiveServices).
+            self.fsn.bm.process_all_postponed()
             log.info("NameNode %s is now ACTIVE at txid %d", self.nn_id, last)
 
     def transition_to_standby(self) -> None:
@@ -747,6 +754,7 @@ class NameNode(AbstractService):
                 raise ValueError("HA is not enabled")
             was_active = self.ha_state == ha.ACTIVE
             self.ha_state = ha.STANDBY
+            self.fsn.bm.postpone_unknown = True
             # Always stop first: observer→standby must not leave the old
             # tailer/checkpointer threads running beside fresh ones.
             self.tailer.stop()

@@ -40,6 +40,19 @@ def test_balancer_spreads_blocks(tmp_path):
         cluster._start_datanode(2)
         cluster._start_datanode(3)
         cluster.wait_active()
+        # The balancer plans from heartbeat-reported usage; writes now
+        # complete faster than the next heartbeat (immediate IBRs), so
+        # wait until the loaded DNs' non-zero dfs_used has actually
+        # reached the NN before asking for a plan.
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            dm = cluster.namenode.fsn.bm.dn_manager
+            loaded = [dm.get(cluster.datanodes[i].uuid) for i in (0, 1)]
+            # replication may be 1: it's enough that SOME loaded DN's
+            # non-zero usage has reached the NN via heartbeat
+            if any(n is not None and n.dfs_used > 0 for n in loaded):
+                break
+            time.sleep(0.1)
         bal = Balancer(cluster.nn_addr, cluster.conf, threshold=0.02)
         try:
             stats = bal.run()

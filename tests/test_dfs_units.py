@@ -474,3 +474,35 @@ def test_blockstore_finalize_existing_rbw(tmp_path):
     assert final.num_bytes == 700
     assert final.gen_stamp == 101
     assert [b.block_id for b in store.all_finalized()] == [11]
+
+
+def test_standby_postpones_unknown_block_reports():
+    """A standby whose edit tail lags the DNs must QUEUE received-reports
+    for unknown blocks, not invalidate the replicas (ref: BlockManager
+    .PendingDataNodeMessages; the round-5 immediate-IBR change makes the
+    race routine). Replay happens when the block appears or on
+    transition to active."""
+    conf = Configuration(load_defaults=False)
+    bm = BlockManager(conf)
+    bm.safemode.leave(force=True)
+    (node,) = _register(bm, 1)
+    bm.postpone_unknown = True
+
+    blk = Block(77, 100, 4096)
+    bm.add_stored_block(blk, node.uuid)           # namespace doesn't know it
+    assert not node.invalidate_queue, "standby must not invalidate"
+    assert bm._postponed_count == 1
+
+    info = bm.add_block_collection(blk, None, 1)  # edit tail catches up
+    info.under_construction = False
+    assert bm._postponed_count == 0
+    assert bm.get(77).live_replicas() == 1        # replayed
+
+    # Unknown at activation time → really deletable: drained with
+    # postponement off, replica invalidated.
+    bm.postpone_unknown = True
+    bm.add_stored_block(Block(88, 100, 4096), node.uuid)
+    assert bm._postponed_count == 1
+    bm.process_all_postponed()
+    assert bm._postponed_count == 0 and not bm.postpone_unknown
+    assert any(b.block_id == 88 for b in node.invalidate_queue)
