@@ -16,8 +16,10 @@ XLA collectives ride ICI:
                        log-sum-exp merge
 - ``optimizer``      — fused AdamW on local shards (the distributed
                        optimizer: state is sharded exactly like params)
-- ``collectives``    — host-level all_to_all/sort primitives reused by the
-                       compute engine (device-path shuffle)
+- ``collectives``    — the device-path shuffle: capacity-bounded
+                       ``lax.all_to_all`` record exchange, sampled range
+                       partitioning, global device sort (consumed by
+                       ``mapreduce.device_shuffle``)
 """
 
 from hadoop_tpu.parallel.mesh import MeshPlan, make_mesh, param_specs

@@ -1,0 +1,147 @@
+"""Device-resident shuffle: the lax.all_to_all exchange (SURVEY §5.8).
+
+Runs on the forced 8-device CPU mesh (conftest). Parity oracle is the
+HOST shuffle semantics: same partition function, same per-partition
+record multisets, same grouped totals — computed in plain numpy.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hadoop_tpu.mapreduce.device_shuffle import (device_group_reduce,
+                                                 device_shuffle,
+                                                 device_terasort,
+                                                 hash_partitioner)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devs = np.array(jax.devices()[:8])
+    assert devs.size == 8, "conftest must force 8 CPU devices"
+    return Mesh(devs, ("x",))
+
+
+def _shard(mesh, arr):
+    return jax.device_put(arr, NamedSharding(mesh, P("x")))
+
+
+def _host_partition(keys, n):
+    """The same hash the device uses, in numpy — the host-shuffle oracle."""
+    h = keys.astype(np.uint32) * np.uint32(0x9E3779B1)
+    h ^= h >> np.uint32(15)
+    return (h % np.uint32(n)).astype(np.int32)
+
+
+def test_device_shuffle_parity_with_host_partitioning(mesh):
+    """Every record lands on the device its hash names, nothing lost,
+    nothing invented — the ShuffleHandler/Fetcher contract."""
+    rng = np.random.default_rng(7)
+    n = 8 * 512
+    keys = rng.integers(0, 10_000, size=n).astype(np.int32)
+    vals = rng.integers(0, 100, size=n).astype(np.int32)
+
+    res = device_shuffle(mesh, "x", _shard(mesh, jnp.asarray(keys)),
+                         _shard(mesh, jnp.asarray(vals)),
+                         capacity_factor=3.0)
+    assert int(res.dropped.sum()) == 0
+
+    out_k = np.asarray(res.keys).reshape(8, -1)
+    out_v = np.asarray(res.values).reshape(8, -1)
+    out_m = np.asarray(res.valid).reshape(8, -1)
+
+    want_dest = _host_partition(keys, 8)
+    for d in range(8):
+        got = sorted(zip(out_k[d][out_m[d]].tolist(),
+                         out_v[d][out_m[d]].tolist()))
+        want = sorted(zip(keys[want_dest == d].tolist(),
+                          vals[want_dest == d].tolist()))
+        assert got == want, f"partition {d} mismatch"
+
+
+def test_device_shuffle_detects_overflow(mesh):
+    """Skew past the capacity factor must be REPORTED, never silent:
+    all records hash to one destination, capacity can't hold them."""
+    n = 8 * 64
+    keys = jnp.full((n,), 42, jnp.int32)  # one destination for everything
+    vals = jnp.arange(n, dtype=jnp.int32)
+    res = device_shuffle(mesh, "x", _shard(mesh, keys),
+                         _shard(mesh, vals), capacity_factor=1.0)
+    n_valid = int(np.asarray(res.valid).sum())
+    n_dropped = int(np.asarray(res.dropped).sum())
+    assert n_dropped > 0
+    assert n_valid + n_dropped == n  # conservation: every record accounted
+
+
+def test_device_terasort_global_order(mesh):
+    """TeraSort acceptance: after sample→range-partition→exchange→sort,
+    concatenating the devices' valid runs IS the sorted input (the
+    TeraValidate check)."""
+    rng = np.random.default_rng(11)
+    n = 8 * 1024
+    keys = rng.integers(-2**31, 2**31 - 2, size=n).astype(np.int32)
+    vals = np.arange(n).astype(np.int32)
+
+    res = device_terasort(mesh, "x", _shard(mesh, jnp.asarray(keys)),
+                          _shard(mesh, jnp.asarray(vals)),
+                          capacity_factor=3.0)
+    assert int(res.dropped.sum()) == 0
+    out_k = np.asarray(res.keys).reshape(8, -1)
+    out_m = np.asarray(res.valid).reshape(8, -1)
+    runs = [out_k[d][out_m[d]] for d in range(8)]
+    for d, run in enumerate(runs):
+        assert np.all(np.diff(run) >= 0), f"device {d} run not sorted"
+    for d in range(7):
+        if runs[d].size and runs[d + 1].size:
+            assert runs[d][-1] <= runs[d + 1][0], "global order broken"
+    glued = np.concatenate(runs)
+    np.testing.assert_array_equal(glued, np.sort(keys))
+
+
+def test_device_group_reduce_wordcount_parity(mesh):
+    """The numeric wordcount: per-key sums across the mesh equal the
+    host reducer's output; each key reported exactly once."""
+    rng = np.random.default_rng(3)
+    n = 8 * 256
+    keys = rng.integers(0, 50, size=n).astype(np.int32)  # heavy dupes
+    vals = rng.integers(1, 10, size=n).astype(np.int32)
+
+    res = device_group_reduce(mesh, "x", _shard(mesh, jnp.asarray(keys)),
+                              _shard(mesh, jnp.asarray(vals)),
+                              capacity_factor=16.0)  # 50 keys / 8 devs: skew
+    assert int(res.dropped.sum()) == 0
+    out_k = np.asarray(res.keys)
+    out_v = np.asarray(res.values)
+    out_m = np.asarray(res.valid)
+
+    got = {int(k): int(v) for k, v in zip(out_k[out_m], out_v[out_m])}
+    want = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        want[k] = want.get(k, 0) + v
+    assert got == want
+    assert len(out_k[out_m]) == len(set(out_k[out_m].tolist()))
+
+
+def test_device_shuffle_values_can_be_vectors(mesh):
+    """Values needn't be scalars — a [n, d] payload (e.g. embedding
+    rows) rides the same exchange."""
+    rng = np.random.default_rng(5)
+    n = 8 * 128
+    keys = rng.integers(0, 1000, size=n).astype(np.int32)
+    vals = rng.standard_normal((n, 16)).astype(np.float32)
+    res = device_shuffle(mesh, "x", _shard(mesh, jnp.asarray(keys)),
+                         _shard(mesh, jnp.asarray(vals)),
+                         capacity_factor=3.0)
+    assert int(res.dropped.sum()) == 0
+    out_k = np.asarray(res.keys)
+    out_v = np.asarray(res.values)
+    out_m = np.asarray(res.valid)
+    # reattach: every surviving (key, payload) pair exists in the input
+    want = {}
+    for k, v in zip(keys.tolist(), vals):
+        want.setdefault(k, []).append(v)
+    for k, v in zip(out_k[out_m].tolist(), out_v[out_m]):
+        assert any(np.allclose(v, w) for w in want[k])
+    assert int(out_m.sum()) == n
