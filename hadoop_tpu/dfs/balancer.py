@@ -39,13 +39,30 @@ def _transfer_security(conf: Configuration, nn):
         nn.get_data_encryption_key,
         qop=conf.get("dfs.data.transfer.protection", "privacy"))
 
+def _block_token_minter(conf: Configuration, nn):
+    """Balancer-side token minter (ref: balancer/KeyManager.java — the
+    balancer fetches the NN's block keys once and mints its own COPY
+    tokens, refreshing on rotation)."""
+    if not conf.get_bool("dfs.block.access.token.enable", False):
+        return None
+    from hadoop_tpu.dfs.protocol.blocktoken import BlockTokenSecretManager
+    mgr = BlockTokenSecretManager.for_verification()
+    mgr.import_keys(nn.get_block_keys())
+    return mgr
+
+
 def _transfer(source: DatanodeInfo, block: Block,
-              target: DatanodeInfo, security=None) -> None:
+              target: DatanodeInfo, security=None, tokens=None) -> None:
     """Command ``source`` to push one replica to ``target``."""
+    req_tok = None
+    if tokens is not None:
+        from hadoop_tpu.dfs.protocol import blocktoken as bt
+        req_tok = tokens.generate_token("balancer", block.block_id,
+                                        (bt.MODE_COPY,))
     sock = dt.connect(source.xfer_addr(), timeout=10.0, security=security)
     try:
         dt.send_frame(sock, {"op": dt.OP_TRANSFER_BLOCK,
-                             "b": block.to_wire(),
+                             "b": block.to_wire(), "tok": req_tok,
                              "targets": [target.to_wire()]})
         resp = dt.recv_frame(sock)
         if not resp.get("ok"):
@@ -68,6 +85,7 @@ class Balancer:
         self.nn = get_proxy("ClientProtocol", nn_addrs[0],
                             client=self._client)
         self.security = _transfer_security(self.conf, self.nn)
+        self.tokens = _block_token_minter(self.conf, self.nn)
 
     def close(self) -> None:
         self._client.stop()
@@ -91,7 +109,7 @@ class Balancer:
             for source, block, target in plan:
                 try:
                     _transfer(source, block, target,
-                              security=self.security)
+                              security=self.security, tokens=self.tokens)
                     ok += 1
                     moved += 1
                 except (OSError, IOError) as e:
@@ -155,6 +173,7 @@ class Mover:
         self.nn = get_proxy("ClientProtocol", nn_addrs[0],
                             client=self._client)
         self.security = _transfer_security(self.conf, self.nn)
+        self.tokens = _block_token_minter(self.conf, self.nn)
 
     def close(self) -> None:
         self._client.stop()
@@ -200,7 +219,8 @@ class Mover:
                 if target is None:
                     break
                 try:
-                    _transfer(bad, block, target, security=self.security)
+                    _transfer(bad, block, target, security=self.security,
+                              tokens=self.tokens)
                     placed_uuids.add(target.uuid)
                     # Wait for the new replica to register, then retire the
                     # misplaced copy (invalidating first could momentarily

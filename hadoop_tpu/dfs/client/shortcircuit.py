@@ -1,34 +1,48 @@
-"""Short-circuit local reads — same-host replicas bypass the DN data path.
+"""Short-circuit local reads — fd-passing grants, no DN data path.
 
 Parity with the reference's short-circuit read stack (ref:
 hadoop-hdfs-client/.../shortcircuit/ShortCircuitCache.java:72,
-ShortCircuitShm.java, client/impl/BlockReaderFactory.java:354-381
-getBlockReaderLocal; native transport
-hadoop-common/src/main/native/src/org/apache/hadoop/net/unix/DomainSocket.c):
-when a replica lives on the reader's own host, the client asks the DN once
-for the replica's file layout and from then on reads the block file
-directly — no socket hop, no DN thread, no packet framing — while STILL
-verifying the stored CRCs (BlockReaderLocal does the same; skipping
-verification is a separate opt-in there).
+client/impl/BlockReaderFactory.java:354-381 getBlockReaderLocal;
+native transport hadoop-common/.../net/unix/DomainSocket.c): when a
+replica lives on the reader's own host, the client asks the DN's
+AF_UNIX socket for the replica's OPEN file descriptors (SCM_RIGHTS,
+``socket.recv_fds``) and from then on reads the block file directly —
+no socket hop, no DN thread, no packet framing — while STILL verifying
+the stored CRCs (BlockReaderLocal does the same).
 
-Transport simplification: the reference passes open file descriptors over
-a Unix domain socket so the DN never reveals paths; here the DN hands the
-client the replica's (data, meta) paths over the regular transfer port.
-Same trust domain (one OS user runs both on a TPU-VM host), one fewer
-native layer. The cache keys and invalidation rules mirror
-ShortCircuitCache: cached per (block, genstamp), dropped on any IO error
-so the TCP path takes over (e.g. after the balancer moves a replica).
+Security: the grant is gated on the block access token when
+``dfs.block.access.token.enable`` is on — the DN never reveals paths,
+so a client that could not read the block over the authenticated TCP
+path cannot open the replica locally either (this replaces the round-4
+path-handoff shortcut the advisor flagged as inconsistent).
+
+Socket discovery: the ``dfs.domain.socket.path`` template with the
+reference's ``_PORT`` placeholder, expanded with the DN's transfer
+port; when the client conf lacks it, one TCP round-trip to the DN's
+transfer port learns the path (the reply carries ``domain_socket``).
+
+Cache/invalidation mirror ShortCircuitCache: slots key per
+(dn, block, genstamp); LRU-evicted slots close their fds; any IO or
+checksum error drops the slot so the TCP path takes over. A cached fd
+stays valid across DN restarts and replica moves — finalized block
+bytes at a given genstamp are immutable, and append/recovery bumps the
+genstamp into a different cache key.
 """
 
 from __future__ import annotations
 
+import array
 import collections
 import logging
+import os
+import socket
+import struct
 import threading
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 from hadoop_tpu.dfs.protocol import datatransfer as dt
 from hadoop_tpu.dfs.protocol.records import Block, DatanodeInfo
+from hadoop_tpu.io import pack, unpack
 from hadoop_tpu.util.crc import DataChecksum
 from hadoop_tpu.util.misc import local_host_names
 
@@ -36,27 +50,43 @@ log = logging.getLogger(__name__)
 
 
 class ShortCircuitUnavailable(Exception):
-    """Fall back to the TCP reader (DN too old, replica moved, ...)."""
+    """Fall back to the TCP reader (no domain socket, token refused at
+    discovery time, replica moved, ...)."""
 
 
 class _Slot:
-    __slots__ = ("data_path", "meta_path", "bpc", "visible")
+    """Refcounted fd pair (ref: ShortCircuitCache's slot refcounting):
+    eviction/invalidation must never close descriptors a concurrent
+    read() still holds — a reused fd number would make that reader
+    pread ANOTHER block's bytes and report a healthy replica corrupt.
+    ``refs``/``dead`` transitions happen under the cache lock; the last
+    releaser closes."""
 
-    def __init__(self, data_path: str, meta_path: str, bpc: int,
-                 visible: int):
-        self.data_path = data_path
-        self.meta_path = meta_path
+    __slots__ = ("data_fd", "meta_fd", "bpc", "visible", "refs", "dead")
+
+    def __init__(self, data_fd: int, meta_fd: int, bpc: int, visible: int):
+        self.data_fd = data_fd
+        self.meta_fd = meta_fd
         self.bpc = bpc
         self.visible = visible
+        self.refs = 0
+        self.dead = False
+
+    def _close_now(self) -> None:
+        for fd in (self.data_fd, self.meta_fd):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        self.data_fd = self.meta_fd = -1
 
 
 class ShortCircuitCache:
-    """Per-process replica-layout cache, LRU-bounded (the reference's
-    ShortCircuitCache evicts on expiry; a size cap serves the same
-    goal — a long-lived reader must not accumulate a slot per block it
-    ever touched). Ref: ShortCircuitCache.java:72."""
+    """Per-process replica-fd cache, LRU-bounded (ref:
+    ShortCircuitCache.java:72 — it caches replica slots the same way;
+    the size cap bounds open-fd usage at 2×MAX_SLOTS descriptors)."""
 
-    MAX_SLOTS = 4096  # ~a few hundred KB of path strings at the cap
+    MAX_SLOTS = 256
 
     _instance: Optional["ShortCircuitCache"] = None
     _instance_lock = threading.Lock()
@@ -66,6 +96,7 @@ class ShortCircuitCache:
             collections.OrderedDict()
         self._lock = threading.Lock()
         self._local = local_host_names()
+        self._socket_paths: Dict[str, str] = {}   # dn uuid → AF_UNIX path
         self.hits = 0
         self.requests = 0
 
@@ -81,7 +112,78 @@ class ShortCircuitCache:
 
     # ------------------------------------------------------------ plumbing
 
-    def _slot_for(self, dn: DatanodeInfo, block: Block) -> _Slot:
+    def _socket_path(self, dn: DatanodeInfo, template: str) -> str:
+        if template:
+            return template.replace("_PORT", str(dn.xfer_port))
+        path = self._socket_paths.get(dn.uuid)
+        if path:
+            return path
+        # one-time TCP discovery: the DN advertises its domain socket
+        sock = dt.connect(dn.xfer_addr(), timeout=10.0)
+        try:
+            dt.send_frame(sock, {"op": dt.OP_SHORT_CIRCUIT})
+            resp = dt.recv_frame(sock)
+        finally:
+            sock.close()
+        path = resp.get("domain_socket") or ""
+        if not path:
+            raise ShortCircuitUnavailable(
+                resp.get("em", "DN offers no domain socket"))
+        self._socket_paths[dn.uuid] = path
+        return path
+
+    def _request_fds(self, path: str, block: Block,
+                     token: Optional[Dict]) -> _Slot:
+        """REQUEST_FDS over AF_UNIX; fds arrive via SCM_RIGHTS."""
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        fds: list = []
+        try:
+            sock.settimeout(10.0)
+            try:
+                sock.connect(path)
+            except OSError as e:
+                raise ShortCircuitUnavailable(
+                    f"domain socket {path}: {e}") from e
+            req = {"b": block.to_wire()}
+            if token is not None:
+                req["tok"] = token
+            frame = pack(req)
+            sock.sendall(struct.pack(">I", len(frame)) + frame)
+            # the reply frame and the fds ride one sendmsg; drain until
+            # the full length-prefixed frame is in hand
+            buf = bytearray()
+            while len(buf) < 4:
+                chunk, newfds, _, _ = socket.recv_fds(sock, 1 << 16, 2)
+                if not chunk and not newfds:
+                    raise ShortCircuitUnavailable("DN closed fd channel")
+                fds.extend(newfds)
+                buf += chunk
+            (flen,) = struct.unpack_from(">I", buf)
+            while len(buf) < 4 + flen:
+                chunk, newfds, _, _ = socket.recv_fds(sock, 1 << 16, 2)
+                if not chunk and not newfds:
+                    break
+                fds.extend(newfds)
+                buf += chunk
+            resp = unpack(bytes(buf[4:4 + flen]))
+            if not resp.get("ok"):
+                raise ShortCircuitUnavailable(resp.get("em", "refused"))
+            if len(fds) != 2:
+                raise ShortCircuitUnavailable(
+                    f"expected 2 fds, got {len(fds)}")
+            slot = _Slot(fds[0], fds[1], resp["bpc"], resp["visible"])
+            fds = []  # ownership moved into the slot
+            return slot
+        finally:
+            for fd in fds:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+            sock.close()
+
+    def _slot_for(self, dn: DatanodeInfo, block: Block,
+                  token: Optional[Dict], template: str) -> _Slot:
         # keyed per REPLICA (dn included): every same-host DN holds its own
         # copy, and a corrupt copy must not shadow the healthy ones
         key = (dn.uuid, block.block_id, block.gen_stamp)
@@ -89,26 +191,46 @@ class ShortCircuitCache:
             slot = self._slots.get(key)
             if slot is not None:
                 self._slots.move_to_end(key)
+                self._acquire_locked(slot)
         if slot is not None:
             return slot
         self.requests += 1
-        sock = dt.connect(dn.xfer_addr(), timeout=10.0)
         try:
-            dt.send_frame(sock, {"op": dt.OP_SHORT_CIRCUIT,
-                                 "b": block.to_wire()})
-            resp = dt.recv_frame(sock)
-        finally:
-            sock.close()
-        if not resp.get("ok"):
-            raise ShortCircuitUnavailable(resp.get("em", "refused"))
-        slot = _Slot(resp["data_path"], resp["meta_path"], resp["bpc"],
-                     resp["visible"])
+            path = self._socket_path(dn, template)
+            slot = self._request_fds(path, block, token)
+        except ShortCircuitUnavailable:
+            # a discovered socket path may be stale (DN restarted onto a
+            # new path) — drop it so the next attempt rediscovers
+            # instead of paying a failing connect forever
+            self._socket_paths.pop(dn.uuid, None)
+            raise
         with self._lock:
-            self._slots[key] = slot
+            have = self._slots.get(key)
+            if have is not None:
+                # lost a race: keep the existing slot, drop ours
+                self._retire_locked(slot)
+                slot = have
+            else:
+                self._slots[key] = slot
             self._slots.move_to_end(key)
+            self._acquire_locked(slot)
             while len(self._slots) > self.MAX_SLOTS:
-                self._slots.popitem(last=False)
+                self._retire_locked(self._slots.popitem(last=False)[1])
         return slot
+
+    def _acquire_locked(self, slot: _Slot) -> None:
+        slot.refs += 1
+
+    def _release(self, slot: _Slot) -> None:
+        with self._lock:
+            slot.refs -= 1
+            if slot.dead and slot.refs == 0:
+                slot._close_now()
+
+    def _retire_locked(self, slot: _Slot) -> None:
+        slot.dead = True
+        if slot.refs == 0:
+            slot._close_now()
 
     def invalidate(self, block: Block, dn: Optional[DatanodeInfo] = None
                    ) -> None:
@@ -117,43 +239,44 @@ class ShortCircuitCache:
                         if k[1] == block.block_id
                         and k[2] == block.gen_stamp
                         and (dn is None or k[0] == dn.uuid)]:
-                del self._slots[key]
+                self._retire_locked(self._slots.pop(key))
 
     # ---------------------------------------------------------------- read
 
     META_HEADER = 4 + 8 + DataChecksum.HEADER_LEN
 
     def read(self, dn: DatanodeInfo, block: Block, offset: int,
-             want: int) -> bytes:
+             want: int, token: Optional[Dict] = None,
+             socket_template: str = "") -> bytes:
         """Read [offset, offset+want) of a local replica, CRC-verified.
         Raises ShortCircuitUnavailable to punt to the TCP reader; raises
         ChecksumError (like the remote path) on real corruption."""
-        slot = self._slot_for(dn, block)
+        slot = self._slot_for(dn, block, token, socket_template)
         try:
-            bpc = slot.bpc
-            avail = min(want, slot.visible - offset)
-            if avail <= 0:
-                return b""
-            # chunk-align both edges: stored CRCs cover whole chunks
-            start = (offset // bpc) * bpc
-            end = min(slot.visible,
-                      (offset + avail + bpc - 1) // bpc * bpc)
-            with open(slot.data_path, "rb") as df:
-                df.seek(start)
-                data = df.read(end - start)
-            first_chunk = start // bpc
-            n_chunks = (len(data) + bpc - 1) // bpc
-            with open(slot.meta_path, "rb") as mf:
-                mf.seek(self.META_HEADER + 4 * first_chunk)
-                sums = mf.read(4 * n_chunks)
-        except OSError as e:
-            # replica moved/deleted under us — forget it, use TCP
-            self.invalidate(block, dn)
-            raise ShortCircuitUnavailable(str(e)) from e
-        try:
-            DataChecksum(bpc).verify(data, sums, base_pos=start)
-        except Exception:
-            self.invalidate(block, dn)  # corrupt copy: never re-serve it
-            raise
-        self.hits += 1
-        return data[offset - start:offset - start + avail]
+            try:
+                bpc = slot.bpc
+                avail = min(want, slot.visible - offset)
+                if avail <= 0:
+                    return b""
+                # chunk-align both edges: stored CRCs cover whole chunks
+                start = (offset // bpc) * bpc
+                end = min(slot.visible,
+                          (offset + avail + bpc - 1) // bpc * bpc)
+                data = os.pread(slot.data_fd, end - start, start)
+                first_chunk = start // bpc
+                n_chunks = (len(data) + bpc - 1) // bpc
+                sums = os.pread(slot.meta_fd, 4 * n_chunks,
+                                self.META_HEADER + 4 * first_chunk)
+            except OSError as e:
+                # fd went bad under us — forget it, use TCP
+                self.invalidate(block, dn)
+                raise ShortCircuitUnavailable(str(e)) from e
+            try:
+                DataChecksum(bpc).verify(data, sums, base_pos=start)
+            except Exception:
+                self.invalidate(block, dn)  # corrupt copy: never re-serve
+                raise
+            self.hits += 1
+            return data[offset - start:offset - start + avail]
+        finally:
+            self._release(slot)

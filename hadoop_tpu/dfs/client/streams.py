@@ -171,7 +171,8 @@ class DFSOutputStream:
             if self._block_size is None:
                 self._block_size = self.client.block_size_for(self.path)
             try:
-                self._pipeline = _Pipeline(block, locs, self.checksum)
+                self._pipeline = _Pipeline(block, locs, self.checksum,
+                                           token=lb.token)
                 self._current = block
                 self._block_pos = 0
                 self._block_packets = []
@@ -268,7 +269,7 @@ class _Pipeline:
     ACK_TIMEOUT_S = 30.0
 
     def __init__(self, block: Block, locations: List[DatanodeInfo],
-                 checksum: DataChecksum):
+                 checksum: DataChecksum, token=None):
         if not locations:
             raise PipelineError("no locations for block")
         DFSClientFaultInjector.get().before_pipeline_setup(locations)
@@ -285,6 +286,7 @@ class _Pipeline:
                 "targets": [t.to_wire() for t in locations[1:]],
                 "stage": dt.STAGE_PIPELINE_SETUP_CREATE,
                 "bpc": checksum.bytes_per_chunk,
+                "tok": token,
             })
             setup = dt.recv_frame(self.sock)
             if not setup.get("ok"):
@@ -548,6 +550,15 @@ class DFSInputStream:
             elif not by_future:
                 raise IOError(f"all hedged reads failed: {errors}")
 
+    def _token_for(self, block: Block):
+        from hadoop_tpu.io import erasurecode as ecmod
+        bid = block.block_id
+        gid = ecmod.group_id_of(bid) if ecmod.is_striped_id(bid) else bid
+        for lb in self.blocks:
+            if lb.block.block_id in (bid, gid):
+                return lb.token
+        return None
+
     def _read_from_datanode(self, dn: DatanodeInfo, block: Block,
                             offset: int, want: int) -> bytes:
         """BlockReaderFactory seam (ref: BlockReaderFactory.java:354-381):
@@ -558,7 +569,11 @@ class DFSInputStream:
             cache = ShortCircuitCache.get()
             if cache.is_local(dn):
                 try:
-                    return cache.read(dn, block, offset, want)
+                    return cache.read(
+                        dn, block, offset, want,
+                        token=self._token_for(block),
+                        socket_template=self.client.conf.get(
+                            "dfs.domain.socket.path", ""))
                 except ShortCircuitUnavailable as e:
                     log.debug("short-circuit read of %s fell back: %s",
                               block, e)
@@ -569,6 +584,7 @@ class DFSInputStream:
         sock = dt.connect(dn.xfer_addr(), timeout=10.0)
         try:
             dt.send_frame(sock, {"op": dt.OP_READ_BLOCK, "b": block.to_wire(),
+                                 "tok": self._token_for(block),
                                  "offset": offset, "length": want})
             setup = dt.recv_frame(sock)
             if not setup.get("ok"):

@@ -39,7 +39,7 @@ class _UnitWriter:
     DN mid-block on large units."""
 
     def __init__(self, unit_block: Block, target: DatanodeInfo,
-                 checksum: DataChecksum):
+                 checksum: DataChecksum, token=None):
         self.block = unit_block
         self.target = target
         self.checksum = checksum
@@ -49,7 +49,7 @@ class _UnitWriter:
         dt.send_frame(self.sock, {
             "op": dt.OP_WRITE_BLOCK, "b": unit_block.to_wire(),
             "targets": [], "stage": dt.STAGE_PIPELINE_SETUP_CREATE,
-            "bpc": checksum.bytes_per_chunk,
+            "bpc": checksum.bytes_per_chunk, "tok": token,
         })
         setup = dt.recv_frame(self.sock)
         if not setup.get("ok"):
@@ -175,7 +175,8 @@ class DFSStripedOutputStream:
             unit = Block(lb.block.block_id + i, lb.block.gen_stamp, 0)
             try:
                 self._writers.append(
-                    _UnitWriter(unit, target, self.checksum))
+                    _UnitWriter(unit, target, self.checksum,
+                                token=lb.token))
             except (OSError, IOError) as e:
                 log.warning("unit %d writer setup failed: %s", i, e)
                 self._writers.append(None)
@@ -297,6 +298,17 @@ class DFSStripedInputStream:
                 return lb
         raise EOFError(f"offset {pos} beyond file length {self.length}")
 
+    def _token_for(self, block: Block):
+        """Unit block → its GROUP's access token (the NN mints one per
+        group; see xceiver's striped-id token resolution)."""
+        from hadoop_tpu.io import erasurecode as ecmod
+        bid = block.block_id
+        gid = ecmod.group_id_of(bid) if ecmod.is_striped_id(bid) else bid
+        for lb in self.blocks:
+            if lb.block.block_id in (bid, gid):
+                return lb.token
+        return None
+
     def _fetch(self, pos: int, want: int) -> bytes:
         """Read up to ``want`` bytes at ``pos``, capped to one cell."""
         lb = self._group_for(pos)
@@ -335,7 +347,8 @@ class DFSStripedInputStream:
         unit_len = ec.unit_length(lb.block.num_bytes, policy, idx)
         unit = Block(lb.block.block_id + idx, lb.block.gen_stamp, unit_len)
         return dt.read_block_range(loc.xfer_addr(), unit.to_wire(), offset,
-                                   min(length, unit_len - offset))
+                                   min(length, unit_len - offset),
+                                   token=self._token_for(unit))
 
     def _decode_fetch(self, lb: LocatedBlock, policy: ec.ECPolicy,
                       stripe: int, col: int, in_cell: int,

@@ -146,6 +146,19 @@ class DataNode(AbstractService):
             security_keys=security_keys,
             required_qop=conf.get("dfs.data.transfer.protection",
                                   "privacy"))
+        # Block access tokens: verification-only manager, keys arrive
+        # from the NN over DatanodeProtocol.get_block_keys (ref:
+        # ExportedBlockKeys at registration + rotation refresh).
+        self.block_tokens = None
+        if conf.get_bool("dfs.block.access.token.enable", False):
+            from hadoop_tpu.dfs.protocol.blocktoken import \
+                BlockTokenSecretManager
+            self.block_tokens = BlockTokenSecretManager.for_verification()
+            self.xceiver.block_tokens = self.block_tokens
+        # fd-passing short-circuit server (ref: dfs.domain.socket.path
+        # with _PORT placeholder; DataXceiver.requestShortCircuitFds)
+        self.domain_server = None
+        self._domain_template = conf.get("dfs.domain.socket.path", "")
         self.heartbeat_interval = conf.get_time_seconds(
             "dfs.heartbeat.interval", 3.0)
         self.block_report_interval = conf.get_time_seconds(
@@ -164,6 +177,21 @@ class DataNode(AbstractService):
 
     def service_start(self) -> None:
         self.xceiver.start()
+        if self._domain_template:
+            from hadoop_tpu.dfs.datanode.domainsocket import (
+                DomainPeerServer, socket_path_for)
+            checker = None
+            if self.block_tokens is not None:
+                from hadoop_tpu.dfs.protocol import blocktoken as bt
+
+                def checker(req, block):
+                    self.block_tokens.check_access(
+                        req.get("tok"), block.block_id, bt.MODE_READ)
+            self.domain_server = DomainPeerServer(
+                socket_path_for(self._domain_template, self.xceiver.port),
+                self.store.open_for_read, token_checker=checker)
+            self.domain_server.start()
+            self.xceiver.domain_socket_path = self.domain_server.path
         self.http = None
         if self.config.get_bool("dfs.datanode.http.enabled", True):
             from hadoop_tpu.http import HttpServer
@@ -194,6 +222,8 @@ class DataNode(AbstractService):
         self._stop_event.set()
         if getattr(self, "http", None) is not None:
             self.http.stop()
+        if getattr(self, "domain_server", None) is not None:
+            self.domain_server.stop()
         if self.xceiver:
             self.xceiver.stop()
         if self._client:
@@ -353,7 +383,8 @@ class DataNode(AbstractService):
         """Ref: ErasureCodingWorker.processErasureCodingTasks."""
         from hadoop_tpu.dfs.datanode import ec_worker
         rebuilt = ec_worker.reconstruct(
-            self.store, payload, security=self.xceiver._dial_security())
+            self.store, payload, security=self.xceiver._dial_security(),
+            block_tokens=self.block_tokens)
         if rebuilt is not None:
             self._on_block_received(rebuilt)
 
@@ -364,6 +395,7 @@ class DataNode(AbstractService):
                 log.warning("asked to transfer %s but replica not found", block)
                 return
             push_block(self.store, rep.to_block(), targets,
+                       block_tokens=self.block_tokens,
                        security=self.xceiver._dial_security())
             log.info("Transferred %s to %s", block, targets)
         except Exception as e:  # noqa: BLE001
@@ -422,6 +454,9 @@ class _BPServiceActor:
                     if dn.xceiver.security_keys is not None:
                         dn.xceiver.security_keys.update(
                             self._proxy.get_data_encryption_keys())
+                    if dn.block_tokens is not None:
+                        dn.block_tokens.import_keys(
+                            self._proxy.get_block_keys())
                     registered = True
                     self._send_full_report()
                     last_full_report = _time.monotonic()
@@ -443,6 +478,9 @@ class _BPServiceActor:
                         # expired keys.
                         dn.xceiver.security_keys.update(
                             self._proxy.get_data_encryption_keys())
+                    if dn.block_tokens is not None:
+                        dn.block_tokens.import_keys(
+                            self._proxy.get_block_keys())
                     last_full_report = _time.monotonic()
             except Exception as e:  # noqa: BLE001 — survive NN bounces
                 log.debug("heartbeat round to %s failed (%s); will retry",

@@ -28,13 +28,21 @@ SPAN_CELLS = 64  # cells fetched per source round (64 × 64 KB = 4 MB)
 
 
 def fetch_range(addr: Tuple[str, int], block: Block, offset: int,
-                length: int, security=None) -> bytes:
-    """Read [offset, offset+length) of a remote replica (OP_READ_BLOCK)."""
+                length: int, security=None, block_tokens=None) -> bytes:
+    """Read [offset, offset+length) of a remote replica (OP_READ_BLOCK).
+    A reconstructing DN mints its own READ token from the shared keys
+    (ref: the reconstruction worker's datanode-issued tokens)."""
+    token = None
+    if block_tokens is not None:
+        from hadoop_tpu.dfs.protocol import blocktoken as bt
+        token = block_tokens.generate_token("datanode", block.block_id,
+                                            (bt.MODE_READ,))
     return dt.read_block_range(addr, block.to_wire(), offset, length,
-                               security=security)
+                               security=security, token=token)
 
 
-def reconstruct(store, payload: Dict, security=None) -> Optional[Block]:
+def reconstruct(store, payload: Dict, security=None,
+                block_tokens=None) -> Optional[Block]:
     """Execute one EC_RECONSTRUCT command; returns the rebuilt unit block
     (for the incremental report) or None on failure."""
     group = Block.from_wire(payload["group"])
@@ -73,7 +81,8 @@ def reconstruct(store, payload: Dict, security=None) -> Optional[Block]:
                 blk = Block(group.block_id + idx, group.gen_stamp, src_len)
                 try:
                     raw = fetch_range(by_idx[idx].xfer_addr(), blk, off,
-                                      want, security=security)
+                                      want, security=security,
+                                      block_tokens=block_tokens)
                 except (OSError, EOFError, IOError) as e:
                     log.warning("EC source unit %d unreadable: %s", idx, e)
                     continue

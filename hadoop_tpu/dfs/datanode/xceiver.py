@@ -50,6 +50,8 @@ class DataXceiverServer:
         # the DataNode once it has an NN proxy; ref: ProvidedVolumeImpl
         # reading through the alias map). Cache hits avoid per-read RPCs.
         self.alias_resolver = None
+        self.domain_socket_path = None     # set by the owning DataNode
+        self.block_tokens = None           # set by the owning DataNode
         self._alias_cache: dict = {}       # block id → (alias, expiry)
         self.ALIAS_CACHE_TTL = 60.0
         self.ALIAS_CACHE_MAX = 4096
@@ -126,6 +128,36 @@ class DataXceiverServer:
                     return
             req = dt.recv_frame(sock)
             op = req.get("op")
+            # Block access tokens gate EVERY op that names a block (ref:
+            # DataXceiver.checkAccess before readBlock/writeBlock/
+            # copyBlock) — not just the short-circuit grant, or the TCP
+            # fallback would bypass the whole scheme.
+            if self.block_tokens is not None and op in (
+                    dt.OP_WRITE_BLOCK, dt.OP_READ_BLOCK,
+                    dt.OP_TRANSFER_BLOCK):
+                from hadoop_tpu.dfs.protocol import blocktoken as bt
+                from hadoop_tpu.security.ugi import AccessControlError
+                mode = {dt.OP_READ_BLOCK: bt.MODE_READ,
+                        dt.OP_WRITE_BLOCK: bt.MODE_WRITE,
+                        dt.OP_TRANSFER_BLOCK: bt.MODE_COPY}[op]
+                bid = Block.from_wire(req["b"]).block_id
+                try:
+                    try:
+                        self.block_tokens.check_access(req.get("tok"),
+                                                       bid, mode)
+                    except AccessControlError:
+                        # striped units carry unit ids; the NN mints one
+                        # token per GROUP (ref: LocatedStripedBlock's
+                        # per-group token semantics here)
+                        from hadoop_tpu.io import erasurecode as ecmod
+                        if not ecmod.is_striped_id(bid):
+                            raise
+                        self.block_tokens.check_access(
+                            req.get("tok"), ecmod.group_id_of(bid), mode)
+                except AccessControlError as e:
+                    dt.send_frame(sock, {"ok": False, "em": str(e),
+                                         "denied": True})
+                    return
             if op == dt.OP_WRITE_BLOCK:
                 self._write_block(sock, req)
             elif op == dt.OP_READ_BLOCK:
@@ -301,7 +333,8 @@ class DataXceiverServer:
         targets = [DatanodeInfo.from_wire(t) for t in req.get("targets", [])]
         try:
             push_block(self.store, block, targets,
-                       security=self._dial_security())
+                       security=self._dial_security(),
+                       block_tokens=self.block_tokens)
         except (OSError, IOError) as e:
             dt.send_frame(sock, {"ok": False, "em": str(e)})
             return
@@ -310,21 +343,23 @@ class DataXceiverServer:
     # -------------------------------------------------------------- reading
 
     def _short_circuit(self, sock: socket.socket, req: dict) -> None:
-        """Hand a same-host client the replica's file layout so it reads
-        the block file directly (ref: DataXceiver.requestShortCircuitFds —
-        paths instead of passed fds; see client/shortcircuit.py)."""
-        block = Block.from_wire(req["b"])
-        try:
-            data_path, meta_path, checksum, visible = \
-                self.store.open_for_read(block)
-        except IOError as e:
-            dt.send_frame(sock, {"ok": False, "em": str(e)})
-            return
-        self._m_short_circuit.incr()
-        dt.send_frame(sock, {
-            "ok": True, "data_path": data_path, "meta_path": meta_path,
-            "bpc": checksum.bytes_per_chunk, "visible": visible,
-        })
+        """Short-circuit DISCOVERY only: point the client at the DN's
+        AF_UNIX fd-passing socket (ref: DataXceiver.requestShortCircuitFds
+        + dfs.domain.socket.path). The old path handoff is gone — a
+        client that must authenticate to read over TCP could previously
+        open any local replica by path; now possession of the replica
+        requires the SCM_RIGHTS grant, which checks the block token
+        (see datanode/domainsocket.py)."""
+        path = self.domain_socket_path
+        if path:
+            self._m_short_circuit.incr()
+            dt.send_frame(sock, {"ok": False, "domain_socket": path,
+                                 "em": "use the domain socket for fds"})
+        else:
+            dt.send_frame(sock, {
+                "ok": False,
+                "em": "short-circuit path handoff removed; enable "
+                      "dfs.domain.socket.path for fd-passing grants"})
 
     def _read_block(self, sock: socket.socket, req: dict) -> None:
         """Ref: BlockSender.java — chunk-aligned stream with stored sums."""
@@ -422,19 +457,25 @@ def _alias_path(uri: str) -> str:
 
 def push_block(store: BlockStore, block: Block,
                targets: List[DatanodeInfo],
-               security=None) -> None:
+               security=None, block_tokens=None) -> None:
     """Re-replication push: stream a local finalized replica into a pipeline
     of targets. Ref: DataNode.DataTransfer (new Sender().writeBlock for
-    TRANSFER stage)."""
+    TRANSFER stage; it mints its own token via the DN's shared keys —
+    blockTokenSecretManager.generateToken in DataNode.transferBlock)."""
     if not targets:
         return
+    req = {
+        "op": dt.OP_WRITE_BLOCK, "b": block.to_wire(),
+        "targets": [t.to_wire() for t in targets[1:]],
+        "stage": dt.STAGE_TRANSFER, "bpc": dt.CHUNK_SIZE,
+    }
+    if block_tokens is not None:
+        from hadoop_tpu.dfs.protocol import blocktoken as bt
+        req["tok"] = block_tokens.generate_token(
+            "datanode", block.block_id, (bt.MODE_WRITE,))
     sock = dt.connect(targets[0].xfer_addr(), security=security)
     try:
-        dt.send_frame(sock, {
-            "op": dt.OP_WRITE_BLOCK, "b": block.to_wire(),
-            "targets": [t.to_wire() for t in targets[1:]],
-            "stage": dt.STAGE_TRANSFER, "bpc": dt.CHUNK_SIZE,
-        })
+        dt.send_frame(sock, req)
         setup = dt.recv_frame(sock)
         if not setup.get("ok"):
             if setup.get("already"):

@@ -116,6 +116,15 @@ class FSNamesystem:
             from hadoop_tpu.dfs.protocol.datatransfer import \
                 DataEncryptionKeys
             self.data_encryption_keys = DataEncryptionKeys()
+        # Block access tokens (ref: dfs.block.access.token.enable +
+        # BlockTokenSecretManager.java:66): minted into every
+        # LocatedBlock, verified by DNs on every data-plane op that
+        # names a block — including fd-passing short-circuit grants.
+        self.block_tokens = None
+        if conf.get_bool("dfs.block.access.token.enable", False):
+            from hadoop_tpu.dfs.protocol.blocktoken import \
+                BlockTokenSecretManager
+            self.block_tokens = BlockTokenSecretManager()
         # PROVIDED storage alias map (ref: hdfs server/aliasmap/
         # InMemoryAliasMap.java + common/blockaliasmap/ — block id →
         # location in an external store; DNs resolve provided reads
@@ -440,6 +449,12 @@ class FSNamesystem:
                 inode.blocks.append(block)
                 txid = self.editlog.log_edit(el.OP_ADD_BLOCK, {
                     "p": path, "b": block.to_wire()})
+            if self.block_tokens is not None:
+                # the writer needs WRITE (pipeline) + READ (verify/reopen)
+                from hadoop_tpu.dfs.protocol import blocktoken as bt
+                lb.token = self.block_tokens.generate_token(
+                    client_name, block.block_id,
+                    (bt.MODE_READ, bt.MODE_WRITE))
             self.editlog.log_sync(txid)
             return lb
 
@@ -722,6 +737,12 @@ class FSNamesystem:
                             blocks.append(self.bm.located_block(
                                 b, pos, reader_host=reader_host))
                     pos += b.num_bytes
+                if self.block_tokens is not None:
+                    from hadoop_tpu.dfs.protocol import blocktoken as bt
+                    user = current_user().user_name
+                    for lb in blocks:
+                        lb.token = self.block_tokens.generate_token(
+                            user, lb.block.block_id, (bt.MODE_READ,))
                 return {
                     "length": inode.length(),
                     "blocks": [lb.to_wire() for lb in blocks],

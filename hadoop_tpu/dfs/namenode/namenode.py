@@ -462,6 +462,18 @@ class DatanodeProtocol:
         return dek.all_wire()
 
     @idempotent
+    def get_block_keys(self) -> List[Dict]:
+        """Block-token master keys for a verifying DN (ref:
+        DatanodeProtocol handing ExportedBlockKeys at registration and
+        on rotation). Same channel gate as DEKs: these keys ARE the
+        data-plane authorization secret."""
+        bt = self.fsn.block_tokens
+        if bt is None:
+            return []
+        _check_dek_channel(self.fsn)
+        return bt.export_keys()
+
+    @idempotent
     def send_heartbeat(self, uuid: str, capacity: int, dfs_used: int,
                        remaining: int, xceivers: int = 0):
         # Standby/observer track liveness but never command DNs — queued

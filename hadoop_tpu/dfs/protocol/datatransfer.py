@@ -237,7 +237,7 @@ def secure_accept(sock, keys: DataEncryptionKeys, required_qop: str):
 
 
 def read_block_range(addr, block_wire: Dict, offset: int,
-                     length: int, security=None) -> bytes:
+                     length: int, security=None, token=None) -> bytes:
     """Read [offset, offset+length) of one replica over OP_READ_BLOCK,
     verifying checksums. The shared client of BlockSender — used by the
     striped reader, the EC reconstruction worker, and the balancer
@@ -248,7 +248,8 @@ def read_block_range(addr, block_wire: Dict, offset: int,
     sock = connect(addr, timeout=10.0, security=security)
     try:
         send_frame(sock, {"op": OP_READ_BLOCK, "b": block_wire,
-                          "offset": offset, "length": length})
+                          "offset": offset, "length": length,
+                          "tok": token})
         setup = recv_frame(sock)
         if not setup.get("ok"):
             raise IOError(setup.get("em", "read setup failed"))

@@ -192,19 +192,23 @@ class LocatedBlock:
     ``indices[i]`` is the storage-unit index served by ``locations[i]``."""
 
     __slots__ = ("block", "locations", "offset", "corrupt", "ec_policy",
-                 "indices", "cached_uuids")
+                 "indices", "cached_uuids", "token")
 
     def __init__(self, block: Block, locations: List[DatanodeInfo],
                  offset: int = 0, corrupt: bool = False,
                  ec_policy: Optional[str] = None,
                  indices: Optional[List[int]] = None,
-                 cached_uuids: Optional[List[str]] = None):
+                 cached_uuids: Optional[List[str]] = None,
+                 token: Optional[Dict] = None):
         self.block = block
         self.locations = locations
         self.offset = offset
         self.corrupt = corrupt
         self.ec_policy = ec_policy
         self.indices = indices
+        # block access token (ref: LocatedBlock.blockToken) — minted by
+        # the NN when dfs.block.access.token.enable is on
+        self.token = token
         # replicas pinned in DN memory (ref: LocatedBlock's
         # cachedLocations) — readers prefer these
         self.cached_uuids = cached_uuids or []
@@ -218,6 +222,8 @@ class LocatedBlock:
             d["idx"] = self.indices
         if self.cached_uuids:
             d["cach"] = self.cached_uuids
+        if self.token is not None:
+            d["tok"] = self.token
         return d
 
     @classmethod
@@ -225,7 +231,8 @@ class LocatedBlock:
         return cls(Block.from_wire(d["b"]),
                    [DatanodeInfo.from_wire(x) for x in d["locs"]],
                    d.get("off", 0), d.get("cor", False),
-                   d.get("ec"), d.get("idx"), d.get("cach"))
+                   d.get("ec"), d.get("idx"), d.get("cach"),
+                   d.get("tok"))
 
 
 class FileStatus:

@@ -192,6 +192,13 @@ class MiniDFSCluster:
                  base_dir: Optional[str] = None,
                  storage_types: Optional[List[str]] = None):
         self.conf = fast_conf(conf)
+        # fd-passing short-circuit on by default, like the reference's
+        # MiniDFSCluster with domain sockets. Path lives under /tmp, NOT
+        # base_dir: AF_UNIX paths cap at ~107 bytes and pytest tmp
+        # paths routinely blow that.
+        self.conf.set_if_unset(
+            "dfs.domain.socket.path",
+            f"/tmp/htpu-ds-{os.getpid()}-_PORT.sock")
         self.base_dir = base_dir or tempfile.mkdtemp(prefix="htpu-minidfs-")
         self._owns_dir = base_dir is None
         self.num_datanodes = num_datanodes

@@ -307,10 +307,12 @@ def test_short_circuit_fallback_when_replica_moved(cluster, fs):
     cache = scmod.ShortCircuitCache.get()
     with fs.open("/sc2.bin") as f:
         assert f.read(10) == data[:10]
-    # poison every cached slot's data path; next read must still succeed
+    # poison every cached slot's data fd; next read must still succeed
+    import os as _os
     with cache._lock:
         for slot in cache._slots.values():
-            slot.data_path = slot.data_path + ".gone"
+            _os.close(slot.data_fd)
+            slot.data_fd = -1  # EBADF on pread; close() is a no-op
     with fs.open("/sc2.bin") as f:
         assert f.read() == data
 
@@ -327,3 +329,36 @@ def test_unaligned_flush_mid_write(cluster, fs):
         out.flush()
         out.write(c)
     assert fs.read_all("/unaligned_flush.bin") == a + b + c
+
+
+def test_short_circuit_fds_survive_dn_restart(cluster, fs):
+    """A cached fd grant outlives the granting DN: finalized block bytes
+    at a genstamp are immutable, so the open descriptors stay correct
+    across a DN restart (the reference's slot invalidation exists to
+    reclaim space, not for correctness) — and after the restart, NEW
+    grants flow through the recreated domain socket."""
+    from hadoop_tpu.dfs.client.shortcircuit import ShortCircuitCache
+    data = os.urandom(500_000)
+    with fs.create("/scr.bin") as out:
+        out.write(data)
+    cache = ShortCircuitCache.get()
+    hits0 = cache.hits
+    with fs.open("/scr.bin") as f:
+        assert f.read() == data        # populate fd slots
+    assert cache.hits > hits0
+
+    cluster.restart_datanode(0)
+    cluster.wait_active()
+
+    # cached fds still serve the immutable bytes
+    hits1 = cache.hits
+    with fs.open("/scr.bin") as f:
+        assert f.read() == data
+    assert cache.hits > hits1
+
+    # and a fresh file gets NEW grants via the recreated socket
+    data2 = os.urandom(100_000)
+    fs.write_all("/scr2.bin", data2)
+    reqs = cache.requests
+    assert fs.read_all("/scr2.bin") == data2
+    assert cache.requests > reqs

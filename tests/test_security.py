@@ -536,3 +536,106 @@ def test_rpc_integrity_end_to_end(kdc, tmp_path):
             client.stop()
     finally:
         server.stop()
+
+
+# --------------------------------------------- block tokens + fd short-circuit
+
+def test_short_circuit_fds_gated_on_block_token(tmp_path):
+    """dfs.block.access.token.enable=true: the DN's AF_UNIX fd server
+    refuses a request without (or with a forged) block token, and the
+    normal client path — which carries the NN-minted token from
+    LocatedBlock — works (ref: BlockTokenSecretManager.checkAccess
+    gating requestShortCircuitFds; VERDICT r4 #4)."""
+    import os as _os
+
+    from hadoop_tpu.dfs.client.shortcircuit import (ShortCircuitCache,
+                                                    ShortCircuitUnavailable)
+    from hadoop_tpu.dfs.protocol.blocktoken import BlockTokenSecretManager
+    from hadoop_tpu.dfs.protocol.records import Block
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster, fast_conf
+
+    conf = fast_conf()
+    conf.set("dfs.replication", "1")
+    conf.set("dfs.block.access.token.enable", "true")
+    with MiniDFSCluster(num_datanodes=1, conf=conf,
+                        base_dir=str(tmp_path)) as cluster:
+        cluster.wait_active()
+        fs = cluster.get_filesystem()
+        payload = _os.urandom(300_000)
+        fs.write_all("/tok.bin", payload)
+        cache = ShortCircuitCache.get()
+        hits0 = cache.hits
+        assert fs.read_all("/tok.bin") == payload   # tokened path works
+        assert cache.hits > hits0
+
+        locs = fs.client.get_block_locations("/tok.bin")
+        blk = Block.from_wire(locs["blocks"][0]["b"])
+        dn = cluster.datanodes[0]
+        sock_path = dn.domain_server.path
+
+        # no token → refused
+        with pytest.raises(ShortCircuitUnavailable, match="token"):
+            cache._request_fds(sock_path, blk, None)
+        # forged token (wrong key) → refused
+        forged = BlockTokenSecretManager().generate_token(
+            "mallory", blk.block_id)
+        with pytest.raises(ShortCircuitUnavailable,
+                           match="key|signature|token"):
+            cache._request_fds(sock_path, blk, forged)
+        # token for a DIFFERENT block → refused
+        other = locs["blocks"][0].get("tok")
+        assert other is not None
+        wrong_block = Block(blk.block_id + 999, blk.gen_stamp, 1)
+        with pytest.raises(ShortCircuitUnavailable, match="block"):
+            cache._request_fds(sock_path, wrong_block, other)
+
+
+def test_block_tokens_gate_tcp_data_plane(tmp_path):
+    """The TCP path enforces tokens too — otherwise the fd gate would be
+    bypassed by the client's automatic TCP fallback (review finding):
+    a bare OP_READ_BLOCK without a token is refused."""
+    import os as _os
+
+    from hadoop_tpu.dfs.protocol import datatransfer as dt
+    from hadoop_tpu.dfs.protocol.records import Block, DatanodeInfo
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster, fast_conf
+
+    conf = fast_conf()
+    conf.set("dfs.replication", "1")
+    conf.set("dfs.block.access.token.enable", "true")
+    with MiniDFSCluster(num_datanodes=1, conf=conf,
+                        base_dir=str(tmp_path)) as cluster:
+        cluster.wait_active()
+        fs = cluster.get_filesystem()
+        fs.write_all("/tcp-tok.bin", _os.urandom(50_000))
+        locs = fs.client.get_block_locations("/tcp-tok.bin")
+        blk = locs["blocks"][0]
+        dn = DatanodeInfo.from_wire(blk["locs"][0])
+        # no token → setup refused before any byte of data
+        with pytest.raises(IOError, match="token"):
+            dt.read_block_range(dn.xfer_addr(), blk["b"], 0, 1024)
+        # the NN-minted token unlocks the same op
+        data = dt.read_block_range(dn.xfer_addr(), blk["b"], 0, 1024,
+                                   token=blk["tok"])
+        assert len(data) == 1024
+
+
+def test_block_tokens_with_erasure_coding(tmp_path):
+    """Striped units carry unit ids but tokens name the group — the
+    DN-side resolution must let a group token read any unit, and EC
+    reconstruction (DN-minted tokens) must still heal."""
+    import os as _os
+
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster, fast_conf
+
+    conf = fast_conf()
+    conf.set("dfs.block.access.token.enable", "true")
+    with MiniDFSCluster(num_datanodes=5, conf=conf,
+                        base_dir=str(tmp_path)) as cluster:
+        cluster.wait_active()
+        fs = cluster.get_filesystem()
+        fs.mkdirs("/ec")
+        fs.client.set_ec_policy("/ec", "RS-3-2-64k")
+        payload = _os.urandom(500_000)
+        fs.write_all("/ec/tok.bin", payload)
+        assert fs.read_all("/ec/tok.bin") == payload
