@@ -46,12 +46,14 @@ class FederationStateStore:
         self._path = store_path
         self._subclusters: Dict[str, Dict] = {}
         self._homes: Dict[str, str] = {}       # app_id str → subcluster id
+        self._policies: Dict[str, Dict] = {}   # queue → policy config
         self._lock = threading.Lock()
         if store_path and os.path.exists(store_path):
             with open(store_path) as f:
                 data = json.load(f)
             self._subclusters = data.get("subclusters", {})
             self._homes = data.get("homes", {})
+            self._policies = data.get("policies", {})
 
     def _save_locked(self) -> None:
         if not self._path:
@@ -60,7 +62,8 @@ class FederationStateStore:
         tmp = self._path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"subclusters": self._subclusters,
-                       "homes": self._homes}, f)
+                       "homes": self._homes,
+                       "policies": self._policies}, f)
         os.replace(tmp, self._path)
 
     def register_subcluster(self, sc_id: str, rm_addr: str) -> None:
@@ -102,25 +105,202 @@ class FederationStateStore:
         with self._lock:
             return self._homes.get(app_id)
 
+    # policy table (ref: FederationPolicyStore — per-queue policy
+    # configurations the router's policy facade resolves)
 
-class _RouterClientProtocol:
-    """The router's ApplicationClientProtocol face (ref:
-    FederationClientInterceptor.java)."""
+    def set_policy(self, queue: str, policy: Dict) -> None:
+        with self._lock:
+            self._policies[queue] = dict(policy)
+            self._save_locked()
+
+    def policy_for(self, queue: str) -> Optional[Dict]:
+        with self._lock:
+            p = self._policies.get(queue)
+            return dict(p) if p is not None else None
+
+
+# ------------------------------------------------------------------ policies
+
+class RouterPolicy:
+    """Home-subcluster selection (ref: federation/policies/router/
+    *RouterPolicy.java). ``choose(active, queue)`` returns a subcluster
+    id from the ACTIVE map or raises IOError."""
+
+    def choose(self, active: Dict[str, Dict], queue: str) -> str:
+        raise NotImplementedError
+
+
+class UniformRandomPolicy(RouterPolicy):
+    """Ref: UniformRandomRouterPolicy."""
+
+    def choose(self, active, queue):
+        import random
+        return random.choice(sorted(active))
+
+
+class RoundRobinPolicy(RouterPolicy):
+    def __init__(self):
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    def choose(self, active, queue):
+        order = sorted(active)
+        with self._lock:
+            sc = order[self._rr % len(order)]
+            self._rr += 1
+        return sc
+
+
+class WeightedRandomPolicy(RouterPolicy):
+    """Per-subcluster weights, usually per queue (ref:
+    WeightedRandomRouterPolicy + the policy manager's per-queue
+    WeightedPolicyInfo). Unknown/zero-weight subclusters are skipped;
+    weights renormalize over whatever is ACTIVE."""
+
+    def __init__(self, weights: Dict[str, float]):
+        self.weights = {k: float(v) for k, v in weights.items()}
+
+    def choose(self, active, queue):
+        import random
+        cands = [(sc, self.weights.get(sc, 0.0)) for sc in sorted(active)]
+        total = sum(w for _, w in cands if w > 0)
+        if total <= 0:
+            raise IOError(f"no ACTIVE subcluster with weight for {queue!r}")
+        r = random.random() * total
+        acc = 0.0
+        for sc, w in cands:
+            if w <= 0:
+                continue
+            acc += w
+            if r <= acc:
+                return sc
+        return cands[-1][0]
+
+
+class LoadBasedPolicy(RouterPolicy):
+    """Fewest running apps wins (ref: LoadBasedRouterPolicy)."""
 
     def __init__(self, router: "YarnRouter"):
         self.router = router
 
+    def choose(self, active, queue):
+        best, best_load = None, float("inf")
+        for sc_id in sorted(active):
+            try:
+                m = self.router.rm_proxy(sc_id).get_cluster_metrics()
+                load = m.get("apps", 0)
+            except (OSError, IOError):
+                continue
+            if load < best_load:
+                best, best_load = sc_id, load
+        if best is None:
+            raise IOError("no reachable ACTIVE subcluster")
+        return best
+
+
+class RejectPolicy(RouterPolicy):
+    """Ref: RejectRouterPolicy — a queue administratively closed."""
+
+    def choose(self, active, queue):
+        raise IOError(f"queue {queue!r} rejects new applications")
+
+
+def make_policy(wire: Dict, router: "YarnRouter") -> RouterPolicy:
+    kind = (wire or {}).get("type", "load")
+    if kind in ("uniform", "random"):
+        return UniformRandomPolicy()
+    if kind == "round-robin":
+        return RoundRobinPolicy()
+    if kind == "weighted":
+        return WeightedRandomPolicy(wire.get("weights", {}))
+    if kind == "reject":
+        return RejectPolicy()
+    return LoadBasedPolicy(router)
+
+
+# -------------------------------------------------------------- interceptors
+
+class ClientInterceptor:
+    """One link of the router's client-RM interceptor chain (ref:
+    router/clientrm/AbstractClientRequestInterceptor.java — Router.java
+    builds the pipeline from conf). Unhandled methods flow to the next
+    link via ``__getattr__``, so a link only implements what it
+    intercepts."""
+
+    def __init__(self, router: "YarnRouter"):
+        self.router = router
+        self.next: Optional["ClientInterceptor"] = None
+
+    def set_next(self, nxt: "ClientInterceptor") -> "ClientInterceptor":
+        self.next = nxt
+        return nxt
+
+    def __getattr__(self, name):
+        nxt = object.__getattribute__(self, "__dict__").get("next")
+        if nxt is None or name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(nxt, name)
+
+
+class RouterAuditInterceptor(ClientInterceptor):
+    """Counts + audit-logs every client call before passing it on (ref:
+    RouterAuditLogger + the metrics the router keeps per method)."""
+
+    def __init__(self, router):
+        super().__init__(router)
+        self.counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def __getattr__(self, name):
+        target = super().__getattr__(name)
+        if not callable(target):
+            return target
+
+        def wrapped(*a, **kw):
+            with self._lock:
+                self.counts[name] = self.counts.get(name, 0) + 1
+            log.debug("router audit: %s", name)
+            return target(*a, **kw)
+        return wrapped
+
+
+class FederationClientInterceptor(ClientInterceptor):
+    """Terminal link: the actual federated routing (ref:
+    clientrm/FederationClientInterceptor.java).
+
+    Failure semantics: a subcluster whose RM stops answering is marked
+    LOST by the liveness loop AND eagerly here on first failure, so new
+    applications immediately route around it (the reference's
+    submitApplication retry loop over the policy does the same);
+    aggregate reads skip unreachable members. Per-app calls follow the
+    home mapping — the home RM restarting with work-preserving recovery
+    resumes them (AM spanning via AMRMProxy/UAMs is out of scope)."""
+
+    SUBMIT_RETRIES = 3
+
     def get_new_application(self) -> Dict:
-        sc_id = self.router.choose_subcluster()
-        out = self.router.rm_proxy(sc_id).get_new_application()
-        app_id = str(ApplicationId.from_wire(out["app_id"]))
-        self.router.store.set_home(app_id, sc_id)
-        return out
+        last: Optional[Exception] = None
+        for _ in range(self.SUBMIT_RETRIES):
+            sc_id = self.router.choose_subcluster()
+            try:
+                out = self.router.rm_proxy(sc_id).get_new_application()
+            except (OSError, IOError) as e:
+                last = e
+                self.router.mark_lost(sc_id)
+                continue
+            app_id = str(ApplicationId.from_wire(out["app_id"]))
+            self.router.store.set_home(app_id, sc_id)
+            return out
+        raise IOError(f"no subcluster could issue an application: {last}")
 
     def submit_application(self, ctx_wire: Dict) -> Dict:
         app_id = str(ApplicationId.from_wire(ctx_wire["id"]))
         sc_id = self.router.home_or_raise(app_id)
-        return self.router.rm_proxy(sc_id).submit_application(ctx_wire)
+        try:
+            return self.router.rm_proxy(sc_id).submit_application(ctx_wire)
+        except (OSError, IOError):
+            self.router.mark_lost(sc_id)
+            raise
 
     def get_application_report(self, app_id_wire: Dict) -> Dict:
         app_id = str(ApplicationId.from_wire(app_id_wire))
@@ -173,6 +353,25 @@ class _RouterClientProtocol:
         return {"state": "active", "role": "router"}
 
 
+INTERCEPTORS = {
+    "audit": RouterAuditInterceptor,
+    "federation": FederationClientInterceptor,
+}
+
+
+def build_interceptor_chain(router: "YarnRouter",
+                            spec: str) -> ClientInterceptor:
+    """Ref: Router's interceptor-class.pipeline conf — comma list, last
+    must be the terminal federation link."""
+    names = [n.strip() for n in spec.split(",") if n.strip()]
+    if not names or names[-1] != "federation":
+        names = names + ["federation"]
+    links = [INTERCEPTORS[n](router) for n in names]
+    for a, b in zip(links, links[1:]):
+        a.set_next(b)
+    return links[0]
+
+
 class _RouterAdminProtocol:
     """Ref: router RouterAdminProtocol / FederationStateStore admin."""
 
@@ -189,6 +388,25 @@ class _RouterAdminProtocol:
     def list_subclusters(self) -> Dict[str, Dict]:
         return self.router.store.subclusters()
 
+    def set_policy(self, queue: str, policy: Dict) -> bool:
+        """Per-queue routing policy (ref: the policy store's
+        setPolicyConfiguration; e.g. {"type": "weighted",
+        "weights": {"sc1": 3, "sc2": 1}})."""
+        make_policy(policy, self.router)  # validate before persisting
+        self.router.store.set_policy(queue, policy)
+        return True
+
+    def get_policy(self, queue: str) -> Optional[Dict]:
+        return self.router.store.policy_for(queue)
+
+    def interceptor_counts(self) -> Dict[str, int]:
+        head = self.router.chain
+        while head is not None:
+            if isinstance(head, RouterAuditInterceptor):
+                return dict(head.counts)
+            head = head.next
+        return {}
+
 
 class YarnRouter(AbstractService):
     """Client-facing router over federated RMs (ref: router/Router.java
@@ -201,12 +419,14 @@ class YarnRouter(AbstractService):
             "yarn.federation.state-store.dir", "/tmp/htpu-yarn-router")
         self.store = FederationStateStore(
             os.path.join(self.state_dir, "federation.json"))
-        self.policy = conf.get("yarn.federation.policy", "load")
+        self.default_policy = {"type": conf.get("yarn.federation.policy",
+                                                "load")}
         self._proxies: Dict[str, object] = {}
+        self._policy_cache: Dict[str, RouterPolicy] = {}
         self._client: Optional[Client] = None
-        self._rr = 0
         self._lock = threading.Lock()
         self.rpc: Optional[Server] = None
+        self.chain: Optional[ClientInterceptor] = None
         self._stop_event = threading.Event()
 
     def service_init(self, conf: Configuration) -> None:
@@ -219,16 +439,18 @@ class YarnRouter(AbstractService):
         self.rpc = Server(conf, bind=("127.0.0.1", conf.get_int(
             "yarn.federation.router.port", 0)), num_handlers=8,
             name="yarn-router")
-        self.rpc.register_protocol("ClientRMProtocol",
-                                   _RouterClientProtocol(self))
+        self.chain = build_interceptor_chain(self, conf.get(
+            "yarn.router.clientrm.interceptors", "audit,federation"))
+        self.rpc.register_protocol("ClientRMProtocol", self.chain)
         self.rpc.register_protocol("RouterAdminProtocol",
                                    _RouterAdminProtocol(self))
 
     def service_start(self) -> None:
         self.rpc.start()
         Daemon(self._liveness_loop, "yarn-router-liveness").start()
-        log.info("YARN Router on :%d (%d subclusters, policy=%s)",
-                 self.rpc.port, len(self.store.subclusters()), self.policy)
+        log.info("YARN Router on :%d (%d subclusters, default policy=%s)",
+                 self.rpc.port, len(self.store.subclusters()),
+                 self.default_policy)
 
     def service_stop(self) -> None:
         self._stop_event.set()
@@ -262,28 +484,30 @@ class YarnRouter(AbstractService):
             raise ValueError(f"no home subcluster for {app_id}")
         return sc_id
 
-    def choose_subcluster(self) -> str:
-        """Routing policy (ref: LoadBasedRouterPolicy /
-        UniformRandomRouterPolicy)."""
-        active = sorted(self.store.subclusters(active_only=True))
+    def choose_subcluster(self, queue: str = "default") -> str:
+        """Resolve the queue's policy from the store (falling back to
+        the conf-wide default) and let it pick over ACTIVE subclusters
+        (ref: FederationPolicyStoreFacade resolving per-queue policy
+        managers)."""
+        active = self.store.subclusters(active_only=True)
         if not active:
             raise IOError("no ACTIVE subclusters")
-        if self.policy == "round-robin":
-            with self._lock:
-                sc = active[self._rr % len(active)]
-                self._rr += 1
-            return sc
-        # load-based: fewest running apps wins
-        best, best_load = active[0], float("inf")
-        for sc_id in active:
-            try:
-                m = self.rm_proxy(sc_id).get_cluster_metrics()
-                load = m.get("apps", 0)
-            except (OSError, IOError):
-                continue
-            if load < best_load:
-                best, best_load = sc_id, load
-        return best
+        wire = self.store.policy_for(queue) or self.default_policy
+        cache_key = f"{queue}|{json.dumps(wire, sort_keys=True)}"
+        with self._lock:
+            policy = self._policy_cache.get(cache_key)
+            if policy is None:
+                policy = make_policy(wire, self)
+                self._policy_cache[cache_key] = policy
+        return policy.choose(active, queue)
+
+    def mark_lost(self, sc_id: str) -> None:
+        """Eager failure demotion: the next routing decision must not
+        wait for the liveness sweep to notice a dead RM."""
+        log.warning("subcluster %s marked LOST after call failure", sc_id)
+        with self._lock:
+            self._proxies.pop(sc_id, None)
+        self.store.subcluster_heartbeat(sc_id, SC_LOST)
 
     # ------------------------------------------------------------ liveness
 
