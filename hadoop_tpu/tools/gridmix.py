@@ -2,12 +2,23 @@
 
 Parity with the reference load generator (ref: hadoop-tools/
 hadoop-gridmix — Gridmix.java submits synthetic jobs shaped like a
-rumen trace against a real cluster; its SleepJob/LoadJob models): where
-SLS (tools/sls.py) simulates the scheduler, GridMix exercises the WHOLE
-stack — every trace entry becomes a real MR job (sleep-task model:
-``containers`` map tasks × ``sleep_ms`` runtime) submitted through the
-ordinary Job client, and the report is end-to-end job latency under
-contention.
+rumen trace against a real cluster): where SLS (tools/sls.py) simulates
+the scheduler, GridMix exercises the WHOLE stack — every trace entry
+becomes a real MR job submitted through the ordinary Job client, and
+the report is end-to-end job latency under contention.
+
+Two job models, matching the reference's:
+
+- **LoadJob** (default when the trace carries a rumen ``load`` model —
+  ref: gridmix/LoadJob.java + its ResourceUsageMatcher emulator
+  plugins): every map/reduce task reproduces the traced task's SHAPE —
+  reads the modeled input record count, burns the modeled CPU time
+  (progressively, interleaved with records, measured by
+  ``time.process_time``), holds the modeled heap, and emits the
+  modeled output records/bytes through the real collector/shuffle —
+  so the replay stresses the data plane the way the original did.
+- **SleepJob** (ref: gridmix/SleepJob.java): containers held for the
+  traced runtime with zero load; measures scheduler latency only.
 
   python -m hadoop_tpu.tools.gridmix --rm host:port --fs URI trace.json
 """
@@ -19,7 +30,8 @@ import logging
 import time
 from typing import Dict, List, Optional
 
-from hadoop_tpu.mapreduce.api import InputFormat, Mapper
+from hadoop_tpu.mapreduce.api import (InputFormat, Mapper,
+                                      Reducer)
 
 log = logging.getLogger(__name__)
 
@@ -48,12 +60,164 @@ class SleepMapper(Mapper):
         ctx.emit(key, b"done")
 
 
+# ------------------------------------------------------------------ load job
+
+class LoadInputFormat(InputFormat):
+    """N synthetic splits, each describing one modeled map's record
+    stream (ref: LoadJob's use of the trace's per-task record counts;
+    the data itself is generated, like GenerateData's corpus)."""
+
+    NUM_MAPS_KEY = "gridmix.load.maps"
+    IN_RECORDS_KEY = "gridmix.load.map.input-records"
+    REC_BYTES_KEY = "gridmix.load.record-bytes"
+
+    def get_splits(self, fs, paths, conf):
+        from hadoop_tpu.mapreduce.api import FileSplit
+        n = int(conf.get(self.NUM_MAPS_KEY, "1"))
+        return [FileSplit(f"synthetic://load/{i}", 0, 1)
+                for i in range(n)]
+
+    def read(self, fs, split, conf):
+        import os as _os
+        n_rec = max(1, int(conf.get(self.IN_RECORDS_KEY, "100")))
+        rec_bytes = max(1, int(conf.get(self.REC_BYTES_KEY, "100")))
+        payload = _os.urandom(rec_bytes)
+        for i in range(n_rec):
+            yield f"{split.path}/{i}".encode(), payload
+
+
+class _CpuBurner:
+    """Progressive CPU emulation (ref: CumulativeCpuUsageEmulatorPlugin:
+    burn in small chunks as records flow, not one big spin at the end).
+    Targets PROCESS time so sleeps/IO don't count toward the budget."""
+
+    def __init__(self, total_ms: float):
+        self.deadline_used = 0.0
+        self.total_s = total_ms / 1000.0
+        self.start = time.process_time()
+        self._x = 12345
+
+    def burn_fraction(self, frac: float) -> None:
+        target = self.start + min(1.0, frac) * self.total_s
+        while time.process_time() < target:
+            # arithmetic chunk; keep the GIL releasable between chunks
+            for _ in range(1000):
+                self._x = (self._x * 1103515245 + 12345) & 0x7FFFFFFF
+
+
+class LoadMapper(Mapper):
+    """Reproduce the traced map shape: record IO at the modeled in/out
+    ratio, modeled output bytes, progressive CPU burn, held heap."""
+
+    def setup(self, ctx):
+        import os as _os
+        self._out_records = max(0, int(ctx.conf.get(
+            "gridmix.load.map.output-records", "100")))
+        self._in_records = max(1, int(ctx.conf.get(
+            LoadInputFormat.IN_RECORDS_KEY, "100")))
+        out_bytes = max(0, int(ctx.conf.get(
+            "gridmix.load.map.output-bytes", "10000")))
+        self._val = _os.urandom(
+            max(1, out_bytes // max(1, self._out_records)))
+        self._burner = _CpuBurner(float(ctx.conf.get(
+            "gridmix.load.cpu-ms", "0")))
+        # heap emulation (ref: TotalHeapUsageEmulatorPlugin): hold the
+        # modeled working set for the task's lifetime
+        heap_mb = int(ctx.conf.get("gridmix.load.heap-mb", "0"))
+        self._ballast = bytearray(heap_mb * 1024 * 1024) if heap_mb else None
+        self._seen = 0
+        self._emitted = 0
+
+    def map(self, key, value, ctx):
+        self._seen += 1
+        self._burner.burn_fraction(self._seen / self._in_records)
+        # emit at the traced out/in ratio, spread evenly
+        want = (self._seen * self._out_records) // self._in_records
+        while self._emitted < want:
+            self._emitted += 1
+            ctx.emit(f"k{self._emitted % 997:03d}".encode(), self._val)
+
+
+class LoadReducer(Reducer):
+    """Consume groups and emit at the traced reduce out/in ratio."""
+
+    def setup(self, ctx):
+        self._ratio = float(ctx.conf.get("gridmix.load.reduce.ratio", "1"))
+        self._burner = _CpuBurner(float(ctx.conf.get(
+            "gridmix.load.reduce.cpu-ms", "0")))
+        self._seen = 0
+        self._acc = 0.0
+
+    def reduce(self, key, values, ctx):
+        n = sum(1 for _ in values)
+        self._seen += n
+        self._burner.burn_fraction(min(1.0, self._seen / 10_000.0))
+        self._acc += self._ratio
+        while self._acc >= 1.0:
+            self._acc -= 1.0
+            ctx.emit(key, str(n).encode())
+
+
+def _make_sleep_job(Job, class_ref, rm_addr, default_fs, entry, idx,
+                    out_root, sleep_ms):
+    return (Job(rm_addr, default_fs,
+                name=f"gridmix-{entry.get('job_id', idx)}")
+            .set_mapper(class_ref(SleepMapper))
+            .set_input_format(class_ref(SleepInputFormat))
+            .add_input_path("/")
+            .set_output_path(f"{out_root}/{idx}")
+            .set_num_reduces(0)
+            .set(SleepInputFormat.NUM_MAPS_KEY,
+                 str(max(1, min(int(entry.get("containers", 1)), 64))))
+            .set("gridmix.sleep.ms", str(
+                entry.get("task_ms", {}).get("mean") or sleep_ms)))
+
+
+def _make_load_job(Job, class_ref, rm_addr, default_fs, entry, idx,
+                   out_root, cpu_fraction):
+    load = entry["load"]
+    m = load.get("map") or {"n": 1, "ms": 100, "input_records": 100,
+                            "output_records": 100, "output_bytes": 10000}
+    r = load.get("reduce")
+    out_per_rec = max(1, m["output_bytes"] //
+                      max(1, m["output_records"]))
+    job = (Job(rm_addr, default_fs,
+               name=f"gridmix-load-{entry.get('job_id', idx)}")
+           .set_mapper(class_ref(LoadMapper))
+           .set_input_format(class_ref(LoadInputFormat))
+           .add_input_path("/")
+           .set_output_path(f"{out_root}/{idx}")
+           .set(LoadInputFormat.NUM_MAPS_KEY, str(max(1, m["n"])))
+           .set(LoadInputFormat.IN_RECORDS_KEY,
+                str(max(1, m["input_records"])))
+           .set(LoadInputFormat.REC_BYTES_KEY, str(out_per_rec))
+           .set("gridmix.load.map.output-records",
+                str(m["output_records"]))
+           .set("gridmix.load.map.output-bytes", str(m["output_bytes"]))
+           .set("gridmix.load.cpu-ms",
+                str(int(m["ms"] * cpu_fraction))))
+    if r:
+        job.set_reducer(class_ref(LoadReducer)) \
+           .set_num_reduces(max(1, r["n"])) \
+           .set("gridmix.load.reduce.ratio", str(
+               r["output_records"] / max(1, r["input_records"]))) \
+           .set("gridmix.load.reduce.cpu-ms",
+                str(int(r["ms"] * cpu_fraction)))
+    else:
+        job.set_num_reduces(0)
+    return job
+
+
 def run_trace(rm_addr, default_fs: str, trace: List[Dict], *,
               sleep_ms: int = 100, max_concurrent: int = 4,
-              out_root: str = "/gridmix-out") -> Dict:
-    """Submit every trace entry as a real sleep job; returns latency
-    stats. Ref: Gridmix.run's JobSubmitter/JobMonitor pair (bounded
-    in-flight jobs)."""
+              out_root: str = "/gridmix-out", mode: str = "auto",
+              cpu_fraction: float = 0.5) -> Dict:
+    """Submit every trace entry as a real job; returns latency stats.
+    Ref: Gridmix.run's JobSubmitter/JobMonitor pair (bounded in-flight
+    jobs). ``mode``: "load" (emulate the rumen load model), "sleep",
+    or "auto" (load when the entry carries one). ``cpu_fraction``:
+    share of the traced task runtime modeled as compute (the rest was
+    IO/framework in the source job)."""
     from hadoop_tpu.mapreduce import Job
     from hadoop_tpu.mapreduce.api import class_ref
     pending = sorted(trace, key=lambda j: j.get("arrival", 0))
@@ -65,23 +229,14 @@ def run_trace(rm_addr, default_fs: str, trace: List[Dict], *,
     while pending or inflight:
         while pending and len(inflight) < max_concurrent:
             entry = pending.pop(0)
-            job = (Job(rm_addr, default_fs,
-                       name=f"gridmix-{entry.get('job_id', idx)}")
-                   .set_mapper(class_ref(SleepMapper))
-                   .set_input_format(class_ref(SleepInputFormat))
-                   .add_input_path("/")
-                   .set_output_path(f"{out_root}/{idx}")
-                   .set_num_reduces(0)
-                   .set(SleepInputFormat.NUM_MAPS_KEY,
-                        str(max(1, min(int(entry.get("containers", 1)),
-                                       64))))
-                   # Trace fidelity: a rumen trace carries the source
-                   # job's measured task runtime; replay each task for
-                   # that long (ref: gridmix's SleepJob using
-                   # LoggedTask runtimes). Fixed sleep_ms otherwise.
-                   .set("gridmix.sleep.ms", str(
-                       entry.get("task_ms", {}).get("mean")
-                       or sleep_ms)))
+            use_load = mode == "load" or (mode == "auto"
+                                          and entry.get("load"))
+            if use_load:
+                job = _make_load_job(Job, class_ref, rm_addr, default_fs,
+                                     entry, idx, out_root, cpu_fraction)
+            else:
+                job = _make_sleep_job(Job, class_ref, rm_addr, default_fs,
+                                      entry, idx, out_root, sleep_ms)
             job.submit()
             inflight.append({"job": job, "start": time.perf_counter()})
             idx += 1
@@ -116,13 +271,18 @@ def main(argv=None) -> int:
     ap.add_argument("--fs", required=True)
     ap.add_argument("--sleep-ms", type=int, default=100)
     ap.add_argument("--concurrent", type=int, default=4)
+    ap.add_argument("--mode", choices=["auto", "load", "sleep"],
+                    default="auto")
+    ap.add_argument("--cpu-fraction", type=float, default=0.5)
     args = ap.parse_args(argv)
     with open(args.trace) as f:
         trace = json.load(f)
     host, _, port = args.rm.rpartition(":")
     print(json.dumps(run_trace((host, int(port)), args.fs, trace,
                                sleep_ms=args.sleep_ms,
-                               max_concurrent=args.concurrent)))
+                               max_concurrent=args.concurrent,
+                               mode=args.mode,
+                               cpu_fraction=args.cpu_fraction)))
     return 0
 
 

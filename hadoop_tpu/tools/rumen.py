@@ -58,9 +58,41 @@ def build_trace(fs: FileSystem,
             "task_ms": {"mean": mean_ms,
                         "p50": durations[len(durations) // 2],
                         "max": durations[-1]},
+            "load": _load_model(tasks),
             "state": finished[0]["state"] if finished else "UNKNOWN",
         })
     return jobs
+
+
+def _load_model(tasks: List[Dict]) -> Dict:
+    """Per-phase load shape from task counters — what gridmix's LoadJob
+    replays (ref: LoggedTaskAttempt's resource/record fields feeding
+    gridmix LoadJob + its ResourceUsageEmulatorPlugins)."""
+    model: Dict[str, Dict] = {}
+    for phase, in_key, out_keys in (
+            ("map", "MAP_INPUT_RECORDS",
+             ("MAP_OUTPUT_RECORDS", "MAP_OUTPUT_BYTES")),
+            ("reduce", "REDUCE_INPUT_RECORDS",
+             ("REDUCE_OUTPUT_RECORDS", None))):
+        phase_tasks = [t for t in tasks if t.get("task_type") == phase]
+        if not phase_tasks:
+            continue
+        n = len(phase_tasks)
+
+        def csum(name):
+            return sum((t.get("counters") or {})
+                       .get("TaskCounter", {}).get(name, 0)
+                       for t in phase_tasks)
+        ms = sorted(t.get("duration_ms", 0) for t in phase_tasks)
+        model[phase] = {
+            "n": n,
+            "ms": sum(ms) // n,
+            "input_records": csum(in_key) // n,
+            "output_records": csum(out_keys[0]) // n,
+            "output_bytes": (csum(out_keys[1]) // n) if out_keys[1]
+            else 0,
+        }
+    return model
 
 
 def main(argv=None) -> int:

@@ -276,3 +276,76 @@ def test_dynamometer_generate_and_parallel_replay(tmp_path):
     assert set(r["per_op"]) >= {"create", "open", "listStatus"}
     # error rate small (renames/opens racing deletes are tolerated)
     assert r["errors"] < r["ops"] * 0.05
+
+
+def test_rumen_gridmix_sls_compose_with_load_emulation(tmp_path):
+    """The full trace chain (VERDICT r4 #6): run a REAL job, rumen
+    extracts a per-phase load model from its counters, gridmix replays
+    it as a LoadJob that emulates cpu/record-IO (not sleep), and SLS
+    accepts the same trace. The replay's record counters must track
+    the model, and its runtime envelope the source job's."""
+    import json as _json
+    import time as _time
+
+    from hadoop_tpu.examples.wordcount import make_job
+    from hadoop_tpu.mapreduce import history as jh
+    from hadoop_tpu.testing.minicluster import MiniMRYarnCluster
+    from hadoop_tpu.tools.gridmix import run_trace
+    from hadoop_tpu.tools.rumen import build_trace
+    from hadoop_tpu.tools.sls import SyntheticTrace, run
+
+    with MiniMRYarnCluster(num_nodes=2,
+                           base_dir=str(tmp_path / "c")) as cluster:
+        fs = cluster.get_filesystem()
+        fs.mkdirs("/lc-in")
+        fs.write_all("/lc-in/x.txt", b"alpha beta gamma delta\n" * 500)
+        t0 = _time.perf_counter()
+        job = make_job(cluster.rm_addr, cluster.default_fs, "/lc-in",
+                       "/lc-out")
+        assert job.wait_for_completion()
+        src_wall = _time.perf_counter() - t0
+
+        trace = build_trace(fs)
+        assert trace, "no trace extracted"
+        entry = trace[0]
+        # the load model is present and shaped by real counters
+        assert entry["load"]["map"]["input_records"] == 500 // \
+            max(1, entry["load"]["map"]["n"]) * 1  # per-map mean
+        assert entry["load"]["map"]["output_records"] > 0
+        assert entry["load"]["map"]["output_bytes"] > 0
+        assert entry["load"]["reduce"]["input_records"] > 0
+
+        # gridmix LOAD replay (auto-picks load mode)
+        t0 = _time.perf_counter()
+        report = run_trace(cluster.rm_addr, cluster.default_fs, trace,
+                           max_concurrent=1, out_root="/lc-replay")
+        replay_wall = _time.perf_counter() - t0
+        assert report["jobs"] == 1 and report["failed"] == 0
+        # the replayed job produced REAL reduce output (load mode, not
+        # sleep: sleep jobs are map-only)
+        outs = [s.path for s in fs.list_status("/lc-replay/0")
+                if "part-r-" in s.path]
+        assert outs, "load replay produced no reduce output"
+        # runtime envelope: same order of magnitude as the source job
+        # (generous band — 1-core CI host; catches sleep-only or
+        # runaway emulation, not percentage drift)
+        assert replay_wall < max(6 * src_wall, 60), \
+            (src_wall, replay_wall)
+
+        # the replay's own history carries the emulated record flow:
+        # map output records within 2x of the model
+        replay_trace = build_trace(fs)
+        load_jobs = [t for t in replay_trace
+                     if t is not entry and t["load"].get("map")]
+        assert load_jobs
+        got = load_jobs[-1]["load"]["map"]["output_records"]
+        want = entry["load"]["map"]["output_records"]
+        assert want / 2 <= got <= want * 2, (got, want)
+
+    # SLS accepts the identical trace file (shared format)
+    path = str(tmp_path / "trace.json")
+    with open(path, "w") as f:
+        _json.dump(trace, f)
+    tr = SyntheticTrace.from_file(path)
+    r = run(num_nodes=4, scheduler="capacity", ticks=200, trace=tr)
+    assert r["unfinished_apps"] == 0
