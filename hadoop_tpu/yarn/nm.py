@@ -136,6 +136,7 @@ class _RunningContainer:
         self.state = "NEW"
         self.exit_code: Optional[int] = None
         self.diagnostics = ""
+        self.start_ts = time.time()
 
 
 class ContainerManagerProtocol:
@@ -329,9 +330,21 @@ class NodeAgent(AbstractService):
                 # container finishing after the app's collector stopped
                 # must not resurrect it (the event is dropped, like the
                 # reference's post-stop puts).
+                # resource-time metrics ride the FINISHED event so the
+                # ATSv2 reader can aggregate flow-run cost (ref: the
+                # container entity's MEMORY/CPU metrics feeding
+                # FlowRunEntity aggregation)
+                dur = max(0.0, time.time() - rc.start_ts)
                 self.timeline.collector_for(str(cid.app_id)).put_entity(
                     "YARN_CONTAINER", str(cid), "FINISHED",
-                    exit_code=rc.exit_code)
+                    exit_code=rc.exit_code,
+                    duration_s=round(dur, 3),
+                    memory_mb=rc.container.resource.memory_mb,
+                    vcores=rc.container.resource.vcores,
+                    mb_seconds=round(
+                        dur * rc.container.resource.memory_mb, 1),
+                    vcore_seconds=round(
+                        dur * rc.container.resource.vcores, 3))
 
     def _localize(self, rc: _RunningContainer) -> None:
         """Fetch DFS resources into the work dir.

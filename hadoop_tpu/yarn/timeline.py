@@ -166,7 +166,10 @@ class AppLevelTimelineCollector:
             if self.stopped:
                 return
             self._buf.append(rec)
-            if len(self._buf) >= self.flush_every:
+            # batch ordinary events; push terminal ones straight through
+            # (a container's FINISHED carries the resource-time metrics
+            # readers aggregate — it must not wait out the batch window)
+            if len(self._buf) >= self.flush_every or event == "FINISHED":
                 self._flush_locked()
 
     def _flush_locked(self) -> None:
@@ -217,11 +220,35 @@ class TimelineCollectorManager:
             c = self._collectors.get(app_id)
             return c is not None and not c.stopped
 
-    def stop_collector(self, app_id: str) -> None:
+    def stop_collector(self, app_id: str, linger_s: float = 1.0) -> None:
+        """Stop after a short LINGER: the RM's app-finished report can
+        beat the app's last container-FINISHED events to this NM by a
+        heartbeat, and the final events carry the resource-time metrics
+        flow aggregation needs (ref: the reference collector outliving
+        the app until its final entities are published). The collector
+        keeps accepting during the grace window; the timer closes it."""
         with self._lock:
-            c = self._collectors.pop(app_id, None)
-        if c is not None:
+            c = self._collectors.get(app_id)
+        if c is None:
+            return
+        if linger_s <= 0:
+            with self._lock:
+                if self._collectors.get(app_id) is c:
+                    self._collectors.pop(app_id)
             c.stop()
+            return
+
+        def _close():
+            # keep the collector REACHABLE while lingering (late events
+            # route through has_collector/collector_for); identity-guard
+            # the pop so a resurrected app's fresh collector survives
+            with self._lock:
+                if self._collectors.get(app_id) is c:
+                    self._collectors.pop(app_id)
+            c.stop()
+        t = threading.Timer(linger_s, _close)
+        t.daemon = True
+        t.start()
 
     def active_apps(self) -> List[str]:
         with self._lock:
@@ -234,3 +261,146 @@ class TimelineCollectorManager:
             self._collectors.clear()
         for c in cs:
             c.stop()
+
+
+# ------------------------------------------------------------- ATSv2 reader
+
+class FlowRunAggregator:
+    """Fold raw timeline events into flows → flow runs → apps with
+    aggregated resource metrics (ref: ATSv2's flow-run aggregation —
+    hadoop-yarn-server-timelineservice FlowRunEntity /
+    HBaseTimelineReaderImpl's flow tables; here computed from the
+    JSONL stores on read, one pass).
+
+    Flow semantics (reference defaults): flow name = the app's NAME,
+    flow run = the submission DAY — apps resubmitted under one name
+    aggregate into the same daily run, answering "what does this
+    pipeline cost per day".
+    """
+
+    def __init__(self, store_dirs: List[str]):
+        self.stores = [TimelineStore(d) for d in store_dirs]
+
+    def _all_events(self) -> List[Dict]:
+        out: List[Dict] = []
+        for st in self.stores:
+            out.extend(st.events())
+        return out
+
+    def snapshot(self) -> Dict:
+        """One pass over every store: apps (with per-app aggregated
+        container metrics) + flows + flow runs."""
+        apps: Dict[str, Dict] = {}
+        containers: Dict[str, Dict] = {}
+        for rec in self._all_events():
+            info = rec.get("info") or {}
+            if rec.get("type") == "YARN_APPLICATION":
+                a = apps.setdefault(rec["id"], {
+                    "id": rec["id"], "events": [],
+                    "metrics": {"containers": 0, "mb_seconds": 0.0,
+                                "vcore_seconds": 0.0,
+                                "container_seconds": 0.0}})
+                a["events"].append(rec["event"])
+                if rec["event"] == "SUBMITTED":
+                    a["submit_ts"] = rec.get("ts")
+                a.update({k: v for k, v in info.items()
+                          if v is not None and k != "app_id"})
+            elif rec.get("type") == "YARN_CONTAINER":
+                c = containers.setdefault(rec["id"], {})
+                c.update(info)
+        for c in containers.values():
+            app = apps.get(c.get("app_id"))
+            if app is None or "mb_seconds" not in c:
+                continue
+            m = app["metrics"]
+            m["containers"] += 1
+            m["mb_seconds"] += c.get("mb_seconds", 0.0)
+            m["vcore_seconds"] += c.get("vcore_seconds", 0.0)
+            m["container_seconds"] += c.get("duration_s", 0.0)
+        flows: Dict[str, Dict] = {}
+        for app in apps.values():
+            flow_name = app.get("flow_name") or app.get("name") \
+                or app["id"]
+            ts = app.get("submit_ts") or 0
+            run_id = time.strftime("%Y%m%d", time.gmtime(ts))
+            fl = flows.setdefault(flow_name, {"flow": flow_name,
+                                              "runs": {}})
+            run = fl["runs"].setdefault(run_id, {
+                "run_id": run_id, "apps": [],
+                "metrics": {"containers": 0, "mb_seconds": 0.0,
+                            "vcore_seconds": 0.0,
+                            "container_seconds": 0.0}})
+            run["apps"].append(app["id"])
+            for k in run["metrics"]:
+                run["metrics"][k] += app["metrics"][k]
+        return {"apps": apps, "flows": flows}
+
+
+class TimelineReaderServer(AbstractService):
+    """The ATSv2 READER half (ref: timelineservice's
+    TimelineReaderServer + TimelineReaderWebServices — /ws/v2/timeline):
+    REST queries over the collector stores, including flow-run
+    aggregated metrics, so the timeline can answer "what did app X /
+    flow Y cost"."""
+
+    def __init__(self, conf: Configuration, store_dirs: List[str]):
+        super().__init__("TimelineReaderServer")
+        self.aggregator = FlowRunAggregator(store_dirs)
+        self.http: Optional[HttpServer] = None
+
+    def service_init(self, conf: Configuration) -> None:
+        self.http = HttpServer(
+            conf, ("127.0.0.1", conf.get_int(
+                "yarn.timeline-service.reader.webapp.port", 0)),
+            daemon_name="timeline-reader")
+        self.http.add_handler("/ws/v2/timeline", self._route)
+
+    def service_start(self) -> None:
+        self.http.start()
+        log.info("TimelineReaderServer on :%d", self.http.port)
+
+    def service_stop(self) -> None:
+        if self.http:
+            self.http.stop()
+
+    @property
+    def port(self) -> int:
+        return self.http.port
+
+    def _route(self, query: Dict, body: bytes):
+        path = query["__path__"][len("/ws/v2/timeline"):].strip("/")
+        parts = [p for p in path.split("/") if p]
+        snap = self.aggregator.snapshot()
+        if not parts or parts == ["flows"]:
+            return 200, {"flows": [
+                {"flow": f["flow"], "num_runs": len(f["runs"])}
+                for f in sorted(snap["flows"].values(),
+                                key=lambda x: x["flow"])]}
+        if parts[0] == "flowruns" and len(parts) >= 2:
+            fl = snap["flows"].get(parts[1])
+            if fl is None:
+                raise FileNotFoundError(parts[1])
+            runs = sorted(fl["runs"].values(),
+                          key=lambda r: r["run_id"])
+            if len(parts) == 2:
+                return 200, {"flow": parts[1], "runs": runs}
+            run = fl["runs"].get(parts[2])
+            if run is None:
+                raise FileNotFoundError(parts[2])
+            return 200, run
+        if parts[0] == "apps" and len(parts) >= 2:
+            app = snap["apps"].get(parts[1])
+            if app is None:
+                raise FileNotFoundError(parts[1])
+            if len(parts) == 2:
+                return 200, {"app": app}
+            # /apps/{id}/entities/{type}: raw entities filtered to app
+            if len(parts) == 4 and parts[2] == "entities":
+                ents = []
+                for st in self.aggregator.stores:
+                    for rec in st.events(entity_type=parts[3]):
+                        if (rec.get("info") or {}).get("app_id") == \
+                                parts[1] or rec.get("id") == parts[1]:
+                            ents.append(rec)
+                return 200, {"entities": ents}
+        raise FileNotFoundError(path)
