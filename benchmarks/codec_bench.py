@@ -1,0 +1,64 @@
+"""Codec throughput: MB/s per codec on shuffle-like data.
+
+Counterpart of the reference's codec perf tests (ref:
+TestCompressionStreamReuse / the lz4/snappy JNI benchmarks): measures
+compress + decompress MB/s on IFile-like record data (sorted text keys
++ small binary values — compressible but not trivially so).
+
+  python -m benchmarks.codec_bench [--mb 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def _corpus(mb: int) -> bytes:
+    # sorted word-like keys + small random values: the shape of
+    # map-output spills. Every record is distinct so no codec gets a
+    # free long-range-repetition win.
+    out = bytearray()
+    i = 0
+    while len(out) < mb * 1024 * 1024:
+        out += f"key-{i:010d}".encode() + b"\x00" + os.urandom(6)
+        i += 1
+    return bytes(out[:mb * 1024 * 1024])
+
+
+def run(mb: int = 64) -> dict:
+    from hadoop_tpu.io.codecs import CodecFactory
+    data = _corpus(mb)
+    out = {}
+    for name in CodecFactory.names():
+        if name in ("lzma", "bzip2"):  # minutes-per-GB archival codecs
+            continue
+        codec = CodecFactory.get(name)
+        t0 = time.perf_counter()
+        comp = codec.compress(data)
+        c_dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        back = codec.decompress(comp)
+        d_dt = time.perf_counter() - t0
+        assert back == data, name
+        out[name] = {
+            "compress_mb_s": round(mb / c_dt, 1),
+            "decompress_mb_s": round(mb / d_dt, 1),
+            "ratio": round(len(data) / len(comp), 2),
+        }
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=int, default=64)
+    args = ap.parse_args()
+    print(json.dumps(run(args.mb)))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

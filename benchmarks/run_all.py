@@ -62,6 +62,8 @@ def main() -> None:
         n_ops=int(5000 * scale))
     out["rpc"] = rpc_bench.run(seconds=5.0 * scale)
     out["dfsio"] = dfsio.run(n_files=4, mb_per_file=int(16 * scale) or 2)
+    from benchmarks import codec_bench
+    out["codecs"] = codec_bench.run(mb=int(64 * scale) or 8)
     # 400 MB: big enough that scheduling/launch overhead amortizes (the
     # canonical benchmark is run at terabyte scale for the same reason)
     out["terasort"] = terasort_bench.run(records=int(4_000_000 * scale))

@@ -32,6 +32,9 @@ def make_job(rm_addr: Tuple[str, int], default_fs: str,
             .set_mapper(TokenizerMapper)
             .set_combiner(IntSumReducer)
             .set_reducer(IntSumReducer)
+            # text shuffles compress well: opt into the lz4 spill path
+            # (ref: the examples enabling map-output compression)
+            .set("mapreduce.map.output.compress", "true")
             .add_input_path(input_path)
             .set_output_path(output_path)
             .set_num_reduces(num_reduces))

@@ -220,6 +220,154 @@ class ZstdCodec(CompressionCodec):
         return self._native.decompress(data)
 
 
+# ------------------------------------------------------- native lz4/snappy
+
+
+class _NativeLz4:
+    """ctypes binding to liblz4's block API (the reference bundles
+    lz4.c and binds it via JNI — ref: io/compress/lz4/lz4.c,
+    Lz4Compressor.java). Each compressed blob carries a u32 original
+    size so decompression can size its buffer, the same job the
+    reference's block stream's length words do."""
+
+    def __init__(self) -> None:
+        path = ctypes.util.find_library("lz4")
+        if not path:
+            raise OSError("liblz4 not found")
+        lib = ctypes.CDLL(path)
+        lib.LZ4_compressBound.restype = ctypes.c_int
+        lib.LZ4_compressBound.argtypes = [ctypes.c_int]
+        lib.LZ4_compress_default.restype = ctypes.c_int
+        lib.LZ4_compress_default.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        lib.LZ4_decompress_safe.restype = ctypes.c_int
+        lib.LZ4_decompress_safe.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        self._lib = lib
+
+    def compress(self, data: bytes) -> bytes:
+        lib = self._lib
+        bound = lib.LZ4_compressBound(len(data))
+        out = ctypes.create_string_buffer(bound)
+        n = lib.LZ4_compress_default(data, out, len(data), bound)
+        if n <= 0:
+            raise IOError("lz4 compress error")
+        return struct.pack("<I", len(data)) + out.raw[:n]
+
+    def decompress(self, data: bytes) -> bytes:
+        if len(data) < 4:
+            raise IOError("truncated lz4 blob")
+        (orig,) = struct.unpack_from("<I", data)
+        out = ctypes.create_string_buffer(max(orig, 1))
+        n = self._lib.LZ4_decompress_safe(data[4:], out, len(data) - 4,
+                                          max(orig, 1))
+        if n < 0 or n != orig:
+            raise IOError(f"lz4 decompress error (rc={n})")
+        return out.raw[:orig]
+
+
+class Lz4Codec(CompressionCodec):
+    name, extension = "lz4", ".lz4"
+    _native: Optional[_NativeLz4] = None
+    _tried = False
+
+    @classmethod
+    def available(cls) -> bool:
+        if not cls._tried:
+            cls._tried = True
+            try:
+                cls._native = _NativeLz4()
+            except OSError:
+                cls._native = None
+        return cls._native is not None
+
+    def compress(self, data):
+        if not self.available():
+            raise IOError("lz4 native library unavailable")
+        return self._native.compress(data)
+
+    def decompress(self, data):
+        if not self.available():
+            raise IOError("lz4 native library unavailable")
+        return self._native.decompress(data)
+
+
+class _NativeSnappy:
+    """ctypes binding to libsnappy's C API (ref: the reference's
+    SnappyCompressor.c JNI glue)."""
+
+    def __init__(self) -> None:
+        path = ctypes.util.find_library("snappy")
+        if not path:
+            raise OSError("libsnappy not found")
+        lib = ctypes.CDLL(path)
+        sz = ctypes.c_size_t
+        lib.snappy_max_compressed_length.restype = sz
+        lib.snappy_max_compressed_length.argtypes = [sz]
+        lib.snappy_compress.restype = ctypes.c_int
+        lib.snappy_compress.argtypes = [ctypes.c_char_p, sz,
+                                        ctypes.c_char_p,
+                                        ctypes.POINTER(sz)]
+        lib.snappy_uncompressed_length.restype = ctypes.c_int
+        lib.snappy_uncompressed_length.argtypes = [ctypes.c_char_p, sz,
+                                                   ctypes.POINTER(sz)]
+        lib.snappy_uncompress.restype = ctypes.c_int
+        lib.snappy_uncompress.argtypes = [ctypes.c_char_p, sz,
+                                          ctypes.c_char_p,
+                                          ctypes.POINTER(sz)]
+        self._lib = lib
+
+    def compress(self, data: bytes) -> bytes:
+        lib = self._lib
+        out_len = ctypes.c_size_t(
+            lib.snappy_max_compressed_length(len(data)))
+        out = ctypes.create_string_buffer(out_len.value)
+        rc = lib.snappy_compress(data, len(data), out,
+                                 ctypes.byref(out_len))
+        if rc != 0:
+            raise IOError(f"snappy compress error rc={rc}")
+        return out.raw[:out_len.value]
+
+    def decompress(self, data: bytes) -> bytes:
+        lib = self._lib
+        orig = ctypes.c_size_t(0)
+        if lib.snappy_uncompressed_length(data, len(data),
+                                          ctypes.byref(orig)) != 0:
+            raise IOError("snappy: cannot determine length")
+        out = ctypes.create_string_buffer(max(orig.value, 1))
+        n = ctypes.c_size_t(orig.value)
+        rc = lib.snappy_uncompress(data, len(data), out, ctypes.byref(n))
+        if rc != 0:
+            raise IOError(f"snappy decompress error rc={rc}")
+        return out.raw[:n.value]
+
+
+class SnappyCodec(CompressionCodec):
+    name, extension = "snappy", ".snappy"
+    _native: Optional[_NativeSnappy] = None
+    _tried = False
+
+    @classmethod
+    def available(cls) -> bool:
+        if not cls._tried:
+            cls._tried = True
+            try:
+                cls._native = _NativeSnappy()
+            except OSError:
+                cls._native = None
+        return cls._native is not None
+
+    def compress(self, data):
+        if not self.available():
+            raise IOError("snappy native library unavailable")
+        return self._native.compress(data)
+
+    def decompress(self, data):
+        if not self.available():
+            raise IOError("snappy native library unavailable")
+        return self._native.decompress(data)
+
+
 # ---------------------------------------------------------------- factory
 
 
@@ -253,5 +401,9 @@ class CodecFactory:
 
 for _codec in (ZlibCodec(), GzipCodec(), Bzip2Codec(), LzmaCodec()):
     CodecFactory.register(_codec)
+if Lz4Codec.available():
+    CodecFactory.register(Lz4Codec())
+if SnappyCodec.available():
+    CodecFactory.register(SnappyCodec())
 if ZstdCodec.available():
     CodecFactory.register(ZstdCodec())

@@ -103,6 +103,23 @@ def decode_records(stored: bytes,
         off += klen + vlen
 
 
+def reframe_uncompressed(stored: bytes, codec: Optional[str]) -> bytes:
+    """CRC-verify + inflate a stored segment, re-emitting it as an
+    UNCOMPRESSED stored segment (raw body + crc32c). The reduce-side
+    raw merge keeps the C k-way path for compressed shuffles this way:
+    inflate once on arrival, merge native."""
+    if not codec:
+        return stored
+    if len(stored) < 4:
+        raise IOError("IFile segment truncated")
+    body, crc = stored[:-4], struct.unpack(">I", stored[-4:])[0]
+    if crc32c(body) != crc:
+        raise IOError("IFile segment checksum mismatch")
+    _, decompress = Codecs.get(codec)
+    raw = decompress(body)
+    return raw + struct.pack(">I", crc32c(raw))
+
+
 class SpillIndex:
     """Per-partition (offset, stored_len, raw_records) index.
     Ref: mapred/SpillRecord.java (.out.index files)."""

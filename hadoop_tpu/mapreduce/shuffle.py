@@ -185,7 +185,11 @@ class MergeManager:
         self.counters = counters
         self.mem_limit = mem_limit
         from hadoop_tpu import native as _nat
-        self._raw_mode = codec is None and _nat.available()
+        # raw mode feeds the C k-way merge with UNCOMPRESSED stored
+        # segments; compressed fetches are inflated + reframed on
+        # arrival (decompress is the cheap half of lz4) so the merge
+        # stays native.
+        self._raw_mode = codec in (None, "lz4") and _nat.available()
         self._raw_segs: List[bytes] = []       # raw mode: stored segments
         self._mem_runs: List[List[Tuple[bytes, bytes]]] = []
         self._mem_bytes = 0
@@ -195,8 +199,12 @@ class MergeManager:
 
     def add_segment(self, stored: bytes) -> None:
         if self._raw_mode:
+            wire_len = len(stored)
+            if self.codec:
+                # inflate once on arrival; the C merge reads raw stored
+                stored = ifile.reframe_uncompressed(stored, self.codec)
             with self._lock:
-                self.counters.incr(Counters.SHUFFLED_BYTES, len(stored))
+                self.counters.incr(Counters.SHUFFLED_BYTES, wire_len)
                 if self._mem_bytes + len(stored) >= self.mem_limit:
                     # over budget: decode (CRC-verified) and spill as a
                     # STREAMABLE run so the final merge stays memory-
@@ -205,7 +213,7 @@ class MergeManager:
                         self.local_dir,
                         f"merge{len(self._disk_runs)}.out")
                     ifile.write_stream(
-                        path, ifile.decode_records(stored, self.codec))
+                        path, ifile.decode_records(stored, None))
                     self._disk_runs.append(path)
                 else:
                     self._mem_bytes += len(stored)
@@ -257,7 +265,9 @@ class MergeManager:
         mixes in-memory segments with on-disk streamed segments)."""
         with self._lock:
             if self._raw_mode:
-                runs: List = [list(ifile.decode_records(s, self.codec))
+                # raw segments were reframed to UNCOMPRESSED on arrival
+                # (add_segment), whatever the job codec is
+                runs: List = [list(ifile.decode_records(s, None))
                               for s in self._raw_segs]
             else:
                 runs = list(self._mem_runs)

@@ -123,19 +123,23 @@ class MapOutputCollector:
         self._spills: List[Tuple[str, ifile.SpillIndex]] = []
         os.makedirs(spill_dir, exist_ok=True)
         # Native batch engine (ref: nativetask) — engaged when the
-        # partition function is expressible in C++ (hash/range), there is
-        # no combiner, and spills aren't compressed. Anything else takes
-        # the Python path below.
+        # partition function is expressible in C++ (hash/range), there
+        # is no combiner, and spills are raw or lz4 (the C writer
+        # compresses segments itself). Anything else takes the Python
+        # path below.
         self._native = None
         self._pending: List[Tuple[bytes, bytes]] = []
         self._pending_bytes = 0
         spec = _native_partition_spec(partitioner, num_partitions)
-        if (spec is not None and combiner is None and codec is None
-                and _nat.available()):
+        if (spec is not None and combiner is None
+                and codec in (None, "lz4") and _nat.available()):
             kind, cuts = spec
-            self._native = _nat.NativeCollector(
-                max(num_partitions, 1), kind, cuts, spill_dir,
-                spill_limit=self.spill_bytes)
+            try:
+                self._native = _nat.NativeCollector(
+                    max(num_partitions, 1), kind, cuts, spill_dir,
+                    spill_limit=self.spill_bytes, codec=codec)
+            except RuntimeError:
+                self._native = None  # e.g. liblz4 absent: Python path
 
     def collect(self, key: bytes, value: bytes) -> None:
         if self._native is not None:

@@ -78,6 +78,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.htpu_coll_feed.restype = ctypes.c_int64
     lib.htpu_coll_feed.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+    lib.htpu_coll_set_lz4.restype = ctypes.c_int
+    lib.htpu_coll_set_lz4.argtypes = [ctypes.c_void_p]
     lib.htpu_coll_close.restype = ctypes.c_int64
     lib.htpu_coll_close.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
@@ -214,16 +216,23 @@ class NativeCollector:
 
     def __init__(self, num_partitions: int, part_kind: int,
                  cuts: Sequence[bytes], spill_dir: str,
-                 spill_limit: int = 256 * 1024 * 1024):
+                 spill_limit: int = 256 * 1024 * 1024,
+                 codec: Optional[str] = None):
         import struct
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native library unavailable")
+        if codec not in (None, "lz4"):
+            raise RuntimeError(f"native collector: no codec {codec!r}")
         self._lib = lib
         packed = b"".join(struct.pack("<I", len(c)) + c for c in cuts)
         self._h = lib.htpu_coll_new(
             num_partitions, part_kind, packed, len(packed),
             spill_limit, spill_dir.encode())
+        if codec == "lz4" and lib.htpu_coll_set_lz4(self._h) != 0:
+            lib.htpu_coll_free(self._h)
+            self._h = None
+            raise RuntimeError("native collector: liblz4 not loadable")
         self.num_partitions = num_partitions
 
     def feed(self, packed: bytes) -> int:

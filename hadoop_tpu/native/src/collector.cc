@@ -33,6 +33,33 @@
 
 extern "C" uint32_t htpu_crc32c(uint32_t crc, const char* data, size_t len);
 
+// --------------------------------------------------------------- lz4 (dlopen)
+//
+// The spill path compresses final IFile segments with lz4 when asked
+// (ref: the reference's nativetask codec support + its bundled lz4).
+// Bound at runtime via dlopen so the build needs no lz4 headers; if the
+// library is absent the collector reports codec-unsupported and the
+// Python engine keeps the compressed path to itself.
+
+#include <dlfcn.h>
+
+typedef int (*lz4_compress_fn)(const char*, char*, int, int);
+typedef int (*lz4_bound_fn)(int);
+
+static lz4_compress_fn g_lz4_compress = nullptr;
+static lz4_bound_fn g_lz4_bound = nullptr;
+
+static bool load_lz4() {
+  if (g_lz4_compress) return true;
+  void* h = dlopen("liblz4.so.1", RTLD_NOW | RTLD_GLOBAL);
+  if (!h) h = dlopen("liblz4.so", RTLD_NOW | RTLD_GLOBAL);
+  if (!h) return false;
+  g_lz4_compress =
+      reinterpret_cast<lz4_compress_fn>(dlsym(h, "LZ4_compress_default"));
+  g_lz4_bound = reinterpret_cast<lz4_bound_fn>(dlsym(h, "LZ4_compressBound"));
+  return g_lz4_compress && g_lz4_bound;
+}
+
 namespace {
 
 struct Rec {
@@ -50,6 +77,7 @@ struct SpillRun {
 
 struct Collector {
   uint32_t num_parts = 1;
+  bool lz4 = false;               // compress final IFile segments
   int part_kind = 0;              // 0 = FNV-1a hash, 1 = range cutpoints
   std::vector<std::string> cuts;  // sorted, R-1 entries (range)
   uint64_t spill_limit = 256ull << 20;
@@ -185,7 +213,9 @@ struct RunReader {
 
 struct IFileWriter {
   FILE* f = nullptr;
+  bool lz4 = false;
   std::vector<uint8_t> seg;  // current segment body
+  std::vector<uint8_t> comp;  // lz4 scratch
   uint64_t file_off = 0;
   // index entries: (offset, stored_len, records)
   std::vector<uint64_t> index;
@@ -203,6 +233,21 @@ struct IFileWriter {
   bool end_segment() {
     static const uint8_t kEof[4] = {0xFF, 0xFF, 0xFF, 0xFF};
     seg.insert(seg.end(), kEof, kEof + 4);
+    if (lz4) {
+      // stored body = u32le(raw size) + lz4 block — the exact frame
+      // io/codecs.py Lz4Codec reads back (CRC covers the stored body,
+      // matching ifile.encode_records' compress-then-crc order)
+      int bound = g_lz4_bound(static_cast<int>(seg.size()));
+      comp.resize(4 + static_cast<size_t>(bound));
+      uint32_t raw = static_cast<uint32_t>(seg.size());
+      std::memcpy(comp.data(), &raw, 4);  // little-endian hosts only
+      int n = g_lz4_compress(reinterpret_cast<const char*>(seg.data()),
+                             reinterpret_cast<char*>(comp.data() + 4),
+                             static_cast<int>(seg.size()), bound);
+      if (n <= 0) return false;
+      comp.resize(4 + static_cast<size_t>(n));
+      seg.swap(comp);
+    }
     uint32_t crc = htpu_crc32c(0, reinterpret_cast<const char*>(seg.data()),
                                seg.size());
     uint8_t crc_be[4] = {static_cast<uint8_t>(crc >> 24),
@@ -250,6 +295,14 @@ void* htpu_coll_new(uint32_t num_partitions, int part_kind,
 
 void htpu_coll_free(void* h) { delete static_cast<Collector*>(h); }
 
+// Enable lz4 output segments. Returns 0 on success, -1 when liblz4 is
+// not loadable (caller falls back to the Python engine).
+int htpu_coll_set_lz4(void* h) {
+  if (!load_lz4()) return -1;
+  static_cast<Collector*>(h)->lz4 = true;
+  return 0;
+}
+
 // Feed one packed batch. Returns number of records consumed, or -1.
 int64_t htpu_coll_feed(void* h, const uint8_t* buf, size_t len) {
   Collector* c = static_cast<Collector*>(h);
@@ -296,6 +349,7 @@ int64_t htpu_coll_close(void* h, const char* path, uint64_t* index_out) {
   sort_recs(c->arena, c->recs);
 
   IFileWriter w;
+  w.lz4 = c->lz4;
   w.f = fopen(path, "wb");
   if (!w.f) return -1;
 
