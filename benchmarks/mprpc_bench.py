@@ -1,0 +1,80 @@
+"""Multi-process RPC throughput: N SO_REUSEPORT workers vs one process.
+
+  python -m benchmarks.mprpc_bench [--seconds 5] [--workers 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+
+def bench_factory(conf):
+    """Stateless echo protocols for every worker (module-level so forked
+    children can import it by path)."""
+    class BenchProtocol:
+        def ping(self, n):
+            return n
+
+        def payload(self, blob):
+            return len(blob)
+    return {"BenchProtocol": BenchProtocol()}
+
+
+def run(seconds: float = 5.0, client_threads: int = 16,
+        workers: int = 4, handlers: int = 4) -> dict:
+    from hadoop_tpu.ipc import Client, get_proxy
+    from hadoop_tpu.ipc.mpserver import MultiProcessServer
+
+    srv = MultiProcessServer(
+        factory="benchmarks.mprpc_bench:bench_factory",
+        num_workers=workers, num_handlers=handlers, name="mpbench")
+    srv.start()
+    stop = threading.Event()
+    counts = [0] * client_threads
+    clients = [Client() for _ in range(client_threads)]
+
+    def worker(idx: int) -> None:
+        proxy = get_proxy("BenchProtocol", ("127.0.0.1", srv.port),
+                          client=clients[idx])
+        n = 0
+        while not stop.is_set():
+            proxy.ping(n)
+            n += 1
+        counts[idx] = n
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(client_threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    dt = time.perf_counter() - t0
+    total = sum(counts)
+    for c in clients:
+        c.stop()
+    alive = srv.alive_workers()
+    srv.stop()
+    return {"calls_per_sec": round(total / dt, 1), "total_calls": total,
+            "client_threads": client_threads, "workers": workers,
+            "handlers_per_worker": handlers, "workers_alive": alive}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=5.0)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--clients", type=int, default=16)
+    args = ap.parse_args()
+    print(json.dumps(run(args.seconds, args.clients, args.workers)))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

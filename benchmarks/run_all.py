@@ -61,6 +61,9 @@ def main() -> None:
     out["nn_throughput_ops_per_sec"] = nn_throughput.run(
         n_ops=int(5000 * scale))
     out["rpc"] = rpc_bench.run(seconds=5.0 * scale)
+    from benchmarks import mprpc_bench
+    out["rpc_multiprocess"] = mprpc_bench.run(seconds=5.0 * scale,
+                                              workers=4)
     out["dfsio"] = dfsio.run(n_files=4, mb_per_file=int(16 * scale) or 2)
     from benchmarks import codec_bench
     out["codecs"] = codec_bench.run(mb=int(64 * scale) or 8)

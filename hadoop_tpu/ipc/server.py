@@ -171,6 +171,7 @@ class Server:
         self._conns: Dict[int, _Connection] = {}
         self._conns_lock = threading.Lock()
         self.max_idle_s = self.conf.get_time_seconds("ipc.client.connection.maxidletime", 120.0)
+        self.reuse_port = self.conf.get_bool("ipc.server.reuseport", False)
         reg = metrics_system().source(f"rpc.{name}")
         self._m_calls = reg.counter("rpc_processing_calls")
         self._m_queue_time = reg.rate("rpc_queue_time")
@@ -200,6 +201,12 @@ class Server:
     def start(self) -> None:
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if self.reuse_port:
+            # multi-process mode: N worker processes bind the SAME port
+            # and the kernel hashes connections across them (see
+            # ipc/mpserver.py; ref: the reference scales Server.Handler
+            # with threads — CPython scales with processes instead)
+            self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
         # A restart on a fixed port can race lingering FIN_WAIT sockets from
         # the previous incarnation's clients; retry briefly instead of dying
         # (SO_REUSEADDR only covers TIME_WAIT).

@@ -433,3 +433,67 @@ def test_retry_cache_timeout_is_retriable():
     assert not owner.done  # we own it and never complete it
     with pytest.raises(RetriableError):
         cache.wait_for_completion(b"c", 1, timeout=0.1)
+
+
+# ------------------------------------------------- multi-process server
+
+def _mp_factory(conf):
+    """Per-worker protocol: reports the serving pid (module-level so
+    forked workers import it by path)."""
+    import os as _os
+
+    class WhoProtocol:
+        def whoserves(self):
+            return _os.getpid()
+
+        def echo(self, x):
+            return x
+    return {"WhoProtocol": WhoProtocol()}
+
+
+def test_multiprocess_server_distributes_and_survives_worker_death():
+    """SO_REUSEPORT worker pool (ref: Server.java scales handlers with
+    threads; CPython scales with processes): connections spread across
+    workers, and killing one worker leaves the port serving."""
+    import os
+    import signal as _signal
+
+    from hadoop_tpu.ipc.mpserver import MultiProcessServer
+
+    srv = MultiProcessServer(factory="tests.test_ipc:_mp_factory",
+                             num_workers=3, num_handlers=2,
+                             name="mp-test")
+    srv.start()
+    try:
+        pids = set()
+        # each Client = fresh connection; the kernel hashes by 4-tuple,
+        # so a handful of distinct source ports reaches >1 worker
+        for _ in range(12):
+            c = Client()
+            try:
+                pid = get_proxy("WhoProtocol", ("127.0.0.1", srv.port),
+                                client=c).whoserves()
+                pids.add(pid)
+            finally:
+                c.stop()
+        assert len(pids) >= 2, f"all connections on one worker: {pids}"
+        assert os.getpid() not in pids  # served by CHILDREN
+
+        # kill one worker: remaining listeners keep the port alive
+        victim = srv._procs[0]
+        os.kill(victim.pid, _signal.SIGKILL)
+        victim.join(timeout=5)
+        ok = 0
+        for _ in range(8):
+            c = Client()
+            try:
+                if get_proxy("WhoProtocol", ("127.0.0.1", srv.port),
+                             client=c).echo(7) == 7:
+                    ok += 1
+            finally:
+                c.stop()
+        assert ok == 8
+        assert srv.alive_workers() == 2
+    finally:
+        srv.stop()
+    assert srv.alive_workers() == 0
