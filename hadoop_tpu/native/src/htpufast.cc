@@ -1,0 +1,702 @@
+// htpufast — async C++ read client speaking the REAL protocols.
+//
+// Fills the libhdfs++ slot (ref: hadoop-hdfs-native-client/src/main/
+// native/libhdfspp/lib/{rpc,reader,connection} — the asynchronous C++
+// client that talks the namenode's RPC protocol and the datanodes'
+// DataTransferProtocol directly, no JVM): where libhtpufs.c detours
+// through the WebHDFS REST gateway, this client speaks the framework's
+// native planes —
+//
+//   * NameNode RPC (wirepack frames over TCP, ClientProtocol
+//     get_block_locations) to resolve a path into located blocks, and
+//   * the DN datatransfer protocol (OP_READ_BLOCK packet streams with
+//     per-chunk CRC32C verification, block access tokens passed
+//     through) for the data itself,
+//
+// with an epoll engine that keeps every block's replica stream in
+// flight CONCURRENTLY — the async fan-out that is the point of
+// libhdfs++. Failed replicas fail over to the next location.
+//
+// Scope: SIMPLE-auth clusters (the SASL/encrypted data plane stays
+// with the Python client); wirepack codec implemented here against the
+// format spec in io/wire.py (tag space documented in wirepack.c).
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <stdarg.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+extern "C" uint32_t htpu_crc32c(uint32_t crc, const char* data, size_t len);
+
+namespace {
+
+// ------------------------------------------------------------- wirepack
+
+struct Value {
+  enum Kind { NIL, BOOL, INT, FLOAT, STR, BIN, ARR, MAP } kind = NIL;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0;
+  std::string s;  // STR and BIN both live here
+  std::vector<Value> arr;
+  std::vector<std::pair<std::string, Value>> map;  // string keys only
+
+  const Value* get(const std::string& key) const {
+    for (auto& kv : map)
+      if (kv.first == key) return &kv.second;
+    return nullptr;
+  }
+  int64_t get_int(const std::string& key, int64_t dflt = 0) const {
+    const Value* v = get(key);
+    return v && v->kind == INT ? v->i : dflt;
+  }
+  bool truthy() const {
+    switch (kind) {
+      case NIL: return false;
+      case BOOL: return b;
+      case INT: return i != 0;
+      case FLOAT: return f != 0;
+      case STR: case BIN: return !s.empty();
+      case ARR: return !arr.empty();
+      case MAP: return !map.empty();
+    }
+    return false;
+  }
+};
+
+Value vstr(const std::string& s) {
+  Value v; v.kind = Value::STR; v.s = s; return v;
+}
+Value vint(int64_t i) {
+  Value v; v.kind = Value::INT; v.i = i; return v;
+}
+
+void enc_uvarint(std::string& out, uint64_t n) {
+  do {
+    uint8_t b = n & 0x7F;
+    n >>= 7;
+    out.push_back(static_cast<char>(n ? (b | 0x80) : b));
+  } while (n);
+}
+
+void encode(std::string& out, const Value& v) {
+  switch (v.kind) {
+    case Value::NIL: out.push_back('\xC0'); return;
+    case Value::BOOL: out.push_back(v.b ? '\xC3' : '\xC2'); return;
+    case Value::INT: {
+      if (v.i >= 0 && v.i <= 0x7F) {
+        out.push_back(static_cast<char>(v.i));
+      } else if (v.i >= -32 && v.i < 0) {
+        out.push_back(static_cast<char>(0x100 + v.i));
+      } else {
+        out.push_back('\xC6');
+        uint64_t zz = v.i >= 0 ? (static_cast<uint64_t>(v.i) << 1)
+                               : ((static_cast<uint64_t>(-(v.i + 1)) << 1) + 1);
+        enc_uvarint(out, zz);
+      }
+      return;
+    }
+    case Value::FLOAT: {
+      out.push_back('\xC7');
+      uint64_t bits;
+      memcpy(&bits, &v.f, 8);
+      for (int k = 7; k >= 0; k--)
+        out.push_back(static_cast<char>((bits >> (8 * k)) & 0xFF));
+      return;
+    }
+    case Value::STR: {
+      if (v.s.size() <= 31) {
+        out.push_back(static_cast<char>(0xA0 | v.s.size()));
+      } else {
+        out.push_back('\xC5');
+        enc_uvarint(out, v.s.size());
+      }
+      out += v.s;
+      return;
+    }
+    case Value::BIN: {
+      out.push_back('\xC4');
+      enc_uvarint(out, v.s.size());
+      out += v.s;
+      return;
+    }
+    case Value::ARR: {
+      if (v.arr.size() <= 15) {
+        out.push_back(static_cast<char>(0x90 | v.arr.size()));
+      } else {
+        out.push_back('\xC8');
+        enc_uvarint(out, v.arr.size());
+      }
+      for (auto& e : v.arr) encode(out, e);
+      return;
+    }
+    case Value::MAP: {
+      if (v.map.size() <= 15) {
+        out.push_back(static_cast<char>(0x80 | v.map.size()));
+      } else {
+        out.push_back('\xC9');
+        enc_uvarint(out, v.map.size());
+      }
+      for (auto& kv : v.map) {
+        encode(out, vstr(kv.first));
+        encode(out, kv.second);
+      }
+      return;
+    }
+  }
+}
+
+struct Decoder {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool fail = false;
+
+  uint64_t uvarint() {
+    uint64_t n = 0;
+    int shift = 0;
+    while (p < end) {
+      uint8_t b = *p++;
+      n |= static_cast<uint64_t>(b & 0x7F) << shift;
+      if (!(b & 0x80)) return n;
+      shift += 7;
+      if (shift > 63) break;
+    }
+    fail = true;
+    return 0;
+  }
+
+  bool take(size_t n, std::string& out) {
+    if (static_cast<size_t>(end - p) < n) { fail = true; return false; }
+    out.assign(reinterpret_cast<const char*>(p), n);
+    p += n;
+    return true;
+  }
+
+  Value value(int depth = 0) {
+    Value v;
+    if (fail || depth > 100 || p >= end) { fail = true; return v; }
+    uint8_t t = *p++;
+    if (t <= 0x7F) { v.kind = Value::INT; v.i = t; return v; }
+    if (t >= 0xE0) { v.kind = Value::INT; v.i = static_cast<int8_t>(t); return v; }
+    if ((t & 0xF0) == 0x80 || t == 0xC9) {
+      size_t n = (t == 0xC9) ? uvarint() : (t & 0x0F);
+      v.kind = Value::MAP;
+      for (size_t k = 0; k < n && !fail; k++) {
+        Value key = value(depth + 1);
+        Value val = value(depth + 1);
+        v.map.emplace_back(key.s, std::move(val));
+      }
+      return v;
+    }
+    if ((t & 0xF0) == 0x90 || t == 0xC8) {
+      size_t n = (t == 0xC8) ? uvarint() : (t & 0x0F);
+      v.kind = Value::ARR;
+      for (size_t k = 0; k < n && !fail; k++)
+        v.arr.push_back(value(depth + 1));
+      return v;
+    }
+    if ((t & 0xE0) == 0xA0 || t == 0xC5) {
+      size_t n = (t == 0xC5) ? uvarint() : (t & 0x1F);
+      v.kind = Value::STR;
+      take(n, v.s);
+      return v;
+    }
+    switch (t) {
+      case 0xC0: return v;
+      case 0xC2: v.kind = Value::BOOL; v.b = false; return v;
+      case 0xC3: v.kind = Value::BOOL; v.b = true; return v;
+      case 0xC4: {
+        size_t n = uvarint();
+        v.kind = Value::BIN;
+        take(n, v.s);
+        return v;
+      }
+      case 0xC6: {
+        uint64_t zz = uvarint();
+        v.kind = Value::INT;
+        v.i = (zz & 1) ? -static_cast<int64_t>(zz >> 1) - 1
+                       : static_cast<int64_t>(zz >> 1);
+        return v;
+      }
+      case 0xC7: {
+        if (end - p < 8) { fail = true; return v; }
+        uint64_t bits = 0;
+        for (int k = 0; k < 8; k++) bits = (bits << 8) | *p++;
+        v.kind = Value::FLOAT;
+        memcpy(&v.f, &bits, 8);
+        return v;
+      }
+    }
+    fail = true;
+    return v;
+  }
+};
+
+// ---------------------------------------------------------- blocking IO
+
+int dial(const char* host, int port, char* err, size_t errlen) {
+  char portbuf[16];
+  snprintf(portbuf, sizeof portbuf, "%d", port);
+  struct addrinfo hints, *res = nullptr;
+  memset(&hints, 0, sizeof hints);
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  if (getaddrinfo(host, portbuf, &hints, &res) != 0 || !res) {
+    snprintf(err, errlen, "resolve %s failed", host);
+    return -1;
+  }
+  int fd = socket(res->ai_family, res->ai_socktype, 0);
+  int rc = fd < 0 ? -1 : connect(fd, res->ai_addr, res->ai_addrlen);
+  freeaddrinfo(res);
+  if (rc != 0) {
+    if (fd >= 0) close(fd);
+    snprintf(err, errlen, "connect %s:%d failed: %s", host, port,
+             strerror(errno));
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  return fd;
+}
+
+bool write_frame(int fd, const std::string& body) {
+  uint32_t n = htonl(static_cast<uint32_t>(body.size()));
+  std::string out(reinterpret_cast<char*>(&n), 4);
+  out += body;
+  size_t off = 0;
+  while (off < out.size()) {
+    ssize_t w = write(fd, out.data() + off, out.size() - off);
+    if (w <= 0) return false;
+    off += static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool read_exact(int fd, void* buf, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t r = read(fd, static_cast<char*>(buf) + off, n - off);
+    if (r <= 0) return false;
+    off += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool read_frame(int fd, std::string& body, size_t max = 256u << 20) {
+  uint32_t n;
+  if (!read_exact(fd, &n, 4)) return false;
+  n = ntohl(n);
+  if (n > max) return false;
+  body.resize(n);
+  return n == 0 || read_exact(fd, &body[0], n);
+}
+
+// ------------------------------------------------------------- NN RPC
+
+struct Fs {
+  std::string nn_host;
+  int nn_port = 0;
+  std::string user = "root";
+  char err[512] = {0};
+
+  void set_err(const char* fmt, ...) {
+    va_list ap;
+    va_start(ap, fmt);
+    vsnprintf(err, sizeof err, fmt, ap);
+    va_end(ap);
+  }
+};
+
+// One-shot RPC (connection header + single call). The Python client
+// multiplexes long-lived connections; for a read client the resolve
+// call is rare enough that simplicity wins.
+bool rpc_call(Fs* fs, const char* method, std::vector<Value> args,
+              Value* out) {
+  int fd = dial(fs->nn_host.c_str(), fs->nn_port, fs->err, sizeof fs->err);
+  if (fd < 0) return false;
+  bool ok = false;
+  std::string frame;
+  Value hdr;
+  hdr.kind = Value::MAP;
+  hdr.map.emplace_back("magic", vstr("htpu1"));
+  hdr.map.emplace_back("protocol", vstr("ClientProtocol"));
+  hdr.map.emplace_back("user", vstr(fs->user));
+  hdr.map.emplace_back("real", Value());
+  hdr.map.emplace_back("auth", vstr("SIMPLE"));
+  std::string body;
+  encode(body, hdr);
+
+  Value req;
+  req.kind = Value::MAP;
+  req.map.emplace_back("id", vint(1));
+  req.map.emplace_back("p", vstr("ClientProtocol"));
+  req.map.emplace_back("m", vstr(method));
+  Value a;
+  a.kind = Value::ARR;
+  a.arr = std::move(args);
+  req.map.emplace_back("a", std::move(a));
+  std::string call;
+  encode(call, req);
+
+  std::string reply;
+  if (!write_frame(fd, body) || !write_frame(fd, call) ||
+      !read_frame(fd, reply)) {
+    fs->set_err("rpc %s: connection failed", method);
+    close(fd);
+    return false;
+  }
+  Decoder d{reinterpret_cast<const uint8_t*>(reply.data()),
+            reinterpret_cast<const uint8_t*>(reply.data()) + reply.size()};
+  *out = d.value();
+  if (d.fail || out->kind != Value::MAP) {
+    fs->set_err("rpc %s: undecodable reply", method);
+  } else if (const Value* fatal = out->get("fatal");
+             fatal && fatal->truthy()) {
+    const Value* em = out->get("em");
+    fs->set_err("rpc %s: fatal: %s", method,
+                em ? em->s.c_str() : "unknown");
+  } else if (const Value* okv = out->get("ok"); !okv || !okv->truthy()) {
+    const Value* em = out->get("em");
+    fs->set_err("rpc %s failed: %s", method,
+                em ? em->s.c_str() : "remote error");
+  } else {
+    ok = true;
+  }
+  close(fd);
+  return ok;
+}
+
+// ------------------------------------------------------ async block read
+
+constexpr int kChunk = 512;  // dfs.bytes-per-checksum
+
+struct Stream {
+  // one located block: its replicas, output placement, protocol state
+  Value block_wire;              // {"id","gs","nb"} map
+  Value token;                   // block access token or NIL
+  std::vector<std::pair<std::string, int>> replicas;
+  size_t next_replica = 0;
+  int64_t file_off = 0;          // where this block's bytes land
+  int64_t want = 0;              // bytes to read (whole block here)
+  int fd = -1;
+  bool setup_seen = false;
+  bool done = false;
+  std::string inbuf;             // partial frames
+  std::string outq;              // pending request bytes
+  int64_t got = 0;
+  std::string fail_reason;
+
+  bool start(uint8_t* dst);
+  bool on_readable(uint8_t* dst, Fs* fs);
+  bool on_writable();
+};
+
+bool Stream::start(uint8_t*) {
+  while (next_replica < replicas.size()) {
+    auto& [host, port] = replicas[next_replica];
+    next_replica++;
+    char err[128];
+    fd = dial(host.c_str(), port, err, sizeof err);
+    if (fd < 0) continue;
+    // async from here on
+    fcntl(fd, F_SETFL, O_NONBLOCK);
+    Value req;
+    req.kind = Value::MAP;
+    req.map.emplace_back("op", vstr("read_block"));
+    req.map.emplace_back("b", block_wire);
+    req.map.emplace_back("offset", vint(0));
+    req.map.emplace_back("length", vint(want));
+    if (token.kind != Value::NIL)
+      req.map.emplace_back("tok", token);
+    std::string body;
+    encode(body, req);
+    uint32_t n = htonl(static_cast<uint32_t>(body.size()));
+    outq.assign(reinterpret_cast<char*>(&n), 4);
+    outq += body;
+    inbuf.clear();
+    setup_seen = false;
+    got = 0;
+    return true;
+  }
+  fail_reason = "no replica reachable";
+  return false;
+}
+
+bool Stream::on_writable() {
+  while (!outq.empty()) {
+    ssize_t w = write(fd, outq.data(), outq.size());
+    if (w > 0) {
+      outq.erase(0, static_cast<size_t>(w));
+    } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
+      return true;
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+// drain frames from inbuf; returns false on stream error
+bool Stream::on_readable(uint8_t* dst, Fs* fs) {
+  // Drain the socket first, PARSE second: the DN closes right after
+  // the last frame, so EOF must fall through to the parser instead of
+  // failing a stream whose bytes are all here already.
+  char buf[256 * 1024];
+  bool eof = false;
+  while (true) {
+    ssize_t r = read(fd, buf, sizeof buf);
+    if (r > 0) {
+      inbuf.append(buf, static_cast<size_t>(r));
+    } else if (r == 0) {
+      eof = true;
+      break;
+    } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
+      break;
+    } else {
+      fail_reason = strerror(errno);
+      return false;
+    }
+  }
+  size_t off = 0;
+  while (inbuf.size() - off >= 4) {
+    uint32_t n;
+    memcpy(&n, inbuf.data() + off, 4);
+    n = ntohl(n);
+    if (inbuf.size() - off - 4 < n) break;
+    Decoder d{reinterpret_cast<const uint8_t*>(inbuf.data()) + off + 4,
+              reinterpret_cast<const uint8_t*>(inbuf.data()) + off + 4 + n};
+    Value msg = d.value();
+    off += 4 + n;
+    if (d.fail || msg.kind != Value::MAP) {
+      fail_reason = "undecodable frame";
+      return false;
+    }
+    if (!setup_seen) {
+      const Value* okv = msg.get("ok");
+      if (!okv || !okv->truthy()) {
+        const Value* em = msg.get("em");
+        fail_reason = em ? em->s : "read setup refused";
+        return false;
+      }
+      setup_seen = true;
+      continue;
+    }
+    if (const Value* last = msg.get("last"); last && last->truthy()) {
+      inbuf.erase(0, off);
+      if (got != want) {
+        fail_reason = "short block stream";
+        return false;
+      }
+      done = true;
+      return true;
+    }
+    const Value* data = msg.get("data");
+    const Value* sums = msg.get("sums");
+    int64_t pkt_off = msg.get_int("off", -1);
+    if (!data || !sums || pkt_off < 0) {
+      fail_reason = "malformed packet";
+      return false;
+    }
+    // CRC32C per chunk (ref: DataChecksum.verifyChunkedSums)
+    size_t n_chunks = (data->s.size() + kChunk - 1) / kChunk;
+    if (sums->s.size() < 4 * n_chunks) {
+      fail_reason = "missing checksums";
+      return false;
+    }
+    for (size_t c = 0; c < n_chunks; c++) {
+      size_t clen = std::min(static_cast<size_t>(kChunk),
+                             data->s.size() - c * kChunk);
+      uint32_t crc = htpu_crc32c(0, data->s.data() + c * kChunk, clen);
+      uint32_t expect =
+          (static_cast<uint8_t>(sums->s[4 * c]) << 24) |
+          (static_cast<uint8_t>(sums->s[4 * c + 1]) << 16) |
+          (static_cast<uint8_t>(sums->s[4 * c + 2]) << 8) |
+          static_cast<uint8_t>(sums->s[4 * c + 3]);
+      if (crc != expect) {
+        fail_reason = "checksum mismatch";
+        return false;
+      }
+    }
+    int64_t copy = std::min<int64_t>(data->s.size(), want - pkt_off);
+    if (copy > 0)
+      memcpy(dst + file_off + pkt_off, data->s.data(), copy);
+    got = pkt_off + copy;
+    (void)fs;
+  }
+  inbuf.erase(0, off);
+  if (eof && !done) {
+    fail_reason = "stream closed mid-block";
+    return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+// ------------------------------------------------------------- public API
+
+extern "C" {
+
+void* htpufast_open(const char* nn_host, int nn_port, const char* user) {
+  Fs* fs = new Fs();
+  fs->nn_host = nn_host ? nn_host : "127.0.0.1";
+  fs->nn_port = nn_port;
+  if (user && *user) fs->user = user;
+  return fs;
+}
+
+void htpufast_close(void* h) { delete static_cast<Fs*>(h); }
+
+const char* htpufast_error(void* h) {
+  return h ? static_cast<Fs*>(h)->err : "null handle";
+}
+
+// File length via get_file_info (so callers can size the buffer).
+int64_t htpufast_file_length(void* h, const char* path) {
+  Fs* fs = static_cast<Fs*>(h);
+  Value reply;
+  if (!rpc_call(fs, "get_file_info", {vstr(path)}, &reply)) return -1;
+  const Value* val = reply.get("val");
+  if (!val || val->kind != Value::MAP) {
+    fs->set_err("no such file: %s", path);
+    return -1;
+  }
+  return val->get_int("len", val->get_int("length", -1));
+}
+
+// Read the whole file into buf (cap bytes). Every block's replica
+// stream runs concurrently under one epoll. Returns bytes read or -1.
+int64_t htpufast_read_file(void* h, const char* path, uint8_t* buf,
+                           int64_t cap) {
+  Fs* fs = static_cast<Fs*>(h);
+  Value reply;
+  if (!rpc_call(fs, "get_block_locations", {vstr(path), vint(0),
+                                            vint(INT64_MAX / 2)},
+                &reply))
+    return -1;
+  const Value* val = reply.get("val");
+  if (!val || val->kind != Value::MAP) {
+    fs->set_err("bad locations reply for %s", path);
+    return -1;
+  }
+  int64_t length = val->get_int("length", 0);
+  if (length > cap) {
+    fs->set_err("buffer too small: need %lld",
+                static_cast<long long>(length));
+    return -1;
+  }
+  const Value* blocks = val->get("blocks");
+  if (!blocks || blocks->kind != Value::ARR) {
+    fs->set_err("no blocks for %s", path);
+    return -1;
+  }
+
+  std::vector<std::unique_ptr<Stream>> streams;
+  for (const Value& lb : blocks->arr) {
+    auto st = std::make_unique<Stream>();
+    const Value* b = lb.get("b");
+    if (!b) continue;
+    st->block_wire = *b;
+    if (const Value* tok = lb.get("tok")) st->token = *tok;
+    st->file_off = lb.get_int("off", 0);
+    st->want = b->get_int("nb", 0);
+    if (const Value* locs = lb.get("locs")) {
+      for (const Value& dn : locs->arr) {
+        const Value* hv = dn.get("h");
+        st->replicas.emplace_back(hv ? hv->s : "127.0.0.1",
+                                  static_cast<int>(dn.get_int("xp", 0)));
+      }
+    }
+    if (st->want > 0) streams.push_back(std::move(st));
+  }
+
+  int ep = epoll_create1(0);
+  if (ep < 0) {
+    fs->set_err("epoll_create failed");
+    return -1;
+  }
+  std::map<int, Stream*> by_fd;
+  auto arm = [&](Stream* st) -> bool {
+    if (!st->start(buf)) return false;
+    epoll_event ev{};
+    ev.events = EPOLLIN | EPOLLOUT;
+    ev.data.ptr = st;
+    epoll_ctl(ep, EPOLL_CTL_ADD, st->fd, &ev);
+    by_fd[st->fd] = st;
+    return true;
+  };
+  size_t live = 0;
+  bool failed = false;
+  for (auto& st : streams) {
+    if (arm(st.get())) {
+      live++;
+    } else {
+      fs->set_err("block read failed: %s", st->fail_reason.c_str());
+      failed = true;
+    }
+  }
+  epoll_event events[64];
+  while (live > 0 && !failed) {
+    int n = epoll_wait(ep, events, 64, 30000);
+    if (n <= 0) {
+      fs->set_err("epoll wait failed/timeout");
+      failed = true;
+      break;
+    }
+    for (int k = 0; k < n; k++) {
+      Stream* st = static_cast<Stream*>(events[k].data.ptr);
+      if (st->done || st->fd < 0) continue;
+      bool ok = true;
+      if (events[k].events & EPOLLOUT) ok = st->on_writable();
+      if (ok && (events[k].events & (EPOLLIN | EPOLLHUP)))
+        ok = st->on_readable(buf, fs);
+      if (st->done) {
+        epoll_ctl(ep, EPOLL_CTL_DEL, st->fd, nullptr);
+        close(st->fd);
+        by_fd.erase(st->fd);
+        st->fd = -1;
+        live--;
+      } else if (!ok) {
+        // replica failover: retry this block on its next location
+        std::string prior = st->fail_reason;
+        epoll_ctl(ep, EPOLL_CTL_DEL, st->fd, nullptr);
+        close(st->fd);
+        by_fd.erase(st->fd);
+        st->fd = -1;
+        if (!arm(st)) {
+          fs->set_err("block at %lld unreadable: %s (stream error: %s)",
+                      static_cast<long long>(st->file_off),
+                      st->fail_reason.c_str(), prior.c_str());
+          failed = true;
+          break;
+        }
+      } else if (st->outq.empty()) {
+        // request fully sent: stop polling writability
+        epoll_event ev{};
+        ev.events = EPOLLIN;
+        ev.data.ptr = st;
+        epoll_ctl(ep, EPOLL_CTL_MOD, st->fd, &ev);
+      }
+    }
+  }
+  for (auto& kv : by_fd) close(kv.first);
+  close(ep);
+  return failed ? -1 : length;
+}
+
+}  // extern "C"
