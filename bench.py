@@ -40,13 +40,21 @@ PEAK_BF16_FLOPS = {
 }
 
 # Fallback ladder: (preset, batch, remat, subprocess wall budget seconds).
-# flagship-1b at batch 4 + full remat was the best measured config in
-# round 3 exploration; flagship-420m is the verified round-2 config
-# (BENCH_r02.json, MFU 0.3328); tiny exists so an outage-day run still
-# records *a* number rather than nothing.
+# "dots" (selective) remat rungs come FIRST: full remat re-runs the
+# whole forward in backward, so the hardware spends ~4 units of matmul
+# per 3 units the MFU formula credits — selective remat keeps MXU
+# outputs and replays only elementwise/norm work, so nearly every
+# hardware FLOP is a counted FLOP (expected ~+30% measured MFU at
+# equal utilization; see PROFILE.md). A dots rung that OOMs just falls
+# through to its full-remat sibling. flagship-1b batch 4 + full remat
+# was round 3's best explored config; flagship-420m batch 8 full is
+# the verified round-2 number (MFU 0.3328); tiny exists so an
+# outage-day run still records *a* number rather than nothing.
 LADDER = [
-    ("flagship-1b", 4, "full", 1500.0),
-    ("flagship-420m", 8, "full", 720.0),
+    ("flagship-1b", 4, "dots", 1200.0),
+    ("flagship-1b", 4, "full", 900.0),
+    ("flagship-420m", 8, "dots", 600.0),
+    ("flagship-420m", 8, "full", 450.0),
     ("tiny", 8, "none", 300.0),
 ]
 
