@@ -1,0 +1,443 @@
+/* fuse-dfs — mount the DFS as a local filesystem.
+ *
+ * Parity with the reference's FUSE module (ref:
+ * hadoop-hdfs-native-client/src/main/native/fuse-dfs/fuse_dfs.c +
+ * fuse_impls_*.c — a FUSE 2.x filesystem over libhdfs): this one sits
+ * on libhtpufs (the dependency-free WebHDFS C client in this tree), so
+ * `ls/cat/cp/mkdir/rm/mv` work on a mounted namespace with zero Python
+ * or JVM in the mount daemon.
+ *
+ * The FUSE 2.9 API is declared here directly against its stable ABI
+ * (the distro ships libfuse.so.2 without headers); only the operations
+ * this filesystem implements are populated, the rest stay NULL, and
+ * fuse_main_real receives sizeof our struct so newer fields are never
+ * read. Write model: whole-file staging like the reference's fuse-dfs
+ * O_WRONLY path — writes buffer in the daemon and upload on release()
+ * (random-access rewrite of existing data is rejected with EROFS-like
+ * errno, matching HDFS append-only semantics).
+ *
+ *   htpu-fuse-dfs <nn-http-host> <nn-http-port> <mountpoint> [-f]
+ */
+
+#define _GNU_SOURCE
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <sys/statvfs.h>
+#include <sys/types.h>
+#include <time.h>
+#include <unistd.h>
+
+/* ------------------------------------------------- libhtpufs (same tree) */
+
+typedef struct htpufs_internal *htpuFS;
+extern htpuFS htpufs_connect(const char *host, int port);
+extern void htpufs_disconnect(htpuFS fs);
+extern const char *htpufs_last_error(htpuFS fs);
+extern int htpufs_exists(htpuFS fs, const char *path);
+extern int htpufs_stat(htpuFS fs, const char *path, int64_t *size,
+                       int *is_dir);
+extern int htpufs_mkdirs(htpuFS fs, const char *path);
+extern int htpufs_delete(htpuFS fs, const char *path, int recursive);
+extern int htpufs_rename(htpuFS fs, const char *src, const char *dst);
+extern int64_t htpufs_pread(htpuFS fs, const char *path, int64_t offset,
+                            char *buf, int64_t length);
+extern int htpufs_write_file(htpuFS fs, const char *path, const char *data,
+                             int64_t len, int overwrite);
+extern int htpufs_list(htpuFS fs, const char *path, char ***names_out,
+                       int *n_out);
+extern void htpufs_free_listing(char **names, int n);
+
+/* --------------------------------------------- FUSE 2.9 ABI declarations */
+
+struct fuse_file_info {
+  int flags;
+  unsigned long fh_old;
+  int writepage;
+  unsigned int direct_io : 1;
+  unsigned int keep_cache : 1;
+  unsigned int flush : 1;
+  unsigned int nonseekable : 1;
+  unsigned int flock_release : 1;
+  unsigned int padding : 27;
+  uint64_t fh;
+  uint64_t lock_owner;
+};
+
+typedef int (*fuse_fill_dir_t)(void *buf, const char *name,
+                               const struct stat *stbuf, off_t off);
+struct fuse_conn_info; /* opaque: only passed through */
+
+struct fuse_operations {
+  int (*getattr)(const char *, struct stat *);
+  int (*readlink)(const char *, char *, size_t);
+  void *getdir; /* deprecated slot */
+  int (*mknod)(const char *, mode_t, dev_t);
+  int (*mkdir)(const char *, mode_t);
+  int (*unlink)(const char *);
+  int (*rmdir)(const char *);
+  int (*symlink)(const char *, const char *);
+  int (*rename)(const char *, const char *);
+  int (*link)(const char *, const char *);
+  int (*chmod)(const char *, mode_t);
+  int (*chown)(const char *, uid_t, gid_t);
+  int (*truncate)(const char *, off_t);
+  void *utime; /* deprecated slot */
+  int (*open)(const char *, struct fuse_file_info *);
+  int (*read)(const char *, char *, size_t, off_t,
+              struct fuse_file_info *);
+  int (*write)(const char *, const char *, size_t, off_t,
+               struct fuse_file_info *);
+  int (*statfs)(const char *, struct statvfs *);
+  int (*flush)(const char *, struct fuse_file_info *);
+  int (*release)(const char *, struct fuse_file_info *);
+  int (*fsync)(const char *, int, struct fuse_file_info *);
+  void *setxattr;
+  void *getxattr;
+  void *listxattr;
+  void *removexattr;
+  int (*opendir)(const char *, struct fuse_file_info *);
+  int (*readdir)(const char *, void *, fuse_fill_dir_t, off_t,
+                 struct fuse_file_info *);
+  int (*releasedir)(const char *, struct fuse_file_info *);
+  int (*fsyncdir)(const char *, int, struct fuse_file_info *);
+  void *(*init)(struct fuse_conn_info *conn);
+  void (*destroy)(void *);
+  int (*access)(const char *, int);
+  int (*create)(const char *, mode_t, struct fuse_file_info *);
+  int (*ftruncate)(const char *, off_t, struct fuse_file_info *);
+  int (*fgetattr)(const char *, struct stat *, struct fuse_file_info *);
+  void *lock;
+  int (*utimens)(const char *, const struct timespec tv[2]);
+  void *bmap;
+  unsigned int flag_nullpath_ok : 1;
+  unsigned int flag_nopath : 1;
+  unsigned int flag_utime_omit_ok : 1;
+  unsigned int flag_reserved : 29;
+  void *ioctl;
+  void *poll;
+  void *write_buf;
+  void *read_buf;
+  void *flock;
+  void *fallocate;
+};
+
+extern int fuse_main_real(int argc, char *argv[],
+                          const struct fuse_operations *op, size_t op_size,
+                          void *user_data);
+
+/* ------------------------------------------------------------- the fs */
+
+static htpuFS g_fs;
+static pthread_mutex_t g_lock = PTHREAD_MUTEX_INITIALIZER;
+
+/* write-staging handle: whole file buffered, uploaded on release */
+struct staged {
+  char *buf;
+  int64_t len, cap;
+  int dirty;
+  char path[1024];
+  struct staged *next;
+};
+
+/* in-flight staged files must be visible to getattr BEFORE the upload
+ * (the kernel stats a path right after create()) */
+static struct staged *g_staged;
+
+static void staged_add(struct staged *stg) {
+  pthread_mutex_lock(&g_lock);
+  stg->next = g_staged;
+  g_staged = stg;
+  pthread_mutex_unlock(&g_lock);
+}
+
+static void staged_remove(struct staged *stg) {
+  pthread_mutex_lock(&g_lock);
+  for (struct staged **pp = &g_staged; *pp; pp = &(*pp)->next) {
+    if (*pp == stg) {
+      *pp = stg->next;
+      break;
+    }
+  }
+  pthread_mutex_unlock(&g_lock);
+}
+
+static int staged_stat(const char *path, int64_t *size) {
+  pthread_mutex_lock(&g_lock);
+  for (struct staged *st = g_staged; st; st = st->next) {
+    if (strcmp(st->path, path) == 0) {
+      *size = st->len;
+      pthread_mutex_unlock(&g_lock);
+      return 1;
+    }
+  }
+  pthread_mutex_unlock(&g_lock);
+  return 0;
+}
+
+static int dfs_getattr(const char *path, struct stat *st) {
+  memset(st, 0, sizeof *st);
+  int64_t size = 0;
+  int is_dir = 0;
+  if (staged_stat(path, &size)) {
+    st->st_mode = S_IFREG | 0644;
+    st->st_nlink = 1;
+    st->st_size = size;
+    st->st_uid = getuid();
+    st->st_gid = getgid();
+    st->st_mtime = time(NULL);
+    return 0;
+  }
+  pthread_mutex_lock(&g_lock);
+  int rc = htpufs_stat(g_fs, path, &size, &is_dir);
+  pthread_mutex_unlock(&g_lock);
+  if (rc != 0) return -ENOENT;
+  if (is_dir) {
+    st->st_mode = S_IFDIR | 0755;
+    st->st_nlink = 2;
+  } else {
+    st->st_mode = S_IFREG | 0644;
+    st->st_nlink = 1;
+    st->st_size = size;
+  }
+  st->st_uid = getuid();
+  st->st_gid = getgid();
+  st->st_mtime = time(NULL);
+  return 0;
+}
+
+static int dfs_readdir(const char *path, void *buf, fuse_fill_dir_t fill,
+                       off_t off, struct fuse_file_info *fi) {
+  (void)off;
+  (void)fi;
+  char **names = NULL;
+  int n = 0;
+  pthread_mutex_lock(&g_lock);
+  int rc = htpufs_list(g_fs, path, &names, &n);
+  pthread_mutex_unlock(&g_lock);
+  if (rc != 0) return -ENOENT;
+  fill(buf, ".", NULL, 0);
+  fill(buf, "..", NULL, 0);
+  for (int i = 0; i < n; i++) {
+    const char *base = strrchr(names[i], '/');
+    fill(buf, base ? base + 1 : names[i], NULL, 0);
+  }
+  htpufs_free_listing(names, n);
+  return 0;
+}
+
+static int dfs_mkdir(const char *path, mode_t mode) {
+  (void)mode;
+  pthread_mutex_lock(&g_lock);
+  int rc = htpufs_mkdirs(g_fs, path);
+  pthread_mutex_unlock(&g_lock);
+  return rc == 0 ? 0 : -EIO;
+}
+
+static int dfs_unlink(const char *path) {
+  pthread_mutex_lock(&g_lock);
+  int rc = htpufs_delete(g_fs, path, 0);
+  pthread_mutex_unlock(&g_lock);
+  return rc == 0 ? 0 : -ENOENT;
+}
+
+static int dfs_rmdir(const char *path) { return dfs_unlink(path); }
+
+static int dfs_rename(const char *src, const char *dst) {
+  pthread_mutex_lock(&g_lock);
+  int rc = htpufs_rename(g_fs, src, dst);
+  pthread_mutex_unlock(&g_lock);
+  return rc == 0 ? 0 : -EIO;
+}
+
+static int dfs_open(const char *path, struct fuse_file_info *fi) {
+  if ((fi->flags & O_ACCMODE) != O_RDONLY) {
+    /* write handles stage locally (append-only store; rewrite of
+     * existing bytes is not supported — like the reference fuse-dfs) */
+    struct staged *stg = calloc(1, sizeof *stg);
+    if (!stg) return -ENOMEM;
+    stg->dirty = 0;
+    snprintf(stg->path, sizeof stg->path, "%s", path);
+    staged_add(stg);
+    fi->fh = (uint64_t)(uintptr_t)stg;
+    return 0;
+  }
+  fi->fh = 0;
+  pthread_mutex_lock(&g_lock);
+  int ex = htpufs_exists(g_fs, path);
+  pthread_mutex_unlock(&g_lock);
+  return ex == 1 ? 0 : -ENOENT;
+}
+
+static int dfs_create(const char *path, mode_t mode,
+                      struct fuse_file_info *fi) {
+  (void)mode;
+  struct staged *stg = calloc(1, sizeof *stg);
+  if (!stg) return -ENOMEM;
+  stg->dirty = 1; /* empty file must be uploaded even with no writes */
+  snprintf(stg->path, sizeof stg->path, "%s", path);
+  staged_add(stg);
+  fi->fh = (uint64_t)(uintptr_t)stg;
+  return 0;
+}
+
+static int dfs_read(const char *path, char *buf, size_t size, off_t off,
+                    struct fuse_file_info *fi) {
+  (void)fi;
+  pthread_mutex_lock(&g_lock);
+  int64_t n = htpufs_pread(g_fs, path, (int64_t)off, buf, (int64_t)size);
+  pthread_mutex_unlock(&g_lock);
+  return n < 0 ? -EIO : (int)n;
+}
+
+static int dfs_write(const char *path, const char *data, size_t size,
+                     off_t off, struct fuse_file_info *fi) {
+  (void)path;
+  struct staged *stg = (struct staged *)(uintptr_t)fi->fh;
+  if (!stg) return -EBADF;
+  if ((int64_t)off != stg->len) return -ENOTSUP; /* sequential only */
+  if (stg->len + (int64_t)size > stg->cap) {
+    int64_t ncap = stg->cap ? stg->cap * 2 : 65536;
+    while (ncap < stg->len + (int64_t)size) ncap *= 2;
+    char *nb = realloc(stg->buf, ncap);
+    if (!nb) return -ENOMEM;
+    stg->buf = nb;
+    stg->cap = ncap;
+  }
+  memcpy(stg->buf + stg->len, data, size);
+  stg->len += (int64_t)size;
+  stg->dirty = 1;
+  return (int)size;
+}
+
+static int upload_staged(const char *path, struct staged *stg) {
+  if (!stg || !stg->dirty) return 0;
+  pthread_mutex_lock(&g_lock);
+  int rc = htpufs_write_file(g_fs, path, stg->buf ? stg->buf : "",
+                             stg->len, 1);
+  pthread_mutex_unlock(&g_lock);
+  if (rc == 0) stg->dirty = 0;
+  return rc == 0 ? 0 : -EIO;
+}
+
+static int dfs_flush(const char *path, struct fuse_file_info *fi) {
+  /* close(2) waits on flush, NOT release (release is async) — the
+   * upload must complete here so close-then-read sees the file */
+  return upload_staged(path, (struct staged *)(uintptr_t)fi->fh);
+}
+
+static int dfs_fsync(const char *path, int datasync,
+                     struct fuse_file_info *fi) {
+  (void)datasync;
+  return upload_staged(path, (struct staged *)(uintptr_t)fi->fh);
+}
+
+static int dfs_release(const char *path, struct fuse_file_info *fi) {
+  struct staged *stg = (struct staged *)(uintptr_t)fi->fh;
+  int rc = upload_staged(path, stg);  /* belt: paths without flush */
+  if (stg) {
+    staged_remove(stg);
+    free(stg->buf);
+    free(stg);
+  }
+  return rc;
+}
+
+static int dfs_truncate(const char *path, off_t len) {
+  if (len != 0) return -ENOTSUP;
+  /* truncate-to-zero = start a fresh upload; the open()/create() that
+   * follows stages the new content */
+  pthread_mutex_lock(&g_lock);
+  int rc = htpufs_write_file(g_fs, path, "", 0, 1);
+  pthread_mutex_unlock(&g_lock);
+  return rc == 0 ? 0 : -EIO;
+}
+
+static int dfs_statfs(const char *path, struct statvfs *sv) {
+  (void)path;
+  memset(sv, 0, sizeof *sv);
+  sv->f_bsize = 1 << 20;
+  sv->f_frsize = 1 << 20;
+  sv->f_blocks = 1 << 20;
+  sv->f_bfree = 1 << 19;
+  sv->f_bavail = 1 << 19;
+  sv->f_namemax = 255;
+  return 0;
+}
+
+static int dfs_access(const char *path, int mask) {
+  (void)path;
+  (void)mask;
+  return 0;
+}
+
+static int dfs_utimens(const char *path, const struct timespec tv[2]) {
+  (void)path;
+  (void)tv; /* store keeps its own mtimes; accept silently like NFS */
+  return 0;
+}
+
+static int dfs_chmod(const char *p, mode_t m) {
+  (void)p;
+  (void)m;
+  return 0;
+}
+
+static int dfs_chown(const char *p, uid_t u, gid_t g) {
+  (void)p;
+  (void)u;
+  (void)g;
+  return 0;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    fprintf(stderr,
+            "usage: %s <nn-http-host> <nn-http-port> <mountpoint> [-f]\n",
+            argv[0]);
+    return 2;
+  }
+  g_fs = htpufs_connect(argv[1], atoi(argv[2]));
+  if (!g_fs) {
+    fprintf(stderr, "connect failed\n");
+    return 1;
+  }
+  struct fuse_operations ops;
+  memset(&ops, 0, sizeof ops);
+  ops.getattr = dfs_getattr;
+  ops.readdir = dfs_readdir;
+  ops.mkdir = dfs_mkdir;
+  ops.unlink = dfs_unlink;
+  ops.rmdir = dfs_rmdir;
+  ops.rename = dfs_rename;
+  ops.open = dfs_open;
+  ops.create = dfs_create;
+  ops.read = dfs_read;
+  ops.write = dfs_write;
+  ops.release = dfs_release;
+  ops.flush = dfs_flush;
+  ops.fsync = dfs_fsync;
+  ops.truncate = dfs_truncate;
+  ops.statfs = dfs_statfs;
+  ops.access = dfs_access;
+  ops.utimens = dfs_utimens;
+  ops.chmod = dfs_chmod;
+  ops.chown = dfs_chown;
+
+  /* fuse argv: prog + mountpoint + flags (direct_io: no page cache in
+   * front of a distributed namespace; big_writes for fewer upcalls) */
+  char *fargv[8];
+  int fargc = 0;
+  fargv[fargc++] = argv[0];
+  fargv[fargc++] = argv[3];
+  fargv[fargc++] = "-o";
+  fargv[fargc++] = "direct_io,big_writes";
+  for (int i = 4; i < argc && fargc < 7; i++) fargv[fargc++] = argv[i];
+  fargv[fargc] = NULL;
+  return fuse_main_real(fargc, fargv, &ops, sizeof ops, NULL);
+}

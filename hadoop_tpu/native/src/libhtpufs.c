@@ -240,6 +240,29 @@ int64_t htpufs_get_file_size(htpuFS fs, const char *path) {
   return (int64_t)n;
 }
 
+/* One-call stat for mount consumers (fuse_dfs.c): size + kind.
+ * Returns 0 on success, -1 missing/error; *is_dir from the WebHDFS
+ * GETFILESTATUS "type" field. */
+int htpufs_stat(htpuFS fs, const char *path, int64_t *size, int *is_dir) {
+  char ep[1024], target[1200];
+  if (enc_path(path, ep, sizeof(ep)) != 0) {
+    set_err(fs, "path too long%s", NULL);
+    return -1;
+  }
+  snprintf(target, sizeof(target), "/webhdfs/v1%s?op=GETFILESTATUS", ep);
+  char *body;
+  int64_t blen;
+  int st = http_request(fs, "GET", target, NULL, 0, &body, &blen);
+  if (st != 200 || !body) {
+    free(body);
+    return -1;
+  }
+  if (size) *size = (int64_t)json_ll(body, "length", 0);
+  if (is_dir) *is_dir = strstr(body, "\"DIRECTORY\"") != NULL;
+  free(body);
+  return 0;
+}
+
 int htpufs_mkdirs(htpuFS fs, const char *path) {
   char ep[1024], target[1200];
   if (enc_path(path, ep, sizeof(ep)) != 0) {

@@ -208,13 +208,13 @@ class ApplicationSubmissionContext:
     """Ref: ApplicationSubmissionContext.java."""
 
     __slots__ = ("app_id", "name", "queue", "am_launch_context", "am_resource",
-                 "max_attempts", "app_type", "in_process_am")
+                 "max_attempts", "app_type", "in_process_am", "unmanaged")
 
     def __init__(self, app_id: ApplicationId, name: str,
                  am_launch_context: ContainerLaunchContext,
                  am_resource: Resource, queue: str = "default",
                  max_attempts: int = 2, app_type: str = "YARN",
-                 in_process_am: bool = False):
+                 in_process_am: bool = False, unmanaged: bool = False):
         self.app_id = app_id
         self.name = name
         self.queue = queue
@@ -225,19 +225,28 @@ class ApplicationSubmissionContext:
         # Minicluster mode: run the AM as a thread in the submitter's process
         # (ref: MiniYARNCluster's unmanaged-AM-style testing shortcut).
         self.in_process_am = in_process_am
+        # Unmanaged AM (ref: setUnmanagedAM + the
+        # hadoop-yarn-applications-unmanaged-am-launcher tool): the RM
+        # allocates NO AM container; an external process registers as
+        # the attempt's master and drives allocate itself.
+        self.unmanaged = unmanaged
 
     def to_wire(self) -> Dict:
-        return {"id": self.app_id.to_wire(), "nm": self.name, "q": self.queue,
-                "lc": self.am_launch_context.to_wire(),
-                "r": self.am_resource.to_wire(), "ma": self.max_attempts,
-                "t": self.app_type, "ip": self.in_process_am}
+        d = {"id": self.app_id.to_wire(), "nm": self.name, "q": self.queue,
+             "lc": self.am_launch_context.to_wire(),
+             "r": self.am_resource.to_wire(), "ma": self.max_attempts,
+             "t": self.app_type, "ip": self.in_process_am}
+        if self.unmanaged:
+            d["um"] = True
+        return d
 
     @classmethod
     def from_wire(cls, d: Dict) -> "ApplicationSubmissionContext":
         return cls(ApplicationId.from_wire(d["id"]), d["nm"],
                    ContainerLaunchContext.from_wire(d["lc"]),
                    Resource.from_wire(d["r"]), d.get("q", "default"),
-                   d.get("ma", 2), d.get("t", "YARN"), d.get("ip", False))
+                   d.get("ma", 2), d.get("t", "YARN"), d.get("ip", False),
+                   d.get("um", False))
 
 
 # Application / attempt / container externally-visible states

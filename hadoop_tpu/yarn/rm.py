@@ -171,6 +171,15 @@ class RMAppAttempt:
         rm = self.app.rm
         rm.scheduler.add_app(self.attempt_id, self.app.ctx.queue,
                              self.app.user)
+        if getattr(self.app.ctx, "unmanaged", False):
+            # Unmanaged AM (ref: RMAppAttemptImpl's unmanaged transitions
+            # + amlauncher skipping): no AM container is requested; the
+            # external master finds its attempt id via the app report
+            # and registers directly.
+            self.state = "LAUNCHED"
+            log.info("Attempt %s waiting for UNMANAGED AM registration",
+                     self.attempt_id)
+            return
         rm.scheduler.allocate(self.attempt_id, [ResourceRequest(
             AM_PRIORITY, 1, self.app.ctx.am_resource)], [])
         log.info("Attempt %s scheduled (AM resource %r)", self.attempt_id,

@@ -1,0 +1,98 @@
+"""Unmanaged-AM launcher: run an ApplicationMaster OUTSIDE the cluster.
+
+Parity with the reference tool (ref: hadoop-yarn-applications/
+hadoop-yarn-applications-unmanaged-am-launcher/.../UnmanagedAMLauncher
+.java): submit an application whose context sets the unmanaged flag —
+the RM allocates NO AM container — then run the AM command as a LOCAL
+subprocess with the same environment a container-launched AM would see
+(attempt id + RM address), so the master registers and drives
+``allocate`` from wherever the launcher runs. The standard debugging /
+gateway-AM workflow: the AM is attachable, restartable, and lives
+outside NM supervision while its containers run on the cluster.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import time
+from typing import List, Optional, Tuple
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.yarn.client import YarnClient
+from hadoop_tpu.yarn.records import (ApplicationSubmissionContext, AppState,
+                                     ContainerLaunchContext, Resource)
+
+log = logging.getLogger(__name__)
+
+
+def launch(rm_addr: Tuple[str, int], am_command: List[str],
+           name: str = "unmanaged-am",
+           conf: Optional[Configuration] = None,
+           env: Optional[dict] = None,
+           attempt_timeout: float = 30.0):
+    """Submit an unmanaged app + run its AM locally. Returns
+    (app_id, subprocess returncode) once the AM process exits; the
+    caller watches the app's report for the final state."""
+    conf = conf or Configuration(load_defaults=False)
+    yc = YarnClient(rm_addr, conf)
+    try:
+        app_id, _ = yc.create_application()
+        ctx = ApplicationSubmissionContext(
+            app_id, name,
+            ContainerLaunchContext(am_command, dict(env or {}), {}),
+            Resource(0, 0),  # no AM container — no AM resource ask
+            unmanaged=True)
+        yc.submit_application(ctx)
+
+        # attempt id appears in the report once the attempt exists
+        deadline = time.monotonic() + attempt_timeout
+        attempt_no = 0
+        while time.monotonic() < deadline:
+            report = yc.application_report(app_id)
+            if report.state in (AppState.FAILED, AppState.KILLED):
+                raise RuntimeError(
+                    f"app died before AM start: {report.diagnostics}")
+            if report.attempt_no:
+                attempt_no = report.attempt_no
+                break
+            time.sleep(0.1)
+        if not attempt_no:
+            raise TimeoutError("no attempt created for unmanaged app")
+        attempt_id = f"{app_id}_{attempt_no:02d}"
+
+        am_env = dict(os.environ)
+        am_env.update(env or {})
+        # the same contract amlauncher/AMLauncher.java sets up in a
+        # container's environment (rm.py _launch_am)
+        am_env["HTPU_ATTEMPT_ID"] = attempt_id
+        am_env["HTPU_RM_ADDRESS"] = f"{rm_addr[0]}:{rm_addr[1]}"
+        log.info("Launching unmanaged AM for %s locally: %s", attempt_id,
+                 am_command)
+        proc = subprocess.run(am_command, env=am_env)
+        return app_id, proc.returncode
+    finally:
+        yc.close()
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+    ap = argparse.ArgumentParser(
+        prog="unmanaged-am-launcher",
+        description="Run an ApplicationMaster outside the cluster "
+                    "(ref: the unmanaged-am-launcher tool)")
+    ap.add_argument("--rm", required=True, help="host:port")
+    ap.add_argument("--name", default="unmanaged-am")
+    ap.add_argument("cmd", nargs="+", help="AM command")
+    args = ap.parse_args(argv)
+    host, _, port = args.rm.rpartition(":")
+    app_id, rc = launch((host, int(port)), args.cmd, name=args.name)
+    print(json.dumps({"app_id": str(app_id), "am_exit": rc}))
+    return 0 if rc == 0 else 1
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -294,3 +294,79 @@ def test_htpufast_respects_block_tokens(tmp_path):
             assert bytes(buf) == payload
         finally:
             lib.htpufast_close(h)
+
+
+def test_fuse_dfs_mount_end_to_end(tmp_path):
+    """fuse-dfs (ref: hadoop-hdfs-native-client fuse-dfs): mount the
+    namespace through the FUSE daemon and drive it with PLAIN POSIX
+    tools — ls/cat/cp/mkdir/mv/rm — against a live cluster."""
+    import os as _os
+    import shutil as _shutil
+    import subprocess as _subprocess
+    import time as _time
+
+    import pytest as _pytest
+
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster, fast_conf
+
+    binary = _os.path.join(_os.path.dirname(__file__), _os.pardir,
+                           "hadoop_tpu", "native", "htpu-fuse-dfs")
+    if not _os.path.exists(binary) or not _os.path.exists("/dev/fuse"):
+        _pytest.skip("fuse-dfs binary or /dev/fuse unavailable")
+
+    conf = fast_conf()
+    conf.set("dfs.replication", "1")
+    mnt = str(tmp_path / "mnt")
+    _os.makedirs(mnt)
+    with MiniDFSCluster(num_datanodes=1, conf=conf,
+                        base_dir=str(tmp_path / "c")) as cluster:
+        cluster.wait_active()
+        fs = cluster.get_filesystem()
+        fs.mkdirs("/fusedir")
+        fs.write_all("/fusedir/hello.txt", b"hello from dfs\n")
+
+        proc = _subprocess.Popen(
+            [binary, "127.0.0.1", str(cluster.namenode.http.port), mnt,
+             "-f"],
+            stdout=_subprocess.DEVNULL, stderr=_subprocess.PIPE)
+        try:
+            deadline = _time.monotonic() + 10
+            mounted = False
+            while _time.monotonic() < deadline:
+                if _os.path.isdir(f"{mnt}/fusedir"):
+                    mounted = True
+                    break
+                if proc.poll() is not None:
+                    _pytest.fail("fuse daemon died: "
+                                 f"{proc.stderr.read().decode()[-400:]}")
+                _time.sleep(0.2)
+            assert mounted, "mount never became visible"
+
+            # read through the kernel
+            with open(f"{mnt}/fusedir/hello.txt", "rb") as f:
+                assert f.read() == b"hello from dfs\n"
+            assert sorted(_os.listdir(f"{mnt}/fusedir")) == ["hello.txt"]
+
+            # write through the kernel → visible in the DFS
+            with open(f"{mnt}/fusedir/new.bin", "wb") as f:
+                f.write(b"x" * 70_000)
+            assert fs.read_all("/fusedir/new.bin") == b"x" * 70_000
+
+            # mkdir / rename / rm via POSIX
+            _os.makedirs(f"{mnt}/fusedir/sub")
+            assert fs.exists("/fusedir/sub")
+            _os.rename(f"{mnt}/fusedir/new.bin", f"{mnt}/fusedir/moved.bin")
+            assert fs.exists("/fusedir/moved.bin")
+            _os.remove(f"{mnt}/fusedir/moved.bin")
+            assert not fs.exists("/fusedir/moved.bin")
+            # stat sizes agree
+            st = _os.stat(f"{mnt}/fusedir/hello.txt")
+            assert st.st_size == len(b"hello from dfs\n")
+        finally:
+            _subprocess.run(["fusermount", "-u", mnt],
+                            stdout=_subprocess.DEVNULL,
+                            stderr=_subprocess.DEVNULL)
+            try:
+                proc.wait(timeout=5)
+            except _subprocess.TimeoutExpired:
+                proc.kill()

@@ -110,3 +110,42 @@ def test_restart_never_runs_once(cluster):
         assert sc.stop(app_id, timeout=40.0)
     finally:
         sc.close()
+
+
+def test_unmanaged_am_launcher(tmp_path):
+    """The unmanaged-AM workflow (ref: hadoop-yarn-applications-
+    unmanaged-am-launcher): the RM allocates no AM container; the AM
+    runs as a LOCAL subprocess of the launcher, registers with the
+    attempt id from the app report, gets real containers on the
+    cluster, and completes the app."""
+    import sys
+
+    from hadoop_tpu.testing.minicluster import MiniYARNCluster
+    from hadoop_tpu.yarn.client import YarnClient
+    from hadoop_tpu.yarn.records import AppState
+    from hadoop_tpu.yarn.unmanaged import launch
+
+    with MiniYARNCluster(num_nodes=1,
+                         base_dir=str(tmp_path / "c")) as cluster:
+        # reuse the distributed-shell AM as the unmanaged master: it
+        # reads HTPU_ATTEMPT_ID/HTPU_RM_ADDRESS from env, asks for n
+        # containers, runs the command in them, unregisters
+        am_cmd = [sys.executable, "-m",
+                  "hadoop_tpu.examples.distributed_shell", "--am"]
+        repo_root = str((tmp_path / "..").resolve())
+        import hadoop_tpu
+        import os as _os
+        py_root = _os.path.dirname(_os.path.dirname(hadoop_tpu.__file__))
+        app_id, rc = launch(
+            cluster.rm_addr, am_cmd, name="unmanaged-dshell",
+            env={"HTPU_DSHELL_N": "2",
+                 "HTPU_DSHELL_CMD": "bash\x1f-c\x1ftrue",
+                 "HTPU_DSHELL_MEM": "64",
+                 "PYTHONPATH": py_root})
+        assert rc == 0
+        yc = YarnClient(cluster.rm_addr, cluster.conf)
+        try:
+            report = yc.wait_for_completion(app_id, timeout=30)
+            assert report.state == AppState.FINISHED, report.diagnostics
+        finally:
+            yc.close()
