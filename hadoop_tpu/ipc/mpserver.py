@@ -120,7 +120,14 @@ class MultiProcessServer:
             if not r.poll(max(0.1, deadline - time.monotonic())):
                 self.stop()
                 raise IOError("mp rpc worker failed to start")
-            got = r.recv()
+            try:
+                got = r.recv()
+            except EOFError:
+                # worker died before reporting (factory import error,
+                # bind failure) — its pipe EOF reads as "readable"
+                self.stop()
+                raise IOError("mp rpc worker died during startup "
+                              "(see worker stderr)") from None
             if got != port:
                 self.stop()
                 raise IOError(f"worker bound {got}, wanted {port}")

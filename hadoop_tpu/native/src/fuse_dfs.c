@@ -258,10 +258,19 @@ static int dfs_rename(const char *src, const char *dst) {
 static int dfs_open(const char *path, struct fuse_file_info *fi) {
   if ((fi->flags & O_ACCMODE) != O_RDONLY) {
     /* write handles stage locally (append-only store; rewrite of
-     * existing bytes is not supported — like the reference fuse-dfs) */
+     * existing bytes is not supported — like the reference fuse-dfs).
+     * O_WRONLY on an EXISTING file without O_TRUNC would silently
+     * replace the whole file with only the staged bytes — refuse it
+     * up front instead of destroying data on close. */
+    if (!(fi->flags & O_TRUNC)) {
+      pthread_mutex_lock(&g_lock);
+      int ex = htpufs_exists(g_fs, path);
+      pthread_mutex_unlock(&g_lock);
+      if (ex == 1) return -ENOTSUP;
+    }
     struct staged *stg = calloc(1, sizeof *stg);
     if (!stg) return -ENOMEM;
-    stg->dirty = 0;
+    stg->dirty = (fi->flags & O_TRUNC) ? 1 : 0;
     snprintf(stg->path, sizeof stg->path, "%s", path);
     staged_add(stg);
     fi->fh = (uint64_t)(uintptr_t)stg;

@@ -32,6 +32,7 @@
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <poll.h>
 #include <sys/epoll.h>
 #include <sys/socket.h>
 #include <unistd.h>
@@ -249,7 +250,8 @@ struct Decoder {
 
 // ---------------------------------------------------------- blocking IO
 
-int dial(const char* host, int port, char* err, size_t errlen) {
+int dial(const char* host, int port, char* err, size_t errlen,
+         int timeout_ms = 5000) {
   char portbuf[16];
   snprintf(portbuf, sizeof portbuf, "%d", port);
   struct addrinfo hints, *res = nullptr;
@@ -261,14 +263,42 @@ int dial(const char* host, int port, char* err, size_t errlen) {
     return -1;
   }
   int fd = socket(res->ai_family, res->ai_socktype, 0);
-  int rc = fd < 0 ? -1 : connect(fd, res->ai_addr, res->ai_addrlen);
+  if (fd < 0) {
+    freeaddrinfo(res);
+    snprintf(err, errlen, "socket failed: %s", strerror(errno));
+    return -1;
+  }
+  // bounded connect: a SYN-blackholed replica must cost at most
+  // timeout_ms, not the kernel's ~2 min retry ladder — dial() is
+  // called from inside the epoll loop on failover, where a long block
+  // would stall every other stream
+  fcntl(fd, F_SETFL, O_NONBLOCK);
+  int rc = connect(fd, res->ai_addr, res->ai_addrlen);
   freeaddrinfo(res);
+  if (rc != 0 && errno == EINPROGRESS) {
+    struct pollfd pfd{fd, POLLOUT, 0};
+    rc = poll(&pfd, 1, timeout_ms) == 1 ? 0 : -1;
+    if (rc == 0) {
+      int soerr = 0;
+      socklen_t slen = sizeof soerr;
+      getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &slen);
+      if (soerr != 0) {
+        errno = soerr;
+        rc = -1;
+      }
+    } else {
+      errno = ETIMEDOUT;
+    }
+  }
   if (rc != 0) {
-    if (fd >= 0) close(fd);
+    close(fd);
     snprintf(err, errlen, "connect %s:%d failed: %s", host, port,
              strerror(errno));
     return -1;
   }
+  // back to blocking for the synchronous RPC users; the async streams
+  // flip O_NONBLOCK on again themselves
+  fcntl(fd, F_SETFL, fcntl(fd, F_GETFL) & ~O_NONBLOCK);
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
   return fd;

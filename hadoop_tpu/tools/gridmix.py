@@ -229,8 +229,11 @@ def run_trace(rm_addr, default_fs: str, trace: List[Dict], *,
     while pending or inflight:
         while pending and len(inflight) < max_concurrent:
             entry = pending.pop(0)
-            use_load = mode == "load" or (mode == "auto"
-                                          and entry.get("load"))
+            # --mode load degrades per-entry: a trace without a load
+            # model (pre-round-5 rumen output) replays as a sleep job
+            # instead of crashing mid-run with jobs in flight
+            use_load = bool(entry.get("load")) and mode in ("load",
+                                                            "auto")
             if use_load:
                 job = _make_load_job(Job, class_ref, rm_addr, default_fs,
                                      entry, idx, out_root, cpu_fraction)

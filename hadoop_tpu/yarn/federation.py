@@ -279,28 +279,48 @@ class FederationClientInterceptor(ClientInterceptor):
     SUBMIT_RETRIES = 3
 
     def get_new_application(self) -> Dict:
+        """Mint an id from any reachable subcluster. The HOME binding
+        happens at submit time, when the submission's QUEUE is known and
+        the per-queue policy can speak (ref: FederationClientInterceptor
+        binds in submitApplication; RMs accept ids minted elsewhere)."""
         last: Optional[Exception] = None
         for _ in range(self.SUBMIT_RETRIES):
-            sc_id = self.router.choose_subcluster()
+            # any reachable member will do for minting — must NOT
+            # consume the queue policy's sequence (that belongs to the
+            # home binding at submit time)
+            sc_id = self.router.any_active()
             try:
-                out = self.router.rm_proxy(sc_id).get_new_application()
+                return self.router.rm_proxy(sc_id).get_new_application()
             except (OSError, IOError) as e:
                 last = e
                 self.router.mark_lost(sc_id)
-                continue
-            app_id = str(ApplicationId.from_wire(out["app_id"]))
-            self.router.store.set_home(app_id, sc_id)
-            return out
         raise IOError(f"no subcluster could issue an application: {last}")
 
     def submit_application(self, ctx_wire: Dict) -> Dict:
         app_id = str(ApplicationId.from_wire(ctx_wire["id"]))
-        sc_id = self.router.home_or_raise(app_id)
-        try:
-            return self.router.rm_proxy(sc_id).submit_application(ctx_wire)
-        except (OSError, IOError):
-            self.router.mark_lost(sc_id)
-            raise
+        queue = ctx_wire.get("q", "default")
+        home = self.router.store.home_of(app_id)
+        if home is not None:
+            # resubmission/retry: sticky home
+            try:
+                return self.router.rm_proxy(home).submit_application(
+                    ctx_wire)
+            except (OSError, IOError):
+                self.router.mark_lost(home)
+                raise
+        last: Optional[Exception] = None
+        for _ in range(self.SUBMIT_RETRIES):
+            sc_id = self.router.choose_subcluster(queue)  # queue policy
+            try:
+                out = self.router.rm_proxy(sc_id).submit_application(
+                    ctx_wire)
+            except (OSError, IOError) as e:
+                last = e
+                self.router.mark_lost(sc_id)
+                continue
+            self.router.store.set_home(app_id, sc_id)
+            return out
+        raise IOError(f"no subcluster accepted {app_id}: {last}")
 
     def get_application_report(self, app_id_wire: Dict) -> Dict:
         app_id = str(ApplicationId.from_wire(app_id_wire))
@@ -500,6 +520,16 @@ class YarnRouter(AbstractService):
                 policy = make_policy(wire, self)
                 self._policy_cache[cache_key] = policy
         return policy.choose(active, queue)
+
+    def any_active(self) -> str:
+        """Rotate over ACTIVE members outside any queue policy (id
+        minting, health probes)."""
+        active = sorted(self.store.subclusters(active_only=True))
+        if not active:
+            raise IOError("no ACTIVE subclusters")
+        with self._lock:
+            self._mint_rr = getattr(self, "_mint_rr", 0) + 1
+            return active[self._mint_rr % len(active)]
 
     def mark_lost(self, sc_id: str) -> None:
         """Eager failure demotion: the next routing decision must not

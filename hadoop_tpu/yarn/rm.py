@@ -788,6 +788,19 @@ class ResourceManager(AbstractService):
                         log.warning("Attempt %s expired (no AM heartbeat)",
                                     attempt.attempt_id)
                         attempt.fail("AM liveness expired")
+                    elif attempt.state == "LAUNCHED" and \
+                            getattr(attempt.app.ctx, "unmanaged", False) \
+                            and now - attempt.last_heartbeat > \
+                            self.am_expiry_s:
+                        # an unmanaged AM has no NM container whose exit
+                        # would fail the attempt — registration itself
+                        # is on the liveness clock (ref: the unmanaged
+                        # path of RMAppAttemptImpl expiring on the
+                        # AMLivelinessMonitor)
+                        log.warning("Attempt %s expired (unmanaged AM "
+                                    "never registered)",
+                                    attempt.attempt_id)
+                        attempt.fail("unmanaged AM never registered")
                 with self.nodes_lock:
                     nodes = list(self.nodes.items())
                 for node_id, node in nodes:

@@ -197,3 +197,42 @@ def test_apps_survive_subcluster_rm_death(tmp_path):
                 yc.close()
         finally:
             router.stop()
+
+
+def test_queue_policy_enforced_on_real_submissions(federation):
+    """The per-queue policy must bind on the REAL client path (review
+    finding: it used to be consulted only for queue 'default'): a
+    weighted policy pins a queue's apps to one subcluster, and a
+    reject policy refuses the submission itself."""
+    from hadoop_tpu.ipc import get_proxy
+    from hadoop_tpu.yarn.records import (ApplicationSubmissionContext,
+                                         ContainerLaunchContext, Resource)
+
+    c1, c2, router = federation
+    admin = get_proxy("RouterAdminProtocol", ("127.0.0.1", router.port))
+    admin.set_policy("pinned", {"type": "weighted",
+                                "weights": {"sc2": 1.0}})
+    admin.set_policy("closed", {"type": "reject"})
+
+    yc = YarnClient(("127.0.0.1", router.port),
+                    Configuration(other=c1.conf))
+    try:
+        for _ in range(2):
+            app_id, _ = yc.create_application()
+            ctx = ApplicationSubmissionContext(
+                app_id, "pinned-app",
+                ContainerLaunchContext(["bash", "-c", "true"], {}, {}),
+                Resource(64, 1), queue="pinned", unmanaged=True)
+            yc.submit_application(ctx, wait_accepted=False)
+            assert router.store.home_of(str(app_id)) == "sc2"
+
+        app_id, _ = yc.create_application()
+        ctx = ApplicationSubmissionContext(
+            app_id, "rejected-app",
+            ContainerLaunchContext(["bash", "-c", "true"], {}, {}),
+            Resource(64, 1), queue="closed", unmanaged=True)
+        with pytest.raises(Exception, match="reject|no subcluster"):
+            yc.submit_application(ctx, wait_accepted=False)
+        assert router.store.home_of(str(app_id)) is None
+    finally:
+        yc.close()
