@@ -642,6 +642,8 @@ class NameNode(AbstractService):
                                           lambda: self.ha_state)
             self.http.add_handler(
                 "/fsstatus", lambda q, b: (200, status_proto.get_stats()))
+            from hadoop_tpu.http.webui import nn_dfshealth_page
+            self.http.add_handler("/dfshealth", nn_dfshealth_page(self))
 
     def _client_pre_call(self, method: str, ctx: CallContext) -> None:
         """HA gate + observer alignment (ref: NameNodeRpcServer's

@@ -1,0 +1,158 @@
+"""CSI adaptor: container storage volumes for YARN apps.
+
+Parity with the reference's CSI module (ref: hadoop-yarn-csi —
+CsiAdaptorProtocolService.java translating YARN's volume lifecycle to a
+CSI driver's gRPC surface: ValidateVolumeCapabilities /
+NodePublishVolume / NodeUnpublishVolume; the NM invokes the adaptor
+around container launch via ContainerVolumePublisher): here the
+adaptor is an RPC service hosting pluggable DRIVERS, and the built-in
+driver mounts the DFS itself through the fuse-dfs daemon
+(native/src/fuse_dfs.c), so a container can request
+``htpufs://nn-http-host:port`` volumes and read the namespace as plain
+files under its own work dir.
+
+Container launch contexts carry ``volumes``:
+``[{"driver": "htpufs", "id": "htpufs://host:port", "target": "data"}]``
+— the NM publishes each volume under ``<workdir>/<target>`` before the
+process starts and unpublishes after it exits.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import threading
+import time
+from typing import Dict, Optional
+
+log = logging.getLogger(__name__)
+
+
+class CsiDriver:
+    """One storage backend (ref: the CSI plugin the adaptor fronts)."""
+
+    def validate_volume(self, volume_id: str, capability: Dict) -> bool:
+        raise NotImplementedError
+
+    def node_publish_volume(self, volume_id: str, target_path: str,
+                            options: Dict) -> None:
+        raise NotImplementedError
+
+    def node_unpublish_volume(self, volume_id: str,
+                              target_path: str) -> None:
+        raise NotImplementedError
+
+
+class DfsFuseDriver(CsiDriver):
+    """Mount the DFS at the target via the fuse-dfs daemon.
+
+    volume id: ``htpufs://<nn-http-host>:<nn-http-port>``. Each publish
+    runs one htpu-fuse-dfs process on the target dir; unpublish
+    fusermounts it away and reaps the daemon.
+    """
+
+    def __init__(self, binary: Optional[str] = None):
+        self.binary = binary or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "native", "htpu-fuse-dfs")
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+
+    def available(self) -> bool:
+        return os.path.exists(self.binary) and os.path.exists("/dev/fuse")
+
+    @staticmethod
+    def _parse(volume_id: str):
+        if not volume_id.startswith("htpufs://"):
+            raise ValueError(f"not an htpufs volume: {volume_id!r}")
+        hostport = volume_id[len("htpufs://"):].strip("/")
+        host, _, port = hostport.rpartition(":")
+        return host or "127.0.0.1", int(port)
+
+    def validate_volume(self, volume_id: str, capability: Dict) -> bool:
+        self._parse(volume_id)
+        if capability.get("access_mode", "ro") not in ("ro", "rw"):
+            return False
+        return self.available()
+
+    def node_publish_volume(self, volume_id: str, target_path: str,
+                            options: Dict) -> None:
+        host, port = self._parse(volume_id)
+        os.makedirs(target_path, exist_ok=True)
+        proc = subprocess.Popen(
+            [self.binary, host, str(port), target_path, "-f"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if os.path.ismount(target_path):
+                with self._lock:
+                    self._procs[target_path] = proc
+                return
+            if proc.poll() is not None:
+                err = (proc.stderr.read() or b"").decode()[-300:]
+                raise IOError(f"fuse mount of {volume_id} failed: {err}")
+            time.sleep(0.1)
+        proc.terminate()
+        raise IOError(f"mount of {volume_id} at {target_path} timed out")
+
+    def node_unpublish_volume(self, volume_id: str,
+                              target_path: str) -> None:
+        subprocess.run(["fusermount", "-u", target_path],
+                       stdout=subprocess.DEVNULL,
+                       stderr=subprocess.DEVNULL)
+        with self._lock:
+            proc = self._procs.pop(target_path, None)
+        if proc is not None:
+            try:
+                proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+class CsiAdaptor:
+    """Driver registry + the adaptor protocol surface (ref:
+    CsiAdaptorProtocolService / CsiAdaptorFactory). Registered as an
+    RPC protocol when hosted standalone; the NM also calls it
+    in-process around container launch."""
+
+    def __init__(self):
+        self._drivers: Dict[str, CsiDriver] = {}
+        fuse = DfsFuseDriver()
+        if fuse.available():
+            self._drivers["htpufs"] = fuse
+
+    def register_driver(self, name: str, driver: CsiDriver) -> None:
+        self._drivers[name] = driver
+
+    def _driver(self, name: str) -> CsiDriver:
+        drv = self._drivers.get(name)
+        if drv is None:
+            raise ValueError(f"no CSI driver {name!r} "
+                             f"(have {sorted(self._drivers)})")
+        return drv
+
+    # ------------------------------------------------- protocol surface
+
+    def validate_volume(self, driver: str, volume_id: str,
+                        capability: Optional[Dict] = None) -> bool:
+        return self._driver(driver).validate_volume(volume_id,
+                                                    capability or {})
+
+    def node_publish_volume(self, driver: str, volume_id: str,
+                            target_path: str,
+                            options: Optional[Dict] = None) -> bool:
+        self._driver(driver).node_publish_volume(volume_id, target_path,
+                                                 options or {})
+        log.info("published %s volume %s at %s", driver, volume_id,
+                 target_path)
+        return True
+
+    def node_unpublish_volume(self, driver: str, volume_id: str,
+                              target_path: str) -> bool:
+        self._driver(driver).node_unpublish_volume(volume_id, target_path)
+        log.info("unpublished %s from %s", volume_id, target_path)
+        return True
+
+    def drivers(self) -> list:
+        return sorted(self._drivers)

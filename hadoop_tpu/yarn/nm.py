@@ -137,6 +137,7 @@ class _RunningContainer:
         self.exit_code: Optional[int] = None
         self.diagnostics = ""
         self.start_ts = time.time()
+        self.published_volumes = []
 
 
 class ContainerManagerProtocol:
@@ -222,6 +223,12 @@ class NodeAgent(AbstractService):
         # PerNodeTimelineCollectorsAuxService): spun up with an app's
         # first container here, stopped when the RM reports the app
         # finished (heartbeat response).
+        # CSI adaptor (ref: yarn-csi CsiAdaptorServices on the NM)
+        from hadoop_tpu.yarn.csi import CsiAdaptor
+        try:
+            self.csi = CsiAdaptor()
+        except Exception:  # noqa: BLE001 — volume support is optional
+            self.csi = None
         self.timeline = None
         if conf.get_bool("yarn.timeline-service.enabled", False):
             from hadoop_tpu.yarn.timeline import TimelineCollectorManager
@@ -292,6 +299,7 @@ class NodeAgent(AbstractService):
             os.makedirs(rc.workdir, exist_ok=True)
             rc.state = "LOCALIZING"
             self._localize(rc)
+            self._publish_volumes(rc)
             env = dict(rc.ctx.env)
             for aux in self.aux_services:
                 env.update(aux.container_env())
@@ -320,6 +328,9 @@ class NodeAgent(AbstractService):
             rc.diagnostics = f"launch failed: {e}"
             log.warning("Container %s launch failed: %s", cid, e)
         finally:
+            # volumes must unmount BEFORE the workdir is ever rmtree'd
+            # (a live fuse mount under rmtree would walk the DFS)
+            self._unpublish_volumes(rc)
             with self._lock:
                 self._chip_pool.extend(rc.chips)
                 self._completed_unreported.append(ContainerStatus(
@@ -345,6 +356,42 @@ class NodeAgent(AbstractService):
                         dur * rc.container.resource.memory_mb, 1),
                     vcore_seconds=round(
                         dur * rc.container.resource.vcores, 3))
+
+    def _publish_volumes(self, rc: _RunningContainer) -> None:
+        """CSI volume publish under the workdir (ref: yarn-csi's
+        ContainerVolumePublisher running before ContainerLaunch)."""
+        vols = getattr(rc.ctx, "volumes", None) or []
+        if not vols:
+            return
+        if self.csi is None:
+            raise IOError("container requests volumes but this NM has "
+                          "no CSI adaptor")
+        published = []
+        try:
+            for v in vols:
+                target = os.path.join(rc.workdir,
+                                      v.get("target", "volume"))
+                self.csi.node_publish_volume(v["driver"], v["id"], target,
+                                             v.get("options"))
+                published.append((v, target))
+        except Exception:
+            for v, target in published:
+                try:
+                    self.csi.node_unpublish_volume(v["driver"], v["id"],
+                                                   target)
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
+        rc.published_volumes = published
+
+    def _unpublish_volumes(self, rc: _RunningContainer) -> None:
+        for v, target in getattr(rc, "published_volumes", None) or []:
+            try:
+                self.csi.node_unpublish_volume(v["driver"], v["id"],
+                                               target)
+            except Exception as e:  # noqa: BLE001
+                log.warning("unpublish of %s failed: %s", v.get("id"), e)
+        rc.published_volumes = []
 
     def _localize(self, rc: _RunningContainer) -> None:
         """Fetch DFS resources into the work dir.

@@ -187,21 +187,31 @@ class ContainerLaunchContext:
     """What to run: command argv, env, local resources (DFS paths to
     localize). Ref: ContainerLaunchContext.java."""
 
-    __slots__ = ("commands", "env", "local_resources")
+    __slots__ = ("commands", "env", "local_resources", "volumes")
 
     def __init__(self, commands: List[str],
                  env: Optional[Dict[str, str]] = None,
-                 local_resources: Optional[Dict[str, str]] = None):
+                 local_resources: Optional[Dict[str, str]] = None,
+                 volumes: Optional[List[Dict]] = None):
         self.commands = commands            # argv
         self.env = env or {}
         self.local_resources = local_resources or {}  # name -> dfs uri
+        # CSI volumes published under the workdir before launch (ref:
+        # the yarn-csi volume resources on a container request):
+        # [{"driver": "htpufs", "id": "htpufs://h:p", "target": "data"}]
+        self.volumes = volumes or []
 
     def to_wire(self) -> Dict:
-        return {"c": self.commands, "e": self.env, "lr": self.local_resources}
+        d = {"c": self.commands, "e": self.env,
+             "lr": self.local_resources}
+        if self.volumes:
+            d["vol"] = self.volumes
+        return d
 
     @classmethod
     def from_wire(cls, d: Dict) -> "ContainerLaunchContext":
-        return cls(d["c"], d.get("e", {}), d.get("lr", {}))
+        return cls(d["c"], d.get("e", {}), d.get("lr", {}),
+                   d.get("vol"))
 
 
 class ApplicationSubmissionContext:

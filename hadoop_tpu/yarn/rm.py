@@ -553,6 +553,8 @@ class ResourceManager(AbstractService):
             self.http.add_handler(
                 "/ws/v1/cluster/nodes",
                 lambda q, b: (200, {"nodes": client_proto.get_nodes()}))
+            from hadoop_tpu.http.webui import rm_cluster_page
+            self.http.add_handler("/cluster", rm_cluster_page(self))
             self.http.start()
         Daemon(self._liveness_loop, "rm-liveness").start()
         if self.config.get_bool(

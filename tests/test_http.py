@@ -114,3 +114,31 @@ def test_rm_web_status():
         assert len(nodes["nodes"]) == 2
         st, apps = _get(f"{base}/ws/v1/cluster/apps")
         assert apps["apps"] == []
+
+
+def test_daemon_web_ui_pages(tmp_path):
+    """The daemons' human pages (ref: the RM webapp + dfshealth.html):
+    HTML renders with live numbers from both masters."""
+    import urllib.request
+
+    from hadoop_tpu.testing.minicluster import (MiniDFSCluster,
+                                                MiniYARNCluster, fast_conf)
+
+    conf = fast_conf()
+    conf.set("dfs.replication", "1")
+    with MiniDFSCluster(num_datanodes=1, conf=conf,
+                        base_dir=str(tmp_path / "dfs")) as dfs:
+        dfs.wait_active()
+        dfs.get_filesystem().write_all("/ui.bin", b"x" * 10_000)
+        page = urllib.request.urlopen(
+            f"http://127.0.0.1:{dfs.namenode.http.port}/dfshealth"
+        ).read().decode()
+        assert "NameNode" in page and "Datanodes (1)" in page
+        assert "active" in page.lower()
+
+    with MiniYARNCluster(num_nodes=2,
+                         base_dir=str(tmp_path / "yarn")) as yarn:
+        page = urllib.request.urlopen(
+            f"http://127.0.0.1:{yarn.rm.http.port}/cluster"
+        ).read().decode()
+        assert "ResourceManager" in page and "Nodes (2)" in page
