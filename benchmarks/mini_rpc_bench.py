@@ -1,0 +1,98 @@
+"""Connection-setup latency: SIMPLE vs SASL vs TOKEN handshakes.
+
+Counterpart of the reference's MiniRPCBenchmark (ref: hadoop-common
+src/test .../ipc/MiniRPCBenchmark.java — it measures connection setup
+including Kerberos/token negotiation, the cost that dominates short-
+lived clients): each sample dials a FRESH connection, performs the
+full handshake for its auth mode, executes one trivial call, and
+closes.
+
+  python -m benchmarks.mini_rpc_bench [--samples 50]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+class _Echo:
+    def echo(self, x):
+        return x
+
+
+def _sample(conf_srv, conf_cli, user=None, token_kind=None, samples=30):
+    from hadoop_tpu.ipc import Client, Server, get_proxy
+    from hadoop_tpu.security.ugi import SecretManager
+
+    sm = SecretManager(kind=token_kind) if token_kind else None
+    srv = Server(conf_srv, num_handlers=2, name="minirpc",
+                 secret_manager=sm)
+    srv.register_protocol("Echo", _Echo())
+    srv.start()
+    lat = []
+    try:
+        ugi = user
+        if token_kind and ugi is not None:
+            ugi.add_token(sm.create_token(ugi.user_name))
+        for i in range(samples):
+            c = Client(conf_cli, token_kind=token_kind)
+            t0 = time.perf_counter()
+            proxy = get_proxy("Echo", ("127.0.0.1", srv.port), client=c,
+                              user=ugi)
+            assert proxy.echo(i) == i
+            lat.append(time.perf_counter() - t0)
+            c.stop()
+    finally:
+        srv.stop()
+    lat.sort()
+    return {"p50_ms": round(lat[len(lat) // 2] * 1000, 2),
+            "p95_ms": round(lat[int(len(lat) * 0.95) - 1] * 1000, 2),
+            "samples": samples}
+
+
+def run(samples: int = 30) -> dict:
+    import tempfile
+
+    from hadoop_tpu.conf import Configuration
+    from hadoop_tpu.security.ugi import UserGroupInformation
+    from hadoop_tpu.testing.minikdc import MiniKdc
+
+    out = {}
+    simple = Configuration(load_defaults=False)
+    out["simple"] = _sample(simple, simple, samples=samples)
+
+    with tempfile.TemporaryDirectory() as td:
+        kdc = MiniKdc(td)
+        kdc.create_principal("bench", b"bench-pw")
+        server_keytab = kdc.create_keytab(f"{td}/server.keytab")
+        for qop in ("authentication", "privacy"):
+            conf = Configuration(load_defaults=False)
+            conf.set("hadoop.security.authentication", "sasl")
+            conf.set("hadoop.rpc.protection", qop)
+            conf.set("hadoop.security.server.keytab", server_keytab)
+            ugi = UserGroupInformation.login_from_keytab(
+                "bench", kdc.keytab_for("bench"))
+            out[f"sasl_{qop}"] = _sample(conf, conf, user=ugi,
+                                         samples=samples)
+
+    # TOKEN auth (the job-token shape: secret manager on the server)
+    tok_conf = Configuration(load_defaults=False)
+    ugi = UserGroupInformation.create_remote_user("bench")
+    out["token"] = _sample(tok_conf, tok_conf, user=ugi,
+                           token_kind="bench-token", samples=samples)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--samples", type=int, default=30)
+    args = ap.parse_args()
+    print(json.dumps(run(args.samples)))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

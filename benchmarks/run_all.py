@@ -64,6 +64,9 @@ def main() -> None:
     from benchmarks import mprpc_bench
     out["rpc_multiprocess"] = mprpc_bench.run(seconds=5.0 * scale,
                                               workers=4)
+    from benchmarks import mini_rpc_bench
+    out["rpc_connection_setup"] = mini_rpc_bench.run(
+        samples=int(30 * scale) or 10)
     out["dfsio"] = dfsio.run(n_files=4, mb_per_file=int(16 * scale) or 2)
     from benchmarks import codec_bench
     out["codecs"] = codec_bench.run(mb=int(64 * scale) or 8)

@@ -145,3 +145,29 @@ def test_device_shuffle_values_can_be_vectors(mesh):
     for k, v in zip(out_k[out_m].tolist(), out_v[out_m]):
         assert any(np.allclose(v, w) for w in want[k])
     assert int(out_m.sum()) == n
+
+
+def test_device_shuffle_extreme_skew_and_tiny_shards(mesh):
+    """Degenerate shapes: a single record per device, and 90%-skewed
+    keys with a big capacity factor — conservation holds throughout."""
+    keys = jnp.arange(8, dtype=jnp.int32)
+    vals = jnp.arange(8, dtype=jnp.int32) * 10
+    res = device_shuffle(mesh, "x", _shard(mesh, keys), _shard(mesh, vals),
+                         capacity_factor=8.0)
+    assert int(res.dropped.sum()) == 0
+    assert int(np.asarray(res.valid).sum()) == 8
+
+    rng = np.random.default_rng(2)
+    n = 8 * 256
+    skewed = np.where(rng.random(n) < 0.9, 7,
+                      rng.integers(0, 1000, size=n)).astype(np.int32)
+    vals = rng.integers(0, 5, size=n).astype(np.int32)
+    res = device_shuffle(mesh, "x", _shard(mesh, jnp.asarray(skewed)),
+                         _shard(mesh, jnp.asarray(vals)),
+                         capacity_factor=16.0)
+    n_valid = int(np.asarray(res.valid).sum())
+    n_drop = int(np.asarray(res.dropped).sum())
+    assert n_valid + n_drop == n
+    if n_drop == 0:
+        got = np.asarray(res.values)[np.asarray(res.valid)].sum()
+        assert int(got) == int(vals.sum())

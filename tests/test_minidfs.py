@@ -362,3 +362,49 @@ def test_short_circuit_fds_survive_dn_restart(cluster, fs):
     reqs = cache.requests
     assert fs.read_all("/scr2.bin") == data2
     assert cache.requests > reqs
+
+
+def test_domain_socket_concurrent_grants_and_bad_peers(cluster, fs):
+    """The fd-passing server under load: N threads grab grants for
+    different blocks concurrently while garbage peers poke the socket —
+    every legitimate read stays correct (slot refcounting + per-conn
+    isolation)."""
+    import socket as _socket
+    import threading
+
+    data = {}
+    for i in range(4):
+        data[i] = os.urandom(300_000)
+        fs.write_all(f"/dsc/f{i}", data[i])
+
+    from hadoop_tpu.dfs.client.shortcircuit import ShortCircuitCache
+    cache = ShortCircuitCache.get()
+    dn = cluster.datanodes[0]
+    sock_path = dn.domain_server.path
+    errs = []
+
+    def garbage():
+        try:
+            s = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+            s.connect(sock_path)
+            s.sendall(b"\x00\x00\x00\x05junk!")
+            s.close()
+        except OSError:
+            pass
+
+    def reader(i):
+        try:
+            for _ in range(5):
+                with fs.open(f"/dsc/f{i}") as f:
+                    assert f.read() == data[i]
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=reader, args=(i,)) for i in data]
+    threads += [threading.Thread(target=garbage) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    assert cache.hits > 0
