@@ -53,6 +53,10 @@ class ParallelCtx:
     ep_size: int = 1
     ring_axis: Optional[str] = None
     ring_size: int = 1
+    # context-parallel attention strategy on ring_axis: "ring" rotates
+    # K/V with ppermute; "ulysses" transposes seq<->head sharding with
+    # one all_to_all pair (parallel/ulysses.py)
+    sp_mode: str = "ring"
 
     @property
     def seq_offset_fn(self):
@@ -159,9 +163,14 @@ def _attention_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
             k = apply_rope(k, cos, sin)
 
     if ctx.ring_axis is not None:
-        from hadoop_tpu.parallel.ring_attention import ring_attention
-        attn = ring_attention(q, k, v, axis_name=ctx.ring_axis,
-                              axis_size=ctx.ring_size)
+        if ctx.sp_mode == "ulysses":
+            from hadoop_tpu.parallel.ulysses import ulysses_attention
+            attn = ulysses_attention(q, k, v, axis_name=ctx.ring_axis,
+                                     axis_size=ctx.ring_size)
+        else:
+            from hadoop_tpu.parallel.ring_attention import ring_attention
+            attn = ring_attention(q, k, v, axis_name=ctx.ring_axis,
+                                  axis_size=ctx.ring_size)
     else:
         attn = causal_attention(q, k, v)
 

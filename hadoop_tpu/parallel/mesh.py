@@ -38,6 +38,7 @@ class MeshPlan:
     ep: int = 1
     sp: int = 1
     megatron_sp: bool = False   # sequence parallelism on the tp axis
+    sp_mode: str = "ring"       # context-parallel attention: ring | ulysses
     vpp: int = 1                # virtual stages per pp rank (interleaved
     #                             1F1B model chunks, Megatron-style)
 
@@ -72,6 +73,7 @@ class MeshPlan:
             ep_size=self.ep,
             ring_axis="sp" if self.sp > 1 else None,
             ring_size=self.sp,
+            sp_mode=self.sp_mode,
         )
 
     def validate(self, cfg: ModelConfig, batch: int, seq: int,
@@ -84,6 +86,10 @@ class MeshPlan:
             (cfg.d_ff % self.tp == 0, "d_ff %% tp"),
             (batch % (self.dp * self.ep) == 0, "batch %% dp*ep"),
             (seq % self.sp == 0, "seq %% sp"),
+            (self.sp_mode != "ulysses" or self.sp == 1 or
+             (cfg.n_heads % self.sp == 0 and
+              cfg.n_kv_heads % self.sp == 0),
+             "heads %% sp (ulysses)"),
             (not self.megatron_sp or seq % self.tp == 0, "seq %% tp (sp)"),
             (not cfg.is_moe or cfg.n_experts % self.ep == 0, "experts %% ep"),
             (self.ep == 1 or cfg.is_moe, "ep needs a MoE config"),

@@ -1,0 +1,63 @@
+"""Ulysses-style context parallelism: all-to-all head↔sequence exchange.
+
+The second of the two sequence-parallel attention strategies SURVEY §5.7
+names ("ring attention or all-to-all sequence/context parallelism").
+Where ring attention (parallel/ring_attention.py) keeps activations
+sequence-sharded and ROTATES K/V around the mesh (P-1 neighbor
+exchanges, O(S/P) memory per rank, arbitrary head counts), the
+all-to-all strategy (the DeepSpeed-Ulysses shape, rebuilt here on
+``lax.all_to_all`` — the same collective the MoE dispatch and the
+device shuffle ride) TRANSPOSES the sharding for the attention op:
+
+    [B, S/P, H, D]  --all_to_all-->  [B, S, H/P, D]
+    full-sequence attention on local heads (one fused flash call —
+    no per-step merge state, no P-step scan)
+    [B, S, H/P, D]  --all_to_all-->  [B, S/P, H, D]
+
+Two collectives per attention instead of P-1 permutes: cheaper on a
+fat-ICI pod when heads divide evenly; ring remains the fallback for
+GQA ratios the head split cannot express and for S too large to hold
+one rank's full-sequence K/V.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def supports(n_q_heads: int, n_kv_heads: int, axis_size: int) -> bool:
+    """The head transpose needs both head counts divisible by the axis."""
+    return n_q_heads % axis_size == 0 and n_kv_heads % axis_size == 0
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      axis_name: str, axis_size: int) -> jnp.ndarray:
+    """q,k,v: [B, S_local, H(q|kv), D] sequence-sharded over axis_name.
+    Returns [B, S_local, Hq, D]. Must run inside shard_map with the
+    axis bound; RoPE must already be applied with GLOBAL positions
+    (the caller's ring-path offsets serve both strategies)."""
+    from hadoop_tpu.ops.attention import causal_attention
+    from hadoop_tpu.ops.vma import pvary_to, vma_of
+
+    target = vma_of(q) | vma_of(k) | vma_of(v) | {axis_name}
+    q, k, v = (pvary_to(t, target) for t in (q, k, v))
+
+    # seq-sharded → head-sharded: split heads P ways, gather the
+    # sequence (tiled: received chunks concatenate along seq)
+    q = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1,
+                       tiled=True)
+    k = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1,
+                       tiled=True)
+    v = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1,
+                       tiled=True)
+
+    # full sequence, H/P local heads: plain fused causal attention —
+    # global causality needs no masks beyond the standard one because
+    # the whole sequence is present
+    attn = causal_attention(q, k, v)
+
+    # head-sharded → seq-sharded (inverse transpose)
+    return lax.all_to_all(attn, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
