@@ -1,0 +1,129 @@
+"""NNBench: NameNode metadata latency/TPS under real MR load.
+
+Counterpart of the reference's NNBench (ref: hadoop-mapreduce-client-
+jobclient/.../hdfs/NNBench.java — metadata ops driven FROM MAP TASKS so
+the NN is measured under the cluster's own task-launch + heartbeat +
+shuffle-control load, unlike NNThroughputBenchmark's in-process drive).
+
+  python -m benchmarks.nn_bench [--maps 4] [--ops 200]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def run(maps: int = 4, ops_per_map: int = 200) -> dict:
+    import statistics
+
+    from hadoop_tpu.mapreduce import Job
+    from hadoop_tpu.testing.minicluster import MiniMRYarnCluster
+    from benchmarks import bench_base_dir
+
+    base = bench_base_dir("nnbench")
+    with MiniMRYarnCluster(num_nodes=2, base_dir=base) as cluster:
+        fs = cluster.get_filesystem()
+        fs.mkdirs("/nnbench-in")
+        fs.write_all("/nnbench-in/seed", b"x")
+        # explicit dotted refs: under `python -m`, class_ref would say
+        # __main__ and containers could not import that
+        job = (Job(cluster.rm_addr, cluster.default_fs, name="nnbench")
+               .set_mapper("benchmarks.nn_bench:_NNBenchMapper")
+               .add_input_path("/nnbench-in")
+               .set_output_path("/nnbench-out")
+               .set_num_reduces(0)
+               .set("nnbench.ops", str(ops_per_map))
+               .set("nnbench.fs", cluster.default_fs)
+               .set("nnbench.maps", str(maps)))
+        job.set_input_format("benchmarks.nn_bench:_NSplits") \
+           .set(_NSplits.NUM_MAPS_KEY, str(maps))
+        t0 = time.perf_counter()
+        ok = job.wait_for_completion(timeout=300)
+        wall = time.perf_counter() - t0
+        if not ok:
+            return {"error": "nnbench job failed",
+                    "diagnostics": job.diagnostics[:3]}
+        # every map emits its op latencies (ms) as output records
+        lats = []
+        for st in fs.list_status("/nnbench-out"):
+            if "part-m-" not in st.path:
+                continue
+            for line in fs.read_all(st.path).decode().splitlines():
+                _, _, val = line.partition("\t")
+                if val:
+                    lats.extend(float(x) for x in val.split(",") if x)
+        lats.sort()
+        total_ops = maps * ops_per_map * 4  # create+write, stat, rename, del
+        return {
+            "maps": maps, "ops_per_map_cycle": ops_per_map,
+            "total_metadata_ops": total_ops,
+            "ops_per_sec": round(total_ops / wall, 1),
+            "op_latency_ms": {
+                "p50": round(statistics.median(lats), 2) if lats else None,
+                "p95": round(lats[int(len(lats) * 0.95) - 1], 2)
+                if lats else None,
+            },
+            "wall_seconds": round(wall, 2),
+        }
+
+
+from hadoop_tpu.mapreduce.api import InputFormat, Mapper
+
+
+class _NSplits(InputFormat):
+    NUM_MAPS_KEY = "nnbench.splits"
+
+    def get_splits(self, fs, paths, conf):
+        from hadoop_tpu.mapreduce.api import FileSplit
+        n = int(conf.get(self.NUM_MAPS_KEY, "1"))
+        return [FileSplit(f"synthetic://nnbench/{i}", 0, 1)
+                for i in range(n)]
+
+    def read(self, fs, split, conf):
+        yield split.path.encode(), b""
+
+
+class _NNBenchMapper(Mapper):
+    def map(self, key, value, ctx):
+        import time as _time
+
+        from hadoop_tpu.fs import FileSystem
+        fs = FileSystem.get(ctx.conf.get("nnbench.fs"))
+        me = key.decode().rsplit("/", 1)[-1]
+        n = int(ctx.conf.get("nnbench.ops", "100"))
+        lats = []
+        root = f"/nnbench-work/{me}"
+        fs.mkdirs(root)
+        for i in range(n):
+            p = f"{root}/f{i}"
+            t0 = _time.perf_counter()
+            fs.write_all(p, b"d")             # create+write+complete
+            lats.append(_time.perf_counter() - t0)
+            t0 = _time.perf_counter()
+            fs.get_file_status(p)             # stat
+            lats.append(_time.perf_counter() - t0)
+            t0 = _time.perf_counter()
+            fs.rename(p, p + ".r")            # rename
+            lats.append(_time.perf_counter() - t0)
+            t0 = _time.perf_counter()
+            fs.delete(p + ".r")               # delete
+            lats.append(_time.perf_counter() - t0)
+        fs.close()
+        ctx.emit(me.encode(),
+                 ",".join(f"{x * 1000:.3f}" for x in lats).encode())
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--maps", type=int, default=4)
+    ap.add_argument("--ops", type=int, default=200)
+    args = ap.parse_args()
+    print(json.dumps(run(args.maps, args.ops)))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

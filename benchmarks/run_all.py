@@ -84,6 +84,9 @@ def main() -> None:
     # Dynamometer: >=100K-op audit replay against a real NameNode over
     # real RPC (ref: hadoop-dynamometer AuditReplayMapper).
     out["dynamometer"] = _dynamometer(int(100_000 * scale) or 20_000)
+    from benchmarks import nn_bench
+    out["nnbench"] = nn_bench.run(maps=4, ops_per_map=int(200 * scale)
+                                  or 40)
     out["wall_seconds"] = round(time.perf_counter() - t0, 1)
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)
