@@ -48,9 +48,12 @@ class MeshPlan:
         if self.vpp > 1 and self.pp == 1:
             raise ValueError("vpp (interleaved virtual stages) requires "
                              "pp > 1")
-        if self.sp > 1 and (self.tp > 1 or self.pp > 1):
-            raise ValueError("ring context parallelism (sp) is composed "
-                             "with dp only in this version")
+        if self.sp > 1 and self.megatron_sp:
+            raise ValueError("sp composes with plain tp, not megatron_sp "
+                             "(two different sequence shardings would "
+                             "fight over the same dimension)")
+        if self.sp > 1 and self.ep > 1:
+            raise ValueError("sp x ep (MoE) is not supported yet")
 
     @property
     def n_devices(self) -> int:
@@ -87,9 +90,9 @@ class MeshPlan:
             (batch % (self.dp * self.ep) == 0, "batch %% dp*ep"),
             (seq % self.sp == 0, "seq %% sp"),
             (self.sp_mode != "ulysses" or self.sp == 1 or
-             (cfg.n_heads % self.sp == 0 and
-              cfg.n_kv_heads % self.sp == 0),
-             "heads %% sp (ulysses)"),
+             ((cfg.n_heads // self.tp) % self.sp == 0 and
+              (cfg.n_kv_heads // self.tp) % self.sp == 0),
+             "heads %% sp (ulysses; after tp head split)"),
             (not self.megatron_sp or seq % self.tp == 0, "seq %% tp (sp)"),
             (not cfg.is_moe or cfg.n_experts % self.ep == 0, "experts %% ep"),
             (self.ep == 1 or cfg.is_moe, "ep needs a MoE config"),

@@ -193,8 +193,11 @@ def test_plan_validation_rejects_bad_shapes():
     cfg = get_config("tiny")
     with pytest.raises(ValueError):
         MeshPlan(dp=2, tp=3).validate(cfg, BATCH, SEQ)
+    # sp now composes with tp/pp; the remaining exclusions:
     with pytest.raises(ValueError):
-        MeshPlan(sp=2, tp=2)
+        MeshPlan(sp=2, tp=2, megatron_sp=True)
+    with pytest.raises(ValueError):
+        MeshPlan(sp=2, ep=2)
     with pytest.raises(ValueError):
         MeshPlan(megatron_sp=True)
 
@@ -272,3 +275,32 @@ def test_ulysses_validation_rejects_indivisible_heads():
     with _pytest.raises(ValueError, match="heads"):
         MeshPlan(dp=1, sp=bad_sp, sp_mode="ulysses").validate(
             cfg, BATCH, max(SEQ, bad_sp * 8))
+
+
+def test_ring_composes_with_tp(reference_dense):
+    """sp x tp: context parallelism with tensor-parallel weights in the
+    same step (previously restricted to sp x dp)."""
+    cfg = get_config("tiny")
+    losses, params = _run_plan(cfg, MeshPlan(dp=2, tp=2, sp=2))
+    ref_losses, ref_params = reference_dense
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
+    _assert_tree_close(params, ref_params)
+
+
+def test_ring_composes_with_pp(reference_dense):
+    cfg = get_config("tiny")
+    losses, params = _run_plan(cfg, MeshPlan(dp=2, pp=2, sp=2),
+                               n_microbatches=2)
+    ref_losses, ref_params = reference_dense
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
+    _assert_tree_close(params, ref_params)
+
+
+def test_ulysses_composes_with_tp(reference_dense):
+    cfg = get_config("tiny")
+    # tp=2 halves head counts to 2q/1kv; sp=2 needs both divisible — 2/1
+    # fails kv, so validate() must reject ulysses here and ring covers it
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="heads"):
+        MeshPlan(dp=2, tp=2, sp=2, sp_mode="ulysses").validate(
+            get_config("tiny"), BATCH, SEQ)
