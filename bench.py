@@ -39,27 +39,36 @@ PEAK_BF16_FLOPS = {
     "v4": 275e12, "v6": 918e12, "cpu": 1e12,
 }
 
-# Fallback ladder: (preset, batch, remat, subprocess wall budget seconds).
-# "dots" (selective) remat rungs come FIRST: full remat re-runs the
-# whole forward in backward, so the hardware spends ~4 units of matmul
-# per 3 units the MFU formula credits — selective remat keeps MXU
-# outputs and replays only elementwise/norm work, so nearly every
-# hardware FLOP is a counted FLOP (expected ~+30% measured MFU at
-# equal utilization; see PROFILE.md). A dots rung that OOMs just falls
-# through to its full-remat sibling. flagship-1b batch 4 + full remat
-# was round 3's best explored config; flagship-420m batch 8 full is
-# the verified round-2 number (MFU 0.3328); tiny exists so an
-# outage-day run still records *a* number rather than nothing.
+# Fallback ladder: (preset, batch, remat, subprocess wall budget seconds),
+# ordered by expected MFU. "dots" (selective) remat rungs come FIRST:
+# full remat re-runs the whole forward in backward, so the hardware
+# spends ~4 units of matmul per 3 units the MFU formula credits —
+# selective remat keeps MXU outputs and replays only elementwise/norm
+# work, so nearly every hardware FLOP is a counted FLOP (see
+# PROFILE.md). Sizing (measured on the 2026-07-30 live window):
+# flagship-1b dots batch 4 OOMs in HLO temps (~5.7 GB of saved MXU
+# outputs vs ~3.7 GB of HBM left beside the 12 GB param+grad+AdamW
+# resident set) — batch 2 is the config that fits, and its d=2048
+# contractions carry a higher single-chip MXU ceiling than 420m's
+# d=1024 (models/config.py note). flagship-420m batch 8 dots fits
+# comfortably (state ~5 GB); its full-remat sibling is the verified
+# round-2 config (MFU 0.3328). tiny exists so an outage-day run still
+# records *a* number rather than nothing.
 LADDER = [
-    ("flagship-1b", 4, "dots", 1200.0),
-    ("flagship-1b", 4, "full", 900.0),
-    ("flagship-420m", 8, "dots", 600.0),
-    ("flagship-420m", 8, "full", 450.0),
+    ("flagship-1b", 2, "dots", 900.0),
+    ("flagship-420m", 8, "dots", 900.0),
+    ("flagship-420m", 8, "full", 600.0),
     ("tiny", 8, "none", 300.0),
 ]
 
+# The environment's sitecustomize force-registers the tunneled TPU and
+# overrides JAX_PLATFORMS, so an env var alone cannot redirect the bench;
+# BENCH_PLATFORM uses jax.config (authoritative) — it exists so the
+# ladder/orchestrator logic itself can be driven on CPU.
 PREFLIGHT = (
-    "import jax, jax.numpy as jnp;"
+    "import os, jax, jax.numpy as jnp;"
+    "p = os.environ.get('BENCH_PLATFORM');"
+    "p and jax.config.update('jax_platforms', p);"
     "x = jnp.ones((256, 256), jnp.bfloat16);"
     "print('PREFLIGHT_OK', float((x @ x)[0, 0]),"
     "      jax.devices()[0].device_kind)"
@@ -79,6 +88,10 @@ def _measure(args) -> None:
     remat = {"none": False, "full": True, "dots": "dots"}[args.remat]
 
     import jax
+
+    platform = os.environ.get("BENCH_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
 
     # Persistent compile cache: the ~1B step takes minutes to compile on
     # the tunneled backend and every bench invocation is a fresh process.
@@ -175,6 +188,7 @@ def _preflight(budget: float) -> bool:
 
 def _orchestrate(args) -> int:
     errors = []
+    deadline = time.monotonic() + args.total_budget
     if not _preflight(args.preflight_budget):
         errors.append("preflight: backend UNAVAILABLE within budget")
         # Fall through anyway with the smallest preset — the measurement
@@ -183,7 +197,26 @@ def _orchestrate(args) -> int:
         ladder = LADDER[-1:]
     else:
         ladder = LADDER
+    backend_suspect = False
     for preset, batch, remat, budget in ladder:
+        if time.monotonic() > deadline:
+            errors.append("total budget exhausted")
+            break
+        if backend_suspect:
+            # The previous rung timed out — on the tunneled backend that
+            # usually means the device flapped mid-ladder (it comes and
+            # goes on a minutes timescale), not that the rung was too
+            # big. Don't burn the remaining rung budgets against a dead
+            # device: wait for a preflight to answer again first.
+            wait = min(args.preflight_budget, deadline - time.monotonic())
+            if wait <= 0 or not _preflight(wait):
+                errors.append("backend did not come back; stopping ladder")
+                break
+            backend_suspect = False
+        budget = min(budget, deadline - time.monotonic())
+        if budget <= 0:
+            errors.append("total budget exhausted")
+            break
         cmd = [sys.executable, os.path.abspath(__file__),
                "--_measure", "--preset", preset, "--batch", str(batch),
                "--remat", remat, "--seq", str(args.seq),
@@ -193,6 +226,7 @@ def _orchestrate(args) -> int:
                                   timeout=budget)
         except subprocess.TimeoutExpired:
             errors.append(f"{preset}: exceeded {budget:.0f}s budget")
+            backend_suspect = True
             continue
         result = None
         for ln in proc.stdout.splitlines():
@@ -206,6 +240,8 @@ def _orchestrate(args) -> int:
         if proc.returncode == 0 and result:
             result["fallbacks"] = errors
             print(json.dumps(result))
+            if os.environ.get("BENCH_PLATFORM"):
+                return 0  # smoke-test run: keep it out of the TPU log
             try:
                 entry = dict(result)
                 entry["timestamp"] = datetime.datetime.now().isoformat(
@@ -237,6 +273,10 @@ def main() -> int:
     ap.add_argument("--remat", default="full",
                     choices=["none", "full", "dots"])
     ap.add_argument("--preflight-budget", type=float, default=420.0)
+    ap.add_argument("--total-budget", type=float, default=5400.0,
+                    help="overall wall-clock cap across rungs + backend "
+                    "waits (the tunneled device flaps; waiting is often "
+                    "the right spend)")
     ap.add_argument("--_measure", action="store_true",
                     help="internal: run one measurement in-process")
     args = ap.parse_args()

@@ -1,0 +1,70 @@
+"""Phase-timing probe for the tunneled TPU backend.
+
+Prints a wall-clock mark after every phase of one tiny train step so a
+hung or slow phase is attributable (the bench ladder only reports
+whole-rung budgets). Writes to stdout unbuffered; run as
+``python -u -m benchmarks.phase_probe [preset]``.
+"""
+
+import os
+import sys
+import time
+
+t0 = time.time()
+
+
+def mark(s):
+    print(f"{time.time() - t0:8.1f}s  {s}", flush=True)
+
+
+def main():
+    preset = sys.argv[1] if len(sys.argv) > 1 else "tiny"
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    remat = sys.argv[3] if len(sys.argv) > 3 else "none"
+    remat_arg = {"none": False, "full": True, "dots": "dots"}[remat]
+    import jax
+    import jax.numpy as jnp
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(here, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    mark(f"jax imported, devices={jax.devices()}")
+    from hadoop_tpu.models import count_params, get_config
+    from hadoop_tpu.parallel import MeshPlan, make_mesh
+    from hadoop_tpu.parallel.train import (init_sharded, make_data_sharding,
+                                           make_train_step)
+    mark("framework imported")
+    cfg = get_config(preset, max_seq=2048)
+    plan = MeshPlan()
+    mesh = make_mesh(plan)
+    step = make_train_step(cfg, plan, mesh, remat=remat_arg, donate=True)
+    mark("train step built")
+    params, opt = init_sharded(jax.random.PRNGKey(0), cfg, plan, mesh)
+    mark("init traced/dispatched")
+    jax.block_until_ready(params)
+    mark(f"init done, params={count_params(params)}")
+    ds = make_data_sharding(mesh)
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (batch, 2048), 0,
+                           cfg.vocab_size, dtype=jnp.int32), ds)
+    targets = jax.device_put(jnp.roll(tokens, -1, axis=1), ds)
+    jax.block_until_ready((tokens, targets))
+    mark("data ready")
+    lowered = step.lower(params, opt, tokens, targets)
+    mark("lowered")
+    compiled = lowered.compile()
+    mark("compiled")
+    params, opt, metrics = compiled(params, opt, tokens, targets)
+    mark("step 1 dispatched")
+    loss = float(metrics["loss"])
+    mark(f"step 1 synced (loss={loss:.4f})")
+    t1 = time.time()
+    for _ in range(5):
+        params, opt, metrics = compiled(params, opt, tokens, targets)
+    float(metrics["loss"])
+    dt = time.time() - t1
+    mark(f"5 steps in {dt:.2f}s = {batch * 2048 * 5 / dt:.0f} tok/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from hadoop_tpu.models.config import ModelConfig
 from hadoop_tpu.models.decoder import ParallelCtx
+from hadoop_tpu.parallel.ulysses import supports as _ulysses_supports
 
 AXES = ("dp", "pp", "tp", "ep", "sp")
 
@@ -90,8 +91,8 @@ class MeshPlan:
             (batch % (self.dp * self.ep) == 0, "batch %% dp*ep"),
             (seq % self.sp == 0, "seq %% sp"),
             (self.sp_mode != "ulysses" or self.sp == 1 or
-             ((cfg.n_heads // self.tp) % self.sp == 0 and
-              (cfg.n_kv_heads // self.tp) % self.sp == 0),
+             _ulysses_supports(cfg.n_heads // self.tp,
+                               cfg.n_kv_heads // self.tp, self.sp),
              "heads %% sp (ulysses; after tp head split)"),
             (not self.megatron_sp or seq % self.tp == 0, "seq %% tp (sp)"),
             (not cfg.is_moe or cfg.n_experts % self.ep == 0, "experts %% ep"),

@@ -296,11 +296,9 @@ def test_ring_composes_with_pp(reference_dense):
     _assert_tree_close(params, ref_params)
 
 
-def test_ulysses_composes_with_tp(reference_dense):
-    cfg = get_config("tiny")
+def test_ulysses_composes_with_tp():
     # tp=2 halves head counts to 2q/1kv; sp=2 needs both divisible — 2/1
     # fails kv, so validate() must reject ulysses here and ring covers it
-    import pytest as _pytest
-    with _pytest.raises(ValueError, match="heads"):
+    with pytest.raises(ValueError, match="heads"):
         MeshPlan(dp=2, tp=2, sp=2, sp_mode="ulysses").validate(
             get_config("tiny"), BATCH, SEQ)
