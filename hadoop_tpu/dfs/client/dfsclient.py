@@ -129,7 +129,13 @@ class DFSClient:
             from hadoop_tpu.dfs.protocol import datatransfer as _dt
             pkt = self.conf.get_size_bytes(
                 "dfs.client-write-packet-size", _dt.PACKET_SIZE)
-            stream = DFSOutputStream(self, path, packet_size=pkt)
+            # ref: dfs.bytes-per-checksum — the replica's meta stores the
+            # writer's chunking and read setup replies echo it back, so
+            # any reader verifies with the right bpc
+            bpc = self.conf.get_size_bytes(
+                "dfs.bytes-per-checksum", _dt.CHUNK_SIZE)
+            stream = DFSOutputStream(self, path, packet_size=pkt,
+                                     chunk_size=bpc)
         orig_close = stream.close
 
         def close_and_release():

@@ -165,13 +165,26 @@ class ShortCircuitCache:
                     break
                 fds.extend(newfds)
                 buf += chunk
-            resp = unpack(bytes(buf[4:4 + flen]))
+            if len(buf) < 4 + flen:
+                # DN died mid-reply; a truncated frame must degrade to
+                # the TCP path, not surface a decode error to read()
+                raise ShortCircuitUnavailable(
+                    f"truncated fd-grant reply ({len(buf)}/{4 + flen}B)")
+            try:
+                resp = unpack(bytes(buf[4:4 + flen]))
+            except Exception as e:  # WireError/garbage: same degrade
+                raise ShortCircuitUnavailable(
+                    f"undecodable fd-grant reply: {e}") from e
             if not resp.get("ok"):
                 raise ShortCircuitUnavailable(resp.get("em", "refused"))
-            if len(fds) != 2:
+            if len(fds) != 2 or "bpc" not in resp or "visible" not in resp:
                 raise ShortCircuitUnavailable(
-                    f"expected 2 fds, got {len(fds)}")
-            slot = _Slot(fds[0], fds[1], resp["bpc"], resp["visible"])
+                    f"malformed fd grant (fds={len(fds)})")
+            bpc = resp["bpc"]
+            if not isinstance(bpc, int) or not 0 < bpc <= (1 << 20):
+                raise ShortCircuitUnavailable(
+                    f"fd grant carries invalid bytes-per-checksum {bpc!r}")
+            slot = _Slot(fds[0], fds[1], bpc, resp["visible"])
             fds = []  # ownership moved into the slot
             return slot
         finally:

@@ -589,7 +589,8 @@ class DFSInputStream:
             setup = dt.recv_frame(sock)
             if not setup.get("ok"):
                 raise IOError(setup.get("em", "read setup failed"))
-            checksum = DataChecksum(dt.CHUNK_SIZE)
+            # verify with the replica's stored chunking, not our default
+            checksum = DataChecksum(dt.checked_bpc(setup))
             out = bytearray()
             skip = None
             while True:

@@ -367,12 +367,14 @@ class DataXceiverServer:
         offset = req.get("offset", 0)
         length = req.get("length", 1 << 62)
         self._fi().before_read_block(block, self.port)
+        bpc = dt.CHUNK_SIZE
         try:
             # Probe EAGERLY — read_chunks is a lazy generator, and a
             # replica-not-found must choose the PROVIDED fallback before
             # the setup reply, not explode mid-stream. The probe result
             # feeds read_chunks so the meta header parses once.
             opened = self.store.open_for_read(block)
+            bpc = opened[2].bytes_per_chunk
             chunks = self.store.read_chunks(block, offset, length,
                                             opened=opened)
         except IOError as e:
@@ -380,7 +382,10 @@ class DataXceiverServer:
             if chunks is None:
                 dt.send_frame(sock, {"ok": False, "em": str(e)})
                 return
-        dt.send_frame(sock, {"ok": True})
+        # The reply carries the replica's stored bytes-per-checksum so
+        # readers verify with the WRITER's chunking, not their default
+        # (ref: OpReadBlock's ReadOpChecksumInfoProto).
+        dt.send_frame(sock, {"ok": True, "bpc": bpc})
         seq = 0
         for pos, data, sums in chunks:
             data, sums = self._fi().corrupt_read_packet(block, data, sums)

@@ -96,6 +96,35 @@ def _check_dek_channel(fsn) -> None:
             "hadoop.rpc.protection=privacy for real protection.")
 
 
+def _check_admin_caller(fsn) -> None:
+    """Master-key-grade RPCs are restricted to cluster administrators.
+
+    DEKs (above) are per-connection material every client legitimately
+    needs; block-token MASTER keys let the holder mint arbitrary access
+    tokens, so handing them to any authenticated client would void
+    block-token authorization entirely. The reference keeps getBlockKeys
+    on NamenodeProtocol behind service-level ACLs reserved for the
+    balancer/admin principals (ref: HDFSPolicyProvider's
+    security.namenode.protocol.acl). Admins = the NN's own user plus
+    ``dfs.cluster.administrators``.
+    """
+    ctx = current_call()
+    if ctx is None or ctx.user is None:
+        return  # in-process embedding (tools linking the NN directly)
+    import getpass
+    admins = {a.strip() for a in
+              (fsn.conf.get("dfs.cluster.administrators", "") or ""
+               ).split(",") if a.strip()}
+    admins.add(getpass.getuser())
+    user = ctx.user
+    real = getattr(user, "real_user", None)
+    if user.user_name not in admins and \
+            (real is None or real.user_name not in admins):
+        raise AccessControlError(
+            f"user {user.user_name!r} is not a cluster administrator; "
+            "block-token master keys are admin-only")
+
+
 class ClientProtocol:
     """RPC facade over FSNamesystem. Ref: NameNodeRpcServer.java — the thin
     translation layer; at-most-once mutations go through the retry cache."""
@@ -408,6 +437,21 @@ class ClientProtocol:
         """Current replica holders of one block (balancer/mover probe)."""
         lb = self.fsn.bm.located_block(Block.from_wire(block), 0)
         return [d.to_wire() for d in lb.locations]
+
+    @idempotent
+    def get_block_keys(self) -> List[Dict]:
+        """Block-token master keys for the balancer/mover (ref:
+        NamenodeProtocol.getBlockKeys — the balancer mints its own
+        access tokens from the same master keys the DNs verify with).
+        Doubly gated: the channel must carry secrets (like DEKs) AND
+        the caller must be a cluster administrator — any client holding
+        master keys could mint tokens for any block."""
+        bt = self.fsn.block_tokens
+        if bt is None:
+            return []
+        _check_dek_channel(self.fsn)
+        _check_admin_caller(self.fsn)
+        return bt.export_keys()
 
     def invalidate_replica(self, block: Dict, uuid: str) -> bool:
         return self.fsn.bm.invalidate_replica(Block.from_wire(block), uuid)

@@ -66,6 +66,14 @@ class BlockTokenSecretManager(SecretManager):
     def import_keys(self, keys: List[Dict]) -> None:
         with self._lock:
             self._keys = {k["id"]: k["key"] for k in keys}
+            # Mint with the exporter's newest key: this instance's own
+            # counter is meaningless after the swap, and would KeyError
+            # in create_token once the exporter rotates past it (the
+            # balancer mints from imported keys the same way DNs do for
+            # transfers — ref: BlockTokenSecretManager.setKeys updating
+            # currentKey on the non-master side).
+            if self._keys:
+                self._key_id = max(self._keys)
 
     def check_access(self, token_wire: Dict, block_id: int,
                      mode: str) -> Dict:

@@ -46,6 +46,17 @@ STATUS_ERROR_CHECKSUM = "checksum"
 PACKET_SIZE = 1024 * 1024
 CHUNK_SIZE = 512                 # ref: dfs.bytes-per-checksum
 
+
+def checked_bpc(setup: dict) -> int:
+    """The replica's bytes-per-checksum from a read setup reply, bounds-
+    checked: a corrupt/malicious peer sending bpc<=0 must fail the
+    REPLICA (IOError → the reader's failover path), not crash the read
+    with a ZeroDivisionError the retry loop doesn't catch."""
+    bpc = setup.get("bpc", CHUNK_SIZE)
+    if not isinstance(bpc, int) or not 0 < bpc <= (1 << 20):
+        raise IOError(f"peer sent invalid bytes-per-checksum {bpc!r}")
+    return bpc
+
 # Pipeline stages (ref: BlockConstructionStage)
 STAGE_PIPELINE_SETUP_CREATE = "create"
 STAGE_PIPELINE_SETUP_APPEND = "append"
@@ -253,7 +264,7 @@ def read_block_range(addr, block_wire: Dict, offset: int,
         setup = recv_frame(sock)
         if not setup.get("ok"):
             raise IOError(setup.get("em", "read setup failed"))
-        checksum = DataChecksum(CHUNK_SIZE)
+        checksum = DataChecksum(checked_bpc(setup))
         out = bytearray()
         skip: Optional[int] = None
         while True:

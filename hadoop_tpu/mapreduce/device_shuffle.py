@@ -94,12 +94,20 @@ def device_group_reduce(mesh, axis: str, keys: jax.Array,
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
+    from hadoop_tpu.parallel.collectives import _PROGRAM_CACHE
+
     spec = P(axis)
     vspec = P(axis, *([None] * (values.ndim - 1)))
-    body = partial(_segment_reduce_sorted, op=op)
-    k, v, first = jax.jit(shard_map(
-        body, mesh=mesh, in_specs=(spec, vspec, spec),
-        out_specs=(spec, vspec, spec)))(res.keys, res.values, res.valid)
+    ck = ("segreduce", mesh, axis, op, res.keys.shape,
+          str(res.keys.dtype), res.values.shape[1:],
+          str(res.values.dtype))
+    prog = _PROGRAM_CACHE.get(ck)
+    if prog is None:
+        body = partial(_segment_reduce_sorted, op=op)
+        prog = _PROGRAM_CACHE.setdefault(ck, jax.jit(shard_map(
+            body, mesh=mesh, in_specs=(spec, vspec, spec),
+            out_specs=(spec, vspec, spec))))
+    k, v, first = prog(res.keys, res.values, res.valid)
     return ShuffleResult(k, v, first, res.dropped)
 
 

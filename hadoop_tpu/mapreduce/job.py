@@ -111,6 +111,16 @@ class Job:
 
     def submit(self):
         """Ref: JobSubmitter.submitJobInternal:139."""
+        # Resolve the spill codec HERE, once, into the job conf: map and
+        # reduce tasks on heterogeneous hosts must agree on the shuffle
+        # wire format, so a per-host liblz4 probe cannot be the decider
+        # (ref: JobConf.getMapOutputCompressorClass resolves client-side).
+        if str(self.conf.get("mapreduce.map.output.compress",
+                             "")).lower() in ("true", "1", "yes") and \
+                not self.conf.get("mapreduce.map.output.compress.codec"):
+            from hadoop_tpu.io.codecs import Lz4Codec
+            self.conf["mapreduce.map.output.compress.codec"] = \
+                "lz4" if Lz4Codec.available() else "zlib"
         if not self.input_paths or not self.output_path:
             raise ValueError("input and output paths are required")
         fs = FileSystem.get(self.default_fs, self.cluster_conf)

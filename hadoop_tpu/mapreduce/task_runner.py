@@ -97,18 +97,15 @@ def _spill_codec(conf):
     """Map-output spill codec (ref: mapreduce.map.output.compress[.codec]).
     Compression stays OFF by default like the reference — whether the
     shuffle compresses is a property of the JOB's data (terasort's
-    random records only pay the cpu; text workloads win big) — but when
-    a job turns it on without naming a codec, the default codec is lz4
-    (300/540 MB/s here) rather than the reference's zlib, falling back
-    to zlib when liblz4 is absent."""
+    random records only pay the cpu; text workloads win big). The codec
+    NAME is resolved client-side at submission (Job.submit defaults it
+    to lz4 when available there): every task must read the same conf
+    value — a per-host availability probe here would let map and reduce
+    tasks on heterogeneous hosts disagree about the shuffle wire format."""
     want = str(conf.get("mapreduce.map.output.compress", "")).lower()
     if want not in ("true", "1", "yes"):
         return None
-    name = conf.get("mapreduce.map.output.compress.codec")
-    if name:
-        return name
-    from hadoop_tpu.io.codecs import Lz4Codec
-    return "lz4" if Lz4Codec.available() else "zlib"
+    return conf.get("mapreduce.map.output.compress.codec") or "zlib"
 
 
 def run_map(job: Dict, task: Dict, umbilical, attempt_id: str,

@@ -413,7 +413,7 @@ bool rpc_call(Fs* fs, const char* method, std::vector<Value> args,
 
 // ------------------------------------------------------ async block read
 
-constexpr int kChunk = 512;  // dfs.bytes-per-checksum
+constexpr int kDefaultChunk = 512;  // dfs.bytes-per-checksum default
 
 struct Stream {
   // one located block: its replicas, output placement, protocol state
@@ -426,6 +426,10 @@ struct Stream {
   int fd = -1;
   bool setup_seen = false;
   bool done = false;
+  // bytes-per-checksum of the replica being streamed: the setup reply
+  // carries the WRITER's chunking ("bpc"); verifying with a fixed 512
+  // would fail every block written with a non-default chunk size
+  int chunk = kDefaultChunk;
   std::string inbuf;             // partial frames
   std::string outq;              // pending request bytes
   int64_t got = 0;
@@ -460,6 +464,7 @@ bool Stream::start(uint8_t*) {
     outq += body;
     inbuf.clear();
     setup_seen = false;
+    chunk = kDefaultChunk;
     got = 0;
     return true;
   }
@@ -524,6 +529,8 @@ bool Stream::on_readable(uint8_t* dst, Fs* fs) {
         return false;
       }
       setup_seen = true;
+      int64_t bpc = msg.get_int("bpc", kDefaultChunk);
+      if (bpc > 0 && bpc <= (1 << 20)) chunk = static_cast<int>(bpc);
       continue;
     }
     if (const Value* last = msg.get("last"); last && last->truthy()) {
@@ -543,15 +550,15 @@ bool Stream::on_readable(uint8_t* dst, Fs* fs) {
       return false;
     }
     // CRC32C per chunk (ref: DataChecksum.verifyChunkedSums)
-    size_t n_chunks = (data->s.size() + kChunk - 1) / kChunk;
+    const size_t ck = static_cast<size_t>(chunk);
+    size_t n_chunks = (data->s.size() + ck - 1) / ck;
     if (sums->s.size() < 4 * n_chunks) {
       fail_reason = "missing checksums";
       return false;
     }
     for (size_t c = 0; c < n_chunks; c++) {
-      size_t clen = std::min(static_cast<size_t>(kChunk),
-                             data->s.size() - c * kChunk);
-      uint32_t crc = htpu_crc32c(0, data->s.data() + c * kChunk, clen);
+      size_t clen = std::min(ck, data->s.size() - c * ck);
+      uint32_t crc = htpu_crc32c(0, data->s.data() + c * ck, clen);
       uint32_t expect =
           (static_cast<uint8_t>(sums->s[4 * c]) << 24) |
           (static_cast<uint8_t>(sums->s[4 * c + 1]) << 16) |

@@ -59,6 +59,9 @@ def hash_partitioner(n_parts: int) -> Callable[[jax.Array], jax.Array]:
         h = keys.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
         h ^= h >> 15
         return (h % jnp.uint32(n_parts)).astype(jnp.int32)
+    # program-cache identity: every hash_partitioner(n) compiles (and
+    # caches) the same exchange program
+    part.cache_key = ("hash", n_parts)
     return part
 
 
@@ -69,7 +72,20 @@ def range_partitioner(splits: jax.Array) -> Callable[[jax.Array], jax.Array]:
     ascending."""
     def part(keys: jax.Array) -> jax.Array:
         return jnp.searchsorted(splits, keys, side="left").astype(jnp.int32)
+    # splits ride into the cached program as a TRACED argument — new cut
+    # points (every device_sorted call samples fresh ones) reuse the
+    # same compiled exchange
+    part.cache_key = ("range", splits.shape[0], str(splits.dtype))
+    part.splits = splits
     return part
+
+
+# Compiled-program cache: jax.jit memoizes on the wrapped callable's
+# identity, so rebuilding shard_map(partial(...)) per call would retrace
+# and recompile the whole exchange every time — the opposite of the
+# "one compiled collective" this module exists for. Keyed on everything
+# that changes the lowered program.
+_PROGRAM_CACHE: dict = {}
 
 
 def _bucketize(keys, values, dest, n_dev: int, cap: int, pad_key):
@@ -101,10 +117,17 @@ def _bucketize(keys, values, dest, n_dev: int, cap: int, pad_key):
             send_m.reshape(n_dev, cap), dropped)
 
 
-def _exchange_local(keys, values, partition, n_dev: int, cap: int,
+def _exchange_local(keys, values, splits, partition, n_dev: int, cap: int,
                     pad_key, axis: str, sort_output: bool):
-    """Per-device body (under shard_map): bucket → all_to_all → merge."""
-    dest = jnp.clip(partition(keys), 0, n_dev - 1)
+    """Per-device body (under shard_map): bucket → all_to_all → merge.
+    ``splits`` is the traced range-partition operand (a dummy scalar for
+    non-range partitioners)."""
+    if splits.ndim:  # range partition: cut points are data, not code
+        dest = jnp.searchsorted(splits, keys,
+                                side="left").astype(jnp.int32)
+    else:
+        dest = partition(keys)
+    dest = jnp.clip(dest, 0, n_dev - 1)
     send_k, send_v, send_m, dropped = _bucketize(
         keys, values, dest, n_dev, cap, pad_key)
     # [n_dev, cap,...] → peer p receives our row p; we end with row j
@@ -154,16 +177,32 @@ def device_shuffle(mesh: Mesh, axis: str, keys: jax.Array,
     pad_key = jnp.iinfo(keys.dtype).max
     if partition is None:
         partition = hash_partitioner(n_dev)
+    part_key = getattr(partition, "cache_key", None)
+    is_range = bool(part_key) and part_key[0] == "range"
+    splits = partition.splits if is_range \
+        else jnp.zeros((), jnp.int32)  # 0-d sentinel: "not range"
 
     spec = P(axis)
     vspec = P(axis, *([None] * (values.ndim - 1)))
-    fn = shard_map(
-        partial(_exchange_local, partition=partition, n_dev=n_dev,
-                cap=cap, pad_key=pad_key, axis=axis,
-                sort_output=sort_output),
-        mesh=mesh, in_specs=(spec, vspec),
-        out_specs=(spec, vspec, spec, spec))
-    out_k, out_v, out_m, dropped = jax.jit(fn)(keys, values)
+
+    def build():
+        return jax.jit(shard_map(
+            partial(_exchange_local, partition=partition, n_dev=n_dev,
+                    cap=cap, pad_key=pad_key, axis=axis,
+                    sort_output=sort_output),
+            mesh=mesh, in_specs=(spec, vspec, P()),
+            out_specs=(spec, vspec, spec, spec)))
+
+    if part_key is None:
+        prog = build()  # custom partitioner: identity unknown, no cache
+    else:
+        ck = ("shuffle", mesh, axis, n_dev, cap, sort_output, part_key,
+              keys.shape, str(keys.dtype), values.shape[1:],
+              str(values.dtype))
+        prog = _PROGRAM_CACHE.get(ck)
+        if prog is None:
+            prog = _PROGRAM_CACHE.setdefault(ck, build())
+    out_k, out_v, out_m, dropped = prog(keys, values, splits)
     return ShuffleResult(out_k, out_v, out_m, dropped)
 
 
@@ -190,9 +229,14 @@ def sample_split_points(mesh: Mesh, axis: str, keys: jax.Array,
         idx = (jnp.arange(1, n_parts) * allsamp.shape[0]) // n_parts
         return allsamp[idx]
 
-    fn = shard_map(body, mesh=mesh, in_specs=(P(axis),),
-                   out_specs=P())
-    return jax.jit(fn)(keys)
+    ck = ("sample", mesh, axis, n_parts, per_dev, keys.shape,
+          str(keys.dtype))
+    prog = _PROGRAM_CACHE.get(ck)
+    if prog is None:
+        prog = _PROGRAM_CACHE.setdefault(
+            ck, jax.jit(shard_map(body, mesh=mesh, in_specs=(P(axis),),
+                                  out_specs=P())))
+    return prog(keys)
 
 
 def device_sorted(mesh: Mesh, axis: str, keys: jax.Array,

@@ -176,7 +176,12 @@ class SecretManager:
                      extra: Optional[Dict] = None) -> Token:
         with self._lock:
             kid = self._key_id
-            key = self._keys[kid]
+            key = self._keys.get(kid)
+        if key is None:
+            # A verification-only instance whose keys were never imported
+            # (or were cleared) must fail like an auth error, not KeyError.
+            raise AccessControlError(
+                f"no current master key (id {kid}) to mint {self.kind}")
         ident = pack({
             "owner": owner, "renewer": renewer, "issue": time.time(),
             "expiry": time.time() + self.token_ttl_s, "key_id": kid,

@@ -145,14 +145,21 @@ class LoadReducer(Reducer):
         self._ratio = float(ctx.conf.get("gridmix.load.reduce.ratio", "1"))
         self._burner = _CpuBurner(float(ctx.conf.get(
             "gridmix.load.reduce.cpu-ms", "0")))
+        # this task's expected share of the traced reduce input, so the
+        # CPU burn completes over the real record stream instead of a
+        # hard-coded count
+        self._in_records = max(1, int(ctx.conf.get(
+            "gridmix.load.reduce.input-records", "10000")))
         self._seen = 0
         self._acc = 0.0
 
     def reduce(self, key, values, ctx):
         n = sum(1 for _ in values)
         self._seen += n
-        self._burner.burn_fraction(min(1.0, self._seen / 10_000.0))
-        self._acc += self._ratio
+        self._burner.burn_fraction(self._seen / self._in_records)
+        # emit at the traced out/in ratio PER INPUT RECORD (a group of
+        # 100 at ratio 1.0 must emit ~100, not 1)
+        self._acc += self._ratio * n
         while self._acc >= 1.0:
             self._acc -= 1.0
             ctx.emit(key, str(n).encode())
@@ -197,10 +204,13 @@ def _make_load_job(Job, class_ref, rm_addr, default_fs, entry, idx,
            .set("gridmix.load.cpu-ms",
                 str(int(m["ms"] * cpu_fraction))))
     if r:
+        n_red = max(1, r["n"])
         job.set_reducer(class_ref(LoadReducer)) \
-           .set_num_reduces(max(1, r["n"])) \
+           .set_num_reduces(n_red) \
            .set("gridmix.load.reduce.ratio", str(
                r["output_records"] / max(1, r["input_records"]))) \
+           .set("gridmix.load.reduce.input-records", str(
+               max(1, r["input_records"] // n_red))) \
            .set("gridmix.load.reduce.cpu-ms",
                 str(int(r["ms"] * cpu_fraction)))
     else:

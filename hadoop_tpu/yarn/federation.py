@@ -83,13 +83,21 @@ class FederationStateStore:
             return True
 
     def subcluster_heartbeat(self, sc_id: str, state: str = SC_ACTIVE
-                             ) -> None:
+                             ) -> bool:
         with self._lock:
             sc = self._subclusters.get(sc_id)
-            if sc is not None:
+            # DEREGISTERED is administrative and final (until an explicit
+            # re-register): neither a failure demotion (mark_lost) nor a
+            # successful liveness probe may overwrite it — both race the
+            # admin's deregister, and an overwrite resurrects a drained
+            # RM into routing. Enforced HERE, under the store lock, so
+            # every caller's check-then-act window closes at once.
+            if sc is not None and sc["state"] != SC_DEREGISTERED:
                 sc["state"] = state
                 sc["last_heartbeat"] = time.time()
                 self._save_locked()
+                return True
+        return False
 
     def subclusters(self, active_only: bool = False) -> Dict[str, Dict]:
         with self._lock:
@@ -215,7 +223,11 @@ def make_policy(wire: Dict, router: "YarnRouter") -> RouterPolicy:
         return WeightedRandomPolicy(wire.get("weights", {}))
     if kind == "reject":
         return RejectPolicy()
-    return LoadBasedPolicy(router)
+    if kind == "load":
+        return LoadBasedPolicy(router)
+    # A typo'd type must fail set_policy's validation loudly, not route
+    # by the wrong policy forever.
+    raise ValueError(f"unknown router policy type {kind!r}")
 
 
 # -------------------------------------------------------------- interceptors
@@ -533,11 +545,14 @@ class YarnRouter(AbstractService):
 
     def mark_lost(self, sc_id: str) -> None:
         """Eager failure demotion: the next routing decision must not
-        wait for the liveness sweep to notice a dead RM."""
-        log.warning("subcluster %s marked LOST after call failure", sc_id)
+        wait for the liveness sweep to notice a dead RM. (The state
+        store itself refuses to overwrite an administrative DEREGISTER —
+        the atomicity lives under its lock, not here.)"""
         with self._lock:
             self._proxies.pop(sc_id, None)
-        self.store.subcluster_heartbeat(sc_id, SC_LOST)
+        if self.store.subcluster_heartbeat(sc_id, SC_LOST):
+            log.warning("subcluster %s marked LOST after call failure",
+                        sc_id)
 
     # ------------------------------------------------------------ liveness
 

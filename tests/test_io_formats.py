@@ -119,17 +119,32 @@ def test_lz4_snappy_codecs_roundtrip_and_reject_garbage():
 
 def test_spill_codec_policy():
     """Spill compression is off by default (like the reference); when a
-    job opts in without naming a codec, lz4 is the default codec."""
+    job opts in without naming a codec, the CLIENT resolves the lz4
+    default into the job conf at submission (Job.submit) so every task
+    sees the same name — task-side resolution is conf-driven only."""
     from hadoop_tpu.conf import Configuration
     from hadoop_tpu.io.codecs import Lz4Codec
+    from hadoop_tpu.mapreduce.job import Job
     from hadoop_tpu.mapreduce.task_runner import _spill_codec
 
     conf = Configuration(load_defaults=False)
     assert _spill_codec(conf) is None            # off by default (ref)
     conf.set("mapreduce.map.output.compress", "true")
-    assert _spill_codec(conf) == \
-        ("lz4" if Lz4Codec.available() else "zlib")
+    # tasks never probe the host: absent a resolved codec they use the
+    # deterministic zlib fallback
+    assert _spill_codec(conf) == "zlib"
     conf.set("mapreduce.map.output.compress.codec", "zstd")
     assert _spill_codec(conf) == "zstd"
     conf.set("mapreduce.map.output.compress", "false")
     assert _spill_codec(conf) is None
+
+    # the submission-side default: compress on, no codec named → the
+    # client picks lz4 when IT has the library
+    job = Job(("127.0.0.1", 1), "file:///tmp") \
+        .set("mapreduce.map.output.compress", "true")
+    try:
+        job.submit()
+    except Exception:
+        pass  # no cluster: only the conf resolution step matters here
+    assert job.conf.get("mapreduce.map.output.compress.codec") == \
+        ("lz4" if Lz4Codec.available() else "zlib")

@@ -211,3 +211,21 @@ def test_merge_manager_disk_spill(tmp_path):
     keys = [k for k, _ in merger.merged_iterator()]
     assert keys == sorted(keys) and len(keys) == 10
     assert len(merger._disk_runs) >= 1
+
+
+def test_spill_codec_is_conf_driven_not_host_probed():
+    """Tasks must read the codec NAME from the job conf (resolved once
+    at submission) — a per-host liblz4 probe would let map and reduce
+    tasks on heterogeneous hosts disagree about the shuffle wire format
+    (review finding)."""
+    from hadoop_tpu.mapreduce.task_runner import _spill_codec
+
+    assert _spill_codec({}) is None
+    assert _spill_codec({"mapreduce.map.output.compress": "false"}) is None
+    # compress on + explicit codec: honored verbatim
+    assert _spill_codec({"mapreduce.map.output.compress": "true",
+                         "mapreduce.map.output.compress.codec": "lz4"}) \
+        == "lz4"
+    # compress on + no codec in conf (job predates client resolution):
+    # the deterministic zlib fallback, NEVER a host-dependent answer
+    assert _spill_codec({"mapreduce.map.output.compress": "true"}) == "zlib"

@@ -408,3 +408,27 @@ def test_domain_socket_concurrent_grants_and_bad_peers(cluster, fs):
         t.join()
     assert not errs, errs
     assert cache.hits > 0
+
+
+def test_non_default_bytes_per_checksum_roundtrip(tmp_path):
+    """dfs.bytes-per-checksum != 512: the replica meta stores the
+    writer's chunking and the read setup reply echoes it, so readers
+    verify with the WRITER's bpc instead of assuming the default
+    (review finding: clients hard-coded 512 and failed every block
+    written with another chunk size)."""
+    import os as _os
+
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster, fast_conf
+
+    conf = fast_conf()
+    conf.set("dfs.replication", "1")
+    conf.set("dfs.bytes-per-checksum", "2048")
+    # force the remote (TCP) read path so the bpc rides the setup reply
+    conf.set("dfs.client.read.shortcircuit", "false")
+    with MiniDFSCluster(num_datanodes=1, conf=conf,
+                        base_dir=str(tmp_path)) as c:
+        c.wait_active()
+        fs = c.get_filesystem()
+        payload = _os.urandom(300_001)  # odd size: partial last chunk
+        fs.write_all("/bpc.bin", payload)
+        assert fs.read_all("/bpc.bin") == payload

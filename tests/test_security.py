@@ -639,3 +639,114 @@ def test_block_tokens_with_erasure_coding(tmp_path):
         payload = _os.urandom(500_000)
         fs.write_all("/ec/tok.bin", payload)
         assert fs.read_all("/ec/tok.bin") == payload
+
+
+def test_block_token_import_tracks_exporter_key_ids():
+    """A verification-side manager mints with the EXPORTER's newest key
+    after import (balancer/DN-minted transfer tokens), and key rotation
+    on the NN side must not strand importers on a stale counter
+    (review finding: import_keys left _key_id at its local value)."""
+    from hadoop_tpu.dfs.protocol.blocktoken import (MODE_COPY,
+                                                    BlockTokenSecretManager)
+
+    nn = BlockTokenSecretManager()
+    dn = BlockTokenSecretManager.for_verification()
+    dn.import_keys(nn.export_keys())
+    tok = dn.generate_token("balancer", 42, modes=(MODE_COPY,))
+    nn.check_access(tok, 42, MODE_COPY)  # NN-side keys verify it
+
+    # rotate past the importer's original counter value
+    for _ in range(3):
+        nn._roll_key()
+    dn.import_keys(nn.export_keys())
+    tok2 = dn.generate_token("balancer", 43, modes=(MODE_COPY,))
+    nn.check_access(tok2, 43, MODE_COPY)
+
+
+def test_mint_without_keys_is_access_error():
+    """An empty verification-side manager fails minting like an auth
+    error, not a KeyError."""
+    from hadoop_tpu.dfs.protocol.blocktoken import BlockTokenSecretManager
+    from hadoop_tpu.security.ugi import AccessControlError
+
+    dn = BlockTokenSecretManager.for_verification()
+    with pytest.raises(AccessControlError, match="master key"):
+        dn.generate_token("x", 1)
+
+
+def test_truncated_fd_grant_degrades_to_unavailable(tmp_path):
+    """A DN dying mid-reply on the fd channel must surface as
+    ShortCircuitUnavailable (which the read path converts into TCP
+    fallback), not a decode error that fails the whole read (review
+    finding)."""
+    import socket as _socket
+    import struct as _struct
+    import threading as _threading
+
+    from hadoop_tpu.dfs.client.shortcircuit import (ShortCircuitCache,
+                                                    ShortCircuitUnavailable)
+    from hadoop_tpu.dfs.protocol.records import Block
+
+    path = str(tmp_path / "dn.sock")
+    srv = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+    srv.bind(path)
+    srv.listen(1)
+
+    def half_reply():
+        conn, _ = srv.accept()
+        try:
+            conn.recv(1 << 16)  # swallow the request
+            # claim a 64-byte frame, deliver 3 bytes, die
+            conn.sendall(_struct.pack(">I", 64) + b"\x81\x01\x02")
+        finally:
+            conn.close()
+
+    t = _threading.Thread(target=half_reply, daemon=True)
+    t.start()
+    cache = ShortCircuitCache()
+    try:
+        with pytest.raises(ShortCircuitUnavailable, match="truncated"):
+            cache._request_fds(path, Block(1, 1, 10), None)
+    finally:
+        t.join(timeout=5)
+        srv.close()
+
+
+def test_block_token_master_keys_are_admin_only(tmp_path):
+    """get_block_keys on ClientProtocol (the balancer's feed) hands out
+    block-token MASTER keys — any client holding them can mint tokens
+    for any block, so the RPC is restricted to cluster administrators
+    (ref: NamenodeProtocol behind service ACLs; review finding)."""
+    from hadoop_tpu.ipc import get_proxy
+    from hadoop_tpu.security.ugi import (AccessControlError,
+                                         UserGroupInformation)
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster, fast_conf
+
+    conf = fast_conf()
+    conf.set("dfs.replication", "1")
+    conf.set("dfs.block.access.token.enable", "true")
+    with MiniDFSCluster(num_datanodes=1, conf=conf,
+                        base_dir=str(tmp_path)) as cluster:
+        cluster.wait_active()
+        nn = get_proxy("ClientProtocol", cluster.nn_addr)
+        # the NN's own user (what the balancer runs as) gets keys
+        keys = nn.get_block_keys()
+        assert keys and all("key" in k for k in keys)
+        # an ordinary remote user does not
+        mallory = UserGroupInformation.create_remote_user("mallory")
+        evil = get_proxy("ClientProtocol", cluster.nn_addr, user=mallory)
+        with pytest.raises(AccessControlError, match="administrator"):
+            evil.get_block_keys()
+
+
+def test_invalid_wire_bpc_fails_replica_not_read(tmp_path):
+    """A peer replying bpc<=0 must fail like a replica IO error (the
+    reader's failover path), never a ZeroDivisionError (review
+    finding)."""
+    from hadoop_tpu.dfs.protocol.datatransfer import checked_bpc
+
+    assert checked_bpc({}) == 512
+    assert checked_bpc({"bpc": 2048}) == 2048
+    for bad in (0, -1, "512", 1 << 21, None):
+        with pytest.raises(IOError, match="bytes-per-checksum"):
+            checked_bpc({"bpc": bad})

@@ -423,3 +423,37 @@ def test_atsv2_reader_flow_run_aggregation(tmp_path):
                        for e in ents["entities"])
         finally:
             reader.stop()
+
+
+def test_load_reducer_emits_per_input_record():
+    """The traced reduce out/in ratio applies PER INPUT RECORD: a group
+    of 100 values at ratio 1.0 emits ~100 records, not 1 (review
+    finding), and the CPU burn completes over the task's real record
+    count instead of a hard-coded 10k."""
+    from hadoop_tpu.tools.gridmix import LoadReducer
+
+    class _Ctx:
+        def __init__(self):
+            self.conf = {"gridmix.load.reduce.ratio": "1.0",
+                         "gridmix.load.reduce.cpu-ms": "0",
+                         "gridmix.load.reduce.input-records": "300"}
+            self.out = []
+
+        def emit(self, k, v):
+            self.out.append((k, v))
+
+    ctx = _Ctx()
+    red = LoadReducer()
+    red.setup(ctx)
+    for g in range(3):
+        red.reduce(f"k{g}".encode(), iter([b"v"] * 100), ctx)
+    assert len(ctx.out) == 300
+
+    # ratio 0.25 over 400 inputs → 100 outputs
+    ctx2 = _Ctx()
+    ctx2.conf["gridmix.load.reduce.ratio"] = "0.25"
+    red2 = LoadReducer()
+    red2.setup(ctx2)
+    for g in range(4):
+        red2.reduce(f"k{g}".encode(), iter([b"v"] * 100), ctx2)
+    assert len(ctx2.out) == 100

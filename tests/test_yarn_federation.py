@@ -236,3 +236,36 @@ def test_queue_policy_enforced_on_real_submissions(federation):
         assert router.store.home_of(str(app_id)) is None
     finally:
         yc.close()
+
+
+def test_mark_lost_does_not_resurrect_deregistered(federation):
+    """An administratively deregistered subcluster stays deregistered
+    even when a stale caller hits a transient error against it —
+    mark_lost demoting it to LOST would put it back on the liveness
+    sweep's probe list and resurrect a drained-but-running RM into
+    routing (review finding)."""
+    from hadoop_tpu.yarn.federation import SC_DEREGISTERED
+    c1, c2, router = federation
+    # register a live-but-drained subcluster, then deregister it
+    router.store.register_subcluster(
+        "sc-drained", f"{c1.rm_addr[0]}:{c1.rm_addr[1]}")
+    assert router.store.deregister_subcluster("sc-drained")
+    router.mark_lost("sc-drained")
+    assert router.store.subclusters()["sc-drained"]["state"] == \
+        SC_DEREGISTERED
+    # two liveness sweeps later it still must not be probed back ACTIVE
+    time.sleep(2.5)
+    assert router.store.subclusters()["sc-drained"]["state"] == \
+        SC_DEREGISTERED
+    router.store._subclusters.pop("sc-drained", None)  # cleanup
+
+
+def test_set_policy_rejects_unknown_type(federation):
+    """A typo'd policy type fails set_policy loudly instead of silently
+    routing by the load-based default forever (review finding)."""
+    c1, c2, router = federation
+    from hadoop_tpu.ipc import get_proxy
+    admin = get_proxy("RouterAdminProtocol", ("127.0.0.1", router.port))
+    with pytest.raises(Exception, match="unknown router policy"):
+        admin.set_policy("typo-queue", {"type": "round_robin"})
+    assert router.store.policy_for("typo-queue") is None
