@@ -370,3 +370,55 @@ def test_fuse_dfs_mount_end_to_end(tmp_path):
                 proc.wait(timeout=5)
             except _subprocess.TimeoutExpired:
                 proc.kill()
+
+
+def test_htpufast_verifies_with_writer_bytes_per_checksum(tmp_path):
+    """Blocks written with a non-default dfs.bytes-per-checksum must
+    CRC-verify in the C++ client: the read setup reply carries the
+    writer's chunking and htpufast uses it instead of assuming 512
+    (review finding — a fixed 512 failed every such block)."""
+    import ctypes
+    import os as _os
+
+    from hadoop_tpu import native as _nat
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster, fast_conf
+
+    lib = _nat.get_lib()
+    if lib is None or not hasattr(lib, "htpufast_read_file"):
+        import pytest as _pytest
+        _pytest.skip("native library unavailable")
+    lib.htpufast_open.restype = ctypes.c_void_p
+    lib.htpufast_open.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                  ctypes.c_char_p]
+    lib.htpufast_close.argtypes = [ctypes.c_void_p]
+    lib.htpufast_error.restype = ctypes.c_char_p
+    lib.htpufast_error.argtypes = [ctypes.c_void_p]
+    lib.htpufast_file_length.restype = ctypes.c_int64
+    lib.htpufast_file_length.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.htpufast_read_file.restype = ctypes.c_int64
+    lib.htpufast_read_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.POINTER(ctypes.c_uint8),
+                                       ctypes.c_int64]
+
+    conf = fast_conf()
+    conf.set("dfs.replication", "1")
+    conf.set("dfs.bytes-per-checksum", "4096")
+    with MiniDFSCluster(num_datanodes=1, conf=conf,
+                        base_dir=str(tmp_path)) as cluster:
+        cluster.wait_active()
+        fs = cluster.get_filesystem()
+        payload = _os.urandom(200_123)  # partial tail chunk at 4096 too
+        fs.write_all("/bpc4k.bin", payload)
+        import time as _time
+        _time.sleep(0.2)
+
+        h = lib.htpufast_open(b"127.0.0.1", cluster.namenode.port, b"root")
+        try:
+            n = lib.htpufast_file_length(h, b"/bpc4k.bin")
+            assert n == len(payload), lib.htpufast_error(h)
+            buf = (ctypes.c_uint8 * n)()
+            got = lib.htpufast_read_file(h, b"/bpc4k.bin", buf, n)
+            assert got == n, lib.htpufast_error(h)
+            assert bytes(buf) == payload
+        finally:
+            lib.htpufast_close(h)
