@@ -1,0 +1,159 @@
+"""Device-resident Reed-Solomon coding: GF(256) as fused VPU bit-ops.
+
+SURVEY §5.8 names the opportunity: when striped data is already in HBM
+(device-resident datasets, checkpoint shards), EC encode/decode can run
+on the accelerator instead of round-tripping to the host C++ coder
+(native/src/erasure_code.cc; ref: the ISA-L path behind
+io/erasurecode/rawcoder/NativeRSRawEncoder.java).
+
+The trick that makes GF(256) arithmetic TPU-shaped: a multiply by the
+constant ``c`` decomposes over the bits of the data byte —
+
+    gf_mul(c, b) = XOR_{s: bit s of b set} gf_mul(c, 2**s)
+
+so with bytes packed four-per-uint32 word, each term is
+
+    ((word >> s) & 0x01010101) * gf_mul(c, 2**s)
+
+(a 0/1 byte-lane mask times a constant < 256 — no cross-byte carries),
+and a parity word is the XOR of ``8*k`` such terms. Everything is
+shift/and/multiply/xor on int32 lanes: XLA fuses the whole generator
+matrix into one elementwise pass over the stripe, no gathers, no
+tables, MDS output **bit-identical to the host coders** (same Cauchy
+matrix, same byte-wise math — wire parity holds, so a DN's C++ coder
+can reconstruct what a device program encoded and vice versa).
+
+Decode reuses the host-side Gauss-Jordan inversion (a k×k uint8 matrix
+— trivially host work) and applies the recovery matrix with the same
+fused kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hadoop_tpu.io.erasurecode import (_MUL, _cauchy_parity_matrix,
+                                       _gf_invert)
+
+__all__ = ["device_encoder", "device_decode", "encode_cells",
+           "decode_cells"]
+
+_LANES = np.uint32(0x01010101)
+
+
+def _bit_consts(mat: np.ndarray) -> np.ndarray:
+    """[r, k] GF matrix → [r, k, 8] uint32 bit-decomposition constants:
+    K[i, j, s] = gf_mul(mat[i,j], 2**s) replicated into all four byte
+    lanes of a uint32."""
+    r, k = mat.shape
+    out = np.zeros((r, k, 8), np.uint32)
+    for i in range(r):
+        for j in range(k):
+            c = int(mat[i, j])
+            for s in range(8):
+                # plain byte constant: the 0/1 per-byte-lane mask times
+                # K places K in each set lane with no cross-byte carry
+                out[i, j, s] = int(_MUL[c, 1 << s])
+    return out
+
+
+def _apply_matrix(consts: np.ndarray, data: jnp.ndarray) -> jnp.ndarray:
+    """[r, k, 8] constants × [k, W] uint32 words → [r, W] uint32.
+    Built as a static XLA graph (r·k·8 fused elementwise terms)."""
+    rows = []
+    for i in range(consts.shape[0]):
+        acc = None
+        for j in range(consts.shape[1]):
+            w = data[j]
+            for s in range(8):
+                kc = consts[i, j, s]
+                if kc == 0:
+                    continue
+                term = ((w >> np.uint32(s)) & _LANES) * np.uint32(kc)
+                acc = term if acc is None else acc ^ term
+        rows.append(acc if acc is not None
+                    else jnp.zeros_like(data[0]))
+    return jnp.stack(rows)
+
+
+_ENCODERS: Dict[Tuple[int, int], "jax.stages.Wrapped"] = {}
+
+
+def device_encoder(k: int, m: int):
+    """Jitted ``[k, W] uint32 data words → [m, W] parity words`` for the
+    RS(k, m) Cauchy code — cached per schema (compiles once)."""
+    key = (k, m)
+    fn = _ENCODERS.get(key)
+    if fn is None:
+        consts = _bit_consts(_cauchy_parity_matrix(k, m))
+        fn = _ENCODERS.setdefault(
+            key, jax.jit(lambda d, c=consts: _apply_matrix(c, d)))
+    return fn
+
+
+def _as_words(cells: Sequence[bytes]) -> Tuple[jnp.ndarray, int]:
+    """k same-length byte cells → [k, W] uint32 (zero-padded to 4)."""
+    n = len(cells[0])
+    pad = (-n) % 4
+    arr = np.zeros((len(cells), n + pad), np.uint8)
+    for i, c in enumerate(cells):
+        if len(c) != n:
+            raise ValueError("cells must be equal length")
+        arr[i, :n] = np.frombuffer(c, np.uint8)
+    return jnp.asarray(arr.view(np.uint32)), n
+
+
+def encode_cells(k: int, m: int, cells: Sequence[bytes]) -> List[bytes]:
+    """Host-convenience wrapper with the RawErasureCoder.encode contract
+    (bytes in, parity bytes out) running the device kernel. Bit-exact
+    with RSRawCoder.encode / the C++ coder."""
+    if len(cells) != k:
+        # must fail loudly: under jit an out-of-range data[j] gather is
+        # CLAMPED, which would return plausible-looking wrong parity
+        raise ValueError(f"need {k} data cells, got {len(cells)}")
+    words, n = _as_words(cells)
+    parity = np.asarray(device_encoder(k, m)(words))
+    return [parity[i].tobytes()[:n] for i in range(m)]
+
+
+_DECODERS: Dict[Tuple[int, int, Tuple[int, ...]], object] = {}
+
+
+def device_decode(k: int, m: int, present: Sequence[int]):
+    """Jitted reconstruction for one erasure pattern: takes the [k, W]
+    words of the first-k SURVIVING units (in ``present`` order) and
+    returns all k data units. ``present`` lists the surviving unit ids
+    (0..k-1 data, k..k+m-1 parity), at least k of them. Cached per
+    (schema, pattern) — the common case is one dead unit across
+    thousands of stripes, which must not recompile per stripe."""
+    rows = tuple(sorted(present)[:k])
+    if len(rows) < k:
+        raise ValueError(f"need {k} surviving units, have {len(rows)}")
+    key = (k, m, rows)
+    fn = _DECODERS.get(key)
+    if fn is None:
+        full = np.vstack([np.eye(k, dtype=np.uint8),
+                          _cauchy_parity_matrix(k, m)])
+        sub = full[list(rows)]             # k×k, invertible (Cauchy MDS)
+        consts = _bit_consts(_gf_invert(sub))
+        fn = _DECODERS.setdefault(
+            key, jax.jit(lambda d, c=consts: _apply_matrix(c, d)))
+    return fn, list(rows)
+
+
+def decode_cells(k: int, m: int,
+                 shards: Sequence[bytes | None]) -> List[bytes]:
+    """RawErasureCoder.decode contract on the device kernel: shards is
+    the k+m unit list with ``None`` for erasures; returns the k data
+    cells."""
+    if len(shards) != k + m:
+        raise ValueError(f"need {k + m} shard slots, got {len(shards)}")
+    present = [i for i, s in enumerate(shards) if s is not None]
+    fn, rows = device_decode(k, m, present)
+    words, n = _as_words([shards[r] for r in rows])
+    data = np.asarray(fn(words))
+    return [data[i].tobytes()[:n] for i in range(k)]
