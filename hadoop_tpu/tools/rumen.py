@@ -13,6 +13,7 @@ replayed against any scheduler configuration.
 from __future__ import annotations
 
 import json
+import zlib
 from typing import Dict, List, Optional
 
 from hadoop_tpu.conf import Configuration
@@ -95,18 +96,140 @@ def _load_model(tasks: List[Dict]) -> Dict:
     return model
 
 
+def _iter_json_objects(text: str):
+    """A reference trace file is a STREAM of JSON objects (jackson's
+    MappingIterator), optionally a single array — handle both."""
+    text = text.lstrip()
+    if text.startswith("["):
+        yield from json.loads(text)
+        return
+    dec = json.JSONDecoder()
+    pos = 0
+    n = len(text)
+    while pos < n:
+        obj, end = dec.raw_decode(text, pos)
+        yield obj
+        pos = end
+        while pos < n and text[pos] in " \r\n\t,":
+            pos += 1
+
+
+def load_reference_trace(text: str, container_mb: int = 1024,
+                         tick_ms: int = 1000) -> List[Dict]:
+    """Convert a trace written by the REFERENCE tooling into this
+    framework's canonical trace, so an existing Hadoop deployment can
+    replay its production workloads here unchanged. Two dialects are
+    recognized per job object:
+
+    - SLS input format (ref: hadoop-sls SLSRunner SLS json mode /
+      RumenToSLSConverter output): ``am.type``, ``job.start.ms``,
+      ``job.queue.name``, ``job.tasks[{container.start.ms, ...}]``.
+    - rumen LoggedJob (ref: hadoop-rumen TraceBuilder output, the keys
+      RumenToSLSConverter.java:164-211 reads): ``jobID``,
+      ``submitTime``, ``mapTasks``/``reduceTasks`` with ``attempts``.
+
+    Arrival ticks are normalized to the earliest job's start. Reference
+    traces carry no counter-level load model, so entries replay as
+    sleep jobs in gridmix (its documented degradation) while SLS gets
+    full fidelity."""
+    raw: List[Dict] = []
+    for obj in _iter_json_objects(text):
+        if not isinstance(obj, dict):
+            continue
+        if "job.tasks" in obj or "am.type" in obj:        # SLS dialect
+            tasks = obj.get("job.tasks") or []
+            durs = sorted(
+                max(0, int(t.get("container.end.ms", 0)) -
+                    int(t.get("container.start.ms", 0)))
+                for t in tasks) or [0]
+            start = obj.get("job.start.ms")
+            raw.append({
+                "job_id": str(obj.get("job.id", f"job_{len(raw)}")),
+                "start_ms": int(start) if start is not None else None,
+                "queue": obj.get("job.queue.name", "default"),
+                "user": obj.get("job.user", "default"),
+                "containers": max(1, len(tasks)),
+                "maps": sum(1 for t in tasks
+                            if t.get("container.type") != "reduce"),
+                "reduces": sum(1 for t in tasks
+                               if t.get("container.type") == "reduce"),
+                "durs": durs,
+            })
+        elif "jobID" in obj or "submitTime" in obj:       # rumen dialect
+            maps = obj.get("mapTasks") or []
+            reds = obj.get("reduceTasks") or []
+
+            def att_durs(tasks):
+                out = []
+                for t in tasks:
+                    for a in (t.get("attempts") or []):
+                        out.append(max(0, int(a.get("finishTime", 0)) -
+                                       int(a.get("startTime", 0))))
+                return out
+            durs = sorted(att_durs(maps) + att_durs(reds)) or [0]
+            start = obj.get("submitTime")
+            raw.append({
+                "job_id": str(obj.get("jobID", f"job_{len(raw)}")),
+                "start_ms": int(start) if start is not None else None,
+                "queue": obj.get("queue", "default"),
+                "user": obj.get("user", "default"),
+                "containers": max(1, len(maps) + len(reds)),
+                "maps": len(maps),
+                "reduces": len(reds),
+                "durs": durs,
+            })
+    if not raw:
+        return []
+    # Normalize arrivals to the earliest EXPLICIT start: a job missing
+    # its start key arrives at tick 0 rather than poisoning t0 (epoch-ms
+    # jobs would otherwise land at ~1e9 ticks and never be submitted).
+    known = [j["start_ms"] for j in raw if j["start_ms"] is not None]
+    t0 = min(known) if known else 0
+    jobs: List[Dict] = []
+    for i, j in enumerate(sorted(
+            raw, key=lambda x: (x["start_ms"] is None,
+                                x["start_ms"] or 0))):
+        durs = j.pop("durs")
+        start = j.pop("start_ms")
+        jobs.append({
+            # the trace app field is an ATTEMPT id —
+            # application_<ts>_<seq>_<attempt> (records.ApplicationId +
+            # attempt, same shape SyntheticTrace emits); the ts field
+            # is a deterministic digest of the job id so merged/
+            # concatenated traces don't collide
+            "app": f"application_{zlib.crc32(j['job_id'].encode())}"
+                   f"_{i + 1:04d}_01",
+            "arrival": 0 if start is None
+            else (start - t0) // max(1, tick_ms),
+            "mb": container_mb,
+            "task_ms": {"mean": sum(durs) // len(durs),
+                        "p50": durs[len(durs) // 2],
+                        "max": durs[-1]},
+            "state": "SUCCEEDED",
+            **j,
+        })
+    return jobs
+
+
 def main(argv=None) -> int:
     import argparse
     ap = argparse.ArgumentParser(prog="rumen")
-    ap.add_argument("--fs", required=True)
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--fs", help="extract from a cluster's history dir")
+    src.add_argument("--convert",
+                     help="convert a reference SLS/rumen json trace file")
     ap.add_argument("--done-dir", default=history.DEFAULT_DONE_DIR)
     ap.add_argument("--out", default="-")
     args = ap.parse_args(argv)
-    fs = FileSystem.get(args.fs, Configuration())
-    try:
-        trace = build_trace(fs, args.done_dir)
-    finally:
-        fs.close()
+    if args.convert:
+        with open(args.convert) as f:
+            trace = load_reference_trace(f.read())
+    else:
+        fs = FileSystem.get(args.fs, Configuration())
+        try:
+            trace = build_trace(fs, args.done_dir)
+        finally:
+            fs.close()
     body = json.dumps(trace, indent=2)
     if args.out == "-":
         print(body)

@@ -253,3 +253,68 @@ def test_pipes_cpp_wordcount_job(tmp_path):
         assert counts[b"the"] == b"3"
         assert counts[b"fox"] == b"2"
         assert counts[b"dog"] == b"1"
+
+
+def test_reference_trace_dialects_convert_and_replay():
+    """Migration story: traces written by the REFERENCE tooling (the
+    SLS input json and rumen LoggedJob streams) convert into the
+    canonical trace and drive the real scheduler via SLS (ref:
+    SLSRunner's input modes + RumenToSLSConverter)."""
+    import json as _json
+
+    from hadoop_tpu.tools.rumen import load_reference_trace
+    from hadoop_tpu.tools.sls import SyntheticTrace, run
+
+    # SLS dialect: a stream of two job objects (jackson MappingIterator
+    # shape — concatenated, not an array)
+    sls_text = _json.dumps({
+        "am.type": "mapreduce", "job.start.ms": 0,
+        "job.end.ms": 9000, "job.queue.name": "q1", "job.id": "job_1",
+        "job.user": "alice",
+        "job.tasks": [
+            {"container.host": "/r/n1", "container.start.ms": 1000,
+             "container.end.ms": 5000, "container.type": "map"},
+            {"container.host": "/r/n2", "container.start.ms": 1000,
+             "container.end.ms": 8000, "container.type": "reduce"},
+        ]}) + "\n" + _json.dumps({
+        "am.type": "mapreduce", "job.start.ms": 4000,
+        "job.queue.name": "q2", "job.id": "job_2", "job.user": "bob",
+        "job.tasks": [
+            {"container.start.ms": 5000, "container.end.ms": 6000,
+             "container.type": "map"}]})
+    jobs = load_reference_trace(sls_text)
+    assert [j["job_id"] for j in jobs] == ["job_1", "job_2"]
+    assert jobs[0]["containers"] == 2 and jobs[0]["reduces"] == 1
+    assert jobs[0]["arrival"] == 0 and jobs[1]["arrival"] == 4
+    assert jobs[0]["task_ms"]["mean"] == (4000 + 7000) // 2
+
+    # rumen LoggedJob dialect (the keys RumenToSLSConverter reads)
+    rumen_text = _json.dumps({
+        "jobID": "job_201601010000_0001", "submitTime": 100000,
+        "finishTime": 160000, "queue": "prod", "user": "carol",
+        "mapTasks": [
+            {"attempts": [{"startTime": 101000, "finishTime": 103000,
+                           "hostName": "/r/n1"}]},
+            {"attempts": [{"startTime": 101000, "finishTime": 105000,
+                           "hostName": "/r/n2"}]}],
+        "reduceTasks": [
+            {"attempts": [{"startTime": 106000, "finishTime": 109000,
+                           "hostName": "/r/n1"}]}]})
+    rjobs = load_reference_trace(rumen_text)
+    assert rjobs[0]["containers"] == 3
+    assert rjobs[0]["maps"] == 2 and rjobs[0]["reduces"] == 1
+    assert rjobs[0]["queue"] == "prod"
+
+    # app ids derive from job ids, so merged traces don't collide
+    merged = jobs + rjobs
+    assert len({j["app"] for j in merged}) == len(merged)
+
+    # converted traces drive the real scheduler end-to-end (the sim's
+    # default capacity config has one queue; the dialect queues were
+    # asserted above)
+    trace = SyntheticTrace.__new__(SyntheticTrace)
+    trace.jobs = [dict(j, queue="default") for j in merged]
+    report = run(num_nodes=4, num_apps=0, scheduler="capacity",
+                 ticks=200, trace=trace)
+    assert report["containers_allocated"] == \
+        sum(j["containers"] for j in trace.jobs)
