@@ -142,3 +142,31 @@ def test_dataloader_state_roundtrip(fs, token_file):
     # deterministic from the start too
     d3 = TokenDataset(fs, token_file, batch=4, seq=32)
     np.testing.assert_array_equal(d3.next_batch(), first[0])
+
+
+def test_trainer_checkpoints_to_object_store():
+    """The checkpoint layer rides the FileSystem SPI, so the S3A-analog
+    object store works as a checkpoint target unmodified (the cloud
+    training story: params in object storage, not just the DFS)."""
+    from hadoop_tpu.conf import Configuration
+    from hadoop_tpu.fs import FileSystem
+    from hadoop_tpu.parallel import make_mesh
+    from hadoop_tpu.parallel.train import init_sharded
+    from hadoop_tpu.testing.fakestore import FakeObjectStore
+
+    with FakeObjectStore() as store:
+        fs = FileSystem.get(f"htps://{store.endpoint}/bkt",
+                            Configuration(load_defaults=False))
+        cfg = get_config("tiny")
+        plan = MeshPlan()
+        mesh = make_mesh(plan)
+        params, opt = init_sharded(jax.random.PRNGKey(0), cfg, plan,
+                                   mesh)
+        save_checkpoint(fs, "/ckpt", 7, {"params": params, "opt": opt})
+        assert latest_step(fs, "/ckpt") == 7
+        like = {"params": params, "opt": opt}
+        loaded, step = load_checkpoint(fs, "/ckpt", like, step=7)
+        assert step == 7
+        for a, b in zip(jax.tree_util.tree_leaves(loaded),
+                        jax.tree_util.tree_leaves(like), strict=True):
+            assert (a == b).all()
