@@ -507,20 +507,26 @@ class BlockManager:
             stale = self._postponed.pop(block.block_id, None)
             if stale:
                 self._postponed_count -= len(stale)
-        if info is None:
-            return
-        for uuid in info.locations:
-            node = self.dn_manager.get(uuid)
-            if node is None:
-                continue
-            if isinstance(info, BlockInfoStriped):
-                idx = info.unit_map.get(uuid, 0)
-                unit = Block(info.block.block_id + idx, info.block.gen_stamp)
-                node.invalidate_queue.append(unit)
-                node.blocks.discard(unit.block_id)
-            else:
-                node.invalidate_queue.append(info.block)
-                node.blocks.discard(block.block_id)
+            if info is None:
+                return
+            # node.blocks mutations stay under bm._lock like every other
+            # replica-map touch (process_report iterates node.blocks -
+            # reported under this lock; a concurrent discard would blow
+            # up that set difference mid-iteration). dn_manager.get only
+            # takes the DN-manager lock, which never calls back here.
+            for uuid in info.locations:
+                node = self.dn_manager.get(uuid)
+                if node is None:
+                    continue
+                if isinstance(info, BlockInfoStriped):
+                    idx = info.unit_map.get(uuid, 0)
+                    unit = Block(info.block.block_id + idx,
+                                 info.block.gen_stamp)
+                    node.invalidate_queue.append(unit)
+                    node.blocks.discard(unit.block_id)
+                else:
+                    node.invalidate_queue.append(info.block)
+                    node.blocks.discard(block.block_id)
 
     def num_blocks(self) -> int:
         with self._lock:

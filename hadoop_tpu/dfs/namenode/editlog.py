@@ -22,6 +22,7 @@ quorum journal (qjournal.py) plugs in here the way QuorumJournalManager does.
 
 from __future__ import annotations
 
+import logging
 import os
 import struct
 import threading
@@ -29,6 +30,8 @@ from typing import Any, Callable, Dict, Iterator, List, Optional
 
 from hadoop_tpu.io.wire import pack, unpack
 from hadoop_tpu.metrics import metrics_system
+
+log = logging.getLogger(__name__)
 
 # Edit-log op codes (ref: FSEditLogOpCodes.java)
 OP_ADD = "add"                # create file (under construction) + lease

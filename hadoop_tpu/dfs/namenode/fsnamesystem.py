@@ -134,7 +134,10 @@ class FSNamesystem:
         self._next_group_id = ec.STRIPED_ID_BASE  # striped block groups
         self._gen_stamp = 1000          # ref: GenerationStamp
         self._id_lock = threading.Lock()
-        self._pending_recovery: set = set()  # paths mid block-recovery
+        # paths mid block-recovery, pinned to their INode identity: the
+        # sweep must never act on a path that now names a DIFFERENT file
+        # (delete + recreate while recovery was in flight)
+        self._pending_recovery: Dict[str, INodeFile] = {}
         # Centralized cache directives (ref: namenode/CacheManager.java):
         # id → path; the cache monitor reconciles DN state against them.
         self.cache_directives: Dict[int, str] = {}
@@ -583,7 +586,7 @@ class FSNamesystem:
                 # Nothing recoverable: drop the trailing block.
                 inode.blocks.pop()
                 self.bm.remove_block(last)
-        self._pending_recovery.discard(path)
+        self._pending_recovery.pop(path, None)
         inode.under_construction = False
         inode.client_name = None
         from hadoop_tpu.dfs.namenode.blockmanager import BlockInfoStriped
@@ -612,7 +615,7 @@ class FSNamesystem:
                  and n.state != "dead"]
         if not nodes:
             return False
-        if path in self._pending_recovery:
+        if self._pending_recovery.get(path) is info.inode:
             return True  # already issued; waiting for reports
         new_gs = self.next_gen_stamp()
         old_block = Block(info.block.block_id, info.block.gen_stamp,
@@ -633,7 +636,7 @@ class FSNamesystem:
                 node.recover_queue.append((unit, new_gs))
             else:
                 node.recover_queue.append((old_block, new_gs))
-        self._pending_recovery.add(path)
+        self._pending_recovery[path] = info.inode
         log.info("Started block recovery of %s for %s on %d nodes "
                  "(gs %d -> %d)", info.block, path, len(nodes),
                  old_block.gen_stamp, new_gs)
@@ -642,12 +645,18 @@ class FSNamesystem:
     def check_pending_recoveries(self) -> None:
         """Second phase of lease recovery: close files whose block recovery
         reported back. Ref: commitBlockSynchronization's role."""
-        for path in list(self._pending_recovery):
+        for path, expected in list(self._pending_recovery.items()):
             with self.lock.write():
                 inode = self.fsdir.get_inode(path)
-                if inode is None or not isinstance(inode, INodeFile) or \
-                        not inode.under_construction:
-                    self._pending_recovery.discard(path)
+                if inode is not expected:
+                    # path deleted, or recreated as a DIFFERENT file a
+                    # client is actively writing — either way this
+                    # recovery no longer applies (force-closing the new
+                    # file would drop a live writer's data)
+                    self._pending_recovery.pop(path, None)
+                    continue
+                if not inode.under_construction:
+                    self._pending_recovery.pop(path, None)
                     continue
                 last = inode.last_block()
                 info = self.bm.get(last.block_id) if last else None
@@ -659,7 +668,13 @@ class FSNamesystem:
         for path in self.leases.hard_expired_paths():
             with self.lock.write():
                 inode = self.fsdir.get_inode(path)
-                if isinstance(inode, INodeFile) and inode.under_construction:
+                # re-verify expiry UNDER the lock: between the snapshot
+                # and here the writer may have renewed, or the path may
+                # now be a different, actively-written file (delete +
+                # recreate) holding a fresh lease
+                if isinstance(inode, INodeFile) and \
+                        inode.under_construction and \
+                        self.leases.is_hard_expired(path):
                     self._recover_lease_locked(path, inode)
         self.check_pending_recoveries()
 
@@ -842,6 +857,14 @@ class FSNamesystem:
                 self._check_mutable_path(src, dst)
                 actual_dst = self.fsdir.rename(src, dst)
                 self.leases.rename_path(src, actual_dst)
+                # in-flight block recoveries follow the rename — their
+                # phase-1 already stripped the lease, so a stale-keyed
+                # entry would strand the file under-construction forever
+                prefix = src.rstrip("/") + "/"
+                for p in list(self._pending_recovery):
+                    if p == src or p.startswith(prefix):
+                        self._pending_recovery[actual_dst + p[len(src):]] \
+                            = self._pending_recovery.pop(p)
                 txid = self.editlog.log_edit(el.OP_RENAME,
                                              {"s": src, "d": dst})
             self.editlog.log_sync(txid)

@@ -116,6 +116,18 @@ class LeaseManager:
                     out.extend(lease.paths)
             return out
 
+    def is_hard_expired(self, path: str) -> bool:
+        """Point check for the recovery sweep's re-verification under the
+        namespace lock: a renewal (or a fresh lease from a delete+
+        recreate) between the sweep's snapshot and the lock acquisition
+        must call off the force-close."""
+        with self._lock:
+            holder = self._path_to_holder.get(path)
+            if holder is None:
+                return True  # no lease at all: nothing protects the file
+            lease = self._leases.get(holder)
+            return lease is None or lease.age() > self.hard_limit_s
+
     def num_leases(self) -> int:
         with self._lock:
             return len(self._leases)

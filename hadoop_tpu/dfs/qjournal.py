@@ -226,7 +226,11 @@ class JournalProtocol:
             j.fjm.close()
             return {"last_txid": j.last_txid,
                     "ctail": j.contiguous_finalized_tail(),
-                    "tail_epoch": j.tail_epoch()}
+                    "tail_epoch": j.tail_epoch(),
+                    # the writer-taught quorum commit point: recovery's
+                    # adoption floor (a responder missing committed txids
+                    # must never be the adopted tail)
+                    "committed": j.committed_txid}
 
     def start_segment(self, jid: str, epoch: int, first_txid: int) -> bool:
         j = self._journal(jid)
@@ -234,6 +238,16 @@ class JournalProtocol:
             j.check_epoch(epoch)
             JournalFaultInjector.get().before_start_segment(
                 self.node.port, first_txid)
+            if 0 < j.last_txid < first_txid - 1:
+                # This JN missed txids (e.g. its recovery accept failed):
+                # opening the new segment here would stamp its tail with
+                # the NEWEST epoch while holding the OLDEST data, making
+                # it outrank complete JNs at the next recovery's adoption
+                # and destroy committed edits. Refuse; the writer's
+                # quorum doesn't need us, and a later accept will resync.
+                raise IOError(
+                    f"refusing gap: segment {first_txid} after local "
+                    f"last {j.last_txid}")
             j.writer_epoch = epoch
             j.fjm.close()
             # Drop any stale in-progress segment at this boundary — the new
@@ -544,8 +558,22 @@ class QuorumJournalManager(JournalManager):
         max_promised = max(r["promised"] for _, r in states)
         self.epoch = max_promised + 1
         acks = self._quorum("new_epoch", self.jid, self.epoch)
+        # Adoption floor: no responder that is MISSING quorum-committed
+        # txids may define the recovered tail, whatever its tail epoch —
+        # a JN can carry a newer-epoch stamp with older data (its accept
+        # failed, or it rejoined late), and adopting it would truncate
+        # client-acked edits on its peers. Any quorum intersects the
+        # majority that acked those commits, so an eligible responder
+        # always exists; an empty eligible set means storage corruption
+        # and must abort rather than "recover" by destroying data.
+        floor = max(r.get("committed", 0) for _, r in acks)
+        eligible = [(i, r) for i, r in acks if r["last_txid"] >= floor]
+        if not eligible:
+            raise IOError(
+                f"no recovery candidate holds the committed txid {floor} "
+                f"(tails: {[(self.addrs[i], r['last_txid']) for i, r in acks]})")
         best_i, best = max(
-            acks, key=lambda t: (t[1]["tail_epoch"], t[1]["last_txid"]))
+            eligible, key=lambda t: (t[1]["tail_epoch"], t[1]["last_txid"]))
         last = best["last_txid"]
         self._last_txid = last
         self._seen_txid = last

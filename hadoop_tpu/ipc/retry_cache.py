@@ -91,8 +91,18 @@ class RetryCache:
         for k in [k for k, e in self._entries.items()
                   if e.done and e.expiry < now]:
             del self._entries[k]
-        while len(self._entries) >= self.max_entries:
-            self._entries.pop(next(iter(self._entries)))
+        if len(self._entries) < self.max_entries:
+            return
+        # Capacity pressure: give up oldest COMPLETED entries early (a
+        # lost replay payload only costs that client a duplicate-reply
+        # miss). NEVER evict an in-flight entry — its retry would mint a
+        # second concurrent executor of a non-idempotent op, the exact
+        # thing this cache exists to prevent; if every entry is in
+        # flight the cache temporarily overflows instead.
+        for k in [k for k, e in self._entries.items() if e.done]:
+            if len(self._entries) < self.max_entries:
+                break
+            del self._entries[k]
 
     def size(self) -> int:
         with self._lock:

@@ -124,6 +124,14 @@ class RMApp:
 
     def _on_attempt_failed(self, diag: str) -> str:
         self.diagnostics = diag or ""
+        # Free the dead attempt's scheduler state and queue its live
+        # containers for NM cleanup BEFORE retrying — otherwise every
+        # failed attempt leaks its containers' capacity for the rest of
+        # the app's life (ref: RMAppAttemptImpl's BaseFinalTransition →
+        # scheduler APP_ATTEMPT_REMOVED).
+        att = self.current_attempt
+        if att is not None:
+            self.rm.release_attempt(att)
         if self.attempt_no >= self.ctx.max_attempts:
             self._on_done(AppState.FAILED, f"exhausted {self.attempt_no} "
                           f"attempts; last: {diag}")
@@ -186,18 +194,25 @@ class RMAppAttempt:
                  self.app.ctx.am_resource)
 
     def fail(self, diag: str) -> None:
+        if self.state in ("FAILED", "FINISHED"):
+            return  # already terminal; duplicates also die at the router
         self.state = "FAILED"
+        # events carry the ATTEMPT identity: the liveness monitor and the
+        # NM-heartbeat handler can both report one AM death, and without
+        # the id the second event would fail the app's NEXT attempt
+        # (ref: RMAppAttemptImpl events are per-attempt)
         self.app.rm.dispatcher.dispatch("app", Event(
-            "app_attempt_failed", (self.app.app_id, diag)))
+            "app_attempt_failed", (self.app.app_id, self.attempt_id,
+                                   diag)))
 
     def finish(self, final_status: str, diag: str) -> None:
+        if self.state in ("FAILED", "FINISHED"):
+            return
         self.state = "FINISHED"
-        if final_status in ("FAILED", "KILLED"):
-            self.app.rm.dispatcher.dispatch("app", Event(
-                "app_attempt_failed", (self.app.app_id, diag)))
-        else:
-            self.app.rm.dispatcher.dispatch("app", Event(
-                "app_attempt_finished", (self.app.app_id, diag)))
+        etype = "app_attempt_failed" if final_status in (
+            "FAILED", "KILLED") else "app_attempt_finished"
+        self.app.rm.dispatcher.dispatch("app", Event(
+            etype, (self.app.app_id, self.attempt_id, diag)))
 
 
 class FileRMStateStore:
@@ -212,9 +227,19 @@ class FileRMStateStore:
         return os.path.join(self.dir, f"{app_id}.json")
 
     def store_app(self, ctx: ApplicationSubmissionContext, user: str) -> None:
-        with open(self._path(ctx.app_id), "w") as f:
-            json.dump({"ctx": _wire_to_jsonable(ctx.to_wire()),
-                       "user": user, "state": "RUNNING"}, f)
+        self._write(self._path(ctx.app_id),
+                    {"ctx": _wire_to_jsonable(ctx.to_wire()),
+                     "user": user, "state": "RUNNING"})
+
+    @staticmethod
+    def _write(path: str, d: Dict) -> None:
+        # tmp + rename: a crash mid-dump must never leave a torn state
+        # file (one corrupt file would block recovery of every app —
+        # ref: FileSystemRMStateStore's updateFile write-to-temp dance)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(d, f)
+        os.replace(tmp, path)
 
     def store_app_done(self, app_id: ApplicationId, state: str,
                        diag: str) -> None:
@@ -233,15 +258,22 @@ class FileRMStateStore:
         with open(path) as f:
             d = json.load(f)
         d.update(fields)
-        with open(path, "w") as f:
-            json.dump(d, f)
+        self._write(path, d)
 
     def load_all(self) -> List[Dict]:
         out = []
         for name in sorted(os.listdir(self.dir)):
-            if name.endswith(".json"):
-                with open(os.path.join(self.dir, name)) as f:
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(self.dir, name)
+            try:
+                with open(path) as f:
                     out.append(json.load(f))
+            except (ValueError, OSError) as e:
+                # a pre-atomic-write torn file (or disk bitrot) costs
+                # that ONE app its recovery, never the whole RM restart
+                log.error("Skipping unreadable RM state file %s: %s",
+                          path, e)
         return out
 
 
@@ -409,9 +441,27 @@ class ResourceTrackerProtocol:
                               ) -> Dict:
         node_id = NodeId.from_wire(node_id_wire)
         total = Resource.from_wire(resource_wire)
+        # Reconcile BEFORE adopting the fresh node: containers we still
+        # count as live on this node but the (restarted) NM no longer
+        # reports died with it — synthesize their completions so AMs
+        # hear about them and queue usage deflates; without this a
+        # crashed NM's containers stay "live" forever (ref:
+        # ResourceTrackerService handling of NM re-register: previous
+        # containers not in NMContainerStatus are completed as lost).
+        reported = {Container.from_wire(cw).container_id
+                    for cw in running_containers or []}
         with self.rm.nodes_lock:
+            known_before = node_id in self.rm.nodes
             node = RMNode(node_id, total, nm_address)
             self.rm.nodes[node_id] = node
+        if known_before:
+            for c in self.rm.scheduler.containers_on_node(node_id):
+                if c.container_id not in reported:
+                    log.info("Container %s lost in NM %s restart",
+                             c.container_id, node_id)
+                    self.rm.on_container_complete(ContainerStatus(
+                        c.container_id, "COMPLETE", exit_code=-100,
+                        diagnostics="NodeManager restarted"))
         self.rm.scheduler.add_node(node_id, total, nm_address)
         # Work-preserving restart: re-adopt containers this NM kept alive
         # across our downtime (ref: ResourceTrackerService
@@ -623,9 +673,20 @@ class ResourceManager(AbstractService):
                 app.sm.handle("attempt_registered")
             return
         if ev.etype in ("app_attempt_finished", "app_attempt_failed"):
-            app_id, diag = ev.payload
+            app_id, attempt_id, diag = ev.payload
             app = self.apps.get(app_id)
             if app is None:
+                return
+            # Staleness filter: only the CURRENT attempt's outcome moves
+            # the app. A duplicate failure report (liveness monitor and
+            # heartbeat handler racing on one AM death) arrives after
+            # _new_attempt switched current_attempt, and acting on it
+            # would spawn a second live AM / double-charge max_attempts.
+            cur = app.current_attempt
+            if cur is None or cur.attempt_id != attempt_id:
+                log.debug("Dropping stale %s for %s (current %s)",
+                          ev.etype, attempt_id,
+                          cur.attempt_id if cur else None)
                 return
             event = ("attempt_finished" if ev.etype == "app_attempt_finished"
                      else "attempt_failed")

@@ -275,6 +275,14 @@ class _BaseScheduler(Scheduler):
             app._seq = max(app._seq, container.container_id.seq)
             return True
 
+    def containers_on_node(self, node_id: NodeId) -> List[Container]:
+        """Live containers currently attributed to one node, across all
+        apps (NM re-registration reconciliation)."""
+        with self.lock:
+            return [c for app in self.apps.values()
+                    for c in app.live_containers.values()
+                    if c.node_id == node_id]
+
     def container_completed(self, attempt_id: str,
                             status: ContainerStatus) -> None:
         """NM reported a container exit."""

@@ -506,3 +506,96 @@ def test_standby_postpones_unknown_block_reports():
     bm.process_all_postponed()
     assert bm._postponed_count == 0 and not bm.postpone_unknown
     assert any(b.block_id == 88 for b in node.invalidate_queue)
+
+
+def test_fjm_start_segment_truncates_torn_tail_directly(tmp_path):
+    """FileJournalManager.start_segment on a segment with a torn tail
+    (the QJM's crash path — no FSEditLog pre-recovery in front of it)
+    must truncate and continue, not die on the warning line (review
+    finding: an undefined logger name made this path raise NameError)."""
+    import os
+
+    import struct
+
+    from hadoop_tpu.io.wire import pack
+
+    d = str(tmp_path / "edits")
+    jm = FileJournalManager(d)
+    jm.start_segment(1)
+    rec = pack({"t": 1, "op": "mkdir", "p": "/a"})
+    jm.journal(struct.pack(">I", len(rec)) + rec, 1, 1)
+    jm.sync()
+    jm.close()
+    with open(os.path.join(d, "edits_inprogress_1"), "ab") as f:
+        f.write(b"\x00\x00\x01\x00partial")
+    jm2 = FileJournalManager(d)
+    jm2.start_segment(1)  # must truncate the torn frame, not raise
+    jm2.close()
+    assert [r for r in jm2.read_edits(1)]  # intact prefix readable
+
+
+def test_pending_recovery_pinned_to_inode_identity(tmp_path):
+    """An in-flight lease recovery must not act on a path that now names
+    a DIFFERENT file (delete + overwrite-create while recovery waited),
+    and must follow renames (review findings: the sweep force-closed a
+    new writer's file; renamed recoveries were stranded)."""
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster, fast_conf
+
+    conf = fast_conf()
+    conf.set("dfs.replication", "1")
+    with MiniDFSCluster(num_datanodes=1, conf=conf,
+                        base_dir=str(tmp_path)) as cluster:
+        cluster.wait_active()
+        fs = cluster.get_filesystem()
+        fsn = cluster.namenode.fsn
+
+        out = fs.create("/f")
+        out.write(b"x" * 100)
+        out.flush()
+        old_inode = fsn.fsdir.get_inode("/f")
+        # recovery of A's file is "in flight"
+        fsn._pending_recovery["/f"] = old_inode
+
+        # B replaces the file and starts writing
+        out.close()
+        out2 = fs.create("/f", overwrite=True)
+        out2.write(b"y" * 50)
+        out2.flush()
+        new_inode = fsn.fsdir.get_inode("/f")
+        assert new_inode is not old_inode
+        assert new_inode.under_construction
+
+        fsn.check_pending_recoveries()
+        # B's live file untouched; the stale entry is gone
+        assert fsn.fsdir.get_inode("/f").under_construction
+        assert "/f" not in fsn._pending_recovery
+        out2.close()
+
+        # rename migrates a pending-recovery key with the file
+        out3 = fs.create("/r1")
+        out3.write(b"z" * 10)
+        out3.flush()
+        fsn._pending_recovery["/r1"] = fsn.fsdir.get_inode("/r1")
+        out3.close()  # closing does not consult the map; entry remains
+        fs.rename("/r1", "/r2")
+        assert "/r1" not in fsn._pending_recovery
+        assert "/r2" in fsn._pending_recovery
+        fsn._pending_recovery.pop("/r2", None)
+
+
+def test_is_hard_expired_point_check(tmp_path):
+    """The sweep's under-lock re-verification: a fresh/renewed lease is
+    NOT hard-expired; an unleased path is fair game (review finding:
+    the sweep acted on a stale snapshot)."""
+    from hadoop_tpu.dfs.namenode.lease import LeaseManager
+
+    lm = LeaseManager(soft_limit_s=0.05, hard_limit_s=0.1)
+    lm.add_lease("clientA", "/f")
+    assert not lm.is_hard_expired("/f")     # fresh
+    import time as _t
+    _t.sleep(0.12)
+    assert lm.is_hard_expired("/f")         # aged out
+    lm.renew_lease("clientA")
+    assert not lm.is_hard_expired("/f")     # renewal rescues it
+    lm.remove_lease("clientA", "/f")
+    assert lm.is_hard_expired("/f")         # nothing protects the path

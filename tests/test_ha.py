@@ -233,10 +233,12 @@ def test_uncommitted_mixed_epoch_copies_do_not_fake_quorum(jns):
         jn2.stop()
 
 
-def test_recovery_refuses_tail_with_holes(jns):
-    """If the adopted tail cannot be fully reconstructed from responders,
-    recovery must fail rather than adopt a log with missing txids (ref:
-    the reference never finalizes a segment it hasn't fully transferred)."""
+def test_journal_refuses_gap_creating_segment(jns):
+    """A JN that missed txids must refuse to open a later segment: the
+    newest-epoch stamp on an empty tail would outrank complete peers at
+    the next recovery's adoption and destroy committed edits (review
+    finding; ref: the reference's startLogSegment txid continuity
+    checks)."""
     from hadoop_tpu.dfs.qjournal import JournalProtocol
     w1 = QuorumJournalManager(_addrs(jns))
     w1.recover()
@@ -244,18 +246,78 @@ def test_recovery_refuses_tail_with_holes(jns):
     _write(w1, 1, [{"t": t, "op": "mkdir", "p": f"/d{t}"}
                    for t in (1, 2, 3)])
     w1.finalize_segment(1, 3)
-    # One journal gets a later segment with a hole before it (txids 4..7
-    # never landed anywhere).
     p0 = JournalProtocol(jns[0])
-    p0.start_segment("ns", w1.epoch, 8)
-    p0.journal("ns", w1.epoch,
-               _blob([{"t": t, "op": "mkdir", "p": f"/d{t}"}
-                      for t in (8, 9, 10)]), 8, 3, 10)
+    with pytest.raises(IOError, match="gap"):
+        p0.start_segment("ns", w1.epoch, 8)  # 4..7 never existed
     w1.close()
+
+
+def test_recovery_refuses_tail_with_holes(jns):
+    """If the adopted tail cannot be fully reconstructed from responders,
+    recovery must fail rather than adopt a log with missing txids (ref:
+    the reference never finalizes a segment it hasn't fully transferred).
+    The API refuses to create gaps, so the hole is disk damage: the
+    middle segment file vanishes from every JN."""
+    import glob
+    import os
+    w1 = QuorumJournalManager(_addrs(jns))
+    w1.recover()
+    w1.start_segment(1)
+    _write(w1, 1, [{"t": t, "op": "mkdir", "p": f"/d{t}"}
+                   for t in (1, 2, 3)])
+    w1.finalize_segment(1, 3)
+    w1.start_segment(4)
+    _write(w1, 4, [{"t": t, "op": "mkdir", "p": f"/d{t}"}
+                   for t in (4, 5, 6, 7)])
+    w1.finalize_segment(4, 7)
+    w1.start_segment(8)
+    _write(w1, 8, [{"t": t, "op": "mkdir", "p": f"/d{t}"}
+                   for t in (8, 9, 10)])
+    w1.close()
+    for jn in jns:
+        for p in glob.glob(os.path.join(jn.storage_dir, "ns",
+                                        "edits_4-7")):
+            os.remove(p)  # txids 4..7 gone everywhere
     w2 = QuorumJournalManager(_addrs(jns))
     with pytest.raises(IOError):
         w2.recover()
     w2.close()
+
+
+def test_recovery_adoption_respects_committed_floor(jns):
+    """A responder whose accept failed can carry the newest promise while
+    missing committed txids; adoption must skip it for a peer that holds
+    everything the writer quorum-acked (review finding: the old rule
+    keyed on (tail_epoch, last) alone could adopt the short tail and
+    destroy acked edits)."""
+    w1 = QuorumJournalManager(_addrs(jns))
+    w1.recover()
+    w1.start_segment(1)
+    _write(w1, 1, [{"t": t, "op": "mkdir", "p": f"/d{t}"}
+                   for t in (1, 2, 3)])
+    # jn0 misses the second batch: stop it, write 4..6 on {jn1, jn2}
+    # (quorum ack ⇒ committed), restart jn0.
+    store0 = jns[0].storage_dir
+    jns[0].stop()
+    _write(w1, 4, [{"t": t, "op": "mkdir", "p": f"/d{t}"}
+                   for t in (4, 5, 6)])
+    w1.close()
+    from hadoop_tpu.dfs.qjournal import JournalNode
+    from hadoop_tpu.testing.minicluster import fast_conf
+    jn0 = JournalNode(fast_conf(), storage_dir=store0)
+    jn0.init(fast_conf())
+    jn0.start()
+    try:
+        # New writer recovers: jn0's tail (last=3) is SHORT of the
+        # committed floor (6) — adoption must come from jn1/jn2, and the
+        # recovered log must retain every acked txid.
+        w2 = QuorumJournalManager(_addrs([jn0, jns[1], jns[2]]))
+        assert w2.recover() == 6
+        seen = [r["t"] for r in w2.read_edits(1)]
+        assert seen == [1, 2, 3, 4, 5, 6]
+        w2.close()
+    finally:
+        jn0.stop()
 
 
 def test_quorum_lease_single_winner(jns):

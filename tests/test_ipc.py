@@ -497,3 +497,26 @@ def test_multiprocess_server_distributes_and_survives_worker_death():
     finally:
         srv.stop()
     assert srv.alive_workers() == 0
+
+
+def test_retry_cache_never_evicts_inflight_entries():
+    """Capacity pressure may only shed COMPLETED entries: evicting an
+    in-flight one would let its retry become a second concurrent
+    executor of a non-idempotent op (review finding)."""
+    cache = RetryCache(ttl_s=600, max_entries=4)
+    inflight = [cache.wait_for_completion(b"c", i, timeout=0.01)
+                for i in range(3)]
+    done = cache.wait_for_completion(b"c", 99, timeout=0.01)
+    cache.complete(done, True, "payload")
+    # 5th insert at capacity: the completed entry goes, in-flight stay
+    cache.wait_for_completion(b"c", 100, timeout=0.01)
+    assert cache.size() == 4
+    import pytest as _p
+
+    from hadoop_tpu.ipc.errors import RetriableError
+    for i in range(3):
+        with _p.raises(RetriableError):
+            # still in flight — retries must NOT become owners
+            cache.wait_for_completion(b"c", i, timeout=0.01)
+    for e in inflight:
+        cache.complete(e, True)

@@ -177,3 +177,151 @@ def test_work_preserving_rm_restart(tmp_path):
         assert len(maps) == len(set(maps)) == 2
         report = cluster.yarn.rm.apps[job._app_id].report()
         assert report.attempt_no == 1, "AM must not have been relaunched"
+
+
+def test_failed_attempt_releases_its_containers(tmp_path):
+    """A retried app must not leak the dead attempt's scheduler state:
+    the failed attempt's containers are freed and queued for NM cleanup
+    before the new attempt starts, and a duplicate failure report for
+    the same dead attempt is dropped (review findings — leaked capacity
+    per AM failure; double-spawned attempts on racing reports)."""
+    from hadoop_tpu.conf import Configuration
+    from hadoop_tpu.yarn.records import (ApplicationSubmissionContext,
+                                         ContainerLaunchContext, NodeId,
+                                         Resource, ResourceRequest)
+    from hadoop_tpu.yarn.rm import ResourceManager, ResourceTrackerProtocol
+
+    conf = Configuration(load_defaults=False)
+    rm = ResourceManager(conf, state_dir=str(tmp_path / "state"))
+    rm.init(conf)
+    rm.start()
+    tracker = ResourceTrackerProtocol(rm)
+    try:
+        nid = NodeId("h1", 9000)
+        tracker.register_node_manager(
+            nid.to_wire(), Resource(8192, 8).to_wire(), "h1:9000")
+        app_id = rm.new_app_id()
+        ctx = ApplicationSubmissionContext(
+            app_id, "leaktest", ContainerLaunchContext(["true"], {}),
+            Resource(512, 1), max_attempts=3, unmanaged=True)
+        rm.submit_application(ctx, "u")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            att1 = rm.apps[app_id].current_attempt
+            if att1 is not None and att1.attempt_id in rm.scheduler.apps:
+                break
+            time.sleep(0.05)
+        first_id = att1.attempt_id
+        # give the attempt a task container
+        rm.scheduler.allocate(first_id, [ResourceRequest(
+            10, 1, Resource(1024, 1))], [])
+        tracker.node_heartbeat(nid.to_wire(), [])
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if rm.scheduler.apps[first_id].live_containers:
+                break
+            time.sleep(0.05)
+        held = list(rm.scheduler.apps[first_id].live_containers)
+        assert held, "no container ever allocated"
+
+        att1.fail("synthetic AM death")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            att2 = rm.apps[app_id].current_attempt
+            if att2 is not None and att2.attempt_id != first_id:
+                break
+            time.sleep(0.05)
+        assert rm.apps[app_id].current_attempt.attempt_id != first_id
+        # dead attempt is GONE from the scheduler and its container is
+        # queued for NM cleanup
+        assert first_id not in rm.scheduler.apps
+        with rm.nodes_lock:
+            cleanup = list(rm.nodes[nid].containers_to_cleanup)
+        assert held[0] in cleanup
+
+        # duplicate failure report for the SAME dead attempt (liveness
+        # monitor racing the heartbeat handler) is dropped: still on
+        # attempt 2, budget not double-charged
+        att1.state = "RUNNING"  # the second racer's stale view
+        att1.fail("duplicate report")
+        time.sleep(0.5)
+        att_now = rm.apps[app_id].current_attempt.attempt_id
+        assert att_now.endswith("_02"), att_now
+    finally:
+        rm.stop()
+
+
+def test_rm_recovers_past_torn_state_file(tmp_path):
+    """One corrupt state file (pre-atomic-write crash, bitrot) costs that
+    app its recovery — never the whole RM restart (review finding)."""
+    from hadoop_tpu.conf import Configuration
+    from hadoop_tpu.yarn.rm import ResourceManager
+
+    state = tmp_path / "state"
+    state.mkdir()
+    (state / "application_1_1.json").write_text('{"truncated": ')
+    conf = Configuration(load_defaults=False)
+    rm = ResourceManager(conf, state_dir=str(state))
+    rm.init(conf)
+    rm.start()   # must not raise
+    try:
+        assert rm.apps == {}
+    finally:
+        rm.stop()
+
+
+def test_nm_restart_completes_lost_containers(tmp_path):
+    """An NM that re-registers WITHOUT its previous containers (it
+    crashed; they died with it) must surface those containers as
+    completed: scheduler usage deflates and the AM hears about the loss
+    (review finding — they stayed 'live' forever)."""
+    from hadoop_tpu.conf import Configuration
+    from hadoop_tpu.yarn.records import (ApplicationSubmissionContext,
+                                         ContainerLaunchContext, NodeId,
+                                         Resource, ResourceRequest)
+    from hadoop_tpu.yarn.rm import ResourceManager, ResourceTrackerProtocol
+
+    conf = Configuration(load_defaults=False)
+    rm = ResourceManager(conf, state_dir=str(tmp_path / "state"))
+    rm.init(conf)
+    rm.start()
+    tracker = ResourceTrackerProtocol(rm)
+    try:
+        nid = NodeId("h1", 9000)
+        tracker.register_node_manager(
+            nid.to_wire(), Resource(8192, 8).to_wire(), "h1:9000")
+        app_id = rm.new_app_id()
+        ctx = ApplicationSubmissionContext(
+            app_id, "nmloss", ContainerLaunchContext(["true"], {}),
+            Resource(512, 1), unmanaged=True)
+        rm.submit_application(ctx, "u")
+        deadline = time.monotonic() + 10
+        attempt_id = None
+        while time.monotonic() < deadline:
+            app = rm.apps[app_id]
+            if app.current_attempt is not None and \
+                    app.current_attempt.attempt_id in rm.scheduler.apps:
+                attempt_id = app.current_attempt.attempt_id
+                break
+            time.sleep(0.05)
+        rm.scheduler.allocate(attempt_id, [ResourceRequest(
+            10, 1, Resource(1024, 1))], [])
+        tracker.node_heartbeat(nid.to_wire(), [])
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if rm.scheduler.apps[attempt_id].live_containers:
+                break
+            time.sleep(0.05)
+        assert rm.scheduler.apps[attempt_id].live_containers
+        # NM restarts: re-registers with NO running containers
+        tracker.register_node_manager(
+            nid.to_wire(), Resource(8192, 8).to_wire(), "h1:9000",
+            running_containers=[])
+        assert not rm.scheduler.apps[attempt_id].live_containers
+        assert rm.scheduler.apps[attempt_id].used.memory_mb == 0
+        # the AM fetches the completion on its next allocate
+        done, _ = rm.scheduler.allocate(attempt_id, [], [])
+        statuses = rm.scheduler.apps[attempt_id].completed_unfetched
+        assert statuses or done is not None
+    finally:
+        rm.stop()
