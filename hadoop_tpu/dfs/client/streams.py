@@ -194,29 +194,38 @@ class DFSOutputStream:
 
     def _recover_block(self, cause: Exception) -> None:
         """Whole-block recovery: abandon, re-allocate excluding suspects,
-        replay retained packets."""
-        log.warning("Pipeline for %s failed (%s); recovering block",
-                    self._current, cause)
-        bad = getattr(cause, "bad_node", None)
-        if bad:
-            self._exclude.add(bad)
-        else:
-            self._exclude.update(self._pipeline.suspect_nodes())
-        try:
-            self._pipeline.close(abort=True)
-        except Exception:
-            pass
-        old_packets = self._block_packets
-        self.client.abandon_block(self.path, self._current)
-        # The block before the abandoned one was already committed by the
-        # add_block(previous=...) that allocated it, so the fresh allocation
-        # passes previous=None.
-        self._current = None
-        self._start_block()
-        for pkt in old_packets:
-            self._block_packets.append(pkt)
-            self._pipeline.send(pkt)
-            self._block_pos += len(pkt.data)
+        replay retained packets. Recovery is itself recoverable — a DN
+        dying mid-replay starts another round with the grown exclude set
+        (ref: DataStreamer loops until the cluster is exhausted);
+        _start_block raises once no pipeline can be built, which bounds
+        the loop."""
+        old_packets = list(self._block_packets)
+        while True:
+            log.warning("Pipeline for %s failed (%s); recovering block",
+                        self._current, cause)
+            bad = getattr(cause, "bad_node", None)
+            if bad:
+                self._exclude.add(bad)
+            elif self._pipeline is not None:
+                self._exclude.update(self._pipeline.suspect_nodes())
+            try:
+                self._pipeline.close(abort=True)
+            except Exception:
+                pass
+            self.client.abandon_block(self.path, self._current)
+            # The block before the abandoned one was already committed by
+            # the add_block(previous=...) that allocated it, so the fresh
+            # allocation passes previous=None.
+            self._current = None
+            self._start_block()
+            try:
+                for pkt in old_packets:
+                    self._block_packets.append(pkt)
+                    self._pipeline.send(pkt)
+                    self._block_pos += len(pkt.data)
+                return
+            except (OSError, PipelineError) as e:
+                cause = e  # next round excludes the fresh suspects
 
     def _finish_block(self) -> None:
         """Send the trailing empty packet, await all acks, commit length."""
@@ -490,6 +499,17 @@ class DFSInputStream:
                 try:
                     return self._read_from_datanode(dn, lb.block,
                                                     in_block_off, want)
+                except ChecksumError:
+                    # report in the retry rounds too — swallowing it in
+                    # the generic handler meant the NN never learned of
+                    # the corruption (no re-replication) and, with
+                    # _dead cleared each round, the client re-downloaded
+                    # the same corrupt replica every round
+                    log.warning("Checksum error reading %s from %s; "
+                                "reporting", lb.block, dn)
+                    self.client.report_bad_block(lb.block, dn.uuid)
+                    self._dead.add(dn.uuid)
+                    errors.append(f"{dn}: checksum")
                 except (OSError, EOFError, IOError) as e:
                     errors.append(f"{dn}: {e}")
             if attempt < self.LOCATION_RETRIES - 1:

@@ -233,24 +233,31 @@ class BlockStore:
     # --------------------------------------------------------------- writes
 
     def create_rbw(self, block: Block, checksum: DataChecksum) -> _OpenReplica:
-        with self._lock:
-            existing = self._replicas.get(block.block_id)
-            stale_writer = self._open_writers.get(block.block_id)
-        if existing is not None and stale_writer is not None:
-            stale_writer.steal()  # fence the old pipeline's writer
-        with self._lock:
-            existing = self._replicas.get(block.block_id)
-            if existing is not None:
-                if existing.state == Replica.FINALIZED:
-                    raise IOError(f"block {block.block_id} already finalized")
-                # Pipeline recovery overwrites a stale rbw replica.
-                self._remove_files(existing)
-                del self._replicas[block.block_id]
-            rep = Replica(block.block_id, block.gen_stamp, 0, Replica.RBW)
-            self._replicas[block.block_id] = rep
-            writer = _OpenReplica(self, block, checksum)
-            self._open_writers[block.block_id] = writer
-            return writer
+        # Claim loop: the replace decision and the claim must be ONE
+        # atomic step, or two concurrent setups for the same block both
+        # pass the stale-check and the loser deletes the winner's open
+        # files unfenced (the winner's later finalize would then publish
+        # the loser's partial data). steal() must run OUTSIDE the lock —
+        # it re-enters via _writer_closed.
+        while True:
+            with self._lock:
+                stale_writer = self._open_writers.get(block.block_id)
+                if stale_writer is None:
+                    existing = self._replicas.get(block.block_id)
+                    if existing is not None:
+                        if existing.state == Replica.FINALIZED:
+                            raise IOError(
+                                f"block {block.block_id} already finalized")
+                        # Pipeline recovery overwrites a stale rbw replica.
+                        self._remove_files(existing)
+                        del self._replicas[block.block_id]
+                    rep = Replica(block.block_id, block.gen_stamp, 0,
+                                  Replica.RBW)
+                    self._replicas[block.block_id] = rep
+                    writer = _OpenReplica(self, block, checksum)
+                    self._open_writers[block.block_id] = writer
+                    return writer
+            stale_writer.steal()  # fence, then retry the claim
 
     def _writer_closed(self, writer: "_OpenReplica") -> None:
         with self._lock:
@@ -287,6 +294,48 @@ class BlockStore:
             if os.path.exists(path):
                 os.remove(path)
 
+    def _reconcile_rbw_files(self, data_path: str, meta_path: str) -> int:
+        """Crash alignment before promoting an rbw: the data and meta
+        files flush independently, so after a DN crash one can be ahead
+        of the other. Truncate both to the longest prefix whose stored
+        checksums actually verify — finalizing at the raw data size
+        would mint a replica whose tail fails every future read and
+        gets invalidated, destroying the recoverable prefix (ref:
+        FsDatasetImpl.recoverRbw's checksum/length alignment +
+        truncateBlock)."""
+        hdr = 12 + DataChecksum.HEADER_LEN
+        try:
+            with open(meta_path, "rb") as f:
+                f.seek(12)
+                checksum = DataChecksum.from_header(
+                    f.read(DataChecksum.HEADER_LEN))
+        except (OSError, ValueError, struct.error):
+            return 0  # torn meta header: nothing is verifiable
+        bpc = checksum.bytes_per_chunk
+        dsize = os.path.getsize(data_path)
+        n_sums = max(0, os.path.getsize(meta_path) - hdr) // 4
+        length = min(dsize, n_sums * bpc)
+        with open(data_path, "rb") as df, open(meta_path, "rb") as mf:
+            while length > 0:
+                last = (length - 1) // bpc
+                start = last * bpc
+                df.seek(start)
+                chunk = df.read(length - start)
+                mf.seek(hdr + last * 4)
+                stored = mf.read(4)
+                if len(stored) == 4 and \
+                        checksum.checksums_for(chunk) == stored:
+                    break
+                length = start  # drop the unverifiable tail chunk
+        n_keep = (length + bpc - 1) // bpc
+        if length < dsize:
+            with open(data_path, "r+b") as f:
+                f.truncate(length)
+        if hdr + n_keep * 4 < hdr + n_sums * 4:
+            with open(meta_path, "r+b") as f:
+                f.truncate(hdr + n_keep * 4)
+        return length
+
     def finalize_existing(self, block_id: int) -> Optional[Replica]:
         """Block recovery: promote an rbw replica to finalized at its current
         length. Stops a still-open writer first so buffered bytes reach disk
@@ -304,9 +353,10 @@ class BlockStore:
                 return rep
             src = self._path(Replica.RBW, block_id)
             dst = self._path(Replica.FINALIZED, block_id)
-            # The on-disk length is the truth: an interrupted pipeline leaves
-            # the in-memory record at 0 while the rbw file holds the data.
-            rep.num_bytes = os.path.getsize(src)
+            # The verified on-disk prefix is the truth: an interrupted
+            # pipeline leaves the in-memory record at 0 while the rbw
+            # file holds the data (and a crash can tear the tail).
+            rep.num_bytes = self._reconcile_rbw_files(src, src + ".meta")
             os.replace(src, dst)
             os.replace(src + ".meta", dst + ".meta")
             rep.state = Replica.FINALIZED

@@ -104,9 +104,12 @@ class VolumeSet:
     def open_for_read(self, block):
         return self._vol_or_raise(block.block_id).open_for_read(block)
 
-    def read_chunks(self, block, offset: int, length: int):
+    def read_chunks(self, block, offset: int, length: int, opened=None):
+        # ``opened`` is the xceiver's eager open_for_read probe result —
+        # must be accepted (and forwarded) or every read on a
+        # multi-volume DN dies with TypeError before the setup reply
         return self._vol_or_raise(block.block_id).read_chunks(
-            block, offset, length)
+            block, offset, length, opened=opened)
 
     def verify_replica(self, block) -> None:
         self._vol_or_raise(block.block_id).verify_replica(block)

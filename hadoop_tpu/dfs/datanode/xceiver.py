@@ -298,6 +298,14 @@ class DataXceiverServer:
                 else:
                     dt.send_frame(up, {"seq": pkt["seq"], "statuses": [status],
                                        "last": pkt.get("last", False)})
+                if status == dt.STATUS_ERROR_CHECKSUM:
+                    # The ack above carries the verdict; tear down NOW.
+                    # Accepting later packets would append them after the
+                    # missing one — a silent mid-replica hole whose
+                    # recomputed CRCs verify — and a client crash would
+                    # leave that holed rbw for recovery to finalize. The
+                    # client rebuilds the pipeline from the acked prefix.
+                    break
                 if pkt.get("last"):
                     break
             if ok:

@@ -72,6 +72,18 @@ class _BlockCompressorStream:
         self._stream.close()
 
 
+def _read_fully(stream, n: int) -> bytes:
+    """Drain ``n`` bytes across short reads (remote FS streams return
+    partial buffers); a clean EOF at a frame boundary returns b""."""
+    out = bytearray()
+    while len(out) < n:
+        chunk = stream.read(n - len(out))
+        if not chunk:
+            break
+        out += chunk
+    return bytes(out)
+
+
 class _BlockDecompressorStream:
     def __init__(self, stream, codec: CompressionCodec):
         self._stream = stream
@@ -83,12 +95,21 @@ class _BlockDecompressorStream:
         out = bytearray()
         while (n < 0 or len(out) < n) and not (self._eof and not self._pending):
             if not self._pending:
-                hdr = self._stream.read(8)
-                if len(hdr) < 8:
-                    self._eof = True
+                hdr = _read_fully(self._stream, 8)
+                if not hdr:
+                    self._eof = True  # clean EOF at a frame boundary
                     break
+                if len(hdr) < 8:
+                    # a short read mid-header is truncation, never EOF —
+                    # returning the partial payload would silently drop
+                    # the file's tail
+                    raise IOError(
+                        f"truncated codec frame header ({len(hdr)}/8B)")
                 raw_len, comp_len = struct.unpack(">II", hdr)
-                comp = self._stream.read(comp_len)
+                comp = _read_fully(self._stream, comp_len)
+                if len(comp) < comp_len:
+                    raise IOError(
+                        f"truncated codec block ({len(comp)}/{comp_len}B)")
                 self._pending = self._codec.decompress(comp)
                 if len(self._pending) != raw_len:
                     raise IOError("codec block length mismatch")

@@ -117,14 +117,37 @@ class Writer:
 class Reader:
     def __init__(self, stream):
         self._stream = stream
-        hdr = stream.read(5)
+        # short-read safe: remote FS streams return partial buffers, and
+        # a truncated sync marker here would fail every later sync check
+        # on a perfectly valid file
+        hdr = b""
+        while len(hdr) < 5:
+            chunk = stream.read(5 - len(hdr))
+            if not chunk:
+                raise IOError("truncated SequenceFile header")
+            hdr += chunk
         if hdr[:4] != MAGIC:
             raise IOError("not a SequenceFile (bad magic)")
         if hdr[4] != VERSION:
             raise IOError(f"unsupported SequenceFile version {hdr[4]}")
-        # header map is small; read incrementally via buffered chunk
-        buf = stream.read(4096)
-        info, consumed = unpack_with_offset(buf)
+        # accumulate until the header map parses AND the 16-byte sync
+        # marker after it is fully buffered
+        buf = b""
+        info = consumed = None
+        while True:
+            chunk = stream.read(4096)
+            if chunk:
+                buf += chunk
+            try:
+                info, consumed = unpack_with_offset(buf)
+            except Exception:
+                if not chunk:
+                    raise IOError("truncated SequenceFile header")
+                continue
+            if len(buf) >= consumed + 16:
+                break
+            if not chunk:
+                raise IOError("truncated SequenceFile header")
         self.compression = info["compression"]
         self.codec_name = info["codec"]
         self.metadata = info["metadata"]

@@ -550,8 +550,13 @@ class MRAppMaster:
         Ref: TaskHeartbeatHandler."""
         now = time.monotonic()
         with self.lock:
+            # ASSIGNED counts too: a container that launched but wedged
+            # before its first umbilical call never reaches RUNNING and
+            # never exits — without expiry here the job hangs forever
+            # (ref: TaskHeartbeatHandler registers at LAUNCH, not first
+            # ping)
             expired = [a for a in self.attempts.values()
-                       if a.state == "RUNNING"
+                       if a.state in ("ASSIGNED", "RUNNING")
                        and now - a.last_contact > self.task_timeout]
             for attempt in expired:
                 self.attempt_failed(attempt, "task timed out")

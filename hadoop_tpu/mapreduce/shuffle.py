@@ -320,6 +320,13 @@ class Fetcher:
         with self._cv:
             return self._done_count >= len(self._seen)
 
+    def failed(self) -> bool:
+        """True once any fetch exhausted its retries (finish() raises
+        the detail) — the reduce's poll loop checks this instead of
+        idling to the shuffle timeout."""
+        with self._cv:
+            return bool(self._errors)
+
     def _work(self) -> None:
         while True:
             with self._cv:
@@ -357,7 +364,12 @@ class Fetcher:
                 with self._cv:
                     self._done_count += 1
                     self._cv.notify_all()
-            except (OSError, ShuffleError) as e:
+            except Exception as e:  # noqa: BLE001 — every failure class
+                # must hit the retry/error accounting: a corrupt segment
+                # raises zlib.error/ValueError from the decompressor,
+                # and letting that kill the worker silently left the
+                # fetch neither retried nor recorded — the reduce then
+                # idled until the full shuffle timeout masked the cause
                 with self._cv:
                     n = self._failures.get(map_id, 0) + 1
                     self._failures[map_id] = n

@@ -260,6 +260,11 @@ def run_reduce(job: Dict, task: Dict, umbilical, attempt_id: str,
         reporter.set_progress(0.3 * got / max(num_maps, 1))
         if got >= num_maps and fetcher.fetched_all():
             break
+        if fetcher.failed():
+            # a permanently failed fetch must surface NOW, not after the
+            # full shuffle timeout idles by (the AM re-runs the map /
+            # this reduce based on the error)
+            fetcher.finish()
         if time.monotonic() > deadline:
             raise TaskFailure(
                 f"shuffle timed out with {got}/{num_maps} map outputs")

@@ -599,3 +599,34 @@ def test_is_hard_expired_point_check(tmp_path):
     assert not lm.is_hard_expired("/f")     # renewal rescues it
     lm.remove_lease("clientA", "/f")
     assert lm.is_hard_expired("/f")         # nothing protects the path
+
+
+def test_finalize_existing_truncates_to_checksummed_prefix(tmp_path):
+    """Crash alignment: data flushed past the meta's checksums must be
+    truncated at promotion, not finalized as a replica whose tail fails
+    every read (review finding)."""
+    import os
+
+    from hadoop_tpu.dfs.datanode.blockstore import BlockStore
+    from hadoop_tpu.dfs.protocol.records import Block
+    from hadoop_tpu.util.crc import DataChecksum
+
+    store = BlockStore(str(tmp_path / "data"))
+    blk = Block(7001, 1, 0)
+    w = store.create_rbw(blk, DataChecksum(512))
+    payload = b"A" * 2048
+    w.write_packet(payload, DataChecksum(512).checksums_for(payload))
+    w.fsync()
+    # crash: data file grows past what the meta covers
+    data_path = w.data_path
+    with open(data_path, "ab") as f:
+        f.write(b"B" * 700)  # unchecksummed tail
+    w.steal()
+    rep = store.finalize_existing(blk.block_id)
+    assert rep.num_bytes == 2048  # truncated to the verified prefix
+    assert os.path.getsize(store._path(rep.state, blk.block_id)) == 2048
+    # and the finalized replica reads back clean end to end
+    _, _, checksum, _ = store.open_for_read(Block(7001, 1, 2048))
+    for _pos, data, sums in store.read_chunks(Block(7001, 1, 2048), 0,
+                                              2048):
+        checksum.verify(data, sums, base_pos=_pos)

@@ -229,3 +229,51 @@ def test_spill_codec_is_conf_driven_not_host_probed():
     # compress on + no codec in conf (job predates client resolution):
     # the deterministic zlib fallback, NEVER a host-dependent answer
     assert _spill_codec({"mapreduce.map.output.compress": "true"}) == "zlib"
+
+
+def test_fetcher_records_nonio_failures_for_retry():
+    """A corrupt segment raises zlib.error/ValueError from the merger —
+    that must hit the retry/error accounting, not silently kill the
+    worker and idle the reduce to the shuffle timeout (review
+    finding). failed() exposes the terminal state to the poll loop."""
+    import threading
+    import time as _t
+
+    from hadoop_tpu.mapreduce.shuffle import Fetcher, ShuffleError
+
+    class _BoomMerger:
+        def add_segment(self, stored):
+            raise ValueError("corrupt segment")
+
+    # a server that always answers OK with junk data
+    import socketserver
+    import struct as _struct
+
+    from hadoop_tpu.io.wire import pack
+
+    class _H(socketserver.BaseRequestHandler):
+        def handle(self):
+            self.request.recv(1 << 16)
+            body = pack({"ok": True, "data": b"junk"})
+            self.request.sendall(_struct.pack(">I", len(body)) + body)
+
+    srv = socketserver.ThreadingTCPServer(("127.1.2.3", 0), _H)
+    srv.daemon_threads = True
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        port = srv.server_address[1]
+        # 127.1.2.3 is loopback-but-not-local-hostname: the remote lane
+        fetcher2 = Fetcher(0, "job_x", _BoomMerger(), max_retries=2,
+                           num_threads=1)
+        fetcher2.add_events([("m_0", f"127.1.2.3:{port}")])
+        deadline = _t.monotonic() + 10
+        while _t.monotonic() < deadline and not fetcher2.failed():
+            _t.sleep(0.05)
+        assert fetcher2.failed(), "ValueError never reached error state"
+        import pytest as _p
+        with _p.raises(ShuffleError, match="corrupt segment"):
+            fetcher2.finish()
+    finally:
+        srv.shutdown()
+        srv.server_close()
