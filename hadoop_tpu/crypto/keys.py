@@ -200,7 +200,7 @@ class KMSClientProvider(KeyProvider):
                                   {"name": name, "length": bits}))
 
     def roll_key(self, name: str) -> KeyVersion:
-        return self._kv(self._req("POST", f"/kms/v1/key/{name}", {}))
+        return self._kv(self._req("POST", f"/kms/v1/key/{name}/_roll", {}))
 
     def get_current_key(self, name: str) -> KeyVersion:
         return self._kv(self._req("GET",
@@ -216,10 +216,12 @@ class KMSClientProvider(KeyProvider):
         self._req("DELETE", f"/kms/v1/key/{name}")
 
     def generate_encrypted_key(self, name: str) -> EncryptedKeyVersion:
-        d = self._req("GET", f"/kms/v1/key/{name}/_eek?op=generate")
+        # the server routes on eek_op and nests the edek material
+        # (kms.py _route; ref: KMS.java generateEncryptedKeys response)
+        d = self._req("GET", f"/kms/v1/key/{name}/_eek?eek_op=generate")
         return EncryptedKeyVersion(
-            d["keyName"], d["versionName"],
-            base64.b64decode(d["iv"]), base64.b64decode(d["edek"]))
+            d["name"], d["versionName"], base64.b64decode(d["iv"]),
+            base64.b64decode(d["encryptedKeyVersion"]["material"]))
 
     def decrypt_encrypted_key(self, ekv: EncryptedKeyVersion) -> bytes:
         d = self._req("POST", f"/kms/v1/keyversion/{ekv.key_version}"

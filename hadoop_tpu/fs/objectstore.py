@@ -62,8 +62,16 @@ class _Http:
     def request(self, method: str, path: str, body: bytes = b"",
                 headers: Optional[Dict] = None) -> Tuple[int, bytes, Dict]:
         """``path`` must already be percent-encoded (callers build it via
-        ``_obj_path``/``_list_page_call``)."""
-        for attempt in (0, 1):
+        ``_obj_path``/``_list_page_call``).
+
+        Only idempotent methods auto-retry a dropped keep-alive. A POST
+        (multipart initiate/complete) may have EXECUTED before the
+        connection died — blind replay would double-initiate (leaking an
+        upload) or re-complete a finished upload into a 404 that masks a
+        successful write; POST callers handle ambiguity themselves."""
+        retries = (0, 1) if method in ("GET", "HEAD", "PUT",
+                                       "DELETE") else (1,)
+        for attempt in retries:
             conn = self._conn()
             try:
                 conn.request(method, path, body=body or None,
@@ -379,6 +387,7 @@ class ObjectOutputStream(io.RawIOBase):
         self._buf = bytearray()
         self._upload_id: Optional[str] = None
         self._parts: List[int] = []
+        self._bytes_sent = 0
         self._next_part = 1
         self._closed = False
         self.pending_commit: Optional[Dict] = None
@@ -412,6 +421,7 @@ class ObjectOutputStream(io.RawIOBase):
         if status != 200:
             raise IOError(f"upload part {n}: HTTP {status}")
         self._parts.append(n)
+        self._bytes_sent += len(part)
 
     def close(self) -> None:
         if self._closed:
@@ -435,10 +445,23 @@ class ObjectOutputStream(io.RawIOBase):
         self._complete()
 
     def _complete(self) -> None:
-        status, _, _ = self.fs.http.request(
-            "POST", self.fs._obj_path(self.bucket, self.key) +
-            f"?uploadId={self._upload_id}&complete",
-            body=json.dumps(self._parts).encode())
+        try:
+            status, _, _ = self.fs.http.request(
+                "POST", self.fs._obj_path(self.bucket, self.key) +
+                f"?uploadId={self._upload_id}&complete",
+                body=json.dumps(self._parts).encode())
+        except (OSError, ConnectionError):
+            # Ambiguous: the server may have completed the upload before
+            # the connection died (POSTs are not auto-retried). Probe the
+            # object — present at the expected size means the complete
+            # landed; failing a durably-written save would be worse than
+            # the extra HEAD (ref: S3A's completeMPUwithRetries probe).
+            st, _, hdrs = self.fs.http.request(
+                "HEAD", self.fs._obj_path(self.bucket, self.key))
+            if st == 200 and int(hdrs.get("Content-Length",
+                                          -1)) == self._bytes_sent:
+                return
+            raise
         if status != 200:
             raise IOError(f"complete multipart {self.key}: HTTP {status}")
 

@@ -48,10 +48,19 @@ def save_checkpoint(fs: FileSystem, base_dir: str, step: int, tree,
 
     Returns the final checkpoint directory. Retains the newest ``keep``
     checkpoints (ref intent: FSImage's NNStorageRetentionManager keeps a
-    bounded number of images)."""
+    bounded number of images).
+
+    Publish protocol: shards are written straight into the final
+    directory and the manifest goes LAST — its presence is the
+    completeness marker list_checkpoints keys on. No rename: on an
+    object store a directory rename is a lexicographic copy loop that
+    lands ``manifest.json`` before the shards, so a crash mid-rename
+    used to publish a manifest-complete checkpoint with missing shard
+    files. A crash mid-write now leaves a manifest-less directory that
+    readers never see and the next save's retention sweep removes."""
     final_dir = f"{base_dir}/step_{step:012d}"
-    tmp_dir = final_dir + "._tmp"
-    fs.delete(tmp_dir, recursive=True)
+    tmp_dir = final_dir
+    fs.delete(final_dir, recursive=True)
     fs.mkdirs(tmp_dir)
 
     manifest: Dict[str, Any] = {"step": step, "leaves": {}, "shards": []}
@@ -87,9 +96,6 @@ def save_checkpoint(fs: FileSystem, base_dir: str, step: int, tree,
         manifest["leaves"][name] = entry
     fs.write_all(f"{tmp_dir}/manifest.json",
                  json.dumps(manifest).encode())
-    fs.delete(final_dir, recursive=True)
-    if not fs.rename(tmp_dir, final_dir):
-        raise IOError(f"checkpoint publish rename failed: {final_dir}")
     _retain(fs, base_dir, keep)
     return final_dir
 
@@ -105,8 +111,20 @@ def _norm_index(index, shape):
 
 def _retain(fs: FileSystem, base_dir: str, keep: int) -> None:
     steps = list_checkpoints(fs, base_dir)
+    complete = {f"step_{s:012d}" for s in steps}
     for step in steps[:-keep] if keep > 0 else []:
         fs.delete(f"{base_dir}/step_{step:012d}", recursive=True)
+        complete.discard(f"step_{step:012d}")
+    # Sweep manifest-less orphans from crashed publishes (single-writer:
+    # any incomplete step dir other than the one just written is ours).
+    try:
+        entries = fs.list_status(base_dir)
+    except (IOError, OSError, FileNotFoundError):
+        return
+    for st in entries:
+        name = st.path.rstrip("/").rsplit("/", 1)[-1]
+        if name.startswith("step_") and name not in complete:
+            fs.delete(f"{base_dir}/{name}", recursive=True)
 
 
 def list_checkpoints(fs: FileSystem, base_dir: str) -> List[int]:

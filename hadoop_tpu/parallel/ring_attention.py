@@ -72,8 +72,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             step, (out, lse, k, v), jnp.arange(1, axis_size))
         return out.astype(q.dtype)
 
-    k = _repeat_kv(k, hq // k.shape[2])
-    v = _repeat_kv(v, hq // v.shape[2])
+    n_rep = hq // k.shape[2]
     q_pos = my * sl + jnp.arange(sl)
     out0 = pvary_to(jnp.zeros((b, sl, hq, d), jnp.float32), target)
     lse0 = pvary_to(jnp.full((b, sl, hq), -jnp.inf, jnp.float32), target)
@@ -82,13 +81,20 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         out, lse, kc, vc = carry
         src = (my - i) % axis_size          # which shard this K/V chunk is
         kv_pos = src * sl + jnp.arange(sl)
-        o_i, l_i = chunk_attention(q, kc, vc, scale, q_pos, kv_pos)
+        # GQA expansion + f32 promotion happen HERE, per step: the ring
+        # rotates the raw [B,S,Hkv,D] bf16 shard, so each ppermute hop
+        # moves n_rep*2x fewer bytes over ICI than rotating expanded
+        # float32 copies (the replication and cast are pure local
+        # compute the VPU redoes for free each step)
+        o_i, l_i = chunk_attention(
+            q, _repeat_kv(kc, n_rep).astype(jnp.float32),
+            _repeat_kv(vc, n_rep).astype(jnp.float32),
+            scale, q_pos, kv_pos)
         out, lse = merge_attention(out, lse, o_i, l_i)
         kc = jax.lax.ppermute(kc, axis_name, perm)
         vc = jax.lax.ppermute(vc, axis_name, perm)
         return (out, lse, kc, vc), None
 
     (out, _, _, _), _ = jax.lax.scan(
-        step, (out0, lse0, k.astype(jnp.float32), v.astype(jnp.float32)),
-        jnp.arange(axis_size))
+        step, (out0, lse0, k, v), jnp.arange(axis_size))
     return out.astype(q.dtype)

@@ -34,15 +34,26 @@ class Trainer:
                  lr: float = 3e-4, optimizer: str = "adamw",
                  zero1: bool = False, remat=False,
                  ckpt_interval: int = 100, keep: int = 3,
-                 data_dtype: str = "uint16"):
+                 data_dtype: str = "uint16",
+                 n_microbatches: Optional[int] = None,
+                 pipeline_schedule: str = "1f1b"):
         self.cfg, self.plan, self.fs = cfg, plan, fs
         self.ckpt_dir = ckpt_dir
         self.ckpt_interval = ckpt_interval
         self.keep = keep
         self.mesh = make_mesh(plan)
+        if n_microbatches is None:
+            # pipeline plans need M > 1 (interleaved REQUIRES pp | M;
+            # plain 1F1B with M=1 is a full bubble); single-stage plans
+            # run unsplit
+            n_microbatches = max(1, plan.pp * getattr(plan, "vpp", 1))
+        plan.validate(cfg, batch, cfg.max_seq,
+                      n_microbatches=n_microbatches)
         self.step_fn = make_train_step(
             cfg, plan, self.mesh, lr=lr, optimizer=optimizer,
-            zero1=zero1, remat=remat, donate=False)
+            zero1=zero1, remat=remat, donate=False,
+            n_microbatches=n_microbatches,
+            pipeline_schedule=pipeline_schedule)
         self.zero1 = zero1 and optimizer == "adamw"
         self.data = TokenDataset(fs, data_path, batch=batch,
                                  seq=cfg.max_seq, dtype=data_dtype)
@@ -75,12 +86,13 @@ class Trainer:
                     opt.count,
                     logical_layer_order(opt.mu, self.cfg, self.plan),
                     logical_layer_order(opt.nu, self.cfg, self.plan))
-        # the data cursor rides in the manifest via an extra scalar leaf
-        # cursor is stored modulo the dataset length (see TokenDataset),
-        # so int32 is ample
+        # The data cursor rides as an extra leaf, split into two int32
+        # halves: datasets beyond 2**31 tokens are ordinary LM scale and
+        # a single int32 would overflow (or wrap negative) and resume
+        # the stream at the wrong position.
+        pos = self.data.state()["pos"] % max(self.data.total_tokens, 1)
         tree = dict(tree, data_pos=jnp.asarray(
-            self.data.state()["pos"] % max(self.data.total_tokens, 1),
-            jnp.int32))
+            [pos >> 31, pos & 0x7FFFFFFF], jnp.int32))
         path = save_checkpoint(self.fs, self.ckpt_dir, self.step, tree,
                                keep=self.keep)
         log.info("checkpoint step %d -> %s", self.step, path)
@@ -101,7 +113,7 @@ class Trainer:
             opt_specs = AdamWState(
                 count=jax.sharding.PartitionSpec(), mu=specs, nu=specs)
         like = dict(self._state_tree(),
-                    data_pos=jnp.zeros((), jnp.int32))
+                    data_pos=jnp.zeros((2,), jnp.int32))
         spec_tree = {"params": specs, "opt": opt_specs,
                      "data_pos": jax.sharding.PartitionSpec()}
         tree, got = load_checkpoint(self.fs, self.ckpt_dir, like,
@@ -119,7 +131,8 @@ class Trainer:
                                          self.plan),
                     physical_layer_order(self.opt.nu, self.cfg,
                                          self.plan))
-        self.data.restore({"pos": int(tree["data_pos"])})
+        hi, lo = (int(x) for x in tree["data_pos"])
+        self.data.restore({"pos": (hi << 31) | lo})
         self.step = got
         log.info("restored step %d from %s", got, self.ckpt_dir)
         return True
