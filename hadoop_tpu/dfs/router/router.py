@@ -296,7 +296,14 @@ class Router(AbstractService):
     def set_mount_quota(self, mount: str, nsquota: int = -1,
                         ssquota: int = -1) -> None:
         mount = "/" + mount.strip("/")
-        self.quotas[mount] = {"nsquota": nsquota, "ssquota": ssquota}
+        # copy-on-write: admin updates and client-handler iteration
+        # (check_mount_quota) run on different RPC handler threads, and
+        # in-place insertion raises "dict changed size during iteration"
+        # into an unlucky client's create
+        with self._lock:
+            quotas = dict(self.quotas)
+            quotas[mount] = {"nsquota": nsquota, "ssquota": ssquota}
+            self.quotas = quotas
         self.store.save("quota", self.quotas)
         self.refresh_quota_usage()
 
@@ -304,7 +311,7 @@ class Router(AbstractService):
         """Aggregate per-mount usage across nameservices (ref:
         RouterQuotaUpdateService computing RouterQuotaUsage)."""
         usage = {}
-        for mount in self.quotas:
+        for mount in list(self.quotas):
             got = self.mounts.resolve(mount)
             if got is None:
                 continue
@@ -323,10 +330,12 @@ class Router(AbstractService):
         enforcement lags by one refresh interval like the reference."""
         from hadoop_tpu.dfs.protocol.records import QuotaExceededError
         p = "/" + path.strip("/")
-        for mount, q in self.quotas.items():
+        quotas = self.quotas          # snapshot: replaced, never mutated
+        usage = self._quota_usage
+        for mount, q in quotas.items():
             if p != mount and not p.startswith(mount.rstrip("/") + "/"):
                 continue
-            used = self._quota_usage.get(mount)
+            used = usage.get(mount)
             if used is None:
                 continue
             if 0 <= q["nsquota"] <= used["files"]:

@@ -285,7 +285,15 @@ class _Connection:
                 raise _ConnClosedBeforeSend(
                     f"connection to {self.addr} closed before send")
             self.calls[call_id] = pend
-        payload = pack(req)
+        try:
+            payload = pack(req)
+        except Exception:
+            # unencodable argument: the entry must not linger — an
+            # orphan pending call makes the idle-close branch never fire
+            # and the connection pings forever
+            with self.calls_lock:
+                self.calls.pop(call_id, None)
+            raise
         self.last_activity = time.monotonic()
         try:
             # wrap() under send_lock: the cipher counters are sequential

@@ -555,6 +555,14 @@ class Server:
         with self._conns_lock:
             self._conns.pop(id(conn), None)
         self._m_open_conns.decr()
+        # The responder may hold this socket registered for EVENT_WRITE
+        # (partial write backpressure). epoll silently forgets closed
+        # fds, so without an explicit forget the SelectorKey — holding
+        # the connection and its buffered response bytes — leaks for the
+        # server's lifetime (and a select()-based selector would EBADF
+        # out of the responder loop instead).
+        if self._responder is not None:
+            self._responder.forget(conn)
         try:
             conn.sock.close()
         except OSError:
@@ -675,6 +683,7 @@ class _Responder:
         self.sel.register(self._waker_r, selectors.EVENT_READ, None)
         self._to_register: deque = deque()
         self._close_after: set = set()
+        self._to_forget: deque = deque()
 
     def respond(self, conn: _Connection, payload: bytes,
                 close_after: bool = False) -> None:
@@ -716,12 +725,30 @@ class _Responder:
         except OSError:
             pass
 
+    def forget(self, conn: _Connection) -> None:
+        """Called from _close_conn (any thread): drop the selector
+        registration and close-after marker in the responder thread —
+        selector mutation is not thread-safe, so it rides the queue."""
+        self._to_forget.append(conn)
+        self.wake()
+
     def run(self) -> None:
         srv = self.server
         while srv._running:
+            while self._to_forget:
+                conn = self._to_forget.popleft()
+                self._close_after.discard(id(conn))
+                try:
+                    self.sel.unregister(conn.sock)
+                except (KeyError, ValueError, OSError):
+                    pass
             while self._to_register:
                 conn = self._to_register.popleft()
                 if conn.closed:
+                    # never registered (or just forgotten): purge its
+                    # close-after marker too, or CPython's id() reuse
+                    # could half-close an unrelated future connection
+                    self._close_after.discard(id(conn))
                     continue
                 try:
                     self.sel.register(conn.sock, selectors.EVENT_WRITE, conn)

@@ -207,6 +207,15 @@ class Nfs3Gateway(RpcProgram):
         e.u32(2 if is_dir else 1)              # nlink
         e.u32(0).u32(0)                        # uid, gid
         size = 0 if is_dir else st.length
+        if not is_dir:
+            # a file mid-write reports the open cursor: the NN only
+            # learns the length at close, but the client's stat after a
+            # COMMIT (which no longer finalizes) must see its own bytes
+            # (ref: Nfs3Utils.getFileAttr consulting OpenFileCtx)
+            with self._ow_lock:
+                ctx = self._open_writes.get(path)
+            if ctx is not None:
+                size = max(size, ctx.offset)
         e.u64(size).u64(size)                  # size, used
         e.u32(0).u32(0)                        # rdev
         e.u64(1)                               # fsid
@@ -258,6 +267,20 @@ class Nfs3Gateway(RpcProgram):
         with self._ow_lock:
             ctx = self._open_writes.pop(path, None)
         return ctx.close() if ctx is not None else NFS3_OK
+
+    def _sync_write(self, path: str) -> int:
+        with self._ow_lock:
+            ctx = self._open_writes.get(path)
+        if ctx is None:
+            return NFS3_OK  # already closed/flushed: commit is satisfied
+        with ctx.lock:
+            ctx.last_activity = time.monotonic()
+            try:
+                if hasattr(ctx.stream, "flush"):
+                    ctx.stream.flush()
+                return NFS3_OK
+            except (IOError, OSError):
+                return NFS3ERR_IO
 
     # ----------------------------------------------------------- dispatch
 
@@ -352,6 +375,15 @@ class Nfs3Gateway(RpcProgram):
         e = XdrEncoder()
         if path is None:
             return e.u32(NFS3ERR_STALE).boolean(False).getvalue()
+        # Close-to-open consistency: a server-side READ of a file with
+        # an open write context comes from a DIFFERENT client (the
+        # writer reads its own bytes from its page cache) — finalize the
+        # stream so the read sees the data. COMMIT alone deliberately
+        # does NOT close (the writer may keep writing, see _commit).
+        with self._ow_lock:
+            in_flight = path in self._open_writes
+        if in_flight:
+            self._close_write(path)
         try:
             st = self.fs.get_file_status(path)
             if st.is_dir:
@@ -526,6 +558,16 @@ class Nfs3Gateway(RpcProgram):
         path = self._resolve(x.opaque())
         cookie = x.u64()
         x.opaque_fixed(8)     # cookieverf
+        if plus:
+            x.u32()                      # dircount (names-only budget)
+            maxcount = x.u32()
+        else:
+            maxcount = x.u32()           # count
+        # honor the client's reply-size cap (RFC 1813): encoding a huge
+        # directory into one reply overflows the client's RPC transport
+        # and makes the directory permanently unlistable; entries past
+        # the budget wait for the next cookie round
+        budget = max(512, min(maxcount or (1 << 16), 1 << 20))
         e = XdrEncoder()
         if path is None:
             return e.u32(NFS3ERR_STALE).boolean(False).getvalue()
@@ -542,9 +584,14 @@ class Nfs3Gateway(RpcProgram):
         e.u32(NFS3_OK)
         self._post_op_attr(e, path)
         e.opaque_fixed(b"\0" * 8)   # cookieverf
+        base = sum(len(p) for p in e._parts)
+        eof = True
         for i, ent in enumerate(entries):
             if i < cookie:
                 continue
+            if sum(len(p) for p in e._parts) - base > budget - 256:
+                eof = False          # client re-calls with this cookie
+                break
             name = ent.path.rstrip("/").rsplit("/", 1)[-1]
             e.boolean(True)
             e.u64(self.handles.id_of(ent.path))
@@ -556,7 +603,7 @@ class Nfs3Gateway(RpcProgram):
                 e.boolean(True)
                 e.opaque(self.handles.fh_of(ent.path))
         e.boolean(False)            # no more entries
-        e.boolean(True)             # eof
+        e.boolean(eof)
         return e.getvalue()
 
     def _fsstat(self, x: XdrDecoder) -> bytes:
@@ -605,7 +652,14 @@ class Nfs3Gateway(RpcProgram):
         x.u32()
         if path is None:
             return self._err(NFS3ERR_STALE, None)
-        stat = self._close_write(path)
+        # COMMIT durability-syncs the open stream but must NOT close it:
+        # Linux clients fsync mid-transfer (memory pressure flushes
+        # dirty pages) and keep writing — closing here made every later
+        # WRITE fail NFS3ERR_IO and truncated the file (review finding;
+        # ref: the reference's COMMIT only hsyncs OpenFileCtx). The
+        # stream closes on CLOSE-equivalent activity (rename/remove),
+        # the idle-writer sweep, or setattr-size finalization.
+        stat = self._sync_write(path)
         e = XdrEncoder()
         e.u32(stat)
         e.boolean(False)

@@ -125,6 +125,10 @@ class NativeExecutor(ContainerExecutor):
             pass
 
 
+class _KilledBeforeLaunch(Exception):
+    """Internal: stop_container won the race against the launch step."""
+
+
 class _RunningContainer:
     def __init__(self, container: Container, ctx: ContainerLaunchContext,
                  workdir: str, chips: List[int]):
@@ -138,6 +142,12 @@ class _RunningContainer:
         self.diagnostics = ""
         self.start_ts = time.time()
         self.published_volumes = []
+        # closes the kill-during-localization hole: _kill and the launch
+        # step synchronize on this, so a stop that lands before the
+        # process exists prevents the launch instead of no-oping (the
+        # process would otherwise run forever unmanaged)
+        self.killed = False
+        self.lock = threading.Lock()
 
 
 class ContainerManagerProtocol:
@@ -288,6 +298,12 @@ class NodeAgent(AbstractService):
         Daemon(self._launch, f"launch-{cid}", args=(rc,)).start()
 
     def _take_chips(self, n: int) -> List[int]:
+        if n > len(self._chip_pool):
+            # refuse rather than under-allocate: a TPU job granted fewer
+            # chips than its resource ask (or zero, which disables the
+            # accelerator runtime entirely) would run wrong silently
+            raise IOError(f"insufficient TPU chips: want {n}, "
+                          f"have {len(self._chip_pool)}")
         chips = self._chip_pool[:n]
         del self._chip_pool[:n]
         return chips
@@ -315,13 +331,21 @@ class NodeAgent(AbstractService):
                 # launch latency). Clearing the trigger var disables it;
                 # empty string is falsy for the plugin's gate.
                 env["PALLAS_AXON_POOL_IPS"] = ""
-            rc.proc = self.executor.launch(rc.workdir, rc.ctx.commands, env)
+            with rc.lock:
+                if rc.killed:
+                    raise _KilledBeforeLaunch()
+                rc.proc = self.executor.launch(rc.workdir,
+                                               rc.ctx.commands, env)
             rc.state = "RUNNING"
             exit_code = rc.proc.wait()
             rc.exit_code = exit_code
             rc.state = "COMPLETE"
             if exit_code != 0:
                 rc.diagnostics = self._tail_stderr(rc)
+        except _KilledBeforeLaunch:
+            rc.state = "COMPLETE"
+            rc.exit_code = -105  # the reference's KILLED_BY_RESOURCEMANAGER
+            rc.diagnostics = "killed before launch"
         except Exception as e:  # noqa: BLE001
             rc.state = "COMPLETE"
             rc.exit_code = -1001
@@ -335,18 +359,18 @@ class NodeAgent(AbstractService):
                 self._chip_pool.extend(rc.chips)
                 self._completed_unreported.append(ContainerStatus(
                     cid, "COMPLETE", rc.exit_code, rc.diagnostics))
-            if self.timeline is not None and \
-                    self.timeline.has_collector(str(cid.app_id)):
-                # Publish only through a LIVE collector — a straggling
-                # container finishing after the app's collector stopped
-                # must not resurrect it (the event is dropped, like the
-                # reference's post-stop puts).
+            if self.timeline is not None:
+                # Publish only through a LIVE collector, atomically — a
+                # straggler finishing after the app's collector stopped
+                # must be dropped, not resurrect it (put_if_active holds
+                # the manager lock across check+put; the old
+                # has_collector/collector_for pair raced the linger
+                # timer into re-creating a stopped collector).
                 # resource-time metrics ride the FINISHED event so the
-                # ATSv2 reader can aggregate flow-run cost (ref: the
-                # container entity's MEMORY/CPU metrics feeding
-                # FlowRunEntity aggregation)
+                # ATSv2 reader can aggregate flow-run cost.
                 dur = max(0.0, time.time() - rc.start_ts)
-                self.timeline.collector_for(str(cid.app_id)).put_entity(
+                self.timeline.put_if_active(
+                    str(cid.app_id),
                     "YARN_CONTAINER", str(cid), "FINISHED",
                     exit_code=rc.exit_code,
                     duration_s=round(dur, 3),
@@ -430,8 +454,10 @@ class NodeAgent(AbstractService):
 
     def _kill(self, rc: _RunningContainer) -> None:
         """SIGTERM, grace, SIGKILL. Ref: ContainerLaunch.cleanupContainer."""
-        if rc.proc is None or rc.proc.poll() is not None:
-            return
+        with rc.lock:
+            rc.killed = True  # a not-yet-launched process must never start
+            if rc.proc is None or rc.proc.poll() is not None:
+                return
         self.executor.signal(rc.proc, signal.SIGTERM)
 
         def force_kill():

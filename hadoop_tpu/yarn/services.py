@@ -255,8 +255,26 @@ class ServiceMaster:
             env = {"HTPU_SERVICE": self.spec.name,
                    "HTPU_COMPONENT": name,
                    "HTPU_INSTANCE": str(inst.index)}
-            self.nm.start_container(
-                container, ContainerLaunchContext(comp.launch_command, env))
+            try:
+                self.nm.start_container(
+                    container,
+                    ContainerLaunchContext(comp.launch_command, env))
+            except Exception as e:  # noqa: BLE001 — one dead NM must not
+                # kill the whole service AM (teardown would skip and
+                # every other live instance would orphan); mark this
+                # instance failed and re-request a replacement
+                log.warning("service %s: start of %s/%d on %s failed: "
+                            "%s; re-requesting", self.spec.name, name,
+                            inst.index, container.node_id, e)
+                with self._lock:
+                    self._by_container.pop(str(container.container_id),
+                                           None)
+                    if inst in self.instances[name]:
+                        self.instances[name].remove(inst)
+                try:
+                    self.amrm.release(container.container_id)
+                except Exception:  # noqa: BLE001
+                    pass
 
     def _completed(self, done) -> None:
         for status in done:

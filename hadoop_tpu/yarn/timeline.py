@@ -220,6 +220,18 @@ class TimelineCollectorManager:
             c = self._collectors.get(app_id)
             return c is not None and not c.stopped
 
+    def put_if_active(self, app_id: str, *args, **kwargs) -> bool:
+        """Atomic has_collector + put: a straggler event either lands on
+        the still-live collector or is dropped — the separate
+        check-then-put raced the linger timer and RESURRECTED a stopped
+        collector (collector_for creates), leaking it forever."""
+        with self._lock:
+            c = self._collectors.get(app_id)
+            if c is None or c.stopped:
+                return False
+            c.put_entity(*args, **kwargs)
+            return True
+
     def stop_collector(self, app_id: str, linger_s: float = 1.0) -> None:
         """Stop after a short LINGER: the RM's app-finished report can
         beat the app's last container-FINISHED events to this NM by a

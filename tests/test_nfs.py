@@ -191,3 +191,90 @@ def test_write_retransmit_with_tail(gateway):
     x.u32(); x.u32(); x.u32(); x.u32()
     assert x.u64() == 4096          # tail bytes 2048-4096 not dropped
     nfs.close()
+
+
+def test_commit_mid_transfer_keeps_stream_writable(gateway):
+    """COMMIT durability-syncs but must NOT close the write stream:
+    Linux clients fsync mid-copy and keep writing (review finding —
+    the close made every later WRITE fail and truncated the file)."""
+    root_fh = _mount(gateway)
+    fs = gateway.nfs3.fs
+    nfs = SimpleRpcClient("127.0.0.1", gateway.port, NFS_PROGRAM, 3)
+
+    def call(proc, args):
+        return nfs.call(proc, args.getvalue())
+
+    # CREATE /c.bin
+    args = XdrEncoder().opaque(root_fh).string("c.bin").u32(0)
+    x = call(8, args)
+    assert x.u32() == 0
+    assert x.boolean()
+    fh = x.opaque()
+
+    half1, half2 = b"A" * 1000, b"B" * 1000
+    args = XdrEncoder().opaque(fh).u64(0).u32(len(half1)).u32(0)
+    args.opaque(half1)
+    assert call(7, args).u32() == 0
+    # COMMIT mid-transfer
+    args = XdrEncoder().opaque(fh).u64(0).u32(0)
+    assert call(21, args).u32() == 0
+    # ...and the client keeps writing at the next offset
+    args = XdrEncoder().opaque(fh).u64(len(half1)).u32(len(half2)).u32(0)
+    args.opaque(half2)
+    assert call(7, args).u32() == 0, "WRITE after COMMIT must succeed"
+    # a reader from another client finalizes (close-to-open): the
+    # gateway READ closes the write context and serves the bytes
+    got = b""
+    for off in (0, 1000):
+        args = XdrEncoder().opaque(fh).u64(off).u32(1000)
+        x = call(6, args)
+        assert x.u32() == 0
+        x.boolean() and x.opaque_fixed(84)
+        n = x.u32()
+        x.boolean()
+        got += x.opaque()[:n]
+    assert got == half1 + half2
+    assert fs.read_all("/c.bin") == half1 + half2  # durable in the DFS
+    nfs.close()
+
+
+def test_readdir_honors_reply_budget(gateway):
+    """READDIRPLUS pages by the client's maxcount instead of encoding
+    the whole directory into one oversized reply (review finding)."""
+    root_fh = _mount(gateway)
+    fs = gateway.nfs3.fs
+    nfs = SimpleRpcClient("127.0.0.1", gateway.port, NFS_PROGRAM, 3)
+
+    fs.mkdirs("/big")
+    for i in range(120):
+        fs.write_all(f"/big/f{i:04d}", b"x")
+
+    def call(proc, args):
+        return nfs.call(proc, args.getvalue())
+
+    # resolve /big
+    x = call(3, XdrEncoder().opaque(root_fh).string("big"))
+    assert x.u32() == 0
+    big_fh = x.opaque()
+
+    names, cookie, rounds = [], 0, 0
+    while True:
+        rounds += 1
+        args = XdrEncoder().opaque(big_fh).u64(cookie)
+        args.opaque_fixed(b"\0" * 8).u32(1024).u32(2048)  # small budget
+        x = call(17, args)
+        assert x.u32() == 0
+        x.boolean() and x.opaque_fixed(84)
+        x.opaque_fixed(8)  # cookieverf
+        while x.boolean():
+            x.u64()
+            names.append(x.string())
+            cookie = x.u64()
+            x.boolean() and x.opaque_fixed(84)
+            x.boolean() and x.opaque()
+        if x.boolean():   # eof
+            break
+        assert rounds < 200
+    assert rounds > 1, "a 120-entry dir must take multiple rounds at 2KB"
+    assert len(names) == 120
+    nfs.close()
