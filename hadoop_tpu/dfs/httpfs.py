@@ -14,6 +14,7 @@ proxy niche: REST access for clients outside the cluster's RPC plane
 from __future__ import annotations
 
 import logging
+import secrets
 from typing import Dict, Optional, Tuple
 
 from hadoop_tpu.conf import Configuration
@@ -38,8 +39,11 @@ class HttpFSServer(AbstractService):
         self.http = HttpServer(
             conf, ("127.0.0.1", conf.get_int("httpfs.http.port", 0)),
             daemon_name="httpfs")
-        secret = conf.get("httpfs.authentication.signature.secret",
-                          "httpfs-secret").encode()
+        # no configured secret → a RANDOM one (ref: RandomSignerSecret
+        # Provider): a well-known default would let anyone forge the
+        # hadoop.auth cookie for any identity
+        secret_s = conf.get("httpfs.authentication.signature.secret", "")
+        secret = secret_s.encode() if secret_s else secrets.token_bytes(32)
         filt = AuthFilter(
             secret,
             allow_anonymous=conf.get_bool(
@@ -85,10 +89,22 @@ class HttpFSServer(AbstractService):
             if op == "OPEN":
                 offset = int(query.get("offset", 0))
                 length = int(query.get("length", -1))
-                with fs.open(path) as f:
-                    if offset:
-                        f.seek(offset)
-                    return 200, f.read(length if length >= 0 else -1)
+
+                def stream(path=path, offset=offset, length=length):
+                    with fs.open(path) as f:
+                        if offset:
+                            f.seek(offset)
+                        left = length if length >= 0 else None
+                        while left is None or left > 0:
+                            want = 1 << 20 if left is None \
+                                else min(1 << 20, left)
+                            data = f.read(want)
+                            if not data:
+                                break
+                            if left is not None:
+                                left -= len(data)
+                            yield data
+                return 200, stream()
         elif method == "PUT":
             if op == "MKDIRS":
                 return 200, {"boolean": fs.mkdirs(path)}
@@ -98,7 +114,14 @@ class HttpFSServer(AbstractService):
             if op == "CREATE":
                 overwrite = query.get("overwrite", "false") == "true"
                 with fs.create(path, overwrite=overwrite) as f:
-                    f.write(body)
+                    if isinstance(body, (bytes, bytearray)):
+                        f.write(body)
+                    else:  # large upload: bounded reader, chunked copy
+                        while True:
+                            chunk = body.read(1 << 20)
+                            if not chunk:
+                                break
+                            f.write(chunk)
                 return 201, {"boolean": True}
         elif method == "DELETE":
             if op == "DELETE":

@@ -99,11 +99,23 @@ class WebHdfsHandler:
             if op == "OPEN":
                 offset = int(query.get("offset", 0))
                 length = int(query.get("length", -1))
-                with self._dfs().open(path) as f:
-                    if offset:
-                        f.seek(offset)
-                    data = f.read(length if length >= 0 else -1)
-                return 200, data
+
+                def stream(path=path, offset=offset, length=length):
+                    # chunked: the daemon never holds the whole file
+                    with self._dfs().open(path) as f:
+                        if offset:
+                            f.seek(offset)
+                        left = length if length >= 0 else None
+                        while left is None or left > 0:
+                            want = 1 << 20 if left is None \
+                                else min(1 << 20, left)
+                            data = f.read(want)
+                            if not data:
+                                break
+                            if left is not None:
+                                left -= len(data)
+                            yield data
+                return 200, stream()
             if op == "GETXATTRS":
                 attrs = fsn.get_xattrs(path)
                 return 200, {"XAttrs": [
@@ -126,7 +138,14 @@ class WebHdfsHandler:
             if op == "CREATE":
                 overwrite = query.get("overwrite", "false") == "true"
                 with self._dfs().create(path, overwrite=overwrite) as f:
-                    f.write(body)
+                    if isinstance(body, (bytes, bytearray)):
+                        f.write(body)
+                    else:  # large upload: bounded reader, chunked copy
+                        while True:
+                            chunk = body.read(1 << 20)
+                            if not chunk:
+                                break
+                            f.write(chunk)
                 return 201, {"boolean": True}
             if op == "SETPERMISSION":
                 fsn.set_permission(path, int(query["permission"], 8))

@@ -19,10 +19,31 @@ import threading
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs, unquote, urlparse
 
 from hadoop_tpu.conf import Configuration
 from hadoop_tpu.metrics import metrics_system
+
+# request bodies above this arrive as a _BodyReader; response payloads
+# that are iterators stream out chunked — either way the daemon process
+# (often the NameNode) never materializes a whole file in memory
+STREAM_BODY_THRESHOLD = 4 * 1024 * 1024
+
+
+class _BodyReader:
+    """Bounded reader over the request socket for large uploads."""
+
+    def __init__(self, rfile, n: int):
+        self._rfile = rfile
+        self.remaining = n
+
+    def read(self, n: int = -1) -> bytes:
+        if self.remaining <= 0:
+            return b""
+        want = self.remaining if n < 0 else min(n, self.remaining)
+        data = self._rfile.read(want)
+        self.remaining -= len(data)
+        return data
 
 
 class HttpServer:
@@ -69,7 +90,13 @@ class HttpServer:
 
             def do_PUT(self):
                 n = int(self.headers.get("Content-Length", 0) or 0)
-                self._dispatch(self.rfile.read(n) if n else b"")
+                if n > STREAM_BODY_THRESHOLD:
+                    # large upload (WebHDFS CREATE of a big file): hand
+                    # the handler a bounded reader instead of
+                    # materializing the body in this daemon's memory
+                    self._dispatch(_BodyReader(self.rfile, n))
+                else:
+                    self._dispatch(self.rfile.read(n) if n else b"")
 
             def do_POST(self):
                 self.do_PUT()
@@ -115,7 +142,12 @@ class HttpServer:
 
     def _dispatch(self, req, body: bytes) -> None:
         parsed = urlparse(req.path)
-        path = parsed.path
+        # percent-decode the path like every REST server (parse_qs
+        # already decodes query values — leaving the path raw made
+        # /webhdfs/v1/a%20b create a file literally named 'a%20b' while
+        # ?destination=/a b decoded, so the two could never refer to the
+        # same file)
+        path = unquote(parsed.path)
         query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
         query["__path__"] = path
         query["__method__"] = req.command
@@ -146,6 +178,37 @@ class HttpServer:
         elif isinstance(payload, str):
             payload = payload.encode()
             ctype = "text/plain"
+        elif not isinstance(payload, (bytes, bytearray)):
+            # iterator payload: stream it — a 20 GB OPEN must not
+            # materialize in the daemon's memory. Clients that asked for
+            # Connection: close (the C client reads until EOF and can't
+            # de-chunk) get raw bytes + close; everyone else gets
+            # HTTP/1.1 chunked framing on the keep-alive connection.
+            raw_close = (req.headers.get("Connection", "").lower() ==
+                         "close" or req.request_version == "HTTP/1.0")
+            req.send_response(status)
+            req.send_header("Content-Type", "application/octet-stream")
+            if raw_close:
+                req.send_header("Connection", "close")
+            else:
+                req.send_header("Transfer-Encoding", "chunked")
+            for name, value in extra_headers.items():
+                req.send_header(name, value)
+            req.end_headers()
+            for chunk in payload:
+                if not chunk:
+                    continue
+                if raw_close:
+                    req.wfile.write(chunk)
+                else:
+                    req.wfile.write(f"{len(chunk):x}\r\n".encode())
+                    req.wfile.write(chunk)
+                    req.wfile.write(b"\r\n")
+            if raw_close:
+                req.close_connection = True
+            else:
+                req.wfile.write(b"0\r\n\r\n")
+            return
         else:
             ctype = "application/octet-stream"
         req.send_response(status)
@@ -167,7 +230,19 @@ class HttpServer:
         return 200, {"beans": [dict(name=k, **v) for k, v in snap.items()]}
 
     def _conf(self, query, body):
-        return 200, self.conf.to_dict()
+        # redact credential-bearing keys: /conf is registered outside
+        # any auth filter (parity with the reference's ConfServlet), so
+        # dumping a configured signing secret would hand out cookie
+        # forgery (ref: ConfRedactor + *.password/*.secret patterns)
+        redacted = {}
+        for k, v in self.conf.to_dict().items():
+            lk = k.lower()
+            if any(s in lk for s in ("secret", "password", "keytab",
+                                     "credential")):
+                redacted[k] = "<redacted>"
+            else:
+                redacted[k] = v
+        return 200, redacted
 
     def _stacks(self, query, body):
         """Ref: HttpServer2.StackServlet — dump of every live thread."""

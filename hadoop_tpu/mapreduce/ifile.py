@@ -66,12 +66,24 @@ def _read_vint(buf: bytes, off: int) -> Tuple[int, int]:
         shift += 7
 
 
+# Keys at/above this length would have a vint whose first four bytes
+# equal the EOF marker (ff ff ff ff …), making the sentinel ambiguous
+# and silently truncating the segment at read time. The reference's
+# IFile has the same raw-sentinel framing; 256 MB keys are absurd, so
+# the writer refuses them to keep the format unambiguous.
+_MAX_KEY_LEN = 0x0FFFFFFF
+
+
 def encode_records(records: List[Tuple[bytes, bytes]],
                    codec: Optional[str] = None) -> bytes:
     """One IFile segment: records + EOF + u32 crc32c, optionally compressed.
     Returns the stored (wire) bytes."""
     parts = []
     for key, value in records:
+        if len(key) >= _MAX_KEY_LEN:
+            raise ValueError(
+                f"IFile key length {len(key)} >= {_MAX_KEY_LEN} would "
+                "collide with the EOF sentinel")
         parts.append(_vint(len(key)))
         parts.append(_vint(len(value)))
         parts.append(key)
@@ -163,11 +175,70 @@ def write_partitioned(path: str, runs: List[List[Tuple[bytes, bytes]]],
 
 def read_partition(path: str, index: SpillIndex, partition: int,
                    codec: Optional[str] = None) -> List[Tuple[bytes, bytes]]:
+    return list(iter_partition(path, index, partition, codec))
+
+
+def iter_partition(path: str, index: SpillIndex, partition: int,
+                   codec: Optional[str] = None
+                   ) -> Iterator[Tuple[bytes, bytes]]:
+    """Generator form of read_partition: holds the stored (compressed)
+    segment, never the decoded record list — the final-merge path's
+    memory bound."""
     off, length = index.range_for(partition)
     with open(path, "rb") as f:
         f.seek(off)
         stored = f.read(length)
-    return list(decode_records(stored, codec))
+    return decode_records(stored, codec)
+
+
+def write_partitioned_streams(path: str, run_iters,
+                              codec: Optional[str] = None) -> SpillIndex:
+    """write_partitioned over record ITERATORS: the uncompressed (spill
+    default) path streams records straight to disk with an incremental
+    CRC — memory stays O(record) however large the map output is (ref:
+    MapTask.mergeParts streaming segment merge; the list-materializing
+    close() path OOM'd exactly at the end of big, correct map tasks).
+    With a codec the one-shot compressor needs the raw segment, so
+    memory is O(one partition)."""
+    index = SpillIndex()
+    compress, _ = Codecs.get(codec)
+    with open(path, "wb") as f:
+        off = 0
+        for it in run_iters:
+            n = 0
+            if codec:
+                parts = []
+                for key, value in it:
+                    if len(key) >= _MAX_KEY_LEN:
+                        raise ValueError("IFile key too long")
+                    parts.append(_vint(len(key)))
+                    parts.append(_vint(len(value)))
+                    parts.append(key)
+                    parts.append(value)
+                    n += 1
+                parts.append(_EOF)
+                stored = compress(b"".join(parts))
+                f.write(stored)
+                f.write(struct.pack(">I", crc32c(stored)))
+                seg = len(stored) + 4
+            else:
+                crc = 0
+                seg = 0
+                for key, value in it:
+                    if len(key) >= _MAX_KEY_LEN:
+                        raise ValueError("IFile key too long")
+                    rec = _vint(len(key)) + _vint(len(value)) + key + value
+                    f.write(rec)
+                    crc = crc32c(rec, crc)
+                    seg += len(rec)
+                    n += 1
+                f.write(_EOF)
+                crc = crc32c(_EOF, crc)
+                f.write(struct.pack(">I", crc))
+                seg += len(_EOF) + 4
+            index.add(off, seg, n)
+            off += seg
+    return index
 
 
 def write_stream(path: str, records: Iterator[Tuple[bytes, bytes]]) -> int:
@@ -176,6 +247,8 @@ def write_stream(path: str, records: Iterator[Tuple[bytes, bytes]]) -> int:
     n = 0
     with open(path, "wb") as f:
         for key, value in records:
+            if len(key) >= _MAX_KEY_LEN:
+                raise ValueError("IFile key too long")
             f.write(_vint(len(key)))
             f.write(_vint(len(value)))
             f.write(key)

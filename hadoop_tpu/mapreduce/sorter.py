@@ -224,15 +224,22 @@ class MapOutputCollector:
             index = ifile.write_partitioned(out_path, runs, self.codec)
             return index
         self._sort_and_spill()
-        final_runs: List[List[Tuple[bytes, bytes]]] = []
-        for p in range(self.num_partitions):
-            segs = [ifile.read_partition(path, idx, p, self.codec)
+
+        def run_iter(p: int) -> Iterator[Tuple[bytes, bytes]]:
+            segs = [ifile.iter_partition(path, idx, p, self.codec)
                     for path, idx in self._spills]
             merged: Iterator[Tuple[bytes, bytes]] = merge_sorted_runs(segs)
             if self.combiner is not None and len(self._spills) > 1:
                 merged = self.combiner(group_by_key(merged))
-            final_runs.append(list(merged))
-        index = ifile.write_partitioned(out_path, final_runs, self.codec)
+            return merged
+
+        # stream the final merge one partition at a time — materializing
+        # every partition's merged records held the ENTIRE map output in
+        # memory at the end of the task, defeating the spill mechanism
+        # (ref: MapTask.mergeParts streams segments)
+        index = ifile.write_partitioned_streams(
+            out_path, (run_iter(p) for p in range(self.num_partitions)),
+            self.codec)
         for path, _ in self._spills:
             try:
                 os.unlink(path)

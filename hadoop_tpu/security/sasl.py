@@ -225,7 +225,13 @@ class CipherSocket:
         while len(chunks) < n:
             chunk = self._sock.recv(n - len(chunks))
             if not chunk:
-                return None if not chunks else chunks  # EOF mid-record
+                if not chunks:
+                    return None          # clean EOF at a record boundary
+                # EOF mid-record: surface socket semantics (the frame
+                # readers handle OSError), not a struct.error from a
+                # partial length header leaking to the caller
+                raise ConnectionError(
+                    f"connection closed mid-record ({len(chunks)}/{n}B)")
             chunks += chunk
         return bytes(chunks)
 
@@ -292,12 +298,18 @@ class SaslServerSession:
         elif mech == MECH_TOKEN:
             if self.secret_manager is None:
                 raise AccessControlError("server does not accept tokens")
-            token = Token.from_wire(msg["token"])
-            token_ident = self.secret_manager.verify_token(token)
+            ident_bytes = msg.get("token_ident")
+            if not isinstance(ident_bytes, bytes):
+                raise AccessControlError("TOKEN initiate without an "
+                                         "identifier")
+            # The recomputed password is the SCRAM shared secret; the
+            # identifier's CLAIMS become trusted only when the client's
+            # proof (which requires knowing that password) verifies.
+            password = self.secret_manager.password_for(ident_bytes)
+            from hadoop_tpu.io import unpack as _unpack
+            token_ident = _unpack(ident_bytes)
             user = token_ident["owner"]
-            # The token's HMAC password is the shared secret (ref: the
-            # DIGEST-MD5-over-token path of SaslRpcServer).
-            ver = scram_verifier(token.password)
+            ver = scram_verifier(password)
         else:
             raise AccessControlError(f"unsupported mechanism {mech!r}")
         snonce = secrets.token_bytes(16)
@@ -365,13 +377,23 @@ class SaslClientSession:
         msg: Dict = {"state": "initiate", "mech": self.mech,
                      "cnonce": self.cnonce, "qop": self.qop}
         if self.mech == MECH_TOKEN:
-            msg["token"] = self.token.to_wire()
+            # ONLY the identifier crosses the wire — the password is the
+            # SCRAM shared secret the server recomputes from its master
+            # key (transmitting it would hand the credential to any
+            # eavesdropper before a cipher exists; ref: DIGEST-MD5 over
+            # tokens sends the identifier, the server retrievePassword's)
+            msg["token_ident"] = self.token.identifier
+            msg["token_kind"] = self.token.kind
         else:
             msg["user"] = self.user
         return msg
 
     def step(self, msg: Dict) -> Optional[Dict]:
         state = msg.get("state")
+        if self.complete:
+            # a replayed/duplicate terminal message must not re-derive
+            # wire ciphers (their counters would reset — a replay window)
+            raise AccessControlError("SASL message after completion")
         if state == "challenge":
             salt, iters = msg["salt"], msg["iters"]
             self._granted_qop = msg.get("qop", QOP_AUTH)
@@ -389,8 +411,15 @@ class SaslClientSession:
                     "proof": _xor(client_key,
                                   _hmac(stored_key, auth_msg))}
         if state == "success":
+            if self._expect_proof is None:
+                # success before any challenge was processed: nothing to
+                # verify against — accepting would let an impostor that
+                # knows no credential complete the "mutual" handshake
+                # with a guessable placeholder proof
+                raise AccessControlError(
+                    "SASL success before challenge — impostor endpoint")
             if not hmac.compare_digest(msg.get("server_proof", b""),
-                                       self._expect_proof or b"\0"):
+                                       self._expect_proof):
                 raise AccessControlError(
                     "server failed mutual authentication (bad server "
                     "proof) — possible impostor endpoint")

@@ -189,6 +189,22 @@ class SecretManager:
         })
         return Token(self.kind, ident, self._sign(key, ident))
 
+    def password_for(self, identifier: bytes) -> bytes:
+        """Recompute a token's password from its identifier — the SASL
+        TOKEN mechanism's server side (ref: the DIGEST-MD5 path where
+        the server derives the password via retrievePassword and only
+        the identifier crosses the wire; transmitting the password
+        itself would hand the credential to any eavesdropper)."""
+        ident = unpack(identifier)
+        kid = ident.get("key_id")
+        with self._lock:
+            key = self._keys.get(kid)
+        if key is None:
+            raise AccessControlError(f"unknown/expired master key {kid}")
+        if ident.get("expiry", 0) < time.time():
+            raise AccessControlError("token expired")
+        return self._sign(key, identifier)
+
     def verify_token(self, token: Token) -> Dict:
         """Returns the decoded identifier; raises AccessControlError on
         bad signature or expiry."""

@@ -49,10 +49,27 @@ def build_copy_listing(src_fs: FileSystem, src_root: str,
             for child in src_fs.list_status(path):
                 walk(child.path)
         else:
-            files.append({"src": path, "dst": dst, "size": st.length})
+            files.append({"src": path, "dst": dst, "size": st.length,
+                          "mtime": st.mtime})
 
     walk(root)
     return files, dirs
+
+
+def resolve_single_file_dst(dst_fs: FileSystem, src_root: str,
+                            dst_root: str) -> str:
+    """Reference semantics: copying ONE file onto an existing directory
+    lands it INSIDE as dst/<name> — mapping the file onto the directory
+    path itself would try create() on a directory and fail (or clobber
+    it)."""
+    dst = dst_root.rstrip("/") or "/"
+    try:
+        if dst_fs.get_file_status(dst).is_dir:
+            name = src_root.rstrip("/").rsplit("/", 1)[-1]
+            return f"{dst}/{name}"
+    except (FileNotFoundError, IOError):
+        pass
+    return dst
 
 
 class CopyMapper(Mapper):
@@ -78,7 +95,13 @@ class CopyMapper(Mapper):
         src, dst = entry["src"], entry["dst"]
         if self.update and dst_fs.exists(dst):
             st = dst_fs.get_file_status(dst)
-            if st.length == entry["size"]:
+            # size alone cannot prove freshness: a same-length in-place
+            # change (fixed-width records) would be skipped forever and
+            # the stale copy could become authoritative after a
+            # fedbalance repoint (ref: -update compares FileChecksums;
+            # mtime is the cheap witness both sides carry)
+            if st.length == entry["size"] and \
+                    st.mtime >= entry.get("mtime", float("inf")):
                 ctx.incr_counter("DistCp", "SKIPPED")
                 return
         parent = Path(dst).parent
@@ -133,8 +156,11 @@ def distcp(rm_addr, default_fs: str, src_uri: str, dst_uri: str, *,
     src_fs = FileSystem.get(src_uri, conf)
     dst_fs = FileSystem.get(dst_uri, conf)
     try:
-        files, dirs = build_copy_listing(src_fs, src_path.path,
-                                         dst_path.path)
+        dst_root = dst_path.path
+        if not src_fs.get_file_status(src_path.path).is_dir:
+            dst_root = resolve_single_file_dst(dst_fs, src_path.path,
+                                               dst_root)
+        files, dirs = build_copy_listing(src_fs, src_path.path, dst_root)
         for d in dirs:
             dst_fs.mkdirs(d)
         if not files:

@@ -96,13 +96,49 @@ def test_token_mechanism_binds_verified_owner():
 
 
 def test_token_mechanism_forged_token_rejected():
+    """A forged password fails the SCRAM proof exchange — the password
+    itself never crosses the wire (the initiate carries only the
+    identifier; the server recomputes the secret from its master key),
+    so the rejection necessarily lands at the response step."""
     sm = SecretManager("TEST_TOKEN")
     token = sm.create_token("bob")
     token.password = b"\x00" * 32  # forged signature
     srv = SaslServerSession(None, secret_manager=sm)
     cli = SaslClientSession(MECH_TOKEN, token=token)
+    challenge = srv.step(cli.initiate())
     with pytest.raises(AccessControlError):
-        srv.step(cli.initiate())
+        srv.step(cli.step(challenge))
+    assert not srv.complete
+
+
+def test_token_initiate_never_transmits_password():
+    """Review finding: the old initiate shipped token.password in
+    cleartext before any cipher existed, handing the credential to any
+    eavesdropper."""
+    sm = SecretManager("TEST_TOKEN")
+    token = sm.create_token("carol")
+    cli = SaslClientSession(MECH_TOKEN, token=token)
+    from hadoop_tpu.io import pack
+    wire = pack(cli.initiate())
+    assert token.password not in wire
+    # and the honest handshake still completes with mutual auth
+    srv = SaslServerSession(None, secret_manager=sm)
+    cli2 = SaslClientSession(MECH_TOKEN, token=sm.create_token("carol"))
+    reply = srv.step(cli2.initiate())
+    success = srv.step(cli2.step(reply))
+    assert cli2.step(success) is None and cli2.complete
+    assert srv.complete and srv.user == "carol"
+
+
+def test_success_before_challenge_rejected():
+    """Mutual-auth bypass (review finding): a forged success arriving
+    before any challenge must be rejected, not compared against a
+    guessable placeholder."""
+    sm = SecretManager("TEST_TOKEN")
+    cli = SaslClientSession(MECH_TOKEN, token=sm.create_token("bob"))
+    cli.initiate()
+    with pytest.raises(AccessControlError, match="before challenge"):
+        cli.step({"state": "success", "server_proof": b"\x00"})
 
 
 def test_wire_cipher_tamper_detection():

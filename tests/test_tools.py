@@ -100,3 +100,41 @@ def test_streaming_with_reducer(cluster, fs, tmp_path):
                    if "part-r-" in s.path)
     rows = dict(line.split(b"\t") for line in out.splitlines() if line)
     assert rows == {b"apple": b"3", b"banana": b"2", b"cherry": b"1"}
+
+
+def test_distcp_update_recopies_same_size_changed_file(tmp_path):
+    """-update must not trust size alone: a same-length in-place change
+    (fixed-width records) re-copies based on mtime (review finding —
+    stale bytes could become authoritative after a fedbalance)."""
+    import time as _t
+
+    from hadoop_tpu.tools.distcp import distcp
+    with MiniMRYarnCluster(num_nodes=1) as cluster:
+        fs = cluster.get_filesystem()
+        fs.mkdirs("/src")
+        fs.write_all("/src/fixed.bin", b"A" * 1024)
+        base = f"{cluster.default_fs}"
+        distcp(cluster.rm_addr, cluster.default_fs,
+               f"{base}/src", f"{base}/dst")
+        assert fs.read_all("/dst/fixed.bin") == b"A" * 1024
+        _t.sleep(1.1)  # mtime resolution
+        fs.write_all("/src/fixed.bin", b"B" * 1024)  # same size, new bytes
+        distcp(cluster.rm_addr, cluster.default_fs,
+               f"{base}/src", f"{base}/dst")
+        assert fs.read_all("/dst/fixed.bin") == b"B" * 1024
+
+
+def test_distcp_single_file_into_existing_dir(tmp_path):
+    """Copying one file onto an existing directory lands INSIDE it as
+    dst/<name> (review finding — it mapped onto the directory path and
+    create() blew up)."""
+    from hadoop_tpu.tools.distcp import distcp
+    with MiniMRYarnCluster(num_nodes=1) as cluster:
+        fs = cluster.get_filesystem()
+        fs.mkdirs("/one")
+        fs.write_all("/one/file.txt", b"payload")
+        fs.mkdirs("/destdir")
+        base = f"{cluster.default_fs}"
+        distcp(cluster.rm_addr, cluster.default_fs,
+               f"{base}/one/file.txt", f"{base}/destdir")
+        assert fs.read_all("/destdir/file.txt") == b"payload"
