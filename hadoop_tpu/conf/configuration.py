@@ -117,15 +117,21 @@ class Configuration:
     def _merge(self, props: Dict[str, Any], source: str,
                respect_final: bool = True,
                final_keys: Optional[set] = None) -> None:
-        for k, v in props.items():
-            k = self._handle_deprecation_on_set(k)
-            if respect_final and k in self._finals:
-                log.warning("Ignoring override of final parameter %s from %s", k, source)
-                continue
-            self._props[k] = str(v)
-            self._sources[k] = source
-            if final_keys and k in final_keys:
-                self._finals.add(k)
+        # under the lock: reload/add_resource races locked readers
+        # (to_dict/__iter__) — an unlocked overlay raised "dict changed
+        # size during iteration" and could expose a half-applied
+        # resource (values visible before their final markers)
+        with self._lock:
+            for k, v in props.items():
+                k = self._handle_deprecation_on_set(k)
+                if respect_final and k in self._finals:
+                    log.warning("Ignoring override of final parameter "
+                                "%s from %s", k, source)
+                    continue
+                if final_keys and k in final_keys:
+                    self._finals.add(k)  # marker BEFORE the value lands
+                self._props[k] = str(v)
+                self._sources[k] = source
 
     def add_resource(self, resource, source: Optional[str] = None) -> None:
         """Overlay a resource: a dict, a JSON file path, or a flat key=value file.

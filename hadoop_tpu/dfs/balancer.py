@@ -226,16 +226,38 @@ class Mover:
                     # misplaced copy (invalidating first could momentarily
                     # leave the block at expected-1 and trip excess pruning
                     # on the wrong node).
+                    registered = False
                     deadline = time.monotonic() + 5.0
                     while time.monotonic() < deadline:
                         locs_now = {d["u"] for d in
                                     self.nn.get_block_datanodes(
                                         block.to_wire())}
                         if target.uuid in locs_now:
+                            registered = True
                             break
                         time.sleep(0.1)
-                    self.nn.invalidate_replica(block.to_wire(), bad.uuid)
-                    moves += 1
+                    if not registered:
+                        # the new replica never reported: invalidating
+                        # the old copy now would open a durability
+                        # window for nothing — leave it for a later pass
+                        log.warning("mover: new replica of %s on %s did "
+                                    "not register; keeping the source",
+                                    block, target.uuid)
+                        continue
+                    if self.nn.invalidate_replica(block.to_wire(),
+                                                  bad.uuid):
+                        moves += 1  # count only completed migrations
+                    else:
+                        # the NN's excess pruning (policy-aware) can
+                        # retire the misplaced copy the instant the new
+                        # replica registers — that race is still a
+                        # completed migration; only an UNMOVED source
+                        # is a failure
+                        locs_now = {d["u"] for d in
+                                    self.nn.get_block_datanodes(
+                                        block.to_wire())}
+                        if bad.uuid not in locs_now:
+                            moves += 1
                 except (OSError, IOError) as e:
                     log.warning("mover transfer %s failed: %s", block, e)
         return moves

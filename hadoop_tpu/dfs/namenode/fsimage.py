@@ -10,8 +10,11 @@ writes to ``.ckpt`` then renames — a torn save never shadows a good image.
 from __future__ import annotations
 
 import hashlib
+import logging
 import os
 from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
 
 from hadoop_tpu.dfs.namenode.inodes import (FSDirectory, INode,
                                             INodeDirectory, INodeFile)
@@ -110,40 +113,70 @@ class FSImage:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, final)
-        with open(final + ".md5", "w") as f:
+        # tmp+rename the side file too: a crash between the image rename
+        # and a bare md5 write left a torn .md5 that failed load() hard
+        md5_tmp = final + ".md5.tmp"
+        with open(md5_tmp, "w") as f:
             f.write(digest)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(md5_tmp, final + ".md5")
         return final
 
-    def newest_image(self) -> Optional[Tuple[int, str]]:
-        best: Optional[Tuple[int, str]] = None
+    def _images(self) -> List[Tuple[int, str]]:
+        out: List[Tuple[int, str]] = []
         for name in os.listdir(self.dir):
-            if name.startswith("fsimage_") and not name.endswith((".md5", ".ckpt")):
-                txid = int(name.split("_", 1)[1])
-                if best is None or txid > best[0]:
-                    best = (txid, os.path.join(self.dir, name))
-        return best
+            if name.startswith("fsimage_") and not name.endswith(
+                    (".md5", ".ckpt", ".tmp")):
+                out.append((int(name.split("_", 1)[1]),
+                            os.path.join(self.dir, name)))
+        return sorted(out)
+
+    def newest_image(self) -> Optional[Tuple[int, str]]:
+        images = self._images()
+        return images[-1] if images else None
 
     def load(self) -> Optional[Tuple[int, FSDirectory, Dict]]:
-        """Load the newest image; returns (txid, fsdir, extra) or None."""
-        newest = self.newest_image()
-        if newest is None:
+        """Load the newest VERIFIABLE image; returns (txid, fsdir,
+        extra) or None. A corrupt/torn newest image falls back to the
+        next retained one (the edit log replays the difference) instead
+        of refusing to start — ref: FSImage iterating candidate images
+        in save-order until one loads."""
+        images = self._images()
+        if not images:
             return None
-        txid, path = newest
-        with open(path, "rb") as f:
-            payload = f.read()
-        md5_path = path + ".md5"
-        if os.path.exists(md5_path):
-            with open(md5_path) as f:
-                want = f.read().strip()
-            got = hashlib.md5(payload).hexdigest()
-            if want != got:
-                raise IOError(f"fsimage {path} is corrupt "
-                              f"(md5 {got} != recorded {want})")
-        d = unpack(payload)
-        fsdir = FSDirectory()
-        fsdir.root = _deserialize_node(d["root"])  # type: ignore[assignment]
-        fsdir._inode_count = d.get("inodes", 1)
-        return d["txid"], fsdir, d.get("extra", {})
+        last_err: Optional[Exception] = None
+        for txid, path in reversed(images):
+            try:
+                with open(path, "rb") as f:
+                    payload = f.read()
+                md5_path = path + ".md5"
+                if os.path.exists(md5_path):
+                    with open(md5_path) as f:
+                        want = f.read().strip()
+                    if not want:
+                        # empty side file = the pre-atomic-write crash
+                        # artifact; treat like a missing one (nothing to
+                        # validate against) rather than condemning a
+                        # perfectly good image
+                        log.warning("fsimage %s has an empty .md5; "
+                                    "skipping digest check", path)
+                    else:
+                        got = hashlib.md5(payload).hexdigest()
+                        if want != got:
+                            raise IOError(
+                                f"fsimage {path} is corrupt (md5 {got} "
+                                f"!= recorded {want})")
+                d = unpack(payload)
+            except Exception as e:  # noqa: BLE001 — try the older image
+                log.error("fsimage %s unusable (%s); trying older", path, e)
+                last_err = e
+                continue
+            fsdir = FSDirectory()
+            fsdir.root = _deserialize_node(d["root"])  # type: ignore[assignment]
+            fsdir._inode_count = d.get("inodes", 1)
+            return d["txid"], fsdir, d.get("extra", {})
+        raise IOError(f"no loadable fsimage in {self.dir}") from last_err
 
     def purge_old(self, keep: int = 2) -> None:
         """Retain the newest ``keep`` images. Ref: NNStorageRetentionManager."""

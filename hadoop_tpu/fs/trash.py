@@ -69,8 +69,20 @@ class Trash:
             return ""
         stamp = time.strftime(CHECKPOINT_FMT, time.localtime())
         dst = f"{root}/{stamp}"
-        self.fs.rename(cur, dst)
-        return dst
+        # two checkpoints in one wall-clock second (emptier pass racing
+        # an explicit expunge) collide on the name: retry with a suffix
+        # like the reference rather than aborting the roll (ref:
+        # TrashPolicyDefault.createCheckpoint's -N retry loop)
+        attempt = 0
+        while True:
+            try:
+                self.fs.rename(cur, dst)
+                return dst
+            except (FileExistsError, IOError):
+                attempt += 1
+                if attempt > 10:
+                    raise
+                dst = f"{root}/{stamp}-{attempt}"
 
     def expunge(self, immediately: bool = False) -> List[str]:
         """Delete checkpoints older than the interval (all of them when

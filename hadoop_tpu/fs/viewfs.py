@@ -122,6 +122,19 @@ class ViewFileSystem(FileSystem):
                                   st.replication, st.block_size,
                                   st.mtime, st.atime, owner=st.owner,
                                   permission=st.permission))
+        # nested mounts shadow the backing fs: a link mounted UNDER this
+        # one must appear in the listing (else recursive walks silently
+        # skip its whole subtree — ref: InodeTree mount points nested in
+        # mounted dirs)
+        seen = {Path(s.path).path for s in out}
+        for m, _t in self._links:
+            if m != p and m.startswith(p.rstrip("/") + "/"):
+                child = p.rstrip("/") + "/" + \
+                    m[len(p.rstrip("/")) + 1:].split("/", 1)[0]
+                if child not in seen:
+                    seen.add(child)
+                    out.append(FileStatus(child, True, 0, 1, 0, 0.0,
+                                          0.0))
         return out
 
     def _link_target(self, mount: str) -> str:

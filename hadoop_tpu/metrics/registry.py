@@ -269,6 +269,12 @@ class MetricsSystem:
                 pass
 
     def start_periodic_publish(self, period_s: float = 10.0) -> None:
+        # idempotent: a second caller (two components wiring the shared
+        # metrics system) must stop the first publisher, not orphan it —
+        # the orphan doubled every sink's output forever and only the
+        # newest thread was stoppable
+        if self._timer is not None:
+            self._timer.set()
         stop = threading.Event()
         self._timer = stop
 

@@ -140,7 +140,10 @@ class StreamSink(Sink):
                  topic: str = "hadoop-metrics"):
         self.topic = topic
         self._addr = (host, port)
-        self._sock = socket.create_connection(self._addr, timeout=5.0)
+        # lazy: a collector that is down at daemon startup must not fail
+        # sink construction (put_snapshot reconnects — the docstring's
+        # whole resilience promise starts at the first publish)
+        self._sock: Optional[socket.socket] = None
 
     def put_snapshot(self, ts: float, snapshot: Dict[str, Dict]) -> None:
         lines = []

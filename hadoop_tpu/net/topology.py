@@ -60,6 +60,7 @@ class TopologyResolver:
         if got is not None:
             return got
         loc = self._table.get(host)
+        script_failed = False
         if loc is None and self._script:
             try:
                 out = subprocess.run(
@@ -69,9 +70,14 @@ class TopologyResolver:
                 loc = line[0].strip() if line else None
             except (OSError, subprocess.SubprocessError) as e:
                 log.warning("topology script failed for %s: %s", host, e)
+                script_failed = True
         loc = loc or DEFAULT_POD
-        with self._lock:
-            self._cache[host] = loc
+        if not script_failed:
+            # never cache a TRANSIENT script failure's default: it would
+            # pin wrong placement/sort decisions for the host until
+            # process restart; the next resolve retries the script
+            with self._lock:
+                self._cache[host] = loc
         return loc
 
 

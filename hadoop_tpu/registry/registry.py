@@ -118,7 +118,12 @@ class RegistryServer(AbstractService):
             e = self._entries.get(path)
             if e is None:
                 return False
-            e.deadline = time.monotonic() + ttl_s
+            # a persistent record stays persistent: arming a TTL here
+            # would let a generic keepalive loop convert it into an
+            # expiring one, and the sweeper would delete it the moment
+            # the caller stopped renewing
+            if e.record.ephemeral:
+                e.deadline = time.monotonic() + ttl_s
             return True
 
     def remove(self, path: str) -> bool:

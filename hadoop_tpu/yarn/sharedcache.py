@@ -171,12 +171,19 @@ class SharedCacheManager(AbstractService):
                         if not refs and now - ts > self.ttl_s]
                 for c in dead:
                     del self._entries[c]
-            for c in dead:
-                try:
-                    self._fs.delete(self._entry_dir(c), recursive=True)
-                    log.info("SCM cleaned %s", c)
-                except (IOError, OSError):
-                    pass
+                # delete UNDER the lock: releasing it between the map
+                # removal and the fs delete let a concurrent
+                # miss→re-upload→notify re-insert the entry, and the
+                # delete then removed the fresh upload while the map
+                # kept advertising it (every later use() returned a
+                # path to nothing)
+                for c in dead:
+                    try:
+                        self._fs.delete(self._entry_dir(c),
+                                        recursive=True)
+                        log.info("SCM cleaned %s", c)
+                    except (IOError, OSError):
+                        pass
 
 
 class SharedCacheClient:

@@ -127,3 +127,30 @@ def test_multilevel_mounts_walkable(two_clusters):
     assert level2 == {"/data/warehouse", "/data/logs"}
     assert {s.path for s in view.list_status("/data/warehouse")} \
         == {"/data/warehouse/t1"}
+
+
+def test_nested_mount_visible_in_parent_listing():
+    """A mount nested under another mount appears in the parent mount's
+    listing — recursive walks must not silently skip its subtree
+    (review finding)."""
+    from hadoop_tpu.fs.viewfs import ViewFileSystem
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster, fast_conf
+
+    with MiniDFSCluster(num_datanodes=1, conf=fast_conf()) as c1, \
+            MiniDFSCluster(num_datanodes=1, conf=fast_conf()) as c2:
+        c1.wait_active()
+        c2.wait_active()
+        c1.get_filesystem().mkdirs("/data")
+        c2.get_filesystem().mkdirs("/archive")
+        vconf = Configuration(load_defaults=False)
+        vconf.set("fs.viewfs.mounttable.cl.link./data",
+                  f"{c1.default_fs}/data")
+        vconf.set("fs.viewfs.mounttable.cl.link./data/archive",
+                  f"{c2.default_fs}/archive")
+        v = ViewFileSystem(vconf, table="cl")
+        names = {s.path.rsplit("/", 1)[-1]
+                 for s in v.list_status("/data")}
+        assert "archive" in names
+        # and the nested subtree resolves through the second cluster
+        v.mkdirs("/data/archive/deep")
+        assert c2.get_filesystem().exists("/archive/deep")
