@@ -233,7 +233,10 @@ class NativeCollector:
             lib.htpu_coll_free(self._h)
             self._h = None
             raise RuntimeError("native collector: liblz4 not loadable")
-        self.num_partitions = num_partitions
+        # mirror the C side's clamp (htpu_coll_new treats 0 as 1): the
+        # close() index array is sized from this value, and a mismatch
+        # would let the C writer overrun it by 24 bytes
+        self.num_partitions = max(1, num_partitions)
 
     def feed(self, packed: bytes) -> int:
         n = self._lib.htpu_coll_feed(self._h, packed, len(packed))

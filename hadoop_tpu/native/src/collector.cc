@@ -233,6 +233,12 @@ struct IFileWriter {
   bool end_segment() {
     static const uint8_t kEof[4] = {0xFF, 0xFF, 0xFF, 0xFF};
     seg.insert(seg.end(), kEof, kEof + 4);
+    if (lz4 && seg.size() > 0x7FFFFFFFull) {
+      // the int cast below would truncate modulo 2^32: a >4 GiB
+      // partition would compress/store a tiny prefix with a perfectly
+      // self-consistent CRC — silent data loss; fail the close instead
+      return false;
+    }
     if (lz4) {
       // stored body = u32le(raw size) + lz4 block — the exact frame
       // io/codecs.py Lz4Codec reads back (CRC covers the stored body,
@@ -466,26 +472,49 @@ int64_t htpu_merge_segments(const uint8_t** segs, const uint64_t* lens,
     uint32_t klen, vlen;
     size_t src;
   };
-  auto read_varint = [](const uint8_t*& p) {
-    uint32_t n = 0;
+  // Segments arrive over the shuffle from OTHER nodes and the CRC
+  // covers whatever bytes were supplied, so framing must be treated as
+  // hostile: every varint read is bounds-checked (a trailing run of
+  // 0x80 continuation bytes must not walk off the heap) and the
+  // record-size advance uses 64-bit math (uint32 klen+vlen wraparound
+  // let a crafted record pass `p <= end` and the copy then read ~4 GB
+  // out of bounds).
+  auto read_varint = [](const uint8_t*& p, const uint8_t* end,
+                        bool* ok) -> uint32_t {
+    uint64_t n = 0;
     int shift = 0;
-    while (true) {
+    while (p < end && shift <= 28) {
       uint8_t b = *p++;
-      n |= (b & 0x7Fu) << shift;
-      if (!(b & 0x80)) return n;
+      n |= static_cast<uint64_t>(b & 0x7Fu) << shift;
+      if (!(b & 0x80)) {
+        if (n > 0xFFFFFFFFull) break;
+        return static_cast<uint32_t>(n);
+      }
       shift += 7;
     }
+    *ok = false;
+    return 0;
   };
-  auto load = [&](Cursor& c) -> bool {  // false = segment exhausted
+  auto load = [&](Cursor& c, bool* malformed) -> bool {
     if (c.p + 4 <= c.end && c.p[0] == 0xFF && c.p[1] == 0xFF &&
         c.p[2] == 0xFF && c.p[3] == 0xFF)
       return false;
     if (c.p >= c.end) return false;
-    c.klen = read_varint(c.p);
-    c.vlen = read_varint(c.p);
+    bool ok = true;
+    c.klen = read_varint(c.p, c.end, &ok);
+    c.vlen = read_varint(c.p, c.end, &ok);
+    if (!ok) {
+      *malformed = true;
+      return false;
+    }
+    uint64_t need = static_cast<uint64_t>(c.klen) + c.vlen;
+    if (need > static_cast<uint64_t>(c.end - c.p)) {
+      *malformed = true;
+      return false;
+    }
     c.key = c.p;
-    c.p += c.klen + c.vlen;
-    return c.p <= c.end;
+    c.p += need;
+    return true;
   };
 
   std::vector<Cursor> curs;
@@ -502,7 +531,9 @@ int64_t htpu_merge_segments(const uint8_t** segs, const uint64_t* lens,
         htpu_crc32c(0, reinterpret_cast<const char*>(body), blen);
     if (got != want) return -1;
     Cursor c{body, body + blen - 4, nullptr, 0, 0, s};
-    if (load(c)) curs.push_back(c);
+    bool malformed = false;
+    if (load(c, &malformed)) curs.push_back(c);
+    if (malformed) return -1;
     total_bytes += blen;
   }
 
@@ -528,9 +559,12 @@ int64_t htpu_merge_segments(const uint8_t** segs, const uint64_t* lens,
       ob.insert(ob.end(), reinterpret_cast<uint8_t*>(&vl),
                 reinterpret_cast<uint8_t*>(&vl) + 4);
     }
-    ob.insert(ob.end(), c.key, c.key + kl + vl);
+    ob.insert(ob.end(), c.key,
+              c.key + (static_cast<uint64_t>(kl) + vl));
     n++;
-    if (load(c)) heap.push(c);
+    bool malformed = false;
+    if (load(c, &malformed)) heap.push(c);
+    if (malformed) return -1;
   }
   uint8_t* flat = static_cast<uint8_t*>(malloc(ob.size() ? ob.size() : 1));
   if (!flat) return -1;

@@ -19,6 +19,7 @@
 
 #include <errno.h>
 #include <fcntl.h>
+#include <grp.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
@@ -121,7 +122,12 @@ int main(int argc, char** argv) {
     // user switch LAST (ref: launch_container_as_user's ordering —
     // privileged setup first, then drop). Only meaningful as root.
     if (run_uid >= 0 && geteuid() == 0) {
-      if (setgid((gid_t)run_uid) < 0 || setuid((uid_t)run_uid) < 0)
+      // drop supplementary groups BEFORE the uid switch: inheriting
+      // root's groups (disk/adm/...) would hand the untrusted container
+      // group-level access to host resources (CWE-271; the reference
+      // calls initgroups for the same reason)
+      if (setgroups(0, NULL) < 0 || setgid((gid_t)run_uid) < 0 ||
+          setuid((uid_t)run_uid) < 0)
         _exit(fail("setuid"));
     }
     execvp(argv[i], &argv[i]);

@@ -268,6 +268,9 @@ static int dfs_open(const char *path, struct fuse_file_info *fi) {
       pthread_mutex_unlock(&g_lock);
       if (ex == 1) return -ENOTSUP;
     }
+    if (strlen(path) >= sizeof((struct staged *)0)->path)
+      return -ENAMETOOLONG; /* a truncated name would upload to (and
+                             * possibly clobber) a DIFFERENT file */
     struct staged *stg = calloc(1, sizeof *stg);
     if (!stg) return -ENOMEM;
     stg->dirty = (fi->flags & O_TRUNC) ? 1 : 0;
@@ -286,6 +289,8 @@ static int dfs_open(const char *path, struct fuse_file_info *fi) {
 static int dfs_create(const char *path, mode_t mode,
                       struct fuse_file_info *fi) {
   (void)mode;
+  if (strlen(path) >= sizeof((struct staged *)0)->path)
+    return -ENAMETOOLONG;
   struct staged *stg = calloc(1, sizeof *stg);
   if (!stg) return -ENOMEM;
   stg->dirty = 1; /* empty file must be uploaded even with no writes */

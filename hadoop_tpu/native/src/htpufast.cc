@@ -652,6 +652,19 @@ int64_t htpufast_read_file(void* h, const char* path, uint8_t* buf,
     if (const Value* tok = lb.get("tok")) st->token = *tok;
     st->file_off = lb.get_int("off", 0);
     st->want = b->get_int("nb", 0);
+    /* validate the NN-supplied block geometry against the caller's
+     * buffer BEFORE any DN bytes arrive: the packet path memcpys
+     * through file_off + pkt_off, so an out-of-range (or negative)
+     * off/nb from a malicious or buggy NameNode would be a remote
+     * heap overflow of the Python-supplied buffer */
+    if (st->file_off < 0 || st->want < 0 ||
+        st->file_off > cap || st->want > cap - st->file_off) {
+      fs->set_err("block geometry out of range (off=%lld nb=%lld cap=%lld)",
+                  static_cast<long long>(st->file_off),
+                  static_cast<long long>(st->want),
+                  static_cast<long long>(cap));
+      return -1;
+    }
     if (const Value* locs = lb.get("locs")) {
       for (const Value& dn : locs->arr) {
         const Value* hv = dn.get("h");

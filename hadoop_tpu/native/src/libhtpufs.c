@@ -378,6 +378,10 @@ int htpufs_list(htpuFS fs, const char *path, char ***names_out,
   }
   int cap = 16, n = 0;
   char **names = malloc(sizeof(char *) * cap);
+  if (!names) {
+    free(body);
+    return -1;
+  }
   const char *p = body;
   while ((p = strstr(p, "\"pathSuffix\"")) != NULL) {
     p = strchr(p, ':');
@@ -388,16 +392,26 @@ int htpufs_list(htpuFS fs, const char *path, char ***names_out,
     const char *end = strchr(p, '"');
     if (!end) break;
     if (n == cap) {
+      char **grown = realloc(names, sizeof(char *) * cap * 2);
+      if (!grown) goto oom;
+      names = grown;
       cap *= 2;
-      names = realloc(names, sizeof(char *) * cap);
     }
     names[n] = strndup(p, (size_t)(end - p));
+    if (!names[n]) goto oom;
     n++;
     p = end + 1;
   }
   free(body);
   *names_out = names;
   *n_out = n;
+  if (0) {
+  oom:
+    for (int i = 0; i < n; i++) free(names[i]);
+    free(names);
+    free(body);
+    return -1;
+  }
   return 0;
 }
 

@@ -302,7 +302,7 @@ static PyObject *dec_obj(dec_t *d, int depth) {
     case 0xC5: {
       uint64_t n;
       if (dec_uvarint(d, &n)) return NULL;
-      if (d->p + (Py_ssize_t)n > d->len) goto truncated;
+      if (n > (uint64_t)(d->len - d->p)) goto truncated;
       PyObject *s =
           PyUnicode_DecodeUTF8((const char *)d->d + d->p, n, NULL);
       d->p += n;
@@ -311,7 +311,7 @@ static PyObject *dec_obj(dec_t *d, int depth) {
     case 0xC4: {
       uint64_t n;
       if (dec_uvarint(d, &n)) return NULL;
-      if (d->p + (Py_ssize_t)n > d->len) goto truncated;
+      if (n > (uint64_t)(d->len - d->p)) goto truncated;
       PyObject *b =
           PyBytes_FromStringAndSize((const char *)d->d + d->p, n);
       d->p += n;
@@ -320,7 +320,7 @@ static PyObject *dec_obj(dec_t *d, int depth) {
     case 0xC8: {
       uint64_t n;
       if (dec_uvarint(d, &n)) return NULL;
-      if ((Py_ssize_t)n > d->len - d->p) goto truncated; /* sanity */
+      if (n > (uint64_t)(d->len - d->p)) goto truncated; /* sanity */
       PyObject *lst = PyList_New((Py_ssize_t)n);
       if (!lst) return NULL;
       for (Py_ssize_t i = 0; i < (Py_ssize_t)n; i++) {
@@ -373,6 +373,11 @@ static PyObject *py_unpack_with_offset(PyObject *self, PyObject *args) {
   Py_buffer view;
   Py_ssize_t offset = 0;
   if (!PyArg_ParseTuple(args, "y*|n", &view, &offset)) return NULL;
+  if (offset < 0 || offset > view.len) {
+    PyBuffer_Release(&view);
+    PyErr_SetString(WireError, "offset out of range");
+    return NULL;
+  }
   dec_t d = {(const uint8_t *)view.buf, view.len, offset};
   PyObject *obj = dec_obj(&d, 0);
   PyBuffer_Release(&view);
@@ -386,6 +391,11 @@ static PyObject *py_unpack(PyObject *self, PyObject *args) {
   Py_buffer view;
   Py_ssize_t offset = 0;
   if (!PyArg_ParseTuple(args, "y*|n", &view, &offset)) return NULL;
+  if (offset < 0 || offset > view.len) {
+    PyBuffer_Release(&view);
+    PyErr_SetString(WireError, "offset out of range");
+    return NULL;
+  }
   dec_t d = {(const uint8_t *)view.buf, view.len, offset};
   PyObject *obj = dec_obj(&d, 0);
   PyBuffer_Release(&view);

@@ -142,3 +142,57 @@ def test_c_codec_bigint_and_object_fallback():
         wire.unpack(b"\xca")
     with pytest.raises(wire.WireError):
         wire.pack({1: "non-str key"})
+
+
+def test_c_decoder_hostile_lengths_and_offsets():
+    """Hostile framing must fail as WireError, never escape as
+    SystemError/OOB (review findings: signed-overflow length checks,
+    negative offsets)."""
+    import struct
+
+    import pytest as _p
+
+    from hadoop_tpu.io.wire import WireError, pack, unpack
+
+    # bin frame claiming a 2^62-byte payload
+    evil = b"\xc4" + b"\xff\xff\xff\xff\xff\xff\xff\xff\x3f"
+    with _p.raises((WireError, OverflowError)):
+        unpack(evil)
+    # str frame with a >=2^63 length (negative after a signed cast)
+    evil2 = b"\xc5" + b"\xff\xff\xff\xff\xff\xff\xff\xff\xff\x01"
+    with _p.raises((WireError, OverflowError)):
+        unpack(evil2)
+    # negative offset must not read before the buffer
+    good = pack({"k": 1})
+    with _p.raises((WireError, OverflowError, ValueError)):
+        unpack(good, -16)
+
+
+def test_native_merge_rejects_hostile_segments():
+    """Crafted shuffle segments (valid CRC, hostile framing) must fail
+    the native k-way merge cleanly — not read past the heap (review
+    findings: uint32 klen+vlen wraparound; unbounded varints)."""
+    import struct
+
+    from hadoop_tpu import native as nat
+
+    if not nat.available():
+        import pytest as _pt
+        _pt.skip("native library unavailable")
+
+    def seg(body: bytes) -> bytes:
+        return body + struct.pack(">I", nat.crc32c(0, body))
+
+    import pytest as _pt
+
+    # varint klen 0xFFFFFFF0 + vlen 0x20 -> uint32 wrap passes p<=end
+    wrap = b"\xf0\xff\xff\xff\x0f" + b"\x20" + b"k" * 8 + \
+        b"\xff\xff\xff\xff"
+    with _pt.raises(IOError):
+        nat.merge_segments([seg(wrap)], raw=False)
+
+    # a valid record then trailing 0x80 continuation bytes (no EOF
+    # marker): the varint reader must stop at the segment end
+    cont = b"\x01\x01kv" + b"\x80\x80\x80"
+    with _pt.raises(IOError):
+        nat.merge_segments([seg(cont)], raw=False)
