@@ -58,7 +58,15 @@ class DFSAdmin:
             return 1
         try:
             return handler(argv[1:]) or 0
-        except (IndexError, KeyError):
+        except (IndexError, KeyError) as e:
+            # only an EMPTY argv slice is an argument error here — a
+            # KeyError from deep in the client/wire path must surface,
+            # not masquerade as bad CLI usage
+            import traceback
+            tb = traceback.extract_tb(e.__traceback__)
+            if any("hadoop_tpu/cli/" not in (fr.filename or "")
+                   for fr in tb[1:]):
+                raise
             self._print(f"dfsadmin -{cmd}: missing or malformed arguments")
             return 1
         except (OSError, ValueError) as e:

@@ -33,7 +33,18 @@ class JoinMapper(Mapper):
     def tag_of(self, ctx: TaskContext) -> bytes:
         path = getattr(getattr(ctx, "split", None), "path", "") or \
             ctx.conf.get("datajoin.tag", "src")
-        return path.rsplit("/", 1)[-1].encode()
+        base = path.rsplit("/", 1)[-1]
+        # the documented per-file override FIRST (two directory inputs
+        # commonly share part-file basenames — tagging by basename alone
+        # would collapse both sources and the inner join would silently
+        # emit nothing)
+        mapped = ctx.conf.get(f"datajoin.tag.{base}")
+        if mapped:
+            return mapped.encode()
+        parent = path.rsplit("/", 2)[-2] if path.count("/") >= 2 else ""
+        if base.startswith("part-") and parent:
+            return parent.encode()  # source dir distinguishes the inputs
+        return base.encode()
 
     def join_key(self, key: bytes, value: bytes) -> bytes:
         return value.split(b"\t", 1)[0]

@@ -80,9 +80,18 @@ class DfsFuseDriver(CsiDriver):
                             options: Dict) -> None:
         host, port = self._parse(volume_id)
         os.makedirs(target_path, exist_ok=True)
-        proc = subprocess.Popen(
-            [self.binary, host, str(port), target_path, "-f"],
-            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        # stderr goes to a FILE, never a pipe: the daemon is long-lived
+        # and nothing drains a pipe after publish — ~64KB of warnings
+        # would block its next stderr write inside a FUSE handler and
+        # hang the mounted volume for every reader
+        errlog_path = target_path.rstrip("/") + ".fuse.log"
+        errlog = open(errlog_path, "wb")
+        try:
+            proc = subprocess.Popen(
+                [self.binary, host, str(port), target_path, "-f"],
+                stdout=subprocess.DEVNULL, stderr=errlog)
+        finally:
+            errlog.close()  # the child holds its own fd
         deadline = time.monotonic() + 10.0
         while time.monotonic() < deadline:
             if os.path.ismount(target_path):
@@ -90,7 +99,11 @@ class DfsFuseDriver(CsiDriver):
                     self._procs[target_path] = proc
                 return
             if proc.poll() is not None:
-                err = (proc.stderr.read() or b"").decode()[-300:]
+                try:
+                    with open(errlog_path, "rb") as f:
+                        err = f.read().decode()[-300:]
+                except OSError:
+                    err = ""
                 raise IOError(f"fuse mount of {volume_id} failed: {err}")
             time.sleep(0.1)
         proc.terminate()

@@ -318,3 +318,32 @@ def test_reference_trace_dialects_convert_and_replay():
                  ticks=200, trace=trace)
     assert report["containers_allocated"] == \
         sum(j["containers"] for j in trace.jobs)
+
+
+def test_datajoin_same_basename_directory_inputs(tmp_path):
+    """Two DIRECTORY inputs whose part files share basenames must join
+    as distinct sources (review finding: basename-only tags collapsed
+    both sides and the inner join silently emitted nothing)."""
+    from hadoop_tpu.mapreduce import Job
+    from hadoop_tpu.mapreduce.api import class_ref
+    from hadoop_tpu.testing.minicluster import MiniMRYarnCluster
+    from hadoop_tpu.tools.datajoin import JoinMapper, JoinReducer
+
+    with MiniMRYarnCluster(num_nodes=1,
+                           base_dir=str(tmp_path)) as cluster:
+        fs = cluster.get_filesystem()
+        fs.mkdirs("/a")
+        fs.mkdirs("/b")
+        fs.write_all("/a/part-00000", b"k1\tleftA\nk2\tleftB\n")
+        fs.write_all("/b/part-00000", b"k1\trightA\nk3\trightC\n")
+        job = (Job(cluster.rm_addr, cluster.default_fs, name="dj2")
+               .set_mapper(class_ref(JoinMapper))
+               .set_reducer(class_ref(JoinReducer))
+               .add_input_path("/a")
+               .add_input_path("/b")
+               .set_output_path("/j2-out")
+               .set_num_reduces(1))
+        assert job.wait_for_completion()
+        out = b"".join(fs.read_all(p) for p in fs.glob("/j2-out/part-*"))
+        assert b"leftA" in out and b"rightA" in out, out
+        assert b"leftB" not in out  # unmatched key drops (inner join)
