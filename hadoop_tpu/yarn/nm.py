@@ -244,7 +244,9 @@ class NodeAgent(AbstractService):
             from hadoop_tpu.yarn.timeline import TimelineCollectorManager
             self.timeline = TimelineCollectorManager(
                 conf.get("yarn.timeline-service.store.dir",
-                         os.path.join(self.work_root, "timeline")))
+                         os.path.join(self.work_root, "timeline")),
+                backend=conf.get(
+                    "yarn.timeline-service.store.backend", "auto"))
 
     def service_start(self) -> None:
         for aux in self.aux_services:

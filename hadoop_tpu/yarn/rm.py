@@ -539,11 +539,11 @@ class ResourceManager(AbstractService):
         self.state_store = FileRMStateStore(self.state_dir)
         # App lifecycle → timeline store (ref: SystemMetricsPublisher;
         # serving side: yarn/timeline.py ApplicationHistoryServer)
-        from hadoop_tpu.yarn.timeline import (TimelinePublisher,
-                                              TimelineStore)
-        self.timeline = TimelinePublisher(TimelineStore(
+        from hadoop_tpu.yarn.timeline import TimelinePublisher, make_store
+        self.timeline = TimelinePublisher(make_store(
             conf.get("yarn.timeline-service.store-dir",
-                     os.path.join(self.state_dir, "timeline"))))
+                     os.path.join(self.state_dir, "timeline")),
+            conf.get("yarn.timeline-service.store.backend", "auto")))
         self.rpc: Optional[Server] = None
         self._stop_event = threading.Event()
         self._nm_client = Client(conf)
@@ -620,6 +620,7 @@ class ResourceManager(AbstractService):
             self.rpc.stop()
         self.dispatcher.stop()
         self._nm_client.stop()
+        self.timeline.close()
 
     def _recover(self) -> None:
         """App recovery on restart. WORK-PRESERVING (default; ref:

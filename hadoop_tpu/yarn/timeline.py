@@ -15,6 +15,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import sqlite3
 import threading
 import time
 from typing import Dict, List, Optional
@@ -39,11 +40,26 @@ class TimelineStore:
 
     def put_event(self, entity_type: str, entity_id: str, event: str,
                   **info) -> None:
-        rec = {"type": entity_type, "id": entity_id, "event": event,
-               "ts": time.time(), "info": info}
+        self.put_events([(entity_type, entity_id, event, info)])
+
+    def put_events(self, batch) -> None:
+        """Append many (type, id, event, info) records in one write —
+        the batch API the NM collectors flush through. One unbuffered
+        O_APPEND write(2) for the whole batch: buffered text IO would
+        split a >8 KB batch across syscalls, letting another process's
+        append land mid-record (RM publisher and NM collectors may
+        share one store file)."""
+        now = time.time()
+        data = "".join(
+            json.dumps({"type": t, "id": i, "event": e, "ts": now,
+                        "info": info}) + "\n"
+            for t, i, e, info in batch).encode()
         with self._lock:
-            with open(self._path, "a") as f:
-                f.write(json.dumps(rec) + "\n")
+            with open(self._path, "ab", buffering=0) as f:
+                f.write(data)
+
+    def close(self) -> None:  # symmetry with SqliteTimelineStore
+        pass
 
     def events(self, entity_type: Optional[str] = None,
                entity_id: Optional[str] = None) -> List[Dict]:
@@ -77,12 +93,156 @@ class TimelineStore:
         return ents
 
 
+class SqliteTimelineStore:
+    """Indexed persistent store — the external-DB backend analog (ref:
+    ATSv2's HBase / v1's leveldb timeline stores: the reference keeps
+    timeline data in an indexed store precisely so reads don't scan the
+    full event history). Same contract as TimelineStore, but
+    (type, id)-indexed queries instead of a full-file fold, and WAL mode
+    so a reader daemon in another process sees a writer's events live.
+    """
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self._path = os.path.join(directory, "timeline.db")
+        self._lock = threading.Lock()
+        self._conn = sqlite3.connect(self._path,
+                                     check_same_thread=False)
+        with self._lock:
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS events("
+                " seq INTEGER PRIMARY KEY AUTOINCREMENT,"
+                " type TEXT NOT NULL, id TEXT NOT NULL,"
+                " event TEXT NOT NULL, ts REAL NOT NULL,"
+                " info TEXT NOT NULL)")
+            self._conn.execute(
+                "CREATE INDEX IF NOT EXISTS idx_events_type_id"
+                " ON events(type, id)")
+            self._conn.commit()
+
+    def put_event(self, entity_type: str, entity_id: str, event: str,
+                  **info) -> None:
+        self.put_events([(entity_type, entity_id, event, info)])
+
+    def put_events(self, batch) -> None:
+        """One transaction per batch: a 32-event collector flush costs
+        one commit, not 32."""
+        now = time.time()
+        rows = [(t, i, e, now, json.dumps(info))
+                for t, i, e, info in batch]
+        with self._lock:
+            self._conn.executemany(
+                "INSERT INTO events(type, id, event, ts, info)"
+                " VALUES(?,?,?,?,?)", rows)
+            self._conn.commit()
+
+    def events(self, entity_type: Optional[str] = None,
+               entity_id: Optional[str] = None) -> List[Dict]:
+        sql = "SELECT type, id, event, ts, info FROM events"
+        clauses, params = [], []
+        if entity_type:
+            clauses.append("type = ?")
+            params.append(entity_type)
+        if entity_id:
+            clauses.append("id = ?")
+            params.append(entity_id)
+        if clauses:
+            sql += " WHERE " + " AND ".join(clauses)
+        sql += " ORDER BY seq"
+        with self._lock:
+            rows = self._conn.execute(sql, params).fetchall()
+        return [{"type": t, "id": i, "event": e, "ts": ts,
+                 "info": json.loads(info)}
+                for t, i, e, ts, info in rows]
+
+    # identical fold to TimelineStore, but over an indexed scan
+    entities = TimelineStore.entities
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+def make_store(directory: str, backend: str = "auto"):
+    """Store factory. backend: "jsonl" | "sqlite" | "auto". Auto honors
+    whatever already lives in the directory (a reader must open the
+    format the writer chose); empty directories default to jsonl, the
+    reference's FileSystem-store-like baseline.
+
+    NOTE for readers: auto-detection is a snapshot of the directory at
+    call time — a reader that may start before the writer's first event
+    must re-resolve per query (see FlowRunAggregator), not bind once.
+    """
+    if backend == "auto":
+        has_db = os.path.exists(os.path.join(directory, "timeline.db"))
+        has_jl = os.path.exists(
+            os.path.join(directory, "timeline.jsonl"))
+        if has_db and has_jl:
+            log.warning(
+                "timeline dir %s holds BOTH timeline.db and "
+                "timeline.jsonl (a backend switch without migration?); "
+                "reading the sqlite store — jsonl history is invisible "
+                "until migrated", directory)
+        backend = "sqlite" if has_db else "jsonl"
+    if backend == "sqlite":
+        return SqliteTimelineStore(directory)
+    if backend == "jsonl":
+        return TimelineStore(directory)
+    raise ValueError(f"unknown timeline store backend: {backend!r}")
+
+
+class _AutoStoreView:
+    """Read-side store handle that defers backend detection until the
+    writer's file actually exists: a reader daemon brought up against a
+    still-empty directory must not bind the jsonl default forever while
+    the writer goes on to create timeline.db. Resolution is retried per
+    query until a concrete store file is seen, then cached (so sqlite
+    readers reuse one WAL connection)."""
+
+    def __init__(self, directory: str, backend: str = "auto"):
+        self.dir = directory
+        self._backend = backend
+        self._bound = None
+        self._resolve_lock = threading.Lock()
+
+    def _resolve(self):
+        # Locked: handler threads share one view, and two racing first
+        # queries must not each open (and one leak) a store connection.
+        with self._resolve_lock:
+            if self._bound is not None:
+                return self._bound
+            st = make_store(self.dir, self._backend)
+            # Bind only when the file matching the RESOLVED store's own
+            # format exists — checking for "any store file" would race a
+            # writer creating timeline.db between our detection snapshot
+            # and this check, caching the jsonl default forever.
+            if self._backend != "auto" or os.path.exists(st._path):
+                self._bound = st
+            return st
+
+    def events(self, *args, **kwargs):
+        return self._resolve().events(*args, **kwargs)
+
+    def entities(self, *args, **kwargs):
+        return self._resolve().entities(*args, **kwargs)
+
+    def close(self) -> None:
+        if self._bound is not None:
+            self._bound.close()
+            self._bound = None
+
+
 class TimelinePublisher:
     """RM-side publisher (ref: SystemMetricsPublisher — the RM component
     that forwards app/attempt transitions into the timeline)."""
 
     def __init__(self, store: TimelineStore):
         self.store = store
+
+    def close(self) -> None:
+        self.store.close()
 
     def app_submitted(self, app_id: str, name: str, user: str,
                       queue: str) -> None:
@@ -105,7 +265,8 @@ class ApplicationHistoryServer(AbstractService):
 
     def __init__(self, conf: Configuration, store_dir: str):
         super().__init__("ApplicationHistoryServer")
-        self.store = TimelineStore(store_dir)
+        self.store = _AutoStoreView(store_dir, conf.get(
+            "yarn.timeline-service.store.backend", "auto"))
         self.http: Optional[HttpServer] = None
 
     def service_init(self, conf: Configuration) -> None:
@@ -122,6 +283,7 @@ class ApplicationHistoryServer(AbstractService):
     def service_stop(self) -> None:
         if self.http:
             self.http.stop()
+        self.store.close()
 
     @property
     def port(self) -> int:
@@ -173,9 +335,10 @@ class AppLevelTimelineCollector:
                 self._flush_locked()
 
     def _flush_locked(self) -> None:
-        for rec in self._buf:
-            self.store.put_event(rec["type"], rec["id"], rec["event"],
-                                 **rec["info"])
+        if self._buf:
+            self.store.put_events([
+                (rec["type"], rec["id"], rec["event"], rec["info"])
+                for rec in self._buf])
         self._buf = []
 
     def flush(self) -> None:
@@ -200,8 +363,8 @@ class TimelineCollectorManager:
     a collector exists per app from its first container's start on this
     node until the RM reports the app finished."""
 
-    def __init__(self, store_dir: str):
-        self.store = TimelineStore(store_dir)
+    def __init__(self, store_dir: str, backend: str = "auto"):
+        self.store = make_store(store_dir, backend)
         self._collectors: Dict[str, AppLevelTimelineCollector] = {}
         self._lock = threading.Lock()
 
@@ -273,6 +436,7 @@ class TimelineCollectorManager:
             self._collectors.clear()
         for c in cs:
             c.stop()
+        self.store.close()
 
 
 # ------------------------------------------------------------- ATSv2 reader
@@ -290,8 +454,8 @@ class FlowRunAggregator:
     pipeline cost per day".
     """
 
-    def __init__(self, store_dirs: List[str]):
-        self.stores = [TimelineStore(d) for d in store_dirs]
+    def __init__(self, store_dirs: List[str], backend: str = "auto"):
+        self.stores = [_AutoStoreView(d, backend) for d in store_dirs]
 
     def _all_events(self) -> List[Dict]:
         out: List[Dict] = []
@@ -357,7 +521,8 @@ class TimelineReaderServer(AbstractService):
 
     def __init__(self, conf: Configuration, store_dirs: List[str]):
         super().__init__("TimelineReaderServer")
-        self.aggregator = FlowRunAggregator(store_dirs)
+        self.aggregator = FlowRunAggregator(store_dirs, conf.get(
+            "yarn.timeline-service.store.backend", "auto"))
         self.http: Optional[HttpServer] = None
 
     def service_init(self, conf: Configuration) -> None:
@@ -374,6 +539,8 @@ class TimelineReaderServer(AbstractService):
     def service_stop(self) -> None:
         if self.http:
             self.http.stop()
+        for st in self.aggregator.stores:
+            st.close()
 
     @property
     def port(self) -> int:

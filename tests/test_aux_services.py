@@ -438,3 +438,84 @@ def test_timeline_v2_per_app_collectors(tmp_path):
             assert any(e["event"] == "FINISHED" for e in mine)
         finally:
             yc.close()
+
+
+# ------------------------------------------------------ timeline store backends
+
+def test_sqlite_timeline_store_contract_parity(tmp_path):
+    """The sqlite backend (external-DB analog, ref: ATSv2 HBase / v1
+    leveldb timeline stores) answers every query identically to the
+    JSONL baseline — same events, same order, same entity fold."""
+    from hadoop_tpu.yarn.timeline import SqliteTimelineStore, TimelineStore
+
+    a = TimelineStore(str(tmp_path / "jl"))
+    b = SqliteTimelineStore(str(tmp_path / "sq"))
+    for st in (a, b):
+        st.put_event("YARN_APPLICATION", "app_1", "SUBMITTED",
+                     name="etl", user="u")
+        st.put_event("YARN_CONTAINER", "c_1", "CREATED", app_id="app_1")
+        st.put_event("YARN_CONTAINER", "c_1", "FINISHED",
+                     app_id="app_1", mb_seconds=12.5)
+        st.put_event("YARN_APPLICATION", "app_1", "FINISHED",
+                     state="FINISHED", diagnostics="")
+
+    def strip_ts(recs):
+        return [{k: v for k, v in r.items() if k != "ts"} for r in recs]
+
+    assert strip_ts(a.events()) == strip_ts(b.events())
+    assert strip_ts(a.events("YARN_CONTAINER")) == \
+        strip_ts(b.events("YARN_CONTAINER"))
+    assert strip_ts(a.events("YARN_CONTAINER", "c_1")) == \
+        strip_ts(b.events("YARN_CONTAINER", "c_1"))
+    assert a.events("YARN_CONTAINER", "absent") == \
+        b.events("YARN_CONTAINER", "absent") == []
+    assert a.entities("YARN_APPLICATION") == b.entities("YARN_APPLICATION")
+
+
+def test_sqlite_timeline_store_cross_connection_visibility(tmp_path):
+    """WAL mode: a second, independently-opened store on the same
+    directory (the reader daemon's view) sees the writer's events —
+    including ones written after the reader opened."""
+    from hadoop_tpu.yarn.timeline import SqliteTimelineStore
+
+    writer = SqliteTimelineStore(str(tmp_path))
+    writer.put_event("T", "e1", "ONE")
+    reader = SqliteTimelineStore(str(tmp_path))
+    assert [r["event"] for r in reader.events("T", "e1")] == ["ONE"]
+    writer.put_event("T", "e1", "TWO")  # after the reader opened
+    assert [r["event"] for r in reader.events("T", "e1")] == ["ONE", "TWO"]
+    reader.close()
+    writer.close()
+
+
+def test_timeline_store_auto_detection(tmp_path):
+    """make_store("auto") must open whatever format the writer left on
+    disk — a reader pointed at a sqlite store must not silently return
+    zero events through a jsonl lens (and vice versa)."""
+    from hadoop_tpu.yarn.timeline import (SqliteTimelineStore,
+                                          TimelineStore, make_store)
+
+    sq_dir, jl_dir, empty = (str(tmp_path / d) for d in ("s", "j", "e"))
+    SqliteTimelineStore(sq_dir).put_event("T", "x", "E")
+    TimelineStore(jl_dir).put_event("T", "x", "E")
+    assert isinstance(make_store(sq_dir, "auto"), SqliteTimelineStore)
+    assert isinstance(make_store(jl_dir, "auto"), TimelineStore)
+    assert isinstance(make_store(empty, "auto"), TimelineStore)
+    assert [r["event"] for r in make_store(sq_dir, "auto").events()] == ["E"]
+    with pytest.raises(ValueError):
+        make_store(str(tmp_path / "z"), "leveldb")
+
+
+def test_reader_opened_before_writer_binds_late(tmp_path):
+    """A reader brought up against a still-empty store directory must
+    not bind the jsonl default forever: once the writer creates the
+    sqlite store, the reader's next query sees it."""
+    from hadoop_tpu.yarn.timeline import SqliteTimelineStore, _AutoStoreView
+
+    view = _AutoStoreView(str(tmp_path))   # directory exists, no store yet
+    assert view.events() == []
+    writer = SqliteTimelineStore(str(tmp_path))
+    writer.put_event("T", "x", "E")
+    assert [r["event"] for r in view.events()] == ["E"]
+    view.close()
+    writer.close()
