@@ -235,3 +235,21 @@ def test_incomplete_checkpoint_is_invisible_and_swept(fs, token_file):
     t2.step = 11
     t2.save()  # retention sweep removes the orphan
     assert not fs.exists("/ckpt-crash/step_000000000009")
+
+
+def test_mid_run_interval_checkpoint_resumes_exactly(fs, token_file):
+    """A checkpoint taken INSIDE train() (interval save) while the
+    prefetch thread has read ahead must record the cursor of the last
+    consumed batch, not the dataset's advanced position — resume from it
+    continues the reference loss curve exactly."""
+    ref = _trainer(fs, token_file, "/ckpt/mid-ref")
+    ref_losses = ref.train(6)
+
+    a = _trainer(fs, token_file, "/ckpt/mid", interval=3)
+    a.train(4)  # interval save fires at step 3 with a batch in flight
+
+    b = _trainer(fs, token_file, "/ckpt/mid")
+    assert b.try_restore()
+    assert b.step == 3
+    b_losses = b.train(3)
+    np.testing.assert_allclose(b_losses, ref_losses[3:], rtol=1e-6)
