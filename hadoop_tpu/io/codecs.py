@@ -22,6 +22,14 @@ import zlib
 from typing import Callable, Dict, List, Optional
 
 
+# Ceiling on a single decompressed blob. The framework's block streams
+# compress 256 KB blocks, so any header claiming gigabytes is corrupt
+# (or hostile) data — without this cap a 12-byte blob whose size word
+# says 4 GB makes the decompressor allocate 4 GB before the payload is
+# even looked at.
+MAX_DECOMPRESSED = 1 << 30
+
+
 class CompressionCodec:
     """One codec: name, extension, one-shot + streaming compression."""
 
@@ -126,6 +134,23 @@ class _BlockDecompressorStream:
 # ----------------------------------------------------------- stdlib codecs
 
 
+def _bounded(decompressor, data: bytes, codec_name: str) -> bytes:
+    """Drive a stdlib incremental decompressor with a max_length bound
+    so a compression bomb raises instead of allocating its claimed
+    size (the native codecs reject via their headers; the stdlib
+    one-shot functions have no bound at all). A single complete stream
+    is expected — the block streams compress one blob per block — so a
+    decompressor that isn't at EOF afterwards means either the bound
+    was hit (bomb) or the stream is truncated; both are errors."""
+    out = decompressor.decompress(data, MAX_DECOMPRESSED)
+    if not decompressor.eof:
+        if len(out) >= MAX_DECOMPRESSED:
+            raise IOError(f"{codec_name} stream exceeds "
+                          f"{MAX_DECOMPRESSED}B decompressed — refusing")
+        raise IOError(f"truncated {codec_name} stream")
+    return out
+
+
 class ZlibCodec(CompressionCodec):
     name, extension = "zlib", ".deflate"
 
@@ -133,7 +158,7 @@ class ZlibCodec(CompressionCodec):
         return zlib.compress(data, 6)
 
     def decompress(self, data):
-        return zlib.decompress(data)
+        return _bounded(zlib.decompressobj(), data, "zlib")
 
 
 class GzipCodec(CompressionCodec):
@@ -143,7 +168,7 @@ class GzipCodec(CompressionCodec):
         return gzip.compress(data, 6)
 
     def decompress(self, data):
-        return gzip.decompress(data)
+        return _bounded(zlib.decompressobj(wbits=31), data, "gzip")
 
 
 class Bzip2Codec(CompressionCodec):
@@ -153,7 +178,7 @@ class Bzip2Codec(CompressionCodec):
         return bz2.compress(data)
 
     def decompress(self, data):
-        return bz2.decompress(data)
+        return _bounded(bz2.BZ2Decompressor(), data, "bzip2")
 
 
 class LzmaCodec(CompressionCodec):
@@ -163,7 +188,7 @@ class LzmaCodec(CompressionCodec):
         return lzma.compress(data)
 
     def decompress(self, data):
-        return lzma.decompress(data)
+        return _bounded(lzma.LZMADecompressor(), data, "lzma")
 
 
 # ------------------------------------------------------------ native zstd
@@ -208,6 +233,9 @@ class _NativeZstd:
         size = lib.ZSTD_getFrameContentSize(data, len(data))
         if size in (2**64 - 1, 2**64 - 2):  # ERROR / UNKNOWN
             raise IOError("zstd cannot determine frame size")
+        if size > MAX_DECOMPRESSED:
+            raise IOError(f"zstd frame claims {int(size)}B "
+                          f"(> {MAX_DECOMPRESSED}B cap) — corrupt frame")
         out = ctypes.create_string_buffer(max(int(size), 1))
         n = lib.ZSTD_decompress(out, max(int(size), 1), data, len(data))
         if lib.ZSTD_isError(n):
@@ -279,6 +307,11 @@ class _NativeLz4:
         if len(data) < 4:
             raise IOError("truncated lz4 blob")
         (orig,) = struct.unpack_from("<I", data)
+        # LZ4's format can't expand beyond ~255x; a size word past that
+        # (or past the global cap) is a corrupt header, not a big block
+        if orig > min(MAX_DECOMPRESSED, 255 * (len(data) - 4) + 64):
+            raise IOError(f"lz4 size word {orig}B exceeds the possible "
+                          "expansion of the payload — corrupt blob")
         out = ctypes.create_string_buffer(max(orig, 1))
         n = self._lib.LZ4_decompress_safe(data[4:], out, len(data) - 4,
                                           max(orig, 1))
@@ -355,6 +388,9 @@ class _NativeSnappy:
         if lib.snappy_uncompressed_length(data, len(data),
                                           ctypes.byref(orig)) != 0:
             raise IOError("snappy: cannot determine length")
+        if orig.value > MAX_DECOMPRESSED:
+            raise IOError(f"snappy header claims {orig.value}B "
+                          f"(> {MAX_DECOMPRESSED}B cap) — corrupt blob")
         out = ctypes.create_string_buffer(max(orig.value, 1))
         n = ctypes.c_size_t(orig.value)
         rc = lib.snappy_uncompress(data, len(data), out, ctypes.byref(n))

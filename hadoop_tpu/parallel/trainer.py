@@ -167,6 +167,20 @@ class Trainer:
         checkpoint cursor tracks the last batch a completed step
         consumed — so a mid-run save resumes bit-exactly even with
         batches in flight."""
+        zombie = getattr(self, "_zombie_producer", None)
+        if zombie is not None:
+            if zombie.is_alive():
+                raise RuntimeError(
+                    "a previous train()'s prefetch thread is still "
+                    "stuck in a dataset read; the dataset cannot be "
+                    "shared with a new run")
+            self._zombie_producer = None
+            if self._inflight_cursor is not None:
+                # the stuck thread has since died: rewind to the
+                # consumed position it left unrestored
+                if self.data.state() != self._inflight_cursor:
+                    self.data.restore(self._inflight_cursor)
+                self._inflight_cursor = None
         out: list = []
         pending: deque = deque()   # device-side loss scalars, oldest first
         q: queue.Queue = queue.Queue(maxsize=2)
@@ -221,20 +235,29 @@ class Trainer:
                 if self.ckpt_interval and \
                         self.step % self.ckpt_interval == 0:
                     self.save()
-            while pending:
-                val = float(pending.popleft())
-                out.append(val)
-                self.losses.append(val)
+            pass
         finally:
             abort.set()
+            # Drain completed steps' losses even when a step raised —
+            # self.losses must not end up behind self.step by up to
+            # MAX_INFLIGHT entries.
+            while pending:
+                try:
+                    val = float(pending.popleft())
+                except Exception:  # noqa: BLE001 — a failed step's loss
+                    break
+                out.append(val)
+                self.losses.append(val)
             producer.join(timeout=10.0)
             if producer.is_alive():
                 # Pathological: producer stuck (e.g. a hung DFS read)
                 # past its abort checks. It still owns self.data, so
                 # don't rewind under it — keep the in-flight cursor so
-                # a later save() records the consumed position.
+                # a later save() records the consumed position, and
+                # make the next train() refuse until the thread dies.
                 log.warning("prefetch thread did not exit within 10s; "
                             "keeping the in-flight data cursor")
+                self._zombie_producer = producer
             elif self._inflight_cursor is not None:
                 # Rewind the dataset's own cursor to the consumed
                 # position so save()/state() outside train() agree with

@@ -161,7 +161,11 @@ class RegistryClient:
         self._client = Client(self.conf)
         self._proxy = get_proxy("RegistryProtocol", addr,
                                 client=self._client)
-        self._renewals: Dict[str, float] = {}
+        # path → (record, ttl): the record is kept so a renewal that
+        # finds it GONE (registry restarted and lost its ephemeral
+        # state) can re-register it — the analog of ZK clients
+        # recreating ephemeral znodes on session re-establishment.
+        self._renewals: Dict[str, tuple] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -169,7 +173,7 @@ class RegistryClient:
                  auto_renew: bool = True) -> None:
         self._proxy.register(record.to_wire(), ttl_s)
         if auto_renew and record.ephemeral:
-            self._renewals[record.path] = ttl_s
+            self._renewals[record.path] = (record, ttl_s)
             if self._thread is None:
                 self._thread = threading.Thread(
                     target=self._renew_loop, daemon=True,
@@ -194,9 +198,24 @@ class RegistryClient:
 
     def _renew_loop(self) -> None:
         while not self._stop.wait(min(
-                [t / 3 for t in self._renewals.values()] or [1.0])):
-            for path, ttl in list(self._renewals.items()):
-                try:
-                    self._proxy.renew(path, ttl)
-                except Exception as e:  # noqa: BLE001
-                    log.debug("registry renewal of %s failed: %s", path, e)
+                [t / 3 for _, t in self._renewals.values()] or [1.0])):
+            self._renew_once()
+
+    def _renew_once(self) -> None:
+        for path, (record, ttl) in list(self._renewals.items()):
+            try:
+                if not self._proxy.renew(path, ttl):
+                    if path not in self._renewals:
+                        continue  # unregistered while we renewed
+                    # Record vanished server-side (registry restart, or
+                    # an expiry that beat this renewal): recreate it so
+                    # the service stays resolvable.
+                    log.info("registry record %s lost; re-registering",
+                             path)
+                    self._proxy.register(record.to_wire(), ttl)
+                    if path not in self._renewals:
+                        # lost a race with unregister() mid-recreate —
+                        # compensate so the deliberate removal wins
+                        self._proxy.unregister(path)
+            except Exception as e:  # noqa: BLE001
+                log.debug("registry renewal of %s failed: %s", path, e)

@@ -159,6 +159,52 @@ def test_registry_register_resolve_expire():
         srv.stop()
 
 
+def test_registry_reregisters_after_server_state_loss():
+    """A renewal that finds its record gone (registry restarted and
+    lost ephemeral state, or the sweep beat a late renewal) must
+    RECREATE the record — the ZK-ephemeral-recreate analog. Before the
+    fix the client renewed into the void forever and the service
+    silently vanished from the registry."""
+    from hadoop_tpu.registry import (RegistryClient, RegistryServer,
+                                     ServiceRecord)
+    conf = Configuration(load_defaults=False)
+    srv = RegistryServer(conf)
+    srv.init(conf)
+    srv.start()
+    try:
+        c = RegistryClient(("127.0.0.1", srv.port), conf)
+        c.register(ServiceRecord("/services/am", {"rpc": "h:1"}),
+                   ttl_s=30.0)
+        assert c.resolve("/services/am") is not None
+        # simulate registry state loss
+        with srv._lock:
+            srv._entries.clear()
+        assert c.resolve("/services/am") is None
+        c._renew_once()
+        got = c.resolve("/services/am")
+        assert got is not None and got.endpoints["rpc"] == "h:1"
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_lz4_corrupt_size_word_rejected_without_allocation():
+    """An lz4 blob whose size prefix claims gigabytes must be rejected
+    as corrupt, not allocated (a 12-byte hostile blob could otherwise
+    demand a 4 GB buffer before decompression even starts)."""
+    import struct as _struct
+
+    from hadoop_tpu.io.codecs import Lz4Codec
+    if not Lz4Codec.available():
+        pytest.skip("liblz4 not present")
+    codec = Lz4Codec()
+    rt = codec.decompress(codec.compress(b"payload" * 100))
+    assert rt == b"payload" * 100
+    evil = _struct.pack("<I", 0xFFFFFFF0) + b"\x00" * 8
+    with pytest.raises(IOError):
+        codec.decompress(evil)
+
+
 # ----------------------------------------------------------- disk checker
 
 

@@ -217,3 +217,18 @@ def test_sequencefile_reader_survives_short_reads(tmp_path):
 
     rd = Reader(_DribbleStream(data, k=7))
     assert list(rd) == recs
+
+
+def test_stdlib_codec_truncation_rejected():
+    """The bounded decompress path must reject a truncated stream (the
+    old one-shot functions raised; silently returning a partial block
+    would corrupt reads)."""
+    import pytest
+
+    from hadoop_tpu.io.codecs import Bzip2Codec, GzipCodec, ZlibCodec
+
+    for codec in (ZlibCodec(), GzipCodec(), Bzip2Codec()):
+        blob = codec.compress(b"x" * 50_000)
+        assert codec.decompress(blob) == b"x" * 50_000
+        with pytest.raises(IOError):
+            codec.decompress(blob[: len(blob) // 2])
