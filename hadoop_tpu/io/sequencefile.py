@@ -23,7 +23,7 @@ import os
 import struct
 from typing import Dict, Iterator, List, Optional, Tuple
 
-from hadoop_tpu.io.codecs import CodecFactory
+from hadoop_tpu.io.codecs import MAX_DECOMPRESSED, CodecFactory
 from hadoop_tpu.io.wire import pack, unpack, unpack_with_offset
 
 MAGIC = b"HTSF"
@@ -78,6 +78,10 @@ class Writer:
 
     def append(self, key: bytes, value: bytes) -> None:
         if self.compression == BLOCK:
+            if len(key) + len(value) > MAX_DECOMPRESSED:
+                # same per-entry bound as the record layout
+                raise ValueError(f"entry exceeds the {MAX_DECOMPRESSED}B "
+                                 "record limit")
             self._block.append((key, value))
             self._block_bytes += len(key) + len(value)
             if self._block_bytes >= self._block_size:
@@ -87,6 +91,12 @@ class Writer:
             value = self._codec.compress(value)
         self._maybe_sync()
         rec_len = 4 + len(key) + len(value)
+        if rec_len - 4 > MAX_DECOMPRESSED:
+            # same bound the Reader enforces (and far below the u32
+            # framing ceiling where the length word would collide with
+            # the sync escape) — never write what can't be read back
+            raise ValueError(f"record of {rec_len}B exceeds the "
+                             f"{MAX_DECOMPRESSED}B record limit")
         self._w(struct.pack(">II", rec_len, len(key)))
         self._w(key)
         self._w(value)
@@ -102,6 +112,15 @@ class Writer:
         payload = pack([len(self._block),
                         self._codec.compress(keys),
                         self._codec.compress(vals)])
+        if len(payload) > MAX_DECOMPRESSED:
+            # never emit a block the Reader's sanity cap would reject —
+            # the writer-side symmetry of that check (reachable only by
+            # configuring block_size near the cap with incompressible
+            # data; the buffered records are lost either way, but a
+            # clean error beats an unreadable file)
+            raise ValueError(
+                f"compressed block payload of {len(payload)}B exceeds "
+                f"the {MAX_DECOMPRESSED}B format cap — lower block_size")
         self._w(struct.pack(">I", SYNC_ESCAPE))
         self._w(self.sync)
         self._w(struct.pack(">I", len(payload)))
@@ -190,6 +209,11 @@ class Reader:
                     raise IOError("sync marker mismatch — corrupt file")
                 if self.compression == BLOCK:
                     (plen,) = struct.unpack(">I", self._read_exact(4))
+                    if plen > MAX_DECOMPRESSED:
+                        # corrupt length word: refuse before buffering it
+                        raise IOError(f"block of {plen}B exceeds the "
+                                      f"{MAX_DECOMPRESSED}B cap — "
+                                      "corrupt file")
                     count, keys_c, vals_c = unpack(self._read_exact(plen))
                     keys = self._split(self._codec.decompress(keys_c), count)
                     vals = self._split(self._codec.decompress(vals_c), count)
@@ -197,7 +221,14 @@ class Reader:
                     if self._block:
                         return self._block.pop(0)
                 continue
+            if n < 4 or n - 4 > MAX_DECOMPRESSED:
+                raise IOError(f"corrupt record length {n}")
             (klen,) = struct.unpack(">I", self._read_exact(4))
+            if klen > n - 4:
+                # a corrupt klen would make the value length negative
+                # and silently return buffer garbage as a record
+                raise IOError(f"corrupt key length {klen} in record "
+                              f"of {n}B")
             key = self._read_exact(klen)
             value = self._read_exact(n - 4 - klen)
             if self.compression == RECORD:

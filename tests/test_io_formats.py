@@ -232,3 +232,27 @@ def test_stdlib_codec_truncation_rejected():
         assert codec.decompress(blob) == b"x" * 50_000
         with pytest.raises(IOError):
             codec.decompress(blob[: len(blob) // 2])
+
+
+def test_sequencefile_corrupt_block_length_rejected():
+    """A corrupt BLOCK length word must be refused before the reader
+    tries to buffer it (a flipped bit could otherwise demand a 4 GB
+    read)."""
+    import io as _io
+    import struct as _struct
+
+    from hadoop_tpu.io import sequencefile as sf
+
+    buf = _io.BytesIO()
+    w = sf.Writer(buf, compression=sf.BLOCK, codec="zlib")
+    w.append(b"k", b"v")
+    w._flush_block()
+    data = bytearray(buf.getvalue())
+    # find the block's length word (follows the first post-header sync
+    # escape) and corrupt it to claim ~3 GB
+    idx = data.index(_struct.pack(">I", sf.SYNC_ESCAPE), 5)
+    plen_off = idx + 4 + 16
+    data[plen_off:plen_off + 4] = _struct.pack(">I", 3 << 30)
+    r = sf.Reader(_io.BytesIO(bytes(data)))
+    with pytest.raises(IOError, match="corrupt file"):
+        next(iter(r))
