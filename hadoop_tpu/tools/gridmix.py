@@ -221,23 +221,45 @@ def _make_load_job(Job, class_ref, rm_addr, default_fs, entry, idx,
 def run_trace(rm_addr, default_fs: str, trace: List[Dict], *,
               sleep_ms: int = 100, max_concurrent: int = 4,
               out_root: str = "/gridmix-out", mode: str = "auto",
-              cpu_fraction: float = 0.5) -> Dict:
+              cpu_fraction: float = 0.5, policy: str = "stress",
+              tick_seconds: float = 0.0) -> Dict:
     """Submit every trace entry as a real job; returns latency stats.
     Ref: Gridmix.run's JobSubmitter/JobMonitor pair (bounded in-flight
     jobs). ``mode``: "load" (emulate the rumen load model), "sleep",
     or "auto" (load when the entry carries one). ``cpu_fraction``:
     share of the traced task runtime modeled as compute (the rest was
-    IO/framework in the source job)."""
+    IO/framework in the source job).
+
+    ``policy`` mirrors the reference's job-submission policies (ref:
+    hadoop-gridmix GridmixJobSubmissionPolicy.{STRESS,REPLAY,SERIAL}):
+    "stress" keeps up to ``max_concurrent`` jobs in flight (greedy),
+    "replay" additionally holds each entry until its trace arrival
+    tick (× ``tick_seconds`` of real time per tick) so the original
+    inter-arrival gaps are reproduced, and "serial" submits one job at
+    a time, each after the previous completes."""
     from hadoop_tpu.mapreduce import Job
     from hadoop_tpu.mapreduce.api import class_ref
+    if policy not in ("stress", "replay", "serial"):
+        raise ValueError(f"unknown submission policy {policy!r}")
+    if policy == "replay" and tick_seconds <= 0:
+        # a zero tick makes every arrival due immediately — that's
+        # stress wearing a replay label, not a replay
+        raise ValueError("replay policy needs tick_seconds > 0")
+    if policy == "serial":
+        max_concurrent = 1
     pending = sorted(trace, key=lambda j: j.get("arrival", 0))
     inflight: List[Dict] = []
     latencies: List[float] = []
     failed = 0
+    peak_inflight = 0
     t0 = time.perf_counter()
     idx = 0
     while pending or inflight:
         while pending and len(inflight) < max_concurrent:
+            if policy == "replay":
+                due = t0 + pending[0].get("arrival", 0) * tick_seconds
+                if time.perf_counter() < due:
+                    break  # not yet arrived in trace time
             entry = pending.pop(0)
             # --mode load degrades per-entry: a trace without a load
             # model (pre-round-5 rumen output) replays as a sleep job
@@ -253,6 +275,7 @@ def run_trace(rm_addr, default_fs: str, trace: List[Dict], *,
             job.submit()
             inflight.append({"job": job, "start": time.perf_counter()})
             idx += 1
+            peak_inflight = max(peak_inflight, len(inflight))
         still = []
         for rec in inflight:
             try:
@@ -270,7 +293,8 @@ def run_trace(rm_addr, default_fs: str, trace: List[Dict], *,
     def pct(p):
         return round(lat[min(len(lat) - 1, int(p * len(lat)))], 3) \
             if lat else None
-    return {"jobs": idx, "failed": failed,
+    return {"jobs": idx, "failed": failed, "policy": policy,
+            "peak_inflight": peak_inflight,
             "wall_seconds": round(dt, 2),
             "job_latency_s": {"p50": pct(0.5), "p95": pct(0.95),
                               "max": pct(1.0)}}
@@ -287,6 +311,11 @@ def main(argv=None) -> int:
     ap.add_argument("--mode", choices=["auto", "load", "sleep"],
                     default="auto")
     ap.add_argument("--cpu-fraction", type=float, default=0.5)
+    ap.add_argument("--policy", choices=["stress", "replay", "serial"],
+                    default="stress")
+    ap.add_argument("--tick-seconds", type=float, default=0.05,
+                    help="real seconds per trace arrival tick "
+                    "(replay policy)")
     args = ap.parse_args(argv)
     with open(args.trace) as f:
         trace = json.load(f)
@@ -295,7 +324,9 @@ def main(argv=None) -> int:
                                sleep_ms=args.sleep_ms,
                                max_concurrent=args.concurrent,
                                mode=args.mode,
-                               cpu_fraction=args.cpu_fraction)))
+                               cpu_fraction=args.cpu_fraction,
+                               policy=args.policy,
+                               tick_seconds=args.tick_seconds)))
     return 0
 
 

@@ -245,6 +245,38 @@ def test_gridmix_replays_trace_as_real_jobs(tmp_path):
         assert len(parts) == 2
 
 
+def test_gridmix_submission_policies(tmp_path):
+    """The reference's three job-submission policies (ref: hadoop-gridmix
+    GridmixJobSubmissionPolicy): SERIAL never overlaps jobs, REPLAY
+    holds each job to its trace arrival tick, STRESS floods up to the
+    in-flight bound."""
+    from hadoop_tpu.testing.minicluster import MiniMRYarnCluster
+    from hadoop_tpu.tools.gridmix import run_trace
+
+    trace = [{"job_id": f"job_{i}", "arrival": i * 20, "containers": 1}
+             for i in range(3)]
+    with MiniMRYarnCluster(num_nodes=2,
+                           base_dir=str(tmp_path / "c")) as cluster:
+        serial = run_trace(cluster.rm_addr, cluster.default_fs, trace,
+                           sleep_ms=50, max_concurrent=3,
+                           out_root="/gm-serial", policy="serial")
+        assert serial["jobs"] == 3 and serial["failed"] == 0
+        assert serial["peak_inflight"] == 1
+
+        # replay: the last job arrives at tick 40 × 0.05 s/tick = 2 s —
+        # total wall time can't be shorter than the trace's span
+        replay = run_trace(cluster.rm_addr, cluster.default_fs, trace,
+                           sleep_ms=50, max_concurrent=3,
+                           out_root="/gm-replay", policy="replay",
+                           tick_seconds=0.05)
+        assert replay["jobs"] == 3 and replay["failed"] == 0
+        assert replay["wall_seconds"] >= 40 * 0.05
+
+        with pytest.raises(ValueError):
+            run_trace(cluster.rm_addr, cluster.default_fs, trace,
+                      policy="bogus")
+
+
 def test_sls_rm_mode_real_rpc():
     """SLS drives a REAL ResourceManager over its three RPC services
     with simulated NMs + AMs (ref: SLSRunner.java architecture)."""
