@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import re
 import time
+import uuid
 from typing import List
 
 from hadoop_tpu.security.ugi import current_user
@@ -68,21 +69,56 @@ class Trash:
         except FileNotFoundError:
             return ""
         stamp = time.strftime(CHECKPOINT_FMT, time.localtime())
+        # Roll through a unique intermediate: rename(Current → .roll-*)
+        # is uncontended (the name is fresh) and atomically claims the
+        # contents — a concurrent roller that loses it has nothing to
+        # roll. The final rename onto the stamped name can still race
+        # another checkpoint, but rename's HDFS move-INTO semantics then
+        # nest our unique name inside the winner's checkpoint, which is
+        # unambiguously detectable and recoverable — a bare
+        # rename(Current, stamp) loop silently nested trash data instead
+        # (ref: TrashPolicyDefault.createCheckpoint's -N retry loop has
+        # the same collision handling need).
+        tmp_name = f".roll-{uuid.uuid4().hex}"
+        tmp = f"{root}/{tmp_name}"
+        try:
+            if not self.fs.rename(cur, tmp):
+                return ""  # a concurrent roller claimed Current first
+        except FileNotFoundError:
+            return ""
         dst = f"{root}/{stamp}"
-        # two checkpoints in one wall-clock second (emptier pass racing
-        # an explicit expunge) collide on the name: retry with a suffix
-        # like the reference rather than aborting the roll (ref:
-        # TrashPolicyDefault.createCheckpoint's -N retry loop)
         attempt = 0
         while True:
+            taken = True
             try:
-                self.fs.rename(cur, dst)
-                return dst
-            except (FileExistsError, IOError):
-                attempt += 1
-                if attempt > 10:
-                    raise
-                dst = f"{root}/{stamp}-{attempt}"
+                self.fs.get_file_status(dst)
+            except FileNotFoundError:
+                taken = False
+            moved = False
+            if not taken:
+                try:
+                    moved = self.fs.rename(tmp, dst)
+                except FileExistsError:
+                    moved = False
+                except FileNotFoundError:
+                    # our intermediate vanished — a concurrent
+                    # expunge(immediately) swept the whole trash,
+                    # contents included; nothing left to roll
+                    return ""
+            if moved:
+                nested = f"{dst}/{tmp_name}"
+                try:
+                    self.fs.get_file_status(nested)
+                except FileNotFoundError:
+                    return dst  # clean roll
+                tmp = nested    # lost the race: dst pre-existed and we
+                # moved INTO it — pull our contents back out under a
+                # suffixed name
+            attempt += 1
+            if attempt > 10:
+                raise IOError(f"cannot roll trash checkpoint {stamp}: "
+                              "repeated collisions")
+            dst = f"{root}/{stamp}-{attempt}"
 
     def expunge(self, immediately: bool = False) -> List[str]:
         """Delete checkpoints older than the interval (all of them when
@@ -99,16 +135,35 @@ class Trash:
             name = st.path.rsplit("/", 1)[-1]
             if name == "Current":
                 continue
-            if not re.fullmatch(r"\d{12}", name):
+            if name.startswith(".roll-"):
+                # An intermediate left by a roller that crashed between
+                # its two renames. mtime can't distinguish crashed from
+                # in-flight, so the timed path is conservative: a known
+                # mtime AND a full extra hour beyond the interval (a
+                # live roll completes in milliseconds; an unknown mtime
+                # is never "old"). immediately=True means "empty the
+                # trash, contents included" and sweeps them regardless.
+                stale = st.mtime and \
+                    now - st.mtime > self.interval_s + 3600.0
+                if immediately or stale:
+                    if self.fs.delete(st.path, recursive=True):
+                        removed.append(st.path)
                 continue
-            age = now - time.mktime(time.strptime(name, CHECKPOINT_FMT))
+            # checkpoint() suffixes same-second collisions as
+            # "<stamp>-N" — those must expire on the same schedule, not
+            # leak forever because the pattern only knew bare stamps
+            m = re.fullmatch(r"(\d{12})(-\d+)?", name)
+            if not m:
+                continue
+            age = now - time.mktime(
+                time.strptime(m.group(1), CHECKPOINT_FMT))
             if immediately or age > self.interval_s:
-                self.fs.delete(st.path, recursive=True)
-                removed.append(st.path)
+                if self.fs.delete(st.path, recursive=True):
+                    removed.append(st.path)
         if immediately:
             try:
-                self.fs.delete(f"{root}/Current", recursive=True)
-                removed.append(f"{root}/Current")
+                if self.fs.delete(f"{root}/Current", recursive=True):
+                    removed.append(f"{root}/Current")
             except FileNotFoundError:
                 pass
         return removed

@@ -182,6 +182,27 @@ def test_trash_move_and_expunge(fs):
         fs.get_file_status(loc)
 
 
+def test_trash_expunges_collision_suffixed_checkpoints(fs):
+    """Two checkpoints in one wall-clock second produce a '<stamp>-N'
+    name; those must expire on the same schedule as bare stamps (review
+    finding: the expunge pattern only knew \\d{12}, so suffixed
+    checkpoints leaked forever)."""
+    trash = Trash(fs, interval_s=3600.0)
+    root = trash._trash_root()
+    first = second = ""
+    for _ in range(5):  # the pair is ~ms apart; straddling a second
+        fs.mkdirs(f"{root}/Current")          # boundary twice is ~never
+        first = trash.checkpoint()
+        fs.mkdirs(f"{root}/Current")
+        second = trash.checkpoint()
+        if "-" in second.rsplit("/", 1)[-1]:
+            break
+        trash.expunge(immediately=True)
+    assert "-" in second.rsplit("/", 1)[-1], (first, second)
+    removed = trash.expunge(immediately=True)
+    assert first in removed and second in removed
+
+
 def test_trash_sibling_of_root_is_trashable(fs):
     """A path sharing the trash root's name as a string prefix but NOT a
     component prefix (/user/u/.TrashOld vs /user/u/.Trash) must be
