@@ -30,6 +30,9 @@ from hadoop_tpu.dfs.namenode.inodes import (FSDirectory, INodeDirectory,
                                             iter_tree, snapshot_copy,
                                             subtree_counts)
 from hadoop_tpu.dfs.namenode.lease import LeaseManager
+from hadoop_tpu.dfs.namenode.permissions import (EXECUTE as PERM_X,
+                                                 READ as PERM_R,
+                                                 WRITE as PERM_W)
 from hadoop_tpu.dfs.namenode.namesystem_lock import NamesystemLock
 from hadoop_tpu.dfs.protocol.records import (AlreadyBeingCreatedError, Block,
                                              DatanodeInfo, FileStatus,
@@ -84,6 +87,21 @@ class FSNamesystem:
             write_warn_threshold_s=conf.get_time_seconds(
                 "dfs.namenode.write-lock-reporting-threshold", 1.0))
         self.fsdir = FSDirectory()
+        # Permission enforcement (ref: FSNamesystem.isPermissionEnabled
+        # + FSPermissionChecker): stored owner/group/mode bits are
+        # CHECKED on every namespace op, not just recorded. The
+        # NameNode's own user is the superuser; members of the
+        # configured supergroup bypass too.
+        self._perm_enabled = conf.get_bool("dfs.permissions.enabled",
+                                           True)
+        self._superuser = current_user().user_name
+        self._supergroup = conf.get("dfs.permissions.superusergroup",
+                                    "supergroup")
+        # Server-side group resolution — NEVER the client-asserted UGI
+        # groups, which would let any caller claim the supergroup
+        # (ref: security/Groups.java).
+        from hadoop_tpu.security.groups import Groups
+        self._groups = Groups(conf)
         self.image = FSImage(os.path.join(name_dir, "image"))
         # Journal seam: local directory by default, quorum journal in HA
         # (ref: FSEditLog's JournalSet of FileJournalManager/QJM members).
@@ -151,6 +169,51 @@ class FSNamesystem:
                     "mkdirs", "delete", "rename", "listing", "get_file_info")}
         self._m_files = reg.register_callback_gauge(
             "files_total", self.fsdir.num_inodes)
+
+    # ----------------------------------------------------------- permissions
+
+    def check_access(self, path: str, *, parent: int = 0,
+                     target: int = 0, owner_only: bool = False,
+                     sub_dirs: int = 0) -> None:
+        """Enforce the stored mode bits for the CURRENT RPC caller
+        (ref: FSNamesystem's per-op FSPermissionChecker use). No-op when
+        dfs.permissions.enabled=false or the caller is the superuser.
+
+        Group resolution rides Groups' per-user TTL cache; a cache miss
+        does the OS lookup while the namesystem lock is held (callers
+        invoke this inside their lock block for check/op atomicity) —
+        once per user per 5 minutes, the documented trade for not
+        threading a pre-lock resolution step through every op."""
+        if not self._perm_enabled:
+            return
+        from hadoop_tpu.dfs.namenode.permissions import FSPermissionChecker
+        from hadoop_tpu.ipc.server import current_call
+        call = current_call()
+        ugi = call.user if call else current_user()
+        FSPermissionChecker(
+            ugi.user_name, self._groups.groups_for(ugi.user_name),
+            self._superuser,
+            self._supergroup).check(self.fsdir, path, parent=parent,
+                                    target=target, owner_only=owner_only,
+                                    sub_dirs=sub_dirs)
+
+    def check_superuser(self, what: str) -> None:
+        """Ref: FSPermissionChecker.checkSuperuserPrivilege — admin-class
+        ops (quota, chown, snapshot admin, encryption zones)."""
+        if not self._perm_enabled:
+            return
+        from hadoop_tpu.dfs.namenode.permissions import FSPermissionChecker
+        from hadoop_tpu.ipc.server import current_call
+        from hadoop_tpu.security.ugi import AccessControlError
+        call = current_call()
+        ugi = call.user if call else current_user()
+        pc = FSPermissionChecker(
+            ugi.user_name, self._groups.groups_for(ugi.user_name),
+            self._superuser, self._supergroup)
+        if not pc.is_superuser:
+            raise AccessControlError(
+                f"Superuser privilege required for {what} "
+                f"(user={ugi.user_name})")
 
     # ------------------------------------------------------------- lifecycle
 
@@ -338,6 +401,12 @@ class FSNamesystem:
             with self.lock.write():
                 self._check_not_safemode("create")
                 self._check_mutable_path(path)
+                # under the lock so the check is atomic with the op
+                # (ref: the reference checks via FSPermissionChecker
+                # inside the namesystem lock): ancestors traversable +
+                # parent writable; an existing target (overwrite) must
+                # itself be writable
+                self.check_access(path, parent=PERM_W, target=PERM_W)
                 existing = self.fsdir.get_inode(path)
                 if existing is not None:
                     if isinstance(existing, INodeDirectory):
@@ -546,6 +615,7 @@ class FSNamesystem:
         """Explicit lease recovery (ref: FSNamesystem.recoverLease). Returns
         True when the file is closed and available."""
         with self.lock.write():
+            self.check_access(path, target=PERM_W)
             inode = self.fsdir.get_inode(path)
             if inode is None or not isinstance(inode, INodeFile):
                 raise FileNotFoundError(path)
@@ -688,6 +758,10 @@ class FSNamesystem:
         fs2img ImageWriter's per-file treatment — here applied to the
         live namesystem, checkpointed with the image).
         """
+        # admin surface: injects externally-backed blocks into the
+        # namespace (the fs2img tool's op) — superuser only, like the
+        # reference's image-import path
+        self.check_superuser("addProvidedFile")
         block_size = block_size or self.default_block_size
         owner = current_user().user_name
         with self.lock.write():
@@ -733,6 +807,7 @@ class FSNamesystem:
         log_audit_event(True, "open", path)
         with self._m["get_block_locations"].time():
             with self.lock.read():
+                self.check_access(path, target=PERM_R)
                 inode = self.fsdir.get_inode(path)
                 if inode is None or not isinstance(inode, INodeFile):
                     raise FileNotFoundError(path)
@@ -767,18 +842,29 @@ class FSNamesystem:
     def get_file_info(self, path: str) -> Optional[Dict]:
         with self._m["get_file_info"].time():
             with self.lock.read():
+                # traverse only — stat needs x on the ancestors
+                self.check_access(path)
                 inode = self.fsdir.get_inode(path)
                 return None if inode is None else inode.status(path).to_wire()
 
     def listing(self, path: str) -> List[Dict]:
-        log_audit_event(True, "listStatus", path)
         with self._m["listing"].time():
             with self.lock.read():
-                return [st.to_wire() for st in self.fsdir.listing(path)]
+                # listing a directory reads its children (r) and stats
+                # them (x); "listing" a file is just a stat — traverse
+                # only (ref: FSPermissionChecker READ_EXECUTE on dirs)
+                is_dir = isinstance(self.fsdir.get_inode(path),
+                                    INodeDirectory)
+                self.check_access(
+                    path, target=(PERM_R | PERM_X) if is_dir else 0)
+                out = [st.to_wire() for st in self.fsdir.listing(path)]
+        log_audit_event(True, "listStatus", path)
+        return out
 
     def content_summary(self, path: str) -> Dict:
         from hadoop_tpu.dfs.namenode.inodes import iter_tree
         with self.lock.read():
+            self.check_access(path)
             node = self.fsdir.get_inode(path)
             if node is None:
                 raise FileNotFoundError(path)
@@ -800,7 +886,14 @@ class FSNamesystem:
                 self._check_not_safemode("mkdirs")
                 self._check_mutable_path(path)
                 if not self.fsdir.exists(path):
+                    # WRITE on the deepest existing ancestor (ref:
+                    # mkdirs' ancestorAccess=WRITE); an already-existing
+                    # directory is the idempotent ensure-exists case and
+                    # needs only traversal, like the reference
+                    self.check_access(path, parent=PERM_W)
                     self._check_quota_locked(path, d_inodes=1, d_space=0)
+                else:
+                    self.check_access(path)
                 self.fsdir.mkdirs(path, owner=owner)
                 txid = self.editlog.log_edit(el.OP_MKDIR,
                                              {"p": path, "o": owner})
@@ -813,6 +906,10 @@ class FSNamesystem:
             with self.lock.write():
                 self._check_not_safemode("delete")
                 self._check_mutable_path(path)
+                self.check_access(
+                    path, parent=PERM_W,
+                    sub_dirs=(PERM_R | PERM_W | PERM_X) if recursive
+                    else 0)
                 removed = self._delete_locked(path, recursive)
                 if not removed:
                     return False
@@ -854,6 +951,15 @@ class FSNamesystem:
         with self._m["rename"].time():
             with self.lock.write():
                 self._check_not_safemode("rename")
+                self.check_access(src, parent=PERM_W)
+                # move-INTO semantics: an existing dst directory IS the
+                # parent the file lands in — WRITE must hold on it, not
+                # on its parent (ref: FSDirRenameOp resolving the real
+                # destination parent)
+                if isinstance(self.fsdir.get_inode(dst), INodeDirectory):
+                    self.check_access(dst, target=PERM_W)
+                else:
+                    self.check_access(dst, parent=PERM_W)
                 self._check_mutable_path(src, dst)
                 actual_dst = self.fsdir.rename(src, dst)
                 self.leases.rename_path(src, actual_dst)
@@ -875,6 +981,7 @@ class FSNamesystem:
         self._check_mutable_path(path)
         with self.lock.write():
             self._check_not_safemode("set replication")
+            self.check_access(path, target=PERM_W)
             inode = self.fsdir.get_inode(path)
             if inode is None or not isinstance(inode, INodeFile):
                 raise FileNotFoundError(path)
@@ -927,6 +1034,7 @@ class FSNamesystem:
 
     def set_quota(self, path: str, ns_quota: int, space_quota: int) -> None:
         """Ref: FSDirAttrOp.setQuota; -1 clears a dimension."""
+        self.check_superuser("setQuota")
         self._check_mutable_path(path)
         with self.lock.write():
             self._check_not_safemode("set quota")
@@ -948,6 +1056,7 @@ class FSNamesystem:
         CacheManager.java addDirective; pools collapse to flat
         directives). Returns the directive id."""
         with self.lock.write():
+            self.check_access(path, target=PERM_R)
             node = self.fsdir.get_inode(path)
             if node is None or not isinstance(node, INodeFile):
                 raise FileNotFoundError(path)
@@ -962,6 +1071,12 @@ class FSNamesystem:
 
     def remove_cache_directive(self, directive_id: int) -> bool:
         with self.lock.write():
+            existing = self.cache_directives.get(directive_id)
+            if existing is None:
+                return False
+            # same bar as adding one for that path: a user who cannot
+            # read the file must not be able to evict its pinned blocks
+            self.check_access(existing, target=PERM_R)
             gone = self.cache_directives.pop(directive_id, None)
             if gone is None:
                 return False
@@ -1007,6 +1122,7 @@ class FSNamesystem:
         """Mark an EMPTY directory as an encryption zone (ref:
         FSDirEncryptionZoneOp.createEncryptionZone — same constraints:
         directory, empty, not nested inside another zone)."""
+        self.check_superuser("createEncryptionZone")
         if self._kms() is None:
             raise ValueError("no KMS configured "
                              "(dfs.encryption.key.provider.uri)")
@@ -1084,7 +1200,13 @@ class FSNamesystem:
         ns = name.split(".", 1)[0]
         if ns not in ("user", "trusted", "system", "security", "raw"):
             raise ValueError(f"xattr name must be namespaced: {name!r}")
+        if ns != "user":
+            # trusted/system/security/raw carry internal state (EDEKs,
+            # provenance): WRITE on the file must not allow forging it
+            # (ref: XAttrPermissionFilter restricting these namespaces)
+            self.check_superuser(f"setXAttr:{ns}")
         with self.lock.write():
+            self.check_access(path, target=PERM_W)
             node = self._inode_or_raise(path)
             if node.xattrs is None:
                 node.xattrs = {}
@@ -1096,6 +1218,7 @@ class FSNamesystem:
     def get_xattrs(self, path: str,
                    names: Optional[List[str]] = None) -> Dict[str, bytes]:
         with self.lock.read():
+            self.check_access(path, target=PERM_R)
             node = self._inode_or_raise(path)
             attrs = node.xattrs or {}
             if names:
@@ -1107,7 +1230,10 @@ class FSNamesystem:
 
     def remove_xattr(self, path: str, name: str) -> None:
         self._check_mutable_path(path)
+        if name.split(".", 1)[0] != "user":
+            self.check_superuser("removeXAttr:reserved")
         with self.lock.write():
+            self.check_access(path, target=PERM_W)
             node = self._inode_or_raise(path)
             if not node.xattrs or name not in node.xattrs:
                 raise ValueError(f"no xattr {name!r} on {path}")
@@ -1126,6 +1252,7 @@ class FSNamesystem:
             if len(e.split(":")) != 3:
                 raise ValueError(f"malformed ACL entry {e!r}")
         with self.lock.write():
+            self.check_access(path, owner_only=True)
             node = self._inode_or_raise(path)
             node.acl = list(entries) or None
             txid = self.editlog.log_edit(el.OP_SET_ACL, {
@@ -1134,6 +1261,7 @@ class FSNamesystem:
 
     def get_acl(self, path: str) -> List[str]:
         with self.lock.read():
+            self.check_access(path)
             return list(self._inode_or_raise(path).acl or [])
 
     def remove_acl(self, path: str) -> None:
@@ -1148,6 +1276,7 @@ class FSNamesystem:
                 f"unknown storage policy {policy!r}; known: "
                 f"{STORAGE_POLICIES}")
         with self.lock.write():
+            self.check_access(path, target=PERM_W)
             node = self._inode_or_raise(path)
             node.storage_policy = policy
             txid = self.editlog.log_edit(el.OP_SET_STORAGE_POLICY, {
@@ -1174,6 +1303,7 @@ class FSNamesystem:
 
     def allow_snapshot(self, path: str) -> None:
         """Ref: FSDirSnapshotOp.allowSnapshot."""
+        self.check_superuser("allowSnapshot")
         with self.lock.write():
             node = self._inode_or_raise(path)
             if not isinstance(node, INodeDirectory):
@@ -1185,6 +1315,7 @@ class FSNamesystem:
         self.editlog.log_sync(txid)
 
     def disallow_snapshot(self, path: str) -> None:
+        self.check_superuser("disallowSnapshot")
         with self.lock.write():
             node = self._inode_or_raise(path)
             if not isinstance(node, INodeDirectory):
@@ -1203,6 +1334,7 @@ class FSNamesystem:
         metadata; shared Block objects pin the data against deletion."""
         with self.lock.write():
             self._check_not_safemode("create snapshot")
+            self.check_access(path, owner_only=True)
             node = self._inode_or_raise(path)
             if not isinstance(node, INodeDirectory) or not node.snapshottable:
                 raise OSError(f"{path} is not snapshottable")
@@ -1217,6 +1349,7 @@ class FSNamesystem:
 
     def delete_snapshot(self, path: str, name: str) -> None:
         with self.lock.write():
+            self.check_access(path, owner_only=True)
             node = self._inode_or_raise(path)
             self._delete_snapshot_locked(node, path, name)
             txid = self.editlog.log_edit(el.OP_DELETE_SNAPSHOT, {
@@ -1242,6 +1375,7 @@ class FSNamesystem:
 
     def rename_snapshot(self, path: str, old: str, new: str) -> None:
         with self.lock.write():
+            self.check_access(path, owner_only=True)
             node = self._inode_or_raise(path)
             if not isinstance(node, INodeDirectory) or \
                     old not in (node.snapshots or {}):
@@ -1315,6 +1449,9 @@ class FSNamesystem:
         with self.lock.write():
             self._check_not_safemode("concat")
             self._check_mutable_path(target, *srcs)
+            self.check_access(target, target=PERM_W)
+            for s in srcs:
+                self.check_access(s, parent=PERM_W, target=PERM_W)
             if len(set(srcs)) != len(srcs) or target in srcs:
                 raise ValueError(
                     f"concat sources must be distinct and exclude the "
@@ -1354,6 +1491,7 @@ class FSNamesystem:
         (immediate completion; the reference's in-progress recovery case
         does not arise)."""
         with self.lock.write():
+            self.check_access(path, target=PERM_W)
             self._check_not_safemode("truncate")
             self._check_mutable_path(path)
             inode = self._inode_or_raise(path)
@@ -1422,6 +1560,7 @@ class FSNamesystem:
             ec.get_policy(policy_name)  # validate
         with self.lock.write():
             self._check_not_safemode("set EC policy")
+            self.check_access(path, target=PERM_W)
             node = self.fsdir.get_inode(path)
             if node is None:
                 raise FileNotFoundError(path)
@@ -1450,6 +1589,7 @@ class FSNamesystem:
     def set_times(self, path: str, mtime: float, atime: float) -> None:
         self._check_mutable_path(path)
         with self.lock.write():
+            self.check_access(path, target=PERM_W)
             inode = self.fsdir.get_inode(path)
             if inode is None:
                 raise FileNotFoundError(path)
@@ -1464,6 +1604,7 @@ class FSNamesystem:
     def set_permission(self, path: str, permission: int) -> None:
         self._check_mutable_path(path)
         with self.lock.write():
+            self.check_access(path, owner_only=True)
             inode = self.fsdir.get_inode(path)
             if inode is None:
                 raise FileNotFoundError(path)
@@ -1473,6 +1614,7 @@ class FSNamesystem:
         self.editlog.log_sync(txid)
 
     def set_owner(self, path: str, owner: str, group: str) -> None:
+        self.check_superuser("setOwner")
         self._check_mutable_path(path)
         with self.lock.write():
             inode = self.fsdir.get_inode(path)

@@ -19,6 +19,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import secrets
 import sys
 import threading
 import time
@@ -80,7 +81,14 @@ class TaskUmbilicalProtocol:
         self.am = am
 
     def get_job(self) -> Dict:
-        return self.am.job
+        # NEVER hand the shuffle secret to umbilical callers: the
+        # umbilical is an open local RPC surface, and the secret rides
+        # the container-private launch env instead (the analog of the
+        # reference's credentials file in the container work dir) —
+        # serving it here would let any local process sign fetches for
+        # the job it protects.
+        return {k: v for k, v in self.am.job.items()
+                if k != "shuffle_secret"}
 
     def get_task(self, attempt_id: str) -> Optional[Dict]:
         with self.am.lock:
@@ -191,6 +199,53 @@ class MRAppMaster:
         fs = FileSystem.get(self.staging_uri, self.conf)
         base = Path(self.staging_uri).path
         self.job = json.loads(fs.read_all(f"{base}/job.json").decode())
+        # The shuffle token: submission staged it as a 0600 file in the
+        # 0700 staging dir (the credentials-file analog) so it is
+        # stable across AM attempts — a recovered AM signs fetches of
+        # the prior attempt's map outputs with the same secret their
+        # nodes registered. Minting here instead would orphan those
+        # outputs. Fallback mint covers descriptors staged by older
+        # clients.
+        token = None
+        for tp in (f"{base}/job.token",
+                   f"{base}/.am-private/job.token"):  # prior-attempt mint
+            try:
+                token = fs.read_all(tp).decode().strip()
+                break
+            except FileNotFoundError:
+                continue
+        if token is not None:
+            self.job["shuffle_secret"] = token
+        else:
+            # descriptor staged by an older client: mint here but
+            # PERSIST the mint, or a recovered AM attempt would mint a
+            # different token and fail to fetch the prior attempt's
+            # registered map outputs
+            minted = secrets.token_hex(32)
+            # The old-client staging dir may be world-readable, so the
+            # mint goes under a directory locked down BEFORE the secret
+            # is written (a bare file would sit at the default mode for
+            # a window, and forever if the chmod failed). If the dir
+            # cannot be restricted, prefer an UNPERSISTED mint (recovery
+            # re-mints) over an exposed one.
+            priv = f"{base}/.am-private"
+            persist = True
+            try:
+                fs.mkdirs(priv)
+                fs.set_permission(priv, 0o700)
+            except NotImplementedError:
+                pass  # object stores: bucket policy is the control
+            except OSError as e:
+                log.warning("not persisting minted shuffle token "
+                            "(cannot restrict %s: %s)", priv, e)
+                persist = False
+            if persist:
+                try:
+                    fs.write_all(f"{priv}/job.token", minted.encode())
+                except OSError as e:
+                    log.warning("could not persist minted shuffle "
+                                "token: %s", e)
+            self.job["shuffle_secret"] = minted
         # History + recovery (ref: MRAppMaster.java:180 recovery path):
         # a prior attempt's event log seeds completed tasks so only
         # unfinished work reruns.
@@ -482,9 +537,26 @@ class MRAppMaster:
             "HTPU_NM_HOST": host,
         }
         cmd = [sys.executable, "-m", "hadoop_tpu.mapreduce.task_runner"]
+        service_data = {}
+        secret = self.job.get("shuffle_secret")
+        if secret:
+            # tasks read the token from their container-private env
+            # (the credentials-file analog); reducers sign fetches with
+            # it
+            env["HTPU_SHUFFLE_SECRET"] = secret
+            if attempt.task.type == "map":
+                # only MAP nodes serve this job's outputs, so only they
+                # need the token registered with their shuffle service
+                # (ref: ContainerLaunchContext serviceData →
+                # ShuffleHandler.initializeApplication); registering it
+                # on reduce-only nodes would leave stale credentials
+                # behind on nodes the purge pass never visits
+                service_data[shuffle.SHUFFLE_SERVICE_KEY] = json.dumps(
+                    {"job": self.job["job_id"], "secret": secret})
         try:
             nm.start_container(container,
-                               ContainerLaunchContext(cmd, env))
+                               ContainerLaunchContext(
+                                   cmd, env, service_data=service_data))
         except Exception as e:  # noqa: BLE001
             log.warning("launch of %s failed: %s", attempt.id, e)
             with self.lock:
@@ -657,7 +729,8 @@ class MRAppMaster:
         for addr in self.shuffle_nodes:
             host, _, port = addr.rpartition(":")
             if port:
-                shuffle.purge_job((host, int(port)), self.job["job_id"])
+                shuffle.purge_job((host, int(port)), self.job["job_id"],
+                                  secret=self.job.get("shuffle_secret"))
 
 
 ENV_AM_ADDRESS_KEY = "HTPU_MR_AM_ADDRESS"

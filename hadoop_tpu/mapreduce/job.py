@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import secrets
 import sys
 import time
 import uuid
@@ -30,6 +31,13 @@ from hadoop_tpu.yarn.records import (ApplicationSubmissionContext, AppState,
                                      ContainerLaunchContext, Resource)
 
 log = logging.getLogger(__name__)
+
+
+def _chmod_if_supported(fs, path: str, mode: int) -> None:
+    try:
+        fs.set_permission(path, mode)
+    except (NotImplementedError, OSError) as e:
+        log.debug("set_permission unsupported on %s: %s", path, e)
 
 
 class JobFailedError(RuntimeError):
@@ -133,6 +141,9 @@ class Job:
             splits = fmt.get_splits(fs, self.input_paths, self.conf)
             if not splits:
                 raise JobFailedError("no input splits computed")
+            # NOTE: no credentials in the descriptor itself — the
+            # shuffle token rides a separate 0600 staging file (below),
+            # mirroring the reference's credentials-file split.
             descriptor = {
                 "job_id": self.job_id, "name": self.name,
                 "default_fs": self.default_fs,
@@ -147,9 +158,42 @@ class Job:
                 "splits": [s.to_wire() for s in splits],
             }
             staging_path = Path(self.staging_uri).path
+            # shared staging ROOT must be world-writable + sticky
+            # (ref: the reference requires /tmp 1777 for its staging;
+            # Yarn's staging root gets the same treatment) — otherwise
+            # the first submitter's 755 ownership of /tmp/staging
+            # blocks every other user's submission once permission
+            # enforcement is on. Sticky keeps users from deleting each
+            # other's job dirs.
+            staging_root = staging_path.rsplit("/", 1)[0]
+            if not fs.exists(staging_root):
+                fs.mkdirs(staging_root)
+                _chmod_if_supported(fs, staging_root, 0o1777)
             fs.mkdirs(staging_path)
+            # owner-only staging (ref: JobSubmissionFiles
+            # JOB_DIR_PERMISSION 700 / JOB_FILE_PERMISSION 644): the
+            # token below must not be listable by other users. Backends
+            # without a permission model (object stores, viewfs roots)
+            # rely on bucket/mount policy instead — same stance as S3A.
+            # Deployment coupling, same as the reference: 0700 staging
+            # assumes the AM runs AS the submitter — true under the
+            # native container-executor, and trivially true
+            # single-user. A multi-user cluster on the default
+            # executor (AM runs as the NodeAgent user) is already not
+            # a security boundary; there the NN superuser bypass is
+            # what keeps the AM reading its staging.
+            _chmod_if_supported(fs, staging_path, 0o700)
             fs.write_all(f"{staging_path}/job.json",
                          json.dumps(descriptor).encode())
+            # Per-job shuffle token, minted at submission so it is
+            # STABLE across AM attempts (a recovered AM must sign
+            # fetches of the prior attempt's map outputs with the same
+            # secret their nodes registered). Separate 0600 file — the
+            # credentials-file analog (ref: TokenCache.setJobToken +
+            # the jobToken file in the 700 staging dir).
+            token_path = f"{staging_path}/job.token"
+            fs.write_all(token_path, secrets.token_hex(32).encode())
+            _chmod_if_supported(fs, token_path, 0o600)
         finally:
             fs.close()
 

@@ -13,8 +13,12 @@ over threshold.
 
 from __future__ import annotations
 
+import hmac
+import hashlib
+import json
 import logging
 import os
+import re
 import shutil
 import socket
 import threading
@@ -43,6 +47,33 @@ def map_output_paths(shuffle_dir: str, job_id: str,
             os.path.join(d, f"{map_task_id}.out.index"))
 
 
+SHUFFLE_SERVICE_KEY = "mapreduce_shuffle"  # service_data key (ref:
+# ShuffleHandler.MAPREDUCE_SHUFFLE_SERVICEID — where the MR client
+# plants the job token for the NM shuffle service)
+
+# job/map ids are single path components chosen by this framework
+# (job_<hex>, task/attempt ids): anything outside this shape is a
+# path-traversal attempt, not a name — '../other-job/m0' would reach
+# another job's outputs through the no-secret open mode, and a crafted
+# service_data job name would write secret files outside the shuffle
+# dir as the NodeAgent user.
+_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.\-]{0,254}$")
+
+
+def _safe_name(s) -> bool:
+    return isinstance(s, str) and bool(_NAME_RE.match(s))
+
+
+def request_mac(secret: str, req: Dict) -> str:
+    """HMAC over the request's semantic fields — the analog of the
+    reference ShuffleHandler's verifyRequest() URL-hash check
+    (ref: ShuffleHandler.java verifyRequest / SecureShuffleUtils)."""
+    msg = "|".join(str(req.get(k, "")) for k in
+                   ("op", "job", "map", "partition"))
+    return hmac.new(secret.encode(), msg.encode(),
+                    hashlib.sha256).hexdigest()
+
+
 class ShuffleService:
     """Serves (job, map, partition) segment requests from the node's shuffle
     dir. Runs as a NodeAgent auxiliary service (ref: AuxServices.java;
@@ -53,9 +84,87 @@ class ShuffleService:
         self._sock: Optional[socket.socket] = None
         self._stop = threading.Event()
         self.port = 0
+        # job id → shuffle secret, learned from container service_data
+        # (ref: ShuffleHandler.initializeApplication recovering the job
+        # token). A job with a registered secret gets every request
+        # MAC-verified; a job that never registered one is served open
+        # (the pre-auth wire behavior, kept for standalone use).
+        # Secrets are mirrored to 0600 files under the shuffle dir so a
+        # NodeAgent restart cannot flip surviving protected outputs
+        # into open mode (ref: ShuffleHandler's recovery state store).
+        self._secrets: Dict[str, str] = {}
+        self._secrets_lock = threading.Lock()
+
+    @property
+    def _secrets_dir(self) -> str:
+        return os.path.join(self.shuffle_dir, ".secrets")
+
+    def _load_secrets(self) -> None:
+        try:
+            names = os.listdir(self._secrets_dir)
+        except OSError:
+            return
+        with self._secrets_lock:
+            for name in names:
+                try:
+                    with open(os.path.join(self._secrets_dir, name)) as f:
+                        self._secrets.setdefault(name, f.read().strip())
+                except OSError:
+                    continue
+
+    def initialize_app(self, service_data: Dict[str, str]) -> None:
+        payload = service_data.get(SHUFFLE_SERVICE_KEY)
+        if not payload:
+            return
+        d = json.loads(payload)
+        job, secret = d["job"], d["secret"]
+        if not _safe_name(job):
+            log.warning("refusing shuffle registration for unsafe job "
+                        "name %r", job)
+            return
+        with self._secrets_lock:
+            # FIRST registration wins: the binding arrives over the
+            # open container-launch path, so an overwrite would let a
+            # later caller hijack (or lock out) a job that already
+            # registered — an AM re-registering after recovery presents
+            # the identical token, which setdefault keeps
+            existing = self._secrets.setdefault(job, secret)
+            if existing != secret:
+                log.warning("refusing to replace registered shuffle "
+                            "secret for %s", job)
+                return
+            try:
+                os.makedirs(self._secrets_dir, mode=0o700, exist_ok=True)
+                path = os.path.join(self._secrets_dir, job)
+                fd = os.open(path, os.O_WRONLY | os.O_CREAT, 0o600)
+                with os.fdopen(fd, "w") as f:
+                    f.write(secret)
+            except OSError as e:
+                log.warning("could not persist shuffle secret: %s", e)
+
+    def _verify(self, req: Dict) -> bool:
+        with self._secrets_lock:
+            secret = self._secrets.get(req.get("job", ""))
+        if secret is None:
+            return True  # no secret registered for this job: open mode
+        mac = req.get("mac", "")
+        return isinstance(mac, str) and hmac.compare_digest(
+            mac, request_mac(secret, req))
 
     def start(self) -> None:
-        os.makedirs(self.shuffle_dir, exist_ok=True)
+        # 0700 when WE create the dir: the MAC only guards the socket —
+        # the segment files must not be readable by other local users
+        # (the locate op even hands out their absolute paths). A
+        # pre-existing dir keeps the admin's modes: a setuid-executor
+        # deployment provisions it wider so containers running as the
+        # submitting user can write their map outputs into it.
+        if not os.path.isdir(self.shuffle_dir):
+            os.makedirs(self.shuffle_dir, mode=0o700, exist_ok=True)
+            try:
+                os.chmod(self.shuffle_dir, 0o700)  # makedirs honors umask
+            except OSError:
+                pass
+        self._load_secrets()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(("127.0.0.1", 0))
@@ -97,10 +206,37 @@ class ShuffleService:
                     except EOFError:
                         return
                     req = unpack(frame)
+                    if not _safe_name(req.get("job", "")) or not (
+                            req.get("op") == "purge" or
+                            _safe_name(req.get("map", ""))):
+                        write_frame(wfile, pack(
+                            {"ok": False, "error": "invalid name"}))
+                        wfile.flush()
+                        continue
+                    if not self._verify(req):
+                        write_frame(wfile, pack(
+                            {"ok": False,
+                             "error": "shuffle authentication failed"}))
+                        wfile.flush()
+                        continue
                     if req.get("op") == "purge":
-                        shutil.rmtree(os.path.join(
-                            self.shuffle_dir, req["job"]), ignore_errors=True)
-                        write_frame(wfile, pack({"ok": True}))
+                        job_dir = os.path.join(self.shuffle_dir,
+                                               req["job"])
+                        shutil.rmtree(job_dir, ignore_errors=True)
+                        gone = not os.path.exists(job_dir)
+                        if gone:
+                            # fail closed: only forget the secret once
+                            # the outputs it protected are really gone —
+                            # a partial rmtree must not flip surviving
+                            # segments into open mode
+                            with self._secrets_lock:
+                                self._secrets.pop(req["job"], None)
+                                try:
+                                    os.unlink(os.path.join(
+                                        self._secrets_dir, req["job"]))
+                                except OSError:
+                                    pass
+                        write_frame(wfile, pack({"ok": gone}))
                         wfile.flush()
                         continue
                     if req.get("op") == "locate":
@@ -145,7 +281,10 @@ class ShuffleService:
 
 
 def _request(addr: Tuple[str, int], req: Dict,
-             timeout: float = 30.0) -> Dict:
+             timeout: float = 30.0,
+             secret: Optional[str] = None) -> Dict:
+    if secret:
+        req = dict(req, mac=request_mac(secret, req))
     with socket.create_connection(addr, timeout=timeout) as sock:
         rfile = sock.makefile("rb")
         wfile = sock.makefile("wb")
@@ -158,9 +297,11 @@ def _request(addr: Tuple[str, int], req: Dict,
         return unpack(frame)
 
 
-def purge_job(addr: Tuple[str, int], job_id: str) -> None:
+def purge_job(addr: Tuple[str, int], job_id: str,
+              secret: Optional[str] = None) -> None:
     try:
-        _request(addr, {"op": "purge", "job": job_id}, timeout=5.0)
+        _request(addr, {"op": "purge", "job": job_id}, timeout=5.0,
+                 secret=secret)
     except OSError:
         pass  # best-effort cleanup
 
@@ -280,10 +421,12 @@ class Fetcher:
     worker pool. Ref: Fetcher.java:185 run, :305 copyFromHost."""
 
     def __init__(self, partition: int, job_id: str, merger: MergeManager,
-                 num_threads: int = 4, max_retries: int = 6):
+                 num_threads: int = 4, max_retries: int = 6,
+                 secret: Optional[str] = None):
         self.partition = partition
         self.job_id = job_id
         self.merger = merger
+        self.secret = secret
         self.num_threads = num_threads
         self.max_retries = max_retries
         self._pending: List[Tuple[str, str]] = []  # (map_id, host:port)
@@ -345,7 +488,7 @@ class Fetcher:
                     # same-host segment file directly
                     resp = _request((host, int(port)), {
                         "op": "locate", "job": self.job_id, "map": map_id,
-                        "partition": self.partition})
+                        "partition": self.partition}, secret=self.secret)
                     if resp.get("ok"):
                         try:
                             with open(resp["path"], "rb") as f:
@@ -356,7 +499,7 @@ class Fetcher:
                 if stored is None:
                     resp = _request((host, int(port)), {
                         "job": self.job_id, "map": map_id,
-                        "partition": self.partition})
+                        "partition": self.partition}, secret=self.secret)
                     if not resp.get("ok"):
                         raise ShuffleError(resp.get("error", "fetch failed"))
                     stored = resp["data"]

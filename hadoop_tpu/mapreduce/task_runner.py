@@ -246,7 +246,9 @@ def run_reduce(job: Dict, task: Dict, umbilical, attempt_id: str,
     fetcher = shuffle.Fetcher(partition, job["job_id"], merger,
                               num_threads=int(conf.get(
                                   "mapreduce.reduce.shuffle.parallelcopies",
-                                  "4")))
+                                  "4")),
+                              secret=job.get("shuffle_secret") or
+                              os.environ.get("HTPU_SHUFFLE_SECRET"))
     # shuffle phase: poll completion events until all maps fetched
     # (ref: Shuffle.java:97 run + EventFetcher)
     next_event = 0

@@ -321,6 +321,15 @@ class NodeAgent(AbstractService):
             env = dict(rc.ctx.env)
             for aux in self.aux_services:
                 env.update(aux.container_env())
+                if rc.ctx.service_data and hasattr(aux, "initialize_app"):
+                    # per-app payloads for aux services (ref:
+                    # AuxServices.initializeApplication — the shuffle
+                    # service learns the job's token secret this way);
+                    # idempotent, so per-container delivery is fine
+                    try:
+                        aux.initialize_app(rc.ctx.service_data)
+                    except Exception as e:  # noqa: BLE001 — advisory
+                        log.warning("aux service_data init failed: %s", e)
             env["HTPU_CONTAINER_ID"] = str(cid)
             env["HTPU_WORK_DIR"] = rc.workdir
             if rc.chips:

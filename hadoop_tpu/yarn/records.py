@@ -187,12 +187,14 @@ class ContainerLaunchContext:
     """What to run: command argv, env, local resources (DFS paths to
     localize). Ref: ContainerLaunchContext.java."""
 
-    __slots__ = ("commands", "env", "local_resources", "volumes")
+    __slots__ = ("commands", "env", "local_resources", "volumes",
+                 "service_data")
 
     def __init__(self, commands: List[str],
                  env: Optional[Dict[str, str]] = None,
                  local_resources: Optional[Dict[str, str]] = None,
-                 volumes: Optional[List[Dict]] = None):
+                 volumes: Optional[List[Dict]] = None,
+                 service_data: Optional[Dict[str, str]] = None):
         self.commands = commands            # argv
         self.env = env or {}
         self.local_resources = local_resources or {}  # name -> dfs uri
@@ -200,18 +202,24 @@ class ContainerLaunchContext:
         # the yarn-csi volume resources on a container request):
         # [{"driver": "htpufs", "id": "htpufs://h:p", "target": "data"}]
         self.volumes = volumes or []
+        # Per-application payloads for NM auxiliary services, keyed by
+        # service name (ref: ContainerLaunchContext.setServiceData —
+        # how the MR client hands the shuffle service its job token)
+        self.service_data = service_data or {}
 
     def to_wire(self) -> Dict:
         d = {"c": self.commands, "e": self.env,
              "lr": self.local_resources}
         if self.volumes:
             d["vol"] = self.volumes
+        if self.service_data:
+            d["sd"] = self.service_data
         return d
 
     @classmethod
     def from_wire(cls, d: Dict) -> "ContainerLaunchContext":
         return cls(d["c"], d.get("e", {}), d.get("lr", {}),
-                   d.get("vol"))
+                   d.get("vol"), d.get("sd"))
 
 
 class ApplicationSubmissionContext:

@@ -186,6 +186,58 @@ def test_shuffle_service_serves_and_fetches(tmp_path):
         svc.stop()
 
 
+def test_shuffle_service_verifies_job_token(tmp_path):
+    """A job whose secret was registered (container service_data →
+    initialize_app, ref: ShuffleHandler.verifyRequest) gets every
+    request MAC-checked: unsigned or wrongly-signed fetch/locate/purge
+    are refused, correctly signed ones succeed — an unauthenticated
+    local process can no longer read another job's map outputs or
+    purge its shuffle dir."""
+    import json as _json
+
+    svc = shuffle.ShuffleService(None, str(tmp_path))
+    svc.start()
+    try:
+        secret = "deadbeef" * 8
+        svc.initialize_app({shuffle.SHUFFLE_SERVICE_KEY: _json.dumps(
+            {"job": "sec-job", "secret": secret})})
+        runs = [[(b"a", b"1")]]
+        out, idx = shuffle.map_output_paths(svc.shuffle_dir, "sec-job",
+                                            "m0")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        index = ifile.write_partitioned(out, runs)
+        with open(idx, "wb") as f:
+            f.write(index.to_bytes())
+
+        addr = ("127.0.0.1", svc.port)
+        base = {"job": "sec-job", "map": "m0", "partition": 0}
+        # unsigned and badly-signed fetches refused
+        assert not shuffle._request(addr, dict(base))["ok"]
+        assert not shuffle._request(addr, dict(base),
+                                    secret="wrong" * 13)["ok"]
+        assert not shuffle._request(
+            addr, dict(base, op="locate"), secret=None)["ok"]
+        # signed fetch succeeds
+        resp = shuffle._request(addr, dict(base), secret=secret)
+        assert resp["ok"] and resp["data"]
+        # unsigned purge refused — the dir survives
+        shuffle.purge_job(addr, "sec-job")
+        assert os.path.exists(os.path.dirname(out))
+        # signed purge removes it
+        shuffle.purge_job(addr, "sec-job", secret=secret)
+        assert not os.path.exists(os.path.dirname(out))
+        # an unrelated job with no registered secret stays open-mode
+        out2, idx2 = shuffle.map_output_paths(svc.shuffle_dir, "open-job",
+                                              "m0")
+        os.makedirs(os.path.dirname(out2), exist_ok=True)
+        with open(idx2, "wb") as f:
+            f.write(ifile.write_partitioned(out2, runs).to_bytes())
+        assert shuffle._request(
+            addr, {"job": "open-job", "map": "m0", "partition": 0})["ok"]
+    finally:
+        svc.stop()
+
+
 def test_fetcher_retries_then_fails(tmp_path):
     svc = shuffle.ShuffleService(None, str(tmp_path))
     svc.start()
@@ -277,3 +329,90 @@ def test_fetcher_records_nonio_failures_for_retry():
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+def test_umbilical_get_job_never_leaks_shuffle_secret():
+    """The umbilical is an open local RPC surface; the shuffle token
+    must ride the container-private launch env instead (review finding:
+    serving it from get_job() let any local process sign fetches for
+    the job the token protects)."""
+    from hadoop_tpu.mapreduce.appmaster import TaskUmbilicalProtocol
+
+    class _FakeAM:
+        job = {"job_id": "j", "shuffle_secret": "s3cr3t", "conf": {}}
+
+    served = TaskUmbilicalProtocol(_FakeAM()).get_job()
+    assert "shuffle_secret" not in served
+    assert served["job_id"] == "j"
+
+
+def test_shuffle_secrets_survive_service_restart(tmp_path):
+    """An NM restart must not flip surviving protected outputs into
+    open mode (review finding): secrets persist as 0600 files beside
+    the shuffle dir and reload on start. A later registration with a
+    DIFFERENT secret must not replace the original binding (hijack via
+    the open container-launch surface)."""
+    import json as _json
+
+    secret = "feedface" * 8
+    svc1 = shuffle.ShuffleService(None, str(tmp_path))
+    svc1.start()
+    svc1.initialize_app({shuffle.SHUFFLE_SERVICE_KEY: _json.dumps(
+        {"job": "pj", "secret": secret})})
+    out, idx = shuffle.map_output_paths(svc1.shuffle_dir, "pj", "m0")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(idx, "wb") as f:
+        f.write(ifile.write_partitioned(out, [[(b"k", b"v")]]).to_bytes())
+    svc1.stop()
+
+    svc2 = shuffle.ShuffleService(None, str(tmp_path))
+    svc2.start()
+    try:
+        addr = ("127.0.0.1", svc2.port)
+        req = {"job": "pj", "map": "m0", "partition": 0}
+        assert not shuffle._request(addr, dict(req))["ok"]       # still closed
+        assert shuffle._request(addr, dict(req), secret=secret)["ok"]
+        # hijack attempt: a different secret must not replace the binding
+        svc2.initialize_app({shuffle.SHUFFLE_SERVICE_KEY: _json.dumps(
+            {"job": "pj", "secret": "attacker" * 8})})
+        assert not shuffle._request(addr, dict(req),
+                                    secret="attacker" * 8)["ok"]
+        assert shuffle._request(addr, dict(req), secret=secret)["ok"]
+    finally:
+        svc2.stop()
+
+
+def test_shuffle_rejects_path_traversal_names(tmp_path):
+    """'../<other-job>/m0' must not reach another job's outputs through
+    a no-secret job's open mode, and a traversal purge must not delete
+    the persisted-secrets dir (review finding)."""
+    import json as _json
+
+    svc = shuffle.ShuffleService(None, str(tmp_path))
+    svc.start()
+    try:
+        secret = "cafebabe" * 8
+        svc.initialize_app({shuffle.SHUFFLE_SERVICE_KEY: _json.dumps(
+            {"job": "prot", "secret": secret})})
+        out, idx = shuffle.map_output_paths(svc.shuffle_dir, "prot", "m0")
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(idx, "wb") as f:
+            f.write(ifile.write_partitioned(
+                out, [[(b"k", b"v")]]).to_bytes())
+        addr = ("127.0.0.1", svc.port)
+        for req in (
+                {"job": "zzz", "map": "../prot/m0", "partition": 0},
+                {"job": "../shuffle/prot", "map": "m0", "partition": 0},
+                {"op": "purge", "job": "../shuffle/prot"},
+                {"op": "purge", "job": ".secrets"},
+        ):
+            resp = shuffle._request(addr, req)
+            assert not resp["ok"], req
+        assert os.path.exists(out)
+        assert os.path.exists(os.path.join(svc._secrets_dir, "prot"))
+        # unsafe registration refused entirely
+        svc.initialize_app({shuffle.SHUFFLE_SERVICE_KEY: _json.dumps(
+            {"job": "../../evil", "secret": "x" * 64})})
+        assert not os.path.exists(str(tmp_path / ".." / "evil"))
+    finally:
+        svc.stop()

@@ -1,0 +1,210 @@
+"""Namespace permission ENFORCEMENT (ref test model: hadoop-hdfs
+TestDFSPermission.java / FSPermissionChecker tests): the stored
+owner/group/mode bits must gate reads, writes, traversal, and
+admin ops for non-superusers — not just be recorded.
+"""
+
+import pytest
+
+from hadoop_tpu.security.ugi import AccessControlError, UserGroupInformation
+from hadoop_tpu.testing.minicluster import MiniDFSCluster, fast_conf
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    conf = fast_conf()
+    # group membership is resolved SERVER-side (security/groups.py) —
+    # a client asserting groups=["supergroup"] must get nothing from it
+    conf.set("hadoop.security.group.mapping.static.mapping",
+             "carol=eng;opsadmin=supergroup")
+    with MiniDFSCluster(num_datanodes=2, conf=conf) as c:
+        c.wait_active()
+        yield c
+
+
+@pytest.fixture(scope="module")
+def root_fs(cluster):
+    fs = cluster.get_filesystem()
+    # world-writable scratch + a private tree, set up by the superuser
+    fs.mkdirs("/open")
+    fs.set_permission("/open", 0o777)
+    fs.write_all("/open/readable.txt", b"anyone")
+    fs.set_permission("/open/readable.txt", 0o644)
+    fs.write_all("/open/secret.txt", b"root only")
+    fs.set_permission("/open/secret.txt", 0o600)
+    fs.mkdirs("/private")
+    fs.set_permission("/private", 0o700)
+    fs.write_all("/private/inner.txt", b"hidden")
+    return fs
+
+
+def _as(user, fn):
+    return UserGroupInformation.create_remote_user(user).do_as(fn)
+
+
+def test_mode_bits_gate_reads(cluster, root_fs):
+    alice = UserGroupInformation.create_remote_user("alice")
+    fs = alice.do_as(cluster.get_filesystem)
+    assert alice.do_as(lambda: fs.read_all("/open/readable.txt")) == \
+        b"anyone"
+    with pytest.raises(AccessControlError):
+        alice.do_as(lambda: fs.read_all("/open/secret.txt"))
+
+
+def test_traverse_gates_everything_below(cluster, root_fs):
+    alice = UserGroupInformation.create_remote_user("alice")
+    fs = alice.do_as(cluster.get_filesystem)
+    with pytest.raises(AccessControlError):
+        alice.do_as(lambda: fs.read_all("/private/inner.txt"))
+    with pytest.raises(AccessControlError):
+        alice.do_as(lambda: fs.list_status("/private"))
+
+
+def test_parent_write_gates_create_and_delete(cluster, root_fs):
+    alice = UserGroupInformation.create_remote_user("alice")
+    fs = alice.do_as(cluster.get_filesystem)
+    # /open is 777 → create allowed
+    alice.do_as(lambda: fs.write_all("/open/alice.txt", b"hi"))
+    assert alice.do_as(
+        lambda: fs.read_all("/open/alice.txt")) == b"hi"
+    # root-owned 755 dir → no write for alice
+    root_fs.mkdirs("/rootdir")
+    root_fs.set_permission("/rootdir", 0o755)
+    with pytest.raises(AccessControlError):
+        alice.do_as(lambda: fs.write_all("/rootdir/nope.txt", b"x"))
+    with pytest.raises(AccessControlError):
+        alice.do_as(lambda: fs.mkdirs("/rootdir/sub"))
+    # delete requires WRITE on the PARENT, not the file
+    root_fs.write_all("/rootdir/owned.txt", b"r")
+    with pytest.raises(AccessControlError):
+        alice.do_as(lambda: fs.delete("/rootdir/owned.txt"))
+
+
+def test_owner_and_superuser_gates_admin_ops(cluster, root_fs):
+    alice = UserGroupInformation.create_remote_user("alice")
+    fs = alice.do_as(cluster.get_filesystem)
+    with pytest.raises(AccessControlError):
+        alice.do_as(
+            lambda: fs.set_permission("/open/readable.txt", 0o777))
+    with pytest.raises(AccessControlError):
+        alice.do_as(
+            lambda: fs.set_owner("/open/readable.txt", "alice", "users"))
+    with pytest.raises(AccessControlError):
+        alice.do_as(lambda: fs.client.nn.set_quota("/open", 10, -1))
+    # alice CAN chmod her own file
+    alice.do_as(lambda: fs.write_all("/open/mine.txt", b"m"))
+    alice.do_as(lambda: fs.set_permission("/open/mine.txt", 0o600))
+    # ...which root still reads (superuser bypass)
+    assert root_fs.read_all("/open/mine.txt") == b"m"
+
+
+def test_named_acl_entry_grants_access(cluster, root_fs):
+    root_fs.write_all("/open/acl.txt", b"acl-gated")
+    root_fs.set_permission("/open/acl.txt", 0o600)
+    alice = UserGroupInformation.create_remote_user("alice")
+    bob = UserGroupInformation.create_remote_user("bob")
+    fs_a = alice.do_as(cluster.get_filesystem)
+    fs_b = bob.do_as(cluster.get_filesystem)
+    with pytest.raises(AccessControlError):
+        alice.do_as(lambda: fs_a.read_all("/open/acl.txt"))
+    root_fs.set_acl("/open/acl.txt", ["user:alice:r--"])
+    assert alice.do_as(
+        lambda: fs_a.read_all("/open/acl.txt")) == b"acl-gated"
+    with pytest.raises(AccessControlError):
+        bob.do_as(lambda: fs_b.read_all("/open/acl.txt"))
+
+
+def test_group_bits_apply(cluster, root_fs):
+    root_fs.write_all("/open/grp.txt", b"group-readable")
+    root_fs.set_permission("/open/grp.txt", 0o640)
+    root_fs.set_owner("/open/grp.txt", "root", "eng")
+    member = UserGroupInformation.create_remote_user("carol")
+    outsider = UserGroupInformation.create_remote_user("dave")
+    fs_m = member.do_as(cluster.get_filesystem)
+    fs_o = outsider.do_as(cluster.get_filesystem)
+    assert member.do_as(
+        lambda: fs_m.read_all("/open/grp.txt")) == b"group-readable"
+    with pytest.raises(AccessControlError):
+        outsider.do_as(lambda: fs_o.read_all("/open/grp.txt"))
+
+
+def test_supergroup_members_bypass_but_asserted_groups_do_not(
+        cluster, root_fs):
+    # opsadmin is in supergroup per the SERVER's static mapping
+    admin = UserGroupInformation.create_remote_user("opsadmin")
+    fs = admin.do_as(cluster.get_filesystem)
+    assert admin.do_as(
+        lambda: fs.read_all("/private/inner.txt")) == b"hidden"
+    # mallory CLAIMS supergroup client-side; the server's mapping says
+    # otherwise — asserted groups must carry no authority
+    mallory = UserGroupInformation("mallory", groups=["supergroup"])
+    fs_m = mallory.do_as(cluster.get_filesystem)
+    with pytest.raises(AccessControlError):
+        mallory.do_as(lambda: fs_m.read_all("/private/inner.txt"))
+
+
+def test_enforcement_can_be_disabled(tmp_path):
+    conf = fast_conf()
+    conf.set("dfs.permissions.enabled", "false")
+    with MiniDFSCluster(num_datanodes=1, conf=conf,
+                        base_dir=str(tmp_path)) as c:
+        c.wait_active()
+        fs = c.get_filesystem()
+        fs.write_all("/s.txt", b"x")
+        fs.set_permission("/s.txt", 0o600)
+        alice = UserGroupInformation.create_remote_user("alice")
+        fs_a = alice.do_as(c.get_filesystem)
+        assert alice.do_as(lambda: fs_a.read_all("/s.txt")) == b"x"
+
+
+def test_sticky_bit_protects_entries_in_shared_dirs(cluster, root_fs):
+    """1777 shared dirs (the staging-root shape): anyone may create,
+    but only an entry's owner (or the dir owner) may delete/rename it
+    (ref: FSPermissionChecker.checkStickyBit)."""
+    root_fs.mkdirs("/shared")
+    root_fs.set_permission("/shared", 0o1777)
+    alice = UserGroupInformation.create_remote_user("alice")
+    bob = UserGroupInformation.create_remote_user("bob")
+    fs_a = alice.do_as(cluster.get_filesystem)
+    fs_b = bob.do_as(cluster.get_filesystem)
+    alice.do_as(lambda: fs_a.write_all("/shared/af.txt", b"a"))
+    with pytest.raises(AccessControlError):
+        bob.do_as(lambda: fs_b.delete("/shared/af.txt"))
+    with pytest.raises(AccessControlError):
+        bob.do_as(lambda: fs_b.rename("/shared/af.txt", "/shared/bf"))
+    assert alice.do_as(lambda: fs_a.delete("/shared/af.txt"))
+    # without sticky, parent-write suffices for anyone
+    root_fs.set_permission("/shared", 0o777)
+    alice.do_as(lambda: fs_a.write_all("/shared/af2.txt", b"a"))
+    assert bob.do_as(lambda: fs_b.delete("/shared/af2.txt"))
+
+
+def test_recursive_delete_requires_subtree_access(cluster, root_fs):
+    """A 0700 subdir inside a world-writable dir must survive another
+    user's recursive delete of it (ref: FSPermissionChecker
+    checkSubAccess on recursive delete)."""
+    alice = UserGroupInformation.create_remote_user("alice")
+    bob = UserGroupInformation.create_remote_user("bob")
+    fs_a = alice.do_as(cluster.get_filesystem)
+    fs_b = bob.do_as(cluster.get_filesystem)
+    alice.do_as(lambda: fs_a.mkdirs("/open/adir"))
+    alice.do_as(lambda: fs_a.write_all("/open/adir/private.txt", b"p"))
+    alice.do_as(lambda: fs_a.set_permission("/open/adir", 0o700))
+    with pytest.raises(AccessControlError):
+        bob.do_as(lambda: fs_b.delete("/open/adir", recursive=True))
+    assert alice.do_as(
+        lambda: fs_a.read_all("/open/adir/private.txt")) == b"p"
+
+
+def test_reserved_xattr_namespaces_are_superuser_only(cluster, root_fs):
+    alice = UserGroupInformation.create_remote_user("alice")
+    fs_a = alice.do_as(cluster.get_filesystem)
+    alice.do_as(lambda: fs_a.write_all("/open/x.txt", b"x"))
+    alice.do_as(  # user namespace: fine on her own file
+        lambda: fs_a.set_xattr("/open/x.txt", "user.tag", b"v"))
+    with pytest.raises(AccessControlError):
+        alice.do_as(lambda: fs_a.set_xattr(
+            "/open/x.txt", "system.crypto.edek", b"forged"))
+    with pytest.raises(AccessControlError):
+        alice.do_as(lambda: fs_a.set_xattr(
+            "/open/x.txt", "trusted.prov", b"forged"))
