@@ -18,7 +18,8 @@ import secrets
 from typing import Dict, Optional, Tuple
 
 from hadoop_tpu.conf import Configuration
-from hadoop_tpu.dfs.webhdfs import PREFIX, _status_json
+from hadoop_tpu.dfs.webhdfs import (PREFIX, _status_json,
+                                    iter_as_caller)
 from hadoop_tpu.fs import FileSystem
 from hadoop_tpu.http.server import HttpServer
 from hadoop_tpu.security.http_auth import AuthFilter
@@ -67,6 +68,14 @@ class HttpFSServer(AbstractService):
     # ------------------------------------------------------------- handler
 
     def _handle(self, query: Dict, body: bytes) -> Tuple[int, object]:
+        # doAs the AUTHENTICATED caller like the NN-embedded face (ref:
+        # HttpFSServer's user resolution) — the gateway's own identity
+        # must not stand in for the remote user's on the NN, and the
+        # AuthFilter principal outranks any user.name parameter
+        from hadoop_tpu.security.http_auth import ugi_for_query
+        return ugi_for_query(query).do_as(self._handle_as, query, body)
+
+    def _handle_as(self, query: Dict, body: bytes) -> Tuple[int, object]:
         path = query["__path__"][len(PREFIX):] or "/"
         method = query["__method__"]
         op = query.get("op", "").upper()
@@ -89,6 +98,12 @@ class HttpFSServer(AbstractService):
             if op == "OPEN":
                 offset = int(query.get("offset", 0))
                 length = int(query.get("length", -1))
+                # authorize EAGERLY (while inside do_as, before the 200
+                # goes out): open() itself drives the NameNode's read
+                # check (get_block_locations → check_access), and
+                # closing immediately avoids a handle that would leak
+                # if the client vanished before the body streamed
+                fs.open(path).close()
 
                 def stream(path=path, offset=offset, length=length):
                     with fs.open(path) as f:
@@ -104,7 +119,7 @@ class HttpFSServer(AbstractService):
                             if left is not None:
                                 left -= len(data)
                             yield data
-                return 200, stream()
+                return 200, iter_as_caller(stream())
         elif method == "PUT":
             if op == "MKDIRS":
                 return 200, {"boolean": fs.mkdirs(path)}

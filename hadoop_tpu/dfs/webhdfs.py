@@ -28,6 +28,31 @@ log = logging.getLogger(__name__)
 PREFIX = "/webhdfs/v1"
 
 
+def iter_as_caller(it):
+    """Re-enter the CURRENT caller's UGI around every step of a lazy
+    stream: the HTTP server consumes response generators after the
+    handler's do_as scope has been reset, so without this an OPEN body
+    would read blocks as the daemon's own (super)user — bypassing the
+    permission check the handler just enforced.
+
+    Plain function wrapping an inner generator ON PURPOSE: a generator
+    function's body (including the current_user() capture) would not
+    run until the first next() — after do_as reset the contextvar —
+    and would capture the daemon's login user instead of the caller's.
+    """
+    from hadoop_tpu.security.ugi import current_user
+    ugi = current_user()  # evaluated NOW, inside the handler's do_as
+
+    def run():
+        while True:
+            try:
+                chunk = ugi.do_as(next, it)
+            except StopIteration:
+                return
+            yield chunk
+    return run()
+
+
 def _status_json(st: Dict) -> Dict:
     """FileStatus wire dict → WebHDFS FileStatus JSON shape."""
     return {
@@ -65,6 +90,14 @@ class WebHdfsHandler:
             self._client.close()
 
     def __call__(self, query: Dict, body: bytes) -> Tuple[int, object]:
+        # Execute AS the remote caller (ref: NamenodeWebHdfsMethods'
+        # ugi.doAs around every op) — without this, every REST request
+        # ran as the NameNode process user and bypassed permission
+        # enforcement.
+        from hadoop_tpu.security.http_auth import ugi_for_query
+        return ugi_for_query(query).do_as(self._handle, query, body)
+
+    def _handle(self, query: Dict, body: bytes) -> Tuple[int, object]:
         full = query["__path__"]
         path = full[len(PREFIX):] or "/"
         method = query["__method__"]
@@ -99,6 +132,12 @@ class WebHdfsHandler:
             if op == "OPEN":
                 offset = int(query.get("offset", 0))
                 length = int(query.get("length", -1))
+                # authorize EAGERLY, while still inside the handler's
+                # do_as and before the 200 status line goes out — the
+                # streamed body runs too late to turn a denial into an
+                # error response
+                from hadoop_tpu.dfs.namenode.permissions import READ
+                fsn.check_access(path, target=READ)
 
                 def stream(path=path, offset=offset, length=length):
                     # chunked: the daemon never holds the whole file
@@ -115,7 +154,7 @@ class WebHdfsHandler:
                             if left is not None:
                                 left -= len(data)
                             yield data
-                return 200, stream()
+                return 200, iter_as_caller(stream())
             if op == "GETXATTRS":
                 attrs = fsn.get_xattrs(path)
                 return 200, {"XAttrs": [

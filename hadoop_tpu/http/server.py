@@ -75,8 +75,11 @@ class HttpServer:
                             {"RemoteException": {
                                 "exception": type(e).__name__,
                                 "message": str(e)}}).encode()
+                        # AccessControlError is a PermissionError (ref:
+                        # WebHDFS maps AccessControlException → 403)
                         self.send_response(
-                            404 if isinstance(e, FileNotFoundError) else 500)
+                            404 if isinstance(e, FileNotFoundError) else
+                            403 if isinstance(e, PermissionError) else 500)
                         self.send_header("Content-Type", "application/json")
                         self.send_header("Content-Length",
                                          str(len(payload)))

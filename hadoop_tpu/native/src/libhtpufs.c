@@ -19,6 +19,7 @@
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <pwd.h>
 #include <stdint.h>
 #include <stdio.h>
 #include <stdlib.h>
@@ -31,6 +32,7 @@
 typedef struct htpufs_internal {
   char host[256];
   int port;
+  char user[64]; /* pseudo-auth identity sent as user.name on every op */
   char err[ERRLEN];
 } htpufs_t;
 
@@ -48,6 +50,24 @@ htpuFS htpufs_connect(const char *host, int port) {
   if (!fs) return NULL;
   snprintf(fs->host, sizeof(fs->host), "%s", host);
   fs->port = port;
+  /* Resolve the caller identity once (the WebHdfsFileSystem analog of
+   * appending user.name under SIMPLE auth): OS account first, then
+   * $USER, else the server applies its unprivileged default. Only
+   * URL-safe name characters are kept. */
+  const char *u = getenv("USER");
+  struct passwd *pw = getpwuid(geteuid());
+  if (pw && pw->pw_name && pw->pw_name[0]) u = pw->pw_name;
+  /* reject, never strip: dropping characters could collapse one
+   * account name into a DIFFERENT valid account; an unusable name
+   * stays empty and the server applies its unprivileged default */
+  if (u && u[0] && strlen(u) < sizeof(fs->user)) {
+    int ok = 1;
+    for (const char *p = u; *p; p++) {
+      if (!(isalnum((unsigned char)*p) || *p == '_' || *p == '-' ||
+            *p == '.')) { ok = 0; break; }
+    }
+    if (ok) snprintf(fs->user, sizeof(fs->user), "%s", u);
+  }
   return fs;
 }
 
@@ -99,11 +119,27 @@ static int http_request(htpuFS fs, const char *method, const char *target,
   int sock = dial(fs);
   if (sock < 0) return -1;
 
+  /* every target already carries "?op=", so the identity appends
+   * with '&'; an empty resolved user lets the server default apply.
+   * Sized past rename's two encoded paths, and CHECKED: a silent
+   * truncation would send an op against a chopped path. */
+  char full_target[2600];
+  int tn;
+  if (fs->user[0])
+    tn = snprintf(full_target, sizeof(full_target), "%s&user.name=%s",
+                  target, fs->user);
+  else
+    tn = snprintf(full_target, sizeof(full_target), "%s", target);
+  if (tn <= 0 || tn >= (int)sizeof(full_target)) {
+    set_err(fs, "request target too large%s", NULL);
+    close(sock);
+    return -1;
+  }
   char hdr[2048];
   int n = snprintf(hdr, sizeof(hdr),
                    "%s %s HTTP/1.1\r\nHost: %s:%d\r\n"
                    "Content-Length: %lld\r\nConnection: close\r\n\r\n",
-                   method, target, fs->host, fs->port,
+                   method, full_target, fs->host, fs->port,
                    (long long)(req_body ? req_body_len : 0));
   if (n <= 0 || n >= (int)sizeof(hdr)) {
     set_err(fs, "request too large%s", NULL);

@@ -111,3 +111,18 @@ class AuthFilter:
                 return status, payload, headers
             return out
         return wrapped
+
+
+def ugi_for_query(query) -> "UserGroupInformation":
+    """Resolve the UGI a REST handler should doAs (ref:
+    NamenodeWebHdfsMethods/HttpFSServer user resolution): the
+    AuthFilter-authenticated principal (``__user__``) outranks the
+    pseudo-auth ``user.name`` parameter — a caller must not execute as
+    someone other than who they authenticated as — and an anonymous or
+    absent identity falls to the reference's unprivileged default
+    "dr.who"."""
+    from hadoop_tpu.security.ugi import UserGroupInformation
+    user = query.get("__user__")
+    if user in (None, "", "anonymous"):
+        user = query.get("user.name") or "dr.who"
+    return UserGroupInformation.create_remote_user(user)

@@ -286,11 +286,19 @@ def test_httpfs_gateway(tmp_path):
         srv.start()
         try:
             base = f"http://127.0.0.1:{srv.port}/webhdfs/v1"
-            auth = "user.name=tester"
+            auth = "user.name=root"
             # unauthenticated → 401
             with pytest.raises(urllib.error.HTTPError) as exc:
                 urllib.request.urlopen(f"{base}/?op=LISTSTATUS")
             assert exc.value.code == 401
+            # authenticated as a non-superuser: a write into the
+            # root-owned tree is 403 — the gateway doAs-es the caller
+            # on the NameNode, not its own process identity
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"{base}/gw/nope?op=MKDIRS&user.name=tester",
+                    method="PUT"))
+            assert exc.value.code == 403
             # mkdirs + create + open + liststatus + delete
             req = urllib.request.Request(
                 f"{base}/gw/dir?op=MKDIRS&{auth}", method="PUT")

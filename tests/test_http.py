@@ -58,10 +58,18 @@ def cluster():
 def test_webhdfs_roundtrip(cluster):
     base = (f"http://127.0.0.1:{cluster.namenode.http.port}"
             f"/webhdfs/v1")
-    st, _ = _req(f"{base}/web/dir?op=MKDIRS", "PUT")
+    # no user.name → the unprivileged dr.who default: a write into the
+    # root-owned tree must be DENIED (the REST door honors the same
+    # permission model as RPC; ref: NamenodeWebHdfsMethods ugi.doAs)
+    with pytest.raises(urllib.error.HTTPError) as denied:
+        _req(f"{base}/web/anon?op=MKDIRS", "PUT")
+    assert denied.value.code == 403  # AccessControlException → Forbidden
+    assert "AccessControlError" in denied.value.read().decode()
+    st, _ = _req(f"{base}/web/dir?op=MKDIRS&user.name=root", "PUT")
     assert st == 200
     payload = b"webhdfs payload bytes"
-    st, _ = _req(f"{base}/web/dir/f.bin?op=CREATE", "PUT", payload)
+    st, _ = _req(f"{base}/web/dir/f.bin?op=CREATE&user.name=root",
+                 "PUT", payload)
     assert st == 201
     st, info = _get(f"{base}/web/dir/f.bin?op=GETFILESTATUS")
     assert info["FileStatus"]["length"] == len(payload)
@@ -76,8 +84,9 @@ def test_webhdfs_roundtrip(cluster):
     st, cs = _get(f"{base}/web?op=GETCONTENTSUMMARY")
     assert cs["ContentSummary"]["fileCount"] == 1
     st, _ = _req(f"{base}/web/dir/f.bin?op=RENAME&"
-                 f"destination=/web/dir/g.bin", "PUT")
-    st, _ = _req(f"{base}/web/dir/g.bin?op=DELETE", "DELETE")
+                 f"destination=/web/dir/g.bin&user.name=root", "PUT")
+    st, _ = _req(f"{base}/web/dir/g.bin?op=DELETE&user.name=root",
+                 "DELETE")
     st, ls = _get(f"{base}/web/dir?op=LISTSTATUS")
     assert ls["FileStatuses"]["FileStatus"] == []
 
@@ -162,7 +171,8 @@ def test_webhdfs_percent_encoded_paths_and_streaming(tmp_path):
         payload = _os.urandom(300_000)
 
         conn = http.client.HTTPConnection("127.0.0.1", port)
-        conn.request("PUT", "/webhdfs/v1/dir/a%20b?op=CREATE", body=payload)
+        conn.request("PUT", "/webhdfs/v1/dir/a%20b?op=CREATE&user.name=root",
+                     body=payload)
         assert conn.getresponse().read() and True
         # the native client sees the DECODED name
         fs = c.get_filesystem()

@@ -208,3 +208,33 @@ def test_reserved_xattr_namespaces_are_superuser_only(cluster, root_fs):
     with pytest.raises(AccessControlError):
         alice.do_as(lambda: fs_a.set_xattr(
             "/open/x.txt", "trusted.prov", b"forged"))
+
+
+def test_webhdfs_rest_door_honors_permissions(cluster, root_fs):
+    """The REST face executes as the pseudo-auth caller (doAs), not the
+    NameNode process user: dr.who (no user.name) cannot read a 0600
+    file — including via OPEN's lazy streamed body, which the HTTP
+    server consumes after the handler's do_as scope ended — while
+    user.name=alice works exactly where RPC-alice works."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    root_fs.write_all("/open/rest.txt", b"rest-gated")
+    root_fs.set_permission("/open/rest.txt", 0o600)
+    root_fs.set_acl("/open/rest.txt", ["user:alice:r--"])
+    base = (f"http://127.0.0.1:{cluster.namenode.http.port}"
+            f"/webhdfs/v1/open/rest.txt")
+    # anonymous OPEN: denied (403), even though the body is streamed
+    with pytest.raises(urllib.error.HTTPError) as denied:
+        urllib.request.urlopen(f"{base}?op=OPEN").read()
+    assert denied.value.code == 403
+    # the ACL-granted identity reads it
+    got = urllib.request.urlopen(
+        f"{base}?op=OPEN&user.name=alice").read()
+    assert got == b"rest-gated"
+    # stat as anonymous works (644-style traverse on /open), but
+    # a write as anonymous into a root-owned dir does not
+    st = _json.loads(urllib.request.urlopen(
+        f"{base}?op=GETFILESTATUS&user.name=alice").read())
+    assert st["FileStatus"]["length"] == len(b"rest-gated")
