@@ -170,7 +170,10 @@ class KMSKeyProvider(KeyProvider):
             import urllib.error
             if isinstance(e, urllib.error.HTTPError):
                 detail = e.read().decode(errors="replace")
-                if e.code == 500 and "PermissionError" in detail:
+                if e.code == 403 or (e.code == 500 and
+                                      "PermissionError" in detail):
+                    # the server maps PermissionError → 403 (older
+                    # servers used a generic 500)
                     raise PermissionError(detail) from e
                 raise IOError(f"KMS {e.code}: {detail}") from e
             raise
