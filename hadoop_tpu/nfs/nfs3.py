@@ -16,10 +16,14 @@ gateway).
 from __future__ import annotations
 
 import logging
+import pwd
 import threading
 import time
 from typing import Dict, Optional, Tuple
 
+from hadoop_tpu.security.ugi import (AccessControlError,
+                                     UserGroupInformation,
+                                     current_user)
 from hadoop_tpu.nfs.oncrpc import (Portmap, RpcCall, RpcProgram,
                                    RpcTcpServer, proc_unavailable)
 from hadoop_tpu.nfs.xdr import XdrDecoder, XdrEncoder
@@ -42,6 +46,7 @@ NFS3ERR_ISDIR = 21
 NFS3ERR_INVAL = 22
 NFS3ERR_NOTEMPTY = 66
 NFS3ERR_STALE = 70
+NFS3ERR_ACCES = 13
 NFS3ERR_NOTSUPP = 10004
 
 NF3REG = 1
@@ -100,8 +105,9 @@ class OpenFileCtx:
     """Sequential-write reassembly for one file (ref: OpenFileCtx.java —
     its nonSequentialWriteInMemory buffer does exactly this)."""
 
-    def __init__(self, stream):
+    def __init__(self, stream, owner: str = ""):
         self.stream = stream
+        self.owner = owner   # AUTH_SYS identity that opened the stream
         self.offset = 0                       # append cursor
         self.pending: Dict[int, bytes] = {}   # offset → parked data
         self.pending_bytes = 0
@@ -170,6 +176,8 @@ class OpenFileCtx:
                 if hasattr(self.stream, "flush"):
                     self.stream.flush()
                 return True
+            except AccessControlError:
+                raise  # mapped to NFS3ERR_ACCES in handle()
             except (OSError, IOError):
                 return False
 
@@ -178,6 +186,8 @@ class OpenFileCtx:
             stat = NFS3_OK if not self.pending else NFS3ERR_IO
             try:
                 self.stream.close()
+            except AccessControlError:
+                raise  # mapped to NFS3ERR_ACCES in handle()
             except (OSError, IOError):
                 stat = NFS3ERR_IO
             self.pending.clear()
@@ -226,6 +236,8 @@ class Nfs3Gateway(RpcProgram):
     def _post_op_attr(self, e: XdrEncoder, path: str) -> None:
         try:
             st = self.fs.get_file_status(path)
+        except AccessControlError:
+            raise  # mapped to NFS3ERR_ACCES in handle()
         except (FileNotFoundError, IOError):
             e.boolean(False)
             return
@@ -255,11 +267,20 @@ class Nfs3Gateway(RpcProgram):
         return e.getvalue()
 
     def _ctx_for(self, path: str, create: bool) -> Optional[OpenFileCtx]:
+        caller = current_user().user_name
         with self._ow_lock:
             ctx = self._open_writes.get(path)
+            if ctx is not None and ctx.owner != caller:
+                # the in-flight stream belongs to the principal that
+                # opened it: a different uid writing into it would
+                # bypass the fs-level check entirely (the bytes go to
+                # an already-authorized open stream)
+                raise AccessControlError(
+                    f"open write context on {path} belongs to "
+                    f"{ctx.owner!r}, not {caller!r}")
             if ctx is None and create:
                 stream = self.fs.create(path, overwrite=True)
-                ctx = OpenFileCtx(stream)
+                ctx = OpenFileCtx(stream, owner=caller)
                 self._open_writes[path] = ctx
             return ctx
 
@@ -271,6 +292,11 @@ class Nfs3Gateway(RpcProgram):
     def _sync_write(self, path: str) -> int:
         with self._ow_lock:
             ctx = self._open_writes.get(path)
+        if ctx is not None and ctx.owner != current_user().user_name:
+            # COMMIT is a write-class op on the in-flight stream: only
+            # its owner may drive it
+            raise AccessControlError(
+                f"open write context on {path} belongs to {ctx.owner!r}")
         if ctx is None:
             return NFS3_OK  # already closed/flushed: commit is satisfied
         with ctx.lock:
@@ -279,6 +305,8 @@ class Nfs3Gateway(RpcProgram):
                 if hasattr(ctx.stream, "flush"):
                     ctx.stream.flush()
                 return NFS3_OK
+            except AccessControlError:
+                raise  # mapped to NFS3ERR_ACCES in handle()
             except (IOError, OSError):
                 return NFS3ERR_IO
 
@@ -298,7 +326,78 @@ class Nfs3Gateway(RpcProgram):
         fn = table.get(proc)
         if fn is None:
             raise proc_unavailable()
-        return fn(x)
+        # Execute as the AUTH_SYS caller, not the gateway's own process
+        # user (ref: the reference NFS gateway's RpcProgram resolving
+        # the credential uid through IdUserGroup before touching the
+        # DFS): the uid in the RPC credential maps to an OS account
+        # name; an unmapped or absent credential gets the unprivileged
+        # "nobody", so the gateway is not a permission-bypass door.
+        # Denials come back as NFS3ERR_ACCES, the errno NFS clients
+        # understand (EIO would read as hardware trouble; NOENT would
+        # make rm -f report success on a file that still exists).
+        try:
+            return self._caller_ugi(call).do_as(fn, x)
+        except AccessControlError:
+            e = XdrEncoder().u32(NFS3ERR_ACCES)
+            # complete the per-procedure resfail body (RFC 1813): a
+            # bare status would be malformed XDR for procedures whose
+            # error arm carries wcc_data / post_op_attr, and a real
+            # kernel client would surface a decode failure as EIO
+            # instead of EACCES
+            for _ in range(self._RESFAIL_FALSE_BOOLEANS.get(proc, 0)):
+                e.boolean(False)
+            return e.getvalue()
+
+    # proc -> count of FALSE discriminators completing its resfail
+    # body: post_op_attr procs carry 1; wcc_data procs 2; RENAME 4
+    _RESFAIL_FALSE_BOOLEANS = {
+        1: 1, 2: 2, 3: 1, 4: 1, 6: 1, 7: 2, 8: 2, 9: 2, 12: 2, 13: 2,
+        14: 4, 16: 1, 17: 1, 18: 1, 19: 1, 20: 1, 21: 2,
+    }
+
+    # uid → account name, cached (ref: IdUserGroup's TTL'd map — the
+    # lookup can hit remote NSS and sits on the per-call hot path)
+    _uid_cache: Dict[int, Tuple[str, float]] = {}
+    _uid_cache_lock = threading.Lock()
+    _UID_TTL_S = 300.0
+    _UID_CACHE_MAX = 4096
+
+    @classmethod
+    def _user_for_uid(cls, uid: int) -> str:
+        now = time.monotonic()
+        with cls._uid_cache_lock:
+            hit = cls._uid_cache.get(uid)
+            if hit and now - hit[1] < cls._UID_TTL_S:
+                return hit[0]
+        try:
+            user = pwd.getpwuid(uid).pw_name
+        except KeyError:
+            user = f"uid-{uid}"                         # unmapped uid
+        with cls._uid_cache_lock:
+            if len(cls._uid_cache) >= cls._UID_CACHE_MAX:
+                # AUTH_SYS uids are attacker-chosen: bound the cache so
+                # a uid-sweeping client cannot grow gateway memory
+                expired = [u for u, (_, t) in cls._uid_cache.items()
+                           if now - t >= cls._UID_TTL_S]
+                for u in expired:
+                    del cls._uid_cache[u]
+                while len(cls._uid_cache) >= cls._UID_CACHE_MAX:
+                    cls._uid_cache.pop(next(iter(cls._uid_cache)))
+            cls._uid_cache[uid] = (user, now)
+        return user
+
+    @classmethod
+    def _caller_ugi(cls, call: RpcCall):
+        user = "nobody"
+        if call.cred_flavor == 1 and call.cred_body:   # AUTH_SYS/UNIX
+            try:
+                c = XdrDecoder(call.cred_body)
+                c.u32()                                 # stamp
+                c.string()                              # machine name
+                user = cls._user_for_uid(c.u32())
+            except Exception:  # noqa: BLE001 — malformed cred → nobody
+                pass
+        return UserGroupInformation.create_remote_user(user)
 
     # --------------------------------------------------------- procedures
 
@@ -309,6 +408,8 @@ class Nfs3Gateway(RpcProgram):
             return e.u32(NFS3ERR_STALE).getvalue()
         try:
             st = self.fs.get_file_status(path)
+        except AccessControlError:
+            raise  # mapped to NFS3ERR_ACCES in handle()
         except (FileNotFoundError, IOError):
             return e.u32(NFS3ERR_NOENT).getvalue()
         e.u32(NFS3_OK)
@@ -324,6 +425,8 @@ class Nfs3Gateway(RpcProgram):
             mode = x.u32()
             try:
                 self.fs.set_permission(path, mode & 0o7777)
+            except AccessControlError:
+                raise  # mapped to NFS3ERR_ACCES in handle()
             except (IOError, NotImplementedError):
                 pass
         if x.boolean():
@@ -347,6 +450,8 @@ class Nfs3Gateway(RpcProgram):
         child = self._child(dpath, name)
         try:
             st = self.fs.get_file_status(child)
+        except AccessControlError:
+            raise  # mapped to NFS3ERR_ACCES in handle()
         except (FileNotFoundError, IOError):
             e.u32(NFS3ERR_NOENT)
             self._post_op_attr(e, dpath)
@@ -358,15 +463,58 @@ class Nfs3Gateway(RpcProgram):
         self._post_op_attr(e, dpath)
         return e.getvalue()
 
+    # ACCESS3 request bits (RFC 1813)
+    _ACC_READ, _ACC_LOOKUP, _ACC_MODIFY = 0x01, 0x02, 0x04
+    _ACC_EXTEND, _ACC_DELETE, _ACC_EXECUTE = 0x08, 0x10, 0x20
+
     def _access(self, x: XdrDecoder) -> bytes:
         path = self._resolve(x.opaque())
         want = x.u32()
         e = XdrEncoder()
         if path is None:
             return e.u32(NFS3ERR_STALE).boolean(False).getvalue()
+        # Evaluate the mapped caller against the stored mode bits so
+        # the client's access(2) pre-check agrees with what the actual
+        # op will do (granting everything made editors open read-write
+        # and then fail). Approximation: owner bits for the owner,
+        # "other" bits for everyone else (the gateway doesn't know the
+        # caller's groups; the NameNode's own check remains the
+        # authority and may still deny more).
+        try:
+            st = self.fs.get_file_status(path)
+        except AccessControlError:
+            raise  # mapped to NFS3ERR_ACCES in handle()
+        except (FileNotFoundError, IOError):
+            return e.u32(NFS3ERR_STALE).boolean(False).getvalue()
+        user = current_user().user_name
+        mode = getattr(st, "permission", 0o755)
+        import getpass
+        if user == getpass.getuser():
+            # the gateway's own account is the DFS superuser in the
+            # deployments this gateway embeds in (minicluster / single
+            # daemon user) — under-granting would make admin clients
+            # refuse operations the server allows
+            bits = 7
+        elif user == getattr(st, "owner", ""):
+            bits = (mode >> 6) & 7
+        else:
+            from hadoop_tpu.security.groups import Groups
+            grp_name = getattr(st, "group", "")
+            if grp_name and grp_name in Groups().groups_for(user):
+                bits = (mode >> 3) & 7
+            else:
+                bits = mode & 7
+        granted = 0
+        if bits & 4:
+            granted |= self._ACC_READ
+        if bits & 2:
+            granted |= (self._ACC_MODIFY | self._ACC_EXTEND |
+                        self._ACC_DELETE)
+        if bits & 1:
+            granted |= self._ACC_LOOKUP | self._ACC_EXECUTE
         e.u32(NFS3_OK)
         self._post_op_attr(e, path)
-        e.u32(want & 0x3F)   # grant everything requested (AUTH_SYS only)
+        e.u32(want & granted)
         return e.getvalue()
 
     def _read(self, x: XdrDecoder) -> bytes:
@@ -383,6 +531,9 @@ class Nfs3Gateway(RpcProgram):
         with self._ow_lock:
             in_flight = path in self._open_writes
         if in_flight:
+            # authorize the read FIRST: a denied caller's READ must not
+            # finalize another user's in-flight stream as a side effect
+            self.fs.open(path).close()
             self._close_write(path)
         try:
             st = self.fs.get_file_status(path)
@@ -393,6 +544,8 @@ class Nfs3Gateway(RpcProgram):
             with self.fs.open(path) as f:
                 data = f.pread(offset, count) if hasattr(f, "pread") \
                     else self._seek_read(f, offset, count)
+        except AccessControlError:
+            raise  # mapped to NFS3ERR_ACCES in handle()
         except (FileNotFoundError, IOError) as ex:
             log.warning("NFS READ %s failed: %s", path, ex)
             e.u32(NFS3ERR_IO)
@@ -453,6 +606,8 @@ class Nfs3Gateway(RpcProgram):
         child = self._child(dpath, name)
         try:
             self._ctx_for(child, create=True)
+        except AccessControlError:
+            raise  # mapped to NFS3ERR_ACCES in handle()
         except (IOError, FileExistsError) as ex:
             log.warning("NFS CREATE %s failed: %s", child, ex)
             return self._err(NFS3ERR_IO, dpath)
@@ -474,6 +629,8 @@ class Nfs3Gateway(RpcProgram):
             return self._err(NFS3ERR_EXIST, dpath)
         try:
             self.fs.mkdirs(child)
+        except AccessControlError:
+            raise  # mapped to NFS3ERR_ACCES in handle()
         except IOError:
             return self._err(NFS3ERR_IO, dpath)
         e = XdrEncoder()
@@ -498,6 +655,8 @@ class Nfs3Gateway(RpcProgram):
         child = self._child(dpath, name)
         try:
             st = self.fs.get_file_status(child)
+        except AccessControlError:
+            raise  # mapped to NFS3ERR_ACCES in handle()
         except (FileNotFoundError, IOError):
             return self._err(NFS3ERR_NOENT, dpath)
         if st.is_dir != want_dir:
@@ -505,11 +664,18 @@ class Nfs3Gateway(RpcProgram):
                              else NFS3ERR_NOTDIR, dpath)
         if want_dir and self.fs.list_status(child):
             return self._err(NFS3ERR_NOTEMPTY, dpath)
-        self._close_write(child)
         try:
             self.fs.delete(child, recursive=want_dir)
+        except AccessControlError:
+            raise  # mapped to NFS3ERR_ACCES in handle()
         except IOError:
             return self._err(NFS3ERR_IO, dpath)
+        # only now finalize any in-flight stream: a DENIED remove must
+        # not close another user's write context as a side effect
+        try:
+            self._close_write(child)
+        except (AccessControlError, OSError):
+            pass  # the file is gone; the stream's fate is moot
         self.handles.removed(child)
         e = XdrEncoder()
         e.u32(NFS3_OK)
@@ -533,11 +699,30 @@ class Nfs3Gateway(RpcProgram):
         dst = self._child(to_dir, to_name)
         stat = NFS3_OK
         try:
-            self._close_write(src)
+            with self._ow_lock:
+                ctx = self._open_writes.get(src)
+            own_stream = ctx is not None and \
+                ctx.owner == current_user().user_name
+            if own_stream:
+                # the caller's own in-flight stream: finalize BEFORE the
+                # rename so the close completes under the path the
+                # stream was opened with
+                self._close_write(src)
             if not self.fs.rename(src, dst):
                 stat = NFS3ERR_IO
+            elif ctx is not None and not own_stream:
+                # a FOREIGN stream: the (authorized) rename decides —
+                # only then is finalizing it legitimate; its tail may be
+                # lost, which concurrent rename-during-write already
+                # implies
+                try:
+                    self._close_write(src)
+                except (AccessControlError, OSError):
+                    pass
         except FileNotFoundError:
             stat = NFS3ERR_NOENT
+        except AccessControlError:
+            raise  # mapped to NFS3ERR_ACCES in handle()
         except IOError:
             stat = NFS3ERR_IO
         if stat == NFS3_OK:
@@ -579,6 +764,8 @@ class Nfs3Gateway(RpcProgram):
                 return e.getvalue()
             entries = sorted(self.fs.list_status(path),
                              key=lambda s: s.path)
+        except AccessControlError:
+            raise  # mapped to NFS3ERR_ACCES in handle()
         except (FileNotFoundError, IOError):
             return e.u32(NFS3ERR_STALE).boolean(False).getvalue()
         e.u32(NFS3_OK)

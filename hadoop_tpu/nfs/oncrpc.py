@@ -272,7 +272,16 @@ class SimpleRpcClient:
         self._xid = 1
 
     def call(self, proc: int, args: bytes = b"",
-             uid: int = 0, gid: int = 0) -> XdrDecoder:
+             uid: Optional[int] = None,
+             gid: Optional[int] = None) -> XdrDecoder:
+        # default to the CALLING PROCESS's ids, not root's: the test
+        # suite must behave identically whoever runs it (uid 0 only
+        # maps to the DFS superuser when the daemons also run as root)
+        import os as _os
+        if uid is None:
+            uid = _os.getuid()
+        if gid is None:
+            gid = _os.getgid()
         self._xid += 1
         e = XdrEncoder()
         e.u32(self._xid).u32(RPC_CALL).u32(RPC_VERSION)

@@ -278,3 +278,66 @@ def test_readdir_honors_reply_budget(gateway):
     assert rounds > 1, "a 120-entry dir must take multiple rounds at 2KB"
     assert len(names) == 120
     nfs.close()
+
+
+def test_nfs_executes_as_the_auth_sys_caller(gateway):
+    """The gateway doAs-es the AUTH_SYS credential's uid, not its own
+    process user (ref: the reference NFS gateway's IdUserGroup uid
+    mapping): an unmapped non-root uid cannot read a 0600 root-owned
+    file or create in a root-owned 0755 dir through the NFS door."""
+    fs = gateway.nfs3.fs
+    fs.mkdirs("/nfssec")
+    fs.write_all("/nfssec/secret.bin", b"top")
+    fs.set_permission("/nfssec/secret.bin", 0o600)
+    fs.set_permission("/nfssec", 0o755)
+
+    root = _mount(gateway)
+    nfs = SimpleRpcClient("127.0.0.1", gateway.port, NFS_PROGRAM, 3)
+    # LOOKUP the dir + file as uid 0 (root → superuser, still allowed)
+    x = nfs.call(3, XdrEncoder().opaque(root).string("nfssec").getvalue())
+    assert x.u32() == 0
+    dir_fh = x.opaque()
+    x = nfs.call(3, XdrEncoder().opaque(dir_fh).string("secret.bin")
+                 .getvalue())
+    assert x.u32() == 0
+    file_fh = x.opaque()
+
+    # READ as unmapped uid 54321 → denied (nonzero NFS status)
+    args = XdrEncoder().opaque(file_fh).u64(0).u32(16)
+    x = nfs.call(6, args.getvalue(), uid=54321)
+    assert x.u32() != 0, "0600 file readable by arbitrary NFS uid"
+    # READ as root works
+    x = nfs.call(6, args.getvalue())
+    assert x.u32() == 0
+
+    # CREATE in the root-owned 755 dir as uid 54321 → denied
+    args = XdrEncoder().opaque(dir_fh).string("intruder").u32(0)
+    x = nfs.call(8, args.getvalue(), uid=54321)
+    assert x.u32() != 0, "root-owned dir writable by arbitrary NFS uid"
+
+
+def test_open_write_context_is_owner_bound(gateway):
+    """An in-flight write stream belongs to the principal that opened
+    it: a different AUTH_SYS uid writing at the cursor must get
+    NFS3ERR_ACCES, not have its bytes land in the other user's file
+    through the already-open stream (review finding)."""
+    root = _mount(gateway)
+    nfs = SimpleRpcClient("127.0.0.1", gateway.port, NFS_PROGRAM, 3)
+    x = nfs.call(9, XdrEncoder().opaque(root).string("wctx")
+                 .boolean(False).boolean(False).boolean(False)
+                 .boolean(False).u32(0).u32(0).getvalue())
+    assert x.u32() == 0 and x.boolean()
+    dir_fh = x.opaque()
+    x = nfs.call(8, XdrEncoder().opaque(dir_fh).string("f").u32(0)
+                 .getvalue())
+    assert x.u32() == 0 and x.boolean()
+    fh = x.opaque()
+    # owner writes the first chunk
+    w = XdrEncoder().opaque(fh).u64(0).u32(4).u32(2).opaque(b"mine")
+    assert nfs.call(7, w.getvalue()).u32() == 0
+    # a different uid tries to append at the cursor → ACCES (13)
+    w2 = XdrEncoder().opaque(fh).u64(4).u32(4).u32(2).opaque(b"evil")
+    assert nfs.call(7, w2.getvalue(), uid=54321).u32() == 13
+    # and COMMIT by the intruder is refused too
+    c = XdrEncoder().opaque(fh).u64(0).u32(0)
+    assert nfs.call(21, c.getvalue(), uid=54321).u32() == 13
