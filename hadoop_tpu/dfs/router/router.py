@@ -157,6 +157,24 @@ _PATH_METHODS = {
 _BROADCAST_METHODS = {"renew_lease", "msync", "report_bad_blocks"}
 
 
+def _forwarding_ugi(router):
+    """The UGI a forwarded downstream call must run under, or None to
+    keep the handler's own context. Only a SECURED router needs one:
+    effective = the RPC caller, real = the router's keytab login."""
+    if not router.secured:
+        return None
+    from hadoop_tpu.ipc.server import current_call
+    from hadoop_tpu.security.ugi import UserGroupInformation
+    ctx = current_call()
+    if ctx is None:
+        return None
+    login = UserGroupInformation.get_login_user()
+    if ctx.user.user_name == login.user_name:
+        return None
+    return UserGroupInformation.create_proxy_user(
+        ctx.user.user_name, login)
+
+
 class _RouterClientProtocol:
     """The forwarding ClientProtocol face (ref: RouterRpcServer +
     RouterClientProtocol.java)."""
@@ -170,6 +188,22 @@ class _RouterClientProtocol:
         router = self.router
 
         def call(*args, **kwargs):
+            # Caller identity reaches the downstream NameNode because
+            # the RPC server dispatches handlers under the caller's
+            # do_as and the IPC client resolves current_user() per
+            # call — in simple auth nothing more is needed (and
+            # re-wrapping would STRIP a proxied caller's real-user
+            # chain). A SECURED router is different: the caller has no
+            # SASL credentials here, so the downstream hop must ride a
+            # proxy-user chain authenticated by the router's own login
+            # (ref: RouterRpcClient's per-call proxy UGI + the
+            # downstream hadoop.proxyuser grant for the router).
+            fwd = _forwarding_ugi(router)
+            if fwd is not None:
+                return fwd.do_as(_invoke, *args, **kwargs)
+            return _invoke(*args, **kwargs)
+
+        def _invoke(*args, **kwargs):
             if method == "rename":
                 return router.rename(*args)
             if method in _BROADCAST_METHODS:
@@ -207,6 +241,20 @@ class Router(AbstractService):
         super().__init__("Router")
         self.state_dir = state_dir or conf.get(
             "dfs.federation.router.store.dir", "/tmp/htpu-router")
+        self.secured = conf.get("hadoop.security.authentication",
+                                "simple").lower() == "sasl"
+        if self.secured:
+            from hadoop_tpu.security.ugi import UserGroupInformation
+            login = UserGroupInformation.get_login_user()
+            if getattr(login, "sasl_password", None) is None:
+                # fail fast at construction: otherwise every forwarded
+                # call dies per-call deep in the downstream SASL
+                # handshake with no hint the ROUTER is misconfigured
+                raise ValueError(
+                    "secured router requires a keytab login "
+                    "(login_from_keytab) before construction — the "
+                    "downstream proxy-user chain authenticates as the "
+                    "router's own principal")
         self.store = StateStore(self.state_dir)
         self.mounts = MountTable(os.path.join(self.state_dir,
                                               "mounts.json"))

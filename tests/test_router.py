@@ -186,3 +186,63 @@ def test_quota_survives_router_restart(federation, tmp_path):
         assert r2.quotas.get("/cold", {}).get("ssquota") == 1 << 40
     finally:
         router.set_mount_quota("/cold", nsquota=-1, ssquota=-1)
+
+
+def test_router_forwards_caller_identity(federation, rfs):
+    """End-to-end identity lock through the router hop: the RPC
+    server's do_as dispatch + per-call client user resolution must keep
+    carrying the caller to the downstream NameNode (a refactor that
+    pins the forwarding connection to the router's own user would pass
+    every other router test — the data still flows — while silently
+    bypassing downstream permission enforcement)."""
+    from hadoop_tpu.security.ugi import (AccessControlError,
+                                         UserGroupInformation)
+    router, ns1, ns2 = federation
+    fs1 = ns1.get_filesystem()
+    fs1.mkdirs("/private")
+    fs1.set_permission("/private", 0o700)
+    fs1.write_all("/private/s.txt", b"locked")
+    fs1.mkdirs("/pub")
+    fs1.set_permission("/pub", 0o777)
+
+    alice = UserGroupInformation.create_remote_user("alice")
+    arfs = alice.do_as(lambda: DistributedFileSystem(
+        [("127.0.0.1", router.port)],
+        Configuration(load_defaults=False)))
+    with pytest.raises(AccessControlError):
+        alice.do_as(lambda: arfs.read_all("/warm/private/s.txt"))
+    alice.do_as(lambda: arfs.write_all("/warm/pub/a.txt", b"hi"))
+    # ...and the downstream file is OWNED by alice, not the router user
+    assert fs1.get_file_status("/pub/a.txt").owner == "alice"
+    # the superuser still reads through the router
+    assert rfs.read_all("/warm/private/s.txt") == b"locked"
+
+
+def test_secured_router_builds_proxy_chain(federation, monkeypatch):
+    """A SECURED router forwards as effective=caller over real=router
+    login (the caller has no SASL credentials at the router), and a
+    secured router without a keytab login refuses to construct."""
+    from hadoop_tpu.dfs.router import router as rmod
+    from hadoop_tpu.security.ugi import UserGroupInformation
+
+    router, _, _ = federation
+
+    class _Ctx:
+        user = UserGroupInformation.create_remote_user("alice")
+
+    monkeypatch.setattr("hadoop_tpu.ipc.server.current_call",
+                        lambda: _Ctx())
+    monkeypatch.setattr(router, "secured", True)
+    fwd = rmod._forwarding_ugi(router)
+    assert fwd is not None
+    assert fwd.user_name == "alice"
+    assert fwd.real_user is not None and \
+        fwd.real_user.user_name == \
+        UserGroupInformation.get_login_user().user_name
+    monkeypatch.setattr(router, "secured", False)
+    assert rmod._forwarding_ugi(router) is None
+
+    conf = Configuration(load_defaults=False)
+    conf.set("hadoop.security.authentication", "sasl")
+    with pytest.raises(ValueError, match="keytab"):
+        Router(conf, state_dir="/tmp/htpu-router-secured-test")
