@@ -172,6 +172,23 @@ class FSNamesystem:
 
     # ----------------------------------------------------------- permissions
 
+    @staticmethod
+    def check_path_names(*paths: str) -> None:
+        """Reject "." / ".." as COMPONENT names on name-CREATING ops
+        (ref: DFSUtil.isValidName, validated at the write boundary):
+        the namespace walks literally, so a directory literally named
+        ".." would make POSIX-normalizing clients and every
+        prefix-based rule (trash containment, encryption zones, mount
+        tables) address a different node than the one stored. Replay
+        and read/delete paths stay permissive so a legacy tree can
+        still be cleaned up."""
+        for p in paths:
+            for c in p.split("/"):
+                if c in (".", ".."):
+                    raise ValueError(
+                        f"invalid path component {c!r} in {p!r}")
+
+
     def check_access(self, path: str, *, parent: int = 0,
                      target: int = 0, owner_only: bool = False,
                      sub_dirs: int = 0) -> None:
@@ -397,6 +414,7 @@ class FSNamesystem:
                 pre_zone_key = self._zone_key_locked(path)
             if pre_zone_key is not None:
                 pre_edek = self._generate_edek_attr(pre_zone_key)
+        self.check_path_names(path)
         with self._m["create"].time():
             with self.lock.write():
                 self._check_not_safemode("create")
@@ -762,6 +780,7 @@ class FSNamesystem:
         # namespace (the fs2img tool's op) — superuser only, like the
         # reference's image-import path
         self.check_superuser("addProvidedFile")
+        self.check_path_names(path)
         block_size = block_size or self.default_block_size
         owner = current_user().user_name
         with self.lock.write():
@@ -880,6 +899,7 @@ class FSNamesystem:
     # ------------------------------------------------------------ mutations
 
     def mkdirs(self, path: str) -> bool:
+        self.check_path_names(path)
         with self._m["mkdirs"].time():
             owner = current_user().user_name
             with self.lock.write():
@@ -948,6 +968,7 @@ class FSNamesystem:
         return True
 
     def rename(self, src: str, dst: str) -> bool:
+        self.check_path_names(dst)
         with self._m["rename"].time():
             with self.lock.write():
                 self._check_not_safemode("rename")

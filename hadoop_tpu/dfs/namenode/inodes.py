@@ -123,6 +123,11 @@ class INodeDirectory(INode):
 
 
 def _components(path: str) -> List[str]:
+    # NOTE: deliberately permissive about "." / ".." — this resolver is
+    # shared with edit-log REPLAY and with cleanup of inodes a pre-fix
+    # tree may hold; name VALIDITY is enforced at the name-creating op
+    # entries instead (FSNamesystem.check_path_names, the reference's
+    # DFSUtil.isValidName placement).
     if not path.startswith("/"):
         raise ValueError(f"path must be absolute: {path!r}")
     return [c for c in path.split("/") if c]

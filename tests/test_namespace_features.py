@@ -287,3 +287,29 @@ def test_truncate_drops_and_trims(fs):
         assert f.read() == data[:150 * 1024]
     with pytest.raises(ValueError):
         fs.truncate("/tt/f", 10**9)
+
+
+def test_dot_and_dotdot_path_components_rejected(fs, cluster):
+    """'.'/'..' are invalid COMPONENT names on name-CREATING ops (ref:
+    DFSUtil.isValidName, validated at the write boundary): the
+    namespace walks literally, so a directory literally named '..'
+    would make POSIX-normalizing clients and prefix-based rules (trash
+    containment, encryption zones, mounts) address a different node
+    than the one stored (probe finding: mkdirs('/a/../b') created a
+    literal '..' child). Read/delete paths stay permissive so a tree
+    holding a pre-fix literal node can still be cleaned up."""
+    for bad in ("/a/../b", "/a/./b", "/..", "/."):
+        with pytest.raises((ValueError, OSError)):
+            fs.mkdirs(bad)
+        with pytest.raises((ValueError, OSError, FileNotFoundError)):
+            fs.write_all(bad + "/f", b"x")
+    fs.mkdirs("/renbase")
+    fs.write_all("/renbase/f", b"x")
+    with pytest.raises((ValueError, OSError)):
+        fs.rename("/renbase/f", "/renbase/../escape")
+    # cleanup escape hatch: a literal legacy node (fabricated below the
+    # validation boundary) is still deletable by path
+    fsn = cluster.namenode.fsn
+    with fsn.lock.write():
+        fsn.fsdir.mkdirs("/renbase/..", owner="root")
+    assert fs.delete("/renbase/..", recursive=True)
