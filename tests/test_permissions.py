@@ -238,3 +238,36 @@ def test_webhdfs_rest_door_honors_permissions(cluster, root_fs):
     st = _json.loads(urllib.request.urlopen(
         f"{base}?op=GETFILESTATUS&user.name=alice").read())
     assert st["FileStatus"]["length"] == len(b"rest-gated")
+
+
+def test_snapshot_paths_enforce_permissions(cluster, root_fs):
+    """The checker's .snapshot traversal branch: captured subtrees carry
+    the permissions they had at capture, and a non-owner is denied
+    through the snapshot path exactly as through the live one."""
+    root_fs.mkdirs("/snapperm")
+    root_fs.set_permission("/snapperm", 0o755)
+    root_fs.write_all("/snapperm/priv.txt", b"s")
+    root_fs.set_permission("/snapperm/priv.txt", 0o600)
+    root_fs.write_all("/snapperm/open.txt", b"o")
+    root_fs.set_permission("/snapperm/open.txt", 0o644)
+    root_fs.allow_snapshot("/snapperm")
+    root_fs.create_snapshot("/snapperm", "s1")
+
+    # flip the LIVE permissions after capture: the snapshot path must
+    # keep answering with the CAPTURED bits, proving resolution goes
+    # through the frozen copy rather than the live inode
+    root_fs.set_permission("/snapperm/priv.txt", 0o644)
+    root_fs.set_permission("/snapperm/open.txt", 0o600)
+
+    alice = UserGroupInformation.create_remote_user("alice")
+    fs_a = alice.do_as(cluster.get_filesystem)
+    assert alice.do_as(
+        lambda: fs_a.read_all("/snapperm/.snapshot/s1/open.txt")) == b"o"
+    with pytest.raises(AccessControlError):
+        alice.do_as(
+            lambda: fs_a.read_all("/snapperm/.snapshot/s1/priv.txt"))
+    # and the live paths answer with the NEW bits
+    assert alice.do_as(
+        lambda: fs_a.read_all("/snapperm/priv.txt")) == b"s"
+    with pytest.raises(AccessControlError):
+        alice.do_as(lambda: fs_a.read_all("/snapperm/open.txt"))
