@@ -271,3 +271,26 @@ def test_snapshot_paths_enforce_permissions(cluster, root_fs):
         lambda: fs_a.read_all("/snapperm/priv.txt")) == b"s"
     with pytest.raises(AccessControlError):
         alice.do_as(lambda: fs_a.read_all("/snapperm/open.txt"))
+
+
+def test_iter_as_caller_captures_identity_eagerly():
+    """iter_as_caller must capture the caller's UGI when CALLED (inside
+    the handler's do_as), not at first next() — a generator-function
+    version would evaluate current_user() after do_as reset the
+    context and silently run the stream as the daemon user (review
+    finding on the WebHDFS OPEN path)."""
+    from hadoop_tpu.dfs.webhdfs import iter_as_caller
+    from hadoop_tpu.security.ugi import UserGroupInformation, current_user
+
+    seen = []
+
+    def producer():
+        for _ in range(3):
+            seen.append(current_user().user_name)
+            yield b"x"
+
+    alice = UserGroupInformation.create_remote_user("alice")
+    wrapped = alice.do_as(lambda: iter_as_caller(producer()))
+    # consumed OUTSIDE do_as — the capture must already have happened
+    assert list(wrapped) == [b"x"] * 3
+    assert seen == ["alice"] * 3
