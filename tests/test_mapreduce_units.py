@@ -416,3 +416,19 @@ def test_shuffle_rejects_path_traversal_names(tmp_path):
         assert not os.path.exists(str(tmp_path / ".." / "evil"))
     finally:
         svc.stop()
+
+
+def test_shuffle_mac_binds_all_request_fields():
+    """A MAC minted for one request must not authorize another: op,
+    job, map, and partition are all bound, so a captured fetch MAC
+    cannot be replayed as a purge (or against another segment)."""
+    base = {"job": "j1", "map": "m0", "partition": 0}
+    secret = "s" * 64
+    mac = shuffle.request_mac(secret, base)
+    assert shuffle.request_mac(secret, dict(base, op="purge")) != mac
+    assert shuffle.request_mac(secret, dict(base, map="m1")) != mac
+    assert shuffle.request_mac(secret, dict(base, partition=1)) != mac
+    assert shuffle.request_mac(secret, dict(base, job="j2")) != mac
+    assert shuffle.request_mac("x" * 64, base) != mac
+    # deterministic for the same request
+    assert shuffle.request_mac(secret, dict(base)) == mac
