@@ -294,3 +294,22 @@ def test_iter_as_caller_captures_identity_eagerly():
     # consumed OUTSIDE do_as — the capture must already have happened
     assert list(wrapped) == [b"x"] * 3
     assert seen == ["alice"] * 3
+
+
+def test_group_mapping_static_precedence_and_isolation():
+    """security/groups.py: the static conf mapping outranks OS lookup,
+    unknown users resolve to no groups (never an error), and results
+    are copies (a caller mutating the list must not poison the map)."""
+    from hadoop_tpu.conf import Configuration
+    from hadoop_tpu.security.groups import Groups
+
+    conf = Configuration(load_defaults=False)
+    conf.set("hadoop.security.group.mapping.static.mapping",
+             "alice=eng,ops; bob=eng")
+    g = Groups(conf)
+    assert g.groups_for("alice") == ["eng", "ops"]
+    assert g.groups_for("bob") == ["eng"]
+    assert g.groups_for("no-such-user-xyz") == []
+    got = g.groups_for("alice")
+    got.append("supergroup")
+    assert "supergroup" not in g.groups_for("alice")
