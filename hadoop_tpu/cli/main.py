@@ -11,6 +11,7 @@ src/main/bin/hadoop + hadoop-functions.sh, hdfs/yarn/mapred CLIs):
   hadoop-tpu namenode|datanode|journalnode daemon launchers
   hadoop-tpu rm|nodeagent                  resource-manager daemons
   hadoop-tpu historyserver|kms|httpfs|router|registry   more daemons
+  hadoop-tpu serve --checkpoint URI --preset NAME   inference replica
   hadoop-tpu job -submit ...               MapReduce job control
   hadoop-tpu distcp SRC DST ...            distributed copy
   hadoop-tpu streaming --mapper CMD ...    external-process jobs
@@ -185,6 +186,12 @@ def _main(argv=None) -> int:
     if cmd == "registry":
         from hadoop_tpu.registry import RegistryServer
         return _run_daemon(RegistryServer(conf), conf)
+    if cmd == "serve":
+        # one serving replica: continuous-batching decode fed from a DFS
+        # checkpoint (hadoop_tpu.serving) — the YARN service packaging
+        # launches this same entry point per container
+        from hadoop_tpu.serving.service import replica_main
+        return replica_main(rest, conf)
     if cmd == "job":
         # ref: mapred job -list/-status/-kill
         from hadoop_tpu.util.misc import parse_addr_list

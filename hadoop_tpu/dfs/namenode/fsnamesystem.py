@@ -232,6 +232,36 @@ class FSNamesystem:
                 f"Superuser privilege required for {what} "
                 f"(user={ugi.user_name})")
 
+    def _check_set_owner_access(self, path: str, inode, owner: str,
+                                group: str) -> None:
+        """Ref: FSDirAttrOp.setOwner — changing the OWNER is superuser
+        territory, but a file's owner may chgrp it to any group they
+        belong to (server-resolved, never client-asserted)."""
+        if not self._perm_enabled:
+            return
+        from hadoop_tpu.dfs.namenode.permissions import FSPermissionChecker
+        from hadoop_tpu.ipc.server import current_call
+        from hadoop_tpu.security.ugi import AccessControlError
+        call = current_call()
+        ugi = call.user if call else current_user()
+        pc = FSPermissionChecker(
+            ugi.user_name, self._groups.groups_for(ugi.user_name),
+            self._superuser, self._supergroup)
+        if pc.is_superuser:
+            return
+        if owner and owner != inode.owner:
+            raise AccessControlError(
+                f"Superuser privilege required to change the owner of "
+                f"\"{path}\" (user={ugi.user_name})")
+        if ugi.user_name != inode.owner:
+            raise AccessControlError(
+                f"Permission denied: user={ugi.user_name} is not the "
+                f"owner of inode \"{path}\" (owner={inode.owner})")
+        if group and group not in pc.groups:
+            raise AccessControlError(
+                f"Permission denied: user={ugi.user_name} does not "
+                f"belong to group {group!r}")
+
     # ------------------------------------------------------------- lifecycle
 
     def load_from_disk(self, open_edits: bool = True) -> int:
@@ -1635,12 +1665,16 @@ class FSNamesystem:
         self.editlog.log_sync(txid)
 
     def set_owner(self, path: str, owner: str, group: str) -> None:
-        self.check_superuser("setOwner")
         self._check_mutable_path(path)
         with self.lock.write():
+            # traversal first (EXECUTE on every ancestor, like every
+            # other op): a caller who cannot reach the path must not
+            # learn whether it exists or who owns it
+            self.check_access(path)
             inode = self.fsdir.get_inode(path)
             if inode is None:
                 raise FileNotFoundError(path)
+            self._check_set_owner_access(path, inode, owner, group)
             if owner:
                 inode.owner = owner
             if group:

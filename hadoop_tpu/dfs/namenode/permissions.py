@@ -101,6 +101,13 @@ class FSPermissionChecker:
         i = 0
         while i < len(comps) and node is not None:
             if not isinstance(node, INodeDirectory):
+                # an intermediate component is a regular file: the
+                # target cannot exist under it. Treat as not-found (the
+                # op raises its own FileNotFoundError/NotADirectory)
+                # instead of applying target/sticky bits to the file
+                # inode (ref: the reference resolves this as an invalid
+                # path, not an access decision on the wrong inode).
+                node = None
                 break
             self._require(node, EXECUTE, path, "traverse")
             last_dir = node

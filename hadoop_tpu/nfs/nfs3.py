@@ -200,13 +200,20 @@ class Nfs3Gateway(RpcProgram):
     version = NFS_VERSION
     name = "nfs3"
 
-    def __init__(self, fs, export: str = "/"):
+    def __init__(self, fs, export: str = "/", conf=None):
         self.fs = fs
         self.export = export.rstrip("/") or "/"
         self.handles = FileHandleMap()
         self.root_fh = self.handles.fh_of(self.export)
         self._open_writes: Dict[str, OpenFileCtx] = {}
         self._ow_lock = threading.Lock()
+        # One Groups instance for the gateway's lifetime (ref: the
+        # reference gateway's long-lived IdUserGroup): the configured
+        # static mapping applies and the per-user TTL cache actually
+        # caches — a fresh Groups() per ACCESS call had neither.
+        from hadoop_tpu.security.groups import Groups
+        self.groups = Groups(conf if conf is not None else getattr(
+            getattr(fs, "client", None), "conf", None))
 
     # ------------------------------------------------------------ plumbing
 
@@ -498,9 +505,8 @@ class Nfs3Gateway(RpcProgram):
         elif user == getattr(st, "owner", ""):
             bits = (mode >> 6) & 7
         else:
-            from hadoop_tpu.security.groups import Groups
             grp_name = getattr(st, "group", "")
-            if grp_name and grp_name in Groups().groups_for(user):
+            if grp_name and grp_name in self.groups.groups_for(user):
                 bits = (mode >> 3) & 7
             else:
                 bits = mode & 7
@@ -533,7 +539,18 @@ class Nfs3Gateway(RpcProgram):
         if in_flight:
             # authorize the read FIRST: a denied caller's READ must not
             # finalize another user's in-flight stream as a side effect
-            self.fs.open(path).close()
+            try:
+                self.fs.open(path).close()
+            except AccessControlError:
+                raise  # mapped to NFS3ERR_ACCES in handle()
+            except (FileNotFoundError, IOError) as ex:
+                # transient failure opening the in-flight file is an IO
+                # error on THIS read, not an RPC system error — same
+                # resfail shape as the main read path below
+                log.warning("NFS READ %s auth-open failed: %s", path, ex)
+                e.u32(NFS3ERR_IO)
+                self._post_op_attr(e, path)
+                return e.getvalue()
             self._close_write(path)
         try:
             st = self.fs.get_file_status(path)
@@ -903,8 +920,8 @@ class NfsGateway:
     RpcProgramNfs3)."""
 
     def __init__(self, fs, export: str = "/", bind_host: str = "127.0.0.1",
-                 port: int = 0):
-        self.nfs3 = Nfs3Gateway(fs, export)
+                 port: int = 0, conf=None):
+        self.nfs3 = Nfs3Gateway(fs, export, conf=conf)
         self.mountd = Mountd(self.nfs3)
         self.portmap = Portmap()
         self.server = RpcTcpServer(bind_host, port)

@@ -1,0 +1,25 @@
+"""Decode-serving plane: continuous-batching TPU inference on YARN.
+
+The compute plane inherited from the reference is batch-only (PAPER.md
+§5.7/§5.8); this package opens the online workload. A serving replica is
+
+    loader.py   checkpoint straight from DFS (hedged reads for stragglers)
+    engine.py   continuous-batching decode engine, paged KV-cache pool
+    server.py   /v1/generate (streaming) + /v1/health on http.server
+    router.py   registry discovery + power-of-two-choices balancing
+    service.py  the replica packaged as a YARN long-running service
+    metrics.py  queue depth / occupancy / TTFT / tokens/s wiring
+
+Everything runs on the CPU mesh in tests and shards over ``tp`` via
+``parallel.mesh`` on real hardware.
+"""
+
+from hadoop_tpu.serving.engine import (BlockPool, DecodeEngine, GenRequest,
+                                       SamplingParams)
+from hadoop_tpu.serving.loader import load_serving_params
+from hadoop_tpu.serving.metrics import ServingMetrics
+
+__all__ = [
+    "BlockPool", "DecodeEngine", "GenRequest", "SamplingParams",
+    "load_serving_params", "ServingMetrics",
+]

@@ -1,0 +1,673 @@
+"""Continuous-batching decode engine over ``models.decoder`` weights.
+
+Design (TPU-first, same rules as the trainer):
+
+- **Fixed shapes, compile once.** Two jit-compiled functions cover the
+  whole lifetime of a replica: ``prefill`` (one request's prompt, padded
+  to ``S_max``) and ``decode_step`` (one token for every slot of the
+  fixed-size running batch). Requests of any length ride the same two
+  executables — no per-request retracing, ever. ``decode_compiles`` /
+  ``prefill_compiles`` count traces so tests and the bench can assert
+  exactly-once compilation.
+
+- **Paged KV cache.** K/V live in a block pool of shape
+  ``[L, num_blocks, block_size, Hkv, Dh]``; each running request owns a
+  block table (list of pool indices). The decode step scatters the new
+  token's K/V into ``table[pos // bs], pos % bs`` and gathers the
+  request's context back through the table — requests share one pool
+  with no per-request padding waste (the vLLM PagedAttention layout,
+  expressed as jnp scatter/gather so XLA keeps it fused). Block 0 is a
+  write-off scratch page: inactive batch lanes and prompt padding
+  scatter there, so masking never needs dynamic shapes.
+
+- **Continuous batching.** New requests are admitted at any step
+  boundary into free slots of the running batch (prefill fills their
+  cache while other requests keep decoding on the next step); finished
+  requests free their slot and blocks immediately. When the pool runs
+  dry the youngest request is preempted — its blocks are freed and it
+  re-queues for recompute-style re-admission (eviction policy of the
+  paged pool).
+
+- **Sharding.** Pass a ``MeshPlan`` (tp only) and the engine places the
+  weights with ``parallel.mesh.param_specs`` and the KV pool with heads
+  sharded over ``tp``; jit's SPMD partitioner inserts the decode
+  collectives. Under ``JAX_PLATFORMS=cpu`` the same code runs on the
+  virtual device mesh (tests) or a single device.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hadoop_tpu.models.config import ModelConfig
+from hadoop_tpu.ops import (apply_rope, causal_attention, gelu, layer_norm,
+                            rms_norm, rope_frequencies, swiglu)
+from hadoop_tpu.ops.attention import _repeat_kv
+from hadoop_tpu.tracing.tracer import global_tracer
+
+_NEG_INF = -1e30
+
+
+# ------------------------------------------------------------- block pool
+
+class BlockPool:
+    """Fixed pool of KV-cache pages. Block 0 is reserved scratch (padding
+    and inactive lanes scatter there), so ``num_blocks - 1`` are
+    allocatable. Allocation is all-or-nothing; freeing returns pages for
+    immediate reuse by the next admission."""
+
+    SCRATCH = 0
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (one is scratch)")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._free = deque(range(1, num_blocks))
+        self._lock = threading.Lock()
+
+    @property
+    def num_usable(self) -> int:
+        return self.num_blocks - 1
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        with self._lock:
+            if n > len(self._free):
+                return None
+            return [self._free.popleft() for _ in range(n)]
+
+    def free(self, blocks: List[int]) -> None:
+        with self._lock:
+            for b in blocks:
+                if b == self.SCRATCH:
+                    raise ValueError("freeing the scratch block")
+                self._free.append(b)
+
+
+# --------------------------------------------------------------- requests
+
+@dataclass
+class SamplingParams:
+    """Per-request decode controls. ``temperature <= 0`` is greedy;
+    ``top_k <= 0`` disables the top-k filter."""
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    top_k: int = 0
+    stop_token: Optional[int] = None
+
+
+_req_ids = itertools.count(1)
+
+QUEUED, RUNNING, FINISHED, FAILED = "QUEUED", "RUNNING", "FINISHED", "FAILED"
+
+
+@dataclass
+class GenRequest:
+    """One generation request. Tokens stream into ``tokens_out`` (a
+    Queue terminated by ``None``); ``done`` fires at completion."""
+    prompt: List[int]
+    sampling: SamplingParams
+    id: int = field(default_factory=lambda: next(_req_ids))
+    state: str = QUEUED
+    out_tokens: List[int] = field(default_factory=list)
+    tokens_out: "queue.Queue" = field(default_factory=queue.Queue)
+    done: threading.Event = field(default_factory=threading.Event)
+    error: Optional[str] = None
+    submitted_at: float = field(default_factory=time.monotonic)
+    first_token_at: Optional[float] = None
+    preemptions: int = 0
+    # engine-private placement
+    _slot: Optional[int] = None
+    _blocks: List[int] = field(default_factory=list)
+    _admit_seq: int = 0
+
+    def _deliver(self, token: int) -> None:
+        if self.first_token_at is None:
+            self.first_token_at = time.monotonic()
+        self.out_tokens.append(token)
+        self.tokens_out.put(token)
+
+    def _finish(self, state: str = FINISHED, error: str = None) -> None:
+        self.state = state
+        self.error = error
+        self.tokens_out.put(None)
+        self.done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> List[int]:
+        if not self.done.wait(timeout):
+            raise TimeoutError(f"request {self.id} not done")
+        if self.state == FAILED:
+            raise RuntimeError(self.error or "generation failed")
+        return list(self.out_tokens)
+
+
+# ----------------------------------------------------------------- engine
+
+def _norm(x, w, b, cfg: ModelConfig):
+    if cfg.use_rmsnorm:
+        return rms_norm(x, w, cfg.norm_eps)
+    return layer_norm(x, w, b, cfg.norm_eps)
+
+
+def _rope_at(x, cos, sin, pos):
+    """Rotate one token per batch row: x [B, H, Dh], pos [B]."""
+    c = cos[pos][:, None, :]
+    s = sin[pos][:, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = jnp.split(xf, 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _sample(logits, temps, topks, key):
+    """logits [B, V] float32; per-row temperature/top-k; greedy when
+    temperature <= 0 (the fused decode+sampling step of arxiv
+    2502.17728 — sampling stays inside the compiled program so no
+    [B, V] logits tensor crosses to the host)."""
+    v = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    srt = jnp.sort(logits, axis=-1)                       # ascending
+    kidx = jnp.clip(v - topks, 0, v - 1)
+    kth = jnp.take_along_axis(srt, kidx[:, None], axis=1)[:, 0]
+    use_topk = (topks > 0)[:, None]
+    masked = jnp.where(use_topk & (logits < kth[:, None]), _NEG_INF, logits)
+    scaled = masked / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temps <= 0, greedy, sampled)
+
+
+def _head(params, cfg: ModelConfig):
+    return params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+
+
+class DecodeEngine:
+    """Continuous-batching decode over a fixed slot batch and a paged KV
+    pool. Drive it either with the background scheduler thread
+    (``start``/``submit``/``stop`` — the serving replica) or by calling
+    ``step()`` directly (tests, offline bench)."""
+
+    def __init__(self, params, cfg: ModelConfig, *,
+                 max_batch: int = 4, block_size: int = 8,
+                 num_blocks: Optional[int] = None,
+                 max_context: Optional[int] = None,
+                 plan=None, metrics=None, tracer=None):
+        if cfg.is_moe:
+            raise NotImplementedError("serving MoE checkpoints is not "
+                                      "wired up yet (dense decoders only)")
+        self.cfg = cfg
+        self.max_batch = max_batch
+        self.block_size = block_size
+        self.max_context = min(max_context or cfg.max_seq, cfg.max_seq)
+        self.blocks_per_seq = -(-self.max_context // block_size)
+        self.s_max = self.blocks_per_seq * block_size
+        if self.s_max > cfg.max_seq:
+            # never round past the rope/pos-embed tables: positions
+            # beyond max_seq would silently clamp (wrong logits)
+            self.blocks_per_seq = cfg.max_seq // block_size
+            if self.blocks_per_seq == 0:
+                raise ValueError(f"block_size {block_size} exceeds the "
+                                 f"model's max_seq {cfg.max_seq}")
+            self.s_max = self.blocks_per_seq * block_size
+        if num_blocks is None:
+            num_blocks = max_batch * self.blocks_per_seq + 1
+        self.pool = BlockPool(num_blocks, block_size)
+        self.metrics = metrics
+        self.tracer = tracer or global_tracer()
+
+        self._mesh = None
+        if plan is not None:
+            from hadoop_tpu.parallel.mesh import (make_mesh, param_specs,
+                                                  shard_params)
+            if plan.pp != 1 or plan.sp != 1 or plan.ep != 1:
+                raise ValueError("serving shards over tp (and dp) only; "
+                                 f"got plan={plan}")
+            self._mesh = make_mesh(plan)
+            params = shard_params(params, self._mesh, param_specs(cfg, plan))
+        self.params = params
+
+        L, hkv, dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        pool_shape = (L, num_blocks, block_size, hkv, dh)
+        self._kp = jnp.zeros(pool_shape, cfg.jax_dtype)
+        self._vp = jnp.zeros(pool_shape, cfg.jax_dtype)
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            kv_sharding = NamedSharding(
+                self._mesh, P(None, None, None, "tp", None))
+            self._kp = jax.device_put(self._kp, kv_sharding)
+            self._vp = jax.device_put(self._vp, kv_sharding)
+
+        # host-side slot state (fixed shapes, rebuilt into jnp per step)
+        self._tables = np.zeros((max_batch, self.blocks_per_seq), np.int32)
+        self._seq_lens = np.zeros((max_batch,), np.int32)
+        self._last_tokens = np.zeros((max_batch,), np.int32)
+        self._active = np.zeros((max_batch,), bool)
+        self._temps = np.zeros((max_batch,), np.float32)
+        self._topks = np.zeros((max_batch,), np.int32)
+        self._slots: List[Optional[GenRequest]] = [None] * max_batch
+
+        self._pending: deque = deque()
+        self._admit_counter = itertools.count()
+        self._cond = threading.Condition()
+        self._sched_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._step_seed = itertools.count()
+        self.steps = 0
+        self.tokens_generated = 0
+        self.occupancy_log: List[int] = []      # active slots per step
+        self.decode_compiles = 0
+        self.prefill_compiles = 0
+        self._decode_fn = jax.jit(self._decode_impl, donate_argnums=(1, 2))
+        self._prefill_fn = jax.jit(self._prefill_impl, donate_argnums=(1, 2))
+
+    # ----------------------------------------------------- compiled bodies
+
+    def _rope_tables(self):
+        if not self.cfg.use_rope:
+            return None, None
+        return rope_frequencies(self.cfg.head_dim, self.cfg.max_seq,
+                                self.cfg.rope_theta)
+
+    def _mlp(self, x, lp):
+        if self.cfg.use_swiglu:
+            return swiglu(x @ lp["w_gate"], x @ lp["w_up"]) @ lp["w_down"]
+        return gelu(x @ lp["w_in"] + lp["b_in"]) @ lp["w_out"] + lp["b_out"]
+
+    def _decode_impl(self, params, kp, vp, tables, seq_lens, tokens,
+                     active, temps, topks, key):
+        """One token for every slot. tables [B, blocks_per_seq];
+        seq_lens[b] = tokens already cached = position of this token."""
+        self.decode_compiles += 1     # python side effect: trace counter
+        cfg = self.cfg
+        b = tables.shape[0]
+        hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        cos, sin = self._rope_tables()
+        h = params["embed"][tokens]
+        if not cfg.use_rope:
+            h = h + params["pos_embed"][
+                jnp.clip(seq_lens, 0, cfg.max_seq - 1)]
+        pos = seq_lens
+        blk = jnp.take_along_axis(
+            tables, (pos // self.block_size)[:, None], axis=1)[:, 0]
+        blk = jnp.where(active, blk, BlockPool.SCRATCH)
+        off = pos % self.block_size
+        scale = 1.0 / (dh ** 0.5)
+        kpos = jnp.arange(self.s_max)
+
+        def layer(h, xs):
+            lp, kc, vc = xs
+            x = _norm(h, lp["attn_norm_w"], lp.get("attn_norm_b"), cfg)
+            q = (x @ lp["wq"]).reshape(b, hq, dh)
+            k = (x @ lp["wk"]).reshape(b, hkv, dh)
+            v = (x @ lp["wv"]).reshape(b, hkv, dh)
+            if cfg.use_rope:
+                q = _rope_at(q, cos, sin, pos)
+                k = _rope_at(k, cos, sin, pos)
+            kc = kc.at[blk, off].set(k.astype(kc.dtype))
+            vc = vc.at[blk, off].set(v.astype(vc.dtype))
+            # paged gather: each row pulls its own pages back into a
+            # contiguous [S_max] context view through the block table
+            kctx = kc[tables].reshape(b, self.s_max, hkv, dh)
+            vctx = vc[tables].reshape(b, self.s_max, hkv, dh)
+            kr = _repeat_kv(kctx, hq // hkv)
+            vr = _repeat_kv(vctx, hq // hkv)
+            logits = jnp.einsum(
+                "bhd,bkhd->bhk", q, kr,
+                preferred_element_type=jnp.float32) * scale
+            mask = kpos[None, :] <= pos[:, None]
+            logits = jnp.where(mask[:, None, :], logits, _NEG_INF)
+            probs = jax.nn.softmax(logits, axis=-1).astype(vr.dtype)
+            attn = jnp.einsum("bhk,bkhd->bhd", probs, vr)
+            h2 = h + (attn.reshape(b, hq * dh) @ lp["wo"]).astype(h.dtype)
+            x2 = _norm(h2, lp["mlp_norm_w"], lp.get("mlp_norm_b"), cfg)
+            return h2 + self._mlp(x2, lp).astype(h.dtype), (kc, vc)
+
+        h, (kp, vp) = jax.lax.scan(layer, h, (params["layers"], kp, vp))
+        h = _norm(h, params["final_norm_w"], params.get("final_norm_b"),
+                  cfg)
+        logits = (h @ _head(params, cfg).astype(h.dtype)).astype(
+            jnp.float32)
+        return kp, vp, _sample(logits, temps, topks, key)
+
+    def _prefill_impl(self, params, kp, vp, tokens, length, block_row,
+                      temp, topk, key):
+        """One request's prompt, padded to S_max: fills its KV pages and
+        samples the first output token. tokens [S_max]; positions >=
+        length scatter to the scratch page and are causally invisible to
+        real positions."""
+        self.prefill_compiles += 1    # python side effect: trace counter
+        cfg = self.cfg
+        p = tokens.shape[0]
+        hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        cos, sin = self._rope_tables()
+        t = tokens[None]
+        h = params["embed"][t]
+        if not cfg.use_rope:
+            h = h + params["pos_embed"][:p][None]
+        p_idx = jnp.arange(p)
+        dest = block_row[p_idx // self.block_size]
+        dest = jnp.where(p_idx < length, dest, BlockPool.SCRATCH)
+        offs = p_idx % self.block_size
+
+        def layer(h, xs):
+            lp, kc, vc = xs
+            x = _norm(h, lp["attn_norm_w"], lp.get("attn_norm_b"), cfg)
+            q = (x @ lp["wq"]).reshape(1, p, hq, dh)
+            k = (x @ lp["wk"]).reshape(1, p, hkv, dh)
+            v = (x @ lp["wv"]).reshape(1, p, hkv, dh)
+            if cfg.use_rope:
+                q = apply_rope(q, cos, sin)
+                k = apply_rope(k, cos, sin)
+            kc = kc.at[dest, offs].set(k[0].astype(kc.dtype))
+            vc = vc.at[dest, offs].set(v[0].astype(vc.dtype))
+            attn = causal_attention(q, k, v)
+            h2 = h + (attn.reshape(1, p, hq * dh) @ lp["wo"]).astype(
+                h.dtype)
+            x2 = _norm(h2, lp["mlp_norm_w"], lp.get("mlp_norm_b"), cfg)
+            return h2 + self._mlp(x2, lp).astype(h.dtype), (kc, vc)
+
+        h, (kp, vp) = jax.lax.scan(layer, h, (params["layers"], kp, vp))
+        h_last = jnp.take(h[0], length - 1, axis=0)
+        h_last = _norm(h_last, params["final_norm_w"],
+                       params.get("final_norm_b"), cfg)
+        logits = (h_last @ _head(params, cfg).astype(h_last.dtype))[None] \
+            .astype(jnp.float32)
+        tok = _sample(logits, temp[None], topk[None], key)[0]
+        return kp, vp, tok
+
+    # -------------------------------------------------------- public face
+
+    def submit(self, prompt: List[int],
+               sampling: Optional[SamplingParams] = None) -> GenRequest:
+        sampling = sampling or SamplingParams()
+        if not prompt:
+            raise ValueError("empty prompt")
+        if sampling.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1 (prefill "
+                             "always emits the first token)")
+        if len(prompt) + sampling.max_new_tokens > self.s_max:
+            raise ValueError(
+                f"prompt({len(prompt)}) + max_new({sampling.max_new_tokens})"
+                f" exceeds engine max_context {self.s_max}")
+        pages = -(-(len(prompt) + sampling.max_new_tokens)
+                  // self.block_size)
+        if pages > self.pool.num_usable:
+            raise ValueError(
+                f"request needs {pages} KV pages but the pool holds only "
+                f"{self.pool.num_usable} — it could never run alone")
+        req = GenRequest(prompt=list(prompt), sampling=sampling)
+        with self._cond:
+            self._pending.append(req)
+            self._cond.notify_all()
+        if self.metrics:
+            self.metrics.requests.incr()
+            self.metrics.queue_depth.set(len(self._pending))
+        return req
+
+    @property
+    def num_active(self) -> int:
+        return int(self._active.sum())
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._pending)
+
+    @property
+    def idle(self) -> bool:
+        return not self._pending and not self._active.any()
+
+    # ------------------------------------------------------ the scheduler
+
+    def step(self) -> int:
+        """One scheduler iteration: admit waiting requests into free
+        slots, ensure every running request has a page for this step's
+        token, run one decode step, retire finished requests. Returns
+        the number of tokens emitted."""
+        with self._sched_lock:
+            self._admit()
+            self._ensure_blocks()
+            emitted = self._decode()
+            self._publish_metrics()
+            return emitted
+
+    def _admit(self) -> None:
+        while self._pending:
+            slot = next((i for i, r in enumerate(self._slots)
+                         if r is None), None)
+            if slot is None:
+                return
+            with self._cond:
+                if not self._pending:
+                    return
+                req = self._pending[0]
+            # prompt plus already-generated tokens (preempted requests
+            # resume by recompute); the first decode step after
+            # admission needs one more page slot for its token
+            ctx = req.prompt + req.out_tokens
+            need = -(-(len(ctx) + 1) // self.block_size)
+            blocks = self.pool.alloc(need)
+            if blocks is None:
+                # running requests outrank waiting ones (preemption only
+                # keeps the running set going, never feeds admission) —
+                # wait for retirements to return pages
+                return
+            with self._cond:
+                self._pending.popleft()
+            self._place(req, slot, blocks, ctx)
+
+    def _place(self, req: GenRequest, slot: int, blocks: List[int],
+               ctx: List[int]) -> None:
+        req.state = RUNNING
+        req._slot = slot
+        req._blocks = blocks
+        req._admit_seq = next(self._admit_counter)
+        self._slots[slot] = req
+        row = np.zeros((self.blocks_per_seq,), np.int32)
+        row[:len(blocks)] = blocks
+        self._tables[slot] = row
+        padded = np.zeros((self.s_max,), np.int32)
+        padded[:len(ctx)] = ctx
+        with self.tracer.span("serving.prefill") as sp:
+            sp.add_kv("request", str(req.id))
+            sp.add_kv("prompt_tokens", str(len(ctx)))
+            key = jax.random.PRNGKey(next(self._step_seed))
+            self._kp, self._vp, tok = self._prefill_fn(
+                self.params, self._kp, self._vp, jnp.asarray(padded),
+                np.int32(len(ctx)), jnp.asarray(row),
+                np.float32(req.sampling.temperature),
+                np.int32(req.sampling.top_k), key)
+        tok = int(tok)
+        self._seq_lens[slot] = len(ctx)
+        self._temps[slot] = req.sampling.temperature
+        self._topks[slot] = req.sampling.top_k
+        self._active[slot] = True
+        first = req.first_token_at is None
+        req._deliver(tok)
+        self._last_tokens[slot] = tok
+        self.tokens_generated += 1
+        if self.metrics:
+            self.metrics.tokens_out.incr()
+            if first:
+                self.metrics.ttft.add(
+                    req.first_token_at - req.submitted_at)
+        self._maybe_finish(req, tok)
+
+    def _ensure_blocks(self) -> None:
+        """Every active slot must own the page its next token lands in;
+        allocate at block boundaries, preempting the youngest request
+        when the pool is dry."""
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            # this step scatters K/V at position seq_lens[slot]; that
+            # page must be owned or the write would land in scratch and
+            # silently corrupt the request's context
+            need = int(self._seq_lens[slot]) // self.block_size + 1
+            while req._slot is not None and len(req._blocks) < need:
+                got = self.pool.alloc(1)
+                if got is not None:
+                    self._tables[slot][len(req._blocks)] = got[0]
+                    req._blocks.extend(got)
+                    continue
+                # pool dry: evict the youngest running request — which
+                # may be this one (then its slot empties and the loop
+                # ends; it resumes by recompute once pages free up)
+                victim = max((r for r in self._slots if r is not None),
+                             key=lambda r: r._admit_seq)
+                self._preempt(victim)
+
+    def _preempt(self, victim: GenRequest) -> None:
+        """vLLM-style recompute preemption: free the request's pages and
+        requeue it at the front; re-admission prefills prompt + tokens
+        generated so far."""
+        self._release_slot(victim)
+        victim.state = QUEUED
+        victim.preemptions += 1
+        with self._cond:
+            self._pending.appendleft(victim)
+        if self.metrics:
+            self.metrics.preemptions.incr()
+        self.tracer.span(f"serving.preempt.{victim.id}").finish()
+
+    def _release_slot(self, req: GenRequest) -> None:
+        slot = req._slot
+        if slot is None:
+            return
+        self.pool.free(req._blocks)
+        req._blocks = []
+        req._slot = None
+        self._slots[slot] = None
+        self._active[slot] = False
+        self._seq_lens[slot] = 0
+        self._tables[slot] = 0
+        self._last_tokens[slot] = 0
+
+    def _decode(self) -> int:
+        if not self._active.any():
+            return 0
+        t0 = time.monotonic()
+        key = jax.random.PRNGKey(next(self._step_seed))
+        self._kp, self._vp, nxt = self._decode_fn(
+            self.params, self._kp, self._vp, jnp.asarray(self._tables),
+            jnp.asarray(self._seq_lens), jnp.asarray(self._last_tokens),
+            jnp.asarray(self._active), jnp.asarray(self._temps),
+            jnp.asarray(self._topks), key)
+        nxt = np.asarray(nxt)
+        self.steps += 1
+        emitted = 0
+        self.occupancy_log.append(self.num_active)
+        if len(self.occupancy_log) > 100_000:
+            del self.occupancy_log[:50_000]
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            tok = int(nxt[slot])
+            self._seq_lens[slot] += 1
+            self._last_tokens[slot] = tok
+            req._deliver(tok)
+            emitted += 1
+            self._maybe_finish(req, tok)
+        self.tokens_generated += emitted
+        if self.metrics:
+            self.metrics.tokens_out.incr(emitted)
+            self.metrics.decode_step.add(time.monotonic() - t0)
+        return emitted
+
+    def _maybe_finish(self, req: GenRequest, tok: int) -> None:
+        sp = req.sampling
+        if len(req.out_tokens) >= sp.max_new_tokens or \
+                (sp.stop_token is not None and tok == sp.stop_token):
+            self._release_slot(req)
+            req._finish(FINISHED)
+
+    def _publish_metrics(self) -> None:
+        if not self.metrics:
+            return
+        m = self.metrics
+        m.queue_depth.set(len(self._pending))
+        m.batch_occupancy.set(self.num_active)
+        used = self.pool.num_usable - self.pool.num_free
+        m.kv_blocks_in_use.set(used)
+        m.kv_block_utilization.set(used / max(1, self.pool.num_usable))
+
+    # --------------------------------------------------- replica lifecycle
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run_loop,
+                                        name="decode-engine", daemon=True)
+        self._thread.start()
+
+    def stop(self, drain: bool = False, timeout: float = 30.0) -> None:
+        """``drain=True``: keep decoding until every queued and running
+        request completes (graceful replica shutdown), then stop."""
+        if drain and self._thread is not None:
+            deadline = time.monotonic() + timeout
+            while not self.idle and time.monotonic() < deadline:
+                time.sleep(0.01)
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+        # only touch slot/pool state under the scheduler lock — a step
+        # still stuck in compilation past the join timeout must not race
+        # a double-free of its KV pages; if the lock can't be had the
+        # pages stay allocated (the process is going down anyway)
+        locked = self._sched_lock.acquire(timeout=5.0)
+        try:
+            for req in list(self._pending) + \
+                    [r for r in self._slots if r]:
+                if not req.done.is_set():
+                    if locked:
+                        self._release_slot(req)
+                    req._finish(FAILED, "engine stopped")
+            self._pending.clear()
+        finally:
+            if locked:
+                self._sched_lock.release()
+
+    def _run_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._cond:
+                while self.idle and not self._stop.is_set():
+                    self._cond.wait(0.05)
+            if self._stop.is_set():
+                return
+            try:
+                self.step()
+            except Exception as e:  # noqa: BLE001 — fail requests, not
+                # the thread: a poisoned request must not wedge the
+                # replica with clients blocked on .done forever
+                for req in [r for r in self._slots if r] + \
+                        list(self._pending):
+                    if req._slot is not None:
+                        self._release_slot(req)
+                    req._finish(FAILED, f"decode failed: {e}")
+                self._pending.clear()
+
+    # ------------------------------------------------------------- offline
+
+    def generate(self, prompts: List[List[int]],
+                 sampling: Optional[SamplingParams] = None,
+                 ) -> List[List[int]]:
+        """Offline batch API: submit everything, step until done."""
+        reqs = [self.submit(p, sampling) for p in prompts]
+        while not all(r.done.is_set() for r in reqs):
+            self.step()
+        return [r.wait(0) for r in reqs]

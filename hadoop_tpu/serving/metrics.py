@@ -1,0 +1,51 @@
+"""Serving metrics — the replica's observability face.
+
+Registered into the process-wide ``metrics_system()`` so they surface
+through every existing sink: the ``/jmx`` endpoint of the replica's own
+HTTP server, file sinks, and the periodic publisher. Source name
+``serving.engine`` mirrors the ``namenode.ops`` convention.
+"""
+
+from __future__ import annotations
+
+from hadoop_tpu.metrics import metrics_system
+
+SOURCE = "serving.engine"
+
+
+class ServingMetrics:
+    """Queue depth / batch occupancy / TTFT / tokens/s / KV-pool usage.
+
+    - ``queue_depth``        requests waiting for a slot or pages
+    - ``batch_occupancy``    running requests in the fixed decode batch
+    - ``kv_blocks_in_use``   allocated KV pages (and a 0..1 utilization)
+    - ``time_to_first_token`` quantiles (s), submit → first token
+    - ``decode_step``        per-step latency rate (num_ops = steps)
+    - ``tokens_out``         generated tokens (monotonic; tokens/s is the
+                             derivative any sink can take)
+    - ``requests`` / ``preemptions`` lifetime counters
+    """
+
+    def __init__(self, source: str = SOURCE):
+        reg = metrics_system().source(source)
+        self.registry = reg
+        self.queue_depth = reg.gauge(
+            "queue_depth", "requests waiting for admission")
+        self.batch_occupancy = reg.gauge(
+            "batch_occupancy", "running requests in the decode batch")
+        self.kv_blocks_in_use = reg.gauge(
+            "kv_blocks_in_use", "allocated KV-cache pages")
+        self.kv_block_utilization = reg.gauge(
+            "kv_block_utilization", "fraction of the KV pool in use")
+        self.ttft = reg.quantiles(
+            "time_to_first_token", "submit to first token, seconds")
+        self.decode_step = reg.rate(
+            "decode_step", "one continuous-batching decode step")
+        self.tokens_out = reg.counter(
+            "tokens_out", "tokens generated (all requests)")
+        self.requests = reg.counter("requests", "requests submitted")
+        self.preemptions = reg.counter(
+            "preemptions", "requests evicted from the KV pool")
+
+    def snapshot(self):
+        return self.registry.snapshot()

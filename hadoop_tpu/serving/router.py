@@ -1,0 +1,230 @@
+"""Client-side router over serving replicas.
+
+Discovery comes from the service registry (``registry.registry``): every
+replica registers an ephemeral record under
+``/services/serving/<name>/<instance>`` with its HTTP endpoint; records
+vanish on lease expiry, so a dead replica falls out of the candidate set
+by itself, and a draining replica flips its ``state`` attribute before
+unregistering so the router stops picking it ahead of the TTL.
+
+Balancing is power-of-two-choices over the router's own outstanding
+request counts (the classic load-balancing result: two random probes +
+pick-the-lighter gets within a constant of perfect balance without any
+global state). Failures ride the IPC retry policies
+(``ipc.retry.RetryPolicies``): connection errors and 503-draining
+responses retry against a different replica with exponential backoff,
+deterministic application errors (400s) fail fast.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import random
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.ipc.errors import RetriableError
+from hadoop_tpu.ipc.retry import RetryAction, RetryPolicies, RetryPolicy
+from hadoop_tpu.registry.registry import RegistryClient, ServiceRecord
+
+log = logging.getLogger(__name__)
+
+REGISTRY_PREFIX = "/services/serving"
+
+
+def replica_path(service: str, instance: str) -> str:
+    return f"{REGISTRY_PREFIX}/{service}/{instance}"
+
+
+class NoReplicasError(RetriableError):
+    pass
+
+
+class ReplicaRequestError(Exception):
+    """Deterministic replica rejection (4xx): retrying the identical
+    request elsewhere cannot succeed, so this deliberately does NOT
+    subclass OSError/RetriableError — it fails fast through the retry
+    loop."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+
+
+class ServingRouter:
+    """Resolve + balance + retry over one serving service's replicas."""
+
+    def __init__(self, registry_addr: Tuple[str, int], service: str,
+                 conf: Optional[Configuration] = None,
+                 policy: Optional[RetryPolicy] = None,
+                 cache_ttl_s: float = 2.0):
+        self.conf = conf or Configuration()
+        self.service = service
+        self.reg = RegistryClient(registry_addr, self.conf)
+        self.policy = policy or RetryPolicies.exponential_backoff(
+            max_retries=self.conf.get_int("serving.router.max.retries", 6),
+            base_delay_s=0.05, max_delay_s=2.0)
+        self._cache_ttl = cache_ttl_s
+        self._cache: List[ServiceRecord] = []
+        self._cache_at = 0.0
+        self._outstanding: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ discovery
+
+    def replicas(self, refresh: bool = False) -> List[ServiceRecord]:
+        """Live, non-draining replicas (briefly cached: the registry is
+        one RPC away and the router sits on every request path)."""
+        now = time.monotonic()
+        with self._lock:
+            if not refresh and self._cache and \
+                    now - self._cache_at < self._cache_ttl:
+                return list(self._cache)
+        try:
+            recs = [r for r in self.reg.list(
+                        f"{REGISTRY_PREFIX}/{self.service}")
+                    if "http" in r.endpoints
+                    and r.attributes.get("state", "serving") == "serving"]
+        except (OSError, IOError) as e:
+            # registry briefly unreachable (restart, RPC timeout): the
+            # stale cache is a better answer than aborting every
+            # request mid-flight; with no cache the failure is
+            # retriable like any other transport error
+            with self._lock:
+                if self._cache:
+                    log.debug("registry lookup failed (%s); serving "
+                              "stale replica cache", e)
+                    return list(self._cache)
+            raise NoReplicasError(f"registry unreachable: {e}")
+        with self._lock:
+            self._cache = recs
+            self._cache_at = now
+        return list(recs)
+
+    def _pick(self, exclude: set) -> ServiceRecord:
+        """Power-of-two-choices on local outstanding counts."""
+        cands = [r for r in self.replicas() if r.path not in exclude]
+        if not cands:
+            cands = [r for r in self.replicas(refresh=True)
+                     if r.path not in exclude]
+        if not cands:
+            raise NoReplicasError(
+                f"no live replicas for {self.service}")
+        if len(cands) == 1:
+            return cands[0]
+        a, b = random.sample(cands, 2)
+        with self._lock:
+            la = self._outstanding.get(a.path, 0)
+            lb = self._outstanding.get(b.path, 0)
+        return a if la <= lb else b
+
+    # -------------------------------------------------------------- request
+
+    def generate(self, payload: Dict, user: Optional[str] = None) -> Dict:
+        """POST /v1/generate on a balanced replica; returns the decoded
+        JSON. Retries per policy on transport errors / draining."""
+        return self._with_retry(lambda rec: self._post(rec, payload, user))
+
+    def generate_stream(self, payload: Dict,
+                        user: Optional[str] = None) -> Iterator[Dict]:
+        """Streaming variant: yields one dict per JSON line. Replica
+        choice and retry apply to connection setup only — a stream that
+        dies mid-flight surfaces to the caller (resuming a half-decoded
+        request on another replica would re-emit tokens)."""
+        payload = dict(payload, stream=True)
+        resp, conn, rec = self._with_retry(
+            lambda rec: self._post(rec, payload, user, stream=True)
+            + (rec,))
+        # the stream holds its p2c weight for its whole life, not just
+        # connection setup — a minutes-long stream is real load
+        with self._lock:
+            self._outstanding[rec.path] = \
+                self._outstanding.get(rec.path, 0) + 1
+        try:
+            for raw in resp:
+                line = raw.strip()
+                if line:
+                    yield json.loads(line)
+        finally:
+            conn.close()
+            with self._lock:
+                n = self._outstanding.get(rec.path, 1)
+                self._outstanding[rec.path] = max(0, n - 1)
+
+    def _with_retry(self, fn):
+        retries = failovers = 0
+        exclude: set = set()
+        while True:
+            try:
+                rec = self._pick(exclude)
+            except NoReplicasError as e:
+                action = self.policy.should_retry(e, retries, failovers,
+                                                  True)
+                if action.action == RetryAction.FAIL:
+                    raise
+                retries += 1
+                time.sleep(max(action.delay_s, 0.05))
+                exclude.clear()
+                continue
+            with self._lock:
+                self._outstanding[rec.path] = \
+                    self._outstanding.get(rec.path, 0) + 1
+            try:
+                return fn(rec)
+            except (ConnectionError, OSError, RetriableError) as e:
+                exclude.add(rec.path)
+                action = self.policy.should_retry(e, retries, failovers,
+                                                  True)
+                log.debug("replica %s failed (%s); %s", rec.path, e,
+                          action.action)
+                if action.action == RetryAction.FAIL:
+                    raise
+                if action.action == RetryAction.FAILOVER_AND_RETRY:
+                    failovers += 1
+                retries += 1
+                if action.delay_s > 0:
+                    time.sleep(action.delay_s)
+            finally:
+                with self._lock:
+                    n = self._outstanding.get(rec.path, 1)
+                    self._outstanding[rec.path] = max(0, n - 1)
+
+    def _post(self, rec: ServiceRecord, payload: Dict,
+              user: Optional[str], stream: bool = False):
+        host, _, port = rec.endpoints["http"].rpartition(":")
+        path = "/v1/generate"
+        if user:
+            path += f"?user.name={user}"
+        conn = http.client.HTTPConnection(host, int(port), timeout=300.0)
+        try:
+            conn.request("POST", path, body=json.dumps(payload).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            if resp.status == 503:
+                # replica started draining between registry refreshes
+                raise RetriableError(f"replica {rec.path} draining")
+            if 400 <= resp.status < 500:
+                # deterministic rejection (bad request, auth): the same
+                # request fails everywhere — no retry
+                body = resp.read().decode(errors="replace")
+                raise ReplicaRequestError(
+                    resp.status, f"replica {rec.path}: {body}")
+            if resp.status != 200:
+                body = resp.read().decode(errors="replace")
+                raise RetriableError(
+                    f"replica {rec.path} -> {resp.status}: {body}")
+            if stream:
+                return resp, conn   # caller iterates + closes
+            data = json.loads(resp.read())
+        except Exception:
+            conn.close()
+            raise
+        conn.close()
+        return data
+
+    def close(self) -> None:
+        self.reg.close()

@@ -1,0 +1,172 @@
+"""HTTP front-end of a serving replica.
+
+Rides the embedded admin HTTP server (``http.server.HttpServer``), the
+same chassis every daemon exposes — so a replica gets ``/jmx`` (serving
+metrics), ``/conf``, ``/stacks`` for free next to its API:
+
+    POST /v1/generate   {"tokens": [...], "max_new_tokens": 8,
+                         "temperature": 0.7, "top_k": 40,
+                         "stream": true}
+    GET  /v1/health     liveness + load (queue depth, occupancy,
+                        free KV pages) — what the router balances on
+
+``/v1/generate`` is wrapped in the hadoop-auth filter
+(``security.http_auth.AuthFilter``): callers present ``?user.name=`` or
+the signed ``hadoop.auth`` cookie; anonymous access only if the
+deployment allows it. Streaming responses ride the chassis' chunked
+iterator payloads — one JSON line per token, then a terminal summary
+line — so a client renders tokens as they decode.
+
+``/v1/health`` stays outside the filter (liveness probes and the router
+must not need credentials — parity with every daemon's ``/health``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+from typing import Dict, Optional, Tuple
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.http.server import HttpServer
+from hadoop_tpu.security.http_auth import AuthFilter
+from hadoop_tpu.serving.engine import DecodeEngine, SamplingParams
+from hadoop_tpu.tracing.tracer import global_tracer
+
+log = logging.getLogger(__name__)
+
+SECRET_KEY = "serving.http.auth.secret"
+ANON_KEY = "serving.http.auth.anonymous.allowed"
+MAX_NEW_CAP_KEY = "serving.max.new.tokens"
+
+
+class ServingServer:
+    """One replica's HTTP door in front of a ``DecodeEngine``."""
+
+    def __init__(self, engine: DecodeEngine,
+                 conf: Optional[Configuration] = None,
+                 bind: Tuple[str, int] = ("127.0.0.1", 0)):
+        self.engine = engine
+        self.conf = conf or Configuration()
+        self.http = HttpServer(self.conf, bind, daemon_name="serving")
+        self.tracer = global_tracer()
+        self._draining = threading.Event()
+        self.max_new_cap = self.conf.get_int(MAX_NEW_CAP_KEY, 1024)
+        secret = self.conf.get(SECRET_KEY, "")
+        handler = self._generate
+        if secret:
+            filt = AuthFilter(
+                secret.encode(),
+                allow_anonymous=self.conf.get_bool(ANON_KEY, False))
+            handler = filt.wrap(handler)
+        self.http.add_handler("/v1/generate", handler)
+        self.http.add_handler("/v1/health", self._health)
+
+    # ------------------------------------------------------------ lifecycle
+
+    @property
+    def port(self) -> int:
+        return self.http.port
+
+    def start(self) -> None:
+        self.http.start()
+        log.info("serving replica on :%d (slots=%d, kv pages=%d)",
+                 self.port, self.engine.max_batch,
+                 self.engine.pool.num_usable)
+
+    def drain(self, timeout: float = 60.0) -> None:
+        """Graceful shutdown, phase 1: refuse new work (503 + draining
+        health so the router stops routing here), let the engine finish
+        what it holds."""
+        self._draining.set()
+        self.engine.stop(drain=True, timeout=timeout)
+
+    def stop(self) -> None:
+        if not self._draining.is_set():
+            self.engine.stop()
+        self.http.stop()
+
+    # ------------------------------------------------------------- handlers
+
+    def _health(self, query: Dict, body) -> Tuple[int, Dict]:
+        eng = self.engine
+        return 200, {
+            "status": "draining" if self._draining.is_set() else "serving",
+            "queue_depth": eng.queue_depth,
+            "active": eng.num_active,
+            "slots": eng.max_batch,
+            "kv_blocks_free": eng.pool.num_free,
+            "kv_blocks_total": eng.pool.num_usable,
+            "tokens_generated": eng.tokens_generated,
+        }
+
+    def _generate(self, query: Dict, body):
+        if self._draining.is_set():
+            return 503, {"RemoteException": {
+                "exception": "RetriableException",
+                "message": "replica draining"}}
+        try:
+            req = json.loads(body or b"{}")
+            tokens = req["tokens"]
+            if (not isinstance(tokens, list) or not tokens or
+                    not all(isinstance(t, int) for t in tokens)):
+                raise ValueError("'tokens' must be a non-empty int list")
+            sampling = SamplingParams(
+                max_new_tokens=min(int(req.get("max_new_tokens", 16)),
+                                   self.max_new_cap),
+                temperature=float(req.get("temperature", 0.0)),
+                top_k=int(req.get("top_k", 0)),
+                stop_token=req.get("stop_token"))
+        except (KeyError, TypeError, ValueError) as e:
+            return 400, {"RemoteException": {
+                "exception": "IllegalArgumentException",
+                "message": f"bad generate request: {e}"}}
+        span = self.tracer.span("serving.request")
+        span.add_kv("user", query.get("__user__", ""))
+        span.add_kv("prompt_tokens", str(len(tokens)))
+        try:
+            handle = self.engine.submit(tokens, sampling)
+        except ValueError as e:
+            span.finish()
+            return 400, {"RemoteException": {
+                "exception": "IllegalArgumentException",
+                "message": str(e)}}
+        span.add_kv("request", str(handle.id))
+        if str(req.get("stream", "")).lower() in ("1", "true", "yes") or \
+                req.get("stream") is True:
+            return 200, self._stream(handle, span)
+        out = handle.wait(timeout=float(req.get("timeout", 300.0)))
+        span.add_kv("tokens_out", str(len(out)))
+        span.finish()
+        return 200, {"request_id": handle.id, "tokens": out,
+                     "prompt_tokens": len(tokens)}
+
+    def _stream(self, handle, span):
+        """Chunked body: one JSON line per token, terminal summary line.
+        The chassis frames each yielded chunk; a killed connection just
+        ends the generator — the engine finishes the request and the
+        tokens fall on the floor, which is the right drop semantics."""
+        def gen():
+            try:
+                while True:
+                    try:
+                        tok = handle.tokens_out.get(timeout=300.0)
+                    except queue.Empty:
+                        yield (json.dumps(
+                            {"error": "timed out"}) + "\n").encode()
+                        return
+                    if tok is None:
+                        break
+                    yield (json.dumps({"token": tok}) + "\n").encode()
+                done = {"done": True, "request_id": handle.id,
+                        "tokens": list(handle.out_tokens)}
+                if handle.state == "FAILED":
+                    done = {"done": True, "error": handle.error,
+                            "request_id": handle.id}
+                yield (json.dumps(done) + "\n").encode()
+            finally:
+                span.add_kv("tokens_out", str(len(handle.out_tokens)))
+                span.finish()
+        return gen()

@@ -1,0 +1,193 @@
+"""The serving replica as a deployable unit.
+
+Two faces:
+
+- ``serving_service_spec`` packages N replicas as a YARN long-running
+  service (``yarn.services``): the RM places the containers, the service
+  AM restarts exited replicas (RESTART_ALWAYS), and ``flex`` scales the
+  replica count at runtime — serving capacity is a YARN knob, exactly
+  like every other long-running daemon on the cluster.
+
+- ``replica_main`` is what runs inside each container (and behind
+  ``hadoop-tpu serve``): pull the checkpoint from the DFS (hedged
+  reads), build the engine + HTTP server, register in the service
+  registry with an ephemeral lease, and on SIGTERM flip the registry
+  record to draining, finish in-flight requests, then exit — the
+  graceful-drain half of the router's balancing contract.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import socket
+import sys
+import threading
+import uuid
+from typing import List, Optional, Tuple
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.models.config import get_config
+from hadoop_tpu.serving.loader import (load_serving_params,
+                                       serving_read_defaults)
+from hadoop_tpu.serving.metrics import ServingMetrics
+from hadoop_tpu.serving.router import replica_path
+from hadoop_tpu.yarn.records import Resource
+from hadoop_tpu.yarn.services import (RESTART_ALWAYS, Component,
+                                      ServiceSpec)
+
+log = logging.getLogger(__name__)
+
+
+def serving_service_spec(name: str, *, checkpoint: str, preset: str,
+                         replicas: int = 2,
+                         registry_addr: Optional[str] = None,
+                         resource: Optional[Resource] = None,
+                         extra_args: Optional[List[str]] = None,
+                         ) -> ServiceSpec:
+    """YARN service spec: N identical replica containers."""
+    cmd = [sys.executable, "-m", "hadoop_tpu.serving.service",
+           "--replica", "--name", name,
+           "--checkpoint", checkpoint, "--preset", preset,
+           # containers land on arbitrary hosts: bind the wildcard so
+           # the replica advertises its hostname, not some loopback the
+           # router would resolve to its own machine
+           "--host", "0.0.0.0"]
+    if registry_addr:
+        cmd += ["--registry", registry_addr]
+    cmd += list(extra_args or [])
+    return ServiceSpec(name, [
+        Component("replica", replicas, cmd,
+                  resource=resource or Resource(1024, 1),
+                  restart_policy=RESTART_ALWAYS),
+    ])
+
+
+class ServingReplica:
+    """Engine + HTTP server + registry lease, wired for one process."""
+
+    def __init__(self, conf: Configuration, *, name: str,
+                 checkpoint: str, preset: str,
+                 registry_addr: Optional[Tuple[str, int]] = None,
+                 bind: Tuple[str, int] = ("127.0.0.1", 0),
+                 instance: Optional[str] = None):
+        from hadoop_tpu.fs import FileSystem, Path
+        from hadoop_tpu.serving.engine import DecodeEngine
+        from hadoop_tpu.serving.server import ServingServer
+        self.conf = conf
+        self.name = name
+        self.instance = instance or \
+            f"{socket.gethostname()}-{uuid.uuid4().hex[:8]}"
+        serving_read_defaults(conf)
+        cfg = get_config(preset)
+        fs = FileSystem.get(checkpoint, conf)
+        ckpt_dir = Path(checkpoint).path
+        params, step = load_serving_params(fs, ckpt_dir, cfg)
+        self.step = step
+        self.engine = DecodeEngine(
+            params, cfg,
+            max_batch=conf.get_int("serving.max.batch", 4),
+            block_size=conf.get_int("serving.kv.block.size", 16),
+            num_blocks=conf.get_int("serving.kv.num.blocks", 0) or None,
+            max_context=conf.get_int("serving.max.context", 0) or None,
+            metrics=ServingMetrics())
+        self.server = ServingServer(self.engine, conf, bind=bind)
+        # advertise a reachable address: the bind host when concrete, the
+        # hostname when bound to the wildcard (cross-host routing must
+        # not resolve to some other machine's loopback)
+        self.advertise_host = bind[0] if bind[0] not in ("", "0.0.0.0") \
+            else socket.gethostname()
+        self.reg = None
+        self._registry_addr = registry_addr
+        self._stopped = threading.Event()
+
+    def start(self) -> None:
+        self.engine.start()
+        self.server.start()
+        if self._registry_addr:
+            from hadoop_tpu.registry.registry import (RegistryClient,
+                                                      ServiceRecord)
+            self.reg = RegistryClient(self._registry_addr, self.conf)
+            self.record = ServiceRecord(
+                replica_path(self.name, self.instance),
+                endpoints={"http":
+                           f"{self.advertise_host}:{self.server.port}"},
+                attributes={"state": "serving",
+                            "slots": str(self.engine.max_batch),
+                            "step": str(self.step)})
+            self.reg.register(self.record, ttl_s=self.conf.get_time_seconds(
+                "serving.registry.ttl", 10.0))
+        log.info("serving replica %s/%s up on :%d (checkpoint step %d)",
+                 self.name, self.instance, self.server.port, self.step)
+
+    def drain_and_stop(self, timeout: float = 60.0) -> None:
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        if self.reg is not None:
+            # flip the record before unregistering so routers that hold
+            # a cached copy see 'draining' on their next refresh even if
+            # the lease outlives us briefly
+            self.record.attributes["state"] = "draining"
+            try:
+                self.reg.register(self.record, ttl_s=10.0,
+                                  auto_renew=False)
+            except Exception:  # noqa: BLE001 — drain must not hang on
+                pass           # a dead registry
+        self.server.drain(timeout=timeout)
+        if self.reg is not None:
+            try:
+                self.reg.unregister(self.record.path)
+            except Exception:  # noqa: BLE001
+                pass
+            self.reg.close()
+        self.server.stop()
+
+
+def replica_main(argv: List[str],
+                 conf: Optional[Configuration] = None) -> int:
+    """Entry point of one replica process (container / `serve` CLI)."""
+    conf = conf or Configuration()
+    args = dict(name="serving", checkpoint=None, preset="tiny",
+                registry=None, port=0, host="127.0.0.1")
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--replica":
+            i += 1
+            continue
+        key = a.lstrip("-").replace("-", "_")
+        if key in args and i + 1 < len(argv):
+            args[key] = argv[i + 1]
+            i += 2
+        else:
+            print(f"unknown serve option {a}", file=sys.stderr)
+            return 2
+    if not args["checkpoint"]:
+        print("usage: serve --checkpoint URI --preset NAME "
+              "[--name SVC] [--registry HOST:PORT] [--port N]",
+              file=sys.stderr)
+        return 2
+    registry_addr = None
+    if args["registry"]:
+        host, _, port = str(args["registry"]).rpartition(":")
+        registry_addr = (host or "127.0.0.1", int(port))
+    replica = ServingReplica(
+        conf, name=str(args["name"]), checkpoint=str(args["checkpoint"]),
+        preset=str(args["preset"]), registry_addr=registry_addr,
+        bind=(str(args["host"]), int(args["port"])))
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    replica.start()
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        replica.drain_and_stop()
+    return 0
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    sys.exit(replica_main(sys.argv[1:]))

@@ -341,3 +341,87 @@ def test_open_write_context_is_owner_bound(gateway):
     # and COMMIT by the intruder is refused too
     c = XdrEncoder().opaque(fh).u64(0).u32(0)
     assert nfs.call(21, c.getvalue(), uid=54321).u32() == 13
+
+
+def test_access_group_bits_use_gateway_groups_config(tmp_path):
+    """ACCESS resolves the caller's groups through the gateway's single
+    Groups(conf) instance, so the cluster's configured static mapping
+    applies (ADVICE round 5: a fresh conf-less Groups() per call lost
+    the static mapping and defeated the TTL cache)."""
+    conf = fast_conf()
+    conf.set("dfs.replication", "1")
+    # the unmapped-uid principal (uid-54321) belongs to a static group
+    conf.set("hadoop.security.group.mapping.static.mapping",
+             "uid-54321=nfsreaders")
+    with MiniDFSCluster(num_datanodes=1, conf=conf,
+                        base_dir=str(tmp_path)) as cluster:
+        cluster.wait_active()
+        fs = cluster.get_filesystem()
+        fs.write_all("/groupread.bin", b"data")
+        fs.set_permission("/groupread.bin", 0o640)
+        # owner is someone else entirely: only the GROUP bits can grant
+        fs.set_owner("/groupread.bin", "alice", "nfsreaders")
+        gw = NfsGateway(fs, export="/", conf=conf)
+        gw.start()
+        try:
+            # every ACCESS must consult the gateway's single Groups
+            # instance (not construct a fresh one)
+            consulted = []
+            orig_groups_for = gw.nfs3.groups.groups_for
+            gw.nfs3.groups.groups_for = \
+                lambda u: (consulted.append(u) or orig_groups_for(u))
+            root = _mount(gw)
+            nfs = SimpleRpcClient("127.0.0.1", gw.port, NFS_PROGRAM, 3)
+            x = nfs.call(3, XdrEncoder().opaque(root)
+                         .string("groupread.bin").getvalue())
+            assert x.u32() == 0
+            fh = x.opaque()
+            # ACCESS as unmapped uid 54321 -> "uid-54321" -> static
+            # group nfsreaders -> group bits (r--) grant READ
+            x = nfs.call(4, XdrEncoder().opaque(fh).u32(0x3f).getvalue(),
+                         uid=54321)
+            assert x.u32() == 0
+            x.boolean() and x.opaque_fixed(84)   # post_op_attr
+            granted = x.u32()
+            assert granted & 0x01, \
+                "static-mapped group bits must grant ACC_READ"
+            assert not granted & 0x04, "group r-- must not grant MODIFY"
+            assert consulted == ["uid-54321"], \
+                "ACCESS bypassed the gateway's Groups instance"
+            nfs.close()
+        finally:
+            gw.stop()
+
+
+def test_read_auth_open_ioerror_maps_to_nfs3err_io(gateway):
+    """A transient IOError from READ's eager authorization open of an
+    in-flight file must come back as NFS3ERR_IO, not escape as a
+    generic RPC system error (ADVICE round 5)."""
+    root = _mount(gateway)
+    nfs = SimpleRpcClient("127.0.0.1", gateway.port, NFS_PROGRAM, 3)
+    x = nfs.call(8, XdrEncoder().opaque(root).string("inflight").u32(0)
+                 .getvalue())
+    assert x.u32() == 0 and x.boolean()
+    fh = x.opaque()
+    w = XdrEncoder().opaque(fh).u64(0).u32(4).u32(2).opaque(b"data")
+    assert nfs.call(7, w.getvalue()).u32() == 0
+
+    orig_open = gateway.nfs3.fs.open
+    def flaky_open(path, *a, **kw):
+        raise IOError("transient NN/DN failure")
+    gateway.nfs3.fs.open = flaky_open
+    try:
+        r = XdrEncoder().opaque(fh).u64(0).u32(4)
+        x = nfs.call(6, r.getvalue())
+        assert x.u32() == 5, "expected NFS3ERR_IO resfail"
+    finally:
+        gateway.nfs3.fs.open = orig_open
+    # the stream was NOT finalized by the failed read; the owner can
+    # still read through the recovered fs (close-to-open finalize)
+    x = nfs.call(6, XdrEncoder().opaque(fh).u64(0).u32(4).getvalue())
+    assert x.u32() == 0
+    x.boolean() and x.opaque_fixed(84)
+    n = x.u32()
+    x.boolean()
+    assert x.opaque()[:n] == b"data"
+    nfs.close()

@@ -313,3 +313,55 @@ def test_group_mapping_static_precedence_and_isolation():
     got = g.groups_for("alice")
     got.append("supergroup")
     assert "supergroup" not in g.groups_for("alice")
+
+
+def test_intermediate_file_component_is_not_found_not_denied(
+        cluster, root_fs):
+    """A path THROUGH a regular file (/open/secret.txt/sub) must resolve
+    as target-not-found — not apply the target bits to the intermediate
+    file inode and fail with AccessControlError (ADVICE round 5; the
+    reference resolves this as an invalid path)."""
+    alice = UserGroupInformation.create_remote_user("alice")
+    fs = alice.do_as(cluster.get_filesystem)
+    # secret.txt is 0600 root-owned: pre-fix this raised
+    # AccessControlError from the READ check on the file inode
+    with pytest.raises(FileNotFoundError):
+        alice.do_as(lambda: fs.read_all("/open/secret.txt/sub"))
+    with pytest.raises(FileNotFoundError):
+        alice.do_as(lambda: fs.get_file_status("/open/secret.txt/sub"))
+
+
+def test_owner_can_chgrp_to_own_group(cluster, root_fs):
+    """Reference chgrp parity (FSDirAttrOp.setOwner): a file's owner may
+    change its group to a group they belong to (server-resolved); owner
+    changes stay superuser-only (ADVICE round 5)."""
+    carol = UserGroupInformation.create_remote_user("carol")  # eng
+    fs = carol.do_as(cluster.get_filesystem)
+    carol.do_as(lambda: fs.write_all("/open/carol.txt", b"c"))
+    # owner chgrp into her own (statically mapped) group: allowed
+    carol.do_as(lambda: fs.set_owner("/open/carol.txt", "", "eng"))
+    assert root_fs.get_file_status("/open/carol.txt").group == "eng"
+    # a group she does NOT belong to: denied
+    with pytest.raises(AccessControlError):
+        carol.do_as(lambda: fs.set_owner("/open/carol.txt", "", "wheel"))
+    # changing the OWNER is still superuser territory
+    with pytest.raises(AccessControlError):
+        carol.do_as(lambda: fs.set_owner("/open/carol.txt", "alice", ""))
+    # a non-owner cannot chgrp someone else's file even to a group of
+    # theirs
+    alice = UserGroupInformation.create_remote_user("alice")
+    afs = alice.do_as(cluster.get_filesystem)
+    with pytest.raises(AccessControlError):
+        alice.do_as(lambda: afs.set_owner("/open/carol.txt", "", "eng"))
+    # and the superuser chowns freely, as before
+    root_fs.set_owner("/open/carol.txt", "alice", "users")
+    st = root_fs.get_file_status("/open/carol.txt")
+    assert (st.owner, st.group) == ("alice", "users")
+    # set_owner on an untraversable path is denied at traversal and
+    # must not leak the inode's existence or owner
+    root_fs.mkdirs("/chgrp-locked")
+    root_fs.set_permission("/chgrp-locked", 0o700)
+    root_fs.write_all("/chgrp-locked/f", b"x")
+    with pytest.raises(AccessControlError) as ei:
+        carol.do_as(lambda: fs.set_owner("/chgrp-locked/f", "", "eng"))
+    assert "is not the owner" not in str(ei.value)
