@@ -87,6 +87,18 @@ def main() -> None:
     from benchmarks import nn_bench
     out["nnbench"] = nn_bench.run(maps=4, ops_per_map=int(200 * scale)
                                   or 40)
+    # Serving plane: tiny-config shared-prefix smoke (compile-once per
+    # shape + hit-rate > 0 + fewer engine steps with the prefix cache)
+    # so decode-path perf regressions surface in the bench trajectory.
+    # A smoke failure is recorded, not raised — it must not discard the
+    # benches already computed above.
+    try:
+        from benchmarks import serve_bench
+        out["serving"] = serve_bench.run_smoke()
+    except Exception as e:  # noqa: BLE001 — any serving failure (even
+        # an import-time one) is a data point for the trajectory, never
+        # a reason to lose the storage/compute numbers computed above
+        out["serving"] = {"error": f"{type(e).__name__}: {e}"}
     out["wall_seconds"] = round(time.perf_counter() - t0, 1)
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)

@@ -1,16 +1,31 @@
 """Offline serving benchmark: throughput + TTFT on synthetic traffic.
 
 Drives the continuous-batching engine the way a replica would see load:
-N requests with mixed prompt lengths submitted up front, the scheduler
-admitting them into the fixed slot batch as pages free up. Reports
-tokens/sec, TTFT p50/p99 (includes queue wait — the number a user
-feels), mean batch occupancy, and asserts the decode step compiled
-exactly once across the whole run.
+N requests submitted up front, the scheduler admitting them into the
+fixed slot batch as pages free up, prefill proceeding in fixed-size
+chunks fused into the decode step. Reports tokens/sec, TTFT p50/p99
+(includes queue wait — the number a user feels), mean batch occupancy,
+prefix-cache hit rate, and asserts the step compiled exactly once
+across the whole run.
+
+Two workload modes:
+
+- default: mixed-length independent prompts (admission order and page
+  pressure vary per request).
+- ``--shared-prefix``: grouped prompts sharing a long common head (the
+  production shape: shared system prompts, few-shot preambles, retry
+  storms). Runs the SAME workload twice — prefix cache disabled, then
+  enabled — and reports the TTFT delta the cache buys plus the hit
+  rate; exits nonzero unless the deterministic contract holds (hit
+  rate positive, strictly fewer engine steps with the cache, both
+  shapes compiled exactly once). A wall-clock TTFT inversion is
+  reported as a warning, not a failure (host-load noise).
 
 Runs under JAX_PLATFORMS=cpu (tiny preset) or on real hardware with a
 bigger preset. JSON output matches the BENCH_*.json shape::
 
     JAX_PLATFORMS=cpu python benchmarks/serve_bench.py
+    JAX_PLATFORMS=cpu python benchmarks/serve_bench.py --shared-prefix
     python benchmarks/serve_bench.py --preset flagship-420m --requests 64
 """
 
@@ -26,44 +41,67 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-import jax
-import numpy as np
+
+def _percentile(sorted_vals, p):
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(p * len(sorted_vals)))]
 
 
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--preset", default="tiny")
-    ap.add_argument("--requests", type=int, default=24)
-    ap.add_argument("--max-new", type=int, default=32)
-    ap.add_argument("--max-batch", type=int, default=8)
-    ap.add_argument("--block-size", type=int, default=16)
-    ap.add_argument("--max-context", type=int, default=128)
-    ap.add_argument("--seed", type=int, default=0)
-    args = ap.parse_args(argv)
+def _make_prompts(rng, cfg, s_max, requests, max_new, shared_prefix,
+                  prefix_groups, shared_len):
+    """Mixed-length independent prompts, or grouped prompts sharing a
+    long head. Group order is interleaved (g0 r0, g1 r0, ..., g0 r1,
+    ...) so every group's first request prefills cold before its
+    siblings arrive — the cache is earning hits, not being handed
+    them."""
+    import numpy as np
+    if not shared_prefix:
+        max_prompt = max(2, s_max - max_new - 1)
+        return [rng.integers(0, cfg.vocab_size,
+                             size=int(rng.integers(2, max_prompt + 1))
+                             ).tolist()
+                for _ in range(requests)]
+    tail_max = max(2, min(12, s_max - max_new - shared_len - 1))
+    heads = [rng.integers(0, cfg.vocab_size, size=shared_len).tolist()
+             for _ in range(prefix_groups)]
+    prompts = []
+    for i in range(requests):
+        head = heads[i % prefix_groups]
+        tail = rng.integers(0, cfg.vocab_size,
+                            size=int(rng.integers(2, tail_max + 1))
+                            ).tolist()
+        prompts.append(head + tail)
+    return prompts
+
+
+def run(preset="tiny", requests=24, max_new=32, max_batch=8,
+        block_size=16, max_context=128, chunk=16, seed=0,
+        shared_prefix=False, prefix_groups=4, shared_len=48,
+        prefix_cache=True) -> dict:
+    """One engine, one workload; returns the result dict."""
+    import jax
+    import numpy as np
 
     from hadoop_tpu.models.config import get_config
     from hadoop_tpu.models.decoder import count_params, init_params
     from hadoop_tpu.serving.engine import DecodeEngine, SamplingParams
     from hadoop_tpu.serving.metrics import ServingMetrics
 
-    cfg = get_config(args.preset)
-    rng = np.random.default_rng(args.seed)
-    params = init_params(jax.random.PRNGKey(args.seed), cfg)
-    engine = DecodeEngine(params, cfg, max_batch=args.max_batch,
-                          block_size=args.block_size,
-                          max_context=min(args.max_context, cfg.max_seq),
+    cfg = get_config(preset)
+    rng = np.random.default_rng(seed)
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    engine = DecodeEngine(params, cfg, max_batch=max_batch,
+                          block_size=block_size,
+                          max_context=min(max_context, cfg.max_seq),
+                          prefill_chunk=chunk,
+                          prefix_cache=prefix_cache,
                           metrics=ServingMetrics())
-    sampling = SamplingParams(max_new_tokens=args.max_new)
+    sampling = SamplingParams(max_new_tokens=max_new)
+    prompts = _make_prompts(rng, cfg, engine.s_max, requests, max_new,
+                            shared_prefix, prefix_groups, shared_len)
 
-    # mixed-length synthetic prompts (the realistic part of the load:
-    # admission order and page pressure vary per request)
-    max_prompt = max(2, engine.s_max - args.max_new - 1)
-    prompts = [
-        rng.integers(0, cfg.vocab_size,
-                     size=int(rng.integers(2, max_prompt + 1))).tolist()
-        for _ in range(args.requests)]
-
-    # warmup: trigger both compiles outside the timed window
+    # warmup: trigger the step compile outside the timed window (too
+    # short to seed the prefix cache: 2 tokens never fill a block)
     engine.generate([prompts[0][:2]], SamplingParams(max_new_tokens=2))
 
     t0 = time.monotonic()
@@ -76,43 +114,158 @@ def main(argv=None) -> int:
     tokens = sum(len(r.out_tokens) for r in reqs)
     ttfts_ms = sorted((r.first_token_at - r.submitted_at) * 1e3
                       for r in reqs)
-
-    def pct(p):
-        return ttfts_ms[min(len(ttfts_ms) - 1,
-                            int(p * len(ttfts_ms)))]
-
     occ = engine.occupancy_log
+    cache = engine.cache_stats()
     dev = jax.devices()[0]
-    result = {
+    return {
         "metric": "serve_tokens_per_sec",
         "value": round(tokens / elapsed, 1),
         "unit": "tokens/s",
-        "preset": args.preset,
+        "preset": preset,
         "n_params": count_params(params),
-        "requests": args.requests,
-        "max_new": args.max_new,
-        "batch_slots": args.max_batch,
-        "kv_block_size": args.block_size,
+        "requests": requests,
+        "max_new": max_new,
+        "batch_slots": max_batch,
+        "kv_block_size": block_size,
+        "prefill_chunk": chunk,
+        "prefix_cache_enabled": prefix_cache,
+        "shared_prefix": shared_prefix,
         "prompt_tokens": sum(len(p) for p in prompts),
         "generated_tokens": tokens,
         "elapsed_s": round(elapsed, 3),
         "decode_steps": engine.steps - steps0,
-        "ttft_p50_ms": round(pct(0.50), 2),
-        "ttft_p99_ms": round(pct(0.99), 2),
+        "ttft_p50_ms": round(_percentile(ttfts_ms, 0.50), 2),
+        "ttft_p99_ms": round(_percentile(ttfts_ms, 0.99), 2),
         "occupancy_mean": round(float(np.mean(occ)), 2) if occ else 0.0,
-        "preemptions": int(engine.metrics.preemptions.value()),
+        # engine-local, not the process-global metrics counter: two
+        # runs in one process (the cache-on/off comparison) must not
+        # bleed counts into each other
+        "preemptions": sum(r.preemptions for r in reqs),
+        "prefix_cache_hit_rate": round(cache["hit_rate"], 4),
+        "prefix_tokens_matched": cache["tokens_matched"],
+        "prefix_cache_evictions": cache["evictions"],
         "decode_compiles": engine.decode_compiles,
         "prefill_compiles": engine.prefill_compiles,
         "device": getattr(dev, "device_kind", str(dev)),
     }
-    if engine.decode_compiles != 1:
-        print(f"FAIL: decode step compiled {engine.decode_compiles} "
-              f"times (expected exactly 1 — shape retracing crept in)",
-              file=sys.stderr)
-        print(json.dumps(result))
-        return 1
+
+
+def run_shared_prefix(**kw) -> dict:
+    """The cache-value measurement: same seed/config/workload twice —
+    prefix cache off, then on. ``failed`` (the CI/exit-code contract)
+    carries only DETERMINISTIC checks: compile-once per shape, positive
+    hit rate, and a strictly lower engine step count with the cache
+    (skipped prefill chunks always mean fewer steps — the
+    noise-immune form of the TTFT win). The wall-clock TTFT p50
+    comparison is reported, and an inversion lands in ``warnings``
+    (advisory: a loaded host can blur millisecond timings even while
+    the cache is demonstrably working)."""
+    kw["shared_prefix"] = True
+    no_cache = run(prefix_cache=False, **kw)
+    cache = run(prefix_cache=True, **kw)
+    warnings = []
+    if cache["requests"] <= cache["batch_slots"]:
+        # every request admits into a free slot before any sibling's
+        # prefill publishes its blocks — the whole wave runs cold and
+        # the hit-rate/steps contract below cannot hold
+        warnings.append(
+            f"requests ({cache['requests']}) <= batch slots "
+            f"({cache['batch_slots']}): the entire workload admits "
+            f"cold; use more requests than slots to measure reuse")
+    result = {
+        "metric": "serve_shared_prefix_ttft_p50_ms",
+        "value": cache["ttft_p50_ms"],
+        "unit": "ms",
+        "no_cache": no_cache,
+        "cache": cache,
+        "ttft_p50_delta_ms": round(
+            no_cache["ttft_p50_ms"] - cache["ttft_p50_ms"], 2),
+        "steps_delta": no_cache["decode_steps"] - cache["decode_steps"],
+        "prefix_cache_hit_rate": cache["prefix_cache_hit_rate"],
+        "failed": [],
+        "warnings": warnings,
+    }
+    for name, r in (("no_cache", no_cache), ("cache", cache)):
+        for counter in ("decode_compiles", "prefill_compiles"):
+            if r[counter] != 1:
+                result["failed"].append(
+                    f"{name}: {counter} == {r[counter]} (expected "
+                    f"exactly 1 — shape retracing crept in)")
+    if cache["prefix_cache_hit_rate"] <= 0:
+        result["failed"].append("prefix cache never hit on a "
+                                "shared-prefix workload")
+    if cache["decode_steps"] >= no_cache["decode_steps"]:
+        result["failed"].append(
+            f"prefix cache did not reduce engine steps: "
+            f"{cache['decode_steps']} vs {no_cache['decode_steps']} "
+            f"without it")
+    if cache["ttft_p50_ms"] >= no_cache["ttft_p50_ms"]:
+        result["warnings"].append(
+            f"TTFT p50 wall-clock did not improve this run: cache "
+            f"{cache['ttft_p50_ms']}ms vs no-cache "
+            f"{no_cache['ttft_p50_ms']}ms (host load noise; the step "
+            f"count fell {no_cache['decode_steps']} -> "
+            f"{cache['decode_steps']})")
+    return result
+
+
+def run_smoke() -> dict:
+    """Tiny-config shared-prefix smoke for benchmarks.run_all: raises
+    unless the deterministic contract holds (compile-once per shape,
+    hit rate > 0, fewer engine steps with the cache). TTFT deltas ride
+    along in the result for the trajectory."""
+    result = run_shared_prefix(preset="tiny", requests=10, max_new=4,
+                               max_batch=4, block_size=4,
+                               max_context=64, chunk=8, seed=0,
+                               prefix_groups=2, shared_len=24)
+    if result["failed"]:
+        raise AssertionError("; ".join(result["failed"]))
+    return result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="tiny")
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--max-new", type=int, default=32)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--max-context", type=int, default=128)
+    ap.add_argument("--chunk", type=int, default=16,
+                    help="prefill tokens per engine step")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--shared-prefix", action="store_true",
+                    help="grouped shared-head workload, run with the "
+                         "prefix cache off then on; fails unless hit "
+                         "rate is positive, the cache strictly reduces "
+                         "engine steps, and both step shapes compile "
+                         "exactly once (a wall-clock TTFT inversion is "
+                         "a warning, not a failure)")
+    ap.add_argument("--prefix-groups", type=int, default=4)
+    ap.add_argument("--shared-len", type=int, default=80)
+    ap.add_argument("--no-prefix-cache", action="store_true",
+                    help="disable the prefix cache (default mode only)")
+    args = ap.parse_args(argv)
+
+    kw = dict(preset=args.preset, requests=args.requests,
+              max_new=args.max_new, max_batch=args.max_batch,
+              block_size=args.block_size, max_context=args.max_context,
+              chunk=args.chunk, seed=args.seed)
+    if args.shared_prefix:
+        result = run_shared_prefix(prefix_groups=args.prefix_groups,
+                                   shared_len=args.shared_len, **kw)
+        failed = result["failed"]
+        for msg in result["warnings"]:
+            print(f"WARN: {msg}", file=sys.stderr)
+    else:
+        result = run(prefix_cache=not args.no_prefix_cache, **kw)
+        failed = [] if result["decode_compiles"] == 1 else [
+            f"step compiled {result['decode_compiles']} times "
+            f"(expected exactly 1 — shape retracing crept in)"]
+    for msg in failed:
+        print(f"FAIL: {msg}", file=sys.stderr)
     print(json.dumps(result))
-    return 0
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

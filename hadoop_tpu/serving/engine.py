@@ -2,31 +2,54 @@
 
 Design (TPU-first, same rules as the trainer):
 
-- **Fixed shapes, compile once.** Two jit-compiled functions cover the
-  whole lifetime of a replica: ``prefill`` (one request's prompt, padded
-  to ``S_max``) and ``decode_step`` (one token for every slot of the
-  fixed-size running batch). Requests of any length ride the same two
-  executables — no per-request retracing, ever. ``decode_compiles`` /
-  ``prefill_compiles`` count traces so tests and the bench can assert
-  exactly-once compilation.
+- **Fixed shapes, compile once per shape.** ONE step function covers
+  the whole lifetime of a replica: every row of the step is "one token
+  at one position, scattered into and gathered through a block table" —
+  the first ``max_batch`` rows are the running decode lanes, the last
+  ``prefill_chunk`` rows are a chunk of some request's prompt. It
+  compiles at exactly TWO shapes: decode-only (``[max_batch]`` rows —
+  steady-state decode pays nothing for an idle chunk lane) and fused
+  (``[max_batch + prefill_chunk]`` rows when a prompt chunk rides
+  along). Prompts of any length, any admission order, and any sampling
+  mix ride those two executables — no per-request retracing, ever.
+  ``decode_compiles`` / ``prefill_compiles`` count the two shape
+  families' traces so tests and the bench can assert exactly-once
+  compilation of each.
 
 - **Paged KV cache.** K/V live in a block pool of shape
   ``[L, num_blocks, block_size, Hkv, Dh]``; each running request owns a
-  block table (list of pool indices). The decode step scatters the new
-  token's K/V into ``table[pos // bs], pos % bs`` and gathers the
-  request's context back through the table — requests share one pool
-  with no per-request padding waste (the vLLM PagedAttention layout,
+  block table (list of pool indices). Each step scatters the new
+  tokens' K/V into ``table[pos // bs], pos % bs`` and gathers each
+  row's context back through its table — requests share one pool with
+  no per-request padding waste (the vLLM PagedAttention layout,
   expressed as jnp scatter/gather so XLA keeps it fused). Block 0 is a
-  write-off scratch page: inactive batch lanes and prompt padding
-  scatter there, so masking never needs dynamic shapes.
+  write-off scratch page: inactive rows and chunk padding scatter
+  there, so masking never needs dynamic shapes.
+
+- **Prefix-reuse KV cache.** The pool is refcounted and a radix index
+  (block-granular trie keyed by token chunks) remembers fully-filled
+  prompt blocks after prefill. A new request whose token prefix walks a
+  cached path maps those blocks into its table (incref — shared,
+  read-only: full blocks are never rewritten, so sharing needs no copy)
+  and prefills only the tail; at least the last prompt token is always
+  recomputed so the first output token has fresh logits. Blocks whose
+  refcount drops to zero stay resident as cache and are evicted LRU
+  (leaves first) when the pool runs dry — eviction composes with the
+  recompute-preemption path: evict cold cache first, preempt the
+  youngest request only when the cache is already dry.
+
+- **Chunked prefill, fused into the step.** A prompt is prefilled
+  ``prefill_chunk`` tokens per engine step in the SAME compiled step
+  that advances every running decode — a long prompt can no longer
+  head-of-line-block the batch for a whole monolithic prefill call, so
+  admitted requests keep streaming while a new prompt fills in.
 
 - **Continuous batching.** New requests are admitted at any step
-  boundary into free slots of the running batch (prefill fills their
-  cache while other requests keep decoding on the next step); finished
-  requests free their slot and blocks immediately. When the pool runs
-  dry the youngest request is preempted — its blocks are freed and it
-  re-queues for recompute-style re-admission (eviction policy of the
-  paged pool).
+  boundary into free slots (their prefill chunks interleave with
+  running decodes); finished requests free their slot and decref their
+  blocks immediately. When pool + cache run dry the youngest request is
+  preempted — its refs drop and it re-queues for recompute-style
+  re-admission (warm: its own prompt blocks usually survive as cache).
 
 - **Sharding.** Pass a ``MeshPlan`` (tp only) and the engine places the
   weights with ``parallel.mesh.param_specs`` and the KV pool with heads
@@ -41,17 +64,17 @@ import itertools
 import queue
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from hadoop_tpu.models.config import ModelConfig
-from hadoop_tpu.ops import (apply_rope, causal_attention, gelu, layer_norm,
-                            rms_norm, rope_frequencies, swiglu)
+from hadoop_tpu.models.decoder import _norm, head_matrix
+from hadoop_tpu.ops import gelu, rope_frequencies, swiglu
 from hadoop_tpu.ops.attention import _repeat_kv
 from hadoop_tpu.tracing.tracer import global_tracer
 
@@ -61,10 +84,17 @@ _NEG_INF = -1e30
 # ------------------------------------------------------------- block pool
 
 class BlockPool:
-    """Fixed pool of KV-cache pages. Block 0 is reserved scratch (padding
-    and inactive lanes scatter there), so ``num_blocks - 1`` are
-    allocatable. Allocation is all-or-nothing; freeing returns pages for
-    immediate reuse by the next admission."""
+    """Refcounted fixed pool of KV-cache pages. Block 0 is reserved
+    scratch (padding and inactive lanes scatter there), so
+    ``num_blocks - 1`` are allocatable.
+
+    Lifecycle: ``alloc`` hands out pages at refcount 1; prefix sharing
+    ``incref``s a page per additional mapper; ``decref`` drops one
+    mapping and reports pages that reached zero WITHOUT freeing them —
+    the engine decides whether a zero-ref page stays resident as prefix
+    cache or returns to the free list via ``free``. ``free`` refuses
+    pages still shared (refcount > 1), so a preemption can never yank a
+    page out from under a sibling."""
 
     SCRATCH = 0
 
@@ -74,6 +104,7 @@ class BlockPool:
         self.num_blocks = num_blocks
         self.block_size = block_size
         self._free = deque(range(1, num_blocks))
+        self._ref = [0] * num_blocks
         self._lock = threading.Lock()
 
     @property
@@ -84,18 +115,160 @@ class BlockPool:
     def num_free(self) -> int:
         return len(self._free)
 
+    def refcount(self, block: int) -> int:
+        return self._ref[block]
+
     def alloc(self, n: int) -> Optional[List[int]]:
         with self._lock:
             if n > len(self._free):
                 return None
-            return [self._free.popleft() for _ in range(n)]
+            out = [self._free.popleft() for _ in range(n)]
+            for b in out:
+                self._ref[b] = 1
+            return out
+
+    def incref(self, blocks: List[int]) -> None:
+        with self._lock:
+            for b in blocks:
+                if b == self.SCRATCH:
+                    raise ValueError("incref of the scratch block")
+                self._ref[b] += 1
+
+    def decref(self, blocks: List[int]) -> List[int]:
+        """Drop one reference per block; returns the blocks that hit
+        zero (now unmapped — cacheable or freeable, caller's call)."""
+        released = []
+        with self._lock:
+            for b in blocks:
+                if self._ref[b] <= 0:
+                    raise ValueError(f"decref of unreferenced block {b}")
+                self._ref[b] -= 1
+                if self._ref[b] == 0:
+                    released.append(b)
+        return released
 
     def free(self, blocks: List[int]) -> None:
         with self._lock:
             for b in blocks:
                 if b == self.SCRATCH:
                     raise ValueError("freeing the scratch block")
+                if self._ref[b] > 1:
+                    raise ValueError(
+                        f"freeing block {b} still shared "
+                        f"(refcount {self._ref[b]}) — decref instead")
+                self._ref[b] = 0
                 self._free.append(b)
+
+
+# ------------------------------------------------------------ prefix cache
+
+class _RadixNode:
+    __slots__ = ("key", "block", "parent", "children")
+
+    def __init__(self, key=None, block=None, parent=None):
+        self.key = key          # tuple of block_size tokens
+        self.block = block      # pool page holding this chunk's K/V
+        self.parent = parent
+        self.children: Dict[tuple, "_RadixNode"] = {}
+
+
+class PrefixCache:
+    """Radix index over fully-filled prompt blocks: a trie at block
+    granularity, where the path from the root IS the token prefix — so
+    a block is only ever matched under the exact full prefix its K/V
+    was computed for (KV at position i depends on tokens 0..i, not just
+    the block's own tokens).
+
+    The cache holds no refcounts itself; the pool's refcount is the
+    truth. A node is evictable when it is a leaf and its block's
+    refcount is zero; ``evict`` pops such leaves in LRU order (leaves
+    first keeps the tree consistent — a parent can only go after its
+    children). ``_lru`` holds ONLY the current leaves, in recency order
+    (moved-to-end on every touch); evicting a leaf promotes a
+    newly-childless parent to the cold end. So the steady-state
+    eviction — pool full of zero-ref cache, evict one page per block
+    allocation — pops the front in O(1) under the scheduler lock,
+    scanning past a node only when it is pinned (actively shared)."""
+
+    def __init__(self, block_size: int):
+        self.block_size = block_size
+        self._root = _RadixNode()
+        self._nodes: Dict[int, _RadixNode] = {}        # every cached page
+        self._lru: "OrderedDict[int, _RadixNode]" = OrderedDict()  # leaves
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def contains_block(self, block: int) -> bool:
+        return block in self._nodes
+
+    def _touch(self, node: _RadixNode) -> None:
+        if node.block in self._lru:
+            self._lru.move_to_end(node.block)
+
+    def match(self, tokens: List[int]) -> List[int]:
+        """Longest cached full-block prefix of ``tokens``; returns the
+        pages in prefix order (no refcounting — caller pins them)."""
+        node = self._root
+        out: List[int] = []
+        bs = self.block_size
+        for i in range(len(tokens) // bs):
+            child = node.children.get(tuple(tokens[i * bs:(i + 1) * bs]))
+            if child is None:
+                break
+            self._touch(child)
+            out.append(child.block)
+            node = child
+        return out
+
+    def insert(self, tokens: List[int], blocks: List[int]) -> int:
+        """Register fully-filled pages for ``tokens`` (one page per
+        ``block_size`` chunk, aligned). First writer wins: an existing
+        node keeps its page and the duplicate stays with its owner (it
+        is freed on that request's release). Returns how many pages
+        were newly registered."""
+        node = self._root
+        new = 0
+        bs = self.block_size
+        for i, blk in enumerate(blocks):
+            key = tuple(tokens[i * bs:(i + 1) * bs])
+            child = node.children.get(key)
+            if child is None:
+                child = _RadixNode(key, blk, node)
+                node.children[key] = child
+                self._nodes[blk] = child
+                if node is not self._root:
+                    self._lru.pop(node.block, None)    # no longer a leaf
+                self._lru[blk] = child
+                new += 1
+            else:
+                self._touch(child)
+            node = child
+        return new
+
+    def evict(self, n: int, refcount: Callable[[int], int]) -> List[int]:
+        """Drop up to ``n`` LRU zero-ref leaf pages from the index and
+        return them (caller returns them to the pool's free list)."""
+        out: List[int] = []
+        while len(out) < n:
+            victim = None
+            for blk, node in self._lru.items():  # oldest leaf first;
+                if refcount(blk) == 0:           # scan past pinned ones
+                    victim = node
+                    break
+            if victim is None:
+                break
+            del self._lru[victim.block]
+            del self._nodes[victim.block]
+            del victim.parent.children[victim.key]
+            out.append(victim.block)
+            parent = victim.parent
+            if parent is not self._root and not parent.children:
+                # newly a leaf, and at least as stale as the child we
+                # just dropped: promote to the cold end of the LRU
+                self._lru[parent.block] = parent
+                self._lru.move_to_end(parent.block, last=False)
+        return out
 
 
 # --------------------------------------------------------------- requests
@@ -130,9 +303,13 @@ class GenRequest:
     submitted_at: float = field(default_factory=time.monotonic)
     first_token_at: Optional[float] = None
     preemptions: int = 0
+    prefix_tokens_reused: int = 0     # cached tokens mapped at admission
     # engine-private placement
     _slot: Optional[int] = None
     _blocks: List[int] = field(default_factory=list)
+    _shared_blocks: int = 0           # leading blocks mapped from cache
+    _ctx: List[int] = field(default_factory=list)
+    _prefill_pos: Optional[int] = None  # next position to prefill
     _admit_seq: int = 0
 
     def _deliver(self, token: int) -> None:
@@ -156,15 +333,12 @@ class GenRequest:
 
 
 # ----------------------------------------------------------------- engine
-
-def _norm(x, w, b, cfg: ModelConfig):
-    if cfg.use_rmsnorm:
-        return rms_norm(x, w, cfg.norm_eps)
-    return layer_norm(x, w, b, cfg.norm_eps)
-
+# (_norm and head_matrix come from models.decoder — the engine must
+# apply EXACTLY the trained model's norm/head rules or served logits
+# silently diverge from training)
 
 def _rope_at(x, cos, sin, pos):
-    """Rotate one token per batch row: x [B, H, Dh], pos [B]."""
+    """Rotate one token per row: x [T, H, Dh], pos [T]."""
     c = cos[pos][:, None, :]
     s = sin[pos][:, None, :]
     xf = x.astype(jnp.float32)
@@ -174,10 +348,10 @@ def _rope_at(x, cos, sin, pos):
 
 
 def _sample(logits, temps, topks, key):
-    """logits [B, V] float32; per-row temperature/top-k; greedy when
+    """logits [T, V] float32; per-row temperature/top-k; greedy when
     temperature <= 0 (the fused decode+sampling step of arxiv
     2502.17728 — sampling stays inside the compiled program so no
-    [B, V] logits tensor crosses to the host)."""
+    [T, V] logits tensor crosses to the host)."""
     v = logits.shape[-1]
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     srt = jnp.sort(logits, axis=-1)                       # ascending
@@ -190,13 +364,10 @@ def _sample(logits, temps, topks, key):
     return jnp.where(temps <= 0, greedy, sampled)
 
 
-def _head(params, cfg: ModelConfig):
-    return params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-
-
 class DecodeEngine:
     """Continuous-batching decode over a fixed slot batch and a paged KV
-    pool. Drive it either with the background scheduler thread
+    pool, with prefix reuse and step-fused chunked prefill. Drive it
+    either with the background scheduler thread
     (``start``/``submit``/``stop`` — the serving replica) or by calling
     ``step()`` directly (tests, offline bench)."""
 
@@ -204,6 +375,8 @@ class DecodeEngine:
                  max_batch: int = 4, block_size: int = 8,
                  num_blocks: Optional[int] = None,
                  max_context: Optional[int] = None,
+                 prefill_chunk: int = 16,
+                 prefix_cache: bool = True,
                  plan=None, metrics=None, tracer=None):
         if cfg.is_moe:
             raise NotImplementedError("serving MoE checkpoints is not "
@@ -211,6 +384,7 @@ class DecodeEngine:
         self.cfg = cfg
         self.max_batch = max_batch
         self.block_size = block_size
+        self.prefill_chunk = max(1, int(prefill_chunk))
         self.max_context = min(max_context or cfg.max_seq, cfg.max_seq)
         self.blocks_per_seq = -(-self.max_context // block_size)
         self.s_max = self.blocks_per_seq * block_size
@@ -225,6 +399,8 @@ class DecodeEngine:
         if num_blocks is None:
             num_blocks = max_batch * self.blocks_per_seq + 1
         self.pool = BlockPool(num_blocks, block_size)
+        self.prefix_cache = PrefixCache(block_size) if prefix_cache \
+            else None
         self.metrics = metrics
         self.tracer = tracer or global_tracer()
 
@@ -269,12 +445,30 @@ class DecodeEngine:
         self.steps = 0
         self.tokens_generated = 0
         self.occupancy_log: List[int] = []      # active slots per step
-        self.decode_compiles = 0
-        self.prefill_compiles = 0
-        self._decode_fn = jax.jit(self._decode_impl, donate_argnums=(1, 2))
-        self._prefill_fn = jax.jit(self._prefill_impl, donate_argnums=(1, 2))
+        self._fused_compiles = 0                # [B + chunk]-row traces
+        self._decode_only_compiles = 0          # [B]-row traces
+        self._chunk_fill = 0                    # chunk rows used last step
+        # prefix-cache lifetime stats (cold-start zeros)
+        self.prefix_tokens_seen = 0
+        self.prefix_tokens_matched = 0
+        self.prefix_evictions = 0
+        self.prefix_inserted_blocks = 0
+        self._step_fn = jax.jit(self._step_impl, donate_argnums=(1, 2))
 
-    # ----------------------------------------------------- compiled bodies
+    @property
+    def decode_compiles(self) -> int:
+        """Traces of the decode-only shape of the step ([B] rows —
+        dispatched when nothing is prefilling, so pure decode never
+        pays for idle chunk rows). At most 1 or shapes are retracing."""
+        return self._decode_only_compiles
+
+    @property
+    def prefill_compiles(self) -> int:
+        """Traces of the fused shape of the step ([B + chunk] rows —
+        dispatched when a prompt chunk rides along). At most 1."""
+        return self._fused_compiles
+
+    # ----------------------------------------------------- compiled body
 
     def _rope_tables(self):
         if not self.cfg.use_rope:
@@ -287,20 +481,37 @@ class DecodeEngine:
             return swiglu(x @ lp["w_gate"], x @ lp["w_up"]) @ lp["w_down"]
         return gelu(x @ lp["w_in"] + lp["b_in"]) @ lp["w_out"] + lp["b_out"]
 
-    def _decode_impl(self, params, kp, vp, tables, seq_lens, tokens,
-                     active, temps, topks, key):
-        """One token for every slot. tables [B, blocks_per_seq];
-        seq_lens[b] = tokens already cached = position of this token."""
-        self.decode_compiles += 1     # python side effect: trace counter
+    def _step_impl(self, params, kp, vp, tables, positions, tokens,
+                   active, temps, topks, key):
+        """The ONE compiled function: every row is one token at one
+        position — rows [0, max_batch) are the decode lanes (position =
+        tokens already cached), rows [max_batch, max_batch +
+        prefill_chunk) are consecutive positions of one request's
+        prompt chunk (they share that request's block table row).
+        Scatter-all-then-gather makes earlier chunk tokens visible to
+        later ones within the same step; the causal mask
+        ``kpos <= position`` does the rest.
+
+        Compiled at exactly TWO shapes for the replica's lifetime:
+        ``[max_batch]`` rows (decode-only — dispatched when nothing is
+        prefilling, so steady-state decode pays nothing for the chunk
+        lane) and ``[max_batch + prefill_chunk]`` rows (a prompt chunk
+        riding along). Any further trace is a retracing bug the
+        counters expose."""
         cfg = self.cfg
-        b = tables.shape[0]
+        t = tables.shape[0]
+        # python side effect at trace time only: shape-family counters
+        if t == self.max_batch:
+            self._decode_only_compiles += 1
+        else:
+            self._fused_compiles += 1
         hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
         cos, sin = self._rope_tables()
         h = params["embed"][tokens]
         if not cfg.use_rope:
             h = h + params["pos_embed"][
-                jnp.clip(seq_lens, 0, cfg.max_seq - 1)]
-        pos = seq_lens
+                jnp.clip(positions, 0, cfg.max_seq - 1)]
+        pos = positions
         blk = jnp.take_along_axis(
             tables, (pos // self.block_size)[:, None], axis=1)[:, 0]
         blk = jnp.where(active, blk, BlockPool.SCRATCH)
@@ -311,9 +522,9 @@ class DecodeEngine:
         def layer(h, xs):
             lp, kc, vc = xs
             x = _norm(h, lp["attn_norm_w"], lp.get("attn_norm_b"), cfg)
-            q = (x @ lp["wq"]).reshape(b, hq, dh)
-            k = (x @ lp["wk"]).reshape(b, hkv, dh)
-            v = (x @ lp["wv"]).reshape(b, hkv, dh)
+            q = (x @ lp["wq"]).reshape(t, hq, dh)
+            k = (x @ lp["wk"]).reshape(t, hkv, dh)
+            v = (x @ lp["wv"]).reshape(t, hkv, dh)
             if cfg.use_rope:
                 q = _rope_at(q, cos, sin, pos)
                 k = _rope_at(k, cos, sin, pos)
@@ -321,8 +532,8 @@ class DecodeEngine:
             vc = vc.at[blk, off].set(v.astype(vc.dtype))
             # paged gather: each row pulls its own pages back into a
             # contiguous [S_max] context view through the block table
-            kctx = kc[tables].reshape(b, self.s_max, hkv, dh)
-            vctx = vc[tables].reshape(b, self.s_max, hkv, dh)
+            kctx = kc[tables].reshape(t, self.s_max, hkv, dh)
+            vctx = vc[tables].reshape(t, self.s_max, hkv, dh)
             kr = _repeat_kv(kctx, hq // hkv)
             vr = _repeat_kv(vctx, hq // hkv)
             logits = jnp.einsum(
@@ -332,62 +543,16 @@ class DecodeEngine:
             logits = jnp.where(mask[:, None, :], logits, _NEG_INF)
             probs = jax.nn.softmax(logits, axis=-1).astype(vr.dtype)
             attn = jnp.einsum("bhk,bkhd->bhd", probs, vr)
-            h2 = h + (attn.reshape(b, hq * dh) @ lp["wo"]).astype(h.dtype)
+            h2 = h + (attn.reshape(t, hq * dh) @ lp["wo"]).astype(h.dtype)
             x2 = _norm(h2, lp["mlp_norm_w"], lp.get("mlp_norm_b"), cfg)
             return h2 + self._mlp(x2, lp).astype(h.dtype), (kc, vc)
 
         h, (kp, vp) = jax.lax.scan(layer, h, (params["layers"], kp, vp))
         h = _norm(h, params["final_norm_w"], params.get("final_norm_b"),
                   cfg)
-        logits = (h @ _head(params, cfg).astype(h.dtype)).astype(
+        logits = (h @ head_matrix(params, cfg, h.dtype)).astype(
             jnp.float32)
         return kp, vp, _sample(logits, temps, topks, key)
-
-    def _prefill_impl(self, params, kp, vp, tokens, length, block_row,
-                      temp, topk, key):
-        """One request's prompt, padded to S_max: fills its KV pages and
-        samples the first output token. tokens [S_max]; positions >=
-        length scatter to the scratch page and are causally invisible to
-        real positions."""
-        self.prefill_compiles += 1    # python side effect: trace counter
-        cfg = self.cfg
-        p = tokens.shape[0]
-        hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-        cos, sin = self._rope_tables()
-        t = tokens[None]
-        h = params["embed"][t]
-        if not cfg.use_rope:
-            h = h + params["pos_embed"][:p][None]
-        p_idx = jnp.arange(p)
-        dest = block_row[p_idx // self.block_size]
-        dest = jnp.where(p_idx < length, dest, BlockPool.SCRATCH)
-        offs = p_idx % self.block_size
-
-        def layer(h, xs):
-            lp, kc, vc = xs
-            x = _norm(h, lp["attn_norm_w"], lp.get("attn_norm_b"), cfg)
-            q = (x @ lp["wq"]).reshape(1, p, hq, dh)
-            k = (x @ lp["wk"]).reshape(1, p, hkv, dh)
-            v = (x @ lp["wv"]).reshape(1, p, hkv, dh)
-            if cfg.use_rope:
-                q = apply_rope(q, cos, sin)
-                k = apply_rope(k, cos, sin)
-            kc = kc.at[dest, offs].set(k[0].astype(kc.dtype))
-            vc = vc.at[dest, offs].set(v[0].astype(vc.dtype))
-            attn = causal_attention(q, k, v)
-            h2 = h + (attn.reshape(1, p, hq * dh) @ lp["wo"]).astype(
-                h.dtype)
-            x2 = _norm(h2, lp["mlp_norm_w"], lp.get("mlp_norm_b"), cfg)
-            return h2 + self._mlp(x2, lp).astype(h.dtype), (kc, vc)
-
-        h, (kp, vp) = jax.lax.scan(layer, h, (params["layers"], kp, vp))
-        h_last = jnp.take(h[0], length - 1, axis=0)
-        h_last = _norm(h_last, params["final_norm_w"],
-                       params.get("final_norm_b"), cfg)
-        logits = (h_last @ _head(params, cfg).astype(h_last.dtype))[None] \
-            .astype(jnp.float32)
-        tok = _sample(logits, temp[None], topk[None], key)[0]
-        return kp, vp, tok
 
     # -------------------------------------------------------- public face
 
@@ -403,6 +568,10 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt({len(prompt)}) + max_new({sampling.max_new_tokens})"
                 f" exceeds engine max_context {self.s_max}")
+        # fail fast on requests the pool can NEVER satisfy — parking
+        # them in the admission queue would wedge the queue forever
+        # (prefix hits could shrink the footprint, but cache contents
+        # are transient and must not admit what can't run cold)
         pages = -(-(len(prompt) + sampling.max_new_tokens)
                   // self.block_size)
         if pages > self.pool.num_usable:
@@ -423,24 +592,46 @@ class DecodeEngine:
         return int(self._active.sum())
 
     @property
+    def num_prefilling(self) -> int:
+        return sum(1 for r in self._slots
+                   if r is not None and r._prefill_pos is not None)
+
+    @property
     def queue_depth(self) -> int:
         return len(self._pending)
 
     @property
     def idle(self) -> bool:
-        return not self._pending and not self._active.any()
+        return not self._pending and all(r is None for r in self._slots)
+
+    def cache_stats(self) -> Dict[str, Any]:
+        """Prefix-cache + chunked-prefill observability (health, bench)."""
+        seen = self.prefix_tokens_seen
+        return {
+            "enabled": self.prefix_cache is not None,
+            "cached_blocks": len(self.prefix_cache)
+                             if self.prefix_cache is not None else 0,
+            "tokens_seen": seen,
+            "tokens_matched": self.prefix_tokens_matched,
+            "hit_rate": (self.prefix_tokens_matched / seen) if seen
+                        else 0.0,
+            "evictions": self.prefix_evictions,
+            "inserted_blocks": self.prefix_inserted_blocks,
+            "prefill_chunk": self.prefill_chunk,
+        }
 
     # ------------------------------------------------------ the scheduler
 
     def step(self) -> int:
         """One scheduler iteration: admit waiting requests into free
-        slots, ensure every running request has a page for this step's
-        token, run one decode step, retire finished requests. Returns
+        slots (mapping any cached prefix), ensure every decoding
+        request has a page for this step's token, run the fused
+        decode+prefill-chunk step, retire finished requests. Returns
         the number of tokens emitted."""
         with self._sched_lock:
             self._admit()
             self._ensure_blocks()
-            emitted = self._decode()
+            emitted = self._run_step()
             self._publish_metrics()
             return emitted
 
@@ -455,85 +646,117 @@ class DecodeEngine:
                     return
                 req = self._pending[0]
             # prompt plus already-generated tokens (preempted requests
-            # resume by recompute); the first decode step after
-            # admission needs one more page slot for its token
+            # resume by recompute — often warm, off their own cached
+            # prompt blocks); the first decode step after prefill needs
+            # one more page slot for its token
             ctx = req.prompt + req.out_tokens
-            need = -(-(len(ctx) + 1) // self.block_size)
-            blocks = self.pool.alloc(need)
-            if blocks is None:
+            shared: List[int] = []
+            if self.prefix_cache is not None:
+                # cap the match below the full context: the last token
+                # must always be prefilled so its logits exist to
+                # sample the first output token from
+                limit = (len(ctx) - 1) // self.block_size
+                matched = self.prefix_cache.match(ctx)[:limit]
+                if matched:
+                    # pin before any eviction this admission might do
+                    self.pool.incref(matched)
+                    shared = matched
+            need = -(-(len(ctx) + 1) // self.block_size) - len(shared)
+            private = self._try_alloc(need)
+            if private is None:
                 # running requests outrank waiting ones (preemption only
                 # keeps the running set going, never feeds admission) —
                 # wait for retirements to return pages
+                if shared:
+                    # unpin; zero-ref pages stay resident in the index
+                    self.pool.decref(shared)
                 return
             with self._cond:
                 self._pending.popleft()
-            self._place(req, slot, blocks, ctx)
+            reused = len(shared) * self.block_size
+            req.prefix_tokens_reused = reused
+            if req.preemptions == 0:
+                # hit-rate counts cross-request reuse only: a preempted
+                # request re-matching its OWN surviving blocks is warm
+                # resume, and counting it would inflate the gauge
+                # exactly when the pool is thrashing
+                self.prefix_tokens_seen += len(ctx)
+                self.prefix_tokens_matched += reused
+                if self.metrics and reused:
+                    self.metrics.prefix_tokens_reused.incr(reused)
+            self._place(req, slot, shared + private, ctx, len(shared))
+
+    def _try_alloc(self, n: int) -> Optional[List[int]]:
+        """Allocate ``n`` pages, evicting LRU zero-ref cached blocks to
+        make room before giving up (cold cache yields to live work)."""
+        if n <= 0:
+            return []
+        got = self.pool.alloc(n)
+        if got is not None or self.prefix_cache is None:
+            return got
+        evicted = self.prefix_cache.evict(n - self.pool.num_free,
+                                          self.pool.refcount)
+        if not evicted:
+            return None
+        self.pool.free(evicted)
+        self.prefix_evictions += len(evicted)
+        if self.metrics:
+            self.metrics.prefix_cache_evictions.incr(len(evicted))
+        return self.pool.alloc(n)
 
     def _place(self, req: GenRequest, slot: int, blocks: List[int],
-               ctx: List[int]) -> None:
+               ctx: List[int], shared_blocks: int) -> None:
         req.state = RUNNING
         req._slot = slot
         req._blocks = blocks
+        req._shared_blocks = shared_blocks
+        req._ctx = ctx
+        req._prefill_pos = shared_blocks * self.block_size
         req._admit_seq = next(self._admit_counter)
         self._slots[slot] = req
         row = np.zeros((self.blocks_per_seq,), np.int32)
         row[:len(blocks)] = blocks
         self._tables[slot] = row
-        padded = np.zeros((self.s_max,), np.int32)
-        padded[:len(ctx)] = ctx
-        with self.tracer.span("serving.prefill") as sp:
-            sp.add_kv("request", str(req.id))
-            sp.add_kv("prompt_tokens", str(len(ctx)))
-            key = jax.random.PRNGKey(next(self._step_seed))
-            self._kp, self._vp, tok = self._prefill_fn(
-                self.params, self._kp, self._vp, jnp.asarray(padded),
-                np.int32(len(ctx)), jnp.asarray(row),
-                np.float32(req.sampling.temperature),
-                np.int32(req.sampling.top_k), key)
-        tok = int(tok)
-        self._seq_lens[slot] = len(ctx)
-        self._temps[slot] = req.sampling.temperature
-        self._topks[slot] = req.sampling.top_k
-        self._active[slot] = True
-        first = req.first_token_at is None
-        req._deliver(tok)
-        self._last_tokens[slot] = tok
-        self.tokens_generated += 1
-        if self.metrics:
-            self.metrics.tokens_out.incr()
-            if first:
-                self.metrics.ttft.add(
-                    req.first_token_at - req.submitted_at)
-        self._maybe_finish(req, tok)
+        self._seq_lens[slot] = 0
+        self._active[slot] = False
+        self._last_tokens[slot] = 0
+        sp = self.tracer.span("serving.admit")
+        sp.add_kv("request", str(req.id))
+        sp.add_kv("prompt_tokens", str(len(ctx)))
+        sp.add_kv("prefix_tokens_reused", str(req.prefix_tokens_reused))
+        sp.finish()
 
     def _ensure_blocks(self) -> None:
-        """Every active slot must own the page its next token lands in;
-        allocate at block boundaries, preempting the youngest request
-        when the pool is dry."""
+        """Every decoding slot must own the page its next token lands
+        in; allocate at block boundaries (evicting cold cache first),
+        preempting the youngest request when everything is dry."""
         for slot, req in enumerate(self._slots):
-            if req is None:
-                continue
+            if req is None or req._prefill_pos is not None:
+                continue     # prefilling slots pre-allocated at admit
             # this step scatters K/V at position seq_lens[slot]; that
             # page must be owned or the write would land in scratch and
             # silently corrupt the request's context
             need = int(self._seq_lens[slot]) // self.block_size + 1
             while req._slot is not None and len(req._blocks) < need:
-                got = self.pool.alloc(1)
+                got = self._try_alloc(1)
                 if got is not None:
                     self._tables[slot][len(req._blocks)] = got[0]
                     req._blocks.extend(got)
                     continue
-                # pool dry: evict the youngest running request — which
-                # may be this one (then its slot empties and the loop
-                # ends; it resumes by recompute once pages free up)
+                # pool and cache dry: evict the youngest running
+                # request — which may be this one (then its slot
+                # empties and the loop ends; it resumes by recompute
+                # once pages free up). Preempting a sharer only drops
+                # its refs — pages still mapped by a sibling survive.
                 victim = max((r for r in self._slots if r is not None),
                              key=lambda r: r._admit_seq)
                 self._preempt(victim)
 
     def _preempt(self, victim: GenRequest) -> None:
-        """vLLM-style recompute preemption: free the request's pages and
-        requeue it at the front; re-admission prefills prompt + tokens
-        generated so far."""
+        """vLLM-style recompute preemption: drop the request's page
+        refs and requeue it at the front; re-admission prefills prompt
+        + tokens generated so far (warm when its prompt blocks survive
+        in the prefix index)."""
         self._release_slot(victim)
         victim.state = QUEUED
         victim.preemptions += 1
@@ -547,8 +770,19 @@ class DecodeEngine:
         slot = req._slot
         if slot is None:
             return
-        self.pool.free(req._blocks)
+        released = self.pool.decref(req._blocks)
+        if self.prefix_cache is not None:
+            # zero-ref pages registered in the radix index stay
+            # resident as reusable cache; the rest return to the pool
+            drop = [b for b in released
+                    if not self.prefix_cache.contains_block(b)]
+        else:
+            drop = released
+        self.pool.free(drop)
         req._blocks = []
+        req._shared_blocks = 0
+        req._ctx = []
+        req._prefill_pos = None
         req._slot = None
         self._slots[slot] = None
         self._active[slot] = False
@@ -556,36 +790,101 @@ class DecodeEngine:
         self._tables[slot] = 0
         self._last_tokens[slot] = 0
 
-    def _decode(self) -> int:
-        if not self._active.any():
+    def _run_step(self) -> int:
+        # oldest still-prefilling request gets this step's chunk budget
+        pre: Optional[GenRequest] = None
+        for r in self._slots:
+            if r is not None and r._prefill_pos is not None:
+                if pre is None or r._admit_seq < pre._admit_seq:
+                    pre = r
+        if pre is None and not self._active.any():
             return 0
+        b, c = self.max_batch, self.prefill_chunk
+        n_valid = 0
+        if pre is None:
+            # decode-only shape: no idle chunk rows to pay for
+            tables, positions = self._tables, self._seq_lens
+            tokens, active = self._last_tokens, self._active
+            temps, topks = self._temps, self._topks
+        else:
+            c_tokens = np.zeros((c,), np.int32)
+            c_pos = np.zeros((c,), np.int32)
+            c_active = np.zeros((c,), bool)
+            c_tables = np.zeros((c, self.blocks_per_seq), np.int32)
+            start = pre._prefill_pos
+            n_valid = min(c, len(pre._ctx) - start)
+            c_tokens[:n_valid] = pre._ctx[start:start + n_valid]
+            c_pos[:n_valid] = np.arange(start, start + n_valid)
+            c_active[:n_valid] = True
+            c_tables[:] = self._tables[pre._slot]
+            tables = np.concatenate([self._tables, c_tables], axis=0)
+            positions = np.concatenate([self._seq_lens, c_pos])
+            tokens = np.concatenate([self._last_tokens, c_tokens])
+            active = np.concatenate([self._active, c_active])
+            temps = np.concatenate([
+                self._temps,
+                np.full((c,), pre.sampling.temperature, np.float32)])
+            topks = np.concatenate([
+                self._topks,
+                np.full((c,), pre.sampling.top_k, np.int32)])
         t0 = time.monotonic()
         key = jax.random.PRNGKey(next(self._step_seed))
-        self._kp, self._vp, nxt = self._decode_fn(
-            self.params, self._kp, self._vp, jnp.asarray(self._tables),
-            jnp.asarray(self._seq_lens), jnp.asarray(self._last_tokens),
-            jnp.asarray(self._active), jnp.asarray(self._temps),
-            jnp.asarray(self._topks), key)
-        nxt = np.asarray(nxt)
+        self._kp, self._vp, sampled = self._step_fn(
+            self.params, self._kp, self._vp, jnp.asarray(tables),
+            jnp.asarray(positions), jnp.asarray(tokens),
+            jnp.asarray(active), jnp.asarray(temps),
+            jnp.asarray(topks), key)
+        sampled = np.asarray(sampled)
         self.steps += 1
+        self._chunk_fill = n_valid
         emitted = 0
         self.occupancy_log.append(self.num_active)
         if len(self.occupancy_log) > 100_000:
             del self.occupancy_log[:50_000]
         for slot, req in enumerate(self._slots):
-            if req is None:
+            if req is None or not self._active[slot]:
                 continue
-            tok = int(nxt[slot])
+            tok = int(sampled[slot])
             self._seq_lens[slot] += 1
             self._last_tokens[slot] = tok
             req._deliver(tok)
             emitted += 1
             self._maybe_finish(req, tok)
+        if pre is not None:
+            pre._prefill_pos += n_valid
+            if pre._prefill_pos >= len(pre._ctx):
+                # the chunk's last valid row sat at the final context
+                # position — its sample is the first output token
+                self._finish_prefill(pre, int(sampled[b + n_valid - 1]))
+                emitted += 1
         self.tokens_generated += emitted
         if self.metrics:
             self.metrics.tokens_out.incr(emitted)
             self.metrics.decode_step.add(time.monotonic() - t0)
         return emitted
+
+    def _finish_prefill(self, req: GenRequest, tok: int) -> None:
+        """Prompt fully cached: flip the slot to a decode lane, publish
+        the fully-filled prompt blocks into the prefix index, deliver
+        the first token."""
+        slot = req._slot
+        ctx_len = len(req._ctx)
+        req._prefill_pos = None
+        self._seq_lens[slot] = ctx_len
+        self._temps[slot] = req.sampling.temperature
+        self._topks[slot] = req.sampling.top_k
+        self._last_tokens[slot] = tok
+        self._active[slot] = True
+        if self.prefix_cache is not None:
+            full = ctx_len // self.block_size
+            if full:
+                self.prefix_inserted_blocks += self.prefix_cache.insert(
+                    req._ctx[:full * self.block_size], req._blocks[:full])
+        first = req.first_token_at is None
+        req._deliver(tok)
+        if self.metrics and first:
+            self.metrics.ttft.add(req.first_token_at - req.submitted_at)
+        self._maybe_finish(req, tok)
 
     def _maybe_finish(self, req: GenRequest, tok: int) -> None:
         sp = req.sampling
@@ -603,6 +902,13 @@ class DecodeEngine:
         used = self.pool.num_usable - self.pool.num_free
         m.kv_blocks_in_use.set(used)
         m.kv_block_utilization.set(used / max(1, self.pool.num_usable))
+        stats = self.cache_stats()
+        m.prefix_cache_hit_rate.set(round(stats["hit_rate"], 4))
+        m.prefix_cached_blocks.set(stats["cached_blocks"])
+        m.chunk_occupancy.set(self._chunk_fill / self.prefill_chunk)
+        m.prefill_backlog.set(sum(
+            len(r._ctx) - r._prefill_pos for r in self._slots
+            if r is not None and r._prefill_pos is not None))
 
     # --------------------------------------------------- replica lifecycle
 
@@ -631,13 +937,20 @@ class DecodeEngine:
         # pages stay allocated (the process is going down anyway)
         locked = self._sched_lock.acquire(timeout=5.0)
         try:
-            for req in list(self._pending) + \
-                    [r for r in self._slots if r]:
+            for req in [r for r in self._slots if r]:
                 if not req.done.is_set():
                     if locked:
                         self._release_slot(req)
                     req._finish(FAILED, "engine stopped")
-            self._pending.clear()
+            # drain, don't snapshot-and-clear: a submit() racing this
+            # shutdown must fail its request, not vanish from the queue
+            while True:
+                with self._cond:
+                    if not self._pending:
+                        break
+                    req = self._pending.popleft()
+                if not req.done.is_set():
+                    req._finish(FAILED, "engine stopped")
         finally:
             if locked:
                 self._sched_lock.release()
@@ -653,13 +966,22 @@ class DecodeEngine:
                 self.step()
             except Exception as e:  # noqa: BLE001 — fail requests, not
                 # the thread: a poisoned request must not wedge the
-                # replica with clients blocked on .done forever
-                for req in [r for r in self._slots if r] + \
-                        list(self._pending):
-                    if req._slot is not None:
+                # replica with clients blocked on .done forever. Slot
+                # state only moves under the scheduler lock (a racing
+                # stop() must not double-release the same pages), and
+                # the queue drains via popleft — a submit() racing this
+                # handler is left pending for the next loop iteration,
+                # never silently dropped
+                with self._sched_lock:
+                    for req in [r for r in self._slots if r]:
                         self._release_slot(req)
-                    req._finish(FAILED, f"decode failed: {e}")
-                self._pending.clear()
+                        req._finish(FAILED, f"decode failed: {e}")
+                    while True:
+                        with self._cond:
+                            if not self._pending:
+                                break
+                            req = self._pending.popleft()
+                        req._finish(FAILED, f"decode failed: {e}")
 
     # ------------------------------------------------------------- offline
 

@@ -5,7 +5,10 @@ Reads the trainer's sharded checkpoints (``parallel.checkpoint`` layout:
 for a ``DistributedFileSystem`` the shard reads ride the client's hedged
 read pool (``dfs.client.hedged.read.*``), so one slow DataNode doesn't
 stall replica startup, exactly the straggler story the trainer already
-gets for input data.
+gets for input data. Shards are fetched CONCURRENTLY through a bounded
+worker pool (``serving.loader.io.workers``): replica cold-start is pure
+IO fan-in latency, and sequential shard pulls were paying one
+round-trip per shard file.
 
 The trainer persists ``{"params": ..., "opt": ...}``; serving wants the
 params only. The manifest's leaf names tell us which layout we're
@@ -30,6 +33,7 @@ log = logging.getLogger(__name__)
 
 HEDGED_POOL_KEY = "dfs.client.hedged.read.threadpool.size"
 HEDGED_THRESHOLD_KEY = "dfs.client.hedged.read.threshold"
+IO_WORKERS_KEY = "serving.loader.io.workers"
 
 
 def serving_read_defaults(conf) -> None:
@@ -42,12 +46,15 @@ def serving_read_defaults(conf) -> None:
 
 def load_serving_params(fs, base_dir: str, cfg: ModelConfig, *,
                         step: Optional[int] = None,
-                        mesh=None, specs=None) -> Tuple[dict, int]:
+                        mesh=None, specs=None,
+                        io_workers: int = 4) -> Tuple[dict, int]:
     """Load decoder params for ``cfg`` from ``base_dir`` on ``fs``.
 
     Returns ``(params, step)``. With ``mesh`` + ``specs`` the leaves are
     placed sharded (the engine passes ``param_specs`` when it owns a
-    mesh). Raises FileNotFoundError when no complete checkpoint exists.
+    mesh). ``io_workers`` bounds the concurrent shard fetches (1 =
+    sequential). Raises FileNotFoundError when no complete checkpoint
+    exists.
     """
     t0 = time.monotonic()
     if step is None:
@@ -64,9 +71,11 @@ def load_serving_params(fs, base_dir: str, cfg: ModelConfig, *,
     spec_tree = {"params": specs} if (wrapped and specs is not None) \
         else specs
     tree, step = load_checkpoint(fs, base_dir, like, step=step,
-                                 mesh=mesh, specs=spec_tree)
+                                 mesh=mesh, specs=spec_tree,
+                                 io_workers=max(1, io_workers))
     params = tree["params"] if wrapped else tree
     n = sum(x.size for x in jax.tree_util.tree_leaves(params))
-    log.info("loaded %d-param checkpoint step %d from %s in %.2fs",
-             n, step, base_dir, time.monotonic() - t0)
+    log.info("loaded %d-param checkpoint step %d from %s in %.2fs "
+             "(%d io workers)", n, step, base_dir,
+             time.monotonic() - t0, max(1, io_workers))
     return params, step

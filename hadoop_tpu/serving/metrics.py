@@ -24,6 +24,15 @@ class ServingMetrics:
     - ``tokens_out``         generated tokens (monotonic; tokens/s is the
                              derivative any sink can take)
     - ``requests`` / ``preemptions`` lifetime counters
+    - ``prefix_cache_hit_rate``  fraction of admitted prompt tokens
+                             served from cached KV blocks (0..1)
+    - ``prefix_cached_blocks``   resident reusable KV pages
+    - ``prefix_tokens_reused`` / ``prefix_cache_evictions`` counters
+    - ``chunk_occupancy``    fraction of the per-step prefill-chunk
+                             budget actually used last step
+    - ``prefill_backlog``    prompt tokens still awaiting prefill across
+                             admitted requests (the stall gauge: how far
+                             first tokens lag behind admission)
     """
 
     def __init__(self, source: str = SOURCE):
@@ -46,6 +55,24 @@ class ServingMetrics:
         self.requests = reg.counter("requests", "requests submitted")
         self.preemptions = reg.counter(
             "preemptions", "requests evicted from the KV pool")
+        self.prefix_cache_hit_rate = reg.gauge(
+            "prefix_cache_hit_rate",
+            "fraction of prompt tokens served from cached KV blocks")
+        self.prefix_cached_blocks = reg.gauge(
+            "prefix_cached_blocks", "resident reusable KV pages")
+        self.prefix_tokens_reused = reg.counter(
+            "prefix_tokens_reused",
+            "prompt tokens whose prefill was skipped via the prefix cache")
+        self.prefix_cache_evictions = reg.counter(
+            "prefix_cache_evictions",
+            "cached KV pages evicted (LRU) to feed live allocations")
+        self.chunk_occupancy = reg.gauge(
+            "chunk_occupancy",
+            "fraction of the per-step prefill chunk budget used")
+        self.prefill_backlog = reg.gauge(
+            "prefill_backlog",
+            "prompt tokens still awaiting prefill across admitted "
+            "requests")
 
     def snapshot(self):
         return self.registry.snapshot()

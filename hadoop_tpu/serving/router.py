@@ -7,10 +7,20 @@ vanish on lease expiry, so a dead replica falls out of the candidate set
 by itself, and a draining replica flips its ``state`` attribute before
 unregistering so the router stops picking it ahead of the TTL.
 
-Balancing is power-of-two-choices over the router's own outstanding
-request counts (the classic load-balancing result: two random probes +
-pick-the-lighter gets within a constant of perfect balance without any
-global state). Failures ride the IPC retry policies
+Balancing is prefix-affinity first, power-of-two-choices underneath:
+requests carrying tokens hash a bounded prompt prefix and rendezvous-
+hash it over the live replica set, so requests sharing a prefix keep
+landing on the replica whose prefix-reuse KV cache likely holds it —
+cache hit-rate survives a multi-replica fleet instead of decaying
+1/N. Rendezvous (highest-random-weight) hashing keeps the mapping
+stable when replicas come and go: only keys owned by the departed
+replica move. When the affinity target is overloaded relative to the
+lightest candidate (``serving.router.affinity.max.imbalance``
+outstanding requests), the router falls back to power-of-two-choices
+over its own outstanding counts (the classic result: two random probes
++ pick-the-lighter gets within a constant of perfect balance without
+any global state) — affinity is a preference, never a hotspot
+generator. Failures ride the IPC retry policies
 (``ipc.retry.RetryPolicies``): connection errors and 503-draining
 responses retry against a different replica with exponential backoff,
 deterministic application errors (400s) fail fast.
@@ -18,6 +28,7 @@ deterministic application errors (400s) fail fast.
 
 from __future__ import annotations
 
+import hashlib
 import http.client
 import json
 import logging
@@ -73,6 +84,13 @@ class ServingRouter:
         self._cache_at = 0.0
         self._outstanding: Dict[str, int] = {}
         self._lock = threading.Lock()
+        self.affinity_enabled = self.conf.get_bool(
+            "serving.router.affinity.enabled", True)
+        self.affinity_prefix = self.conf.get_int(
+            "serving.router.affinity.prefix.tokens", 64)
+        self.affinity_max_imbalance = self.conf.get_int(
+            "serving.router.affinity.max.imbalance", 4)
+        self.affinity_routed = 0      # picks that followed the prefix hash
 
     # ------------------------------------------------------------ discovery
 
@@ -105,8 +123,22 @@ class ServingRouter:
             self._cache_at = now
         return list(recs)
 
-    def _pick(self, exclude: set) -> ServiceRecord:
-        """Power-of-two-choices on local outstanding counts."""
+    def _affinity_key(self, payload: Dict) -> Optional[str]:
+        """Digest of a bounded prompt prefix — the routing key that
+        keeps shared-prefix traffic on one replica's warm KV cache.
+        Bounded so two prompts diverging past the prefix still share a
+        replica, and hashing a megaprompt costs O(prefix)."""
+        tokens = payload.get("tokens")
+        if (not self.affinity_enabled or not isinstance(tokens, list)
+                or not tokens):
+            return None
+        head = ",".join(str(t) for t in tokens[:self.affinity_prefix])
+        return hashlib.sha256(head.encode()).hexdigest()
+
+    def _pick(self, exclude: set,
+              affinity: Optional[str] = None) -> ServiceRecord:
+        """Prefix-affinity (rendezvous hash) with a load guard, else
+        power-of-two-choices on local outstanding counts."""
         cands = [r for r in self.replicas() if r.path not in exclude]
         if not cands:
             cands = [r for r in self.replicas(refresh=True)
@@ -116,18 +148,26 @@ class ServingRouter:
                 f"no live replicas for {self.service}")
         if len(cands) == 1:
             return cands[0]
-        a, b = random.sample(cands, 2)
         with self._lock:
-            la = self._outstanding.get(a.path, 0)
-            lb = self._outstanding.get(b.path, 0)
-        return a if la <= lb else b
+            loads = {r.path: self._outstanding.get(r.path, 0)
+                     for r in cands}
+        if affinity is not None:
+            target = max(cands, key=lambda r: hashlib.sha256(
+                f"{affinity}|{r.path}".encode()).digest())
+            if loads[target.path] - min(loads.values()) <= \
+                    self.affinity_max_imbalance:
+                self.affinity_routed += 1
+                return target
+        a, b = random.sample(cands, 2)
+        return a if loads[a.path] <= loads[b.path] else b
 
     # -------------------------------------------------------------- request
 
     def generate(self, payload: Dict, user: Optional[str] = None) -> Dict:
         """POST /v1/generate on a balanced replica; returns the decoded
         JSON. Retries per policy on transport errors / draining."""
-        return self._with_retry(lambda rec: self._post(rec, payload, user))
+        return self._with_retry(lambda rec: self._post(rec, payload, user),
+                                self._affinity_key(payload))
 
     def generate_stream(self, payload: Dict,
                         user: Optional[str] = None) -> Iterator[Dict]:
@@ -138,7 +178,7 @@ class ServingRouter:
         payload = dict(payload, stream=True)
         resp, conn, rec = self._with_retry(
             lambda rec: self._post(rec, payload, user, stream=True)
-            + (rec,))
+            + (rec,), self._affinity_key(payload))
         # the stream holds its p2c weight for its whole life, not just
         # connection setup — a minutes-long stream is real load
         with self._lock:
@@ -155,12 +195,12 @@ class ServingRouter:
                 n = self._outstanding.get(rec.path, 1)
                 self._outstanding[rec.path] = max(0, n - 1)
 
-    def _with_retry(self, fn):
+    def _with_retry(self, fn, affinity: Optional[str] = None):
         retries = failovers = 0
         exclude: set = set()
         while True:
             try:
-                rec = self._pick(exclude)
+                rec = self._pick(exclude, affinity)
             except NoReplicasError as e:
                 action = self.policy.should_retry(e, retries, failovers,
                                                   True)

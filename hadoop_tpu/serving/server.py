@@ -8,7 +8,9 @@ metrics), ``/conf``, ``/stacks`` for free next to its API:
                          "temperature": 0.7, "top_k": 40,
                          "stream": true}
     GET  /v1/health     liveness + load (queue depth, occupancy,
-                        free KV pages) — what the router balances on
+                        free KV pages, prefix-cache hit rate / resident
+                        blocks / chunk budget) — what the router
+                        balances on and dashboards scrape
 
 ``/v1/generate`` is wrapped in the hadoop-auth filter
 (``security.http_auth.AuthFilter``): callers present ``?user.name=`` or
@@ -100,6 +102,10 @@ class ServingServer:
             "kv_blocks_free": eng.pool.num_free,
             "kv_blocks_total": eng.pool.num_usable,
             "tokens_generated": eng.tokens_generated,
+            "prefilling": eng.num_prefilling,
+            # prefix-reuse cache + chunked-prefill observability: the
+            # router and ops dashboards read hit_rate/cached_blocks here
+            "prefix_cache": eng.cache_stats(),
         }
 
     def _generate(self, query: Dict, body):
@@ -119,6 +125,7 @@ class ServingServer:
                 temperature=float(req.get("temperature", 0.0)),
                 top_k=int(req.get("top_k", 0)),
                 stop_token=req.get("stop_token"))
+            timeout = float(req.get("timeout", 300.0))
         except (KeyError, TypeError, ValueError) as e:
             return 400, {"RemoteException": {
                 "exception": "IllegalArgumentException",
@@ -137,7 +144,20 @@ class ServingServer:
         if str(req.get("stream", "")).lower() in ("1", "true", "yes") or \
                 req.get("stream") is True:
             return 200, self._stream(handle, span)
-        out = handle.wait(timeout=float(req.get("timeout", 300.0)))
+        try:
+            out = handle.wait(timeout=timeout)
+        except TimeoutError:
+            # 4xx on purpose: the router fails 4xx fast, so a slow
+            # generation is NOT replayed end-to-end on every other
+            # replica (retry amplification exactly when the fleet is
+            # loaded); the request keeps decoding here and its tokens
+            # drop — same semantics as a client killing a stream
+            span.add_kv("timed_out", "true")
+            span.finish()
+            return 408, {"RemoteException": {
+                "exception": "RequestTimedOutException",
+                "message": f"request {handle.id} still decoding after "
+                           f"{timeout}s"}}
         span.add_kv("tokens_out", str(len(out)))
         span.finish()
         return 200, {"request_id": handle.id, "tokens": out,

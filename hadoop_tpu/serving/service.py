@@ -23,12 +23,14 @@ import signal
 import socket
 import sys
 import threading
+import time
 import uuid
 from typing import List, Optional, Tuple
 
 from hadoop_tpu.conf import Configuration
 from hadoop_tpu.models.config import get_config
-from hadoop_tpu.serving.loader import (load_serving_params,
+from hadoop_tpu.serving.loader import (IO_WORKERS_KEY,
+                                       load_serving_params,
                                        serving_read_defaults)
 from hadoop_tpu.serving.metrics import ServingMetrics
 from hadoop_tpu.serving.router import replica_path
@@ -82,7 +84,11 @@ class ServingReplica:
         cfg = get_config(preset)
         fs = FileSystem.get(checkpoint, conf)
         ckpt_dir = Path(checkpoint).path
-        params, step = load_serving_params(fs, ckpt_dir, cfg)
+        t0 = time.monotonic()
+        params, step = load_serving_params(
+            fs, ckpt_dir, cfg,
+            io_workers=conf.get_int(IO_WORKERS_KEY, 4))
+        self.load_seconds = round(time.monotonic() - t0, 3)
         self.step = step
         self.engine = DecodeEngine(
             params, cfg,
@@ -90,6 +96,9 @@ class ServingReplica:
             block_size=conf.get_int("serving.kv.block.size", 16),
             num_blocks=conf.get_int("serving.kv.num.blocks", 0) or None,
             max_context=conf.get_int("serving.max.context", 0) or None,
+            prefill_chunk=conf.get_int("serving.prefill.chunk", 16),
+            prefix_cache=conf.get_bool("serving.prefix_cache.enabled",
+                                       True),
             metrics=ServingMetrics())
         self.server = ServingServer(self.engine, conf, bind=bind)
         # advertise a reachable address: the bind host when concrete, the
@@ -114,7 +123,11 @@ class ServingReplica:
                            f"{self.advertise_host}:{self.server.port}"},
                 attributes={"state": "serving",
                             "slots": str(self.engine.max_batch),
-                            "step": str(self.step)})
+                            "step": str(self.step),
+                            # checkpoint pull latency: the fleet-level
+                            # cold-start signal (regressions here mean
+                            # slow flex-up under YARN restarts)
+                            "load_seconds": str(self.load_seconds)})
             self.reg.register(self.record, ttl_s=self.conf.get_time_seconds(
                 "serving.registry.ttl", 10.0))
         log.info("serving replica %s/%s up on :%d (checkpoint step %d)",

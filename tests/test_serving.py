@@ -25,7 +25,7 @@ from hadoop_tpu.conf import Configuration
 from hadoop_tpu.models.config import get_config
 from hadoop_tpu.models.decoder import forward, init_params
 from hadoop_tpu.serving.engine import (BlockPool, DecodeEngine,
-                                       SamplingParams)
+                                       PrefixCache, SamplingParams)
 
 
 @pytest.fixture(scope="module")
@@ -72,6 +72,49 @@ def test_block_pool_alloc_free():
     assert sorted(c) == sorted(a)        # freed pages recycle
     with pytest.raises(ValueError):
         pool.free([BlockPool.SCRATCH])
+
+
+def test_block_pool_refcounts_protect_shared_pages():
+    pool = BlockPool(num_blocks=6, block_size=4)
+    blocks = pool.alloc(2)
+    assert all(pool.refcount(b) == 1 for b in blocks)
+    pool.incref(blocks)                  # a second request maps them
+    with pytest.raises(ValueError):      # still shared: free must refuse
+        pool.free(blocks)
+    assert pool.decref(blocks) == []     # first unmap: nothing hits zero
+    zeros = pool.decref(blocks)          # second unmap: both unreferenced
+    assert sorted(zeros) == sorted(blocks)
+    pool.free(zeros)                     # only now may they recycle
+    assert pool.num_free == 5
+    with pytest.raises(ValueError):      # double-decref is a bug
+        pool.decref(blocks)
+    with pytest.raises(ValueError):
+        pool.incref([BlockPool.SCRATCH])
+
+
+def test_prefix_cache_radix_match_insert_evict():
+    """Block-granular trie: longest full-block prefix match, first
+    writer wins on insert, LRU zero-ref leaves evict first (a parent
+    can only go after its children)."""
+    cache = PrefixCache(block_size=2)
+    ref = {10: 0, 11: 0, 12: 0, 13: 0}
+    assert cache.match([1, 2, 3, 4]) == []
+    assert cache.insert([1, 2, 3, 4], [10, 11]) == 2
+    assert cache.match([1, 2, 3, 4, 5]) == [10, 11]   # partial tail cut
+    assert cache.match([1, 2, 9, 9]) == [10]          # diverges mid-way
+    assert cache.match([9, 2, 3, 4]) == []            # prefix is the key:
+    # same block tokens under a different head must NOT match
+    assert cache.insert([1, 2, 3, 4], [12, 13]) == 0  # dedup: first wins
+    assert cache.match([1, 2, 3, 4]) == [10, 11]
+    assert cache.insert([1, 2, 7, 8], [10, 12]) == 1  # sibling branch
+    assert len(cache) == 3
+    # 11 is the least-recently-touched leaf (12 was just inserted)
+    assert cache.evict(1, ref.get) == [11]
+    ref[12] = 1                                       # a request maps 12
+    assert cache.evict(2, ref.get) == []   # leaf pinned, parent has kids
+    ref[12] = 0
+    assert cache.evict(2, ref.get) == [12, 10]        # leaf, then parent
+    assert len(cache) == 0
 
 
 # ------------------------------------------------------------------ engine
@@ -159,10 +202,17 @@ def test_kv_pool_pressure_preempts_youngest_and_recovers(tiny_model):
     assert eng.metrics.preemptions.value() >= 1
     assert a.wait(0) == ref_a
     assert b.wait(0) == ref_b
-    assert eng.pool.num_free == eng.pool.num_usable   # all pages back
+    # every page is either free or resident ref-zero prefix cache —
+    # nothing is still mapped by a finished request
+    cached = len(eng.prefix_cache)
+    assert eng.pool.num_free + cached == eng.pool.num_usable
+    assert all(eng.pool.refcount(b) == 0
+               for b in range(1, eng.pool.num_blocks))
 
 
 def test_submit_rejects_impossible_requests(tiny_model):
+    """A request the pool can NEVER satisfy must fail fast at submit —
+    parking it in the admission queue would wedge the queue forever."""
     params, cfg = tiny_model
     eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
                        max_context=16, num_blocks=3)
@@ -170,10 +220,23 @@ def test_submit_rejects_impossible_requests(tiny_model):
         eng.submit(list(range(20)), SamplingParams(max_new_tokens=1))
     with pytest.raises(ValueError):     # pool can never hold it
         eng.submit([1, 2], SamplingParams(max_new_tokens=12))
+    assert eng.queue_depth == 0         # rejected, not parked
     with pytest.raises(ValueError):
         eng.submit([], SamplingParams())
     with pytest.raises(ValueError):     # prefill always emits one token
         eng.submit([1], SamplingParams(max_new_tokens=0))
+    # the bound is pool capacity, not current availability: resident
+    # prefix-cache blocks are evictable, so a feasible request must
+    # still be accepted when the pool is momentarily full of cache
+    eng2 = DecodeEngine(params, cfg, max_batch=1, block_size=4,
+                        max_context=16, num_blocks=4)   # 3 usable pages
+    eng2.generate([[1, 2, 3, 4, 5, 6]], SamplingParams(max_new_tokens=2))
+    assert len(eng2.prefix_cache) > 0   # cache resident, pages not free
+    with pytest.raises(ValueError):     # 13 tokens = 4 pages > 3 ever
+        eng2.submit(list(range(9)), SamplingParams(max_new_tokens=4))
+    out = eng2.generate([[9, 9, 9, 9, 9, 9, 9, 9]],
+                        SamplingParams(max_new_tokens=4))
+    assert len(out[0]) == 4             # feasible: cache evicted to fit
 
 
 def test_engine_context_never_exceeds_model_max_seq(tiny_model):
@@ -206,6 +269,110 @@ def test_per_request_sampling_params(tiny_model):
     assert all(0 <= t < cfg.vocab_size for t in free.wait(0))
 
 
+def test_warm_prefix_cache_stays_exact_match(tiny_model):
+    """The tentpole correctness pin: decode through REUSED KV blocks
+    must produce exactly the tokens a cold full recompute produces —
+    for a shared-head sibling and for an identical resubmit."""
+    params, cfg = tiny_model
+    head = [5, 9, 2, 7, 1, 8, 3, 6, 4, 2, 9, 1, 7, 3, 8, 5]   # 4 blocks
+    pa, pb = head + [11, 12], head + [13]
+    ref_a = _reference_greedy(params, cfg, pa, 8)
+    ref_b = _reference_greedy(params, cfg, pb, 8)
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                       max_context=48, prefill_chunk=4,
+                       metrics=_metrics())
+    a = eng.submit(pa, SamplingParams(max_new_tokens=8))
+    while not a.done.is_set():
+        eng.step()
+    assert a.wait(0) == ref_a                   # cold
+    assert a.prefix_tokens_reused == 0
+    assert len(eng.prefix_cache) >= 4           # head blocks resident
+    b = eng.submit(pb, SamplingParams(max_new_tokens=8))
+    while not b.done.is_set():
+        eng.step()
+    assert b.wait(0) == ref_b                   # warm sibling: exact
+    assert b.prefix_tokens_reused == 16         # the whole head
+    a2 = eng.submit(pa, SamplingParams(max_new_tokens=8))
+    while not a2.done.is_set():
+        eng.step()
+    assert a2.wait(0) == ref_a                  # identical resubmit:
+    # matched to the last full block, never the final prompt token
+    # (its logits must be recomputed to sample the first output)
+    assert a2.prefix_tokens_reused == 16
+    stats = eng.cache_stats()
+    assert stats["hit_rate"] > 0
+    # engine-local counter, not the process-global metrics source
+    # (other tests in this process share that counter object)
+    assert eng.prefix_tokens_matched == 32
+    assert eng.decode_compiles == 1 and eng.prefill_compiles == 1
+
+
+def test_chunked_prefill_does_not_stall_running_decodes(tiny_model):
+    """A long prompt prefills prefill_chunk tokens per step INSIDE the
+    decode step: the running request keeps emitting one token every
+    step of the newcomer's multi-chunk prefill (the head-of-line block
+    the monolithic prefill used to cause), and both streams stay
+    exact."""
+    params, cfg = tiny_model
+    long_prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9, 3,
+                   2, 3, 8, 4]                                  # 5 chunks
+    ref_a = _reference_greedy(params, cfg, [7, 8, 9], 16)
+    ref_b = _reference_greedy(params, cfg, long_prompt, 6)
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                       max_context=48, prefill_chunk=4)
+    a = eng.submit([7, 8, 9], SamplingParams(max_new_tokens=16))
+    eng.step()
+    eng.step()
+    a_before = len(a.out_tokens)
+    b = eng.submit(long_prompt, SamplingParams(max_new_tokens=6))
+    b_first_step = None
+    for i in range(1, 30):
+        eng.step()
+        if b.out_tokens and b_first_step is None:
+            b_first_step = i
+            a_during = len(a.out_tokens) - a_before
+            break
+    assert b_first_step >= 5, "20-token prompt at chunk=4 must take " \
+                              ">= 5 steps to its first token"
+    # every prefill-chunk step also advanced A by one decode token
+    assert a_during >= b_first_step - 1
+    while not (a.done.is_set() and b.done.is_set()):
+        eng.step()
+    assert a.wait(0) == ref_a
+    assert b.wait(0) == ref_b
+    assert eng.decode_compiles == 1 and eng.prefill_compiles == 1
+
+
+def test_preempting_a_sharer_never_frees_sibling_blocks(tiny_model):
+    """Preemption x chunked prefill x prefix sharing: B maps A's cached
+    head blocks; pool pressure then preempts B (the youngest). The
+    shared pages must survive for A (its stream stays exact), and B's
+    warm resubmit-by-recompute stays exact too."""
+    params, cfg = tiny_model
+    head = [5, 9, 2, 7, 1, 8, 3, 6]                   # 2 full blocks
+    pa, pb = head + [1, 2], head + [3, 4]
+    ref_a = _reference_greedy(params, cfg, pa, 14)
+    ref_b = _reference_greedy(params, cfg, pb, 10)
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                       max_context=32, num_blocks=8, prefill_chunk=4,
+                       metrics=_metrics())
+    a = eng.submit(pa, SamplingParams(max_new_tokens=14))
+    while a._prefill_pos is not None or not a.out_tokens:
+        eng.step()                      # A's head is now cached
+    b = eng.submit(pb, SamplingParams(max_new_tokens=10))
+    while not (a.done.is_set() and b.done.is_set()):
+        eng.step()
+    assert b.prefix_tokens_reused >= 8, "B never mapped the shared head"
+    assert b.preemptions >= 1, "pool pressure never evicted the youngest"
+    assert a.wait(0) == ref_a           # sibling pages survived
+    assert b.wait(0) == ref_b           # warm recompute resume: exact
+    # every page is free or resident zero-ref cache; nothing leaked
+    assert eng.pool.num_free + len(eng.prefix_cache) == \
+        eng.pool.num_usable
+    assert all(eng.pool.refcount(blk) == 0
+               for blk in range(1, eng.pool.num_blocks))
+
+
 def test_engine_shards_over_tp_mesh(tiny_model):
     """The same engine code runs with weights and KV heads sharded over
     a tp=2 mesh (virtual CPU devices) — greedy output is unchanged."""
@@ -235,12 +402,15 @@ def test_loader_reads_wrapped_and_bare_trees(tmp_path, tiny_model):
     save_checkpoint(fs, f"{tmp_path}/wrapped", 3,
                     {"params": params, "opt": {"step": jnp.zeros(())}})
     save_checkpoint(fs, f"{tmp_path}/bare", 5, params)
-    for base in ("wrapped", "bare"):
-        got, step = load_serving_params(fs, f"{tmp_path}/{base}", cfg)
-        assert step == (3 if base == "wrapped" else 5)
-        for a, b in zip(jax.tree_util.tree_leaves(got),
-                        jax.tree_util.tree_leaves(params)):
-            assert jnp.allclose(a, b)
+    # sequential and concurrent shard fetch must load identical trees
+    for io_workers in (1, 4):
+        for base in ("wrapped", "bare"):
+            got, step = load_serving_params(fs, f"{tmp_path}/{base}",
+                                            cfg, io_workers=io_workers)
+            assert step == (3 if base == "wrapped" else 5)
+            for a, b in zip(jax.tree_util.tree_leaves(got),
+                            jax.tree_util.tree_leaves(params)):
+                assert jnp.allclose(a, b)
 
 
 # ----------------------------------------------------------- http replica
@@ -322,6 +492,11 @@ def test_end_to_end_dfs_checkpoint_to_streaming_http(tmp_path,
             assert max(eng.occupancy_log) >= 2
             assert eng.metrics.ttft.snapshot()[
                 "time_to_first_token_count"] == 3
+            # cache observability rides the health door
+            status, health = _post_json(srv.port, "/v1/health", {})
+            assert status == 200
+            assert health["prefix_cache"]["enabled"] is True
+            assert health["prefix_cache"]["prefill_chunk"] >= 1
 
             # streaming: chunked JSON lines, one per token
             conn = http.client.HTTPConnection("127.0.0.1", srv.port,
@@ -347,6 +522,31 @@ def test_end_to_end_dfs_checkpoint_to_streaming_http(tmp_path,
             assert health["status"] == "draining"
         finally:
             srv.stop()
+
+
+def test_generate_timeout_returns_408_not_retriable(tiny_model):
+    """A generation outliving the client timeout returns 408 (a 4xx the
+    router fails fast on) instead of a 500 the router would replay on
+    every replica — retry amplification under load."""
+    from hadoop_tpu.serving.server import ServingServer
+    params, cfg = tiny_model
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                       max_context=32)
+    srv = ServingServer(eng, Configuration(load_defaults=False))
+    srv.start()          # engine scheduler NOT started: request parks
+    try:
+        status, body = _post_json(
+            srv.port, "/v1/generate",
+            {"tokens": [1, 2], "max_new_tokens": 4, "timeout": 0.2})
+        assert status == 408
+        assert "RequestTimedOutException" in str(body)
+        status, body = _post_json(
+            srv.port, "/v1/generate",
+            {"tokens": [1, 2], "timeout": "abc"})
+        assert status == 400         # malformed timeout is a 400 like
+        assert "IllegalArgument" in str(body)   # every other bad field
+    finally:
+        srv.stop()
 
 
 def test_router_power_of_two_and_drain(tiny_model):
@@ -414,6 +614,65 @@ def test_router_power_of_two_and_drain(tiny_model):
         out = router.generate({"tokens": [3, 4, 5],
                                "max_new_tokens": 4})
         assert out["tokens"] == ref
+        router.close()
+        rc.close()
+    finally:
+        for srv in servers:
+            srv.stop()
+        reg_srv.stop()
+
+
+def test_router_prefix_affinity_pins_shared_prefixes(tiny_model):
+    """Requests sharing a prompt prefix rendezvous onto ONE replica
+    (its prefix cache keeps earning hits across the fleet) and fail
+    over when that replica drains."""
+    from hadoop_tpu.registry import (RegistryClient, RegistryServer,
+                                     ServiceRecord)
+    from hadoop_tpu.serving.router import ServingRouter, replica_path
+    from hadoop_tpu.serving.server import ServingServer
+    params, cfg = tiny_model
+    conf = Configuration(load_defaults=False)
+    reg_srv = RegistryServer(conf)
+    reg_srv.init(conf)
+    reg_srv.start()
+    engines, servers = [], []
+    try:
+        for _ in range(2):
+            eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                               max_context=32)
+            srv = ServingServer(eng, Configuration(load_defaults=False))
+            eng.start()
+            srv.start()
+            engines.append(eng)
+            servers.append(srv)
+        reg_addr = ("127.0.0.1", reg_srv.port)
+        rc = RegistryClient(reg_addr, conf)
+        for i, srv in enumerate(servers):
+            rc.register(ServiceRecord(
+                replica_path("affine", f"r{i}"),
+                {"http": f"127.0.0.1:{srv.port}"},
+                {"state": "serving"}), ttl_s=30.0, auto_renew=False)
+        router = ServingRouter(reg_addr, "affine", conf, cache_ttl_s=0.0)
+        ref = _reference_greedy(params, cfg, [3, 4, 5], 4)
+        for _ in range(6):
+            out = router.generate({"tokens": [3, 4, 5],
+                                   "max_new_tokens": 4})
+            assert out["tokens"] == ref
+        assert router.affinity_routed == 6
+        # all six shared-prefix requests landed on one replica
+        served = [e for e in engines if e.tokens_generated > 0]
+        assert len(served) == 1
+        # drain the pinned replica: affinity must fail over, not wedge
+        pinned = engines.index(served[0])
+        servers[pinned].drain(timeout=10)
+        rc.register(ServiceRecord(
+            replica_path("affine", f"r{pinned}"),
+            {"http": f"127.0.0.1:{servers[pinned].port}"},
+            {"state": "draining"}), ttl_s=30.0, auto_renew=False)
+        out = router.generate({"tokens": [3, 4, 5],
+                               "max_new_tokens": 4})
+        assert out["tokens"] == ref
+        assert engines[1 - pinned].tokens_generated > 0
         router.close()
         rc.close()
     finally:
