@@ -1,0 +1,44 @@
+"""tpulint — AST static analysis for the invariants tests can only sample.
+
+The reference Hadoop encodes its concurrency and RPC conventions
+(`@GuardedBy`, FSNamesystem lock ordering, socket timeouts) as findbugs
+rules run in CI; this package is the same idea for this tree, organised
+as pluggable checkers over a shared parsed-module project:
+
+``lock/*``   lock discipline: ``# guarded-by: <lock>`` field annotations
+             enforced against ``with self.<lock>`` scopes, plus a
+             cross-module lock-acquisition-order graph with cycle
+             detection (deadlocks caught before they are scheduled).
+``jit/*``    tracer discipline: inside functions reachable from
+             ``jax.jit``, Python branches on traced values and host
+             syncs (``.item()``, ``np.asarray``) break the engine's
+             compile-once contract — flagged at the line that retraces.
+``rpc/*``    RPC/retry hygiene: timeoutless sockets, ``settimeout(None)``
+             on live connections, constant-sleep retry loops with no
+             backoff/jitter, and silent broad ``except: pass`` swallows.
+
+Entry points: ``hadoop-tpu lint`` and ``python -m hadoop_tpu.analysis``.
+Findings are suppressible per line with ``# lint: disable=<id>`` or via a
+committed baseline file; the run exits nonzero on any unbaselined
+finding, so tier-1 keeps the tree lint-clean.
+"""
+
+from hadoop_tpu.analysis.core import (Finding, Project, SourceModule,
+                                      load_baseline, run_lint)
+from hadoop_tpu.analysis.jitcheck import JitDisciplineChecker
+from hadoop_tpu.analysis.lockcheck import GuardedByChecker, LockOrderChecker
+from hadoop_tpu.analysis.rpccheck import (RetryHygieneChecker,
+                                          SilentSwallowChecker,
+                                          TimeoutChecker)
+
+
+def all_checkers():
+    """The shipped checker set, fresh instances (checkers hold state)."""
+    return [GuardedByChecker(), LockOrderChecker(), JitDisciplineChecker(),
+            TimeoutChecker(), RetryHygieneChecker(), SilentSwallowChecker()]
+
+
+__all__ = ["Finding", "Project", "SourceModule", "run_lint",
+           "load_baseline", "all_checkers", "GuardedByChecker",
+           "LockOrderChecker", "JitDisciplineChecker", "TimeoutChecker",
+           "RetryHygieneChecker", "SilentSwallowChecker"]

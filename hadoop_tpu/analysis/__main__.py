@@ -1,0 +1,120 @@
+"""``python -m hadoop_tpu.analysis`` / ``hadoop-tpu lint`` entry point.
+
+Exit codes: 0 clean (every finding baselined or none), 1 unbaselined
+findings, 2 usage error. ``--write-baseline`` records the current
+findings so a later run fails only on NEW ones — the committed baseline
+is meant to be burned down, never grown.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from hadoop_tpu.analysis import all_checkers
+from hadoop_tpu.analysis.core import (load_baseline, run_lint,
+                                      split_baselined, write_baseline)
+
+DEFAULT_BASELINE = "LINT_BASELINE"
+
+
+def _default_paths() -> List[str]:
+    """The hadoop_tpu package next to this file — linting the shipped
+    tree is the no-arguments behaviour."""
+    return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hadoop-tpu lint",
+        description="tpulint: lock discipline, jit-retracing hazards, "
+                    "RPC timeout hygiene")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the hadoop_tpu "
+                         "package)")
+    ap.add_argument("--baseline", metavar="FILE", default=None,
+                    help=f"baseline file of accepted findings (default: "
+                         f"./{DEFAULT_BASELINE} when present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline file")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings to the baseline file "
+                         "and exit 0")
+    ap.add_argument("--checkers", metavar="IDS", default=None,
+                    help="comma-separated checker names to run "
+                         "(default: all)")
+    ap.add_argument("--list-checkers", action="store_true",
+                    help="list checker names and finding ids")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="print only the summary line")
+    args = ap.parse_args(argv)
+
+    checkers = all_checkers()
+    if args.list_checkers:
+        for ch in checkers:
+            print(f"{ch.name:16s} {', '.join(ch.ids)}")
+        return 0
+    if args.checkers:
+        wanted = {c.strip() for c in args.checkers.split(",")}
+        checkers = [c for c in checkers if c.name in wanted]
+        unknown = wanted - {c.name for c in checkers}
+        if unknown:
+            print(f"lint: unknown checkers: {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    paths = args.paths or _default_paths()
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"lint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    # root: make finding paths stable (hadoop_tpu/... relative) wherever
+    # the command runs from, matching committed baseline keys
+    findings = run_lint(paths, checkers=checkers)
+
+    if args.write_baseline:
+        # write where the user pointed, else the working directory —
+        # never the discovered default (a lint of /some/other/tree must
+        # not clobber this repo's committed baseline)
+        out = args.baseline or DEFAULT_BASELINE
+        write_baseline(out, findings)
+        print(f"lint: wrote {len(findings)} finding(s) to {out}")
+        return 0
+
+    baseline_path = args.baseline
+    if baseline_path is None and not args.no_baseline:
+        # cwd first, then the repo holding the default-linted package
+        for cand in (DEFAULT_BASELINE,
+                     os.path.join(os.path.dirname(_default_paths()[0]),
+                                  DEFAULT_BASELINE)):
+            if os.path.isfile(cand):
+                baseline_path = cand
+                break
+    elif baseline_path is not None and not os.path.isfile(baseline_path):
+        print(f"lint: baseline file not found: {baseline_path}",
+              file=sys.stderr)
+        return 2
+
+    baseline = set()
+    if baseline_path and not args.no_baseline:
+        baseline = load_baseline(baseline_path)
+    new, old = split_baselined(findings, baseline)
+
+    if not args.quiet:
+        for f in new:
+            print(f.render())
+    n_files = len({f.path for f in new})
+    if new:
+        print(f"lint: {len(new)} unbaselined finding(s) in {n_files} "
+              f"file(s)" + (f" ({len(old)} baselined)" if old else ""))
+        return 1
+    print(f"lint: clean ({len(old)} baselined finding(s))"
+          if old else "lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

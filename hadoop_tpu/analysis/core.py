@@ -1,0 +1,253 @@
+"""Checker plumbing: parsed modules, suppressions, baseline, runner.
+
+Mirrors how findbugs runs in the reference's CI: every checker sees every
+module (so cross-module facts like the lock-order graph accumulate), then
+a finalize pass emits whole-project findings. Suppression is per line
+(``# lint: disable=<id>``), per file (``# lint: disable-file=<id>`` in the
+header), or via a committed baseline of ``path:line:checker`` keys that is
+meant to be burned down, never grown.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+_DISABLE_RE = re.compile(r"#\s*lint:\s*disable=([\w/,\- ]+)")
+_DISABLE_FILE_RE = re.compile(r"#\s*lint:\s*disable-file=([\w/,\- ]+)")
+_HOLDS_RE = re.compile(r"#\s*lint:\s*holds=([\w,\- ]+)")
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([\w.]+)")
+_STATIC_FN_RE = re.compile(r"#\s*lint:\s*static-fn")
+
+
+class Finding:
+    """One diagnostic: a checker id anchored to a file:line."""
+
+    __slots__ = ("path", "line", "checker", "message")
+
+    def __init__(self, path: str, line: int, checker: str, message: str):
+        self.path = path          # posix-relative to the lint root
+        self.line = line
+        self.checker = checker    # e.g. "lock/guarded-by"
+        self.message = message
+
+    def key(self) -> str:
+        return f"{self.path}:{self.line}:{self.checker}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.checker}] {self.message}"
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<Finding {self.render()}>"
+
+
+class SourceModule:
+    """One parsed file plus the line-comment annotations checkers read."""
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line -> set of suppressed checker ids ("all" suppresses any)
+        self.suppressed: Dict[int, Set[str]] = {}
+        self.file_suppressed: Set[str] = set()
+        # def-line -> lock names the function body is documented to hold
+        self.holds: Dict[int, Set[str]] = {}
+        # line -> guard annotation (field assignments name their lock)
+        self.guards: Dict[int, str] = {}
+        # def lines marked "# lint: static-fn": the function returns
+        # trace-time-static metadata (shapes, axis sets), so its result
+        # never taints jit-discipline analysis
+        self.static_fn_lines: Set[int] = set()
+        for i, text in enumerate(self.lines, start=1):
+            if "#" not in text:
+                continue
+            m = _DISABLE_RE.search(text)
+            if m:
+                ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+                self.suppressed.setdefault(i, set()).update(ids)
+            m = _DISABLE_FILE_RE.search(text)
+            if m and i <= 10:
+                self.file_suppressed.update(
+                    s.strip() for s in m.group(1).split(",") if s.strip())
+            m = _HOLDS_RE.search(text)
+            if m:
+                self.holds[i] = {s.strip() for s in m.group(1).split(",")
+                                 if s.strip()}
+            m = _GUARDED_RE.search(text)
+            if m:
+                self.guards[i] = m.group(1).strip()
+            if _STATIC_FN_RE.search(text):
+                self.static_fn_lines.add(i)
+
+    # dotted module name under the package root, e.g. "hadoop_tpu.ipc.client"
+    @property
+    def dotted(self) -> str:
+        stem = self.rel[:-3] if self.rel.endswith(".py") else self.rel
+        parts = stem.split("/")
+        if parts and parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+
+    def is_suppressed(self, line: int, checker: str) -> bool:
+        if checker in self.file_suppressed or "all" in self.file_suppressed:
+            return True
+        ids = self.suppressed.get(line)
+        return bool(ids) and (checker in ids or "all" in ids)
+
+    def finding(self, node_or_line, checker: str,
+                message: str) -> Optional[Finding]:
+        """Build a Finding unless that line suppresses the checker."""
+        line = getattr(node_or_line, "lineno", node_or_line)
+        if self.is_suppressed(line, checker):
+            return None
+        return Finding(self.rel, line, checker, message)
+
+
+class Project:
+    """Every module the run will see; shared context for finalize()."""
+
+    def __init__(self, modules: Sequence[SourceModule]):
+        self.modules = list(modules)
+        self.by_dotted: Dict[str, SourceModule] = {
+            m.dotted: m for m in self.modules}
+
+
+class Checker:
+    """Base checker. ``check_module`` runs per file (and may accumulate
+    project-wide state); ``finalize`` emits cross-module findings."""
+
+    name = "checker"
+    ids: Tuple[str, ...] = ()
+
+    def check_module(self, mod: SourceModule) -> List[Finding]:
+        return []
+
+    def finalize(self, project: Project) -> List[Finding]:
+        return []
+
+
+# --------------------------------------------------------------- discovery
+
+_EXCLUDE_DIRS = {"__pycache__", ".git", "node_modules", ".venv"}
+
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(os.path.abspath(p))
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _EXCLUDE_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.abspath(os.path.join(dirpath, fn)))
+    return out
+
+
+def load_project(paths: Iterable[str], root: Optional[str] = None
+                 ) -> Tuple[Project, List[Finding]]:
+    """Parse every .py under ``paths``. Unparseable files become findings
+    (a lint run must not die on one bad file)."""
+    files = iter_py_files(paths)
+    if root is None:
+        root = os.path.commonpath(files) if files else os.getcwd()
+    root = os.path.abspath(root)
+    if os.path.isfile(root):  # single-file run
+        root = os.path.dirname(root)
+    # walk out of the package so rel paths (and dotted names) carry the
+    # package prefix: hadoop_tpu/ipc/client.py, not ipc/client.py
+    while os.path.isfile(os.path.join(root, "__init__.py")):
+        root = os.path.dirname(root)
+    modules: List[SourceModule] = []
+    errors: List[Finding] = []
+    for f in files:
+        rel = os.path.relpath(f, root)
+        try:
+            with open(f, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            modules.append(SourceModule(f, rel, src))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            line = getattr(e, "lineno", None) or 1
+            errors.append(Finding(rel.replace(os.sep, "/"), line,
+                                  "parse/error", f"cannot analyse: {e}"))
+    return Project(modules), errors
+
+
+def run_lint(paths: Iterable[str], checkers=None,
+             root: Optional[str] = None) -> List[Finding]:
+    """Run ``checkers`` (default: the shipped set) over ``paths``."""
+    if checkers is None:
+        from hadoop_tpu.analysis import all_checkers
+        checkers = all_checkers()
+    project, findings = load_project(paths, root=root)
+    for mod in project.modules:
+        for ch in checkers:
+            findings.extend(ch.check_module(mod))
+    for ch in checkers:
+        findings.extend(ch.finalize(project))
+    uniq = {}
+    for f in findings:
+        uniq.setdefault(f.key(), f)
+    findings = list(uniq.values())
+    findings.sort(key=lambda f: (f.path, f.line, f.checker))
+    return findings
+
+
+# ---------------------------------------------------------------- baseline
+
+def load_baseline(path: str) -> Set[str]:
+    """Baseline lines are finding keys (``path:line:checker``); ``#``
+    starts a comment (used to justify each kept entry)."""
+    keys: Set[str] = set()
+    with open(path, "r", encoding="utf-8") as fh:
+        for raw in fh:
+            line = raw.split("#", 1)[0].strip()
+            if line:
+                keys.add(line)
+    return keys
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write("# tpulint baseline — burn down, never grow. Each entry\n"
+                 "# is path:line:checker and should carry a justification.\n")
+        for f in findings:
+            fh.write(f"{f.key()}  # {f.message}\n")
+
+
+def split_baselined(findings: Sequence[Finding], baseline: Set[str]
+                    ) -> Tuple[List[Finding], List[Finding]]:
+    """(new, baselined) — matching is exact on path:line:checker."""
+    new, old = [], []
+    for f in findings:
+        (old if f.key() in baseline else new).append(f)
+    return new, old
+
+
+# -------------------------------------------------------------- AST helpers
+
+def attr_chain(node: ast.AST) -> Optional[List[str]]:
+    """``a.b.c`` -> ["a", "b", "c"]; None for non-name-rooted chains."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """Dotted name of the called object, when name-rooted."""
+    chain = attr_chain(node.func)
+    return ".".join(chain) if chain else None

@@ -1,0 +1,425 @@
+"""Tracer discipline: protect the compile-once contract.
+
+T3 (arxiv 2401.16677) and Flash Communication (arxiv 2412.04964) both
+show overlap/fusion wins evaporating when a stray host sync or retrace
+lands on the hot path. Inside any function reachable from ``jax.jit``
+this checker flags, by value-taint from the jitted function's traced
+parameters:
+
+``jit/traced-branch``  Python ``if``/``while``/``for`` control flow on a
+                       traced VALUE (``x.shape``-derived quantities are
+                       static and stay exempt, as do ``is None`` checks —
+                       both are legal trace-time Python). Each distinct
+                       branch path is a separate compiled program: a
+                       retrace per step on the serving hot path.
+``jit/host-sync``      ``.item()``/``.tolist()``/``float()``/``int()`` /
+                       ``np.asarray()``/``device_get`` on a traced value —
+                       a device round-trip (TracerConversionError at best,
+                       a silent pipeline bubble at worst).
+
+Roots are found per module: ``jax.jit(f)`` / ``@jax.jit`` /
+``@partial(jax.jit, ...)``, unwrapping ``shard_map``/``partial`` wrappers
+and following assignments (``prog = jax.jit(shard_map(body, ...))``).
+Reachability follows same-module calls, ``self.`` methods, and
+``from hadoop_tpu.x import f`` imports, mapping argument taint onto
+callee parameters (so a constant-table default argument stays static).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from hadoop_tpu.analysis.core import (Checker, Finding, Project,
+                                      SourceModule, attr_chain, call_name)
+
+# attribute reads that yield STATIC (trace-time Python) values
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "sharding"}
+# callables whose result is static regardless of argument taint.
+# NOT here: range/max/min/enumerate/zip — those propagate their
+# arguments' taint (range(n) over a traced n is a traced trip count),
+# which the generic Call handling already models. len() is static: it
+# reads the leading shape dimension.
+_STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr",
+                 "type", "str", "repr",
+                 "jnp.issubdtype", "jnp.iinfo", "jnp.finfo", "np.iinfo",
+                 "np.finfo"}
+# receivers of a method call that sync the device when the value is traced
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+               "jax.device_get", "device_get", "onp.asarray", "onp.array"}
+_SYNC_CASTS = {"float", "int", "bool", "complex"}
+
+
+class _FuncDef:
+    def __init__(self, mod: SourceModule, node, cls: Optional[str]):
+        self.mod = mod
+        self.node = node
+        self.cls = cls
+        self.name = getattr(node, "name", f"<lambda:{node.lineno}>")
+        self.qual = (f"{mod.dotted}.{cls}.{self.name}" if cls
+                     else f"{mod.dotted}.{self.name}")
+
+
+class JitDisciplineChecker(Checker):
+    name = "jit-discipline"
+    ids = ("jit/traced-branch", "jit/host-sync")
+
+    def __init__(self):
+        # qual -> _FuncDef for every def in the project
+        self._defs: Dict[str, _FuncDef] = {}
+        # import maps per module: local name -> qualified target
+        self._imports: Dict[str, Dict[str, str]] = {}
+        # jit roots: (qual, params statically bound by partial/defaults)
+        self._roots: List[Tuple[str, frozenset]] = []
+        # defs marked "# lint: static-fn" (trace-time metadata helpers)
+        self._static_fns: Set[str] = set()
+
+    # ------------------------------------------------------- collection
+
+    def check_module(self, mod: SourceModule) -> List[Finding]:
+        imports: Dict[str, str] = {}
+        self._imports[mod.dotted] = imports
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.module and \
+                    node.level == 0:
+                for alias in node.names:
+                    imports[alias.asname or alias.name] = \
+                        f"{node.module}.{alias.name}"
+        self._index_defs(mod, mod.tree.body, cls=None)
+        self._find_roots(mod)
+        return []
+
+    def _index_defs(self, mod: SourceModule, body, cls: Optional[str]):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fd = _FuncDef(mod, node, cls)
+                self._defs[fd.qual] = fd
+                if node.lineno in mod.static_fn_lines:
+                    self._static_fns.add(fd.qual)
+                # nested defs are reachable via their enclosing scope;
+                # index them under the same class for self-resolution
+                self._index_defs(mod, node.body, cls)
+            elif isinstance(node, ast.ClassDef):
+                self._index_defs(mod, node.body, cls=node.name)
+
+    def _find_roots(self, mod: SourceModule) -> None:
+        # decorators
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if self._is_jit_expr(dec):
+                        self._roots.append((self._qual_for(mod, node),
+                                            frozenset()))
+            if isinstance(node, ast.Call) and self._is_jit_call(node):
+                if node.args:
+                    target = self._unwrap(mod, node.args[0])
+                    if target:
+                        self._roots.append(target)
+
+    def _qual_for(self, mod: SourceModule, node) -> str:
+        for q, fd in self._defs.items():
+            if fd.node is node:
+                return q
+        return f"{mod.dotted}.{node.name}"
+
+    @staticmethod
+    def _is_jit_call(node: ast.Call) -> bool:
+        name = call_name(node)
+        return name in ("jax.jit", "jit")
+
+    def _is_jit_expr(self, dec: ast.AST) -> bool:
+        chain = attr_chain(dec)
+        if chain and ".".join(chain) in ("jax.jit", "jit"):
+            return True
+        if isinstance(dec, ast.Call):
+            name = call_name(dec)
+            if name in ("jax.jit", "jit"):
+                return True
+            if name in ("partial", "functools.partial") and dec.args:
+                return self._is_jit_expr(dec.args[0])
+        return False
+
+    def _unwrap(self, mod: SourceModule, expr: ast.AST, depth: int = 0
+                ) -> Optional[Tuple[str, frozenset]]:
+        """Resolve the function object inside jax.jit(...): through
+        shard_map/partial wrappers, local assignments, lambdas, and
+        self-attributes, to (qual, statically-bound-params). Params
+        bound by ``partial`` are Python constants at jit-wrap time, so
+        they never carry tracers."""
+        if depth > 6:
+            return None
+        if isinstance(expr, ast.Lambda):
+            # register the lambda itself as an analysable def: its
+            # defaulted params (constant tables) stay static, its
+            # call-time params are traced
+            fd = _FuncDef(mod, expr, cls=None)
+            self._defs.setdefault(fd.qual, fd)
+            return (fd.qual, frozenset())
+        chain = attr_chain(expr)
+        if chain:
+            if chain[0] == "self" and len(chain) == 2:
+                for q, fd in self._defs.items():
+                    if fd.mod is mod and fd.cls and fd.name == chain[1]:
+                        return (q, frozenset())
+                return None
+            dotted = ".".join(chain)
+            local = f"{mod.dotted}.{dotted}"
+            if local in self._defs:
+                return (local, frozenset())
+            imported = self._imports.get(mod.dotted, {}).get(dotted)
+            if imported in self._defs:
+                return (imported, frozenset())
+            # a local variable: find its assignment and unwrap the value
+            if len(chain) == 1:
+                for node in ast.walk(mod.tree):
+                    if isinstance(node, ast.Assign):
+                        for t in node.targets:
+                            if isinstance(t, ast.Name) and \
+                                    t.id == chain[0]:
+                                got = self._unwrap(mod, node.value,
+                                                   depth + 1)
+                                if got:
+                                    return got
+            return None
+        if isinstance(expr, ast.Call):
+            name = call_name(expr)
+            if name in ("shard_map", "_smap", "jax.jit", "jit",
+                        "checkpoint", "jax.checkpoint", "remat",
+                        "jax.remat", "vmap", "jax.vmap"):
+                if expr.args:
+                    return self._unwrap(mod, expr.args[0], depth + 1)
+            if name in ("partial", "functools.partial") and expr.args:
+                got = self._unwrap(mod, expr.args[0], depth + 1)
+                if got is None:
+                    return None
+                qual, static = got
+                fd = self._defs.get(qual)
+                if fd is None:
+                    return got
+                params = [a.arg for a in fd.node.args.args
+                          if a.arg != "self"]
+                bound = set(static)
+                # positional partial args bind leading params
+                bound.update(params[:len(expr.args) - 1])
+                # keyword partial args bind by name
+                bound.update(k.arg for k in expr.keywords if k.arg)
+                return (qual, frozenset(bound))
+        return None
+
+    # -------------------------------------------------------- finalize
+
+    def finalize(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        # worklist of (qual, frozenset tainted param names)
+        seen: Set[Tuple[str, frozenset]] = set()
+        work: List[Tuple[str, frozenset]] = []
+        for root, static in self._roots:
+            fd = self._defs.get(root)
+            if fd is None:
+                continue
+            tainted = frozenset(self._root_tainted_params(fd) - static)
+            work.append((root, tainted))
+        while work:
+            qual, tainted = work.pop()
+            if (qual, tainted) in seen:
+                continue
+            seen.add((qual, tainted))
+            fd = self._defs.get(qual)
+            if fd is None:
+                continue
+            calls = self._analyse(fd, set(tainted), findings)
+            for callee, callee_tainted in calls:
+                work.append((callee, frozenset(callee_tainted)))
+        # dedupe (same function may be analysed under several taint sets)
+        uniq: Dict[str, Finding] = {}
+        for f in findings:
+            uniq.setdefault(f.key(), f)
+        return list(uniq.values())
+
+    @staticmethod
+    def _root_tainted_params(fd: _FuncDef) -> Set[str]:
+        """Positional params without defaults are traced; ``self`` and
+        defaulted params (constant tables bound at jit time) are not."""
+        args = fd.node.args
+        n_default = len(args.defaults)
+        names = [a.arg for a in args.args]
+        cut = len(names) - n_default if n_default else len(names)
+        return {n for n in names[:cut] if n != "self"}
+
+    # ---- per-function taint pass
+
+    def _analyse(self, fd: _FuncDef, tainted: Set[str],
+                 findings: List[Finding]
+                 ) -> List[Tuple[str, Set[str]]]:
+        mod = fd.mod
+        out_calls: List[Tuple[str, Set[str]]] = []
+
+        def expr_tainted(e: ast.AST) -> bool:
+            if isinstance(e, ast.Name):
+                return e.id in tainted
+            if isinstance(e, ast.Attribute):
+                if e.attr in _STATIC_ATTRS:
+                    return False
+                return expr_tainted(e.value)
+            if isinstance(e, ast.Subscript):
+                return expr_tainted(e.value) or expr_tainted(e.slice)
+            if isinstance(e, ast.Call):
+                name = call_name(e)
+                if name in _STATIC_CALLS:
+                    return False
+                resolved = self._resolve_call(fd, e)
+                if resolved is not None and resolved in self._static_fns:
+                    return False  # marked "# lint: static-fn"
+                if name and (name.split(".")[-1] in
+                             ("astype", "reshape", "sum", "mean", "get")):
+                    return expr_tainted(e.func)
+                args_tainted = any(expr_tainted(a) for a in e.args) or \
+                    any(expr_tainted(k.value) for k in e.keywords)
+                if isinstance(e.func, ast.Attribute):
+                    return args_tainted or expr_tainted(e.func.value)
+                return args_tainted
+            if isinstance(e, ast.BinOp):
+                return expr_tainted(e.left) or expr_tainted(e.right)
+            if isinstance(e, ast.UnaryOp):
+                return expr_tainted(e.operand)
+            if isinstance(e, ast.BoolOp):
+                return any(expr_tainted(v) for v in e.values)
+            if isinstance(e, ast.Compare):
+                # `x is None` / `x is not None` is trace-time Python
+                if all(isinstance(op, (ast.Is, ast.IsNot))
+                       for op in e.ops):
+                    return False
+                return expr_tainted(e.left) or \
+                    any(expr_tainted(c) for c in e.comparators)
+            if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+                return any(expr_tainted(el) for el in e.elts)
+            if isinstance(e, ast.IfExp):
+                return (expr_tainted(e.test) or expr_tainted(e.body)
+                        or expr_tainted(e.orelse))
+            if isinstance(e, ast.Starred):
+                return expr_tainted(e.value)
+            return False
+
+        def taint_targets(t: ast.AST) -> List[str]:
+            if isinstance(t, ast.Name):
+                return [t.id]
+            if isinstance(t, (ast.Tuple, ast.List)):
+                out = []
+                for el in t.elts:
+                    out.extend(taint_targets(el))
+                return out
+            return []
+
+        # two passes so taint flowing backwards through loops settles
+        body = fd.node.body
+        for _ in range(2):
+            for stmt in ast.walk(fd.node):
+                if isinstance(stmt, ast.Assign) and \
+                        expr_tainted(stmt.value):
+                    for t in stmt.targets:
+                        tainted.update(taint_targets(t))
+                elif isinstance(stmt, ast.AugAssign) and \
+                        (expr_tainted(stmt.value) or
+                         expr_tainted(stmt.target)):
+                    tainted.update(taint_targets(stmt.target))
+                elif isinstance(stmt, (ast.For, ast.AsyncFor)) and \
+                        expr_tainted(stmt.iter):
+                    tainted.update(taint_targets(stmt.target))
+
+        # findings + call propagation
+        for node in ast.walk(fd.node):
+            if isinstance(node, (ast.If, ast.While)):
+                if expr_tainted(node.test):
+                    f = mod.finding(
+                        node, "jit/traced-branch",
+                        f"Python branch on a traced value inside "
+                        f"jit-reachable {fd.name}() — every distinct "
+                        f"outcome is a retrace (use jnp.where/lax.cond)")
+                    if f:
+                        findings.append(f)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                if expr_tainted(node.iter):
+                    f = mod.finding(
+                        node, "jit/traced-branch",
+                        f"Python loop over a traced value inside "
+                        f"jit-reachable {fd.name}() — trip count "
+                        f"must be static (use lax.scan/fori_loop)")
+                    if f:
+                        findings.append(f)
+            elif isinstance(node, ast.Call):
+                self._check_sync(fd, node, expr_tainted, findings)
+                callee = self._resolve_call(fd, node)
+                if callee:
+                    callee_tainted = self._map_args(callee, node,
+                                                    expr_tainted)
+                    if callee_tainted is not None:
+                        out_calls.append((callee, callee_tainted))
+        return out_calls
+
+    def _check_sync(self, fd: _FuncDef, node: ast.Call, expr_tainted,
+                    findings: List[Finding]) -> None:
+        mod = fd.mod
+        name = call_name(node)
+        msg = None
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _SYNC_METHODS and \
+                expr_tainted(node.func.value):
+            msg = (f".{node.func.attr}() on a traced value inside "
+                   f"jit-reachable {fd.name}() forces a host sync")
+        elif name in _SYNC_CALLS and any(expr_tainted(a)
+                                         for a in node.args):
+            msg = (f"{name}() materialises a traced value on the host "
+                   f"inside jit-reachable {fd.name}()")
+        elif name in _SYNC_CASTS and len(node.args) == 1 and \
+                expr_tainted(node.args[0]):
+            msg = (f"{name}() on a traced value inside jit-reachable "
+                   f"{fd.name}() forces a host sync "
+                   f"(use jnp casts / keep it on device)")
+        if msg:
+            f = mod.finding(node, "jit/host-sync", msg)
+            if f:
+                findings.append(f)
+
+    def _resolve_call(self, fd: _FuncDef, node: ast.Call) -> Optional[str]:
+        chain = attr_chain(node.func)
+        if not chain:
+            return None
+        if chain[0] == "self" and len(chain) == 2 and fd.cls:
+            q = f"{fd.mod.dotted}.{fd.cls}.{chain[1]}"
+            return q if q in self._defs else None
+        dotted = ".".join(chain)
+        local = f"{fd.mod.dotted}.{dotted}"
+        if local in self._defs:
+            return local
+        # same-class nested / sibling functions indexed under the class
+        if fd.cls and len(chain) == 1:
+            q = f"{fd.mod.dotted}.{fd.cls}.{chain[0]}"
+            if q in self._defs:
+                return q
+        imported = self._imports.get(fd.mod.dotted, {}).get(dotted)
+        if imported in self._defs:
+            return imported
+        return None
+
+    def _map_args(self, callee_qual: str, call: ast.Call,
+                  expr_tainted) -> Optional[Set[str]]:
+        """Taint callee params fed by tainted arguments (positional and
+        keyword); returns None when nothing tainted flows in."""
+        callee = self._defs[callee_qual]
+        params = [a.arg for a in callee.node.args.args]
+        if params and params[0] == "self":
+            params = params[1:]
+        tainted: Set[str] = set()
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                if expr_tainted(arg.value):
+                    tainted.update(params[i:])
+                break
+            if i < len(params) and expr_tainted(arg):
+                tainted.add(params[i])
+        for kw in call.keywords:
+            if kw.arg and kw.arg in [a.arg for a in callee.node.args.args] \
+                    and expr_tainted(kw.value):
+                tainted.add(kw.arg)
+        return tainted if tainted else None

@@ -1,0 +1,413 @@
+"""Lock discipline: guarded-by enforcement + lock-order cycle detection.
+
+The reference encodes these as ``@GuardedBy`` annotations checked by
+findbugs and a documented FSNamesystem → BlockManager lock order; here the
+annotation is a line comment on the field's initialising assignment::
+
+    self._free = deque(...)   # guarded-by: _lock
+
+and every other ``self._free`` access in the class must sit inside a
+``with self._lock`` (or ``with self._lock.read()/.write()`` — the
+namesystem RW lock) scope. Helper methods documented as called under the
+lock mark themselves ``# lint: holds=_lock`` on their ``def`` line.
+
+The order checker builds one graph for the whole run: node =
+``Class.lockattr`` (or ``module.lockvar``), edge A→B when B is acquired
+— lexically, or via a resolvable same-class/same-module call — while A is
+held. Any strongly-connected component is a schedulable deadlock.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from hadoop_tpu.analysis.core import (Checker, Finding, Project,
+                                      SourceModule, attr_chain)
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
+               "Lock", "RLock", "Condition", "NamesystemLock"}
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    chain = attr_chain(node.func)
+    return bool(chain) and ".".join(chain) in _LOCK_CTORS
+
+
+def _with_lock_names(stmt: ast.With) -> List[str]:
+    """Lock attribute names acquired by a ``with`` statement: matches
+    ``self.X``, ``self.X.read()/.write()/...()``, and bare module-level
+    ``X`` / ``X.acquire_shared()`` style items."""
+    out = []
+    for item in stmt.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Call):     # self.lock.write() / lock.held()
+            expr = expr.func
+            if isinstance(expr, ast.Attribute):
+                expr = expr.value          # drop the method
+        chain = attr_chain(expr)
+        if not chain:
+            continue
+        if chain[0] == "self" and len(chain) >= 2:
+            out.append(chain[1])
+        elif len(chain) == 1:
+            out.append(chain[0])
+    return out
+
+
+class _ClassInfo:
+    def __init__(self, module: SourceModule, node: ast.ClassDef):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.lock_attrs: Set[str] = set()
+        self.guards: Dict[str, Tuple[str, int]] = {}  # field -> (lock, line)
+        # find lock fields and guarded fields from __init__-level
+        # assignments anywhere in the class body
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                targets = sub.targets if isinstance(sub, ast.Assign) \
+                    else [sub.target]
+                value = sub.value
+                for t in targets:
+                    chain = attr_chain(t)
+                    if not chain or chain[0] != "self" or len(chain) != 2:
+                        continue
+                    field = chain[1]
+                    if value is not None and _is_lock_ctor(value):
+                        self.lock_attrs.add(field)
+                    guard = module.guards.get(sub.lineno)
+                    if guard:
+                        self.guards[field] = (guard, sub.lineno)
+
+
+class GuardedByChecker(Checker):
+    """``lock/guarded-by`` — a field annotated ``# guarded-by: <lock>``
+    touched outside a ``with self.<lock>`` scope."""
+
+    name = "guarded-by"
+    ids = ("lock/guarded-by",)
+
+    # methods where unguarded access is inherent: construction (object
+    # not yet shared) and destruction (object no longer shared)
+    _EXEMPT = {"__init__", "__del__", "__repr__", "__str__"}
+
+    def check_module(self, mod: SourceModule) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                info = _ClassInfo(mod, node)
+                if info.guards:
+                    findings.extend(self._check_class(mod, info))
+        return [f for f in findings if f is not None]
+
+    def _check_class(self, mod: SourceModule,
+                     info: _ClassInfo) -> List[Finding]:
+        findings: List[Finding] = []
+        for item in info.node.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name in self._EXEMPT:
+                continue
+            held0 = set(mod.holds.get(item.lineno, ()))
+            self._walk(mod, info, item.body, held0, item, findings)
+        return findings
+
+    def _walk(self, mod: SourceModule, info: _ClassInfo,
+              stmts: Sequence[ast.stmt], held: Set[str],
+              func: ast.AST, findings: List[Finding]) -> None:
+        for stmt in stmts:
+            for expr_field in self._accesses_in(stmt):
+                self._report(mod, info, expr_field, held, findings)
+            if isinstance(stmt, ast.With):
+                inner = held | set(_with_lock_names(stmt))
+                self._walk(mod, info, stmt.body, inner, func, findings)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested def: a closure runs later, possibly unlocked —
+                # unless its def line carries its own holds annotation
+                inner_held = set(mod.holds.get(stmt.lineno, ()))
+                self._walk(mod, info, stmt.body, inner_held, stmt, findings)
+            elif isinstance(stmt, (ast.If, ast.While, ast.For,
+                                   ast.AsyncFor)):
+                self._walk(mod, info, stmt.body, held, func, findings)
+                self._walk(mod, info, stmt.orelse, held, func, findings)
+            elif isinstance(stmt, ast.Try):
+                self._walk(mod, info, stmt.body, held, func, findings)
+                for h in stmt.handlers:
+                    self._walk(mod, info, h.body, held, func, findings)
+                self._walk(mod, info, stmt.orelse, held, func, findings)
+                self._walk(mod, info, stmt.finalbody, held, func, findings)
+
+    def _accesses_in(self, stmt: ast.stmt) -> List[Tuple[ast.AST, str]]:
+        """(node, field) for every self.<field> touch in expression
+        position of the statement HEADER only — nested bodies are walked
+        separately with their own held sets."""
+        out: List[Tuple[ast.AST, str]] = []
+        for n in self._shallow(stmt):
+            for sub in ast.walk(n):
+                if isinstance(sub, ast.Attribute) and \
+                        isinstance(sub.value, ast.Name) and \
+                        sub.value.id == "self":
+                    out.append((sub, sub.attr))
+        return out
+
+    @staticmethod
+    def _shallow(stmt: ast.stmt) -> List[ast.AST]:
+        """Header expressions of a statement (bodies excluded)."""
+        if isinstance(stmt, (ast.If, ast.While)):
+            return [stmt.test]
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return [stmt.target, stmt.iter]
+        if isinstance(stmt, ast.With):
+            return [i.context_expr for i in stmt.items]
+        if isinstance(stmt, (ast.Try, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            return []
+        return [stmt]
+
+    def _report(self, mod: SourceModule, info: _ClassInfo,
+                expr_field: Tuple[ast.AST, str], held: Set[str],
+                findings: List[Finding]) -> None:
+        node, field = expr_field
+        spec = info.guards.get(field)
+        if spec is None:
+            return
+        lock, _ = spec
+        lock_head = lock.split(".")[0]
+        if lock_head in held:
+            return
+        f = mod.finding(node, "lock/guarded-by",
+                        f"{info.name}.{field} is guarded by "
+                        f"self.{lock} but accessed without it")
+        if f is not None:
+            findings.append(f)
+
+
+# ------------------------------------------------------------- lock order
+
+class _FuncFacts:
+    """Per-function lock facts for the order graph."""
+
+    def __init__(self, qual: str):
+        self.qual = qual                       # Module.Class.method
+        self.acquires: Set[str] = set()        # lock nodes taken anywhere
+        # (held_lock, callee_qual) — call made while holding held_lock
+        self.calls_under: List[Tuple[str, str, str, int]] = []
+        # (outer, inner, rel, line) direct lexical nesting edges
+        self.nest_edges: List[Tuple[str, str, str, int]] = []
+
+
+class LockOrderChecker(Checker):
+    """``lock/order-cycle`` — the project-wide lock acquisition graph
+    contains a cycle (two threads can deadlock by taking the locks in
+    opposite orders)."""
+
+    name = "lock-order"
+    ids = ("lock/order-cycle",)
+
+    def __init__(self):
+        self._funcs: Dict[str, _FuncFacts] = {}
+        self._suppress_lines: Dict[str, SourceModule] = {}
+
+    # ---- per-module collection
+
+    def check_module(self, mod: SourceModule) -> List[Finding]:
+        module_locks = self._module_level_locks(mod)
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                info = _ClassInfo(mod, node)
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self._collect(mod, item,
+                                      cls=info, module_locks=module_locks)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._collect(mod, node, cls=None,
+                              module_locks=module_locks)
+        return []
+
+    @staticmethod
+    def _module_level_locks(mod: SourceModule) -> Set[str]:
+        out: Set[str] = set()
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        return out
+
+    def _lock_node(self, mod: SourceModule, cls: Optional[_ClassInfo],
+                   module_locks: Set[str], name: str) -> Optional[str]:
+        """Map a with-acquired attribute/name to a graph node, only for
+        objects we KNOW are locks (declared in this class/module)."""
+        if cls is not None and name in cls.lock_attrs:
+            return f"{cls.name}.{name}"
+        if name in module_locks:
+            return f"{mod.dotted}.{name}"
+        # the namesystem RW lock: self.lock = NamesystemLock(...)
+        return None
+
+    def _collect(self, mod: SourceModule, func: ast.AST,
+                 cls: Optional[_ClassInfo],
+                 module_locks: Set[str]) -> None:
+        qual = f"{mod.dotted}.{cls.name}.{func.name}" if cls else \
+            f"{mod.dotted}.{func.name}"
+        facts = _FuncFacts(qual)
+        self._funcs[qual] = facts
+        self._suppress_lines[qual] = mod
+
+        def walk(stmts, held: List[Tuple[str, int]]):
+            for stmt in stmts:
+                if isinstance(stmt, ast.With):
+                    taken = []
+                    for name in _with_lock_names(stmt):
+                        ln = self._lock_node(mod, cls, module_locks, name)
+                        if ln is not None:
+                            facts.acquires.add(ln)
+                            if held:
+                                outer = held[-1][0]
+                                facts.nest_edges.append(
+                                    (outer, ln, mod.rel, stmt.lineno))
+                            taken.append((ln, stmt.lineno))
+                    walk(stmt.body, held + taken)
+                elif isinstance(stmt, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    walk(stmt.body, [])   # closure: lock state unknown
+                else:
+                    if held:
+                        for call in ast.walk(stmt):
+                            if isinstance(call, ast.Call):
+                                callee = self._resolve(mod, cls, call)
+                                if callee:
+                                    facts.calls_under.append(
+                                        (held[-1][0], callee, mod.rel,
+                                         call.lineno))
+                    if isinstance(stmt, (ast.If, ast.While, ast.For,
+                                         ast.AsyncFor)):
+                        walk(stmt.body, held)
+                        walk(stmt.orelse, held)
+                    elif isinstance(stmt, ast.Try):
+                        walk(stmt.body, held)
+                        for h in stmt.handlers:
+                            walk(h.body, held)
+                        walk(stmt.orelse, held)
+                        walk(stmt.finalbody, held)
+
+        walk(func.body, [])
+
+    def _resolve(self, mod: SourceModule, cls: Optional[_ClassInfo],
+                 call: ast.Call) -> Optional[str]:
+        chain = attr_chain(call.func)
+        if not chain:
+            return None
+        if chain[0] == "self" and len(chain) == 2 and cls is not None:
+            return f"{mod.dotted}.{cls.name}.{chain[1]}"
+        if len(chain) == 1:
+            return f"{mod.dotted}.{chain[0]}"
+        return None
+
+    # ---- whole-project graph
+
+    def finalize(self, project: Project) -> List[Finding]:
+        # transitive acquires through resolvable calls (fixpoint)
+        acquires: Dict[str, Set[str]] = {
+            q: set(f.acquires) for q, f in self._funcs.items()}
+        callees: Dict[str, Set[str]] = {}
+        for q, f in self._funcs.items():
+            callees[q] = {c for _, c, _, _ in f.calls_under}
+        changed = True
+        rounds = 0
+        while changed and rounds < 20:
+            changed = False
+            rounds += 1
+            for q, f in self._funcs.items():
+                for c in callees[q]:
+                    extra = acquires.get(c)
+                    if extra and not extra <= acquires[q]:
+                        acquires[q] |= extra
+                        changed = True
+        # edges: lexical nesting + "call under lock reaches an acquire"
+        edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for q, f in self._funcs.items():
+            for outer, inner, rel, line in f.nest_edges:
+                if outer != inner:
+                    edges.setdefault((outer, inner), (rel, line))
+            for held, callee, rel, line in f.calls_under:
+                for inner in acquires.get(callee, ()):
+                    if inner != held:
+                        edges.setdefault((held, inner), (rel, line))
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        findings: List[Finding] = []
+        for cycle in self._cycles(graph):
+            # anchor the finding at some edge inside the cycle
+            members = set(cycle)
+            rel, line = next((loc for (a, b), loc in sorted(edges.items())
+                              if a in members and b in members),
+                             ("<unknown>", 1))
+            path = " -> ".join(cycle + [cycle[0]])
+            mod = next((m for m in project.modules if m.rel == rel), None)
+            if mod is not None and mod.is_suppressed(line,
+                                                     "lock/order-cycle"):
+                continue
+            findings.append(Finding(
+                rel, line, "lock/order-cycle",
+                f"lock acquisition order cycle: {path} — two threads "
+                f"taking these locks in opposite orders deadlock"))
+        return findings
+
+    @staticmethod
+    def _cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+        """Strongly connected components of size > 1 (or a self-loop),
+        via iterative Tarjan; each SCC is reported once, deterministically
+        ordered."""
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        sccs: List[List[str]] = []
+        counter = [0]
+
+        for root in sorted(graph):
+            if root in index:
+                continue
+            work = [(root, iter(sorted(graph.get(root, ()))))]
+            index[root] = low[root] = counter[0]
+            counter[0] += 1
+            stack.append(root)
+            on_stack.add(root)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for nxt in it:
+                    if nxt not in index:
+                        index[nxt] = low[nxt] = counter[0]
+                        counter[0] += 1
+                        stack.append(nxt)
+                        on_stack.add(nxt)
+                        work.append((nxt, iter(sorted(graph.get(nxt, ())))))
+                        advanced = True
+                        break
+                    elif nxt in on_stack:
+                        low[node] = min(low[node], index[nxt])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    if len(comp) > 1 or node in graph.get(node, ()):
+                        sccs.append(sorted(comp))
+        return sccs

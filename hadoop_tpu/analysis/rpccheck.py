@@ -1,0 +1,243 @@
+"""RPC / retry hygiene checkers.
+
+Every network call in a fleet must be bounded by a timeout (a hung peer
+otherwise blocks the caller forever), retries must back off with jitter
+(constant-sleep retry loops synchronise a fleet into retry storms —
+ref: io/retry/RetryPolicies exponential policies), and failures must
+leave a breadcrumb (silent broad ``except: pass`` swallows the evidence).
+
+``rpc/no-timeout``        ``socket.create_connection``/``urlopen``/
+                          ``HTTPConnection`` without a timeout, or a
+                          ``socket.socket()`` connected without a prior
+                          ``settimeout`` in the same function.
+``rpc/timeout-cleared``   ``x.settimeout(None)`` — unbounds every later
+                          recv/send on a live connection.
+``rpc/retry-no-backoff``  ``time.sleep(<constant>)`` inside a loop that
+                          catches exceptions: the retry cadence neither
+                          grows nor jitters.
+``rpc/silent-swallow``    ``except:`` / ``except Exception:`` with a
+                          body of ``pass``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from hadoop_tpu.analysis.core import (Checker, Finding, SourceModule,
+                                      attr_chain, call_name)
+
+_CONNECT_CALLS = {"socket.create_connection", "create_connection"}
+_HTTP_CTORS = {"HTTPConnection", "HTTPSConnection",
+               "http.client.HTTPConnection", "http.client.HTTPSConnection",
+               "httplib.HTTPConnection"}
+_URLOPEN = {"urlopen", "urllib.request.urlopen", "request.urlopen"}
+
+
+def _has_kw(call: ast.Call, name: str) -> bool:
+    return any(k.arg == name for k in call.keywords)
+
+
+class TimeoutChecker(Checker):
+    name = "rpc-timeout"
+    ids = ("rpc/no-timeout", "rpc/timeout-cleared")
+
+    def check_module(self, mod: SourceModule) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_function(mod, node, findings)
+        # module-level code too (scripts)
+        self._check_calls(mod, mod.tree.body, set(), set(), findings,
+                          toplevel=True)
+        return findings
+
+    def _check_function(self, mod: SourceModule, func, findings) -> None:
+        raw_socks: Set[str] = set()
+        timed: Set[str] = set()
+        # pass 1: names bound to socket.socket() and names .settimeout()ed
+        for node in ast.walk(func):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                name = call_name(node.value)
+                if name in ("socket.socket", "socket"):
+                    for t in node.targets:
+                        chain = attr_chain(t)
+                        if chain:
+                            raw_socks.add(".".join(chain))
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("settimeout", "setblocking"):
+                chain = attr_chain(node.func.value)
+                if chain:
+                    timed.add(".".join(chain))
+        self._check_calls(mod, [func], raw_socks, timed, findings)
+
+    def _check_calls(self, mod: SourceModule, roots, raw_socks: Set[str],
+                     timed: Set[str], findings: List[Finding],
+                     toplevel: bool = False) -> None:
+        for root in roots:
+            if toplevel and isinstance(root, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef,
+                                              ast.ClassDef)):
+                continue  # functions/methods get their own pass
+            for node in ast.walk(root):
+                if isinstance(node, ast.Call):
+                    self._check_one(mod, node, raw_socks, timed, findings)
+
+    def _check_one(self, mod: SourceModule, node: ast.Call,
+                   raw_socks: Set[str], timed: Set[str],
+                   findings: List[Finding]) -> None:
+        name = call_name(node)
+        f: Optional[Finding] = None
+        if name in _CONNECT_CALLS:
+            if len(node.args) < 2 and not _has_kw(node, "timeout"):
+                f = mod.finding(node, "rpc/no-timeout",
+                                "create_connection without a timeout — a "
+                                "black-holed peer blocks the caller "
+                                "forever")
+        elif name and name.split(".")[-1] in ("HTTPConnection",
+                                              "HTTPSConnection") and \
+                (name in _HTTP_CTORS or name.split(".")[-1] == name):
+            if not _has_kw(node, "timeout"):
+                f = mod.finding(node, "rpc/no-timeout",
+                                f"{name.split('.')[-1]} without a timeout")
+        elif name in _URLOPEN:
+            if len(node.args) < 3 and not _has_kw(node, "timeout"):
+                f = mod.finding(node, "rpc/no-timeout",
+                                "urlopen without a timeout")
+        elif isinstance(node.func, ast.Attribute):
+            if node.func.attr == "settimeout" and len(node.args) == 1 \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and node.args[0].value is None:
+                f = mod.finding(node, "rpc/timeout-cleared",
+                                "settimeout(None) unbounds every later "
+                                "recv/send on this connection — use a "
+                                "configurable read timeout")
+            elif node.func.attr == "connect":
+                chain = attr_chain(node.func.value)
+                dotted = ".".join(chain) if chain else None
+                if dotted and dotted in raw_socks and dotted not in timed:
+                    f = mod.finding(node, "rpc/no-timeout",
+                                    f"{dotted}.connect() on a socket with "
+                                    f"no settimeout in this function")
+        if f is not None:
+            findings.append(f)
+
+
+class RetryHygieneChecker(Checker):
+    name = "retry-hygiene"
+    ids = ("rpc/retry-no-backoff",)
+
+    def check_module(self, mod: SourceModule) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.For, ast.While)):
+                self._check_loop(mod, node, findings)
+        return findings
+
+    def _check_loop(self, mod: SourceModule, loop, findings) -> None:
+        # retry shape: the loop body catches exceptions somewhere
+        has_try = any(isinstance(n, ast.Try) for n in ast.walk(loop))
+        if not has_try:
+            return
+        # names whose value varies per iteration: loop targets + anything
+        # (re)assigned inside the loop body
+        varying: Set[str] = set()
+        if isinstance(loop, ast.For):
+            varying.update(self._names(loop.target))
+        for n in ast.walk(loop):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    varying.update(self._names(t))
+            elif isinstance(n, ast.AugAssign):
+                varying.update(self._names(n.target))
+        for n in ast.walk(loop):
+            if not (isinstance(n, ast.Call) and
+                    call_name(n) in ("time.sleep", "sleep", "_time.sleep",
+                                     "_t.sleep")):
+                continue
+            if not n.args:
+                continue
+            arg = n.args[0]
+            if self._is_constant_delay(arg, varying):
+                f = mod.finding(
+                    n, "rpc/retry-no-backoff",
+                    "retry loop sleeps a constant delay — add "
+                    "exponential backoff + jitter (util.misc."
+                    "backoff_delay) or the fleet retries in lockstep")
+                if f:
+                    findings.append(f)
+
+    @staticmethod
+    def _names(t: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for sub in ast.walk(t):
+            if isinstance(sub, ast.Name):
+                out.add(sub.id)
+        return out
+
+    @staticmethod
+    def _is_constant_delay(arg: ast.AST, varying: Set[str]) -> bool:
+        randomish = False
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Name) and sub.id in varying:
+                return False
+            if isinstance(sub, ast.Call):
+                name = call_name(sub) or ""
+                head = name.split(".")[0]
+                leaf = name.split(".")[-1]
+                if head in ("random", "secrets") or \
+                        leaf in ("random", "uniform", "backoff_delay",
+                                 "jitter", "expovariate"):
+                    randomish = True
+            if isinstance(sub, ast.Attribute):
+                chain = attr_chain(sub)
+                if chain and chain[0] in varying:
+                    return False
+        return not randomish
+
+
+class SilentSwallowChecker(Checker):
+    name = "silent-swallow"
+    ids = ("rpc/silent-swallow",)
+
+    _BROAD = {"Exception", "BaseException"}
+
+    def check_module(self, mod: SourceModule) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(node.type):
+                continue
+            if not self._is_silent(node.body):
+                continue
+            f = mod.finding(node, "rpc/silent-swallow",
+                            "broad except swallows every error silently — "
+                            "narrow the exception type and leave a "
+                            "log.debug breadcrumb")
+            if f:
+                findings.append(f)
+        return findings
+
+    def _is_broad(self, t: Optional[ast.AST]) -> bool:
+        if t is None:
+            return True                      # bare except:
+        chain = attr_chain(t)
+        if chain and chain[-1] in self._BROAD:
+            return True
+        if isinstance(t, ast.Tuple):
+            return any(self._is_broad(el) for el in t.elts)
+        return False
+
+    @staticmethod
+    def _is_silent(body) -> bool:
+        if len(body) != 1:
+            return False
+        stmt = body[0]
+        if isinstance(stmt, ast.Pass):
+            return True
+        return isinstance(stmt, ast.Expr) and \
+            isinstance(stmt.value, ast.Constant) and \
+            stmt.value.value is Ellipsis

@@ -18,6 +18,7 @@ src/main/bin/hadoop + hadoop-functions.sh, hdfs/yarn/mapred CLIs):
   hadoop-tpu archive SRC DST.har           create a har archive
   hadoop-tpu sls|gridmix|rumen|dynamometer simulators / replay tools\n  hadoop-tpu fs2img EXTERNAL DFS_ROOT --fs URI   mount external data as PROVIDED storage\n  hadoop-tpu resourceestimator TRACE       size a recurring job's reservation
   hadoop-tpu oiv|oev --name-dir DIR        offline image/edits viewers
+  hadoop-tpu lint [PATHS] [--baseline F]   tpulint static analysis
   hadoop-tpu version
 
 Generic options (before the subcommand args, ref:
@@ -186,6 +187,12 @@ def _main(argv=None) -> int:
     if cmd == "registry":
         from hadoop_tpu.registry import RegistryServer
         return _run_daemon(RegistryServer(conf), conf)
+    if cmd == "lint":
+        # tpulint: AST static analysis for lock discipline, jit
+        # retracing hazards, and RPC timeout hygiene (hadoop_tpu
+        # .analysis) — the findbugs-in-CI lane of the reference
+        from hadoop_tpu.analysis.__main__ import main as lint_main
+        return lint_main(rest)
     if cmd == "serve":
         # one serving replica: continuous-batching decode fed from a DFS
         # checkpoint (hadoop_tpu.serving) — the YARN service packaging

@@ -9,6 +9,7 @@ SetReplication, XAttrCommands, AclCommands, SnapshotCommands): each
 
 from __future__ import annotations
 
+import logging
 import sys
 import time
 from typing import List, Optional
@@ -16,6 +17,8 @@ from typing import List, Optional
 from hadoop_tpu.conf import Configuration
 from hadoop_tpu.fs.filesystem import FileSystem, Path
 from hadoop_tpu.fs.trash import Trash
+
+log = logging.getLogger(__name__)
 
 
 def _fmt_size(n: int) -> str:
@@ -65,8 +68,8 @@ class FsShell:
         for fs in self._fs_cache.values():
             try:
                 fs.close()
-            except Exception:
-                pass
+            except (OSError, ValueError) as e:
+                log.debug("fs close failed: %s", e)
 
     # ----------------------------------------------------------------- run
 

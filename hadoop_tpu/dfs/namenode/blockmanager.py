@@ -136,7 +136,7 @@ class DatanodeManager:
         self.dead_interval_s = conf.get_time_seconds(
             "dfs.namenode.heartbeat.recheck-interval", 10.0) * 2 \
             + 10 * self.heartbeat_interval_s
-        self._nodes: Dict[str, DatanodeDescriptor] = {}
+        self._nodes: Dict[str, DatanodeDescriptor] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         # Locality tree (ref: DatanodeManager's NetworkTopology + the
         # dnsToSwitchMapping resolver chain)
@@ -390,7 +390,7 @@ class BlockManager:
         self.min_replication = conf.get_int("dfs.namenode.replication.min", 1)
         self.max_replication = conf.get_int("dfs.replication.max", 512)
         self.dn_manager = DatanodeManager(conf, self)
-        self._blocks: Dict[int, BlockInfo] = {}
+        self._blocks: Dict[int, BlockInfo] = {}  # guarded-by: _lock
         self._lock = threading.RLock()
         # Under-replication priority queues (ref: LowRedundancyBlocks.java):
         # 0 = highest risk (1 replica), 1 = under-replicated, 2 = queued drains.
@@ -481,7 +481,7 @@ class BlockManager:
         if pending:
             self.safemode.report_blocks()
 
-    def _resolve_locked(self, block_id: int) -> Optional[BlockInfo]:
+    def _resolve_locked(self, block_id: int) -> Optional[BlockInfo]:  # lint: holds=_lock
         """Map a reported block id to its BlockInfo; a striped unit id
         resolves to its group (ref: BlockManager.getStoredBlock's
         BlockIdManager.convertToStripedID)."""

@@ -148,14 +148,14 @@ class FSNamesystem:
         # location in an external store; DNs resolve provided reads
         # through it). Persisted with the image; populated by fs2img.
         self.alias_map: Dict[int, Dict] = {}
-        self._next_block_id = 1 << 30   # ref: SequentialBlockIdGenerator
+        self._next_block_id = 1 << 30   # ref: SequentialBlockIdGenerator  # guarded-by: _id_lock
         self._next_group_id = ec.STRIPED_ID_BASE  # striped block groups
-        self._gen_stamp = 1000          # ref: GenerationStamp
+        self._gen_stamp = 1000          # ref: GenerationStamp  # guarded-by: _id_lock
         self._id_lock = threading.Lock()
         # paths mid block-recovery, pinned to their INode identity: the
         # sweep must never act on a path that now names a DIFFERENT file
         # (delete + recreate while recovery was in flight)
-        self._pending_recovery: Dict[str, INodeFile] = {}
+        self._pending_recovery: Dict[str, INodeFile] = {}  # guarded-by: lock
         # Centralized cache directives (ref: namenode/CacheManager.java):
         # id → path; the cache monitor reconciles DN state against them.
         self.cache_directives: Dict[int, str] = {}
@@ -273,9 +273,12 @@ class FSNamesystem:
         loaded = self.image.load()
         if loaded is not None:
             last_txid, self.fsdir, extra = loaded
-            self._next_block_id = extra.get("next_block_id", self._next_block_id)
-            self._next_group_id = extra.get("next_group_id", self._next_group_id)
-            self._gen_stamp = extra.get("gen_stamp", self._gen_stamp)
+            with self._id_lock:
+                self._next_block_id = extra.get(
+                    "next_block_id", self._next_block_id)
+                self._next_group_id = extra.get(
+                    "next_group_id", self._next_group_id)
+                self._gen_stamp = extra.get("gen_stamp", self._gen_stamp)
             self.leases.restore_from_image(extra.get("leases", {}))
             self.alias_map = {int(k): v for k, v in
                               extra.get("alias_map", {}).items()}
@@ -361,10 +364,12 @@ class FSNamesystem:
         """Counters that must survive restart alongside the image — the
         single source for both the local checkpointer and the standby's
         (drift here would lose id/stamp state across failover)."""
+        with self._id_lock:
+            ids = {"next_block_id": self._next_block_id,
+                   "next_group_id": self._next_group_id,
+                   "gen_stamp": self._gen_stamp}
         return {
-            "next_block_id": self._next_block_id,
-            "next_group_id": self._next_group_id,
-            "gen_stamp": self._gen_stamp,
+            **ids,
             "leases": self.leases.snapshot_for_image(),
             "cache_directives": dict(self.cache_directives),
             "next_cache_id": self._next_cache_id,
@@ -400,6 +405,10 @@ class FSNamesystem:
                 self._next_block_id = bid
             if gs > self._gen_stamp:
                 self._gen_stamp = gs
+
+    def current_gen_stamp(self) -> int:
+        with self._id_lock:
+            return self._gen_stamp
 
     def next_gen_stamp(self) -> int:
         with self._id_lock:
@@ -533,7 +542,8 @@ class FSNamesystem:
                 offset = sum(b.num_bytes for b in inode.blocks)
                 if inode.ec_policy:
                     policy = ec.get_policy(inode.ec_policy)
-                    block = Block(self._new_group_id(), self._gen_stamp, 0)
+                    block = Block(self._new_group_id(),
+                                  self.current_gen_stamp(), 0)
                     targets = self.bm.dn_manager.choose_targets(
                         policy.num_units, set(exclude), None)
                     if len(targets) < policy.k:
@@ -552,7 +562,8 @@ class FSNamesystem:
                 else:
                     from hadoop_tpu.dfs.protocol.records import (
                         POLICY_TYPES, effective_storage_policy)
-                    block = Block(self._new_block_id(), self._gen_stamp, 0)
+                    block = Block(self._new_block_id(),
+                                  self.current_gen_stamp(), 0)
                     targets = self.bm.dn_manager.choose_targets(
                         inode.replication, set(exclude), writer_host,
                         preferred_types=POLICY_TYPES.get(
@@ -675,7 +686,7 @@ class FSNamesystem:
             self._recover_lease_locked(path, inode)
             return not inode.under_construction
 
-    def _recover_lease_locked(self, path: str, inode: INodeFile) -> bool:
+    def _recover_lease_locked(self, path: str, inode: INodeFile) -> bool:  # lint: holds=lock
         """Release an abandoned under-construction file. Two phases, like the
         reference (ref: FSNamesystem.internalReleaseLease →
         BlockUnderConstructionFeature.initializeBlockRecovery):
@@ -724,7 +735,7 @@ class FSNamesystem:
         log.info("Recovered lease on %s (was held by %s)", path, holder)
         return True
 
-    def _start_block_recovery_locked(self, path: str,
+    def _start_block_recovery_locked(self, path: str,  # lint: holds=lock
                                      info) -> bool:
         """Queue RECOVER commands to the expected pipeline members.
         Returns False when no member is live (recovery impossible)."""
@@ -763,7 +774,9 @@ class FSNamesystem:
     def check_pending_recoveries(self) -> None:
         """Second phase of lease recovery: close files whose block recovery
         reported back. Ref: commitBlockSynchronization's role."""
-        for path, expected in list(self._pending_recovery.items()):
+        with self.lock.read():
+            pending = list(self._pending_recovery.items())
+        for path, expected in pending:
             with self.lock.write():
                 inode = self.fsdir.get_inode(path)
                 if inode is not expected:
@@ -824,7 +837,7 @@ class FSNamesystem:
             while off < length or not blocks:
                 n = min(block_size, length - off)
                 blk = Block(self._new_block_id(),
-                            self._gen_stamp, n)
+                            self.current_gen_stamp(), n)
                 self.alias_map[blk.block_id] = {
                     "uri": external_uri, "offset": off, "length": n}
                 inode.blocks.append(blk)
@@ -1881,6 +1894,7 @@ class FSNamesystem:
                     if info is not None:
                         info.block.num_bytes = b.num_bytes
         elif op == el.OP_SET_GENSTAMP:
-            self._gen_stamp = max(self._gen_stamp, rec["gs"])
+            with self._id_lock:
+                self._gen_stamp = max(self._gen_stamp, rec["gs"])
         else:
             log.warning("Unknown edit op %r (txid %d) — skipped", op, rec["t"])

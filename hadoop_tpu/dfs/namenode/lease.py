@@ -34,8 +34,8 @@ class LeaseManager:
                  hard_limit_s: float = 20 * 60.0):
         self.soft_limit_s = soft_limit_s
         self.hard_limit_s = hard_limit_s
-        self._leases: Dict[str, Lease] = {}
-        self._path_to_holder: Dict[str, str] = {}
+        self._leases: Dict[str, Lease] = {}            # guarded-by: _lock
+        self._path_to_holder: Dict[str, str] = {}      # guarded-by: _lock
         self._lock = threading.Lock()
 
     def add_lease(self, holder: str, path: str) -> None:

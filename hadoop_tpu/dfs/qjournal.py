@@ -41,7 +41,7 @@ from hadoop_tpu.conf import Configuration
 from hadoop_tpu.dfs.namenode.editlog import (FileJournalManager,
                                              JournalManager)
 from hadoop_tpu.ipc import Client, Server, get_proxy, idempotent
-from hadoop_tpu.ipc.errors import register_exception
+from hadoop_tpu.ipc.errors import RpcError, register_exception
 from hadoop_tpu.service import AbstractService
 from hadoop_tpu.util.misc import Daemon
 
@@ -829,8 +829,8 @@ class QuorumLease:
             try:
                 if f.result(timeout=5.0).get("granted"):
                     granted += 1
-            except Exception:  # noqa: BLE001 — unreachable JN = no grant
-                pass
+            except (RpcError, OSError, TimeoutError) as e:
+                log.debug("lease grant unavailable: %s", e)
         return granted >= self.majority
 
     def release(self) -> None:
@@ -839,8 +839,8 @@ class QuorumLease:
         for f in futs:
             try:
                 f.result(timeout=5.0)
-            except Exception:  # noqa: BLE001
-                pass
+            except (RpcError, OSError, TimeoutError) as e:
+                log.debug("lease release failed: %s", e)
 
     def close(self) -> None:
         self._pool.shutdown(wait=False)

@@ -28,7 +28,7 @@ from hadoop_tpu.conf import Configuration
 from hadoop_tpu.dfs.client.dfsclient import DFSClient
 from hadoop_tpu.ipc import Server, idempotent
 from hadoop_tpu.service import AbstractService
-from hadoop_tpu.util.misc import parse_addr_list
+from hadoop_tpu.util.misc import RetryOnException, parse_addr_list
 
 log = logging.getLogger(__name__)
 
@@ -330,9 +330,15 @@ class Router(AbstractService):
                                       "error": str(e)[:200],
                                       "last_seen": _time.time()}
             try:
-                self.store.save("membership", membership)
-            except OSError:
-                pass
+                # jittered bounded retry: the State Store may sit on
+                # shared/remote storage that blips — and routers must
+                # not re-poll it in lockstep (ref: StateStoreService's
+                # retried writes)
+                RetryOnException(attempts=3, delay_s=0.05,
+                                 max_delay_s=1.0).call(
+                    self.store.save, "membership", membership)
+            except OSError as e:
+                log.debug("membership save failed after retries: %s", e)
             import time as _t
             if self.quotas and _t.monotonic() >= next_quota:
                 self.refresh_quota_usage()

@@ -244,7 +244,9 @@ class CallQueueManager:
                     self.queue.put_nowait(call, priority)
                     return
                 except queue.Full:
-                    time.sleep(0.005)
+                    # deliberate constant spin: bounded at 60s, and
+                    # jitter here would only delay queue admission
+                    time.sleep(0.005)  # lint: disable=rpc/retry-no-backoff
             raise ServerTooBusyError("call queue full for 60s") from None
 
     def take(self, timeout: Optional[float] = None):

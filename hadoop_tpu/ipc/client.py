@@ -60,7 +60,7 @@ class _Connection:
         self.user = user
         self.sock: Optional[socket.socket] = None
         self.send_lock = threading.Lock()
-        self.calls: Dict[int, _PendingCall] = {}
+        self.calls: Dict[int, _PendingCall] = {}  # guarded-by: calls_lock
         self.calls_lock = threading.Lock()
         self.dead = False
         self.last_state_id = -1
@@ -83,14 +83,25 @@ class _Connection:
         # itself rather than pinging the server's idle reaper awake forever.
         self.max_idle_s = conf.get_time_seconds(
             "ipc.client.connection.maxidletime", 10.0)
+        # Read timeout (ref: ipc.client.rpc-timeout + Client.java's
+        # pingInterval-bounded reads): with calls outstanding, a server
+        # that sends NOTHING for this long is declared hung and every
+        # in-flight call fails with RpcTimeoutError — a stalled peer can
+        # no longer block a caller whose per-call timeout is large (or
+        # None). Also caps individual socket sends. 0 disables.
+        self.read_timeout = conf.get_time_seconds(
+            "ipc.client.read.timeout", 120.0)
         try:
             self.sock = socket.create_connection(self.addr, timeout=timeout)
         except OSError as e:
             raise ConnectFailedError(
                 f"failed to connect to {self.addr}: {e}") from e
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self.sock.settimeout(None)
+        # bounded, not cleared: recv is select()-gated so this mostly
+        # caps sends; the receive loop enforces read_timeout itself
+        self.sock.settimeout(self.read_timeout or None)
         self.last_activity = time.monotonic()
+        self.last_inbound = time.monotonic()
         self.cipher = None
         hdr: Dict[str, Any] = {
             "magic": MAGIC,
@@ -152,7 +163,7 @@ class _Connection:
             self.sock.sendall(struct.pack(">I", len(payload)) + payload)
             sess.step(self._handshake_reply(read_frame))
         finally:
-            self.sock.settimeout(None)
+            self.sock.settimeout(self.read_timeout or None)
         self.cipher = sess.cipher
 
     def _handshake_reply(self, read_frame) -> Dict:
@@ -170,10 +181,12 @@ class _Connection:
         import select
 
         buf = bytearray()
+        # tick fast enough that a small read timeout is honored promptly
+        tick = self.ping_interval if not self.read_timeout else \
+            min(self.ping_interval, max(0.05, self.read_timeout / 4.0))
         while not self.dead:
             try:
-                ready, _, _ = select.select([self.sock], [], [],
-                                            self.ping_interval)
+                ready, _, _ = select.select([self.sock], [], [], tick)
             except (OSError, ValueError):
                 self._fail_all(RpcError(f"connection to {self.addr} closed"))
                 return
@@ -197,6 +210,19 @@ class _Connection:
                         f"connection to {self.addr} idle-closed"))
                     return
                 if outstanding:
+                    # Read-timeout enforcement: calls are in flight and
+                    # the server has sent NOTHING for read_timeout — a
+                    # ping only proves OUR writes land (its send buffer
+                    # may still drain); silence this long means hung.
+                    if self.read_timeout and \
+                            time.monotonic() - self.last_inbound > \
+                            self.read_timeout:
+                        self._fail_all(RpcTimeoutError(
+                            f"no response bytes from {self.addr} in "
+                            f"{self.read_timeout:.1f}s with "
+                            f"{outstanding} call(s) outstanding "
+                            f"(ipc.client.read.timeout)"))
+                        return
                     try:
                         self.ping()
                     except OSError:
@@ -212,6 +238,7 @@ class _Connection:
                 self._fail_all(RpcError(f"connection to {self.addr} closed"))
                 return
             self.last_activity = time.monotonic()
+            self.last_inbound = self.last_activity
             buf += chunk
             while len(buf) >= 4:
                 (flen,) = struct.unpack_from(">I", buf, 0)
@@ -285,6 +312,7 @@ class _Connection:
                 raise _ConnClosedBeforeSend(
                     f"connection to {self.addr} closed before send")
             self.calls[call_id] = pend
+            first_outstanding = len(self.calls) == 1
         try:
             payload = pack(req)
         except Exception:
@@ -295,6 +323,13 @@ class _Connection:
                 self.calls.pop(call_id, None)
             raise
         self.last_activity = time.monotonic()
+        if first_outstanding:
+            # restart the read-timeout clock: it measures silence AFTER
+            # the first in-flight request, not the idle gap before it.
+            # ONLY the 0→1 transition resets — a steady stream of new
+            # sends against a wedged server must not keep deferring the
+            # verdict while older calls starve.
+            self.last_inbound = self.last_activity
         try:
             # wrap() under send_lock: the cipher counters are sequential
             # and the peer enforces transmit order, so wrap and send must
@@ -331,9 +366,9 @@ class Client:
         self.token_kind = token_kind
         self.client_id = os.urandom(16)  # ref: ipc/ClientId.java
         self.last_state_id = -1          # ref: ClientGSIContext (msync)
-        self._call_id = 0
+        self._call_id = 0  # guarded-by: _id_lock
         self._id_lock = threading.Lock()
-        self._conns: Dict[Tuple[Address, str, str], _Connection] = {}
+        self._conns: Dict[Tuple[Address, str, str], _Connection] = {}  # guarded-by: _conns_lock
         self._conns_lock = threading.Lock()
         self.default_timeout = self.conf.get_time_seconds("ipc.client.rpc-timeout", 60.0)
 

@@ -17,6 +17,7 @@ from hadoop_tpu.conf import Configuration
 from hadoop_tpu.ipc.client import Client, default_client
 from hadoop_tpu.ipc.errors import RpcError
 from hadoop_tpu.security.ugi import UserGroupInformation
+from hadoop_tpu.util.misc import backoff_delay
 
 
 def idempotent(fn):
@@ -96,6 +97,7 @@ def wait_for_proxy(protocol, address, conf=None, timeout_s: float = 30.0,
     """Ref: RPC.waitForProxy:293 — keep connecting until the server is up."""
     deadline = time.monotonic() + timeout_s
     last: Optional[BaseException] = None
+    attempt = 0
     while time.monotonic() < deadline:
         try:
             proxy = get_proxy(protocol, address, conf)
@@ -103,7 +105,8 @@ def wait_for_proxy(protocol, address, conf=None, timeout_s: float = 30.0,
             return proxy
         except (RpcError, OSError) as e:
             last = e
-            time.sleep(0.2)
+            time.sleep(backoff_delay(0.2, attempt, max_s=2.0))
+            attempt += 1
         except Exception:
             # Server is up but the probe method is unknown — good enough.
             return get_proxy(protocol, address, conf)

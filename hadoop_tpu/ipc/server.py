@@ -44,7 +44,7 @@ from hadoop_tpu.metrics import metrics_system
 from hadoop_tpu.security.ugi import (AccessControlError, SecretManager, Token,
                                      UserGroupInformation)
 from hadoop_tpu.tracing.tracer import SpanContext, global_tracer
-from hadoop_tpu.util.misc import Daemon
+from hadoop_tpu.util.misc import Daemon, backoff_delay
 
 log = logging.getLogger(__name__)
 
@@ -168,7 +168,7 @@ class Server:
         self._threads: List[threading.Thread] = []
         self._readers: List["_Reader"] = []
         self._responder: Optional["_Responder"] = None
-        self._conns: Dict[int, _Connection] = {}
+        self._conns: Dict[int, _Connection] = {}  # guarded-by: _conns_lock
         self._conns_lock = threading.Lock()
         self.max_idle_s = self.conf.get_time_seconds("ipc.client.connection.maxidletime", 120.0)
         self.reuse_port = self.conf.get_bool("ipc.server.reuseport", False)
@@ -212,6 +212,7 @@ class Server:
         # (SO_REUSEADDR only covers TIME_WAIT).
         import errno
         deadline = time.monotonic() + 10.0
+        bind_attempt = 0
         while True:
             try:
                 self._lsock.bind(self._bind_addr)
@@ -220,7 +221,8 @@ class Server:
                 if e.errno != errno.EADDRINUSE or \
                         time.monotonic() > deadline:
                     raise
-                time.sleep(0.1)
+                time.sleep(backoff_delay(0.1, bind_attempt, max_s=1.0))
+                bind_attempt += 1
         self._lsock.listen(256)
         # close() won't wake a blocked accept(2); timeout so the listener
         # polls _running and exits on stop instead of leaking.

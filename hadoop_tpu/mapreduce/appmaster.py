@@ -26,10 +26,12 @@ import time
 from typing import Dict, List, Optional, Set, Tuple
 
 from hadoop_tpu.conf import Configuration
+from hadoop_tpu.ipc.errors import RpcError
 from hadoop_tpu.fs import FileSystem
 from hadoop_tpu.ipc import Server
 from hadoop_tpu.mapreduce import shuffle
 from hadoop_tpu.mapreduce.api import Counters
+from hadoop_tpu.util.misc import backoff_delay
 from hadoop_tpu.yarn.client import AMRMClient, NMClient
 from hadoop_tpu.yarn.records import (Container, ContainerLaunchContext,
                                      Resource)
@@ -336,6 +338,7 @@ class MRAppMaster:
         self._schedule(amrm, maps)
         reduces_scheduled = False
         ok = True
+        alloc_failures = 0
         try:
             while True:
                 with self.lock:
@@ -353,8 +356,11 @@ class MRAppMaster:
                         progress=done / max(total, 1))
                 except Exception as e:  # noqa: BLE001 — RM may be bouncing
                     log.warning("allocate failed (%s); retrying", e)
-                    time.sleep(0.2)
+                    time.sleep(backoff_delay(0.2, alloc_failures,
+                                             max_s=5.0))
+                    alloc_failures += 1
                     continue
+                alloc_failures = 0
                 if amrm.resynced:
                     # RM restarted work-preserving: its ask table is
                     # empty — re-ask for everything still pending
@@ -376,7 +382,8 @@ class MRAppMaster:
                            for t in self.tasks.values()):
                         ok = False
                         break
-                time.sleep(0.05)
+                # fixed scheduler cadence, not a failure retry
+                time.sleep(0.05)  # lint: disable=rpc/retry-no-backoff
         finally:
             status = "SUCCEEDED" if ok else "FAILED"
             try:
@@ -422,8 +429,8 @@ class MRAppMaster:
                     done = sum(1 for t in self.tasks.values()
                                if t.succeeded)
                     amrm.allocate(progress=done / max(len(self.tasks), 1))
-                except Exception:  # noqa: BLE001
-                    pass
+                except (RpcError, OSError) as e:
+                    log.debug("uber heartbeat allocate failed: %s", e)
                 stop_hb.wait(1.0)
 
         hb = threading.Thread(target=heartbeat, daemon=True,
@@ -637,8 +644,8 @@ class MRAppMaster:
             if attempt.container is not None:
                 try:
                     nm.stop_container(attempt.container)
-                except Exception:  # noqa: BLE001
-                    pass
+                except (RpcError, OSError) as e:
+                    log.debug("stop of expired container failed: %s", e)
 
     # ------------------------------------------------------------- history
 
@@ -702,8 +709,8 @@ class MRAppMaster:
             out = self.job["output"]
             try:
                 fs.delete(f"{out}/_temporary", recursive=True)
-            except Exception:  # noqa: BLE001
-                pass
+            except (OSError, IOError) as e:
+                log.debug("_temporary cleanup failed: %s", e)
             fs.write_all(f"{out}/_SUCCESS", b"")
         report = {"state": "SUCCEEDED" if ok else "FAILED",
                   "name": self.job.get("name", ""),

@@ -28,6 +28,7 @@ import traceback
 from typing import Dict, List, Optional, Tuple
 
 from hadoop_tpu.conf import Configuration
+from hadoop_tpu.ipc.errors import RpcError
 from hadoop_tpu.fs import FileSystem
 from hadoop_tpu.ipc import Client, get_proxy
 from hadoop_tpu.mapreduce import ifile, shuffle
@@ -379,8 +380,8 @@ def main() -> int:
         log.error("task %s failed: %s", attempt_id, err)
         try:
             umbilical.fatal_error(attempt_id, err)
-        except Exception:  # noqa: BLE001
-            pass
+        except (RpcError, OSError) as e2:
+            log.debug("fatal_error relay to AM failed: %s", e2)
         return 1
     finally:
         client.stop()

@@ -13,11 +13,14 @@ from __future__ import annotations
 
 import bisect
 import json
+import logging
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
 from hadoop_tpu.util.misc import Daemon
+
+log = logging.getLogger(__name__)
 
 
 class MutableCounter:
@@ -265,8 +268,8 @@ class MetricsSystem:
         for s in sinks:
             try:
                 s(snap)
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — sink is arbitrary code
+                log.debug("metrics sink %r failed: %s", s, e)
 
     def start_periodic_publish(self, period_s: float = 10.0) -> None:
         # idempotent: a second caller (two components wiring the shared

@@ -107,8 +107,8 @@ class SinkPublisher:
         for sink in self._sinks:
             try:
                 sink.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except (OSError, ValueError) as e:
+                log.debug("sink close failed: %s", e)
 
     def publish_once(self) -> None:
         snap = metrics_system().snapshot_all()

@@ -402,8 +402,8 @@ class Nfs3Gateway(RpcProgram):
                 c.u32()                                 # stamp
                 c.string()                              # machine name
                 user = cls._user_for_uid(c.u32())
-            except Exception:  # noqa: BLE001 — malformed cred → nobody
-                pass
+            except (ValueError, IndexError, EOFError) as e:
+                log.debug("malformed AUTH_SYS cred (%s); using nobody", e)
         return UserGroupInformation.create_remote_user(user)
 
     # --------------------------------------------------------- procedures

@@ -13,7 +13,7 @@ from __future__ import annotations
 import jax
 
 
-def vma_of(x) -> frozenset:
+def vma_of(x) -> frozenset:  # lint: static-fn — vma is trace-time metadata
     try:
         return frozenset(jax.typeof(x).vma)
     except (AttributeError, TypeError):

@@ -76,7 +76,7 @@ class RegistryProtocol:
 class RegistryServer(AbstractService):
     def __init__(self, conf: Configuration):
         super().__init__("RegistryServer")
-        self._entries: Dict[str, _Entry] = {}
+        self._entries: Dict[str, _Entry] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self.rpc: Optional[Server] = None
         self._stop = threading.Event()

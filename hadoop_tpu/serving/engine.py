@@ -103,8 +103,8 @@ class BlockPool:
             raise ValueError("need at least 2 blocks (one is scratch)")
         self.num_blocks = num_blocks
         self.block_size = block_size
-        self._free = deque(range(1, num_blocks))
-        self._ref = [0] * num_blocks
+        self._free = deque(range(1, num_blocks))  # guarded-by: _lock
+        self._ref = [0] * num_blocks              # guarded-by: _lock
         self._lock = threading.Lock()
 
     @property
@@ -113,10 +113,12 @@ class BlockPool:
 
     @property
     def num_free(self) -> int:
-        return len(self._free)
+        with self._lock:
+            return len(self._free)
 
     def refcount(self, block: int) -> int:
-        return self._ref[block]
+        with self._lock:
+            return self._ref[block]
 
     def alloc(self, n: int) -> Optional[List[int]]:
         with self._lock:
@@ -435,7 +437,7 @@ class DecodeEngine:
         self._topks = np.zeros((max_batch,), np.int32)
         self._slots: List[Optional[GenRequest]] = [None] * max_batch
 
-        self._pending: deque = deque()
+        self._pending: deque = deque()  # guarded-by: _cond
         self._admit_counter = itertools.count()
         self._cond = threading.Condition()
         self._sched_lock = threading.Lock()
@@ -581,10 +583,11 @@ class DecodeEngine:
         req = GenRequest(prompt=list(prompt), sampling=sampling)
         with self._cond:
             self._pending.append(req)
+            depth = len(self._pending)
             self._cond.notify_all()
         if self.metrics:
             self.metrics.requests.incr()
-            self.metrics.queue_depth.set(len(self._pending))
+            self.metrics.queue_depth.set(depth)
         return req
 
     @property
@@ -598,11 +601,14 @@ class DecodeEngine:
 
     @property
     def queue_depth(self) -> int:
-        return len(self._pending)
+        with self._cond:
+            return len(self._pending)
 
     @property
     def idle(self) -> bool:
-        return not self._pending and all(r is None for r in self._slots)
+        with self._cond:
+            has_pending = bool(self._pending)
+        return not has_pending and all(r is None for r in self._slots)
 
     def cache_stats(self) -> Dict[str, Any]:
         """Prefix-cache + chunked-prefill observability (health, bench)."""
@@ -636,15 +642,15 @@ class DecodeEngine:
             return emitted
 
     def _admit(self) -> None:
-        while self._pending:
-            slot = next((i for i, r in enumerate(self._slots)
-                         if r is None), None)
-            if slot is None:
-                return
+        while True:
             with self._cond:
                 if not self._pending:
                     return
                 req = self._pending[0]
+            slot = next((i for i, r in enumerate(self._slots)
+                         if r is None), None)
+            if slot is None:
+                return
             # prompt plus already-generated tokens (preempted requests
             # resume by recompute — often warm, off their own cached
             # prompt blocks); the first decode step after prefill needs
@@ -897,7 +903,9 @@ class DecodeEngine:
         if not self.metrics:
             return
         m = self.metrics
-        m.queue_depth.set(len(self._pending))
+        with self._cond:
+            depth = len(self._pending)
+        m.queue_depth.set(depth)
         m.batch_occupancy.set(self.num_active)
         used = self.pool.num_usable - self.pool.num_free
         m.kv_blocks_in_use.set(used)

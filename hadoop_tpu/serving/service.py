@@ -28,6 +28,7 @@ import uuid
 from typing import List, Optional, Tuple
 
 from hadoop_tpu.conf import Configuration
+from hadoop_tpu.ipc.errors import RpcError
 from hadoop_tpu.models.config import get_config
 from hadoop_tpu.serving.loader import (IO_WORKERS_KEY,
                                        load_serving_params,
@@ -145,14 +146,14 @@ class ServingReplica:
             try:
                 self.reg.register(self.record, ttl_s=10.0,
                                   auto_renew=False)
-            except Exception:  # noqa: BLE001 — drain must not hang on
-                pass           # a dead registry
+            except (RpcError, OSError) as e:  # drain must not hang on
+                log.debug("draining-state publish failed: %s", e)  # a dead registry
         self.server.drain(timeout=timeout)
         if self.reg is not None:
             try:
                 self.reg.unregister(self.record.path)
-            except Exception:  # noqa: BLE001
-                pass
+            except (RpcError, OSError) as e:
+                log.debug("unregister on drain failed: %s", e)
             self.reg.close()
         self.server.stop()
 

@@ -18,6 +18,7 @@ import time
 from typing import List, Optional
 
 from hadoop_tpu.conf import Configuration
+from hadoop_tpu.ipc.errors import RpcError
 from hadoop_tpu.dfs.client.filesystem import DistributedFileSystem
 from hadoop_tpu.dfs.datanode import DataNode
 from hadoop_tpu.dfs.namenode import NameNode
@@ -146,8 +147,8 @@ class MiniQJMHACluster:
         for fs in self._fs_instances:
             try:
                 fs.close()
-            except Exception:
-                pass
+            except (OSError, RpcError) as e:
+                log.debug("fs close during shutdown failed: %s", e)
         for dn in self.datanodes:
             if dn is not None:
                 dn.stop()
@@ -261,8 +262,8 @@ class MiniDFSCluster:
         for fs in self._fs_instances:
             try:
                 fs.close()
-            except Exception:
-                pass
+            except (OSError, RpcError) as e:
+                log.debug("fs close during shutdown failed: %s", e)
         for dn in self.datanodes:
             if dn is not None:
                 dn.stop()

@@ -14,6 +14,7 @@ decisions/sec + time-to-first-allocation percentiles.
 from __future__ import annotations
 
 import json
+import logging
 import time
 from typing import Dict, List, Optional
 
@@ -21,6 +22,8 @@ from hadoop_tpu.conf import Configuration
 from hadoop_tpu.yarn.records import (ApplicationId, ContainerId, NodeId,
                                      Resource, ResourceRequest)
 from hadoop_tpu.yarn.scheduler import make_scheduler
+
+log = logging.getLogger(__name__)
 
 
 class SyntheticTrace:
@@ -346,8 +349,8 @@ def run_rm(num_nodes: int = 1000, num_apps: int = 20,
     finally:
         try:
             yc.close()
-        except Exception:
-            pass
+        except (OSError, RuntimeError) as e:
+            log.debug("yarn client close failed: %s", e)
         for p in ("pool", "am_pool"):
             ex = locals().get(p)
             if ex is not None:

@@ -15,10 +15,13 @@ spans; the default in-memory receiver backs tests and the /tracing endpoint.
 from __future__ import annotations
 
 import contextvars
+import logging
 import random
 import threading
 import time
 from typing import Callable, Dict, List, Optional
+
+log = logging.getLogger(__name__)
 
 _active: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
     "htpu_active_span", default=None)
@@ -133,8 +136,8 @@ class Tracer:
         for r in receivers:
             try:
                 r(span)
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — receiver is user code
+                log.debug("span receiver %r failed: %s", r, e)
 
     def set_sample_rate(self, rate: float) -> None:
         """Runtime reconfiguration (ref: TracerConfigurationManager)."""

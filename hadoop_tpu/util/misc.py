@@ -105,26 +105,48 @@ class PauseMonitor:
                     self._on_pause(overslept)
 
 
+# Shared retry randomness: one process-wide generator so tests can seed
+# it (misc.RETRY_RNG.seed(0)) and get deterministic delay sequences
+# without monkeypatching every retry site.
+import random as _random  # noqa: E402 — grouped with its consumer
+
+RETRY_RNG = _random.Random()
+
+
+def backoff_delay(base_s: float, attempt: int, max_s: float = 30.0,
+                  rng=None) -> float:
+    """Exponential backoff with full-range jitter (ref:
+    io/retry/RetryPolicies.exponentialBackoffRetry — delay doubles per
+    attempt, then is scaled by a random factor in [0.5, 1.5) so a fleet
+    of clients never retries in lockstep)."""
+    rng = RETRY_RNG if rng is None else rng
+    return min(max_s, base_s * (2 ** attempt)) * (0.5 + rng.random())
+
+
 class RetryOnException:
-    """Bounded retry helper for idempotent host-side calls."""
+    """Bounded retry helper for idempotent host-side calls; delays grow
+    exponentially with jitter (util.misc.backoff_delay)."""
 
     def __init__(self, attempts: int = 3, delay_s: float = 0.1, backoff: float = 2.0,
-                 retryable=(OSError, ConnectionError)):
+                 retryable=(OSError, ConnectionError), max_delay_s: float = 30.0):
         self.attempts = attempts
         self.delay_s = delay_s
         self.backoff = backoff
         self.retryable = retryable
+        self.max_delay_s = max_delay_s
 
     def call(self, fn: Callable, *args, **kwargs):
-        delay = self.delay_s
         for i in range(self.attempts):
             try:
                 return fn(*args, **kwargs)
             except self.retryable:
                 if i == self.attempts - 1:
                     raise
-                time.sleep(delay)
-                delay *= self.backoff
+                # honor the caller's growth factor (backoff=1.0 means
+                # constant-with-jitter) — same jitter law as backoff_delay
+                delay = min(self.max_delay_s,
+                            self.delay_s * (self.backoff ** i))
+                time.sleep(delay * (0.5 + RETRY_RNG.random()))
 
 
 def local_host_names() -> set:

@@ -105,7 +105,8 @@ class DfsFuseDriver(CsiDriver):
                 except OSError:
                     err = ""
                 raise IOError(f"fuse mount of {volume_id} failed: {err}")
-            time.sleep(0.1)
+            # bounded poll for the fuse mount to appear
+            time.sleep(0.1)  # lint: disable=rpc/retry-no-backoff
         proc.terminate()
         raise IOError(f"mount of {volume_id} at {target_path} timed out")
 

@@ -267,8 +267,8 @@ class NodeAgent(AbstractService):
         for aux in self.aux_services:
             try:
                 aux.stop()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001 — aux is plugin code
+                log.debug("aux service stop failed: %s", e)
         if self.timeline is not None:
             self.timeline.stop_all()
         if self.rpc:
@@ -414,8 +414,8 @@ class NodeAgent(AbstractService):
                 try:
                     self.csi.node_unpublish_volume(v["driver"], v["id"],
                                                    target)
-                except Exception:  # noqa: BLE001
-                    pass
+                except (OSError, IOError) as e:
+                    log.debug("rollback unpublish failed: %s", e)
             raise
         rc.published_volumes = published
 

@@ -24,6 +24,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from hadoop_tpu.conf import Configuration
+from hadoop_tpu.ipc.errors import RpcError
 from hadoop_tpu.ipc import Client, Server, get_proxy
 from hadoop_tpu.yarn.client import AMRMClient, NMClient, YarnClient
 from hadoop_tpu.yarn.records import (ApplicationSubmissionContext, AppState,
@@ -273,8 +274,8 @@ class ServiceMaster:
                         self.instances[name].remove(inst)
                 try:
                     self.amrm.release(container.container_id)
-                except Exception:  # noqa: BLE001
-                    pass
+                except (RpcError, OSError) as e:
+                    log.debug("release of failed container: %s", e)
 
     def _completed(self, done) -> None:
         for status in done:

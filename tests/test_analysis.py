@@ -1,0 +1,492 @@
+"""tpulint tests: every checker against known-bad and known-good
+fixtures, the suppression/baseline workflow, and — the tier-1 gate — a
+self-run asserting the shipped tree is clean against the committed
+baseline (the findbugs-in-CI lane of the reference)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from hadoop_tpu.analysis import (GuardedByChecker, JitDisciplineChecker,
+                                 LockOrderChecker, RetryHygieneChecker,
+                                 SilentSwallowChecker, TimeoutChecker,
+                                 all_checkers)
+from hadoop_tpu.analysis.core import (load_baseline, run_lint,
+                                      split_baselined, write_baseline)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "hadoop_tpu")
+
+
+def lint_source(tmp_path, source, checkers, name="fixture.py"):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    return run_lint([str(f)], checkers=checkers, root=str(tmp_path))
+
+
+def ids_of(findings):
+    return [f.checker for f in findings]
+
+
+# ------------------------------------------------------------ guarded-by
+
+BAD_GUARDED = """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._free = []  # guarded-by: _lock
+
+        def take(self):
+            with self._lock:
+                return self._free.pop()
+
+        def peek(self):
+            return self._free[0]      # BAD: no lock held
+"""
+
+
+def test_unguarded_field_is_flagged(tmp_path):
+    findings = lint_source(tmp_path, BAD_GUARDED, [GuardedByChecker()])
+    assert ids_of(findings) == ["lock/guarded-by"]
+    assert "Pool._free" in findings[0].message
+    # the finding lands on the unguarded access, not the guarded one
+    assert "BAD" in (tmp_path / "fixture.py").read_text().splitlines()[
+        findings[0].line - 1]
+
+
+def test_guarded_access_and_init_are_clean(tmp_path):
+    findings = lint_source(tmp_path, """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._free = [1]  # guarded-by: _lock
+                self._free.append(2)   # __init__ is exempt
+
+            def take(self):
+                with self._lock:
+                    return self._free.pop()
+    """, [GuardedByChecker()])
+    assert findings == []
+
+
+def test_holds_annotation_covers_locked_helpers(tmp_path):
+    findings = lint_source(tmp_path, """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._free = []  # guarded-by: _lock
+
+            def take(self):
+                with self._lock:
+                    return self._take_locked()
+
+            def _take_locked(self):  # lint: holds=_lock
+                return self._free.pop()
+    """, [GuardedByChecker()])
+    assert findings == []
+
+
+def test_rw_lock_scopes_count_as_held(tmp_path):
+    findings = lint_source(tmp_path, """
+        import threading
+
+        class NS:
+            def __init__(self):
+                self.lock = threading.RLock()
+                self._dirs = {}  # guarded-by: lock
+
+            def read(self, p):
+                with self.lock.read():
+                    return self._dirs.get(p)
+    """, [GuardedByChecker()])
+    assert findings == []
+
+
+# ------------------------------------------------------------ lock order
+
+CYCLE = """
+    import threading
+
+    class A:
+        def __init__(self):
+            self.l1 = threading.Lock()
+            self.l2 = threading.Lock()
+
+        def forward(self):
+            with self.l1:
+                with self.l2:
+                    return 1
+
+        def backward(self):
+            with self.l2:
+                with self.l1:
+                    return 2
+"""
+
+
+def test_lock_order_cycle_is_detected(tmp_path):
+    findings = lint_source(tmp_path, CYCLE, [LockOrderChecker()])
+    assert ids_of(findings) == ["lock/order-cycle"]
+    assert "A.l1" in findings[0].message and "A.l2" in findings[0].message
+
+
+def test_consistent_lock_order_is_clean(tmp_path):
+    findings = lint_source(tmp_path, """
+        import threading
+
+        class A:
+            def __init__(self):
+                self.l1 = threading.Lock()
+                self.l2 = threading.Lock()
+
+            def forward(self):
+                with self.l1:
+                    with self.l2:
+                        return 1
+
+            def also_forward(self):
+                with self.l1:
+                    with self.l2:
+                        return 2
+    """, [LockOrderChecker()])
+    assert findings == []
+
+
+def test_lock_order_cycle_through_a_call_is_detected(tmp_path):
+    """The deadlock hides one call deep: forward() nests l1→l2 lexically,
+    backward() holds l2 and CALLS a helper that takes l1."""
+    findings = lint_source(tmp_path, """
+        import threading
+
+        class A:
+            def __init__(self):
+                self.l1 = threading.Lock()
+                self.l2 = threading.Lock()
+
+            def forward(self):
+                with self.l1:
+                    with self.l2:
+                        return 1
+
+            def helper(self):
+                with self.l1:
+                    return 3
+
+            def backward(self):
+                with self.l2:
+                    return self.helper()
+    """, [LockOrderChecker()])
+    assert ids_of(findings) == ["lock/order-cycle"]
+
+
+# ---------------------------------------------------------- jit checkers
+
+def test_traced_branch_is_flagged(tmp_path):
+    findings = lint_source(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def step(x):
+            if x > 0:              # BAD: branch on a traced value
+                return x + 1
+            return x - 1
+
+        step_fn = jax.jit(step)
+    """, [JitDisciplineChecker()])
+    assert ids_of(findings) == ["jit/traced-branch"]
+
+
+def test_shape_branch_and_config_branch_are_clean(tmp_path):
+    findings = lint_source(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        USE_BIAS = True
+
+        def step(x, b):
+            if x.shape[0] > 4:     # static: shapes are trace-time
+                x = x * 2
+            if USE_BIAS:           # static: Python config
+                x = x + 1
+            if b is None:          # static: identity check
+                return x
+            return x + b
+
+        step_fn = jax.jit(step)
+    """, [JitDisciplineChecker()])
+    assert findings == []
+
+
+def test_host_sync_is_flagged_through_a_callee(tmp_path):
+    """Reachability: the sync hides in a helper the jitted fn calls."""
+    findings = lint_source(tmp_path, """
+        import jax
+        import numpy as np
+
+        def helper(v):
+            return float(v.item())     # BAD: host sync on traced value
+
+        def step(x):
+            return helper(x) + 1
+
+        step_fn = jax.jit(step)
+    """, [JitDisciplineChecker()])
+    assert "jit/host-sync" in ids_of(findings)
+
+
+def test_np_asarray_on_traced_is_flagged(tmp_path):
+    findings = lint_source(tmp_path, """
+        import jax
+        import numpy as np
+
+        def step(x):
+            host = np.asarray(x)       # BAD: device→host copy
+            return host.sum()
+
+        step_fn = jax.jit(step)
+    """, [JitDisciplineChecker()])
+    assert "jit/host-sync" in ids_of(findings)
+
+
+def test_partial_bound_params_stay_static(tmp_path):
+    """partial()-bound arguments are Python constants at jit time — a
+    branch on one must NOT be flagged (the device_shuffle pattern)."""
+    findings = lint_source(tmp_path, """
+        from functools import partial
+
+        import jax
+
+        def body(x, mode):
+            if mode == "sum":      # static: bound by partial below
+                return x + x
+            return x * x
+
+        prog = jax.jit(partial(body, mode="sum"))
+    """, [JitDisciplineChecker()])
+    assert findings == []
+
+
+def test_loop_over_traced_value_is_flagged(tmp_path):
+    findings = lint_source(tmp_path, """
+        import jax
+
+        def step(x, n):
+            acc = x
+            for _ in range(n):     # BAD: traced trip count
+                acc = acc + 1
+            return acc
+
+        step_fn = jax.jit(step)
+    """, [JitDisciplineChecker()])
+    assert ids_of(findings) == ["jit/traced-branch"]
+
+
+# ---------------------------------------------------------- rpc checkers
+
+def test_timeoutless_socket_is_flagged(tmp_path):
+    findings = lint_source(tmp_path, """
+        import socket
+
+        def dial(addr):
+            return socket.create_connection(addr)   # BAD: no timeout
+    """, [TimeoutChecker()])
+    assert ids_of(findings) == ["rpc/no-timeout"]
+
+
+def test_socket_with_timeout_is_clean(tmp_path):
+    findings = lint_source(tmp_path, """
+        import socket
+
+        def dial(addr):
+            s = socket.socket()
+            s.settimeout(5.0)
+            s.connect(addr)
+            return socket.create_connection(addr, timeout=5.0)
+    """, [TimeoutChecker()])
+    assert findings == []
+
+
+def test_raw_connect_without_settimeout_is_flagged(tmp_path):
+    findings = lint_source(tmp_path, """
+        import socket
+
+        def dial(addr):
+            s = socket.socket()
+            s.connect(addr)      # BAD: blocking connect, no settimeout
+            return s
+    """, [TimeoutChecker()])
+    assert ids_of(findings) == ["rpc/no-timeout"]
+
+
+def test_settimeout_none_is_flagged(tmp_path):
+    findings = lint_source(tmp_path, """
+        def unbound(sock):
+            sock.settimeout(None)    # BAD: unbounds the live connection
+    """, [TimeoutChecker()])
+    assert ids_of(findings) == ["rpc/timeout-cleared"]
+
+
+def test_constant_sleep_retry_is_flagged(tmp_path):
+    findings = lint_source(tmp_path, """
+        import time
+
+        def fetch(op):
+            for _ in range(5):
+                try:
+                    return op()
+                except OSError:
+                    time.sleep(0.5)      # BAD: lockstep retries
+    """, [RetryHygieneChecker()])
+    assert ids_of(findings) == ["rpc/retry-no-backoff"]
+
+
+def test_jittered_backoff_retry_is_clean(tmp_path):
+    findings = lint_source(tmp_path, """
+        import time
+
+        from hadoop_tpu.util.misc import backoff_delay
+
+        def fetch(op):
+            for attempt in range(5):
+                try:
+                    return op()
+                except OSError:
+                    time.sleep(backoff_delay(0.5, attempt))
+    """, [RetryHygieneChecker()])
+    assert findings == []
+
+
+def test_silent_broad_swallow_is_flagged(tmp_path):
+    findings = lint_source(tmp_path, """
+        def quiet(op):
+            try:
+                op()
+            except Exception:
+                pass
+    """, [SilentSwallowChecker()])
+    assert ids_of(findings) == ["rpc/silent-swallow"]
+
+
+def test_narrow_or_logged_excepts_are_clean(tmp_path):
+    findings = lint_source(tmp_path, """
+        import logging
+
+        log = logging.getLogger(__name__)
+
+        def quiet(op):
+            try:
+                op()
+            except OSError:
+                pass                      # narrow: fine
+            try:
+                op()
+            except Exception as e:        # broad but leaves a breadcrumb
+                log.debug("op failed: %s", e)
+    """, [SilentSwallowChecker()])
+    assert findings == []
+
+
+# -------------------------------------------- suppression + baseline
+
+def test_line_suppression(tmp_path):
+    findings = lint_source(tmp_path, """
+        def quiet(op):
+            try:
+                op()
+            except Exception:  # lint: disable=rpc/silent-swallow
+                pass
+    """, [SilentSwallowChecker()])
+    assert findings == []
+
+
+def test_file_suppression(tmp_path):
+    findings = lint_source(tmp_path, """
+        # lint: disable-file=rpc/silent-swallow
+
+        def quiet(op):
+            try:
+                op()
+            except Exception:
+                pass
+    """, [SilentSwallowChecker()])
+    assert findings == []
+
+
+def test_baseline_roundtrip(tmp_path):
+    findings = lint_source(tmp_path, """
+        def quiet(op):
+            try:
+                op()
+            except Exception:
+                pass
+    """, [SilentSwallowChecker()])
+    assert len(findings) == 1
+    bl = tmp_path / "baseline"
+    write_baseline(str(bl), findings)
+    keys = load_baseline(str(bl))
+    new, old = split_baselined(findings, keys)
+    assert new == [] and len(old) == 1
+    # an un-baselined finding still surfaces
+    new2, _ = split_baselined(findings, set())
+    assert len(new2) == 1
+
+
+# --------------------------------------------------- the tier-1 gate
+
+def test_shipped_tree_is_lint_clean():
+    """Self-run: the full package against the committed baseline. A
+    regression anywhere in hadoop_tpu/ fails this test."""
+    findings = run_lint([PKG], checkers=all_checkers(), root=REPO)
+    baseline = load_baseline(os.path.join(REPO, "LINT_BASELINE"))
+    new, _ = split_baselined(findings, baseline)
+    assert new == [], "unbaselined lint findings:\n" + \
+        "\n".join(f.render() for f in new)
+
+
+def test_cli_lint_gate():
+    """`hadoop-tpu lint --baseline LINT_BASELINE` exits 0 on the shipped
+    tree (the command CI shells)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hadoop-tpu"), "lint",
+         "--baseline", os.path.join(REPO, "LINT_BASELINE")],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_cli_lint_fails_on_seeded_bad_tree(tmp_path):
+    (tmp_path / "bad.py").write_text(textwrap.dedent("""
+        import threading
+
+        class D:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def one(self):
+                with self.a:
+                    with self.b:
+                        pass
+
+            def two(self):
+                with self.b:
+                    with self.a:
+                        pass
+    """))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "hadoop-tpu"), "lint",
+         "--no-baseline", str(tmp_path)],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1
+    assert "lock/order-cycle" in proc.stdout

@@ -520,3 +520,58 @@ def test_retry_cache_never_evicts_inflight_entries():
             cache.wait_for_completion(b"c", i, timeout=0.01)
     for e in inflight:
         cache.complete(e, True)
+
+
+# ---------------------------------------------------------- read timeout
+
+
+def test_read_timeout_fails_calls_against_stalled_server():
+    """A server that accepts the connection and then goes silent must not
+    block a caller for its full (possibly huge) per-call timeout:
+    ipc.client.read.timeout bounds the silence (regression for the old
+    settimeout(None)-after-connect behaviour)."""
+    import socket as _socket
+
+    from hadoop_tpu.ipc.errors import RpcTimeoutError
+
+    lsock = _socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+    accepted = []
+
+    def stall():
+        conn, _ = lsock.accept()
+        accepted.append(conn)  # read nothing, answer nothing — just hang
+
+    t = threading.Thread(target=stall, daemon=True)
+    t.start()
+    conf = Configuration(load_defaults=False)
+    conf.set("ipc.client.read.timeout", "0.4")
+    c = Client(conf)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(RpcTimeoutError, match="read.timeout"):
+            # per-call timeout far beyond what the test tolerates: only
+            # the read timeout can fail this fast
+            c.call(("127.0.0.1", port), "EchoProtocol", "echo",
+                   ("hi",), timeout=60.0)
+        assert time.monotonic() - t0 < 10.0
+    finally:
+        c.stop()
+        for conn in accepted:
+            conn.close()
+        lsock.close()
+
+
+def test_read_timeout_spares_slow_but_alive_server(server, client):
+    """Inbound bytes reset the clock: a handler that takes longer than
+    the read timeout but whose connection stays live must still complete
+    (the timeout measures silence, not latency... while pings and other
+    call responses flow, only TOTAL silence kills the connection)."""
+    proxy = get_proxy(EchoProtocol, ("127.0.0.1", server.port),
+                      client=client)
+    # an early fast call proves the path; the slow call then outlives
+    # the default read timeout tick without the connection dying
+    assert proxy.echo("warm") == "warm"
+    assert proxy.slow(0.3) == "done"
