@@ -99,6 +99,16 @@ def main() -> None:
         # an import-time one) is a data point for the trajectory, never
         # a reason to lose the storage/compute numbers computed above
         out["serving"] = {"error": f"{type(e).__name__}: {e}"}
+    # Training plane: 8-virtual-device overlap smoke (A-B step counts +
+    # bit-exact loss parity with the communication-overlap pass on vs
+    # off, plus the async-save blocking-time split). Same recorded-not-
+    # raised contract as the serving smoke.
+    try:
+        from benchmarks import overlap_smoke
+        out["overlap"] = overlap_smoke.run()
+    except Exception as e:  # noqa: BLE001 — recorded for the
+        # trajectory; must not discard the benches already computed
+        out["overlap"] = {"error": f"{type(e).__name__}: {e}"}
     out["wall_seconds"] = round(time.perf_counter() - t0, 1)
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)

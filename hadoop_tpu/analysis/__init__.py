@@ -25,7 +25,8 @@ finding, so tier-1 keeps the tree lint-clean.
 
 from hadoop_tpu.analysis.core import (Finding, Project, SourceModule,
                                       load_baseline, run_lint)
-from hadoop_tpu.analysis.jitcheck import JitDisciplineChecker
+from hadoop_tpu.analysis.jitcheck import (JitDisciplineChecker,
+                                          StepBlockingChecker)
 from hadoop_tpu.analysis.lockcheck import GuardedByChecker, LockOrderChecker
 from hadoop_tpu.analysis.rpccheck import (RetryHygieneChecker,
                                           SilentSwallowChecker,
@@ -35,10 +36,12 @@ from hadoop_tpu.analysis.rpccheck import (RetryHygieneChecker,
 def all_checkers():
     """The shipped checker set, fresh instances (checkers hold state)."""
     return [GuardedByChecker(), LockOrderChecker(), JitDisciplineChecker(),
-            TimeoutChecker(), RetryHygieneChecker(), SilentSwallowChecker()]
+            StepBlockingChecker(), TimeoutChecker(), RetryHygieneChecker(),
+            SilentSwallowChecker()]
 
 
 __all__ = ["Finding", "Project", "SourceModule", "run_lint",
            "load_baseline", "all_checkers", "GuardedByChecker",
-           "LockOrderChecker", "JitDisciplineChecker", "TimeoutChecker",
+           "LockOrderChecker", "JitDisciplineChecker",
+           "StepBlockingChecker", "TimeoutChecker",
            "RetryHygieneChecker", "SilentSwallowChecker"]

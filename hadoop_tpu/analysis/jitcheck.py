@@ -34,21 +34,55 @@ from hadoop_tpu.analysis.core import (Checker, Finding, Project,
                                       SourceModule, attr_chain, call_name)
 
 # attribute reads that yield STATIC (trace-time Python) values
-_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "sharding"}
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "sharding",
+                 "itemsize"}
 # callables whose result is static regardless of argument taint.
-# NOT here: range/max/min/enumerate/zip — those propagate their
-# arguments' taint (range(n) over a traced n is a traced trip count),
-# which the generic Call handling already models. len() is static: it
-# reads the leading shape dimension.
+# NOT here: range/max/min — those propagate their arguments' taint
+# (range(n) over a traced n is a traced trip count), which the generic
+# Call handling already models. len() is static: it reads the leading
+# shape dimension.
 _STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr",
                  "type", "str", "repr",
                  "jnp.issubdtype", "jnp.iinfo", "jnp.finfo", "np.iinfo",
-                 "np.finfo"}
+                 "np.finfo", "jnp.dtype", "np.dtype"}
+
+# Two taint levels. VAL: a traced array/scalar — branching on it or
+# syncing it breaks the compile-once contract. ITEMS: a static-length
+# Python CONTAINER holding traced values (tree_flatten output, zip of
+# leaf lists) — iterating it is ordinary trace-time Python (the trip
+# count is structural), only its ELEMENTS are traced. Telling the two
+# apart is what lets the bucketed-collective code (parallel/overlap.py)
+# iterate leaf lists without tripping jit/traced-branch.
+VAL = "val"
+ITEMS = "items"
+# structural builders: container-in/container-out, static length
+_STRUCTURAL_CALLS = {"zip", "enumerate", "sorted", "reversed", "list",
+                     "tuple", "set", "frozenset",
+                     "tree_flatten", "tree_leaves",
+                     "tree_flatten_with_path", "flatten_up_to",
+                     "tree_unflatten", "unflatten",
+                     "tree_leaves_with_path"}
 # receivers of a method call that sync the device when the value is traced
 _SYNC_METHODS = {"item", "tolist", "block_until_ready"}
 _SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
                "jax.device_get", "device_get", "onp.asarray", "onp.array"}
 _SYNC_CASTS = {"float", "int", "bool", "complex"}
+
+
+def _max_level(levels) -> Optional[str]:
+    """Strongest taint in a collection: VAL > ITEMS > None."""
+    out = None
+    for lv in levels:
+        if lv is VAL:
+            return VAL
+        if lv is ITEMS:
+            out = ITEMS
+    return out
+
+
+def _val_if(lv) -> Optional[str]:
+    """Arithmetic/comparison collapses container-ness to a value."""
+    return VAL if lv else None
 
 
 class _FuncDef:
@@ -59,6 +93,123 @@ class _FuncDef:
         self.name = getattr(node, "name", f"<lambda:{node.lineno}>")
         self.qual = (f"{mod.dotted}.{cls}.{self.name}" if cls
                      else f"{mod.dotted}.{self.name}")
+
+
+class StepBlockingChecker(Checker):
+    """``jit/blocking-in-step``: host syncs and blocking IO lexically
+    inside a trainer STEP LOOP.
+
+    The overlap pass (parallel/overlap.py, async checkpointing) exists
+    to keep the device ahead of the host; one stray ``float(loss)`` or
+    synchronous ``fs.`` write inside the loop that drives the jitted
+    step serializes read → transfer → step again and silently undoes
+    it. A step loop is recognized lexically: a ``for``/``while`` whose
+    body calls ``*.step_fn(...)`` / ``step_fn(...)`` / ``train_step``
+    or a callable assigned from ``make_train_step(...)``. Inside it
+    (nested defs excluded) the checker flags:
+
+    - ``float()`` / ``int()`` casts of non-literal values, ``.item()``,
+      ``.tolist()``, ``.block_until_ready()`` — device round-trips;
+    - calls through an ``fs``-named receiver (``self.fs.delete(...)``)
+      — synchronous filesystem IO;
+    - ``.join()`` with no args / a numeric timeout / a ``timeout=``
+      keyword — thread joins (``", ".join(parts)`` stays exempt).
+
+    Annotate deliberate syncs (bounded in-flight backpressure, final
+    drain) with ``# lint: disable=jit/blocking-in-step``.
+    """
+
+    name = "step-blocking"
+    ids = ("jit/blocking-in-step",)
+
+    _SYNC_METHOD_NAMES = {"item", "tolist", "block_until_ready"}
+
+    def check_module(self, mod: SourceModule) -> List[Finding]:
+        # names bound from make_train_step(...) anywhere in the module
+        step_names: Set[str] = {"step_fn", "train_step"}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    call_name(node.value) and \
+                    call_name(node.value).split(".")[-1] == \
+                    "make_train_step":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        step_names.add(t.id)
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While)) and \
+                    self._is_step_loop(node, step_names):
+                self._scan_loop(mod, node, findings)
+        return findings
+
+    def _is_step_loop(self, loop, step_names: Set[str]) -> bool:
+        for node in self._walk_no_defs(loop):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if isinstance(fn, ast.Attribute) and \
+                        fn.attr in step_names:
+                    return True
+                if isinstance(fn, ast.Name) and fn.id in step_names:
+                    return True
+        return False
+
+    @staticmethod
+    def _walk_no_defs(loop):
+        """Walk a loop's body, not descending into nested defs (a
+        worker closure defined in the loop runs off the step path)."""
+        stack = list(ast.iter_child_nodes(loop))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def _scan_loop(self, mod: SourceModule, loop,
+                   findings: List[Finding]) -> None:
+        for node in self._walk_no_defs(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = self._blocking_call(node)
+            if msg:
+                f = mod.finding(
+                    node, "jit/blocking-in-step",
+                    f"{msg} inside the trainer step loop — it "
+                    f"serializes the host against the device step "
+                    f"(move it off the loop, make it async, or "
+                    f"annotate a deliberate sync)")
+                if f:
+                    findings.append(f)
+
+    def _blocking_call(self, node: ast.Call) -> Optional[str]:
+        name = call_name(node)
+        fn = node.func
+        if name in ("float", "int") and len(node.args) == 1 and \
+                not isinstance(node.args[0], ast.Constant):
+            return f"{name}() host-sync cast"
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in self._SYNC_METHOD_NAMES:
+                return f".{fn.attr}() host sync"
+            chain = attr_chain(fn)
+            if chain and any(seg == "fs" or seg.endswith("_fs")
+                             for seg in chain[:-1]):
+                return f"blocking filesystem call {'.'.join(chain)}()"
+            if fn.attr == "join" and self._looks_like_thread_join(node):
+                return "Thread.join()"
+        return None
+
+    @staticmethod
+    def _looks_like_thread_join(node: ast.Call) -> bool:
+        # str.join(iterable) always takes one non-numeric positional;
+        # Thread.join takes nothing or a numeric/keyword timeout
+        if any(k.arg == "timeout" for k in node.keywords):
+            return True
+        if not node.args and not node.keywords:
+            return True
+        return len(node.args) == 1 and \
+            isinstance(node.args[0], ast.Constant) and \
+            isinstance(node.args[0].value, (int, float))
 
 
 class JitDisciplineChecker(Checker):
@@ -211,14 +362,15 @@ class JitDisciplineChecker(Checker):
 
     def finalize(self, project: Project) -> List[Finding]:
         findings: List[Finding] = []
-        # worklist of (qual, frozenset tainted param names)
+        # worklist of (qual, frozenset of (param, level) pairs)
         seen: Set[Tuple[str, frozenset]] = set()
         work: List[Tuple[str, frozenset]] = []
         for root, static in self._roots:
             fd = self._defs.get(root)
             if fd is None:
                 continue
-            tainted = frozenset(self._root_tainted_params(fd) - static)
+            tainted = frozenset(
+                (n, VAL) for n in self._root_tainted_params(fd) - static)
             work.append((root, tainted))
         while work:
             qual, tainted = work.pop()
@@ -228,9 +380,9 @@ class JitDisciplineChecker(Checker):
             fd = self._defs.get(qual)
             if fd is None:
                 continue
-            calls = self._analyse(fd, set(tainted), findings)
+            calls = self._analyse(fd, dict(tainted), findings)
             for callee, callee_tainted in calls:
-                work.append((callee, frozenset(callee_tainted)))
+                work.append((callee, frozenset(callee_tainted.items())))
         # dedupe (same function may be analysed under several taint sets)
         uniq: Dict[str, Finding] = {}
         for f in findings:
@@ -249,83 +401,129 @@ class JitDisciplineChecker(Checker):
 
     # ---- per-function taint pass
 
-    def _analyse(self, fd: _FuncDef, tainted: Set[str],
+    def _analyse(self, fd: _FuncDef, tainted: Dict[str, str],
                  findings: List[Finding]
-                 ) -> List[Tuple[str, Set[str]]]:
+                 ) -> List[Tuple[str, Dict[str, str]]]:
         mod = fd.mod
-        out_calls: List[Tuple[str, Set[str]]] = []
+        out_calls: List[Tuple[str, Dict[str, str]]] = []
 
-        def expr_tainted(e: ast.AST) -> bool:
+        def level(e: ast.AST) -> Optional[str]:
+            """None (static), VAL (traced value) or ITEMS (static
+            container of traced values)."""
             if isinstance(e, ast.Name):
-                return e.id in tainted
+                return tainted.get(e.id)
             if isinstance(e, ast.Attribute):
                 if e.attr in _STATIC_ATTRS:
-                    return False
-                return expr_tainted(e.value)
+                    return None
+                return level(e.value)
             if isinstance(e, ast.Subscript):
-                return expr_tainted(e.value) or expr_tainted(e.slice)
+                # an element OF a tainted container is a traced value;
+                # indexing a static table by static metadata is static,
+                # but by a traced index it is a traced gather
+                if level(e.value) is not None:
+                    return VAL
+                if level(e.slice) is VAL:
+                    return VAL
+                return None
             if isinstance(e, ast.Call):
                 name = call_name(e)
                 if name in _STATIC_CALLS:
-                    return False
+                    return None
                 resolved = self._resolve_call(fd, e)
                 if resolved is not None and resolved in self._static_fns:
-                    return False  # marked "# lint: static-fn"
-                if name and (name.split(".")[-1] in
-                             ("astype", "reshape", "sum", "mean", "get")):
-                    return expr_tainted(e.func)
-                args_tainted = any(expr_tainted(a) for a in e.args) or \
-                    any(expr_tainted(k.value) for k in e.keywords)
-                if isinstance(e.func, ast.Attribute):
-                    return args_tainted or expr_tainted(e.func.value)
-                return args_tainted
+                    return None  # marked "# lint: static-fn"
+                last = name.split(".")[-1] if name else ""
+                if last in ("astype", "reshape", "sum", "mean", "get"):
+                    return level(e.func)
+                arg_level = _max_level(
+                    [level(a) for a in e.args] +
+                    [level(k.value) for k in e.keywords])
+                if last in _STRUCTURAL_CALLS:
+                    # container-in/container-out, static length:
+                    # iterating the result is trace-time Python
+                    return ITEMS if arg_level else None
+                recv = level(e.func.value) \
+                    if isinstance(e.func, ast.Attribute) else None
+                return VAL if (arg_level or recv) else None
             if isinstance(e, ast.BinOp):
-                return expr_tainted(e.left) or expr_tainted(e.right)
+                return _val_if(level(e.left) or level(e.right))
             if isinstance(e, ast.UnaryOp):
-                return expr_tainted(e.operand)
+                return level(e.operand)
             if isinstance(e, ast.BoolOp):
-                return any(expr_tainted(v) for v in e.values)
+                return _max_level([level(v) for v in e.values])
             if isinstance(e, ast.Compare):
                 # `x is None` / `x is not None` is trace-time Python
                 if all(isinstance(op, (ast.Is, ast.IsNot))
                        for op in e.ops):
-                    return False
-                return expr_tainted(e.left) or \
-                    any(expr_tainted(c) for c in e.comparators)
+                    return None
+                # membership over STATIC containers is trace-time too
+                if all(isinstance(op, (ast.In, ast.NotIn))
+                       for op in e.ops) and \
+                        level(e.left) is not VAL and \
+                        all(level(c) is not VAL for c in e.comparators):
+                    return None
+                got = _max_level([level(e.left)] +
+                                 [level(c) for c in e.comparators])
+                return _val_if(got)
             if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
-                return any(expr_tainted(el) for el in e.elts)
+                return ITEMS if _max_level(
+                    [level(el) for el in e.elts]) else None
             if isinstance(e, ast.IfExp):
-                return (expr_tainted(e.test) or expr_tainted(e.body)
-                        or expr_tainted(e.orelse))
+                return _max_level([level(e.test), level(e.body),
+                                   level(e.orelse)])
             if isinstance(e, ast.Starred):
-                return expr_tainted(e.value)
-            return False
+                return level(e.value)
+            return None
 
-        def taint_targets(t: ast.AST) -> List[str]:
+        def expr_tainted(e: ast.AST) -> bool:
+            """A traced VALUE (the thing branches/syncs must not see).
+            ITEMS containers are deliberately excluded — their length
+            and truthiness are static."""
+            return level(e) is VAL
+
+        def assign(target: ast.AST, lv: Optional[str]) -> None:
+            if lv is None:
+                return
+            if isinstance(target, ast.Name):
+                tainted[target.id] = lv
+            elif isinstance(target, (ast.Tuple, ast.List)):
+                # unpacking a metadata tuple keeps container-ness;
+                # leaf extraction happens via Subscript/iteration
+                for el in target.elts:
+                    assign(el, lv)
+
+        def assign_stmt(stmt: ast.Assign) -> None:
+            lv = level(stmt.value)
+            for t in stmt.targets:
+                if isinstance(t, (ast.Tuple, ast.List)) and \
+                        isinstance(stmt.value, ast.Tuple) and \
+                        len(t.elts) == len(stmt.value.elts):
+                    # `a, b = f(x), g(y)` — map levels element-wise
+                    for el, val in zip(t.elts, stmt.value.elts):
+                        assign(el, level(val))
+                else:
+                    assign(t, lv)
+
+        def loop_targets(t: ast.AST) -> None:
+            # iterating a container (or a traced array) yields traced
+            # VALUES in the loop targets
             if isinstance(t, ast.Name):
-                return [t.id]
-            if isinstance(t, (ast.Tuple, ast.List)):
-                out = []
+                tainted[t.id] = VAL
+            elif isinstance(t, (ast.Tuple, ast.List)):
                 for el in t.elts:
-                    out.extend(taint_targets(el))
-                return out
-            return []
+                    loop_targets(el)
 
         # two passes so taint flowing backwards through loops settles
-        body = fd.node.body
         for _ in range(2):
             for stmt in ast.walk(fd.node):
-                if isinstance(stmt, ast.Assign) and \
-                        expr_tainted(stmt.value):
-                    for t in stmt.targets:
-                        tainted.update(taint_targets(t))
+                if isinstance(stmt, ast.Assign):
+                    assign_stmt(stmt)
                 elif isinstance(stmt, ast.AugAssign) and \
-                        (expr_tainted(stmt.value) or
-                         expr_tainted(stmt.target)):
-                    tainted.update(taint_targets(stmt.target))
+                        (level(stmt.value) or level(stmt.target)):
+                    assign(stmt.target, VAL)
                 elif isinstance(stmt, (ast.For, ast.AsyncFor)) and \
-                        expr_tainted(stmt.iter):
-                    tainted.update(taint_targets(stmt.target))
+                        level(stmt.iter) is not None:
+                    loop_targets(stmt.target)
 
         # findings + call propagation
         for node in ast.walk(fd.node):
@@ -339,7 +537,9 @@ class JitDisciplineChecker(Checker):
                     if f:
                         findings.append(f)
             elif isinstance(node, (ast.For, ast.AsyncFor)):
-                if expr_tainted(node.iter):
+                # ITEMS iteration is static-trip-count trace Python;
+                # only a traced ARRAY as the iterable is a finding
+                if level(node.iter) is VAL:
                     f = mod.finding(
                         node, "jit/traced-branch",
                         f"Python loop over a traced value inside "
@@ -351,8 +551,7 @@ class JitDisciplineChecker(Checker):
                 self._check_sync(fd, node, expr_tainted, findings)
                 callee = self._resolve_call(fd, node)
                 if callee:
-                    callee_tainted = self._map_args(callee, node,
-                                                    expr_tainted)
+                    callee_tainted = self._map_args(callee, node, level)
                     if callee_tainted is not None:
                         out_calls.append((callee, callee_tainted))
         return out_calls
@@ -403,23 +602,28 @@ class JitDisciplineChecker(Checker):
         return None
 
     def _map_args(self, callee_qual: str, call: ast.Call,
-                  expr_tainted) -> Optional[Set[str]]:
-        """Taint callee params fed by tainted arguments (positional and
-        keyword); returns None when nothing tainted flows in."""
+                  level) -> Optional[Dict[str, str]]:
+        """Map argument taint LEVELS onto callee params (positional and
+        keyword) so an ITEMS container stays iterable in the callee;
+        returns None when nothing tainted flows in."""
         callee = self._defs[callee_qual]
         params = [a.arg for a in callee.node.args.args]
         if params and params[0] == "self":
             params = params[1:]
-        tainted: Set[str] = set()
+        tainted: Dict[str, str] = {}
         for i, arg in enumerate(call.args):
             if isinstance(arg, ast.Starred):
-                if expr_tainted(arg.value):
-                    tainted.update(params[i:])
+                lv = level(arg.value)
+                if lv:
+                    for p in params[i:]:
+                        tainted[p] = VAL
                 break
-            if i < len(params) and expr_tainted(arg):
-                tainted.add(params[i])
+            lv = level(arg)
+            if i < len(params) and lv:
+                tainted[params[i]] = lv
         for kw in call.keywords:
-            if kw.arg and kw.arg in [a.arg for a in callee.node.args.args] \
-                    and expr_tainted(kw.value):
-                tainted.add(kw.arg)
+            lv = level(kw.value)
+            if kw.arg and lv and \
+                    kw.arg in [a.arg for a in callee.node.args.args]:
+                tainted[kw.arg] = lv
         return tainted if tainted else None

@@ -135,8 +135,18 @@ class DFSClient:
             # any reader verifies with the right bpc
             bpc = self.conf.get_size_bytes(
                 "dfs.bytes-per-checksum", _dt.CHUNK_SIZE)
+            # Write-pipeline depth (STORAGE_BENCH showed writes at ~1/6
+            # of read throughput; the pipe per hop held ~1 packet):
+            # outstanding-ack window (ref: the reference's 80-packet
+            # dataQueue bound) + per-hop socket buffer sizing.
+            window = self.conf.get_int(
+                "dfs.client.write.max-packets-in-flight", 64)
+            sock_buf = self.conf.get_size_bytes(
+                "dfs.client.write.socket.buffer", 0)
             stream = DFSOutputStream(self, path, packet_size=pkt,
-                                     chunk_size=bpc)
+                                     chunk_size=bpc,
+                                     max_packets_in_flight=window,
+                                     socket_buffer=sock_buf)
         orig_close = stream.close
 
         def close_and_release():

@@ -87,10 +87,23 @@ class DFSClientFaultInjector:
 
 class DFSOutputStream:
     def __init__(self, client, path: str, packet_size: int = dt.PACKET_SIZE,
-                 chunk_size: int = dt.CHUNK_SIZE):
+                 chunk_size: int = dt.CHUNK_SIZE,
+                 max_packets_in_flight: int = 0,
+                 socket_buffer: int = 0):
         self.client = client
         self.path = path
         self.packet_size = packet_size
+        # Outstanding-ack window (ref: dfs.client-write-max-packets-in-
+        # flight / the reference's dataQueue+ackQueue bound of 80
+        # packets): how far the writer may run ahead of the LAST acked
+        # packet before blocking. 0 = unbounded (the block-recovery
+        # buffer already retains every packet of the open block, so the
+        # window bounds DN-side backlog and stall detection, not client
+        # memory). ``socket_buffer`` (dfs.client.write.socket.buffer)
+        # sizes the per-hop kernel pipe — the depth the wire itself
+        # holds; 0 keeps the transport default.
+        self.max_packets_in_flight = max_packets_in_flight
+        self.socket_buffer = socket_buffer
         self.checksum = DataChecksum(chunk_size)
         self._buf = bytearray()
         self._pos = 0          # bytes written overall
@@ -173,8 +186,10 @@ class DFSOutputStream:
             if self._block_size is None:
                 self._block_size = self.client.block_size_for(self.path)
             try:
-                self._pipeline = _Pipeline(block, locs, self.checksum,
-                                           token=lb.token)
+                self._pipeline = _Pipeline(
+                    block, locs, self.checksum, token=lb.token,
+                    window=self.max_packets_in_flight,
+                    socket_buffer=self.socket_buffer)
                 self._current = block
                 self._block_pos = 0
                 self._block_packets = []
@@ -280,18 +295,21 @@ class _Pipeline:
     ACK_TIMEOUT_S = 30.0
 
     def __init__(self, block: Block, locations: List[DatanodeInfo],
-                 checksum: DataChecksum, token=None):
+                 checksum: DataChecksum, token=None, window: int = 0,
+                 socket_buffer: int = 0):
         if not locations:
             raise PipelineError("no locations for block")
         DFSClientFaultInjector.get().before_pipeline_setup(locations)
         self.block = block
         self.locations = locations
+        self.window = window            # max unacked packets (0 = no cap)
         self._unacked: "queue.Queue[int]" = queue.Queue()
         self._acked_through = -1
         self._ack_cond = threading.Condition()
         self._error: Optional[Exception] = None
         try:
-            self.sock = dt.connect(locations[0].xfer_addr(), timeout=10.0)
+            self.sock = dt.connect(locations[0].xfer_addr(), timeout=10.0,
+                                   buffer_bytes=socket_buffer)
             dt.send_frame(self.sock, {
                 "op": dt.OP_WRITE_BLOCK, "b": block.to_wire(),
                 "targets": [t.to_wire() for t in locations[1:]],
@@ -338,9 +356,24 @@ class _Pipeline:
 
     def send(self, pkt: _Packet) -> None:
         DFSClientFaultInjector.get().before_send_packet(self.block, pkt.seq)
+        deadline = time.monotonic() + self.ACK_TIMEOUT_S
         with self._ack_cond:
             if self._error is not None:
                 raise self._error
+            # outstanding-ack window: run at most ``window`` packets
+            # ahead of the last ack — deep enough to keep every hop's
+            # pipe full, bounded so a wedged DN surfaces as a pipeline
+            # error here instead of an unbounded DN-side backlog
+            while self.window and pkt.seq - self._acked_through > \
+                    self.window:
+                if self._error is not None:
+                    raise self._error
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise PipelineError(
+                        f"ack window ({self.window} packets) stalled "
+                        f"for {self.ACK_TIMEOUT_S}s")
+                self._ack_cond.wait(remaining)
         self._last_seq = pkt.seq
         dt.send_frame(self.sock, pkt.to_frame())
 

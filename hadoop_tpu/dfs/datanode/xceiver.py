@@ -258,14 +258,16 @@ class DataXceiverServer:
 
         import struct as _struct
 
-        from hadoop_tpu.io.wire import read_frame, unpack
+        from hadoop_tpu.io.wire import read_frame_buffer, unpack
 
         ok = True
         try:
             while True:
-                # keep the raw frame: a mirror forwards it verbatim (no
-                # re-encode of the megabyte payload per hop)
-                raw = read_frame(up)
+                # keep the raw frame BUFFER: a mirror forwards it
+                # verbatim (no re-encode of the megabyte payload per
+                # hop), and receiving into a reusable buffer skips the
+                # immutable-bytes copy each hop used to pay
+                raw = read_frame_buffer(up)
                 pkt = unpack(raw)
                 if not isinstance(pkt, dict):
                     raise IOError("malformed packet frame")
@@ -294,7 +296,10 @@ class DataXceiverServer:
                 if down is not None:
                     with ack_lock:
                         my_status[pkt["seq"]] = status
-                    down.sendall(_struct.pack(">I", len(raw)) + raw)
+                    # two sends, zero copies: the old prefix+payload
+                    # concatenation copied the whole packet per hop
+                    down.sendall(_struct.pack(">I", len(raw)))
+                    down.sendall(raw)
                 else:
                     dt.send_frame(up, {"seq": pkt["seq"], "statuses": [status],
                                        "last": pkt.get("last", False)})

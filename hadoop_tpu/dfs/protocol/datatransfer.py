@@ -94,12 +94,17 @@ def default_security():
     return _default_security
 
 
-def connect(addr, timeout: float = 30.0, security=None):
+def connect(addr, timeout: float = 30.0, security=None,
+            buffer_bytes: int = 0):
     sock = socket.create_connection(addr, timeout=timeout)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-    # Throughput plane: fat buffers (≥ a few packets in flight per hop).
-    sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4 << 20)
-    sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4 << 20)
+    # Throughput plane: fat buffers (≥ a few packets in flight per hop);
+    # bulk writers can deepen the per-hop pipe with ``buffer_bytes``
+    # (dfs.client.write.socket.buffer — sized to
+    # packet_size × packets-in-flight on high-BDP paths).
+    buf = buffer_bytes if buffer_bytes > 0 else (4 << 20)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, buf)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, buf)
     sec = security if security is not None else _default_security
     if sec is not None:
         return sec.dial(sock)

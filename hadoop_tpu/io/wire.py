@@ -257,6 +257,21 @@ def write_frame(sock_or_file, payload: bytes) -> None:
 
 
 def read_exact(sock_or_file, n: int) -> bytes:
+    # recv_into a preallocated buffer: for megabyte data-plane frames
+    # the chunks+join form paid one extra full copy per frame per hop,
+    # all under the GIL — measurable on the DFS write pipeline where
+    # every packet crosses 2-3 hops in one process (benchmarks/dfsio).
+    recv_into = getattr(sock_or_file, "recv_into", None)
+    if recv_into is not None:
+        buf = bytearray(n)
+        view = memoryview(buf)
+        got = 0
+        while got < n:
+            m = recv_into(view[got:])
+            if not m:
+                raise EOFError(f"stream closed after {got}/{n} bytes")
+            got += m
+        return bytes(buf)
     chunks = []
     got = 0
     recv = getattr(sock_or_file, "recv", None)
@@ -274,3 +289,27 @@ def read_frame(sock_or_file, max_frame: int = MAX_FRAME) -> bytes:
     if n > max_frame:
         raise WireError(f"frame of {n} bytes exceeds limit {max_frame}")
     return read_exact(sock_or_file, n)
+
+
+def read_frame_buffer(sock_or_file, max_frame: int = MAX_FRAME
+                      ) -> bytearray:
+    """``read_frame`` without the final ``bytes()`` copy: returns the
+    receive buffer itself. For the data plane's forwarding hops
+    (xceiver store-and-forward), where the megabyte frame is unpacked
+    (the decoder accepts any buffer) and re-sent verbatim, the
+    immutable copy bought nothing but GIL time."""
+    (n,) = struct.unpack(">I", read_exact(sock_or_file, 4))
+    if n > max_frame:
+        raise WireError(f"frame of {n} bytes exceeds limit {max_frame}")
+    recv_into = getattr(sock_or_file, "recv_into", None)
+    if recv_into is None:
+        return bytearray(read_exact(sock_or_file, n))
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        m = recv_into(view[got:])
+        if not m:
+            raise EOFError(f"stream closed after {got}/{n} bytes")
+        got += m
+    return buf

@@ -57,6 +57,10 @@ class ParallelCtx:
     # K/V with ppermute; "ulysses" transposes seq<->head sharding with
     # one all_to_all pair (parallel/ulysses.py)
     sp_mode: str = "ring"
+    # row-parallel matmuls issue their tp reduction in this many chunks
+    # so the collective overlaps the matmul (ops/collective_matmul.py);
+    # 1 = the classic single whole-tensor psum/psum_scatter
+    tp_overlap_chunks: int = 1
 
     @property
     def seq_offset_fn(self):
@@ -174,40 +178,34 @@ def _attention_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
     else:
         attn = causal_attention(q, k, v)
 
-    out = attn.reshape(B, S, hq_local * cfg.head_dim) @ lp["wo"]
-    if ctx.tp_axis is not None:
-        if ctx.megatron_sp:  # reduce + re-scatter the sequence in one op
-            out = jax.lax.psum_scatter(out, ctx.tp_axis,
-                                       scatter_dimension=1, tiled=True)
-        else:
-            out = jax.lax.psum(out, ctx.tp_axis)
+    from hadoop_tpu.ops.collective_matmul import row_parallel_project
+    out = row_parallel_project(
+        attn.reshape(B, S, hq_local * cfg.head_dim), lp["wo"], ctx)
     return resid + out.astype(resid.dtype)
 
 
 # -------------------------------------------------------------------- mlp
 
-def _dense_mlp(h, lp, cfg: ModelConfig):
-    if cfg.use_swiglu:
-        return swiglu(h @ lp["w_gate"], h @ lp["w_up"]) @ lp["w_down"]
-    return gelu(h @ lp["w_in"] + lp["b_in"]) @ lp["w_out"] + lp["b_out"]
-
-
 def _mlp_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx):
+    from hadoop_tpu.ops.collective_matmul import (reduce_row_parallel,
+                                                  row_parallel_project)
     resid = x
     h = _norm(x, lp["mlp_norm_w"], lp.get("mlp_norm_b"), cfg)
     if ctx.megatron_sp:
         h = jax.lax.all_gather(h, ctx.tp_axis, axis=1, tiled=True)
     if cfg.is_moe:
+        # the expert matmuls stay whole inside the dispatch; the final
+        # row-parallel reduce visible here chunks like every other
+        # (reduce-only chunking is bit-exact in both directions)
         from hadoop_tpu.models.moe import moe_mlp
-        out = moe_mlp(h, lp, cfg, ctx)
+        out = reduce_row_parallel(moe_mlp(h, lp, cfg, ctx), ctx)
+    elif cfg.use_swiglu:
+        out = row_parallel_project(
+            swiglu(h @ lp["w_gate"], h @ lp["w_up"]), lp["w_down"], ctx)
     else:
-        out = _dense_mlp(h, lp, cfg)
-    if ctx.tp_axis is not None:
-        if ctx.megatron_sp:
-            out = jax.lax.psum_scatter(out, ctx.tp_axis,
-                                       scatter_dimension=1, tiled=True)
-        else:
-            out = jax.lax.psum(out, ctx.tp_axis)
+        out = row_parallel_project(
+            gelu(h @ lp["w_in"] + lp["b_in"]), lp["w_out"], ctx,
+            bias=lp["b_out"])
     return resid + out.astype(resid.dtype)
 
 

@@ -1,0 +1,87 @@
+"""Chunked row-parallel reduce: the tp collective decomposed for overlap.
+
+The row-parallel matmuls (attention out-projection, MLP down-projection)
+end in a psum over ``tp`` — and nothing downstream can start until that
+whole-tensor collective lands, so the ICI sits idle during the matmul
+and the MXU sits idle during the psum. Flash Communication
+(arxiv 2412.04964) breaks the serialization by chunking the exchange:
+the reduction is issued as C independent chunked collectives along a
+non-contraction dimension, so the first chunk's result is available
+while later chunks are still in flight and XLA's async-collective
+scheduler pipelines them with the neighbouring compute (the residual
+add, the next block's norm/matmul — and, in the backward, the
+per-chunk gather transposes against the weight-gradient matmuls).
+
+Why the MATMUL stays whole: chunking the forward product is value-exact,
+but its autodiff transpose accumulates the weight gradient as a sum of
+per-chunk contractions — a reassociation that moves the loss by an ulp
+and breaks the bit-exact parity contract this pass is built on
+(measured on the CPU mesh). Chunking only the collective keeps every
+matmul, scatter and add in the exact shape/order of the unchunked
+graph in BOTH directions:
+
+- forward: ``slice_c(y)`` chunks are disjoint rows of the same product;
+  each element rides exactly one psum/psum_scatter over the same ranks.
+- backward: transpose of the chunked concat/slice is a disjoint scatter
+  (exact), and the weight/input gradients remain single whole matmuls.
+
+Composition with Megatron sequence parallelism: ``psum_scatter``
+scatters the SEQUENCE dimension, so under sp the chunks ride the batch
+dimension (each batch chunk's seq scatter is a sub-block of the full
+one); plain tp chunks the sequence.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _largest_divisor(n: int, want: int) -> int:
+    for d in range(min(want, n), 0, -1):
+        if n % d == 0:
+            return d
+    return 1
+
+
+def reduce_row_parallel(y, ctx):
+    """The row-parallel reduce — psum, or psum_scatter(seq) under
+    megatron_sp — issued in ``ctx.tp_overlap_chunks`` chunks along a
+    non-contraction dim. Identity when tp is absent; one whole-tensor
+    collective when chunking is off (the classic form)."""
+    if ctx.tp_axis is None:
+        return y
+
+    def reduce_one(t):
+        if ctx.megatron_sp:
+            return jax.lax.psum_scatter(t, ctx.tp_axis,
+                                        scatter_dimension=1, tiled=True)
+        return jax.lax.psum(t, ctx.tp_axis)
+
+    n_chunks = getattr(ctx, "tp_overlap_chunks", 1)
+    # megatron_sp scatters dim 1 (sequence) — chunk dim 0 (batch) so
+    # each chunk's scatter is a sub-block of the full scatter; plain tp
+    # chunks the bigger sequence dim.
+    axis = 0 if ctx.megatron_sp else 1
+    c = _largest_divisor(y.shape[axis], n_chunks) if n_chunks > 1 else 1
+    if c <= 1:
+        return reduce_one(y)
+    step = y.shape[axis] // c
+    outs = []
+    for i in range(c):
+        outs.append(reduce_one(
+            jax.lax.dynamic_slice_in_dim(y, i * step, step, axis=axis)))
+    return jnp.concatenate(outs, axis=axis)
+
+
+def row_parallel_project(x, w, ctx, bias: Optional[jax.Array] = None):
+    """``reduce_row_parallel(x @ w + bias)`` — the shared shape of the
+    attention out-projection and MLP down-projection. ``bias``
+    (replicated) is added to the PARTIAL product exactly like the
+    unchunked code paths did, preserving their numerics verbatim."""
+    y = x @ w
+    if bias is not None:
+        y = y + bias
+    return reduce_row_parallel(y, ctx)

@@ -28,7 +28,9 @@ that saved is free (resharding happens at placement).
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List, Optional, Tuple
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -36,38 +38,30 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from hadoop_tpu.fs import FileSystem
 
+log = logging.getLogger(__name__)
+
 
 def _leaf_paths(tree) -> List[Tuple[str, Any]]:
     flat = jax.tree_util.tree_leaves_with_path(tree)
     return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
 
 
-def save_checkpoint(fs: FileSystem, base_dir: str, step: int, tree,
-                    *, keep: int = 3) -> str:
-    """Write one checkpoint of ``tree`` (any pytree of jax/np arrays).
+def snapshot_tree(tree) -> List[Dict[str, Any]]:
+    """Device→host snapshot of ``tree``: per leaf, its dtype/shape and
+    the OWNED copies of its unique device shards (replicas deduped).
 
-    Returns the final checkpoint directory. Retains the newest ``keep``
-    checkpoints (ref intent: FSImage's NNStorageRetentionManager keeps a
-    bounded number of images).
-
-    Publish protocol: shards are written straight into the final
-    directory and the manifest goes LAST — its presence is the
-    completeness marker list_checkpoints keys on. No rename: on an
-    object store a directory rename is a lexicographic copy loop that
-    lands ``manifest.json`` before the shards, so a crash mid-rename
-    used to publish a manifest-complete checkpoint with missing shard
-    files. A crash mid-write now leaves a manifest-less directory that
-    readers never see and the next save's retention sweep removes."""
-    final_dir = f"{base_dir}/step_{step:012d}"
-    tmp_dir = final_dir
-    fs.delete(final_dir, recursive=True)
-    fs.mkdirs(tmp_dir)
-
-    manifest: Dict[str, Any] = {"step": step, "leaves": {}, "shards": []}
-    shard_idx = 0
+    This is the only part of a save that must happen synchronously —
+    once it returns, the live arrays may be updated or donated freely
+    while a background writer streams the copies out (the split behind
+    ``Trainer``'s async checkpointing). Copies, not views: ``np.asarray``
+    of a CPU-backed jax array can alias the device buffer, and an
+    aliased snapshot would race the steps that keep training.
+    """
+    snap: List[Dict[str, Any]] = []
     for name, leaf in _leaf_paths(tree):
         arr = leaf
         entry: Dict[str, Any] = {
+            "name": name,
             "dtype": str(np.dtype(arr.dtype)),
             "shape": list(np.shape(arr)),
             "shards": [],
@@ -80,24 +74,153 @@ def save_checkpoint(fs: FileSystem, base_dir: str, step: int, tree,
                 if key in seen:
                     continue  # replicated copy
                 seen.add(key)
-                fname = f"shard_{shard_idx:06d}.bin"
-                shard_idx += 1
-                fs.write_all(f"{tmp_dir}/{fname}",
-                             np.asarray(sh.data).tobytes())
-                entry["shards"].append({"file": fname,
-                                        "index": [list(k) for k in key]})
+                entry["shards"].append(
+                    ([list(k) for k in key],
+                     np.array(sh.data, copy=True)))
         else:
+            entry["shards"].append(
+                ([[0, d] for d in np.shape(arr)],
+                 np.array(arr, copy=True)))
+        snap.append(entry)
+    return snap
+
+
+def write_snapshot(fs: FileSystem, base_dir: str, step: int,
+                   snap: List[Dict[str, Any]], *, keep: int = 3) -> str:
+    """Write a host snapshot as one checkpoint (see snapshot_tree).
+
+    Publish protocol: shards are written straight into the final
+    directory and the manifest goes LAST — its presence is the
+    completeness marker list_checkpoints keys on. No rename: on an
+    object store a directory rename is a lexicographic copy loop that
+    lands ``manifest.json`` before the shards, so a crash mid-rename
+    used to publish a manifest-complete checkpoint with missing shard
+    files. A crash (or writer death) mid-write leaves a manifest-less
+    directory that readers never see and the next save's retention
+    sweep removes — which is exactly what makes the write safe to run
+    on a background thread."""
+    final_dir = f"{base_dir}/step_{step:012d}"
+    fs.delete(final_dir, recursive=True)
+    fs.mkdirs(final_dir)
+
+    manifest: Dict[str, Any] = {"step": step, "leaves": {}, "shards": []}
+    shard_idx = 0
+    for entry in snap:
+        mentry: Dict[str, Any] = {
+            "dtype": entry["dtype"],
+            "shape": entry["shape"],
+            "shards": [],
+        }
+        for index, data in entry["shards"]:
             fname = f"shard_{shard_idx:06d}.bin"
             shard_idx += 1
-            fs.write_all(f"{tmp_dir}/{fname}", np.asarray(arr).tobytes())
-            entry["shards"].append({
-                "file": fname,
-                "index": [[0, d] for d in np.shape(arr)]})
-        manifest["leaves"][name] = entry
-    fs.write_all(f"{tmp_dir}/manifest.json",
+            fs.write_all(f"{final_dir}/{fname}", data.tobytes())
+            mentry["shards"].append({"file": fname, "index": index})
+        manifest["leaves"][entry["name"]] = mentry
+    fs.write_all(f"{final_dir}/manifest.json",
                  json.dumps(manifest).encode())
     _retain(fs, base_dir, keep)
     return final_dir
+
+
+def assemble_snapshot_leaf(entry: Dict[str, Any]) -> np.ndarray:
+    """One snapshot entry's full host array, reassembled from shards."""
+    out = np.empty(tuple(entry["shape"]), np.dtype(entry["dtype"]))
+    for index, data in entry["shards"]:
+        out[tuple(slice(a, b) for a, b in index)] = data
+    return out
+
+
+def reorder_snapshot_axis0(snap: List[Dict[str, Any]], perm,
+                           match: Callable[[str], bool]
+                           ) -> List[Dict[str, Any]]:
+    """Apply ``take(perm, axis=0)`` to every snapshot entry whose name
+    ``match``es — on HOST arrays, so the device never materializes the
+    permuted copy (the vpp logical-reorder moved off the step path).
+    A permuted axis no longer aligns with the device shard grid, so the
+    affected entries collapse to one full-array shard; load_checkpoint
+    reshards at placement either way."""
+    perm = np.asarray(perm)
+    out = []
+    for entry in snap:
+        if not match(entry["name"]) or len(entry["shape"]) == 0:
+            out.append(entry)
+            continue
+        full = np.take(assemble_snapshot_leaf(entry), perm, axis=0)
+        out.append({
+            "name": entry["name"], "dtype": entry["dtype"],
+            "shape": entry["shape"],
+            "shards": [([[0, d] for d in entry["shape"]], full)],
+        })
+    return out
+
+
+def save_checkpoint(fs: FileSystem, base_dir: str, step: int, tree,
+                    *, keep: int = 3) -> str:
+    """Write one checkpoint of ``tree`` (any pytree of jax/np arrays),
+    synchronously: snapshot_tree + write_snapshot. Returns the final
+    checkpoint directory. Retains the newest ``keep`` checkpoints (ref
+    intent: FSImage's NNStorageRetentionManager keeps a bounded number
+    of images)."""
+    return write_snapshot(fs, base_dir, step, snapshot_tree(tree),
+                          keep=keep)
+
+
+class AsyncCheckpointWriter:
+    """One background writer thread, at most one write in flight.
+
+    ``submit`` fences the previous write (so checkpoints always land in
+    order and a slow DFS can never pile up host snapshots), then hands
+    the job to a fresh daemon thread. A failed write surfaces at the
+    NEXT fence — ``wait()``, the next ``submit``, or close — never
+    silently: the job that failed left a manifest-less directory, so
+    the previous complete checkpoint still wins (see write_snapshot).
+    """
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._lock = threading.Lock()
+
+    def submit(self, fn: Callable[[], Any]) -> None:
+        """Fence the previous write, then run ``fn`` in the background."""
+        self.wait()
+
+        def run():
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 — deferred to wait()
+                log.warning("async checkpoint write failed: %s", e)
+                with self._lock:
+                    self._error = e
+
+        t = threading.Thread(target=run, daemon=True, name="ckpt-writer")
+        with self._lock:
+            self._thread = t
+        t.start()
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Block until the in-flight write (if any) finishes; re-raise
+        its error exactly once."""
+        with self._lock:
+            t = self._thread
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
+                raise TimeoutError("checkpoint write still in flight")
+            with self._lock:
+                if self._thread is t:
+                    self._thread = None
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    @property
+    def in_flight(self) -> bool:
+        with self._lock:
+            t = self._thread
+        return t is not None and t.is_alive()
 
 
 def _norm_index(index, shape):

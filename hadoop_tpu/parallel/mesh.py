@@ -68,7 +68,8 @@ class MeshPlan:
             axes.append("ep")
         return tuple(axes)
 
-    def ctx(self, cfg: ModelConfig) -> ParallelCtx:
+    def ctx(self, cfg: ModelConfig,
+            tp_overlap_chunks: int = 1) -> ParallelCtx:
         return ParallelCtx(
             tp_axis="tp" if self.tp > 1 else None,
             tp_size=self.tp,
@@ -78,6 +79,7 @@ class MeshPlan:
             ring_axis="sp" if self.sp > 1 else None,
             ring_size=self.sp,
             sp_mode=self.sp_mode,
+            tp_overlap_chunks=tp_overlap_chunks if self.tp > 1 else 1,
         )
 
     def validate(self, cfg: ModelConfig, batch: int, seq: int,

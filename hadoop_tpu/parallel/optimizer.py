@@ -117,12 +117,21 @@ def zero1_update(params, grads, state: AdamWState, lr: float, *,
                  leaf_axes, mesh_axis_sizes: Dict[str, int],
                  b1: float = 0.9, b2: float = 0.95, eps: float = 1e-8,
                  weight_decay: float = 0.1, grad_clip: float = 1.0,
-                 gsq=None):
+                 gsq=None, grads_sliced: bool = False,
+                 gather_bucket_bytes: int = 0):
     """ZeRO-1 AdamW step (inside shard_map). ``leaf_axes``: pytree like
     params whose leaves are the tuple of data axes partitioning that
     leaf's state (see zero1_leaf_plan). State mu/nu leaves are the local
     (K,) slices. Ref intent: Megatron's DistributedOptimizer — param
-    update computed on 1/Z of each replicated leaf, then gathered."""
+    update computed on 1/Z of each replicated leaf, then gathered.
+
+    ``grads_sliced``: the grad leaves are already this rank's reduced
+    (K,) slices (the overlap pass reduce-scatters them straight into
+    the state layout — parallel/overlap.py); the clip scale still
+    applies here. ``gather_bucket_bytes`` > 0 reassembles the updated
+    params through bucketed psum-of-scatters (one collective per
+    bucket, bitwise identical to the per-leaf form) instead of one
+    collective per leaf."""
     count = state.count + 1
     cf = count.astype(jnp.float32)
     gnorm = jnp.sqrt(gsq)
@@ -130,25 +139,31 @@ def zero1_update(params, grads, state: AdamWState, lr: float, *,
     bc1 = 1.0 - b1 ** cf
     bc2 = 1.0 - b2 ** cf
 
-    def leaf(p, g, m, n, axes):
-        z = 1
-        for a in axes:
-            z *= mesh_axis_sizes.get(a, 1)
+    # the slice layout (Z, K, rank index) has ONE definition, shared
+    # with the overlap pass's reduce-scatter/gather so the layouts can
+    # never silently fork (parallel/overlap.py)
+    from hadoop_tpu.parallel.overlap import (zero1_slice_index,
+                                             zero1_slice_meta)
+
+    def leaf_slice(p, g, m, n, axes):
+        """(new_slice, m2, n2) for this rank's (K,) piece of one leaf."""
+        z, k = zero1_slice_meta(p, axes, mesh_axis_sizes)
         flat = p.reshape(-1)
-        gflat = g.reshape(-1).astype(jnp.float32) * scale
-        k = _pad_len(flat.size, z)
         if z == 1:
             idx = jnp.zeros((), jnp.int32)
         else:
-            idx = jnp.zeros((), jnp.int32)
-            for a in axes:  # row-major over the leaf's data axes
-                idx = idx * mesh_axis_sizes[a] + jax.lax.axis_index(a)
+            idx = zero1_slice_index(axes, mesh_axis_sizes)
         pad = z * k - flat.size
         if pad:
             flat = jnp.pad(flat, (0, pad))
-            gflat = jnp.pad(gflat, (0, pad))
         pslice = jax.lax.dynamic_slice(flat, (idx * k,), (k,))
-        gslice = jax.lax.dynamic_slice(gflat, (idx * k,), (k,))
+        if grads_sliced:
+            gslice = g.astype(jnp.float32) * scale
+        else:
+            gflat = g.reshape(-1).astype(jnp.float32) * scale
+            if pad:
+                gflat = jnp.pad(gflat, (0, pad))
+            gslice = jax.lax.dynamic_slice(gflat, (idx * k,), (k,))
         m2 = b1 * m + (1 - b1) * gslice
         n2 = b2 * n + (1 - b2) * jnp.square(gslice)
         update = (m2 / bc1) / (jnp.sqrt(n2 / bc2) + eps)
@@ -156,27 +171,37 @@ def zero1_update(params, grads, state: AdamWState, lr: float, *,
             update = update + weight_decay * pslice.astype(jnp.float32)
         new_slice = (pslice.astype(jnp.float32) - lr * update).astype(
             p.dtype)
+        return new_slice, m2, n2, z, k, idx
+
+    def gather_leaf(p, new_slice, z, k, idx, axes):
         if z == 1:
-            newp = new_slice
-        else:
-            # gather expressed as psum of disjoint scatters: numerically
-            # identical to all_gather(tiled) over the slice layout, and
-            # provably replication-invariant under shard_map's vma
-            # checking (all_gather's output can't be statically shown
-            # invariant; a psum's can).
-            full = jnp.zeros((z * k,), new_slice.dtype)
-            full = jax.lax.dynamic_update_slice(full, new_slice, (idx * k,))
-            newp = jax.lax.psum(full, axes)
-        return newp[:p.size].reshape(p.shape), m2, n2
+            return new_slice[:p.size].reshape(p.shape)
+        # gather expressed as psum of disjoint scatters: numerically
+        # identical to all_gather(tiled) over the slice layout, and
+        # provably replication-invariant under shard_map's vma
+        # checking (all_gather's output can't be statically shown
+        # invariant; a psum's can).
+        full = jnp.zeros((z * k,), new_slice.dtype)
+        full = jax.lax.dynamic_update_slice(full, new_slice, (idx * k,))
+        full = jax.lax.psum(full, axes)
+        return full[:p.size].reshape(p.shape)
 
     flat_p, treedef = jax.tree_util.tree_flatten(params)
     flat_g = treedef.flatten_up_to(grads)
     flat_m = treedef.flatten_up_to(state.mu)
     flat_n = treedef.flatten_up_to(state.nu)
     flat_a = treedef.flatten_up_to(leaf_axes)
-    out = [leaf(p, g, m, n, a) for p, g, m, n, a in
+    out = [leaf_slice(p, g, m, n, a) for p, g, m, n, a in
            zip(flat_p, flat_g, flat_m, flat_n, flat_a)]
-    new_p = treedef.unflatten([o[0] for o in out])
     new_m = treedef.unflatten([o[1] for o in out])
     new_n = treedef.unflatten([o[2] for o in out])
+    if gather_bucket_bytes > 0:
+        from hadoop_tpu.parallel.overlap import bucketed_gather_slices
+        new_p = bucketed_gather_slices(
+            treedef.unflatten([o[0] for o in out]), params, leaf_axes,
+            mesh_axis_sizes, gather_bucket_bytes)
+    else:
+        new_p = treedef.unflatten([
+            gather_leaf(p, o[0], o[3], o[4], o[5], a)
+            for p, o, a in zip(flat_p, out, flat_a)])
     return new_p, AdamWState(count, new_m, new_n), gnorm

@@ -1,0 +1,318 @@
+"""Communication overlap: bucketed gradient collectives + chunked TP reduce.
+
+What serializes a multichip step once the compute itself is clean
+(PROFILE.md round 5) is the communication that waits for it: the manual
+pipeline schedules psum the whole gradient tree in one burst at the end
+of backward, the ZeRO-1 optimizer reassembles parameters with one
+collective per leaf, and every row-parallel matmul stalls on its psum
+before the residual add can proceed. T3 (arxiv 2401.16677) and Flash
+Communication (arxiv 2412.04964) both recover this by decomposing the
+collectives so XLA's async-collective scheduler can run them beside the
+remaining compute. This module is that decomposition, shaped for
+bit-exact parity:
+
+- **Bucketed reduction** (``bucketed_psum``): leaves are grouped by
+  (reduce-axes, dtype) signature and packed — in deterministic tree
+  flatten order — into buckets of at most ``bucket_bytes``; each bucket
+  is one flattened psum. Per element the same ranks' values are summed
+  by the same collective, so results are bitwise identical to the
+  per-leaf form; what changes is the schedule: many small dependent
+  collectives become few large independent ones XLA can overlap with
+  the optimizer math that only consumes other buckets.
+- **Scattered reduction** (``bucketed_psum_scatter``): the ZeRO-1 form.
+  A rank about to update only its 1/Z slice never needs the other
+  ranks' elements, so the bucket is reduce-scattered instead of
+  all-reduced — half the traffic of psum + local slice. The slice
+  VALUES are bitwise identical to psum-then-slice (verified on the CPU
+  mesh); only the global grad-norm, now accumulated slice-wise, can
+  differ in the last ulp (see make_train_step's zero1 notes).
+- **Chunked TP collective-matmul** lives in
+  :mod:`hadoop_tpu.ops.collective_matmul` and is driven by
+  ``ParallelCtx.tp_overlap_chunks``.
+
+Conf knobs (all ``parallel.overlap.*``; read by :func:`overlap_from_conf`):
+
+  parallel.overlap.enabled              default true
+  parallel.overlap.bucket.mb            default 4
+  parallel.overlap.tp.chunks            default 4
+  parallel.overlap.zero1.reduce-scatter default true
+  parallel.ckpt.async                   default true (parallel/trainer.py)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from hadoop_tpu.ops.vma import vma_of
+
+
+def _vma_key(x) -> Tuple[str, ...]:
+    """vma as a deterministic tuple. Bucket groups are keyed on it so a
+    bucket only ever concatenates same-vma leaves: mixing would force a
+    pvary up-cast, and a value CLAIMING to vary on an axis it is really
+    invariant over turns any later psum over that axis into an
+    over-count."""
+    return tuple(sorted(vma_of(x)))
+
+
+@dataclasses.dataclass(frozen=True)
+class OverlapConfig:
+    """Static overlap knobs, fixed at train-step build time."""
+    enabled: bool = True
+    bucket_mb: int = 4
+    tp_chunks: int = 4
+    zero1_reduce_scatter: bool = True
+
+    @property
+    def bucket_bytes(self) -> int:
+        return max(1, self.bucket_mb) * (1 << 20)
+
+
+DEFAULT_OVERLAP = OverlapConfig()
+OVERLAP_OFF = OverlapConfig(enabled=False)
+
+
+def overlap_from_conf(conf) -> OverlapConfig:
+    """Build an OverlapConfig from a Configuration (defaults above)."""
+    if conf is None:
+        return DEFAULT_OVERLAP
+    return OverlapConfig(
+        enabled=conf.get_bool("parallel.overlap.enabled", True),
+        bucket_mb=conf.get_int("parallel.overlap.bucket.mb", 4),
+        tp_chunks=conf.get_int("parallel.overlap.tp.chunks", 4),
+        zero1_reduce_scatter=conf.get_bool(
+            "parallel.overlap.zero1.reduce-scatter", True))
+
+
+# ---------------------------------------------------------------- bucketing
+
+def _pack_buckets(sizes: Sequence[int], itemsize: int,  # lint: static-fn
+                  bucket_bytes: int) -> List[List[int]]:
+    """Greedy in-order packing of leaf positions into buckets.
+
+    Deterministic: order is the caller's (tree flatten) order, a leaf
+    larger than ``bucket_bytes`` gets its own bucket. Returns lists of
+    indices into the caller's sequence."""
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    for i, n in enumerate(sizes):
+        nb = n * itemsize
+        if cur and cur_bytes + nb > bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nb
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def bucketed_psum(tree, reduce_axes_tree, bucket_bytes: int):
+    """psum every leaf over its reduce axes, packing same-signature
+    leaves into flattened buckets of at most ``bucket_bytes`` each.
+
+    ``reduce_axes_tree``: pytree like ``tree`` whose leaves are tuples of
+    mesh axis names to reduce over (empty tuple = leaf passes through).
+    Bitwise identical to the per-leaf form — concatenation changes
+    which collective an element rides in, never which values it sums.
+    """
+    flat, treedef = jax.tree_util.tree_flatten(tree)
+    axes_flat = treedef.flatten_up_to(reduce_axes_tree)
+    out: List[Any] = list(flat)
+
+    # group leaf positions by (axes, dtype, vma), preserving first-seen
+    # order
+    groups: Dict[Any, List[int]] = {}
+    order: List[Any] = []
+    for i, (g, axes) in enumerate(zip(flat, axes_flat)):
+        axes = tuple(axes)
+        if not axes:
+            continue
+        key = (axes, jnp.dtype(g.dtype), _vma_key(g))
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(i)
+
+    for key in order:
+        axes, dtype, _ = key
+        idxs = groups[key]
+        for bucket in _pack_buckets([flat[i].size for i in idxs],
+                                    dtype.itemsize, bucket_bytes):
+            members = [idxs[j] for j in bucket]
+            if len(members) == 1:
+                i = members[0]
+                out[i] = jax.lax.psum(flat[i], axes)
+                continue
+            buf = jnp.concatenate([flat[i].reshape(-1) for i in members])
+            buf = jax.lax.psum(buf, axes)
+            off = 0
+            for i in members:
+                n = flat[i].size
+                out[i] = buf[off:off + n].reshape(flat[i].shape)
+                off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# --------------------------------------------------------- ZeRO-1 scatter
+
+def _axes_product(axes: Sequence[str],  # lint: static-fn
+                  mesh_axis_sizes: Dict[str, int]) -> int:
+    z = 1
+    for a in axes:
+        z *= mesh_axis_sizes.get(a, 1)
+    return z
+
+
+def zero1_slice_meta(leaf, axes: Sequence[str],  # lint: static-fn
+                     mesh_axis_sizes: Dict[str, int]) -> Tuple[int, int]:
+    """(Z, K) for one leaf's ZeRO-1 slice layout: the leaf's flattened
+    size padded to Z*K, Z = product of its partitioning data axes.
+    THE slice-layout definition — the optimizer's update/gather and
+    this module's scatter/gather all import it so the layout cannot
+    silently fork."""
+    z = _axes_product(axes, mesh_axis_sizes)
+    k = (leaf.size + z - 1) // z
+    return z, k
+
+
+def zero1_slice_index(axes: Sequence[str],
+                      mesh_axis_sizes: Dict[str, int]):
+    """This rank's slice position: mixed-radix (row-major) over the
+    leaf's partitioning data axes — the companion of zero1_slice_meta,
+    shared for the same single-definition reason."""
+    idx = jnp.zeros((), jnp.int32)
+    for a in axes:
+        idx = idx * mesh_axis_sizes[a] + jax.lax.axis_index(a)
+    return idx
+
+
+def bucketed_psum_scatter(tree, reduce_axes_tree, scatter_axes_tree,
+                          mesh_axis_sizes: Dict[str, int],
+                          bucket_bytes: int):
+    """Reduce each leaf over its reduce axes AND hand back only this
+    rank's ZeRO-1 slice: ``psum`` over the non-scatter axes composed with
+    a ``psum_scatter`` over the (single) scatter axis, bucketed.
+
+    Returns a pytree of ``(K,)`` slices in the zero1_layout order. Falls
+    back to psum + local dynamic_slice for leaves partitioned over more
+    than one data axis (the multi-axis scatter layout does not match a
+    single tiled reduce-scatter) and for unpartitioned leaves (Z == 1,
+    full psum, slice is the whole leaf).
+    """
+    flat, treedef = jax.tree_util.tree_flatten(tree)
+    red_flat = treedef.flatten_up_to(reduce_axes_tree)
+    sc_flat = treedef.flatten_up_to(scatter_axes_tree)
+    out: List[Any] = [None] * len(flat)
+
+    def _pad_flat(g, z, k):
+        gf = g.reshape(-1)
+        pad = z * k - gf.size
+        return jnp.pad(gf, (0, pad)) if pad else gf
+
+    # scatter-eligible: exactly one partitioning axis of size > 1
+    groups: Dict[Any, List[int]] = {}
+    order: List[Any] = []
+    for i, (g, red0, sc0) in enumerate(zip(flat, red_flat, sc_flat)):
+        red = tuple(red0)
+        sc = tuple(a for a in sc0 if mesh_axis_sizes.get(a, 1) > 1)
+        if len(sc) != 1 or sc[0] not in red:
+            # fallback: full (possibly bucketed later by caller) psum,
+            # then the local slice of the zero1 layout
+            z, k = zero1_slice_meta(g, sc, mesh_axis_sizes)
+            full = jax.lax.psum(g, red) if red else g
+            if z == 1:
+                out[i] = _pad_flat(full, 1, k)
+            else:
+                idx = zero1_slice_index(sc, mesh_axis_sizes)
+                out[i] = jax.lax.dynamic_slice(
+                    _pad_flat(full, z, k), (idx * k,), (k,))
+            continue
+        rest = tuple(a for a in red if a != sc[0])
+        key = (rest, sc[0], jnp.dtype(g.dtype), _vma_key(g))
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(i)
+
+    for key in order:
+        rest, sc_axis, dtype, _ = key
+        idxs = groups[key]
+        z = mesh_axis_sizes[sc_axis]
+        ks = [zero1_slice_meta(flat[i], (sc_axis,), mesh_axis_sizes)[1]
+              for i in idxs]
+        for bucket in _pack_buckets(ks, dtype.itemsize * z, bucket_bytes):
+            members = [(idxs[j], ks[j]) for j in bucket]
+            # [Z, K_total]: row r carries rank r's slices, concatenated
+            buf = jnp.concatenate(
+                [_pad_flat(flat[i], z, k).reshape(z, k)
+                 for i, k in members], axis=1)
+            if rest:
+                buf = jax.lax.psum(buf, rest)
+            sl = jax.lax.psum_scatter(buf, sc_axis, scatter_dimension=0,
+                                      tiled=True).reshape(-1)
+            off = 0
+            for i, k in members:
+                out[i] = sl[off:off + k]
+                off += k
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def bucketed_gather_slices(slices, params_like, leaf_axes,
+                           mesh_axis_sizes: Dict[str, int],
+                           bucket_bytes: int):
+    """Reassemble full leaves from per-rank ZeRO-1 slices with bucketed
+    psum-of-disjoint-scatters (the vma-provable all_gather; see
+    optimizer.zero1_update). One collective per bucket instead of one
+    per leaf; bitwise identical — each element is still the psum of one
+    rank's scatter against zeros.
+
+    ``slices``: pytree of (K,) updated slices; ``params_like``: pytree of
+    the full leaves (shape/dtype targets); ``leaf_axes``: the data axes
+    partitioning each leaf. Leaves with Z == 1 pass through reshaped.
+    """
+    flat_s, treedef = jax.tree_util.tree_flatten(slices)
+    flat_p = treedef.flatten_up_to(params_like)
+    flat_a = treedef.flatten_up_to(leaf_axes)
+    out: List[Any] = [None] * len(flat_s)
+
+    groups: Dict[Any, List[int]] = {}
+    order: List[Any] = []
+    for i, (sl, p, axes0) in enumerate(zip(flat_s, flat_p, flat_a)):
+        axes = tuple(a for a in axes0 if mesh_axis_sizes.get(a, 1) > 1)
+        if not axes:
+            out[i] = sl[:flat_p[i].size].reshape(flat_p[i].shape)
+            continue
+        key = (axes, jnp.dtype(sl.dtype), _vma_key(sl))
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(i)
+
+    for key in order:
+        axes, dtype, _ = key
+        idxs = groups[key]
+        z = _axes_product(axes, mesh_axis_sizes)
+        idx = zero1_slice_index(axes, mesh_axis_sizes)
+        ks = [flat_s[i].shape[0] for i in idxs]
+        for bucket in _pack_buckets(ks, dtype.itemsize * z, bucket_bytes):
+            members = [(idxs[j], ks[j]) for j in bucket]
+            k_total = sum(k for _, k in members)
+            row = jnp.concatenate([flat_s[i] for i, _ in members])
+            buf = jnp.zeros((z, k_total), row.dtype)
+            buf = jax.lax.dynamic_update_slice(
+                buf, row[None, :], (idx, jnp.zeros((), jnp.int32)))
+            buf = jax.lax.psum(buf, axes)
+            off = 0
+            for i, k in members:
+                p = flat_p[i]
+                # [Z, k] block, rows = rank slices → flatten row-major
+                full = buf[:, off:off + k].reshape(-1)
+                out[i] = full[:p.size].reshape(p.shape)
+                off += k
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -22,7 +22,7 @@ sharded there and its gradient is already local-complete.
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +39,9 @@ from hadoop_tpu.parallel.mesh import AXES, MeshPlan, param_specs, \
     shard_params
 from hadoop_tpu.parallel.optimizer import (AdamWState, adamw_init,
                                            adamw_update, zero1_update)
+from hadoop_tpu.parallel.overlap import (DEFAULT_OVERLAP, OverlapConfig,
+                                         bucketed_psum,
+                                         bucketed_psum_scatter)
 
 try:  # stable name first, experimental fallback
     _shard_map_fn = jax.shard_map  # type: ignore[attr-defined]
@@ -136,7 +139,8 @@ def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
                     lr: float = 3e-4, n_microbatches: int = 1,
                     remat: bool = False, donate: bool = True,
                     optimizer: str = "adamw", zero1: bool = False,
-                    pipeline_schedule: str = "1f1b"):
+                    pipeline_schedule: str = "1f1b",
+                    overlap: Optional[OverlapConfig] = None):
     """Build the jitted sharded train step.
 
     Returns fn(params, opt_state, tokens, targets) ->
@@ -148,8 +152,19 @@ def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
     activation memory (parallel.pipeline); "gpipe" — all-forwards scan
     with autodiff-generated backwards (activation liveness grows with
     n_microbatches).
+
+    ``overlap`` (default ON, parallel.overlap.* conf): communication
+    overlap — chunked row-parallel tp collectives, bucketed manual-
+    schedule gradient reduction (reduce-scattered into the ZeRO-1 slice
+    layout when ``zero1``), bucketed ZeRO-1 param reassembly. All of it
+    is loss-bit-exact against overlap-off except the zero1 manual-
+    schedule (pp>1) grad-norm, whose slice-wise accumulation can move
+    the clip scale by an ulp (see parallel/overlap.py).
     """
-    ctx = plan.ctx(cfg)
+    if overlap is None:
+        overlap = DEFAULT_OVERLAP
+    ctx = plan.ctx(cfg, tp_overlap_chunks=(
+        overlap.tp_chunks if overlap.enabled else 1))
     specs = param_specs(cfg, plan)
     data_spec = P(("dp", "ep"), "sp")
 
@@ -231,17 +246,48 @@ def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
     # varies on and the leaf is not sharded on — those are exactly the
     # axes whose ranks contributed partial sums (different tokens or
     # stages); anything the grad does not vary on is already complete.
-    def _reduce_manual(grads):
+    # With overlap on the per-leaf psums pack into deterministic-order
+    # buckets (parallel/overlap.py) — same sums per element, but few
+    # large independent collectives XLA can run beside remaining compute.
+    def _manual_reduce_axes(grads):
         from hadoop_tpu.ops.vma import vma_of
+        return jax.tree_util.tree_map(
+            lambda g, s: tuple(sorted(vma_of(g) - _spec_axes(s))),
+            grads, specs)
 
-        def leaf(g, s):
-            reduce_axes = tuple(sorted(vma_of(g) - _spec_axes(s)))
-            return jax.lax.psum(g, reduce_axes) if reduce_axes else g
-        return jax.tree_util.tree_map(leaf, grads, specs)
+    def _reduce_manual(grads):
+        axes_tree = _manual_reduce_axes(grads)
+        if overlap.enabled:
+            return bucketed_psum(grads, axes_tree, overlap.bucket_bytes)
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_a = treedef.flatten_up_to(axes_tree)
+        return treedef.unflatten([
+            jax.lax.psum(g, a) if a else g
+            for g, a in zip(flat_g, flat_a)])
 
     # -------------------------------------------------------------- body
 
     from hadoop_tpu.ops.vma import vma_of
+
+    # ZeRO-1 under a manual schedule: reduce-scatter the accumulated
+    # grads straight into the slice layout (a rank about to update 1/Z
+    # of each leaf never needs the rest) — half the grad traffic of
+    # psum + local slice, bitwise-identical slice values. Only the
+    # grad-norm accumulates slice-wise (± an ulp on the clip scale).
+    z1_scatter = (zero1 and optimizer == "adamw" and use_1f1b and
+                  overlap.enabled and overlap.zero1_reduce_scatter)
+
+    def _global_grad_sq_sliced(slices):
+        """Squared global grad norm from per-rank ZeRO-1 slices: each
+        slice's local sum-of-squares psummed over every axis it still
+        varies on (its scatter + shard axes)."""
+        def leaf(g):
+            local = jnp.sum(jnp.square(g.astype(jnp.float32)))
+            axes = tuple(sorted(vma_of(local)))
+            return jax.lax.psum(local, axes) if axes else local
+        parts = jax.tree_util.tree_map(leaf, slices)
+        return functools.reduce(
+            jnp.add, jax.tree_util.tree_leaves(parts))
 
     def body(params, opt_state, tokens, targets):
         if use_1f1b:
@@ -255,7 +301,12 @@ def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
                 params, tokens, targets, cfg=cfg, plan=plan, ctx=ctx,
                 n_microbatches=n_microbatches, remat=remat,
                 loss_from_h=_loss_from_h)
-            grads = _reduce_manual(grads)
+            if z1_scatter:
+                grads = bucketed_psum_scatter(
+                    grads, _manual_reduce_axes(grads), z1_axes,
+                    z1_sizes, overlap.bucket_bytes)
+            else:
+                grads = _reduce_manual(grads)
             # Accumulators summed M per-microbatch mean-losses; the
             # objective (like the gpipe path's psum(...)/M) is their mean.
             grads = jax.tree_util.tree_map(
@@ -276,7 +327,8 @@ def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
                 loss = jax.lax.psum(loss, rem)
         grads = _reduce_grads(grads)
         loss = loss / loss_div
-        gsq = _global_grad_sq(grads)
+        gsq = _global_grad_sq_sliced(grads) if z1_scatter \
+            else _global_grad_sq(grads)
         if zero1 and optimizer == "adamw":
             mu_l = jax.tree_util.tree_map(
                 lambda m: m.reshape(-1), opt_state.mu)
@@ -285,7 +337,10 @@ def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
             new_params, new_opt_l, gnorm = zero1_update(
                 params, grads,
                 AdamWState(opt_state.count, mu_l, nu_l), lr,
-                leaf_axes=z1_axes, mesh_axis_sizes=z1_sizes, gsq=gsq)
+                leaf_axes=z1_axes, mesh_axis_sizes=z1_sizes, gsq=gsq,
+                grads_sliced=z1_scatter,
+                gather_bucket_bytes=(overlap.bucket_bytes
+                                     if overlap.enabled else 0))
             # restore the (1,...,1,K) local state layout for out_specs
             new_opt = AdamWState(
                 new_opt_l.count,

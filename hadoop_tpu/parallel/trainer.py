@@ -20,10 +20,13 @@ import jax.numpy as jnp
 
 from hadoop_tpu.fs import FileSystem
 from hadoop_tpu.models.config import ModelConfig
-from hadoop_tpu.parallel.checkpoint import (latest_step, load_checkpoint,
-                                            save_checkpoint)
+from hadoop_tpu.parallel.checkpoint import (AsyncCheckpointWriter,
+                                            latest_step, load_checkpoint,
+                                            reorder_snapshot_axis0,
+                                            snapshot_tree, write_snapshot)
 from hadoop_tpu.parallel.data import TokenDataset
 from hadoop_tpu.parallel.mesh import MeshPlan, make_mesh, param_specs
+from hadoop_tpu.parallel.overlap import OverlapConfig
 from hadoop_tpu.parallel.train import (init_sharded, make_data_sharding,
                                        make_train_step, zero1_layout)
 from hadoop_tpu.parallel.optimizer import AdamWState
@@ -39,7 +42,9 @@ class Trainer:
                  ckpt_interval: int = 100, keep: int = 3,
                  data_dtype: str = "uint16",
                  n_microbatches: Optional[int] = None,
-                 pipeline_schedule: str = "1f1b"):
+                 pipeline_schedule: str = "1f1b",
+                 overlap: Optional[OverlapConfig] = None,
+                 async_ckpt: bool = True):
         self.cfg, self.plan, self.fs = cfg, plan, fs
         self.ckpt_dir = ckpt_dir
         self.ckpt_interval = ckpt_interval
@@ -56,8 +61,14 @@ class Trainer:
             cfg, plan, self.mesh, lr=lr, optimizer=optimizer,
             zero1=zero1, remat=remat, donate=False,
             n_microbatches=n_microbatches,
-            pipeline_schedule=pipeline_schedule)
+            pipeline_schedule=pipeline_schedule, overlap=overlap)
         self.zero1 = zero1 and optimizer == "adamw"
+        # parallel.ckpt.async: save() blocks only for the host snapshot;
+        # the DFS write (and the vpp logical reorder) runs on a
+        # background writer fenced at the next save / restore /
+        # train-exit (checkpoint.AsyncCheckpointWriter).
+        self.async_ckpt = async_ckpt
+        self._ckpt_writer = AsyncCheckpointWriter()
         self.data = TokenDataset(fs, data_path, batch=batch,
                                  seq=cfg.max_seq, dtype=data_dtype)
         self.data_sharding = make_data_sharding(self.mesh)
@@ -77,24 +88,29 @@ class Trainer:
     def _state_tree(self):
         return {"params": self.params, "opt": self.opt}
 
-    def save(self) -> str:
+    def save(self, wait: Optional[bool] = None) -> str:
+        """Checkpoint the current state.
+
+        ``wait=False`` (what the step loop's interval saves pass): block
+        only for the host snapshot (device→host copies of the unique
+        shards) plus a fence on any PREVIOUS in-flight write; the DFS
+        write itself — and the vpp logical-reorder, which permutes
+        whole layer stacks — runs on the background writer, fenced at
+        the next save / restore / train-exit. The data cursor is
+        captured at call time, so in-flight prefetched batches are
+        accounted exactly as before. A crash (or writer failure)
+        mid-write leaves a manifest-less directory the next retention
+        sweep removes — the previous complete checkpoint keeps winning.
+
+        Default (``wait=None`` → True): an EXPLICIT save is durable on
+        return, exactly like the old synchronous path — only saves
+        issued from inside the training loop ride the background
+        writer. ``async_ckpt=False`` forces every save synchronous.
+        """
+        if wait is None:
+            wait = True
+        self._ckpt_writer.wait()  # fence: surfaces a prior write failure
         tree = self._state_tree()
-        if getattr(self.plan, "vpp", 1) > 1:
-            # Checkpoints persist the LOGICAL layer order so they stay
-            # portable across plans (interleaved placement permutes the
-            # stacked layer axis on device; see
-            # train.physical_layer_order). Adam moments mirror the
-            # params tree, so they permute the same way. ZeRO-1 state is
-            # flat slices — plan-locked either way — left as stored.
-            from hadoop_tpu.parallel.train import logical_layer_order
-            tree = dict(tree, params=logical_layer_order(
-                tree["params"], self.cfg, self.plan))
-            if not self.zero1:
-                opt = tree["opt"]
-                tree["opt"] = type(opt)(
-                    opt.count,
-                    logical_layer_order(opt.mu, self.cfg, self.plan),
-                    logical_layer_order(opt.nu, self.cfg, self.plan))
         # The data cursor rides as an extra leaf, split into two int32
         # halves: datasets beyond 2**31 tokens are ordinary LM scale and
         # a single int32 would overflow (or wrap negative) and resume
@@ -104,13 +120,61 @@ class Trainer:
         pos = cursor["pos"] % max(self.data.total_tokens, 1)
         tree = dict(tree, data_pos=jnp.asarray(
             [pos >> 31, pos & 0x7FFFFFFF], jnp.int32))
-        path = save_checkpoint(self.fs, self.ckpt_dir, self.step, tree,
-                               keep=self.keep)
-        log.info("checkpoint step %d -> %s", self.step, path)
-        return path
+        snap = snapshot_tree(tree)
+        step, fs, ckpt_dir, keep = self.step, self.fs, self.ckpt_dir, \
+            self.keep
+        reorder = self._vpp_snapshot_reorder()
+
+        def write():
+            path = write_snapshot(fs, ckpt_dir, step,
+                                  reorder(snap) if reorder else snap,
+                                  keep=keep)
+            log.info("checkpoint step %d -> %s", step, path)
+
+        if self.async_ckpt:
+            self._ckpt_writer.submit(write)
+            if wait:
+                self._ckpt_writer.wait()
+        else:
+            write()
+        return f"{self.ckpt_dir}/step_{step:012d}"
+
+    def _vpp_snapshot_reorder(self):
+        """Host-side logical-reorder closure for interleaved plans.
+
+        Checkpoints persist the LOGICAL layer order so they stay
+        portable across plans (interleaved placement permutes the
+        stacked layer axis on device; see train.physical_layer_order).
+        Adam moments mirror the params tree, so they permute the same
+        way. ZeRO-1 state is flat slices — plan-locked either way —
+        left as stored. Running the permutation on the host snapshot
+        keeps the device free of the full permuted copy the old
+        device-side ``logical_layer_order`` materialized."""
+        if getattr(self.plan, "vpp", 1) <= 1:
+            return None
+        import numpy as _np
+
+        from hadoop_tpu.parallel.pipeline import \
+            interleaved_layer_permutation
+        inv = _np.argsort(interleaved_layer_permutation(
+            self.cfg.n_layers, self.plan.pp, self.plan.vpp))
+        prefixes = ["['params']['layers']"]
+        if not self.zero1:
+            prefixes += ["['opt'].mu['layers']", "['opt'].nu['layers']"]
+
+        def match(name: str) -> bool:
+            return any(name.startswith(p) for p in prefixes)
+
+        return lambda snap: reorder_snapshot_axis0(snap, inv, match)
+
+    def wait_for_checkpoint(self) -> None:
+        """Block until any in-flight async checkpoint write completes
+        (re-raising its failure, if it failed)."""
+        self._ckpt_writer.wait()
 
     def try_restore(self) -> bool:
         """Resume from the newest complete checkpoint, if any."""
+        self._ckpt_writer.wait()  # a restore must see the newest save
         step = latest_step(self.fs, self.ckpt_dir)
         if step is None:
             return False
@@ -215,6 +279,7 @@ class Trainer:
         producer = threading.Thread(target=produce, daemon=True,
                                     name="trainer-prefetch")
         producer.start()
+        step_failed = False
         try:
             for _ in range(n_steps):
                 item = q.get()
@@ -227,15 +292,23 @@ class Trainer:
                 self._inflight_cursor = cursor
                 pending.append(metrics["loss"])
                 # materialize as they age out so self.losses stays
-                # current even if a later step raises
+                # current even if a later step raises; this float() is
+                # the DELIBERATE bounded-in-flight backpressure sync
+                # (see MAX_INFLIGHT above), not a stray stall
                 while len(pending) > self.MAX_INFLIGHT:
-                    val = float(pending.popleft())
+                    val = float(  # lint: disable=jit/blocking-in-step
+                        pending.popleft())
                     out.append(val)
                     self.losses.append(val)
                 if self.ckpt_interval and \
                         self.step % self.ckpt_interval == 0:
-                    self.save()
-            pass
+                    # interval saves ride the background writer: the
+                    # step loop pays only the host-snapshot time (the
+                    # train-exit fence below guarantees durability)
+                    self.save(wait=False)
+        except BaseException:
+            step_failed = True
+            raise
         finally:
             abort.set()
             # Drain completed steps' losses even when a step raised —
@@ -268,4 +341,20 @@ class Trainer:
                 if self.data.state() != self._inflight_cursor:
                     self.data.restore(self._inflight_cursor)
                 self._inflight_cursor = None
+            # Completion fence at train-exit, AFTER the drain/join/
+            # rewind so a failed write never skips the loss and cursor
+            # bookkeeping above: a caller returning from train() must
+            # find its interval checkpoints durable (and learn about a
+            # failed write here, not at some later save). When a STEP
+            # exception is propagating (tracked explicitly — exc_info()
+            # lies both inside except blocks and when train() is called
+            # from a caller's handler), the write failure is logged
+            # instead of masking it.
+            try:
+                self._ckpt_writer.wait()
+            except Exception:
+                if not step_failed:
+                    raise
+                log.exception("async checkpoint write failed during "
+                              "train()")
         return out

@@ -290,6 +290,113 @@ def test_loop_over_traced_value_is_flagged(tmp_path):
     assert ids_of(findings) == ["jit/traced-branch"]
 
 
+def test_iterating_leaf_containers_is_clean(tmp_path):
+    """Static-length containers of tracers (tree_flatten output, zip of
+    leaf lists) are trace-time Python — iterating them, testing their
+    truthiness, and keying dicts on their metadata must NOT flag (the
+    bucketed-collective idiom, parallel/overlap.py)."""
+    findings = lint_source(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def reduce_tree(tree, axes_tree):
+            flat, treedef = jax.tree_util.tree_flatten(tree)
+            axes_flat = treedef.flatten_up_to(axes_tree)
+            out = []
+            for g, axes in zip(flat, axes_flat):   # OK: static length
+                axes = tuple(axes)
+                if not axes:                       # OK: static tuple
+                    out.append(g)
+                    continue
+                out.append(jax.lax.psum(g, axes))
+            buf = jnp.concatenate([o.reshape(-1) for o in out])
+            return treedef.unflatten(out), buf
+
+        prog = jax.jit(reduce_tree)
+    """, [JitDisciplineChecker()])
+    assert findings == []
+
+
+def test_branch_on_container_element_is_still_flagged(tmp_path):
+    """Container precision must not hide the real bug: branching on an
+    ELEMENT of a leaf container is still a traced branch."""
+    findings = lint_source(tmp_path, """
+        import jax
+
+        def worst(tree):
+            flat, _ = jax.tree_util.tree_flatten(tree)
+            for g in flat:
+                if g > 0:          # BAD: branch on a traced leaf
+                    return g
+            return flat[0]
+
+        prog = jax.jit(worst)
+    """, [JitDisciplineChecker()])
+    assert ids_of(findings) == ["jit/traced-branch"]
+
+
+# ------------------------------------------------------ blocking-in-step
+
+def test_blocking_in_step_loop_is_flagged(tmp_path):
+    from hadoop_tpu.analysis import StepBlockingChecker
+    findings = lint_source(tmp_path, """
+        def train(self, n_steps):
+            for _ in range(n_steps):
+                params, opt, m = self.step_fn(params, opt, tok, tgt)
+                loss = float(m["loss"])        # BAD: per-step host sync
+                self.fs.write_all("/log", b"x")  # BAD: blocking IO
+                self.writer.join(5.0)          # BAD: thread join
+    """, [StepBlockingChecker()])
+    assert sorted(ids_of(findings)) == ["jit/blocking-in-step"] * 3
+
+
+def test_blocking_outside_step_loop_is_clean(tmp_path):
+    from hadoop_tpu.analysis import StepBlockingChecker
+    findings = lint_source(tmp_path, """
+        def train(self, n_steps):
+            for _ in range(n_steps):
+                params, opt, m = self.step_fn(params, opt, tok, tgt)
+            # after the loop: syncs are fine
+            loss = float(m["loss"])
+            self.fs.write_all("/log", b"x")
+            self.writer.join(5.0)
+
+        def not_a_step_loop(rows):
+            out = []
+            for r in rows:                  # no step_fn call inside
+                out.append(float(r))
+            return ", ".join(out)           # str.join stays exempt
+    """, [StepBlockingChecker()])
+    assert findings == []
+
+
+def test_blocking_in_step_annotation_suppresses(tmp_path):
+    from hadoop_tpu.analysis import StepBlockingChecker
+    findings = lint_source(tmp_path, """
+        def train(self, n_steps):
+            for _ in range(n_steps):
+                params, opt, m = self.step_fn(params, opt, tok, tgt)
+                if len(pending) > 16:  # deliberate backpressure sync
+                    v = float(  # lint: disable=jit/blocking-in-step
+                        pending.popleft())
+    """, [StepBlockingChecker()])
+    assert findings == []
+
+
+def test_step_loop_from_make_train_step_assignment(tmp_path):
+    from hadoop_tpu.analysis import StepBlockingChecker
+    findings = lint_source(tmp_path, """
+        from hadoop_tpu.parallel.train import make_train_step
+
+        def bench(cfg, plan, mesh, params, opt, tok, tgt):
+            step = make_train_step(cfg, plan, mesh)
+            while True:
+                params, opt, m = step(params, opt, tok, tgt)
+                print(m["loss"].item())        # BAD: per-step sync
+    """, [StepBlockingChecker()])
+    assert ids_of(findings) == ["jit/blocking-in-step"]
+
+
 # ---------------------------------------------------------- rpc checkers
 
 def test_timeoutless_socket_is_flagged(tmp_path):
