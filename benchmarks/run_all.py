@@ -109,6 +109,15 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — recorded for the
         # trajectory; must not discard the benches already computed
         out["overlap"] = {"error": f"{type(e).__name__}: {e}"}
+    # Telemetry plane: tracing-on vs tracing-off step + DFS write/read
+    # cost, with the <5% step-overhead bound recorded in the JSON.
+    # Recorded-not-raised like the other smokes.
+    try:
+        from benchmarks import trace_overhead
+        out["trace_overhead"] = trace_overhead.run(quick=args.quick)
+    except Exception as e:  # noqa: BLE001 — recorded for the
+        # trajectory; must not discard the benches already computed
+        out["trace_overhead"] = {"error": f"{type(e).__name__}: {e}"}
     out["wall_seconds"] = round(time.perf_counter() - t0, 1)
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)

@@ -1,0 +1,185 @@
+"""Tracing-overhead smoke: what does the telemetry plane cost?
+
+Two hot paths, each timed tracing-OFF (plain loop) vs tracing-ON (span
+per iteration with kv annotations, metrics rate+histogram ticks, the
+span collector receiving every span — the full always-on surface the
+trainer/serving paths carry):
+
+  step:  a jitted tiny-model train-ish step (forward+grad+update on
+         ``models.decoder``; the trainer's own ``make_train_step`` rides
+         shard_map, which this smoke deliberately avoids so the number
+         is about TRACING, not about mesh plumbing)
+  dfs:   writing + reading a file through a 1-DN miniDFS cluster under
+         a client root span (the span context then rides the RPC and
+         DataTransfer headers into the NN and DN)
+
+The recorded contract: ``step.overhead_frac`` stays under
+``overhead_bound`` (5%) at the default sample rate. ``run_all`` records
+a failure instead of raising, like the other smokes.
+
+  python -m benchmarks.trace_overhead [--steps N] [--mb M]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+OVERHEAD_BOUND = 0.05  # fraction of step time tracing may cost
+
+
+def _median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def bench_step(n_steps: int = 30, repeats: int = 3) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from hadoop_tpu.metrics import metrics_system
+    from hadoop_tpu.models.config import get_config
+    from hadoop_tpu.models.decoder import forward, init_params
+    from hadoop_tpu.tracing.collector import span_collector
+    from hadoop_tpu.tracing.tracer import global_tracer
+
+    cfg = get_config("tiny")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.zeros((2, 64), jnp.int32)
+
+    def loss_fn(p):
+        logits = forward(p, tokens, cfg)
+        return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+    @jax.jit
+    def step(p):
+        g = jax.grad(loss_fn)(p)
+        return jax.tree_util.tree_map(lambda a, b: a - 1e-4 * b, p, g)
+
+    params = jax.block_until_ready(step(params))  # compile once
+
+    tracer = global_tracer()
+    collector = span_collector()   # installed: every span is received
+    reg = metrics_system().source("trace_overhead")
+    rate = reg.rate("step_wall")
+    hist = reg.histogram("step_wall_seconds")
+
+    def run_off():
+        p = params
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            p = step(p)
+        jax.block_until_ready(p)
+        return (time.perf_counter() - t0) / n_steps
+
+    def run_on():
+        p = params
+        t0 = time.perf_counter()
+        for i in range(n_steps):
+            ts = time.monotonic()
+            with tracer.span("trainer.step") as sp:
+                sp.add_kv("step", str(i))
+                p = step(p)
+            wall = time.monotonic() - ts
+            rate.add(wall)
+            hist.add(wall)
+        jax.block_until_ready(p)
+        return (time.perf_counter() - t0) / n_steps
+
+    # interleave A/B, median-of-N: one-box noise hygiene
+    offs, ons = [], []
+    for _ in range(repeats):
+        offs.append(run_off())
+        ons.append(run_on())
+    off_s, on_s = _median(offs), _median(ons)
+    overhead = (on_s - off_s) / off_s if off_s > 0 else 0.0
+    return {
+        "n_steps": n_steps,
+        "repeats": repeats,
+        "off_step_ms": round(off_s * 1e3, 3),
+        "on_step_ms": round(on_s * 1e3, 3),
+        "overhead_frac": round(overhead, 4),
+        "overhead_bound": OVERHEAD_BOUND,
+        "within_bound": overhead < OVERHEAD_BOUND,
+        "sample_rate": tracer.sample_rate,
+        "spans_collected": len(collector.snapshot()["spans"]),
+    }
+
+
+def bench_dfs(mb: int = 8, repeats: int = 3) -> dict:
+    import os
+    import shutil
+    import tempfile
+
+    from hadoop_tpu.conf import Configuration
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster, fast_conf
+    from hadoop_tpu.tracing.tracer import global_tracer
+
+    conf = fast_conf()
+    conf.set("dfs.replication", "1")
+    conf.set("dfs.client.read.shortcircuit", "false")
+    base = tempfile.mkdtemp(
+        prefix="trace-overhead-",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None)
+    payload = b"\xab" * (mb << 20)
+    tracer = global_tracer()
+    out = {"mb": mb, "repeats": repeats}
+    try:
+        with MiniDFSCluster(num_datanodes=1, conf=conf,
+                            base_dir=base) as cluster:
+            cluster.wait_active()
+            fs = cluster.get_filesystem()
+
+            def write_read(i, traced):
+                path = f"/t{int(traced)}-{i}.bin"
+                t0 = time.perf_counter()
+                if traced:
+                    with tracer.span("bench.dfs"):
+                        fs.write_all(path, payload)
+                        fs.read_all(path)
+                else:
+                    fs.write_all(path, payload)
+                    fs.read_all(path)
+                elapsed = time.perf_counter() - t0
+                fs.delete(path)
+                return elapsed
+
+            offs = [write_read(i, False) for i in range(repeats)]
+            ons = [write_read(i, True) for i in range(repeats)]
+            off_s, on_s = _median(offs), _median(ons)
+            out.update({
+                "off_ms": round(off_s * 1e3, 2),
+                "on_ms": round(on_s * 1e3, 2),
+                "overhead_frac": round((on_s - off_s) / off_s, 4)
+                if off_s > 0 else 0.0,
+            })
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    return out
+
+
+def run(quick: bool = False) -> dict:
+    result = {"step": bench_step(n_steps=10 if quick else 30),
+              "dfs": bench_dfs(mb=2 if quick else 8)}
+    result["overhead_bound"] = OVERHEAD_BOUND
+    result["within_bound"] = result["step"]["within_bound"]
+    return result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--mb", type=int, default=8)
+    args = ap.parse_args(argv)
+    result = {"step": bench_step(n_steps=args.steps),
+              "dfs": bench_dfs(mb=args.mb),
+              "overhead_bound": OVERHEAD_BOUND}
+    result["within_bound"] = result["step"]["within_bound"]
+    print(json.dumps(result, indent=2))
+    return 0 if result["within_bound"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -16,6 +16,10 @@ as pluggable checkers over a shared parsed-module project:
 ``rpc/*``    RPC/retry hygiene: timeoutless sockets, ``settimeout(None)``
              on live connections, constant-sleep retry loops with no
              backoff/jitter, and silent broad ``except: pass`` swallows.
+``trace/*``  tracing discipline: a ``tracer.span(...)`` that is neither
+             a context manager nor guaranteed to ``finish()`` (incl.
+             exception edges) never delivers — a silent hole in the
+             trace someone will later debug from.
 
 Entry points: ``hadoop-tpu lint`` and ``python -m hadoop_tpu.analysis``.
 Findings are suppressible per line with ``# lint: disable=<id>`` or via a
@@ -31,17 +35,19 @@ from hadoop_tpu.analysis.lockcheck import GuardedByChecker, LockOrderChecker
 from hadoop_tpu.analysis.rpccheck import (RetryHygieneChecker,
                                           SilentSwallowChecker,
                                           TimeoutChecker)
+from hadoop_tpu.analysis.tracecheck import SpanFinishChecker
 
 
 def all_checkers():
     """The shipped checker set, fresh instances (checkers hold state)."""
     return [GuardedByChecker(), LockOrderChecker(), JitDisciplineChecker(),
             StepBlockingChecker(), TimeoutChecker(), RetryHygieneChecker(),
-            SilentSwallowChecker()]
+            SilentSwallowChecker(), SpanFinishChecker()]
 
 
 __all__ = ["Finding", "Project", "SourceModule", "run_lint",
            "load_baseline", "all_checkers", "GuardedByChecker",
            "LockOrderChecker", "JitDisciplineChecker",
            "StepBlockingChecker", "TimeoutChecker",
-           "RetryHygieneChecker", "SilentSwallowChecker"]
+           "RetryHygieneChecker", "SilentSwallowChecker",
+           "SpanFinishChecker"]

@@ -1,0 +1,286 @@
+"""Tracing discipline: every span must reach ``finish()``.
+
+An unfinished span never delivers (the collector, the flight recorder
+and every receiver see nothing), silently punching a hole in the very
+trace someone will later stare at — and if it was entered as the active
+span, it leaks the contextvar slot too. The htrace-era bug class this
+kills: a handler that finishes its span on the happy path but leaks it
+on the exception edge.
+
+``trace/span-not-finished`` flags a ``tracer.span(...)`` call that is
+neither used as a context manager nor guaranteed to be finished:
+
+- OK: ``with tracer.span(...) as sp:`` (directly or via an assigned
+  name later used in a ``with``) — ``__exit__`` finishes on every edge.
+- OK: ``tracer.span(...).finish()`` — immediate fire-and-forget marker.
+- OK: the span object ESCAPES the creating function (passed as an
+  argument, returned, yielded, stored on an object) — a long-lived
+  span finished elsewhere; annotate intent at the handoff site.
+- Flagged: assigned to a local that is never ``finish()``ed.
+- Flagged: finished only on the straight-line path while a statement
+  that can raise sits between creation and the first ``finish()`` and
+  no enclosing ``try`` guarantees the finish (``finally``, or an
+  ``except``/``else`` arm finishing it) — the exception edge leaks.
+
+Span-method calls (``add_kv``/``annotate``/``context``) and argument-
+free builtins (``str``/``int``/``len``/``repr``/``format``/``round``)
+between creation and finish are treated as non-raising.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from hadoop_tpu.analysis.core import (Checker, Finding, SourceModule,
+                                      attr_chain, call_name)
+
+_SAFE_BUILTINS = {"str", "int", "float", "len", "repr", "format", "round",
+                  "bool"}
+_SPAN_METHODS = {"add_kv", "annotate", "context", "duration_ms"}
+
+
+def _is_span_call(node: ast.Call) -> bool:
+    """``<something tracer-ish>.span(...)``: the attribute is ``span``
+    and the receiver chain mentions a tracer (``self.tracer``,
+    ``tracer``, ``self._tracer``, ``global_tracer()``)."""
+    if not (isinstance(node.func, ast.Attribute) and
+            node.func.attr == "span"):
+        return False
+    recv = node.func.value
+    chain = attr_chain(recv)
+    if chain is not None:
+        return any("tracer" in part for part in chain)
+    if isinstance(recv, ast.Call):
+        name = call_name(recv) or ""
+        return "tracer" in name
+    return False
+
+
+class SpanFinishChecker(Checker):
+    name = "trace-span-finish"
+    ids = ("trace/span-not-finished",)
+
+    def check_module(self, mod: SourceModule) -> List[Finding]:
+        findings: List[Finding] = []
+        for func in ast.walk(mod.tree):
+            if isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_function(mod, func, findings)
+        return findings
+
+    # ------------------------------------------------------------ per-func
+
+    def _check_function(self, mod: SourceModule, func, findings) -> None:
+        # calls already blessed: inside a with-item, or .finish()ed
+        # directly on the call result
+        in_with: Set[int] = set()
+        direct_finished: Set[int] = set()
+        for node in ast.walk(func):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    for sub in ast.walk(item.context_expr):
+                        if isinstance(sub, ast.Call) and _is_span_call(sub):
+                            in_with.add(id(sub))
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "finish" and \
+                    isinstance(node.func.value, ast.Call) and \
+                    _is_span_call(node.func.value):
+                direct_finished.add(id(node.func.value))
+
+        # name -> (assign stmt, span call); only simple single-name
+        # targets are tracked (anything fancier counts as an escape)
+        assigned: Dict[str, Tuple[ast.stmt, ast.Call]] = {}
+        bare: List[ast.Call] = []
+        for node in ast.walk(func):
+            if not (isinstance(node, ast.Call) and _is_span_call(node)):
+                continue
+            if id(node) in in_with or id(node) in direct_finished:
+                continue
+            holder = self._assignment_of(func, node)
+            if holder is None:
+                bare.append(node)
+            else:
+                name, stmt = holder
+                if name is None:
+                    continue  # attribute/subscript target: escapes
+                assigned[name] = (stmt, node)
+
+        for node in bare:
+            f = mod.finding(node, "trace/span-not-finished",
+                            "span is neither a context manager nor "
+                            "finish()ed — it will never be delivered")
+            if f:
+                findings.append(f)
+
+        for name, (stmt, node) in assigned.items():
+            verdict = self._analyse_name(func, name, stmt)
+            if verdict is not None:
+                f = mod.finding(node, "trace/span-not-finished", verdict)
+                if f:
+                    findings.append(f)
+
+    @staticmethod
+    def _assignment_of(func, call: ast.Call
+                       ) -> Optional[Tuple[Optional[str], ast.stmt]]:
+        """The statement assigning this call, if any. Returns
+        (name, stmt) for ``x = tracer.span(...)``; (None, stmt) for a
+        non-name target (treated as escaping)."""
+        def holds(value) -> bool:
+            # the call itself, or nested in a conditional expression
+            # (``cm = tracer.span(...) if ctx else nullcontext()``)
+            return value is not None and \
+                any(sub is call for sub in ast.walk(value))
+
+        for stmt in ast.walk(func):
+            if isinstance(stmt, ast.Assign) and holds(stmt.value):
+                if len(stmt.targets) == 1 and \
+                        isinstance(stmt.targets[0], ast.Name):
+                    return stmt.targets[0].id, stmt
+                return None, stmt
+            if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)) and \
+                    holds(getattr(stmt, "value", None)):
+                if isinstance(stmt.target, ast.Name):
+                    return stmt.target.id, stmt
+                return None, stmt
+        return None  # expression statement or nested expr → bare
+
+    def _analyse_name(self, func, name: str, assign_stmt) -> Optional[str]:
+        """None when the span named ``name`` is safely finished;
+        else the finding message."""
+        uses_with = False
+        escapes = False
+        finishes: List[ast.Call] = []
+        for node in ast.walk(func):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    expr = item.context_expr
+                    if isinstance(expr, ast.Name) and expr.id == name:
+                        uses_with = True
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == name and \
+                        node.func.attr == "finish":
+                    finishes.append(node)
+                else:
+                    # passed as an argument to any call → escapes
+                    for arg in list(node.args) + \
+                            [k.value for k in node.keywords]:
+                        if isinstance(arg, ast.Name) and arg.id == name:
+                            escapes = True
+            if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                v = node.value
+                if isinstance(v, ast.Name) and v.id == name:
+                    escapes = True
+                elif v is not None:
+                    for sub in ast.walk(v):
+                        if isinstance(sub, ast.Name) and sub.id == name:
+                            escapes = True
+            if isinstance(node, ast.Assign):
+                # stored onto an object/container → escapes
+                if isinstance(node.value, ast.Name) and \
+                        node.value.id == name and \
+                        any(not isinstance(t, ast.Name)
+                            for t in node.targets):
+                    escapes = True
+        if uses_with or escapes:
+            return None
+        if not finishes:
+            return (f"span '{name}' is never finish()ed on any path — "
+                    "use 'with' or finish() in a finally")
+        if self._finish_guarded(func, name):
+            return None
+        if self._raising_call_before_finish(func, assign_stmt, finishes,
+                                            name):
+            return (f"span '{name}' leaks on the exception edge: a call "
+                    "that can raise sits between span() and finish() "
+                    "with no finally/except finishing it — use 'with' "
+                    "or a try/finally")
+        return None
+
+    # --------------------------------------------------------- path checks
+
+    @staticmethod
+    def _finish_guarded(func, name: str) -> bool:
+        """True when some try statement finishes the span on its
+        non-happy edges: a ``finally`` arm, or an ``except`` handler,
+        containing ``name.finish()``."""
+        def has_finish(stmts) -> bool:
+            for s in stmts:
+                for node in ast.walk(s):
+                    if isinstance(node, ast.Call) and \
+                            isinstance(node.func, ast.Attribute) and \
+                            node.func.attr == "finish" and \
+                            isinstance(node.func.value, ast.Name) and \
+                            node.func.value.id == name:
+                        return True
+            return False
+
+        for node in ast.walk(func):
+            if isinstance(node, ast.Try):
+                if has_finish(node.finalbody):
+                    return True
+                if node.handlers and all(
+                        has_finish(h.body) for h in node.handlers) and \
+                        has_finish(node.body + sum(
+                            [h.body for h in node.handlers], []) +
+                            node.orelse + node.finalbody):
+                    # every except arm finishes AND the try covers the
+                    # raising region (approximated: the finish exists)
+                    return True
+        return False
+
+    def _raising_call_before_finish(self, func, assign_stmt, finishes,
+                                    name: str) -> bool:
+        """Scan the statements between the assignment and the first
+        finish in the SAME statement list; any call that is not a span
+        method or a safe builtin can raise past the finish."""
+        parent_body = self._body_containing(func, assign_stmt)
+        if parent_body is None:
+            return False
+        try:
+            i = parent_body.index(assign_stmt)
+        except ValueError:
+            return False
+        finish_lines = {f.lineno for f in finishes}
+        for stmt in parent_body[i + 1:]:
+            if any(f.lineno >= stmt.lineno and
+                   f.lineno <= getattr(stmt, "end_lineno", stmt.lineno)
+                   for f in finishes):
+                return False  # reached a finish in-line
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call) and \
+                        node.lineno not in finish_lines and \
+                        not self._safe_call(node, name):
+                    return True
+        # fell off the list without reaching a finish: the finish lives
+        # in a nested branch — conservatively fine (branch analysis is
+        # out of scope; the no-finish and finally rules caught the
+        # egregious cases)
+        return False
+
+    @staticmethod
+    def _body_containing(func, stmt) -> Optional[list]:
+        for node in ast.walk(func):
+            for field in ("body", "orelse", "finalbody"):
+                body = getattr(node, field, None)
+                if isinstance(body, list) and stmt in body:
+                    return body
+            if isinstance(node, ast.Try):
+                for h in node.handlers:
+                    if stmt in h.body:
+                        return h.body
+        return None
+
+    @staticmethod
+    def _safe_call(node: ast.Call, name: str) -> bool:
+        if isinstance(node.func, ast.Attribute):
+            recv = node.func.value
+            if isinstance(recv, ast.Name) and recv.id == name and \
+                    node.func.attr in _SPAN_METHODS | {"finish"}:
+                return True
+        if isinstance(node.func, ast.Name) and \
+                node.func.id in _SAFE_BUILTINS:
+            return True
+        return False

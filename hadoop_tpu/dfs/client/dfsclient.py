@@ -269,7 +269,10 @@ class DFSClient:
             if self._hedged_inflight >= self._hedged_workers:
                 return None
             self._hedged_inflight += 1
-        fut = pool.submit(fn, *args)
+        # span-aware seam: the pool thread reads the SUBMITTING thread's
+        # active span, so a hedge's DN read joins the read's trace
+        from hadoop_tpu.tracing.tracer import carry_context
+        fut = pool.submit(carry_context(fn), *args)
 
         def _done(_f):
             with self._hedged_pool_lock:

@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Set
 from hadoop_tpu.dfs.protocol import datatransfer as dt
 from hadoop_tpu.ipc.errors import RpcError
 from hadoop_tpu.dfs.protocol.records import Block, DatanodeInfo, LocatedBlock
+from hadoop_tpu.tracing.tracer import current_context, global_tracer
 from hadoop_tpu.util.crc import ChecksumError, DataChecksum
 from hadoop_tpu.util.misc import backoff_delay
 
@@ -310,13 +311,20 @@ class _Pipeline:
         try:
             self.sock = dt.connect(locations[0].xfer_addr(), timeout=10.0,
                                    buffer_bytes=socket_buffer)
-            dt.send_frame(self.sock, {
+            setup_req = {
                 "op": dt.OP_WRITE_BLOCK, "b": block.to_wire(),
                 "targets": [t.to_wire() for t in locations[1:]],
                 "stage": dt.STAGE_PIPELINE_SETUP_CREATE,
                 "bpc": checksum.bytes_per_chunk,
                 "tok": token,
-            })
+            }
+            # trace context rides the op header: every DN in the
+            # pipeline resumes the CLIENT's span (the forward loop
+            # relays the header verbatim), so one trace covers all hops
+            ctx = current_context()
+            if ctx is not None:
+                setup_req["t"] = ctx.to_wire()
+            dt.send_frame(self.sock, setup_req)
             setup = dt.recv_frame(self.sock)
             if not setup.get("ok"):
                 raise PipelineError(setup.get("em", "pipeline setup failed"),
@@ -494,7 +502,18 @@ class DFSInputStream:
 
     def _fetch_range(self, pos: int, want: int) -> bytes:
         """Read up to ``want`` bytes at pos from one replica, with failover.
-        Ref: DFSInputStream.blockSeekTo:639 + read retry loop."""
+        Ref: DFSInputStream.blockSeekTo:639 + read retry loop.
+
+        Wrapped in a ``dfs.client.read`` span — the ROOT of a read
+        trace when no span is active (the htrace model: the client
+        decides sampling; NN handler + DN xceiver spans join it over
+        the wire)."""
+        with global_tracer().span("dfs.client.read") as rsp:
+            rsp.add_kv("path", self.path)
+            rsp.add_kv("pos", str(pos))
+            return self._fetch_range_traced(pos, want)
+
+    def _fetch_range_traced(self, pos: int, want: int) -> bytes:
         lb = self._block_for(pos)
         in_block_off = pos - lb.offset
         want = min(want, lb.block.num_bytes - in_block_off)
@@ -642,9 +661,13 @@ class DFSInputStream:
                      offset: int, want: int) -> bytes:
         sock = dt.connect(dn.xfer_addr(), timeout=10.0)
         try:
-            dt.send_frame(sock, {"op": dt.OP_READ_BLOCK, "b": block.to_wire(),
-                                 "tok": self._token_for(block),
-                                 "offset": offset, "length": want})
+            req = {"op": dt.OP_READ_BLOCK, "b": block.to_wire(),
+                   "tok": self._token_for(block),
+                   "offset": offset, "length": want}
+            ctx = current_context()
+            if ctx is not None:
+                req["t"] = ctx.to_wire()
+            dt.send_frame(sock, req)
             setup = dt.recv_frame(sock)
             if not setup.get("ok"):
                 raise IOError(setup.get("em", "read setup failed"))

@@ -17,15 +17,19 @@ verifies; a checksum error at the client marks the replica corrupt at the NN).
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import socket
 import threading
+import time
 from typing import Callable, List, Optional
 
 from hadoop_tpu.dfs.protocol import datatransfer as dt
 from hadoop_tpu.dfs.protocol.records import Block, DatanodeInfo
 from hadoop_tpu.dfs.datanode.blockstore import BlockStore
 from hadoop_tpu.metrics import metrics_system
+from hadoop_tpu.tracing.tracer import (SpanContext, current_span,
+                                       global_tracer)
 from hadoop_tpu.util.crc import ChecksumError, DataChecksum
 from hadoop_tpu.util.misc import Daemon
 
@@ -77,6 +81,13 @@ class DataXceiverServer:
         self._m_bytes_in = reg.counter("bytes_written")
         self._m_bytes_out = reg.counter("bytes_read")
         self._m_short_circuit = reg.counter("short_circuit_grants")
+        # log-bucketed op-latency histograms (the /prom exposition's
+        # native shape; /jmx sees count/sum/mean of the same series)
+        self._m_read_hist = reg.histogram(
+            "read_block_seconds", "whole READ_BLOCK op wall time")
+        self._m_write_hist = reg.histogram(
+            "write_block_seconds", "whole WRITE_BLOCK op wall time")
+        self._tracer = global_tracer()
 
     def _fi(self):
         if self._fixed_injector is not None:
@@ -158,16 +169,39 @@ class DataXceiverServer:
                     dt.send_frame(sock, {"ok": False, "em": str(e),
                                          "denied": True})
                     return
-            if op == dt.OP_WRITE_BLOCK:
-                self._write_block(sock, req)
-            elif op == dt.OP_READ_BLOCK:
-                self._read_block(sock, req)
-            elif op == dt.OP_TRANSFER_BLOCK:
-                self._transfer_block(sock, req)
-            elif op == dt.OP_SHORT_CIRCUIT:
-                self._short_circuit(sock, req)
-            else:
-                dt.send_frame(sock, {"ok": False, "em": f"bad op {op!r}"})
+            # Resume the CLIENT's span around the whole op (ref: the
+            # HTrace spans DataXceiver opened from the op header). Like
+            # the RPC server, no context → no span: sampling is decided
+            # at the client root and untraced bulk transfers stay free.
+            span_ctx = SpanContext.from_wire(req.get("t"))
+            cm = (self._tracer.span(f"dfs.xceiver.{op}", parent=span_ctx)
+                  if span_ctx is not None else contextlib.nullcontext())
+            t0 = time.monotonic()
+            with cm as sp:
+                if sp is not None and "b" in req:
+                    sp.add_kv("block", str(req["b"].get("id")))
+                    sp.add_kv("port", str(self.port))
+                # record latency on EVERY edge, not just success — the
+                # failed/aborted ops (client died, checksum, mirror
+                # failure) are exactly the slow tail the histograms
+                # exist to expose
+                try:
+                    if op == dt.OP_WRITE_BLOCK:
+                        self._write_block(sock, req)
+                    elif op == dt.OP_READ_BLOCK:
+                        self._read_block(sock, req)
+                    elif op == dt.OP_TRANSFER_BLOCK:
+                        self._transfer_block(sock, req)
+                    elif op == dt.OP_SHORT_CIRCUIT:
+                        self._short_circuit(sock, req)
+                    else:
+                        dt.send_frame(sock, {"ok": False,
+                                             "em": f"bad op {op!r}"})
+                finally:
+                    if op == dt.OP_WRITE_BLOCK:
+                        self._m_write_hist.add(time.monotonic() - t0)
+                    elif op == dt.OP_READ_BLOCK:
+                        self._m_read_hist.add(time.monotonic() - t0)
         except (OSError, EOFError) as e:
             log.debug("xceiver connection error: %s", e)
         except Exception:
@@ -187,6 +221,10 @@ class DataXceiverServer:
         targets = [DatanodeInfo.from_wire(t) for t in req.get("targets", [])]
         checksum = DataChecksum(req.get("bpc", dt.CHUNK_SIZE))
         self._fi().before_write_block(block)
+        xsp = current_span()   # resumed client span (see _serve)
+        if xsp is not None:
+            # pipeline hop: how many DNs remain DOWNSTREAM of this one
+            xsp.add_kv("pipeline_remaining", str(len(targets)))
 
         down: Optional[socket.socket] = None
         down_name = ""
@@ -313,6 +351,9 @@ class DataXceiverServer:
                     break
                 if pkt.get("last"):
                     break
+            if xsp is not None:
+                xsp.add_kv("bytes", str(open_rep.num_bytes))
+                xsp.add_kv("crc_ok", str(ok).lower())
             if ok:
                 block.num_bytes = open_rep.num_bytes
                 rep = self.store.finalize(open_rep)
@@ -400,15 +441,21 @@ class DataXceiverServer:
         # (ref: OpReadBlock's ReadOpChecksumInfoProto).
         dt.send_frame(sock, {"ok": True, "bpc": bpc})
         seq = 0
+        sent = 0
         for pos, data, sums in chunks:
             data, sums = self._fi().corrupt_read_packet(block, data, sums)
             dt.send_frame(sock, {"seq": seq, "off": pos, "data": data,
                                  "sums": sums, "last": False})
             self._m_bytes_out.incr(len(data))
+            sent += len(data)
             seq += 1
         dt.send_frame(sock, {"seq": seq, "off": 0, "data": b"", "sums": b"",
                              "last": True})
         self._m_reads.incr()
+        xsp = current_span()   # resumed client span (see _serve)
+        if xsp is not None:
+            xsp.add_kv("bytes", str(sent))
+            xsp.add_kv("offset", str(offset))
 
 
     def _provided_chunks(self, block: Block, offset: int, length: int):
@@ -416,8 +463,7 @@ class DataXceiverServer:
         and computing chunk CRCs on the fly (ref: ProvidedVolumeImpl's
         FileRegion reads — the DN is a caching/streaming proxy for data
         that lives outside the cluster)."""
-        import time as _time
-        now = _time.monotonic()
+        now = time.monotonic()
         hit = self._alias_cache.get(block.block_id)
         alias = hit[0] if hit and hit[1] > now else None
         if alias is None and self.alias_resolver is not None:
@@ -487,6 +533,10 @@ def push_block(store: BlockStore, block: Block,
         "targets": [t.to_wire() for t in targets[1:]],
         "stage": dt.STAGE_TRANSFER, "bpc": dt.CHUNK_SIZE,
     }
+    from hadoop_tpu.tracing.tracer import current_context
+    ctx = current_context()
+    if ctx is not None:
+        req["t"] = ctx.to_wire()
     if block_tokens is not None:
         from hadoop_tpu.dfs.protocol import blocktoken as bt
         req["tok"] = block_tokens.generate_token(

@@ -258,14 +258,18 @@ def read_block_range(addr, block_wire: Dict, offset: int,
     verifying checksums. The shared client of BlockSender — used by the
     striped reader, the EC reconstruction worker, and the balancer
     (ref: the remote half of BlockReaderFactory.getRemoteBlockReader)."""
+    from hadoop_tpu.tracing.tracer import current_context
     from hadoop_tpu.util.crc import DataChecksum
     if length <= 0:
         return b""
     sock = connect(addr, timeout=10.0, security=security)
     try:
-        send_frame(sock, {"op": OP_READ_BLOCK, "b": block_wire,
-                          "offset": offset, "length": length,
-                          "tok": token})
+        req = {"op": OP_READ_BLOCK, "b": block_wire,
+               "offset": offset, "length": length, "tok": token}
+        ctx = current_context()   # trace rides the op header
+        if ctx is not None:
+            req["t"] = ctx.to_wire()
+        send_frame(sock, req)
         setup = recv_frame(sock)
         if not setup.get("ok"):
             raise IOError(setup.get("em", "read setup failed"))

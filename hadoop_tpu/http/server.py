@@ -118,6 +118,15 @@ class HttpServer:
         self.add_handler("/health", lambda q, b: (200, {"status": "alive",
                                                         "daemon":
                                                         self.daemon_name}))
+        # Unified telemetry plane: Prometheus text exposition and the
+        # span collector's ring/flight-recorder — on EVERY daemon that
+        # rides this chassis (NN, DN, serving replica, RM, ...), the way
+        # /jmx is.
+        self.add_handler("/prom", self._prom)
+        self.add_handler("/ws/v1/traces", self._traces)
+        self.add_handler("/ws/v1/traces/slow", self._traces_slow)
+        from hadoop_tpu.tracing.collector import span_collector
+        span_collector().configure(self.conf)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -155,6 +164,9 @@ class HttpServer:
         query["__path__"] = path
         query["__method__"] = req.command
         query["__cookie__"] = req.headers.get("Cookie", "")
+        # cross-plane trace propagation: handlers resume the caller's
+        # span from this header (serving door, WebHDFS)
+        query["__trace__"] = req.headers.get("X-Htpu-Trace", "")
         handler = None
         best = -1
         for prefix, fn in self._handlers.items():
@@ -198,15 +210,25 @@ class HttpServer:
             for name, value in extra_headers.items():
                 req.send_header(name, value)
             req.end_headers()
-            for chunk in payload:
-                if not chunk:
-                    continue
-                if raw_close:
-                    req.wfile.write(chunk)
-                else:
-                    req.wfile.write(f"{len(chunk):x}\r\n".encode())
-                    req.wfile.write(chunk)
-                    req.wfile.write(b"\r\n")
+            try:
+                for chunk in payload:
+                    if not chunk:
+                        continue
+                    if raw_close:
+                        req.wfile.write(chunk)
+                    else:
+                        req.wfile.write(f"{len(chunk):x}\r\n".encode())
+                        req.wfile.write(chunk)
+                        req.wfile.write(b"\r\n")
+            finally:
+                # A client that disconnects mid-stream raises out of the
+                # write above and abandons the generator suspended at a
+                # yield. close() runs its finally/cleanup NOW (finishing
+                # any span it holds) instead of at some far-future GC —
+                # the serving stream-span leak.
+                close = getattr(payload, "close", None)
+                if close is not None:
+                    close()
             if raw_close:
                 req.close_connection = True
             else:
@@ -246,6 +268,43 @@ class HttpServer:
             else:
                 redacted[k] = v
         return 200, redacted
+
+    def _prom(self, query, body):
+        """Prometheus text exposition of the live metrics system."""
+        from hadoop_tpu.metrics.prom import render_prom
+        return 200, render_prom(metrics_system())
+
+    def _traces(self, query, body):
+        """Span-collector ring: ?trace_id= filters (decimal OR the hex
+        form the slow-trace log line and X-Htpu-Trace header use — an
+        all-digit string is tried as both), ?limit=N caps."""
+        from hadoop_tpu.tracing.collector import span_collector
+        tid = (query.get("trace_id") or "").strip().lower()
+        try:
+            limit = int(query.get("limit", 0) or 0)
+        except ValueError:
+            return 400, {"RemoteException": {
+                "exception": "IllegalArgumentException",
+                "message": f"bad limit {query.get('limit')!r}"}}
+        cands = set()
+        if tid:
+            raw = tid[2:] if tid.startswith("0x") else tid
+            for base in ((16,) if tid.startswith("0x") else (10, 16)):
+                try:
+                    cands.add(int(raw, base))
+                except ValueError:
+                    pass
+            if not cands:
+                return 400, {"RemoteException": {
+                    "exception": "IllegalArgumentException",
+                    "message": f"bad trace_id {tid!r}"}}
+        return 200, span_collector().snapshot(
+            trace_id=cands or None, limit=limit)
+
+    def _traces_slow(self, query, body):
+        """Flight recorder: whole traces retained by slow-op promotion."""
+        from hadoop_tpu.tracing.collector import span_collector
+        return 200, span_collector().slow_traces()
 
     def _stacks(self, query, body):
         """Ref: HttpServer2.StackServlet — dump of every live thread."""

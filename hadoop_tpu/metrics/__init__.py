@@ -1,9 +1,9 @@
 from hadoop_tpu.metrics.registry import (
-    MetricsRegistry, MetricsSystem, MutableCounter, MutableGauge, MutableRate,
-    MutableQuantiles, metrics_system,
+    MetricsRegistry, MetricsSystem, MutableCounter, MutableGauge,
+    MutableHistogram, MutableRate, MutableQuantiles, metrics_system,
 )
 
 __all__ = [
     "MetricsRegistry", "MetricsSystem", "MutableCounter", "MutableGauge",
-    "MutableRate", "MutableQuantiles", "metrics_system",
+    "MutableHistogram", "MutableRate", "MutableQuantiles", "metrics_system",
 ]

@@ -1,0 +1,144 @@
+"""Prometheus text exposition (version 0.0.4) over the metrics system.
+
+Every daemon's ``/prom`` endpoint (http/server.py chassis) renders the
+live registries through this module — the pull-based twin of ``/jmx``:
+same sources, but typed for a Prometheus scraper instead of flattened
+for JMX parity. Mapping:
+
+  MutableCounter    -> counter  ``htpu_<name>_total``
+  MutableGauge      -> gauge
+  _CallbackGauge    -> gauge (numeric values only)
+  MutableRate       -> counter ``<name>_num_ops`` + gauge ``<name>_avg_time``
+  MutableQuantiles  -> summary (``quantile`` labels + ``_count``)
+  MutableHistogram  -> histogram (cumulative ``_bucket{le=...}``, ``_sum``,
+                       ``_count``) — the log-bucketed layout added for this
+                       exposition; quantiles stay for JMX parity
+
+The source registry name rides as a ``source`` label, so one metric
+family (say ``blocks_written``) aggregates across every per-port xceiver
+source the scraper sees.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Optional
+
+from hadoop_tpu.metrics.registry import (MetricsSystem, MutableCounter,
+                                         MutableGauge, MutableHistogram,
+                                         MutableQuantiles, MutableRate,
+                                         _CallbackGauge)
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+PREFIX = "htpu_"
+
+
+def _san(name: str) -> str:
+    name = _NAME_OK.sub("_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _fmt(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float):
+        if math.isinf(v):
+            return "+Inf" if v > 0 else "-Inf"
+        if math.isnan(v):
+            return "NaN"
+    return repr(float(v)) if isinstance(v, float) else str(v)
+
+
+def _esc(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _line(name: str, labels: dict, value) -> str:
+    if labels:
+        lab = ",".join(f'{k}="{_esc(v)}"' for k, v in labels.items())
+        return f"{name}{{{lab}}} {_fmt(value)}"
+    return f"{name} {_fmt(value)}"
+
+
+def render_prom(system: MetricsSystem) -> str:
+    """Render every registered source as Prometheus text exposition.
+
+    Output is grouped BY FAMILY, not by source: the text format
+    requires every sample line of one metric family to form a single
+    contiguous group after its TYPE line, and same-named families
+    across sources are by design here (per-port xceiver sources,
+    per-server rpc sources) — emitting source-by-source would split
+    families and strict consumers (promtool, OpenMetrics ingesters)
+    reject or silently drop the earlier group."""
+    # family name → {"type", "help", "lines": [sample line, ...]}
+    fams: Dict[str, Dict] = {}
+
+    def fam(name: str, mtype: str, help_text: str) -> Optional[List[str]]:
+        f = fams.get(name)
+        if f is None:
+            f = fams[name] = {"type": mtype, "help": help_text,
+                              "lines": []}
+        elif f["type"] != mtype:
+            return None  # same family name, conflicting type: skip
+        return f["lines"]
+
+    def add(name, mtype, help_text, labels, value) -> None:
+        lines = fam(name, mtype, help_text)
+        if lines is not None:
+            lines.append(_line(name, labels, value))
+
+    for source, reg in sorted(system.sources().items()):
+        labels = {"source": source}
+        for m in reg.metrics():
+            name = PREFIX + _san(m.name)
+            if isinstance(m, MutableCounter):
+                add(f"{name}_total", "counter", m.description, labels,
+                    m.value())
+            elif isinstance(m, MutableGauge):
+                add(name, "gauge", m.description, labels, m.value())
+            elif isinstance(m, MutableHistogram):
+                lines = fam(name, "histogram", m.description)
+                if lines is None:
+                    continue
+                buckets, total, n = m.buckets()
+                for bound, cum in buckets:
+                    le = "+Inf" if math.isinf(bound) else _fmt(bound)
+                    lines.append(_line(f"{name}_bucket",
+                                       dict(labels, le=le), cum))
+                lines.append(_line(f"{name}_sum", labels, total))
+                lines.append(_line(f"{name}_count", labels, n))
+            elif isinstance(m, MutableQuantiles):
+                lines = fam(name, "summary", m.description)
+                if lines is None:
+                    continue
+                snap = m.snapshot()
+                for q in m.QUANTILES:
+                    lines.append(_line(
+                        name, dict(labels, quantile=_fmt(q)),
+                        snap[f"{m.name}_p{int(q * 100)}"]))
+                lines.append(_line(f"{name}_count", labels,
+                                   snap[f"{m.name}_count"]))
+            elif isinstance(m, MutableRate):
+                snap = m.snapshot()
+                add(f"{name}_num_ops_total", "counter", m.description,
+                    labels, snap[f"{m.name}_num_ops"])
+                add(f"{name}_avg_time", "gauge", "", labels,
+                    snap[f"{m.name}_avg_time"])
+            elif isinstance(m, _CallbackGauge):
+                v = m.snapshot().get(m.name)
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    add(name, "gauge", "", labels, v)
+            # unknown metric kinds are skipped — /jmx still shows them
+    out: List[str] = []
+    for name in sorted(fams):
+        f = fams[name]
+        if not f["lines"]:
+            continue
+        if f["help"]:
+            out.append(f"# HELP {name} {f['help']}")
+        out.append(f"# TYPE {name} {f['type']}")
+        out.extend(f["lines"])
+    return "\n".join(out) + "\n"

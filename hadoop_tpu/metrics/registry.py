@@ -164,6 +164,55 @@ class MutableQuantiles:
             return out
 
 
+class MutableHistogram:
+    """Log-bucketed latency histogram (seconds): geometric bucket bounds
+    so one fixed layout covers microsecond RPCs through minute-long
+    checkpoint writes. This is the Prometheus-native shape (`/prom`
+    renders cumulative ``_bucket{le=...}`` series); MutableQuantiles
+    stays alongside for JMX parity — same samples, two expositions."""
+
+    # 0.25 ms .. ~128 s, ×2 per bucket (20 bounds + +Inf)
+    BOUNDS = tuple(0.00025 * (2 ** i) for i in range(20))
+
+    def __init__(self, name: str, description: str = ""):
+        self.name = name
+        self.description = description
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.BOUNDS) + 1)
+        self._sum = 0.0
+        self._n = 0
+
+    def add(self, v: float) -> None:
+        with self._lock:
+            self._n += 1
+            self._sum += v
+            i = bisect.bisect_left(self.BOUNDS, v)
+            self._counts[i] += 1
+
+    def time(self):
+        return _Timer(self)
+
+    def buckets(self):
+        """[(upper_bound_or_inf, cumulative_count)], plus (sum, count)."""
+        with self._lock:
+            counts = list(self._counts)
+            total, n = self._sum, self._n
+        out = []
+        cum = 0
+        for bound, c in zip(self.BOUNDS, counts):
+            cum += c
+            out.append((bound, cum))
+        out.append((float("inf"), cum + counts[-1]))
+        return out, total, n
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            n, total = self._n, self._sum
+        return {f"{self.name}_count": n,
+                f"{self.name}_sum": round(total, 6),
+                f"{self.name}_mean": (total / n) if n else 0.0}
+
+
 class MetricsRegistry:
     """Per-source registry. Ref: metrics2/lib/MetricsRegistry.java."""
 
@@ -183,6 +232,15 @@ class MetricsRegistry:
 
     def quantiles(self, name: str, description: str = "") -> MutableQuantiles:
         return self._get_or_make(name, lambda: MutableQuantiles(name, description))
+
+    def histogram(self, name: str, description: str = "") -> MutableHistogram:
+        return self._get_or_make(name, lambda: MutableHistogram(name, description))
+
+    def metrics(self) -> List[Any]:
+        """Typed metric objects (the /prom renderer walks these; /jmx
+        keeps using the flattened snapshot)."""
+        with self._lock:
+            return list(self._metrics.values())
 
     def register_callback_gauge(self, name: str, fn: Callable[[], Any]) -> None:
         with self._lock:
@@ -260,6 +318,10 @@ class MetricsSystem:
         with self._lock:
             sources = dict(self._sources)
         return {name: reg.snapshot() for name, reg in sources.items()}
+
+    def sources(self) -> Dict[str, MetricsRegistry]:
+        with self._lock:
+            return dict(self._sources)
 
     def publish(self) -> None:
         snap = self.snapshot_all()

@@ -183,12 +183,17 @@ class AsyncCheckpointWriter:
         self._lock = threading.Lock()
 
     def submit(self, fn: Callable[[], Any]) -> None:
-        """Fence the previous write, then run ``fn`` in the background."""
+        """Fence the previous write, then run ``fn`` in the background.
+        The writer thread carries the submitter's span context, so any
+        span the write creates joins the training step's trace instead
+        of starting an orphan root."""
         self.wait()
+        from hadoop_tpu.tracing.tracer import carry_context
+        traced_fn = carry_context(fn)
 
         def run():
             try:
-                fn()
+                traced_fn()
             except BaseException as e:  # noqa: BLE001 — deferred to wait()
                 log.warning("async checkpoint write failed: %s", e)
                 with self._lock:

@@ -15,11 +15,15 @@ import threading
 from collections import deque
 from typing import Dict, Optional
 
+import time
+
 import jax
 import jax.numpy as jnp
 
 from hadoop_tpu.fs import FileSystem
+from hadoop_tpu.metrics import metrics_system
 from hadoop_tpu.models.config import ModelConfig
+from hadoop_tpu.tracing.tracer import global_tracer
 from hadoop_tpu.parallel.checkpoint import (AsyncCheckpointWriter,
                                             latest_step, load_checkpoint,
                                             reorder_snapshot_axis0,
@@ -76,6 +80,28 @@ class Trainer:
             jax.random.PRNGKey(0), cfg, plan, self.mesh, zero1=self.zero1)
         self.step = 0
         self.losses: list = []
+        # Step anatomy as a LIVE surface (profile_train's one-shot
+        # accounting, always on): /jmx and /prom see exactly where a
+        # step's wall time goes — data wait vs dispatched step vs the
+        # checkpoint snapshot/fence the async writer still charges the
+        # loop for. Rates carry JMX parity; histograms feed /prom.
+        reg = metrics_system().source("trainer")
+        self._m_steps = reg.counter("steps", "completed train steps")
+        self._m_data_wait = reg.rate(
+            "data_wait", "time blocked on the prefetch queue")
+        self._m_data_wait_hist = reg.histogram(
+            "data_wait_seconds", "time blocked on the prefetch queue")
+        self._m_step_wall = reg.rate(
+            "step_wall", "dispatch-to-dispatch step wall time")
+        self._m_step_wall_hist = reg.histogram(
+            "step_wall_seconds", "dispatch-to-dispatch step wall time")
+        self._m_ckpt_snapshot = reg.rate(
+            "ckpt_snapshot", "blocking device->host snapshot of a save")
+        self._m_ckpt_write = reg.rate(
+            "ckpt_write", "background DFS write of a save")
+        self._m_ckpt_fence = reg.rate(
+            "ckpt_fence", "time a save/restore stalled on the writer")
+        self._tracer = global_tracer()
         # Cursor of the last batch a completed step CONSUMED — set only
         # while train() runs (the prefetch thread advances the dataset
         # ahead of consumption, so the dataset's own cursor overstates
@@ -109,7 +135,9 @@ class Trainer:
         """
         if wait is None:
             wait = True
+        t_fence = time.monotonic()
         self._ckpt_writer.wait()  # fence: surfaces a prior write failure
+        self._m_ckpt_fence.add(time.monotonic() - t_fence)
         tree = self._state_tree()
         # The data cursor rides as an extra leaf, split into two int32
         # halves: datasets beyond 2**31 tokens are ordinary LM scale and
@@ -120,21 +148,35 @@ class Trainer:
         pos = cursor["pos"] % max(self.data.total_tokens, 1)
         tree = dict(tree, data_pos=jnp.asarray(
             [pos >> 31, pos & 0x7FFFFFFF], jnp.int32))
-        snap = snapshot_tree(tree)
+        with self._tracer.span("trainer.ckpt.snapshot") as ssp:
+            t_snap = time.monotonic()
+            snap = snapshot_tree(tree)
+            self._m_ckpt_snapshot.add(time.monotonic() - t_snap)
+            ssp.add_kv("step", str(self.step))
         step, fs, ckpt_dir, keep = self.step, self.fs, self.ckpt_dir, \
             self.keep
         reorder = self._vpp_snapshot_reorder()
+        m_write, tracer = self._m_ckpt_write, self._tracer
 
         def write():
-            path = write_snapshot(fs, ckpt_dir, step,
-                                  reorder(snap) if reorder else snap,
-                                  keep=keep)
+            # the writer thread carries the submitter's context
+            # (AsyncCheckpointWriter wraps with carry_context), so this
+            # span lands in the same trace as the snapshot above
+            with tracer.span("trainer.ckpt.write") as wsp:
+                t_w = time.monotonic()
+                path = write_snapshot(fs, ckpt_dir, step,
+                                      reorder(snap) if reorder else snap,
+                                      keep=keep)
+                m_write.add(time.monotonic() - t_w)
+                wsp.add_kv("step", str(step))
             log.info("checkpoint step %d -> %s", step, path)
 
         if self.async_ckpt:
             self._ckpt_writer.submit(write)
             if wait:
+                t_fence = time.monotonic()
                 self._ckpt_writer.wait()
+                self._m_ckpt_fence.add(time.monotonic() - t_fence)
         else:
             write()
         return f"{self.ckpt_dir}/step_{step:012d}"
@@ -282,30 +324,51 @@ class Trainer:
         step_failed = False
         try:
             for _ in range(n_steps):
+                t_step = time.monotonic()
                 item = q.get()
+                data_wait = time.monotonic() - t_step
                 if isinstance(item, BaseException):
                     raise item
                 tokens, targets, cursor = item
-                self.params, self.opt, metrics = self.step_fn(
-                    self.params, self.opt, tokens, targets)
-                self.step += 1
-                self._inflight_cursor = cursor
-                pending.append(metrics["loss"])
-                # materialize as they age out so self.losses stays
-                # current even if a later step raises; this float() is
-                # the DELIBERATE bounded-in-flight backpressure sync
-                # (see MAX_INFLIGHT above), not a stray stall
-                while len(pending) > self.MAX_INFLIGHT:
-                    val = float(  # lint: disable=jit/blocking-in-step
-                        pending.popleft())
-                    out.append(val)
-                    self.losses.append(val)
-                if self.ckpt_interval and \
-                        self.step % self.ckpt_interval == 0:
-                    # interval saves ride the background writer: the
-                    # step loop pays only the host-snapshot time (the
-                    # train-exit fence below guarantees durability)
-                    self.save(wait=False)
+                # always-on step anatomy: one span per step (the root
+                # of that step's trace — an interval save's snapshot/
+                # write spans join it) + the live data-wait/step-wall
+                # split. step_fn dispatches asynchronously, so
+                # "step wall" is dispatch-to-dispatch time; the
+                # MAX_INFLIGHT float() below is where a device stall
+                # would surface in it.
+                with self._tracer.span("trainer.step") as stsp:
+                    stsp.add_kv("step", str(self.step + 1))
+                    stsp.add_kv("data_wait_ms",
+                                f"{data_wait * 1e3:.2f}")
+                    self.params, self.opt, metrics = self.step_fn(
+                        self.params, self.opt, tokens, targets)
+                    self.step += 1
+                    self._inflight_cursor = cursor
+                    pending.append(metrics["loss"])
+                    # materialize as they age out so self.losses stays
+                    # current even if a later step raises; this float()
+                    # is the DELIBERATE bounded-in-flight backpressure
+                    # sync (see MAX_INFLIGHT above), not a stray stall
+                    while len(pending) > self.MAX_INFLIGHT:
+                        val = float(  # lint: disable=jit/blocking-in-step
+                            pending.popleft())
+                        out.append(val)
+                        self.losses.append(val)
+                    if self.ckpt_interval and \
+                            self.step % self.ckpt_interval == 0:
+                        # interval saves ride the background writer:
+                        # the step loop pays only the host-snapshot
+                        # time (the train-exit fence below guarantees
+                        # durability); the save's snapshot/write spans
+                        # join this step's trace
+                        self.save(wait=False)
+                self._m_steps.incr()
+                self._m_data_wait.add(data_wait)
+                self._m_data_wait_hist.add(data_wait)
+                step_wall = time.monotonic() - t_step
+                self._m_step_wall.add(step_wall)
+                self._m_step_wall_hist.add(step_wall)
         except BaseException:
             step_failed = True
             raise

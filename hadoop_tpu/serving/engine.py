@@ -306,6 +306,10 @@ class GenRequest:
     first_token_at: Optional[float] = None
     preemptions: int = 0
     prefix_tokens_reused: int = 0     # cached tokens mapped at admission
+    # trace context of the request's door span: engine-side spans
+    # (admit/preempt/first-token) run on the scheduler thread where no
+    # contextvar survives, so the context rides the request itself
+    trace_ctx: Optional[Any] = None
     # engine-private placement
     _slot: Optional[int] = None
     _blocks: List[int] = field(default_factory=list)
@@ -559,7 +563,8 @@ class DecodeEngine:
     # -------------------------------------------------------- public face
 
     def submit(self, prompt: List[int],
-               sampling: Optional[SamplingParams] = None) -> GenRequest:
+               sampling: Optional[SamplingParams] = None,
+               trace_ctx=None) -> GenRequest:
         sampling = sampling or SamplingParams()
         if not prompt:
             raise ValueError("empty prompt")
@@ -580,7 +585,9 @@ class DecodeEngine:
             raise ValueError(
                 f"request needs {pages} KV pages but the pool holds only "
                 f"{self.pool.num_usable} — it could never run alone")
-        req = GenRequest(prompt=list(prompt), sampling=sampling)
+        from hadoop_tpu.tracing.tracer import current_context
+        req = GenRequest(prompt=list(prompt), sampling=sampling,
+                         trace_ctx=trace_ctx or current_context())
         with self._cond:
             self._pending.append(req)
             depth = len(self._pending)
@@ -726,7 +733,7 @@ class DecodeEngine:
         self._seq_lens[slot] = 0
         self._active[slot] = False
         self._last_tokens[slot] = 0
-        sp = self.tracer.span("serving.admit")
+        sp = self.tracer.span("serving.admit", parent=req.trace_ctx)
         sp.add_kv("request", str(req.id))
         sp.add_kv("prompt_tokens", str(len(ctx)))
         sp.add_kv("prefix_tokens_reused", str(req.prefix_tokens_reused))
@@ -770,7 +777,9 @@ class DecodeEngine:
             self._pending.appendleft(victim)
         if self.metrics:
             self.metrics.preemptions.incr()
-        self.tracer.span(f"serving.preempt.{victim.id}").finish()
+        psp = self.tracer.span("serving.preempt", parent=victim.trace_ctx)
+        psp.add_kv("request", str(victim.id))
+        psp.finish()
 
     def _release_slot(self, req: GenRequest) -> None:
         slot = req._slot
@@ -866,7 +875,9 @@ class DecodeEngine:
         self.tokens_generated += emitted
         if self.metrics:
             self.metrics.tokens_out.incr(emitted)
-            self.metrics.decode_step.add(time.monotonic() - t0)
+            step_s = time.monotonic() - t0
+            self.metrics.decode_step.add(step_s)
+            self.metrics.decode_step_hist.add(step_s)
         return emitted
 
     def _finish_prefill(self, req: GenRequest, tok: int) -> None:
@@ -888,8 +899,16 @@ class DecodeEngine:
                     req._ctx[:full * self.block_size], req._blocks[:full])
         first = req.first_token_at is None
         req._deliver(tok)
-        if self.metrics and first:
-            self.metrics.ttft.add(req.first_token_at - req.submitted_at)
+        if first:
+            ttft = req.first_token_at - req.submitted_at
+            if self.metrics:
+                self.metrics.ttft.add(ttft)
+                self.metrics.ttft_hist.add(ttft)
+            fsp = self.tracer.span("serving.first_token",
+                                   parent=req.trace_ctx)
+            fsp.add_kv("request", str(req.id))
+            fsp.add_kv("ttft_s", f"{ttft:.6f}")
+            fsp.finish()
         self._maybe_finish(req, tok)
 
     def _maybe_finish(self, req: GenRequest, tok: int) -> None:

@@ -48,8 +48,14 @@ class ServingMetrics:
             "kv_block_utilization", "fraction of the KV pool in use")
         self.ttft = reg.quantiles(
             "time_to_first_token", "submit to first token, seconds")
+        # log-bucketed twins for the /prom exposition (quantiles/rates
+        # stay for JMX parity — same samples, two shapes)
+        self.ttft_hist = reg.histogram(
+            "time_to_first_token_seconds", "submit to first token")
         self.decode_step = reg.rate(
             "decode_step", "one continuous-batching decode step")
+        self.decode_step_hist = reg.histogram(
+            "decode_step_seconds", "one continuous-batching decode step")
         self.tokens_out = reg.counter(
             "tokens_out", "tokens generated (all requests)")
         self.requests = reg.counter("requests", "requests submitted")

@@ -41,6 +41,7 @@ from hadoop_tpu.conf import Configuration
 from hadoop_tpu.ipc.errors import RetriableError
 from hadoop_tpu.ipc.retry import RetryAction, RetryPolicies, RetryPolicy
 from hadoop_tpu.registry.registry import RegistryClient, ServiceRecord
+from hadoop_tpu.tracing.tracer import current_context, global_tracer
 
 log = logging.getLogger(__name__)
 
@@ -165,20 +166,31 @@ class ServingRouter:
 
     def generate(self, payload: Dict, user: Optional[str] = None) -> Dict:
         """POST /v1/generate on a balanced replica; returns the decoded
-        JSON. Retries per policy on transport errors / draining."""
-        return self._with_retry(lambda rec: self._post(rec, payload, user),
-                                self._affinity_key(payload))
+        JSON. Retries per policy on transport errors / draining.
+
+        Roots the request's trace (unless the caller already holds a
+        span): the replica door resumes it from ``X-Htpu-Trace``, so
+        one trace id runs router → door → engine admit → first token."""
+        with global_tracer().span("serving.router.generate") as rsp:
+            rsp.add_kv("prompt_tokens",
+                       str(len(payload.get("tokens") or [])))
+            return self._with_retry(
+                lambda rec: self._post(rec, payload, user),
+                self._affinity_key(payload))
 
     def generate_stream(self, payload: Dict,
                         user: Optional[str] = None) -> Iterator[Dict]:
         """Streaming variant: yields one dict per JSON line. Replica
         choice and retry apply to connection setup only — a stream that
         dies mid-flight surfaces to the caller (resuming a half-decoded
-        request on another replica would re-emit tokens)."""
+        request on another replica would re-emit tokens). The router
+        span covers routing + connection setup (a minutes-long stream
+        must not hold a span open; the replica-side spans carry on)."""
         payload = dict(payload, stream=True)
-        resp, conn, rec = self._with_retry(
-            lambda rec: self._post(rec, payload, user, stream=True)
-            + (rec,), self._affinity_key(payload))
+        with global_tracer().span("serving.router.generate_stream"):
+            resp, conn, rec = self._with_retry(
+                lambda rec: self._post(rec, payload, user, stream=True)
+                + (rec,), self._affinity_key(payload))
         # the stream holds its p2c weight for its whole life, not just
         # connection setup — a minutes-long stream is real load
         with self._lock:
@@ -241,8 +253,12 @@ class ServingRouter:
             path += f"?user.name={user}"
         conn = http.client.HTTPConnection(host, int(port), timeout=300.0)
         try:
+            headers = {"Content-Type": "application/json"}
+            ctx = current_context()
+            if ctx is not None:
+                headers["X-Htpu-Trace"] = ctx.to_header()
             conn.request("POST", path, body=json.dumps(payload).encode(),
-                         headers={"Content-Type": "application/json"})
+                         headers=headers)
             resp = conn.getresponse()
             if resp.status == 503:
                 # replica started draining between registry refreshes

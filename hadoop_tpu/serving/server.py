@@ -35,7 +35,7 @@ from hadoop_tpu.conf import Configuration
 from hadoop_tpu.http.server import HttpServer
 from hadoop_tpu.security.http_auth import AuthFilter
 from hadoop_tpu.serving.engine import DecodeEngine, SamplingParams
-from hadoop_tpu.tracing.tracer import global_tracer
+from hadoop_tpu.tracing.tracer import SpanContext, global_tracer
 
 log = logging.getLogger(__name__)
 
@@ -130,11 +130,18 @@ class ServingServer:
             return 400, {"RemoteException": {
                 "exception": "IllegalArgumentException",
                 "message": f"bad generate request: {e}"}}
-        span = self.tracer.span("serving.request")
+        # resume the ROUTER's trace from the X-Htpu-Trace header (the
+        # HTTP twin of the RPC header's SpanContext): the door, engine
+        # admit, and first token all join the request's one trace
+        parent = SpanContext.from_header(query.get("__trace__"))
+        span = self.tracer.span("serving.request", parent=parent)
         span.add_kv("user", query.get("__user__", ""))
         span.add_kv("prompt_tokens", str(len(tokens)))
         try:
-            handle = self.engine.submit(tokens, sampling)
+            # the door span's context rides the request into the engine
+            # so admit/preempt/first-token spans join this trace
+            handle = self.engine.submit(tokens, sampling,
+                                        trace_ctx=span.context())
         except ValueError as e:
             span.finish()
             return 400, {"RemoteException": {
@@ -146,6 +153,15 @@ class ServingServer:
             return 200, self._stream(handle, span)
         try:
             out = handle.wait(timeout=timeout)
+        except RuntimeError as e:
+            # engine failed the request (decode error, stop/drain):
+            # the span must still deliver — the failure path is exactly
+            # where the cross-daemon trace earns its keep
+            span.add_kv("failed", str(e))
+            span.finish()
+            return 500, {"RemoteException": {
+                "exception": "GenerationFailedException",
+                "message": f"request {handle.id}: {e}"}}
         except TimeoutError:
             # 4xx on purpose: the router fails 4xx fast, so a slow
             # generation is NOT replayed end-to-end on every other

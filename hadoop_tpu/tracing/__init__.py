@@ -1,3 +1,6 @@
-from hadoop_tpu.tracing.tracer import Tracer, Span, SpanContext, current_span
+from hadoop_tpu.tracing.tracer import (Span, SpanContext, Tracer,
+                                       carry_context, current_context,
+                                       current_span, global_tracer)
 
-__all__ = ["Tracer", "Span", "SpanContext", "current_span"]
+__all__ = ["Tracer", "Span", "SpanContext", "current_span",
+           "current_context", "carry_context", "global_tracer"]

@@ -1,0 +1,193 @@
+"""Per-process span collector: bounded ring buffer + slow-trace flight
+recorder behind every daemon's ``/ws/v1/traces`` endpoints.
+
+The tracer's in-memory ``finished`` list is a test convenience; this is
+the production receiver: finished spans land in a bounded ring (drops
+counted, never blocking the hot path), and any span whose duration
+crosses its conf-keyed slow threshold promotes its WHOLE trace — every
+buffered span sharing the trace id — into a retained flight-recorder
+buffer and logs one structured line. The flight recorder is how a slow
+``/v1/generate`` or a stalled training step keeps its cross-plane
+evidence (the DataNode hop, the collective, the checkpoint fence) after
+the ring has churned past it.
+
+Thresholds (milliseconds; 0 disables a rule):
+
+  ``tracing.slow.rpc.ms``      RPC handler + client spans   (default 300)
+  ``tracing.slow.xceiver.ms``  ``dfs.xceiver.*`` block ops  (default 500)
+  ``tracing.slow.step.ms``     ``trainer.step*``            (default 1000)
+  ``tracing.slow.serving.ms``  ``serving.*`` door/engine    (default 1000)
+
+Sizing: ``tracing.collector.max-spans`` (ring, default 4096) and
+``tracing.flight.max-traces`` (retained slow traces, default 32).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from hadoop_tpu.tracing.tracer import Span, Tracer, global_tracer
+
+log = logging.getLogger(__name__)
+
+# span-name prefix → (conf key, default ms); first match wins, the rpc
+# rule is the catch-all (RPC server spans are named <daemon>.<method>).
+# Long-by-design bulk spans get their OWN rules so routine checkpoint
+# writes / multi-packet client reads don't trip the 300 ms RPC rule and
+# churn the flight recorder with expected traffic.
+_THRESHOLD_RULES = (
+    ("dfs.xceiver.", "tracing.slow.xceiver.ms", 500.0),
+    ("dfs.client.", "tracing.slow.client.ms", 2000.0),
+    ("trainer.ckpt.", "tracing.slow.ckpt.ms", 30000.0),
+    ("trainer.step", "tracing.slow.step.ms", 1000.0),
+    ("serving.", "tracing.slow.serving.ms", 1000.0),
+    ("", "tracing.slow.rpc.ms", 300.0),
+)
+
+
+class SpanCollector:
+    """Bounded ring of finished spans + flight recorder of slow traces."""
+
+    def __init__(self, max_spans: int = 4096, max_traces: int = 32):
+        self._lock = threading.Lock()
+        self.max_spans = max_spans
+        self._ring: deque = deque(maxlen=max_spans)   # guarded-by: _lock
+        self.dropped = 0                              # guarded-by: _lock
+        self._slow: deque = deque(maxlen=max_traces)  # guarded-by: _lock
+        self.slow_promoted = 0                        # guarded-by: _lock
+        # conf key → ms, resolved through configure(); starts at defaults
+        self._thresholds: Dict[str, float] = {
+            key: default for _, key, default in _THRESHOLD_RULES}
+
+    # ------------------------------------------------------------- config
+
+    def configure(self, conf) -> None:
+        """Resolve thresholds and sizes from a daemon's Configuration.
+        Process-global like the tracer itself: the last daemon to start
+        in a shared-process minicluster wins, which is fine — they share
+        one conf lineage."""
+        for _, key, default in _THRESHOLD_RULES:
+            self._thresholds[key] = conf.get_float(key, default)
+        max_spans = conf.get_int("tracing.collector.max-spans",
+                                 self.max_spans)
+        if max_spans != self.max_spans:
+            with self._lock:
+                self.max_spans = max_spans
+                self._ring = deque(self._ring, maxlen=max_spans)
+        with self._lock:
+            cur_max = self._slow.maxlen
+        max_traces = conf.get_int("tracing.flight.max-traces", cur_max)
+        if max_traces != cur_max:
+            with self._lock:
+                self._slow = deque(self._slow, maxlen=max_traces)
+
+    def threshold_ms_for(self, name: str) -> float:
+        for prefix, key, _ in _THRESHOLD_RULES:
+            if name.startswith(prefix):
+                return self._thresholds[key]
+        return self._thresholds["tracing.slow.rpc.ms"]
+
+    # ----------------------------------------------------------- receiver
+
+    def receive(self, span: Span) -> None:
+        """Tracer receiver: ring-buffer the span; promote its trace when
+        it crossed the slow threshold."""
+        ms = span.duration_ms()
+        threshold = self.threshold_ms_for(span.name)
+        slow = 0 < threshold <= ms
+        retained = 0
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(span)
+            if slow:
+                trace = [s for s in self._ring
+                         if s.trace_id == span.trace_id]
+                # one slot per TRACE: a multi-plane trace whose client
+                # read, xceiver hop, and ckpt write all trip their
+                # thresholds must refresh one entry, not occupy three
+                # of the few retained slots (evicting distinct traces)
+                existing = next((t for t in self._slow
+                                 if t["trace_id"] == span.trace_id),
+                                None)
+                spans = [s.to_dict() for s in trace]
+                if existing is not None:
+                    # merge: spans the ring already churned past live
+                    # only in the retained entry — keep them
+                    seen = {s["span_id"] for s in spans}
+                    spans = [s for s in existing["spans"]
+                             if s["span_id"] not in seen] + spans
+                    self._slow.remove(existing)
+                self._slow.append({
+                    "trace_id": span.trace_id,
+                    "trigger": span.name,
+                    "trigger_ms": round(ms, 2),
+                    "threshold_ms": threshold,
+                    "retained_at": time.time(),
+                    "spans": spans,
+                })
+                if existing is None:
+                    self.slow_promoted += 1
+                retained = len(spans)
+        if slow:
+            # exactly one structured line per promotion — greppable,
+            # join-able on trace_id with every other daemon's log
+            log.warning(
+                "slow-trace trace_id=%016x trigger=%s ms=%.1f "
+                "threshold_ms=%.0f spans_retained=%d",
+                span.trace_id, span.name, ms, threshold, retained)
+
+    # ------------------------------------------------------------ queries
+
+    def snapshot(self, trace_id=None, limit: int = 0) -> Dict:
+        """``trace_id``: one id or a collection of candidate ids (the
+        HTTP handler passes both the decimal and hex readings of an
+        ambiguous query string)."""
+        with self._lock:
+            spans = list(self._ring)
+            dropped = self.dropped
+        if trace_id is not None:
+            wanted = (set(trace_id) if isinstance(trace_id, (set, list,
+                                                             tuple))
+                      else {trace_id})
+            spans = [s for s in spans if s.trace_id in wanted]
+        if limit > 0:
+            spans = spans[-limit:]
+        return {"spans": [s.to_dict() for s in spans],
+                "dropped": dropped, "max_spans": self.max_spans}
+
+    def slow_traces(self) -> Dict:
+        with self._lock:
+            return {"traces": list(self._slow),
+                    "promoted": self.slow_promoted,
+                    "max_traces": self._slow.maxlen}
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._slow.clear()
+            self.dropped = 0
+            self.slow_promoted = 0
+            # a prior test's conf (e.g. a near-zero serving threshold)
+            # must not leak promotions into later tests
+            self._thresholds = {key: default
+                                for _, key, default in _THRESHOLD_RULES}
+
+
+_collector: Optional[SpanCollector] = None
+_collector_lock = threading.Lock()
+
+
+def span_collector(tracer: Optional[Tracer] = None) -> SpanCollector:
+    """Process-wide collector, installed as a receiver on the global
+    tracer (or ``tracer``) on first use."""
+    global _collector
+    with _collector_lock:
+        if _collector is None:
+            _collector = SpanCollector()
+            (tracer or global_tracer()).add_receiver(_collector.receive)
+        return _collector

@@ -6,10 +6,22 @@ hadoop-common/pom.xml:286-287; span creation hdfs/DFSClient.java:1563;
 propagation ipc/Server.java:121-123 SpanId in RPC headers; runtime-configurable
 receivers tracing/TracerConfigurationManager.java, TraceAdmin.java).
 
-A Span carries (trace_id, span_id, parent_id); the active span lives in a
-contextvar so nested ``with tracer.span(...)`` calls parent correctly across
-threads spawned with Span-aware helpers. Receivers are callables fed finished
-spans; the default in-memory receiver backs tests and the /tracing endpoint.
+A Span carries (trace_id, span_id, parent_id, sampled); the active span lives
+in a contextvar so nested ``with tracer.span(...)`` calls parent correctly
+across threads spawned with the span-aware helpers below (``carry_context``
+wraps a callable so the spawning thread's active span survives into the new
+thread — the seam the async checkpoint writer and hedged-read pool ride).
+
+Sampling is decided ONCE, at root-span creation, and the verdict travels in
+``SpanContext`` across every wire hop — children (local or remote) inherit
+it, so a trace is delivered all-or-nothing. (The seed flipped a coin per
+*finished* span in ``_deliver``, which shredded every trace at
+sample_rate < 1.0: each span of one trace was kept or dropped
+independently.)
+
+Receivers are callables fed finished spans; the in-memory list backs tests
+and ``tracing.collector.SpanCollector`` is the production receiver behind
+``/ws/v1/traces``.
 """
 
 from __future__ import annotations
@@ -28,32 +40,53 @@ _active: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
 
 
 class SpanContext:
-    """Wire form of a span: what travels in RPC headers."""
+    """Wire form of a span: what travels in RPC / data-transfer / HTTP
+    headers. ``sampled`` is the root's sampling verdict — every hop
+    honors it instead of re-rolling."""
 
-    __slots__ = ("trace_id", "span_id")
+    __slots__ = ("trace_id", "span_id", "sampled")
 
-    def __init__(self, trace_id: int, span_id: int):
+    def __init__(self, trace_id: int, span_id: int, sampled: bool = True):
         self.trace_id = trace_id
         self.span_id = span_id
+        self.sampled = sampled
 
     def to_wire(self) -> Dict[str, int]:
-        return {"t": self.trace_id, "s": self.span_id}
+        return {"t": self.trace_id, "s": self.span_id,
+                "sm": 1 if self.sampled else 0}
 
     @classmethod
     def from_wire(cls, d: Optional[Dict[str, int]]) -> Optional["SpanContext"]:
         if not d:
             return None
-        return cls(d["t"], d["s"])
+        # pre-sampled-bit peers omit "sm": treat as sampled (the old
+        # behavior for a delivered context)
+        return cls(d["t"], d["s"], bool(d.get("sm", 1)))
+
+    def to_header(self) -> str:
+        """Compact HTTP-header form (``X-Htpu-Trace``)."""
+        return f"{self.trace_id:x}:{self.span_id:x}:{int(self.sampled)}"
+
+    @classmethod
+    def from_header(cls, h: Optional[str]) -> Optional["SpanContext"]:
+        if not h:
+            return None
+        try:
+            t, s, sm = h.split(":")
+            return cls(int(t, 16), int(s, 16), sm != "0")
+        except (ValueError, AttributeError):
+            return None
 
 
 class Span:
     def __init__(self, tracer: "Tracer", name: str, trace_id: int,
-                 parent_id: Optional[int]):
+                 parent_id: Optional[int], sampled: bool = True):
         self.tracer = tracer
         self.name = name
         self.trace_id = trace_id
         self.span_id = random.getrandbits(63)
         self.parent_id = parent_id
+        self.sampled = sampled
         self.start = time.time()
         self.end: Optional[float] = None
         self.annotations: List[str] = []
@@ -67,7 +100,11 @@ class Span:
         self.kv[k] = v
 
     def context(self) -> SpanContext:
-        return SpanContext(self.trace_id, self.span_id)
+        return SpanContext(self.trace_id, self.span_id, self.sampled)
+
+    def duration_ms(self) -> float:
+        return ((self.end if self.end is not None else time.time())
+                - self.start) * 1e3
 
     def __enter__(self) -> "Span":
         self._token = _active.set(self)
@@ -97,12 +134,36 @@ def current_span() -> Optional[Span]:
     return _active.get()
 
 
-class Tracer:
-    """Per-process tracer with sampling and pluggable receivers."""
+def current_context() -> Optional[SpanContext]:
+    """Wire context of the active span, if any — what a client attaches
+    to an outgoing RPC / data-transfer op / HTTP request."""
+    sp = _active.get()
+    return sp.context() if sp is not None else None
 
-    def __init__(self, name: str = "htpu", sample_rate: float = 1.0):
+
+def carry_context(fn: Callable) -> Callable:
+    """Span-aware thread seam: capture the CALLER's contextvars (incl.
+    the active span) and run ``fn`` under them in whatever thread
+    eventually calls the wrapper. Spans created inside the target
+    thread then parent into the spawning trace instead of starting
+    orphan roots — the helper behind the async checkpoint writer and
+    the hedged-read pool (the async seams ISSUE 4 opened)."""
+    ctx = contextvars.copy_context()
+
+    def run(*args, **kwargs):
+        return ctx.run(fn, *args, **kwargs)
+    return run
+
+
+class Tracer:
+    """Per-process tracer with root-decided sampling and pluggable
+    receivers."""
+
+    def __init__(self, name: str = "htpu", sample_rate: float = 1.0,
+                 rng: Optional[random.Random] = None):
         self.name = name
         self.sample_rate = sample_rate
+        self._rng = rng or random
         self._receivers: List[Callable[[Span], None]] = []
         self._lock = threading.Lock()
         self.finished: List[Span] = []  # in-memory receiver (tests, /tracing)
@@ -115,17 +176,24 @@ class Tracer:
 
     def span(self, name: str, parent: Optional[SpanContext] = None) -> Span:
         """New span: child of ``parent`` (wire context), else of the active
-        span, else a new trace root. Unsampled traces still produce Span
-        objects (cheap) but aren't delivered."""
+        span, else a new trace root. Children inherit the root's sampling
+        verdict; only a ROOT rolls the dice — so a trace is delivered
+        all-or-nothing. Unsampled traces still produce Span objects
+        (cheap) but aren't delivered."""
         cur = _active.get()
         if parent is not None:
-            return Span(self, name, parent.trace_id, parent.span_id)
+            return Span(self, name, parent.trace_id, parent.span_id,
+                        sampled=parent.sampled)
         if cur is not None:
-            return Span(self, name, cur.trace_id, cur.span_id)
-        return Span(self, name, random.getrandbits(63), None)
+            return Span(self, name, cur.trace_id, cur.span_id,
+                        sampled=cur.sampled)
+        sampled = (self.sample_rate >= 1.0 or
+                   self._rng.random() < self.sample_rate)
+        return Span(self, name, random.getrandbits(63), None,
+                    sampled=sampled)
 
     def _deliver(self, span: Span) -> None:
-        if self.sample_rate < 1.0 and random.random() > self.sample_rate:
+        if not span.sampled:
             return
         with self._lock:
             if self._keep_in_memory:

@@ -29,6 +29,11 @@ import pytest
 logging.basicConfig(level=logging.INFO)
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 run (-m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def _reset_global_state():
     """Each test gets a clean config registry and metrics system."""
@@ -41,3 +46,7 @@ def _reset_global_state():
     datatransfer.set_default_security(None)
     from hadoop_tpu.security.ugi import UserGroupInformation
     UserGroupInformation._login_user = None
+    from hadoop_tpu.tracing.collector import span_collector
+    span_collector().reset_for_tests()
+    from hadoop_tpu.tracing.tracer import global_tracer
+    global_tracer().set_sample_rate(1.0)

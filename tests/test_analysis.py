@@ -502,6 +502,82 @@ def test_narrow_or_logged_excepts_are_clean(tmp_path):
     assert findings == []
 
 
+# ------------------------------------------------ trace span discipline
+
+def test_span_never_finished_is_flagged(tmp_path):
+    from hadoop_tpu.analysis import SpanFinishChecker
+    findings = lint_source(tmp_path, """
+        def handler(tracer, work):
+            sp = tracer.span("op")     # BAD: never finished
+            sp.add_kv("k", "v")
+            return work()
+    """, [SpanFinishChecker()])
+    assert ids_of(findings) == ["trace/span-not-finished"]
+
+
+def test_span_bare_call_is_flagged(tmp_path):
+    from hadoop_tpu.analysis import SpanFinishChecker
+    findings = lint_source(tmp_path, """
+        def handler(tracer):
+            tracer.span("op")          # BAD: dropped on the floor
+    """, [SpanFinishChecker()])
+    assert ids_of(findings) == ["trace/span-not-finished"]
+
+
+def test_span_exception_edge_leak_is_flagged(tmp_path):
+    from hadoop_tpu.analysis import SpanFinishChecker
+    findings = lint_source(tmp_path, """
+        def handler(tracer, work):
+            sp = tracer.span("op")
+            result = work()            # raises past the finish below
+            sp.finish()
+            return result
+    """, [SpanFinishChecker()])
+    assert ids_of(findings) == ["trace/span-not-finished"]
+    assert "exception edge" in findings[0].message
+
+
+def test_span_good_shapes_are_clean(tmp_path):
+    from hadoop_tpu.analysis import SpanFinishChecker
+    findings = lint_source(tmp_path, """
+        def ctx_manager(tracer, work):
+            with tracer.span("op") as sp:
+                sp.add_kv("k", "v")
+                return work()
+
+        def named_ctx_manager(tracer, work):
+            sp = tracer.span("op")
+            with sp:
+                return work()
+
+        def fire_and_forget(tracer):
+            tracer.span("marker").finish()
+
+        def try_finally(tracer, work):
+            sp = tracer.span("op")
+            try:
+                return work()
+            finally:
+                sp.finish()
+
+        def annotate_then_finish(tracer, n):
+            sp = tracer.span("op")
+            sp.add_kv("n", str(n))     # span methods + safe builtins
+            sp.finish()                # can't raise past the finish
+
+        def escapes(tracer, sink):
+            sp = tracer.span("op")     # finished by the sink
+            sink(sp)
+
+        def conditional_cm(tracer, ctx, work):
+            import contextlib
+            cm = (tracer.span("op") if ctx else contextlib.nullcontext())
+            with cm:
+                return work()
+    """, [SpanFinishChecker()])
+    assert findings == []
+
+
 # -------------------------------------------- suppression + baseline
 
 def test_line_suppression(tmp_path):

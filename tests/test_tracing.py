@@ -1,0 +1,522 @@
+"""The unified telemetry plane: root-decided sampling, the span
+collector + flight recorder, /prom exposition, and — the acceptance
+path — cross-plane trace assembly: one trace id from the serving door
+(resp. the DFS client) through every daemon it touched, pulled back out
+of each daemon's ``/ws/v1/traces``.
+"""
+
+import http.client
+import json
+import random
+import re
+import time
+
+import jax
+import pytest
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.tracing.collector import SpanCollector, span_collector
+from hadoop_tpu.tracing.tracer import (SpanContext, Tracer, global_tracer)
+
+# ---------------------------------------------------------------- helpers
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10.0)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read()
+        return resp.status, body
+    finally:
+        conn.close()
+
+
+def _get_json(port, path):
+    status, body = _get(port, path)
+    assert status == 200, body
+    return json.loads(body)
+
+
+def _trace_names(port, trace_id):
+    """Span names for one trace id, pulled from a daemon's collector."""
+    snap = _get_json(port, f"/ws/v1/traces?trace_id={trace_id}")
+    return {s["name"] for s in snap["spans"]}
+
+
+def _abrupt_stream_client(port, method, path, body=b""):
+    """Open a RAW socket request and return (sock, first_chunk). The
+    caller kills it with _rst_close — http.client keeps the fd alive
+    through the response object, which can't model a crashed client."""
+    import socket
+    sock = socket.create_connection(("127.0.0.1", port), timeout=10.0)
+    req = (f"{method} {path} HTTP/1.1\r\nHost: x\r\n"
+           f"Content-Length: {len(body)}\r\n"
+           "Content-Type: application/json\r\n\r\n").encode() + body
+    sock.sendall(req)
+    first = sock.recv(65536)
+    return sock, first
+
+
+def _rst_close(sock):
+    """Close with SO_LINGER=0: an immediate RST, like a killed client —
+    the server's next write fails instead of filling buffers forever."""
+    import socket as _s
+    import struct
+    sock.setsockopt(_s.SOL_SOCKET, _s.SO_LINGER,
+                    struct.pack("ii", 1, 0))
+    sock.close()
+
+
+# ----------------------------------------------------- sampling (all-or-none)
+
+
+def test_sampling_decided_at_root_is_all_or_nothing():
+    """Regression for the per-span coin flip: at sample_rate < 1 every
+    trace must be delivered whole or not at all — including spans
+    resumed from a wire context on 'another process'."""
+    tr = Tracer(sample_rate=0.5, rng=random.Random(7))
+    for _ in range(60):
+        with tr.span("root") as root:
+            with tr.span("child"):
+                pass
+            # remote hop: resume via the serialized wire context
+            ctx = SpanContext.from_wire(root.context().to_wire())
+            tr.span("remote", parent=ctx).finish()
+    by_trace = {}
+    for s in tr.finished:
+        by_trace.setdefault(s.trace_id, []).append(s.name)
+    assert 0 < len(by_trace) < 60          # some kept, some dropped
+    for names in by_trace.values():
+        assert sorted(names) == ["child", "remote", "root"], \
+            "a sampled trace was shredded"
+
+
+def test_sample_rate_zero_drops_remote_children_too():
+    tr = Tracer(sample_rate=0.0)
+    root = tr.span("root")
+    ctx = SpanContext.from_wire(root.context().to_wire())
+    assert ctx.sampled is False
+    tr.span("remote", parent=ctx).finish()
+    root.finish()
+    assert tr.finished == []
+
+
+def test_wire_context_without_sampled_bit_defaults_to_sampled():
+    # pre-upgrade peers send {"t","s"} only
+    ctx = SpanContext.from_wire({"t": 1, "s": 2})
+    assert ctx.sampled is True
+
+
+def test_header_roundtrip():
+    ctx = SpanContext(0xdeadbeef, 0x1234, False)
+    back = SpanContext.from_header(ctx.to_header())
+    assert (back.trace_id, back.span_id, back.sampled) == \
+        (0xdeadbeef, 0x1234, False)
+    assert SpanContext.from_header("") is None
+    assert SpanContext.from_header("garbage") is None
+
+
+def test_carry_context_parents_across_threads():
+    import threading
+    from hadoop_tpu.tracing.tracer import carry_context
+    tr = Tracer()
+    got = {}
+
+    def work():
+        sp = tr.span("inner")
+        got["trace"] = sp.trace_id
+        sp.finish()
+
+    with tr.span("outer") as outer:
+        t = threading.Thread(target=carry_context(work))
+        t.start()
+        t.join()
+    assert got["trace"] == outer.trace_id
+
+
+# ------------------------------------------------------- collector + flight
+
+
+def test_collector_ring_bounds_and_drop_counter():
+    col = SpanCollector(max_spans=8, max_traces=4)
+    tr = Tracer()
+    tr.add_receiver(col.receive)
+    for i in range(20):
+        tr.span(f"op{i}").finish()
+    snap = col.snapshot()
+    assert len(snap["spans"]) == 8
+    assert snap["dropped"] == 12
+    assert snap["spans"][-1]["name"] == "op19"
+
+
+def test_flight_recorder_promotes_whole_slow_trace():
+    col = SpanCollector()
+    conf = Configuration(load_defaults=False)
+    conf.set("tracing.slow.rpc.ms", "5")
+    col.configure(conf)
+    tr = Tracer()
+    tr.add_receiver(col.receive)
+    with tr.span("namenode.slow_op") as root:
+        tr.span("namenode.fast_child").finish()   # fast: not a trigger
+        time.sleep(0.02)                          # root crosses 5 ms
+    slow = col.slow_traces()
+    assert slow["promoted"] == 1
+    trace = slow["traces"][0]
+    assert trace["trigger"] == "namenode.slow_op"
+    assert trace["trigger_ms"] >= 5
+    # the WHOLE trace was retained, not just the trigger span
+    names = {s["name"] for s in trace["spans"]}
+    assert names == {"namenode.slow_op", "namenode.fast_child"}
+    assert trace["trace_id"] == root.trace_id
+
+
+def test_slow_thresholds_are_conf_keyed_per_plane():
+    col = SpanCollector()
+    conf = Configuration(load_defaults=False)
+    conf.set("tracing.slow.xceiver.ms", "123")
+    conf.set("tracing.slow.step.ms", "456")
+    conf.set("tracing.slow.serving.ms", "789")
+    conf.set("tracing.slow.rpc.ms", "42")
+    col.configure(conf)
+    assert col.threshold_ms_for("dfs.xceiver.read_block") == 123
+    assert col.threshold_ms_for("trainer.step") == 456
+    assert col.threshold_ms_for("serving.request") == 789
+    assert col.threshold_ms_for("namenode.mkdirs") == 42
+    # long-by-design bulk spans have their own (lenient) rules — they
+    # must NOT fall through to the 42 ms RPC catch-all
+    assert col.threshold_ms_for("trainer.ckpt.write") == 30000
+    assert col.threshold_ms_for("dfs.client.read") == 2000
+    # reset restores defaults: a test's near-zero threshold can't leak
+    col.reset_for_tests()
+    assert col.threshold_ms_for("namenode.mkdirs") == 300
+
+
+def test_traces_endpoint_accepts_hex_and_decimal_trace_ids():
+    """The slow-trace log line and X-Htpu-Trace header print hex; the
+    query must accept that form (and plain decimal) or the
+    grep-the-log-then-query workflow dead-ends."""
+    from hadoop_tpu.http.server import HttpServer
+    tracer = global_tracer()
+    with tracer.span("probe.op") as sp:
+        pass
+    srv = HttpServer(Configuration(load_defaults=False), daemon_name="t")
+    srv.start()
+    try:
+        for form in (str(sp.trace_id), f"{sp.trace_id:016x}",
+                     f"0x{sp.trace_id:x}"):
+            snap = _get_json(srv.port, f"/ws/v1/traces?trace_id={form}")
+            assert any(s["name"] == "probe.op" for s in snap["spans"]), \
+                f"form {form!r} found nothing"
+        status, _ = _get(srv.port, "/ws/v1/traces?trace_id=zzz")
+        assert status == 400
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------------------- /prom parsing
+
+_PROM_LINE = re.compile(
+    r"^(?:# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .*"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^}]*\})? "
+    r"(?:[-+]?[0-9.eE+-]+|\+Inf|-Inf|NaN))$")
+
+
+def _assert_parseable_prom(text):
+    assert text.strip(), "empty /prom body"
+    for line in text.strip().splitlines():
+        assert _PROM_LINE.match(line), f"unparseable prom line: {line!r}"
+    types = dict(re.findall(r"# TYPE (\S+) (\S+)", text))
+    return types
+
+
+# --------------------------------------------------- miniDFS: one trace id
+
+
+def test_minidfs_one_trace_across_planes_and_prom(tmp_path):
+    """The DFS acceptance path, one cluster: (1) a single block read
+    under one client root span yields ONE trace_id whose spans cover
+    the client read, the NameNode RPC handler, and the DataNode
+    xceiver — verified by pulling /ws/v1/traces from every daemon's
+    HTTP server; (2) a pipelined write joins the client trace the same
+    way; (3) /prom on both daemons is parseable and carries counters,
+    gauges, and the new log-bucketed histograms."""
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster
+    conf = Configuration(load_defaults=False)
+    conf.set("dfs.replication", "1")
+    # force the remote (TCP xceiver) read path — short-circuit would
+    # bypass the DN entirely and there'd be no DN hop to trace
+    conf.set("dfs.client.read.shortcircuit", "false")
+    with MiniDFSCluster(num_datanodes=1, conf=conf,
+                        base_dir=str(tmp_path / "traced")) as cluster:
+        fs = cluster.get_filesystem()
+        tracer = global_tracer()
+        nn_port = cluster.namenode.http.port
+        dn_port = cluster.datanodes[0].http.port
+
+        # ---- write: the pipeline setup frame carries the context
+        span_collector().reset_for_tests()
+        with tracer.span("fsshell.put") as wroot:
+            payload = b"traced-bytes" * 1000
+            with fs.create("/traced.bin") as out:
+                out.write(payload)
+        wnames = _trace_names(dn_port, wroot.trace_id)
+        assert "dfs.xceiver.write_block" in wnames
+        snap = _get_json(dn_port,
+                         f"/ws/v1/traces?trace_id={wroot.trace_id}")
+        wr = [s for s in snap["spans"]
+              if s["name"] == "dfs.xceiver.write_block"][0]
+        assert wr["kv"]["crc_ok"] == "true"
+        assert wr["kv"]["pipeline_remaining"] == "0"  # single-DN chain
+
+        # ---- read: ONE assembled trace across all three planes
+        with tracer.span("fsshell.cat") as root:   # the client-side root
+            assert fs.read_all("/traced.bin") == payload
+        trace_id = root.trace_id
+        # every daemon's collector (one per process; the minicluster's
+        # daemons share this process) shows the SAME assembled trace
+        for port in (nn_port, dn_port):
+            names = _trace_names(port, trace_id)
+            # plane 1: client
+            assert "fsshell.cat" in names
+            assert "dfs.client.read" in names
+            # plane 2: NN RPC handler (resumed from the RPC header)
+            assert any(n.startswith("namenode.") for n in names), names
+            # plane 3: DN xceiver (resumed from the op frame header)
+            assert "dfs.xceiver.read_block" in names
+        # the xceiver annotated data-plane facts onto the client trace
+        snap = _get_json(dn_port, f"/ws/v1/traces?trace_id={trace_id}")
+        xc = [s for s in snap["spans"]
+              if s["name"] == "dfs.xceiver.read_block"]
+        assert xc and int(xc[0]["kv"]["bytes"]) > 0
+
+        # ---- /prom on both daemons
+        for port in (nn_port, dn_port):
+            status, body = _get(port, "/prom")
+            assert status == 200
+            types = _assert_parseable_prom(body.decode())
+            assert {"counter", "gauge", "histogram"} <= \
+                set(types.values()), types
+        _, body = _get(dn_port, "/prom")
+        text = body.decode()
+        assert "htpu_read_block_seconds_bucket" in text
+        assert 'le="+Inf"' in text
+
+
+# ------------------------------------------- serving: one trace id + /prom
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from hadoop_tpu.models.config import get_config
+    from hadoop_tpu.models.decoder import init_params
+    cfg = get_config("tiny")
+    return init_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+def test_router_to_replica_generate_is_one_trace(tiny_model):
+    """router → replica door → engine admit → first token all share the
+    request's trace id (header-propagated), pulled from the replica's
+    /ws/v1/traces; the flight recorder retains the trace when the
+    serving threshold trips."""
+    from hadoop_tpu.registry import (RegistryClient, RegistryServer,
+                                     ServiceRecord)
+    from hadoop_tpu.serving.engine import DecodeEngine
+    from hadoop_tpu.serving.metrics import ServingMetrics
+    from hadoop_tpu.serving.router import ServingRouter, replica_path
+    from hadoop_tpu.serving.server import ServingServer
+    params, cfg = tiny_model
+    conf = Configuration(load_defaults=False)
+    # any serving.request span longer than 0.01 ms trips the recorder
+    conf.set("tracing.slow.serving.ms", "0.01")
+    reg_srv = RegistryServer(conf)
+    reg_srv.init(conf)
+    reg_srv.start()
+    # reset BEFORE the replica configures the collector: reset restores
+    # default thresholds, which would undo the 0.01 ms one above
+    span_collector().reset_for_tests()
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                       max_context=32, metrics=ServingMetrics())
+    srv = ServingServer(eng, conf)
+    eng.start()
+    srv.start()
+    assert span_collector().threshold_ms_for("serving.request") == 0.01
+    router = None
+    try:
+        reg_addr = ("127.0.0.1", reg_srv.port)
+        rc = RegistryClient(reg_addr, conf)
+        rc.register(ServiceRecord(
+            replica_path("traced", "r0"),
+            {"http": f"127.0.0.1:{srv.port}"},
+            {"state": "serving"}), ttl_s=30.0, auto_renew=False)
+        router = ServingRouter(reg_addr, "traced", conf, cache_ttl_s=0.0)
+        out = router.generate({"tokens": [3, 4, 5], "max_new_tokens": 4})
+        assert len(out["tokens"]) == 4
+
+        # the router span is the root; find it in the local tracer
+        roots = [s for s in global_tracer().finished
+                 if s.name == "serving.router.generate"]
+        assert roots, "router did not emit its root span"
+        trace_id = roots[-1].trace_id
+        names = _trace_names(srv.port, trace_id)
+        assert {"serving.router.generate", "serving.request",
+                "serving.admit", "serving.first_token"} <= names, names
+
+        # flight recorder: the serving.request span crossed 0.01 ms
+        slow = _get_json(srv.port, "/ws/v1/traces/slow")
+        assert any(t["trace_id"] == trace_id for t in slow["traces"])
+
+        # /prom on the replica: counters + gauges + histograms
+        status, body = _get(srv.port, "/prom")
+        assert status == 200
+        types = _assert_parseable_prom(body.decode())
+        assert {"counter", "gauge", "histogram"} <= set(types.values())
+        assert "htpu_decode_step_seconds_bucket" in body.decode()
+        rc.close()
+    finally:
+        if router is not None:
+            router.close()
+        srv.stop()
+        reg_srv.stop()
+
+
+def test_stream_span_finishes_on_client_disconnect(tiny_model):
+    """Satellite regression: a client that abandons a stream mid-flight
+    must still finish the door's serving.request span (the chassis
+    close()s the abandoned generator; its finally finishes the span)."""
+    from hadoop_tpu.serving.engine import DecodeEngine
+    from hadoop_tpu.serving.server import ServingServer
+    params, cfg = tiny_model
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                       max_context=128)
+    srv = ServingServer(eng, Configuration(load_defaults=False))
+    eng.start()
+    srv.start()
+    try:
+        before = len(global_tracer().finished)
+        body = json.dumps({"tokens": [3, 4, 5], "max_new_tokens": 120,
+                           "stream": True}).encode()
+        sock, first = _abrupt_stream_client(srv.port, "POST",
+                                            "/v1/generate", body)
+        assert b"200" in first.split(b"\r\n", 1)[0]
+        _rst_close(sock)                  # crash mid-stream
+        deadline = time.monotonic() + 15.0
+        finished = []
+        while time.monotonic() < deadline:
+            finished = [s for s in global_tracer().finished[before:]
+                        if s.name == "serving.request"]
+            if finished:
+                break
+            time.sleep(0.05)
+        assert finished, ("serving.request span leaked after client "
+                          "disconnect")
+    finally:
+        srv.stop()
+
+
+def test_failed_generation_returns_500_and_delivers_span(tiny_model):
+    """A request the engine FAILS (stop/drain, decode error) must still
+    deliver the serving.request span — the failure path is where the
+    cross-daemon trace earns its keep."""
+    from hadoop_tpu.serving.engine import DecodeEngine
+    from hadoop_tpu.serving.server import ServingServer
+    params, cfg = tiny_model
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                       max_context=32)
+    srv = ServingServer(eng, Configuration(load_defaults=False))
+    # engine deliberately NOT started: stop() fails whatever is queued
+    before = len(global_tracer().finished)
+    result = {}
+
+    def call():
+        result["out"] = srv._generate(
+            {"__trace__": "", "__user__": "t"},
+            json.dumps({"tokens": [1, 2, 3],
+                        "max_new_tokens": 2}).encode())
+
+    import threading
+    t = threading.Thread(target=call)
+    t.start()
+    time.sleep(0.2)          # the request is parked in the queue
+    eng.stop()               # fails it: wait() raises RuntimeError
+    t.join(10.0)
+    status, payload = result["out"]
+    assert status == 500
+    assert "GenerationFailed" in payload["RemoteException"]["exception"]
+    finished = [s for s in global_tracer().finished[before:]
+                if s.name == "serving.request"]
+    assert finished and "failed" in finished[0].kv
+    srv.stop()
+
+
+def test_http_chassis_closes_abandoned_generator():
+    """Chassis-level: a streaming payload generator abandoned by a
+    dying connection runs its cleanup immediately (not at GC)."""
+    import threading
+    from hadoop_tpu.http.server import HttpServer
+    cleaned = threading.Event()
+
+    def gen():
+        try:
+            while True:
+                yield b"x" * 65536
+                time.sleep(0.01)
+        finally:
+            cleaned.set()
+
+    http_srv = HttpServer(Configuration(load_defaults=False),
+                          daemon_name="t")
+    http_srv.add_handler("/stream", lambda q, b: (200, gen()))
+    http_srv.start()
+    try:
+        sock, first = _abrupt_stream_client(http_srv.port, "GET",
+                                            "/stream")
+        assert first
+        _rst_close(sock)
+        assert cleaned.wait(10.0), "generator cleanup never ran"
+    finally:
+        http_srv.stop()
+
+
+# ------------------------------------------------ trainer anatomy metrics
+
+
+@pytest.mark.slow
+def test_trainer_step_anatomy_is_live():
+    """Per-step metrics + spans: data-wait/step-wall rates tick, the
+    ckpt snapshot/write/fence split records, and trainer.step spans
+    reach the collector."""
+    import numpy as np
+    from hadoop_tpu.fs import FileSystem
+    from hadoop_tpu.metrics import metrics_system
+    from hadoop_tpu.models.config import get_config
+    from hadoop_tpu.parallel.mesh import MeshPlan
+    from hadoop_tpu.parallel.trainer import Trainer
+    import tempfile
+    cfg = get_config("tiny")
+    td = tempfile.mkdtemp(prefix="anatomy-")
+    fs = FileSystem.get(f"file://{td}")
+    tokens = np.random.randint(0, cfg.vocab_size, size=(4096,),
+                               ).astype("uint16")
+    with open(f"{td}/data.bin", "wb") as f:
+        f.write(tokens.tobytes())
+    tr = Trainer(cfg, MeshPlan(), fs, f"{td}/data.bin", f"{td}/ckpt",
+                 batch=2, ckpt_interval=2)
+    span_collector().reset_for_tests()
+    tr.train(3)
+    tr.wait_for_checkpoint()
+    snap = metrics_system().source("trainer").snapshot()
+    assert snap["steps"] == 3
+    assert snap["step_wall_num_ops"] == 3
+    assert snap["data_wait_num_ops"] == 3
+    assert snap["ckpt_snapshot_num_ops"] >= 1   # the interval save
+    names = [s["name"] for s in span_collector().snapshot()["spans"]]
+    assert names.count("trainer.step") == 3
+    assert "trainer.ckpt.snapshot" in names
+    assert "trainer.ckpt.write" in names
+    # the async write span joined the step's trace (carried context)
+    spans = span_collector().snapshot()["spans"]
+    write_sp = [s for s in spans if s["name"] == "trainer.ckpt.write"][0]
+    step_traces = {s["trace_id"] for s in spans
+                   if s["name"] == "trainer.step"}
+    assert write_sp["trace_id"] in step_traces
