@@ -99,6 +99,16 @@ def main() -> None:
         # an import-time one) is a data point for the trajectory, never
         # a reason to lose the storage/compute numbers computed above
         out["serving"] = {"error": f"{type(e).__name__}: {e}"}
+    # Replica-churn smoke: kill/restart an engine mid shared-prefix
+    # workload over a miniDFS-backed KV store — fleet hit-rate must
+    # recover via the DFS tier (post-restart hits > 0, strictly fewer
+    # engine steps than the DFS-off arm). Recorded, not raised.
+    try:
+        from benchmarks import serve_bench
+        out["serving_churn"] = serve_bench.run_churn_smoke()
+    except Exception as e:  # noqa: BLE001 — recorded for the
+        # trajectory; must not discard the benches already computed
+        out["serving_churn"] = {"error": f"{type(e).__name__}: {e}"}
     # Training plane: 8-virtual-device overlap smoke (A-B step counts +
     # bit-exact loss parity with the communication-overlap pass on vs
     # off, plus the async-save blocking-time split). Same recorded-not-

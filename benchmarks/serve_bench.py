@@ -209,6 +209,142 @@ def run_shared_prefix(**kw) -> dict:
     return result
 
 
+def run_churn(preset="tiny", prefix_groups=2, shared_len=24,
+              block_size=4, chunk=4, max_new=4, max_batch=4,
+              max_context=None, seed=0) -> dict:
+    """Replica-churn measurement: does fleet hit-rate survive a replica
+    restart? A replica dies with its HBM radix and host ring; only the
+    DFS prefix store outlives it. Two arms, same seed and workload:
+
+    - ``dfs``:  engine 1 serves wave 1 of a shared-prefix workload with
+      the DFS tier on (hot heads persist through the miniDFS write
+      pipeline), then is killed mid-workload. A fresh engine — cold
+      HBM, pointed at the same DFS — serves wave 2 and recovers the
+      shared heads with hedged reads instead of re-prefilling.
+    - ``cold``: identical, DFS tier off — the restart torches
+      everything and wave 2 prefills from scratch.
+
+    The deterministic contract (``failed``): the restarted DFS-arm
+    engine has post-restart hit-rate > 0 with every hit from the DFS
+    tier, and spends STRICTLY fewer engine steps on wave 2 than the
+    cold arm (skipped prefill chunks always mean fewer steps —
+    wall-clock-noise-immune), with both step shapes compiling exactly
+    once per engine."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from hadoop_tpu.models.config import get_config
+    from hadoop_tpu.models.decoder import init_params
+    from hadoop_tpu.serving.engine import DecodeEngine, SamplingParams
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster, fast_conf
+
+    cfg = get_config(preset)
+    if max_context is None:
+        # room for the shared head, the per-request tail, and max_new
+        max_context = min(cfg.max_seq, shared_len + 16 + max_new)
+    rng = np.random.default_rng(seed)
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    heads = [rng.integers(0, cfg.vocab_size, size=shared_len).tolist()
+             for _ in range(prefix_groups)]
+
+    def tail():
+        return rng.integers(0, cfg.vocab_size,
+                            size=int(rng.integers(2, 7))).tolist()
+
+    # wave 1 runs in two sequential half-waves: the second half's
+    # requests re-match the heads the first half inserted — only that
+    # CROSS-REQUEST match makes a head hot (crosses min-refs) and
+    # persists it; submitting both at once would admit every request
+    # cold before any sibling's prefill published its blocks
+    wave1a = [h + tail() for h in heads]
+    wave1b = [h + tail() for h in heads]
+    wave2 = [h + tail() for h in heads for _ in range(2)]
+    sampling = SamplingParams(max_new_tokens=max_new)
+
+    def mk(fs, kvdir):
+        return DecodeEngine(params, cfg, max_batch=max_batch,
+                            block_size=block_size,
+                            max_context=max_context, prefill_chunk=chunk,
+                            kv_store_fs=fs, kv_store_dir=kvdir,
+                            kv_dfs_min_refs=1)
+
+    def wave(eng, prompts):
+        s0 = eng.steps
+        reqs = [eng.submit(p, sampling) for p in prompts]
+        while not all(r.done.is_set() for r in reqs):
+            eng.step()
+        return eng.steps - s0, [r.wait(0) for r in reqs]
+
+    conf = fast_conf()
+    conf.set("dfs.replication", "1")
+    result = {}
+    with tempfile.TemporaryDirectory() as tmp, \
+            MiniDFSCluster(num_datanodes=1, conf=conf,
+                           base_dir=tmp) as cluster:
+        cluster.wait_active()
+        fs = cluster.get_filesystem()
+        for arm, arm_fs in (("dfs", fs), ("cold", None)):
+            e1 = mk(arm_fs, f"/kvcache-{arm}")
+            w1_steps, w1_out = wave(e1, wave1a)
+            w1b_steps, _ = wave(e1, wave1b)
+            w1_steps += w1b_steps
+            if arm_fs is not None:
+                e1.kvstore.flush(60.0)
+            persisted = e1.kvstore.stats()["dfs_persists"]
+            e1.stop()                       # the churn: replica killed —
+            del e1                          # HBM radix + host ring gone
+            e2 = mk(arm_fs, f"/kvcache-{arm}")
+            w2_steps, w2_out = wave(e2, wave2)
+            st = e2.kvstore.stats()
+            result[arm] = {
+                "wave1_steps": w1_steps, "wave2_steps": w2_steps,
+                "persisted_blocks": persisted,
+                "post_restart_hits_dfs": st["hits_dfs"],
+                "post_restart_hit_rate": round(
+                    e2.prefix_tokens_matched /
+                    max(1, e2.prefix_tokens_seen), 4),
+                "decode_compiles": e2.decode_compiles,
+                "prefill_compiles": e2.prefill_compiles,
+                "outputs": w2_out,
+            }
+            e2.stop()
+    failed = []
+    d, c = result["dfs"], result["cold"]
+    if d["outputs"] != c["outputs"]:
+        failed.append("DFS-recovered decode diverged from the cold "
+                      "decode — the tiers are corrupting KV")
+    if d["post_restart_hits_dfs"] <= 0 or \
+            d["post_restart_hit_rate"] <= 0:
+        failed.append(
+            f"hit-rate did not survive the restart: dfs hits "
+            f"{d['post_restart_hits_dfs']}, rate "
+            f"{d['post_restart_hit_rate']}")
+    if d["wave2_steps"] >= c["wave2_steps"]:
+        failed.append(
+            f"post-restart steps not reduced: {d['wave2_steps']} with "
+            f"the DFS tier vs {c['wave2_steps']} cold")
+    for arm in ("dfs", "cold"):
+        for counter in ("decode_compiles", "prefill_compiles"):
+            if result[arm][counter] > 1:
+                failed.append(f"{arm}: {counter} == "
+                              f"{result[arm][counter]} (retracing)")
+        del result[arm]["outputs"]
+    return {
+        "metric": "serve_churn_post_restart_steps",
+        "value": d["wave2_steps"],
+        "unit": "engine steps",
+        "preset": preset,
+        "prefix_groups": prefix_groups,
+        "shared_len": shared_len,
+        "steps_saved_vs_cold": c["wave2_steps"] - d["wave2_steps"],
+        "dfs": d,
+        "cold": c,
+        "failed": failed,
+    }
+
+
 def run_smoke() -> dict:
     """Tiny-config shared-prefix smoke for benchmarks.run_all: raises
     unless the deterministic contract holds (compile-once per shape,
@@ -218,6 +354,15 @@ def run_smoke() -> dict:
                                max_batch=4, block_size=4,
                                max_context=64, chunk=8, seed=0,
                                prefix_groups=2, shared_len=24)
+    if result["failed"]:
+        raise AssertionError("; ".join(result["failed"]))
+    return result
+
+
+def run_churn_smoke() -> dict:
+    """Tiny-config churn smoke for benchmarks.run_all: raises unless
+    fleet hit-rate survives a replica restart via the DFS tier."""
+    result = run_churn(preset="tiny")
     if result["failed"]:
         raise AssertionError("; ".join(result["failed"]))
     return result
@@ -241,6 +386,13 @@ def main(argv=None) -> int:
                          "engine steps, and both step shapes compile "
                          "exactly once (a wall-clock TTFT inversion is "
                          "a warning, not a failure)")
+    ap.add_argument("--churn", action="store_true",
+                    help="kill and restart a replica mid shared-prefix "
+                         "workload over a miniDFS-backed KV store; "
+                         "fails unless post-restart hit-rate is "
+                         "positive (recovered from the DFS tier) with "
+                         "strictly fewer engine steps than the "
+                         "DFS-tier-off arm")
     ap.add_argument("--prefix-groups", type=int, default=4)
     ap.add_argument("--shared-len", type=int, default=80)
     ap.add_argument("--no-prefix-cache", action="store_true",
@@ -251,7 +403,15 @@ def main(argv=None) -> int:
               max_new=args.max_new, max_batch=args.max_batch,
               block_size=args.block_size, max_context=args.max_context,
               chunk=args.chunk, seed=args.seed)
-    if args.shared_prefix:
+    if args.churn:
+        result = run_churn(preset=args.preset, max_new=args.max_new,
+                           max_batch=args.max_batch, seed=args.seed,
+                           block_size=args.block_size, chunk=args.chunk,
+                           max_context=args.max_context,
+                           prefix_groups=args.prefix_groups,
+                           shared_len=args.shared_len)
+        failed = result["failed"]
+    elif args.shared_prefix:
         result = run_shared_prefix(prefix_groups=args.prefix_groups,
                                    shared_len=args.shared_len, **kw)
         failed = result["failed"]

@@ -100,6 +100,13 @@ def render_prom(system: MetricsSystem) -> str:
             elif isinstance(m, MutableGauge):
                 add(name, "gauge", m.description, labels, m.value())
             elif isinstance(m, MutableHistogram):
+                # histograms may publish under a shared family name
+                # with static labels (kv_fetch_seconds{tier=...}) while
+                # their registry/snapshot name stays unique for /jmx
+                if m.prom_name:
+                    name = PREFIX + _san(m.prom_name)
+                hlabels = dict(labels, **m.prom_labels) \
+                    if m.prom_labels else labels
                 lines = fam(name, "histogram", m.description)
                 if lines is None:
                     continue
@@ -107,9 +114,9 @@ def render_prom(system: MetricsSystem) -> str:
                 for bound, cum in buckets:
                     le = "+Inf" if math.isinf(bound) else _fmt(bound)
                     lines.append(_line(f"{name}_bucket",
-                                       dict(labels, le=le), cum))
-                lines.append(_line(f"{name}_sum", labels, total))
-                lines.append(_line(f"{name}_count", labels, n))
+                                       dict(hlabels, le=le), cum))
+                lines.append(_line(f"{name}_sum", hlabels, total))
+                lines.append(_line(f"{name}_count", hlabels, n))
             elif isinstance(m, MutableQuantiles):
                 lines = fam(name, "summary", m.description)
                 if lines is None:

@@ -174,9 +174,16 @@ class MutableHistogram:
     # 0.25 ms .. ~128 s, ×2 per bucket (20 bounds + +Inf)
     BOUNDS = tuple(0.00025 * (2 ** i) for i in range(20))
 
-    def __init__(self, name: str, description: str = ""):
+    def __init__(self, name: str, description: str = "",
+                 prom_name: str = None, prom_labels: dict = None):
         self.name = name
         self.description = description
+        # optional exposition override: several histograms can share
+        # one Prometheus family name, distinguished by static labels
+        # (e.g. kv_fetch_seconds{tier="host"} / {tier="dfs"}), while
+        # keeping unique snapshot keys for /jmx
+        self.prom_name = prom_name
+        self.prom_labels = dict(prom_labels) if prom_labels else {}
         self._lock = threading.Lock()
         self._counts = [0] * (len(self.BOUNDS) + 1)
         self._sum = 0.0
@@ -233,8 +240,12 @@ class MetricsRegistry:
     def quantiles(self, name: str, description: str = "") -> MutableQuantiles:
         return self._get_or_make(name, lambda: MutableQuantiles(name, description))
 
-    def histogram(self, name: str, description: str = "") -> MutableHistogram:
-        return self._get_or_make(name, lambda: MutableHistogram(name, description))
+    def histogram(self, name: str, description: str = "",
+                  prom_name: str = None,
+                  prom_labels: dict = None) -> MutableHistogram:
+        return self._get_or_make(name, lambda: MutableHistogram(
+            name, description, prom_name=prom_name,
+            prom_labels=prom_labels))
 
     def metrics(self) -> List[Any]:
         """Typed metric objects (the /prom renderer walks these; /jmx

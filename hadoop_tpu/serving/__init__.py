@@ -4,11 +4,14 @@ The compute plane inherited from the reference is batch-only (PAPER.md
 §5.7/§5.8); this package opens the online workload. A serving replica is
 
     loader.py   checkpoint straight from DFS (hedged reads for stragglers)
-    engine.py   continuous-batching decode engine, paged KV-cache pool
-    server.py   /v1/generate (streaming) + /v1/health on http.server
-    router.py   registry discovery + power-of-two-choices balancing
+    engine.py   continuous-batching decode engine over the paged KV pool
+    kvstore/    tiered fleet-wide KV cache: HBM radix -> host-RAM ring
+                -> DFS prefix store (+ raw/int8 block codecs)
+    server.py   /v1/generate (streaming) + /v1/prefill + /v1/health
+    router.py   registry discovery, role- and prefix-affinity-aware
+                balancing, prefill/decode disaggregation handoff
     service.py  the replica packaged as a YARN long-running service
-    metrics.py  queue depth / occupancy / TTFT / tokens/s wiring
+    metrics.py  queue depth / occupancy / TTFT / per-tier KV wiring
 
 Everything runs on the CPU mesh in tests and shards over ``tp`` via
 ``parallel.mesh`` on real hardware.

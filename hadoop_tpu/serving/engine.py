@@ -38,6 +38,17 @@ Design (TPU-first, same rules as the trainer):
   recompute-preemption path: evict cold cache first, preempt the
   youngest request only when the cache is already dry.
 
+- **Tiered fleet-wide cache.** The pool + radix moved into
+  ``serving/kvstore`` and grew two cold tiers behind them: zero-ref
+  blocks demote to a host-RAM ring (``serving.kv.host.bytes``) when the
+  HBM tier evicts them, and hot shared prefixes persist as blocks on
+  the DataNodes (``serving.kv.dfs.enable``) via the DFS write pipeline
+  so ANY replica — including one that just restarted — maps them back
+  with hedged reads instead of re-prefilling. A radix miss at admission
+  consults host, then DFS, before falling back to prefill; promotions
+  ride fixed-shape jitted page movers (no new compiles). See
+  ``kvstore/tiered.py`` for the policy.
+
 - **Chunked prefill, fused into the step.** A prompt is prefilled
   ``prefill_chunk`` tokens per engine step in the SAME compiled step
   that advances every running decode — a long prompt can no longer
@@ -64,9 +75,9 @@ import itertools
 import queue
 import threading
 import time
-from collections import OrderedDict, deque
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -76,201 +87,31 @@ from hadoop_tpu.models.config import ModelConfig
 from hadoop_tpu.models.decoder import _norm, head_matrix
 from hadoop_tpu.ops import gelu, rope_frequencies, swiglu
 from hadoop_tpu.ops.attention import _repeat_kv
+# BlockPool/PrefixCache live in the kvstore package now (the tiered
+# fleet-wide cache); re-exported here so `from serving.engine import
+# BlockPool` keeps working for every existing consumer
+from hadoop_tpu.serving.kvstore import (BlockPool, PrefixCache,
+                                        TieredKVCache)
 from hadoop_tpu.tracing.tracer import global_tracer
 
 _NEG_INF = -1e30
 
 
-# ------------------------------------------------------------- block pool
-
-class BlockPool:
-    """Refcounted fixed pool of KV-cache pages. Block 0 is reserved
-    scratch (padding and inactive lanes scatter there), so
-    ``num_blocks - 1`` are allocatable.
-
-    Lifecycle: ``alloc`` hands out pages at refcount 1; prefix sharing
-    ``incref``s a page per additional mapper; ``decref`` drops one
-    mapping and reports pages that reached zero WITHOUT freeing them —
-    the engine decides whether a zero-ref page stays resident as prefix
-    cache or returns to the free list via ``free``. ``free`` refuses
-    pages still shared (refcount > 1), so a preemption can never yank a
-    page out from under a sibling."""
-
-    SCRATCH = 0
-
-    def __init__(self, num_blocks: int, block_size: int):
-        if num_blocks < 2:
-            raise ValueError("need at least 2 blocks (one is scratch)")
-        self.num_blocks = num_blocks
-        self.block_size = block_size
-        self._free = deque(range(1, num_blocks))  # guarded-by: _lock
-        self._ref = [0] * num_blocks              # guarded-by: _lock
-        self._lock = threading.Lock()
-
-    @property
-    def num_usable(self) -> int:
-        return self.num_blocks - 1
-
-    @property
-    def num_free(self) -> int:
-        with self._lock:
-            return len(self._free)
-
-    def refcount(self, block: int) -> int:
-        with self._lock:
-            return self._ref[block]
-
-    def alloc(self, n: int) -> Optional[List[int]]:
-        with self._lock:
-            if n > len(self._free):
-                return None
-            out = [self._free.popleft() for _ in range(n)]
-            for b in out:
-                self._ref[b] = 1
-            return out
-
-    def incref(self, blocks: List[int]) -> None:
-        with self._lock:
-            for b in blocks:
-                if b == self.SCRATCH:
-                    raise ValueError("incref of the scratch block")
-                self._ref[b] += 1
-
-    def decref(self, blocks: List[int]) -> List[int]:
-        """Drop one reference per block; returns the blocks that hit
-        zero (now unmapped — cacheable or freeable, caller's call)."""
-        released = []
-        with self._lock:
-            for b in blocks:
-                if self._ref[b] <= 0:
-                    raise ValueError(f"decref of unreferenced block {b}")
-                self._ref[b] -= 1
-                if self._ref[b] == 0:
-                    released.append(b)
-        return released
-
-    def free(self, blocks: List[int]) -> None:
-        with self._lock:
-            for b in blocks:
-                if b == self.SCRATCH:
-                    raise ValueError("freeing the scratch block")
-                if self._ref[b] > 1:
-                    raise ValueError(
-                        f"freeing block {b} still shared "
-                        f"(refcount {self._ref[b]}) — decref instead")
-                self._ref[b] = 0
-                self._free.append(b)
+# fixed-shape page movers for the cold tiers: one trace each for the
+# replica's lifetime (the block index is a traced scalar, the payload
+# shape is pinned by the engine config), shared across engine instances
+# through jit's module-level cache — tier promotions and demotions ride
+# these, never a fresh compile
+def _inject_impl(kp, vp, blk, k, v):
+    return kp.at[:, blk].set(k), vp.at[:, blk].set(v)
 
 
-# ------------------------------------------------------------ prefix cache
-
-class _RadixNode:
-    __slots__ = ("key", "block", "parent", "children")
-
-    def __init__(self, key=None, block=None, parent=None):
-        self.key = key          # tuple of block_size tokens
-        self.block = block      # pool page holding this chunk's K/V
-        self.parent = parent
-        self.children: Dict[tuple, "_RadixNode"] = {}
+def _extract_impl(kp, vp, blk):
+    return kp[:, blk], vp[:, blk]
 
 
-class PrefixCache:
-    """Radix index over fully-filled prompt blocks: a trie at block
-    granularity, where the path from the root IS the token prefix — so
-    a block is only ever matched under the exact full prefix its K/V
-    was computed for (KV at position i depends on tokens 0..i, not just
-    the block's own tokens).
-
-    The cache holds no refcounts itself; the pool's refcount is the
-    truth. A node is evictable when it is a leaf and its block's
-    refcount is zero; ``evict`` pops such leaves in LRU order (leaves
-    first keeps the tree consistent — a parent can only go after its
-    children). ``_lru`` holds ONLY the current leaves, in recency order
-    (moved-to-end on every touch); evicting a leaf promotes a
-    newly-childless parent to the cold end. So the steady-state
-    eviction — pool full of zero-ref cache, evict one page per block
-    allocation — pops the front in O(1) under the scheduler lock,
-    scanning past a node only when it is pinned (actively shared)."""
-
-    def __init__(self, block_size: int):
-        self.block_size = block_size
-        self._root = _RadixNode()
-        self._nodes: Dict[int, _RadixNode] = {}        # every cached page
-        self._lru: "OrderedDict[int, _RadixNode]" = OrderedDict()  # leaves
-
-    def __len__(self) -> int:
-        return len(self._nodes)
-
-    def contains_block(self, block: int) -> bool:
-        return block in self._nodes
-
-    def _touch(self, node: _RadixNode) -> None:
-        if node.block in self._lru:
-            self._lru.move_to_end(node.block)
-
-    def match(self, tokens: List[int]) -> List[int]:
-        """Longest cached full-block prefix of ``tokens``; returns the
-        pages in prefix order (no refcounting — caller pins them)."""
-        node = self._root
-        out: List[int] = []
-        bs = self.block_size
-        for i in range(len(tokens) // bs):
-            child = node.children.get(tuple(tokens[i * bs:(i + 1) * bs]))
-            if child is None:
-                break
-            self._touch(child)
-            out.append(child.block)
-            node = child
-        return out
-
-    def insert(self, tokens: List[int], blocks: List[int]) -> int:
-        """Register fully-filled pages for ``tokens`` (one page per
-        ``block_size`` chunk, aligned). First writer wins: an existing
-        node keeps its page and the duplicate stays with its owner (it
-        is freed on that request's release). Returns how many pages
-        were newly registered."""
-        node = self._root
-        new = 0
-        bs = self.block_size
-        for i, blk in enumerate(blocks):
-            key = tuple(tokens[i * bs:(i + 1) * bs])
-            child = node.children.get(key)
-            if child is None:
-                child = _RadixNode(key, blk, node)
-                node.children[key] = child
-                self._nodes[blk] = child
-                if node is not self._root:
-                    self._lru.pop(node.block, None)    # no longer a leaf
-                self._lru[blk] = child
-                new += 1
-            else:
-                self._touch(child)
-            node = child
-        return new
-
-    def evict(self, n: int, refcount: Callable[[int], int]) -> List[int]:
-        """Drop up to ``n`` LRU zero-ref leaf pages from the index and
-        return them (caller returns them to the pool's free list)."""
-        out: List[int] = []
-        while len(out) < n:
-            victim = None
-            for blk, node in self._lru.items():  # oldest leaf first;
-                if refcount(blk) == 0:           # scan past pinned ones
-                    victim = node
-                    break
-            if victim is None:
-                break
-            del self._lru[victim.block]
-            del self._nodes[victim.block]
-            del victim.parent.children[victim.key]
-            out.append(victim.block)
-            parent = victim.parent
-            if parent is not self._root and not parent.children:
-                # newly a leaf, and at least as stale as the child we
-                # just dropped: promote to the cold end of the LRU
-                self._lru[parent.block] = parent
-                self._lru.move_to_end(parent.block, last=False)
-        return out
+_INJECT = jax.jit(_inject_impl, donate_argnums=(0, 1))
+_EXTRACT = jax.jit(_extract_impl)
 
 
 # --------------------------------------------------------------- requests
@@ -383,6 +224,9 @@ class DecodeEngine:
                  max_context: Optional[int] = None,
                  prefill_chunk: int = 16,
                  prefix_cache: bool = True,
+                 kv_host_bytes: int = 0,
+                 kv_store_fs=None, kv_store_dir: str = "/kvcache",
+                 kv_dfs_min_refs: int = 1, kv_codec: str = "raw",
                  plan=None, metrics=None, tracer=None):
         if cfg.is_moe:
             raise NotImplementedError("serving MoE checkpoints is not "
@@ -405,10 +249,19 @@ class DecodeEngine:
         if num_blocks is None:
             num_blocks = max_batch * self.blocks_per_seq + 1
         self.pool = BlockPool(num_blocks, block_size)
-        self.prefix_cache = PrefixCache(block_size) if prefix_cache \
-            else None
         self.metrics = metrics
         self.tracer = tracer or global_tracer()
+        # the tier manager owns the radix index and the cold tiers;
+        # the engine stays the device owner (extract/inject below)
+        self.kvstore = TieredKVCache(
+            self.pool, layers=cfg.n_layers, kv_heads=cfg.n_kv_heads,
+            head_dim=cfg.head_dim, dtype=cfg.jax_dtype,
+            enabled=prefix_cache, host_bytes=kv_host_bytes,
+            fs=kv_store_fs, dfs_dir=kv_store_dir,
+            dfs_min_refs=kv_dfs_min_refs, codec=kv_codec,
+            metrics=metrics, tracer=self.tracer,
+            extract=self._extract_block)
+        self.prefix_cache = self.kvstore.radix
 
         self._mesh = None
         if plan is not None:
@@ -473,6 +326,22 @@ class DecodeEngine:
         """Traces of the fused shape of the step ([B + chunk] rows —
         dispatched when a prompt chunk rides along). At most 1."""
         return self._fused_compiles
+
+    # ------------------------------------------------- tier page movers
+
+    def _extract_block(self, blk: int):
+        """One page's (K, V) payload to host numpy — the demotion /
+        persistence copy. Fixed-shape jit, compiled once per layout."""
+        k, v = _EXTRACT(self._kp, self._vp, jnp.int32(blk))
+        return np.asarray(k), np.asarray(v)
+
+    def _inject_block(self, blk: int, k, v) -> None:
+        """Scatter a cold-tier payload into pool page ``blk`` (donated
+        buffers — no pool-sized copy, no new compile)."""
+        self._kp, self._vp = _INJECT(
+            self._kp, self._vp, jnp.int32(blk),
+            jnp.asarray(k, self._kp.dtype),
+            jnp.asarray(v, self._vp.dtype))
 
     # ----------------------------------------------------- compiled body
 
@@ -631,6 +500,9 @@ class DecodeEngine:
             "evictions": self.prefix_evictions,
             "inserted_blocks": self.prefix_inserted_blocks,
             "prefill_chunk": self.prefill_chunk,
+            # per-tier traffic: HBM radix hits vs host-ring and DFS
+            # recoveries, demotions/promotions/persists
+            "tiers": self.kvstore.stats(),
         }
 
     # ------------------------------------------------------ the scheduler
@@ -664,29 +536,59 @@ class DecodeEngine:
             # one more page slot for its token
             ctx = req.prompt + req.out_tokens
             shared: List[int] = []
+            nodes = []
+            cold = []
+            limit = 0
             if self.prefix_cache is not None:
                 # cap the match below the full context: the last token
                 # must always be prefilled so its logits exist to
                 # sample the first output token from
                 limit = (len(ctx) - 1) // self.block_size
-                matched = self.prefix_cache.match(ctx)[:limit]
-                if matched:
+                nodes = self.prefix_cache.match_nodes(ctx)[:limit]
+                if nodes:
+                    shared = [n.block for n in nodes]
                     # pin before any eviction this admission might do
-                    self.pool.incref(matched)
-                    shared = matched
+                    self.pool.incref(shared)
             need = -(-(len(ctx) + 1) // self.block_size) - len(shared)
             private = self._try_alloc(need)
             if private is None:
                 # running requests outrank waiting ones (preemption only
                 # keeps the running set going, never feeds admission) —
-                # wait for retirements to return pages
+                # wait for retirements to return pages. The cold-tier
+                # walk hasn't run yet, so a saturated pool never burns
+                # DataNode reads on an admission it can't complete
                 if shared:
                     # unpin; zero-ref pages stay resident in the index
                     self.pool.decref(shared)
                 return
+            if self.prefix_cache is not None:
+                # a radix miss consults host RAM, then the DFS store,
+                # for the next chunks of the chain — only the still-
+                # uncached tail falls back to prefill. The matched
+                # node's chain digest seeds the walk, so nothing is
+                # rehashed from the root
+                cold = self.kvstore.fetch_cold(
+                    ctx, len(nodes), limit, parent_ctx=req.trace_ctx,
+                    start_digest=nodes[-1].digest if nodes else None)
             with self._cond:
                 self._pending.popleft()
-            reused = len(shared) * self.block_size
+            if cold:
+                # cold payloads land in the first of the freshly
+                # allocated pages (ref 1, owned by this request) and
+                # re-register in the radix so siblings share them from
+                # HBM; a mid-admission eviction above could only have
+                # taken OTHER zero-ref pages — the shared span is
+                # pinned and these pages are already allocated
+                cold_pages = private[:len(cold)]
+                for page, hit in zip(cold_pages, cold):
+                    self._inject_block(page, hit.k, hit.v)
+                span = shared + cold_pages
+                self.prefix_cache.insert(
+                    ctx[:len(span) * self.block_size], span)
+                self.kvstore.mark_promoted(cold, cold_pages)
+            self.kvstore.note_match(nodes, parent_ctx=req.trace_ctx,
+                                    count=req.preemptions == 0)
+            reused = (len(shared) + len(cold)) * self.block_size
             req.prefix_tokens_reused = reused
             if req.preemptions == 0:
                 # hit-rate counts cross-request reuse only: a preempted
@@ -697,18 +599,23 @@ class DecodeEngine:
                 self.prefix_tokens_matched += reused
                 if self.metrics and reused:
                     self.metrics.prefix_tokens_reused.incr(reused)
-            self._place(req, slot, shared + private, ctx, len(shared))
+            self._place(req, slot, shared + private, ctx,
+                        len(shared) + len(cold))
 
     def _try_alloc(self, n: int) -> Optional[List[int]]:
         """Allocate ``n`` pages, evicting LRU zero-ref cached blocks to
-        make room before giving up (cold cache yields to live work)."""
+        make room before giving up (cold cache yields to live work).
+        Victims demote to the host-RAM ring on their way out (the
+        ``on_evict`` hook copies the payload while the page is still
+        valid), so "evicted" means "one memcpy away", not "gone"."""
         if n <= 0:
             return []
         got = self.pool.alloc(n)
         if got is not None or self.prefix_cache is None:
             return got
         evicted = self.prefix_cache.evict(n - self.pool.num_free,
-                                          self.pool.refcount)
+                                          self.pool.refcount,
+                                          on_evict=self.kvstore.demote)
         if not evicted:
             return None
         self.pool.free(evicted)
@@ -981,6 +888,50 @@ class DecodeEngine:
         finally:
             if locked:
                 self._sched_lock.release()
+        self.kvstore.close()
+
+    # ------------------------------------------------ disaggregation face
+
+    def prefill_to_store(self, prompt: List[int],
+                         timeout: float = 60.0) -> int:
+        """Prefill ``prompt`` and force-persist its full-block KV span
+        to the DFS tier — the prefill half of prefill/decode
+        disaggregation. The KV ships over the DataTransferProtocol via
+        the DFS write pipeline; the decode replica's admission maps it
+        back with hedged reads and prefills only the tail. Returns the
+        number of tokens actually durable on return — re-verified
+        against the radix after the flush, so a DataNode refusal can
+        never be reported as a persisted handoff. Raises when nothing
+        went durable (the router's signal to decode cold)."""
+        if not self.kvstore.dfs_enabled:
+            raise ValueError("DFS KV tier disabled (set "
+                             "serving.kv.dfs.enable for prefill-role "
+                             "replicas)")
+        req = self.submit(prompt, SamplingParams(max_new_tokens=1))
+        if self._thread is None:
+            # offline/test mode: no scheduler thread, drive it here
+            deadline = time.monotonic() + timeout
+            while not req.done.is_set():
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"prefill {req.id} not done")
+                self.step()
+        req.wait(timeout)
+        with self._sched_lock:
+            blocks = self.kvstore.persist_prefix(prompt,
+                                                 parent_ctx=req.trace_ctx)
+            # flush to THIS handoff's watermark, not the global queue
+            # tail — other requests' min-refs persists keep arriving
+            watermark = self.kvstore.persists_enqueued
+        if not self.kvstore.flush(timeout, up_to=watermark):
+            raise TimeoutError("DFS KV persist did not drain in "
+                               f"{timeout}s")
+        with self._sched_lock:
+            durable = self.kvstore.persisted_span(prompt)
+        if blocks and not durable:
+            raise RuntimeError(
+                f"handoff persist failed: 0/{blocks} blocks durable "
+                "(DataNodes refusing writes?)")
+        return durable * self.block_size
 
     def _run_loop(self) -> None:
         while not self._stop.is_set():

@@ -1,0 +1,31 @@
+"""Tiered fleet-wide KV cache: HBM radix → host-RAM ring → DFS store.
+
+The storage half of the serving plane, extracted from the engine so the
+cache outlives any one replica: ``BlockPool`` (refcounted HBM pages),
+``PrefixCache`` (block-granular radix with prefix chain digests),
+``HostTier`` (pinned numpy ring under a byte budget), ``DFSTier``
+(blocks persisted through the DFS write pipeline, fetched with hedged
+reads), and ``TieredKVCache`` (the demote/fetch/persist policy that
+ties them together). ``serving/engine.py`` is a thin consumer.
+"""
+
+from hadoop_tpu.serving.kvstore.codec import (CODECS, decode_block,
+                                              encode_block)
+from hadoop_tpu.serving.kvstore.dfstier import DFSTier
+from hadoop_tpu.serving.kvstore.hosttier import HostTier
+from hadoop_tpu.serving.kvstore.pool import BlockPool
+from hadoop_tpu.serving.kvstore.radix import (PrefixCache, _RadixNode,
+                                              chain_digest)
+from hadoop_tpu.serving.kvstore.tiered import (CODEC_KEY, DFS_DIR_KEY,
+                                               DFS_ENABLE_KEY,
+                                               DFS_MIN_REFS_KEY,
+                                               HOST_BYTES_KEY, ColdHit,
+                                               TieredKVCache)
+
+__all__ = [
+    "BlockPool", "PrefixCache", "_RadixNode", "chain_digest",
+    "HostTier", "DFSTier", "TieredKVCache", "ColdHit",
+    "encode_block", "decode_block", "CODECS",
+    "HOST_BYTES_KEY", "DFS_ENABLE_KEY", "DFS_DIR_KEY",
+    "DFS_MIN_REFS_KEY", "CODEC_KEY",
+]

@@ -1,0 +1,119 @@
+"""Block codecs for the wire/DFS KV tiers.
+
+A cached KV block leaving HBM for the DFS tier crosses the network
+twice (write pipeline out, hedged read back), so comm volume is the
+cost that decides how many prefixes the fleet can afford to share —
+the bottleneck Flash Communication (arXiv:2412.04964) attacks with
+low-bit quantization. Two codecs ship:
+
+- ``raw``  — dtype bytes verbatim; demote/promote round-trips are
+  bit-exact and the decoded tokens match a cold prefill exactly.
+- ``int8`` — symmetric per-layer int8 with float32 scales (amax/127
+  over each layer's ``[block, heads, dim]`` slab): ~2× (bf16) to ~4×
+  (f32) smaller on the wire and on the DataNodes, decode is allclose
+  rather than bit-exact.
+
+The codec is a property of each stored block, not of the reader: the
+file header records which codec wrote it, so a raw-configured replica
+reads an int8 store (and vice versa) — mixed fleets stay compatible
+during a codec rollout.
+
+File layout: ``u32 BE header length || header JSON || k payload || v
+payload``. The header pins shape and dtype; ``decode_block`` validates
+both so a store written by an incompatible engine shape fails loudly
+instead of silently corrupting a context.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Tuple
+
+import numpy as np
+
+CODECS = ("raw", "int8")
+_MAGIC_VERSION = 1
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # bfloat16 and friends register through ml_dtypes, which numpy
+        # cannot resolve from the string name alone
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _quant_int8(x: np.ndarray) -> Tuple[np.ndarray, list]:
+    """Symmetric per-layer int8: scales are float32 amax/127 over each
+    layer's [block, heads, dim] slab (layer 0 of the array's axis 0)."""
+    xf = np.asarray(x, np.float32)
+    amax = np.max(np.abs(xf), axis=(1, 2, 3), keepdims=True)
+    scales = np.maximum(amax, 1e-12) / 127.0
+    q = np.clip(np.rint(xf / scales), -127, 127).astype(np.int8)
+    return q, [float(s) for s in scales.reshape(-1)]
+
+
+def _dequant_int8(q: np.ndarray, scales: list, dtype: np.dtype
+                  ) -> np.ndarray:
+    s = np.asarray(scales, np.float32).reshape(-1, 1, 1, 1)
+    return (q.astype(np.float32) * s).astype(dtype)
+
+
+def encode_block(k: np.ndarray, v: np.ndarray, codec: str = "raw"
+                 ) -> bytes:
+    """Serialize one block's (K, V) payload (shape [L, bs, Hkv, Dh])."""
+    if codec not in CODECS:
+        raise ValueError(f"unknown KV block codec {codec!r} "
+                         f"(serving.kv.codec must be one of {CODECS})")
+    header = {"v": _MAGIC_VERSION, "codec": codec,
+              "dtype": str(np.dtype(k.dtype)), "shape": list(k.shape)}
+    if codec == "raw":
+        kb, vb = k.tobytes(), v.tobytes()
+    else:
+        kq, header["scales_k"] = _quant_int8(k)
+        vq, header["scales_v"] = _quant_int8(v)
+        kb, vb = kq.tobytes(), vq.tobytes()
+    hj = json.dumps(header, separators=(",", ":")).encode()
+    return struct.pack(">I", len(hj)) + hj + kb + vb
+
+
+def decode_block(data: bytes, *, shape=None, dtype=None
+                 ) -> Tuple[np.ndarray, np.ndarray, dict]:
+    """Inverse of ``encode_block``; validates ``shape``/``dtype`` when
+    the caller pins them (the tier manager always does — a mismatched
+    payload must be a loud miss, never a silent context corruption)."""
+    if len(data) < 4:
+        raise ValueError("truncated KV block (no header length)")
+    (hlen,) = struct.unpack(">I", data[:4])
+    header = json.loads(data[4:4 + hlen].decode())
+    if header.get("v") != _MAGIC_VERSION:
+        raise ValueError(f"KV block version {header.get('v')!r} "
+                         f"(expected {_MAGIC_VERSION})")
+    hshape = tuple(header["shape"])
+    hdtype = _np_dtype(header["dtype"])
+    if shape is not None and hshape != tuple(shape):
+        raise ValueError(f"KV block shape {hshape} != engine {shape}")
+    if dtype is not None and hdtype != np.dtype(dtype):
+        raise ValueError(f"KV block dtype {hdtype} != engine "
+                         f"{np.dtype(dtype)}")
+    n = int(np.prod(hshape))
+    body = data[4 + hlen:]
+    if header["codec"] == "raw":
+        itemsize = hdtype.itemsize
+        if len(body) != 2 * n * itemsize:
+            raise ValueError("truncated raw KV block payload")
+        k = np.frombuffer(body[:n * itemsize], hdtype).reshape(hshape)
+        v = np.frombuffer(body[n * itemsize:], hdtype).reshape(hshape)
+    elif header["codec"] == "int8":
+        if len(body) != 2 * n:
+            raise ValueError("truncated int8 KV block payload")
+        kq = np.frombuffer(body[:n], np.int8).reshape(hshape)
+        vq = np.frombuffer(body[n:], np.int8).reshape(hshape)
+        k = _dequant_int8(kq, header["scales_k"], hdtype)
+        v = _dequant_int8(vq, header["scales_v"], hdtype)
+    else:
+        raise ValueError(f"unknown KV block codec {header['codec']!r}")
+    return k, v, header

@@ -1,0 +1,75 @@
+"""Host-RAM KV tier: a pinned numpy ring under a conf-keyed byte budget.
+
+Every block payload has one fixed shape (``[L, block_size, Hkv, Dh]``
+twice, K and V), so the tier is two preallocated arenas sliced into
+fixed slots — no per-block allocation, no fragmentation, and the pages
+stay resident (the OS never has to fault them back in under memory
+pressure from the model weights). Eviction is the ring itself: when the
+budget wraps, the oldest slot is overwritten and its key drops out of
+the index. A demoted block costs one ``memcpy`` in, a promotion one
+``memcpy`` out; both are host-side only — the device round-trip happens
+in the engine's fixed-shape inject/extract helpers.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class HostTier:
+    """FIFO ring of demoted KV blocks keyed by prefix chain digest."""
+
+    def __init__(self, shape: Tuple[int, ...], dtype, budget_bytes: int):
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        per_block = 2 * int(np.prod(self.shape)) * self.dtype.itemsize
+        self.block_bytes = per_block
+        self.capacity = max(0, int(budget_bytes) // per_block)
+        self._k = np.zeros((self.capacity,) + self.shape, self.dtype)
+        self._v = np.zeros_like(self._k)
+        self._index: Dict[bytes, int] = {}            # guarded-by: _lock
+        self._slot_key: List[Optional[bytes]] = \
+            [None] * self.capacity                    # guarded-by: _lock
+        self._next = 0                                # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._index)
+
+    @property
+    def budget_bytes(self) -> int:
+        return self.capacity * self.block_bytes
+
+    def put(self, digest: bytes, k: np.ndarray, v: np.ndarray) -> bool:
+        """Copy one block's payload into the ring (overwriting the
+        oldest slot when full). Returns False when the tier has no
+        capacity at all (budget below one block)."""
+        if self.capacity == 0:
+            return False
+        with self._lock:
+            slot = self._index.get(digest)
+            if slot is None:
+                slot = self._next
+                self._next = (self._next + 1) % self.capacity
+                old = self._slot_key[slot]
+                if old is not None:
+                    del self._index[old]
+                self._slot_key[slot] = digest
+                self._index[digest] = slot
+            self._k[slot] = k
+            self._v[slot] = v
+        return True
+
+    def get(self, digest: bytes
+            ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Copies of the block's (K, V), or None. Copied under the lock
+        so a concurrent ring wrap can't overwrite the view mid-read."""
+        with self._lock:
+            slot = self._index.get(digest)
+            if slot is None:
+                return None
+            return self._k[slot].copy(), self._v[slot].copy()

@@ -33,6 +33,12 @@ class ServingMetrics:
     - ``prefill_backlog``    prompt tokens still awaiting prefill across
                              admitted requests (the stall gauge: how far
                              first tokens lag behind admission)
+    - ``kv_hits_{hbm,host,dfs}`` per-tier KV block hit counters
+    - ``kv_demotions`` / ``kv_promotions`` / ``kv_dfs_persists``
+                             tier traffic (HBM→host spills, cold-tier
+                             re-injections, DFS write-pipeline persists)
+    - ``kv_fetch_seconds{tier=host|dfs}`` log-bucketed cold-fetch
+                             latency histograms (one prom family)
     """
 
     def __init__(self, source: str = SOURCE):
@@ -79,6 +85,34 @@ class ServingMetrics:
             "prefill_backlog",
             "prompt tokens still awaiting prefill across admitted "
             "requests")
+        # tiered KV cache: per-tier hit counters, demotion/promotion
+        # traffic, and log-bucketed fetch latency published under ONE
+        # prom family (kv_fetch_seconds{tier=...}) — a dashboard reads
+        # the HBM→host→DFS waterfall off a single query
+        self.kv_hits_hbm = reg.counter(
+            "kv_hits_hbm", "KV blocks served from the HBM radix tier")
+        self.kv_hits_host = reg.counter(
+            "kv_hits_host",
+            "KV blocks recovered from the host-RAM ring")
+        self.kv_hits_dfs = reg.counter(
+            "kv_hits_dfs",
+            "KV blocks recovered from the DFS prefix store")
+        self.kv_demotions = reg.counter(
+            "kv_demotions",
+            "zero-ref KV pages spilled HBM -> host ring at eviction")
+        self.kv_promotions = reg.counter(
+            "kv_promotions",
+            "KV pages re-injected into HBM from a cold tier")
+        self.kv_dfs_persists = reg.counter(
+            "kv_dfs_persists",
+            "KV pages persisted to the DFS prefix store")
+        self.kv_fetch_hist = {
+            tier: reg.histogram(
+                f"kv_fetch_seconds_{tier}",
+                "cold-tier KV block fetch latency",
+                prom_name="kv_fetch_seconds",
+                prom_labels={"tier": tier})
+            for tier in ("host", "dfs")}
 
     def snapshot(self):
         return self.registry.snapshot()

@@ -92,6 +92,21 @@ class ServingRouter:
         self.affinity_max_imbalance = self.conf.get_int(
             "serving.router.affinity.max.imbalance", 4)
         self.affinity_routed = 0      # picks that followed the prefix hash
+        # prefill/decode disaggregation: prompts at least this long are
+        # offered to a prefill-role replica first (it prefills and ships
+        # the KV through the DFS tier), then decoded on a decode/mixed
+        # replica that maps the shipped blocks instead of re-prefilling.
+        # Engaged ONLY when prefill-role replicas exist — a fleet of
+        # mixed (default-role) replicas behaves exactly as before.
+        self.prefill_min_tokens = self.conf.get_int(
+            "serving.router.prefill.min.tokens", 32)
+        # the handoff POST is synchronous on the request path: a wedged
+        # prefill replica must cost at most this long before the cold
+        # fallback engages, never _post's generous generate timeout
+        self.prefill_timeout = self.conf.get_time_seconds(
+            "serving.router.prefill.timeout", 20.0)
+        self.prefill_offloaded = 0    # handoffs that reached a prefill
+        #                               replica (failures decode cold)
 
     # ------------------------------------------------------------ discovery
 
@@ -136,14 +151,37 @@ class ServingRouter:
         head = ",".join(str(t) for t in tokens[:self.affinity_prefix])
         return hashlib.sha256(head.encode()).hexdigest()
 
-    def _pick(self, exclude: set,
-              affinity: Optional[str] = None) -> ServiceRecord:
+    @staticmethod
+    def _rec_role(rec: ServiceRecord) -> str:
+        return rec.attributes.get("role", "mixed")
+
+    def _pick(self, exclude: set, affinity: Optional[str] = None,
+              role: Optional[str] = None,
+              prefer_dfs: bool = False) -> ServiceRecord:
         """Prefix-affinity (rendezvous hash) with a load guard, else
-        power-of-two-choices on local outstanding counts."""
+        power-of-two-choices on local outstanding counts. ``role``
+        prefers replicas of that role (``mixed`` always qualifies);
+        when no replica matches, the filter is dropped entirely — a
+        deployment without role separation behaves exactly as today,
+        and a fleet that lost its last decode replica still serves off
+        whatever is alive rather than wedging. ``prefer_dfs`` steers
+        toward replicas that can map a just-completed prefill handoff
+        (a kv_dfs=0 pick would re-prefill what the handoff already
+        paid for) — a preference, never a hard filter."""
         cands = [r for r in self.replicas() if r.path not in exclude]
         if not cands:
             cands = [r for r in self.replicas(refresh=True)
                      if r.path not in exclude]
+        if role is not None:
+            roled = [r for r in cands
+                     if self._rec_role(r) in (role, "mixed")]
+            if roled:
+                cands = roled
+        if prefer_dfs:
+            dfsable = [r for r in cands
+                       if r.attributes.get("kv_dfs") != "0"]
+            if dfsable:
+                cands = dfsable
         if not cands:
             raise NoReplicasError(
                 f"no live replicas for {self.service}")
@@ -174,9 +212,11 @@ class ServingRouter:
         with global_tracer().span("serving.router.generate") as rsp:
             rsp.add_kv("prompt_tokens",
                        str(len(payload.get("tokens") or [])))
+            offloaded = self._maybe_offload_prefill(payload, user)
             return self._with_retry(
                 lambda rec: self._post(rec, payload, user),
-                self._affinity_key(payload))
+                self._affinity_key(payload), role="decode",
+                prefer_dfs=offloaded)
 
     def generate_stream(self, payload: Dict,
                         user: Optional[str] = None) -> Iterator[Dict]:
@@ -188,9 +228,11 @@ class ServingRouter:
         must not hold a span open; the replica-side spans carry on)."""
         payload = dict(payload, stream=True)
         with global_tracer().span("serving.router.generate_stream"):
+            offloaded = self._maybe_offload_prefill(payload, user)
             resp, conn, rec = self._with_retry(
                 lambda rec: self._post(rec, payload, user, stream=True)
-                + (rec,), self._affinity_key(payload))
+                + (rec,), self._affinity_key(payload), role="decode",
+                prefer_dfs=offloaded)
         # the stream holds its p2c weight for its whole life, not just
         # connection setup — a minutes-long stream is real load
         with self._lock:
@@ -207,12 +249,88 @@ class ServingRouter:
                 n = self._outstanding.get(rec.path, 1)
                 self._outstanding[rec.path] = max(0, n - 1)
 
-    def _with_retry(self, fn, affinity: Optional[str] = None):
+    def _maybe_offload_prefill(self, payload: Dict,
+                               user: Optional[str]) -> bool:
+        """The disaggregation hook on the request path: a long prompt is
+        first POSTed to a strict ``prefill``-role replica, which
+        prefills it and ships the finished KV to the DataNodes through
+        the DFS write pipeline (durable on return). The decode replica
+        picked next maps those blocks back via hedged reads at
+        admission and prefills only the tail, so its MXU never burns a
+        full prefill. Strictly best-effort: no prefill replicas, a
+        short prompt, or ANY handoff failure mean the decode replica
+        simply prefills cold — disaggregation can shed load, never add
+        a failure mode. Returns True when KV actually shipped (the
+        decode pick then prefers a replica that can map it back)."""
+        tokens = payload.get("tokens")
+        if (not isinstance(tokens, list) or
+                len(tokens) < self.prefill_min_tokens):
+            return False
+        try:
+            recs = self.replicas()
+        except NoReplicasError:
+            # registry blip on a cold router: the offload is strictly
+            # best-effort — let _with_retry's policy handle discovery
+            # with backoff exactly as it does for short prompts
+            return False
+        pres = [r for r in recs if self._rec_role(r) == "prefill"]
+        if not pres:
+            return False
+        # the handoff only pays off when the replica decoding next can
+        # map the shipped blocks back: when every decode-capable
+        # replica explicitly advertises kv_dfs=0, offloading would pay
+        # the prefill twice — once on the prefill replica, once cold on
+        # the decode side — plus the DataNode writes. A record without
+        # the attribute (hand-registered, mid-upgrade) stays eligible
+        dec = [r for r in recs if self._rec_role(r) != "prefill"]
+        if dec and all(r.attributes.get("kv_dfs") == "0" for r in dec):
+            return False
+        with self._lock:
+            loads = {r.path: self._outstanding.get(r.path, 0)
+                     for r in pres}
+        rec = min(pres, key=lambda r: loads[r.path])
+        # the handoff is a full prefill — it must weigh on the replica's
+        # outstanding count or every offload piles onto the same pick
+        with self._lock:
+            self._outstanding[rec.path] = \
+                self._outstanding.get(rec.path, 0) + 1
+        shipped = False
+        try:
+            with global_tracer().span(
+                    "serving.router.prefill_offload") as sp:
+                sp.add_kv("replica", rec.path)
+                sp.add_kv("prompt_tokens", str(len(tokens)))
+                try:
+                    out = self._post(rec, {"tokens": tokens,
+                                           "timeout":
+                                               self.prefill_timeout},
+                                     user, api_path="/v1/prefill",
+                                     timeout=self.prefill_timeout)
+                    sp.add_kv("persisted_tokens",
+                              str(out.get("persisted_tokens", 0)))
+                    self.prefill_offloaded += 1
+                    shipped = True
+                except Exception as e:  # noqa: BLE001 — ANY handoff
+                    # failure (transport, 4xx, replica without the DFS
+                    # tier) falls back to a cold decode-side prefill
+                    sp.add_kv("failed", str(e))
+                    log.debug("prefill offload to %s failed (%s); "
+                              "decoding cold", rec.path, e)
+        finally:
+            with self._lock:
+                n = self._outstanding.get(rec.path, 1)
+                self._outstanding[rec.path] = max(0, n - 1)
+        return shipped
+
+    def _with_retry(self, fn, affinity: Optional[str] = None,
+                    role: Optional[str] = None,
+                    prefer_dfs: bool = False):
         retries = failovers = 0
         exclude: set = set()
         while True:
             try:
-                rec = self._pick(exclude, affinity)
+                rec = self._pick(exclude, affinity, role=role,
+                                 prefer_dfs=prefer_dfs)
             except NoReplicasError as e:
                 action = self.policy.should_retry(e, retries, failovers,
                                                   True)
@@ -246,12 +364,15 @@ class ServingRouter:
                     self._outstanding[rec.path] = max(0, n - 1)
 
     def _post(self, rec: ServiceRecord, payload: Dict,
-              user: Optional[str], stream: bool = False):
+              user: Optional[str], stream: bool = False,
+              api_path: str = "/v1/generate",
+              timeout: float = 300.0):
         host, _, port = rec.endpoints["http"].rpartition(":")
-        path = "/v1/generate"
+        path = api_path
         if user:
             path += f"?user.name={user}"
-        conn = http.client.HTTPConnection(host, int(port), timeout=300.0)
+        conn = http.client.HTTPConnection(host, int(port),
+                                          timeout=timeout)
         try:
             headers = {"Content-Type": "application/json"}
             ctx = current_context()

@@ -63,7 +63,11 @@ class ServingServer:
                 secret.encode(),
                 allow_anonymous=self.conf.get_bool(ANON_KEY, False))
             handler = filt.wrap(handler)
+        prefill_handler = self._prefill
+        if secret:
+            prefill_handler = filt.wrap(prefill_handler)
         self.http.add_handler("/v1/generate", handler)
+        self.http.add_handler("/v1/prefill", prefill_handler)
         self.http.add_handler("/v1/health", self._health)
 
     # ------------------------------------------------------------ lifecycle
@@ -107,6 +111,48 @@ class ServingServer:
             # router and ops dashboards read hit_rate/cached_blocks here
             "prefix_cache": eng.cache_stats(),
         }
+
+    def _prefill(self, query: Dict, body):
+        """The prefill half of prefill/decode disaggregation: prefill
+        the prompt and persist its full-block KV span to the DFS tier
+        (durable on return — the decode replica the router picks next
+        maps it back immediately). 400 when this replica has no DFS
+        tier, so a router probing a misconfigured fleet fails fast
+        instead of retrying the handoff everywhere."""
+        if self._draining.is_set():
+            return 503, {"RemoteException": {
+                "exception": "RetriableException",
+                "message": "replica draining"}}
+        try:
+            req = json.loads(body or b"{}")
+            tokens = req["tokens"]
+            if (not isinstance(tokens, list) or not tokens or
+                    not all(isinstance(t, int) for t in tokens)):
+                raise ValueError("'tokens' must be a non-empty int list")
+            timeout = float(req.get("timeout", 300.0))
+        except (KeyError, TypeError, ValueError) as e:
+            return 400, {"RemoteException": {
+                "exception": "IllegalArgumentException",
+                "message": f"bad prefill request: {e}"}}
+        parent = SpanContext.from_header(query.get("__trace__"))
+        with self.tracer.span("serving.prefill_request",
+                              parent=parent) as span:
+            span.add_kv("prompt_tokens", str(len(tokens)))
+            try:
+                persisted = self.engine.prefill_to_store(
+                    tokens, timeout=timeout)
+            except ValueError as e:
+                return 400, {"RemoteException": {
+                    "exception": "IllegalArgumentException",
+                    "message": str(e)}}
+            except (RuntimeError, TimeoutError) as e:
+                span.add_kv("failed", str(e))
+                return 500, {"RemoteException": {
+                    "exception": "PrefillFailedException",
+                    "message": str(e)}}
+            span.add_kv("persisted_tokens", str(persisted))
+        return 200, {"persisted_tokens": persisted,
+                     "prompt_tokens": len(tokens)}
 
     def _generate(self, query: Dict, body):
         if self._draining.is_set():

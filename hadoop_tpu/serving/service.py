@@ -91,6 +91,26 @@ class ServingReplica:
             io_workers=conf.get_int(IO_WORKERS_KEY, 4))
         self.load_seconds = round(time.monotonic() - t0, 3)
         self.step = step
+        # the tiered KV cache: host-RAM spill ring byte budget, and the
+        # DFS prefix store on the SAME filesystem the checkpoint came
+        # from (the replica already holds a client with hedged reads
+        # armed). role=prefill replicas require the DFS tier — without
+        # it they could never ship finished KV to a decode replica.
+        self.role = conf.get("serving.role", "mixed")
+        if self.role not in ("prefill", "decode", "mixed"):
+            raise ValueError(f"serving.role must be prefill/decode/"
+                             f"mixed, got {self.role!r}")
+        self.kv_host_bytes = conf.get_int("serving.kv.host.bytes", 0)
+        # any explicitly role'd replica defaults the DFS tier ON: the
+        # handoff needs the prefill side writing AND the decode side
+        # reading the same store. A mixed (default) replica keeps
+        # today's behavior unless the deployment opts in.
+        kv_dfs = conf.get_bool("serving.kv.dfs.enable",
+                               self.role != "mixed")
+        if self.role == "prefill" and not kv_dfs:
+            raise ValueError("a prefill-role replica needs the DFS KV "
+                             "tier (serving.kv.dfs.enable)")
+        self.kv_dfs_enabled = kv_dfs
         self.engine = DecodeEngine(
             params, cfg,
             max_batch=conf.get_int("serving.max.batch", 4),
@@ -100,6 +120,11 @@ class ServingReplica:
             prefill_chunk=conf.get_int("serving.prefill.chunk", 16),
             prefix_cache=conf.get_bool("serving.prefix_cache.enabled",
                                        True),
+            kv_host_bytes=self.kv_host_bytes,
+            kv_store_fs=fs if kv_dfs else None,
+            kv_store_dir=conf.get("serving.kv.dfs.dir", "/kvcache"),
+            kv_dfs_min_refs=conf.get_int("serving.kv.dfs.min-refs", 1),
+            kv_codec=conf.get("serving.kv.codec", "raw"),
             metrics=ServingMetrics())
         self.server = ServingServer(self.engine, conf, bind=bind)
         # advertise a reachable address: the bind host when concrete, the
@@ -128,7 +153,15 @@ class ServingReplica:
                             # checkpoint pull latency: the fleet-level
                             # cold-start signal (regressions here mean
                             # slow flex-up under YARN restarts)
-                            "load_seconds": str(self.load_seconds)})
+                            "load_seconds": str(self.load_seconds),
+                            # disaggregation + tier capacities: the
+                            # router routes long prompts to role=prefill
+                            # and decodes on decode/mixed; an autoscaler
+                            # reads the tier budgets for drain planning
+                            "role": self.role,
+                            "kv_host_bytes": str(self.kv_host_bytes),
+                            "kv_dfs": "1" if self.kv_dfs_enabled
+                                      else "0"})
             self.reg.register(self.record, ttl_s=self.conf.get_time_seconds(
                 "serving.registry.ttl", 10.0))
         log.info("serving replica %s/%s up on :%d (checkpoint step %d)",
@@ -163,7 +196,7 @@ def replica_main(argv: List[str],
     """Entry point of one replica process (container / `serve` CLI)."""
     conf = conf or Configuration()
     args = dict(name="serving", checkpoint=None, preset="tiny",
-                registry=None, port=0, host="127.0.0.1")
+                registry=None, port=0, host="127.0.0.1", role=None)
     i = 0
     while i < len(argv):
         a = argv[i]
@@ -182,6 +215,8 @@ def replica_main(argv: List[str],
               "[--name SVC] [--registry HOST:PORT] [--port N]",
               file=sys.stderr)
         return 2
+    if args["role"]:
+        conf.set("serving.role", str(args["role"]))
     registry_addr = None
     if args["registry"]:
         host, _, port = str(args["registry"]).rpartition(":")

@@ -1,0 +1,466 @@
+"""Tiered fleet-wide KV cache: HBM radix → host-RAM ring → DFS store.
+
+What must hold for the tiers to be invisible to correctness:
+
+- a demote → promote round trip is BIT-EXACT (raw codec) — a prompt
+  whose blocks took a detour through the host ring or the DFS store
+  decodes to exactly the tokens a cold prefill produces;
+- only zero-ref pages ever demote — an active decode can never lose KV
+  under itself;
+- the DFS tier is fleet-wide: a DIFFERENT engine instance (fresh HBM,
+  fresh host ring — a restarted replica) maps a persisted prefix with
+  zero prefill steps for the cached span;
+- eviction interleaved with a cold-tier fetch-admission cannot corrupt
+  either side;
+- the prefill/decode disaggregation handoff (prefill_to_store on one
+  engine, decode on another) matches single-replica decode exactly.
+"""
+
+import json
+import http.client
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.fs import LocalFileSystem
+from hadoop_tpu.models.config import get_config
+from hadoop_tpu.models.decoder import forward, init_params
+from hadoop_tpu.serving.engine import DecodeEngine, SamplingParams
+from hadoop_tpu.serving.kvstore import (CODECS, HostTier, decode_block,
+                                        encode_block)
+from hadoop_tpu.serving.metrics import ServingMetrics
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = get_config("tiny")
+    return init_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+_REF_P = 48
+_ref_fwd_cache = {}
+
+
+def _reference_greedy(params, cfg, prompt, max_new):
+    fwd = _ref_fwd_cache.get(id(cfg))
+    if fwd is None:
+        fwd = jax.jit(lambda p, t: forward(p, t, cfg))
+        _ref_fwd_cache[id(cfg)] = fwd
+    seq = list(prompt)
+    for _ in range(max_new):
+        padded = seq + [0] * (_REF_P - len(seq))
+        logits = fwd(params, jnp.asarray([padded]))
+        seq.append(int(jnp.argmax(logits[0, len(seq) - 1])))
+    return seq[len(prompt):]
+
+
+def _drive(eng, req):
+    while not req.done.is_set():
+        eng.step()
+    return req.wait(0)
+
+
+# ------------------------------------------------------------------ codec
+
+def test_codec_raw_roundtrip_bit_exact():
+    rng = np.random.default_rng(0)
+    shape = (2, 4, 3, 8)
+    k = rng.standard_normal(shape).astype(np.float32)
+    v = rng.standard_normal(shape).astype(np.float32)
+    k2, v2, hdr = decode_block(encode_block(k, v, "raw"),
+                               shape=shape, dtype=np.float32)
+    assert hdr["codec"] == "raw"
+    assert np.array_equal(k, k2) and np.array_equal(v, v2)
+
+
+def test_codec_int8_roundtrip_allclose_and_smaller():
+    rng = np.random.default_rng(1)
+    shape = (3, 4, 2, 8)
+    k = rng.standard_normal(shape).astype(np.float32) * 3.0
+    v = rng.standard_normal(shape).astype(np.float32) * 0.1
+    raw = encode_block(k, v, "raw")
+    q = encode_block(k, v, "int8")
+    assert len(q) < len(raw) / 2          # ~4x on f32 minus the header
+    k2, v2, hdr = decode_block(q, shape=shape, dtype=np.float32)
+    assert hdr["codec"] == "int8"
+    # symmetric per-layer int8: error bounded by half a step (amax/127)
+    for orig, deq in ((k, k2), (v, v2)):
+        step = np.abs(orig).max(axis=(1, 2, 3), keepdims=True) / 127.0
+        assert np.all(np.abs(orig - deq) <= step * 0.51 + 1e-7)
+
+
+def test_codec_is_a_block_property_not_a_reader_config():
+    """Mixed fleets during a codec rollout: the header records which
+    codec WROTE the block, so any reader decodes it."""
+    rng = np.random.default_rng(2)
+    shape = (2, 4, 2, 4)
+    k = rng.standard_normal(shape).astype(np.float32)
+    v = rng.standard_normal(shape).astype(np.float32)
+    for codec in CODECS:
+        k2, _, hdr = decode_block(encode_block(k, v, codec),
+                                  shape=shape, dtype=np.float32)
+        assert hdr["codec"] == codec
+        assert np.allclose(k, k2, atol=float(np.abs(k).max()) / 120)
+
+
+def test_codec_shape_dtype_mismatch_is_loud():
+    k = np.zeros((2, 4, 2, 4), np.float32)
+    data = encode_block(k, k, "raw")
+    with pytest.raises(ValueError, match="shape"):
+        decode_block(data, shape=(2, 4, 2, 8), dtype=np.float32)
+    with pytest.raises(ValueError, match="dtype"):
+        decode_block(data, shape=(2, 4, 2, 4), dtype=np.float16)
+    with pytest.raises(ValueError):
+        decode_block(data[:10], shape=(2, 4, 2, 4), dtype=np.float32)
+    with pytest.raises(ValueError):
+        encode_block(k, k, "zstd")
+
+
+# -------------------------------------------------------------- host tier
+
+def test_host_tier_ring_wrap_evicts_oldest():
+    shape = (1, 2, 1, 2)
+    tier = HostTier(shape, np.float32, budget_bytes=3 * 2 * 4 * 4)
+    assert tier.capacity == 3
+    mk = lambda i: (np.full(shape, i, np.float32),
+                    np.full(shape, -i, np.float32))
+    for i in range(4):                       # 4 puts into 3 slots
+        assert tier.put(bytes([i]), *mk(i))
+    assert tier.get(bytes([0])) is None      # oldest fell off the ring
+    for i in (1, 2, 3):
+        k, v = tier.get(bytes([i]))
+        assert float(k[0, 0, 0, 0]) == i and float(v[0, 0, 0, 0]) == -i
+    assert len(tier) == 3
+    # get() hands back copies: mutating them must not poison the ring
+    k, _ = tier.get(bytes([2]))
+    k[:] = 99
+    assert float(tier.get(bytes([2]))[0][0, 0, 0, 0]) == 2
+    assert HostTier(shape, np.float32, budget_bytes=1).put(b"x", *mk(0)) \
+        is False                             # budget below one block
+
+
+# -------------------------------------------- demote/promote round trips
+
+def test_demote_promote_roundtrip_bit_exact(tiny_model):
+    """A prompt whose cached blocks were evicted HBM → host ring and
+    recovered at re-admission decodes bit-identically to its cold run,
+    and the recovery is visible as host-tier hits (not re-prefill)."""
+    params, cfg = tiny_model
+    head = [5, 9, 2, 7, 1, 8, 3, 6, 4, 2, 9, 1]          # 3 full blocks
+    pa = head + [11, 12]
+    ref = _reference_greedy(params, cfg, pa, 6)
+    # pool of 7 usable pages; the host ring holds the whole working set
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                       max_context=32, num_blocks=8,
+                       kv_host_bytes=1 << 30, metrics=ServingMetrics())
+    assert _drive(eng, eng.submit(
+        pa, SamplingParams(max_new_tokens=6))) == ref     # cold
+    # flood the pool with unrelated prompts so pa's zero-ref cached
+    # pages are evicted — demoting them into the host ring on the way
+    for flood in ([77, 66, 55, 44, 33, 22, 88, 99, 12, 13, 14, 15],
+                  [31, 41, 59, 26, 53, 58, 97, 93, 23, 84, 62, 64]):
+        _drive(eng, eng.submit(flood + [1, 2], SamplingParams(
+            max_new_tokens=6)))
+    assert eng.kvstore.demotions >= 3
+    assert eng.prefix_cache.match(pa) == []               # gone from HBM
+    # re-admission recovers the head from the ring instead of prefilling
+    req = eng.submit(pa, SamplingParams(max_new_tokens=6))
+    assert _drive(eng, req) == ref                        # bit-exact
+    assert eng.kvstore.hits["host"] >= 3
+    assert req.prefix_tokens_reused >= 12
+
+
+def test_zero_ref_only_demotion_under_active_decode(tiny_model):
+    """An ACTIVE request's pages are pinned (refcount > 0): pool
+    pressure may evict and demote only zero-ref cache, and the active
+    stream still decodes exactly."""
+    params, cfg = tiny_model
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                       max_context=32, num_blocks=8,
+                       kv_host_bytes=1 << 30, metrics=ServingMetrics())
+    ref_a = _reference_greedy(params, cfg, [1, 2, 3, 4], 20)
+    ref_b = _reference_greedy(params, cfg, [9, 9, 9, 9], 16)
+    a = eng.submit([1, 2, 3, 4], SamplingParams(max_new_tokens=20))
+    b = eng.submit([9, 9, 9, 9], SamplingParams(max_new_tokens=16))
+    while not (a.done.is_set() and b.done.is_set()):
+        eng.step()
+        # invariant mid-flight: every demoted digest belongs to a page
+        # that was zero-ref at demotion time — active tables never
+        # overlap the host ring's source pages
+        for slot, req in enumerate(eng._slots):
+            if req is not None:
+                for blk in req._blocks:
+                    assert eng.pool.refcount(blk) >= 1
+    assert a.wait(0) == ref_a
+    assert b.wait(0) == ref_b
+
+
+# ------------------------------------------------------------- DFS tier
+
+def test_dfs_tier_hit_from_a_different_engine(tmp_path, tiny_model):
+    """The fleet-wide property: engine A persists a hot shared prefix
+    through the write pipeline; engine B — a different instance with
+    cold HBM and no host ring (a restarted replica) — maps it from the
+    DFS store with zero prefill steps for the cached span."""
+    params, cfg = tiny_model
+    fs = LocalFileSystem()
+    kvdir = f"{tmp_path}/kvcache"
+    head = [5, 9, 2, 7, 1, 8, 3, 6, 4, 2, 9, 1]          # 3 full blocks
+    pa = head + [11, 12]
+    ref = _reference_greedy(params, cfg, pa, 6)
+
+    def mk(min_refs):
+        # chunk < cached span so skipped prefill shows up in the step
+        # count (one chunk per engine step)
+        return DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                            max_context=32, prefill_chunk=4,
+                            kv_store_fs=fs, kv_store_dir=kvdir,
+                            kv_dfs_min_refs=min_refs,
+                            metrics=ServingMetrics())
+
+    # min-refs gates persistence on cross-request HOTNESS: after one
+    # cold run nothing is durable; a second request re-matching the
+    # prefix crosses the threshold and triggers the background persist
+    a = mk(min_refs=1)
+    assert _drive(a, a.submit(pa, SamplingParams(max_new_tokens=6))) \
+        == ref
+    assert a.kvstore.stats()["dfs_persists"] == 0
+    assert _drive(a, a.submit(pa, SamplingParams(max_new_tokens=6))) \
+        == ref
+    assert a.kvstore.flush(30.0)
+    assert a.kvstore.stats()["dfs_persists"] == 3
+    files = []
+    for d in fs.list_status(kvdir):
+        files += [s.path for s in fs.list_status(d.path)]
+    assert len([f for f in files if f.endswith(".kvb")]) == 3
+
+    # a DIFFERENT engine instance: every full block of the head comes
+    # off the DataNodes; only the tail (and the last prompt token)
+    # prefills — fewer engine steps than the same run cold
+    cold = mk(min_refs=1)
+    cold.kvstore.dfs = None          # cache-off arm for the step count
+    s0 = cold.steps
+    assert _drive(cold, cold.submit(
+        pa, SamplingParams(max_new_tokens=6))) == ref
+    cold_steps = cold.steps - s0
+
+    b = mk(min_refs=1)
+    req = b.submit(pa, SamplingParams(max_new_tokens=6))
+    assert _drive(b, req) == ref                         # exact
+    assert b.kvstore.hits["dfs"] == 3
+    assert req.prefix_tokens_reused == 12                # the whole head
+    assert b.steps < cold_steps
+
+
+def test_dfs_min_refs_threshold(tmp_path, tiny_model):
+    """serving.kv.dfs.min-refs=2: one re-match is not hot enough, the
+    second is."""
+    params, cfg = tiny_model
+    fs = LocalFileSystem()
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                       max_context=32, kv_store_fs=fs,
+                       kv_store_dir=f"{tmp_path}/kv", kv_dfs_min_refs=2,
+                       metrics=ServingMetrics())
+    pa = [5, 9, 2, 7, 1, 8, 3, 6] + [11]                 # 2 full blocks
+    for expect in (0, 0, 2):           # cold, hits=1, hits=2 -> persist
+        _drive(eng, eng.submit(pa, SamplingParams(max_new_tokens=4)))
+        assert eng.kvstore.flush(30.0)
+        assert eng.kvstore.stats()["dfs_persists"] == expect
+
+
+def test_mid_fetch_eviction_safety(tmp_path, tiny_model):
+    """Admission that recovers blocks from a cold tier while its OWN
+    allocation evicts (and demotes) other zero-ref pages: both streams
+    of payloads stay intact — the recovered prompt decodes exactly and
+    the evicted one recovers from the ring next."""
+    params, cfg = tiny_model
+    pa = [5, 9, 2, 7, 1, 8, 3, 6, 4, 2, 9, 1] + [11, 12]
+    pb = [77, 66, 55, 44, 33, 22, 88, 99, 12, 13, 14, 15] + [1, 2]
+    ref_a = _reference_greedy(params, cfg, pa, 6)
+    ref_b = _reference_greedy(params, cfg, pb, 6)
+    # 7 usable pages: either prompt's working set is 4 — caching both
+    # heads (3+3) plus a live tail can't fit, so every re-admission
+    # must evict the other's cache while injecting its own cold hits
+    eng = DecodeEngine(params, cfg, max_batch=1, block_size=4,
+                       max_context=32, num_blocks=8,
+                       kv_host_bytes=1 << 30, metrics=ServingMetrics())
+    assert _drive(eng, eng.submit(pa, SamplingParams(
+        max_new_tokens=6))) == ref_a
+    assert _drive(eng, eng.submit(pb, SamplingParams(
+        max_new_tokens=6))) == ref_b
+    for _ in range(3):                 # ping-pong: fetch + evict each way
+        assert _drive(eng, eng.submit(pa, SamplingParams(
+            max_new_tokens=6))) == ref_a
+        assert _drive(eng, eng.submit(pb, SamplingParams(
+            max_new_tokens=6))) == ref_b
+    assert eng.kvstore.hits["host"] >= 6
+    assert eng.kvstore.demotions >= 6
+
+
+# ------------------------------------------------------- disaggregation
+
+def test_disaggregated_handoff_exact_match(tmp_path, tiny_model):
+    """prefill_to_store on one engine, decode on another: the decode
+    replica's output is bit-identical to a single-replica decode, with
+    the whole full-block span served from the store."""
+    params, cfg = tiny_model
+    fs = LocalFileSystem()
+    kvdir = f"{tmp_path}/kvcache"
+    prompt = list(range(7, 21))                          # 3 full blocks
+
+    def mk():
+        return DecodeEngine(params, cfg, max_batch=4, block_size=4,
+                            max_context=48, kv_store_fs=fs,
+                            kv_store_dir=kvdir, kv_dfs_min_refs=1,
+                            metrics=ServingMetrics())
+
+    solo = mk()
+    ref = _drive(solo, solo.submit(prompt,
+                                   SamplingParams(max_new_tokens=8)))
+    p_eng = mk()
+    assert p_eng.prefill_to_store(prompt) == 12          # durable now
+    d_eng = mk()
+    req = d_eng.submit(prompt, SamplingParams(max_new_tokens=8))
+    assert _drive(d_eng, req) == ref
+    assert d_eng.kvstore.hits["dfs"] == 3
+    assert req.prefix_tokens_reused == 12
+    # no DFS tier -> the handoff API refuses loudly
+    plain = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                         max_context=48)
+    with pytest.raises(ValueError, match="dfs"):
+        plain.prefill_to_store(prompt)
+
+
+def test_prefill_http_door_and_role_records(tmp_path, tiny_model):
+    """/v1/prefill persists and reports the span; a replica without the
+    DFS tier answers 400 (the router's fall-back-to-cold signal)."""
+    from hadoop_tpu.serving.server import ServingServer
+    params, cfg = tiny_model
+    fs = LocalFileSystem()
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                       max_context=48, kv_store_fs=fs,
+                       kv_store_dir=f"{tmp_path}/kv",
+                       metrics=ServingMetrics())
+    srv = ServingServer(eng, Configuration(load_defaults=False))
+    eng.start()
+    srv.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=60)
+        conn.request("POST", "/v1/prefill", body=json.dumps(
+            {"tokens": list(range(7, 21))}).encode())
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200, body
+        assert body["persisted_tokens"] == 12
+    finally:
+        srv.stop()
+    plain = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                         max_context=48)
+    srv2 = ServingServer(plain, Configuration(load_defaults=False))
+    plain.start()
+    srv2.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv2.port,
+                                          timeout=60)
+        conn.request("POST", "/v1/prefill", body=json.dumps(
+            {"tokens": [1, 2, 3]}).encode())
+        resp = conn.getresponse()
+        body = resp.read()
+        conn.close()
+        assert resp.status == 400, body
+    finally:
+        srv2.stop()
+
+
+def test_router_offloads_long_prompts_to_prefill_role(tmp_path,
+                                                      tiny_model):
+    """Role-aware routing end to end: a long prompt is first shipped to
+    the role=prefill replica (KV lands on the shared store), then
+    decoded on the role=decode replica, which maps the handoff blocks
+    instead of re-prefilling. Short prompts skip the handoff, and a
+    fleet with no prefill replicas behaves exactly as before."""
+    from hadoop_tpu.registry import (RegistryClient, RegistryServer,
+                                     ServiceRecord)
+    from hadoop_tpu.serving.router import ServingRouter, replica_path
+    from hadoop_tpu.serving.server import ServingServer
+    params, cfg = tiny_model
+    fs = LocalFileSystem()
+    kvdir = f"{tmp_path}/kvcache"
+    conf = Configuration(load_defaults=False)
+    conf.set("serving.router.prefill.min.tokens", "12")
+    reg_srv = RegistryServer(conf)
+    reg_srv.init(conf)
+    reg_srv.start()
+    engines, servers = [], []
+    try:
+        for _ in range(2):
+            eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                               max_context=48, kv_store_fs=fs,
+                               kv_store_dir=kvdir, kv_dfs_min_refs=1)
+            srv = ServingServer(eng, Configuration(load_defaults=False))
+            eng.start()
+            srv.start()
+            engines.append(eng)
+            servers.append(srv)
+        reg_addr = ("127.0.0.1", reg_srv.port)
+        rc = RegistryClient(reg_addr, conf)
+        for i, role in enumerate(("prefill", "decode")):
+            rc.register(ServiceRecord(
+                replica_path("disagg", f"r{i}"),
+                {"http": f"127.0.0.1:{servers[i].port}"},
+                {"state": "serving", "role": role}),
+                ttl_s=30.0, auto_renew=False)
+        router = ServingRouter(reg_addr, "disagg", conf, cache_ttl_s=0.0)
+        prompt = list(range(7, 21))                      # 14 >= 12
+        ref = _reference_greedy(params, cfg, prompt, 6)
+        out = router.generate({"tokens": prompt, "max_new_tokens": 6})
+        assert out["tokens"] == ref
+        assert router.prefill_offloaded == 1
+        # the decode replica mapped the handoff instead of prefilling
+        assert engines[1].kvstore.hits["dfs"] == 3
+        # and the decode itself ran on the decode-role replica
+        assert engines[1].tokens_generated >= 6
+        # short prompt: no handoff
+        out = router.generate({"tokens": [3, 4, 5],
+                               "max_new_tokens": 4})
+        assert out["tokens"] == _reference_greedy(params, cfg,
+                                                  [3, 4, 5], 4)
+        assert router.prefill_offloaded == 1
+        router.close()
+        rc.close()
+    finally:
+        for srv in servers:
+            srv.stop()
+        reg_srv.stop()
+
+
+# ----------------------------------------------------------- telemetry
+
+def test_prom_exposition_has_tier_labels(tiny_model):
+    """kv_fetch_seconds publishes as ONE family with tier labels, and
+    the per-tier hit counters surface on /prom."""
+    from hadoop_tpu.metrics import metrics_system
+    from hadoop_tpu.metrics.prom import render_prom
+    params, cfg = tiny_model
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                       max_context=32, num_blocks=8,
+                       kv_host_bytes=1 << 30, metrics=ServingMetrics())
+    pa = [5, 9, 2, 7, 1, 8, 3, 6, 4, 2, 9, 1, 11, 12]
+    _drive(eng, eng.submit(pa, SamplingParams(max_new_tokens=4)))
+    _drive(eng, eng.submit([7] * 12 + [1, 2],
+                           SamplingParams(max_new_tokens=4)))
+    _drive(eng, eng.submit(pa, SamplingParams(max_new_tokens=4)))
+    text = render_prom(metrics_system())
+    assert 'kv_fetch_seconds_bucket{' in text
+    assert 'tier="host"' in text
+    # ONE family declaration even with two labelled series
+    assert text.count("# TYPE htpu_kv_fetch_seconds histogram") == 1
+    for name in ("kv_hits_hbm", "kv_hits_host", "kv_hits_dfs",
+                 "kv_demotions", "kv_promotions"):
+        assert f"htpu_{name}" in text
