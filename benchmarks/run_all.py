@@ -99,6 +99,17 @@ def main() -> None:
         # an import-time one) is a data point for the trajectory, never
         # a reason to lose the storage/compute numbers computed above
         out["serving"] = {"error": f"{type(e).__name__}: {e}"}
+    # Speculative-decoding smoke: same repetitive workload with the
+    # speculation lane off then on — greedy outputs must match
+    # token-for-token, speculation must strictly reduce engine steps
+    # with at least one accepted draft, and both step shapes compile
+    # exactly once. Recorded, not raised.
+    try:
+        from benchmarks import serve_bench
+        out["serving_speculate"] = serve_bench.run_speculate_smoke()
+    except Exception as e:  # noqa: BLE001 — recorded for the
+        # trajectory; must not discard the benches already computed
+        out["serving_speculate"] = {"error": f"{type(e).__name__}: {e}"}
     # Replica-churn smoke: kill/restart an engine mid shared-prefix
     # workload over a miniDFS-backed KV store — fleet hit-rate must
     # recover via the DFS tier (post-restart hits > 0, strictly fewer

@@ -104,9 +104,13 @@ class StepBlockingChecker(Checker):
     synchronous ``fs.`` write inside the loop that drives the jitted
     step serializes read → transfer → step again and silently undoes
     it. A step loop is recognized lexically: a ``for``/``while`` whose
-    body calls ``*.step_fn(...)`` / ``step_fn(...)`` / ``train_step``
-    or a callable assigned from ``make_train_step(...)``. Inside it
-    (nested defs excluded) the checker flags:
+    body calls ``*.step_fn(...)`` / ``step_fn(...)`` / ``train_step``,
+    a callable assigned from ``make_train_step(...)``, or any name
+    bound from ``jax.jit(...)`` — the serving engine's device-resident
+    step helpers (``_SET_SLOT``/``_SET_TABLE``/``_INJECT``/
+    ``self._step_fn``) are jit-bound module or attribute names, and a
+    loop dispatching them is exactly as hot as a trainer step loop.
+    Inside it (nested defs excluded) the checker flags:
 
     - ``float()`` / ``int()`` casts of non-literal values, ``.item()``,
       ``.tolist()``, ``.block_until_ready()`` — device round-trips;
@@ -125,32 +129,48 @@ class StepBlockingChecker(Checker):
     _SYNC_METHOD_NAMES = {"item", "tolist", "block_until_ready"}
 
     def check_module(self, mod: SourceModule) -> List[Finding]:
-        # names bound from make_train_step(...) anywhere in the module
-        step_names: Set[str] = {"step_fn", "train_step"}
+        # names bound from make_train_step(...) or jax.jit(...)
+        # anywhere in the module: a loop dispatching a compiled
+        # callable IS a step loop, whether it drives training or the
+        # serving engine's device-resident state movers
+        # kept in two sets so the call FORM must match the binding
+        # form: a module-level `_MOVER = jax.jit(...)` marks only bare
+        # `_MOVER(...)` calls, a `self._step_fn = jax.jit(...)` only
+        # `*. _step_fn(...)` attribute calls — a module that happens to
+        # bind jit to a common name (`compile`, `run`) must not turn
+        # every `re.compile(...)`-calling loop into a step loop
+        step_attrs: Set[str] = {"step_fn", "train_step"}
+        step_calls: Set[str] = {"step_fn", "train_step"}
         for node in ast.walk(mod.tree):
             if isinstance(node, ast.Assign) and \
                     isinstance(node.value, ast.Call) and \
-                    call_name(node.value) and \
-                    call_name(node.value).split(".")[-1] == \
-                    "make_train_step":
-                for t in node.targets:
-                    if isinstance(t, ast.Name):
-                        step_names.add(t.id)
+                    call_name(node.value):
+                cn = call_name(node.value)
+                if cn.split(".")[-1] == "make_train_step" or \
+                        cn in ("jax.jit", "jit"):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            step_calls.add(t.id)
+                        elif isinstance(t, ast.Attribute):
+                            # self._step_fn = jax.jit(...) — calls
+                            # arrive as *.step_fn-style attributes
+                            step_attrs.add(t.attr)
         findings: List[Finding] = []
         for node in ast.walk(mod.tree):
             if isinstance(node, (ast.For, ast.AsyncFor, ast.While)) and \
-                    self._is_step_loop(node, step_names):
+                    self._is_step_loop(node, step_attrs, step_calls):
                 self._scan_loop(mod, node, findings)
         return findings
 
-    def _is_step_loop(self, loop, step_names: Set[str]) -> bool:
+    def _is_step_loop(self, loop, step_attrs: Set[str],
+                      step_calls: Set[str]) -> bool:
         for node in self._walk_no_defs(loop):
             if isinstance(node, ast.Call):
                 fn = node.func
                 if isinstance(fn, ast.Attribute) and \
-                        fn.attr in step_names:
+                        fn.attr in step_attrs:
                     return True
-                if isinstance(fn, ast.Name) and fn.id in step_names:
+                if isinstance(fn, ast.Name) and fn.id in step_calls:
                     return True
         return False
 

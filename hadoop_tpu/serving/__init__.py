@@ -5,6 +5,9 @@ The compute plane inherited from the reference is batch-only (PAPER.md
 
     loader.py   checkpoint straight from DFS (hedged reads for stragglers)
     engine.py   continuous-batching decode engine over the paged KV pool
+                (device-resident step state, in-graph stop scan, and a
+                speculation lane verified in the same fused step)
+    speculate.py  n-gram / prompt-lookup draft proposer per request
     kvstore/    tiered fleet-wide KV cache: HBM radix -> host-RAM ring
                 -> DFS prefix store (+ raw/int8 block codecs)
     server.py   /v1/generate (streaming) + /v1/prefill + /v1/health

@@ -62,6 +62,37 @@ Design (TPU-first, same rules as the trainer):
   preempted — its refs drop and it re-queues for recompute-style
   re-admission (warm: its own prompt blocks usually survive as cache).
 
+- **Device-resident step state.** Block tables, positions, last
+  tokens, active mask, sampling params, token budgets and the PRNG
+  seed live ON DEVICE and are carried through the donated step — the
+  host no longer rebuilds eight numpy arrays into device arrays every
+  step. State changes ride small event scatters (``_SET_SLOT`` /
+  ``_SET_TABLE``) on admission, prefill completion, page growth,
+  preemption and release — events, not steps. The stop-condition scan
+  (max_new budget, stop_token) runs INSIDE the compiled step, and the
+  host reads back one packed ``[B, k+4]`` bundle per step
+  (sampled tokens, emit counts, finished mask, verifier accept
+  lengths) instead of scanning per-slot Python. In steady-state decode the hot loop transfers
+  nothing host→device (tests pin this with a ``jax.transfer_guard``).
+
+- **Speculative decoding.** A third lane in the SAME compiled step:
+  a host-side n-gram / prompt-lookup index over each request's prompt
+  + generated tokens (``serving/speculate.py``) proposes up to
+  ``serving.speculate.k`` draft tokens per decode lane; each lane
+  becomes a group of ``k+1`` rows (last accepted token + k drafts at
+  consecutive positions) and the single batched forward verifies all
+  of them against the paged KV cache at once. The longest agreeing
+  prefix is accepted — greedy lanes by argmax equality (token-for-token
+  identical to speculation-off, the serve_bench A-B contract), sampled
+  lanes by rejection sampling against the verifier distribution (the
+  draft is a point mass: accept ``u < p(draft)``, re-sample from the
+  draft-removed renormalized target on rejection — output distribution
+  exactly the target's). Rejected drafts waste only the row: their KV
+  lands beyond the accepted tip and is rewritten by the next step's
+  contiguous window before anything can attend to it, and the radix
+  prefix cache only ever sees accepted, block-aligned tokens. The two
+  compiled shapes stay two: ``[B*(k+1)]`` and ``[B*(k+1) + chunk]``.
+
 - **Sharding.** Pass a ``MeshPlan`` (tp only) and the engine places the
   weights with ``parallel.mesh.param_specs`` and the KV pool with heads
   sharded over ``tp``; jit's SPMD partitioner inserts the decode
@@ -92,6 +123,7 @@ from hadoop_tpu.ops.attention import _repeat_kv
 # BlockPool` keeps working for every existing consumer
 from hadoop_tpu.serving.kvstore import (BlockPool, PrefixCache,
                                         TieredKVCache)
+from hadoop_tpu.serving.speculate import NgramProposer
 from hadoop_tpu.tracing.tracer import global_tracer
 
 _NEG_INF = -1e30
@@ -112,6 +144,43 @@ def _extract_impl(kp, vp, blk):
 
 _INJECT = jax.jit(_inject_impl, donate_argnums=(0, 1))
 _EXTRACT = jax.jit(_extract_impl)
+
+
+# device-resident step-state event movers: the ONLY host→device traffic
+# of the steady-state decode loop is these two scatters, and they fire
+# on slot lifecycle events (admission, prefill completion, page growth,
+# preemption, release) — never per step. Module-level jits like
+# _INJECT/_EXTRACT: one trace per state layout for the process
+# lifetime, outside the engine's two step-shape counters.
+def _set_slot_impl(state, ints, table_row, temp):
+    """Scatter one slot's full lane state. ``ints`` packs
+    [slot, pos, last_token, active, top_k, out_count, max_new,
+    stop_token] so one small upload carries the whole event."""
+    slot = ints[0]
+    return {
+        "tables": state["tables"].at[slot].set(table_row),
+        "positions": state["positions"].at[slot].set(ints[1]),
+        "last": state["last"].at[slot].set(ints[2]),
+        "active": state["active"].at[slot].set(ints[3] != 0),
+        "temps": state["temps"].at[slot].set(temp),
+        "topks": state["topks"].at[slot].set(ints[4]),
+        "outc": state["outc"].at[slot].set(ints[5]),
+        "maxn": state["maxn"].at[slot].set(ints[6]),
+        "stopt": state["stopt"].at[slot].set(ints[7]),
+        "seed": state["seed"],
+    }
+
+
+def _set_table_impl(state, ints):
+    """Scatter one new page into a slot's block table:
+    ``ints`` = [slot, index, block]."""
+    out = dict(state)
+    out["tables"] = state["tables"].at[ints[0], ints[1]].set(ints[2])
+    return out
+
+
+_SET_SLOT = jax.jit(_set_slot_impl, donate_argnums=(0,))
+_SET_TABLE = jax.jit(_set_table_impl, donate_argnums=(0,))
 
 
 # --------------------------------------------------------------- requests
@@ -153,6 +222,7 @@ class GenRequest:
     trace_ctx: Optional[Any] = None
     # engine-private placement
     _slot: Optional[int] = None
+    _proposer: Optional[Any] = None   # n-gram draft index (speculation)
     _blocks: List[int] = field(default_factory=list)
     _shared_blocks: int = 0           # leading blocks mapped from cache
     _ctx: List[int] = field(default_factory=list)
@@ -194,19 +264,27 @@ def _rope_at(x, cos, sin, pos):
     return out.astype(x.dtype)
 
 
+def _mask_and_scale(logits, temps, topks):
+    """The exact top-k mask + temperature transform ``_sample`` draws
+    from, rank-polymorphic over leading axes — the speculation
+    verifier shares it so the acceptance distribution can never drift
+    from the sampler's."""
+    v = logits.shape[-1]
+    srt = jnp.sort(logits, axis=-1)                       # ascending
+    kidx = jnp.clip(v - topks, 0, v - 1)
+    kth = jnp.take_along_axis(srt, kidx[..., None], axis=-1)[..., 0]
+    masked = jnp.where((topks > 0)[..., None] & (logits < kth[..., None]),
+                       _NEG_INF, logits)
+    return masked / jnp.maximum(temps, 1e-6)[..., None]
+
+
 def _sample(logits, temps, topks, key):
     """logits [T, V] float32; per-row temperature/top-k; greedy when
     temperature <= 0 (the fused decode+sampling step of arxiv
     2502.17728 — sampling stays inside the compiled program so no
     [T, V] logits tensor crosses to the host)."""
-    v = logits.shape[-1]
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    srt = jnp.sort(logits, axis=-1)                       # ascending
-    kidx = jnp.clip(v - topks, 0, v - 1)
-    kth = jnp.take_along_axis(srt, kidx[:, None], axis=1)[:, 0]
-    use_topk = (topks > 0)[:, None]
-    masked = jnp.where(use_topk & (logits < kth[:, None]), _NEG_INF, logits)
-    scaled = masked / jnp.maximum(temps, 1e-6)[:, None]
+    scaled = _mask_and_scale(logits, temps, topks)
     sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
     return jnp.where(temps <= 0, greedy, sampled)
 
@@ -227,6 +305,7 @@ class DecodeEngine:
                  kv_host_bytes: int = 0,
                  kv_store_fs=None, kv_store_dir: str = "/kvcache",
                  kv_dfs_min_refs: int = 1, kv_codec: str = "raw",
+                 speculate_k: int = 0, speculate_ngram: int = 3,
                  plan=None, metrics=None, tracer=None):
         if cfg.is_moe:
             raise NotImplementedError("serving MoE checkpoints is not "
@@ -275,24 +354,42 @@ class DecodeEngine:
         self.params = params
 
         L, hkv, dh = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
-        pool_shape = (L, num_blocks, block_size, hkv, dh)
-        self._kp = jnp.zeros(pool_shape, cfg.jax_dtype)
-        self._vp = jnp.zeros(pool_shape, cfg.jax_dtype)
+        self._pool_shape = (L, num_blocks, block_size, hkv, dh)
+        self._kv_sharding = None
         if self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
-            kv_sharding = NamedSharding(
+            self._kv_sharding = NamedSharding(
                 self._mesh, P(None, None, None, "tp", None))
-            self._kp = jax.device_put(self._kp, kv_sharding)
-            self._vp = jax.device_put(self._vp, kv_sharding)
+        self._kp, self._vp = self._fresh_kv_pools()
 
-        # host-side slot state (fixed shapes, rebuilt into jnp per step)
+        # speculation lane: k draft tokens per decode lane, verified by
+        # the same fused step (0 = off; every lane is then one row,
+        # exactly the pre-speculation layout)
+        self.spec_k = max(0, int(speculate_k))
+        self.spec_ngram = max(1, int(speculate_ngram))
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+
+        # host MIRRORS of the slot state (management reads: page
+        # allocation, occupancy, tests). The device copy below is the
+        # one the compiled step consumes and advances.
         self._tables = np.zeros((max_batch, self.blocks_per_seq), np.int32)
         self._seq_lens = np.zeros((max_batch,), np.int32)
         self._last_tokens = np.zeros((max_batch,), np.int32)
         self._active = np.zeros((max_batch,), bool)
-        self._temps = np.zeros((max_batch,), np.float32)
-        self._topks = np.zeros((max_batch,), np.int32)
         self._slots: List[Optional[GenRequest]] = [None] * max_batch
+        # device-resident step state: carried (donated) through every
+        # step, mutated from the host ONLY by slot lifecycle events via
+        # _SET_SLOT/_SET_TABLE. "seed" replaces the per-step host
+        # PRNGKey upload — the key is derived in-graph.
+        self._dstate = self._fresh_dstate()
+        # per-step draft proposals (host-filled when speculating); the
+        # device-resident zero twins are dispatched on steps with no
+        # proposals so an idle speculation lane uploads nothing
+        self._draft_tokens = np.zeros((max_batch, self.spec_k), np.int32)
+        self._draft_lens = np.zeros((max_batch,), np.int32)
+        self._dz_drafts = jnp.zeros((max_batch, self.spec_k), jnp.int32)
+        self._dz_lens = jnp.zeros((max_batch,), jnp.int32)
 
         self._pending: deque = deque()  # guarded-by: _cond
         self._admit_counter = itertools.count()
@@ -300,7 +397,6 @@ class DecodeEngine:
         self._sched_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._step_seed = itertools.count()
         self.steps = 0
         self.tokens_generated = 0
         self.occupancy_log: List[int] = []      # active slots per step
@@ -312,7 +408,7 @@ class DecodeEngine:
         self.prefix_tokens_matched = 0
         self.prefix_evictions = 0
         self.prefix_inserted_blocks = 0
-        self._step_fn = jax.jit(self._step_impl, donate_argnums=(1, 2))
+        self._step_fn = jax.jit(self._step_impl, donate_argnums=(1, 2, 3))
 
     @property
     def decode_compiles(self) -> int:
@@ -356,37 +452,96 @@ class DecodeEngine:
             return swiglu(x @ lp["w_gate"], x @ lp["w_up"]) @ lp["w_down"]
         return gelu(x @ lp["w_in"] + lp["b_in"]) @ lp["w_out"] + lp["b_out"]
 
-    def _step_impl(self, params, kp, vp, tables, positions, tokens,
-                   active, temps, topks, key):
+    def _step_impl(self, params, kp, vp, state, drafts, draft_lens,
+                   chunk):
         """The ONE compiled function: every row is one token at one
-        position — rows [0, max_batch) are the decode lanes (position =
-        tokens already cached), rows [max_batch, max_batch +
-        prefill_chunk) are consecutive positions of one request's
-        prompt chunk (they share that request's block table row).
-        Scatter-all-then-gather makes earlier chunk tokens visible to
-        later ones within the same step; the causal mask
-        ``kpos <= position`` does the rest.
+        position. The first ``max_batch * (spec_k + 1)`` rows are the
+        decode lanes — each lane a GROUP of ``spec_k + 1`` rows (its
+        last accepted token plus up to ``spec_k`` draft tokens at
+        consecutive positions, sharing the lane's block table row);
+        when ``chunk`` rides along, the last ``prefill_chunk`` rows are
+        consecutive positions of one request's prompt chunk.
+        Scatter-all-then-gather makes earlier rows' K/V visible to
+        later positions within the same step; the causal mask
+        ``kpos <= position`` does the rest — a draft row attends to the
+        drafts before it exactly as it would have sequentially.
+
+        All lane state arrives in (and leaves through) the donated
+        ``state`` dict: positions advance by the accepted length, the
+        stop-condition scan (max_new budget, stop_token) retires lanes
+        in-graph, and the PRNG key derives from the carried seed — the
+        host uploads nothing per steady-state decode step and reads
+        back one packed ``[B, spec_k + 4]`` bundle
+        (tokens | emit_count | finished | accept_len).
 
         Compiled at exactly TWO shapes for the replica's lifetime:
-        ``[max_batch]`` rows (decode-only — dispatched when nothing is
-        prefilling, so steady-state decode pays nothing for the chunk
-        lane) and ``[max_batch + prefill_chunk]`` rows (a prompt chunk
-        riding along). Any further trace is a retracing bug the
-        counters expose."""
+        ``[B*(spec_k+1)]`` rows (decode-only) and
+        ``[B*(spec_k+1) + prefill_chunk]`` rows (a prompt chunk riding
+        along). Any further trace is a retracing bug the counters
+        expose."""
         cfg = self.cfg
-        t = tables.shape[0]
+        B, S = self.max_batch, self.spec_k
+        G = S + 1
         # python side effect at trace time only: shape-family counters
-        if t == self.max_batch:
+        if chunk is None:
             self._decode_only_compiles += 1
         else:
             self._fused_compiles += 1
+        tables_s = state["tables"]
+        positions_s = state["positions"]
+        active_s = state["active"]
+        temps_s, topks_s = state["temps"], state["topks"]
+        outc, maxn, stopt = state["outc"], state["maxn"], state["stopt"]
+        drafts = drafts.astype(jnp.int32)
+        gj = jnp.arange(G)
+
+        # ---- build the decode rows from the carried state
+        if S:
+            row_tok = jnp.concatenate([state["last"][:, None], drafts],
+                                      axis=1)
+        else:
+            row_tok = state["last"][:, None]
+        row_pos = positions_s[:, None] + gj[None, :]
+        row_act = active_s[:, None] & (gj[None, :] <=
+                                       draft_lens[:, None])
+        bps = tables_s.shape[1]
+        tokens = row_tok.reshape(B * G)
+        positions = row_pos.reshape(B * G)
+        active = row_act.reshape(B * G)
+        tables = jnp.broadcast_to(tables_s[:, None, :],
+                                  (B, G, bps)).reshape(B * G, bps)
+        temps = jnp.broadcast_to(temps_s[:, None], (B, G)).reshape(B * G)
+        topks = jnp.broadcast_to(topks_s[:, None], (B, G)).reshape(B * G)
+        if chunk is not None:
+            # chunk rows: tokens uploaded, everything else derived from
+            # the prefilling slot's carried state (table row, sampling
+            # params) — ints = [slot, start, n_valid]
+            c_tok, c_ints = chunk
+            c_slot, c_start, c_n = c_ints[0], c_ints[1], c_ints[2]
+            C = self.prefill_chunk
+            cj = jnp.arange(C)
+            tokens = jnp.concatenate([tokens, c_tok.astype(jnp.int32)])
+            positions = jnp.concatenate([positions, c_start + cj])
+            active = jnp.concatenate([active, cj < c_n])
+            tables = jnp.concatenate(
+                [tables, jnp.broadcast_to(tables_s[c_slot][None, :],
+                                          (C, bps))], axis=0)
+            temps = jnp.concatenate(
+                [temps, jnp.broadcast_to(temps_s[c_slot], (C,))])
+            topks = jnp.concatenate(
+                [topks, jnp.broadcast_to(topks_s[c_slot], (C,))])
+        t = tokens.shape[0]
+        # inactive draft rows can sit past the end of the table/rope
+        # range; clip (identity for every live row) and let the active
+        # mask discard their output
+        pos = jnp.minimum(positions, self.s_max - 1)
+
         hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
         cos, sin = self._rope_tables()
         h = params["embed"][tokens]
         if not cfg.use_rope:
             h = h + params["pos_embed"][
-                jnp.clip(positions, 0, cfg.max_seq - 1)]
-        pos = positions
+                jnp.clip(pos, 0, cfg.max_seq - 1)]
         blk = jnp.take_along_axis(
             tables, (pos // self.block_size)[:, None], axis=1)[:, 0]
         blk = jnp.where(active, blk, BlockPool.SCRATCH)
@@ -427,7 +582,112 @@ class DecodeEngine:
                   cfg)
         logits = (h @ head_matrix(params, cfg, h.dtype)).astype(
             jnp.float32)
-        return kp, vp, _sample(logits, temps, topks, key)
+
+        # ---- sample + verify (the key derives from the carried seed:
+        # identical to the old host-side PRNGKey(step_counter))
+        key = jax.random.PRNGKey(state["seed"])
+        c_first = None
+        if S == 0:
+            # no speculation: one sample per row, bitwise the
+            # pre-speculation engine (same _sample over the same rows
+            # with the same key)
+            sampled = _sample(logits, temps, topks, key)
+            out = sampled[:B][:, None]                      # [B, 1]
+            accept = jnp.zeros((B,), jnp.int32)
+            if chunk is not None:
+                c_first = sampled[B * G + c_n - 1]
+        else:
+            ku, kr_, kc_ = jax.random.split(key, 3)
+            dec_logits = logits[:B * G].reshape(B, G, -1)
+            V = dec_logits.shape[-1]
+            greedy_tok = jnp.argmax(dec_logits, axis=-1).astype(
+                jnp.int32)                                  # [B, G]
+            # target distribution per row: the exact _sample transform
+            # (top-k mask, temperature) in probability space
+            row_top = jnp.broadcast_to(topks_s[:, None], (B, G))
+            row_tmp = jnp.broadcast_to(temps_s[:, None], (B, G))
+            scaled = _mask_and_scale(dec_logits, row_tmp, row_top)
+            probs = jax.nn.softmax(scaled, axis=-1)         # [B, G, V]
+            # acceptance: greedy lanes by argmax equality; sampled
+            # lanes by rejection sampling — the n-gram draft is a point
+            # mass, so accept iff u < p_target(draft)
+            u = jax.random.uniform(ku, (B, S))
+            p_draft = jnp.take_along_axis(
+                probs[:, :S], drafts[..., None], axis=2)[..., 0]
+            greedy_lane = temps_s <= 0
+            ok = jnp.where(greedy_lane[:, None],
+                           drafts == greedy_tok[:, :S], u < p_draft)
+            ok = ok & (jnp.arange(S)[None, :] < draft_lens[:, None])
+            accept = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1),
+                             axis=1)                        # [B] 0..S
+            # the bonus token at group index `accept`: greedy lanes
+            # take the argmax; sampled lanes draw from the target with
+            # a rejected draft token removed and renormalized (exact
+            # speculative sampling — all-accepted lanes sample the
+            # unmodified target)
+            p_a = jnp.take_along_axis(
+                probs, jnp.broadcast_to(accept[:, None, None],
+                                        (B, 1, V)), axis=1)[:, 0]
+            g_a = jnp.take_along_axis(greedy_tok, accept[:, None],
+                                      axis=1)[:, 0]
+            rejected = accept < draft_lens
+            d_a = jnp.take_along_axis(
+                drafts, jnp.minimum(accept, S - 1)[:, None],
+                axis=1)[:, 0]
+            adj = jnp.where(rejected[:, None] &
+                            (jnp.arange(V)[None, :] == d_a[:, None]),
+                            0.0, p_a)
+            adj = adj / jnp.maximum(adj.sum(-1, keepdims=True), 1e-30)
+            samp_a = jax.random.categorical(
+                kr_, jnp.log(jnp.maximum(adj, 1e-38)),
+                axis=-1).astype(jnp.int32)
+            final = jnp.where(greedy_lane, g_a, samp_a)     # [B]
+            draft_pad = jnp.concatenate(
+                [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1)
+            out = jnp.where(gj[None, :] < accept[:, None],
+                            draft_pad, final[:, None])      # [B, G]
+            if chunk is not None:
+                c_sampled = _sample(logits[B * G:], temps[B * G:],
+                                    topks[B * G:], kc_)
+                c_first = c_sampled[c_n - 1]
+
+        # ---- in-graph stop-condition scan: budget clamp, stop_token
+        # cut, lane retirement — the host reads the verdict, it does
+        # not compute it
+        remaining = jnp.maximum(maxn - outc, 0)
+        n_emit = jnp.minimum(accept + 1, remaining)
+        has_stop = stopt >= 0
+        stop_hits = (out == stopt[:, None]) & has_stop[:, None]
+        first_stop = jnp.min(
+            jnp.where(stop_hits, gj[None, :], G + 1), axis=1)
+        n_emit = jnp.minimum(n_emit, first_stop + 1)
+        n_emit = jnp.where(active_s, n_emit, 0)
+        stop_hit = first_stop < n_emit
+        finished = active_s & ((outc + n_emit >= maxn) | stop_hit)
+        last_idx = jnp.maximum(n_emit - 1, 0)
+        new_last = jnp.where(
+            active_s,
+            jnp.take_along_axis(out, last_idx[:, None], axis=1)[:, 0],
+            state["last"])
+        new_state = {
+            "tables": tables_s,
+            "positions": positions_s + n_emit,
+            "last": new_last,
+            "active": active_s & ~finished,
+            "temps": temps_s,
+            "topks": topks_s,
+            "outc": outc + n_emit,
+            "maxn": maxn,
+            "stopt": stopt,
+            "seed": state["seed"] + 1,
+        }
+        packed = jnp.concatenate(
+            [out, n_emit[:, None], finished.astype(jnp.int32)[:, None],
+             accept[:, None]],
+            axis=1)                                         # [B, G + 3]
+        if chunk is None:
+            return kp, vp, new_state, packed
+        return kp, vp, new_state, packed, c_first
 
     # -------------------------------------------------------- public face
 
@@ -503,22 +763,57 @@ class DecodeEngine:
             # per-tier traffic: HBM radix hits vs host-ring and DFS
             # recoveries, demotions/promotions/persists
             "tiers": self.kvstore.stats(),
+            # speculation lane: draft tokens proposed vs accepted
+            # (engine-local — bench A-B runs must not bleed into each
+            # other through the process-global metrics source)
+            "speculate": {
+                "k": self.spec_k,
+                "proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+                "accept_rate": (self.spec_accepted / self.spec_proposed)
+                               if self.spec_proposed else 0.0,
+            },
         }
 
     # ------------------------------------------------------ the scheduler
 
     def step(self) -> int:
         """One scheduler iteration: admit waiting requests into free
-        slots (mapping any cached prefix), ensure every decoding
-        request has a page for this step's token, run the fused
-        decode+prefill-chunk step, retire finished requests. Returns
-        the number of tokens emitted."""
+        slots (mapping any cached prefix), propose draft tokens for
+        the speculation lane, ensure every decoding request has pages
+        for this step's tokens, run the fused decode+prefill-chunk
+        step, retire finished requests. Returns the number of tokens
+        emitted."""
         with self._sched_lock:
             self._admit()
+            self._propose_drafts()
             self._ensure_blocks()
             emitted = self._run_step()
             self._publish_metrics()
             return emitted
+
+    def _propose_drafts(self) -> None:
+        """Fill the per-lane draft buffers from each running request's
+        n-gram index, clamped so speculation can never out-emit the
+        request's remaining token budget (each step emits at most
+        draft_len + 1 tokens; the last budgeted token must come from a
+        verified sample, so a lane with 1 token left proposes none)."""
+        if self.spec_k == 0:
+            return
+        self._draft_lens[:] = 0
+        for slot, req in enumerate(self._slots):
+            if req is None or req._prefill_pos is not None or \
+                    not self._active[slot]:
+                continue
+            budget = min(self.spec_k,
+                         req.sampling.max_new_tokens
+                         - len(req.out_tokens) - 1)
+            if budget <= 0:
+                continue
+            toks = req._proposer.propose(budget)
+            if toks:
+                self._draft_tokens[slot, :len(toks)] = toks
+                self._draft_lens[slot] = len(toks)
 
     def _admit(self) -> None:
         while True:
@@ -633,6 +928,8 @@ class DecodeEngine:
         req._ctx = ctx
         req._prefill_pos = shared_blocks * self.block_size
         req._admit_seq = next(self._admit_counter)
+        if self.spec_k:
+            req._proposer = NgramProposer(ctx, max_n=self.spec_ngram)
         self._slots[slot] = req
         row = np.zeros((self.blocks_per_seq,), np.int32)
         row[:len(blocks)] = blocks
@@ -640,6 +937,10 @@ class DecodeEngine:
         self._seq_lens[slot] = 0
         self._active[slot] = False
         self._last_tokens[slot] = 0
+        # the admission-event scatter: the slot's whole lane state
+        # (table row, sampling params, budget, stop token) lands on
+        # device ONCE here; the compiled step carries it from now on
+        self._push_slot(slot, req)
         sp = self.tracer.span("serving.admit", parent=req.trace_ctx)
         sp.add_kv("request", str(req.id))
         sp.add_kv("prompt_tokens", str(len(ctx)))
@@ -649,7 +950,11 @@ class DecodeEngine:
     def _ensure_blocks(self) -> None:
         """Every decoding slot must own the page its next token lands
         in; allocate at block boundaries (evicting cold cache first),
-        preempting the youngest request when everything is dry."""
+        preempting the youngest request when everything is dry. Draft
+        rows scatter K/V too, so a speculating lane best-effort
+        allocates through its furthest draft position — and on a dry
+        pool the drafts are CLAMPED to the owned pages rather than
+        preempting anyone: speculation degrades before it evicts."""
         for slot, req in enumerate(self._slots):
             if req is None or req._prefill_pos is not None:
                 continue     # prefilling slots pre-allocated at admit
@@ -660,8 +965,7 @@ class DecodeEngine:
             while req._slot is not None and len(req._blocks) < need:
                 got = self._try_alloc(1)
                 if got is not None:
-                    self._tables[slot][len(req._blocks)] = got[0]
-                    req._blocks.extend(got)
+                    self._append_block(slot, req, got[0])
                     continue
                 # pool and cache dry: evict the youngest running
                 # request — which may be this one (then its slot
@@ -671,6 +975,33 @@ class DecodeEngine:
                 victim = max((r for r in self._slots if r is not None),
                              key=lambda r: r._admit_seq)
                 self._preempt(victim)
+            lens = int(self._draft_lens[slot]) if self.spec_k else 0
+            if req._slot is None or not lens:
+                continue
+            want = (int(self._seq_lens[slot]) + lens) \
+                // self.block_size + 1
+            while len(req._blocks) < want:
+                # pool.alloc, NOT _try_alloc: a possibly-rejected
+                # draft page must never evict a cached prefix either —
+                # the clamp below degrades speculation instead
+                got = self.pool.alloc(1)
+                if got is None:
+                    break
+                self._append_block(slot, req, got[0])
+            self._draft_lens[slot] = min(
+                lens, len(req._blocks) * self.block_size
+                - int(self._seq_lens[slot]) - 1)
+
+    def _append_block(self, slot: int, req: GenRequest,
+                      block: int) -> None:
+        """One new page for a decoding slot: host mirror + the
+        device-side table scatter (a page-growth event — once per
+        block_size tokens per lane, never per step)."""
+        idx = len(req._blocks)
+        self._tables[slot][idx] = block
+        req._blocks.append(block)
+        self._dstate = _SET_TABLE(
+            self._dstate, np.asarray([slot, idx, block], np.int32))
 
     def _preempt(self, victim: GenRequest) -> None:
         """vLLM-style recompute preemption: drop the request's page
@@ -687,6 +1018,65 @@ class DecodeEngine:
         psp = self.tracer.span("serving.preempt", parent=victim.trace_ctx)
         psp.add_kv("request", str(victim.id))
         psp.finish()
+
+    def _fresh_kv_pools(self):
+        """Zeroed paged K/V pools, sharded when the engine owns a mesh
+        — construction and the failed-step recovery path share it."""
+        kp = jnp.zeros(self._pool_shape, self.cfg.jax_dtype)
+        vp = jnp.zeros(self._pool_shape, self.cfg.jax_dtype)
+        if self._kv_sharding is not None:
+            kp = jax.device_put(kp, self._kv_sharding)
+            vp = jax.device_put(vp, self._kv_sharding)
+        return kp, vp
+
+    def _fresh_dstate(self) -> dict:
+        """Zeroed device-resident step state, every lane cleared. Used
+        at construction and to REPLACE a state dict whose buffers a
+        failed (donated) step call consumed — the seed resumes at the
+        step count so the sampled-lane key stream never replays."""
+        mb = self.max_batch
+        return {
+            "tables": jnp.zeros((mb, self.blocks_per_seq), jnp.int32),
+            "positions": jnp.zeros((mb,), jnp.int32),
+            "last": jnp.zeros((mb,), jnp.int32),
+            "active": jnp.zeros((mb,), bool),
+            "temps": jnp.zeros((mb,), jnp.float32),
+            "topks": jnp.zeros((mb,), jnp.int32),
+            "outc": jnp.zeros((mb,), jnp.int32),
+            "maxn": jnp.zeros((mb,), jnp.int32),
+            "stopt": jnp.full((mb,), -1, jnp.int32),
+            "seed": jnp.int32(getattr(self, "steps", 0)),
+        }
+
+    def _push_slot(self, slot: int, req: Optional[GenRequest]) -> None:
+        """One event scatter carrying a slot's whole lane state to the
+        device copy (``req=None`` clears the lane)."""
+        if req is None:
+            ints = np.zeros((8,), np.int32)
+            ints[0] = slot
+            ints[7] = -1
+            row = np.zeros((self.blocks_per_seq,), np.int32)
+            temp = np.float32(0.0)
+        else:
+            sp = req.sampling
+            stop = -1 if sp.stop_token is None else int(sp.stop_token)
+            ints = np.asarray(
+                [slot, int(self._seq_lens[slot]),
+                 int(self._last_tokens[slot]),
+                 int(self._active[slot]), sp.top_k,
+                 len(req.out_tokens), sp.max_new_tokens, stop],
+                np.int32)
+            row = self._tables[slot]
+            temp = np.float32(sp.temperature)
+        self._dstate = _SET_SLOT(self._dstate, ints, row, temp)
+
+    def _finish_request(self, req: GenRequest, state: str = FINISHED,
+                        error: str = None) -> None:
+        """Complete a request and wake anyone waiting on the scheduler
+        condition (``stop(drain=True)`` parks there)."""
+        req._finish(state, error)
+        with self._cond:
+            self._cond.notify_all()
 
     def _release_slot(self, req: GenRequest) -> None:
         slot = req._slot
@@ -711,6 +1101,8 @@ class DecodeEngine:
         self._seq_lens[slot] = 0
         self._tables[slot] = 0
         self._last_tokens[slot] = 0
+        self._draft_lens[slot] = 0     # stale drafts must not dispatch
+        self._push_slot(slot, None)    # release event: clear the lane
 
     def _run_step(self) -> int:
         # oldest still-prefilling request gets this step's chunk budget
@@ -721,63 +1113,94 @@ class DecodeEngine:
                     pre = r
         if pre is None and not self._active.any():
             return 0
-        b, c = self.max_batch, self.prefill_chunk
-        n_valid = 0
-        if pre is None:
-            # decode-only shape: no idle chunk rows to pay for
-            tables, positions = self._tables, self._seq_lens
-            tokens, active = self._last_tokens, self._active
-            temps, topks = self._temps, self._topks
+        G = self.spec_k + 1
+        proposed = int(self._draft_lens.sum()) if self.spec_k else 0
+        if proposed:
+            drafts_in, lens_in = self._draft_tokens, self._draft_lens
         else:
-            c_tokens = np.zeros((c,), np.int32)
-            c_pos = np.zeros((c,), np.int32)
-            c_active = np.zeros((c,), bool)
-            c_tables = np.zeros((c, self.blocks_per_seq), np.int32)
+            # nothing proposed this step: dispatch the device-resident
+            # zero twins so an idle speculation lane uploads nothing
+            drafts_in, lens_in = self._dz_drafts, self._dz_lens
+        n_valid = 0
+        t0 = time.monotonic()
+        if pre is None:
+            # decode-only shape: no idle chunk rows to pay for — and
+            # with the state device-resident, NOTHING crosses
+            # host→device on this path (the steady-state contract the
+            # transfer-guard test pins)
+            self._kp, self._vp, self._dstate, packed = self._step_fn(
+                self.params, self._kp, self._vp, self._dstate,
+                drafts_in, lens_in, None)
+            c_first = None
+        else:
+            c = self.prefill_chunk
             start = pre._prefill_pos
             n_valid = min(c, len(pre._ctx) - start)
+            c_tokens = np.zeros((c,), np.int32)
             c_tokens[:n_valid] = pre._ctx[start:start + n_valid]
-            c_pos[:n_valid] = np.arange(start, start + n_valid)
-            c_active[:n_valid] = True
-            c_tables[:] = self._tables[pre._slot]
-            tables = np.concatenate([self._tables, c_tables], axis=0)
-            positions = np.concatenate([self._seq_lens, c_pos])
-            tokens = np.concatenate([self._last_tokens, c_tokens])
-            active = np.concatenate([self._active, c_active])
-            temps = np.concatenate([
-                self._temps,
-                np.full((c,), pre.sampling.temperature, np.float32)])
-            topks = np.concatenate([
-                self._topks,
-                np.full((c,), pre.sampling.top_k, np.int32)])
-        t0 = time.monotonic()
-        key = jax.random.PRNGKey(next(self._step_seed))
-        self._kp, self._vp, sampled = self._step_fn(
-            self.params, self._kp, self._vp, jnp.asarray(tables),
-            jnp.asarray(positions), jnp.asarray(tokens),
-            jnp.asarray(active), jnp.asarray(temps),
-            jnp.asarray(topks), key)
-        sampled = np.asarray(sampled)
+            c_ints = np.asarray([pre._slot, start, n_valid], np.int32)
+            self._kp, self._vp, self._dstate, packed, c_first = \
+                self._step_fn(self.params, self._kp, self._vp,
+                              self._dstate, drafts_in, lens_in,
+                              (c_tokens, c_ints))
+        # the ONE device→host read of the step: [B, G+3] =
+        # tokens | emit_count | finished | accept_len
+        packed = np.asarray(packed)
         self.steps += 1
         self._chunk_fill = n_valid
         emitted = 0
         self.occupancy_log.append(self.num_active)
         if len(self.occupancy_log) > 100_000:
             del self.occupancy_log[:50_000]
+        accepted = 0
+        spec_parent = None
         for slot, req in enumerate(self._slots):
             if req is None or not self._active[slot]:
                 continue
-            tok = int(sampled[slot])
-            self._seq_lens[slot] += 1
-            self._last_tokens[slot] = tok
-            req._deliver(tok)
-            emitted += 1
-            self._maybe_finish(req, tok)
+            n = int(packed[slot, G])
+            if n <= 0:
+                continue
+            toks = packed[slot, :n]
+            if self.spec_k:
+                # the VERIFIER's accept count, not the delivered n-1:
+                # a stop-token or budget clamp truncates the burst but
+                # must not read as the proposer guessing wrong
+                acc = int(packed[slot, G + 2])
+                accepted += acc
+                if self._draft_lens[slot]:
+                    if self.metrics:
+                        self.metrics.spec_accept_len.add(acc)
+                    if spec_parent is None:
+                        spec_parent = req.trace_ctx
+            # mirrors advance with the device state (the device already
+            # committed these positions)
+            self._seq_lens[slot] += n
+            self._last_tokens[slot] = int(toks[-1])
+            emitted += self._deliver_burst(req, toks)
+            if packed[slot, G + 1] or self._exhausted(req):
+                self._release_slot(req)
+                self._finish_request(req, FINISHED)
+        if self.spec_k and proposed:
+            self.spec_proposed += proposed
+            self.spec_accepted += accepted
+            if self.metrics:
+                self.metrics.spec_proposed.incr(proposed)
+                if accepted:
+                    self.metrics.spec_accepted.incr(accepted)
+            # join a speculating request's trace (root spans at
+            # decode-step rate would flood the bounded collector ring
+            # with single-span traces and evict real request traces)
+            ssp = self.tracer.span("serving.speculate",
+                                   parent=spec_parent)
+            ssp.add_kv("proposed", str(proposed))
+            ssp.add_kv("accepted", str(accepted))
+            ssp.finish()
         if pre is not None:
             pre._prefill_pos += n_valid
             if pre._prefill_pos >= len(pre._ctx):
                 # the chunk's last valid row sat at the final context
                 # position — its sample is the first output token
-                self._finish_prefill(pre, int(sampled[b + n_valid - 1]))
+                self._finish_prefill(pre, int(c_first))
                 emitted += 1
         self.tokens_generated += emitted
         if self.metrics:
@@ -787,16 +1210,41 @@ class DecodeEngine:
             self.metrics.decode_step_hist.add(step_s)
         return emitted
 
+    def _deliver_burst(self, req: GenRequest, toks) -> int:
+        """Deliver a step's accepted tokens in order, guarded against
+        multi-token overshoot: never past ``max_new_tokens``, nothing
+        past a ``stop_token`` hit mid-burst. The compiled step already
+        truncates — this is the host-side belt to its braces."""
+        sp = req.sampling
+        n = 0
+        for t in toks:
+            if len(req.out_tokens) >= sp.max_new_tokens:
+                break
+            tok = int(t)
+            req._deliver(tok)
+            if req._proposer is not None:
+                req._proposer.append(tok)
+            n += 1
+            if sp.stop_token is not None and tok == sp.stop_token:
+                break
+        return n
+
+    @staticmethod
+    def _exhausted(req: GenRequest) -> bool:
+        sp = req.sampling
+        return len(req.out_tokens) >= sp.max_new_tokens or \
+            (sp.stop_token is not None and req.out_tokens and
+             req.out_tokens[-1] == sp.stop_token)
+
     def _finish_prefill(self, req: GenRequest, tok: int) -> None:
         """Prompt fully cached: flip the slot to a decode lane, publish
         the fully-filled prompt blocks into the prefix index, deliver
-        the first token."""
+        the first token, and scatter the armed lane state to the
+        device (a prefill-completion event)."""
         slot = req._slot
         ctx_len = len(req._ctx)
         req._prefill_pos = None
         self._seq_lens[slot] = ctx_len
-        self._temps[slot] = req.sampling.temperature
-        self._topks[slot] = req.sampling.top_k
         self._last_tokens[slot] = tok
         self._active[slot] = True
         if self.prefix_cache is not None:
@@ -806,6 +1254,8 @@ class DecodeEngine:
                     req._ctx[:full * self.block_size], req._blocks[:full])
         first = req.first_token_at is None
         req._deliver(tok)
+        if req._proposer is not None:
+            req._proposer.append(tok)
         if first:
             ttft = req.first_token_at - req.submitted_at
             if self.metrics:
@@ -817,13 +1267,17 @@ class DecodeEngine:
             fsp.add_kv("ttft_s", f"{ttft:.6f}")
             fsp.finish()
         self._maybe_finish(req, tok)
+        if req._slot is not None:
+            # still running: arm the device lane (active, position at
+            # the context tip, budget counters) in one scatter
+            self._push_slot(slot, req)
 
     def _maybe_finish(self, req: GenRequest, tok: int) -> None:
         sp = req.sampling
         if len(req.out_tokens) >= sp.max_new_tokens or \
                 (sp.stop_token is not None and tok == sp.stop_token):
             self._release_slot(req)
-            req._finish(FINISHED)
+            self._finish_request(req, FINISHED)
 
     def _publish_metrics(self) -> None:
         if not self.metrics:
@@ -854,11 +1308,20 @@ class DecodeEngine:
 
     def stop(self, drain: bool = False, timeout: float = 30.0) -> None:
         """``drain=True``: keep decoding until every queued and running
-        request completes (graceful replica shutdown), then stop."""
+        request completes (graceful replica shutdown), then stop. The
+        wait parks on the scheduler condition — request completions
+        notify it — instead of a sleep-poll, so the drain turns around
+        the moment the last request finishes."""
         if drain and self._thread is not None:
             deadline = time.monotonic() + timeout
-            while not self.idle and time.monotonic() < deadline:
-                time.sleep(0.01)
+            with self._cond:
+                # self.idle re-enters _cond (Condition() wraps an
+                # RLock); completions and submits both notify
+                while not self.idle:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
         self._stop.set()
         with self._cond:
             self._cond.notify_all()
@@ -875,7 +1338,7 @@ class DecodeEngine:
                 if not req.done.is_set():
                     if locked:
                         self._release_slot(req)
-                    req._finish(FAILED, "engine stopped")
+                    self._finish_request(req, FAILED, "engine stopped")
             # drain, don't snapshot-and-clear: a submit() racing this
             # shutdown must fail its request, not vanish from the queue
             while True:
@@ -884,7 +1347,7 @@ class DecodeEngine:
                         break
                     req = self._pending.popleft()
                 if not req.done.is_set():
-                    req._finish(FAILED, "engine stopped")
+                    self._finish_request(req, FAILED, "engine stopped")
         finally:
             if locked:
                 self._sched_lock.release()
@@ -951,15 +1414,30 @@ class DecodeEngine:
                 # handler is left pending for the next loop iteration,
                 # never silently dropped
                 with self._sched_lock:
+                    # the failed step call consumed ALL the donated
+                    # device buffers (KV pools + step state) — rebuild
+                    # them BEFORE the release path scatters lane-clear
+                    # events into the state, or the recovery itself
+                    # raises on deleted buffers and wedges the replica
+                    self._dstate = self._fresh_dstate()
+                    self._kp, self._vp = self._fresh_kv_pools()
                     for req in [r for r in self._slots if r]:
                         self._release_slot(req)
-                        req._finish(FAILED, f"decode failed: {e}")
+                        self._finish_request(req, FAILED, f"decode failed: {e}")
+                    # the HBM radix indexed pages that died with the
+                    # pools: purge it (no demotion — the bytes are
+                    # gone; host/DFS tier copies are digest-keyed and
+                    # survive) so no future admission maps a zeroed
+                    # page as a cached prefix
+                    if self.prefix_cache is not None:
+                        self.pool.free(self.prefix_cache.evict(
+                            len(self.prefix_cache), self.pool.refcount))
                     while True:
                         with self._cond:
                             if not self._pending:
                                 break
                             req = self._pending.popleft()
-                        req._finish(FAILED, f"decode failed: {e}")
+                        self._finish_request(req, FAILED, f"decode failed: {e}")
 
     # ------------------------------------------------------------- offline
 

@@ -39,6 +39,10 @@ class ServingMetrics:
                              re-injections, DFS write-pipeline persists)
     - ``kv_fetch_seconds{tier=host|dfs}`` log-bucketed cold-fetch
                              latency histograms (one prom family)
+    - ``spec_proposed`` / ``spec_accepted`` speculative-decoding draft
+                             token counters (proposal vs verifier)
+    - ``spec_accept_len``    log-bucketed accepted-draft-length
+                             histogram per speculating lane-step
     """
 
     def __init__(self, source: str = SOURCE):
@@ -113,6 +117,19 @@ class ServingMetrics:
                 prom_name="kv_fetch_seconds",
                 prom_labels={"tier": tier})
             for tier in ("host", "dfs")}
+        # speculative decoding: draft tokens proposed by the n-gram
+        # index vs accepted by the in-step verifier, plus a
+        # log-bucketed per-lane accepted-length histogram (one prom
+        # family — the acceptance-depth distribution in one query)
+        self.spec_proposed = reg.counter(
+            "spec_proposed",
+            "draft tokens proposed to the speculation lane")
+        self.spec_accepted = reg.counter(
+            "spec_accepted",
+            "draft tokens accepted by the in-step verifier")
+        self.spec_accept_len = reg.histogram(
+            "spec_accept_len",
+            "accepted draft-prefix length per speculating lane-step")
 
     def snapshot(self):
         return self.registry.snapshot()

@@ -125,6 +125,11 @@ class ServingReplica:
             kv_store_dir=conf.get("serving.kv.dfs.dir", "/kvcache"),
             kv_dfs_min_refs=conf.get_int("serving.kv.dfs.min-refs", 1),
             kv_codec=conf.get("serving.kv.codec", "raw"),
+            # speculative decoding: k draft tokens per decode lane from
+            # the per-request n-gram index, verified in the same fused
+            # step (0 = off; exact sampling either way)
+            speculate_k=conf.get_int("serving.speculate.k", 0),
+            speculate_ngram=conf.get_int("serving.speculate.ngram", 3),
             metrics=ServingMetrics())
         self.server = ServingServer(self.engine, conf, bind=bind)
         # advertise a reachable address: the bind host when concrete, the

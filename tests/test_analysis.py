@@ -397,6 +397,46 @@ def test_step_loop_from_make_train_step_assignment(tmp_path):
     assert ids_of(findings) == ["jit/blocking-in-step"]
 
 
+def test_step_loop_from_jit_bound_names(tmp_path):
+    """The serving engine's device-resident step helpers are names
+    bound from ``jax.jit(...)`` — at module level (_SET_SLOT-style) or
+    as a self attribute (self._step_fn) — and a loop dispatching them
+    is a step loop: blocking calls inside it undo the device-resident
+    win exactly like in a trainer loop."""
+    from hadoop_tpu.analysis import StepBlockingChecker
+    findings = lint_source(tmp_path, """
+        import jax
+
+        _MOVER = jax.jit(lambda s, i: s)
+
+        class Engine:
+            def __init__(self):
+                self._step_fn = jax.jit(self._impl)
+
+            def drive(self, state, events, n):
+                for ev in events:
+                    state = _MOVER(state, ev)
+                    self.log.write(float(ev.seq))   # BAD: host sync
+                while n:
+                    state, out = self._step_fn(state)
+                    self.fs.append("/t", out)       # BAD: blocking IO
+                    n -= 1
+                return state
+
+            def cold(self, state, events):
+                # no jit-bound callable in this loop: syncs are fine
+                for ev in events:
+                    self.log.write(float(ev.seq))
+
+            def warm(self, batches):
+                # _MOVER is NAME-bound: an unrelated ATTRIBUTE call
+                # spelled the same must not mark a step loop
+                for b in batches:
+                    self.log.write(float(self.other._MOVER(b)))
+    """, [StepBlockingChecker()])
+    assert sorted(ids_of(findings)) == ["jit/blocking-in-step"] * 2
+
+
 # ---------------------------------------------------------- rpc checkers
 
 def test_timeoutless_socket_is_flagged(tmp_path):

@@ -1,0 +1,371 @@
+"""Speculative decoding + device-resident step state.
+
+The exactness pins: speculation may only move WORK (fewer engine
+steps), never tokens — greedy output through the speculation lane must
+be token-for-token what the speculation-off engine (and the full
+``models.decoder.forward`` recompute) produces, rejected drafts must
+never reach the radix prefix cache, and multi-token bursts must respect
+``max_new_tokens`` and ``stop_token`` exactly.
+
+The perf pins: the steady-state decode loop transfers NOTHING
+host→device (the state is device-resident; a ``jax.transfer_guard``
+proves it), the two step shapes still compile exactly once each, and
+``stop(drain=True)`` parks on the scheduler condition instead of
+sleep-polling.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hadoop_tpu.models.config import get_config
+from hadoop_tpu.models.decoder import forward, init_params
+from hadoop_tpu.serving.engine import DecodeEngine, SamplingParams
+from hadoop_tpu.serving.metrics import ServingMetrics
+from hadoop_tpu.serving.speculate import NgramProposer
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = get_config("tiny")
+    return init_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+_REF_P = 64
+_ref_fwd_cache = {}
+
+
+def _reference_greedy(params, cfg, prompt, max_new):
+    """Full forward recompute each step — ground truth (padded to one
+    fixed length so the reference compiles once; causal attention keeps
+    the padded tail out of earlier logits)."""
+    fwd = _ref_fwd_cache.get(id(cfg))
+    if fwd is None:
+        fwd = jax.jit(lambda p, t: forward(p, t, cfg))
+        _ref_fwd_cache[id(cfg)] = fwd
+    seq = list(prompt)
+    for _ in range(max_new):
+        padded = seq + [0] * (_REF_P - len(seq))
+        logits = fwd(params, jnp.asarray([padded]))
+        seq.append(int(jnp.argmax(logits[0, len(seq) - 1])))
+    return seq[len(prompt):]
+
+
+def _drive(eng, reqs):
+    if not isinstance(reqs, list):
+        reqs = [reqs]
+    while not all(r.done.is_set() for r in reqs):
+        eng.step()
+    return [r.wait(0) for r in reqs]
+
+
+def _motif_prompt(rng, cfg, motif_len=2, plen=16):
+    m = rng.integers(0, cfg.vocab_size, size=motif_len).tolist()
+    return (m * (-(-plen // motif_len)))[:plen]
+
+
+# ----------------------------------------------------------- proposer
+
+def test_ngram_proposer_chains_through_cycles():
+    p = NgramProposer([1, 2, 3, 1, 2, 3, 1, 2], max_n=3)
+    # tail (1, 2) last occurred ending at index 4; continuation chains
+    # through the whole cycle as deep as k allows
+    assert p.propose(6) == [3, 1, 2, 3, 1, 2]
+    assert p.propose(2) == [3, 1]
+    p.append(3)
+    assert p.propose(3) == [1, 2, 3]
+
+
+def test_ngram_proposer_never_matches_its_own_tip():
+    # the tip trigram (7, 8, 9) exists nowhere earlier: no proposal —
+    # a self-match would "predict" the token after the end of history
+    p = NgramProposer([7, 8, 9])
+    assert p.propose(4) == []
+    # a single repeated token proposes itself (1-gram fallback)
+    assert NgramProposer([5, 5]).propose(3) == [5, 5, 5]
+    assert NgramProposer([]).propose(3) == []
+    assert NgramProposer([1, 2]).propose(0) == []
+
+
+def test_ngram_proposer_prefers_longer_context():
+    # after [..., 1, 2] the 2-gram (1, 2) → 9 must beat the staler but
+    # shorter 1-gram (2) → 7 evidence
+    p = NgramProposer([2, 7, 1, 2, 9, 4, 1, 2], max_n=3)
+    assert p.propose(1) == [9]
+
+
+# ----------------------------------------------------- exact sampling
+
+def test_speculative_greedy_matches_reference_and_off(tiny_model):
+    """The tentpole pin: greedy decode through the speculation lane is
+    token-for-token the full-recompute reference, accepts drafts, and
+    still compiles exactly two shapes once each."""
+    params, cfg = tiny_model
+    rng = np.random.default_rng(3)
+    prompt = _motif_prompt(rng, cfg)
+    ref = _reference_greedy(params, cfg, prompt, 24)
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                       max_context=64, prefill_chunk=8, speculate_k=4)
+    got = _drive(eng, eng.submit(
+        prompt, SamplingParams(max_new_tokens=24)))[0]
+    assert got == ref
+    assert eng.spec_proposed > 0 and eng.spec_accepted > 0, \
+        "a repetitive prompt must earn accepted drafts"
+    off = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                       max_context=64, prefill_chunk=8)
+    assert _drive(off, off.submit(
+        prompt, SamplingParams(max_new_tokens=24)))[0] == ref
+    assert eng.steps < off.steps, \
+        "accepted drafts must strictly reduce engine steps"
+    assert eng.decode_compiles == 1 and eng.prefill_compiles == 1
+
+
+def test_speculative_lanes_mix_with_sampled_lanes(tiny_model):
+    """top_k=1 at temperature 1.0 is a point-mass target: rejection
+    sampling degenerates to argmax equality, so the lane must emit
+    exactly the greedy reference through the speculation path; a free
+    temperature lane sharing the batch stays in-vocab."""
+    params, cfg = tiny_model
+    rng = np.random.default_rng(4)
+    prompt = _motif_prompt(rng, cfg)
+    ref = _reference_greedy(params, cfg, prompt, 12)
+    eng = DecodeEngine(params, cfg, max_batch=3, block_size=4,
+                       max_context=64, prefill_chunk=8, speculate_k=3)
+    topk1 = eng.submit(prompt, SamplingParams(
+        max_new_tokens=12, temperature=1.0, top_k=1))
+    free = eng.submit(prompt[:6], SamplingParams(
+        max_new_tokens=12, temperature=1.3))
+    greedy = eng.submit(prompt, SamplingParams(max_new_tokens=12))
+    outs = _drive(eng, [topk1, free, greedy])
+    assert outs[0] == ref
+    assert outs[2] == ref
+    assert all(0 <= t < cfg.vocab_size for t in outs[1])
+    assert len(outs[1]) == 12
+
+
+# ------------------------------------------------- burst-delivery guard
+
+def test_speculation_never_overshoots_max_new(tiny_model):
+    """k > remaining budget: a lane accepting j drafts must deliver at
+    most ``max_new_tokens - len(out_tokens)`` — the regression the
+    in-step budget clamp (and the host-side burst guard) pins."""
+    params, cfg = tiny_model
+    rng = np.random.default_rng(3)
+    prompt = _motif_prompt(rng, cfg)
+    for max_new in (1, 2, 3, 5):
+        ref = _reference_greedy(params, cfg, prompt, max_new)
+        eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                           max_context=64, prefill_chunk=8,
+                           speculate_k=4)
+        got = _drive(eng, eng.submit(
+            prompt, SamplingParams(max_new_tokens=max_new)))[0]
+        assert got == ref, f"max_new={max_new}"
+        assert len(got) == max_new
+
+
+def test_speculation_stops_exactly_at_stop_token(tiny_model):
+    """A stop_token hit mid-burst must cut delivery at the stop, never
+    past it — token-for-token with the speculation-off engine."""
+    params, cfg = tiny_model
+    rng = np.random.default_rng(3)
+    prompt = _motif_prompt(rng, cfg)
+    ref = _reference_greedy(params, cfg, prompt, 24)
+    # pick a token the greedy stream emits mid-flight so the stop
+    # lands inside an accepted multi-token burst
+    stop = ref[len(ref) // 2]
+    want = ref[:ref.index(stop) + 1]
+    for k in (0, 4):
+        eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                           max_context=64, prefill_chunk=8,
+                           speculate_k=k)
+        got = _drive(eng, eng.submit(prompt, SamplingParams(
+            max_new_tokens=24, stop_token=stop)))[0]
+        assert got == want, f"speculate_k={k}"
+        assert got[-1] == stop and stop not in got[:-1]
+
+
+# ------------------------------------------- speculation x prefix cache
+
+def test_rejected_drafts_never_enter_radix(tiny_model):
+    """Pool pressure preempts a speculating request mid-flight; its
+    re-prefill republishes prompt + ACCEPTED tokens into the radix.
+    Every ``PrefixCache.insert`` must see only block-aligned prefixes
+    of a request's true delivered stream — a rejected draft in the
+    index would poison every future sharer — and the preemption must
+    release draft pages exactly once (the pool invariants catch a
+    double free)."""
+    params, cfg = tiny_model
+    rng = np.random.default_rng(3)
+    pa = _motif_prompt(rng, cfg, plen=12)
+    pb = _motif_prompt(rng, cfg, plen=12)
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                       max_context=48, num_blocks=10, prefill_chunk=8,
+                       speculate_k=4, metrics=ServingMetrics())
+    inserts = []
+    real_insert = eng.prefix_cache.insert
+
+    def spy(tokens, blocks):
+        inserts.append(list(tokens))
+        return real_insert(tokens, blocks)
+
+    eng.prefix_cache.insert = spy
+    ra = eng.submit(pa, SamplingParams(max_new_tokens=24))
+    rb = eng.submit(pb, SamplingParams(max_new_tokens=20))
+    outs = _drive(eng, [ra, rb])
+    assert outs[0] == _reference_greedy(params, cfg, pa, 24)
+    assert outs[1] == _reference_greedy(params, cfg, pb, 20)
+    assert rb.preemptions + ra.preemptions >= 1, \
+        "pool pressure never preempted a speculating lane"
+    streams = [pa + outs[0], pb + outs[1]]
+    for tokens in inserts:
+        assert len(tokens) % eng.block_size == 0, \
+            "insert saw a non-block-aligned span"
+        assert any(tokens == s[:len(tokens)] for s in streams), \
+            f"radix insert {tokens} is not a prefix of any " \
+            f"accepted stream"
+    # draft pages released exactly once: every page is free or
+    # resident zero-ref cache, nothing leaked or double-freed
+    assert eng.pool.num_free + len(eng.prefix_cache) == \
+        eng.pool.num_usable
+    assert all(eng.pool.refcount(b) == 0
+               for b in range(1, eng.pool.num_blocks))
+
+
+# --------------------------------------- device-resident state contract
+
+def test_steady_state_decode_uploads_nothing(tiny_model):
+    """The transfer-count probe: with the step state device-resident,
+    a steady-state decode step performs ZERO host→device transfers —
+    the eight per-step jnp.asarray uploads of the old engine are gone,
+    replaced by event scatters at admission/finish/page-growth only.
+    jax.transfer_guard turns any regression into a hard error."""
+    params, cfg = tiny_model
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=16,
+                       max_context=64)
+    req = eng.submit([1, 2, 3], SamplingParams(max_new_tokens=40))
+    for _ in range(4):       # prefill, flip to decode, compile shapes
+        eng.step()
+    assert eng._active[0]
+    before = len(req.out_tokens)
+    with jax.transfer_guard_host_to_device("disallow"):
+        for _ in range(8):   # no admission/finish/page event in here
+            eng.step()
+    assert len(req.out_tokens) == before + 8
+    # the speculation lane keeps the contract on no-proposal steps:
+    # the device-resident zero-draft twins dispatch, not an upload
+    eng2 = DecodeEngine(params, cfg, max_batch=2, block_size=16,
+                        max_context=64, speculate_k=4)
+    req2 = eng2.submit(list(range(1, 8)),
+                       SamplingParams(max_new_tokens=40))
+    for _ in range(4):
+        eng2.step()
+    if not eng2._draft_lens.any():
+        with jax.transfer_guard_host_to_device("disallow"):
+            eng2.step()
+
+
+def test_packed_bundle_reports_emission_and_finish(tiny_model):
+    """The one device→host read per step carries everything the host
+    needs: finished lanes retire without any extra scan."""
+    params, cfg = tiny_model
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                       max_context=32)
+    ref = _reference_greedy(params, cfg, [9, 3, 7], 4)
+    got = _drive(eng, eng.submit([9, 3, 7],
+                                 SamplingParams(max_new_tokens=4)))[0]
+    assert got == ref
+    # slot fully cleared on the device side too: nothing decodes after
+    assert not eng._active.any()
+    assert eng.step() == 0
+
+
+# ------------------------------------------------------------ lifecycle
+
+def test_drain_stop_waits_on_condition_not_poll(tiny_model,
+                                                monkeypatch):
+    """stop(drain=True) parks on the scheduler condition and is
+    notified on request completion — a time.sleep anywhere in the
+    drain path fails the test."""
+    params, cfg = tiny_model
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                       max_context=32)
+    req = eng.submit([4, 5, 6], SamplingParams(max_new_tokens=6))
+    eng.start()
+
+    def no_sleep(_):
+        raise AssertionError("drain busy-waited via time.sleep")
+
+    monkeypatch.setattr(time, "sleep", no_sleep)
+    eng.stop(drain=True, timeout=60.0)
+    assert req.done.is_set()
+    assert req.wait(0) == _reference_greedy(params, cfg, [4, 5, 6], 6)
+
+
+def test_failed_step_recovery_rebuilds_donated_state(tiny_model):
+    """A step that fails AFTER consuming its donated device buffers
+    (KV pools + step state) must not wedge the replica: the scheduler
+    loop's handler rebuilds all of them before scattering lane-clear
+    events, purges the HBM radix (its cached pages died with the
+    pools), fails the in-flight requests, and the engine decodes fresh
+    work correctly afterwards. (Simulated by deleting every donated
+    buffer before raising — what a mid-execution device failure leaves
+    behind.) The doomed prompt spans two full blocks so its prefix IS
+    cached before the failure: replaying it afterwards must re-prefill
+    exactly, not map a zeroed page the purged radix no longer knows."""
+    params, cfg = tiny_model
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                       max_context=32)
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+    real_step = eng._step_fn
+    state = {"armed": True}
+
+    def flaky_step(*a, **kw):
+        # fail the first step AFTER prefill completed — by then the
+        # prompt's two full blocks sit in the radix
+        if state["armed"] and eng._active.any():
+            state["armed"] = False
+            for leaf in jax.tree_util.tree_leaves(
+                    (eng._dstate, eng._kp, eng._vp)):
+                leaf.delete()
+            raise RuntimeError("injected device failure")
+        return real_step(*a, **kw)
+
+    eng._step_fn = flaky_step
+    eng.start()
+    doomed = eng.submit(prompt, SamplingParams(max_new_tokens=6))
+    with pytest.raises(RuntimeError, match="decode failed"):
+        doomed.wait(30.0)
+    assert len(eng.prefix_cache) == 0, "dead pages survived as cache"
+    # the thread survived; the rebuilt pools decode the SAME prompt
+    # exactly (a stale radix entry would map zeroed K/V instead)
+    fresh = eng.submit(prompt, SamplingParams(max_new_tokens=6))
+    got = fresh.wait(30.0)
+    eng.stop()
+    assert got == _reference_greedy(params, cfg, prompt, 6)
+
+
+# -------------------------------------------------------------- metrics
+
+def test_spec_metrics_surface_on_prom(tiny_model):
+    """spec_proposed/spec_accepted counters and the accepted-length
+    histogram publish through /prom as one family each."""
+    from hadoop_tpu.metrics import metrics_system
+    from hadoop_tpu.metrics.prom import render_prom
+    params, cfg = tiny_model
+    rng = np.random.default_rng(3)
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                       max_context=64, prefill_chunk=8, speculate_k=4,
+                       metrics=ServingMetrics())
+    _drive(eng, eng.submit(_motif_prompt(rng, cfg),
+                           SamplingParams(max_new_tokens=24)))
+    assert eng.spec_accepted > 0
+    stats = eng.cache_stats()["speculate"]
+    assert stats["proposed"] >= stats["accepted"] > 0
+    assert stats["k"] == 4
+    text = render_prom(metrics_system())
+    assert "htpu_spec_proposed" in text
+    assert "htpu_spec_accepted" in text
+    assert text.count("# TYPE htpu_spec_accept_len histogram") == 1
