@@ -120,6 +120,18 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — recorded for the
         # trajectory; must not discard the benches already computed
         out["serving_churn"] = {"error": f"{type(e).__name__}: {e}"}
+    # Elastic-fleet storm smoke: step-function load against a mini-fleet
+    # of real `hadoop-tpu serve` subprocesses + the autoscaler — fleet
+    # must grow, hold TTFT p99 within the SLO after settling, scale back
+    # in via the drain protocol with zero failed requests + post-drain
+    # DFS hit-rate recovery, and shed a heavy tenant (429) under
+    # overload before a light tenant degrades. Recorded, not raised.
+    try:
+        from benchmarks import serve_bench
+        out["serving_storm"] = serve_bench.run_storm_smoke()
+    except Exception as e:  # noqa: BLE001 — recorded for the
+        # trajectory; must not discard the benches already computed
+        out["serving_storm"] = {"error": f"{type(e).__name__}: {e}"}
     # Training plane: 8-virtual-device overlap smoke (A-B step counts +
     # bit-exact loss parity with the communication-overlap pass on vs
     # off, plus the async-save blocking-time split). Same recorded-not-

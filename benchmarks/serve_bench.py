@@ -473,6 +473,477 @@ def run_churn(preset="tiny", prefix_groups=2, shared_len=24,
     }
 
 
+def run_storm(preset="tiny", slo_ttft_s=15.0, qos_slo_s=10.0,
+              max_batch=4, block_size=4, chunk=8, max_context=64,
+              max_new=6, storm_workers=8, markers=10, seed=0,
+              spawn_timeout_s=120.0) -> dict:
+    """The elastic-fleet acceptance storm, end-to-end over the REAL CLI
+    path: a miniDFS (checkpoint + DFS KV tier), an in-process registry,
+    replicas as ``hadoop-tpu serve`` subprocesses, and the autoscaler
+    control loop driving them.
+
+    Step-function load: a light baseline, then ``storm_workers``
+    closed-loop clients slam the single replica. The hard contract:
+
+    - the fleet GROWS (1 → 2 replicas) under the storm;
+    - after the scale-out settles, fleet TTFT p99 (the autoscaler's own
+      windowed signal) is within the conf'd SLO;
+    - when the load drops the fleet scales back to baseline via the
+      drain protocol — ZERO failed requests across the whole run;
+    - post-drain the survivor recovers the drained replica's prefixes
+      from the DFS tier (``hits_dfs`` delta > 0 on marker prompts whose
+      rendezvous owner was the drained replica);
+    - under synthetic overload, a heavy tenant is shed (429 +
+      Retry-After) while a light tenant's requests all succeed with
+      p99 within the QoS SLO, and the shed counter shows on ``/prom``.
+    """
+    import http.client as _http
+    import statistics
+    import subprocess
+    import tempfile
+    import threading
+
+    import jax
+    import numpy as np
+
+    from hadoop_tpu.conf import Configuration
+    from hadoop_tpu.models.config import get_config
+    from hadoop_tpu.models.decoder import init_params
+    from hadoop_tpu.parallel.checkpoint import save_checkpoint
+    from hadoop_tpu.registry import RegistryServer
+    from hadoop_tpu.serving.autoscale import Autoscaler, FleetActuator
+    from hadoop_tpu.serving.autoscale.signals import http_get
+    from hadoop_tpu.serving.router import (REGISTRY_PREFIX,
+                                           ServingRouter, affinity_key,
+                                           rendezvous_owner)
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster, fast_conf
+
+    cfg = get_config(preset)
+    rng = np.random.default_rng(seed)
+    service = "storm"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def post_json(port, path, payload, timeout=60.0):
+        conn = _http.HTTPConnection("127.0.0.1", port, timeout=timeout)
+        try:
+            conn.request("POST", path,
+                         body=json.dumps(payload).encode())
+            resp = conn.getresponse()
+            body = resp.read()
+            return resp.status, (json.loads(body) if body else {}), \
+                resp.getheader("Retry-After")
+        finally:
+            conn.close()
+
+    class ProcFleet(FleetActuator):
+        """Spawn `hadoop-tpu serve` subprocesses; a drained replica
+        exits itself, retire() just reaps it."""
+
+        def __init__(self, ckpt_uri, reg_port, logdir):
+            self.ckpt_uri = ckpt_uri
+            self.reg_port = reg_port
+            self.logdir = logdir
+            self.procs = []
+            self.spawned = 0
+
+        def spawn(self, n=1):
+            for _ in range(n):
+                i = self.spawned
+                self.spawned += 1
+                logf = open(os.path.join(self.logdir,
+                                         f"replica-{i}.log"), "w")
+                cmd = [sys.executable, "-m", "hadoop_tpu.cli.main",
+                       "serve",
+                       "-D", "serving.kv.dfs.enable=true",
+                       "-D", "serving.qos.enabled=true",
+                       "-D", "serving.qos.shed.queue.depth=6",
+                       "-D", "serving.registry.record.ttl=5s",
+                       "-D", f"serving.max.batch={max_batch}",
+                       "-D", f"serving.kv.block.size={block_size}",
+                       "-D", f"serving.max.context={max_context}",
+                       "-D", f"serving.prefill.chunk={chunk}",
+                       "--name", service,
+                       "--checkpoint", self.ckpt_uri,
+                       "--preset", preset,
+                       "--registry", f"127.0.0.1:{self.reg_port}",
+                       "--host", "127.0.0.1", "--port", "0"]
+                env = dict(os.environ, JAX_PLATFORMS="cpu",
+                           PYTHONPATH=repo_root)
+                self.procs.append((subprocess.Popen(
+                    cmd, stdout=logf, stderr=subprocess.STDOUT,
+                    env=env), logf))
+
+        def scale_out(self, role, target):
+            live = sum(1 for p, _ in self.procs if p.poll() is None)
+            if target > live:
+                self.spawn(target - live)
+
+        def retire(self, sample, target):
+            # the drained replica exits on its own; wait for it
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                for p, _ in self.procs:
+                    if p.poll() is not None:
+                        return
+                time.sleep(0.2)
+
+        def reap(self):
+            for p, logf in self.procs:
+                if p.poll() is None:
+                    p.terminate()
+            for p, logf in self.procs:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                logf.close()
+
+    def live_records(reg_srv):
+        return [r for r in reg_srv.list(f"{REGISTRY_PREFIX}/{service}")
+                if r.attributes.get("state") == "serving"]
+
+    def wait_replicas(reg_srv, n, timeout, fleet):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            recs = live_records(reg_srv)
+            if len(recs) >= n:
+                return recs
+            time.sleep(0.5)
+        logs = ""
+        for i in range(fleet.spawned):
+            path = os.path.join(fleet.logdir, f"replica-{i}.log")
+            if os.path.exists(path):
+                with open(path) as f:
+                    logs += f"\n--- replica-{i} ---\n" + f.read()[-2000:]
+        raise TimeoutError(f"{n} replicas not live in {timeout}s:{logs}")
+
+    def affinity_owner(tokens, paths):
+        # the router's OWN rendezvous hash: which replica owns this
+        # prompt prefix while both are alive (shared helpers — the
+        # bench's owner attribution can never drift from routing)
+        return rendezvous_owner(
+            affinity_key(tokens, router.affinity_prefix), paths)
+
+    failures = []
+    failed_requests = [0]
+    latencies_light = []
+    conf = fast_conf()
+    conf.set("dfs.replication", "1")
+    result = {"metric": "serve_storm_peak_replicas", "unit": "replicas",
+              "preset": preset, "failed": failures}
+    with tempfile.TemporaryDirectory() as tmp, \
+            MiniDFSCluster(num_datanodes=1, conf=conf,
+                           base_dir=tmp) as cluster:
+        cluster.wait_active()
+        fs = cluster.get_filesystem()
+        params = init_params(jax.random.PRNGKey(seed), cfg)
+        save_checkpoint(fs, "/models/storm", 1,
+                        {"params": params, "opt": {}})
+        reg_conf = Configuration(load_defaults=False)
+        reg_srv = RegistryServer(reg_conf)
+        reg_srv.init(reg_conf)
+        reg_srv.start()
+        fleet = ProcFleet(f"{cluster.default_fs}/models/storm",
+                          reg_srv.port, tmp)
+        as_conf = Configuration(load_defaults=False)
+        as_conf.set("serving.autoscale.interval", "1s")
+        as_conf.set("serving.autoscale.ttft.p99.slo",
+                    f"{slo_ttft_s:g}s")
+        as_conf.set("serving.autoscale.queue.high", "1.5")
+        as_conf.set("serving.autoscale.breach.polls", "2")
+        as_conf.set("serving.autoscale.idle.polls", "3")
+        as_conf.set("serving.autoscale.cooldown", "6s")
+        as_conf.set("serving.autoscale.max", "2")
+        as_conf.set("serving.autoscale.drain.timeout", "90s")
+        as_conf.set("serving.registry.record.ttl", "5s")
+        scaler = Autoscaler(as_conf, ("127.0.0.1", reg_srv.port),
+                            service, actuator=fleet)
+        router = ServingRouter(("127.0.0.1", reg_srv.port), service,
+                               Configuration(load_defaults=False),
+                               cache_ttl_s=0.5)
+        heads = [rng.integers(0, cfg.vocab_size,
+                              size=2 * block_size).tolist()
+                 for _ in range(4)]
+        marker_heads = [rng.integers(0, cfg.vocab_size,
+                                     size=2 * block_size).tolist()
+                        for _ in range(markers)]
+
+        import random as _random
+        load_rng = _random.Random(seed)   # stdlib: GIL-safe across the
+        #                                   closed-loop worker threads
+
+        def one_request(user="storm"):
+            head = heads[load_rng.randrange(len(heads))]
+            tail = [load_rng.randrange(cfg.vocab_size)
+                    for _ in range(load_rng.randrange(2, 5))]
+            try:
+                router.generate({"tokens": head + tail,
+                                 "max_new_tokens": max_new,
+                                 "timeout": 120.0}, user=user)
+            except Exception as e:  # noqa: BLE001 — ANY client-visible
+                # failure breaks the zero-failures contract
+                failed_requests[0] += 1
+                failures.append(f"request failed: {type(e).__name__}: "
+                                f"{e}")
+
+        stop_load = threading.Event()
+
+        def load_worker():
+            while not stop_load.is_set():
+                one_request()
+
+        try:
+            fleet.spawn(1)
+            wait_replicas(reg_srv, 1, spawn_timeout_s, fleet)
+            scaler.start()
+            # phase A: light baseline
+            t_phase = time.monotonic()
+            while time.monotonic() - t_phase < 3.0:
+                one_request()
+                time.sleep(0.1)
+            # phase B: the step function — closed-loop storm
+            workers = [threading.Thread(target=load_worker,
+                                        daemon=True)
+                       for _ in range(storm_workers)]
+            for w in workers:
+                w.start()
+            try:
+                recs2 = wait_replicas(reg_srv, 2, spawn_timeout_s,
+                                      fleet)
+            except TimeoutError as e:
+                failures.append(f"fleet never grew: {e}")
+                recs2 = live_records(reg_srv)
+            grow_decisions = [d for d in scaler.decisions
+                              if d.action == "grow"]
+            if not grow_decisions:
+                failures.append("no grow decision was recorded")
+            paths2 = [r.path for r in recs2]
+            # settle, then judge TTFT p99 off the autoscaler's own
+            # windowed signal
+            time.sleep(6.0)
+            p99s = []
+            t_settle = time.monotonic()
+            while time.monotonic() - t_settle < 5.0:
+                snap = scaler.last_snapshot
+                if snap is not None and snap.ttft_p99_s is not None:
+                    p99s.append(snap.ttft_p99_s)
+                time.sleep(0.5)
+            settle_p99 = statistics.median(p99s) if p99s else None
+            if settle_p99 is None:
+                failures.append("no TTFT p99 signal after scale-out")
+            elif settle_p99 > slo_ttft_s:
+                failures.append(
+                    f"settled TTFT p99 {settle_p99:.3f}s over the "
+                    f"{slo_ttft_s:g}s SLO with the grown fleet")
+            # phase C: calm window — seed the markers while affinity is
+            # deterministic (no load imbalance), then drop the load so
+            # the autoscaler scales back in
+            stop_load.set()
+            for w in workers:
+                w.join(timeout=150.0)
+            time.sleep(1.0)
+            marker_owner = {}
+            if len(paths2) >= 2:
+                for idx, m in enumerate(marker_heads):
+                    prompt = m + [1, 2]
+                    marker_owner[idx] = affinity_owner(prompt, paths2)
+                    try:
+                        router.generate({"tokens": prompt,
+                                         "max_new_tokens": 2,
+                                         "timeout": 60.0})
+                    except Exception as e:  # noqa: BLE001
+                        failed_requests[0] += 1
+                        failures.append(f"marker seed failed: {e}")
+            # keep a trickle alive so drain happens under (light) load
+            trickle_stop = threading.Event()
+
+            def trickle():
+                while not trickle_stop.is_set():
+                    one_request()
+                    time.sleep(0.4)
+
+            tr = threading.Thread(target=trickle, daemon=True)
+            tr.start()
+            # scale-in complete = the victim PROCESS exited (it only
+            # exits after the drain finished persisting) — the registry
+            # record can expire by TTL mid-drain once heartbeats stop,
+            # so record-count alone would race the persist
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                alive = sum(1 for p, _ in fleet.procs
+                            if p.poll() is None)
+                if alive <= 1 and len(live_records(reg_srv)) <= 1:
+                    break
+                time.sleep(0.5)
+            trickle_stop.set()
+            tr.join(timeout=150.0)
+            survivors = live_records(reg_srv)
+            if len(survivors) != 1:
+                failures.append(f"fleet did not scale back to 1 "
+                                f"(live={len(survivors)})")
+            shrink_decisions = [d for d in scaler.decisions
+                                if d.action == "shrink"]
+            if not shrink_decisions:
+                failures.append("no shrink decision was recorded")
+            scaler.stop()
+            # post-drain recovery: replay markers whose rendezvous
+            # owner was the DRAINED replica — the survivor must map
+            # them back from the DFS tier, not re-prefill
+            hits_dfs_delta = 0
+            try:
+                result["kvcache_dirs"] = len(
+                    fs.list_status("/kvcache"))
+            except (OSError, IOError):
+                result["kvcache_dirs"] = 0
+            if survivors and marker_owner:
+                surv = survivors[0]
+                result["survivor"] = surv.path
+                host, _, port = surv.endpoints["http"].rpartition(":")
+                port = int(port)
+
+                def surv_hits():
+                    h = json.loads(http_get(host, port, "/v1/health",
+                                            10.0))
+                    return int(((h.get("prefix_cache") or {})
+                                .get("tiers") or {}).get("hits_dfs", 0))
+
+                before = surv_hits()
+                drained_markers = [
+                    i for i, owner in marker_owner.items()
+                    if owner != surv.path]
+                result["drained_markers"] = len(drained_markers)
+                result["surv_hits_before"] = before
+                if not drained_markers:
+                    failures.append(
+                        f"all {markers} markers rendezvous onto the "
+                        f"survivor (p≈2^-{markers}) — rerun")
+                for i in drained_markers:
+                    status, body, _ = post_json(
+                        port, "/v1/generate",
+                        {"tokens": marker_heads[i] + [1, 2],
+                         "max_new_tokens": 2, "timeout": 60.0})
+                    if status != 200:
+                        failed_requests[0] += 1
+                        failures.append(
+                            f"marker replay -> HTTP {status}: {body}")
+                hits_dfs_delta = surv_hits() - before
+                if drained_markers and hits_dfs_delta <= 0:
+                    failures.append(
+                        "survivor recovered nothing from the DFS tier "
+                        "after the drain (hits_dfs delta 0)")
+                # QoS overload: heavy tenant floods the survivor's door
+                # directly; a light tenant keeps getting served
+                heavy_sheds = [0]
+                light_sheds = [0]
+                qos_stop = threading.Event()
+
+                def heavy_worker():
+                    while not qos_stop.is_set():
+                        try:
+                            status, _, ra = post_json(
+                                port, "/v1/generate?user.name=heavy",
+                                {"tokens": heads[0] + [3, 4],
+                                 "max_new_tokens": max_new,
+                                 "timeout": 60.0}, timeout=90.0)
+                            if status == 429:
+                                heavy_sheds[0] += 1
+                                time.sleep(min(float(ra or 0.2), 0.5))
+                        except OSError:
+                            break
+
+                hw = [threading.Thread(target=heavy_worker,
+                                       daemon=True)
+                      for _ in range(12)]
+                for w in hw:
+                    w.start()
+                time.sleep(1.0)
+                for _ in range(8):
+                    t0 = time.monotonic()
+                    status, body, _ = post_json(
+                        port, "/v1/generate?user.name=light",
+                        {"tokens": heads[1] + [5, 6],
+                         "max_new_tokens": max_new,
+                         "timeout": 60.0}, timeout=90.0)
+                    if status == 429:
+                        light_sheds[0] += 1
+                    elif status != 200:
+                        failures.append(
+                            f"light tenant -> HTTP {status}: {body}")
+                    else:
+                        latencies_light.append(
+                            time.monotonic() - t0)
+                    time.sleep(0.2)
+                qos_stop.set()
+                for w in hw:
+                    w.join(timeout=120.0)
+                prom = http_get(host, port, "/prom", 10.0).decode()
+                shed_line = [ln for ln in prom.splitlines()
+                             if ln.startswith("htpu_qos_shed_total")]
+                prom_sheds = sum(float(ln.rsplit(" ", 1)[1])
+                                 for ln in shed_line)
+                if heavy_sheds[0] <= 0 or prom_sheds <= 0:
+                    failures.append(
+                        f"heavy tenant was never shed under overload "
+                        f"(client 429s={heavy_sheds[0]}, /prom "
+                        f"sheds={prom_sheds})")
+                if light_sheds[0] > 0:
+                    failures.append(
+                        f"light tenant was shed {light_sheds[0]} "
+                        f"times — fairness inverted")
+                light_p99 = (sorted(latencies_light)[
+                    max(0, int(0.99 * len(latencies_light)) - 1)]
+                    if latencies_light else None)
+                if light_p99 is None:
+                    failures.append("light tenant never completed a "
+                                    "request under overload")
+                elif light_p99 > qos_slo_s:
+                    failures.append(
+                        f"light tenant p99 {light_p99:.2f}s degraded "
+                        f"past {qos_slo_s:g}s while heavy was shedding")
+                result.update(
+                    qos_heavy_sheds=heavy_sheds[0],
+                    qos_light_sheds=light_sheds[0],
+                    qos_prom_sheds=prom_sheds,
+                    qos_light_p99_s=round(light_p99, 3)
+                    if light_p99 is not None else None)
+            if failed_requests[0] > 0:
+                failures.append(
+                    f"{failed_requests[0]} requests failed across the "
+                    f"storm (contract: zero)")
+            result.update(
+                value=max(len(recs2), 1),
+                grow_decisions=len(grow_decisions),
+                shrink_decisions=len(shrink_decisions),
+                settle_ttft_p99_s=round(settle_p99, 4)
+                if settle_p99 is not None else None,
+                ttft_p99_slo_s=slo_ttft_s,
+                failed_requests=failed_requests[0],
+                hits_dfs_delta=hits_dfs_delta,
+                decisions=[{"role": d.role, "action": d.action,
+                            "current": d.current, "target": d.target,
+                            "reason": d.reason}
+                           for d in scaler.decisions])
+        finally:
+            try:
+                scaler.stop()
+            except Exception as e:  # noqa: BLE001
+                print(f"WARN: scaler stop: {e}", file=sys.stderr)
+            router.close()
+            fleet.reap()
+            reg_srv.stop()
+    return result
+
+
+def run_storm_smoke() -> dict:
+    """Storm smoke for benchmarks.run_all: raises unless the elastic
+    contract holds end-to-end (grow → SLO held → drain-in with zero
+    failures and DFS recovery → heavy-tenant shed under overload)."""
+    result = run_storm()
+    if result["failed"]:
+        raise AssertionError("; ".join(result["failed"]))
+    return result
+
+
 def run_smoke() -> dict:
     """Tiny-config shared-prefix smoke for benchmarks.run_all: raises
     unless the deterministic contract holds (compile-once per shape,
@@ -525,6 +996,16 @@ def main(argv=None) -> int:
                          "positive (recovered from the DFS tier) with "
                          "strictly fewer engine steps than the "
                          "DFS-tier-off arm")
+    ap.add_argument("--storm", action="store_true",
+                    help="step-function load against a mini-fleet of "
+                         "real `hadoop-tpu serve` subprocesses + the "
+                         "autoscaler; fails unless the fleet grows, "
+                         "TTFT p99 holds within the SLO after "
+                         "scale-out settles, scale-in drains with "
+                         "zero failed requests and post-drain DFS "
+                         "hit-rate recovery, and a heavy tenant is "
+                         "shed (429) under overload while a light "
+                         "tenant keeps being served")
     ap.add_argument("--prefix-groups", type=int, default=4)
     ap.add_argument("--shared-len", type=int, default=80)
     ap.add_argument("--no-prefix-cache", action="store_true",
@@ -567,6 +1048,9 @@ def main(argv=None) -> int:
         failed = result["failed"]
         for msg in result["warnings"]:
             print(f"WARN: {msg}", file=sys.stderr)
+    elif args.storm:
+        result = run_storm(preset=args.preset)
+        failed = result["failed"]
     elif args.churn:
         result = run_churn(preset=args.preset, max_new=args.max_new,
                            max_batch=args.max_batch, seed=args.seed,

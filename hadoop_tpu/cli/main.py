@@ -12,6 +12,7 @@ src/main/bin/hadoop + hadoop-functions.sh, hdfs/yarn/mapred CLIs):
   hadoop-tpu rm|nodeagent                  resource-manager daemons
   hadoop-tpu historyserver|kms|httpfs|router|registry   more daemons
   hadoop-tpu serve --checkpoint URI --preset NAME   inference replica
+  hadoop-tpu autoscale --registry H:P --service N   serving SLO controller
   hadoop-tpu job -submit ...               MapReduce job control
   hadoop-tpu distcp SRC DST ...            distributed copy
   hadoop-tpu streaming --mapper CMD ...    external-process jobs
@@ -199,6 +200,12 @@ def _main(argv=None) -> int:
         # launches this same entry point per container
         from hadoop_tpu.serving.service import replica_main
         return replica_main(rest, conf)
+    if cmd == "autoscale":
+        # the serving fleet's SLO controller: scrapes the registry +
+        # every replica's /prom, grows/shrinks the fleet against
+        # conf-keyed TTFT/backlog SLOs (advise mode without --rm/--app)
+        from hadoop_tpu.serving.autoscale.__main__ import autoscaler_main
+        return autoscaler_main(rest, conf)
     if cmd == "job":
         # ref: mapred job -list/-status/-kill
         from hadoop_tpu.util.misc import parse_addr_list

@@ -1,6 +1,8 @@
 """Service registry (ref: hadoop-common-project/hadoop-registry)."""
 
-from hadoop_tpu.registry.registry import (RegistryClient, RegistryServer,
-                                          ServiceRecord)
+from hadoop_tpu.registry.registry import (HEARTBEAT_ATTR, RegistryClient,
+                                          RegistryServer, ServiceRecord,
+                                          record_is_stale)
 
-__all__ = ["RegistryClient", "RegistryServer", "ServiceRecord"]
+__all__ = ["RegistryClient", "RegistryServer", "ServiceRecord",
+           "HEARTBEAT_ATTR", "record_is_stale"]

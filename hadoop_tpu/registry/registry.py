@@ -23,6 +23,49 @@ from hadoop_tpu.service import AbstractService
 
 log = logging.getLogger(__name__)
 
+# services that publish liveness heartbeats stamp this attribute with
+# time.time() on every refresh; consumers (router, autoscaler) treat a
+# record whose stamp has aged past the record TTL as dead even while it
+# still sits in the registry (a wedged sweeper, or a consumer serving
+# its stale cache through a registry outage, must not route into a
+# corpse)
+HEARTBEAT_ATTR = "hb"
+
+RECORD_TTL_KEY = "serving.registry.record.ttl"
+
+
+def record_ttl(conf) -> float:
+    """THE record-TTL resolution, shared by publisher (replica
+    heartbeat cadence), router, and autoscaler — three consumers
+    resolving it differently would disagree on what 'stale' means.
+    Falls back to the older ``serving.registry.ttl`` key."""
+    return conf.get_time_seconds(
+        RECORD_TTL_KEY, conf.get_time_seconds("serving.registry.ttl",
+                                              10.0))
+
+
+def record_is_stale(record: "ServiceRecord", ttl_s: float,
+                    now: Optional[float] = None) -> bool:
+    """Client-side staleness: the record's owner stopped heartbeating.
+    Records without the attribute (hand-registered, pre-heartbeat
+    publishers) are never stale — the registry's own TTL sweep is
+    their only eviction.
+
+    The stamp is the publisher's wall clock compared against the
+    consumer's: the check assumes NTP-disciplined hosts (skew well
+    under the TTL, 10s by default — the same assumption Kerberos and
+    every lease in the reference make). A consumer whose clock runs a
+    full TTL ahead would see the whole fleet as stale; keep the TTL
+    comfortably above your clock-sync error budget."""
+    hb = record.attributes.get(HEARTBEAT_ATTR)
+    if not hb:
+        return False
+    try:
+        stamp = float(hb)
+    except (TypeError, ValueError):
+        return True     # a malformed stamp means a broken publisher
+    return (time.time() if now is None else now) - stamp > ttl_s
+
 
 class ServiceRecord:
     """Ref: registry/client/types/ServiceRecord.java."""

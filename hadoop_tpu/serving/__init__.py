@@ -11,8 +11,13 @@ The compute plane inherited from the reference is batch-only (PAPER.md
     kvstore/    tiered fleet-wide KV cache: HBM radix -> host-RAM ring
                 -> DFS prefix store (+ raw/int8 block codecs)
     server.py   /v1/generate (streaming) + /v1/prefill + /v1/health
+                + /v1/admin/drain (autoscaler-initiated retirement)
     router.py   registry discovery, role- and prefix-affinity-aware
                 balancing, prefill/decode disaggregation handoff
+    qos.py      door QoS: per-tenant decay-cost fairness + load
+                shedding (FairCallQueue ported to admission)
+    autoscale/  the SLO control loop: scrape /prom + registry, grow
+                and shrink the fleet, drain-aware scale-in
     service.py  the replica packaged as a YARN long-running service
     metrics.py  queue depth / occupancy / TTFT / per-tier KV wiring
 

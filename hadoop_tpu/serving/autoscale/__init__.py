@@ -1,0 +1,33 @@
+"""SLO-driven elastic serving fleet — the autoscaler control plane.
+
+The last loop of the serving story closed: the registry provides
+membership (with heartbeat staleness), every replica's ``/v1/health`` +
+``/prom`` provide the signals (TTFT p99 over a sliding window, queue
+depth, prefill backlog, utilization, QoS sheds), and a ``FleetActuator``
+provides the muscle (YARN ``flex``, or a local fleet in benchmarks).
+
+    signals.py     prom parsing, windowed histogram quantiles, the
+                   per-poll FleetSnapshot
+    controller.py  the Autoscaler: hysteresis + cooldown, cold-start-
+                   aware growth, role-aware pools, drain-aware shrink
+    __main__.py    standalone daemon (`hadoop-tpu autoscale`) and the
+                   YARN-packaged controller component
+"""
+
+from hadoop_tpu.serving.autoscale.controller import (AdviseOnlyActuator,
+                                                     Autoscaler,
+                                                     FleetActuator,
+                                                     ScaleDecision,
+                                                     YarnServiceActuator)
+from hadoop_tpu.serving.autoscale.signals import (FleetScraper,
+                                                  FleetSnapshot,
+                                                  ReplicaSample,
+                                                  histogram_p99,
+                                                  parse_prom)
+
+__all__ = [
+    "Autoscaler", "FleetActuator", "AdviseOnlyActuator",
+    "YarnServiceActuator", "ScaleDecision",
+    "FleetScraper", "FleetSnapshot", "ReplicaSample",
+    "parse_prom", "histogram_p99",
+]

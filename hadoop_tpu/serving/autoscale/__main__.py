@@ -1,0 +1,94 @@
+"""Standalone autoscaler daemon — `hadoop-tpu autoscale` and the YARN
+service component both land here.
+
+    python -m hadoop_tpu.serving.autoscale \
+        --registry HOST:PORT --service NAME \
+        [--rm HOST:PORT --app APP_ID [--component replica]] \
+        [--http-port N]
+
+Without ``--rm/--app`` the controller runs in **advise** mode: it
+scrapes, decides, and publishes its would-have-done decisions on
+``/ws/v1/autoscaler`` and ``/prom`` — the dry-run an operator watches
+before handing it the flex lever. The HTTP chassis is the same one
+every daemon rides, so ``/prom``, ``/jmx`` and the trace endpoints come
+for free next to the status door.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+from hadoop_tpu.conf import Configuration
+
+log = logging.getLogger(__name__)
+
+
+def autoscaler_main(argv: List[str],
+                    conf: Optional[Configuration] = None) -> int:
+    from hadoop_tpu.cli.main import parse_generic_options
+    from hadoop_tpu.http.server import HttpServer
+    from hadoop_tpu.serving.autoscale.controller import (
+        Autoscaler, YarnServiceActuator)
+    from hadoop_tpu.util.misc import parse_addr_list
+    from hadoop_tpu.yarn.records import ApplicationId
+
+    conf = conf or Configuration()
+    argv = parse_generic_options(conf, list(argv))
+    args = dict(registry=None, service="serving", rm=None, app=None,
+                component="replica", http_port="0", host="127.0.0.1")
+    i = 0
+    while i < len(argv):
+        key = argv[i].lstrip("-").replace("-", "_")
+        if key in args and i + 1 < len(argv):
+            args[key] = argv[i + 1]
+            i += 2
+        else:
+            print(f"unknown autoscale option {argv[i]}", file=sys.stderr)
+            return 2
+    if not args["registry"]:
+        print("usage: autoscale --registry HOST:PORT --service NAME "
+              "[--rm HOST:PORT --app APP_ID [--component NAME]] "
+              "[--http-port N]", file=sys.stderr)
+        return 2
+    registry_addr = parse_addr_list(args["registry"])[0]
+    actuator = None
+    if args["rm"] and args["app"]:
+        try:  # application_<cluster_ts>_<seq>
+            _, ts, seq = str(args["app"]).split("_")
+            app_id = ApplicationId(int(ts), int(seq))
+        except ValueError:
+            print(f"bad --app {args['app']!r} (want "
+                  f"application_<ts>_<seq>)", file=sys.stderr)
+            return 2
+        actuator = YarnServiceActuator(
+            parse_addr_list(args["rm"])[0], app_id,
+            component=str(args["component"]), conf=conf)
+    scaler = Autoscaler(conf, registry_addr, str(args["service"]),
+                        actuator=actuator)
+    http = HttpServer(conf, (str(args["host"]), int(args["http_port"])),
+                      daemon_name="autoscaler")
+    http.add_handler("/ws/v1/autoscaler",
+                     lambda q, b: (200, scaler.status()))
+    http.start()
+    scaler.start()
+    log.info("autoscaler for %s up on :%d (%s mode)", args["service"],
+             http.port, "flex" if actuator else "advise")
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        scaler.stop()
+        http.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    sys.exit(autoscaler_main(sys.argv[1:]))

@@ -103,6 +103,7 @@ Design (TPU-first, same rules as the trainer):
 from __future__ import annotations
 
 import itertools
+import logging
 import queue
 import threading
 import time
@@ -125,6 +126,8 @@ from hadoop_tpu.serving.kvstore import (BlockPool, PrefixCache,
                                         TieredKVCache)
 from hadoop_tpu.serving.speculate import NgramProposer
 from hadoop_tpu.tracing.tracer import global_tracer
+
+log = logging.getLogger(__name__)
 
 _NEG_INF = -1e30
 
@@ -214,6 +217,9 @@ class GenRequest:
     error: Optional[str] = None
     submitted_at: float = field(default_factory=time.monotonic)
     first_token_at: Optional[float] = None
+    # auth identity for door QoS: the fair admission queue orders
+    # pending requests by the tenant's decayed usage share
+    tenant: str = ""
     preemptions: int = 0
     prefix_tokens_reused: int = 0     # cached tokens mapped at admission
     # trace context of the request's door span: engine-side spans
@@ -306,6 +312,7 @@ class DecodeEngine:
                  kv_store_fs=None, kv_store_dir: str = "/kvcache",
                  kv_dfs_min_refs: int = 1, kv_codec: str = "raw",
                  speculate_k: int = 0, speculate_ngram: int = 3,
+                 admission_queue=None, drain_persist: bool = True,
                  plan=None, metrics=None, tracer=None):
         if cfg.is_moe:
             raise NotImplementedError("serving MoE checkpoints is not "
@@ -391,7 +398,12 @@ class DecodeEngine:
         self._dz_drafts = jnp.zeros((max_batch, self.spec_k), jnp.int32)
         self._dz_lens = jnp.zeros((max_batch,), jnp.int32)
 
-        self._pending: deque = deque()  # guarded-by: _cond
+        # the admission seam: a deque by default, or any deque-shaped
+        # queue (append/appendleft/popleft/len/[0]) — the door's QoS
+        # layer installs a per-tenant weighted-round-robin queue here
+        self._pending = admission_queue if admission_queue is not None \
+            else deque()                # guarded-by: _cond
+        self.drain_persist = drain_persist
         self._admit_counter = itertools.count()
         self._cond = threading.Condition()
         self._sched_lock = threading.Lock()
@@ -693,7 +705,7 @@ class DecodeEngine:
 
     def submit(self, prompt: List[int],
                sampling: Optional[SamplingParams] = None,
-               trace_ctx=None) -> GenRequest:
+               trace_ctx=None, tenant: str = "") -> GenRequest:
         sampling = sampling or SamplingParams()
         if not prompt:
             raise ValueError("empty prompt")
@@ -716,7 +728,8 @@ class DecodeEngine:
                 f"{self.pool.num_usable} — it could never run alone")
         from hadoop_tpu.tracing.tracer import current_context
         req = GenRequest(prompt=list(prompt), sampling=sampling,
-                         trace_ctx=trace_ctx or current_context())
+                         trace_ctx=trace_ctx or current_context(),
+                         tenant=tenant)
         with self._cond:
             self._pending.append(req)
             depth = len(self._pending)
@@ -739,6 +752,22 @@ class DecodeEngine:
     def queue_depth(self) -> int:
         with self._cond:
             return len(self._pending)
+
+    @property
+    def prefill_backlog(self) -> int:
+        """Prompt tokens still awaiting prefill across admitted
+        requests — the stall gauge the autoscaler sizes prefill
+        capacity against. Read lock-free from the health thread: each
+        slot's fields are snapshotted once, so a prefill completing
+        mid-scan reads as 0, never as a TypeError."""
+        total = 0
+        for r in list(self._slots):
+            if r is None:
+                continue
+            pos = r._prefill_pos
+            if pos is not None:
+                total += max(0, len(r._ctx) - pos)
+        return total
 
     @property
     def idle(self) -> bool:
@@ -1294,9 +1323,7 @@ class DecodeEngine:
         m.prefix_cache_hit_rate.set(round(stats["hit_rate"], 4))
         m.prefix_cached_blocks.set(stats["cached_blocks"])
         m.chunk_occupancy.set(self._chunk_fill / self.prefill_chunk)
-        m.prefill_backlog.set(sum(
-            len(r._ctx) - r._prefill_pos for r in self._slots
-            if r is not None and r._prefill_pos is not None))
+        m.prefill_backlog.set(self.prefill_backlog)
 
     # --------------------------------------------------- replica lifecycle
 
@@ -1322,6 +1349,14 @@ class DecodeEngine:
                     if remaining <= 0:
                         break
                     self._cond.wait(remaining)
+            if self.drain_persist and self.kvstore.dfs_enabled:
+                # affinity-aware drain: ship every resident cached
+                # prefix to the DFS tier BEFORE the pools die with this
+                # process, so a surviving replica maps the departed
+                # replica's hot prefixes back instead of re-prefilling
+                # — scale-in must never torch the fleet's cache
+                self.persist_cache(
+                    timeout=max(1.0, deadline - time.monotonic()))
         self._stop.set()
         with self._cond:
             self._cond.notify_all()
@@ -1352,6 +1387,22 @@ class DecodeEngine:
             if locked:
                 self._sched_lock.release()
         self.kvstore.close()
+
+    def persist_cache(self, timeout: float = 30.0) -> int:
+        """Force-persist every resident cached block (HBM radix + host
+        ring) to the DFS tier and wait for durability — the drain half
+        of affinity-aware scale-in. Returns the number of blocks
+        enqueued; best-effort on timeout (whatever went durable is
+        durable, the rest is recomputable by definition)."""
+        if not self.kvstore.dfs_enabled:
+            return 0
+        with self._sched_lock:
+            n = self.kvstore.persist_resident()
+            watermark = self.kvstore.persists_enqueued
+        if n and not self.kvstore.flush(timeout, up_to=watermark):
+            log.warning("drain persist did not finish in %.1fs "
+                        "(%d blocks enqueued)", timeout, n)
+        return n
 
     # ------------------------------------------------ disaggregation face
 

@@ -64,6 +64,14 @@ class HostTier:
             self._v[slot] = v
         return True
 
+    def items(self) -> List[Tuple[bytes, np.ndarray, np.ndarray]]:
+        """Copies of every resident (digest, K, V) — the drain path
+        persists the whole ring to the DFS tier before the process
+        exits. Copied under the lock like ``get``."""
+        with self._lock:
+            return [(d, self._k[s].copy(), self._v[s].copy())
+                    for d, s in self._index.items()]
+
     def get(self, digest: bytes
             ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         """Copies of the block's (K, V), or None. Copied under the lock

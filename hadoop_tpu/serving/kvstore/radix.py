@@ -83,6 +83,11 @@ class PrefixCache:
     def node_for_block(self, block: int) -> Optional[_RadixNode]:
         return self._nodes.get(block)
 
+    def nodes(self) -> List["_RadixNode"]:
+        """Every resident node (no particular order) — the drain path
+        walks these to persist the whole cache."""
+        return list(self._nodes.values())
+
     def _touch(self, node: _RadixNode) -> None:
         if node.block in self._lru:
             self._lru.move_to_end(node.block)

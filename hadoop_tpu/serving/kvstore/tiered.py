@@ -308,6 +308,40 @@ class TieredKVCache:
                 self._enqueue_persist(node, parent_ctx)
         return len(nodes)
 
+    def persist_resident(self, parent_ctx=None) -> int:
+        """Drain-time handoff: enqueue persistence of EVERY resident
+        cached block — the whole HBM radix (not just min-refs-hot
+        nodes) plus the host ring — so scale-in hands the fleet its
+        cache instead of torching it. A block another replica already
+        persisted dedups at the DFSTier rename. Caller holds the
+        scheduler lock (same contract as ``persist_prefix``); returns
+        the number of blocks enqueued, which bounds the caller's
+        ``flush`` watermark."""
+        if self.dfs is None:
+            return 0
+        n = 0
+        if self.radix is not None:
+            for node in self.radix.nodes():
+                if not node.persisted:
+                    self._enqueue_persist(node, parent_ctx)
+                    n += 1
+        if self.host is not None:
+            for digest, k, v in self.host.items():
+                self._enqueue_raw(digest, k, v, parent_ctx)
+                n += 1
+        return n
+
+    def _enqueue_raw(self, digest: bytes, k, v, parent_ctx) -> None:
+        """Persist a payload that has no radix node (a host-ring entry
+        whose HBM page is long gone). Rides the same writer queue and
+        done/failure counters so ``flush`` watermarks cover it."""
+        self.persists_enqueued += 1
+        job = carry_context(
+            lambda: self._write_block(None, k, v, parent_ctx,
+                                      digest=digest))
+        self._write_q.put(job)
+        self._ensure_writer()
+
     def _enqueue_persist(self, node: _RadixNode, parent_ctx) -> None:
         """Extract now (scheduler thread — the page could be evicted or
         rewritten the moment the lock drops), write later (writer
@@ -320,23 +354,28 @@ class TieredKVCache:
         job = carry_context(
             lambda: self._write_block(node, k, v, parent_ctx))
         self._write_q.put(job)
+        self._ensure_writer()
+
+    def _ensure_writer(self) -> None:
         if self._writer is None:
             self._writer = threading.Thread(
                 target=self._write_loop, name="kv-dfs-writer",
                 daemon=True)
             self._writer.start()
 
-    def _write_block(self, node: _RadixNode, k, v, parent_ctx) -> None:
+    def _write_block(self, node: Optional[_RadixNode], k, v, parent_ctx,
+                     digest: Optional[bytes] = None) -> None:
         sp = self.tracer.span("serving.kv.persist", parent=parent_ctx)
         sp.add_kv("bytes", str(k.nbytes + v.nbytes))
         sp.add_kv("codec", self.codec)
         ok = False
         try:
-            ok = self.dfs.put(node.digest, k, v)
+            ok = self.dfs.put(node.digest if node is not None
+                              else digest, k, v)
         finally:
             sp.add_kv("ok", str(ok))
             sp.finish()
-            if not ok:
+            if not ok and node is not None:
                 # let a later hot match retry the write; MUST precede
                 # the counter bump — flush() returns the moment
                 # done+failures reaches its watermark, and the caller

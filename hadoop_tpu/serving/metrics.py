@@ -43,6 +43,9 @@ class ServingMetrics:
                              token counters (proposal vs verifier)
     - ``spec_accept_len``    log-bucketed accepted-draft-length
                              histogram per speculating lane-step
+    - ``qos_admitted`` / ``qos_shed``  door QoS gate outcomes (sheds
+                             are 429 + Retry-After responses)
+    - ``qos_tenants``        tenants tracked by the decay scheduler
     """
 
     def __init__(self, source: str = SOURCE):
@@ -130,6 +133,16 @@ class ServingMetrics:
         self.spec_accept_len = reg.histogram(
             "spec_accept_len",
             "accepted draft-prefix length per speculating lane-step")
+        # door QoS: admissions vs sheds (429) and tracked tenants — the
+        # autoscaler scrapes qos_shed off /prom as a scale-out signal
+        # (a shedding fleet is past its SLO by definition)
+        self.qos_admitted = reg.counter(
+            "qos_admitted", "requests admitted through the QoS gate")
+        self.qos_shed = reg.counter(
+            "qos_shed",
+            "requests shed (429 + Retry-After) at the serving door")
+        self.qos_tenants = reg.gauge(
+            "qos_tenants", "tenants tracked by the decay cost scheduler")
 
     def snapshot(self):
         return self.registry.snapshot()

@@ -40,7 +40,8 @@ from typing import Dict, Iterator, List, Optional, Tuple
 from hadoop_tpu.conf import Configuration
 from hadoop_tpu.ipc.errors import RetriableError
 from hadoop_tpu.ipc.retry import RetryAction, RetryPolicies, RetryPolicy
-from hadoop_tpu.registry.registry import RegistryClient, ServiceRecord
+from hadoop_tpu.registry.registry import (RegistryClient, ServiceRecord,
+                                          record_is_stale, record_ttl)
 from hadoop_tpu.tracing.tracer import current_context, global_tracer
 
 log = logging.getLogger(__name__)
@@ -50,6 +51,22 @@ REGISTRY_PREFIX = "/services/serving"
 
 def replica_path(service: str, instance: str) -> str:
     return f"{REGISTRY_PREFIX}/{service}/{instance}"
+
+
+def affinity_key(tokens, prefix_tokens: int = 64) -> str:
+    """Digest of a bounded prompt prefix — THE routing key. One
+    definition: the router routes by it and the storm bench predicts
+    owners with it; forking the formula would silently split them."""
+    head = ",".join(str(t) for t in tokens[:prefix_tokens])
+    return hashlib.sha256(head.encode()).hexdigest()
+
+
+def rendezvous_owner(key: str, paths):
+    """Highest-random-weight owner of ``key`` among replica ``paths``
+    (stable under membership churn: only the departed owner's keys
+    move)."""
+    return max(paths, key=lambda p: hashlib.sha256(
+        f"{key}|{p}".encode()).digest())
 
 
 class NoReplicasError(RetriableError):
@@ -107,6 +124,12 @@ class ServingRouter:
             "serving.router.prefill.timeout", 20.0)
         self.prefill_offloaded = 0    # handoffs that reached a prefill
         #                               replica (failures decode cold)
+        # heartbeat staleness: a replica that died without deregistering
+        # (SIGKILL, kernel panic) stops stamping its record; past this
+        # TTL the router skips it instead of burning a retry into a
+        # corpse — which matters most on the stale-cache path below,
+        # where a registry outage would otherwise freeze membership
+        self.record_ttl = record_ttl(self.conf)
 
     # ------------------------------------------------------------ discovery
 
@@ -117,22 +140,28 @@ class ServingRouter:
         with self._lock:
             if not refresh and self._cache and \
                     now - self._cache_at < self._cache_ttl:
-                return list(self._cache)
+                return [r for r in self._cache
+                        if not record_is_stale(r, self.record_ttl)]
         try:
             recs = [r for r in self.reg.list(
                         f"{REGISTRY_PREFIX}/{self.service}")
                     if "http" in r.endpoints
-                    and r.attributes.get("state", "serving") == "serving"]
+                    and r.attributes.get("state", "serving") == "serving"
+                    and not record_is_stale(r, self.record_ttl)]
         except (OSError, IOError) as e:
             # registry briefly unreachable (restart, RPC timeout): the
             # stale cache is a better answer than aborting every
-            # request mid-flight; with no cache the failure is
-            # retriable like any other transport error
+            # request mid-flight — minus replicas whose heartbeats have
+            # aged out (through a LONG outage the cache decays to empty
+            # instead of pointing at corpses forever); with nothing
+            # live the failure is retriable like any transport error
             with self._lock:
-                if self._cache:
+                live = [r for r in self._cache
+                        if not record_is_stale(r, self.record_ttl)]
+                if live:
                     log.debug("registry lookup failed (%s); serving "
                               "stale replica cache", e)
-                    return list(self._cache)
+                    return live
             raise NoReplicasError(f"registry unreachable: {e}")
         with self._lock:
             self._cache = recs
@@ -148,8 +177,7 @@ class ServingRouter:
         if (not self.affinity_enabled or not isinstance(tokens, list)
                 or not tokens):
             return None
-        head = ",".join(str(t) for t in tokens[:self.affinity_prefix])
-        return hashlib.sha256(head.encode()).hexdigest()
+        return affinity_key(tokens, self.affinity_prefix)
 
     @staticmethod
     def _rec_role(rec: ServiceRecord) -> str:
@@ -191,8 +219,9 @@ class ServingRouter:
             loads = {r.path: self._outstanding.get(r.path, 0)
                      for r in cands}
         if affinity is not None:
-            target = max(cands, key=lambda r: hashlib.sha256(
-                f"{affinity}|{r.path}".encode()).digest())
+            owner = rendezvous_owner(affinity,
+                                     [r.path for r in cands])
+            target = next(r for r in cands if r.path == owner)
             if loads[target.path] - min(loads.values()) <= \
                     self.affinity_max_imbalance:
                 self.affinity_routed += 1
@@ -327,6 +356,7 @@ class ServingRouter:
                     prefer_dfs: bool = False):
         retries = failovers = 0
         exclude: set = set()
+        shed_floor = 0.0      # max Retry-After seen from 429 sheds
         while True:
             try:
                 rec = self._pick(exclude, affinity, role=role,
@@ -337,7 +367,13 @@ class ServingRouter:
                 if action.action == RetryAction.FAIL:
                     raise
                 retries += 1
-                time.sleep(max(action.delay_s, 0.05))
+                # every candidate failed or shed this round: honor the
+                # strongest Retry-After the doors pushed back with
+                # (capped — a misconfigured door must not park the
+                # client) before re-opening the whole candidate set
+                time.sleep(max(action.delay_s, 0.05,
+                               min(shed_floor, 5.0)))
+                shed_floor = 0.0
                 exclude.clear()
                 continue
             with self._lock:
@@ -347,6 +383,8 @@ class ServingRouter:
                 return fn(rec)
             except (ConnectionError, OSError, RetriableError) as e:
                 exclude.add(rec.path)
+                shed_floor = max(shed_floor,
+                                 getattr(e, "retry_after_s", 0.0))
                 action = self.policy.should_retry(e, retries, failovers,
                                                   True)
                 log.debug("replica %s failed (%s); %s", rec.path, e,
@@ -384,6 +422,23 @@ class ServingRouter:
             if resp.status == 503:
                 # replica started draining between registry refreshes
                 raise RetriableError(f"replica {rec.path} draining")
+            if resp.status == 429:
+                # QoS shed: THIS replica is over its overload line for
+                # this tenant, but another replica may have headroom —
+                # retriable-on-another-replica, unlike 408 (below via
+                # the 4xx arm), where the generation is already running
+                # here and a replay would amplify load exactly when the
+                # fleet is slow. Retry-After rides along as a delay
+                # floor for when every replica is shedding.
+                body = resp.read().decode(errors="replace")
+                err = RetriableError(
+                    f"replica {rec.path} shedding: {body}")
+                try:
+                    err.retry_after_s = float(
+                        resp.getheader("Retry-After") or 0.0)
+                except ValueError:
+                    err.retry_after_s = 0.0
+                raise err
             if 400 <= resp.status < 500:
                 # deterministic rejection (bad request, auth): the same
                 # request fails everywhere — no retry

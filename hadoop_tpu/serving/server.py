@@ -21,6 +21,12 @@ line — so a client renders tokens as they decode.
 
 ``/v1/health`` stays outside the filter (liveness probes and the router
 must not need credentials — parity with every daemon's ``/health``).
+
+Two control-plane additions ride the same chassis: ``POST
+/v1/admin/drain`` (the autoscaler's retirement knock — async graceful
+drain, 202 immediately) and, when a ``QoSGate`` is wired, per-tenant
+fairness in front of engine admission with ``429 + Retry-After``
+shedding for over-share tenants under overload (``serving/qos.py``).
 """
 
 from __future__ import annotations
@@ -49,26 +55,45 @@ class ServingServer:
 
     def __init__(self, engine: DecodeEngine,
                  conf: Optional[Configuration] = None,
-                 bind: Tuple[str, int] = ("127.0.0.1", 0)):
+                 bind: Tuple[str, int] = ("127.0.0.1", 0),
+                 qos=None, drain_cb=None):
         self.engine = engine
         self.conf = conf or Configuration()
         self.http = HttpServer(self.conf, bind, daemon_name="serving")
         self.tracer = global_tracer()
         self._draining = threading.Event()
+        # set when drain() has fully FINISHED (in-flight requests
+        # delivered AND the cache persist flushed) — _draining only
+        # marks the start. /v1/health exposes it so a controller never
+        # retires a replica that is still persisting
+        self._drain_done = threading.Event()
         self.max_new_cap = self.conf.get_int(MAX_NEW_CAP_KEY, 1024)
+        # door QoS (serving/qos.py): per-tenant decay-cost accounting +
+        # load shedding in front of engine admission. None = open door
+        # (bare servers in tests; ServingReplica wires the gate).
+        self.qos = qos
+        # autoscaler hook: /v1/admin/drain invokes this (async) so a
+        # controller can retire THIS replica — the replica process
+        # wires its own full drain-and-exit here
+        self.drain_cb = drain_cb
+        self._drain_lock = threading.Lock()
+        self._drain_started = False     # guarded-by: _drain_lock
         secret = self.conf.get(SECRET_KEY, "")
         handler = self._generate
+        admin_drain = self._admin_drain
         if secret:
             filt = AuthFilter(
                 secret.encode(),
                 allow_anonymous=self.conf.get_bool(ANON_KEY, False))
             handler = filt.wrap(handler)
+            admin_drain = filt.wrap(admin_drain)
         prefill_handler = self._prefill
         if secret:
             prefill_handler = filt.wrap(prefill_handler)
         self.http.add_handler("/v1/generate", handler)
         self.http.add_handler("/v1/prefill", prefill_handler)
         self.http.add_handler("/v1/health", self._health)
+        self.http.add_handler("/v1/admin/drain", admin_drain)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -88,18 +113,22 @@ class ServingServer:
         what it holds."""
         self._draining.set()
         self.engine.stop(drain=True, timeout=timeout)
+        self._drain_done.set()
 
     def stop(self) -> None:
         if not self._draining.is_set():
             self.engine.stop()
+        if self.qos is not None:
+            self.qos.stop()
         self.http.stop()
 
     # ------------------------------------------------------------- handlers
 
     def _health(self, query: Dict, body) -> Tuple[int, Dict]:
         eng = self.engine
-        return 200, {
+        out = {
             "status": "draining" if self._draining.is_set() else "serving",
+            "drain_complete": self._drain_done.is_set(),
             "queue_depth": eng.queue_depth,
             "active": eng.num_active,
             "slots": eng.max_batch,
@@ -107,10 +136,38 @@ class ServingServer:
             "kv_blocks_total": eng.pool.num_usable,
             "tokens_generated": eng.tokens_generated,
             "prefilling": eng.num_prefilling,
+            # the autoscaler's per-replica load signals ride here (the
+            # /prom exposition is process-wide, so an in-process fleet
+            # can only tell replicas apart through this door)
+            "prefill_backlog": eng.prefill_backlog,
             # prefix-reuse cache + chunked-prefill observability: the
             # router and ops dashboards read hit_rate/cached_blocks here
             "prefix_cache": eng.cache_stats(),
         }
+        if self.qos is not None:
+            out["qos"] = self.qos.stats()
+        return 200, out
+
+    def _admin_drain(self, query: Dict, body) -> Tuple[int, Dict]:
+        """Autoscaler-initiated retirement: refuse new work, persist
+        hot prefixes to the DFS tier, finish in-flight generations —
+        asynchronously, so the controller gets its 202 immediately and
+        watches /v1/health (then the registry record vanishing) for
+        completion. Idempotent: a second POST during an active drain
+        just reports it."""
+        if query.get("__method__") != "POST":
+            return 200, {"draining": self._draining.is_set()}
+        # atomic check-and-set: two racing POSTs (controller retry vs
+        # operator) must start exactly ONE drain thread — _draining is
+        # only set later inside that thread, so it can't be the guard
+        with self._drain_lock:
+            already = self._drain_started
+            self._drain_started = True
+        if not already:
+            cb = self.drain_cb or self.drain
+            threading.Thread(target=cb, name="admin-drain",
+                             daemon=True).start()
+        return 202, {"draining": True, "already_draining": already}
 
     def _prefill(self, query: Dict, body):
         """The prefill half of prefill/decode disaggregation: prefill
@@ -176,6 +233,27 @@ class ServingServer:
             return 400, {"RemoteException": {
                 "exception": "IllegalArgumentException",
                 "message": f"bad generate request: {e}"}}
+        # the tenant is the authenticated principal (the auth filter's
+        # __user__), falling back to the unauthenticated ?user.name=
+        # claim — QoS fairness, unlike authz, is useful even on an
+        # open door
+        tenant = query.get("__user__") or query.get("user.name") or ""
+        if self.qos is not None:
+            ok, retry_after, level = self.qos.admit(
+                tenant, self.qos.cost_of(tokens,
+                                         sampling.max_new_tokens))
+            if not ok:
+                # the router treats 429 + Retry-After as
+                # retriable-on-another-replica; a direct caller backs
+                # off — either way this replica sheds the over-share
+                # tenant before light tenants feel the overload
+                return (429,
+                        {"RemoteException": {
+                            "exception": "ServerTooBusyException",
+                            "message": f"tenant {tenant or 'anonymous'} "
+                                       f"over fair share (priority "
+                                       f"{level}) under overload"}},
+                        {"Retry-After": f"{retry_after:g}"})
         # resume the ROUTER's trace from the X-Htpu-Trace header (the
         # HTTP twin of the RPC header's SpanContext): the door, engine
         # admit, and first token all join the request's one trace
@@ -187,7 +265,8 @@ class ServingServer:
             # the door span's context rides the request into the engine
             # so admit/preempt/first-token spans join this trace
             handle = self.engine.submit(tokens, sampling,
-                                        trace_ctx=span.context())
+                                        trace_ctx=span.context(),
+                                        tenant=tenant)
         except ValueError as e:
             span.finish()
             return 400, {"RemoteException": {

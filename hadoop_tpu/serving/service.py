@@ -66,6 +66,25 @@ def serving_service_spec(name: str, *, checkpoint: str, preset: str,
     ])
 
 
+def autoscaler_service_spec(name: str, *, registry_addr: str,
+                            service: str,
+                            resource: Optional[Resource] = None,
+                            extra_args: Optional[List[str]] = None,
+                            ) -> ServiceSpec:
+    """The SLO controller as its own YARN long-running service, placed
+    next to the replica fleet it scales (one instance; the RM restarts
+    it like any daemon — the controller is stateless, its hysteresis
+    counters rebuild within a few polls)."""
+    cmd = [sys.executable, "-m", "hadoop_tpu.serving.autoscale",
+           "--registry", registry_addr, "--service", service]
+    cmd += list(extra_args or [])
+    return ServiceSpec(name, [
+        Component("autoscaler", 1, cmd,
+                  resource=resource or Resource(256, 1),
+                  restart_policy=RESTART_ALWAYS),
+    ])
+
+
 class ServingReplica:
     """Engine + HTTP server + registry lease, wired for one process."""
 
@@ -111,6 +130,19 @@ class ServingReplica:
             raise ValueError("a prefill-role replica needs the DFS KV "
                              "tier (serving.kv.dfs.enable)")
         self.kv_dfs_enabled = kv_dfs
+        metrics = ServingMetrics()
+        # door QoS (serving/qos.py): the decay scheduler + the fair
+        # admission queue must exist BEFORE the engine (the queue is
+        # the engine's pending queue) and the gate after it (the shed
+        # decision reads live queue depth)
+        self.qos_enabled = conf.get_bool("serving.qos.enabled", True)
+        qos_queue = qos_sched = None
+        if self.qos_enabled:
+            from hadoop_tpu.serving.qos import (DecayCostScheduler,
+                                                FairAdmissionQueue)
+            qos_sched = DecayCostScheduler(
+                conf.get_int("serving.qos.levels", 4), conf)
+            qos_queue = FairAdmissionQueue(qos_sched)
         self.engine = DecodeEngine(
             params, cfg,
             max_batch=conf.get_int("serving.max.batch", 4),
@@ -130,8 +162,23 @@ class ServingReplica:
             # step (0 = off; exact sampling either way)
             speculate_k=conf.get_int("serving.speculate.k", 0),
             speculate_ngram=conf.get_int("serving.speculate.ngram", 3),
-            metrics=ServingMetrics())
-        self.server = ServingServer(self.engine, conf, bind=bind)
+            admission_queue=qos_queue,
+            # drain-aware scale-in: ship resident cached prefixes to
+            # the DFS tier before this replica exits
+            drain_persist=conf.get_bool("serving.kv.drain.persist",
+                                        True),
+            metrics=metrics)
+        qos_gate = None
+        if self.qos_enabled:
+            from hadoop_tpu.serving.qos import QoSGate
+            qos_gate = QoSGate(conf, self.engine, metrics=metrics,
+                               scheduler=qos_sched)
+        self.server = ServingServer(self.engine, conf, bind=bind,
+                                    qos=qos_gate,
+                                    # the autoscaler's /v1/admin/drain
+                                    # retires the WHOLE replica, not
+                                    # just the door
+                                    drain_cb=self.drain_and_stop)
         # advertise a reachable address: the bind host when concrete, the
         # hostname when bound to the wildcard (cross-host routing must
         # not resolve to some other machine's loopback)
@@ -140,14 +187,23 @@ class ServingReplica:
         self.reg = None
         self._registry_addr = registry_addr
         self._stopped = threading.Event()
+        self._drain_lock = threading.Lock()
+        # set when drain_and_stop has fully FINISHED (persist included)
+        # — _stopped only means it began. The process main loop exits
+        # on this one: leaving on _stopped would kill the daemon
+        # drain thread mid-persist and strand half-written KV blocks
+        self.drained = threading.Event()
 
     def start(self) -> None:
         self.engine.start()
         self.server.start()
         if self._registry_addr:
-            from hadoop_tpu.registry.registry import (RegistryClient,
-                                                      ServiceRecord)
+            from hadoop_tpu.registry.registry import (HEARTBEAT_ATTR,
+                                                      RegistryClient,
+                                                      ServiceRecord,
+                                                      record_ttl)
             self.reg = RegistryClient(self._registry_addr, self.conf)
+            self._record_ttl = record_ttl(self.conf)
             self.record = ServiceRecord(
                 replica_path(self.name, self.instance),
                 endpoints={"http":
@@ -155,9 +211,14 @@ class ServingReplica:
                 attributes={"state": "serving",
                             "slots": str(self.engine.max_batch),
                             "step": str(self.step),
+                            # liveness stamp: routers/autoscalers skip
+                            # the record once this ages past the TTL,
+                            # even before the registry sweep evicts it
+                            HEARTBEAT_ATTR: f"{time.time():.3f}",
                             # checkpoint pull latency: the fleet-level
-                            # cold-start signal (regressions here mean
-                            # slow flex-up under YARN restarts)
+                            # cold-start signal the autoscaler scales
+                            # AHEAD of (a 5-minute load means growing
+                            # 5 minutes before saturation)
                             "load_seconds": str(self.load_seconds),
                             # disaggregation + tier capacities: the
                             # router routes long prompts to role=prefill
@@ -167,33 +228,71 @@ class ServingReplica:
                             "kv_host_bytes": str(self.kv_host_bytes),
                             "kv_dfs": "1" if self.kv_dfs_enabled
                                       else "0"})
-            self.reg.register(self.record, ttl_s=self.conf.get_time_seconds(
-                "serving.registry.ttl", 10.0))
+            # the heartbeat loop below refreshes the record (stamp +
+            # live load) — it IS the renewal, so no auto_renew twin
+            self.reg.register(self.record, ttl_s=self._record_ttl,
+                              auto_renew=False)
+            from hadoop_tpu.util.misc import Daemon
+            Daemon(self._heartbeat_loop,
+                   f"replica-heartbeat-{self.instance}").start()
         log.info("serving replica %s/%s up on :%d (checkpoint step %d)",
                  self.name, self.instance, self.server.port, self.step)
 
-    def drain_and_stop(self, timeout: float = 60.0) -> None:
-        if self._stopped.is_set():
-            return
-        self._stopped.set()
-        if self.reg is not None:
-            # flip the record before unregistering so routers that hold
-            # a cached copy see 'draining' on their next refresh even if
-            # the lease outlives us briefly
-            self.record.attributes["state"] = "draining"
+    def _heartbeat_loop(self) -> None:
+        """Refresh the registry record at a third of its TTL: the stamp
+        keeps staleness checks green, the re-register keeps the lease
+        alive (and recreates the record after a registry restart), and
+        the live load attributes give the autoscaler a signal even when
+        it cannot reach the replica's own door."""
+        from hadoop_tpu.registry.registry import HEARTBEAT_ATTR
+        period = max(0.2, self._record_ttl / 3.0)
+        while not self._stopped.wait(period):
+            self.record.attributes.update({
+                HEARTBEAT_ATTR: f"{time.time():.3f}",
+                "queue_depth": str(self.engine.queue_depth),
+                "active": str(self.engine.num_active)})
             try:
-                self.reg.register(self.record, ttl_s=10.0,
+                self.reg.register(self.record, ttl_s=self._record_ttl,
                                   auto_renew=False)
-            except (RpcError, OSError) as e:  # drain must not hang on
-                log.debug("draining-state publish failed: %s", e)  # a dead registry
-        self.server.drain(timeout=timeout)
-        if self.reg is not None:
-            try:
-                self.reg.unregister(self.record.path)
             except (RpcError, OSError) as e:
-                log.debug("unregister on drain failed: %s", e)
-            self.reg.close()
-        self.server.stop()
+                # a dead registry must not kill the replica; the next
+                # beat retries and re-registration heals a restart
+                log.debug("registry heartbeat failed: %s", e)
+
+    def drain_and_stop(self, timeout: float = 60.0) -> None:
+        # atomic check-and-set: a SIGTERM racing an /v1/admin/drain
+        # must yield exactly ONE drain sequence (two concurrent
+        # engine.stop calls would race _thread=None against join)
+        with self._drain_lock:
+            mine = not self._stopped.is_set()
+            self._stopped.set()
+        if not mine:
+            # a drain is already running on another thread: wait for
+            # IT to finish rather than returning mid-persist
+            self.drained.wait(timeout)
+            return
+        try:
+            if self.reg is not None:
+                # flip the record before unregistering so routers that
+                # hold a cached copy see 'draining' on their next
+                # refresh even if the lease outlives us briefly
+                self.record.attributes["state"] = "draining"
+                try:
+                    self.reg.register(self.record, ttl_s=10.0,
+                                      auto_renew=False)
+                except (RpcError, OSError) as e:  # drain must not hang
+                    log.debug("draining-state publish failed: %s",
+                              e)                  # on a dead registry
+            self.server.drain(timeout=timeout)
+            if self.reg is not None:
+                try:
+                    self.reg.unregister(self.record.path)
+                except (RpcError, OSError) as e:
+                    log.debug("unregister on drain failed: %s", e)
+                self.reg.close()
+            self.server.stop()
+        finally:
+            self.drained.set()
 
 
 def replica_main(argv: List[str],
@@ -236,7 +335,11 @@ def replica_main(argv: List[str],
     replica.start()
     try:
         while not stop.wait(0.5):
-            pass
+            if replica.drained.is_set():
+                # an autoscaler retired us through /v1/admin/drain and
+                # the drain FINISHED (prefixes persisted, in-flight
+                # requests delivered) — exit the container cleanly
+                break
     finally:
         replica.drain_and_stop()
     return 0

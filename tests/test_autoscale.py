@@ -1,0 +1,702 @@
+"""Elastic serving fleet: autoscaler policy/signals, door QoS,
+drain-aware scale-in, registry heartbeat staleness, router 429 edges.
+
+Policy tests drive ``Autoscaler._decide`` on synthetic snapshots (the
+pure half of the control loop); the drain and scale-in tests run real
+engines + doors so the protocol is exercised end-to-end in-process —
+the subprocess/CLI variant lives in ``benchmarks/serve_bench.py
+--storm``.
+"""
+
+import json
+import math
+import http.client
+import threading
+import time
+
+import jax
+import pytest
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.models.config import get_config
+from hadoop_tpu.models.decoder import init_params
+from hadoop_tpu.serving.autoscale import (Autoscaler, FleetActuator,
+                                          histogram_p99, parse_prom)
+from hadoop_tpu.serving.autoscale.signals import (FleetSnapshot,
+                                                  ReplicaSample)
+from hadoop_tpu.serving.engine import DecodeEngine, SamplingParams
+from hadoop_tpu.serving.qos import (DecayCostScheduler,
+                                    FairAdmissionQueue, QoSGate)
+from hadoop_tpu.serving.server import ServingServer
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = get_config("tiny")
+    return init_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+def _post_json(port, path, payload, timeout=60.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                      timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(payload).encode())
+        resp = conn.getresponse()
+        body = resp.read()
+        return resp.status, (json.loads(body) if body else {}), \
+            resp.getheader("Retry-After")
+    finally:
+        conn.close()
+
+
+# ----------------------------------------------------------- signal math
+
+def test_parse_prom_and_histogram_p99():
+    text = """# HELP htpu_x_total things
+# TYPE htpu_x_total counter
+htpu_x_total{source="a"} 5
+htpu_h_bucket{source="a",le="0.01"} 50
+htpu_h_bucket{source="a",le="0.1"} 99
+htpu_h_bucket{source="a",le="+Inf"} 100
+htpu_h_count{source="a"} 100
+htpu_gauge 2.5
+garbage line that must not crash the parser
+"""
+    fams = parse_prom(text)
+    assert fams["htpu_x_total"] == [({"source": "a"}, 5.0)]
+    assert fams["htpu_gauge"] == [({}, 2.5)]
+    buckets = {float(lab["le"]) if lab["le"] != "+Inf" else math.inf: v
+               for lab, v in fams["htpu_h_bucket"]}
+    # p50 inside the first bucket, p99 exactly at the 0.1 edge, and the
+    # overflow bucket never interpolates past the last finite bound
+    assert histogram_p99(buckets, q=0.99) == pytest.approx(0.1)
+    assert histogram_p99(buckets, q=0.995) == pytest.approx(0.1)
+    assert histogram_p99(buckets, q=0.25) == pytest.approx(0.005)
+    assert histogram_p99({}) is None
+    assert histogram_p99({0.01: 0.0, math.inf: 0.0}) is None
+
+
+def _sample(path="/services/serving/s/r0", role="mixed", ok=True,
+            queue=0, active=0, slots=4, backlog=0, cached=0,
+            load_seconds=0.0):
+    return ReplicaSample(path=path, host="127.0.0.1", port=1, role=role,
+                        ok=ok, queue_depth=queue, active=active,
+                        slots=slots, prefill_backlog=backlog,
+                        cached_blocks=cached,
+                        load_seconds=load_seconds)
+
+
+def test_snapshot_pools_and_utilization():
+    snap = FleetSnapshot(at=0.0, samples=[
+        _sample("/s/d0", active=4),
+        _sample("/s/d1", active=0),
+        _sample("/s/p0", role="prefill", backlog=100),
+    ])
+    assert {s.path for s in snap.pool("decode")} == {"/s/d0", "/s/d1"}
+    assert [s.path for s in snap.pool("prefill")] == ["/s/p0"]
+    assert snap.utilization("decode") == pytest.approx(0.5)
+    assert snap.mean_prefill_backlog("prefill") == pytest.approx(100)
+    # a draining replica belongs to no pool (mid-retirement)
+    snap.samples[0].draining = True
+    assert [s.path for s in snap.pool("decode")] == ["/s/d1"]
+
+
+# ----------------------------------------------------------------- policy
+
+def _mk_scaler(**over):
+    conf = Configuration(load_defaults=False)
+    conf.set("serving.autoscale.breach.polls", "2")
+    conf.set("serving.autoscale.idle.polls", "2")
+    conf.set("serving.autoscale.cooldown", "0s")
+    conf.set("serving.autoscale.ttft.p99.slo", "1s")
+    for k, v in over.items():
+        conf.set(k, v)
+    # dead registry address: these tests drive _decide directly
+    return Autoscaler(conf, ("127.0.0.1", 1), "svc")
+
+
+def test_grow_needs_consecutive_breaches_then_cooldown():
+    sc = _mk_scaler(**{"serving.autoscale.cooldown": "60s"})
+    hot = FleetSnapshot(at=0.0, samples=[_sample(queue=9)],
+                        ttft_p99_s=5.0, ttft_samples=10)
+    assert sc._decide("decode", hot) is None          # breach 1 of 2
+    d = sc._decide("decode", hot)
+    assert d is not None and d.action == "grow" and d.target == 2
+    assert "ttft" in d.reason
+    # cooldown holds the pool even though the breach persists
+    assert sc._decide("decode", hot) is None
+    assert sc._decide("decode", hot) is None
+
+
+def test_breach_counter_resets_on_a_quiet_poll():
+    sc = _mk_scaler()
+    hot = FleetSnapshot(at=0.0, samples=[_sample(queue=9)])
+    calm = FleetSnapshot(at=0.0, samples=[_sample()])
+    assert sc._decide("decode", hot) is None
+    sc._decide("decode", calm)                        # breach resets
+    assert sc._decide("decode", hot) is None          # back to 1 of 2
+    assert sc._decide("decode", hot).action == "grow"
+
+
+def test_shed_signal_triggers_growth():
+    sc = _mk_scaler(**{"serving.autoscale.breach.polls": "1"})
+    snap = FleetSnapshot(at=0.0, samples=[_sample()], shed_delta=3)
+    d = sc._decide("decode", snap)
+    assert d.action == "grow" and "shed" in d.reason
+
+
+def test_cold_start_lead_grows_before_saturation():
+    # same 75% utilization: instant-loading replicas hold (under the
+    # 0.85 high-water mark), replicas that take 30s to come up
+    # (horizon 60s, lead cap 0.3 → effective mark 0.55) grow NOW
+    sc = _mk_scaler(**{"serving.autoscale.breach.polls": "1",
+                       "serving.autoscale.util.high": "0.85"})
+    cold_fast = FleetSnapshot(at=0.0, samples=[
+        _sample(active=3, slots=4, load_seconds=0.1) for _ in range(2)])
+    assert sc._decide("decode", cold_fast) is None
+    cold_slow = FleetSnapshot(at=0.0, samples=[
+        _sample(f"/s/r{i}", active=3, slots=4, load_seconds=30.0)
+        for i in range(2)])
+    d = sc._decide("decode", cold_slow)
+    assert d is not None and d.action == "grow"
+    assert "cold-start lead" in d.reason
+
+
+def test_scale_in_needs_idle_polls_and_picks_cheapest_victim():
+    sc = _mk_scaler()
+    quiet = FleetSnapshot(at=0.0, samples=[
+        _sample("/s/r0", active=1, cached=50),
+        _sample("/s/r1", active=0, cached=40),
+        _sample("/s/r2", active=0, cached=3),
+    ])
+    assert sc._decide("decode", quiet) is None        # idle 1 of 2
+    d = sc._decide("decode", quiet)
+    assert d is not None and d.action == "shrink" and d.target == 2
+    # least loaded, then least cache-resident: r2's drain costs least
+    assert d.victim == "/s/r2"
+
+
+def test_scale_in_never_shrinks_below_min():
+    sc = _mk_scaler(**{"serving.autoscale.min": "1",
+                       "serving.autoscale.idle.polls": "1"})
+    quiet = FleetSnapshot(at=0.0, samples=[_sample()])
+    assert sc._decide("decode", quiet) is None
+
+
+def test_pool_below_min_floor_is_restored_without_a_breach():
+    # a crashed replica whose record TTL-expired: the pool is empty and
+    # quiet — no signal ever breaches, the floor must grow it anyway
+    sc = _mk_scaler(**{"serving.autoscale.min": "2"})
+    quiet = FleetSnapshot(at=0.0, samples=[_sample()])
+    d = sc._decide("decode", quiet)
+    assert d is not None and d.action == "grow" and d.target == 2
+    assert "floor" in d.reason
+
+
+def test_scale_in_skips_pools_with_only_min_healthy_replicas():
+    # one working + one wedged replica: n=2 > min=1, but retiring the
+    # healthy one would leave a fleet of corpses
+    sc = _mk_scaler(**{"serving.autoscale.idle.polls": "1"})
+    snap = FleetSnapshot(at=0.0, samples=[
+        _sample("/s/ok"),
+        _sample("/s/wedged", ok=False),
+    ])
+    assert sc._decide("decode", snap) is None
+
+
+def test_prefill_pool_sized_independently():
+    sc = _mk_scaler(**{"serving.autoscale.breach.polls": "1",
+                       "serving.autoscale.backlog.high": "64"})
+    snap = FleetSnapshot(at=0.0, samples=[
+        _sample("/s/d0", queue=0),
+        _sample("/s/p0", role="prefill", backlog=500),
+    ])
+    d = sc._decide("prefill", snap)
+    assert d is not None and d.role == "prefill" and d.action == "grow"
+    assert sc._decide("decode", snap) is None
+    # a fleet with no prefill replicas and prefill.min=0 has no
+    # prefill pool to manage at all
+    sc2 = _mk_scaler()
+    snap2 = FleetSnapshot(at=0.0, samples=[_sample(backlog=500)])
+    assert sc2._decide("prefill", snap2) is None
+
+
+# -------------------------------------------------------------- door QoS
+
+def test_decay_cost_scheduler_levels_by_share():
+    conf = Configuration(load_defaults=False)
+    conf.set("serving.qos.decay.period", "3600s")   # no decay in-test
+    sched = DecayCostScheduler(4, conf)
+    sched.charge("heavy", 900)
+    sched.charge("light", 100)
+    assert sched.share_of("heavy") == pytest.approx(0.9)
+    assert sched.level_of("heavy") == 3               # >= 1/2 share
+    assert sched.level_of("light") == 0               # < 1/8 share
+    assert sched.num_tenants == 2
+    sched.stop()
+
+
+class _Req:
+    def __init__(self, tenant):
+        self.tenant = tenant
+
+
+def test_fair_admission_queue_wrr_and_urgent_lane():
+    class _FixedSched:
+        num_levels = 4
+
+        def level_of(self, tenant):
+            return 3 if tenant == "heavy" else 0
+
+    q = FairAdmissionQueue(_FixedSched())
+    h1, h2, h3 = _Req("heavy"), _Req("heavy"), _Req("heavy")
+    light = _Req("light")
+    for r in (h1, h2, h3, light):
+        q.append(r)
+    assert len(q) == 4
+    # peek == pop (the engine peeks, allocates, then pops)
+    assert q[0] is light                 # level 0 outranks the backlog
+    assert q.popleft() is light
+    # heavy backlog still drains (weighted RR, never starved)
+    assert q.popleft() is h1
+    # a preempted request re-queues at the absolute front, regardless
+    # of its tenant's level (preemption order is the engine's contract)
+    pre = _Req("heavy")
+    q.appendleft(pre)
+    assert q[0] is pre
+    assert q.popleft() is pre
+    assert q.popleft() is h2
+    assert q.popleft() is h3
+    assert len(q) == 0
+    with pytest.raises(IndexError):
+        q.popleft()
+
+
+def test_qos_gate_sheds_over_share_only_under_overload():
+    class _Eng:
+        queue_depth = 0
+
+    conf = Configuration(load_defaults=False)
+    conf.set("serving.qos.decay.period", "3600s")
+    conf.set("serving.qos.shed.queue.depth", "4")
+    conf.set("serving.qos.queue.max", "10")
+    eng = _Eng()
+    gate = QoSGate(conf, eng)
+    gate.sched.charge("heavy", 900)
+    gate.sched.charge("light", 100)
+    # no overload: even the heavy tenant queues
+    ok, _, _ = gate.admit("heavy", 10)
+    assert ok
+    # overload: heavy sheds with a level-scaled Retry-After, light rides
+    eng.queue_depth = 5
+    ok, retry_after, level = gate.admit("heavy", 10)
+    assert not ok and level > 0 and retry_after >= gate.retry_after_s
+    ok, _, _ = gate.admit("light", 10)
+    assert ok
+    # past the hard cap everyone sheds
+    eng.queue_depth = 10
+    ok, _, _ = gate.admit("light", 10)
+    assert not ok
+    assert gate.stats()["sheds"] == 2
+    gate.stop()
+
+
+def test_qos_single_tenant_is_never_fairness_shed():
+    class _Eng:
+        queue_depth = 100
+
+    conf = Configuration(load_defaults=False)
+    conf.set("serving.qos.decay.period", "3600s")
+    conf.set("serving.qos.shed.queue.depth", "4")
+    conf.set("serving.qos.queue.max", "1000")
+    gate = QoSGate(conf, _Eng())
+    # the only tenant owns 100% share — there is no one to be fair to
+    for _ in range(5):
+        ok, _, _ = gate.admit("solo", 50)
+        assert ok
+    gate.stop()
+
+
+def test_door_sheds_heavy_tenant_with_retry_after(tiny_model):
+    """Door-level 429: the engine is never started, so admitted
+    requests park in the queue; once the queue is past the shed line a
+    second tenant over its share gets 429 + Retry-After while the
+    light tenant is still admitted (408 on its own timeout — admitted,
+    not shed)."""
+    params, cfg = tiny_model
+    conf = Configuration(load_defaults=False)
+    conf.set("serving.qos.decay.period", "3600s")
+    conf.set("serving.qos.shed.queue.depth", "2")
+    # two tenants in the whole test: over-share means majority share
+    conf.set("serving.qos.thresholds", "0.5,0.7,0.9")
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                       max_context=32)
+    gate = QoSGate(conf, eng)
+    srv = ServingServer(eng, conf, qos=gate)
+    srv.start()
+    try:
+        results = {}
+
+        def ask(i, user):
+            results[i] = _post_json(
+                srv.port, f"/v1/generate?user.name={user}",
+                {"tokens": [1, 2], "max_new_tokens": 4,
+                 "timeout": 1.5})
+
+        # one light probe seeds the second tenant, then the heavy
+        # tenant parks requests past the shed line
+        t0 = threading.Thread(target=ask, args=("light0", "light"))
+        t0.start()
+        parked = [threading.Thread(target=ask, args=(f"h{i}", "heavy"))
+                  for i in range(3)]
+        for t in parked:
+            t.start()
+        deadline = time.monotonic() + 10
+        # once the parked queue crosses the shed line, further heavy
+        # arrivals (including some of the parked threads) shed
+        while eng.queue_depth < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert eng.queue_depth >= 2
+        status, body, retry_after = _post_json(
+            srv.port, "/v1/generate?user.name=heavy",
+            {"tokens": [1, 2], "max_new_tokens": 4})
+        assert status == 429, body
+        assert "ServerTooBusy" in str(body)
+        assert retry_after is not None and float(retry_after) > 0
+        # the light tenant is still ADMITTED under the same overload
+        status, body, _ = _post_json(
+            srv.port, "/v1/generate?user.name=light",
+            {"tokens": [1, 2], "max_new_tokens": 4, "timeout": 0.3})
+        assert status == 408, body      # parked then timed out — never
+        #                                 shed
+        for t in [t0] + parked:
+            t.join(timeout=30)
+        assert gate.stats()["sheds"] >= 1
+        assert gate.stats()["sheds_by_tenant"].get("heavy", 0) >= 1
+        assert "light" not in gate.stats()["sheds_by_tenant"]
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------- router edges (satellite 2)
+
+def test_router_429_retries_on_another_replica_408_fails_fast(
+        tiny_model):
+    from hadoop_tpu.registry import (RegistryClient, RegistryServer,
+                                     ServiceRecord)
+    from hadoop_tpu.serving.router import (ReplicaRequestError,
+                                           ServingRouter, replica_path)
+    params, cfg = tiny_model
+    conf = Configuration(load_defaults=False)
+    reg_srv = RegistryServer(conf)
+    reg_srv.init(conf)
+    reg_srv.start()
+    servers, engines = [], []
+    try:
+        # replica 0 sheds EVERYTHING (a gate stub); replica 1 serves
+        class _AlwaysShed:
+            retry_after_s = 0.05
+
+            @staticmethod
+            def cost_of(tokens, max_new):
+                return 1.0
+
+            def admit(self, tenant, cost):
+                return False, 0.05, 3
+
+            def stats(self):
+                return {}
+
+            def stop(self):
+                pass
+
+        for i in range(2):
+            eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                               max_context=32)
+            srv = ServingServer(eng, Configuration(load_defaults=False),
+                                qos=_AlwaysShed() if i == 0 else None)
+            eng.start()
+            srv.start()
+            engines.append(eng)
+            servers.append(srv)
+        reg_addr = ("127.0.0.1", reg_srv.port)
+        rc = RegistryClient(reg_addr, conf)
+        for i, srv in enumerate(servers):
+            rc.register(ServiceRecord(
+                replica_path("edges", f"r{i}"),
+                {"http": f"127.0.0.1:{srv.port}"},
+                {"state": "serving"}), ttl_s=60.0, auto_renew=False)
+        router = ServingRouter(reg_addr, "edges", conf, cache_ttl_s=0.0)
+        # every request succeeds: 429s from r0 fail over to r1
+        for _ in range(8):
+            out = router.generate({"tokens": [3, 4, 5],
+                                   "max_new_tokens": 3})
+            assert len(out["tokens"]) == 3
+        assert engines[0].tokens_generated == 0
+        assert engines[1].tokens_generated > 0
+        # 408 stays fail-fast: r1's engine is stopped so the request
+        # parks and times out — the router must NOT replay it
+        engines[1].stop()
+        rc.unregister(replica_path("edges", "r0"))
+        with pytest.raises(ReplicaRequestError) as ei:
+            router.generate({"tokens": [3, 4, 5], "max_new_tokens": 3,
+                             "timeout": 0.3})
+        assert ei.value.status == 408
+        router.close()
+        rc.close()
+    finally:
+        for srv in servers:
+            srv.stop()
+        reg_srv.stop()
+
+
+# ------------------------- registry heartbeat + staleness (satellite 1)
+
+def test_registry_ttl_evicts_dead_record_and_stale_hb_is_skipped():
+    from hadoop_tpu.registry import (HEARTBEAT_ATTR, RegistryServer,
+                                     ServiceRecord, record_is_stale)
+    from hadoop_tpu.serving.router import ServingRouter, replica_path
+    conf = Configuration(load_defaults=False)
+    conf.set("registry.sweep.interval", "0.1s")
+    reg_srv = RegistryServer(conf)
+    reg_srv.init(conf)
+    reg_srv.start()
+    try:
+        # a replica that died without deregistering: registered with a
+        # short TTL and never renewed — the sweep evicts it
+        reg_srv.put(ServiceRecord(replica_path("ttl", "dead"),
+                                  {"http": "127.0.0.1:1"},
+                                  {"state": "serving"}), ttl_s=0.3)
+        assert len(reg_srv.list("/services/serving/ttl")) == 1
+        deadline = time.monotonic() + 5
+        while reg_srv.list("/services/serving/ttl") and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert reg_srv.list("/services/serving/ttl") == []
+
+        # heartbeat staleness: the record still SITS in the registry
+        # (long lease) but its owner stopped stamping — consumers skip
+        # it instead of retrying into a corpse
+        stale = ServiceRecord(
+            replica_path("hb", "stale"), {"http": "127.0.0.1:1"},
+            {"state": "serving",
+             HEARTBEAT_ATTR: f"{time.time() - 100:.3f}"})
+        fresh = ServiceRecord(
+            replica_path("hb", "fresh"), {"http": "127.0.0.1:2"},
+            {"state": "serving", HEARTBEAT_ATTR: f"{time.time():.3f}"})
+        legacy = ServiceRecord(       # no heartbeat attr: never stale
+            replica_path("hb", "legacy"), {"http": "127.0.0.1:3"},
+            {"state": "serving"})
+        assert record_is_stale(stale, 10.0)
+        assert not record_is_stale(fresh, 10.0)
+        assert not record_is_stale(legacy, 10.0)
+        for r in (stale, fresh, legacy):
+            reg_srv.put(r, ttl_s=3600.0)
+        router = ServingRouter(("127.0.0.1", reg_srv.port), "hb", conf,
+                               cache_ttl_s=0.0)
+        live = {r.path for r in router.replicas(refresh=True)}
+        assert live == {replica_path("hb", "fresh"),
+                        replica_path("hb", "legacy")}
+        router.close()
+    finally:
+        reg_srv.stop()
+
+
+def test_replica_heartbeat_keeps_record_alive_and_fresh(tmp_path,
+                                                        tiny_model):
+    """A live replica outlives many record TTLs through its heartbeat
+    (which also refreshes live-load attributes); once it stops beating
+    — death without deregistration — the sweep evicts the record."""
+    from hadoop_tpu.fs import LocalFileSystem
+    from hadoop_tpu.parallel.checkpoint import save_checkpoint
+    from hadoop_tpu.registry import HEARTBEAT_ATTR, RegistryServer
+    from hadoop_tpu.serving.service import ServingReplica
+    params, cfg = tiny_model
+    save_checkpoint(LocalFileSystem(), f"{tmp_path}/ckpt", 2,
+                    {"params": params, "opt": {}})
+    conf = Configuration(load_defaults=False)
+    conf.set("registry.sweep.interval", "0.1s")
+    conf.set("serving.registry.record.ttl", "0.6s")
+    reg_srv = RegistryServer(conf)
+    reg_srv.init(conf)
+    reg_srv.start()
+    try:
+        replica = ServingReplica(
+            conf, name="hb-live", checkpoint=f"file://{tmp_path}/ckpt",
+            preset="tiny", registry_addr=("127.0.0.1", reg_srv.port),
+            instance="i0")
+        replica.start()
+        time.sleep(1.5)                 # two+ TTLs worth of beats
+        recs = reg_srv.list("/services/serving/hb-live")
+        assert len(recs) == 1
+        attrs = recs[0].attributes
+        assert time.time() - float(attrs[HEARTBEAT_ATTR]) < 1.0
+        assert "queue_depth" in attrs   # live load rides the beat
+        # simulate a hard death: beats stop, nothing deregisters
+        replica._stopped.set()
+        deadline = time.monotonic() + 5
+        while reg_srv.list("/services/serving/hb-live") and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert reg_srv.list("/services/serving/hb-live") == []
+        replica.server.stop()
+    finally:
+        reg_srv.stop()
+
+
+# ------------------------------------- drain protocol (satellite 3)
+
+def test_drain_persists_prefixes_completes_inflight_survivor_recovers(
+        tmp_path, tiny_model):
+    """Scale-in under an active shared-prefix workload: the victim
+    finishes every in-flight request (zero failures), force-persists
+    its resident prefixes to the DFS tier, and a fresh replica over the
+    same store serves the next shared-prefix request with
+    ``hits_dfs > 0`` instead of re-prefilling."""
+    from hadoop_tpu.fs import LocalFileSystem
+    params, cfg = tiny_model
+    fs = LocalFileSystem()
+    head = [5, 9, 2, 7, 1, 8, 3, 6]                  # 2 full blocks
+
+    def mk():
+        return DecodeEngine(params, cfg, max_batch=4, block_size=4,
+                            max_context=48, prefill_chunk=4,
+                            kv_store_fs=fs,
+                            kv_store_dir=f"{tmp_path}/kv",
+                            kv_dfs_min_refs=100)     # hotness never
+        #   crosses the threshold — only the DRAIN persists anything
+
+    eng1 = mk()
+    srv1 = ServingServer(eng1, Configuration(load_defaults=False))
+    eng1.start()
+    srv1.start()
+    results = {}
+
+    def ask(i, tail, max_new):
+        results[i] = _post_json(srv1.port, "/v1/generate",
+                                {"tokens": head + tail,
+                                 "max_new_tokens": max_new,
+                                 "timeout": 60.0})
+
+    threads = [threading.Thread(target=ask, args=(i, [10 + i], 12))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 30
+    while eng1.num_active < 1 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert eng1.num_active >= 1          # the workload is in flight
+    # the autoscaler's door-drain, mid-workload
+    status, body, _ = _post_json(srv1.port, "/v1/admin/drain", {})
+    assert status == 202 and body["draining"] is True
+    for t in threads:
+        t.join(timeout=60)
+    # every in-flight request completed — zero failures
+    for i in range(3):
+        status, body, _ = results[i]
+        assert status == 200, body
+        assert len(body["tokens"]) == 12
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        h = _post_json(srv1.port, "/v1/generate", {"tokens": [1]})[0]
+        if h == 503:
+            break
+        time.sleep(0.05)
+    assert _post_json(srv1.port, "/v1/generate", {"tokens": [1]})[0] \
+        == 503                           # drained: new work refused
+    # wait for the async drain (persist included) to finish
+    deadline = time.monotonic() + 60
+    while eng1.kvstore.stats()["dfs_persists"] == 0 and \
+            time.monotonic() < deadline:
+        time.sleep(0.05)
+    persisted = eng1.kvstore.stats()["dfs_persists"]
+    assert persisted > 0, "drain persisted nothing to the DFS tier"
+    srv1.stop()
+    # the survivor: cold HBM, same DFS store — the shared head comes
+    # back from the DataNodes, not from a re-prefill
+    eng2 = mk()
+    out = eng2.generate([head + [42]],
+                        SamplingParams(max_new_tokens=4))
+    assert len(out[0]) == 4
+    st = eng2.kvstore.stats()
+    assert st["hits_dfs"] >= 2           # both head blocks recovered
+    eng2.stop()
+
+
+# ------------------------------- autoscaler scale-in, end to end
+
+def test_autoscaler_scale_in_drains_victim_via_door(tiny_model):
+    """poll() → shrink decision → POST /v1/admin/drain on the
+    affinity-cheapest victim → watch /v1/health → retire through the
+    actuator. Runs against real doors + the real registry."""
+    from hadoop_tpu.registry import (HEARTBEAT_ATTR, RegistryClient,
+                                     RegistryServer, ServiceRecord)
+    from hadoop_tpu.serving.router import replica_path
+    params, cfg = tiny_model
+    conf = Configuration(load_defaults=False)
+    reg_srv = RegistryServer(conf)
+    reg_srv.init(conf)
+    reg_srv.start()
+    servers, engines = [], []
+    retired = []
+
+    class _Act(FleetActuator):
+        def scale_out(self, role, target):
+            raise AssertionError("quiet fleet must never grow")
+
+        def retire(self, sample, target):
+            retired.append((sample.path, target))
+
+    try:
+        for i in range(2):
+            eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                               max_context=32)
+            srv = ServingServer(eng, Configuration(load_defaults=False))
+            eng.start()
+            srv.start()
+            engines.append(eng)
+            servers.append(srv)
+        reg_addr = ("127.0.0.1", reg_srv.port)
+        rc = RegistryClient(reg_addr, conf)
+        for i, srv in enumerate(servers):
+            rc.register(ServiceRecord(
+                replica_path("shrinkme", f"r{i}"),
+                {"http": f"127.0.0.1:{srv.port}"},
+                {"state": "serving",
+                 HEARTBEAT_ATTR: f"{time.time():.3f}"}),
+                ttl_s=3600.0, auto_renew=False)
+        # seed a prefix on r0 so the victim choice (fewest cached
+        # blocks) deterministically lands on r1
+        engines[0].generate([[5, 9, 2, 7, 1, 8, 3, 6, 1]],
+                            SamplingParams(max_new_tokens=2))
+        as_conf = Configuration(load_defaults=False)
+        as_conf.set("serving.autoscale.idle.polls", "1")
+        as_conf.set("serving.autoscale.cooldown", "0s")
+        as_conf.set("serving.autoscale.drain.timeout", "30s")
+        as_conf.set("serving.registry.record.ttl", "3600s")
+        scaler = Autoscaler(as_conf, reg_addr, "shrinkme",
+                            actuator=_Act())
+        decisions = scaler.poll()
+        assert [d.action for d in decisions] == ["shrink"]
+        assert decisions[0].victim == replica_path("shrinkme", "r1")
+        deadline = time.monotonic() + 30
+        while not retired and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert retired == [(replica_path("shrinkme", "r1"), 1)]
+        # the victim is draining: refuses new work, r0 untouched
+        status, _, _ = _post_json(servers[1].port, "/v1/generate",
+                                  {"tokens": [1]})
+        assert status == 503
+        status, _, _ = _post_json(servers[0].port, "/v1/generate",
+                                  {"tokens": [1, 2],
+                                   "max_new_tokens": 2})
+        assert status == 200
+        # while a drain is pending the pool must not shrink again
+        # (the victim reads as draining, pool size 1 == min)
+        assert scaler.poll() == []
+        scaler.stop()
+        rc.close()
+    finally:
+        for srv in servers:
+            srv.stop()
+        reg_srv.stop()
