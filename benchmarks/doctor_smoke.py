@@ -1,0 +1,144 @@
+"""Fleet-doctor smoke: miniDFS + one injected-slow DataNode.
+
+The doctor's acceptance loop, end to end over real daemons:
+
+  1. a 3-DN miniDFS carries real write/read traffic (pipeline acks
+     populate every DN's per-peer tracker);
+  2. one DN gets INJECTED 250 ms pipeline-ack latencies (the detection
+     decision never reads a wall-clock measurement — the absolute
+     floor sits far above single-box noise);
+  3. the doctor polls: exactly that DN must be flagged at
+     ``/ws/v1/fleet/doctor`` within ``min-windows`` report windows,
+     the NameNode must deprioritize it in pipeline placement, and an
+     exemplar trace id lifted off a DN's ``/prom`` histogram must
+     resolve into an assembled cross-daemon trace.
+
+Contract failures are RECORDED in the returned dict (``failures``),
+not raised — run_all keeps its prior bench results either way.
+
+  python -m benchmarks.doctor_smoke
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+
+def run(quick: bool = False) -> dict:
+    import os
+    import shutil
+    import tempfile
+
+    from hadoop_tpu.conf import Configuration
+    from hadoop_tpu.obs.doctor import FleetDoctor
+    from hadoop_tpu.serving.autoscale.signals import http_get
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster, fast_conf
+    from hadoop_tpu.tracing.tracer import global_tracer
+
+    out: dict = {"failures": []}
+
+    def check(ok: bool, what: str) -> None:
+        if not ok:
+            out["failures"].append(what)
+
+    conf = fast_conf()
+    conf.set("dfs.replication", "2")
+    conf.set("dfs.client.read.shortcircuit", "false")
+    base = tempfile.mkdtemp(
+        prefix="doctor-smoke-",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None)
+    doctor = None
+    try:
+        with MiniDFSCluster(num_datanodes=3, conf=conf,
+                            base_dir=base) as cluster:
+            cluster.wait_active()
+            fs = cluster.get_filesystem()
+            n_files = 2 if quick else 4
+            for i in range(n_files):
+                fs.write_all(f"/doc{i}.bin", b"\xcd" * 100_000)
+                fs.read_all(f"/doc{i}.bin")
+            dconf = Configuration(load_defaults=False)
+            dconf.set("obs.doctor.namenode.http",
+                      f"127.0.0.1:{cluster.namenode.http.port}")
+            dconf.set("dfs.namenode.rpc-address",
+                      f"127.0.0.1:{cluster.namenode.port}")
+            dconf.set("obs.doctor.slow.floor.ms", "50")
+            doctor = FleetDoctor(dconf)
+            doctor.init(dconf)
+            doctor.start()
+            uuids = [dn.uuid for dn in cluster.datanodes]
+            sick = uuids[2]
+            # the injection: two healthy reporters each measure the
+            # sick DN ~50x slower than each other
+            for reporter in (0, 1):
+                tracker = cluster.datanodes[reporter].xceiver \
+                    .peer_tracker
+                for _ in range(16):
+                    tracker.record(sick, 0.250)
+                    tracker.record(uuids[1 - reporter], 0.005)
+            windows = 0
+            report = {}
+            for windows in range(1, 4):
+                report = doctor.poll_once()
+                if list(report["datanodes"]["flagged"]) == [sick]:
+                    break
+            flagged = sorted(report["datanodes"]["flagged"])
+            out["flagged"] = [u[:8] for u in flagged]
+            out["windows_to_flag"] = windows
+            check(flagged == [sick],
+                  f"flagged {flagged} != injected-slow [{sick}]")
+            # NN placement deprioritizes the flagged node
+            dm = cluster.namenode.fsn.bm.dn_manager
+            check(sick in dm.slow_node_uuids(),
+                  "NN never received the slow-node push")
+            picks = [t.uuid for _ in range(8)
+                     for t in dm.choose_targets(2, set())]
+            out["placements_avoiding_sick"] = picks.count(sick) == 0
+            check(sick not in picks,
+                  "placement still chooses the flagged DN")
+            # exemplar -> assembled trace
+            with global_tracer().span("doctor.smoke.read") as root:
+                fs.read_all("/doc0.bin")
+            prom = http_get("127.0.0.1",
+                            cluster.datanodes[0].http.port, "/prom",
+                            5.0).decode()
+            hexid = f"{root.trace_id:016x}"
+            has_exemplar = any(
+                m.group(1) == hexid for m in re.finditer(
+                    r'_bucket\{[^}]*\} \d+ # \{trace_id="([0-9a-f]+)"\}',
+                    prom))
+            check(has_exemplar, "traced read left no /prom exemplar")
+            assembled = json.loads(http_get(
+                "127.0.0.1", doctor.port,
+                f"/ws/v1/fleet/traces/{hexid}", 5.0))
+            names = set()
+
+            def walk(n):
+                names.add(n["name"])
+                for c in n["children"]:
+                    walk(c)
+            for r in assembled.get("tree", []):
+                walk(r)
+            out["assembled_spans"] = assembled.get("num_spans", 0)
+            check("dfs.xceiver.read_block" in names and
+                  any(n.startswith("namenode.") for n in names),
+                  f"assembled trace missing planes: {sorted(names)}")
+            out["critical_path"] = assembled.get("critical_path",
+                                                 [])[:3]
+    finally:
+        if doctor is not None:
+            doctor.stop()
+        shutil.rmtree(base, ignore_errors=True)
+    out["ok"] = not out["failures"]
+    return out
+
+
+def main() -> int:
+    result = run()
+    print(json.dumps(result, indent=2))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

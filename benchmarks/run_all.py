@@ -143,7 +143,8 @@ def main() -> None:
         # trajectory; must not discard the benches already computed
         out["overlap"] = {"error": f"{type(e).__name__}: {e}"}
     # Telemetry plane: tracing-on vs tracing-off step + DFS write/read
-    # cost, with the <5% step-overhead bound recorded in the JSON.
+    # cost, with the <5% step-overhead bound recorded in the JSON
+    # (exemplar bookkeeping now rides the on-arm — same bound).
     # Recorded-not-raised like the other smokes.
     try:
         from benchmarks import trace_overhead
@@ -151,6 +152,16 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — recorded for the
         # trajectory; must not discard the benches already computed
         out["trace_overhead"] = {"error": f"{type(e).__name__}: {e}"}
+    # Fleet doctor: miniDFS + one injected-slow DN — exactly that DN
+    # flagged within bounded report windows, NN placement deprioritizes
+    # it, and a /prom exemplar resolves to an assembled cross-daemon
+    # trace. Recorded-not-raised.
+    try:
+        from benchmarks import doctor_smoke
+        out["doctor"] = doctor_smoke.run(quick=args.quick)
+    except Exception as e:  # noqa: BLE001 — recorded for the
+        # trajectory; must not discard the benches already computed
+        out["doctor"] = {"error": f"{type(e).__name__}: {e}"}
     out["wall_seconds"] = round(time.perf_counter() - t0, 1)
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)

@@ -20,6 +20,11 @@ as pluggable checkers over a shared parsed-module project:
              a context manager nor guaranteed to ``finish()`` (incl.
              exception edges) never delivers — a silent hole in the
              trace someone will later debug from.
+``metrics/*`` exposition discipline: one /prom family registered with
+             two metric kinds (families merge across sources; the
+             conflicting type is silently dropped), and prom label
+             values not drawn from a bounded literal set (a label from
+             request/user data mints one series per distinct value).
 
 Entry points: ``hadoop-tpu lint`` and ``python -m hadoop_tpu.analysis``.
 Findings are suppressible per line with ``# lint: disable=<id>`` or via a
@@ -32,6 +37,7 @@ from hadoop_tpu.analysis.core import (Finding, Project, SourceModule,
 from hadoop_tpu.analysis.jitcheck import (JitDisciplineChecker,
                                           StepBlockingChecker)
 from hadoop_tpu.analysis.lockcheck import GuardedByChecker, LockOrderChecker
+from hadoop_tpu.analysis.metricscheck import PromFamilyChecker
 from hadoop_tpu.analysis.rpccheck import (RetryHygieneChecker,
                                           SilentSwallowChecker,
                                           TimeoutChecker)
@@ -42,7 +48,8 @@ def all_checkers():
     """The shipped checker set, fresh instances (checkers hold state)."""
     return [GuardedByChecker(), LockOrderChecker(), JitDisciplineChecker(),
             StepBlockingChecker(), TimeoutChecker(), RetryHygieneChecker(),
-            SilentSwallowChecker(), SpanFinishChecker()]
+            SilentSwallowChecker(), SpanFinishChecker(),
+            PromFamilyChecker()]
 
 
 __all__ = ["Finding", "Project", "SourceModule", "run_lint",
@@ -50,4 +57,4 @@ __all__ = ["Finding", "Project", "SourceModule", "run_lint",
            "LockOrderChecker", "JitDisciplineChecker",
            "StepBlockingChecker", "TimeoutChecker",
            "RetryHygieneChecker", "SilentSwallowChecker",
-           "SpanFinishChecker"]
+           "SpanFinishChecker", "PromFamilyChecker"]

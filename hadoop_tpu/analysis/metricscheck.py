@@ -1,0 +1,179 @@
+"""Metrics exposition discipline — the ``/prom`` plane's two footguns.
+
+``metrics/duplicate-family`` — the same Prometheus family name
+registered with two different metric kinds anywhere in the project.
+``/prom`` merges same-named families across every source (per-port
+xceiver registries, per-server rpc registries) into one TYPE'd group;
+a ``counter`` named ``x`` in one module and a ``gauge`` named ``x`` in
+another silently drops whichever registers second (prom.py skips
+type-conflicting families), so the dashboard reading ``htpu_x`` sees
+half the fleet. Caught statically at the second registration site.
+
+``metrics/unbounded-label`` — a ``prom_labels`` value that is not
+provably drawn from a bounded literal set. Prometheus label values
+create one series each; a label derived from request or user data
+(a path, a tenant name, an f-string with a port in it) is a cardinality
+bomb that OOMs the scraper a week later. Allowed: constants, and names
+bound by a ``for``/comprehension iterating a literal tuple/list/set of
+constants (the ``{"tier": tier} for tier in ("host", "dfs")`` idiom).
+Everything else — parameters, attributes, calls, f-strings — flags.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from hadoop_tpu.analysis.core import (Checker, Finding, Project,
+                                      SourceModule)
+
+# metric-factory method name -> the (prom kind, family name) pairs it
+# mints (mirrors metrics/prom.py's rendering exactly — a rate becomes
+# one counter family and one gauge family)
+_FACTORIES = {
+    "counter": (("counter", "{n}_total"),),
+    "gauge": (("gauge", "{n}"),),
+    "register_callback_gauge": (("gauge", "{n}"),),
+    "rate": (("counter", "{n}_num_ops_total"), ("gauge", "{n}_avg_time")),
+    "quantiles": (("summary", "{n}"),),
+    "histogram": (("histogram", "{n}"),),
+}
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _literal_iterable_names(func: ast.AST) -> Set[str]:
+    """Names bound (anywhere in ``func``) by a for-loop or comprehension
+    whose iterable is a literal container of constants — bounded by
+    construction."""
+    bounded: Set[str] = set()
+
+    def literal(it: ast.AST) -> bool:
+        return isinstance(it, (ast.Tuple, ast.List, ast.Set)) and \
+            all(isinstance(e, ast.Constant) for e in it.elts)
+
+    def bind(target: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            bounded.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                bind(e)
+
+    for node in ast.walk(func):
+        if isinstance(node, ast.For) and literal(node.iter):
+            bind(node.target)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in node.generators:
+                if literal(gen.iter):
+                    bind(gen.target)
+    return bounded
+
+
+class PromFamilyChecker(Checker):
+    name = "metrics-prom"
+    ids = ("metrics/duplicate-family", "metrics/unbounded-label")
+
+    def __init__(self):
+        # family -> (kind, module rel path, line) of first registration
+        self._families: Dict[str, Tuple[str, str, int]] = {}
+        self._findings: List[Finding] = []
+
+    def check_module(self, mod: SourceModule) -> List[Finding]:
+        findings: List[Finding] = []
+        # enclosing-function context for bounded-name resolution
+        funcs = [n for n in ast.walk(mod.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Module))]
+        bounded_by_func = {id(f): _literal_iterable_names(f)
+                           for f in funcs}
+        # map every call to its nearest enclosing function
+        parents: Dict[int, ast.AST] = {}
+        for f in funcs:
+            for node in ast.walk(f):
+                if isinstance(node, ast.Call):
+                    # nearest wins: later (inner) functions overwrite
+                    parents[id(node)] = f
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute):
+                continue
+            factory = _FACTORIES.get(node.func.attr)
+            if factory is None:
+                continue
+            raw_name = _const_str(node.args[0]) if node.args else None
+            prom_name = None
+            labels_node = None
+            for kw in node.keywords:
+                if kw.arg == "prom_name":
+                    prom_name = _const_str(kw.value)
+                elif kw.arg == "prom_labels":
+                    labels_node = kw.value
+            base = prom_name if (node.func.attr == "histogram" and
+                                 prom_name is not None) else raw_name
+            if base is not None:
+                for kind, form in factory:
+                    self._note_family(mod, node, kind,
+                                      form.format(n=base))
+            if labels_node is not None:
+                bounded = bounded_by_func.get(
+                    id(parents.get(id(node))), set())
+                self._check_labels(mod, node, labels_node, bounded,
+                                   findings)
+        return findings
+
+    # ------------------------------------------------------------ families
+
+    def _note_family(self, mod: SourceModule, node: ast.Call, kind: str,
+                     family: str) -> None:
+        prior = self._families.get(family)
+        if prior is None:
+            self._families[family] = (kind, mod.rel, node.lineno)
+            return
+        p_kind, p_mod, p_line = prior
+        if p_kind != kind:
+            f = mod.finding(
+                node, "metrics/duplicate-family",
+                f"/prom family '{family}' registered as {kind} here but "
+                f"as {p_kind} at {p_mod}:{p_line} — same-named families "
+                f"merge across sources and conflicting types are "
+                f"silently dropped")
+            if f is not None:
+                self._findings.append(f)
+
+    def finalize(self, project: Project) -> List[Finding]:
+        out = self._findings
+        self._findings = []
+        return out
+
+    # -------------------------------------------------------------- labels
+
+    def _check_labels(self, mod: SourceModule, call: ast.Call,
+                      labels: ast.AST, bounded: Set[str],
+                      findings: List[Finding]) -> None:
+        if not isinstance(labels, ast.Dict):
+            f = mod.finding(call, "metrics/unbounded-label",
+                            "prom_labels built dynamically — label "
+                            "values must come from a bounded literal "
+                            "set (one Prometheus series per value)")
+            if f is not None:
+                findings.append(f)
+            return
+        for v in labels.values:
+            if v is None:
+                continue                       # dict-unpacking: opaque
+            if isinstance(v, ast.Constant):
+                continue
+            if isinstance(v, ast.Name) and v.id in bounded:
+                continue                       # for x in ("a", "b")
+            f = mod.finding(
+                v, "metrics/unbounded-label",
+                "prom label value is not drawn from a bounded literal "
+                "set — a label derived from request/user data mints "
+                "one series per distinct value (cardinality bomb)")
+            if f is not None:
+                findings.append(f)

@@ -13,6 +13,7 @@ src/main/bin/hadoop + hadoop-functions.sh, hdfs/yarn/mapred CLIs):
   hadoop-tpu historyserver|kms|httpfs|router|registry   more daemons
   hadoop-tpu serve --checkpoint URI --preset NAME   inference replica
   hadoop-tpu autoscale --registry H:P --service N   serving SLO controller
+  hadoop-tpu doctor --namenode-http H:P [--registry H:P]   fleet doctor
   hadoop-tpu job -submit ...               MapReduce job control
   hadoop-tpu distcp SRC DST ...            distributed copy
   hadoop-tpu streaming --mapper CMD ...    external-process jobs
@@ -206,6 +207,11 @@ def _main(argv=None) -> int:
         # conf-keyed TTFT/backlog SLOs (advise mode without --rm/--app)
         from hadoop_tpu.serving.autoscale.__main__ import autoscaler_main
         return autoscaler_main(rest, conf)
+    if cmd == "doctor":
+        # the fleet doctor: cross-daemon trace assembly + statistical
+        # slow-node detection over every daemon's /ws/v1 surfaces
+        from hadoop_tpu.obs.doctor import doctor_main
+        return doctor_main(rest, conf)
     if cmd == "job":
         # ref: mapred job -list/-status/-kill
         from hadoop_tpu.util.misc import parse_addr_list

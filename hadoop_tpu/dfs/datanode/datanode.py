@@ -100,12 +100,18 @@ class DataNode(AbstractService):
 
     def datanode_info(self) -> DatanodeInfo:
         stats = self.store.stats()
+        http = getattr(self, "http", None)
         return DatanodeInfo(self.uuid, self.host, self.xceiver.port,
                             capacity=stats["capacity"],
                             dfs_used=stats["dfs_used"],
                             remaining=stats["remaining"],
                             storage_type=self.config.get(
-                                "dfs.datanode.storage.type", "DISK"))
+                                "dfs.datanode.storage.type", "DISK"),
+                            # admin-HTTP port rides registration (ref:
+                            # DatanodeID.infoPort) so the NN's
+                            # /ws/v1/datanodes roster can point the
+                            # fleet doctor at this node's /ws/v1/peers
+                            info_port=http.port if http else 0)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -204,6 +210,13 @@ class DataNode(AbstractService):
                 "/blockstats", lambda q, b: (200, self.store.stats()))
             self.http.add_handler(
                 "/diskbalancer", self._diskbalancer_endpoint)
+            # rolling per-peer pipeline latencies + own service times —
+            # what the fleet doctor's slow-node detection scrapes
+            self.http.add_handler(
+                "/ws/v1/peers",
+                lambda q, b: (200,
+                              self.xceiver.peer_tracker.to_report(
+                                  self.uuid)))
             self.http.start()
         for addr in self.nn_addrs:
             actor = _BPServiceActor(self, addr)

@@ -87,6 +87,11 @@ class DataXceiverServer:
             "read_block_seconds", "whole READ_BLOCK op wall time")
         self._m_write_hist = reg.histogram(
             "write_block_seconds", "whole WRITE_BLOCK op wall time")
+        # slow-node evidence (ref: DataNodePeerMetrics): rolling
+        # per-downstream-peer pipeline ack latency + windowed own
+        # service times, published at /ws/v1/peers for the fleet doctor
+        from hadoop_tpu.obs.peers import PeerLatencyTracker
+        self.peer_tracker = PeerLatencyTracker()
         self._tracer = global_tracer()
 
     def _fi(self):
@@ -198,10 +203,13 @@ class DataXceiverServer:
                         dt.send_frame(sock, {"ok": False,
                                              "em": f"bad op {op!r}"})
                 finally:
+                    elapsed = time.monotonic() - t0
                     if op == dt.OP_WRITE_BLOCK:
-                        self._m_write_hist.add(time.monotonic() - t0)
+                        self._m_write_hist.add(elapsed)
+                        self.peer_tracker.record_self_write(elapsed)
                     elif op == dt.OP_READ_BLOCK:
-                        self._m_read_hist.add(time.monotonic() - t0)
+                        self._m_read_hist.add(elapsed)
+                        self.peer_tracker.record_self_read(elapsed)
         except (OSError, EOFError) as e:
             log.debug("xceiver connection error: %s", e)
         except Exception:
@@ -273,14 +281,25 @@ class DataXceiverServer:
         # Terminal node acks directly. Ref: BlockReceiver.PacketResponder.
         ack_lock = threading.Lock()
         my_status: dict = {}
+        sent_at: dict = {}       # seq -> forward time; guarded-by: ack_lock
+        down_uuid = targets[0].uuid if targets else ""
         responder_done = threading.Event()
 
         def responder():
             try:
                 while True:
                     ack = dt.recv_frame(down)
+                    now = time.monotonic()
                     with ack_lock:
                         st = my_status.pop(ack["seq"], dt.STATUS_SUCCESS)
+                        fwd_t = sent_at.pop(ack["seq"], None)
+                    if fwd_t is not None:
+                        # forward + downstream ack round trip for THIS
+                        # peer: the per-peer signal the doctor's
+                        # median/MAD pass runs across (ref: the
+                        # SendPacketDownstream timing SlowPeerTracker
+                        # aggregates)
+                        self.peer_tracker.record(down_uuid, now - fwd_t)
                     dt.send_frame(up, {"seq": ack["seq"],
                                        "statuses": [st] + ack["statuses"],
                                        "last": ack.get("last", False)})
@@ -334,6 +353,7 @@ class DataXceiverServer:
                 if down is not None:
                     with ack_lock:
                         my_status[pkt["seq"]] = status
+                        sent_at[pkt["seq"]] = time.monotonic()
                     # two sends, zero copies: the old prefix+payload
                     # concatenation copied the whole packet per hop
                     down.sendall(_struct.pack(">I", len(raw)))

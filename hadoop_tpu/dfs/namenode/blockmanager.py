@@ -62,7 +62,8 @@ class DatanodeDescriptor(DatanodeInfo):
     def public_info(self) -> DatanodeInfo:
         info = DatanodeInfo(self.uuid, self.host, self.xfer_port,
                             self.ipc_port, self.capacity, self.dfs_used,
-                            self.remaining, self.storage_type)
+                            self.remaining, self.storage_type,
+                            info_port=self.info_port)
         info.state = self.state
         info.num_blocks = len(self.blocks)
         return info
@@ -137,6 +138,13 @@ class DatanodeManager:
             "dfs.namenode.heartbeat.recheck-interval", 10.0) * 2 \
             + 10 * self.heartbeat_interval_s
         self._nodes: Dict[str, DatanodeDescriptor] = {}  # guarded-by: _lock
+        # uuid -> monotonic expiry: DNs the fleet doctor flagged as
+        # statistical outliers (report_slow_peers). Placement treats
+        # them as last-resort targets until the TTL lapses — a doctor
+        # outage fails OPEN (flags decay, placement heals itself).
+        # Ref: SlowPeerTracker feeding BlockPlacementPolicyDefault's
+        # excludeSlowNodesEnabled path.
+        self._slow_nodes: Dict[str, float] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         # Locality tree (ref: DatanodeManager's NetworkTopology + the
         # dnsToSwitchMapping resolver chain)
@@ -158,6 +166,7 @@ class DatanodeManager:
             node.host = info.host
             node.xfer_port = info.xfer_port
             node.ipc_port = info.ipc_port
+            node.info_port = info.info_port
             node.storage_type = info.storage_type
             # Re-registration revives a DEAD node but must NOT cancel an
             # operator-set admin state — rebooting a DN is exactly what
@@ -310,6 +319,27 @@ class DatanodeManager:
                     continue
             log.info("Node %s is now %s", node, node.state)
 
+    # ------------------------------------------------------------ slow nodes
+
+    def set_slow_nodes(self, uuids: List[str], ttl_s: float) -> None:
+        """Replace-and-arm: the doctor's CURRENT flagged set, each entry
+        expiring after ``ttl_s``. A node the doctor stopped flagging is
+        cleared immediately (the push is a full report, not a delta)."""
+        deadline = time.monotonic() + max(0.0, ttl_s)
+        with self._lock:
+            self._slow_nodes = {u: deadline for u in uuids}
+        if uuids:
+            log.info("placement deprioritizing slow datanodes: %s",
+                     [u[:8] for u in uuids])
+
+    def slow_node_uuids(self) -> Set[str]:
+        now = time.monotonic()
+        with self._lock:
+            expired = [u for u, t in self._slow_nodes.items() if t < now]
+            for u in expired:
+                del self._slow_nodes[u]
+            return set(self._slow_nodes)
+
     # ------------------------------------------------------------ placement
 
     def choose_targets(self, n: int, exclude: Set[str],
@@ -338,8 +368,15 @@ class DatanodeManager:
         if not candidates:
             return []
         chosen: List[DatanodeDescriptor] = []
+        # doctor-flagged nodes are LAST-RESORT targets: every pick
+        # prefers the healthy subset of its pool and falls back to the
+        # whole pool only when the constraint can't otherwise be met —
+        # a mostly-flagged cluster still places n replicas.
+        slow = self.slow_node_uuids()
 
         def pick_from(pool: List[DatanodeDescriptor]) -> None:
+            healthy = [c for c in pool if c.uuid not in slow]
+            pool = healthy or pool
             a = random.choice(pool)
             b = random.choice(pool)
             pick = a if a.xceiver_count <= b.xceiver_count else b
@@ -349,6 +386,7 @@ class DatanodeManager:
         # replica 1: writer-local when possible (short-circuit win)
         if writer_host is not None:
             local = [c for c in candidates if c.host == writer_host]
+            local = [c for c in local if c.uuid not in slow] or local
             if local:
                 pick = min(local, key=lambda c: c.xceiver_count)
                 chosen.append(pick)

@@ -49,24 +49,49 @@ log = logging.getLogger(__name__)
 # The audit trail (ref: FSNamesystem.java:392 logAuditEvent + the
 # "FSNamesystem.audit" logger convention): one line per namespace op with
 # the caller's identity and address from the RPC CallContext. Operators
-# attach handlers/sinks to THIS logger name.
+# attach handlers/sinks to THIS logger name — it rotates/routes with
+# whatever logging config the deployment already runs, and the
+# dynamometer replays it (tools/dynamometer.parse_audit_line tolerates
+# the extra fields). ``status`` distinguishes ok from failed(Type) —
+# failure lines come from the RPC-facade auditor
+# (dfs/namenode/audit.py), success lines from these call sites — and
+# ``trace_id`` joins each line to the telemetry plane: grep the audit
+# log, assemble the trace at the fleet doctor.
 audit_log = logging.getLogger("hadoop_tpu.audit")
+
+AUDIT_ENABLE_KEY = "namenode.audit.enable"
+
+# conf-keyed master switch (namenode.audit.enable, default on —
+# the seed always logged); FSNamesystem.__init__ resolves it
+_audit_enabled = True
+
+
+def set_audit_enabled(enabled: bool) -> None:
+    global _audit_enabled
+    _audit_enabled = bool(enabled)
 
 
 def log_audit_event(allowed: bool, cmd: str, src: str,
-                    dst: Optional[str] = None) -> None:
+                    dst: Optional[str] = None,
+                    status: str = "ok") -> None:
     """Ref: FSNamesystem.logAuditEvent — ugi/ip/cmd/src/dst(+CallerContext
     = the RPC client id, its role here)."""
-    if not audit_log.isEnabledFor(logging.INFO):
+    if not _audit_enabled or not audit_log.isEnabledFor(logging.INFO):
         return
     from hadoop_tpu.ipc.server import current_call
+    from hadoop_tpu.tracing.tracer import current_span
     call = current_call()
     ugi = call.user.user_name if call else current_user().user_name
     ip = call.address if call else "local"
     ctx = call.client_id.hex()[:16] if call and call.client_id else "-"
+    sp = current_span()
+    trace = f"{sp.trace_id:016x}" if sp is not None and sp.sampled \
+        else "-"
     audit_log.info(
-        "allowed=%s\tugi=%s\tip=%s\tcmd=%s\tsrc=%s\tdst=%s\tcallerContext=%s",
-        str(allowed).lower(), ugi, ip, cmd, src, dst or "null", ctx)
+        "allowed=%s\tugi=%s\tip=%s\tcmd=%s\tsrc=%s\tdst=%s"
+        "\tcallerContext=%s\tstatus=%s\ttrace_id=%s",
+        str(allowed).lower(), ugi, ip, cmd, src, dst or "null", ctx,
+        status, trace)
 
 
 # Ref: BlockStoragePolicySuite — policy ids the mover acts on. On a
@@ -95,6 +120,10 @@ class FSNamesystem:
         self._perm_enabled = conf.get_bool("dfs.permissions.enabled",
                                            True)
         self._superuser = current_user().user_name
+        # audit-plane master switch (process-global like the logger
+        # itself; the last namesystem to init in a shared-process
+        # minicluster wins, which is fine — one conf lineage)
+        set_audit_enabled(conf.get_bool(AUDIT_ENABLE_KEY, True))
         self._supergroup = conf.get("dfs.permissions.superusergroup",
                                     "supergroup")
         # Server-side group resolution — NEVER the client-asserted UGI

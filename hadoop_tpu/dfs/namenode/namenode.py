@@ -553,6 +553,17 @@ class DatanodeProtocol:
             self.fsn.bm.mark_corrupt(Block.from_wire(b), uuid)
         return True
 
+    @idempotent
+    def report_slow_peers(self, uuids: List[str],
+                          ttl_s: float = 60.0) -> bool:
+        """The fleet doctor's slow-node report (ref: the slowPeers leg
+        of DatanodeProtocol.sendHeartbeat feeding SlowPeerTracker —
+        here the doctor aggregates and pushes the verdict): pipeline
+        placement deprioritizes these uuids until the TTL lapses."""
+        self.fsn.bm.dn_manager.set_slow_nodes(
+            [str(u) for u in uuids], float(ttl_s))
+        return True
+
     def next_generation_stamp(self) -> int:
         return self.fsn.next_gen_stamp()
 
@@ -655,6 +666,13 @@ class NameNode(AbstractService):
         bind_host = conf.get("dfs.namenode.rpc-bind-host", "127.0.0.1")
         port = conf.get_int("dfs.namenode.rpc-port", 0)
         self.retry_cache = RetryCache()
+        # default the NN's RPC scheduler to decay accounting: priority
+        # behavior is unchanged on the default FIFO queue (priorities
+        # are computed, the queue ignores them) but per-caller decayed
+        # counts exist — which is what /ws/v1/top reads instead of
+        # growing an nntop-private second counter
+        if not conf.get("dfs.namenode.scheduler.impl", ""):
+            conf.set("dfs.namenode.scheduler.impl", "decay")
         self.rpc = Server(
             conf, bind=(bind_host, port),
             num_handlers=conf.get_int("dfs.namenode.handler.count", 8),
@@ -662,10 +680,19 @@ class NameNode(AbstractService):
             state_provider=self.applied_txid,
             queue_prefix="dfs.namenode")
         state = lambda: self.ha_state  # noqa: E731
+        from hadoop_tpu.dfs.namenode.audit import maybe_audited
         self.rpc.register_protocol(
-            "ClientProtocol", ClientProtocol(self.fsn, self.retry_cache,
-                                             state),
+            "ClientProtocol",
+            maybe_audited(ClientProtocol(self.fsn, self.retry_cache,
+                                         state), conf),
             pre_call=self._client_pre_call)
+        # nntop: expose the scheduler's decayed per-caller window at
+        # every chassis' /ws/v1/top (obs/top.py)
+        from hadoop_tpu.obs.top import register_top_source
+        self._top_source = f"namenode.{self.nn_id}.rpc.callers"
+        sched = self.rpc._callq.scheduler
+        if hasattr(sched, "snapshot"):
+            register_top_source(self._top_source, sched.snapshot)
         self.rpc.register_protocol("DatanodeProtocol",
                                    DatanodeProtocol(self.fsn, state))
         self.rpc.register_protocol("HAServiceProtocol",
@@ -688,6 +715,20 @@ class NameNode(AbstractService):
                 "/fsstatus", lambda q, b: (200, status_proto.get_stats()))
             from hadoop_tpu.http.webui import nn_dfshealth_page
             self.http.add_handler("/dfshealth", nn_dfshealth_page(self))
+            # the fleet doctor's DN discovery roster: uuid/host/
+            # info_port/state plus the currently-deprioritized set
+            self.http.add_handler("/ws/v1/datanodes", self._ws_datanodes)
+
+    def _ws_datanodes(self, query, body):
+        """DN roster for the fleet doctor: every registered node with
+        the admin-HTTP ``info_port`` it advertised at registration."""
+        dm = self.fsn.bm.dn_manager
+        slow = dm.slow_node_uuids()
+        return 200, {"datanodes": [
+            {"uuid": n.uuid, "host": n.host, "xfer_port": n.xfer_port,
+             "info_port": n.info_port, "state": n.state,
+             "slow": n.uuid in slow}
+            for n in dm.all_nodes()]}
 
     def _client_pre_call(self, method: str, ctx: CallContext) -> None:
         """HA gate + observer alignment (ref: NameNodeRpcServer's
@@ -734,6 +775,9 @@ class NameNode(AbstractService):
 
     def service_stop(self) -> None:
         self._stop_event.set()
+        if getattr(self, "_top_source", None):
+            from hadoop_tpu.obs.top import unregister_top_source
+            unregister_top_source(self._top_source)
         if self.failover is not None:
             self.failover.stop()
             self.failover.lease.release()

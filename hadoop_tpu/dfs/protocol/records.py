@@ -109,24 +109,29 @@ class Block:
 class DatanodeID:
     """Identity + addresses of one block server. Ref: protocol/DatanodeID.java."""
 
-    __slots__ = ("uuid", "host", "xfer_port", "ipc_port")
+    __slots__ = ("uuid", "host", "xfer_port", "ipc_port", "info_port")
 
-    def __init__(self, uuid: str, host: str, xfer_port: int, ipc_port: int = 0):
+    def __init__(self, uuid: str, host: str, xfer_port: int, ipc_port: int = 0,
+                 info_port: int = 0):
         self.uuid = uuid
         self.host = host
         self.xfer_port = xfer_port
         self.ipc_port = ipc_port
+        # admin HTTP port (ref: DatanodeID.infoPort) — how the fleet
+        # doctor reaches /ws/v1/peers and /ws/v1/stacks on this node
+        self.info_port = info_port
 
     def xfer_addr(self) -> tuple:
         return (self.host, self.xfer_port)
 
     def to_wire(self) -> Dict:
         return {"u": self.uuid, "h": self.host, "xp": self.xfer_port,
-                "ip": self.ipc_port}
+                "ip": self.ipc_port, "inf": self.info_port}
 
     @classmethod
     def from_wire(cls, d: Dict) -> "DatanodeID":
-        return cls(d["u"], d["h"], d["xp"], d.get("ip", 0))
+        return cls(d["u"], d["h"], d["xp"], d.get("ip", 0),
+                   d.get("inf", 0))
 
     def __eq__(self, other):
         return isinstance(other, DatanodeID) and other.uuid == self.uuid
@@ -155,8 +160,9 @@ class DatanodeInfo(DatanodeID):
 
     def __init__(self, uuid: str, host: str, xfer_port: int, ipc_port: int = 0,
                  capacity: int = 0, dfs_used: int = 0, remaining: int = 0,
-                 storage_type: str = "DISK"):
-        super().__init__(uuid, host, xfer_port, ipc_port)
+                 storage_type: str = "DISK", info_port: int = 0):
+        super().__init__(uuid, host, xfer_port, ipc_port,
+                         info_port=info_port)
         self.capacity = capacity
         self.dfs_used = dfs_used
         self.remaining = remaining
@@ -179,7 +185,7 @@ class DatanodeInfo(DatanodeID):
     def from_wire(cls, d: Dict) -> "DatanodeInfo":
         info = cls(d["u"], d["h"], d["xp"], d.get("ip", 0), d.get("cap", 0),
                    d.get("used", 0), d.get("rem", 0),
-                   d.get("sty", "DISK"))
+                   d.get("sty", "DISK"), info_port=d.get("inf", 0))
         info.state = d.get("st", cls.STATE_LIVE)
         info.num_blocks = d.get("nblk", 0)
         return info

@@ -1,1 +1,19 @@
 from hadoop_tpu.http.server import HttpServer  # noqa: F401
+
+
+def http_get(host: str, port: int, path: str, timeout: float) -> bytes:
+    """One bounded GET against a daemon's admin door — every fleet
+    probe (autoscaler scrape, doctor pull) goes through here so no
+    probe can ever hang a control loop. Raises ``IOError`` on any
+    non-200."""
+    import http.client
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read()
+        if resp.status != 200:
+            raise IOError(f"{path} -> HTTP {resp.status}")
+        return body
+    finally:
+        conn.close()

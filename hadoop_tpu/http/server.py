@@ -125,6 +125,11 @@ class HttpServer:
         self.add_handler("/prom", self._prom)
         self.add_handler("/ws/v1/traces", self._traces)
         self.add_handler("/ws/v1/traces/slow", self._traces_slow)
+        # machine-readable twins of /stacks and nntop: the fleet
+        # doctor's slow-node report links the former; the latter reads
+        # the process' registered decay accountings (obs/top.py)
+        self.add_handler("/ws/v1/stacks", self._ws_stacks)
+        self.add_handler("/ws/v1/top", self._ws_top)
         from hadoop_tpu.tracing.collector import span_collector
         span_collector().configure(self.conf)
 
@@ -270,16 +275,26 @@ class HttpServer:
         return 200, redacted
 
     def _prom(self, query, body):
-        """Prometheus text exposition of the live metrics system."""
+        """Prometheus text exposition of the live metrics system.
+        OpenMetrics exemplars ride the histogram buckets by default;
+        strict 0.0.4 consumers (a stock Prometheus scraper selects its
+        parser by content type and rejects the exemplar suffix) opt out
+        per-scrape with ``?exemplars=0`` or fleet-wide with
+        ``metrics.prom.exemplars=false``."""
         from hadoop_tpu.metrics.prom import render_prom
-        return 200, render_prom(metrics_system())
+        exemplars = self.conf.get_bool("metrics.prom.exemplars", True)
+        q = (query.get("exemplars") or "").strip().lower()
+        if q:
+            exemplars = q not in ("0", "false", "no")
+        return 200, render_prom(metrics_system(), exemplars=exemplars)
 
     def _traces(self, query, body):
         """Span-collector ring: ?trace_id= filters (decimal OR the hex
         form the slow-trace log line and X-Htpu-Trace header use — an
         all-digit string is tried as both), ?limit=N caps."""
         from hadoop_tpu.tracing.collector import span_collector
-        tid = (query.get("trace_id") or "").strip().lower()
+        from hadoop_tpu.tracing.tracer import parse_trace_id_candidates
+        tid = (query.get("trace_id") or "").strip()
         try:
             limit = int(query.get("limit", 0) or 0)
         except ValueError:
@@ -288,12 +303,7 @@ class HttpServer:
                 "message": f"bad limit {query.get('limit')!r}"}}
         cands = set()
         if tid:
-            raw = tid[2:] if tid.startswith("0x") else tid
-            for base in ((16,) if tid.startswith("0x") else (10, 16)):
-                try:
-                    cands.add(int(raw, base))
-                except ValueError:
-                    pass
+            cands = set(parse_trace_id_candidates(tid))
             if not cands:
                 return 400, {"RemoteException": {
                     "exception": "IllegalArgumentException",
@@ -315,3 +325,36 @@ class HttpServer:
             stack = "".join(traceback.format_stack(frame)) if frame else ""
             out.append(f'Thread "{t.name}" daemon={t.daemon}:\n{stack}')
         return 200, "\n".join(out)
+
+    def _ws_stacks(self, query, body):
+        """JSON thread dump (the /stacks text servlet, structured):
+        per thread, name + daemon flag + alive frames innermost-last —
+        what the fleet doctor's slow-node report links to, so "that
+        node is slow" resolves to "and HERE is what it's doing"."""
+        threads = []
+        frames = sys._current_frames()
+        for t in threading.enumerate():
+            frame = frames.get(t.ident)
+            stack = []
+            if frame is not None:
+                for fs in traceback.extract_stack(frame):
+                    stack.append({"file": fs.filename, "line": fs.lineno,
+                                  "func": fs.name})
+            threads.append({"name": t.name, "daemon": t.daemon,
+                            "ident": t.ident, "alive": t.is_alive(),
+                            "stack": stack})
+        return 200, {"daemon": self.daemon_name,
+                     "num_threads": len(threads), "threads": threads}
+
+    def _ws_top(self, query, body):
+        """nntop-style top-N over every decay accounting this process
+        registered (obs/top.py): NN RPC callers, serving-door tenants.
+        ``?n=`` caps the per-source list."""
+        from hadoop_tpu.obs.top import top_n
+        try:
+            n = int(query.get("n", 10) or 10)
+        except ValueError:
+            return 400, {"RemoteException": {
+                "exception": "IllegalArgumentException",
+                "message": f"bad n {query.get('n')!r}"}}
+        return 200, {"daemon": self.daemon_name, "sources": top_n(n)}

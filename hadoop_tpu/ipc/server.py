@@ -518,7 +518,13 @@ class Server:
             _current_call.reset(token)
             elapsed = time.monotonic() - t0
             self._m_processing.add(elapsed)
-            self._m_processing_hist.add(elapsed)
+            # exemplar recorded explicitly: the handler span already
+            # finished, but the caller's wire context still names the
+            # trace a slow bucket should resolve to
+            self._m_processing_hist.add(
+                elapsed,
+                exemplar_trace=span_ctx.trace_id
+                if span_ctx is not None and span_ctx.sampled else None)
             self._m_calls.incr()
             self._callq.add_response_time(conn.caller_key(), call.priority, elapsed)
 

@@ -17,6 +17,16 @@ for JMX parity. Mapping:
 The source registry name rides as a ``source`` label, so one metric
 family (say ``blocks_written``) aggregates across every per-port xceiver
 source the scraper sees.
+
+Histogram ``_bucket`` lines carry **OpenMetrics exemplars** when the
+bucket has seen a sampled trace::
+
+    htpu_x_bucket{le="0.128"} 5 # {trace_id="00ab..."} 0.093 1700000000.0
+
+— the trace id resolves through the fleet doctor's
+``/ws/v1/fleet/traces/<id>`` into a full assembled cross-daemon trace.
+Consumers that only speak the 0.0.4 text format should pass
+``exemplars=False`` (the in-tree scrapers strip the suffix instead).
 """
 
 from __future__ import annotations
@@ -63,7 +73,7 @@ def _line(name: str, labels: dict, value) -> str:
     return f"{name} {_fmt(value)}"
 
 
-def render_prom(system: MetricsSystem) -> str:
+def render_prom(system: MetricsSystem, exemplars: bool = True) -> str:
     """Render every registered source as Prometheus text exposition.
 
     Output is grouped BY FAMILY, not by source: the text format
@@ -111,10 +121,17 @@ def render_prom(system: MetricsSystem) -> str:
                 if lines is None:
                     continue
                 buckets, total, n = m.buckets()
-                for bound, cum in buckets:
+                bucket_ex = m.bucket_exemplars() if exemplars \
+                    else [None] * len(buckets)
+                for (bound, cum), ex in zip(buckets, bucket_ex):
                     le = "+Inf" if math.isinf(bound) else _fmt(bound)
-                    lines.append(_line(f"{name}_bucket",
-                                       dict(hlabels, le=le), cum))
+                    line = _line(f"{name}_bucket",
+                                 dict(hlabels, le=le), cum)
+                    if ex is not None:
+                        trace_id, value, ts = ex
+                        line += (f' # {{trace_id="{trace_id:016x}"}} '
+                                 f"{_fmt(value)} {ts:.3f}")
+                    lines.append(line)
                 lines.append(_line(f"{name}_sum", hlabels, total))
                 lines.append(_line(f"{name}_count", hlabels, n))
             elif isinstance(m, MutableQuantiles):

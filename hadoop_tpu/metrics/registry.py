@@ -18,6 +18,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from hadoop_tpu.tracing.tracer import current_span
 from hadoop_tpu.util.misc import Daemon
 
 log = logging.getLogger(__name__)
@@ -169,7 +170,16 @@ class MutableHistogram:
     so one fixed layout covers microsecond RPCs through minute-long
     checkpoint writes. This is the Prometheus-native shape (`/prom`
     renders cumulative ``_bucket{le=...}`` series); MutableQuantiles
-    stays alongside for JMX parity — same samples, two expositions."""
+    stays alongside for JMX parity — same samples, two expositions.
+
+    Every bucket also keeps one **exemplar** — the most recent *sampled*
+    trace id whose observation landed in it (OpenMetrics exemplar
+    semantics): a slow ``_bucket`` on ``/prom`` then names a concrete
+    trace the fleet doctor can assemble, instead of pointing at nothing.
+    The trace id is taken from the caller (``exemplar_trace``) or, when
+    omitted, from the active span — unsampled traces never become
+    exemplars because their spans were never delivered anywhere a
+    resolver could find them."""
 
     # 0.25 ms .. ~128 s, ×2 per bucket (20 bounds + +Inf)
     BOUNDS = tuple(0.00025 * (2 ** i) for i in range(20))
@@ -186,15 +196,26 @@ class MutableHistogram:
         self.prom_labels = dict(prom_labels) if prom_labels else {}
         self._lock = threading.Lock()
         self._counts = [0] * (len(self.BOUNDS) + 1)
+        # bucket index -> (trace_id, value, unix_ts) of the most recent
+        # sampled observation that landed there
+        self._exemplars: Dict[int, tuple] = {}  # guarded-by: _lock
         self._sum = 0.0
         self._n = 0
 
-    def add(self, v: float) -> None:
+    def add(self, v: float, exemplar_trace: Optional[int] = None) -> None:
+        if exemplar_trace is None:
+            # auto-capture: an observation made under an active sampled
+            # span adopts its trace id (one contextvar read — cheap)
+            sp = current_span()
+            if sp is not None and sp.sampled:
+                exemplar_trace = sp.trace_id
         with self._lock:
             self._n += 1
             self._sum += v
             i = bisect.bisect_left(self.BOUNDS, v)
             self._counts[i] += 1
+            if exemplar_trace is not None:
+                self._exemplars[i] = (exemplar_trace, v, time.time())
 
     def time(self):
         return _Timer(self)
@@ -211,6 +232,14 @@ class MutableHistogram:
             out.append((bound, cum))
         out.append((float("inf"), cum + counts[-1]))
         return out, total, n
+
+    def bucket_exemplars(self):
+        """Per-bucket exemplars aligned with ``buckets()`` output:
+        list of (trace_id, value, unix_ts) or None, one per bound
+        (+Inf last)."""
+        with self._lock:
+            ex = dict(self._exemplars)
+        return [ex.get(i) for i in range(len(self.BOUNDS) + 1)]
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:

@@ -1,0 +1,30 @@
+"""Fleet doctor — fleet-level observability over per-daemon telemetry.
+
+ISSUE 5 gave every daemon spans, a flight recorder, and ``/prom``; this
+package adds the layer that sees the *fleet*:
+
+- ``assemble``  — ``FleetTraceStore``: cross-daemon trace assembly with
+                  per-daemon critical-path summaries
+- ``detect``    — median/MAD outlier detection with report-window
+                  hysteresis (SlowPeerTracker semantics)
+- ``peers``     — per-peer rolling latency tracking (the DataNode hook)
+- ``top``       — nntop-style ``/ws/v1/top`` over the EXISTING decay
+                  accountings (RPC callers, serving tenants)
+- ``doctor``    — the aggregation daemon: ``/ws/v1/fleet/doctor``,
+                  ``/ws/v1/fleet/traces/<id>``, NN slow-node push,
+                  autoscaler sick-replica signal; ``hadoop-tpu doctor``
+"""
+
+from hadoop_tpu.obs.assemble import (Endpoint, FleetTraceStore,
+                                     assemble_tree)
+from hadoop_tpu.obs.detect import (SlowNodeDetector, mad_outliers,
+                                   median)
+from hadoop_tpu.obs.doctor import FleetDoctor, doctor_main
+from hadoop_tpu.obs.peers import PeerLatencyTracker
+from hadoop_tpu.obs.top import (register_top_source, top_n,
+                                unregister_top_source)
+
+__all__ = ["Endpoint", "FleetTraceStore", "assemble_tree",
+           "SlowNodeDetector", "mad_outliers", "median",
+           "FleetDoctor", "doctor_main", "PeerLatencyTracker",
+           "register_top_source", "top_n", "unregister_top_source"]

@@ -1,0 +1,455 @@
+"""The fleet doctor — registry/conf-discovered observability aggregator.
+
+One daemon closes the loop ISSUE 5 left open: per-daemon telemetry
+exists everywhere, fleet-level answers nowhere. The doctor
+
+1. **assembles traces**: pulls every daemon's ``/ws/v1/traces[/slow]``
+   on a jittered cadence into a ``FleetTraceStore`` and serves merged
+   trees at ``/ws/v1/fleet/traces/<id>`` with a per-daemon critical-path
+   split — an exemplar trace id lifted off any slow ``/prom`` bucket
+   resolves here (a miss triggers a targeted pull, so flight-recorder
+   retained traces resolve even after the rings churned);
+
+2. **detects slow nodes**: scrapes every DataNode's ``/ws/v1/peers``
+   (rolling pipeline-ack latencies per downstream peer + own service
+   times) and every replica's ``/prom`` (decode-step/TTFT windows via
+   cumulative diffs, the FleetScraper discipline), runs median/MAD
+   outlier detection across peers (SlowPeerTracker semantics, report-
+   window hysteresis), and maintains ``/ws/v1/fleet/doctor`` — each
+   flagged node linked to its ``/ws/v1/stacks`` thread dump;
+
+3. **acts**: pushes flagged DataNodes to the NameNode
+   (``DatanodeProtocol.report_slow_peers`` — pipeline placement then
+   deprioritizes them) and names sick replicas for the autoscaler's
+   scale-in victim choice.
+
+Discovery: static ``obs.doctor.endpoints``, the NameNode's
+``/ws/v1/datanodes`` roster (DN admin ports ride registration's
+``info_port``), and the serving registry for replicas + the autoscaler.
+Every probe is bounded by ``obs.doctor.scrape.timeout``; a dead daemon
+is a status row, never a wedged doctor.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.http import http_get
+from hadoop_tpu.obs.assemble import (Endpoint, FleetTraceStore,
+                                     parse_endpoint_list)
+from hadoop_tpu.obs.detect import SlowNodeDetector, median
+from hadoop_tpu.service import AbstractService
+from hadoop_tpu.util.misc import Daemon, backoff_delay
+
+log = logging.getLogger(__name__)
+
+INTERVAL_KEY = "obs.doctor.interval"
+ENDPOINTS_KEY = "obs.doctor.endpoints"
+REGISTRY_KEY = "obs.doctor.registry"
+SERVICE_KEY = "obs.doctor.service"
+NN_HTTP_KEY = "obs.doctor.namenode.http"
+PUSH_NN_KEY = "obs.doctor.push.namenode"
+SLOW_TTL_KEY = "obs.doctor.slow.ttl"
+
+STEP_FAMILY = "htpu_decode_step_seconds"
+TTFT_FAMILY = "htpu_time_to_first_token_seconds"
+
+
+class FleetDoctor(AbstractService):
+    """Aggregation service + its own chassis HTTP door."""
+
+    def __init__(self, conf: Configuration):
+        super().__init__("FleetDoctor")
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._report: Dict = {"generated_at": 0.0}   # guarded-by: _lock
+        self._endpoints: List[Endpoint] = []         # guarded-by: _lock
+        self._reg_client = None
+        self._nn_proxy = None
+        self._rpc_client = None
+        self.http = None
+        # replica /prom window state: endpoint key ->
+        # {family: (sum, count)} cumulative at the previous poll
+        self._prom_prev: Dict[str, Dict[str, Tuple[float, float]]] = {}
+
+    # ----------------------------------------------------------- lifecycle
+
+    def service_init(self, conf: Configuration) -> None:
+        self.interval = conf.get_time_seconds(INTERVAL_KEY, 5.0)
+        self.timeout = conf.get_time_seconds(
+            "obs.doctor.scrape.timeout", 2.0)
+        self.store = FleetTraceStore(conf)
+        self.slow_ttl = conf.get_time_seconds(
+            SLOW_TTL_KEY, max(30.0, self.interval * 10))
+        det = dict(
+            history=conf.get_int("obs.doctor.slow.history", 5),
+            min_windows=conf.get_int("obs.doctor.slow.min-windows", 3),
+            min_peers=conf.get_int("obs.doctor.slow.min-peers", 3),
+            mad_k=conf.get_float("obs.doctor.slow.mad-k", 3.0),
+            ratio=conf.get_float("obs.doctor.slow.ratio", 1.5),
+            abs_floor=conf.get_float("obs.doctor.slow.floor.ms",
+                                     1.0) / 1e3)
+        # one detector per signal: a node slow on pipeline acks and a
+        # node slow on its own disk are different diagnoses
+        self.detectors: Dict[str, SlowNodeDetector] = {
+            "dn.pipeline_ack": SlowNodeDetector(**det),
+            "dn.read_service": SlowNodeDetector(**det),
+            "replica.decode_step": SlowNodeDetector(**det),
+            "replica.ttft": SlowNodeDetector(**det),
+        }
+        self._static = [Endpoint(n, h, p, "daemon") for n, h, p in
+                        parse_endpoint_list(conf.get(ENDPOINTS_KEY, ""))]
+        self._pushed_slow: set = set()   # last flagged set sent to NN
+        self._nn_http = None
+        nn_http = conf.get(NN_HTTP_KEY, "")
+        if nn_http:
+            host, _, port = nn_http.rpartition(":")
+            self._nn_http = Endpoint("namenode", host or "127.0.0.1",
+                                     int(port), "namenode")
+        self._registry_addr = None
+        reg = conf.get(REGISTRY_KEY, "")
+        if reg:
+            host, _, port = reg.rpartition(":")
+            self._registry_addr = (host or "127.0.0.1", int(port))
+        self._service_prefix = conf.get(SERVICE_KEY, "")
+        self.push_nn = conf.get_bool(PUSH_NN_KEY, True)
+        from hadoop_tpu.http import HttpServer
+        self.http = HttpServer(
+            conf, bind=("127.0.0.1", conf.get_int("obs.doctor.port", 0)),
+            daemon_name="fleet-doctor")
+        self.http.add_handler("/ws/v1/fleet/doctor", self._h_doctor)
+        self.http.add_handler("/ws/v1/fleet/traces", self._h_traces)
+
+    def service_start(self) -> None:
+        self.http.start()
+        Daemon(self._poll_loop, "fleet-doctor-poll").start()
+        log.info("fleet doctor on :%d (interval %.1fs)",
+                 self.http.port, self.interval)
+
+    def service_stop(self) -> None:
+        self._stop.set()
+        if self.http is not None:
+            self.http.stop()
+        if self._reg_client is not None:
+            self._reg_client.close()
+        if self._rpc_client is not None:
+            self._rpc_client.stop()
+
+    @property
+    def port(self) -> int:
+        return self.http.port
+
+    # ----------------------------------------------------------- discovery
+
+    def discover(self) -> List[Endpoint]:
+        """Static conf + NameNode roster + registry replicas. Failures
+        shrink the list, never raise — the doctor keeps doctoring the
+        daemons it CAN see."""
+        eps: Dict[str, Endpoint] = {e.key: e for e in self._static}
+        if self._nn_http is not None:
+            eps[self._nn_http.key] = self._nn_http
+            try:
+                roster = json.loads(http_get(
+                    self._nn_http.host, self._nn_http.port,
+                    "/ws/v1/datanodes", self.timeout))
+                for dn in roster.get("datanodes", []):
+                    if dn.get("state") != "live" or \
+                            not dn.get("info_port"):
+                        continue
+                    ep = Endpoint(dn["uuid"], dn.get("host", "127.0.0.1"),
+                                  dn["info_port"], "datanode")
+                    eps[ep.key] = ep
+            except (OSError, ValueError, KeyError) as e:
+                log.debug("datanode roster pull failed: %s", e)
+        if self._registry_addr is not None:
+            from hadoop_tpu.registry.registry import (record_is_stale,
+                                                      record_ttl)
+            ttl = record_ttl(self.config)
+            try:
+                for rec in self._registry().list(self._service_prefix
+                                                 or "/services"):
+                    if record_is_stale(rec, ttl):
+                        # corpse replica (died without deregistering,
+                        # awaiting the registry sweep): scraping it
+                        # costs bounded timeouts EVERY poll and can
+                        # push a poll past its interval — the router/
+                        # autoscaler precedent skips it
+                        continue
+                    try:
+                        host, _, port = \
+                            rec.endpoints["http"].rpartition(":")
+                    except (KeyError, AttributeError):
+                        continue
+                    ep = Endpoint(rec.path, host or "127.0.0.1",
+                                  int(port), "replica")
+                    eps[ep.key] = ep
+            except Exception as e:  # noqa: BLE001 — registry outage: the
+                # doctor keeps serving what it can still see; the next
+                # jittered poll retries discovery
+                log.debug("registry discovery failed: %s", e)
+        return list(eps.values())
+
+    def _registry(self):
+        if self._reg_client is None:
+            from hadoop_tpu.registry.registry import RegistryClient
+            self._reg_client = RegistryClient(self._registry_addr,
+                                              self.config)
+        return self._reg_client
+
+    # ---------------------------------------------------------------- poll
+
+    def _poll_loop(self) -> None:
+        # jittered cadence (fleet hygiene: N doctors/scrapers must not
+        # align their pulls), same law as every poll loop in this tree
+        while not self._stop.wait(backoff_delay(self.interval, 0,
+                                                max_s=self.interval * 2)):
+            try:
+                self.poll_once()
+            except Exception:
+                log.exception("doctor poll failed")
+
+    def poll_once(self) -> Dict:
+        """One full pass: discover -> scrape traces -> scrape signals ->
+        detect -> publish report (and push slow DNs to the NN).
+        Callable synchronously — tests and the smoke pump this."""
+        endpoints = self.discover()
+        with self._lock:
+            self._endpoints = endpoints
+        self.store.scrape(endpoints)
+        dn_eps = [e for e in endpoints if e.kind == "datanode"]
+        rep_eps = [e for e in endpoints if e.kind == "replica"]
+        self._observe_datanodes(dn_eps)
+        self._observe_replicas(rep_eps)
+        report = self._compile(endpoints)
+        with self._lock:
+            self._report = report
+        flagged_dns = sorted(report["datanodes"]["flagged"])
+        # push when anything is flagged (refreshing the NN's TTL) AND
+        # once more when the set empties — set_slow_nodes is a full
+        # report, so the empty push clears a recovered node IMMEDIATELY
+        # instead of letting it ride out the TTL. (A failed empty push
+        # is covered by the TTL fail-open.)
+        if self.push_nn and (flagged_dns or self._pushed_slow):
+            self._push_slow_nodes(flagged_dns)
+        self._pushed_slow = set(flagged_dns)
+        return report
+
+    def _observe_datanodes(self, dn_eps: List[Endpoint]) -> None:
+        """Aggregate every DN's view of every peer: a target's signal is
+        the MEDIAN of what its upstream reporters measured (one broken
+        reporter cannot frame a healthy target), then MAD across
+        targets."""
+        reported: Dict[str, List[float]] = {}
+        self_read: Dict[str, float] = {}
+        for ep in dn_eps:
+            try:
+                rep = json.loads(http_get(ep.host, ep.port,
+                                          "/ws/v1/peers", self.timeout))
+            except (OSError, ValueError):
+                continue                      # churn: skip this reporter
+            for target, s in (rep.get("peers") or {}).items():
+                if s and s.get("n"):
+                    reported.setdefault(target, []).append(
+                        float(s["mean"]))
+            own = (rep.get("self") or {}).get("read")
+            if own and own.get("n"):
+                self_read[rep.get("node", ep.name)] = float(own["mean"])
+        if reported:
+            self.detectors["dn.pipeline_ack"].observe(
+                {t: median(v) for t, v in reported.items()})
+        if self_read:
+            self.detectors["dn.read_service"].observe(self_read)
+
+    def _observe_replicas(self, rep_eps: List[Endpoint]) -> None:
+        """Per-stage replica latencies from /prom, windowed by diffing
+        cumulative sum/count per endpoint (counter reset => restart =>
+        whole history is this window)."""
+        # lazy: parse_prom lives with the autoscaler, whose package
+        # pulls the serving engine — only the doctor daemon pays that,
+        # never a DataNode importing obs.peers
+        from hadoop_tpu.serving.autoscale.signals import parse_prom
+        step_means: Dict[str, float] = {}
+        ttft_means: Dict[str, float] = {}
+        seen = set()
+        for ep in rep_eps:
+            seen.add(ep.key)
+            try:
+                fams = parse_prom(http_get(ep.host, ep.port, "/prom",
+                                           self.timeout).decode())
+            except (OSError, ValueError):
+                continue
+            prev = self._prom_prev.setdefault(ep.key, {})
+            for family, sink in ((STEP_FAMILY, step_means),
+                                 (TTFT_FAMILY, ttft_means)):
+                total = sum(v for _, v in fams.get(f"{family}_sum", []))
+                count = sum(v for _, v in fams.get(f"{family}_count",
+                                                   []))
+                p_sum, p_count = prev.get(family, (0.0, 0.0))
+                if count < p_count:
+                    p_sum, p_count = 0.0, 0.0
+                d_count = count - p_count
+                if d_count > 0 and math.isfinite(total):
+                    sink[ep.name] = (total - p_sum) / d_count
+                prev[family] = (total, count)
+        # prune window state for departed replicas (elastic fleets mint
+        # a port per replica — the FleetScraper precedent)
+        for key in [k for k in self._prom_prev if k not in seen]:
+            del self._prom_prev[key]
+        if step_means:
+            self.detectors["replica.decode_step"].observe(step_means)
+        if ttft_means:
+            self.detectors["replica.ttft"].observe(ttft_means)
+
+    # -------------------------------------------------------------- report
+
+    def _compile(self, endpoints: List[Endpoint]) -> Dict:
+        by_name = {e.name: e for e in endpoints}
+
+        def section(kinds: Tuple[str, ...]) -> Dict:
+            flagged: Dict[str, Dict] = {}
+            for signal in kinds:
+                for node, ev in self.detectors[signal].report().items():
+                    entry = flagged.setdefault(
+                        node, {"node": node, "signals": {}})
+                    entry["signals"][signal] = ev
+                    ep = by_name.get(node)
+                    if ep is not None:
+                        entry["endpoint"] = ep.to_dict()
+                        # the diagnosis handoff: a flagged node's live
+                        # thread dump is one click away
+                        entry["stacks"] = (f"http://{ep.host}:{ep.port}"
+                                           f"/ws/v1/stacks")
+            return {"flagged": flagged}
+
+        return {
+            "generated_at": time.time(),
+            "interval_s": self.interval,
+            "endpoints": self.store.status(),
+            "datanodes": section(("dn.pipeline_ack", "dn.read_service")),
+            "replicas": section(("replica.decode_step", "replica.ttft")),
+            "traces_held": len(self.store.trace_ids()),
+        }
+
+    def report(self) -> Dict:
+        with self._lock:
+            return dict(self._report)
+
+    def sick_replicas(self) -> List[str]:
+        """Endpoint names (registry paths) of flagged replicas — the
+        autoscaler's scale-in victim hint."""
+        with self._lock:
+            rep = self._report
+        return sorted((rep.get("replicas") or {})
+                      .get("flagged", {}).keys())
+
+    # ----------------------------------------------------------- NN push
+
+    def _push_slow_nodes(self, uuids: List[str]) -> None:
+        """DatanodeProtocol.report_slow_peers to EVERY configured
+        NameNode — the DN precedent (one BPServiceActor per NN): in an
+        HA pair the doctor cannot know which node is active, and a
+        standby silently accepting the report while the active never
+        hears it would defeat placement deprioritization with no error
+        anywhere. Pipeline placement then avoids these uuids until the
+        TTL lapses (a doctor outage fails open: flags decay)."""
+        delivered = 0
+        for addr, proxy in self._nn_proxies():
+            try:
+                proxy.report_slow_peers(uuids, self.slow_ttl)
+                delivered += 1
+            except Exception as e:  # noqa: BLE001 — an unreachable NN
+                # must not kill the doctor or starve its HA twin; the
+                # next poll re-pushes (the TTL is several intervals
+                # wide exactly so one miss is harmless)
+                log.debug("slow-node push to %s failed: %s", addr, e)
+                self._nn_proxy = None     # rebuild proxies next push
+        if not delivered:
+            log.debug("slow-node push reached no NameNode")
+
+    def _nn_proxies(self):
+        if self._nn_proxy is None:
+            from hadoop_tpu.ipc import Client, get_proxy
+            from hadoop_tpu.util.misc import parse_addr_list
+            addrs = parse_addr_list(self.config.get(
+                "dfs.namenode.rpc-address", "127.0.0.1:8020"))
+            if self._rpc_client is None:
+                self._rpc_client = Client(self.config)
+            self._nn_proxy = [
+                (addr, get_proxy("DatanodeProtocol", addr,
+                                 client=self._rpc_client))
+                for addr in addrs]
+        return self._nn_proxy
+
+    # ------------------------------------------------------------ servlets
+
+    def _h_doctor(self, query, body):
+        return 200, self.report()
+
+    def _h_traces(self, query, body):
+        """``/ws/v1/fleet/traces`` lists held ids;
+        ``/ws/v1/fleet/traces/<id>`` (hex or decimal) assembles one —
+        with a targeted fleet pull on a miss, so a trace retained only
+        in some daemon's flight recorder still resolves."""
+        path = query.get("__path__", "")
+        suffix = path[len("/ws/v1/fleet/traces"):].strip("/")
+        if not suffix:
+            return 200, {
+                "traces": [f"{t:016x}" for t in self.store.trace_ids()],
+                "endpoints": self.store.status()}
+        from hadoop_tpu.tracing.tracer import parse_trace_id_candidates
+        cands = parse_trace_id_candidates(suffix)
+        if not cands:
+            return 400, {"RemoteException": {
+                "exception": "IllegalArgumentException",
+                "message": f"bad trace id {suffix!r}"}}
+        assembled = next((a for a in map(self.store.assemble, cands)
+                          if a is not None), None)
+        if assembled is None:
+            with self._lock:
+                endpoints = list(self._endpoints)
+            if not endpoints:
+                endpoints = self.discover()
+            for tid in cands:
+                self.store.fetch_trace(tid, endpoints)
+                assembled = self.store.assemble(tid)
+                if assembled is not None:
+                    break
+        if assembled is None:
+            return 404, {"RemoteException": {
+                "exception": "FileNotFoundException",
+                "message": f"trace {suffix} not found on any daemon"}}
+        return 200, assembled
+
+
+def doctor_main(argv: List[str],
+                conf: Optional[Configuration] = None) -> int:
+    """`hadoop-tpu doctor` — run the fleet doctor as a daemon."""
+    import sys
+    conf = conf or Configuration()
+    args = dict(registry=None, service=None, namenode_http=None,
+                endpoints=None, port=None, interval=None)
+    i = 0
+    while i < len(argv):
+        key = argv[i].lstrip("-").replace("-", "_")
+        if key in args and i + 1 < len(argv):
+            args[key] = argv[i + 1]
+            i += 2
+        else:
+            print(f"unknown doctor option {argv[i]}", file=sys.stderr)
+            return 2
+    for key, conf_key in (("registry", REGISTRY_KEY),
+                          ("service", SERVICE_KEY),
+                          ("namenode_http", NN_HTTP_KEY),
+                          ("endpoints", ENDPOINTS_KEY),
+                          ("port", "obs.doctor.port"),
+                          ("interval", INTERVAL_KEY)):
+        if args[key] is not None:
+            conf.set(conf_key, str(args[key]))
+    from hadoop_tpu.cli.main import _run_daemon
+    return _run_daemon(FleetDoctor(conf), conf)

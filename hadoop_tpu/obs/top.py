@@ -1,0 +1,68 @@
+"""nntop-style rolling-window top-N — ``/ws/v1/top`` on every chassis.
+
+The reference's nntop (ref: namenode/top/TopMetrics.java +
+RollingWindowManager) keeps its *own* rolling counters per (op, user).
+This tree already pays for decayed per-caller accounting twice — the
+RPC plane's ``DecayRpcScheduler`` (per-caller decayed call counts) and
+the serving door's ``DecayCostScheduler`` (per-tenant decayed token
+cost, ISSUE 8) — so the top servlet *reads those*, it does not grow a
+third counter. A daemon registers each accounting it owns as a named
+source; ``/ws/v1/top`` (http/server.py chassis) renders every source's
+current decayed window as a ranked top-N.
+
+Process-global like the metrics system (a shared-process minicluster
+registers several daemons' sources side by side); daemons unregister on
+stop so tests don't leak sources across cases.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List
+
+# source name -> zero-arg snapshot fn returning
+# {"total": float, <"callers"|"tenants">: {key: decayed_cost}}
+_sources: Dict[str, Callable[[], Dict]] = {}
+_lock = threading.Lock()
+
+
+def register_top_source(name: str, snapshot_fn: Callable[[], Dict]) -> None:
+    """Register (or replace) a decay-accounting snapshot under ``name``.
+    ``snapshot_fn`` is the EXISTING scheduler's ``snapshot`` — e.g.
+    ``DecayRpcScheduler.snapshot`` or ``DecayCostScheduler.snapshot``."""
+    with _lock:
+        _sources[name] = snapshot_fn
+
+
+def unregister_top_source(name: str) -> None:
+    with _lock:
+        _sources.pop(name, None)
+
+
+def top_n(n: int = 10) -> Dict[str, Dict]:
+    """{source: {total, window: [{key, cost, share}]}} — ranked,
+    heaviest first. A source whose snapshot raises is reported as an
+    error entry, never an exception out of the servlet."""
+    with _lock:
+        sources = dict(_sources)
+    out: Dict[str, Dict] = {}
+    for name, fn in sources.items():
+        try:
+            snap = fn()
+        except Exception as e:  # noqa: BLE001 — source is daemon code
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+            continue
+        total = float(snap.get("total", 0.0) or 0.0)
+        entries = snap.get("callers") or snap.get("tenants") or {}
+        ranked: List[Dict] = sorted(
+            ({"key": k, "cost": round(float(v), 3),
+              "share": round(float(v) / total, 4) if total else 0.0}
+             for k, v in entries.items()),
+            key=lambda e: -e["cost"])[:n]
+        out[name] = {"total": round(total, 3), "window": ranked}
+    return out
+
+
+def reset_for_tests() -> None:
+    with _lock:
+        _sources.clear()

@@ -75,6 +75,9 @@ PREFILL_MAX_KEY = "serving.autoscale.prefill.max"
 BACKLOG_HIGH_KEY = "serving.autoscale.backlog.high"
 DRAIN_TIMEOUT_KEY = "serving.autoscale.drain.timeout"
 SCALEIN_TTFT_FRAC_KEY = "serving.autoscale.scalein.ttft.frac"
+# fleet doctor door (host:port): sick replicas become preferred
+# scale-in victims — retiring the statistical outlier heals the fleet
+DOCTOR_KEY = "serving.autoscale.doctor"
 
 METRICS_SOURCE = "serving.autoscale"
 
@@ -191,6 +194,12 @@ class Autoscaler:
         self.scalein_ttft_frac = conf.get_float(SCALEIN_TTFT_FRAC_KEY,
                                                 0.5)
         self.record_ttl = record_ttl(conf)
+        self._doctor_addr: Optional[Tuple[str, int]] = None
+        doctor = conf.get(DOCTOR_KEY, "")
+        if doctor:
+            host, _, port = doctor.rpartition(":")
+            self._doctor_addr = (host or "127.0.0.1", int(port))
+        self._sick: set = set()     # doctor-flagged replica paths
         self._pools: Dict[str, _PoolState] = {
             "decode": _PoolState(), "prefill": _PoolState()}
         self._draining: set = set()     # guarded-by: _lock
@@ -233,6 +242,7 @@ class Autoscaler:
             for s in snap.samples:
                 if s.path in self._draining:
                     s.draining = True
+        self._refresh_sick()
         self.last_snapshot = snap
         self.m_decode_replicas.set(len(snap.pool("decode")))
         self.m_prefill_replicas.set(len(snap.pool("prefill")))
@@ -247,6 +257,22 @@ class Autoscaler:
                 del self.decisions[:-256]          # bounded history
                 self._act(d, snap)
         return out
+
+    def _refresh_sick(self) -> None:
+        """Pull the doctor's sick-replica verdict (bounded timeout; a
+        dead doctor keeps the last-known set — a transient doctor
+        outage must not flip victim preference every poll)."""
+        if self._doctor_addr is None:
+            return
+        try:
+            rep = json.loads(http_get(self._doctor_addr[0],
+                                      self._doctor_addr[1],
+                                      "/ws/v1/fleet/doctor",
+                                      self.scraper.timeout))
+            self._sick = set((rep.get("replicas") or {})
+                             .get("flagged", {}).keys())
+        except (OSError, ValueError) as e:
+            log.debug("doctor scrape failed: %s", e)
 
     # ---------------------------------------------------------- policy
 
@@ -339,17 +365,19 @@ class Autoscaler:
     def _cooled(self, st: _PoolState) -> bool:
         return time.monotonic() - st.last_action >= self.cooldown
 
-    @staticmethod
-    def _pick_victim(pool: List[ReplicaSample]
+    def _pick_victim(self, pool: List[ReplicaSample]
                      ) -> Optional[ReplicaSample]:
-        """Affinity-aware victim choice: the least-loaded replica
-        first, then the one with the fewest resident cached blocks —
-        retire the member whose drain persists the least and whose
-        loss moves the fewest rendezvous keys."""
+        """Affinity-aware victim choice: a doctor-flagged SICK replica
+        first (retiring the statistical outlier removes the fleet's
+        tail), then the least-loaded, then the fewest resident cached
+        blocks — retire the member whose drain persists the least and
+        whose loss moves the fewest rendezvous keys."""
         cands = [s for s in pool if s.ok]
         if not cands:
             return None
-        return min(cands, key=lambda s: (s.active + s.queue_depth,
+        sick = self._sick
+        return min(cands, key=lambda s: (s.path not in sick,
+                                         s.active + s.queue_depth,
                                          s.cached_blocks, s.path))
 
     # ---------------------------------------------------------- actions
@@ -494,6 +522,7 @@ class Autoscaler:
             "ttft_p99_s": snap.ttft_p99_s if snap else None,
             "shed_delta": snap.shed_delta if snap else 0,
             "draining": draining,
+            "sick": sorted(self._sick),
             "decisions": [
                 {"at": d.at, "role": d.role, "action": d.action,
                  "current": d.current, "target": d.target,

@@ -17,7 +17,6 @@ stall in the control loop.
 
 from __future__ import annotations
 
-import http.client
 import json
 import logging
 import math
@@ -26,6 +25,10 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from hadoop_tpu.conf import Configuration
+# THE bounded fleet probe lives in the light http package (the
+# doctor's obs/ plane reuses it without dragging serving imports into
+# a DataNode process); re-exported here for existing callers
+from hadoop_tpu.http import http_get  # noqa: F401
 
 log = logging.getLogger(__name__)
 
@@ -45,6 +48,12 @@ def parse_prom(text: str) -> Dict[str, List[Tuple[Dict[str, str], float]]]:
         line = line.strip()
         if not line or line.startswith("#"):
             continue
+        # histogram _bucket lines may carry an OpenMetrics exemplar
+        # suffix ('value # {trace_id="..."} ex_value ts') — strip it, or
+        # float(valstr) below rejects the line and the autoscaler loses
+        # exactly the TTFT buckets it scales on
+        if " # " in line:
+            line = line.split(" # ", 1)[0].rstrip()
         try:
             if "{" in line:
                 name, rest = line.split("{", 1)
@@ -161,19 +170,6 @@ class FleetSnapshot:
         return max(s.load_seconds for s in pool)
 
 
-def http_get(host: str, port: int, path: str, timeout: float) -> bytes:
-    """One bounded GET — every fleet probe goes through here so no
-    scrape can ever hang the control loop."""
-    conn = http.client.HTTPConnection(host, port, timeout=timeout)
-    try:
-        conn.request("GET", path)
-        resp = conn.getresponse()
-        body = resp.read()
-        if resp.status != 200:
-            raise IOError(f"{path} -> HTTP {resp.status}")
-        return body
-    finally:
-        conn.close()
 
 
 class FleetScraper:

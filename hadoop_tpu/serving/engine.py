@@ -1183,9 +1183,13 @@ class DecodeEngine:
             del self.occupancy_log[:50_000]
         accepted = 0
         spec_parent = None
+        step_exemplar = None   # any sampled request names this step
         for slot, req in enumerate(self._slots):
             if req is None or not self._active[slot]:
                 continue
+            if step_exemplar is None and req.trace_ctx is not None \
+                    and req.trace_ctx.sampled:
+                step_exemplar = req.trace_ctx.trace_id
             n = int(packed[slot, G])
             if n <= 0:
                 continue
@@ -1236,7 +1240,10 @@ class DecodeEngine:
             self.metrics.tokens_out.incr(emitted)
             step_s = time.monotonic() - t0
             self.metrics.decode_step.add(step_s)
-            self.metrics.decode_step_hist.add(step_s)
+            # exemplar: a slow decode_step bucket on /prom names a
+            # trace riding this step, resolvable at the fleet doctor
+            self.metrics.decode_step_hist.add(
+                step_s, exemplar_trace=step_exemplar)
         return emitted
 
     def _deliver_burst(self, req: GenRequest, toks) -> int:
@@ -1289,7 +1296,12 @@ class DecodeEngine:
             ttft = req.first_token_at - req.submitted_at
             if self.metrics:
                 self.metrics.ttft.add(ttft)
-                self.metrics.ttft_hist.add(ttft)
+                # a slow TTFT bucket's exemplar IS this request's trace
+                self.metrics.ttft_hist.add(
+                    ttft,
+                    exemplar_trace=req.trace_ctx.trace_id
+                    if req.trace_ctx is not None and
+                    req.trace_ctx.sampled else None)
             fsp = self.tracer.span("serving.first_token",
                                    parent=req.trace_ctx)
             fsp.add_kv("request", str(req.id))

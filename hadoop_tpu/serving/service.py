@@ -197,6 +197,15 @@ class ServingReplica:
     def start(self) -> None:
         self.engine.start()
         self.server.start()
+        self._top_source = None
+        if self.qos_enabled and self.server.qos is not None:
+            # /ws/v1/top on this replica's chassis reads the door's
+            # decay-cost accounting — the serving twin of nntop, no
+            # second counter (obs/top.py)
+            from hadoop_tpu.obs.top import register_top_source
+            self._top_source = f"serving.{self.name}.tenants"
+            register_top_source(self._top_source,
+                                self.server.qos.sched.snapshot)
         if self._registry_addr:
             from hadoop_tpu.registry.registry import (HEARTBEAT_ATTR,
                                                       RegistryClient,
@@ -292,6 +301,9 @@ class ServingReplica:
                 self.reg.close()
             self.server.stop()
         finally:
+            if getattr(self, "_top_source", None):
+                from hadoop_tpu.obs.top import unregister_top_source
+                unregister_top_source(self._top_source)
             self.drained.set()
 
 

@@ -130,6 +130,30 @@ class Span:
         }
 
 
+def parse_trace_id_candidates(raw: str) -> List[int]:
+    """THE reading of a user-supplied trace id, shared by every query
+    surface (per-daemon ``/ws/v1/traces?trace_id=``, the fleet
+    doctor's ``/ws/v1/fleet/traces/<id>``): an explicit ``0x`` form is
+    hex; an ambiguous all-digit string is tried as BOTH hex and
+    decimal — span JSON prints ids decimal while the slow-trace log
+    line and fleet endpoints print ``016x``, and either paste must
+    resolve. Hex first (the printed fleet form); callers that filter
+    by membership treat the result as a set. Empty list = unparseable."""
+    raw = raw.strip().lower()
+    base16 = raw[2:] if raw.startswith("0x") else raw
+    bases = ((16, base16),) if raw.startswith("0x") \
+        else ((16, base16), (10, raw))
+    out: List[int] = []
+    for base, s in bases:
+        try:
+            v = int(s, base)
+        except ValueError:
+            continue
+        if v not in out:
+            out.append(v)
+    return out
+
+
 def current_span() -> Optional[Span]:
     return _active.get()
 

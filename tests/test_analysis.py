@@ -618,6 +618,73 @@ def test_span_good_shapes_are_clean(tmp_path):
     assert findings == []
 
 
+# ------------------------------------------- metrics /prom discipline
+
+def test_duplicate_prom_family_is_flagged(tmp_path):
+    from hadoop_tpu.analysis import PromFamilyChecker
+    findings = lint_source(tmp_path, """
+        def a(reg):
+            reg.gauge("queue_depth", "waiting")
+
+        def b(reg2):
+            reg2.counter("queue_depth", "BAD: merges as a counter "
+                         "family elsewhere")  # still distinct: _total
+            reg2.quantiles("queue_depth", "BAD: same family, summary")
+    """, [PromFamilyChecker()])
+    assert ids_of(findings) == ["metrics/duplicate-family"]
+    # counter mints queue_depth_total (no clash); quantiles mints
+    # queue_depth (clashes with the gauge)
+
+
+def test_same_kind_shared_family_is_clean(tmp_path):
+    from hadoop_tpu.analysis import PromFamilyChecker
+    findings = lint_source(tmp_path, """
+        def a(reg):
+            for tier in ("host", "dfs"):
+                reg.histogram(f"kv_fetch_seconds_{tier}", "fetch",
+                              prom_name="kv_fetch_seconds",
+                              prom_labels={"tier": tier})
+
+        def b(reg2):
+            reg2.histogram("kv_fetch_seconds_x", "another source",
+                           prom_name="kv_fetch_seconds",
+                           prom_labels={"tier": "x"})
+    """, [PromFamilyChecker()])
+    assert findings == []
+
+
+def test_unbounded_prom_label_is_flagged(tmp_path):
+    from hadoop_tpu.analysis import PromFamilyChecker
+    findings = lint_source(tmp_path, """
+        def per_user_series(reg, request):
+            reg.histogram("op_seconds", "BAD: label from request data",
+                          prom_labels={"user": request.user})
+
+        def per_port_series(reg, port):
+            reg.histogram("op2_seconds", "BAD: label from a parameter",
+                          prom_labels={"port": f"{port}"})
+    """, [PromFamilyChecker()])
+    assert ids_of(findings) == ["metrics/unbounded-label",
+                                "metrics/unbounded-label"]
+
+
+def test_bounded_literal_labels_are_clean(tmp_path):
+    from hadoop_tpu.analysis import PromFamilyChecker
+    findings = lint_source(tmp_path, """
+        def tiers(reg):
+            hists = {t: reg.histogram(f"h_{t}", "ok",
+                                      prom_name="h",
+                                      prom_labels={"tier": t})
+                     for t in ("host", "dfs")}
+            for lane in ["a", "b"]:
+                reg.histogram(f"lane_{lane}", "ok", prom_name="lane",
+                              prom_labels={"lane": lane,
+                                           "static": "x"})
+            return hists
+    """, [PromFamilyChecker()])
+    assert findings == []
+
+
 # -------------------------------------------- suppression + baseline
 
 def test_line_suppression(tmp_path):

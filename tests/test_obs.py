@@ -1,0 +1,710 @@
+"""Fleet doctor: cross-daemon trace assembly, median/MAD slow-node
+detection (with the NN placement loop), histogram exemplars resolving
+through the doctor, and the satellite servlets (/ws/v1/stacks JSON,
+/ws/v1/top, NN audit log).
+
+Determinism rule (the ISSUE's hard constraint): detection decisions run
+on INJECTED latencies only — tests feed the per-peer trackers synthetic
+samples and assert on flag sets, never on wall-clock elapsed time.
+"""
+
+import http.client
+import json
+import logging
+import re
+import threading
+import time
+
+import pytest
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.obs.assemble import (Endpoint, FleetTraceStore,
+                                     assemble_tree, parse_endpoint_list)
+from hadoop_tpu.obs.detect import (RollingStat, SlowNodeDetector,
+                                   mad_outliers, median)
+from hadoop_tpu.obs.peers import PeerLatencyTracker
+from hadoop_tpu.obs import top as obs_top
+from hadoop_tpu.tracing.collector import span_collector
+from hadoop_tpu.tracing.tracer import global_tracer
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10.0)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _get_json(port, path):
+    status, body = _get(port, path)
+    assert status == 200, body
+    return json.loads(body)
+
+
+# ------------------------------------------------------- detection math
+
+
+def test_median_and_mad_outliers():
+    assert median([3.0, 1.0, 2.0]) == 2.0
+    assert median([1.0, 2.0, 3.0, 4.0]) == 2.5
+    vals = {"a": 0.010, "b": 0.012, "c": 0.011, "d": 0.150}
+    flagged = mad_outliers(vals)
+    assert list(flagged) == ["d"]
+    ev = flagged["d"]
+    assert ev["value"] == 0.15 and ev["peers"] == 4
+    assert ev["threshold"] < 0.15
+
+
+def test_outliers_need_peers_and_spread():
+    # below min_peers: nobody can be an outlier among too few
+    assert mad_outliers({"a": 0.01, "b": 9.9}, min_peers=3) == {}
+    # a tight healthy fleet (microseconds of spread, all below the
+    # absolute floor) flags nobody
+    tight = {f"n{i}": 0.0010 + i * 1e-6 for i in range(5)}
+    assert mad_outliers(tight, abs_floor=0.002) == {}
+    # ratio guard: statistically "outlying" but only 10% slower
+    near = {"a": 1.000, "b": 1.000, "c": 1.000, "d": 1.100}
+    assert mad_outliers(near, ratio=1.5) == {}
+
+
+def test_detector_hysteresis_flags_and_recovers():
+    det = SlowNodeDetector(history=5, min_windows=3, min_peers=3)
+    slow = {"a": 0.01, "b": 0.011, "c": 0.012, "sick": 0.2}
+    clean = {"a": 0.01, "b": 0.011, "c": 0.012, "sick": 0.011}
+    det.observe(slow)
+    det.observe(slow)
+    assert det.report() == {}          # 2 of 3 required windows
+    det.observe(slow)
+    rep = det.report()
+    assert list(rep) == ["sick"]
+    assert rep["sick"]["windows_flagged"] == 3
+    # recovery: clean windows push the slow ones out of history
+    for _ in range(5):
+        det.observe(clean)
+    assert det.report() == {}
+
+
+def test_rolling_stat_window_bound():
+    rs = RollingStat(window=4)
+    for v in (1.0, 2.0, 3.0, 4.0, 5.0, 6.0):
+        rs.record(v)
+    s = rs.summary()
+    assert s["n"] == 4 and s["mean"] == pytest.approx(4.5)
+    assert s["median"] == pytest.approx(4.5)
+
+
+def test_peer_tracker_bounds_and_self_stats():
+    tr = PeerLatencyTracker(window=8, max_peers=3)
+    for i in range(5):          # 5 peers through a 3-peer budget
+        tr.record(f"peer{i}", 0.01 * (i + 1))
+    assert len(tr.summary()) == 3
+    tr.record_self_read(0.002)
+    tr.record_self_write(0.004)
+    rep = tr.to_report("node-x")
+    assert rep["node"] == "node-x"
+    assert rep["self"]["read"]["n"] == 1
+    assert rep["self"]["write"]["mean"] == pytest.approx(0.004)
+    # self stats never leak into the peer map
+    assert all(not p.startswith("__") for p in rep["peers"])
+
+
+def test_peer_tracker_summary_safe_under_concurrent_records():
+    """A doctor scrape (/ws/v1/peers -> summary) racing a responder
+    thread's record() must never die with deque-mutated-during-
+    iteration — summaries read under the tracker lock."""
+    tr = PeerLatencyTracker(window=64)
+    stop = threading.Event()
+
+    def hammer():
+        i = 0
+        while not stop.is_set():
+            tr.record(f"p{i % 8}", 0.001)
+            tr.record_self_read(0.001)
+            i += 1
+
+    t = threading.Thread(target=hammer, daemon=True)
+    t.start()
+    try:
+        for _ in range(300):
+            tr.summary()
+            tr.self_summary()
+            tr.to_report("n")
+    finally:
+        stop.set()
+        t.join(5.0)
+
+
+def test_peer_tracker_never_evicts_self_stats():
+    """A read-quiet node forwarding writes to many peers must keep its
+    own service-time signal: the reserved self entries are not eviction
+    candidates even as the idle-longest members."""
+    tr = PeerLatencyTracker(window=8, max_peers=4)
+    tr.record_self_read(0.002)       # oldest entries by last_at
+    tr.record_self_write(0.003)
+    for i in range(10):              # churn well past the budget
+        tr.record(f"peer{i}", 0.01)
+    rep = tr.to_report("n")
+    assert rep["self"]["read"] is not None
+    assert rep["self"]["write"] is not None
+    assert len(rep["peers"]) <= 4
+
+
+# ------------------------------------------------------- tree assembly
+
+
+def _span(tid, sid, parent, start, end, name, daemon="d"):
+    return {"trace_id": tid, "span_id": sid, "parent_id": parent,
+            "start": start, "end": end, "name": name, "daemon": daemon}
+
+
+def test_assemble_tree_nesting_self_time_and_orphans():
+    spans = [
+        _span(7, 1, None, 0.0, 1.0, "root", "client"),
+        _span(7, 2, 1, 0.1, 0.9, "nn.op", "nn"),
+        _span(7, 3, 2, 0.2, 0.8, "dn.read", "dn"),
+        # parent 99 never arrived (its daemon died): adopted as a root
+        _span(7, 4, 99, 0.3, 0.4, "orphan", "gone"),
+    ]
+    t = assemble_tree(7, spans)
+    assert t["num_spans"] == 4 and t["roots"] == 2
+    root = t["tree"][0]
+    assert root["name"] == "root"
+    assert root["children"][0]["name"] == "nn.op"
+    assert root["children"][0]["children"][0]["name"] == "dn.read"
+    # self time: root 1.0-0.8, nn 0.8-0.6, dn 0.6 — dn dominates
+    crit = t["critical_path"]
+    assert crit[0]["daemon"] == "dn"
+    assert crit[0]["self_ms"] == pytest.approx(600.0)
+    assert t["trace_id_hex"] == f"{7:016x}"
+
+
+def test_parse_endpoint_list():
+    eps = parse_endpoint_list("nn=1.2.3.4:80, :9090 ,dn=x:1")
+    assert eps == [("nn", "1.2.3.4", 80), (":9090", "127.0.0.1", 9090),
+                   ("dn", "x", 1)]
+
+
+def test_trace_id_candidates_shared_and_consistent():
+    """ONE reading of user-supplied trace ids, shared by the per-daemon
+    /ws/v1/traces?trace_id= handler and the fleet endpoint (two drifted
+    copies is exactly how ids end up resolving per-daemon but 404ing
+    fleet-wide): ambiguous all-digit strings try both hex and decimal,
+    0x forces hex, garbage is empty."""
+    from hadoop_tpu.tracing.tracer import parse_trace_id_candidates
+    assert parse_trace_id_candidates("ff") == [255]
+    assert parse_trace_id_candidates("123") == [0x123, 123]
+    assert parse_trace_id_candidates("0x123") == [0x123]
+    assert parse_trace_id_candidates("zzz!") == []
+    assert parse_trace_id_candidates("0") == [0]   # dedup across bases
+
+
+# --------------------------------------------- trace store under churn
+
+
+def _fake_trace_server(spans, slow=()):
+    """A chassis HttpServer whose trace endpoints serve CONTROLLED
+    spans (overriding the process-global collector handlers)."""
+    from hadoop_tpu.http.server import HttpServer
+    srv = HttpServer(Configuration(load_defaults=False), daemon_name="f")
+    srv.add_handler("/ws/v1/traces",
+                    lambda q, b: (200, {"spans": list(spans)}))
+    srv.add_handler("/ws/v1/traces/slow",
+                    lambda q, b: (200, {"traces": list(slow)}))
+    srv.start()
+    return srv
+
+
+def test_store_merges_and_keeps_spans_of_departed_endpoint():
+    """Kill a daemon mid-scrape: the spans it already contributed stay
+    in the assembled trace; its endpoint bookkeeping is pruned once
+    discovery drops it (FleetScraper precedent)."""
+    a = _fake_trace_server([_span(5, 1, None, 0.0, 1.0, "client.op")])
+    b = _fake_trace_server([_span(5, 2, 1, 0.2, 0.8, "dn.op")])
+    store = FleetTraceStore(Configuration(load_defaults=False))
+    ep_a = Endpoint("a", "127.0.0.1", a.port, "daemon")
+    ep_b = Endpoint("b", "127.0.0.1", b.port, "datanode")
+    try:
+        store.scrape([ep_a, ep_b])
+        t = store.assemble(5)
+        assert t["num_spans"] == 2
+        assert {s["daemon"] for s in _names(t)} == {"a", "b"}
+
+        # b dies; still listed: scrape fails, spans kept, ok=False
+        b.stop()
+        store.scrape([ep_a, ep_b])
+        st = store.status()
+        assert st[ep_b.key]["ok"] is False and st[ep_b.key]["error"]
+        assert store.assemble(5)["num_spans"] == 2
+
+        # discovery drops b: bookkeeping pruned, spans STILL kept
+        store.scrape([ep_a])
+        st = store.status()
+        assert ep_b.key not in st and ep_a.key in st
+        t = store.assemble(5)
+        assert t["num_spans"] == 2
+        assert any(s["name"] == "dn.op" for s in _names(t))
+    finally:
+        a.stop()
+
+
+def _names(tree):
+    out = []
+
+    def walk(n):
+        out.append(n)
+        for c in n["children"]:
+            walk(c)
+    for r in tree["tree"]:
+        walk(r)
+    return out
+
+
+def test_store_bounds_traces_lru():
+    conf = Configuration(load_defaults=False)
+    conf.set("obs.doctor.max-traces", "3")
+    srv = _fake_trace_server(
+        [_span(t, t * 10, None, 0.0, 1.0, f"op{t}") for t in
+         (1, 2, 3, 4, 5)])
+    store = FleetTraceStore(conf)
+    try:
+        store.scrape([Endpoint("a", "127.0.0.1", srv.port)])
+        held = store.trace_ids()
+        assert len(held) == 3 and set(held) == {3, 4, 5}
+    finally:
+        srv.stop()
+
+
+def test_store_targeted_fetch_pulls_flight_recorder():
+    """A trace only the flight recorder retains resolves via the
+    targeted fetch path (exemplar-resolution's fallback)."""
+    slow_trace = {"trace_id": 11, "trigger": "x", "spans": [
+        _span(11, 1, None, 0.0, 2.0, "slow.root")]}
+    srv = _fake_trace_server([], slow=[slow_trace])
+    store = FleetTraceStore(Configuration(load_defaults=False))
+    try:
+        ep = Endpoint("a", "127.0.0.1", srv.port)
+        assert store.assemble(11) is None
+        store.fetch_trace(11, [ep])
+        t = store.assemble(11)
+        assert t is not None and t["tree"][0]["name"] == "slow.root"
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------------- chassis servlets
+
+
+def test_ws_stacks_json_servlet():
+    from hadoop_tpu.http.server import HttpServer
+    srv = HttpServer(Configuration(load_defaults=False),
+                     daemon_name="stacky")
+    srv.start()
+    marker = threading.Event()
+    t = threading.Thread(target=marker.wait, name="obs-marker-thread",
+                         daemon=True)
+    t.start()
+    try:
+        js = _get_json(srv.port, "/ws/v1/stacks")
+        assert js["daemon"] == "stacky"
+        byname = {th["name"]: th for th in js["threads"]}
+        assert "obs-marker-thread" in byname
+        th = byname["obs-marker-thread"]
+        assert th["daemon"] is True and th["alive"] is True
+        # frames carry file/line/func — the wait() frame is in there
+        assert any(f["func"] == "wait" for f in th["stack"])
+    finally:
+        marker.set()
+        srv.stop()
+
+
+def test_prom_exemplars_opt_out():
+    """Strict 0.0.4 consumers can disable exemplars per-scrape
+    (?exemplars=0) or fleet-wide (metrics.prom.exemplars=false) — a
+    stock Prometheus scraper rejects the OpenMetrics suffix."""
+    from hadoop_tpu.http.server import HttpServer
+    from hadoop_tpu.metrics import metrics_system
+    h = metrics_system().source("exq").histogram("exq_seconds", "t")
+    h.add(0.01, exemplar_trace=0xbeef)
+    srv = HttpServer(Configuration(load_defaults=False), daemon_name="p")
+    srv.start()
+    try:
+        _, body = _get(srv.port, "/prom")
+        assert ' # {trace_id="' in body.decode()     # default: on
+        _, body = _get(srv.port, "/prom?exemplars=0")
+        assert " # " not in body.decode()
+        assert "exq_seconds_bucket" in body.decode()  # data intact
+    finally:
+        srv.stop()
+    conf = Configuration(load_defaults=False)
+    conf.set("metrics.prom.exemplars", "false")
+    srv = HttpServer(conf, daemon_name="p2")
+    srv.start()
+    try:
+        _, body = _get(srv.port, "/prom")
+        assert " # " not in body.decode()
+        _, body = _get(srv.port, "/prom?exemplars=1")  # per-scrape wins
+        assert ' # {trace_id="' in body.decode()
+    finally:
+        srv.stop()
+
+
+def test_ws_top_reads_registered_decay_accounting():
+    from hadoop_tpu.http.server import HttpServer
+    obs_top.reset_for_tests()
+    obs_top.register_top_source(
+        "test.tenants",
+        lambda: {"total": 100.0,
+                 "tenants": {"heavy": 80.0, "light": 20.0}})
+    srv = HttpServer(Configuration(load_defaults=False), daemon_name="t")
+    srv.start()
+    try:
+        js = _get_json(srv.port, "/ws/v1/top?n=1")
+        src = js["sources"]["test.tenants"]
+        assert src["window"] == [
+            {"key": "heavy", "cost": 80.0, "share": 0.8}]
+        status, _ = _get(srv.port, "/ws/v1/top?n=zzz")
+        assert status == 400
+        # a raising source becomes an error entry, not a 500
+        obs_top.register_top_source(
+            "bad", lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+        js = _get_json(srv.port, "/ws/v1/top")
+        assert "RuntimeError" in js["sources"]["bad"]["error"]
+    finally:
+        srv.stop()
+        obs_top.reset_for_tests()
+
+
+def test_serving_qos_snapshot_shape_matches_top_contract():
+    """The door's decay accounting is readable by /ws/v1/top as-is —
+    the 'reuse ISSUE 8's accounting, no second counter' contract."""
+    from hadoop_tpu.serving.qos import DecayCostScheduler
+    sched = DecayCostScheduler(4, Configuration(load_defaults=False))
+    try:
+        sched.charge("tenant-a", 700.0)
+        sched.charge("tenant-b", 300.0)
+        obs_top.reset_for_tests()
+        obs_top.register_top_source("serving.test.tenants",
+                                    sched.snapshot)
+        out = obs_top.top_n(5)["serving.test.tenants"]
+        assert out["window"][0]["key"] == "tenant-a"
+        assert out["window"][0]["share"] == pytest.approx(0.7)
+    finally:
+        sched.stop()
+        obs_top.reset_for_tests()
+
+
+# ------------------------------------------------- autoscaler victim
+
+
+def test_autoscaler_prefers_sick_victim():
+    from hadoop_tpu.serving.autoscale.controller import Autoscaler
+    from hadoop_tpu.serving.autoscale.signals import ReplicaSample
+
+    busy_sick = ReplicaSample(path="/s/r1", host="h", port=1, ok=True,
+                              active=3, queue_depth=2, cached_blocks=9)
+    idle_healthy = ReplicaSample(path="/s/r2", host="h", port=2,
+                                 ok=True, active=0, queue_depth=0,
+                                 cached_blocks=0)
+    pick = Autoscaler._pick_victim  # unbound: no registry needed
+
+    class Stub:
+        _sick = {"/s/r1"}
+    assert pick(Stub(), [busy_sick, idle_healthy]) is busy_sick
+
+    class NoSick:
+        _sick = set()
+    assert pick(NoSick(), [busy_sick, idle_healthy]) is idle_healthy
+
+
+def test_parse_prom_strips_exemplar_suffix():
+    from hadoop_tpu.serving.autoscale.signals import parse_prom
+    text = ('htpu_x_bucket{le="0.5"} 3 # {trace_id="00ab"} 0.4 1.7e9\n'
+            'htpu_x_bucket{le="+Inf"} 3\n')
+    fams = parse_prom(text)
+    assert fams["htpu_x_bucket"][0] == ({"le": "0.5"}, 3.0)
+
+
+# -------------------------------------------------------- miniDFS e2e
+
+
+@pytest.fixture(scope="module")
+def doctor_cluster(tmp_path_factory):
+    """One 3-DN miniDFS + a FleetDoctor wired to it (and the NN audit
+    log on) — shared by the e2e tests below."""
+    from hadoop_tpu.obs.doctor import FleetDoctor
+    from hadoop_tpu.testing.minicluster import MiniDFSCluster, fast_conf
+    conf = fast_conf()
+    conf.set("dfs.replication", "2")
+    conf.set("dfs.client.read.shortcircuit", "false")
+    conf.set("namenode.audit.enable", "true")
+    base = str(tmp_path_factory.mktemp("doctor-e2e"))
+    span_collector().reset_for_tests()
+    with MiniDFSCluster(num_datanodes=3, conf=conf,
+                        base_dir=base) as cluster:
+        cluster.wait_active()
+        fs = cluster.get_filesystem()
+        # real traffic: pipelines populate the peer trackers and the
+        # xceiver histograms (tiny and fast; detection never reads
+        # these wall-clock numbers — the tests inject their own)
+        for i in range(3):
+            fs.write_all(f"/warm{i}.bin", b"x" * 100_000)
+            fs.read_all(f"/warm{i}.bin")
+        dconf = Configuration(load_defaults=False)
+        dconf.set("obs.doctor.namenode.http",
+                  f"127.0.0.1:{cluster.namenode.http.port}")
+        dconf.set("dfs.namenode.rpc-address",
+                  f"127.0.0.1:{cluster.namenode.port}")
+        # determinism: the absolute floor sits far above anything the
+        # real miniDFS traffic can produce (single-box microsecond-to-
+        # millisecond noise), so ONLY the injected 250 ms latencies can
+        # flag — the decision never reads wall-clock measurements
+        dconf.set("obs.doctor.slow.floor.ms", "50")
+        doctor = FleetDoctor(dconf)
+        doctor.init(dconf)
+        doctor.start()
+        try:
+            yield cluster, fs, doctor
+        finally:
+            doctor.stop()
+
+
+def test_slow_datanode_flagged_deprioritized_and_exemplar_resolves(
+        doctor_cluster):
+    """THE acceptance path, in two phases sharing one live cluster
+    (the conftest autouse reset wipes the process-global metrics
+    system BETWEEN tests, so the /prom-exemplar phase must run in the
+    same test as the traffic that mints it):
+
+    1. one DN gets injected slow pipeline-ack latencies; within
+       min_windows doctor polls it (and only it) is flagged at
+       /ws/v1/fleet/doctor, and NN placement stops choosing it while
+       healthy nodes can satisfy the pipeline;
+    2. an exemplar trace id lifted off a DN's /prom histogram bucket
+       resolves at the doctor into a full assembled cross-daemon trace.
+    """
+    cluster, fs, doctor = doctor_cluster
+    uuids = [dn.uuid for dn in cluster.datanodes]
+    sick = uuids[2]
+    # injected latencies, never wall-clock: two healthy reporters each
+    # measure the sick DN ~50x slower than each other
+    for reporter in (0, 1):
+        tracker = cluster.datanodes[reporter].xceiver.peer_tracker
+        other = uuids[1 - reporter]
+        for _ in range(16):
+            tracker.record(sick, 0.250)
+            tracker.record(other, 0.005)
+    for _ in range(3):                    # bounded: min_windows polls
+        report = doctor.poll_once()
+    flagged = report["datanodes"]["flagged"]
+    assert list(flagged) == [sick], flagged
+    ev = flagged[sick]["signals"]["dn.pipeline_ack"]
+    assert ev["windows_flagged"] >= 3
+    # the report links the node's thread dump (and the link works)
+    stacks_url = flagged[sick]["stacks"]
+    port = int(stacks_url.rsplit(":", 1)[1].split("/", 1)[0])
+    assert _get_json(port, "/ws/v1/stacks")["num_threads"] > 0
+
+    # the doctor door serves the same verdict
+    js = _get_json(doctor.port, "/ws/v1/fleet/doctor")
+    assert list(js["datanodes"]["flagged"]) == [sick]
+
+    # NN consumed the push: placement deprioritizes the flagged DN
+    dm = cluster.namenode.fsn.bm.dn_manager
+    assert sick in dm.slow_node_uuids()
+    for _ in range(8):
+        targets = dm.choose_targets(2, set())
+        assert sick not in [t.uuid for t in targets]
+    # ...but a pipeline WIDER than the healthy pool still places
+    assert len(dm.choose_targets(3, set())) == 3
+    # NN roster marks it for operators
+    roster = _get_json(cluster.namenode.http.port, "/ws/v1/datanodes")
+    assert {d["uuid"]: d["slow"] for d in roster["datanodes"]}[sick]
+
+    # ---- phase 2: exemplar -> assembled cross-daemon trace
+    tracer = global_tracer()
+    with tracer.span("e2e.traced_read") as root:
+        assert fs.read_all("/warm0.bin")
+    # the xceiver's read histogram recorded inside the resumed span:
+    # its bucket exemplar IS this trace
+    found = None
+    debug = []
+    for dn in cluster.datanodes:
+        _, body = _get(dn.http.port, "/prom")
+        debug += [l for l in body.decode().splitlines()
+                  if "read_block_seconds_bucket" in l and "#" in l]
+        for m in re.finditer(
+                r'htpu_read_block_seconds_bucket\{[^}]*\} \d+ '
+                r'# \{trace_id="([0-9a-f]+)"\}', body.decode()):
+            if int(m.group(1), 16) == root.trace_id:
+                found = m.group(1)
+        if found:
+            break
+    assert found, (f"no exemplar for trace {root.trace_id:016x}; "
+                   f"saw {debug}")
+    # the DECIMAL form (what span JSON prints) must resolve too — the
+    # fleet endpoint tries the same candidate set per-daemon handlers do
+    assert _get_json(doctor.port,
+                     f"/ws/v1/fleet/traces/{root.trace_id}")
+    assembled = _get_json(doctor.port, f"/ws/v1/fleet/traces/{found}")
+    names = {s["name"] for s in _names(assembled)}
+    assert "e2e.traced_read" in names            # client plane
+    assert any(n.startswith("namenode.") for n in names)   # NN plane
+    assert "dfs.xceiver.read_block" in names     # DN plane
+    assert assembled["critical_path"], "no critical-path summary"
+    # list endpoint knows it now; bad ids are rejected loudly
+    listed = _get_json(doctor.port, "/ws/v1/fleet/traces")
+    assert found in listed["traces"]
+    status, _ = _get(doctor.port, "/ws/v1/fleet/traces/zzz!")
+    assert status == 400
+    status, _ = _get(doctor.port, f"/ws/v1/fleet/traces/{'f' * 16}")
+    assert status == 404
+
+    # ---- phase 3: recovery — the node stops being slow, and the NN
+    # clears IMMEDIATELY on the doctor's next (empty) full report, not
+    # after the TTL
+    for reporter in (0, 1):
+        tracker = cluster.datanodes[reporter].xceiver.peer_tracker
+        for _ in range(tracker.window):     # flush the injected slowness
+            tracker.record(sick, 0.004)
+    for _ in range(5):                      # clean windows push out slow
+        report = doctor.poll_once()
+    assert report["datanodes"]["flagged"] == {}
+    assert sick not in dm.slow_node_uuids(), \
+        "recovered DN still deprioritized (empty report never pushed)"
+
+
+def test_nn_audit_log_lines(doctor_cluster, caplog):
+    """One structured tab-separated line per namespace op on the
+    existing ``hadoop_tpu.audit`` plane — success lines gain status=ok
+    + trace_id (joined to the telemetry plane), failed RPCs gain their
+    own failure line from the facade auditor, and the whole stream
+    stays dynamometer-parseable."""
+    from hadoop_tpu.tools.dynamometer import parse_audit_line
+    cluster, fs, doctor = doctor_cluster
+    tracer = global_tracer()
+    with caplog.at_level(logging.INFO, logger="hadoop_tpu.audit"):
+        with tracer.span("audit.probe") as root:
+            fs.mkdirs("/audited-dir")
+        with pytest.raises(FileNotFoundError):
+            fs.read_all("/no-such-file")
+    lines = [r.getMessage() for r in caplog.records
+             if r.name == "hadoop_tpu.audit"]
+    mk = [parse_audit_line(l) for l in lines if "cmd=mkdirs" in l]
+    assert mk, lines
+    ev = mk[-1]
+    assert ev["src"] == "/audited-dir" and ev["allowed"] == "true"
+    assert ev["status"] == "ok"
+    assert ev["trace_id"] == f"{root.trace_id:016x}"
+    assert ev["ugi"] and ev["ip"]
+    failed = [parse_audit_line(l) for l in lines if "failed" in l]
+    assert any(e["src"] == "/no-such-file" and
+               e["status"].startswith("failed(") and
+               e["cmd"] == "get_block_locations" for e in failed), lines
+
+
+def test_slow_node_push_reaches_every_configured_namenode(
+        doctor_cluster):
+    """HA posture: the doctor pushes its report to EVERY address in
+    dfs.namenode.rpc-address (the DN's one-actor-per-NN precedent) and
+    tolerates dead members — a push that only ever reached the first
+    (possibly standby) NN would silently defeat placement
+    deprioritization."""
+    from hadoop_tpu.obs.doctor import FleetDoctor
+    cluster, fs, doctor = doctor_cluster
+    dconf = Configuration(load_defaults=False)
+    # first address is a corpse; the real NN is second
+    dconf.set("dfs.namenode.rpc-address",
+              f"127.0.0.1:1,127.0.0.1:{cluster.namenode.port}")
+    doc2 = FleetDoctor(dconf)
+    doc2.init(dconf)             # no start(): push driven directly
+    try:
+        doc2._push_slow_nodes(["ha-probe-uuid"])
+        dm = cluster.namenode.fsn.bm.dn_manager
+        assert "ha-probe-uuid" in dm.slow_node_uuids()
+        doc2._push_slow_nodes([])        # the full-report clear
+        assert "ha-probe-uuid" not in dm.slow_node_uuids()
+    finally:
+        doc2.stop()
+
+
+def test_discovery_skips_stale_registry_records():
+    """Corpse replicas (heartbeat stamp older than the record TTL)
+    cost bounded-timeout scrapes EVERY poll — discovery skips them,
+    the router/autoscaler precedent."""
+    from hadoop_tpu.obs.doctor import FleetDoctor
+    from hadoop_tpu.registry.registry import (HEARTBEAT_ATTR,
+                                              RegistryServer,
+                                              ServiceRecord)
+    conf = Configuration(load_defaults=False)
+    reg = RegistryServer(conf)
+    reg.init(conf)
+    reg.start()
+    try:
+        reg.put(ServiceRecord(
+            "/services/s/fresh", {"http": "127.0.0.1:1234"},
+            {HEARTBEAT_ATTR: f"{time.time():.3f}"}), ttl_s=60.0)
+        reg.put(ServiceRecord(
+            "/services/s/corpse", {"http": "127.0.0.1:1235"},
+            {HEARTBEAT_ATTR: f"{time.time() - 3600:.3f}"}), ttl_s=60.0)
+        reg.put(ServiceRecord(           # pre-heartbeat publisher:
+            "/services/s/legacy", {"http": "127.0.0.1:1236"},
+            {}), ttl_s=60.0)             # never stale by contract
+        dconf = Configuration(load_defaults=False)
+        dconf.set("obs.doctor.registry", f"127.0.0.1:{reg.port}")
+        dconf.set("obs.doctor.service", "/services/s")
+        doc = FleetDoctor(dconf)
+        doc.init(dconf)
+        try:
+            names = {e.name for e in doc.discover()}
+            assert names == {"/services/s/fresh", "/services/s/legacy"}
+        finally:
+            doc.stop()
+    finally:
+        reg.stop()
+
+
+def test_nn_top_shows_rpc_callers(doctor_cluster):
+    """nntop over the NN's decay scheduler: the test user's calls rank
+    on /ws/v1/top without any nntop-private counter."""
+    cluster, fs, doctor = doctor_cluster
+    for i in range(5):
+        fs.exists("/warm0.bin")
+    js = _get_json(cluster.namenode.http.port, "/ws/v1/top")
+    nn_sources = [s for s in js["sources"]
+                  if s.startswith("namenode.") and
+                  s.endswith("rpc.callers")]
+    assert nn_sources, js["sources"].keys()
+    window = js["sources"][nn_sources[0]]["window"]
+    assert window and window[0]["cost"] > 0
+
+
+def test_audit_toggle(tmp_path):
+    """namenode.audit.enable (default on, the seed's behavior) gates
+    BOTH halves of the plane: the facade install and the success-line
+    call sites."""
+    from hadoop_tpu.dfs.namenode.audit import (AuditedClientProtocol,
+                                               maybe_audited)
+    from hadoop_tpu.dfs.namenode import fsnamesystem as fsn_mod
+    conf = Configuration(load_defaults=False)
+    sentinel = object()
+    assert isinstance(maybe_audited(sentinel, conf),
+                      AuditedClientProtocol)
+    conf.set("namenode.audit.enable", "false")
+    assert maybe_audited(sentinel, conf) is sentinel
+    records = []
+    handler = logging.Handler()
+    handler.emit = lambda r: records.append(r)
+    fsn_mod.audit_log.addHandler(handler)
+    try:
+        fsn_mod.set_audit_enabled(False)
+        fsn_mod.log_audit_event(True, "mkdirs", "/x")
+        assert records == []
+        fsn_mod.set_audit_enabled(True)
+        fsn_mod.log_audit_event(True, "mkdirs", "/x")
+        assert len(records) == 1
+    finally:
+        fsn_mod.audit_log.removeHandler(handler)
+        fsn_mod.set_audit_enabled(True)

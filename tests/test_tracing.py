@@ -219,7 +219,9 @@ def test_traces_endpoint_accepts_hex_and_decimal_trace_ids():
 _PROM_LINE = re.compile(
     r"^(?:# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .*"
     r"|[a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^}]*\})? "
-    r"(?:[-+]?[0-9.eE+-]+|\+Inf|-Inf|NaN))$")
+    r"(?:[-+]?[0-9.eE+-]+|\+Inf|-Inf|NaN)"
+    # optional OpenMetrics exemplar on histogram _bucket lines
+    r"(?: # \{[^}]*\} [-+]?[0-9.eE+-]+(?: [0-9.]+)?)?)$")
 
 
 def _assert_parseable_prom(text):
