@@ -9,8 +9,16 @@ blocking (host-snapshot) and background (DFS write) halves
 noise by construction (the pass only changes collectives); on a
 multichip plan it is the recovered communication time.
 
+``--parity both`` adds the relaxed-tier rung (parallel/lowp):
+quantized collective payloads + the true chunked collective matmul,
+timed beside the bitwise step, with the trace-time comm-byte ledger
+(payload vs reference bytes per step) in the row — so every future
+run of the ladder prices BOTH tiers. ``--guard-steps N`` additionally
+runs the loss-curve A-B acceptance over N training steps and records
+the verdict in the JSON.
+
   python -m benchmarks.profile_train --preset tiny --seq 512 \
-      --dp 2 --tp 2 --overlap both --ckpt both
+      --dp 2 --tp 2 --overlap both --ckpt both --parity both
 """
 
 from __future__ import annotations
@@ -98,6 +106,12 @@ def main():
     ap.add_argument("--overlap", default="on",
                     choices=["on", "off", "both"],
                     help="communication-overlap pass A-B mode")
+    ap.add_argument("--parity", default="bitwise",
+                    choices=["bitwise", "relaxed", "both"],
+                    help="parity tier rungs (parallel/lowp)")
+    ap.add_argument("--guard-steps", type=int, default=0,
+                    help="also run the relaxed loss-curve A-B guard "
+                         "over this many steps (0 = skip)")
     ap.add_argument("--ckpt", default="none",
                     choices=["none", "sync", "async", "both"],
                     help="include a checkpoint blocking-time breakdown")
@@ -143,6 +157,14 @@ def main():
                 return _loss_from_h(p, h, targets, cfg, ctx)
             return jax.value_and_grad(f)(params)
 
+        from hadoop_tpu.parallel.lowp import (BITWISE_PARITY,
+                                              RELAXED_PARITY)
+        from hadoop_tpu.parallel.lowp.quant import capture_comm
+        parities = {"bitwise": [("", BITWISE_PARITY)],
+                    "relaxed": [("parity-relaxed_", RELAXED_PARITY)],
+                    "both": [("", BITWISE_PARITY),
+                             ("parity-relaxed_", RELAXED_PARITY)]}[
+            args.parity]
         row: dict = {"batch": batch}
         # single-trace components are only meaningful single-device (no
         # collectives outside shard_map); skip them on multichip plans
@@ -151,18 +173,31 @@ def main():
                 timeit(fwd_only, params, tokens, targets) * 1e3, 1)
             t_fb = timeit(fwd_bwd, params, tokens, targets)
             row["bwd_ms"] = round(t_fb * 1e3 - row["fwd_ms"], 1)
-        for label, ov in overlaps:
-            try:
-                step = make_train_step(cfg, plan, mesh, remat=remat,
-                                       donate=False, overlap=ov)
-                t_full = timeit(step, params, opt, tokens, targets)
-            except Exception as e:  # noqa: BLE001 — a step that cannot
-                # run on this backend (e.g. no vma tracking) is a data
-                # point; the fwd/bwd and ckpt numbers must still land
-                row[label + "_error"] = f"{type(e).__name__}"
-                continue
-            row[label + "_ms"] = round(t_full * 1e3, 1)
-            row[label + "_tok_s"] = round(batch * args.seq / t_full)
+        for plabel, par in parities:
+            for olabel, ov in overlaps:
+                if par.relaxed and not ov.enabled:
+                    # relaxed rides the overlap pass's collectives;
+                    # make_train_step refuses the combination
+                    continue
+                label = plabel + olabel
+                try:
+                    with capture_comm() as ledger:
+                        step = make_train_step(cfg, plan, mesh,
+                                               remat=remat,
+                                               donate=False, overlap=ov,
+                                               parity=par)
+                        t_full = timeit(step, params, opt, tokens,
+                                        targets)
+                except Exception as e:  # noqa: BLE001 — a step that
+                    # cannot run on this backend (e.g. no vma tracking)
+                    # is a data point; the fwd/bwd and ckpt numbers
+                    # must still land
+                    row[label + "_error"] = f"{type(e).__name__}"
+                    continue
+                row[label + "_ms"] = round(t_full * 1e3, 1)
+                row[label + "_tok_s"] = round(batch * args.seq / t_full)
+                if par.relaxed and ledger.sites:
+                    row[label + "_comm"] = ledger.report()
         if "fwd_ms" in row and "overlap-on_ms" in row:
             # optimizer + (unoverlapped) comm residue: what the full
             # step spends beyond fwd+bwd compute
@@ -174,6 +209,25 @@ def main():
         report["batches"].append(row)
         if not args.json:
             print(" ".join(f"{k}={v}" for k, v in row.items()))
+
+    if args.guard_steps > 0:
+        # loss-curve A-B acceptance (parallel/lowp/guard.py): the
+        # relaxed trajectory must stay within the bounded divergence
+        # of its bitwise twin. Recorded verbatim in the JSON.
+        from hadoop_tpu.parallel.lowp.guard import run_loss_ab
+        try:
+            report["parity_guard"] = run_loss_ab(
+                plan, preset=args.preset, steps=args.guard_steps,
+                seq=min(args.seq, 128))
+        except Exception as e:  # noqa: BLE001 — a backend that cannot
+            # run the step records the gap instead of dying
+            report["parity_guard"] = {"error": f"{type(e).__name__}"}
+        if not args.json:
+            pg = report["parity_guard"]
+            print("parity_guard " + " ".join(
+                f"{k}={pg[k]}" for k in ("accepted", "max_rel_div",
+                                         "final_rel_div", "reason")
+                if k in pg))
 
     if args.ckpt != "none":
         report["ckpt"] = ckpt_breakdown(params, opt, args.ckpt)

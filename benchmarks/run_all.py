@@ -142,6 +142,17 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — recorded for the
         # trajectory; must not discard the benches already computed
         out["overlap"] = {"error": f"{type(e).__name__}: {e}"}
+    # Relaxed-parity plane: loss-curve A-B acceptance (dp2×tp2 +
+    # zero1-dp8 + pp grad buckets, 50 steps) with the ≥2× quantized
+    # payload-byte contract and the bitwise-tier byte-identity proof.
+    # Both tiers ride every future run of this ladder. Recorded, not
+    # raised.
+    try:
+        from benchmarks import lowp_smoke
+        out["lowp"] = lowp_smoke.run()
+    except Exception as e:  # noqa: BLE001 — recorded for the
+        # trajectory; must not discard the benches already computed
+        out["lowp"] = {"error": f"{type(e).__name__}: {e}"}
     # Telemetry plane: tracing-on vs tracing-off step + DFS write/read
     # cost, with the <5% step-overhead bound recorded in the JSON
     # (exemplar bookkeeping now rides the on-arm — same bound).

@@ -25,6 +25,11 @@ as pluggable checkers over a shared parsed-module project:
              conflicting type is silently dropped), and prom label
              values not drawn from a bounded literal set (a label from
              request/user data mints one series per distinct value).
+``parity/*`` parity-tier discipline: quantized-collective and
+             chunked-matmul entry points (the relaxed plane,
+             parallel/lowp) may only be reached under a lexical guard
+             naming the relaxed tier, so parallel.parity=bitwise
+             provably compiles byte-identical graphs.
 
 Entry points: ``hadoop-tpu lint`` and ``python -m hadoop_tpu.analysis``.
 Findings are suppressible per line with ``# lint: disable=<id>`` or via a
@@ -38,6 +43,7 @@ from hadoop_tpu.analysis.jitcheck import (JitDisciplineChecker,
                                           StepBlockingChecker)
 from hadoop_tpu.analysis.lockcheck import GuardedByChecker, LockOrderChecker
 from hadoop_tpu.analysis.metricscheck import PromFamilyChecker
+from hadoop_tpu.analysis.paritycheck import RelaxedGateChecker
 from hadoop_tpu.analysis.rpccheck import (RetryHygieneChecker,
                                           SilentSwallowChecker,
                                           TimeoutChecker)
@@ -49,7 +55,7 @@ def all_checkers():
     return [GuardedByChecker(), LockOrderChecker(), JitDisciplineChecker(),
             StepBlockingChecker(), TimeoutChecker(), RetryHygieneChecker(),
             SilentSwallowChecker(), SpanFinishChecker(),
-            PromFamilyChecker()]
+            PromFamilyChecker(), RelaxedGateChecker()]
 
 
 __all__ = ["Finding", "Project", "SourceModule", "run_lint",
@@ -57,4 +63,5 @@ __all__ = ["Finding", "Project", "SourceModule", "run_lint",
            "LockOrderChecker", "JitDisciplineChecker",
            "StepBlockingChecker", "TimeoutChecker",
            "RetryHygieneChecker", "SilentSwallowChecker",
-           "SpanFinishChecker", "PromFamilyChecker"]
+           "SpanFinishChecker", "PromFamilyChecker",
+           "RelaxedGateChecker"]

@@ -1,0 +1,126 @@
+"""Parity-tier discipline — the relaxed plane stays behind its gate.
+
+``parity/relaxed-gated`` — a call to a quantized-collective or
+chunked-matmul entry point (the relaxed parity tier,
+``hadoop_tpu/parallel/lowp``) that is not lexically inside a guard
+naming the relaxed tier. The tier's whole contract is that
+``parallel.parity=bitwise`` (the default) compiles byte-identical
+graphs with zero lowp code reachable; one unguarded call site quietly
+quantizes a collective for every user and turns the bitwise parity
+tests into liars. The guard is judged lexically: some enclosing ``if``
+(or ternary) whose test mentions an identifier containing ``relaxed``
+— ``if ctx.relaxed_codec is not None:``, ``if relaxed is not None:``,
+``if parity.relaxed:`` all qualify — which is also why the tier's
+plumbing NAMES everything ``relaxed``. Definitions inside the lowp
+package itself are exempt (they are the tier).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from hadoop_tpu.analysis.core import (Checker, Finding, SourceModule,
+                                      attr_chain)
+
+# the relaxed tier's entry points: the in-graph quantized collectives
+# (parallel/lowp/quant.py) and the reassociating chunked matmul
+# (ops/collective_matmul.py). Matched by trailing name so both
+# `psum_quantized(...)` and `quant.psum_quantized(...)` resolve.
+ENTRY_POINTS = frozenset({
+    "psum_quantized",
+    "psum_scatter_quantized",
+    "psum_of_scatter_quantized",
+    "chunked_matmul_reduce",
+})
+
+_LOWP_PKG = "hadoop_tpu.parallel.lowp"
+
+
+def _mentions_relaxed(test: ast.AST) -> bool:
+    """Does the guard expression name the relaxed tier? Any identifier
+    (Name, attribute, keyword-arg name, string constant) containing
+    "relaxed" counts."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and "relaxed" in node.id.lower():
+            return True
+        if isinstance(node, ast.Attribute) and \
+                "relaxed" in node.attr.lower():
+            return True
+        if isinstance(node, ast.keyword) and node.arg and \
+                "relaxed" in node.arg.lower():
+            return True
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and \
+                "relaxed" in node.value.lower():
+            return True
+    return False
+
+
+class RelaxedGateChecker(Checker):
+    name = "parity"
+    ids = ("parity/relaxed-gated",)
+
+    def check_module(self, mod: SourceModule) -> List[Finding]:
+        if mod.dotted == _LOWP_PKG or \
+                mod.dotted.startswith(_LOWP_PKG + "."):
+            return []   # the tier itself
+        findings: List[Finding] = []
+        # entry points stay entry points under a rename
+        # (`from ...lowp.quant import psum_quantized as pq`); other
+        # lowp symbols (ParityConfig, the guard harness, the host
+        # payload codec) are tier PLUMBING, not quantized paths
+        imported: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.module and \
+                    node.module.startswith(_LOWP_PKG):
+                for alias in node.names:
+                    if alias.name in ENTRY_POINTS:
+                        imported.add(alias.asname or alias.name)
+        self._walk(mod, mod.tree, imported, guarded=False,
+                   findings=findings)
+        return findings
+
+    # --------------------------------------------------------------- walk
+
+    def _walk(self, mod: SourceModule, node: ast.AST, imported: Set[str],
+              guarded: bool, findings: List[Finding]) -> None:
+        """Recursive descent carrying whether a relaxed-naming guard
+        encloses the current position. Only `if`/ternary tests open a
+        guard; everything else propagates the flag."""
+        for child in ast.iter_child_nodes(node):
+            child_guarded = guarded
+            if isinstance(child, ast.If) and \
+                    _mentions_relaxed(child.test):
+                # both arms: the else of `if not ...relaxed...: return`
+                # style early-outs is still tier-aware code; flagging
+                # the else arm would force contortions for no safety
+                child_guarded = True
+            if isinstance(child, ast.IfExp) and \
+                    _mentions_relaxed(child.test):
+                child_guarded = True
+            if isinstance(child, ast.Call):
+                name = self._entry_name(child, imported)
+                if name is not None and not child_guarded:
+                    f = mod.finding(
+                        child, "parity/relaxed-gated",
+                        f"relaxed-tier entry point {name}() reached "
+                        f"without a relaxed-parity guard — quantized "
+                        f"collectives / chunked matmul must be "
+                        f"unreachable under parallel.parity=bitwise "
+                        f"(enclose in an `if ...relaxed...:` branch)")
+                    if f is not None:
+                        findings.append(f)
+            self._walk(mod, child, imported, child_guarded, findings)
+
+    def _entry_name(self, call: ast.Call,
+                    imported: Set[str]) -> Optional[str]:
+        chain = attr_chain(call.func)
+        if not chain:
+            return None
+        tail = chain[-1]
+        if tail in ENTRY_POINTS:
+            return tail
+        if len(chain) == 1 and chain[0] in imported:
+            return chain[0]   # renamed entry-point import
+        return None

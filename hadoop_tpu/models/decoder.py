@@ -61,6 +61,16 @@ class ParallelCtx:
     # so the collective overlaps the matmul (ops/collective_matmul.py);
     # 1 = the classic single whole-tensor psum/psum_scatter
     tp_overlap_chunks: int = 1
+    # relaxed parity tier (parallel/lowp): when set, row-parallel tp
+    # reduces quantize their wire payload to this codec ("int8"|"fp8")
+    # — values become allclose, never bitwise. None (the default) is
+    # the bitwise tier: no lowp code is reachable.
+    relaxed_codec: Optional[str] = None
+    # relaxed tier only: chunk the row-parallel MATMUL itself so each
+    # chunk's product pipelines against its reduce (T3-style). The
+    # backward's weight-grad contraction reassociates — illegal under
+    # the bitwise contract, covered by the lowp loss-curve guard.
+    relaxed_chunk_matmul: bool = False
 
     @property
     def seq_offset_fn(self):

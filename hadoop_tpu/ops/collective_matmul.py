@@ -12,18 +12,31 @@ scheduler pipelines them with the neighbouring compute (the residual
 add, the next block's norm/matmul — and, in the backward, the
 per-chunk gather transposes against the weight-gradient matmuls).
 
-Why the MATMUL stays whole: chunking the forward product is value-exact,
-but its autodiff transpose accumulates the weight gradient as a sum of
-per-chunk contractions — a reassociation that moves the loss by an ulp
-and breaks the bit-exact parity contract this pass is built on
-(measured on the CPU mesh). Chunking only the collective keeps every
-matmul, scatter and add in the exact shape/order of the unchunked
-graph in BOTH directions:
+Two parity tiers (``parallel.parity``, parallel/lowp):
 
-- forward: ``slice_c(y)`` chunks are disjoint rows of the same product;
-  each element rides exactly one psum/psum_scatter over the same ranks.
-- backward: transpose of the chunked concat/slice is a disjoint scatter
-  (exact), and the weight/input gradients remain single whole matmuls.
+- **bitwise** (default): only the COLLECTIVE is chunked. Chunking the
+  forward product is value-exact, but its autodiff transpose
+  accumulates the weight gradient as a sum of per-chunk contractions —
+  a reassociation that moves the loss by an ulp and breaks the
+  bit-exact parity contract (measured on the CPU mesh). Chunking only
+  the collective keeps every matmul, scatter and add in the exact
+  shape/order of the unchunked graph in BOTH directions:
+
+  - forward: ``slice_c(y)`` chunks are disjoint rows of the same
+    product; each element rides exactly one psum/psum_scatter over the
+    same ranks.
+  - backward: transpose of the chunked concat/slice is a disjoint
+    scatter (exact), and the weight/input gradients remain single
+    whole matmuls.
+
+- **relaxed** (``ctx.relaxed_codec`` / ``ctx.relaxed_chunk_matmul``):
+  the reduce's wire payload quantizes to int8/fp8 with a shared
+  per-tensor scale (activations inside one layer are magnitude-
+  homogeneous), and :func:`chunked_matmul_reduce` chunks the MATMUL
+  too — per-chunk product pipelined against per-chunk reduce, the
+  T3-style interleave (arxiv 2401.16677) the bitwise tier had to
+  defer. The weight-grad reassociation is covered by the lowp
+  loss-curve guard instead of forbidden.
 
 Composition with Megatron sequence parallelism: ``psum_scatter``
 scatters the SEQUENCE dimension, so under sp the chunks ride the batch
@@ -46,20 +59,34 @@ def _largest_divisor(n: int, want: int) -> int:
     return 1
 
 
+def _reduce_one(t, ctx):
+    """One chunk's tp reduction — psum, or psum_scatter(seq) under
+    megatron_sp — on the tier ``ctx`` names: exact collectives under
+    bitwise, quantized wire payloads under relaxed."""
+    if ctx.relaxed_codec is not None:  # relaxed tier: quantized wire
+        from hadoop_tpu.parallel.lowp.quant import (RelaxedQuant,
+                                                    psum_quantized,
+                                                    psum_scatter_quantized)
+        rq = RelaxedQuant(codec=ctx.relaxed_codec,
+                          mesh_axis_sizes={ctx.tp_axis: ctx.tp_size})
+        if ctx.megatron_sp:
+            return psum_scatter_quantized(
+                t, ctx.tp_axis, rq, scatter_dimension=1, scale="tensor",
+                site="tp.scatter")
+        return psum_quantized(t, (ctx.tp_axis,), rq, scale="tensor",
+                              site="tp.psum")
+    if ctx.megatron_sp:
+        return jax.lax.psum_scatter(t, ctx.tp_axis,
+                                    scatter_dimension=1, tiled=True)
+    return jax.lax.psum(t, ctx.tp_axis)
+
+
 def reduce_row_parallel(y, ctx):
-    """The row-parallel reduce — psum, or psum_scatter(seq) under
-    megatron_sp — issued in ``ctx.tp_overlap_chunks`` chunks along a
-    non-contraction dim. Identity when tp is absent; one whole-tensor
-    collective when chunking is off (the classic form)."""
+    """The row-parallel reduce issued in ``ctx.tp_overlap_chunks``
+    chunks along a non-contraction dim. Identity when tp is absent; one
+    whole-tensor collective when chunking is off (the classic form)."""
     if ctx.tp_axis is None:
         return y
-
-    def reduce_one(t):
-        if ctx.megatron_sp:
-            return jax.lax.psum_scatter(t, ctx.tp_axis,
-                                        scatter_dimension=1, tiled=True)
-        return jax.lax.psum(t, ctx.tp_axis)
-
     n_chunks = getattr(ctx, "tp_overlap_chunks", 1)
     # megatron_sp scatters dim 1 (sequence) — chunk dim 0 (batch) so
     # each chunk's scatter is a sub-block of the full scatter; plain tp
@@ -67,12 +94,43 @@ def reduce_row_parallel(y, ctx):
     axis = 0 if ctx.megatron_sp else 1
     c = _largest_divisor(y.shape[axis], n_chunks) if n_chunks > 1 else 1
     if c <= 1:
-        return reduce_one(y)
+        return _reduce_one(y, ctx)
     step = y.shape[axis] // c
     outs = []
     for i in range(c):
-        outs.append(reduce_one(
-            jax.lax.dynamic_slice_in_dim(y, i * step, step, axis=axis)))
+        outs.append(_reduce_one(
+            jax.lax.dynamic_slice_in_dim(y, i * step, step, axis=axis),
+            ctx))
+    return jnp.concatenate(outs, axis=axis)
+
+
+def chunked_matmul_reduce(x, w, ctx, bias: Optional[jax.Array] = None):
+    """True chunked collective matmul (T3-style): per-chunk product
+    pipelined against per-chunk reduce. RELAXED-TIER ENTRY POINT — the
+    forward chunks are disjoint rows of the same product (value-exact),
+    but the backward accumulates the weight gradient as a sum of
+    per-chunk ``x_cᵀ @ dy_c`` contractions, a reassociation only the
+    lowp loss-curve guard covers. tpulint's ``parity/relaxed-gated``
+    checker keeps every call site behind a relaxed-tier guard.
+
+    ``bias`` (replicated) is added to each chunk's PARTIAL product,
+    exactly where the unchunked path adds it to the whole one."""
+    axis = 0 if ctx.megatron_sp else 1
+    want = max(2, getattr(ctx, "tp_overlap_chunks", 1))
+    c = _largest_divisor(x.shape[axis], want)
+    if c <= 1:
+        y = x @ w
+        if bias is not None:
+            y = y + bias
+        return _reduce_one(y, ctx)
+    step = x.shape[axis] // c
+    outs = []
+    for i in range(c):
+        xi = jax.lax.dynamic_slice_in_dim(x, i * step, step, axis=axis)
+        yi = xi @ w
+        if bias is not None:
+            yi = yi + bias
+        outs.append(_reduce_one(yi, ctx))
     return jnp.concatenate(outs, axis=axis)
 
 
@@ -81,6 +139,9 @@ def row_parallel_project(x, w, ctx, bias: Optional[jax.Array] = None):
     attention out-projection and MLP down-projection. ``bias``
     (replicated) is added to the PARTIAL product exactly like the
     unchunked code paths did, preserving their numerics verbatim."""
+    if ctx.relaxed_chunk_matmul and ctx.tp_axis is not None:
+        # relaxed tier: matmul and collective interleave per chunk
+        return chunked_matmul_reduce(x, w, ctx, bias=bias)
     y = x @ w
     if bias is not None:
         y = y + bias

@@ -20,8 +20,16 @@ XLA collectives ride ICI:
                        ``lax.all_to_all`` record exchange, sampled range
                        partitioning, global device sort (consumed by
                        ``mapreduce.device_shuffle``)
+- ``overlap``        — communication overlap (bucketed/chunked
+                       collectives, bit-exact, default on)
+- ``lowp``           — the relaxed parity tier: quantized collective
+                       payloads, true chunked collective matmul,
+                       loss-curve A-B acceptance (``parallel.parity``)
 """
 
+from hadoop_tpu.parallel.lowp import (BITWISE_PARITY, RELAXED_PARITY,
+                                      ParityConfig, parity_from_conf)
 from hadoop_tpu.parallel.mesh import MeshPlan, make_mesh, param_specs
 
-__all__ = ["MeshPlan", "make_mesh", "param_specs"]
+__all__ = ["MeshPlan", "make_mesh", "param_specs", "ParityConfig",
+           "parity_from_conf", "BITWISE_PARITY", "RELAXED_PARITY"]

@@ -1,0 +1,122 @@
+"""Relaxed-parity plane: parity tiers for the communication stack.
+
+Every transform in the overlap pass (parallel/overlap.py,
+ops/collective_matmul.py) ships under a BIT-EXACT contract: same
+per-element sums, same matmul shapes, byte-identical losses. That
+contract is what made the pass safe to turn on by default — and what
+put the best remaining levers off the table. Chunking the collective
+matmul reassociates the weight-grad contraction (measured, PROFILE.md);
+quantizing a gradient bucket to int8 moves every element. Neither can
+ever pass a bitwise A-B.
+
+This package is the second tier. ``parallel.parity`` names the
+contract the train step is built under:
+
+- ``bitwise`` (the default): exactly today's behavior. No lowp code
+  executes, no quantizer is imported on the hot path, and every
+  existing parity test stays byte-identical.
+- ``relaxed``: collectives may trade bits for bytes and schedule.
+  Correctness is guarded statistically instead of bit-wise — allclose
+  guards on values (:mod:`guard`) and a loss-curve A-B acceptance
+  (N training steps relaxed vs bitwise, bounded trajectory
+  divergence) recorded in the bench JSON.
+
+Under the relaxed tier three consumer families light up (Flash
+Communication, arXiv:2412.04964; T3, arXiv:2401.16677):
+
+1. **Quantized gradient buckets** — the overlap pass's bucketed
+   psum / psum_scatter payloads ride the wire as int8 (or emulated
+   fp8) with shared f32 scales; the ZeRO-1 param reassembly's
+   psum-of-disjoint-scatters quantizes at full int8 range (exactly
+   one rank contributes per element). ≥2× fewer collective payload
+   bytes, proven by the trace-time comm ledger (:mod:`quant`).
+2. **Quantized chunked TP reduces** — the row-parallel reduce in
+   ops/collective_matmul.py quantizes each chunk's psum/psum_scatter
+   with a per-tensor scale.
+3. **True chunked collective matmul** — per-chunk matmul pipelined
+   against per-chunk reduce (T3-style compute/collective
+   interleaving). The forward is value-exact (disjoint row chunks);
+   the backward's weight-grad reassociation is covered by the
+   loss-curve guard instead of forbidden by the bitwise contract.
+
+Conf keys (read by :func:`parity_from_conf`):
+
+  parallel.parity                   bitwise | relaxed   (default bitwise)
+  parallel.lowp.codec               int8 | fp8          (default int8)
+  parallel.lowp.quant.buckets       default true  (consumer 1, grads)
+  parallel.lowp.quant.zero1-gather  default true  (consumer 1, params)
+  parallel.lowp.quant.tp            default true  (consumer 2)
+  parallel.lowp.chunk-matmul        default true  (consumer 3)
+  parallel.lowp.quant.group         default 1024  (scale granularity)
+  parallel.lowp.guard.steps         default 50    (loss-curve A-B length)
+  parallel.lowp.guard.rel-tol       default 0.25  (max per-step rel div)
+
+tpulint's ``parity/relaxed-gated`` checker enforces the tiering
+statically: any call to a quantized-collective or chunked-matmul entry
+point outside this package must sit under a lexical guard that names
+the relaxed tier, so the bitwise paths are provably untouched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+PARITY_KEY = "parallel.parity"
+TIERS = ("bitwise", "relaxed")
+WIRE_CODECS = ("int8", "fp8")
+
+
+@dataclasses.dataclass(frozen=True)
+class ParityConfig:
+    """Static parity-tier knobs, fixed at train-step build time.
+
+    ``tier == "bitwise"`` disables every consumer regardless of the
+    per-consumer flags — the flags describe what the relaxed tier
+    quantizes, not whether the tier is on.
+    """
+    tier: str = "bitwise"
+    codec: str = "int8"               # int8 | fp8 (emulated)
+    quant_buckets: bool = True        # grad bucket psum / psum_scatter
+    quant_zero1_gather: bool = True   # ZeRO-1 param reassembly
+    quant_tp: bool = True             # row-parallel tp reduces
+    chunk_matmul: bool = True         # true chunked collective matmul
+    group: int = 1024                 # elements per shared scale
+    guard_steps: int = 50
+    guard_rel_tol: float = 0.25
+
+    def __post_init__(self):
+        if self.tier not in TIERS:
+            raise ValueError(f"{PARITY_KEY} must be one of {TIERS}, "
+                             f"got {self.tier!r}")
+        if self.codec not in WIRE_CODECS:
+            raise ValueError(f"parallel.lowp.codec must be one of "
+                             f"{WIRE_CODECS}, got {self.codec!r}")
+
+    @property
+    def relaxed(self) -> bool:
+        return self.tier == "relaxed"
+
+
+BITWISE_PARITY = ParityConfig()
+RELAXED_PARITY = ParityConfig(tier="relaxed")
+
+
+def parity_from_conf(conf) -> ParityConfig:
+    """Build a ParityConfig from a Configuration (defaults above)."""
+    if conf is None:
+        return BITWISE_PARITY
+    return ParityConfig(
+        tier=conf.get(PARITY_KEY, "bitwise"),
+        codec=conf.get("parallel.lowp.codec", "int8"),
+        quant_buckets=conf.get_bool("parallel.lowp.quant.buckets", True),
+        quant_zero1_gather=conf.get_bool(
+            "parallel.lowp.quant.zero1-gather", True),
+        quant_tp=conf.get_bool("parallel.lowp.quant.tp", True),
+        chunk_matmul=conf.get_bool("parallel.lowp.chunk-matmul", True),
+        group=conf.get_int("parallel.lowp.quant.group", 1024),
+        guard_steps=conf.get_int("parallel.lowp.guard.steps", 50),
+        guard_rel_tol=conf.get_float("parallel.lowp.guard.rel-tol", 0.25))
+
+
+__all__ = ["ParityConfig", "parity_from_conf", "BITWISE_PARITY",
+           "RELAXED_PARITY", "PARITY_KEY", "TIERS", "WIRE_CODECS"]

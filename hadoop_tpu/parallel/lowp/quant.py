@@ -1,0 +1,490 @@
+"""Quantized collective payloads for the relaxed parity tier.
+
+Comm volume is the bottleneck Flash Communication (arXiv:2412.04964)
+attacks: a gradient bucket or row-parallel activation crossing ICI as
+float32 spends 4 bytes per element on values whose useful information
+is a few bits. Under ``parallel.parity=relaxed`` the collectives here
+replace the float payload with:
+
+- ``int8`` — symmetric quantization against SHARED scales: every
+  participating rank computes the same scale via a tiny ``pmax``
+  collective (one f32 per scale group), so the int8 payloads are
+  summable without an all_to_all re-layout. Overflow headroom is
+  carved out of the quantization range: with N summing ranks the
+  per-rank range is ``127 // N``, so the int8 accumulator can never
+  wrap; past 127 ranks the wire widens to int16 (``32767 // N``) —
+  still 2× under f32 — rather than silently wrapping. Payload:
+  1 byte/element + 4 bytes/group of scales.
+- ``fp8`` (emulated via ``float8_e4m3fn``) — values are normalized by
+  a shared per-group scale and cast to e4m3 for the wire; the sum runs
+  as an all_gather of fp8 payloads reduced locally in f32 (an in-wire
+  fp8 accumulation would cost more bits than it saves). On backends
+  without native f8 this is exactly what the emulation costs on real
+  hardware; the byte accounting is the same 1 byte/element.
+
+Every quantized collective records its payload bytes — and the bytes
+the float form would have moved — into the trace-time comm ledger
+(:func:`capture_comm`), which is how the bench rungs and tests prove
+the ≥2× reduction without instrumenting XLA.
+
+These functions are RELAXED-TIER ENTRY POINTS: tpulint's
+``parity/relaxed-gated`` checker requires every call site outside this
+package to sit under a lexical guard naming the relaxed tier, so the
+bitwise tier provably never reaches them.
+
+Host-side payload codec: :func:`encode_payload` / :func:`decode_payload`
+serialize a quantized array with a self-describing header (codec,
+dtype, shape) and fail loudly on any mismatch — the same contract as
+the serving KV block codec (serving/kvstore/codec.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import struct
+from contextlib import contextmanager
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hadoop_tpu.parallel.lowp import WIRE_CODECS
+
+_TINY = 1e-30          # scale floor: an all-zeros group stays exactly 0
+_F8_MAX = 240.0        # e4m3 headroom below the 448 format max
+_F8 = jnp.float8_e4m3fn if hasattr(jnp, "float8_e4m3fn") else None
+
+
+@dataclasses.dataclass(frozen=True)
+class RelaxedQuant:
+    """How a relaxed-tier collective quantizes its payload."""
+    codec: str = "int8"
+    group: int = 1024                     # elements per shared scale
+    mesh_axis_sizes: Mapping[str, int] = dataclasses.field(
+        default_factory=dict)
+
+    def __post_init__(self):
+        if self.codec not in WIRE_CODECS:
+            raise ValueError(f"relaxed wire codec must be one of "
+                             f"{WIRE_CODECS}, got {self.codec!r}")
+
+    def ranks(self, axes: Sequence[str]) -> int:
+        n = 1
+        for a in axes:
+            n *= self.mesh_axis_sizes.get(a, 1)
+        return n
+
+
+# ------------------------------------------------------------ comm ledger
+
+class CommLedger:
+    """Trace-time accounting of collective payload bytes.
+
+    ``payload_bytes`` is what the quantized collectives put on the wire
+    (int8/fp8 values + f32 scale exchanges); ``reference_bytes`` is
+    what the same collectives would have moved unquantized. Both are
+    static at trace time (shapes and dtypes are), so recording happens
+    while jit TRACES the step — capture must wrap the first call of a
+    freshly built step function (a jit cache hit records nothing).
+    """
+
+    def __init__(self):
+        self.payload_bytes = 0
+        self.reference_bytes = 0
+        self.sites: List[Tuple[str, int, int]] = []
+
+    def add(self, site: str, payload: int, reference: int) -> None:
+        self.payload_bytes += payload
+        self.reference_bytes += reference
+        self.sites.append((site, payload, reference))
+
+    @property
+    def ratio(self) -> float:
+        """reference / payload — ≥2.0 is the relaxed tier's contract."""
+        if self.payload_bytes == 0:
+            return float("inf") if self.reference_bytes else 1.0
+        return self.reference_bytes / self.payload_bytes
+
+    def report(self) -> Dict:
+        return {"payload_bytes": self.payload_bytes,
+                "reference_bytes": self.reference_bytes,
+                "ratio": round(self.ratio, 3) if self.payload_bytes
+                else None,
+                "sites": len(self.sites)}
+
+
+_ACTIVE_LEDGERS: List[CommLedger] = []
+
+
+@contextmanager
+def capture_comm():
+    """Collect quantized-collective byte counts recorded while tracing
+    happens inside the ``with`` (build the step fn AND call it once
+    inside — jit traces at the first call)."""
+    led = CommLedger()
+    _ACTIVE_LEDGERS.append(led)
+    try:
+        yield led
+    finally:
+        _ACTIVE_LEDGERS.remove(led)
+
+
+def _nbytes(x) -> int:
+    return int(np.prod(x.shape)) * jnp.dtype(x.dtype).itemsize
+
+
+def _record(site: str, payload: int, reference: int) -> None:
+    for led in _ACTIVE_LEDGERS:
+        led.add(site, payload, reference)
+
+
+# ------------------------------------------------------------- primitives
+
+def _pad_to_group(flat, group: int):
+    pad = (-flat.size) % group
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat, pad
+
+
+def _shared_group_scales(flat2d, axes, qmax: float):
+    """[G] shared scales: per-group amax agreed across ranks via pmax
+    (the only float exchange the quantized path keeps)."""
+    amax = jnp.max(jnp.abs(flat2d.astype(jnp.float32)), axis=1)
+    # axes is a static tuple of mesh-axis NAMES, not a tracer
+    if axes:  # lint: disable=jit/traced-branch
+        amax = jax.lax.pmax(amax, tuple(axes))
+    return jnp.maximum(amax, _TINY) / qmax
+
+
+def _wire_for(n_ranks: int):  # lint: static-fn — mesh size is trace-time
+    """(wire dtype, per-rank qmax) with overflow headroom for ``n``
+    in-wire summands. Past 127 ranks an int8 range can't hold even
+    ±1 per rank without wrapping, so the wire widens to int16 — still
+    2× under f32, and the accumulator invariant stays true instead of
+    silently failing at fleet scale."""
+    # n_ranks is a static product of mesh-axis sizes, not a tracer
+    if n_ranks <= 127:  # lint: disable=jit/traced-branch
+        return jnp.int8, max(1, 127 // n_ranks)
+    if n_ranks > 32767:  # lint: disable=jit/traced-branch
+        raise ValueError(f"quantized collective over {n_ranks} ranks "
+                         f"overflows the int16 wire — widen the codec")
+    return jnp.int16, max(1, 32767 // n_ranks)
+
+
+def _quant_rows(flat2d, scales, qmax: float, wire=jnp.int8):
+    q = jnp.rint(flat2d.astype(jnp.float32) / scales[:, None])
+    return jnp.clip(q, -qmax, qmax).astype(wire)
+
+
+def _pvary_ct(ct, axes):
+    """Re-stamp a cotangent as varying over ``axes`` — metadata only.
+
+    The straight-through backwards implement the VMA transpose
+    convention (psum of a varying value transposes to the identity-
+    valued pvary). Pre-vma jax has no pcast AND transposes psum as
+    psum(ct) — a ×N mismatch — but it also cannot trace the train
+    step at all (out_specs replication inference fails, the seed
+    parallel suite's gap), so the only pre-vma consumers are the
+    verify harness's deliberately patched runs, whose valid-plan
+    caveats live in .claude/skills/verify/SKILL.md."""
+    if hasattr(jax, "typeof"):
+        from hadoop_tpu.ops.vma import pvary_to
+        return pvary_to(ct, axes)
+    return ct
+
+
+def _straight_through(fwd_impl, bwd_fn, x):
+    """Quantized collective with the EXACT collective's backward.
+
+    The quantizer's rounding has measure-zero gradients — naively
+    differentiating through ``rint``/``clip`` returns zero cotangents
+    and the relaxed tier silently stops training the moment a
+    quantized collective sits inside the autodiff region (the tp
+    reduces do). The straight-through estimator keeps the quantized
+    wire in the forward and applies the transpose the exact collective
+    would have applied in the backward — which for a psum is the free
+    cotangent broadcast, so the backward costs exactly what the
+    bitwise tier's backward costs."""
+    f = jax.custom_vjp(fwd_impl)
+    f.defvjp(lambda v: (fwd_impl(v), None),
+             lambda _res, ct: (bwd_fn(ct),))
+    return f(x)
+
+
+def psum_quantized(x, axes, rq: RelaxedQuant, *, scale: str = "group",
+                   site: str = "psum"):
+    """Relaxed psum: int8 (or fp8) payload + shared scales.
+
+    ``scale="group"`` uses one scale per ``rq.group`` elements (gradient
+    buckets concatenate leaves whose magnitudes differ by orders);
+    ``scale="tensor"`` uses one scalar (activations inside one layer are
+    magnitude-homogeneous, and a scalar scale survives any downstream
+    re-layout). Result has x's shape/dtype; values are allclose to the
+    exact psum, never bitwise. Differentiable: the backward is the
+    exact psum's transpose (straight-through), identical in cost and
+    value to the bitwise tier's backward.
+    """
+    axes = tuple(axes)
+    n = rq.ranks(axes)
+    # static mesh-size / dtype facts decide the codec path at trace time
+    if n == 1 or not jnp.issubdtype(  # lint: disable=jit/traced-branch
+            jnp.dtype(x.dtype), jnp.floating):
+        return jax.lax.psum(x, axes) if axes else x
+
+    def bwd(ct):
+        # transpose of psum: every rank receives the (replicated)
+        # cotangent; pvary only re-stamps the varying-axes metadata
+        return _pvary_ct(ct, axes)
+
+    return _straight_through(
+        lambda v: _psum_quantized_impl(v, axes, rq, scale, site),
+        bwd, x)
+
+
+def _psum_quantized_impl(x, axes, rq: RelaxedQuant, scale: str,
+                         site: str):
+    n = rq.ranks(axes)
+    flat = x.reshape(-1)
+    group = flat.size if scale == "tensor" else max(1, rq.group)
+    flat, _pad = _pad_to_group(flat, group)
+    rows = flat.reshape(-1, group)
+    if rq.codec == "fp8" and _F8 is not None and len(axes) == 1:
+        # in-wire fp8 accumulation would burn the saved bits: gather
+        # the fp8 payloads and reduce locally in f32. Only single-axis
+        # sums — a multi-axis sum would need an f32 second stage that
+        # moves MORE bytes than the f8 leg saves, so those ride the
+        # int8 wire below instead.
+        scales = _shared_group_scales(rows, axes, _F8_MAX)
+        f8 = (rows.astype(jnp.float32) / scales[:, None]).astype(_F8)
+        gat = jax.lax.all_gather(f8, axes[0])
+        acc = jnp.sum(gat.astype(jnp.float32), axis=0)
+        out = acc * scales[:, None]
+        _record(site, _nbytes(f8) + _nbytes(scales), _nbytes(x))
+    else:
+        wire, qmax = _wire_for(n)
+        scales = _shared_group_scales(rows, axes, qmax)
+        q = _quant_rows(rows, scales, qmax, wire)
+        s = jax.lax.psum(q, axes)
+        out = s.astype(jnp.float32) * scales[:, None]
+        _record(site, _nbytes(q) + _nbytes(scales), _nbytes(x))
+    return out.reshape(-1)[:x.size].reshape(x.shape).astype(x.dtype)
+
+
+def psum_scatter_quantized(x, scatter_axis: str, rq: RelaxedQuant, *,
+                           rest_axes: Sequence[str] = (),
+                           scatter_dimension: int = 0,
+                           scale: str = "group", site: str = "scatter"):
+    """Relaxed psum(+rest) ∘ psum_scatter: the reduce-scatter form.
+
+    ``scale="group"`` requires the ZeRO-1 bucket layout — a 2-D
+    ``[Z, K]`` array tiled-scattered on dim 0 — and keeps one scale per
+    (row, group-of-K) so the surviving slice dequantizes with exactly
+    its own scales (selected by this rank's row index after the
+    scatter). ``scale="tensor"`` works with any layout/dimension (the
+    megatron-SP activation scatter) at scalar-scale granularity.
+
+    The in-wire accumulation needs integer headroom, so the fp8 codec
+    falls back to the int8 wire here (documented; the gather-based fp8
+    form cannot express a scatter without re-materializing the full
+    tensor it exists to avoid). The tensor-scale form is
+    differentiable: its backward is the exact reduce-scatter's
+    transpose (an all_gather of the cotangent — the same collective
+    the bitwise tier's backward issues).
+    """
+    rest = tuple(rest_axes)
+    all_axes = rest + (scatter_axis,)
+    n = rq.ranks(all_axes)
+    wire, qmax = _wire_for(n)
+    if scale == "tensor":
+        def impl(v):
+            # one scalar scale, agreed across every participating rank
+            # — survives any scatter layout (the megatron-SP scatter)
+            amax = jax.lax.pmax(
+                jnp.max(jnp.abs(v.astype(jnp.float32))), all_axes)
+            s0 = jnp.maximum(amax, _TINY) / qmax
+            q = jnp.clip(jnp.rint(v.astype(jnp.float32) / s0),
+                         -qmax, qmax).astype(wire)
+            if rest:
+                q = jax.lax.psum(q, rest)
+            sl = jax.lax.psum_scatter(
+                q, scatter_axis, scatter_dimension=scatter_dimension,
+                tiled=True)
+            _record(site, _nbytes(q) + 4, _nbytes(v))
+            return (sl.astype(jnp.float32) * s0).astype(v.dtype)
+
+        def bwd(ct):
+            full = jax.lax.all_gather(ct, scatter_axis,
+                                      axis=scatter_dimension,
+                                      tiled=True)
+            return _pvary_ct(full, all_axes)
+
+        return _straight_through(impl, bwd, x)
+    if x.ndim != 2 or scatter_dimension != 0:
+        raise ValueError("group-scaled quantized scatter needs the "
+                         "[Z, K] bucket layout (scatter_dimension=0)")
+    z, k = x.shape
+    group = min(max(1, rq.group), k)
+    pad = (-k) % group
+    buf = jnp.pad(x, ((0, 0), (0, pad))) if pad else x
+    g = buf.shape[1] // group
+    rows = buf.reshape(z * g, group)
+    scales = _shared_group_scales(rows, all_axes, qmax)   # [z*g]
+    q = _quant_rows(rows, scales, qmax, wire).reshape(z, g * group)
+    if rest:
+        q = jax.lax.psum(q, rest)
+    sl = jax.lax.psum_scatter(q, scatter_axis, scatter_dimension=0,
+                              tiled=True).reshape(g, group)
+    idx = jax.lax.axis_index(scatter_axis)
+    my_scales = jax.lax.dynamic_slice(scales.reshape(z, g),
+                                      (idx, jnp.zeros((), jnp.int32)),
+                                      (1, g)).reshape(g)
+    out = sl.astype(jnp.float32) * my_scales[:, None]
+    _record(site, _nbytes(q) + _nbytes(scales), _nbytes(x))
+    return out.reshape(-1)[:k].astype(x.dtype)
+
+
+def psum_of_scatter_quantized(row, z: int, idx, axes,
+                              rq: RelaxedQuant, *, site: str = "gather"):
+    """Relaxed ZeRO-1 reassembly: the psum-of-disjoint-scatters gather
+    with a quantized wire. Exactly ONE rank contributes each element,
+    so there is no accumulation and the full ±127 int8 range (or a
+    true fp8 value — f8 + 0 is exact) applies; scales are local to the
+    contributing rank and ride a tiny parallel f32 scatter-psum.
+
+    ``row``: this rank's (K,) updated slice; returns the dequantized
+    ``[Z, K_padded]`` buffer (caller slices columns per leaf).
+    """
+    axes = tuple(axes)
+    k = row.shape[0]
+    group = min(max(1, rq.group), k)
+    flat, _pad = _pad_to_group(row, group)
+    rows = flat.reshape(-1, group)
+    g = rows.shape[0]
+    kp = g * group
+    zero_i = jnp.zeros((), jnp.int32)
+    if rq.codec == "fp8" and _F8 is not None:
+        scales = _shared_group_scales(rows, (), _F8_MAX)   # local amax
+        payload = (rows.astype(jnp.float32) /
+                   scales[:, None]).astype(_F8).reshape(kp)
+        buf = jnp.zeros((z, kp), _F8)
+    else:
+        scales = _shared_group_scales(rows, (), 127.0)
+        payload = _quant_rows(rows, scales, 127.0).reshape(kp)
+        buf = jnp.zeros((z, kp), jnp.int8)
+    buf = jax.lax.dynamic_update_slice(buf, payload[None, :],
+                                       (idx, zero_i))
+    sbuf = jnp.zeros((z, g), jnp.float32)
+    sbuf = jax.lax.dynamic_update_slice(sbuf, scales[None, :],
+                                        (idx, zero_i))
+    # int8/f8 + 0 sums exactly: the psum IS the all_gather here
+    buf = jax.lax.psum(buf, axes)
+    sbuf = jax.lax.psum(sbuf, axes)
+    out = buf.astype(jnp.float32).reshape(z, g, group) * \
+        sbuf[:, :, None]
+    # the wire moves the whole [Z, Kp] buffer (as the bitwise psum-of-
+    # scatters does in the leaf dtype) plus the [Z, G] scale plane
+    _record(site, _nbytes(buf) + _nbytes(sbuf),
+            z * kp * jnp.dtype(row.dtype).itemsize)
+    return out.reshape(z, kp).astype(row.dtype)
+
+
+# ------------------------------------------------- host-side payload codec
+
+_PAYLOAD_VERSION = 1
+
+
+def _np_dtype(name) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # bfloat16 and friends register through ml_dtypes, which numpy
+        # cannot resolve from the string name alone
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def quantize_array(x: np.ndarray, codec: str = "int8",
+                   group: int = 1024) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side symmetric per-group quantization (test/bench mirror of
+    the in-graph path; full ±127 range — no accumulation headroom)."""
+    if codec not in WIRE_CODECS:
+        raise ValueError(f"unknown wire codec {codec!r} "
+                         f"(must be one of {WIRE_CODECS})")
+    flat = np.asarray(x, np.float32).reshape(-1)
+    pad = (-flat.size) % group
+    if pad:
+        flat = np.pad(flat, (0, pad))
+    rows = flat.reshape(-1, group)
+    qmax = _F8_MAX if codec == "fp8" else 127.0
+    scales = np.maximum(np.max(np.abs(rows), axis=1), _TINY) / qmax
+    if codec == "fp8":
+        import ml_dtypes
+        q = (rows / scales[:, None]).astype(ml_dtypes.float8_e4m3fn)
+    else:
+        q = np.clip(np.rint(rows / scales[:, None]), -127,
+                    127).astype(np.int8)
+    return q, scales.astype(np.float32)
+
+
+def dequantize_array(q: np.ndarray, scales: np.ndarray, shape,
+                     dtype) -> np.ndarray:
+    rows = np.asarray(q, np.float32) * np.asarray(
+        scales, np.float32)[:, None]
+    n = int(np.prod(shape))
+    return rows.reshape(-1)[:n].reshape(shape).astype(dtype)
+
+
+def encode_payload(x: np.ndarray, codec: str = "int8",
+                   group: int = 1024) -> bytes:
+    """Serialize one quantized payload with a self-describing header
+    (``u32 BE length || JSON || q bytes || scale bytes``). The header
+    pins codec/dtype/shape so a reader configured differently fails
+    loudly — mirroring the KV block codec contract."""
+    q, scales = quantize_array(x, codec=codec, group=group)
+    header = {"v": _PAYLOAD_VERSION, "codec": codec, "group": group,
+              "dtype": str(np.dtype(x.dtype)), "shape": list(x.shape)}
+    hj = json.dumps(header, separators=(",", ":")).encode()
+    return struct.pack(">I", len(hj)) + hj + q.tobytes() + \
+        scales.tobytes()
+
+
+def decode_payload(data: bytes, *, codec: Optional[str] = None,
+                   shape=None, dtype=None) -> Tuple[np.ndarray, dict]:
+    """Inverse of :func:`encode_payload`; any pinned expectation
+    (codec/shape/dtype) that disagrees with the header is a loud
+    error, never a silent dequantization against the wrong scales."""
+    if len(data) < 4:
+        raise ValueError("truncated lowp payload (no header length)")
+    (hlen,) = struct.unpack(">I", data[:4])
+    header = json.loads(data[4:4 + hlen].decode())
+    if header.get("v") != _PAYLOAD_VERSION:
+        raise ValueError(f"lowp payload version {header.get('v')!r} "
+                         f"(expected {_PAYLOAD_VERSION})")
+    if codec is not None and header["codec"] != codec:
+        raise ValueError(f"lowp payload codec {header['codec']!r} != "
+                         f"expected {codec!r}")
+    hshape = tuple(header["shape"])
+    if shape is not None and hshape != tuple(shape):
+        raise ValueError(f"lowp payload shape {hshape} != {tuple(shape)}")
+    if dtype is not None and _np_dtype(header["dtype"]) != \
+            _np_dtype(dtype):
+        raise ValueError(f"lowp payload dtype {header['dtype']} != "
+                         f"{_np_dtype(dtype)}")
+    group = int(header["group"])
+    n = int(np.prod(hshape))
+    g = -(-n // group)
+    body = data[4 + hlen:]
+    if len(body) != g * group + g * 4:
+        raise ValueError("truncated lowp payload body")
+    if header["codec"] == "fp8":
+        import ml_dtypes
+        q = np.frombuffer(body[:g * group], ml_dtypes.float8_e4m3fn)
+    else:
+        q = np.frombuffer(body[:g * group], np.int8)
+    scales = np.frombuffer(body[g * group:], np.float32)
+    out = dequantize_array(q.reshape(g, group), scales, hshape,
+                           _np_dtype(header["dtype"]))
+    return out, header

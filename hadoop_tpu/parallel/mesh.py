@@ -68,8 +68,9 @@ class MeshPlan:
             axes.append("ep")
         return tuple(axes)
 
-    def ctx(self, cfg: ModelConfig,
-            tp_overlap_chunks: int = 1) -> ParallelCtx:
+    def ctx(self, cfg: ModelConfig, tp_overlap_chunks: int = 1,
+            relaxed_codec=None,
+            relaxed_chunk_matmul: bool = False) -> ParallelCtx:
         return ParallelCtx(
             tp_axis="tp" if self.tp > 1 else None,
             tp_size=self.tp,
@@ -80,6 +81,11 @@ class MeshPlan:
             ring_size=self.sp,
             sp_mode=self.sp_mode,
             tp_overlap_chunks=tp_overlap_chunks if self.tp > 1 else 1,
+            # the relaxed lowp knobs only change behaviour where a tp
+            # collective exists; a tp=1 plan stays bitwise by shape
+            relaxed_codec=relaxed_codec if self.tp > 1 else None,
+            relaxed_chunk_matmul=(relaxed_chunk_matmul
+                                  if self.tp > 1 else False),
         )
 
     def validate(self, cfg: ModelConfig, batch: int, seq: int,

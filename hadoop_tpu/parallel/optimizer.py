@@ -118,7 +118,7 @@ def zero1_update(params, grads, state: AdamWState, lr: float, *,
                  b1: float = 0.9, b2: float = 0.95, eps: float = 1e-8,
                  weight_decay: float = 0.1, grad_clip: float = 1.0,
                  gsq=None, grads_sliced: bool = False,
-                 gather_bucket_bytes: int = 0):
+                 gather_bucket_bytes: int = 0, gather_relaxed=None):
     """ZeRO-1 AdamW step (inside shard_map). ``leaf_axes``: pytree like
     params whose leaves are the tuple of data axes partitioning that
     leaf's state (see zero1_leaf_plan). State mu/nu leaves are the local
@@ -131,7 +131,10 @@ def zero1_update(params, grads, state: AdamWState, lr: float, *,
     applies here. ``gather_bucket_bytes`` > 0 reassembles the updated
     params through bucketed psum-of-scatters (one collective per
     bucket, bitwise identical to the per-leaf form) instead of one
-    collective per leaf."""
+    collective per leaf. ``gather_relaxed`` (relaxed parity tier only,
+    parallel/lowp) quantizes that reassembly's wire payload; the
+    master mu/nu/param slices this rank updates stay full precision —
+    only the broadcast working copy is quantized."""
     count = state.count + 1
     cf = count.astype(jnp.float32)
     gnorm = jnp.sqrt(gsq)
@@ -199,7 +202,8 @@ def zero1_update(params, grads, state: AdamWState, lr: float, *,
         from hadoop_tpu.parallel.overlap import bucketed_gather_slices
         new_p = bucketed_gather_slices(
             treedef.unflatten([o[0] for o in out]), params, leaf_axes,
-            mesh_axis_sizes, gather_bucket_bytes)
+            mesh_axis_sizes, gather_bucket_bytes,
+            relaxed=gather_relaxed)
     else:
         new_p = treedef.unflatten([
             gather_leaf(p, o[0], o[3], o[4], o[5], a)

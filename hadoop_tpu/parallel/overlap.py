@@ -37,6 +37,13 @@ Conf knobs (all ``parallel.overlap.*``; read by :func:`overlap_from_conf`):
   parallel.overlap.tp.chunks            default 4
   parallel.overlap.zero1.reduce-scatter default true
   parallel.ckpt.async                   default true (parallel/trainer.py)
+
+Parity tiers: under ``parallel.parity=relaxed`` (parallel/lowp) the
+bucketed collectives here accept a ``relaxed`` quant spec and ride the
+wire as int8/fp8 payloads + shared f32 scales — allclose to the exact
+sums, ≥2× fewer payload bytes, covered by the lowp loss-curve guard.
+Under the default bitwise tier ``relaxed`` is None and this module
+compiles exactly the graph documented above.
 """
 
 from __future__ import annotations
@@ -112,7 +119,21 @@ def _pack_buckets(sizes: Sequence[int], itemsize: int,  # lint: static-fn
     return buckets
 
 
-def bucketed_psum(tree, reduce_axes_tree, bucket_bytes: int):
+def _bucket_psum(buf, axes, relaxed, site):
+    """One bucket's reduction on the configured parity tier: exact
+    psum under bitwise; int8/fp8 wire payload with shared per-group
+    scales under relaxed (values allclose, never bitwise — covered by
+    the lowp loss-curve guard). Integer buckets stay exact on either
+    tier (quantizing an int payload would be a lie, not a codec)."""
+    if relaxed is not None and \
+            jnp.issubdtype(jnp.dtype(buf.dtype), jnp.floating):
+        from hadoop_tpu.parallel.lowp.quant import psum_quantized
+        return psum_quantized(buf, axes, relaxed, site=site)
+    return jax.lax.psum(buf, axes)
+
+
+def bucketed_psum(tree, reduce_axes_tree, bucket_bytes: int,
+                  relaxed=None):
     """psum every leaf over its reduce axes, packing same-signature
     leaves into flattened buckets of at most ``bucket_bytes`` each.
 
@@ -120,6 +141,10 @@ def bucketed_psum(tree, reduce_axes_tree, bucket_bytes: int):
     mesh axis names to reduce over (empty tuple = leaf passes through).
     Bitwise identical to the per-leaf form — concatenation changes
     which collective an element rides in, never which values it sums.
+
+    ``relaxed`` (a :class:`~hadoop_tpu.parallel.lowp.quant.RelaxedQuant`,
+    relaxed parity tier only): each bucket's payload rides the wire
+    quantized — allclose to the exact sums, ≥2× fewer payload bytes.
     """
     flat, treedef = jax.tree_util.tree_flatten(tree)
     axes_flat = treedef.flatten_up_to(reduce_axes_tree)
@@ -147,10 +172,11 @@ def bucketed_psum(tree, reduce_axes_tree, bucket_bytes: int):
             members = [idxs[j] for j in bucket]
             if len(members) == 1:
                 i = members[0]
-                out[i] = jax.lax.psum(flat[i], axes)
+                out[i] = _bucket_psum(flat[i], axes, relaxed,
+                                      "bucket.psum")
                 continue
             buf = jnp.concatenate([flat[i].reshape(-1) for i in members])
-            buf = jax.lax.psum(buf, axes)
+            buf = _bucket_psum(buf, axes, relaxed, "bucket.psum")
             off = 0
             for i in members:
                 n = flat[i].size
@@ -194,7 +220,7 @@ def zero1_slice_index(axes: Sequence[str],
 
 def bucketed_psum_scatter(tree, reduce_axes_tree, scatter_axes_tree,
                           mesh_axis_sizes: Dict[str, int],
-                          bucket_bytes: int):
+                          bucket_bytes: int, relaxed=None):
     """Reduce each leaf over its reduce axes AND hand back only this
     rank's ZeRO-1 slice: ``psum`` over the non-scatter axes composed with
     a ``psum_scatter`` over the (single) scatter axis, bucketed.
@@ -203,7 +229,10 @@ def bucketed_psum_scatter(tree, reduce_axes_tree, scatter_axes_tree,
     back to psum + local dynamic_slice for leaves partitioned over more
     than one data axis (the multi-axis scatter layout does not match a
     single tiled reduce-scatter) and for unpartitioned leaves (Z == 1,
-    full psum, slice is the whole leaf).
+    full psum, slice is the whole leaf). ``relaxed`` quantizes the
+    bucketed scatter payloads (relaxed parity tier; the per-leaf
+    fallback path stays exact — it carries the rare multi-axis leaves
+    whose layout the quantized scatter cannot express).
     """
     flat, treedef = jax.tree_util.tree_flatten(tree)
     red_flat = treedef.flatten_up_to(reduce_axes_tree)
@@ -252,10 +281,19 @@ def bucketed_psum_scatter(tree, reduce_axes_tree, scatter_axes_tree,
             buf = jnp.concatenate(
                 [_pad_flat(flat[i], z, k).reshape(z, k)
                  for i, k in members], axis=1)
-            if rest:
-                buf = jax.lax.psum(buf, rest)
-            sl = jax.lax.psum_scatter(buf, sc_axis, scatter_dimension=0,
-                                      tiled=True).reshape(-1)
+            if relaxed is not None and \
+                    jnp.issubdtype(dtype, jnp.floating):
+                from hadoop_tpu.parallel.lowp.quant import \
+                    psum_scatter_quantized
+                sl = psum_scatter_quantized(
+                    buf, sc_axis, relaxed, rest_axes=rest,
+                    site="bucket.scatter")
+            else:
+                if rest:
+                    buf = jax.lax.psum(buf, rest)
+                sl = jax.lax.psum_scatter(
+                    buf, sc_axis, scatter_dimension=0,
+                    tiled=True).reshape(-1)
             off = 0
             for i, k in members:
                 out[i] = sl[off:off + k]
@@ -265,7 +303,7 @@ def bucketed_psum_scatter(tree, reduce_axes_tree, scatter_axes_tree,
 
 def bucketed_gather_slices(slices, params_like, leaf_axes,
                            mesh_axis_sizes: Dict[str, int],
-                           bucket_bytes: int):
+                           bucket_bytes: int, relaxed=None):
     """Reassemble full leaves from per-rank ZeRO-1 slices with bucketed
     psum-of-disjoint-scatters (the vma-provable all_gather; see
     optimizer.zero1_update). One collective per bucket instead of one
@@ -275,6 +313,11 @@ def bucketed_gather_slices(slices, params_like, leaf_axes,
     ``slices``: pytree of (K,) updated slices; ``params_like``: pytree of
     the full leaves (shape/dtype targets); ``leaf_axes``: the data axes
     partitioning each leaf. Leaves with Z == 1 pass through reshaped.
+    ``relaxed`` (relaxed parity tier) quantizes the broadcast wire:
+    each rank ships its slice as int8/fp8 + local scales at FULL range
+    (exactly one rank contributes per element, so there is no
+    accumulation headroom to pay) — the optimizer's master slices stay
+    full precision, only the reassembled working copy is quantized.
     """
     flat_s, treedef = jax.tree_util.tree_flatten(slices)
     flat_p = treedef.flatten_up_to(params_like)
@@ -304,10 +347,18 @@ def bucketed_gather_slices(slices, params_like, leaf_axes,
             members = [(idxs[j], ks[j]) for j in bucket]
             k_total = sum(k for _, k in members)
             row = jnp.concatenate([flat_s[i] for i, _ in members])
-            buf = jnp.zeros((z, k_total), row.dtype)
-            buf = jax.lax.dynamic_update_slice(
-                buf, row[None, :], (idx, jnp.zeros((), jnp.int32)))
-            buf = jax.lax.psum(buf, axes)
+            if relaxed is not None and \
+                    jnp.issubdtype(dtype, jnp.floating):
+                from hadoop_tpu.parallel.lowp.quant import \
+                    psum_of_scatter_quantized
+                buf = psum_of_scatter_quantized(
+                    row, z, idx, axes, relaxed,
+                    site="zero1.gather")[:, :k_total]
+            else:
+                buf = jnp.zeros((z, k_total), row.dtype)
+                buf = jax.lax.dynamic_update_slice(
+                    buf, row[None, :], (idx, jnp.zeros((), jnp.int32)))
+                buf = jax.lax.psum(buf, axes)
             off = 0
             for i, k in members:
                 p = flat_p[i]

@@ -39,6 +39,7 @@ from hadoop_tpu.parallel.mesh import AXES, MeshPlan, param_specs, \
     shard_params
 from hadoop_tpu.parallel.optimizer import (AdamWState, adamw_init,
                                            adamw_update, zero1_update)
+from hadoop_tpu.parallel.lowp import BITWISE_PARITY, ParityConfig
 from hadoop_tpu.parallel.overlap import (DEFAULT_OVERLAP, OverlapConfig,
                                          bucketed_psum,
                                          bucketed_psum_scatter)
@@ -140,7 +141,8 @@ def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
                     remat: bool = False, donate: bool = True,
                     optimizer: str = "adamw", zero1: bool = False,
                     pipeline_schedule: str = "1f1b",
-                    overlap: Optional[OverlapConfig] = None):
+                    overlap: Optional[OverlapConfig] = None,
+                    parity: Optional[ParityConfig] = None):
     """Build the jitted sharded train step.
 
     Returns fn(params, opt_state, tokens, targets) ->
@@ -160,11 +162,51 @@ def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
     is loss-bit-exact against overlap-off except the zero1 manual-
     schedule (pp>1) grad-norm, whose slice-wise accumulation can move
     the clip scale by an ulp (see parallel/overlap.py).
+
+    ``parity`` (default BITWISE, ``parallel.parity`` conf): the parity
+    tier (parallel/lowp). Bitwise builds exactly the graph this
+    function always built — no lowp code executes. Relaxed quantizes
+    the bucketed gradient/reassembly collectives and the tp reduces
+    to int8/fp8 wire payloads and unlocks the true chunked collective
+    matmul; correctness is covered by the lowp loss-curve A-B guard
+    instead of bit-parity. The relaxed consumers ride the overlap
+    pass's bucketed collectives, so they require ``overlap.enabled``
+    (the default).
     """
     if overlap is None:
         overlap = DEFAULT_OVERLAP
+    if parity is None:
+        parity = BITWISE_PARITY
+    if parity.relaxed and not overlap.enabled:
+        # silently degrading to bitwise would label bench rows and
+        # A-B arms "relaxed" while measuring the bitwise tier
+        raise ValueError(
+            "parallel.parity=relaxed requires the overlap pass "
+            "(parallel.overlap.enabled=true): every relaxed consumer "
+            "rides its bucketed/chunked collectives")
+    if parity.relaxed:
+        # the relaxed consumers live on the overlap pass's bucketed /
+        # chunked collectives; build the quant spec once (guarded —
+        # under bitwise no lowp module is touched)
+        from hadoop_tpu.parallel.lowp.quant import RelaxedQuant
+        _sizes = dict(zip(AXES,
+                          (plan.dp, plan.pp, plan.tp, plan.ep, plan.sp)))
+        rq_buckets = RelaxedQuant(
+            codec=parity.codec, group=parity.group,
+            mesh_axis_sizes=_sizes) if parity.quant_buckets else None
+        rq_gather = RelaxedQuant(
+            codec=parity.codec, group=parity.group,
+            mesh_axis_sizes=_sizes) if parity.quant_zero1_gather \
+            else None
+        relaxed_codec = parity.codec if parity.quant_tp else None
+        relaxed_chunk = parity.chunk_matmul
+    else:
+        rq_buckets = rq_gather = relaxed_codec = None
+        relaxed_chunk = False
     ctx = plan.ctx(cfg, tp_overlap_chunks=(
-        overlap.tp_chunks if overlap.enabled else 1))
+        overlap.tp_chunks if overlap.enabled else 1),
+        relaxed_codec=relaxed_codec,
+        relaxed_chunk_matmul=relaxed_chunk)
     specs = param_specs(cfg, plan)
     data_spec = P(("dp", "ep"), "sp")
 
@@ -258,7 +300,8 @@ def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
     def _reduce_manual(grads):
         axes_tree = _manual_reduce_axes(grads)
         if overlap.enabled:
-            return bucketed_psum(grads, axes_tree, overlap.bucket_bytes)
+            return bucketed_psum(grads, axes_tree, overlap.bucket_bytes,
+                                 relaxed=rq_buckets)
         flat_g, treedef = jax.tree_util.tree_flatten(grads)
         flat_a = treedef.flatten_up_to(axes_tree)
         return treedef.unflatten([
@@ -304,7 +347,7 @@ def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
             if z1_scatter:
                 grads = bucketed_psum_scatter(
                     grads, _manual_reduce_axes(grads), z1_axes,
-                    z1_sizes, overlap.bucket_bytes)
+                    z1_sizes, overlap.bucket_bytes, relaxed=rq_buckets)
             else:
                 grads = _reduce_manual(grads)
             # Accumulators summed M per-microbatch mean-losses; the
@@ -340,7 +383,8 @@ def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
                 leaf_axes=z1_axes, mesh_axis_sizes=z1_sizes, gsq=gsq,
                 grads_sliced=z1_scatter,
                 gather_bucket_bytes=(overlap.bucket_bytes
-                                     if overlap.enabled else 0))
+                                     if overlap.enabled else 0),
+                gather_relaxed=rq_gather)
             # restore the (1,...,1,K) local state layout for out_specs
             new_opt = AdamWState(
                 new_opt_l.count,

@@ -30,6 +30,7 @@ from hadoop_tpu.parallel.checkpoint import (AsyncCheckpointWriter,
                                             snapshot_tree, write_snapshot)
 from hadoop_tpu.parallel.data import TokenDataset
 from hadoop_tpu.parallel.mesh import MeshPlan, make_mesh, param_specs
+from hadoop_tpu.parallel.lowp import ParityConfig
 from hadoop_tpu.parallel.overlap import OverlapConfig
 from hadoop_tpu.parallel.train import (init_sharded, make_data_sharding,
                                        make_train_step, zero1_layout)
@@ -48,6 +49,7 @@ class Trainer:
                  n_microbatches: Optional[int] = None,
                  pipeline_schedule: str = "1f1b",
                  overlap: Optional[OverlapConfig] = None,
+                 parity: Optional[ParityConfig] = None,
                  async_ckpt: bool = True):
         self.cfg, self.plan, self.fs = cfg, plan, fs
         self.ckpt_dir = ckpt_dir
@@ -65,7 +67,8 @@ class Trainer:
             cfg, plan, self.mesh, lr=lr, optimizer=optimizer,
             zero1=zero1, remat=remat, donate=False,
             n_microbatches=n_microbatches,
-            pipeline_schedule=pipeline_schedule, overlap=overlap)
+            pipeline_schedule=pipeline_schedule, overlap=overlap,
+            parity=parity)
         self.zero1 = zero1 and optimizer == "adamw"
         # parallel.ckpt.async: save() blocks only for the host snapshot;
         # the DFS write (and the vpp logical reorder) runs on a

@@ -10,7 +10,8 @@ ties them together). ``serving/engine.py`` is a thin consumer.
 """
 
 from hadoop_tpu.serving.kvstore.codec import (CODECS, decode_block,
-                                              encode_block)
+                                              dequant_int8, encode_block,
+                                              quant_int8)
 from hadoop_tpu.serving.kvstore.dfstier import DFSTier
 from hadoop_tpu.serving.kvstore.hosttier import HostTier
 from hadoop_tpu.serving.kvstore.pool import BlockPool
@@ -25,7 +26,8 @@ from hadoop_tpu.serving.kvstore.tiered import (CODEC_KEY, DFS_DIR_KEY,
 __all__ = [
     "BlockPool", "PrefixCache", "_RadixNode", "chain_digest",
     "HostTier", "DFSTier", "TieredKVCache", "ColdHit",
-    "encode_block", "decode_block", "CODECS",
+    "encode_block", "decode_block", "CODECS", "quant_int8",
+    "dequant_int8",
     "HOST_BYTES_KEY", "DFS_ENABLE_KEY", "DFS_DIR_KEY",
     "DFS_MIN_REFS_KEY", "CODEC_KEY",
 ]

@@ -46,20 +46,31 @@ def _np_dtype(name: str) -> np.dtype:
         return np.dtype(getattr(ml_dtypes, name))
 
 
-def _quant_int8(x: np.ndarray) -> Tuple[np.ndarray, list]:
+def quant_int8(x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     """Symmetric per-layer int8: scales are float32 amax/127 over each
-    layer's [block, heads, dim] slab (layer 0 of the array's axis 0)."""
+    layer's [block, heads, dim] slab (layer 0 of the array's axis 0).
+    Shared by the file codec below and the host-RAM ring's resident
+    form (hosttier.py) so one quantizer defines the int8 tier."""
     xf = np.asarray(x, np.float32)
     amax = np.max(np.abs(xf), axis=(1, 2, 3), keepdims=True)
     scales = np.maximum(amax, 1e-12) / 127.0
     q = np.clip(np.rint(xf / scales), -127, 127).astype(np.int8)
-    return q, [float(s) for s in scales.reshape(-1)]
+    return q, scales.reshape(-1).astype(np.float32)
+
+
+def dequant_int8(q: np.ndarray, scales, dtype: np.dtype) -> np.ndarray:
+    s = np.asarray(scales, np.float32).reshape(-1, 1, 1, 1)
+    return (q.astype(np.float32) * s).astype(dtype)
+
+
+def _quant_int8(x: np.ndarray) -> Tuple[np.ndarray, list]:
+    q, scales = quant_int8(x)
+    return q, [float(s) for s in scales]
 
 
 def _dequant_int8(q: np.ndarray, scales: list, dtype: np.dtype
                   ) -> np.ndarray:
-    s = np.asarray(scales, np.float32).reshape(-1, 1, 1, 1)
-    return (q.astype(np.float32) * s).astype(dtype)
+    return dequant_int8(q, scales, dtype)
 
 
 def encode_block(k: np.ndarray, v: np.ndarray, codec: str = "raw"

@@ -9,6 +9,14 @@ budget wraps, the oldest slot is overwritten and its key drops out of
 the index. A demoted block costs one ``memcpy`` in, a promotion one
 ``memcpy`` out; both are host-side only — the device round-trip happens
 in the engine's fixed-shape inject/extract helpers.
+
+``codec`` (``serving.kv.codec``, same knob the DFS tier honors): with
+``int8`` the arenas hold symmetric per-layer int8 payloads beside a
+small f32 scale plane — one quantize on ``put``, one dequantize on
+``get`` — so the same ``serving.kv.host.bytes`` budget holds ~4× the
+blocks of an f32 engine (~2× bf16). Promotions out of an int8 ring are
+allclose rather than bit-exact, exactly like a DFS round-trip under the
+same codec; ``raw`` (the default) stays byte-identical.
 """
 
 from __future__ import annotations
@@ -18,18 +26,34 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from hadoop_tpu.serving.kvstore.codec import (CODECS, dequant_int8,
+                                              quant_int8)
+
 
 class HostTier:
     """FIFO ring of demoted KV blocks keyed by prefix chain digest."""
 
-    def __init__(self, shape: Tuple[int, ...], dtype, budget_bytes: int):
+    def __init__(self, shape: Tuple[int, ...], dtype, budget_bytes: int,
+                 codec: str = "raw"):
+        if codec not in CODECS:
+            raise ValueError(f"serving.kv.codec must be one of {CODECS}, "
+                             f"got {codec!r}")
         self.shape = tuple(shape)
         self.dtype = np.dtype(dtype)
-        per_block = 2 * int(np.prod(self.shape)) * self.dtype.itemsize
+        self.codec = codec
+        store_dtype = np.dtype(np.int8) if codec == "int8" else self.dtype
+        n_layers = self.shape[0]
+        per_block = 2 * int(np.prod(self.shape)) * store_dtype.itemsize
+        if codec == "int8":
+            per_block += 2 * n_layers * 4   # the f32 scale planes
         self.block_bytes = per_block
         self.capacity = max(0, int(budget_bytes) // per_block)
-        self._k = np.zeros((self.capacity,) + self.shape, self.dtype)
+        self._k = np.zeros((self.capacity,) + self.shape, store_dtype)
         self._v = np.zeros_like(self._k)
+        if codec == "int8":
+            self._k_scales = np.zeros((self.capacity, n_layers),
+                                      np.float32)
+            self._v_scales = np.zeros_like(self._k_scales)
         self._index: Dict[bytes, int] = {}            # guarded-by: _lock
         self._slot_key: List[Optional[bytes]] = \
             [None] * self.capacity                    # guarded-by: _lock
@@ -50,6 +74,11 @@ class HostTier:
         capacity at all (budget below one block)."""
         if self.capacity == 0:
             return False
+        if self.codec == "int8":
+            # quantize OUTSIDE the lock — the ring write below is the
+            # memcpy-cheap part a concurrent get should wait on
+            kq, ks = quant_int8(k)
+            vq, vs = quant_int8(v)
         with self._lock:
             slot = self._index.get(digest)
             if slot is None:
@@ -60,24 +89,54 @@ class HostTier:
                     del self._index[old]
                 self._slot_key[slot] = digest
                 self._index[digest] = slot
-            self._k[slot] = k
-            self._v[slot] = v
+            if self.codec == "int8":
+                self._k[slot] = kq
+                self._v[slot] = vq
+                self._k_scales[slot] = ks
+                self._v_scales[slot] = vs
+            else:
+                self._k[slot] = k
+                self._v[slot] = v
         return True
+
+    def _snapshot(self, slot: int) -> Tuple:
+        """Copy one slot's raw payload (+ scales). Caller holds the
+        lock — this is the memcpy-cheap part a concurrent ring wrap
+        must not race; the float-expanding dequant runs OUTSIDE it."""
+        if self.codec == "int8":
+            return (self._k[slot].copy(), self._v[slot].copy(),
+                    self._k_scales[slot].copy(),
+                    self._v_scales[slot].copy())
+        return self._k[slot].copy(), self._v[slot].copy(), None, None
+
+    def _decode(self, snap: Tuple) -> Tuple[np.ndarray, np.ndarray]:
+        """Materialize a snapshot's (K, V) in the engine dtype — lock
+        NOT held (dequantization is per-block float math, the same
+        reasoning that keeps ``put``'s quantize outside the lock)."""
+        k, v, ks, vs = snap
+        if ks is None:
+            return k, v
+        return (dequant_int8(k, ks, self.dtype),
+                dequant_int8(v, vs, self.dtype))
 
     def items(self) -> List[Tuple[bytes, np.ndarray, np.ndarray]]:
         """Copies of every resident (digest, K, V) — the drain path
         persists the whole ring to the DFS tier before the process
-        exits. Copied under the lock like ``get``."""
+        exits. Raw payloads copied under the lock like ``get``;
+        decoded after it drops."""
         with self._lock:
-            return [(d, self._k[s].copy(), self._v[s].copy())
-                    for d, s in self._index.items()]
+            snaps = [(d, self._snapshot(s))
+                     for d, s in self._index.items()]
+        return [(d,) + self._decode(snap) for d, snap in snaps]
 
     def get(self, digest: bytes
             ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-        """Copies of the block's (K, V), or None. Copied under the lock
-        so a concurrent ring wrap can't overwrite the view mid-read."""
+        """Copies of the block's (K, V), or None. Raw payload copied
+        under the lock so a concurrent ring wrap can't overwrite the
+        view mid-read; decoded after it drops."""
         with self._lock:
             slot = self._index.get(digest)
             if slot is None:
                 return None
-            return self._k[slot].copy(), self._v[slot].copy()
+            snap = self._snapshot(slot)
+        return self._decode(snap)

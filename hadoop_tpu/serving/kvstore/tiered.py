@@ -96,7 +96,8 @@ class TieredKVCache:
             f"{head_dim}:{self.dtype}".encode()).digest()
         self.radix = PrefixCache(pool.block_size, salt=salt) if enabled \
             else None
-        self.host = HostTier(shape, self.dtype, host_bytes) \
+        self.host = HostTier(shape, self.dtype, host_bytes,
+                             codec=codec) \
             if enabled and host_bytes > 0 else None
         if self.host is not None and self.host.capacity == 0:
             log.warning("%s=%d holds zero KV blocks (one block is %d "

@@ -780,3 +780,93 @@ def test_cli_lint_fails_on_seeded_bad_tree(tmp_path):
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 1
     assert "lock/order-cycle" in proc.stdout
+
+
+# ------------------------------------------------- parity/relaxed-gated
+
+def test_unguarded_lowp_entry_points_are_flagged(tmp_path):
+    from hadoop_tpu.analysis import RelaxedGateChecker
+    findings = lint_source(tmp_path, """
+        from hadoop_tpu.parallel.lowp.quant import psum_quantized as pq
+
+        def reduce_bucket(buf, rq):
+            return pq(buf, ("dp",), rq)                       # BAD
+
+        def reduce_scatter(buf, ctx):
+            from hadoop_tpu.parallel.lowp.quant import \\
+                psum_scatter_quantized
+            return psum_scatter_quantized(buf, "tp", None)    # BAD
+
+        def project(x, w, ctx):
+            from hadoop_tpu.ops.collective_matmul import \\
+                chunked_matmul_reduce
+            return chunked_matmul_reduce(x, w, ctx)           # BAD
+    """, [RelaxedGateChecker()])
+    assert len(findings) == 3
+    assert all(f.checker == "parity/relaxed-gated" for f in findings)
+    assert "relaxed-parity guard" in findings[0].message
+
+
+def test_relaxed_guarded_entry_points_are_clean(tmp_path):
+    from hadoop_tpu.analysis import RelaxedGateChecker
+    findings = lint_source(tmp_path, """
+        def reduce_bucket(buf, rq, relaxed):
+            from hadoop_tpu.parallel.lowp.quant import psum_quantized
+            if relaxed is not None:
+                return psum_quantized(buf, ("dp",), rq)
+            return buf
+
+        def reduce_tp(y, ctx):
+            from hadoop_tpu.parallel.lowp.quant import \\
+                psum_scatter_quantized
+            if ctx.relaxed_codec is not None:
+                return psum_scatter_quantized(y, "tp", None)
+            return y
+
+        def project(x, w, ctx):
+            from hadoop_tpu.ops.collective_matmul import \\
+                chunked_matmul_reduce
+            return chunked_matmul_reduce(x, w, ctx) \\
+                if ctx.relaxed_chunk_matmul else x
+
+        def plumbing(conf):
+            # tier plumbing is not a quantized path: never flagged
+            from hadoop_tpu.parallel.lowp import parity_from_conf
+            return parity_from_conf(conf)
+
+        def kw_guard(x, rq, tier_matches):
+            # a keyword ARG naming the tier is a guard too
+            from hadoop_tpu.parallel.lowp.quant import psum_quantized
+            if tier_matches(relaxed=True):
+                return psum_quantized(x, ("dp",), rq)
+            return x
+    """, [RelaxedGateChecker()])
+    assert findings == []
+
+
+def test_lowp_package_itself_is_exempt(tmp_path):
+    from hadoop_tpu.analysis import RelaxedGateChecker
+    pkg = tmp_path / "hadoop_tpu" / "parallel" / "lowp"
+    pkg.mkdir(parents=True)
+    for p in (tmp_path / "hadoop_tpu", tmp_path / "hadoop_tpu" /
+              "parallel", pkg):
+        (p / "__init__.py").write_text("")
+    (pkg / "quant.py").write_text(textwrap.dedent("""
+        def psum_quantized(x, axes, rq):
+            return x
+
+        def helper(x, rq):
+            return psum_quantized(x, (), rq)   # definition site: exempt
+    """))
+    findings = run_lint([str(tmp_path)], checkers=[RelaxedGateChecker()],
+                        root=str(tmp_path))
+    assert findings == []
+
+
+def test_shipped_tree_has_no_unguarded_relaxed_entry_points():
+    """The real consumers (overlap.py, collective_matmul.py, train.py)
+    stay behind their guards — the tier-1 self-run of the contract."""
+    from hadoop_tpu.analysis import RelaxedGateChecker
+    findings = run_lint([os.path.join(REPO, "hadoop_tpu")],
+                        checkers=[RelaxedGateChecker()])
+    assert findings == [], [f.render() for f in findings]

@@ -143,6 +143,83 @@ def test_host_tier_ring_wrap_evicts_oldest():
         is False                             # budget below one block
 
 
+def test_host_tier_int8_codec_roundtrip_and_items():
+    """serving.kv.codec=int8 on the host ring: payloads round-trip
+    allclose (same contract as a DFS round trip under the codec) and
+    the drain path's items() decodes every resident block."""
+    shape = (2, 4, 2, 4)
+    tier = HostTier(shape, np.float32, budget_bytes=1 << 20,
+                    codec="int8")
+    rng = np.random.default_rng(0)
+    blocks = {bytes([i]): (rng.normal(size=shape).astype(np.float32),
+                           rng.normal(size=shape).astype(np.float32))
+              for i in range(3)}
+    for d, (k, v) in blocks.items():
+        assert tier.put(d, k, v)
+    for d, (k, v) in blocks.items():
+        gk, gv = tier.get(d)
+        assert gk.dtype == np.float32 and gk.shape == shape
+        np.testing.assert_allclose(gk, k, atol=2.5 / 127 * np.abs(
+            k).max())
+        np.testing.assert_allclose(gv, v, atol=2.5 / 127 * np.abs(
+            v).max())
+    got = dict((d, kv) for d, *kv in
+               ((d, k, v) for d, k, v in tier.items()))
+    assert set(got) == set(blocks)
+    # all-zero block decodes exactly zero (scale-of-zeros edge)
+    z = np.zeros(shape, np.float32)
+    tier.put(b"z", z, z)
+    gk, gv = tier.get(b"z")
+    assert (gk == 0).all() and (gv == 0).all()
+
+
+def test_host_tier_int8_codec_quadruples_f32_capacity():
+    """The compounding satellite: the same serving.kv.host.bytes budget
+    holds ~4× the blocks of an f32 engine under the int8 codec (the
+    scale plane costs a sliver below exactly 4×)."""
+    shape = (2, 8, 2, 8)
+    budget = 64 * 1024
+    raw = HostTier(shape, np.float32, budget_bytes=budget)
+    q = HostTier(shape, np.float32, budget_bytes=budget, codec="int8")
+    assert q.capacity >= 3 * raw.capacity            # ~3.9× here
+    assert q.capacity * q.block_bytes <= budget
+    with pytest.raises(ValueError, match="codec"):
+        HostTier(shape, np.float32, budget_bytes=budget, codec="zstd")
+
+
+def test_tiered_int8_demote_promote_allclose():
+    """End-to-end through TieredKVCache: with serving.kv.codec=int8 the
+    demote path quantizes into the ring and a host get dequantizes
+    back allclose in the engine dtype."""
+    from hadoop_tpu.serving.kvstore import BlockPool, TieredKVCache
+    shape = (2, 4, 2, 4)
+    pool = BlockPool(8, block_size=4)
+    store = {}
+    rng = np.random.default_rng(1)
+
+    def extract(block):
+        return store[block]
+
+    kv = TieredKVCache(pool, layers=2, kv_heads=2, head_dim=4,
+                       dtype=np.float32, host_bytes=1 << 20,
+                       codec="int8", extract=extract)
+    assert kv.host is not None and kv.host.codec == "int8"
+    # simulate a demotion: radix-owned page whose payload we control
+    toks = list(range(4))
+    kv.radix.insert(toks, [3])
+    node = kv.radix.node_for_block(3)
+    payload = (rng.normal(size=shape).astype(np.float32),
+               rng.normal(size=shape).astype(np.float32))
+    store[3] = payload
+    kv.demote(node)
+    got = kv.host.get(node.digest)
+    assert got is not None
+    np.testing.assert_allclose(got[0], payload[0],
+                               atol=2.5 / 127 * np.abs(
+                                   payload[0]).max())
+    assert kv.demotions == 1
+
+
 # -------------------------------------------- demote/promote round trips
 
 def test_demote_promote_roundtrip_bit_exact(tiny_model):
