@@ -24,6 +24,7 @@ the verdict in the JSON.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import time
 
@@ -136,6 +137,7 @@ def main():
     report: dict = {"preset": args.preset, "seq": args.seq,
                     "plan": {"dp": args.dp, "tp": args.tp, "pp": args.pp},
                     "remat": args.remat, "params": count_params(params),
+                    "parity_mode": args.parity,
                     "batches": []}
     for batch in [int(x) for x in args.batches.split(",")]:
         tokens = jax.device_put(
@@ -198,6 +200,11 @@ def main():
                 row[label + "_tok_s"] = round(batch * args.seq / t_full)
                 if par.relaxed and ledger.sites:
                     row[label + "_comm"] = ledger.report()
+                    # the policy that produced this row, next to its
+                    # ledger — bench rows stay self-describing when
+                    # tiers multiply (codec/group/consumer flags here,
+                    # the serving weight plane in serve_bench's JSON)
+                    row[label + "_policy"] = dataclasses.asdict(par)
         if "fwd_ms" in row and "overlap-on_ms" in row:
             # optimizer + (unoverlapped) comm residue: what the full
             # step spends beyond fwd+bwd compute

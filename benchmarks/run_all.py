@@ -110,6 +110,17 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — recorded for the
         # trajectory; must not discard the benches already computed
         out["serving_speculate"] = {"error": f"{type(e).__name__}: {e}"}
+    # Weight-plane smoke: the same tiny model served from f32- and
+    # int8-resident weights under one fixed HBM budget — the int8 arm
+    # must admit >= 2x the lanes x context (and KV blocks), the logits
+    # A-B guard must accept the greedy outputs, and both step shapes
+    # compile exactly once on both arms. Recorded, not raised.
+    try:
+        from benchmarks import serve_bench
+        out["serving_quantized"] = serve_bench.run_quantized_smoke()
+    except Exception as e:  # noqa: BLE001 — recorded for the
+        # trajectory; must not discard the benches already computed
+        out["serving_quantized"] = {"error": f"{type(e).__name__}: {e}"}
     # Replica-churn smoke: kill/restart an engine mid shared-prefix
     # workload over a miniDFS-backed KV store — fleet hit-rate must
     # recover via the DFS tier (post-restart hits > 0, strictly fewer

@@ -20,6 +20,11 @@ Two workload modes:
   rate positive, strictly fewer engine steps with the cache, both
   shapes compiled exactly once). A wall-clock TTFT inversion is
   reported as a warning, not a failure (host-load noise).
+- ``--quantized``: the weight-plane A-B (serving/weightplane.py) — the
+  same model served from f32- and int8-resident weights under ONE
+  fixed HBM budget; fails unless the int8 arm admits >= 2x the
+  lanes x context (and KV blocks), the logits A-B guard accepts the
+  greedy outputs, and both shapes compile exactly once on both arms.
 
 Runs under JAX_PLATFORMS=cpu (tiny preset) or on real hardware with a
 bigger preset. JSON output matches the BENCH_*.json shape::
@@ -332,6 +337,150 @@ def run_speculate_smoke() -> dict:
     buys nothing here (run_smoke precedent); the tokens/s ratio rides
     along for the trajectory."""
     result = run_speculate(preset="tiny", max_new=48, reps=1)
+    if result["failed"]:
+        raise AssertionError("; ".join(result["failed"]))
+    return result
+
+
+def run_quantized(preset="tiny", requests=24, max_new=12, block_size=4,
+                  max_context=64, chunk=8, seed=0, group=16,
+                  f32_lanes=2, max_lanes=16) -> dict:
+    """The weight-plane capacity measurement: the SAME model and
+    workload served from f32-resident weights and from int8-resident
+    weights (serving/weightplane.py, full policy: layer matmuls +
+    embedding + head) under ONE fixed HBM budget — f32 weights plus
+    ``f32_lanes`` full-context lanes of KV. The engine sizes its KV
+    pool and decode lanes against the MEASURED resident-weight bytes,
+    so the int8 arm's freed HBM shows up directly as lanes x context.
+
+    The hard capacity contract (``failed``, all deterministic):
+
+    - the int8 arm admits >= 2x the lanes x context of the f32 arm at
+      the same ``serving.kv.hbm.bytes``-equivalent budget (and >= 2x
+      the usable KV blocks);
+    - greedy-output acceptance via the logits A-B guard
+      (``run_weight_ab``: teacher-forced argmax agreement + bounded
+      logit divergence over identical inputs);
+    - both step shapes compile exactly once on both arms.
+
+    tokens/s is reported for both arms (wall-clock — advisory on a
+    contended CPU box; the capacity numbers are the stable signal)."""
+    import jax
+    import numpy as np
+
+    from hadoop_tpu.models.config import get_config
+    from hadoop_tpu.models.decoder import count_params, init_params
+    from hadoop_tpu.serving.engine import DecodeEngine, SamplingParams
+    from hadoop_tpu.serving.weightplane import (WeightPlaneConfig,
+                                                quantize_params,
+                                                resident_weight_bytes,
+                                                run_weight_ab)
+
+    cfg = get_config(preset)
+    rng = np.random.default_rng(seed)
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    wp = WeightPlaneConfig(tier="relaxed", group=group,
+                           quant_embed=True, quant_head=True)
+    qparams, qreport = quantize_params(params, cfg, wp)
+    wb_f32 = resident_weight_bytes(params)
+    wb_int8 = resident_weight_bytes(qparams)
+    # one budget for both arms: f32 weights + f32_lanes full-context
+    # lanes of KV (+ scratch/slack) — what a chip sized for the f32
+    # model actually has
+    bps = -(-min(max_context, cfg.max_seq) // block_size)
+    block_nbytes = (2 * cfg.n_layers * block_size * cfg.n_kv_heads *
+                    cfg.head_dim * jax.numpy.dtype(cfg.jax_dtype).itemsize)
+    budget = wb_f32 + (f32_lanes * bps + 2) * block_nbytes
+
+    sampling = SamplingParams(max_new_tokens=max_new)
+    s_max = bps * block_size
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            size=int(rng.integers(4, max(5, s_max
+                                                         - max_new - 1)))
+                            ).tolist()
+               for _ in range(requests)]
+
+    def arm(p, quantize_seconds=0.0):
+        eng = DecodeEngine(p, cfg, max_batch=None, block_size=block_size,
+                           max_context=max_context, prefill_chunk=chunk,
+                           hbm_bytes=budget, max_lanes=max_lanes,
+                           quantize_seconds=quantize_seconds)
+        eng.generate([prompts[0][:2]], SamplingParams(max_new_tokens=2))
+        t0 = time.monotonic()
+        reqs = [eng.submit(pr, sampling) for pr in prompts]
+        steps0 = eng.steps
+        while not all(r.done.is_set() for r in reqs):
+            eng.step()
+        elapsed = time.monotonic() - t0
+        tokens = sum(len(r.out_tokens) for r in reqs)
+        plane = eng.weight_plane()
+        return {
+            "tokens_per_sec": round(tokens / elapsed, 1),
+            "elapsed_s": round(elapsed, 3),
+            "decode_steps": eng.steps - steps0,
+            "lanes": eng.max_batch,
+            "max_context": eng.s_max,
+            "lanes_x_context": plane["lanes_x_context"],
+            "kv_blocks": eng.pool.num_usable,
+            "kv_capacity_tokens": plane["kv_capacity_tokens"],
+            "weight_bytes": plane["weight_bytes"],
+            "weight_dtype": plane["dtype"],
+            "decode_compiles": eng.decode_compiles,
+            "prefill_compiles": eng.prefill_compiles,
+        }
+
+    f32 = arm(params)
+    int8 = arm(qparams, qreport["quantize_seconds"])
+    guard = run_weight_ab(cfg, params, qparams, seed=seed, wp=wp)
+    cap_ratio = int8["lanes_x_context"] / max(1, f32["lanes_x_context"])
+    blocks_ratio = int8["kv_blocks"] / max(1, f32["kv_blocks"])
+    failed = []
+    if cap_ratio < 2.0:
+        failed.append(
+            f"int8 arm admits only {cap_ratio:.2f}x the lanes x context "
+            f"of the f32 arm at the same HBM budget (contract: >= 2x)")
+    if blocks_ratio < 2.0:
+        failed.append(
+            f"int8 arm holds only {blocks_ratio:.2f}x the KV blocks of "
+            f"the f32 arm at the same HBM budget (contract: >= 2x)")
+    if not guard.get("accepted"):
+        failed.append(f"logits/output A-B guard rejected the int8 "
+                      f"weight plane: {guard.get('reason')}")
+    for name, r in (("f32", f32), ("int8", int8)):
+        for counter in ("decode_compiles", "prefill_compiles"):
+            if r[counter] != 1:
+                failed.append(
+                    f"{name}: {counter} == {r[counter]} (expected "
+                    f"exactly 1 — shape retracing crept in)")
+    return {
+        "metric": "serve_quantized_capacity_ratio",
+        "value": round(cap_ratio, 3),
+        "unit": "x lanes*context at fixed HBM",
+        "preset": preset,
+        "n_params": count_params(params),
+        "hbm_budget_bytes": int(budget),
+        "weight_bytes_f32": wb_f32,
+        "weight_bytes_int8": wb_int8,
+        "weight_bytes_ratio": round(wb_f32 / wb_int8, 3),
+        "quantize_seconds": qreport["quantize_seconds"],
+        "kv_blocks_ratio": round(blocks_ratio, 3),
+        "tokens_per_sec_f32": f32["tokens_per_sec"],
+        "tokens_per_sec_int8": int8["tokens_per_sec"],
+        "weight_plane": {k: v for k, v in qreport.items()
+                         if not k.startswith("_")},
+        "guard": guard,
+        "f32": f32,
+        "int8": int8,
+        "failed": failed,
+    }
+
+
+def run_quantized_smoke() -> dict:
+    """Tiny-config weight-plane smoke for benchmarks.run_all: raises
+    unless the capacity contract holds (>= 2x lanes x context and KV
+    blocks at fixed HBM, logits A-B guard accepted, compile-once per
+    shape on both arms)."""
+    result = run_quantized(preset="tiny")
     if result["failed"]:
         raise AssertionError("; ".join(result["failed"]))
     return result
@@ -1006,6 +1155,16 @@ def main(argv=None) -> int:
                          "hit-rate recovery, and a heavy tenant is "
                          "shed (429) under overload while a light "
                          "tenant keeps being served")
+    ap.add_argument("--quantized", action="store_true",
+                    help="weight-plane A-B: the same model served from "
+                         "f32- and int8-resident weights under ONE "
+                         "fixed HBM budget; fails unless the int8 arm "
+                         "admits >= 2x the lanes x context (and KV "
+                         "blocks), the logits A-B guard accepts the "
+                         "greedy outputs, and both step shapes compile "
+                         "exactly once on both arms")
+    ap.add_argument("--group", type=int, default=16,
+                    help="weight scale-group size (--quantized)")
     ap.add_argument("--prefix-groups", type=int, default=4)
     ap.add_argument("--shared-len", type=int, default=80)
     ap.add_argument("--no-prefix-cache", action="store_true",
@@ -1048,6 +1207,15 @@ def main(argv=None) -> int:
         failed = result["failed"]
         for msg in result["warnings"]:
             print(f"WARN: {msg}", file=sys.stderr)
+    elif args.quantized:
+        result = run_quantized(preset=args.preset,
+                               requests=args.requests,
+                               max_new=args.max_new,
+                               block_size=args.block_size,
+                               max_context=args.max_context,
+                               chunk=args.chunk, seed=args.seed,
+                               group=args.group)
+        failed = result["failed"]
     elif args.storm:
         result = run_storm(preset=args.preset)
         failed = result["failed"]

@@ -1,18 +1,21 @@
 """Parity-tier discipline — the relaxed plane stays behind its gate.
 
-``parity/relaxed-gated`` — a call to a quantized-collective or
-chunked-matmul entry point (the relaxed parity tier,
-``hadoop_tpu/parallel/lowp``) that is not lexically inside a guard
-naming the relaxed tier. The tier's whole contract is that
-``parallel.parity=bitwise`` (the default) compiles byte-identical
-graphs with zero lowp code reachable; one unguarded call site quietly
-quantizes a collective for every user and turns the bitwise parity
-tests into liars. The guard is judged lexically: some enclosing ``if``
-(or ternary) whose test mentions an identifier containing ``relaxed``
-— ``if ctx.relaxed_codec is not None:``, ``if relaxed is not None:``,
-``if parity.relaxed:`` all qualify — which is also why the tier's
-plumbing NAMES everything ``relaxed``. Definitions inside the lowp
-package itself are exempt (they are the tier).
+``parity/relaxed-gated`` — a call to a quantized-collective,
+chunked-matmul or quantized-weight entry point (the relaxed parity
+tiers: ``parallel.parity`` for the training communication plane in
+``hadoop_tpu/parallel/lowp``, ``serving.parity`` for the serving
+weight plane in ``hadoop_tpu/serving/weightplane.py``) that is not
+lexically inside a guard naming the relaxed tier. Each tier's whole
+contract is that its bitwise default compiles byte-identical graphs
+with zero quantized code reachable; one unguarded call site quietly
+quantizes a collective (or a resident weight) for every user and
+turns the bitwise parity tests into liars. The guard is judged
+lexically: some enclosing ``if`` (or ternary) whose test mentions an
+identifier containing ``relaxed`` — ``if ctx.relaxed_codec is not
+None:``, ``if relaxed is not None:``, ``if self._relaxed_weights:``
+all qualify — which is also why both tiers' plumbing NAMES everything
+``relaxed``. Definitions inside the tier packages themselves are
+exempt (they are the tier).
 """
 
 from __future__ import annotations
@@ -23,18 +26,26 @@ from typing import List, Optional, Set
 from hadoop_tpu.analysis.core import (Checker, Finding, SourceModule,
                                       attr_chain)
 
-# the relaxed tier's entry points: the in-graph quantized collectives
-# (parallel/lowp/quant.py) and the reassociating chunked matmul
-# (ops/collective_matmul.py). Matched by trailing name so both
+# the relaxed tiers' entry points: the in-graph quantized collectives
+# (parallel/lowp/quant.py), the reassociating chunked matmul
+# (ops/collective_matmul.py), and the serving weight plane's
+# dequantizing matmul/gather/head + its quantize-at-load seam
+# (serving/weightplane.py). Matched by trailing name so both
 # `psum_quantized(...)` and `quant.psum_quantized(...)` resolve.
 ENTRY_POINTS = frozenset({
     "psum_quantized",
     "psum_scatter_quantized",
     "psum_of_scatter_quantized",
     "chunked_matmul_reduce",
+    # serving weight plane (serving.parity)
+    "qdot",
+    "qrows",
+    "qhead",
+    "quantized_load",
 })
 
 _LOWP_PKG = "hadoop_tpu.parallel.lowp"
+_WEIGHTPLANE_MOD = "hadoop_tpu.serving.weightplane"
 
 
 def _mentions_relaxed(test: ast.AST) -> bool:
@@ -63,17 +74,20 @@ class RelaxedGateChecker(Checker):
 
     def check_module(self, mod: SourceModule) -> List[Finding]:
         if mod.dotted == _LOWP_PKG or \
-                mod.dotted.startswith(_LOWP_PKG + "."):
-            return []   # the tier itself
+                mod.dotted.startswith(_LOWP_PKG + ".") or \
+                mod.dotted == _WEIGHTPLANE_MOD:
+            return []   # the tiers themselves
         findings: List[Finding] = []
         # entry points stay entry points under a rename
         # (`from ...lowp.quant import psum_quantized as pq`); other
-        # lowp symbols (ParityConfig, the guard harness, the host
-        # payload codec) are tier PLUMBING, not quantized paths
+        # tier symbols (ParityConfig/WeightPlaneConfig, the guard
+        # harnesses, the host payload codecs) are tier PLUMBING, not
+        # quantized paths
         imported: Set[str] = set()
         for node in ast.walk(mod.tree):
             if isinstance(node, ast.ImportFrom) and node.module and \
-                    node.module.startswith(_LOWP_PKG):
+                    (node.module.startswith(_LOWP_PKG) or
+                     node.module == _WEIGHTPLANE_MOD):
                 for alias in node.names:
                     if alias.name in ENTRY_POINTS:
                         imported.add(alias.asname or alias.name)

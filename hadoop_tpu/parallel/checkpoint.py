@@ -278,7 +278,9 @@ def latest_step(fs: FileSystem, base_dir: str) -> Optional[int]:
 def load_checkpoint(fs: FileSystem, base_dir: str, like, *,
                     step: Optional[int] = None,
                     mesh: Optional[Mesh] = None, specs=None,
-                    io_workers: int = 1):
+                    io_workers: int = 1,
+                    leaf_transform: Optional[Callable[[str, np.ndarray],
+                                                      Any]] = None):
     """Load a checkpoint into the structure of ``like`` (a pytree of
     arrays or ShapeDtypeStructs). With ``mesh``+``specs`` the leaves are
     placed sharded (resharding from the saved layout is implicit).
@@ -289,6 +291,17 @@ def load_checkpoint(fs: FileSystem, base_dir: str, like, *,
     IO fan-in latency, and the pool overlaps it the way hedged reads
     overlap a single slow replica. Only shards of leaves present in
     ``like`` are fetched (a serving load never reads optimizer shards).
+
+    ``leaf_transform(name, array)`` switches the load to STREAMING
+    mode: leaves are fetched one at a time (shards of each leaf still
+    ride the pool concurrently), the transform consumes the assembled
+    host array immediately, and its result — a plain array or a small
+    pytree of arrays (the serving weight plane returns int8 payload +
+    scale dicts) — is what lands on device. The assembled f32 buffer
+    dies as soon as the transform returns, so peak host memory is
+    bounded by the LARGEST leaf, never the whole checkpoint — the
+    contract quantize-at-load relies on. Not combinable with
+    ``mesh``/``specs`` (a transformed leaf has no single spec).
     """
     if step is None:
         step = latest_step(fs, base_dir)
@@ -299,6 +312,14 @@ def load_checkpoint(fs: FileSystem, base_dir: str, like, *,
 
     spec_by_name = dict(_leaf_paths(specs)) if specs is not None else {}
     flat, treedef = jax.tree_util.tree_flatten_with_path(like)
+
+    if leaf_transform is not None:
+        if mesh is not None or specs is not None:
+            raise NotImplementedError(
+                "leaf_transform streams leaves through a host-side "
+                "transform and cannot compose with sharded placement")
+        return _load_streaming(fs, ckpt_dir, manifest, flat, treedef,
+                               step, io_workers, leaf_transform)
 
     raw_by_file: Dict[str, bytes] = {}
     if io_workers > 1:
@@ -338,4 +359,47 @@ def load_checkpoint(fs: FileSystem, base_dir: str, like, *,
         return jax.numpy.asarray(out)
 
     rebuilt = [build(p, leaf) for p, leaf in flat]
+    return jax.tree_util.tree_unflatten(treedef, rebuilt), step
+
+
+def _load_streaming(fs: FileSystem, ckpt_dir: str, manifest: Dict,
+                    flat, treedef, step: int, io_workers: int,
+                    leaf_transform: Callable[[str, np.ndarray], Any]):
+    """The ``leaf_transform`` mode of :func:`load_checkpoint`: one leaf
+    in flight at a time (its shard files fetched concurrently), the
+    transform's result placed on device, the f32 assembly dropped —
+    peak host memory stays ~the largest leaf plus its raw shards."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    ex = ThreadPoolExecutor(max_workers=max(1, io_workers))
+    try:
+        def build(path, leaf):
+            name = jax.tree_util.keystr(path)
+            entry = manifest["leaves"].get(name)
+            if entry is None:
+                raise KeyError(f"checkpoint {ckpt_dir} missing leaf "
+                               f"{name}")
+            shape = tuple(entry["shape"])
+            dtype = np.dtype(entry["dtype"])
+            if tuple(np.shape(leaf)) != shape:
+                raise ValueError(
+                    f"shape mismatch for {name}: checkpoint {shape} vs "
+                    f"expected {tuple(np.shape(leaf))}")
+            shards = entry["shards"]
+            raws = list(ex.map(
+                lambda sh: fs.read_all(f"{ckpt_dir}/{sh['file']}"),
+                shards))
+            out = np.empty(shape, dtype)
+            for sh, raw in zip(shards, raws):
+                idx = tuple(slice(a, b) for a, b in sh["index"])
+                sub = tuple(b - a for a, b in sh["index"])
+                out[idx] = np.frombuffer(raw, dtype).reshape(sub)
+            del raws
+            res = leaf_transform(name, out)
+            del out
+            return jax.tree_util.tree_map(jax.numpy.asarray, res)
+
+        rebuilt = [build(p, leaf) for p, leaf in flat]
+    finally:
+        ex.shutdown(wait=True)
     return jax.tree_util.tree_unflatten(treedef, rebuilt), step

@@ -118,5 +118,33 @@ def parity_from_conf(conf) -> ParityConfig:
         guard_rel_tol=conf.get_float("parallel.lowp.guard.rel-tol", 0.25))
 
 
+# ---- public host-side per-group int8 codec (the kvstore codec.py
+# precedent: ONE quantizer defines every int8 surface). Re-exported
+# lazily — `quant` imports jax, and this package's config surface must
+# stay importable from jax-free processes:
+#
+#   quantize_array(x, codec="int8", group)  -> (q, scales): symmetric
+#       per-group quantization at full +/-127 range, groups of `group`
+#       consecutive elements, one f32 scale per group (amax/qmax).
+#   dequantize_array(q, scales, shape, dtype) -> the reconstruction.
+#   encode_payload / decode_payload          -> the self-describing
+#       wire form (loud failure on codec/shape/dtype mismatch).
+#
+# Consumers: the relaxed collectives here, the serving weight plane
+# (serving/weightplane.py — weight groups ride the contraction dim),
+# and any future int8 surface. Quantization behavior changes happen in
+# quant.py or nowhere.
+_QUANT_API = ("quantize_array", "dequantize_array", "encode_payload",
+              "decode_payload")
+
+
+def __getattr__(name: str):
+    if name in _QUANT_API:
+        from hadoop_tpu.parallel.lowp import quant
+        return getattr(quant, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = ["ParityConfig", "parity_from_conf", "BITWISE_PARITY",
-           "RELAXED_PARITY", "PARITY_KEY", "TIERS", "WIRE_CODECS"]
+           "RELAXED_PARITY", "PARITY_KEY", "TIERS", "WIRE_CODECS",
+           *_QUANT_API]

@@ -408,8 +408,19 @@ def _np_dtype(name) -> np.dtype:
 
 def quantize_array(x: np.ndarray, codec: str = "int8",
                    group: int = 1024) -> Tuple[np.ndarray, np.ndarray]:
-    """Host-side symmetric per-group quantization (test/bench mirror of
-    the in-graph path; full ±127 range — no accumulation headroom)."""
+    """Host-side symmetric per-group quantization — THE public
+    per-group int8 codec (re-exported from ``hadoop_tpu.parallel.lowp``;
+    the kvstore codec.py precedent: one quantizer defines every int8
+    surface). Groups are ``group`` consecutive elements of the
+    flattened array, one f32 scale per group (amax / qmax), full ±127
+    range — no accumulation headroom, resident/payload data sums
+    nothing in-wire. Contract pins consumers rely on: an all-zeros
+    group decodes to exact zeros (the _TINY scale floor), and
+    ``scales.size == ceil(x.size / group)``. Consumers: the relaxed
+    collectives above, the serving weight plane
+    (``serving/weightplane.py`` — weight groups ride the contraction
+    dimension so scales dequantize next to the MXU), the payload codec
+    below."""
     if codec not in WIRE_CODECS:
         raise ValueError(f"unknown wire codec {codec!r} "
                          f"(must be one of {WIRE_CODECS})")

@@ -8,6 +8,9 @@ The compute plane inherited from the reference is batch-only (PAPER.md
                 (device-resident step state, in-graph stop scan, and a
                 speculation lane verified in the same fused step)
     speculate.py  n-gram / prompt-lookup draft proposer per request
+    weightplane.py  resident-weight dtype/layout policy behind
+                serving.parity: int8 + per-group scales at load,
+                dequantized in-register, freed HBM sized into lanes
     kvstore/    tiered fleet-wide KV cache: HBM radix -> host-RAM ring
                 -> DFS prefix store (+ raw/int8 block codecs)
     server.py   /v1/generate (streaming) + /v1/prefill + /v1/health

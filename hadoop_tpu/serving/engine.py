@@ -93,6 +93,19 @@ Design (TPU-first, same rules as the trainer):
   prefix cache only ever sees accepted, block-aligned tokens. The two
   compiled shapes stay two: ``[B*(k+1)]`` and ``[B*(k+1) + chunk]``.
 
+- **Weight plane.** Resident weights follow the per-tensor policy of
+  ``serving/weightplane.py``: under ``serving.parity=relaxed`` the
+  matmul weights live in HBM as int8 + per-group f32 scales and every
+  serving matmul dequantizes them in-register (weight-only int8 —
+  decode is bandwidth-bound, so ~4x fewer weight-read bytes is decode
+  speed AND freed HBM). ``hbm_bytes`` turns the freed memory into
+  capacity: the KV pool and the decode-lane count are sized against
+  the MEASURED resident-weight bytes, so the int8 plane admits 2-4x
+  the lanes x context of the f32 plane at the same budget. Bitwise
+  (the default) compiles the exact pre-weight-plane graph — zero
+  quantized code reachable, enforced by tpulint's
+  ``parity/relaxed-gated`` checker on the qdot/qrows/qhead call sites.
+
 - **Sharding.** Pass a ``MeshPlan`` (tp only) and the engine places the
   weights with ``parallel.mesh.param_specs`` and the KV pool with heads
   sharded over ``tp``; jit's SPMD partitioner inserts the decode
@@ -125,6 +138,13 @@ from hadoop_tpu.ops.attention import _repeat_kv
 from hadoop_tpu.serving.kvstore import (BlockPool, PrefixCache,
                                         TieredKVCache)
 from hadoop_tpu.serving.speculate import NgramProposer
+# the weight plane (serving/weightplane.py): qdot/qrows/qhead are
+# RELAXED-TIER entry points — every call below sits under an
+# `if self._relaxed_weights ...` guard, so serving.parity=bitwise (the
+# default) compiles zero quantized code (tpulint-enforced)
+from hadoop_tpu.serving.weightplane import (describe_tree, is_qtensor,
+                                            is_quantized_tree, qdot,
+                                            qhead, qrows)
 from hadoop_tpu.tracing.tracer import global_tracer
 
 log = logging.getLogger(__name__)
@@ -303,7 +323,7 @@ class DecodeEngine:
     ``step()`` directly (tests, offline bench)."""
 
     def __init__(self, params, cfg: ModelConfig, *,
-                 max_batch: int = 4, block_size: int = 8,
+                 max_batch: Optional[int] = None, block_size: int = 8,
                  num_blocks: Optional[int] = None,
                  max_context: Optional[int] = None,
                  prefill_chunk: int = 16,
@@ -313,12 +333,13 @@ class DecodeEngine:
                  kv_dfs_min_refs: int = 1, kv_codec: str = "raw",
                  speculate_k: int = 0, speculate_ngram: int = 3,
                  admission_queue=None, drain_persist: bool = True,
+                 hbm_bytes: int = 0, max_lanes: int = 16,
+                 quantize_seconds: float = 0.0,
                  plan=None, metrics=None, tracer=None):
         if cfg.is_moe:
             raise NotImplementedError("serving MoE checkpoints is not "
                                       "wired up yet (dense decoders only)")
         self.cfg = cfg
-        self.max_batch = max_batch
         self.block_size = block_size
         self.prefill_chunk = max(1, int(prefill_chunk))
         self.max_context = min(max_context or cfg.max_seq, cfg.max_seq)
@@ -332,10 +353,55 @@ class DecodeEngine:
                 raise ValueError(f"block_size {block_size} exceeds the "
                                  f"model's max_seq {cfg.max_seq}")
             self.s_max = self.blocks_per_seq * block_size
+        # ---- the weight plane: MEASURED resident bytes decide the KV
+        # budget. serving.parity=relaxed loads int8 weights + per-group
+        # scales (serving/weightplane.py); the freed HBM converts into
+        # more decode lanes x context below, at the same hbm_bytes.
+        self._relaxed_weights = is_quantized_tree(params)
+        self._q_embed = is_qtensor(params.get("embed"))
+        self._q_head = is_qtensor(params["embed"]) if cfg.tie_embeddings \
+            else is_qtensor(params.get("lm_head"))
+        if self._relaxed_weights and plan is not None:
+            raise NotImplementedError(
+                "tp sharding of int8 resident weights is not wired yet "
+                "(serving.parity=relaxed serves single-chip replicas)")
+        # cached once: the params tree never changes after construction,
+        # and /v1/health scrapes weight_plane() every autoscaler poll
+        self._weight_desc = describe_tree(params)
+        self.weight_bytes = self._weight_desc["weight_bytes"]
+        self.quantize_seconds = quantize_seconds
+        self.hbm_bytes = int(hbm_bytes or 0)
+        kv_itemsize = jnp.dtype(cfg.jax_dtype).itemsize
+        self.block_nbytes = (2 * cfg.n_layers * block_size *
+                             cfg.n_kv_heads * cfg.head_dim * kv_itemsize)
+        if self.hbm_bytes:
+            # capacity = budget minus what the weights measurably
+            # occupy; lanes sized so each can hold a full context
+            kv_budget = self.hbm_bytes - self.weight_bytes
+            min_blocks = self.blocks_per_seq + 2  # one lane + scratch
+            if kv_budget < min_blocks * self.block_nbytes:
+                raise ValueError(
+                    f"serving.kv.hbm.bytes={self.hbm_bytes} leaves "
+                    f"{kv_budget} bytes of KV after {self.weight_bytes} "
+                    f"bytes of resident weights — below one "
+                    f"{self.s_max}-token lane "
+                    f"({min_blocks * self.block_nbytes} bytes)")
+            budget_blocks = kv_budget // self.block_nbytes
+            if num_blocks is None:
+                num_blocks = int(budget_blocks)
+            if max_batch is None:
+                max_batch = max(1, min(int(max_lanes),
+                                       (num_blocks - 1)
+                                       // self.blocks_per_seq))
+        if max_batch is None:
+            max_batch = 4
+        self.max_batch = max_batch
         if num_blocks is None:
             num_blocks = max_batch * self.blocks_per_seq + 1
         self.pool = BlockPool(num_blocks, block_size)
         self.metrics = metrics
+        if metrics:
+            metrics.weight_bytes.set(self.weight_bytes)
         self.tracer = tracer or global_tracer()
         # the tier manager owns the radix index and the cold tiers;
         # the engine stays the device owner (extract/inject below)
@@ -459,10 +525,23 @@ class DecodeEngine:
         return rope_frequencies(self.cfg.head_dim, self.cfg.max_seq,
                                 self.cfg.rope_theta)
 
+    def _wdot(self, x, w):
+        """One serving matmul, weight-plane aware: under
+        ``serving.parity=relaxed`` the weight arrives as int8 + scale
+        groups and dequantizes in-register inside the contraction
+        (weightplane.qdot); bitwise (the default) is the plain matmul,
+        byte-identical to the pre-weight-plane engine."""
+        if self._relaxed_weights:
+            return qdot(x, w)
+        return x @ w
+
     def _mlp(self, x, lp):
         if self.cfg.use_swiglu:
-            return swiglu(x @ lp["w_gate"], x @ lp["w_up"]) @ lp["w_down"]
-        return gelu(x @ lp["w_in"] + lp["b_in"]) @ lp["w_out"] + lp["b_out"]
+            return self._wdot(swiglu(self._wdot(x, lp["w_gate"]),
+                                     self._wdot(x, lp["w_up"])),
+                              lp["w_down"])
+        return self._wdot(gelu(self._wdot(x, lp["w_in"]) + lp["b_in"]),
+                          lp["w_out"]) + lp["b_out"]
 
     def _step_impl(self, params, kp, vp, state, drafts, draft_lens,
                    chunk):
@@ -550,7 +629,12 @@ class DecodeEngine:
 
         hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
         cos, sin = self._rope_tables()
-        h = params["embed"][tokens]
+        if self._relaxed_weights and self._q_embed:
+            # quantized embedding gather (policy-selectable; norms and
+            # pos_embed never quantize)
+            h = qrows(params["embed"], tokens, cfg.jax_dtype)
+        else:
+            h = params["embed"][tokens]
         if not cfg.use_rope:
             h = h + params["pos_embed"][
                 jnp.clip(pos, 0, cfg.max_seq - 1)]
@@ -564,9 +648,9 @@ class DecodeEngine:
         def layer(h, xs):
             lp, kc, vc = xs
             x = _norm(h, lp["attn_norm_w"], lp.get("attn_norm_b"), cfg)
-            q = (x @ lp["wq"]).reshape(t, hq, dh)
-            k = (x @ lp["wk"]).reshape(t, hkv, dh)
-            v = (x @ lp["wv"]).reshape(t, hkv, dh)
+            q = self._wdot(x, lp["wq"]).reshape(t, hq, dh)
+            k = self._wdot(x, lp["wk"]).reshape(t, hkv, dh)
+            v = self._wdot(x, lp["wv"]).reshape(t, hkv, dh)
             if cfg.use_rope:
                 q = _rope_at(q, cos, sin, pos)
                 k = _rope_at(k, cos, sin, pos)
@@ -585,15 +669,19 @@ class DecodeEngine:
             logits = jnp.where(mask[:, None, :], logits, _NEG_INF)
             probs = jax.nn.softmax(logits, axis=-1).astype(vr.dtype)
             attn = jnp.einsum("bhk,bkhd->bhd", probs, vr)
-            h2 = h + (attn.reshape(t, hq * dh) @ lp["wo"]).astype(h.dtype)
+            h2 = h + self._wdot(attn.reshape(t, hq * dh),
+                                lp["wo"]).astype(h.dtype)
             x2 = _norm(h2, lp["mlp_norm_w"], lp.get("mlp_norm_b"), cfg)
             return h2 + self._mlp(x2, lp).astype(h.dtype), (kc, vc)
 
         h, (kp, vp) = jax.lax.scan(layer, h, (params["layers"], kp, vp))
         h = _norm(h, params["final_norm_w"], params.get("final_norm_b"),
                   cfg)
-        logits = (h @ head_matrix(params, cfg, h.dtype)).astype(
-            jnp.float32)
+        if self._relaxed_weights and self._q_head:
+            logits = qhead(params, h, cfg).astype(jnp.float32)
+        else:
+            logits = (h @ head_matrix(params, cfg, h.dtype)).astype(
+                jnp.float32)
 
         # ---- sample + verify (the key derives from the carried seed:
         # identical to the old host-side PRNGKey(step_counter))
@@ -774,6 +862,25 @@ class DecodeEngine:
         with self._cond:
             has_pending = bool(self._pending)
         return not has_pending and all(r is None for r in self._slots)
+
+    def weight_plane(self) -> Dict[str, Any]:
+        """The resident-weight policy and the capacity it bought —
+        /v1/health, the registry record and the bench all read this:
+        dtype, MEASURED weight bytes, quantize-at-load seconds, and the
+        lanes x context the KV budget admits at those bytes."""
+        desc = self._weight_desc
+        return {
+            "parity": "relaxed" if self._relaxed_weights else "bitwise",
+            "dtype": desc["dtype"],
+            "weight_bytes": self.weight_bytes,
+            "quantize_seconds": self.quantize_seconds,
+            "quantized_leaves": desc["int8_leaves"],
+            "hbm_bytes": self.hbm_bytes,
+            "lanes": self.max_batch,
+            "max_context": self.s_max,
+            "kv_capacity_tokens": self.pool.num_usable * self.block_size,
+            "lanes_x_context": self.max_batch * self.s_max,
+        }
 
     def cache_stats(self) -> Dict[str, Any]:
         """Prefix-cache + chunked-prefill observability (health, bench)."""

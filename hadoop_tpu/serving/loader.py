@@ -47,13 +47,18 @@ def serving_read_defaults(conf) -> None:
 def load_serving_params(fs, base_dir: str, cfg: ModelConfig, *,
                         step: Optional[int] = None,
                         mesh=None, specs=None,
-                        io_workers: int = 4) -> Tuple[dict, int]:
+                        io_workers: int = 4,
+                        leaf_transform=None) -> Tuple[dict, int]:
     """Load decoder params for ``cfg`` from ``base_dir`` on ``fs``.
 
     Returns ``(params, step)``. With ``mesh`` + ``specs`` the leaves are
     placed sharded (the engine passes ``param_specs`` when it owns a
     mesh). ``io_workers`` bounds the concurrent shard fetches (1 =
-    sequential). Raises FileNotFoundError when no complete checkpoint
+    sequential). ``leaf_transform`` switches ``load_checkpoint`` to its
+    streaming per-leaf mode — the weight plane's quantize-at-load seam
+    (``serving/weightplane.py``): each assembled leaf is consumed the
+    moment its shards arrive, so the full f32 model is never resident
+    on the host. Raises FileNotFoundError when no complete checkpoint
     exists.
     """
     t0 = time.monotonic()
@@ -72,7 +77,8 @@ def load_serving_params(fs, base_dir: str, cfg: ModelConfig, *,
         else specs
     tree, step = load_checkpoint(fs, base_dir, like, step=step,
                                  mesh=mesh, specs=spec_tree,
-                                 io_workers=max(1, io_workers))
+                                 io_workers=max(1, io_workers),
+                                 leaf_transform=leaf_transform)
     params = tree["params"] if wrapped else tree
     n = sum(x.size for x in jax.tree_util.tree_leaves(params))
     log.info("loaded %d-param checkpoint step %d from %s in %.2fs "

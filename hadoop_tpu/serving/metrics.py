@@ -46,6 +46,11 @@ class ServingMetrics:
     - ``qos_admitted`` / ``qos_shed``  door QoS gate outcomes (sheds
                              are 429 + Retry-After responses)
     - ``qos_tenants``        tenants tracked by the decay scheduler
+    - ``weight_bytes``       measured resident model weight bytes
+                             (``htpu_weight_bytes`` on ``/prom`` — the
+                             weight-plane capacity signal: int8 resident
+                             weights shrink it ~4x and the KV budget
+                             grows by exactly the difference)
     """
 
     def __init__(self, source: str = SOURCE):
@@ -143,6 +148,11 @@ class ServingMetrics:
             "requests shed (429 + Retry-After) at the serving door")
         self.qos_tenants = reg.gauge(
             "qos_tenants", "tenants tracked by the decay cost scheduler")
+        # the weight plane: measured resident weight bytes (int8
+        # payloads + scale planes under serving.parity=relaxed, plain
+        # dtype bytes bitwise) — the number the KV budget subtracts
+        self.weight_bytes = reg.gauge(
+            "weight_bytes", "resident model weight bytes on the chip")
 
     def snapshot(self):
         return self.registry.snapshot()

@@ -143,6 +143,10 @@ class ServingServer:
             # prefix-reuse cache + chunked-prefill observability: the
             # router and ops dashboards read hit_rate/cached_blocks here
             "prefix_cache": eng.cache_stats(),
+            # the weight plane: resident dtype, measured weight bytes,
+            # quantize-at-load seconds, and the lanes x context those
+            # bytes left room for (serving/weightplane.py)
+            "weights": eng.weight_plane(),
         }
         if self.qos is not None:
             out["qos"] = self.qos.stats()

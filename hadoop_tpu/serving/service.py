@@ -104,10 +104,24 @@ class ServingReplica:
         cfg = get_config(preset)
         fs = FileSystem.get(checkpoint, conf)
         ckpt_dir = Path(checkpoint).path
+        # the weight plane (serving/weightplane.py): serving.parity
+        # picks the tier. bitwise (default) loads the checkpoint's own
+        # dtypes untouched; relaxed streams each shard through the int8
+        # quantizer at load so the full f32 model is never host-resident
+        from hadoop_tpu.serving.weightplane import weightplane_from_conf
+        weights = weightplane_from_conf(conf)
         t0 = time.monotonic()
-        params, step = load_serving_params(
-            fs, ckpt_dir, cfg,
-            io_workers=conf.get_int(IO_WORKERS_KEY, 4))
+        self.quantize_seconds = 0.0
+        if weights.relaxed:
+            from hadoop_tpu.serving.weightplane import quantized_load
+            params, step, wreport = quantized_load(
+                fs, ckpt_dir, cfg, weights,
+                io_workers=conf.get_int(IO_WORKERS_KEY, 4))
+            self.quantize_seconds = wreport["quantize_seconds"]
+        else:
+            params, step = load_serving_params(
+                fs, ckpt_dir, cfg,
+                io_workers=conf.get_int(IO_WORKERS_KEY, 4))
         self.load_seconds = round(time.monotonic() - t0, 3)
         self.step = step
         # the tiered KV cache: host-RAM spill ring byte budget, and the
@@ -145,7 +159,9 @@ class ServingReplica:
             qos_queue = FairAdmissionQueue(qos_sched)
         self.engine = DecodeEngine(
             params, cfg,
-            max_batch=conf.get_int("serving.max.batch", 4),
+            # unset = engine default (4), or budget-derived lanes when
+            # serving.kv.hbm.bytes is set
+            max_batch=conf.get_int("serving.max.batch", 0) or None,
             block_size=conf.get_int("serving.kv.block.size", 16),
             num_blocks=conf.get_int("serving.kv.num.blocks", 0) or None,
             max_context=conf.get_int("serving.max.context", 0) or None,
@@ -167,6 +183,13 @@ class ServingReplica:
             # the DFS tier before this replica exits
             drain_persist=conf.get_bool("serving.kv.drain.persist",
                                         True),
+            # fixed HBM budget: KV pool (and lanes, when
+            # serving.max.batch is unset) sized against the MEASURED
+            # resident-weight bytes — int8 weights become lanes, capped
+            # by serving.max.lanes (step rows scale with the lane count)
+            hbm_bytes=conf.get_int("serving.kv.hbm.bytes", 0),
+            max_lanes=conf.get_int("serving.max.lanes", 16),
+            quantize_seconds=self.quantize_seconds,
             metrics=metrics)
         qos_gate = None
         if self.qos_enabled:
@@ -229,6 +252,16 @@ class ServingReplica:
                             # AHEAD of (a 5-minute load means growing
                             # 5 minutes before saturation)
                             "load_seconds": str(self.load_seconds),
+                            # the weight plane: resident dtype +
+                            # measured bytes + quantize-at-load cost —
+                            # an autoscaler/dashboard reads capacity
+                            # and cold-start directly off the record
+                            "weight_dtype":
+                                self.engine.weight_plane()["dtype"],
+                            "weight_bytes":
+                                str(self.engine.weight_bytes),
+                            "quantize_seconds":
+                                str(self.quantize_seconds),
                             # disaggregation + tier capacities: the
                             # router routes long prompts to role=prefill
                             # and decodes on decode/mixed; an autoscaler

@@ -844,6 +844,53 @@ def test_relaxed_guarded_entry_points_are_clean(tmp_path):
     assert findings == []
 
 
+def test_unguarded_weightplane_entry_points_are_flagged(tmp_path):
+    """The serving weight plane's entry points (qdot/qrows/qhead and
+    the quantize-at-load seam) are relaxed-tier entry points too:
+    unguarded calls would quantize resident weights for every
+    serving.parity=bitwise user."""
+    from hadoop_tpu.analysis import RelaxedGateChecker
+    findings = lint_source(tmp_path, """
+        from hadoop_tpu.serving.weightplane import qdot, quantized_load
+
+        def project(x, w):
+            return qdot(x, w)                                 # BAD
+
+        def head(params, h, cfg):
+            from hadoop_tpu.serving.weightplane import qhead
+            return qhead(params, h, cfg)                      # BAD
+
+        def load(fs, d, cfg, w):
+            return quantized_load(fs, d, cfg, w)              # BAD
+    """, [RelaxedGateChecker()])
+    assert len(findings) == 3
+    assert all(f.checker == "parity/relaxed-gated" for f in findings)
+
+
+def test_guarded_weightplane_entry_points_are_clean(tmp_path):
+    from hadoop_tpu.analysis import RelaxedGateChecker
+    findings = lint_source(tmp_path, """
+        from hadoop_tpu.serving.weightplane import (qdot, qrows,
+                                                    weightplane_from_conf)
+
+        class Engine:
+            def _wdot(self, x, w):
+                if self._relaxed_weights:
+                    return qdot(x, w)
+                return x @ w
+
+            def embed(self, params, tokens, dtype):
+                if self._relaxed_weights and self._q_embed:
+                    return qrows(params["embed"], tokens, dtype)
+                return params["embed"][tokens]
+
+        def plumbing(conf):
+            # tier plumbing is not a quantized path: never flagged
+            return weightplane_from_conf(conf)
+    """, [RelaxedGateChecker()])
+    assert findings == []
+
+
 def test_lowp_package_itself_is_exempt(tmp_path):
     from hadoop_tpu.analysis import RelaxedGateChecker
     pkg = tmp_path / "hadoop_tpu" / "parallel" / "lowp"
