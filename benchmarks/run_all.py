@@ -131,6 +131,18 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — recorded for the
         # trajectory; must not discard the benches already computed
         out["serving_churn"] = {"error": f"{type(e).__name__}: {e}"}
+    # Long-context smoke: a prompt 8x one chip's KV budget prefilled
+    # context-parallel across an 8-dev subprocess mesh, KV streamed
+    # into the host/DFS tiers, decoded through the real door with an
+    # exact single-chip reference match, CP guards accepted, hit-tier
+    # counters live, and every longctx shape compiled exactly once.
+    # Recorded, not raised.
+    try:
+        from benchmarks import longctx_smoke
+        out["serving_longctx"] = longctx_smoke.run(quick=args.quick)
+    except Exception as e:  # noqa: BLE001 — recorded for the
+        # trajectory; must not discard the benches already computed
+        out["serving_longctx"] = {"error": f"{type(e).__name__}: {e}"}
     # Elastic-fleet storm smoke: step-function load against a mini-fleet
     # of real `hadoop-tpu serve` subprocesses + the autoscaler — fleet
     # must grow, hold TTFT p99 within the SLO after settling, scale back

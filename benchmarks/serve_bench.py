@@ -25,6 +25,12 @@ Two workload modes:
   fixed HBM budget; fails unless the int8 arm admits >= 2x the
   lanes x context (and KV blocks), the logits A-B guard accepts the
   greedy outputs, and both shapes compile exactly once on both arms.
+- ``--longctx``: the long-context arm (``benchmarks/longctx_smoke``,
+  8-virtual-device subprocess): a prompt 8x one chip's KV budget
+  prefilled context-parallel across the mesh, KV streamed into the
+  host/DFS tiers, decoded through the real door with an exact
+  single-chip reference match; CP guards + compile-once + hit-tier
+  counters asserted, TTFT-by-chips recorded.
 
 Runs under JAX_PLATFORMS=cpu (tiny preset) or on real hardware with a
 bigger preset. JSON output matches the BENCH_*.json shape::
@@ -1165,6 +1171,14 @@ def main(argv=None) -> int:
                          "exactly once on both arms")
     ap.add_argument("--group", type=int, default=16,
                     help="weight scale-group size (--quantized)")
+    ap.add_argument("--longctx", action="store_true",
+                    help="long-context arm (benchmarks/longctx_smoke "
+                         "in an 8-virtual-device subprocess): a prompt "
+                         "8x one chip's KV budget prefilled context-"
+                         "parallel, KV streamed into the host/DFS "
+                         "tiers, decoded through the real door with "
+                         "an exact single-chip reference match, CP "
+                         "guards accepted, TTFT-by-chips recorded")
     ap.add_argument("--prefix-groups", type=int, default=4)
     ap.add_argument("--shared-len", type=int, default=80)
     ap.add_argument("--no-prefix-cache", action="store_true",
@@ -1216,6 +1230,11 @@ def main(argv=None) -> int:
                                chunk=args.chunk, seed=args.seed,
                                group=args.group)
         failed = result["failed"]
+    elif args.longctx:
+        from benchmarks import longctx_smoke
+        result = longctx_smoke.run()
+        failed = result.get("failed") or (
+            [result["error"]] if "error" in result else [])
     elif args.storm:
         result = run_storm(preset=args.preset)
         failed = result["failed"]

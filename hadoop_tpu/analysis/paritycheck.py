@@ -1,11 +1,12 @@
 """Parity-tier discipline — the relaxed plane stays behind its gate.
 
 ``parity/relaxed-gated`` — a call to a quantized-collective,
-chunked-matmul or quantized-weight entry point (the relaxed parity
-tiers: ``parallel.parity`` for the training communication plane in
-``hadoop_tpu/parallel/lowp``, ``serving.parity`` for the serving
-weight plane in ``hadoop_tpu/serving/weightplane.py``) that is not
-lexically inside a guard naming the relaxed tier. Each tier's whole
+chunked-matmul, quantized-weight or context-parallel-serving entry
+point (the relaxed parity tiers: ``parallel.parity`` for the training
+communication plane in ``hadoop_tpu/parallel/lowp``, ``serving.parity``
+for the serving weight plane in ``hadoop_tpu/serving/weightplane.py``
+and the long-context plane in ``hadoop_tpu/serving/longctx/``) that is
+not lexically inside a guard naming the relaxed tier. Each tier's whole
 contract is that its bitwise default compiles byte-identical graphs
 with zero quantized code reachable; one unguarded call site quietly
 quantizes a collective (or a resident weight) for every user and
@@ -42,10 +43,18 @@ ENTRY_POINTS = frozenset({
     "qrows",
     "qhead",
     "quantized_load",
+    # long-context serving plane (serving.parity): CP prefill
+    # reassociates the softmax across ranks, paged decode across
+    # windows — neither is bitwise vs the single-chip step
+    "cp_prefill",
+    "paged_decode",
+    "longctx_submit",
+    "longctx_plane_from_conf",
 })
 
 _LOWP_PKG = "hadoop_tpu.parallel.lowp"
 _WEIGHTPLANE_MOD = "hadoop_tpu.serving.weightplane"
+_LONGCTX_PKG = "hadoop_tpu.serving.longctx"
 
 
 def _mentions_relaxed(test: ast.AST) -> bool:
@@ -75,7 +84,9 @@ class RelaxedGateChecker(Checker):
     def check_module(self, mod: SourceModule) -> List[Finding]:
         if mod.dotted == _LOWP_PKG or \
                 mod.dotted.startswith(_LOWP_PKG + ".") or \
-                mod.dotted == _WEIGHTPLANE_MOD:
+                mod.dotted == _WEIGHTPLANE_MOD or \
+                mod.dotted == _LONGCTX_PKG or \
+                mod.dotted.startswith(_LONGCTX_PKG + "."):
             return []   # the tiers themselves
         findings: List[Finding] = []
         # entry points stay entry points under a rename
@@ -87,7 +98,8 @@ class RelaxedGateChecker(Checker):
         for node in ast.walk(mod.tree):
             if isinstance(node, ast.ImportFrom) and node.module and \
                     (node.module.startswith(_LOWP_PKG) or
-                     node.module == _WEIGHTPLANE_MOD):
+                     node.module == _WEIGHTPLANE_MOD or
+                     node.module.startswith(_LONGCTX_PKG)):
                 for alias in node.names:
                     if alias.name in ENTRY_POINTS:
                         imported.add(alias.asname or alias.name)

@@ -149,8 +149,15 @@ def _norm(x, w, b, cfg: ModelConfig):
 
 # -------------------------------------------------------------- attention
 
-def _attention_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
-    """Pre-norm attention with residual. x: [B, S_local, D]."""
+def _attention_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin,
+                     return_kv: bool = False):
+    """Pre-norm attention with residual. x: [B, S_local, D].
+
+    ``return_kv=True`` also returns this layer's post-RoPE ``(k, v)``
+    shard ([B, S_local, Hkv_local, Dh]) — the long-context serving
+    plane streams exactly these rows into the tiered KV store, and the
+    layout matches what the decode engine scatters into its paged pool
+    (KV is cached post-rotation there too)."""
     resid = x
     h = _norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"), cfg)
 
@@ -191,7 +198,10 @@ def _attention_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
     from hadoop_tpu.ops.collective_matmul import row_parallel_project
     out = row_parallel_project(
         attn.reshape(B, S, hq_local * cfg.head_dim), lp["wo"], ctx)
-    return resid + out.astype(resid.dtype)
+    y = resid + out.astype(resid.dtype)
+    if return_kv:
+        return y, (k, v)
+    return y
 
 
 # -------------------------------------------------------------------- mlp
@@ -226,6 +236,32 @@ def layer_forward(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
     x = _attention_block(x, lp, cfg, ctx, cos, sin)
     x = _mlp_block(x, lp, cfg, ctx)
     return x
+
+
+def layer_forward_kv(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
+    """One transformer block, also returning the layer's post-RoPE
+    ``(k, v)`` shard — the KV-capturing twin of ``layer_forward`` the
+    long-context prefill plane scans with."""
+    x, kv = _attention_block(x, lp, cfg, ctx, cos, sin, return_kv=True)
+    return _mlp_block(x, lp, cfg, ctx), kv
+
+
+def run_layers_kv(x, layers, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
+    """scan the layer stack over x, collecting every layer's post-RoPE
+    K/V as scan outputs. Returns ``(h, (k, v))`` with k/v shaped
+    ``[L, B, S_local, Hkv_local, Dh]`` — the prefill side of the
+    long-context serving plane (``serving/longctx``), which slices
+    these into block-sized chunks for the tiered KV store. No remat:
+    inference-only (nothing differentiates through it)."""
+    from hadoop_tpu.ops.vma import pvary_to, tree_vma, vma_of
+
+    def step(h, lp):
+        h2, kv = layer_forward_kv(h, lp, cfg, ctx, cos, sin)
+        return h2, kv
+
+    out, kvs = jax.lax.scan(
+        step, pvary_to(x, vma_of(x) | tree_vma(layers)), layers)
+    return out, kvs
 
 
 def run_layers(x, layers, cfg: ModelConfig, ctx: ParallelCtx, cos, sin,

@@ -13,6 +13,9 @@ The compute plane inherited from the reference is batch-only (PAPER.md
                 dequantized in-register, freed HBM sized into lanes
     kvstore/    tiered fleet-wide KV cache: HBM radix -> host-RAM ring
                 -> DFS prefix store (+ raw/int8 block codecs)
+    longctx/    long-context plane (serving.parity=relaxed only):
+                context-parallel prefill across the replica's mesh,
+                KV streamed into the cold tiers, working-set decode
     server.py   /v1/generate (streaming) + /v1/prefill + /v1/health
                 + /v1/admin/drain (autoscaler-initiated retirement)
     router.py   registry discovery, role- and prefix-affinity-aware

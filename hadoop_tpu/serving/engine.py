@@ -106,6 +106,17 @@ Design (TPU-first, same rules as the trainer):
   quantized code reachable, enforced by tpulint's
   ``parity/relaxed-gated`` checker on the qdot/qrows/qhead call sites.
 
+- **Long-context lane.** With a ``serving/longctx`` plane attached
+  (``attach_longctx`` — ``serving.parity=relaxed`` only, the CP
+  softmax reassociation is not bitwise), prompts of at least
+  ``serving.longctx.min.tokens`` bypass the fused step entirely:
+  prefill runs as a context-parallel job across the replica's mesh,
+  the finished KV streams into the host/DFS tiers
+  (``kvstore.ingest_chain``, digest-chained), and decode pages a
+  working set back through a fixed device window — the prompt never
+  has to fit this engine's pool, and the two step shapes here stay
+  exactly two.
+
 - **Sharding.** Pass a ``MeshPlan`` (tp only) and the engine places the
   weights with ``parallel.mesh.param_specs`` and the KV pool with heads
   sharded over ``tp``; jit's SPMD partitioner inserts the decode
@@ -331,6 +342,7 @@ class DecodeEngine:
                  kv_host_bytes: int = 0,
                  kv_store_fs=None, kv_store_dir: str = "/kvcache",
                  kv_dfs_min_refs: int = 1, kv_codec: str = "raw",
+                 kv_fetch_window: int = 4,
                  speculate_k: int = 0, speculate_ngram: int = 3,
                  admission_queue=None, drain_persist: bool = True,
                  hbm_bytes: int = 0, max_lanes: int = 16,
@@ -411,6 +423,7 @@ class DecodeEngine:
             enabled=prefix_cache, host_bytes=kv_host_bytes,
             fs=kv_store_fs, dfs_dir=kv_store_dir,
             dfs_min_refs=kv_dfs_min_refs, codec=kv_codec,
+            fetch_window=kv_fetch_window,
             metrics=metrics, tracer=self.tracer,
             extract=self._extract_block)
         self.prefix_cache = self.kvstore.radix
@@ -486,7 +499,28 @@ class DecodeEngine:
         self.prefix_tokens_matched = 0
         self.prefix_evictions = 0
         self.prefix_inserted_blocks = 0
+        # the long-context plane (serving/longctx): attached after
+        # construction (it reads this engine's kvstore) and ONLY under
+        # serving.parity=relaxed — the CP softmax reassociation is not
+        # bitwise, so the bitwise default must keep it unreachable
+        self._relaxed_longctx = None
         self._step_fn = jax.jit(self._step_impl, donate_argnums=(1, 2, 3))
+
+    def attach_longctx(self, plane) -> None:
+        """Wire the long-context serving plane (``serving/longctx``):
+        prompts at least ``plane.min_tokens`` long route to it from
+        ``submit`` instead of the fused-step path. Caller is the
+        relaxed-tier gate (``longctx_plane_from_conf`` re-validates)."""
+        self._relaxed_longctx = plane
+
+        def wake() -> None:
+            # a drain parked on `idle` in stop() waits on the scheduler
+            # condition; without this, a completion on the plane's own
+            # worker thread would only be seen at the drain deadline
+            with self._cond:
+                self._cond.notify_all()
+
+        plane.on_done = wake
 
     @property
     def decode_compiles(self) -> int:
@@ -800,6 +834,15 @@ class DecodeEngine:
         if sampling.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1 (prefill "
                              "always emits the first token)")
+        if self._relaxed_longctx is not None and \
+                len(prompt) >= self._relaxed_longctx.min_tokens:
+            # the long-context lane: CP prefill across the mesh, KV
+            # streamed into the cold tiers, working-set decode — the
+            # prompt never has to fit this engine's pool or s_max
+            from hadoop_tpu.tracing.tracer import current_context
+            return self._relaxed_longctx.longctx_submit(
+                prompt, sampling,
+                trace_ctx=trace_ctx or current_context(), tenant=tenant)
         if len(prompt) + sampling.max_new_tokens > self.s_max:
             raise ValueError(
                 f"prompt({len(prompt)}) + max_new({sampling.max_new_tokens})"
@@ -858,10 +901,28 @@ class DecodeEngine:
         return total
 
     @property
-    def idle(self) -> bool:
+    def _local_idle(self) -> bool:
+        """No fused-step work: the RUN LOOP's wait predicate. It must
+        NOT consult the longctx plane — the plane serves on its own
+        worker thread, and parking the scheduler on its busyness would
+        hot-spin no-op step() calls against the very CP prefill it is
+        waiting for."""
         with self._cond:
             has_pending = bool(self._pending)
         return not has_pending and all(r is None for r in self._slots)
+
+    @property
+    def idle(self) -> bool:
+        """Nothing in flight ANYWHERE (fused step + longctx plane) —
+        the drain/stop predicate."""
+        lc = self._relaxed_longctx
+        return self._local_idle and (lc is None or lc.idle)
+
+    def longctx_stats(self) -> Dict[str, Any]:
+        """The long-context plane's observability face (health, bench):
+        ``{"enabled": False}`` when no plane is attached."""
+        lc = self._relaxed_longctx
+        return lc.stats() if lc is not None else {"enabled": False}
 
     def weight_plane(self) -> Dict[str, Any]:
         """The resident-weight policy and the capacity it bought —
@@ -1505,6 +1566,10 @@ class DecodeEngine:
         finally:
             if locked:
                 self._sched_lock.release()
+        if self._relaxed_longctx is not None:
+            # the drain above already waited for the plane through
+            # `idle`; this stops its worker and fails anything queued
+            self._relaxed_longctx.stop(drain=drain, timeout=timeout)
         self.kvstore.close()
 
     def persist_cache(self, timeout: float = 30.0) -> int:
@@ -1540,6 +1605,14 @@ class DecodeEngine:
             raise ValueError("DFS KV tier disabled (set "
                              "serving.kv.dfs.enable for prefill-role "
                              "replicas)")
+        if self._relaxed_longctx is not None and \
+                len(prompt) >= self._relaxed_longctx.min_tokens:
+            # monster handoff: CP prefill + streamed tier ingest — the
+            # radix never sees these blocks, so the radix-walking
+            # persist below would report 0 durable tokens for a chain
+            # that IS durable
+            return self._relaxed_longctx.prefill_to_store(prompt,
+                                                          timeout)
         req = self.submit(prompt, SamplingParams(max_new_tokens=1))
         if self._thread is None:
             # offline/test mode: no scheduler thread, drive it here
@@ -1569,7 +1642,10 @@ class DecodeEngine:
     def _run_loop(self) -> None:
         while not self._stop.is_set():
             with self._cond:
-                while self.idle and not self._stop.is_set():
+                # _local_idle, not idle: a busy longctx plane must not
+                # flip this predicate — step() would return 0 in a
+                # tight no-sleep loop for the whole monster request
+                while self._local_idle and not self._stop.is_set():
                     self._cond.wait(0.05)
             if self._stop.is_set():
                 return

@@ -58,6 +58,7 @@ DFS_ENABLE_KEY = "serving.kv.dfs.enable"
 DFS_DIR_KEY = "serving.kv.dfs.dir"
 DFS_MIN_REFS_KEY = "serving.kv.dfs.min-refs"
 CODEC_KEY = "serving.kv.codec"
+FETCH_WINDOW_KEY = "serving.kv.fetch.window"
 
 
 @dataclass
@@ -78,7 +79,8 @@ class TieredKVCache:
                  head_dim: int, dtype, enabled: bool = True,
                  host_bytes: int = 0, fs=None,
                  dfs_dir: str = "/kvcache", dfs_min_refs: int = 1,
-                 codec: str = "raw", metrics=None, tracer=None,
+                 codec: str = "raw", fetch_window: int = 4,
+                 metrics=None, tracer=None,
                  extract: Optional[Callable] = None):
         if codec not in CODECS:
             raise ValueError(f"{CODEC_KEY} must be one of {CODECS}, "
@@ -94,6 +96,10 @@ class TieredKVCache:
         salt = hashlib.sha256(
             f"htpu-kv1:{layers}:{pool.block_size}:{kv_heads}:"
             f"{head_dim}:{self.dtype}".encode()).digest()
+        # the chain root: held here (not only on the radix) so the
+        # radix-less chain surfaces — longctx ingest/read — key blocks
+        # identically to the radix tier they interoperate with
+        self.chain_salt = salt
         self.radix = PrefixCache(pool.block_size, salt=salt) if enabled \
             else None
         self.host = HostTier(shape, self.dtype, host_bytes,
@@ -125,16 +131,20 @@ class TieredKVCache:
         self._write_q: "queue.Queue" = queue.Queue()
         self._writer: Optional[threading.Thread] = None
         # cold DFS chunks are read in speculative parallel windows of
-        # this many blocks: one DataNode round-trip of wall time per
-        # window instead of one per block (the walk runs under the
-        # scheduler lock, so every serial round-trip is a decode stall
-        # for the whole replica); reads past the chain's first miss
-        # are wasted but bounded by the window
-        self.fetch_window = 4
+        # this many blocks (``serving.kv.fetch.window``): one DataNode
+        # round-trip of wall time per window instead of one per block
+        # (the walk runs under the scheduler lock, so every serial
+        # round-trip is a decode stall for the whole replica); reads
+        # past the chain's first miss are wasted but bounded by the
+        # window. The default of 4 suits short radix-miss tails; a
+        # long-context chain wants a window sized so the whole chain
+        # pages in with O(chain/window) round trips, not O(chain).
+        self.fetch_window = max(1, int(fetch_window))
         self._fetch_pool = ThreadPoolExecutor(
-            max_workers=self.fetch_window,
+            max_workers=min(self.fetch_window, 32),
             thread_name_prefix="kv-dfs-fetch") if self.dfs is not None \
             else None
+        self.chain_ingested = 0     # longctx blocks streamed in
 
     # ------------------------------------------------------------- flags
 
@@ -177,14 +187,13 @@ class TieredKVCache:
         ``start_block`` chunks when the caller already holds it (the
         matched radix node carries exactly this value) — without it the
         chain is rehashed from the root."""
-        if not self.cold_enabled or self.radix is None or \
-                start_block >= limit:
+        if not self.cold_enabled or start_block >= limit:
             return []
         bs = self.block_size
         if start_digest is not None:
             digest = start_digest
         else:
-            digest = self.radix.root_digest
+            digest = self.chain_salt
             for i in range(start_block):
                 digest = chain_digest(digest,
                                       tuple(ctx[i * bs:(i + 1) * bs]))
@@ -250,9 +259,59 @@ class TieredKVCache:
                     time.monotonic() - t0)
             return d, got
 
-        if len(window) == 1 or self._fetch_pool is None:
+        if len(window) == 1:
             return dict([read(window[0])])
+        if self._fetch_pool is None:
+            # no executor (hand-wired tests): read the whole window
+            # serially so the caller's lookahead still covers it
+            return dict(read(d) for d in window)
         return dict(self._fetch_pool.map(read, window))
+
+    def read_chain(self, ctx: List[int], limit: int, parent_ctx=None
+                   ) -> List[ColdHit]:
+        """Page a digest chain back from the cold tiers WITHOUT going
+        through the radix/pool (the long-context decode path: the
+        chain lands host-resident and visits HBM one window at a
+        time, never as pool pages). Same contiguity contract and
+        speculative DFS windows as ``fetch_cold``; per-tier hit
+        counters are bumped here because no ``mark_promoted``
+        follows."""
+        hits = self.fetch_cold(ctx, 0, limit, parent_ctx=parent_ctx)
+        for h in hits:
+            self.hits[h.tier] += 1
+            if self.metrics:
+                (self.metrics.kv_hits_host if h.tier == "host"
+                 else self.metrics.kv_hits_dfs).incr()
+        return hits
+
+    # --------------------------------------------------- streamed ingest
+
+    def ingest_chain(self, tokens: List[int], payloads,
+                     parent_ctx=None) -> int:
+        """Stream full-block KV payloads for ``tokens`` straight into
+        the cold tiers — the long-context prefill sink. ``payloads``
+        yields ``(k, v)`` ``[L, bs, Hkv, Dh]`` blocks in chain order
+        (a generator: the caller never holds the whole context);
+        each lands in the host ring now and rides the background DFS
+        writer (digest-chained with the SAME salt/keying as the radix
+        tier, so a later radix-path admission — or another replica —
+        maps these blocks like any other persisted prefix; the codec
+        applies per tier exactly as on the demotion path). Returns the
+        number of blocks ingested."""
+        bs = self.block_size
+        digest = self.chain_salt
+        n = 0
+        for k, v in payloads:
+            digest = chain_digest(digest,
+                                  tuple(tokens[n * bs:(n + 1) * bs]))
+            if self.host is not None:
+                self.host.put(digest, np.asarray(k), np.asarray(v))
+            if self.dfs is not None:
+                self._enqueue_raw(digest, np.asarray(k), np.asarray(v),
+                                  parent_ctx)
+            n += 1
+        self.chain_ingested += n
+        return n
 
     def mark_promoted(self, hits: List[ColdHit], pages: List[int]
                       ) -> None:
@@ -466,4 +525,6 @@ class TieredKVCache:
                                     if self.host is not None else 0,
             "dfs_persists": done,
             "dfs_persist_failures": failed,
+            "chain_ingested": self.chain_ingested,
+            "fetch_window": self.fetch_window,
         }

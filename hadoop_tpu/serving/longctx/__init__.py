@@ -1,0 +1,43 @@
+"""Long-context serving plane: context length as a FLEET property.
+
+Prompts longer than ``serving.longctx.min.tokens`` stop being a
+workload class the replica refuses: prefill runs as a context-parallel
+job across the replica's mesh (ring attention / ulysses, topology-aware
+ring placement per TASP), the finished KV streams straight into the
+tiered KV store (digest-chained, int8-codec eligible) instead of
+pinning the whole context in HBM, and decode pages a working set back
+in through a fixed device window.
+
+This package IS the relaxed serving tier for context parallelism: the
+CP softmax reassociation is not bitwise, so tpulint's
+``parity/relaxed-gated`` checker requires every call into
+``cp_prefill`` / ``paged_decode`` / ``longctx_submit`` /
+``longctx_plane_from_conf`` from outside this package to sit under a
+``serving.parity=relaxed`` guard, and ``guard.run_prefill_ab`` is the
+A-B acceptance (exact at small shapes, bounded-logit at scale).
+"""
+
+from hadoop_tpu.serving.longctx.decode import (WorkingSetDecoder,
+                                               trace_counts)
+from hadoop_tpu.serving.longctx.guard import (longctx_ab_report,
+                                              run_prefill_ab)
+from hadoop_tpu.serving.longctx.plan import (choose_sp_mode, cp_mesh,
+                                             ring_order)
+from hadoop_tpu.serving.longctx.plane import (CHIPS_KEY, ENABLED_KEY,
+                                              MAX_TOKENS_KEY,
+                                              MIN_TOKENS_KEY,
+                                              SP_MODE_KEY, TAIL_KEY,
+                                              WINDOW_KEY,
+                                              LongContextPlane,
+                                              longctx_plane_from_conf)
+from hadoop_tpu.serving.longctx.prefill import (ContextParallelPrefiller,
+                                                PrefillResult)
+
+__all__ = [
+    "LongContextPlane", "longctx_plane_from_conf",
+    "ContextParallelPrefiller", "PrefillResult", "WorkingSetDecoder",
+    "run_prefill_ab", "longctx_ab_report", "ring_order", "cp_mesh",
+    "choose_sp_mode", "trace_counts",
+    "ENABLED_KEY", "MIN_TOKENS_KEY", "MAX_TOKENS_KEY", "CHIPS_KEY",
+    "SP_MODE_KEY", "WINDOW_KEY", "TAIL_KEY",
+]

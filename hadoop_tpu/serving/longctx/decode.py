@@ -1,0 +1,353 @@
+"""Working-set decode over a tier-resident context.
+
+The engine's fused step gathers each lane's WHOLE context out of the
+HBM block pool — which is exactly what a long-context request cannot
+have. This decoder keeps the context where CP prefill streamed it (the
+host ring / DFS tiers, chain-digest-keyed) and pages it through a
+fixed-shape device window instead: per generated token, per layer, the
+query merges online-softmax partials (``ops.attention.chunk_attention``
++ ``merge_attention`` — the same math ring attention runs across chips,
+run here across TIME) over
+
+- a device-resident TAIL buffer holding the prompt's partial last
+  block plus every generated token's K/V (scattered in as they are
+  computed, the ``_INJECT``-mover idiom), and
+- a sliding WINDOW of ``serving.longctx.decode.window.blocks`` full
+  blocks paged in from the host-resident chain on demand.
+
+So decode HBM holds ``window + tail`` — a working set — while the
+context itself lives a tier down. The chain is assembled once per
+request with ``TieredKVCache.read_chain`` (host probe, then
+DFS hedged reads in ``serving.kv.fetch.window``-sized speculative
+windows: O(chain/window) DataNode round trips).
+
+Compile-once: every jitted piece below is cached at module level per
+(model config, window, tail capacity) and traced exactly once for the
+process lifetime — ``trace_counts()`` exposes the counters and the
+longctx smoke pins them, exactly like the engine's two step shapes.
+
+Sampling runs host-side (greedy argmax / temperature + top-k with the
+same mask-then-scale transform as the engine's in-graph sampler): the
+per-token logits are already host-visible here, unlike the fused step
+where keeping sampling in-graph is what avoids a [B, V] readback.
+"""
+
+from __future__ import annotations
+
+import threading
+from types import SimpleNamespace
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from hadoop_tpu.models.config import ModelConfig
+
+_NEG_INF = -1e30
+_FAR = 1 << 30     # a kv position no query position ever reaches
+
+
+# one jit family per (cfg, window, tail) layout, shared by every
+# decoder instance in the process — same compile-once contract as the
+# engine's module-level _INJECT/_EXTRACT movers
+_JIT_CACHE: Dict = {}                       # guarded-by: _JIT_LOCK
+_JIT_LOCK = threading.Lock()
+_TRACES: Dict[str, int] = {}
+
+
+def trace_counts() -> Dict[str, int]:
+    """Traces per jitted decode piece (name → count): the longctx
+    smoke asserts every value stays exactly 1 per layout family."""
+    return dict(_TRACES)
+
+
+def _count(name: str) -> None:
+    _TRACES[name] = _TRACES.get(name, 0) + 1
+
+
+def _build_jits(cfg: ModelConfig, win: int, tail_cap: int):
+    import jax
+    import jax.numpy as jnp
+
+    from hadoop_tpu.models.decoder import _norm, head_matrix
+    from hadoop_tpu.ops import (apply_rope, gelu, rope_frequencies,
+                                swiglu)
+    from hadoop_tpu.ops.attention import (_repeat_kv, chunk_attention,
+                                          merge_attention)
+
+    hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    nrep = hq // hkv
+    scale = 1.0 / (dh ** 0.5)
+    # the counter key must distinguish everything the jit cache key
+    # does (the FULL config, not just the family) or two legitimate
+    # jit families would share one counter and falsely read as
+    # retracing; hash(cfg) is process-local, which is all a
+    # process-local trace counter needs
+    fam = f"{cfg.family}:{win}:{tail_cap}:{hash(cfg) & 0xffffff:x}"
+
+    def embed_impl(params, tok, pos):
+        _count(f"embed@{fam}")
+        h = params["embed"][tok][None, None, :]
+        if not cfg.use_rope:
+            h = h + params["pos_embed"][
+                jnp.clip(pos, 0, cfg.max_seq - 1)][None, None, :]
+        return h                                        # [1, 1, D]
+
+    def layer_in_impl(layers, l, h, pos):
+        _count(f"layer_in@{fam}")
+        x = _norm(h, layers["attn_norm_w"][l],
+                  layers["attn_norm_b"][l]
+                  if "attn_norm_b" in layers else None, cfg)
+        q = (x @ layers["wq"][l]).reshape(1, 1, hq, dh)
+        k = (x @ layers["wk"][l]).reshape(1, 1, hkv, dh)
+        v = (x @ layers["wv"][l]).reshape(1, 1, hkv, dh)
+        if cfg.use_rope:
+            cos, sin = rope_frequencies(dh, cfg.max_seq, cfg.rope_theta)
+            p = pos[None]
+            q = apply_rope(q, cos, sin, p)
+            k = apply_rope(k, cos, sin, p)
+        return q, k[0, 0], v[0, 0]          # q [1,1,Hq,Dh]; k/v [Hkv,Dh]
+
+    def tail_set_impl(ktail, vtail, l, idx, k, v):
+        _count(f"tail_set@{fam}")
+        return (ktail.at[l, idx].set(k.astype(ktail.dtype)),
+                vtail.at[l, idx].set(v.astype(vtail.dtype)))
+
+    def _partial(q, kc, vc, qpos, kvpos):
+        return chunk_attention(
+            q, _repeat_kv(kc[None], nrep).astype(jnp.float32),
+            _repeat_kv(vc[None], nrep).astype(jnp.float32),
+            scale, qpos[None], kvpos)
+
+    def tail_part_impl(q, ktail, vtail, l, pos, base, n_tail):
+        _count(f"tail@{fam}")
+        j = jnp.arange(tail_cap)
+        kvpos = jnp.where(j < n_tail, base + j, _FAR)
+        return _partial(q, ktail[l], vtail[l], pos, kvpos)
+
+    def win_part_impl(q, kw, vw, pos, w0, n_valid):
+        _count(f"win@{fam}")
+        j = jnp.arange(win)
+        kvpos = jnp.where(j < n_valid, w0 + j, _FAR)
+        return _partial(q, kw, vw, pos, kvpos)
+
+    def merge_impl(oa, la, ob, lb):
+        _count(f"merge@{fam}")
+        return merge_attention(oa, la, ob, lb)
+
+    def layer_out_impl(layers, l, h, o):
+        _count(f"layer_out@{fam}")
+        h = h + (o.astype(h.dtype).reshape(1, 1, hq * dh)
+                 @ layers["wo"][l])
+        x = _norm(h, layers["mlp_norm_w"][l],
+                  layers["mlp_norm_b"][l]
+                  if "mlp_norm_b" in layers else None, cfg)
+        if cfg.use_swiglu:
+            mlp = swiglu(x @ layers["w_gate"][l],
+                         x @ layers["w_up"][l]) @ layers["w_down"][l]
+        else:
+            mlp = gelu(x @ layers["w_in"][l]
+                       + layers["b_in"][l]) @ layers["w_out"][l] \
+                + layers["b_out"][l]
+        return h + mlp.astype(h.dtype)
+
+    def head_impl(params, h):
+        _count(f"head@{fam}")
+        h = _norm(h, params["final_norm_w"],
+                  params.get("final_norm_b"), cfg)
+        return (h[0, 0] @ head_matrix(params, cfg, h.dtype)).astype(
+            jnp.float32)
+
+    return SimpleNamespace(
+        embed=jax.jit(embed_impl),
+        layer_in=jax.jit(layer_in_impl),
+        tail_set=jax.jit(tail_set_impl, donate_argnums=(0, 1)),
+        tail=jax.jit(tail_part_impl),
+        win=jax.jit(win_part_impl),
+        merge=jax.jit(merge_impl),
+        layer_out=jax.jit(layer_out_impl),
+        head=jax.jit(head_impl),
+        family=fam)
+
+
+def _jits_for(cfg: ModelConfig, win: int, tail_cap: int):
+    key = (cfg, win, tail_cap)
+    with _JIT_LOCK:
+        if key not in _JIT_CACHE:
+            _JIT_CACHE[key] = _build_jits(cfg, win, tail_cap)
+        return _JIT_CACHE[key]
+
+
+def _host_sample(logits: np.ndarray, temperature: float, top_k: int,
+                 rng: np.random.Generator) -> int:
+    """The engine's mask-then-scale sampling transform, host-side:
+    greedy when temperature <= 0; top-k keeps values >= the k-th
+    largest (ties included, matching ``engine._mask_and_scale``)."""
+    if temperature <= 0:
+        return int(np.argmax(logits))
+    l = np.asarray(logits, np.float64).copy()
+    if top_k > 0:
+        kth = np.sort(l)[max(0, l.size - top_k)]
+        l[l < kth] = _NEG_INF
+    l = l / max(temperature, 1e-6)
+    l -= l.max()
+    p = np.exp(l)
+    p /= p.sum()
+    return int(rng.choice(l.size, p=p))
+
+
+class WorkingSetDecoder:
+    """Decode one long-context request with HBM bounded by
+    window + tail, the context streamed from the cold tiers."""
+
+    def __init__(self, params, cfg: ModelConfig, store, *,
+                 block_size: int, window_blocks: int = 4,
+                 tail_tokens: int = 128, metrics=None):
+        import jax.numpy as jnp
+
+        from hadoop_tpu.serving.weightplane import is_quantized_tree
+        if is_quantized_tree(params):
+            raise NotImplementedError(
+                "the longctx decoder serves the checkpoint-dtype view; "
+                "hand it dequantized params (the plane does this at "
+                "construction)")
+        if cfg.is_moe:
+            raise NotImplementedError("longctx serves dense decoders "
+                                      "only (same as the engine)")
+        self.params = params
+        self.cfg = cfg
+        self.store = store
+        self.block_size = int(block_size)
+        self.win = int(window_blocks) * self.block_size
+        self.tail_cap = int(tail_tokens)
+        self._jnp = jnp
+        self._jits = _jits_for(cfg, self.win, self.tail_cap)
+        self.metrics = metrics
+        self.window_fetches = 0     # device window loads (per l, w, tok)
+        self.tokens_decoded = 0
+
+    @property
+    def hbm_working_set_bytes(self) -> int:
+        """What this decoder keeps device-resident per request: the
+        window (transient) + the tail buffers. The number the 'working
+        set, not the full context' contract is about."""
+        item = np.dtype(self.cfg.dtype).itemsize
+        per_tok = 2 * self.cfg.n_layers * self.cfg.n_kv_heads * \
+            self.cfg.head_dim * item
+        return (self.win + self.tail_cap) * per_tok
+
+    # ------------------------------------------------------------ decode
+
+    def paged_decode(self, tokens: List[int], first_token: int,
+                     sampling, *, tail_k=None, tail_v=None,
+                     deliver: Callable[[int], None],
+                     stop: Optional[Callable[[], bool]] = None,
+                     seed: int = 0, rng=None, parent_ctx=None) -> int:
+        """Generate up to ``sampling.max_new_tokens - 1`` tokens after
+        ``first_token`` (which prefill already delivered), paging the
+        prompt's KV chain in windows. Relaxed-tier entry point
+        (``parity/relaxed-gated``). Returns tokens emitted here."""
+        jnp = self._jnp
+        cfg = self.cfg
+        bs = self.block_size
+        s = len(tokens)
+        n_full = s // bs
+        tail_len = s - n_full * bs
+        if tail_len + sampling.max_new_tokens > self.tail_cap:
+            raise ValueError(
+                f"prompt tail ({tail_len}) + max_new "
+                f"({sampling.max_new_tokens}) exceeds the longctx tail "
+                f"budget {self.tail_cap} "
+                f"(serving.longctx.decode.tail.tokens)")
+        # ---- the chain pages back from the tiers (host probe, DFS
+        # hedged-read windows) — NOT into the engine's pool: it lands
+        # host-resident and only ever visits HBM one window at a time
+        hits = self.store.read_chain(tokens, n_full,
+                                     parent_ctx=parent_ctx)
+        if len(hits) < n_full:
+            raise RuntimeError(
+                f"longctx KV chain has a gap: {len(hits)}/{n_full} "
+                f"blocks recoverable from the host/DFS tiers (host ring "
+                f"too small without the DFS tier?)")
+        # ONE preallocated buffer at the window-padded shape, hits
+        # written in place: the chain is the dominant host allocation
+        # at real scale, and an assemble-then-pad concatenate pair
+        # would hold TWO copies live at peak. Padding to a window
+        # multiple once here keeps per-token window slicing
+        # allocation-free on the decode critical path.
+        chain_len = n_full * bs
+        padded = chain_len + ((-chain_len) % self.win)
+        shape = (cfg.n_layers, padded, cfg.n_kv_heads, cfg.head_dim)
+        knp = np.zeros(shape, hits[0].k.dtype if hits else cfg.dtype)
+        vnp = np.zeros(shape, knp.dtype)
+        for i, h in enumerate(hits):
+            knp[:, i * bs:(i + 1) * bs] = h.k
+            vnp[:, i * bs:(i + 1) * bs] = h.v
+        # ---- device-resident tail: prompt's partial block + every
+        # generated token's K/V
+        tshape = (cfg.n_layers, self.tail_cap, cfg.n_kv_heads,
+                  cfg.head_dim)
+        kt = np.zeros(tshape, cfg.dtype)
+        vt = np.zeros(tshape, cfg.dtype)
+        if tail_len:
+            kt[:, :tail_len] = tail_k
+            vt[:, :tail_len] = tail_v
+        ktail, vtail = jnp.asarray(kt), jnp.asarray(vt)
+        base = n_full * bs
+        n_tail = tail_len
+        if rng is None:
+            rng = np.random.default_rng(seed)
+        sp = sampling
+        cur = first_token
+        pos = s                        # first_token's absolute position
+        emitted = 0
+        out_count = 1                  # first_token already delivered
+        while out_count < sp.max_new_tokens and \
+                (sp.stop_token is None or cur != sp.stop_token) and \
+                (stop is None or not stop()):
+            logits, ktail, vtail, n_tail = self._token(
+                cur, pos, knp, vnp, chain_len, ktail, vtail, base,
+                n_tail)
+            nxt = _host_sample(logits, sp.temperature, sp.top_k, rng)
+            deliver(nxt)
+            emitted += 1
+            out_count += 1
+            cur = nxt
+            pos += 1
+        self.tokens_decoded += emitted
+        return emitted
+
+    def _token(self, tok: int, pos: int, knp, vnp, chain_len: int,
+               ktail, vtail, base: int, n_tail: int):
+        """One full forward for one token: per layer, scatter its K/V
+        into the tail, then merge attention partials over the tail and
+        over the chain paged through the fixed window. ``knp``/``vnp``
+        arrive padded to a window multiple; ``chain_len`` is the true
+        context length the positions mask against."""
+        jnp = self._jnp
+        J = self._jits
+        cfg = self.cfg
+        pos_j = jnp.int32(pos)
+        h = J.embed(self.params, jnp.int32(tok), pos_j)
+        layers = self.params["layers"]
+        n_win = knp.shape[1] // self.win
+        idx = n_tail            # this token's tail slot
+        for l in range(cfg.n_layers):
+            l_j = jnp.int32(l)
+            q, k, v = J.layer_in(layers, l_j, h, pos_j)
+            ktail, vtail = J.tail_set(ktail, vtail, l_j,
+                                      jnp.int32(idx), k, v)
+            o, lse = J.tail(q, ktail, vtail, l_j, pos_j,
+                            jnp.int32(base), jnp.int32(idx + 1))
+            for w in range(n_win):
+                w0 = w * self.win
+                ow, lw = J.win(q, knp[l, w0:w0 + self.win],
+                               vnp[l, w0:w0 + self.win], pos_j,
+                               jnp.int32(w0),
+                               jnp.int32(min(chain_len - w0, self.win)))
+                o, lse = J.merge(o, lse, ow, lw)
+                self.window_fetches += 1
+                if self.metrics:
+                    self.metrics.longctx_window_fetches.incr()
+            h = J.layer_out(layers, l_j, h, o)
+        logits = np.asarray(J.head(self.params, h))
+        return logits, ktail, vtail, n_tail + 1

@@ -1,0 +1,85 @@
+"""A-B acceptance for the long-context plane.
+
+The CP softmax reassociation (online-softmax merges across ranks, and
+across paged windows at decode) is not bitwise vs the single-chip
+reference — same deal as the lowp collectives — so the plane ships
+behind the repo's standard two-mode guard:
+
+- **exact** (small shapes, where the single-chip reference fits): the
+  CP prefill's last-token logits must be allclose at tight tolerance
+  AND greedy-argmax-identical to ``models.decoder.forward`` — the
+  ``run_weight_ab``-style contract.
+- **relaxed** (at scale): bounded logit divergence
+  (``serving.longctx.guard.rel-tol``) plus argmax agreement — the
+  logits guard, reported with the measured divergence so a rejection
+  says HOW far off (``parallel.lowp.guard.allclose_guard`` ethos).
+
+Both return a plain report dict (benches record it; the smoke's JSON
+carries the trajectory) and raise ``ParityGuardError`` on rejection —
+the same exception the lowp and weight-plane guards raise, so "the
+guard rejected" means one thing everywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from hadoop_tpu.parallel.lowp.guard import ParityGuardError
+
+
+def longctx_ab_report(ref_logits, cp_logits, *, mode: str = "exact",
+                      rel_tol: float = 0.05,
+                      exact_atol: float = 5e-4) -> Dict:
+    """Judge CP last-token logits against the single-chip reference.
+    Raises :class:`ParityGuardError` on rejection, returns the
+    divergence report on acceptance."""
+    ref = np.asarray(ref_logits, np.float32).reshape(-1)
+    got = np.asarray(cp_logits, np.float32).reshape(-1)
+    if ref.shape != got.shape:
+        raise ParityGuardError(
+            f"longctx guard: logits shape {got.shape} != {ref.shape}")
+    d = np.abs(ref - got)
+    max_abs = float(d.max(initial=0.0))
+    max_rel = float((d / np.maximum(np.abs(ref), 1e-6)).max(initial=0.0))
+    agree = int(np.argmax(ref)) == int(np.argmax(got))
+    report = {"mode": mode, "max_abs": max_abs, "max_rel": max_rel,
+              "argmax_agree": agree}
+    if mode == "exact":
+        report["atol"] = exact_atol
+        ok = agree and max_abs <= exact_atol
+    elif mode == "relaxed":
+        report["rel_tol"] = rel_tol
+        ok = agree and max_rel <= rel_tol
+    else:
+        raise ValueError(f"guard mode must be exact|relaxed, got {mode!r}")
+    report["accepted"] = ok
+    if not ok:
+        raise ParityGuardError(
+            f"longctx {mode} guard rejected: max_abs={max_abs:.3e}, "
+            f"max_rel={max_rel:.3e}, argmax_agree={agree}")
+    return report
+
+
+def run_prefill_ab(params, cfg, tokens: List[int], prefiller, *,
+                   mode: str = "exact", rel_tol: float = 0.05,
+                   exact_atol: float = 5e-4) -> Dict:
+    """The prefill A-B: CP prefill of ``tokens`` on ``prefiller`` vs
+    the single-chip ``decoder.forward`` last-token logits. One shared
+    harness — tests, the smoke and the bench all call this, so
+    "passes the longctx guard" means the same thing everywhere."""
+    import jax.numpy as jnp
+
+    from hadoop_tpu.models.decoder import forward
+
+    ref = np.asarray(
+        forward(params, jnp.asarray(tokens, jnp.int32)[None, :],
+                cfg)[0, -1], np.float32)
+    res = prefiller.cp_prefill(tokens)
+    report = longctx_ab_report(ref, res.last_logits, mode=mode,
+                               rel_tol=rel_tol, exact_atol=exact_atol)
+    report.update(chips=res.chips, sp_mode=res.sp_mode,
+                  prompt_tokens=len(tokens),
+                  prefill_seconds=round(res.seconds, 4))
+    return report

@@ -1,0 +1,97 @@
+"""CP plan construction for inference-shaped batches.
+
+Training already knows how to build meshes (``parallel/mesh.py``), but
+its five-axis mesh is shaped for dp×pp×tp×ep×sp training steps; a
+long-context prefill is a batch-of-one, sequence-sharded job, so the
+serving plane builds a dedicated ONE-axis ``sp`` mesh instead — every
+chip of the replica becomes a context-parallel rank, and outputs are
+sharded over the only axis there is (which also keeps shard_map's
+replication checks trivially satisfiable on every jax version the
+repo runs under).
+
+Topology-aware placement per TASP (PAPERS: arXiv:2509.26541): ring
+attention moves one K/V shard per step between CONSECUTIVE ranks, so
+the rank order decides whether every hop is one ICI link or a tour of
+the pod. ``ring_order`` snakes through the device coordinate grid so
+consecutive ranks are physical neighbors (and the wrap-around hop is
+short); devices without coordinates (the CPU-sim mesh, single hosts)
+fall back to id order, which is exactly the old behavior.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+def ring_order(devices: Sequence) -> List:
+    """Order ``devices`` so consecutive entries are topology neighbors.
+
+    Devices exposing ``coords`` (TPU PJRT) are snake-sorted through
+    their coordinate grid: ranks walk axis -1 forward on even rows and
+    backward on odd rows, so every consecutive pair differs by one
+    step on one axis — each ring hop is a single ICI link. Devices
+    without coords keep id order (the CPU-sim mesh has no topology to
+    respect)."""
+    devs = list(devices)
+    if any(getattr(d, "coords", None) is None for d in devs):
+        return sorted(devs, key=lambda d: d.id)
+    coords = {d: tuple(d.coords) for d in devs}
+    ndim = max(len(c) for c in coords.values())
+    spans = [sorted({c[i] for c in coords.values() if len(c) > i})
+             for i in range(ndim)]
+
+    def snake_key(d):
+        c = coords[d]
+        key = []
+        flip = False
+        for i in range(ndim):
+            axis = spans[i]
+            idx = axis.index(c[i]) if i < len(c) and c[i] in axis else 0
+            key.append(len(axis) - 1 - idx if flip else idx)
+            # an odd ORIGINAL position on this axis reverses the walk
+            # of the next — the mixed-radix reflected-Gray rule that
+            # turns row-major order into a snake (propagating the
+            # REFLECTED digit's parity instead breaks the unit-hop
+            # invariant on even-sized 3D grids, e.g. 2x2x2)
+            flip = (flip != bool(idx % 2))
+        return tuple(key)
+
+    return sorted(devs, key=snake_key)
+
+
+def cp_mesh(sp: int, devices: Optional[Sequence] = None):
+    """A one-axis ``sp`` mesh over the first ``sp`` ring-ordered
+    devices — the mesh every long-context prefill runs on."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = ring_order(devices if devices is not None else jax.devices())
+    if len(devs) < sp:
+        raise ValueError(f"longctx plan needs {sp} devices, "
+                         f"have {len(devs)}")
+    return Mesh(np.array(devs[:sp]), ("sp",))
+
+
+def choose_sp_mode(cfg, sp: int, requested: str = "ring") -> str:
+    """Validate the conf-selected CP attention strategy against the
+    model's head counts: ulysses needs both head counts divisible by
+    the axis (``parallel/ulysses.py``); ring handles any shape. An
+    impossible ulysses request degrades to ring with a loud log — a
+    conf typo must not refuse a fleet's whole long-context workload."""
+    if requested not in ("ring", "ulysses"):
+        raise ValueError("serving.longctx.sp.mode must be ring|ulysses, "
+                         f"got {requested!r}")
+    if requested == "ulysses" and sp > 1:
+        from hadoop_tpu.parallel.ulysses import supports
+        if not supports(cfg.n_heads, cfg.n_kv_heads, sp):
+            log.warning(
+                "serving.longctx.sp.mode=ulysses needs n_heads(%d) and "
+                "n_kv_heads(%d) divisible by the %d-chip axis; "
+                "falling back to ring", cfg.n_heads, cfg.n_kv_heads, sp)
+            return "ring"
+    return requested

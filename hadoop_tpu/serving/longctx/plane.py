@@ -1,0 +1,425 @@
+"""The long-context serving plane: one replica's monster-prompt lane.
+
+Ties the pieces into a request lifecycle the door already understands:
+
+    engine.submit() routes prompts >= ``serving.longctx.min.tokens``
+    here (under the ``serving.parity=relaxed`` guard) →
+    CP prefill across the replica's mesh (``prefill.py``) →
+    finished KV chunks stream STRAIGHT into the host/DFS tiers
+    (``TieredKVCache.ingest_chain`` — digest-chained, codec-eligible,
+    never pinned in the HBM pool) →
+    first token sampled from the CP logits →
+    working-set decode (``decode.py``) pages the chain back through a
+    fixed device window while generated tokens' KV accumulates in the
+    device tail.
+
+The plane runs its own single worker thread: a monster prefill is a
+whole-mesh job, so two can't overlap anyway, and the engine's fused
+step keeps serving short prompts underneath it untouched (the
+compile-once contract of the two step shapes survives — the longctx
+path adds only its OWN pinned shapes, counted separately).
+
+Requests are ordinary ``GenRequest``s: tokens stream through the same
+queue, the same door handlers, the same trace ids
+(``serving.longctx.prefill`` / ``serving.longctx.decode`` spans join
+the request trace), and the same metrics surface (``htpu_longctx_*``).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from hadoop_tpu.models.config import ModelConfig
+from hadoop_tpu.serving.longctx.decode import (WorkingSetDecoder,
+                                               _host_sample)
+from hadoop_tpu.serving.longctx.prefill import ContextParallelPrefiller
+from hadoop_tpu.tracing.tracer import global_tracer
+
+log = logging.getLogger(__name__)
+
+ENABLED_KEY = "serving.longctx.enabled"
+MIN_TOKENS_KEY = "serving.longctx.min.tokens"
+MAX_TOKENS_KEY = "serving.longctx.max.tokens"
+CHIPS_KEY = "serving.longctx.chips"
+SP_MODE_KEY = "serving.longctx.sp.mode"
+WINDOW_KEY = "serving.longctx.decode.window.blocks"
+TAIL_KEY = "serving.longctx.decode.tail.tokens"
+
+
+class LongContextPlane:
+    """CP prefill + tier streaming + working-set decode behind one
+    submit seam. Construct directly (tests, benches) or from conf via
+    :func:`longctx_plane_from_conf`."""
+
+    def __init__(self, params, cfg: ModelConfig, store, *,
+                 block_size: int, min_tokens: int,
+                 max_tokens: Optional[int] = None, sp: int = 0,
+                 sp_mode: str = "ring", window_blocks: int = 4,
+                 tail_tokens: int = 256, devices=None, metrics=None,
+                 tracer=None):
+        if not store.cold_enabled:
+            raise ValueError(
+                "the longctx plane streams prefill KV into the cold "
+                "tiers — enable serving.kv.host.bytes and/or "
+                "serving.kv.dfs.enable")
+        from hadoop_tpu.serving.weightplane import (dequantize_params,
+                                                    is_quantized_tree,
+                                                    resident_weight_bytes)
+        self.dequantized_view_bytes = 0
+        if is_quantized_tree(params):
+            # CP prefill and the paged decoder run decoder-layer math
+            # on plain arrays; int8-resident CP weights are future
+            # work. This view is a SECOND resident copy of the model
+            # next to the engine's int8 plane — it is not in the
+            # engine's hbm_bytes lane math, so it is loud here and
+            # reported in stats()/health for capacity accounting.
+            params = dequantize_params(params, cfg)
+            self.dequantized_view_bytes = resident_weight_bytes(params)
+            log.warning(
+                "longctx plane holds a dequantized weight view (%d "
+                "bytes) BESIDE the engine's int8 plane — budget HBM "
+                "for both until int8 CP weights land",
+                self.dequantized_view_bytes)
+        self.cfg = cfg
+        self.store = store
+        self.min_tokens = int(min_tokens)
+        self.metrics = metrics
+        self.tracer = tracer or global_tracer()
+        self.prefiller = ContextParallelPrefiller(
+            params, cfg, block_size=block_size,
+            pad_tokens=max_tokens or cfg.max_seq, sp=sp,
+            sp_mode=sp_mode, devices=devices)
+        self.decoder = WorkingSetDecoder(
+            params, cfg, store, block_size=block_size,
+            window_blocks=window_blocks, tail_tokens=tail_tokens,
+            metrics=metrics)
+        self.requests_served = 0
+        self.blocks_streamed = 0
+        self._q: "queue.Queue" = queue.Queue()
+        # accepted-but-unfinished requests: incremented at submit
+        # BEFORE the queue put, decremented after serve — `idle` can
+        # never race a request sitting between q.get() and "busy"
+        self._inflight = 0              # guarded-by: _inflight_lock
+        self._inflight_lock = threading.Lock()
+        # invoked after every request completes (success or failure):
+        # the engine wires its scheduler condition here so a drain
+        # parked on `idle` wakes when the plane finishes, instead of
+        # sleeping out its whole timeout
+        self.on_done = None
+        self._stopped = threading.Event()
+        # orders submit's stopped-check+enqueue against stop(): a
+        # submit racing shutdown either lands BEFORE the sentinel (the
+        # drain loop fails it) or observes _stopped and raises — never
+        # an orphaned request behind a dead worker
+        self._admit_lock = threading.Lock()
+        self._worker = threading.Thread(target=self._work_loop,
+                                        name="longctx-plane",
+                                        daemon=True)
+        self._worker.start()
+        if metrics:
+            metrics.longctx_chips.set(self.prefiller.sp)
+
+    # ----------------------------------------------------------- submit
+
+    def longctx_submit(self, prompt: List[int], sampling=None,
+                       trace_ctx=None, tenant: str = ""):
+        """Admit one monster prompt. Relaxed-tier entry point
+        (``parity/relaxed-gated``): the engine calls this under its
+        ``serving.parity=relaxed`` guard. Raises ``ValueError`` for
+        requests the plane can NEVER serve (the door's 400)."""
+        from hadoop_tpu.serving.engine import GenRequest, SamplingParams
+        sampling = sampling or SamplingParams()
+        s = len(prompt)
+        bs = self.decoder.block_size
+        if s > self.prefiller.pad_tokens:
+            raise ValueError(
+                f"prompt ({s} tokens) exceeds {MAX_TOKENS_KEY}="
+                f"{self.prefiller.pad_tokens}")
+        if s + sampling.max_new_tokens > self.cfg.max_seq:
+            # generated-token positions past the rope/pos tables would
+            # silently clamp to the last row — wrong logits, no error
+            # (the fused path's s_max check, which this lane bypasses,
+            # guards exactly this)
+            raise ValueError(
+                f"prompt({s}) + max_new({sampling.max_new_tokens}) "
+                f"exceeds the model's max_seq {self.cfg.max_seq}")
+        tail_len = s % bs
+        if tail_len + sampling.max_new_tokens > self.decoder.tail_cap:
+            raise ValueError(
+                f"prompt tail ({tail_len}) + max_new "
+                f"({sampling.max_new_tokens}) exceeds {TAIL_KEY}="
+                f"{self.decoder.tail_cap}")
+        n_full = s // bs
+        if not self.store.dfs_enabled and self.store.host is not None:
+            # host-ring-only deployments must hold the WHOLE chain
+            # PLUS churn slack: the fused step demotes its evictions
+            # into the SAME ring, and an exact-fit chain would lose
+            # its head to the first concurrent short-prompt demotion
+            # (one full pool sweep is the realistic per-request bound;
+            # sustained heavier churn wants the DFS tier)
+            need = n_full + self.store.pool.num_usable
+            if self.store.host.capacity < need:
+                raise ValueError(
+                    f"longctx chain needs {n_full} host-ring blocks "
+                    f"plus {self.store.pool.num_usable} demotion-churn "
+                    f"slack but serving.kv.host.bytes holds "
+                    f"{self.store.host.capacity}; grow the ring or "
+                    f"enable the DFS tier")
+        req = GenRequest(prompt=list(prompt), sampling=sampling,
+                         trace_ctx=trace_ctx, tenant=tenant)
+        with self._admit_lock:
+            if self._stopped.is_set():
+                raise ValueError("longctx plane is stopped")
+            with self._inflight_lock:
+                self._inflight += 1
+            self._q.put(req)
+        if self.metrics:
+            self.metrics.requests.incr()
+            self.metrics.longctx_requests.incr()
+        return req
+
+    # ----------------------------------------------------- request work
+
+    def _work_loop(self) -> None:
+        from hadoop_tpu.serving.engine import FAILED
+        while True:
+            req = self._q.get()
+            if req is None:
+                return
+            try:
+                self._serve(req)
+            except Exception as e:  # noqa: BLE001 — fail the request,
+                # not the lane: a poisoned prompt must not wedge every
+                # future monster prompt behind a dead worker
+                log.warning("longctx request %d failed: %s", req.id, e)
+                req._finish(FAILED, f"longctx failed: {e}")
+            finally:
+                with self._inflight_lock:
+                    self._inflight -= 1
+                done_cb = self.on_done
+                if done_cb is not None:
+                    done_cb()
+
+    def _serve(self, req) -> None:
+        from hadoop_tpu.serving.engine import FAILED, FINISHED, RUNNING
+        req.state = RUNNING
+        sp = self.tracer.span("serving.longctx.prefill",
+                              parent=req.trace_ctx)
+        sp.add_kv("request", str(req.id))
+        sp.add_kv("prompt_tokens", str(len(req.prompt)))
+        sp.add_kv("chips", str(self.prefiller.sp))
+        sp.add_kv("sp_mode", self.prefiller.sp_mode)
+        try:
+            res = self.prefiller.cp_prefill(req.prompt)
+        finally:
+            sp.finish()
+        # first token BEFORE the tier ingest: it only needs the CP
+        # logits, so TTFT is prefill time, not prefill + DataNode writes
+        rng = np.random.default_rng(req.id)
+        smp = req.sampling
+        first = _host_sample(res.last_logits, smp.temperature,
+                             smp.top_k, rng)
+        self._deliver(req, first)
+        ttft = req.first_token_at - req.submitted_at
+        if self.metrics:
+            self.metrics.ttft.add(ttft)
+            self.metrics.ttft_hist.add(
+                ttft, exemplar_trace=req.trace_ctx.trace_id
+                if req.trace_ctx is not None and req.trace_ctx.sampled
+                else None)
+        streamed = self.store.ingest_chain(req.prompt, res.blocks,
+                                           parent_ctx=req.trace_ctx)
+        self.blocks_streamed += streamed
+        if self.metrics:
+            self.metrics.longctx_blocks_streamed.incr(streamed)
+            self.metrics.longctx_prefill_hist.add(res.seconds)
+        if streamed and self.store.dfs_enabled:
+            # decode reads the chain back THROUGH the tiers: when the
+            # host ring is smaller than the chain, the head blocks only
+            # exist on the DataNodes — wait for durability or the
+            # read_chain below races the background writer into a gap
+            if not self.store.flush(timeout=120.0,
+                                    up_to=self.store.persists_enqueued):
+                # fail with the REAL cause, not the downstream
+                # chain-gap error read_chain would report
+                raise RuntimeError(
+                    "longctx DFS persist did not drain before decode "
+                    "(DataNodes slow or refusing writes?)")
+        done = smp.max_new_tokens <= 1 or \
+            (smp.stop_token is not None and first == smp.stop_token)
+        if not done:
+            dsp = self.tracer.span("serving.longctx.decode",
+                                   parent=req.trace_ctx)
+            dsp.add_kv("request", str(req.id))
+            try:
+                # the SAME rng that drew the first token: re-seeding
+                # here would replay its uniform stream on the second
+                # token's sample (correlated consecutive draws)
+                self.decoder.paged_decode(
+                    req.prompt, first, smp,
+                    tail_k=res.tail_k, tail_v=res.tail_v,
+                    deliver=lambda t: self._deliver(req, t),
+                    stop=self._stopped.is_set, rng=rng,
+                    parent_ctx=req.trace_ctx)
+            finally:
+                dsp.add_kv("tokens_out", str(len(req.out_tokens)))
+                dsp.finish()
+        self.requests_served += 1
+        # a non-drain stop truncates the generation mid-flight: that
+        # must surface as a FAILURE (the fused-step path fails its
+        # in-flight requests on stop too) — a client asking for 200
+        # tokens must be able to tell 37-then-stopped from complete
+        truncated = self._stopped.is_set() and \
+            len(req.out_tokens) < smp.max_new_tokens and \
+            (smp.stop_token is None or
+             req.out_tokens[-1] != smp.stop_token)
+        if truncated:
+            req._finish(FAILED, "longctx plane stopped mid-generation")
+        else:
+            req._finish(FINISHED)
+
+    def _deliver(self, req, tok: int) -> None:
+        req._deliver(tok)
+        if self.metrics:
+            self.metrics.tokens_out.incr()
+
+    # ------------------------------------------- disaggregation handoff
+
+    def prefill_to_store(self, prompt: List[int],
+                         timeout: float = 60.0) -> int:
+        """The /v1/prefill half for monster prompts: CP prefill,
+        stream the chain into the tiers, wait for DFS durability.
+        Returns the durable token span (full blocks only)."""
+        if not self.store.dfs_enabled:
+            raise ValueError("longctx prefill handoff needs the DFS KV "
+                             "tier (serving.kv.dfs.enable)")
+        # the handoff runs on the door's HTTP thread, not the worker:
+        # it must still count as in-flight work or a concurrent
+        # engine.stop(drain=True) reads the plane idle and closes the
+        # kvstore (killing the writer) under this flush
+        with self._admit_lock:
+            if self._stopped.is_set():
+                raise ValueError("longctx plane is stopped")
+            with self._inflight_lock:
+                self._inflight += 1
+        try:
+            fails_before = self.store.stats()["dfs_persist_failures"]
+            res = self.prefiller.cp_prefill(prompt)
+            n = self.store.ingest_chain(prompt, res.blocks)
+            watermark = self.store.persists_enqueued
+            if n and not self.store.flush(timeout, up_to=watermark):
+                raise TimeoutError(
+                    f"longctx DFS persist did not drain in {timeout}s")
+            # flush() counts FAILED persists toward its watermark — a
+            # refused DataNode must not be reported as a durable
+            # handoff (the engine's radix path re-verifies via
+            # persisted_span; the chain path re-verifies via the
+            # failure counter). Concurrent requests' failures can only
+            # make this report MORE conservative, never claim
+            # durability that isn't there.
+            fails = self.store.stats()["dfs_persist_failures"] \
+                - fails_before
+            durable = max(0, n - fails)
+            if n and not durable:
+                raise RuntimeError(
+                    f"longctx handoff persist failed: 0/{n} blocks "
+                    "durable (DataNodes refusing writes?)")
+            return durable * self.decoder.block_size
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+            done_cb = self.on_done
+            if done_cb is not None:
+                done_cb()
+
+    # -------------------------------------------------------- lifecycle
+
+    @property
+    def idle(self) -> bool:
+        with self._inflight_lock:
+            return self._inflight == 0
+
+    def stop(self, drain: bool = False, timeout: float = 30.0) -> None:
+        from hadoop_tpu.serving.engine import FAILED
+        if drain:
+            deadline = time.monotonic() + timeout
+            while not self.idle and time.monotonic() < deadline:
+                time.sleep(0.02)
+        with self._admit_lock:
+            # once set under the lock no further submit can enqueue;
+            # everything in the queue is older than the sentinel
+            self._stopped.set()
+            self._q.put(None)
+        self._worker.join(timeout=timeout)
+        # fail anything still queued — a submit that raced this
+        # shutdown must fail its request, never strand a client parked
+        # on .done forever
+        sentinel_seen = False
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if req is None:
+                sentinel_seen = True
+                continue
+            with self._inflight_lock:
+                self._inflight -= 1
+            if not req.done.is_set():
+                req._finish(FAILED, "longctx plane stopped")
+        if sentinel_seen and self._worker.is_alive():
+            # the join timed out mid-request and this drain swallowed
+            # the worker's shutdown sentinel — re-arm it, or the
+            # worker's next q.get() blocks forever
+            self._q.put(None)
+
+    def stats(self) -> Dict:
+        from hadoop_tpu.serving.longctx.decode import trace_counts
+        return {
+            "enabled": True,
+            "min_tokens": self.min_tokens,
+            "max_tokens": self.prefiller.pad_tokens,
+            "chips": self.prefiller.sp,
+            "sp_mode": self.prefiller.sp_mode,
+            "requests": self.requests_served,
+            "blocks_streamed": self.blocks_streamed,
+            "window_fetches": self.decoder.window_fetches,
+            "window_tokens": self.decoder.win,
+            "tail_tokens": self.decoder.tail_cap,
+            "hbm_working_set_bytes":
+                self.decoder.hbm_working_set_bytes,
+            "dequantized_view_bytes": self.dequantized_view_bytes,
+            "prefill_compiles": self.prefiller.prefill_compiles,
+            "decode_traces": trace_counts(),
+        }
+
+
+def longctx_plane_from_conf(conf, cfg: ModelConfig, engine
+                            ) -> LongContextPlane:
+    """Build the plane off a replica's conf + engine. Relaxed-tier
+    entry point: callers gate on ``serving.parity=relaxed`` (and this
+    re-validates — the CP softmax reassociation is not bitwise, so the
+    plane must be unreachable under the bitwise default)."""
+    from hadoop_tpu.serving.weightplane import weightplane_from_conf
+    wp = weightplane_from_conf(conf)
+    if not wp.relaxed:
+        raise ValueError(
+            f"{ENABLED_KEY} requires serving.parity=relaxed — the CP "
+            "softmax reassociation is not bitwise vs the single-chip "
+            "step")
+    return LongContextPlane(
+        engine.params, cfg, engine.kvstore,
+        block_size=engine.block_size,
+        min_tokens=conf.get_int(MIN_TOKENS_KEY, 4096),
+        max_tokens=conf.get_int(MAX_TOKENS_KEY, 0) or cfg.max_seq,
+        sp=conf.get_int(CHIPS_KEY, 0),
+        sp_mode=conf.get(SP_MODE_KEY, "ring"),
+        window_blocks=conf.get_int(WINDOW_KEY, 4),
+        tail_tokens=conf.get_int(TAIL_KEY, 256),
+        metrics=engine.metrics, tracer=engine.tracer)

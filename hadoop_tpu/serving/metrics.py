@@ -46,6 +46,12 @@ class ServingMetrics:
     - ``qos_admitted`` / ``qos_shed``  door QoS gate outcomes (sheds
                              are 429 + Retry-After responses)
     - ``qos_tenants``        tenants tracked by the decay scheduler
+    - ``longctx_requests`` / ``longctx_blocks_streamed`` /
+      ``longctx_window_fetches`` / ``longctx_chips`` /
+      ``longctx_prefill_seconds``  long-context plane: prompts routed
+                             to CP prefill, KV blocks streamed to the
+                             cold tiers, decode window page-ins, CP
+                             width, prefill wall time
     - ``weight_bytes``       measured resident model weight bytes
                              (``htpu_weight_bytes`` on ``/prom`` — the
                              weight-plane capacity signal: int8 resident
@@ -153,6 +159,24 @@ class ServingMetrics:
         # dtype bytes bitwise) — the number the KV budget subtracts
         self.weight_bytes = reg.gauge(
             "weight_bytes", "resident model weight bytes on the chip")
+        # the long-context plane (serving/longctx): monster prompts
+        # routed to CP prefill, KV blocks streamed into the cold
+        # tiers, decode window page-ins, CP width, and the prefill
+        # wall-time histogram (htpu_longctx_* on /prom)
+        self.longctx_requests = reg.counter(
+            "longctx_requests",
+            "prompts routed to the long-context CP prefill plane")
+        self.longctx_blocks_streamed = reg.counter(
+            "longctx_blocks_streamed",
+            "prefilled KV blocks streamed into the cold tiers")
+        self.longctx_window_fetches = reg.counter(
+            "longctx_window_fetches",
+            "decode working-set window page-ins (per layer, window)")
+        self.longctx_chips = reg.gauge(
+            "longctx_chips", "context-parallel width of the mesh")
+        self.longctx_prefill_hist = reg.histogram(
+            "longctx_prefill_seconds",
+            "context-parallel prefill wall time per prompt")
 
     def snapshot(self):
         return self.registry.snapshot()

@@ -124,6 +124,12 @@ class ServingRouter:
             "serving.router.prefill.timeout", 20.0)
         self.prefill_offloaded = 0    # handoffs that reached a prefill
         #                               replica (failures decode cold)
+        # prompts never OFFERED to a prefill replica whose advertised
+        # KV capacity (registry kv_hbm_blocks x kv_block_size tokens;
+        # longctx+DFS replicas are unbounded) cannot hold even the
+        # paged working set — a loud skip here beats a handoff that
+        # fails or times out there
+        self.prefill_capacity_skips = 0
         # heartbeat staleness: a replica that died without deregistering
         # (SIGKILL, kernel panic) stops stamping its record; past this
         # TTL the router skips it instead of burning a retry into a
@@ -182,6 +188,41 @@ class ServingRouter:
     @staticmethod
     def _rec_role(rec: ServiceRecord) -> str:
         return rec.attributes.get("role", "mixed")
+
+    @staticmethod
+    def _kv_fit(rec: ServiceRecord, n_tokens: int) -> bool:
+        """Can this replica's prefill admission hold ``n_tokens`` of
+        KV? A normal prefill admits the WHOLE prompt into the HBM
+        block pool (the host ring and DFS tiers receive demotions,
+        they cannot back an admission), so the gate is the advertised
+        pool: ``kv_hbm_blocks`` x ``kv_block_size`` tokens. A replica
+        advertising the long-context plane (``longctx=1``) with a DFS
+        tier streams monster prompts into the cold tiers instead of
+        the pool — its capacity is effectively unbounded, so the gate
+        never skips it. A record missing the attributes stays eligible
+        — hand-registered or mid-upgrade replicas must not be starved
+        by a stricter router."""
+        a = rec.attributes
+        if a.get("longctx") == "1" and a.get("kv_dfs") != "0":
+            # unbounded only up to the plane's pinned prompt budget:
+            # past serving.longctx.max.tokens the replica's own door
+            # rejects, so offering it would be the failed handoff
+            # this gate exists to prevent
+            try:
+                return n_tokens <= int(a["longctx_max_tokens"])
+            except (KeyError, ValueError):
+                return True
+        try:
+            block_size = int(a["kv_block_size"])
+            pool_blocks = int(a["kv_hbm_blocks"])
+        except (KeyError, ValueError):
+            return True
+        if block_size <= 0:
+            return True
+        # +1 token, not +1 block: the handoff's single generated token
+        # rides the prompt's last partial page when there is one —
+        # exactly the engine's own admission formula
+        return -(-(n_tokens + 1) // block_size) <= pool_blocks
 
     def _pick(self, exclude: set, affinity: Optional[str] = None,
               role: Optional[str] = None,
@@ -303,6 +344,23 @@ class ServingRouter:
             # with backoff exactly as it does for short prompts
             return False
         pres = [r for r in recs if self._rec_role(r) == "prefill"]
+        # capacity gate: a monster prompt must never be OFFERED to a
+        # replica that cannot hold even its paged working set — that
+        # handoff ends as a timeout on the request path, while this
+        # skip is free. Loud (counter + warn), never silent. (The
+        # empty-pres case falls through to the check below.)
+        fit = []
+        for r in pres:
+            if self._kv_fit(r, len(tokens)):
+                fit.append(r)
+            else:
+                self.prefill_capacity_skips += 1
+                log.warning(
+                    "prefill offload: %s advertises too little KV "
+                    "capacity for a %d-token prompt; skipping it "
+                    "(prefill_capacity_skips=%d)", r.path, len(tokens),
+                    self.prefill_capacity_skips)
+        pres = fit
         if not pres:
             return False
         # the handoff only pays off when the replica decoding next can

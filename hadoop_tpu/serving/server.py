@@ -147,6 +147,10 @@ class ServingServer:
             # quantize-at-load seconds, and the lanes x context those
             # bytes left room for (serving/weightplane.py)
             "weights": eng.weight_plane(),
+            # the long-context plane: CP width, streamed-block and
+            # window-page-in traffic, pinned compile counters — or
+            # {"enabled": False} on a bitwise replica
+            "longctx": eng.longctx_stats(),
         }
         if self.qos is not None:
             out["qos"] = self.qos.stats()

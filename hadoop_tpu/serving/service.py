@@ -173,6 +173,10 @@ class ServingReplica:
             kv_store_dir=conf.get("serving.kv.dfs.dir", "/kvcache"),
             kv_dfs_min_refs=conf.get_int("serving.kv.dfs.min-refs", 1),
             kv_codec=conf.get("serving.kv.codec", "raw"),
+            # speculative cold-fetch window: how many chain blocks one
+            # DFS round trip reads ahead (longctx chains want this
+            # sized so paging is O(chain/window) round trips)
+            kv_fetch_window=conf.get_int("serving.kv.fetch.window", 4),
             # speculative decoding: k draft tokens per decode lane from
             # the per-request n-gram index, verified in the same fused
             # step (0 = off; exact sampling either way)
@@ -196,6 +200,22 @@ class ServingReplica:
             from hadoop_tpu.serving.qos import QoSGate
             qos_gate = QoSGate(conf, self.engine, metrics=metrics,
                                scheduler=qos_sched)
+        # the long-context plane (serving/longctx): CP prefill across
+        # the replica's mesh + streamed tier ingest + working-set
+        # decode for prompts >= serving.longctx.min.tokens. Relaxed
+        # tier ONLY — the CP softmax reassociation is not bitwise.
+        self.longctx_enabled = conf.get_bool("serving.longctx.enabled",
+                                             False)
+        if self.longctx_enabled and weights.relaxed:
+            from hadoop_tpu.serving.longctx import \
+                longctx_plane_from_conf
+            self.engine.attach_longctx(
+                longctx_plane_from_conf(conf, cfg, self.engine))
+        elif self.longctx_enabled:
+            raise ValueError(
+                "serving.longctx.enabled requires serving.parity="
+                "relaxed (context-parallel prefill reassociates the "
+                "softmax — not bitwise vs the single-chip step)")
         self.server = ServingServer(self.engine, conf, bind=bind,
                                     qos=qos_gate,
                                     # the autoscaler's /v1/admin/drain
@@ -268,6 +288,27 @@ class ServingReplica:
                             # reads the tier budgets for drain planning
                             "role": self.role,
                             "kv_host_bytes": str(self.kv_host_bytes),
+                            # KV capacity in routable units: the
+                            # router's prefill capacity gate computes
+                            # a prompt's paged working set from these
+                            # and never offers a monster prompt to a
+                            # replica that cannot hold it
+                            "kv_block_bytes":
+                                str(self.engine.block_nbytes),
+                            "kv_block_size":
+                                str(self.engine.block_size),
+                            "kv_hbm_blocks":
+                                str(self.engine.pool.num_usable),
+                            "longctx": "1" if self.longctx_enabled
+                                       else "0",
+                            # the plane's pinned prompt budget: the
+                            # router's capacity gate treats a
+                            # longctx+DFS replica as unbounded only
+                            # UP TO this — offering a prompt past it
+                            # would fail at the replica's door
+                            "longctx_max_tokens": str(
+                                self.engine.longctx_stats().get(
+                                    "max_tokens", 0)),
                             "kv_dfs": "1" if self.kv_dfs_enabled
                                       else "0"})
             # the heartbeat loop below refreshes the record (stamp +

@@ -910,6 +910,75 @@ def test_lowp_package_itself_is_exempt(tmp_path):
     assert findings == []
 
 
+def test_unguarded_longctx_entry_points_are_flagged(tmp_path):
+    """The long-context plane's entry points are relaxed-tier entry
+    points: an unguarded call would run CP-reassociated softmax (not
+    bitwise) for every serving.parity=bitwise user."""
+    from hadoop_tpu.analysis import RelaxedGateChecker
+    findings = lint_source(tmp_path, """
+        from hadoop_tpu.serving.longctx import longctx_plane_from_conf
+
+        def build(conf, cfg, engine):
+            return longctx_plane_from_conf(conf, cfg, engine)  # BAD
+
+        def admit(plane, prompt, sampling):
+            return plane.longctx_submit(prompt, sampling)      # BAD
+
+        def prefill(pre, tokens):
+            return pre.cp_prefill(tokens)                      # BAD
+
+        def decode(dec, tokens, first, sampling, deliver):
+            return dec.paged_decode(tokens, first, sampling,   # BAD
+                                    deliver=deliver)
+    """, [RelaxedGateChecker()])
+    assert len(findings) == 4
+    assert all(f.checker == "parity/relaxed-gated" for f in findings)
+
+
+def test_guarded_longctx_entry_points_are_clean(tmp_path):
+    from hadoop_tpu.analysis import RelaxedGateChecker
+    findings = lint_source(tmp_path, """
+        from hadoop_tpu.serving.longctx import longctx_plane_from_conf
+
+        class Engine:
+            def submit(self, prompt, sampling):
+                if self._relaxed_longctx is not None and \\
+                        len(prompt) >= self._relaxed_longctx.min_tokens:
+                    return self._relaxed_longctx.longctx_submit(
+                        prompt, sampling)
+                return self._fused(prompt, sampling)
+
+        def wire(conf, cfg, engine, weights):
+            if weights.relaxed:
+                engine.attach_longctx(
+                    longctx_plane_from_conf(conf, cfg, engine))
+
+        def plumbing(plane):
+            # tier plumbing / observability is not a quantized path
+            return plane.stats()
+    """, [RelaxedGateChecker()])
+    assert findings == []
+
+
+def test_longctx_package_itself_is_exempt(tmp_path):
+    from hadoop_tpu.analysis import RelaxedGateChecker
+    pkg = tmp_path / "hadoop_tpu" / "serving" / "longctx"
+    pkg.mkdir(parents=True)
+    for p in (tmp_path / "hadoop_tpu", tmp_path / "hadoop_tpu" /
+              "serving", pkg):
+        (p / "__init__.py").write_text("")
+    (pkg / "plane.py").write_text(textwrap.dedent("""
+        def longctx_submit(prompt):
+            return prompt
+
+        def serve(req):
+            return longctx_submit(req)   # definition site: exempt
+    """))
+    findings = run_lint([str(tmp_path)], checkers=[RelaxedGateChecker()],
+                        root=str(tmp_path))
+    assert findings == []
+
+
 def test_shipped_tree_has_no_unguarded_relaxed_entry_points():
     """The real consumers (overlap.py, collective_matmul.py, train.py)
     stay behind their guards — the tier-1 self-run of the contract."""

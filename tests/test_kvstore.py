@@ -541,3 +541,121 @@ def test_prom_exposition_has_tier_labels(tiny_model):
     for name in ("kv_hits_hbm", "kv_hits_host", "kv_hits_dfs",
                  "kv_demotions", "kv_promotions"):
         assert f"htpu_{name}" in text
+
+
+# ---------------------------------------- chain ingest + fetch window
+
+def _bare_tiered(fetch_window=4, host_bytes=0):
+    """A TieredKVCache with tiny payload shapes and no engine behind
+    it — the chain surfaces (ingest/read) need no device pool."""
+    from hadoop_tpu.serving.kvstore import BlockPool
+    from hadoop_tpu.serving.kvstore.tiered import TieredKVCache
+    pool = BlockPool(4, 4)
+    return TieredKVCache(pool, layers=1, kv_heads=1, head_dim=2,
+                         dtype=np.float32, host_bytes=host_bytes,
+                         fetch_window=fetch_window)
+
+
+def _chain_payload(i):
+    k = np.full((1, 4, 1, 2), float(i), np.float32)
+    return k, -k
+
+
+def test_ingest_chain_roundtrips_through_read_chain():
+    """Streamed ingest (the longctx prefill sink) and read_chain (the
+    working-set decode source) agree on digests and payloads."""
+    kv = _bare_tiered(host_bytes=1 << 20)
+    tokens = list(range(40))                      # 10 full blocks
+    n = kv.ingest_chain(tokens, (_chain_payload(i) for i in range(10)))
+    assert n == 10
+    assert kv.stats()["chain_ingested"] == 10
+    hits = kv.read_chain(tokens, 10)
+    assert len(hits) == 10
+    for i, h in enumerate(hits):
+        np.testing.assert_array_equal(h.k, _chain_payload(i)[0])
+    assert kv.hits["host"] == 10
+    # a DIFFERENT token chain misses (digest chaining, not position)
+    assert kv.read_chain([9] * 40, 10) == []
+
+
+def test_ingest_chain_digests_match_the_radix_scheme():
+    """One keying for both writers: blocks streamed by ingest_chain
+    carry exactly the digests a radix insert of the same tokens would
+    — the interop that lets a normal admission map a longctx chain."""
+    from hadoop_tpu.serving.kvstore.radix import chain_digest
+    kv = _bare_tiered(host_bytes=1 << 20)
+    tokens = list(range(12))                      # 3 full blocks
+    kv.ingest_chain(tokens, (_chain_payload(i) for i in range(3)))
+    digest = kv.chain_salt
+    for i in range(3):
+        digest = chain_digest(digest, tuple(tokens[i * 4:(i + 1) * 4]))
+    assert kv.host.get(digest) is not None
+    assert kv.radix.root_digest == kv.chain_salt
+
+
+class _CountingDFS:
+    """Digest-keyed in-memory stand-in for the DFS tier that counts
+    individual reads (the per-block DataNode round trips)."""
+
+    def __init__(self, store):
+        self.store = store
+        self.reads = 0
+
+    def get(self, digest):
+        self.reads += 1
+        return self.store.get(digest)
+
+
+def test_fetch_window_pages_long_chains_in_window_round_trips():
+    """The serving.kv.fetch.window regression: a 1000-block contiguous
+    chain pages in with O(chain/window) speculative window reads, not
+    O(chain) serial round trips."""
+    from hadoop_tpu.serving.kvstore.radix import chain_digest
+    from hadoop_tpu.serving.kvstore.tiered import TieredKVCache
+
+    class Counting(TieredKVCache):
+        def __init__(self, *a, **k):
+            super().__init__(*a, **k)
+            self.window_reads = 0
+
+        def _dfs_read_window(self, digests, idx):
+            self.window_reads += 1
+            return super()._dfs_read_window(digests, idx)
+
+    chain = 1000
+    tokens = list(range(chain * 4))
+    payload = _chain_payload(1)
+
+    def mk(window):
+        from hadoop_tpu.serving.kvstore import BlockPool
+        kv = Counting(BlockPool(4, 4), layers=1, kv_heads=1,
+                      head_dim=2, dtype=np.float32,
+                      fetch_window=window)
+        store = {}
+        digest = kv.chain_salt
+        for i in range(chain):
+            digest = chain_digest(digest,
+                                  tuple(tokens[i * 4:(i + 1) * 4]))
+            store[digest] = payload
+        kv.dfs = _CountingDFS(store)
+        return kv
+
+    kv = mk(50)
+    hits = kv.read_chain(tokens, chain)
+    assert len(hits) == chain
+    assert kv.window_reads == chain // 50          # 20, not 1000
+    assert kv.dfs.reads == chain                   # every block once
+
+    kv1 = mk(1)
+    assert len(kv1.read_chain(tokens, chain)) == chain
+    assert kv1.window_reads == chain               # the old O(chain)
+
+
+def test_fetch_window_is_conf_keyed_through_the_engine(tiny_model):
+    params, cfg = tiny_model
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                       max_context=32, kv_host_bytes=1 << 20,
+                       kv_fetch_window=17)
+    assert eng.kvstore.fetch_window == 17
+    assert eng.kvstore.stats()["fetch_window"] == 17
+    eng.stop()

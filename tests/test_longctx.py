@@ -1,0 +1,535 @@
+"""Long-context serving plane (serving/longctx).
+
+The contract: a prompt too big for one chip's KV pool prefills as a CP
+job across the virtual mesh, its KV streams into the cold tiers, and
+working-set decode reproduces the single-chip ``decoder.forward``
+greedy tokens EXACTLY at small shapes — with the A-B guard rejecting a
+deliberately broken ring hop, every longctx shape compiling exactly
+once, and the engine's fused-step path untouched beside it.
+
+CP tests are capability-gated like the seed parallel suite: they skip
+when the shard_map context-parallel machinery is unavailable on the
+installed jax (the non-CP pieces — paging, validation, routing, the
+router capacity gate — run everywhere).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hadoop_tpu.models.config import get_config
+from hadoop_tpu.models.decoder import forward, init_params
+from hadoop_tpu.serving.engine import DecodeEngine, SamplingParams
+from hadoop_tpu.serving.metrics import ServingMetrics
+
+
+def _cp_supported() -> bool:
+    """One 2-device ring probe: CP tests skip (not fail) on jax builds
+    where the shard_map machinery can't run — the same capability the
+    seed parallel suite depends on."""
+    try:
+        from functools import partial
+
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from hadoop_tpu.parallel.ring_attention import ring_attention
+        mesh = Mesh(np.array(jax.devices()[:2]), ("sp",))
+        q = jnp.ones((1, 4, 2, 4), jnp.float32)
+
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"))
+        def ring(q, k, v):
+            return ring_attention(q, k, v, "sp", 2)
+
+        np.asarray(ring(q, q, q))
+        return True
+    except Exception:  # noqa: BLE001 — any failure means "not on this
+        # jax"; the skip reason is the gate, not the traceback
+        return False
+
+
+cp_only = pytest.mark.skipif(not _cp_supported(),
+                             reason="shard_map CP machinery "
+                                    "unavailable on this jax build")
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = get_config("tiny", max_seq=512)
+    return init_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+def _reference_greedy(params, cfg, prompt, n):
+    ctx = list(prompt)
+    out = []
+    for _ in range(n):
+        lg = forward(params, jnp.asarray(ctx, jnp.int32)[None, :],
+                     cfg)[0, -1]
+        tok = int(jnp.argmax(lg))
+        out.append(tok)
+        ctx.append(tok)
+    return out
+
+
+def _prompt(cfg, n, seed=1):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, cfg.vocab_size, size=n).tolist()
+
+
+def _mk_plane(params, cfg, engine, **kw):
+    from hadoop_tpu.serving.longctx import LongContextPlane
+    kw.setdefault("block_size", engine.block_size)
+    kw.setdefault("min_tokens", 100)
+    kw.setdefault("max_tokens", 256)
+    kw.setdefault("sp", 4)
+    kw.setdefault("window_blocks", 3)
+    kw.setdefault("tail_tokens", 64)
+    kw.setdefault("metrics", engine.metrics)
+    return LongContextPlane(params, cfg, engine.kvstore, **kw)
+
+
+# ------------------------------------------------------- plan / topology
+
+@pytest.mark.parametrize("shape", [(2, 4), (4, 4), (2, 2, 2),
+                                   (2, 2, 4), (4, 4, 4), (2, 3, 4)])
+def test_ring_order_snakes_through_the_grid(shape):
+    """TASP placement: consecutive CP ranks must be physical neighbors
+    — on every coordinate grid (2D and the 3D torus-slice shapes) the
+    snake order makes every hop one step on one axis."""
+    import itertools
+
+    from hadoop_tpu.serving.longctx import ring_order
+
+    class Dev:
+        def __init__(self, i, coords):
+            self.id = i
+            self.coords = coords
+
+    coords = list(itertools.product(*[range(s) for s in shape]))
+    devs = [Dev(i, c) for i, c in enumerate(coords)]
+    rng = np.random.default_rng(3)
+    shuffled = [devs[i] for i in rng.permutation(len(devs))]
+    ordered = ring_order(shuffled)
+    for a, b in zip(ordered, ordered[1:]):
+        dist = sum(abs(x - y) for x, y in zip(a.coords, b.coords))
+        assert dist == 1, (
+            f"non-neighbor hop {a.coords}->{b.coords} on grid {shape}")
+
+
+def test_ring_order_without_coords_is_id_order():
+    from hadoop_tpu.serving.longctx import ring_order
+
+    class Dev:
+        def __init__(self, i):
+            self.id = i
+            self.coords = None
+
+    devs = [Dev(i) for i in (3, 0, 2, 1)]
+    assert [d.id for d in ring_order(devs)] == [0, 1, 2, 3]
+
+
+def test_choose_sp_mode_validates_and_falls_back(tiny_model):
+    from hadoop_tpu.serving.longctx import choose_sp_mode
+    _, cfg = tiny_model
+    assert choose_sp_mode(cfg, 2, "ulysses") == "ulysses"
+    # tiny has 2 kv heads: ulysses over 4 ranks is impossible — loud
+    # fallback, not a refused workload
+    assert choose_sp_mode(cfg, 4, "ulysses") == "ring"
+    with pytest.raises(ValueError):
+        choose_sp_mode(cfg, 2, "diagonal")
+
+
+# ------------------------------------------------------ CP prefill parity
+
+@cp_only
+@pytest.mark.parametrize("sp,mode", [(4, "ring"), (2, "ulysses")])
+def test_cp_prefill_exact_match(tiny_model, sp, mode):
+    """Small-shape A-B: CP last-token logits vs single-chip
+    ``decoder.forward`` — exact guard (tight atol + greedy argmax
+    identity), for both CP strategies."""
+    from hadoop_tpu.serving.longctx import (ContextParallelPrefiller,
+                                            run_prefill_ab)
+    params, cfg = tiny_model
+    prompt = _prompt(cfg, 150)
+    pre = ContextParallelPrefiller(params, cfg, block_size=8,
+                                   pad_tokens=160, sp=sp, sp_mode=mode)
+    report = run_prefill_ab(params, cfg, prompt, pre, mode="exact")
+    assert report["accepted"] and report["argmax_agree"]
+    assert report["sp_mode"] == mode
+
+
+@cp_only
+def test_cp_prefill_pinned_shape_compiles_once(tiny_model):
+    """Different prompt lengths ride ONE padded executable — the
+    compile-once contract of the longctx plane."""
+    from hadoop_tpu.serving.longctx import ContextParallelPrefiller
+    params, cfg = tiny_model
+    pre = ContextParallelPrefiller(params, cfg, block_size=8,
+                                   pad_tokens=200, sp=4)
+    for n in (110, 150, 197):
+        res = pre.cp_prefill(_prompt(cfg, n, seed=n))
+        list(res.blocks)      # drain the stream
+    assert pre.prefill_compiles == 1
+    assert pre.head_compiles == 1
+
+
+@cp_only
+def test_guard_rejects_broken_ring_hop(tiny_model, monkeypatch):
+    """A deliberately corrupted ring hop (one rank's attention output
+    scaled) must be REJECTED by the exact guard — the A-B machinery is
+    what stands between a silent CP bug and served logits."""
+    import hadoop_tpu.parallel.ring_attention as ra
+    from hadoop_tpu.parallel.lowp.guard import ParityGuardError
+    from hadoop_tpu.serving.longctx import (ContextParallelPrefiller,
+                                            run_prefill_ab)
+    params, cfg = tiny_model
+    orig = ra.ring_attention
+
+    def broken(q, k, v, axis_name, axis_size, impl="auto"):
+        out = orig(q, k, v, axis_name, axis_size, impl)
+        rank = jax.lax.axis_index(axis_name)
+        return out * jnp.where(rank == 1, 1.5, 1.0)
+
+    monkeypatch.setattr(ra, "ring_attention", broken)
+    pre = ContextParallelPrefiller(params, cfg, block_size=8,
+                                   pad_tokens=160, sp=4)
+    with pytest.raises(ParityGuardError):
+        run_prefill_ab(params, cfg, _prompt(cfg, 150), pre,
+                       mode="exact")
+
+
+# ------------------------------------------------------------ end to end
+
+@cp_only
+def test_longctx_end_to_end_matches_single_chip(tiny_model):
+    """The whole lane: submit through the ENGINE (routing seam), CP
+    prefill, KV streamed to the host ring, working-set decode — greedy
+    tokens identical to repeated single-chip forward."""
+    params, cfg = tiny_model
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=8,
+                       max_context=64, prefill_chunk=8,
+                       kv_host_bytes=1 << 22, metrics=ServingMetrics())
+    plane = _mk_plane(params, cfg, eng)
+    eng.attach_longctx(plane)
+    try:
+        prompt = _prompt(cfg, 150)
+        req = eng.submit(prompt, SamplingParams(max_new_tokens=6))
+        toks = req.wait(180)
+        assert toks == _reference_greedy(params, cfg, prompt, 6)
+        # the fused step never ran: the monster prompt was the plane's
+        assert eng.steps == 0
+        st = plane.stats()
+        assert st["requests"] == 1
+        assert st["blocks_streamed"] == len(prompt) // 8
+        kv = eng.kvstore.stats()
+        assert kv["chain_ingested"] == len(prompt) // 8
+        assert kv["hits_host"] >= len(prompt) // 8
+        # working set stays a window+tail, far under the full context
+        full_ctx_bytes = (len(prompt) * 2 * cfg.n_layers *
+                          cfg.n_kv_heads * cfg.head_dim * 4)
+        assert plane.decoder.hbm_working_set_bytes < full_ctx_bytes
+        assert st["window_fetches"] > 0
+    finally:
+        eng.stop()
+
+
+@cp_only
+def test_streamed_chain_feeds_the_radix_path(tiny_model):
+    """Interop: a SHORT prompt that is a prefix of a served monster
+    prompt maps the longctx-streamed chain through the normal radix
+    admission (fetch_cold promotions) — one digest scheme, two
+    consumers."""
+    params, cfg = tiny_model
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=8,
+                       max_context=64, prefill_chunk=8,
+                       kv_host_bytes=1 << 22, metrics=ServingMetrics())
+    plane = _mk_plane(params, cfg, eng)
+    eng.attach_longctx(plane)
+    try:
+        prompt = _prompt(cfg, 150)
+        eng.submit(prompt, SamplingParams(max_new_tokens=2)).wait(180)
+        short = prompt[:24]
+        req = eng.submit(short, SamplingParams(max_new_tokens=3))
+        while not req.done.is_set():
+            eng.step()
+        assert req.wait(0) == _reference_greedy(params, cfg, short, 3)
+        assert eng.kvstore.promotions > 0
+    finally:
+        eng.stop()
+
+
+@cp_only
+def test_short_prompts_keep_the_fused_step(tiny_model):
+    """Routing seam: below min_tokens the request rides the fused step
+    exactly as before (compile-once intact), at/above it the plane
+    serves without touching the step."""
+    params, cfg = tiny_model
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=8,
+                       max_context=64, prefill_chunk=8,
+                       kv_host_bytes=1 << 20, metrics=ServingMetrics())
+    plane = _mk_plane(params, cfg, eng, min_tokens=100)
+    eng.attach_longctx(plane)
+    try:
+        short = _prompt(cfg, 20)
+        req = eng.submit(short, SamplingParams(max_new_tokens=3))
+        while not req.done.is_set():
+            eng.step()
+        assert req.wait(0) == _reference_greedy(params, cfg, short, 3)
+        assert eng.decode_compiles == 1
+        assert eng.prefill_compiles == 1
+        long_req = eng.submit(_prompt(cfg, 120),
+                              SamplingParams(max_new_tokens=2))
+        long_req.wait(180)
+        assert eng.decode_compiles == 1      # untouched by the plane
+        assert eng.prefill_compiles == 1
+    finally:
+        eng.stop()
+
+
+@cp_only
+def test_engine_drain_finishes_longctx_request(tiny_model):
+    params, cfg = tiny_model
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=8,
+                       max_context=64, kv_host_bytes=1 << 20,
+                       metrics=ServingMetrics())
+    plane = _mk_plane(params, cfg, eng)
+    eng.attach_longctx(plane)
+    req = eng.submit(_prompt(cfg, 120), SamplingParams(max_new_tokens=2))
+    eng.stop(drain=True, timeout=180.0)
+    assert req.done.is_set()
+    assert req.state == "FINISHED"
+    assert len(req.out_tokens) == 2
+
+
+# ------------------------------------------------------------ validation
+
+def test_longctx_submit_validation(tiny_model):
+    """Requests the plane can NEVER serve fail loudly at submit (the
+    door's 400), not as a wedged worker."""
+    params, cfg = tiny_model
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=8,
+                       max_context=64, kv_host_bytes=1 << 20,
+                       metrics=ServingMetrics())
+    plane = _mk_plane(params, cfg, eng, sp=1, tail_tokens=16)
+    eng.attach_longctx(plane)
+    try:
+        with pytest.raises(ValueError, match="max.tokens"):
+            eng.submit(_prompt(cfg, 300),
+                       SamplingParams(max_new_tokens=2))
+        with pytest.raises(ValueError, match="tail"):
+            eng.submit(_prompt(cfg, 120),
+                       SamplingParams(max_new_tokens=32))
+    finally:
+        eng.stop()
+
+
+def test_host_ring_too_small_for_chain_is_loud(tiny_model):
+    params, cfg = tiny_model
+    # a ring that holds ~4 blocks cannot hold a 15-block chain and
+    # there is no DFS tier behind it — reject at the door
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=8,
+                       max_context=64,
+                       kv_host_bytes=4 * 2 * cfg.n_layers * 8 *
+                       cfg.n_kv_heads * cfg.head_dim * 4,
+                       metrics=ServingMetrics())
+    plane = _mk_plane(params, cfg, eng, sp=1)
+    eng.attach_longctx(plane)
+    try:
+        with pytest.raises(ValueError, match="host-ring|host.ring|ring"):
+            eng.submit(_prompt(cfg, 130),
+                       SamplingParams(max_new_tokens=2))
+    finally:
+        eng.stop()
+
+
+def test_plane_requires_cold_tier(tiny_model):
+    from hadoop_tpu.serving.longctx import LongContextPlane
+    params, cfg = tiny_model
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=8,
+                       max_context=64)
+    try:
+        with pytest.raises(ValueError, match="cold|host|dfs"):
+            LongContextPlane(params, cfg, eng.kvstore, block_size=8,
+                             min_tokens=100)
+    finally:
+        eng.stop()
+
+
+def test_plane_from_conf_requires_relaxed_parity(tiny_model):
+    """The tier gate: under the bitwise default the plane must be
+    unconstructable — CP softmax reassociation is not bitwise."""
+    from hadoop_tpu.conf import Configuration
+    from hadoop_tpu.serving.longctx import longctx_plane_from_conf
+    params, cfg = tiny_model
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=8,
+                       max_context=64, kv_host_bytes=1 << 20,
+                       metrics=ServingMetrics())
+    try:
+        conf = Configuration(load_defaults=False)
+        with pytest.raises(ValueError, match="relaxed"):
+            longctx_plane_from_conf(conf, cfg, eng)
+        conf.set("serving.parity", "relaxed")
+        conf.set("serving.longctx.min.tokens", "100")
+        conf.set("serving.longctx.chips", "2")
+        plane = longctx_plane_from_conf(conf, cfg, eng)
+        assert plane.min_tokens == 100
+        assert plane.prefiller.sp == 2
+        plane.stop()
+    finally:
+        eng.stop()
+
+
+def test_health_exposes_longctx_stats(tiny_model):
+    from hadoop_tpu.conf import Configuration
+    from hadoop_tpu.serving.server import ServingServer
+    params, cfg = tiny_model
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=8,
+                       max_context=64, kv_host_bytes=1 << 20,
+                       metrics=ServingMetrics())
+    plane = _mk_plane(params, cfg, eng, sp=1)
+    eng.attach_longctx(plane)
+    srv = ServingServer(eng, Configuration(load_defaults=False))
+    try:
+        status, health = srv._health({}, b"")
+        assert status == 200
+        assert health["longctx"]["enabled"] is True
+        assert health["longctx"]["chips"] == 1
+    finally:
+        eng.stop()
+    # a bitwise replica reports the plane absent
+    plain = DecodeEngine(params, cfg, max_batch=2, block_size=8,
+                         max_context=64)
+    assert plain.longctx_stats() == {"enabled": False}
+    plain.stop()
+
+
+# ------------------------------------------- router prefill capacity gate
+
+def _rec(path, role, **attrs):
+    from hadoop_tpu.registry import ServiceRecord
+    a = {"state": "serving", "role": role}
+    a.update({k: str(v) for k, v in attrs.items()})
+    return ServiceRecord(path, {"http": "127.0.0.1:9"}, a)
+
+
+def _router(conf=None):
+    from hadoop_tpu.conf import Configuration
+    from hadoop_tpu.serving.router import ServingRouter
+    conf = conf or Configuration(load_defaults=False)
+    conf.set("serving.router.prefill.min.tokens", "8")
+    return ServingRouter(("127.0.0.1", 1), "svc", conf)
+
+
+def test_router_skips_undersized_prefill_replica(monkeypatch):
+    """The capacity gate: a monster prompt is never OFFERED to a
+    prefill replica whose advertised HBM pool cannot hold its paged
+    working set — loud skip with a counter, not a handoff failure.
+    (The host ring backs demotions, not admissions, so it does NOT
+    count toward prefill capacity.)"""
+    r = _router()
+    # pool of 4 blocks x 4 tokens = 16 tokens; a fat host ring must
+    # not make a 100-token prompt look admittable
+    small = _rec("/services/serving/svc/small", "prefill",
+                 kv_block_bytes=1024, kv_block_size=4, kv_hbm_blocks=4,
+                 kv_host_bytes=1 << 30)
+    dec = _rec("/services/serving/svc/dec", "decode")
+    monkeypatch.setattr(r, "replicas",
+                        lambda refresh=False: [small, dec])
+    posts = []
+    monkeypatch.setattr(r, "_post",
+                        lambda *a, **k: posts.append(a) or {})
+    shipped = r._maybe_offload_prefill(
+        {"tokens": list(range(100))}, None)
+    assert shipped is False
+    assert r.prefill_capacity_skips == 1
+    assert posts == []
+    r.close()
+
+
+def test_router_offloads_to_the_replica_that_fits(monkeypatch):
+    r = _router()
+    small = _rec("/services/serving/svc/small", "prefill",
+                 kv_block_bytes=1024, kv_block_size=4, kv_hbm_blocks=4,
+                 kv_host_bytes=0)
+    big = _rec("/services/serving/svc/big", "prefill",
+               kv_block_bytes=1024, kv_block_size=4, kv_hbm_blocks=64,
+               kv_host_bytes=0)
+    dec = _rec("/services/serving/svc/dec", "decode")
+    monkeypatch.setattr(r, "replicas",
+                        lambda refresh=False: [small, big, dec])
+    posts = []
+    monkeypatch.setattr(
+        r, "_post",
+        lambda rec, *a, **k: posts.append(rec.path) or
+        {"persisted_tokens": 100})
+    assert r._maybe_offload_prefill({"tokens": list(range(100))},
+                                    None) is True
+    assert posts == ["/services/serving/svc/big"]
+    assert r.prefill_capacity_skips == 1
+    r.close()
+
+
+def test_router_longctx_replica_is_never_capacity_skipped(monkeypatch):
+    """A replica advertising the long-context plane + DFS streams
+    monster prompts into the cold tiers — its tiny HBM pool must not
+    disqualify it (that pool is exactly what longctx works around)."""
+    r = _router()
+    lcx = _rec("/services/serving/svc/lcx", "prefill",
+               kv_block_bytes=1024, kv_block_size=4, kv_hbm_blocks=4,
+               kv_host_bytes=0, longctx=1, kv_dfs=1)
+    dec = _rec("/services/serving/svc/dec", "decode")
+    monkeypatch.setattr(r, "replicas",
+                        lambda refresh=False: [lcx, dec])
+    posts = []
+    monkeypatch.setattr(
+        r, "_post",
+        lambda rec, *a, **k: posts.append(rec.path) or
+        {"persisted_tokens": 100000})
+    assert r._maybe_offload_prefill({"tokens": list(range(100000))},
+                                    None) is True
+    assert posts == ["/services/serving/svc/lcx"]
+    assert r.prefill_capacity_skips == 0
+    r.close()
+
+
+def test_router_respects_longctx_pinned_budget(monkeypatch):
+    """...but only up to the plane's advertised pinned prompt budget:
+    past serving.longctx.max.tokens the replica's door rejects, so the
+    gate must skip rather than burn a doomed handoff."""
+    r = _router()
+    lcx = _rec("/services/serving/svc/lcx", "prefill",
+               kv_block_bytes=1024, kv_block_size=4, kv_hbm_blocks=4,
+               longctx=1, kv_dfs=1, longctx_max_tokens=4096)
+    dec = _rec("/services/serving/svc/dec", "decode")
+    monkeypatch.setattr(r, "replicas",
+                        lambda refresh=False: [lcx, dec])
+    posts = []
+    monkeypatch.setattr(r, "_post",
+                        lambda *a, **k: posts.append(a) or {})
+    assert r._maybe_offload_prefill({"tokens": list(range(5000))},
+                                    None) is False
+    assert posts == []
+    assert r.prefill_capacity_skips == 1
+    r.close()
+
+
+def test_router_keeps_legacy_records_eligible(monkeypatch):
+    """Records without capacity attributes (hand-registered,
+    mid-upgrade) must stay eligible — a stricter router cannot starve
+    an older fleet."""
+    r = _router()
+    legacy = _rec("/services/serving/svc/old", "prefill")
+    dec = _rec("/services/serving/svc/dec", "decode")
+    monkeypatch.setattr(r, "replicas",
+                        lambda refresh=False: [legacy, dec])
+    posts = []
+    monkeypatch.setattr(
+        r, "_post",
+        lambda rec, *a, **k: posts.append(rec.path) or
+        {"persisted_tokens": 8})
+    assert r._maybe_offload_prefill({"tokens": list(range(50))},
+                                    None) is True
+    assert posts and r.prefill_capacity_skips == 0
+    r.close()
