@@ -1,0 +1,287 @@
+"""Training flight-recorder smoke: a straggler rank, caught and cleared.
+
+The acceptance loop for the per-rank trainer telemetry plane, end to
+end over real subprocess ranks:
+
+  1. four worker subprocesses each run a real jitted comm-bearing step
+     (bucketed psum over a 2-virtual-device mesh — the overlap pass's
+     actual entry point, so the runtime comm ledger records REAL
+     trace-time bytes and REAL dispatch walls) and publish step anatomy
+     through the real ``TrainerStepMetrics`` + ``TrainerTelemetry``
+     chassis (``/ws/v1/trainer``, ``/prom``, ``/ws/v1/traces``);
+  2. rank 2 gets an INJECTED per-step latency (a flag file the parent
+     controls — the detection decision reads only the reported means,
+     and ``obs.doctor.slow.floor.ms=50`` sits far above single-box
+     noise);
+  3. the fleet doctor must flag exactly rank 2 at
+     ``/ws/v1/fleet/doctor`` within 3 observation windows, and must
+     UNFLAG it within the hysteresis history once the injection stops;
+  4. the slow rank's ``htpu_comm_seconds`` histogram must show the
+     collective tail (site mean >= 2x the healthy ranks') with a
+     bucket exemplar whose trace id resolves through the doctor into
+     an assembled trace.
+
+Contract failures are RECORDED in the returned dict (``failures``),
+not raised — run_all keeps its prior bench results either way.
+
+  python -m benchmarks.flight_smoke
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+
+N_RANKS = 4
+SLOW_RANK = 2
+SLOW_SECONDS = 0.30
+STEP_PACE = 0.02
+
+
+# ---------------------------------------------------------------- worker
+
+def worker_main(argv) -> int:
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--port-file", required=True)
+    ap.add_argument("--slow-file", required=True)
+    ap.add_argument("--stop-file", required=True)
+    ap.add_argument("--max-seconds", type=float, default=120.0)
+    args = ap.parse_args(argv)
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from hadoop_tpu.obs.comm import comm_runtime
+    from hadoop_tpu.obs.trainer import (TrainerStepMetrics,
+                                        TrainerTelemetry)
+    from hadoop_tpu.parallel.overlap import bucketed_psum
+    from hadoop_tpu.tracing.tracer import global_tracer
+
+    tracer = global_tracer()
+    tracer.set_sample_rate(1.0)
+    metrics = TrainerStepMetrics(rank=args.rank)
+    telemetry = TrainerTelemetry(rank=args.rank, job="flight-smoke",
+                                 metrics=metrics)
+    with open(args.port_file + ".tmp", "w") as f:
+        f.write(str(telemetry.port))
+    os.replace(args.port_file + ".tmp", args.port_file)
+
+    # a real comm-bearing step: matmul "work" + the overlap pass's
+    # bucketed gradient psum over the 2-device mesh
+    mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+    tree = {"w": jnp.ones((32, 32)), "b": jnp.ones((64,))}
+    axes = {"w": ("dp",), "b": ("dp",)}
+
+    def body(t):
+        g = {"w": t["w"] @ t["w"].T * 1e-3, "b": t["b"] * 0.5}
+        return bucketed_psum(g, axes, 1 << 20)
+
+    step = jax.jit(shard_map(body, mesh=mesh, in_specs=(P(),),
+                             out_specs=P()))
+    rt = comm_runtime()
+    deadline = time.monotonic() + args.max_seconds
+    while time.monotonic() < deadline and \
+            not os.path.exists(args.stop_file):
+        t0 = time.monotonic()
+        with tracer.span("trainer.step") as sp:
+            sp.add_kv("rank", str(args.rank))
+            with rt.step("trainer.step"):
+                out = step(tree)
+                jax.block_until_ready(out)
+                if os.path.exists(args.slow_file):
+                    time.sleep(SLOW_SECONDS)   # the injection
+        wall = time.monotonic() - t0
+        metrics.steps.incr()
+        metrics.step_wall.add(wall)
+        metrics.step_wall_hist.add(wall)
+        time.sleep(STEP_PACE)
+    telemetry.close()
+    return 0
+
+
+# ---------------------------------------------------------------- parent
+
+def run(quick: bool = False) -> dict:
+    from hadoop_tpu.conf import Configuration
+    from hadoop_tpu.http import http_get
+    from hadoop_tpu.obs.doctor import FleetDoctor
+
+    # quick: shorter observation windows + fewer recovery polls. The
+    # rank count stays 4 — the detector's min-peers=3 needs a
+    # population to be an outlier among, so that is the floor.
+    window_s = 0.6 if quick else 1.0
+    recovery_polls = 6 if quick else 8
+    out: dict = {"failures": []}
+
+    def check(ok: bool, what: str) -> None:
+        if not ok:
+            out["failures"].append(what)
+
+    base = tempfile.mkdtemp(prefix="flight-smoke-")
+    slow_file = os.path.join(base, "slow")
+    stop_file = os.path.join(base, "stop")
+    with open(slow_file, "w") as f:
+        f.write("1")
+    procs = []
+    ports = {}
+    doctor = None
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)   # workers set their own device count
+        for r in range(N_RANKS):
+            pf = os.path.join(base, f"port-{r}")
+            sf = slow_file if r == SLOW_RANK else \
+                os.path.join(base, "never")
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "benchmarks.flight_smoke",
+                 "--worker", "--rank", str(r), "--port-file", pf,
+                 "--slow-file", sf, "--stop-file", stop_file],
+                env=env, cwd=os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__)))))
+        deadline = time.monotonic() + 90.0
+        for r in range(N_RANKS):
+            pf = os.path.join(base, f"port-{r}")
+            while not os.path.exists(pf):
+                if time.monotonic() > deadline:
+                    raise RuntimeError(f"rank {r} never came up")
+                if procs[r].poll() is not None:
+                    raise RuntimeError(
+                        f"rank {r} exited rc={procs[r].returncode}")
+                time.sleep(0.2)
+            with open(pf) as f:
+                ports[r] = int(f.read())
+        slow_name = f"rank-{SLOW_RANK}"
+        conf = Configuration(load_defaults=False)
+        conf.set("obs.doctor.endpoints", ",".join(
+            f"rank-{r}=127.0.0.1:{ports[r]}" for r in range(N_RANKS)))
+        # the absolute floor sits far above single-box noise: only the
+        # injected latency can clear it (the doctor_smoke precedent)
+        conf.set("obs.doctor.slow.floor.ms", "50")
+        doctor = FleetDoctor(conf)
+        doctor.init(conf)
+        doctor.start()
+        # first poll establishes the cumulative baseline (no diff yet)
+        doctor.poll_once()
+        time.sleep(window_s)
+        windows = 0
+        flagged: list = []
+        for windows in range(1, 4):
+            time.sleep(window_s)
+            report = doctor.poll_once()
+            flagged = sorted(report["trainers"]["flagged"])
+            if flagged == [slow_name]:
+                break
+        out["windows_to_flag"] = windows
+        out["flagged"] = flagged
+        check(flagged == [slow_name],
+              f"flagged {flagged} != injected-slow [{slow_name}]")
+        ranks = report["trainers"]["ranks"]
+        check(len(ranks) == N_RANKS and
+              all(r.get("ok") for r in ranks.values()),
+              f"roster incomplete or unhealthy: {ranks}")
+        # -------- recovery: stop the injection, hysteresis must clear
+        os.remove(slow_file)
+        recovered_in = None
+        for w in range(1, recovery_polls):
+            time.sleep(window_s)
+            report = doctor.poll_once()
+            if not report["trainers"]["flagged"]:
+                recovered_in = w
+                break
+        out["windows_to_recover"] = recovered_in
+        check(recovered_in is not None,
+              "slow rank never unflagged after the injection stopped")
+        # -------- comm ledger: the slow rank's collective tail
+        means = {}
+        proms = {}
+        for r in range(N_RANKS):
+            text = http_get("127.0.0.1", ports[r], "/prom",
+                            5.0).decode()
+            proms[r] = text
+            m = re.search(
+                r'htpu_comm_seconds_sum\{[^}]*site="bucket.psum"[^}]*\} '
+                r'([0-9.e+-]+)', text)
+            c = re.search(
+                r'htpu_comm_seconds_count\{[^}]*site="bucket.psum"'
+                r'[^}]*\} ([0-9.e+-]+)', text)
+            if m and c and float(c.group(1)) > 0:
+                means[r] = float(m.group(1)) / float(c.group(1))
+        out["comm_means_ms"] = {r: round(v * 1e3, 2)
+                                for r, v in means.items()}
+        healthy = [v for r, v in means.items() if r != SLOW_RANK]
+        check(len(means) == N_RANKS, f"comm histograms missing: {means}")
+        check(bool(healthy) and SLOW_RANK in means and
+              means[SLOW_RANK] >= 2.0 * max(healthy),
+              f"slow rank's comm tail not visible: {means}")
+        # -------- exemplar: a slow comm bucket resolves to a trace
+        ex = re.search(
+            r'htpu_comm_seconds_bucket\{[^}]*\} \d+ '
+            r'# \{trace_id="([0-9a-f]+)"\}', proms[SLOW_RANK])
+        check(ex is not None, "no exemplar on the slow rank's "
+                              "htpu_comm_seconds buckets")
+        if ex is not None:
+            doctor.poll_once()        # pull the rank's span ring
+            status, body = 0, b""
+            try:
+                body = http_get("127.0.0.1", doctor.port,
+                                f"/ws/v1/fleet/traces/{ex.group(1)}",
+                                5.0)
+                status = 200
+            except IOError:
+                pass
+            check(status == 200, "exemplar trace did not resolve "
+                                 "through the doctor")
+            if status == 200:
+                tree = json.loads(body)
+                out["exemplar_spans"] = tree.get("num_spans")
+                check(tree.get("num_spans", 0) >= 1,
+                      "assembled exemplar trace is empty")
+    except Exception as e:  # noqa: BLE001 — smoke harness failure is a
+        # recorded data point for the trajectory, never a crash
+        out["failures"].append(f"{type(e).__name__}: {e}")
+    finally:
+        try:
+            with open(stop_file, "w") as f:
+                f.write("1")
+        except OSError:
+            pass
+        for p in procs:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if doctor is not None:
+            doctor.stop()
+        import shutil
+        shutil.rmtree(base, ignore_errors=True)
+    out["ok"] = not out["failures"]
+    return out
+
+
+def main() -> int:
+    if "--worker" in sys.argv:
+        argv = [a for a in sys.argv[1:] if a != "--worker"]
+        return worker_main(argv)
+    result = run()
+    print(json.dumps(result, indent=2))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

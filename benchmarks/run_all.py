@@ -37,9 +37,89 @@ def _dynamometer(n_ops: int) -> dict:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def _code_hash() -> str:
+    """Short git hash of the tree the suite ran against (the train-row
+    precedent in BENCH_LOG.jsonl carries the same ``code`` field)."""
+    import os
+    import subprocess
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))).stdout.strip()
+    except Exception:  # noqa: BLE001 — no git = no hash, not no log
+        return ""
+
+
+def _suite_failures(result: dict) -> list:
+    """Failure strings a suite reported, whatever its local shape:
+    an ``error`` (the suite itself died) or a ``failures`` list (a
+    contract inside it failed)."""
+    if not isinstance(result, dict):
+        return []
+    out = []
+    if result.get("error"):
+        out.append(str(result["error"]))
+    # both in-tree conventions: doctor/flight use "failures",
+    # longctx/serve_bench contracts use "failed"
+    for key in ("failures", "failed"):
+        for f in result.get(key) or []:
+            out.append(str(f))
+    return out
+
+
+# per-suite key metrics for the trajectory row: (path into the suite
+# result, logged name). Scalars only — the full result stays in --out.
+_KEY_METRICS = {
+    "nn_throughput_ops_per_sec": (("create",), "create_ops_per_sec"),
+    "dfsio": (("write_mb_s",), "write_mb_s"),
+    "terasort": (("sort_bytes_per_sec",), "sort_bytes_per_sec"),
+    "serving": (("value",), "ttft_p50_ms"),
+    "serving_speculate": (("steps_ratio",), "steps_ratio"),
+    "serving_quantized": (("value",), "capacity_ratio"),
+    "trace_overhead": (("step", "overhead_frac"), "overhead_frac"),
+    "doctor": (("windows_to_flag",), "windows_to_flag"),
+    "flight_recorder": (("windows_to_flag",), "windows_to_flag"),
+}
+
+
+def _append_bench_log(path: str, out: dict, quick: bool) -> None:
+    summary = {}
+    failures = []
+    for suite, result in out.items():
+        if suite in ("timestamp", "host", "wall_seconds"):
+            continue
+        fails = _suite_failures(result) if isinstance(result, dict) \
+            else []
+        failures.extend(f"{suite}: {f}" for f in fails)
+        keyed = _KEY_METRICS.get(suite)
+        node = result
+        if keyed is not None:
+            for k in keyed[0]:
+                node = node.get(k) if isinstance(node, dict) else None
+            if isinstance(node, (int, float)) and not isinstance(
+                    node, bool):
+                summary[f"{suite}.{keyed[1]}"] = node
+    row = {"metric": "bench_suite",
+           "timestamp": out.get("timestamp"),
+           "code": _code_hash(),
+           "quick": quick,
+           "wall_seconds": out.get("wall_seconds"),
+           "suites": sorted(k for k in out if k not in
+                            ("timestamp", "host", "wall_seconds")),
+           "key_metrics": summary,
+           "failures": failures}
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(json.dumps(row) + "\n")
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="STORAGE_BENCH.json")
+    ap.add_argument("--log", default="BENCH_LOG.jsonl",
+                    help="bench trajectory log (one summary row per "
+                         "suite run, appended)")
     ap.add_argument("--quick", action="store_true",
                     help="smaller sizes for smoke runs")
     args = ap.parse_args()
@@ -196,9 +276,29 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — recorded for the
         # trajectory; must not discard the benches already computed
         out["doctor"] = {"error": f"{type(e).__name__}: {e}"}
+    # Training flight recorder: four subprocess trainer ranks, one with
+    # injected per-step latency — the doctor must flag exactly that
+    # rank within 3 observation windows and unflag it within the
+    # hysteresis history; the slow rank's htpu_comm collective tail
+    # must carry a doctor-resolvable exemplar. Recorded-not-raised.
+    try:
+        from benchmarks import flight_smoke
+        out["flight_recorder"] = flight_smoke.run(quick=args.quick)
+    except Exception as e:  # noqa: BLE001 — recorded for the
+        # trajectory; must not discard the benches already computed
+        out["flight_recorder"] = {"error": f"{type(e).__name__}: {e}"}
     out["wall_seconds"] = round(time.perf_counter() - t0, 1)
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)
+    # One summary row per suite run into the bench trajectory log: the
+    # log used to carry only hand-stamped train rows, so a regression
+    # BETWEEN issues was invisible until someone re-ran a bench by
+    # hand. Key metrics + failures per suite, appended, never rewritten.
+    try:
+        _append_bench_log(args.log, out, quick=args.quick)
+    except Exception as e:  # noqa: BLE001 — the trajectory log is
+        # best-effort; a full bench run must never die on it
+        print(f"BENCH_LOG append failed: {type(e).__name__}: {e}")
     print(json.dumps(out))
 
 

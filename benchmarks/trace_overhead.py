@@ -123,6 +123,81 @@ def bench_step(n_steps: int = 30, repeats: int = 3) -> dict:
     }
 
 
+def bench_comm(n_steps: int = 30, repeats: int = 3) -> dict:
+    """Comm-timing arm: the SAME jitted step driven through the runtime
+    comm ledger's dispatch seam (obs/comm.py) with ``obs.comm.timing``
+    on vs off. Both arms pay the seam context manager (the trainer
+    always enters it); the gate decides whether the per-site byte
+    counters + latency histograms are bookkept — exactly the new-ledger
+    cost the 5% bound must cover. The step itself has no collectives
+    (single device), so a representative site profile is stamped at
+    capture time: site byte values are static trace facts either way,
+    and the bookkeeping cost per step is what is being measured."""
+    import jax
+    import jax.numpy as jnp
+
+    from hadoop_tpu.models.config import get_config
+    from hadoop_tpu.models.decoder import forward, init_params
+    from hadoop_tpu.obs.comm import comm_runtime, record_comm
+    from hadoop_tpu.tracing.tracer import global_tracer
+
+    cfg = get_config("tiny")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.zeros((2, 64), jnp.int32)
+
+    def loss_fn(p):
+        logits = forward(p, tokens, cfg)
+        return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+    @jax.jit
+    def step(p):
+        g = jax.grad(loss_fn)(p)
+        return jax.tree_util.tree_map(lambda a, b: a - 1e-4 * b, p, g)
+
+    params = jax.block_until_ready(step(params))  # compile once
+
+    rt = comm_runtime()
+    tracer = global_tracer()
+    # stamp the per-step site profile the trainer's first traced step
+    # would bind: one record per canonical collective site
+    with rt.step("bench.comm"):
+        for site in ("bucket.psum", "bucket.scatter", "zero1.gather",
+                     "tp.psum", "cp.ring"):
+            record_comm(site, 1 << 20, 4 << 20)
+
+    def run(enabled: bool) -> float:
+        rt.set_enabled(enabled)
+        p = params
+        t0 = time.perf_counter()
+        for i in range(n_steps):
+            with tracer.span("trainer.step"):
+                with rt.step("bench.comm"):
+                    p = step(p)
+        jax.block_until_ready(p)
+        return (time.perf_counter() - t0) / n_steps
+
+    offs, ons = [], []
+    for _ in range(repeats):
+        offs.append(run(False))
+        ons.append(run(True))
+    rt.set_enabled(True)
+    off_s, on_s = _median(offs), _median(ons)
+    overhead = (on_s - off_s) / off_s if off_s > 0 else 0.0
+    rep = rt.report()
+    return {
+        "n_steps": n_steps,
+        "repeats": repeats,
+        "off_step_ms": round(off_s * 1e3, 3),
+        "on_step_ms": round(on_s * 1e3, 3),
+        "overhead_frac": round(overhead, 4),
+        "overhead_bound": OVERHEAD_BOUND,
+        "within_bound": overhead < OVERHEAD_BOUND,
+        "sites_observed": len(rep["sites"]),
+        "payload_bytes_total": sum(
+            s["payload_bytes"] for s in rep["sites"].values()),
+    }
+
+
 def bench_dfs(mb: int = 8, repeats: int = 3) -> dict:
     import os
     import shutil
@@ -177,9 +252,11 @@ def bench_dfs(mb: int = 8, repeats: int = 3) -> dict:
 
 def run(quick: bool = False) -> dict:
     result = {"step": bench_step(n_steps=10 if quick else 30),
+              "comm": bench_comm(n_steps=10 if quick else 30),
               "dfs": bench_dfs(mb=2 if quick else 8)}
     result["overhead_bound"] = OVERHEAD_BOUND
-    result["within_bound"] = result["step"]["within_bound"]
+    result["within_bound"] = (result["step"]["within_bound"]
+                              and result["comm"]["within_bound"])
     return result
 
 
@@ -189,9 +266,11 @@ def main(argv=None) -> int:
     ap.add_argument("--mb", type=int, default=8)
     args = ap.parse_args(argv)
     result = {"step": bench_step(n_steps=args.steps),
+              "comm": bench_comm(n_steps=args.steps),
               "dfs": bench_dfs(mb=args.mb),
               "overhead_bound": OVERHEAD_BOUND}
-    result["within_bound"] = result["step"]["within_bound"]
+    result["within_bound"] = (result["step"]["within_bound"]
+                              and result["comm"]["within_bound"])
     print(json.dumps(result, indent=2))
     return 0 if result["within_bound"] else 1
 

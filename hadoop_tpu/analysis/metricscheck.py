@@ -113,8 +113,14 @@ class PromFamilyChecker(Checker):
                     prom_name = _const_str(kw.value)
                 elif kw.arg == "prom_labels":
                     labels_node = kw.value
-            base = prom_name if (node.func.attr == "histogram" and
-                                 prom_name is not None) else raw_name
+            # counters/gauges/histograms/callback gauges all honor the
+            # prom_name exposition override (metrics/prom.py): the
+            # FAMILY a scraper sees is prom_name, so that is what the
+            # duplicate-family ledger must key on
+            base = prom_name if (node.func.attr in (
+                "histogram", "counter", "gauge",
+                "register_callback_gauge") and prom_name is not None) \
+                else raw_name
             if base is not None:
                 for kind, form in factory:
                     self._note_family(mod, node, kind,

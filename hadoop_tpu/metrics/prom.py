@@ -104,19 +104,22 @@ def render_prom(system: MetricsSystem, exemplars: bool = True) -> str:
         labels = {"source": source}
         for m in reg.metrics():
             name = PREFIX + _san(m.name)
+            # shared-family exposition override (histogram precedent):
+            # counters/gauges may publish under one family name with
+            # static labels while the registry/snapshot name stays
+            # unique for /jmx
+            mlabels = labels
+            if getattr(m, "prom_name", None):
+                name = PREFIX + _san(m.prom_name)
+            if getattr(m, "prom_labels", None):
+                mlabels = dict(labels, **m.prom_labels)
             if isinstance(m, MutableCounter):
-                add(f"{name}_total", "counter", m.description, labels,
+                add(f"{name}_total", "counter", m.description, mlabels,
                     m.value())
             elif isinstance(m, MutableGauge):
-                add(name, "gauge", m.description, labels, m.value())
+                add(name, "gauge", m.description, mlabels, m.value())
             elif isinstance(m, MutableHistogram):
-                # histograms may publish under a shared family name
-                # with static labels (kv_fetch_seconds{tier=...}) while
-                # their registry/snapshot name stays unique for /jmx
-                if m.prom_name:
-                    name = PREFIX + _san(m.prom_name)
-                hlabels = dict(labels, **m.prom_labels) \
-                    if m.prom_labels else labels
+                hlabels = mlabels
                 lines = fam(name, "histogram", m.description)
                 if lines is None:
                     continue
@@ -154,7 +157,7 @@ def render_prom(system: MetricsSystem, exemplars: bool = True) -> str:
             elif isinstance(m, _CallbackGauge):
                 v = m.snapshot().get(m.name)
                 if isinstance(v, (int, float)) and not isinstance(v, bool):
-                    add(name, "gauge", "", labels, v)
+                    add(name, "gauge", "", mlabels, v)
             # unknown metric kinds are skipped — /jmx still shows them
     out: List[str] = []
     for name in sorted(fams):

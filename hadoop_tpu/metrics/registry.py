@@ -25,11 +25,19 @@ log = logging.getLogger(__name__)
 
 
 class MutableCounter:
-    """Monotonic counter. Ref: metrics2/lib/MutableCounterLong.java."""
+    """Monotonic counter. Ref: metrics2/lib/MutableCounterLong.java.
 
-    def __init__(self, name: str, description: str = ""):
+    ``prom_name``/``prom_labels`` mirror MutableHistogram's exposition
+    override: several counters can publish under ONE Prometheus family
+    distinguished by static labels (``htpu_comm_payload_bytes_total
+    {site=...}``) while keeping unique snapshot keys for ``/jmx``."""
+
+    def __init__(self, name: str, description: str = "",
+                 prom_name: str = None, prom_labels: dict = None):
         self.name = name
         self.description = description
+        self.prom_name = prom_name
+        self.prom_labels = dict(prom_labels) if prom_labels else {}
         self._value = 0
         self._lock = threading.Lock()
 
@@ -45,11 +53,16 @@ class MutableCounter:
 
 
 class MutableGauge:
-    """Settable gauge. Ref: metrics2/lib/MutableGaugeLong.java."""
+    """Settable gauge. Ref: metrics2/lib/MutableGaugeLong.java.
+    ``prom_name``/``prom_labels``: shared-family exposition override
+    (see MutableCounter)."""
 
-    def __init__(self, name: str, description: str = "", initial=0):
+    def __init__(self, name: str, description: str = "", initial=0,
+                 prom_name: str = None, prom_labels: dict = None):
         self.name = name
         self.description = description
+        self.prom_name = prom_name
+        self.prom_labels = dict(prom_labels) if prom_labels else {}
         self._value = initial
         self._lock = threading.Lock()
 
@@ -257,11 +270,19 @@ class MetricsRegistry:
         self._metrics: Dict[str, Any] = {}
         self._lock = threading.Lock()
 
-    def counter(self, name: str, description: str = "") -> MutableCounter:
-        return self._get_or_make(name, lambda: MutableCounter(name, description))
+    def counter(self, name: str, description: str = "",
+                prom_name: str = None,
+                prom_labels: dict = None) -> MutableCounter:
+        return self._get_or_make(name, lambda: MutableCounter(
+            name, description, prom_name=prom_name,
+            prom_labels=prom_labels))
 
-    def gauge(self, name: str, description: str = "", initial=0) -> MutableGauge:
-        return self._get_or_make(name, lambda: MutableGauge(name, description, initial))
+    def gauge(self, name: str, description: str = "", initial=0,
+              prom_name: str = None,
+              prom_labels: dict = None) -> MutableGauge:
+        return self._get_or_make(name, lambda: MutableGauge(
+            name, description, initial, prom_name=prom_name,
+            prom_labels=prom_labels))
 
     def rate(self, name: str, description: str = "") -> MutableRate:
         return self._get_or_make(name, lambda: MutableRate(name, description))
@@ -282,9 +303,19 @@ class MetricsRegistry:
         with self._lock:
             return list(self._metrics.values())
 
-    def register_callback_gauge(self, name: str, fn: Callable[[], Any]) -> None:
+    def register_callback_gauge(self, name: str, fn: Callable[[], Any],
+                                prom_name: str = None,
+                                prom_labels: dict = None) -> None:
         with self._lock:
-            self._metrics[name] = _CallbackGauge(name, fn)
+            self._metrics[name] = _CallbackGauge(
+                name, fn, prom_name=prom_name, prom_labels=prom_labels)
+
+    def remove(self, name: str) -> None:
+        """Drop one metric so a re-registration can change its
+        exposition (a re-ranked trainer's label) — get_or_make alone
+        would silently return the stale object."""
+        with self._lock:
+            self._metrics.pop(name, None)
 
     def _get_or_make(self, name: str, factory: Callable):
         with self._lock:
@@ -304,8 +335,11 @@ class MetricsRegistry:
 
 
 class _CallbackGauge:
-    def __init__(self, name: str, fn: Callable[[], Any]):
+    def __init__(self, name: str, fn: Callable[[], Any],
+                 prom_name: str = None, prom_labels: dict = None):
         self.name = name
+        self.prom_name = prom_name
+        self.prom_labels = dict(prom_labels) if prom_labels else {}
         self._fn = fn
 
     def snapshot(self) -> Dict[str, Any]:

@@ -13,18 +13,29 @@ package adds the layer that sees the *fleet*:
 - ``doctor``    — the aggregation daemon: ``/ws/v1/fleet/doctor``,
                   ``/ws/v1/fleet/traces/<id>``, NN slow-node push,
                   autoscaler sick-replica signal; ``hadoop-tpu doctor``
+- ``trainer``   — per-rank trainer telemetry chassis (``/ws/v1/trainer``
+                  + the rank-labeled step-anatomy metric set)
+- ``comm``      — the RUNTIME comm ledger: per-site byte counters +
+                  dispatch-window latency histograms (``htpu_comm``)
+- ``hbm``       — the live HBM ledger (``htpu_hbm_bytes{component=}``)
 """
 
 from hadoop_tpu.obs.assemble import (Endpoint, FleetTraceStore,
                                      assemble_tree)
+from hadoop_tpu.obs.comm import CommRuntime, comm_runtime, record_comm
 from hadoop_tpu.obs.detect import (SlowNodeDetector, mad_outliers,
                                    median)
 from hadoop_tpu.obs.doctor import FleetDoctor, doctor_main
+from hadoop_tpu.obs.hbm import HbmLedger, hbm_ledger
 from hadoop_tpu.obs.peers import PeerLatencyTracker
 from hadoop_tpu.obs.top import (register_top_source, top_n,
                                 unregister_top_source)
+from hadoop_tpu.obs.trainer import TrainerStepMetrics, TrainerTelemetry
 
 __all__ = ["Endpoint", "FleetTraceStore", "assemble_tree",
            "SlowNodeDetector", "mad_outliers", "median",
            "FleetDoctor", "doctor_main", "PeerLatencyTracker",
-           "register_top_source", "top_n", "unregister_top_source"]
+           "register_top_source", "top_n", "unregister_top_source",
+           "CommRuntime", "comm_runtime", "record_comm",
+           "HbmLedger", "hbm_ledger",
+           "TrainerStepMetrics", "TrainerTelemetry"]

@@ -1,0 +1,266 @@
+"""Runtime comm ledger — the trace-time byte contracts grown a time axis.
+
+The ISSUE-10 comm ledger (``parallel/lowp/quant.capture_comm``) proves
+*byte* contracts at trace time and then forgets: nothing at runtime says
+how many bytes a training run actually moved, or how slow the steps
+carrying a given collective were on THIS rank. Both are exactly the
+sensing partially-synchronized activations (arXiv:2506.19645) needs —
+"dropping that sync bought step time" is a claim about runtime
+latency, per collective site, per rank.
+
+This module closes that gap without touching the compiled graph:
+
+- **Trace-time site profile.** Every collective entry point (quantized
+  *and* bitwise: bucketed psum/psum_scatter, the ZeRO-1 gather, the
+  chunked tp reduce, CP ring hops and ulysses all-to-alls) calls
+  :func:`record_comm` while jit traces it. Payload/reference bytes are
+  static facts of the traced program, so recording costs zero compiled
+  code — the Flash-Communication accounting (arXiv:2412.04964) the
+  trace-time ledger already uses, now kept per bounded ``site`` label.
+- **Dispatch-seam runtime accounting.** The step driver (the Trainer
+  loop, a CP prefill, a bench harness) wraps each execution in
+  :meth:`CommRuntime.step`. On exit the ledger advances every profiled
+  site's cumulative byte counters by the traced per-step bytes and
+  records the host-timed wall of that dispatch window into the site's
+  log-bucketed histogram — ``htpu_comm_seconds{site=...}`` /
+  ``htpu_comm_payload_bytes_total{site=...}`` /
+  ``htpu_comm_reference_bytes_total{site=...}``, one ``htpu_comm``
+  family each, label values drawn from the bounded literal set below
+  (the tpulint ``metrics/unbounded-label`` contract).
+
+Semantics the reader must know: sites fused into ONE compiled step
+share that step's dispatch-window wall — per-collective attribution
+inside a fused XLA program is the profiler's job; this ledger's job is
+the per-rank tail ("steps carrying site X on rank 7 are 4x slower
+than the fleet") and the A-B proof ("the schedule without site X is
+measurably faster"). An observation made under an active sampled span
+(the trainer's per-step ``trainer.step`` root) captures that trace id
+as the bucket's exemplar, so a slow bucket on ``/prom`` resolves
+through the fleet doctor into the exact step's assembled trace.
+
+Conf: ``obs.comm.timing`` (default **on**) gates the runtime
+bookkeeping; the trace-time recording is a few Python appends per
+*trace*, not per step, and stays on. Overhead of the on-path is pinned
+by ``benchmarks/trace_overhead.py``'s comm-timing arm (<5% bound).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+# The bounded site label set. Every record under an unknown site maps
+# to "other" so a new call site can never mint an unbounded Prometheus
+# series. Keep in sync with the literal tuples in _build_metrics below
+# (the tpulint unbounded-label checker requires the literals inline).
+COMM_SITES = ("bucket.psum", "bucket.scatter", "zero1.gather",
+              "tp.psum", "tp.scatter", "cp.ring", "cp.all2all", "other")
+
+
+def static_nbytes(x) -> int:
+    """Byte count of an array/tracer from its STATIC shape/dtype —
+    safe to call on tracers at trace time."""
+    n = 1
+    for d in x.shape:
+        n *= int(d)
+    return n * x.dtype.itemsize
+
+
+class _StepHandle:
+    """Returned by :meth:`CommRuntime.step`; callers that measure the
+    dispatch window themselves (the trainer's dispatch-to-dispatch
+    step_wall) override the wall via :meth:`observe`."""
+
+    __slots__ = ("wall",)
+
+    def __init__(self):
+        self.wall: Optional[float] = None
+
+    def observe(self, seconds: float) -> None:
+        self.wall = float(seconds)
+
+
+class CommRuntime:
+    """Process-global runtime comm ledger (one per rank process)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._enabled = True
+        # step key -> {site: (payload_bytes, reference_bytes)} per step,
+        # captured from trace-time records during that key's dispatch
+        self._profiles: Dict[str, Dict[str, Tuple[int, int]]] = {}
+        self._steps: Dict[str, int] = {}     # guarded-by: _lock
+        # cumulative per-site totals (report() survives a metrics reset)
+        self._totals: Dict[str, List[int]] = {}  # guarded-by: _lock
+        self._tls = threading.local()
+        self._reg = None
+        self._hists: Dict = {}
+        self._payload: Dict = {}
+        self._reference: Dict = {}
+
+    # ------------------------------------------------------------- config
+
+    def configure(self, conf) -> None:
+        if conf is not None:
+            self._enabled = conf.get_bool("obs.comm.timing", True)
+
+    def set_enabled(self, on: bool) -> None:
+        self._enabled = bool(on)
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    # -------------------------------------------------- trace-time record
+
+    def record(self, site: str, payload: int, reference: int) -> None:
+        """Called by the collective entry points while jit traces them.
+        Binds to the innermost active :meth:`step` capture on this
+        thread; records outside any capture (a bare test trace) are
+        dropped — they never correspond to a runtime step."""
+        stack = getattr(self._tls, "stack", None)
+        if stack:
+            stack[-1].append((site, int(payload), int(reference)))
+
+    # ------------------------------------------------------ dispatch seam
+
+    @contextmanager
+    def step(self, key: str):
+        """The dispatch seam: wrap ONE execution of a comm-bearing
+        step. The first execution of a freshly built step traces inside
+        this window, so its site records bind to ``key``; every
+        execution advances the profiled sites' byte counters and
+        records the window's host wall into their histograms."""
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        records: List[Tuple[str, int, int]] = []
+        stack.append(records)
+        handle = _StepHandle()
+        t0 = time.monotonic()
+        try:
+            yield handle
+        except BaseException:
+            # a step that RAISED moved neither its bytes nor completed
+            # its window: recording it would overstate the counters and
+            # pollute the latency tail with aborted-step samples
+            stack.pop()
+            raise
+        else:
+            stack.pop()
+            wall = handle.wall if handle.wall is not None \
+                else time.monotonic() - t0
+            if records:
+                # a (re)trace happened inside this window: it REDEFINES
+                # the per-step profile for this key
+                prof: Dict[str, Tuple[int, int]] = {}
+                for site, p, r in records:
+                    if site not in COMM_SITES:
+                        site = "other"
+                    pp, rr = prof.get(site, (0, 0))
+                    prof[site] = (pp + p, rr + r)
+                with self._lock:
+                    self._profiles[key] = prof
+            if self._enabled:
+                self._observe(key, wall)
+
+    def _observe(self, key: str, wall: float) -> None:
+        with self._lock:
+            prof = self._profiles.get(key)
+            if not prof:
+                return
+            self._steps[key] = self._steps.get(key, 0) + 1
+            for site, (p, r) in prof.items():
+                tot = self._totals.setdefault(site, [0, 0, 0])
+                tot[0] += p
+                tot[1] += r
+                tot[2] += 1
+        hists, payload, reference = self._metrics()
+        for site, (p, r) in prof.items():
+            payload[site].incr(p)
+            reference[site].incr(r)
+            # under an active sampled span (trainer.step) the add
+            # captures the trace id as this bucket's exemplar
+            hists[site].add(wall)
+
+    # ------------------------------------------------------------ metrics
+
+    def _metrics(self):
+        """(Re)build the htpu_comm metric families lazily; revalidated
+        against the live metrics system so a test-harness reset never
+        leaves us holding unregistered objects."""
+        from hadoop_tpu.metrics import metrics_system
+        reg = metrics_system().source("comm")
+        if reg is self._reg:
+            return self._hists, self._payload, self._reference
+        hists: Dict = {}
+        payload: Dict = {}
+        reference: Dict = {}
+        # label values drawn from this literal tuple — the bounded-set
+        # contract the tpulint metrics/unbounded-label checker enforces
+        for s in ("bucket.psum", "bucket.scatter", "zero1.gather",
+                  "tp.psum", "tp.scatter", "cp.ring", "cp.all2all",
+                  "other"):
+            k = s.replace(".", "_")
+            hists[s] = reg.histogram(
+                "comm_seconds_" + k,
+                "host wall of the dispatch window carrying this "
+                "collective site",
+                prom_name="comm_seconds", prom_labels={"site": s})
+            payload[s] = reg.counter(
+                "comm_payload_bytes_" + k,
+                "cumulative wire payload bytes this site moved",
+                prom_name="comm_payload_bytes", prom_labels={"site": s})
+            reference[s] = reg.counter(
+                "comm_reference_bytes_" + k,
+                "bytes the unquantized form of this site would move",
+                prom_name="comm_reference_bytes",
+                prom_labels={"site": s})
+        self._reg, self._hists = reg, hists
+        self._payload, self._reference = payload, reference
+        return hists, payload, reference
+
+    # ------------------------------------------------------------- report
+
+    def report(self) -> Dict:
+        """JSON shape served at ``/ws/v1/trainer`` and read by tests:
+        cumulative per-site bytes + observation counts + per-key step
+        counts."""
+        with self._lock:
+            sites = {s: {"payload_bytes": t[0], "reference_bytes": t[1],
+                         "observations": t[2]}
+                     for s, t in self._totals.items()}
+            steps = dict(self._steps)
+        return {"enabled": self._enabled, "sites": sites, "steps": steps}
+
+    def profile(self, key: str) -> Dict[str, Tuple[int, int]]:
+        """The captured per-step byte profile for one step key."""
+        with self._lock:
+            return dict(self._profiles.get(key, {}))
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._profiles.clear()
+            self._steps.clear()
+            self._totals.clear()
+        self._enabled = True
+        self._reg = None
+        self._hists = {}
+        self._payload = {}
+        self._reference = {}
+
+
+_RUNTIME = CommRuntime()
+
+
+def comm_runtime() -> CommRuntime:
+    return _RUNTIME
+
+
+def record_comm(site: str, payload: int, reference: int) -> None:
+    """Module-level trace-time hook the collective entry points call
+    (quant.py forwards its quantized-site records here too, so one
+    profile covers both tiers)."""
+    _RUNTIME.record(site, payload, reference)

@@ -53,12 +53,18 @@ INTERVAL_KEY = "obs.doctor.interval"
 ENDPOINTS_KEY = "obs.doctor.endpoints"
 REGISTRY_KEY = "obs.doctor.registry"
 SERVICE_KEY = "obs.doctor.service"
+TRAINER_SERVICE_KEY = "obs.doctor.trainer.service"
 NN_HTTP_KEY = "obs.doctor.namenode.http"
 PUSH_NN_KEY = "obs.doctor.push.namenode"
 SLOW_TTL_KEY = "obs.doctor.slow.ttl"
 
 STEP_FAMILY = "htpu_decode_step_seconds"
 TTFT_FAMILY = "htpu_time_to_first_token_seconds"
+
+# trainer roster rows retained after a rank dies (ok=False history —
+# a dead rank must not vanish from the fleet view mid-diagnosis), hard
+# bound so an elastic job minting ranks can't grow the report forever
+MAX_TRAINER_ROWS = 128
 
 
 class FleetDoctor(AbstractService):
@@ -77,6 +83,17 @@ class FleetDoctor(AbstractService):
         # replica /prom window state: endpoint key ->
         # {family: (sum, count)} cumulative at the previous poll
         self._prom_prev: Dict[str, Dict[str, Tuple[float, float]]] = {}
+        # trainer /ws/v1/trainer window state: endpoint key ->
+        # (step_wall_sum, step_wall_count) cumulative at previous poll
+        self._trainer_prev: Dict[str, Tuple[float, float]] = {}
+        # rank roster: endpoint key -> row (ok flips False when a rank
+        # stops answering — contributed history stays visible)
+        self._trainer_status: Dict[str, Dict] = {}   # guarded-by: _lock
+        # static daemon endpoints proven non-trainers (live daemon, no
+        # /ws/v1/trainer servlet): never probed again until they depart
+        # discovery — probing them every poll would cost a scrape each
+        self._not_trainer: set = set()
+        self._trainer_polls = 0
 
     # ----------------------------------------------------------- lifecycle
 
@@ -102,6 +119,10 @@ class FleetDoctor(AbstractService):
             "dn.read_service": SlowNodeDetector(**det),
             "replica.decode_step": SlowNodeDetector(**det),
             "replica.ttft": SlowNodeDetector(**det),
+            # training flight recorder: per-rank step-wall means from
+            # /ws/v1/trainer, same median/MAD + hysteresis machinery —
+            # the sensory input doctor-driven elastic training needs
+            "trainer.step_wall": SlowNodeDetector(**det),
         }
         self._static = [Endpoint(n, h, p, "daemon") for n, h, p in
                         parse_endpoint_list(conf.get(ENDPOINTS_KEY, ""))]
@@ -118,6 +139,9 @@ class FleetDoctor(AbstractService):
             host, _, port = reg.rpartition(":")
             self._registry_addr = (host or "127.0.0.1", int(port))
         self._service_prefix = conf.get(SERVICE_KEY, "")
+        from hadoop_tpu.obs.trainer import DEFAULT_SERVICE
+        self._trainer_prefix = conf.get(TRAINER_SERVICE_KEY,
+                                        DEFAULT_SERVICE)
         self.push_nn = conf.get_bool(PUSH_NN_KEY, True)
         from hadoop_tpu.http import HttpServer
         self.http = HttpServer(
@@ -171,28 +195,33 @@ class FleetDoctor(AbstractService):
             from hadoop_tpu.registry.registry import (record_is_stale,
                                                       record_ttl)
             ttl = record_ttl(self.config)
-            try:
-                for rec in self._registry().list(self._service_prefix
-                                                 or "/services"):
-                    if record_is_stale(rec, ttl):
-                        # corpse replica (died without deregistering,
-                        # awaiting the registry sweep): scraping it
-                        # costs bounded timeouts EVERY poll and can
-                        # push a poll past its interval — the router/
-                        # autoscaler precedent skips it
-                        continue
-                    try:
-                        host, _, port = \
-                            rec.endpoints["http"].rpartition(":")
-                    except (KeyError, AttributeError):
-                        continue
-                    ep = Endpoint(rec.path, host or "127.0.0.1",
-                                  int(port), "replica")
-                    eps[ep.key] = ep
-            except Exception as e:  # noqa: BLE001 — registry outage: the
-                # doctor keeps serving what it can still see; the next
-                # jittered poll retries discovery
-                log.debug("registry discovery failed: %s", e)
+            # replicas + the trainer-job roster (obs/trainer.py ranks
+            # publish heartbeat-stamped records): corpse records —
+            # a publisher that died without deregistering, awaiting
+            # the registry sweep — are SKIPPED by the record_is_stale
+            # precedent (scraping one costs bounded timeouts EVERY
+            # poll and can push a poll past its interval); a skipped
+            # rank's contributed history stays in the fleet view with
+            # ok=False via _observe_trainers
+            for prefix, kind in (
+                    (self._service_prefix or "/services", "replica"),
+                    (self._trainer_prefix, "trainer")):
+                try:
+                    for rec in self._registry().list(prefix):
+                        if record_is_stale(rec, ttl):
+                            continue
+                        try:
+                            host, _, port = \
+                                rec.endpoints["http"].rpartition(":")
+                        except (KeyError, AttributeError):
+                            continue
+                        ep = Endpoint(rec.path, host or "127.0.0.1",
+                                      int(port), kind)
+                        eps[ep.key] = ep
+                except Exception as e:  # noqa: BLE001 — registry
+                    # outage: the doctor keeps serving what it can
+                    # still see; the next jittered poll retries
+                    log.debug("registry discovery failed: %s", e)
         return list(eps.values())
 
     def _registry(self):
@@ -224,8 +253,15 @@ class FleetDoctor(AbstractService):
         self.store.scrape(endpoints)
         dn_eps = [e for e in endpoints if e.kind == "datanode"]
         rep_eps = [e for e in endpoints if e.kind == "replica"]
+        # trainer candidates: roster records (kind trainer) plus static
+        # obs.doctor.endpoints entries (kind daemon) — a static entry
+        # that is not a trainer 404s a probe, is remembered as a
+        # non-trainer, and never makes a roster row
+        tr_eps = [e for e in endpoints
+                  if e.kind in ("trainer", "daemon")]
         self._observe_datanodes(dn_eps)
         self._observe_replicas(rep_eps)
+        self._observe_trainers(tr_eps)
         report = self._compile(endpoints)
         with self._lock:
             self._report = report
@@ -306,6 +342,86 @@ class FleetDoctor(AbstractService):
         if ttft_means:
             self.detectors["replica.ttft"].observe(ttft_means)
 
+    def _observe_trainers(self, tr_eps: List[Endpoint]) -> None:
+        """Per-rank step-wall means from ``/ws/v1/trainer``, windowed
+        by diffing the cumulative sum/count between polls (counter
+        reset => rank restarted => whole history is this window — the
+        FleetScraper discipline). A rank that stops answering keeps its
+        roster row with ``ok=False`` (its detector history ages out
+        through the hysteresis window); an endpoint discovery no longer
+        lists at all has its inter-poll window state pruned."""
+        means: Dict[str, float] = {}
+        candidate_keys = set()
+        scraped_ok = set()
+        now = time.time()
+        self._trainer_polls += 1
+        with self._lock:
+            known_keys = set(self._trainer_status)
+        for ep in tr_eps:
+            candidate_keys.add(ep.key)
+            if ep.key in self._not_trainer:
+                continue     # proven non-trainer daemon: no probe
+            if ep.kind == "daemon" and ep.key not in known_keys and \
+                    self._trainer_polls % 4 != 1:
+                # unknown static daemon that has never answered: probe
+                # on a 1-in-4 cadence so a DEAD non-trainer entry
+                # can't burn a scrape timeout every poll (the same
+                # per-poll-cost discipline as the corpse skip); a
+                # late-starting static trainer is found within 4 polls
+                continue
+            try:
+                rep = json.loads(http_get(ep.host, ep.port,
+                                          "/ws/v1/trainer",
+                                          self.timeout))
+            except IOError as e:
+                if "HTTP 404" in str(e):
+                    # a LIVE daemon without the servlet is a permanent
+                    # non-trainer (until discovery drops it)
+                    self._not_trainer.add(ep.key)
+                continue        # dead rank, or unreachable
+            except ValueError:
+                continue
+            sw = rep.get("step_wall") or {}
+            total = float(sw.get("sum", 0.0) or 0.0)
+            count = float(sw.get("count", 0) or 0)
+            p_sum, p_count = self._trainer_prev.get(ep.key, (0.0, 0.0))
+            if count < p_count:
+                p_sum, p_count = 0.0, 0.0
+            d_count = count - p_count
+            if d_count > 0 and math.isfinite(total):
+                means[ep.name] = (total - p_sum) / d_count
+            self._trainer_prev[ep.key] = (total, count)
+            scraped_ok.add(ep.key)
+            row = {"endpoint": ep.to_dict(), "ok": True,
+                   "rank": rep.get("rank"), "job": rep.get("job"),
+                   "steps": rep.get("steps"),
+                   "step_wall": sw, "last_seen": now}
+            with self._lock:
+                self._trainer_status[ep.key] = row
+        # prune window state only for endpoints discovery dropped (the
+        # _prom_prev precedent); a still-listed-but-dead rank keeps its
+        # cumulative baseline for the restart-reset check above
+        for key in [k for k in self._trainer_prev
+                    if k not in candidate_keys]:
+            del self._trainer_prev[key]
+        self._not_trainer &= candidate_keys
+        with self._lock:
+            for key, row in self._trainer_status.items():
+                if key not in scraped_ok and row.get("ok"):
+                    row = dict(row)
+                    row["ok"] = False
+                    self._trainer_status[key] = row
+            # bounded roster: oldest dead rows age out first
+            while len(self._trainer_status) > MAX_TRAINER_ROWS:
+                victim = min(
+                    self._trainer_status,
+                    key=lambda k: (self._trainer_status[k].get("ok"),
+                                   self._trainer_status[k].get(
+                                       "last_seen", 0.0)))
+                del self._trainer_status[victim]
+        if means:
+            self.detectors["trainer.step_wall"].observe(means)
+
     # -------------------------------------------------------------- report
 
     def _compile(self, endpoints: List[Endpoint]) -> Dict:
@@ -327,12 +443,17 @@ class FleetDoctor(AbstractService):
                                            f"/ws/v1/stacks")
             return {"flagged": flagged}
 
+        trainers = section(("trainer.step_wall",))
+        with self._lock:
+            trainers["ranks"] = {k: dict(v) for k, v in
+                                 self._trainer_status.items()}
         return {
             "generated_at": time.time(),
             "interval_s": self.interval,
             "endpoints": self.store.status(),
             "datanodes": section(("dn.pipeline_ack", "dn.read_service")),
             "replicas": section(("replica.decode_step", "replica.ttft")),
+            "trainers": trainers,
             "traces_held": len(self.store.trace_ids()),
         }
 

@@ -1,0 +1,240 @@
+"""Per-rank trainer telemetry — the training flight recorder's chassis.
+
+A training job was the last anonymous workload on the doctor plane:
+DataNodes publish ``/ws/v1/peers``, replicas publish ``/prom`` + health,
+but a trainer rank had metrics with nowhere to serve them from. This
+module gives every rank (the single-process ``Trainer`` and each
+multichip-dryrun subprocess worker) a **lightweight chassis**:
+
+- :class:`TrainerStepMetrics` — THE step-anatomy metric set (steps,
+  data_wait, step_wall, ckpt snapshot/write/fence), rank-labeled on
+  ``/prom`` (``htpu_trainer_step_wall_seconds{rank=...}``) with the
+  rank label drawn from a bounded literal set so the tpulint
+  ``metrics/unbounded-label`` checker stays green. One definition,
+  shared by ``parallel/trainer.py`` and the bench workers — two copies
+  would fork the family names the doctor diffs.
+- :class:`TrainerTelemetry` — the rank's admin door: the standard
+  chassis servlets (``/prom``, ``/jmx``, ``/ws/v1/traces``,
+  ``/ws/v1/stacks``) via ``hadoop_tpu.http`` (a worker never drags
+  serving imports in) plus ``/ws/v1/trainer`` serving the step anatomy
+  as JSON — cumulative sums the doctor windows by diffing, exactly the
+  FleetScraper discipline — alongside the runtime comm ledger and the
+  live HBM ledger. Optionally registers in the service registry under
+  ``obs.trainer.service`` (default ``/trainer-jobs``) with a heartbeat
+  stamp, so doctor discovery finds ranks the way it finds replicas and
+  skips corpses by the same ``record_is_stale`` precedent.
+
+Conf keys: ``obs.trainer.port`` (default 0 = ephemeral),
+``obs.trainer.service``, ``obs.trainer.registry`` (host:port), and
+``obs.comm.timing`` (configured onto the process comm ledger here).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.metrics import metrics_system
+from hadoop_tpu.obs.comm import comm_runtime
+from hadoop_tpu.obs.hbm import hbm_ledger
+
+log = logging.getLogger(__name__)
+
+PORT_KEY = "obs.trainer.port"
+SERVICE_KEY = "obs.trainer.service"
+REGISTRY_KEY = "obs.trainer.registry"
+DEFAULT_SERVICE = "/trainer-jobs"
+
+# the bounded rank label set: ranks 0..15 get their own series, the
+# tail shares "other" (the doctor tells ranks apart by ENDPOINT — the
+# label exists for fleet-level Prometheus aggregation, where 17 series
+# per family is a budget, not a bomb)
+MAX_RANK_LABEL = 16
+
+
+def rank_label(rank: int) -> str:
+    return str(rank) if 0 <= rank < MAX_RANK_LABEL else "other"
+
+
+class TrainerStepMetrics:
+    """The step-anatomy metric set, rank-labeled for ``/prom``.
+
+    Snapshot keys (``/jmx`` and the ``/ws/v1/trainer`` JSON) stay the
+    historical un-labeled names; the /prom families are
+    ``htpu_trainer_step_wall_seconds`` / ``htpu_trainer_data_wait_seconds``
+    with a ``rank`` label."""
+
+    SOURCE = "trainer"
+
+    def __init__(self, rank: int = 0):
+        self.rank = int(rank)
+        reg = metrics_system().source(self.SOURCE)
+        self.registry = reg
+        self.steps = reg.counter("steps", "completed train steps")
+        self.data_wait = reg.rate(
+            "data_wait", "time blocked on the prefetch queue")
+        self.step_wall = reg.rate(
+            "step_wall", "dispatch-to-dispatch step wall time")
+        self.ckpt_snapshot = reg.rate(
+            "ckpt_snapshot", "blocking device->host snapshot of a save")
+        self.ckpt_write = reg.rate(
+            "ckpt_write", "background DFS write of a save")
+        self.ckpt_fence = reg.rate(
+            "ckpt_fence", "time a save/restore stalled on the writer")
+        want = rank_label(self.rank)
+        # a RE-RANKED process (elastic restart) must not keep publishing
+        # under the old rank's label: get_or_make returns the existing
+        # histogram whatever prom_labels we pass, so drop a stale-ranked
+        # one first and mint fresh
+        for m in reg.metrics():
+            if m.name in ("step_wall_seconds", "data_wait_seconds") \
+                    and getattr(m, "prom_labels", {}).get("rank") != want:
+                reg.remove(m.name)
+        self.step_wall_hist = None
+        self.data_wait_hist = None
+        # label values drawn from this literal tuple — the bounded-set
+        # contract the tpulint metrics/unbounded-label checker enforces
+        for r in ("0", "1", "2", "3", "4", "5", "6", "7", "8", "9",
+                  "10", "11", "12", "13", "14", "15", "other"):
+            if r != want:
+                continue
+            self.step_wall_hist = reg.histogram(
+                "step_wall_seconds",
+                "dispatch-to-dispatch step wall time",
+                prom_name="trainer_step_wall_seconds",
+                prom_labels={"rank": r})
+            self.data_wait_hist = reg.histogram(
+                "data_wait_seconds",
+                "time blocked on the prefetch queue",
+                prom_name="trainer_data_wait_seconds",
+                prom_labels={"rank": r})
+
+    def anatomy(self) -> Dict:
+        """Cumulative step anatomy, JSON-shaped for ``/ws/v1/trainer``.
+        Sums/counts are CUMULATIVE on purpose: the doctor windows them
+        by diffing between polls (counter reset = rank restarted =
+        whole history is this window — the FleetScraper discipline)."""
+        snap = self.registry.snapshot()
+
+        def hist(n):
+            return {"sum": float(snap.get(f"{n}_sum", 0.0) or 0.0),
+                    "count": int(snap.get(f"{n}_count", 0) or 0)}
+
+        def rate(n):
+            return {"num_ops": int(snap.get(f"{n}_num_ops", 0) or 0),
+                    "avg_time": float(snap.get(f"{n}_avg_time", 0.0)
+                                      or 0.0)}
+
+        return {"rank": self.rank,
+                "steps": int(snap.get("steps", 0) or 0),
+                "step_wall": hist("step_wall_seconds"),
+                "data_wait": hist("data_wait_seconds"),
+                "ckpt": {"snapshot": rate("ckpt_snapshot"),
+                         "write": rate("ckpt_write"),
+                         "fence": rate("ckpt_fence")}}
+
+
+class TrainerTelemetry:
+    """One rank's observability door + fleet registration."""
+
+    def __init__(self, conf: Optional[Configuration] = None, *,
+                 rank: int = 0, job: str = "train",
+                 metrics: Optional[TrainerStepMetrics] = None,
+                 advertise_host: str = "127.0.0.1"):
+        self.conf = conf or Configuration(load_defaults=False)
+        self.rank = int(rank)
+        self.job = job
+        comm_runtime().configure(self.conf)
+        self.metrics = metrics or TrainerStepMetrics(rank=self.rank)
+        from hadoop_tpu.http import HttpServer
+        self.http = HttpServer(
+            self.conf,
+            bind=("127.0.0.1", self.conf.get_int(PORT_KEY, 0)),
+            daemon_name=f"trainer-rank{self.rank}")
+        self.http.add_handler("/ws/v1/trainer", self._h_trainer)
+        self.http.start()
+        self._stopped = threading.Event()
+        self._reg = None
+        self._record = None
+        reg_addr = self.conf.get(REGISTRY_KEY, "")
+        if reg_addr:
+            self._register(reg_addr, advertise_host)
+        log.info("trainer rank %d telemetry on :%d", self.rank,
+                 self.http.port)
+
+    @property
+    def port(self) -> int:
+        return self.http.port
+
+    def record_path(self) -> str:
+        service = self.conf.get(SERVICE_KEY, DEFAULT_SERVICE)
+        return f"{service}/{self.job}/rank-{self.rank}"
+
+    # ---------------------------------------------------------- registry
+
+    def _register(self, reg_addr: str, advertise_host: str) -> None:
+        """Publish this rank in the trainer-job roster: the doctor's
+        discovery path for dynamic jobs (static ``obs.doctor.endpoints``
+        covers pinned fleets). Heartbeat-stamped exactly like a serving
+        replica's record, so the doctor skips a corpse by the same
+        ``record_is_stale`` precedent instead of paying scrape timeouts
+        on it every poll."""
+        from hadoop_tpu.registry.registry import (HEARTBEAT_ATTR,
+                                                  RegistryClient,
+                                                  ServiceRecord,
+                                                  record_ttl)
+        host, _, port = reg_addr.rpartition(":")
+        self._reg = RegistryClient((host or "127.0.0.1", int(port)),
+                                   self.conf)
+        self._record_ttl = record_ttl(self.conf)
+        self._record = ServiceRecord(
+            self.record_path(),
+            endpoints={"http": f"{advertise_host}:{self.http.port}"},
+            attributes={"kind": "trainer",
+                        "rank": str(self.rank),
+                        "job": self.job,
+                        HEARTBEAT_ATTR: f"{time.time():.3f}"})
+        self._reg.register(self._record, ttl_s=self._record_ttl,
+                           auto_renew=False)
+        from hadoop_tpu.util.misc import Daemon
+        Daemon(self._heartbeat_loop,
+               f"trainer-heartbeat-{self.rank}").start()
+
+    def _heartbeat_loop(self) -> None:
+        from hadoop_tpu.registry.registry import HEARTBEAT_ATTR
+        period = max(0.2, self._record_ttl / 3.0)
+        while not self._stopped.wait(period):
+            self._record.attributes.update({
+                HEARTBEAT_ATTR: f"{time.time():.3f}",
+                "steps": str(self.metrics.anatomy()["steps"])})
+            try:
+                self._reg.register(self._record,
+                                   ttl_s=self._record_ttl,
+                                   auto_renew=False)
+            except Exception as e:  # noqa: BLE001 — a dead registry
+                # must not kill the rank; the next beat retries
+                log.debug("trainer heartbeat failed: %s", e)
+
+    # ---------------------------------------------------------- servlets
+
+    def _h_trainer(self, query, body):
+        out = dict(self.metrics.anatomy())
+        out["job"] = self.job
+        out["comm"] = comm_runtime().report()
+        out["hbm"] = hbm_ledger().report()
+        return 200, out
+
+    def close(self) -> None:
+        self._stopped.set()
+        if self._reg is not None:
+            try:
+                self._reg.unregister(self._record.path)
+            except Exception as e:  # noqa: BLE001 — best-effort: the
+                # heartbeat staleness (and the registry sweep) evict the
+                # record if the registry is unreachable right now
+                log.debug("trainer unregister failed: %s", e)
+            self._reg.close()
+        self.http.stop()

@@ -75,9 +75,15 @@ def _reduce_one(t, ctx):
                 site="tp.scatter")
         return psum_quantized(t, (ctx.tp_axis,), rq, scale="tensor",
                               site="tp.psum")
+    # runtime comm ledger (obs/comm.py): bitwise wire = payload ==
+    # reference, recorded at trace time under the bounded site labels
+    # the quantized twins use — one htpu_comm family covers both tiers
+    from hadoop_tpu.obs.comm import record_comm, static_nbytes
     if ctx.megatron_sp:
+        record_comm("tp.scatter", static_nbytes(t), static_nbytes(t))
         return jax.lax.psum_scatter(t, ctx.tp_axis,
                                     scatter_dimension=1, tiled=True)
+    record_comm("tp.psum", static_nbytes(t), static_nbytes(t))
     return jax.lax.psum(t, ctx.tp_axis)
 
 

@@ -132,12 +132,22 @@ def capture_comm():
 
 
 def _nbytes(x) -> int:
-    return int(np.prod(x.shape)) * jnp.dtype(x.dtype).itemsize
+    # THE static byte-count helper lives with the runtime ledger — two
+    # copies of the byte-accounting primitive feeding one htpu_comm
+    # surface would drift
+    from hadoop_tpu.obs.comm import static_nbytes
+    return static_nbytes(x)
 
 
 def _record(site: str, payload: int, reference: int) -> None:
     for led in _ACTIVE_LEDGERS:
         led.add(site, payload, reference)
+    # the RUNTIME comm ledger (obs/comm.py) keeps the same trace-time
+    # byte facts per bounded site label, bound to the dispatch seam
+    # that traced them — that is how htpu_comm byte counters advance
+    # per executed step at runtime
+    from hadoop_tpu.obs.comm import record_comm
+    record_comm(site, payload, reference)
 
 
 # ------------------------------------------------------------- primitives

@@ -129,6 +129,10 @@ def _bucket_psum(buf, axes, relaxed, site):
             jnp.issubdtype(jnp.dtype(buf.dtype), jnp.floating):
         from hadoop_tpu.parallel.lowp.quant import psum_quantized
         return psum_quantized(buf, axes, relaxed, site=site)
+    # runtime comm ledger: the bitwise wire moves exactly the payload
+    # (payload == reference); bytes are static trace-time facts
+    from hadoop_tpu.obs.comm import record_comm, static_nbytes
+    record_comm(site, static_nbytes(buf), static_nbytes(buf))
     return jax.lax.psum(buf, axes)
 
 
@@ -289,6 +293,10 @@ def bucketed_psum_scatter(tree, reduce_axes_tree, scatter_axes_tree,
                     buf, sc_axis, relaxed, rest_axes=rest,
                     site="bucket.scatter")
             else:
+                from hadoop_tpu.obs.comm import (record_comm,
+                                                 static_nbytes)
+                record_comm("bucket.scatter", static_nbytes(buf),
+                            static_nbytes(buf))
                 if rest:
                     buf = jax.lax.psum(buf, rest)
                 sl = jax.lax.psum_scatter(
@@ -355,9 +363,13 @@ def bucketed_gather_slices(slices, params_like, leaf_axes,
                     row, z, idx, axes, relaxed,
                     site="zero1.gather")[:, :k_total]
             else:
+                from hadoop_tpu.obs.comm import (record_comm,
+                                                 static_nbytes)
                 buf = jnp.zeros((z, k_total), row.dtype)
                 buf = jax.lax.dynamic_update_slice(
                     buf, row[None, :], (idx, jnp.zeros((), jnp.int32)))
+                record_comm("zero1.gather", static_nbytes(buf),
+                            static_nbytes(buf))
                 buf = jax.lax.psum(buf, axes)
             off = 0
             for i, k in members:

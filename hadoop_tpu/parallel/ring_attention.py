@@ -40,6 +40,14 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     my = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
+    # runtime comm ledger (obs/comm.py): each ring hop rotates the raw
+    # K/V shards; the per-step total is hops x (K+V shard bytes) — a
+    # static trace-time fact recorded OUTSIDE the scan (the scan body
+    # traces once, but executes per hop). The flash path skips the
+    # step-0 diagonal, so it pays one hop fewer.
+    from hadoop_tpu.obs.comm import record_comm, static_nbytes
+    kv_bytes = static_nbytes(k) + static_nbytes(v)
+
     from hadoop_tpu.ops import flash
     use_flash = impl == "flash" or (
         impl == "auto" and jax.default_backend() not in ("cpu", "gpu")
@@ -49,6 +57,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     target = vma_of(q) | vma_of(k) | vma_of(v) | {axis_name}
 
     if use_flash:
+        record_comm("cp.ring", (axis_size - 1) * kv_bytes,
+                    (axis_size - 1) * kv_bytes)
         # step 0: the causal diagonal, fused
         out, lse = flash.flash_attention_partial(q, k, v, scale, True)
         out = pvary_to(out, target)
@@ -72,6 +82,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             step, (out, lse, k, v), jnp.arange(1, axis_size))
         return out.astype(q.dtype)
 
+    record_comm("cp.ring", axis_size * kv_bytes, axis_size * kv_bytes)
     n_rep = hq // k.shape[2]
     q_pos = my * sl + jnp.arange(sl)
     out0 = pvary_to(jnp.zeros((b, sl, hq, d), jnp.float32), target)

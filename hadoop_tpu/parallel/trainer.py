@@ -31,7 +31,7 @@ from hadoop_tpu.parallel.checkpoint import (AsyncCheckpointWriter,
 from hadoop_tpu.parallel.data import TokenDataset
 from hadoop_tpu.parallel.mesh import MeshPlan, make_mesh, param_specs
 from hadoop_tpu.parallel.lowp import ParityConfig
-from hadoop_tpu.parallel.overlap import OverlapConfig
+from hadoop_tpu.parallel.overlap import DEFAULT_OVERLAP, OverlapConfig
 from hadoop_tpu.parallel.train import (init_sharded, make_data_sharding,
                                        make_train_step, zero1_layout)
 from hadoop_tpu.parallel.optimizer import AdamWState
@@ -50,7 +50,7 @@ class Trainer:
                  pipeline_schedule: str = "1f1b",
                  overlap: Optional[OverlapConfig] = None,
                  parity: Optional[ParityConfig] = None,
-                 async_ckpt: bool = True):
+                 async_ckpt: bool = True, rank: int = 0):
         self.cfg, self.plan, self.fs = cfg, plan, fs
         self.ckpt_dir = ckpt_dir
         self.ckpt_interval = ckpt_interval
@@ -87,23 +87,50 @@ class Trainer:
         # accounting, always on): /jmx and /prom see exactly where a
         # step's wall time goes — data wait vs dispatched step vs the
         # checkpoint snapshot/fence the async writer still charges the
-        # loop for. Rates carry JMX parity; histograms feed /prom.
-        reg = metrics_system().source("trainer")
-        self._m_steps = reg.counter("steps", "completed train steps")
-        self._m_data_wait = reg.rate(
-            "data_wait", "time blocked on the prefetch queue")
-        self._m_data_wait_hist = reg.histogram(
-            "data_wait_seconds", "time blocked on the prefetch queue")
-        self._m_step_wall = reg.rate(
-            "step_wall", "dispatch-to-dispatch step wall time")
-        self._m_step_wall_hist = reg.histogram(
-            "step_wall_seconds", "dispatch-to-dispatch step wall time")
-        self._m_ckpt_snapshot = reg.rate(
-            "ckpt_snapshot", "blocking device->host snapshot of a save")
-        self._m_ckpt_write = reg.rate(
-            "ckpt_write", "background DFS write of a save")
-        self._m_ckpt_fence = reg.rate(
-            "ckpt_fence", "time a save/restore stalled on the writer")
+        # loop for. The metric set is THE shared definition in
+        # obs/trainer.py (rank-labeled /prom families the fleet doctor
+        # windows per rank); a dryrun subprocess worker builds the same
+        # set, so the families can never fork.
+        from hadoop_tpu.obs.trainer import TrainerStepMetrics
+        self.rank = int(rank)
+        m = TrainerStepMetrics(rank=self.rank)
+        self.step_metrics = m
+        self._m_steps = m.steps
+        self._m_data_wait = m.data_wait
+        self._m_data_wait_hist = m.data_wait_hist
+        self._m_step_wall = m.step_wall
+        self._m_step_wall_hist = m.step_wall_hist
+        self._m_ckpt_snapshot = m.ckpt_snapshot
+        self._m_ckpt_write = m.ckpt_write
+        self._m_ckpt_fence = m.ckpt_fence
+        # Live HBM ledger: this trainer's resident state, alongside the
+        # serving components (obs/hbm.py). grad_buckets is the overlap
+        # pass's transient packing buffer bound — the concat each
+        # bucketed collective materializes at peak.
+        from hadoop_tpu.obs.comm import comm_runtime
+        from hadoop_tpu.obs.hbm import hbm_ledger, tree_nbytes
+        self._comm = comm_runtime()
+        ov = overlap if overlap is not None else DEFAULT_OVERLAP
+        led = hbm_ledger()
+        self._hbm_owner = f"trainer@{id(self)}."
+        # providers hold a WEAK ref: a replaced trainer that was never
+        # close()d must not pin its whole params+opt state in the
+        # process-global ledger forever (a dead ref reports 0 bytes —
+        # truthfully: that state is collectable)
+        import weakref
+        ref = weakref.ref(self)
+
+        def _tree(attr):
+            t = ref()
+            return tree_nbytes(getattr(t, attr)) if t is not None else 0
+
+        led.register(f"{self._hbm_owner}params", "params",
+                     lambda: _tree("params"))
+        led.register(f"{self._hbm_owner}opt", "opt_state",
+                     lambda: _tree("opt"))
+        led.register(f"{self._hbm_owner}buckets", "grad_buckets",
+                     lambda: (ov.bucket_bytes if ov.enabled else 0)
+                     if ref() is not None else 0)
         self._tracer = global_tracer()
         # Cursor of the last batch a completed step CONSUMED — set only
         # while train() runs (the prefetch thread advances the dataset
@@ -216,6 +243,15 @@ class Trainer:
         """Block until any in-flight async checkpoint write completes
         (re-raising its failure, if it failed)."""
         self._ckpt_writer.wait()
+
+    def close(self) -> None:
+        """Retire this trainer from the process-global ledgers. Without
+        this, a replaced trainer (elastic restart, a bench loop) keeps
+        its params/opt providers registered — the HBM report double-
+        counts AND the ledger's provider closures pin the dead
+        trainer's whole state in memory."""
+        from hadoop_tpu.obs.hbm import hbm_ledger
+        hbm_ledger().unregister_prefix(self._hbm_owner)
 
     def try_restore(self) -> bool:
         """Resume from the newest complete checkpoint, if any."""
@@ -344,20 +380,29 @@ class Trainer:
                     stsp.add_kv("step", str(self.step + 1))
                     stsp.add_kv("data_wait_ms",
                                 f"{data_wait * 1e3:.2f}")
-                    self.params, self.opt, metrics = self.step_fn(
-                        self.params, self.opt, tokens, targets)
-                    self.step += 1
-                    self._inflight_cursor = cursor
-                    pending.append(metrics["loss"])
-                    # materialize as they age out so self.losses stays
-                    # current even if a later step raises; this float()
-                    # is the DELIBERATE bounded-in-flight backpressure
-                    # sync (see MAX_INFLIGHT above), not a stray stall
-                    while len(pending) > self.MAX_INFLIGHT:
-                        val = float(  # lint: disable=jit/blocking-in-step
-                            pending.popleft())
-                        out.append(val)
-                        self.losses.append(val)
+                    # runtime comm ledger dispatch seam: the first call
+                    # traces the step INSIDE this window (binding every
+                    # collective site's static bytes to "trainer.step");
+                    # every call advances the per-site byte counters and
+                    # records this window's host wall — with this span's
+                    # trace id as the bucket exemplar — into the
+                    # htpu_comm histograms. Nothing enters the graph.
+                    with self._comm.step("trainer.step"):
+                        self.params, self.opt, metrics = self.step_fn(
+                            self.params, self.opt, tokens, targets)
+                        self.step += 1
+                        self._inflight_cursor = cursor
+                        pending.append(metrics["loss"])
+                        # materialize as they age out so self.losses
+                        # stays current even if a later step raises;
+                        # this float() is the DELIBERATE bounded-in-
+                        # flight backpressure sync (see MAX_INFLIGHT
+                        # above), not a stray stall
+                        while len(pending) > self.MAX_INFLIGHT:
+                            val = float(  # lint: disable=jit/blocking-in-step
+                                pending.popleft())
+                            out.append(val)
+                            self.losses.append(val)
                     if self.ckpt_interval and \
                             self.step % self.ckpt_interval == 0:
                         # interval saves ride the background writer:

@@ -44,6 +44,12 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     target = vma_of(q) | vma_of(k) | vma_of(v) | {axis_name}
     q, k, v = (pvary_to(t, target) for t in (q, k, v))
 
+    # runtime comm ledger (obs/comm.py): four all_to_alls per attention
+    # (q/k/v in, attn out) — static trace-time byte facts
+    from hadoop_tpu.obs.comm import record_comm, static_nbytes
+    a2a = (2 * static_nbytes(q) + static_nbytes(k) + static_nbytes(v))
+    record_comm("cp.all2all", a2a, a2a)
+
     # seq-sharded → head-sharded: split heads P ways, gather the
     # sequence (tiled: received chunks concatenate along seq)
     q = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1,

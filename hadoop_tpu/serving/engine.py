@@ -448,6 +448,21 @@ class DecodeEngine:
                 self._mesh, P(None, None, None, "tp", None))
         self._kp, self._vp = self._fresh_kv_pools()
 
+        # live HBM ledger (obs/hbm.py): this engine's resident bytes —
+        # measured weights + the K/V pool it sized against them —
+        # published as htpu_hbm_bytes{component=...} beside the trainer
+        # and longctx components; torn down in stop()
+        from hadoop_tpu.obs.hbm import hbm_ledger
+        # trailing separator: unregister_prefix("engine@123") must not
+        # also match a coexisting "engine@1234..." owner
+        self._hbm_owner = f"engine@{id(self)}."
+        kv_pool_bytes = num_blocks * self.block_nbytes
+        led = hbm_ledger()
+        led.register(f"{self._hbm_owner}weights", "weights",
+                     lambda: self.weight_bytes)
+        led.register(f"{self._hbm_owner}kv", "kv_pool",
+                     lambda: kv_pool_bytes)
+
         # speculation lane: k draft tokens per decode lane, verified by
         # the same fused step (0 = off; every lane is then one row,
         # exactly the pre-speculation layout)
@@ -1540,6 +1555,9 @@ class DecodeEngine:
         self._stop.set()
         with self._cond:
             self._cond.notify_all()
+        # a stopped engine's pool must not haunt the HBM ledger
+        from hadoop_tpu.obs.hbm import hbm_ledger
+        hbm_ledger().unregister_prefix(self._hbm_owner)
         if self._thread is not None:
             self._thread.join(timeout=timeout)
             self._thread = None

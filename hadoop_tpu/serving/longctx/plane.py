@@ -124,6 +124,24 @@ class LongContextPlane:
         self._worker.start()
         if metrics:
             metrics.longctx_chips.set(self.prefiller.sp)
+        # live HBM ledger (obs/hbm.py): the decode working set split
+        # into window (transient page-in buffer) + tail (device-resident
+        # prompt tail + generated tokens), and the dequantized weight
+        # view an int8 replica pays beside the engine's plane
+        from hadoop_tpu.obs.hbm import hbm_ledger
+        # trailing separator: see engine's _hbm_owner note
+        self._hbm_owner = f"longctx@{id(self)}."
+        dec = self.decoder
+        per_tok = dec.hbm_working_set_bytes // max(
+            1, dec.win + dec.tail_cap)
+        led = hbm_ledger()
+        led.register(f"{self._hbm_owner}window", "longctx_window",
+                     lambda: dec.win * per_tok)
+        led.register(f"{self._hbm_owner}tail", "longctx_tail",
+                     lambda: dec.tail_cap * per_tok)
+        if self.dequantized_view_bytes:
+            led.register(f"{self._hbm_owner}deq", "weights_dequantized",
+                         lambda: self.dequantized_view_bytes)
 
     # ----------------------------------------------------------- submit
 
@@ -347,6 +365,8 @@ class LongContextPlane:
 
     def stop(self, drain: bool = False, timeout: float = 30.0) -> None:
         from hadoop_tpu.serving.engine import FAILED
+        from hadoop_tpu.obs.hbm import hbm_ledger
+        hbm_ledger().unregister_prefix(self._hbm_owner)
         if drain:
             deadline = time.monotonic() + timeout
             while not self.idle and time.monotonic() < deadline:

@@ -176,8 +176,15 @@ class ContextParallelPrefiller:
         padded = np.zeros((self.pad_tokens,), np.int32)
         padded[:s] = tokens
         t0 = time.monotonic()
-        h, ks, vs = self._fn(self.params, jnp.asarray(padded))
-        row = np.asarray(h[s - 1])
+        # runtime comm ledger dispatch seam: the first call traces the
+        # CP program inside this window (binding the ring-hop /
+        # all-to-all byte records to "longctx.prefill"); every prefill
+        # advances the cp.* byte counters and records its host wall
+        # into the htpu_comm histograms. Nothing enters the graph.
+        from hadoop_tpu.obs.comm import comm_runtime
+        with comm_runtime().step("longctx.prefill"):
+            h, ks, vs = self._fn(self.params, jnp.asarray(padded))
+            row = np.asarray(h[s - 1])
         logits = np.asarray(self._head(self.params, row))
         seconds = time.monotonic() - t0
         bs = self.block_size

@@ -152,6 +152,11 @@ class ServingServer:
             # {"enabled": False} on a bitwise replica
             "longctx": eng.longctx_stats(),
         }
+        # the live HBM ledger: what the chip's memory is spent on, one
+        # scrape — weights / kv_pool / longctx window+tail components
+        # cross-checked against backend device stats where reported
+        from hadoop_tpu.obs.hbm import hbm_ledger
+        out["hbm"] = hbm_ledger().report()
         if self.qos is not None:
             out["qos"] = self.qos.stats()
         return 200, out

@@ -144,6 +144,11 @@ class ServingReplica:
             raise ValueError("a prefill-role replica needs the DFS KV "
                              "tier (serving.kv.dfs.enable)")
         self.kv_dfs_enabled = kv_dfs
+        # runtime comm ledger gate (obs.comm.timing, default on): the
+        # replica process owns the conf, so it configures the
+        # process-global ledger the CP prefill dispatches record into
+        from hadoop_tpu.obs.comm import comm_runtime
+        comm_runtime().configure(conf)
         metrics = ServingMetrics()
         # door QoS (serving/qos.py): the decay scheduler + the fair
         # admission queue must exist BEFORE the engine (the queue is

@@ -50,3 +50,7 @@ def _reset_global_state():
     span_collector().reset_for_tests()
     from hadoop_tpu.tracing.tracer import global_tracer
     global_tracer().set_sample_rate(1.0)
+    from hadoop_tpu.obs.comm import comm_runtime
+    comm_runtime().reset_for_tests()
+    from hadoop_tpu.obs.hbm import hbm_ledger
+    hbm_ledger().reset_for_tests()

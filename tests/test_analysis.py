@@ -685,6 +685,62 @@ def test_bounded_literal_labels_are_clean(tmp_path):
     assert findings == []
 
 
+def test_labeled_counter_shared_family_is_clean(tmp_path):
+    """Counters/gauges honor the prom_name override (the runtime comm
+    ledger's htpu_comm_* families, the HBM ledger's htpu_hbm_bytes):
+    same kind under one shared family across sites is the DESIGN."""
+    from hadoop_tpu.analysis import PromFamilyChecker
+    findings = lint_source(tmp_path, """
+        def sites(reg):
+            for s in ("bucket.psum", "tp.psum", "other"):
+                reg.counter("comm_payload_bytes_" + s, "bytes",
+                            prom_name="comm_payload_bytes",
+                            prom_labels={"site": s})
+                reg.histogram("comm_seconds_" + s, "wall",
+                              prom_name="comm_seconds",
+                              prom_labels={"site": s})
+
+        def components(reg2):
+            for c in ("weights", "kv_pool"):
+                reg2.register_callback_gauge(
+                    "hbm_bytes_" + c, lambda: 0,
+                    prom_name="hbm_bytes",
+                    prom_labels={"component": c})
+    """, [PromFamilyChecker()])
+    assert findings == []
+
+
+def test_labeled_counter_family_kind_conflict_is_flagged(tmp_path):
+    """A prom_name override joins the duplicate-family ledger: a gauge
+    registering under a family another module minted as a counter is
+    the silently-dropped-exposition bug, caught at the second site."""
+    from hadoop_tpu.analysis import PromFamilyChecker
+    findings = lint_source(tmp_path, """
+        def a(reg):
+            reg.counter("comm_payload_bytes_x", "ok",
+                        prom_name="comm_payload_bytes",
+                        prom_labels={"site": "x"})
+
+        def b(reg2):
+            reg2.gauge("whatever_unique_name", "BAD: the scraper sees "
+                       "family comm_payload_bytes_total as a gauge",
+                       prom_name="comm_payload_bytes_total",
+                       prom_labels={"site": "y"})
+    """, [PromFamilyChecker()])
+    assert ids_of(findings) == ["metrics/duplicate-family"]
+
+
+def test_unbounded_counter_label_is_flagged(tmp_path):
+    from hadoop_tpu.analysis import PromFamilyChecker
+    findings = lint_source(tmp_path, """
+        def per_site_series(reg, site):
+            reg.counter("comm_bytes_" + site, "BAD: label from a "
+                        "parameter", prom_name="comm_bytes",
+                        prom_labels={"site": site})
+    """, [PromFamilyChecker()])
+    assert ids_of(findings) == ["metrics/unbounded-label"]
+
+
 # -------------------------------------------- suppression + baseline
 
 def test_line_suppression(tmp_path):

@@ -708,3 +708,348 @@ def test_audit_toggle(tmp_path):
     finally:
         fsn_mod.audit_log.removeHandler(handler)
         fsn_mod.set_audit_enabled(True)
+
+
+# -------------------------------------------- training flight recorder
+
+
+def test_comm_runtime_capture_and_counters():
+    """The dispatch seam: trace-time records bind to the step key, and
+    every subsequent execution advances the per-site byte counters and
+    latency histograms by the captured static profile."""
+    from hadoop_tpu.obs.comm import comm_runtime, record_comm
+
+    rt = comm_runtime()
+    with rt.step("t.step"):
+        record_comm("tp.psum", 100, 400)       # quantized: 4x bytes
+        record_comm("tp.psum", 50, 200)        # two chunks, one site
+        record_comm("not-a-real-site", 7, 7)   # unbounded-proof: other
+    # second execution: jit cache hit, no fresh records, profile reused
+    with rt.step("t.step"):
+        pass
+    prof = rt.profile("t.step")
+    assert prof["tp.psum"] == (150, 600)
+    assert prof["other"] == (7, 7)
+    rep = rt.report()
+    assert rep["sites"]["tp.psum"]["payload_bytes"] == 300
+    assert rep["sites"]["tp.psum"]["reference_bytes"] == 1200
+    assert rep["sites"]["tp.psum"]["observations"] == 2
+    assert rep["steps"]["t.step"] == 2
+    # records OUTSIDE any dispatch window are dropped (a bare test
+    # trace is not a runtime step)
+    record_comm("tp.psum", 999, 999)
+    assert rt.report()["sites"]["tp.psum"]["payload_bytes"] == 300
+    # a step that RAISED moved nothing: no observation recorded
+    try:
+        with rt.step("t.step"):
+            raise RuntimeError("aborted step")
+    except RuntimeError:
+        pass
+    assert rt.report()["steps"]["t.step"] == 2
+
+
+def test_comm_runtime_conf_gate():
+    """obs.comm.timing=false: the seam no-ops (no counters, no
+    histograms) but the capture still binds profiles, so flipping the
+    gate back on needs no retrace."""
+    from hadoop_tpu.obs.comm import comm_runtime, record_comm
+
+    rt = comm_runtime()
+    conf = Configuration(load_defaults=False)
+    conf.set("obs.comm.timing", "false")
+    rt.configure(conf)
+    with rt.step("gated.step"):
+        record_comm("bucket.psum", 10, 40)
+    assert rt.report()["sites"] == {}
+    assert rt.profile("gated.step")["bucket.psum"] == (10, 40)
+    rt.set_enabled(True)
+    with rt.step("gated.step"):
+        pass
+    assert rt.report()["sites"]["bucket.psum"]["payload_bytes"] == 10
+
+
+def test_comm_prom_families_are_bounded_and_shared():
+    """htpu_comm_* on /prom: ONE family per kind, site label values
+    only from the bounded set, histogram exemplar captured under an
+    active sampled span."""
+    from hadoop_tpu.metrics import metrics_system
+    from hadoop_tpu.metrics.prom import render_prom
+    from hadoop_tpu.obs.comm import COMM_SITES, comm_runtime, record_comm
+
+    rt = comm_runtime()
+    with global_tracer().span("trainer.step") as root:
+        with rt.step("p.step"):
+            record_comm("zero1.gather", 64, 256)
+    text = render_prom(metrics_system())
+    assert text.count("# TYPE htpu_comm_seconds histogram") == 1
+    assert text.count("# TYPE htpu_comm_payload_bytes_total counter") \
+        == 1
+    sites = set(re.findall(
+        r'htpu_comm_payload_bytes_total\{[^}]*site="([^"]+)"', text))
+    assert sites and sites <= set(COMM_SITES)
+    # the slow-bucket exemplar names the step's trace
+    assert f'trace_id="{root.trace_id:016x}"' in text
+
+
+def test_hbm_ledger_components_and_family():
+    """Component sums, provider error containment, unregister_prefix,
+    and the single htpu_hbm_bytes family with bounded component
+    labels."""
+    from hadoop_tpu.metrics import metrics_system
+    from hadoop_tpu.metrics.prom import render_prom
+    from hadoop_tpu.obs.hbm import HBM_COMPONENTS, hbm_ledger
+
+    led = hbm_ledger()
+    led.register("e1.w", "weights", lambda: 1000)
+    led.register("e1.kv", "kv_pool", lambda: 500)
+    led.register("e1.kv2", "kv_pool", lambda: 250)     # sums per comp
+    led.register("e1.bad", "opt_state", lambda: 1 / 0)  # contained
+    led.register("e1.odd", "no-such-component", lambda: 9)  # -> other
+    rep = led.report()
+    assert rep["components"]["weights"] == 1000
+    assert rep["components"]["kv_pool"] == 750
+    assert rep["components"]["other"] == 9
+    assert rep["errors"] == 1
+    assert rep["total_bytes"] == sum(rep["components"].values())
+    text = render_prom(metrics_system())
+    assert text.count("# TYPE htpu_hbm_bytes gauge") == 1
+    comps = set(re.findall(
+        r'htpu_hbm_bytes\{[^}]*component="([^"]+)"', text))
+    assert comps and comps <= set(HBM_COMPONENTS)
+    assert 'component="weights"} 1000' in text
+    led.unregister_prefix("e1.")
+    assert led.report()["components"] == {}
+
+
+def test_engine_hbm_components_sum_sanity(tiny_model=None):
+    """The engine's registered components match its measured numbers:
+    weights == engine.weight_bytes, kv_pool == num_blocks x
+    block_nbytes; stop() removes them from the ledger."""
+    import jax
+
+    from hadoop_tpu.models.config import get_config
+    from hadoop_tpu.models.decoder import init_params
+    from hadoop_tpu.obs.hbm import hbm_ledger
+    from hadoop_tpu.serving.engine import DecodeEngine
+
+    cfg = get_config("tiny")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                       num_blocks=9, max_context=32)
+    comps, errors = hbm_ledger().component_bytes()
+    assert errors == 0
+    assert comps["weights"] == eng.weight_bytes
+    assert comps["kv_pool"] == 9 * eng.block_nbytes
+    rep = hbm_ledger().report()
+    assert rep["total_bytes"] == sum(comps.values())
+    # the CPU simulator reports no device stats — the ledger degrades
+    # to accounted bytes (device is None), never an error
+    eng.stop()
+    comps, _ = hbm_ledger().component_bytes()
+    assert "weights" not in comps and "kv_pool" not in comps
+
+
+def test_trainer_step_metrics_rank_label():
+    """Rank-labeled /prom families from the bounded label set: rank 3
+    publishes rank="3"; a rank past the set shares "other"."""
+    from hadoop_tpu.metrics import metrics_system
+    from hadoop_tpu.metrics.prom import render_prom
+    from hadoop_tpu.obs.trainer import TrainerStepMetrics, rank_label
+
+    assert rank_label(3) == "3"
+    assert rank_label(99) == "other"
+    m = TrainerStepMetrics(rank=3)
+    m.step_wall_hist.add(0.05)
+    m.data_wait_hist.add(0.01)
+    text = render_prom(metrics_system())
+    assert re.search(
+        r'htpu_trainer_step_wall_seconds_count\{[^}]*rank="3"', text)
+    assert re.search(
+        r'htpu_trainer_data_wait_seconds_count\{[^}]*rank="3"', text)
+    # a RE-RANKED process must not keep publishing under the old label
+    # (get_or_make alone would hand back the rank="3" histogram)
+    m2 = TrainerStepMetrics(rank=5)
+    m2.step_wall_hist.add(0.02)
+    text = render_prom(metrics_system())
+    assert re.search(
+        r'htpu_trainer_step_wall_seconds_count\{[^}]*rank="5"', text)
+    assert 'rank="3"' not in text
+
+
+def test_trainer_telemetry_endpoint_shape():
+    """/ws/v1/trainer serves the step anatomy as CUMULATIVE sums (the
+    doctor diffs them), plus the comm and HBM ledger blocks."""
+    from hadoop_tpu.obs.comm import comm_runtime, record_comm
+    from hadoop_tpu.obs.hbm import hbm_ledger
+    from hadoop_tpu.obs.trainer import (TrainerStepMetrics,
+                                        TrainerTelemetry)
+
+    m = TrainerStepMetrics(rank=1)
+    m.steps.incr()
+    m.step_wall.add(0.2)
+    m.step_wall_hist.add(0.2)
+    m.ckpt_snapshot.add(0.01)
+    with comm_runtime().step("trainer.step"):
+        record_comm("bucket.psum", 11, 44)
+    hbm_ledger().register("t.params", "params", lambda: 4096)
+    tt = TrainerTelemetry(rank=1, job="j", metrics=m)
+    try:
+        body = _get_json(tt.port, "/ws/v1/trainer")
+        assert body["rank"] == 1 and body["job"] == "j"
+        assert body["steps"] == 1
+        assert body["step_wall"]["count"] == 1
+        assert abs(body["step_wall"]["sum"] - 0.2) < 1e-9
+        assert body["ckpt"]["snapshot"]["num_ops"] == 1
+        assert body["comm"]["sites"]["bucket.psum"]["payload_bytes"] \
+            == 11
+        assert body["hbm"]["components"]["params"] == 4096
+        # the chassis standard servlets ride along
+        assert _get(tt.port, "/prom")[0] == 200
+        assert _get_json(tt.port, "/ws/v1/stacks")["num_threads"] >= 1
+    finally:
+        tt.close()
+
+
+class _FakeRank:
+    """A controllable trainer endpoint: the test scripts the cumulative
+    step_wall sums the doctor windows — detection runs on INJECTED
+    numbers only (the determinism rule), never wall clocks."""
+
+    def __init__(self, name):
+        from hadoop_tpu.http import HttpServer
+        self.name = name
+        self.sum = 0.0
+        self.count = 0
+        self.http = HttpServer(Configuration(load_defaults=False),
+                               daemon_name=name)
+        self.http.add_handler("/ws/v1/trainer", self._h)
+        self.http.start()
+
+    def _h(self, query, body):
+        return 200, {"rank": self.name, "job": "j",
+                     "steps": self.count,
+                     "step_wall": {"sum": self.sum,
+                                   "count": self.count}}
+
+    def advance(self, per_step, steps=10):
+        self.sum += per_step * steps
+        self.count += steps
+
+    def stop(self):
+        self.http.stop()
+
+
+def _trainer_doctor(ranks):
+    from hadoop_tpu.obs.doctor import FleetDoctor
+    conf = Configuration(load_defaults=False)
+    conf.set("obs.doctor.endpoints", ",".join(
+        f"{r.name}=127.0.0.1:{r.http.port}" for r in ranks))
+    # absolute floor far above box noise (values here are scripted
+    # anyway — the doctor_smoke precedent)
+    conf.set("obs.doctor.slow.floor.ms", "50")
+    doctor = FleetDoctor(conf)
+    doctor.init(conf)
+    doctor.start()
+    return doctor
+
+
+def test_doctor_flags_straggler_rank_and_recovers():
+    """Injected-latency straggler: exactly the slow rank flagged in <=3
+    observation windows, a dead rank keeps its roster row with
+    ok=False, and clean windows recover the flag without operator
+    reset."""
+    ranks = [_FakeRank(f"rank-{i}") for i in range(4)]
+    doctor = _trainer_doctor(ranks)
+    try:
+        for r in ranks:
+            r.advance(0.010)        # baseline poll: no diff yet
+        doctor.poll_once()
+        flagged = []
+        windows = 0
+        for windows in range(1, 4):
+            for i, r in enumerate(ranks):
+                r.advance(0.500 if i == 2 else 0.010)
+            report = doctor.poll_once()
+            flagged = sorted(report["trainers"]["flagged"])
+            if flagged == ["rank-2"]:
+                break
+        assert flagged == ["rank-2"], report["trainers"]
+        assert windows <= 3
+        ev = report["trainers"]["flagged"]["rank-2"]
+        assert ev["signals"]["trainer.step_wall"]["value"] > 0.05
+        assert ev["stacks"].endswith("/ws/v1/stacks")
+        rows = report["trainers"]["ranks"]
+        assert len(rows) == 4 and all(v["ok"] for v in rows.values())
+        # ---- recovery: the injection stops, hysteresis clears it
+        for _ in range(5):
+            for r in ranks:
+                r.advance(0.010)
+            report = doctor.poll_once()
+            if not report["trainers"]["flagged"]:
+                break
+        assert report["trainers"]["flagged"] == {}
+        # ---- a dead rank keeps its history with ok=False
+        ranks[3].stop()
+        for i, r in enumerate(ranks[:3]):
+            r.advance(0.010)
+        report = doctor.poll_once()
+        rows = report["trainers"]["ranks"]
+        dead = [v for v in rows.values()
+                if v["endpoint"]["name"] == "rank-3"]
+        assert dead and dead[0]["ok"] is False
+        assert dead[0]["steps"] > 0          # contributed history kept
+        alive = [v for v in rows.values()
+                 if v["endpoint"]["name"] != "rank-3"]
+        assert all(v["ok"] for v in alive)
+    finally:
+        doctor.stop()
+        for r in ranks[:3]:
+            r.stop()
+
+
+def test_doctor_discovers_trainer_roster_and_skips_stale():
+    """Trainer-job roster through the registry: a live heartbeat-
+    stamped rank is discovered with kind=trainer; a corpse record
+    (stale heartbeat) is SKIPPED by the record_is_stale precedent —
+    no scrape timeouts burned on it."""
+    from hadoop_tpu.obs.trainer import TrainerTelemetry
+    from hadoop_tpu.registry import (HEARTBEAT_ATTR, RegistryServer,
+                                     ServiceRecord)
+
+    conf = Configuration(load_defaults=False)
+    reg_srv = RegistryServer(conf)
+    reg_srv.init(conf)
+    reg_srv.start()
+    tt = None
+    doctor = None
+    try:
+        tconf = Configuration(load_defaults=False)
+        tconf.set("obs.trainer.registry",
+                  f"127.0.0.1:{reg_srv.port}")
+        tt = TrainerTelemetry(tconf, rank=0, job="jobx")
+        # a corpse: registered long ago, heartbeat stamp stale
+        reg_srv.put(ServiceRecord(
+            "/trainer-jobs/jobx/rank-9",
+            endpoints={"http": "127.0.0.1:1"},
+            attributes={HEARTBEAT_ATTR: f"{time.time() - 3600:.3f}"}),
+            ttl_s=7200)
+        from hadoop_tpu.obs.doctor import FleetDoctor
+        dconf = Configuration(load_defaults=False)
+        dconf.set("obs.doctor.registry", f"127.0.0.1:{reg_srv.port}")
+        doctor = FleetDoctor(dconf)
+        doctor.init(dconf)
+        doctor.start()
+        eps = doctor.discover()
+        trainers = {e.name: e for e in eps if e.kind == "trainer"}
+        assert "/trainer-jobs/jobx/rank-0" in trainers
+        assert "/trainer-jobs/jobx/rank-9" not in trainers
+        report = doctor.poll_once()
+        rows = report["trainers"]["ranks"]
+        assert any(v["endpoint"]["name"] == "/trainer-jobs/jobx/rank-0"
+                   and v["ok"] for v in rows.values())
+    finally:
+        if doctor is not None:
+            doctor.stop()
+        if tt is not None:
+            tt.close()
+        reg_srv.stop()
