@@ -13,6 +13,12 @@ tier's acceptance ladder on the tiny config:
 - **dp2×pp2 manual schedule, 12 steps** — quantized GRADIENT buckets
   (the bucketed psum path only the manual schedule exercises); ≥2×
   payload reduction asserted here too.
+- **partial-sync schedule, dp2×tp2+sp, 50 steps** — the partially-
+  synchronized activation schedule (parallel/lowp/syncpolicy.py) at
+  ``periodic:2``: the loss-curve guard must accept, the ledger must
+  show the scheduled tp sites executing ≥1.8× fewer collectives per
+  step than the full-schedule relaxed rung, and the falsifiability
+  arm (``none`` — every sync skipped) must REJECT.
 - **bitwise is byte-identical** — a step built with parity=BITWISE
   must produce bit-identical losses to a step built with parity
   unset, proving zero lowp code executes on the default tier.
@@ -56,6 +62,9 @@ out["dp2xtp2"] = {k: rep[k] for k in
 out["dp2xtp2"]["losses_relaxed"] = rep.get("relaxed_losses")
 out["dp2xtp2"]["losses_bitwise"] = rep.get("bitwise_losses")
 assert rep.get("accepted"), f"dp2xtp2 guard rejected: {rep.get('reason')}"
+# the partial-sync rungs below A-B the SAME plan/steps/seed — reuse
+# this rung's bitwise twin instead of re-training it twice more
+bit_tp = rep.get("bitwise_losses")
 
 # ---- zero1 dp8: quantized ZeRO-1 reassembly, ≥2× payload contract
 rep = run_loss_ab(MeshPlan(dp=8), zero1=True, steps=50)
@@ -76,6 +85,49 @@ assert rep.get("accepted"), f"pp guard rejected: {rep.get('reason')}"
 ratio = rep["comm"].get("ratio")
 assert ratio is not None and ratio >= 2.0, \
     f"grad-bucket quantized payload reduction {ratio} < 2x"
+
+# ---- partial-sync schedule (syncpolicy.py): periodic:2 on dp2×tp2+sp
+from hadoop_tpu.parallel.lowp import ParityConfig
+
+
+def _tp_site_execs(comm):
+    return sum(v["executions"] for s, v in comm.get("per_site", {}).items()
+               if s in ("tp.psum", "tp.scatter"))
+
+
+full_execs = _tp_site_execs(out["dp2xtp2"]["comm"])
+rep = run_loss_ab(MeshPlan(dp=2, tp=2, megatron_sp=True), steps=50,
+                  bitwise_losses=bit_tp,
+                  parity=ParityConfig(tier="relaxed",
+                                      relaxed_sync="periodic:2"))
+sync_execs = _tp_site_execs(rep["comm"])
+exec_ratio = full_execs / max(sync_execs, 1)
+out["partial_sync"] = {
+    "schedule": "periodic:2", "mode": "skip",
+    "guard_accepted": int(bool(rep.get("accepted"))),
+    "max_rel_div": rep.get("max_rel_div"),
+    "relaxed_final": rep.get("relaxed_final"),
+    "tp_execs_full_per_step": full_execs,
+    "tp_execs_sync_per_step": sync_execs,
+    "skipped_per_step": full_execs - sync_execs,
+    "exec_ratio": round(exec_ratio, 3),
+    "comm": rep.get("comm")}
+assert rep.get("accepted"), \
+    f"partial-sync guard rejected: {rep.get('reason')}"
+assert exec_ratio >= 1.8, \
+    f"periodic:2 cut tp collective executions only {exec_ratio}x " \
+    f"(full={full_execs}/step sync={sync_execs}/step)"
+# falsifiability: a schedule that skips EVERY sync must reject — if it
+# does not, the guard is not measuring anything
+rep_none = run_loss_ab(MeshPlan(dp=2, tp=2, megatron_sp=True), steps=50,
+                       bitwise_losses=bit_tp,
+                       parity=ParityConfig(tier="relaxed",
+                                           relaxed_sync="none"))
+out["partial_sync"]["none_rejected"] = int(not rep_none.get("accepted"))
+out["partial_sync"]["none_reason"] = rep_none.get("reason")
+assert not rep_none.get("accepted"), \
+    "all-layers-skipped schedule was ACCEPTED — the falsifiability " \
+    "arm failed, the guard cannot be trusted"
 
 # ---- the bitwise tier is byte-identical to parity-unset
 cfg = get_config("tiny")

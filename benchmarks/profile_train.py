@@ -110,6 +110,16 @@ def main():
     ap.add_argument("--parity", default="bitwise",
                     choices=["bitwise", "relaxed", "both"],
                     help="parity tier rungs (parallel/lowp)")
+    ap.add_argument("--sync-schedule", default="full",
+                    help="relaxed-tier TP activation-sync schedule "
+                         "(parallel.lowp.sync.schedule: full | none | "
+                         "periodic:<k> | layers:<spec>) — priced on "
+                         "the relaxed rung and recorded in its policy "
+                         "dict + comm ledger")
+    ap.add_argument("--sync-mode", default="skip",
+                    choices=["skip", "stale"],
+                    help="what a scheduled-off layer does "
+                         "(parallel.lowp.sync.mode)")
     ap.add_argument("--guard-steps", type=int, default=0,
                     help="also run the relaxed loss-curve A-B guard "
                          "over this many steps (0 = skip)")
@@ -160,12 +170,15 @@ def main():
             return jax.value_and_grad(f)(params)
 
         from hadoop_tpu.parallel.lowp import (BITWISE_PARITY,
-                                              RELAXED_PARITY)
+                                              ParityConfig)
         from hadoop_tpu.parallel.lowp.quant import capture_comm
+        relaxed_par = ParityConfig(
+            tier="relaxed", relaxed_sync=args.sync_schedule,
+            relaxed_sync_mode=args.sync_mode)
         parities = {"bitwise": [("", BITWISE_PARITY)],
-                    "relaxed": [("parity-relaxed_", RELAXED_PARITY)],
+                    "relaxed": [("parity-relaxed_", relaxed_par)],
                     "both": [("", BITWISE_PARITY),
-                             ("parity-relaxed_", RELAXED_PARITY)]}[
+                             ("parity-relaxed_", relaxed_par)]}[
             args.parity]
         row: dict = {"batch": batch}
         # single-trace components are only meaningful single-device (no
@@ -221,11 +234,15 @@ def main():
         # loss-curve A-B acceptance (parallel/lowp/guard.py): the
         # relaxed trajectory must stay within the bounded divergence
         # of its bitwise twin. Recorded verbatim in the JSON.
+        from hadoop_tpu.parallel.lowp import ParityConfig
         from hadoop_tpu.parallel.lowp.guard import run_loss_ab
         try:
             report["parity_guard"] = run_loss_ab(
                 plan, preset=args.preset, steps=args.guard_steps,
-                seq=min(args.seq, 128))
+                seq=min(args.seq, 128),
+                parity=ParityConfig(tier="relaxed",
+                                    relaxed_sync=args.sync_schedule,
+                                    relaxed_sync_mode=args.sync_mode))
         except Exception as e:  # noqa: BLE001 — a backend that cannot
             # run the step records the gap instead of dying
             report["parity_guard"] = {"error": f"{type(e).__name__}"}

@@ -69,18 +69,27 @@ def _suite_failures(result: dict) -> list:
     return out
 
 
-# per-suite key metrics for the trajectory row: (path into the suite
-# result, logged name). Scalars only — the full result stays in --out.
+# per-suite key metrics for the trajectory row: a list of (path into
+# the suite result, logged name) per suite. Scalars only — the full
+# result stays in --out.
 _KEY_METRICS = {
-    "nn_throughput_ops_per_sec": (("create",), "create_ops_per_sec"),
-    "dfsio": (("write_mb_s",), "write_mb_s"),
-    "terasort": (("sort_bytes_per_sec",), "sort_bytes_per_sec"),
-    "serving": (("value",), "ttft_p50_ms"),
-    "serving_speculate": (("steps_ratio",), "steps_ratio"),
-    "serving_quantized": (("value",), "capacity_ratio"),
-    "trace_overhead": (("step", "overhead_frac"), "overhead_frac"),
-    "doctor": (("windows_to_flag",), "windows_to_flag"),
-    "flight_recorder": (("windows_to_flag",), "windows_to_flag"),
+    "nn_throughput_ops_per_sec": [(("create",), "create_ops_per_sec")],
+    "dfsio": [(("write_mb_s",), "write_mb_s")],
+    "terasort": [(("sort_bytes_per_sec",), "sort_bytes_per_sec")],
+    "serving": [(("value",), "ttft_p50_ms")],
+    "serving_speculate": [(("steps_ratio",), "steps_ratio")],
+    "serving_quantized": [(("value",), "capacity_ratio")],
+    "trace_overhead": [(("step", "overhead_frac"), "overhead_frac")],
+    "doctor": [(("windows_to_flag",), "windows_to_flag")],
+    "flight_recorder": [(("windows_to_flag",), "windows_to_flag")],
+    # partially-synchronized activations (parallel/lowp/syncpolicy):
+    # the lever only counts as moving when the trajectory file shows
+    # per-step collectives skipped AND the guard verdict next to them
+    "lowp": [(("partial_sync", "skipped_per_step"),
+              "sync_skipped_per_step"),
+             (("partial_sync", "exec_ratio"), "sync_exec_ratio"),
+             (("partial_sync", "guard_accepted"),
+              "sync_guard_accepted")],
 }
 
 
@@ -93,14 +102,13 @@ def _append_bench_log(path: str, out: dict, quick: bool) -> None:
         fails = _suite_failures(result) if isinstance(result, dict) \
             else []
         failures.extend(f"{suite}: {f}" for f in fails)
-        keyed = _KEY_METRICS.get(suite)
-        node = result
-        if keyed is not None:
-            for k in keyed[0]:
+        for paths, name in _KEY_METRICS.get(suite, []):
+            node = result
+            for k in paths:
                 node = node.get(k) if isinstance(node, dict) else None
             if isinstance(node, (int, float)) and not isinstance(
                     node, bool):
-                summary[f"{suite}.{keyed[1]}"] = node
+                summary[f"{suite}.{name}"] = node
     row = {"metric": "bench_suite",
            "timestamp": out.get("timestamp"),
            "code": _code_hash(),

@@ -38,6 +38,13 @@ ENTRY_POINTS = frozenset({
     "psum_scatter_quantized",
     "psum_of_scatter_quantized",
     "chunked_matmul_reduce",
+    # partially-synchronized sync schedules (parallel/lowp/syncpolicy):
+    # a scheduled-off layer's reduce replacement — skipping or staling
+    # a TP activation sync outside a relaxed guard would silently make
+    # the bitwise tier's activations rank-divergent
+    "scheduled_row_reduce",
+    "skip_row_reduce",
+    "stale_row_reduce",
     # serving weight plane (serving.parity)
     "qdot",
     "qrows",

@@ -71,6 +71,15 @@ class ParallelCtx:
     # backward's weight-grad contraction reassociates — illegal under
     # the bitwise contract, covered by the lowp loss-curve guard.
     relaxed_chunk_matmul: bool = False
+    # relaxed tier only: per-layer TP activation-sync schedule
+    # (partially synchronized activations, parallel/lowp/syncpolicy.py)
+    # — a tuple of per-layer modes ("sync"|"skip"|"stale"), one per
+    # layer this trace runs (resolve_schedule output). None (the
+    # default) = every layer syncs, the bitwise graph; a tuple must
+    # only ever be set under parallel.parity=relaxed (enforced by the
+    # make_train_step wiring + the tpulint relaxed-gated checker on the
+    # syncpolicy entry points the schedule routes to).
+    relaxed_sync: Optional[tuple] = None
 
     @property
     def seq_offset_fn(self):
@@ -150,14 +159,19 @@ def _norm(x, w, b, cfg: ModelConfig):
 # -------------------------------------------------------------- attention
 
 def _attention_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin,
-                     return_kv: bool = False):
+                     return_kv: bool = False, relaxed_sync=None):
     """Pre-norm attention with residual. x: [B, S_local, D].
 
     ``return_kv=True`` also returns this layer's post-RoPE ``(k, v)``
     shard ([B, S_local, Hkv_local, Dh]) — the long-context serving
     plane streams exactly these rows into the tiered KV store, and the
     layout matches what the decode engine scatters into its paged pool
-    (KV is cached post-rotation there too)."""
+    (KV is cached post-rotation there too).
+
+    ``relaxed_sync`` (relaxed tier only): this block's scheduled
+    reduce behavior (a ``syncpolicy.SiteSync``). When given, the block
+    returns ``(y, corr)`` where ``corr`` is the new stale correction
+    (None unless mode == "stale")."""
     resid = x
     h = _norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"), cfg)
 
@@ -197,16 +211,23 @@ def _attention_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin,
 
     from hadoop_tpu.ops.collective_matmul import row_parallel_project
     out = row_parallel_project(
-        attn.reshape(B, S, hq_local * cfg.head_dim), lp["wo"], ctx)
+        attn.reshape(B, S, hq_local * cfg.head_dim), lp["wo"], ctx,
+        relaxed_sync=relaxed_sync)
+    corr = None
+    if relaxed_sync is not None and relaxed_sync.mode == "stale":
+        out, corr = out
     y = resid + out.astype(resid.dtype)
     if return_kv:
         return y, (k, v)
+    if relaxed_sync is not None:
+        return y, corr
     return y
 
 
 # -------------------------------------------------------------------- mlp
 
-def _mlp_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx):
+def _mlp_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx,
+               relaxed_sync=None):
     from hadoop_tpu.ops.collective_matmul import (reduce_row_parallel,
                                                   row_parallel_project)
     resid = x
@@ -218,24 +239,44 @@ def _mlp_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx):
         # row-parallel reduce visible here chunks like every other
         # (reduce-only chunking is bit-exact in both directions)
         from hadoop_tpu.models.moe import moe_mlp
-        out = reduce_row_parallel(moe_mlp(h, lp, cfg, ctx), ctx)
+        out = reduce_row_parallel(moe_mlp(h, lp, cfg, ctx), ctx,
+                                  relaxed_sync=relaxed_sync)
     elif cfg.use_swiglu:
         out = row_parallel_project(
-            swiglu(h @ lp["w_gate"], h @ lp["w_up"]), lp["w_down"], ctx)
+            swiglu(h @ lp["w_gate"], h @ lp["w_up"]), lp["w_down"], ctx,
+            relaxed_sync=relaxed_sync)
     else:
         out = row_parallel_project(
             gelu(h @ lp["w_in"] + lp["b_in"]), lp["w_out"], ctx,
-            bias=lp["b_out"])
-    return resid + out.astype(resid.dtype)
+            bias=lp["b_out"], relaxed_sync=relaxed_sync)
+    corr = None
+    if relaxed_sync is not None and relaxed_sync.mode == "stale":
+        out, corr = out
+    y = resid + out.astype(resid.dtype)
+    if relaxed_sync is not None:
+        return y, corr
+    return y
 
 
 # ------------------------------------------------------------------ layer
 
-def layer_forward(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
-    """One transformer block. lp: this layer's weights (no leading L dim)."""
-    x = _attention_block(x, lp, cfg, ctx, cos, sin)
-    x = _mlp_block(x, lp, cfg, ctx)
-    return x
+def layer_forward(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin,
+                  relaxed_sync=None):
+    """One transformer block. lp: this layer's weights (no leading L dim).
+
+    ``relaxed_sync`` (relaxed tier only): a ``(attn, mlp)`` pair of
+    ``syncpolicy.SiteSync`` naming each reduce site's scheduled mode;
+    when given the layer returns ``(x, (attn_corr, mlp_corr))`` — the
+    corrections are None except in stale mode."""
+    if relaxed_sync is None:
+        x = _attention_block(x, lp, cfg, ctx, cos, sin)
+        x = _mlp_block(x, lp, cfg, ctx)
+        return x
+    a_sync, m_sync = relaxed_sync
+    x, ca = _attention_block(x, lp, cfg, ctx, cos, sin,
+                             relaxed_sync=a_sync)
+    x, cm = _mlp_block(x, lp, cfg, ctx, relaxed_sync=m_sync)
+    return x, (ca, cm)
 
 
 def layer_forward_kv(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
@@ -259,13 +300,35 @@ def run_layers_kv(x, layers, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
         h2, kv = layer_forward_kv(h, lp, cfg, ctx, cos, sin)
         return h2, kv
 
-    out, kvs = jax.lax.scan(
-        step, pvary_to(x, vma_of(x) | tree_vma(layers)), layers)
+    from hadoop_tpu.obs.comm import comm_scale
+    with comm_scale(jax.tree_util.tree_leaves(layers)[0].shape[0]):
+        out, kvs = jax.lax.scan(
+            step, pvary_to(x, vma_of(x) | tree_vma(layers)), layers)
     return out, kvs
 
 
+def _remat_policy(remat):
+    """THE remat-mode → checkpoint-policy mapping (None = default
+    save-nothing policy). Both layer-loop paths (the scan-fused
+    unscheduled body and the scheduled segment bodies) derive their
+    wrapping from this one table so the policies can never fork."""
+    if remat == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    return None
+
+
+def _wrap_remat(f, remat):
+    """checkpoint-wrap a layer body that closes over its static args."""
+    if not remat:
+        return f
+    pol = _remat_policy(remat)
+    if pol is not None:
+        return jax.checkpoint(f, policy=pol)
+    return jax.checkpoint(f)
+
+
 def run_layers(x, layers, cfg: ModelConfig, ctx: ParallelCtx, cos, sin,
-               remat=False):
+               remat=False, sync_state=None):
     """scan the (local slice of the) layer stack over x.
 
     ``remat``: False — save all activations; True/"full" — recompute the
@@ -274,25 +337,108 @@ def run_layers(x, layers, cfg: ModelConfig, ctx: ParallelCtx, cos, sin,
     (near-zero FLOP overhead, most of the memory win). The selective
     policy is the TPU-idiomatic middle ground: MXU results are kept,
     VPU work is replayed.
+
+    ``ctx.relaxed_sync`` (relaxed tier only) switches to the scheduled
+    layer loop: contiguous equal-mode layer runs scan with that mode's
+    reduce behavior, stale layers unroll so each consumes/emits its own
+    correction. ``sync_state`` (required iff the schedule has stale
+    layers): ``[n_stale, 2, *x.shape]`` — the previous step's reduced
+    residual corrections, one ``[2(attn,mlp), ...]`` slab per stale
+    layer in layer order. When ``sync_state`` is passed the function
+    returns ``(out, new_sync_state)``.
     """
     from hadoop_tpu.ops.vma import pvary_to, tree_vma, vma_of
-    body = layer_forward
-    if remat == "dots":
-        body = jax.checkpoint(
-            body, static_argnums=(2, 3),
-            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
-    elif remat:
-        body = jax.checkpoint(
-            body, static_argnums=(2, 3))  # cfg, ctx are static pytrees
+    sched = ctx.relaxed_sync if ctx.tp_axis is not None else None
+    if sched is not None and all(m == "sync" for m in sched):
+        sched = None
+    if sched is None:
+        body = layer_forward
+        if remat:  # cfg, ctx are static pytrees
+            pol = _remat_policy(remat)
+            body = jax.checkpoint(
+                body, static_argnums=(2, 3),
+                **({"policy": pol} if pol is not None else {}))
 
-    def step(h, lp):
-        return body(h, lp, cfg, ctx, cos, sin), None
+        def step(h, lp):
+            return body(h, lp, cfg, ctx, cos, sin), None
 
-    # the carry leaves the scan varying over every axis the layer weights
-    # vary over; the initial carry must match
-    out, _ = jax.lax.scan(step, pvary_to(x, vma_of(x) | tree_vma(layers)),
-                          layers)
-    return out
+        # the carry leaves the scan varying over every axis the layer
+        # weights vary over; the initial carry must match. comm_scale:
+        # the scan traces ONE body for n layers — scale its trace-time
+        # comm records so the per-step ledger profile counts per-step
+        # hardware executions, not per-trace appearances
+        from hadoop_tpu.obs.comm import comm_scale
+        n_local = jax.tree_util.tree_leaves(layers)[0].shape[0]
+        with comm_scale(n_local):
+            out, _ = jax.lax.scan(
+                step, pvary_to(x, vma_of(x) | tree_vma(layers)), layers)
+        return (out, sync_state) if sync_state is not None else out
+
+    # ---- scheduled layer loop (parallel.lowp.sync.*, relaxed tier) ----
+    from hadoop_tpu.parallel.lowp.syncpolicy import SiteSync
+    n_local = jax.tree_util.tree_leaves(layers)[0].shape[0]
+    if len(sched) != n_local:
+        raise ValueError(
+            f"sync schedule names {len(sched)} layers but this trace "
+            f"runs {n_local} (per-layer schedules compose with the flat "
+            f"layer stack only — pp plans are refused at train-step "
+            f"build)")
+    if any(m == "stale" for m in sched) and sync_state is None:
+        raise ValueError("stale sync schedule needs sync_state (the "
+                         "previous step's corrections)")
+
+    def plain_body(mode):
+        pair = (SiteSync(mode), SiteSync(mode))
+
+        def f(h, lp):
+            y, _ = layer_forward(h, lp, cfg, ctx, cos, sin,
+                                 relaxed_sync=pair)
+            return y
+        return _wrap_remat(f, remat)
+
+    def stale_body():
+        def f(h, lp, corr2):
+            pair = (SiteSync("stale", corr2[0]),
+                    SiteSync("stale", corr2[1]))
+            return layer_forward(h, lp, cfg, ctx, cos, sin,
+                                 relaxed_sync=pair)
+        return _wrap_remat(f, remat)
+
+    h = x
+    stale_corrs = []
+    si = 0
+    i = 0
+    while i < n_local:
+        mode = sched[i]
+        j = i
+        while j < n_local and sched[j] == mode:
+            j += 1
+        seg = jax.tree_util.tree_map(lambda a: a[i:j], layers)
+        if mode == "stale":
+            # unrolled: each stale layer consumes ITS previous-step
+            # correction and emits this step's
+            fn = stale_body()
+            for k in range(j - i):
+                lp = jax.tree_util.tree_map(lambda a, _k=k: a[_k], seg)
+                h, (ca, cm) = fn(h, lp, sync_state[si])
+                stale_corrs.append(jnp.stack([ca, cm]))
+                si += 1
+        else:
+            fn = plain_body(mode)
+
+            def seg_step(hh, lp, _fn=fn):
+                return _fn(hh, lp), None
+
+            from hadoop_tpu.obs.comm import comm_scale
+            with comm_scale(j - i):
+                h, _ = jax.lax.scan(
+                    seg_step, pvary_to(h, vma_of(h) | tree_vma(seg)),
+                    seg)
+        i = j
+    if sync_state is not None:
+        new_state = jnp.stack(stale_corrs) if stale_corrs else sync_state
+        return h, new_state
+    return h
 
 
 # ------------------------------------------------------------- embeddings
@@ -368,10 +514,18 @@ def lm_logits(params, h, cfg: ModelConfig, ctx: ParallelCtx = None):
 # ---------------------------------------------------------------- forward
 
 def forward_hidden(params, tokens, cfg: ModelConfig,
-                   ctx: ParallelCtx = SINGLE, remat: bool = False):
-    """Embed + layer stack (everything before the LM head)."""
+                   ctx: ParallelCtx = SINGLE, remat: bool = False,
+                   sync_state=None):
+    """Embed + layer stack (everything before the LM head).
+
+    ``sync_state`` (relaxed stale sync schedules only) threads the
+    previous step's corrections through ``run_layers``; when given the
+    return is ``(h, new_sync_state)``."""
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq, cfg.rope_theta)
     h = embed_tokens(params, tokens, cfg, ctx)
+    if sync_state is not None:
+        return run_layers(h, params["layers"], cfg, ctx, cos, sin,
+                          remat=remat, sync_state=sync_state)
     return run_layers(h, params["layers"], cfg, ctx, cos, sin, remat=remat)
 
 

@@ -55,8 +55,12 @@ from typing import Dict, List, Optional, Tuple
 # to "other" so a new call site can never mint an unbounded Prometheus
 # series. Keep in sync with the literal tuples in _build_metrics below
 # (the tpulint unbounded-label checker requires the literals inline).
+# "tp.stale" carries the deferred correction collectives of the
+# partially-synchronized sync schedule (parallel/lowp/syncpolicy.py) —
+# bytes that still move but off the step's critical path.
 COMM_SITES = ("bucket.psum", "bucket.scatter", "zero1.gather",
-              "tp.psum", "tp.scatter", "cp.ring", "cp.all2all", "other")
+              "tp.psum", "tp.scatter", "tp.stale", "cp.ring",
+              "cp.all2all", "other")
 
 
 def static_nbytes(x) -> int:
@@ -66,6 +70,33 @@ def static_nbytes(x) -> int:
     for d in x.shape:
         n *= int(d)
     return n * x.dtype.itemsize
+
+
+_SCALE_TLS = threading.local()
+
+
+@contextmanager
+def comm_scale(n: int):
+    """Trace-time record multiplier for scan-fused bodies.
+
+    ``lax.scan`` traces its body ONCE for however many layers it runs,
+    so a collective recorded inside a scanned layer body stands for
+    ``scan_length`` executions per step. The layer loop
+    (``models/decoder.run_layers``) wraps each scan trace in
+    ``comm_scale(scan_length)`` so the per-step profile counts what the
+    hardware actually runs — which is what makes the full-schedule vs
+    sync-schedule execution/byte comparison an honest ledger read
+    instead of a per-trace artifact. Nests multiplicatively."""
+    prev = getattr(_SCALE_TLS, "scale", 1)
+    _SCALE_TLS.scale = prev * int(n)
+    try:
+        yield
+    finally:
+        _SCALE_TLS.scale = prev
+
+
+def comm_scale_factor() -> int:
+    return getattr(_SCALE_TLS, "scale", 1)
 
 
 class _StepHandle:
@@ -99,6 +130,7 @@ class CommRuntime:
         self._hists: Dict = {}
         self._payload: Dict = {}
         self._reference: Dict = {}
+        self._execs: Dict = {}
 
     # ------------------------------------------------------------- config
 
@@ -115,14 +147,22 @@ class CommRuntime:
 
     # -------------------------------------------------- trace-time record
 
-    def record(self, site: str, payload: int, reference: int) -> None:
+    def record(self, site: str, payload: int, reference: int,
+               executions: int = 1) -> None:
         """Called by the collective entry points while jit traces them.
         Binds to the innermost active :meth:`step` capture on this
         thread; records outside any capture (a bare test trace) are
-        dropped — they never correspond to a runtime step."""
+        dropped — they never correspond to a runtime step.
+        ``executions`` counts collectives the wire actually runs per
+        step at this record: 1 for a real collective, 0 for a site a
+        sync schedule skipped/staled (payload 0, reference intact) —
+        which is how the ledger proves per-step collective-EXECUTION
+        counts drop on schedule, not just bytes."""
         stack = getattr(self._tls, "stack", None)
         if stack:
-            stack[-1].append((site, int(payload), int(reference)))
+            m = comm_scale_factor()
+            stack[-1].append((site, int(payload) * m,
+                              int(reference) * m, int(executions) * m))
 
     # ------------------------------------------------------ dispatch seam
 
@@ -136,7 +176,7 @@ class CommRuntime:
         stack = getattr(self._tls, "stack", None)
         if stack is None:
             stack = self._tls.stack = []
-        records: List[Tuple[str, int, int]] = []
+        records: List[Tuple[str, int, int, int]] = []
         stack.append(records)
         handle = _StepHandle()
         t0 = time.monotonic()
@@ -155,12 +195,12 @@ class CommRuntime:
             if records:
                 # a (re)trace happened inside this window: it REDEFINES
                 # the per-step profile for this key
-                prof: Dict[str, Tuple[int, int]] = {}
-                for site, p, r in records:
+                prof: Dict[str, Tuple[int, int, int]] = {}
+                for site, p, r, e in records:
                     if site not in COMM_SITES:
                         site = "other"
-                    pp, rr = prof.get(site, (0, 0))
-                    prof[site] = (pp + p, rr + r)
+                    pp, rr, ee = prof.get(site, (0, 0, 0))
+                    prof[site] = (pp + p, rr + r, ee + e)
                 with self._lock:
                     self._profiles[key] = prof
             if self._enabled:
@@ -172,15 +212,17 @@ class CommRuntime:
             if not prof:
                 return
             self._steps[key] = self._steps.get(key, 0) + 1
-            for site, (p, r) in prof.items():
-                tot = self._totals.setdefault(site, [0, 0, 0])
+            for site, (p, r, e) in prof.items():
+                tot = self._totals.setdefault(site, [0, 0, 0, 0])
                 tot[0] += p
                 tot[1] += r
-                tot[2] += 1
-        hists, payload, reference = self._metrics()
-        for site, (p, r) in prof.items():
+                tot[2] += e
+                tot[3] += 1
+        hists, payload, reference, execs = self._metrics()
+        for site, (p, r, e) in prof.items():
             payload[site].incr(p)
             reference[site].incr(r)
+            execs[site].incr(e)
             # under an active sampled span (trainer.step) the add
             # captures the trace id as this bucket's exemplar
             hists[site].add(wall)
@@ -194,15 +236,17 @@ class CommRuntime:
         from hadoop_tpu.metrics import metrics_system
         reg = metrics_system().source("comm")
         if reg is self._reg:
-            return self._hists, self._payload, self._reference
+            return self._hists, self._payload, self._reference, \
+                self._execs
         hists: Dict = {}
         payload: Dict = {}
         reference: Dict = {}
+        execs: Dict = {}
         # label values drawn from this literal tuple — the bounded-set
         # contract the tpulint metrics/unbounded-label checker enforces
         for s in ("bucket.psum", "bucket.scatter", "zero1.gather",
-                  "tp.psum", "tp.scatter", "cp.ring", "cp.all2all",
-                  "other"):
+                  "tp.psum", "tp.scatter", "tp.stale", "cp.ring",
+                  "cp.all2all", "other"):
             k = s.replace(".", "_")
             hists[s] = reg.histogram(
                 "comm_seconds_" + k,
@@ -218,9 +262,15 @@ class CommRuntime:
                 "bytes the unquantized form of this site would move",
                 prom_name="comm_reference_bytes",
                 prom_labels={"site": s})
+            execs[s] = reg.counter(
+                "comm_executions_" + k,
+                "collectives this site actually executed (a site a "
+                "sync schedule skipped counts 0 per step)",
+                prom_name="comm_executions", prom_labels={"site": s})
         self._reg, self._hists = reg, hists
         self._payload, self._reference = payload, reference
-        return hists, payload, reference
+        self._execs = execs
+        return hists, payload, reference, execs
 
     # ------------------------------------------------------------- report
 
@@ -230,13 +280,14 @@ class CommRuntime:
         counts."""
         with self._lock:
             sites = {s: {"payload_bytes": t[0], "reference_bytes": t[1],
-                         "observations": t[2]}
+                         "executions": t[2], "observations": t[3]}
                      for s, t in self._totals.items()}
             steps = dict(self._steps)
         return {"enabled": self._enabled, "sites": sites, "steps": steps}
 
-    def profile(self, key: str) -> Dict[str, Tuple[int, int]]:
-        """The captured per-step byte profile for one step key."""
+    def profile(self, key: str) -> Dict[str, Tuple[int, int, int]]:
+        """The captured per-step profile for one step key:
+        site -> (payload_bytes, reference_bytes, executions)."""
         with self._lock:
             return dict(self._profiles.get(key, {}))
 
@@ -250,6 +301,7 @@ class CommRuntime:
         self._hists = {}
         self._payload = {}
         self._reference = {}
+        self._execs = {}
 
 
 _RUNTIME = CommRuntime()
@@ -259,8 +311,11 @@ def comm_runtime() -> CommRuntime:
     return _RUNTIME
 
 
-def record_comm(site: str, payload: int, reference: int) -> None:
+def record_comm(site: str, payload: int, reference: int,
+                executions: int = 1) -> None:
     """Module-level trace-time hook the collective entry points call
     (quant.py forwards its quantized-site records here too, so one
-    profile covers both tiers)."""
-    _RUNTIME.record(site, payload, reference)
+    profile covers both tiers). ``executions=0`` marks a site a sync
+    schedule scheduled off — bytes 0, reference intact, no collective
+    on the wire."""
+    _RUNTIME.record(site, payload, reference, executions)

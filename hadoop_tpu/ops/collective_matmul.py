@@ -87,12 +87,23 @@ def _reduce_one(t, ctx):
     return jax.lax.psum(t, ctx.tp_axis)
 
 
-def reduce_row_parallel(y, ctx):
+def reduce_row_parallel(y, ctx, relaxed_sync=None):
     """The row-parallel reduce issued in ``ctx.tp_overlap_chunks``
     chunks along a non-contraction dim. Identity when tp is absent; one
-    whole-tensor collective when chunking is off (the classic form)."""
+    whole-tensor collective when chunking is off (the classic form).
+
+    ``relaxed_sync`` (relaxed tier only): this site's scheduled mode
+    under a partially-synchronized sync schedule
+    (parallel/lowp/syncpolicy.py). A scheduled-off site replaces the
+    whole reduce with the local partial (skip) or the previous step's
+    correction (stale — the return becomes ``(y, new_corr)``); there
+    is no chunk loop to run, the wire moves nothing this step."""
     if ctx.tp_axis is None:
         return y
+    if relaxed_sync is not None and relaxed_sync.mode != "sync":
+        from hadoop_tpu.parallel.lowp.syncpolicy import \
+            scheduled_row_reduce
+        return scheduled_row_reduce(y, ctx, relaxed_sync)
     n_chunks = getattr(ctx, "tp_overlap_chunks", 1)
     # megatron_sp scatters dim 1 (sequence) — chunk dim 0 (batch) so
     # each chunk's scatter is a sub-block of the full scatter; plain tp
@@ -140,11 +151,26 @@ def chunked_matmul_reduce(x, w, ctx, bias: Optional[jax.Array] = None):
     return jnp.concatenate(outs, axis=axis)
 
 
-def row_parallel_project(x, w, ctx, bias: Optional[jax.Array] = None):
+def row_parallel_project(x, w, ctx, bias: Optional[jax.Array] = None,
+                         relaxed_sync=None):
     """``reduce_row_parallel(x @ w + bias)`` — the shared shape of the
     attention out-projection and MLP down-projection. ``bias``
     (replicated) is added to the PARTIAL product exactly like the
-    unchunked code paths did, preserving their numerics verbatim."""
+    unchunked code paths did, preserving their numerics verbatim.
+
+    ``relaxed_sync`` (relaxed tier only): the site's per-layer sync
+    schedule entry. A scheduled-off layer has no reduce to chunk or
+    quantize, so the schedule takes precedence over
+    ``relaxed_chunk_matmul``/``relaxed_codec`` at this site; synced
+    layers of the same schedule compose with both as before."""
+    if relaxed_sync is not None and relaxed_sync.mode != "sync" \
+            and ctx.tp_axis is not None:
+        from hadoop_tpu.parallel.lowp.syncpolicy import \
+            scheduled_row_reduce
+        y = x @ w
+        if bias is not None:
+            y = y + bias
+        return scheduled_row_reduce(y, ctx, relaxed_sync)
     if ctx.relaxed_chunk_matmul and ctx.tp_axis is not None:
         # relaxed tier: matmul and collective interleave per chunk
         return chunked_matmul_reduce(x, w, ctx, bias=bias)

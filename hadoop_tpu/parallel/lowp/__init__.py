@@ -48,6 +48,14 @@ Conf keys (read by :func:`parity_from_conf`):
   parallel.lowp.quant.tp            default true  (consumer 2)
   parallel.lowp.chunk-matmul        default true  (consumer 3)
   parallel.lowp.quant.group         default 1024  (scale granularity)
+  parallel.lowp.sync.schedule       default full  (per-layer TP sync
+                                    schedule: full | none | periodic:<k>
+                                    | layers:<spec> — syncpolicy.py)
+  parallel.lowp.sync.mode           default skip  (skip | stale: what a
+                                    scheduled-off layer does)
+  parallel.lowp.sync.guard.rel-tol  default 2.0   (loss-curve tolerance
+                                    for sync-SCHEDULE rungs — see
+                                    syncpolicy.py)
   parallel.lowp.guard.steps         default 50    (loss-curve A-B length)
   parallel.lowp.guard.rel-tol       default 0.25  (max per-step rel div)
 
@@ -81,6 +89,20 @@ class ParityConfig:
     quant_tp: bool = True             # row-parallel tp reduces
     chunk_matmul: bool = True         # true chunked collective matmul
     group: int = 1024                 # elements per shared scale
+    # per-layer TP activation-sync schedule (syncpolicy.py; partially
+    # synchronized activations, arXiv:2506.19645). "full" (the
+    # default) syncs every layer — the schedule machinery is
+    # unreachable, and under the bitwise tier it is unreachable
+    # regardless of this field (the lexical relaxed_* gating tpulint
+    # enforces).
+    relaxed_sync: str = "full"        # parallel.lowp.sync.schedule
+    relaxed_sync_mode: str = "skip"   # parallel.lowp.sync.mode
+    # loss-curve tolerance for SYNC-SCHEDULE rungs (a schedule shifts
+    # the trajectory — the scheduled curve tracks the bitwise shape a
+    # constant factor behind — so the per-step relative guard needs a
+    # wider bar than quantization noise; the all-skipped falsifiability
+    # arm still rejects >8x above this: see syncpolicy.py)
+    sync_guard_rel_tol: float = 2.0   # parallel.lowp.sync.guard.rel-tol
     guard_steps: int = 50
     guard_rel_tol: float = 0.25
 
@@ -91,6 +113,10 @@ class ParityConfig:
         if self.codec not in WIRE_CODECS:
             raise ValueError(f"parallel.lowp.codec must be one of "
                              f"{WIRE_CODECS}, got {self.codec!r}")
+        # grammar check at config time (jax-free; full resolution
+        # against n_layers happens at train-step build)
+        from hadoop_tpu.parallel.lowp.syncpolicy import validate_spec
+        validate_spec(self.relaxed_sync, self.relaxed_sync_mode)
 
     @property
     def relaxed(self) -> bool:
@@ -114,6 +140,10 @@ def parity_from_conf(conf) -> ParityConfig:
         quant_tp=conf.get_bool("parallel.lowp.quant.tp", True),
         chunk_matmul=conf.get_bool("parallel.lowp.chunk-matmul", True),
         group=conf.get_int("parallel.lowp.quant.group", 1024),
+        relaxed_sync=conf.get("parallel.lowp.sync.schedule", "full"),
+        relaxed_sync_mode=conf.get("parallel.lowp.sync.mode", "skip"),
+        sync_guard_rel_tol=conf.get_float(
+            "parallel.lowp.sync.guard.rel-tol", 2.0),
         guard_steps=conf.get_int("parallel.lowp.guard.steps", 50),
         guard_rel_tol=conf.get_float("parallel.lowp.guard.rel-tol", 0.25))
 

@@ -144,12 +144,34 @@ def loss_curve_report(bitwise: Sequence[float],
     return report
 
 
+def guard_rel_tol_for(parity: ParityConfig, n_layers: int, *,
+                      tp: int = 1) -> float:
+    """Which loss-curve tolerance judges this parity config.
+
+    Sync-SCHEDULE rungs judge at the schedule tier's tolerance: a
+    schedule shifts the trajectory (constant-factor lag), which the
+    per-step relative bar built for quantization noise reads as
+    divergence — see syncpolicy.py for the measured separation from
+    the all-skipped falsifiability arm. Decided on the RESOLVED
+    schedule, never the spec string: ``periodic:1`` / ``layers:*=sync``
+    resolve to the exact full graph (and tp=1 plans have no schedule
+    at all), so they keep the strict quantization tolerance."""
+    from hadoop_tpu.parallel.lowp.syncpolicy import resolve_schedule
+    sched = resolve_schedule(
+        parity.relaxed_sync, n_layers,
+        off_mode=parity.relaxed_sync_mode) if tp > 1 else None
+    if sched is not None and any(m != "sync" for m in sched):
+        return parity.sync_guard_rel_tol
+    return parity.guard_rel_tol
+
+
 def run_loss_ab(plan, *, preset: str = "tiny", steps: int = 50,
                 lr: float = 5e-3, batch: int = 8, seq: int = 32,
                 zero1: bool = False, n_microbatches: int = 1,
                 optimizer: str = "adamw",
                 parity: Optional[ParityConfig] = None,
                 rel_tol: Optional[float] = None,
+                bitwise_losses: Optional[Sequence[float]] = None,
                 seed: int = 0) -> Dict:
     """The loss-curve A-B: run ``steps`` training steps bitwise and
     relaxed from identical init/data on ``plan`` and judge the relaxed
@@ -158,6 +180,12 @@ def run_loss_ab(plan, *, preset: str = "tiny", steps: int = 50,
     payload-byte reduction. Returns the report dict (never raises on
     rejection — callers assert ``report["accepted"]`` so benches can
     record a failing rung as data).
+
+    ``bitwise_losses``: a previously-measured bitwise twin for the SAME
+    plan/steps/seed/preset (e.g. another rung's
+    ``report["bitwise_losses"]``) — skips re-running the bitwise arm,
+    which otherwise dominates a multi-rung ladder's wall clock. A
+    length mismatch with ``steps`` is rejected by the judge.
 
     The default ``lr`` keeps the tiny preset in its DESCENT regime for
     all 50 steps: a hotter rate parks both curves on the converged
@@ -177,9 +205,10 @@ def run_loss_ab(plan, *, preset: str = "tiny", steps: int = 50,
 
     if parity is None:
         parity = RELAXED_PARITY
-    if rel_tol is None:
-        rel_tol = parity.guard_rel_tol
     cfg = get_config(preset, max_seq=max(seq, 32))
+    if rel_tol is None:
+        rel_tol = guard_rel_tol_for(parity, cfg.n_layers,
+                                    tp=plan.tp)
     mesh = make_mesh(plan)
     ds = make_data_sharding(mesh)
     tokens = jax.device_put(
@@ -202,12 +231,18 @@ def run_loss_ab(plan, *, preset: str = "tiny", steps: int = 50,
             losses.append(float(m["loss"]))  # lint: disable=jit/blocking-in-step
         return losses
 
-    bit = run(BITWISE_PARITY)
+    bit = [float(x) for x in bitwise_losses] \
+        if bitwise_losses is not None else run(BITWISE_PARITY)
     with capture_comm() as ledger:
         rel = run(parity)
     report = loss_curve_report(bit, rel, rel_tol=rel_tol)
     report["plan"] = repr(plan)
     report["codec"] = parity.codec
+    # the active TP sync schedule (syncpolicy.py) — A-B rows must say
+    # which schedule produced them, or two rungs' ledgers are
+    # indistinguishable in the bench JSON
+    report["sync_schedule"] = parity.relaxed_sync
+    report["sync_mode"] = parity.relaxed_sync_mode
     report["comm"] = ledger.report()
     report["bitwise_losses"] = [round(x, 6) for x in bit]
     report["relaxed_losses"] = [round(x, 6) for x in rel]

@@ -93,12 +93,23 @@ class CommLedger:
     def __init__(self):
         self.payload_bytes = 0
         self.reference_bytes = 0
+        self.executions = 0
         self.sites: List[Tuple[str, int, int]] = []
+        # per-site accumulation: site -> [payload, reference, executions]
+        # (the sync-schedule proofs read executed-collective counts per
+        # site off the trace — a scheduled-off site records 0)
+        self.per_site: Dict[str, List[int]] = {}
 
-    def add(self, site: str, payload: int, reference: int) -> None:
+    def add(self, site: str, payload: int, reference: int,
+            executions: int = 1) -> None:
         self.payload_bytes += payload
         self.reference_bytes += reference
+        self.executions += executions
         self.sites.append((site, payload, reference))
+        tot = self.per_site.setdefault(site, [0, 0, 0])
+        tot[0] += payload
+        tot[1] += reference
+        tot[2] += executions
 
     @property
     def ratio(self) -> float:
@@ -110,9 +121,14 @@ class CommLedger:
     def report(self) -> Dict:
         return {"payload_bytes": self.payload_bytes,
                 "reference_bytes": self.reference_bytes,
+                "executions": self.executions,
                 "ratio": round(self.ratio, 3) if self.payload_bytes
                 else None,
-                "sites": len(self.sites)}
+                "sites": len(self.sites),
+                "per_site": {s: {"payload_bytes": t[0],
+                                 "reference_bytes": t[1],
+                                 "executions": t[2]}
+                             for s, t in self.per_site.items()}}
 
 
 _ACTIVE_LEDGERS: List[CommLedger] = []
@@ -139,15 +155,22 @@ def _nbytes(x) -> int:
     return static_nbytes(x)
 
 
-def _record(site: str, payload: int, reference: int) -> None:
+def _record(site: str, payload: int, reference: int,
+            executions: int = 1) -> None:
+    # scan-fused layer bodies trace once for many executions: the layer
+    # loop sets a comm_scale so the trace-time ledgers count what the
+    # hardware runs per step (obs/comm.comm_scale)
+    from hadoop_tpu.obs.comm import comm_scale_factor
+    m = comm_scale_factor()
     for led in _ACTIVE_LEDGERS:
-        led.add(site, payload, reference)
+        led.add(site, payload * m, reference * m, executions * m)
     # the RUNTIME comm ledger (obs/comm.py) keeps the same trace-time
     # byte facts per bounded site label, bound to the dispatch seam
     # that traced them — that is how htpu_comm byte counters advance
-    # per executed step at runtime
+    # per executed step at runtime. executions=0 marks a site the sync
+    # schedule (syncpolicy.py) scheduled off.
     from hadoop_tpu.obs.comm import record_comm
-    record_comm(site, payload, reference)
+    record_comm(site, payload, reference, executions)
 
 
 # ------------------------------------------------------------- primitives

@@ -70,7 +70,8 @@ class MeshPlan:
 
     def ctx(self, cfg: ModelConfig, tp_overlap_chunks: int = 1,
             relaxed_codec=None,
-            relaxed_chunk_matmul: bool = False) -> ParallelCtx:
+            relaxed_chunk_matmul: bool = False,
+            relaxed_sync=None) -> ParallelCtx:
         return ParallelCtx(
             tp_axis="tp" if self.tp > 1 else None,
             tp_size=self.tp,
@@ -82,10 +83,15 @@ class MeshPlan:
             sp_mode=self.sp_mode,
             tp_overlap_chunks=tp_overlap_chunks if self.tp > 1 else 1,
             # the relaxed lowp knobs only change behaviour where a tp
-            # collective exists; a tp=1 plan stays bitwise by shape
+            # collective exists; a tp=1 plan stays bitwise by shape —
+            # including the sync schedule, which is forced to full
+            # (None) by construction when there is no tp sync to skip
             relaxed_codec=relaxed_codec if self.tp > 1 else None,
             relaxed_chunk_matmul=(relaxed_chunk_matmul
                                   if self.tp > 1 else False),
+            relaxed_sync=(tuple(relaxed_sync)
+                          if relaxed_sync is not None and self.tp > 1
+                          else None),
         )
 
     def validate(self, cfg: ModelConfig, batch: int, seq: int,

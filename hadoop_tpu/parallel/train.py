@@ -189,6 +189,7 @@ def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
         # chunked collectives; build the quant spec once (guarded —
         # under bitwise no lowp module is touched)
         from hadoop_tpu.parallel.lowp.quant import RelaxedQuant
+        from hadoop_tpu.parallel.lowp.syncpolicy import resolve_schedule
         _sizes = dict(zip(AXES,
                           (plan.dp, plan.pp, plan.tp, plan.ep, plan.sp)))
         rq_buckets = RelaxedQuant(
@@ -200,13 +201,33 @@ def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
             else None
         relaxed_codec = parity.codec if parity.quant_tp else None
         relaxed_chunk = parity.chunk_matmul
+        # per-layer TP sync schedule (syncpolicy.py): resolved once
+        # against the layer count; tp=1 plans have no sync to schedule
+        # (plan.ctx forces None there too — by construction)
+        relaxed_sched = resolve_schedule(
+            parity.relaxed_sync, cfg.n_layers,
+            off_mode=parity.relaxed_sync_mode) if plan.tp > 1 else None
+        if relaxed_sched is not None and \
+                all(m == "sync" for m in relaxed_sched):
+            relaxed_sched = None
+        if relaxed_sched is not None and plan.pp > 1:
+            # each pp stage traces only its local layer slice and the
+            # schedule indexes GLOBAL layers — refusing loudly beats a
+            # schedule that silently applies per-stage
+            raise ValueError(
+                "parallel.lowp.sync.schedule requires a flat layer "
+                "stack (pp=1); pipeline plans trace per-stage layer "
+                "slices the global schedule cannot index")
     else:
         rq_buckets = rq_gather = relaxed_codec = None
         relaxed_chunk = False
+        relaxed_sched = None
     ctx = plan.ctx(cfg, tp_overlap_chunks=(
         overlap.tp_chunks if overlap.enabled else 1),
         relaxed_codec=relaxed_codec,
-        relaxed_chunk_matmul=relaxed_chunk)
+        relaxed_chunk_matmul=relaxed_chunk,
+        relaxed_sync=relaxed_sched)
+    n_stale = sum(m == "stale" for m in (relaxed_sched or ()))
     specs = param_specs(cfg, plan)
     data_spec = P(("dp", "ep"), "sp")
 
@@ -368,6 +389,34 @@ def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
             rem = tuple(sorted(vma_of(loss)))
             if rem:
                 loss = jax.lax.psum(loss, rem)
+        return _tail(params, opt_state, loss, grads)
+
+    def body_sync(params, opt_state, tokens, targets, sync_state):
+        # stale sync schedule (parallel/lowp/syncpolicy.py): the step
+        # additionally carries the [pp, tp, n_stale, 2, B, S, D]
+        # correction state — the previous step's reduced residual
+        # corrections in, this step's out (stop-gradient: state is soft
+        # numerics, never part of the autodiff objective). Flat path
+        # only (pp plans are refused above).
+        st = sync_state.reshape(sync_state.shape[2:])
+
+        def loss_sync(p):
+            h, ns = forward_hidden(p, tokens, cfg, ctx, remat=remat,
+                                   sync_state=st)
+            return _loss_from_h(p, h, targets, cfg, ctx), ns
+
+        (loss, new_st), grads = jax.value_and_grad(
+            loss_sync, has_aux=True)(params)
+        rem = tuple(sorted(vma_of(loss)))
+        if rem:
+            loss = jax.lax.psum(loss, rem)
+        new_params, new_opt, metrics = _tail(params, opt_state, loss,
+                                             grads)
+        new_sync = jax.lax.stop_gradient(new_st).reshape(
+            sync_state.shape)
+        return new_params, new_opt, metrics, new_sync
+
+    def _tail(params, opt_state, loss, grads):
         grads = _reduce_grads(grads)
         loss = loss / loss_div
         gsq = _global_grad_sq_sliced(grads) if z1_scatter \
@@ -418,10 +467,46 @@ def make_train_step(cfg: ModelConfig, plan: MeshPlan, mesh: Mesh, *,
     else:
         z1_axes = z1_sizes = None
         opt_specs = AdamWState(count=P(), mu=specs, nu=specs)
+    metric_specs = {"loss": P(), "grad_norm": P()}
+    if n_stale:
+        # stale sync schedules carry the correction state through the
+        # step as an explicit donated operand: global layout
+        # [pp, tp, n_stale, 2(attn,mlp), B, S_eff, D] — the leading
+        # axes hold each rank's distinct partial-sum corrections, the
+        # batch/seq dims shard exactly like the data. The wrapper owns
+        # the buffer so every existing caller keeps the 4-arg step
+        # signature; a restart (or a batch-shape change) reinitializes
+        # it to zeros, which makes the next step behave as skip for
+        # exactly one step — soft state, deliberately not checkpointed.
+        state_spec = P("pp", "tp", None, None, ("dp", "ep"), "sp", None)
+        mapped = _smap(
+            body_sync, mesh,
+            in_specs=(specs, opt_specs, data_spec, data_spec,
+                      state_spec),
+            out_specs=(specs, opt_specs, metric_specs, state_spec))
+        jitted = jax.jit(mapped,
+                         donate_argnums=(0, 1, 4) if donate else ())
+        holder = {"shape": None, "state": None}
+
+        def step_with_sync_state(params, opt_state, tokens, targets):
+            if holder["shape"] != tuple(tokens.shape):
+                b, s = tokens.shape
+                s_eff = s // plan.tp if plan.megatron_sp else s
+                shp = (plan.pp, plan.tp, n_stale, 2, b, s_eff,
+                       cfg.d_model)
+                holder["state"] = jax.device_put(
+                    jnp.zeros(shp, cfg.jax_dtype),
+                    jax.sharding.NamedSharding(mesh, state_spec))
+                holder["shape"] = tuple(tokens.shape)
+            new_p, new_o, metrics, holder["state"] = jitted(
+                params, opt_state, tokens, targets, holder["state"])
+            return new_p, new_o, metrics
+
+        return step_with_sync_state
     mapped = _smap(
         body, mesh,
         in_specs=(specs, opt_specs, data_spec, data_spec),
-        out_specs=(specs, opt_specs, {"loss": P(), "grad_norm": P()}))
+        out_specs=(specs, opt_specs, metric_specs))
     return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
 
 

@@ -947,6 +947,52 @@ def test_guarded_weightplane_entry_points_are_clean(tmp_path):
     assert findings == []
 
 
+def test_unguarded_syncpolicy_entry_points_are_flagged(tmp_path):
+    """The partially-synchronized sync schedule's entry points
+    (parallel/lowp/syncpolicy.py) are relaxed-tier entry points: an
+    unguarded call would skip/stale TP activation syncs — rank-
+    divergent activations — for every parallel.parity=bitwise user."""
+    from hadoop_tpu.analysis import RelaxedGateChecker
+    findings = lint_source(tmp_path, """
+        from hadoop_tpu.parallel.lowp.syncpolicy import \\
+            scheduled_row_reduce
+
+        def reduce(y, ctx, entry):
+            return scheduled_row_reduce(y, ctx, entry)        # BAD
+
+        def skip(y, ctx):
+            from hadoop_tpu.parallel.lowp.syncpolicy import \\
+                skip_row_reduce
+            return skip_row_reduce(y, ctx)                    # BAD
+
+        def stale(y, ctx, corr):
+            from hadoop_tpu.parallel.lowp.syncpolicy import \\
+                stale_row_reduce
+            return stale_row_reduce(y, ctx, corr)             # BAD
+    """, [RelaxedGateChecker()])
+    assert len(findings) == 3
+    assert all(f.checker == "parity/relaxed-gated" for f in findings)
+
+
+def test_guarded_syncpolicy_entry_points_are_clean(tmp_path):
+    from hadoop_tpu.analysis import RelaxedGateChecker
+    findings = lint_source(tmp_path, """
+        def reduce(y, ctx, relaxed_sync):
+            from hadoop_tpu.parallel.lowp.syncpolicy import \\
+                scheduled_row_reduce
+            if relaxed_sync is not None and relaxed_sync.mode != "sync":
+                return scheduled_row_reduce(y, ctx, relaxed_sync)
+            return y
+
+        def plumbing(conf, n_layers):
+            # schedule parsing is tier plumbing, never flagged
+            from hadoop_tpu.parallel.lowp.syncpolicy import \\
+                resolve_schedule
+            return resolve_schedule("periodic:2", n_layers)
+    """, [RelaxedGateChecker()])
+    assert findings == []
+
+
 def test_lowp_package_itself_is_exempt(tmp_path):
     from hadoop_tpu.analysis import RelaxedGateChecker
     pkg = tmp_path / "hadoop_tpu" / "parallel" / "lowp"

@@ -724,16 +724,26 @@ def test_comm_runtime_capture_and_counters():
         record_comm("tp.psum", 100, 400)       # quantized: 4x bytes
         record_comm("tp.psum", 50, 200)        # two chunks, one site
         record_comm("not-a-real-site", 7, 7)   # unbounded-proof: other
+        # a site a sync schedule scheduled OFF: reference intact,
+        # payload 0, zero executed collectives (syncpolicy.py)
+        record_comm("tp.scatter", 0, 640, executions=0)
     # second execution: jit cache hit, no fresh records, profile reused
     with rt.step("t.step"):
         pass
     prof = rt.profile("t.step")
-    assert prof["tp.psum"] == (150, 600)
-    assert prof["other"] == (7, 7)
+    assert prof["tp.psum"] == (150, 600, 2)
+    assert prof["other"] == (7, 7, 1)
+    assert prof["tp.scatter"] == (0, 640, 0)
     rep = rt.report()
     assert rep["sites"]["tp.psum"]["payload_bytes"] == 300
     assert rep["sites"]["tp.psum"]["reference_bytes"] == 1200
+    assert rep["sites"]["tp.psum"]["executions"] == 4
     assert rep["sites"]["tp.psum"]["observations"] == 2
+    # the skipped site still observes per step but executes nothing —
+    # how the ledger proves collective-execution counts drop
+    assert rep["sites"]["tp.scatter"]["executions"] == 0
+    assert rep["sites"]["tp.scatter"]["observations"] == 2
+    assert rep["sites"]["tp.scatter"]["reference_bytes"] == 1280
     assert rep["steps"]["t.step"] == 2
     # records OUTSIDE any dispatch window are dropped (a bare test
     # trace is not a runtime step)
@@ -761,7 +771,7 @@ def test_comm_runtime_conf_gate():
     with rt.step("gated.step"):
         record_comm("bucket.psum", 10, 40)
     assert rt.report()["sites"] == {}
-    assert rt.profile("gated.step")["bucket.psum"] == (10, 40)
+    assert rt.profile("gated.step")["bucket.psum"] == (10, 40, 1)
     rt.set_enabled(True)
     with rt.step("gated.step"):
         pass
