@@ -24,7 +24,23 @@ end over real subprocess ranks:
 Contract failures are RECORDED in the returned dict (``failures``),
 not raised — run_all keeps its prior bench results either way.
 
-  python -m benchmarks.flight_smoke
+Fault injection rides ``hadoop_tpu.testing.faults`` (the flag-file
+API extracted from this smoke's original ad-hoc slow-file): the parent
+arms per-rank kill/delay-ms/hang flags, workers call ``apply_faults``
+once per step.
+
+The ELASTIC leg (``run_elastic`` / ``--elastic``) closes the loop the
+recorder only observes: a subprocess child trains a real zero1 dp=4
+job, a rank is slowed (delay-ms flag → demote: protective checkpoint)
+then KILLED (kill flag → evict), and the elastic controller reshards
+onto dp=3 via reshard-on-restore — finishing with the loss-curve A-B
+guard green against an uninterrupted dp=4 twin and strictly fewer
+lost steps than the restart-from-checkpoint baseline. Needs
+vma-tracking jax (the train step); no-vma boxes record
+``skipped(env: no-vma)``.
+
+  python -m benchmarks.flight_smoke             # recorder leg
+  python -m benchmarks.flight_smoke --elastic   # elastic leg
 """
 
 from __future__ import annotations
@@ -39,7 +55,7 @@ import time
 
 N_RANKS = 4
 SLOW_RANK = 2
-SLOW_SECONDS = 0.30
+DELAY_MS = 300
 STEP_PACE = 0.02
 
 
@@ -50,7 +66,7 @@ def worker_main(argv) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rank", type=int, required=True)
     ap.add_argument("--port-file", required=True)
-    ap.add_argument("--slow-file", required=True)
+    ap.add_argument("--faults-dir", required=True)
     ap.add_argument("--stop-file", required=True)
     ap.add_argument("--max-seconds", type=float, default=120.0)
     args = ap.parse_args(argv)
@@ -71,6 +87,7 @@ def worker_main(argv) -> int:
     from hadoop_tpu.obs.trainer import (TrainerStepMetrics,
                                         TrainerTelemetry)
     from hadoop_tpu.parallel.overlap import bucketed_psum
+    from hadoop_tpu.testing.faults import apply_faults
     from hadoop_tpu.tracing.tracer import global_tracer
 
     tracer = global_tracer()
@@ -104,8 +121,9 @@ def worker_main(argv) -> int:
             with rt.step("trainer.step"):
                 out = step(tree)
                 jax.block_until_ready(out)
-                if os.path.exists(args.slow_file):
-                    time.sleep(SLOW_SECONDS)   # the injection
+                # the injection seam: kill / delay-ms / hang flags the
+                # parent arms (hadoop_tpu/testing/faults.py)
+                apply_faults(args.faults_dir, args.rank)
         wall = time.monotonic() - t0
         metrics.steps.incr()
         metrics.step_wall.add(wall)
@@ -133,11 +151,13 @@ def run(quick: bool = False) -> dict:
         if not ok:
             out["failures"].append(what)
 
+    from hadoop_tpu.testing.faults import FaultInjector
+
     base = tempfile.mkdtemp(prefix="flight-smoke-")
-    slow_file = os.path.join(base, "slow")
+    faults_dir = os.path.join(base, "faults")
     stop_file = os.path.join(base, "stop")
-    with open(slow_file, "w") as f:
-        f.write("1")
+    inj = FaultInjector(faults_dir)
+    inj.inject(SLOW_RANK, "delay-ms", str(DELAY_MS))
     procs = []
     ports = {}
     doctor = None
@@ -146,12 +166,10 @@ def run(quick: bool = False) -> dict:
         env.pop("XLA_FLAGS", None)   # workers set their own device count
         for r in range(N_RANKS):
             pf = os.path.join(base, f"port-{r}")
-            sf = slow_file if r == SLOW_RANK else \
-                os.path.join(base, "never")
             procs.append(subprocess.Popen(
                 [sys.executable, "-m", "benchmarks.flight_smoke",
                  "--worker", "--rank", str(r), "--port-file", pf,
-                 "--slow-file", sf, "--stop-file", stop_file],
+                 "--faults-dir", faults_dir, "--stop-file", stop_file],
                 env=env, cwd=os.path.dirname(
                     os.path.dirname(os.path.abspath(__file__)))))
         deadline = time.monotonic() + 90.0
@@ -196,7 +214,7 @@ def run(quick: bool = False) -> dict:
               all(r.get("ok") for r in ranks.values()),
               f"roster incomplete or unhealthy: {ranks}")
         # -------- recovery: stop the injection, hysteresis must clear
-        os.remove(slow_file)
+        inj.clear(SLOW_RANK, "delay-ms")
         recovered_in = None
         for w in range(1, recovery_polls):
             time.sleep(window_s)
@@ -274,10 +292,207 @@ def run(quick: bool = False) -> dict:
     return out
 
 
+# ------------------------------------------------------------ elastic leg
+
+def _elastic_body() -> dict:
+    """The elastic acceptance loop, in a process that already holds an
+    8-virtual-device CPU mesh and vma-tracking jax.
+
+    Two arms over the same token stream, tiny config, global batch 12:
+
+    - reference: an uninterrupted zero1 dp=4 run of 36 steps;
+    - elastic: the same job wired to an ElasticController. Rank 2 is
+      slowed via the delay-ms flag at step 22 (→ demote: protective
+      checkpoint at the next streak threshold) and KILLED via the kill
+      flag at step 28 (→ evict: fence, shrink to the largest healthy
+      sub-mesh dp=3 — non-power-of-two — reshard-on-restore from the
+      protective snapshot, re-run the lost steps).
+
+    The doctor FEED is scripted from the armed fault flags (the real
+    FleetDoctor's detection path has its own leg above — this leg
+    proves the ACTUATION half end to end): flags → trainer verdicts in
+    the exact ``/ws/v1/fleet/doctor`` trainers shape the controller
+    polls in production.
+
+    Green means: loss-curve A-B guard ACCEPTED (elastic curve vs the
+    uninterrupted twin, per absolute step index) and strictly fewer
+    lost steps than restart-from-checkpoint (which would resume at the
+    last INTERVAL save; the demote's protective snapshot is fresher).
+    """
+    import shutil
+
+    import numpy as np
+
+    from hadoop_tpu.fs import LocalFileSystem
+    from hadoop_tpu.models import get_config
+    from hadoop_tpu.parallel import MeshPlan
+    from hadoop_tpu.parallel.checkpoint import list_checkpoints
+    from hadoop_tpu.parallel.elastic import ElasticConfig
+    from hadoop_tpu.parallel.lowp.guard import loss_curve_report
+    from hadoop_tpu.parallel.trainer import Trainer
+    from hadoop_tpu.testing.faults import FaultInjector
+
+    N_STEPS, BATCH, INTERVAL = 36, 12, 12
+    SLOW_AT, KILL_AT = 22, 28
+    out: dict = {"failures": []}
+
+    def check(ok: bool, what: str) -> None:
+        if not ok:
+            out["failures"].append(what)
+
+    base = tempfile.mkdtemp(
+        prefix="elastic-smoke-",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None)
+    try:
+        fs = LocalFileSystem()
+        cfg = get_config("tiny", max_seq=32)
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, cfg.vocab_size, 120_000, dtype=np.uint16)
+        data_path = os.path.join(base, "tokens.bin")
+        fs.write_all(data_path, toks.tobytes())
+        inj = FaultInjector(os.path.join(base, "faults"))
+
+        def poll_fn():
+            # scripted doctor feed: armed flags → the trainers section
+            # shape FleetDoctor.poll_once() serves (obs/doctor.py)
+            flagged, ranks = {}, {}
+            for r in range(4):
+                dead = inj.armed(r, "kill")
+                ranks[f"rank-{r}"] = {"ok": not dead, "rank": r,
+                                      "job": "elastic-smoke"}
+                if inj.armed(r, "delay-ms") and not dead:
+                    flagged[f"rank-{r}"] = {
+                        "node": f"rank-{r}",
+                        "signals": ["trainer.step_wall"]}
+            return {"trainers": {"flagged": flagged, "ranks": ranks}}
+
+        # -------- reference arm: uninterrupted dp=4
+        ref = Trainer(cfg, MeshPlan(dp=4), fs, data_path,
+                      os.path.join(base, "ckpt-ref"), batch=BATCH,
+                      lr=1e-2, zero1=True, ckpt_interval=INTERVAL)
+        ref.train(N_STEPS)
+        ref.wait_for_checkpoint()
+        ref_curve = [ref.loss_by_step[i] for i in range(1, N_STEPS + 1)]
+        ref.close()
+
+        # -------- elastic arm: slow → demote, kill → evict, reshard
+        econf = ElasticConfig(enabled=True, poll_steps=2, min_dp=1,
+                              demote_windows=2, evict_windows=6,
+                              dead_windows=1, cooldown_polls=2)
+        ckpt_dir = os.path.join(base, "ckpt-el")
+        tr = Trainer(cfg, MeshPlan(dp=4), fs, data_path, ckpt_dir,
+                     batch=BATCH, lr=1e-2, zero1=True,
+                     ckpt_interval=INTERVAL, elastic=econf,
+                     doctor_poll=poll_fn)
+        tr.train(SLOW_AT)
+        inj.inject(SLOW_RANK, "delay-ms", str(DELAY_MS))
+        tr.train(KILL_AT - tr.step)          # demote fires in here
+        inj.inject(SLOW_RANK, "kill")
+        t0 = time.monotonic()
+        tr.train(N_STEPS - tr.step)          # evict + reshard + replay
+        out["elastic_tail_seconds"] = round(time.monotonic() - t0, 2)
+        tr.wait_for_checkpoint()
+        el_curve = [tr.loss_by_step[i] for i in range(1, N_STEPS + 1)]
+
+        events = tr.elastic.events
+        by_kind = {}
+        for ev in events:
+            by_kind.setdefault(ev["decision"], []).append(ev)
+        out["events"] = [{k: ev[k] for k in ev
+                          if k not in ("config",)} for ev in events]
+        check(len(by_kind.get("demote", [])) == 1,
+              f"expected exactly one demote: {by_kind.keys()}")
+        check(len(by_kind.get("evict", [])) == 1,
+              f"expected exactly one evict: {by_kind.keys()}")
+        resumes = by_kind.get("resume", [])
+        check(len(resumes) == 1 and resumes[0]["restored"],
+              f"expected one restoring resume: {resumes}")
+        check(tr.plan.dp == 3,
+              f"largest healthy sub-mesh should be dp=3 (non-power-of-"
+              f"two), got {tr.plan}")
+        check(tr.step == N_STEPS, f"elastic arm ended at {tr.step}")
+
+        # lost steps: elastic resumes from the demote's protective
+        # snapshot; a restart-from-checkpoint baseline resumes from
+        # the newest INTERVAL save before the kill
+        if resumes:
+            evict_step = by_kind["evict"][0]["step"]
+            out["lost_steps"] = resumes[0]["lost_steps"]
+            out["resume_seconds"] = resumes[0]["resume_seconds"]
+            out["lost_steps_baseline"] = \
+                evict_step - (evict_step // INTERVAL) * INTERVAL
+            check(out["lost_steps"] < out["lost_steps_baseline"],
+                  f"elastic lost {out['lost_steps']} steps, restart "
+                  f"baseline loses {out['lost_steps_baseline']}")
+            # the baseline's interval checkpoint must really exist —
+            # the comparison is against a restartable state, not air
+            steps_on_disk = list_checkpoints(fs, ckpt_dir)
+            check((evict_step // INTERVAL) * INTERVAL in steps_on_disk,
+                  f"baseline interval checkpoint missing: "
+                  f"{steps_on_disk}")
+        out["evictions"] = len(by_kind.get("evict", []))
+
+        guard = loss_curve_report(ref_curve, el_curve, rel_tol=0.25)
+        out["guard"] = {k: guard[k] for k in
+                        ("accepted", "max_rel_div", "final_rel_div")
+                        if k in guard}
+        check(bool(guard.get("accepted")),
+              f"loss-curve guard rejected the elastic arm: {guard}")
+        tr.close()
+    except Exception as e:  # noqa: BLE001 — recorded for the
+        # trajectory, never a crash
+        out["failures"].append(f"{type(e).__name__}: {e}")
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    out["ok"] = not out["failures"]
+    return out
+
+
+def elastic_child_main() -> int:
+    """Subprocess entry: force the 8-device CPU mesh BEFORE jax loads,
+    then run the elastic body (or record the no-vma skip)."""
+    from __graft_entry__ import _force_cpu_devices
+    _force_cpu_devices(8)
+    import jax
+    if not hasattr(jax, "typeof"):
+        # this box's jax cannot trace the multichip train step (see
+        # __graft_entry__.dryrun precedent): record the skip, stay green
+        print("ELASTIC_SMOKE " + json.dumps(
+            {"skipped": "env: no-vma", "ok": True}))
+        return 0
+    print("ELASTIC_SMOKE " + json.dumps(_elastic_body()))
+    return 0
+
+
+def run_elastic(quick: bool = False, timeout_s: float = 900.0) -> dict:
+    """Parent wrapper for the elastic leg (run_all records, never
+    raises). ``quick`` is accepted for signature parity — the leg is
+    one fixed tiny scenario either way."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)   # the child sets its own device count
+    proc = subprocess.run(
+        [sys.executable, "-m", "benchmarks.flight_smoke",
+         "--elastic-child"],
+        capture_output=True, text=True, timeout=timeout_s, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    for line in proc.stdout.splitlines():
+        if line.startswith("ELASTIC_SMOKE "):
+            return json.loads(line[len("ELASTIC_SMOKE "):])
+    raise RuntimeError(
+        f"elastic smoke produced no record (rc={proc.returncode}): "
+        f"{proc.stderr.strip()[-2000:]}")
+
+
 def main() -> int:
     if "--worker" in sys.argv:
         argv = [a for a in sys.argv[1:] if a != "--worker"]
         return worker_main(argv)
+    if "--elastic-child" in sys.argv:
+        return elastic_child_main()
+    if "--elastic" in sys.argv:
+        result = run_elastic()
+        print(json.dumps(result, indent=2))
+        return 0 if result.get("ok") else 1
     result = run()
     print(json.dumps(result, indent=2))
     return 0 if result["ok"] else 1

@@ -82,6 +82,13 @@ _KEY_METRICS = {
     "trace_overhead": [(("step", "overhead_frac"), "overhead_frac")],
     "doctor": [(("windows_to_flag",), "windows_to_flag")],
     "flight_recorder": [(("windows_to_flag",), "windows_to_flag")],
+    # elastic training plane (parallel/elastic): the lever only counts
+    # as moving when the trajectory shows the eviction taken AND fewer
+    # steps lost than a restart-from-checkpoint would lose
+    "flight_elastic": [(("lost_steps",), "lost_steps"),
+                       (("lost_steps_baseline",), "lost_steps_baseline"),
+                       (("evictions",), "evictions"),
+                       (("resume_seconds",), "resume_seconds")],
     # partially-synchronized activations (parallel/lowp/syncpolicy):
     # the lever only counts as moving when the trajectory file shows
     # per-step collectives skipped AND the guard verdict next to them
@@ -295,6 +302,19 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — recorded for the
         # trajectory; must not discard the benches already computed
         out["flight_recorder"] = {"error": f"{type(e).__name__}: {e}"}
+    # Elastic training plane: slow→demote (protective snapshot), kill→
+    # evict onto the largest healthy sub-mesh (dp 4→3, non-power-of-two)
+    # with reshard-on-restore — loss-curve A-B guard vs an uninterrupted
+    # twin must ACCEPT and the elastic arm must lose strictly fewer
+    # steps than restart-from-checkpoint. On a no-vma jax the child
+    # records skipped(env: no-vma) and stays green. Recorded-not-raised.
+    try:
+        from benchmarks import flight_smoke
+        out["flight_elastic"] = flight_smoke.run_elastic(
+            quick=args.quick)
+    except Exception as e:  # noqa: BLE001 — recorded for the
+        # trajectory; must not discard the benches already computed
+        out["flight_elastic"] = {"error": f"{type(e).__name__}: {e}"}
     out["wall_seconds"] = round(time.perf_counter() - t0, 1)
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)

@@ -143,10 +143,16 @@ class TrainerTelemetry:
     def __init__(self, conf: Optional[Configuration] = None, *,
                  rank: int = 0, job: str = "train",
                  metrics: Optional[TrainerStepMetrics] = None,
-                 advertise_host: str = "127.0.0.1"):
+                 advertise_host: str = "127.0.0.1",
+                 elastic=None):
         self.conf = conf or Configuration(load_defaults=False)
         self.rank = int(rank)
         self.job = job
+        # elastic: a no-arg callable returning the elastic controller's
+        # report() block (parallel/elastic/controller.py) — rides
+        # /ws/v1/trainer so the fleet doctor (and an operator) can see
+        # demote/evict/resume decisions next to the step anatomy
+        self._elastic = elastic
         comm_runtime().configure(self.conf)
         self.metrics = metrics or TrainerStepMetrics(rank=self.rank)
         from hadoop_tpu.http import HttpServer
@@ -225,6 +231,12 @@ class TrainerTelemetry:
         out["job"] = self.job
         out["comm"] = comm_runtime().report()
         out["hbm"] = hbm_ledger().report()
+        if self._elastic is not None:
+            try:
+                out["elastic"] = self._elastic()
+            except Exception as e:  # noqa: BLE001 — a mid-reshard
+                # controller must not take the telemetry door down
+                out["elastic"] = {"error": f"{type(e).__name__}: {e}"}
         return 200, out
 
     def close(self) -> None:

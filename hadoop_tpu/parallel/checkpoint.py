@@ -86,7 +86,8 @@ def snapshot_tree(tree) -> List[Dict[str, Any]]:
 
 
 def write_snapshot(fs: FileSystem, base_dir: str, step: int,
-                   snap: List[Dict[str, Any]], *, keep: int = 3) -> str:
+                   snap: List[Dict[str, Any]], *, keep: int = 3,
+                   meta: Optional[Dict[str, Any]] = None) -> str:
     """Write a host snapshot as one checkpoint (see snapshot_tree).
 
     Publish protocol: shards are written straight into the final
@@ -98,12 +99,19 @@ def write_snapshot(fs: FileSystem, base_dir: str, step: int,
     files. A crash (or writer death) mid-write leaves a manifest-less
     directory that readers never see and the next save's retention
     sweep removes — which is exactly what makes the write safe to run
-    on a background thread."""
+    on a background thread.
+
+    ``meta``: an optional JSON block stored under ``manifest["meta"]``
+    — the elastic plane records the writing plan here
+    (``elastic.reshard.manifest_meta``) so a restore can tell whether
+    it must reshard. Manifests without it are legacy same-plan-only."""
     final_dir = f"{base_dir}/step_{step:012d}"
     fs.delete(final_dir, recursive=True)
     fs.mkdirs(final_dir)
 
     manifest: Dict[str, Any] = {"step": step, "leaves": {}, "shards": []}
+    if meta is not None:
+        manifest["meta"] = meta
     shard_idx = 0
     for entry in snap:
         mentry: Dict[str, Any] = {
@@ -156,14 +164,15 @@ def reorder_snapshot_axis0(snap: List[Dict[str, Any]], perm,
 
 
 def save_checkpoint(fs: FileSystem, base_dir: str, step: int, tree,
-                    *, keep: int = 3) -> str:
+                    *, keep: int = 3,
+                    meta: Optional[Dict[str, Any]] = None) -> str:
     """Write one checkpoint of ``tree`` (any pytree of jax/np arrays),
     synchronously: snapshot_tree + write_snapshot. Returns the final
     checkpoint directory. Retains the newest ``keep`` checkpoints (ref
     intent: FSImage's NNStorageRetentionManager keeps a bounded number
     of images)."""
     return write_snapshot(fs, base_dir, step, snapshot_tree(tree),
-                          keep=keep)
+                          keep=keep, meta=meta)
 
 
 class AsyncCheckpointWriter:
@@ -237,22 +246,38 @@ def _norm_index(index, shape):
     return tuple(out)
 
 
-def _retain(fs: FileSystem, base_dir: str, keep: int) -> None:
+def _retain(fs: FileSystem, base_dir: str, keep: int
+            ) -> List[Tuple[str, str]]:
+    """Retention sweep. Returns — and logs, one structured breadcrumb
+    per removal — every ``(path, reason)`` it swept, with reason
+    ``"retention"`` (a complete checkpoint aged past ``keep``) or
+    ``"crash-mid-write"`` (a manifest-less orphan from a crashed or
+    killed publish). An elastic resume that lands on an older snapshot
+    than expected is auditable from these lines alone."""
+    swept: List[Tuple[str, str]] = []
     steps = list_checkpoints(fs, base_dir)
     complete = {f"step_{s:012d}" for s in steps}
     for step in steps[:-keep] if keep > 0 else []:
-        fs.delete(f"{base_dir}/step_{step:012d}", recursive=True)
+        path = f"{base_dir}/step_{step:012d}"
+        fs.delete(path, recursive=True)
         complete.discard(f"step_{step:012d}")
+        swept.append((path, "retention"))
     # Sweep manifest-less orphans from crashed publishes (single-writer:
     # any incomplete step dir other than the one just written is ours).
     try:
         entries = fs.list_status(base_dir)
     except (IOError, OSError, FileNotFoundError):
-        return
+        entries = []
     for st in entries:
         name = st.path.rstrip("/").rsplit("/", 1)[-1]
         if name.startswith("step_") and name not in complete:
-            fs.delete(f"{base_dir}/{name}", recursive=True)
+            path = f"{base_dir}/{name}"
+            fs.delete(path, recursive=True)
+            swept.append((path, "crash-mid-write"))
+    for path, reason in swept:
+        log.info("checkpoint sweep: path=%s reason=%s keep=%d",
+                 path, reason, keep)
+    return swept
 
 
 def list_checkpoints(fs: FileSystem, base_dir: str) -> List[int]:
@@ -273,6 +298,14 @@ def list_checkpoints(fs: FileSystem, base_dir: str) -> List[int]:
 def latest_step(fs: FileSystem, base_dir: str) -> Optional[int]:
     steps = list_checkpoints(fs, base_dir)
     return steps[-1] if steps else None
+
+
+def read_manifest(fs: FileSystem, base_dir: str, step: int
+                  ) -> Dict[str, Any]:
+    """One checkpoint's manifest (the elastic restore path reads the
+    plan block before deciding how to load the shards)."""
+    path = f"{base_dir}/step_{step:012d}/manifest.json"
+    return json.loads(fs.read_all(path).decode())
 
 
 def load_checkpoint(fs: FileSystem, base_dir: str, like, *,

@@ -1,0 +1,116 @@
+"""Elastic training plane: doctor-driven eviction + reshard-on-restore.
+
+ISSUE 14 built the SENSING half of fleet-elastic training (per-rank
+step anatomy at ``/ws/v1/trainer``, the doctor's ``trainer.step_wall``
+median/MAD straggler detector, the trainer-job roster). This package is
+the ACTUATION half:
+
+- :mod:`hadoop_tpu.parallel.elastic.reshard` — checkpoints carry a
+  plan-describing manifest, and a snapshot written under mesh plan A
+  restores into a step built for plan B (ZeRO-1 optimizer slices and
+  pp stage shards reassembled to global layout on the host, re-sliced
+  for the target plan). Bit-identical when A == B; allclose across
+  plan changes.
+- :mod:`hadoop_tpu.parallel.elastic.controller` — a trainer-side loop
+  that polls the doctor's trainer verdicts and, on a flagged or dead
+  rank, fences the async checkpoint writer, picks the largest healthy
+  sub-mesh, rebuilds the train step for the shrunken plan, and resumes
+  from the last snapshot via reshard-on-restore — with hysteresis so
+  one noisy window never thrashes the mesh.
+
+Configuration keys (the ParityConfig/asdict self-describing precedent —
+:class:`ElasticConfig` round-trips through ``dataclasses.asdict`` so
+every decision event can embed the exact knobs that produced it):
+
+==============================  =======  ==================================
+key                             default  meaning
+==============================  =======  ==================================
+``elastic.enabled``             false    turn the controller on
+``elastic.poll.steps``          20       trainer steps between doctor polls
+``elastic.min-dp``              1        never shrink dp below this
+``elastic.demote.windows``      2        consecutive flagged polls before a
+                                         DEMOTE (protective checkpoint)
+``elastic.evict.windows``       4        consecutive flagged polls before a
+                                         slow rank is EVICTED
+``elastic.dead.windows``        2        consecutive dead polls before a
+                                         lost rank is evicted
+``elastic.cooldown.polls``      3        polls ignored after a resume
+                                         (hysteresis against thrash)
+==============================  =======  ==================================
+
+This module stays importable from jax-free processes (conf tooling, the
+doctor); the controller and reshard machinery import jax lazily.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+ELASTIC_KEY = "elastic.enabled"
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticConfig:
+    """Static elastic-plane knobs, fixed at trainer build time."""
+    enabled: bool = False
+    poll_steps: int = 20          # elastic.poll.steps
+    min_dp: int = 1               # elastic.min-dp
+    demote_windows: int = 2       # elastic.demote.windows
+    evict_windows: int = 4        # elastic.evict.windows
+    dead_windows: int = 2         # elastic.dead.windows
+    cooldown_polls: int = 3       # elastic.cooldown.polls
+
+    def __post_init__(self):
+        if self.poll_steps < 1:
+            raise ValueError("elastic.poll.steps must be >= 1, got "
+                             f"{self.poll_steps}")
+        if self.min_dp < 1:
+            raise ValueError(f"elastic.min-dp must be >= 1, got "
+                             f"{self.min_dp}")
+        if self.demote_windows < 1 or self.evict_windows < 1 or \
+                self.dead_windows < 1:
+            raise ValueError("elastic window thresholds must be >= 1")
+        if self.evict_windows <= self.demote_windows:
+            raise ValueError(
+                "elastic.evict.windows must exceed elastic.demote.windows "
+                "(a demote must get its protective checkpoint in before "
+                f"the evict fires): demote={self.demote_windows} "
+                f"evict={self.evict_windows}")
+        if self.cooldown_polls < 0:
+            raise ValueError("elastic.cooldown.polls must be >= 0")
+
+
+DEFAULT_ELASTIC = ElasticConfig()
+
+
+def elastic_from_conf(conf) -> ElasticConfig:
+    """Build an ElasticConfig from a Configuration (defaults above)."""
+    if conf is None:
+        return DEFAULT_ELASTIC
+    return ElasticConfig(
+        enabled=conf.get_bool(ELASTIC_KEY, False),
+        poll_steps=conf.get_int("elastic.poll.steps", 20),
+        min_dp=conf.get_int("elastic.min-dp", 1),
+        demote_windows=conf.get_int("elastic.demote.windows", 2),
+        evict_windows=conf.get_int("elastic.evict.windows", 4),
+        dead_windows=conf.get_int("elastic.dead.windows", 2),
+        cooldown_polls=conf.get_int("elastic.cooldown.polls", 3))
+
+
+def __getattr__(name):
+    # lazy: the controller/reshard modules import jax; this package's
+    # config surface must stay importable from jax-free processes
+    if name in ("ElasticController", "doctor_http_poll"):
+        from hadoop_tpu.parallel.elastic import controller as _c
+        return getattr(_c, name)
+    if name in ("manifest_meta", "plan_from_meta", "resolve_restore",
+                "check_reshardable", "reshard_opt_state"):
+        from hadoop_tpu.parallel.elastic import reshard as _r
+        return getattr(_r, name)
+    raise AttributeError(name)
+
+
+__all__ = ["ElasticConfig", "DEFAULT_ELASTIC", "ELASTIC_KEY",
+           "elastic_from_conf", "ElasticController", "doctor_http_poll",
+           "manifest_meta", "plan_from_meta", "resolve_restore",
+           "check_reshardable", "reshard_opt_state"]

@@ -1,0 +1,280 @@
+"""Trainer-side elastic controller: doctor verdicts → mesh actuation.
+
+The loop the sensing plane (ISSUE 14) was built for. Every
+``elastic.poll.steps`` trainer steps the controller reads the doctor's
+trainer verdicts (the ``trainers`` section of ``/ws/v1/fleet/doctor``
+— flagged stragglers from the step_wall median/MAD detector, dead
+ranks from the roster) and turns streaks into three decisions:
+
+- **DEMOTE** — a rank flagged ``elastic.demote.windows`` polls in a
+  row: write a protective checkpoint NOW, while the straggler is still
+  alive, so an eventual eviction resumes from here instead of the last
+  interval save. This is what makes the elastic plane lose strictly
+  fewer steps than restart-from-checkpoint: the protective snapshot is
+  always at least as fresh as the interval schedule's.
+- **EVICT** — flagged ``elastic.evict.windows`` polls, or dead (roster
+  ``ok=False``) ``elastic.dead.windows`` polls: fence the async
+  checkpoint writer, pick the largest healthy sub-mesh (largest dp'
+  ≤ healthy ranks that divides the global batch, ≥ ``elastic.min-dp``
+  — non-power-of-two shrinks like 8→6 included), and hand the trainer
+  the new plan. The trainer ends its step segment, rebuilds the train
+  step, and resumes from the newest snapshot via reshard-on-restore.
+- **RESUME** — the restore landed: record the lost-step count and wall
+  time, then hold ``elastic.cooldown.polls`` polls of hysteresis so
+  one noisy window after the reshard can't immediately thrash the
+  mesh again.
+
+Every decision is a structured event (the ElasticConfig that produced
+it rides along via ``dataclasses.asdict``) on the ``htpu_elastic_*``
+counter family and the trainer's ``/ws/v1/trainer`` elastic block.
+
+The poll itself is HOST-side work on a step-count cadence, outside the
+jitted step — the deliberate blocking the ``jit/blocking-in-step``
+lint annotations in the trainer loop mark.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from hadoop_tpu.metrics import metrics_system
+from hadoop_tpu.parallel.elastic import ElasticConfig
+from hadoop_tpu.parallel.mesh import MeshPlan
+
+log = logging.getLogger(__name__)
+
+MAX_EVENTS = 256   # bounded event ring for /ws/v1/trainer
+
+
+def doctor_http_poll(host: str, port: int,
+                     timeout: float = 5.0) -> Callable[[], Dict]:
+    """A poll_fn reading the fleet doctor's HTTP report — the
+    deployment wiring (in-process tests/smokes script their own)."""
+    from hadoop_tpu.http import http_get
+
+    def poll() -> Dict:
+        return json.loads(http_get(host, port, "/ws/v1/fleet/doctor",
+                                   timeout).decode())
+    return poll
+
+
+def pick_shrunken_plan(plan: MeshPlan, healthy: int, batch: int,
+                       min_dp: int) -> Optional[MeshPlan]:
+    """Largest healthy sub-mesh: shrink ONLY dp (tp/pp/ep/sp shape the
+    model math; dp is the replica axis eviction removes capacity from),
+    to the largest dp' ≤ healthy ranks with ``batch % (dp'*ep) == 0``
+    and dp' ≥ min_dp. Non-power-of-two shrinks (8→6, 4→3) are fine —
+    the reshard path never assumes power-of-two. None if no feasible
+    plan exists."""
+    for d in range(min(plan.dp, healthy), min_dp - 1, -1):
+        if d >= 1 and batch % (d * plan.ep) == 0:
+            return dataclasses.replace(plan, dp=d)
+    return None
+
+
+class ElasticController:
+    """Streak bookkeeping + decisions for one trainer.
+
+    ``trainer`` needs: ``.plan``, ``.step``, ``.batch``,
+    ``.save(wait=False)``, ``.apply_plan(plan) -> bool`` (the Trainer
+    contract; tests duck-type it). ``poll_fn`` returns the doctor
+    report dict (see :func:`doctor_http_poll`).
+    """
+
+    def __init__(self, trainer, cfg: ElasticConfig, *,
+                 poll_fn: Callable[[], Dict]):
+        if poll_fn is None:
+            raise ValueError("ElasticController needs a poll_fn (use "
+                             "doctor_http_poll for a live doctor)")
+        self.trainer = trainer
+        self.cfg = cfg
+        self._poll_fn = poll_fn
+        self._flagged_streak: Dict[str, int] = {}
+        self._dead_streak: Dict[str, int] = {}
+        self._demoted: set = set()
+        # ranks already evicted from the mesh: their roster rows linger
+        # (a dead rank's registry record only ages out) and must never
+        # re-trigger an eviction of capacity that is already gone
+        self._evicted_ranks: set = set()
+        self._cooldown = 0
+        self._pending_plan: Optional[MeshPlan] = None
+        self._pending_ranks: List[str] = []
+        self.events: List[Dict[str, Any]] = []
+        reg = metrics_system().source("elastic")
+        self._m_polls = reg.counter(
+            "polls", "doctor polls taken by the elastic controller",
+            prom_name="htpu_elastic_polls")
+        self._m_demotes = reg.counter(
+            "demotes", "protective checkpoints on flagged-rank streaks",
+            prom_name="htpu_elastic_demotes")
+        self._m_evictions = reg.counter(
+            "evictions", "ranks evicted from the mesh",
+            prom_name="htpu_elastic_evictions")
+        self._m_resumes = reg.counter(
+            "resumes", "reshard-on-restore resumes completed",
+            prom_name="htpu_elastic_resumes")
+        self._m_lost_steps = reg.counter(
+            "lost_steps", "steps re-run after elastic resumes",
+            prom_name="htpu_elastic_lost_steps")
+        self._m_resume_seconds = reg.counter(
+            "resume_seconds", "wall seconds spent in elastic resumes",
+            prom_name="htpu_elastic_resume_seconds")
+
+    # ------------------------------------------------------------ events
+
+    def _event(self, decision: str, step: int, **detail) -> Dict:
+        ev = {"decision": decision, "step": int(step),
+              "time": time.time(),
+              "config": dataclasses.asdict(self.cfg)}
+        ev.update(detail)
+        self.events.append(ev)
+        del self.events[:-MAX_EVENTS]
+        log.info("elastic %s at step %d: %s", decision, step,
+                 {k: v for k, v in detail.items()})
+        return ev
+
+    # ------------------------------------------------------------- polls
+
+    def on_step(self, step: int) -> bool:
+        """One cadence-gated poll+decide. Returns True when an evict
+        decision is pending — the trainer must end its step segment
+        and call :meth:`resume`."""
+        if self._pending_plan is not None:
+            return True
+        try:
+            report = self._poll_fn()
+        except Exception as e:  # noqa: BLE001 — an unreachable doctor
+            # must not kill training; the next poll retries
+            log.warning("elastic doctor poll failed: %s", e)
+            return False
+        self._m_polls.incr()
+        trainers = (report or {}).get("trainers") or {}
+        flagged = set(trainers.get("flagged") or ()) \
+            - self._evicted_ranks
+        roster = trainers.get("ranks") or {}
+        dead = {name for name, row in roster.items()
+                if not row.get("ok")} - self._evicted_ranks
+        for name in list(self._flagged_streak):
+            if name not in flagged:
+                self._flagged_streak.pop(name)
+                self._demoted.discard(name)
+        for name in flagged:
+            self._flagged_streak[name] = \
+                self._flagged_streak.get(name, 0) + 1
+        for name in list(self._dead_streak):
+            if name not in dead:
+                self._dead_streak.pop(name)
+        for name in dead:
+            self._dead_streak[name] = self._dead_streak.get(name, 0) + 1
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return False
+
+        evict = sorted(
+            {n for n, s in self._dead_streak.items()
+             if s >= self.cfg.dead_windows} |
+            {n for n, s in self._flagged_streak.items()
+             if s >= self.cfg.evict_windows})
+        if evict:
+            return self._decide_evict(step, evict, roster, dead)
+
+        for name in sorted(flagged):
+            if self._flagged_streak[name] >= self.cfg.demote_windows \
+                    and name not in self._demoted:
+                self._demoted.add(name)
+                self._demote(step, name)
+        return False
+
+    # --------------------------------------------------------- decisions
+
+    def _demote(self, step: int, rank: str) -> None:
+        """Protective checkpoint while the straggler is still alive:
+        the freshest possible resume point if the streak becomes an
+        eviction."""
+        self.trainer.save(wait=False)
+        self._m_demotes.incr()
+        self._event("demote", step, rank=rank,
+                    streak=self._flagged_streak.get(rank, 0),
+                    snapshot_step=int(step))
+
+    def _decide_evict(self, step: int, ranks: List[str], roster: Dict,
+                      dead: set) -> bool:
+        plan = self.trainer.plan
+        if roster:
+            healthy = sum(1 for name, row in roster.items()
+                          if row.get("ok") and name not in ranks)
+        else:
+            # static fleets may poll a doctor without a roster: assume
+            # one rank per dp slice and count the survivors
+            healthy = plan.dp - len(ranks)
+        new_plan = pick_shrunken_plan(plan, healthy, self.trainer.batch,
+                                      self.cfg.min_dp)
+        if new_plan is None:
+            self._event("evict-infeasible", step, ranks=ranks,
+                        healthy=healthy, plan=dataclasses.asdict(plan))
+            raise RuntimeError(
+                f"elastic eviction of {ranks} leaves {healthy} healthy "
+                f"ranks but no dp in [{self.cfg.min_dp}, {plan.dp}] "
+                f"divides batch={self.trainer.batch} (ep={plan.ep})")
+        self._m_evictions.incr(len(ranks))
+        self._event("evict", step, ranks=ranks, healthy=healthy,
+                    dead=sorted(dead),
+                    plan_from=dataclasses.asdict(plan),
+                    plan_to=dataclasses.asdict(new_plan))
+        self._pending_plan = new_plan
+        self._pending_ranks = list(ranks)
+        return True
+
+    def resume(self) -> bool:
+        """Apply the pending evict decision: fence, rebuild the train
+        step for the shrunken plan, reshard-on-restore from the newest
+        snapshot. Called by the trainer BETWEEN step segments (never
+        under a live prefetch thread). Returns whether a snapshot was
+        restored."""
+        plan = self._pending_plan
+        if plan is None:
+            return False
+        self._pending_plan = None
+        ranks, self._pending_ranks = self._pending_ranks, []
+        self._evicted_ranks.update(ranks)
+        step_before = int(self.trainer.step)
+        t0 = time.monotonic()
+        restored = self.trainer.apply_plan(plan)
+        resume_s = time.monotonic() - t0
+        lost = step_before - int(self.trainer.step) if restored \
+            else step_before
+        self._m_resumes.incr()
+        self._m_lost_steps.incr(int(lost))
+        self._m_resume_seconds.incr(int(round(resume_s)))
+        self._event("resume", self.trainer.step, ranks=ranks,
+                    restored=bool(restored), lost_steps=int(lost),
+                    resume_seconds=round(resume_s, 3),
+                    plan_to=dataclasses.asdict(plan))
+        self._cooldown = self.cfg.cooldown_polls
+        self._flagged_streak.clear()
+        self._dead_streak.clear()
+        self._demoted.clear()
+        return bool(restored)
+
+    @property
+    def pending(self) -> bool:
+        return self._pending_plan is not None
+
+    # ------------------------------------------------------------ report
+
+    def report(self) -> Dict[str, Any]:
+        """The ``/ws/v1/trainer`` elastic block."""
+        return {
+            "enabled": self.cfg.enabled,
+            "config": dataclasses.asdict(self.cfg),
+            "plan": dataclasses.asdict(self.trainer.plan),
+            "cooldown": self._cooldown,
+            "flagged_streaks": dict(self._flagged_streak),
+            "dead_streaks": dict(self._dead_streak),
+            "evicted_ranks": sorted(self._evicted_ranks),
+            "events": list(self.events[-32:]),
+        }

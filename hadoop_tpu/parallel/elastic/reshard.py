@@ -1,0 +1,249 @@
+"""Reshard-on-restore: one checkpoint serves any dp×tp fleet shape.
+
+Checkpoints already store PARAMS in global logical layout (shards are
+reassembled on load and re-placed under the target mesh — see
+``parallel/checkpoint.py``), so a param tree restores into any plan for
+free. The plan-locked leaves are the ZeRO-1 optimizer moments: a state
+leaf is a ``(*spec_axis_sizes, *data_axis_sizes, K)`` array whose very
+SHAPE bakes in the plan that wrote it (the slice layout defined once by
+``overlap.zero1_slice_meta``). This module converts those leaves
+through the canonical intermediate form — the global param-shaped
+moment array — so a snapshot written under plan A restores into a step
+built for plan B:
+
+    plan-A state ──(slice layout A)──▶ global moments
+                 ──(slice layout B)──▶ plan-B state
+
+The conversion is exact on the real (non-padding) region: every slice
+segment lands at the flattened-param offset the mixed-radix rank index
+(``overlap.zero1_slice_index``) assigns it, and the padding tail is
+zeros by construction (grads are zero-padded, so moments never leave
+zero there). Plain-AdamW moments ARE global moment arrays, so the same
+two maps also convert zero1 ⇄ non-zero1 restores (one side is the
+identity).
+
+Refused loudly: pp/vpp stage-count changes. A pp resize re-stacks which
+layers share a stage (and an interleaved checkpoint persists ZeRO-1
+state in PHYSICAL layer order while params are logical — see
+``Trainer._vpp_snapshot_reorder``), so there is no host-side relayout
+that preserves the optimizer trajectory; restore under the saved pp,
+re-save, then change plans.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from hadoop_tpu.parallel.mesh import AXES, MeshPlan
+
+# manifest["meta"]["format"] for plan-bearing checkpoints; bumping it is
+# a layout break (readers refuse formats they don't know)
+MANIFEST_FORMAT = "htpu-ckpt-plan-1"
+
+
+# ------------------------------------------------------------- manifest
+
+def manifest_meta(plan: MeshPlan, *, zero1: bool) -> Dict[str, Any]:
+    """The plan-describing manifest block a checkpoint writer embeds."""
+    return {"format": MANIFEST_FORMAT,
+            "zero1": bool(zero1),
+            "plan": dataclasses.asdict(plan)}
+
+
+def plan_from_meta(meta: Dict[str, Any]) -> MeshPlan:
+    if meta.get("format") != MANIFEST_FORMAT:
+        raise ValueError(
+            f"unknown checkpoint meta format {meta.get('format')!r} "
+            f"(this reader understands {MANIFEST_FORMAT!r})")
+    return MeshPlan(**meta["plan"])
+
+
+def resolve_restore(manifest: Dict[str, Any], plan: MeshPlan,
+                    zero1: bool) -> Tuple[str, Optional[MeshPlan], bool]:
+    """Classify a restore against the manifest's plan block.
+
+    Returns ``(mode, saved_plan, saved_zero1)`` with mode one of:
+
+    - ``"same-plan"`` — saved and target plans match exactly; the
+      restore takes the direct placement path (bit-identical).
+    - ``"reshard"`` — plans differ; the restore goes through the
+      host-side global relayout (allclose, not bitwise).
+    - ``"legacy"`` — manifest predates the plan block; the restore
+      proceeds as same-plan (all a legacy manifest can support) with a
+      DeprecationWarning.
+    """
+    meta = manifest.get("meta")
+    if not meta or "plan" not in meta:
+        warnings.warn(
+            "checkpoint manifest has no plan block (written before the "
+            "elastic plane); restoring as same-plan — re-save to make "
+            "this checkpoint reshardable", DeprecationWarning,
+            stacklevel=2)
+        return "legacy", None, zero1
+    saved_plan = plan_from_meta(meta)
+    saved_zero1 = bool(meta.get("zero1", False))
+    if saved_plan == plan and saved_zero1 == zero1:
+        return "same-plan", saved_plan, saved_zero1
+    check_reshardable(saved_plan, plan)
+    return "reshard", saved_plan, saved_zero1
+
+
+def check_reshardable(plan_a: MeshPlan, plan_b: MeshPlan) -> None:
+    """Refuse plan changes reshard-on-restore cannot express."""
+    if plan_a.pp != plan_b.pp or plan_a.vpp != plan_b.vpp:
+        raise ValueError(
+            "reshard-on-restore cannot change the pipeline stage count: "
+            f"checkpoint written under pp={plan_a.pp} vpp={plan_a.vpp}, "
+            f"target plan has pp={plan_b.pp} vpp={plan_b.vpp}. A pp "
+            "resize re-stacks which layers share a stage (and ZeRO-1 "
+            "state under vpp is persisted in physical layer order), so "
+            "no host relayout preserves the optimizer trajectory — "
+            "restore under the saved pp, re-save, then change plans.")
+
+
+# ---------------------------------------------------- slice-layout math
+
+def _plan_sizes(plan: MeshPlan) -> Dict[str, int]:
+    return dict(zip(AXES, (plan.dp, plan.pp, plan.tp, plan.ep, plan.sp)))
+
+
+def _sharded_dims(spec):
+    """``[(dim, [axes...]), ...]`` for a PartitionSpec's sharded dims,
+    in order of appearance — matches ``train._spec_axes_ordered`` so
+    state-leaf leading dims line up with coordinate enumeration."""
+    out = []
+    for d, part in enumerate(spec):
+        if part is None:
+            continue
+        axes = list(part) if isinstance(part, tuple) else [part]
+        out.append((d, axes))
+    return out
+
+
+def _block_slices(coords, sharded, shape, sizes):
+    """Global-array slices selecting the shard at spec coords
+    (``coords`` ordered like the state leaf's leading dims)."""
+    sl = [slice(None)] * len(shape)
+    it = iter(coords)
+    for d, axes in sharded:
+        idx, n = 0, 1
+        for a in axes:
+            idx = idx * sizes[a] + next(it)
+            n *= sizes[a]
+        bl = shape[d] // n
+        sl[d] = slice(idx * bl, (idx + 1) * bl)
+    return tuple(sl)
+
+
+def _leaf_geometry(spec, shape, plan: MeshPlan):
+    """(sharded dims, spec axis sizes, z axis sizes, Z, K, local size)
+    for one leaf under one plan — the host-side mirror of
+    ``train.zero1_layout`` / ``overlap.zero1_slice_meta``."""
+    sizes = _plan_sizes(plan)
+    sharded = _sharded_dims(spec)
+    spec_ax = [a for _, axes in sharded for a in axes]
+    for d, axes in sharded:
+        n = int(np.prod([sizes[a] for a in axes]))
+        if shape[d] % n:
+            raise ValueError(
+                f"leaf dim {d} of shape {shape} not divisible by its "
+                f"mesh axes {axes} (sizes {sizes})")
+    spec_sizes = tuple(sizes[a] for a in spec_ax)
+    z_ax = tuple(a for a in plan.batch_axes if a not in spec_ax)
+    z_sizes = tuple(sizes[a] for a in z_ax)
+    z = int(np.prod(z_sizes)) if z_sizes else 1
+    denom = int(np.prod(spec_sizes)) if spec_sizes else 1
+    local = max(1, int(np.prod(shape)) // denom) if shape else 1
+    k = (local + z - 1) // z
+    return sharded, sizes, spec_sizes, z_sizes, z, k, local
+
+
+def zero1_state_to_global(state, spec, global_shape,
+                          plan: MeshPlan) -> np.ndarray:
+    """One ZeRO-1 moment leaf (plan layout) → the global param-shaped
+    f32 moment array. Exact: every slice segment is written back at
+    the flattened offset the mixed-radix rank index assigned it."""
+    state = np.asarray(state)
+    global_shape = tuple(global_shape)
+    sharded, sizes, spec_sizes, z_sizes, z, k, local = \
+        _leaf_geometry(spec, global_shape, plan)
+    want = spec_sizes + z_sizes + (k,)
+    if tuple(state.shape) != want:
+        raise ValueError(
+            f"zero1 state leaf shape {tuple(state.shape)} does not "
+            f"match plan layout {want} (global {global_shape})")
+    out = np.empty(global_shape, np.float32)
+    for coords in np.ndindex(*spec_sizes):
+        sl = _block_slices(coords, sharded, global_shape, sizes)
+        block_shape = out[sl].shape
+        # (z..., K) segments concatenate, row-major over the data axes,
+        # into the zero-padded flattened shard — drop the pad tail
+        flat = state[coords].reshape(-1)[:local].astype(np.float32)
+        out[sl] = flat.reshape(block_shape)
+    return out
+
+
+def global_to_zero1_state(garr, spec, plan: MeshPlan) -> np.ndarray:
+    """The global param-shaped moment array → one ZeRO-1 moment leaf in
+    ``plan``'s layout (inverse of :func:`zero1_state_to_global`; the
+    padding tail is zero, matching what training writes there)."""
+    garr = np.asarray(garr, np.float32)
+    sharded, sizes, spec_sizes, z_sizes, z, k, local = \
+        _leaf_geometry(spec, garr.shape, plan)
+    out = np.zeros(spec_sizes + z_sizes + (k,), np.float32)
+    for coords in np.ndindex(*spec_sizes):
+        sl = _block_slices(coords, sharded, garr.shape, sizes)
+        flat = garr[sl].reshape(-1)
+        pad = z * k - flat.size
+        if pad:
+            flat = np.pad(flat, (0, pad))
+        out[coords] = flat.reshape(z_sizes + (k,))
+    return out
+
+
+def reshard_zero1_leaf(state, spec, global_shape, plan_a: MeshPlan,
+                       plan_b: MeshPlan) -> np.ndarray:
+    """Plan-A moment leaf → plan-B moment leaf, through global layout."""
+    return global_to_zero1_state(
+        zero1_state_to_global(state, spec, global_shape, plan_a),
+        spec, plan_b)
+
+
+# --------------------------------------------------------- whole trees
+
+def reshard_opt_state(opt, params_shapes, specs, plan_a: MeshPlan,
+                      plan_b: MeshPlan, *, zero1_a: bool, zero1_b: bool):
+    """Convert a host AdamWState between plan layouts.
+
+    ``opt`` is the loaded host optimizer state (mu/nu trees in plan A's
+    layout); ``params_shapes`` a matching pytree of GLOBAL param shapes
+    (tuples or arrays — only ``np.shape`` is read); ``specs`` the
+    ``mesh.param_specs`` tree (plan-independent). Same plan AND same
+    zero1 flag returns ``opt`` untouched — the bit-identical path.
+    """
+    import jax
+
+    check_reshardable(plan_a, plan_b)
+    if plan_a == plan_b and zero1_a == zero1_b:
+        return opt
+
+    def leaf(m, shape_like, spec):
+        gshape = tuple(np.shape(shape_like))
+        if zero1_a:
+            g = zero1_state_to_global(m, spec, gshape, plan_a)
+        else:
+            g = np.asarray(m, np.float32)
+            if g.shape != gshape:
+                raise ValueError(f"moment shape {g.shape} != param "
+                                 f"shape {gshape}")
+        if zero1_b:
+            return global_to_zero1_state(g, spec, plan_b)
+        return g
+
+    mu = jax.tree_util.tree_map(leaf, opt.mu, params_shapes, specs)
+    nu = jax.tree_util.tree_map(leaf, opt.nu, params_shapes, specs)
+    return type(opt)(np.asarray(opt.count), mu, nu)

@@ -26,9 +26,11 @@ from hadoop_tpu.models.config import ModelConfig
 from hadoop_tpu.tracing.tracer import global_tracer
 from hadoop_tpu.parallel.checkpoint import (AsyncCheckpointWriter,
                                             latest_step, load_checkpoint,
+                                            read_manifest,
                                             reorder_snapshot_axis0,
                                             snapshot_tree, write_snapshot)
 from hadoop_tpu.parallel.data import TokenDataset
+from hadoop_tpu.parallel.elastic import ElasticConfig
 from hadoop_tpu.parallel.mesh import MeshPlan, make_mesh, param_specs
 from hadoop_tpu.parallel.lowp import ParityConfig
 from hadoop_tpu.parallel.overlap import DEFAULT_OVERLAP, OverlapConfig
@@ -50,26 +52,22 @@ class Trainer:
                  pipeline_schedule: str = "1f1b",
                  overlap: Optional[OverlapConfig] = None,
                  parity: Optional[ParityConfig] = None,
-                 async_ckpt: bool = True, rank: int = 0):
+                 async_ckpt: bool = True, rank: int = 0,
+                 elastic: Optional[ElasticConfig] = None,
+                 doctor_poll=None):
         self.cfg, self.plan, self.fs = cfg, plan, fs
         self.ckpt_dir = ckpt_dir
         self.ckpt_interval = ckpt_interval
         self.keep = keep
-        self.mesh = make_mesh(plan)
-        if n_microbatches is None:
-            # pipeline plans need M > 1 (interleaved REQUIRES pp | M;
-            # plain 1F1B with M=1 is a full bubble); single-stage plans
-            # run unsplit
-            n_microbatches = max(1, plan.pp * getattr(plan, "vpp", 1))
-        plan.validate(cfg, batch, cfg.max_seq,
-                      n_microbatches=n_microbatches)
-        self.step_fn = make_train_step(
-            cfg, plan, self.mesh, lr=lr, optimizer=optimizer,
-            zero1=zero1, remat=remat, donate=False,
-            n_microbatches=n_microbatches,
+        self.batch = batch
+        self.zero1 = zero1 and optimizer == "adamw"
+        # everything the train step's build needs, kept so apply_plan
+        # (the elastic reshard seam) can rebuild for a different plan
+        self._n_microbatches_arg = n_microbatches
+        self._build_kwargs = dict(
+            lr=lr, optimizer=optimizer, zero1=zero1, remat=remat,
             pipeline_schedule=pipeline_schedule, overlap=overlap,
             parity=parity)
-        self.zero1 = zero1 and optimizer == "adamw"
         # parallel.ckpt.async: save() blocks only for the host snapshot;
         # the DFS write (and the vpp logical reorder) runs on a
         # background writer fenced at the next save / restore /
@@ -78,11 +76,24 @@ class Trainer:
         self._ckpt_writer = AsyncCheckpointWriter()
         self.data = TokenDataset(fs, data_path, batch=batch,
                                  seq=cfg.max_seq, dtype=data_dtype)
-        self.data_sharding = make_data_sharding(self.mesh)
-        self.params, self.opt = init_sharded(
-            jax.random.PRNGKey(0), cfg, plan, self.mesh, zero1=self.zero1)
+        self._build_for_plan(plan)
         self.step = 0
         self.losses: list = []
+        # latest loss per ABSOLUTE step index: under the elastic plane
+        # a resume rewinds and re-runs steps, so self.losses alone can
+        # carry duplicates; this map always holds one (the newest)
+        # loss per step — what the loss-curve A-B guard compares.
+        self.loss_by_step: Dict[int, float] = {}
+        # elastic controller (parallel/elastic): polls the doctor's
+        # trainer verdicts every elastic.poll.steps steps and, on a
+        # flagged/dead rank, hands train() a shrunken plan to resume
+        # under via apply_plan + reshard-on-restore.
+        self.elastic = None
+        if elastic is not None and elastic.enabled:
+            from hadoop_tpu.parallel.elastic.controller import \
+                ElasticController
+            self.elastic = ElasticController(self, elastic,
+                                             poll_fn=doctor_poll)
         # Step anatomy as a LIVE surface (profile_train's one-shot
         # accounting, always on): /jmx and /prom see exactly where a
         # step's wall time goes — data wait vs dispatched step vs the
@@ -139,6 +150,53 @@ class Trainer:
         # the dataset directly.
         self._inflight_cursor: Optional[Dict] = None
 
+    def _build_for_plan(self, plan: MeshPlan) -> None:
+        """Mesh + step_fn + data sharding + fresh sharded state for one
+        plan — the slice of construction ``apply_plan`` re-runs when
+        the elastic controller shrinks the mesh."""
+        kw = self._build_kwargs
+        n_microbatches = self._n_microbatches_arg
+        if n_microbatches is None:
+            # pipeline plans need M > 1 (interleaved REQUIRES pp | M;
+            # plain 1F1B with M=1 is a full bubble); single-stage plans
+            # run unsplit
+            n_microbatches = max(1, plan.pp * getattr(plan, "vpp", 1))
+        plan.validate(self.cfg, self.batch, self.cfg.max_seq,
+                      n_microbatches=n_microbatches)
+        self.plan = plan
+        self.mesh = make_mesh(plan)
+        self.step_fn = make_train_step(
+            self.cfg, plan, self.mesh, lr=kw["lr"],
+            optimizer=kw["optimizer"], zero1=kw["zero1"],
+            remat=kw["remat"], donate=False,
+            n_microbatches=n_microbatches,
+            pipeline_schedule=kw["pipeline_schedule"],
+            overlap=kw["overlap"], parity=kw["parity"])
+        self.data_sharding = make_data_sharding(self.mesh)
+        self.params, self.opt = init_sharded(
+            jax.random.PRNGKey(0), self.cfg, plan, self.mesh,
+            zero1=self.zero1)
+
+    def apply_plan(self, new_plan: MeshPlan) -> bool:
+        """Rebuild this trainer for a new mesh plan and resume from the
+        newest snapshot via reshard-on-restore (the elastic
+        controller's actuation seam; callable directly for a manual
+        reshard). Must not run under a live train() segment — the
+        prefetch thread shares the dataset. Returns whether a
+        checkpoint was restored; without one the state is freshly
+        initialized and the step count restarts at 0."""
+        self._ckpt_writer.wait()   # fence: an in-flight write lands
+        #                            before the plan that wrote it dies
+        old_step = self.step
+        self._build_for_plan(new_plan)
+        restored = self.try_restore()
+        if not restored:
+            self.step = 0
+            log.warning("apply_plan(%s): no checkpoint to restore; "
+                        "reinitialized from step 0 (was step %d)",
+                        new_plan, old_step)
+        return restored
+
     # -------------------------------------------------------- persistence
 
     def _state_tree(self):
@@ -187,6 +245,12 @@ class Trainer:
             self.keep
         reorder = self._vpp_snapshot_reorder()
         m_write, tracer = self._m_ckpt_write, self._tracer
+        # the manifest carries the writing plan (captured NOW — the
+        # elastic controller may swap self.plan before the background
+        # write lands) so a restore under any other plan knows to go
+        # through the host-side reshard
+        from hadoop_tpu.parallel.elastic.reshard import manifest_meta
+        meta = manifest_meta(self.plan, zero1=self.zero1)
 
         def write():
             # the writer thread carries the submitter's context
@@ -196,7 +260,7 @@ class Trainer:
                 t_w = time.monotonic()
                 path = write_snapshot(fs, ckpt_dir, step,
                                       reorder(snap) if reorder else snap,
-                                      keep=keep)
+                                      keep=keep, meta=meta)
                 m_write.add(time.monotonic() - t_w)
                 wsp.add_kv("step", str(step))
             log.info("checkpoint step %d -> %s", step, path)
@@ -253,12 +317,8 @@ class Trainer:
         from hadoop_tpu.obs.hbm import hbm_ledger
         hbm_ledger().unregister_prefix(self._hbm_owner)
 
-    def try_restore(self) -> bool:
-        """Resume from the newest complete checkpoint, if any."""
-        self._ckpt_writer.wait()  # a restore must see the newest save
-        step = latest_step(self.fs, self.ckpt_dir)
-        if step is None:
-            return False
+    def _target_spec_tree(self):
+        """Placement specs for the CURRENT plan's state tree."""
         specs = param_specs(self.cfg, self.plan)
         if self.zero1:
             _, _, z1_specs, _ = zero1_layout(self.cfg, self.plan)
@@ -268,13 +328,37 @@ class Trainer:
         else:
             opt_specs = AdamWState(
                 count=jax.sharding.PartitionSpec(), mu=specs, nu=specs)
-        like = dict(self._state_tree(),
-                    data_pos=jnp.zeros((2,), jnp.int32))
-        spec_tree = {"params": specs, "opt": opt_specs,
-                     "data_pos": jax.sharding.PartitionSpec()}
-        tree, got = load_checkpoint(self.fs, self.ckpt_dir, like,
-                                    step=step, mesh=self.mesh,
-                                    specs=spec_tree)
+        return {"params": specs, "opt": opt_specs,
+                "data_pos": jax.sharding.PartitionSpec()}
+
+    def try_restore(self) -> bool:
+        """Resume from the newest complete checkpoint, if any.
+
+        Reads the manifest's plan block first: a snapshot written
+        under a DIFFERENT mesh plan restores through the host-side
+        reshard (parallel/elastic/reshard.py — ZeRO-1 slices
+        reassembled to global moments and re-sliced for this plan); a
+        matching plan takes the direct placement path, bit-identical
+        to what was saved; a legacy manifest (no plan block) restores
+        as same-plan with a DeprecationWarning."""
+        self._ckpt_writer.wait()  # a restore must see the newest save
+        step = latest_step(self.fs, self.ckpt_dir)
+        if step is None:
+            return False
+        from hadoop_tpu.parallel.elastic.reshard import resolve_restore
+        manifest = read_manifest(self.fs, self.ckpt_dir, step)
+        mode, saved_plan, saved_zero1 = resolve_restore(
+            manifest, self.plan, self.zero1)
+        spec_tree = self._target_spec_tree()
+        if mode == "reshard":
+            tree, got = self._load_resharded(step, saved_plan,
+                                             saved_zero1, spec_tree)
+        else:
+            like = dict(self._state_tree(),
+                        data_pos=jnp.zeros((2,), jnp.int32))
+            tree, got = load_checkpoint(self.fs, self.ckpt_dir, like,
+                                        step=step, mesh=self.mesh,
+                                        specs=spec_tree)
         self.params, self.opt = tree["params"], tree["opt"]
         if getattr(self.plan, "vpp", 1) > 1:
             from hadoop_tpu.parallel.train import physical_layer_order
@@ -293,6 +377,49 @@ class Trainer:
         log.info("restored step %d from %s", got, self.ckpt_dir)
         return True
 
+    def _load_resharded(self, step: int, saved_plan: MeshPlan,
+                        saved_zero1: bool, spec_tree):
+        """Cross-plan restore: assemble the snapshot to HOST arrays in
+        the saved plan's layout (params and pp stage shards come back
+        global for free — the manifest stores global logical shapes),
+        convert the optimizer moments through global layout for this
+        plan (elastic/reshard.py), then place everything under the
+        target mesh. Returns ``(tree, step)`` like load_checkpoint."""
+        from jax.sharding import NamedSharding
+        from hadoop_tpu.parallel.elastic.reshard import reshard_opt_state
+        sds = jax.ShapeDtypeStruct
+        pshapes = jax.tree_util.tree_map(
+            lambda p: sds(p.shape, p.dtype), self.params)
+        if saved_zero1:
+            _, shape_tree, _, _ = zero1_layout(self.cfg, saved_plan)
+            # shape_tree's leaves are shape TUPLES — without is_leaf,
+            # tree_map would descend into them int by int
+            moments = jax.tree_util.tree_map(
+                lambda s: sds(tuple(s), jnp.float32), shape_tree,
+                is_leaf=lambda s: isinstance(s, tuple))
+        else:
+            moments = jax.tree_util.tree_map(
+                lambda p: sds(p.shape, jnp.float32), self.params)
+        like = {"params": pshapes,
+                "opt": AdamWState(count=sds((), jnp.int32), mu=moments,
+                                  nu=moments),
+                "data_pos": sds((2,), jnp.int32)}
+        tree, got = load_checkpoint(self.fs, self.ckpt_dir, like,
+                                    step=step)
+        opt = reshard_opt_state(
+            tree["opt"], self.params, param_specs(self.cfg, self.plan),
+            saved_plan, self.plan, zero1_a=saved_zero1,
+            zero1_b=self.zero1)
+
+        def place(x, s):
+            return jax.device_put(x, NamedSharding(self.mesh, s))
+
+        return {"params": jax.tree_util.tree_map(
+                    place, tree["params"], spec_tree["params"]),
+                "opt": jax.tree_util.tree_map(
+                    place, opt, spec_tree["opt"]),
+                "data_pos": tree["data_pos"]}, got
+
     # -------------------------------------------------------------- train
 
     # In-flight step bound: losses older than this are forced to host,
@@ -304,14 +431,38 @@ class Trainer:
     MAX_INFLIGHT = 16
 
     def train(self, n_steps: int) -> list:
-        """Run ``n_steps`` more steps; returns their losses.
+        """Run ``n_steps`` more steps; returns the losses of every step
+        executed.
 
         The dataloader runs in a background prefetch thread (DFS read +
         host→device transfer overlap the device step); each prefetched
         batch carries the dataset cursor as of ITS production, and the
         checkpoint cursor tracks the last batch a completed step
         consumed — so a mid-run save resumes bit-exactly even with
-        batches in flight."""
+        batches in flight.
+
+        Under the elastic plane the target is ABSOLUTE: an eviction
+        ends the running step segment (the prefetch thread drains and
+        the dataset cursor rewinds first), the controller reshards onto
+        the shrunken plan, and the loop re-runs the steps lost since
+        the restored snapshot — the call still returns with
+        ``self.step == start + n_steps``. The returned list includes
+        re-run steps; ``self.loss_by_step`` keeps exactly one (the
+        newest) loss per step index."""
+        if self.elastic is None:
+            return self._train_segment(n_steps)
+        target = self.step + n_steps
+        out: list = []
+        while self.step < target:
+            out.extend(self._train_segment(target - self.step))
+            if self.elastic.pending:
+                self.elastic.resume()
+        return out
+
+    def _train_segment(self, n_steps: int) -> list:
+        """One uninterrupted run of the step loop (train() without the
+        elastic replan seam). Ends early only when the elastic
+        controller marks an eviction pending."""
         zombie = getattr(self, "_zombie_producer", None)
         if zombie is not None:
             if zombie.is_alive():
@@ -392,17 +543,19 @@ class Trainer:
                             self.params, self.opt, tokens, targets)
                         self.step += 1
                         self._inflight_cursor = cursor
-                        pending.append(metrics["loss"])
+                        pending.append((self.step, metrics["loss"]))
                         # materialize as they age out so self.losses
                         # stays current even if a later step raises;
                         # this float() is the DELIBERATE bounded-in-
                         # flight backpressure sync (see MAX_INFLIGHT
                         # above), not a stray stall
                         while len(pending) > self.MAX_INFLIGHT:
+                            s, dev = pending.popleft()
                             val = float(  # lint: disable=jit/blocking-in-step
-                                pending.popleft())
+                                dev)
                             out.append(val)
                             self.losses.append(val)
+                            self.loss_by_step[s] = val
                     if self.ckpt_interval and \
                             self.step % self.ckpt_interval == 0:
                         # interval saves ride the background writer:
@@ -417,6 +570,17 @@ class Trainer:
                 step_wall = time.monotonic() - t_step
                 self._m_step_wall.add(step_wall)
                 self._m_step_wall_hist.add(step_wall)
+                if self.elastic is not None and \
+                        self.step % self.elastic.cfg.poll_steps == 0:
+                    # DELIBERATE host-side doctor poll, cadence-gated
+                    # and outside the jitted step: the elastic plane's
+                    # sensing seam (an HTTP read of
+                    # /ws/v1/fleet/doctor, never per-step)
+                    if self.elastic.on_step(self.step):
+                        # evict pending: end this segment so the
+                        # prefetch thread drains and the cursor
+                        # rewinds before the mesh is rebuilt
+                        break
         except BaseException:
             step_failed = True
             raise
@@ -426,12 +590,14 @@ class Trainer:
             # self.losses must not end up behind self.step by up to
             # MAX_INFLIGHT entries.
             while pending:
+                s, dev = pending.popleft()
                 try:
-                    val = float(pending.popleft())
+                    val = float(dev)
                 except Exception:  # noqa: BLE001 — a failed step's loss
                     break
                 out.append(val)
                 self.losses.append(val)
+                self.loss_by_step[s] = val
             producer.join(timeout=10.0)
             if producer.is_alive():
                 # Pathological: producer stuck (e.g. a hung DFS read)

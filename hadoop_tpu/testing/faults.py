@@ -1,0 +1,103 @@
+"""Flag-file fault injection shared by smokes and soak harnesses.
+
+Extracted from ``benchmarks/flight_smoke.py``'s ad-hoc slow-file so the
+flight legs, the elastic leg, and any future soak harness inject faults
+through ONE api instead of each growing its own file conventions. The
+transport is deliberately primitive — a flag file per (rank, kind) —
+because it crosses process boundaries with no shared runtime: the
+parent (or a test) writes flags with :class:`FaultInjector`, and the
+victim calls :func:`apply_faults` once per step.
+
+Kinds:
+
+- ``kill``     — hard process death (``os._exit(KILL_EXIT)``), the
+  lost-host case. No cleanup runs: exactly what a real kill looks like
+  to the doctor (heartbeats stop, the roster row goes ``ok=False``).
+- ``delay-ms`` — per-step latency injection; the flag file's content
+  is the delay in milliseconds (the straggler case the step_wall
+  median/MAD detector flags).
+- ``hang``     — the step blocks until the flag is cleared (a stuck
+  DFS read / collective). Bounded by ``hang_timeout_s`` so a harness
+  bug can't wedge a worker forever.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+KINDS = ("kill", "delay-ms", "hang")
+
+# mirrors the rc of SIGKILL'd processes (128+9) so the parent's
+# post-mortem can't mistake an injected kill for a clean exit
+KILL_EXIT = 137
+
+
+class FaultInjector:
+    """Parent-side writer of per-(rank, kind) flag files."""
+
+    def __init__(self, base_dir: str):
+        self.base_dir = base_dir
+        os.makedirs(base_dir, exist_ok=True)
+
+    def path(self, rank: int, kind: str) -> str:
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} "
+                             f"(one of {KINDS})")
+        return os.path.join(self.base_dir, f"fault-{kind}-rank{rank}")
+
+    def inject(self, rank: int, kind: str, value: str = "1") -> str:
+        """Arm one fault; atomic (write + rename) so a checker never
+        reads a half-written value."""
+        p = self.path(rank, kind)
+        with open(p + ".tmp", "w") as f:
+            f.write(value)
+        os.replace(p + ".tmp", p)
+        return p
+
+    def clear(self, rank: int, kind: str) -> None:
+        try:
+            os.remove(self.path(rank, kind))
+        except FileNotFoundError:
+            pass
+
+    def clear_all(self) -> None:
+        for name in os.listdir(self.base_dir):
+            if name.startswith("fault-") and not name.endswith(".tmp"):
+                try:
+                    os.remove(os.path.join(self.base_dir, name))
+                except FileNotFoundError:
+                    pass
+
+    def armed(self, rank: int, kind: str) -> bool:
+        return os.path.exists(self.path(rank, kind))
+
+
+def _read(path: str) -> Optional[str]:
+    try:
+        with open(path) as f:
+            return f.read().strip()
+    except (FileNotFoundError, OSError):
+        return None
+
+
+def apply_faults(base_dir: str, rank: int, *,
+                 hang_timeout_s: float = 60.0,
+                 poll_s: float = 0.05) -> None:
+    """Worker-side checker: call once per step. Applies, in order,
+    ``kill`` (never returns), ``delay-ms`` (sleeps), ``hang`` (blocks
+    until cleared, bounded by ``hang_timeout_s``)."""
+    inj = FaultInjector(base_dir)
+    if inj.armed(rank, "kill"):
+        # no cleanup, no atexit: a real lost host doesn't say goodbye
+        os._exit(KILL_EXIT)
+    delay = _read(inj.path(rank, "delay-ms"))
+    if delay:
+        try:
+            time.sleep(float(delay) / 1e3)
+        except ValueError:
+            pass
+    deadline = time.monotonic() + hang_timeout_s
+    while inj.armed(rank, "hang") and time.monotonic() < deadline:
+        time.sleep(poll_s)
