@@ -89,6 +89,15 @@ _KEY_METRICS = {
                        (("lost_steps_baseline",), "lost_steps_baseline"),
                        (("evictions",), "evictions"),
                        (("resume_seconds",), "resume_seconds")],
+    # long-context pipelined decode (serving/longctx/decode): the
+    # lever counts as moving when the trajectory shows decode tokens/s
+    # NEXT TO the per-token dispatch budget and the double-buffer
+    # window bytes it was bought with
+    "serving_longctx": [
+        (("decode_tokens_per_sec",), "longctx_decode_tokens_per_sec"),
+        (("decode_dispatches_per_token",),
+         "longctx_dispatches_per_token"),
+        (("decode_hbm_window_bytes",), "longctx_hbm_window_bytes")],
     # partially-synchronized activations (parallel/lowp/syncpolicy):
     # the lever only counts as moving when the trajectory file shows
     # per-step collectives skipped AND the guard verdict next to them

@@ -49,6 +49,7 @@ ENTRY_POINTS = frozenset({
     "qdot",
     "qrows",
     "qhead",
+    "qslice",
     "quantized_load",
     # long-context serving plane (serving.parity): CP prefill
     # reassociates the softmax across ranks, paged decode across

@@ -80,6 +80,12 @@ class ParallelCtx:
     # make_train_step wiring + the tpulint relaxed-gated checker on the
     # syncpolicy entry points the schedule routes to).
     relaxed_sync: Optional[tuple] = None
+    # relaxed tier only (serving.parity): quantized resident weights —
+    # matmul leaves may arrive as weight-plane qtensors and route
+    # through the dequantizing matmul (serving/weightplane.py qdot).
+    # False (the default) is the bitwise tier: quantized leaves are a
+    # wiring bug and fail loudly at the first shape access.
+    relaxed_qweights: bool = False
 
     @property
     def seq_offset_fn(self):
@@ -156,6 +162,36 @@ def _norm(x, w, b, cfg: ModelConfig):
     return layer_norm(x, w, b, cfg.norm_eps)
 
 
+# -------------------------------------------------- quantized weight seam
+
+def _out_features(w) -> int:
+    """Output width of a projection weight. Quantized leaves store
+    transposed-and-grouped ({"q": int8 [.., N, G, gs], "s": [.., N, G]})
+    so the output dim sits third-from-last."""
+    if isinstance(w, dict):
+        return w["q"].shape[-3]
+    return w.shape[-1]
+
+
+def _relaxed_qready(w, ctx: ParallelCtx) -> bool:
+    """Should this matmul route through the weight plane's dequantizing
+    contraction? Only when the trace opted in AND the leaf actually
+    carries the quantized layout — and never under tp: the qtensor is
+    the unsharded weight, so a tp trace would contract the full output
+    dim on every rank and then psum, double-counting."""
+    if not ctx.relaxed_qweights:
+        return False
+    from hadoop_tpu.serving.weightplane import is_qtensor
+    if not is_qtensor(w):
+        return False
+    if ctx.tp_axis is not None:
+        raise NotImplementedError(
+            "quantized resident weights compose with tp-free meshes "
+            "only (the serving engine / longctx CP); shard the f32 "
+            "view under tensor parallelism")
+    return True
+
+
 # -------------------------------------------------------------- attention
 
 def _attention_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin,
@@ -181,11 +217,17 @@ def _attention_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin,
 
     B, S, _ = h.shape
     # local head counts (already sharded if tp): infer from weight shapes
-    hq_local = lp["wq"].shape[-1] // cfg.head_dim
-    hkv_local = lp["wk"].shape[-1] // cfg.head_dim
-    q = (h @ lp["wq"]).reshape(B, S, hq_local, cfg.head_dim)
-    k = (h @ lp["wk"]).reshape(B, S, hkv_local, cfg.head_dim)
-    v = (h @ lp["wv"]).reshape(B, S, hkv_local, cfg.head_dim)
+    hq_local = _out_features(lp["wq"]) // cfg.head_dim
+    hkv_local = _out_features(lp["wk"]) // cfg.head_dim
+    if _relaxed_qready(lp["wq"], ctx):
+        from hadoop_tpu.serving.weightplane import qdot
+        q = qdot(h, lp["wq"]).reshape(B, S, hq_local, cfg.head_dim)
+        k = qdot(h, lp["wk"]).reshape(B, S, hkv_local, cfg.head_dim)
+        v = qdot(h, lp["wv"]).reshape(B, S, hkv_local, cfg.head_dim)
+    else:
+        q = (h @ lp["wq"]).reshape(B, S, hq_local, cfg.head_dim)
+        k = (h @ lp["wk"]).reshape(B, S, hkv_local, cfg.head_dim)
+        v = (h @ lp["wv"]).reshape(B, S, hkv_local, cfg.head_dim)
 
     if cfg.use_rope:
         if ctx.ring_axis is not None:
@@ -210,9 +252,15 @@ def _attention_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin,
         attn = causal_attention(q, k, v)
 
     from hadoop_tpu.ops.collective_matmul import row_parallel_project
-    out = row_parallel_project(
-        attn.reshape(B, S, hq_local * cfg.head_dim), lp["wo"], ctx,
-        relaxed_sync=relaxed_sync)
+    attn_flat = attn.reshape(B, S, hq_local * cfg.head_dim)
+    if _relaxed_qready(lp["wo"], ctx):
+        # tp-free trace (enforced above): the row-parallel reduce is
+        # the identity, so the dequantizing matmul substitutes directly
+        from hadoop_tpu.serving.weightplane import qdot
+        out = qdot(attn_flat, lp["wo"])
+    else:
+        out = row_parallel_project(attn_flat, lp["wo"], ctx,
+                                   relaxed_sync=relaxed_sync)
     corr = None
     if relaxed_sync is not None and relaxed_sync.mode == "stale":
         out, corr = out
@@ -242,13 +290,23 @@ def _mlp_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx,
         out = reduce_row_parallel(moe_mlp(h, lp, cfg, ctx), ctx,
                                   relaxed_sync=relaxed_sync)
     elif cfg.use_swiglu:
-        out = row_parallel_project(
-            swiglu(h @ lp["w_gate"], h @ lp["w_up"]), lp["w_down"], ctx,
-            relaxed_sync=relaxed_sync)
+        if _relaxed_qready(lp["w_down"], ctx):
+            from hadoop_tpu.serving.weightplane import qdot
+            out = qdot(swiglu(qdot(h, lp["w_gate"]),
+                              qdot(h, lp["w_up"])), lp["w_down"])
+        else:
+            out = row_parallel_project(
+                swiglu(h @ lp["w_gate"], h @ lp["w_up"]), lp["w_down"],
+                ctx, relaxed_sync=relaxed_sync)
     else:
-        out = row_parallel_project(
-            gelu(h @ lp["w_in"] + lp["b_in"]), lp["w_out"], ctx,
-            bias=lp["b_out"], relaxed_sync=relaxed_sync)
+        if _relaxed_qready(lp["w_out"], ctx):
+            from hadoop_tpu.serving.weightplane import qdot
+            out = qdot(gelu(qdot(h, lp["w_in"]) + lp["b_in"]),
+                       lp["w_out"]) + lp["b_out"]
+        else:
+            out = row_parallel_project(
+                gelu(h @ lp["w_in"] + lp["b_in"]), lp["w_out"], ctx,
+                bias=lp["b_out"], relaxed_sync=relaxed_sync)
     corr = None
     if relaxed_sync is not None and relaxed_sync.mode == "stale":
         out, corr = out
@@ -465,6 +523,9 @@ def embed_tokens(params, tokens, cfg: ModelConfig, ctx: ParallelCtx):
         else:
             h = jax.lax.psum(h.astype(jnp.float32),
                              ctx.tp_axis).astype(embed.dtype)
+    elif _relaxed_qready(embed, ctx):
+        from hadoop_tpu.serving.weightplane import qrows
+        h = qrows(embed, tokens, cfg.jax_dtype)
     else:
         h = embed[tokens]
     if not cfg.use_rope:

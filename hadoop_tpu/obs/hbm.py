@@ -33,8 +33,8 @@ from typing import Callable, Dict, Optional, Tuple
 # a registration can never mint an unbounded Prometheus series. Keep in
 # sync with the literal tuple in _ensure_metrics below.
 HBM_COMPONENTS = ("weights", "weights_dequantized", "kv_pool",
-                  "longctx_window", "longctx_tail", "params",
-                  "opt_state", "grad_buckets", "other")
+                  "longctx_window", "longctx_tail", "longctx_sampler",
+                  "params", "opt_state", "grad_buckets", "other")
 
 
 def device_memory_stats() -> Optional[Dict]:
@@ -64,8 +64,8 @@ class HbmLedger:
     """Process-global registry of HBM byte providers."""
 
     # how long one provider sweep may serve the per-component gauges:
-    # a /prom render reads all 9 component gauges back to back, and a
-    # params/opt provider walks a whole pytree — 9 sweeps per scrape
+    # a /prom render reads all 10 component gauges back to back, and a
+    # params/opt provider walks a whole pytree — 10 sweeps per scrape
     # would be pure redundant hot-path work
     CACHE_SECONDS = 0.25
 
@@ -156,8 +156,8 @@ class HbmLedger:
         # label values drawn from this literal tuple — the bounded-set
         # contract the tpulint metrics/unbounded-label checker enforces
         for c in ("weights", "weights_dequantized", "kv_pool",
-                  "longctx_window", "longctx_tail", "params",
-                  "opt_state", "grad_buckets", "other"):
+                  "longctx_window", "longctx_tail", "longctx_sampler",
+                  "params", "opt_state", "grad_buckets", "other"):
             reg.register_callback_gauge(
                 "hbm_bytes_" + c,
                 (lambda comp=c: self._one_component(comp)),

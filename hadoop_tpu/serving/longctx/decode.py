@@ -21,15 +21,38 @@ request with ``TieredKVCache.read_chain`` (host probe, then
 DFS hedged reads in ``serving.kv.fetch.window``-sized speculative
 windows: O(chain/window) DataNode round trips).
 
-Compile-once: every jitted piece below is cached at module level per
-(model config, window, tail capacity) and traced exactly once for the
-process lifetime — ``trace_counts()`` exposes the counters and the
-longctx smoke pins them, exactly like the engine's two step shapes.
+Two decode loops share that working-set contract:
 
-Sampling runs host-side (greedy argmax / temperature + top-k with the
-same mask-then-scale transform as the engine's in-graph sampler): the
-per-token logits are already host-visible here, unlike the fused step
-where keeping sampling in-graph is what avoids a [B, V] readback.
+- the PIPELINED path (``serving.longctx.decode.pipeline``, the
+  default): the per-layer op chain is fused into four scanned,
+  fixed-shape dispatches (``fstart``/``fadvance``/``fwin``/
+  ``ffinish``), the window transfer unit is a SLAB of
+  ``serving.longctx.decode.fetch.windows`` consecutive windows for one
+  layer (one async ``device_put`` per slab, consumed by one ``fwin``
+  scan), and the next slab is shipped while the current one computes —
+  a two-slab double buffer, the flash/paged-attention page-in idiom
+  run at the jit boundary instead of inside a kernel. With the default
+  slab depth (= ``n_layers``) host→HBM traffic per token is
+  O(chain/window) slab transfers — O(layers × chain/window) slices on
+  the legacy loop — and dispatches per token collapse from
+  ~``2 + n_layers * (4 + 2*n_windows)`` to ``n_layers * n_slabs +
+  n_layers + 1``. Sampling runs in-graph by default
+  (``serving.longctx.decode.sampler=device``: the engine's
+  mask-then-scale transform + categorical, one int32 readback per
+  token) with the host sampler as fallback. Quantized (int8-resident)
+  weight trees serve directly on this path: the fused pieces route
+  matmuls through the weight plane's ``qdot``/``qslice``/``qhead``.
+- the LEGACY path (``pipeline=false``): the pre-pipelining per-(layer,
+  window) loop, kept byte-identical as the bitwise-parity fallback and
+  the A-B reference for the fused path.
+
+Compile-once: every jitted piece below is cached at module level per
+(model config, window, tail capacity[, slab depth, weight tier]) and
+traced exactly once for the process lifetime — ``trace_counts()``
+exposes the trace counters and ``dispatch_counts()`` the per-dispatch
+counters (stamped per jit call the way the comm ledger stamps
+collectives); the longctx smoke pins the former at 1 and budgets the
+latter per token.
 """
 
 from __future__ import annotations
@@ -52,12 +75,21 @@ _FAR = 1 << 30     # a kv position no query position ever reaches
 _JIT_CACHE: Dict = {}                       # guarded-by: _JIT_LOCK
 _JIT_LOCK = threading.Lock()
 _TRACES: Dict[str, int] = {}
+_DISPATCHES: Dict[str, int] = {}
 
 
 def trace_counts() -> Dict[str, int]:
     """Traces per jitted decode piece (name → count): the longctx
     smoke asserts every value stays exactly 1 per layout family."""
     return dict(_TRACES)
+
+
+def dispatch_counts() -> Dict[str, int]:
+    """Device dispatches per jitted decode piece (name → count),
+    stamped host-side at every call the way the comm ledger stamps
+    collectives — the number the ≤ 2-per-(token, window) budget is
+    audited against."""
+    return dict(_DISPATCHES)
 
 
 def _count(name: str) -> None:
@@ -177,6 +209,199 @@ def _jits_for(cfg: ModelConfig, win: int, tail_cap: int):
         return _JIT_CACHE[key]
 
 
+# ------------------------------------------------- fused pipelined path
+
+def _build_fused(cfg: ModelConfig, win: int, tail_cap: int, slab_wins: int,
+                 quantized: bool):
+    """The pipelined path's jit family: the per-token op chain folded
+    into four fixed-shape dispatches (arXiv:2502.17728's fusion
+    direction applied at the jit boundary).
+
+    - ``fstart``: embed + layer 0's qkv/rope + tail scatter + tail
+      attention partial.
+    - ``fadvance``: layer ``l-1``'s wo/mlp exit + layer ``l``'s entry +
+      tail scatter + tail partial (one trace serves every layer — the
+      layer index is data).
+    - ``fwin``: ``lax.scan`` over the ``slab_wins`` windows of one
+      transferred slab, merging each window's online-softmax partial
+      into the running (o, lse) — the scanned per-window step. Windows
+      past the chain mask to -inf rows, which ``chunk_attention``
+      documents as the merge identity, so slab padding needs no guard.
+    - ``ffinish`` / ``fhead``: last layer's exit + final norm + head,
+      then either the engine's mask-then-scale sampler in-graph
+      (``ffinish`` → one int32 per token crosses back) or raw f32
+      logits for the host fallback (``fhead``).
+
+    ``quantized`` selects the int8-resident weight tier: matmuls route
+    through the weight plane (``qdot``/``qslice``/``qrows``/``qhead``)
+    wherever the leaf carries the quantized layout. The tier is part of
+    the family key — a quantized tree is a different pytree structure,
+    so sharing a counter with the f32 family would misread the second
+    trace as a retracing bug.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from hadoop_tpu.models.decoder import _norm, head_matrix
+    from hadoop_tpu.ops import (apply_rope, gelu, rope_frequencies,
+                                swiglu)
+    from hadoop_tpu.ops.attention import (_repeat_kv, chunk_attention,
+                                          merge_attention)
+    from hadoop_tpu.serving.weightplane import (is_qtensor, qdot, qhead,
+                                                qrows, qslice)
+
+    hq, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    nrep = hq // hkv
+    nl = cfg.n_layers
+    scale = 1.0 / (dh ** 0.5)
+    tier = "q8" if quantized else "f32"
+    fam = (f"{cfg.family}:{win}:{tail_cap}:s{slab_wins}:{tier}:"
+           f"{hash(cfg) & 0xffffff:x}")
+
+    # trace-time weight routing: the pytree structure (qtensor vs
+    # array) is static per family, so these branches compile away
+    def _mm(x, w):
+        return qdot(x, w) if is_qtensor(w) else x @ w
+
+    def _lw(layers, name, l):
+        w = layers[name]
+        return qslice(w, l) if is_qtensor(w) else w[l]
+
+    def _partial(q, kc, vc, qpos, kvpos):
+        return chunk_attention(
+            q, _repeat_kv(kc[None], nrep).astype(jnp.float32),
+            _repeat_kv(vc[None], nrep).astype(jnp.float32),
+            scale, qpos[None], kvpos)
+
+    def _layer_in(layers, l, h, pos):
+        x = _norm(h, layers["attn_norm_w"][l],
+                  layers["attn_norm_b"][l]
+                  if "attn_norm_b" in layers else None, cfg)
+        q = _mm(x, _lw(layers, "wq", l)).reshape(1, 1, hq, dh)
+        k = _mm(x, _lw(layers, "wk", l)).reshape(1, 1, hkv, dh)
+        v = _mm(x, _lw(layers, "wv", l)).reshape(1, 1, hkv, dh)
+        if cfg.use_rope:
+            cos, sin = rope_frequencies(dh, cfg.max_seq, cfg.rope_theta)
+            p = pos[None]
+            q = apply_rope(q, cos, sin, p)
+            k = apply_rope(k, cos, sin, p)
+        return q, k[0, 0], v[0, 0]
+
+    def _layer_out(layers, l, h, o):
+        h = h + _mm(o.astype(h.dtype).reshape(1, 1, hq * dh),
+                    _lw(layers, "wo", l))
+        x = _norm(h, layers["mlp_norm_w"][l],
+                  layers["mlp_norm_b"][l]
+                  if "mlp_norm_b" in layers else None, cfg)
+        if cfg.use_swiglu:
+            mlp = _mm(swiglu(_mm(x, _lw(layers, "w_gate", l)),
+                             _mm(x, _lw(layers, "w_up", l))),
+                      _lw(layers, "w_down", l))
+        else:
+            mlp = _mm(gelu(_mm(x, _lw(layers, "w_in", l))
+                           + layers["b_in"][l]),
+                      _lw(layers, "w_out", l)) + layers["b_out"][l]
+        return h + mlp.astype(h.dtype)
+
+    def _tail_partial(q, ktail, vtail, l, pos, base, n_tail):
+        j = jnp.arange(tail_cap)
+        kvpos = jnp.where(j < n_tail, base + j, _FAR)
+        return _partial(q, ktail[l], vtail[l], pos, kvpos)
+
+    def _final_logits(params, layers, h, o):
+        h = _layer_out(layers, nl - 1, h, o)
+        h = _norm(h, params["final_norm_w"],
+                  params.get("final_norm_b"), cfg)
+        row = h[0, 0]
+        head = params["embed"] if cfg.tie_embeddings \
+            else params.get("lm_head")
+        if is_qtensor(head):
+            return qhead(params, row, cfg).astype(jnp.float32)
+        return (row @ head_matrix(params, cfg, row.dtype)).astype(
+            jnp.float32)
+
+    def fstart_impl(params, layers, tok, pos, ktail, vtail, idx, base):
+        _count(f"fstart@{fam}")
+        emb = params["embed"]
+        if is_qtensor(emb):
+            h = qrows(emb, tok, cfg.jax_dtype)[None, None, :]
+        else:
+            h = emb[tok][None, None, :]
+        if not cfg.use_rope:
+            h = h + params["pos_embed"][
+                jnp.clip(pos, 0, cfg.max_seq - 1)][None, None, :]
+        q, k, v = _layer_in(layers, 0, h, pos)
+        ktail = ktail.at[0, idx].set(k.astype(ktail.dtype))
+        vtail = vtail.at[0, idx].set(v.astype(vtail.dtype))
+        o, lse = _tail_partial(q, ktail, vtail, 0, pos, base, idx + 1)
+        return h, q, ktail, vtail, o, lse
+
+    def fadvance_impl(layers, l, h, o, pos, ktail, vtail, idx, base):
+        _count(f"fadvance@{fam}")
+        h = _layer_out(layers, l - 1, h, o)
+        q, k, v = _layer_in(layers, l, h, pos)
+        ktail = ktail.at[l, idx].set(k.astype(ktail.dtype))
+        vtail = vtail.at[l, idx].set(v.astype(vtail.dtype))
+        o2, lse2 = _tail_partial(q, ktail, vtail, l, pos, base, idx + 1)
+        return h, q, ktail, vtail, o2, lse2
+
+    def fwin_impl(q, o, lse, slab, slab0, chain_len, pos):
+        _count(f"fwin@{fam}")
+        ks = slab[0].reshape(slab_wins, win, hkv, dh)
+        vs = slab[1].reshape(slab_wins, win, hkv, dh)
+        w0s = slab0 + jnp.arange(slab_wins, dtype=jnp.int32) * win
+
+        def body(carry, xs):
+            o, lse = carry
+            kw, vw, w0 = xs
+            j = jnp.arange(win)
+            n_valid = jnp.clip(chain_len - w0, 0, win)
+            kvpos = jnp.where(j < n_valid, w0 + j, _FAR)
+            ow, lw = _partial(q, kw, vw, pos, kvpos)
+            return merge_attention(o, lse, ow, lw), None
+
+        (o, lse), _ = jax.lax.scan(body, (o, lse), (ks, vs, w0s))
+        return o, lse
+
+    def fhead_impl(params, layers, h, o):
+        _count(f"fhead@{fam}")
+        return _final_logits(params, layers, h, o)
+
+    def ffinish_impl(params, layers, h, o, pos, temp, topk, seed):
+        _count(f"ffinish@{fam}")
+        logits = _final_logits(params, layers, h, o)
+        # the engine's mask-then-scale sampler, in-graph: greedy when
+        # temp <= 0 (bit-identical to the host argmax), else top-k
+        # mask + temperature + categorical off a position-folded key
+        greedy = jnp.argmax(logits).astype(jnp.int32)
+        v = logits.shape[-1]
+        srt = jnp.sort(logits)
+        kth = srt[jnp.clip(v - topk, 0, v - 1)]
+        masked = jnp.where((topk > 0) & (logits < kth), _NEG_INF, logits)
+        scaled = masked / jnp.maximum(temp, 1e-6)
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+        sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
+        return jnp.where(temp <= 0, greedy, sampled)
+
+    return SimpleNamespace(
+        fstart=jax.jit(fstart_impl, donate_argnums=(4, 5)),
+        fadvance=jax.jit(fadvance_impl, donate_argnums=(5, 6)),
+        fwin=jax.jit(fwin_impl),
+        fhead=jax.jit(fhead_impl),
+        ffinish=jax.jit(ffinish_impl),
+        family=fam)
+
+
+def _fused_for(cfg: ModelConfig, win: int, tail_cap: int, slab_wins: int,
+               quantized: bool):
+    key = ("fused", cfg, win, tail_cap, slab_wins, quantized)
+    with _JIT_LOCK:
+        if key not in _JIT_CACHE:
+            _JIT_CACHE[key] = _build_fused(cfg, win, tail_cap,
+                                           slab_wins, quantized)
+        return _JIT_CACHE[key]
+
+
 def _host_sample(logits: np.ndarray, temperature: float, top_k: int,
                  rng: np.random.Generator) -> int:
     """The engine's mask-then-scale sampling transform, host-side:
@@ -201,15 +426,22 @@ class WorkingSetDecoder:
 
     def __init__(self, params, cfg: ModelConfig, store, *,
                  block_size: int, window_blocks: int = 4,
-                 tail_tokens: int = 128, metrics=None):
+                 tail_tokens: int = 128, pipeline: bool = True,
+                 sampler: str = "device", fetch_windows: int = 0,
+                 metrics=None):
         import jax.numpy as jnp
 
         from hadoop_tpu.serving.weightplane import is_quantized_tree
-        if is_quantized_tree(params):
-            raise NotImplementedError(
-                "the longctx decoder serves the checkpoint-dtype view; "
-                "hand it dequantized params (the plane does this at "
-                "construction)")
+        if sampler not in ("device", "host"):
+            raise ValueError(
+                f"serving.longctx.decode.sampler must be 'device' or "
+                f"'host', got {sampler!r}")
+        quantized = is_quantized_tree(params)
+        if quantized and not pipeline:
+            raise ValueError(
+                "int8-resident longctx weights need the pipelined "
+                "decode path (serving.longctx.decode.pipeline=true): "
+                "the legacy loop serves the checkpoint-dtype view only")
         if cfg.is_moe:
             raise NotImplementedError("longctx serves dense decoders "
                                       "only (same as the engine)")
@@ -219,21 +451,86 @@ class WorkingSetDecoder:
         self.block_size = int(block_size)
         self.win = int(window_blocks) * self.block_size
         self.tail_cap = int(tail_tokens)
+        self.pipeline = bool(pipeline)
+        self.sampler = sampler
+        self.relaxed_qweights = quantized
+        # slab depth: windows shipped per transfer/dispatch. The auto
+        # default (= n_layers) makes per-token transfer count equal
+        # the legacy loop's per-LAYER window count — O(chain/window)
+        # slabs instead of O(layers x chain/window) slices — and makes
+        # the two in-flight slabs together cost exactly 2 windows of
+        # per-token working-set bytes.
+        self.fetch_windows = int(fetch_windows) or cfg.n_layers
+        if self.fetch_windows < 1:
+            raise ValueError("serving.longctx.decode.fetch.windows "
+                             "must be >= 1")
         self._jnp = jnp
-        self._jits = _jits_for(cfg, self.win, self.tail_cap)
+        if self.pipeline:
+            self._fused = _fused_for(cfg, self.win, self.tail_cap,
+                                     self.fetch_windows, quantized)
+            self._jits = None
+        else:
+            self._jits = _jits_for(cfg, self.win, self.tail_cap)
+            self._fused = None
         self.metrics = metrics
-        self.window_fetches = 0     # device window loads (per l, w, tok)
+        self.window_fetches = 0     # host->device window transfers
         self.tokens_decoded = 0
+        self.dispatches = 0         # jit calls on the decode hot path
+
+    # ------------------------------------------------------- accounting
+
+    @property
+    def _per_tok_bytes(self) -> int:
+        item = np.dtype(self.cfg.dtype).itemsize
+        return 2 * self.cfg.n_layers * self.cfg.n_kv_heads * \
+            self.cfg.head_dim * item
+
+    @property
+    def slab_bytes(self) -> int:
+        """One transferred slab: ``fetch_windows`` windows of ONE
+        layer's K+V."""
+        return self.fetch_windows * self.win * \
+            (self._per_tok_bytes // self.cfg.n_layers)
+
+    @property
+    def hbm_window_bytes(self) -> int:
+        """Device bytes the window paging keeps in flight: both slabs
+        of the double buffer when pipelining (one computing, one in
+        transfer), one window's worth on the legacy loop."""
+        if self.pipeline:
+            return 2 * self.slab_bytes
+        return self.win * self._per_tok_bytes
+
+    @property
+    def sampler_state_bytes(self) -> int:
+        """Device-resident sampler state (in-graph sampling only): the
+        folded PRNG key + the sampled int32 token."""
+        if self.pipeline and self.sampler == "device":
+            return 12
+        return 0
 
     @property
     def hbm_working_set_bytes(self) -> int:
         """What this decoder keeps device-resident per request: the
-        window (transient) + the tail buffers. The number the 'working
-        set, not the full context' contract is about."""
-        item = np.dtype(self.cfg.dtype).itemsize
-        per_tok = 2 * self.cfg.n_layers * self.cfg.n_kv_heads * \
-            self.cfg.head_dim * item
-        return (self.win + self.tail_cap) * per_tok
+        in-flight window slabs + the tail buffers + sampler state. The
+        number the 'working set, not the full context' contract is
+        about."""
+        return self.hbm_window_bytes + \
+            self.tail_cap * self._per_tok_bytes + \
+            self.sampler_state_bytes
+
+    @property
+    def dispatches_per_token(self) -> float:
+        return self.dispatches / max(1, self.tokens_decoded)
+
+    def _disp(self, name: str) -> None:
+        self.dispatches += 1
+        _DISPATCHES[name] = _DISPATCHES.get(name, 0) + 1
+
+    def _note_fetch(self) -> None:
+        self.window_fetches += 1
+        if self.metrics:
+            self.metrics.longctx_window_fetches.incr()
 
     # ------------------------------------------------------------ decode
 
@@ -268,20 +565,7 @@ class WorkingSetDecoder:
                 f"longctx KV chain has a gap: {len(hits)}/{n_full} "
                 f"blocks recoverable from the host/DFS tiers (host ring "
                 f"too small without the DFS tier?)")
-        # ONE preallocated buffer at the window-padded shape, hits
-        # written in place: the chain is the dominant host allocation
-        # at real scale, and an assemble-then-pad concatenate pair
-        # would hold TWO copies live at peak. Padding to a window
-        # multiple once here keeps per-token window slicing
-        # allocation-free on the decode critical path.
         chain_len = n_full * bs
-        padded = chain_len + ((-chain_len) % self.win)
-        shape = (cfg.n_layers, padded, cfg.n_kv_heads, cfg.head_dim)
-        knp = np.zeros(shape, hits[0].k.dtype if hits else cfg.dtype)
-        vnp = np.zeros(shape, knp.dtype)
-        for i, h in enumerate(hits):
-            knp[:, i * bs:(i + 1) * bs] = h.k
-            vnp[:, i * bs:(i + 1) * bs] = h.v
         # ---- device-resident tail: prompt's partial block + every
         # generated token's K/V
         tshape = (cfg.n_layers, self.tail_cap, cfg.n_kv_heads,
@@ -301,6 +585,23 @@ class WorkingSetDecoder:
         pos = s                        # first_token's absolute position
         emitted = 0
         out_count = 1                  # first_token already delivered
+        if self.pipeline:
+            return self._decode_fused(
+                hits, chain_len, cur, pos, ktail, vtail, base, n_tail,
+                sp, seed, rng, deliver, stop, out_count)
+        # ---- legacy per-(layer, window) loop: the pre-pipelining path,
+        # byte-identical — the bitwise fallback and the fused path's
+        # A-B reference. ONE preallocated buffer at the window-padded
+        # shape, hits written in place: the chain is the dominant host
+        # allocation at real scale, and an assemble-then-pad
+        # concatenate pair would hold TWO copies live at peak.
+        padded = chain_len + ((-chain_len) % self.win)
+        shape = (cfg.n_layers, padded, cfg.n_kv_heads, cfg.head_dim)
+        knp = np.zeros(shape, hits[0].k.dtype if hits else cfg.dtype)
+        vnp = np.zeros(shape, knp.dtype)
+        for i, h in enumerate(hits):
+            knp[:, i * bs:(i + 1) * bs] = h.k
+            vnp[:, i * bs:(i + 1) * bs] = h.v
         while out_count < sp.max_new_tokens and \
                 (sp.stop_token is None or cur != sp.stop_token) and \
                 (stop is None or not stop()):
@@ -316,38 +617,150 @@ class WorkingSetDecoder:
         self.tokens_decoded += emitted
         return emitted
 
+    def _decode_fused(self, hits, chain_len: int, cur: int, pos: int,
+                      ktail, vtail, base: int, n_tail: int, sp,
+                      seed: int, rng, deliver, stop,
+                      out_count: int) -> int:
+        """The pipelined loop: pack the chain into per-(layer, slab)
+        transfer units, then per token run the fused dispatch chain
+        with the next slab always in flight behind the current one."""
+        cfg = self.cfg
+        bs = self.block_size
+        st = self.fetch_windows * self.win      # tokens per slab
+        # slab-packed host chain: [L, n_slabs, 2(k,v), slab_tokens,
+        # Hkv, Dh]. Each [l, s] plane is one contiguous device_put —
+        # a block (bs | win | slab_tokens) never straddles a slab.
+        padded = chain_len + ((-chain_len) % st)
+        n_slabs = padded // st
+        kvnp = np.zeros((cfg.n_layers, n_slabs, 2, st, cfg.n_kv_heads,
+                         cfg.head_dim),
+                        hits[0].k.dtype if hits else cfg.dtype)
+        for i, h in enumerate(hits):
+            sl, off = divmod(i * bs, st)
+            kvnp[:, sl, 0, off:off + bs] = h.k
+            kvnp[:, sl, 1, off:off + bs] = h.v
+        emitted = 0
+        while out_count < sp.max_new_tokens and \
+                (sp.stop_token is None or cur != sp.stop_token) and \
+                (stop is None or not stop()):
+            res, ktail, vtail = self._token_fused(
+                cur, pos, kvnp, chain_len, ktail, vtail, base, n_tail,
+                sp, seed)
+            if self.sampler == "device":
+                nxt = int(res)    # the one 4-byte readback per token
+            else:
+                # deliberate host sync: the fallback sampler draws from
+                # the [V] logits on the host rng stream
+                nxt = _host_sample(np.asarray(res), sp.temperature,  # lint: disable=jit/blocking-in-step
+                                   sp.top_k, rng)
+            n_tail += 1
+            deliver(nxt)
+            emitted += 1
+            out_count += 1
+            cur = nxt
+            pos += 1
+        self.tokens_decoded += emitted
+        return emitted
+
+    def _token_fused(self, tok: int, pos: int, kvnp, chain_len: int,
+                     ktail, vtail, base: int, n_tail: int, sampling,
+                     seed: int):
+        """One token through the fused dispatch chain. Per (layer,
+        slab) the NEXT slab's ``device_put`` is issued before the
+        current slab's ``fwin`` dispatch, so the transfer rides behind
+        the attention partials (the paged-attention double buffer at
+        the jit boundary). Dispatches: 1 fstart + (L-1) fadvance +
+        L*n_slabs fwin + 1 ffinish/fhead."""
+        import jax
+        jnp = self._jnp
+        J = self._fused
+        nl = self.cfg.n_layers
+        pos_j = jnp.int32(pos)
+        idx_j = jnp.int32(n_tail)
+        base_j = jnp.int32(base)
+        cl_j = jnp.int32(chain_len)
+        layers = self.params["layers"]
+        n_slabs = kvnp.shape[1]
+        st = self.fetch_windows * self.win
+        # slab (0, 0) goes in flight BEFORE the first dispatch: the
+        # embed + layer-0 entry computes under the first transfer
+        nxt_slab = None
+        if n_slabs:
+            nxt_slab = jax.device_put(kvnp[0, 0])
+            self._note_fetch()
+        h, q, ktail, vtail, o, lse = J.fstart(
+            self.params, layers, jnp.int32(tok), pos_j, ktail, vtail,
+            idx_j, base_j)
+        self._disp(f"fstart@{J.family}")
+        for l in range(nl):
+            if l > 0:
+                h, q, ktail, vtail, o, lse = J.fadvance(
+                    layers, jnp.int32(l), h, o, pos_j, ktail, vtail,
+                    idx_j, base_j)
+                self._disp(f"fadvance@{J.family}")
+            for s in range(n_slabs):
+                cur_slab = nxt_slab
+                if s + 1 < n_slabs:
+                    nxt_slab = jax.device_put(kvnp[l, s + 1])
+                    self._note_fetch()
+                elif l + 1 < nl:
+                    nxt_slab = jax.device_put(kvnp[l + 1, 0])
+                    self._note_fetch()
+                o, lse = J.fwin(q, o, lse, cur_slab, jnp.int32(s * st),
+                                cl_j, pos_j)
+                self._disp(f"fwin@{J.family}")
+        if self.sampler == "device":
+            out = J.ffinish(self.params, layers, h, o, pos_j,
+                            jnp.float32(sampling.temperature),
+                            jnp.int32(sampling.top_k), jnp.int32(seed))
+            self._disp(f"ffinish@{J.family}")
+        else:
+            out = J.fhead(self.params, layers, h, o)
+            self._disp(f"fhead@{J.family}")
+        return out, ktail, vtail
+
     def _token(self, tok: int, pos: int, knp, vnp, chain_len: int,
                ktail, vtail, base: int, n_tail: int):
-        """One full forward for one token: per layer, scatter its K/V
-        into the tail, then merge attention partials over the tail and
-        over the chain paged through the fixed window. ``knp``/``vnp``
-        arrive padded to a window multiple; ``chain_len`` is the true
-        context length the positions mask against."""
+        """One full forward for one token (legacy loop): per layer,
+        scatter its K/V into the tail, then merge attention partials
+        over the tail and over the chain paged through the fixed
+        window. ``knp``/``vnp`` arrive padded to a window multiple;
+        ``chain_len`` is the true context length the positions mask
+        against."""
         jnp = self._jnp
         J = self._jits
         cfg = self.cfg
         pos_j = jnp.int32(pos)
         h = J.embed(self.params, jnp.int32(tok), pos_j)
+        self._disp(f"embed@{J.family}")
         layers = self.params["layers"]
         n_win = knp.shape[1] // self.win
         idx = n_tail            # this token's tail slot
         for l in range(cfg.n_layers):
             l_j = jnp.int32(l)
             q, k, v = J.layer_in(layers, l_j, h, pos_j)
+            self._disp(f"layer_in@{J.family}")
             ktail, vtail = J.tail_set(ktail, vtail, l_j,
                                       jnp.int32(idx), k, v)
+            self._disp(f"tail_set@{J.family}")
             o, lse = J.tail(q, ktail, vtail, l_j, pos_j,
                             jnp.int32(base), jnp.int32(idx + 1))
+            self._disp(f"tail@{J.family}")
             for w in range(n_win):
                 w0 = w * self.win
                 ow, lw = J.win(q, knp[l, w0:w0 + self.win],
                                vnp[l, w0:w0 + self.win], pos_j,
                                jnp.int32(w0),
                                jnp.int32(min(chain_len - w0, self.win)))
+                self._disp(f"win@{J.family}")
                 o, lse = J.merge(o, lse, ow, lw)
-                self.window_fetches += 1
-                if self.metrics:
-                    self.metrics.longctx_window_fetches.incr()
+                self._disp(f"merge@{J.family}")
+                # every J.win call slices+transfers one (layer, window)
+                # piece of the host chain — that IS this loop's HBM
+                # traffic unit (the pipelined path counts per slab)
+                self._note_fetch()
             h = J.layer_out(layers, l_j, h, o)
+            self._disp(f"layer_out@{J.family}")
         logits = np.asarray(J.head(self.params, h))
+        self._disp(f"head@{J.family}")
         return logits, ktail, vtail, n_tail + 1

@@ -50,6 +50,9 @@ CHIPS_KEY = "serving.longctx.chips"
 SP_MODE_KEY = "serving.longctx.sp.mode"
 WINDOW_KEY = "serving.longctx.decode.window.blocks"
 TAIL_KEY = "serving.longctx.decode.tail.tokens"
+PIPELINE_KEY = "serving.longctx.decode.pipeline"
+SAMPLER_KEY = "serving.longctx.decode.sampler"
+FETCH_KEY = "serving.longctx.decode.fetch.windows"
 
 
 class LongContextPlane:
@@ -61,31 +64,21 @@ class LongContextPlane:
                  block_size: int, min_tokens: int,
                  max_tokens: Optional[int] = None, sp: int = 0,
                  sp_mode: str = "ring", window_blocks: int = 4,
-                 tail_tokens: int = 256, devices=None, metrics=None,
-                 tracer=None):
+                 tail_tokens: int = 256, pipeline: bool = True,
+                 sampler: str = "device", fetch_windows: int = 0,
+                 devices=None, metrics=None, tracer=None):
         if not store.cold_enabled:
             raise ValueError(
                 "the longctx plane streams prefill KV into the cold "
                 "tiers — enable serving.kv.host.bytes and/or "
                 "serving.kv.dfs.enable")
-        from hadoop_tpu.serving.weightplane import (dequantize_params,
-                                                    is_quantized_tree,
-                                                    resident_weight_bytes)
+        # a quantized tree serves int8-resident: CP prefill and the
+        # pipelined decoder route their matmuls through the weight
+        # plane (qdot/qslice/qhead), so the plane shares the engine's
+        # one resident copy — no dequantized view, no second model in
+        # HBM. The attribute survives (always 0 now) for the stats /
+        # health surface that capacity tooling already scrapes.
         self.dequantized_view_bytes = 0
-        if is_quantized_tree(params):
-            # CP prefill and the paged decoder run decoder-layer math
-            # on plain arrays; int8-resident CP weights are future
-            # work. This view is a SECOND resident copy of the model
-            # next to the engine's int8 plane — it is not in the
-            # engine's hbm_bytes lane math, so it is loud here and
-            # reported in stats()/health for capacity accounting.
-            params = dequantize_params(params, cfg)
-            self.dequantized_view_bytes = resident_weight_bytes(params)
-            log.warning(
-                "longctx plane holds a dequantized weight view (%d "
-                "bytes) BESIDE the engine's int8 plane — budget HBM "
-                "for both until int8 CP weights land",
-                self.dequantized_view_bytes)
         self.cfg = cfg
         self.store = store
         self.min_tokens = int(min_tokens)
@@ -98,7 +91,8 @@ class LongContextPlane:
         self.decoder = WorkingSetDecoder(
             params, cfg, store, block_size=block_size,
             window_blocks=window_blocks, tail_tokens=tail_tokens,
-            metrics=metrics)
+            pipeline=pipeline, sampler=sampler,
+            fetch_windows=fetch_windows, metrics=metrics)
         self.requests_served = 0
         self.blocks_streamed = 0
         self._q: "queue.Queue" = queue.Queue()
@@ -125,23 +119,22 @@ class LongContextPlane:
         if metrics:
             metrics.longctx_chips.set(self.prefiller.sp)
         # live HBM ledger (obs/hbm.py): the decode working set split
-        # into window (transient page-in buffer) + tail (device-resident
-        # prompt tail + generated tokens), and the dequantized weight
-        # view an int8 replica pays beside the engine's plane
+        # into window (BOTH in-flight slabs of the double buffer when
+        # pipelining — 2x one window at the default slab depth), tail
+        # (device-resident prompt tail + generated tokens), and the
+        # in-graph sampler's device state when it is on
         from hadoop_tpu.obs.hbm import hbm_ledger
         # trailing separator: see engine's _hbm_owner note
         self._hbm_owner = f"longctx@{id(self)}."
         dec = self.decoder
-        per_tok = dec.hbm_working_set_bytes // max(
-            1, dec.win + dec.tail_cap)
         led = hbm_ledger()
         led.register(f"{self._hbm_owner}window", "longctx_window",
-                     lambda: dec.win * per_tok)
+                     lambda: dec.hbm_window_bytes)
         led.register(f"{self._hbm_owner}tail", "longctx_tail",
-                     lambda: dec.tail_cap * per_tok)
-        if self.dequantized_view_bytes:
-            led.register(f"{self._hbm_owner}deq", "weights_dequantized",
-                         lambda: self.dequantized_view_bytes)
+                     lambda: dec.tail_cap * dec._per_tok_bytes)
+        if dec.sampler_state_bytes:
+            led.register(f"{self._hbm_owner}sampler", "longctx_sampler",
+                         lambda: dec.sampler_state_bytes)
 
     # ----------------------------------------------------------- submit
 
@@ -278,12 +271,16 @@ class LongContextPlane:
             try:
                 # the SAME rng that drew the first token: re-seeding
                 # here would replay its uniform stream on the second
-                # token's sample (correlated consecutive draws)
+                # token's sample (correlated consecutive draws). The
+                # in-graph sampler keys off seed=req.id instead (its
+                # jax key stream is position-folded per token, so it
+                # never replays either); greedy decoding is identical
+                # on both.
                 self.decoder.paged_decode(
                     req.prompt, first, smp,
                     tail_k=res.tail_k, tail_v=res.tail_v,
                     deliver=lambda t: self._deliver(req, t),
-                    stop=self._stopped.is_set, rng=rng,
+                    stop=self._stopped.is_set, seed=req.id, rng=rng,
                     parent_ctx=req.trace_ctx)
             finally:
                 dsp.add_kv("tokens_out", str(len(req.out_tokens)))
@@ -400,7 +397,9 @@ class LongContextPlane:
             self._q.put(None)
 
     def stats(self) -> Dict:
-        from hadoop_tpu.serving.longctx.decode import trace_counts
+        from hadoop_tpu.serving.longctx.decode import (dispatch_counts,
+                                                       trace_counts)
+        dec = self.decoder
         return {
             "enabled": True,
             "min_tokens": self.min_tokens,
@@ -409,14 +408,23 @@ class LongContextPlane:
             "sp_mode": self.prefiller.sp_mode,
             "requests": self.requests_served,
             "blocks_streamed": self.blocks_streamed,
-            "window_fetches": self.decoder.window_fetches,
-            "window_tokens": self.decoder.win,
-            "tail_tokens": self.decoder.tail_cap,
-            "hbm_working_set_bytes":
-                self.decoder.hbm_working_set_bytes,
+            "window_fetches": dec.window_fetches,
+            "window_tokens": dec.win,
+            "tail_tokens": dec.tail_cap,
+            "decode_pipeline": dec.pipeline,
+            "decode_sampler": dec.sampler,
+            "fetch_windows": dec.fetch_windows,
+            "int8_weights": dec.relaxed_qweights,
+            "tokens_decoded": dec.tokens_decoded,
+            "decode_dispatches": dec.dispatches,
+            "dispatches_per_token":
+                round(dec.dispatches_per_token, 2),
+            "hbm_window_bytes": dec.hbm_window_bytes,
+            "hbm_working_set_bytes": dec.hbm_working_set_bytes,
             "dequantized_view_bytes": self.dequantized_view_bytes,
             "prefill_compiles": self.prefiller.prefill_compiles,
             "decode_traces": trace_counts(),
+            "decode_dispatch_counts": dispatch_counts(),
         }
 
 
@@ -442,4 +450,7 @@ def longctx_plane_from_conf(conf, cfg: ModelConfig, engine
         sp_mode=conf.get(SP_MODE_KEY, "ring"),
         window_blocks=conf.get_int(WINDOW_KEY, 4),
         tail_tokens=conf.get_int(TAIL_KEY, 256),
+        pipeline=conf.get_bool(PIPELINE_KEY, True),
+        sampler=conf.get(SAMPLER_KEY, "device"),
+        fetch_windows=conf.get_int(FETCH_KEY, 0),
         metrics=engine.metrics, tracer=engine.tracer)

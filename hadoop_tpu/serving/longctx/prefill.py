@@ -115,9 +115,17 @@ class ContextParallelPrefiller:
                                                final_hidden, run_layers_kv)
         from hadoop_tpu.ops import rope_frequencies
 
+        from hadoop_tpu.serving.weightplane import is_quantized_tree
+
         cfg, sp = self.cfg, self.sp
+        # int8-resident CP weights: a quantized tree (the engine's own
+        # weight plane, shared — no second resident copy) routes every
+        # local matmul through the dequantizing qdot inside the decoder
+        # body. The ctx flag is the relaxed-tier opt-in; a bitwise
+        # deployment never loads a quantized tree in the first place.
         ctx = ParallelCtx(ring_axis="sp", ring_size=sp,
-                          sp_mode=self.sp_mode)
+                          sp_mode=self.sp_mode,
+                          relaxed_qweights=is_quantized_tree(self.params))
 
         def local(params, tokens):
             # tokens: this rank's [S_pad / sp] shard
@@ -149,10 +157,15 @@ class ContextParallelPrefiller:
         import jax
 
         from hadoop_tpu.models.decoder import head_matrix
+        from hadoop_tpu.serving.weightplane import is_qtensor, qhead
         cfg = self.cfg
 
         def impl(params, row):
             self.head_compiles += 1
+            head = params["embed"] if cfg.tie_embeddings \
+                else params.get("lm_head")
+            if is_qtensor(head):
+                return qhead(params, row, cfg).astype(np.float32)
             return (row @ head_matrix(params, cfg, row.dtype)).astype(
                 np.float32)
 

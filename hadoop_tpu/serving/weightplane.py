@@ -205,8 +205,10 @@ def dequantize_weight(qw: Dict[str, Any], *, transpose: bool,
     return np.ascontiguousarray(out)
 
 
-def is_qtensor(leaf) -> bool:
-    """Is this params-tree node a quantized weight?"""
+def is_qtensor(leaf) -> bool:  # lint: static-fn — pytree structure
+    """Is this params-tree node a quantized weight? Structure, not
+    values: static at trace time (the fused decode jits branch on it
+    to pick the weight route per family)."""
     return isinstance(leaf, dict) and set(leaf.keys()) == _QKEYS
 
 
@@ -431,6 +433,15 @@ def qrows(qe, tokens, dtype):
     return rows.reshape(*rows.shape[:-2], -1).astype(dtype)
 
 
+def qslice(qw, l):
+    """Layer ``l``'s slice of a layer-stacked quantized weight — the
+    quantized twin of ``layers["wq"][l]``: both planes slice their
+    leading ``n_layers`` dim together so the scales can never pair
+    with another layer's payload. In-graph (``l`` may be a traced
+    index, as in the longctx decoder's per-layer dispatches)."""
+    return {"q": qw["q"][l], "s": qw["s"][l]}
+
+
 def qhead(params, h, cfg: ModelConfig):
     """Quantized LM head: ``h [..., D] @ head [D, V]`` where the head
     is the (transposed-stored) quantized ``lm_head`` — or the quantized
@@ -528,6 +539,6 @@ __all__ = [
     "quantize_weight", "dequantize_weight", "is_qtensor",
     "is_quantized_tree", "resident_weight_bytes", "describe_tree",
     "quantize_params", "make_load_quantizer", "quantized_load",
-    "dequantize_params", "qdot", "qrows", "qhead",
+    "dequantize_params", "qdot", "qrows", "qhead", "qslice",
     "weight_ab_report", "run_weight_ab",
 ]

@@ -947,6 +947,42 @@ def test_guarded_weightplane_entry_points_are_clean(tmp_path):
     assert findings == []
 
 
+def test_unguarded_qslice_calls_are_flagged(tmp_path):
+    """``qslice`` is the layer-sliced twin of ``qdot`` (the longctx
+    fused decode path's per-layer weight route) — same entry-point
+    contract, including through a renamed import."""
+    from hadoop_tpu.analysis import RelaxedGateChecker
+    findings = lint_source(tmp_path, """
+        from hadoop_tpu.serving.weightplane import qslice
+
+        def layer_weight(layers, l):
+            return qslice(layers["wq"], l)                    # BAD
+
+        def renamed(layers, l):
+            from hadoop_tpu.serving.weightplane import qslice as qs
+            return qs(layers["wo"], l)                        # BAD
+    """, [RelaxedGateChecker()])
+    assert len(findings) == 2
+    assert all(f.checker == "parity/relaxed-gated" for f in findings)
+
+
+def test_guarded_qslice_calls_are_clean(tmp_path):
+    from hadoop_tpu.analysis import RelaxedGateChecker
+    findings = lint_source(tmp_path, """
+        from hadoop_tpu.serving.weightplane import qdot, qslice
+
+        class FusedStep:
+            def _lw(self, layers, name, l):
+                if self._relaxed_qweights:
+                    return qslice(layers[name], l)
+                return layers[name][l]
+
+            def _mm(self, x, w, relaxed):
+                return qdot(x, w) if relaxed else x @ w
+    """, [RelaxedGateChecker()])
+    assert findings == []
+
+
 def test_unguarded_syncpolicy_entry_points_are_flagged(tmp_path):
     """The partially-synchronized sync schedule's entry points
     (parallel/lowp/syncpolicy.py) are relaxed-tier entry points: an

@@ -303,6 +303,238 @@ def test_engine_drain_finishes_longctx_request(tiny_model):
     assert len(req.out_tokens) == 2
 
 
+# ------------------------------------------ pipelined decode (fused path)
+
+def _prefill_chain(params, cfg, eng, prompt):
+    """CP prefill at sp=1 + stream the chain into the engine's tiers —
+    the decoder-level fixtures' shared setup (the plane does exactly
+    this per request)."""
+    from hadoop_tpu.serving.longctx import ContextParallelPrefiller
+    pre = ContextParallelPrefiller(params, cfg, block_size=8,
+                                   pad_tokens=160, sp=1)
+    res = pre.cp_prefill(prompt)
+    eng.kvstore.ingest_chain(prompt, res.blocks)
+    return res
+
+
+def _run_decoder(params, cfg, eng, prompt, res, sampling, **kw):
+    from hadoop_tpu.serving.longctx.decode import WorkingSetDecoder
+    dec = WorkingSetDecoder(params, cfg, eng.kvstore, block_size=8,
+                            window_blocks=3, tail_tokens=64, **kw)
+    out = []
+    dec.paged_decode(prompt, int(np.argmax(res.last_logits)), sampling,
+                     tail_k=res.tail_k, tail_v=res.tail_v,
+                     deliver=out.append, seed=11,
+                     rng=np.random.default_rng(11))
+    return out, dec
+
+
+@cp_only
+def test_pipelined_decode_is_token_identical_to_legacy(tiny_model):
+    """The fused path's A-B vs the pre-pipelining loop it replaced:
+    same chain, same tail, same sampler stream — identical tokens,
+    greedy AND stochastic (the pipelined host-sampler fallback draws
+    the legacy loop's exact rng stream; the in-graph device sampler is
+    greedy-identical by construction). Alongside: the per-token budgets
+    the pipelining exists for, audited on the real counters —
+    dispatches <= 2 per (token, window) + head, and host->HBM
+    transfers counted per (layer, slab), O(chain) instead of the
+    legacy loop's O(layers x chain) window slices."""
+    params, cfg = tiny_model
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=8,
+                       max_context=64, kv_host_bytes=1 << 22,
+                       metrics=ServingMetrics())
+    try:
+        prompt = _prompt(cfg, 150)
+        res = _prefill_chain(params, cfg, eng, prompt)
+        greedy = SamplingParams(max_new_tokens=6)
+        legacy, dl = _run_decoder(params, cfg, eng, prompt, res,
+                                  greedy, pipeline=False)
+        fused, df = _run_decoder(params, cfg, eng, prompt, res, greedy)
+        host, _ = _run_decoder(params, cfg, eng, prompt, res, greedy,
+                               sampler="host")
+        assert fused == legacy == host and len(fused) == 5
+        # stochastic A-B rides the host sampler on both arms
+        sp = SamplingParams(max_new_tokens=6, temperature=0.8, top_k=5)
+        a, _ = _run_decoder(params, cfg, eng, prompt, res, sp,
+                            pipeline=False)
+        b, _ = _run_decoder(params, cfg, eng, prompt, res, sp,
+                            sampler="host")
+        assert a == b
+        # ---- budgets (chain = 18 full blocks = 144 tokens)
+        chain = (len(prompt) // 8) * 8
+        n_win = -(-chain // df.win)
+        assert df.dispatches_per_token <= 2 * n_win + 1
+        assert df.dispatches < dl.dispatches
+        # fetches: one per (layer, slab) on the fused path — the slab
+        # IS the transfer unit — one per (layer, window) SLICE legacy
+        n_slabs = -(-chain // (df.fetch_windows * df.win))
+        assert df.window_fetches == cfg.n_layers * n_slabs * 5
+        assert dl.window_fetches == cfg.n_layers * n_win * 5
+        assert df.window_fetches < dl.window_fetches
+    finally:
+        eng.stop()
+
+
+@cp_only
+def test_fused_family_compiles_once_across_tokens(tiny_model):
+    """Compile-once on the fused family: a multi-token paged decode —
+    across two decoder INSTANCES and both samplers — traces each of
+    fstart/fadvance/fwin/ffinish/fhead exactly once (the module-level
+    jit cache is per layout family, not per decoder)."""
+    from hadoop_tpu.serving.longctx.decode import trace_counts
+    params, cfg = tiny_model
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=8,
+                       max_context=64, kv_host_bytes=1 << 22,
+                       metrics=ServingMetrics())
+    try:
+        prompt = _prompt(cfg, 150)
+        res = _prefill_chain(params, cfg, eng, prompt)
+        greedy = SamplingParams(max_new_tokens=5)
+        _, dec = _run_decoder(params, cfg, eng, prompt, res, greedy)
+        _run_decoder(params, cfg, eng, prompt, res, greedy,
+                     sampler="host")
+        fam = dec._fused.family
+        tc = trace_counts()
+        for piece in ("fstart", "fadvance", "fwin", "ffinish", "fhead"):
+            assert tc[f"{piece}@{fam}"] == 1, (piece, tc)
+    finally:
+        eng.stop()
+
+
+@cp_only
+def test_int8_longctx_serves_and_guard_accepts(tiny_model):
+    """int8-resident CP weights: the plane serves straight off the
+    quantized tree (no dequantized second copy), the weight A-B guard
+    accepts the arm, and a zeroed payload is REJECTED — the guard is
+    falsifiable, not a rubber stamp."""
+    from hadoop_tpu.serving.longctx import LongContextPlane
+    from hadoop_tpu.serving.weightplane import (WeightPlaneConfig,
+                                                dequantize_params,
+                                                quantize_params,
+                                                run_weight_ab)
+    params, cfg = tiny_model
+    wp = WeightPlaneConfig(tier="relaxed", quant_embed=True,
+                           quant_head=True)
+    qparams, rep = quantize_params(params, cfg, wp)
+    assert rep["leaves_quantized"] > 0
+    ab = run_weight_ab(cfg, params, qparams, wp=wp)
+    assert ab["accepted"], ab
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=8,
+                       max_context=64, kv_host_bytes=1 << 22,
+                       metrics=ServingMetrics())
+    plane = LongContextPlane(qparams, cfg, eng.kvstore, block_size=8,
+                             min_tokens=100, max_tokens=256, sp=1,
+                             window_blocks=3, tail_tokens=64,
+                             metrics=eng.metrics)
+    try:
+        prompt = _prompt(cfg, 150)
+        req = plane.longctx_submit(prompt,
+                                   SamplingParams(max_new_tokens=4))
+        toks = req.wait(180)
+        # greedy off the int8 plane == greedy off the dequantized
+        # reconstruction (numerically what qdot contracts against)
+        assert toks == _reference_greedy(
+            dequantize_params(qparams, cfg), cfg, prompt, 4)
+        st = plane.stats()
+        assert st["int8_weights"] is True
+        assert st["dequantized_view_bytes"] == 0
+    finally:
+        plane.stop()
+        eng.stop()
+    # falsifiability: zero one layer matmul's payload -> rejected
+    broken = dict(qparams)
+    broken["layers"] = dict(qparams["layers"])
+    wq = qparams["layers"]["wq"]
+    broken["layers"]["wq"] = {"q": np.zeros_like(wq["q"]),
+                              "s": wq["s"]}
+    assert not run_weight_ab(cfg, params, broken, wp=wp)["accepted"]
+    # the legacy loop cannot serve a quantized tree: loud, not wrong
+    from hadoop_tpu.serving.longctx.decode import WorkingSetDecoder
+    with pytest.raises(ValueError, match="pipeline"):
+        WorkingSetDecoder(qparams, cfg, eng.kvstore, block_size=8,
+                          pipeline=False)
+
+
+def test_hbm_ledger_reflects_decode_double_buffer(tiny_model):
+    """Live HBM ledger: the pipelined decoder's window component is
+    BOTH in-flight slabs of the double buffer (2x one window at the
+    default slab depth), the in-graph sampler registers its device
+    state, /v1/health surfaces the same split, and stop() unregisters
+    every owner — a stopped plane never haunts /prom."""
+    from hadoop_tpu.obs.hbm import hbm_ledger
+    from hadoop_tpu.serving.longctx.decode import WorkingSetDecoder
+    params, cfg = tiny_model
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=8,
+                       max_context=64, kv_host_bytes=1 << 20,
+                       metrics=ServingMetrics())
+    plane = _mk_plane(params, cfg, eng, sp=1)
+    eng.attach_longctx(plane)
+    try:
+        dec = plane.decoder
+        assert dec.fetch_windows == cfg.n_layers
+        # slab depth = n_layers => one slab costs exactly one window
+        # of per-token working-set bytes; the double buffer costs two
+        assert dec.hbm_window_bytes == 2 * dec.win * dec._per_tok_bytes
+        assert dec.hbm_working_set_bytes == (
+            dec.hbm_window_bytes + dec.tail_cap * dec._per_tok_bytes
+            + dec.sampler_state_bytes)
+        comps = hbm_ledger().report()["components"]
+        assert comps["longctx_window"] == dec.hbm_window_bytes
+        assert comps["longctx_tail"] == \
+            dec.tail_cap * dec._per_tok_bytes
+        assert comps["longctx_sampler"] == dec.sampler_state_bytes > 0
+        from hadoop_tpu.conf import Configuration
+        from hadoop_tpu.serving.server import ServingServer
+        srv = ServingServer(eng, Configuration(load_defaults=False))
+        _, health = srv._health({}, b"")
+        assert health["hbm"]["components"]["longctx_window"] == \
+            dec.hbm_window_bytes
+        # the legacy loop keeps the pre-pipelining accounting: one
+        # window in flight, no device sampler state
+        dl = WorkingSetDecoder(params, cfg, eng.kvstore, block_size=8,
+                               window_blocks=3, tail_tokens=64,
+                               pipeline=False)
+        assert dl.hbm_window_bytes == dl.win * dl._per_tok_bytes
+        assert dl.sampler_state_bytes == 0
+    finally:
+        eng.stop()
+    comps = hbm_ledger().report()["components"]
+    assert "longctx_window" not in comps
+    assert "longctx_sampler" not in comps
+
+
+def test_plane_from_conf_reads_decode_pipeline_keys(tiny_model):
+    from hadoop_tpu.conf import Configuration
+    from hadoop_tpu.serving.longctx import longctx_plane_from_conf
+    params, cfg = tiny_model
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=8,
+                       max_context=64, kv_host_bytes=1 << 20,
+                       metrics=ServingMetrics())
+    try:
+        conf = Configuration(load_defaults=False)
+        conf.set("serving.parity", "relaxed")
+        conf.set("serving.longctx.min.tokens", "100")
+        conf.set("serving.longctx.chips", "1")
+        conf.set("serving.longctx.decode.pipeline", "false")
+        conf.set("serving.longctx.decode.sampler", "host")
+        plane = longctx_plane_from_conf(conf, cfg, eng)
+        assert plane.decoder.pipeline is False
+        assert plane.decoder.sampler == "host"
+        plane.stop()
+        conf.set("serving.longctx.decode.pipeline", "true")
+        conf.set("serving.longctx.decode.fetch.windows", "2")
+        plane = longctx_plane_from_conf(conf, cfg, eng)
+        assert plane.decoder.pipeline is True
+        assert plane.decoder.fetch_windows == 2
+        plane.stop()
+        conf.set("serving.longctx.decode.sampler", "bogus")
+        with pytest.raises(ValueError, match="sampler"):
+            longctx_plane_from_conf(conf, cfg, eng)
+    finally:
+        eng.stop()
+
+
 # ------------------------------------------------------------ validation
 
 def test_longctx_submit_validation(tiny_model):
