@@ -37,6 +37,38 @@ def _dynamometer(n_ops: int) -> dict:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def _lint_selfrun() -> dict:
+    """tpulint self-run as a bench suite: the full tree against the
+    committed baseline plus the conf-registry drift gate — a dirty
+    tree or a stale registry is a trajectory failure like any other."""
+    import os
+
+    from hadoop_tpu.analysis import all_checkers, confscan
+    from hadoop_tpu.analysis.core import (load_baseline, run_lint,
+                                          split_baselined)
+    from hadoop_tpu.conf import registry
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    t0 = time.perf_counter()
+    checkers = all_checkers()
+    findings = run_lint([os.path.join(repo, "hadoop_tpu")],
+                        checkers=checkers, root=repo)
+    baseline = load_baseline(os.path.join(repo, "LINT_BASELINE"))
+    new, old = split_baselined(findings, baseline)
+    gate_ok, diff = confscan.check_registry(repo)
+    failures = [f.render() for f in new[:20]]
+    if not gate_ok:
+        failures.append(f"conf registry stale ({len(diff)} diff lines)")
+    return {"checkers": len(checkers),
+            "unbaselined": len(new),
+            "baselined": len(old),
+            "registry_keys": len(registry.KEYS),
+            "registry_patterns": len(registry.PATTERNS),
+            "registry_gate_ok": gate_ok,
+            "wall_seconds": round(time.perf_counter() - t0, 2),
+            "failures": failures}
+
+
 def _code_hash() -> str:
     """Short git hash of the tree the suite ran against (the train-row
     precedent in BENCH_LOG.jsonl carries the same ``code`` field)."""
@@ -106,6 +138,11 @@ _KEY_METRICS = {
              (("partial_sync", "exec_ratio"), "sync_exec_ratio"),
              (("partial_sync", "guard_accepted"),
               "sync_guard_accepted")],
+    # static-analysis plane: the self-run is healthy when it stays at
+    # zero unbaselined findings with the registry gate green
+    "lint": [(("unbaselined",), "unbaselined"),
+             (("registry_keys",), "registry_keys"),
+             (("wall_seconds",), "wall_seconds")],
 }
 
 
@@ -324,6 +361,15 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — recorded for the
         # trajectory; must not discard the benches already computed
         out["flight_elastic"] = {"error": f"{type(e).__name__}: {e}"}
+    # Static-analysis plane: tpulint self-run (all checkers against the
+    # committed baseline) + the conf-registry drift gate, timed so a
+    # creeping lint cost, a dirty tree, or a stale registry surfaces in
+    # the bench trajectory. Recorded-not-raised.
+    try:
+        out["lint"] = _lint_selfrun()
+    except Exception as e:  # noqa: BLE001 — recorded for the
+        # trajectory; must not discard the benches already computed
+        out["lint"] = {"error": f"{type(e).__name__}: {e}"}
     out["wall_seconds"] = round(time.perf_counter() - t0, 1)
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)

@@ -30,6 +30,12 @@ as pluggable checkers over a shared parsed-module project:
              parallel/lowp) may only be reached under a lexical guard
              naming the relaxed tier, so parallel.parity=bitwise
              provably compiles byte-identical graphs.
+``conf/*``   conf-lever discipline: every ``conf.get*`` site resolved
+             into the generated registry (conf/registry.py) and judged
+             for default drift (one key, two defaults or two typed
+             getters), undocumented keys, stale doc entries, and
+             near-miss typo clusters inside a namespace. The registry
+             itself is gated by ``--check-conf-registry``.
 
 Entry points: ``hadoop-tpu lint`` and ``python -m hadoop_tpu.analysis``.
 Findings are suppressible per line with ``# lint: disable=<id>`` or via a
@@ -37,6 +43,7 @@ committed baseline file; the run exits nonzero on any unbaselined
 finding, so tier-1 keeps the tree lint-clean.
 """
 
+from hadoop_tpu.analysis.confcheck import ConfDisciplineChecker
 from hadoop_tpu.analysis.core import (Finding, Project, SourceModule,
                                       load_baseline, run_lint)
 from hadoop_tpu.analysis.jitcheck import (JitDisciplineChecker,
@@ -55,7 +62,8 @@ def all_checkers():
     return [GuardedByChecker(), LockOrderChecker(), JitDisciplineChecker(),
             StepBlockingChecker(), TimeoutChecker(), RetryHygieneChecker(),
             SilentSwallowChecker(), SpanFinishChecker(),
-            PromFamilyChecker(), RelaxedGateChecker()]
+            PromFamilyChecker(), RelaxedGateChecker(),
+            ConfDisciplineChecker()]
 
 
 __all__ = ["Finding", "Project", "SourceModule", "run_lint",
@@ -64,4 +72,4 @@ __all__ = ["Finding", "Project", "SourceModule", "run_lint",
            "StepBlockingChecker", "TimeoutChecker",
            "RetryHygieneChecker", "SilentSwallowChecker",
            "SpanFinishChecker", "PromFamilyChecker",
-           "RelaxedGateChecker"]
+           "RelaxedGateChecker", "ConfDisciplineChecker"]

@@ -42,6 +42,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--write-baseline", action="store_true",
                     help="write current findings to the baseline file "
                          "and exit 0")
+    ap.add_argument("--write-conf-registry", action="store_true",
+                    help="regenerate hadoop_tpu/conf/registry.py and the "
+                         "README conf-key appendix from the tree, then "
+                         "exit 0")
+    ap.add_argument("--check-conf-registry", action="store_true",
+                    help="fail (exit 1) with a diff when regenerating "
+                         "the conf registry would change anything — the "
+                         "tier-1 drift gate")
     ap.add_argument("--checkers", metavar="IDS", default=None,
                     help="comma-separated checker names to run "
                          "(default: all)")
@@ -70,6 +78,31 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not os.path.exists(p):
             print(f"lint: no such path: {p}", file=sys.stderr)
             return 2
+
+    if args.write_conf_registry or args.check_conf_registry:
+        from hadoop_tpu.analysis import confscan
+        # root = the repo holding the (first) linted package, so the
+        # registry and README land next to the tree they describe
+        root = os.path.abspath(paths[0])
+        if os.path.isfile(root):
+            root = os.path.dirname(root)
+        while os.path.isfile(os.path.join(root, "__init__.py")):
+            root = os.path.dirname(root)
+        if args.write_conf_registry:
+            changed = confscan.write_registry(root)
+            print(f"lint: conf registry "
+                  + (f"updated ({', '.join(changed)})" if changed
+                     else "already current"))
+            return 0
+        ok, diff = confscan.check_registry(root)
+        if ok:
+            print("lint: conf registry current")
+            return 0
+        for line in diff[:120]:
+            print(line)
+        print("lint: conf registry is STALE — run "
+              "`hadoop-tpu lint --write-conf-registry` and commit")
+        return 1
 
     # root: make finding paths stable (hadoop_tpu/... relative) wherever
     # the command runs from, matching committed baseline keys

@@ -1,0 +1,236 @@
+"""Conf-lever discipline — the registry's four checkers.
+
+``conf/default-drift`` — one key, two truths. The same conf key read
+with different resolved defaults in different files means the fleet's
+effective default depends on which module reads first; the same key
+read through conflicting typed getters (``get_list`` here, ``get``
+there) means the two sites disagree about the value's shape. Both are
+the exact bug class the reference centralises ``DFSConfigKeys`` to
+prevent. Fix by single-sourcing the key and default in
+``hadoop_tpu/conf/keys.py``.
+
+``conf/undocumented-key`` — a key read in code but absent from
+README.md (generated appendix included). Every lever an operator can
+set must be documented; ``hadoop-tpu lint --write-conf-registry``
+regenerates the appendix so the fix is mechanical.
+
+``conf/stale-doc-key`` — a key documented in a marked README conf
+table (``<!-- conf-keys:begin -->`` blocks and the generated appendix)
+that no code reads. Stale docs send operators chasing knobs that do
+nothing — usually a typo'd or renamed key.
+
+``conf/typo-cluster`` — near-miss key names inside one registered
+namespace: same parent with leaf edit distance 1
+(``...data.dir`` / ``...data.dirs``), or whole-key equality after
+separator normalisation (``store-dir`` / ``store.dir``). One of the
+pair is a typo of the other; readers of each see half the
+configuration.
+
+All four run in ``finalize`` over the shared ``confscan`` extraction,
+so a fixture tree and the shipped tree are judged identically.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+from hadoop_tpu.analysis.core import (Checker, Finding, Project,
+                                      SourceModule)
+from hadoop_tpu.analysis.confscan import (ABSENT, DYNAMIC, ConfRead,
+                                          doc_covers, readme_doc_keys,
+                                          scan_project)
+
+
+def _edit1(a: str, b: str) -> bool:
+    """Levenshtein distance exactly 1 (one insert/delete/substitute)."""
+    if a == b:
+        return False
+    la, lb = len(a), len(b)
+    if abs(la - lb) > 1:
+        return False
+    if la > lb:
+        a, b, la, lb = b, a, lb, la
+    i = j = diffs = 0
+    while i < la and j < lb:
+        if a[i] == b[j]:
+            i += 1
+            j += 1
+            continue
+        diffs += 1
+        if diffs > 1:
+            return False
+        if la == lb:
+            i += 1
+            j += 1
+        else:
+            j += 1
+    return True
+
+
+def _split_key(key: str) -> Tuple[str, str]:
+    return key.rsplit(".", 1) if "." in key else ("", key)
+
+
+class ConfDisciplineChecker(Checker):
+    name = "conf"
+    ids = ("conf/default-drift", "conf/undocumented-key",
+           "conf/stale-doc-key", "conf/typo-cluster")
+
+    def finalize(self, project: Project) -> List[Finding]:
+        if not project.modules:
+            return []
+        scan = scan_project(project)
+        by_rel: Dict[str, SourceModule] = {m.rel: m for m in
+                                           project.modules}
+        readme = self._readme(project)
+        findings: List[Finding] = []
+
+        concrete: Dict[str, List[ConfRead]] = {}
+        patterns: Dict[str, List[ConfRead]] = {}
+        for r in scan.reads:
+            (patterns if r.is_pattern else concrete).setdefault(
+                r.key, []).append(r)
+        for reads in concrete.values():
+            reads.sort(key=lambda r: (r.rel, r.line))
+        for reads in patterns.values():
+            reads.sort(key=lambda r: (r.rel, r.line))
+
+        self._check_drift(concrete, by_rel, findings)
+        self._check_typos(concrete, by_rel, findings)
+        if readme is not None:
+            self._check_docs(concrete, patterns, readme, by_rel, findings)
+        return findings
+
+    # ----------------------------------------------------------- readme
+
+    @staticmethod
+    def _readme(project: Project) -> Optional[Tuple[str, str]]:
+        """(rel path, text) of the lint root's README, when present."""
+        mod = project.modules[0]
+        suffix = mod.rel.replace("/", os.sep)
+        if not mod.path.endswith(suffix):
+            return None
+        root = mod.path[:-len(suffix)]
+        path = os.path.join(root, "README.md")
+        if not os.path.isfile(path):
+            return None
+        with open(path, "r", encoding="utf-8") as fh:
+            return ("README.md", fh.read())
+
+    # ------------------------------------------------------------ drift
+
+    def _check_drift(self, concrete: Dict[str, List[ConfRead]],
+                     by_rel: Dict[str, SourceModule],
+                     findings: List[Finding]) -> None:
+        for key, reads in sorted(concrete.items()):
+            resolved = [r for r in reads
+                        if r.defaults not in ((ABSENT,), (DYNAMIC,))]
+            if len({r.defaults for r in resolved}) > 1:
+                first = resolved[0]
+                for r in resolved[1:]:
+                    if r.defaults == first.defaults:
+                        continue
+                    self._emit(
+                        by_rel, r, "conf/default-drift",
+                        f"conf key '{key}' read with default "
+                        f"{', '.join(r.defaults)} here but "
+                        f"{', '.join(first.defaults)} at "
+                        f"{first.rel}:{first.line} — the effective "
+                        f"default depends on which module reads first; "
+                        f"single-source it in hadoop_tpu/conf/keys.py",
+                        findings)
+            if len({r.rtype for r in reads}) > 1:
+                first = reads[0]
+                for r in reads[1:]:
+                    if r.rtype == first.rtype:
+                        continue
+                    self._emit(
+                        by_rel, r, "conf/default-drift",
+                        f"conf key '{key}' read as {r.rtype} here but as "
+                        f"{first.rtype} at {first.rel}:{first.line} — "
+                        f"the two sites disagree about the value's shape",
+                        findings)
+
+    # ------------------------------------------------------------ typos
+
+    def _check_typos(self, concrete: Dict[str, List[ConfRead]],
+                     by_rel: Dict[str, SourceModule],
+                     findings: List[Finding]) -> None:
+        keys = sorted(concrete)
+        for i, a in enumerate(keys):
+            pa, la = _split_key(a)
+            for b in keys[i + 1:]:
+                pb, lb = _split_key(b)
+                near = (pa == pb and _edit1(la, lb)) or \
+                    (a.replace("-", ".") == b.replace("-", "."))
+                if not near:
+                    continue
+                # flag the rarer spelling — it is usually the typo
+                # (ties: the lexicographically later one)
+                fa, fb = len(concrete[a]), len(concrete[b])
+                if fa != fb:
+                    victim, other = (a, b) if fa < fb else (b, a)
+                else:
+                    victim, other = (b, a) if a < b else (a, b)
+                site = concrete[victim][0]
+                o = concrete[other][0]
+                self._emit(
+                    by_rel, site, "conf/typo-cluster",
+                    f"conf key '{victim}' is a near-miss of '{other}' "
+                    f"(read at {o.rel}:{o.line}) — writers of one are "
+                    f"invisible to readers of the other; unify the "
+                    f"spelling (a DeprecationDelta keeps old setters "
+                    f"working)", findings)
+
+    # ------------------------------------------------------------- docs
+
+    def _check_docs(self, concrete: Dict[str, List[ConfRead]],
+                    patterns: Dict[str, List[ConfRead]],
+                    readme: Tuple[str, str],
+                    by_rel: Dict[str, SourceModule],
+                    findings: List[Finding]) -> None:
+        rel, text = readme
+        docs = readme_doc_keys(text)
+        all_docs = set(docs)
+        for key in sorted(set(concrete) | set(patterns)):
+            if doc_covers(all_docs, key):
+                continue
+            site = (concrete.get(key) or patterns[key])[0]
+            self._emit(
+                by_rel, site, "conf/undocumented-key",
+                f"conf key '{key}' is read here but documented nowhere "
+                f"in {rel} — every operator-settable lever must be "
+                f"documented (hadoop-tpu lint --write-conf-registry "
+                f"regenerates the appendix)", findings)
+        roots = {k.split(".", 1)[0] for k in concrete} | \
+                {k.split(".", 1)[0] for k in patterns}
+        roots.discard("*")
+        registered = set(concrete) | set(patterns)
+        for tok in sorted(docs):
+            line, in_gen, in_doc = docs[tok]
+            if not (in_gen or in_doc):
+                continue          # prose mention, not a conf table row
+            if tok.split(".", 1)[0] not in roots:
+                continue
+            if doc_covers(registered, tok):
+                continue
+            findings.append(Finding(
+                rel, line, "conf/stale-doc-key",
+                f"documented conf key '{tok}' is read nowhere in the "
+                f"tree — a stale or typo'd doc entry sends operators "
+                f"chasing a knob that does nothing"))
+
+    # ------------------------------------------------------------ emit
+
+    @staticmethod
+    def _emit(by_rel: Dict[str, SourceModule], read: ConfRead,
+              checker: str, message: str,
+              findings: List[Finding]) -> None:
+        mod = by_rel.get(read.rel)
+        if mod is None:
+            findings.append(Finding(read.rel, read.line, checker, message))
+            return
+        f = mod.finding(read.line, checker, message)
+        if f is not None:
+            findings.append(f)

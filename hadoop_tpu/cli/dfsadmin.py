@@ -15,6 +15,7 @@ import sys
 from typing import List, Optional
 
 from hadoop_tpu.conf import Configuration
+from hadoop_tpu.conf.keys import FS_DEFAULT_FS
 from hadoop_tpu.fs.filesystem import FileSystem, Path
 from hadoop_tpu.io import erasurecode as ec
 
@@ -32,7 +33,7 @@ class DFSAdmin:
 
     def fs(self):
         if self._fs is None:
-            uri = self.conf.get("fs.defaultFS", "")
+            uri = self.conf.get(FS_DEFAULT_FS) or ""
             self._fs = FileSystem.get(uri, self.conf)
             if not hasattr(self._fs, "client"):
                 raise ValueError(
@@ -219,7 +220,7 @@ class Fsck:
 
     def fs(self):
         if self._fs is None:
-            uri = self.conf.get("fs.defaultFS", "")
+            uri = self.conf.get(FS_DEFAULT_FS) or ""
             self._fs = FileSystem.get(uri, self.conf)
             if not hasattr(self._fs, "client"):
                 raise ValueError(

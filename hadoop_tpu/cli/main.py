@@ -33,6 +33,9 @@ import sys
 from typing import List, Tuple
 
 from hadoop_tpu.conf import Configuration
+from hadoop_tpu.conf.keys import (DFS_NAMENODE_RPC_ADDRESS,
+                                  DFS_NAMENODE_RPC_ADDRESS_DEFAULT,
+                                  FS_DEFAULT_FS, FS_DEFAULT_FS_DEFAULT)
 
 VERSION = "0.1.0"
 
@@ -57,7 +60,7 @@ def parse_generic_options(conf: Configuration,
             conf.add_resource(argv[i + 1])
             i += 2
         elif a == "-fs" and i + 1 < len(argv):
-            conf.set("fs.defaultFS", argv[i + 1])
+            conf.set(FS_DEFAULT_FS, argv[i + 1])
             i += 2
         else:
             rest.append(a)
@@ -133,8 +136,8 @@ def _main(argv=None) -> int:
         threshold = 0.10
         if "-threshold" in rest:
             threshold = float(rest[rest.index("-threshold") + 1])
-        addrs = parse_addr_list(conf.get("dfs.namenode.rpc-address",
-                                         "127.0.0.1:8020"))
+        addrs = parse_addr_list(conf.get(DFS_NAMENODE_RPC_ADDRESS,
+                                         DFS_NAMENODE_RPC_ADDRESS_DEFAULT))
         bal = Balancer(addrs, conf, threshold=threshold)
         try:
             stats = bal.run()
@@ -145,8 +148,8 @@ def _main(argv=None) -> int:
     if cmd == "mover":
         from hadoop_tpu.dfs.balancer import Mover
         from hadoop_tpu.util.misc import parse_addr_list
-        addrs = parse_addr_list(conf.get("dfs.namenode.rpc-address",
-                                         "127.0.0.1:8020"))
+        addrs = parse_addr_list(conf.get(DFS_NAMENODE_RPC_ADDRESS,
+                                         DFS_NAMENODE_RPC_ADDRESS_DEFAULT))
         mover = Mover(addrs, conf)
         try:
             stats = mover.run(rest[0] if rest else "/")
@@ -175,14 +178,14 @@ def _main(argv=None) -> int:
     if cmd == "historyserver":
         from hadoop_tpu.mapreduce.historyserver import JobHistoryServer
         return _run_daemon(JobHistoryServer(
-            conf, conf.get("fs.defaultFS", "file:///")), conf)
+            conf, conf.get(FS_DEFAULT_FS, FS_DEFAULT_FS_DEFAULT)), conf)
     if cmd == "kms":
         from hadoop_tpu.crypto.kms import KMSServer
         return _run_daemon(KMSServer(conf), conf)
     if cmd == "httpfs":
         from hadoop_tpu.dfs.httpfs import HttpFSServer
         return _run_daemon(HttpFSServer(
-            conf, conf.get("fs.defaultFS", "file:///")), conf)
+            conf, conf.get(FS_DEFAULT_FS, FS_DEFAULT_FS_DEFAULT)), conf)
     if cmd == "router":
         from hadoop_tpu.dfs.router import Router
         return _run_daemon(Router(conf), conf)
@@ -242,7 +245,7 @@ def _main(argv=None) -> int:
     if cmd == "cacheadmin":
         # ref: hdfs cacheadmin — -addDirective/-listDirectives/-remove
         from hadoop_tpu.fs import FileSystem
-        fs = FileSystem.get(conf.get("fs.defaultFS", "file:///"), conf)
+        fs = FileSystem.get(conf.get(FS_DEFAULT_FS, FS_DEFAULT_FS_DEFAULT), conf)
         try:
             if rest[:1] == ["-addDirective"]:
                 print(fs.add_cache_directive(rest[1]))
@@ -263,7 +266,7 @@ def _main(argv=None) -> int:
     if cmd == "crypto":
         # ref: hdfs crypto — -createZone/-listZones
         from hadoop_tpu.fs import FileSystem
-        fs = FileSystem.get(conf.get("fs.defaultFS", "file:///"), conf)
+        fs = FileSystem.get(conf.get(FS_DEFAULT_FS, FS_DEFAULT_FS_DEFAULT), conf)
         try:
             if rest[:1] == ["-createZone"]:
                 # -createZone -keyName K PATH

@@ -15,6 +15,9 @@ import time
 from typing import List, Optional
 
 from hadoop_tpu.conf import Configuration
+from hadoop_tpu.conf.keys import (FS_DEFAULT_FS, FS_DEFAULT_FS_DEFAULT,
+                                  FS_TRASH_INTERVAL,
+                                  FS_TRASH_INTERVAL_DEFAULT)
 from hadoop_tpu.fs.filesystem import FileSystem, Path
 from hadoop_tpu.fs.trash import Trash
 
@@ -50,7 +53,9 @@ class FsShell:
     def _fs(self, path: str) -> FileSystem:
         p = Path(path)
         if p.scheme == "file" and not path.startswith("file:"):
-            default = self.conf.get("fs.defaultFS", "")
+            # presence probe, not a defaulted read: only an EXPLICIT
+            # fs.defaultFS redirects schemeless paths
+            default = self.conf.get(FS_DEFAULT_FS) or ""
             if default:
                 key = default
                 if key not in self._fs_cache:
@@ -190,7 +195,8 @@ class FsShell:
         recursive = "-r" in args or "-R" in args
         skip_trash = "-skipTrash" in args
         paths = [a for a in args if not a.startswith("-")]
-        interval = self.conf.get_time_seconds("fs.trash.interval", 0.0)
+        interval = self.conf.get_time_seconds(FS_TRASH_INTERVAL,
+                                              FS_TRASH_INTERVAL_DEFAULT)
         for path in paths:
             fs = self._fs(path)
             p = Path(path).path
@@ -211,9 +217,12 @@ class FsShell:
         return self.cmd_rm(["-r"] + args)
 
     def cmd_expunge(self, args: List[str]) -> int:
-        fs = self._fs(self.conf.get("fs.defaultFS", "/"))
-        trash = Trash(fs, self.conf.get_time_seconds(
-            "fs.trash.interval", 24 * 3600.0))
+        fs = self._fs(self.conf.get(FS_DEFAULT_FS, FS_DEFAULT_FS_DEFAULT))
+        # expunge still needs a checkpoint period when trash is off
+        # (interval default 0): fall back to one day explicitly
+        interval = self.conf.get_time_seconds(FS_TRASH_INTERVAL,
+                                              FS_TRASH_INTERVAL_DEFAULT)
+        trash = Trash(fs, interval or 24 * 3600.0)
         trash.checkpoint()
         for gone in trash.expunge():
             self._print(f"Deleted trash checkpoint: {gone}")
@@ -272,7 +281,7 @@ class FsShell:
 
     def cmd_df(self, args: List[str]) -> int:
         fs = self._fs(args[0] if args else
-                      self.conf.get("fs.defaultFS", "/"))
+                      self.conf.get(FS_DEFAULT_FS, FS_DEFAULT_FS_DEFAULT))
         stats = fs.client.nn.get_stats() if hasattr(fs, "client") else {}
         self._print(f"Filesystem stats: {stats}")
         return 0

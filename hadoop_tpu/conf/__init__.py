@@ -1,3 +1,4 @@
 from hadoop_tpu.conf.configuration import Configuration, ConfigRegistry
+from hadoop_tpu.conf import keys  # noqa: F401  — registers deprecations
 
-__all__ = ["Configuration", "ConfigRegistry"]
+__all__ = ["Configuration", "ConfigRegistry", "keys"]

@@ -40,6 +40,33 @@ _TIME_SUFFIXES = {  # ref: Configuration.getTimeDuration
 _TRUE = {"true", "yes", "on", "1"}
 _FALSE = {"false", "no", "off", "0"}
 
+# Registry strict mode: opt in with conf.strict.keys=true and every
+# set() of a key the generated registry doesn't know warns once — the
+# runtime face of tpulint's conf-discipline family (a typo'd key is
+# caught at the set, not three subsystems later when nothing reads it).
+_STRICT_KEY = "conf.strict.keys"
+
+
+def _strict_enabled(conf: "Configuration") -> bool:
+    return conf.get_bool(_STRICT_KEY, False)
+
+
+def _registry_knows(key: str) -> bool:
+    """The generated registry (hadoop_tpu/conf/registry.py) accounts for
+    ``key`` — as a concrete key, a dynamic-family pattern, or a
+    deprecated spelling. A missing registry knows everything (partial
+    checkouts must not warn on every set)."""
+    try:
+        from hadoop_tpu.conf import registry
+    except ImportError:  # pragma: no cover - registry not generated yet
+        return True
+    if key == _STRICT_KEY or key in registry.KEYS:
+        return True
+    if ConfigRegistry.deprecation_for(key) is not None:
+        return True
+    from fnmatch import fnmatchcase
+    return any(fnmatchcase(key, p) for p in registry.PATTERNS)
+
 
 class DeprecationDelta:
     """One deprecated key and its replacement(s). Ref: Configuration.DeprecationDelta."""
@@ -86,9 +113,17 @@ class ConfigRegistry:
 
     @classmethod
     def reset_for_tests(cls) -> None:
+        """Back to the SHIPPED state: no default resources, and the
+        tree's own deprecation table (conf/keys.py) re-registered fresh
+        so warn-once flags reset too."""
         with cls._lock:
             cls._default_resources = []
             cls._deprecations = {}
+        try:
+            from hadoop_tpu.conf.keys import shipped_deprecations
+        except ImportError:  # pragma: no cover - partial checkouts
+            return
+        cls.add_deprecations(shipped_deprecations())
 
 
 @audience.public
@@ -103,6 +138,7 @@ class Configuration:
         self._finals: set = set()
         self._sources: Dict[str, str] = {}
         self._reconf_listeners: List[Callable[[str, Optional[str], Optional[str]], None]] = []
+        self._strict_warned: set = set()  # strict-mode warn-once, per key
         if other is not None:
             with other._lock:
                 self._props = dict(other._props)
@@ -212,6 +248,14 @@ class Configuration:
             self._props[k] = str(value)
             self._sources[k] = source
             listeners = list(self._reconf_listeners)
+        # outside the lock: the strict probe re-enters get_raw
+        if k not in self._strict_warned and _strict_enabled(self) and \
+                not _registry_knows(k):
+            self._strict_warned.add(k)
+            log.warning(
+                "conf.strict.keys: set() of key %r that the conf "
+                "registry does not know — a typo, or a new lever that "
+                "needs `hadoop-tpu lint --write-conf-registry`", k)
         for cb in listeners:
             cb(k, old, str(value))
 
@@ -252,9 +296,13 @@ class Configuration:
         v = self.get_trimmed(key)
         if v is None or v == "":
             return default
-        if v.lower().startswith("0x"):
-            return int(v, 16)
-        return int(v)
+        try:
+            if v.lower().startswith("0x"):
+                return int(v, 16)
+            return int(v)
+        except ValueError:
+            raise ValueError(
+                f"conf key {key!r}: invalid int value {v!r}") from None
 
     def get_float(self, key: str, default: float = 0.0) -> float:
         v = self.get_trimmed(key)
@@ -262,14 +310,18 @@ class Configuration:
 
     def get_bool(self, key: str, default: bool = False) -> bool:
         v = self.get_trimmed(key)
-        if v is None:
+        if v is None or v == "":
             return default
         vl = v.lower()
         if vl in _TRUE:
             return True
         if vl in _FALSE:
             return False
-        return default
+        # loudly, naming the key: a silent fall-through to the default
+        # turns "treu" into production-off and nobody ever finds out
+        raise ValueError(
+            f"conf key {key!r}: invalid boolean value {v!r} (accepted: "
+            f"{'/'.join(sorted(_TRUE))} or {'/'.join(sorted(_FALSE))})")
 
     def get_size_bytes(self, key: str, default: int = 0) -> int:
         """'64m' → 67108864. Ref: Configuration.getLongBytes."""

@@ -1,0 +1,78 @@
+"""Shared conf keys and defaults — single-sourced (ref: DFSConfigKeys).
+
+The reference centralises every key + default in per-subsystem
+``*ConfigKeys`` classes precisely so two readers can never disagree
+about a default. This module is the same move for the keys this tree
+reads from MORE than one file: each constant pair here is the one
+truth, and tpulint's ``conf/default-drift`` checker keeps it that way
+(two sites reading one key with different literal defaults fail tier-1
+on the empty baseline).
+
+Keys read from exactly one site stay literal at that site — hoisting
+them all here would just move 300 lines without adding a guarantee;
+the generated registry (``hadoop_tpu/conf/registry.py``) already
+records them.
+
+``shipped_deprecations`` is the tree's DeprecationDelta table — old
+spellings that tpulint's ``conf/typo-cluster`` checker caught reading
+as two distinct keys (``store-dir``/``store.dir``,
+``data.dirs``/``data.dir``) keep working for setters while every
+reader sees the unified spelling.
+"""
+
+from hadoop_tpu.conf.configuration import ConfigRegistry, DeprecationDelta
+
+# fs: the default filesystem URI. Empty-string / "/" spellings drifted
+# across the CLIs; "file:///" is the canonical no-cluster default.
+FS_DEFAULT_FS = "fs.defaultFS"
+FS_DEFAULT_FS_DEFAULT = "file:///"
+
+# fs: trash retention. 0 disables trash (ref: fs.trash.interval,
+# core-default.xml) — commands that need a checkpoint period when trash
+# is off (expunge) fall back explicitly rather than via a bigger default.
+FS_TRASH_INTERVAL = "fs.trash.interval"
+FS_TRASH_INTERVAL_DEFAULT = 0.0
+
+# dfs: NameNode RPC endpoint(s), comma list for HA pairs.
+DFS_NAMENODE_RPC_ADDRESS = "dfs.namenode.rpc-address"
+DFS_NAMENODE_RPC_ADDRESS_DEFAULT = "127.0.0.1:8020"
+
+# dfs: hedged reads are enabled by a NONZERO pool size (ref:
+# dfs.client.hedged.read.threadpool.size, default 0 = off). The pool
+# builder clamps to >=2 workers when hedging is live.
+DFS_CLIENT_HEDGED_READ_POOL_SIZE = "dfs.client.hedged.read.threadpool.size"
+DFS_CLIENT_HEDGED_READ_POOL_SIZE_DEFAULT = 0
+
+# dfs: DataNode volume roots, comma list (ref: dfs.datanode.data.dir
+# backing FsVolumeList). First entry is the primary/metadata volume;
+# more than one entry makes the node multi-volume.
+DFS_DATANODE_DATA_DIR = "dfs.datanode.data.dir"
+DFS_DATANODE_DATA_DIR_DEFAULT = "/tmp/htpu-data"
+
+# ipc: idle-connection close. The CLIENT closes a call-free connection
+# after 10s (ref: ipc.client.connection.maxidletime, client reader);
+# the SERVER's reaper keeps sockets longer so short-lived idle clients
+# reconnect cheaply. These were one key read with two defaults — now
+# two keys, each with one truth.
+IPC_CLIENT_CONNECTION_MAXIDLETIME = "ipc.client.connection.maxidletime"
+IPC_CLIENT_CONNECTION_MAXIDLETIME_DEFAULT = 10.0
+IPC_SERVER_CONNECTION_MAXIDLETIME = "ipc.server.connection.maxidletime"
+IPC_SERVER_CONNECTION_MAXIDLETIME_DEFAULT = 120.0
+
+# yarn: timeline store root — one spelling for the NM collectors and
+# the RM publisher (the "store-dir" twin is deprecated below).
+YARN_TIMELINE_STORE_DIR = "yarn.timeline-service.store.dir"
+
+
+def shipped_deprecations():
+    """Fresh DeprecationDelta instances for the tree's renamed keys
+    (fresh so warn-once state resets with the registry)."""
+    return [
+        DeprecationDelta("yarn.timeline-service.store-dir",
+                         [YARN_TIMELINE_STORE_DIR]),
+        DeprecationDelta("dfs.datanode.data.dirs",
+                         [DFS_DATANODE_DATA_DIR]),
+    ]
+
+
+ConfigRegistry.add_deprecations(shipped_deprecations())

@@ -250,8 +250,14 @@ class DFSClient:
         with self._hedged_pool_lock:
             if self._hedged_pool is None:
                 from concurrent.futures import ThreadPoolExecutor
+                from hadoop_tpu.conf.keys import (
+                    DFS_CLIENT_HEDGED_READ_POOL_SIZE,
+                    DFS_CLIENT_HEDGED_READ_POOL_SIZE_DEFAULT)
                 size = self.conf.get_int(
-                    "dfs.client.hedged.read.threadpool.size", 4)
+                    DFS_CLIENT_HEDGED_READ_POOL_SIZE,
+                    DFS_CLIENT_HEDGED_READ_POOL_SIZE_DEFAULT)
+                # only reached when streams saw a nonzero pool size;
+                # clamp so a racing reconfigure still gets a live pool
                 self._hedged_workers = max(2, size)
                 self._hedged_pool = ThreadPoolExecutor(
                     max_workers=self._hedged_workers,

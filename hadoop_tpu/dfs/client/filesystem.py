@@ -82,9 +82,13 @@ class DistributedFileSystem(FileSystem):
             host, _, port = path.authority.partition(":")
             addrs = [(host, int(port))]
         else:
-            addrs = [tuple(a.rsplit(":", 1))
-                     for a in conf.get_list("dfs.namenode.rpc-address")]
-            addrs = [(h, int(p)) for h, p in addrs]
+            from hadoop_tpu.conf.keys import (
+                DFS_NAMENODE_RPC_ADDRESS,
+                DFS_NAMENODE_RPC_ADDRESS_DEFAULT)
+            from hadoop_tpu.util.misc import parse_addr_list
+            addrs = parse_addr_list(conf.get(
+                DFS_NAMENODE_RPC_ADDRESS,
+                DFS_NAMENODE_RPC_ADDRESS_DEFAULT))
         return cls(addrs, conf)
 
     def open(self, path: str):

@@ -430,8 +430,12 @@ class DFSInputStream:
         # answer from the first replica, a second read races it.
         self._hedged_threshold_s = 0.5
         self._hedged_enabled = False
+        from hadoop_tpu.conf.keys import (
+            DFS_CLIENT_HEDGED_READ_POOL_SIZE,
+            DFS_CLIENT_HEDGED_READ_POOL_SIZE_DEFAULT)
         if conf is not None and conf.get_int(
-                "dfs.client.hedged.read.threadpool.size", 0) > 0:
+                DFS_CLIENT_HEDGED_READ_POOL_SIZE,
+                DFS_CLIENT_HEDGED_READ_POOL_SIZE_DEFAULT) > 0:
             self._hedged_enabled = True
             self._hedged_threshold_s = conf.get_time_seconds(
                 "dfs.client.hedged.read.threshold", 0.5)

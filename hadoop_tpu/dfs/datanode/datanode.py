@@ -62,13 +62,19 @@ class DataNode(AbstractService):
     def __init__(self, conf: Configuration, data_dir: Optional[str] = None,
                  nn_addr=None):
         super().__init__("DataNode")
-        self.data_dir = data_dir or conf.get("dfs.datanode.data.dir",
-                                             "/tmp/htpu-data")
+        from hadoop_tpu.conf.keys import (
+            DFS_DATANODE_DATA_DIR, DFS_DATANODE_DATA_DIR_DEFAULT,
+            DFS_NAMENODE_RPC_ADDRESS, DFS_NAMENODE_RPC_ADDRESS_DEFAULT)
+        # dfs.datanode.data.dir is a comma list (ref: FsVolumeList);
+        # the first entry is the primary/metadata volume
+        self.data_dir = data_dir or conf.get_list(
+            DFS_DATANODE_DATA_DIR, [DFS_DATANODE_DATA_DIR_DEFAULT])[0]
         host = conf.get("dfs.datanode.hostname", "127.0.0.1")
         if nn_addr is None:
             from hadoop_tpu.util.misc import parse_addr_list
-            self.nn_addrs = parse_addr_list(
-                conf.get("dfs.namenode.rpc-address", "127.0.0.1:8020"))
+            self.nn_addrs = parse_addr_list(conf.get(
+                DFS_NAMENODE_RPC_ADDRESS,
+                DFS_NAMENODE_RPC_ADDRESS_DEFAULT))
         elif isinstance(nn_addr, tuple):
             self.nn_addrs = [nn_addr]
         else:
@@ -116,11 +122,13 @@ class DataNode(AbstractService):
     # ------------------------------------------------------------- lifecycle
 
     def service_init(self, conf: Configuration) -> None:
-        # Multi-volume node when dfs.datanode.data.dirs lists several
-        # directories (ref: dfs.datanode.data.dir is a comma list backing
-        # FsVolumeList); single-volume stays on the plain BlockStore.
-        extra_dirs = [d for d in conf.get(
-            "dfs.datanode.data.dirs", "").split(",") if d.strip()]
+        # Multi-volume node when dfs.datanode.data.dir lists several
+        # directories (ref: a comma list backing FsVolumeList; the old
+        # "data.dirs" spelling is a registered DeprecationDelta);
+        # single-volume stays on the plain BlockStore.
+        from hadoop_tpu.conf.keys import DFS_DATANODE_DATA_DIR
+        dirs = conf.get_list(DFS_DATANODE_DATA_DIR)
+        extra_dirs = dirs if len(dirs) > 1 else []
         n_vols = conf.get_int("dfs.datanode.volumes", 1)
         if not extra_dirs and n_vols > 1:
             extra_dirs = [os.path.join(self.data_dir, f"current{i}")

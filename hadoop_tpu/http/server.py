@@ -130,6 +130,9 @@ class HttpServer:
         # the process' registered decay accountings (obs/top.py)
         self.add_handler("/ws/v1/stacks", self._ws_stacks)
         self.add_handler("/ws/v1/top", self._ws_top)
+        # machine-readable twin of /conf: the effective lever table
+        # diffed against the generated conf registry (ISSUE 18)
+        self.add_handler("/ws/v1/conf", self._ws_conf)
         from hadoop_tpu.tracing.collector import span_collector
         span_collector().configure(self.conf)
 
@@ -273,6 +276,64 @@ class HttpServer:
             else:
                 redacted[k] = v
         return 200, redacted
+
+    def _ws_conf(self, query, body):
+        """Effective lever table: every registered conf key joined with
+        this daemon's live Configuration and diffed against the
+        registry's recorded defaults. Rows carry the tunable-lever
+        annotation (type/range/guard) when one exists, so an autotuner
+        can discover its legal search space over HTTP. ``?diff=1``
+        returns only overridden rows. Same redaction rule as /conf."""
+        try:
+            from hadoop_tpu.conf import registry
+        except ImportError:
+            return 503, {"error": "conf registry not generated — run "
+                                  "`hadoop-tpu lint --write-conf-registry`"}
+        import fnmatch as _fn
+
+        def _redact(k: str, v):
+            lk = k.lower()
+            if any(s in lk for s in ("secret", "password", "keytab",
+                                     "credential")):
+                return "<redacted>"
+            return v
+
+        live = self.conf.to_dict()
+        diff_only = (query.get("diff") or "") in ("1", "true", "yes")
+        rows = []
+        overridden = []
+        for key, meta in sorted(registry.KEYS.items()):
+            is_set = key in live
+            if is_set:
+                overridden.append(key)
+            if diff_only and not is_set:
+                continue
+            row = {"key": key,
+                   "type": meta["type"],
+                   "defaults": list(meta["defaults"]),
+                   "namespace": meta["namespace"],
+                   "documented": meta["documented"],
+                   "source": "set" if is_set else "default",
+                   "effective": _redact(key, live[key]) if is_set else None}
+            lever = registry.LEVERS.get(key)
+            if lever is not None:
+                row["lever"] = {lk: list(lv) if isinstance(lv, tuple) else lv
+                                for lk, lv in lever.items()}
+            rows.append(row)
+        # set() keys the registry has never heard of — typos, or levers
+        # born after the last --write-conf-registry run
+        unregistered = sorted(
+            k for k in live
+            if k not in registry.KEYS
+            and not any(_fn.fnmatch(k, p) for p in registry.PATTERNS))
+        return 200, {
+            "registry_keys": len(registry.KEYS),
+            "patterns": sorted(registry.PATTERNS),
+            "keys": rows,
+            "overridden": overridden,
+            "unregistered": [{"key": k, "value": _redact(k, live[k])}
+                             for k in unregistered],
+        }
 
     def _prom(self, query, body):
         """Prometheus text exposition of the live metrics system.

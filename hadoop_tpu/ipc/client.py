@@ -81,8 +81,12 @@ class _Connection:
         # Client-side idle close (ref: ipc.client.connection.maxidletime,
         # client default 10s): a connection with no outstanding calls closes
         # itself rather than pinging the server's idle reaper awake forever.
+        from hadoop_tpu.conf.keys import (
+            IPC_CLIENT_CONNECTION_MAXIDLETIME,
+            IPC_CLIENT_CONNECTION_MAXIDLETIME_DEFAULT)
         self.max_idle_s = conf.get_time_seconds(
-            "ipc.client.connection.maxidletime", 10.0)
+            IPC_CLIENT_CONNECTION_MAXIDLETIME,
+            IPC_CLIENT_CONNECTION_MAXIDLETIME_DEFAULT)
         # Read timeout (ref: ipc.client.rpc-timeout + Client.java's
         # pingInterval-bounded reads): with calls outstanding, a server
         # that sends NOTHING for this long is declared hung and every

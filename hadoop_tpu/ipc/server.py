@@ -170,7 +170,14 @@ class Server:
         self._responder: Optional["_Responder"] = None
         self._conns: Dict[int, _Connection] = {}  # guarded-by: _conns_lock
         self._conns_lock = threading.Lock()
-        self.max_idle_s = self.conf.get_time_seconds("ipc.client.connection.maxidletime", 120.0)
+        # server reaper keeps idle sockets longer than the client's own
+        # 10s close — its own key, so the two defaults can't drift
+        from hadoop_tpu.conf.keys import (
+            IPC_SERVER_CONNECTION_MAXIDLETIME,
+            IPC_SERVER_CONNECTION_MAXIDLETIME_DEFAULT)
+        self.max_idle_s = self.conf.get_time_seconds(
+            IPC_SERVER_CONNECTION_MAXIDLETIME,
+            IPC_SERVER_CONNECTION_MAXIDLETIME_DEFAULT)
         self.reuse_port = self.conf.get_bool("ipc.server.reuseport", False)
         reg = metrics_system().source(f"rpc.{name}")
         self._m_calls = reg.counter("rpc_processing_calls")

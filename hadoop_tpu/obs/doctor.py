@@ -495,10 +495,14 @@ class FleetDoctor(AbstractService):
 
     def _nn_proxies(self):
         if self._nn_proxy is None:
+            from hadoop_tpu.conf.keys import (
+                DFS_NAMENODE_RPC_ADDRESS,
+                DFS_NAMENODE_RPC_ADDRESS_DEFAULT)
             from hadoop_tpu.ipc import Client, get_proxy
             from hadoop_tpu.util.misc import parse_addr_list
             addrs = parse_addr_list(self.config.get(
-                "dfs.namenode.rpc-address", "127.0.0.1:8020"))
+                DFS_NAMENODE_RPC_ADDRESS,
+                DFS_NAMENODE_RPC_ADDRESS_DEFAULT))
             if self._rpc_client is None:
                 self._rpc_client = Client(self.config)
             self._nn_proxy = [

@@ -241,9 +241,10 @@ class NodeAgent(AbstractService):
             self.csi = None
         self.timeline = None
         if conf.get_bool("yarn.timeline-service.enabled", False):
+            from hadoop_tpu.conf.keys import YARN_TIMELINE_STORE_DIR
             from hadoop_tpu.yarn.timeline import TimelineCollectorManager
             self.timeline = TimelineCollectorManager(
-                conf.get("yarn.timeline-service.store.dir",
+                conf.get(YARN_TIMELINE_STORE_DIR,
                          os.path.join(self.work_root, "timeline")),
                 backend=conf.get(
                     "yarn.timeline-service.store.backend", "auto"))

@@ -539,9 +539,10 @@ class ResourceManager(AbstractService):
         self.state_store = FileRMStateStore(self.state_dir)
         # App lifecycle → timeline store (ref: SystemMetricsPublisher;
         # serving side: yarn/timeline.py ApplicationHistoryServer)
+        from hadoop_tpu.conf.keys import YARN_TIMELINE_STORE_DIR
         from hadoop_tpu.yarn.timeline import TimelinePublisher, make_store
         self.timeline = TimelinePublisher(make_store(
-            conf.get("yarn.timeline-service.store-dir",
+            conf.get(YARN_TIMELINE_STORE_DIR,
                      os.path.join(self.state_dir, "timeline")),
             conf.get("yarn.timeline-service.store.backend", "auto")))
         self.rpc: Optional[Server] = None

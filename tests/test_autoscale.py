@@ -353,13 +353,19 @@ def test_door_sheds_heavy_tenant_with_retry_after(tiny_model):
             t.start()
         deadline = time.monotonic() + 10
         # once the parked queue crosses the shed line, further heavy
-        # arrivals (including some of the parked threads) shed
-        while eng.queue_depth < 2 and time.monotonic() < deadline:
+        # arrivals (including some of the parked threads) shed. The
+        # gate only sheds with >= 2 TRACKED tenants, so also wait for
+        # the light probe's charge to land — two parked heavies alone
+        # satisfy the depth check, and if light loses the thread-start
+        # race the next heavy is (correctly) admitted, parking for the
+        # server's full 60s default timeout.
+        while (eng.queue_depth < 2 or gate.sched.num_tenants < 2) \
+                and time.monotonic() < deadline:
             time.sleep(0.01)
-        assert eng.queue_depth >= 2
+        assert eng.queue_depth >= 2 and gate.sched.num_tenants >= 2
         status, body, retry_after = _post_json(
             srv.port, "/v1/generate?user.name=heavy",
-            {"tokens": [1, 2], "max_new_tokens": 4})
+            {"tokens": [1, 2], "max_new_tokens": 4, "timeout": 5})
         assert status == 429, body
         assert "ServerTooBusy" in str(body)
         assert retry_after is not None and float(retry_after) > 0

@@ -135,3 +135,64 @@ def test_get_class():
     c = Configuration(load_defaults=False)
     c.set("impl", "hadoop_tpu.conf.configuration.Configuration")
     assert c.get_class("impl") is Configuration
+
+
+def test_get_int_garbage_is_loud():
+    c = Configuration(load_defaults=False)
+    c.set("i", "not-a-number")
+    with pytest.raises(ValueError) as exc:
+        c.get_int("i")
+    assert "i" in str(exc.value) and "not-a-number" in str(exc.value)
+
+
+def test_get_bool_garbage_is_loud():
+    c = Configuration(load_defaults=False)
+    c.set("b", "yeah")
+    with pytest.raises(ValueError) as exc:
+        c.get_bool("b")
+    assert "b" in str(exc.value) and "yeah" in str(exc.value)
+
+
+def test_get_bool_accepted_literals():
+    c = Configuration(load_defaults=False)
+    for raw in ("true", "YES", "On", "1"):
+        c.set("b", raw)
+        assert c.get_bool("b") is True, raw
+    for raw in ("false", "NO", "Off", "0"):
+        c.set("b", raw)
+        assert c.get_bool("b") is False, raw
+    c.set("b", "")
+    assert c.get_bool("b", True) is True  # empty = unset, default wins
+
+
+def test_strict_mode_warns_on_unknown_key(caplog):
+    import logging
+    c = Configuration(load_defaults=False)
+    c.set("conf.strict.keys", "true")
+    with caplog.at_level(logging.WARNING, logger="hadoop_tpu.conf"):
+        c.set("dfs.blocksize.typo-key", "1")  # not in the registry
+        c.set("dfs.blocksize", "64m")         # registered: silent
+        c.set("fs.htpu.endpoint", "x")        # pattern fs.*.endpoint: silent
+        c.set("dfs.blocksize.typo-key", "2")  # warn-once per key
+    warned = [r for r in caplog.records if "registry" in r.getMessage()]
+    assert len(warned) == 1
+    assert "dfs.blocksize.typo-key" in warned[0].getMessage()
+
+
+def test_strict_mode_off_is_silent(caplog):
+    import logging
+    c = Configuration(load_defaults=False)
+    with caplog.at_level(logging.WARNING, logger="hadoop_tpu.conf"):
+        c.set("total.garbage.key", "1")
+    assert [r for r in caplog.records if "registry" in r.getMessage()] == []
+
+
+def test_shipped_deprecations_survive_registry_reset():
+    """conftest resets ConfigRegistry per test; the shipped deltas
+    (data.dirs -> data.dir, store-dir -> store.dir) must come back."""
+    ConfigRegistry.reset_for_tests()
+    c = Configuration(load_defaults=False)
+    c.set("dfs.datanode.data.dirs", "/a,/b")
+    assert c.get("dfs.datanode.data.dir") == "/a,/b"
+    c.set("yarn.timeline-service.store-dir", "/tl")
+    assert c.get("yarn.timeline-service.store.dir") == "/tl"

@@ -200,3 +200,39 @@ def test_ifile_rejects_sentinel_colliding_keys(monkeypatch):
     with _p.raises(ValueError, match="key"):
         ifile.write_partitioned_streams("/dev/null",
                                         [iter([(b"k" * 64, b"v")])])
+
+
+def test_ws_conf_lever_table():
+    """/ws/v1/conf: the registry joined with the live conf — overridden
+    keys diffed out, lever annotations attached, set-but-unregistered
+    keys surfaced, credentials redacted (same rule as /conf)."""
+    conf = Configuration(load_defaults=False)
+    conf.set("dfs.blocksize", "64m")            # registered override
+    conf.set("serving.max.lanes", "32")         # registered, has a lever
+    conf.set("totally.unknown.key", "x")        # not in the registry
+    conf.set("serving.http.auth.secret", "s3"); # registered + redacted
+    srv = HttpServer(conf, daemon_name="unit")
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        st, table = _get(f"{base}/ws/v1/conf")
+        assert st == 200
+        assert table["registry_keys"] > 300
+        rows = {r["key"]: r for r in table["keys"]}
+        assert rows["dfs.blocksize"]["source"] == "set"
+        assert rows["dfs.blocksize"]["effective"] == "64m"
+        assert rows["dfs.blocksize"]["type"] == "size"
+        # unset keys report their registry default, no effective value
+        assert rows["dfs.replication"]["source"] == "default"
+        assert rows["dfs.replication"]["effective"] is None
+        lever = rows["serving.max.lanes"]["lever"]
+        assert lever["guard"] == "capacity" and lever["range"] == [1, 256]
+        assert rows["serving.http.auth.secret"]["effective"] == "<redacted>"
+        assert "dfs.blocksize" in table["overridden"]
+        unreg = {u["key"] for u in table["unregistered"]}
+        assert unreg == {"totally.unknown.key"}
+        # ?diff=1 keeps only the overridden rows
+        st, diff = _get(f"{base}/ws/v1/conf?diff=1")
+        assert {r["key"] for r in diff["keys"]} == set(table["overridden"])
+    finally:
+        srv.stop()
