@@ -111,6 +111,16 @@ _KEY_METRICS = {
     "serving": [(("value",), "ttft_p50_ms")],
     "serving_speculate": [(("steps_ratio",), "steps_ratio")],
     "serving_quantized": [(("value",), "capacity_ratio")],
+    # expert-parallel MoE serving (serving/engine._moe_mlp): the lever
+    # counts as moving when the trajectory shows sparse tokens/s priced
+    # against dense-compute NEXT TO the ledger-measured a2a byte cut
+    # and the guard verdict that bought it
+    "serving_moe": [
+        (("moe_tokens_per_sec",), "moe_tokens_per_sec"),
+        (("dense_tokens_per_sec",), "moe_dense_tokens_per_sec"),
+        (("moe_a2a_payload_ratio",), "moe_a2a_payload_ratio"),
+        (("guard_accepted",), "moe_guard_accepted"),
+        (("falsifier_rejected",), "moe_falsifier_rejected")],
     "trace_overhead": [(("step", "overhead_frac"), "overhead_frac")],
     "doctor": [(("windows_to_flag",), "windows_to_flag")],
     "flight_recorder": [(("windows_to_flag",), "windows_to_flag")],
@@ -262,6 +272,18 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — recorded for the
         # trajectory; must not discard the benches already computed
         out["serving_quantized"] = {"error": f"{type(e).__name__}: {e}"}
+    # MoE serving smoke: one int8-expert checkpoint served sparse vs
+    # dense-compute — the quantized all2all payload must measure >= 2x
+    # below the f32 reference on the comm ledger, the logits A-B guard
+    # must accept (and its zeroed-payload falsifier reject), and both
+    # step shapes compile exactly once on both arms. Recorded, not
+    # raised.
+    try:
+        from benchmarks import serve_bench
+        out["serving_moe"] = serve_bench.run_moe_smoke()
+    except Exception as e:  # noqa: BLE001 — recorded for the
+        # trajectory; must not discard the benches already computed
+        out["serving_moe"] = {"error": f"{type(e).__name__}: {e}"}
     # Replica-churn smoke: kill/restart an engine mid shared-prefix
     # workload over a miniDFS-backed KV store — fleet hit-rate must
     # recover via the DFS tier (post-restart hits > 0, strictly fewer

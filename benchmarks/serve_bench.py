@@ -25,6 +25,13 @@ Two workload modes:
   fixed HBM budget; fails unless the int8 arm admits >= 2x the
   lanes x context (and KV blocks), the logits A-B guard accepts the
   greedy outputs, and both shapes compile exactly once on both arms.
+- ``--moe``: the MoE serving A-B — one int8-expert checkpoint served
+  sparse (config top_k) vs dense-compute (top_k = n_experts) at the
+  same parameters; fails unless the relaxed-tier quantized all2all
+  payload measures >= 2x below the f32 reference on the comm ledger
+  (``moe.dispatch``/``moe.combine`` sites), the logits A-B guard
+  accepts and its zeroed-expert-payload falsifier rejects, and both
+  step shapes compile exactly once on both arms.
 - ``--longctx``: the long-context arm (``benchmarks/longctx_smoke``,
   8-virtual-device subprocess): a prompt 8x one chip's KV budget
   prefilled context-parallel across the mesh, KV streamed into the
@@ -487,6 +494,197 @@ def run_quantized_smoke() -> dict:
     blocks at fixed HBM, logits A-B guard accepted, compile-once per
     shape on both arms)."""
     result = run_quantized(preset="tiny")
+    if result["failed"]:
+        raise AssertionError("; ".join(result["failed"]))
+    return result
+
+
+def run_moe(preset="tiny-moe", requests=16, max_new=12, block_size=4,
+            chunk=8, max_context=64, max_batch=2, group=16,
+            seed=0) -> dict:
+    """MoE serving A-B: dense-compute vs sparse dispatch at equal
+    quality, plus the relaxed-tier all2all byte contract.
+
+    One MoE checkpoint, int8-quantized expert stacks
+    (serving/weightplane.py — the expert dims quantize through the same
+    policy table as dense), served twice with identical weights:
+
+    - ``sparse``: the config's top_k (the production shape — each token
+      computes only its routed experts' FLOPs);
+    - ``dense``:  top_k = n_experts (every expert active for every
+      token — the dense-equivalent compute at the same parameters, the
+      cost baseline sparse routing is supposed to beat).
+
+    The hard contract (``failed``, all deterministic):
+
+    - the quantized all2all dispatch/combine payloads measure >= 2x
+      below the f32 reference bytes ON THE COMM LEDGER
+      (``moe.dispatch``/``moe.combine`` sites, payload/reference/
+      executions dimensions — int8 payload + one f32 scale per
+      (expert, slot) row vs the f32 exchange);
+    - greedy-output acceptance via the logits A-B guard
+      (``run_weight_ab``; MoE thresholds — routing flips at near-tie
+      tokens cause localized logit spikes, so the rel-err bound is
+      wide and the argmax-agreement dimension carries the systematic-
+      damage check);
+    - falsifiability: the same guard REJECTS a zeroed expert payload
+      (w_down int8 bytes zeroed, scales kept) — proof the acceptance
+      above is a real measurement, not a rubber stamp;
+    - both step shapes compile exactly once on both arms (capacity
+      padding keeps the routed step's shapes static).
+
+    tokens/s for both arms is wall-clock — advisory on a contended CPU
+    box; the ledger byte ratio and the guard verdicts are the stable
+    signal (sparse-slower-than-dense at toy scale is a warning, not a
+    failure: with 4 tiny experts the routing einsums dominate)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hadoop_tpu.models.config import get_config
+    from hadoop_tpu.models.decoder import count_params, init_params
+    from hadoop_tpu.parallel.lowp.quant import capture_comm
+    from hadoop_tpu.serving.engine import DecodeEngine, SamplingParams
+    from hadoop_tpu.serving.weightplane import (EXPERT_STACKS,
+                                                WeightPlaneConfig,
+                                                expert_weight_bytes,
+                                                quantize_params,
+                                                run_weight_ab)
+
+    cfg = get_config(preset)
+    if not cfg.is_moe:
+        raise ValueError(f"--moe needs an MoE preset, got {preset!r}")
+    rng = np.random.default_rng(seed)
+    params = init_params(jax.random.PRNGKey(seed), cfg)
+    wp = WeightPlaneConfig(tier="relaxed", group=group)
+    qparams, qreport = quantize_params(params, cfg, wp)
+
+    sampling = SamplingParams(max_new_tokens=max_new)
+    s_max = -(-min(max_context, cfg.max_seq) // block_size) * block_size
+    prompts = [rng.integers(0, cfg.vocab_size,
+                            size=int(rng.integers(4, max(5, s_max
+                                                         - max_new - 1)))
+                            ).tolist()
+               for _ in range(requests)]
+
+    def arm(arm_cfg):
+        eng = DecodeEngine(qparams, arm_cfg, max_batch=max_batch,
+                           block_size=block_size,
+                           max_context=max_context, prefill_chunk=chunk,
+                           quantize_seconds=qreport["quantize_seconds"])
+        eng.generate([prompts[0][:2]], SamplingParams(max_new_tokens=2))
+        t0 = time.monotonic()
+        reqs = [eng.submit(pr, sampling) for pr in prompts]
+        while not all(r.done.is_set() for r in reqs):
+            eng.step()
+        elapsed = time.monotonic() - t0
+        tokens = sum(len(r.out_tokens) for r in reqs)
+        plane = eng.weight_plane()
+        return {
+            "tokens_per_sec": round(tokens / elapsed, 1),
+            "elapsed_s": round(elapsed, 3),
+            "top_k": arm_cfg.top_k,
+            "expert_capacity": plane["expert_capacity"],
+            "decode_compiles": eng.decode_compiles,
+            "prefill_compiles": eng.prefill_compiles,
+        }
+
+    sparse = arm(cfg)
+    dense = arm(dataclasses.replace(cfg, top_k=cfg.n_experts))
+
+    # ---- the all2all byte contract, measured on the comm ledger: one
+    # fresh engine traced (both shapes) inside the capture window — the
+    # ledger's executions dimension counts what the hardware runs per
+    # step (n_layers legs via comm_scale), the ratio is reference/payload
+    with capture_comm() as led:
+        eng = DecodeEngine(qparams, cfg, max_batch=max_batch,
+                           block_size=block_size,
+                           max_context=max_context, prefill_chunk=chunk)
+        eng.generate([prompts[0][:6]], SamplingParams(max_new_tokens=4))
+    a2a_sites = {s: v for s, v in led.per_site.items()
+                 if s.startswith("moe.")}
+    a2a_ratio = led.ratio
+
+    # ---- acceptance + falsifiability: MoE guard thresholds are wider
+    # on rel-err (near-tie routing flips spike single positions) and
+    # lean on greedy agreement; the zeroed-payload arm proves the guard
+    # still discriminates at these thresholds
+    moe_agree, moe_rel = 0.9, 3.0
+    guard = run_weight_ab(cfg, params, qparams, seed=seed, wp=wp,
+                          min_agree=moe_agree, rel_tol=moe_rel)
+    broken = dict(qparams)
+    broken["layers"] = dict(qparams["layers"])
+    wd = qparams["layers"]["w_down"]
+    broken["layers"]["w_down"] = {"q": jnp.zeros_like(wd["q"]),
+                                  "s": wd["s"]}
+    falsifier = run_weight_ab(cfg, params, broken, seed=seed, wp=wp,
+                              min_agree=moe_agree, rel_tol=moe_rel)
+
+    failed = []
+    warnings = []
+    if not a2a_sites or {"moe.dispatch", "moe.combine"} - set(a2a_sites):
+        failed.append(f"comm ledger missing MoE a2a sites: recorded "
+                      f"{sorted(led.per_site)}")
+    if a2a_ratio < 2.0:
+        failed.append(
+            f"quantized a2a payload is only {a2a_ratio:.2f}x below the "
+            f"f32 reference on the comm ledger (contract: >= 2x)")
+    if not guard.get("accepted"):
+        failed.append(f"logits/output A-B guard rejected the int8 MoE "
+                      f"weight plane: {guard.get('reason')}")
+    if falsifier.get("accepted"):
+        failed.append("falsifiability arm FAILED: the guard accepted a "
+                      "zeroed expert payload — the acceptance above "
+                      "proves nothing")
+    for name, r in (("sparse", sparse), ("dense", dense)):
+        for counter in ("decode_compiles", "prefill_compiles"):
+            if r[counter] != 1:
+                failed.append(
+                    f"{name}: {counter} == {r[counter]} (expected "
+                    f"exactly 1 — shape retracing crept in)")
+    if sparse["tokens_per_sec"] < dense["tokens_per_sec"]:
+        warnings.append(
+            f"sparse arm ({sparse['tokens_per_sec']} tok/s) slower than "
+            f"dense-compute arm ({dense['tokens_per_sec']} tok/s) — "
+            f"expected at toy scale, routing overhead dominates "
+            f"{cfg.n_experts} tiny experts")
+    return {
+        "metric": "serve_moe_a2a_payload_ratio",
+        "value": round(a2a_ratio, 3),
+        "unit": "x f32 reference bytes on the comm ledger",
+        "preset": preset,
+        "n_params": count_params(params),
+        "n_experts": cfg.n_experts,
+        "top_k": cfg.top_k,
+        "capacity_factor": cfg.capacity_factor,
+        "moe_tokens_per_sec": sparse["tokens_per_sec"],
+        "dense_tokens_per_sec": dense["tokens_per_sec"],
+        "moe_a2a_payload_ratio": round(a2a_ratio, 3),
+        "guard_accepted": int(bool(guard.get("accepted"))),
+        "falsifier_rejected": int(not falsifier.get("accepted")),
+        "expert_bytes_f32": expert_weight_bytes(params, cfg),
+        "expert_bytes_int8": expert_weight_bytes(qparams, cfg),
+        "expert_stacks": sorted(EXPERT_STACKS),
+        "a2a_sites": a2a_sites,
+        "weight_plane": {k: v for k, v in qreport.items()
+                         if not k.startswith("_")},
+        "guard": guard,
+        "falsifier": falsifier,
+        "sparse": sparse,
+        "dense": dense,
+        "failed": failed,
+        "warnings": warnings,
+    }
+
+
+def run_moe_smoke() -> dict:
+    """Tiny MoE A-B smoke for benchmarks.run_all: raises unless the
+    expert-serving contract holds (a2a payload >= 2x below reference on
+    the comm ledger, guard accepted, zeroed-payload falsifier rejected,
+    compile-once per shape on both arms)."""
+    result = run_moe()
     if result["failed"]:
         raise AssertionError("; ".join(result["failed"]))
     return result
@@ -1170,7 +1368,16 @@ def main(argv=None) -> int:
                          "greedy outputs, and both step shapes compile "
                          "exactly once on both arms")
     ap.add_argument("--group", type=int, default=16,
-                    help="weight scale-group size (--quantized)")
+                    help="weight scale-group size (--quantized/--moe)")
+    ap.add_argument("--moe", action="store_true",
+                    help="MoE serving A-B: one int8-expert checkpoint "
+                         "served sparse (config top_k) and dense-"
+                         "compute (top_k = n_experts); fails unless "
+                         "the quantized all2all payload measures >= 2x "
+                         "below the f32 reference on the comm ledger, "
+                         "the logits A-B guard accepts and its zeroed-"
+                         "payload falsifier rejects, and both step "
+                         "shapes compile exactly once on both arms")
     ap.add_argument("--longctx", action="store_true",
                     help="long-context arm (benchmarks/longctx_smoke "
                          "in an 8-virtual-device subprocess): a prompt "
@@ -1230,6 +1437,18 @@ def main(argv=None) -> int:
                                chunk=args.chunk, seed=args.seed,
                                group=args.group)
         failed = result["failed"]
+    elif args.moe:
+        preset = args.preset if args.preset != "tiny" else "tiny-moe"
+        result = run_moe(preset=preset, requests=args.requests,
+                         max_new=args.max_new,
+                         max_batch=args.max_batch,
+                         block_size=args.block_size,
+                         max_context=args.max_context,
+                         chunk=args.chunk, seed=args.seed,
+                         group=args.group)
+        failed = result["failed"]
+        for msg in result["warnings"]:
+            print(f"WARN: {msg}", file=sys.stderr)
     elif args.longctx:
         from benchmarks import longctx_smoke
         result = longctx_smoke.run()

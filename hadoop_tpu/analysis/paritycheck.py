@@ -50,7 +50,13 @@ ENTRY_POINTS = frozenset({
     "qrows",
     "qhead",
     "qslice",
+    "qedot",
     "quantized_load",
+    # MoE expert-parallel serving: the int8 all2all payload codecs
+    # (parallel/lowp/quant.py) — an unguarded leg would quantize every
+    # bitwise MoE replica's dispatch/combine exchange
+    "moe_dispatch_quantized",
+    "moe_combine_quantized",
     # long-context serving plane (serving.parity): CP prefill
     # reassociates the softmax across ranks, paged decode across
     # windows — neither is bitwise vs the single-chip step

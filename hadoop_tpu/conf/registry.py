@@ -2155,6 +2155,33 @@ KEYS = {
             'hadoop_tpu/serving/server.py',
         ),
     },
+    "serving.moe.a2a.codec": {
+        "type": 'str',
+        "defaults": ("'int8'",),
+        "namespace": 'serving',
+        "documented": True, "sites": 1,
+        "files": (
+            'hadoop_tpu/serving/service.py',
+        ),
+    },
+    "serving.moe.capacity.factor": {
+        "type": 'float',
+        "defaults": ('0.0',),
+        "namespace": 'serving',
+        "documented": True, "sites": 1,
+        "files": (
+            'hadoop_tpu/serving/service.py',
+        ),
+    },
+    "serving.moe.shards": {
+        "type": 'int',
+        "defaults": ('0',),
+        "namespace": 'serving',
+        "documented": True, "sites": 1,
+        "files": (
+            'hadoop_tpu/serving/service.py',
+        ),
+    },
     "serving.parity": {
         "type": 'str',
         "defaults": ("'bitwise'",),

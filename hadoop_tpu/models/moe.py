@@ -13,6 +13,13 @@ expert's capacity C = ceil(T * k / E * capacity_factor) are dropped (their
 MLP output is 0, residual passes through) — standard capacity semantics.
 The single-device path uses the identical dispatch math with a local
 expert stack, so parallel-vs-reference tests match bit-for-bit.
+
+The serving engine's fused step reuses :func:`route` and
+:func:`_expert_ffn` directly (serving/engine.py ``_moe_mlp``) — the
+capacity padding is what keeps the step's shapes static, so serving
+MUST share this module's dispatch math or the two planes drift.
+:func:`capacity` is the public twin of the capacity rule for the
+engine/bench observability surfaces.
 """
 
 from __future__ import annotations
@@ -29,6 +36,13 @@ from hadoop_tpu.ops import swiglu
 def _capacity(n_tokens: int, cfg: ModelConfig) -> int:
     c = math.ceil(n_tokens * cfg.top_k / cfg.n_experts * cfg.capacity_factor)
     return max(4, int(c))
+
+
+def capacity(n_tokens: int, cfg: ModelConfig) -> int:
+    """Per-expert slot count C for a ``n_tokens``-row dispatch — the
+    one capacity rule, published so the serving engine's health block
+    and the bench report the same C the routing math pads to."""
+    return _capacity(n_tokens, cfg)
 
 
 def route(x2d: jnp.ndarray, router_w: jnp.ndarray, cfg: ModelConfig):

@@ -60,7 +60,7 @@ from typing import Dict, List, Optional, Tuple
 # bytes that still move but off the step's critical path.
 COMM_SITES = ("bucket.psum", "bucket.scatter", "zero1.gather",
               "tp.psum", "tp.scatter", "tp.stale", "cp.ring",
-              "cp.all2all", "other")
+              "cp.all2all", "moe.dispatch", "moe.combine", "other")
 
 
 def static_nbytes(x) -> int:
@@ -246,7 +246,7 @@ class CommRuntime:
         # contract the tpulint metrics/unbounded-label checker enforces
         for s in ("bucket.psum", "bucket.scatter", "zero1.gather",
                   "tp.psum", "tp.scatter", "tp.stale", "cp.ring",
-                  "cp.all2all", "other"):
+                  "cp.all2all", "moe.dispatch", "moe.combine", "other"):
             k = s.replace(".", "_")
             hists[s] = reg.histogram(
                 "comm_seconds_" + k,

@@ -32,7 +32,8 @@ from typing import Callable, Dict, Optional, Tuple
 # The bounded component label set. Unknown components map to "other" so
 # a registration can never mint an unbounded Prometheus series. Keep in
 # sync with the literal tuple in _ensure_metrics below.
-HBM_COMPONENTS = ("weights", "weights_dequantized", "kv_pool",
+HBM_COMPONENTS = ("weights", "weights_dequantized", "moe_experts",
+                  "kv_pool",
                   "longctx_window", "longctx_tail", "longctx_sampler",
                   "params", "opt_state", "grad_buckets", "other")
 
@@ -155,7 +156,8 @@ class HbmLedger:
             return
         # label values drawn from this literal tuple — the bounded-set
         # contract the tpulint metrics/unbounded-label checker enforces
-        for c in ("weights", "weights_dequantized", "kv_pool",
+        for c in ("weights", "weights_dequantized", "moe_experts",
+                  "kv_pool",
                   "longctx_window", "longctx_tail", "longctx_sampler",
                   "params", "opt_state", "grad_buckets", "other"):
             reg.register_callback_gauge(

@@ -424,6 +424,58 @@ def psum_of_scatter_quantized(row, z: int, idx, axes,
     return out.reshape(z, kp).astype(row.dtype)
 
 
+# ------------------------------------------- MoE expert all2all payloads
+# (RELAXED-TIER ENTRY POINTS: the expert-parallel dispatch/combine
+# exchange of serving MoE — models/moe.py's all_to_all pair — carries
+# its payload as int8 rows + per-(expert, slot) f32 scales under
+# serving.parity=relaxed. Flash Communication (arXiv:2412.04964)
+# applied to the a2a legs; every call site outside the lowp package
+# must sit under a lexical relaxed-parity guard.)
+
+def _expert_payload_quantized(x, site: str, axis_name, *,
+                              split_axis: int, concat_axis: int):
+    """Quantize an ``[E, C, D]`` expert payload to int8 with one f32
+    scale per (expert, slot) row, exchange it over ``axis_name`` (the
+    ``ep`` mesh axis; ``None`` = single-chip replica, the exchange is
+    the identity), and dequantize on the far side. The trace-time
+    record charges the WIRE form (int8 payload + scale plane) against
+    the f32 reference at the bounded ``moe.*`` comm-ledger sites —
+    that ledger is where the >=2x byte contract is asserted from."""
+    flat = x.reshape(-1, x.shape[-1])
+    amax = jnp.max(jnp.abs(flat.astype(jnp.float32)), axis=1)
+    scales = jnp.maximum(amax, _TINY) / 127.0
+    q = _quant_rows(flat, scales, 127.0).reshape(x.shape)
+    s = scales.reshape(x.shape[:-1])
+    _record(site, _nbytes(q) + _nbytes(s), _nbytes(x))
+    # axis_name is a static mesh-axis name, never a tracer
+    if axis_name is not None:  # lint: disable=jit/traced-branch
+        # tiled=True form — the untiled form's transpose miscompiles
+        # in current JAX (models/moe.py precedent); the scale plane
+        # rides the same exchange one dim short
+        q = jax.lax.all_to_all(q, axis_name, split_axis=split_axis,
+                               concat_axis=concat_axis, tiled=True)
+        s = jax.lax.all_to_all(s, axis_name, split_axis=split_axis,
+                               concat_axis=concat_axis, tiled=True)
+    return (q.astype(jnp.float32) * s[..., None]).astype(x.dtype)
+
+
+def moe_dispatch_quantized(xe, axis_name=None):
+    """The dispatch leg: every rank's ``[E, C, D]`` expert input
+    batches cross to their expert owners ([E, C, D] -> [E/ep, ep*C, D]
+    on a real ``ep`` mesh) as int8 + row scales. RELAXED-TIER ENTRY
+    POINT — recorded at the bounded ``moe.dispatch`` site."""
+    return _expert_payload_quantized(xe, "moe.dispatch", axis_name,
+                                     split_axis=0, concat_axis=1)
+
+
+def moe_combine_quantized(ye, axis_name=None):
+    """The combine leg: expert outputs return to their token owners
+    (the reverse exchange) as int8 + row scales. RELAXED-TIER ENTRY
+    POINT — recorded at the bounded ``moe.combine`` site."""
+    return _expert_payload_quantized(ye, "moe.combine", axis_name,
+                                     split_axis=1, concat_axis=0)
+
+
 # ------------------------------------------------- host-side payload codec
 
 _PAYLOAD_VERSION = 1

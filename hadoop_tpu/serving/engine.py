@@ -132,7 +132,7 @@ import queue
 import threading
 import time
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace as _dc_replace
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -141,6 +141,10 @@ import numpy as np
 
 from hadoop_tpu.models.config import ModelConfig
 from hadoop_tpu.models.decoder import _norm, head_matrix
+# MoE serving shares models/moe.py's dispatch math verbatim — the
+# capacity padding there is what keeps the fused step's shapes static
+from hadoop_tpu.models.moe import _expert_ffn, route
+from hadoop_tpu.models.moe import capacity as moe_capacity
 from hadoop_tpu.ops import gelu, rope_frequencies, swiglu
 from hadoop_tpu.ops.attention import _repeat_kv
 # BlockPool/PrefixCache live in the kvstore package now (the tiered
@@ -149,18 +153,51 @@ from hadoop_tpu.ops.attention import _repeat_kv
 from hadoop_tpu.serving.kvstore import (BlockPool, PrefixCache,
                                         TieredKVCache)
 from hadoop_tpu.serving.speculate import NgramProposer
-# the weight plane (serving/weightplane.py): qdot/qrows/qhead are
-# RELAXED-TIER entry points — every call below sits under an
-# `if self._relaxed_weights ...` guard, so serving.parity=bitwise (the
-# default) compiles zero quantized code (tpulint-enforced)
-from hadoop_tpu.serving.weightplane import (describe_tree, is_qtensor,
-                                            is_quantized_tree, qdot,
-                                            qhead, qrows)
+# the weight plane (serving/weightplane.py): qdot/qrows/qhead/qedot and
+# the lowp a2a codecs below are RELAXED-TIER entry points — every call
+# sits under an `if self._relaxed_weights ...` guard, so
+# serving.parity=bitwise (the default) compiles zero quantized code
+# (tpulint-enforced)
+from hadoop_tpu.parallel.lowp.quant import (moe_combine_quantized,
+                                            moe_dispatch_quantized)
+from hadoop_tpu.serving.weightplane import (EXPERT_STACKS, describe_tree,
+                                            expert_shard_count,
+                                            expert_weight_bytes,
+                                            is_qtensor, is_quantized_tree,
+                                            qdot, qedot, qhead, qrows)
 from hadoop_tpu.tracing.tracer import global_tracer
 
 log = logging.getLogger(__name__)
 
 _NEG_INF = -1e30
+
+
+def _shard_expert_stacks(params, shards: int):
+    """Place the expert FFN stacks expert-split across the replica's
+    local chips: the leading layout is ``[L, E, ...]`` (f32 stacks) or
+    ``[L, E, N, G, gs]``/``[L, E, N, G]`` (qtensor payload/scales), so
+    a ``P(None, "ep")`` spec over a 1-axis local mesh splits the expert
+    dim and replicates everything else — payload and scales split
+    together, scales can never land off their expert's shard. Dense
+    leaves (attention, norms, router) are untouched: they stay
+    replicated, exactly the dense engine's placement."""
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+    mesh = Mesh(np.asarray(jax.local_devices()[:shards]), ("ep",))
+    spec = NamedSharding(mesh, P(None, "ep"))
+    layers = dict(params["layers"])
+    for k in EXPERT_STACKS:
+        if k not in layers:
+            continue
+        leaf = layers[k]
+        if is_qtensor(leaf):
+            layers[k] = {"q": jax.device_put(leaf["q"], spec),
+                         "s": jax.device_put(leaf["s"], spec)}
+        else:
+            layers[k] = jax.device_put(leaf, spec)
+    out = dict(params)
+    out["layers"] = layers
+    return out
 
 
 # fixed-shape page movers for the cold tiers: one trace each for the
@@ -347,11 +384,22 @@ class DecodeEngine:
                  admission_queue=None, drain_persist: bool = True,
                  hbm_bytes: int = 0, max_lanes: int = 16,
                  quantize_seconds: float = 0.0,
+                 moe_capacity_factor: float = 0.0, moe_shards: int = 0,
+                 moe_a2a_codec: str = "int8",
                  plan=None, metrics=None, tracer=None):
-        if cfg.is_moe:
-            raise NotImplementedError("serving MoE checkpoints is not "
-                                      "wired up yet (dense decoders only)")
         self.cfg = cfg
+        # ---- expert plane (MoE checkpoints): the fused step routes
+        # every row through models/moe.py's capacity-padded one-hot
+        # dispatch, so the static row count pins the capacity and the
+        # compile count stays at the same two shapes as dense
+        if moe_a2a_codec not in ("int8", "none"):
+            raise ValueError(f"serving.moe.a2a.codec={moe_a2a_codec!r} "
+                             "(choices: int8, none)")
+        self._moe_a2a_codec = moe_a2a_codec
+        self._moe_cfg = cfg
+        if cfg.is_moe and moe_capacity_factor:
+            self._moe_cfg = _dc_replace(
+                cfg, capacity_factor=float(moe_capacity_factor))
         self.block_size = block_size
         self.prefill_chunk = max(1, int(prefill_chunk))
         self.max_context = min(max_context or cfg.max_seq, cfg.max_seq)
@@ -382,6 +430,15 @@ class DecodeEngine:
         self._weight_desc = describe_tree(params)
         self.weight_bytes = self._weight_desc["weight_bytes"]
         self.quantize_seconds = quantize_seconds
+        # expert stacks: measured resident bytes (ledgered as the
+        # moe_experts component beside, not inside, the dense remainder)
+        # and the expert-dim shard count across the replica's chips
+        self.expert_bytes = expert_weight_bytes(params, cfg)
+        self.expert_shards = expert_shard_count(
+            cfg.n_experts, int(moe_shards),
+            jax.local_device_count()) if cfg.is_moe else 0
+        if cfg.is_moe and self.expert_shards > 1:
+            params = _shard_expert_stacks(params, self.expert_shards)
         self.hbm_bytes = int(hbm_bytes or 0)
         kv_itemsize = jnp.dtype(cfg.jax_dtype).itemsize
         self.block_nbytes = (2 * cfg.n_layers * block_size *
@@ -459,7 +516,12 @@ class DecodeEngine:
         kv_pool_bytes = num_blocks * self.block_nbytes
         led = hbm_ledger()
         led.register(f"{self._hbm_owner}weights", "weights",
-                     lambda: self.weight_bytes)
+                     lambda: self.weight_bytes - self.expert_bytes)
+        if cfg.is_moe:
+            # expert stacks get their own component so the autoscaler
+            # sees where an MoE replica's HBM actually went
+            led.register(f"{self._hbm_owner}experts", "moe_experts",
+                         lambda: self.expert_bytes)
         led.register(f"{self._hbm_owner}kv", "kv_pool",
                      lambda: kv_pool_bytes)
 
@@ -585,12 +647,45 @@ class DecodeEngine:
         return x @ w
 
     def _mlp(self, x, lp):
+        if self.cfg.is_moe:
+            return self._moe_mlp(x, lp)
         if self.cfg.use_swiglu:
             return self._wdot(swiglu(self._wdot(x, lp["w_gate"]),
                                      self._wdot(x, lp["w_up"])),
                               lp["w_down"])
         return self._wdot(gelu(self._wdot(x, lp["w_in"]) + lp["b_in"]),
                           lp["w_out"]) + lp["b_out"]
+
+    def _moe_mlp(self, x, lp):
+        """Routed expert MLP inside the ONE fused step. The full row
+        batch ``x [T, D]`` (decode lanes + any riding prefill chunk)
+        goes through models/moe.py's capacity-padded one-hot dispatch —
+        T is static per shape family, so the capacity C is static and
+        the compile count stays at the same two shapes as dense.
+        Tokens past an expert's capacity (and inactive draft rows) get
+        an all-zero combine row: the combine einsum yields exact 0.0
+        and the residual passes through, bit-for-bit ``moe_mlp``'s
+        dropped-token semantics. Under ``serving.parity=relaxed`` the
+        expert contractions run against the int8 stacks
+        (weightplane.qedot) and both all2all legs ride the lowp codec,
+        recorded at the bounded ``moe.dispatch``/``moe.combine`` comm
+        sites (Flash Communication, arXiv:2412.04964)."""
+        mcfg = self._moe_cfg
+        dispatch, combine = route(x, lp["router"], mcfg)
+        xe = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+        if self._relaxed_weights and self._moe_a2a_codec != "none":
+            xe = moe_dispatch_quantized(xe)
+        if self._relaxed_weights:
+            ye = qedot(swiglu(qedot(xe, lp["w_gate"]),
+                              qedot(xe, lp["w_up"])),
+                       lp["w_down"])
+        else:
+            ye = _expert_ffn(xe, lp, mcfg)
+        if self._relaxed_weights and self._moe_a2a_codec != "none":
+            ye = moe_combine_quantized(ye)
+        y2d = jnp.einsum("tec,ecd->td", combine.astype(jnp.float32),
+                         ye.astype(jnp.float32))
+        return y2d.astype(x.dtype)
 
     def _step_impl(self, params, kp, vp, state, drafts, draft_lens,
                    chunk):
@@ -723,7 +818,13 @@ class DecodeEngine:
             x2 = _norm(h2, lp["mlp_norm_w"], lp.get("mlp_norm_b"), cfg)
             return h2 + self._mlp(x2, lp).astype(h.dtype), (kc, vc)
 
-        h, (kp, vp) = jax.lax.scan(layer, h, (params["layers"], kp, vp))
+        # comm_scale: the trace-time comm ledgers see one body trace of
+        # the scan; the hardware runs it n_layers times per step — the
+        # MoE a2a sites record honest per-step executions/bytes
+        from hadoop_tpu.obs.comm import comm_scale
+        with comm_scale(cfg.n_layers):
+            h, (kp, vp) = jax.lax.scan(layer, h,
+                                       (params["layers"], kp, vp))
         h = _norm(h, params["final_norm_w"], params.get("final_norm_b"),
                   cfg)
         if self._relaxed_weights and self._q_head:
@@ -945,7 +1046,7 @@ class DecodeEngine:
         dtype, MEASURED weight bytes, quantize-at-load seconds, and the
         lanes x context the KV budget admits at those bytes."""
         desc = self._weight_desc
-        return {
+        plane = {
             "parity": "relaxed" if self._relaxed_weights else "bitwise",
             "dtype": desc["dtype"],
             "weight_bytes": self.weight_bytes,
@@ -956,7 +1057,17 @@ class DecodeEngine:
             "max_context": self.s_max,
             "kv_capacity_tokens": self.pool.num_usable * self.block_size,
             "lanes_x_context": self.max_batch * self.s_max,
+            # expert placement, beside weight_dtype for the autoscaler
+            # and the registry record (0s on a dense checkpoint)
+            "experts": self.cfg.n_experts,
+            "expert_shards": self.expert_shards,
+            "expert_bytes": self.expert_bytes,
         }
+        if self.cfg.is_moe:
+            plane["expert_capacity"] = moe_capacity(
+                self.max_batch * (self.spec_k + 1), self._moe_cfg)
+            plane["a2a_codec"] = self._moe_a2a_codec
+        return plane
 
     def cache_stats(self) -> Dict[str, Any]:
         """Prefix-cache + chunked-prefill observability (health, bench)."""

@@ -199,6 +199,14 @@ class ServingReplica:
             hbm_bytes=conf.get_int("serving.kv.hbm.bytes", 0),
             max_lanes=conf.get_int("serving.max.lanes", 16),
             quantize_seconds=self.quantize_seconds,
+            # expert-parallel MoE serving: capacity-factor override
+            # (0 = the model config's), expert-dim shard count across
+            # the replica's chips (0 = auto), and the relaxed-tier
+            # all2all payload codec for the dispatch/combine legs
+            moe_capacity_factor=conf.get_float(
+                "serving.moe.capacity.factor", 0.0),
+            moe_shards=conf.get_int("serving.moe.shards", 0),
+            moe_a2a_codec=conf.get("serving.moe.a2a.codec", "int8"),
             metrics=metrics)
         qos_gate = None
         if self.qos_enabled:
@@ -287,6 +295,15 @@ class ServingReplica:
                                 str(self.engine.weight_bytes),
                             "quantize_seconds":
                                 str(self.quantize_seconds),
+                            # expert placement: count/shards/resident
+                            # bytes (0s on dense) — the autoscaler sees
+                            # an MoE replica's real HBM split without
+                            # scraping /v1/health
+                            "experts": str(self.engine.cfg.n_experts),
+                            "expert_shards":
+                                str(self.engine.expert_shards),
+                            "expert_bytes":
+                                str(self.engine.expert_bytes),
                             # disaggregation + tier capacities: the
                             # router routes long prompts to role=prefill
                             # and decodes on decode/mixed; an autoscaler

@@ -86,14 +86,24 @@ WEIGHTS_PARITY_KEY = "serving.parity"
 TIERS = ("bitwise", "relaxed")
 
 # the per-layer matmul weights: every one contracts x over its -2 axis
-# (x @ w), so all of them store transposed-and-grouped. MoE leaves
-# (router, expert stacks) are absent on purpose — the engine rejects
-# MoE checkpoints, and a silent skip here would misreport weight_bytes.
+# (x @ w), so all of them store transposed-and-grouped. On a MoE config
+# the same three FFN names carry the layer-stacked EXPERT stacks
+# ([L, E, D, F] / [L, E, F, D]): quantize_weight groups the trailing
+# contraction dim under any leading axes, so ONE policy table covers
+# dense and sparse — per-expert int8 payloads + per-(expert, column)
+# scale groups. The ROUTER stays f32 on purpose: it is value-critical
+# (a flipped top-k re-routes whole tokens, not a bounded perturbation)
+# and bytes-irrelevant next to the expert stacks — the norms precedent.
 LAYER_MATMULS = frozenset({
     "wq", "wk", "wv", "wo",
-    "w_gate", "w_up", "w_down",          # swiglu mlp
+    "w_gate", "w_up", "w_down",          # swiglu mlp / MoE expert stacks
     "w_in", "w_out",                     # gelu mlp (biases stay f32)
 })
+
+# the expert FFN stacks of a MoE layer — the subset of LAYER_MATMULS
+# whose resident bytes the engine ledgers under the dedicated
+# ``moe_experts`` HBM component and shards along the expert dim
+EXPERT_STACKS = frozenset({"w_gate", "w_up", "w_down"})
 
 _QKEYS = frozenset({"q", "s"})
 _KEYSTR = re.compile(r"\['([^']+)'\]")
@@ -285,10 +295,6 @@ def _quantize_one(key: str, arr, *, in_layers: bool, cfg: ModelConfig,
 
 def _fresh_report(cfg: ModelConfig,
                   wp: WeightPlaneConfig) -> Dict[str, Any]:
-    if cfg.is_moe:
-        raise NotImplementedError("the quantized weight plane serves "
-                                  "dense decoders only (MoE leaves are "
-                                  "not in the policy table)")
     if not wp.relaxed:
         # the module contract, enforced here and not by call-site
         # discipline: the bitwise tier NEVER quantizes — a bitwise
@@ -301,6 +307,7 @@ def _fresh_report(cfg: ModelConfig,
             "quant_embed": wp.quant_embed, "quant_head": wp.quant_head,
             "leaves_quantized": 0, "quantize_seconds": 0.0,
             "total_f32_bytes": 0, "peak_f32_bytes": 0,
+            "moe_experts": cfg.n_experts if cfg.is_moe else 0,
             "_flags": _resolve_flags(cfg, wp)}
 
 
@@ -308,7 +315,49 @@ def _finish_report(report: Dict[str, Any], params) -> Dict[str, Any]:
     report.pop("_flags", None)
     report["quantize_seconds"] = round(report["quantize_seconds"], 3)
     report["weight_bytes"] = resident_weight_bytes(params)
+    if report.get("moe_experts"):
+        report["expert_bytes"] = _expert_stack_bytes(params)
     return report
+
+
+def _expert_stack_bytes(params) -> int:
+    layers = params.get("layers", {}) if isinstance(params, dict) else {}
+    return sum(resident_weight_bytes(layers[k])
+               for k in EXPERT_STACKS if k in layers)
+
+
+def expert_weight_bytes(params, cfg: ModelConfig) -> int:
+    """MEASURED resident bytes of the expert FFN stacks (0 on a dense
+    config) — what the engine ledgers under the ``moe_experts`` HBM
+    component, beside (not inside) the dense ``weights`` remainder."""
+    if not cfg.is_moe:
+        return 0
+    return _expert_stack_bytes(params)
+
+
+def expert_shard_count(n_experts: int, requested: int,
+                       n_devices: int) -> int:
+    """Resolve ``serving.moe.shards``: how many chips the expert dim
+    splits across. ``requested=0`` (auto) picks the largest shard count
+    the replica's devices allow that divides the expert count; an
+    explicit request that does not divide the experts or exceeds the
+    devices is a loud error, never a silent round-down."""
+    if n_experts <= 0:
+        return 1
+    if requested:
+        if requested > n_devices:
+            raise ValueError(
+                f"serving.moe.shards={requested} exceeds the replica's "
+                f"{n_devices} local device(s)")
+        if n_experts % requested:
+            raise ValueError(
+                f"serving.moe.shards={requested} does not divide "
+                f"n_experts={n_experts} — expert shards must be equal")
+        return int(requested)
+    for d in range(min(n_devices, n_experts), 0, -1):
+        if n_experts % d == 0:
+            return d
+    return 1
 
 
 def quantize_params(params, cfg: ModelConfig,
@@ -452,6 +501,20 @@ def qhead(params, h, cfg: ModelConfig):
                 else params["lm_head"])
 
 
+def qedot(x, qw):
+    """Expert-batched int8 matmul: ``x [E, C, D]`` against a quantized
+    expert stack ``{"q": int8 [E, N, G, gs], "s": f32 [E, N, G]}`` —
+    the MoE twin of :func:`qdot`, one contraction per expert with that
+    expert's own scale plane (scales can never cross experts). Covers
+    both orientations of the stacks: w_gate/w_up store [E, F, D]
+    (contract D), w_down stores [E, D, F] (contract F) — the stored
+    trailing dim is always the contraction dim, exactly as for qdot."""
+    q, s = qw["q"], qw["s"]
+    e, n = q.shape[0], q.shape[1]
+    w = (q.astype(jnp.float32) * s[..., None]).reshape(e, n, -1)
+    return jnp.einsum("ecd,end->ecn", x, w.astype(x.dtype))
+
+
 # -------------------------------------------------- logits/output guard
 
 def weight_ab_report(logits_ref, logits_q, *, min_agree: float = 0.95,
@@ -534,11 +597,12 @@ def run_weight_ab(cfg: ModelConfig, params, qparams, *, batch: int = 8,
 
 
 __all__ = [
-    "WEIGHTS_PARITY_KEY", "TIERS", "LAYER_MATMULS",
+    "WEIGHTS_PARITY_KEY", "TIERS", "LAYER_MATMULS", "EXPERT_STACKS",
     "WeightPlaneConfig", "BITWISE_WEIGHTS", "weightplane_from_conf",
     "quantize_weight", "dequantize_weight", "is_qtensor",
     "is_quantized_tree", "resident_weight_bytes", "describe_tree",
     "quantize_params", "make_load_quantizer", "quantized_load",
-    "dequantize_params", "qdot", "qrows", "qhead", "qslice",
+    "dequantize_params", "qdot", "qrows", "qhead", "qslice", "qedot",
+    "expert_weight_bytes", "expert_shard_count",
     "weight_ab_report", "run_weight_ab",
 ]

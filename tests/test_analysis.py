@@ -947,6 +947,55 @@ def test_guarded_weightplane_entry_points_are_clean(tmp_path):
     assert findings == []
 
 
+def test_unguarded_moe_entry_points_are_flagged(tmp_path):
+    """The MoE expert-serving entry points — the expert-batched int8
+    matmul (``qedot``) and the quantized all2all payload legs
+    (``moe_dispatch_quantized``/``moe_combine_quantized``) — are
+    relaxed-tier entry points: an unguarded call would quantize every
+    bitwise MoE replica's expert math or exchange, including through a
+    renamed import."""
+    from hadoop_tpu.analysis import RelaxedGateChecker
+    findings = lint_source(tmp_path, """
+        from hadoop_tpu.serving.weightplane import qedot
+        from hadoop_tpu.parallel.lowp.quant import \\
+            moe_dispatch_quantized
+
+        def expert_ffn(xe, lp):
+            return qedot(xe, lp["w_gate"])                    # BAD
+
+        def dispatch(xe):
+            return moe_dispatch_quantized(xe)                 # BAD
+
+        def combine(ye, ax):
+            from hadoop_tpu.parallel.lowp.quant import \\
+                moe_combine_quantized as mc
+            return mc(ye, ax)                                 # BAD
+    """, [RelaxedGateChecker()])
+    assert len(findings) == 3
+    assert all(f.checker == "parity/relaxed-gated" for f in findings)
+
+
+def test_guarded_moe_entry_points_are_clean(tmp_path):
+    from hadoop_tpu.analysis import RelaxedGateChecker
+    findings = lint_source(tmp_path, """
+        from hadoop_tpu.parallel.lowp.quant import (
+            moe_combine_quantized, moe_dispatch_quantized)
+        from hadoop_tpu.serving.weightplane import qedot
+
+        class Engine:
+            def _moe_mlp(self, xe, lp):
+                if self._relaxed_weights:
+                    ye = qedot(xe, lp["w_gate"])
+                else:
+                    ye = xe
+                if self._relaxed_weights and self._codec != "none":
+                    xe = moe_dispatch_quantized(xe)
+                    ye = moe_combine_quantized(ye)
+                return ye
+    """, [RelaxedGateChecker()])
+    assert findings == []
+
+
 def test_unguarded_qslice_calls_are_flagged(tmp_path):
     """``qslice`` is the layer-sliced twin of ``qdot`` (the longctx
     fused decode path's per-layer weight route) — same entry-point
