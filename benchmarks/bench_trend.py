@@ -1,0 +1,214 @@
+"""Trajectory sentinel over BENCH_LOG.jsonl — the history's judge.
+
+``run_all`` has appended one ``bench_suite`` row per run since ISSUE
+17, and train rows have been hand-stamped far longer, but nothing ever
+*read* the log: a metric could halve between issues and nobody would
+know until someone re-ran a bench by hand. This module diffs the
+newest row's key metrics against the recent history and flags moves
+beyond a per-metric tolerance.
+
+Judgement rules:
+
+- The baseline for each metric is the **median of up to the last 5
+  prior values** (median, not last: one outlier run must not become
+  the yardstick every later run is judged against).
+- Each metric has a direction (``higher`` is better for throughputs,
+  ``lower`` for latencies/overheads/counts-of-bad-things) and a
+  relative tolerance; metrics not in the table fall back to a suffix
+  heuristic + ``DEFAULT_REL``. Only moves in the WORSE direction flag.
+- Comparisons only happen between rows that actually ran the suite
+  (a ``--quick`` row is only compared against other quick rows —
+  sizes differ, so cross-shape diffs would be noise).
+
+Wire-in: ``run_all`` calls :func:`check` on the row it is about to
+append (recorded-not-raised — a regression is a data point in the
+trajectory, not a reason to lose the run). CI-style use::
+
+    python -m benchmarks.bench_trend --log BENCH_LOG.jsonl --check
+
+exits 1 when the newest row regresses.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Dict, List, Optional
+
+DEFAULT_REL = 0.35
+HISTORY = 5
+
+# metric name (as logged: "<suite>.<name>") -> direction + tolerance.
+# Direction "lower" = smaller is better. Tolerances are deliberately
+# loose — the sentinel hunts step-function regressions between issues,
+# not run-to-run jitter on shared CI iron.
+TOLERANCES: Dict[str, Dict[str, object]] = {
+    "nn_throughput_ops_per_sec.create_ops_per_sec":
+        {"direction": "higher", "rel": 0.4},
+    "dfsio.write_mb_s": {"direction": "higher", "rel": 0.4},
+    "terasort.sort_bytes_per_sec": {"direction": "higher", "rel": 0.4},
+    "serving.ttft_p50_ms": {"direction": "lower", "rel": 0.5},
+    "serving_speculate.steps_ratio": {"direction": "lower", "rel": 0.3},
+    "serving_quantized.capacity_ratio":
+        {"direction": "higher", "rel": 0.2},
+    "serving_moe.moe_tokens_per_sec":
+        {"direction": "higher", "rel": 0.5},
+    "serving_moe.moe_a2a_payload_ratio":
+        {"direction": "lower", "rel": 0.3},
+    "trace_overhead.overhead_frac": {"direction": "lower", "rel": 0.5},
+    "doctor.windows_to_flag": {"direction": "lower", "rel": 0.5},
+    "flight_recorder.windows_to_flag":
+        {"direction": "lower", "rel": 0.5},
+    "flight_elastic.lost_steps": {"direction": "lower", "rel": 0.5},
+    "serving_longctx.longctx_decode_tokens_per_sec":
+        {"direction": "higher", "rel": 0.5},
+    "lowp.sync_exec_ratio": {"direction": "lower", "rel": 0.3},
+    # hard zeroes: ANY unbaselined lint finding is a regression
+    "lint.unbaselined": {"direction": "lower", "rel": 0.0},
+    "lint.wall_seconds": {"direction": "lower", "rel": 1.0},
+}
+
+# suffixes that mean "smaller is better" when a metric has no table
+# entry (seconds, latencies, overheads, error-ish counters)
+_LOWER_SUFFIXES = ("_seconds", "_ms", "_frac", "_ratio_bad", "_lost",
+                   "_sheds", "_failures", "_unbaselined",
+                   "windows_to_flag", "lost_steps", "overhead_frac")
+
+
+def _rule(metric: str) -> Dict[str, object]:
+    rule = TOLERANCES.get(metric)
+    if rule is not None:
+        return rule
+    lower = any(metric.endswith(s) or s in metric
+                for s in _LOWER_SUFFIXES)
+    return {"direction": "lower" if lower else "higher",
+            "rel": DEFAULT_REL}
+
+
+def load_rows(path: str) -> List[dict]:
+    """All ``bench_suite`` rows of a BENCH_LOG.jsonl, oldest first
+    (hand-stamped train rows and scorecards pass through untouched
+    elsewhere — the sentinel only judges suite rows)."""
+    rows: List[dict] = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(row, dict) and \
+                        row.get("metric") == "bench_suite":
+                    rows.append(row)
+    except OSError:
+        pass
+    return rows
+
+
+def check(rows: List[dict],
+          tolerances: Optional[Dict[str, Dict[str, object]]] = None
+          ) -> dict:
+    """Judge the NEWEST row in ``rows`` against the prior history.
+
+    Returns ``{compared, regressions, regressions_count, skipped}``;
+    ``regressions`` rows carry metric / newest / baseline / ratio /
+    tolerance / direction. Never raises on malformed history.
+    """
+    table = tolerances if tolerances is not None else TOLERANCES
+    if not rows:
+        return {"compared": 0, "regressions": [],
+                "regressions_count": 0, "skipped": "empty log"}
+    newest = rows[-1]
+    quick = bool(newest.get("quick"))
+    prior = [r for r in rows[:-1] if bool(r.get("quick")) == quick]
+    if not prior:
+        return {"compared": 0, "regressions": [],
+                "regressions_count": 0,
+                "skipped": "no prior rows of the same shape"}
+    metrics = newest.get("key_metrics") or {}
+    if not isinstance(metrics, dict):
+        return {"compared": 0, "regressions": [],
+                "regressions_count": 0,
+                "skipped": "newest row carries no key_metrics map"}
+    regressions: List[dict] = []
+    compared = 0
+    for metric, value in sorted(metrics.items()):
+        if not isinstance(value, (int, float)) or \
+                isinstance(value, bool):
+            continue
+        history = [r["key_metrics"][metric] for r in prior
+                   if isinstance(r.get("key_metrics"), dict)
+                   and isinstance(r["key_metrics"].get(metric),
+                                  (int, float))
+                   and not isinstance(r["key_metrics"][metric], bool)]
+        if not history:
+            continue                    # metric born this run
+        history = history[-HISTORY:]
+        baseline = sorted(history)[len(history) // 2]
+        rule = table.get(metric) or _rule(metric)
+        rel = float(rule.get("rel", DEFAULT_REL))
+        direction = rule.get("direction", "higher")
+        compared += 1
+        if direction == "lower":
+            # smaller is better: flag when newest exceeds the
+            # baseline by more than rel (a zero baseline means any
+            # positive value must clear the absolute tolerance 0)
+            bound = baseline * (1.0 + rel) if baseline > 0 else 0.0
+            bad = value > bound
+        else:
+            bound = baseline * (1.0 - rel)
+            bad = value < bound
+        if bad:
+            regressions.append({
+                "metric": metric,
+                "newest": value,
+                "baseline": baseline,
+                "ratio": round(value / baseline, 4) if baseline
+                else None,
+                "tolerance_rel": rel,
+                "direction": direction})
+    return {"compared": compared,
+            "regressions": regressions,
+            "regressions_count": len(regressions)}
+
+
+def append_slo_scorecard(path: str, slo: dict,
+                         quick: bool = False) -> None:
+    """Append one ``slo_scorecard`` row (per-class availability /
+    p99 attainment / sheds / burn verdict, joined to the build hash
+    the fleet's ``htpu_build_info`` gauge carries) to the trajectory
+    log. Shared by ``run_all`` and ``serve_bench --storm``."""
+    import time
+    classes = slo.get("classes") or {}
+    row = {"metric": "slo_scorecard",
+           "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+           "code": slo.get("code") or "",
+           "quick": quick,
+           "classes": classes,
+           "burning": sorted(c for c, r in classes.items()
+                             if isinstance(r, dict)
+                             and r.get("burning"))}
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="judge the newest BENCH_LOG row against history")
+    ap.add_argument("--log", default="BENCH_LOG.jsonl")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when the newest row regresses "
+                         "(CI-style gate; default just prints)")
+    args = ap.parse_args(argv)
+    verdict = check(load_rows(args.log))
+    print(json.dumps(verdict, indent=2))
+    if args.check and verdict["regressions_count"] > 0:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -148,6 +148,13 @@ _KEY_METRICS = {
              (("partial_sync", "exec_ratio"), "sync_exec_ratio"),
              (("partial_sync", "guard_accepted"),
               "sync_guard_accepted")],
+    # elastic-fleet storm (autoscaler + QoS door + SLO scoreboard):
+    # the trajectory shows how far the fleet grew, that zero requests
+    # failed, and that the DFS tier recovered after the drain
+    "serving_storm": [(("value",), "peak_replicas"),
+                      (("failed_requests",), "storm_failed_requests"),
+                      (("hits_dfs_delta",), "storm_hits_dfs_delta"),
+                      (("qos_heavy_sheds",), "storm_heavy_sheds")],
     # static-analysis plane: the self-run is healthy when it stays at
     # zero unbaselined findings with the registry gate green
     "lint": [(("unbaselined",), "unbaselined"),
@@ -156,7 +163,10 @@ _KEY_METRICS = {
 }
 
 
-def _append_bench_log(path: str, out: dict, quick: bool) -> None:
+def _bench_row(out: dict, quick: bool) -> dict:
+    """The ``bench_suite`` trajectory row for one full run — built
+    separately from the append so the trend sentinel can judge the
+    row BEFORE it lands in the log."""
     summary = {}
     failures = []
     for suite, result in out.items():
@@ -172,17 +182,29 @@ def _append_bench_log(path: str, out: dict, quick: bool) -> None:
             if isinstance(node, (int, float)) and not isinstance(
                     node, bool):
                 summary[f"{suite}.{name}"] = node
-    row = {"metric": "bench_suite",
-           "timestamp": out.get("timestamp"),
-           "code": _code_hash(),
-           "quick": quick,
-           "wall_seconds": out.get("wall_seconds"),
-           "suites": sorted(k for k in out if k not in
-                            ("timestamp", "host", "wall_seconds")),
-           "key_metrics": summary,
-           "failures": failures}
+    return {"metric": "bench_suite",
+            "timestamp": out.get("timestamp"),
+            "code": _code_hash(),
+            "quick": quick,
+            "wall_seconds": out.get("wall_seconds"),
+            "suites": sorted(k for k in out if k not in
+                             ("timestamp", "host", "wall_seconds")),
+            "key_metrics": summary,
+            "failures": failures}
+
+
+def _append_bench_log(path: str, row: dict, out: dict,
+                      quick: bool) -> None:
     with open(path, "a", encoding="utf-8") as f:
         f.write(json.dumps(row) + "\n")
+    # the storm phase's per-class SLO verdict rides along as its own
+    # scorecard row (availability / p99 attainment / burn per class,
+    # joined to the fleet's htpu_build_info hash)
+    slo = (out.get("serving_storm") or {}).get("slo") \
+        if isinstance(out.get("serving_storm"), dict) else None
+    if slo:
+        from benchmarks.bench_trend import append_slo_scorecard
+        append_slo_scorecard(path, slo, quick=quick)
 
 
 def main() -> None:
@@ -393,14 +415,27 @@ def main() -> None:
         # trajectory; must not discard the benches already computed
         out["lint"] = {"error": f"{type(e).__name__}: {e}"}
     out["wall_seconds"] = round(time.perf_counter() - t0, 1)
-    with open(args.out, "w") as f:
-        json.dump(out, f, indent=2)
     # One summary row per suite run into the bench trajectory log: the
     # log used to carry only hand-stamped train rows, so a regression
     # BETWEEN issues was invisible until someone re-ran a bench by
     # hand. Key metrics + failures per suite, appended, never rewritten.
+    # The trend sentinel judges the new row against the history BEFORE
+    # it lands — recorded, not raised: a regression between issues is a
+    # data point in the trajectory, never a reason to lose the run.
+    row = None
     try:
-        _append_bench_log(args.log, out, quick=args.quick)
+        from benchmarks import bench_trend
+        row = _bench_row(out, quick=args.quick)
+        out["bench_trend"] = bench_trend.check(
+            bench_trend.load_rows(args.log) + [row])
+    except Exception as e:  # noqa: BLE001 — the sentinel is
+        # best-effort; a full bench run must never die on it
+        out["bench_trend"] = {"error": f"{type(e).__name__}: {e}"}
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+    try:
+        if row is not None:
+            _append_bench_log(args.log, row, out, quick=args.quick)
     except Exception as e:  # noqa: BLE001 — the trajectory log is
         # best-effort; a full bench run must never die on it
         print(f"BENCH_LOG append failed: {type(e).__name__}: {e}")

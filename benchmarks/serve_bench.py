@@ -848,7 +848,13 @@ def run_storm(preset="tiny", slo_ttft_s=15.0, qos_slo_s=10.0,
       rendezvous owner was the drained replica);
     - under synthetic overload, a heavy tenant is shed (429 +
       Retry-After) while a light tenant's requests all succeed with
-      p99 within the QoS SLO, and the shed counter shows on ``/prom``.
+      p99 within the QoS SLO, and the shed counter shows on ``/prom``;
+    - the fleet doctor's SLO scoreboard, pumped over the same overload
+      (deterministic ``poll_once`` windows — injected counters, no
+      wall-clock asserts), flags the heavy class (p3) as burning its
+      error budget at ``/ws/v1/fleet/slo`` while the light class (p0)
+      stays green; the per-class scorecard rides the result (and lands
+      in BENCH_LOG.jsonl as an ``slo_scorecard`` row).
     """
     import http.client as _http
     import statistics
@@ -910,6 +916,10 @@ def run_storm(preset="tiny", slo_ttft_s=15.0, qos_slo_s=10.0,
                        "-D", "serving.kv.dfs.enable=true",
                        "-D", "serving.qos.enabled=true",
                        "-D", "serving.qos.shed.queue.depth=6",
+                       # pin the overload tenants' SLO classes so the
+                       # scoreboard verdict never depends on how far
+                       # earlier phases' decay-shares have aged
+                       "-D", "obs.slo.class.map=heavy=p3,light=p0",
                        "-D", "serving.registry.record.ttl=5s",
                        "-D", f"serving.max.batch={max_batch}",
                        "-D", f"serving.kv.block.size={block_size}",
@@ -980,6 +990,7 @@ def run_storm(preset="tiny", slo_ttft_s=15.0, qos_slo_s=10.0,
     failures = []
     failed_requests = [0]
     latencies_light = []
+    slo_doctor = [None]
     conf = fast_conf()
     conf.set("dfs.replication", "1")
     result = {"metric": "serve_storm_peak_replicas", "unit": "replicas",
@@ -1184,6 +1195,33 @@ def run_storm(preset="tiny", slo_ttft_s=15.0, qos_slo_s=10.0,
                     failures.append(
                         "survivor recovered nothing from the DFS tier "
                         "after the drain (hits_dfs delta 0)")
+                # fleet doctor + SLO scoreboard over the overload:
+                # registry-discovered, pumped synchronously (poll 1 =
+                # baseline absorbing all pre-overload counters)
+                from hadoop_tpu.obs.doctor import FleetDoctor
+                dconf = Configuration(load_defaults=False)
+                dconf.set("obs.doctor.registry",
+                          f"127.0.0.1:{reg_srv.port}")
+                dconf.set("obs.doctor.service",
+                          f"{REGISTRY_PREFIX}/{service}")
+                dconf.set("obs.doctor.push.namenode", "false")
+                dconf.set("obs.doctor.interval", "3600s")
+                dconf.set("obs.slo.window.fast", "2")
+                dconf.set("obs.slo.window.slow", "8")
+                dconf.set("obs.slo.burn.min-windows", "2")
+                dconf.set("obs.slo.burn.history", "4")
+                # the bench overload lasts seconds, not the hours the
+                # default 14x fast gate is sized for: run the heavy
+                # class on a tight error budget (99.9%) so the shed
+                # storm measurably burns it, and gate at 5x so the
+                # verdict is deterministic at this scenario's scale
+                dconf.set("obs.slo.burn.fast", "5")
+                dconf.set("obs.slo.p3.availability", "0.999")
+                doctor = FleetDoctor(dconf)
+                doctor.init(dconf)
+                doctor.start()
+                slo_doctor[0] = doctor
+                doctor.poll_once()
                 # QoS overload: heavy tenant floods the survivor's door
                 # directly; a light tenant keeps getting served
                 heavy_sheds = [0]
@@ -1253,6 +1291,42 @@ def run_storm(preset="tiny", slo_ttft_s=15.0, qos_slo_s=10.0,
                     failures.append(
                         f"light tenant p99 {light_p99:.2f}s degraded "
                         f"past {qos_slo_s:g}s while heavy was shedding")
+                # SLO scoreboard verdicts: poll 2 diffs the whole
+                # overload off the baseline; poll 3's fast window still
+                # spans the burn, so the min-windows hysteresis flags —
+                # pure counter arithmetic, nothing sleeps or races
+                doctor.poll_once()
+                doctor.poll_once()
+                slo_rep = json.loads(http_get(
+                    "127.0.0.1", doctor.port, "/ws/v1/fleet/slo",
+                    10.0))
+                classes = slo_rep.get("classes") or {}
+                heavy_row = classes.get("p3") or {}
+                light_row = classes.get("p0") or {}
+                if not heavy_row.get("burning"):
+                    failures.append(
+                        f"heavy class p3 never flagged burning at "
+                        f"/ws/v1/fleet/slo (row: {heavy_row})")
+                if light_row.get("burning"):
+                    failures.append(
+                        "light class p0 flagged burning — scoreboard "
+                        "fairness inverted")
+                light_avail = light_row.get("availability")
+                if light_avail is not None and light_avail < 1.0:
+                    failures.append(
+                        f"light class availability {light_avail} "
+                        f"under overload (contract: stays green)")
+                from hadoop_tpu.obs.build import build_info
+                result["slo"] = {
+                    "code": build_info()["code_hash"],
+                    "windows_seen": slo_rep.get("windows_seen"),
+                    "classes": {
+                        c: {k: row.get(k) for k in
+                            ("availability", "burn_fast", "burn_slow",
+                             "burning", "ttft_p99_ms",
+                             "ttft_attained", "token_p99_ms", "window")}
+                        for c, row in classes.items()
+                        if isinstance(row, dict)}}
                 result.update(
                     qos_heavy_sheds=heavy_sheds[0],
                     qos_light_sheds=light_sheds[0],
@@ -1281,6 +1355,11 @@ def run_storm(preset="tiny", slo_ttft_s=15.0, qos_slo_s=10.0,
                 scaler.stop()
             except Exception as e:  # noqa: BLE001
                 print(f"WARN: scaler stop: {e}", file=sys.stderr)
+            if slo_doctor[0] is not None:
+                try:
+                    slo_doctor[0].stop()
+                except Exception as e:  # noqa: BLE001
+                    print(f"WARN: doctor stop: {e}", file=sys.stderr)
             router.close()
             fleet.reap()
             reg_srv.stop()
@@ -1386,6 +1465,9 @@ def main(argv=None) -> int:
                          "tiers, decoded through the real door with "
                          "an exact single-chip reference match, CP "
                          "guards accepted, TTFT-by-chips recorded")
+    ap.add_argument("--bench-log", default="BENCH_LOG.jsonl",
+                    help="trajectory log the --storm SLO scorecard "
+                         "row is appended to ('' disables)")
     ap.add_argument("--prefix-groups", type=int, default=4)
     ap.add_argument("--shared-len", type=int, default=80)
     ap.add_argument("--no-prefix-cache", action="store_true",
@@ -1457,6 +1539,14 @@ def main(argv=None) -> int:
     elif args.storm:
         result = run_storm(preset=args.preset)
         failed = result["failed"]
+        # the per-class SLO scorecard lands in the trajectory log so
+        # fleet-level regressions between issues stay visible
+        if args.bench_log and result.get("slo"):
+            from benchmarks.bench_trend import append_slo_scorecard
+            try:
+                append_slo_scorecard(args.bench_log, result["slo"])
+            except OSError as e:
+                print(f"WARN: scorecard append: {e}", file=sys.stderr)
     elif args.churn:
         result = run_churn(preset=args.preset, max_new=args.max_new,
                            max_batch=args.max_batch, seed=args.seed,

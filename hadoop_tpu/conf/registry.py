@@ -1552,6 +1552,177 @@ KEYS = {
             'hadoop_tpu/obs/doctor.py',
         ),
     },
+    "obs.slo.burn.fast": {
+        "type": 'float',
+        "defaults": ('14.0',),
+        "namespace": 'obs',
+        "documented": True, "sites": 1,
+        "files": (
+            'hadoop_tpu/obs/slo.py',
+        ),
+    },
+    "obs.slo.burn.history": {
+        "type": 'int',
+        "defaults": ('5',),
+        "namespace": 'obs',
+        "documented": True, "sites": 1,
+        "files": (
+            'hadoop_tpu/obs/slo.py',
+        ),
+    },
+    "obs.slo.burn.min-windows": {
+        "type": 'int',
+        "defaults": ('2',),
+        "namespace": 'obs',
+        "documented": True, "sites": 1,
+        "files": (
+            'hadoop_tpu/obs/slo.py',
+        ),
+    },
+    "obs.slo.burn.slow": {
+        "type": 'float',
+        "defaults": ('2.0',),
+        "namespace": 'obs',
+        "documented": True, "sites": 1,
+        "files": (
+            'hadoop_tpu/obs/slo.py',
+        ),
+    },
+    "obs.slo.class.map": {
+        "type": 'str',
+        "defaults": ("''",),
+        "namespace": 'obs',
+        "documented": True, "sites": 1,
+        "files": (
+            'hadoop_tpu/obs/slo.py',
+        ),
+    },
+    "obs.slo.p0.availability": {
+        "type": 'float',
+        "defaults": ('0.99',),
+        "namespace": 'obs',
+        "documented": True, "sites": 1,
+        "files": (
+            'hadoop_tpu/obs/slo.py',
+        ),
+    },
+    "obs.slo.p0.token.p99.ms": {
+        "type": 'float',
+        "defaults": ('500.0',),
+        "namespace": 'obs',
+        "documented": True, "sites": 1,
+        "files": (
+            'hadoop_tpu/obs/slo.py',
+        ),
+    },
+    "obs.slo.p0.ttft.p99.ms": {
+        "type": 'float',
+        "defaults": ('2000.0',),
+        "namespace": 'obs',
+        "documented": True, "sites": 1,
+        "files": (
+            'hadoop_tpu/obs/slo.py',
+        ),
+    },
+    "obs.slo.p1.availability": {
+        "type": 'float',
+        "defaults": ('0.99',),
+        "namespace": 'obs',
+        "documented": True, "sites": 1,
+        "files": (
+            'hadoop_tpu/obs/slo.py',
+        ),
+    },
+    "obs.slo.p1.token.p99.ms": {
+        "type": 'float',
+        "defaults": ('500.0',),
+        "namespace": 'obs',
+        "documented": True, "sites": 1,
+        "files": (
+            'hadoop_tpu/obs/slo.py',
+        ),
+    },
+    "obs.slo.p1.ttft.p99.ms": {
+        "type": 'float',
+        "defaults": ('2000.0',),
+        "namespace": 'obs',
+        "documented": True, "sites": 1,
+        "files": (
+            'hadoop_tpu/obs/slo.py',
+        ),
+    },
+    "obs.slo.p2.availability": {
+        "type": 'float',
+        "defaults": ('0.99',),
+        "namespace": 'obs',
+        "documented": True, "sites": 1,
+        "files": (
+            'hadoop_tpu/obs/slo.py',
+        ),
+    },
+    "obs.slo.p2.token.p99.ms": {
+        "type": 'float',
+        "defaults": ('500.0',),
+        "namespace": 'obs',
+        "documented": True, "sites": 1,
+        "files": (
+            'hadoop_tpu/obs/slo.py',
+        ),
+    },
+    "obs.slo.p2.ttft.p99.ms": {
+        "type": 'float',
+        "defaults": ('2000.0',),
+        "namespace": 'obs',
+        "documented": True, "sites": 1,
+        "files": (
+            'hadoop_tpu/obs/slo.py',
+        ),
+    },
+    "obs.slo.p3.availability": {
+        "type": 'float',
+        "defaults": ('0.99',),
+        "namespace": 'obs',
+        "documented": True, "sites": 1,
+        "files": (
+            'hadoop_tpu/obs/slo.py',
+        ),
+    },
+    "obs.slo.p3.token.p99.ms": {
+        "type": 'float',
+        "defaults": ('500.0',),
+        "namespace": 'obs',
+        "documented": True, "sites": 1,
+        "files": (
+            'hadoop_tpu/obs/slo.py',
+        ),
+    },
+    "obs.slo.p3.ttft.p99.ms": {
+        "type": 'float',
+        "defaults": ('2000.0',),
+        "namespace": 'obs',
+        "documented": True, "sites": 1,
+        "files": (
+            'hadoop_tpu/obs/slo.py',
+        ),
+    },
+    "obs.slo.window.fast": {
+        "type": 'int',
+        "defaults": ('3',),
+        "namespace": 'obs',
+        "documented": True, "sites": 1,
+        "files": (
+            'hadoop_tpu/obs/slo.py',
+        ),
+    },
+    "obs.slo.window.slow": {
+        "type": 'int',
+        "defaults": ('12',),
+        "namespace": 'obs',
+        "documented": True, "sites": 1,
+        "files": (
+            'hadoop_tpu/obs/slo.py',
+        ),
+    },
     "obs.trainer.port": {
         "type": 'int',
         "defaults": ('0',),
@@ -1883,6 +2054,15 @@ KEYS = {
         "documented": True, "sites": 1,
         "files": (
             'hadoop_tpu/serving/autoscale/signals.py',
+        ),
+    },
+    "serving.autoscale.slo.burn": {
+        "type": 'bool',
+        "defaults": ('False',),
+        "namespace": 'serving',
+        "documented": True, "sites": 1,
+        "files": (
+            'hadoop_tpu/serving/autoscale/controller.py',
         ),
     },
     "serving.autoscale.ttft.p99.slo": {

@@ -343,11 +343,15 @@ class HttpServer:
         per-scrape with ``?exemplars=0`` or fleet-wide with
         ``metrics.prom.exemplars=false``."""
         from hadoop_tpu.metrics.prom import render_prom
+        from hadoop_tpu.obs.build import build_info_prom
         exemplars = self.conf.get_bool("metrics.prom.exemplars", True)
         q = (query.get("exemplars") or "").strip().lower()
         if q:
             exemplars = q not in ("0", "false", "no")
-        return 200, render_prom(metrics_system(), exemplars=exemplars)
+        text = render_prom(metrics_system(), exemplars=exemplars)
+        # every chassis carries the build-identity constant gauge so
+        # fleet dashboards can join scrapes against BENCH_LOG rows
+        return 200, text + build_info_prom()
 
     def _traces(self, query, body):
         """Span-collector ring: ?trace_id= filters (decimal OR the hex

@@ -18,10 +18,14 @@ package adds the layer that sees the *fleet*:
 - ``comm``      — the RUNTIME comm ledger: per-site byte counters +
                   dispatch-window latency histograms (``htpu_comm``)
 - ``hbm``       — the live HBM ledger (``htpu_hbm_bytes{component=}``)
+- ``slo``       — the fleet SLO scoreboard: per-tenant-class
+                  attainment + error-budget burn (``/ws/v1/fleet/slo``)
+- ``build``     — ``htpu_build_info`` constant gauge on every chassis
 """
 
 from hadoop_tpu.obs.assemble import (Endpoint, FleetTraceStore,
                                      assemble_tree)
+from hadoop_tpu.obs.build import build_info, build_info_prom
 from hadoop_tpu.obs.comm import CommRuntime, comm_runtime, record_comm
 from hadoop_tpu.obs.detect import (SlowNodeDetector, mad_outliers,
                                    median)
@@ -30,6 +34,8 @@ from hadoop_tpu.obs.hbm import HbmLedger, hbm_ledger
 from hadoop_tpu.obs.peers import PeerLatencyTracker
 from hadoop_tpu.obs.top import (register_top_source, top_n,
                                 unregister_top_source)
+from hadoop_tpu.obs.slo import (SLO_CLASSES, SloScoreboard,
+                                parse_class_map, slo_class_of)
 from hadoop_tpu.obs.trainer import TrainerStepMetrics, TrainerTelemetry
 
 __all__ = ["Endpoint", "FleetTraceStore", "assemble_tree",
@@ -38,4 +44,6 @@ __all__ = ["Endpoint", "FleetTraceStore", "assemble_tree",
            "register_top_source", "top_n", "unregister_top_source",
            "CommRuntime", "comm_runtime", "record_comm",
            "HbmLedger", "hbm_ledger",
+           "SLO_CLASSES", "SloScoreboard", "parse_class_map",
+           "slo_class_of", "build_info", "build_info_prom",
            "TrainerStepMetrics", "TrainerTelemetry"]

@@ -1,0 +1,78 @@
+"""Build identity for the /prom chassis and the bench scorecard.
+
+``htpu_build_info{code_hash,jax} 1`` is the standard constant-gauge
+idiom: a value-1 gauge whose labels carry the build identity so fleet
+dashboards can join live series against BENCH_LOG.jsonl rows (which
+stamp the same hash). The label VALUES vary per build but the series
+is a single per-process constant — it is hand-rendered onto the
+chassis ``/prom`` text (see ``HttpServer._prom``) rather than minted
+through the metrics registry, whose static label lint is scoped to
+per-request label sets.
+
+Resolution order for ``code_hash``: ``HTPU_CODE_HASH`` env (set by CI
+or the bench harness), then ``git rev-parse --short HEAD`` from the
+package checkout, else ``unknown``. The probe runs once per process.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+from typing import Dict, Optional
+
+log = logging.getLogger(__name__)
+
+_INFO: Optional[Dict[str, str]] = None
+
+
+def _git_hash() -> str:
+    env = os.environ.get("HTPU_CODE_HASH", "").strip()
+    if env:
+        return env
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=5)
+        if out.returncode == 0 and out.stdout.strip():
+            return out.stdout.strip()
+    except (OSError, subprocess.SubprocessError) as e:
+        log.debug("build hash probe failed: %s", e)
+    return "unknown"
+
+
+def _jax_version() -> str:
+    # metadata only -- build info must never be the reason a light
+    # daemon (DataNode, doctor) imports jax
+    try:
+        from importlib.metadata import PackageNotFoundError, version
+    except ImportError:          # pragma: no cover — py<3.8 fallback
+        return "none"
+    try:
+        return version("jax")
+    except PackageNotFoundError:
+        return "none"
+
+
+def build_info() -> Dict[str, str]:
+    """Cached ``{"code_hash": ..., "jax": ...}`` for this process."""
+    global _INFO
+    if _INFO is None:
+        _INFO = {"code_hash": _git_hash(), "jax": _jax_version()}
+    return dict(_INFO)
+
+
+def _esc(value: str) -> str:
+    return (value.replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def build_info_prom() -> str:
+    """The ``htpu_build_info`` exposition block (trailing newline)."""
+    info = build_info()
+    labels = ",".join(f'{k}="{_esc(v)}"'
+                      for k, v in sorted(info.items()))
+    return ("# HELP htpu_build_info build identity of this process\n"
+            "# TYPE htpu_build_info gauge\n"
+            f"htpu_build_info{{{labels}}} 1\n")

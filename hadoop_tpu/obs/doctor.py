@@ -44,6 +44,7 @@ from hadoop_tpu.http import http_get
 from hadoop_tpu.obs.assemble import (Endpoint, FleetTraceStore,
                                      parse_endpoint_list)
 from hadoop_tpu.obs.detect import SlowNodeDetector, median
+from hadoop_tpu.obs.slo import SloScoreboard
 from hadoop_tpu.service import AbstractService
 from hadoop_tpu.util.misc import Daemon, backoff_delay
 
@@ -147,7 +148,11 @@ class FleetDoctor(AbstractService):
         self.http = HttpServer(
             conf, bind=("127.0.0.1", conf.get_int("obs.doctor.port", 0)),
             daemon_name="fleet-doctor")
+        # fleet SLO scoreboard: class-labeled door accounting diffed
+        # per poll into availability / p99 attainment / budget burn
+        self.slo = SloScoreboard(conf)
         self.http.add_handler("/ws/v1/fleet/doctor", self._h_doctor)
+        self.http.add_handler("/ws/v1/fleet/slo", self._h_slo)
         self.http.add_handler("/ws/v1/fleet/traces", self._h_traces)
 
     def service_start(self) -> None:
@@ -320,6 +325,9 @@ class FleetDoctor(AbstractService):
                                            self.timeout).decode())
             except (OSError, ValueError):
                 continue
+            # the SLO scoreboard diffs the same scrape (class-labeled
+            # htpu_slo_* families) with its own per-endpoint baselines
+            self.slo.observe(ep.key, fams)
             prev = self._prom_prev.setdefault(ep.key, {})
             for family, sink in ((STEP_FAMILY, step_means),
                                  (TTFT_FAMILY, ttft_means)):
@@ -337,6 +345,9 @@ class FleetDoctor(AbstractService):
         # a port per replica — the FleetScraper precedent)
         for key in [k for k in self._prom_prev if k not in seen]:
             del self._prom_prev[key]
+        # close the scoreboard's poll window (same departed-endpoint
+        # pruning; merges this poll's per-class deltas + recomputes)
+        self.slo.commit(seen)
         if step_means:
             self.detectors["replica.decode_step"].observe(step_means)
         if ttft_means:
@@ -454,6 +465,9 @@ class FleetDoctor(AbstractService):
             "datanodes": section(("dn.pipeline_ack", "dn.read_service")),
             "replicas": section(("replica.decode_step", "replica.ttft")),
             "trainers": trainers,
+            # per-class SLO attainment + error-budget burn verdicts —
+            # the autoscaler reads burn off this same pull
+            "slo": self.slo.report(),
             "traces_held": len(self.store.trace_ids()),
         }
 
@@ -515,6 +529,12 @@ class FleetDoctor(AbstractService):
 
     def _h_doctor(self, query, body):
         return 200, self.report()
+
+    def _h_slo(self, query, body):
+        """The fleet SLO scoreboard on its own: per-class p99
+        attainment vs conf'd targets, availability, and multi-window
+        error-budget burn over the doctor's poll cadence."""
+        return 200, self.slo.report()
 
     def _h_traces(self, query, body):
         """``/ws/v1/fleet/traces`` lists held ids;

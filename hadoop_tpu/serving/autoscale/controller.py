@@ -78,6 +78,11 @@ SCALEIN_TTFT_FRAC_KEY = "serving.autoscale.scalein.ttft.frac"
 # fleet doctor door (host:port): sick replicas become preferred
 # scale-in victims — retiring the statistical outlier heals the fleet
 DOCTOR_KEY = "serving.autoscale.doctor"
+# guarded grow signal off the doctor's SLO scoreboard: when enabled, a
+# tenant class burning its error budget (multi-window verdict at
+# /ws/v1/fleet/slo) breaches like a shed. Default OFF — the scoreboard
+# observes a fleet for free; acting on it is an operator's call.
+SLO_BURN_KEY = "serving.autoscale.slo.burn"
 
 METRICS_SOURCE = "serving.autoscale"
 
@@ -200,6 +205,10 @@ class Autoscaler:
             host, _, port = doctor.rpartition(":")
             self._doctor_addr = (host or "127.0.0.1", int(port))
         self._sick: set = set()     # doctor-flagged replica paths
+        self.slo_burn_enabled = conf.get_bool(SLO_BURN_KEY, False)
+        # last per-class burn verdict off the doctor report's "slo"
+        # section (kept on doctor outage, like _sick)
+        self._slo_burn: Dict[str, dict] = {}
         self._pools: Dict[str, _PoolState] = {
             "decode": _PoolState(), "prefill": _PoolState()}
         self._draining: set = set()     # guarded-by: _lock
@@ -271,6 +280,16 @@ class Autoscaler:
                                       self.scraper.timeout))
             self._sick = set((rep.get("replicas") or {})
                              .get("flagged", {}).keys())
+            # the SLO burn verdicts ride the same pull — one doctor
+            # scrape feeds both victim preference and the grow signal
+            classes = (rep.get("slo") or {}).get("classes") or {}
+            self._slo_burn = {
+                cls: {"burning": bool(row.get("burning")),
+                      "burn_fast": row.get("burn_fast"),
+                      "burn_slow": row.get("burn_slow"),
+                      "availability": row.get("availability")}
+                for cls, row in classes.items()
+                if isinstance(row, dict)}
         except (OSError, ValueError) as e:
             log.debug("doctor scrape failed: %s", e)
 
@@ -290,6 +309,13 @@ class Autoscaler:
                     f"{self.ttft_slo * 1e3:.0f}ms")
         if snap.shed_delta > 0:
             return f"{snap.shed_delta} requests shed (429) this window"
+        if self.slo_burn_enabled:
+            burning = sorted(cls for cls, row in self._slo_burn.items()
+                             if row.get("burning"))
+            if burning:
+                return (f"error-budget burn in class"
+                        f"{'es' if len(burning) > 1 else ''} "
+                        f"{', '.join(burning)} (doctor SLO scoreboard)")
         q = snap.mean_queue_depth(role)
         if q > self.queue_high:
             return f"queue depth {q:.1f}/replica > {self.queue_high:g}"
@@ -523,6 +549,10 @@ class Autoscaler:
             "shed_delta": snap.shed_delta if snap else 0,
             "draining": draining,
             "sick": sorted(self._sick),
+            # last per-class SLO burn verdict (doctor scoreboard) next
+            # to the decision history it can justify
+            "slo_burn": {"enabled": self.slo_burn_enabled,
+                         "classes": dict(self._slo_burn)},
             "decisions": [
                 {"at": d.at, "role": d.role, "action": d.action,
                  "current": d.current, "target": d.target,

@@ -154,6 +154,33 @@ class ServingMetrics:
             "requests shed (429 + Retry-After) at the serving door")
         self.qos_tenants = reg.gauge(
             "qos_tenants", "tenants tracked by the decay cost scheduler")
+        # fleet SLO scoreboard (obs/slo): class-labeled request
+        # accounting the doctor diffs per poll window. The class set
+        # is the BOUNDED p0..p3 ladder (DecayCostScheduler level,
+        # clamped — see hadoop_tpu.obs.slo.SLO_CLASSES); the tuples
+        # stay inline literals so the label lint can prove the bound.
+        self.slo_ttft_hist = {
+            cls: reg.histogram(
+                f"slo_ttft_seconds_{cls}",
+                "submit to first token by tenant class",
+                prom_name="slo_ttft_seconds",
+                prom_labels={"class": cls})
+            for cls in ("p0", "p1", "p2", "p3")}
+        self.slo_token_hist = {
+            cls: reg.histogram(
+                f"slo_token_seconds_{cls}",
+                "per-token decode seconds by tenant class",
+                prom_name="slo_token_seconds",
+                prom_labels={"class": cls})
+            for cls in ("p0", "p1", "p2", "p3")}
+        self.slo_requests = {
+            (cls, outcome): reg.counter(
+                f"slo_requests_{cls}_{outcome}",
+                "door outcomes by tenant class",
+                prom_name="slo_requests",
+                prom_labels={"class": cls, "outcome": outcome})
+            for cls in ("p0", "p1", "p2", "p3")
+            for outcome in ("ok", "shed", "failed")}
         # the weight plane: measured resident weight bytes (int8
         # payloads + scale planes under serving.parity=relaxed, plain
         # dtype bytes bitwise) — the number the KV budget subtracts

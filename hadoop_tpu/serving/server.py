@@ -35,10 +35,12 @@ import json
 import logging
 import queue
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 from hadoop_tpu.conf import Configuration
 from hadoop_tpu.http.server import HttpServer
+from hadoop_tpu.obs.slo import parse_class_map, slo_class_of
 from hadoop_tpu.security.http_auth import AuthFilter
 from hadoop_tpu.serving.engine import DecodeEngine, SamplingParams
 from hadoop_tpu.tracing.tracer import SpanContext, global_tracer
@@ -72,6 +74,12 @@ class ServingServer:
         # load shedding in front of engine admission. None = open door
         # (bare servers in tests; ServingReplica wires the gate).
         self.qos = qos
+        # fleet SLO scoreboard (obs/slo): every request is stamped
+        # with a bounded tenant class — the QoS scheduler's decay
+        # level clamped into p0..p3, or a conf-pinned identity — and
+        # the door records class-labeled TTFT / per-token / outcome
+        # families the doctor diffs per poll window
+        self._class_map = parse_class_map(self.conf)
         # autoscaler hook: /v1/admin/drain invokes this (async) so a
         # controller can retire THIS replica — the replica process
         # wires its own full drain-and-exit here
@@ -121,6 +129,42 @@ class ServingServer:
         if self.qos is not None:
             self.qos.stop()
         self.http.stop()
+
+    # ------------------------------------------------------------------ slo
+
+    def _slo_class(self, tenant: str, level: int) -> str:
+        """Bounded tenant class: the conf identity map wins, else the
+        QoS decay level clamps into p0..p3 (open door => p0)."""
+        cls = self._class_map.get(tenant or "anonymous")
+        return cls if cls is not None else slo_class_of(level)
+
+    def _slo_record(self, cls: str, outcome: str,
+                    ttft_s: Optional[float] = None,
+                    token_s: Optional[float] = None) -> None:
+        m = getattr(self.engine, "metrics", None)
+        if m is None or not hasattr(m, "slo_requests"):
+            return                       # bare engines mint no metrics
+        m.slo_requests[(cls, outcome)].incr()
+        if ttft_s is not None:
+            m.slo_ttft_hist[cls].add(ttft_s)
+        if token_s is not None:
+            m.slo_token_hist[cls].add(token_s)
+
+    def _slo_finish(self, cls: str, handle, failed: bool) -> None:
+        """Terminal accounting for an admitted request: outcome plus
+        the latency families when a first token was delivered."""
+        ttft_s = None
+        token_s = None
+        if handle.first_token_at is not None:
+            ttft_s = max(0.0, handle.first_token_at
+                         - handle.submitted_at)
+            n = len(handle.out_tokens)
+            if n >= 2:
+                token_s = max(0.0, (time.monotonic()
+                                    - handle.first_token_at)
+                              / (n - 1))
+        self._slo_record(cls, "failed" if failed else "ok",
+                         ttft_s=ttft_s, token_s=token_s)
 
     # ------------------------------------------------------------- handlers
 
@@ -251,11 +295,14 @@ class ServingServer:
         # claim — QoS fairness, unlike authz, is useful even on an
         # open door
         tenant = query.get("__user__") or query.get("user.name") or ""
+        slo_cls = self._slo_class(tenant, 0)
         if self.qos is not None:
             ok, retry_after, level = self.qos.admit(
                 tenant, self.qos.cost_of(tokens,
                                          sampling.max_new_tokens))
+            slo_cls = self._slo_class(tenant, level)
             if not ok:
+                self._slo_record(slo_cls, "shed")
                 # the router treats 429 + Retry-After as
                 # retriable-on-another-replica; a direct caller backs
                 # off — either way this replica sheds the over-share
@@ -288,7 +335,7 @@ class ServingServer:
         span.add_kv("request", str(handle.id))
         if str(req.get("stream", "")).lower() in ("1", "true", "yes") or \
                 req.get("stream") is True:
-            return 200, self._stream(handle, span)
+            return 200, self._stream(handle, span, slo_cls)
         try:
             out = handle.wait(timeout=timeout)
         except RuntimeError as e:
@@ -297,6 +344,7 @@ class ServingServer:
             # where the cross-daemon trace earns its keep
             span.add_kv("failed", str(e))
             span.finish()
+            self._slo_finish(slo_cls, handle, failed=True)
             return 500, {"RemoteException": {
                 "exception": "GenerationFailedException",
                 "message": f"request {handle.id}: {e}"}}
@@ -308,26 +356,33 @@ class ServingServer:
             # drop — same semantics as a client killing a stream
             span.add_kv("timed_out", "true")
             span.finish()
+            # a missed deadline spends error budget: the caller never
+            # got their generation, whatever the engine does next
+            self._slo_record(slo_cls, "failed")
             return 408, {"RemoteException": {
                 "exception": "RequestTimedOutException",
                 "message": f"request {handle.id} still decoding after "
                            f"{timeout}s"}}
         span.add_kv("tokens_out", str(len(out)))
         span.finish()
+        self._slo_finish(slo_cls, handle, failed=False)
         return 200, {"request_id": handle.id, "tokens": out,
                      "prompt_tokens": len(tokens)}
 
-    def _stream(self, handle, span):
+    def _stream(self, handle, span, slo_cls: str = "p0"):
         """Chunked body: one JSON line per token, terminal summary line.
         The chassis frames each yielded chunk; a killed connection just
         ends the generator — the engine finishes the request and the
         tokens fall on the floor, which is the right drop semantics."""
+        timed_out = [False]
+
         def gen():
             try:
                 while True:
                     try:
                         tok = handle.tokens_out.get(timeout=300.0)
                     except queue.Empty:
+                        timed_out[0] = True
                         yield (json.dumps(
                             {"error": "timed out"}) + "\n").encode()
                         return
@@ -343,4 +398,7 @@ class ServingServer:
             finally:
                 span.add_kv("tokens_out", str(len(handle.out_tokens)))
                 span.finish()
+                self._slo_finish(
+                    slo_cls, handle,
+                    failed=timed_out[0] or handle.state == "FAILED")
         return gen()

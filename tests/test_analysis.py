@@ -741,6 +741,45 @@ def test_unbounded_counter_label_is_flagged(tmp_path):
     assert ids_of(findings) == ["metrics/unbounded-label"]
 
 
+def test_bounded_slo_class_outcome_labels_are_clean(tmp_path):
+    """The SLO scoreboard idiom (serving/metrics.py): a dict
+    comprehension with TWO generators over inline literal tuples
+    binds both the class and the outcome as provably bounded — 12
+    same-kind registrations share one prom family without a flag."""
+    from hadoop_tpu.analysis import PromFamilyChecker
+    findings = lint_source(tmp_path, """
+        def slo(reg):
+            hists = {c: reg.histogram(f"slo_ttft_seconds_{c}", "ttft",
+                                      prom_name="slo_ttft_seconds",
+                                      prom_labels={"class": c})
+                     for c in ("p0", "p1", "p2", "p3")}
+            counters = {(c, o): reg.counter(
+                            f"slo_requests_{c}_{o}", "outcomes",
+                            prom_name="slo_requests",
+                            prom_labels={"class": c, "outcome": o})
+                        for c in ("p0", "p1", "p2", "p3")
+                        for o in ("ok", "shed", "failed")}
+            return hists, counters
+    """, [PromFamilyChecker()])
+    assert findings == []
+
+
+def test_unbounded_tenant_class_label_is_flagged(tmp_path):
+    """The failure the bounded p0..p3 ladder exists to prevent: a
+    class set flowing in from data (a conf string, a tenant name)
+    would mint unbounded /prom series."""
+    from hadoop_tpu.analysis import PromFamilyChecker
+    findings = lint_source(tmp_path, """
+        def slo(reg, classes):
+            for c in classes:
+                reg.counter("slo_requests_" + c,
+                            "BAD: class set from a parameter",
+                            prom_name="slo_requests",
+                            prom_labels={"class": c})
+    """, [PromFamilyChecker()])
+    assert ids_of(findings) == ["metrics/unbounded-label"]
+
+
 # -------------------------------------------- suppression + baseline
 
 def test_line_suppression(tmp_path):

@@ -1,0 +1,475 @@
+"""Fleet SLO scoreboard: bounded tenant-class stamping at the serving
+door, class-labeled ``htpu_slo_*`` families on ``/prom``, doctor-side
+burn-rate/attainment math over injected cumulative counters, the
+autoscaler's guarded grow signal, the ``htpu_build_info`` constant
+gauge, and the BENCH_LOG scorecard/trend satellites.
+
+Determinism rule (the ISSUE's hard constraint): every burn/attainment
+verdict here is pure arithmetic over INJECTED counters pumped through
+``observe``/``commit`` — no wall-clock reads feed an assertion.
+"""
+
+import http.client
+import json
+import re
+import threading
+import time
+
+import jax
+import pytest
+
+from hadoop_tpu.conf import Configuration
+from hadoop_tpu.models.config import get_config
+from hadoop_tpu.models.decoder import init_params
+from hadoop_tpu.obs.slo import (SLO_CLASSES, SloScoreboard,
+                                parse_class_map, slo_class_of)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = get_config("tiny")
+    return init_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10.0)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _get_json(port, path):
+    status, body = _get(port, path)
+    assert status == 200, body
+    return json.loads(body)
+
+
+def _post_json(port, path, payload, timeout=60.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                      timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(payload).encode())
+        resp = conn.getresponse()
+        body = resp.read()
+        return resp.status, (json.loads(body) if body else {})
+    finally:
+        conn.close()
+
+
+# ------------------------------------------------------ class stamping
+
+def test_slo_class_of_clamps_into_the_bounded_set():
+    assert slo_class_of(0) == "p0"
+    assert slo_class_of(3) == "p3"
+    # a deeper QoS ladder or a junk level must NOT mint a new label
+    assert slo_class_of(17) == "p3"
+    assert slo_class_of(-2) == "p0"
+    assert all(slo_class_of(n) in SLO_CLASSES for n in range(-3, 9))
+
+
+def test_parse_class_map_drops_unknown_classes():
+    conf = Configuration(load_defaults=False)
+    conf.set("obs.slo.class.map",
+             " heavy = p3 , light=p0, weird=zz, =p1, bare")
+    m = parse_class_map(conf)
+    # the pinned identities land; an unknown class and malformed
+    # entries are dropped — the label set stays bounded no matter
+    # what the conf says
+    assert m == {"heavy": "p3", "light": "p0"}
+    assert parse_class_map(Configuration(load_defaults=False)) == {}
+
+
+# ------------------------------------------------- injected-counter math
+
+def _fams(outcomes, ttft=None, token=None):
+    """Build a parse_prom-shaped family dict from per-class CUMULATIVE
+    outcome counts and optional cumulative histogram buckets."""
+    fams = {"htpu_slo_requests_total": [
+        ({"class": c, "outcome": o}, float(v))
+        for c, oc in outcomes.items() for o, v in oc.items()]}
+    for name, hists in (("htpu_slo_ttft_seconds", ttft),
+                        ("htpu_slo_token_seconds", token)):
+        if not hists:
+            continue
+        fams[f"{name}_bucket"] = [
+            ({"class": c, "le": str(le)}, float(v))
+            for c, b in hists.items() for le, v in b.items()]
+        fams[f"{name}_count"] = [
+            ({"class": c}, float(max(b.values())))
+            for c, b in hists.items()]
+    return fams
+
+
+def _board(**over):
+    conf = Configuration(load_defaults=False)
+    conf.set("obs.slo.window.fast", "2")
+    conf.set("obs.slo.window.slow", "4")
+    conf.set("obs.slo.burn.min-windows", "1")
+    conf.set("obs.slo.burn.history", "3")
+    for k, v in over.items():
+        conf.set(k.replace("_", "."), v)
+    return SloScoreboard(conf)
+
+
+def test_burn_rate_and_attainment_over_injected_counters():
+    sb = _board()
+    # poll 1: both classes healthy (baseline)
+    sb.observe("r0", _fams(
+        {"p3": {"ok": 10}, "p0": {"ok": 10}},
+        ttft={"p0": {0.1: 10, float("inf"): 10}}))
+    rep = sb.commit(["r0"])
+    assert rep["classes"]["p3"]["burning"] is False
+    assert rep["classes"]["p0"]["availability"] == pytest.approx(1.0)
+    # poll 2: the heavy class torches its budget (21 failures on 1 ok
+    # delta); the light class stays perfect and fast
+    sb.observe("r0", _fams(
+        {"p3": {"ok": 11, "failed": 21}, "p0": {"ok": 12}},
+        ttft={"p0": {0.1: 12, float("inf"): 12}}))
+    rep = sb.commit(["r0"])
+    p3, p0 = rep["classes"]["p3"], rep["classes"]["p0"]
+    # fast window spans both polls: 11 ok / 32 total
+    assert p3["availability"] == pytest.approx(11 / 32)
+    budget = 1.0 - p3["targets"]["availability"]
+    assert p3["burn_fast"] == pytest.approx(
+        (1 - 11 / 32) / budget)
+    assert p3["burn_fast"] >= 14.0 and p3["burn_slow"] >= 2.0
+    assert p3["burning"] is True
+    # the light class is green under the same overload: full
+    # availability, p99 attained against the 2000 ms default target
+    assert p0["availability"] == pytest.approx(1.0)
+    assert p0["burning"] is False and p0["burn_fast"] == 0.0
+    assert p0["ttft_p99_ms"] is not None
+    assert p0["ttft_p99_ms"] <= p0["targets"]["ttft_p99_ms"]
+    assert p0["ttft_attained"] is True
+    assert rep["windows_seen"] == 2
+
+
+def test_counter_reset_means_restart_not_negative_window():
+    sb = _board(obs_slo_window_fast="1")
+    sb.observe("r0", _fams({"p3": {"ok": 50}}))
+    sb.commit(["r0"])
+    # the replica restarted: cumulative counters fell. The whole new
+    # history belongs to this window (FleetScraper rule) — never a
+    # negative delta
+    sb.observe("r0", _fams({"p3": {"ok": 5}}))
+    rep = sb.commit(["r0"])
+    assert rep["classes"]["p3"]["window"]["ok"] == pytest.approx(5.0)
+    assert all(v >= 0 for v in
+               rep["classes"]["p3"]["window"].values())
+
+
+def test_departed_endpoint_is_pruned_then_rejoins_fresh():
+    sb = _board(obs_slo_window_fast="1")
+    sb.observe("a", _fams({"p0": {"ok": 100}}))
+    sb.observe("b", _fams({"p0": {"ok": 40}}))
+    sb.commit(["a", "b"])
+    # b leaves the registry; its baseline must be forgotten
+    sb.observe("a", _fams({"p0": {"ok": 101}}))
+    rep = sb.commit(["a"])
+    assert rep["classes"]["p0"]["window"]["ok"] == pytest.approx(1.0)
+    # b's address returns with LOWER counters (a new replica on a
+    # recycled port): fresh baseline, full value counted, no negatives
+    sb.observe("a", _fams({"p0": {"ok": 102}}))
+    sb.observe("b", _fams({"p0": {"ok": 3}}))
+    rep = sb.commit(["a", "b"])
+    assert rep["classes"]["p0"]["window"]["ok"] == pytest.approx(4.0)
+
+
+def test_burn_hysteresis_flags_and_recovers():
+    sb = _board(**{"obs_slo_burn_min-windows": "2",
+                   "obs_slo_window_fast": "1",
+                   "obs_slo_window_slow": "1"})
+    burn = {"p3": {"ok": 0, "failed": 10}}
+    ok = {"p3": {"ok": 10, "failed": 0}}
+    cum = {"ok": 0, "failed": 0}
+
+    def poll(shape):
+        cum["ok"] += shape["p3"].get("ok", 0)
+        cum["failed"] += shape["p3"].get("failed", 0)
+        sb.observe("r0", _fams({"p3": dict(cum)}))
+        return sb.commit(["r0"])
+
+    # one burning poll is a spike, not a verdict (min-windows=2)
+    assert poll(burn)["classes"]["p3"]["burning"] is False
+    # the second consecutive burning poll flags
+    assert poll(burn)["classes"]["p3"]["burning"] is True
+    # clean polls age the flag out of the history deque (3 here) —
+    # recovery without operator reset, the SlowNodeDetector precedent
+    for _ in range(3):
+        rep = poll(ok)
+    assert rep["classes"]["p3"]["burning"] is False
+
+
+def test_empty_fleet_commit_does_not_age_standing_verdicts():
+    sb = _board(**{"obs_slo_burn_min-windows": "1",
+                   "obs_slo_window_fast": "1",
+                   "obs_slo_window_slow": "1"})
+    sb.observe("r0", _fams({"p3": {"ok": 0, "failed": 10}}))
+    rep = sb.commit(["r0"])
+    assert rep["classes"]["p3"]["burning"] is True
+    before = rep["windows_seen"]
+    # nothing scraped and nobody known: NOT a window — a blind doctor
+    # must not launder a burning class back to green
+    rep = sb.commit([])
+    assert rep["windows_seen"] == before
+    assert rep["classes"]["p3"]["burning"] is True
+
+
+# ------------------------------------------------- door -> /prom e2e
+
+def test_door_stamps_bounded_class_labels_on_prom(tiny_model):
+    """The e2e seam: a pinned tenant's 200 lands under its mapped
+    class on /prom (ttft + outcome families), a QoS shed lands under
+    the level-derived class, and the chassis carries the
+    htpu_build_info constant gauge — all labels from the bounded
+    p0..p3 set."""
+    from hadoop_tpu.serving.engine import DecodeEngine
+    from hadoop_tpu.serving.metrics import ServingMetrics
+    from hadoop_tpu.serving.server import ServingServer
+    params, cfg = tiny_model
+    conf = Configuration(load_defaults=False)
+    conf.set("obs.slo.class.map", "vip=p1")
+    m = ServingMetrics()
+    eng = DecodeEngine(params, cfg, max_batch=2, block_size=4,
+                       max_context=32, metrics=m)
+    srv = ServingServer(eng, conf)
+    eng.start()
+    srv.start()
+    try:
+        ok_before = m.slo_requests[("p1", "ok")].value()
+        status, body = _post_json(
+            srv.port, "/v1/generate?user.name=vip",
+            {"tokens": [1, 2, 3], "max_new_tokens": 4})
+        assert status == 200, body
+        assert m.slo_requests[("p1", "ok")].value() == ok_before + 1
+        # a shedding gate stamps the shed with the ADMIT level's class
+        class _AlwaysShed:
+            @staticmethod
+            def cost_of(tokens, max_new):
+                return 1.0
+
+            def admit(self, tenant, cost):
+                return False, 0.05, 3
+
+            def stats(self):
+                return {}
+
+            def stop(self):
+                pass
+
+        srv.qos = _AlwaysShed()
+        shed_before = m.slo_requests[("p3", "shed")].value()
+        status, body = _post_json(
+            srv.port, "/v1/generate?user.name=batchjob",
+            {"tokens": [1, 2], "max_new_tokens": 4})
+        assert status == 429, body
+        assert m.slo_requests[("p3", "shed")].value() \
+            == shed_before + 1
+        # ...and the families surface class-labeled on this door's own
+        # /prom, next to the build-identity gauge
+        text = _get(srv.port, "/prom")[1].decode()
+        assert re.search(
+            r'htpu_slo_requests_total\{[^}]*class="p1"[^}]*'
+            r'outcome="ok"[^}]*\} \d+', text)
+        assert re.search(
+            r'htpu_slo_requests_total\{[^}]*class="p3"[^}]*'
+            r'outcome="shed"[^}]*\} \d+', text)
+        assert re.search(
+            r'htpu_slo_ttft_seconds_bucket\{[^}]*class="p1"', text)
+        assert re.search(
+            r'htpu_build_info\{code_hash="[^"]+",jax="[^"]+"\} 1',
+            text)
+        # every emitted class label is from the bounded set
+        for cls in re.findall(r'htpu_slo_\w+\{[^}]*class="([^"]+)"',
+                              text):
+            assert cls in SLO_CLASSES
+    finally:
+        srv.stop()
+
+
+def test_build_info_constant_gauge_on_every_chassis():
+    from hadoop_tpu.http.server import HttpServer
+    from hadoop_tpu.obs.build import build_info, build_info_prom
+    info = build_info()
+    assert set(info) == {"code_hash", "jax"}
+    assert info["code_hash"] and info["jax"]
+    assert build_info() == info          # cached: one probe per process
+    assert re.search(
+        r'htpu_build_info\{code_hash="[^"]+",jax="[^"]+"\} 1\n',
+        build_info_prom())
+    # any daemon's chassis carries it — not just serving doors
+    srv = HttpServer(Configuration(load_defaults=False),
+                     daemon_name="anydaemon")
+    srv.start()
+    try:
+        text = _get(srv.port, "/prom")[1].decode()
+        assert f'htpu_build_info{{code_hash="{info["code_hash"]}"' \
+            in text
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------- doctor + autoscaler seam
+
+class _FakeReplica:
+    """A scripted serving endpoint: the test sets the exact /prom text
+    the doctor scrapes, so the scoreboard verdict is pure counter
+    arithmetic (the _FakeRank precedent)."""
+
+    def __init__(self):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        fake = self
+
+        class _H(BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = fake.text.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.text = ""
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def set_counts(self, counts):
+        lines = ["# TYPE htpu_slo_requests_total counter"]
+        for cls, oc in counts.items():
+            for outcome, v in oc.items():
+                lines.append(
+                    f'htpu_slo_requests_total{{class="{cls}",'
+                    f'outcome="{outcome}"}} {v}')
+        self.text = "\n".join(lines) + "\n"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_doctor_scoreboard_flags_heavy_class_and_serves_slo_door():
+    """The deterministic overload scenario end-to-end through the
+    doctor: a registry-discovered replica's heavy class burns its
+    budget and is flagged at /ws/v1/fleet/slo within min-windows
+    polls; the light class stays green; the verdict is joined into
+    /ws/v1/fleet/doctor."""
+    from hadoop_tpu.obs.doctor import FleetDoctor
+    from hadoop_tpu.registry import RegistryServer, ServiceRecord
+    reg_srv = RegistryServer(Configuration(load_defaults=False))
+    reg_srv.init(Configuration(load_defaults=False))
+    reg_srv.start()
+    rep = _FakeReplica()
+    doctor = None
+    try:
+        reg_srv.put(ServiceRecord(
+            "/services/serving/svc/r0",
+            endpoints={"http": f"127.0.0.1:{rep.port}"}), ttl_s=3600)
+        dconf = Configuration(load_defaults=False)
+        dconf.set("obs.doctor.registry", f"127.0.0.1:{reg_srv.port}")
+        dconf.set("obs.doctor.push.namenode", "false")
+        dconf.set("obs.slo.window.fast", "2")
+        dconf.set("obs.slo.window.slow", "8")
+        dconf.set("obs.slo.burn.min-windows", "2")
+        dconf.set("obs.slo.burn.history", "4")
+        doctor = FleetDoctor(dconf)
+        doctor.init(dconf)
+        doctor.start()
+        # poll 1: healthy baseline for both classes
+        rep.set_counts({"p3": {"ok": 4}, "p0": {"ok": 5}})
+        doctor.poll_once()
+        # overload: the heavy class sheds 20 on 2 ok; light stays ok
+        rep.set_counts({"p3": {"ok": 6, "shed": 20},
+                        "p0": {"ok": 10}})
+        doctor.poll_once()
+        report = doctor.poll_once()       # 2nd flagged poll >= min
+        slo = _get_json(doctor.port, "/ws/v1/fleet/slo")
+        p3, p0 = slo["classes"]["p3"], slo["classes"]["p0"]
+        assert p3["burning"] is True, p3
+        assert p3["burn_fast"] >= 14.0 and p3["burn_slow"] >= 2.0
+        assert p0["burning"] is False, p0
+        assert p0["availability"] == pytest.approx(1.0)
+        # the same verdict rides the main doctor report
+        assert report["slo"]["classes"]["p3"]["burning"] is True
+    finally:
+        if doctor is not None:
+            doctor.stop()
+        rep.stop()
+        reg_srv.stop()
+
+
+def test_autoscaler_slo_burn_grow_signal_is_conf_guarded():
+    from hadoop_tpu.serving.autoscale import Autoscaler
+    from hadoop_tpu.serving.autoscale.signals import (FleetSnapshot,
+                                                      ReplicaSample)
+
+    def mk(enabled):
+        conf = Configuration(load_defaults=False)
+        conf.set("serving.autoscale.breach.polls", "1")
+        conf.set("serving.autoscale.cooldown", "0s")
+        conf.set("serving.autoscale.ttft.p99.slo", "1s")
+        if enabled:
+            conf.set("serving.autoscale.slo.burn", "true")
+        return Autoscaler(conf, ("127.0.0.1", 1), "svc")
+
+    calm = FleetSnapshot(at=0.0, samples=[ReplicaSample(
+        path="/s/d0", host="127.0.0.1", port=1, role="mixed", ok=True,
+        queue_depth=0, active=0, slots=4, prefill_backlog=0,
+        cached_blocks=0, load_seconds=0.0)])
+    burn = {"p3": {"burning": True, "burn_fast": 50.0,
+                   "burn_slow": 9.0, "availability": 0.5}}
+    # default OFF: a burning class alone must not grow the fleet
+    sc = mk(enabled=False)
+    sc._slo_burn = dict(burn)
+    assert sc._decide("decode", calm) is None
+    assert sc.status()["slo_burn"]["enabled"] is False
+    # opted in: the doctor's verdict is a grow reason on its own
+    sc = mk(enabled=True)
+    sc._slo_burn = dict(burn)
+    d = sc._decide("decode", calm)
+    assert d is not None and d.action == "grow"
+    assert "error-budget burn" in d.reason and "p3" in d.reason
+    st = sc.status()
+    assert st["slo_burn"]["enabled"] is True
+    assert st["slo_burn"]["classes"]["p3"]["burning"] is True
+
+
+# --------------------------------------- BENCH_LOG scorecard + sentinel
+
+def test_scorecard_append_and_trend_sentinel(tmp_path):
+    from benchmarks import bench_trend
+    log = str(tmp_path / "BENCH_LOG.jsonl")
+    slo = {"code": "abc1234",
+           "classes": {"p3": {"burning": True, "availability": 0.5},
+                       "p0": {"burning": False, "availability": 1.0}}}
+    bench_trend.append_slo_scorecard(log, slo)
+    with open(log) as f:
+        rows = [json.loads(line) for line in f]
+    assert rows[0]["metric"] == "slo_scorecard"
+    assert rows[0]["burning"] == ["p3"]
+    assert rows[0]["code"] == "abc1234"
+    # scorecards pass through the suite sentinel untouched
+    assert bench_trend.load_rows(log) == []
+    # history + a regressed newest row: flagged, and --check exits 1
+    with open(log, "a") as f:
+        for mbs in (100.0, 110.0, 105.0, 40.0):
+            f.write(json.dumps({
+                "metric": "bench_suite", "quick": False,
+                "key_metrics": {"dfsio.write_mb_s": mbs}}) + "\n")
+    verdict = bench_trend.check(bench_trend.load_rows(log))
+    assert verdict["regressions_count"] == 1
+    assert verdict["regressions"][0]["metric"] == "dfsio.write_mb_s"
+    assert verdict["regressions"][0]["direction"] == "higher"
+    assert bench_trend.main(["--log", log, "--check"]) == 1
+    # a recovered newest row passes the gate
+    with open(log, "a") as f:
+        f.write(json.dumps({
+            "metric": "bench_suite", "quick": False,
+            "key_metrics": {"dfsio.write_mb_s": 104.0}}) + "\n")
+    assert bench_trend.main(["--log", log, "--check"]) == 0
